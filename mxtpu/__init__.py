@@ -1,0 +1,52 @@
+"""mxtpu — a TPU-native deep learning framework with MXNet's capabilities.
+
+A from-scratch re-design of Apache MXNet (incubating) v1.1 for TPU hardware:
+JAX/XLA is the compute substrate (whole-graph jit instead of a per-op async
+engine), Pallas supplies custom kernels, pjit/shard_map over device meshes
+replace KVStore/NCCL/ps-lite for parallelism. The public API mirrors
+MXNet's (nd/sym/module/gluon/autograd/kv/io/optimizer/metric) so users of
+the reference find everything in the same places.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, MXTPUError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .ndarray import NDArray
+
+# Populated as the build proceeds (symbol, module, gluon, io, kvstore, ...).
+def _optional_imports():
+    import importlib
+    g = globals()
+    for name, aliases in [
+        ("symbol", ("sym",)), ("executor", ()), ("optimizer", ("opt",)),
+        ("initializer", ()), ("metric", ()), ("lr_scheduler", ()),
+        ("io", ()), ("callback", ()), ("model", ()), ("module", ("mod",)),
+        ("kvstore", ("kv",)), ("gluon", ()), ("parallel", ()),
+        ("profiler", ()), ("recordio", ()), ("image", ()),
+        ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
+        ("rnn", ()), ("engine", ()),
+    ]:
+        try:
+            m = importlib.import_module("." + name, __name__)
+        except ModuleNotFoundError as e:
+            # only tolerate the submodule itself being absent (still being
+            # built); real import errors inside present modules must surface.
+            if e.name == __name__ + "." + name:
+                continue
+            raise
+        g[name] = m
+        for a in aliases:
+            g[a] = m
+
+
+_optional_imports()
+if "symbol" in globals():
+    Symbol = symbol.Symbol  # noqa: F821
+if "executor" in globals():
+    Executor = executor.Executor  # noqa: F821

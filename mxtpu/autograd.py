@@ -1,0 +1,285 @@
+"""Imperative autograd.
+
+Capability parity with MXNet's tape autograd (``src/imperative/imperative.cc``
+``RecordOp:140-240`` / ``Backward:357`` and ``python/mxnet/autograd.py``):
+``record()`` scopes capture every nd op invocation on a tape; ``backward()``
+walks the tape in reverse, obtaining each op's gradient from ``jax.vjp`` of
+the same pure function that computed the forward (MXNet's FGradient
+equivalent, derived rather than hand-registered).
+
+Stateful ops (Dropout &c.) save their PRNG key on the tape so the vjp
+re-materialises the same mask — the functional rendering of MXNet saving
+mask outputs for backward.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .ops.registry import rng_scope
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "Function", "get_symbol"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape = []
+
+
+_STATE = _State()
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_recording(is_record):
+    prev = _STATE.recording
+    _STATE.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    prev = _STATE.training
+    _STATE.training = bool(train_mode)
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording=None, training=None):
+        self._rec, self._train = recording, training
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *a):
+        _STATE.recording, _STATE.training = self._prev
+
+
+def record(train_mode=True):
+    """Scope in which nd ops are recorded for backward (autograd.py:122)."""
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _Scope(training=True)
+
+
+def predict_mode():
+    return _Scope(training=False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class TapeEntry:
+    __slots__ = ("op", "params", "inputs", "input_values", "outputs",
+                 "rng_key", "custom_backward", "saved")
+
+    def __init__(self, op, params, inputs, input_values, outputs,
+                 rng_key=None, custom_backward=None, saved=None):
+        self.op = op
+        self.params = params
+        self.inputs = inputs            # NDArray objects
+        self.input_values = input_values  # jax values at record time
+        self.outputs = outputs          # NDArray objects
+        self.rng_key = rng_key
+        self.custom_backward = custom_backward
+        self.saved = saved
+
+
+def _tape_append(entry):
+    _STATE.tape.append(entry)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference MXAutogradMarkVariables)."""
+    from .ndarray import NDArray
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._is_ag_variable = True
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from head arrays along the recorded tape
+    (reference: Imperative::Backward imperative.cc:357)."""
+    from .ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    grads = {}
+    for i, h in enumerate(heads):
+        g = None if head_grads is None else head_grads[i]
+        gv = jnp.ones_like(h._data) if g is None else g._data
+        _accum(grads, h, gv)
+
+    tape = _STATE.tape
+    for entry in reversed(tape):
+        out_gs = [grads.get(id(o)) for o in entry.outputs]
+        if all(g is None for g in out_gs):
+            continue
+        cotangents = tuple(
+            jnp.zeros(o._data.shape, o._data.dtype) if g is None else g
+            for o, g in zip(entry.outputs, out_gs))
+        if entry.custom_backward is not None:
+            in_grads = entry.custom_backward(cotangents, entry)
+        else:
+            op = entry.op
+            params = entry.params
+            # differentiate only w.r.t. the NDArray positions; scalar/int
+            # positional args are closed over (MXNet: only tensor inputs
+            # appear as graph entries).
+            nd_pos = [i for i, a in enumerate(entry.inputs)
+                      if a is not None and i not in op.aux_update]
+
+            def fwd_fn(*xs):
+                vals = list(entry.input_values)
+                for p, x in zip(nd_pos, xs):
+                    vals[p] = x
+                if entry.rng_key is not None:
+                    with rng_scope(entry.rng_key):
+                        r = op.fn(*vals, **params)
+                else:
+                    r = op.fn(*vals, **params)
+                return r if isinstance(r, tuple) else (r,)
+
+            primals = [entry.input_values[p] for p in nd_pos]
+            _, vjp_fn = jax.vjp(fwd_fn, *primals)
+            sub_grads = vjp_fn(cotangents)
+            in_grads = [None] * len(entry.inputs)
+            for p, g in zip(nd_pos, sub_grads):
+                in_grads[p] = g
+        for inp, g in zip(entry.inputs, in_grads):
+            if g is not None and inp is not None:
+                _accum(grads, inp, g)
+
+    # write into attached grad buffers
+    seen = set()
+    for entry in tape:
+        for arr in entry.inputs:
+            if arr is None or id(arr) in seen:
+                continue
+            seen.add(id(arr))
+            _write_grad(arr, grads)
+    for h in heads:
+        if id(h) not in seen:
+            _write_grad(h, grads)
+    if not retain_graph:
+        _STATE.tape = []
+
+
+def _write_grad(arr, grads):
+    if getattr(arr, "_grad", None) is not None and id(arr) in grads:
+        g = grads[id(arr)].astype(arr._grad._data.dtype)
+        if getattr(arr, "_grad_req", "write") == "add":
+            arr._grad._data = arr._grad._data + g
+        else:
+            arr._grad._data = g
+
+
+def _accum(grads, arr, value):
+    k = id(arr)
+    if k in grads:
+        grads[k] = grads[k] + value
+    else:
+        grads[k] = value
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables without mutating .grad."""
+    from .ndarray import NDArray, array
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    # temporarily attach scratch grads
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write"))
+             for v in variables]
+    from . import ndarray as nd_mod
+    scratch = [nd_mod.zeros(v.shape, dtype=v.dtype) for v in variables]
+    mark_variables(variables, scratch)
+    backward(heads, head_grads, retain_graph=bool(retain_graph),
+             train_mode=train_mode)
+    for v, (g, req) in zip(variables, saved):
+        v._grad, v._grad_req = g, req
+    return scratch[0] if single else scratch
+
+
+def get_symbol(x):  # parity stub: tape-to-symbol export arrives with Symbol
+    raise NotImplementedError("get_symbol is not supported yet")
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.py:406-507).
+
+    Subclass and implement forward(self, *inputs) and backward(self, *grads).
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, _wrap
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            def custom_backward(cotangents, entry):
+                from .ndarray import _wrap
+                gs = [_wrap(c) for c in cotangents]
+                with pause():
+                    in_grads = self.backward(*gs)
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return [g._data if g is not None else None for g in in_grads]
+
+            entry = TapeEntry(
+                op=None, params={},
+                inputs=[i for i in inputs if isinstance(i, NDArray)],
+                input_values=[i._data for i in inputs if isinstance(i, NDArray)],
+                outputs=outs, custom_backward=custom_backward)
+            _tape_append(entry)
+        return outs[0] if single else outs

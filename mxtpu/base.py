@@ -1,0 +1,72 @@
+"""Base types, dtype mapping, and error classes for the mxtpu framework.
+
+TPU-native re-design of the capabilities in Apache MXNet's
+``include/mxnet/base.h`` and ``python/mxnet/base.py``: instead of a C ABI +
+ctypes marshalling layer, the runtime is JAX/XLA; this module keeps the
+dtype/name registries and the exception type that every layer shares.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # pragma: no cover - exercised implicitly on import
+    import jax
+    import jax.numpy as jnp
+except ImportError as e:  # pragma: no cover
+    raise ImportError("mxtpu requires jax") from e
+
+__all__ = ["MXNetError", "MXTPUError", "string_types", "numeric_types",
+           "DTYPE_TO_ID", "ID_TO_DTYPE", "canonical_dtype"]
+
+
+class MXTPUError(RuntimeError):
+    """Framework error (capability parity with MXNetError in base.py)."""
+
+
+# Alias so code written against the reference API keeps working.
+MXNetError = MXTPUError
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+# MXNet's integer dtype codes (reference: python/mxnet/base.py _DTYPE_NP_TO_MX)
+# extended with bfloat16, the native TPU matmul type.
+DTYPE_TO_ID = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    jnp.bfloat16.dtype: 7,
+    np.dtype(np.bool_): 8,
+}
+ID_TO_DTYPE = {v: k for k, v in DTYPE_TO_ID.items()}
+
+_DTYPE_ALIASES = {
+    "float": np.dtype(np.float32),
+    "double": np.dtype(np.float64),
+    "half": np.dtype(np.float16),
+    "bfloat16": jnp.bfloat16.dtype,
+}
+
+
+def canonical_dtype(dtype):
+    """Normalise a user-provided dtype spec to a numpy dtype object."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str) and dtype in _DTYPE_ALIASES:
+        return _DTYPE_ALIASES[dtype]
+    if dtype is jnp.bfloat16 or getattr(dtype, "name", None) == "bfloat16":
+        return jnp.bfloat16.dtype
+    return np.dtype(dtype)
+
+
+def _as_list(obj):
+    """Return obj as a list (None -> [])."""
+    if obj is None:
+        return []
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]

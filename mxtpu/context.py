@@ -1,0 +1,128 @@
+"""Device context model.
+
+Capability parity with ``include/mxnet/base.h:142-168`` (Context: kCPU/kGPU/
+kCPUPinned/kCPUShared) re-designed for TPU: a Context names a JAX device.
+``tpu`` is the first-class accelerator type; ``gpu`` is accepted as an alias
+for the default accelerator so reference-written scripts keep running.
+
+Unlike MXNet there is no per-device stream/engine pair to manage: XLA owns
+scheduling. A Context resolves lazily to a ``jax.Device`` so that importing
+mxtpu never forces backend initialisation.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+
+
+class Context:
+    """A device context (device_type, device_id) resolving to a jax.Device."""
+
+    # MXNet device mask values (base.h:142-168) kept for API parity.
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in self.devstr2type:
+                raise ValueError("unknown device type %r" % (device_type,))
+            self.device_typeid = self.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return self.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __str__ = __repr__
+
+    # -- jax resolution ---------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (lazy; raises if out of range)."""
+        devs = _devices_for(self.device_type)
+        if not devs:
+            raise RuntimeError("no %s devices available" % self.device_type)
+        return devs[self.device_id % len(devs)]
+
+    # -- scope protocol (with mx.Context(...):) ---------------------------
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, *args):
+        Context._default_ctx.value = self._old_ctx
+
+    @classmethod
+    def default_ctx(cls):
+        if not hasattr(cls._default_ctx, "value"):
+            cls._default_ctx.value = Context("cpu", 0)
+        return cls._default_ctx.value
+
+
+def _devices_for(device_type):
+    """Best-effort mapping from a device-type string to jax devices."""
+    if device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            # cpu backend unavailable under some platform pinnings; fall back
+            # to the default backend so code still runs.
+            return jax.devices()
+    # accelerator types: tpu preferred, then whatever the default backend is.
+    try:
+        return jax.devices("tpu")
+    except RuntimeError:
+        pass
+    devs = jax.devices()
+    return [d for d in devs if d.platform != "cpu"] or devs
+
+
+def cpu(device_id=0):
+    """Return a CPU context."""
+    return Context("cpu", device_id)
+
+
+def gpu(device_id=0):
+    """Alias for the default accelerator (API parity with mx.gpu)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    """Return a TPU context — the first-class accelerator of this framework."""
+    return Context("tpu", device_id)
+
+
+def num_gpus():
+    return num_tpus()
+
+
+def num_tpus():
+    devs = _devices_for("tpu")
+    return len([d for d in devs if d.platform != "cpu"])
+
+
+def current_context():
+    """The default context of the current scope."""
+    return Context.default_ctx()

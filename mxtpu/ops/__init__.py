@@ -1,0 +1,17 @@
+"""Operator library: one registry backing nd.* (imperative) and sym.* (symbolic).
+
+TPU-first re-design of ``src/operator/`` (91k LoC of C++/CUDA in the
+reference): each op is a single pure-JAX function lowered by XLA to every
+backend, with Pallas kernels substituting where stock lowering is weak.
+"""
+from .registry import (OpDef, register, get_op, list_ops, alias,
+                       next_rng_key, rng_scope, set_global_seed)
+
+# Importing these modules populates the registry.
+from . import elemwise       # noqa: F401
+from . import reduce         # noqa: F401
+from . import shape_ops      # noqa: F401
+from . import nn             # noqa: F401
+from . import random_ops     # noqa: F401
+from . import optim_ops      # noqa: F401
+from . import linalg_ops     # noqa: F401

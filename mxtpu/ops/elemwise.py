@@ -1,0 +1,131 @@
+"""Elementwise / broadcast / scalar op families.
+
+Capability parity with ``src/operator/tensor/elemwise_*`` (unary/binary/
+broadcast/scalar/logic macro families) — here each family is a few lines of
+jnp, fused by XLA instead of hand-scheduled mshadow kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# Binary broadcast family. MXNet splits elemwise_* (same-shape) from
+# broadcast_* — jnp broadcasting subsumes both, so they share implementations.
+# ---------------------------------------------------------------------------
+
+def _binary(name, fn, aliases=()):
+    register(name, aliases=aliases)(fn)
+
+_binary("broadcast_add", lambda a, b: jnp.add(a, b),
+        aliases=("elemwise_add", "_plus", "_add", "add_n_pair"))
+_binary("broadcast_sub", lambda a, b: jnp.subtract(a, b),
+        aliases=("elemwise_sub", "_minus", "_sub"))
+_binary("broadcast_mul", lambda a, b: jnp.multiply(a, b),
+        aliases=("elemwise_mul", "_mul"))
+_binary("broadcast_div", lambda a, b: jnp.divide(a, b),
+        aliases=("elemwise_div", "_div"))
+_binary("broadcast_mod", lambda a, b: jnp.mod(a, b), aliases=("_mod",))
+_binary("broadcast_power", lambda a, b: jnp.power(a, b), aliases=("_power", "pow"))
+_binary("broadcast_maximum", lambda a, b: jnp.maximum(a, b), aliases=("_maximum", "maximum"))
+_binary("broadcast_minimum", lambda a, b: jnp.minimum(a, b), aliases=("_minimum", "minimum"))
+_binary("broadcast_hypot", lambda a, b: jnp.hypot(a, b), aliases=("_hypot",))
+_binary("arctan2", lambda a, b: jnp.arctan2(a, b))
+
+for _n, _f in [
+    ("equal", jnp.equal), ("not_equal", jnp.not_equal),
+    ("greater", jnp.greater), ("greater_equal", jnp.greater_equal),
+    ("lesser", jnp.less), ("lesser_equal", jnp.less_equal),
+    ("logical_and", jnp.logical_and), ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    def _mk(f):
+        # comparisons return same-dtype 0/1 arrays like MXNet, not bools
+        def g(a, b):
+            out = f(a, b)
+            d = jnp.result_type(a)
+            return out.astype(d if jnp.issubdtype(d, jnp.floating) or
+                              jnp.issubdtype(d, jnp.integer) else jnp.float32)
+        return g
+    register("broadcast_" + _n, differentiable=False,
+             aliases=("_" + _n, _n))(_mk(_f))
+
+
+# ---------------------------------------------------------------------------
+# Unary math family (mshadow_op.h functors).
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "negative": jnp.negative,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "reciprocal": lambda x: 1.0 / x,
+    "erf": lambda x: jax.scipy.special.erf(x),
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "sigmoid": lambda x: jax.nn.sigmoid(x),
+    "softsign": lambda x: x / (1 + jnp.abs(x)),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "softrelu": lambda x: jnp.logaddexp(x, 0.0),
+    "logical_not": lambda x: (x == 0).astype(jnp.result_type(x)),
+}
+
+for _n, _f in _UNARY.items():
+    register(_n, differentiable=_n not in ("sign", "round", "rint", "ceil",
+                                           "floor", "trunc", "fix",
+                                           "logical_not"))(_f)
+
+alias("negative", "_neg")
+alias("abs", "_abs")
+
+
+@register("clip")
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data, absd - 0.5 / s2)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_element_wise_sum"))
+def add_n(*args):
+    """Sum of N arrays (reference src/ndarray/ndarray.cc:1225 ElementwiseSum)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out

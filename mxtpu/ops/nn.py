@@ -1,0 +1,541 @@
+"""Neural-network ops.
+
+Capability parity with ``src/operator/nn/*`` (Convolution, FullyConnected,
+BatchNorm, Pooling, Activation, softmax, Dropout, LRN, Embedding, UpSampling,
+...) and the loss/output heads (SoftmaxOutput etc., which in MXNet carry
+custom backward semantics — rendered here with ``jax.custom_vjp``).
+
+TPU notes: matmuls/convs hit the MXU through lax.dot_general /
+lax.conv_general_dilated; XLA fuses the elementwise tails. Layout is NCHW at
+the API (MXNet default) — XLA re-layouts internally for TPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, next_rng_key
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference: src/operator/nn/fully_connected-inl.h:103-165,
+# cuBLAS linalg_gemm there; one dot_general on the MXU here).
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                    flatten=True):
+    if flatten:
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data
+    out = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32
+                          if x.dtype == jnp.bfloat16 else None)
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v if v else (1,) * n
+
+
+def _conv_dims(ndim):
+    if ndim == 3:  # NCW
+        return ("NCH", "OIH", "NCH")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False,
+                workspace=1024, cudnn_tune=None, cudnn_off=False, layout=None):
+    """NCHW conv on the MXU. Weight layout (num_filter, C/group, *kernel)
+    matches the reference (src/operator/nn/convolution-inl.h)."""
+    nsp = data.ndim - 2
+    stride = _pair(stride, nsp) if stride else (1,) * nsp
+    dilate = _pair(dilate, nsp) if dilate else (1,) * nsp
+    pad = _pair(pad, nsp) if pad else (0,) * nsp
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(data.ndim))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
+                  no_bias=True, workspace=512, cudnn_tune=None,
+                  cudnn_off=False, layout=None):
+    """Transposed conv (reference src/operator/nn/deconvolution-inl.h).
+    Weight layout (C_in, num_filter/group, *kernel) as in MXNet."""
+    nsp = data.ndim - 2
+    stride = _pair(stride, nsp) if stride else (1,) * nsp
+    dilate = _pair(dilate, nsp) if dilate else (1,) * nsp
+    pad = _pair(pad, nsp) if pad else (0,) * nsp
+    kernel = _pair(kernel, nsp) if kernel else weight.shape[2:]
+    # Transposed conv = gradient of conv w.r.t. its input: use
+    # conv_general_dilated with lhs_dilation (fractional stride).
+    # Flip spatial dims of the kernel and swap in/out channels.
+    w = jnp.flip(weight, axis=tuple(range(2, weight.ndim)))
+    w = jnp.swapaxes(w, 0, 1)  # (out/group? ...) -> (num_filter/group, C_in, ...)
+    # padding for full correlation
+    pads = []
+    for i in range(nsp):
+        k = (kernel[i] - 1) * dilate[i]
+        pads.append((k - pad[i], k - pad[i] + (adj[i] if adj else 0)))
+    if num_group > 1:
+        # grouped deconv: split channels, run per group, concat
+        xs = jnp.split(data, num_group, axis=1)
+        ws = jnp.split(w, num_group, axis=0)
+        outs = []
+        for xg, wg in zip(xs, ws):
+            dn = lax.conv_dimension_numbers(xg.shape, wg.shape, _conv_dims(data.ndim))
+            outs.append(lax.conv_general_dilated(
+                xg, wg, window_strides=(1,) * nsp, padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn))
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        dn = lax.conv_dimension_numbers(data.shape, w.shape, _conv_dims(data.ndim))
+        out = lax.conv_general_dilated(
+            data, w, window_strides=(1,) * nsp, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: src/operator/nn/pooling-inl.h) via reduce_window.
+# ---------------------------------------------------------------------------
+
+@register("Pooling", aliases=("pooling",))
+def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
+            pad=(), pooling_convention="valid", cudnn_off=False,
+            count_include_pad=True):
+    nsp = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nsp
+        pad = (0,) * nsp
+    else:
+        kernel = _pair(kernel, nsp)
+        stride = _pair(stride, nsp) if stride else (1,) * nsp
+        pad = _pair(pad, nsp) if pad else (0,) * nsp
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    if pooling_convention == "full":
+        # ceil-mode: pad high edge enough that ceil division is covered
+        pads = [(0, 0), (0, 0)]
+        for i in range(nsp):
+            in_sz = data.shape[2 + i] + 2 * pad[i]
+            out_sz = -(-(in_sz - kernel[i]) // stride[i]) + 1  # ceil
+            needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz
+            pads.append((pad[i], pad[i] + max(needed, 0)))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return summed / counts
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+@register("Activation", aliases=("activation",))
+def activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jnp.logaddexp(data, 0.0)
+    if act_type == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU", needs_train_flag=True, stateful=True)
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, _training=False):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "rrelu":
+        if _training:
+            u = jax.random.uniform(next_rng_key(), data.shape, dtype=data.dtype,
+                                   minval=lower_bound, maxval=upper_bound)
+            return jnp.where(data >= 0, data, u * data)
+        s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, s * data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+# ---------------------------------------------------------------------------
+# Softmax family
+# ---------------------------------------------------------------------------
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+# ---------------------------------------------------------------------------
+# Normalisation
+# ---------------------------------------------------------------------------
+
+def _bn_stats(data, axis):
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    mean = jnp.mean(data, axis=red)
+    var = jnp.var(data, axis=red)
+    return mean, var
+
+
+@register("BatchNorm", aliases=("batch_norm", "BatchNorm_v1"),
+          num_outputs=3, user_outputs=1, aux_update={3: 1, 4: 2},
+          needs_train_flag=True)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               _training=False):
+    """Reference: src/operator/nn/batch_norm.cc. Returns
+    (out, new_moving_mean, new_moving_var); the runtime writes the moving
+    stats back into the aux arrays (MXNet mutates aux_states in the kernel).
+    """
+    axis = axis % data.ndim
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    shape = tuple(shape)
+    if _training and not use_global_stats:
+        mean, var = _bn_stats(data, axis)
+        new_mm = moving_mean * momentum + mean * (1 - momentum)
+        new_mv = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(shape)) * inv.reshape(shape) * g.reshape(shape) \
+        + beta.reshape(shape)
+    return out, new_mm, new_mv
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis % data.ndim] = data.shape[axis % data.ndim]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / n
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    window = jnp.zeros_like(sq)
+    for i in range(nsize):
+        window = window + lax.dynamic_slice_in_dim(padded, i, data.shape[1], axis=1)
+    return data * jnp.power(knorm + alpha * window / nsize, -beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (stateful; reference src/operator/nn/dropout-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("Dropout", stateful=True, needs_train_flag=True)
+def dropout(data, p=0.5, mode="training", axes=(), _training=False):
+    if p == 0.0 or (not _training and mode != "always"):
+        return data
+    shape = list(data.shape)
+    for ax in axes:
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(next_rng_key(), keep, tuple(shape))
+    return jnp.where(mask, data / keep, jnp.zeros_like(data))
+
+
+# ---------------------------------------------------------------------------
+# Embedding (reference src/operator/tensor/indexing_op.h EmbeddingOp)
+# ---------------------------------------------------------------------------
+
+@register("Embedding")
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# UpSampling
+# ---------------------------------------------------------------------------
+
+@register("UpSampling")
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=512):
+    data = args[0]
+    if sample_type == "nearest":
+        outs = []
+        for a in args:
+            o = jnp.repeat(jnp.repeat(a, scale, axis=2), scale, axis=3)
+            outs.append(o)
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    # bilinear: weight is args[1]; use resize (deconv-equivalent capability)
+    b, c, h, w = data.shape
+    return jax.image.resize(data, (b, c, h * scale, w * scale), method="linear")
+
+
+# ---------------------------------------------------------------------------
+# Loss / output heads with MXNet's custom backward semantics.
+# ---------------------------------------------------------------------------
+
+def _softmax_output_impl(data, label, grad_scale, ignore_label, multi_output,
+                         use_ignore, preserve_shape, normalization,
+                         out_grad, smooth_alpha):
+    if multi_output:
+        out = jax.nn.softmax(data, axis=1)
+    elif preserve_shape:
+        out = jax.nn.softmax(data, axis=-1)
+    else:
+        out = jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _make_softmax_output(grad_scale, ignore_label, multi_output, use_ignore,
+                         preserve_shape, normalization, out_grad, smooth_alpha):
+    @jax.custom_vjp
+    def f(data, label):
+        return _softmax_output_impl(data, label, grad_scale, ignore_label,
+                                    multi_output, use_ignore, preserve_shape,
+                                    normalization, out_grad, smooth_alpha)
+
+    def fwd(data, label):
+        out = f(data, label)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        if multi_output:
+            # data: (B, C, ...); label: (B, ...)
+            C = out.shape[1]
+            lab = label.astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, C, dtype=out.dtype)
+            onehot = jnp.moveaxis(onehot, -1, 1)
+            grad = out - onehot
+            if smooth_alpha:
+                grad = grad + smooth_alpha * (onehot - 1.0 / C)
+            if use_ignore:
+                mask = (label != ignore_label).astype(out.dtype)
+                grad = grad * jnp.expand_dims(mask, 1)
+            valid = (label != ignore_label).sum() if use_ignore else label.size
+        else:
+            C = out.shape[-1]
+            flat = out.reshape(out.shape[0], -1)
+            lab = label.reshape(-1).astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, flat.shape[-1], dtype=out.dtype)
+            grad = (flat - onehot).reshape(out.shape)
+            if smooth_alpha:
+                grad = grad + smooth_alpha * (onehot.reshape(out.shape) - 1.0 / C)
+            if use_ignore:
+                mask = (label != ignore_label).astype(out.dtype).reshape(
+                    (-1,) + (1,) * (out.ndim - 1))
+                grad = grad * mask
+            valid = (label != ignore_label).sum() if use_ignore else label.shape[0]
+        if normalization == "valid":
+            grad = grad / jnp.maximum(valid, 1).astype(out.dtype)
+        elif normalization == "batch":
+            grad = grad / out.shape[0]
+        grad = grad * grad_scale
+        if out_grad:
+            grad = grad * g
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("SoftmaxOutput", aliases=("Softmax",), needs_train_flag=False)
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Softmax forward; backward is d(CE)/d(data) directly, ignoring the head
+    gradient — exactly the reference's semantics
+    (src/operator/softmax_output-inl.h)."""
+    f = _make_softmax_output(float(grad_scale), float(ignore_label),
+                             bool(multi_output), bool(use_ignore),
+                             bool(preserve_shape), str(normalization),
+                             bool(out_grad), float(smooth_alpha))
+    return f(data, label)
+
+
+def _regression(name, fwd_fn, grad_fn):
+    @functools.lru_cache(maxsize=None)
+    def make(grad_scale):
+        @jax.custom_vjp
+        def f(data, label):
+            return fwd_fn(data)
+
+        def fwd(data, label):
+            out = f(data, label)
+            return out, (out, label)
+
+        def bwd(res, g):
+            out, label = res
+            num = 1
+            for s in out.shape[1:]:
+                num *= s
+            grad = grad_fn(out, label.reshape(out.shape)) * grad_scale / num
+            return grad.astype(out.dtype), jnp.zeros_like(label)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    @register(name)
+    def op(data, label, grad_scale=1.0):
+        return make(float(grad_scale))(data, label)
+    op.__name__ = name
+    return op
+
+
+_regression("LinearRegressionOutput", lambda d: d, lambda o, l: o - l)
+_regression("MAERegressionOutput", lambda d: d, lambda o, l: jnp.sign(o - l))
+_regression("LogisticRegressionOutput", jax.nn.sigmoid, lambda o, l: o - l)
+
+
+@register("MakeLoss")
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x.shape
+
+    def bwd(shape, g):
+        grad = jnp.full(shape, grad_scale, dtype=g.dtype)
+        if normalization == "batch":
+            grad = grad / shape[0]
+        return (grad,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data):
+    return lax.stop_gradient(data)
+
+
+@register("identity", aliases=("_copy", "copy"))
+def identity(data):
+    return data
+
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        lab = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, d.shape[1], dtype=d.dtype)
+        score_t = jnp.sum(d * onehot, axis=1, keepdims=True)
+        viol = (d - score_t + margin) > 0
+        if use_linear:
+            grad = jnp.where(viol, regularization_coefficient, 0.0)
+        else:
+            grad = jnp.where(viol, 2 * regularization_coefficient *
+                             (d - score_t + margin), 0.0)
+        grad = grad * (1 - onehot) - onehot * jnp.sum(grad * (1 - onehot),
+                                                      axis=1, keepdims=True)
+        return grad.astype(d.dtype), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)

@@ -1,0 +1,152 @@
+"""Optimizer update ops.
+
+Capability parity with ``src/operator/optimizer_op.*`` (sgd_update,
+sgd_mom_update, mp_* fp16 master-weight variants, adam_update, rmsprop,
+ftml, signsgd/signum, ftrl). In MXNet these run as graph ops so updates
+stay on-device and overlap with communication; here they are pure jax
+functions the Optimizer/Trainer jits (XLA fuses each into one kernel).
+
+Multi-output ops return (new_weight, new_state...); the frontend writes
+results back into the passed arrays.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+               lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient >= 0 else None, wd, weight)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient >= 0 else None, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    """fp16 weights with fp32 master copy (reference mp_sgd_update)."""
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad,
+                      clip_gradient if clip_gradient >= 0 else None,
+                      wd, weight32)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad,
+                      clip_gradient if clip_gradient >= 0 else None,
+                      wd, weight32)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", num_outputs=3)
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient >= 0 else None, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", num_outputs=2)
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient >= 0 else None, wd, weight)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", num_outputs=4)
+def rmspropalex_update(weight, grad, n, g_state, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad,
+                      clip_gradient if clip_gradient >= 0 else None, wd, weight)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * g_state
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftml_update", num_outputs=3)
+def ftml_update(weight, grad, d, v, z, lr, t=1, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = grad * rescale_grad
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma_t = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma_t * weight
+    new_w = -new_z / d_t - lr * wd * weight
+    return new_w, d_t, new_v, new_z
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", num_outputs=2)
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+@register("ftrl_update", num_outputs=3)
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd),
+        jnp.zeros_like(weight))
+    return new_w, new_z, new_n

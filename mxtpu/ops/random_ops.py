@@ -1,0 +1,141 @@
+"""Random sampling ops.
+
+Capability parity with ``src/operator/random/`` (uniform/normal/gamma/
+exponential/poisson/neg-binomial samplers, multinomial, shuffle). MXNet
+threads per-device PRNG resources through ResourceRequest; here randomness is
+a functional PRNG key from the registry's rng plumbing, which becomes an
+explicit input of compiled graphs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, next_rng_key
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register("random_uniform", stateful=True, differentiable=False,
+          aliases=("_random_uniform", "uniform"))
+def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32"):
+    return jax.random.uniform(next_rng_key(), _shape(shape), dtype=jnp.dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@register("random_normal", stateful=True, differentiable=False,
+          aliases=("_random_normal", "normal"))
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32"):
+    return loc + scale * jax.random.normal(next_rng_key(), _shape(shape),
+                                           dtype=jnp.dtype(dtype))
+
+
+@register("random_gamma", stateful=True, differentiable=False,
+          aliases=("_random_gamma",))
+def random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32"):
+    return beta * jax.random.gamma(next_rng_key(), alpha, _shape(shape),
+                                   dtype=jnp.dtype(dtype))
+
+
+@register("random_exponential", stateful=True, differentiable=False,
+          aliases=("_random_exponential",))
+def random_exponential(lam=1.0, shape=None, dtype="float32"):
+    return jax.random.exponential(next_rng_key(), _shape(shape),
+                                  dtype=jnp.dtype(dtype)) / lam
+
+
+@register("random_poisson", stateful=True, differentiable=False,
+          aliases=("_random_poisson",))
+def random_poisson(lam=1.0, shape=None, dtype="float32"):
+    return jax.random.poisson(next_rng_key(), lam, _shape(shape)).astype(dtype)
+
+
+@register("random_negative_binomial", stateful=True, differentiable=False,
+          aliases=("_random_negative_binomial",))
+def random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32"):
+    key1, key2 = jax.random.split(next_rng_key())
+    g = jax.random.gamma(key1, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(key2, g).astype(dtype)
+
+
+@register("random_generalized_negative_binomial", stateful=True,
+          differentiable=False,
+          aliases=("_random_generalized_negative_binomial",))
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                         dtype="float32"):
+    key1, key2 = jax.random.split(next_rng_key())
+    if alpha == 0.0:
+        return jax.random.poisson(key1, mu, _shape(shape)).astype(dtype)
+    g = jax.random.gamma(key1, 1.0 / alpha, _shape(shape)) * alpha * mu
+    return jax.random.poisson(key2, g).astype(dtype)
+
+
+@register("random_randint", stateful=True, differentiable=False,
+          aliases=("_random_randint", "randint"))
+def random_randint(low=0, high=1, shape=None, dtype="int32"):
+    return jax.random.randint(next_rng_key(), _shape(shape), low, high,
+                              dtype=jnp.dtype(dtype))
+
+
+# sample_* families: per-element distribution params
+@register("sample_uniform", stateful=True, differentiable=False)
+def sample_uniform(low, high, shape=None, dtype=None):
+    s = _shape(shape)
+    out_shape = low.shape + s
+    u = jax.random.uniform(next_rng_key(), out_shape, dtype=low.dtype)
+    low_b = low.reshape(low.shape + (1,) * len(s))
+    high_b = high.reshape(high.shape + (1,) * len(s))
+    return low_b + u * (high_b - low_b)
+
+
+@register("sample_normal", stateful=True, differentiable=False)
+def sample_normal(mu, sigma, shape=None, dtype=None):
+    s = _shape(shape)
+    out_shape = mu.shape + s
+    n = jax.random.normal(next_rng_key(), out_shape, dtype=mu.dtype)
+    return mu.reshape(mu.shape + (1,) * len(s)) + \
+        sigma.reshape(sigma.shape + (1,) * len(s)) * n
+
+
+@register("sample_gamma", stateful=True, differentiable=False)
+def sample_gamma(alpha, beta, shape=None, dtype=None):
+    s = _shape(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    b = beta.reshape(beta.shape + (1,) * len(s))
+    g = jax.random.gamma(next_rng_key(), jnp.broadcast_to(a, alpha.shape + s))
+    return g * b
+
+
+@register("sample_multinomial", stateful=True, differentiable=False,
+          aliases=("_sample_multinomial", "multinomial"))
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    """data: (..., K) probabilities. Returns draws of given shape."""
+    s = _shape(shape)
+    n = 1
+    for d in s:
+        n *= d
+    n = max(n, 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    draws = jax.random.categorical(next_rng_key(), logits, axis=-1,
+                                   shape=(n,) + data.shape[:-1])
+    # -> (..., n) then reshape
+    draws = jnp.moveaxis(draws, 0, -1)
+    out = draws.reshape(data.shape[:-1] + s if s else data.shape[:-1])
+    out = out.astype(dtype)
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits),
+            draws.reshape(data.shape[:-1] + (n,)).astype(jnp.int32), axis=-1)
+        return out, lp.reshape(out.shape)
+    return out
+
+
+@register("shuffle", stateful=True, differentiable=False, aliases=("_shuffle",))
+def shuffle(data):
+    return jax.random.permutation(next_rng_key(), data, axis=0)

@@ -1,0 +1,108 @@
+"""Reduction and ordering ops.
+
+Capability parity with ``src/operator/tensor/broadcast_reduce_op*`` and
+``ordering_op-inl.h`` (topk/sort/argsort, CUB-based in the reference —
+XLA sort/top_k here).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return int(axis)
+
+
+def _reduce(fn):
+    def impl(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            if isinstance(ax, int):
+                ax = (ax,)
+            ax = tuple(i for i in range(data.ndim) if i not in
+                       tuple(a % data.ndim for a in ax))
+        return fn(data, axis=ax, keepdims=keepdims)
+    return impl
+
+
+register("sum", aliases=("sum_axis",))(_reduce(jnp.sum))
+register("mean")(_reduce(jnp.mean))
+register("prod")(_reduce(jnp.prod))
+register("max", aliases=("max_axis",))(_reduce(jnp.max))
+register("min", aliases=("min_axis",))(_reduce(jnp.min))
+register("nansum")(_reduce(jnp.nansum))
+register("nanprod")(_reduce(jnp.nanprod))
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", differentiable=False)
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("topk", differentiable=False, num_outputs=2)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Reference: src/operator/tensor/ordering_op-inl.h. Uses XLA top_k."""
+    axis = axis % data.ndim
+    moved = jnp.moveaxis(data, axis, -1)
+    # XLA top_k returns the k largest; negate to get the k smallest.
+    _, idx = jax.lax.top_k(-moved if is_ascend else moved, k)
+    vals = jnp.take_along_axis(moved, idx, axis=-1)
+    idxf = jnp.moveaxis(idx, -1, axis).astype(dtype)
+    valsm = jnp.moveaxis(vals, -1, axis)
+    if ret_typ == "indices":
+        return idxf
+    if ret_typ == "value":
+        return valsm
+    if ret_typ == "both":
+        return valsm, idxf
+    if ret_typ == "mask":
+        onehot = jax.nn.one_hot(idx, moved.shape[-1], dtype=data.dtype).sum(-2)
+        return jnp.moveaxis(onehot, -1, axis)
+    raise ValueError("unknown ret_typ %r" % ret_typ)
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", differentiable=False)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype)

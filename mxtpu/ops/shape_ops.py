@@ -1,0 +1,273 @@
+"""Shape manipulation and indexing ops.
+
+Capability parity with ``src/operator/tensor/matrix_op-inl.h`` (reshape/
+transpose/slice family), ``indexing_op.h`` (take/gather_nd/scatter_nd/
+one_hot/Embedding-side indexing) — static-shape XLA formulations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("reshape", aliases=("Reshape",))
+def reshape(data, shape=None, reverse=False):
+    """MXNet reshape incl. special codes 0 (keep), -1 (infer), -2 (copy rest),
+    -3 (merge two), -4 (split). Reference: matrix_op-inl.h InferReshapeShape."""
+    if shape is None:
+        return data
+    ishape = list(data.shape)
+    if reverse:
+        ishape = ishape[::-1]
+        shape = tuple(shape)[::-1]
+    out = []
+    i = 0  # index into ishape
+    it = iter(range(len(shape)))
+    shape = list(shape)
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(ishape[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(ishape[i:]); i = len(ishape)
+        elif s == -3:
+            out.append(ishape[i] * ishape[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = ishape[i] // b
+            if b == -1:
+                b = ishape[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s)
+            if i < len(ishape):
+                i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+@register("flatten", aliases=("Flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    if axes is None or axes == ():
+        return jnp.transpose(data)
+    return jnp.transpose(data, axes)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("concat", aliases=("Concat",))
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",), num_outputs=None)
+def split(data, num_outputs=2, axis=1, squeeze_axis=False):
+    outs = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    slices = []
+    step = tuple(step) if step else (None,) * len(begin)
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) else None
+        slices.append(slice(b, e, s))
+    return data[tuple(slices)]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes = axes or tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for ax in axes:
+        idx[ax] = slice(0, shape_like.shape[ax])
+    return data[tuple(idx)]
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1).squeeze(1)
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis if axis >= 0 else data.ndim + axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
+    return oh * on_value + (1 - oh) * off_value
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[idx].add(data)
+
+
+@register("tile")
+def tile(data, reps):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise ValueError(mode)
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, axis=0):
+    if isinstance(axis, (list, tuple)):
+        for a in axis:
+            data = jnp.flip(data, axis=a)
+        return data
+    return jnp.flip(data, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=()):
+    tgt = tuple(int(s) if s != 0 else data.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("broadcast_like")
+def broadcast_like(data, like):
+    return jnp.broadcast_to(data, like.shape)
+
+
+@register("cast", aliases=("Cast",))
+def cast(data, dtype="float32"):
+    from ..base import canonical_dtype
+    return data.astype(canonical_dtype(dtype))
+
+
+@register("_index")
+def _index(data, key=()):
+    """Differentiable basic indexing (backs NDArray.__getitem__ under
+    autograd recording)."""
+    return data[key]
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.array(data.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def size_array(data):
+    return jnp.array([data.size], dtype=jnp.int32)
+
+
+@register("diag")
+def diag(data, k=0):
+    return jnp.diag(data, k=k) if data.ndim <= 2 else jnp.diagonal(data, offset=k)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = data.reshape(b, bs, bs, c // (bs * bs), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size):
+    b, c, h, w = data.shape
+    bs = block_size
+    x = data.reshape(b, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(b, c * bs * bs, h // bs, w // bs)

@@ -1,0 +1,65 @@
+"""Random namespace (parity with python/mxnet/random.py + mx.nd.random)."""
+from __future__ import annotations
+
+from .ops.registry import set_global_seed
+from . import ndarray as nd
+
+__all__ = ["seed", "uniform", "normal", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle", "randint"]
+
+
+def seed(seed_state):
+    """Seed the global PRNG (reference MXRandomSeed; on TPU this reseeds the
+    functional key chain used by all stateful ops)."""
+    set_global_seed(int(seed_state))
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return nd.random_uniform(low=low, high=high, shape=shape or (1,),
+                             dtype=dtype, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return nd.random_normal(loc=loc, scale=scale, shape=shape or (1,),
+                            dtype=dtype, out=out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return nd.random_gamma(alpha=alpha, beta=beta, shape=shape or (1,),
+                           dtype=dtype, out=out)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return nd.random_exponential(lam=1.0 / scale, shape=shape or (1,),
+                                 dtype=dtype, out=out)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return nd.random_poisson(lam=lam, shape=shape or (1,), dtype=dtype, out=out)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None,
+                      out=None):
+    return nd.random_negative_binomial(k=k, p=p, shape=shape or (1,),
+                                       dtype=dtype, out=out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, out=None):
+    return nd.random_generalized_negative_binomial(
+        mu=mu, alpha=alpha, shape=shape or (1,), dtype=dtype, out=out)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", out=None):
+    return nd.sample_multinomial(data, shape=shape, get_prob=get_prob,
+                                 dtype=dtype, out=out)
+
+
+def shuffle(data, out=None):
+    return nd.shuffle(data, out=out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    return nd.random_randint(low=low, high=high, shape=shape or (1,),
+                             dtype=dtype, out=out)

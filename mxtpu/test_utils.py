@@ -1,0 +1,197 @@
+"""Test utilities.
+
+Capability parity with ``python/mxnet/test_utils.py``: numeric-gradient
+checking (``check_numeric_gradient`` :792), symbolic forward/backward checks
+(:925, :999), ``assert_almost_equal`` (:470), and cross-device consistency
+(``check_consistency`` :1207 — cpu↔tpu here instead of cpu↔gpu).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .context import cpu, current_context
+from .ndarray import NDArray
+
+__all__ = ["assert_almost_equal", "almost_equal", "same", "rand_ndarray",
+           "rand_shape_nd", "check_numeric_gradient",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_consistency", "default_context"]
+
+
+def default_context():
+    return current_context()
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def same(a, b):
+    return np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return np.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6, names=("a", "b")):
+    a, b = _as_np(a), _as_np(b)
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        diff = np.abs(a - b)
+        rel = diff / (np.abs(b) + atol)
+        raise AssertionError(
+            "%s and %s differ: max abs %g, max rel %g" %
+            (names[0], names[1], diff.max(), rel.max()))
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=np.float32,
+                 ctx=None):
+    arr = np.random.uniform(-1, 1, size=shape).astype(dtype)
+    out = nd.array(arr, ctx=ctx)
+    if stype != "default":
+        return out.tostype(stype)
+    return out
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=1e-4, grad_nodes=None, ctx=None):
+    """Compare executor gradients against finite differences
+    (reference test_utils.py:792)."""
+    ctx = ctx or cpu()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    location = {k: (v.asnumpy() if isinstance(v, NDArray)
+                    else np.asarray(v, np.float32)) for k, v in location.items()}
+    grad_nodes = grad_nodes or list(location)
+
+    ex = sym.simple_bind(ctx=ctx, grad_req={n: ("write" if n in grad_nodes
+                                                else "null")
+                                            for n in arg_names},
+                         **{k: v.shape for k, v in location.items()})
+    for k, v in location.items():
+        ex.arg_dict[k][:] = v
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = v
+
+    out = ex.forward(is_train=True)
+    # random projection to a scalar
+    proj = [np.random.normal(0, 1.0, size=o.shape).astype(np.float32)
+            for o in out]
+    ex.backward([nd.array(p) for p in proj])
+    analytic = {n: ex.grad_dict[n].asnumpy() for n in grad_nodes}
+
+    def f_of(xs_map):
+        for k, v in xs_map.items():
+            ex.arg_dict[k][:] = v
+        outs = ex.forward(is_train=True)
+        s = 0.0
+        for o, p in zip(outs, proj):
+            s += float((o.asnumpy() * p).sum())
+        return s
+
+    for n in grad_nodes:
+        x = location[n].astype(np.float64)
+        g = np.zeros_like(x)
+        flat = x.reshape(-1)
+        gf = g.reshape(-1)
+        for j in range(flat.size):
+            old = flat[j]
+            flat[j] = old + numeric_eps
+            location[n] = x.astype(np.float32)
+            fp = f_of(location)
+            flat[j] = old - numeric_eps
+            location[n] = x.astype(np.float32)
+            fm = f_of(location)
+            flat[j] = old
+            location[n] = x.astype(np.float32)
+            gf[j] = (fp - fm) / (2 * numeric_eps)
+        assert_almost_equal(analytic[n], g, rtol=rtol, atol=atol,
+                            names=("analytic_%s" % n, "numeric_%s" % n))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           aux_states=None, ctx=None):
+    ctx = ctx or cpu()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    ex = sym.simple_bind(ctx=ctx, grad_req="null",
+                         **{k: np.asarray(v).shape for k, v in location.items()})
+    for k, v in location.items():
+        ex.arg_dict[k][:] = _as_np(v)
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = _as_np(v)
+    outs = ex.forward(is_train=False)
+    for o, e in zip(outs, expected):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol)
+    return outs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
+                            atol=1e-6, aux_states=None, grad_req="write",
+                            ctx=None):
+    ctx = ctx or cpu()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(arg_names, expected))
+    ex = sym.simple_bind(ctx=ctx, grad_req=grad_req,
+                         **{k: np.asarray(v).shape for k, v in location.items()})
+    for k, v in location.items():
+        ex.arg_dict[k][:] = _as_np(v)
+    if aux_states:
+        for k, v in aux_states.items():
+            ex.aux_dict[k][:] = _as_np(v)
+    ex.forward(is_train=True)
+    ex.backward([nd.array(_as_np(g)) for g in out_grads])
+    for k, e in expected.items():
+        assert_almost_equal(ex.grad_dict[k], e, rtol=rtol, atol=atol,
+                            names=("grad_" + k, "expected_" + k))
+    return ex.grad_dict
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, tol=None):
+    """Run the same symbol on several contexts and compare
+    (reference test_utils.py:1207 cpu/gpu consistency — cpu/tpu here)."""
+    assert len(ctx_list) > 1
+    exes = []
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx")
+        type_dict = spec.pop("type_dict", None)
+        ex = sym.simple_bind(ctx=ctx, grad_req=grad_req,
+                             type_dict=type_dict, **spec)
+        exes.append(ex)
+    # same init everywhere
+    ref = exes[0]
+    for name, arr in ref.arg_dict.items():
+        v = np.random.normal(0, scale, size=arr.shape).astype(np.float32)
+        if arg_params and name in arg_params:
+            v = arg_params[name]
+        for ex in exes:
+            ex.arg_dict[name][:] = v.astype(_as_np(ex.arg_dict[name]).dtype)
+    outs = [ex.forward(is_train=True) for ex in exes]
+    for o in outs[1:]:
+        for a, b in zip(outs[0], o):
+            assert_almost_equal(a, b.asnumpy().astype(np.float32),
+                                rtol=1e-3, atol=1e-3)
+    for ex in exes:
+        ex.backward([nd.ones(o.shape, ctx=ex._ctx) for o in ex.outputs])
+    for ex in exes[1:]:
+        for n in ref.grad_dict:
+            assert_almost_equal(ref.grad_dict[n],
+                                ex.grad_dict[n].asnumpy().astype(np.float32),
+                                rtol=1e-3, atol=1e-3)
+    return exes

@@ -1,0 +1,23 @@
+"""Test configuration: force a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of faking multi-device with multiple CPU
+contexts in one process (tests/python/unittest/test_multi_device_exec.py):
+here we give XLA 8 host devices so jax.sharding Meshes exercise real
+collectives without TPU hardware.
+
+Note: the environment's sitecustomize registers an `axon` TPU backend and
+calls jax.config.update("jax_platforms", "axon,cpu") at interpreter start,
+which overrides the JAX_PLATFORMS env var — so we must force the config
+value back to "cpu" after importing jax, or tests would try to grab the
+(single, possibly busy) TPU chip.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

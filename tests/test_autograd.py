@@ -1,0 +1,126 @@
+"""Autograd tests (modelled on tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.ndarray as nd
+import mxtpu.autograd as ag
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_and_broadcast():
+    x = nd.array(np.random.randn(3, 4).astype("f"))
+    w = nd.array(np.random.randn(5, 4).astype("f"))
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = nd.FullyConnected(data=x, weight=w, num_hidden=5, no_bias=True)
+        z = nd.relu(y).sum()
+    z.backward()
+    mask = (x.asnumpy() @ w.asnumpy().T) > 0
+    expected_w = mask.T.astype("f") @ x.asnumpy()
+    assert np.allclose(w.grad.asnumpy(), expected_w, atol=1e-5)
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), 3 * 2 * x.asnumpy())
+
+
+def test_not_recording_outside_scope():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    with ag.record():
+        z = x * 3
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [3.0])
+
+
+def test_train_mode_dropout():
+    x = nd.ones((100, 100))
+    with ag.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    with ag.record(train_mode=False):
+        y2 = nd.Dropout(x, p=0.5)
+    assert np.allclose(y2.asnumpy(), x.asnumpy())
+    assert ag.is_recording() is False
+
+
+def test_dropout_backward_same_mask():
+    x = nd.ones((50, 50))
+    x.attach_grad()
+    with ag.record():
+        y = nd.Dropout(x, p=0.5)
+    y.backward()
+    # grad is 2.0 where kept, 0 where dropped — matches forward mask
+    yv = y.asnumpy()
+    g = x.grad.asnumpy()
+    assert np.allclose((g > 0), (yv > 0))
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+        z = y.detach() * 2
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_autograd_grad_function():
+    x = nd.array([1.0, 2.0])
+    with ag.record():
+        y = (x * x * x).sum()
+    g = ag.grad(y, x)
+    assert np.allclose(g.asnumpy(), 3 * x.asnumpy() ** 2)
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.5, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), atol=1e-6)
+
+
+def test_softmax_output_ce_gradient():
+    # SoftmaxOutput backward must be softmax - onehot (ignoring head grad)
+    x = nd.array(np.random.randn(4, 3).astype("f"))
+    label = nd.array([0.0, 1.0, 2.0, 1.0])
+    x.attach_grad()
+    with ag.record():
+        out = nd.SoftmaxOutput(data=x, label=label)
+    out.backward()
+    p = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
+    onehot = np.eye(3)[label.asnumpy().astype(int)]
+    assert np.allclose(x.grad.asnumpy(), p - onehot, atol=1e-5)

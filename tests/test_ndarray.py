@@ -1,0 +1,114 @@
+"""NDArray tests (modelled on tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.ndarray as nd
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert a.context.device_type == "cpu"
+    b = nd.zeros((3, 4))
+    assert (b.asnumpy() == 0).all()
+    c = nd.ones((2,), dtype="int32")
+    assert c.dtype == np.int32
+    d = nd.full((2, 2), 7.0)
+    assert (d.asnumpy() == 7).all()
+    e = nd.arange(0, 10, 2)
+    assert list(e.asnumpy()) == [0, 2, 4, 6, 8]
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    assert np.allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    assert np.allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    assert np.allclose((a * 2).asnumpy(), [[2, 4], [6, 8]])
+    assert np.allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    assert np.allclose((1 / a).asnumpy(), 1 / a.asnumpy())
+    assert np.allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert np.allclose((-a).asnumpy(), -a.asnumpy())
+    a += b
+    assert np.allclose(a.asnumpy(), [[11, 22], [33, 44]])
+
+
+def test_comparison_returns_numeric():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    eq = (a == b).asnumpy()
+    assert eq.dtype == np.float32
+    assert list(eq) == [0, 1, 0]
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4).astype("f"))
+    assert a[1].shape == (4,)
+    assert np.allclose(a[1:3].asnumpy(), np.arange(12).reshape(3, 4)[1:3])
+    a[0] = 99.0
+    assert (a.asnumpy()[0] == 99).all()
+    a[1:3] = 0.0
+    assert (a.asnumpy()[1:] == 0).all()
+
+
+def test_reshape_and_methods():
+    a = nd.array(np.arange(24).astype("f"))
+    b = a.reshape(2, 3, 4)
+    assert b.shape == (2, 3, 4)
+    assert b.reshape((-1,)).shape == (24,)
+    # mxnet special codes
+    c = b.reshape(0, -1)
+    assert c.shape == (2, 12)
+    assert a.sum().asscalar() == pytest.approx(276.0)
+    assert b.transpose(axes=(2, 0, 1)).shape == (4, 2, 3)
+    assert b.flatten().shape == (2, 12)
+    assert b.expand_dims(axis=0).shape == (1, 2, 3, 4)
+
+
+def test_dot():
+    a = nd.array(np.random.randn(3, 4).astype("f"))
+    b = nd.array(np.random.randn(4, 5).astype("f"))
+    c = nd.dot(a, b)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+
+
+def test_copyto_context():
+    a = nd.array([1.0, 2.0])
+    b = a.as_in_context(mx.cpu(1))
+    assert b.context == mx.cpu(1)
+    assert np.allclose(a.asnumpy(), b.asnumpy())
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    d = {"w": nd.array([1.0, 2.0]), "b": nd.array([[3.0]])}
+    nd.save(f, d)
+    back = nd.load(f)
+    assert set(back) == {"w", "b"}
+    assert np.allclose(back["w"].asnumpy(), [1, 2])
+
+
+def test_random_seeded():
+    mx.random.seed(42)
+    a = nd.random_uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random_uniform(shape=(5,)).asnumpy()
+    assert np.allclose(a, b)
+    c = nd.random_normal(loc=1.0, scale=0.0, shape=(3,)).asnumpy()
+    assert np.allclose(c, 1.0)
+
+
+def test_wait_and_scalar():
+    a = nd.array([3.5])
+    a.wait_to_read()
+    assert a.asscalar() == pytest.approx(3.5)
+    nd.waitall()
+
+
+def test_astype_and_T():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.astype("int32").dtype == np.int32
+    assert a.T.shape == (2, 2)
+    assert np.allclose(a.T.asnumpy(), a.asnumpy().T)

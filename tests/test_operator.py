@@ -1,0 +1,199 @@
+"""Operator correctness tests (modelled on tests/python/unittest/test_operator.py:
+per-op forward vs numpy + numeric-gradient checks)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.ndarray as nd
+import mxtpu.symbol as sym
+from mxtpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                              check_symbolic_forward)
+
+
+def test_unary_vs_numpy():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype("f")
+    a = nd.array(x)
+    for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                      ("square", np.square), ("tanh", np.tanh),
+                      ("abs", np.abs), ("floor", np.floor),
+                      ("sigmoid", lambda v: 1 / (1 + np.exp(-v)))]:
+        out = getattr(nd, name)(a).asnumpy()
+        assert np.allclose(out, ref(x), rtol=1e-5, atol=1e-6), name
+
+
+def test_broadcast_binary():
+    a = np.random.randn(2, 3, 1).astype("f")
+    b = np.random.randn(1, 3, 4).astype("f")
+    assert np.allclose(nd.broadcast_add(nd.array(a), nd.array(b)).asnumpy(),
+                       a + b)
+    assert np.allclose(nd.broadcast_maximum(nd.array(a), nd.array(b)).asnumpy(),
+                       np.maximum(a, b))
+
+
+def test_reductions():
+    x = np.random.randn(2, 3, 4).astype("f")
+    a = nd.array(x)
+    assert np.allclose(nd.sum(a, axis=1).asnumpy(), x.sum(1), atol=1e-5)
+    assert np.allclose(nd.mean(a, axis=(0, 2)).asnumpy(), x.mean((0, 2)), atol=1e-5)
+    assert np.allclose(nd.max(a, axis=2, keepdims=True).asnumpy(),
+                       x.max(2, keepdims=True))
+    assert np.allclose(nd.norm(a).asnumpy(), np.sqrt((x ** 2).sum()), rtol=1e-4)
+    assert np.allclose(nd.argmax(a, axis=1).asnumpy(), x.argmax(1))
+
+
+def test_topk_sort():
+    x = np.random.randn(4, 10).astype("f")
+    a = nd.array(x)
+    idx = nd.topk(a, k=3).asnumpy()
+    ref = np.argsort(-x, axis=1)[:, :3]
+    assert np.allclose(idx, ref)
+    assert np.allclose(nd.sort(a, is_ascend=False).asnumpy(),
+                       -np.sort(-x, axis=1))
+
+
+def test_concat_split_stack():
+    a = np.random.randn(2, 3).astype("f")
+    b = np.random.randn(2, 3).astype("f")
+    out = nd.concat(nd.array(a), nd.array(b), dim=1).asnumpy()
+    assert np.allclose(out, np.concatenate([a, b], 1))
+    parts = nd.split(nd.array(np.hstack([a, b])), num_outputs=2, axis=1)
+    assert np.allclose(parts[0].asnumpy(), a)
+    st = nd.stack(nd.array(a), nd.array(b), axis=0).asnumpy()
+    assert st.shape == (2, 2, 3)
+
+
+def test_take_onehot_pick():
+    w = np.random.randn(10, 4).astype("f")
+    idx = np.array([1, 5, 9], dtype="f")
+    out = nd.take(nd.array(w), nd.array(idx)).asnumpy()
+    assert np.allclose(out, w[idx.astype(int)])
+    oh = nd.one_hot(nd.array(idx), 10).asnumpy()
+    assert oh.shape == (3, 10)
+    assert (oh.argmax(1) == idx.astype(int)).all()
+    data = np.random.randn(3, 5).astype("f")
+    picked = nd.pick(nd.array(data), nd.array([0.0, 2.0, 4.0])).asnumpy()
+    assert np.allclose(picked, data[np.arange(3), [0, 2, 4]])
+
+
+def test_convolution_shapes_and_grad():
+    x = sym.var("data")
+    c = sym.Convolution(data=x, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                        name="conv0")
+    _, out_shapes, _ = c.infer_shape(data=(2, 3, 8, 8))
+    assert out_shapes[0] == (2, 4, 8, 8)
+    check_numeric_gradient(
+        c, {"data": np.random.randn(1, 2, 5, 5).astype("f") * 0.5,
+            "conv0_weight": np.random.randn(2, 2, 3, 3).astype("f") * 0.5,
+            "conv0_bias": np.zeros(2, "f")},
+        rtol=5e-2, atol=1e-2)
+
+
+def test_pooling():
+    x = np.arange(16, dtype="f").reshape(1, 1, 4, 4)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    assert np.allclose(out[0, 0], [[5, 7], [13, 15]])
+    avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="avg").asnumpy()
+    assert np.allclose(avg[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    g = nd.Pooling(nd.array(x), global_pool=True, pool_type="max").asnumpy()
+    assert g.shape == (1, 1, 1, 1) and g[0, 0, 0, 0] == 15
+
+
+def test_fullyconnected_numeric_grad():
+    x = sym.var("data")
+    f = sym.FullyConnected(data=x, num_hidden=3, name="fc")
+    check_numeric_gradient(
+        f, {"data": np.random.randn(2, 4).astype("f"),
+            "fc_weight": np.random.randn(3, 4).astype("f"),
+            "fc_bias": np.random.randn(3).astype("f")},
+        rtol=2e-2, atol=1e-2)
+
+
+def test_batchnorm_train_eval():
+    x = np.random.randn(4, 3, 2, 2).astype("f") * 2 + 1
+    d = sym.var("data")
+    bn = sym.BatchNorm(data=d, fix_gamma=False, name="bn")
+    ex = bn.simple_bind(mx.cpu(), data=x.shape)
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    ex.arg_dict["data"][:] = x
+    out = ex.forward(is_train=True)[0].asnumpy()
+    # normalized per-channel
+    assert abs(out.mean()) < 1e-4
+    assert abs(out.std() - 1.0) < 1e-2
+    # eval mode uses moving stats
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    assert not np.allclose(out, out_eval)
+
+
+def test_softmax_and_logsoftmax():
+    x = np.random.randn(3, 5).astype("f")
+    s = nd.softmax(nd.array(x)).asnumpy()
+    assert np.allclose(s.sum(1), 1.0, atol=1e-5)
+    ls = nd.log_softmax(nd.array(x)).asnumpy()
+    assert np.allclose(np.exp(ls), s, atol=1e-5)
+
+
+def test_embedding():
+    w = np.random.randn(10, 4).astype("f")
+    idx = nd.array([0.0, 3.0, 9.0])
+    out = nd.Embedding(data=idx, weight=nd.array(w), input_dim=10,
+                       output_dim=4).asnumpy()
+    assert np.allclose(out, w[[0, 3, 9]])
+
+
+def test_activation_leakyrelu():
+    x = np.array([[-2.0, 3.0]], dtype="f")
+    assert np.allclose(nd.LeakyReLU(nd.array(x), slope=0.1).asnumpy(),
+                       [[-0.2, 3.0]])
+    e = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy()
+    assert np.allclose(e, [[np.expm1(-2.0), 3.0]], atol=1e-6)
+
+
+def test_transpose_slice_family():
+    x = np.random.randn(2, 3, 4).astype("f")
+    a = nd.array(x)
+    assert np.allclose(nd.transpose(a, axes=(1, 0, 2)).asnumpy(),
+                       x.transpose(1, 0, 2))
+    assert np.allclose(nd.slice_axis(a, axis=2, begin=1, end=3).asnumpy(),
+                       x[:, :, 1:3])
+    assert np.allclose(nd.flip(a, axis=1).asnumpy(), x[:, ::-1])
+    assert np.allclose(nd.tile(a, reps=(1, 2, 1)).asnumpy(),
+                       np.tile(x, (1, 2, 1)))
+
+
+def test_where_clip():
+    x = np.random.randn(3, 3).astype("f")
+    c = (x > 0).astype("f")
+    out = nd.where(nd.array(c), nd.array(x), nd.array(-x)).asnumpy()
+    assert (out >= 0).all()
+    assert np.allclose(nd.clip(nd.array(x), 0.0, 0.5).asnumpy(),
+                       np.clip(x, 0, 0.5))
+
+
+def test_linalg_ops():
+    a = np.random.randn(3, 4).astype("f")
+    b = np.random.randn(3, 4).astype("f")
+    out = nd.linalg_gemm2(nd.array(a), nd.array(b), transpose_b=True).asnumpy()
+    assert np.allclose(out, a @ b.T, atol=1e-5)
+    spd = np.eye(3, dtype="f") * 2 + 0.1
+    l = nd.linalg_potrf(nd.array(spd)).asnumpy()
+    assert np.allclose(l @ l.T, spd, atol=1e-5)
+
+
+def test_batch_dot():
+    a = np.random.randn(5, 2, 3).astype("f")
+    b = np.random.randn(5, 3, 4).astype("f")
+    out = nd.batch_dot(nd.array(a), nd.array(b)).asnumpy()
+    assert np.allclose(out, a @ b, atol=1e-5)
+
+
+def test_optimizer_update_ops():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.1])
+    new_w = nd.sgd_update(w, g, lr=1.0, wd=0.0)
+    assert np.allclose(new_w.asnumpy(), [0.9, 1.9])
+    mom = nd.zeros((2,))
+    outs = nd.sgd_mom_update(w, g, mom, lr=1.0, momentum=0.9)
+    assert np.allclose(outs[0].asnumpy(), [0.9, 1.9])
