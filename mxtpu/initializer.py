@@ -1,0 +1,378 @@
+"""Weight initializers.
+
+Capability parity with ``python/mxnet/initializer.py`` (726 LoC): an
+``Initializer`` registry dispatched by parameter name through ``InitDesc``,
+with Zero/One/Constant/Uniform/Normal/Orthogonal/Xavier/MSRAPrelu/Bilinear/
+LSTMBias/Load/Mixed. TPU-first: values are produced with jax PRNG via the
+framework RNG stream so initialization is reproducible under
+``mx.random.seed`` and can run on-device.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .ops.registry import next_rng_key
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["InitDesc", "Initializer", "register", "Zero", "One", "Constant",
+           "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+           "Bilinear", "LSTMBias", "Load", "Mixed", "FusedRNN"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer class under its lowercased name."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Name + attrs descriptor for the array being initialized
+    (reference initializer.py:InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer; callable on (InitDesc/name, NDArray)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        if print_func is None:
+            def asum_stat(x):
+                return str((_np.abs(x.asnumpy()).mean(),))
+            print_func = asum_stat
+        self._print_func = print_func
+        return self
+
+    def _verbose_print(self, desc, init, arr):
+        if self._verbose and self._print_func:
+            logging.info("Initialized %s as %s: %s", desc, init,
+                         self._print_func(arr))
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        if desc.global_init is None:
+            desc.global_init = self
+        init = desc.attrs.get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init) if init.startswith("[") \
+                else (init, {})
+            create(klass, **kwargs)._init_weight(desc, arr)
+            self._verbose_print(desc, init, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+            self._verbose_print(desc, "weight", arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("min"):
+            self._init_zero(desc, arr)
+        elif name.endswith("max"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("moving_avg"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- leaf rules --------------------------------------------------------
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("Must override _init_weight")
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            "Unknown initialization pattern for %s. Default initialization "
+            "is now limited to 'weight', 'bias', 'gamma', and 'beta'. Please "
+            "use mx.sym.Variable(init=mx.init.*) to set the pattern." % name)
+
+    def __eq__(self, other):
+        return isinstance(other, self.__class__) \
+            and self._kwargs == other._kwargs
+
+
+def create(name, **kwargs):
+    """Create an initializer from registry name or pass through instances."""
+    if isinstance(name, Initializer):
+        return name
+    if callable(name) and not isinstance(name, type):
+        return name
+    key = name.lower() if isinstance(name, str) else name
+    if key not in _INIT_REGISTRY:
+        raise ValueError("unknown initializer %r" % (name,))
+    return _INIT_REGISTRY[key](**kwargs)
+
+
+def _set(arr, value):
+    arr._data = jnp.asarray(value, dtype=arr._data.dtype).reshape(arr.shape)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference initializer.py Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        key = next_rng_key()
+        _set(arr, jax.random.uniform(key, arr.shape, jnp.float32,
+                                     -self.scale, self.scale))
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma^2)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        key = next_rng_key()
+        _set(arr, jax.random.normal(key, arr.shape, jnp.float32) * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (Saxe et al.; reference initializer.py)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        key = next_rng_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        _set(arr, self.scale * q.reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot init (reference initializer.py:Xavier)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                "Xavier initializer cannot be applied to vector %s. It "
+                "requires at least 2D." % name)
+        if len(shape) > 2:
+            hw_scale = float(_np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = _np.sqrt(self.magnitude / factor)
+        key = next_rng_key()
+        if self.rnd_type == "uniform":
+            _set(arr, jax.random.uniform(key, shape, jnp.float32,
+                                         -scale, scale))
+        elif self.rnd_type == "gaussian":
+            _set(arr, jax.random.normal(key, shape, jnp.float32) * scale)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He/MSRA init for PReLU nets (reference initializer.py:MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (for Deconvolution upsampling layers)."""
+
+    def _init_weight(self, _, arr):
+        shape = arr.shape
+        weight = _np.zeros(int(_np.prod(shape)), dtype="float32")
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        _set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Zero bias except forget gate set to ``forget_bias``."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _np.zeros(arr.shape, dtype="float32")
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        _set(arr, b)
+
+
+@register
+class Load:
+    """Initialize from a dict of arrays, falling back to default_init."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k[4:] if k.startswith("arg:") or k.startswith("aux:")
+                      else k: v for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise ValueError("Parameter %s cannot be initialized from "
+                                 "loading. Shape mismatch, target %s vs "
+                                 "loaded %s" % (name, arr.shape, src.shape))
+            arr._data = src._data if isinstance(src, NDArray) \
+                else jnp.asarray(src)
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    "Cannot Initialize parameter %s. Not found in loaded "
+                    "param and no default initializer provided." % name)
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.info("Initialized %s by default", name)
+
+
+@register
+class Mixed:
+    """Dispatch by regex over parameter names (reference Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(
+            "Parameter name %s did not match any pattern. Consider adding a "
+            '".*" pattern at the and with default Initializer.' % name)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize fused RNN parameter blobs by slicing per-gate
+    (reference initializer.py:FusedRNN, simplified: one flat init)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _INIT_REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._mode = mode
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        if self._init is not None:
+            self._init._init_weight(desc, arr)
+        else:
+            Uniform(0.07)._init_weight(desc, arr)

@@ -1,0 +1,16 @@
+"""Module API: intermediate/high-level training interface.
+
+Capability parity with ``python/mxnet/module/``: BaseModule (fit/score/
+predict), Module (bind/init_params/init_optimizer/forward/backward/update),
+BucketingModule (shape-keyed executor cache — on TPU a shape-keyed jit
+cache), SequentialModule, PythonModule/PythonLossModule.
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule",
+           "PythonModule", "PythonLossModule", "DataParallelExecutorGroup"]

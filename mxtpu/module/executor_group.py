@@ -1,0 +1,323 @@
+"""Data-parallel executor group.
+
+Capability parity with ``python/mxnet/module/executor_group.py`` (289-650):
+slices each batch across a list of contexts, binds one executor per
+context, scatters inputs / gathers outputs, and accumulates gradients per
+device.
+
+TPU-first note: this class reproduces the reference's explicit
+multi-context data parallelism (used by the faked multi-device tests and
+CPU meshes). The idiomatic large-scale path is ``mxtpu.parallel``'s
+pjit/shard_map trainer, where XLA inserts the collectives; here gradient
+reduction happens through the KVStore facade exactly like the reference's
+``_update_params`` flow.
+"""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup", "_split_input_slice"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice the batch by workload (reference executor_group.py uses
+    mxnet.executor_manager._split_input_slice)."""
+    total = sum(work_load_list)
+    batch_num_list = [round(batch_size * (float(w) / total))
+                      for w in work_load_list]
+    # fix rounding drift
+    diff = batch_size - sum(batch_num_list)
+    batch_num_list[-1] += diff
+    slices = []
+    start = 0
+    for n in batch_num_list:
+        slices.append(slice(start, start + int(n)))
+        start += int(n)
+    return slices
+
+
+def _load_general(data, targets):
+    """Scatter host batch arrays into per-executor buffers."""
+    for d_src, d_targets in zip(data, targets):
+        for slice_idx, d_dst in d_targets:
+            if d_src.shape[0] == d_dst.shape[0]:
+                d_dst._data = d_src._data
+            else:
+                d_dst._data = d_src[slice_idx]._data
+
+
+class DataParallelExecutorGroup:
+    """A group of executors, one per context, each on a batch slice
+    (reference executor_group.py:289)."""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload if workload else [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        if not for_training:
+            grad_req = "null"
+        data_names = [x.name if isinstance(x, DataDesc) else x[0]
+                      for x in data_shapes]
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = "null" \
+                        if k in self.fixed_param_names else grad_req
+                elif k in data_names:
+                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self.grad_req = {k: "null" for k in self.arg_names}
+            self.grad_req.update(grad_req)
+        else:
+            raise ValueError("invalid grad_req")
+
+        self.execs = []
+        self._total_exec_bytes = 0
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_layouts = None
+        self.label_layouts = None
+        self.output_layouts = [
+            DataDesc.get_batch_axis(self.symbol[i].attr("__layout__"))
+            for i in range(len(self.symbol.list_outputs()))]
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """Per-context batch slices (reference executor_group.py:330)."""
+        assert len(data_shapes) > 0
+        major_axis = [DataDesc.get_batch_axis(getattr(x, "layout", "NCHW"))
+                      for x in data_shapes]
+        for (name, shape), axis in zip(
+                [(x.name, x.shape) for x in data_shapes], major_axis):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size, \
+                    ("all data must have the same batch size: batch_size = "
+                     "%d, but %s has shape %s" % (self.batch_size, name,
+                                                  shape))
+            else:
+                self.batch_size = batch_size
+                self.slices = _split_input_slice(self.batch_size,
+                                                 self.workload)
+        return major_axis
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        """Bind one executor per context on sliced shapes
+        (reference executor_group.py:bind_exec)."""
+        assert reshape or not self.execs
+        self.batch_size = None
+        self.data_layouts = self.decide_slices(data_shapes)
+        if label_shapes is not None:
+            self.label_layouts = self.decide_slices(label_shapes)
+
+        self.execs = []
+        for i in range(len(self.contexts)):
+            data_shapes_i = self._sliced_shape(data_shapes, i,
+                                               self.data_layouts)
+            if label_shapes is not None:
+                label_shapes_i = self._sliced_shape(label_shapes, i,
+                                                    self.label_layouts)
+            else:
+                label_shapes_i = []
+            shapes = {x.name: x.shape for x in data_shapes_i + label_shapes_i}
+            exec_ = self.symbol.simple_bind(
+                ctx=self.contexts[i], grad_req=self.grad_req, **shapes)
+            self.execs.append(exec_)
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.data_names = [x.name for x in self.data_shapes]
+        if label_shapes is not None:
+            self.label_names = [x.name for x in self.label_shapes]
+        self._collect_arrays()
+
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and \
+                label_shapes == self.label_shapes:
+            return
+        self.execs = []
+        self.bind_exec(data_shapes, label_shapes, reshape=False)
+
+    def _sliced_shape(self, shapes, i, major_axis):
+        sliced = []
+        for desc, axis in zip(shapes, major_axis):
+            shape = list(desc.shape)
+            if axis >= 0:
+                shape[axis] = self.slices[i].stop - self.slices[i].start
+            sliced.append(DataDesc(desc.name, tuple(shape),
+                                   getattr(desc, "dtype", _np.float32),
+                                   getattr(desc, "layout", "NCHW")))
+        return sliced
+
+    def _collect_arrays(self):
+        self.data_arrays = [
+            [(self.slices[i], e.arg_dict[name])
+             for i, e in enumerate(self.execs)]
+            for name in self.data_names]
+        if self.label_shapes is not None:
+            self.label_arrays = [
+                [(self.slices[i], e.arg_dict[name])
+                 for i, e in enumerate(self.execs)]
+                for name in self.label_names if name in self.execs[0].arg_dict]
+        else:
+            self.label_arrays = None
+        self.param_arrays = [
+            [e.arg_dict[name] for e in self.execs]
+            for name in self.param_names if name in self.arg_names]
+        if self.for_training:
+            self.grad_arrays = [
+                [e.grad_dict.get(name) for e in self.execs]
+                for name in self.param_names if name in self.arg_names]
+        else:
+            self.grad_arrays = None
+        self.aux_arrays = [
+            [e.aux_dict[name] for e in self.execs]
+            for name in self.aux_names]
+        data_names = [x.name for x in self.data_shapes]
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [
+                [e.grad_dict.get(name) for e in self.execs]
+                for name in data_names]
+        else:
+            self.input_grad_arrays = None
+
+    # -- params ------------------------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for exec_ in self.execs:
+            exec_.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Average params over devices into the given dicts
+        (reference executor_group.py:get_params)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            if len(block) == 1:
+                weight = block[0].copy()
+            else:
+                weight = sum(b.asnumpy() for b in block) / len(block)
+                weight = nd.array(weight)
+            arg_params[name] = weight.astype(arg_params[name].dtype) \
+                if name in arg_params else weight
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            if len(block) == 1:
+                weight = block[0].copy()
+            else:
+                weight = nd.array(sum(b.asnumpy() for b in block) / len(block))
+            aux_params[name] = weight
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """Scatter batch, run forward on every executor
+        (reference executor_group.py:422)."""
+        _load_general(data_batch.data, self.data_arrays)
+        if is_train is None:
+            is_train = self.for_training
+        if self.label_arrays is not None and data_batch.label:
+            _load_general(data_batch.label, self.label_arrays)
+        for exec_ in self.execs:
+            exec_.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        """Run backward on every executor (reference executor_group.py:554)."""
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        for i, exec_ in enumerate(self.execs):
+            out_grads_slice = []
+            if out_grads is not None:
+                for grad, axis in zip(out_grads, self.output_layouts):
+                    if axis >= 0:
+                        og_my_slice = nd.slice_axis(grad, axis=axis,
+                                                    begin=self.slices[i].start,
+                                                    end=self.slices[i].stop)
+                        out_grads_slice.append(
+                            og_my_slice.as_in_context(self.contexts[i]))
+                    else:
+                        out_grads_slice.append(
+                            grad.copyto(self.contexts[i]))
+                exec_.backward(out_grads=out_grads_slice)
+            else:
+                exec_.backward()
+
+    def get_outputs(self, merge_multi_context=True, begin=0, end=None):
+        """Gather outputs; concat across devices if merging
+        (reference executor_group.py:get_outputs)."""
+        if end is None:
+            end = len(self.execs[0].outputs)
+        outputs = [[exec_.outputs[i] for exec_ in self.execs]
+                   for i in range(begin, end)]
+        if merge_multi_context:
+            outputs = _merge_multi_context(outputs, self.output_layouts)
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        if merge_multi_context:
+            return _merge_multi_context(self.input_grad_arrays,
+                                        self.data_layouts)
+        return self.input_grad_arrays
+
+    def get_states(self, merge_multi_context=True):
+        assert not merge_multi_context, \
+            "merge_multi_context=True is not supported for get_states yet."
+        return [[] for _ in self.execs]
+
+    def set_states(self, states=None, value=None):
+        pass
+
+    def update_metric(self, eval_metric, labels):
+        """Per-executor metric update on the matching label slice
+        (reference executor_group.py:update_metric)."""
+        for current_exec, islice in zip(self.execs, self.slices):
+            labels_slice = []
+            for label, axis in zip(labels, self.label_layouts or
+                                   [0] * len(labels)):
+                if axis == 0:
+                    labels_slice.append(label[islice])
+                elif axis > 0:
+                    label_my_slice = nd.slice_axis(label, axis=axis,
+                                                   begin=islice.start,
+                                                   end=islice.stop)
+                    labels_slice.append(label_my_slice)
+                else:
+                    labels_slice.append(label)
+            eval_metric.update(labels_slice, current_exec.outputs)
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            mon.install(exe)
+
+
+def _merge_multi_context(outputs, major_axis):
+    """Concat per-device outputs along the batch axis."""
+    rets = []
+    for tensors, axis in zip(outputs, major_axis):
+        if len(tensors) == 1:
+            rets.append(tensors[0])
+        elif axis >= 0:
+            rets.append(nd.concat(*tensors, dim=axis))
+        else:
+            rets.append(tensors[0])
+    return rets

@@ -436,7 +436,10 @@ def save(fname, data):
         payload = {"__arr_%d" % i: v.asnumpy() for i, v in enumerate(data)}
     else:
         raise TypeError("save expects NDArray, dict, or list")
-    _np.savez(fname, **payload)
+    # write to the exact filename (np.savez(str) would append ".npz",
+    # breaking the reference's `prefix-%04d.params` naming)
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
 
 
 def load(fname):

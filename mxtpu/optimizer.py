@@ -1,0 +1,773 @@
+"""Optimizers.
+
+Capability parity with ``python/mxnet/optimizer.py`` (1,519 LoC): Optimizer
+base with registry, lr/wd multipliers, param_idx2name, ``create_state``/
+``update``, plus SGD (+fp16 master weights), Signum, FTML, LBSGD, DCASGD,
+NAG, SGLD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam, Test, and
+the ``Updater`` wrapper with serializable states (used by KVStore servers).
+
+TPU-first: each update is a registered graph op (``ops/optim_ops.py``) — a
+pure jax function XLA fuses into one kernel; the sharded-trainer path
+(``mxtpu.parallel``) jits the same functions over a mesh so optimizer math
+runs SPMD next to psum'd gradients instead of on a parameter server.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+import warnings
+
+import numpy as _np
+import jax.numpy as jnp
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD",
+           "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
+           "Nadam", "Test", "LBSGD", "create", "register", "get_updater",
+           "Updater", "ccSGD"]
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:35)."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None \
+            else ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- registry ----------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            warnings.warn("WARNING: New optimizer %s.%s is overriding "
+                          "existing optimizer %s" % (klass.__module__,
+                                                     klass.__name__, name))
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy = weight.astype(_np.float32)
+            return (weight_master_copy,) + (self.create_state(index,
+                                                              weight_master_copy),)
+        if weight.dtype == _np.float16 and not self.multi_precision:
+            warnings.warn("Accumulating with float16 in optimizer can lead to "
+                          "poor accuracy or slow convergence. Consider using "
+                          "multi_precision=True option of the optimizer")
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy = state[0]
+            original_state = state[1]
+            grad32 = grad.astype(_np.float32)
+            self.update(index, weight_master_copy, grad32, original_state)
+            weight._data = weight_master_copy._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- multipliers -------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_scale(self, args_lrscale):
+        raise DeprecationWarning("Use set_lr_mult instead.")
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            is_fc_bias = n.endswith("_bias")
+            if not (is_weight or is_fc_bias):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    # -- bookkeeping -------------------------------------------------------
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        return self.__dict__
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _clip(g, bound):
+    if bound is not None:
+        return jnp.clip(g, -bound, bound)
+    return g
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional fp32 master weights
+    (reference optimizer.py:432, op sgd_update/sgd_mom_update/mp_*)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if state is not None:
+            new_w, new_mom = nd.sgd_mom_update(
+                weight, grad, state, lr=lr, momentum=self.momentum, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient
+                if self.clip_gradient is not None else -1.0)
+            weight._data = new_w._data
+            state._data = new_mom._data
+        else:
+            new_w = nd.sgd_update(
+                weight, grad, lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient
+                if self.clip_gradient is not None else -1.0)
+            weight._data = new_w._data
+
+
+@register
+class Signum(Optimizer):
+    """signSGD / Signum (reference optimizer.py:560)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        if state is not None:
+            new_w, new_mom = nd.signum_update(
+                weight, grad, state, lr=lr, momentum=self.momentum, wd=wd,
+                rescale_grad=self.rescale_grad, clip_gradient=clip,
+                wd_lh=self.wd_lh)
+            weight._data = new_w._data
+            state._data = new_mom._data
+        else:
+            new_w = nd.signsgd_update(weight, grad, lr=lr, wd=wd,
+                                      rescale_grad=self.rescale_grad,
+                                      clip_gradient=clip)
+            weight._data = new_w._data
+
+
+@register
+class FTML(Optimizer):
+    """FTML optimizer (reference optimizer.py:634)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        d = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        v = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return (d, v, z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        new_w, new_d, new_v, new_z = nd.ftml_update(
+            weight, grad, d, v, z, lr=lr, t=t, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_grad=self.clip_gradient
+            if self.clip_gradient is not None else -1.0)
+        weight._data = new_w._data
+        d._data, v._data, z._data = new_d._data, new_v._data, new_z._data
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS-style layer-wise adaptive rates
+    (reference optimizer.py:682)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        logging.info("Running Large-Batch SGD Algorithm")
+        logging.info("(Batch_scale=%f, warmup_epochs=%d, warmup_strategy=%s, "
+                     "updates_per_epoch=%d)", batch_scale, warmup_epochs,
+                     warmup_strategy, updates_per_epoch)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1
+        self.cumgrads = {}
+        self.adaptive = False
+        self.admult = 1
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def _get_lbmult(self, nup):
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        strategy = self.warmup_strategy
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            mult = maxmult
+        elif nwup <= 1:
+            mult = 1.0
+        else:
+            if strategy == "linear":
+                mult = 1.0 + (maxmult - 1) * nup / nwup
+            elif strategy == "power2":
+                mult = 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+            elif strategy == "sqrt":
+                mult = 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+            else:
+                mult = 1.0
+        return mult
+
+    def _get_lars(self, weight, g, wd):
+        weight2 = float((weight * weight).sum().asscalar())
+        grad2 = float((g * g).sum().asscalar())
+        lars = math.sqrt(weight2 / (grad2 + wd * weight2 + 1e-18))
+        if lars < 0.01:
+            lars = 0.01
+        elif lars > 100:
+            lars = 100
+        return lars
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if self.warmup_strategy == "lars":
+            lbmult = self._get_lars(weight, grad, wd)
+        else:
+            lbmult = self._get_lbmult(self.num_update + self.init_updates)
+        lr = lr * lbmult
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        if state is not None:
+            new_w, new_mom = nd.sgd_mom_update(
+                weight, grad, state, lr=lr, momentum=self.momentum, wd=wd,
+                rescale_grad=self.rescale_grad, clip_gradient=clip)
+            weight._data = new_w._data
+            state._data = new_mom._data
+        else:
+            new_w = nd.sgd_update(weight, grad, lr=lr, wd=wd,
+                                  rescale_grad=self.rescale_grad,
+                                  clip_gradient=clip)
+            weight._data = new_w._data
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py:967)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        mon, previous_weight = state
+        comp = g + wd * weight._data + self.lamda * g * g * \
+            (weight._data - previous_weight._data)
+        if mon is not None:
+            mon._data = self.momentum * mon._data - lr * comp
+            delta = mon._data
+        else:
+            delta = -lr * comp
+        previous_weight._data = weight._data
+        weight._data = weight._data + delta
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference optimizer.py:1023)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        g = g + wd * weight._data
+        if state is not None:
+            mom = state._data
+            mom = self.momentum * mom + g
+            g = self.momentum * mom + g
+            state._data = mom
+            weight._data = weight._data - lr * g
+        else:
+            weight._data = weight._data - lr * g
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:1067)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        from .ops.registry import next_rng_key
+        import jax
+        eps = jax.random.normal(next_rng_key(), weight.shape,
+                                weight._data.dtype) * math.sqrt(lr)
+        weight._data = weight._data - lr / 2 * (g + wd * weight._data) + eps
+
+
+@register
+class ccSGD(SGD):
+    """Deprecated alias of SGD (reference optimizer.py:1095)."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:1108, op adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        new_w, new_mean, new_var = nd.adam_update(
+            weight, grad, mean, var, lr=lr, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient
+            if self.clip_gradient is not None else -1.0)
+        weight._data = new_w._data
+        mean._data, var._data = new_mean._data, new_var._data
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:1178)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        history = state._data + g * g
+        state._data = history
+        weight._data = weight._data - lr * \
+            (g / jnp.sqrt(history + self.float_stable_eps)
+             + wd * weight._data)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered (Graves) or not (reference optimizer.py:1212)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                    nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                    nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        clip = self.clip_gradient if self.clip_gradient is not None else -1.0
+        cw = self.clip_weights if self.clip_weights is not None else -1.0
+        if not self.centered:
+            (n,) = state
+            new_w, new_n = nd.rmsprop_update(
+                weight, grad, n, lr=lr, gamma1=self.gamma1,
+                epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=clip, clip_weights=cw)
+            weight._data = new_w._data
+            n._data = new_n._data
+        else:
+            n, g, delta = state
+            new_w, new_n, new_g, new_delta = nd.rmspropalex_update(
+                weight, grad, n, g, delta, lr=lr, gamma1=self.gamma1,
+                gamma2=self.gamma2, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad, clip_gradient=clip,
+                clip_weights=cw)
+            weight._data = new_w._data
+            n._data, g._data, delta._data = (new_n._data, new_g._data,
+                                             new_delta._data)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:1285)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context),
+                nd.zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g._data + (1.0 - self.rho) * g * g
+        current_delta = jnp.sqrt(acc_delta._data + self.epsilon) / \
+            jnp.sqrt(new_acc_g + self.epsilon) * g
+        new_acc_delta = self.rho * acc_delta._data + \
+            (1.0 - self.rho) * current_delta * current_delta
+        acc_g._data = new_acc_g
+        acc_delta._data = new_acc_delta
+        weight._data = weight._data - current_delta - wd * weight._data
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference optimizer.py:1325, op ftrl_update)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        z, n = state
+        new_w, new_z, new_n = nd.ftrl_update(
+            weight, grad, z, n, lr=lr, lamda1=self.lamda1, beta=self.beta,
+            wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient
+            if self.clip_gradient is not None else -1.0)
+        weight._data = new_w._data
+        z._data, n._data = new_z._data, new_n._data
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference optimizer.py:1399)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        g = grad._data * self.rescale_grad + wd * weight._data
+        g = _clip(g, self.clip_gradient)
+        m_t, u_t = state
+        m_t._data = self.beta1 * m_t._data + (1.0 - self.beta1) * g
+        u_t._data = jnp.maximum(self.beta2 * u_t._data, jnp.abs(g))
+        weight._data = weight._data - lr * m_t._data / (u_t._data + 1e-12)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference optimizer.py:1446)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad._data * self.rescale_grad + wd * weight._data
+        g = _clip(g, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                   (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._data = self.beta1 * m_t._data + (1.0 - self.beta1) * g
+        v_t._data = self.beta2 * v_t._data + (1.0 - self.beta2) * g * g
+        grad_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = m_t._data / (1.0 - m_schedule_next)
+        v_t_prime = v_t._data / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight._data = weight._data - lr * m_t_bar / \
+            (jnp.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: weight += grad * rescale (reference optimizer.py:1498)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data + grad._data * self.rescale_grad
+        state._data = weight._data
+
+
+class Updater:
+    """Stateful updater wrapper (reference optimizer.py:1516): lazily creates
+    per-index states and serializes them for kvstore servers."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        elif not self.states_synced[index]:
+            self.states[index] = self.sync_state_context(self.states[index],
+                                                         weight.context)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            synced_state = (self.sync_state_context(i, context)
+                            for i in state)
+            if isinstance(state, tuple):
+                return tuple(synced_state)
+            return list(synced_state)
+        return state
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            states, self.optimizer = states
+
+        def from_np(s):
+            import numpy as _np
+            if isinstance(s, _np.ndarray):
+                return nd.array(s)
+            if isinstance(s, (tuple, list)):
+                return type(s)(from_np(x) for x in s)
+            return s
+
+        self.states = {k: from_np(v) for k, v in states.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        # serialize as numpy so states round-trip without device handles
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return type(s)(to_np(x) for x in s)
+            return s
+
+        states = {k: to_np(v) for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer
+                            else states)
+
+
+def get_updater(optimizer):
+    """Wrap an optimizer as an updater closure (reference optimizer.py:1566)."""
+    return Updater(optimizer)

@@ -1,0 +1,118 @@
+"""Data iterator tests (reference: tests/python/unittest/test_io.py)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype("float32")
+    labels = np.arange(25).astype("float32")
+    it = mx.io.NDArrayIter(data, labels, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (5, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), labels[:5])
+    # reset and re-iterate
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad_discard():
+    data = np.arange(23 * 2).reshape(23, 2).astype("float32")
+    it = mx.io.NDArrayIter(data, None, batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 2
+    it = mx.io.NDArrayIter(data, None, batch_size=5,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_shuffle():
+    data = np.arange(40).reshape(20, 2).astype("float32")
+    label = np.arange(20).astype("float32")
+    it = mx.io.NDArrayIter(data, label, batch_size=4, shuffle=True)
+    seen = []
+    for b in it:
+        # data/label stay aligned after shuffling
+        np.testing.assert_allclose(b.data[0].asnumpy()[:, 0] // 2,
+                                   b.label[0].asnumpy())
+        seen.extend(b.label[0].asnumpy().tolist())
+    assert sorted(seen) == list(range(20))
+
+
+def test_resize_iter():
+    data = np.zeros((16, 2), dtype="float32")
+    inner = mx.io.NDArrayIter(data, None, batch_size=4)
+    it = mx.io.ResizeIter(inner, 7)
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(64).reshape(16, 4).astype("float32")
+    label = np.arange(16).astype("float32")
+    inner = mx.io.NDArrayIter(data, label, batch_size=4)
+    it = mx.io.PrefetchingIter(inner)
+    batches = list(it)
+    assert len(batches) == 4
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4])
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.RandomState(0).rand(10, 3).astype("float32")
+    labels = np.arange(10).astype("float32")
+    dpath = str(tmp_path / "data.csv")
+    lpath = str(tmp_path / "label.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels.reshape(-1, 1), delimiter=",")
+    it = mx.io.CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                       label_shape=(1,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 5
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:2],
+                               rtol=1e-5)
+
+
+def test_mnist_iter(tmp_path):
+    """MNISTIter reads idx format (reference src/io/iter_mnist.cc)."""
+    rng = np.random.RandomState(0)
+    images = (rng.rand(50, 28, 28) * 255).astype(np.uint8)
+    labels = rng.randint(0, 10, size=50).astype(np.uint8)
+    img_path = str(tmp_path / "train-images-idx3-ubyte")
+    lbl_path = str(tmp_path / "train-labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 50, 28, 28))
+        f.write(images.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 50))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                         shuffle=False)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (10, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               images[:10].reshape(10, 1, 28, 28) / 255.0,
+                               rtol=1e-5)
+    it_flat = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                              shuffle=False, flat=True)
+    assert next(it_flat).data[0].shape == (10, 784)
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:0.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2)
+    b = next(it)
+    np.testing.assert_allclose(b.data[0].asnumpy(),
+                               [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_allclose(b.label[0].asnumpy(), [1, 0])

@@ -1,0 +1,87 @@
+"""Metric tests (reference: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+
+def test_accuracy():
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m = mx.metric.create("acc")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+
+
+def test_top_k_accuracy():
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.7, 0.2, 0.1]])
+    label = mx.nd.array([1, 1])
+    m = mx.metric.create("top_k_accuracy", top_k=2)
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6
+
+
+def test_f1():
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6], [0.7, 0.3]])
+    label = mx.nd.array([1, 0, 1, 0])
+    m = mx.metric.create("f1")
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+
+def test_mae_mse_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[2.0], [4.0]])
+    mae = mx.metric.create("mae")
+    mae.update([label], [pred])
+    assert abs(mae.get()[1] - 1.5) < 1e-6
+    mse = mx.metric.create("mse")
+    mse.update([label], [pred])
+    assert abs(mse.get()[1] - 2.5) < 1e-6
+    rmse = mx.metric.create("rmse")
+    rmse.update([label], [pred])
+    assert abs(rmse.get()[1] - np.sqrt(2.5)) < 1e-6
+
+
+def test_cross_entropy_and_nll():
+    pred = mx.nd.array([[0.9, 0.1], [0.2, 0.8]])
+    label = mx.nd.array([0, 1])
+    ce = mx.metric.create("ce")
+    ce.update([label], [pred])
+    expect = -(np.log(0.9) + np.log(0.8)) / 2
+    assert abs(ce.get()[1] - expect) < 1e-5
+    nll = mx.metric.create("nll_loss")
+    nll.update([label], [pred])
+    assert abs(nll.get()[1] - expect) < 1e-5
+
+
+def test_perplexity():
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m = mx.metric.create("perplexity", ignore_label=None)
+    m.update([label], [pred])
+    expect = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(m.get()[1] - expect) < 1e-5
+
+
+def test_composite_and_custom():
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1]])
+    label = mx.nd.array([1, 0])
+    comp = mx.metric.create(["acc", "ce"])
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert "accuracy" in names and "cross-entropy" in names
+
+    def my_metric(label, pred):
+        return float((pred.argmax(axis=1) == label).mean())
+    m = mx.metric.np(my_metric)
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+
+def test_pearson():
+    pred = mx.nd.array([[1.0], [2.0], [3.0]])
+    label = mx.nd.array([[2.0], [4.0], [6.0]])
+    m = mx.metric.create("pearsonr")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6

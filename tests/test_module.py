@@ -1,0 +1,187 @@
+"""Module API tests (reference: tests/python/unittest/test_module.py +
+tests/python/train/test_mlp.py — end-to-end convergence asserting final
+accuracy, and bind/checkpoint behaviors)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+
+def _toy_problem(n=512, dim=20, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, dim).astype("float32")
+    w = rng.randn(dim, classes).astype("float32")
+    y = (x @ w).argmax(axis=1).astype("float32")
+    return x, y
+
+
+def _mlp(classes=4):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_convergence():
+    x, y = _toy_problem()
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(x, y, batch_size=32,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier(),
+            num_epoch=15, eval_metric="acc")
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.97, score
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _toy_problem()
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(x, y, batch_size=32,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier(), num_epoch=3)
+    base = mod.score(val, "acc")
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 3, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 3)
+    mod2.bind(val.provide_data, val.provide_label, for_training=False)
+    s2 = mod2.score(val, "acc")
+    assert abs(s2[0][1] - base[0][1]) < 1e-6
+
+    preds = mod2.predict(val)
+    assert preds.shape == (512, 4)
+
+
+def test_module_forward_backward_shapes():
+    x, y = _toy_problem()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    train = mx.io.NDArrayIter(x, y, batch_size=16,
+                              label_name="softmax_label")
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+    batch = next(train)
+    mod.forward(batch)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (16, 4)
+    mod.backward()
+    mod.update()
+
+
+def test_module_input_grads():
+    x, y = _toy_problem()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    train = mx.io.NDArrayIter(x, y, batch_size=16,
+                              label_name="softmax_label")
+    mod.bind(train.provide_data, train.provide_label, for_training=True,
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = next(train)
+    mod.forward(batch)
+    mod.backward()
+    igrads = mod.get_input_grads()
+    assert igrads[0].shape == (16, 20)
+    assert float(mx.nd.norm(igrads[0]).asscalar()) > 0
+
+
+def test_module_multi_device():
+    """Data-parallel executor group over multiple faked devices
+    (reference tests/python/unittest/test_multi_device_exec.py)."""
+    x, y = _toy_problem()
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(x, y, batch_size=32,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=ctxs)
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier(), num_epoch=10)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_reshape():
+    x, y = _toy_problem()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind([("data", (32, 20))], [("softmax_label", (32,))])
+    mod.init_params()
+    mod.reshape([("data", (8, 20))], [("softmax_label", (8,))])
+    batch = mx.io.DataBatch([mx.nd.array(x[:8])],
+                            [mx.nd.array(y[:8])])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (8, 4)
+
+
+def test_bucketing_module():
+    """Shape-bucketed training (reference test_module.py bucketing)."""
+    x, y = _toy_problem()
+
+    def sym_gen(bucket_key):
+        data = mx.sym.var("data")
+        net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu", name="relu1")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=20,
+                                 context=mx.cpu())
+    mod.bind([("data", (32, 20))], [("softmax_label", (32,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.create("acc")
+    for _ in range(30):
+        for i in range(0, 512, 32):
+            batch = mx.io.DataBatch(
+                [mx.nd.array(x[i:i + 32])], [mx.nd.array(y[i:i + 32])],
+                bucket_key=20,
+                provide_data=[("data", (32, 20))],
+                provide_label=[("softmax_label", (32,))])
+            mod.forward(batch)
+            mod.backward()
+            mod.update()
+    metric.reset()
+    for i in range(0, 512, 32):
+        batch = mx.io.DataBatch(
+            [mx.nd.array(x[i:i + 32])], [mx.nd.array(y[i:i + 32])],
+            bucket_key=20,
+            provide_data=[("data", (32, 20))],
+            provide_label=[("softmax_label", (32,))])
+        mod.forward(batch, is_train=False)
+        mod.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.95
+
+
+def test_conv_module():
+    """Small conv net trains (reference tests/python/train/test_conv.py)."""
+    rng = np.random.RandomState(0)
+    n = 256
+    x = rng.randn(n, 1, 8, 8).astype("float32")
+    y = (x.sum(axis=(1, 2, 3)) > 0).astype("float32")
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    net = mx.sym.Activation(net, act_type="relu", name="act1")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                         name="pool1")
+    net = mx.sym.Flatten(net, name="flat")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.initializer.Xavier(), num_epoch=20)
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.95, score

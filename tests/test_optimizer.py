@@ -1,0 +1,118 @@
+"""Optimizer tests (reference: tests/python/unittest/test_optimizer.py —
+each optimizer's update checked against a numpy reference implementation)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import optimizer as opt
+
+
+def _quadratic_min(optimizer, steps=200):
+    """Minimize ||w - target||^2; returns final distance."""
+    target = np.arange(6, dtype="float32").reshape(2, 3) / 10.0
+    w = mx.nd.zeros((2, 3))
+    state = optimizer.create_state(0, w)
+    for _ in range(steps):
+        g = mx.nd.array(2.0 * (w.asnumpy() - target))
+        optimizer.update(0, w, g, state)
+    return float(np.abs(w.asnumpy() - target).max())
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.05}),
+    ("adagrad", {"learning_rate": 0.2}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+    ("adadelta", {"rho": 0.9}),
+    ("ftrl", {"learning_rate": 0.5}),
+    ("adamax", {"learning_rate": 0.05}),
+    ("nadam", {"learning_rate": 0.05}),
+    ("signum", {"learning_rate": 0.01}),
+    ("ftml", {"learning_rate": 0.02}),
+    ("dcasgd", {"learning_rate": 0.1}),
+])
+def test_optimizer_converges(name, kwargs):
+    o = opt.create(name, **kwargs)
+    err = _quadratic_min(o)
+    assert err < 0.05, "%s end error %f" % (name, err)
+
+
+def test_sgd_matches_numpy():
+    """sgd_mom_update vs explicit numpy update rule."""
+    lr, momentum, wd = 0.1, 0.9, 0.01
+    w0 = np.random.RandomState(0).randn(4, 5).astype("float32")
+    g0 = np.random.RandomState(1).randn(4, 5).astype("float32")
+    w = mx.nd.array(w0)
+    o = opt.create("sgd", learning_rate=lr, momentum=momentum, wd=wd,
+                   rescale_grad=1.0)
+    state = o.create_state(0, w)
+    o.update(0, w, mx.nd.array(g0), state)
+    mom_np = -(lr) * (g0 + wd * w0)
+    w_np = w0 + mom_np
+    np.testing.assert_allclose(w.asnumpy(), w_np, rtol=1e-5)
+    o.update(0, w, mx.nd.array(g0), state)
+    mom_np = momentum * mom_np - lr * (g0 + wd * w_np)
+    w_np = w_np + mom_np
+    np.testing.assert_allclose(w.asnumpy(), w_np, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    w0 = np.random.RandomState(0).randn(10).astype("float32")
+    g0 = np.random.RandomState(1).randn(10).astype("float32")
+    w = mx.nd.array(w0)
+    o = opt.create("adam", learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps,
+                   rescale_grad=1.0)
+    state = o.create_state(0, w)
+    o.update(0, w, mx.nd.array(g0), state)
+    m = (1 - b1) * g0
+    v = (1 - b2) * g0 ** 2
+    lr_t = lr * np.sqrt(1 - b2) / (1 - b1)
+    w_np = w0 - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(w.asnumpy(), w_np, rtol=1e-5)
+
+
+def test_lr_scheduler_factor():
+    from mxtpu.lr_scheduler import FactorScheduler, MultiFactorScheduler, \
+        PolyScheduler
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(5) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1)
+    m.base_lr = 1.0
+    assert m(2) == 1.0
+    assert abs(m(7) - 0.1) < 1e-12
+    assert abs(m(16) - 0.01) < 1e-12
+
+    p = PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0
+    assert abs(p(50) - 0.25) < 1e-12
+
+
+def test_lr_wd_mult():
+    """lr_mult/wd_mult routing by name (reference test_optimizer)."""
+    o = opt.create("sgd", learning_rate=1.0,
+                   param_idx2name={0: "w_weight", 1: "b_bias"})
+    o.set_lr_mult({"w_weight": 0.0})
+    w = mx.nd.ones((2, 2))
+    g = mx.nd.ones((2, 2))
+    st = o.create_state(0, w)
+    o.update(0, w, g, st)
+    np.testing.assert_allclose(w.asnumpy(), np.ones((2, 2)))  # lr 0 => frozen
+
+
+def test_updater_states_roundtrip():
+    o = opt.create("adam", learning_rate=0.01)
+    u = opt.get_updater(o)
+    w = mx.nd.ones((3,))
+    u(0, mx.nd.ones((3,)), w)
+    st = u.get_states()
+    u2 = opt.get_updater(opt.create("adam", learning_rate=0.01))
+    u2.set_states(st)
+    assert 0 in u2.states
