@@ -1,0 +1,17 @@
+"""Gluon: the imperative/hybrid high-level API.
+
+Capability parity with ``python/mxnet/gluon/`` — Block/HybridBlock,
+Parameter/ParameterDict, Trainer, nn layers, losses, data pipeline,
+model zoo, rnn — re-designed so hybridize() compiles a block to one XLA
+computation (see block.py docstring).
+"""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import rnn

@@ -1,0 +1,543 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Capability parity with ``python/mxnet/gluon/block.py`` (Block:123,
+HybridBlock:376, SymbolBlock:599, hybridize:332,498, _build_cache:436-439),
+re-designed TPU-first:
+
+* ``hybridize()`` does not build an NNVM CachedOp; it wraps the block's
+  forward as ONE pure JAX function over (rng key, parameter values, input
+  values) and compiles it with ``jax.jit`` — XLA's trace cache replaces
+  MXNet's per-shape CachedOp graph specialization, and buffer donation /
+  fusion replace its PlanMemory pass.
+* Under ``autograd.record`` a hybridized call records a single tape entry
+  whose vjp differentiates through the whole compiled body (the analogue of
+  ``CachedOp::Backward`` cached_op.cc:434).
+* Deferred shape inference runs the same ``hybrid_forward`` against the
+  Symbol frontend and uses graph shape inference — the same trick MXNet's
+  ``_deferred_infer_shape`` uses.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray, _wrap, invoke
+from .. import symbol as _sym
+from .. import autograd as _ag
+from ..ops.registry import OpDef, rng_scope
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name manager for nested blocks (reference block.py:30-87)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_counter(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *a):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+_NAME_COUNTERS = {}
+
+
+def _name_counter(hint):
+    count = _NAME_COUNTERS.get(hint, 0)
+    _NAME_COUNTERS[hint] = count + 1
+    return "%s%d" % (hint, count)
+
+
+def _flatten_nds(args):
+    """Flatten nested lists/tuples of NDArrays; return (flat, treedef-fn)."""
+    flat = []
+
+    def rec(a):
+        if isinstance(a, NDArray):
+            flat.append(a)
+            return ("leaf", len(flat) - 1)
+        if isinstance(a, (list, tuple)):
+            return ("seq", [rec(x) for x in a])
+        return ("const", a)
+
+    tree = [rec(a) for a in args]
+
+    def unflatten(tree, values):
+        def rec2(t):
+            kind = t[0]
+            if kind == "leaf":
+                return values[t[1]]
+            if kind == "seq":
+                return [rec2(x) for x in t[1]]
+            return t[1]
+        return [rec2(t) for t in tree]
+
+    return flat, tree, unflatten
+
+
+class Block:
+    """Base building block (reference gluon/block.py:123)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pat = re.compile(select)
+            ret.update({n: p for n, p in self.params.items()
+                        if pat.match(n)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select))
+        return ret
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    # -- persistence (reference block.py:295,303) -------------------------
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    save_parameters = save_params
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing,
+                                   ignore_extra, restore_prefix=self.prefix)
+
+    load_parameters = load_params
+
+    # -- call -------------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        lines = ["-" * 64,
+                 "%-30s %s" % ("Layer (type)", "Param #"),
+                 "=" * 64]
+        total = 0
+        for name, p in self.collect_params().items():
+            n = 1
+            for s in (p.shape or ()):
+                n *= s
+            total += n
+            lines.append("%-30s %d" % (name, n))
+        lines.append("=" * 64)
+        lines.append("Total params: %d" % total)
+        print("\n".join(lines))
+
+    def __repr__(self):
+        s = "{name}(\n".format(name=self.__class__.__name__)
+        for key, block in self._children.items():
+            s += "  ({key}): {block}\n".format(
+                key=key, block=repr(block).replace("\n", "\n  "))
+        return s + ")"
+
+
+class HybridBlock(Block):
+    """Block convertible to one compiled XLA computation
+    (reference gluon/block.py:376)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_op = None
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        super().cast(dtype)
+        self._cached_op = None
+
+    def infer_shape(self, *args):
+        self._deferred_infer_shape(*args)
+
+    def _ordered_params(self):
+        """All params reachable from this block, in stable order."""
+        return list(self.collect_params().values())
+
+    def _deferred_infer_shape(self, *args):
+        """Resolve unknown param shapes by symbolic graph inference —
+        the analogue of reference block.py _deferred_infer_shape."""
+        params = self._ordered_params()
+        pending = [p for p in params if p._deferred_init is not None]
+        if not pending:
+            return
+        flat, _, _ = _flatten_nds(args)
+        data_syms = [_sym.var("__data%d" % i) for i in range(len(flat))]
+        sym_args = _rebuild_like(args, iter(data_syms))
+        with _ag.pause():
+            out = self._symbolic_forward(*sym_args)
+        shape_kwargs = {"__data%d" % i: a.shape for i, a in enumerate(flat)}
+        arg_shapes, _, aux_shapes = out.infer_shape_partial(**shape_kwargs)
+        names = out.list_arguments()
+        aux_names = out.list_auxiliary_states()
+        shape_of = dict(zip(names, arg_shapes))
+        shape_of.update(zip(aux_names, aux_shapes))
+        for p in pending:
+            s = shape_of.get(p.name)
+            if s is None or not all(d > 0 for d in s):
+                raise DeferredInitializationError(
+                    "could not infer shape for parameter %s" % p.name)
+            p.shape = s
+            p._finish_deferred_init()
+
+    def _symbolic_forward(self, *sym_args):
+        """Run hybrid_forward against the Symbol frontend."""
+        kwargs = {}
+        for name, p in self._reg_params.items():
+            kwargs[name] = p.var()
+        return self.hybrid_forward(_sym, *sym_args, **kwargs)
+
+    # -- eager path -------------------------------------------------------
+    def forward(self, *args):
+        if _contains_symbol(args):
+            # child block invoked during a symbolic trace (F=sym)
+            return self._symbolic_forward(*args)
+        if self._active and not getattr(_TRACING, "active", False):
+            return self._call_cached_op(*args)
+        try:
+            return self._eager_forward(*args)
+        except DeferredInitializationError:
+            self._deferred_infer_shape(*args)
+            return self._eager_forward(*args)
+
+    def _eager_forward(self, *args):
+        kwargs = {}
+        for name, p in self._reg_params.items():
+            kwargs[name] = p.data()
+        return self.hybrid_forward(nd, *args, **kwargs)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- compiled path (CachedOp analogue) --------------------------------
+    def _build_cached_op(self, args):
+        params = self._ordered_params()
+        # finish any deferred init first
+        try:
+            for p in params:
+                p._finish_deferred_init()
+        except DeferredInitializationError:
+            self._deferred_infer_shape(*args)
+        param_nds = [p.data() for p in params]
+        n_params = len(param_nds)
+        aux_pos = [i for i, p in enumerate(params) if p.grad_req == "null"]
+        flat_in, tree, unflatten = _flatten_nds(args)
+        n_inputs = len(flat_in)
+        block = self
+        out_struct = {}
+
+        def body(key, vals, training):
+            pvals, ivals = vals[:n_params], vals[n_params:]
+            pw = [NDArray(v) for v in pvals]
+            iw = [NDArray(v) for v in ivals]
+            with _ag.pause(train_mode=training), rng_scope(key), \
+                    _trace_scope(), _swap_params(block, dict(zip(params, pw))):
+                raw = block._run_hybrid(unflatten(tree, iw))
+            outs = raw if isinstance(raw, (list, tuple)) else [raw]
+            out_struct["n"] = len(outs)
+            out_struct["single"] = not isinstance(raw, (list, tuple))
+            aux_new = tuple(pw[i]._data for i in aux_pos)
+            return tuple(o._data for o in outs) + aux_new
+
+        jit_body = jax.jit(
+            lambda key, vals, training: body(key, vals, training),
+            static_argnames=("training",))
+
+        def cached_fn(key, *vals, _training=False):
+            return jit_body(key, vals, bool(_training))
+
+        # Warm trace once to learn the output structure (cheap: reuses the
+        # jit cache for the real first call).
+        key0 = jax.random.PRNGKey(0)
+        jax.eval_shape(lambda k, v: body(k, v, False), key0,
+                       tuple(p._data for p in param_nds)
+                       + tuple(a._data for a in flat_in))
+        n_user = out_struct["n"]
+        aux_update = {1 + i: n_user + j for j, i in enumerate(aux_pos)}
+        op = OpDef("_cached_op_" + self.name, cached_fn,
+                   differentiable=True, stateful=False,
+                   aux_update=aux_update, needs_train_flag=True,
+                   user_outputs=n_user)
+        self._cached_op = (op, params, out_struct["single"],
+                           tuple(a.shape for a in flat_in), tree)
+        return self._cached_op
+
+    def _run_hybrid(self, args):
+        kwargs = {}
+        for name, p in self._reg_params.items():
+            kwargs[name] = p.data()
+        return self.hybrid_forward(nd, *args, **kwargs)
+
+    def _call_cached_op(self, *args):
+        flat_in, tree, _ = _flatten_nds(args)
+        if self._cached_op is None \
+                or self._cached_op[3] != tuple(a.shape for a in flat_in) \
+                or self._cached_op[4] != tree:
+            self._build_cached_op(args)
+        op, params, single, _, _ = self._cached_op
+        key = _next_framework_key()
+        inputs = [key] + [p.data() for p in params] + flat_in
+        out = invoke(op, inputs, {})
+        if single:
+            return out if isinstance(out, NDArray) else out[0]
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+    # -- export (reference HybridBlock.export) ----------------------------
+    def export(self, path, epoch=0):
+        """Save symbol json + params like Module checkpoints."""
+        data_syms = [_sym.var("data")]
+        with _ag.pause():
+            out = self._symbolic_forward(*data_syms)
+        out.save("%s-symbol.json" % path)
+        payload = {}
+        for p in self._ordered_params():
+            prefix = "aux:" if p.grad_req == "null" else "arg:"
+            payload[prefix + p.name] = p.data()
+        nd.save("%s-%04d.params" % (path, epoch), payload)
+
+
+_TRACING = threading.local()
+
+
+class _trace_scope:
+    """Marks 'inside a cached-op trace': nested hybridized children execute
+    inline (their ops fold into the enclosing jit) instead of spawning
+    nested cached ops."""
+
+    def __enter__(self):
+        self._prev = getattr(_TRACING, "active", False)
+        _TRACING.active = True
+        return self
+
+    def __exit__(self, *a):
+        _TRACING.active = self._prev
+
+
+def _contains_symbol(args):
+    for a in args:
+        if isinstance(a, _sym.Symbol):
+            return True
+        if isinstance(a, (list, tuple)) and _contains_symbol(a):
+            return True
+    return False
+
+
+class _swap_params:
+    """Temporarily point Parameters at traced wrapper arrays."""
+
+    def __init__(self, block, mapping):
+        self._mapping = mapping
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = {p: p._data for p in self._mapping}
+        for p, w in self._mapping.items():
+            p._data = w
+        return self
+
+    def __exit__(self, *a):
+        for p, old in self._saved.items():
+            p._data = old
+
+
+def _next_framework_key():
+    # draw from the framework-global RNG so mxtpu.random.seed() governs
+    # hybridized stochastic layers exactly like eager ones
+    from ..ops.registry import next_rng_key
+    return next_rng_key()
+
+
+def _rebuild_like(args, it):
+    out = []
+    for a in args:
+        if isinstance(a, NDArray):
+            out.append(next(it))
+        elif isinstance(a, (list, tuple)):
+            out.append(_rebuild_like(a, it))
+        else:
+            out.append(a)
+    return out
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol graph as a Block (reference gluon/block.py:599)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        if isinstance(outputs, (list, tuple)):
+            outputs = _sym.Group(list(outputs))
+        if isinstance(inputs, _sym.Symbol):
+            inputs = [inputs]
+        self._output_sym = outputs
+        self._input_names = [s.name for s in inputs]
+        input_set = set(self._input_names)
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in arg_names:
+            if name not in input_set:
+                self._params.get(
+                    name, grad_req="null" if name in aux_names else "write",
+                    allow_deferred_init=True)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        sym = _sym.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_sym.var(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file:
+            loaded = nd.load(param_file)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                if name in block._params:
+                    block._params[name].set_data(v)
+        return block
+
+    def forward(self, *args):
+        feed = {}
+        flat, _, _ = _flatten_nds(args)
+        for name, a in zip(self._input_names, flat):
+            feed[name] = a._data
+        for name, p in self._params.items():
+            if p._data is None:
+                # infer from graph
+                shape_kwargs = {n: a.shape for n, a in
+                                zip(self._input_names, flat)}
+                arg_shapes, _, aux_shapes = \
+                    self._output_sym.infer_shape_partial(**shape_kwargs)
+                names = self._output_sym.list_arguments()
+                aux = self._output_sym.list_auxiliary_states()
+                shape_of = dict(zip(names, arg_shapes))
+                shape_of.update(zip(aux, aux_shapes))
+                p.shape = shape_of[name]
+                p._finish_deferred_init()
+            feed[name] = p.data()._data
+        outs, _ = _sym.eval_graph(self._output_sym._outputs, feed,
+                                  _ag.is_training())
+        outs = [_wrap(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs

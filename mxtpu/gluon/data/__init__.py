@@ -1,0 +1,5 @@
+"""Gluon data API (reference python/mxnet/gluon/data/)."""
+from .dataset import *
+from .sampler import *
+from .dataloader import *
+from . import vision

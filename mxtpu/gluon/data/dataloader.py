@@ -1,0 +1,122 @@
+"""Gluon DataLoader.
+
+Capability parity with ``python/mxnet/gluon/data/dataloader.py``: batches a
+Dataset through a Sampler with optional parallel workers. TPU-first
+re-design: MXNet forks worker *processes* that pickle NDArrays through
+POSIX shared memory (dataloader.py:49-126) because Python decode work held
+the GIL around BLAS kernels; here batchify produces host numpy and the
+device transfer is one ``jax.device_put`` per batch, so workers are
+*threads* (decode releases the GIL in numpy/PIL) and the prefetch queue
+overlaps host decode with device compute.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+from . import sampler as _sampler_mod
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference dataloader.py:128)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return nd.array(data)
+
+
+class DataLoader:
+    """(reference dataloader.py:149)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                "batch_size/shuffle/sampler/last_batch must not be "
+                "specified if batch_sampler is")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        # threaded prefetch pipeline: workers decode ahead of the consumer
+        # up to a bounded depth; errors propagate to the caller
+        batches = list(self._batch_sampler)
+        depth = max(self._prefetch, self._num_workers, 1)
+        out_q = {}
+        cond = threading.Condition()
+        task_q = _queue.Queue()
+
+        def worker():
+            while True:
+                item = task_q.get()
+                if item is None:
+                    return
+                i, indices = item
+                try:
+                    result = (self._make_batch(indices), None)
+                except BaseException as e:  # propagate to consumer
+                    result = (None, e)
+                with cond:
+                    out_q[i] = result
+                    cond.notify_all()
+
+        submitted = min(depth, len(batches))
+        for i in range(submitted):
+            task_q.put((i, batches[i]))
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(batches)):
+                with cond:
+                    while i not in out_q:
+                        cond.wait()
+                    batch, err = out_q.pop(i)
+                if err is not None:
+                    raise err
+                if submitted < len(batches):
+                    task_q.put((submitted, batches[submitted]))
+                    submitted += 1
+                yield batch
+        finally:
+            for _ in threads:
+                task_q.put(None)

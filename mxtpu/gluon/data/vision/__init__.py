@@ -1,0 +1,3 @@
+"""Gluon vision data (reference python/mxnet/gluon/data/vision/)."""
+from .datasets import *
+from . import transforms

@@ -1,0 +1,190 @@
+"""Gluon vision datasets (reference python/mxnet/gluon/data/vision/datasets.py).
+
+Downloads are disabled in this environment: datasets read local files under
+``root``; MNIST/FashionMNIST use the standard idx gzip files, CIFAR uses the
+binary batches. If the files are absent a clear error tells the user where
+to place them.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as _np
+
+from .... import ndarray as nd
+from ..dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageFolderDataset"]
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = _np.frombuffer(f.read(), dtype=_np.uint8)
+        return data.reshape(num, rows, cols, 1)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        return _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.int32)
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        img = nd.array(self._data[idx])
+        label = self._label[idx]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """(reference datasets.py:37). Expects train-images-idx3-ubyte[.gz] etc.
+    under root."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root=os.path.join("~", ".mxtpu", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _find(self, stem):
+        for cand in (stem, stem + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        raise IOError(
+            "%s not found under %s — downloads are disabled; place the "
+            "MNIST idx files there." % (stem, self._root))
+
+    def _get_data(self):
+        img_stem, lbl_stem = self._files[self._train]
+        self._data = _read_idx_images(self._find(img_stem))
+        self._label = _read_idx_labels(self._find(lbl_stem))
+
+
+class FashionMNIST(MNIST):
+    """(reference datasets.py:100)."""
+
+    def __init__(self, root=os.path.join("~", ".mxtpu", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """(reference datasets.py:127). Expects cifar-10-batches-py/ or the
+    binary batches under root."""
+
+    def __init__(self, root=os.path.join("~", ".mxtpu", "datasets",
+                                         "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        pydir = os.path.join(self._root, "cifar-10-batches-py")
+        if os.path.isdir(pydir):
+            files = ["data_batch_%d" % i for i in range(1, 6)] \
+                if self._train else ["test_batch"]
+            data, labels = [], []
+            for fn in files:
+                with open(os.path.join(pydir, fn), "rb") as f:
+                    batch = pickle.load(f, encoding="latin1")
+                data.append(batch["data"])
+                labels.extend(batch["labels"])
+            raw = _np.concatenate(data).reshape(-1, 3, 32, 32)
+            self._data = raw.transpose(0, 2, 3, 1)
+            self._label = _np.asarray(labels, dtype=_np.int32)
+            return
+        raise IOError(
+            "CIFAR-10 python batches not found under %s — downloads are "
+            "disabled; extract cifar-10-python.tar.gz there." % self._root)
+
+
+class CIFAR100(_DownloadedDataset):
+    """(reference datasets.py:169)."""
+
+    def __init__(self, root=os.path.join("~", ".mxtpu", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._train = train
+        self._fine = fine_label
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        pydir = os.path.join(self._root, "cifar-100-python")
+        if os.path.isdir(pydir):
+            fn = "train" if self._train else "test"
+            with open(os.path.join(pydir, fn), "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            raw = _np.asarray(batch["data"]).reshape(-1, 3, 32, 32)
+            self._data = raw.transpose(0, 2, 3, 1)
+            key = "fine_labels" if self._fine else "coarse_labels"
+            self._label = _np.asarray(batch[key], dtype=_np.int32)
+            return
+        raise IOError(
+            "CIFAR-100 python batches not found under %s — downloads are "
+            "disabled; extract cifar-100-python.tar.gz there." % self._root)
+
+
+class ImageFolderDataset(Dataset):
+    """Images arranged in per-class folders
+    (reference datasets.py:208)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png", ".bmp"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                ext = os.path.splitext(filename)[1].lower()
+                if ext in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from .... import image as img_mod
+        path, label = self.items[idx]
+        img = img_mod.imread(path, self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

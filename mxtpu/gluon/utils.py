@@ -1,0 +1,77 @@
+"""Gluon utilities (reference python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import math
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks
+    (reference utils.py:30)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d" % (data.shape, num_slice, batch_axis))
+    step = size // num_slice
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step]
+                  if i < num_slice - 1 else data[i * step:size]
+                  for i in range(num_slice)]
+    else:
+        slices = [nd.slice_axis(data, axis=batch_axis, begin=i * step,
+                                end=(i + 1) * step if i < num_slice - 1
+                                else size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data and load each slice on one context
+    (reference utils.py:79). On a TPU mesh this is where batch-sharding
+    happens; with a single device it degrades to a plain split."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale arrays so that the l2 norm of their concatenation is at most
+    max_norm (reference utils.py:109)."""
+    assert len(arrays) > 0
+    total = 0.0
+    for arr in arrays:
+        n = nd.norm(arr)
+        total = total + n * n
+    total_norm = float(nd.sqrt(total).asscalar())
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    raise RuntimeError(
+        "network downloads are disabled in this environment; place the "
+        "file locally and pass its path instead (url=%s)" % url)

@@ -1,0 +1,426 @@
+"""Image API.
+
+Capability parity with ``python/mxnet/image/image.py`` (1,244 LoC): decode,
+resize, crop, augmenters, and the ImageIter-style augmenter list. The
+reference decodes through OpenCV inside C++ ops; here host-side decode uses
+PIL/numpy (releasing the GIL in the codec) and all tensor math happens in
+XLA once the batch is on device — the TPU-idiomatic split of host IO vs
+device compute.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as _random
+
+import numpy as _np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "random_size_crop", "color_normalize",
+           "HorizontalFlipAug", "RandomCropAug", "CenterCropAug",
+           "ResizeAug", "ForceResizeAug", "CastAug", "ColorNormalizeAug",
+           "RandomSizedCropAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "ColorJitterAug", "LightingAug",
+           "RandomOrderAug", "CreateAugmenter", "Augmenter", "ImageIter"]
+
+
+def _to_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return _np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode a jpeg/png byte buffer to an HWC uint8 NDArray
+    (reference image.py:imdecode; C++ op src/operator/image)."""
+    from PIL import Image
+    img = Image.open(_io.BytesIO(buf if isinstance(buf, (bytes, bytearray))
+                                 else bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = _np.asarray(img, dtype=_np.uint8)
+    if not flag:
+        arr = arr[:, :, None]
+    return nd.array(arr, dtype=_np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    from PIL import Image
+    arr = _to_np(src)
+    squeeze = arr.shape[-1] == 1
+    img = Image.fromarray(arr[..., 0] if squeeze else arr.astype(_np.uint8))
+    img = img.resize((w, h),
+                     Image.NEAREST if interp == 0 else Image.BILINEAR)
+    out = _np.asarray(img)
+    if squeeze:
+        out = out[:, :, None]
+    return nd.array(out, dtype=arr.dtype)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = nd.array(_to_np(src)[y0:y0 + h, x0:x0 + w, :])
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = _random.randint(0, max(0, w - new_w))
+    y0 = _random.randint(0, max(0, h - new_h))
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _random.uniform(area[0], area[1]) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        aspect = _np.exp(_random.uniform(*log_ratio))
+        new_w = int(round((target_area * aspect) ** 0.5))
+        new_h = int(round((target_area / aspect) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = _random.randint(0, w - new_w)
+            y0 = _random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - (mean if isinstance(mean, NDArray) else nd.array(mean))
+    if std is not None:
+        src = src / (std if isinstance(std, NDArray) else nd.array(std))
+    return src
+
+
+class Augmenter:
+    """(reference image.py Augmenter base)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.random() < self.p:
+            return nd.array(_to_np(src)[:, ::-1, :])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], dtype=_np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.contrast, self.contrast)
+        arr = _to_np(src).astype(_np.float32)
+        gray = (arr * self._coef).sum() * (3.0 / arr.size)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], dtype=_np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.saturation, self.saturation)
+        arr = _to_np(src).astype(_np.float32)
+        gray = (arr * self._coef).sum(axis=2, keepdims=True)
+        return nd.array(arr * alpha + gray * (1.0 - alpha))
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _random.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval)
+        self.eigvec = _np.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = _np.dot(self.eigvec * alpha, self.eigval)
+        return src + nd.array(rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = nd.array(mean) if mean is not None else None
+        self.std = nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Build the standard augmenter list (reference image.py:CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and (not hasattr(mean, "size") or mean.size > 0):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Image iterator over an image list or RecordIO file with augmenters
+    (reference image.py:ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        from .io import DataDesc, DataBatch
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self._shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **kwargs)
+        self._data_name = data_name
+        self._label_name = label_name
+        self._items = []  # (path-or-bytes, label)
+        if path_imgrec is not None:
+            from . import recordio
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            rec = recordio.IndexedRecordIO(idx_path, path_imgrec, "r") \
+                if os.path.exists(idx_path) else \
+                recordio.RecordIO(path_imgrec, "r")
+            while True:
+                item = rec.read()
+                if item is None:
+                    break
+                header, img = recordio.unpack(item)
+                self._items.append((img, header.label))
+        elif imglist is not None:
+            for entry in imglist:
+                label, path = entry[0], entry[-1]
+                self._items.append((os.path.join(path_root or "", path),
+                                    label))
+        elif path_imglist is not None:
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    label = [float(x) for x in parts[1:-1]]
+                    self._items.append(
+                        (os.path.join(path_root or "", parts[-1]),
+                         label[0] if len(label) == 1 else _np.array(label)))
+        self._order = list(range(len(self._items)))
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            _random.shuffle(self._order)
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        from .io import DataDesc
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        from .io import DataDesc
+        return [DataDesc(self._label_name, (self.batch_size,))]
+
+    def __iter__(self):
+        return self
+
+    def _load(self, item):
+        src, label = item
+        if isinstance(src, (bytes, bytearray)):
+            img = imdecode(src)
+        else:
+            img = imread(src)
+        for aug in self.auglist:
+            img = aug(img)
+        return nd.transpose(img.astype("float32"), axes=(2, 0, 1)), label
+
+    def next(self):
+        from .io import DataBatch
+        if self._cursor >= len(self._items):
+            raise StopIteration
+        datas, labels = [], []
+        while len(datas) < self.batch_size:
+            if self._cursor >= len(self._items):
+                idx = self._order[0]
+            else:
+                idx = self._order[self._cursor]
+                self._cursor += 1
+            d, l = self._load(self._items[idx])
+            datas.append(d)
+            labels.append(l)
+        data = nd.stack(*datas, axis=0)
+        label = nd.array(_np.asarray(labels))
+        return DataBatch(data=[data], label=[label])
+
+    __next__ = next

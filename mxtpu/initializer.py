@@ -96,9 +96,10 @@ class Initializer:
             self._init_zero(desc, arr)
         elif name.endswith("max"):
             self._init_one(desc, arr)
-        elif name.endswith("moving_mean"):
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(desc, arr)
-        elif name.endswith("moving_var") or name.endswith("moving_avg"):
+        elif name.endswith("moving_var") or name.endswith("moving_avg") \
+                or name.endswith("running_var"):
             self._init_one(desc, arr)
         elif name.endswith("moving_inv_var"):
             self._init_zero(desc, arr)
@@ -135,6 +136,9 @@ class Initializer:
             and self._kwargs == other._kwargs
 
 
+_NAME_ALIASES = {"zeros": "zero", "ones": "one"}
+
+
 def create(name, **kwargs):
     """Create an initializer from registry name or pass through instances."""
     if isinstance(name, Initializer):
@@ -142,6 +146,7 @@ def create(name, **kwargs):
     if callable(name) and not isinstance(name, type):
         return name
     key = name.lower() if isinstance(name, str) else name
+    key = _NAME_ALIASES.get(key, key)
     if key not in _INIT_REGISTRY:
         raise ValueError("unknown initializer %r" % (name,))
     return _INIT_REGISTRY[key](**kwargs)

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from ..base import canonical_dtype, MXNetError
 from ..context import Context, current_context, cpu
 from .. import autograd as _ag
-from ..ops.registry import get_op, list_ops, next_rng_key, _RNG
+from ..ops.registry import get_op, list_ops, next_rng_key
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "save", "load", "waitall", "imports"]
@@ -298,7 +298,9 @@ def invoke(op, inputs, params):
         call_params["_training"] = _ag.is_training()
     rng_key = None
     if op.stateful:
-        _RNG.key, rng_key = jax.random.split(_RNG.key)
+        # scope-aware draw: inside a jit trace an enclosing rng_scope supplies
+        # a traced key (never mutate the global key with a tracer)
+        rng_key = next_rng_key()
         with _rng(rng_key):
             result = op.fn(*values, **call_params)
     else:

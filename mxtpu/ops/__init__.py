@@ -15,3 +15,4 @@ from . import nn             # noqa: F401
 from . import random_ops     # noqa: F401
 from . import optim_ops      # noqa: F401
 from . import linalg_ops     # noqa: F401
+from . import rnn            # noqa: F401

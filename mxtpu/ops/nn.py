@@ -539,3 +539,82 @@ def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
 
     f.defvjp(fwd, bwd)
     return f(data, label)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/contrib/ctc_loss.cc, vendored warp-ctc).
+# TPU-native design: log-space forward DP expressed as one lax.scan over
+# time — a single compiled kernel, batch-vectorised over (N, S), instead of
+# warp-ctc's per-sample CUDA workspace machinery.
+# ---------------------------------------------------------------------------
+
+@register("ctc_loss", aliases=("CTCLoss", "_contrib_ctc_loss",
+                               "_contrib_CTCLoss"))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """CTC negative log-likelihood.
+
+    data: (T, N, C) unnormalised activations (softmax applied internally,
+    matching the reference); label: (N, L) int labels padded with 0 (when
+    blank is 'first') or -1; returns per-sample loss of shape (N,).
+    """
+    T, N, C = data.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=2)
+    lab = label.astype(jnp.int32)
+    blank = 0 if blank_label == "first" else C - 1
+    if blank == 0:
+        lab_valid = lab > 0
+    else:
+        lab_valid = lab >= 0
+    if label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum(lab_valid.astype(jnp.int32), axis=1)
+    if data_lengths is not None:
+        t_len = data_lengths.astype(jnp.int32)
+    else:
+        t_len = jnp.full((N,), T, dtype=jnp.int32)
+
+    neg_inf = jnp.float32(-1e30)
+    s_idx = jnp.arange(S)
+    lab_pos = jnp.maximum((s_idx[None, :] - 1) // 2, 0)
+    ext = jnp.where(s_idx[None, :] % 2 == 0, blank,
+                    jnp.take_along_axis(lab, lab_pos, axis=1))  # (N, S)
+    ext_m2 = jnp.concatenate(
+        [jnp.full((N, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    allow_skip = (s_idx[None, :] % 2 == 1) & (ext != ext_m2)
+
+    def lse3(a, b, c):
+        m = jnp.maximum(jnp.maximum(a, b), c)
+        m = jnp.maximum(m, neg_inf)  # avoid -inf - -inf
+        return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m) + jnp.exp(c - m))
+
+    def step(alpha, logp_t):
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)  # (N, S)
+        a2 = jnp.concatenate(
+            [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a3 = jnp.concatenate(
+            [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a3 = jnp.where(allow_skip, a3, neg_inf)
+        new = emit + lse3(alpha, a2, a3)
+        return new, new
+
+    # virtual pre-start state: probability mass only at s=0, no emission yet
+    start = jnp.where(jnp.broadcast_to(s_idx[None, :] == 0, (N, S)),
+                      0.0, neg_inf)
+    _, alphas = jax.lax.scan(step, start, logp)  # (T, N, S)
+
+    last = jnp.take_along_axis(
+        alphas, (t_len - 1)[None, :, None].astype(jnp.int32), axis=0)[0]
+    end1 = jnp.take_along_axis(last, (2 * lab_len)[:, None], axis=1)[:, 0]
+    end2 = jnp.take_along_axis(
+        last, jnp.maximum(2 * lab_len - 1, 0)[:, None], axis=1)[:, 0]
+    # empty label (lab_len==0): only the all-blank path exists; don't count
+    # the clamped duplicate end state twice
+    end2 = jnp.where(lab_len > 0, end2, neg_inf)
+    m = jnp.maximum(end1, end2)
+    ll = m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m))
+    return -ll.astype(data.dtype)

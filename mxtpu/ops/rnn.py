@@ -1,0 +1,165 @@
+"""Fused RNN op.
+
+Capability parity with MXNet's fused RNN operator
+(``src/operator/rnn-inl.h``, ``src/operator/cudnn_rnn-inl.h``): one op runs
+a full multi-layer (optionally bidirectional) RNN/LSTM/GRU over a sequence,
+with all weights packed into a single flat parameter vector exactly like
+the cuDNN packing the reference uses.
+
+TPU-first design: the time loop is a ``lax.scan`` (compiled once, no
+per-step dispatch), the per-step math is two MXU matmuls batched over the
+whole layer, and dropout between layers draws from the functional PRNG.
+Gate orders: LSTM [i, f, g, o]; GRU [r, z, n] — consistent with the
+unfused cells in gluon/rnn/rnn_cell.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, next_rng_key
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _unpack_params(params, mode, input_size, state_size, num_layers,
+                   num_dir):
+    """Slice the flat cudnn-layout vector: all weights (layer-major,
+    direction within layer), then all biases."""
+    G = _GATES[mode]
+    H = state_size
+    weights, biases = [], []
+    off = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else H * num_dir
+        for d in range(num_dir):
+            wi = params[off:off + G * H * isz].reshape(G * H, isz)
+            off += G * H * isz
+            wh = params[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            weights.append((wi, wh))
+    for layer in range(num_layers):
+        for d in range(num_dir):
+            bi = params[off:off + G * H]
+            off += G * H
+            bh = params[off:off + G * H]
+            off += G * H
+            biases.append((bi, bh))
+    return weights, biases
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    G = _GATES[mode]
+    H = state_size
+    D = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else H * D
+        size += D * (G * H * isz + G * H * H + 2 * G * H)
+    return size
+
+
+def _cell_step(mode, H):
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = jnp.tanh if mode == "rnn_tanh" else lambda v: jnp.maximum(v, 0)
+
+        def step(carry, gates):
+            h, c = carry
+            new_h = act(gates)
+            return (new_h, c), new_h
+    elif mode == "lstm":
+        def step(carry, gates):
+            h, c = carry
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return (new_h, new_c), new_h
+    else:
+        step = None  # gru handled separately (needs h inside gate math)
+    return step
+
+
+def _run_direction(xs, h0, c0, wi, wh, bi, bh, mode, reverse):
+    """xs: (T, N, I); returns (T, N, H), hT, cT."""
+    H = h0.shape[-1]
+    G = _GATES[mode]
+    # hoist the input projection out of the scan: one big MXU matmul
+    x_proj = jnp.einsum("tni,gi->tng", xs, wi) + bi  # (T, N, G*H)
+    if reverse:
+        x_proj = jnp.flip(x_proj, axis=0)
+
+    if mode == "gru":
+        # split h2h so the candidate gate sees r * (h @ Whn + bhn)
+        wh_rz, wh_n = wh[:2 * H], wh[2 * H:]
+        bh_rz, bh_n = bh[:2 * H], bh[2 * H:]
+
+        def step(carry, xp):
+            h, _ = carry
+            rz = jax.nn.sigmoid(
+                xp[:, :2 * H] + h @ wh_rz.T + bh_rz)
+            r, z = jnp.split(rz, 2, axis=-1)
+            n = jnp.tanh(xp[:, 2 * H:] + r * (h @ wh_n.T + bh_n))
+            new_h = (1 - z) * n + z * h
+            return (new_h, new_h), new_h
+    else:
+        cell = _cell_step(mode, H)
+
+        def step(carry, xp):
+            h, c = carry
+            gates = xp + h @ wh.T + bh
+            return cell((h, c), gates)
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), x_proj)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+@register("RNN", aliases=("rnn",), stateful=True, needs_train_flag=True)
+def rnn(data, parameters, state, state_cell=None, state_size=0,
+        num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+        state_outputs=False, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, _training=False):
+    """data: (T, N, I); state: (L*D, N, H); returns output (T, N, D*H)
+    plus final states when state_outputs (reference rnn-inl.h RNNParam)."""
+    T, N, I = data.shape
+    H = state_size
+    D = 2 if bidirectional else 1
+    L = num_layers
+    weights, biases = _unpack_params(parameters, mode, I, H, L, D)
+    if state_cell is None:
+        state_cell = jnp.zeros_like(state)
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            idx = layer * D + d
+            wi, wh = weights[idx]
+            bi, bh = biases[idx]
+            ys, hT, cT = _run_direction(
+                x, state[idx], state_cell[idx], wi, wh, bi, bh, mode,
+                reverse=(d == 1))
+            outs.append(ys)
+            h_finals.append(hT)
+            c_finals.append(cT)
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and _training and layer != L - 1:
+            keep = jax.random.bernoulli(next_rng_key(), 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0)
+    h_out = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        c_out = jnp.stack(c_finals, axis=0)
+        if lstm_state_clip_min is not None:
+            c_out = jnp.clip(c_out, lstm_state_clip_min, lstm_state_clip_max)
+        if state_outputs:
+            return x, h_out, c_out
+        return x
+    if state_outputs:
+        return x, h_out
+    return x

@@ -650,10 +650,13 @@ def _infer_graph_shapes(sym, known, partial=False):
     shapes = dict(known)  # var name -> shape
     node_out_dtypes = {}
     nodes = sym._topo()
-    # include declared shapes on vars
+    # include declared shapes on vars; dims of 0 mean "unknown" (MXNet's
+    # deferred-init convention) so such shapes don't count as known
     for n in nodes:
         if n.is_variable and "__shape__" in n.attrs and n.name not in shapes:
-            shapes[n.name] = tuple(n.attrs["__shape__"])
+            s = tuple(n.attrs["__shape__"])
+            if all(d > 0 for d in s):
+                shapes[n.name] = s
 
     node_out_shapes = {}
 

@@ -1,0 +1,351 @@
+"""Gluon tests (modeled on reference tests/python/unittest/test_gluon.py,
+test_gluon_rnn.py, test_gluon_data.py, test_loss.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.list_data()[0] is p.data()
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False,
+                     prefix="test_dense_")
+    inputs = mx.nd.zeros((2, 3, 10))
+    model.initialize()
+    out = model(inputs)
+    assert out.shape == (2, 3, 128)
+    assert list(model.collect_params().keys()) == \
+        ["test_dense_weight", "test_dense_bias"]
+
+    model2 = nn.Dense(64, activation="relu", in_units=30, prefix="fc_")
+    inputs2 = mx.nd.zeros((17, 2, 15))
+    model2.initialize()
+    assert model2(inputs2).shape == (17, 64)
+
+
+def test_hybrid_eager_consistency():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(8))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(4, 16))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(y_eager, y_hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_backward_matches_eager():
+    np.random.seed(0)
+
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"))
+            net.add(nn.Dense(4))
+        return net
+
+    net = build()
+    net.initialize()
+    x = mx.nd.array(np.random.rand(8, 10))
+    label = mx.nd.array(np.random.randint(0, 4, (8,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    with mx.autograd.record():
+        loss = loss_fn(net(x), label)
+    loss.backward()
+    eager_grads = {k: v.grad().asnumpy().copy()
+                   for k, v in net.collect_params().items()}
+
+    net.hybridize()
+    with mx.autograd.record():
+        loss = loss_fn(net(x), label)
+    loss.backward()
+    for k, v in net.collect_params().items():
+        np.testing.assert_allclose(eager_grads[k], v.grad().asnumpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_batchnorm_running_stats():
+    layer = nn.BatchNorm(in_channels=4)
+    layer.initialize()
+    x = mx.nd.random_normal(loc=2.0, scale=3.0, shape=(16, 4, 5, 5))
+    with mx.autograd.record():
+        y = layer(x)
+    # running mean moved toward batch mean
+    rm = layer.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0
+    # inference mode uses running stats (no crash, deterministic)
+    y1 = layer(x).asnumpy()
+    y2 = layer(x).asnumpy()
+    np.testing.assert_allclose(y1, y2)
+
+
+def test_dropout_modes():
+    layer = nn.Dropout(0.5)
+    layer.initialize()
+    x = mx.nd.ones((100, 100))
+    # predict mode: identity
+    np.testing.assert_allclose(layer(x).asnumpy(), x.asnumpy())
+    with mx.autograd.record():
+        y = layer(x)
+    frac_zero = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_trainer_convergence():
+    np.random.seed(0)
+    net = nn.Dense(1, in_units=4, use_bias=False)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    w_true = np.array([[1.0, -2.0, 3.0, 0.5]], dtype=np.float32)
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(200):
+        x = mx.nd.array(np.random.rand(16, 4))
+        y = mx.nd.array(x.asnumpy() @ w_true.T)
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(16)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w_true,
+                               atol=1e-2)
+
+
+def test_save_load_params(tmp_path):
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4))
+        net.add(nn.Dense(2, in_units=8))
+    net.initialize()
+    x = mx.nd.ones((1, 4))
+    y0 = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_params(fname)
+
+    net2 = nn.HybridSequential(prefix="model_")
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4))
+        net2.add(nn.Dense(2, in_units=8))
+    net2.load_params(fname)
+    np.testing.assert_allclose(net2(x).asnumpy(), y0, rtol=1e-6)
+
+
+def test_losses():
+    pred = mx.nd.array([[1.0, 2.0, 3.0], [0.0, 0.0, 0.0]])
+    label = mx.nd.array([2, 1])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label).asnumpy()
+    # manual
+    p = pred.asnumpy()
+    lse = np.log(np.exp(p).sum(1))
+    expected = np.array([lse[0] - p[0, 2], lse[1] - p[1, 1]])
+    np.testing.assert_allclose(l, expected, rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, mx.nd.zeros((2, 3))).asnumpy()
+    np.testing.assert_allclose(l2, 0.5 * (p ** 2).mean(1), rtol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(pred, mx.nd.zeros((2, 3))).asnumpy()
+    np.testing.assert_allclose(l1, np.abs(p).mean(1), rtol=1e-5)
+
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    out = bce(mx.nd.array([[10.0]]), mx.nd.array([[1.0]])).asnumpy()
+    assert out[0] < 1e-3
+
+    hl = gluon.loss.HuberLoss()(pred, mx.nd.zeros((2, 3))).asnumpy()
+    assert hl.shape == (2,)
+
+
+def test_ctc_loss():
+    loss = gluon.loss.CTCLoss(layout="TNC")
+    T, N, C = 20, 2, 6
+    acts = mx.nd.random_uniform(shape=(T, N, C))
+    label = mx.nd.array([[2, 3], [4, 0]])
+    l = loss(acts, label).asnumpy()
+    assert l.shape == (N,)
+    assert (l > 0).all()
+
+
+def test_rnn_cells_unroll():
+    for cell_cls, n_states in [(gluon.rnn.RNNCell, 1),
+                               (gluon.rnn.LSTMCell, 2),
+                               (gluon.rnn.GRUCell, 1)]:
+        cell = cell_cls(16, input_size=8)
+        cell.initialize()
+        x = mx.nd.random_uniform(shape=(4, 5, 8))
+        outs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+        assert outs.shape == (4, 5, 16)
+        assert len(states) == n_states
+
+
+def test_fused_lstm_matches_cell():
+    """Fused scan LSTM must agree with the unfused cell stepping."""
+    np.random.seed(0)
+    H, I, T, N = 8, 4, 6, 3
+    layer = gluon.rnn.LSTM(H, input_size=I)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(T, N, I).astype(np.float32))
+    out = layer(x)
+
+    cell = gluon.rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy fused weights into the cell
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, _ = cell.unroll(T, x.swapaxes(0, 1), layout="NTC",
+                          merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(),
+                               outs.swapaxes(0, 1).asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_gru_shape():
+    layer = gluon.rnn.GRU(12, num_layers=2, bidirectional=True,
+                          input_size=6)
+    layer.initialize()
+    x = mx.nd.random_uniform(shape=(7, 2, 6))
+    out, states = layer(x, layer.begin_state(2))
+    assert out.shape == (7, 2, 24)
+    assert states[0].shape == (4, 2, 12)
+
+
+def test_sequential_rnn_cell():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(16, input_size=8))
+    stack.add(gluon.rnn.LSTMCell(16, input_size=16))
+    stack.initialize()
+    x = mx.nd.random_uniform(shape=(2, 5, 8))
+    outs, _ = stack.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 5, 16)
+
+
+def test_conv_layers():
+    x = mx.nd.random_uniform(shape=(2, 3, 16, 16))
+    layer = nn.Conv2D(8, 3, padding=1)
+    layer.initialize()
+    assert layer(x).shape == (2, 8, 16, 16)
+
+    layer = nn.Conv2DTranspose(4, 2, strides=2, in_channels=3)
+    layer.initialize()
+    assert layer(x).shape == (2, 4, 32, 32)
+
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 8, 8)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+
+    x1 = mx.nd.random_uniform(shape=(2, 3, 16))
+    layer = nn.Conv1D(8, 3)
+    layer.initialize()
+    assert layer(x1).shape == (2, 8, 14)
+
+
+def test_model_zoo_smoke():
+    """Construct every family; forward the small ones."""
+    from mxtpu.gluon.model_zoo import vision as models
+    net = models.get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize()
+    assert net(mx.nd.zeros((1, 3, 32, 32))).shape == (1, 10)
+    net = models.get_model("mobilenet0.25", classes=7)
+    net.initialize()
+    assert net(mx.nd.zeros((1, 3, 224, 224))).shape == (1, 7)
+    # constructors only (forward is heavy)
+    for name in ["resnet50_v1", "resnet50_v2", "vgg11", "alexnet",
+                 "densenet121", "squeezenet1.0", "inceptionv3",
+                 "mobilenet1.0"]:
+        models.get_model(name)
+
+
+def test_dataloader():
+    X = np.random.rand(37, 5).astype(np.float32)
+    y = np.arange(37).astype(np.float32)
+    dataset = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(dataset, batch_size=8, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 5
+    assert batches[0][0].shape == (8, 5)
+    assert batches[-1][0].shape == (5, 5)
+    np.testing.assert_allclose(batches[0][1].asnumpy(), y[:8])
+
+    # threaded workers produce the same batches in order
+    loader2 = gluon.data.DataLoader(dataset, batch_size=8, shuffle=False,
+                                    num_workers=2)
+    for (a, _), (b, _) in zip(loader, loader2):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+
+    # last_batch=discard
+    loader3 = gluon.data.DataLoader(dataset, batch_size=8,
+                                    last_batch="discard")
+    assert len(list(loader3)) == 4
+
+
+def test_split_and_load():
+    data = mx.nd.arange(0, 80).reshape((8, 10))
+    splits = gluon.utils.split_data(data, 4)
+    assert len(splits) == 4
+    assert splits[0].shape == (2, 10)
+
+
+def test_clip_global_norm():
+    x1 = mx.nd.ones((3,)) * 3.0
+    x2 = mx.nd.ones((4,)) * 4.0
+    norm = gluon.utils.clip_global_norm([x1, x2], 1.0)
+    total = np.sqrt((x1.asnumpy() ** 2).sum() + (x2.asnumpy() ** 2).sum())
+    np.testing.assert_allclose(total, 1.0, rtol=1e-3)
+
+
+def test_symbol_block():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, name="fc1", num_hidden=6)
+    out = mx.sym.Activation(out, act_type="relu")
+    block = gluon.SymbolBlock(out, data)
+    block.initialize()
+    y = block(mx.nd.ones((2, 3)))
+    assert y.shape == (2, 6)
+
+
+def test_embedding_block():
+    layer = nn.Embedding(10, 4)
+    layer.initialize()
+    idx = mx.nd.array([1, 2, 3])
+    assert layer(idx).shape == (3, 4)
+    # grads flow to weight
+    with mx.autograd.record():
+        out = layer(idx).sum()
+    out.backward()
+    g = layer.weight.grad().asnumpy()
+    assert np.abs(g[1:4]).sum() > 0 and np.abs(g[5:]).sum() == 0
+
+
+def test_hybridize_shape_change():
+    """jit cache re-specializes per input shape like CachedOp rebind."""
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize()
+    assert net(mx.nd.ones((2, 3))).shape == (2, 4)
+    assert net(mx.nd.ones((5, 3))).shape == (5, 4)
