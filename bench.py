@@ -1,0 +1,70 @@
+"""mxtpu headline benchmark: ResNet-50 training throughput (images/sec).
+
+Mirrors the reference's benchmark methodology
+(`example/image-classification/train_imagenet.py` + docs/faq/perf.md:176-185,
+measured with batch 32 on 1x P100 = 181.53 img/s): synthetic ImageNet-shaped
+data, full training step (forward + backward + SGD-momentum update), steady-
+state timing after warmup. Runs on whatever accelerator JAX exposes (the
+driver provides one real TPU chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # ResNet-50 train, batch 32, 1x P100 (perf.md:185)
+
+
+def main():
+    import jax
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.parallel import MeshContext, ShardedTrainer
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    batch = 32
+    hw = 224
+    if not on_tpu:
+        # CPU fallback so the script stays runnable anywhere; numbers are
+        # only meaningful on TPU.
+        batch, hw = 8, 64
+
+    mx.random.seed(0)
+    net = vision.get_resnet(1, 50)
+    net.initialize(mx.init.Xavier())
+    x = np.random.uniform(0, 1, (batch, 3, hw, hw)).astype(np.float32)
+    y = np.random.randint(0, 1000, (batch,)).astype(np.float32)
+    net(mx.nd.array(x[:1]))
+
+    mesh = MeshContext(jax.devices()[:1], data=1)
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "sgd", {"learning_rate": 0.05, "momentum": 0.9,
+                                "wd": 1e-4},
+                        mesh=mesh)
+
+    # warmup: compile + settle
+    for _ in range(3):
+        st.step(x, y)
+    n_iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        st.step(x, y)
+    dt = time.perf_counter() - t0
+    img_s = batch * n_iters / dt
+
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

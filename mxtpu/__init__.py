@@ -25,7 +25,7 @@ def _optional_imports():
     g = globals()
     for name, aliases in [
         ("symbol", ("sym",)), ("executor", ()), ("optimizer", ("opt",)),
-        ("initializer", ()), ("metric", ()), ("lr_scheduler", ()),
+        ("initializer", ("init",)), ("metric", ()), ("lr_scheduler", ()),
         ("io", ()), ("callback", ()), ("model", ()), ("module", ("mod",)),
         ("kvstore", ("kv",)), ("gluon", ()), ("parallel", ()),
         ("profiler", ()), ("recordio", ()), ("image", ()),

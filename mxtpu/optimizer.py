@@ -450,7 +450,7 @@ class SGLD(Optimizer):
         from .ops.registry import next_rng_key
         import jax
         eps = jax.random.normal(next_rng_key(), weight.shape,
-                                weight._data.dtype) * math.sqrt(lr)
+                                weight._data.dtype) * jnp.sqrt(lr)
         weight._data = weight._data - lr / 2 * (g + wd * weight._data) + eps
 
 
@@ -482,7 +482,8 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
+        # jnp so the step count t may be a traced scalar (sharded trainer)
+        lr = lr * jnp.sqrt(coef2) / coef1
         mean, var = state
         new_w, new_mean, new_var = nd.adam_update(
             weight, grad, mean, var, lr=lr, beta1=self.beta1,

@@ -1,0 +1,29 @@
+"""mxtpu.parallel — SPMD parallelism over TPU device meshes.
+
+The TPU-native replacement for the reference's entire distributed stack
+(SURVEY §2.4): DataParallelExecutorGroup batch slicing, KVStore
+local/device/nccl/dist backends (``src/kvstore/``), ps-lite parameter
+servers, and group2ctx model-parallel placement all map onto ONE
+abstraction here — a named ``jax.sharding.Mesh`` plus sharding rules,
+with XLA inserting the ICI/DCN collectives.
+
+Axes: ``data`` (DP), ``model`` (TP), ``pipe`` (PP), ``seq``
+(ring-attention context parallelism), ``expert`` (MoE).
+"""
+from .mesh import (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT,
+                   make_mesh, MeshContext, ShardingRules, PartitionSpec,
+                   NamedSharding, Mesh, current_mesh)
+from .trainer import (ShardedTrainer, functional_optimizer_step,
+                      state_to_tree, tree_to_state)
+from .ring_attention import (ring_attention, ring_attention_sharded,
+                             ulysses_attention, local_attention)
+
+__all__ = [
+    "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
+    "make_mesh", "MeshContext", "ShardingRules", "PartitionSpec",
+    "NamedSharding", "Mesh", "current_mesh",
+    "ShardedTrainer", "functional_optimizer_step", "state_to_tree",
+    "tree_to_state",
+    "ring_attention", "ring_attention_sharded", "ulysses_attention",
+    "local_attention",
+]

@@ -1,0 +1,146 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context scaling is first-class in mxtpu. The reference's only
+sequence-length tooling is bucketing (SURVEY §5.7 — BucketingModule,
+``python/mxnet/module/bucketing_module.py:36``); on TPU we scale the
+sequence dimension itself across the mesh ``seq`` axis:
+
+* **Ring attention** — K/V blocks rotate around the ring via
+  ``lax.ppermute`` over ICI while each device holds its Q shard and
+  accumulates the softmax online (flash-attention style running max /
+  denominator), so attention over sequence length S costs O(S/n) memory
+  per device and the permute overlaps with the block matmuls.
+* **Ulysses all-to-all** — ``lax.all_to_all`` re-shards [seq-sharded,
+  heads-replicated] activations into [seq-replicated, heads-sharded]
+  around a standard attention core, for models whose head count divides
+  the seq axis.
+
+Both are pure jax functions usable inside any jitted step; `shard_map`
+wrappers bind them to a MeshContext.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import MeshContext, AXIS_SEQ, AXIS_DATA
+
+__all__ = ["ring_attention", "ring_attention_sharded", "ulysses_attention",
+           "local_attention"]
+
+
+def local_attention(q, k, v, causal=False, scale=None, q_offset=0,
+                    k_offset=0):
+    """Plain softmax attention on local shards. q,k,v: [B, H, T, D].
+
+    ``q_offset``/``k_offset`` give the global positions of the local rows
+    for causal masking under sequence sharding."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[2])
+        ki = k_offset + jnp.arange(k.shape[2])
+        mask = qi[:, None] >= ki[None, :]
+        s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def ring_attention(q, k, v, axis_name=AXIS_SEQ, causal=False, scale=None):
+    """Ring attention over a shard_map axis. q,k,v: local [B, H, T/n, D].
+
+    Must run inside shard_map (or pmap) with ``axis_name`` bound. Each of
+    the n ring steps attends Q_local against one rotating K/V block with a
+    numerically-stable online softmax, then ppermutes K/V to the next
+    neighbour — the all-gather-free formulation (Liu et al., Ring
+    Attention; blockwise parallel transformers)."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, t, d = q.shape
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+
+    def step(i, carry):
+        o, m, l, kk, vv = carry
+        src = (my - i) % n          # whose K/V block we now hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       kk.astype(jnp.float32)) * scale
+        if causal:
+            qi = my * t + jnp.arange(t)
+            ki = src * t + jnp.arange(t)
+            mask = qi[:, None] >= ki[None, :]
+            s = jnp.where(mask[None, None], s, neg)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows (exp of min stays finite at 0 via where)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vv.astype(jnp.float32))
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return o_new, m_new, l_new, kk, vv
+
+    o = jnp.zeros((b, h, t, d), jnp.float32)
+    m = jnp.full((b, h, t), neg, jnp.float32)
+    l = jnp.zeros((b, h, t), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o, m, l, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, causal=False,
+                           data_axis=AXIS_DATA, seq_axis=AXIS_SEQ):
+    """shard_map-bound ring attention over a MeshContext.
+
+    q,k,v: global [B, H, T, D]; B sharded over ``data``, T over ``seq``.
+    Returns the attention output with the same layout."""
+    if isinstance(mesh, MeshContext):
+        mesh = mesh.mesh
+    spec = P(data_axis if data_axis in mesh.axis_names else None, None,
+             seq_axis if seq_axis in mesh.axis_names else None, None)
+    if seq_axis not in mesh.axis_names:
+        return local_attention(q, k, v, causal=causal)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name=AXIS_SEQ, causal=False,
+                      attn_fn=None):
+    """DeepSpeed-Ulysses style sequence parallelism inside shard_map.
+
+    Local inputs [B, H, T/n, D] are all-to-all'd to [B, H/n, T, D] (full
+    sequence, sharded heads), attention runs locally, then the layout is
+    restored. Requires H % n == 0."""
+    n = lax.psum(1, axis_name)
+    b, h, t, d = q.shape
+
+    def scatter_heads(x):   # [B,H,T/n,D] -> [B,H/n,T,D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def gather_heads(x):    # [B,H/n,T,D] -> [B,H,T/n,D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if attn_fn is None:
+        attn_fn = lambda a, b_, c: local_attention(a, b_, c, causal=causal)
+    oh = attn_fn(qh, kh, vh)
+    return gather_heads(oh)

@@ -1,0 +1,401 @@
+"""ShardedTrainer: the SPMD training step.
+
+This is the TPU-native rendering of the reference's whole data-parallel
+training machinery — ``DataParallelExecutorGroup`` batch slicing
+(``python/mxnet/module/executor_group.py:289,422,554``), KVStore gradient
+reduce/broadcast (``src/kvstore/comm.h``, ``kvstore_nccl.h``), and the
+optimizer ``Updater`` loop (``python/mxnet/optimizer.py`` +
+``src/operator/optimizer_op.*``) — collapsed into ONE jitted XLA program
+laid out over a named device mesh:
+
+* the batch arrives sharded over the ``data`` axis (no host-side split);
+* forward+backward run as a single fused computation; GSPMD inserts the
+  psum/reduce-scatter over ICI that CommDevice/NCCL did by hand — and
+  because gradients are produced layer-by-layer inside one program, XLA
+  overlaps the collectives with remaining backward compute, which is
+  exactly the engine-priority overlap trick of ``comm.h``
+  (FnProperty::kCPUPrioritized) done by the compiler;
+* the optimizer update runs sharded in the same program (the
+  "update_on_kvstore" capability: the update happens where the data lives);
+* tensor/model parallelism is expressed by parameter ShardingRules
+  (mesh.py) — the superset of the reference's group2ctx placement.
+
+Any mxtpu Optimizer works unmodified inside the jitted step: a functional
+adapter feeds it traced (t, lr) scalars so Adam bias-correction and LR
+schedules stay dynamic across steps without retracing.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from .. import autograd as _ag
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+from ..ops.registry import rng_scope
+from ..gluon.block import _swap_params, _trace_scope
+from ..gluon.loss import Loss
+from .mesh import MeshContext, ShardingRules, AXIS_DATA
+
+__all__ = ["ShardedTrainer", "functional_optimizer_step", "state_to_tree",
+           "tree_to_state"]
+
+
+# ---------------------------------------------------------------------------
+# optimizer state <-> pytree
+# ---------------------------------------------------------------------------
+
+def state_to_tree(state):
+    """Optimizer state (None | NDArray | nested tuple/list) → jax pytree."""
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state._data
+    if isinstance(state, (tuple, list)):
+        return tuple(state_to_tree(s) for s in state)
+    return state
+
+
+def tree_to_state(tree):
+    """jax pytree → NDArray-structured optimizer state for Optimizer.update."""
+    if tree is None:
+        return None
+    if isinstance(tree, (tuple, list)):
+        return tuple(tree_to_state(t) for t in tree)
+    return NDArray(tree)
+
+
+class _TracedCounts(dict):
+    """Stands in for Optimizer._index_update_count during a functional
+    trace: every key reads as the traced step count."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __getitem__(self, key):
+        return self._t
+
+    def __setitem__(self, key, value):
+        pass
+
+    def __contains__(self, key):
+        return True
+
+
+class _functional_optimizer:
+    """Patch an Optimizer instance so update() can be traced by jit with a
+    dynamic step count and learning rate.
+
+    The imperative Optimizer API keeps host-side python counters
+    (``_index_update_count``, ``num_update``) and computes lr via its
+    scheduler at call time. Inside jit those would freeze at trace-time
+    values; this context hands the optimizer traced scalars instead."""
+
+    def __init__(self, opt, t, lr):
+        self._opt = opt
+        self._t = t
+        self._lr = lr
+
+    def __enter__(self):
+        o = self._opt
+        self._saved = (o.__dict__.get("_index_update_count"),
+                       o.__dict__.get("num_update"))
+        lr_arg = self._lr
+
+        def _get_lr(index):
+            mult = 1.0
+            if index in o.param_dict:
+                mult = o.param_dict[index].lr_mult
+            elif index in o.lr_mult:
+                mult = o.lr_mult[index]
+            elif index in o.idx2name:
+                mult = o.lr_mult.get(o.idx2name[index], 1.0)
+            return lr_arg * mult
+
+        o._index_update_count = _TracedCounts(self._t)
+        o.num_update = self._t
+        o._update_count = lambda index: None
+        o._get_lr = _get_lr
+        return o
+
+    def __exit__(self, *a):
+        o = self._opt
+        for name in ("_update_count", "_get_lr"):
+            o.__dict__.pop(name, None)
+        saved_counts, saved_num = self._saved
+        if saved_counts is None:
+            o.__dict__.pop("_index_update_count", None)
+        else:
+            o._index_update_count = saved_counts
+        if saved_num is None:
+            o.__dict__.pop("num_update", None)
+        else:
+            o.num_update = saved_num
+
+
+def functional_optimizer_step(optimizer, index, weight_val, grad_val,
+                              state_tree, t, lr):
+    """Run one Optimizer.update purely: (w, g, state, t, lr) → (w', state').
+
+    Reuses the full imperative optimizer library (all 14 registered
+    optimizers, reference optimizer.py:432-1434) inside jit."""
+    w = NDArray(weight_val)
+    g = NDArray(grad_val)
+    state = tree_to_state(state_tree)
+    with _functional_optimizer(optimizer, t, lr):
+        optimizer.update_multi_precision(index, w, g, state)
+    return w._data, state_to_tree(state)
+
+
+# ---------------------------------------------------------------------------
+# ShardedTrainer
+# ---------------------------------------------------------------------------
+
+def _as_jax(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
+class ShardedTrainer:
+    """Train a Gluon block SPMD over a device mesh.
+
+    Parameters
+    ----------
+    block : HybridBlock
+        The model. Parameters must be initialized (or initializable from
+        the first batch's shapes).
+    loss : gluon Loss block or callable(pred, label) -> NDArray
+    optimizer : str or mxtpu Optimizer
+    mesh : MeshContext, optional (defaults to all devices on the data axis)
+    rules : ShardingRules, optional — tensor-parallel parameter layouts;
+        unmatched parameters are replicated (pure DP).
+
+    Example
+    -------
+    >>> mesh = MeshContext(data=4, model=2)
+    >>> st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+    ...                     'sgd', {'learning_rate': 0.1}, mesh=mesh,
+    ...                     rules=ShardingRules([...]))
+    >>> loss = st.step(data, label)
+    """
+
+    def __init__(self, block, loss, optimizer, optimizer_params=None,
+                 mesh=None, rules=None, donate=True):
+        self._block = block
+        self._loss = loss
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+            if optimizer_params:
+                raise ValueError("optimizer_params must be empty when "
+                                 "optimizer is an Optimizer instance")
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             **(optimizer_params or {}))
+        self._mesh = mesh if mesh is not None else MeshContext()
+        self._rules = rules or ShardingRules()
+        self._donate = donate
+        self._step_fns = {}
+        self._placed = False
+        self._key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
+        self._num_update = 0
+        # filled at first placement
+        self._params = None
+        self._train_idx = None
+        self._aux_idx = None
+        self._param_vals = None
+        self._aux_vals = None
+        self._opt_states = None
+        self._shardings = None
+
+    # -- placement ---------------------------------------------------------
+    def _place(self, args):
+        """Finish init, shard every parameter and optimizer state onto the
+        mesh per the ShardingRules, create sharded optimizer state."""
+        block = self._block
+        try:
+            for p in block._ordered_params():
+                p._finish_deferred_init()
+        except Exception:
+            block._deferred_infer_shape(*args)
+        params = block._ordered_params()
+        self._params = params
+        self._train_idx = [i for i, p in enumerate(params)
+                           if p.grad_req != "null"]
+        self._aux_idx = [i for i, p in enumerate(params)
+                         if p.grad_req == "null"]
+        shardings = [self._rules.sharding_for(self._mesh, p.name, p.shape)
+                     for p in params]
+        self._shardings = shardings
+        vals = [jax.device_put(p.data()._data, s)
+                for p, s in zip(params, shardings)]
+        self._param_vals = [vals[i] for i in self._train_idx]
+        self._aux_vals = [vals[i] for i in self._aux_idx]
+        # sharded optimizer state: any state leaf with the param's shape
+        # inherits the param's sharding (momentum/variance live alongside
+        # the weight shard — the ZeRO-friendly default), scalars replicate.
+        self._opt_states = []
+        for j, i in enumerate(self._train_idx):
+            p = params[i]
+            st = state_to_tree(
+                self._optimizer.create_state_multi_precision(j, p.data()))
+            sh = shardings[i]
+
+            def place_leaf(leaf, sh=sh, shape=p.shape):
+                if leaf is None:
+                    return None
+                tgt = sh if tuple(leaf.shape) == tuple(shape) \
+                    else self._mesh.replicated()
+                return jax.device_put(leaf, tgt)
+
+            self._opt_states.append(jax.tree_util.tree_map(
+                place_leaf, st, is_leaf=lambda x: x is None))
+        self._placed = True
+
+    # -- the jitted step ---------------------------------------------------
+    def _build_step(self, shapes_key, n_inputs, with_update):
+        block = self._block
+        loss_blk = self._loss
+        params = self._params
+        train_idx = self._train_idx
+        aux_idx = self._aux_idx
+        optimizer = self._optimizer
+        mesh = self._mesh
+
+        def forward_loss(train_vals, aux_vals, inputs, label, key, training):
+            full = [None] * len(params)
+            for v, i in zip(train_vals, train_idx):
+                full[i] = NDArray(v)
+            for v, i in zip(aux_vals, aux_idx):
+                full[i] = NDArray(v)
+            ins = [NDArray(v) for v in inputs]
+            with _ag.pause(train_mode=training), rng_scope(key), \
+                    _trace_scope(), \
+                    _swap_params(block, dict(zip(params, full))):
+                out = block._run_hybrid(ins)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                if isinstance(loss_blk, Loss):
+                    with _swap_params(
+                            loss_blk,
+                            dict(zip(loss_blk._ordered_params(),
+                                     [NDArray(p.data()._data)
+                                      for p in loss_blk._ordered_params()]))):
+                        l = loss_blk(outs[0], NDArray(label))
+                elif callable(loss_blk):
+                    l = loss_blk(outs[0], NDArray(label))
+                else:
+                    raise TypeError("loss must be a Loss block or callable")
+            loss_val = jnp.mean(l._data)
+            aux_new = tuple(full[i]._data for i in aux_idx)
+            return loss_val, (aux_new, tuple(o._data for o in outs))
+
+        def train_step(train_vals, states, aux_vals, inputs, label, key,
+                       t, lr):
+            (loss_val, (aux_new, outs)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(
+                    train_vals, aux_vals, inputs, label, key, True)
+            new_vals, new_states = [], []
+            for j, (w, g, st) in enumerate(zip(train_vals, grads, states)):
+                w2, st2 = functional_optimizer_step(
+                    optimizer, j, w, g, st, t, lr)
+                new_vals.append(w2)
+                new_states.append(st2)
+            # pin layouts so donation round-trips buffers in place
+            new_vals = [
+                jax.lax.with_sharding_constraint(v, s)
+                for v, s in zip(new_vals,
+                                [self._shardings[i] for i in train_idx])]
+            return tuple(new_vals), tuple(new_states), tuple(aux_new), \
+                loss_val, outs
+
+        def eval_step(train_vals, aux_vals, inputs, label, key):
+            loss_val, (aux_new, outs) = forward_loss(
+                train_vals, aux_vals, inputs, label, key, False)
+            return loss_val, outs
+
+        with mesh.mesh:
+            if with_update:
+                return jax.jit(train_step,
+                               donate_argnums=(0, 1, 2)
+                               if self._donate else ())
+            return jax.jit(eval_step)
+
+    # -- public API --------------------------------------------------------
+    def _shard_batch(self, arrs):
+        out = []
+        for a in arrs:
+            v = _as_jax(a)
+            sh = self._mesh.batch_sharding(v.ndim)
+            out.append(jax.device_put(v, sh))
+        return out
+
+    def step(self, data, label):
+        """One fused forward/backward/update step. Returns the scalar loss
+        (host float) — the Module.forward_backward+update equivalent."""
+        data_list = data if isinstance(data, (list, tuple)) else [data]
+        if not self._placed:
+            self._place([NDArray(_as_jax(d)) for d in data_list])
+        inputs = self._shard_batch(data_list)
+        label_j = self._shard_batch([label])[0]
+        key, self._key = jax.random.split(self._key)
+        skey = ("train", tuple(tuple(i.shape) for i in inputs),
+                tuple(label_j.shape))
+        if skey not in self._step_fns:
+            self._step_fns[skey] = self._build_step(skey, len(inputs), True)
+        self._num_update += 1
+        t = jnp.asarray(self._num_update, jnp.int32)
+        lr = jnp.asarray(self._host_lr(), jnp.float32)
+        new_vals, new_states, aux_new, loss_val, outs = self._step_fns[skey](
+            tuple(self._param_vals), tuple(self._opt_states),
+            tuple(self._aux_vals), tuple(inputs), label_j, key, t, lr)
+        self._param_vals = list(new_vals)
+        self._opt_states = list(new_states)
+        self._aux_vals = list(aux_new)
+        self._last_outputs = outs
+        return float(loss_val)
+
+    def forward(self, data, label):
+        """Evaluation forward: returns (loss, outputs) without updating."""
+        data_list = data if isinstance(data, (list, tuple)) else [data]
+        if not self._placed:
+            self._place([NDArray(_as_jax(d)) for d in data_list])
+        inputs = self._shard_batch(data_list)
+        label_j = self._shard_batch([label])[0]
+        key, self._key = jax.random.split(self._key)
+        skey = ("eval", tuple(tuple(i.shape) for i in inputs),
+                tuple(label_j.shape))
+        if skey not in self._step_fns:
+            self._step_fns[skey] = self._build_step(skey, len(inputs), False)
+        loss_val, outs = self._step_fns[skey](
+            tuple(self._param_vals), tuple(self._aux_vals),
+            tuple(inputs), label_j, key)
+        return float(loss_val), [NDArray(o) for o in outs]
+
+    def _host_lr(self):
+        o = self._optimizer
+        if o.lr_scheduler is not None:
+            return float(o.lr_scheduler(self._num_update))
+        return float(o.lr)
+
+    @property
+    def learning_rate(self):
+        return self._host_lr()
+
+    def set_learning_rate(self, lr):
+        self._optimizer.lr = lr
+
+    def sync_params(self):
+        """Copy mesh-sharded values back into the block's Parameters so
+        save_params / export / eager inference see the trained weights
+        (the kv.pull-at-checkpoint equivalent)."""
+        if not self._placed:
+            return
+        for v, i in zip(self._param_vals, self._train_idx):
+            self._params[i].set_data(NDArray(jax.device_get(v)))
+        for v, i in zip(self._aux_vals, self._aux_idx):
+            self._params[i].set_data(NDArray(jax.device_get(v)))
