@@ -286,7 +286,8 @@ class HybridBlock(Block):
         if not pending:
             return
         flat, _, _ = _flatten_nds(args)
-        data_syms = [_sym.var("__data%d" % i) for i in range(len(flat))]
+        data_syms = [_sym.var("__data%d" % i, dtype=a.dtype)
+                     for i, a in enumerate(flat)]
         sym_args = _rebuild_like(args, iter(data_syms))
         with _ag.pause():
             out = self._symbolic_forward(*sym_args)

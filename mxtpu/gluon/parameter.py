@@ -223,6 +223,7 @@ class Parameter:
 
     def cast(self, dtype):
         self.dtype = canonical_dtype(dtype)
+        self._var = None  # cached symbol carries the old dtype
         if self._data is not None:
             self._data._data = self._data._data.astype(self.dtype)
             if self._grad is not None:

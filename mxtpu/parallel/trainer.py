@@ -204,6 +204,11 @@ class ShardedTrainer:
         self._placed = False
         self._key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
         self._num_update = 0
+        # on-device step state, materialized at first step_async
+        self._key_dev = None
+        self._t_dev = None
+        self._lr_dev = None
+        self._lr_host = None
         # filled at first placement
         self._params = None
         self._train_idx = None
@@ -296,9 +301,14 @@ class ShardedTrainer:
 
         def train_step(train_vals, states, aux_vals, inputs, label, key,
                        t, lr):
+            # rng, step count and lr live on device and are carried through
+            # donated buffers: a steady-state step makes ZERO host->device
+            # transfers (critical when the host link is thin).
+            key, sub = jax.random.split(key)
+            t = t + 1
             (loss_val, (aux_new, outs)), grads = jax.value_and_grad(
                 forward_loss, has_aux=True)(
-                    train_vals, aux_vals, inputs, label, key, True)
+                    train_vals, aux_vals, inputs, label, sub, True)
             new_vals, new_states = [], []
             for j, (w, g, st) in enumerate(zip(train_vals, grads, states)):
                 w2, st2 = functional_optimizer_step(
@@ -311,7 +321,7 @@ class ShardedTrainer:
                 for v, s in zip(new_vals,
                                 [self._shardings[i] for i in train_idx])]
             return tuple(new_vals), tuple(new_states), tuple(aux_new), \
-                loss_val, outs
+                loss_val, outs, key, t
 
         def eval_step(train_vals, aux_vals, inputs, label, key):
             loss_val, (aux_new, outs) = forward_loss(
@@ -321,7 +331,7 @@ class ShardedTrainer:
         with mesh.mesh:
             if with_update:
                 return jax.jit(train_step,
-                               donate_argnums=(0, 1, 2)
+                               donate_argnums=(0, 1, 2, 5, 6)
                                if self._donate else ())
             return jax.jit(eval_step)
 
@@ -331,33 +341,66 @@ class ShardedTrainer:
         for a in arrs:
             v = _as_jax(a)
             sh = self._mesh.batch_sharding(v.ndim)
-            out.append(jax.device_put(v, sh))
+            if isinstance(v, jax.Array) and v.sharding == sh:
+                out.append(v)  # already staged (prefetching loader path)
+            else:
+                out.append(jax.device_put(v, sh))
         return out
 
-    def step(self, data, label):
-        """One fused forward/backward/update step. Returns the scalar loss
-        (host float) — the Module.forward_backward+update equivalent."""
+    def _device_step_state(self):
+        """Lazily created on-device (key, t, lr) carried across steps."""
+        if self._key_dev is None:
+            rep = self._mesh.replicated()
+            # branch the host chain: the device chain carries one fork (and
+            # is donated every step), the host keeps advancing the other
+            # for eval-time draws. np copy so donation can't delete the
+            # host key's buffer (device_put may alias when shardings match).
+            self._key, dev_key = jax.random.split(self._key)
+            self._key_dev = jax.device_put(_np.asarray(dev_key), rep)
+            self._t_dev = jax.device_put(
+                _np.asarray(self._num_update, _np.int32), rep)
+            self._lr_host = self._host_lr()
+            self._lr_dev = jax.device_put(
+                _np.asarray(self._lr_host, _np.float32), rep)
+        return self._key_dev, self._t_dev, self._lr_dev
+
+    def step_async(self, data, label):
+        """One fused forward/backward/update step. Returns the loss as a
+        lazy NDArray (no host sync): dispatches pipeline back-to-back, so
+        steady-state throughput is bounded by device compute, not host
+        round-trips — the engine-async property of the reference
+        (ThreadedEngine returns immediately; sync happens at WaitForVar)."""
         data_list = data if isinstance(data, (list, tuple)) else [data]
         if not self._placed:
             self._place([NDArray(_as_jax(d)) for d in data_list])
         inputs = self._shard_batch(data_list)
         label_j = self._shard_batch([label])[0]
-        key, self._key = jax.random.split(self._key)
         skey = ("train", tuple(tuple(i.shape) for i in inputs),
                 tuple(label_j.shape))
         if skey not in self._step_fns:
             self._step_fns[skey] = self._build_step(skey, len(inputs), True)
+        key, t, lr = self._device_step_state()
         self._num_update += 1
-        t = jnp.asarray(self._num_update, jnp.int32)
-        lr = jnp.asarray(self._host_lr(), jnp.float32)
-        new_vals, new_states, aux_new, loss_val, outs = self._step_fns[skey](
+        new_lr = self._host_lr()
+        if new_lr != self._lr_host:  # scheduler moved: push the new value
+            self._lr_host = new_lr
+            lr = jax.device_put(_np.asarray(new_lr, _np.float32),
+                                self._mesh.replicated())
+        (new_vals, new_states, aux_new, loss_val, outs, new_key,
+         new_t) = self._step_fns[skey](
             tuple(self._param_vals), tuple(self._opt_states),
             tuple(self._aux_vals), tuple(inputs), label_j, key, t, lr)
         self._param_vals = list(new_vals)
         self._opt_states = list(new_states)
         self._aux_vals = list(aux_new)
         self._last_outputs = outs
-        return float(loss_val)
+        self._key_dev, self._t_dev, self._lr_dev = new_key, new_t, lr
+        return NDArray(loss_val)
+
+    def step(self, data, label):
+        """Synchronous step: returns the scalar loss as a host float —
+        the Module.forward_backward+update equivalent."""
+        return float(self.step_async(data, label).asnumpy())
 
     def forward(self, data, label):
         """Evaluation forward: returns (loss, outputs) without updating."""
