@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import pickle
 
+import numpy as _np_mod
+
 from . import ndarray as nd
 from .ndarray import NDArray
 from .base import string_types
@@ -73,12 +75,27 @@ class KVStore:
         """Push value(s); lists of arrays per key are reduced (summed) —
         the CommDevice/NCCL reduce path of the reference, rendered as one
         fused XLA add chain."""
+        from .ndarray.sparse import RowSparseNDArray, row_sparse_array
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
-                merged = v[0].copy()
-                for arr in v[1:]:
-                    merged._data = merged._data + arr._data
+                if all(isinstance(a, RowSparseNDArray) for a in v):
+                    # union of stored rows, summed values (reference
+                    # ElementwiseSum rsp path, src/ndarray/ndarray.cc:1225)
+                    import numpy as np
+                    dense = v[0]._data
+                    for arr in v[1:]:
+                        dense = dense + arr._data
+                    rows = np.unique(np.concatenate(
+                        [a.indices.asnumpy() for a in v]).astype(np.int64))
+                    merged = row_sparse_array(
+                        (nd.NDArray(dense[rows.astype("int32")]), rows),
+                        shape=v[0].shape)
+                    merged._data = dense
+                else:
+                    merged = v[0].copy()
+                    for arr in v[1:]:
+                        merged._data = merged._data + arr._data
             else:
                 merged = v.copy()
             if self._updater is not None:
@@ -99,23 +116,35 @@ class KVStore:
                 o._data = src._data
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the given rows (reference KVStore::PullRowSparse).
-
-        Dense-backed: gathers the requested rows on device; a row_sparse
-        NDArray result arrives with the sparse subsystem.
-        """
+        """Pull only the given rows (reference KVStore::PullRowSparse,
+        src/kvstore/kvstore_local.h PullRowSparseImpl): each out array
+        receives a row_sparse view holding exactly the requested rows —
+        only nnz rows move, which is the point of the API (embedding-table
+        pulls touch a sliver of a huge weight)."""
+        from .ndarray.sparse import RowSparseNDArray, row_sparse_array
         assert out is not None and row_ids is not None
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
         for k, o, rid in zip(keys, outs, row_ids):
             src = self._store[k]
-            gathered = nd.take(src, rid.astype("int32"), axis=0)
+            rid_np = rid.asnumpy().astype("int64") if isinstance(rid, NDArray) \
+                else _np_mod.asarray(rid, dtype="int64")
+            rid_np = _np_mod.unique(rid_np)
+            gathered = nd.take(src, nd.array(rid_np).astype("int32"), axis=0)
+            rsp = row_sparse_array((gathered, rid_np), shape=src.shape)
             targets = o if isinstance(o, (list, tuple)) else [o]
             for arr in targets:
-                if arr.shape == gathered.shape:
+                if isinstance(arr, RowSparseNDArray):
+                    arr._data = rsp._data
+                    arr._aux = {kk: vv.copy()
+                                for kk, vv in rsp._aux.items()}
+                elif arr.shape == gathered.shape:
                     arr._data = gathered._data
                 else:
+                    # dense full-shape target: a dense pull (rows outside
+                    # row_ids must NOT be zeroed — Module.prepare pulls
+                    # into full executor buffers)
                     arr._data = src._data
 
     # -- updater / optimizer ----------------------------------------------

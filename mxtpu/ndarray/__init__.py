@@ -427,17 +427,53 @@ def waitall():
         jax.block_until_ready(d)
 
 
-def save(fname, data):
-    """Save NDArrays (reference format: src/ndarray/ndarray.cc:1515 +
-    MXNDArraySave). Container: numpy .npz under the hood."""
-    if isinstance(data, NDArray):
-        payload = {"__arr_0": data.asnumpy()}
-    elif isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
-    elif isinstance(data, (list, tuple)):
-        payload = {"__arr_%d" % i: v.asnumpy() for i, v in enumerate(data)}
+def _save_entry(payload, k, v):
+    stype = v.stype
+    if stype == "default":
+        payload[k] = v.asnumpy()
     else:
+        # sparse entries keep their compressed aux arrays, mirroring the
+        # reference's stype-tagged chunks (src/ndarray/ndarray.cc:1515)
+        payload[k + "::stype"] = _np.asarray(stype)
+        for aux_name, aux in v._aux.items():
+            payload[k + "::" + aux_name] = aux.asnumpy()
+        payload[k + "::shape"] = _np.asarray(v.shape, _np.int64)
+
+
+def _load_entries(z):
+    from . import sparse as _sp
+    keys = [k for k in z.files if "::" not in k]
+    stypes = {k[: -len("::stype")]: str(z[k][()])
+              for k in z.files if k.endswith("::stype")}
+    out = {k: array(z[k]) for k in keys}
+    for k, stype in stypes.items():
+        shape = tuple(z[k + "::shape"].tolist())
+        if stype == "csr":
+            out[k] = _sp.csr_matrix(
+                (z[k + "::data"], z[k + "::indices"],
+                 z[k + "::indptr"]), shape=shape)
+        else:
+            out[k] = _sp.row_sparse_array(
+                (z[k + "::data"], z[k + "::indices"]), shape=shape)
+    return out
+
+
+def save(fname, data):
+    """Save NDArrays, dense or sparse (reference format:
+    src/ndarray/ndarray.cc:1515 + MXNDArraySave). Container: numpy .npz."""
+    if isinstance(data, NDArray):
+        data = {"__arr_0": data}
+    elif isinstance(data, (list, tuple)):
+        data = {"__arr_%d" % i: v for i, v in enumerate(data)}
+    if not isinstance(data, dict):
         raise TypeError("save expects NDArray, dict, or list")
+    payload = {}
+    for k, v in data.items():
+        if "::" in k:
+            raise ValueError(
+                "'::' is reserved in save keys (sparse metadata tags): %r"
+                % (k,))
+        _save_entry(payload, k, v)
     # write to the exact filename (np.savez(str) would append ".npz",
     # breaking the reference's `prefix-%04d.params` naming)
     with open(fname, "wb") as f:
@@ -449,12 +485,21 @@ def load(fname):
     import os
     path = fname if os.path.exists(fname) else fname + ".npz"
     with _np.load(path, allow_pickle=False) as z:
-        keys = list(z.files)
-        if keys and all(k.startswith("__arr_") for k in keys):
-            ordered = sorted(keys, key=lambda k: int(k.split("_")[-1]))
-            return [array(z[k]) for k in ordered]
-        return {k: array(z[k]) for k in keys}
+        out = _load_entries(z)
+        if out and all(k.startswith("__arr_") for k in out):
+            return [out[k] for k in
+                    sorted(out, key=lambda k: int(k.split("_")[-1]))]
+        return out
 
 
 def imports(*a, **k):
     raise NotImplementedError
+
+
+# sparse storage lives in a sibling module (imported last: it subclasses
+# NDArray). Reference layout: python/mxnet/ndarray/sparse.py.
+from . import sparse  # noqa: E402
+from .sparse import (CSRNDArray, RowSparseNDArray,  # noqa: E402,F401
+                     csr_matrix, row_sparse_array)
+__all__ += ["sparse", "CSRNDArray", "RowSparseNDArray", "csr_matrix",
+            "row_sparse_array"]

@@ -1,0 +1,387 @@
+"""Sparse NDArrays: CSR and row-sparse storage.
+
+Capability parity with ``python/mxnet/ndarray/sparse.py`` (1,282 LoC) and
+the C++ storage machinery (``include/mxnet/ndarray.h:61-66`` kCSRStorage /
+kRowSparseStorage, cast_storage / sparse_retain / sparse dot in
+``src/operator/tensor/``), re-designed for TPU:
+
+XLA has no native sparse representation and thrives on static shapes, so
+mxtpu sparse arrays are **dense-backed with authoritative compressed
+metadata**: the logical value lives in one device buffer (`_data`, like
+any NDArray), while `data`/`indices`/`indptr` hold the compressed view
+that defines which rows/elements are *stored*. Consequences, all
+deliberate:
+
+* every dense op works on a sparse array unchanged — this IS the
+  reference's storage-fallback machinery (``src/common/utils.h``
+  FComputeFallback) with zero marshalling cost;
+* sparse-AWARE paths (lazy optimizer updates on stored rows only,
+  ``KVStore.row_sparse_pull``, retain, sparse dot) use the index metadata
+  to touch only nnz work — the part that actually mattered on the
+  reference too;
+* explicit zeros are honoured: metadata given at construction is kept
+  verbatim, exactly like MXNet's "stored row may be zero" semantics.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import canonical_dtype
+from ..context import current_context
+from . import NDArray, _wrap, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "cast_storage", "retain",
+           "zeros", "empty", "array", "add", "subtract", "multiply",
+           "divide", "dot"]
+
+
+def _idx_dtype(d=None):
+    return canonical_dtype(d) if d is not None else _np.int64
+
+
+class BaseSparseNDArray(NDArray):
+    """Common behaviour for CSR / row_sparse arrays."""
+
+    __slots__ = ("_aux",)
+
+    # subclasses set _stype
+    _stype = None
+
+    def __init__(self, dense, aux, ctx=None):
+        super().__init__(dense, ctx)
+        self._aux = aux  # dict name -> NDArray
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def _aux_data(self, i):
+        order = self._aux_names
+        return self._aux[order[i]]
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(map(str, self.shape)), self._ctx)
+
+    # dense ops produced from this array lose the sparse metadata — they
+    # return plain NDArrays (MXNet: output stype inferred per op; fallback
+    # outputs are dense).
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def todense(self):
+        return _wrap(self._data, self._ctx)
+
+    def asscipy(self):
+        raise NotImplementedError("scipy export not supported")
+
+    def copy(self):
+        aux = {k: _wrap(v._data, self._ctx) for k, v in self._aux.items()}
+        return type(self)(self._data, aux, self._ctx)
+
+    def astype(self, dtype, copy=True):
+        """Cast values, preserving storage type and index metadata."""
+        d = canonical_dtype(dtype)
+        aux = {}
+        for k, v in self._aux.items():
+            # index-typed aux arrays keep their integer dtype
+            aux[k] = _wrap(v._data if k in ("indices", "indptr")
+                           else v._data.astype(d), self._ctx)
+        return type(self)(self._data.astype(d), aux, self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = self._data
+            if isinstance(other, BaseSparseNDArray):
+                if type(other) is not type(self):
+                    raise TypeError(
+                        "copyto between different sparse stypes")
+                other._aux = {k: v.copy() for k, v in self._aux.items()}
+            return other
+        return self.as_in_context(other)
+
+    @property
+    def nnz(self):
+        return int(self._aux["data"].shape[0])
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference CSRNDArray,
+    python/mxnet/ndarray/sparse.py; kCSRStorage ndarray.h:64)."""
+
+    _stype = "csr"
+    _aux_names = ("indices", "indptr", "data")
+
+    @property
+    def data(self):
+        """Stored values, shape (nnz,)."""
+        return self._aux["data"]
+
+    @property
+    def indices(self):
+        """Column index per stored value, shape (nnz,)."""
+        return self._aux["indices"]
+
+    @property
+    def indptr(self):
+        """Row pointer array, shape (rows+1,)."""
+        return self._aux["indptr"]
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            if key.step is not None and key.step != 1:
+                raise ValueError("CSR slicing supports step=1 only")
+            start, stop, _ = key.indices(self.shape[0])
+            dense = self._data[start:stop]
+            return csr_matrix(_wrap(dense, self._ctx))
+        return super().__getitem__(key)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: a subset of rows stored (reference
+    RowSparseNDArray; kRowSparseStorage ndarray.h:65). The canonical
+    storage for sparse gradients/weights of embedding-style tables."""
+
+    _stype = "row_sparse"
+    _aux_names = ("indices", "data")
+
+    @property
+    def data(self):
+        """Stored rows, shape (num_stored, *shape[1:])."""
+        return self._aux["data"]
+
+    @property
+    def indices(self):
+        """Stored row ids, ascending, shape (num_stored,)."""
+        return self._aux["indices"]
+
+    @property
+    def nnz(self):
+        return int(self._aux["indices"].shape[0])
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+def _as_nd(x, dtype=None):
+    if isinstance(x, NDArray):
+        return x.astype(dtype) if dtype is not None else x
+    return _dense_array(_np.asarray(x), dtype=dtype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray.
+
+    Accepts ``(data, indices, indptr)`` + shape (the MXNet calling
+    convention), a dense NDArray/numpy array, or another CSRNDArray."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, CSRNDArray):
+        return arg1.astype(dtype) if dtype else arg1.copy()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _as_nd(data, dtype)
+        indices = _as_nd(indices, _idx_dtype())
+        indptr = _as_nd(indptr, _idx_dtype())
+        if shape is None:
+            cols = int(indices.asnumpy().max()) + 1 if indices.size else 0
+            shape = (int(indptr.size) - 1, cols)
+        dense = _np.zeros(shape, dtype=data.asnumpy().dtype)
+        ind_np = indices.asnumpy().astype(_np.int64)
+        ptr_np = indptr.asnumpy().astype(_np.int64)
+        dat_np = data.asnumpy()
+        row_ids = _np.repeat(_np.arange(shape[0]), _np.diff(ptr_np))
+        dense[row_ids, ind_np] = dat_np
+        aux = {"data": data, "indices": indices, "indptr": indptr}
+        return CSRNDArray(jnp.asarray(dense), aux, ctx)
+    # dense input -> compress
+    nd_in = _as_nd(arg1, dtype)
+    dense_np = nd_in.asnumpy()
+    if dense_np.ndim != 2:
+        raise ValueError("csr_matrix requires 2-D input")
+    if shape is not None and tuple(shape) != dense_np.shape:
+        raise ValueError("shape mismatch")
+    rows, cols = _np.nonzero(dense_np)
+    counts = _np.bincount(rows, minlength=dense_np.shape[0])
+    ptr = _np.concatenate([[0], _np.cumsum(counts)])
+    aux = {"data": _dense_array(dense_np[rows, cols]),
+           "indices": _dense_array(cols.astype(_np.int64)),
+           "indptr": _dense_array(ptr.astype(_np.int64))}
+    return CSRNDArray(nd_in._data, aux, ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from ``(data, indices)``, a dense array,
+    or another RowSparseNDArray."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1.astype(dtype) if dtype else arg1.copy()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _as_nd(data, dtype)
+        indices = _as_nd(indices, _idx_dtype())
+        if shape is None:
+            rows = int(indices.asnumpy().max()) + 1 if indices.size else 0
+            shape = (rows,) + data.shape[1:]
+        dense = jnp.zeros(shape, data._data.dtype)
+        if indices.size:
+            dense = dense.at[indices._data.astype(jnp.int32)].set(data._data)
+        aux = {"data": data, "indices": indices}
+        return RowSparseNDArray(dense, aux, ctx)
+    nd_in = _as_nd(arg1, dtype)
+    dense_np = nd_in.asnumpy()
+    if shape is not None and tuple(shape) != dense_np.shape:
+        raise ValueError("shape mismatch")
+    nz_rows = _np.nonzero(dense_np.reshape(dense_np.shape[0], -1).any(axis=1))[0]
+    aux = {"data": _dense_array(dense_np[nz_rows]),
+           "indices": _dense_array(nz_rows.astype(_np.int64))}
+    return RowSparseNDArray(nd_in._data, aux, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    """Sparse-typed zeros (reference mx.nd.sparse.zeros)."""
+    ctx = ctx or current_context()
+    dtype = canonical_dtype(dtype) if dtype is not None else _np.float32
+    if stype == "default":
+        from . import zeros as dzeros
+        return dzeros(shape, ctx=ctx, dtype=dtype)
+    dense = jnp.zeros(shape, dtype)
+    if stype == "csr":
+        aux = {"data": _dense_array(_np.zeros((0,), dtype)),
+               "indices": _dense_array(_np.zeros((0,), _np.int64)),
+               "indptr": _dense_array(_np.zeros((shape[0] + 1,), _np.int64))}
+        return CSRNDArray(dense, aux, ctx)
+    if stype == "row_sparse":
+        aux = {"data": _dense_array(_np.zeros((0,) + tuple(shape[1:]), dtype)),
+               "indices": _dense_array(_np.zeros((0,), _np.int64))}
+        return RowSparseNDArray(dense, aux, ctx)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Build a sparse array preserving the source's stype."""
+    if isinstance(source_array, CSRNDArray):
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    if isinstance(source_array, RowSparseNDArray):
+        return row_sparse_array(source_array, ctx=ctx, dtype=dtype)
+    try:  # scipy sparse duck-typing
+        import scipy.sparse as sps
+        if sps.issparse(source_array):
+            return csr_matrix(source_array.toarray(), ctx=ctx, dtype=dtype)
+    except ImportError:
+        pass
+    return _dense_array(source_array, ctx=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# storage conversion ops (reference src/operator/tensor/cast_storage-inl.h)
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr, stype):
+    """Convert between 'default' / 'csr' / 'row_sparse' storage."""
+    if stype == arr.stype:
+        return arr.copy() if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "default":
+        return _wrap(arr._data, arr._ctx)
+    if stype == "csr":
+        return csr_matrix(_wrap(arr._data, arr._ctx))
+    if stype == "row_sparse":
+        return row_sparse_array(_wrap(arr._data, arr._ctx))
+    raise ValueError("unknown stype %r" % stype)
+
+
+def retain(arr, indices):
+    """Keep only the given rows of a row_sparse array
+    (reference sparse_retain, src/operator/tensor/sparse_retain-inl.h)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    if isinstance(indices, NDArray):
+        idx = indices.asnumpy().astype(_np.int64)
+    else:
+        idx = _np.asarray(indices, _np.int64)
+    idx = _np.sort(idx)
+    stored = arr.indices.asnumpy().astype(_np.int64)
+    keep_mask = _np.isin(idx, stored)
+    kept = idx[keep_mask]
+    rows = arr._data[jnp.asarray(kept, jnp.int32)] if kept.size else \
+        jnp.zeros((0,) + arr.shape[1:], arr._data.dtype)
+    dense = jnp.zeros(arr.shape, arr._data.dtype)
+    if kept.size:
+        dense = dense.at[jnp.asarray(kept, jnp.int32)].set(rows)
+    aux = {"data": _wrap(rows, arr._ctx),
+           "indices": _dense_array(kept)}
+    return RowSparseNDArray(dense, aux, arr._ctx)
+
+
+# ---------------------------------------------------------------------------
+# arithmetic — stype-aware wrappers (reference elemwise FComputeEx paths)
+# ---------------------------------------------------------------------------
+
+def _binary(a, b, fn):
+    from . import NDArray as ND
+    av = a._data if isinstance(a, ND) else a
+    bv = b._data if isinstance(b, ND) else b
+    out = fn(jnp.asarray(av), jnp.asarray(bv))
+    # rsp op rsp stays rsp (union of stored rows); anything else densifies
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray) \
+            and a.shape == b.shape:
+        rows = _np.union1d(a.indices.asnumpy(), b.indices.asnumpy())
+        rows = rows.astype(_np.int64)
+        data = out[jnp.asarray(rows, jnp.int32)] if rows.size else \
+            jnp.zeros((0,) + tuple(out.shape[1:]), out.dtype)
+        aux = {"data": _wrap(data, a._ctx), "indices": _dense_array(rows)}
+        return RowSparseNDArray(out, aux, a._ctx)
+    return _wrap(out)
+
+
+def add(a, b):
+    return _binary(a, b, jnp.add)
+
+
+def subtract(a, b):
+    return _binary(a, b, jnp.subtract)
+
+
+def multiply(a, b):
+    return _binary(a, b, jnp.multiply)
+
+
+def divide(a, b):
+    return _binary(a, b, jnp.divide)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference src/operator/tensor/dot-inl.h:
+    csr·dense, csrT·dense -> dense/rsp; dense·csr variants).
+
+    The compute runs as ONE dense XLA matmul on the MXU (the dense-backed
+    representation makes csr·dense literally a gemm — on TPU this beats
+    any gather-based sparse kernel for the density ranges MXNet targets);
+    the sparse *semantics* (output stype of csrT·dense = row_sparse) are
+    preserved via metadata."""
+    lv = lhs._data
+    rv = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+    if transpose_a:
+        lv = lv.T
+    if transpose_b:
+        rv = rv.T
+    out = jnp.matmul(lv, rv)
+    if isinstance(lhs, CSRNDArray) and transpose_a:
+        # stored output rows = columns referenced by stored csr entries
+        cols = _np.unique(lhs.indices.asnumpy().astype(_np.int64))
+        data = out[jnp.asarray(cols, jnp.int32)] if cols.size else \
+            jnp.zeros((0,) + tuple(out.shape[1:]), out.dtype)
+        aux = {"data": _wrap(data, lhs._ctx), "indices": _dense_array(cols)}
+        return RowSparseNDArray(out, aux, lhs._ctx)
+    return _wrap(out)

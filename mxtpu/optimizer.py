@@ -190,6 +190,50 @@ def _clip(g, bound):
     return g
 
 
+def _is_rsp(x):
+    from .ndarray.sparse import RowSparseNDArray
+    return isinstance(x, RowSparseNDArray)
+
+
+def _lazy_rsp_update(opt, index, weight, grad, state):
+    """Row-sparse lazy update: apply the optimizer's dense math to the
+    STORED rows only (reference 'lazy'/sparse update ops:
+    src/operator/optimizer_op.cc sgd_update FComputeEx on rsp grads —
+    untouched rows keep stale state, by design).
+
+    Gathers the active rows, re-enters ``opt.update`` with dense row
+    views (grad is dense there, so no recursion), scatters results back.
+    """
+    rows = grad.indices._data.astype(jnp.int32)
+    if rows.shape[0] == 0:
+        opt._update_count(index)
+        return
+    g_rows = NDArray(grad.data._data)
+    w_rows = NDArray(weight._data[rows])
+
+    def take(s):
+        if s is None:
+            return None
+        if isinstance(s, (tuple, list)):
+            return tuple(take(x) for x in s)
+        return NDArray(s._data[rows])
+
+    s_rows = take(state)
+    opt.update(index, w_rows, g_rows, s_rows)
+    weight._data = weight._data.at[rows].set(w_rows._data)
+
+    def put(s, sr):
+        if s is None:
+            return
+        if isinstance(s, (tuple, list)):
+            for a, b in zip(s, sr):
+                put(a, b)
+            return
+        s._data = s._data.at[rows].set(sr._data)
+
+    put(state, s_rows)
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum and optional fp32 master weights
@@ -206,6 +250,8 @@ class SGD(Optimizer):
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        if _is_rsp(grad) and self.lazy_update:
+            return _lazy_rsp_update(self, index, weight, grad, state)
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
@@ -476,6 +522,8 @@ class Adam(Optimizer):
                 nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
+        if _is_rsp(grad) and self.lazy_update:
+            return _lazy_rsp_update(self, index, weight, grad, state)
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
@@ -507,6 +555,8 @@ class AdaGrad(Optimizer):
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        if _is_rsp(grad):
+            return _lazy_rsp_update(self, index, weight, grad, state)
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
@@ -610,6 +660,8 @@ class Ftrl(Optimizer):
                          dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
+        if _is_rsp(grad):
+            return _lazy_rsp_update(self, index, weight, grad, state)
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)

@@ -1,0 +1,231 @@
+"""Sparse NDArray tests, modeled on the reference suites
+tests/python/unittest/test_sparse_ndarray.py and test_sparse_operator.py:
+construction, cast_storage round trips, retain, sparse dot, stype-aware
+arithmetic, lazy optimizer updates, kvstore row_sparse_pull, serialization.
+"""
+import os
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def _rand_rsp(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(*shape).astype(np.float32)
+    mask = rng.rand(shape[0]) < density
+    dense[~mask] = 0
+    return nd.sparse.row_sparse_array(nd.array(dense)), dense
+
+
+def _rand_csr(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(*shape).astype(np.float32)
+    dense[rng.rand(*shape) >= density] = 0
+    return nd.sparse.csr_matrix(nd.array(dense)), dense
+
+
+def test_csr_construction():
+    data = np.array([1., 2., 3., 4.])
+    indices = np.array([0, 2, 1, 3])
+    indptr = np.array([0, 2, 3, 4])
+    a = nd.sparse.csr_matrix((data, indices, indptr), shape=(3, 4))
+    expect = np.array([[1, 0, 2, 0], [0, 3, 0, 0], [0, 0, 0, 4]], np.float32)
+    np.testing.assert_array_equal(a.asnumpy(), expect)
+    assert a.stype == "csr"
+    assert a.nnz == 4
+    np.testing.assert_array_equal(a.indices.asnumpy(), indices)
+    np.testing.assert_array_equal(a.indptr.asnumpy(), indptr)
+    np.testing.assert_array_equal(a.data.asnumpy(), data)
+
+
+def test_rsp_construction_and_explicit_zero_rows():
+    data = np.zeros((2, 3), np.float32)
+    data[0] = 1.0
+    idx = np.array([1, 4])
+    r = nd.sparse.row_sparse_array((data, idx), shape=(6, 3))
+    assert r.stype == "row_sparse"
+    # explicit zero row stays stored
+    np.testing.assert_array_equal(r.indices.asnumpy(), idx)
+    assert r.nnz == 2
+    dense = r.asnumpy()
+    np.testing.assert_array_equal(dense[1], np.ones(3))
+    np.testing.assert_array_equal(dense[4], np.zeros(3))
+
+
+def test_cast_storage_round_trip():
+    a = nd.array(np.array([[0, 1.5], [0, 0], [2.5, 0]], np.float32))
+    for stype in ("csr", "row_sparse"):
+        s = a.tostype(stype)
+        assert s.stype == stype
+        np.testing.assert_array_equal(s.asnumpy(), a.asnumpy())
+        back = s.tostype("default")
+        assert back.stype == "default"
+        np.testing.assert_array_equal(back.asnumpy(), a.asnumpy())
+
+
+def test_retain():
+    r, dense = _rand_rsp((8, 4), density=0.9, seed=1)
+    kept = nd.sparse.retain(r, nd.array(np.array([0, 3, 7])))
+    expect = np.zeros_like(dense)
+    for i in (0, 3, 7):
+        expect[i] = dense[i]
+    np.testing.assert_allclose(kept.asnumpy(), expect, rtol=1e-6)
+    assert set(kept.indices.asnumpy().tolist()) <= {0, 3, 7}
+
+
+def test_sparse_dot():
+    a, da = _rand_csr((5, 7), seed=2)
+    b = np.random.RandomState(3).randn(7, 4).astype(np.float32)
+    out = nd.sparse.dot(a, nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), da @ b, rtol=1e-5, atol=1e-5)
+    # csr^T . dense -> row_sparse
+    c = np.random.RandomState(4).randn(5, 4).astype(np.float32)
+    out_t = nd.sparse.dot(a, nd.array(c), transpose_a=True)
+    assert out_t.stype == "row_sparse"
+    np.testing.assert_allclose(out_t.asnumpy(), da.T @ c, rtol=1e-5, atol=1e-5)
+
+
+def test_rsp_arithmetic_keeps_stype():
+    a, da = _rand_rsp((6, 3), seed=5)
+    b, db = _rand_rsp((6, 3), seed=6)
+    out = nd.sparse.add(a, b)
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), da + db, rtol=1e-6)
+    out2 = nd.sparse.multiply(a, b)
+    np.testing.assert_allclose(out2.asnumpy(), da * db, rtol=1e-6)
+
+
+def test_dense_op_fallback():
+    """Any dense op accepts a sparse array (the storage-fallback path)."""
+    a, da = _rand_csr((4, 4), seed=7)
+    out = nd.relu(a)
+    np.testing.assert_allclose(out.asnumpy(), np.maximum(da, 0), rtol=1e-6)
+
+
+@pytest.mark.parametrize("opt_name,opt_kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.1}),
+    ("adagrad", {"learning_rate": 0.1}),
+    ("ftrl", {"learning_rate": 0.1}),
+])
+def test_lazy_optimizer_update(opt_name, opt_kwargs):
+    """Lazy update touches only stored rows; untouched rows keep both
+    weight and state unchanged (reference sgd_update FComputeEx on rsp)."""
+    from mxtpu import optimizer as opt
+    shape = (10, 4)
+    rng = np.random.RandomState(8)
+    w0 = rng.randn(*shape).astype(np.float32)
+
+    o = opt.create(opt_name, **opt_kwargs)
+    w = nd.array(w0.copy())
+    state = o.create_state(0, w)
+
+    g_rows = rng.randn(3, 4).astype(np.float32)
+    grad = nd.sparse.row_sparse_array((g_rows, np.array([1, 5, 6])),
+                                      shape=shape)
+    o.update(0, w, grad, state)
+    new_w = w.asnumpy()
+    touched = [1, 5, 6]
+    untouched = [i for i in range(10) if i not in touched]
+    np.testing.assert_array_equal(new_w[untouched], w0[untouched])
+    assert not np.allclose(new_w[touched], w0[touched])
+
+    # dense reference: same math on a dense grad restricted to those rows
+    o2 = opt.create(opt_name, **opt_kwargs)
+    w2 = nd.array(w0.copy())
+    state2 = o2.create_state(0, w2)
+    dense_grad = np.zeros(shape, np.float32)
+    dense_grad[touched] = g_rows
+    o2.update(0, w2, nd.array(dense_grad), state2)
+    np.testing.assert_allclose(new_w[touched], w2.asnumpy()[touched],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    shape = (8, 3)
+    val = np.random.RandomState(9).randn(*shape).astype(np.float32)
+    kv.init("w", nd.array(val))
+    out = nd.sparse.zeros("row_sparse", shape)
+    kv.row_sparse_pull("w", out=out, row_ids=nd.array(np.array([2, 5])))
+    assert out.stype == "row_sparse"
+    res = out.asnumpy()
+    np.testing.assert_allclose(res[2], val[2], rtol=1e-6)
+    np.testing.assert_allclose(res[5], val[5], rtol=1e-6)
+    np.testing.assert_array_equal(res[0], np.zeros(3))
+
+
+def test_sparse_save_load(tmp_path):
+    a, da = _rand_csr((4, 6), seed=10)
+    r, dr = _rand_rsp((5, 2), seed=11)
+    d = nd.array(np.ones((2, 2), np.float32))
+    fname = str(tmp_path / "arrs.params")
+    nd.save(fname, {"a": a, "r": r, "d": d})
+    back = nd.load(fname)
+    assert back["a"].stype == "csr"
+    assert back["r"].stype == "row_sparse"
+    assert back["d"].stype == "default"
+    np.testing.assert_allclose(back["a"].asnumpy(), da, rtol=1e-6)
+    np.testing.assert_allclose(back["r"].asnumpy(), dr, rtol=1e-6)
+
+
+def test_sparse_zeros():
+    z = nd.sparse.zeros("csr", (3, 4))
+    assert z.stype == "csr" and z.nnz == 0
+    np.testing.assert_array_equal(z.asnumpy(), np.zeros((3, 4)))
+    z2 = nd.sparse.zeros("row_sparse", (3, 4))
+    assert z2.stype == "row_sparse" and z2.nnz == 0
+
+
+def test_dense_pull_not_zeroed_by_row_sparse_pull():
+    """Pulling into a full-shape dense out must keep all rows (regression:
+    Module.prepare pulls into full executor buffers)."""
+    kv = mx.kv.create("local")
+    val = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("w", nd.array(val))
+    dense_out = nd.zeros((4, 3))
+    kv.row_sparse_pull("w", out=dense_out, row_ids=nd.array(np.array([1])))
+    np.testing.assert_array_equal(dense_out.asnumpy(), val)
+
+
+def test_push_rsp_list_unions_rows():
+    """Multi-device rsp gradient push must union stored rows (regression:
+    only device 0's rows were visible to the lazy updater)."""
+    from mxtpu import optimizer as opt
+    kv = mx.kv.create("local")
+    val = np.arange(12, dtype=np.float32).reshape(4, 3)
+    kv.init("w", nd.array(val))
+    g1 = nd.sparse.row_sparse_array((np.ones((1, 3), np.float32),
+                                     np.array([0])), shape=(4, 3))
+    g2 = nd.sparse.row_sparse_array((np.ones((1, 3), np.float32),
+                                     np.array([2])), shape=(4, 3))
+    kv._updater = opt.get_updater(opt.create("sgd", learning_rate=1.0, wd=0.0))
+    kv.push("w", [g1, g2])
+    got = kv._store["w"].asnumpy()
+    assert not np.allclose(got[0], val[0])
+    assert not np.allclose(got[2], val[2])
+    np.testing.assert_array_equal(got[1], val[1])
+    np.testing.assert_array_equal(got[3], val[3])
+
+
+def test_sparse_astype_preserves_stype():
+    c = nd.array(np.eye(3, dtype=np.float32)).tostype("csr").astype("float16")
+    assert c.stype == "csr"
+    np.testing.assert_array_equal(c.indptr.asnumpy(), [0, 1, 2, 3])
+    r = nd.array(np.eye(3, dtype=np.float32)).tostype("row_sparse")
+    assert r.astype("float16").stype == "row_sparse"
+
+
+def test_save_rejects_reserved_keys():
+    with pytest.raises(ValueError):
+        nd.save("/tmp/reserved.params", {"a::b": nd.zeros((1,))})
+
+
+def test_sparse_copyto_syncs_metadata():
+    a = nd.array(np.eye(4, dtype=np.float32)).tostype("row_sparse")
+    b = nd.sparse.zeros("row_sparse", (4, 4))
+    a.copyto(b)
+    np.testing.assert_array_equal(b.asnumpy(), np.eye(4))
+    np.testing.assert_array_equal(b.indices.asnumpy(), [0, 1, 2, 3])
