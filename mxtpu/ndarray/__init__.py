@@ -503,3 +503,23 @@ from .sparse import (CSRNDArray, RowSparseNDArray,  # noqa: E402,F401
                      csr_matrix, row_sparse_array)
 __all__ += ["sparse", "CSRNDArray", "RowSparseNDArray", "csr_matrix",
             "row_sparse_array"]
+
+
+class _ContribNamespace:
+    """``nd.contrib.X`` resolves registry op ``_contrib_X`` (or plain X),
+    mirroring python/mxnet/ndarray/contrib.py's generated namespace."""
+
+    def __init__(self, resolver):
+        self._resolve = resolver
+
+    def __getattr__(self, name):
+        for candidate in ("_contrib_" + name, name):
+            op = get_op(candidate)
+            if op is not None:
+                return self._resolve(op)
+        raise AttributeError("no contrib op %r" % name)
+
+
+contrib = _ContribNamespace(
+    lambda op: (lambda *a, **k: _call_op(op, a, k)))
+__all__ += ["contrib"]

@@ -16,3 +16,5 @@ from . import random_ops     # noqa: F401
 from . import optim_ops      # noqa: F401
 from . import linalg_ops     # noqa: F401
 from . import rnn            # noqa: F401
+from . import vision         # noqa: F401
+from . import contrib_ops    # noqa: F401

@@ -1,0 +1,79 @@
+"""Experimental/contrib operators.
+
+Capability parity with ``src/operator/contrib/``: 8-bit quantization
+(quantize/dequantize, quantization_range_for_multiplication), FFT/IFFT
+(cuFFT there, jnp.fft -> XLA here), count_sketch, and the Khatri-Rao
+product lives in linalg_ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, next_rng_key
+
+
+@register("_contrib_quantize", aliases=("quantize",), num_outputs=3,
+          differentiable=False)
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Linear-quantize float data into int8/uint8 given the calibration
+    range (reference contrib/quantize.cc). Returns (q, min, max)."""
+    if out_type == "uint8":
+        qmin, qmax, dt = 0.0, 255.0, jnp.uint8
+    elif out_type == "int8":
+        qmin, qmax, dt = -127.0, 127.0, jnp.int8
+    else:
+        raise ValueError("out_type must be int8/uint8")
+    lo = jnp.min(min_range)
+    hi = jnp.max(max_range)
+    scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-12)
+    q = jnp.clip(jnp.round((data - lo) * scale + qmin), qmin, qmax)
+    return q.astype(dt), lo.reshape(1), hi.reshape(1)
+
+
+@register("_contrib_dequantize", aliases=("dequantize",),
+          differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """Inverse of quantize (reference contrib/dequantize.cc)."""
+    dt = jnp.dtype(out_type)
+    lo = jnp.min(min_range)
+    hi = jnp.max(max_range)
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = jnp.maximum(hi - lo, 1e-12) / (qmax - qmin)
+    return ((data.astype(jnp.float32) - qmin) * scale + lo).astype(dt)
+
+
+@register("_contrib_fft", aliases=("fft",))
+def fft(data, compute_size=128):
+    """FFT along the last axis; complex output packed as interleaved
+    (real, imag) pairs, matching the reference's layout
+    (contrib/fft-inl.h: output last dim = 2*n)."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    packed = jnp.stack([out.real, out.imag], axis=-1)
+    return packed.reshape(*data.shape[:-1], data.shape[-1] * 2)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def ifft(data, compute_size=128):
+    """Inverse FFT of interleaved (real, imag) input; returns the real
+    part scaled by n (the reference's unnormalized convention)."""
+    n = data.shape[-1] // 2
+    unpacked = data.reshape(*data.shape[:-1], n, 2)
+    cplx = unpacked[..., 0] + 1j * unpacked[..., 1]
+    out = jnp.fft.ifft(cplx, axis=-1) * n
+    return out.real.astype(jnp.float32)
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count sketch projection (reference contrib/count_sketch.cc):
+    out[:, h[j]] += s[j] * data[:, j] with sign hashes s in {-1, +1}."""
+    out_dim = int(out_dim)
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    contrib = data * ss[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, hh].add(contrib)

@@ -1,0 +1,564 @@
+"""Vision / detection operators.
+
+Capability parity with the reference's detection stack:
+``src/operator/roi_pooling.cc``, ``src/operator/contrib/multibox_prior.cc``
+/ ``multibox_target.cc`` / ``multibox_detection.cc`` (SSD),
+``src/operator/contrib/proposal.cc`` (Faster R-CNN RPN),
+``src/operator/contrib/psroi_pooling.cc`` (R-FCN),
+``src/operator/bilinear_sampler.cc``, ``spatial_transformer.cc``,
+``grid_generator.cc``, ``correlation.cc``, and the sequence ops
+(``sequence_last/mask/reverse.cc``).
+
+TPU-first design notes: everything is static-shape jnp — ROI bins are
+masked reductions instead of per-ROI dynamic loops (vmap over the ROI axis,
+XLA fuses the masks), NMS is a fixed-trip-count ``lax.fori_loop`` over a
+topk-truncated candidate set, and bilinear sampling is four static gathers.
+No dynamic shapes ever reach XLA, so all of it jits and shards.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# ROI pooling (reference src/operator/roi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max-pool each ROI into a fixed (PH, PW) grid.
+
+    data: [N, C, H, W]; rois: [R, 5] of (batch_idx, x1, y1, x2, y2) in
+    image coordinates. Bins with no pixels output 0 (reference behaviour).
+    """
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+    n, c, h, w = data.shape
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        img = data[bidx]  # [C, H, W]
+        # mask_h[p, y] = y inside bin p's [start, end) row range
+        p_idx = jnp.arange(ph, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(p_idx * bin_h) + y1, 0, h)
+        hend = jnp.clip(jnp.ceil((p_idx + 1) * bin_h) + y1, 0, h)
+        q_idx = jnp.arange(pw, dtype=jnp.float32)
+        wstart = jnp.clip(jnp.floor(q_idx * bin_w) + x1, 0, w)
+        wend = jnp.clip(jnp.ceil((q_idx + 1) * bin_w) + x1, 0, w)
+        mask_h = (ys[None, :] >= hstart[:, None]) & (ys[None, :] < hend[:, None])
+        mask_w = (xs[None, :] >= wstart[:, None]) & (xs[None, :] < wend[:, None])
+        # [PH, PW, H, W]
+        mask = mask_h[:, None, :, None] & mask_w[None, :, None, :]
+        neg = jnp.finfo(data.dtype).min
+        vals = jnp.where(mask[None], img[:, None, None, :, :], neg)
+        out = vals.max(axis=(-1, -2))
+        empty = ~mask.any(axis=(-1, -2))
+        return jnp.where(empty[None], 0.0, out).astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+@register("_contrib_PSROIPooling", aliases=("psroi_pooling",))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1, pooled_size=7,
+                  group_size=0):
+    """Position-sensitive ROI pooling (R-FCN, reference
+    src/operator/contrib/psroi_pooling.cc): channel k*(i*P+j) average-pools
+    bin (i, j)."""
+    p = int(pooled_size)
+    group = int(group_size) if group_size else p
+    n, c, h, w = data.shape
+    assert c == output_dim * group * group, "channels != output_dim*group^2"
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        roi_h = jnp.maximum(y2 - y1, 0.1)
+        roi_w = jnp.maximum(x2 - x1, 0.1)
+        bin_h = roi_h / p
+        bin_w = roi_w / p
+        img = data[bidx].reshape(output_dim, group, group, h, w)
+        p_idx = jnp.arange(p, dtype=jnp.float32)
+        hstart = jnp.clip(jnp.floor(p_idx * bin_h + y1), 0, h)
+        hend = jnp.clip(jnp.ceil((p_idx + 1) * bin_h + y1), 0, h)
+        wstart = jnp.clip(jnp.floor(p_idx * bin_w + x1), 0, w)
+        wend = jnp.clip(jnp.ceil((p_idx + 1) * bin_w + x1), 0, w)
+        mask_h = (ys[None, :] >= hstart[:, None]) & (ys[None, :] < hend[:, None])
+        mask_w = (xs[None, :] >= wstart[:, None]) & (xs[None, :] < wend[:, None])
+        mask = mask_h[:, None, :, None] & mask_w[None, :, None, :]  # [P,P,H,W]
+        # position-sensitive channel per bin: map bin (i,j) -> group cell
+        gi = jnp.floor(p_idx * group / p).astype(jnp.int32)
+        img_bins = img[:, gi][:, :, gi]  # [D, P, P, H, W]
+        s = jnp.where(mask[None], img_bins, 0.0).sum(axis=(-1, -2))
+        cnt = jnp.maximum(mask.sum(axis=(-1, -2)), 1)
+        return (s / cnt).astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# SSD: MultiBoxPrior / MultiBoxTarget / MultiBoxDetection
+# (reference src/operator/contrib/multibox_*.cc)
+# ---------------------------------------------------------------------------
+
+def _parse_floats(v, default):
+    if v is None:
+        return list(default)
+    if isinstance(v, (int, float)):
+        return [float(v)]
+    return [float(x) for x in v]
+
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",
+                                             "multibox_prior"),
+          differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate SSD anchor boxes for a feature map: per cell,
+    sizes[0]xratios anchors + extra sizes with ratio 1 (reference layout:
+    num_anchors = len(sizes) + len(ratios) - 1)."""
+    sizes = _parse_floats(sizes, (1.0,))
+    ratios = _parse_floats(ratios, (1.0,))
+    h, w = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    # reference enumeration (multibox_prior.cc:50-66): all sizes at
+    # ratios[0], then sizes[0] at ratios[1:]; width carries the in_h/in_w
+    # aspect correction so anchors are square in pixel space
+    aspect = float(h) / float(w)
+    combos = [(s, ratios[0]) for s in sizes] + \
+             [(sizes[0], r) for r in ratios[1:]]
+    ws, hs = [], []
+    for s, r in combos:
+        sr = r ** 0.5
+        ws.append(s * aspect * sr)
+        hs.append(s / sr)
+    ws = jnp.asarray(ws, jnp.float32) / 2
+    hs = jnp.asarray(hs, jnp.float32) / 2
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cxg, cyg], -1).reshape(-1, 1, 2)  # [HW, 1, 2]
+    half = jnp.stack([ws, hs], -1)  # [A, 2]
+    mins = centers - half[None]
+    maxs = centers + half[None]
+    anchors = jnp.concatenate([mins, maxs], -1).reshape(1, -1, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+def _box_iou(a, b):
+    """IoU matrix between corner boxes a [M,4] and b [N,4]."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",
+                                              "multibox_target"),
+          differentiable=False, num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth and emit regression/classification
+    targets (reference multibox_target.cc). Outputs
+    (box_target [B, A*4], box_mask [B, A*4], cls_target [B, A])."""
+    anchors = anchor.reshape(-1, 4)
+    num_anchors = anchors.shape[0]
+    v = jnp.asarray(variances, jnp.float32)
+
+    def one_batch(lab, preds):
+        valid = lab[:, 0] >= 0  # class id -1 => padding
+        gt = lab[:, 1:5]
+        iou = _box_iou(anchors, gt)  # [A, G]
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= overlap_threshold
+        # bipartite: force-match the best anchor of each valid gt; padding
+        # gts scatter out of range and are dropped (mode='drop') so they
+        # can never clobber a real gt's forced match
+        best_anchor = jnp.argmax(iou, axis=0)  # [G]
+        scatter_idx = jnp.where(valid, best_anchor, num_anchors)
+        forced = jnp.zeros(num_anchors, bool).at[scatter_idx].set(
+            True, mode="drop")
+        forced_gt = jnp.zeros(num_anchors, jnp.int32).at[scatter_idx].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
+        m_gt = jnp.where(forced, forced_gt, best_gt)
+        matched = matched | forced
+        # regression targets in center/size space with variances
+        g = gt[m_gt]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        aw = jnp.clip(anchors[:, 2] - anchors[:, 0], 1e-8)
+        ah = jnp.clip(anchors[:, 3] - anchors[:, 1], 1e-8)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.clip(g[:, 2] - g[:, 0], 1e-8)
+        gh = jnp.clip(g[:, 3] - g[:, 1], 1e-8)
+        tx = (gcx - acx) / aw / v[0]
+        ty = (gcy - acy) / ah / v[1]
+        tw = jnp.log(gw / aw) / v[2]
+        th = jnp.log(gh / ah) / v[3]
+        box_t = jnp.stack([tx, ty, tw, th], -1)
+        box_t = jnp.where(matched[:, None], box_t, 0.0).reshape(-1)
+        box_m = jnp.where(matched[:, None],
+                          jnp.ones((num_anchors, 4), jnp.float32),
+                          0.0).reshape(-1)
+        cls_t = jnp.where(matched, lab[m_gt, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining (reference multibox_target.cc): rank
+            # unmatched low-IoU anchors by their most-confident non-
+            # background prediction; keep ratio*num_pos hardest as
+            # negatives, set the rest to ignore_label
+            cand = (~matched) & (best_iou < negative_mining_thresh)
+            neg_score = jnp.max(preds[1:], axis=0)  # [A]
+            order_score = jnp.where(cand, neg_score, -jnp.inf)
+            rank = jnp.argsort(jnp.argsort(-order_score))
+            num_neg = jnp.sum(matched) * negative_mining_ratio
+            keep_neg = cand & (rank < num_neg)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(keep_neg, 0.0, ignore_label))
+        return box_t, box_m, cls_t
+
+    box_target, box_mask, cls_target = jax.vmap(one_batch)(
+        label.astype(jnp.float32), cls_pred.astype(jnp.float32))
+    return box_target, box_mask, cls_target
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",
+                                                 "multibox_detection"),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_topk=-1):
+    """Decode predictions into detections with per-class NMS (reference
+    multibox_detection.cc). Output: [B, A, 6] rows of
+    (class_id, score, x1, y1, x2, y2); suppressed rows have class_id -1."""
+    anchors = anchor.reshape(-1, 4)
+    num_anchors = anchors.shape[0]
+    v = jnp.asarray(variances, jnp.float32)
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+
+    def one_batch(probs, loc):
+        loc = loc.reshape(-1, 4)
+        cx = loc[:, 0] * v[0] * aw + acx
+        cy = loc[:, 1] * v[1] * ah + acy
+        bw = jnp.exp(loc[:, 2] * v[2]) * aw / 2
+        bh = jnp.exp(loc[:, 3] * v[3]) * ah / 2
+        boxes = jnp.stack([cx - bw, cy - bh, cx + bw, cy + bh], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        masked = probs.at[background_id].set(-1.0)
+        cls_id = jnp.argmax(masked, axis=0).astype(jnp.float32)
+        score = jnp.max(masked, axis=0)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id - (cls_id > background_id), -1.0)
+        score = jnp.where(keep, score, 0.0)
+        order = jnp.argsort(-score)
+        cls_id, score, boxes = cls_id[order], score[order], boxes[order]
+        iou = _box_iou(boxes, boxes)
+        same = (cls_id[:, None] == cls_id[None, :]) | force_suppress
+
+        def body(i, alive):
+            sup = (iou[i] > nms_threshold) & same[i] & \
+                  (jnp.arange(num_anchors) > i) & alive[i] & (cls_id[i] >= 0)
+            return alive & ~sup
+
+        limit = num_anchors if nms_topk <= 0 else min(nms_topk, num_anchors)
+        alive = lax.fori_loop(0, limit, body,
+                              jnp.ones(num_anchors, bool))
+        cls_id = jnp.where(alive, cls_id, -1.0)
+        return jnp.concatenate([cls_id[:, None], score[:, None], boxes], -1)
+
+    return jax.vmap(one_batch)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# RPN Proposal (reference src/operator/contrib/proposal.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_Proposal", aliases=("Proposal", "proposal"),
+          differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """Generate object proposals from RPN outputs: anchor enumeration,
+    bbox decode, clip, min-size filter, topk + NMS. Returns [B*post, 5]
+    rois of (batch_idx, x1, y1, x2, y2) — padded with the top box."""
+    b, twice_a, h, w = cls_prob.shape
+    num_anchor = twice_a // 2
+    base = float(feature_stride)
+    # base anchors centered at (stride-1)/2 (reference GenerateAnchors)
+    ctr = (base - 1) / 2
+    anchors = []
+    for r in ratios:
+        size = base * base
+        ws = jnp.sqrt(size / r)
+        hs = ws * r
+        for s in scales:
+            anchors.append([ctr - (ws * s) / 2, ctr - (hs * s) / 2,
+                            ctr + (ws * s) / 2, ctr + (hs * s) / 2])
+    base_anchors = jnp.asarray(anchors[: num_anchor], jnp.float32)
+    sy = jnp.arange(h, dtype=jnp.float32) * base
+    sx = jnp.arange(w, dtype=jnp.float32) * base
+    shift = jnp.stack(jnp.meshgrid(sx, sy, indexing="xy"), -1)  # [h,w,2]? use both
+    shifts = jnp.concatenate([shift, shift], -1).reshape(-1, 4)  # [hw,4] x1y1x2y2
+    all_anchors = (base_anchors[None] + shifts[:, None]).reshape(-1, 4)
+    n_total = all_anchors.shape[0]
+
+    def one_batch(score_map, deltas, info):
+        scores = score_map[num_anchor:].transpose(1, 2, 0).reshape(-1)
+        d = deltas.reshape(num_anchor, 4, h, w).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        aw = all_anchors[:, 2] - all_anchors[:, 0] + 1
+        ah = all_anchors[:, 3] - all_anchors[:, 1] + 1
+        acx = all_anchors[:, 0] + aw / 2
+        acy = all_anchors[:, 1] + ah / 2
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        bw = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        bh = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2, cy + bh / 2], -1)
+        boxes = jnp.clip(boxes, 0.0,
+                         jnp.stack([info[1] - 1, info[0] - 1,
+                                    info[1] - 1, info[0] - 1]))
+        min_size = rpn_min_size * info[2]
+        ok = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+             ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        scores2 = jnp.where(ok, scores, -jnp.inf)
+        pre = min(rpn_pre_nms_top_n, n_total)
+        top_scores, top_idx = lax.top_k(scores2, pre)
+        top_boxes = boxes[top_idx]
+        iou = _box_iou(top_boxes, top_boxes)
+
+        def body(i, alive):
+            sup = (iou[i] > threshold) & (jnp.arange(pre) > i) & alive[i]
+            return alive & ~sup
+
+        alive = lax.fori_loop(0, pre, body, jnp.ones(pre, bool))
+        rank = jnp.where(alive, top_scores, -jnp.inf)
+        post = min(rpn_post_nms_top_n, pre)
+        keep_scores, keep_idx = lax.top_k(rank, post)
+        # pad short result with the top proposal (reference proposal.cc),
+        # never with min-size-filtered or suppressed garbage
+        good = jnp.isfinite(keep_scores)
+        keep_idx = jnp.where(good, keep_idx, keep_idx[0])
+        keep_scores = jnp.where(good, keep_scores, keep_scores[0])
+        kept = top_boxes[keep_idx]
+        return kept, keep_scores
+
+    rois, scores = jax.vmap(one_batch)(cls_prob, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(b, dtype=jnp.float32),
+                      rois.shape[1])[:, None]
+    flat = jnp.concatenate([bidx, rois.reshape(-1, 4)], -1)
+    if output_score:
+        return flat, scores.reshape(-1, 1)
+    return flat
+
+
+@register("_contrib_MultiProposal", aliases=("MultiProposal",),
+          differentiable=False)
+def multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Batched Proposal (reference contrib/multi_proposal.cc) — the
+    jnp Proposal above is already batched via vmap."""
+    return proposal(cls_prob, bbox_pred, im_info, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Bilinear sampling / spatial transformer
+# (reference bilinear_sampler.cc, grid_generator.cc, spatial_transformer.cc)
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(img, gx, gy):
+    """Sample img [C,H,W] at float pixel coords gx, gy [Ho,Wo] with
+    zero padding outside (differentiable)."""
+    c, h, w = img.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx1 = gx - x0
+    wy1 = gy - y0
+    wx0 = 1.0 - wx1
+    wy0 = 1.0 - wy1
+
+    def tap(xi, yi, wgt):
+        inb = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        vals = img[:, yc, xc]  # [C, Ho, Wo]
+        return vals * (wgt * inb)[None]
+
+    return (tap(x0, y0, wx0 * wy0) + tap(x1, y0, wx1 * wy0)
+            + tap(x0, y1, wx0 * wy1) + tap(x1, y1, wx1 * wy1))
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid):
+    """data [N,C,H,W], grid [N,2,Ho,Wo] with x,y in [-1,1]
+    (reference bilinear_sampler.cc)."""
+    n, c, h, w = data.shape
+
+    def one(img, g):
+        gx = (g[0] + 1.0) * (w - 1) / 2.0
+        gy = (g[1] + 1.0) * (h - 1) / 2.0
+        return _bilinear_gather(img, gx, gy)
+
+    return jax.vmap(one)(data, grid)
+
+
+@register("GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: data [N,6] -> sampling grid [N,2,H,W]; warp: data is a flow
+    field [N,2,H,W] added to the identity grid (reference
+    grid_generator.cc)."""
+    if transform_type == "affine":
+        h, w = target_shape
+        theta = data.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # [3, HW]
+        out = jnp.einsum("nij,jk->nik", theta, coords)  # [N,2,HW]
+        return out.reshape(-1, 2, h, w)
+    # warp: flow + identity in pixel units, normalized back to [-1,1]
+    n, _, h, w = data.shape
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    px = gx + data[:, 0]
+    py = gy + data[:, 1]
+    nx = px * 2 / jnp.maximum(w - 1, 1) - 1
+    ny = py * 2 / jnp.maximum(h - 1, 1) - 1
+    return jnp.stack([nx, ny], 1)
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear"):
+    """Affine spatial transformer network head (reference
+    spatial_transformer.cc): loc [N,6] affine params -> sampled output."""
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet cost volume, reference src/operator/correlation.cc)
+# ---------------------------------------------------------------------------
+
+@register("Correlation", aliases=("correlation",))
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Cost volume between two feature maps: for each displacement
+    (dy, dx) within max_displacement, mean over channels and the
+    kernel_size x kernel_size patch of data1 * shifted(data2).
+    Out-of-extent displaced features contribute zero (no wrap-around),
+    matching src/operator/correlation.cc."""
+    n, c, h, w = data1.shape
+    d = int(max_displacement)
+    k = int(kernel_size)
+    # zero-pad data2 so shifted windows read zeros outside the image
+    b = jnp.pad(data2, ((0, 0), (0, 0), (d, d), (d, d)))
+    disp = range(-d, d + 1, int(stride2))
+    outs = []
+    for dy in disp:
+        for dx in disp:
+            shifted = lax.dynamic_slice(
+                b, (0, 0, d + dy, d + dx), (n, c, h, w))
+            if is_multiply:
+                prod = (data1 * shifted).mean(axis=1)
+            else:
+                prod = jnp.abs(data1 - shifted).mean(axis=1)
+            if k > 1:
+                # patch average (reference sums the k x k window and
+                # divides by sumelems = k*k*channels)
+                prod = lax.reduce_window(
+                    prod, 0.0, lax.add, (1, k, k), (1, 1, 1),
+                    "SAME") / float(k * k)
+            outs.append(prod)
+    out = jnp.stack(outs, 1)  # [N, D*D, H, W]
+    if stride1 > 1:
+        out = out[:, :, ::stride1, ::stride1]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (reference sequence_last/mask/reverse-inl.h)
+# ---------------------------------------------------------------------------
+
+@register("SequenceLast", aliases=("sequence_last",))
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    """Pick the last valid step per sequence. data [T, B, ...] (axis=0)."""
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # [T, B, ...]
+    return jax.vmap(lambda b, i: moved[i, b],
+                    in_axes=(0, 0))(jnp.arange(moved.shape[1]), idx)
+
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    """Zero (or `value`) steps beyond each sequence's length."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    t = data.shape[axis]
+    steps = jnp.arange(t)
+    mask = steps[:, None] < sequence_length.astype(jnp.int32)[None, :]  # [T,B]
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    """Reverse along time, respecting per-sequence lengths. data [T,B,...]."""
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    t = data.shape[0]
+    lens = sequence_length.astype(jnp.int32)
+    steps = jnp.arange(t)
+    # index i maps to len-1-i for i < len, else stays i
+    src = jnp.where(steps[:, None] < lens[None, :],
+                    lens[None, :] - 1 - steps[:, None], steps[:, None])
+    moved = data  # [T, B, ...]
+    return jax.vmap(lambda b, s: moved[s, b], in_axes=(0, 1),
+                    out_axes=1)(jnp.arange(data.shape[1]), src)

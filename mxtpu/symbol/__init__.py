@@ -402,6 +402,8 @@ def _apply_op(op, name, inputs, params, attrs=None, input_names=()):
         node.num_outputs = 2 if params.get("ret_typ") == "both" else 1
     elif op.name == "sample_multinomial":
         node.num_outputs = 2 if params.get("get_prob") else 1
+    elif op.name in ("_contrib_Proposal", "_contrib_MultiProposal"):
+        node.num_outputs = 2 if params.get("output_score") else 1
     nuser = op.user_outputs or node.num_outputs
     return Symbol([(node, i) for i in range(nuser)])
 
@@ -755,3 +757,21 @@ def zeros(shape, dtype="float32", **kwargs):
 
 def ones(shape, dtype="float32", **kwargs):
     raise NotImplementedError("use a variable + executor feed instead")
+
+
+class _ContribNamespace:
+    """``sym.contrib.X`` resolves registry op ``_contrib_X`` (or plain X),
+    mirroring python/mxnet/symbol/contrib.py."""
+
+    def __getattr__(self, name):
+        for candidate in ("_contrib_" + name, name):
+            op = get_op(candidate)
+            if op is not None:
+                def fn(*args, _op=op, **kwargs):
+                    return _create_symbol(_op, *args, **kwargs)
+                fn.__name__ = name
+                return fn
+        raise AttributeError("no contrib op %r" % name)
+
+
+contrib = _ContribNamespace()

@@ -1,0 +1,308 @@
+"""Detection / vision / sequence / contrib op tests, modeled on the
+reference's per-op checks in tests/python/unittest/test_operator.py
+(test_roipooling, test_sequence_*, test_bilinear_sampler,
+test_multibox_*, test_correlation, test_quantization ...).
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def test_roi_pooling():
+    x = nd.array(np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7],
+                              [0, 0, 0, 3, 3]], np.float32))
+    out = nd.ROIPooling(x, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (2, 1, 2, 2)
+    res = out.asnumpy()
+    # full-image roi, 2x2 max-pool of an 8x8 ramp
+    np.testing.assert_array_equal(res[0, 0], [[27, 31], [59, 63]])
+    np.testing.assert_array_equal(res[1, 0], [[9, 11], [25, 27]])
+
+
+def test_roi_pooling_grad():
+    x = nd.array(np.random.RandomState(0).randn(1, 2, 6, 6)
+                 .astype(np.float32))
+    rois = nd.array(np.array([[0, 1, 1, 4, 4]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.ROIPooling(x, rois, pooled_size=(2, 2), spatial_scale=1.0)
+        loss = nd.sum(y)
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all()
+    assert g.sum() > 0  # max positions get gradient
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    # num_anchors per cell = len(sizes) + len(ratios) - 1 = 3
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # first cell center at (0.125, 0.125), first anchor size .5 ratio 1
+    np.testing.assert_allclose(a[0], [0.125 - 0.25, 0.125 - 0.25,
+                                      0.125 + 0.25, 0.125 + 0.25],
+                               atol=1e-6)
+
+
+def test_multibox_target_and_detection():
+    anchors = nd.MultiBoxPrior(nd.zeros((1, 3, 2, 2)), sizes=(0.4,),
+                               ratios=(1,))
+    # one gt box matching the top-left anchor region
+    label = nd.array(np.array([[[0, 0.05, 0.05, 0.45, 0.45],
+                                [-1, 0, 0, 0, 0]]], np.float32))
+    cls_pred = nd.zeros((1, 2, anchors.shape[1]))
+    bt, bm, ct = nd.MultiBoxTarget(anchors, label, cls_pred)
+    assert bt.shape == (1, anchors.shape[1] * 4)
+    ct_np = ct.asnumpy()[0]
+    assert (ct_np == 1).sum() >= 1          # the matched anchor got class 0+1
+    assert (ct_np == 0).sum() >= 1          # background anchors remain
+
+    # detection decode: feed perfect loc targets back -> recovered gt box
+    cls_prob = np.zeros((1, 2, anchors.shape[1]), np.float32)
+    cls_prob[0, 0, :] = 0.8                 # background
+    matched = np.where(ct_np == 1)[0]
+    cls_prob[0, 1, matched] = 0.99
+    loc = bt.asnumpy().reshape(1, -1)
+    det = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc), anchors,
+                               nms_threshold=0.5, threshold=0.5)
+    d = det.asnumpy()[0]
+    kept = d[d[:, 0] >= 0]
+    assert len(kept) >= 1
+    np.testing.assert_allclose(kept[0, 2:], [0.05, 0.05, 0.45, 0.45],
+                               atol=0.02)
+
+
+def test_nms_suppression():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.4, 0.4],
+                                  [0.12, 0.12, 0.42, 0.42],
+                                  [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    cls_prob = np.zeros((1, 2, 3), np.float32)
+    cls_prob[0, 1] = [0.9, 0.8, 0.7]
+    loc = np.zeros((1, 12), np.float32)
+    det = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc), anchors,
+                               nms_threshold=0.5, threshold=0.1)
+    d = det.asnumpy()[0]
+    kept = d[d[:, 0] >= 0]
+    # overlapping anchor 1 suppressed; anchors 0 and 2 survive
+    assert len(kept) == 2
+
+
+def test_proposal():
+    rng = np.random.RandomState(1)
+    b, a, h, w = 1, 3, 4, 4
+    cls_prob = nd.array(rng.rand(b, 2 * a, h, w).astype(np.float32))
+    bbox_pred = nd.array((rng.randn(b, 4 * a, h, w) * 0.1).astype(np.float32))
+    im_info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois = nd.Proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=12,
+                       rpn_post_nms_top_n=5, feature_stride=16,
+                       scales=(2, 4, 8), ratios=(1,), rpn_min_size=1)
+    assert rois.shape == (5, 5)
+    r = rois.asnumpy()
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1] <= r[:, 3]).all() and (r[:, 2] <= r[:, 4]).all()
+
+
+def test_bilinear_sampler_identity():
+    x = nd.array(np.random.RandomState(2).randn(1, 2, 5, 5)
+                 .astype(np.float32))
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = nd.array(np.stack([xs, ys])[None].astype(np.float32))
+    out = nd.BilinearSampler(x, grid)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    x = nd.array(np.random.RandomState(3).randn(2, 1, 6, 6)
+                 .astype(np.float32))
+    theta = nd.array(np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32),
+                             (2, 1)))
+    out = nd.SpatialTransformer(x, theta, target_shape=(6, 6))
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_spatial_transformer_grad():
+    x = nd.array(np.random.RandomState(4).randn(1, 1, 4, 4)
+                 .astype(np.float32))
+    theta = nd.array(np.array([[0.8, 0.1, 0.05, -0.1, 0.9, 0.02]],
+                              np.float32))
+    theta.attach_grad()
+    with mx.autograd.record():
+        y = nd.SpatialTransformer(x, theta, target_shape=(4, 4))
+        loss = nd.sum(y * y)
+    loss.backward()
+    assert np.isfinite(theta.grad.asnumpy()).all()
+    assert np.abs(theta.grad.asnumpy()).sum() > 0
+
+
+def test_correlation_self():
+    x = nd.array(np.random.RandomState(5).randn(1, 4, 6, 6)
+                 .astype(np.float32))
+    out = nd.Correlation(x, x, max_displacement=1)
+    assert out.shape == (1, 9, 6, 6)
+    # zero displacement channel equals mean of squares
+    center = out.asnumpy()[0, 4]
+    np.testing.assert_allclose(center, (x.asnumpy()[0] ** 2).mean(0),
+                               rtol=1e-5)
+
+
+def test_sequence_ops():
+    t, b, d = 4, 3, 2
+    x = np.arange(t * b * d, dtype=np.float32).reshape(t, b, d)
+    lens = np.array([2, 4, 1], np.float32)
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True)
+    np.testing.assert_array_equal(last.asnumpy(),
+                                  np.stack([x[1, 0], x[3, 1], x[0, 2]]))
+    masked = nd.SequenceMask(nd.array(x), nd.array(lens),
+                             use_sequence_length=True, value=-1.0)
+    m = masked.asnumpy()
+    np.testing.assert_array_equal(m[2, 0], [-1, -1])
+    np.testing.assert_array_equal(m[1, 0], x[1, 0])
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True)
+    r = rev.asnumpy()
+    np.testing.assert_array_equal(r[0, 0], x[1, 0])
+    np.testing.assert_array_equal(r[1, 0], x[0, 0])
+    np.testing.assert_array_equal(r[2, 0], x[2, 0])  # beyond len: unchanged
+    np.testing.assert_array_equal(r[:, 1], x[::-1, 1])
+
+
+def test_quantize_dequantize_round_trip():
+    x = np.random.RandomState(6).uniform(-3, 3, (4, 5)).astype(np.float32)
+    q, lo, hi = nd.quantize(nd.array(x), nd.array([-3.0]), nd.array([3.0]),
+                            out_type="uint8")
+    assert q.asnumpy().dtype == np.uint8
+    back = nd.dequantize(q, lo, hi)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=6 / 255 + 1e-6)
+
+
+def test_fft_ifft():
+    x = np.random.RandomState(7).randn(2, 8).astype(np.float32)
+    f = nd.fft(nd.array(x))
+    assert f.shape == (2, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f.asnumpy()[:, 0::2], ref.real, atol=1e-4)
+    np.testing.assert_allclose(f.asnumpy()[:, 1::2], ref.imag, atol=1e-4)
+    back = nd.ifft(f)
+    np.testing.assert_allclose(back.asnumpy(), x * 8, atol=1e-4)
+
+
+def test_count_sketch():
+    d_in, d_out = 6, 4
+    x = np.random.RandomState(8).randn(2, d_in).astype(np.float32)
+    h = np.random.RandomState(9).randint(0, d_out, d_in)
+    s = np.random.RandomState(10).choice([-1.0, 1.0], d_in)
+    out = nd.count_sketch(nd.array(x), nd.array(h.astype(np.float32)),
+                          nd.array(s.astype(np.float32)), out_dim=d_out)
+    expect = np.zeros((2, d_out), np.float32)
+    for j in range(d_in):
+        expect[:, h[j]] += s[j] * x[:, j]
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+
+
+def test_psroi_pooling():
+    p, dim = 2, 3
+    c = dim * p * p
+    x = nd.array(np.random.RandomState(11).randn(1, c, 8, 8)
+                 .astype(np.float32))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    out = nd.psroi_pooling(x, rois, spatial_scale=1.0, output_dim=dim,
+                           pooled_size=p)
+    assert out.shape == (1, dim, p, p)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_multibox_prior_reference_order_and_aspect():
+    """Reference enumeration: sizes at ratios[0] first, then sizes[0] at
+    ratios[1:], with in_h/in_w aspect correction on widths."""
+    x = nd.zeros((1, 3, 2, 4))  # non-square: aspect = 0.5
+    a = nd.contrib.MultiBoxPrior(x, sizes=(0.4, 0.2), ratios=(1, 4)).asnumpy()[0]
+    aspect = 2.0 / 4.0
+    # anchor 0: size .4 ratio 1 -> w = .4*aspect/2, h = .4/2
+    c = [1 / 8, 1 / 4]  # first cell center (x, y)
+    np.testing.assert_allclose(
+        a[0], [c[0] - 0.4 * aspect / 2, c[1] - 0.2, c[0] + 0.4 * aspect / 2,
+               c[1] + 0.2], atol=1e-6)
+    # anchor 1: size .2 ratio 1
+    np.testing.assert_allclose(
+        a[1], [c[0] - 0.2 * aspect / 2, c[1] - 0.1, c[0] + 0.2 * aspect / 2,
+               c[1] + 0.1], atol=1e-6)
+    # anchor 2: size .4 ratio 4 -> w = .4*aspect*2/2, h = .4/2/2
+    np.testing.assert_allclose(
+        a[2], [c[0] - 0.4 * aspect, c[1] - 0.1, c[0] + 0.4 * aspect,
+               c[1] + 0.1], atol=1e-6)
+
+
+def test_multibox_target_negative_mining():
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 4, 4)), sizes=(0.3,))
+    A = anchors.shape[1]
+    label = nd.array(np.array([[[0, 0.3, 0.3, 0.6, 0.6]]], np.float32))
+    rng = np.random.RandomState(0)
+    cls_pred = nd.array(rng.rand(1, 2, A).astype(np.float32))
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, negative_mining_ratio=3.0,
+        negative_mining_thresh=0.5)
+    c = ct.asnumpy()[0]
+    num_pos = (c > 0).sum()
+    num_neg = (c == 0).sum()
+    num_ign = (c == -1).sum()
+    assert num_pos >= 1
+    assert num_neg <= 3 * num_pos
+    assert num_ign > 0  # easy negatives ignored
+
+
+def test_multibox_target_padding_cannot_clobber():
+    """A padding gt row must not steal the forced match of a real gt."""
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.2, 0.2],
+                                  [0.5, 0.5, 0.9, 0.9]]], np.float32))
+    label = nd.array(np.array([[[2, 0.02, 0.02, 0.2, 0.2],
+                                [-1, 0, 0, 0, 0]]], np.float32))
+    bt, bm, ct = nd.contrib.MultiBoxTarget(anchors, label,
+                                           nd.zeros((1, 4, 2)))
+    c = ct.asnumpy()[0]
+    assert c[0] == 3  # class 2 + 1
+    assert c[1] == 0
+
+
+def test_correlation_no_wraparound():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 0, 0] = 5.0  # mass only at the top-left corner
+    out = nd.Correlation(nd.array(x), nd.array(x), max_displacement=1)
+    o = out.asnumpy()[0]
+    # displacement (dy=-1): shifted reads above row 0 -> zero, NOT row 3
+    # channel order: (dy,dx) in row-major from (-1,-1); (dy=-1,dx=0) is ch 1
+    assert o[1, 0, 0] == 0.0
+    # zero displacement channel: 25 at the corner
+    assert o[4, 0, 0] == 25.0
+
+
+def test_correlation_kernel_size():
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
+    o1 = nd.Correlation(x, x, kernel_size=1, max_displacement=0)
+    o3 = nd.Correlation(x, x, kernel_size=3, max_displacement=0)
+    assert o1.shape == o3.shape
+    assert not np.allclose(o1.asnumpy(), o3.asnumpy())
+
+
+def test_proposal_pads_with_top_box():
+    """When nearly all boxes fail min-size, padding repeats the top box."""
+    rng = np.random.RandomState(2)
+    cls_prob = nd.array(rng.rand(1, 2, 2, 2).astype(np.float32))
+    bbox_pred = nd.array(np.zeros((1, 4, 2, 2), np.float32))
+    im_info = nd.array(np.array([[32, 32, 1.0]], np.float32))
+    rois = nd.Proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=4,
+                       rpn_post_nms_top_n=4, feature_stride=16,
+                       scales=(1,), ratios=(1,), rpn_min_size=14,
+                       threshold=0.01)
+    r = rois.asnumpy()
+    # all rows are valid boxes (w/h >= min size), duplicates allowed
+    assert ((r[:, 3] - r[:, 1] + 1) >= 14).all()
+    assert ((r[:, 4] - r[:, 2] + 1) >= 14).all()
