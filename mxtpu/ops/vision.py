@@ -298,6 +298,9 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
         limit = num_anchors if nms_topk <= 0 else min(nms_topk, num_anchors)
         alive = lax.fori_loop(0, limit, body,
                               jnp.ones(num_anchors, bool))
+        if nms_topk > 0:
+            # reference keeps only the top-k sorted boxes in the output
+            alive = alive & (jnp.arange(num_anchors) < limit)
         cls_id = jnp.where(alive, cls_id, -1.0)
         return jnp.concatenate([cls_id[:, None], score[:, None], boxes], -1)
 
@@ -491,18 +494,24 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     n, c, h, w = data1.shape
     d = int(max_displacement)
     k = int(kernel_size)
-    # zero-pad data2 so shifted windows read zeros outside the image
-    b = jnp.pad(data2, ((0, 0), (0, 0), (d, d), (d, d)))
+    pad = int(pad_size)
+    kr = k // 2
+    border = d + kr  # reference: border_size = max_displacement + kernel_radius
+    hp, wp = h + 2 * pad, w + 2 * pad
+    a = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    # pad data2 by an extra d so displaced reads see zeros outside
+    b = jnp.pad(data2, ((0, 0), (0, 0), (pad + d, pad + d),
+                        (pad + d, pad + d)))
     disp = range(-d, d + 1, int(stride2))
     outs = []
     for dy in disp:
         for dx in disp:
             shifted = lax.dynamic_slice(
-                b, (0, 0, d + dy, d + dx), (n, c, h, w))
+                b, (0, 0, d + dy, d + dx), (n, c, hp, wp))
             if is_multiply:
-                prod = (data1 * shifted).mean(axis=1)
+                prod = (a * shifted).mean(axis=1)
             else:
-                prod = jnp.abs(data1 - shifted).mean(axis=1)
+                prod = jnp.abs(a - shifted).mean(axis=1)
             if k > 1:
                 # patch average (reference sums the k x k window and
                 # divides by sumelems = k*k*channels)
@@ -510,7 +519,13 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
                     prod, 0.0, lax.add, (1, k, k), (1, 1, 1),
                     "SAME") / float(k * k)
             outs.append(prod)
-    out = jnp.stack(outs, 1)  # [N, D*D, H, W]
+    out = jnp.stack(outs, 1)  # [N, D*D, Hp, Wp]
+    # reference output geometry: crop the border, then stride
+    # (correlation.cc: top_h = (padded_h - 2*border)/stride1)
+    if border > 0:
+        lo = min(border, (hp - 1) // 2)
+        lo_w = min(border, (wp - 1) // 2)
+        out = out[:, :, lo:hp - lo or None, lo_w:wp - lo_w or None]
     if stride1 > 1:
         out = out[:, :, ::stride1, ::stride1]
     return out
@@ -550,15 +565,17 @@ def sequence_mask(data, sequence_length=None, use_sequence_length=False,
 @register("SequenceReverse", aliases=("sequence_reverse",))
 def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
                      axis=0):
-    """Reverse along time, respecting per-sequence lengths. data [T,B,...]."""
+    """Reverse along time, respecting per-sequence lengths. data [T,B,...]
+    (or [B,T,...] with axis=1)."""
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=axis)
-    t = data.shape[0]
+    moved = jnp.moveaxis(data, axis, 0)  # -> [T, B, ...]
+    t = moved.shape[0]
     lens = sequence_length.astype(jnp.int32)
     steps = jnp.arange(t)
     # index i maps to len-1-i for i < len, else stays i
     src = jnp.where(steps[:, None] < lens[None, :],
                     lens[None, :] - 1 - steps[:, None], steps[:, None])
-    moved = data  # [T, B, ...]
-    return jax.vmap(lambda b, s: moved[s, b], in_axes=(0, 1),
-                    out_axes=1)(jnp.arange(data.shape[1]), src)
+    out = jax.vmap(lambda b, s: moved[s, b], in_axes=(0, 1),
+                   out_axes=1)(jnp.arange(moved.shape[1]), src)
+    return jnp.moveaxis(out, 0, axis)

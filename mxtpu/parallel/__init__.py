@@ -17,6 +17,8 @@ from .trainer import (ShardedTrainer, functional_optimizer_step,
                       state_to_tree, tree_to_state)
 from .ring_attention import (ring_attention, ring_attention_sharded,
                              ulysses_attention, local_attention)
+from .pipeline import pipeline_spmd, pipeline_apply
+from .moe import moe_dispatch, moe_ffn, expert_sharding_rules
 
 __all__ = [
     "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
@@ -26,4 +28,6 @@ __all__ = [
     "tree_to_state",
     "ring_attention", "ring_attention_sharded", "ulysses_attention",
     "local_attention",
+    "pipeline_spmd", "pipeline_apply",
+    "moe_dispatch", "moe_ffn", "expert_sharding_rules",
 ]

@@ -1,0 +1,109 @@
+"""Pipeline parallelism: GPipe-style microbatching over the ``pipe`` axis.
+
+The reference's model parallelism is static per-layer placement
+(``group2ctx`` → PlaceDevice inserting _CrossDeviceCopy nodes,
+``src/executor/graph_executor.cc:313-406``; example/model-parallel/lstm) —
+layers live on different devices and activations hop between them, but
+only one device computes at a time. The TPU-native superset implemented
+here keeps the pipeline FULL: the batch is split into microbatches that
+flow through the stages in a software pipeline, activations move
+stage-to-stage over ICI via ``lax.ppermute``, and the whole schedule is
+one differentiable ``lax.scan`` inside ``shard_map`` — so forward AND
+backward pipeline automatically (grads ride the reversed permutes XLA
+derives from the forward).
+
+Requires homogeneous stages (same params/activation shapes per stage),
+the standard stacked-transformer-block setting. Stage parameters carry a
+leading ``n_stages`` axis sharded over ``pipe``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .mesh import MeshContext, AXIS_PIPE, AXIS_DATA
+
+__all__ = ["pipeline_spmd", "pipeline_apply"]
+
+
+def pipeline_spmd(stage_fn, stage_params, microbatches, axis_name=AXIS_PIPE):
+    """Run a GPipe pipeline inside shard_map.
+
+    stage_fn(params, x) -> y : one stage's computation, applied by every
+        device to its local stage params.
+    stage_params : pytree whose leaves have a leading local axis of 1
+        (this device's stage), i.e. global leading axis = n_stages.
+    microbatches : [M, mb, ...] — the full sequence of microbatches,
+        identical on every device (replicated input).
+
+    Returns [M, mb, ...] outputs of the LAST stage, replicated.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != 1:
+            raise ValueError(
+                "pipeline stage params must have global leading dim == "
+                "pipe axis size (got local stage slice of %d per device)"
+                % leaf.shape[0])
+    local_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        buf = carry  # activation arriving at this device this tick
+        # stage 0 ingests microbatch t (while t < m); later stages use buf
+        x_in = jnp.where(t < m, microbatches[jnp.clip(t, 0, m - 1)], 0.0)
+        x = jnp.where(idx == 0, x_in, buf)
+        y = stage_fn(local_params, x)
+        # last stage's result at tick t corresponds to microbatch t-(n-1)
+        out = y
+        nxt = lax.ppermute(y, axis_name, fwd)
+        return nxt, out
+
+    _, outs = lax.scan(step, jnp.zeros_like(microbatches[0]),
+                       jnp.arange(m + n - 1))
+    # keep the last stage's outputs for ticks n-1 .. n-1+m, broadcast to all
+    mine = lax.dynamic_slice_in_dim(outs, n - 1, m, axis=0)
+    mine = jnp.where(idx == n - 1, mine, 0.0)
+    return lax.psum(mine, axis_name)
+
+
+def pipeline_apply(mesh, stage_fn, stage_params, x, n_microbatch,
+                   pipe_axis=AXIS_PIPE, data_axis=AXIS_DATA):
+    """Jittable global-view pipeline application.
+
+    stage_params leaves: [n_stages, ...] (sharded over ``pipe``);
+    x: [B, ...] (optionally sharded over ``data``); the batch is split
+    into ``n_microbatch`` microbatches. Returns [B, ...] outputs.
+    """
+    if isinstance(mesh, MeshContext):
+        mesh = mesh.mesh
+    n_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    pipe_size = dict(zip(mesh.axis_names,
+                         mesh.devices.shape)).get(pipe_axis, 1)
+    if n_stages != pipe_size:
+        raise ValueError(
+            "n_stages (%d) must equal the %r mesh axis size (%d)"
+            % (n_stages, pipe_axis, pipe_size))
+    b = x.shape[0]
+    assert b % n_microbatch == 0, "batch must divide microbatch count"
+    mb = b // n_microbatch
+    xm = x.reshape((n_microbatch, mb) + x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(pipe_axis), stage_params)
+    x_spec = P(None, data_axis if data_axis in mesh.axis_names else None)
+    fn = shard_map(
+        functools.partial(pipeline_spmd, stage_fn, axis_name=pipe_axis),
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False)
+    out = fn(stage_params, xm)
+    return out.reshape((b,) + out.shape[2:])

@@ -144,7 +144,7 @@ def test_spatial_transformer_grad():
 def test_correlation_self():
     x = nd.array(np.random.RandomState(5).randn(1, 4, 6, 6)
                  .astype(np.float32))
-    out = nd.Correlation(x, x, max_displacement=1)
+    out = nd.Correlation(x, x, max_displacement=1, pad_size=1)
     assert out.shape == (1, 9, 6, 6)
     # zero displacement channel equals mean of squares
     center = out.asnumpy()[0, 4]
@@ -274,7 +274,8 @@ def test_multibox_target_padding_cannot_clobber():
 def test_correlation_no_wraparound():
     x = np.zeros((1, 1, 4, 4), np.float32)
     x[0, 0, 0, 0] = 5.0  # mass only at the top-left corner
-    out = nd.Correlation(nd.array(x), nd.array(x), max_displacement=1)
+    out = nd.Correlation(nd.array(x), nd.array(x), max_displacement=1,
+                         pad_size=1)
     o = out.asnumpy()[0]
     # displacement (dy=-1): shifted reads above row 0 -> zero, NOT row 3
     # channel order: (dy,dx) in row-major from (-1,-1); (dy=-1,dx=0) is ch 1
@@ -287,7 +288,7 @@ def test_correlation_kernel_size():
     rng = np.random.RandomState(1)
     x = nd.array(rng.randn(1, 2, 6, 6).astype(np.float32))
     o1 = nd.Correlation(x, x, kernel_size=1, max_displacement=0)
-    o3 = nd.Correlation(x, x, kernel_size=3, max_displacement=0)
+    o3 = nd.Correlation(x, x, kernel_size=3, max_displacement=0, pad_size=1)
     assert o1.shape == o3.shape
     assert not np.allclose(o1.asnumpy(), o3.asnumpy())
 
@@ -306,3 +307,48 @@ def test_proposal_pads_with_top_box():
     # all rows are valid boxes (w/h >= min size), duplicates allowed
     assert ((r[:, 3] - r[:, 1] + 1) >= 14).all()
     assert ((r[:, 4] - r[:, 2] + 1) >= 14).all()
+
+
+def test_correlation_shrinks_without_padding():
+    """Reference geometry: output = input + 2*pad - 2*(max_disp + k//2)."""
+    x = nd.array(np.random.RandomState(12).randn(1, 2, 8, 8)
+                 .astype(np.float32))
+    out = nd.Correlation(x, x, max_displacement=2, pad_size=0)
+    assert out.shape == (1, 25, 4, 4)
+    out2 = nd.Correlation(x, x, max_displacement=2, pad_size=2)
+    assert out2.shape == (1, 25, 8, 8)
+
+
+def test_nms_topk_discards_tail():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.3, 0.3],
+                                  [0.5, 0.5, 0.7, 0.7],
+                                  [0.75, 0.75, 0.95, 0.95]]], np.float32))
+    cls_prob = np.zeros((1, 2, 3), np.float32)
+    cls_prob[0, 1] = [0.9, 0.8, 0.7]
+    loc = np.zeros((1, 12), np.float32)
+    det = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc), anchors,
+                               nms_threshold=0.5, threshold=0.1, nms_topk=2)
+    d = det.asnumpy()[0]
+    assert (d[:, 0] >= 0).sum() == 2  # third box dropped by topk
+
+
+def test_sequence_reverse_axis1():
+    b, t, d = 2, 4, 3
+    x = np.arange(b * t * d, dtype=np.float32).reshape(b, t, d)
+    lens = np.array([2, 4], np.float32)
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True, axis=1)
+    r = rev.asnumpy()
+    np.testing.assert_array_equal(r[0, 0], x[0, 1])
+    np.testing.assert_array_equal(r[0, 2], x[0, 2])
+    np.testing.assert_array_equal(r[1], x[1, ::-1])
+
+
+def test_pipeline_rejects_stage_mismatch():
+    import pytest as _pytest
+    import jax.numpy as jnp
+    from mxtpu.parallel import MeshContext, pipeline_apply
+    mesh = MeshContext(pipe=4)
+    ws = jnp.zeros((8, 4, 4))  # 8 stages on a 4-wide pipe
+    with _pytest.raises(ValueError):
+        pipeline_apply(mesh, lambda p, h: h, (ws,), jnp.zeros((4, 4)), 2)
