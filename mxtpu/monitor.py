@@ -1,0 +1,84 @@
+"""Monitor: per-op output statistics during training.
+
+Capability parity with ``python/mxnet/monitor.py``: install on an
+Executor/Module via ``install``; each ``tic``/``toc`` window collects
+``stat_func`` of every output whose name matches ``pattern`` through the
+executor's monitor callback (``graph_executor.cc:1448-1468`` equivalent —
+mxtpu's Executor invokes the callback per node output after forward).
+"""
+from __future__ import annotations
+
+import re
+import logging
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                return nd.norm(x) / (x.size ** 0.5)
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        if isinstance(arr, NDArray):
+            self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe):
+        """Attach to an executor (reference Monitor.install)."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for arr in getattr(exe, "arg_arrays", []):
+                    arr.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for arr in getattr(exe, "arg_arrays", []):
+                arr.wait_to_read()
+        # also record argument/aux stats like the reference toc
+        for exe in self.exes:
+            for name, arr in getattr(exe, "arg_dict", {}).items():
+                if self.re_prog.match(name):
+                    self.queue.append(
+                        (self.step, name, self.stat_func(arr)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ",".join("%f" % float(v.asnumpy().ravel()[0])
+                         if isinstance(v, NDArray) else str(v)
+                         for v in v_list)
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

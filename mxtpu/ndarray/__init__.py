@@ -283,7 +283,36 @@ def _wrap(value, ctx=None):
 # reference src/c_api/c_api_ndarray.cc:117 → Imperative::Invoke).
 # ---------------------------------------------------------------------------
 
+_hooks = None  # (profiler, engine, profile_sync_flag) — resolved lazily
+# to dodge the load-time circular import, then cached for the hot path
+
+
+def _get_hooks():
+    global _hooks
+    if _hooks is None:
+        import os as _os
+        from .. import profiler as _prof
+        from .. import engine as _engine
+        _hooks = (_prof, _engine,
+                  _os.environ.get("MXTPU_PROFILE_SYNC", "0") == "1")
+    return _hooks
+
+
 def invoke(op, inputs, params):
+    prof, engine, profile_sync = _get_hooks()
+    active = prof.is_active()
+    t0 = prof._now_us() if active else 0.0
+    out = _invoke_impl(op, inputs, params)
+    if engine.is_synchronous() or (active and profile_sync):
+        tail = out[-1] if isinstance(out, (list, tuple)) else out
+        if isinstance(tail, NDArray):
+            tail.wait_to_read()  # true device time (NaiveEngine mode)
+    if active:
+        prof.record_span(op.name, "operator", t0, prof._now_us())
+    return out
+
+
+def _invoke_impl(op, inputs, params):
     values = []
     nd_inputs = []
     for i in inputs:

