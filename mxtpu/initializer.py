@@ -360,24 +360,64 @@ class Mixed:
 
 @register
 class FusedRNN(Initializer):
-    """Initialize fused RNN parameter blobs by slicing per-gate
-    (reference initializer.py:FusedRNN, simplified: one flat init)."""
+    """Initialize the flat parameter blob of a fused RNN (reference
+    initializer.py:FusedRNN): de-fuse into per-layer i2h/h2h weight
+    matrices and biases using the fused op's layout (ops/rnn.py — all
+    weights first, then all biases), apply the wrapped initializer to
+    each weight matrix, zero the biases, and add ``forget_bias`` to the
+    LSTM forget gate."""
 
     def __init__(self, init, num_hidden, num_layers, mode,
                  bidirectional=False, forget_bias=1.0):
-        if isinstance(init, str):
-            klass, kwargs = json.loads(init)
-            init = _INIT_REGISTRY[klass.lower()](**kwargs)
-        super().__init__(init=init.dumps() if init else None,
-                         num_hidden=num_hidden, num_layers=num_layers,
-                         mode=mode, bidirectional=bidirectional,
+        init_str = init.dumps() if isinstance(init, Initializer) \
+            else (init or Xavier(factor_type="in", magnitude=2.34).dumps())
+        super().__init__(init=init_str, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
                          forget_bias=forget_bias)
-        self._init = init
+        if isinstance(init, Initializer):
+            self._init = init
+        else:
+            klass, kwargs = json.loads(init_str)
+            self._init = create(klass, **kwargs)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
         self._mode = mode
+        self._bidirectional = bidirectional
         self._forget_bias = forget_bias
 
+    def __call__(self, desc, arr):
+        # the fused blob never matches name-suffix patterns; always init
+        self._init_weight(desc, arr)
+
     def _init_weight(self, desc, arr):
-        if self._init is not None:
-            self._init._init_weight(desc, arr)
-        else:
-            Uniform(0.07)._init_weight(desc, arr)
+        from .ops.rnn import _GATES, rnn_param_size
+        from . import ndarray as nd
+        G = _GATES[self._mode]
+        H = self._num_hidden
+        D = 2 if self._bidirectional else 1
+        L = self._num_layers
+        total = arr.size
+        # solve layer-0 input size from the blob size
+        rest = rnn_param_size(self._mode, 0, H, L, self._bidirectional)
+        isz = (total - rest) // (D * G * H)
+        out = _np.zeros((total,), _np.float32)
+        off = 0
+        for layer in range(L):
+            in_sz = isz if layer == 0 else H * D
+            for _ in range(D):
+                for shape in ((G * H, in_sz), (G * H, H)):
+                    w = nd.zeros(shape)
+                    self._init._init_weight(desc, w)
+                    n = shape[0] * shape[1]
+                    out[off:off + n] = w.asnumpy().ravel()
+                    off += n
+        for layer in range(L):
+            for _ in range(D):
+                for _half in range(2):
+                    b = _np.zeros((G * H,), _np.float32)
+                    if self._mode == "lstm":
+                        b[H:2 * H] = self._forget_bias / 2.0
+                    out[off:off + G * H] = b
+                    off += G * H
+        _set(arr, out)

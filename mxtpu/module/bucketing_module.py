@@ -187,6 +187,11 @@ class BucketingModule(BaseModule):
                         grad_req=self._grad_req)
             if self._monitor is not None:
                 module.install_monitor(self._monitor)
+            if self.optimizer_initialized:
+                # buckets created after init_optimizer share the updater
+                # (reference bucketing_module.py switch_bucket borrow)
+                module.borrow_optimizer(
+                    self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
         prev = self._curr_module
         self._curr_module = self._buckets[bucket_key]

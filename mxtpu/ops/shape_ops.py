@@ -271,3 +271,15 @@ def space_to_depth(data, block_size):
     x = data.reshape(b, c, h // bs, bs, w // bs, bs)
     x = x.transpose(0, 3, 5, 1, 2, 4)
     return x.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+@register("_zeros")
+def _zeros_op(shape=(), dtype="float32"):
+    """Nullary zeros creator (used by symbolic begin_state; reference
+    mx.sym.zeros)."""
+    return jnp.zeros(tuple(shape), jnp.dtype(dtype))
+
+
+@register("_ones")
+def _ones_op(shape=(), dtype="float32"):
+    return jnp.ones(tuple(shape), jnp.dtype(dtype))

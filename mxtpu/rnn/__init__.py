@@ -1,0 +1,16 @@
+"""mx.rnn namespace: symbolic RNN cells, bucketing IO, RNN checkpoints.
+
+Capability parity with ``python/mxnet/rnn/`` (rnn_cell.py, io.py, rnn.py).
+"""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       ModifierCell, DropoutCell, ZoneoutCell, ResidualCell)
+from .io import BucketSentenceIter
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "ModifierCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BucketSentenceIter", "save_rnn_checkpoint",
+           "load_rnn_checkpoint", "do_rnn_checkpoint"]

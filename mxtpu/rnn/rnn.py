@@ -1,0 +1,48 @@
+"""RNN checkpointing with fused/unfused weight conversion.
+
+Capability parity with ``python/mxnet/rnn/rnn.py``: cells' fused weight
+blobs are unpacked to per-gate arrays before saving (so checkpoints are
+interchangeable between FusedRNNCell and unfused stacks) and re-packed on
+load.
+"""
+from __future__ import annotations
+
+from .. import model
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint"]
+
+
+def _normalize_cells(cells):
+    if not isinstance(cells, (list, tuple)):
+        cells = [cells]
+    return cells
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """Save checkpoint, unpacking cell weights (reference
+    rnn.py:save_rnn_checkpoint)."""
+    args = dict(arg_params)
+    for cell in _normalize_cells(cells):
+        args = cell.unpack_weights(args)
+    model.save_checkpoint(prefix, epoch, symbol, args, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load checkpoint, re-packing cell weights (reference
+    rnn.py:load_rnn_checkpoint)."""
+    sym, arg, aux = model.load_checkpoint(prefix, epoch)
+    for cell in _normalize_cells(cells):
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback (reference rnn.py:do_rnn_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

@@ -1,0 +1,606 @@
+"""Symbolic RNN cells for the Module/bucketing workflow.
+
+Capability parity with ``python/mxnet/rnn/rnn_cell.py`` (1,186 LoC):
+``BaseRNNCell`` with ``__call__(inputs, states)``/``unroll``/``begin_state``,
+parameter sharing via ``RNNParams``, and the cell zoo — RNNCell, LSTMCell,
+GRUCell, FusedRNNCell, SequentialRNNCell, BidirectionalCell, DropoutCell,
+ZoneoutCell, ResidualCell.
+
+These build **Symbol** graphs (the Gluon eager cells live in
+``mxtpu.gluon.rnn``). On TPU an unrolled cell graph jits into one XLA
+computation per bucket length — the executor-level analogue of the
+reference's per-bucket shared-memory executors — while FusedRNNCell maps
+onto the fused scan ``RNN`` op (cuDNN RNN there, ``lax.scan`` kernel here,
+ops/rnn.py).
+"""
+from __future__ import annotations
+
+from .. import symbol
+from ..symbol import Symbol
+from ..base import string_types
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "ModifierCell", "DropoutCell", "ZoneoutCell", "ResidualCell"]
+
+
+class RNNParams:
+    """Container for shared cell parameters (reference RNNParams)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.var(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract RNN cell (reference rnn_cell.py:BaseRNNCell)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError()
+
+    @property
+    def state_shape(self):
+        return [ele["shape"] for ele in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=None, **kwargs):
+        """Initial states as zero symbols (reference begin_state)."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called"
+        states = []
+        func = func or symbol._zeros
+        for info in self.state_info:
+            self._init_counter += 1
+            if info is not None:
+                info = dict(info)
+                shape = info.pop("shape", ())
+                state = func(name="%sbegin_state_%d" % (self._prefix,
+                                                        self._init_counter),
+                             shape=shape, **kwargs) \
+                    if func is not symbol._zeros else \
+                    func(shape=tuple(0 if s is None else s for s in shape),
+                         name="%sbegin_state_%d"
+                         % (self._prefix, self._init_counter))
+            else:
+                state = func(name="%sbegin_state_%d" % (self._prefix,
+                                                        self._init_counter),
+                             **kwargs)
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Split fused weight blobs into per-gate arrays (reference
+        unpack_weights)."""
+        args = dict(args)
+        for group in ("i2h", "h2h"):
+            weight = args.pop("%s%s_weight" % (self._prefix, group), None)
+            bias = args.pop("%s%s_bias" % (self._prefix, group), None)
+            if weight is None:
+                continue
+            gates = self._gate_names
+            if not gates:
+                args["%s%s_weight" % (self._prefix, group)] = weight
+                if bias is not None:
+                    args["%s%s_bias" % (self._prefix, group)] = bias
+                continue
+            n = len(gates)
+            h = weight.shape[0] // n
+            for j, g in enumerate(gates):
+                args["%s%s%s_weight" % (self._prefix, group, g)] = \
+                    weight[j * h:(j + 1) * h]
+                if bias is not None:
+                    args["%s%s%s_bias" % (self._prefix, group, g)] = \
+                        bias[j * h:(j + 1) * h]
+        return args
+
+    def pack_weights(self, args):
+        """Inverse of unpack_weights."""
+        from .. import ndarray as nd
+        args = dict(args)
+        gates = self._gate_names
+        if not gates:
+            return args
+        for group in ("i2h", "h2h"):
+            ws = []
+            bs = []
+            ok = True
+            for g in gates:
+                wkey = "%s%s%s_weight" % (self._prefix, group, g)
+                if wkey not in args:
+                    ok = False
+                    break
+                ws.append(args.pop(wkey))
+                bkey = "%s%s%s_bias" % (self._prefix, group, g)
+                if bkey in args:
+                    bs.append(args.pop(bkey))
+            if not ok:
+                continue
+            args["%s%s_weight" % (self._prefix, group)] = nd.concatenate(
+                ws, axis=0)
+            if bs:
+                args["%s%s_bias" % (self._prefix, group)] = nd.concatenate(
+                    bs, axis=0)
+        return args
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll the cell over `length` steps (reference unroll)."""
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _format_sequence(length, outputs, layout,
+                                      merge_outputs)
+        return outputs, states
+
+
+def _normalize_sequence(length, inputs, layout, merge):
+    axis = layout.find("T")
+    if isinstance(inputs, Symbol):
+        if len(inputs.list_outputs()) == 1:
+            inputs = symbol.split(inputs, axis=axis, num_outputs=length,
+                                  squeeze_axis=True)
+            inputs = [inputs[i] for i in range(length)]
+        else:
+            inputs = list(inputs)
+    assert len(inputs) == length
+    return inputs, axis
+
+
+def _format_sequence(length, outputs, layout, merge):
+    axis = layout.find("T")
+    if merge:
+        outputs = [symbol.expand_dims(o, axis=axis) for o in outputs]
+        return symbol.Concat(*outputs, dim=axis), axis
+    return outputs, axis
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla tanh/relu RNN cell (reference RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
+                                    num_hidden=self._num_hidden,
+                                    name="%sh2h" % name)
+        output = symbol.Activation(i2h + h2h, act_type=self._activation,
+                                   name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (reference LSTMCell; gate order i, f, c, o)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+        self._forget_bias = forget_bias
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(states[0], self._hW, self._hB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name="%sh2h" % name)
+        gates = i2h + h2h
+        sliced = symbol.SliceChannel(gates, num_outputs=4,
+                                     name="%sslice" % name)
+        in_gate = symbol.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = symbol.Activation(sliced[1], act_type="sigmoid")
+        in_transform = symbol.Activation(sliced[2], act_type="tanh")
+        out_gate = symbol.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (reference GRUCell; gate order r, z, o)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        prev_h = states[0]
+        i2h = symbol.FullyConnected(inputs, self._iW, self._iB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%si2h" % name)
+        h2h = symbol.FullyConnected(prev_h, self._hW, self._hB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name="%sh2h" % name)
+        i2h_s = symbol.SliceChannel(i2h, num_outputs=3)
+        h2h_s = symbol.SliceChannel(h2h, num_outputs=3)
+        reset = symbol.Activation(i2h_s[0] + h2h_s[0], act_type="sigmoid")
+        update = symbol.Activation(i2h_s[1] + h2h_s[1], act_type="sigmoid")
+        next_h_tmp = symbol.Activation(i2h_s[2] + reset * h2h_s[2],
+                                       act_type="tanh")
+        next_h = (1.0 - update) * next_h_tmp + update * prev_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN over the scan-based ``RNN`` op (the cuDNN RNN
+    analogue, reference FusedRNNCell + src/operator/cudnn_rnn-inl.h)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        from .. import initializer as _init
+        self._parameter = self.params.get(
+            "parameters",
+            init=_init.FusedRNN(None, num_hidden, num_layers, mode,
+                                bidirectional, forget_bias))
+        self._directions = 2 if bidirectional else 1
+
+    @property
+    def state_info(self):
+        b = self._directions
+        n = (self._mode == "lstm") + 1
+        return [{"shape": (b * self._num_layers, 0, self._num_hidden),
+                 "__layout__": "LNC"} for _ in range(n)]
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "FusedRNNCell cannot be stepped; call unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, None)
+        # stack back to time-major [T, N, C] for the fused op
+        stacked = symbol.stack(*inputs, axis=0)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        args = dict(mode=self._mode, state_size=self._num_hidden,
+                    num_layers=self._num_layers,
+                    bidirectional=self._bidirectional, p=self._dropout,
+                    state_outputs=True)
+        if self._mode == "lstm":
+            rnn = symbol.RNN(stacked, self._parameter, begin_state[0],
+                             begin_state[1], name="%srnn" % self._prefix,
+                             **args)
+            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            rnn = symbol.RNN(stacked, self._parameter, begin_state[0],
+                             name="%srnn" % self._prefix, **args)
+            outputs, states = rnn[0], [rnn[1]]
+        # back to a list of per-step symbols / merged tensor in `layout`
+        axis = layout.find("T")
+        if merge_outputs:
+            if axis == 1:
+                outputs = symbol.SwapAxis(outputs, dim1=0, dim2=1)
+            return outputs, states if self._get_next_state else []
+        steps = symbol.split(outputs, axis=0, num_outputs=length,
+                             squeeze_axis=True)
+        outs = [steps[i] for i in range(length)]
+        return outs, states if self._get_next_state else []
+
+    def unfuse(self):
+        """Equivalent stack of unfused cells (reference unfuse)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden,
+                                          activation="relu", prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden,
+                                          activation="tanh", prefix=p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
+            "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell("%sl%d_" % (self._prefix, i)),
+                    get_cell("%sr%d_" % (self._prefix, i)),
+                    output_prefix="%sbi_l%d_" % (self._prefix, i)))
+            else:
+                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix="%s_dropout%d_"
+                                      % (self._prefix, i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells applied in sequence (reference SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+        self._override_cell_params = params is not None
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            state = states[p: p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        if begin_state is None:
+            begin_state = self.begin_state()
+        p = 0
+        states = begin_state
+        next_states = []
+        num_cells = len(self._cells)
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            state = states[p: p + n]
+            p += n
+            inputs, state = cell.unroll(
+                length, inputs=inputs, begin_state=state, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            next_states.extend(state)
+        return inputs, next_states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Forward + backward cells over the sequence (reference
+    BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(prefix="", params=params)
+        self._cells = [l_cell, r_cell]
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell cannot be stepped; call unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        l_cell, r_cell = self._cells
+        n_l = len(l_cell.state_info)
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state[:n_l],
+            layout=layout, merge_outputs=None)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=begin_state[n_l:], layout=layout,
+            merge_outputs=None)
+        outputs = [symbol.Concat(l_o, r_o, dim=1,
+                                 name="%st%d" % (self._output_prefix, i))
+                   for i, (l_o, r_o) in
+                   enumerate(zip(l_outputs, reversed(r_outputs)))]
+        if merge_outputs:
+            outputs, _ = _format_sequence(length, outputs, layout, True)
+        return outputs, l_states + r_states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells wrapping another cell (reference ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout on the outputs between layers (reference DropoutCell)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(inputs, p=self.dropout)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference ZoneoutCell): randomly preserve
+    previous states."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), \
+            "FusedRNNCell does not support zoneout"
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell = self.base_cell
+        next_output, next_states = cell(inputs, states)
+        mask = lambda p, like: symbol.Dropout(  # noqa: E731
+            symbol.ones_like(like), p=p)
+        prev_output = self.prev_output if self.prev_output is not None \
+            else symbol.zeros_like(next_output)
+        if self.zoneout_outputs > 0:
+            m = mask(self.zoneout_outputs, next_output)
+            output = symbol.where(m, next_output, prev_output)
+        else:
+            output = next_output
+        if self.zoneout_states > 0:
+            states = [symbol.where(mask(self.zoneout_states, ns), ns, s)
+                      for ns, s in zip(next_states, states)]
+        else:
+            states = next_states
+        self.prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the cell output (reference ResidualCell)."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = symbol.elemwise_add(output, inputs)
+        return output, states

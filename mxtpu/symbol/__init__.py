@@ -394,18 +394,27 @@ def _apply_op(op, name, inputs, params, attrs=None, input_names=()):
     if name is None:
         name = _name_mgr.current().get(None, op.name.lower())
     node = _Node(op, name, in_refs, params, attrs, input_names)
-    # determine output arity cheaply from the op decl
-    node.num_outputs = op.num_outputs if isinstance(op.num_outputs, int) else 1
-    if op.name in ("split", "SliceChannel"):
-        node.num_outputs = int(params.get("num_outputs", 2))
-    elif op.name == "topk":
-        node.num_outputs = 2 if params.get("ret_typ") == "both" else 1
-    elif op.name == "sample_multinomial":
-        node.num_outputs = 2 if params.get("get_prob") else 1
-    elif op.name in ("_contrib_Proposal", "_contrib_MultiProposal"):
-        node.num_outputs = 2 if params.get("output_score") else 1
+    node.num_outputs = _node_num_outputs(op, params)
     nuser = op.user_outputs or node.num_outputs
     return Symbol([(node, i) for i in range(nuser)])
+
+
+def _node_num_outputs(op, params):
+    """Output arity of an op node, including param-dependent cases
+    (single source of truth for _apply_op and load_json)."""
+    n = op.num_outputs if isinstance(op.num_outputs, int) else 1
+    if op.name in ("split", "SliceChannel"):
+        return int(params.get("num_outputs", 2))
+    if op.name == "topk":
+        return 2 if params.get("ret_typ") == "both" else 1
+    if op.name == "sample_multinomial":
+        return 2 if params.get("get_prob") else 1
+    if op.name in ("_contrib_Proposal", "_contrib_MultiProposal"):
+        return 2 if params.get("output_score") else 1
+    if op.name == "RNN":
+        return 1 if not params.get("state_outputs") else \
+            (3 if params.get("mode", "lstm") == "lstm" else 2)
+    return n
 
 
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
@@ -423,7 +432,9 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
     if wd_mult is not None:
         node.attrs["__wd_mult__"] = wd_mult
     if init is not None:
-        node.attrs["__init__"] = init
+        # accept Initializer instances or their dumps() JSON string
+        node.attrs["__init__"] = init if isinstance(init, str) \
+            else init.dumps()
     node.attrs.update(kwargs)
     return Symbol([(node, 0)])
 
@@ -469,18 +480,42 @@ def load_json(json_str):
         node.inputs = [(nodes[i], oi) for (i, oi) in jn["inputs"]]
         if node.op:
             node.aux_positions = set(node.op.aux_update.keys())
-            node.num_outputs = node.op.num_outputs \
-                if isinstance(node.op.num_outputs, int) else 1
-            if node.op.name in ("split", "SliceChannel"):
-                node.num_outputs = int(node.params.get("num_outputs", 2))
-            elif node.op.name == "topk":
-                node.num_outputs = 2 if node.params.get("ret_typ") == "both" else 1
+            node.num_outputs = _node_num_outputs(node.op, node.params)
     return Symbol([(nodes[i], oi) for (i, oi) in d["heads"]])
 
 
 # ---------------------------------------------------------------------------
 # Graph evaluation (shared by Executor and shape inference)
 # ---------------------------------------------------------------------------
+
+def _build_consumer_map(nodes):
+    consumers = {}
+    for n in nodes:
+        for (inp, _oi) in n.inputs:
+            consumers.setdefault(id(inp), []).append(n)
+    return consumers
+
+
+def _creation_batch(node, consumers, get_input_shape, fallback_shapes):
+    """Resolve the MXNet 'unknown batch' (dim 0 in a _zeros/_ones shape).
+
+    Preferred: an RNN consumer pins it — fused states are (L*D, N, H) and
+    RNN data is TNC, so batch = data_shape[1] (both subtrees precede the
+    state in DFS order, so the data shape is already available). Fallback:
+    the leading dim of a bound variable named 'data'/'*_data', else the
+    first known variable shape.
+    """
+    for c in consumers.get(id(node), ()):
+        if c.op is not None and c.op.name == "RNN" and c.inputs:
+            s = get_input_shape(c.inputs[0])
+            if s is not None and len(s) >= 2:
+                return s[1]
+    for name, s in fallback_shapes.items():
+        if (name == "data" or name.endswith("_data")) and len(s) > 0:
+            return s[0]
+    return next((s[0] for s in fallback_shapes.values() if len(s) > 0),
+                None)
+
 
 def eval_graph(sym_outputs, feed, training=False):
     """Evaluate graph outputs given {var_name: jax value}.
@@ -490,6 +525,7 @@ def eval_graph(sym_outputs, feed, training=False):
     """
     cache = {}
     aux_updates = {}
+    consumer_map = _build_consumer_map(Symbol(list(sym_outputs))._topo())
 
     def eval_node(node):
         key = id(node)
@@ -509,6 +545,21 @@ def eval_graph(sym_outputs, feed, training=False):
             params = dict(node.params)
             if node.op.needs_train_flag:
                 params["_training"] = training
+            if node.op.name in ("_zeros", "_ones") \
+                    and 0 in tuple(params.get("shape", ())):
+                # MXNet convention: dim 0 in a state/creation shape means
+                # "unknown batch"
+                def _in_shape(ref):
+                    n2, oi2 = ref
+                    vals2 = eval_node(n2)
+                    v2 = vals2[oi2]
+                    return tuple(getattr(v2, "shape", ())) or None
+                fb = {k: tuple(v.shape) for k, v in feed.items()
+                      if getattr(v, "ndim", 0) > 0}
+                batch = _creation_batch(node, consumer_map, _in_shape, fb)
+                if batch:
+                    params["shape"] = tuple(batch if d == 0 else d
+                                            for d in params["shape"])
             out = node.op.fn(*in_vals, **params)
             vals = out if isinstance(out, tuple) else (out,)
             for in_pos, out_idx in node.op.aux_update.items():
@@ -652,6 +703,7 @@ def _infer_graph_shapes(sym, known, partial=False):
     shapes = dict(known)  # var name -> shape
     node_out_dtypes = {}
     nodes = sym._topo()
+    consumer_map = _build_consumer_map(nodes)
     # include declared shapes on vars; dims of 0 mean "unknown" (MXNet's
     # deferred-init convention) so such shapes don't count as known
     for n in nodes:
@@ -718,6 +770,19 @@ def _infer_graph_shapes(sym, known, partial=False):
         params = dict(node.params)
         if node.op.needs_train_flag:
             params["_training"] = False
+        if node.op.name in ("_zeros", "_ones") \
+                and 0 in tuple(params.get("shape", ())):
+            def _in_shape(ref):
+                n2, oi2 = ref
+                if n2.is_variable:
+                    return shapes.get(n2.name)
+                got = node_out_shapes.get(id(n2))
+                return got[oi2] if got else None
+            fb = {k: v for k, v in known.items()}
+            batch = _creation_batch(node, consumer_map, _in_shape, fb)
+            if batch:
+                params["shape"] = tuple(batch if d == 0 else d
+                                        for d in params["shape"])
 
         def f(*xs):
             r = node.op.fn(*xs, **params)
@@ -775,3 +840,22 @@ class _ContribNamespace:
 
 
 contrib = _ContribNamespace()
+
+
+@shape_hint("RNN")
+def _rnn_hint(params, in_shapes, input_names):
+    data = in_shapes.get("data")
+    if data is None:
+        return {}
+    mode = params.get("mode", "lstm")
+    state_size = int(params.get("state_size", 0))
+    num_layers = int(params.get("num_layers", 1))
+    bidir = bool(params.get("bidirectional", False))
+    dirs = 2 if bidir else 1
+    from ..ops.rnn import rnn_param_size
+    psize = rnn_param_size(mode, data[2], state_size, num_layers, bidir)
+    out = {"parameters": (psize,),
+           "state": (num_layers * dirs, data[1], state_size)}
+    if "state_cell" in input_names:
+        out["state_cell"] = (num_layers * dirs, data[1], state_size)
+    return out

@@ -1,0 +1,217 @@
+"""mx.rnn tests, modeled on the reference tests/python/unittest/test_rnn.py:
+cell unroll shapes, stacked/bidirectional composition, fused<->unfused
+weight conversion, bucketing iterator, and an end-to-end bucketing LM
+training run (the PTB-style config, BASELINE configs item 4).
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def _check_unroll(cell, num_hidden, t=3, b=2, d=4):
+    inputs = [mx.sym.var("t%d_data" % i) for i in range(t)]
+    outputs, states = cell.unroll(t, inputs)
+    out = mx.sym.Group(outputs) if isinstance(outputs, list) else outputs
+    shape_kwargs = {"t%d_data" % i: (b, d) for i in range(t)}
+    arg_shapes, out_shapes, _ = out.infer_shape_partial(**shape_kwargs)
+    return out, out_shapes
+
+
+def test_rnn_cell_unroll():
+    cell = mx.rnn.RNNCell(10, prefix="rnn_")
+    out, shapes = _check_unroll(cell, 10)
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    assert all(s == (2, 10) for s in shapes)
+
+
+def test_lstm_cell_unroll():
+    cell = mx.rnn.LSTMCell(16, prefix="lstm_")
+    out, shapes = _check_unroll(cell, 16)
+    assert all(s == (2, 16) for s in shapes)
+
+
+def test_gru_cell_unroll():
+    cell = mx.rnn.GRUCell(16, prefix="gru_")
+    out, shapes = _check_unroll(cell, 16)
+    assert all(s == (2, 16) for s in shapes)
+
+
+def test_stacked_and_residual():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.LSTMCell(8, prefix="l1_")))
+    stack.add(mx.rnn.DropoutCell(0.2))
+    inputs = [mx.sym.var("t%d_data" % i) for i in range(3)]
+    outputs, states = stack.unroll(3, inputs)
+    assert len(states) == 4  # 2 lstm cells x (h, c)
+    out = mx.sym.Group(outputs)
+    _, out_shapes, _ = out.infer_shape_partial(
+        **{"t%d_data" % i: (2, 8) for i in range(3)})
+    assert all(s == (2, 8) for s in out_shapes)
+
+
+def test_bidirectional():
+    cell = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(6, prefix="l_"),
+                                    mx.rnn.LSTMCell(6, prefix="r_"))
+    inputs = [mx.sym.var("t%d_data" % i) for i in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    out = mx.sym.Group(outputs)
+    _, out_shapes, _ = out.infer_shape_partial(
+        **{"t%d_data" % i: (2, 5) for i in range(3)})
+    assert all(s == (2, 12) for s in out_shapes)  # concat of fwd+bwd
+
+
+def test_unpack_pack_weights_round_trip():
+    cell = mx.rnn.LSTMCell(4, prefix="lstm_")
+    rng = np.random.RandomState(0)
+    args = {
+        "lstm_i2h_weight": nd.array(rng.randn(16, 3).astype(np.float32)),
+        "lstm_i2h_bias": nd.array(rng.randn(16).astype(np.float32)),
+        "lstm_h2h_weight": nd.array(rng.randn(16, 4).astype(np.float32)),
+        "lstm_h2h_bias": nd.array(rng.randn(16).astype(np.float32)),
+    }
+    orig = {k: v.asnumpy().copy() for k, v in args.items()}
+    unpacked = cell.unpack_weights(dict(args))
+    assert "lstm_i2h_i_weight" in unpacked
+    assert "lstm_i2h_weight" not in unpacked
+    packed = cell.pack_weights(unpacked)
+    for k in orig:
+        np.testing.assert_allclose(packed[k].asnumpy(), orig[k], rtol=1e-6)
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(1)
+    sentences = [list(rng.randint(1, 20, size=l))
+                 for l in rng.randint(2, 12, size=200)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[4, 8, 12], invalid_label=0)
+    seen = 0
+    for batch in it:
+        assert batch.bucket_key in (4, 8, 12)
+        assert batch.data[0].shape == (8, batch.bucket_key)
+        # label is data shifted left
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+        seen += 1
+    assert seen > 3
+
+
+def test_bucketing_module_lm_end_to_end():
+    """Tiny PTB-style LM with BucketingModule over unrolled LSTM cells:
+    perplexity must drop (reference example/rnn/lstm_bucketing.py)."""
+    rng = np.random.RandomState(2)
+    vocab = 16
+    # learnable data: next token = (token + 1) % vocab
+    sentences = []
+    for _ in range(120):
+        ln = rng.choice([4, 8])
+        start = rng.randint(1, vocab)
+        sentences.append([(start + i) % (vocab - 1) + 1 for i in range(ln)])
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8, buckets=[4, 8],
+                                   invalid_label=0)
+
+    num_hidden = 32
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=16,
+                                 name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(num_hidden, prefix="lstm_l0_"))
+        outputs, _ = stack.unroll(seq_len, inputs=embed, layout="NTC",
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label_r = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label_r, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    metric = mx.metric.Perplexity(ignore_label=0)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    ppl = []
+    for epoch in range(3):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        ppl.append(metric.get()[1])
+    assert ppl[-1] < ppl[0] * 0.9, ppl
+
+
+def test_fused_rnn_cell_unroll_and_init():
+    """FusedRNNCell unrolls via the scan RNN op; FusedRNN initializer
+    fills the flat blob (weights nonzero, lstm forget bias set)."""
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm",
+                               get_next_state=True, prefix="flstm_")
+    inputs = [mx.sym.var("t%d_data" % i) for i in range(4)]
+    outputs, states = cell.unroll(4, inputs, merge_outputs=False)
+    assert len(outputs) == 4 and len(states) == 2
+    out = mx.sym.Group(outputs)
+    _, out_shapes, _ = out.infer_shape_partial(
+        **{"t%d_data" % i: (2, 6) for i in range(4)})
+    assert all(s == (2, 8) for s in out_shapes)
+    # initializer on the blob
+    from mxtpu.ops.rnn import rnn_param_size
+    sz = rnn_param_size("lstm", 6, 8, 2, False)
+    blob = nd.zeros((sz,))
+    mx.init.FusedRNN(None, 8, 2, "lstm")("flstm_parameters", blob)
+    assert (blob.asnumpy() != 0).mean() > 0.4
+
+
+def test_fused_unfuse_shapes_match():
+    fused = mx.rnn.FusedRNNCell(8, num_layers=2, mode="gru",
+                                prefix="g_")
+    stack = fused.unfuse()
+    inputs = [mx.sym.var("t%d_data" % i) for i in range(3)]
+    outputs, _ = stack.unroll(3, inputs)
+    out = mx.sym.Group(outputs)
+    _, out_shapes, _ = out.infer_shape_partial(
+        **{"t%d_data" % i: (2, 4) for i in range(3)})
+    assert all(s == (2, 8) for s in out_shapes)
+
+
+def test_fused_rnn_tnc_layout_batch_resolution():
+    """begin_state batch must come from the RNN data's TNC batch dim,
+    not the first bound shape's dim 0 (T != N here)."""
+    t, n, c, h = 6, 2, 4, 8
+    data = mx.sym.var("data")  # fed time-major [T, N, C]
+    cell = mx.rnn.FusedRNNCell(h, num_layers=1, mode="lstm",
+                               get_next_state=True, prefix="tnc_")
+    outputs, states = cell.unroll(t, inputs=mx.sym.split(
+        data, axis=0, num_outputs=t, squeeze_axis=True),
+        merge_outputs=True, layout="TNC")
+    out = mx.sym.Group([outputs] + states)
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(t, n, c))
+    assert out_shapes[0] == (t, n, h)
+    assert out_shapes[1] == (1, n, h)  # state batch == n, not t
+    # executes too
+    exe = out.simple_bind(mx.cpu(), data=(t, n, c))
+    res = exe.forward(data=nd.ones((t, n, c)))
+    assert res[0].shape == (t, n, h)
+
+
+def test_rnn_symbol_json_round_trip():
+    """RNN with state_outputs keeps 3 outputs across save/load."""
+    data = mx.sym.var("data")
+    p = mx.sym.var("p")
+    s = mx.sym.var("s")
+    sc = mx.sym.var("sc")
+    r = mx.sym.RNN(data, p, s, sc, state_size=4, num_layers=1, mode="lstm",
+                   state_outputs=True, name="r")
+    assert len(r.list_outputs()) == 3
+    r2 = mx.sym.load_json(r.tojson())
+    assert len(r2.list_outputs()) == 3
+    assert r2.list_outputs() == r.list_outputs()
