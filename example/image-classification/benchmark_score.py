@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Inference throughput benchmark across the model zoo (reference
+example/image-classification/benchmark_score.py:45-84 — the source of the
+docs/faq/perf.md inference tables). Prints images/sec per (model, batch).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+from mxtpu.gluon.model_zoo import vision  # noqa: E402
+
+MODELS = {
+    "alexnet": vision.alexnet,
+    "vgg16": lambda **kw: vision.get_vgg(16, **kw),
+    "resnet-50": lambda **kw: vision.get_resnet(1, 50, **kw),
+    "resnet-152": lambda **kw: vision.get_resnet(1, 152, **kw),
+    "inception-v3": vision.inception_v3,
+    "mobilenet": lambda **kw: vision.get_mobilenet(1.0, **kw),
+    "squeezenet": vision.squeezenet1_0,
+    "densenet121": vision.densenet121,
+}
+
+
+def score(model_name, batch, hw, n_iter=10):
+    mx.random.seed(0)
+    net = MODELS[model_name]()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.uniform(
+        size=(batch, 3, hw, hw)).astype(np.float32))
+    # warmup/compile
+    out = net(x)
+    out.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        out = net(x)
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+    return batch * n_iter / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--models", default="resnet-50")
+    p.add_argument("--batch-sizes", default="1,8,32")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+    for name in args.models.split(","):
+        hw = 299 if "inception" in name else args.image_size
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            img_s = score(name, b, hw, args.iters)
+            print("network: %-14s batch: %3d  images/sec: %.2f"
+                  % (name, b, img_s))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Train MNIST with the Module API (reference
+example/image-classification/train_mnist.py — the BASELINE.json LeNet
+config). Downloads nothing: uses the real MNIST files if present under
+--data-dir, else a synthetic drop-in so the pipeline always runs.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+
+def get_mlp():
+    data = mx.sym.var("data")
+    data = mx.sym.Flatten(data)
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def get_lenet():
+    data = mx.sym.var("data")
+    conv1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    tanh1 = mx.sym.Activation(conv1, act_type="tanh")
+    pool1 = mx.sym.Pooling(tanh1, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2))
+    conv2 = mx.sym.Convolution(pool1, kernel=(5, 5), num_filter=50)
+    tanh2 = mx.sym.Activation(conv2, act_type="tanh")
+    pool2 = mx.sym.Pooling(tanh2, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2))
+    flat = mx.sym.Flatten(pool2)
+    fc1 = mx.sym.FullyConnected(flat, num_hidden=500)
+    tanh3 = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(tanh3, num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def get_mnist_iter(args):
+    """Real MNIST if the idx files exist, else synthetic class-separable
+    digits (keeps the example runnable hermetically)."""
+    import gzip
+    import struct
+
+    def read_idx(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return np.frombuffer(f.read(), np.uint8).reshape(shape)
+
+    shape = (1, 28, 28)
+    d = args.data_dir
+    candidates = [os.path.join(d, "train-images-idx3-ubyte"),
+                  os.path.join(d, "train-images-idx3-ubyte.gz")]
+    found = next((c for c in candidates if os.path.exists(c)), None)
+    if found:
+        suffix = ".gz" if found.endswith(".gz") else ""
+        tr_x = read_idx(found).astype(np.float32)[:, None] / 255.0
+        tr_y = read_idx(os.path.join(
+            d, "train-labels-idx1-ubyte" + suffix)).astype(np.float32)
+        va_x = read_idx(os.path.join(
+            d, "t10k-images-idx3-ubyte" + suffix)).astype(np.float32)[:, None] / 255.0
+        va_y = read_idx(os.path.join(
+            d, "t10k-labels-idx1-ubyte" + suffix)).astype(np.float32)
+    else:
+        logging.warning("MNIST not found under %s; using synthetic digits", d)
+        rng = np.random.RandomState(0)
+        n = 2000
+        tr_y = rng.randint(0, 10, n).astype(np.float32)
+        tr_x = rng.rand(n, *shape).astype(np.float32) * 0.1
+        for i in range(n):
+            c = int(tr_y[i])
+            tr_x[i, 0, c * 2:c * 2 + 3, c * 2:c * 2 + 3] += 0.9
+        va_x, va_y = tr_x[:500], tr_y[:500]
+    train = mx.io.NDArrayIter(tr_x, tr_y, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(va_x, va_y, args.batch_size)
+    return train, val
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--network", choices=("mlp", "lenet"), default="mlp")
+    p.add_argument("--data-dir", default="data/mnist")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--kv-store", default="local")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    train, val = get_mnist_iter(args)
+    kv = mx.kv.create(args.kv_store)
+    mod = mx.mod.Module(net, context=mx.context.current_context())
+    mod.fit(train,
+            eval_data=val,
+            kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       50))
+    score = mod.score(val, mx.metric.Accuracy())
+    print("final validation accuracy:", score)
+
+
+if __name__ == "__main__":
+    main()

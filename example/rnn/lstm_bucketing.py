@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Bucketed LSTM language model (reference example/rnn/lstm_bucketing.py —
+the PTB config in BASELINE.json). Reads a tokenized text file (one
+sentence per line) or falls back to a synthetic corpus.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = [line.split() for line in f]
+    sentences, vocab = mx.rnn.io.encode_sentences(lines, vocab=vocab) \
+        if hasattr(mx.rnn.io, "encode_sentences") else _encode(lines, vocab)
+    return sentences, vocab
+
+
+def _encode(lines, vocab):
+    vocab = vocab or {}
+    out = []
+    for words in lines:
+        sent = []
+        for w in words:
+            if w not in vocab:
+                vocab[w] = len(vocab) + 1
+            sent.append(vocab[w])
+        out.append(sent)
+    return out, vocab
+
+
+def synthetic_corpus(n=500, vocab=64, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ln = int(rng.choice([8, 16, 24, 32]))
+        start = rng.randint(1, vocab)
+        out.append([(start + i) % (vocab - 1) + 1 for i in range(ln)])
+    return out, vocab
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--train-data", default=None)
+    p.add_argument("--num-hidden", type=int, default=200)
+    p.add_argument("--num-embed", type=int, default=200)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--buckets", default="8,16,24,32")
+    p.add_argument("--fused", action="store_true",
+                   help="use the scan-fused RNN op (cuDNN-RNN analogue)")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.train_data and os.path.exists(args.train_data):
+        sentences, vocab = tokenize_text(args.train_data)
+        num_vocab = len(vocab) + 2
+    else:
+        logging.warning("no --train-data; using synthetic corpus")
+        sentences, num_vocab = synthetic_corpus()
+    buckets = [int(b) for b in args.buckets.split(",")]
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=buckets, invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=num_vocab,
+                                 output_dim=args.num_embed, name="embed")
+        if args.fused:
+            cell = mx.rnn.FusedRNNCell(args.num_hidden,
+                                       num_layers=args.num_layers,
+                                       mode="lstm", prefix="lstm_")
+            stack = cell
+        else:
+            stack = mx.rnn.SequentialRNNCell()
+            for i in range(args.num_layers):
+                stack.add(mx.rnn.LSTMCell(args.num_hidden,
+                                          prefix="lstm_l%d_" % i))
+        outputs, _ = stack.unroll(seq_len, inputs=embed, layout="NTC",
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=num_vocab,
+                                     name="pred")
+        label_r = mx.sym.Reshape(label, shape=(-1,))
+        return (mx.sym.SoftmaxOutput(pred, label_r, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.default_bucket_key,
+                                 context=mx.context.current_context())
+    mod.fit(train,
+            eval_metric=mx.metric.Perplexity(ignore_label=0),
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(),
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,14 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # honour the env var even when a sitecustomize has already pinned the
+    # platform list via jax.config (the env var must win for users)
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 from .base import MXNetError, MXTPUError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ndarray
@@ -30,7 +38,7 @@ def _optional_imports():
         ("kvstore", ("kv",)), ("gluon", ()), ("parallel", ()),
         ("profiler", ()), ("recordio", ()), ("image", ()),
         ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
-        ("rnn", ()), ("engine", ()),
+        ("rnn", ()), ("engine", ()), ("operator", ()),
     ]:
         try:
             m = importlib.import_module("." + name, __name__)

@@ -1,0 +1,223 @@
+"""Custom operators: user-defined Python ops inside graphs.
+
+Capability parity with ``python/mxnet/operator.py`` + the reference's
+``CustomOperator`` machinery (``src/operator/custom/custom-inl.h:50-139``,
+which runs user Python callbacks on a dedicated worker thread integrated
+with the engine): ``CustomOp``/``CustomOpProp``/``register``, invoked as
+``nd.Custom(*args, op_type='name')`` or ``sym.Custom``.
+
+TPU-first rendering: the user's Python ``forward``/``backward`` run via
+``jax.pure_callback`` — the XLA-sanctioned host-callback escape hatch — so
+a custom op composes with jit/vmap-free graphs and the symbolic executor
+exactly like the reference's engine-integrated callback thread. Gradients
+flow through a ``jax.custom_vjp`` whose bwd calls the user's ``backward``.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom op implementations (reference CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write src into dst honouring the grad req."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst._data = src._data if hasattr(src, "_data") else \
+                jnp.asarray(src)
+        elif req == "add":
+            dst._data = dst._data + (src._data if hasattr(src, "_data")
+                                     else jnp.asarray(src))
+        else:
+            raise ValueError("invalid req %r" % req)
+
+
+class CustomOpProp:
+    """Describes a custom op's signature (reference CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, (in_shape[0],), ()
+
+    def infer_type(self, in_type):
+        return in_type, (in_type[0],) * len(self.list_outputs()), \
+            (in_type[0],) * len(self.list_auxiliary_states())
+
+    def list_arguments(self):
+        return ("data",)
+
+    def list_outputs(self):
+        return ("output",)
+
+    def list_auxiliary_states(self):
+        return ()
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under op_type ``reg_name``
+    (reference mx.operator.register)."""
+    def deco(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get_prop(op_type, kwargs=None):
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError("custom op type %r is not registered "
+                         "(use mx.operator.register)" % op_type)
+    return _CUSTOM_REGISTRY[op_type](**{k: str(v)
+                                        for k, v in (kwargs or {}).items()})
+
+
+# ---------------------------------------------------------------------------
+# the framework-level 'Custom' op
+# ---------------------------------------------------------------------------
+
+def _shape_structs(shapes, dtypes):
+    return tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                 for s, d in zip(shapes, dtypes))
+
+
+def _custom_fn_for(op_type, prop_kwargs, in_shapes, in_dtypes):
+    """Build a custom_vjp-wrapped pure function for one (op_type, shapes)
+    specialization."""
+    from .ndarray import NDArray
+
+    prop = get_prop(op_type, prop_kwargs)
+    if prop.list_auxiliary_states():
+        raise NotImplementedError(
+            "custom ops with auxiliary states are not supported")
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    n_out = len(prop.list_outputs())
+    _, out_dtypes, _ = prop.infer_type(list(in_dtypes))
+    out_dtypes = [_np.dtype(d) for d in out_dtypes]
+    out_structs = _shape_structs(out_shapes, out_dtypes)
+    in_structs = _shape_structs(in_shapes, in_dtypes)
+    # ONE operator instance serves forward and backward, like the
+    # reference's per-executor CustomOperator — ops may stash forward
+    # state on self for backward (dropout-mask pattern)
+    op_holder = []
+
+    def _get_op():
+        if not op_holder:
+            op_holder.append(prop.create_operator(
+                "cpu", [list(s) for s in in_shapes], list(in_dtypes)))
+        return op_holder[0]
+
+    def _host_forward(is_train, *arrays):
+        op = _get_op()
+        in_data = [NDArray(jnp.asarray(a)) for a in arrays]
+        out_data = [NDArray(jnp.zeros(tuple(s), d))
+                    for s, d in zip(out_shapes, out_dtypes)]
+        op.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+        return tuple(_np.asarray(o.asnumpy(), dtype=out_dtypes[i])
+                     for i, o in enumerate(out_data))
+
+    def _host_backward(*arrays):
+        n_in = len(in_shapes)
+        grads = arrays[:n_out]
+        ins = arrays[n_out:n_out + n_in]
+        outs = arrays[n_out + n_in:]
+        op = _get_op()
+        out_grad = [NDArray(jnp.asarray(g)) for g in grads]
+        in_data = [NDArray(jnp.asarray(a)) for a in ins]
+        out_data = [NDArray(jnp.asarray(a)) for a in outs]
+        in_grad = [NDArray(jnp.zeros(tuple(s), d))
+                   for s, d in zip(in_shapes, in_dtypes)]
+        op.backward(["write"] * n_in, out_grad, in_data, out_data,
+                    in_grad, [])
+        return tuple(_np.asarray(g.asnumpy(), dtype=in_dtypes[i])
+                     for i, g in enumerate(in_grad))
+
+    @jax.custom_vjp
+    def custom_apply(*inputs):
+        return jax.pure_callback(
+            functools.partial(_host_forward, False), out_structs, *inputs,
+            vmap_method="sequential")
+
+    def fwd(*inputs):
+        outs = jax.pure_callback(
+            functools.partial(_host_forward, True), out_structs, *inputs,
+            vmap_method="sequential")
+        return outs, (inputs, outs)
+
+    def bwd(res, gs):
+        inputs, outs = res
+        gs = gs if isinstance(gs, tuple) else (gs,)
+        in_grads = jax.pure_callback(
+            _host_backward, in_structs, *(tuple(gs) + tuple(inputs)
+                                          + tuple(outs)),
+            vmap_method="sequential")
+        return tuple(in_grads)
+
+    custom_apply.defvjp(fwd, bwd)
+    return custom_apply, n_out
+
+
+_FN_CACHE = {}
+
+
+def _custom_op_fn(*inputs, op_type=None, _training=False, **kwargs):
+    if op_type is None:
+        raise ValueError("Custom requires op_type=")
+    in_shapes = tuple(tuple(x.shape) for x in inputs)
+    in_dtypes = tuple(_np.dtype(x.dtype) for x in inputs)
+    key = (op_type, tuple(sorted(kwargs.items())), in_shapes, in_dtypes)
+    if key not in _FN_CACHE:
+        _FN_CACHE[key] = _custom_fn_for(op_type, kwargs, in_shapes,
+                                        in_dtypes)
+    fn, n_out = _FN_CACHE[key]
+    out = fn(*inputs)
+    return out if n_out > 1 else out[0]
+
+
+def _register_framework_op():
+    from .ops.registry import register as _reg_op
+    _reg_op("Custom", differentiable=True, needs_train_flag=True)(
+        _custom_op_fn)
+
+
+_register_framework_op()
+
+
+def custom_num_outputs(params):
+    """Output arity for a Custom node (symbol layer hook)."""
+    kwargs = {k: v for k, v in params.items()
+              if k not in ("op_type", "_training")}
+    return len(get_prop(params.get("op_type"), kwargs).list_outputs())

@@ -414,6 +414,9 @@ def _node_num_outputs(op, params):
     if op.name == "RNN":
         return 1 if not params.get("state_outputs") else \
             (3 if params.get("mode", "lstm") == "lstm" else 2)
+    if op.name == "Custom":
+        from ..operator import custom_num_outputs
+        return custom_num_outputs(params)
     return n
 
 

@@ -1,0 +1,186 @@
+"""Custom op tests, modeled on the reference test_operator.py:test_custom_op:
+a user-defined softmax with hand-written backward must match the builtin,
+compose with autograd, work symbolically, and survive jit.
+"""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+class MySoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        dx = y * (g - (g * y).sum(axis=1, keepdims=True))
+        self.assign(in_grad[0], req[0], nd.array(dx))
+
+
+@mx.operator.register("mysoftmax")
+class MySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0]], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return MySoftmax()
+
+
+class MyScale2(mx.operator.CustomOp):
+    """Two-output op: (x*scale, x+scale)."""
+
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], nd.array(x * self.scale))
+        self.assign(out_data[1], req[1], nd.array(x + self.scale))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        g = out_grad[0].asnumpy() * self.scale + out_grad[1].asnumpy()
+        self.assign(in_grad[0], req[0], nd.array(g))
+
+
+@mx.operator.register("myscale2")
+class MyScale2Prop(mx.operator.CustomOpProp):
+    def __init__(self, scale="2.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_outputs(self):
+        return ["scaled", "shifted"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0]], [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return MyScale2(self.scale)
+
+
+def test_custom_forward_matches_builtin():
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    out = nd.Custom(nd.array(x), op_type="mysoftmax")
+    ref = nd.softmax(nd.array(x))
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_custom_backward_through_autograd():
+    x = nd.array(np.random.RandomState(1).randn(3, 4).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="mysoftmax")
+        loss = nd.sum(y * y)
+    loss.backward()
+    g_custom = x.grad.asnumpy().copy()
+
+    x2 = nd.array(x.asnumpy())
+    x2.attach_grad()
+    with mx.autograd.record():
+        y2 = nd.softmax(x2)
+        loss2 = nd.sum(y2 * y2)
+    loss2.backward()
+    np.testing.assert_allclose(g_custom, x2.grad.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_custom_multi_output_with_params():
+    x = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+    a, b = nd.Custom(nd.array(x), op_type="myscale2", scale=3.0)
+    np.testing.assert_allclose(a.asnumpy(), x * 3.0, rtol=1e-6)
+    np.testing.assert_allclose(b.asnumpy(), x + 3.0, rtol=1e-6)
+
+
+def test_custom_symbolic_and_jit():
+    """Custom op inside a bound symbol graph (pure_callback under jit)."""
+    data = mx.sym.var("data")
+    out = mx.sym.Custom(data, op_type="mysoftmax", name="cs")
+    out = mx.sym.sum(out * out)
+    exe = out.simple_bind(mx.cpu(), data=(4, 6))
+    x = np.random.RandomState(3).randn(4, 6).astype(np.float32)
+    res = exe.forward(data=nd.array(x))
+    y = np.exp(x - x.max(1, keepdims=True))
+    y /= y.sum(1, keepdims=True)
+    np.testing.assert_allclose(float(res[0].asnumpy()), (y * y).sum(),
+                               rtol=1e-4)
+    exe.backward()
+    g = exe.grad_dict["data"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class Stateful(mx.operator.CustomOp):
+    """Stashes forward state on self for backward (dropout-mask pattern)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.mask = (x > 0).astype(np.float32)
+        self.assign(out_data[0], req[0], nd.array(x * self.mask))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], nd.array(g * self.mask))
+
+
+@mx.operator.register("statefulrelu")
+class StatefulProp(mx.operator.CustomOpProp):
+    def infer_shape(self, in_shape):
+        return [in_shape[0]], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Stateful()
+
+
+def test_custom_op_shares_instance_between_fwd_bwd():
+    x = nd.array(np.random.RandomState(4).randn(3, 3).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="statefulrelu")
+        loss = nd.sum(y)
+    loss.backward()
+    mask = (x.asnumpy() > 0).astype(np.float32)
+    np.testing.assert_allclose(x.grad.asnumpy(), mask, rtol=1e-6)
+
+
+class IndexOut(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], nd.array(
+            np.argmax(x, axis=1).astype(np.int32)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    nd.zeros(in_data[0].shape))
+
+
+@mx.operator.register("myargmax")
+class IndexOutProp(mx.operator.CustomOpProp):
+    def infer_shape(self, in_shape):
+        return [in_shape[0]], [[in_shape[0][0]]], []
+
+    def infer_type(self, in_type):
+        return in_type, [np.int32], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return IndexOut()
+
+
+def test_custom_op_honors_infer_type():
+    x = np.random.RandomState(5).randn(4, 6).astype(np.float32)
+    out = nd.Custom(nd.array(x), op_type="myargmax")
+    assert out.asnumpy().dtype == np.int32
+    np.testing.assert_array_equal(out.asnumpy(), np.argmax(x, axis=1))

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder into RecordIO (reference tools/im2rec.py).
+
+Two phases, same CLI shape as the reference:
+  --list   walk a directory, write `prefix.lst` (index\\tlabel\\tpath);
+  (default) read `prefix.lst`, encode images, write `prefix.rec` +
+  `prefix.idx` for MXIndexedRecordIO random access.
+
+Uses Pillow for decode/resize (the reference shells into OpenCV).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxtpu import recordio  # noqa: E402
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(args):
+    image_list = []
+    label = 0
+    label_of = {}
+    for root, dirs, files in sorted(os.walk(args.root)):
+        dirs.sort()
+        files.sort()
+        for f in files:
+            if os.path.splitext(f)[1].lower() not in _EXTS:
+                continue
+            cat = os.path.relpath(root, args.root).split(os.sep)[0]
+            if cat not in label_of:
+                label_of[cat] = label
+                label += 1
+            image_list.append((label_of[cat],
+                               os.path.relpath(os.path.join(root, f),
+                                               args.root)))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    chunk = n // args.chunks
+    for c in range(args.chunks):
+        suffix = "" if args.chunks == 1 else "_%d" % c
+        part = image_list[c * chunk:(c + 1) * chunk
+                          if c < args.chunks - 1 else n]
+        n_train = int(len(part) * args.train_ratio)
+        sets = [("train" if args.train_ratio < 1 else "", part[:n_train])]
+        if args.train_ratio < 1:
+            sets.append(("val", part[n_train:]))
+        for setname, items in sets:
+            name = args.prefix + suffix + \
+                ("_" + setname if setname else "") + ".lst"
+            with open(name, "w") as f:
+                for i, (lab, path) in enumerate(items):
+                    f.write("%d\t%f\t%s\n" % (i, lab, path))
+            print("wrote %s (%d items)" % (name, len(items)))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), float(parts[1]), parts[-1]
+
+
+def im2rec(args):
+    try:
+        from PIL import Image
+    except ImportError:
+        sys.exit("im2rec needs Pillow for image encoding")
+    lst = args.prefix + ".lst"
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    count = 0
+    for idx, label, path in read_list(lst):
+        full = os.path.join(args.root, path)
+        try:
+            img = Image.open(full).convert("RGB")
+        except Exception as e:  # noqa: BLE001
+            print("skipping %s: %s" % (path, e))
+            continue
+        if args.resize:
+            w, h = img.size
+            scale = args.resize / min(w, h)
+            img = img.resize((max(1, int(w * scale)),
+                              max(1, int(h * scale))))
+        if args.center_crop:
+            w, h = img.size
+            s = min(w, h)
+            img = img.crop(((w - s) // 2, (h - s) // 2,
+                            (w + s) // 2, (h + s) // 2))
+        import io as _io
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG", quality=args.quality)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf.getvalue()))
+        count += 1
+    rec.close()
+    print("packed %d images into %s.rec" % (count, args.prefix))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="output prefix (and .lst path)")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="generate the .lst file instead of packing")
+    p.add_argument("--no-shuffle", dest="shuffle", action="store_false",
+                   help="keep list order (default shuffles with seed 100)")
+    p.add_argument("--chunks", type=int, default=1)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    args = p.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        im2rec(args)
+
+
+if __name__ == "__main__":
+    main()

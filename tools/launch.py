@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference tools/launch.py:29-111).
+
+The reference shells into dmlc-tracker to spawn ps-lite scheduler/server/
+worker processes over ssh/mpi/sge/yarn or locally. mxtpu's distributed
+backend is ``jax.distributed`` (single controller per host, collectives
+over ICI/DCN), so the launcher's job is to start N worker processes with
+the coordinator environment — the `--launcher local` mode forks them on
+this host (how the reference's nightly dist tests run without a cluster,
+tests/nightly/dist_sync_kvstore.py), and `--launcher ssh` prints/execs
+the per-host commands.
+
+Env handed to each worker (read by mxtpu.kvstore / jax.distributed):
+  MXTPU_COORDINATOR  host:port of process 0
+  MXTPU_NUM_PROCS    world size
+  MXTPU_PROC_ID      rank
+(Plus DMLC_* aliases for scripts written against the reference.)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def launch_local(args, command):
+    procs = []
+    base_env = dict(os.environ)
+    coordinator = "127.0.0.1:%d" % args.port
+    for rank in range(args.num_workers):
+        env = dict(base_env)
+        env.update({
+            "MXTPU_COORDINATOR": coordinator,
+            "MXTPU_NUM_PROCS": str(args.num_workers),
+            "MXTPU_PROC_ID": str(rank),
+            # reference-compatible aliases
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+            "DMLC_WORKER_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(command, shell=True, env=env))
+    code = 0
+    try:
+        for p in procs:
+            p.wait()
+            code = code or p.returncode
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        code = 1
+    return code
+
+
+def launch_ssh(args, command):
+    hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+    coordinator = "%s:%d" % (hosts[0], args.port)
+    procs = []
+    for rank in range(args.num_workers):
+        host = hosts[rank % len(hosts)]
+        envs = ("MXTPU_COORDINATOR=%s MXTPU_NUM_PROCS=%d MXTPU_PROC_ID=%d "
+                "DMLC_ROLE=worker DMLC_NUM_WORKER=%d DMLC_NUM_SERVER=%d "
+                "DMLC_WORKER_ID=%d"
+                % (coordinator, args.num_workers, rank, args.num_workers,
+                   args.num_servers, rank))
+        remote = "ssh -o StrictHostKeyChecking=no %s 'cd %s && %s %s'" % (
+            host, os.getcwd(), envs, command)
+        print(remote)
+        if not args.dry_run:
+            procs.append(subprocess.Popen(remote, shell=True))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("-s", "--num-servers", type=int, default=0,
+                   help="accepted for reference-CLI parity; mxtpu has no "
+                        "parameter servers (SPMD collectives instead)")
+    p.add_argument("--launcher", choices=("local", "ssh"), default="local")
+    p.add_argument("-H", "--hostfile", default=None)
+    p.add_argument("--port", type=int, default=9327)
+    p.add_argument("--dry-run", action="store_true")
+    p.add_argument("command", nargs="+")
+    args = p.parse_args()
+    command = " ".join(args.command)
+    if args.launcher == "local":
+        sys.exit(launch_local(args, command))
+    if not args.hostfile:
+        sys.exit("ssh launcher requires --hostfile")
+    sys.exit(launch_ssh(args, command))
+
+
+if __name__ == "__main__":
+    main()

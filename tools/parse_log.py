@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference tools/parse_log.py):
+extracts per-epoch train/validation accuracy and throughput from the
+``fit``/Speedometer log format.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(fname):
+    tr_acc = {}
+    va_acc = {}
+    speed = {}
+    with open(fname) as f:
+        for line in f:
+            m = re.search(r"Epoch\[(\d+)\].*Train-accuracy=([\d.]+)", line)
+            if m:
+                tr_acc[int(m.group(1))] = float(m.group(2))
+            m = re.search(r"Epoch\[(\d+)\].*Validation-accuracy=([\d.]+)",
+                          line)
+            if m:
+                va_acc[int(m.group(1))] = float(m.group(2))
+            m = re.search(r"Epoch\[(\d+)\].*Speed: ([\d.]+) samples/sec",
+                          line)
+            if m:
+                speed.setdefault(int(m.group(1)), []).append(
+                    float(m.group(2)))
+    return tr_acc, va_acc, speed
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile")
+    p.add_argument("--format", choices=("markdown", "none"),
+                   default="markdown")
+    args = p.parse_args()
+    tr, va, sp = parse(args.logfile)
+    epochs = sorted(set(tr) | set(va) | set(sp))
+    if args.format == "markdown":
+        print("| epoch | train-accuracy | valid-accuracy | speed |")
+        print("| --- | --- | --- | --- |")
+    for e in epochs:
+        avg_speed = sum(sp.get(e, [0])) / max(len(sp.get(e, [1])), 1)
+        print("| %d | %s | %s | %.1f |"
+              % (e, tr.get(e, ""), va.get(e, ""), avg_speed))
+
+
+if __name__ == "__main__":
+    main()
