@@ -38,7 +38,7 @@ def _optional_imports():
         ("kvstore", ("kv",)), ("gluon", ()), ("parallel", ()),
         ("profiler", ()), ("recordio", ()), ("image", ()),
         ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
-        ("rnn", ()), ("engine", ()), ("operator", ()),
+        ("rnn", ()), ("engine", ()), ("operator", ()), ("contrib", ()),
     ]:
         try:
             m = importlib.import_module("." + name, __name__)

@@ -1,0 +1,8 @@
+"""mxtpu.contrib: experimental namespaces (reference python/mxnet/contrib/).
+
+``contrib.text`` (vocab/embeddings) here; tensor-level contrib ops live on
+``nd.contrib`` / ``sym.contrib`` (ops/vision.py, ops/contrib_ops.py).
+"""
+from . import text
+
+__all__ = ["text"]

@@ -1,0 +1,8 @@
+"""contrib.text: vocab + token embeddings (reference
+python/mxnet/contrib/text/)."""
+from . import vocab
+from . import embedding
+from .vocab import Vocabulary
+from . import utils
+
+__all__ = ["vocab", "embedding", "utils", "Vocabulary"]

@@ -1,0 +1,203 @@
+"""Token embeddings (reference python/mxnet/contrib/text/embedding.py).
+
+File-based pretrained embeddings (GloVe/fastText text format: one token +
+floats per line), CustomEmbedding, CompositeEmbedding, and
+``get_vecs_by_tokens`` / ``update_token_vectors``. No network access —
+pretrained files must already be on disk (the reference downloads;
+this environment has zero egress, so pass ``pretrained_file_path``).
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as _np
+
+from ... import ndarray as nd
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "list_sources", "TokenEmbedding",
+           "CustomEmbedding", "CompositeEmbedding", "GloVe", "FastText"]
+
+_EMB_REGISTRY = {}
+
+
+def register(cls):
+    _EMB_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    key = embedding_name.lower()
+    if key not in _EMB_REGISTRY:
+        raise KeyError("unknown embedding %r (registered: %s)"
+                       % (embedding_name, sorted(_EMB_REGISTRY)))
+    return _EMB_REGISTRY[key](**kwargs)
+
+
+def list_sources(embedding_name=None):
+    if embedding_name is not None:
+        return _EMB_REGISTRY[embedding_name.lower()].source_file_hint
+    return {k: v.source_file_hint for k, v in _EMB_REGISTRY.items()}
+
+
+class TokenEmbedding:
+    """Base: maps tokens to vectors, unknown -> init_unknown_vec."""
+
+    source_file_hint = "local text file: '<token> <v0> <v1> ...' per line"
+
+    def __init__(self, vocabulary=None, init_unknown_vec=None):
+        self._init_unknown_vec = init_unknown_vec or (lambda s: nd.zeros(s))
+        self._token_to_idx = {"<unk>": 0}
+        self._idx_to_token = ["<unk>"]
+        self._idx_to_vec = None
+        self._vec_len = 0
+        self._vocabulary = vocabulary
+
+    # -- loading ----------------------------------------------------------
+    def _load_embedding_file(self, path, elem_delim=" ", encoding="utf8"):
+        vecs = []
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                token, elems = parts[0], parts[1:]
+                if line_num == 0 and len(elems) == 1:
+                    continue  # fastText header "count dim"
+                try:
+                    vec = _np.asarray([float(x) for x in elems],
+                                      _np.float32)
+                except ValueError:
+                    logging.warning("skipping malformed line %d", line_num)
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = vec.size
+                elif vec.size != self._vec_len:
+                    logging.warning("line %d has dim %d != %d; skipped",
+                                    line_num, vec.size, self._vec_len)
+                    continue
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(vec)
+        unk = self._init_unknown_vec((1, self._vec_len)).asnumpy()
+        table = _np.concatenate([unk] + [v[None] for v in vecs], axis=0) \
+            if vecs else unk
+        self._idx_to_vec = nd.array(table)
+        if self._vocabulary is not None:
+            self._align_to_vocabulary(self._vocabulary)
+
+    def _align_to_vocabulary(self, vocab):
+        """Re-index the table so row i holds the vector of
+        vocab.idx_to_token[i] (reference
+        _build_embedding_for_vocabulary)."""
+        table = self.get_vecs_by_tokens(vocab.idx_to_token)
+        self._idx_to_vec = table
+        self._idx_to_token = list(vocab.idx_to_token)
+        self._token_to_idx = dict(vocab.token_to_idx)
+
+    # -- api --------------------------------------------------------------
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        idx = []
+        for t in tokens:
+            if t in self._token_to_idx:
+                idx.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                idx.append(self._token_to_idx[t.lower()])
+            else:
+                idx.append(0)
+        vecs = nd.take(self._idx_to_vec,
+                       nd.array(_np.asarray(idx, _np.int32)), axis=0)
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        if new_vectors.ndim == 1:
+            new_vectors = new_vectors.reshape((1, -1))
+        if new_vectors.shape[0] != len(tokens):
+            raise ValueError(
+                "%d tokens but %d vectors" % (len(tokens),
+                                              new_vectors.shape[0]))
+        for i, t in enumerate(tokens):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r not indexed" % t)
+            self._idx_to_vec[self._token_to_idx[t]] = new_vectors[i]
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe text-format file loader (reference embedding.py:GloVe;
+    pass pretrained_file_path — no downloads here)."""
+
+    source_file_hint = "glove.*.txt (space-delimited)"
+
+    def __init__(self, pretrained_file_path, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_file(pretrained_file_path)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText .vec file loader (reference embedding.py:FastText)."""
+
+    source_file_hint = "wiki.*.vec (space-delimited with header line)"
+
+    def __init__(self, pretrained_file_path, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_file(pretrained_file_path)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """User-provided embedding file with arbitrary delimiter
+    (reference embedding.py:CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_file(pretrained_file_path, elem_delim,
+                                  encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (reference embedding.py:CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        self._vocabulary = vocabulary
+        self._token_to_idx = vocabulary.token_to_idx
+        self._idx_to_token = vocabulary.idx_to_token
+        parts = [emb.get_vecs_by_tokens(vocabulary.idx_to_token)
+                 for emb in token_embeddings]
+        self._idx_to_vec = nd.concat(*parts, dim=1) if len(parts) > 1 \
+            else parts[0]
+        self._vec_len = self._idx_to_vec.shape[1]

@@ -1,0 +1,85 @@
+"""Text vocabulary (reference python/mxnet/contrib/text/vocab.py).
+
+Indexes tokens by frequency with reserved tokens and an unknown token at
+index 0, exactly mirroring the reference's Vocabulary semantics.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if unknown_token in reserved_tokens or \
+                    len(set(reserved_tokens)) != len(reserved_tokens):
+                raise ValueError("reserved tokens must be unique and must "
+                                 "not contain the unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens) if reserved_tokens \
+            else None
+        self._idx_to_token = [unknown_token] + (list(reserved_tokens)
+                                                if reserved_tokens else [])
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, collections.Counter)
+        unknown_and_reserved = set(self._idx_to_token)
+        pairs = sorted(counter.items(), key=lambda t: t[0])
+        pairs.sort(key=lambda t: t[1], reverse=True)
+        limit = len(counter) if most_freq_count is None else most_freq_count
+        indexed = 0
+        for token, freq in pairs:
+            if freq < min_freq or indexed >= limit:
+                break
+            if token in unknown_and_reserved:
+                continue
+            self._idx_to_token.append(token)
+            self._token_to_idx[token] = len(self._idx_to_token) - 1
+            indexed += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index(es); unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        out = [self._token_to_idx.get(t, 0) for t in tokens]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        if single:
+            indices = [indices]
+        out = []
+        for i in indices:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("token index %d out of range" % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -338,7 +338,8 @@ class ImageIter:
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root=None,
                  shuffle=False, aug_list=None, imglist=None,
-                 data_name="data", label_name="softmax_label", **kwargs):
+                 data_name="data", label_name="softmax_label",
+                 part_index=0, num_parts=1, **kwargs):
         from .io import DataDesc, DataBatch
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
@@ -351,15 +352,20 @@ class ImageIter:
         if path_imgrec is not None:
             from . import recordio
             idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
-            rec = recordio.IndexedRecordIO(idx_path, path_imgrec, "r") \
+            rec = recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r") \
                 if os.path.exists(idx_path) else \
-                recordio.RecordIO(path_imgrec, "r")
+                recordio.MXRecordIO(path_imgrec, "r")
+            # shard during the read so a worker holds only its records
+            # (reference dmlc InputSplit with part_index from kv rank)
+            rec_idx = 0
             while True:
                 item = rec.read()
                 if item is None:
                     break
-                header, img = recordio.unpack(item)
-                self._items.append((img, header.label))
+                if rec_idx % num_parts == part_index:
+                    header, img = recordio.unpack(item)
+                    self._items.append((img, header.label))
+                rec_idx += 1
         elif imglist is not None:
             for entry in imglist:
                 label, path = entry[0], entry[-1]
@@ -424,3 +430,84 @@ class ImageIter:
         return DataBatch(data=[data], label=[label])
 
     __next__ = next
+
+
+class ImageRecordIterImpl:
+    """Threaded RecordIO image pipeline: the reference ImageRecordIter v2
+    (src/io/iter_image_recordio_2.cc:727 — InputSplit shard -> parallel
+    decode+augment -> batch -> prefetch), rendered as an ImageIter over a
+    worker-sharded record set wrapped in a background-thread prefetcher.
+
+    Reference kwargs accepted: path_imgrec, data_shape, batch_size,
+    shuffle, rand_crop, rand_mirror, mean_r/g/b, std_r/g/b, resize,
+    label_width, part_index/num_parts (distributed sharding),
+    preprocess_threads & prefetch_buffer (prefetch depth).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
+                 rand_crop=False, rand_mirror=False, mean_img=None,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=0.0, std_g=0.0,
+                 std_b=0.0, resize=0, label_width=1, part_index=0,
+                 num_parts=1, preprocess_threads=4, prefetch_buffer=4,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        mean = None
+        if mean_r or mean_g or mean_b:
+            mean = _np.array([mean_r, mean_g, mean_b])
+        std = None
+        if std_r or std_g or std_b:
+            std = _np.array([std_r or 1.0, std_g or 1.0, std_b or 1.0])
+        self._inner = ImageIter(
+            batch_size, data_shape, label_width=label_width,
+            path_imgrec=path_imgrec, shuffle=shuffle,
+            rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean,
+            std=std, resize=resize,
+            data_name=data_name, label_name=label_name,
+            part_index=part_index, num_parts=num_parts)
+        if mean_img:
+            self._install_mean_img(mean_img)
+        from .io import PrefetchingIter
+        self._prefetch = PrefetchingIter(self._inner)
+
+    def _install_mean_img(self, mean_img):
+        """Mean-image subtraction (reference: the iterator computes and
+        caches mean.bin on first use, then subtracts it per sample)."""
+        inner = self._inner
+        if os.path.exists(mean_img):
+            loaded = nd.load(mean_img)
+            mean_arr = (loaded["mean_img"] if isinstance(loaded, dict)
+                        else loaded[0]).asnumpy()
+        else:
+            # one pass over the shard with the geometric augmenters only
+            total = None
+            count = 0
+            for item in inner._items:
+                img = imdecode(item[0]) if isinstance(
+                    item[0], (bytes, bytearray)) else imread(item[0])
+                for aug in inner.auglist:
+                    img = aug(img)
+                arr = img.asnumpy().astype(_np.float64)
+                total = arr if total is None else total + arr
+                count += 1
+            mean_arr = (total / max(count, 1)).astype(_np.float32)
+            nd.save(mean_img, {"mean_img": nd.array(mean_arr)})
+
+        class _MeanImageAug(Augmenter):
+            def __init__(self, m):
+                super().__init__()
+                self._m = nd.array(mean_arr)
+
+            def __call__(self, src):
+                return src.astype("float32") - self._m
+
+        inner.auglist = list(inner.auglist) + [_MeanImageAug(mean_arr)]
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._prefetch, name)
+
+    def __iter__(self):
+        return self._prefetch.__iter__()
+
+    def __next__(self):
+        return self._prefetch.__next__()

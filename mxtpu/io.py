@@ -505,7 +505,7 @@ class MNISTIter(DataIter):
 def ImageRecordIter(**kwargs):
     """RecordIO image pipeline (reference iter_image_recordio_2.cc:727);
     implemented in mxtpu.image over mxtpu.recordio."""
-    from .image.iterators import ImageRecordIterImpl
+    from .image import ImageRecordIterImpl
     return ImageRecordIterImpl(**kwargs)
 
 

@@ -1,0 +1,150 @@
+"""contrib.text + ImageRecordIter tests (reference
+tests/python/unittest/test_contrib_text.py and the iterator checks in
+test_io.py)."""
+import collections
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, recordio
+from mxtpu.contrib import text
+
+
+def test_vocabulary():
+    c = text.utils.count_tokens_from_str("a b b c c c\nd d d d")
+    v = text.vocab.Vocabulary(c, min_freq=2, reserved_tokens=["<pad>"])
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "<pad>"
+    # frequency order: d(4), c(3), b(2); a dropped (freq 1 < min_freq 2)
+    assert v.idx_to_token[2:] == ["d", "c", "b"]
+    assert v.to_indices(["d", "nope"]) == [2, 0]
+    assert v.to_tokens([0, 2]) == ["<unk>", "d"]
+    assert len(v) == 5
+
+
+def test_vocabulary_most_freq_count():
+    c = collections.Counter({"a": 5, "b": 4, "c": 3, "d": 2})
+    v = text.vocab.Vocabulary(c, most_freq_count=2)
+    assert len(v) == 3  # unk + 2
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    path = str(tmp_path / "emb.txt")
+    with open(path, "w") as f:
+        for t, vec in [("hello", [1, 2]), ("world", [3, 4])]:
+            f.write("%s %s\n" % (t, " ".join(map(str, vec))))
+    emb = text.embedding.create("customembedding",
+                                pretrained_file_path=path)
+    assert emb.vec_len == 2
+    np.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("world").asnumpy(), [3, 4])
+    np.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("unknown-token").asnumpy(), [0, 0])
+    emb.update_token_vectors("hello", nd.array(np.array([[9., 9.]])))
+    np.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9])
+    v = text.vocab.Vocabulary(collections.Counter(["hello", "world"]))
+    comp = text.embedding.CompositeEmbedding(v, [emb, emb])
+    assert comp.idx_to_vec.shape == (3, 4)
+
+
+def _write_rec(tmp_path, n=6, size=20):
+    pytest.importorskip("PIL")
+    from PIL import Image
+    import io as _io
+    prefix = str(tmp_path / "imgs")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        arr = np.full((size, size, 3), i * 40, np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 2), i, 0), buf.getvalue()))
+    w.close()
+    return prefix + ".rec"
+
+
+def test_image_record_iter(tmp_path):
+    rec = _write_rec(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16),
+                               batch_size=2, shuffle=True,
+                               rand_mirror=True, mean_r=10.0)
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.data[0].shape == (2, 3, 16, 16)
+        assert b.label[0].shape == (2,)
+
+
+def test_image_record_iter_sharded(tmp_path):
+    rec = _write_rec(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16),
+                               batch_size=1, part_index=1, num_parts=3)
+    assert len(list(it)) == 2  # 6 records / 3 parts
+
+
+def test_image_iter_from_list(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image
+    root = tmp_path / "imgs"
+    root.mkdir()
+    entries = []
+    for i in range(4):
+        p = root / ("img%d.png" % i)
+        Image.fromarray(np.full((18, 18, 3), i * 30, np.uint8)).save(p)
+        entries.append((float(i), "img%d.png" % i))
+    it = mx.image.ImageIter(2, (3, 12, 12), imglist=entries,
+                            path_root=str(root))
+    b = next(iter(it))
+    assert b.data[0].shape == (2, 3, 12, 12)
+
+
+def test_vocab_most_freq_count_zero():
+    c = collections.Counter({"a": 5, "b": 4})
+    v = text.vocab.Vocabulary(c, most_freq_count=0)
+    assert len(v) == 1  # only <unk>
+
+
+def test_embedding_vocab_alignment(tmp_path):
+    path = str(tmp_path / "emb2.txt")
+    with open(path, "w") as f:
+        f.write("x 1 1\ny 2 2\nz 3 3\n")
+    v = text.vocab.Vocabulary(collections.Counter({"z": 3, "x": 1}))
+    emb = text.embedding.CustomEmbedding(path, vocabulary=v)
+    assert emb.idx_to_token == v.idx_to_token
+    np.testing.assert_array_equal(
+        emb.idx_to_vec.asnumpy()[v.to_indices("z")], [3, 3])
+    np.testing.assert_array_equal(
+        emb.idx_to_vec.asnumpy()[v.to_indices("x")], [1, 1])
+
+
+def test_update_token_vectors_validates_length(tmp_path):
+    path = str(tmp_path / "emb3.txt")
+    with open(path, "w") as f:
+        f.write("a 1 1\nb 2 2\n")
+    emb = text.embedding.CustomEmbedding(path)
+    with pytest.raises(ValueError):
+        emb.update_token_vectors(["a", "b"], nd.array(np.ones((1, 2))))
+
+
+def test_count_tokens_regex_delim():
+    c = text.utils.count_tokens_from_str("a]b]c", token_delim="]")
+    assert c == collections.Counter({"a": 1, "b": 1, "c": 1})
+
+
+def test_image_record_iter_mean_img(tmp_path):
+    rec = _write_rec(tmp_path)
+    mean_path = str(tmp_path / "mean.bin")
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 16, 16),
+                               batch_size=2, mean_img=mean_path)
+    b = next(iter(it))
+    assert os.path.exists(mean_path)
+    # mean-subtracted data is centered around 0 over the dataset
+    all_vals = []
+    all_vals.append(b.data[0].asnumpy())
+    for b2 in it:
+        all_vals.append(b2.data[0].asnumpy())
+    m = np.concatenate(all_vals).mean()
+    assert abs(m) < 2.0
