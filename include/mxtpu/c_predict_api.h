@@ -1,0 +1,79 @@
+/*
+ * mxtpu C predict API — flat C ABI for inference from any language.
+ *
+ * Capability parity with the reference include/mxnet/c_predict_api.h (250
+ * lines; impl src/c_api/c_predict_api.cc:461): load a symbol JSON + a
+ * params blob, bind inputs, forward, read outputs. This is the surface the
+ * reference's Scala/R/Perl/C++ bindings and the amalgamation mobile
+ * runtime build on.
+ *
+ * Implementation: libmxtpu_predict.so embeds CPython and drives the mxtpu
+ * executor (XLA compiles the graph on first forward). Link with
+ * `-lmxtpu_predict` (see mxtpu/_native/Makefile).
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+#include <stddef.h>
+
+typedef float mx_float;
+typedef unsigned int mx_uint;
+typedef void *PredictorHandle;
+
+/* Returns a thread-local message for the last failed call. */
+const char *MXGetLastError(void);
+
+/*
+ * Create a predictor.
+ *  symbol_json_str    : symbol graph JSON (contents of *-symbol.json)
+ *  param_bytes/size   : contents of a *.params file
+ *  dev_type           : 1 = cpu, 2 = gpu, 6 = tpu (any accelerator)
+ *  dev_id             : device ordinal
+ *  num_input_nodes    : number of input arrays
+ *  input_keys         : input names (e.g. {"data"})
+ *  input_shape_indptr : CSR-style offsets into input_shape_data,
+ *                       length num_input_nodes+1
+ *  input_shape_data   : concatenated input shapes
+ * Returns 0 on success, -1 on failure (see MXGetLastError).
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/* Copy input data (row-major float32) into the named input. */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/* Run the forward pass. */
+int MXPredForward(PredictorHandle handle);
+
+/* Shape of output `index`: *shape_data points at handle-owned memory valid
+ * until the next call on this handle. */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/* Copy output `index` into caller-provided buffer (float32, row-major). */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+/* Reshape the predictor for new input shapes (re-specializes the jit). */
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out);
+
+/* Free the predictor. */
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_PREDICT_API_H_ */

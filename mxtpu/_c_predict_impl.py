@@ -1,0 +1,90 @@
+"""Python side of the C predict API (called from c_predict_api.cc via the
+embedded interpreter). Keeps the C++ layer to pure marshalling.
+
+Reference counterpart: src/c_api/c_predict_api.cc builds a static
+GraphExecutor from symbol JSON + params; here the executor's whole graph
+jits through XLA on the first forward.
+"""
+from __future__ import annotations
+
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+class _Predictor:
+    def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
+                 input_shapes):
+        sym = mx.sym.load_json(symbol_json)
+        # strip a trailing loss head for inference outputs, like the
+        # reference predictor keeps the net's top as-is
+        ctx = {1: mx.cpu, 2: mx.gpu, 6: mx.tpu}.get(dev_type, mx.cpu)(dev_id)
+        payload = nd.load_from_bytes(param_bytes) if param_bytes else {}
+        arg_params, aux_params = {}, {}
+        for k, v in payload.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        self._input_names = list(input_shapes.keys())
+        shape_kwargs = {k: tuple(v) for k, v in input_shapes.items()}
+        self._exe = sym.simple_bind(ctx, grad_req="null", **shape_kwargs)
+        self._exe.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=True)
+        self._param_names = set(arg_params) | set(aux_params)
+        self._sym = sym
+        self._ctx = ctx
+        self._outputs = None
+
+    def set_input(self, key, flat):
+        arr = self._exe.arg_dict[key]
+        data = np.asarray(flat, np.float32).reshape(arr.shape)
+        arr._data = nd.array(data)._data
+
+    def forward(self):
+        self._outputs = self._exe.forward(is_train=False)
+
+    def output_shape(self, index):
+        return list(self._outputs[index].shape)
+
+    def output(self, index):
+        return np.ascontiguousarray(
+            self._outputs[index].asnumpy().astype(np.float32)).ravel()
+
+
+def create(symbol_json, param_bytes, dev_type, dev_id, keys, shapes):
+    input_shapes = {k: tuple(int(d) for d in s)
+                    for k, s in zip(keys, shapes)}
+    return _Predictor(symbol_json, bytes(param_bytes), dev_type, dev_id,
+                      input_shapes)
+
+
+def reshape(pred, keys, shapes):
+    """Re-bind an existing predictor for new input shapes, carrying the
+    trained parameter values over (reference MXPredReshape)."""
+    shape_kwargs = {k: tuple(int(d) for d in s)
+                    for k, s in zip(keys, shapes)}
+    new_exe = pred._exe.reshape(**shape_kwargs)
+    # reject reshapes that would alter (and thus zero out) LOADED
+    # parameters (reference MXPredReshape); inputs and batch-dependent
+    # vars like labels may change freely
+    for name, arr in new_exe.arg_dict.items():
+        if name in shape_kwargs or name not in pred._param_names:
+            continue
+        old = pred._exe.arg_dict.get(name)
+        if old is not None and old.shape != arr.shape:
+            raise ValueError(
+                "reshape would change parameter %r from %s to %s; only "
+                "input shapes may change" % (name, old.shape, arr.shape))
+    p = object.__new__(_Predictor)
+    p._input_names = list(shape_kwargs)
+    p._param_names = set(pred._param_names)
+    p._sym = pred._sym
+    p._ctx = pred._ctx
+    p._exe = new_exe
+    p._outputs = None
+    return p

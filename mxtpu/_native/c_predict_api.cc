@@ -1,0 +1,254 @@
+// C predict API implementation: embeds CPython and drives mxtpu.
+//
+// Reference counterpart: src/c_api/c_predict_api.cc (461 LoC) — there it
+// builds a static GraphExecutor over the C++ runtime; here the flat C ABI
+// marshals into the mxtpu executor whose graph XLA compiles. The ABI in
+// include/mxtpu/c_predict_api.h matches the reference's surface so
+// bindings/mobile runtimes port directly.
+//
+// Build: make -C mxtpu/_native libmxtpu_predict.so
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../../include/mxtpu/c_predict_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      g_last_error = msg ? msg : "(unprintable python error)";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Predictor {
+  PyObject *obj = nullptr;           // _c_predict_impl._Predictor
+  std::vector<mx_uint> shape_buf;    // owned output-shape storage
+};
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+bool ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization so GIL guards work
+      PyEval_SaveThread();
+    }
+  });
+  return true;
+}
+
+PyObject *impl_module() {
+  static PyObject *mod = nullptr;
+  if (!mod) {
+    mod = PyImport_ImportModule("mxtpu._c_predict_impl");
+  }
+  return mod;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *mod = impl_module();
+  if (!mod) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shape = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyList_SetItem(shape, j - lo, PyLong_FromUnsignedLong(
+          input_shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shape);
+  }
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *res = PyObject_CallMethod(
+      mod, "create", "sOiiOO",
+      symbol_json_str, params, dev_type, dev_id, keys, shapes);
+  Py_DECREF(params);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  auto *p = new Predictor();
+  p->obj = res;
+  *out = p;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  GIL gil;
+  auto *p = static_cast<Predictor *>(handle);
+  PyObject *mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float), PyBUF_READ);
+  PyObject *np = PyImport_ImportModule("numpy");
+  PyObject *arr = PyObject_CallMethod(np, "frombuffer", "Os", mem,
+                                      "float32");
+  Py_DECREF(np);
+  Py_DECREF(mem);
+  if (!arr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *res = PyObject_CallMethod(p->obj, "set_input", "sO", key, arr);
+  Py_DECREF(arr);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  GIL gil;
+  auto *p = static_cast<Predictor *>(handle);
+  PyObject *res = PyObject_CallMethod(p->obj, "forward", nullptr);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  GIL gil;
+  auto *p = static_cast<Predictor *>(handle);
+  PyObject *res = PyObject_CallMethod(p->obj, "output_shape", "I", index);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(res);
+  p->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    p->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyList_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  *shape_data = p->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  GIL gil;
+  auto *p = static_cast<Predictor *>(handle);
+  PyObject *res = PyObject_CallMethod(p->obj, "output", "I", index);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *bytes = PyObject_CallMethod(res, "tobytes", nullptr);
+  Py_DECREF(res);
+  if (!bytes) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t nbytes = PyBytes_Size(bytes);
+  Py_ssize_t want = static_cast<Py_ssize_t>(size) * sizeof(mx_float);
+  if (nbytes != want) {
+    g_last_error = "output size mismatch: caller buffer holds " +
+        std::to_string(size) + " floats, output has " +
+        std::to_string(nbytes / sizeof(mx_float));
+    Py_DECREF(bytes);
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), want);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  GIL gil;
+  auto *p = static_cast<Predictor *>(handle);
+  PyObject *mod = impl_module();
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shape = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyList_SetItem(shape, j - lo, PyLong_FromUnsignedLong(
+          input_shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shape);
+  }
+  PyObject *res = PyObject_CallMethod(mod, "reshape", "OOO", p->obj, keys,
+                                      shapes);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  auto *np = new Predictor();
+  np->obj = res;
+  *out = np;
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  GIL gil;
+  auto *p = static_cast<Predictor *>(handle);
+  Py_XDECREF(p->obj);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

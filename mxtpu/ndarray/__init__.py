@@ -552,3 +552,18 @@ class _ContribNamespace:
 contrib = _ContribNamespace(
     lambda op: (lambda *a, **k: _call_op(op, a, k)))
 __all__ += ["contrib"]
+
+
+def load_from_bytes(buf):
+    """Load NDArrays from an in-memory save() blob (used by the C predict
+    API, reference MXNDArrayLoadFromBuffer)."""
+    import io as _io
+    with _np.load(_io.BytesIO(bytes(buf)), allow_pickle=False) as z:
+        out = _load_entries(z)
+        if out and all(k.startswith("__arr_") for k in out):
+            return [out[k] for k in
+                    sorted(out, key=lambda k: int(k.split("_")[-1]))]
+        return out
+
+
+__all__ += ["load_from_bytes"]

@@ -1,0 +1,146 @@
+"""C predict API tests: drive the flat C ABI (libmxtpu_predict.so) via
+ctypes and via a freshly compiled pure-C program, comparing against the
+Python Module.predict path (reference tests exercise c_predict_api through
+the amalgamation/cpp-package).
+"""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+_NATIVE = os.path.join(os.path.dirname(__file__), "..", "mxtpu", "_native")
+_SO = os.path.join(_NATIVE, "libmxtpu_predict.so")
+
+
+def _export_model(tmp_path):
+    mx.random.seed(0)
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    out = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 5).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.float32)
+    mod.fit(mx.io.NDArrayIter(x, y, batch_size=8), num_epoch=1,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    probe = np.arange(10, dtype=np.float32).reshape(2, 5) / 10.0
+    sym2, arg, aux = mx.model.load_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module(out, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (2, 5))], for_training=False)
+    mod2.set_params(arg, aux)
+    expect = mod2.predict(
+        mx.io.NDArrayIter(probe, None, batch_size=2)).asnumpy()
+    return prefix, probe, expect
+
+
+@pytest.mark.skipif(not os.path.exists(_SO),
+                    reason="libmxtpu_predict.so not built")
+def test_c_predict_ctypes(tmp_path):
+    prefix, probe, expect = _export_model(tmp_path)
+    lib = ctypes.CDLL(_SO)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    json_data = open(prefix + "-symbol.json", "rb").read()
+    params = open(prefix + "-0001.params", "rb").read()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shape = (ctypes.c_uint * 2)(2, 5)
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreate(json_data, params, len(params), 1, 0, 1, keys,
+                          indptr, shape, ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError()
+    flat = probe.ravel().astype(np.float32)
+    buf = (ctypes.c_float * flat.size)(*flat)
+    assert lib.MXPredSetInput(handle, b"data", buf, flat.size) == 0
+    assert lib.MXPredForward(handle) == 0
+    sdata = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    assert lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                    ctypes.byref(ndim)) == 0
+    oshape = tuple(sdata[i] for i in range(ndim.value))
+    assert oshape == (2, 3)
+    out = (ctypes.c_float * 6)()
+    assert lib.MXPredGetOutput(handle, 0, out, 6) == 0
+    got = np.asarray(out[:6], np.float32).reshape(2, 3)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    # reshape path: new batch size
+    indptr2 = (ctypes.c_uint * 2)(0, 2)
+    shape2 = (ctypes.c_uint * 2)(4, 5)
+    h2 = ctypes.c_void_p()
+    assert lib.MXPredReshape(1, keys, indptr2, shape2, handle,
+                             ctypes.byref(h2)) == 0, lib.MXGetLastError()
+    probe4 = np.tile(probe, (2, 1)).astype(np.float32)
+    buf4 = (ctypes.c_float * 20)(*probe4.ravel())
+    assert lib.MXPredSetInput(h2, b"data", buf4, 20) == 0
+    assert lib.MXPredForward(h2) == 0
+    out4 = (ctypes.c_float * 12)()
+    assert lib.MXPredGetOutput(h2, 0, out4, 12) == 0
+    got4 = np.asarray(out4[:12], np.float32).reshape(4, 3)
+    np.testing.assert_allclose(got4[:2], expect, rtol=1e-5, atol=1e-6)
+    lib.MXPredFree(handle)
+    lib.MXPredFree(h2)
+
+
+_C_PROGRAM = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include "mxtpu/c_predict_api.h"
+static char *rf(const char *p, long *n) {
+  FILE *f = fopen(p, "rb"); fseek(f, 0, SEEK_END); *n = ftell(f);
+  fseek(f, 0, SEEK_SET); char *b = malloc(*n + 1);
+  fread(b, 1, *n, f); b[*n] = 0; fclose(f); return b;
+}
+int main(int argc, char **argv) {
+  long js, ps;
+  char *j = rf(argv[1], &js), *p = rf(argv[2], &ps);
+  const char *keys[] = {"data"};
+  mx_uint ip[] = {0, 2}, sh[] = {2, 5};
+  PredictorHandle h = NULL;
+  if (MXPredCreate(j, p, (int)ps, 1, 0, 1, keys, ip, sh, &h)) {
+    fprintf(stderr, "%s\n", MXGetLastError()); return 1; }
+  mx_float in[10];
+  for (int i = 0; i < 10; ++i) in[i] = i / 10.0f;
+  if (MXPredSetInput(h, "data", in, 10) || MXPredForward(h)) return 1;
+  mx_float out[6];
+  if (MXPredGetOutput(h, 0, out, 6)) return 1;
+  for (int i = 0; i < 6; ++i) printf("%.6f ", out[i]);
+  MXPredFree(h);
+  return 0;
+}
+"""
+
+
+@pytest.mark.skipif(not os.path.exists(_SO),
+                    reason="libmxtpu_predict.so not built")
+def test_c_predict_from_pure_c_program(tmp_path):
+    import shutil
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    prefix, probe, expect = _export_model(tmp_path)
+    src = tmp_path / "t.c"
+    src.write_text(_C_PROGRAM)
+    exe = str(tmp_path / "t")
+    inc = os.path.join(os.path.dirname(__file__), "..", "include")
+    subprocess.run(["gcc", "-O1", str(src), "-I", inc, "-L", _NATIVE,
+                    "-lmxtpu_predict", "-o", exe,
+                    "-Wl,-rpath," + os.path.abspath(_NATIVE)], check=True)
+    env = dict(os.environ,
+               PYTHONPATH=os.path.abspath(
+                   os.path.join(os.path.dirname(__file__), "..")),
+               JAX_PLATFORMS="cpu")
+    res = subprocess.run([exe, prefix + "-symbol.json",
+                          prefix + "-0001.params"], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert res.returncode == 0, res.stderr
+    got = np.asarray([float(v) for v in res.stdout.split()],
+                     np.float32).reshape(2, 3)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
