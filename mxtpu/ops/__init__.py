@@ -18,3 +18,14 @@ from . import linalg_ops     # noqa: F401
 from . import rnn            # noqa: F401
 from . import vision         # noqa: F401
 from . import contrib_ops    # noqa: F401
+
+
+@register("_contrib_flash_attention", aliases=("flash_attention",))
+def _flash_attention_op(q, k, v, causal=False, scale=None, q_offset=0,
+                        k_offset=0, block_q=512, block_k=1024):
+    """Pallas flash attention (see ops/pallas_attention.py). Lazy import:
+    pallas/mosaic cost ~2s, which `import mxtpu` must not pay."""
+    from .pallas_attention import flash_attention
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           q_offset=q_offset, k_offset=k_offset,
+                           block_q=block_q, block_k=block_k)

@@ -35,11 +35,21 @@ __all__ = ["ring_attention", "ring_attention_sharded", "ulysses_attention",
 
 
 def local_attention(q, k, v, causal=False, scale=None, q_offset=0,
-                    k_offset=0):
-    """Plain softmax attention on local shards. q,k,v: [B, H, T, D].
+                    k_offset=0, impl="auto"):
+    """Softmax attention on local shards. q,k,v: [B, H, T, D].
 
     ``q_offset``/``k_offset`` give the global positions of the local rows
-    for causal masking under sequence sharding."""
+    for causal masking under sequence sharding. ``impl``: "flash" lowers
+    to the Pallas flash-attention kernels (ops/pallas_attention.py),
+    "xla" is the plain einsum+softmax path, "auto" picks flash on TPU
+    for sequences long enough to tile."""
+    if impl == "auto":
+        impl = ("flash" if jax.default_backend() == "tpu"
+                and q.shape[2] >= 128 and k.shape[2] >= 128 else "xla")
+    if impl == "flash":
+        from ..ops.pallas_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               q_offset=q_offset, k_offset=k_offset)
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
@@ -53,17 +63,29 @@ def local_attention(q, k, v, causal=False, scale=None, q_offset=0,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def ring_attention(q, k, v, axis_name=AXIS_SEQ, causal=False, scale=None):
+def ring_attention(q, k, v, axis_name=AXIS_SEQ, causal=False, scale=None,
+                   impl="auto"):
     """Ring attention over a shard_map axis. q,k,v: local [B, H, T/n, D].
 
     Must run inside shard_map (or pmap) with ``axis_name`` bound. Each of
     the n ring steps attends Q_local against one rotating K/V block with a
     numerically-stable online softmax, then ppermutes K/V to the next
     neighbour — the all-gather-free formulation (Liu et al., Ring
-    Attention; blockwise parallel transformers)."""
+    Attention; blockwise parallel transformers).
+
+    ``impl="flash"`` computes each ring step with the Pallas flash
+    kernels (ops/pallas_attention.py): per-step (out, lse) pairs merge
+    online via logaddexp, so the whole ring is one flash pass per K/V
+    block — "auto" picks flash on TPU for local shards >= 128 rows."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, h, t, d = q.shape
+    if impl == "auto":
+        impl = ("flash" if jax.default_backend() == "tpu" and t >= 128
+                else "xla")
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale,
+                                     n, my)
     if scale is None:
         scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     q32 = q.astype(jnp.float32)
@@ -102,8 +124,40 @@ def ring_attention(q, k, v, axis_name=AXIS_SEQ, causal=False, scale=None):
     return (o / l[..., None]).astype(q.dtype)
 
 
+def _ring_attention_flash(q, k, v, axis_name, causal, scale, n, my):
+    """Ring steps as Pallas flash-attention calls merged via lse.
+
+    Each step yields a normalized partial (o_b, lse_b) for the K/V block
+    currently held; disjoint-key partials combine exactly with
+    lse' = logaddexp(lse, lse_b), o' = o*e^(lse-lse') + o_b*e^(lse_b-lse').
+    Fully-masked partials carry lse_b = -1e30 and drop out of the merge."""
+    from ..ops.pallas_attention import flash_attention_with_lse, _NEG
+
+    b, h, t, d = q.shape
+
+    def step(i, carry):
+        o, lse, kk, vv = carry
+        src = (my - i) % n          # whose K/V block we now hold
+        o_b, lse_b = flash_attention_with_lse(
+            q, kk, vv, causal=causal, scale=scale,
+            q_offset=my * t, k_offset=src * t)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + o_b.astype(jnp.float32) * jnp.exp(lse_b - lse_new)[..., None])
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return o, lse_new, kk, vv
+
+    o = jnp.zeros((b, h, t, d), jnp.float32)
+    lse = jnp.full((b, h, t), _NEG, jnp.float32)
+    o, _, _, _ = lax.fori_loop(0, n, step, (o, lse, k, v))
+    return o.astype(q.dtype)
+
+
 def ring_attention_sharded(q, k, v, mesh, causal=False,
-                           data_axis=AXIS_DATA, seq_axis=AXIS_SEQ):
+                           data_axis=AXIS_DATA, seq_axis=AXIS_SEQ,
+                           impl="auto"):
     """shard_map-bound ring attention over a MeshContext.
 
     q,k,v: global [B, H, T, D]; B sharded over ``data``, T over ``seq``.
@@ -113,9 +167,10 @@ def ring_attention_sharded(q, k, v, mesh, causal=False,
     spec = P(data_axis if data_axis in mesh.axis_names else None, None,
              seq_axis if seq_axis in mesh.axis_names else None, None)
     if seq_axis not in mesh.axis_names:
-        return local_attention(q, k, v, causal=causal)
+        return local_attention(q, k, v, causal=causal, impl=impl)
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                          impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
