@@ -49,7 +49,11 @@ class _Predictor:
         self._outputs = self._exe.forward(is_train=False)
 
     def output_shape(self, index):
-        return list(self._outputs[index].shape)
+        # usable right after create, before any forward (reference
+        # MXPredCreate infers output shapes at bind: c_predict_api.cc)
+        if self._outputs is not None:
+            return list(self._outputs[index].shape)
+        return list(self._exe.output_shapes[index])
 
     def output(self, index):
         return np.ascontiguousarray(
@@ -68,18 +72,24 @@ def reshape(pred, keys, shapes):
     trained parameter values over (reference MXPredReshape)."""
     shape_kwargs = {k: tuple(int(d) for d in s)
                     for k, s in zip(keys, shapes)}
-    new_exe = pred._exe.reshape(**shape_kwargs)
+    # weights share storage with the parent (reference MXPredReshape keeps
+    # trained values); inputs get independent copies so set_input on one
+    # predictor cannot overwrite the other's data
+    new_exe = pred._exe.reshape(shared_args=pred._param_names,
+                                **shape_kwargs)
     # reject reshapes that would alter (and thus zero out) LOADED
     # parameters (reference MXPredReshape); inputs and batch-dependent
     # vars like labels may change freely
-    for name, arr in new_exe.arg_dict.items():
-        if name in shape_kwargs or name not in pred._param_names:
-            continue
-        old = pred._exe.arg_dict.get(name)
-        if old is not None and old.shape != arr.shape:
-            raise ValueError(
-                "reshape would change parameter %r from %s to %s; only "
-                "input shapes may change" % (name, old.shape, arr.shape))
+    for old_dict, new_dict in ((pred._exe.arg_dict, new_exe.arg_dict),
+                               (pred._exe.aux_dict, new_exe.aux_dict)):
+        for name, arr in new_dict.items():
+            if name in shape_kwargs or name not in pred._param_names:
+                continue
+            old = old_dict.get(name)
+            if old is not None and old.shape != arr.shape:
+                raise ValueError(
+                    "reshape would change parameter %r from %s to %s; only "
+                    "input shapes may change" % (name, old.shape, arr.shape))
     p = object.__new__(_Predictor)
     p._input_names = list(shape_kwargs)
     p._param_names = set(pred._param_names)

@@ -44,6 +44,7 @@ class Executor:
         self.grad_arrays = [grad_dict.get(n) for n in self._arg_names]
         self.aux_arrays = [aux_dict[n] for n in self._aux_names]
         self._outputs = None
+        self._out_shapes = None
         self._key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
         self._monitor_callback = None
 
@@ -88,7 +89,6 @@ class Executor:
     def _simple_bind(sym, ctx, grad_req, type_dict, shape_kwargs):
         arg_names = sym.list_arguments()
         aux_names = sym.list_auxiliary_states()
-        shapes, out_shapes, _ = None, None, None
         arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**shape_kwargs)
         type_dict = type_dict or {}
         arg_dict, grad_dict = {}, {}
@@ -105,7 +105,9 @@ class Executor:
             if shape is None:
                 raise ValueError("could not infer shape for aux state %r" % name)
             aux_dict[name] = nd.zeros(shape, ctx=ctx)
-        return Executor(sym, ctx, arg_dict, grad_dict, req_dict, aux_dict)
+        exe = Executor(sym, ctx, arg_dict, grad_dict, req_dict, aux_dict)
+        exe._out_shapes = [tuple(s) for s in out_shapes]
+        return exe
 
     @staticmethod
     def _bind(sym, ctx, args, args_grad, grad_req, aux_states):
@@ -184,6 +186,17 @@ class Executor:
         return self._outputs if self._outputs is not None else []
 
     @property
+    def output_shapes(self):
+        """Inferred output shapes, available before any forward (the
+        reference computes these at SimpleBind: graph_executor.cc:512)."""
+        if self._out_shapes is None:
+            shape_kwargs = {n: tuple(a.shape)
+                            for n, a in self.arg_dict.items()}
+            _, outs, _ = self._symbol.infer_shape(**shape_kwargs)
+            self._out_shapes = [tuple(s) for s in outs]
+        return self._out_shapes
+
+    @property
     def output_dict(self):
         return dict(zip(self._symbol.list_outputs(), self.outputs))
 
@@ -202,25 +215,39 @@ class Executor:
                 elif not allow_extra_params:
                     raise ValueError("unknown aux state %r" % k)
 
-    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
-        """Re-bind with new shapes (cheap: jit re-specialises per shape)."""
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                shared_args=None, **kwargs):
+        """Re-bind with new shapes (cheap: jit re-specialises per shape).
+
+        ``shared_args``: names whose NDArray objects may be shared with
+        this executor when the shape is unchanged (None = all, matching
+        the reference's memory-sharing reshape). Names outside the set
+        get value-preserving copies so in-place writes on one executor
+        cannot leak into the other."""
         new_shapes = dict(kwargs)
-        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**new_shapes)
+        arg_shapes, out_shapes, aux_shapes = \
+            self._symbol.infer_shape(**new_shapes)
+        share_ok = ((lambda n: True) if shared_args is None
+                    else set(shared_args).__contains__)
         arg_dict = {}
         for n, s in zip(self._arg_names, arg_shapes):
             old = self.arg_dict[n]
             if tuple(old.shape) == tuple(s):
-                arg_dict[n] = old
+                arg_dict[n] = old if share_ok(n) else old.copy()
             else:
                 arg_dict[n] = nd.zeros(s, ctx=self._ctx, dtype=old.dtype)
         grad_dict = {n: nd.zeros_like(arg_dict[n]) for n in self.grad_dict}
         aux_dict = {}
         for n, s in zip(self._aux_names, aux_shapes):
             old = self.aux_dict[n]
-            aux_dict[n] = old if tuple(old.shape) == tuple(s) \
-                else nd.zeros(s, ctx=self._ctx)
-        return Executor(self._symbol, self._ctx, arg_dict, grad_dict,
-                        self._grad_req, aux_dict)
+            if tuple(old.shape) == tuple(s):
+                aux_dict[n] = old if share_ok(n) else old.copy()
+            else:
+                aux_dict[n] = nd.zeros(s, ctx=self._ctx)
+        new_exe = Executor(self._symbol, self._ctx, arg_dict, grad_dict,
+                           self._grad_req, aux_dict)
+        new_exe._out_shapes = [tuple(s) for s in out_shapes]
+        return new_exe
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback

@@ -509,16 +509,21 @@ def save(fname, data):
         _np.savez(f, **payload)
 
 
+def _unpack_loaded(z):
+    """dict-vs-list result convention shared by load/load_from_bytes."""
+    out = _load_entries(z)
+    if out and all(k.startswith("__arr_") for k in out):
+        return [out[k] for k in
+                sorted(out, key=lambda k: int(k.split("_")[-1]))]
+    return out
+
+
 def load(fname):
     """Load NDArrays saved by ``save``. Returns dict or list matching input."""
     import os
     path = fname if os.path.exists(fname) else fname + ".npz"
     with _np.load(path, allow_pickle=False) as z:
-        out = _load_entries(z)
-        if out and all(k.startswith("__arr_") for k in out):
-            return [out[k] for k in
-                    sorted(out, key=lambda k: int(k.split("_")[-1]))]
-        return out
+        return _unpack_loaded(z)
 
 
 def imports(*a, **k):
@@ -559,11 +564,7 @@ def load_from_bytes(buf):
     API, reference MXNDArrayLoadFromBuffer)."""
     import io as _io
     with _np.load(_io.BytesIO(bytes(buf)), allow_pickle=False) as z:
-        out = _load_entries(z)
-        if out and all(k.startswith("__arr_") for k in out):
-            return [out[k] for k in
-                    sorted(out, key=lambda k: int(k.split("_")[-1]))]
-        return out
+        return _unpack_loaded(z)
 
 
 __all__ += ["load_from_bytes"]

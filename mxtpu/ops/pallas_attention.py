@@ -328,29 +328,17 @@ def _flash_with_lse(q, k, v, offs, causal, block_q, block_k):
 _flash_with_lse.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, offs, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, offs, causal, block_q, block_k)[0][0]
-
-
-def _flash_o_fwd(q, k, v, offs, causal, block_q, block_k):
-    (o, _), res = _flash_fwd(q, k, v, offs, causal, block_q, block_k)
-    return o, res
-
-
-def _flash_o_bwd(causal, block_q, block_k, res, do):
-    lse = res[5]
-    return _flash_bwd(causal, block_q, block_k, res,
-                      (do, jnp.zeros(lse.shape, jnp.float32)))
-
-
-_flash.defvjp(_flash_o_fwd, _flash_o_bwd)
-
-
 def _prep(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k):
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / float(d) ** 0.5
+    if not isinstance(scale, (int, float)):
+        # Traced scale: fold it into Q (s = (q*scale)·k) so its gradient
+        # flows through ordinary AD of the multiply — the custom VJP
+        # returns zeros for the offs operand, which would otherwise
+        # silently drop d(loss)/d(scale).
+        q = q * jnp.asarray(scale).astype(q.dtype)
+        scale = 1.0
 
     def blk(req, t):  # round up to the 8-sublane tile multiple
         return int(min(req, -(-max(t, 1) // 8) * 8))
@@ -362,7 +350,7 @@ def _prep(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k):
                       jnp.asarray(k_offset, jnp.float32),
                       jnp.asarray(tk, jnp.float32),
                       jnp.asarray(scale, jnp.float32)])
-    return offs, bool(causal), block_q, block_k
+    return q, offs, bool(causal), block_q, block_k
 
 
 def flash_attention(q, k, v, causal=False, scale=None, q_offset=0,
@@ -376,10 +364,12 @@ def flash_attention(q, k, v, causal=False, scale=None, q_offset=0,
     (custom VJP, flash-attention-2 style recompute backward); one HBM
     pass per tensor per kernel. Block defaults tuned on v5e.
     """
-    offs, causal, block_q, block_k = _prep(q, k, v, causal, scale,
-                                           q_offset, k_offset,
-                                           block_q, block_k)
-    return _flash(q, k, v, offs, causal, block_q, block_k)
+    q, offs, causal, block_q, block_k = _prep(q, k, v, causal, scale,
+                                              q_offset, k_offset,
+                                              block_q, block_k)
+    # dropping lse via [0] makes AD deliver a zero dlse cotangent — no
+    # separate VJP wrapper needed, and the kernel computes lse anyway
+    return _flash_with_lse(q, k, v, offs, causal, block_q, block_k)[0]
 
 
 def flash_attention_with_lse(q, k, v, causal=False, scale=None, q_offset=0,
@@ -391,9 +381,9 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None, q_offset=0,
     + o2*exp(lse2 - lse')`` — the merge rule ring attention
     (impl="flash") applies across ppermute steps. Both outputs are
     differentiable."""
-    offs, causal, block_q, block_k = _prep(q, k, v, causal, scale,
-                                           q_offset, k_offset,
-                                           block_q, block_k)
+    q, offs, causal, block_q, block_k = _prep(q, k, v, causal, scale,
+                                              q_offset, k_offset,
+                                              block_q, block_k)
     return _flash_with_lse(q, k, v, offs, causal, block_q, block_k)
 
 

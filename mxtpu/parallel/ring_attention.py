@@ -91,8 +91,7 @@ def ring_attention(q, k, v, axis_name=AXIS_SEQ, causal=False, scale=None,
     q32 = q.astype(jnp.float32)
     neg = jnp.finfo(jnp.float32).min
 
-    def step(i, carry):
-        o, m, l, kk, vv = carry
+    def absorb(i, o, m, l, kk, vv):
         src = (my - i) % n          # whose K/V block we now hold
         s = jnp.einsum("bhqd,bhkd->bhqk", q32,
                        kk.astype(jnp.float32)) * scale
@@ -111,15 +110,24 @@ def ring_attention(q, k, v, axis_name=AXIS_SEQ, causal=False, scale=None,
         l_new = l * corr + jnp.sum(p, axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, vv.astype(jnp.float32))
-        perm = [(j, (j + 1) % n) for j in range(n)]
+        return o_new, m_new, l_new
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        o, m, l, kk, vv = carry
+        o, m, l = absorb(i, o, m, l, kk, vv)
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
-        return o_new, m_new, l_new, kk, vv
+        return o, m, l, kk, vv
 
     o = jnp.zeros((b, h, t, d), jnp.float32)
     m = jnp.full((b, h, t), neg, jnp.float32)
     l = jnp.zeros((b, h, t), jnp.float32)
-    o, m, l, _, _ = lax.fori_loop(0, n, step, (o, m, l, k, v))
+    # permute only BETWEEN steps: the last block is absorbed outside the
+    # loop so no dead final K/V rotation rides the ICI
+    o, m, l, kk, vv = lax.fori_loop(0, n - 1, step, (o, m, l, k, v))
+    o, m, l = absorb(n - 1, o, m, l, kk, vv)
     l = jnp.where(l == 0.0, 1.0, l)
     return (o / l[..., None]).astype(q.dtype)
 
@@ -135,8 +143,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, n, my):
 
     b, h, t, d = q.shape
 
-    def step(i, carry):
-        o, lse, kk, vv = carry
+    def absorb(i, o, lse, kk, vv):
         src = (my - i) % n          # whose K/V block we now hold
         o_b, lse_b = flash_attention_with_lse(
             q, kk, vv, causal=causal, scale=scale,
@@ -144,14 +151,22 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, n, my):
         lse_new = jnp.logaddexp(lse, lse_b)
         o = (o * jnp.exp(lse - lse_new)[..., None]
              + o_b.astype(jnp.float32) * jnp.exp(lse_b - lse_new)[..., None])
-        perm = [(j, (j + 1) % n) for j in range(n)]
+        return o, lse_new
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        o, lse, kk, vv = carry
+        o, lse = absorb(i, o, lse, kk, vv)
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
-        return o, lse_new, kk, vv
+        return o, lse, kk, vv
 
     o = jnp.zeros((b, h, t, d), jnp.float32)
     lse = jnp.full((b, h, t), _NEG, jnp.float32)
-    o, _, _, _ = lax.fori_loop(0, n, step, (o, lse, k, v))
+    # last block absorbed outside the loop: no dead final K/V rotation
+    o, lse, kk, vv = lax.fori_loop(0, n - 1, step, (o, lse, k, v))
+    o, _ = absorb(n - 1, o, lse, kk, vv)
     return o.astype(q.dtype)
 
 

@@ -144,3 +144,46 @@ def test_c_predict_from_pure_c_program(tmp_path):
     got = np.asarray([float(v) for v in res.stdout.split()],
                      np.float32).reshape(2, 3)
     np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_predict_impl_output_shape_before_forward(tmp_path):
+    from mxtpu import _c_predict_impl as impl
+    prefix, probe, expect = _export_model(tmp_path)
+    json_data = open(prefix + "-symbol.json").read()
+    params = open(prefix + "-0001.params", "rb").read()
+    pred = impl.create(json_data, params, 1, 0, ["data"], [(2, 5)])
+    # reference MXPredCreate infers output shapes at bind time; clients
+    # size their buffers from this before ever calling forward
+    assert pred.output_shape(0) == [2, 3]
+    pred.set_input("data", probe.ravel())
+    pred.forward()
+    np.testing.assert_allclose(
+        pred.output(0).reshape(2, 3), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_predict_impl_reshape_does_not_alias_inputs(tmp_path):
+    from mxtpu import _c_predict_impl as impl
+    prefix, probe, expect = _export_model(tmp_path)
+    json_data = open(prefix + "-symbol.json").read()
+    params = open(prefix + "-0001.params", "rb").read()
+    pred = impl.create(json_data, params, 1, 0, ["data"], [(2, 5)])
+    pred.set_input("data", probe.ravel())
+
+    # same-shape reshape: inputs must be independent copies
+    pred2 = impl.reshape(pred, ["data"], [(2, 5)])
+    assert pred2.output_shape(0) == [2, 3]
+    assert pred2._exe.arg_dict["data"] is not pred._exe.arg_dict["data"]
+    # executor-internal views (arg_arrays) must agree with arg_dict
+    for i, n in enumerate(pred2._exe._arg_names):
+        assert pred2._exe.arg_arrays[i] is pred2._exe.arg_dict[n]
+    pred2.set_input("data", np.zeros(10, np.float32))
+    pred.forward()
+    np.testing.assert_allclose(
+        pred.output(0).reshape(2, 3), expect, rtol=1e-5, atol=1e-5)
+
+    # weights stay shared semantically: new predictor still computes the
+    # trained function on its own input
+    pred2.set_input("data", probe.ravel())
+    pred2.forward()
+    np.testing.assert_allclose(
+        pred2.output(0).reshape(2, 3), expect, rtol=1e-5, atol=1e-5)

@@ -251,3 +251,20 @@ def test_ring_attention_flash_grad():
     for a, b in zip(gf, gx):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=3e-5, rtol=3e-5)
+
+
+def test_traced_scale_gradient():
+    # a learnable attention temperature must receive a real gradient
+    q, k, v = (_rand((1, 1, 64, 16), seed=i + 101) for i in range(3))
+
+    def loss_flash(s):
+        return jnp.sum(flash_attention(q, k, v, scale=s,
+                                       block_q=64, block_k=64) ** 2)
+
+    def loss_ref(s):
+        return jnp.sum(flash_attention_reference(q, k, v, scale=s) ** 2)
+
+    g = jax.grad(loss_flash)(jnp.float32(0.2))
+    gr = jax.grad(loss_ref)(jnp.float32(0.2))
+    assert float(jnp.abs(g)) > 0
+    np.testing.assert_allclose(float(g), float(gr), rtol=1e-4)
