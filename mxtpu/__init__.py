@@ -35,7 +35,9 @@ def _optional_imports():
         ("symbol", ("sym",)), ("executor", ()), ("optimizer", ("opt",)),
         ("initializer", ("init",)), ("metric", ()), ("lr_scheduler", ()),
         ("io", ()), ("callback", ()), ("model", ()), ("module", ("mod",)),
-        ("kvstore", ("kv",)), ("gluon", ()), ("parallel", ()),
+        ("kvstore", ("kv",)), ("kvstore_server", ()),
+        ("gluon", ()), ("parallel", ()),
+        ("gradient_compression", ()),
         ("profiler", ()), ("recordio", ()), ("image", ()),
         ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
         ("rnn", ()), ("engine", ()), ("operator", ()), ("contrib", ()),

@@ -1,0 +1,107 @@
+"""2-bit gradient compression with error feedback.
+
+Capability parity with the reference ``src/kvstore/gradient_compression.{h,cc,cu}``
+(threshold spec at ``gradient_compression.h:43-48``, ReduceCompressed at
+``src/kvstore/comm.h:489-533``): each gradient element quantizes to 2 bits
+(zero / +threshold / -threshold) against a per-array error-feedback
+residual, packing 16 elements per uint32 word — a 16x wire-size cut.
+
+TPU-first rendering: quantize/dequantize are pure jax bit-twiddling ops
+(VPU integer lanes), usable standalone, inside a jitted training step
+before a psum, or via ``KVStore.set_gradient_compression`` which applies
+them per pushed device-array with per-(key, slot) residuals — the same
+point in the pipeline as the reference's ReduceCompressed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.registry import register
+
+__all__ = ["GradientCompression", "quantize_2bit", "dequantize_2bit"]
+
+_WORD = 16  # 2-bit codes per uint32
+
+
+@register("_contrib_gc_quantize_2bit", num_outputs=2, differentiable=False)
+def quantize_2bit(data, residual, threshold=0.5):
+    """Quantize ``data + residual`` to 2-bit codes.
+
+    Returns ``(packed, new_residual)``: ``packed`` is a uint32 vector with
+    16 codes per word (00=zero, 01=+threshold, 10=-threshold); the
+    residual keeps the quantization error for the next round (reference
+    gradient_compression.cc Quantize2BitKernel semantics).
+    """
+    threshold = float(threshold)
+    r = residual.astype(jnp.float32) + data.astype(jnp.float32)
+    pos = r >= threshold
+    neg = r <= -threshold
+    new_residual = r - jnp.where(pos, threshold, 0.0) \
+        + jnp.where(neg, threshold, 0.0)
+    codes = jnp.where(pos, 1, jnp.where(neg, 2, 0)).astype(jnp.uint32)
+    flat = codes.ravel()
+    pad = (-flat.size) % _WORD
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.uint32)])
+    shifts = (jnp.arange(_WORD, dtype=jnp.uint32) * 2)[None, :]
+    # codes occupy disjoint bit ranges, so a sum is a bitwise OR
+    packed = jnp.sum(flat.reshape(-1, _WORD) << shifts, axis=1,
+                     dtype=jnp.uint32)
+    return packed, new_residual.astype(residual.dtype)
+
+
+@register("_contrib_gc_dequantize_2bit", differentiable=False)
+def dequantize_2bit(packed, threshold=0.5, shape=None):
+    """Inverse of :func:`quantize_2bit`. ``shape`` is the original array
+    shape (the packed form carries only word-padded length)."""
+    threshold = float(threshold)
+    shifts = (jnp.arange(_WORD, dtype=jnp.uint32) * 2)[None, :]
+    codes = (packed[:, None] >> shifts) & jnp.uint32(3)
+    vals = jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0))
+    flat = vals.astype(jnp.float32).ravel()
+    if shape is None:
+        return flat
+    size = 1
+    for d in shape:
+        size *= int(d)
+    return flat[:size].reshape(shape)
+
+
+class GradientCompression:
+    """Stateful helper holding per-slot residuals (reference
+    GradientCompression object owned by the kvstore/comm layer)."""
+
+    def __init__(self, type="2bit", threshold=0.5, **extra):
+        if extra:
+            # reference dmlc parameter Init rejects unknown keys; a typo'd
+            # threshold silently training at the default would be worse
+            raise ValueError("unknown compression params: %s"
+                             % sorted(extra))
+        if type != "2bit":
+            raise ValueError("unsupported compression type %r (reference "
+                             "supports '2bit', gradient_compression.cc)" % type)
+        if threshold <= 0:
+            raise ValueError("threshold must be positive")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals = {}
+
+    def compress(self, slot, array):
+        """Quantize one device-array for wire transfer; updates the
+        slot's residual. Returns the packed uint32 representation."""
+        data = array.astype(jnp.float32)
+        res = self._residuals.get(slot)
+        if res is None or res.shape != data.shape:
+            res = jnp.zeros(data.shape, jnp.float32)
+        packed, new_res = quantize_2bit(data, res, self.threshold)
+        self._residuals[slot] = new_res
+        return packed
+
+    def decompress(self, packed, shape):
+        return dequantize_2bit(packed, self.threshold, shape)
+
+    def roundtrip(self, slot, array):
+        """compress + decompress (what a local reduce sees on the far
+        side of the wire)."""
+        return self.decompress(self.compress(slot, array), array.shape)

@@ -45,6 +45,7 @@ class KVStore:
         self._store = {}
         self._updater = None
         self._compression_params = None
+        self._compression = None
         self._barrier_count = 0
 
     # -- identity ----------------------------------------------------------
@@ -93,6 +94,12 @@ class KVStore:
                         shape=v[0].shape)
                     merged._data = dense
                 else:
+                    # reference comm.h Reduce returns a lone src untouched
+                    # (no wire crossing): compression engages only when
+                    # there are >=2 device shards to reduce
+                    if len(v) > 1:
+                        v = [self._maybe_compress(k, i, a)
+                             for i, a in enumerate(v)]
                     merged = v[0].copy()
                     for arr in v[1:]:
                         merged._data = merged._data + arr._data
@@ -165,12 +172,28 @@ class KVStore:
 
     # -- gradient compression ---------------------------------------------
     def set_gradient_compression(self, compression_params):
-        """2-bit gradient compression spec (reference gradient_compression.h).
-        Stored for the comm path; the sharded trainer applies it before
-        cross-device reduction."""
+        """Enable 2-bit gradient compression with error feedback
+        (reference gradient_compression.h; ReduceCompressed comm.h:489).
+        Every dense pushed device-array is quantized against its
+        per-(key, slot) residual and dequantized on the "far side" before
+        the reduce — numerics identical to the reference wire protocol."""
         if "type" not in compression_params:
             raise ValueError("compression_params requires 'type'")
-        self._compression_params = dict(compression_params)
+        from .gradient_compression import GradientCompression
+        params = dict(compression_params)
+        self._compression_params = params
+        self._compression = GradientCompression(**params)
+
+    @property
+    def gradient_compression(self):
+        return self._compression
+
+    def _maybe_compress(self, key, slot, arr):
+        if self._compression is None:
+            return arr
+        out = arr.copy()
+        out._data = self._compression.roundtrip((key, slot), arr._data)
+        return out
 
     # -- dist machinery ----------------------------------------------------
     def barrier(self):

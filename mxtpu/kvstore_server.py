@@ -1,0 +1,75 @@
+"""Parameter-server role loop (reference python/mxnet/kvstore_server.py).
+
+In the reference, ``tools/launch.py`` starts scheduler/server/worker
+processes; server processes enter ``KVStoreServer.run`` which blocks on
+ps-lite handlers and applies the optimizer that workers serialize over
+(``src/kvstore/kvstore_dist_server.h:150-196``).
+
+TPU-native distributed training is SPMD over ``jax.distributed`` — every
+process is a worker and optimizer updates are sharded, so there is no
+separate server role to run. The API is kept so launch scripts written
+against the reference work unchanged: a ``server``/``scheduler`` role
+process enters :func:`_init_kvstore_server_module`, logs that the role is
+subsumed, and exits cleanly instead of deadlocking a fleet that expects
+the process to terminate.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import sys
+
+from . import kvstore as kvs
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Server-role wrapper (reference kvstore_server.py:KVStoreServer)."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.handle = getattr(kvstore, "handle", None)
+        self.init_logging = False
+
+    def _controller(self):
+        """Return the command handler (reference registers it with ps-lite;
+        command 0 = optimizer payload, serialized with pickle)."""
+        def server_controller(cmd_id, cmd_body):
+            if not self.init_logging:
+                head = "%(asctime)-15s Server[" + str(self.kvstore.rank) + "]"
+                logging.basicConfig(level=logging.DEBUG,
+                                    format=head + " %(message)s")
+                self.init_logging = True
+            if cmd_id == 0:
+                try:
+                    optimizer = pickle.loads(cmd_body)
+                except (pickle.UnpicklingError, TypeError, ValueError):
+                    optimizer = None
+                if optimizer is not None:
+                    self.kvstore.set_optimizer(optimizer)
+            else:
+                logging.debug("server %d received unknown command (%s, %s)",
+                              self.kvstore.rank, cmd_id, cmd_body)
+        return server_controller
+
+    def run(self):
+        """Reference: blocks in ps-lite until shutdown. Here the optimizer
+        runs sharded on the workers, so the server loop returns at once."""
+        logging.info("kvstore server role is subsumed by SPMD sharded "
+                     "optimizer updates; returning")
+
+
+def _init_kvstore_server_module():
+    """Process entry for DMLC_ROLE=server|scheduler launches (reference
+    checks is_worker via ps-lite; we read the launcher's env directly)."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        store = kvs.create("dist")
+        server = KVStoreServer(store)
+        server.run()
+        sys.exit(0)
+
+
+_init_kvstore_server_module()

@@ -1,0 +1,147 @@
+"""2-bit gradient compression (reference test_kvstore.py compression
+tests + gradient_compression.cc semantics), kvstore server role, and the
+bandwidth diagnostic."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.gradient_compression import (GradientCompression, quantize_2bit,
+                                        dequantize_2bit)
+
+
+def _ref_2bit(data, residual, threshold):
+    """Reference semantics in plain numpy (gradient_compression.cc)."""
+    r = residual + data
+    out = np.where(r >= threshold, threshold,
+                   np.where(r <= -threshold, -threshold, 0.0)).astype(
+        np.float32)
+    return out, (r - out).astype(np.float32)
+
+
+def test_quantize_roundtrip_matches_reference():
+    rng = np.random.RandomState(0)
+    data = rng.standard_normal((7, 33)).astype(np.float32)  # non-multiple of 16
+    residual = rng.standard_normal((7, 33)).astype(np.float32) * 0.1
+    packed, new_res = quantize_2bit(jnp.asarray(data),
+                                    jnp.asarray(residual), 0.5)
+    assert packed.dtype == jnp.uint32
+    assert packed.size == -(-data.size // 16)     # 16x compression
+    out = dequantize_2bit(packed, 0.5, data.shape)
+    ref_out, ref_res = _ref_2bit(data, residual, 0.5)
+    np.testing.assert_allclose(np.asarray(out), ref_out)
+    np.testing.assert_allclose(np.asarray(new_res), ref_res, atol=1e-6)
+
+
+def test_error_feedback_accumulates():
+    gc = GradientCompression(threshold=0.5)
+    small = jnp.full((16,), 0.2, jnp.float32)
+    # 0.2 < threshold: first two rounds emit zero, residual builds up
+    out1 = gc.roundtrip("w", small)
+    out2 = gc.roundtrip("w", small)
+    out3 = gc.roundtrip("w", small)
+    np.testing.assert_allclose(np.asarray(out1), 0.0)
+    np.testing.assert_allclose(np.asarray(out2), 0.0)
+    # third round: residual 0.6 >= 0.5 fires
+    np.testing.assert_allclose(np.asarray(out3), 0.5)
+    # nothing is ever lost on average: residual after firing is 0.1
+    np.testing.assert_allclose(np.asarray(gc._residuals["w"]), 0.1,
+                               atol=1e-6)
+
+
+def test_invalid_params():
+    import pytest
+    with pytest.raises(ValueError):
+        GradientCompression(type="1bit")
+    with pytest.raises(ValueError):
+        GradientCompression(threshold=0.0)
+    with pytest.raises(ValueError):
+        mx.kv.create("local").set_gradient_compression({"threshold": 1})
+    with pytest.raises(ValueError):  # typo'd key must not pass silently
+        GradientCompression(type="2bit", treshold=2.0)
+
+
+def test_single_push_not_compressed():
+    # reference comm.h Reduce returns a lone src untouched — compression
+    # only crosses the wire when >= 2 device shards reduce
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.zeros((4,)))
+    g = np.array([0.1, -0.2, 0.7, -0.9], np.float32)
+    kv.push("w", nd.array(g))
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), g)
+    kv.push("w", [nd.array(g)])      # list of one: same rule
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), g)
+
+
+def test_kvstore_push_compressed():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    shape = (4, 8)
+    kv.init("w", nd.zeros(shape))
+    rng = np.random.RandomState(1)
+    grads = [rng.standard_normal(shape).astype(np.float32) for _ in range(3)]
+
+    kv.push("w", [nd.array(g) for g in grads])
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+
+    # simulate: each device slot compresses against its own residual,
+    # decompressed shards are summed, store had no updater -> assignment
+    expect = np.zeros(shape, np.float32)
+    for g in grads:
+        q, _ = _ref_2bit(g, np.zeros(shape, np.float32), 0.5)
+        expect += q
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+    # second push: per-slot residuals carry over
+    kv.push("w", [nd.array(g) for g in grads])
+    kv.pull("w", out=out)
+    expect2 = np.zeros(shape, np.float32)
+    for g in grads:
+        _, res = _ref_2bit(g, np.zeros(shape, np.float32), 0.5)
+        q2, _ = _ref_2bit(g, res, 0.5)
+        expect2 += q2
+    np.testing.assert_allclose(out.asnumpy(), expect2)
+
+
+def test_kvstore_uncompressed_key_unaffected():
+    kv = mx.kv.create("local")
+    kv.init("a", nd.ones((3,)))
+    kv.push("a", nd.array(np.full((3,), 2.0, np.float32)))
+    out = nd.zeros((3,))
+    kv.pull("a", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+def test_kvstore_server_worker_role_noop():
+    from mxtpu import kvstore_server
+    # import as worker (default role) must not exit; server class drives
+    # the controller protocol
+    kv = mx.kv.create("local")
+    srv = kvstore_server.KVStoreServer(kv)
+    import pickle
+    from mxtpu import optimizer as opt
+    srv._controller()(0, pickle.dumps(opt.SGD(learning_rate=0.5)))
+    assert kv._updater is not None
+    srv.run()
+
+
+def test_bandwidth_tool_smoke():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "bandwidth.py"),
+         "--sizes", "1000", "--iters", "2"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "psum" in r.stdout and "ppermute" in r.stdout
