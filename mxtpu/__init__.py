@@ -41,6 +41,7 @@ def _optional_imports():
         ("profiler", ()), ("recordio", ()), ("image", ()),
         ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
         ("rnn", ()), ("engine", ()), ("operator", ()), ("contrib", ()),
+        ("rtc", ()), ("torch", ()),
     ]:
         try:
             m = importlib.import_module("." + name, __name__)

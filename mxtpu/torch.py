@@ -1,0 +1,79 @@
+"""Torch interop bridge (reference ``python/mxnet/torch.py``, which wrapped
+Lua-Torch tensor functions as mxnet ops; the modern equivalent is PyTorch).
+
+Provides zero-copy-where-possible conversion between mxtpu NDArrays and
+``torch.Tensor`` (DLPack first, numpy fallback) plus ``wrap``, which lifts
+any torch function into an NDArray->NDArray function so torch's CPU ops
+act as an escape hatch the way the reference's ``mxnet.th`` namespace did.
+
+Torch never runs on the TPU here — bridged calls execute on the host, so
+use them for data prep / verification, not inside jitted training steps
+(for that, ``mx.operator.CustomOp`` with pure_callback is the sanctioned
+route).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["to_torch", "from_torch", "wrap", "available"]
+
+
+def available():
+    try:
+        import torch  # noqa: F401
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor (host). DLPack when the buffer is on CPU,
+    otherwise via numpy copy."""
+    import torch
+    try:
+        return torch.from_dlpack(arr._data)
+    except Exception:
+        return torch.from_numpy(arr.asnumpy())
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor -> NDArray on the current (or given) context."""
+    t = tensor.detach().cpu().contiguous()
+    try:
+        import jax
+        return NDArray(jax.dlpack.from_dlpack(t))
+    except Exception:
+        return nd.array(t.numpy())
+
+
+def wrap(fn):
+    """Lift a torch function into an NDArray function:
+
+        tsort = mx.torch.wrap(torch.sort)
+        values, idx = tsort(mx.nd.array([3, 1, 2]))
+    """
+    def wrapped(*args, **kwargs):
+        import torch
+
+        def conv(a):
+            return to_torch(a) if isinstance(a, NDArray) else a
+
+        out = fn(*[conv(a) for a in args],
+                 **{k: conv(v) for k, v in kwargs.items()})
+
+        def back(o):
+            if isinstance(o, torch.Tensor):
+                return from_torch(o)
+            if isinstance(o, dict):
+                return {k: back(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return type(o)(back(x) for x in o)
+            if hasattr(o, "_fields"):  # torch.return_types.* sequences
+                return tuple(back(x) for x in o)
+            return o
+
+        return back(out)
+    return wrapped

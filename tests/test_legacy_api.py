@@ -1,0 +1,172 @@
+"""FeedForward legacy front-end, mx.rtc runtime kernels, torch bridge
+(reference model.py:419-994, rtc.py, torch.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=16,
+                                                name="fc1"),
+                          act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=2,
+                                                      name="fc2"),
+                                name="softmax")
+
+
+def _toy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 8).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.float32)
+    return x, y
+
+
+def test_feedforward_fit_predict_score():
+    import logging
+    logging.disable(logging.INFO)
+    mx.random.seed(0)
+    x, y = _toy()
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=8,
+                                 optimizer="sgd", learning_rate=0.1,
+                                 initializer=mx.init.Xavier(),
+                                 numpy_batch_size=32)
+    model.fit(x, y)
+    prob = model.predict(x)
+    assert prob.shape == (128, 2)
+    acc = model.score(mx.io.NDArrayIter(x, y, batch_size=32))
+    assert acc > 0.85, acc
+
+
+def test_feedforward_create_save_load(tmp_path):
+    import logging
+    logging.disable(logging.INFO)
+    mx.random.seed(0)
+    x, y = _toy()
+    model = mx.model.FeedForward.create(_mlp(), x, y, ctx=mx.cpu(),
+                                        num_epoch=3, learning_rate=0.1,
+                                        initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 3)
+    loaded = mx.model.FeedForward.load(prefix, 3, ctx=mx.cpu())
+    np.testing.assert_allclose(loaded.predict(x), model.predict(x),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rtc_axpy():
+    src = r'''
+def axpy(x_ref, y_ref, out_ref, *, alpha):
+    out_ref[...] = alpha * x_ref[...] + y_ref[...]
+'''
+    mod = mx.rtc.PallasModule(src, exports=["axpy"])
+    k = mod.get_kernel("axpy", "const float *x, const float *y, "
+                               "float alpha, float *out")
+    # note signature order defines arg order at launch
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    y = nd.ones((2, 4))
+    out = nd.zeros((2, 4))
+    k.launch((x, y, 3.0, out), mx.cpu(0), (1, 1, 1))
+    np.testing.assert_allclose(out.asnumpy(),
+                               3.0 * x.asnumpy() + 1.0)
+
+
+def test_rtc_grid_program_id():
+    src = r'''
+def fill_rows(out_ref):
+    i = pl.program_id(0)
+    out_ref[i, :] = jnp.full((4,), i, jnp.float32)
+'''
+    mod = mx.rtc.PallasModule(src, exports=["fill_rows"])
+    k = mod.get_kernel("fill_rows", "float *out")
+    out = nd.zeros((3, 4))
+    k.launch((out,), mx.cpu(0), (3, 1, 1))
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.arange(3, dtype=np.float32)[:, None]
+                               * np.ones((1, 4)))
+
+
+def test_rtc_signature_errors():
+    mod = mx.rtc.PallasModule("def f(o_ref):\n    o_ref[...] = 0.0\n",
+                              exports=["f"])
+    with pytest.raises(ValueError):
+        mod.get_kernel("f", "blob *x")
+    with pytest.raises(ValueError):
+        mod.get_kernel("missing", "float *x")
+    k = mod.get_kernel("f", "float *x")
+    with pytest.raises(ValueError):
+        k.launch((), mx.cpu(0), (1, 1, 1))
+
+
+def test_torch_bridge_roundtrip():
+    torch_mod = pytest.importorskip("torch")
+    from mxtpu import torch as bridge
+    assert bridge.available()
+    a = nd.array(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32))
+    t = bridge.to_torch(a)
+    assert isinstance(t, torch_mod.Tensor)
+    back = bridge.from_torch(t)
+    np.testing.assert_allclose(back.asnumpy(), a.asnumpy())
+
+
+def test_torch_bridge_wrap():
+    torch_mod = pytest.importorskip("torch")
+    from mxtpu import torch as bridge
+    tsort = bridge.wrap(torch_mod.sort)
+    values, idx = tsort(nd.array(np.array([3.0, 1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(values.asnumpy(), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(idx.asnumpy(), [1, 2, 0])
+
+
+def test_rtc_output_first_signature():
+    # declared order must be honored even when an output precedes inputs
+    src = r'''
+def dbl(out_ref, x_ref):
+    out_ref[...] = x_ref[...] * 2.0
+'''
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("dbl", "float *out, const float *x")
+    x = nd.array(np.arange(4, dtype=np.float32))
+    out = nd.zeros((4,))
+    k.launch((out, x), mx.cpu(0), (1, 1, 1))
+    np.testing.assert_allclose(out.asnumpy(), 2.0 * x.asnumpy())
+
+
+def test_rtc_exports_enforced():
+    mod = mx.rtc.PallasModule("def f(o_ref):\n    o_ref[...] = 0.0\n")
+    with pytest.raises(ValueError):
+        mod.get_kernel("jnp", "float *x")   # namespace entry, not a kernel
+    with pytest.raises(ValueError):
+        mx.rtc.PallasModule("x = 1\n", exports=["g"])
+
+
+def test_feedforward_predict_return_data():
+    import logging
+    logging.disable(logging.INFO)
+    mx.random.seed(0)
+    x, y = _toy()
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=2,
+                                 learning_rate=0.1, numpy_batch_size=32,
+                                 initializer=mx.init.Xavier())
+    model.fit(x, y)
+    outs, datas, labels = model.predict(
+        mx.io.NDArrayIter(x, y, batch_size=50), return_data=True)
+    # padding of the last 128/50 batch must be trimmed everywhere
+    assert outs.shape == (128, 2)
+    np.testing.assert_allclose(datas, x)
+    np.testing.assert_allclose(labels, y)
+
+
+def test_torch_wrap_dict_and_scalars():
+    torch_mod = pytest.importorskip("torch")
+    from mxtpu import torch as bridge
+
+    def f(t):
+        return {"mean": t.mean(), "raw": t, "tag": "ok"}
+
+    out = bridge.wrap(f)(nd.array(np.array([1.0, 3.0], np.float32)))
+    assert set(out) == {"mean", "raw", "tag"}
+    assert out["tag"] == "ok"
+    np.testing.assert_allclose(out["mean"].asnumpy(), 2.0)
+    np.testing.assert_allclose(out["raw"].asnumpy(), [1.0, 3.0])
