@@ -269,6 +269,49 @@ class ColorJitterAug(RandomOrderAug):
         super().__init__(ts)
 
 
+class RandomGrayAug(Augmenter):
+    """Convert to 3-channel grayscale with probability p (reference
+    image.py:RandomGrayAug)."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+        self._coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __call__(self, src):
+        if _random.random() < self.p:
+            gray = nd.sum(src.astype("float32") * nd.array(self._coef),
+                          axis=2, keepdims=True)
+            src = nd.broadcast_to(gray, src.shape).astype(src.dtype) \
+                if hasattr(nd, "broadcast_to") else \
+                nd.NDArray(gray._data.repeat(3, axis=2))
+        return src
+
+
+class HueJitterAug(Augmenter):
+    """Random hue rotation in [-hue, hue] via the YIQ linear approximation
+    (reference image.py:HueJitterAug)."""
+
+    def __init__(self, hue=0.0):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = _np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], _np.float32)
+        self.ityiq = _np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], _np.float32)
+
+    def __call__(self, src):
+        alpha = _random.uniform(-self.hue, self.hue)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], _np.float32)
+        t = _np.dot(_np.dot(self.ityiq, bt), self.tyiq).T
+        return nd.NDArray(src.astype("float32")._data @ t)
+
+
 class LightingAug(Augmenter):
     """PCA lighting noise (reference image.py LightingAug)."""
 
@@ -296,8 +339,8 @@ class ColorNormalizeAug(Augmenter):
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
-                    inter_method=2):
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
     """Build the standard augmenter list (reference image.py:CreateAugmenter)."""
     auglist = []
     if resize > 0:
@@ -316,6 +359,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if pca_noise > 0:
         eigval = _np.array([55.46, 4.794, 1.148])
         eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
@@ -339,8 +386,13 @@ class ImageIter:
                  path_imgrec=None, path_imglist=None, path_root=None,
                  shuffle=False, aug_list=None, imglist=None,
                  data_name="data", label_name="softmax_label",
-                 part_index=0, num_parts=1, **kwargs):
+                 part_index=0, num_parts=1, last_batch_handle="pad",
+                 **kwargs):
         from .io import DataDesc, DataBatch
+        if last_batch_handle not in ("pad", "discard"):
+            raise ValueError("last_batch_handle must be 'pad' or "
+                             "'discard', got %r" % last_batch_handle)
+        self.last_batch_handle = last_batch_handle
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self._shuffle = shuffle
@@ -414,6 +466,9 @@ class ImageIter:
     def next(self):
         from .io import DataBatch
         if self._cursor >= len(self._items):
+            raise StopIteration
+        if self.last_batch_handle == "discard" and \
+                len(self._items) - self._cursor < self.batch_size:
             raise StopIteration
         datas, labels = [], []
         while len(datas) < self.batch_size:
@@ -511,3 +566,12 @@ class ImageRecordIterImpl:
 
     def __next__(self):
         return self._prefetch.__next__()
+
+
+# detection pipeline (reference python/mxnet/image/detection.py) lives in
+# a sibling module; re-exported here so mx.image.ImageDetIter matches the
+# reference namespace.
+from .image_detection import (DetAugmenter, DetBorrowAug,  # noqa: E402
+                              DetRandomSelectAug, DetHorizontalFlipAug,
+                              DetRandomCropAug, DetRandomPadAug,
+                              CreateDetAugmenter, ImageDetIter)

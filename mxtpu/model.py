@@ -169,7 +169,11 @@ class FeedForward:
         if eval_data is not None and not hasattr(eval_data, "provide_data"):
             eval_data = self._as_iter(eval_data[0], eval_data[1])
         m = self._ensure_module()
+        # a prior predict/score bound the module for inference; Module.bind
+        # silently ignores rebinds, so force one to get backward graphs
+        rebind = m.binded and not m.for_training
         m.fit(train, eval_data=eval_data, eval_metric=eval_metric,
+              force_rebind=rebind,
               epoch_end_callback=epoch_end_callback,
               batch_end_callback=batch_end_callback, kvstore=kvstore,
               optimizer=self.optimizer,

@@ -69,10 +69,11 @@ def wrap(fn):
                 return from_torch(o)
             if isinstance(o, dict):
                 return {k: back(v) for k, v in o.items()}
+            if isinstance(o, tuple) and hasattr(o, "_fields"):
+                # namedtuples (incl. torch.return_types.*) need *args
+                return type(o)(*(back(x) for x in o))
             if isinstance(o, (list, tuple)):
                 return type(o)(back(x) for x in o)
-            if hasattr(o, "_fields"):  # torch.return_types.* sequences
-                return tuple(back(x) for x in o)
             return o
 
         return back(out)

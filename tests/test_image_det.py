@@ -1,0 +1,187 @@
+"""Detection augmenters + ImageDetIter (reference tests for
+python/mxnet/image/detection.py; geometry checked analytically)."""
+import random
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.image_detection import (DetBorrowAug, DetHorizontalFlipAug,
+                                   DetRandomCropAug, DetRandomPadAug,
+                                   DetRandomSelectAug, CreateDetAugmenter,
+                                   ImageDetIter, _box_iob)
+
+
+def _img(h=60, w=80):
+    rng = np.random.RandomState(0)
+    return nd.array(rng.randint(0, 255, (h, w, 3)).astype(np.uint8))
+
+
+def _label():
+    # one object in the left half, one in the bottom-right corner
+    return np.array([[0, 0.10, 0.20, 0.40, 0.60],
+                     [1, 0.70, 0.70, 0.95, 0.95]], np.float32)
+
+
+def test_box_iob():
+    boxes = _label()[:, 1:5]
+    full = np.array([0.0, 0.0, 1.0, 1.0])
+    np.testing.assert_allclose(_box_iob(boxes, full), [1.0, 1.0])
+    left = np.array([0.0, 0.0, 0.5, 1.0])
+    cov = _box_iob(boxes, left)
+    assert cov[0] == pytest.approx(1.0)
+    assert cov[1] == pytest.approx(0.0)
+
+
+def test_horizontal_flip_boxes():
+    random.seed(0)
+    aug = DetHorizontalFlipAug(p=1.0)
+    img, lab = aug(_img(), _label())
+    # x mirrored, y unchanged, still well-formed
+    np.testing.assert_allclose(lab[0, [1, 3]], [1 - 0.40, 1 - 0.10],
+                               atol=1e-6)
+    np.testing.assert_allclose(lab[:, [2, 4]], _label()[:, [2, 4]])
+    assert (lab[:, 1] <= lab[:, 3]).all()
+    # image actually mirrored
+    np.testing.assert_allclose(img.asnumpy(),
+                               _img().asnumpy()[:, ::-1])
+
+
+def test_random_crop_keeps_and_renormalizes():
+    random.seed(3)
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.3, 0.9), min_eject_coverage=0.3,
+                           max_attempts=100)
+    img, lab = aug(_img(), _label())
+    assert lab.shape[0] >= 1
+    assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+    assert (lab[:, 1] < lab[:, 3]).all() and (lab[:, 2] < lab[:, 4]).all()
+    assert img.shape[0] <= 60 and img.shape[1] <= 80
+
+
+def test_random_pad_expands_and_rescales():
+    random.seed(1)
+    aug = DetRandomPadAug(area_range=(1.5, 2.5), pad_val=(9, 9, 9))
+    img, lab = aug(_img(), _label())
+    assert img.shape[0] >= 60 and img.shape[1] >= 80
+    # boxes shrink into the canvas but stay ordered
+    assert (lab[:, 1] < lab[:, 3]).all() and (lab[:, 2] < lab[:, 4]).all()
+    w_before = _label()[:, 3] - _label()[:, 1]
+    w_after = lab[:, 3] - lab[:, 1]
+    assert (w_after <= w_before + 1e-6).all()
+
+
+def test_random_select_skip():
+    aug = DetRandomSelectAug([DetHorizontalFlipAug(p=1.0)], skip_prob=1.0)
+    img, lab = aug(_img(), _label())
+    np.testing.assert_allclose(lab, _label())
+
+
+def test_create_det_augmenter_chain():
+    random.seed(0)
+    augs = CreateDetAugmenter((3, 32, 48), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True)
+    img, lab = _img(), _label()
+    for a in augs:
+        img, lab = a(img, lab)
+    assert img.shape == (32, 48, 3)          # forced to data_shape
+    assert lab.shape[1] == 5
+    assert img.dtype == np.float32
+
+
+def test_image_det_iter_batching():
+    random.seed(0)
+    items = []
+    rng = np.random.RandomState(0)
+    for i in range(5):
+        arr = rng.randint(0, 255, (40, 50, 3)).astype(np.uint8)
+        import io as _io
+        try:
+            from PIL import Image
+            buf = _io.BytesIO()
+            Image.fromarray(arr).save(buf, format="PNG")
+            items.append((buf.getvalue(),
+                          _label()[:1 + i % 2]))
+        except ImportError:
+            pytest.skip("PIL not available")
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32), imglist=None,
+                      aug_list=CreateDetAugmenter((3, 32, 32)),
+                      path_imgrec=None)
+    # inject pre-parsed items directly (record files covered elsewhere)
+    it._items = [(src, it._parse_label(lbl)) for src, lbl in items]
+    it.max_objects = max(l.shape[0] for _, l in it._items)
+    it._order = list(range(len(it._items)))
+    it.reset()
+    batch = it.next()
+    data, label = batch.data[0], batch.label[0]
+    assert data.shape == (2, 3, 32, 32)
+    assert label.shape == (2, it.max_objects, 5)
+    lab = label.asnumpy()
+    # padding rows are -1
+    assert ((lab == -1).all(axis=2) | (lab[..., 3] > lab[..., 1])).all()
+    assert it.provide_label[0].shape == (2, it.max_objects, 5)
+
+
+def test_parse_label_flat_reference_format():
+    it = ImageDetIter.__new__(ImageDetIter)
+    flat = np.array([4, 5, 0, 0,
+                     0, 0.1, 0.2, 0.4, 0.6,
+                     1, 0.7, 0.7, 0.95, 0.95], np.float32)
+    parsed = ImageDetIter._parse_label(it, flat)
+    assert parsed.shape == (2, 5)
+    np.testing.assert_allclose(parsed, _label())
+    with pytest.raises(ValueError):
+        ImageDetIter._parse_label(it, np.array([1.0, 2.0, 3.0]))
+
+
+def test_sync_label_shape():
+    a = ImageDetIter.__new__(ImageDetIter)
+    b = ImageDetIter.__new__(ImageDetIter)
+    a.max_objects, a.label_width = 3, 5
+    b.max_objects, b.label_width = 7, 6
+    a.sync_label_shape(b)
+    assert a.max_objects == b.max_objects == 7
+    assert a.label_width == b.label_width == 6
+    assert a.label_shape == (7, 6)
+
+
+def test_gray_hue_augmenters():
+    random.seed(0)
+    from mxtpu.image import RandomGrayAug, HueJitterAug
+    img = _img()
+    gray = RandomGrayAug(p=1.0)(img)
+    g = gray.asnumpy()
+    np.testing.assert_allclose(g[..., 0], g[..., 1], atol=1.0)
+    np.testing.assert_allclose(g[..., 1], g[..., 2], atol=1.0)
+    hue = HueJitterAug(hue=0.3)(img)
+    assert hue.shape == img.shape
+    # hue rotation preserves rough luminance
+    lum = lambda a: (a.asnumpy().astype(np.float64)
+                     @ [0.299, 0.587, 0.114]).mean()
+    assert abs(lum(hue) - lum(img)) < 12.0
+    augs = CreateDetAugmenter((3, 32, 32), rand_gray=0.5, hue=0.2)
+    im2, lab = _img(), _label()
+    for a in augs:
+        im2, lab = a(im2, lab)
+    assert im2.shape == (32, 32, 3)
+
+
+def test_last_batch_discard():
+    from mxtpu.image import ImageIter
+    import io as _io
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    items = []
+    for _ in range(5):
+        buf = _io.BytesIO()
+        Image.fromarray(rng.randint(0, 255, (8, 8, 3), dtype=np.uint8)
+                        ).save(buf, format="PNG")
+        items.append((buf.getvalue(), 0.0))
+    it = ImageIter(2, (3, 8, 8), aug_list=[], last_batch_handle="discard")
+    it._items = items
+    it._order = list(range(5))
+    it.reset()
+    assert sum(1 for _ in it) == 2   # 5//2, last partial batch dropped
+    with pytest.raises(ValueError):
+        ImageIter(2, (3, 8, 8), aug_list=[], last_batch_handle="roll_over")

@@ -170,3 +170,68 @@ def test_torch_wrap_dict_and_scalars():
     assert out["tag"] == "ok"
     np.testing.assert_allclose(out["mean"].asnumpy(), 2.0)
     np.testing.assert_allclose(out["raw"].asnumpy(), [1.0, 3.0])
+
+
+def test_feedforward_fit_after_predict(tmp_path):
+    import logging
+    logging.disable(logging.INFO)
+    mx.random.seed(0)
+    x, y = _toy()
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=2,
+                                 learning_rate=0.1, numpy_batch_size=32,
+                                 initializer=mx.init.Xavier())
+    model.fit(x, y)
+    model.save(str(tmp_path / "m"), 2)
+    # load -> predict binds the fresh module for INFERENCE; the following
+    # fit must force a training rebind instead of hitting the backward
+    # assert on an inference-bound module
+    # begin_epoch resumes at 2, so ask for 2 more epochs
+    loaded = mx.model.FeedForward.load(str(tmp_path / "m"), 2, ctx=mx.cpu(),
+                                       num_epoch=4, learning_rate=0.1,
+                                       numpy_batch_size=32)
+    before = loaded.predict(x)
+    loaded.fit(x, y)
+    after = loaded.predict(x)
+    assert not np.allclose(before, after)    # training actually happened
+
+
+def test_rtc_interior_unit_grid_dim():
+    src = r'''
+def rows(out_ref):
+    j = pl.program_id(1)
+    out_ref[0, j] = j * 1.0
+'''
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("rows", "float *out")
+    out = nd.zeros((1, 3))
+    # interior 1 must be kept so program_id(1) addresses the 3-axis
+    k.launch((out,), mx.cpu(0), (1, 3, 1))
+    np.testing.assert_allclose(out.asnumpy(), [[0.0, 1.0, 2.0]])
+
+
+def test_rtc_launch_cache():
+    src = "def f(x_ref, o_ref):\n    o_ref[...] = x_ref[...] + 1.0\n"
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("f", "const float *x, float *o")
+    x, o = nd.ones((4,)), nd.zeros((4,))
+    k.launch((x, o), mx.cpu(0))
+    assert len(k._cache) == 1
+    k.launch((x, o), mx.cpu(0))
+    assert len(k._cache) == 1        # same shapes: compiled once
+    k.launch((nd.ones((8,)), nd.zeros((8,))), mx.cpu(0))
+    assert len(k._cache) == 2
+
+
+def test_torch_wrap_namedtuple():
+    pytest.importorskip("torch")
+    import collections
+    from mxtpu import torch as bridge
+    R = collections.namedtuple("R", "a b")
+
+    def f(t):
+        return R(t * 2, t + 1)
+
+    out = bridge.wrap(f)(nd.array(np.array([1.0, 2.0], np.float32)))
+    assert type(out).__name__ == "R"
+    np.testing.assert_allclose(out.a.asnumpy(), [2.0, 4.0])
+    np.testing.assert_allclose(out.b.asnumpy(), [2.0, 3.0])

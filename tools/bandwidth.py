@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import argparse
 import functools
-import time  # noqa: F401
+import time
 
 
 def measure(fn, x, iters):
@@ -25,7 +25,6 @@ def measure(fn, x, iters):
     time an iters-loop and a 2*iters-loop (both ending in the same scalar
     round-trip) and difference them, so the fixed cost of the final
     reduction + host sync drops out of the reported number."""
-    import functools
     import jax
     import jax.numpy as jnp
 
