@@ -46,7 +46,8 @@ def main():
     st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                         "sgd", {"learning_rate": 0.05, "momentum": 0.9,
                                 "wd": 1e-4},
-                        mesh=mesh)
+                        mesh=mesh,
+                        dtype="bfloat16" if on_tpu else None)
 
     # warmup: compile + settle
     for _ in range(3):

@@ -1,0 +1,208 @@
+/*
+ * mxtpu C++ API — header-only RAII wrapper over the C predict ABI.
+ *
+ * Capability parity with the reference cpp-package (`cpp-package/include/
+ * mxnet-cpp`, 5,044 LoC of headers over include/mxnet/c_api.h): idiomatic
+ * C++ classes for deployment — Context, NDArray (host tensor), Predictor
+ * (load checkpoint, set inputs, forward, read outputs, reshape). Training
+ * stays in Python/JAX where the compiler lives; this is the C++ serving
+ * surface the reference's cpp-package inference examples
+ * (cpp-package/example/inference) use.
+ *
+ * Usage:
+ *   #include <mxtpu/mxtpu_cpp.hpp>          // link -lmxtpu_predict
+ *   mxtpu::cpp::Predictor pred(json, params, mxtpu::cpp::Context::cpu(),
+ *                              {{"data", {1, 3, 224, 224}}});
+ *   pred.SetInput("data", img);              // std::vector<float>
+ *   pred.Forward();
+ *   std::vector<float> out = pred.GetOutput(0);
+ */
+#ifndef MXTPU_CPP_HPP_
+#define MXTPU_CPP_HPP_
+
+#include <cstddef>
+#include <fstream>
+#include <numeric>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "c_predict_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) {
+    const char *msg = MXGetLastError();
+    throw std::runtime_error(msg ? msg : "mxtpu call failed");
+  }
+}
+
+/* Device handle (reference mxnet-cpp/context.h). */
+class Context {
+ public:
+  Context(int dev_type, int dev_id) : type_(dev_type), id_(dev_id) {}
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context gpu(int id = 0) { return Context(2, id); }
+  static Context tpu(int id = 0) { return Context(6, id); }
+  int dev_type() const { return type_; }
+  int dev_id() const { return id_; }
+
+ private:
+  int type_;
+  int id_;
+};
+
+/* Minimal host tensor (reference mxnet-cpp/ndarray.h for the inference
+ * path: shape + contiguous float buffer). */
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(std::vector<mx_uint> shape, std::vector<mx_float> data)
+      : shape_(std::move(shape)), data_(std::move(data)) {
+    if (Size() != data_.size())
+      throw std::invalid_argument("NDArray: shape/data size mismatch");
+  }
+  explicit NDArray(std::vector<mx_uint> shape)
+      : shape_(std::move(shape)), data_(Size(), 0.0f) {}
+
+  size_t Size() const {
+    return std::accumulate(shape_.begin(), shape_.end(),
+                           static_cast<size_t>(1),
+                           [](size_t a, mx_uint b) { return a * b; });
+  }
+  const std::vector<mx_uint> &Shape() const { return shape_; }
+  const std::vector<mx_float> &Data() const { return data_; }
+  std::vector<mx_float> &Data() { return data_; }
+
+ private:
+  std::vector<mx_uint> shape_;
+  std::vector<mx_float> data_;
+};
+
+/* Read a whole file (checkpoint part) into a string. */
+inline std::string LoadFile(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+/* Inference executor over a *-symbol.json + *.params checkpoint
+ * (reference cpp-package inference flow / predictor.hpp). */
+class Predictor {
+ public:
+  using Shapes = std::vector<std::pair<std::string, std::vector<mx_uint>>>;
+
+  Predictor(const std::string &symbol_json, const std::string &param_bytes,
+            const Context &ctx, const Shapes &input_shapes) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> flat;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      flat.insert(flat.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(flat.size()));
+    }
+    Check(MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                       static_cast<int>(param_bytes.size()), ctx.dev_type(),
+                       ctx.dev_id(), static_cast<mx_uint>(keys.size()),
+                       keys.data(), indptr.data(), flat.data(), &handle_));
+  }
+
+  /* Load from checkpoint files: prefix-symbol.json + prefix-%04d.params
+   * (reference save_checkpoint layout). */
+  static Predictor FromCheckpoint(const std::string &prefix, int epoch,
+                                  const Context &ctx,
+                                  const Shapes &input_shapes) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "-%04d.params", epoch);
+    return Predictor(LoadFile(prefix + "-symbol.json"),
+                     LoadFile(prefix + buf), ctx, input_shapes);
+  }
+
+  Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  Predictor &operator=(Predictor &&other) noexcept {
+    if (this != &other) {
+      Free();
+      handle_ = other.handle_;
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+  ~Predictor() { Free(); }
+
+  void SetInput(const std::string &name, const std::vector<mx_float> &data) {
+    Check(MXPredSetInput(handle_, name.c_str(), data.data(),
+                         static_cast<mx_uint>(data.size())));
+  }
+  void SetInput(const std::string &name, const NDArray &array) {
+    SetInput(name, array.Data());
+  }
+
+  void Forward() { Check(MXPredForward(handle_)); }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index) const {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &shape, &ndim));
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+
+  std::vector<mx_float> GetOutput(mx_uint index) const {
+    std::vector<mx_uint> shape = GetOutputShape(index);
+    size_t size = std::accumulate(shape.begin(), shape.end(),
+                                  static_cast<size_t>(1),
+                                  [](size_t a, mx_uint b) { return a * b; });
+    std::vector<mx_float> out(size);
+    Check(MXPredGetOutput(handle_, index, out.data(),
+                          static_cast<mx_uint>(size)));
+    return out;
+  }
+
+  NDArray GetOutputArray(mx_uint index) const {
+    return NDArray(GetOutputShape(index), GetOutput(index));
+  }
+
+  /* Re-bind for new input shapes; weights carry over (reference
+   * MXPredReshape). Returns the new predictor; this one stays valid. */
+  Predictor Reshape(const Shapes &input_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> flat;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      flat.insert(flat.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(flat.size()));
+    }
+    PredictorHandle out = nullptr;
+    Check(MXPredReshape(static_cast<mx_uint>(keys.size()), keys.data(),
+                        indptr.data(), flat.data(), handle_, &out));
+    return Predictor(out);
+  }
+
+  PredictorHandle handle() const { return handle_; }
+
+ private:
+  explicit Predictor(PredictorHandle h) : handle_(h) {}
+  void Free() {
+    if (handle_ != nullptr) {
+      MXPredFree(handle_);
+      handle_ = nullptr;
+    }
+  }
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_HPP_

@@ -4,5 +4,6 @@
 ``nd.contrib`` / ``sym.contrib`` (ops/vision.py, ops/contrib_ops.py).
 """
 from . import text
+from . import autograd
 
-__all__ = ["text"]
+__all__ = ["text", "autograd"]

@@ -1,0 +1,90 @@
+"""Old-style contrib autograd API (reference
+``python/mxnet/contrib/autograd.py``): the pre-1.0 surface that
+``mxnet.autograd`` superseded. Thin aliases over mxtpu.autograd so code
+written against the contrib names runs unchanged.
+"""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from .. import ndarray as _nd
+from ..ndarray import NDArray
+
+__all__ = ["set_is_training", "set_recording", "train_section",
+           "test_section", "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Reference contrib/autograd.py:set_is_training; returns previous."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    return prev
+
+
+def set_recording(is_recording):
+    prev = _ag.is_recording()
+    _ag.set_recording(is_recording)
+    return prev
+
+
+def train_section():
+    """``with autograd.train_section():`` (reference name for record)."""
+    return _ag.record()
+
+
+def test_section():
+    """``with autograd.test_section():`` (reference name for pause)."""
+    return _ag.pause()
+
+
+_marked = []   # (variable, gradient) pairs, in marking order
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    _ag.mark_variables(variables, gradients, grad_reqs)
+    _marked.extend(zip(variables, gradients))
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs, out_grads=None, retain_graph=False):
+    """Reference compute_gradient: backward + return the gradients of the
+    variables marked via :func:`mark_variables`, in marking order."""
+    backward(outputs, out_grads, retain_graph)
+    return [g for _, g in _marked]
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorate func to also return gradients w.r.t. its NDArray inputs
+    (reference contrib/autograd.py:grad_and_loss)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for v in variables:
+            assert isinstance(v, NDArray), "argument must be NDArray"
+        grads = [_nd.zeros_like(v) for v in variables]
+        _ag.mark_variables(variables, grads)
+        with _ag.record():
+            outputs = func(*args)
+        _ag.backward([outputs] if isinstance(outputs, NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Like grad_and_loss but returns only the gradients."""
+    g_and_l = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return g_and_l(*args)[0]
+
+    return wrapped

@@ -282,9 +282,7 @@ class RandomGrayAug(Augmenter):
         if _random.random() < self.p:
             gray = nd.sum(src.astype("float32") * nd.array(self._coef),
                           axis=2, keepdims=True)
-            src = nd.broadcast_to(gray, src.shape).astype(src.dtype) \
-                if hasattr(nd, "broadcast_to") else \
-                nd.NDArray(gray._data.repeat(3, axis=2))
+            src = nd.broadcast_to(gray, src.shape).astype(src.dtype)
         return src
 
 

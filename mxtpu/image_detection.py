@@ -337,6 +337,13 @@ class ImageDetIter(_img.ImageIter):
         ImageDetIter.reshape)."""
         if data_shape is not None:
             self.data_shape = tuple(data_shape)
+            # keep the augmenter chain's forced resize in sync so batches
+            # match provide_data
+            size = (self.data_shape[2], self.data_shape[1])
+            for aug in self.det_auglist:
+                inner = getattr(aug, "augmenter", None)
+                if isinstance(inner, _img.ForceResizeAug):
+                    inner.size = size
         if label_shape is not None:
             self.max_objects = int(label_shape[0])
             self.label_width = int(label_shape[1])

@@ -440,6 +440,98 @@ class CSVIter(DataIter):
         return self._inner.next()
 
 
+class LibSVMIter(DataIter):
+    """LibSVM text iterator producing CSR batches (reference
+    src/io/iter_libsvm.cc:200). Each line: ``label idx:val idx:val ...``;
+    ``data_shape`` gives the dense feature width. Labels may come from a
+    second libsvm file (multi-output) or inline."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        from .ndarray.sparse import csr_matrix
+        self._data_shape = tuple(data_shape) if hasattr(data_shape,
+                                                        "__len__") \
+            else (int(data_shape),)
+        self._width = int(_np.prod(self._data_shape))
+        rows, labels = self._parse(data_libsvm)
+        if label_libsvm is not None:
+            lab_rows, _ = self._parse(label_libsvm)
+            if len(lab_rows) != len(rows):
+                raise ValueError(
+                    "label file %r has %d rows but data file %r has %d"
+                    % (label_libsvm, len(lab_rows), data_libsvm,
+                       len(rows)))
+            if label_shape:
+                w = int(label_shape[-1])
+            else:
+                w = 1 + max((idx for r in lab_rows for idx, _ in r),
+                            default=0)
+            labels = [self._densify(r, w) for r in lab_rows]
+        self._rows = rows
+        self._labels = _np.asarray(labels, _np.float32)
+        self._round_batch = round_batch
+        self._csr = csr_matrix
+        self.reset()
+
+    @staticmethod
+    def _parse(path):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                rows.append([(int(t.split(":")[0]), float(t.split(":")[1]))
+                             for t in parts[1:]])
+        return rows, labels
+
+    def _densify(self, row, width):
+        out = _np.zeros(width, _np.float32)
+        for idx, val in row:
+            out[idx] = val
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) + (self._labels.shape[1:] or ())
+        return [DataDesc("label", shape)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        n = len(self._rows)
+        if self._cursor >= n:
+            raise StopIteration
+        if not self._round_batch and n - self._cursor < self.batch_size:
+            raise StopIteration
+        idxs = []
+        while len(idxs) < self.batch_size:
+            idxs.append(min(self._cursor, n - 1))
+            self._cursor += 1
+        pad = max(0, self._cursor - n)
+        dense = _np.zeros((self.batch_size, self._width), _np.float32)
+        for i, j in enumerate(idxs):
+            for idx, val in self._rows[j]:
+                dense[i, idx] = val
+        if len(self._data_shape) > 1:
+            # multi-dim rows round-trip dense (CSR is inherently 2-D,
+            # reference LibSVMIter emits CSR only for 1-D data_shape)
+            data = nd.array(dense.reshape((self.batch_size,)
+                                          + self._data_shape))
+        else:
+            data = self._csr(dense)
+        label = nd.array(self._labels[idxs])
+        return DataBatch(data=[data], label=[label], pad=pad)
+
+
 def _read_mnist_images(path):
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
@@ -509,26 +601,4 @@ def ImageRecordIter(**kwargs):
     return ImageRecordIterImpl(**kwargs)
 
 
-def LibSVMIter(data_libsvm, data_shape, batch_size=1, **kwargs):
-    """LibSVM sparse-format iterator (reference src/io/iter_libsvm.cc:200).
-
-    Parses libsvm text into a dense array (sparse NDArray arrives with the
-    sparse subsystem) and iterates like NDArrayIter.
-    """
-    num_features = int(_np.prod(data_shape))
-    rows, labels = [], []
-    with open(data_libsvm) as f:
-        for line in f:
-            parts = line.strip().split()
-            if not parts:
-                continue
-            labels.append(float(parts[0]))
-            row = _np.zeros(num_features, dtype=_np.float32)
-            for tok in parts[1:]:
-                idx, val = tok.split(":")
-                row[int(idx)] = float(val)
-            rows.append(row)
-    data = _np.stack(rows).reshape((-1,) + tuple(data_shape))
-    return NDArrayIter({"data": data},
-                       {"label": _np.asarray(labels, dtype=_np.float32)},
-                       batch_size=batch_size, last_batch_handle="pad")
+# (LibSVMIter: CSR-batch implementation defined above, alongside CSVIter.)

@@ -196,6 +196,14 @@ class KVStore:
         return out
 
     # -- dist machinery ----------------------------------------------------
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Reference KVStore::get_num_dead_node (include/mxnet/kvstore.h:338,
+        ps-lite GetDeadNodes): count of unresponsive peers. The SPMD runtime
+        fails the whole program on peer loss (XLA collectives are not
+        partition-tolerant), so a live store always reports 0 — the hook
+        exists so reference health-check loops run unchanged."""
+        return 0
+
     def barrier(self):
         self._barrier_count += 1
 

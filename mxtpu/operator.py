@@ -221,3 +221,77 @@ def custom_num_outputs(params):
     kwargs = {k: v for k, v in params.items()
               if k not in ("op_type", "_training")}
     return len(get_prop(params.get("op_type"), kwargs).list_outputs())
+
+
+class NDArrayOp:
+    """Legacy v0.x custom-op base (reference python/mxnet/operator.py
+    NDArrayOp, bridged by src/nnvm/legacy_op_util.cc). Deprecated in the
+    reference in favour of CustomOp; kept as a compatibility adapter:
+    subclass with forward/backward/list_arguments/list_outputs/infer_shape
+    exactly like the reference and call ``.get_symbol(*args)``."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    # reference API surface -------------------------------------------------
+    def forward(self, in_data, out_data):
+        raise NotImplementedError()
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError()
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def get_symbol(self, *args, **kwargs):
+        """Wrap as a CustomOp-backed symbol (the modern path)."""
+        legacy = self
+
+        class _Prop(CustomOpProp):
+            def __init__(self, **pkw):
+                super().__init__(need_top_grad=legacy.need_top_grad())
+
+            def list_arguments(self):
+                return legacy.list_arguments()
+
+            def list_outputs(self):
+                return legacy.list_outputs()
+
+            def infer_shape(self, in_shape):
+                res = legacy.infer_shape(in_shape)
+                return res if len(res) == 3 else (res[0], res[1], [])
+
+            def create_operator(self, ctx, shapes, dtypes):
+                class _Op(CustomOp):
+                    def forward(self, is_train, req, in_data, out_data,
+                                aux):
+                        legacy.forward(in_data=in_data, out_data=out_data)
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        legacy.backward(out_grad=out_grad, in_data=in_data,
+                                        out_data=out_data, in_grad=in_grad)
+                return _Op()
+
+        name = "_legacy_%s_%d" % (type(self).__name__, id(self))
+        register(name)(_Prop)
+        from . import symbol as sym
+        return sym.Custom(*args, op_type=name, **kwargs)
+
+
+class NativeOp(NDArrayOp):
+    """Legacy NativeOp (C callback custom op): in mxtpu, native custom
+    kernels are Pallas (mx.rtc) or C ops behind the C ABI; Python-side
+    NativeOp semantics are identical to NDArrayOp."""
+
+
+__all__ += ["NDArrayOp", "NativeOp"]

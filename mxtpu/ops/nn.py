@@ -262,27 +262,37 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     shape = tuple(shape)
+    # statistics and the affine math run in f32 even for bf16 activations
+    # (reference cuDNN BN accumulates in fp32 for fp16 inputs); the output
+    # drops back to the input dtype so a bf16 chain stays bf16 end to end
+    x32 = data.astype(jnp.float32)
     if _training and not use_global_stats:
-        mean, var = _bn_stats(data, axis)
-        new_mm = moving_mean * momentum + mean * (1 - momentum)
-        new_mv = moving_var * momentum + var * (1 - momentum)
+        mean, var = _bn_stats(x32, axis)
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) \
+            * (1 - momentum)
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) \
+            * (1 - momentum)
     else:
         mean, var = moving_mean, moving_var
         new_mm, new_mv = moving_mean, moving_var
-    inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(shape)) * inv.reshape(shape) * g.reshape(shape) \
-        + beta.reshape(shape)
-    return out, new_mm, new_mv
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    out = (x32 - mean.astype(jnp.float32).reshape(shape)) \
+        * inv.reshape(shape) * g.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype), new_mm, new_mv
 
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
-    out = (data - mean) * lax.rsqrt(var + eps)
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + eps)
     shape = [1] * data.ndim
     shape[axis % data.ndim] = data.shape[axis % data.ndim]
-    return out * gamma.reshape(shape) + beta.reshape(shape)
+    out = out * gamma.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)
 
 
 @register("InstanceNorm")

@@ -186,7 +186,14 @@ class ShardedTrainer:
     """
 
     def __init__(self, block, loss, optimizer, optimizer_params=None,
-                 mesh=None, rules=None, donate=True):
+                 mesh=None, rules=None, donate=True, dtype=None):
+        if dtype not in (None, "float32", "bfloat16"):
+            # float16 would need loss scaling (reference mp_sgd pairs fp16
+            # weights with fp32 master copies + scale); bf16 shares f32's
+            # exponent range so no scaling is required on TPU
+            raise ValueError("dtype must be None/'float32'/'bfloat16'")
+        self._compute_dtype = (jnp.bfloat16 if dtype == "bfloat16"
+                               else None)
         self._block = block
         self._loss = loss
         if isinstance(optimizer, opt_mod.Optimizer):
@@ -272,13 +279,25 @@ class ShardedTrainer:
         optimizer = self._optimizer
         mesh = self._mesh
 
+        cdt = self._compute_dtype
+
         def forward_loss(train_vals, aux_vals, inputs, label, key, training):
+            # mixed precision (the reference's mp_sgd capability,
+            # optimizer_op-inl.h multi-precision update): params/activations
+            # compute in bf16 on the MXU, master weights + optimizer state
+            # and BN statistics stay f32 — the cast sits inside the
+            # differentiated function so grads come back f32 via the cast
+            # VJP.
             full = [None] * len(params)
             for v, i in zip(train_vals, train_idx):
-                full[i] = NDArray(v)
+                full[i] = NDArray(v.astype(cdt) if cdt is not None and
+                                  jnp.issubdtype(v.dtype, jnp.floating)
+                                  else v)
             for v, i in zip(aux_vals, aux_idx):
                 full[i] = NDArray(v)
-            ins = [NDArray(v) for v in inputs]
+            ins = [NDArray(v.astype(cdt) if cdt is not None and
+                           jnp.issubdtype(v.dtype, jnp.floating) else v)
+                   for v in inputs]
             with _ag.pause(train_mode=training), rng_scope(key), \
                     _trace_scope(), \
                     _swap_params(block, dict(zip(params, full))):
@@ -295,8 +314,10 @@ class ShardedTrainer:
                     l = loss_blk(outs[0], NDArray(label))
                 else:
                     raise TypeError("loss must be a Loss block or callable")
-            loss_val = jnp.mean(l._data)
-            aux_new = tuple(full[i]._data for i in aux_idx)
+            loss_val = jnp.mean(l._data.astype(jnp.float32))
+            aux_new = tuple(
+                full[i]._data.astype(av.dtype)
+                for i, av in zip(aux_idx, aux_vals))
             return loss_val, (aux_new, tuple(o._data for o in outs))
 
         def train_step(train_vals, states, aux_vals, inputs, label, key,

@@ -142,19 +142,29 @@ class PallasKernel:
         fn, tensor_params = self._fn, [p for p in self._params
                                        if p.is_ndarray]
         n_in = len(in_arrays)
-        key = (grid, tuple(sorted(scalars.items())),
+        # scalar VALUES stay out of the cache key: they ride into the
+        # kernel as extra (1,)-shaped input operands, so a per-step scalar
+        # (decaying epsilon, step count) reuses the compiled kernel instead
+        # of recompiling and growing the cache every launch
+        scalar_names = tuple(sorted(scalars))
+        n_scal = len(scalar_names)
+        key = (grid, scalar_names,
                tuple((d.shape, str(d.dtype)) for _, d in in_arrays),
                tuple((d.shape, str(d.dtype)) for _, d in out_arrays))
         call = self._cache.get(key)
         if call is None:
             def shim(*refs):
-                # pallas hands refs inputs-first then outputs; replay them
-                # in declared signature order so 'float *out, const float
-                # *x' kernels see (out_ref, x_ref) like the reference
-                ins, outs = list(refs[:n_in]), list(refs[n_in:])
+                # pallas ref order: tensor inputs, scalar inputs, outputs;
+                # replay tensor refs in declared signature order so
+                # 'float *out, const float *x' kernels see (out_ref,
+                # x_ref) like the reference CudaKernel
+                ins = list(refs[:n_in])
+                kw = {nme: refs[n_in + i][0]
+                      for i, nme in enumerate(scalar_names)}
+                outs = list(refs[n_in + n_scal:])
                 ordered = [(ins if p.is_const else outs).pop(0)
                            for p in tensor_params]
-                return fn(*ordered, **scalars)
+                return fn(*ordered, **kw)
 
             call = jax.jit(pl.pallas_call(
                 shim,
@@ -164,7 +174,10 @@ class PallasKernel:
                 interpret=jax.default_backend() != "tpu",
             ))
             self._cache[key] = call
-        outs = call(*[d for _, d in in_arrays])
+        import jax.numpy as jnp
+        svals = [jnp.asarray(scalars[nme]).reshape(1)
+                 for nme in scalar_names]
+        outs = call(*([d for _, d in in_arrays] + svals))
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
         for (arr, _), o in zip(out_arrays, outs):

@@ -187,3 +187,60 @@ def test_predict_impl_reshape_does_not_alias_inputs(tmp_path):
     pred2.forward()
     np.testing.assert_allclose(
         pred2.output(0).reshape(2, 3), expect, rtol=1e-5, atol=1e-5)
+
+
+_CPP_PROGRAM = r"""
+#include <cstdio>
+#include <mxtpu/mxtpu_cpp.hpp>
+
+int main(int argc, char **argv) {
+  using mxtpu::cpp::Predictor;
+  using mxtpu::cpp::Context;
+  Predictor pred(mxtpu::cpp::LoadFile(argv[1]),
+                 mxtpu::cpp::LoadFile(argv[2]), Context::cpu(),
+                 {{"data", {2, 5}}});
+  std::vector<mx_uint> shape = pred.GetOutputShape(0);  // pre-forward
+  if (shape.size() != 2 || shape[0] != 2 || shape[1] != 3) return 2;
+  std::vector<mx_float> probe(10);
+  for (int i = 0; i < 10; ++i) probe[i] = i / 10.0f;
+  pred.SetInput("data", probe);
+  pred.Forward();
+  mxtpu::cpp::NDArray out = pred.GetOutputArray(0);
+  // reshape keeps weights; run the same input through the new predictor
+  Predictor pred2 = pred.Reshape({{"data", {2, 5}}});
+  pred2.SetInput("data", probe);
+  pred2.Forward();
+  std::vector<mx_float> out2 = pred2.GetOutput(0);
+  for (size_t i = 0; i < out.Data().size(); ++i) {
+    if (out.Data()[i] - out2[i] > 1e-6f || out2[i] - out.Data()[i] > 1e-6f)
+      return 3;
+    std::printf("%f\n", out.Data()[i]);
+  }
+  return 0;
+}
+"""
+
+
+def test_cpp_package_header(tmp_path):
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    prefix, probe, expect = _export_model(tmp_path)
+    src = tmp_path / "t.cc"
+    src.write_text(_CPP_PROGRAM)
+    exe = str(tmp_path / "tcc")
+    inc = os.path.join(os.path.dirname(__file__), "..", "include")
+    subprocess.run(["g++", "-std=c++14", "-O1", str(src), "-I", inc,
+                    "-L", _NATIVE, "-lmxtpu_predict", "-o", exe,
+                    "-Wl,-rpath," + os.path.abspath(_NATIVE)], check=True)
+    env = dict(os.environ,
+               PYTHONPATH=os.path.abspath(
+                   os.path.join(os.path.dirname(__file__), "..")),
+               JAX_PLATFORMS="cpu")
+    res = subprocess.run([exe, prefix + "-symbol.json",
+                          prefix + "-0001.params"], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert res.returncode == 0, (res.returncode, res.stderr)
+    got = np.asarray([float(v) for v in res.stdout.split()],
+                     np.float32).reshape(2, 3)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)

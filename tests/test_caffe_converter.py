@@ -1,0 +1,112 @@
+"""Caffe prototxt -> symbol conversion (reference tools/caffe_converter)."""
+import sys, os
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import caffe_converter as cc  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+LENET = """
+name: "LeNet"
+input: "data"
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 5 stride: 1 } }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "pool1" top: "pool1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 32 } }
+layer { name: "relu2" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" top: "loss" }
+"""
+
+
+def test_parse_prototxt():
+    msg = cc.parse_prototxt(LENET)
+    assert msg["name"] == "LeNet"
+    layers = msg["layer"]
+    assert len(layers) == 7
+    assert layers[0]["convolution_param"]["num_output"] == 8
+    assert str(layers[1]["pooling_param"]["pool"]) == "MAX"
+
+
+def test_convert_lenet_runs():
+    sym, inp = cc.convert_symbol(LENET)
+    assert inp == "data"
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(2, 1, 28, 28))
+    out = exe.forward(is_train=False,
+                      data=np.random.RandomState(0).rand(2, 1, 28, 28)
+                      .astype(np.float32))[0]
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.asnumpy().sum(1), 1.0, rtol=1e-5)
+
+
+def test_convert_residual_block():
+    proto = """
+input: "data"
+layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "c1" top: "c1" }
+layer { name: "sc1" type: "Scale" bottom: "c1" top: "c1" }
+layer { name: "r1" type: "ReLU" bottom: "c1" top: "c1" }
+layer { name: "c2" type: "Convolution" bottom: "c1" top: "c2"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "add" type: "Eltwise" bottom: "c2" bottom: "c1" top: "add"
+  eltwise_param { operation: SUM } }
+layer { name: "sm" type: "Softmax" bottom: "add" top: "sm" }
+"""
+    sym, _ = cc.convert_symbol(proto)
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(1, 3, 8, 8))
+    out = exe.forward(is_train=False,
+                      data=np.zeros((1, 3, 8, 8), np.float32))[0]
+    assert out.shape[0] == 1
+
+
+def test_cli(tmp_path):
+    p = tmp_path / "net.prototxt"
+    p.write_text(LENET)
+    rc = cc.main([str(p), str(tmp_path / "conv")])
+    assert rc == 0
+    assert (tmp_path / "conv-symbol.json").exists()
+    loaded = mx.sym.load(str(tmp_path / "conv-symbol.json"))
+    assert "loss" in loaded.list_outputs()[0]
+
+
+def test_unsupported_layer():
+    import pytest
+    with pytest.raises(NotImplementedError):
+        cc.convert_symbol('input: "data"\n'
+                          'layer { name: "x" type: "SPP" bottom: "data" '
+                          'top: "x" }')
+
+
+def test_non_square_kernel():
+    proto = """
+input: "data"
+layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+  convolution_param { num_output: 2 kernel_h: 3 kernel_w: 5
+                      stride_h: 1 stride_w: 2 pad_h: 1 pad_w: 2 } }
+layer { name: "sm" type: "Softmax" bottom: "c" top: "sm" }
+"""
+    sym, _ = cc.convert_symbol(proto)
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(1, 1, 9, 12))
+    out = exe.forward(is_train=False,
+                      data=np.zeros((1, 1, 9, 12), np.float32))[0]
+    # H: (9+2*1-3)/1+1 = 9 ; W: (12+2*2-5)/2+1 = 6
+    assert out.shape == (1, 2, 9, 6), out.shape
+
+
+def test_compute_gradient_contrib():
+    from mxtpu.contrib import autograd as cag
+    from mxtpu import nd
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    g = nd.zeros((2,))
+    n_before = len(cag._marked)
+    cag.mark_variables([x], [g])
+    with cag.train_section():
+        y = x * x
+    grads = cag.compute_gradient([y])
+    assert grads[n_before] is g
+    np.testing.assert_allclose(g.asnumpy(), 2 * x.asnumpy())

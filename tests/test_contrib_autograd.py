@@ -1,0 +1,61 @@
+"""contrib.autograd legacy API + legacy NDArrayOp custom ops
+(reference python/mxnet/contrib/autograd.py and operator.py NDArrayOp)."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.contrib import autograd as cag
+
+
+def test_train_test_sections():
+    assert not mx.autograd.is_recording()
+    with cag.train_section():
+        assert mx.autograd.is_recording()
+        assert mx.autograd.is_training()
+        with cag.test_section():
+            assert not mx.autograd.is_recording()
+    assert not mx.autograd.is_recording()
+    prev = cag.set_is_training(True)
+    assert mx.autograd.is_training()
+    cag.set_is_training(prev)
+
+
+def test_mark_and_backward():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    g = nd.zeros((3,))
+    cag.mark_variables([x], [g])
+    with cag.train_section():
+        y = x * x
+    cag.backward([y])
+    np.testing.assert_allclose(g.asnumpy(), 2 * x.asnumpy())
+
+
+def test_grad_and_loss():
+    f = cag.grad_and_loss(lambda a: nd.sum(a * a * a))
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    grads, loss = f(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), 3 * x.asnumpy() ** 2)
+    np.testing.assert_allclose(loss.asnumpy(), 9.0)
+    g_only = cag.grad(lambda a: nd.sum(a * a))(x)
+    np.testing.assert_allclose(g_only[0].asnumpy(), 2 * x.asnumpy())
+
+
+def test_legacy_ndarray_op():
+    class Square(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = nd.square(in_data[0])
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = 2 * in_data[0] * out_grad[0]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+    data = mx.sym.var("data")
+    s = Square().get_symbol(data, name="sq")
+    exe = s.simple_bind(mx.cpu(), grad_req="write", data=(3,))
+    x = np.array([1.0, 2.0, -3.0], np.float32)
+    out = exe.forward(is_train=True, data=x)[0]
+    np.testing.assert_allclose(out.asnumpy(), x * x)
+    exe.backward(out_grads=nd.ones((3,)))
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * x)

@@ -185,3 +185,20 @@ def test_last_batch_discard():
     assert sum(1 for _ in it) == 2   # 5//2, last partial batch dropped
     with pytest.raises(ValueError):
         ImageIter(2, (3, 8, 8), aug_list=[], last_batch_handle="roll_over")
+
+
+def test_det_iter_reshape_updates_aug_chain():
+    it = ImageDetIter.__new__(ImageDetIter)
+    it.det_auglist = CreateDetAugmenter((3, 32, 32))
+    it.data_shape = (3, 32, 32)
+    it.max_objects, it.label_width = 2, 5
+    it.reshape(data_shape=(3, 64, 48))
+    import mxtpu.image as mimg
+    sizes = [a.augmenter.size for a in it.det_auglist
+             if getattr(a, "augmenter", None) is not None
+             and isinstance(a.augmenter, mimg.ForceResizeAug)]
+    assert sizes == [(48, 64)]
+    img, lab = it.det_auglist[0](_img(), _label())
+    for a in it.det_auglist:
+        img, lab = a(img, lab)
+    assert img.shape[:2] == (64, 48)

@@ -116,3 +116,43 @@ def test_libsvm_iter(tmp_path):
     np.testing.assert_allclose(b.data[0].asnumpy(),
                                [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
     np.testing.assert_allclose(b.label[0].asnumpy(), [1, 0])
+
+
+def test_libsvm_iter_csr(tmp_path):
+    import numpy as np
+    p = tmp_path / "d.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n"
+                 "0 1:0.5\n"
+                 "1 2:3.0 3:1.0\n")
+    import mxtpu as mx
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    b1 = it.next()
+    from mxtpu.ndarray.sparse import CSRNDArray
+    assert isinstance(b1.data[0], CSRNDArray)
+    np.testing.assert_allclose(
+        b1.data[0].asnumpy(),
+        [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1.0, 0.0])
+    b2 = it.next()
+    assert b2.pad == 1          # round_batch pads with the last row
+    np.testing.assert_allclose(b2.data[0].asnumpy()[0],
+                               [0, 0, 3.0, 1.0])
+    import pytest
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().pad == 0
+
+
+def test_libsvm_iter_label_file(tmp_path):
+    import numpy as np
+    d = tmp_path / "d.libsvm"
+    d.write_text("0 0:1.0\n0 1:1.0\n")
+    l = tmp_path / "l.libsvm"
+    l.write_text("0 0:0.25 2:0.75\n0 1:1.0\n")
+    import mxtpu as mx
+    it = mx.io.LibSVMIter(data_libsvm=str(d), data_shape=(2,),
+                          label_libsvm=str(l), batch_size=2)
+    b = it.next()
+    np.testing.assert_allclose(b.label[0].asnumpy(),
+                               [[0.25, 0, 0.75], [0, 1.0, 0]])

