@@ -6,6 +6,7 @@ written against the contrib names runs unchanged.
 from __future__ import annotations
 
 import functools
+import weakref
 
 from .. import autograd as _ag
 from .. import ndarray as _nd
@@ -39,12 +40,15 @@ def test_section():
     return _ag.pause()
 
 
-_marked = []   # (variable, gradient) pairs, in marking order
+_marked = []   # (weakref(variable), gradient) pairs, in marking order —
+               # weakrefs so out-of-scope models drop out instead of
+               # pinning every gradient buffer for the process lifetime
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
     _ag.mark_variables(variables, gradients, grad_reqs)
-    _marked.extend(zip(variables, gradients))
+    _marked.extend((weakref.ref(v), g)
+                   for v, g in zip(variables, gradients))
 
 
 def backward(outputs, out_grads=None, retain_graph=False):
@@ -53,8 +57,10 @@ def backward(outputs, out_grads=None, retain_graph=False):
 
 def compute_gradient(outputs, out_grads=None, retain_graph=False):
     """Reference compute_gradient: backward + return the gradients of the
-    variables marked via :func:`mark_variables`, in marking order."""
+    still-live variables marked via :func:`mark_variables`, in marking
+    order (dead markings are pruned)."""
     backward(outputs, out_grads, retain_graph)
+    _marked[:] = [(r, g) for r, g in _marked if r() is not None]
     return [g for _, g in _marked]
 
 

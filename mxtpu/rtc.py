@@ -142,13 +142,17 @@ class PallasKernel:
         fn, tensor_params = self._fn, [p for p in self._params
                                        if p.is_ndarray]
         n_in = len(in_arrays)
-        # scalar VALUES stay out of the cache key: they ride into the
-        # kernel as extra (1,)-shaped input operands, so a per-step scalar
-        # (decaying epsilon, step count) reuses the compiled kernel instead
-        # of recompiling and growing the cache every launch
-        scalar_names = tuple(sorted(scalars))
-        n_scal = len(scalar_names)
-        key = (grid, scalar_names,
+        # FLOAT scalars ride as traced (1,)-operands so per-step values
+        # (decaying epsilon) reuse one compile; INT scalars stay static
+        # Python constants — kernels use them for loop bounds / shapes /
+        # indexing, which tracers cannot serve — and key the cache.
+        import numpy as _onp
+        traced = {k: v for k, v in scalars.items()
+                  if not _onp.issubdtype(type(v), _onp.integer)}
+        static = {k: v for k, v in scalars.items() if k not in traced}
+        traced_names = tuple(sorted(traced))
+        n_scal = len(traced_names)
+        key = (grid, traced_names, tuple(sorted(static.items())),
                tuple((d.shape, str(d.dtype)) for _, d in in_arrays),
                tuple((d.shape, str(d.dtype)) for _, d in out_arrays))
         call = self._cache.get(key)
@@ -159,8 +163,9 @@ class PallasKernel:
                 # 'float *out, const float *x' kernels see (out_ref,
                 # x_ref) like the reference CudaKernel
                 ins = list(refs[:n_in])
-                kw = {nme: refs[n_in + i][0]
-                      for i, nme in enumerate(scalar_names)}
+                kw = dict(static)
+                kw.update({nme: refs[n_in + i][0]
+                           for i, nme in enumerate(traced_names)})
                 outs = list(refs[n_in + n_scal:])
                 ordered = [(ins if p.is_const else outs).pop(0)
                            for p in tensor_params]
@@ -175,8 +180,8 @@ class PallasKernel:
             ))
             self._cache[key] = call
         import jax.numpy as jnp
-        svals = [jnp.asarray(scalars[nme]).reshape(1)
-                 for nme in scalar_names]
+        svals = [jnp.asarray(traced[nme]).reshape(1)
+                 for nme in traced_names]
         outs = call(*([d for _, d in in_arrays] + svals))
         if not isinstance(outs, (list, tuple)):
             outs = [outs]

@@ -247,3 +247,23 @@ def test_rtc_scalar_no_recompile():
         k.launch((x, a, o), mx.cpu(0))
         np.testing.assert_allclose(o.asnumpy(), a)
     assert len(k._cache) == 1   # scalar value changes reuse the compile
+
+
+def test_rtc_int_scalar_static():
+    # int scalars are static: usable as Python loop bounds in the body
+    src = """
+def rep(x_ref, o_ref, *, n):
+    acc = x_ref[...]
+    for _ in range(n - 1):
+        acc = acc + x_ref[...]
+    o_ref[...] = acc
+"""
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("rep", "const float *x, int n, float *o")
+    x = nd.ones((4,))
+    o = nd.zeros((4,))
+    k.launch((x, 3, o), mx.cpu(0))
+    np.testing.assert_allclose(o.asnumpy(), 3.0)
+    k.launch((x, 5, o), mx.cpu(0))
+    np.testing.assert_allclose(o.asnumpy(), 5.0)
+    assert len(k._cache) == 2   # int value IS the specialization key

@@ -195,13 +195,17 @@ def convert_symbol(prototxt_text):
                                  p=float(p.get("dropout_ratio", 0.5)))
         elif ltype == "BatchNorm":
             p = layer.get("batch_norm_param", {})
+            # caffe BatchNorm has no affine terms; its paired Scale layer
+            # carries gamma/beta. Our BatchNorm owns gamma/beta, so keep
+            # gamma LEARNABLE (fix_gamma=False) and fold Scale to identity
+            # — the converted net keeps the per-channel scale capacity
+            # (reference convert_symbol.py emits fix_gamma=False too).
             sym = mx.sym.BatchNorm(
-                x, name=name, fix_gamma=True,
+                x, name=name, fix_gamma=False,
                 eps=float(p.get("eps", 1e-5)),
                 use_global_stats=bool(p.get("use_global_stats", False)))
         elif ltype == "Scale":
-            # caffe pairs BatchNorm with a Scale layer; BatchNorm here
-            # already carries gamma/beta, so Scale is identity
+            # affine absorbed by the preceding BatchNorm's gamma/beta
             sym = x
         elif ltype == "Concat":
             sym = mx.sym.Concat(*bottoms, name=name, dim=1)
@@ -231,21 +235,22 @@ def convert_symbol(prototxt_text):
         for t in tops:
             nodes[t] = sym
 
-    out = sym
-    return out, input_name or "data"
+    if "sym" not in dict(locals()):
+        raise ValueError("prototxt contains no convertible layers")
+    return sym, input_name or "data"
 
 
 def convert_model(prototxt_path, caffemodel_path, output_prefix):
-    """Full model conversion (reference convert_model.py). Requires the
-    caffe python package for the binary blob schema, like the reference."""
-    try:
-        import caffe  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "convert_model needs the caffe package to read .caffemodel "
-            "blobs (the reference caffe_parser.py has the same "
-            "requirement); convert_symbol works without it") from e
-    raise NotImplementedError("binary blob conversion requires caffe")
+    """Weight conversion is NOT implemented. The reference convert_model.py
+    reads .caffemodel blobs through caffe's protobuf schema; without a
+    caffe install to validate against, this build ships symbol conversion
+    only. Porting weights: load the net in caffe, dump each blob to an
+    .npz keyed by the symbol's parameter names, and save with
+    mxtpu.nd.save — the symbol from :func:`convert_symbol` binds to it."""
+    raise NotImplementedError(
+        "caffemodel blob conversion is not implemented; use "
+        "convert_symbol for the graph and port weights via numpy "
+        "(see docstring)")
 
 
 def main(argv=None):
