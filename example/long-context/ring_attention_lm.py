@@ -27,11 +27,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import jax                   # noqa: E402
 import jax.numpy as jnp      # noqa: E402
-from jax.sharding import PartitionSpec as P  # noqa: E402
-
 from mxtpu.parallel import MeshContext                      # noqa: E402
-from mxtpu.parallel.ring_attention import ring_attention    # noqa: E402
-from jax import shard_map    # noqa: E402
+from mxtpu.parallel.ring_attention import ring_attention_sharded  # noqa: E402
 
 VOCAB, DIM, HEADS, SEQ, PERIOD = 32, 64, 4, 256, 16
 
@@ -59,14 +56,8 @@ def model(params, tokens, mesh):
         return h.reshape(b, t, HEADS, d // HEADS).transpose(0, 2, 1, 3)
 
     q, k, v = (heads(x @ params[w]) for w in ("wq", "wk", "wv"))
-
-    attn = shard_map(
-        lambda qq, kk, vv: ring_attention(qq, kk, vv, axis_name="seq",
-                                          causal=True),
-        mesh=mesh.mesh,
-        in_specs=(P(None, None, "seq", None),) * 3,
-        out_specs=P(None, None, "seq", None), check_vma=False)
-    o = attn(q, k, v)                              # [B, H, T, dh]
+    o = ring_attention_sharded(q, k, v, mesh, causal=True,
+                               data_axis=None)    # [B, H, T, dh]
     o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
     x = x + o @ params["wo"]
     return x @ params["head"]

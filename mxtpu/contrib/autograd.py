@@ -6,7 +6,6 @@ written against the contrib names runs unchanged.
 from __future__ import annotations
 
 import functools
-import weakref
 
 from .. import autograd as _ag
 from .. import ndarray as _nd
@@ -40,28 +39,19 @@ def test_section():
     return _ag.pause()
 
 
-_marked = []   # (weakref(variable), gradient) pairs, in marking order —
-               # weakrefs so out-of-scope models drop out instead of
-               # pinning every gradient buffer for the process lifetime
-
-
 def mark_variables(variables, gradients, grad_reqs="write"):
     _ag.mark_variables(variables, gradients, grad_reqs)
-    _marked.extend((weakref.ref(v), g)
-                   for v, g in zip(variables, gradients))
 
 
 def backward(outputs, out_grads=None, retain_graph=False):
     _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
 
 
-def compute_gradient(outputs, out_grads=None, retain_graph=False):
-    """Reference compute_gradient: backward + return the gradients of the
-    still-live variables marked via :func:`mark_variables`, in marking
-    order (dead markings are pruned)."""
-    backward(outputs, out_grads, retain_graph)
-    _marked[:] = [(r, g) for r, g in _marked if r() is not None]
-    return [g for _, g in _marked]
+def compute_gradient(outputs):
+    """Deprecated. Please use backward (the reference's exact contract:
+    contrib/autograd.py:158 — runs backward, gradients land in the
+    buffers passed to mark_variables)."""
+    backward(outputs)
 
 
 def grad_and_loss(func, argnum=None):

@@ -59,21 +59,3 @@ def test_legacy_ndarray_op():
     np.testing.assert_allclose(out.asnumpy(), x * x)
     exe.backward(out_grads=nd.ones((3,)))
     np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * x)
-
-
-def test_compute_gradient_prunes_dead_markings():
-    import gc
-    x = nd.array(np.array([1.0], np.float32))
-    g = nd.zeros((1,))
-    cag.mark_variables([x], [g])
-    n_live = len(cag._marked)
-    del x
-    gc.collect()
-    y_var = nd.array(np.array([2.0], np.float32))
-    gy = nd.zeros((1,))
-    cag.mark_variables([y_var], [gy])
-    with cag.train_section():
-        out = y_var * y_var
-    grads = cag.compute_gradient([out])
-    assert grads[-1] is gy
-    assert len(cag._marked) < n_live + 1   # dead x pruned

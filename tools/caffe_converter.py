@@ -121,6 +121,7 @@ def convert_symbol(prototxt_text):
     layers = _as_list(net.get("layer")) or _as_list(net.get("layers"))
     nodes = {}
     input_name = None
+    sym = None
 
     for inp in _as_list(net.get("input")):
         nodes[inp] = mx.sym.var(inp)
@@ -235,7 +236,7 @@ def convert_symbol(prototxt_text):
         for t in tops:
             nodes[t] = sym
 
-    if "sym" not in dict(locals()):
+    if sym is None:
         raise ValueError("prototxt contains no convertible layers")
     return sym, input_name or "data"
 
