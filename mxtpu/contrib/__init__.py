@@ -5,5 +5,11 @@
 """
 from . import text
 from . import autograd
+from . import io
+from . import ndarray
+from . import symbol
+from . import tensorboard
+from . import onnx
 
-__all__ = ["text", "autograd"]
+__all__ = ["text", "autograd", "io", "ndarray", "symbol",
+           "tensorboard", "onnx"]

@@ -1,0 +1,58 @@
+"""contrib.io (reference python/mxnet/contrib/io.py): DataLoaderIter wraps
+a Gluon DataLoader as a classic DataIter for Module.fit."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..io import DataIter, DataDesc, DataBatch
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Iterate a gluon DataLoader through the DataIter protocol
+    (reference contrib/io.py:25). The loader must yield (data, label)
+    batches of constant batch size (use last_batch='discard'/'rollover')."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self._dtype = dtype
+        self.data_name = data_name
+        self.label_name = label_name
+        try:
+            first = next(self._iter)
+        except StopIteration:
+            raise ValueError("DataLoader is empty — DataLoaderIter needs "
+                             "at least one batch to infer shapes") from None
+        self._first = first
+        data, label = first
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(data_name, tuple(data.shape), dtype)]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape),
+                                       dtype)]
+
+    def reset(self):
+        self._first = None
+        self._iter = iter(self._loader)
+
+    def next(self):
+        if self._first is not None:
+            batch, self._first = self._first, None
+        else:
+            batch = next(self._iter)   # raises StopIteration at epoch end
+        data, label = batch
+        if not isinstance(data, nd.NDArray):
+            data = nd.array(_np.asarray(data))
+        if not isinstance(label, nd.NDArray):
+            label = nd.array(_np.asarray(label))
+        if data.shape[0] != self.batch_size:
+            raise ValueError(
+                "DataLoaderIter needs a constant batch size; got %d then "
+                "%d — construct the DataLoader with last_batch='discard'"
+                % (self.batch_size, data.shape[0]))
+        return DataBatch(data=[data.astype(self._dtype)],
+                         label=[label.astype(self._dtype)], pad=0)

@@ -1,0 +1,9 @@
+"""contrib.ndarray (reference python/mxnet/contrib/ndarray.py): the
+``_contrib_*`` op namespace as a module — ``from mxtpu.contrib import
+ndarray as C; C.quantize(...)``. Backed by the same registry that serves
+``nd.contrib``."""
+from ..ndarray import contrib as _contrib_ns
+
+
+def __getattr__(name):
+    return getattr(_contrib_ns, name)

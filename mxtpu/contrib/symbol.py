@@ -1,0 +1,7 @@
+"""contrib.symbol (reference python/mxnet/contrib/symbol.py): symbolic
+``_contrib_*`` namespace as a module, mirroring sym.contrib."""
+from ..symbol import contrib as _contrib_ns
+
+
+def __getattr__(name):
+    return getattr(_contrib_ns, name)

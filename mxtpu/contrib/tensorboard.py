@@ -1,0 +1,44 @@
+"""contrib.tensorboard (reference python/mxnet/contrib/tensorboard.py):
+LogMetricsCallback streams eval metrics to a TensorBoard event file.
+Uses tensorboardX or torch.utils.tensorboard, whichever is importable
+(the reference requires the standalone `tensorboard` python package)."""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+def _summary_writer(logging_dir):
+    try:
+        from tensorboardX import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except Exception:
+        # a tensorboardX broken by e.g. protobuf mismatch raises non-
+        # ImportError at import; fall through to the torch writer
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError as e:
+        raise ImportError(
+            "LogMetricsCallback requires tensorboardX or torch's "
+            "tensorboard writer (reference requires the `tensorboard` "
+            "package)") from e
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging eval metrics as tensorboard scalars
+    (reference contrib/tensorboard.py:25)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _summary_writer(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -59,3 +59,60 @@ def test_legacy_ndarray_op():
     np.testing.assert_allclose(out.asnumpy(), x * x)
     exe.backward(out_grads=nd.ones((3,)))
     np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * x)
+
+
+def test_contrib_io_dataloader_iter():
+    from mxtpu import gluon
+    from mxtpu.contrib.io import DataLoaderIter
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = (np.arange(10) % 2).astype(np.float32)
+    ds = gluon.data.ArrayDataset(x, y)
+    loader = gluon.data.DataLoader(ds, batch_size=5)
+    it = DataLoaderIter(loader)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    it.reset()
+    assert len(list(it)) == 2
+    # Module.fit accepts it
+    import logging
+    logging.disable(logging.INFO)
+    data = mx.sym.var("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it.reset()
+    mod.fit(it, num_epoch=1, initializer=mx.init.Xavier())
+
+
+def test_contrib_nd_sym_namespaces():
+    from mxtpu.contrib import ndarray as cnd
+    from mxtpu.contrib import symbol as csym
+    out = cnd.quantize(nd.array(np.array([0.0, 0.5, 1.0], np.float32)),
+                       nd.array(np.array([0.0], np.float32)),
+                       nd.array(np.array([1.0], np.float32)))
+    assert len(out) == 3
+    assert csym.MultiBoxPrior is not None
+
+
+def test_contrib_tensorboard_and_onnx_gating():
+    import pytest
+    from mxtpu.contrib import tensorboard as tb
+    try:
+        import torch.utils.tensorboard  # noqa: F401
+        has_writer = True
+    except Exception:
+        has_writer = False
+    if has_writer:
+        import tempfile
+        cb = tb.LogMetricsCallback(tempfile.mkdtemp())
+        metric = mx.metric.Accuracy()
+        metric.update([nd.array(np.array([0.0, 1.0], np.float32))],
+                      [nd.array(np.array([[0.9, 0.1], [0.2, 0.8]],
+                                         np.float32))])
+        from mxtpu.model import BatchEndParam
+        cb(BatchEndParam(epoch=0, nbatch=1, eval_metric=metric,
+                         locals=None))
+    from mxtpu.contrib import onnx as onnx_mod
+    with pytest.raises((ImportError, NotImplementedError)):
+        onnx_mod.import_model("x.onnx")
