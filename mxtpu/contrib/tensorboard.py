@@ -8,21 +8,24 @@ __all__ = ["LogMetricsCallback"]
 
 
 def _summary_writer(logging_dir):
+    writer_cls = None
     try:
-        from tensorboardX import SummaryWriter
-        return SummaryWriter(logging_dir)
+        from tensorboardX import SummaryWriter as writer_cls  # noqa: F811
     except Exception:
         # a tensorboardX broken by e.g. protobuf mismatch raises non-
         # ImportError at import; fall through to the torch writer
-        pass
-    try:
-        from torch.utils.tensorboard import SummaryWriter
-        return SummaryWriter(logging_dir)
-    except ImportError as e:
-        raise ImportError(
-            "LogMetricsCallback requires tensorboardX or torch's "
-            "tensorboard writer (reference requires the `tensorboard` "
-            "package)") from e
+        writer_cls = None
+    if writer_cls is None:
+        try:
+            from torch.utils.tensorboard import                 SummaryWriter as writer_cls  # noqa: F811
+        except ImportError as e:
+            raise ImportError(
+                "LogMetricsCallback requires tensorboardX or torch's "
+                "tensorboard writer (reference requires the `tensorboard` "
+                "package)") from e
+    # construct OUTSIDE the import guards: a real failure (unwritable
+    # logging_dir, ...) must surface as itself, not as a missing package
+    return writer_cls(logging_dir)
 
 
 class LogMetricsCallback:

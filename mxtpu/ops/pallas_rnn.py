@@ -1,0 +1,132 @@
+"""Pallas fused LSTM time loop (cuDNN-RNN parity, the second hot op).
+
+The fused RNN op (ops/rnn.py) hoists the input projection into one big
+MXU matmul and scans the recurrence with ``lax.scan``. This module lowers
+the scan body itself to a Pallas kernel: the grid walks time steps while
+h/c live in VMEM scratch across the whole sequence — no per-step HBM
+round-trip for the carry, and the gate pointwise math fuses with the
+h @ Wh matmul in one kernel (the reference gets this from cuDNN's fused
+LSTM, ``src/operator/cudnn_rnn-inl.h``).
+
+Differentiation: custom VJP whose backward recomputes through the
+mathematically identical ``lax.scan`` formulation — residuals stay tiny
+(the inputs), matching the rematerialization discipline used elsewhere.
+
+Non-TPU backends run the same kernel through the Pallas interpreter, so
+tests cover it everywhere; ``ops.rnn`` routes LSTM through this path on
+TPU (override with ``mxtpu.ops.rnn.USE_PALLAS_LSTM``).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["lstm_scan"]
+
+
+@functools.cache
+def _fwd_call():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(xp_ref, wh_ref, h0_ref, c0_ref, ys_ref, ht_ref, ct_ref,
+               h_s, c_s, *, T, H):
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _init():
+            h_s[:] = h0_ref[:].astype(jnp.float32)
+            c_s[:] = c0_ref[:].astype(jnp.float32)
+
+        h, c = h_s[:], c_s[:]
+        gates = xp_ref[0].astype(jnp.float32) + jax.lax.dot_general(
+            h, wh_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
+        f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
+        g = jnp.tanh(gates[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        h_s[:], c_s[:] = h, c
+        ys_ref[0] = h.astype(ys_ref.dtype)
+
+        @pl.when(t == T - 1)
+        def _fin():
+            ht_ref[:] = h.astype(ht_ref.dtype)
+            ct_ref[:] = c.astype(ct_ref.dtype)
+
+    def call(x_proj, h0, c0, wh_t):
+        T, N, G = x_proj.shape
+        H = h0.shape[-1]
+        return pl.pallas_call(
+            functools.partial(kernel, T=T, H=H),
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((1, N, G), lambda t: (t, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, N, H), lambda t: (t, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, N, H), x_proj.dtype),
+                jax.ShapeDtypeStruct((N, H), h0.dtype),
+                jax.ShapeDtypeStruct((N, H), c0.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((N, H), jnp.float32),
+                            pltpu.VMEM((N, H), jnp.float32)],
+            interpret=jax.default_backend() != "tpu",
+        )(x_proj, wh_t, h0, c0)
+
+    return call
+
+
+def _scan_reference(x_proj, h0, c0, wh_t):
+    """The mathematically identical lax.scan formulation (used for the
+    backward recompute and as the numeric cross-check in tests)."""
+    H = h0.shape[-1]
+
+    def step(carry, xp):
+        h, c = carry
+        gates = xp + h @ wh_t
+        i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
+        f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
+        g = jnp.tanh(gates[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), x_proj)
+    return ys, hT, cT
+
+
+@jax.custom_vjp
+def lstm_scan(x_proj, h0, c0, wh_t):
+    """Fused LSTM over time. x_proj: (T, N, 4H) pre-projected inputs
+    (x @ Wx + biases), h0/c0: (N, H), wh_t: (H, 4H) transposed recurrent
+    weights, gate order [i, f, g, o]. Returns (ys (T,N,H), hT, cT)."""
+    return _fwd_call()(x_proj, h0, c0, wh_t)
+
+
+def _vjp_fwd(x_proj, h0, c0, wh_t):
+    out = _fwd_call()(x_proj, h0, c0, wh_t)
+    return out, (x_proj, h0, c0, wh_t)
+
+
+def _vjp_bwd(res, cot):
+    # recompute-based backward through the identical scan math
+    _, vjp = jax.vjp(_scan_reference, *res)
+    return vjp(cot)
+
+
+lstm_scan.defvjp(_vjp_fwd, _vjp_bwd)

@@ -22,6 +22,18 @@ from .registry import register, next_rng_key
 
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
+# LSTM time loop backend: None = auto (Pallas kernel on TPU, lax.scan
+# elsewhere); True/False force. Read at TRACE time — set it before the
+# first forward of a model; already-jit-cached traces keep whichever
+# backend they were traced with. See ops/pallas_rnn.py.
+USE_PALLAS_LSTM = None
+
+
+def _pallas_lstm_enabled():
+    if USE_PALLAS_LSTM is not None:
+        return USE_PALLAS_LSTM
+    return jax.default_backend() == "tpu"
+
 
 def _unpack_params(params, mode, input_size, state_size, num_layers,
                    num_dir):
@@ -106,6 +118,12 @@ def _run_direction(xs, h0, c0, wi, wh, bi, bh, mode, reverse):
             n = jnp.tanh(xp[:, 2 * H:] + r * (h @ wh_n.T + bh_n))
             new_h = (1 - z) * n + z * h
             return (new_h, new_h), new_h
+    elif mode == "lstm" and _pallas_lstm_enabled():
+        from .pallas_rnn import lstm_scan
+        ys, hT, cT = lstm_scan(x_proj + bh, h0, c0, wh.T)
+        if reverse:
+            ys = jnp.flip(ys, axis=0)
+        return ys, hT, cT
     else:
         cell = _cell_step(mode, H)
 

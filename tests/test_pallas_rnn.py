@@ -1,0 +1,105 @@
+"""Pallas fused LSTM time loop vs the lax.scan formulation
+(ops/pallas_rnn.py; interpret mode on the CPU test mesh)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.ops import rnn as rnn_ops
+from mxtpu.ops.pallas_rnn import lstm_scan, _scan_reference
+
+
+def _inputs(T=6, N=4, H=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.standard_normal((T, N, 4 * H)).astype(np.float32)),
+            jnp.asarray(rng.standard_normal((N, H)).astype(np.float32)),
+            jnp.asarray(rng.standard_normal((N, H)).astype(np.float32)),
+            jnp.asarray(rng.standard_normal((H, 4 * H)).astype(np.float32)
+                        * 0.3))
+
+
+def test_forward_matches_scan():
+    xp, h0, c0, wh = _inputs()
+    ys_p, ht_p, ct_p = lstm_scan(xp, h0, c0, wh)
+    ys_s, ht_s, ct_s = _scan_reference(xp, h0, c0, wh)
+    np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_s),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ht_p), np.asarray(ht_s),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ct_p), np.asarray(ct_s),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gradients_match_scan():
+    xp, h0, c0, wh = _inputs(T=4, N=2, H=4, seed=3)
+
+    def loss(fn, *args):
+        ys, ht, ct = fn(*args)
+        return jnp.sum(ys ** 2) + jnp.sum(jnp.sin(ht)) + jnp.sum(ct)
+
+    gp = jax.grad(lambda *a: loss(lstm_scan, *a),
+                  argnums=(0, 1, 2, 3))(xp, h0, c0, wh)
+    gs = jax.grad(lambda *a: loss(_scan_reference, *a),
+                  argnums=(0, 1, 2, 3))(xp, h0, c0, wh)
+    for a, b in zip(gp, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_rnn_op_pallas_path(bidirectional):
+    """Full fused RNN op: pallas LSTM path == scan path, fwd and grads."""
+    T, N, I, H, L = 5, 3, 6, 4, 2
+    rng = np.random.RandomState(7)
+    x = rng.standard_normal((T, N, I)).astype(np.float32)
+    ndir = 2 if bidirectional else 1
+    psize = rnn_ops.rnn_param_size("lstm", I, H, L, bidirectional)
+    params = (rng.standard_normal(psize) * 0.2).astype(np.float32)
+    h0 = np.zeros((L * ndir, N, H), np.float32)
+    c0 = np.zeros((L * ndir, N, H), np.float32)
+
+    def run():
+        return mx.nd.RNN(nd.array(x), nd.array(params), nd.array(h0),
+                         nd.array(c0), state_size=H, num_layers=L,
+                         mode="lstm", bidirectional=bidirectional,
+                         state_outputs=True)
+
+    try:
+        rnn_ops.USE_PALLAS_LSTM = False
+        ref = [o.asnumpy() for o in run()]
+        rnn_ops.USE_PALLAS_LSTM = True
+        got = [o.asnumpy() for o in run()]
+    finally:
+        rnn_ops.USE_PALLAS_LSTM = None
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_gluon_lstm_layer_pallas_path():
+    from mxtpu.gluon import rnn as grnn
+    T, N, I, H = 4, 2, 5, 3
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.standard_normal((T, N, I)).astype(np.float32))
+    mx.random.seed(0)
+    layer = grnn.LSTM(H, num_layers=1)
+    layer.initialize(mx.init.Xavier())
+
+    def fwd_and_grad():
+        with mx.autograd.record():
+            out = layer(x)
+            loss = (out * out).sum()
+        loss.backward()
+        w = next(iter(layer.collect_params().values()))
+        return out.asnumpy(), w.grad().asnumpy()
+
+    try:
+        rnn_ops.USE_PALLAS_LSTM = False
+        out_ref, g_ref = fwd_and_grad()
+        rnn_ops.USE_PALLAS_LSTM = True
+        out_p, g_p = fwd_and_grad()
+    finally:
+        rnn_ops.USE_PALLAS_LSTM = None
+    np.testing.assert_allclose(out_p, out_ref, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(g_p, g_ref, atol=1e-5, rtol=1e-5)
