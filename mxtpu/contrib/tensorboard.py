@@ -17,7 +17,8 @@ def _summary_writer(logging_dir):
         writer_cls = None
     if writer_cls is None:
         try:
-            from torch.utils.tensorboard import                 SummaryWriter as writer_cls  # noqa: F811
+            from torch.utils.tensorboard import (  # noqa: F811
+                SummaryWriter as writer_cls)
         except ImportError as e:
             raise ImportError(
                 "LogMetricsCallback requires tensorboardX or torch's "

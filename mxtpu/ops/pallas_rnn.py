@@ -92,22 +92,27 @@ def _fwd_call():
 
 def _scan_reference(x_proj, h0, c0, wh_t):
     """The mathematically identical lax.scan formulation (used for the
-    backward recompute and as the numeric cross-check in tests)."""
+    backward recompute and as the numeric cross-check in tests). Must
+    mirror the kernel's precision EXACTLY — carry and gate math in f32,
+    outputs cast back — or bf16 gradients would belong to a different
+    function than the forward that ran."""
     H = h0.shape[-1]
+    wh32 = wh_t.astype(jnp.float32)
 
     def step(carry, xp):
         h, c = carry
-        gates = xp + h @ wh_t
+        gates = xp.astype(jnp.float32) + h @ wh32
         i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
         f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
         g = jnp.tanh(gates[:, 2 * H:3 * H])
         o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
         c = f * c + i * g
         h = o * jnp.tanh(c)
-        return (h, c), h
+        return (h, c), h.astype(x_proj.dtype)
 
-    (hT, cT), ys = jax.lax.scan(step, (h0, c0), x_proj)
-    return ys, hT, cT
+    (hT, cT), ys = jax.lax.scan(
+        step, (h0.astype(jnp.float32), c0.astype(jnp.float32)), x_proj)
+    return ys, hT.astype(h0.dtype), cT.astype(c0.dtype)
 
 
 @jax.custom_vjp

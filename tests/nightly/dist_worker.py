@@ -1,0 +1,73 @@
+"""Multi-process SPMD worker (reference tests/nightly/dist_sync_kvstore.py,
+launched by tools/launch.py --launcher local).
+
+Each process initializes jax.distributed from the launcher's env, builds
+a global mesh over all processes' CPU devices, and runs (a) a psum
+all-reduce, (b) a tiny data-parallel training step — asserting both are
+bitwise identical across processes (the dist_sync property the reference
+nightly checks via kvstore push/pull).
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+coord = os.environ["MXTPU_COORDINATOR"]
+nproc = int(os.environ["MXTPU_NUM_PROCS"])
+rank = int(os.environ["MXTPU_PROC_ID"])
+jax.distributed.initialize(coordinator_address=coord, num_processes=nproc,
+                           process_id=rank)
+
+import jax.numpy as jnp                                     # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+from jax import shard_map                                   # noqa: E402
+
+devs = jax.devices()          # all processes' devices, DCN-addressable
+assert len(devs) >= nproc
+mesh = Mesh(np.array(devs), ("x",))
+sharding = NamedSharding(mesh, P("x"))
+
+# (a) cross-process psum: every process contributes rank+1
+n = len(devs)
+host = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+x = jax.make_array_from_callback(
+    (n, 4), sharding, lambda idx: host[idx])
+f = jax.jit(shard_map(lambda v: jax.lax.psum(v, "x"), mesh=mesh,
+                      in_specs=P("x"), out_specs=P("x"), check_vma=False))
+red = f(x)
+expect = host.sum(axis=0)
+got = np.asarray(jax.device_get(red.addressable_shards[0].data))[0]
+np.testing.assert_allclose(got, expect)
+
+# (b) data-parallel least-squares step: grads psum'd over the mesh
+w = jnp.zeros((4,))
+rng = np.random.RandomState(0)          # same data everywhere; shards split
+X = rng.standard_normal((n * 8, 4)).astype(np.float32)
+wt = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+y = X @ wt
+Xg = jax.make_array_from_callback((n * 8, 4), sharding,
+                                  lambda idx: X[idx])
+yg = jax.make_array_from_callback((n * 8,), sharding, lambda idx: y[idx])
+
+
+@jax.jit
+def step(w, Xl, yl):
+    def local(w, Xs, ys):
+        g = 2 * Xs.T @ (Xs @ w - ys) / (n * 8)
+        return jax.lax.psum(g, "x")
+    g = shard_map(local, mesh=mesh,
+                  in_specs=(P(), P("x"), P("x")), out_specs=P(),
+                  check_vma=False)(w, Xl, yl)
+    return w - 0.05 * g
+
+
+for _ in range(200):
+    w = step(w, Xg, yg)
+w_np = np.asarray(jax.device_get(w))
+np.testing.assert_allclose(w_np, wt, atol=2e-2)
+print("RANK_%d_OK nprocs=%d ndevices=%d" % (rank, nproc, n))

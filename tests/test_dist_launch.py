@@ -1,0 +1,32 @@
+"""Multi-process distributed training via the local launcher (reference
+tests/nightly/dist_sync_kvstore.py run through tools/launch.py -n 2
+--launcher local: fork worker processes on one host, real cross-process
+collectives over jax.distributed)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_local_launcher_dist_training():
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)            # one device per process
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--port", str(_free_port()),
+         sys.executable + " " + os.path.join(root, "tests", "nightly",
+                                             "dist_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-2000:]
+    assert "RANK_0_OK" in out and "RANK_1_OK" in out, out[-2000:]

@@ -103,3 +103,32 @@ def test_gluon_lstm_layer_pallas_path():
         rnn_ops.USE_PALLAS_LSTM = None
     np.testing.assert_allclose(out_p, out_ref, atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(g_p, g_ref, atol=1e-5, rtol=1e-5)
+
+
+def test_bf16_forward_backward_consistent():
+    # bf16 inputs: backward recompute must mirror the kernel's f32-carry
+    # precision so gradients belong to the same function as the forward
+    xp, h0, c0, wh = _inputs(T=5, N=2, H=4, seed=9)
+    xp = xp.astype(jnp.bfloat16)
+    h0 = h0.astype(jnp.bfloat16)
+    c0 = c0.astype(jnp.bfloat16)
+    wh = wh.astype(jnp.bfloat16)
+    ys_p, ht_p, ct_p = lstm_scan(xp, h0, c0, wh)
+    ys_s, ht_s, ct_s = _scan_reference(xp, h0, c0, wh)
+    assert ys_p.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(ys_p, np.float32),
+                               np.asarray(ys_s, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+    def loss(fn, *a):
+        ys, ht, ct = fn(*a)
+        return jnp.sum(ys.astype(jnp.float32) ** 2)
+
+    gp = jax.grad(lambda *a: loss(lstm_scan, *a), argnums=(0, 3))(
+        xp, h0, c0, wh)
+    gs = jax.grad(lambda *a: loss(_scan_reference, *a), argnums=(0, 3))(
+        xp, h0, c0, wh)
+    for a, b in zip(gp, gs):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=5e-2, rtol=5e-2)
