@@ -37,7 +37,7 @@ def _optional_imports():
         ("io", ()), ("callback", ()), ("model", ()), ("module", ("mod",)),
         ("kvstore", ("kv",)), ("kvstore_server", ()),
         ("gluon", ()), ("parallel", ()),
-        ("gradient_compression", ()),
+        ("gradient_compression", ()), ("checkpoint", ()),
         ("profiler", ()), ("recordio", ()), ("image", ()),
         ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
         ("rnn", ()), ("engine", ()), ("operator", ()), ("contrib", ()),

@@ -12,8 +12,6 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
-
 import jax
 jax.config.update("jax_platforms", "cpu")
 

@@ -1,0 +1,101 @@
+"""Async checkpoint manager (mxtpu/checkpoint.py): orbax backend and the
+thread fallback, params + trainer state + metadata, retention, restart."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, gluon
+from mxtpu.gluon import nn
+from mxtpu.checkpoint import CheckpointManager
+
+
+def _net_and_trainer(seed=0):
+    # fresh process semantics: reset the auto-naming counter so a restart
+    # rebuilds the same parameter names the checkpoint was saved under
+    import mxtpu.gluon.block as _blk
+    _blk._NAME_COUNTERS.clear()
+    mx.random.seed(seed)
+    net = nn.Dense(3, in_units=4)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    x = nd.array(np.random.RandomState(seed).rand(2, 4).astype(np.float32))
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+    return net, trainer, x
+
+
+@pytest.mark.parametrize("use_orbax", [True, False])
+def test_save_restore_roundtrip(tmp_path, use_orbax):
+    if use_orbax:
+        pytest.importorskip("orbax.checkpoint")
+    net, trainer, x = _net_and_trainer()
+    before = net(x).asnumpy()
+    ckpt = CheckpointManager(str(tmp_path / ("o" if use_orbax else "f")),
+                             use_orbax=use_orbax)
+    ckpt.save(7, net.collect_params(), trainer=trainer,
+              metadata={"epoch": 7, "note": "hi"})
+    ckpt.wait_until_finished()
+    assert ckpt.latest_step() == 7
+
+    # fresh model restored in place
+    net2, trainer2, _ = _net_and_trainer(seed=5)
+    tree = ckpt.restore(None, net2.collect_params(), trainer=trainer2)
+    np.testing.assert_allclose(net2(x).asnumpy(), before, rtol=1e-6)
+    assert tree["metadata"]["epoch"] == 7
+    ckpt.close()
+
+
+@pytest.mark.parametrize("use_orbax", [True, False])
+def test_retention_and_latest(tmp_path, use_orbax):
+    if use_orbax:
+        pytest.importorskip("orbax.checkpoint")
+    net, trainer, _ = _net_and_trainer()
+    ckpt = CheckpointManager(str(tmp_path / "r"), max_to_keep=2,
+                             async_save=False, use_orbax=use_orbax)
+    for step in (1, 2, 3, 4):
+        ckpt.save(step, net.collect_params())
+    ckpt.wait_until_finished()
+    steps = ckpt.all_steps()
+    assert steps[-1] == 4 and len(steps) <= 2
+    ckpt.close()
+
+
+def test_restore_empty_returns_none(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "e"), use_orbax=False)
+    assert ckpt.restore() is None
+    assert ckpt.latest_step() is None
+
+
+def test_crash_safe_fallback(tmp_path):
+    # a stale .tmp dir from a crashed save must not shadow real steps
+    net, trainer, _ = _net_and_trainer()
+    ckpt = CheckpointManager(str(tmp_path / "c"), async_save=False,
+                             use_orbax=False)
+    ckpt.save(1, net.collect_params())
+    import os
+    os.makedirs(str(tmp_path / "c" / "step_9.tmp"))
+    assert ckpt.all_steps() == [1]
+    ckpt.save(2, net.collect_params())   # overwrites cleanly
+    assert ckpt.latest_step() == 2
+
+
+def test_restore_missing_explicit_step(tmp_path):
+    net, trainer, _ = _net_and_trainer()
+    ckpt = CheckpointManager(str(tmp_path / "m"), async_save=False,
+                             use_orbax=False)
+    ckpt.save(1, net.collect_params())
+    assert ckpt.restore(3) is None      # reaped/never-written step
+
+
+def test_async_write_failure_surfaces(tmp_path):
+    net, trainer, _ = _net_and_trainer()
+    ckpt = CheckpointManager(str(tmp_path / "good"), use_orbax=False)
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")
+    ckpt.directory = str(blocker)       # writer's makedirs now fails
+    ckpt.save(1, net.collect_params())
+    with pytest.raises(RuntimeError):
+        ckpt.wait_until_finished()

@@ -21,12 +21,21 @@ def test_local_launcher_dist_training():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)            # one device per process
     env["JAX_PLATFORMS"] = "cpu"
-    res = subprocess.run(
+    # own process group so a timeout can reap the launcher's worker
+    # grandchildren too (Popen(shell=True) would otherwise orphan them)
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(root, "tools", "launch.py"),
          "-n", "2", "--launcher", "local", "--port", str(_free_port()),
          sys.executable + " " + os.path.join(root, "tests", "nightly",
                                              "dist_worker.py")],
-        env=env, capture_output=True, text=True, timeout=420)
-    out = res.stdout + res.stderr
-    assert res.returncode == 0, out[-2000:]
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.communicate()
+        raise
+    assert proc.returncode == 0, out[-2000:]
     assert "RANK_0_OK" in out and "RANK_1_OK" in out, out[-2000:]
