@@ -18,6 +18,7 @@ from . import linalg_ops     # noqa: F401
 from . import rnn            # noqa: F401
 from . import vision         # noqa: F401
 from . import contrib_ops    # noqa: F401
+from . import extra_ops      # noqa: F401
 
 
 @register("_contrib_flash_attention", aliases=("flash_attention",))

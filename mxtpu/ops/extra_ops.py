@@ -1,0 +1,396 @@
+"""Remaining reference-registry ops surfaced by the coverage sweep.
+
+Bounding-box utilities (``src/operator/contrib/bounding_box.cc``),
+deformable convolution / PS-ROI pooling (R-FCN,
+``src/operator/contrib/deformable_convolution.cc`` /
+``deformable_psroi_pooling.cc``), legacy ``Crop`` / ``*_v1`` variants,
+image tensor ops (``src/operator/image/image_random-inl.h``), AdaGrad
+update ops (``src/operator/optimizer_op.cc``), ``reshape_like``,
+``softmax_cross_entropy``, the docs' ``quadratic`` example op, and
+``IdentityAttachKLSparseReg`` (``src/operator/identity_attach_KL_sparse_reg.cc``).
+All pure jax; the deformable family vectorizes bilinear sampling over
+gather instead of the reference's per-thread CUDA loops.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias, get_op
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# bounding boxes (contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+
+def _corner_iou(a, b):
+    """IoU of [..., 4] corner boxes, broadcasting leading dims."""
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:4], b[..., 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = lambda x: jnp.maximum(x[..., 2] - x[..., 0], 0.0) * \
+        jnp.maximum(x[..., 3] - x[..., 1], 0.0)
+    union = area(a) + area(b) - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    ctr, wh = boxes[..., :2], boxes[..., 2:4]
+    return jnp.concatenate([ctr - wh / 2, ctr + wh / 2], axis=-1)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",), differentiable=False)
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU: lhs [..., N, 4] x rhs [..., M, 4] -> [..., N, M]
+    (reference bounding_box.cc BoxIoU)."""
+    a = _to_corner(lhs, format)[..., :, None, :]
+    b = _to_corner(rhs, format)[..., None, :, :]
+    return _corner_iou(a, b)
+
+
+@register("_contrib_box_nms", aliases=("box_nms",), differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference bounding_box.cc BoxNMS).
+
+    data: [..., N, K] rows with a score column and 4 coord columns;
+    suppressed/invalid rows come back with score -1 (the reference's
+    marker), sorted by score descending.
+    """
+    batch_shape = data.shape[:-2]
+    n, k = data.shape[-2], data.shape[-1]
+    flat = data.reshape((-1, n, k))
+
+    def one(rows):
+        scores = rows[:, score_index]
+        boxes = _to_corner(rows[:, coord_start:coord_start + 4], in_format)
+        ids = rows[:, id_index] if id_index >= 0 else jnp.zeros(n)
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        rows_s = rows[order]
+        boxes_s = boxes[order]
+        ids_s = ids[order]
+        valid_s = valid[order]
+        if topk > 0:
+            valid_s = valid_s & (jnp.arange(n) < topk)
+        iou = _corner_iou(boxes_s[:, None, :], boxes_s[None, :, :])
+        same_cls = (ids_s[:, None] == ids_s[None, :]) | force_suppress
+        sup_pair = (iou > overlap_thresh) & same_cls
+
+        def body(i, keep):
+            sup_by_i = sup_pair[i] & keep[i] & (jnp.arange(n) > i)
+            return jnp.where(sup_by_i, False, keep)
+
+        keep = jax.lax.fori_loop(0, n, body, valid_s)
+        score_col = jnp.where(keep, rows_s[:, score_index], -1.0)
+        out = rows_s.at[:, score_index].set(score_col)
+        if out_format != in_format:
+            cur = out[:, coord_start:coord_start + 4]
+            if out_format == "corner":
+                conv = _to_corner(cur, in_format)
+            else:                       # corner -> center
+                tl, br = cur[:, :2], cur[:, 2:4]
+                conv = jnp.concatenate([(tl + br) / 2, br - tl], axis=-1)
+            out = out.at[:, coord_start:coord_start + 4].set(conv)
+        return out
+
+    return jax.vmap(one)(flat).reshape(batch_shape + (n, k))
+
+
+@register("_contrib_bipartite_matching", num_outputs=2,
+          differentiable=False)
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
+    """Greedy bipartite matching on a score matrix [..., N, M]
+    (reference bounding_box.cc BipartiteMatching): repeatedly take the
+    globally best unmatched (row, col) pair. Returns (row_match [.., N],
+    col_match [.., M]); unmatched entries are -1."""
+    batch_shape = data.shape[:-2]
+    n, m = data.shape[-2], data.shape[-1]
+    flat = data.reshape((-1, n, m))
+    sign = 1.0 if is_ascend else -1.0
+    limit = n if topk <= 0 else min(topk, n)
+
+    def one(score):
+        s = score * sign                     # minimize s
+
+        def body(_, carry):
+            s_cur, row_m, col_m = carry
+            idx = jnp.argmin(s_cur)
+            r, c = idx // m, idx % m
+            ok = jnp.isfinite(s_cur[r, c])
+            if is_ascend:
+                ok = ok & (score[r, c] <= threshold)
+            else:
+                ok = ok & (score[r, c] >= threshold)
+            row_m = jnp.where(ok, row_m.at[r].set(c), row_m)
+            col_m = jnp.where(ok, col_m.at[c].set(r), col_m)
+            s_cur = jnp.where(ok, s_cur.at[r, :].set(jnp.inf), s_cur)
+            s_cur = jnp.where(ok, s_cur.at[:, c].set(jnp.inf), s_cur)
+            return s_cur, row_m, col_m
+
+        _, row_m, col_m = jax.lax.fori_loop(
+            0, limit, body,
+            (s, jnp.full((n,), -1.0), jnp.full((m,), -1.0)))
+        return row_m, col_m
+
+    rows, cols = jax.vmap(one)(flat)
+    return (rows.reshape(batch_shape + (n,)),
+            cols.reshape(batch_shape + (m,)))
+
+
+# ---------------------------------------------------------------------------
+# deformable ops (contrib/deformable_convolution.cc, deformable_psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+def _bilinear(data, y, x):
+    """Sample data [C, H, W] at float coords y, x [...]; zero padding."""
+    C, H, W = data.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1, wx1 = y - y0, x - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            v = data[:, jnp.clip(yy, 0, H - 1), jnp.clip(xx, 0, W - 1)]
+            out = out + v * (wy * wx * ok)[None]
+    return out                               # [C, ...]
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",), needs_train_flag=False)
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_filter=0, num_group=1, num_deformable_group=1,
+                           no_bias=False, workspace=1024, layout=None):
+    """Deformable conv v1 (Dai et al.; reference contrib/
+    deformable_convolution.cc): each kernel tap samples the input at its
+    regular location plus a learned per-position offset, via bilinear
+    interpolation — rendered as gather + einsum instead of CUDA loops.
+
+    data [B, C, H, W]; offset [B, 2*G_d*kh*kw, Ho, Wo] (y/x interleaved
+    per tap); weight [F, C/g, kh, kw]."""
+    B, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    G = num_deformable_group
+    taps = kh * kw
+    off = offset.reshape(B, G, taps, 2, Ho, Wo)
+
+    base_y = (jnp.arange(Ho) * sh - ph)[:, None] + jnp.zeros((1, Wo))
+    base_x = (jnp.arange(Wo) * sw - pw)[None, :] + jnp.zeros((Ho, 1))
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                          indexing="ij")
+    ky = ky.reshape(taps)
+    kx = kx.reshape(taps)
+
+    Cg = C // G
+
+    def per_image(img, offs):
+        # img [C,H,W]; offs [G, taps, 2, Ho, Wo]
+        cols = []
+        for g in range(G):
+            y = base_y[None] + ky[:, None, None] + offs[g, :, 0]
+            x = base_x[None] + kx[:, None, None] + offs[g, :, 1]
+            samp = _bilinear(img[g * Cg:(g + 1) * Cg], y, x)
+            cols.append(samp)                # [Cg, taps, Ho, Wo]
+        return jnp.concatenate(cols, axis=0)  # [C, taps, Ho, Wo]
+
+    col = jax.vmap(per_image)(data, off)      # [B, C, taps, Ho, Wo]
+    F = weight.shape[0]
+    wg = weight.reshape(num_group, F // num_group, C // num_group, taps)
+    colg = col.reshape(B, num_group, C // num_group, taps, Ho, Wo)
+    out = jnp.einsum("gfct,bgcthw->bgfhw", wg, colg)
+    out = out.reshape(B, F, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, F, 1, 1)
+    return out
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",), num_outputs=1)
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=0, group_size=1, pooled_size=7,
+                             part_size=0, sample_per_part=4,
+                             trans_std=0.0, no_trans=False):
+    """Deformable position-sensitive ROI pooling (R-FCN; reference
+    contrib/deformable_psroi_pooling.cc). data [B, C, H, W] with
+    C = output_dim * group_size^2; rois [R, 5] (batch_idx, x1, y1, x2,
+    y2); trans [R, 2*part^2, 1, 1]-ish per-part offsets."""
+    B, C, H, W = data.shape
+    P = pooled_size
+    part = part_size or P
+    gs = group_size
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / P, rh / P
+        img = data[b]
+        iy, ix = jnp.meshgrid(jnp.arange(P), jnp.arange(P), indexing="ij")
+        # per-bin offsets from trans, scaled by roi size
+        if no_trans or tr is None:
+            off_y = jnp.zeros((P, P))
+            off_x = jnp.zeros((P, P))
+        else:
+            py = (iy * part // P).astype(jnp.int32)
+            px = (ix * part // P).astype(jnp.int32)
+            off_y = tr[0, py, px] * trans_std * rh
+            off_x = tr[1, py, px] * trans_std * rw
+        # sample_per_part x sample_per_part grid inside each bin
+        s = sample_per_part
+        sub = (jnp.arange(s) + 0.5) / s
+        gy = y1 + (iy[..., None, None] + sub[None, None, :, None]) * bin_h \
+            + off_y[..., None, None]
+        gx = x1 + (ix[..., None, None] + sub[None, None, None, :]) * bin_w \
+            + off_x[..., None, None]
+        # position-sensitive channel per bin: reference layout is
+        # ctop-major, c = (ctop*gs + gh)*gs + gw
+        # (deformable_psroi_pooling.cu:152)
+        cy = (iy * gs // P).astype(jnp.int32)
+        cx = (ix * gs // P).astype(jnp.int32)
+        chan = (cy * gs + cx)                   # [P, P] = gh*gs + gw
+        samp = _bilinear(img, gy, gx)           # [C, P, P, s, s]
+        samp = samp.mean(axis=(-1, -2))         # [C, P, P]
+        chans = jnp.arange(output_dim)[:, None, None] * (gs * gs) \
+            + chan[None]
+        return jnp.take_along_axis(
+            samp.reshape(C, P * P),
+            chans.reshape(output_dim, P * P), axis=0).reshape(
+                output_dim, P, P)
+
+    if trans is None or no_trans:
+        outs = jax.vmap(lambda r: one_roi(r, None))(rois)
+    else:
+        tr = trans.reshape(rois.shape[0], 2, part, part)
+        outs = jax.vmap(one_roi)(rois, tr)
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# small parity ops
+# ---------------------------------------------------------------------------
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    """Reshape lhs to rhs's shape (reference tensor/elemwise_unary_op.cc)."""
+    return lhs.reshape(rhs.shape)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Summed CE against integer labels (reference loss_binary_op.cc)."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    return -jnp.sum(picked)
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (the reference docs' example op,
+    contrib/quadratic_op.cc)."""
+    return a * data * data + b * data + c
+
+
+@register("adagrad_update")
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad as a graph op (reference optimizer_op.cc). Returns
+    (new_weight, new_history)."""
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    new_hist = history + g * g
+    return (weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist)
+
+
+alias("adagrad_update", "_sparse_adagrad_update")
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    """Identity forward; backward adds the KL sparseness penalty gradient
+    on mean sigmoid activation (reference
+    identity_attach_KL_sparse_reg.cc)."""
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, (x,)
+
+    def bwd(res, g):
+        (x,) = res
+        s = jax.nn.sigmoid(x)
+        rho = sparseness_target
+        rho_hat = jnp.mean(s)     # computed HERE: no captured tracers
+        dkl_drho_hat = (-rho / rho_hat + (1 - rho) / (1 - rho_hat)) \
+            / x.size
+        return (g + penalty * dkl_drho_hat * s * (1 - s),)
+
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("Crop", aliases=("crop_like",))
+def crop_op(data, crop_like=None, offset=(0, 0), h_w=(0, 0),
+            center_crop=False, num_args=1):
+    """Legacy Crop op (reference src/operator/crop.cc): crop data's
+    spatial dims to crop_like's (or h_w), from offset or centered."""
+    th, tw = (crop_like.shape[2], crop_like.shape[3]) \
+        if crop_like is not None else h_w
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+@register("_image_to_tensor", aliases=("image_to_tensor",))
+def image_to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float [0,1] (reference
+    image/image_random-inl.h ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    axes = (2, 0, 1) if data.ndim == 3 else (0, 3, 1, 2)
+    return jnp.transpose(x, axes)
+
+
+@register("_image_normalize", aliases=("image_normalize",))
+def image_normalize(data, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW float input (reference
+    image/image_random-inl.h Normalize)."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    if data.ndim == 3:
+        return (data - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (data - mean.reshape(1, -1, 1, 1)) / std.reshape(1, -1, 1, 1)
+
+
+# legacy v1 variants and misc aliases: identical TPU lowering
+for _legacy, _modern in (("Convolution_v1", "Convolution"),
+                         ("Pooling_v1", "Pooling"),
+                         ("CuDNNBatchNorm", "BatchNorm"),
+                         ("_contrib_SparseEmbedding", "Embedding")):
+    if get_op(_modern) is not None and get_op(_legacy) is None:
+        alias(_modern, _legacy)

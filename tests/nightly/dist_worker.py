@@ -8,7 +8,6 @@ bitwise identical across processes (the dist_sync property the reference
 nightly checks via kvstore push/pull).
 """
 import os
-import sys
 
 import numpy as np
 

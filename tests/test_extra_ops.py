@@ -1,0 +1,176 @@
+"""Coverage-sweep ops: bbox utilities, deformable conv/PSROI, legacy and
+image ops (reference contrib/bounding_box.cc, deformable_*.cc, crop.cc,
+image_random-inl.h, optimizer_op.cc)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def test_box_iou():
+    a = nd.array(np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32))
+    b = nd.array(np.array([[0, 0, 2, 2], [10, 10, 11, 11]], np.float32))
+    iou = mx.nd.contrib.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[0, 0], 1.0)
+    np.testing.assert_allclose(iou[1, 0], 1.0 / 7.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[:, 1], 0.0)
+
+
+def test_box_nms():
+    # rows: [cls_id, score, x1, y1, x2, y2]
+    rows = np.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2, 2],     # overlaps the first -> suppressed
+        [0, 0.7, 5, 5, 6, 6],         # far away -> kept
+        [1, 0.6, 0, 0, 2, 2],         # other class -> kept
+        [0, -1.0, 0, 0, 1, 1],        # invalid
+    ], np.float32)
+    out = mx.nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5,
+                                coord_start=2, score_index=1,
+                                id_index=0).asnumpy()
+    kept = out[out[:, 1] > 0]
+    assert len(kept) == 3
+    np.testing.assert_allclose(sorted(kept[:, 1])[::-1], [0.9, 0.7, 0.6])
+    # force_suppress ignores class ids
+    out2 = mx.nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5,
+                                 coord_start=2, score_index=1, id_index=0,
+                                 force_suppress=True).asnumpy()
+    assert (out2[:, 1] > 0).sum() == 2
+
+
+def test_bipartite_matching():
+    score = np.array([[0.9, 0.1], [0.8, 0.7], [0.2, 0.2]], np.float32)
+    rows, cols = mx.nd.contrib.bipartite_matching(nd.array(score),
+                                                  threshold=0.5)
+    rows, cols = rows.asnumpy(), cols.asnumpy()
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; row 2 below threshold
+    np.testing.assert_allclose(rows, [0, 1, -1])
+    np.testing.assert_allclose(cols, [0, 1])
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((1, 4, 7, 7)).astype(np.float32)
+    w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 5, 5), np.float32)
+    out = mx.nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        num_filter=6, no_bias=True).asnumpy()
+    ref = mx.nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                            num_filter=6, no_bias=True).asnumpy()
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    # offset of exactly (0, +1) everywhere == shifting the input left
+    rng = np.random.RandomState(1)
+    x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((3, 2, 1, 1)).astype(np.float32)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 1] = 1.0                      # x offset +1 for the single tap
+    out = mx.nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(1, 1),
+        num_filter=3, no_bias=True).asnumpy()
+    ref = mx.nd.Convolution(nd.array(np.roll(x, -1, axis=3)), nd.array(w),
+                            kernel=(1, 1), num_filter=3,
+                            no_bias=True).asnumpy()
+    np.testing.assert_allclose(out[..., :-1], ref[..., :-1], atol=1e-4)
+
+
+def test_deformable_psroi_pooling_uniform():
+    # constant per-ps-channel data: each bin must return its own channel's
+    # constant regardless of offsets
+    out_dim, gs, P = 2, 2, 2
+    C = out_dim * gs * gs
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0,
+        output_dim=out_dim, group_size=gs, pooled_size=P,
+        no_trans=True).asnumpy()
+    assert out.shape == (1, out_dim, P, P)
+    # reference ctop-major layout: c = (ctop*gs + gh)*gs + gw
+    for iy in range(P):
+        for ix in range(P):
+            chan = (iy * gs + ix)
+            np.testing.assert_allclose(
+                out[0, :, iy, ix],
+                [d * gs * gs + chan for d in range(out_dim)])
+
+
+def test_small_ops():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = nd.array(np.zeros((3, 2), np.float32))
+    assert mx.nd.reshape_like(x, y).shape == (3, 2)
+    lab = nd.array(np.array([2.0, 0.0], np.float32))
+    ce = mx.nd.softmax_cross_entropy(x, lab).asnumpy()
+    logp = np.log(np.exp(x.asnumpy()) /
+                  np.exp(x.asnumpy()).sum(1, keepdims=True))
+    np.testing.assert_allclose(ce, -(logp[0, 2] + logp[1, 0]), rtol=1e-5)
+    q = mx.nd.contrib.quadratic(nd.array(np.array([2.0], np.float32)),
+                                a=1.0, b=2.0, c=3.0).asnumpy()
+    np.testing.assert_allclose(q, [11.0])
+
+
+def test_adagrad_update():
+    w = nd.array(np.array([1.0, 2.0], np.float32))
+    g = nd.array(np.array([0.5, -0.5], np.float32))
+    h = nd.array(np.zeros(2, np.float32))
+    new_w, new_h = mx.nd.adagrad_update(w, g, h, lr=0.1)
+    np.testing.assert_allclose(new_h.asnumpy(), [0.25, 0.25])
+    np.testing.assert_allclose(
+        new_w.asnumpy(), [1.0 - 0.1 * 0.5 / 0.5, 2.0 + 0.1 * 0.5 / 0.5],
+        rtol=1e-4)
+
+
+def test_kl_sparse_reg_identity_forward():
+    import jax
+    from mxtpu.ops.extra_ops import identity_attach_kl_sparse_reg as klreg
+    x = nd.array(np.array([[0.3, -0.2]], np.float32))
+    out = mx.nd.IdentityAttachKLSparseReg(x)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    # gradient = identity + penalty term (non-1 everywhere)
+    g = jax.grad(lambda v: jnp.sum(klreg(v, penalty=0.1)))(
+        jnp.array([[0.3, -0.2]], jnp.float32))
+    assert np.all(np.abs(np.asarray(g) - 1.0) > 1e-6)
+    # penalty=0 degenerates to pure identity
+    g0 = jax.grad(lambda v: jnp.sum(klreg(v, penalty=0.0)))(
+        jnp.array([[0.3, -0.2]], jnp.float32))
+    np.testing.assert_allclose(np.asarray(g0), 1.0)
+
+
+def test_crop_and_image_ops():
+    x = nd.array(np.arange(2 * 3 * 6 * 6, dtype=np.float32)
+                 .reshape(2, 3, 6, 6))
+    like = nd.zeros((2, 3, 4, 4))
+    out = mx.nd.Crop(x, like, center_crop=True)
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy()[:, :, 1:5, 1:5])
+
+    img = nd.array(np.full((4, 5, 3), 255, np.uint8))
+    t = mx.nd.image_to_tensor(img)
+    assert t.shape == (3, 4, 5)
+    np.testing.assert_allclose(t.asnumpy(), 1.0)
+    norm = mx.nd.image_normalize(t, mean=(1.0, 1.0, 1.0),
+                                 std=(0.5, 0.5, 0.5))
+    np.testing.assert_allclose(norm.asnumpy(), 0.0)
+
+
+def test_legacy_aliases():
+    from mxtpu.ops import get_op
+    assert get_op("Convolution_v1") is get_op("Convolution")
+    assert get_op("Pooling_v1") is get_op("Pooling")
+    assert get_op("_contrib_SparseEmbedding") is get_op("Embedding")
+
+
+def test_box_nms_center_output():
+    rows = np.array([[0, 0.9, 1.0, 1.0, 3.0, 5.0]], np.float32)
+    out = mx.nd.contrib.box_nms(nd.array(rows), coord_start=2,
+                                score_index=1, id_index=0,
+                                in_format="corner",
+                                out_format="center").asnumpy()
+    np.testing.assert_allclose(out[0, 2:6], [2.0, 3.0, 2.0, 4.0])
