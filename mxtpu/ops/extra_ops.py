@@ -27,16 +27,7 @@ __all__ = []
 # bounding boxes (contrib/bounding_box.cc)
 # ---------------------------------------------------------------------------
 
-def _corner_iou(a, b):
-    """IoU of [..., 4] corner boxes, broadcasting leading dims."""
-    tl = jnp.maximum(a[..., :2], b[..., :2])
-    br = jnp.minimum(a[..., 2:4], b[..., 2:4])
-    wh = jnp.maximum(br - tl, 0.0)
-    inter = wh[..., 0] * wh[..., 1]
-    area = lambda x: jnp.maximum(x[..., 2] - x[..., 0], 0.0) * \
-        jnp.maximum(x[..., 3] - x[..., 1], 0.0)
-    union = area(a) + area(b) - inter
-    return jnp.where(union > 0, inter / union, 0.0)
+from .vision import _corner_iou, _bilinear_gather
 
 
 def _to_corner(boxes, fmt):
@@ -150,23 +141,6 @@ def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1):
 # deformable ops (contrib/deformable_convolution.cc, deformable_psroi_pooling.cc)
 # ---------------------------------------------------------------------------
 
-def _bilinear(data, y, x):
-    """Sample data [C, H, W] at float coords y, x [...]; zero padding."""
-    C, H, W = data.shape
-    y0 = jnp.floor(y)
-    x0 = jnp.floor(x)
-    wy1, wx1 = y - y0, x - x0
-    out = 0.0
-    for dy, wy in ((0, 1 - wy1), (1, wy1)):
-        for dx, wx in ((0, 1 - wx1), (1, wx1)):
-            yy = (y0 + dy).astype(jnp.int32)
-            xx = (x0 + dx).astype(jnp.int32)
-            ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
-            v = data[:, jnp.clip(yy, 0, H - 1), jnp.clip(xx, 0, W - 1)]
-            out = out + v * (wy * wx * ok)[None]
-    return out                               # [C, ...]
-
-
 @register("_contrib_DeformableConvolution",
           aliases=("DeformableConvolution",), needs_train_flag=False)
 def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
@@ -206,7 +180,7 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
         for g in range(G):
             y = base_y[None] + ky[:, None, None] + offs[g, :, 0]
             x = base_x[None] + kx[:, None, None] + offs[g, :, 1]
-            samp = _bilinear(img[g * Cg:(g + 1) * Cg], y, x)
+            samp = _bilinear_gather(img[g * Cg:(g + 1) * Cg], x, y)
             cols.append(samp)                # [Cg, taps, Ho, Wo]
         return jnp.concatenate(cols, axis=0)  # [C, taps, Ho, Wo]
 
@@ -267,7 +241,7 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
         cy = (iy * gs // P).astype(jnp.int32)
         cx = (ix * gs // P).astype(jnp.int32)
         chan = (cy * gs + cx)                   # [P, P] = gh*gs + gw
-        samp = _bilinear(img, gy, gx)           # [C, P, P, s, s]
+        samp = _bilinear_gather(img, gx, gy)    # [C, P, P, s, s]
         samp = samp.mean(axis=(-1, -2))         # [C, P, P]
         chans = jnp.arange(output_dim)[:, None, None] * (gs * gs) \
             + chan[None]
@@ -310,11 +284,15 @@ def quadratic(data, a=0.0, b=0.0, c=0.0):
     return a * data * data + b * data + c
 
 
-@register("adagrad_update")
-def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+@register("adagrad_update", num_outputs=2)
+def adagrad_update(weight, grad, history, lr=None, epsilon=1e-7, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
     """AdaGrad as a graph op (reference optimizer_op.cc). Returns
-    (new_weight, new_history)."""
+    (new_weight, new_history). ``lr`` is a required static param (kept
+    keyword-style so the symbolic frontend treats it as a parameter, not
+    an array input)."""
+    if lr is None:
+        raise ValueError("adagrad_update requires lr")
     g = grad * rescale_grad
     if clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -331,7 +309,10 @@ def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
                                   penalty=0.001, momentum=0.9):
     """Identity forward; backward adds the KL sparseness penalty gradient
     on mean sigmoid activation (reference
-    identity_attach_KL_sparse_reg.cc)."""
+    identity_attach_KL_sparse_reg.cc). ``momentum`` is accepted for
+    signature parity but NOT applied: the reference smooths rho_hat with
+    a cross-batch moving average (mutable aux state); this functional
+    rendering uses the current batch's rho_hat only."""
     @jax.custom_vjp
     def f(x):
         return x

@@ -167,16 +167,28 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
     return anchors
 
 
-def _box_iou(a, b):
-    """IoU matrix between corner boxes a [M,4] and b [N,4]."""
-    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
-    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+def _corner_iou(a, b):
+    """IoU of [..., 4] corner boxes, broadcasting leading dims (shared by
+    the multibox family here and the contrib bbox ops in extra_ops)."""
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:4], b[..., 2:4])
     wh = jnp.clip(br - tl, 0.0)
     inter = wh[..., 0] * wh[..., 1]
-    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
-    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
-    union = area_a[:, None] + area_b[None, :] - inter
+
+    def area(x):
+        return jnp.clip(x[..., 2] - x[..., 0], 0) * \
+            jnp.clip(x[..., 3] - x[..., 1], 0)
+
+    union = area(a) + area(b) - inter
     return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _box_iou(a, b):
+    """IoU matrix between corner boxes a [M,4] and b [N,4]."""
+    return _corner_iou(a[:, None, :], b[None, :, :])
+
+
+
 
 
 @register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",

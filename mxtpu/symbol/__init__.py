@@ -304,7 +304,12 @@ class _ScalarConst:
 # Optional (default=None) fn parameters that denote *array* inputs; any other
 # default-None parameter (axes=None, a_min=None, ...) is a static param.
 _OPTIONAL_ARRAY_PARAMS = {"bias", "gamma", "state", "state_cell", "weight32",
-                          "parameters"}
+                          "parameters", "crop_like", "trans"}
+
+# optional array inputs that are genuinely absent when not supplied — no
+# implicit variable is auto-created for them (unlike bias/state, which are
+# real parameters the frontend materializes)
+_OPTIONAL_NO_AUTO = {"crop_like", "trans"}
 
 
 def _array_input_names(op, params):
@@ -352,7 +357,9 @@ def _create_symbol(op, *args, **kwargs):
         inputs = list(args)
         used_names = ["arg%d" % i for i in range(len(inputs))]
     else:
-        pos = list(args)
+        # None positionals mean "input not supplied" (gluon passes
+        # op(x, weight, None, no_bias=True))
+        pos = [a for a in args if a is not None]
         for i, argname in enumerate(input_names):
             if pos:
                 inputs.append(pos.pop(0))
@@ -360,6 +367,8 @@ def _create_symbol(op, *args, **kwargs):
             elif argname in sym_kwargs:
                 inputs.append(sym_kwargs.pop(argname))
                 used_names.append(argname)
+            elif argname in _OPTIONAL_NO_AUTO:
+                continue            # genuinely optional: fn gets None
             else:
                 # auto-create variable (MXNet: implicit weight/bias/label vars)
                 suffix = argname
@@ -375,6 +384,14 @@ def _create_symbol(op, *args, **kwargs):
         if sym_kwargs:
             raise TypeError("unexpected symbol kwargs %s for op %s"
                             % (list(sym_kwargs), op.name))
+        if pos:
+            raise TypeError(
+                "op %s consumes %d array inputs (%s) but got %d "
+                "positional symbols — extra inputs would be silently "
+                "dropped; pass optional array inputs by keyword or add "
+                "them to _OPTIONAL_ARRAY_PARAMS"
+                % (op.name, len(input_names), input_names,
+                   sum(a is not None for a in args)))
     return _apply_op(op, name, inputs, params, attrs, used_names)
 
 

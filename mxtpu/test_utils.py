@@ -20,6 +20,8 @@ __all__ = ["assert_almost_equal", "almost_equal", "same", "rand_ndarray",
 
 
 def default_context():
+    if _DEFAULT_CTX is not None:
+        return _DEFAULT_CTX
     return current_context()
 
 
@@ -195,3 +197,400 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
                                 ex.grad_dict[n].asnumpy().astype(np.float32),
                                 rtol=1e-3, atol=1e-3)
     return exes
+
+
+# ---------------------------------------------------------------------------
+# reference test_utils.py parity helpers (python/mxnet/test_utils.py)
+# ---------------------------------------------------------------------------
+
+_DEFAULT_CTX = None
+
+
+def set_default_context(ctx):
+    """Override the context used by default_context (reference
+    test_utils.py:set_default_context)."""
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def get_atol(atol=None):
+    return 1e-20 if atol is None else atol
+
+
+def get_rtol(rtol=None):
+    return 1e-5 if rtol is None else rtol
+
+
+def list_gpus():
+    """Ordinals of usable accelerator devices (reference queries nvidia-smi;
+    here: jax's non-cpu devices)."""
+    import jax
+    return [d.id for d in jax.devices() if d.platform != "cpu"]
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def random_arrays(*shapes):
+    """List of float32 standard-normal numpy arrays (reference
+    test_utils.py:random_arrays)."""
+    arrays = [np.random.randn(*s).astype(np.float32) if s else
+              np.float32(np.random.randn()) for s in shapes]
+    return arrays if len(arrays) > 1 else arrays[0]
+
+
+def random_sample(population, k):
+    """Sample without replacement preserving population order."""
+    idx = sorted(np.random.choice(len(population), size=k, replace=False))
+    return [population[i] for i in idx]
+
+
+def same_array(array1, array2):
+    """True iff the two NDArrays share storage (reference checks by
+    mutating one and observing the other)."""
+    if array1.shape != array2.shape:
+        return False
+    return array1 is array2 or array1._data is array2._data
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    a, b = _as_np(a).copy(), _as_np(b).copy()
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    return almost_equal(a, b, get_rtol(rtol), get_atol(atol))
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=("a", "b")):
+    a, b = _as_np(a).copy(), _as_np(b).copy()
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    assert_almost_equal(a, b, get_rtol(rtol), get_atol(atol), names)
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    """Location and value of the worst relative error (reference
+    test_utils.py:find_max_violation)."""
+    a, b = _as_np(a), _as_np(b)
+    diff = np.abs(a - b)
+    tol = get_atol(atol) + get_rtol(rtol) * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    idx = np.unravel_index(np.argmax(violation), violation.shape)
+    return idx, float(violation[idx])
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """Assert that f(*args, **kwargs) raises exception_type."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError("%r did not raise %s" % (f, exception_type))
+
+
+def retry(n):
+    """Decorator retrying a flaky (randomized) test up to n times
+    (reference test_utils.py:retry)."""
+    assert n > 0
+
+    def decorate(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError:
+                    if i == n - 1:
+                        raise
+        return wrapper
+    return decorate
+
+
+def discard_stderr():
+    """Context manager silencing stderr (reference discards C-level too;
+    Python-level suffices here since there is no C logging)."""
+    import contextlib
+    import io
+    return contextlib.redirect_stderr(io.StringIO())
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Bind, forward, return outputs as numpy (reference
+    test_utils.py:simple_forward)."""
+    from . import context as ctx_mod
+    ctx = ctx or default_context()
+    shapes = {k: v.shape for k, v in inputs.items()}
+    exe = sym.simple_bind(ctx, grad_req="null", **shapes)
+    outs = [o.asnumpy() for o in exe.forward(is_train=is_train, **inputs)]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
+                typ="whole", **kwargs):
+    """Seconds per forward(+backward) iteration (reference
+    test_utils.py:check_speed)."""
+    import time
+    ctx = ctx or default_context()
+    if location is None:
+        arg_shapes, _, _ = sym.infer_shape(**kwargs)
+        location = {name: np.random.normal(size=shape, scale=1.0)
+                    for name, shape in zip(sym.list_arguments(),
+                                           arg_shapes)}
+    else:
+        kwargs = {k: v.shape for k, v in location.items()}
+    exe = sym.simple_bind(ctx, grad_req=grad_req, **kwargs)
+    for name, arr in location.items():
+        exe.arg_dict[name][:] = arr
+
+    if typ == "whole":
+        def run():
+            out = exe.forward(is_train=True)
+            exe.backward(out_grads=[o.ones_like() for o in out])
+            out[0].wait_to_read()
+    elif typ == "forward":
+        def run():
+            exe.forward(is_train=False)[0].wait_to_read()
+    else:
+        raise ValueError("typ can only be 'whole' or 'forward'")
+
+    run()                         # warm-up / compile
+    tic = time.time()
+    for _ in range(N):
+        run()
+    return (time.time() - tic) / N
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Apply a numpy reduction honoring mxnet axis/keepdims conventions
+    (reference test_utils.py:np_reduce)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else \
+            list(range(len(dat.shape)))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences through an executor (reference
+    test_utils.py:numeric_grad); check_numeric_gradient is the high-level
+    wrapper."""
+    approx_grads = {}
+    for name, arr in location.items():
+        arr = np.ascontiguousarray(arr)   # reshape(-1) must be a view
+        grad = np.zeros_like(arr)
+        flat = arr.reshape(-1)
+        gflat = grad.reshape(-1)
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps
+            executor.arg_dict[name][:] = arr
+            fp = float(_as_np(executor.forward(
+                is_train=use_forward_train)[0]).sum())
+            flat[i] = old - eps
+            executor.arg_dict[name][:] = arr
+            fm = float(_as_np(executor.forward(
+                is_train=use_forward_train)[0]).sum())
+            flat[i] = old
+            gflat[i] = (fp - fm) / (2 * eps)
+        executor.arg_dict[name][:] = arr
+        approx_grads[name] = grad
+    return approx_grads
+
+
+# -- sparse test data (reference rand_sparse_ndarray and friends) ----------
+
+def shuffle_csr_column_indices(csr):
+    """Shuffle the stored column order within each row (reference
+    test_utils.py:shuffle_csr_column_indices: exercises unordered-index
+    handling). The dense value semantics are unchanged; the aux
+    data/indices arrays are permuted per row."""
+    import numpy as _n
+    data = csr.data.asnumpy().copy()
+    indices = csr.indices.asnumpy().copy()
+    indptr = csr.indptr.asnumpy()
+    for r in range(len(indptr) - 1):
+        lo, hi = int(indptr[r]), int(indptr[r + 1])
+        perm = _n.random.permutation(hi - lo)
+        data[lo:hi] = data[lo:hi][perm]
+        indices[lo:hi] = indices[lo:hi][perm]
+    out = csr.copy()
+    from . import ndarray as _nd
+    out._aux["data"] = _nd.array(data)
+    out._aux["indices"] = _nd.array(indices)
+    return out
+
+
+def create_sparse_array(shape, stype, data_init=None, rsp_indices=None,
+                        dtype=None, modifier_func=None, density=0.5,
+                        shuffle_csr_indices=False):
+    """Random sparse NDArray (reference test_utils.py:create_sparse_array)."""
+    from .ndarray.sparse import csr_matrix, row_sparse_array
+    dtype = dtype or default_dtype()
+    dense = np.zeros(shape, dtype=dtype)
+    if stype == "row_sparse":
+        if rsp_indices is not None:
+            rows = np.asarray(rsp_indices)
+        else:
+            n = max(1, int(shape[0] * density))
+            rows = np.sort(np.random.choice(shape[0], n, replace=False))
+        for r in rows:
+            dense[r] = data_init if data_init is not None else \
+                np.random.rand(*shape[1:]).astype(dtype)
+        if modifier_func is not None:
+            dense = np.vectorize(modifier_func)(dense).astype(dtype)
+        return row_sparse_array(dense)
+    if stype == "csr":
+        mask = np.random.rand(*shape) < density
+        vals = np.random.rand(*shape).astype(dtype) if data_init is None \
+            else np.full(shape, data_init, dtype)
+        dense = np.where(mask, vals, 0).astype(dtype)
+        if modifier_func is not None:
+            dense = np.where(mask, np.vectorize(modifier_func)(dense),
+                             0).astype(dtype)
+        return csr_matrix(dense)
+    raise ValueError("unsupported stype %r" % stype)
+
+
+def create_sparse_array_zd(shape, stype, density, data_init=None,
+                           rsp_indices=None, dtype=None,
+                           modifier_func=None, shuffle_csr_indices=False):
+    """Sparse array that may have zero density (reference
+    create_sparse_array_zd)."""
+    if density == 0.0:
+        from .ndarray.sparse import csr_matrix, row_sparse_array
+        dense = np.zeros(shape, dtype or default_dtype())
+        return csr_matrix(dense) if stype == "csr" \
+            else row_sparse_array(dense)
+    return create_sparse_array(shape, stype, data_init, rsp_indices,
+                               dtype, modifier_func, density,
+                               shuffle_csr_indices)
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        modifier_func=None, shuffle_csr_indices=False,
+                        distribution="uniform"):
+    """(sparse NDArray, (data, indices[, indptr])) like the reference
+    rand_sparse_ndarray (test_utils.py:339). distribution="powerlaw"
+    concentrates nnz in early rows like the reference's powerlaw
+    generator; shuffle_csr_indices permutes stored column order."""
+    density = np.random.rand() if density is None else density
+    if distribution not in ("uniform", "powerlaw"):
+        raise ValueError("unsupported distribution %r" % distribution)
+    if distribution == "powerlaw" and stype == "csr":
+        from .ndarray.sparse import csr_matrix
+        dtype = dtype or default_dtype()
+        dense = np.zeros(shape, dtype)
+        total = max(1, int(density * shape[0] * shape[1]))
+        per_row = 1
+        row = 0
+        while total > 0 and row < shape[0]:
+            n = min(per_row, shape[1], total)
+            cols = np.random.choice(shape[1], n, replace=False)
+            dense[row, cols] = np.random.rand(n).astype(dtype)
+            total -= n
+            row += 1
+            per_row *= 2
+        arr = csr_matrix(dense)
+    else:
+        arr = create_sparse_array_zd(shape, stype, density, dtype=dtype,
+                                     modifier_func=modifier_func)
+    if stype == "csr" and shuffle_csr_indices:
+        arr = shuffle_csr_column_indices(arr)
+    if stype == "csr":
+        aux = (arr.data.asnumpy(), arr.indices.asnumpy(),
+               arr.indptr.asnumpy())
+    else:
+        aux = (arr.data.asnumpy(), arr.indices.asnumpy())
+    return arr, aux
+
+
+# -- datasets (reference get_mnist / get_mnist_iterator) -------------------
+
+def get_mnist(path=None):
+    """MNIST as numpy dicts (reference test_utils.py:get_mnist downloads
+    from the web). This environment has no egress: reads ubyte files from
+    ``path`` (or $MXTPU_MNIST_PATH) when present, else generates a
+    deterministic SYNTHETIC stand-in with the real shapes/dtypes so
+    convergence smoke tests stay runnable offline."""
+    import os
+    path = path or os.environ.get("MXTPU_MNIST_PATH")
+    if path and os.path.exists(os.path.join(path,
+                                            "train-images-idx3-ubyte")):
+        from .io import _read_mnist_images, _read_mnist_labels
+        j = os.path.join
+        return {
+            "train_data": _read_mnist_images(
+                j(path, "train-images-idx3-ubyte"))[:, None].astype(
+                    np.float32) / 255.0,
+            "train_label": _read_mnist_labels(
+                j(path, "train-labels-idx1-ubyte")).astype(np.float32),
+            "test_data": _read_mnist_images(
+                j(path, "t10k-images-idx3-ubyte"))[:, None].astype(
+                    np.float32) / 255.0,
+            "test_label": _read_mnist_labels(
+                j(path, "t10k-labels-idx1-ubyte")).astype(np.float32),
+        }
+    rng = np.random.RandomState(42)
+    n_tr, n_te = 6000, 1000
+
+    def synth(n):
+        labels = rng.randint(0, 10, n)
+        imgs = np.zeros((n, 1, 28, 28), np.float32)
+        for i, lab in enumerate(labels):          # class-dependent blob
+            y, x = divmod(int(lab), 4)
+            imgs[i, 0, 4 + y * 5:10 + y * 5, 4 + x * 5:10 + x * 5] = 1.0
+        imgs += rng.rand(n, 1, 28, 28).astype(np.float32) * 0.2
+        return imgs, labels.astype(np.float32)
+
+    td, tl = synth(n_tr)
+    vd, vl = synth(n_te)
+    return {"train_data": td, "train_label": tl,
+            "test_data": vd, "test_label": vl}
+
+
+def get_mnist_iterator(batch_size, input_shape, num_parts=1, part_index=0,
+                       path=None):
+    """(train_iter, val_iter) over get_mnist (reference
+    test_utils.py:get_mnist_iterator)."""
+    from .io import NDArrayIter
+    mnist = get_mnist(path)
+    shape = (-1,) + tuple(input_shape)
+    train = NDArrayIter(mnist["train_data"].reshape(shape)
+                        [part_index::num_parts],
+                        mnist["train_label"][part_index::num_parts],
+                        batch_size, shuffle=True)
+    val = NDArrayIter(mnist["test_data"].reshape(shape),
+                      mnist["test_label"], batch_size)
+    return train, val
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """Reference test_utils.py:download. This environment has no network
+    egress; the hook exists so reference scripts fail with a clear
+    message instead of a hang."""
+    raise RuntimeError("no network egress in this environment; stage %r "
+                       "locally and point the caller at the file" % url)

@@ -174,3 +174,52 @@ def test_box_nms_center_output():
                                 in_format="corner",
                                 out_format="center").asnumpy()
     np.testing.assert_allclose(out[0, 2:6], [2.0, 3.0, 2.0, 4.0])
+
+
+def test_symbolic_crop_and_trans_inputs():
+    # optional array inputs must flow through the SYMBOLIC frontend too
+    d = mx.sym.var("d")
+    like = mx.sym.var("like")
+    s = mx.sym.Crop(d, like, center_crop=True)
+    assert set(s.list_arguments()) == {"d", "like"}
+    exe = s.simple_bind(mx.cpu(), grad_req="null", d=(1, 2, 6, 6),
+                        like=(1, 2, 4, 4))
+    out = exe.forward(is_train=False,
+                      d=np.arange(72, dtype=np.float32).reshape(1, 2, 6, 6),
+                      like=np.zeros((1, 2, 4, 4), np.float32))[0]
+    assert out.shape == (1, 2, 4, 4)
+    # without crop_like: no phantom variable is created
+    s2 = mx.sym.Crop(d, h_w=(3, 3))
+    assert s2.list_arguments() == ["d"]
+
+    # DeformablePSROIPooling keeps its trans input symbolically
+    data = mx.sym.var("data")
+    rois = mx.sym.var("rois")
+    trans = mx.sym.var("trans")
+    ps = mx.sym.contrib.DeformablePSROIPooling(
+        data, rois, trans, spatial_scale=1.0, output_dim=2, group_size=1,
+        pooled_size=2, part_size=2, trans_std=0.1)
+    assert set(ps.list_arguments()) == {"data", "rois", "trans"}
+
+
+def test_symbolic_extra_positional_raises():
+    d = mx.sym.var("d")
+    e = mx.sym.var("e")
+    import pytest
+    with pytest.raises(TypeError):
+        mx.sym.relu(d, e)        # relu takes one input: loud, not silent
+
+
+def test_symbolic_adagrad_update():
+    w = mx.sym.var("w")
+    g = mx.sym.var("g")
+    h = mx.sym.var("h")
+    s = mx.sym.adagrad_update(w, g, h, lr=0.1)
+    assert set(s.list_arguments()) == {"w", "g", "h"}
+    exe = s.simple_bind(mx.cpu(), grad_req="null", w=(2,), g=(2,), h=(2,))
+    outs = exe.forward(is_train=False,
+                       w=np.array([1.0, 2.0], np.float32),
+                       g=np.array([0.5, -0.5], np.float32),
+                       h=np.zeros(2, np.float32))
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[1].asnumpy(), [0.25, 0.25])

@@ -1,0 +1,159 @@
+"""The test_utils parity helpers themselves (reference test_utils.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu import test_utils as tu
+
+
+def test_tolerances_and_shapes():
+    assert tu.get_rtol(None) == 1e-5 and tu.get_rtol(0.1) == 0.1
+    assert tu.default_dtype() == np.float32
+    assert len(tu.rand_shape_2d()) == 2
+    assert len(tu.rand_shape_3d(3, 3, 3)) == 3
+    arrs = tu.random_arrays((2, 3), (4,))
+    assert arrs[0].shape == (2, 3) and arrs[1].shape == (4,)
+
+
+def test_ignore_nan_compare():
+    a = np.array([1.0, np.nan, 3.0])
+    b = np.array([1.0, 2.0, 3.0])
+    b_nan = np.array([1.0, np.nan, 3.0])
+    assert tu.almost_equal_ignore_nan(a, b)       # nan positions zeroed
+    tu.assert_almost_equal_ignore_nan(a, b_nan)
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal(a, b_nan)          # strict compare: nan != 2
+
+
+def test_find_max_violation():
+    a = np.array([1.0, 5.0, 3.0])
+    b = np.array([1.0, 2.0, 3.0])
+    idx, v = tu.find_max_violation(a, b)
+    assert idx == (1,) and v > 1
+
+
+def test_same_array():
+    x = nd.ones((3,))
+    y = x
+    z = nd.ones((3,))
+    assert tu.same_array(x, y)
+    assert not tu.same_array(x, z)
+
+
+def test_retry_and_assert_exception():
+    calls = []
+
+    @tu.retry(3)
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise AssertionError("first try fails")
+
+    flaky()
+    assert len(calls) == 2
+    tu.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    with pytest.raises(AssertionError):
+        tu.assert_exception(lambda: None, ValueError)
+
+
+def test_np_reduce():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    out = tu.np_reduce(x, (0, 2), True, np.sum)
+    np.testing.assert_allclose(out, x.sum(axis=(0, 2), keepdims=True))
+    out2 = tu.np_reduce(x, 1, False, np.max)
+    np.testing.assert_allclose(out2, x.max(axis=1))
+
+
+def test_simple_forward_and_check_speed():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    rng = np.random.RandomState(0)
+    out = tu.simple_forward(net, mx.cpu(),
+                            data=rng.rand(2, 4).astype(np.float32),
+                            fc_weight=rng.rand(3, 4).astype(np.float32),
+                            fc_bias=np.zeros(3, np.float32))
+    assert out.shape == (2, 3)
+    dt = tu.check_speed(net, ctx=mx.cpu(), N=2, data=(2, 4))
+    assert dt > 0
+
+
+def test_sparse_generators():
+    arr, (data, indices, indptr) = tu.rand_sparse_ndarray(
+        (6, 5), "csr", density=0.5)
+    from mxtpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+    assert isinstance(arr, CSRNDArray)
+    dense = arr.asnumpy()
+    assert (dense != 0).sum() == len(data)
+    rsp, _ = tu.rand_sparse_ndarray((6, 4), "row_sparse", density=0.4)
+    assert isinstance(rsp, RowSparseNDArray)
+    zero = tu.create_sparse_array_zd((4, 4), "csr", density=0.0)
+    np.testing.assert_allclose(zero.asnumpy(), 0.0)
+
+
+def test_numeric_grad():
+    data = mx.sym.var("data")
+    net = 2 * data * data  # d/dx = 4x
+    x = np.array([[1.0, -2.0]], np.float32)
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=x.shape)
+    grads = tu.numeric_grad(exe, {"data": x.copy()})
+    np.testing.assert_allclose(grads["data"], 4 * x, atol=1e-2)
+
+
+def test_get_mnist_synthetic():
+    m = tu.get_mnist()
+    assert m["train_data"].shape == (6000, 1, 28, 28)
+    assert m["test_label"].shape == (1000,)
+    train, val = tu.get_mnist_iterator(32, (1, 28, 28))
+    batch = next(iter(train))
+    assert batch.data[0].shape == (32, 1, 28, 28)
+    # synthetic stand-in must be learnable (class-dependent structure)
+    import logging
+    logging.disable(logging.INFO)
+    mx.random.seed(0)
+    data = mx.sym.var("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Flatten(data), num_hidden=10), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier())
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.5, acc
+
+
+def test_download_gated():
+    with pytest.raises(RuntimeError):
+        tu.download("http://example.com/x")
+
+
+def test_set_default_context():
+    tu.set_default_context(mx.cpu(1))
+    try:
+        assert tu.default_context() == mx.cpu(1)
+    finally:
+        tu.set_default_context(None)
+
+
+def test_shuffle_csr_and_powerlaw():
+    np.random.seed(0)
+    arr, _ = tu.rand_sparse_ndarray((6, 8), "csr", density=0.5,
+                                    shuffle_csr_indices=True)
+    dense_before = arr.asnumpy()
+    # indices within a row may be unsorted but values are intact
+    idx = arr.indices.asnumpy()
+    ptr = arr.indptr.asnumpy()
+    from mxtpu.ndarray.sparse import csr_matrix
+    rebuilt = np.zeros((6, 8), np.float32)
+    data = arr.data.asnumpy()
+    for r in range(6):
+        for j in range(int(ptr[r]), int(ptr[r + 1])):
+            rebuilt[r, int(idx[j])] = data[j]
+    np.testing.assert_allclose(rebuilt, dense_before)
+
+    pl, _ = tu.rand_sparse_ndarray((16, 16), "csr", density=0.3,
+                                   distribution="powerlaw")
+    row_nnz = (pl.asnumpy() != 0).sum(axis=1)
+    assert row_nnz[0] <= row_nnz[: max(1, np.argmax(row_nnz))].max() + 1
+    with pytest.raises(ValueError):
+        tu.rand_sparse_ndarray((4, 4), "csr", distribution="zipf")
