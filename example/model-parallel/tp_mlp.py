@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Tensor/model parallelism via sharding rules (the group2ctx successor).
+
+The reference's model parallelism is manual per-layer placement:
+``group2ctx`` in bind routes layers to devices and inserts
+_CrossDeviceCopy nodes (example/model-parallel/lstm,
+docs/faq/model_parallel_lstm.md). The TPU-native rendering names a
+partition spec per parameter pattern; GSPMD places compute and inserts
+the collectives those copies hand-coded.
+
+This example trains one wide MLP three ways on a (data=2, model=4) mesh —
+pure DP, column-parallel TP, and DP x TP — and checks all three learn the
+same function, so the sharding is semantics-preserving.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+         python example/model-parallel/tp_mlp.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax                                      # noqa: E402
+from jax.sharding import PartitionSpec as P     # noqa: E402
+
+import mxtpu as mx                              # noqa: E402
+from mxtpu import nd, gluon                     # noqa: E402
+from mxtpu.gluon import nn                      # noqa: E402
+from mxtpu.parallel import (MeshContext, ShardedTrainer,  # noqa: E402
+                            ShardingRules)
+
+
+def build_net(seed):
+    import mxtpu.gluon.block as blk
+    blk._NAME_COUNTERS.clear()
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(256, activation="relu"),
+            nn.Dense(256, activation="relu"),
+            nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def train(mesh, rules, x, y, steps=60):
+    net = build_net(0)
+    net(nd.array(x[:2]))
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "sgd", {"learning_rate": 0.2}, mesh=mesh,
+                        rules=rules)
+    loss = None
+    for _ in range(steps):
+        loss = st.step(x, y)
+    return st, loss
+
+
+def main():
+    devs = jax.devices()
+    assert len(devs) >= 8, "run with 8 virtual devices (see docstring)"
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 16).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.float32)
+
+    # 1) pure data parallelism over all devices
+    dp_mesh = MeshContext(devs, data=8)
+    _, dp_loss = train(dp_mesh, None, x, y)
+    print("DP   (data=8)          final loss %.4f" % dp_loss)
+
+    # 2) pure tensor parallelism: dense weights column-sharded over model
+    tp_mesh = MeshContext(devs, model=8)
+    tp_rules = ShardingRules([(r".*dense\d*_weight", P("model", None))])
+    _, tp_loss = train(tp_mesh, tp_rules, x, y)
+    print("TP   (model=8)         final loss %.4f" % tp_loss)
+
+    # 3) DP x TP on a 2x4 mesh
+    mix_mesh = MeshContext(devs, data=2, model=4)
+    mix_rules = ShardingRules([(r".*dense\d*_weight", P("model", None))])
+    _, mix_loss = train(mix_mesh, mix_rules, x, y)
+    print("DPxTP (data=2,model=4) final loss %.4f" % mix_loss)
+
+    # identical math, identical init => identical training trajectory
+    assert abs(dp_loss - tp_loss) < 1e-3, (dp_loss, tp_loss)
+    assert abs(dp_loss - mix_loss) < 1e-3, (dp_loss, mix_loss)
+    assert dp_loss < 0.2
+    print("all three parallelism layouts converged identically")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

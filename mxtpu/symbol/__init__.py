@@ -357,12 +357,16 @@ def _create_symbol(op, *args, **kwargs):
         inputs = list(args)
         used_names = ["arg%d" % i for i in range(len(inputs))]
     else:
-        # None positionals mean "input not supplied" (gluon passes
-        # op(x, weight, None, no_bias=True))
-        pos = [a for a in args if a is not None]
+        # A None positional means "this slot not supplied" (gluon passes
+        # op(x, weight, None, no_bias=True)) — it must consume its slot so
+        # later symbols don't shift into earlier inputs.
+        pos = list(args)
         for i, argname in enumerate(input_names):
+            supplied = None
             if pos:
-                inputs.append(pos.pop(0))
+                supplied = pos.pop(0)
+            if supplied is not None:
+                inputs.append(supplied)
                 used_names.append(argname)
             elif argname in sym_kwargs:
                 inputs.append(sym_kwargs.pop(argname))
@@ -384,14 +388,14 @@ def _create_symbol(op, *args, **kwargs):
         if sym_kwargs:
             raise TypeError("unexpected symbol kwargs %s for op %s"
                             % (list(sym_kwargs), op.name))
-        if pos:
+        pos = [a for a in pos if a is not None]   # leftover Nones are
+        if pos:                                    # legitimately unsupplied
             raise TypeError(
                 "op %s consumes %d array inputs (%s) but got %d "
                 "positional symbols — extra inputs would be silently "
                 "dropped; pass optional array inputs by keyword or add "
                 "them to _OPTIONAL_ARRAY_PARAMS"
-                % (op.name, len(input_names), input_names,
-                   sum(a is not None for a in args)))
+                % (op.name, len(input_names), input_names, len(args)))
     return _apply_op(op, name, inputs, params, attrs, used_names)
 
 

@@ -16,7 +16,17 @@ from .ndarray import NDArray
 __all__ = ["assert_almost_equal", "almost_equal", "same", "rand_ndarray",
            "rand_shape_nd", "check_numeric_gradient",
            "check_symbolic_forward", "check_symbolic_backward",
-           "check_consistency", "default_context"]
+           "check_consistency", "default_context",
+           # reference parity helpers
+           "set_default_context", "default_dtype", "get_atol", "get_rtol",
+           "list_gpus", "rand_shape_2d", "rand_shape_3d", "random_arrays",
+           "random_sample", "same_array", "almost_equal_ignore_nan",
+           "assert_almost_equal_ignore_nan", "find_max_violation",
+           "assert_exception", "retry", "discard_stderr", "simple_forward",
+           "check_speed", "np_reduce", "numeric_grad",
+           "shuffle_csr_column_indices", "create_sparse_array",
+           "create_sparse_array_zd", "rand_sparse_ndarray", "get_mnist",
+           "get_mnist_iterator", "download"]
 
 
 def default_context():
@@ -256,11 +266,12 @@ def random_sample(population, k):
 
 
 def same_array(array1, array2):
-    """True iff the two NDArrays share storage (reference checks by
-    mutating one and observing the other)."""
-    if array1.shape != array2.shape:
-        return False
-    return array1 is array2 or array1._data is array2._data
+    """True iff mutating one NDArray is observed through the other
+    (the reference's storage-sharing probe). mxtpu buffers are immutable
+    jax arrays and mutation rebinds the handle's ``_data`` slot, so only
+    the SAME handle observes mutations — ``copy()`` shares the buffer
+    until written but is still an independent array."""
+    return array1 is array2
 
 
 def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
@@ -331,7 +342,6 @@ def discard_stderr():
 def simple_forward(sym, ctx=None, is_train=False, **inputs):
     """Bind, forward, return outputs as numpy (reference
     test_utils.py:simple_forward)."""
-    from . import context as ctx_mod
     ctx = ctx or default_context()
     shapes = {k: v.shape for k, v in inputs.items()}
     exe = sym.simple_bind(ctx, grad_req="null", **shapes)
@@ -440,7 +450,7 @@ def shuffle_csr_column_indices(csr):
     out = csr.copy()
     from . import ndarray as _nd
     out._aux["data"] = _nd.array(data)
-    out._aux["indices"] = _nd.array(indices)
+    out._aux["indices"] = _nd.array(indices, dtype=indices.dtype)
     return out
 
 
@@ -458,10 +468,11 @@ def create_sparse_array(shape, stype, data_init=None, rsp_indices=None,
             n = max(1, int(shape[0] * density))
             rows = np.sort(np.random.choice(shape[0], n, replace=False))
         for r in rows:
-            dense[r] = data_init if data_init is not None else \
+            vals = data_init if data_init is not None else \
                 np.random.rand(*shape[1:]).astype(dtype)
-        if modifier_func is not None:
-            dense = np.vectorize(modifier_func)(dense).astype(dtype)
+            if modifier_func is not None:   # stored values only: zero
+                vals = np.vectorize(modifier_func)(vals)  # rows stay zero
+            dense[r] = vals
         return row_sparse_array(dense)
     if stype == "csr":
         mask = np.random.rand(*shape) < density
@@ -500,6 +511,9 @@ def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
     density = np.random.rand() if density is None else density
     if distribution not in ("uniform", "powerlaw"):
         raise ValueError("unsupported distribution %r" % distribution)
+    if distribution == "powerlaw" and stype != "csr":
+        raise ValueError("powerlaw distribution is only implemented for "
+                         "csr (matching its use in the reference suite)")
     if distribution == "powerlaw" and stype == "csr":
         from .ndarray.sparse import csr_matrix
         dtype = dtype or default_dtype()
@@ -538,21 +552,27 @@ def get_mnist(path=None):
     convergence smoke tests stay runnable offline."""
     import os
     path = path or os.environ.get("MXTPU_MNIST_PATH")
-    if path and os.path.exists(os.path.join(path,
-                                            "train-images-idx3-ubyte")):
+
+    def find(stem):        # the readers handle .gz transparently
+        for name in (stem, stem + ".gz"):
+            full = os.path.join(path, name)
+            if os.path.exists(full):
+                return full
+        return None
+
+    if path and find("train-images-idx3-ubyte"):
         from .io import _read_mnist_images, _read_mnist_labels
-        j = os.path.join
         return {
             "train_data": _read_mnist_images(
-                j(path, "train-images-idx3-ubyte"))[:, None].astype(
+                find("train-images-idx3-ubyte"))[:, None].astype(
                     np.float32) / 255.0,
             "train_label": _read_mnist_labels(
-                j(path, "train-labels-idx1-ubyte")).astype(np.float32),
+                find("train-labels-idx1-ubyte")).astype(np.float32),
             "test_data": _read_mnist_images(
-                j(path, "t10k-images-idx3-ubyte"))[:, None].astype(
+                find("t10k-images-idx3-ubyte"))[:, None].astype(
                     np.float32) / 255.0,
             "test_label": _read_mnist_labels(
-                j(path, "t10k-labels-idx1-ubyte")).astype(np.float32),
+                find("t10k-labels-idx1-ubyte")).astype(np.float32),
         }
     rng = np.random.RandomState(42)
     n_tr, n_te = 6000, 1000

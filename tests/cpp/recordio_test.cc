@@ -1,0 +1,89 @@
+// Native RecordIO C++ unit test (the §4 C++ test tier: reference
+// tests/cpp/{engine,storage}_test.cc with gtest; assert-based here to
+// avoid a vendored gtest). Compiled and run by tests/test_native_cpp.py.
+//
+// Covers: write/read roundtrip, reset, random access by offset, prefetcher
+// stream equivalence with multiple worker threads, EOF behavior.
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* rio_open_reader(const char* path);
+int64_t rio_read_next(void* handle, const char** data);
+int64_t rio_read_at(void* handle, uint64_t offset, const char** data);
+void rio_reader_reset(void* handle);
+void rio_close_reader(void* handle);
+void* rio_open_writer(const char* path);
+int64_t rio_write(void* handle, const char* data, uint64_t len);
+void rio_close_writer(void* handle);
+void* pf_create(const char* path, uint64_t capacity);
+int64_t pf_next(void* handle, const char** data);
+void pf_destroy(void* handle);
+}
+
+int main(int argc, char** argv) {
+  assert(argc > 1);
+  std::string path = std::string(argv[1]) + "/t.rec";
+
+  // write records of varying, non-aligned sizes
+  std::vector<std::string> recs;
+  for (int i = 0; i < 257; ++i) {
+    std::string s;
+    for (int j = 0; j < (i * 7) % 61 + 1; ++j)
+      s.push_back(static_cast<char>('a' + (i + j) % 26));
+    recs.push_back(s);
+  }
+  void* w = rio_open_writer(path.c_str());
+  assert(w != nullptr);
+  std::vector<int64_t> offsets;
+  for (const auto& s : recs) {
+    int64_t off = rio_write(w, s.data(), s.size());
+    assert(off >= 0);
+    offsets.push_back(off);
+  }
+  rio_close_writer(w);
+
+  // sequential read + EOF
+  void* r = rio_open_reader(path.c_str());
+  assert(r != nullptr);
+  const char* data = nullptr;
+  for (const auto& s : recs) {
+    int64_t n = rio_read_next(r, &data);
+    assert(n == static_cast<int64_t>(s.size()));
+    assert(std::memcmp(data, s.data(), s.size()) == 0);
+  }
+  assert(rio_read_next(r, &data) == -1);  // EOF
+
+  // reset re-reads from the start
+  rio_reader_reset(r);
+  assert(rio_read_next(r, &data) == static_cast<int64_t>(recs[0].size()));
+
+  // random access via recorded offsets (the .idx file contract)
+  for (int i = 256; i >= 0; i -= 17) {
+    int64_t n = rio_read_at(r, static_cast<uint64_t>(offsets[i]), &data);
+    assert(n == static_cast<int64_t>(recs[i].size()));
+    assert(std::memcmp(data, recs[i].data(), recs[i].size()) == 0);
+  }
+  rio_close_reader(r);
+
+  // prefetcher yields the same stream (ordering preserved)
+  void* p = pf_create(path.c_str(), 8);
+  assert(p != nullptr);
+  size_t count = 0;
+  while (true) {
+    int64_t n = pf_next(p, &data);
+    if (n < 0) break;
+    assert(n == static_cast<int64_t>(recs[count].size()));
+    assert(std::memcmp(data, recs[count].data(), recs[count].size()) == 0);
+    ++count;
+  }
+  assert(count == recs.size());
+  pf_destroy(p);
+
+  std::printf("recordio_test OK (%zu records)\n", recs.size());
+  return 0;
+}

@@ -223,3 +223,13 @@ def test_symbolic_adagrad_update():
                        h=np.zeros(2, np.float32))
     assert len(outs) == 2
     np.testing.assert_allclose(outs[1].asnumpy(), [0.25, 0.25])
+
+
+def test_symbolic_none_positional_keeps_alignment():
+    # a None in the middle must consume its slot, not shift later inputs
+    x = mx.sym.var("x")
+    b = mx.sym.var("b")
+    s = mx.sym.FullyConnected(x, None, b, num_hidden=3, name="fc")
+    args = s.list_arguments()
+    assert "b" in args and "fc_weight" in args    # b bound as BIAS
+    assert "fc_bias" not in args

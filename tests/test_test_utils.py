@@ -157,3 +157,40 @@ def test_shuffle_csr_and_powerlaw():
     assert row_nnz[0] <= row_nnz[: max(1, np.argmax(row_nnz))].max() + 1
     with pytest.raises(ValueError):
         tu.rand_sparse_ndarray((4, 4), "csr", distribution="zipf")
+
+
+def test_star_import_surface():
+    ns = {}
+    exec("from mxtpu.test_utils import *", ns)
+    for name in ("rand_sparse_ndarray", "retry", "get_atol",
+                 "set_default_context", "numeric_grad", "get_mnist"):
+        assert name in ns, name
+
+
+def test_same_array_copy_semantics():
+    a = nd.ones((3,))
+    b = a.copy()
+    assert not tu.same_array(a, b)     # mutating b never shows through a
+    assert tu.same_array(a, a)
+
+
+def test_rsp_modifier_preserves_sparsity():
+    arr = tu.create_sparse_array((6, 4), "row_sparse", rsp_indices=[1, 4],
+                                 modifier_func=lambda v: v + 0.5)
+    dense = arr.asnumpy()
+    nz_rows = np.unique(np.nonzero(dense)[0])
+    np.testing.assert_array_equal(nz_rows, [1, 4])
+
+
+def test_powerlaw_rsp_rejected():
+    with pytest.raises(ValueError):
+        tu.rand_sparse_ndarray((8, 4), "row_sparse",
+                               distribution="powerlaw")
+
+
+def test_shuffle_preserves_index_dtype():
+    np.random.seed(1)
+    arr, _ = tu.rand_sparse_ndarray((5, 7), "csr", density=0.6)
+    dt = arr.indices.asnumpy().dtype
+    shuffled = tu.shuffle_csr_column_indices(arr)
+    assert shuffled.indices.asnumpy().dtype == dt
