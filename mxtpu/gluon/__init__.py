@@ -15,3 +15,4 @@ from . import data
 from . import utils
 from . import model_zoo
 from . import rnn
+from . import contrib

@@ -85,6 +85,15 @@ class _BlockScope:
 _NAME_COUNTERS = {}
 
 
+def _iter_syms(nest):
+    from .. import symbol as _s
+    if isinstance(nest, _s.Symbol):
+        yield nest
+    elif isinstance(nest, (list, tuple)):
+        for item in nest:
+            yield from _iter_syms(item)
+
+
 def _name_counter(hint):
     count = _NAME_COUNTERS.get(hint, 0)
     _NAME_COUNTERS[hint] = count + 1
@@ -291,6 +300,10 @@ class HybridBlock(Block):
         sym_args = _rebuild_like(args, iter(data_syms))
         with _ag.pause():
             out = self._symbolic_forward(*sym_args)
+        if not hasattr(out, "infer_shape_partial"):
+            # blocks may return (output, states)-style nests: group every
+            # symbol so all parameters participate in shape inference
+            out = _sym.Group(list(_iter_syms(out)))
         shape_kwargs = {"__data%d" % i: a.shape for i, a in enumerate(flat)}
         arg_shapes, _, aux_shapes = out.infer_shape_partial(**shape_kwargs)
         names = out.list_arguments()

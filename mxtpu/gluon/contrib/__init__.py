@@ -1,0 +1,7 @@
+"""Gluon contrib (reference python/mxnet/gluon/contrib): experimental
+layers, recurrent cells and data utilities."""
+from . import nn
+from . import rnn
+from . import data
+
+__all__ = ["nn", "rnn", "data"]

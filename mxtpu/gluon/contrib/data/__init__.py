@@ -1,0 +1,3 @@
+from .sampler import IntervalSampler
+
+__all__ = ["IntervalSampler"]

@@ -1,0 +1,3 @@
+from .basic_layers import Concurrent, HybridConcurrent, Identity
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity"]

@@ -1,0 +1,41 @@
+"""Contrib layers (reference gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ...nn import Sequential, HybridSequential
+from ...block import HybridBlock
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input and concat their outputs
+    (reference basic_layers.py:27 — Inception-style towers)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference basic_layers.py:60)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference basic_layers.py:93): the no-op
+    branch in Concurrent residual compositions."""
+
+    def hybrid_forward(self, F, x):
+        return x

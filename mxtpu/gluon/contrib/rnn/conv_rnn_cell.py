@@ -1,0 +1,199 @@
+"""Convolutional recurrent cells (reference
+gluon/contrib/rnn/conv_rnn_cell.py, 975 LoC): RNN/LSTM/GRU cells whose
+input-to-hidden and hidden-to-hidden transforms are N-D convolutions —
+spatio-temporal models (ConvLSTM, Shi et al. 2015). One generic base
+covers the 9 reference classes; the state is a [batch, hidden_channels,
+*spatial] feature map.
+
+As in the reference, ``input_shape`` (C, *spatial) is given at
+construction so weight shapes are static; h2h convolutions use "same"
+padding so the state keeps its spatial shape.
+"""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tuplize(v, ndim, name):
+    if isinstance(v, int):
+        return (v,) * ndim
+    v = tuple(v)
+    assert len(v) == ndim, "%s must have %d elements" % (name, ndim)
+    return v
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, num_gates, conv_ndim, activation,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._ndim = conv_ndim
+        self._input_shape = tuple(input_shape)       # (C, *spatial)
+        self._hidden_channels = hidden_channels
+        self._activation = activation
+        self._i2h_kernel = _tuplize(i2h_kernel, conv_ndim, "i2h_kernel")
+        self._h2h_kernel = _tuplize(h2h_kernel, conv_ndim, "h2h_kernel")
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, ("h2h_kernel must be odd for same-padding; "
+                                "got %s" % (self._h2h_kernel,))
+        self._i2h_pad = _tuplize(i2h_pad, conv_ndim, "i2h_pad")
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        G = num_gates
+        self._num_gates = G
+        in_c = self._input_shape[0]
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(G * hidden_channels, in_c)
+            + self._i2h_kernel, init=i2h_weight_initializer,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(G * hidden_channels, hidden_channels)
+            + self._h2h_kernel, init=h2h_weight_initializer,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(G * hidden_channels,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(G * hidden_channels,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    @property
+    def _state_shape(self):
+        # spatial dims after the i2h conv (stride 1, given pad)
+        spatial = tuple(
+            s + 2 * p - k + 1
+            for s, p, k in zip(self._input_shape[1:], self._i2h_pad,
+                               self._i2h_kernel))
+        return (self._hidden_channels,) + spatial
+
+    _num_states = 1          # subclasses with cell state override
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size,) + self._state_shape
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._ndim:]}
+                for _ in range(self._num_states)]
+
+    def _convs(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+               h2h_bias):
+        G = self._num_gates
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=G * self._hidden_channels)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=G * self._hidden_channels)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, activation="tanh", conv_ndim=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, num_gates=1,
+                         conv_ndim=conv_ndim, activation=activation,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _num_states = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, activation="tanh", conv_ndim=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, num_gates=4,
+                         conv_ndim=conv_ndim, activation=activation,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        sl = F.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(sl[0])
+        f = F.sigmoid(sl[1])
+        g = self._act(F, sl[2])
+        o = F.sigmoid(sl[3])
+        next_c = f * states[1] + i * g
+        next_h = o * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, activation="tanh", conv_ndim=2, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, num_gates=3,
+                         conv_ndim=conv_ndim, activation=activation,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._convs(F, inputs, states, i2h_weight, h2h_weight,
+                               i2h_bias, h2h_bias)
+        i2h_sl = F.split(i2h, num_outputs=3, axis=1)
+        h2h_sl = F.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(i2h_sl[0] + h2h_sl[0])
+        z = F.sigmoid(i2h_sl[1] + h2h_sl[1])
+        n = self._act(F, i2h_sl[2] + r * h2h_sl[2])
+        next_h = (1 - z) * n + z * states[0]
+        return next_h, [next_h]
+
+
+def _make(ndim, base, alias_name, doc):
+    class Cell(base):
+        __doc__ = doc
+
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, activation="tanh", **kwargs):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad, activation=activation,
+                             conv_ndim=ndim, **kwargs)
+
+    Cell.__name__ = alias_name
+    Cell.__qualname__ = alias_name
+    return Cell
+
+
+Conv1DRNNCell = _make(1, _ConvRNNCell, "Conv1DRNNCell",
+                      "1-D convolutional RNN cell (reference :218).")
+Conv2DRNNCell = _make(2, _ConvRNNCell, "Conv2DRNNCell",
+                      "2-D convolutional RNN cell (reference :285).")
+Conv3DRNNCell = _make(3, _ConvRNNCell, "Conv3DRNNCell",
+                      "3-D convolutional RNN cell (reference :352).")
+Conv1DLSTMCell = _make(1, _ConvLSTMCell, "Conv1DLSTMCell",
+                       "1-D ConvLSTM cell (reference :473).")
+Conv2DLSTMCell = _make(2, _ConvLSTMCell, "Conv2DLSTMCell",
+                       "2-D ConvLSTM cell (Shi et al.; reference :550).")
+Conv3DLSTMCell = _make(3, _ConvLSTMCell, "Conv3DLSTMCell",
+                       "3-D ConvLSTM cell (reference :627).")
+Conv1DGRUCell = _make(1, _ConvGRUCell, "Conv1DGRUCell",
+                      "1-D ConvGRU cell (reference :762).")
+Conv2DGRUCell = _make(2, _ConvGRUCell, "Conv2DGRUCell",
+                      "2-D ConvGRU cell (reference :834).")
+Conv3DGRUCell = _make(3, _ConvGRUCell, "Conv3DGRUCell",
+                      "3-D ConvGRU cell (reference :906).")

@@ -1,0 +1,132 @@
+"""Contrib recurrent cells (reference gluon/contrib/rnn/rnn_cell.py):
+VariationalDropoutCell (same dropout mask across all time steps) and
+LSTMPCell (LSTM with a learned hidden-state projection, LSTMP)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import ModifierCell, HybridRecurrentCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (locked) dropout around a cell (reference
+    contrib/rnn/rnn_cell.py:26, Gal & Ghahramani): ONE Bernoulli mask per
+    sequence for each of input/state/output, reused at every step, unlike
+    DropoutCell's fresh mask per step."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def hybridize(self, active=True, **kwargs):
+        if active:
+            # the locked masks live on the instance between eager steps;
+            # a jitted trace would bake one rng draw in and resample a
+            # FRESH mask per compiled call — the opposite semantics
+            raise NotImplementedError(
+                "VariationalDropoutCell does not support hybridize: the "
+                "per-sequence locked masks are instance state (reference "
+                "contrib cell is also trace-hostile); unroll it eagerly")
+        super().hybridize(active, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def _initialize_mask(self, F, name, data, rate):
+        if getattr(self, name) is None and rate:
+            # dropout over ones = the locked mask (scaled at train time,
+            # identity at inference, matching Dropout's mode handling)
+            setattr(self, name, F.Dropout(F.ones_like(data), p=rate))
+        return getattr(self, name)
+
+    def hybrid_forward(self, F, inputs, states):
+        if self.drop_inputs:
+            mask = self._initialize_mask(F, "drop_inputs_mask", inputs,
+                                         self.drop_inputs)
+            inputs = inputs * mask
+        if self.drop_states:
+            mask = self._initialize_mask(F, "drop_states_mask", states[0],
+                                         self.drop_states)
+            states = [states[0] * mask] + list(states[1:])
+        output, states = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            mask = self._initialize_mask(F, "drop_outputs_mask", output,
+                                         self.drop_outputs)
+            output = output * mask
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()     # fresh masks per sequence
+        return super().unroll(length, inputs, begin_state, layout,
+                              merge_outputs, valid_length)
+
+
+class LSTMPCell(HybridRecurrentCell):
+    """LSTM with hidden projection (reference contrib/rnn/rnn_cell.py:197,
+    Sak et al. 2014): the recurrent/output state is h = proj(o * tanh(c)),
+    decoupling cell width from recurrent width."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = F.tanh(slices[2])
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        hidden = out_gate * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]

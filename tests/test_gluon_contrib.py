@@ -1,0 +1,128 @@
+"""gluon.contrib: Concurrent/Identity, IntervalSampler, variational
+dropout, LSTMP, ConvRNN/LSTM/GRU cells (reference gluon/contrib)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, gluon
+from mxtpu.gluon import nn
+from mxtpu.gluon.contrib import nn as cnn
+from mxtpu.gluon.contrib import rnn as crnn
+from mxtpu.gluon.contrib.data import IntervalSampler
+
+
+def test_concurrent_and_identity():
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(3), cnn.Identity(), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.ones((4, 5), np.float32))
+    out = net(x)
+    assert out.shape == (4, 3 + 5 + 2)
+    np.testing.assert_allclose(out.asnumpy()[:, 3:8], 1.0)
+
+    net2 = cnn.Concurrent(axis=1)
+    net2.add(cnn.Identity(), cnn.Identity())
+    out2 = net2(x)
+    assert out2.shape == (4, 10)
+
+
+def test_interval_sampler():
+    s = IntervalSampler(10, 3)
+    idx = list(s)
+    assert sorted(idx) == list(range(10))      # rollover covers all
+    assert idx[:4] == [0, 3, 6, 9]
+    s2 = IntervalSampler(10, 3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9]
+    assert len(s2) == 4
+
+
+def test_lstmp_cell():
+    mx.random.seed(0)
+    cell = crnn.LSTMPCell(hidden_size=8, projection_size=3)
+    inputs = [nd.array(np.random.RandomState(i).rand(2, 4)
+                       .astype(np.float32)) for i in range(5)]
+    cell.initialize(mx.init.Xavier())
+    outputs, states = cell.unroll(5, inputs, merge_outputs=False)
+    assert outputs[-1].shape == (2, 3)          # projected size
+    assert states[0].shape == (2, 3)
+    assert states[1].shape == (2, 8)            # cell keeps full width
+
+
+def test_variational_dropout_rejects_hybridize():
+    base = gluon.rnn.RNNCell(4, input_size=4)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    with pytest.raises(NotImplementedError):
+        cell.hybridize()
+
+
+def test_variational_dropout_locked_mask():
+    mx.random.seed(0)
+    base = gluon.rnn.RNNCell(6, input_size=6)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.ones((3, 6), np.float32))
+    states = cell.begin_state(batch_size=3)
+    with mx.autograd.record():
+        cell.reset()
+        _ = cell(x, states)
+        m1 = cell.drop_inputs_mask.asnumpy()
+        _ = cell(x, states)
+        m2 = cell.drop_inputs_mask.asnumpy()
+    np.testing.assert_allclose(m1, m2)          # locked across steps
+    assert (m1 == 0).any() or (m1 != 1).any()   # dropout actually applied
+    cell.reset()
+    assert cell.drop_inputs_mask is None
+
+
+@pytest.mark.parametrize("Cell,n_states", [
+    (crnn.Conv1DRNNCell, 1), (crnn.Conv2DRNNCell, 1),
+    (crnn.Conv1DLSTMCell, 2), (crnn.Conv2DLSTMCell, 2),
+    (crnn.Conv3DLSTMCell, 2),
+    (crnn.Conv2DGRUCell, 1),
+])
+def test_conv_cells(Cell, n_states):
+    mx.random.seed(0)
+    nd_dims = {"Conv1D": 1, "Conv2D": 2, "Conv3D": 3}[Cell.__name__[:6]]
+    spatial = (8,) * nd_dims
+    cell = Cell(input_shape=(2,) + spatial, hidden_channels=4,
+                i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    seq = [nd.array(np.random.RandomState(t).rand(2, 2, *spatial)
+                    .astype(np.float32)) for t in range(3)]
+    outputs, states = cell.unroll(3, seq, merge_outputs=False)
+    assert outputs[-1].shape == (2, 4) + spatial
+    assert len(states) == n_states
+    for st in states:
+        assert st.shape == (2, 4) + spatial
+
+
+def test_conv_lstm_learns():
+    # ConvLSTM can fit "predict the previous frame" on tiny data
+    import logging
+    logging.disable(logging.INFO)
+    mx.random.seed(0)
+    cell = crnn.Conv2DLSTMCell(input_shape=(1, 6, 6), hidden_channels=4,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    head = nn.Conv2D(1, 1)
+    cell.initialize(mx.init.Xavier())
+    head.initialize(mx.init.Xavier())
+    all_params = {}
+    all_params.update(cell.collect_params())
+    all_params.update(head.collect_params())
+    trainer = gluon.Trainer(all_params, "adam", {"learning_rate": 1e-2})
+    rng = np.random.RandomState(0)
+    seq = [nd.array(rng.rand(2, 1, 6, 6).astype(np.float32))
+           for _ in range(4)]
+    L = gluon.loss.L2Loss()
+    first = last = None
+    for it in range(30):
+        with mx.autograd.record():
+            outs, _ = cell.unroll(4, seq, merge_outputs=False)
+            pred = head(outs[-2])
+            loss = L(pred, seq[-1])
+        loss.backward()
+        trainer.step(2)
+        v = float(loss.mean().asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first, (first, last)
