@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Toy DCGAN (reference example/gan/dcgan.py shape, shrunk to synthetic
+8x8 "images" so it runs in seconds): generator/discriminator as Gluon
+blocks, alternating adversarial updates with two Trainers — the training
+pattern the reference example demonstrates.
+
+Run: JAX_PLATFORMS=cpu python example/gan/dcgan_toy.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxtpu as mx          # noqa: E402
+from mxtpu import nd, gluon  # noqa: E402
+from mxtpu.gluon import nn   # noqa: E402
+
+
+def real_batch(rng, n):
+    """"Real" data: centered bright diamonds on dark background."""
+    imgs = np.zeros((n, 1, 8, 8), np.float32)
+    for i in range(n):
+        c = rng.randint(3, 5)
+        for d in range(3):
+            for dy in range(-d, d + 1):
+                dx = d - abs(dy)
+                imgs[i, 0, c + dy, c - dx:c + dx + 1] = 1.0 - 0.2 * d
+    return imgs + rng.rand(n, 1, 8, 8).astype(np.float32) * 0.05
+
+
+def build_nets():
+    netG = nn.HybridSequential()
+    netG.add(nn.Dense(64, activation="relu"),
+             nn.Dense(64, activation="relu"),
+             nn.Dense(64, activation="tanh"))
+    netD = nn.HybridSequential()
+    netD.add(nn.Conv2D(8, 3, padding=1), nn.LeakyReLU(0.2),
+             nn.MaxPool2D(2), nn.Flatten(), nn.Dense(1))
+    return netG, netD
+
+
+def main():
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    netG, netD = build_nets()
+    netG.initialize(mx.init.Normal(0.05))
+    netD.initialize(mx.init.Normal(0.05))
+    trainerG = gluon.Trainer(netG.collect_params(), "adam",
+                             {"learning_rate": 2e-3, "beta1": 0.5})
+    trainerD = gluon.Trainer(netD.collect_params(), "adam",
+                             {"learning_rate": 2e-3, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    B, Z = 32, 16
+    ones = nd.ones((B,))
+    zeros_l = nd.zeros((B,))
+
+    for it in range(120):
+        real = nd.array(real_batch(rng, B))
+        noise = nd.array(rng.randn(B, Z).astype(np.float32))
+        # D step: real -> 1, fake -> 0
+        with mx.autograd.record():
+            fake = netG(noise).reshape((B, 1, 8, 8))
+            errD = loss_fn(netD(real), ones) + \
+                loss_fn(netD(fake.detach()), zeros_l)
+        errD.backward()
+        trainerD.step(B)
+        # G step: fool D
+        with mx.autograd.record():
+            fake = netG(noise).reshape((B, 1, 8, 8))
+            errG = loss_fn(netD(fake), ones)
+        errG.backward()
+        trainerG.step(B)
+        if it % 30 == 0 or it == 119:
+            print("iter %3d  errD %.3f  errG %.3f"
+                  % (it, float(errD.mean().asnumpy()),
+                     float(errG.mean().asnumpy())))
+
+    # the generator should have moved toward the data manifold: its
+    # samples light up the center like the real diamonds
+    noise = nd.array(rng.randn(64, Z).astype(np.float32))
+    fake = netG(noise).reshape((64, 1, 8, 8)).asnumpy()
+    center = np.abs(fake[:, 0, 3:5, 3:5]).mean()
+    border = np.abs(fake[:, 0, 0, :]).mean()
+    print("center intensity %.3f vs border %.3f" % (center, border))
+    assert center > border, "generator did not learn center structure"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -324,10 +324,13 @@ class ModifierCell(HybridRecurrentCell):
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
-    def begin_state(self, func=None, **kwargs):
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        # positional batch_size must bind like every other cell's
+        # begin_state (RecurrentCell.unroll calls begin_state(batch_size))
         assert not self._modified
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func or nd.zeros, **kwargs)
+        begin = self.base_cell.begin_state(batch_size=batch_size,
+                                           func=func or nd.zeros, **kwargs)
         self.base_cell._modified = True
         return begin
 

@@ -126,3 +126,19 @@ def test_conv_lstm_learns():
         first = v if first is None else first
         last = v
     assert last < first, (first, last)
+
+
+def test_modifier_cell_default_unroll():
+    # unroll without explicit begin_state must work for ModifierCells
+    # (begin_state(batch_size) binds positionally)
+    mx.random.seed(0)
+    for wrap in (lambda c: crnn.VariationalDropoutCell(c, drop_inputs=0.3),
+                 lambda c: gluon.rnn.ZoneoutCell(c, zoneout_states=0.2)):
+        base = gluon.rnn.RNNCell(4, input_size=4)
+        cell = wrap(base)
+        cell.initialize(mx.init.Xavier())
+        seq = [nd.array(np.random.RandomState(t).rand(2, 4)
+                        .astype(np.float32)) for t in range(3)]
+        with mx.autograd.record():
+            outputs, _ = cell.unroll(3, seq, merge_outputs=False)
+        assert outputs[-1].shape == (2, 4)
