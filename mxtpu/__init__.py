@@ -41,7 +41,9 @@ def _optional_imports():
         ("profiler", ()), ("recordio", ()), ("image", ()),
         ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
         ("rnn", ()), ("engine", ()), ("operator", ()), ("contrib", ()),
-        ("rtc", ()), ("torch", ()),
+        ("rtc", ()), ("torch", ()), ("attribute", ()),
+        ("log", ()), ("registry", ()), ("libinfo", ()),
+        ("executor_manager", ()), ("misc", ()),
     ]:
         try:
             m = importlib.import_module("." + name, __name__)
@@ -57,6 +59,8 @@ def _optional_imports():
 
 
 _optional_imports()
+if "attribute" in globals():
+    AttrScope = attribute.AttrScope  # noqa: F821
 if "symbol" in globals():
     Symbol = symbol.Symbol  # noqa: F821
 if "executor" in globals():

@@ -1,0 +1,53 @@
+"""Symbol attribute scoping (reference python/mxnet/attribute.py):
+``with mx.AttrScope(ctx_group="dev1"):`` stamps every symbol created in
+the scope with the given attributes — how the reference expresses
+group2ctx model-parallel placement; mxtpu's sharding machinery reads the
+same attributes."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope"]
+
+
+class AttrScope:
+    """Attach attributes to all symbols created within the scope
+    (reference attribute.py:24). Scopes nest; inner values win."""
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be strings")
+        self._attr = kwargs
+
+    def get(self, attr):
+        """Merge scope attrs into (a copy of) ``attr``; explicit wins."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "value"):
+            AttrScope._current.value = AttrScope()
+        self._old_scope = AttrScope._current.value
+        merged = self._old_scope._attr.copy()
+        merged.update(self._attr)
+        self._attr = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *a):
+        assert self._old_scope is not None
+        AttrScope._current.value = self._old_scope
+
+
+def current():
+    if not hasattr(AttrScope._current, "value"):
+        AttrScope._current.value = AttrScope()
+    return AttrScope._current.value

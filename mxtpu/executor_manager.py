@@ -1,0 +1,13 @@
+"""Legacy executor-manager shim (reference python/mxnet/executor_manager.py,
+441 LoC): the pre-Module data-parallel machinery. The maintained
+implementation lives in mxtpu.module.executor_group; this module keeps the
+reference's import surface for code that reaches into the internals."""
+from __future__ import annotations
+
+from .module.executor_group import (DataParallelExecutorGroup,
+                                    _split_input_slice)
+
+__all__ = ["DataParallelExecutorGroup", "_split_input_slice"]
+
+# reference name for the manager object; the group subsumes its job
+DataParallelExecutorManager = DataParallelExecutorGroup

@@ -1,0 +1,34 @@
+"""Library discovery (reference python/mxnet/libinfo.py): locate the
+native shared libraries and report the version."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path", "__version__"]
+
+
+def find_lib_path():
+    """Paths of the native libs (reference find_lib_path returns the
+    libmxnet.so candidates; here: the predict + io .so files that exist)."""
+    native = os.path.join(os.path.dirname(__file__), "_native")
+    libs = [os.path.join(native, n)
+            for n in ("libmxtpu_predict.so", "libmxtpu_io.so")]
+    found = [p for p in libs if os.path.exists(p)]
+    if not found:
+        raise RuntimeError(
+            "Cannot find the native libraries (run `make -C %s`); "
+            "List of candidates:\n%s" % (native, "\n".join(libs)))
+    return found
+
+
+def _get_version():
+    from . import __version__ as v
+    return v
+
+
+# resolved lazily via module __getattr__ so the package constant is the
+# single source of truth
+def __getattr__(name):
+    if name == "__version__":
+        return _get_version()
+    raise AttributeError(name)

@@ -1,0 +1,61 @@
+"""Logging utilities (reference python/mxnet/log.py): a level-colored
+formatter and get_logger()."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+
+class _Formatter(logging.Formatter):
+    """Level-colored formatter (reference log.py:37)."""
+
+    def __init__(self, colored=True):
+        self.colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def _get_color(self, level):
+        if level >= ERROR:
+            return "\x1b[31m"
+        if level >= WARNING:
+            return "\x1b[33m"
+        return "\x1b[32m"
+
+    def format(self, record):
+        fmt = ""
+        if self.colored and sys.stderr.isatty():
+            fmt = self._get_color(record.levelno)
+        fmt += record.levelname[0]
+        fmt += "%(asctime)s %(process)d %(pathname)s:%(funcName)s:%(lineno)d"
+        if self.colored and sys.stderr.isatty():
+            fmt += "\x1b[0m"
+        fmt += " %(message)s"
+        self._style._fmt = fmt
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configured logger (reference log.py:90)."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", False):
+        logger._init_done = True
+        if filename:
+            hdlr = logging.FileHandler(filename, filemode or "a")
+            hdlr.setFormatter(_Formatter(colored=False))
+        else:
+            hdlr = logging.StreamHandler()
+            hdlr.setFormatter(_Formatter())
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger
+
+
+getLogger = get_logger

@@ -1,0 +1,8 @@
+"""Deprecated LR schedulers (reference python/mxnet/misc.py — superseded
+there and here by lr_scheduler.py; kept as aliases)."""
+from __future__ import annotations
+
+from .lr_scheduler import LRScheduler as LearningRateScheduler
+from .lr_scheduler import FactorScheduler
+
+__all__ = ["LearningRateScheduler", "FactorScheduler"]

@@ -1,0 +1,81 @@
+"""Generic class registry (reference python/mxnet/registry.py): the
+machinery behind ``mx.optimizer.register`` / ``mx.init.register`` /
+``mx.metric.register`` — exposed so user code can build the same
+nickname-keyed factories."""
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def get_registry(base_class):
+    """A copy of the registered name -> class map for base_class."""
+    return dict(_REGISTRIES.get(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """A decorator registering subclasses of base_class by lowercase name
+    (reference registry.py:49)."""
+    registry = _REGISTRIES.setdefault(base_class, {})
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "Can only register subclass of %s" % base_class.__name__
+        name = (name or klass.__name__).lower()
+        if name in registry and registry[name] is not klass:
+            warnings.warn("New %s %s registered with name %s is overriding "
+                          "existing %s" % (nickname, klass,
+                                           name, registry[name]))
+        registry[name] = klass
+        return klass
+
+    register.__name__ = "register_" + nickname
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+
+    alias.__name__ = "alias_" + nickname
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """A factory: create(name_or_instance_or_json, *args, **kwargs)
+    (reference registry.py:115)."""
+    registry = _REGISTRIES.setdefault(base_class, {})
+
+    def create(*args, **kwargs):
+        if args:
+            name, args = args[0], args[1:]
+        else:
+            name = kwargs.pop(nickname)
+        if isinstance(name, base_class):
+            assert not args and not kwargs, \
+                "%s is already an instance; additional arguments are " \
+                "invalid" % nickname
+            return name
+        if isinstance(name, str) and name.startswith("["):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        assert isinstance(name, str), "%s must be of string type" % nickname
+        name = name.lower()
+        assert name in registry, "%s is not registered (known: %s)" % (
+            name, sorted(registry))
+        return registry[name](*args, **kwargs)
+
+    create.__name__ = "create_" + nickname
+    return create

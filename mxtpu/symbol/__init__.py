@@ -17,6 +17,7 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from ..attribute import current as _attr_scope_current
 from ..base import canonical_dtype
 from ..context import current_context
 from ..ops.registry import get_op, rng_scope
@@ -152,6 +153,13 @@ class Symbol:
         return self.list_arguments() + self.list_auxiliary_states()
 
     # -- attributes --------------------------------------------------------
+    def list_attr(self, recursive=False):
+        """Attributes of this symbol's output node (reference
+        symbol.py:list_attr); attr_dict() for the whole graph."""
+        if recursive:
+            return self.attr_dict()
+        return dict(self._outputs[0][0].attrs)
+
     def attr(self, key):
         return self._outputs[0][0].attrs.get(key)
 
@@ -342,6 +350,7 @@ def _array_input_names(op, params):
 def _create_symbol(op, *args, **kwargs):
     name = kwargs.pop("name", None)
     attrs = kwargs.pop("attr", None)
+    attrs = _attr_scope_current().get(attrs)   # with AttrScope(...): stamping
     # split symbol inputs passed as kwargs
     sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
     for k in sym_kwargs:
@@ -445,6 +454,7 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs):
     """Create a free variable (parity with sym.var / sym.Variable)."""
     node = _Node(None, name)
+    attr = _attr_scope_current().get(attr)   # AttrScope stamps vars too
     if attr:
         node.attrs.update(attr)
     if shape is not None:

@@ -1,0 +1,78 @@
+"""attribute/log/registry/libinfo/executor_manager/misc parity modules."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+
+def test_attr_scope_stamps_symbols():
+    with mx.AttrScope(ctx_group="dev1", lr_mult="2"):
+        a = mx.sym.var("a")
+        b = mx.sym.FullyConnected(a, num_hidden=4, name="fc")
+        with mx.AttrScope(ctx_group="dev2"):
+            c = mx.sym.relu(b, name="r")
+    d = mx.sym.relu(b, name="d")
+    assert a.list_attr().get("ctx_group") == "dev1"   # variables stamped
+    assert b.list_attr().get("ctx_group") == "dev1"
+    assert b.list_attr().get("lr_mult") == "2"
+    assert c.list_attr().get("ctx_group") == "dev2"
+    assert c.list_attr().get("lr_mult") == "2"     # nesting inherits
+    assert "ctx_group" not in d.list_attr()
+    with pytest.raises(ValueError):
+        mx.AttrScope(ctx_group=1)
+
+
+def test_explicit_attr_wins():
+    with mx.AttrScope(ctx_group="scope"):
+        s = mx.sym.var("x")
+        y = mx.sym.relu(s, name="y", attr={"ctx_group": "explicit"})
+    assert y.list_attr()["ctx_group"] == "explicit"
+
+
+def test_log_get_logger(tmp_path):
+    lg = mx.log.get_logger("mxtpu_test_log", level=logging.INFO)
+    assert lg.level == logging.INFO
+    assert lg.handlers
+    lg2 = mx.log.get_logger("mxtpu_test_log")
+    assert lg2 is lg and len(lg2.handlers) == 1   # no duplicate handlers
+    lgf = mx.log.get_logger("mxtpu_test_log_f", str(tmp_path / "x.log"))
+    lgf.warning("hello")
+    for h in lgf.handlers:
+        h.flush()
+    assert "hello" in (tmp_path / "x.log").read_text()
+
+
+def test_generic_registry():
+    class Base:
+        pass
+
+    reg = mx.registry.get_register_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+
+    @alias("t2")
+    @reg
+    class Thing(Base):
+        def __init__(self, v=1):
+            self.v = v
+
+    assert create("thing").v == 1
+    assert create("T2", v=5).v == 5
+    inst = Thing(9)
+    assert create(inst) is inst
+    assert "thing" in mx.registry.get_registry(Base)
+    with pytest.raises(AssertionError):
+        create("missing")
+
+
+def test_libinfo_and_misc_and_manager():
+    libs = mx.libinfo.find_lib_path()
+    assert any(p.endswith(".so") for p in libs)
+    assert mx.libinfo.__version__
+    from mxtpu.executor_manager import (DataParallelExecutorManager,
+                                        _split_input_slice)
+    slices = _split_input_slice(10, [1, 1])
+    assert len(slices) == 2
+    assert mx.misc.FactorScheduler is mx.lr_scheduler.FactorScheduler
