@@ -25,8 +25,9 @@ class AttrScope:
 
     def get(self, attr):
         """Merge scope attrs into (a copy of) ``attr``; explicit wins."""
-        if self._attr:
-            ret = self._attr.copy()
+        eff = self._effective_attrs()
+        if eff:
+            ret = dict(eff)
             if attr:
                 ret.update(attr)
             return ret
@@ -36,14 +37,20 @@ class AttrScope:
         if not hasattr(AttrScope._current, "value"):
             AttrScope._current.value = AttrScope()
         self._old_scope = AttrScope._current.value
-        merged = self._old_scope._attr.copy()
-        merged.update(self._attr)
-        self._attr = merged
+        # effective attrs = parent's merged with ours, computed per entry
+        # (never mutate self._attr: a reused scope must not leak whatever
+        # it was previously nested under)
+        self._effective = self._old_scope._effective_attrs()
+        self._effective.update(self._attr)
         AttrScope._current.value = self
         return self
 
+    def _effective_attrs(self):
+        return dict(getattr(self, "_effective", None) or self._attr)
+
     def __exit__(self, *a):
         assert self._old_scope is not None
+        self._effective = None
         AttrScope._current.value = self._old_scope
 
 

@@ -46,7 +46,6 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
     """Configured logger (reference log.py:90)."""
     logger = logging.getLogger(name)
     if name is not None and not getattr(logger, "_init_done", False):
-        logger._init_done = True
         if filename:
             hdlr = logging.FileHandler(filename, filemode or "a")
             hdlr.setFormatter(_Formatter(colored=False))
@@ -55,6 +54,7 @@ def get_logger(name=None, filename=None, filemode=None, level=WARNING):
             hdlr.setFormatter(_Formatter())
         logger.addHandler(hdlr)
         logger.setLevel(level)
+        logger._init_done = True   # only after the handler attached
     return logger
 
 

@@ -159,6 +159,31 @@ class ShardingRules:
     def __init__(self, rules=None):
         self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
 
+    @classmethod
+    def from_ctx_groups(cls, symbol, group2spec):
+        """Build rules from ``ctx_group`` attributes stamped by AttrScope
+        (the reference's group2ctx flow, ``with mx.AttrScope(ctx_group=
+        'dev1'):`` + ``group2ctx`` in bind): every variable whose node
+        carries ``ctx_group: g`` gets ``group2spec[g]``.
+
+        >>> with mx.AttrScope(ctx_group="experts"):
+        ...     w = mx.sym.var("expert_weight")
+        >>> rules = ShardingRules.from_ctx_groups(
+        ...     net, {"experts": P("model", None)})
+        """
+        attrs = symbol.attr_dict() if hasattr(symbol, "attr_dict") else {}
+        names = set(symbol.list_arguments()) | \
+            set(symbol.list_auxiliary_states()) \
+            if hasattr(symbol, "list_arguments") else set(attrs)
+        rules = []
+        for name, a in attrs.items():
+            if name not in names:     # variables only, not op nodes
+                continue
+            g = a.get("ctx_group")
+            if g is not None and g in group2spec:
+                rules.append((re.escape(name) + "$", group2spec[g]))
+        return cls(rules)
+
     def spec_for(self, name, shape):
         for pat, spec in self.rules:
             if pat.match(name):

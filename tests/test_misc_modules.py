@@ -76,3 +76,22 @@ def test_libinfo_and_misc_and_manager():
     slices = _split_input_slice(10, [1, 1])
     assert len(slices) == 2
     assert mx.misc.FactorScheduler is mx.lr_scheduler.FactorScheduler
+
+
+def test_attr_scope_reuse_no_leak():
+    s = mx.AttrScope(lr_mult="2")
+    with mx.AttrScope(ctx_group="dev1"):
+        with s:
+            pass
+    with s:
+        v = mx.sym.var("leakcheck")
+    attrs = v.list_attr()
+    assert attrs.get("lr_mult") == "2"
+    assert "ctx_group" not in attrs      # dev1 must not leak out
+
+
+def test_get_logger_retry_after_failure(tmp_path):
+    with pytest.raises(OSError):
+        mx.log.get_logger("mxtpu_retry_log", "/nonexistent_dir_xyz/a.log")
+    lg = mx.log.get_logger("mxtpu_retry_log", str(tmp_path / "b.log"))
+    assert lg.handlers                   # retry actually initialized

@@ -354,3 +354,33 @@ def test_moe_ffn_expert_sharded():
     # top-1 routing with cf=2 must place every token
     dispatch, _, _ = parallel.moe_dispatch(x @ gate_w, capacity=8)
     assert float(np.asarray(dispatch).sum()) == t
+
+
+def test_sharding_rules_from_ctx_groups():
+    import mxtpu as mx
+    from jax.sharding import PartitionSpec as P
+    from mxtpu.parallel import ShardingRules
+
+    with mx.AttrScope(ctx_group="tp"):
+        w = mx.sym.var("fc_weight")
+    x = mx.sym.var("data")
+    out = mx.sym.FullyConnected(x, w, num_hidden=8, no_bias=True,
+                                name="fc")
+    rules = ShardingRules.from_ctx_groups(out, {"tp": P("model", None)})
+    assert tuple(rules.spec_for("fc_weight", (8, 4))) == ("model", None)
+    assert tuple(rules.spec_for("data", (2, 4))) == ()
+    assert tuple(rules.spec_for("fc_weight_suffix", (8, 4))) == ()
+
+
+def test_ctx_group_rules_skip_op_nodes():
+    import mxtpu as mx
+    from jax.sharding import PartitionSpec as P
+    from mxtpu.parallel import ShardingRules
+    with mx.AttrScope(ctx_group="tp"):
+        x = mx.sym.var("data2")
+        out = mx.sym.FullyConnected(x, num_hidden=4, name="opnode")
+    rules = ShardingRules.from_ctx_groups(out, {"tp": P("model", None)})
+    # op node 'opnode' stamped but excluded; its auto-created weight and
+    # the variable are included
+    assert tuple(rules.spec_for("opnode", (4, 4))) == ()
+    assert tuple(rules.spec_for("data2", (2, 4))) == ("model", None)

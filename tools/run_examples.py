@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Run every example end to end on the CPU mesh (the reference's example
+suites double as integration tests; this is the local runner)."""
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    ("image-classification/train_mnist.py", {}),
+    ("image-classification/benchmark_score.py",
+     {"ARGS": ["--models", "resnet-50", "--batch-sizes", "1"]}),
+    ("rnn/lstm_bucketing.py", {}),
+    ("ssd/train_ssd_toy.py", {}),
+    ("gan/dcgan_toy.py", {}),
+    ("long-context/ring_attention_lm.py", {"DEVICES": 8}),
+    ("model-parallel/tp_mlp.py", {"DEVICES": 8}),
+]
+
+
+def main():
+    failures = []
+    for rel, cfg in EXAMPLES:
+        path = os.path.join(ROOT, "example", rel)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        if cfg.get("DEVICES"):
+            env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
+                                % cfg["DEVICES"])
+        else:
+            env.pop("XLA_FLAGS", None)
+        t0 = time.time()
+        res = subprocess.run([sys.executable, path] + cfg.get("ARGS", []),
+                             env=env, capture_output=True, text=True,
+                             timeout=1200)
+        status = "OK " if res.returncode == 0 else "FAIL"
+        print("%s %-45s %6.1fs" % (status, rel, time.time() - t0))
+        if res.returncode != 0:
+            failures.append((rel, res.stdout[-800:] + res.stderr[-800:]))
+    for rel, out in failures:
+        print("\n--- %s ---\n%s" % (rel, out))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
