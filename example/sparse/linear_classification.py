@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Sparse linear classification (reference example/sparse/linear_
+classification.py: LibSVMIter CSR batches + row_sparse weight, lazy
+sparse optimizer updates through the kvstore).
+
+Run: JAX_PLATFORMS=cpu python example/sparse/linear_classification.py
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxtpu as mx          # noqa: E402
+from mxtpu import nd        # noqa: E402
+
+
+def write_libsvm(path, X, y):
+    with open(path, "w") as f:
+        for row, lab in zip(X, y):
+            idx = np.nonzero(row)[0]
+            f.write("%d %s\n" % (lab, " ".join(
+                "%d:%.4f" % (i, row[i]) for i in idx)))
+
+
+def main():
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    n, d = 512, 64
+    w_true = np.zeros(d, np.float32)
+    w_true[rng.choice(d, 8, replace=False)] = rng.randn(8)
+    X = (rng.rand(n, d) < 0.1) * rng.randn(n, d).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.int32)
+
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "train.libsvm")
+    write_libsvm(path, X, y)
+
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(d,), batch_size=64)
+
+    # symbolic logistic regression; the data flows as CSR batches
+    data = mx.sym.var("data", stype="csr")
+    label = mx.sym.var("softmax_label")
+    w = mx.sym.var("weight", stype="row_sparse", shape=(d, 2))
+    out = mx.sym.SoftmaxOutput(mx.sym.dot(data, w), label, name="softmax")
+
+    import logging
+    logging.disable(logging.INFO)
+    mod = mx.mod.Module(out, context=mx.cpu(),
+                        data_names=["data"], label_names=["softmax_label"])
+    mod.fit(it, num_epoch=10, optimizer="adam",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Normal(0.01))
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    print("train accuracy: %.3f" % acc)
+    assert acc > 0.8, acc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,7 @@
 ``with mx.AttrScope(ctx_group="dev1"):`` stamps every symbol created in
 the scope with the given attributes — how the reference expresses
 group2ctx model-parallel placement; mxtpu's sharding machinery reads the
-same attributes."""
+same attributes (ShardingRules.from_ctx_groups)."""
 from __future__ import annotations
 
 import threading
@@ -12,49 +12,54 @@ __all__ = ["AttrScope"]
 
 class AttrScope:
     """Attach attributes to all symbols created within the scope
-    (reference attribute.py:24). Scopes nest; inner values win."""
+    (reference attribute.py:24). Scopes nest (inner wins) and instances
+    are freely reusable/re-entrant: the active stack lives in
+    thread-local state, never on the instance."""
 
-    _current = threading.local()
+    _local = threading.local()
 
     def __init__(self, **kwargs):
-        self._old_scope = None
         for value in kwargs.values():
             if not isinstance(value, str):
                 raise ValueError("Attributes need to be strings")
         self._attr = kwargs
 
+    @staticmethod
+    def _stack():
+        if not hasattr(AttrScope._local, "stack"):
+            AttrScope._local.stack = []
+        return AttrScope._local.stack
+
     def get(self, attr):
-        """Merge scope attrs into (a copy of) ``attr``; explicit wins."""
-        eff = self._effective_attrs()
-        if eff:
-            ret = dict(eff)
-            if attr:
-                ret.update(attr)
-            return ret
-        return attr if attr else {}
+        """Effective attrs at this scope merged into (a copy of)
+        ``attr``; explicit entries win."""
+        stack = self._stack()
+        eff = {}
+        if any(s is self for s in stack):
+            for scope in stack:          # bottom-up: inner scopes win
+                eff.update(scope._attr)
+                if scope is self:
+                    break
+        else:
+            eff.update(self._attr)
+        if attr:
+            eff.update(attr)
+        return eff
 
     def __enter__(self):
-        if not hasattr(AttrScope._current, "value"):
-            AttrScope._current.value = AttrScope()
-        self._old_scope = AttrScope._current.value
-        # effective attrs = parent's merged with ours, computed per entry
-        # (never mutate self._attr: a reused scope must not leak whatever
-        # it was previously nested under)
-        self._effective = self._old_scope._effective_attrs()
-        self._effective.update(self._attr)
-        AttrScope._current.value = self
+        self._stack().append(self)
         return self
 
-    def _effective_attrs(self):
-        return dict(getattr(self, "_effective", None) or self._attr)
-
     def __exit__(self, *a):
-        assert self._old_scope is not None
-        self._effective = None
-        AttrScope._current.value = self._old_scope
+        stack = self._stack()
+        assert stack and stack[-1] is self, "unbalanced AttrScope exit"
+        stack.pop()
 
 
 def current():
-    if not hasattr(AttrScope._current, "value"):
-        AttrScope._current.value = AttrScope()
-    return AttrScope._current.value
+    """The innermost active scope (an empty one when none is active)."""
+    stack = AttrScope._stack()
+    return stack[-1] if stack else _EMPTY
+
+
+_EMPTY = AttrScope()

@@ -407,7 +407,8 @@ class CSVIter(DataIter):
     """CSV file iterator (reference src/io/iter_csv.cc:151)."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
-                 batch_size=1, round_batch=True, dtype="float32", **kwargs):
+                 batch_size=1, round_batch=True, dtype="float32",
+                 data_name="data", label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
         data = _np.loadtxt(data_csv, delimiter=",",
                            dtype=dtype, ndmin=2)
@@ -420,7 +421,7 @@ class CSVIter(DataIter):
             label = _np.zeros((data.shape[0],) + tuple(label_shape),
                               dtype=dtype)
         self._inner = NDArrayIter(
-            data={"data": data}, label={"label": label},
+            data={data_name: data}, label={label_name: label},
             batch_size=batch_size,
             last_batch_handle="pad" if round_batch else "discard")
         self.batch_size = batch_size
@@ -448,8 +449,10 @@ class LibSVMIter(DataIter):
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
                  label_shape=None, batch_size=1, round_batch=True,
-                 **kwargs):
+                 data_name="data", label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
+        self._data_name = data_name
+        self._label_name = label_name
         from .ndarray.sparse import csr_matrix
         self._data_shape = tuple(data_shape) if hasattr(data_shape,
                                                         "__len__") \
@@ -496,12 +499,13 @@ class LibSVMIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self._data_shape)]
 
     @property
     def provide_label(self):
-        shape = (self.batch_size,) + (self._labels.shape[1:] or ())
-        return [DataDesc("label", shape)]
+        shape = (self.batch_size,) + tuple(self._labels.shape[1:])
+        return [DataDesc(self._label_name, shape)]
 
     def reset(self):
         self._cursor = 0

@@ -95,3 +95,18 @@ def test_get_logger_retry_after_failure(tmp_path):
         mx.log.get_logger("mxtpu_retry_log", "/nonexistent_dir_xyz/a.log")
     lg = mx.log.get_logger("mxtpu_retry_log", str(tmp_path / "b.log"))
     assert lg.handlers                   # retry actually initialized
+
+
+def test_attr_scope_reentrant():
+    outer = mx.AttrScope(a="1")
+    s = mx.AttrScope(lr_mult="2")
+    with outer:
+        with s:
+            with s:
+                pass
+        v = mx.sym.var("reentrant_check")
+    attrs = v.list_attr()
+    assert attrs.get("a") == "1"            # outer still active + intact
+    assert "lr_mult" not in attrs           # s fully exited
+    from mxtpu.attribute import AttrScope as A
+    assert A._stack() == []                 # stack balanced

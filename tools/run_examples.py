@@ -17,6 +17,8 @@ EXAMPLES = [
     ("gan/dcgan_toy.py", {}),
     ("long-context/ring_attention_lm.py", {"DEVICES": 8}),
     ("model-parallel/tp_mlp.py", {"DEVICES": 8}),
+    ("recommenders/matrix_fact.py", {}),
+    ("sparse/linear_classification.py", {}),
 ]
 
 
@@ -31,13 +33,18 @@ def main():
         else:
             env.pop("XLA_FLAGS", None)
         t0 = time.time()
-        res = subprocess.run([sys.executable, path] + cfg.get("ARGS", []),
-                             env=env, capture_output=True, text=True,
-                             timeout=1200)
-        status = "OK " if res.returncode == 0 else "FAIL"
+        try:
+            res = subprocess.run([sys.executable, path]
+                                 + cfg.get("ARGS", []), env=env,
+                                 capture_output=True, text=True,
+                                 timeout=1200)
+            rc, out = res.returncode, res.stdout[-800:] + res.stderr[-800:]
+        except subprocess.TimeoutExpired as e:
+            rc, out = -1, "TIMEOUT after 1200s\n" + str(e.stdout or "")[-400:]
+        status = "OK " if rc == 0 else "FAIL"
         print("%s %-45s %6.1fs" % (status, rel, time.time() - t0))
-        if res.returncode != 0:
-            failures.append((rel, res.stdout[-800:] + res.stderr[-800:]))
+        if rc != 0:
+            failures.append((rel, out))
     for rel, out in failures:
         print("\n--- %s ---\n%s" % (rel, out))
     return 1 if failures else 0
