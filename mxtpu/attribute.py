@@ -35,11 +35,14 @@ class AttrScope:
         ``attr``; explicit entries win."""
         stack = self._stack()
         eff = {}
-        if any(s is self for s in stack):
-            for scope in stack:          # bottom-up: inner scopes win
+        idx = max((i for i, s in enumerate(stack) if s is self),
+                  default=None)
+        if idx is not None:
+            # merge every scope active at our INNERMOST entry (bottom-up:
+            # inner wins) — a re-entered scope must still see scopes
+            # nested between its two entries
+            for scope in stack[:idx + 1]:
                 eff.update(scope._attr)
-                if scope is self:
-                    break
         else:
             eff.update(self._attr)
         if attr:

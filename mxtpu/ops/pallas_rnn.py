@@ -1,4 +1,4 @@
-"""Pallas fused LSTM time loop (cuDNN-RNN parity, the second hot op).
+"""Pallas fused LSTM/GRU time loops (cuDNN-RNN parity, the second hot op).
 
 The fused RNN op (ops/rnn.py) hoists the input projection into one big
 MXU matmul and scans the recurrence with ``lax.scan``. This module lowers
@@ -23,7 +23,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["lstm_scan"]
+__all__ = ["lstm_scan", "gru_scan"]
 
 
 @functools.cache
@@ -88,6 +88,110 @@ def _fwd_call():
         )(x_proj, wh_t, h0, c0)
 
     return call
+
+
+@functools.cache
+def _gru_fwd_call():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(xp_ref, whrz_ref, whn_ref, bhn_ref, h0_ref, ys_ref, ht_ref,
+               h_s, *, T, H):
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _init():
+            h_s[:] = h0_ref[:].astype(jnp.float32)
+
+        h = h_s[:]
+        xp = xp_ref[0].astype(jnp.float32)            # [N, 3H], order r,z,n
+        rz = jax.nn.sigmoid(xp[:, :2 * H] + jax.lax.dot_general(
+            h, whrz_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))
+        r, z = rz[:, :H], rz[:, H:]
+        hn = jax.lax.dot_general(
+            h, whn_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) \
+            + bhn_ref[:].astype(jnp.float32)
+        n = jnp.tanh(xp[:, 2 * H:] + r * hn)
+        h = (1 - z) * n + z * h
+        h_s[:] = h
+        ys_ref[0] = h.astype(ys_ref.dtype)
+
+        @pl.when(t == T - 1)
+        def _fin():
+            ht_ref[:] = h.astype(ht_ref.dtype)
+
+    def call(x_proj, h0, whrz_t, whn_t, bhn):
+        T, N, G = x_proj.shape
+        H = h0.shape[-1]
+        return pl.pallas_call(
+            functools.partial(kernel, T=T, H=H),
+            grid=(T,),
+            in_specs=[
+                pl.BlockSpec((1, N, G), lambda t: (t, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, N, H), lambda t: (t, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((T, N, H), x_proj.dtype),
+                jax.ShapeDtypeStruct((N, H), h0.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((N, H), jnp.float32)],
+            interpret=jax.default_backend() != "tpu",
+        )(x_proj, whrz_t, whn_t, bhn, h0)
+
+    return call
+
+
+def _gru_scan_reference(x_proj, h0, whrz_t, whn_t, bhn):
+    """lax.scan formulation mirroring the GRU kernel's f32 precision."""
+    H = h0.shape[-1]
+    whrz32 = whrz_t.astype(jnp.float32)
+    whn32 = whn_t.astype(jnp.float32)
+    bhn32 = bhn.astype(jnp.float32)
+
+    def step(h, xp):
+        xp = xp.astype(jnp.float32)
+        rz = jax.nn.sigmoid(xp[:, :2 * H] + h @ whrz32)
+        r, z = rz[:, :H], rz[:, H:]
+        n = jnp.tanh(xp[:, 2 * H:] + r * (h @ whn32 + bhn32))
+        h = (1 - z) * n + z * h
+        return h, h.astype(x_proj.dtype)
+
+    hT, ys = jax.lax.scan(step, h0.astype(jnp.float32), x_proj)
+    return ys, hT.astype(h0.dtype)
+
+
+@jax.custom_vjp
+def gru_scan(x_proj, h0, whrz_t, whn_t, bhn):
+    """Fused GRU over time. x_proj: (T, N, 3H) pre-projected inputs
+    (x @ Wx + bi, gate order [r, z, n]), h0: (N, H), whrz_t: (H, 2H)
+    transposed r/z recurrent weights, whn_t: (H, H) candidate weights,
+    bhn: (H,) candidate recurrent bias (kept separate because the
+    candidate gate sees r * (h @ Whn + bhn)). Returns (ys, hT)."""
+    return _gru_fwd_call()(x_proj, h0, whrz_t, whn_t, bhn)
+
+
+def _gru_vjp_fwd(x_proj, h0, whrz_t, whn_t, bhn):
+    out = _gru_fwd_call()(x_proj, h0, whrz_t, whn_t, bhn)
+    return out, (x_proj, h0, whrz_t, whn_t, bhn)
+
+
+def _gru_vjp_bwd(res, cot):
+    _, vjp = jax.vjp(_gru_scan_reference, *res)
+    return vjp(cot)
+
+
+gru_scan.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)
 
 
 def _scan_reference(x_proj, h0, c0, wh_t):

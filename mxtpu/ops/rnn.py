@@ -109,6 +109,14 @@ def _run_direction(xs, h0, c0, wi, wh, bi, bh, mode, reverse):
         # split h2h so the candidate gate sees r * (h @ Whn + bhn)
         wh_rz, wh_n = wh[:2 * H], wh[2 * H:]
         bh_rz, bh_n = bh[:2 * H], bh[2 * H:]
+        if _pallas_lstm_enabled():
+            from .pallas_rnn import gru_scan
+            # fold the r/z recurrent bias into the hoisted projection
+            xp = x_proj.at[:, :, :2 * H].add(bh_rz)
+            ys, hT = gru_scan(xp, h0, wh_rz.T, wh_n.T, bh_n)
+            if reverse:
+                ys = jnp.flip(ys, axis=0)
+            return ys, hT, hT
 
         def step(carry, xp):
             h, _ = carry

@@ -110,3 +110,14 @@ def test_attr_scope_reentrant():
     assert "lr_mult" not in attrs           # s fully exited
     from mxtpu.attribute import AttrScope as A
     assert A._stack() == []                 # stack balanced
+
+
+def test_attr_scope_reentrant_sees_intervening_scope():
+    s = mx.AttrScope(a="1")
+    other = mx.AttrScope(b="2")
+    with s:
+        with other:
+            with s:
+                v = mx.sym.var("nested_reentrant")
+    attrs = v.list_attr()
+    assert attrs.get("a") == "1" and attrs.get("b") == "2"

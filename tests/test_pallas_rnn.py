@@ -132,3 +132,59 @@ def test_bf16_forward_backward_consistent():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    atol=5e-2, rtol=5e-2)
+
+
+def test_gru_forward_and_grads_match_scan():
+    from mxtpu.ops.pallas_rnn import gru_scan, _gru_scan_reference
+    rng = np.random.RandomState(11)
+    T, N, H = 5, 3, 4
+    xp = jnp.asarray(rng.standard_normal((T, N, 3 * H)).astype(np.float32))
+    h0 = jnp.asarray(rng.standard_normal((N, H)).astype(np.float32))
+    whrz = jnp.asarray(rng.standard_normal((H, 2 * H)).astype(np.float32)
+                       * 0.3)
+    whn = jnp.asarray(rng.standard_normal((H, H)).astype(np.float32) * 0.3)
+    bhn = jnp.asarray(rng.standard_normal((H,)).astype(np.float32) * 0.1)
+    ys_p, ht_p = gru_scan(xp, h0, whrz, whn, bhn)
+    ys_s, ht_s = _gru_scan_reference(xp, h0, whrz, whn, bhn)
+    np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_s),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ht_p), np.asarray(ht_s),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss(fn, *a):
+        ys, ht = fn(*a)
+        return jnp.sum(ys ** 2) + jnp.sum(jnp.sin(ht))
+
+    gp = jax.grad(lambda *a: loss(gru_scan, *a),
+                  argnums=(0, 1, 2, 3, 4))(xp, h0, whrz, whn, bhn)
+    gs = jax.grad(lambda *a: loss(_gru_scan_reference, *a),
+                  argnums=(0, 1, 2, 3, 4))(xp, h0, whrz, whn, bhn)
+    for a, b in zip(gp, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_rnn_op_gru_pallas_path(bidirectional):
+    T, N, I, H, L = 5, 3, 6, 4, 2
+    rng = np.random.RandomState(7)
+    x = rng.standard_normal((T, N, I)).astype(np.float32)
+    ndir = 2 if bidirectional else 1
+    psize = rnn_ops.rnn_param_size("gru", I, H, L, bidirectional)
+    params = (rng.standard_normal(psize) * 0.2).astype(np.float32)
+    h0 = np.zeros((L * ndir, N, H), np.float32)
+
+    def run():
+        return mx.nd.RNN(nd.array(x), nd.array(params), nd.array(h0),
+                         state_size=H, num_layers=L, mode="gru",
+                         bidirectional=bidirectional, state_outputs=True)
+
+    try:
+        rnn_ops.USE_PALLAS_LSTM = False
+        ref = [o.asnumpy() for o in run()]
+        rnn_ops.USE_PALLAS_LSTM = True
+        got = [o.asnumpy() for o in run()]
+    finally:
+        rnn_ops.USE_PALLAS_LSTM = None
+    for a, b in zip(got, ref):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)

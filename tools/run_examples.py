@@ -40,7 +40,9 @@ def main():
                                  timeout=1200)
             rc, out = res.returncode, res.stdout[-800:] + res.stderr[-800:]
         except subprocess.TimeoutExpired as e:
-            rc, out = -1, "TIMEOUT after 1200s\n" + str(e.stdout or "")[-400:]
+            rc = -1
+            out = ("TIMEOUT after 1200s\n" + str(e.stdout or "")[-800:]
+                   + str(e.stderr or "")[-800:])
         status = "OK " if rc == 0 else "FAIL"
         print("%s %-45s %6.1fs" % (status, rel, time.time() - t0))
         if rc != 0:
