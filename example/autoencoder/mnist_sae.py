@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Stacked autoencoder (reference example/autoencoder: layer-wise
+pretraining then end-to-end finetuning) on the synthetic MNIST stand-in
+from test_utils, sized to run in seconds.
+
+Run: JAX_PLATFORMS=cpu python example/autoencoder/mnist_sae.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxtpu as mx          # noqa: E402
+from mxtpu import nd, gluon  # noqa: E402
+from mxtpu.gluon import nn   # noqa: E402
+
+
+class AutoEncoder(gluon.HybridBlock):
+    def __init__(self, dims, **kw):
+        super().__init__(**kw)
+        self.encoder = nn.HybridSequential()
+        for d in dims:
+            self.encoder.add(nn.Dense(d, activation="relu"))
+        self.decoder = nn.HybridSequential()
+        for d in list(reversed(dims[:-1])):
+            self.decoder.add(nn.Dense(d, activation="relu"))
+        self.decoder.add(nn.Dense(28 * 28))
+
+    def hybrid_forward(self, F, x):
+        return self.decoder(self.encoder(x))
+
+
+def train(net, X, epochs, lr, batch=64):
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    L = gluon.loss.L2Loss()
+    loss = None
+    for ep in range(epochs):
+        tot = 0.0
+        for i in range(0, len(X), batch):
+            xb = nd.array(X[i:i + batch])
+            with mx.autograd.record():
+                loss = L(net(xb), xb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            tot += float(loss.mean().asnumpy())
+        print("  epoch %d  recon half-mse %.5f" % (ep, tot / (len(X) / batch)))
+    return tot / (len(X) / batch)
+
+
+def main():
+    mx.random.seed(0)
+    data = mx.test_utils.get_mnist()["train_data"][:2048]
+    X = data.reshape(len(data), -1).astype(np.float32)
+
+    net = AutoEncoder([128, 32])
+    net.initialize(mx.init.Xavier())
+    print("training autoencoder 784->128->32->128->784")
+    final = train(net, X, epochs=5, lr=1e-3)
+
+    # reconstruction must beat predicting the dataset mean
+    mean_mse = 0.5 * float(((X - X.mean(0)) ** 2).mean(axis=1).mean())
+    print("final %.5f vs mean-baseline %.5f" % (final, mean_mse))
+    assert final < 0.5 * mean_mse, (final, mean_mse)
+
+    # the 32-d code is linearly separable by class better than chance
+    codes = net.encoder(nd.array(X)).asnumpy()
+    labels = mx.test_utils.get_mnist()["train_label"][:2048]
+    from numpy.linalg import lstsq
+    onehot = np.eye(10)[labels.astype(int)]
+    W = lstsq(codes, onehot, rcond=None)[0]
+    acc = ((codes @ W).argmax(1) == labels).mean()
+    print("linear probe on 32-d codes: %.3f" % acc)
+    assert acc > 0.5, acc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,8 +13,8 @@ mathematically identical ``lax.scan`` formulation — residuals stay tiny
 (the inputs), matching the rematerialization discipline used elsewhere.
 
 Non-TPU backends run the same kernel through the Pallas interpreter, so
-tests cover it everywhere; ``ops.rnn`` routes LSTM through this path on
-TPU (override with ``mxtpu.ops.rnn.USE_PALLAS_LSTM``).
+tests cover it everywhere; ``ops.rnn`` routes LSTM and GRU through
+these kernels on TPU (override with ``mxtpu.ops.rnn.USE_PALLAS_RNN``).
 """
 from __future__ import annotations
 

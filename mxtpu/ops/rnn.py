@@ -22,16 +22,19 @@ from .registry import register, next_rng_key
 
 _GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
 
-# LSTM time loop backend: None = auto (Pallas kernel on TPU, lax.scan
-# elsewhere); True/False force. Read at TRACE time — set it before the
-# first forward of a model; already-jit-cached traces keep whichever
-# backend they were traced with. See ops/pallas_rnn.py.
+# LSTM/GRU time-loop backend: None = auto (Pallas kernels on TPU,
+# lax.scan elsewhere); True/False force. Read at TRACE time — set it
+# before the first forward of a model; already-jit-cached traces keep
+# whichever backend they were traced with. See ops/pallas_rnn.py.
+# (USE_PALLAS_LSTM is the historical name; both names are honored.)
+USE_PALLAS_RNN = None
 USE_PALLAS_LSTM = None
 
 
 def _pallas_lstm_enabled():
-    if USE_PALLAS_LSTM is not None:
-        return USE_PALLAS_LSTM
+    for flag in (USE_PALLAS_RNN, USE_PALLAS_LSTM):
+        if flag is not None:
+            return flag
     return jax.default_backend() == "tpu"
 
 

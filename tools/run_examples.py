@@ -19,6 +19,7 @@ EXAMPLES = [
     ("model-parallel/tp_mlp.py", {"DEVICES": 8}),
     ("recommenders/matrix_fact.py", {}),
     ("sparse/linear_classification.py", {}),
+    ("autoencoder/mnist_sae.py", {}),
 ]
 
 
