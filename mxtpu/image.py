@@ -276,7 +276,8 @@ class RandomGrayAug(Augmenter):
     def __init__(self, p=0.5):
         super().__init__(p=p)
         self.p = p
-        self._coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+        # reference RandomGrayAug uses BT.709-like luma weights, not BT.601
+        self._coef = _np.array([[[0.21, 0.72, 0.07]]], _np.float32)
 
     def __call__(self, src):
         if _random.random() < self.p:
@@ -359,14 +360,15 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
     if hue:
         auglist.append(HueJitterAug(hue))
-    if rand_gray > 0:
-        auglist.append(RandomGrayAug(rand_gray))
     if pca_noise > 0:
         eigval = _np.array([55.46, 4.794, 1.148])
         eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
                             [-0.5808, -0.0045, -0.8140],
                             [-0.5836, -0.6948, 0.4203]])
         auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        # reference order: ColorJitter, Hue, Lighting, then RandomGray
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = _np.array([123.68, 116.28, 103.53])
     if std is True:

@@ -518,7 +518,9 @@ class LibSVMIter(DataIter):
             raise StopIteration
         idxs = []
         while len(idxs) < self.batch_size:
-            idxs.append(min(self._cursor, n - 1))
+            # round_batch overflow wraps to the start of the dataset
+            # (reference src/io/iter_libsvm.cc round-batch semantics)
+            idxs.append(self._cursor % n)
             self._cursor += 1
         pad = max(0, self._cursor - n)
         dense = _np.zeros((self.batch_size, self._width), _np.float32)

@@ -134,9 +134,12 @@ def test_libsvm_iter_csr(tmp_path):
         [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
     np.testing.assert_allclose(b1.label[0].asnumpy(), [1.0, 0.0])
     b2 = it.next()
-    assert b2.pad == 1          # round_batch pads with the last row
+    assert b2.pad == 1          # round_batch overflow wraps to the start
     np.testing.assert_allclose(b2.data[0].asnumpy()[0],
                                [0, 0, 3.0, 1.0])
+    # padded row is dataset row 0 again (reference iter_libsvm.cc wrap)
+    np.testing.assert_allclose(b2.data[0].asnumpy()[1],
+                               [1.5, 0, 0, 2.0])
     import pytest
     with pytest.raises(StopIteration):
         it.next()
