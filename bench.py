@@ -7,11 +7,21 @@ data, full training step (forward + backward + SGD-momentum update), steady-
 state timing after warmup. Runs on whatever accelerator JAX exposes (the
 driver provides one real TPU chip).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Relay robustness: the TPU is reached through an experimental relay that can
+wedge indefinitely — any process touching the backend blocks in init. Before
+committing this process to the TPU backend we probe it in a *subprocess* with
+a hard timeout (a wedged init cannot be interrupted in-process), retrying a
+few times. On failure we fall back to CPU and still print a parseable JSON
+line with "tpu_unavailable": true instead of dying with a nonzero rc.
+
+Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", "tpu_unavailable", "mfu", ...}
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -19,15 +29,71 @@ import numpy as np
 
 BASELINE_IMG_S = 181.53  # ResNet-50 train, batch 32, 1x P100 (perf.md:185)
 
+# ResNet-50 at 224x224: ~4.089 GFLOPs forward per image (2*MACs). A training
+# step is fwd + bwd ~= 3x forward (bwd is ~2x fwd).
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.089e9
 
-def main():
+# Peak dense bf16 TFLOP/s per chip, keyed by substring of device_kind.
+_TPU_PEAK_TFLOPS = [
+    ("v6", 918.0),      # Trillium
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+PROBE_TIMEOUT_S = 75
+PROBE_RETRIES = 3
+PROBE_RETRY_WAIT_S = 20
+
+
+def probe_tpu():
+    """Check TPU backend liveness in a killable subprocess.
+
+    Returns the device_kind string if a TPU came up within the timeout,
+    else None. Retries a few times with a pause — transient relay hiccups
+    sometimes clear in seconds; multi-hour wedges won't, and we must not
+    hang the driver's bench run on them.
+    """
+    code = (
+        "import jax; d = jax.devices()[0]; "
+        "print(d.platform + '|' + getattr(d, 'device_kind', ''))"
+    )
+    for attempt in range(PROBE_RETRIES):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                platform, _, kind = out.stdout.strip().partition("|")
+                if platform == "tpu":
+                    return kind or "tpu"
+                return None  # backend up but not TPU: fall back cleanly
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt < PROBE_RETRIES - 1:
+            time.sleep(PROBE_RETRY_WAIT_S)
+    return None
+
+
+def peak_tflops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, tf in _TPU_PEAK_TFLOPS:
+        if key in kind:
+            return tf
+    return None
+
+
+def run_bench(on_tpu: bool):
     import jax
     import mxtpu as mx
     from mxtpu import gluon
     from mxtpu.gluon.model_zoo import vision
     from mxtpu.parallel import MeshContext, ShardedTrainer
 
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
     batch = 32
     hw = 224
     if not on_tpu:
@@ -65,14 +131,124 @@ def main():
         last = st.step_async(xd, yd)
     last.wait_to_read()
     dt = time.perf_counter() - t0
-    img_s = batch * n_iters / dt
+    return batch * n_iters / dt
 
-    print(json.dumps({
+
+def tpu_run_main():
+    """Entry for the --tpu-run re-exec: do the real TPU measurement and
+    print the JSON line. Runs in a child process so the parent can bound
+    it with a timeout — the relay can wedge *after* a successful probe."""
+    result = {
         "metric": "resnet50_train_img_per_sec",
-        "value": round(img_s, 2),
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "tpu_unavailable": False,
+    }
+    kind = sys.argv[sys.argv.index("--tpu-run") + 1]
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+        if platform != "tpu":
+            # the relay can drop between probe and run; never report a CPU
+            # number as a TPU measurement
+            raise RuntimeError(
+                "TPU backend gone after probe (got %r)" % platform)
+        img_s = run_bench(on_tpu=True)
+        result["value"] = round(img_s, 2)
+        result["vs_baseline"] = round(img_s / BASELINE_IMG_S, 3)
+        result["device_kind"] = kind
+        peak = peak_tflops(kind)
+        if peak is not None:
+            mfu = img_s * RESNET50_TRAIN_FLOPS_PER_IMG / (peak * 1e12)
+            result["mfu"] = round(mfu, 4)
+    except Exception as e:
+        result["value"] = 0.0
+        result["vs_baseline"] = 0.0
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result))
+    return 0
+
+
+def cpu_fallback_main():
+    """Entry for the --cpu-fallback re-exec (fresh interpreter started with
+    JAX_PLATFORMS=cpu so the sitecustomize never arms the axon backend)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {
+        "metric": "resnet50_train_img_per_sec",
+        "unit": "images/sec",
+        "tpu_unavailable": True,
+    }
+    try:
+        img_s = run_bench(on_tpu=False)
+        result["value"] = round(img_s, 2)
+        result["vs_baseline"] = 0.0
+    except Exception as e:  # still emit parseable JSON
+        result["value"] = 0.0
+        result["vs_baseline"] = 0.0
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result))
+    return 0
+
+
+def _reexec(flag_args, env=None, timeout=None):
+    """Run this script in a child with extra args; return (json_line, None)
+    on success or (None, diagnostic) on timeout/crash/bad output."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + flag_args,
+            env=env or dict(os.environ), capture_output=True, text=True,
+            timeout=timeout,
+        )
+        line = (out.stdout.strip().splitlines()[-1]
+                if out.stdout.strip() else "")
+        json.loads(line)
+        return line, None
+    except Exception as e:
+        stderr = ""
+        if "out" in locals() and getattr(out, "stderr", None):
+            stderr = out.stderr[-400:]
+        elif getattr(e, "stderr", None):  # TimeoutExpired carries streams
+            err = e.stderr
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            stderr = (err or "")[-400:]
+        return None, "%s: %r stderr=%r" % (flag_args[0],
+                                           type(e).__name__, stderr)
+
+
+def main():
+    if "--cpu-fallback" in sys.argv:
+        return cpu_fallback_main()
+    if "--tpu-run" in sys.argv:
+        return tpu_run_main()
+
+    kind = probe_tpu()
+    errors = []
+    if kind is not None:
+        # Real measurement in a bounded child — the relay can wedge even
+        # after a clean probe, and an in-process wedge is unkillable.
+        line, err = _reexec(["--tpu-run", kind], timeout=2400)
+        if line is not None:
+            print(line)
+            return 0
+        errors.append(err)
+    # Relay down (or the TPU child wedged/died): re-exec on CPU so the
+    # pipeline is still exercised (fresh interpreter with JAX_PLATFORMS=cpu
+    # at start — in-process config.update after sitecustomize has armed the
+    # axon backend is not reliable), marked as not-a-TPU-measurement.
+    line, err = _reexec(["--cpu-fallback"],
+                        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                        timeout=1200)
+    if line is not None:
+        print(line)
+        return 0
+    errors.append(err)
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec", "unit": "images/sec",
+        "value": 0.0, "vs_baseline": 0.0, "tpu_unavailable": kind is None,
+        "error": "; ".join(errors),
     }))
+    return 0
 
 
 if __name__ == "__main__":
