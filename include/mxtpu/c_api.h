@@ -1,0 +1,244 @@
+/*!
+ * \file c_api.h
+ * \brief Core C ABI of the mxtpu framework.
+ *
+ * Reference counterpart: include/mxnet/c_api.h (2,216 lines, 174 MX*
+ * functions). This header carries the ~60 most-consumed functions — the
+ * surface every language binding (R/Scala/Perl/cpp-package) actually
+ * calls: NDArray create/copy/sync, the imperative op invoke, autograd,
+ * Symbol compose/infer, Executor bind/forward/backward, KVStore, and
+ * DataIter handles. Signatures match the reference's where the semantics
+ * carry over; deviations are documented inline.
+ *
+ * Implementation: mxtpu/_native/c_api.cc embeds CPython and drives the
+ * mxtpu package (the TPU-native executor underneath is jit-compiled by
+ * XLA); handles own Python objects. Thread-safe via the GIL.
+ *
+ * All functions return 0 on success, -1 on failure (message via
+ * MXGetLastError, thread-local).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+
+typedef void *NDArrayHandle;
+typedef const void *OpHandle;         /* a.k.a. AtomicSymbolCreator */
+typedef const void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef void *DataIterHandle;
+typedef void *DataIterCreator;
+
+/*! \brief user-supplied KVStore updater: merged = fn(key, recv, local) */
+typedef void (MXKVUpdater)(int key, NDArrayHandle recv, NDArrayHandle local,
+                           void *handle);
+
+/* ------------------------------------------------------------------ misc */
+
+/*! \brief last error message of the calling thread */
+const char *MXGetLastError(void);
+/*! \brief library version as a single integer (major*10000+minor*100+patch) */
+int MXGetVersion(int *out);
+/*! \brief seed all global random number generators */
+int MXRandomSeed(int seed);
+/*! \brief notify the engine about a shutdown (flush pending async work) */
+int MXNotifyShutdown(void);
+
+/* --------------------------------------------------------------- NDArray */
+
+/*! \brief create an empty (deferred) NDArray handle */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+/*! \brief create an uninitialized float32 NDArray of the given shape */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+/*! \brief create with explicit dtype (mshadow type codes: 0=f32 1=f64
+ *  2=f16 3=u8 4=i32 5=i8 6=i64) */
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+/*! \brief blocking host->device copy (size = element count) */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+/*! \brief blocking device->host copy (size = element count) */
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+/*! \brief wait until the array's pending writes complete */
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+/*! \brief wait until all async engine work completes */
+int MXNDArrayWaitAll(void);
+int MXNDArrayFree(NDArrayHandle handle);
+/*! \brief shape query; pointer valid until the next call on this handle */
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+/*! \brief new handle viewing the same data with a new shape (-1 infers) */
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out);
+/*! \brief slice along axis 0: [slice_begin, slice_end) */
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out);
+/*! \brief index along axis 0 */
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+/*! \brief save arrays to an .nd file (keys may be NULL for unnamed) */
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+/*! \brief load arrays; out pointers owned by the library (stable until the
+ *  next MXNDArrayLoad on this thread) */
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+/*! \brief gradient buffer attached by MXAutogradMarkVariables */
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+/* ----------------------------------------------------- operator registry */
+
+/*! \brief names of every registered operator; storage owned by library */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+/*! \brief resolve an op name to its creator handle */
+int MXGetOpHandle(const char *name, OpHandle *out);
+/*! \brief creator handles of every registered op (Symbol + imperative) */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **out_name);
+
+/*!
+ * \brief invoke an operator imperatively.
+ *
+ * If *num_outputs is 0 on entry the library allocates output handles and
+ * returns them via *outputs (library-owned array, stable until the next
+ * invoke on this thread); otherwise the caller-provided output arrays are
+ * written in place (MXNet's `out=` convention).
+ */
+int MXImperativeInvoke(OpHandle op, int num_inputs, NDArrayHandle *inputs,
+                       int *num_outputs, NDArrayHandle **outputs,
+                       int num_params, const char **param_keys,
+                       const char **param_vals);
+
+/* -------------------------------------------------------------- autograd */
+
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+/*! \brief attach gradient buffers; grad_reqs use 1=write 2=add 0=null */
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *grad_reqs,
+                            NDArrayHandle *grad_handles);
+/*! \brief run backward from the given heads (ograds may be NULL) */
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph);
+
+/* ---------------------------------------------------------------- Symbol */
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/*! \brief create an op node with static params only (inputs via Compose) */
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+/*! \brief connect inputs: positional when keys==NULL, else by arg name */
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out);
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolFree(SymbolHandle symbol);
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array);
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array);
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+/*!
+ * \brief infer shapes from the named argument shapes (CSR layout: shapes of
+ * arg i live in arg_shape_data[arg_ind_ptr[i] .. arg_ind_ptr[i+1]）).
+ * Output arrays are library-owned, stable until the next InferShape on
+ * this thread.
+ */
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+
+/* -------------------------------------------------------------- Executor */
+
+/*!
+ * \brief bind a symbol to argument arrays for execution (the reference's
+ * MXExecutorBind). grad_req_type: 0=null 1=write 2=add. arg_grad_store
+ * entries may be NULL where grads are not needed.
+ */
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+/*! \brief head gradients may be len==0 for loss-terminal graphs */
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads);
+/*! \brief output handles; library-owned array, stable until next call */
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXExecutorFree(ExecutorHandle handle);
+
+/* --------------------------------------------------------------- KVStore */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+/*! \brief install a C updater called as fn(key, recv_grad, local_weight) */
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVUpdater updater,
+                        void *updater_handle);
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+
+/* -------------------------------------------------------------- DataIter */
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+/*! \brief advance; *out = 1 while data remains */
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

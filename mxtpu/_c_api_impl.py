@@ -1,0 +1,426 @@
+"""Python side of the core C ABI (include/mxtpu/c_api.h).
+
+``mxtpu/_native/c_api.cc`` embeds CPython and calls these functions; every
+C handle owns one of the Python objects returned here. This mirrors the
+reference's split where ``src/c_api/c_api.cc`` marshals into the C++
+runtime — here the runtime is the mxtpu package itself (NDArray over jax
+arrays, the _Node symbol graph, the jit-compiled Executor).
+
+Everything here traffics in plain Python objects + lists so the C side
+needs only generic marshaling.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_DTYPE_CODES = ["float32", "float64", "float16", "uint8", "int32", "int8",
+                "int64"]
+
+
+def _mx():
+    import mxtpu
+    return mxtpu
+
+
+def _nd():
+    import mxtpu.ndarray as nd
+    return nd
+
+
+def _sym():
+    import mxtpu.symbol as sym
+    return sym
+
+
+def _ctx(dev_type, dev_id):
+    mx = _mx()
+    # MXNet dev_type codes: 1=cpu, 2=gpu (-> accelerator), 3=cpu_pinned
+    if dev_type == 2:
+        return mx.context.Context("tpu", dev_id)
+    return mx.cpu(dev_id)
+
+
+def version():
+    return 20000  # 2.0.0 — the TPU-native re-design
+
+
+def random_seed(seed):
+    _mx().random.seed(int(seed))
+
+
+def dtype_code(dtype_str):
+    return _DTYPE_CODES.index(str(dtype_str))
+
+
+# ------------------------------------------------------------------ NDArray
+
+def ndarray_create(shape, dev_type, dev_id, dtype):
+    nd = _nd()
+    return nd.zeros(tuple(int(s) for s in shape),
+                    ctx=_ctx(dev_type, dev_id),
+                    dtype=_DTYPE_CODES[dtype])
+
+
+def ndarray_create_none():
+    nd = _nd()
+    return nd.zeros((0,))
+
+
+def ndarray_sync_copy_from(arr, buf, size):
+    """buf: a C memoryview of size*itemsize bytes, dtype of arr."""
+    np_arr = np.frombuffer(buf, dtype=arr.dtype, count=int(size))
+    arr[:] = np_arr.reshape(arr.shape)
+    arr.wait_to_read()
+
+
+def ndarray_sync_copy_to(arr, size):
+    """Return the raw bytes of the array (C side memcpy's them out)."""
+    host = arr.asnumpy()
+    if host.size != int(size):
+        raise ValueError("buffer holds %d elements, array has %d"
+                         % (int(size), host.size))
+    return np.ascontiguousarray(host).tobytes()
+
+
+def ndarray_shape(arr):
+    return [int(s) for s in arr.shape]
+
+
+def ndarray_dtype(arr):
+    return _DTYPE_CODES.index(str(np.dtype(arr.dtype)))
+
+
+def ndarray_context(arr):
+    ctx = arr.context
+    return [1 if ctx.device_type == "cpu" else 2, int(ctx.device_id)]
+
+
+def ndarray_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def ndarray_slice(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def ndarray_at(arr, idx):
+    return arr[int(idx)]
+
+
+def ndarray_save(fname, args, keys):
+    nd = _nd()
+    if keys:
+        nd.save(fname, dict(zip(keys, args)))
+    else:
+        nd.save(fname, list(args))
+
+
+def ndarray_load(fname):
+    nd = _nd()
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrs = [data[k] for k in names]
+    else:
+        names = []
+        arrs = list(data)
+    return [arrs, names]
+
+
+def ndarray_grad(arr):
+    g = arr.grad
+    if g is None:
+        raise ValueError("no gradient attached; call "
+                         "MXAutogradMarkVariables first")
+    return g
+
+
+def ndarray_wait_to_read(arr):
+    arr.wait_to_read()
+
+
+def wait_all():
+    from mxtpu import engine
+    engine.waitall()
+
+
+# --------------------------------------------------------------- operators
+
+def list_op_names():
+    from mxtpu.ops import registry
+    return registry.list_ops()
+
+
+def imperative_invoke(op_name, inputs, param_keys, param_vals, outputs):
+    """Invoke op by name; params arrive as strings and are parsed the way
+    the reference parses dmlc::Parameter strings."""
+    nd = _nd()
+    params = {k: _parse_param(v) for k, v in zip(param_keys, param_vals)}
+    fn = getattr(nd, op_name)
+    res = fn(*inputs, **params)
+    res = list(res) if isinstance(res, (list, tuple)) else [res]
+    if outputs:
+        if len(outputs) != len(res):
+            raise ValueError("op %s returned %d outputs, %d out= arrays "
+                             "given" % (op_name, len(res), len(outputs)))
+        for dst, src in zip(outputs, res):
+            dst._data = src._data
+        return outputs
+    return res
+
+
+def _parse_param(v):
+    """String -> python value, dmlc::Parameter style."""
+    s = v.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    if s.startswith("(") or s.startswith("["):
+        inner = s[1:-1].strip()
+        if not inner:
+            return ()
+        return tuple(_parse_param(x) for x in inner.split(","))
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+# ---------------------------------------------------------------- autograd
+
+def autograd_set_recording(flag):
+    import mxtpu.autograd as ag
+    return 1 if ag.set_recording(bool(flag)) else 0
+
+
+def autograd_set_training(flag):
+    import mxtpu.autograd as ag
+    return 1 if ag.set_training(bool(flag)) else 0
+
+
+def autograd_mark_variables(variables, grad_reqs, grads):
+    req_names = {0: "null", 1: "write", 2: "add"}
+    for var, req, grad in zip(variables, grad_reqs, grads):
+        var.attach_grad(grad_req=req_names[int(req)])
+        if grad is not None:
+            var._grad = grad
+
+
+def autograd_backward(outputs, ograds, retain_graph):
+    import mxtpu.autograd as ag
+    ograds = None if not ograds else list(ograds)
+    ag.backward(list(outputs), head_grads=ograds,
+                retain_graph=bool(retain_graph))
+
+
+# ------------------------------------------------------------------ Symbol
+
+def symbol_create_variable(name):
+    return _sym().Variable(name)
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    """Return a partial op application: composed later via symbol_compose.
+
+    The reference's AtomicSymbol is exactly this — an op node with static
+    attrs and unconnected inputs (nnvm::Symbol::CreateFunctor).
+    """
+    params = {k: _parse_param(v) for k, v in zip(keys, vals)}
+    name = params.pop("name", None)
+    return _PendingOp(op_name, params, name)
+
+
+class _PendingOp:
+    """Op node awaiting input composition (MXSymbolCompose)."""
+
+    def __init__(self, op_name, params, name=None):
+        self.op_name = op_name
+        self.params = params
+        self.name = name
+
+    def compose(self, name, args, kwargs):
+        sym = _sym()
+        fn = getattr(sym, self.op_name)
+        params = dict(self.params)
+        if name:
+            params["name"] = name
+        elif self.name:
+            params["name"] = self.name
+        if kwargs:
+            return fn(**kwargs, **params)
+        return fn(*args, **params)
+
+
+def symbol_compose(sym_or_pending, name, keys, args):
+    if isinstance(sym_or_pending, _PendingOp):
+        if keys:
+            return sym_or_pending.compose(name, [], dict(zip(keys, args)))
+        return sym_or_pending.compose(name, list(args), {})
+    raise TypeError("MXSymbolCompose target is already composed; create it "
+                    "with MXSymbolCreateAtomicSymbol")
+
+
+def symbol_group(symbols):
+    return _sym().Group(list(symbols))
+
+
+def symbol_internals(s):
+    return s.get_internals()
+
+
+def symbol_get_output(s, index):
+    return s[int(index)]
+
+
+def symbol_copy(s):
+    import copy
+    return copy.deepcopy(s)
+
+
+def symbol_list_arguments(s):
+    return list(s.list_arguments())
+
+
+def symbol_list_outputs(s):
+    return list(s.list_outputs())
+
+
+def symbol_list_aux(s):
+    return list(s.list_auxiliary_states())
+
+
+def symbol_tojson(s):
+    return s.tojson()
+
+
+def symbol_from_json(js):
+    return _sym().load_json(js)
+
+
+def symbol_save_file(s, fname):
+    s.save(fname)
+
+
+def symbol_load_file(fname):
+    return _sym().load(fname)
+
+
+def symbol_infer_shape(s, keys, shapes):
+    kwargs = {k: tuple(int(x) for x in shp) for k, shp in zip(keys, shapes)}
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(**kwargs)
+    complete = (arg_shapes is not None)
+    if not complete:
+        return [[], [], [], 0]
+    pack = lambda lst: [[int(x) for x in shp] for shp in lst]
+    return [pack(arg_shapes), pack(out_shapes), pack(aux_shapes), 1]
+
+
+# ---------------------------------------------------------------- Executor
+
+def executor_bind(sym, dev_type, dev_id, in_args, arg_grads, grad_reqs,
+                  aux_states):
+    req_names = {0: "null", 1: "write", 2: "add"}
+    arg_names = sym.list_arguments()
+    req = {n: req_names[int(r)] for n, r in zip(arg_names, grad_reqs)}
+    ex = sym.bind(ctx=_ctx(dev_type, dev_id),
+                  args=list(in_args),
+                  args_grad={n: g for n, g in zip(arg_names, arg_grads)
+                             if g is not None},
+                  grad_req=req,
+                  aux_states=list(aux_states) if aux_states else None)
+    return ex
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, head_grads):
+    ex.backward(list(head_grads) if head_grads else None)
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+# ----------------------------------------------------------------- KVStore
+
+def kvstore_create(type_str):
+    return _mx().kvstore.create(type_str)
+
+
+def kvstore_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=int(priority))
+
+
+def kvstore_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+
+
+def kvstore_set_updater(kv, trampoline):
+    """Install a C updater. ``trampoline`` is a PyCFunction built by the C
+    layer (c_api.cc) that wraps (recv, local) NDArrays into C handles and
+    calls the user's MXKVUpdater function pointer."""
+    def updater(key, recv, local):
+        trampoline(int(key), recv, local)
+
+    kv._set_updater(updater)
+
+
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_group_size(kv):
+    return int(kv.num_workers)
+
+
+# ---------------------------------------------------------------- DataIter
+
+_ITER_NAMES = ["MNISTIter", "ImageRecordIter", "CSVIter", "LibSVMIter",
+               "NDArrayIter"]
+
+
+def list_data_iters():
+    return list(_ITER_NAMES)
+
+
+def data_iter_create(name, keys, vals):
+    mx = _mx()
+    params = {k: _parse_param(v) for k, v in zip(keys, vals)}
+    return getattr(mx.io, name)(**params)
+
+
+def data_iter_next(it):
+    try:
+        batch = it.next()
+    except StopIteration:
+        return None
+    return batch
+
+
+def data_iter_before_first(it):
+    it.reset()
+
+
+def data_iter_data(batch):
+    return batch.data[0]
+
+
+def data_iter_label(batch):
+    return batch.label[0]
+
+
+def data_iter_pad(batch):
+    return int(batch.pad or 0)

@@ -1,0 +1,1234 @@
+// Core C ABI implementation: embeds CPython and drives the mxtpu package.
+//
+// Reference counterpart: src/c_api/c_api.cc + c_api_symbolic.cc +
+// c_api_executor.cc (~4,000 LoC over the C++ runtime). Here the runtime is
+// the mxtpu Python package (XLA-jitted executor underneath); this file is
+// pure marshaling: every handle owns a Python object, list/str returns are
+// cached in the handle (or thread-local storage) so pointers stay valid per
+// the header's documented lifetimes.
+//
+// Python-side counterpart: mxtpu/_c_api_impl.py.
+// Build: make -C mxtpu/_native libmxtpu_c.so
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../../include/mxtpu/c_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      g_last_error = msg ? msg : "(unprintable python error)";
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+bool ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
+  return true;
+}
+
+PyObject *impl_module() {
+  static PyObject *mod = nullptr;
+  if (!mod) {
+    mod = PyImport_ImportModule("mxtpu._c_api_impl");
+  }
+  return mod;
+}
+
+// call a function on the impl module; returns new ref or nullptr (+err set)
+PyObject *icall(const char *fn, const char *fmt, ...) {
+  PyObject *mod = impl_module();
+  if (!mod) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject *callable = PyObject_GetAttrString(mod, fn);
+  if (!callable) {
+    set_error_from_python();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject *args = fmt ? Py_VaBuildValue(fmt, va) : PyTuple_New(0);
+  va_end(va);
+  if (!args) {
+    Py_DECREF(callable);
+    set_error_from_python();
+    return nullptr;
+  }
+  if (!PyTuple_Check(args)) {  // single-arg format strings
+    PyObject *t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+  }
+  PyObject *res = PyObject_CallObject(callable, args);
+  Py_DECREF(callable);
+  Py_DECREF(args);
+  if (!res) set_error_from_python();
+  return res;
+}
+
+// ----------------------------------------------------------------- handles
+
+struct NDArrayH {
+  PyObject *obj = nullptr;
+  std::vector<mx_uint> shape_buf;
+};
+
+struct SymbolH {
+  PyObject *obj = nullptr;
+  std::vector<std::string> str_store;
+  std::vector<const char *> str_ptrs;
+  std::string json;
+};
+
+struct ExecutorH {
+  PyObject *obj = nullptr;
+  std::vector<NDArrayHandle> out_handles;  // freed on next call / Free
+};
+
+struct KVStoreH {
+  PyObject *obj = nullptr;
+};
+
+struct DataIterH {
+  PyObject *obj = nullptr;          // the iterator
+  PyObject *batch = nullptr;        // current batch
+  NDArrayHandle data = nullptr;     // owned; replaced per GetData call
+  NDArrayHandle label = nullptr;
+};
+
+NDArrayH *wrap_nd(PyObject *obj) {  // steals the reference
+  auto *h = new NDArrayH();
+  h->obj = obj;
+  return h;
+}
+
+void free_nd(NDArrayHandle handle) {
+  auto *h = static_cast<NDArrayH *>(handle);
+  if (h) {
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+}
+
+PyObject *nd_list(int n, NDArrayHandle *arr) {  // new ref; None for nullptr
+  PyObject *lst = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject *o = arr && arr[i]
+        ? static_cast<NDArrayH *>(arr[i])->obj : Py_None;
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  return lst;
+}
+
+PyObject *str_list(mx_uint n, const char **strs) {
+  PyObject *lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SetItem(lst, i, PyUnicode_FromString(strs ? strs[i] : ""));
+  }
+  return lst;
+}
+
+PyObject *uint_list(mx_uint n, const mx_uint *vals) {
+  PyObject *lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SetItem(lst, i, PyLong_FromUnsignedLong(vals[i]));
+  }
+  return lst;
+}
+
+// store python list-of-str into (store, ptrs); returns 0/-1
+int cache_str_list(PyObject *lst, std::vector<std::string> *store,
+                   std::vector<const char *> *ptrs) {
+  if (!PyList_Check(lst)) {
+    g_last_error = "expected list of strings from impl";
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(lst);
+  store->clear();
+  ptrs->clear();
+  store->reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    store->push_back(s ? s : "");
+  }
+  for (auto &s : *store) ptrs->push_back(s.c_str());
+  return 0;
+}
+
+// thread-local caches for library-owned returns
+thread_local std::vector<std::string> tl_str_store;
+thread_local std::vector<const char *> tl_str_ptrs;
+thread_local std::vector<NDArrayHandle> tl_invoke_out;
+thread_local std::vector<NDArrayHandle> tl_load_arrs;
+thread_local std::vector<std::string> tl_load_names_store;
+thread_local std::vector<const char *> tl_load_names;
+
+// op-name interning: creator handles are pointers into this vector
+std::vector<std::string> *op_names() {
+  static std::vector<std::string> *names = nullptr;
+  static std::once_flag once;
+  std::call_once(once, []() {
+    names = new std::vector<std::string>();
+    PyObject *res = icall("list_op_names", nullptr);
+    if (res && PyList_Check(res)) {
+      Py_ssize_t n = PyList_Size(res);
+      names->reserve(n);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        const char *s = PyUnicode_AsUTF8(PyList_GetItem(res, i));
+        names->push_back(s ? s : "");
+      }
+    }
+    Py_XDECREF(res);
+  });
+  return names;
+}
+
+}  // namespace
+
+extern "C" {
+
+#ifndef MXTPU_PREDICT_COMBINED
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+#endif
+
+int MXGetVersion(int *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("version", nullptr);
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("random_seed", "(i)", seed);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNotifyShutdown(void) { return MXNDArrayWaitAll(); }
+
+// ------------------------------------------------------------------ NDArray
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("ndarray_create_none", nullptr);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  (void)delay_alloc;  // XLA buffers materialize lazily anyway
+  ensure_python();
+  GIL gil;
+  PyObject *shp = uint_list(ndim, shape);
+  PyObject *res = icall("ndarray_create", "(Oiii)", shp, dev_type, dev_id,
+                        dtype);
+  Py_DECREF(shp);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  // element size from dtype code
+  int dtype = 0;
+  if (MXNDArrayGetDType(handle, &dtype) != 0) return -1;
+  static const size_t kSize[] = {4, 8, 2, 1, 4, 1, 8};
+  size_t nbytes = size * kSize[dtype];
+  PyObject *mem = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<void *>(data)),
+      static_cast<Py_ssize_t>(nbytes), PyBUF_READ);
+  PyObject *res = icall("ndarray_sync_copy_from", "(OOn)", h->obj, mem,
+                        static_cast<Py_ssize_t>(size));
+  Py_DECREF(mem);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_sync_copy_to", "(On)", h->obj,
+                        static_cast<Py_ssize_t>(size));
+  if (!res) return -1;
+  char *buf = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &nbytes) != 0) {
+    set_error_from_python();
+    Py_DECREF(res);
+    return -1;
+  }
+  std::memcpy(data, buf, nbytes);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_wait_to_read", "(O)", h->obj);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitAll(void) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("wait_all", nullptr);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  GIL gil;
+  free_nd(handle);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_shape", "(O)", h->obj);
+  if (!res) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  h->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyList_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = h->shape_buf.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_dtype", "(O)", h->obj);
+  if (!res) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_context", "(O)", h->obj);
+  if (!res) return -1;
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *lst = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SetItem(lst, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject *res = icall("ndarray_reshape", "(OO)", h->obj, lst);
+  Py_DECREF(lst);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_slice", "(OII)", h->obj, slice_begin,
+                        slice_end);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_at", "(OI)", h->obj, idx);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  GIL gil;
+  PyObject *arrs = nd_list(num_args, args);
+  PyObject *names = keys ? str_list(num_args, keys) : (Py_INCREF(Py_None),
+                                                       Py_None);
+  PyObject *res = icall("ndarray_save", "(sOO)", fname, arrs, names);
+  Py_DECREF(arrs);
+  Py_DECREF(names);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("ndarray_load", "(s)", fname);
+  if (!res) return -1;
+  PyObject *arrs = PyList_GetItem(res, 0);
+  PyObject *names = PyList_GetItem(res, 1);
+  tl_load_arrs.clear();
+  Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(arrs, i);
+    Py_INCREF(o);
+    tl_load_arrs.push_back(wrap_nd(o));
+  }
+  PyObject *nl = names;
+  Py_INCREF(nl);
+  int rc = cache_str_list(nl, &tl_load_names_store, &tl_load_names);
+  Py_DECREF(nl);
+  Py_DECREF(res);
+  if (rc != 0) return -1;
+  *out_size = static_cast<mx_uint>(tl_load_arrs.size());
+  *out_arr = tl_load_arrs.data();
+  *out_name_size = static_cast<mx_uint>(tl_load_names.size());
+  *out_names = tl_load_names.data();
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_grad", "(O)", h->obj);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------- registry
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  ensure_python();
+  GIL gil;
+  auto *names = op_names();
+  tl_str_store = *names;
+  tl_str_ptrs.clear();
+  for (auto &s : tl_str_store) tl_str_ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(tl_str_ptrs.size());
+  *out_array = tl_str_ptrs.data();
+  return 0;
+}
+
+int MXGetOpHandle(const char *name, OpHandle *out) {
+  ensure_python();
+  GIL gil;
+  auto *names = op_names();
+  for (auto &s : *names) {
+    if (s == name) {
+      *out = static_cast<const void *>(&s);
+      return 0;
+    }
+  }
+  g_last_error = std::string("unknown operator: ") + name;
+  return -1;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  ensure_python();
+  GIL gil;
+  auto *names = op_names();
+  static thread_local std::vector<AtomicSymbolCreator> creators;
+  creators.clear();
+  for (auto &s : *names) {
+    creators.push_back(static_cast<const void *>(&s));
+  }
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **out_name) {
+  *out_name = static_cast<const std::string *>(creator)->c_str();
+  return 0;
+}
+
+int MXImperativeInvoke(OpHandle op, int num_inputs, NDArrayHandle *inputs,
+                       int *num_outputs, NDArrayHandle **outputs,
+                       int num_params, const char **param_keys,
+                       const char **param_vals) {
+  GIL gil;
+  const std::string *name = static_cast<const std::string *>(op);
+  PyObject *ins = nd_list(num_inputs, inputs);
+  PyObject *keys = str_list(num_params, param_keys);
+  PyObject *vals = str_list(num_params, param_vals);
+  PyObject *outs;
+  bool in_place = (*num_outputs > 0);
+  if (in_place) {
+    outs = nd_list(*num_outputs, *outputs);
+  } else {
+    outs = Py_None;
+    Py_INCREF(outs);
+  }
+  PyObject *res = icall("imperative_invoke", "(sOOOO)", name->c_str(), ins,
+                        keys, vals, outs);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  Py_DECREF(outs);
+  if (!res) return -1;
+  if (!in_place) {
+    Py_ssize_t n = PyList_Size(res);
+    tl_invoke_out.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *o = PyList_GetItem(res, i);
+      Py_INCREF(o);
+      tl_invoke_out.push_back(wrap_nd(o));
+    }
+    *num_outputs = static_cast<int>(n);
+    *outputs = tl_invoke_out.data();
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------- autograd
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("autograd_set_recording", "(i)", is_recording);
+  if (!res) return -1;
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("autograd_set_training", "(i)", is_training);
+  if (!res) return -1;
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *grad_reqs, NDArrayHandle *grad_handles) {
+  GIL gil;
+  PyObject *vars = nd_list(num_var, var_handles);
+  PyObject *grads = nd_list(num_var, grad_handles);
+  PyObject *reqs = uint_list(num_var, grad_reqs);
+  PyObject *res = icall("autograd_mark_variables", "(OOO)", vars, reqs,
+                        grads);
+  Py_DECREF(vars);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  GIL gil;
+  PyObject *outs = nd_list(num_output, output_handles);
+  PyObject *ograds = ograd_handles
+      ? nd_list(num_output, ograd_handles)
+      : (Py_INCREF(Py_None), Py_None);
+  PyObject *res = icall("autograd_backward", "(OOi)", outs, ograds,
+                        retain_graph);
+  Py_DECREF(outs);
+  Py_DECREF(ograds);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+// ------------------------------------------------------------------ Symbol
+
+namespace {
+
+SymbolH *wrap_sym(PyObject *obj) {
+  auto *h = new SymbolH();
+  h->obj = obj;
+  return h;
+}
+
+int sym_str_list(SymbolHandle handle, const char *fn, mx_uint *out_size,
+                 const char ***out_str_array) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(handle);
+  PyObject *res = icall(fn, "(O)", h->obj);
+  if (!res) return -1;
+  int rc = cache_str_list(res, &h->str_store, &h->str_ptrs);
+  Py_DECREF(res);
+  if (rc != 0) return -1;
+  *out_size = static_cast<mx_uint>(h->str_ptrs.size());
+  *out_str_array = h->str_ptrs.data();
+  return 0;
+}
+
+}  // namespace
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("symbol_create_variable", "(s)", name);
+  if (!res) return -1;
+  *out = wrap_sym(res);
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  ensure_python();
+  GIL gil;
+  const std::string *name = static_cast<const std::string *>(creator);
+  PyObject *k = str_list(num_param, keys);
+  PyObject *v = str_list(num_param, vals);
+  PyObject *res = icall("symbol_create_atomic", "(sOO)", name->c_str(), k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!res) return -1;
+  *out = wrap_sym(res);
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(sym);
+  PyObject *arg_objs = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *o = static_cast<SymbolH *>(args[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(arg_objs, i, o);
+  }
+  PyObject *k = keys ? str_list(num_args, keys)
+                     : (Py_INCREF(Py_None), Py_None);
+  PyObject *res = icall("symbol_compose", "(OsOO)", h->obj,
+                        name ? name : "", k, arg_objs);
+  Py_DECREF(arg_objs);
+  Py_DECREF(k);
+  if (!res) return -1;
+  // the reference composes in place: the handle becomes the composed node
+  Py_DECREF(h->obj);
+  h->obj = res;
+  return 0;
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  GIL gil;
+  PyObject *lst = PyList_New(num_symbols);
+  for (mx_uint i = 0; i < num_symbols; ++i) {
+    PyObject *o = static_cast<SymbolH *>(symbols[i])->obj;
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  PyObject *res = icall("symbol_group", "(O)", lst);
+  Py_DECREF(lst);
+  if (!res) return -1;
+  *out = wrap_sym(res);
+  return 0;
+}
+
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_internals", "(O)", h->obj);
+  if (!res) return -1;
+  *out = wrap_sym(res);
+  return 0;
+}
+
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_get_output", "(OI)", h->obj, index);
+  if (!res) return -1;
+  *out = wrap_sym(res);
+  return 0;
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_copy", "(O)", h->obj);
+  if (!res) return -1;
+  *out = wrap_sym(res);
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle symbol) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  if (h) {
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array) {
+  return sym_str_list(symbol, "symbol_list_arguments", out_size,
+                      out_str_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array) {
+  return sym_str_list(symbol, "symbol_list_outputs", out_size,
+                      out_str_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array) {
+  return sym_str_list(symbol, "symbol_list_aux", out_size, out_str_array);
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_tojson", "(O)", h->obj);
+  if (!res) return -1;
+  const char *s = PyUnicode_AsUTF8(res);
+  h->json = s ? s : "";
+  Py_DECREF(res);
+  *out_json = h->json.c_str();
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("symbol_from_json", "(s)", json);
+  if (!res) return -1;
+  *out = wrap_sym(res);
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_save_file", "(Os)", h->obj, fname);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("symbol_load_file", "(s)", fname);
+  if (!res) return -1;
+  *out = wrap_sym(res);
+  return 0;
+}
+
+namespace {
+
+// storage for InferShape returns (thread-local)
+struct ShapeGroup {
+  std::vector<mx_uint> ndims;
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<const mx_uint *> ptrs;
+
+  void fill(PyObject *lst) {
+    Py_ssize_t n = PyList_Size(lst);
+    ndims.resize(n);
+    shapes.assign(n, {});
+    ptrs.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *shp = PyList_GetItem(lst, i);
+      Py_ssize_t d = PyList_Size(shp);
+      ndims[i] = static_cast<mx_uint>(d);
+      shapes[i].resize(d);
+      for (Py_ssize_t j = 0; j < d; ++j) {
+        shapes[i][j] = static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyList_GetItem(shp, j)));
+      }
+      ptrs[i] = shapes[i].data();
+    }
+  }
+};
+
+thread_local ShapeGroup tl_in_shapes, tl_out_shapes, tl_aux_shapes;
+
+}  // namespace
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(sym);
+  PyObject *k = str_list(num_args, keys);
+  PyObject *shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyList_SetItem(shapes, i, uint_list(hi - lo, arg_shape_data + lo));
+  }
+  PyObject *res = icall("symbol_infer_shape", "(OOO)", h->obj, k, shapes);
+  Py_DECREF(k);
+  Py_DECREF(shapes);
+  if (!res) return -1;
+  tl_in_shapes.fill(PyList_GetItem(res, 0));
+  tl_out_shapes.fill(PyList_GetItem(res, 1));
+  tl_aux_shapes.fill(PyList_GetItem(res, 2));
+  *complete = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, 3)));
+  Py_DECREF(res);
+  *in_shape_size = static_cast<mx_uint>(tl_in_shapes.ndims.size());
+  *in_shape_ndim = tl_in_shapes.ndims.data();
+  *in_shape_data = tl_in_shapes.ptrs.data();
+  *out_shape_size = static_cast<mx_uint>(tl_out_shapes.ndims.size());
+  *out_shape_ndim = tl_out_shapes.ndims.data();
+  *out_shape_data = tl_out_shapes.ptrs.data();
+  *aux_shape_size = static_cast<mx_uint>(tl_aux_shapes.ndims.size());
+  *aux_shape_ndim = tl_aux_shapes.ndims.data();
+  *aux_shape_data = tl_aux_shapes.ptrs.data();
+  return 0;
+}
+
+// ---------------------------------------------------------------- Executor
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  GIL gil;
+  auto *sh = static_cast<SymbolH *>(symbol_handle);
+  PyObject *args = nd_list(len, in_args);
+  PyObject *grads = nd_list(len, arg_grad_store);
+  PyObject *reqs = uint_list(len, grad_req_type);
+  PyObject *aux = nd_list(aux_states_len, aux_states);
+  PyObject *res = icall("executor_bind", "(OiiOOOO)", sh->obj, dev_type,
+                        dev_id, args, grads, reqs, aux);
+  Py_DECREF(args);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  Py_DECREF(aux);
+  if (!res) return -1;
+  auto *h = new ExecutorH();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  GIL gil;
+  auto *h = static_cast<ExecutorH *>(handle);
+  PyObject *res = icall("executor_forward", "(Oi)", h->obj, is_train);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  GIL gil;
+  auto *h = static_cast<ExecutorH *>(handle);
+  PyObject *grads = nd_list(len, head_grads);
+  PyObject *res = icall("executor_backward", "(OO)", h->obj, grads);
+  Py_DECREF(grads);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  GIL gil;
+  auto *h = static_cast<ExecutorH *>(handle);
+  PyObject *res = icall("executor_outputs", "(O)", h->obj);
+  if (!res) return -1;
+  for (auto nd : h->out_handles) free_nd(nd);
+  h->out_handles.clear();
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    h->out_handles.push_back(wrap_nd(o));
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out = h->out_handles.data();
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  GIL gil;
+  auto *h = static_cast<ExecutorH *>(handle);
+  if (h) {
+    for (auto nd : h->out_handles) free_nd(nd);
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+// ----------------------------------------------------------------- KVStore
+
+namespace {
+
+struct UpdaterCtx {
+  MXKVUpdater *fn;
+  void *handle;
+};
+
+// PyCFunction trampoline: (key:int, recv:NDArray, local:NDArray) -> None.
+// Wraps the python NDArrays into temporary C handles for the user callback.
+PyObject *updater_trampoline(PyObject *self, PyObject *args) {
+  auto *ctx = static_cast<UpdaterCtx *>(PyCapsule_GetPointer(
+      self, "mxtpu.updater"));
+  int key = 0;
+  PyObject *recv = nullptr, *local = nullptr;
+  if (!PyArg_ParseTuple(args, "iOO", &key, &recv, &local)) return nullptr;
+  Py_INCREF(recv);
+  Py_INCREF(local);
+  NDArrayH *hrecv = wrap_nd(recv);
+  NDArrayH *hlocal = wrap_nd(local);
+  // the user callback may call back into MX* APIs (which take the GIL
+  // recursively via PyGILState_Ensure — fine on the same thread)
+  ctx->fn(key, hrecv, hlocal, ctx->handle);
+  free_nd(hrecv);
+  free_nd(hlocal);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef updater_def = {
+    "mxtpu_kv_updater", updater_trampoline, METH_VARARGS,
+    "C KVStore updater trampoline"};
+
+void updater_capsule_free(PyObject *cap) {
+  delete static_cast<UpdaterCtx *>(
+      PyCapsule_GetPointer(cap, "mxtpu.updater"));
+}
+
+}  // namespace
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("kvstore_create", "(s)", type);
+  if (!res) return -1;
+  auto *h = new KVStoreH();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  if (h) {
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *k = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SetItem(k, i, PyLong_FromLong(keys[i]));
+  }
+  PyObject *v = nd_list(num, vals);
+  PyObject *res = icall("kvstore_init", "(OOO)", h->obj, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+static int kv_push_pull(KVStoreHandle handle, mx_uint num, const int *keys,
+                        NDArrayHandle *vals, int priority, const char *fn) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *k = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SetItem(k, i, PyLong_FromLong(keys[i]));
+  }
+  PyObject *v = nd_list(num, vals);
+  PyObject *res = icall(fn, "(OOOi)", h->obj, k, v, priority);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return kv_push_pull(handle, num, keys, vals, priority, "kvstore_push");
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return kv_push_pull(handle, num, keys, vals, priority, "kvstore_pull");
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVUpdater updater,
+                        void *updater_handle) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  auto *ctx = new UpdaterCtx{updater, updater_handle};
+  PyObject *cap = PyCapsule_New(ctx, "mxtpu.updater", updater_capsule_free);
+  if (!cap) {
+    delete ctx;
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *fn = PyCFunction_New(&updater_def, cap);
+  Py_DECREF(cap);  // fn holds the reference now
+  if (!fn) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *res = icall("kvstore_set_updater", "(OO)", h->obj, fn);
+  Py_DECREF(fn);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *res = icall("kvstore_rank", "(O)", h->obj);
+  if (!res) return -1;
+  *rank = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *res = icall("kvstore_group_size", "(O)", h->obj);
+  if (!res) return -1;
+  *size = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+// ---------------------------------------------------------------- DataIter
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array) {
+  ensure_python();
+  GIL gil;
+  static std::vector<std::string> names;
+  static std::vector<DataIterCreator> creators;
+  if (names.empty()) {
+    PyObject *res = icall("list_data_iters", nullptr);
+    if (!res) return -1;
+    Py_ssize_t n = PyList_Size(res);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char *s = PyUnicode_AsUTF8(PyList_GetItem(res, i));
+      names.push_back(s ? s : "");
+    }
+    Py_DECREF(res);
+    for (auto &s : names) {
+      creators.push_back(static_cast<DataIterCreator>(
+          static_cast<void *>(&s)));
+    }
+  }
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  *name = static_cast<const std::string *>(creator)->c_str();
+  if (description) *description = "";
+  if (num_args) *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  ensure_python();
+  GIL gil;
+  const std::string *name = static_cast<const std::string *>(creator);
+  PyObject *k = str_list(num_param, keys);
+  PyObject *v = str_list(num_param, vals);
+  PyObject *res = icall("data_iter_create", "(sOO)", name->c_str(), k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!res) return -1;
+  auto *h = new DataIterH();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  GIL gil;
+  auto *h = static_cast<DataIterH *>(handle);
+  if (h) {
+    free_nd(h->data);
+    free_nd(h->label);
+    Py_XDECREF(h->batch);
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  GIL gil;
+  auto *h = static_cast<DataIterH *>(handle);
+  PyObject *res = icall("data_iter_next", "(O)", h->obj);
+  if (!res) return -1;
+  Py_XDECREF(h->batch);
+  if (res == Py_None) {
+    h->batch = nullptr;
+    Py_DECREF(res);
+    *out = 0;
+  } else {
+    h->batch = res;
+    *out = 1;
+  }
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  GIL gil;
+  auto *h = static_cast<DataIterH *>(handle);
+  PyObject *res = icall("data_iter_before_first", "(O)", h->obj);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+static int iter_get(DataIterHandle handle, NDArrayHandle *out,
+                    const char *fn, NDArrayHandle *slot) {
+  GIL gil;
+  auto *h = static_cast<DataIterH *>(handle);
+  if (!h->batch) {
+    g_last_error = "no current batch; call MXDataIterNext first";
+    return -1;
+  }
+  PyObject *res = icall(fn, "(O)", h->batch);
+  if (!res) return -1;
+  free_nd(*slot);
+  *slot = wrap_nd(res);
+  *out = *slot;
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  auto *h = static_cast<DataIterH *>(handle);
+  return iter_get(handle, out, "data_iter_data", &h->data);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  auto *h = static_cast<DataIterH *>(handle);
+  return iter_get(handle, out, "data_iter_label", &h->label);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  GIL gil;
+  auto *h = static_cast<DataIterH *>(handle);
+  if (!h->batch) {
+    g_last_error = "no current batch; call MXDataIterNext first";
+    return -1;
+  }
+  PyObject *res = icall("data_iter_pad", "(O)", h->batch);
+  if (!res) return -1;
+  *pad = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

