@@ -1,0 +1,15 @@
+/* Umbrella header for the training-capable C++ package.
+ * Reference counterpart: cpp-package/include/mxnet-cpp/MxNetCpp.h.
+ * Link against -lmxtpu_c (built by make -C mxtpu/_native). */
+#ifndef MXTPU_CPP_MXTPUCPP_HPP_
+#define MXTPU_CPP_MXTPUCPP_HPP_
+
+#include "base.hpp"
+#include "executor.hpp"
+#include "ndarray.hpp"
+#include "op.hpp"
+#include "operator.hpp"
+#include "optimizer.hpp"
+#include "symbol.hpp"
+
+#endif  // MXTPU_CPP_MXTPUCPP_HPP_

@@ -1,0 +1,84 @@
+/*
+ * mxtpu-cpp: training-capable C++ package over the core C ABI.
+ *
+ * Reference counterpart: cpp-package/include/mxnet-cpp (base.h, MxNetCpp.h)
+ * — idiomatic RAII classes (NDArray, Symbol, Executor, Operator, Optimizer)
+ * over include/mxtpu/c_api.h. The predict-only header
+ * include/mxtpu/mxtpu_cpp.hpp stays for deployment; this package adds the
+ * full training surface. Link against -lmxtpu_c.
+ */
+#ifndef MXTPU_CPP_BASE_HPP_
+#define MXTPU_CPP_BASE_HPP_
+
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../mxtpu/c_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) {
+    const char *msg = MXGetLastError();
+    throw std::runtime_error(msg && *msg ? msg : "mxtpu c_api call failed");
+  }
+}
+
+/* Device handle (reference mxnet-cpp/context.h). dev_type uses the ABI
+ * codes: 1 = cpu, 2 = accelerator (the TPU chip here). */
+class Context {
+ public:
+  Context(int dev_type, int dev_id) : type_(dev_type), id_(dev_id) {}
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context gpu(int id = 0) { return Context(2, id); }  // alias
+  static Context tpu(int id = 0) { return Context(2, id); }
+  int dev_type() const { return type_; }
+  int dev_id() const { return id_; }
+
+ private:
+  int type_;
+  int id_;
+};
+
+/* Tensor shape (reference mxnet-cpp/shape.h). */
+using Shape = std::vector<mx_uint>;
+
+/* General numeric tuple parameter — op tuple params may hold negative or
+ * fractional values (steps=(-1,-1), variances=(0.1,...)), which Shape's
+ * unsigned elements cannot. */
+using Tuple = std::vector<double>;
+
+inline std::string ShapeStr(const Shape &s) {
+  std::ostringstream os;
+  os << "(";
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (i) os << ",";
+    os << s[i];
+  }
+  os << ")";
+  return os.str();
+}
+
+inline std::string TupleStr(const Tuple &t) {
+  std::ostringstream os;
+  os << "(";
+  for (size_t i = 0; i < t.size(); ++i) {
+    if (i) os << ",";
+    double v = t[i];
+    if (v == static_cast<long long>(v)) {
+      os << static_cast<long long>(v);
+    } else {
+      os << v;
+    }
+  }
+  os << ")";
+  return os.str();
+}
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_BASE_HPP_

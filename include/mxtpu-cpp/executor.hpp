@@ -1,0 +1,122 @@
+/* Bound computation. Reference: cpp-package/include/mxnet-cpp/executor.h. */
+#ifndef MXTPU_CPP_EXECUTOR_HPP_
+#define MXTPU_CPP_EXECUTOR_HPP_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.hpp"
+#include "ndarray.hpp"
+#include "symbol.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+enum class OpReq : mx_uint { kNull = 0, kWrite = 1, kAdd = 2 };
+
+class Executor {
+ public:
+  /* Bind in list_arguments() order; grads entries may be null NDArrays
+   * where req is kNull. */
+  Executor(const Symbol &symbol, const Context &ctx,
+           const std::vector<NDArray> &args,
+           const std::vector<NDArray> &arg_grads,
+           const std::vector<OpReq> &grad_reqs,
+           const std::vector<NDArray> &aux_states = {})
+      : symbol_(symbol), args_(args), arg_grads_(arg_grads) {
+    std::vector<NDArrayHandle> ah, gh, xh;
+    std::vector<mx_uint> rq;
+    for (const auto &a : args) ah.push_back(a.handle());
+    for (const auto &g : arg_grads) gh.push_back(g.handle());
+    for (OpReq r : grad_reqs) rq.push_back(static_cast<mx_uint>(r));
+    for (const auto &x : aux_states) xh.push_back(x.handle());
+    ExecutorHandle h = nullptr;
+    Check(MXExecutorBind(symbol.handle(), ctx.dev_type(), ctx.dev_id(),
+                         static_cast<mx_uint>(ah.size()), ah.data(),
+                         gh.data(), rq.data(),
+                         static_cast<mx_uint>(xh.size()),
+                         xh.empty() ? nullptr : xh.data(), &h));
+    handle_ = std::make_shared<Blob>(h);
+  }
+
+  /* Convenience: allocate + zero-init args/grads from inferred shapes.
+   * Inputs named in `data_names` get OpReq::kNull grads. */
+  static Executor SimpleBind(const Symbol &symbol, const Context &ctx,
+                             const std::map<std::string, Shape> &input_shapes,
+                             const std::vector<std::string> &data_names) {
+    std::vector<Shape> arg_shapes;
+    if (!symbol.InferShape(input_shapes, &arg_shapes)) {
+      throw std::runtime_error("SimpleBind: shape inference incomplete");
+    }
+    auto names = symbol.ListArguments();
+    std::vector<NDArray> args, grads;
+    std::vector<OpReq> reqs;
+    for (size_t i = 0; i < names.size(); ++i) {
+      args.emplace_back(arg_shapes[i], ctx);
+      grads.emplace_back(arg_shapes[i], ctx);
+      bool is_data = false;
+      for (const auto &d : data_names) is_data |= (d == names[i]);
+      reqs.push_back(is_data ? OpReq::kNull : OpReq::kWrite);
+    }
+    return Executor(symbol, ctx, args, grads, reqs);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle(), is_train ? 1 : 0));
+  }
+
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> hs;
+    for (const auto &g : head_grads) hs.push_back(g.handle());
+    Check(MXExecutorBackward(handle(),
+                             static_cast<mx_uint>(hs.size()),
+                             hs.empty() ? nullptr : hs.data()));
+  }
+
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXExecutorOutputs(handle(), &n, &outs));
+    std::vector<NDArray> result;
+    for (mx_uint i = 0; i < n; ++i) {
+      // outputs are library-owned (freed by the executor); wrap without
+      // ownership by copying the handle into a non-owning NDArray is not
+      // supported, so we just read through them immediately — copy out.
+      NDArrayHandle h = outs[i];
+      mx_uint ndim;
+      const mx_uint *dims;
+      Check(MXNDArrayGetShape(h, &ndim, &dims));
+      Shape shape(dims, dims + ndim);
+      size_t size = 1;
+      for (mx_uint d : shape) size *= d;
+      std::vector<mx_float> host(size);
+      Check(MXNDArraySyncCopyToCPU(h, host.data(), size));
+      result.emplace_back(host, shape);
+    }
+    return result;
+  }
+
+  const std::vector<NDArray> &args() const { return args_; }
+  const std::vector<NDArray> &arg_grads() const { return arg_grads_; }
+  ExecutorHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+ private:
+  struct Blob {
+    ExecutorHandle h;
+    explicit Blob(ExecutorHandle hh) : h(hh) {}
+    ~Blob() {
+      if (h) MXExecutorFree(h);
+    }
+  };
+
+  Symbol symbol_;  // keep the graph alive
+  std::vector<NDArray> args_, arg_grads_;
+  std::shared_ptr<Blob> handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_EXECUTOR_HPP_

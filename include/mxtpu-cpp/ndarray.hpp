@@ -1,0 +1,145 @@
+/* Device tensor with copy-on-destroy-safe shared ownership.
+ * Reference counterpart: cpp-package/include/mxnet-cpp/ndarray.h. */
+#ifndef MXTPU_CPP_NDARRAY_HPP_
+#define MXTPU_CPP_NDARRAY_HPP_
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  /* Uninitialized (zeroed) device array. */
+  NDArray(const Shape &shape, const Context &ctx = Context::cpu(),
+          int dtype = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreateEx(shape.data(),
+                            static_cast<mx_uint>(shape.size()),
+                            ctx.dev_type(), ctx.dev_id(), 0, dtype, &h));
+    reset(h);
+  }
+
+  /* From host data. */
+  NDArray(const std::vector<mx_float> &data, const Shape &shape,
+          const Context &ctx = Context::cpu())
+      : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data);
+  }
+
+  /* Adopt an existing handle (takes ownership). */
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+
+  bool IsNull() const { return !handle_; }
+  NDArrayHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+  void SyncCopyFromCPU(const std::vector<mx_float> &data) {
+    Check(MXNDArraySyncCopyFromCPU(handle(), data.data(), data.size()));
+  }
+
+  std::vector<mx_float> SyncCopyToCPU() const {
+    std::vector<mx_float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(handle(), out.data(), out.size()));
+    return out;
+  }
+
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(handle())); }
+  static void WaitAll() { Check(MXNDArrayWaitAll()); }
+
+  Shape GetShape() const {
+    mx_uint ndim = 0;
+    const mx_uint *dims = nullptr;
+    Check(MXNDArrayGetShape(handle(), &ndim, &dims));
+    return Shape(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : GetShape()) n *= d;
+    return n;
+  }
+
+  int GetDType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(handle(), &dt));
+    return dt;
+  }
+
+  NDArray Reshape(const std::vector<int> &dims) const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayReshape(handle(), static_cast<int>(dims.size()),
+                           dims.data(), &h));
+    return FromHandle(h);
+  }
+
+  NDArray Slice(mx_uint begin, mx_uint end) const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArraySlice(handle(), begin, end, &h));
+    return FromHandle(h);
+  }
+
+  NDArray At(mx_uint idx) const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayAt(handle(), idx, &h));
+    return FromHandle(h);
+  }
+
+  NDArray Grad() const {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayGetGrad(handle(), &h));
+    return FromHandle(h);
+  }
+
+  static void Save(const std::string &fname,
+                   const std::vector<NDArray> &arrays,
+                   const std::vector<std::string> &names = {}) {
+    std::vector<NDArrayHandle> hs;
+    for (const auto &a : arrays) hs.push_back(a.handle());
+    std::vector<const char *> keys;
+    for (const auto &n : names) keys.push_back(n.c_str());
+    Check(MXNDArraySave(fname.c_str(), static_cast<mx_uint>(hs.size()),
+                        hs.data(), names.empty() ? nullptr : keys.data()));
+  }
+
+  static void Load(const std::string &fname, std::vector<NDArray> *arrays,
+                   std::vector<std::string> *names = nullptr) {
+    mx_uint n = 0, nn = 0;
+    NDArrayHandle *hs = nullptr;
+    const char **ns = nullptr;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &hs, &nn, &ns));
+    arrays->clear();
+    for (mx_uint i = 0; i < n; ++i) arrays->push_back(FromHandle(hs[i]));
+    if (names) {
+      names->clear();
+      for (mx_uint i = 0; i < nn; ++i) names->push_back(ns[i]);
+    }
+  }
+
+ private:
+  struct Blob {
+    NDArrayHandle h;
+    explicit Blob(NDArrayHandle hh) : h(hh) {}
+    ~Blob() {
+      if (h) MXNDArrayFree(h);
+    }
+  };
+
+  void reset(NDArrayHandle h) { handle_ = std::make_shared<Blob>(h); }
+
+  std::shared_ptr<Blob> handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_NDARRAY_HPP_

@@ -1,0 +1,135 @@
+/* Generic operator invocation: set params + inputs, then create a Symbol
+ * node or invoke imperatively on NDArrays. Reference counterpart:
+ * cpp-package/include/mxnet-cpp/operator.h (the class the generated op.h
+ * wrappers call into). */
+#ifndef MXTPU_CPP_OPERATOR_HPP_
+#define MXTPU_CPP_OPERATOR_HPP_
+
+#include <sstream>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "base.hpp"
+#include "ndarray.hpp"
+#include "symbol.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : name_(op_name) {
+    Check(MXGetOpHandle(op_name.c_str(), &op_));
+  }
+
+  template <typename T>
+  Operator &SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    keys_.push_back(key);
+    vals_.push_back(os.str());
+    return *this;
+  }
+
+  Operator &SetParam(const std::string &key, const Shape &value) {
+    keys_.push_back(key);
+    vals_.push_back(ShapeStr(value));
+    return *this;
+  }
+
+  Operator &SetParam(const std::string &key, bool value) {
+    keys_.push_back(key);
+    vals_.push_back(value ? "true" : "false");
+    return *this;
+  }
+
+  Operator &SetInput(const std::string &arg_name, const Symbol &sym) {
+    input_keys_.push_back(arg_name);
+    sym_inputs_.push_back(sym);
+    return *this;
+  }
+
+  Operator &PushInput(const Symbol &sym) {
+    sym_inputs_.push_back(sym);
+    return *this;
+  }
+
+  Operator &PushInput(const NDArray &nd) {
+    nd_inputs_.push_back(nd);
+    return *this;
+  }
+
+  /* Build a graph node from the accumulated symbol inputs. */
+  Symbol CreateSymbol(const std::string &node_name = "") {
+    AtomicSymbolCreator creator = op_;
+    std::vector<const char *> pk, pv;
+    for (size_t i = 0; i < keys_.size(); ++i) {
+      pk.push_back(keys_[i].c_str());
+      pv.push_back(vals_[i].c_str());
+    }
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(creator,
+                                     static_cast<mx_uint>(pk.size()),
+                                     pk.data(), pv.data(), &h));
+    Symbol s = Symbol::FromHandle(h);
+    std::vector<SymbolHandle> args;
+    std::vector<const char *> arg_keys;
+    for (const auto &sym : sym_inputs_) args.push_back(sym.handle());
+    for (const auto &k : input_keys_) arg_keys.push_back(k.c_str());
+    Check(MXSymbolCompose(
+        s.handle(), node_name.empty() ? nullptr : node_name.c_str(),
+        static_cast<mx_uint>(args.size()),
+        input_keys_.empty() ? nullptr : arg_keys.data(), args.data()));
+    return s;
+  }
+
+  /* Imperative invoke over the accumulated NDArray inputs; outputs are
+   * allocated by the library. */
+  std::vector<NDArray> Invoke() {
+    int num_out = 0;
+    NDArrayHandle *outs = nullptr;
+    InvokeRaw(&num_out, &outs);
+    std::vector<NDArray> result;
+    for (int i = 0; i < num_out; ++i) {
+      result.push_back(NDArray::FromHandle(outs[i]));
+    }
+    return result;
+  }
+
+  /* Imperative invoke writing into caller-provided outputs (out= form). */
+  void Invoke(std::vector<NDArray> *outputs) {
+    std::vector<NDArrayHandle> hs;
+    for (const auto &o : *outputs) hs.push_back(o.handle());
+    int num_out = static_cast<int>(hs.size());
+    NDArrayHandle *outs = hs.data();
+    InvokeRaw(&num_out, &outs);
+  }
+
+ private:
+  void InvokeRaw(int *num_out, NDArrayHandle **outs) {
+    std::vector<NDArrayHandle> ins;
+    for (const auto &i : nd_inputs_) ins.push_back(i.handle());
+    std::vector<const char *> pk, pv;
+    for (size_t i = 0; i < keys_.size(); ++i) {
+      pk.push_back(keys_[i].c_str());
+      pv.push_back(vals_[i].c_str());
+    }
+    Check(MXImperativeInvoke(op_, static_cast<int>(ins.size()), ins.data(),
+                             num_out, outs,
+                             static_cast<int>(pk.size()), pk.data(),
+                             pv.data()));
+  }
+
+  std::string name_;
+  OpHandle op_ = nullptr;
+  std::vector<std::string> keys_, vals_;
+  std::vector<std::string> input_keys_;
+  std::vector<Symbol> sym_inputs_;
+  std::vector<NDArray> nd_inputs_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_OPERATOR_HPP_

@@ -1,0 +1,108 @@
+/* Optimizers as imperative update-op drivers.
+ * Reference: cpp-package/include/mxnet-cpp/optimizer.h — there each
+ * optimizer calls its fused update op (sgd_update, adam_update, ...)
+ * through the C ABI; same here, with per-index state arrays. */
+#ifndef MXTPU_CPP_OPTIMIZER_HPP_
+#define MXTPU_CPP_OPTIMIZER_HPP_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.hpp"
+#include "ndarray.hpp"
+#include "operator.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+class Optimizer {
+ public:
+  virtual ~Optimizer() = default;
+
+  template <typename T>
+  Optimizer *SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    params_[key] = os.str();
+    return this;
+  }
+
+  float lr() const {
+    auto it = params_.find("lr");
+    return it == params_.end() ? 0.01f : std::stof(it->second);
+  }
+
+  /* Apply one update: weight <- update(weight, grad, state...). */
+  virtual void Update(int index, NDArray weight, NDArray grad) = 0;
+
+ protected:
+  Operator MakeOp(const std::string &op_name) {
+    Operator op(op_name);
+    for (const auto &kv : params_) op.SetParam(kv.first, kv.second);
+    return op;
+  }
+
+  NDArray &State(std::map<int, NDArray> &store, int index,
+                 const NDArray &like) {
+    auto it = store.find(index);
+    if (it == store.end()) {
+      it = store.emplace(index, NDArray(like.GetShape())).first;
+    }
+    return it->second;
+  }
+
+  std::map<std::string, std::string> params_;
+};
+
+class SGDOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray weight, NDArray grad) override {
+    if (params_.count("momentum")) {
+      NDArray &mom = State(mom_, index, weight);
+      Operator op = MakeOp("sgd_mom_update");
+      op.PushInput(weight).PushInput(grad).PushInput(mom);
+      std::vector<NDArray> outs{weight, mom};
+      op.Invoke(&outs);
+    } else {
+      Operator op = MakeOp("sgd_update");
+      op.PushInput(weight).PushInput(grad);
+      std::vector<NDArray> outs{weight};
+      op.Invoke(&outs);
+    }
+  }
+
+ private:
+  std::map<int, NDArray> mom_;
+};
+
+class AdamOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray weight, NDArray grad) override {
+    NDArray &mean = State(mean_, index, weight);
+    NDArray &var = State(var_, index, weight);
+    Operator op = MakeOp("adam_update");
+    op.PushInput(weight).PushInput(grad).PushInput(mean).PushInput(var);
+    std::vector<NDArray> outs{weight, mean, var};
+    op.Invoke(&outs);
+  }
+
+ private:
+  std::map<int, NDArray> mean_, var_;
+};
+
+inline std::unique_ptr<Optimizer> CreateOptimizer(const std::string &name) {
+  if (name == "sgd") {
+    return std::unique_ptr<Optimizer>(new SGDOptimizer());
+  }
+  if (name == "adam") {
+    return std::unique_ptr<Optimizer>(new AdamOptimizer());
+  }
+  throw std::runtime_error("unknown optimizer: " + name);
+}
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_OPTIMIZER_HPP_

@@ -1,0 +1,153 @@
+/* Symbolic graph node. Reference: cpp-package/include/mxnet-cpp/symbol.h. */
+#ifndef MXTPU_CPP_SYMBOL_HPP_
+#define MXTPU_CPP_SYMBOL_HPP_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "base.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+class Symbol {
+ public:
+  Symbol() = default;
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return FromHandle(h);
+  }
+
+  static Symbol FromHandle(SymbolHandle h) {
+    Symbol s;
+    s.reset(h);
+    return s;
+  }
+
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return FromHandle(h);
+  }
+
+  static Symbol Load(const std::string &fname) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromFile(fname.c_str(), &h));
+    return FromHandle(h);
+  }
+
+  static Symbol Group(const std::vector<Symbol> &symbols) {
+    std::vector<SymbolHandle> hs;
+    for (const auto &s : symbols) hs.push_back(s.handle());
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateGroup(static_cast<mx_uint>(hs.size()), hs.data(),
+                              &h));
+    return FromHandle(h);
+  }
+
+  bool IsNull() const { return !handle_; }
+  SymbolHandle handle() const { return handle_ ? handle_->h : nullptr; }
+
+  std::string ToJSON() const {
+    const char *js = nullptr;
+    Check(MXSymbolSaveToJSON(handle(), &js));
+    return js;
+  }
+
+  void Save(const std::string &fname) const {
+    Check(MXSymbolSaveToFile(handle(), fname.c_str()));
+  }
+
+  Symbol GetInternals() const {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolGetInternals(handle(), &h));
+    return FromHandle(h);
+  }
+
+  Symbol operator[](mx_uint index) const {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolGetOutput(handle(), index, &h));
+    return FromHandle(h);
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return StrList(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrList(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrList(&MXSymbolListAuxiliaryStates);
+  }
+
+  /* Infer shapes of all arguments/outputs/aux from known input shapes.
+   * Returns false when inference is incomplete. */
+  bool InferShape(const std::map<std::string, Shape> &known,
+                  std::vector<Shape> *arg_shapes,
+                  std::vector<Shape> *out_shapes = nullptr,
+                  std::vector<Shape> *aux_shapes = nullptr) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> ind_ptr{0};
+    std::vector<mx_uint> data;
+    for (const auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) data.push_back(d);
+      ind_ptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_n, out_n, aux_n;
+    const mx_uint *in_nd, *out_nd, *aux_nd;
+    const mx_uint **in_d, **out_d, **aux_d;
+    int complete = 0;
+    Check(MXSymbolInferShape(handle(),
+                             static_cast<mx_uint>(keys.size()), keys.data(),
+                             ind_ptr.data(), data.data(), &in_n, &in_nd,
+                             &in_d, &out_n, &out_nd, &out_d, &aux_n,
+                             &aux_nd, &aux_d, &complete));
+    if (!complete) return false;
+    auto unpack = [](mx_uint n, const mx_uint *nd, const mx_uint **d,
+                     std::vector<Shape> *out) {
+      if (!out) return;
+      out->clear();
+      for (mx_uint i = 0; i < n; ++i) {
+        out->push_back(Shape(d[i], d[i] + nd[i]));
+      }
+    };
+    unpack(in_n, in_nd, in_d, arg_shapes);
+    unpack(out_n, out_nd, out_d, out_shapes);
+    unpack(aux_n, aux_nd, aux_d, aux_shapes);
+    return true;
+  }
+
+ private:
+  using ListFn = int (*)(SymbolHandle, mx_uint *, const char ***);
+
+  std::vector<std::string> StrList(ListFn fn) const {
+    mx_uint n = 0;
+    const char **strs = nullptr;
+    Check(fn(handle(), &n, &strs));
+    std::vector<std::string> out;
+    for (mx_uint i = 0; i < n; ++i) out.push_back(strs[i]);
+    return out;
+  }
+
+  struct Blob {
+    SymbolHandle h;
+    explicit Blob(SymbolHandle hh) : h(hh) {}
+    ~Blob() {
+      if (h) MXSymbolFree(h);
+    }
+  };
+
+  void reset(SymbolHandle h) { handle_ = std::make_shared<Blob>(h); }
+
+  std::shared_ptr<Blob> handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_SYMBOL_HPP_
