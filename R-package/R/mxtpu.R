@@ -1,0 +1,40 @@
+# R interface to mxtpu over the core C ABI.
+#
+# Reference counterpart: R-package/R in the reference (mx.nd.*,
+# mx.symbol.*, mx.model.* surfaces over c_api.h). Scope here matches the
+# Perl binding: NDArray, imperative op invocation, Symbol loading, and
+# Executor inference — enough to predict with a trained model from R.
+#
+# Example:
+#   a <- mx.nd.array(c(1, 2, 3, 4), c(2L, 2L))
+#   b <- mx.op.invoke("square", list(a))[[1]]
+#   mx.nd.to.array(b)   # 1 4 9 16
+
+mx.version <- function() .Call(mxr_version)
+
+mx.seed <- function(seed) invisible(.Call(mxr_seed, as.integer(seed)))
+
+mx.nd.array <- function(data, shape) {
+  .Call(mxr_nd_array, as.double(data), as.integer(shape))
+}
+
+mx.nd.to.array <- function(nd) .Call(mxr_nd_to_array, nd)
+
+mx.nd.shape <- function(nd) .Call(mxr_nd_shape, nd)
+
+mx.op.invoke <- function(name, inputs, params = list()) {
+  keys <- as.character(names(params))
+  vals <- vapply(params, function(v) as.character(v), character(1))
+  .Call(mxr_op_invoke, name, inputs, keys, vals)
+}
+
+mx.symbol.load.json <- function(json) .Call(mxr_symbol_from_json, json)
+
+mx.symbol.arguments <- function(sym) .Call(mxr_symbol_arguments, sym)
+
+# args: list of NDArrays in mx.symbol.arguments() order
+mx.executor.bind <- function(sym, args) .Call(mxr_executor_bind, sym, args)
+
+mx.executor.forward <- function(executor) {
+  .Call(mxr_executor_forward, executor)
+}

@@ -1,0 +1,238 @@
+/* R .Call glue over the mxtpu core C ABI (include/mxtpu/c_api.h).
+ *
+ * Reference counterpart: the reference R-package's src/ bridges R to
+ * c_api.h via Rcpp; this is the same layer in plain C over R's .Call
+ * interface, matching the Perl binding's scope (NDArray, imperative
+ * invoke, Symbol load, Executor inference).
+ *
+ * Build (from R-package/): R CMD SHLIB src/mxtpu_r.c \
+ *     PKG_CPPFLAGS=-I../.. "PKG_LIBS=-L../../mxtpu/_native -lmxtpu_c"
+ * Handles cross into R as external pointers.
+ */
+#include <R.h>
+#include <Rinternals.h>
+#include <R_ext/Rdynload.h>
+
+#include "../../include/mxtpu/c_api.h"
+
+static void check_rc(int rc, const char *what) {
+  if (rc != 0) {
+    Rf_error("%s failed: %s", what, MXGetLastError());
+  }
+}
+
+static void nd_finalizer(SEXP ptr) {
+  NDArrayHandle h = R_ExternalPtrAddr(ptr);
+  if (h) {
+    MXNDArrayFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void sym_finalizer(SEXP ptr) {
+  SymbolHandle h = R_ExternalPtrAddr(ptr);
+  if (h) {
+    MXSymbolFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void exec_finalizer(SEXP ptr) {
+  ExecutorHandle h = R_ExternalPtrAddr(ptr);
+  if (h) {
+    MXExecutorFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static SEXP wrap_nd(NDArrayHandle h) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, nd_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP mxr_version(void) {
+  int v = 0;
+  check_rc(MXGetVersion(&v), "MXGetVersion");
+  return Rf_ScalarInteger(v);
+}
+
+SEXP mxr_seed(SEXP seed) {
+  check_rc(MXRandomSeed(Rf_asInteger(seed)), "MXRandomSeed");
+  return R_NilValue;
+}
+
+/* data: numeric vector, shape: integer vector -> NDArray extptr */
+SEXP mxr_nd_array(SEXP data, SEXP shape) {
+  mx_uint dims[32];
+  int ndim = Rf_length(shape);
+  int i;
+  NDArrayHandle h = NULL;
+  R_xlen_t n = Rf_xlength(data);
+  float *buf;
+  for (i = 0; i < ndim; ++i) dims[i] = (mx_uint)INTEGER(shape)[i];
+  check_rc(MXNDArrayCreate(dims, (mx_uint)ndim, 1, 0, 0, &h),
+           "MXNDArrayCreate");
+  buf = (float *)R_alloc(n, sizeof(float));
+  for (i = 0; i < n; ++i) buf[i] = (float)REAL(data)[i];
+  check_rc(MXNDArraySyncCopyFromCPU(h, buf, (size_t)n),
+           "MXNDArraySyncCopyFromCPU");
+  return wrap_nd(h);
+}
+
+SEXP mxr_nd_to_array(SEXP ptr) {
+  NDArrayHandle h = R_ExternalPtrAddr(ptr);
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  size_t n = 1, i;
+  float *buf;
+  SEXP out;
+  check_rc(MXNDArrayGetShape(h, &ndim, &dims), "MXNDArrayGetShape");
+  for (i = 0; i < ndim; ++i) n *= dims[i];
+  buf = (float *)R_alloc(n, sizeof(float));
+  check_rc(MXNDArraySyncCopyToCPU(h, buf, n), "MXNDArraySyncCopyToCPU");
+  out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)n));
+  for (i = 0; i < n; ++i) REAL(out)[i] = buf[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxr_nd_shape(SEXP ptr) {
+  NDArrayHandle h = R_ExternalPtrAddr(ptr);
+  mx_uint ndim = 0, i;
+  const mx_uint *dims = NULL;
+  SEXP out;
+  check_rc(MXNDArrayGetShape(h, &ndim, &dims), "MXNDArrayGetShape");
+  out = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (i = 0; i < ndim; ++i) INTEGER(out)[i] = (int)dims[i];
+  UNPROTECT(1);
+  return out;
+}
+
+/* op_name: string, inputs: list of NDArray extptrs,
+ * keys/vals: character vectors -> list of NDArray extptrs */
+SEXP mxr_op_invoke(SEXP op_name, SEXP inputs, SEXP keys, SEXP vals) {
+  OpHandle op = NULL;
+  NDArrayHandle ins[64];
+  const char *pk[64];
+  const char *pv[64];
+  int n_in = Rf_length(inputs);
+  int n_par = Rf_length(keys);
+  int num_out = 0, i;
+  NDArrayHandle *outs = NULL;
+  SEXP result;
+  check_rc(MXGetOpHandle(CHAR(STRING_ELT(op_name, 0)), &op),
+           "MXGetOpHandle");
+  for (i = 0; i < n_in; ++i) {
+    ins[i] = R_ExternalPtrAddr(VECTOR_ELT(inputs, i));
+  }
+  for (i = 0; i < n_par; ++i) {
+    pk[i] = CHAR(STRING_ELT(keys, i));
+    pv[i] = CHAR(STRING_ELT(vals, i));
+  }
+  check_rc(MXImperativeInvoke(op, n_in, ins, &num_out, &outs, n_par, pk,
+                              pv),
+           "MXImperativeInvoke");
+  result = PROTECT(Rf_allocVector(VECSXP, num_out));
+  for (i = 0; i < num_out; ++i) {
+    SET_VECTOR_ELT(result, i, wrap_nd(outs[i]));
+  }
+  UNPROTECT(1);
+  return result;
+}
+
+SEXP mxr_symbol_from_json(SEXP json) {
+  SymbolHandle h = NULL;
+  SEXP ptr;
+  check_rc(MXSymbolCreateFromJSON(CHAR(STRING_ELT(json, 0)), &h),
+           "MXSymbolCreateFromJSON");
+  ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, sym_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP mxr_symbol_arguments(SEXP ptr) {
+  SymbolHandle h = R_ExternalPtrAddr(ptr);
+  mx_uint n = 0, i;
+  const char **names = NULL;
+  SEXP out;
+  check_rc(MXSymbolListArguments(h, &n, &names), "MXSymbolListArguments");
+  out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (i = 0; i < n; ++i) SET_STRING_ELT(out, i, Rf_mkChar(names[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+/* inference bind: args in list_arguments order, no gradients */
+SEXP mxr_executor_bind(SEXP sym_ptr, SEXP args) {
+  SymbolHandle sym = R_ExternalPtrAddr(sym_ptr);
+  NDArrayHandle ah[128];
+  NDArrayHandle gh[128];
+  mx_uint reqs[128];
+  mx_uint n = (mx_uint)Rf_length(args), i;
+  ExecutorHandle ex = NULL;
+  SEXP ptr;
+  for (i = 0; i < n; ++i) {
+    ah[i] = R_ExternalPtrAddr(VECTOR_ELT(args, i));
+    gh[i] = NULL;
+    reqs[i] = 0;
+  }
+  check_rc(MXExecutorBind(sym, 1, 0, n, ah, gh, reqs, 0, NULL, &ex),
+           "MXExecutorBind");
+  ptr = PROTECT(R_MakeExternalPtr(ex, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, exec_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP mxr_executor_forward(SEXP ex_ptr) {
+  ExecutorHandle ex = R_ExternalPtrAddr(ex_ptr);
+  mx_uint n = 0, i;
+  NDArrayHandle *outs = NULL;
+  SEXP result;
+  check_rc(MXExecutorForward(ex, 0), "MXExecutorForward");
+  check_rc(MXExecutorOutputs(ex, &n, &outs), "MXExecutorOutputs");
+  /* outputs are executor-owned: copy them into fresh owned arrays */
+  result = PROTECT(Rf_allocVector(VECSXP, n));
+  for (i = 0; i < n; ++i) {
+    mx_uint ndim = 0;
+    const mx_uint *dims = NULL;
+    size_t sz = 1;
+    mx_uint d;
+    float *buf;
+    NDArrayHandle copy = NULL;
+    check_rc(MXNDArrayGetShape(outs[i], &ndim, &dims),
+             "MXNDArrayGetShape");
+    for (d = 0; d < ndim; ++d) sz *= dims[d];
+    buf = (float *)R_alloc(sz, sizeof(float));
+    check_rc(MXNDArraySyncCopyToCPU(outs[i], buf, sz),
+             "MXNDArraySyncCopyToCPU");
+    check_rc(MXNDArrayCreate(dims, ndim, 1, 0, 0, &copy),
+             "MXNDArrayCreate");
+    check_rc(MXNDArraySyncCopyFromCPU(copy, buf, sz),
+             "MXNDArraySyncCopyFromCPU");
+    SET_VECTOR_ELT(result, i, wrap_nd(copy));
+  }
+  UNPROTECT(1);
+  return result;
+}
+
+static const R_CallMethodDef call_methods[] = {
+    {"mxr_version", (DL_FUNC)&mxr_version, 0},
+    {"mxr_seed", (DL_FUNC)&mxr_seed, 1},
+    {"mxr_nd_array", (DL_FUNC)&mxr_nd_array, 2},
+    {"mxr_nd_to_array", (DL_FUNC)&mxr_nd_to_array, 1},
+    {"mxr_nd_shape", (DL_FUNC)&mxr_nd_shape, 1},
+    {"mxr_op_invoke", (DL_FUNC)&mxr_op_invoke, 4},
+    {"mxr_symbol_from_json", (DL_FUNC)&mxr_symbol_from_json, 1},
+    {"mxr_symbol_arguments", (DL_FUNC)&mxr_symbol_arguments, 1},
+    {"mxr_executor_bind", (DL_FUNC)&mxr_executor_bind, 2},
+    {"mxr_executor_forward", (DL_FUNC)&mxr_executor_forward, 1},
+    {NULL, NULL, 0}};
+
+void R_init_mxtpu(DllInfo *dll) {
+  R_registerRoutines(dll, NULL, call_methods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

@@ -18,6 +18,7 @@
 #include <vector>
 
 #include "../../include/mxtpu/c_api.h"
+#include "embed_python.h"
 
 namespace {
 
@@ -51,16 +52,7 @@ class GIL {
   PyGILState_STATE state_;
 };
 
-bool ensure_python() {
-  static std::once_flag once;
-  std::call_once(once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      PyEval_SaveThread();
-    }
-  });
-  return true;
-}
+using mxtpu_native::ensure_python;
 
 PyObject *impl_module() {
   static PyObject *mod = nullptr;

@@ -16,6 +16,7 @@
 #include <vector>
 
 #include "../../include/mxtpu/c_predict_api.h"
+#include "embed_python.h"
 
 namespace {
 
@@ -54,17 +55,7 @@ class GIL {
   PyGILState_STATE state_;
 };
 
-bool ensure_python() {
-  static std::once_flag once;
-  std::call_once(once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // release the GIL acquired by initialization so GIL guards work
-      PyEval_SaveThread();
-    }
-  });
-  return true;
-}
+using mxtpu_native::ensure_python;
 
 PyObject *impl_module() {
   static PyObject *mod = nullptr;

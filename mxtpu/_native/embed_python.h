@@ -1,0 +1,41 @@
+// Shared embedded-CPython bootstrap for the native ABI libraries
+// (c_api.cc, c_predict_api.cc — keep this the single copy; the
+// amalgamation inlines it into the mobile bundle).
+#ifndef MXTPU_NATIVE_EMBED_PYTHON_H_
+#define MXTPU_NATIVE_EMBED_PYTHON_H_
+
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include <mutex>
+
+namespace mxtpu_native {
+
+// Initialize the embedded interpreter exactly once, releasing the GIL so
+// PyGILState guards work from any thread afterwards.
+//
+// When the enclosing library is dlopened from a non-Python host (perl, R,
+// a mobile app...), libpython's symbols are not in the global namespace,
+// so Python's own C-extension modules (math, _ctypes, numpy) fail to
+// resolve them. Promote the already-mapped libpython to RTLD_GLOBAL
+// before initializing.
+inline bool ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Dl_info info;
+      if (dladdr(reinterpret_cast<void *>(&Py_Initialize), &info) &&
+          info.dli_fname) {
+        dlopen(info.dli_fname, RTLD_LAZY | RTLD_GLOBAL | RTLD_NOLOAD);
+      }
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
+  return true;
+}
+
+}  // namespace mxtpu_native
+
+#endif  // MXTPU_NATIVE_EMBED_PYTHON_H_

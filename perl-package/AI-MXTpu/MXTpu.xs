@@ -1,0 +1,243 @@
+/* XS glue: Perl <-> mxtpu core C ABI (include/mxtpu/c_api.h).
+ *
+ * Reference counterpart: the reference perl-package binds through
+ * swig-generated wrappers over c_api.h; this is the same layer hand-rolled
+ * for the predict + imperative surface. Handles cross into Perl as
+ * opaque IVs (pointer-sized integers) wrapped by lib/AI/MXTpu.pm.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "include/mxtpu/c_api.h"
+
+static void croak_on_fail(pTHX_ int rc, const char *what) {
+  if (rc != 0) {
+    croak("%s failed: %s", what, MXGetLastError());
+  }
+}
+
+MODULE = AI::MXTpu  PACKAGE = AI::MXTpu
+
+PROTOTYPES: DISABLE
+
+int
+_version()
+  CODE:
+    {
+      int v = 0;
+      croak_on_fail(aTHX_ MXGetVersion(&v), "MXGetVersion");
+      RETVAL = v;
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_seed(int seed)
+  CODE:
+    croak_on_fail(aTHX_ MXRandomSeed(seed), "MXRandomSeed");
+
+IV
+_nd_create(AV *shape_av)
+  CODE:
+    {
+      mx_uint ndim = (mx_uint)(av_len(shape_av) + 1);
+      mx_uint shape[32];
+      mx_uint i;
+      NDArrayHandle h = NULL;
+      for (i = 0; i < ndim; ++i) {
+        SV **sv = av_fetch(shape_av, i, 0);
+        shape[i] = (mx_uint)SvUV(*sv);
+      }
+      croak_on_fail(aTHX_ MXNDArrayCreate(shape, ndim, 1, 0, 0, &h),
+                    "MXNDArrayCreate");
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_nd_set(IV handle, AV *data_av)
+  CODE:
+    {
+      size_t n = (size_t)(av_len(data_av) + 1);
+      float *buf;
+      size_t i;
+      Newx(buf, n, float);
+      for (i = 0; i < n; ++i) {
+        SV **sv = av_fetch(data_av, i, 0);
+        buf[i] = (float)SvNV(*sv);
+      }
+      {
+        int rc = MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, handle),
+                                          buf, n);
+        Safefree(buf);
+        croak_on_fail(aTHX_ rc, "MXNDArraySyncCopyFromCPU");
+      }
+    }
+
+AV *
+_nd_get(IV handle)
+  CODE:
+    {
+      NDArrayHandle h = INT2PTR(NDArrayHandle, handle);
+      mx_uint ndim = 0;
+      const mx_uint *dims = NULL;
+      size_t n = 1, i;
+      float *buf;
+      croak_on_fail(aTHX_ MXNDArrayGetShape(h, &ndim, &dims),
+                    "MXNDArrayGetShape");
+      for (i = 0; i < ndim; ++i) n *= dims[i];
+      Newx(buf, n, float);
+      {
+        int rc = MXNDArraySyncCopyToCPU(h, buf, n);
+        if (rc != 0) {
+          Safefree(buf);
+          croak("MXNDArraySyncCopyToCPU failed: %s", MXGetLastError());
+        }
+      }
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < n; ++i) av_push(RETVAL, newSVnv(buf[i]));
+      Safefree(buf);
+    }
+  OUTPUT:
+    RETVAL
+
+AV *
+_nd_shape(IV handle)
+  CODE:
+    {
+      mx_uint ndim = 0, i;
+      const mx_uint *dims = NULL;
+      croak_on_fail(aTHX_ MXNDArrayGetShape(INT2PTR(NDArrayHandle, handle),
+                                            &ndim, &dims),
+                    "MXNDArrayGetShape");
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < ndim; ++i) av_push(RETVAL, newSVuv(dims[i]));
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_nd_free(IV handle)
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, handle));
+
+AV *
+_invoke(const char *op_name, AV *in_av, HV *params_hv)
+  CODE:
+    {
+      OpHandle op = NULL;
+      NDArrayHandle inputs[64];
+      const char *keys[64];
+      const char *vals[64];
+      int n_in = (int)(av_len(in_av) + 1);
+      int n_par = 0;
+      int num_out = 0, i;
+      NDArrayHandle *outputs = NULL;
+      HE *he;
+      croak_on_fail(aTHX_ MXGetOpHandle(op_name, &op), "MXGetOpHandle");
+      for (i = 0; i < n_in; ++i) {
+        SV **sv = av_fetch(in_av, i, 0);
+        inputs[i] = INT2PTR(NDArrayHandle, SvIV(*sv));
+      }
+      hv_iterinit(params_hv);
+      while ((he = hv_iternext(params_hv)) != NULL) {
+        STRLEN klen;
+        keys[n_par] = HePV(he, klen);
+        vals[n_par] = SvPV_nolen(HeVAL(he));
+        ++n_par;
+      }
+      croak_on_fail(aTHX_ MXImperativeInvoke(op, n_in, inputs, &num_out,
+                                             &outputs, n_par, keys, vals),
+                    "MXImperativeInvoke");
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < num_out; ++i) {
+        av_push(RETVAL, newSViv(PTR2IV(outputs[i])));
+      }
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+_sym_from_json(const char *json)
+  CODE:
+    {
+      SymbolHandle h = NULL;
+      croak_on_fail(aTHX_ MXSymbolCreateFromJSON(json, &h),
+                    "MXSymbolCreateFromJSON");
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+AV *
+_sym_arguments(IV handle)
+  CODE:
+    {
+      mx_uint n = 0, i;
+      const char **names = NULL;
+      croak_on_fail(aTHX_ MXSymbolListArguments(
+                        INT2PTR(SymbolHandle, handle), &n, &names),
+                    "MXSymbolListArguments");
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < n; ++i) av_push(RETVAL, newSVpv(names[i], 0));
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_sym_free(IV handle)
+  CODE:
+    MXSymbolFree(INT2PTR(SymbolHandle, handle));
+
+IV
+_executor_bind(IV sym_handle, AV *args_av)
+  CODE:
+    {
+      NDArrayHandle args[128];
+      NDArrayHandle grads[128];
+      mx_uint reqs[128];
+      mx_uint n = (mx_uint)(av_len(args_av) + 1), i;
+      ExecutorHandle ex = NULL;
+      for (i = 0; i < n; ++i) {
+        SV **sv = av_fetch(args_av, i, 0);
+        args[i] = INT2PTR(NDArrayHandle, SvIV(*sv));
+        grads[i] = NULL;
+        reqs[i] = 0;  /* inference binding: no gradients */
+      }
+      croak_on_fail(aTHX_ MXExecutorBind(INT2PTR(SymbolHandle, sym_handle),
+                                         1, 0, n, args, grads, reqs, 0,
+                                         NULL, &ex),
+                    "MXExecutorBind");
+      RETVAL = PTR2IV(ex);
+    }
+  OUTPUT:
+    RETVAL
+
+AV *
+_executor_forward(IV ex_handle)
+  CODE:
+    {
+      ExecutorHandle ex = INT2PTR(ExecutorHandle, ex_handle);
+      mx_uint n = 0, i;
+      NDArrayHandle *outs = NULL;
+      croak_on_fail(aTHX_ MXExecutorForward(ex, 0), "MXExecutorForward");
+      croak_on_fail(aTHX_ MXExecutorOutputs(ex, &n, &outs),
+                    "MXExecutorOutputs");
+      RETVAL = newAV();
+      sv_2mortal((SV *)RETVAL);
+      for (i = 0; i < n; ++i) av_push(RETVAL, newSViv(PTR2IV(outs[i])));
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_executor_free(IV ex_handle)
+  CODE:
+    MXExecutorFree(INT2PTR(ExecutorHandle, ex_handle));

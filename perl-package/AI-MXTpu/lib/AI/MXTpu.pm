@@ -1,0 +1,131 @@
+package AI::MXTpu;
+
+# Perl binding for the mxtpu framework over the core C ABI.
+#
+# Reference counterpart: perl-package/AI-MXNet. Scope here is the
+# inference + imperative surface (NDArray, operator invoke, Symbol
+# load, Executor forward) — enough to load a trained model and predict
+# from Perl, proving the ABI is binding-ready. Training stays in
+# Python/C++ where the full Optimizer/autograd surfaces live.
+#
+# Usage:
+#   use AI::MXTpu;
+#   my $a = AI::MXTpu::NDArray->from_array([1, 2, 3], [3]);
+#   my ($b) = AI::MXTpu::op('square', [$a]);
+#   print join(',', @{$b->to_array}), "\n";   # 1,4,9
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXTpu', $VERSION);
+
+sub version { return _version(); }
+sub seed    { my ($s) = @_; _seed($s); }
+
+# invoke an operator: op($name, \@ndarrays, \%params) -> list of NDArrays
+sub op {
+    my ($name, $inputs, $params) = @_;
+    $params ||= {};
+    my @in_handles = map { $_->{handle} } @$inputs;
+    my %str_params = map { $_ => "" . $params->{$_} } keys %$params;
+    my $outs = _invoke($name, \@in_handles, \%str_params);
+    return map { AI::MXTpu::NDArray->_wrap($_) } @$outs;
+}
+
+package AI::MXTpu::NDArray;
+
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $shape) = @_;
+    my $h = AI::MXTpu::_nd_create($shape);
+    return bless { handle => $h, own => 1 }, $class;
+}
+
+sub from_array {
+    my ($class, $data, $shape) = @_;
+    my $self = $class->new($shape);
+    AI::MXTpu::_nd_set($self->{handle}, $data);
+    return $self;
+}
+
+sub _wrap {
+    my ($class, $h) = @_;
+    return bless { handle => $h, own => 1 }, $class;
+}
+
+sub set      { my ($self, $data) = @_; AI::MXTpu::_nd_set($self->{handle}, $data); }
+sub to_array { my ($self) = @_; return AI::MXTpu::_nd_get($self->{handle}); }
+sub shape    { my ($self) = @_; return AI::MXTpu::_nd_shape($self->{handle}); }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTpu::_nd_free($self->{handle}) if $self->{own};
+}
+
+package AI::MXTpu::Symbol;
+
+use strict;
+use warnings;
+
+sub from_json {
+    my ($class, $json) = @_;
+    my $h = AI::MXTpu::_sym_from_json($json);
+    return bless { handle => $h }, $class;
+}
+
+sub load {
+    my ($class, $fname) = @_;
+    open my $fh, '<', $fname or die "cannot open $fname: $!";
+    local $/;
+    my $json = <$fh>;
+    close $fh;
+    return $class->from_json($json);
+}
+
+sub list_arguments {
+    my ($self) = @_;
+    return AI::MXTpu::_sym_arguments($self->{handle});
+}
+
+# Bind for inference: args is an arrayref of NDArrays in
+# list_arguments() order.
+sub bind_executor {
+    my ($self, $args) = @_;
+    my @handles = map { $_->{handle} } @$args;
+    my $ex = AI::MXTpu::_executor_bind($self->{handle}, \@handles);
+    return bless { handle => $ex }, 'AI::MXTpu::Executor';
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTpu::_sym_free($self->{handle}) if $self->{handle};
+}
+
+package AI::MXTpu::Executor;
+
+use strict;
+use warnings;
+
+sub forward {
+    my ($self) = @_;
+    my $outs = AI::MXTpu::_executor_forward($self->{handle});
+    # executor outputs are library-owned; copy them into owned arrays
+    return map {
+        my $tmp = bless { handle => $_, own => 0 }, 'AI::MXTpu::NDArray';
+        my $copy = AI::MXTpu::NDArray->from_array($tmp->to_array,
+                                                  $tmp->shape);
+        $copy;
+    } @$outs;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTpu::_executor_free($self->{handle}) if $self->{handle};
+}
+
+1;

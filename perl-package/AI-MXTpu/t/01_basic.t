@@ -1,0 +1,36 @@
+# Basic binding tests: version, NDArray round-trip, operator invoke,
+# Symbol-from-JSON + Executor forward.
+use strict;
+use warnings;
+use Test::More tests => 7;
+use FindBin;
+use lib "$FindBin::Bin/../blib/lib", "$FindBin::Bin/../blib/arch";
+
+use AI::MXTpu;
+
+ok(AI::MXTpu::version() >= 20000, 'version');
+
+AI::MXTpu::seed(0);
+
+my $a = AI::MXTpu::NDArray->from_array([1, 2, 3, 4], [2, 2]);
+is_deeply($a->shape, [2, 2], 'shape round-trip');
+is_deeply($a->to_array, [1, 2, 3, 4], 'data round-trip');
+
+my ($sq) = AI::MXTpu::op('square', [$a]);
+is_deeply($sq->to_array, [1, 4, 9, 16], 'imperative square');
+
+my ($s) = AI::MXTpu::op('sum', [$a], { axis => 1 });
+is_deeply($s->to_array, [3, 7], 'imperative sum with param');
+
+# symbolic predict: y = 2*x through a saved-symbol round trip done in
+# python (tojson), loaded here
+my $json = `python -c 'import jax; jax.config.update("jax_platforms","cpu"); import mxtpu.symbol as sym; s = sym.broadcast_mul(sym.Variable("x"), sym.Variable("w")); print(s.tojson())'`;
+ok($json =~ /broadcast_mul/, 'symbol json from python');
+my $sym = AI::MXTpu::Symbol->from_json($json);
+my $args = $sym->list_arguments;
+my @arg_arrays = map {
+    AI::MXTpu::NDArray->from_array($_ eq 'w' ? [2, 2, 2] : [1, 2, 3], [3])
+} @$args;
+my $ex = $sym->bind_executor(\@arg_arrays);
+my ($out) = $ex->forward;
+is_deeply($out->to_array, [2, 4, 6], 'executor forward');
