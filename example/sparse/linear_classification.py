@@ -57,6 +57,16 @@ def main():
     acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
     print("train accuracy: %.3f" % acc)
     assert acc > 0.8, acc
+
+    # the storage-type pass flowed through Module's simple_bind: the data
+    # slot is CSR, and the weight + its gradient are row_sparse
+    from mxtpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+    ex = mod._exec_group.execs[0]
+    assert isinstance(ex.arg_dict["data"], CSRNDArray), type(ex.arg_dict["data"])
+    assert isinstance(ex.arg_dict["weight"], RowSparseNDArray)
+    assert isinstance(ex.grad_dict["weight"], RowSparseNDArray)
+    arg_st, _, _ = out.infer_storage_type()
+    print("arg stypes:", dict(zip(out.list_arguments(), arg_st)))
     return 0
 
 

@@ -86,20 +86,40 @@ class Executor:
 
     # -- binding constructors ---------------------------------------------
     @staticmethod
-    def _simple_bind(sym, ctx, grad_req, type_dict, shape_kwargs):
+    def _simple_bind(sym, ctx, grad_req, type_dict, shape_kwargs,
+                     stype_dict=None):
         arg_names = sym.list_arguments()
         aux_names = sym.list_auxiliary_states()
         arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**shape_kwargs)
         type_dict = type_dict or {}
+        # storage types: InferStorageType pass over var declarations,
+        # overridden by an explicit stype_dict (reference simple_bind's
+        # stype_dict argument). Sparse-typed args materialize as CSR /
+        # RowSparse NDArrays so sparse-aware consumers (lazy updates,
+        # row_sparse_pull) engage; grads of row_sparse params are
+        # row_sparse too (reference: BackwardStorageType of sparse dot).
+        arg_stypes, _out_st, _aux_st = sym.infer_storage_type(
+            **(stype_dict or {}))
+        stype_of = dict(zip(arg_names, arg_stypes))
         arg_dict, grad_dict = {}, {}
         req_dict = _normalize_grad_req(grad_req, arg_names)
         for name, shape in zip(arg_names, arg_shapes):
             if shape is None:
                 raise ValueError("could not infer shape for argument %r" % name)
             dt = canonical_dtype(type_dict.get(name, _np.float32))
-            arg_dict[name] = nd.zeros(shape, ctx=ctx, dtype=dt)
+            st = stype_of.get(name, "default")
+            if st != "default":
+                from .ndarray import sparse as _sparse
+                arg_dict[name] = _sparse.zeros(st, shape, ctx=ctx, dtype=dt)
+            else:
+                arg_dict[name] = nd.zeros(shape, ctx=ctx, dtype=dt)
             if req_dict.get(name, "null") != "null":
-                grad_dict[name] = nd.zeros(shape, ctx=ctx, dtype=dt)
+                if st == "row_sparse":
+                    from .ndarray import sparse as _sparse
+                    grad_dict[name] = _sparse.zeros(st, shape, ctx=ctx,
+                                                    dtype=dt)
+                else:
+                    grad_dict[name] = nd.zeros(shape, ctx=ctx, dtype=dt)
         aux_dict = {}
         for name, shape in zip(aux_names, aux_shapes):
             if shape is None:
@@ -139,10 +159,10 @@ class Executor:
     # -- execution ---------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
-            if isinstance(v, NDArray):
-                self.arg_dict[k]._data = v._data
-            else:
-                self.arg_dict[k]._data = jnp.asarray(v)
+            # sparse-aware rebind: same-stype sources hand their
+            # compressed metadata over, anything else invalidates it for
+            # lazy recompute (NDArray._assign_value)
+            self.arg_dict[k]._assign_value(v)
         self._key, sub = jax.random.split(self._key)
         arg_vals = tuple(self.arg_dict[n]._data for n in self._arg_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
@@ -180,6 +200,13 @@ class Executor:
                 tgt._data = tgt._data + g
             else:
                 tgt._data = g
+            if hasattr(tgt, "_aux"):
+                # sparse gradient slot: XLA computed a dense cotangent
+                # (the fused fwd+vjp is one dense program by design);
+                # invalidate the compressed metadata so sparse-aware
+                # consumers (lazy optimizer updates, row_sparse_pull)
+                # lazily recover the true stored rows from the value
+                tgt._aux = None
 
     @property
     def outputs(self):
@@ -204,8 +231,10 @@ class Executor:
                          allow_extra_params=False):
         for k, v in arg_params.items():
             if k in self.arg_dict:
-                self.arg_dict[k]._data = v._data.astype(
-                    self.arg_dict[k]._data.dtype)
+                dst = self.arg_dict[k]
+                if v._data.dtype != dst._data.dtype:
+                    v = _wrap(v._data.astype(dst._data.dtype), dst._ctx)
+                dst._assign_value(v)
             elif not allow_extra_params:
                 raise ValueError("unknown argument %r" % k)
         if aux_params:

@@ -16,6 +16,21 @@ degenerates to rank 0 of 1, exactly like launching the reference without a
 scheduler. The parameter-server *capability* (server-side optimizer via
 set_optimizer) is kept: the updater runs where the store lives, which on
 TPU is simply the device copy of the weights.
+
+**dist_async behavior statement** (asserted by tests/nightly/
+dist_worker.py): in the reference, 'dist_async' relaxes 'dist_sync' by
+letting the ps-lite server apply each worker's push immediately
+(kvstore_dist_server.h:339,462), trading gradient staleness for hiding
+parameter-server round-trip latency. The SPMD/XLA runtime has no server
+and no per-key round-trips — cross-host reduction is a compiled psum over
+ICI/DCN inside the training step — so the latency async exists to hide is
+gone, and ``create('dist_async')`` intentionally executes the same
+synchronous program as ``create('dist_sync')``. This is sound because
+async consistency is a *relaxation*: every synchronous schedule is a legal
+async schedule (staleness 0), so any algorithm correct under dist_async is
+correct here; the updater still runs where the store lives (the
+server-side-update capability), and rank/num_workers reflect the process
+group identically in both modes.
 """
 from __future__ import annotations
 
@@ -145,7 +160,7 @@ class KVStore:
                 if isinstance(arr, RowSparseNDArray):
                     arr._data = rsp._data
                     arr._aux = {kk: vv.copy()
-                                for kk, vv in rsp._aux.items()}
+                                for kk, vv in rsp._ensure_aux().items()}
                 elif arr.shape == gathered.shape:
                     arr._data = gathered._data
                 else:

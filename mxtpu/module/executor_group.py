@@ -47,9 +47,9 @@ def _load_general(data, targets):
     for d_src, d_targets in zip(data, targets):
         for slice_idx, d_dst in d_targets:
             if d_src.shape[0] == d_dst.shape[0]:
-                d_dst._data = d_src._data
+                d_dst._assign_value(d_src)
             else:
-                d_dst._data = d_src[slice_idx]._data
+                d_dst._assign_value(d_src[slice_idx])
 
 
 class DataParallelExecutorGroup:

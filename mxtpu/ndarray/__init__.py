@@ -125,6 +125,18 @@ class NDArray:
     def copy(self):
         return _wrap(self._data, self._ctx)
 
+    def _assign_value(self, src):
+        """Rebind this array's value to ``src``'s (the executor /
+        module batch-feed primitive). Sparse-typed destinations keep
+        their compressed metadata coherent: same-stype sources hand it
+        over, any other source invalidates it so the sparse accessors
+        recompute it lazily from the dense value (see
+        BaseSparseNDArray._ensure_aux)."""
+        self._data = src._data if isinstance(src, NDArray) \
+            else jnp.asarray(src)
+        if hasattr(self, "_aux"):
+            self._aux = src._aux if type(src) is type(self) else None
+
     def __array__(self, dtype=None):
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
@@ -342,7 +354,10 @@ def _invoke_impl(op, inputs, params):
     for in_idx, out_idx in op.aux_update.items():
         if in_idx < len(nd_inputs) and nd_inputs[in_idx] is not None:
             nd_inputs[in_idx]._data = outs[out_idx]
-    if _ag.is_recording() and op.differentiable:
+    if _ag.is_recording():
+        # non-differentiable ops are recorded too (MXNet's tape has every
+        # node — needed by autograd.get_symbol); backward treats them as
+        # constants and propagates no gradient through them
         entry = _ag.TapeEntry(op=op, params=call_params,
                               inputs=nd_inputs, input_values=values,
                               outputs=out_nd, rng_key=rng_key)
@@ -464,7 +479,7 @@ def _save_entry(payload, k, v):
         # sparse entries keep their compressed aux arrays, mirroring the
         # reference's stype-tagged chunks (src/ndarray/ndarray.cc:1515)
         payload[k + "::stype"] = _np.asarray(stype)
-        for aux_name, aux in v._aux.items():
+        for aux_name, aux in v._ensure_aux().items():
             payload[k + "::" + aux_name] = aux.asnumpy()
         payload[k + "::shape"] = _np.asarray(v.shape, _np.int64)
 

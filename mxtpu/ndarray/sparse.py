@@ -61,7 +61,15 @@ class BaseSparseNDArray(NDArray):
 
     def _aux_data(self, i):
         order = self._aux_names
-        return self._aux[order[i]]
+        return self._ensure_aux()[order[i]]
+
+    def _ensure_aux(self):
+        """Compressed metadata, recomputed lazily from the dense value
+        when an assignment invalidated it (``NDArray._assign_value`` with
+        a dense or different-stype source sets ``_aux = None``)."""
+        if self._aux is None:
+            self._aux = self._recompute_aux()
+        return self._aux
 
     def __repr__(self):
         return "\n<%s %s @%s>" % (type(self).__name__,
@@ -80,14 +88,15 @@ class BaseSparseNDArray(NDArray):
         raise NotImplementedError("scipy export not supported")
 
     def copy(self):
-        aux = {k: _wrap(v._data, self._ctx) for k, v in self._aux.items()}
+        aux = {k: _wrap(v._data, self._ctx)
+               for k, v in self._ensure_aux().items()}
         return type(self)(self._data, aux, self._ctx)
 
     def astype(self, dtype, copy=True):
         """Cast values, preserving storage type and index metadata."""
         d = canonical_dtype(dtype)
         aux = {}
-        for k, v in self._aux.items():
+        for k, v in self._ensure_aux().items():
             # index-typed aux arrays keep their integer dtype
             aux[k] = _wrap(v._data if k in ("indices", "indptr")
                            else v._data.astype(d), self._ctx)
@@ -100,13 +109,14 @@ class BaseSparseNDArray(NDArray):
                 if type(other) is not type(self):
                     raise TypeError(
                         "copyto between different sparse stypes")
-                other._aux = {k: v.copy() for k, v in self._aux.items()}
+                other._aux = {k: v.copy()
+                              for k, v in self._ensure_aux().items()}
             return other
         return self.as_in_context(other)
 
     @property
     def nnz(self):
-        return int(self._aux["data"].shape[0])
+        return int(self._ensure_aux()["data"].shape[0])
 
 
 class CSRNDArray(BaseSparseNDArray):
@@ -119,17 +129,26 @@ class CSRNDArray(BaseSparseNDArray):
     @property
     def data(self):
         """Stored values, shape (nnz,)."""
-        return self._aux["data"]
+        return self._ensure_aux()["data"]
 
     @property
     def indices(self):
         """Column index per stored value, shape (nnz,)."""
-        return self._aux["indices"]
+        return self._ensure_aux()["indices"]
 
     @property
     def indptr(self):
         """Row pointer array, shape (rows+1,)."""
-        return self._aux["indptr"]
+        return self._ensure_aux()["indptr"]
+
+    def _recompute_aux(self):
+        dense = _np.asarray(self.asnumpy())
+        rows, cols = _np.nonzero(dense)
+        counts = _np.bincount(rows, minlength=dense.shape[0])
+        indptr = _np.concatenate([[0], _np.cumsum(counts)])
+        return {"data": _dense_array(dense[rows, cols]),
+                "indices": _dense_array(cols.astype(_np.int64)),
+                "indptr": _dense_array(indptr.astype(_np.int64))}
 
     def __getitem__(self, key):
         if isinstance(key, slice):
@@ -152,16 +171,23 @@ class RowSparseNDArray(BaseSparseNDArray):
     @property
     def data(self):
         """Stored rows, shape (num_stored, *shape[1:])."""
-        return self._aux["data"]
+        return self._ensure_aux()["data"]
 
     @property
     def indices(self):
         """Stored row ids, ascending, shape (num_stored,)."""
-        return self._aux["indices"]
+        return self._ensure_aux()["indices"]
 
     @property
     def nnz(self):
-        return int(self._aux["indices"].shape[0])
+        return int(self._ensure_aux()["indices"].shape[0])
+
+    def _recompute_aux(self):
+        dense = _np.asarray(self.asnumpy())
+        flat = dense.reshape(dense.shape[0], -1)
+        rows = _np.nonzero(flat.any(axis=1))[0]
+        return {"indices": _dense_array(rows.astype(_np.int64)),
+                "data": _dense_array(dense[rows])}
 
     def retain(self, indices):
         return retain(self, indices)

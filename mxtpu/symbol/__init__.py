@@ -196,6 +196,30 @@ class Symbol:
         aux_names = self.list_auxiliary_states()
         return (arg_shapes, out_shapes, [shapes.get(n) for n in aux_names])
 
+    def infer_storage_type(self, *args, **kwargs):
+        """Infer storage types ("default"/"csr"/"row_sparse") for all
+        arguments, outputs and aux states (the reference's
+        InferStorageType pass, src/executor/infer_graph_attr_pass.cc).
+
+        Input stypes come from ``var(stype=...)`` declarations, overridden
+        by positional (list_arguments order) or keyword stypes given here.
+        Ops without a sparse rule produce "default" outputs — the dense
+        fallback, which is free on the dense-backed TPU representation.
+        """
+        from .storage_type import infer_graph_storage_types
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = s
+        known.update({k: v for k, v in kwargs.items() if v is not None})
+        var_stypes, out_stypes = infer_graph_storage_types(self, known)
+        arg_stypes = [var_stypes.get(n, "default") for n in arg_names]
+        aux_stypes = [var_stypes.get(n, "default")
+                      for n in self.list_auxiliary_states()]
+        return arg_stypes, out_stypes, aux_stypes
+
     def infer_type(self, *args, **kwargs):
         arg_names = self.list_arguments()
         dtypes = {}
@@ -279,10 +303,11 @@ class Symbol:
 
     # -- binding -----------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    **kwargs):
+                    stype_dict=None, **kwargs):
         from ..executor import Executor
         return Executor._simple_bind(self, ctx or current_context(),
-                                     grad_req, type_dict, kwargs)
+                                     grad_req, type_dict, kwargs,
+                                     stype_dict=stype_dict)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -469,6 +494,8 @@ def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         # accept Initializer instances or their dumps() JSON string
         node.attrs["__init__"] = init if isinstance(init, str) \
             else init.dumps()
+    if stype is not None:
+        node.attrs["__stype__"] = stype
     node.attrs.update(kwargs)
     return Symbol([(node, 0)])
 
@@ -497,6 +524,8 @@ def load_json(json_str):
             for k, v in jn.get("var_attrs", {}).items():
                 if k == "__dtype__":
                     node.attrs[k] = _np.dtype(v)
+                elif k == "__stype__":
+                    node.attrs[k] = v  # plain string, not a python literal
                 elif isinstance(v, str) and k.startswith("__"):
                     node.attrs[k] = eval(v, {"__builtins__": {}}, {})  # noqa: S307
                 else:

@@ -1,0 +1,111 @@
+"""Symbolic storage-type inference (the reference's InferStorageType pass,
+src/executor/infer_graph_attr_pass.cc + exec_pass.h:151-179): stype
+declarations on variables propagate through per-op rules with dense
+fallback, and simple_bind materializes sparse-typed args and grads."""
+import numpy as np
+
+import mxtpu as mx
+import mxtpu.ndarray as nd
+import mxtpu.symbol as sym
+from mxtpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+
+def test_var_stype_declared():
+    x = sym.var("x", stype="csr")
+    arg_st, out_st, _ = x.infer_storage_type()
+    assert arg_st == ["csr"]
+    assert out_st == ["csr"]
+
+
+def test_dot_rules():
+    data = sym.var("data", stype="csr")
+    w = sym.var("w")
+    # dot(csr, dense) -> dense
+    out = sym.dot(data, w)
+    _, out_st, _ = out.infer_storage_type()
+    assert out_st == ["default"]
+    # dot(csr.T, dense) -> row_sparse (reference dot-inl.h)
+    outT = sym.dot(data, w, transpose_a=True)
+    _, out_stT, _ = outT.infer_storage_type()
+    assert out_stT == ["row_sparse"]
+
+
+def test_elemwise_and_fallback():
+    a = sym.var("a", stype="row_sparse")
+    b = sym.var("b", stype="row_sparse")
+    _, out_st, _ = (a + b).infer_storage_type()
+    assert out_st == ["row_sparse"]
+    # zero-preserving unary keeps stype
+    _, out_st, _ = sym.negative(a).infer_storage_type()
+    assert out_st == ["row_sparse"]
+    # non-zero-preserving op falls back to dense
+    _, out_st, _ = sym.exp(a).infer_storage_type()
+    assert out_st == ["default"]
+    # mixing with dense falls back for addition
+    c = sym.var("c")
+    _, out_st, _ = (a + c).infer_storage_type()
+    assert out_st == ["default"]
+    # but multiplication by rsp preserves the zero structure
+    _, out_st, _ = sym.broadcast_mul(a, c).infer_storage_type()
+    assert out_st == ["row_sparse"]
+
+
+def test_infer_storage_type_overrides():
+    x = sym.var("x")
+    y = sym.var("y")
+    out = sym.dot(x, y, transpose_a=True)
+    # positional + keyword overrides, reference infer_storage_type API
+    arg_st, out_st, _ = out.infer_storage_type("csr", None)
+    assert arg_st == ["csr", "default"]
+    assert out_st == ["row_sparse"]
+    arg_st, out_st, _ = out.infer_storage_type(x="csr")
+    assert out_st == ["row_sparse"]
+
+
+def test_simple_bind_materializes_sparse():
+    data = sym.var("data", stype="csr")
+    w = sym.var("w", stype="row_sparse")
+    out = sym.dot(data, w)
+    ex = out.simple_bind(ctx=mx.cpu(), grad_req={"w": "write"},
+                         data=(4, 6), w=(6, 3))
+    assert isinstance(ex.arg_dict["data"], CSRNDArray)
+    assert isinstance(ex.arg_dict["w"], RowSparseNDArray)
+    assert isinstance(ex.grad_dict["w"], RowSparseNDArray)
+
+    # feed a CSR batch; metadata travels into the bound slot
+    dense = np.zeros((4, 6), np.float32)
+    dense[0, 1] = 2.0
+    dense[2, 4] = 3.0
+    batch = nd.array(dense).tostype("csr")
+    wv = np.random.RandomState(0).randn(6, 3).astype(np.float32)
+    ex.arg_dict["w"][:] = wv
+    outs = ex.forward(is_train=True, data=batch)
+    np.testing.assert_allclose(outs[0].asnumpy(), dense @ wv, rtol=1e-5)
+    assert ex.arg_dict["data"].indices.size == 2  # metadata propagated
+
+    ex.backward(nd.array(np.ones((4, 3), np.float32)))
+    g = ex.grad_dict["w"]
+    assert isinstance(g, RowSparseNDArray)
+    np.testing.assert_allclose(g.asnumpy(), dense.T @ np.ones((4, 3)),
+                               rtol=1e-5)
+    # lazily-recovered metadata exposes the TRUE stored rows: the weight
+    # grad of dot(csr, w) is nonzero only on the batch's nonzero columns
+    np.testing.assert_array_equal(np.sort(g.indices.asnumpy()), [1, 4])
+    assert g.nnz == 2
+
+
+def test_stype_dict_override_in_simple_bind():
+    x = sym.var("x")
+    out = sym.negative(x)
+    ex = out.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         stype_dict={"x": "row_sparse"}, x=(3, 2))
+    assert isinstance(ex.arg_dict["x"], RowSparseNDArray)
+
+
+def test_stype_survives_json_roundtrip():
+    x = sym.var("x", stype="csr")
+    out = sym.dot(x, sym.var("w"), transpose_a=True)
+    js = out.tojson()
+    loaded = sym.load_json(js)
+    _, out_st, _ = loaded.infer_storage_type()
+    assert out_st == ["row_sparse"]
