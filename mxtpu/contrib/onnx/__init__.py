@@ -1,18 +1,10 @@
 """contrib.onnx (reference python/mxnet/contrib/onnx): ONNX graph import.
-The onnx package is not available in this environment; the surface is
-kept so callers get the same gating error the reference raises when onnx
-is missing (reference _import checks `import onnx` and errors)."""
 
+Unlike the reference, no external ``onnx`` package is required — the wire
+schema is vendored (onnx.proto -> onnx_pb2.py, parsed with the protobuf
+runtime), so ``import_model`` works on real .onnx files directly. See
+``_import.py`` for the supported operator subset.
+"""
+from ._import import import_model
 
-def import_model(model_file):
-    """Reference onnx_import entry: ONNX file -> (sym, arg_params,
-    aux_params). Requires the `onnx` package."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "ONNX import requires the `onnx` package (reference "
-            "contrib/onnx/_import has the same requirement)") from e
-    raise NotImplementedError(
-        "onnx graph translation lands once the onnx package is available "
-        "to validate against")
+__all__ = ["import_model"]

@@ -1,0 +1,307 @@
+"""ONNX graph import: ModelProto -> (Symbol, arg_params, aux_params).
+
+Capability parity with the reference's ``python/mxnet/contrib/onnx``
+importer (``_import/import_onnx.py`` + ``import_helper.py`` op
+translations). The reference depends on the external ``onnx`` package for
+protobuf parsing; this environment has the protobuf runtime but not onnx,
+so the wire schema is vendored (``onnx.proto`` -> ``onnx_pb2.py``) — real
+.onnx files parse directly, unknown fields are skipped by protobuf.
+
+Supported operator set (the classic-CNN/MLP subset the reference's
+importer was built for): Conv, Gemm, MatMul, Add/Sub/Mul/Div/Sum,
+Relu/Sigmoid/Tanh/Exp/Log/Sqrt/Abs/Neg, Softmax/LogSoftmax, MaxPool/
+AveragePool/GlobalAveragePool/GlobalMaxPool, BatchNormalization, Flatten,
+Reshape, Transpose, Concat, Dropout, Identity, Squeeze, Unsqueeze, Clip,
+Constant. Anything else raises with the op name.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ... import symbol as sym
+from . import onnx_pb2
+
+_DTYPES = {
+    1: _np.float32, 2: _np.uint8, 3: _np.int8, 6: _np.int32,
+    7: _np.int64, 9: _np.bool_, 10: _np.float16, 11: _np.float64,
+}
+
+
+def _tensor_to_np(t):
+    dtype = _DTYPES.get(t.data_type)
+    if dtype is None:
+        raise ValueError("unsupported ONNX tensor dtype %d" % t.data_type)
+    dims = tuple(t.dims)
+    if t.raw_data:
+        arr = _np.frombuffer(t.raw_data, dtype=dtype)
+    elif t.float_data:
+        arr = _np.asarray(list(t.float_data), dtype=dtype)
+    elif t.int64_data:
+        arr = _np.asarray(list(t.int64_data), dtype=dtype)
+    elif t.int32_data:
+        arr = _np.asarray(list(t.int32_data), dtype=dtype)
+    elif t.double_data:
+        arr = _np.asarray(list(t.double_data), dtype=dtype)
+    else:
+        arr = _np.zeros(dims, dtype)
+    return arr.reshape(dims) if dims else arr.reshape(())
+
+
+def _attrs(node):
+    out = {}
+    A = onnx_pb2.AttributeProto
+    for a in node.attribute:
+        if a.type == A.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == A.INT:
+            out[a.name] = int(a.i)
+        elif a.type == A.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == A.TENSOR:
+            out[a.name] = _tensor_to_np(a.t)
+        elif a.type == A.FLOATS:
+            out[a.name] = tuple(float(x) for x in a.floats)
+        elif a.type == A.INTS:
+            out[a.name] = tuple(int(x) for x in a.ints)
+        elif a.type == A.STRINGS:
+            out[a.name] = tuple(s.decode() for s in a.strings)
+        else:
+            raise ValueError("unsupported attribute type %d on %s"
+                             % (a.type, node.op_type))
+    return out
+
+
+def _pads_to_sym(pads, nspatial):
+    """ONNX pads = [x1_begin, x2_begin, ..., x1_end, ...]; the symmetric
+    case maps onto Convolution/Pooling pad=()."""
+    if not pads:
+        return (0,) * nspatial
+    begin, end = pads[:nspatial], pads[nspatial:]
+    if tuple(begin) != tuple(end):
+        raise ValueError("asymmetric ONNX pads %r not supported" % (pads,))
+    return tuple(begin)
+
+
+class _Importer:
+    def __init__(self, graph):
+        self.graph = graph
+        self.params = {}     # initializer name -> numpy
+        self.syms = {}       # value name -> Symbol
+        self.consumed = set()
+
+    def value(self, name):
+        if name in self.syms:
+            return self.syms[name]
+        if name in self.params:
+            # parameter tensor consumed as a graph input: becomes a var
+            self.consumed.add(name)
+            self.syms[name] = sym.var(name)
+            return self.syms[name]
+        self.syms[name] = sym.var(name)
+        return self.syms[name]
+
+    def np_value(self, name, what):
+        """Static (initializer/Constant) value required at build time."""
+        if name not in self.params:
+            raise ValueError("%s requires a static initializer input %r"
+                             % (what, name))
+        return self.params[name]
+
+    def run(self):
+        for t in self.graph.initializer:
+            self.params[t.name] = _tensor_to_np(t)
+        for node in self.graph.node:
+            handler = getattr(self, "op_" + node.op_type, None)
+            if handler is None:
+                raise NotImplementedError(
+                    "ONNX op %r is not supported by the importer"
+                    % node.op_type)
+            attrs = _attrs(node)
+            outs = handler(node, attrs)
+            if isinstance(outs, sym.Symbol):
+                outs = [outs]
+            for name, s in zip(node.output, outs):
+                self.syms[name] = s
+        outputs = [self.value(o.name) for o in self.graph.output]
+        out = outputs[0] if len(outputs) == 1 else sym.Group(outputs)
+        arg_params = {k: nd.array(v) for k, v in self.params.items()
+                      if k in self.consumed and
+                      k in set(out.list_arguments())}
+        aux_params = {k: nd.array(self.params[k])
+                      for k in set(out.list_auxiliary_states())
+                      if k in self.params}
+        return out, arg_params, aux_params
+
+    # ---- op translations -------------------------------------------------
+
+    def op_Conv(self, node, a):
+        kernel = a.get("kernel_shape")
+        nsp = len(kernel)
+        w = self.np_value(node.input[1], "Conv weight")
+        kwargs = dict(
+            data=self.value(node.input[0]),
+            weight=self.value(node.input[1]),
+            no_bias=len(node.input) <= 2,
+            kernel=tuple(kernel),
+            stride=tuple(a.get("strides", (1,) * nsp)),
+            dilate=tuple(a.get("dilations", (1,) * nsp)),
+            pad=_pads_to_sym(a.get("pads", ()), nsp),
+            num_filter=int(w.shape[0]),
+            num_group=int(a.get("group", 1)))
+        if len(node.input) > 2:
+            kwargs["bias"] = self.value(node.input[2])
+        return sym.Convolution(**kwargs)
+
+    def op_Gemm(self, node, a):
+        alpha, beta = a.get("alpha", 1.0), a.get("beta", 1.0)
+        A = self.value(node.input[0])
+        B = self.value(node.input[1])
+        out = sym.dot(A, B, transpose_a=bool(a.get("transA", 0)),
+                      transpose_b=bool(a.get("transB", 0)))
+        if alpha != 1.0:
+            out = out * alpha
+        if len(node.input) > 2:
+            C = self.value(node.input[2])
+            out = sym.broadcast_add(out, C * beta if beta != 1.0 else C)
+        return out
+
+    def op_MatMul(self, node, a):
+        return sym.dot(self.value(node.input[0]), self.value(node.input[1]))
+
+    def _binary(op_name):
+        def impl(self, node, a):
+            return getattr(sym, op_name)(self.value(node.input[0]),
+                                         self.value(node.input[1]))
+        return impl
+
+    op_Add = _binary("broadcast_add")
+    op_Sub = _binary("broadcast_sub")
+    op_Mul = _binary("broadcast_mul")
+    op_Div = _binary("broadcast_div")
+
+    def op_Sum(self, node, a):
+        return sym.add_n(*[self.value(i) for i in node.input])
+
+    def _unary(op_name):
+        def impl(self, node, a):
+            return getattr(sym, op_name)(self.value(node.input[0]))
+        return impl
+
+    op_Relu = _unary("relu")
+    op_Sigmoid = _unary("sigmoid")
+    op_Tanh = _unary("tanh")
+    op_Exp = _unary("exp")
+    op_Log = _unary("log")
+    op_Sqrt = _unary("sqrt")
+    op_Abs = _unary("abs")
+    op_Neg = _unary("negative")
+    op_Identity = _unary("identity")
+
+    def op_Softmax(self, node, a):
+        return sym.softmax(self.value(node.input[0]),
+                           axis=int(a.get("axis", -1)))
+
+    def op_LogSoftmax(self, node, a):
+        return sym.log_softmax(self.value(node.input[0]),
+                               axis=int(a.get("axis", -1)))
+
+    def _pool(self, node, a, pool_type, global_pool):
+        if global_pool:
+            return sym.Pooling(self.value(node.input[0]),
+                               pool_type=pool_type, global_pool=True,
+                               kernel=(1, 1))
+        kernel = tuple(a["kernel_shape"])
+        return sym.Pooling(
+            self.value(node.input[0]), pool_type=pool_type, kernel=kernel,
+            stride=tuple(a.get("strides", (1,) * len(kernel))),
+            pad=_pads_to_sym(a.get("pads", ()), len(kernel)),
+            count_include_pad=bool(a.get("count_include_pad", 0)))
+
+    def op_MaxPool(self, node, a):
+        return self._pool(node, a, "max", False)
+
+    def op_AveragePool(self, node, a):
+        return self._pool(node, a, "avg", False)
+
+    def op_GlobalAveragePool(self, node, a):
+        return self._pool(node, a, "avg", True)
+
+    def op_GlobalMaxPool(self, node, a):
+        return self._pool(node, a, "max", True)
+
+    def op_BatchNormalization(self, node, a):
+        return sym.BatchNorm(
+            data=self.value(node.input[0]),
+            gamma=self.value(node.input[1]),
+            beta=self.value(node.input[2]),
+            moving_mean=self.value(node.input[3]),
+            moving_var=self.value(node.input[4]),
+            eps=float(a.get("epsilon", 1e-5)),
+            momentum=float(a.get("momentum", 0.9)),
+            fix_gamma=False, use_global_stats=True)
+
+    def op_Flatten(self, node, a):
+        axis = int(a.get("axis", 1))
+        if axis != 1:
+            raise ValueError("Flatten axis %d not supported" % axis)
+        return sym.flatten(self.value(node.input[0]))
+
+    def op_Reshape(self, node, a):
+        shape = tuple(int(x) for x in
+                      self.np_value(node.input[1], "Reshape").reshape(-1))
+        return sym.reshape(self.value(node.input[0]), shape=shape)
+
+    def op_Transpose(self, node, a):
+        return sym.transpose(self.value(node.input[0]),
+                             axes=tuple(a.get("perm", ())) or None)
+
+    def op_Concat(self, node, a):
+        return sym.concat(*[self.value(i) for i in node.input],
+                          dim=int(a.get("axis", 1)))
+
+    def op_Dropout(self, node, a):
+        return sym.Dropout(self.value(node.input[0]),
+                           p=float(a.get("ratio", 0.5)))
+
+    def op_Squeeze(self, node, a):
+        axes = a.get("axes")
+        return sym.squeeze(self.value(node.input[0]),
+                           axis=tuple(axes) if axes else None)
+
+    def op_Unsqueeze(self, node, a):
+        out = self.value(node.input[0])
+        for ax in sorted(a["axes"]):
+            out = sym.expand_dims(out, axis=int(ax))
+        return out
+
+    def op_Clip(self, node, a):
+        lo = a.get("min")
+        hi = a.get("max")
+        if lo is None and len(node.input) > 1 and node.input[1]:
+            lo = float(self.np_value(node.input[1], "Clip min"))
+        if hi is None and len(node.input) > 2 and node.input[2]:
+            hi = float(self.np_value(node.input[2], "Clip max"))
+        return sym.clip(self.value(node.input[0]), a_min=lo, a_max=hi)
+
+    def op_Constant(self, node, a):
+        value = a["value"]
+        self.params[node.output[0]] = value
+        # also usable as a static input (Reshape shape etc.); emit no node
+        self.consumed.add(node.output[0])
+        return sym.var(node.output[0])
+
+
+def import_model(model_file):
+    """Import an ONNX model file (or ModelProto bytes).
+
+    Returns ``(sym, arg_params, aux_params)`` — the reference
+    onnx_mxnet.import_model contract."""
+    if isinstance(model_file, bytes):
+        data = model_file
+    else:
+        with open(model_file, "rb") as f:
+            data = f.read()
+    model = onnx_pb2.ModelProto()
+    model.ParseFromString(data)
+    return _Importer(model.graph).run()

@@ -1,0 +1,170 @@
+"""ONNX importer tests: models are constructed with the vendored pb2
+schema (no external onnx package), imported, and checked numerically
+against numpy. Reference counterpart: tests/python-pytest/onnx."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.ndarray as nd
+from mxtpu.contrib import onnx as onnx_mxtpu
+from mxtpu.contrib.onnx import onnx_pb2 as P
+
+
+def _tensor(name, arr):
+    t = P.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = {np.dtype(np.float32): 1,
+                   np.dtype(np.int64): 7}[arr.dtype]
+    t.raw_data = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+def _vi(name, shape):
+    v = P.ValueInfoProto()
+    v.name = name
+    v.type.tensor_type.elem_type = 1
+    for d in shape:
+        v.type.tensor_type.shape.dim.add().dim_value = d
+    return v
+
+
+def _node(op, inputs, outputs, **attrs):
+    n = P.NodeProto()
+    n.op_type = op
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    for k, v in attrs.items():
+        a = n.attribute.add()
+        a.name = k
+        if isinstance(v, float):
+            a.type = P.AttributeProto.FLOAT
+            a.f = v
+        elif isinstance(v, int):
+            a.type = P.AttributeProto.INT
+            a.i = v
+        elif isinstance(v, (tuple, list)):
+            a.type = P.AttributeProto.INTS
+            a.ints.extend(v)
+        elif isinstance(v, str):
+            a.type = P.AttributeProto.STRING
+            a.s = v.encode()
+        else:
+            raise TypeError(v)
+    return n
+
+
+def _model(nodes, inputs, outputs, initializers):
+    m = P.ModelProto()
+    m.ir_version = 7
+    op = m.opset_import.add()
+    op.version = 12
+    m.graph.name = "test"
+    m.graph.node.extend(nodes)
+    m.graph.input.extend(inputs)
+    m.graph.output.extend(outputs)
+    m.graph.initializer.extend(initializers)
+    return m.SerializeToString()
+
+
+def _run(sym_, arg_params, aux_params, feeds):
+    shapes = {k: v.shape for k, v in feeds.items()}
+    shapes.update({k: tuple(v.shape) for k, v in arg_params.items()})
+    ex = sym_.simple_bind(ctx=mx.cpu(), grad_req="null", **shapes)
+    for k, v in arg_params.items():
+        ex.arg_dict[k][:] = v.asnumpy()
+    for k, v in aux_params.items():
+        ex.aux_dict[k][:] = v.asnumpy()
+    for k, v in feeds.items():
+        ex.arg_dict[k][:] = v
+    return [o.asnumpy() for o in ex.forward(is_train=False)]
+
+
+def test_mlp_gemm_relu_softmax():
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(16, 8).astype(np.float32)
+    b1 = rng.randn(16).astype(np.float32)
+    w2 = rng.randn(4, 16).astype(np.float32)
+    b2 = rng.randn(4).astype(np.float32)
+    nodes = [
+        _node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+        _node("Relu", ["h"], ["hr"]),
+        _node("Gemm", ["hr", "w2", "b2"], ["logits"], transB=1),
+        _node("Softmax", ["logits"], ["y"], axis=-1),
+    ]
+    data = _model(nodes, [_vi("x", (2, 8))], [_vi("y", (2, 4))],
+                  [_tensor("w1", w1), _tensor("b1", b1),
+                   _tensor("w2", w2), _tensor("b2", b2)])
+    s, args, aux = onnx_mxtpu.import_model(data)
+    assert set(args) == {"w1", "b1", "w2", "b2"}
+    x = rng.randn(2, 8).astype(np.float32)
+    (out,) = _run(s, args, aux, {"x": x})
+    h = np.maximum(x @ w1.T + b1, 0)
+    logits = h @ w2.T + b2
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv_pool_bn_flatten():
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = rng.rand(4).astype(np.float32) + 0.5
+    nodes = [
+        _node("Conv", ["x", "w"], ["c"], kernel_shape=(3, 3),
+              pads=(1, 1, 1, 1)),
+        _node("BatchNormalization",
+              ["c", "gamma", "beta", "mean", "var"], ["bn"],
+              epsilon=1e-5),
+        _node("Relu", ["bn"], ["r"]),
+        _node("MaxPool", ["r"], ["p"], kernel_shape=(2, 2),
+              strides=(2, 2)),
+        _node("Flatten", ["p"], ["f"]),
+    ]
+    data = _model(nodes, [_vi("x", (1, 2, 6, 6))], [_vi("f", (1, 36))],
+                  [_tensor("w", w), _tensor("gamma", gamma),
+                   _tensor("beta", beta), _tensor("mean", mean),
+                   _tensor("var", var)])
+    s, args, aux = onnx_mxtpu.import_model(data)
+    assert "mean" in aux and "var" in aux  # BatchNorm running stats
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    (out,) = _run(s, args, aux, {"x": x})
+
+    # numpy reference
+    from tests.test_op_sweep import np_conv2d, np_pool2d
+    c = np_conv2d(x, w, pad=(1, 1))
+    bn = ((c - mean[None, :, None, None]) /
+          np.sqrt(var[None, :, None, None] + 1e-5) *
+          gamma[None, :, None, None] + beta[None, :, None, None])
+    p = np_pool2d(np.maximum(bn, 0), (2, 2), "max", (2, 2))
+    np.testing.assert_allclose(out, p.reshape(1, -1), rtol=1e-3, atol=1e-4)
+
+
+def test_elemwise_reshape_concat_clip():
+    rng = np.random.RandomState(2)
+    shp = np.array([2, 6], np.int64)
+    nodes = [
+        _node("Add", ["a", "b"], ["s"]),
+        _node("Clip", ["s"], ["cl"], min=-0.5, max=0.5),
+        _node("Reshape", ["cl", "shp"], ["r"]),
+        _node("Concat", ["r", "r"], ["y"], axis=1),
+    ]
+    data = _model(nodes, [_vi("a", (3, 4)), _vi("b", (3, 4))],
+                  [_vi("y", (2, 12))], [_tensor("shp", shp)])
+    s, args, aux = onnx_mxtpu.import_model(data)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    (out,) = _run(s, args, aux, {"a": a, "b": b})
+    ref = np.clip(a + b, -0.5, 0.5).reshape(2, 6)
+    np.testing.assert_allclose(out, np.concatenate([ref, ref], 1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_unsupported_op_errors():
+    nodes = [_node("LSTM", ["x"], ["y"])]
+    data = _model(nodes, [_vi("x", (1, 2))], [_vi("y", (1, 2))], [])
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        onnx_mxtpu.import_model(data)
