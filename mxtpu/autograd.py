@@ -142,6 +142,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
     tape = _STATE.tape
     for entry in reversed(tape):
+        if entry.op is not None and not entry.op.differentiable:
+            continue  # gradient-constant node (argmax/topk/...): stop here
         out_gs = [grads.get(id(o)) for o in entry.outputs]
         if all(g is None for g in out_gs):
             continue
@@ -234,8 +236,59 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     return scratch[0] if single else scratch
 
 
-def get_symbol(x):  # parity stub: tape-to-symbol export arrives with Symbol
-    raise NotImplementedError("get_symbol is not supported yet")
+def get_symbol(x):
+    """Export the recorded computation that produced ``x`` as a Symbol
+    (reference autograd.get_symbol, python/mxnet/autograd.py:447 /
+    MXAutogradGetSymbol): replays the tape entries reachable from ``x``
+    into graph nodes; arrays not produced on the tape become free
+    variables named var0, var1, ... in discovery order."""
+    from .ndarray import NDArray
+    from .symbol import Symbol, _apply_op, _ScalarConst, var as _sym_var
+
+    if not isinstance(x, NDArray):
+        raise TypeError("get_symbol expects an NDArray, got %r" % (x,))
+    producer = {}
+    for entry in _STATE.tape:
+        for i, o in enumerate(entry.outputs):
+            producer[id(o)] = (entry, i)
+
+    arr_sym = {}      # id(NDArray) -> Symbol (one output)
+    entry_sym = {}    # id(entry) -> Symbol (all outputs)
+    counter = [0]
+
+    def build(arr):
+        if id(arr) in arr_sym:
+            return arr_sym[id(arr)]
+        prod = producer.get(id(arr))
+        if prod is None:
+            s = _sym_var("var%d" % counter[0])
+            counter[0] += 1
+            arr_sym[id(arr)] = s
+            return s
+        entry, oi = prod
+        if entry.op is None:
+            raise ValueError(
+                "get_symbol: the computation contains a custom "
+                "autograd.Function node, which has no symbolic "
+                "counterpart (the reference has the same limitation — "
+                "CachedOp graphs cannot contain CustomFunction)")
+        if id(entry) not in entry_sym:
+            sym_inputs = []
+            for inp, val in zip(entry.inputs, entry.input_values):
+                if inp is None:
+                    sym_inputs.append(_ScalarConst(val))
+                else:
+                    sym_inputs.append(build(inp))
+            params = {k: v for k, v in entry.params.items()
+                      if k != "_training"}
+            entry_sym[id(entry)] = _apply_op(entry.op, None, sym_inputs,
+                                             params)
+        s = entry_sym[id(entry)]
+        out = s[oi] if len(s._outputs) > 1 else s
+        arr_sym[id(arr)] = out
+        return out
+
+    return build(x)
 
 
 class Function:

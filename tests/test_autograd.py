@@ -124,3 +124,28 @@ def test_softmax_output_ce_gradient():
     p = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
     onehot = np.eye(3)[label.asnumpy().astype(int)]
     assert np.allclose(x.grad.asnumpy(), p - onehot, atol=1e-5)
+
+
+def test_get_symbol_exports_tape():
+    """autograd.get_symbol turns the recorded computation into a Symbol
+    (reference autograd.py:447 get_symbol / MXAutogradGetSymbol)."""
+    import mxtpu as mx
+    x = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    w = nd.array(np.array([0.5, 0.5, 0.5], np.float32))
+    with ag.record():
+        y = nd.relu(x * w) + 2.0
+    s = ag.get_symbol(y)
+    args = s.list_arguments()
+    assert len(args) == 2
+    # evaluating the exported graph reproduces the recorded computation
+    ex = s.bind(mx.cpu(), {args[0]: x.copy(), args[1]: w.copy()})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(
+        out, np.maximum(x.asnumpy() * w.asnumpy(), 0) + 2.0)
+    # multi-output ops export with the right output picked
+    d = nd.array(np.array([[3.0, 1.0, 2.0]], np.float32))
+    with ag.record():
+        vals = nd.topk(d, k=2, ret_typ="value")
+    s2 = ag.get_symbol(vals)
+    ex2 = s2.bind(mx.cpu(), {s2.list_arguments()[0]: d.copy()})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(), [[3.0, 2.0]])
