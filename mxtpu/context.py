@@ -82,20 +82,25 @@ class Context:
 
 
 def _devices_for(device_type):
-    """Best-effort mapping from a device-type string to jax devices."""
+    """Best-effort mapping from a device-type string to jax devices.
+
+    Uses *local* devices: in a multi-process (jax.distributed) run,
+    jax.devices() lists every process's devices and only this process's
+    are addressable — a Context must never resolve to a peer's device
+    (caught by tests/nightly/dist_worker.py on rank 1)."""
     if device_type in ("cpu", "cpu_pinned", "cpu_shared"):
         try:
-            return jax.devices("cpu")
+            return jax.local_devices(backend="cpu")
         except RuntimeError:
             # cpu backend unavailable under some platform pinnings; fall back
             # to the default backend so code still runs.
-            return jax.devices()
+            return jax.local_devices()
     # accelerator types: tpu preferred, then whatever the default backend is.
     try:
-        return jax.devices("tpu")
+        return jax.local_devices(backend="tpu")
     except RuntimeError:
         pass
-    devs = jax.devices()
+    devs = jax.local_devices()
     return [d for d in devs if d.platform != "cpu"] or devs
 
 

@@ -19,10 +19,17 @@ def print_summary(symbol, shape=None, line_length=120,
         raise TypeError("symbol must be a Symbol")
     show_shape = shape is not None
     shape_of = {}
+    out_shape_of = {}
     if show_shape:
         arg_shapes, out_shapes, _ = symbol.infer_shape_partial(**shape)
         for name, s in zip(symbol.list_arguments(), arg_shapes):
             shape_of[name] = s
+        # per-layer output shapes via the internals symbol (the reference
+        # runs infer_shape on get_internals() for exactly this column)
+        internals = symbol.get_internals()
+        _, int_shapes, _ = internals.infer_shape_partial(**shape)
+        for oname, s in zip(internals.list_outputs(), int_shapes):
+            out_shape_of[oname] = s
     nodes = symbol._topo()
     heads = {id(n) for n, _ in symbol._outputs}
     positions = [int(line_length * p) for p in positions]
@@ -49,6 +56,12 @@ def print_summary(symbol, shape=None, line_length=120,
         prevs = []
         params = 0
         out_shape = ""
+        if show_shape:
+            key = (name + "_output" if node.num_outputs == 1
+                   else name + "_output0")
+            s = out_shape_of.get(key)
+            if s:
+                out_shape = "x".join(str(d) for d in s)
         for pn, slot in node.inputs:
             if pn.op is None:
                 if pn.name in arg_names and pn.name in shape_of:
@@ -63,7 +76,7 @@ def print_summary(symbol, shape=None, line_length=120,
                 prevs.append(pn.name)
         total_params += params
         print_row(["%s(%s)" % (name, op_name), out_shape, params,
-                   ",".join(prevs[:2])], positions)
+                   ",".join(prevs[:3])], positions)
     print("=" * line_length)
     print("Total params: %d" % total_params)
     print("_" * line_length)

@@ -67,4 +67,35 @@ for _ in range(200):
     w = step(w, Xg, yg)
 w_np = np.asarray(jax.device_get(w))
 np.testing.assert_allclose(w_np, wt, atol=2e-2)
+
+# (c) dist_sync vs dist_async: on the SPMD runtime both execute the same
+# synchronous program (behavior statement in mxtpu/kvstore.py) — assert
+# the two modes expose identical store semantics and process identity.
+import sys                                                   # noqa: E402
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx                                           # noqa: E402
+
+results = {}
+for mode in ("dist_sync", "dist_async"):
+    kv = mx.kvstore.create(mode)
+    assert kv.type == mode
+    assert kv.rank == rank and kv.num_workers == nproc, \
+        (mode, kv.rank, kv.num_workers)
+    updates = []
+    kv.init(9, mx.nd.ones((3,)))
+
+    def updater(key, recv, local, _log=updates):
+        _log.append(int(key))
+        local[:] = local - 0.1 * recv
+
+    kv._set_updater(updater)
+    kv.push(9, mx.nd.ones((3,)) * (rank + 1))
+    out = mx.nd.zeros((3,))
+    kv.pull(9, out=out)
+    # updater applied exactly once per push in both modes (the reference's
+    # server-side immediate apply, running where the store lives)
+    assert updates == [9], (mode, updates)
+    results[mode] = out.asnumpy()
+np.testing.assert_array_equal(results["dist_sync"], results["dist_async"])
+
 print("RANK_%d_OK nprocs=%d ndevices=%d" % (rank, nproc, n))
