@@ -12,12 +12,25 @@ the API (MXNet default) — XLA re-layouts internally for TPU.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from .registry import register, next_rng_key
+
+
+def _nhwc_enabled():
+    """MXTPU_CONV_LAYOUT=NHWC: run 2-D conv/pool internally channels-last.
+
+    TPU systolic/vector units natively prefer channels-last; with the flag
+    set, each conv/pool transposes NCHW->NHWC at entry and back at exit.
+    Adjacent pairs cancel in XLA's algebraic simplifier (and elementwise
+    ops commute through), so a conv-net chain effectively runs NHWC end to
+    end while the public API stays NCHW (MXNet default). Measured by
+    tools/run_tpu_checks.py bench variants; read at trace time."""
+    return os.environ.get("MXTPU_CONV_LAYOUT", "").upper() == "NHWC"
 
 # ---------------------------------------------------------------------------
 # FullyConnected (reference: src/operator/nn/fully_connected-inl.h:103-165,
@@ -70,14 +83,22 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     stride = _pair(stride, nsp) if stride else (1,) * nsp
     dilate = _pair(dilate, nsp) if dilate else (1,) * nsp
     pad = _pair(pad, nsp) if pad else (0,) * nsp
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(data.ndim))
+    nhwc = nsp == 2 and _nhwc_enabled()
+    if nhwc:
+        data = jnp.transpose(data, (0, 2, 3, 1))
+        weight = jnp.transpose(weight, (2, 3, 1, 0))  # OIHW -> HWIO
+        dn = ("NHWC", "HWIO", "NHWC")
+    else:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        _conv_dims(data.ndim))
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nsp)
-    return out
+        out = out + bias.reshape((1, 1, 1, -1) if nhwc
+                                 else (1, -1) + (1,) * nsp)
+    return jnp.transpose(out, (0, 3, 1, 2)) if nhwc else out
 
 
 @register("Deconvolution", aliases=("deconvolution",))
@@ -140,33 +161,47 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
         kernel = _pair(kernel, nsp)
         stride = _pair(stride, nsp) if stride else (1,) * nsp
         pad = _pair(pad, nsp) if pad else (0,) * nsp
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
+    nhwc = nsp == 2 and _nhwc_enabled()
+    if nhwc:
+        data = jnp.transpose(data, (0, 2, 3, 1))
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
     if pooling_convention == "full":
         # ceil-mode: pad high edge enough that ceil division is covered
-        pads = [(0, 0), (0, 0)]
+        sp_pads = []
         for i in range(nsp):
-            in_sz = data.shape[2 + i] + 2 * pad[i]
+            in_sz = data.shape[(1 if nhwc else 2) + i] + 2 * pad[i]
             out_sz = -(-(in_sz - kernel[i]) // stride[i]) + 1  # ceil
             needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz
-            pads.append((pad[i], pad[i] + max(needed, 0)))
+            sp_pads.append((pad[i], pad[i] + max(needed, 0)))
     else:
-        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+        sp_pads = [(p, p) for p in pad]
+    if nhwc:
+        pads = [(0, 0)] + sp_pads + [(0, 0)]
+    else:
+        pads = [(0, 0), (0, 0)] + sp_pads
+    def _back(x):
+        return jnp.transpose(x, (0, 3, 1, 2)) if nhwc else x
+
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+        return _back(lax.reduce_window(data, init, lax.max, window, strides,
+                                       pads))
     if pool_type in ("avg", "sum"):
         summed = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
         if pool_type == "sum":
-            return summed
+            return _back(summed)
         if count_include_pad:
             denom = 1.0
             for k in kernel:
                 denom *= k
-            return summed / denom
+            return _back(summed / denom)
         ones = jnp.ones_like(data)
         counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
-        return summed / counts
+        return _back(summed / counts)
     raise ValueError("unknown pool_type %r" % pool_type)
 
 

@@ -351,6 +351,15 @@ class ShardedTrainer:
 
         with mesh.mesh:
             if with_update:
+                # donation audit: params(0), optimizer states(1), aux(2),
+                # rng key(5) and step count(6) are donated — each is
+                # replaced by a same-shaped output, so XLA updates the
+                # buffers in place (zero extra HBM for the update).
+                # inputs(3)/label(4) are deliberately NOT donated: callers
+                # legitimately reuse pre-staged batches across steps
+                # (bench.py's steady-state loop; a donated batch buffer
+                # would be invalidated after the first step). lr(7) is a
+                # carried constant, never replaced, so it must stay live.
                 return jax.jit(train_step,
                                donate_argnums=(0, 1, 2, 5, 6)
                                if self._donate else ())

@@ -1,0 +1,326 @@
+#!/usr/bin/env python
+"""One-shot TPU validation + benchmark suite (run when the chip is up).
+
+Produces ``tpu_checks_report.json`` covering the TPU-dependent backlog:
+
+1. **bench**: the headline ResNet-50 batch-32 number (bench.py child) plus
+   batch-128/256 variants with MFU — the batch-scaling view of the MFU
+   ceiling.
+2. **pallas_rnn**: fused LSTM/GRU kernels on real Mosaic — correctness vs
+   the lax.scan reference and fwd timing, deciding USE_PALLAS_RNN.
+3. **flash_attention**: block-size sweep for head_dim 64 and 128
+   (fwd and fwd+bwd) vs XLA attention.
+4. **consistency**: the registry-wide op sweep's forward SPECS replayed on
+   TPU vs CPU with fp32/bf16 tolerance tiers — the reference's
+   test_operator_gpu.py check_consistency trick (test_utils.py:1207).
+
+Relay-safe: probes the backend in a bounded subprocess first (bench.py's
+probe); exits with a parseable "tpu_unavailable" report if wedged.
+
+Run:  python tools/run_tpu_checks.py [--skip consistency ...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, ROOT)
+
+REPORT = os.path.join(ROOT, "tpu_checks_report.json")
+
+
+def _timeit(fn, iters=20, warmup=3):
+    import jax
+    for _ in range(warmup):
+        r = fn()
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn()
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / iters
+
+
+def check_bench(report):
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=3600)
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
+    report["bench_batch32"] = json.loads(line)
+
+    # batch-scaling variants (single chip): run in-process, we are already
+    # on the TPU at this point
+    import jax
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.parallel import MeshContext, ShardedTrainer
+    from bench import (BASELINE_IMG_S, RESNET50_TRAIN_FLOPS_PER_IMG,
+                       peak_tflops)
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    peak = peak_tflops(kind) or 0.0
+    for batch, nhwc in ((128, False), (256, False), (128, True)):
+        try:
+            if nhwc:
+                os.environ["MXTPU_CONV_LAYOUT"] = "NHWC"
+            else:
+                os.environ.pop("MXTPU_CONV_LAYOUT", None)
+            mx.random.seed(0)
+            net = vision.get_resnet(1, 50)
+            net.initialize(mx.init.Xavier(), force_reinit=True)
+            x = np.random.uniform(0, 1, (batch, 3, 224, 224)).astype("f")
+            y = np.random.randint(0, 1000, (batch,)).astype("f")
+            net(mx.nd.array(x[:1]))
+            st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                "sgd", {"learning_rate": 0.05,
+                                        "momentum": 0.9, "wd": 1e-4},
+                                mesh=MeshContext(jax.devices()[:1], data=1),
+                                dtype="bfloat16")
+            for _ in range(3):
+                st.step(x, y)
+            xd = st._shard_batch([x])[0]
+            yd = st._shard_batch([y])[0]
+            n_iters = 20
+            t0 = time.perf_counter()
+            last = None
+            for _ in range(n_iters):
+                last = st.step_async(xd, yd)
+            last.wait_to_read()
+            dt = time.perf_counter() - t0
+            img_s = batch * n_iters / dt
+            entry = {"img_per_sec": round(img_s, 1),
+                     "vs_baseline": round(img_s / BASELINE_IMG_S, 2)}
+            if peak:
+                entry["mfu"] = round(
+                    img_s * RESNET50_TRAIN_FLOPS_PER_IMG / (peak * 1e12), 4)
+            key = "bench_batch%d%s" % (batch, "_nhwc" if nhwc else "")
+            report[key] = entry
+        except Exception as e:
+            key = "bench_batch%d%s" % (batch, "_nhwc" if nhwc else "")
+            report[key] = {"error": repr(e)}
+        finally:
+            os.environ.pop("MXTPU_CONV_LAYOUT", None)
+
+
+def check_pallas_rnn(report):
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.ops import pallas_rnn
+
+    rng = np.random.RandomState(0)
+    T, B, H = 128, 32, 256
+    res = {}
+    # LSTM: pallas fused vs scan reference
+    x_proj = jnp.asarray(rng.randn(T, B, 4 * H).astype("f"))
+    h0 = jnp.asarray(rng.randn(B, H).astype("f"))
+    c0 = jnp.asarray(rng.randn(B, H).astype("f"))
+    wh_t = jnp.asarray((rng.randn(H, 4 * H) / np.sqrt(H)).astype("f"))
+    fused = jax.jit(pallas_rnn.lstm_scan)
+    ref = jax.jit(pallas_rnn._scan_reference)
+    out_f = jax.block_until_ready(fused(x_proj, h0, c0, wh_t))
+    out_r = jax.block_until_ready(ref(x_proj, h0, c0, wh_t))
+    err = max(float(jnp.max(jnp.abs(a - b)))
+              for a, b in zip(jax.tree.leaves(out_f), jax.tree.leaves(out_r)))
+    res["lstm_max_abs_err"] = err
+    res["lstm_pallas_ms"] = round(
+        _timeit(lambda: fused(x_proj, h0, c0, wh_t)) * 1e3, 3)
+    res["lstm_scan_ms"] = round(
+        _timeit(lambda: ref(x_proj, h0, c0, wh_t)) * 1e3, 3)
+
+    # GRU
+    x3 = jnp.asarray(rng.randn(T, B, 3 * H).astype("f"))
+    whrz = jnp.asarray((rng.randn(H, 2 * H) / np.sqrt(H)).astype("f"))
+    whn = jnp.asarray((rng.randn(H, H) / np.sqrt(H)).astype("f"))
+    bhn = jnp.asarray(rng.randn(H).astype("f") * 0.1)
+    gfused = jax.jit(pallas_rnn.gru_scan)
+    gref = jax.jit(pallas_rnn._gru_scan_reference)
+    out_f = jax.block_until_ready(gfused(x3, h0, whrz, whn, bhn))
+    out_r = jax.block_until_ready(gref(x3, h0, whrz, whn, bhn))
+    err = max(float(jnp.max(jnp.abs(a - b)))
+              for a, b in zip(jax.tree.leaves(out_f), jax.tree.leaves(out_r)))
+    res["gru_max_abs_err"] = err
+    res["gru_pallas_ms"] = round(
+        _timeit(lambda: gfused(x3, h0, whrz, whn, bhn)) * 1e3, 3)
+    res["gru_scan_ms"] = round(
+        _timeit(lambda: gref(x3, h0, whrz, whn, bhn)) * 1e3, 3)
+    res["recommend_use_pallas_rnn"] = bool(
+        res["lstm_max_abs_err"] < 1e-3 and
+        res["lstm_pallas_ms"] < res["lstm_scan_ms"])
+    report["pallas_rnn"] = res
+
+
+def check_flash_attention(report):
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.ops.pallas_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    res = {}
+    for d in (64, 128):
+        B, Hh, T = 1, 8, 8192
+        q = jnp.asarray(rng.randn(B, Hh, T, d).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, Hh, T, d).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, Hh, T, d).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+
+        def xla_attn(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask, s, -1e9)
+            return jnp.einsum("bhqk,bhkd->bhqd",
+                              jax.nn.softmax(s.astype(jnp.float32), -1
+                                             ).astype(q.dtype), v)
+
+        xla_j = jax.jit(xla_attn)
+        try:
+            res["xla_fwd_ms_d%d" % d] = round(
+                _timeit(lambda: xla_j(q, k, v), iters=5) * 1e3, 2)
+        except Exception as e:
+            res["xla_fwd_ms_d%d" % d] = repr(e)
+
+        best = None
+        for bq in (256, 512, 1024):
+            for bk in (512, 1024, 2048):
+                try:
+                    f = jax.jit(lambda q, k, v, bq=bq, bk=bk:
+                                flash_attention(q, k, v, causal=True,
+                                                block_q=bq, block_k=bk))
+                    ms = _timeit(lambda: f(q, k, v), iters=5) * 1e3
+                    res["flash_fwd_ms_d%d_q%d_k%d" % (d, bq, bk)] = \
+                        round(ms, 2)
+                    if best is None or ms < best[0]:
+                        best = (ms, bq, bk)
+                except Exception as e:
+                    res["flash_fwd_ms_d%d_q%d_k%d" % (d, bq, bk)] = \
+                        repr(e)[:120]
+        if best:
+            res["best_d%d" % d] = {"ms": round(best[0], 2),
+                                   "block_q": best[1], "block_k": best[2]}
+
+        # fwd+bwd at the best block size
+        if best:
+            _, bq, bk = best
+
+            def loss(q, k, v):
+                return flash_attention(q, k, v, causal=True, block_q=bq,
+                                       block_k=bk).astype(jnp.float32).sum()
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            try:
+                res["flash_fwdbwd_ms_d%d" % d] = round(
+                    _timeit(lambda: g(q, k, v), iters=5) * 1e3, 2)
+            except Exception as e:
+                res["flash_fwdbwd_ms_d%d" % d] = repr(e)[:120]
+    report["flash_attention"] = res
+
+
+def check_consistency(report):
+    """Replay the op sweep's forward SPECS on TPU vs CPU (the reference's
+    cpu/gpu check_consistency tier, test_utils.py:1207)."""
+    import importlib.util
+    import jax
+    spec_mod = importlib.util.spec_from_file_location(
+        "op_sweep_specs", os.path.join(ROOT, "tests", "test_op_sweep.py"))
+    sweep = importlib.util.module_from_spec(spec_mod)
+    spec_mod.loader.exec_module(sweep)
+    SPECS, _seed, _canonical_ops = (sweep.SPECS, sweep._seed,
+                                    sweep._canonical_ops)
+    import mxtpu as mx
+    import mxtpu.ndarray as nd
+
+    cpu_dev = jax.local_devices(backend="cpu")[0]
+    tpu_dev = jax.local_devices(backend="tpu")[0]
+    mismatches, errors, checked = [], [], 0
+    for name in sorted(SPECS):
+        spec = SPECS[name]
+        op = _canonical_ops()[name]
+        if op.stateful:
+            continue  # different backends draw identical keys, but skip
+        r = np.random.RandomState(_seed(name))
+        try:
+            args = spec.args(r)
+        except Exception:
+            continue
+        outs = {}
+        for devname, dev in (("cpu", cpu_dev), ("tpu", tpu_dev)):
+            try:
+                with jax.default_device(dev):
+                    mx.random.seed(0)
+                    o = getattr(nd, name)(
+                        *[nd.array(a) if isinstance(a, np.ndarray) else a
+                          for a in args], **spec.params)
+                    o = o if isinstance(o, (list, tuple)) else [o]
+                    outs[devname] = [np.asarray(x.asnumpy()) for x in o]
+            except Exception as e:
+                errors.append({"op": name, "dev": devname,
+                               "error": repr(e)[:200]})
+                outs[devname] = None
+        if outs.get("cpu") is None or outs.get("tpu") is None:
+            continue
+        checked += 1
+        for i, (a, b) in enumerate(zip(outs["cpu"], outs["tpu"])):
+            if a.dtype.kind == "f":
+                # fp32 tier on-chip can use bf16 matmul passes: loose tol
+                if not np.allclose(a.astype(np.float64),
+                                   b.astype(np.float64),
+                                   rtol=2e-2, atol=2e-2):
+                    diff = float(np.max(np.abs(
+                        a.astype(np.float64) - b.astype(np.float64))))
+                    mismatches.append({"op": name, "out": i,
+                                       "max_abs_diff": diff})
+            else:
+                if not np.array_equal(a, b):
+                    mismatches.append({"op": name, "out": i,
+                                       "max_abs_diff": "int mismatch"})
+    report["consistency"] = {
+        "ops_checked": checked,
+        "mismatches": mismatches,
+        "errors": errors[:20],
+        "n_errors": len(errors),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip", nargs="*", default=[],
+                    choices=["bench", "pallas_rnn", "flash_attention",
+                             "consistency"])
+    args = ap.parse_args()
+
+    from bench import probe_tpu
+    kind = probe_tpu()
+    report = {"device_kind": kind, "timestamp": time.strftime("%F %T")}
+    if kind is None:
+        report["tpu_unavailable"] = True
+        with open(REPORT, "w") as f:
+            json.dump(report, f, indent=2)
+        print(json.dumps(report))
+        return 1
+
+    checks = [("bench", check_bench), ("pallas_rnn", check_pallas_rnn),
+              ("flash_attention", check_flash_attention),
+              ("consistency", check_consistency)]
+    for cname, fn in checks:
+        if cname in args.skip:
+            continue
+        print("== %s ==" % cname, flush=True)
+        try:
+            fn(report)
+        except Exception as e:
+            report[cname + "_error"] = repr(e)
+        with open(REPORT, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
