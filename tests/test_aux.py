@@ -175,3 +175,27 @@ def test_print_summary_counts_params(capsys):
     out = capsys.readouterr().out
     assert "fc1" in out
     assert total == 8 * 4 + 4
+
+
+def test_launcher_cluster_modes_dry_run():
+    """mpi/slurm/sge launcher modes construct the reference-shaped
+    dispatch (tools/launch.py vs reference dmlc-tracker dispatchers);
+    dry-run prints the exact command/script with the env contract."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.join(os.path.dirname(__file__), "..")
+    for mode, markers in (
+            ("mpi", ["mpirun", "-np 4", "OMPI_COMM_WORLD_RANK"]),
+            ("slurm", ["srun", "--ntasks=4", "SLURM_PROCID"]),
+            ("sge", ["#$ -t 1-4", "SGE_TASK_ID"])):
+        res = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "launch.py"),
+             "-n", "4", "--launcher", mode, "--dry-run",
+             "--coordinator-host", "node0", "python worker.py"],
+            capture_output=True, text=True, timeout=60)
+        assert res.returncode == 0, (mode, res.stderr)
+        for m in markers:
+            assert m in res.stdout, (mode, m, res.stdout)
+        assert "MXTPU_COORDINATOR=node0:9327" in res.stdout, mode
+        assert "MXTPU_NUM_PROCS=4" in res.stdout, mode

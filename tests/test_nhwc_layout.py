@@ -7,11 +7,6 @@ import pytest
 import mxtpu.ndarray as nd
 
 
-@pytest.fixture
-def nhwc_env(monkeypatch):
-    monkeypatch.setenv("MXTPU_CONV_LAYOUT", "NHWC")
-
-
 def _both(fn, monkeypatch):
     monkeypatch.delenv("MXTPU_CONV_LAYOUT", raising=False)
     base = fn()

@@ -77,21 +77,119 @@ def launch_ssh(args, command):
     return code
 
 
+def _env_exports(args, coordinator_host, rank_expr, sep="; "):
+    """The single source of the MXTPU_*/DMLC_* worker env contract; each
+    cluster launcher supplies only its scheduler's rank expression."""
+    return sep.join([
+        "export MXTPU_COORDINATOR=%s:%d MXTPU_NUM_PROCS=%d"
+        % (coordinator_host, args.port, args.num_workers),
+        "export MXTPU_PROC_ID=%s" % rank_expr,
+        "export DMLC_ROLE=worker DMLC_NUM_WORKER=%d DMLC_NUM_SERVER=%d "
+        "DMLC_WORKER_ID=$MXTPU_PROC_ID" % (args.num_workers,
+                                           args.num_servers),
+    ])
+
+
+def _coordinator_host(args, scheduler):
+    """Rank 0's host. mpi derives it from the hostfile when given; the
+    scheduler modes (slurm/sge) allocate nodes at submit time, so a
+    reachable --coordinator-host must be provided for multi-node jobs."""
+    if scheduler == "mpi" and args.hostfile:
+        with open(args.hostfile) as f:
+            for line in f:
+                host = line.split()[0] if line.strip() else ""
+                if host:
+                    return host
+    return args.coordinator_host
+
+
+def launch_mpi(args, command):
+    """mpirun dispatch (reference dmlc-tracker/mpi.py): one rank per
+    worker; each rank derives its identity from OMPI/PMI env vars via the
+    wrapper below, so the same worker script runs under every launcher."""
+    wrapper = "%s; %s" % (
+        _env_exports(args, _coordinator_host(args, "mpi"),
+                     "${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-0}}"), command)
+    cmd = ["mpirun", "-np", str(args.num_workers)]
+    if args.hostfile:
+        cmd += ["--hostfile", args.hostfile]
+    cmd += ["bash", "-c", wrapper]
+    print(" ".join("'%s'" % c if " " in c else c for c in cmd))
+    if args.dry_run:
+        return 0
+    return subprocess.call(cmd)
+
+
+def launch_slurm(args, command):
+    """srun dispatch (the modern cluster-scheduler analogue of the
+    reference's sge/yarn trackers): SLURM_PROCID provides the rank.
+    Multi-node jobs must pass --coordinator-host (a node reachable by all
+    ranks) since nodes are allocated by the scheduler at submit time."""
+    wrapper = "%s; %s" % (
+        _env_exports(args, _coordinator_host(args, "slurm"),
+                     "$SLURM_PROCID"), command)
+    cmd = ["srun", "--ntasks=%d" % args.num_workers, "bash", "-c", wrapper]
+    print(" ".join("'%s'" % c if " " in c else c for c in cmd))
+    if args.dry_run:
+        return 0
+    return subprocess.call(cmd)
+
+
+def launch_sge(args, command):
+    """SGE array-job dispatch (reference dmlc-tracker/sge.py): submits a
+    task-array of size N; SGE_TASK_ID (1-based) provides the rank.
+    Multi-node jobs must pass --coordinator-host (see launch_slurm)."""
+    script = "#!/bin/bash\n#$ -t 1-%d\n#$ -cwd\n#$ -S /bin/bash\n%s\n%s\n" % (
+        args.num_workers,
+        _env_exports(args, _coordinator_host(args, "sge"),
+                     "$((SGE_TASK_ID - 1))", sep="\n"),
+        command)
+    print(script)
+    if args.dry_run:
+        return 0
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".sh",
+                                     delete=False) as f:
+        f.write(script)
+        path = f.name
+    return subprocess.call(["qsub", "-sync", "y", path])
+
+
+# Kubernetes / GKE (the modern yarn analogue): no dispatch code needed —
+# run the worker as an indexed Job / JobSet with
+#   MXTPU_COORDINATOR=<job>-0.<headless-svc>:9327
+#   MXTPU_NUM_PROCS=<parallelism>
+#   MXTPU_PROC_ID=$JOB_COMPLETION_INDEX
+# which is exactly the env contract every launcher above emits. On Cloud
+# TPU pods, jax.distributed.initialize() with no args uses the TPU
+# metadata server instead and none of this is required.
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("-s", "--num-servers", type=int, default=0,
                    help="accepted for reference-CLI parity; mxtpu has no "
                         "parameter servers (SPMD collectives instead)")
-    p.add_argument("--launcher", choices=("local", "ssh"), default="local")
+    p.add_argument("--launcher",
+                   choices=("local", "ssh", "mpi", "slurm", "sge"),
+                   default="local")
     p.add_argument("-H", "--hostfile", default=None)
     p.add_argument("--port", type=int, default=9327)
+    p.add_argument("--coordinator-host", default="127.0.0.1",
+                   help="host of rank 0 for mpi/slurm/sge modes")
     p.add_argument("--dry-run", action="store_true")
     p.add_argument("command", nargs="+")
     args = p.parse_args()
     command = " ".join(args.command)
     if args.launcher == "local":
         sys.exit(launch_local(args, command))
+    if args.launcher == "mpi":
+        sys.exit(launch_mpi(args, command))
+    if args.launcher == "slurm":
+        sys.exit(launch_slurm(args, command))
+    if args.launcher == "sge":
+        sys.exit(launch_sge(args, command))
     if not args.hostfile:
         sys.exit("ssh launcher requires --hostfile")
     sys.exit(launch_ssh(args, command))
