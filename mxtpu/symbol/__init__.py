@@ -337,12 +337,15 @@ class _ScalarConst:
 # Optional (default=None) fn parameters that denote *array* inputs; any other
 # default-None parameter (axes=None, a_min=None, ...) is a static param.
 _OPTIONAL_ARRAY_PARAMS = {"bias", "gamma", "state", "state_cell", "weight32",
-                          "parameters", "crop_like", "trans"}
+                          "parameters", "crop_like", "trans",
+                          "sequence_length", "data_lengths",
+                          "label_lengths"}
 
 # optional array inputs that are genuinely absent when not supplied — no
 # implicit variable is auto-created for them (unlike bias/state, which are
 # real parameters the frontend materializes)
-_OPTIONAL_NO_AUTO = {"crop_like", "trans"}
+_OPTIONAL_NO_AUTO = {"crop_like", "trans", "sequence_length",
+                     "data_lengths", "label_lengths"}
 
 
 def _array_input_names(op, params):
@@ -355,6 +358,8 @@ def _array_input_names(op, params):
     for p in sig.parameters.values():
         if p.kind == inspect.Parameter.VAR_POSITIONAL:
             return None  # variadic
+        if p.kind == inspect.Parameter.VAR_KEYWORD:
+            break        # **kwargs holds passthrough params, not inputs
         if p.default is inspect.Parameter.empty:
             if p.name.startswith("_"):
                 continue
@@ -364,8 +369,13 @@ def _array_input_names(op, params):
         else:
             break
     # op-specific trims
-    if op.name in ("Convolution", "Deconvolution", "FullyConnected"):
-        if params.get("no_bias"):
+    if op.name in ("Convolution", "Deconvolution", "FullyConnected",
+                   "_contrib_DeformableConvolution"):
+        # honor each op's own no_bias default (Deconvolution defaults to
+        # bias-less, Convolution/FullyConnected to biased)
+        default_no_bias = sig.parameters["no_bias"].default \
+            if "no_bias" in sig.parameters else False
+        if params.get("no_bias", default_no_bias):
             names = [n for n in names if n != "bias"]
     if op.name == "LeakyReLU" and params.get("act_type", "leaky") != "prelu":
         names = [n for n in names if n != "gamma"]
@@ -399,6 +409,26 @@ def _create_symbol(op, *args, **kwargs):
             supplied = None
             if pos:
                 supplied = pos.pop(0)
+            if supplied is not None and not isinstance(supplied, Symbol):
+                # a concrete (non-Symbol) value for an input-classified
+                # name is a static parameter: sym.tile(x, reps=(2,2)) and
+                # sym.sgd_update(w, g, 0.1) — required fn args without
+                # defaults look like inputs to the signature heuristic.
+                # Arrays are NOT params: an nd/sym mix-up must fail loudly.
+                from ..ndarray import NDArray as _NDArray
+                if isinstance(supplied, (_NDArray, _np.ndarray)):
+                    raise TypeError(
+                        "op %s input %r must be a Symbol, got %s (mixing "
+                        "NDArrays into symbol construction?)"
+                        % (op.name, argname, type(supplied).__name__))
+                if argname in params:
+                    raise TypeError(
+                        "op %s got multiple values for argument %r"
+                        % (op.name, argname))
+                params[argname] = supplied
+                continue
+            if supplied is None and argname in params:
+                continue                    # static param given by keyword
             if supplied is not None:
                 inputs.append(supplied)
                 used_names.append(argname)
