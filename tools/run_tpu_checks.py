@@ -302,10 +302,21 @@ def main():
     ap.add_argument("--skip", nargs="*", default=[],
                     choices=["bench", "pallas_rnn", "flash_attention",
                              "consistency"])
+    ap.add_argument("--wait", type=int, default=0, metavar="MINUTES",
+                    help="poll the relay up to this long and run the "
+                         "checks the moment it answers (probe every 15 "
+                         "min; the relay wedges for hours at a time)")
     args = ap.parse_args()
 
     from bench import probe_tpu
     kind = probe_tpu()
+    deadline = time.time() + args.wait * 60
+    while kind is None and time.time() < deadline:
+        remaining = int((deadline - time.time()) / 60)
+        print("relay down; retrying for up to %d more minutes" % remaining,
+              flush=True)
+        time.sleep(min(900, max(60, deadline - time.time())))
+        kind = probe_tpu()
     report = {"device_kind": kind, "timestamp": time.strftime("%F %T")}
     if kind is None:
         report["tpu_unavailable"] = True
