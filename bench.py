@@ -49,16 +49,23 @@ PROBE_RETRIES = 3
 PROBE_RETRY_WAIT_S = 20
 
 
-def probe_tpu():
-    """Check TPU backend liveness in a killable subprocess.
+def probe_backend():
+    """Check backend liveness in a killable subprocess.
 
-    Returns the device_kind string if a TPU came up within the timeout,
-    else None. Retries a few times with a pause — transient relay hiccups
-    sometimes clear in seconds; multi-hour wedges won't, and we must not
-    hang the driver's bench run on them.
+    Returns ``(platform, device_kind)`` — platform is None when nothing
+    answered within the timeout (wedged relay), else the backend's
+    platform string ("tpu", "cpu", ...). Retries a few times with a
+    pause — transient relay hiccups sometimes clear in seconds;
+    multi-hour wedges won't, and we must not hang the driver's bench run
+    on them.
     """
     code = (
-        "import jax; d = jax.devices()[0]; "
+        # the sitecustomize's config.update overrides JAX_PLATFORMS; re-
+        # assert the env var so a cpu-pinned environment probes as cpu
+        # instead of wedging on the relay
+        "import os, jax; p = os.environ.get('JAX_PLATFORMS');\n"
+        "jax.config.update('jax_platforms', p) if p else None;\n"
+        "d = jax.devices()[0]; "
         "print(d.platform + '|' + getattr(d, 'device_kind', ''))"
     )
     for attempt in range(PROBE_RETRIES):
@@ -69,14 +76,18 @@ def probe_tpu():
             )
             if out.returncode == 0 and out.stdout.strip():
                 platform, _, kind = out.stdout.strip().partition("|")
-                if platform == "tpu":
-                    return kind or "tpu"
-                return None  # backend up but not TPU: fall back cleanly
+                return platform, (kind or platform)
         except subprocess.TimeoutExpired:
             pass
         if attempt < PROBE_RETRIES - 1:
             time.sleep(PROBE_RETRY_WAIT_S)
-    return None
+    return None, None
+
+
+def probe_tpu():
+    """device_kind if a TPU answered, else None (wedged or non-TPU)."""
+    platform, kind = probe_backend()
+    return kind if platform == "tpu" else None
 
 
 def peak_tflops(device_kind: str) -> float | None:

@@ -308,15 +308,21 @@ def main():
                          "min; the relay wedges for hours at a time)")
     args = ap.parse_args()
 
-    from bench import probe_tpu
-    kind = probe_tpu()
+    from bench import probe_backend
+    platform, kind = probe_backend()
+    if platform != "tpu":
+        kind = None
     deadline = time.time() + args.wait * 60
-    while kind is None and time.time() < deadline:
+    while kind is None and platform is None and time.time() < deadline:
+        # platform None = wedged relay (worth waiting out); a healthy
+        # non-TPU backend is definitive — no amount of waiting helps
         remaining = int((deadline - time.time()) / 60)
         print("relay down; retrying for up to %d more minutes" % remaining,
               flush=True)
         time.sleep(min(900, max(60, deadline - time.time())))
-        kind = probe_tpu()
+        platform, kind = probe_backend()
+        if platform != "tpu":
+            kind = None
     report = {"device_kind": kind, "timestamp": time.strftime("%F %T")}
     if kind is None:
         report["tpu_unavailable"] = True
