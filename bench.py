@@ -111,6 +111,11 @@ def run_bench(on_tpu: bool):
         # CPU fallback so the script stays runnable anywhere; numbers are
         # only meaningful on TPU.
         batch, hw = 8, 64
+    if not on_tpu and os.environ.get("MXTPU_BENCH_TINY", "") not in ("", "0"):
+        # contract-test mode (tests/test_bench_contract.py): exercise the
+        # full pipeline at toy size. Never applies to a real TPU
+        # measurement — a leaked env var must not corrupt the headline.
+        batch, hw = 2, 32
 
     mx.random.seed(0)
     net = vision.get_resnet(1, 50)

@@ -1,0 +1,25 @@
+"""bench.py's output contract: the driver parses exactly one JSON line
+with fixed keys, rc 0, under every backend condition. MXTPU_BENCH_TINY
+shrinks the model so the contract test stays fast."""
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_cpu_fallback_contract():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_BENCH_TINY="1",
+               PYTHONPATH=_ROOT)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--cpu-fallback"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-500:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    payload = json.loads(lines[-1])
+    assert payload["metric"] == "resnet50_train_img_per_sec"
+    assert payload["unit"] == "images/sec"
+    assert payload["tpu_unavailable"] is True
+    assert isinstance(payload["value"], (int, float))
+    assert "error" not in payload, payload
