@@ -28,7 +28,7 @@ from .. import autograd as _ag
 from ..ops.registry import get_op, list_ops, next_rng_key
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
-           "concatenate", "save", "load", "waitall", "imports"]
+           "eye", "concatenate", "save", "load", "waitall", "imports"]
 
 
 def _jax_dtype(dtype):
@@ -451,6 +451,16 @@ def full(shape, val, ctx=None, dtype=None):
 
 def empty(shape, ctx=None, dtype=None):
     return zeros(shape, ctx, dtype)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    """Identity-like matrix (reference ``_eye`` op,
+    src/operator/tensor/init_op.cc): N rows, M columns (M=0 means N),
+    with the diagonal offset by k."""
+    ctx = ctx or current_context()
+    return _wrap(jax.device_put(
+        jnp.eye(int(N), int(M) if M else None, k=int(k),
+                dtype=_jax_dtype(dtype)), ctx.jax_device()), ctx)
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):

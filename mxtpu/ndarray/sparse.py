@@ -350,6 +350,17 @@ def retain(arr, indices):
     return RowSparseNDArray(dense, aux, arr._ctx)
 
 
+def square_sum(arr, axis=None, keepdims=False):
+    """Sum of squares (reference ``_square_sum``,
+    src/operator/tensor/square_sum-inl.h) — the row-sparse-aware norm
+    kernel behind lazy Adam/AdaGrad updates. Only stored rows contribute
+    for row_sparse inputs; the dense-backed representation makes that free
+    (absent rows are zero)."""
+    v = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    out = jnp.sum(jnp.square(v), axis=axis, keepdims=keepdims)
+    return _wrap(out)
+
+
 # ---------------------------------------------------------------------------
 # arithmetic — stype-aware wrappers (reference elemwise FComputeEx paths)
 # ---------------------------------------------------------------------------

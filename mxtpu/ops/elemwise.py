@@ -21,9 +21,10 @@ def _binary(name, fn, aliases=()):
     register(name, aliases=aliases)(fn)
 
 _binary("broadcast_add", lambda a, b: jnp.add(a, b),
-        aliases=("elemwise_add", "_plus", "_add", "add_n_pair"))
+        aliases=("elemwise_add", "_plus", "_add", "add_n_pair",
+                 "broadcast_plus"))
 _binary("broadcast_sub", lambda a, b: jnp.subtract(a, b),
-        aliases=("elemwise_sub", "_minus", "_sub"))
+        aliases=("elemwise_sub", "_minus", "_sub", "broadcast_minus"))
 _binary("broadcast_mul", lambda a, b: jnp.multiply(a, b),
         aliases=("elemwise_mul", "_mul"))
 _binary("broadcast_div", lambda a, b: jnp.divide(a, b),

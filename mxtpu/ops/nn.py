@@ -528,7 +528,7 @@ _regression("MAERegressionOutput", lambda d: d, lambda o, l: jnp.sign(o - l))
 _regression("LogisticRegressionOutput", jax.nn.sigmoid, lambda o, l: o - l)
 
 
-@register("MakeLoss")
+@register("MakeLoss", aliases=("make_loss",))
 def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
     @jax.custom_vjp
     def f(x):

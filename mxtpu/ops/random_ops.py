@@ -112,6 +112,55 @@ def sample_gamma(alpha, beta, shape=None, dtype=None):
     return g * b
 
 
+@register("sample_exponential", stateful=True, differentiable=False,
+          aliases=("_sample_exponential",))
+def sample_exponential(lam, shape=None, dtype=None):
+    s = _shape(shape)
+    e = jax.random.exponential(next_rng_key(), lam.shape + s,
+                               dtype=lam.dtype)
+    return e / lam.reshape(lam.shape + (1,) * len(s))
+
+
+@register("sample_poisson", stateful=True, differentiable=False,
+          aliases=("_sample_poisson",))
+def sample_poisson(lam, shape=None, dtype="float32"):
+    s = _shape(shape)
+    lam_b = jnp.broadcast_to(lam.reshape(lam.shape + (1,) * len(s)),
+                             lam.shape + s)
+    return jax.random.poisson(next_rng_key(), lam_b).astype(dtype)
+
+
+@register("sample_negative_binomial", stateful=True, differentiable=False,
+          aliases=("_sample_negative_binomial",))
+def sample_negative_binomial(k, p, shape=None, dtype="float32"):
+    s = _shape(shape)
+    key1, key2 = jax.random.split(next_rng_key())
+    k_b = jnp.broadcast_to(k.reshape(k.shape + (1,) * len(s)), k.shape + s)
+    p_b = jnp.broadcast_to(p.reshape(p.shape + (1,) * len(s)), p.shape + s)
+    g = jax.random.gamma(key1, k_b.astype(jnp.float32)) * (1 - p_b) / p_b
+    return jax.random.poisson(key2, g).astype(dtype)
+
+
+@register("sample_generalized_negative_binomial", stateful=True,
+          differentiable=False,
+          aliases=("_sample_generalized_negative_binomial",))
+def sample_generalized_negative_binomial(mu, alpha, shape=None,
+                                         dtype="float32"):
+    s = _shape(shape)
+    key1, key2 = jax.random.split(next_rng_key())
+    mu_b = jnp.broadcast_to(mu.reshape(mu.shape + (1,) * len(s)),
+                            mu.shape + s).astype(jnp.float32)
+    a_b = jnp.broadcast_to(alpha.reshape(alpha.shape + (1,) * len(s)),
+                           alpha.shape + s).astype(jnp.float32)
+    # alpha=0 degenerates to Poisson(mu); use a tiny floor so the gamma
+    # mixing stays defined elementwise (matches sampler semantics in
+    # src/operator/random/multisample_op.cc)
+    a_safe = jnp.maximum(a_b, 1e-8)
+    g = jax.random.gamma(key1, 1.0 / a_safe) * a_safe * mu_b
+    lam = jnp.where(a_b > 0, g, mu_b)
+    return jax.random.poisson(key2, lam).astype(dtype)
+
+
 @register("sample_multinomial", stateful=True, differentiable=False,
           aliases=("_sample_multinomial", "multinomial"))
 def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):

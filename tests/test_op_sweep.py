@@ -782,6 +782,26 @@ S("sample_gamma", lambda r: [np.array([2.0], np.float32),
                              np.array([3.0], np.float32)],
   params={"shape": (_N,)},
   check=lambda outs, args: _moments([outs[0][0]], 6.0, math.sqrt(18.0)))
+S("sample_exponential", lambda r: [np.array([2.0, 0.5], np.float32)],
+  params={"shape": (_N,)},
+  check=lambda outs, args: (
+      _moments([outs[0][0]], 0.5, 0.5),
+      _moments([outs[0][1]], 2.0, 2.0)))
+S("sample_poisson", lambda r: [np.array([4.0, 9.0], np.float32)],
+  params={"shape": (_N,)},
+  check=lambda outs, args: (
+      _moments([outs[0][0]], 4.0, 2.0),
+      _moments([outs[0][1]], 9.0, 3.0)))
+S("sample_negative_binomial", lambda r: [np.array([3.0], np.float32),
+                                         np.array([0.5], np.float32)],
+  params={"shape": (_N,)},
+  check=lambda outs, args: _moments([outs[0][0]], 3.0, math.sqrt(6.0),
+                                    tol=0.2))
+S("sample_generalized_negative_binomial",
+  lambda r: [np.array([2.0], np.float32), np.array([0.5], np.float32)],
+  params={"shape": (_N,)},
+  check=lambda outs, args: _moments([outs[0][0]], 2.0,
+                                    math.sqrt(2 + 0.5 * 4), tol=0.2))
 S("sample_multinomial", lambda r: [np.array([[0.7, 0.2, 0.1],
                                              [0.05, 0.05, 0.9]], np.float32)],
   params={"shape": (_N,)},
