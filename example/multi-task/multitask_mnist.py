@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Multi-task training (reference example/multi-task/example_multi_task.py):
+one shared trunk with two softmax heads — the digit class and an even/odd
+auxiliary task — trained jointly through the Module API on a
+``sym.Group`` of both outputs, with a per-task metric.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+
+def synthetic_digits(n, seed=0):
+    # class prototypes are FIXED (seed 0) so train/test share classes;
+    # only the per-example noise varies with the seed
+    protos = np.random.RandomState(0).uniform(0, 1, (10, 784)) \
+        .astype(np.float32)
+    r = np.random.RandomState(seed)
+    y = r.randint(0, 10, n)
+    x = protos[y] + 0.25 * r.randn(n, 784).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def build():
+    data = mx.sym.var("data")
+    shared = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    shared = mx.sym.Activation(shared, name="relu1", act_type="relu")
+    digit = mx.sym.FullyConnected(shared, name="fc_digit", num_hidden=10)
+    digit = mx.sym.SoftmaxOutput(digit, name="softmax_digit")
+    parity = mx.sym.FullyConnected(shared, name="fc_parity", num_hidden=2)
+    parity = mx.sym.SoftmaxOutput(parity, name="softmax_parity")
+    return mx.sym.Group([digit, parity])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Accuracy per head (the reference example defines the same)."""
+
+    def __init__(self, num=2):
+        self.num = num
+        super(MultiAccuracy, self).__init__("multi-accuracy")
+
+    def reset(self):
+        self.num_inst = [0] * self.num
+        self.sum_metric = [0.0] * self.num
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(axis=1)
+            label = labels[i].asnumpy().astype(np.int64)
+            self.sum_metric[i] += float((pred == label).sum())
+            self.num_inst[i] += len(label)
+
+    def get(self):
+        accs = [s / max(n, 1) for s, n in zip(self.sum_metric,
+                                              self.num_inst)]
+        return (["digit-acc", "parity-acc"], accs)
+
+
+def main():
+    mx.random.seed(11)
+    xtr, ytr = synthetic_digits(2048, seed=0)
+    xte, yte = synthetic_digits(512, seed=1)
+    batch = 128
+    train = mx.io.NDArrayIter(
+        xtr, {"softmax_digit_label": ytr,
+              "softmax_parity_label": (ytr % 2).astype(np.float32)},
+        batch, shuffle=True)
+    val = mx.io.NDArrayIter(
+        xte, {"softmax_digit_label": yte,
+              "softmax_parity_label": (yte % 2).astype(np.float32)}, batch)
+
+    mod = mx.mod.Module(build(), data_names=("data",),
+                        label_names=("softmax_digit_label",
+                                     "softmax_parity_label"))
+    mod.fit(train, eval_data=val, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3},
+            eval_metric=MultiAccuracy(), num_epoch=4)
+
+    metric = MultiAccuracy()
+    metric.reset()
+    val.reset()
+    for batch_data in val:
+        mod.forward(batch_data, is_train=False)
+        metric.update(batch_data.label, mod.get_outputs())
+    names, accs = metric.get()
+    for n, a in zip(names, accs):
+        print("%s: %.3f" % (n, a))
+    assert accs[0] > 0.9 and accs[1] > 0.9, accs
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Custom numpy operator (reference example/numpy-ops/custom_softmax.py):
+a softmax-with-loss head written as a ``mx.operator.CustomOp`` whose
+forward and backward are plain numpy, registered under an op_type and
+used from a Symbol graph through ``mx.sym.Custom`` — the python
+escape-hatch path (reference src/operator/custom/custom-inl.h).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super(SoftmaxProp, self).__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def main():
+    mx.random.seed(5)
+    r = np.random.RandomState(0)
+    y = r.randint(0, 10, 2048)
+    protos = r.uniform(0, 1, (10, 784)).astype(np.float32)
+    x_all = (protos[y] + 0.25 * r.randn(2048, 784)).astype(np.float32)
+    y_all = y.astype(np.float32)
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=10)
+    net = mx.sym.Custom(net, mx.sym.var("softmax_label"), name="softmax",
+                        op_type="softmax")
+
+    batch = 128
+    train = mx.io.NDArrayIter(x_all, y_all, batch, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc", num_epoch=4)
+    score = dict(mod.score(train, "acc"))
+    print("train accuracy: %.3f" % score["accuracy"])
+    assert score["accuracy"] > 0.9, score
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Large-margin classification with SVMOutput (reference
+example/svm_mnist/svm_mnist.py): an MLP whose head is the hinge-loss
+SVMOutput op (L1 and squared L2 variants), trained through the Module
+API on synthetic class-separable digits.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+
+def synthetic_digits(n, seed=0):
+    # class prototypes are FIXED (seed 0) so train/test share classes;
+    # only the per-example noise varies with the seed
+    protos = np.random.RandomState(0).uniform(0, 1, (10, 784)) \
+        .astype(np.float32)
+    r = np.random.RandomState(seed)
+    y = r.randint(0, 10, n)
+    x = protos[y] + 0.25 * r.randn(n, 784).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def build(use_linear=False):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=10)
+    # use_linear=True -> L1 hinge; False -> squared hinge (reference arg)
+    return mx.sym.SVMOutput(net, name="svm", use_linear=use_linear,
+                            margin=1.0, regularization_coefficient=1.0)
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(7)
+    xtr, ytr = synthetic_digits(2048, seed=0)
+    xte, yte = synthetic_digits(512, seed=1)
+    batch = 128
+    train = mx.io.NDArrayIter(xtr, ytr, batch, shuffle=True,
+                              label_name="svm_label")
+    val = mx.io.NDArrayIter(xte, yte, batch, label_name="svm_label")
+
+    # L2 (squared) hinge gradients grow with the violation, so it wants a
+    # smaller step than the bounded L1 hinge (same guidance as the
+    # reference example's lr choice)
+    for use_linear, lr in ((False, 1e-3), (True, 1e-2)):
+        mod = mx.mod.Module(build(use_linear), data_names=("data",),
+                            label_names=("svm_label",))
+        mod.fit(train, eval_data=val,
+                optimizer="sgd",
+                optimizer_params={"learning_rate": lr, "momentum": 0.9,
+                                  "wd": 1e-4},
+                eval_metric="acc", num_epoch=4)
+        score = mod.score(val, "acc")
+        acc = dict(score)["accuracy"]
+        print("use_linear=%s val accuracy: %.3f" % (use_linear, acc))
+        assert acc > 0.9, (use_linear, acc)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Variational autoencoder (reference example/vae/VAE.py): gluon
+encoder/decoder, the reparameterization trick drawn with
+``mx.nd.random_normal`` inside ``autograd.record``, ELBO = reconstruction
++ KL, trained with the gluon Trainer.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+from mxtpu import autograd, gluon  # noqa: E402
+from mxtpu.gluon import nn  # noqa: E402
+
+
+class VAE(gluon.Block):
+    """A plain (imperative) Block: the reparameterization draw reads the
+    concrete batch size, which a hybridized trace would not have."""
+
+    def __init__(self, n_latent=8, n_hidden=256, n_out=784, **kwargs):
+        super(VAE, self).__init__(**kwargs)
+        self.n_latent = n_latent
+        with self.name_scope():
+            self.enc = nn.HybridSequential()
+            self.enc.add(nn.Dense(n_hidden, activation="tanh"))
+            self.enc.add(nn.Dense(n_latent * 2))
+            self.dec = nn.HybridSequential()
+            self.dec.add(nn.Dense(n_hidden, activation="tanh"))
+            self.dec.add(nn.Dense(n_out, activation="sigmoid"))
+
+    def forward(self, x):
+        h = self.enc(x)
+        mu = mx.nd.slice_axis(h, axis=1, begin=0, end=self.n_latent)
+        log_var = mx.nd.slice_axis(h, axis=1, begin=self.n_latent,
+                                   end=2 * self.n_latent)
+        eps = mx.nd.random_normal(0, 1, shape=(x.shape[0], self.n_latent))
+        z = mu + mx.nd.exp(0.5 * log_var) * eps
+        y = self.dec(z)
+        # KL(q(z|x) || N(0,1)) per example
+        kl = -0.5 * mx.nd.sum(1 + log_var - mu * mu - mx.nd.exp(log_var),
+                              axis=1)
+        return y, kl
+
+
+def main():
+    mx.random.seed(3)
+    r = np.random.RandomState(0)
+    protos = r.uniform(0, 1, (10, 784)).astype(np.float32)
+    y = r.randint(0, 10, 2048)
+    x_all = np.clip(protos[y] + 0.1 * r.randn(2048, 784), 0, 1) \
+        .astype(np.float32)
+
+    net = VAE()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    batch = 128
+    first = last = None
+    for epoch in range(10):
+        tot = 0.0
+        for i in range(0, len(x_all), batch):
+            x = mx.nd.array(x_all[i:i + batch])
+            with autograd.record():
+                yhat, kl = net(x)
+                # Bernoulli reconstruction log-likelihood
+                logloss = -mx.nd.sum(
+                    x * mx.nd.log(yhat + 1e-10)
+                    + (1 - x) * mx.nd.log(1 - yhat + 1e-10), axis=1)
+                elbo_loss = logloss + kl
+            elbo_loss.backward()
+            trainer.step(batch)
+            tot += float(elbo_loss.mean().asnumpy())
+        avg = tot / (len(x_all) // batch)
+        if first is None:
+            first = avg
+        last = avg
+        print("epoch %d -ELBO %.2f" % (epoch, avg))
+    assert last < first * 0.8, (first, last)
+
+    # draw fresh digits from the prior through the trained decoder
+    z = mx.nd.random_normal(0, 1, shape=(4, net.n_latent))
+    samples = net.dec(z).asnumpy()
+    assert samples.shape == (4, 784) and np.isfinite(samples).all()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,11 @@ EXAMPLES = [
     ("recommenders/matrix_fact.py", {}),
     ("sparse/linear_classification.py", {}),
     ("autoencoder/mnist_sae.py", {}),
+    ("adversary/fgsm_mnist.py", {}),
+    ("svm_mnist/svm_mnist.py", {}),
+    ("multi-task/multitask_mnist.py", {}),
+    ("vae/vae_mnist.py", {}),
+    ("numpy-ops/custom_softmax.py", {}),
 ]
 
 
