@@ -1,0 +1,14 @@
+"""Optional-dependency plugins (reference ``plugin/``).
+
+The reference ships four plugin families: caffe (covered here by
+``tools/caffe_converter.py``), torch (covered by the DLPack bridge
+``mxtpu/torch.py``), opencv (``plugin/opencv/opencv.py`` — cv2-backed
+decode/augment + an image-list iterator) and sframe
+(``plugin/sframe/iter_sframe.cc`` — a columnar-dataframe DataIter).
+This package provides the latter two: ``mxtpu.plugin.opencv`` and
+``mxtpu.plugin.dataframe`` (pandas is the maintained columnar store that
+turi SFrame mapped to). Each module import-gates its optional dependency.
+"""
+from __future__ import annotations
+
+__all__ = ["opencv", "dataframe"]

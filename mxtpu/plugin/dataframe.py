@@ -1,0 +1,87 @@
+"""Columnar-dataframe DataIter (reference ``plugin/sframe/iter_sframe.cc``).
+
+The reference plugin iterates a turi/graphlab SFrame — an on-disk columnar
+dataframe — selecting one column (or column set) as data and one as label,
+batching into dense tensors. pandas is the maintained columnar store that
+fills SFrame's role today, so ``DataFrameIter`` exposes the same
+capability: pick ``data_field`` (str or list of str) and ``label_field``
+columns from a DataFrame, with cells that may be scalars or fixed-shape
+arrays, and iterate fixed-size padded batches through the DataIter
+protocol (reference SFrameParam: path_sframe/data_field/label_field,
+iter_sframe.cc:30-60).
+
+Requires the optional ``pandas`` package (import-gated).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..io import DataIter, DataDesc, DataBatch
+
+__all__ = ["DataFrameIter"]
+
+
+def _column_block(frame, field):
+    """A column (or list of columns) -> one 2-D+ numpy block."""
+    col = frame[field]
+    if isinstance(field, (list, tuple)):
+        return col.to_numpy().astype(_np.float32)
+    first = col.iloc[0]
+    if isinstance(first, (list, tuple, _np.ndarray)):
+        block = _np.stack([_np.asarray(v, _np.float32) for v in col])
+    else:
+        block = col.to_numpy().astype(_np.float32)
+    return block
+
+
+class DataFrameIter(DataIter):
+    """Iterate a pandas DataFrame as (data, label) batches.
+
+    Parameters
+    ----------
+    frame : pandas.DataFrame
+    data_field : str | list of str
+        Column(s) forming the data block. A single column may hold
+        fixed-shape array cells (the SFrame image/vector case); a column
+        list is stacked into a (batch, n_cols) matrix.
+    label_field : str, optional
+    batch_size : int
+    data_name / label_name : DataDesc names for Module binding.
+    """
+
+    def __init__(self, frame, data_field, label_field=None, batch_size=32,
+                 data_name="data", label_name="softmax_label"):
+        try:
+            import pandas  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "mxtpu.plugin.dataframe requires the pandas package") from e
+        super().__init__()
+        if len(frame) == 0:
+            raise ValueError("DataFrameIter needs a non-empty DataFrame")
+        self._data = _column_block(frame, data_field)
+        self._label = (_column_block(frame, label_field)
+                       if label_field is not None else None)
+        self.batch_size = batch_size
+        self._cursor = 0
+        self._n = len(self._data)
+        self.provide_data = [DataDesc(
+            data_name, (batch_size,) + self._data.shape[1:], "float32")]
+        self.provide_label = [] if self._label is None else [DataDesc(
+            label_name, (batch_size,) + self._label.shape[1:], "float32")]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= self._n:
+            raise StopIteration
+        end = min(self._cursor + self.batch_size, self._n)
+        pad = self.batch_size - (end - self._cursor)
+        idx = _np.arange(self._cursor, self._cursor + self.batch_size)
+        idx[idx >= self._n] = self._n - 1  # pad by repeating the last row
+        data = nd.array(self._data[idx])
+        label = [] if self._label is None else [nd.array(self._label[idx])]
+        self._cursor = end
+        return DataBatch(data=[data], label=label, pad=pad, index=None)

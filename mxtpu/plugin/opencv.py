@@ -1,0 +1,196 @@
+"""OpenCV plugin (reference ``plugin/opencv/opencv.py`` + ``cv_api.cc``).
+
+cv2-backed image decode and geometric augmenters returning NDArrays, plus
+``ImageListIter`` — the reference plugin's example iterator over a root
+directory + file list. The reference backs these with a private C API
+(``MXCVImdecode`` etc.); here cv2 already hands back numpy arrays that
+device-transfer straight into XLA buffers, so the python surface is the
+whole plugin.
+
+Requires the optional ``cv2`` package (import-gated).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover - exercised only without cv2
+    cv2 = None
+
+from .. import ndarray as nd
+from .. import io as _io
+
+__all__ = ["imdecode", "resize", "copyMakeBorder", "scale_down",
+           "fixed_crop", "random_crop", "color_normalize",
+           "random_size_crop", "ImageListIter"]
+
+
+def _require_cv2():
+    if cv2 is None:
+        raise ImportError("mxtpu.plugin.opencv requires the cv2 package")
+
+
+def imdecode(str_img, flag=1):
+    """Decode an encoded image byte string to an HWC BGR NDArray
+    (reference opencv.py:29 imdecode)."""
+    _require_cv2()
+    buf = _np.frombuffer(
+        str_img if isinstance(str_img, (bytes, bytearray))
+        else str_img.encode("latin-1"), dtype=_np.uint8)
+    img = cv2.imdecode(buf, flag)
+    if img is None:
+        raise ValueError("cv2 could not decode the image buffer")
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img.astype(_np.float32))
+
+
+def resize(src, size, interpolation=None):
+    """Resize to (w, h) (reference opencv.py:51). float32 in/out — cv2
+    resizes float images directly, so normalized values survive."""
+    _require_cv2()
+    interpolation = cv2.INTER_LINEAR if interpolation is None \
+        else interpolation
+    out = cv2.resize(src.asnumpy(), tuple(size),
+                     interpolation=interpolation)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=None, value=0):
+    """Pad an image (reference opencv.py:74). float32 in/out."""
+    _require_cv2()
+    border_type = cv2.BORDER_CONSTANT if border_type is None else border_type
+    out = cv2.copyMakeBorder(src.asnumpy(), top, bot,
+                             left, right, border_type, value=value)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out)
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit inside src_size keeping the aspect ratio
+    (reference opencv.py:97)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def _fixed_crop_np(arr, x0, y0, w, h, size=None, interpolation=None):
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != tuple(size):
+        _require_cv2()
+        interpolation = cv2.INTER_CUBIC if interpolation is None \
+            else interpolation
+        out = cv2.resize(out, tuple(size), interpolation=interpolation)
+    return out
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interpolation=None):
+    """Crop [y0:y0+h, x0:x0+w] and optionally resize (opencv.py:107).
+    float32 in/out."""
+    return nd.array(_fixed_crop_np(src.asnumpy(), x0, y0, w, h, size,
+                                   interpolation))
+
+
+def random_crop(src, size):
+    """Random crop to (w, h); returns (image, (x0, y0, w, h))
+    (opencv.py:114)."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = int(_np.random.uniform(0, w - new_w + 1))
+    y0 = int(_np.random.uniform(0, h - new_h + 1))
+    out = fixed_crop(src, x0, y0, new_w, new_h, size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """Subtract mean, divide by std (opencv.py:125) — delegates to the
+    framework-level implementation in mxtpu.image."""
+    from ..image import color_normalize as _cn
+    return _cn(src, mean, std)
+
+
+def random_size_crop(src, size, min_area=0.25, ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """Random area+aspect crop (the Inception-style crop, opencv.py:131)."""
+    h, w = src.shape[:2]
+    area = w * h
+    for _ in range(10):
+        new_area = _np.random.uniform(min_area, 1.0) * area
+        new_ratio = _np.random.uniform(*ratio)
+        new_w = int(round((new_area * new_ratio) ** 0.5))
+        new_h = int(round((new_area / new_ratio) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = int(_np.random.uniform(0, w - new_w + 1))
+            y0 = int(_np.random.uniform(0, h - new_h + 1))
+            out = fixed_crop(src, x0, y0, new_w, new_h, size)
+            return out, (x0, y0, new_w, new_h)
+    return random_crop(src, size)
+
+
+class ImageListIter(_io.DataIter):
+    """Iterate images listed one-name-per-line under a root directory
+    (reference plugin ImageListIter, opencv.py:155): decode with cv2,
+    random-crop to ``size`` = (w, h), emit NCHW float batches."""
+
+    def __init__(self, root, flist, batch_size, size, mean=None,
+                 suffix=".jpg"):
+        _require_cv2()
+        super().__init__()
+        self.root = root
+        if isinstance(flist, str):
+            with open(flist) as f:
+                self.list = [line.strip() for line in f if line.strip()]
+        else:
+            self.list = list(flist)
+        self.cur = 0
+        self.batch_size = batch_size
+        self.size = tuple(size)
+        self.suffix = suffix
+        self.mean = nd.array(mean) if mean is not None else None
+        w, h = self.size
+        self.provide_data = [_io.DataDesc(
+            "data", (batch_size, 3, h, w), "float32")]
+        self.provide_label = []
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= len(self.list):
+            raise StopIteration
+        w, h = self.size
+        batch = _np.zeros((self.batch_size, h, w, 3), _np.float32)
+        mean = self.mean.asnumpy() if self.mean is not None else None
+        n = 0
+        # the decode/crop loop stays in numpy — ONE device transfer per
+        # batch (the io.py iterator convention), not per image
+        while n < self.batch_size and self.cur < len(self.list):
+            path = os.path.join(self.root, self.list[self.cur] + self.suffix)
+            with open(path, "rb") as f:
+                buf = _np.frombuffer(f.read(), dtype=_np.uint8)
+            arr = cv2.imdecode(buf, 1)
+            if arr is None:
+                raise ValueError("cv2 could not decode %r" % path)
+            arr = arr.astype(_np.float32)
+            ih, iw = arr.shape[:2]
+            new_w, new_h = scale_down((iw, ih), self.size)
+            x0 = int(_np.random.uniform(0, iw - new_w + 1))
+            y0 = int(_np.random.uniform(0, ih - new_h + 1))
+            arr = _fixed_crop_np(arr, x0, y0, new_w, new_h, self.size)
+            if mean is not None:
+                arr = arr - mean
+            batch[n] = arr
+            n += 1
+            self.cur += 1
+        data = nd.array(batch.transpose(0, 3, 1, 2))
+        return _io.DataBatch(data=[data], label=[],
+                             pad=self.batch_size - n, index=None)

@@ -1,0 +1,117 @@
+"""Plugin parity tests (reference plugin/opencv, plugin/sframe)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+
+from mxtpu.plugin.dataframe import DataFrameIter  # noqa: E402
+
+
+def _cv():
+    """cv2 + the opencv plugin, or skip — kept per-test so the
+    pandas-only DataFrameIter tests still run without cv2."""
+    cv2 = pytest.importorskip("cv2")
+    from mxtpu.plugin import opencv as cvplug
+    return cv2, cvplug
+
+
+def _jpeg_bytes(cv2, h=48, w=64, seed=0):
+    img = np.random.RandomState(seed).randint(0, 255, (h, w, 3), np.uint8)
+    ok, buf = cv2.imencode(".jpg", img)
+    assert ok
+    return buf.tobytes(), img
+
+
+def test_imdecode_resize_border():
+    cv2, cvplug = _cv()
+    raw, img = _jpeg_bytes(cv2)
+    out = cvplug.imdecode(raw, 1)
+    assert out.shape == img.shape
+    small = cvplug.resize(out, (32, 24))
+    assert small.shape == (24, 32, 3)
+    padded = cvplug.copyMakeBorder(out, 2, 3, 4, 5)
+    assert padded.shape == (48 + 5, 64 + 9, 3)
+
+
+def test_float_values_survive_resize():
+    # normalized (negative/fractional) pixels must not wrap through uint8
+    cv2, cvplug = _cv()
+    raw, _ = _jpeg_bytes(cv2)
+    src = cvplug.imdecode(raw, 1)
+    n = cvplug.color_normalize(src, mx.nd.array(np.float32([120] * 3)),
+                               mx.nd.array(np.float32([60] * 3)))
+    out = cvplug.resize(n, (32, 24)).asnumpy()
+    assert out.min() < -0.1, "negative values should survive the resize"
+    assert abs(out.mean()) < 2.0
+
+
+def test_crops_and_normalize():
+    cv2, cvplug = _cv()
+    raw, _ = _jpeg_bytes(cv2)
+    src = cvplug.imdecode(raw, 1)
+    crop = cvplug.fixed_crop(src, 4, 2, 32, 24)
+    assert crop.shape == (24, 32, 3)
+    crop2, (x0, y0, w, h) = cvplug.random_crop(src, (20, 16))
+    assert crop2.shape == (16, 20, 3)
+    crop3, _ = cvplug.random_size_crop(src, (20, 16))
+    assert crop3.shape == (16, 20, 3)
+    assert cvplug.scale_down((10, 10), (20, 16)) == (10, 8)
+    n = cvplug.color_normalize(src, mx.nd.array(np.float32([120, 120, 120])),
+                               mx.nd.array(np.float32([60, 60, 60])))
+    assert abs(float(n.asnumpy().mean())) < 2.0
+
+
+def test_image_list_iter(tmp_path):
+    cv2, cvplug = _cv()
+    names = []
+    for i in range(5):
+        raw, _ = _jpeg_bytes(cv2, seed=i)
+        (tmp_path / ("img%d.jpg" % i)).write_bytes(raw)
+        names.append("img%d" % i)
+    it = cvplug.ImageListIter(str(tmp_path), names, batch_size=2,
+                              size=(32, 24))
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 3, 24, 32)
+    assert batches[-1].pad == 1
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_dataframe_iter_columns():
+    pd = pytest.importorskip("pandas")
+    df = pd.DataFrame({
+        "f1": np.arange(10, dtype=np.float32),
+        "f2": np.arange(10, dtype=np.float32) * 2,
+        "y": (np.arange(10) % 2).astype(np.float32),
+    })
+    it = DataFrameIter(df, data_field=["f1", "f2"], label_field="y",
+                       batch_size=4)
+    b = list(it)
+    assert len(b) == 3 and b[-1].pad == 2
+    assert b[0].data[0].shape == (4, 2)
+    np.testing.assert_allclose(b[0].data[0].asnumpy()[:, 1],
+                               [0, 2, 4, 6])
+
+
+def test_dataframe_iter_array_cells_module_fit():
+    pd = pytest.importorskip("pandas")
+    r = np.random.RandomState(0)
+    y = r.randint(0, 2, 64).astype(np.float32)
+    x = (y[:, None] * 2 - 1) + 0.3 * r.randn(64, 8).astype(np.float32)
+    df = pd.DataFrame({"vec": [row for row in x.astype(np.float32)],
+                       "y": y})
+    it = DataFrameIter(df, data_field="vec", label_field="y", batch_size=16)
+    assert it.provide_data[0].shape == (16, 8)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=4)
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
