@@ -214,3 +214,65 @@ def test_image_record_iter_fast_path(tmp_path):
 
 def raw_label(i):
     return i % 3
+
+
+def test_image_worker_cv2_pil_parity():
+    """The cv2 fast decode path and the PIL fallback produce identical
+    crop geometry and near-identical pixels (resize interpolation may
+    differ by a few intensity levels)."""
+    import io as _io
+    import numpy as np
+    import pytest
+    from PIL import Image
+    from mxtpu import _image_worker as w
+
+    pytest.importorskip("cv2")
+    # smooth content: interpolation backends agree closely on gradients
+    # but diverge on per-pixel noise (different sample alignment)
+    yy, xx = np.mgrid[0:48, 0:64]
+    arr = np.stack([(yy * 4) % 256, (xx * 3) % 256,
+                    ((yy + xx) * 2) % 256], axis=-1).astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    raw = buf.getvalue()
+
+    # deterministic config: resize shorter side then center crop
+    cfg = {"crop_h": 24, "crop_w": 24, "resize": 32, "rand_crop": False,
+           "rand_mirror": False}
+    w.init_worker(dict(cfg))
+    out_cv, _ = w.decode_augment((7, raw, 0.0))
+    w.init_worker(dict(cfg, force_pil=True))
+    out_pil, _ = w.decode_augment((7, raw, 0.0))
+    assert out_cv.shape == out_pil.shape == (24, 24, 3)
+    diff = np.abs(out_cv.astype(np.int32) - out_pil.astype(np.int32))
+    assert diff.mean() < 8.0, diff.mean()
+
+    # no-resize path is decode-exact (lossless PNG): bitwise equal
+    cfg2 = {"crop_h": 48, "crop_w": 64, "rand_crop": False,
+            "rand_mirror": False}
+    w.init_worker(dict(cfg2))
+    exact_cv, _ = w.decode_augment((3, raw, 0.0))
+    w.init_worker(dict(cfg2, force_pil=True))
+    exact_pil, _ = w.decode_augment((3, raw, 0.0))
+    np.testing.assert_array_equal(exact_cv, exact_pil)
+    np.testing.assert_array_equal(exact_cv, arr)
+
+
+def test_image_worker_gif_falls_back_to_pil():
+    """cv2 cannot decode GIF; the worker must fall back per record
+    instead of failing the pool (scraped-dataset stragglers)."""
+    import io as _io
+    import numpy as np
+    import pytest
+    from PIL import Image
+    from mxtpu import _image_worker as w
+
+    pytest.importorskip("cv2")
+    arr = (np.arange(32 * 32 * 3).reshape(32, 32, 3) % 256).astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).convert("P").save(buf, format="GIF")
+    w.init_worker({"crop_h": 32, "crop_w": 32, "rand_crop": False,
+                   "rand_mirror": False})
+    out, _ = w.decode_augment((0, buf.getvalue(), 0.0))
+    w.init_worker({})
+    assert out.shape == (32, 32, 3)
