@@ -34,8 +34,10 @@ def init_worker(cfg):
     _CFG.clear()
     _CFG.update(cfg)
     if _cv2 is not None:
-        # workers are the parallelism; no nested threads. Set here (not at
-        # import) so the parent's own cv2 users keep their threading.
+        # workers are the parallelism; no nested cv2 threads. Note this is
+        # process-wide: in-process callers (the unit-cost benchmark, the
+        # parity tests) also lose cv2-internal threading after init_worker,
+        # which is the behavior a single-core measurement wants anyway.
         _cv2.setNumThreads(0)
 
 

@@ -516,7 +516,7 @@ class _FastRecordIter:
     loop (iter_image_recordio_2.cc:138-149) rendered with spawned worker
     processes (Python threads are GIL-capped on the numpy portions of
     decode; processes are not). Workers run mxtpu/_image_worker.py, which
-    imports only numpy+PIL. ``prefetch_buffer`` batches stay in flight so
+    imports only numpy+cv2/PIL. ``prefetch_buffer`` batches stay in flight so
     decode overlaps the consumer's training step."""
 
     def __init__(self, items, batch_size, data_shape, cfg, shuffle,
