@@ -1,0 +1,382 @@
+"""Configuration-sweep depth for the high-traffic operators.
+
+The registry-wide sweep (tests/test_op_sweep.py) checks every op at ONE
+configuration; the reference's test_operator.py additionally walks the
+parameter spaces of the hot ops (kernel/stride/pad/dilate/groups for
+conv, conventions for pooling, axes for softmax/norm/transpose, transpose
+flags for dot, modes for take/clip). This file is that tier: each variant
+runs forward vs a numpy reference AND finite-difference gradients through
+the symbolic executor (``check_numeric_gradient``), so the Symbol path,
+the jitted executor and the vjp are all exercised per configuration.
+
+Reference: tests/python/unittest/test_operator.py (test_convolution_*,
+test_pooling_*, test_dot, test_take, test_transpose families).
+"""
+import importlib.util
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.ndarray as nd
+from mxtpu.test_utils import check_numeric_gradient, check_symbolic_forward
+
+_spec = importlib.util.spec_from_file_location(
+    "op_sweep_helpers",
+    os.path.join(os.path.dirname(__file__), "test_op_sweep.py"))
+_sweep = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_sweep)
+np_conv2d = _sweep.np_conv2d
+np_deconv2d = _sweep.np_deconv2d
+np_pool2d = _sweep.np_pool2d
+np_softmax = _sweep.np_softmax
+
+
+def _r(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _check(symf, args_np, ref_out, rtol=1e-3, atol=1e-4, grad=True,
+           aux=None):
+    """Forward vs numpy + FD gradients for a symbol-builder closure."""
+    names = ["a%d" % i for i in range(len(args_np))]
+    sym = symf(*[mx.sym.var(n) for n in names])
+    loc = dict(zip(names, args_np))
+    check_symbolic_forward(sym, loc, [ref_out], rtol=rtol, atol=atol,
+                           aux_states=aux)
+    if grad:
+        check_numeric_gradient(sym, loc, aux_states=aux, rtol=5e-2,
+                               atol=5e-3)
+
+
+# ---- Convolution variants -------------------------------------------------
+
+CONV_CASES = [
+    # (in_shape, num_filter, kernel, stride, pad, dilate, groups, bias)
+    ((1, 1, 7, 7), 2, (1, 1), (1, 1), (0, 0), (1, 1), 1, True),
+    ((2, 3, 6, 6), 4, (3, 3), (1, 1), (1, 1), (1, 1), 1, True),
+    ((1, 2, 8, 8), 3, (3, 3), (2, 2), (0, 0), (1, 1), 1, False),
+    ((1, 2, 9, 9), 2, (3, 3), (1, 1), (2, 2), (2, 2), 1, True),
+    ((1, 4, 6, 6), 4, (3, 3), (1, 1), (1, 1), (1, 1), 2, True),
+    ((1, 4, 5, 5), 4, (5, 5), (1, 1), (2, 2), (1, 1), 4, False),
+    ((2, 2, 7, 5), 3, (3, 1), (2, 1), (1, 0), (1, 1), 1, True),
+]
+
+
+@pytest.mark.parametrize("case", CONV_CASES,
+                         ids=lambda c: "c%s_k%s_s%s_p%s_d%s_g%d" % (
+                             c[0][1], c[2], c[3], c[4], c[5], c[6]))
+def test_convolution_variants(case):
+    in_shape, nf, kernel, stride, pad, dilate, groups, bias = case
+    r = _r(zlib.crc32(str(case).encode()))
+    x = r.uniform(-1, 1, in_shape).astype(np.float32)
+    w = r.uniform(-1, 1, (nf, in_shape[1] // groups) + kernel) \
+        .astype(np.float32)
+    b = r.uniform(-1, 1, (nf,)).astype(np.float32)
+
+    cin_g = in_shape[1] // groups
+    parts = []
+    for g in range(groups):
+        parts.append(np_conv2d(x[:, g * cin_g:(g + 1) * cin_g],
+                               w[g * (nf // groups):(g + 1) * (nf // groups)],
+                               None, stride=stride, pad=pad, dilate=dilate))
+    ref = np.concatenate(parts, axis=1)
+    if bias:
+        ref = ref + b.reshape(1, -1, 1, 1)
+
+    args = [x, w] + ([b] if bias else [])
+    _check(lambda *vs: mx.sym.Convolution(
+        *vs, kernel=kernel, num_filter=nf, stride=stride, pad=pad,
+        dilate=dilate, num_group=groups, no_bias=not bias),
+        args, ref)
+
+
+def test_convolution_1d_3d():
+    r = _r(1)
+    # 1-D (NCW)
+    x = r.uniform(-1, 1, (2, 3, 8)).astype(np.float32)
+    w = r.uniform(-1, 1, (4, 3, 3)).astype(np.float32)
+    ref = np_conv2d(x[:, :, None, :], w[:, :, None, :], None,
+                    stride=(1, 2), pad=(0, 1))[:, :, 0]
+    _check(lambda a, b: mx.sym.Convolution(a, b, kernel=(3,), num_filter=4,
+                                           stride=(2,), pad=(1,),
+                                           no_bias=True),
+           [x, w], ref)
+    # 3-D (NCDHW): check against explicit loop on a tiny case
+    x3 = r.uniform(-1, 1, (1, 2, 3, 4, 4)).astype(np.float32)
+    w3 = r.uniform(-1, 1, (2, 2, 2, 2, 2)).astype(np.float32)
+    out = np.zeros((1, 2, 2, 3, 3), np.float64)
+    for o in range(2):
+        for d in range(2):
+            for i in range(3):
+                for j in range(3):
+                    out[0, o, d, i, j] = (
+                        x3[0, :, d:d + 2, i:i + 2, j:j + 2] * w3[o]).sum()
+    _check(lambda a, b: mx.sym.Convolution(a, b, kernel=(2, 2, 2),
+                                           num_filter=2, no_bias=True),
+           [x3, w3], out.astype(np.float32))
+
+
+DECONV_CASES = [
+    ((1, 2, 4, 4), 3, (3, 3), (1, 1), (0, 0)),
+    ((1, 3, 4, 4), 2, (3, 3), (2, 2), (1, 1)),
+    ((2, 2, 3, 5), 2, (2, 4), (2, 1), (0, 1)),
+]
+
+
+@pytest.mark.parametrize("case", DECONV_CASES,
+                         ids=lambda c: "k%s_s%s_p%s" % (c[2], c[3], c[4]))
+def test_deconvolution_variants(case):
+    in_shape, nf, kernel, stride, pad = case
+    r = _r(zlib.crc32(str(case).encode()))
+    x = r.uniform(-1, 1, in_shape).astype(np.float32)
+    w = r.uniform(-1, 1, (in_shape[1], nf) + kernel).astype(np.float32)
+    ref = np_deconv2d(x, w, stride=stride, pad=pad)
+    _check(lambda a, b: mx.sym.Deconvolution(
+        a, b, kernel=kernel, num_filter=nf, stride=stride, pad=pad,
+        no_bias=True), [x, w], ref)
+
+
+# ---- Pooling variants -----------------------------------------------------
+
+POOL_CASES = [
+    ("max", (2, 2), (2, 2), (0, 0), "valid"),
+    ("max", (3, 3), (1, 1), (1, 1), "valid"),
+    ("avg", (2, 2), (2, 2), (0, 0), "valid"),
+    ("avg", (3, 3), (2, 2), (1, 1), "valid"),
+    ("max", (2, 2), (2, 2), (0, 0), "full"),
+    ("sum", (2, 2), (2, 2), (0, 0), "valid"),
+]
+
+
+@pytest.mark.parametrize("case", POOL_CASES,
+                         ids=lambda c: "%s_k%s_s%s_p%s_%s" % c)
+def test_pooling_variants(case):
+    pool_type, kernel, stride, pad, conv = case
+    r = _r(zlib.crc32(str(case).encode()))
+    # distinct values so max-pool FD has a unique argmax
+    n = 1 * 2 * 7 * 7
+    x = (r.permutation(np.arange(n) - n / 2) * 0.07) \
+        .reshape(1, 2, 7, 7).astype(np.float32)
+
+    if conv == "full":
+        # ceil-mode output; compute via padded-valid equivalence
+        H = 7 + 2 * pad[0]
+        oh = -(-(H - kernel[0]) // stride[0]) + 1
+        need = (oh - 1) * stride[0] + kernel[0] - H
+        xp = np.pad(x, ((0, 0), (0, 0),
+                        (pad[0], pad[0] + max(need, 0)),
+                        (pad[1], pad[1] + max(need, 0))),
+                    constant_values=-np.inf if pool_type == "max" else 0)
+        ref = np_pool2d(xp, kernel, pool_type, stride, (0, 0))
+    elif pool_type == "sum":
+        ref = np_pool2d(x, kernel, "avg", stride, pad) * \
+            (kernel[0] * kernel[1])
+    else:
+        ref = np_pool2d(x, kernel, pool_type, stride, pad)
+
+    _check(lambda a: mx.sym.Pooling(
+        a, kernel=kernel, pool_type=pool_type, stride=stride, pad=pad,
+        pooling_convention=conv), [x], ref)
+
+
+def test_global_pooling():
+    r = _r(3)
+    x = r.uniform(-1, 1, (2, 3, 5, 4)).astype(np.float32)
+    _check(lambda a: mx.sym.Pooling(a, global_pool=True, pool_type="avg",
+                                    kernel=(1, 1)),
+           [x], x.mean(axis=(2, 3), keepdims=True))
+    _check(lambda a: mx.sym.Pooling(a, global_pool=True, pool_type="max",
+                                    kernel=(1, 1)),
+           [x], x.max(axis=(2, 3), keepdims=True), grad=False)
+
+
+# ---- dot / batch_dot transpose flags --------------------------------------
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_dot_transpose_flags(ta, tb):
+    r = _r(4)
+    a = r.uniform(-1, 1, (4, 3) if ta else (3, 4)).astype(np.float32)
+    b = r.uniform(-1, 1, (5, 4) if tb else (4, 5)).astype(np.float32)
+    ref = (a.T if ta else a) @ (b.T if tb else b)
+    _check(lambda x, y: mx.sym.dot(x, y, transpose_a=ta, transpose_b=tb),
+           [a, b], ref)
+
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_batch_dot_transpose_flags(ta, tb):
+    r = _r(5)
+    a = r.uniform(-1, 1, (2, 4, 3) if ta else (2, 3, 4)).astype(np.float32)
+    b = r.uniform(-1, 1, (2, 5, 4) if tb else (2, 4, 5)).astype(np.float32)
+    ref = np.matmul(a.transpose(0, 2, 1) if ta else a,
+                    b.transpose(0, 2, 1) if tb else b)
+    _check(lambda x, y: mx.sym.batch_dot(x, y, transpose_a=ta,
+                                         transpose_b=tb), [a, b], ref)
+
+
+# ---- softmax / norm axes --------------------------------------------------
+
+@pytest.mark.parametrize("axis", [-1, 0, 1, 2])
+def test_softmax_axes(axis):
+    r = _r(6)
+    x = r.uniform(-2, 2, (3, 4, 5)).astype(np.float32)
+    _check(lambda a: mx.sym.softmax(a, axis=axis), [x],
+           np_softmax(x, axis=axis))
+
+
+@pytest.mark.parametrize("axis,keepdims,ord", [(0, False, 2), (1, True, 2),
+                                               ((0, 1), False, 2),
+                                               (1, False, 1)])
+def test_norm_axes(axis, keepdims, ord):
+    r = _r(7)
+    x = r.uniform(-2, 2, (3, 4)).astype(np.float32) + 0.5
+    if ord == 2:
+        ref = np.sqrt((x ** 2).sum(axis=axis, keepdims=keepdims))
+    else:
+        ref = np.abs(x).sum(axis=axis, keepdims=keepdims)
+    ref = np.asarray(ref, np.float32)
+    _check(lambda a: mx.sym.norm(a, ord=ord, axis=axis, keepdims=keepdims),
+           [x], ref, grad=(ord == 2))
+
+
+# ---- BatchNorm axis + training-mode stats ---------------------------------
+
+@pytest.mark.parametrize("axis", [1, -1])
+def test_batchnorm_axis_training_stats(axis):
+    r = _r(8)
+    x = r.uniform(-1, 1, (4, 3, 5)).astype(np.float32)
+    C = x.shape[axis]
+    g = r.uniform(0.5, 1.5, (C,)).astype(np.float32)
+    b = r.uniform(-0.5, 0.5, (C,)).astype(np.float32)
+    mm = np.zeros(C, np.float32)
+    mv = np.ones(C, np.float32)
+    red = tuple(i for i in range(3) if i != (axis % 3))
+    mean = x.mean(axis=red)
+    var = x.var(axis=red)
+    shape = [1, 1, 1]
+    shape[axis % 3] = C
+    ref = ((x - mean.reshape(shape)) / np.sqrt(var.reshape(shape) + 1e-3)
+           * g.reshape(shape) + b.reshape(shape))
+
+    xs, gs, bs = mx.sym.var("a0"), mx.sym.var("a1"), mx.sym.var("a2")
+    mms = mx.sym.var("mm")
+    mvs = mx.sym.var("mv")
+    sym = mx.sym.BatchNorm(xs, gs, bs, mms, mvs, fix_gamma=False,
+                           axis=axis, eps=1e-3)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         a0=x.shape, a1=g.shape, a2=b.shape)
+    ex.arg_dict["a0"][:] = x
+    ex.arg_dict["a1"][:] = g
+    ex.arg_dict["a2"][:] = b
+    ex.aux_dict["mm"][:] = mm
+    ex.aux_dict["mv"][:] = mv
+    out = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+    # moving stats updated toward batch stats (momentum 0.9)
+    np.testing.assert_allclose(ex.aux_dict["mm"].asnumpy(),
+                               0.1 * mean, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(ex.aux_dict["mv"].asnumpy(),
+                               0.9 + 0.1 * var, rtol=1e-3, atol=1e-5)
+
+
+# ---- take / clip / transpose / reshape ------------------------------------
+
+@pytest.mark.parametrize("axis,mode", [(0, "clip"), (1, "clip"),
+                                       (0, "wrap"), (2, "clip")])
+def test_take_variants(axis, mode):
+    r = _r(9)
+    x = r.uniform(-1, 1, (4, 5, 6)).astype(np.float32)
+    raw = np.array([[0, 2], [7, -1]], np.int64)  # out-of-range on purpose
+    if mode == "clip":
+        eff = np.clip(raw, 0, x.shape[axis] - 1)
+    else:  # wrap
+        eff = raw % x.shape[axis]
+    ref = np.take(x, eff, axis=axis)
+    out = nd.take(nd.array(x), nd.array(raw.astype(np.float32)),
+                  axis=axis, mode=mode).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_transpose_orders():
+    r = _r(10)
+    x = r.uniform(-1, 1, (2, 3, 4, 5)).astype(np.float32)
+    for axes in [(0, 1, 2, 3), (3, 2, 1, 0), (0, 2, 1, 3), (1, 0, 3, 2)]:
+        _check(lambda a, axes=axes: mx.sym.transpose(a, axes=axes),
+               [x], x.transpose(axes), grad=False)
+    _check(lambda a: mx.sym.transpose(a), [x], x.T, grad=True)
+
+
+def test_reshape_special_codes():
+    """MXNet reshape special values (reference matrix_op-inl.h):
+    0=copy dim, -1=infer, -2=copy rest, -3=merge two, -4=split."""
+    r = _r(11)
+    x = r.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    cases = [
+        ((0, -1), (2, 12)),
+        ((-1,), (24,)),
+        ((0, 0, 0), (2, 3, 4)),
+        ((-2,), (2, 3, 4)),
+        ((-3, 0), (6, 4)),
+        ((0, -3), (2, 12)),
+        ((-4, 1, 2, 0, 0), (1, 2, 3, 4)),
+        ((6, -1), (6, 4)),
+    ]
+    for shape_arg, want in cases:
+        out = nd.reshape(nd.array(x), shape=shape_arg)
+        assert out.shape == want, (shape_arg, out.shape, want)
+        np.testing.assert_allclose(out.asnumpy().ravel(), x.ravel())
+
+
+def test_clip_gradient_at_bounds():
+    r = _r(12)
+    x = np.array([-2.0, -0.5, 0.3, 0.9, 2.5], np.float32)
+    _check(lambda a: mx.sym.clip(a, a_min=-1.0, a_max=1.0), [x],
+           np.clip(x, -1, 1), grad=False)
+    # gradient: 1 inside, 0 outside
+    xn = nd.array(x)
+    xn.attach_grad()
+    import mxtpu.autograd as ag
+    with ag.record():
+        y = nd.clip(xn, a_min=-1.0, a_max=1.0)
+    y.backward()
+    np.testing.assert_allclose(xn.grad.asnumpy(), [0, 1, 1, 1, 0])
+
+
+# ---- broadcasting edge shapes ---------------------------------------------
+
+@pytest.mark.parametrize("sa,sb", [((1,), (3, 1)), ((2, 1, 4), (1, 3, 1)),
+                                   ((3, 1), (3, 4)), ((1, 1), (2, 3))])
+def test_broadcast_edge_shapes(sa, sb):
+    r = _r(13)
+    a = r.uniform(-1, 1, sa).astype(np.float32)
+    b = r.uniform(0.5, 1.5, sb).astype(np.float32)
+    for opn, npf in [("broadcast_add", np.add), ("broadcast_mul",
+                                                 np.multiply),
+                     ("broadcast_div", np.divide),
+                     ("broadcast_maximum", np.maximum)]:
+        _check(lambda x, y, opn=opn: getattr(mx.sym, opn)(x, y),
+               [a, b], npf(a, b))
+
+
+# ---- slice variants -------------------------------------------------------
+
+def test_slice_variants():
+    r = _r(14)
+    x = r.uniform(-1, 1, (4, 6, 5)).astype(np.float32)
+    cases = [
+        ({"begin": (1,), "end": (3,)}, x[1:3]),
+        ({"begin": (0, 2), "end": (4, 5)}, x[:, 2:5]),
+        ({"begin": (1, 0, 1), "end": (3, 6, 4), "step": (1, 2, 1)},
+         x[1:3, ::2, 1:4]),
+        ({"begin": (None, 4), "end": (None, 1), "step": (None, -1)},
+         x[:, 4:1:-1]),
+    ]
+    for params, ref in cases:
+        _check(lambda a, params=params: mx.sym.slice(a, **params), [x],
+               ref, grad=False)
+    _check(lambda a: mx.sym.slice_axis(a, axis=2, begin=-3, end=-1), [x],
+           x[:, :, -3:-1])
