@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Sort sequences with a bidirectional LSTM (reference
+example/bi-lstm-sort/): the network reads a sequence of digit tokens and
+emits the same tokens in sorted order, one classification per position —
+the classic demo that a BiLSTM can learn content+position reasoning.
+Uses the symbolic ``mx.rnn`` cell API (BidirectionalCell over LSTMCells,
+unrolled) through the Module API.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+SEQ_LEN = 6
+VOCAB = 10
+
+
+def make_data(n, seed):
+    r = np.random.RandomState(seed)
+    x = r.randint(0, VOCAB, (n, SEQ_LEN)).astype(np.float32)
+    y = np.sort(x, axis=1).astype(np.float32)
+    return x, y
+
+
+def build():
+    data = mx.sym.var("data")
+    embed = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=32,
+                             name="embed")
+    stack = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(num_hidden=64, prefix="l_"),
+        mx.rnn.LSTMCell(num_hidden=64, prefix="r_"))
+    outputs, _ = stack.unroll(SEQ_LEN, inputs=embed, merge_outputs=True)
+    # per-position classifier over the vocabulary
+    pred = mx.sym.Reshape(outputs, shape=(-1, 128))
+    pred = mx.sym.FullyConnected(pred, num_hidden=VOCAB, name="cls")
+    label = mx.sym.Reshape(mx.sym.var("softmax_label"), shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, label, name="softmax")
+
+
+def main():
+    mx.random.seed(9)
+    xtr, ytr = make_data(8192, 0)
+    xte, yte = make_data(512, 1)
+    batch = 128
+    train = mx.io.NDArrayIter(xtr, ytr, batch, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(build(), data_names=("data",),
+                        label_names=("softmax_label",))
+    # per-position outputs are flattened to (batch*seq, vocab), so the
+    # seq-task metric is Perplexity (as the reference's RNN examples use;
+    # Accuracy requires label/pred leading dims to match)
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": 3e-3},
+            eval_metric=mx.metric.Perplexity(ignore_label=None),
+            num_epoch=12)
+
+    val = mx.io.NDArrayIter(xte, yte, batch, label_name="softmax_label")
+    correct = total = 0
+    for b in val:
+        mod.forward(b, is_train=False)
+        out = mod.get_outputs()[0].asnumpy()
+        pred = out.reshape(batch, SEQ_LEN, VOCAB).argmax(axis=2)
+        lab = b.label[0].asnumpy().astype(np.int64)
+        k = batch - (b.pad or 0)
+        correct += (pred[:k] == lab[:k]).sum()
+        total += k * SEQ_LEN
+    acc = correct / total
+    print("per-position sort accuracy: %.3f" % acc)
+    assert acc > 0.85, acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""CNN for sentence classification (reference
+example/cnn_text_classification/text_cnn.py, the Kim-2014 architecture):
+embedding -> parallel Conv2D branches over n-gram windows -> max-over-time
+pooling -> concat -> dropout -> dense, built symbolically and trained
+through the Module API. Data is synthetic: the class is determined by
+which "signal" bigram appears in the token sequence, so the conv filters
+must learn n-gram detectors.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+SEQ_LEN = 20
+VOCAB = 50
+EMBED = 16
+FILTERS = (2, 3, 4)
+NUM_FILTER = 8
+
+
+def make_data(n, seed):
+    """Class c in {0,1,2}: the bigram (c+1, c+1) appears somewhere."""
+    r = np.random.RandomState(seed)
+    y = r.randint(0, 3, n)
+    x = r.randint(4, VOCAB, (n, SEQ_LEN))
+    pos = r.randint(0, SEQ_LEN - 1, n)
+    for i in range(n):
+        x[i, pos[i]] = y[i] + 1
+        x[i, pos[i] + 1] = y[i] + 1
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def build():
+    data = mx.sym.var("data")
+    embed = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                             name="embed")
+    # (batch, 1, seq, embed) image for the n-gram convs
+    conv_in = mx.sym.Reshape(embed, shape=(-1, 1, SEQ_LEN, EMBED))
+    pooled = []
+    for width in FILTERS:
+        c = mx.sym.Convolution(conv_in, kernel=(width, EMBED),
+                               num_filter=NUM_FILTER,
+                               name="conv%d" % width)
+        c = mx.sym.Activation(c, act_type="relu")
+        p = mx.sym.Pooling(c, kernel=(SEQ_LEN - width + 1, 1),
+                           pool_type="max")
+        pooled.append(p)
+    h = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Reshape(h, shape=(-1, NUM_FILTER * len(FILTERS)))
+    h = mx.sym.Dropout(h, p=0.3)
+    fc = mx.sym.FullyConnected(h, num_hidden=3, name="cls")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    mx.random.seed(13)
+    xtr, ytr = make_data(4096, 0)
+    xte, yte = make_data(512, 1)
+    batch = 128
+    train = mx.io.NDArrayIter(xtr, ytr, batch, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(xte, yte, batch, label_name="softmax_label")
+    mod = mx.mod.Module(build(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_data=val, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-3},
+            eval_metric="acc", num_epoch=6)
+    acc = dict(mod.score(val, "acc"))["accuracy"]
+    print("val accuracy: %.3f" % acc)
+    assert acc > 0.9, acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Noise-contrastive estimation for a large-softmax language model
+(reference example/nce-loss/: LogisticRegressionOutput over sampled
+negatives instead of a full softmax). A skip-gram-style toy task: predict
+the "context" token from a center token where each center deterministically
+maps to one context; NCE trains output embeddings with k sampled noise
+labels per example, then evaluation ranks the true context against the
+full vocabulary by dot product.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+from mxtpu import autograd, gluon  # noqa: E402
+from mxtpu.gluon import nn  # noqa: E402
+
+VOCAB = 200
+EMBED = 32
+K_NOISE = 8
+
+
+class NCEModel(gluon.Block):
+    def __init__(self, **kw):
+        super(NCEModel, self).__init__(**kw)
+        with self.name_scope():
+            self.in_embed = nn.Embedding(VOCAB, EMBED)
+            self.out_embed = nn.Embedding(VOCAB, EMBED)
+
+    def forward(self, center, labels):
+        """labels: (batch, 1+K) — true context then K noise draws.
+        Returns logits (batch, 1+K) = <in_embed(center), out_embed(l)>."""
+        e_in = self.in_embed(center)              # (B, D)
+        e_out = self.out_embed(labels)            # (B, 1+K, D)
+        return mx.nd.batch_dot(
+            e_out, mx.nd.reshape(e_in, shape=(-1, EMBED, 1))) \
+            .reshape((labels.shape[0], labels.shape[1]))
+
+
+def main():
+    mx.random.seed(17)
+    r = np.random.RandomState(0)
+    mapping = r.permutation(VOCAB)  # center c -> context mapping[c]
+
+    net = NCEModel()
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+
+    batch = 256
+    for step in range(400):
+        center = r.randint(0, VOCAB, batch)
+        true_ctx = mapping[center]
+        noise = r.randint(0, VOCAB, (batch, K_NOISE))
+        labels = np.concatenate([true_ctx[:, None], noise], axis=1)
+        target = np.zeros((batch, 1 + K_NOISE), np.float32)
+        target[:, 0] = 1.0
+        c_nd = mx.nd.array(center.astype(np.float32))
+        l_nd = mx.nd.array(labels.astype(np.float32))
+        with autograd.record():
+            logits = net(c_nd, l_nd)
+            l = loss_fn(logits, mx.nd.array(target))
+        l.backward()
+        trainer.step(batch)
+        if step % 100 == 0:
+            print("step %d nce loss %.4f" % (step,
+                                             float(l.mean().asnumpy())))
+
+    # full-vocab ranking: true context should be the top inner product
+    centers = np.arange(VOCAB, dtype=np.float32)
+    e_in = net.in_embed(mx.nd.array(centers)).asnumpy()
+    e_out = net.out_embed(mx.nd.array(centers)).asnumpy()
+    scores = e_in @ e_out.T
+    pred = scores.argmax(axis=1)
+    acc = float((pred == mapping).mean())
+    print("full-vocab retrieval accuracy: %.3f" % acc)
+    assert acc > 0.9, acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

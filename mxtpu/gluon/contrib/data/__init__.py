@@ -1,3 +1,5 @@
 from .sampler import IntervalSampler
+from . import text
+from .text import WikiText2, WikiText103
 
-__all__ = ["IntervalSampler"]
+__all__ = ["IntervalSampler", "text", "WikiText2", "WikiText103"]

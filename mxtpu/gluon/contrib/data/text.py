@@ -1,0 +1,123 @@
+"""Language-modeling text datasets (reference
+``python/mxnet/gluon/contrib/data/text.py``: WikiText2 / WikiText103).
+
+Same API as the reference — fixed-length (data, label) index-vector
+samples with next-token labels, an auto-built ``Vocabulary`` with
+``<eos>`` appended per line, and token ``frequencies`` — but sourcing is
+offline-first: the reference downloads the Salesforce archives at
+construction; here the extracted token files are read from ``root``
+(place ``wiki.{train,valid,test}.tokens`` there yourself, or pass any
+corpus file via ``filename``). This build runs in a zero-egress
+environment, so implicit downloading is deliberately not implemented —
+construction fails with instructions instead of a hang.
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ...data import dataset
+from ....contrib import text as _text
+from .... import ndarray as nd
+
+__all__ = ["WikiText2", "WikiText103"]
+
+EOS_TOKEN = "<eos>"
+
+
+_SEGMENT_ALIASES = {"train": "train", "val": "valid", "validation": "valid",
+                    "valid": "valid", "test": "test"}
+
+
+class _WikiText(dataset.Dataset):
+    _namespace = None
+    _file_pattern = None
+
+    def __init__(self, root, segment, seq_len, vocab=None, filename=None):
+        if segment not in _SEGMENT_ALIASES:
+            raise ValueError(
+                "segment must be one of %s, got %r"
+                % (sorted(_SEGMENT_ALIASES), segment))
+        segment = _SEGMENT_ALIASES[segment]
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = seq_len
+        self._vocab = vocab
+        self._counter = None
+        path = filename or os.path.join(
+            self._root, self._file_pattern % segment)
+        if not os.path.exists(path):
+            raise IOError(
+                "%s not found. This environment has no network egress, so "
+                "the dataset is not auto-downloaded; obtain the %s token "
+                "archive and place the extracted file at %r (or pass "
+                "filename=)." % (path, self._namespace, path))
+        data, label = self._read(path)
+        n = (len(data) // seq_len) * seq_len
+        self._data = nd.array(data[:n].reshape(-1, seq_len), dtype="int32")
+        self._label = nd.array(label[:n].reshape(-1, seq_len), dtype="int32")
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _read(self, path):
+        import collections
+        with io.open(path, "r", encoding="utf8") as f:
+            content = f.read()
+        # single tokenization pass; the counter is derived from the same
+        # token list (reference counts with count_tokens_from_str, whose
+        # default whitespace tokenization this matches)
+        tokens = []
+        counter = collections.Counter()
+        for raw_line in content.splitlines():
+            line = raw_line.strip().split()
+            if line:
+                counter.update(line)
+                tokens.extend(line)
+                tokens.append(EOS_TOKEN)
+        if self._counter is None:
+            self._counter = counter
+        if self._vocab is None:
+            self._vocab = _text.vocab.Vocabulary(
+                counter=self._counter, reserved_tokens=[EOS_TOKEN])
+        idx = np.array(self._vocab.to_indices(tokens), np.int32)
+        return idx[:-1], idx[1:]
+
+    def __getitem__(self, i):
+        return self._data[i], self._label[i]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 word-level LM dataset (reference text.py WikiText2).
+
+    Each sample is a (seq_len,) int32 vector; label is the next-token
+    shift. ``segment`` is train/val/test.
+    """
+
+    _namespace = "wikitext-2"
+    _file_pattern = "wiki.%s.tokens"
+
+    def __init__(self, root="~/.mxtpu/datasets/wikitext-2", segment="train",
+                 vocab=None, seq_len=35, filename=None):
+        super().__init__(root, segment, seq_len, vocab, filename)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 word-level LM dataset (reference text.py WikiText103)."""
+
+    _namespace = "wikitext-103"
+    _file_pattern = "wiki.%s.tokens"
+
+    def __init__(self, root="~/.mxtpu/datasets/wikitext-103",
+                 segment="train", vocab=None, seq_len=35, filename=None):
+        super().__init__(root, segment, seq_len, vocab, filename)

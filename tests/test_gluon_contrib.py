@@ -142,3 +142,30 @@ def test_modifier_cell_default_unroll():
         with mx.autograd.record():
             outputs, _ = cell.unroll(3, seq, merge_outputs=False)
         assert outputs[-1].shape == (2, 4)
+
+
+def test_wikitext_dataset_local_file(tmp_path):
+    """WikiText2 reads a local token file: vocab with <eos>, next-token
+    labels, fixed-length samples (reference gluon/contrib/data/text.py)."""
+    import numpy as np
+    import pytest
+    from mxtpu.gluon.contrib.data import WikiText2
+
+    corpus = tmp_path / "wiki.train.tokens"
+    corpus.write_text("the cat sat\nthe dog ran\n\nthe cat ran\n")
+    ds = WikiText2(root=str(tmp_path), segment="train", seq_len=4)
+    assert len(ds) >= 2
+    d0, l0 = ds[0]
+    assert d0.shape == (4,) and l0.shape == (4,)
+    # label is the next-token shift of data
+    d1, _ = ds[1]
+    np.testing.assert_array_equal(l0.asnumpy()[:3], d0.asnumpy()[1:])
+    np.testing.assert_array_equal(l0.asnumpy()[3], d1.asnumpy()[0])
+    # every line ends in <eos>; 'the' is the most frequent real token
+    vocab = ds.vocabulary
+    assert ds.frequencies["the"] == 3
+    eos_id = vocab.to_indices(["<eos>"])[0]
+    assert eos_id in ds[0][0].asnumpy().tolist() + ds[0][1].asnumpy().tolist()
+    # missing file fails with instructions, not a hang/download
+    with pytest.raises(IOError):
+        WikiText2(root=str(tmp_path / "nope"), segment="train")

@@ -25,6 +25,9 @@ EXAMPLES = [
     ("multi-task/multitask_mnist.py", {}),
     ("vae/vae_mnist.py", {}),
     ("numpy-ops/custom_softmax.py", {}),
+    ("bi-lstm-sort/sort_lstm.py", {}),
+    ("cnn_text_classification/text_cnn.py", {}),
+    ("nce-loss/nce_lm.py", {}),
 ]
 
 
