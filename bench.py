@@ -49,7 +49,8 @@ PROBE_RETRIES = 3
 PROBE_RETRY_WAIT_S = 20
 
 
-def probe_backend():
+def probe_backend(timeout=PROBE_TIMEOUT_S, retries=PROBE_RETRIES,
+                  retry_wait=PROBE_RETRY_WAIT_S):
     """Check backend liveness in a killable subprocess.
 
     Returns ``(platform, device_kind)`` — platform is None when nothing
@@ -57,7 +58,8 @@ def probe_backend():
     platform string ("tpu", "cpu", ...). Retries a few times with a
     pause — transient relay hiccups sometimes clear in seconds;
     multi-hour wedges won't, and we must not hang the driver's bench run
-    on them.
+    on them. The single shared probe — tools/diagnose.py reuses it with
+    its own timeout so both report the relay's state identically.
     """
     code = (
         # the sitecustomize's config.update overrides JAX_PLATFORMS; re-
@@ -68,19 +70,19 @@ def probe_backend():
         "d = jax.devices()[0]; "
         "print(d.platform + '|' + getattr(d, 'device_kind', ''))"
     )
-    for attempt in range(PROBE_RETRIES):
+    for attempt in range(retries):
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+                capture_output=True, text=True, timeout=timeout,
             )
             if out.returncode == 0 and out.stdout.strip():
                 platform, _, kind = out.stdout.strip().partition("|")
                 return platform, (kind or platform)
         except subprocess.TimeoutExpired:
             pass
-        if attempt < PROBE_RETRIES - 1:
-            time.sleep(PROBE_RETRY_WAIT_S)
+        if attempt < retries - 1:
+            time.sleep(retry_wait)
     return None, None
 
 

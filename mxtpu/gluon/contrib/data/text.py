@@ -82,8 +82,7 @@ class _WikiText(dataset.Dataset):
                 counter.update(line)
                 tokens.extend(line)
                 tokens.append(EOS_TOKEN)
-        if self._counter is None:
-            self._counter = counter
+        self._counter = counter
         if self._vocab is None:
             self._vocab = _text.vocab.Vocabulary(
                 counter=self._counter, reserved_tokens=[EOS_TOKEN])

@@ -276,3 +276,28 @@ def test_image_worker_gif_falls_back_to_pil():
     out, _ = w.decode_augment((0, buf.getvalue(), 0.0))
     w.init_worker({})
     assert out.shape == (32, 32, 3)
+
+
+def test_rec2idx_tool(tmp_path):
+    """tools/rec2idx.py regenerates a random-access index for a bare .rec
+    (reference tools/rec2idx.py)."""
+    import subprocess
+    import sys
+    from mxtpu import recordio
+
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    payloads = [b"payload-%d" % i for i in range(7)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "rec2idx.py"), rec],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    r = recordio.MXIndexedRecordIO(str(tmp_path / "t.idx"), rec, "r")
+    assert r.read_idx(0) == payloads[0]
+    assert r.read_idx(6) == payloads[6]
+    assert sorted(r.keys) == list(range(7))

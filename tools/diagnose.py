@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Environment diagnostic (reference tools/diagnose.py): OS / hardware /
+python / mxtpu / backend sections, printable into bug reports.
+
+The backend section probes the accelerator in a TIMEOUT-BOUNDED
+subprocess (this environment's TPU relay can wedge indefinitely — an
+in-process jax.devices() would hang the diagnostic itself; see
+bench.py's probe).
+
+Usage: python tools/diagnose.py [--timeout SECONDS]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def section(title):
+    print("-" * 24)
+    print(title)
+    print("-" * 24)
+
+
+def check_os():
+    section("Platform")
+    print("system   :", platform.system(), platform.release())
+    print("machine  :", platform.machine())
+    print("version  :", platform.version())
+    print("node     :", platform.node())
+
+
+def check_hardware():
+    section("Hardware")
+    print("cpu_count:", os.cpu_count())
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith(("MemTotal", "MemAvailable")):
+                    print(line.strip())
+    except IOError:
+        pass
+    try:
+        with open("/proc/cpuinfo") as f:
+            models = [l.split(":", 1)[1].strip() for l in f
+                      if l.startswith("model name")]
+        if models:
+            print("cpu model:", models[0], "x%d" % len(models))
+    except IOError:
+        pass
+
+
+def check_python():
+    section("Python")
+    print("version  :", sys.version.replace("\n", " "))
+    print("exe      :", sys.executable)
+    for mod in ("numpy", "jax", "jaxlib", "flax", "optax", "orbax",
+                "PIL", "cv2", "pandas", "torch"):
+        try:
+            m = __import__(mod)
+            print("%-9s: %s" % (mod, getattr(m, "__version__", "present")))
+        except ImportError:
+            print("%-9s: NOT INSTALLED" % mod)
+
+
+def check_mxtpu():
+    section("mxtpu")
+    try:
+        import mxtpu
+        print("version  :", getattr(mxtpu, "__version__", "dev"))
+        print("path     :", os.path.dirname(mxtpu.__file__))
+        from mxtpu.ops.registry import _REGISTRY
+        canonical = {op.name for op in _REGISTRY.values()}
+        print("ops      : %d canonical (%d incl. aliases)"
+              % (len(canonical), len(_REGISTRY)))
+        so = os.path.join(os.path.dirname(mxtpu.__file__), "_native")
+        native = [f for f in os.listdir(so)
+                  if f.endswith(".so")] if os.path.isdir(so) else []
+        print("native   :", ", ".join(native) if native
+              else "(not built; make -C mxtpu/_native)")
+    except Exception as e:
+        print("IMPORT FAILED:", repr(e))
+
+
+def check_backend(timeout):
+    section("Accelerator backend (bounded probe)")
+    print("JAX_PLATFORMS =", os.environ.get("JAX_PLATFORMS", "(unset)"))
+    print("XLA_FLAGS     =", os.environ.get("XLA_FLAGS", "(unset)"))
+    # the ONE shared probe (bench.py probe_backend) so diagnose and the
+    # bench driver always report the relay's state the same way
+    from bench import probe_backend
+    t0 = time.time()
+    platform, kind = probe_backend(timeout=timeout, retries=1)
+    dt = time.time() - t0
+    if platform is not None:
+        print("device 0 : %s (%s)  [%.1fs]" % (platform, kind, dt))
+    else:
+        print("probe TIMED OUT after %ds — backend init is wedged (if "
+              "this host uses the axon TPU relay, that is the known "
+              "failure mode; run CPU-only with JAX_PLATFORMS=cpu)"
+              % timeout)
+
+
+def check_env():
+    section("MXTPU_* / MXNET_* environment")
+    found = False
+    for k in sorted(os.environ):
+        if k.startswith(("MXTPU_", "MXNET_", "JAX_", "XLA_")):
+            print("%s=%s" % (k, os.environ[k]))
+            found = True
+    if not found:
+        print("(none set)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=int, default=60,
+                    help="backend probe timeout in seconds")
+    ap.add_argument("--skip-backend", action="store_true",
+                    help="skip the accelerator probe entirely")
+    args = ap.parse_args()
+    check_os()
+    check_hardware()
+    check_python()
+    check_mxtpu()
+    check_env()
+    if not args.skip_backend:
+        check_backend(args.timeout)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Regenerate the .idx for an existing RecordIO file (reference
+tools/rec2idx.py): walks the packed records sequentially, recording each
+record's byte offset so MXIndexedRecordIO can random-access the file
+(required by shuffling ImageRecordIter configs and im2rec consumers).
+
+Usage: python tools/rec2idx.py data.rec data.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxtpu import recordio  # noqa: E402
+
+
+class IndexCreator(recordio.MXRecordIO):
+    """Sequential reader that records each record's start offset
+    (reference rec2idx.py IndexCreator)."""
+
+    def __init__(self, uri, idx_path, key_type=int):
+        self.idx_path = idx_path
+        self.key_type = key_type
+        super().__init__(uri, "r")
+
+    def tell(self):
+        return self.handle.tell()
+
+    def create_index(self):
+        counter = 0
+        with open(self.idx_path, "w") as fidx:
+            while True:
+                pos = self.tell()
+                cont = self.read()
+                if cont is None:
+                    break
+                key = self.key_type(counter)
+                fidx.write("%s\t%d\n" % (str(key), pos))
+                counter += 1
+        return counter
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Create an index file from a .rec file")
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx path (default: alongside the .rec)")
+    args = ap.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    t0 = time.time()
+    creator = IndexCreator(args.record, idx)
+    n = creator.create_index()
+    creator.close()
+    print("wrote %d entries to %s in %.2fs" % (n, idx, time.time() - t0))
+
+
+if __name__ == "__main__":
+    main()
