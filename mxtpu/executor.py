@@ -16,7 +16,7 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
-from .base import canonical_dtype
+from .base import canonical_dtype, backward_mirror_enabled, maybe_remat
 from .context import current_context
 from .ops.registry import rng_scope
 from .symbol import eval_graph
@@ -62,6 +62,11 @@ class Executor:
             new_aux = tuple(aux_updates.get(n, feed[n]) for n in aux_names)
             return tuple(outs), new_aux
 
+        # MXNET_BACKWARD_DO_MIRROR (read at bind time): checkpoint the
+        # differentiated region so the backward recomputes activations
+        # instead of storing them (base.maybe_remat).
+        self._mirror = backward_mirror_enabled()
+
         @jax.jit
         def fwd_bwd(arg_vals, aux_vals, key, cotangents):
             feed = dict(zip(arg_names, arg_vals))
@@ -76,7 +81,8 @@ class Executor:
                 return tuple(outs), new_aux
 
             primals = tuple(feed[n] for n in grad_args)
-            (outs, new_aux), vjp_fn = jax.vjp(f, primals)
+            (outs, new_aux), vjp_fn = jax.vjp(
+                maybe_remat(f, enabled=self._mirror), primals)
             zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
             grads = vjp_fn((cotangents, zero_aux))[0]
             return outs, new_aux, grads

@@ -26,7 +26,8 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
-from ..base import MXNetError
+from ..base import MXNetError, backward_mirror_enabled as _mirror_enabled, \
+    maybe_remat as _maybe_remat
 from .. import ndarray as nd
 from ..ndarray import NDArray, _wrap, invoke
 from .. import symbol as _sym
@@ -377,8 +378,18 @@ class HybridBlock(Block):
             aux_new = tuple(pw[i]._data for i in aux_pos)
             return tuple(o._data for o in outs) + aux_new
 
+        # hybridize(remat=True) — or the MXNET_BACKWARD_DO_MIRROR env var —
+        # checkpoints the compiled body: an outer autograd.backward then
+        # recomputes this block's activations instead of holding them
+        # (per-block mirroring, the CachedOp analogue of the reference's
+        # graph mirror pass).
+        remat_flag = self._flags.get("remat")
+        if remat_flag is None:
+            remat_flag = _mirror_enabled()
+        wrapped = _maybe_remat(body, enabled=bool(remat_flag),
+                               static_argnums=(2,))
         jit_body = jax.jit(
-            lambda key, vals, training: body(key, vals, training),
+            lambda key, vals, training: wrapped(key, vals, training),
             static_argnames=("training",))
 
         def cached_fn(key, *vals, _training=False):

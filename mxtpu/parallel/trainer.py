@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
 from .. import autograd as _ag
+from .. import base as _base
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
@@ -186,7 +187,8 @@ class ShardedTrainer:
     """
 
     def __init__(self, block, loss, optimizer, optimizer_params=None,
-                 mesh=None, rules=None, donate=True, dtype=None):
+                 mesh=None, rules=None, donate=True, dtype=None,
+                 remat=None, remat_policy=None):
         if dtype not in (None, "float32", "bfloat16"):
             # float16 would need loss scaling (reference mp_sgd pairs fp16
             # weights with fp32 master copies + scale); bf16 shares f32's
@@ -207,6 +209,18 @@ class ShardedTrainer:
         self._mesh = mesh if mesh is not None else MeshContext()
         self._rules = rules or ShardingRules()
         self._donate = donate
+        # rematerialization (the MXNET_BACKWARD_DO_MIRROR capability):
+        # checkpoint the loss computation so backward recomputes
+        # activations — the standard HBM lever for deep nets / long
+        # sequences. remat=None defers to the env knob.
+        if remat is None:
+            # an explicit policy implies remat; else defer to the env knob
+            remat = True if remat_policy is not None \
+                else _base.backward_mirror_enabled()
+        elif not remat and remat_policy is not None:
+            raise ValueError("remat_policy given but remat=False")
+        self._remat = bool(remat)
+        self._remat_policy = remat_policy
         self._step_fns = {}
         self._placed = False
         self._key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
@@ -320,6 +334,10 @@ class ShardedTrainer:
                 for i, av in zip(aux_idx, aux_vals))
             return loss_val, (aux_new, tuple(o._data for o in outs))
 
+        loss_fn = _base.maybe_remat(
+            forward_loss, enabled=self._remat, static_argnums=(5,),
+            policy=self._remat_policy)
+
         def train_step(train_vals, states, aux_vals, inputs, label, key,
                        t, lr):
             # rng, step count and lr live on device and are carried through
@@ -328,7 +346,7 @@ class ShardedTrainer:
             key, sub = jax.random.split(key)
             t = t + 1
             (loss_val, (aux_new, outs)), grads = jax.value_and_grad(
-                forward_loss, has_aux=True)(
+                loss_fn, has_aux=True)(
                     train_vals, aux_vals, inputs, label, sub, True)
             new_vals, new_states = [], []
             for j, (w, g, st) in enumerate(zip(train_vals, grads, states)):

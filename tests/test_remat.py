@@ -1,0 +1,166 @@
+"""Rematerialization (the reference's MXNET_BACKWARD_DO_MIRROR,
+docs/faq/env_var.md: trade extra forward compute for backward memory).
+
+mxtpu renders the mirror pass as jax.checkpoint over the differentiated
+region (base.maybe_remat), reachable three ways: the env knob on a bound
+Executor, ``hybridize(remat=True)`` per block, and
+``ShardedTrainer(remat=True)``. These tests assert (a) the checkpoint
+actually engages (the ``remat`` primitive appears in the jaxpr and the
+backward recomputes forward ops), and (b) results are unchanged.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.base import maybe_remat, backward_mirror_enabled
+from mxtpu.gluon import nn
+from mxtpu.parallel import MeshContext, ShardedTrainer
+
+
+def test_maybe_remat_engages_and_preserves_grads():
+    def deep(x, ws):
+        for w in ws:
+            x = jnp.tanh(x @ w)
+        return x.sum()
+
+    ws = [jnp.ones((16, 16)) * 0.1 for _ in range(6)]
+    x = jnp.ones((4, 16))
+    g_plain = jax.grad(deep, argnums=1)
+    g_remat = jax.grad(maybe_remat(deep, enabled=True), argnums=1)
+    jx_plain = str(jax.make_jaxpr(g_plain)(x, ws))
+    jx_remat = str(jax.make_jaxpr(g_remat)(x, ws))
+    assert "remat" not in jx_plain
+    assert "remat" in jx_remat
+    for a, b in zip(g_plain(x, ws), g_remat(x, ws)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+    # disabled -> identity
+    assert maybe_remat(deep, enabled=False) is deep
+
+
+def test_env_knob(monkeypatch):
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+    assert not backward_mirror_enabled()
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    assert backward_mirror_enabled()
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "0")
+    assert not backward_mirror_enabled()
+
+
+def _mlp_sym():
+    net = mx.sym.var("data")
+    for i in range(4):
+        net = mx.sym.FullyConnected(net, name="fc%d" % i, num_hidden=16)
+        net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, name="out", num_hidden=4)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _run_executor_grads(monkeypatch, mirror):
+    if mirror:
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    else:
+        monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+    r = np.random.RandomState(0)
+    sym = _mlp_sym()
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write",
+                         data=(8, 12), softmax_label=(8,))
+    assert ex._mirror == mirror
+    for name, arr in ex.arg_dict.items():
+        if name == "data":
+            arr[:] = r.uniform(-1, 1, arr.shape).astype(np.float32)
+        elif name == "softmax_label":
+            arr[:] = r.randint(0, 4, arr.shape).astype(np.float32)
+        else:
+            arr[:] = r.uniform(-0.3, 0.3, arr.shape).astype(np.float32)
+    ex.forward(is_train=True)
+    ex.backward()
+    return {n: g.asnumpy() for n, g in ex.grad_dict.items()
+            if g is not None}
+
+
+def test_executor_mirror_env_same_grads(monkeypatch):
+    plain = _run_executor_grads(monkeypatch, False)
+    mirrored = _run_executor_grads(monkeypatch, True)
+    assert plain.keys() == mirrored.keys() and len(plain) > 3
+    for n in plain:
+        np.testing.assert_allclose(plain[n], mirrored[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def _gluon_loss_and_grads(remat):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(3):
+            net.add(nn.Dense(16, activation="tanh"))
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+    net.hybridize(remat=remat)
+    x = mx.nd.array(np.random.RandomState(1)
+                    .uniform(-1, 1, (8, 12)).astype(np.float32))
+    with mx.autograd.record():
+        out = net(x)
+        loss = (out * out).mean()
+    loss.backward()
+    grads = [p.grad().asnumpy() for p in net.collect_params().values()]
+    return float(loss.asnumpy()), grads
+
+
+def test_hybridize_remat_flag_same_results():
+    l0, g0 = _gluon_loss_and_grads(remat=False)
+    l1, g1 = _gluon_loss_and_grads(remat=True)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("policy", [None, "dots"])
+def test_sharded_trainer_remat(policy):
+    kw = {}
+    if policy == "dots":
+        kw["remat_policy"] = \
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    losses = {}
+    for remat in (False, True):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(32, activation="tanh"))
+            net.add(nn.Dense(32, activation="tanh"))
+            net.add(nn.Dense(4))
+        net.initialize(mx.init.Xavier(), force_reinit=True)
+        r = np.random.RandomState(2)
+        x = r.uniform(-1, 1, (16, 8)).astype(np.float32)
+        y = r.randint(0, 4, (16,)).astype(np.float32)
+        net(mx.nd.array(x[:2]))
+        st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            "sgd", {"learning_rate": 0.1},
+                            mesh=MeshContext(jax.devices()[:1], data=1),
+                            remat=remat, **(kw if remat else {}))
+        assert st._remat == remat
+        losses[remat] = [st.step(x, y) for _ in range(4)]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+    assert losses[True][-1] < losses[True][0]
+
+
+def test_remat_policy_implies_remat_and_false_conflicts():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    pol = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                        {"learning_rate": 0.1},
+                        mesh=MeshContext(jax.devices()[:1], data=1),
+                        remat_policy=pol)
+    assert st._remat
+    with pytest.raises(ValueError):
+        ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                       {"learning_rate": 0.1},
+                       mesh=MeshContext(jax.devices()[:1], data=1),
+                       remat=False, remat_policy=pol)
