@@ -71,7 +71,13 @@ def check_bench(report):
                        peak_tflops)
     kind = getattr(jax.devices()[0], "device_kind", "")
     peak = peak_tflops(kind) or 0.0
-    for batch, nhwc in ((128, False), (256, False), (128, True)):
+    # (batch, nhwc, remat): layout is the MFU lever, batch scaling shows
+    # the ceiling, remat=True shows the HBM headroom lever at large batch
+    for batch, nhwc, remat in ((128, False, False), (256, False, False),
+                               (128, True, False), (256, True, False),
+                               (512, False, False), (512, False, True)):
+        key = "bench_batch%d%s%s" % (batch, "_nhwc" if nhwc else "",
+                                     "_remat" if remat else "")
         try:
             if nhwc:
                 os.environ["MXTPU_CONV_LAYOUT"] = "NHWC"
@@ -87,7 +93,7 @@ def check_bench(report):
                                 "sgd", {"learning_rate": 0.05,
                                         "momentum": 0.9, "wd": 1e-4},
                                 mesh=MeshContext(jax.devices()[:1], data=1),
-                                dtype="bfloat16")
+                                dtype="bfloat16", remat=remat)
             for _ in range(3):
                 st.step(x, y)
             xd = st._shard_batch([x])[0]
@@ -105,10 +111,8 @@ def check_bench(report):
             if peak:
                 entry["mfu"] = round(
                     img_s * RESNET50_TRAIN_FLOPS_PER_IMG / (peak * 1e12), 4)
-            key = "bench_batch%d%s" % (batch, "_nhwc" if nhwc else "")
             report[key] = entry
         except Exception as e:
-            key = "bench_batch%d%s" % (batch, "_nhwc" if nhwc else "")
             report[key] = {"error": repr(e)}
         finally:
             os.environ.pop("MXTPU_CONV_LAYOUT", None)
