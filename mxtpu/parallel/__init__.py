@@ -14,7 +14,7 @@ from .mesh import (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT,
                    make_mesh, MeshContext, ShardingRules, PartitionSpec,
                    NamedSharding, Mesh, current_mesh)
 from .trainer import (ShardedTrainer, functional_optimizer_step,
-                      state_to_tree, tree_to_state)
+                      state_to_tree, tree_to_state, device_prefetch)
 from .ring_attention import (ring_attention, ring_attention_sharded,
                              ulysses_attention, local_attention)
 from .pipeline import pipeline_spmd, pipeline_apply
@@ -25,7 +25,7 @@ __all__ = [
     "make_mesh", "MeshContext", "ShardingRules", "PartitionSpec",
     "NamedSharding", "Mesh", "current_mesh",
     "ShardedTrainer", "functional_optimizer_step", "state_to_tree",
-    "tree_to_state",
+    "tree_to_state", "device_prefetch",
     "ring_attention", "ring_attention_sharded", "ulysses_attention",
     "local_attention",
     "pipeline_spmd", "pipeline_apply",

@@ -43,7 +43,7 @@ from ..gluon.loss import Loss
 from .mesh import MeshContext, ShardingRules, AXIS_DATA
 
 __all__ = ["ShardedTrainer", "functional_optimizer_step", "state_to_tree",
-           "tree_to_state"]
+           "tree_to_state", "device_prefetch"]
 
 
 # ---------------------------------------------------------------------------
@@ -490,3 +490,63 @@ class ShardedTrainer:
             self._params[i].set_data(NDArray(jax.device_get(v)))
         for v, i in zip(self._aux_vals, self._aux_idx):
             self._params[i].set_data(NDArray(jax.device_get(v)))
+
+
+def device_prefetch(iterator, mesh=None, size=2):
+    """Stage upcoming batches onto the mesh ahead of consumption.
+
+    The device-side half of the input pipeline: the host-side prefetchers
+    (``io.PrefetchingIter``, the gluon DataLoader workers) overlap decode
+    with compute, and this generator overlaps the host->device transfer —
+    batches are ``jax.device_put`` onto the mesh's batch sharding ``size``
+    steps ahead, so ``ShardedTrainer.step_async`` finds them already
+    staged (its ``_shard_batch`` recognizes matching shardings) and the
+    steady-state step makes no synchronous transfer at all. This is the
+    engine-async PrefetcherIter capability (reference
+    ``src/io/iter_prefetcher.h``) extended across the PCIe/host link.
+
+    ``iterator`` yields arrays, (data, label) tuples/lists, or DataBatch
+    objects; the same structure is yielded back with device-staged
+    contents.
+
+    Example
+    -------
+    >>> for x, y in device_prefetch(loader, mesh=st._mesh):
+    ...     st.step_async(x, y)
+    """
+    import collections
+
+    mesh = mesh if mesh is not None else MeshContext()
+
+    def stage_arr(a):
+        v = _as_jax(a)
+        return jax.device_put(v, mesh.batch_sharding(v.ndim))
+
+    def stage(batch):
+        if isinstance(batch, (tuple, list)):
+            staged = [stage_arr(b) for b in batch]
+            # namedtuples construct from positional fields, not an iterable
+            if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+                return type(batch)(*staged)
+            return type(batch)(staged)
+        if hasattr(batch, "data") and hasattr(batch, "label"):
+            batch.data = [NDArray(stage_arr(d)) for d in batch.data]
+            if batch.label is not None:  # DataBatch allows label=None
+                batch.label = [NDArray(stage_arr(l)) for l in batch.label]
+            return batch
+        return stage_arr(batch)
+
+    it = iter(iterator)
+    buf = collections.deque()
+    try:
+        while len(buf) < max(1, size):
+            buf.append(stage(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(stage(next(it)))
+        except StopIteration:
+            pass
+        yield out

@@ -384,3 +384,94 @@ def test_ctx_group_rules_skip_op_nodes():
     # the variable are included
     assert tuple(rules.spec_for("opnode", (4, 4))) == ()
     assert tuple(rules.spec_for("data2", (2, 4))) == ("model", None)
+
+
+def test_device_prefetch_stages_and_trains():
+    """device_prefetch pre-stages batches with the mesh's batch sharding;
+    ShardedTrainer.step_async consumes them without re-transfer, and
+    training matches the unprefetched path exactly."""
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    from mxtpu.parallel import MeshContext, ShardedTrainer, device_prefetch
+
+    def build():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"))
+            net.add(nn.Dense(4))
+        net.initialize(mx.init.Xavier(), force_reinit=True)
+        return net
+
+    mesh = MeshContext(jax.devices()[:4], data=4)
+    r = np.random.RandomState(0)
+    batches = [(r.uniform(-1, 1, (8, 6)).astype(np.float32),
+                r.randint(0, 4, (8,)).astype(np.float32))
+               for _ in range(5)]
+
+    # order + structure + sharding of the staged stream
+    staged = list(device_prefetch(iter(batches), mesh=mesh, size=2))
+    assert len(staged) == 5
+    for (sx, sy), (x, y) in zip(staged, batches):
+        assert isinstance(sx, jax.Array)
+        assert sx.sharding == mesh.batch_sharding(2)
+        np.testing.assert_allclose(np.asarray(sx), x)
+        np.testing.assert_allclose(np.asarray(sy), y)
+
+    losses = {}
+    for prefetch in (False, True):
+        net = build()
+        net(mx.nd.array(batches[0][0][:2]))
+        st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            "sgd", {"learning_rate": 0.1}, mesh=mesh)
+        if prefetch:
+            ls = [float(st.step_async(x, y).asnumpy())
+                  for x, y in device_prefetch(iter(batches), mesh=mesh)]
+        else:
+            ls = [st.step(x, y) for x, y in batches]
+        losses[prefetch] = ls
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
+
+
+def test_device_prefetch_databatch_and_short_iter():
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu.parallel import MeshContext, device_prefetch
+
+    mesh = MeshContext(jax.devices()[:2], data=2)
+    it = mx.io.NDArrayIter(np.arange(24).reshape(6, 4).astype(np.float32),
+                           np.arange(6).astype(np.float32), batch_size=2)
+    out = list(device_prefetch(it, mesh=mesh, size=8))  # size > n batches
+    assert len(out) == 3
+    b0 = out[0]
+    assert b0.data[0].shape == (2, 4)
+    np.testing.assert_allclose(b0.data[0].asnumpy(),
+                               [[0, 1, 2, 3], [4, 5, 6, 7]])
+    # empty iterator
+    assert list(device_prefetch(iter([]), mesh=mesh)) == []
+
+
+def test_device_prefetch_none_label_and_namedtuple():
+    import collections
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu.io import DataBatch
+    from mxtpu.parallel import MeshContext, device_prefetch
+
+    mesh = MeshContext(jax.devices()[:2], data=2)
+    # DataBatch with label=None (inference batches)
+    b = DataBatch(data=[mx.nd.array(np.zeros((2, 3), np.float32))],
+                  label=None)
+    out = list(device_prefetch(iter([b]), mesh=mesh))
+    assert len(out) == 1 and out[0].label is None
+    # namedtuple batches (common collate pattern)
+    Batch = collections.namedtuple("Batch", ["data", "label"])
+    nb = Batch(np.ones((2, 3), np.float32), np.zeros((2,), np.float32))
+    out = list(device_prefetch(iter([nb]), mesh=mesh))
+    assert isinstance(out[0], Batch)
+    np.testing.assert_allclose(np.asarray(out[0].data), nb.data)
