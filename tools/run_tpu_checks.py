@@ -48,6 +48,13 @@ def _timeit(fn, iters=20, warmup=3):
     return (time.perf_counter() - t0) / iters
 
 
+def _flush(report):
+    """Persist partial results — the relay can wedge mid-run and a
+    killed process must not lose the variants already measured."""
+    with open(REPORT, "w") as f:
+        json.dump(report, f, indent=2)
+
+
 def check_bench(report):
     # a failed headline child must not abort the batch/layout variants
     try:
@@ -59,6 +66,7 @@ def check_bench(report):
         report["bench_batch32"] = json.loads(line)
     except Exception as e:
         report["bench_batch32"] = {"error": repr(e)}
+    _flush(report)
 
     # batch-scaling variants (single chip): run in-process, we are already
     # on the TPU at this point
@@ -116,6 +124,7 @@ def check_bench(report):
             report[key] = {"error": repr(e)}
         finally:
             os.environ.pop("MXTPU_CONV_LAYOUT", None)
+            _flush(report)
 
 
 def check_pallas_rnn(report):
@@ -176,6 +185,7 @@ def check_flash_attention(report):
 
     rng = np.random.RandomState(0)
     res = {}
+    report["flash_attention"] = res  # mutated in place; flushed per d
     for d in (64, 128):
         B, Hh, T = 1, 8, 8192
         q = jnp.asarray(rng.randn(B, Hh, T, d).astype(np.float32)
@@ -232,7 +242,7 @@ def check_flash_attention(report):
                     _timeit(lambda: g(q, k, v), iters=5) * 1e3, 2)
             except Exception as e:
                 res["flash_fwdbwd_ms_d%d" % d] = repr(e)[:120]
-    report["flash_attention"] = res
+        _flush(report)
 
 
 def check_consistency(report):
