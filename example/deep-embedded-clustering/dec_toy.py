@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Deep Embedded Clustering (reference example/deep-embedded-clustering/,
+Xie et al. 2016): pretrain an autoencoder, k-means the embeddings to seed
+cluster centers held as a trainable Parameter, then iterate the DEC KL
+objective — soft assignments q (Student-t kernel), sharpened target p,
+minimize KL(p||q) through encoder and centers — and check cluster purity
+against the generating labels.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+from mxtpu import autograd, gluon  # noqa: E402
+from mxtpu.gluon import nn  # noqa: E402
+
+K = 4          # clusters
+DIM = 32       # input dim
+LATENT = 5
+
+
+def make_data(n=1024, seed=0):
+    r = np.random.RandomState(seed)
+    centers = r.uniform(-3, 3, (K, DIM))
+    y = r.randint(0, K, n)
+    x = centers[y] + 0.6 * r.randn(n, DIM)
+    return x.astype(np.float32), y
+
+
+class AutoEncoder(gluon.Block):
+    def __init__(self, **kw):
+        super(AutoEncoder, self).__init__(**kw)
+        with self.name_scope():
+            self.enc = nn.HybridSequential()
+            self.enc.add(nn.Dense(64, activation="relu"))
+            self.enc.add(nn.Dense(LATENT))
+            self.dec = nn.HybridSequential()
+            self.dec.add(nn.Dense(64, activation="relu"))
+            self.dec.add(nn.Dense(DIM))
+
+    def forward(self, x):
+        z = self.enc(x)
+        return z, self.dec(z)
+
+
+def kmeans(z, k, iters=25, seed=0):
+    r = np.random.RandomState(seed)
+    mu = z[r.choice(len(z), k, replace=False)]
+    for _ in range(iters):
+        d = ((z[:, None, :] - mu[None]) ** 2).sum(-1)
+        a = d.argmin(1)
+        for j in range(k):
+            if (a == j).any():
+                mu[j] = z[a == j].mean(0)
+    return mu
+
+
+def soft_assign(z, mu):
+    """Student-t kernel soft assignment (DEC eq. 1)."""
+    d2 = mx.nd.sum(mx.nd.square(
+        mx.nd.expand_dims(z, axis=1) - mx.nd.expand_dims(mu, axis=0)),
+        axis=2)
+    q = 1.0 / (1.0 + d2)
+    return q / mx.nd.sum(q, axis=1, keepdims=True)
+
+
+def cluster_accuracy(pred, truth, k):
+    """Greedy cluster->label matching purity."""
+    best = 0
+    used = set()
+    for c in range(k):
+        counts = np.bincount(truth[pred == c], minlength=k).astype(float)
+        for u in used:
+            counts[u] = -1
+        lab = int(counts.argmax())
+        used.add(lab)
+        best += counts[lab] if counts[lab] > 0 else 0
+    return best / len(truth)
+
+
+def main():
+    mx.random.seed(21)
+    x_np, y_np = make_data()
+    x = mx.nd.array(x_np)
+
+    # ---- stage 1: autoencoder pretraining ------------------------------
+    ae = AutoEncoder()
+    ae.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(ae.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    batch = 128
+    for epoch in range(15):
+        tot = 0.0
+        for i in range(0, len(x_np), batch):
+            xb = x[i:i + batch]
+            with autograd.record():
+                _, rec = ae(xb)
+                l = mx.nd.mean(mx.nd.square(rec - xb))
+            l.backward()
+            trainer.step(batch)
+            tot += float(l.asnumpy())
+        if epoch % 5 == 0:
+            print("pretrain epoch %d mse %.4f" % (epoch, tot * batch
+                                                  / len(x_np)))
+
+    # ---- stage 2: seed centers with k-means on embeddings --------------
+    z0 = ae.enc(x).asnumpy()
+    mu0 = kmeans(z0, K)
+    centers = gluon.Parameter("centers", shape=(K, LATENT))
+    centers.initialize(mx.init.Constant(mx.nd.array(mu0)))
+    pred0 = ((z0[:, None, :] - mu0[None]) ** 2).sum(-1).argmin(1)
+    acc0 = cluster_accuracy(pred0, y_np, K)
+    print("k-means seed purity: %.3f" % acc0)
+
+    # ---- stage 3: DEC iterations ---------------------------------------
+    params = list(ae.enc.collect_params().values()) + [centers]
+    dec_trainer = gluon.Trainer(params, "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+    for it in range(40):
+        # target distribution from current assignments (sharpen)
+        q_all = soft_assign(ae.enc(x), centers.data()).asnumpy()
+        f = q_all.sum(0)
+        p_all = (q_all ** 2) / f
+        p_all = p_all / p_all.sum(1, keepdims=True)
+        for i in range(0, len(x_np), batch):
+            xb = x[i:i + batch]
+            pb = mx.nd.array(p_all[i:i + batch])
+            with autograd.record():
+                q = soft_assign(ae.enc(xb), centers.data())
+                kl = mx.nd.sum(pb * (mx.nd.log(pb + 1e-10)
+                                     - mx.nd.log(q + 1e-10))) / xb.shape[0]
+            kl.backward()
+            dec_trainer.step(xb.shape[0])
+        if it % 10 == 0:
+            print("dec iter %d KL %.4f" % (it, float(kl.asnumpy())))
+
+    q_final = soft_assign(ae.enc(x), centers.data()).asnumpy()
+    acc = cluster_accuracy(q_final.argmax(1), y_np, K)
+    print("DEC purity: %.3f" % acc)
+    assert acc > 0.9 and acc >= acc0 - 0.02, (acc0, acc)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ EXAMPLES = [
     ("bi-lstm-sort/sort_lstm.py", {}),
     ("cnn_text_classification/text_cnn.py", {}),
     ("nce-loss/nce_lm.py", {}),
+    ("deep-embedded-clustering/dec_toy.py", {}),
 ]
 
 

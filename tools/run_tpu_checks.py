@@ -135,6 +135,7 @@ def check_pallas_rnn(report):
     rng = np.random.RandomState(0)
     T, B, H = 128, 32, 256
     res = {}
+    report["pallas_rnn"] = res  # mutated in place; flushed per cell type
     # LSTM: pallas fused vs scan reference
     x_proj = jnp.asarray(rng.randn(T, B, 4 * H).astype("f"))
     h0 = jnp.asarray(rng.randn(B, H).astype("f"))
@@ -151,6 +152,7 @@ def check_pallas_rnn(report):
         _timeit(lambda: fused(x_proj, h0, c0, wh_t)) * 1e3, 3)
     res["lstm_scan_ms"] = round(
         _timeit(lambda: ref(x_proj, h0, c0, wh_t)) * 1e3, 3)
+    _flush(report)
 
     # GRU
     x3 = jnp.asarray(rng.randn(T, B, 3 * H).astype("f"))
@@ -175,7 +177,7 @@ def check_pallas_rnn(report):
         res["gru_max_abs_err"] < 1e-3 and
         res["lstm_pallas_ms"] < res["lstm_scan_ms"] and
         res["gru_pallas_ms"] < res["gru_scan_ms"])
-    report["pallas_rnn"] = res
+    _flush(report)
 
 
 def check_flash_attention(report):
@@ -262,6 +264,9 @@ def check_consistency(report):
     cpu_dev = jax.local_devices(backend="cpu")[0]
     tpu_dev = jax.local_devices(backend="tpu")[0]
     mismatches, errors, checked = [], [], 0
+    cons = {"ops_checked": 0, "mismatches": mismatches,
+            "errors": errors, "n_errors": 0, "partial": True}
+    report["consistency"] = cons
     for name in sorted(SPECS):
         spec = SPECS[name]
         op = _canonical_ops()[name]
@@ -289,6 +294,10 @@ def check_consistency(report):
         if outs.get("cpu") is None or outs.get("tpu") is None:
             continue
         checked += 1
+        if checked % 25 == 0:
+            cons["ops_checked"] = checked
+            cons["n_errors"] = len(errors)
+            _flush(report)
         for i, (a, b) in enumerate(zip(outs["cpu"], outs["tpu"])):
             if a.dtype.kind == "f":
                 # fp32 tier on-chip can use bf16 matmul passes: loose tol
@@ -309,6 +318,7 @@ def check_consistency(report):
         "errors": errors[:20],
         "n_errors": len(errors),
     }
+    _flush(report)
 
 
 def main():
@@ -340,8 +350,7 @@ def main():
     report = {"device_kind": kind, "timestamp": time.strftime("%F %T")}
     if kind is None:
         report["tpu_unavailable"] = True
-        with open(REPORT, "w") as f:
-            json.dump(report, f, indent=2)
+        _flush(report)
         print(json.dumps(report))
         return 1
 
@@ -356,8 +365,7 @@ def main():
             fn(report)
         except Exception as e:
             report[cname + "_error"] = repr(e)
-        with open(REPORT, "w") as f:
-            json.dump(report, f, indent=2)
+        _flush(report)
     print(json.dumps(report, indent=2))
     return 0
 
