@@ -274,11 +274,26 @@ def test_model_zoo_smoke():
     net = models.get_model("mobilenet0.25", classes=7)
     net.initialize()
     assert net(mx.nd.zeros((1, 3, 224, 224))).shape == (1, 7)
-    # constructors only (forward is heavy)
-    for name in ["resnet50_v1", "resnet50_v2", "vgg11", "alexnet",
-                 "densenet121", "squeezenet1.0", "inceptionv3",
-                 "mobilenet1.0"]:
+    # constructors for the big variants (squeezenet1.0 has a distinct
+    # first-conv config from 1.1, so keep it constructed here)
+    for name in ["resnet50_v1", "resnet50_v2", "vgg16", "densenet201",
+                 "mobilenet1.0", "squeezenet1.0", "vgg11"]:
         models.get_model(name)
+
+
+def test_model_zoo_every_family_forwards():
+    """One variant per family runs a real forward at its native input
+    size (reference model zoo gluon/model_zoo/vision: resnet, vgg,
+    alexnet, densenet, squeezenet, inception, mobilenet)."""
+    from mxtpu.gluon.model_zoo import vision as models
+    specs = [("resnet34_v2", 224), ("vgg11_bn", 224), ("alexnet", 224),
+             ("densenet121", 224), ("squeezenet1.1", 224),
+             ("inceptionv3", 299), ("mobilenet0.5", 224)]
+    for name, hw in specs:
+        net = models.get_model(name, classes=13)
+        net.initialize()
+        out = net(mx.nd.zeros((1, 3, hw, hw)))
+        assert out.shape == (1, 13), name
 
 
 def test_dataloader():
