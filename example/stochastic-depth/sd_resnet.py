@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Stochastic depth (reference example/stochastic-depth/sd_cifar10.py,
+Huang et al. 2016): residual blocks are randomly skipped during training
+(identity shortcut survives), and scaled by their survival probability at
+inference — implemented as a gluon Block drawing per-batch Bernoulli
+survival decisions, with a linear-decay survival schedule over depth.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+from mxtpu import autograd, gluon  # noqa: E402
+from mxtpu.gluon import nn  # noqa: E402
+
+
+class SDBlock(gluon.Block):
+    """Residual block that survives with probability p_survive."""
+
+    def __init__(self, channels, p_survive, **kw):
+        super(SDBlock, self).__init__(**kw)
+        self.p_survive = float(p_survive)
+        with self.name_scope():
+            self.body = nn.HybridSequential()
+            self.body.add(nn.Conv2D(channels, 3, padding=1,
+                                    activation="relu"))
+            self.body.add(nn.BatchNorm())
+            self.body.add(nn.Conv2D(channels, 3, padding=1))
+
+    def forward(self, x):
+        if autograd.is_training():
+            if np.random.rand() < self.p_survive:
+                return mx.nd.relu(x + self.body(x))
+            return x  # block dropped: identity survives
+        # inference: expected value — residual scaled by survival prob
+        return mx.nd.relu(x + self.p_survive * self.body(x))
+
+
+class SDNet(gluon.Block):
+    def __init__(self, n_blocks=6, channels=16, classes=5, p_last=0.5,
+                 **kw):
+        super(SDNet, self).__init__(**kw)
+        with self.name_scope():
+            self.stem = nn.Conv2D(channels, 3, padding=1,
+                                  activation="relu")
+            self.blocks = nn.Sequential()
+            for i in range(n_blocks):
+                # linear decay: early blocks almost always survive
+                p = 1.0 - (i + 1) / n_blocks * (1.0 - p_last)
+                self.blocks.add(SDBlock(channels, p))
+            self.head = nn.HybridSequential()
+            self.head.add(nn.GlobalAvgPool2D())
+            self.head.add(nn.Dense(classes))
+
+    def forward(self, x):
+        return self.head(self.blocks(self.stem(x)))
+
+
+def make_data(n, seed):
+    # class prototypes are FIXED (seed 0) so train/test share classes;
+    # only the per-example noise varies with the seed
+    protos = np.random.RandomState(0).uniform(0, 1, (5, 3, 16, 16)) \
+        .astype(np.float32)
+    r = np.random.RandomState(seed)
+    y = r.randint(0, 5, n)
+    x = protos[y] + 0.15 * r.randn(n, 3, 16, 16).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    mx.random.seed(33)
+    np.random.seed(33)
+    xtr, ytr = make_data(1024, 0)
+    xte, yte = make_data(256, 1)
+    net = SDNet()
+    net.initialize(mx.init.Xavier())
+    # one eval-mode forward resolves every block's deferred shapes (the
+    # eval path runs all bodies; a training batch may skip a block before
+    # its parameters have seen a shape)
+    net(mx.nd.array(xtr[:2]))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    batch = 64
+    for epoch in range(6):
+        tot = 0.0
+        for i in range(0, len(xtr), batch):
+            x = mx.nd.array(xtr[i:i + batch])
+            y = mx.nd.array(ytr[i:i + batch])
+            with autograd.record():
+                l = loss_fn(net(x), y)
+            l.backward()
+            trainer.step(batch)
+            tot += float(l.mean().asnumpy())
+        print("epoch %d loss %.4f" % (epoch, tot / (len(xtr) // batch)))
+
+    # inference is deterministic (expected-value scaling, no sampling)
+    out1 = net(mx.nd.array(xte[:32])).asnumpy()
+    out2 = net(mx.nd.array(xte[:32])).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+    pred = net(mx.nd.array(xte)).asnumpy().argmax(axis=1)
+    acc = float((pred == yte).mean())
+    print("val accuracy: %.3f" % acc)
+    assert acc > 0.9, acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ EXAMPLES = [
     ("cnn_text_classification/text_cnn.py", {}),
     ("nce-loss/nce_lm.py", {}),
     ("deep-embedded-clustering/dec_toy.py", {}),
+    ("stochastic-depth/sd_resnet.py", {}),
 ]
 
 
