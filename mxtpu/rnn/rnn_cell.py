@@ -372,6 +372,91 @@ class FusedRNNCell(BaseRNNCell):
         outs = [steps[i] for i in range(length)]
         return outs, states if self._get_next_state else []
 
+    @property
+    def _fused_gate_names(self):
+        return {"lstm": ("_i", "_f", "_c", "_o"),
+                "gru": ("_r", "_z", "_o"),
+                "rnn_relu": ("",), "rnn_tanh": ("",)}[self._mode]
+
+    def _blob_slices(self, blob_size):
+        """Walk the flat cudnn-layout blob (ops/rnn.py _unpack_params:
+        all weights layer-major with direction inner, then all biases)
+        yielding (arg_name, start, shape) slices named for the unfuse()
+        stack's per-gate parameters."""
+        G = len(self._fused_gate_names)
+        H = self._num_hidden
+        D = self._directions
+        # infer input size from the blob size (reference rnn_cell.py:645)
+        per_gate = blob_size // D // H // G
+        isz = per_gate - (self._num_layers - 1) * (H + D * H + 2) - H - 2
+        slices = []
+        off = 0
+        for layer in range(self._num_layers):
+            in_size = isz if layer == 0 else H * D
+            for d in range(D):
+                cp = "%s%s%d_" % (self._prefix, "lr"[d], layer)
+                for j, g in enumerate(self._fused_gate_names):
+                    slices.append(("%si2h%s_weight" % (cp, g),
+                                   off + j * H * in_size, (H, in_size)))
+                off += G * H * in_size
+                for j, g in enumerate(self._fused_gate_names):
+                    slices.append(("%sh2h%s_weight" % (cp, g),
+                                   off + j * H * H, (H, H)))
+                off += G * H * H
+        for layer in range(self._num_layers):
+            for d in range(D):
+                cp = "%s%s%d_" % (self._prefix, "lr"[d], layer)
+                for group in ("i2h", "h2h"):
+                    for j, g in enumerate(self._fused_gate_names):
+                        slices.append(("%s%s%s_bias" % (cp, group, g),
+                                       off + j * H, (H,)))
+                    off += G * H
+        assert off == blob_size, (off, blob_size)
+        return slices
+
+    def unpack_weights(self, args):
+        """Slice the flat ``<prefix>parameters`` blob into the per-cell
+        per-gate arrays of the equivalent unfuse() stack (reference
+        FusedRNNCell.unpack_weights, rnn_cell.py:639)."""
+        import numpy as _np
+        from .. import ndarray as nd
+        args = dict(args)
+        blob = args.pop(self._parameter.name)
+        arr = blob.asnumpy() if hasattr(blob, "asnumpy") \
+            else _np.asarray(blob)
+        for name, start, shape in self._blob_slices(arr.size):
+            n = int(_np.prod(shape))
+            args[name] = nd.array(arr[start:start + n].reshape(shape),
+                                  dtype=arr.dtype)
+        return args
+
+    def pack_weights(self, args):
+        """Inverse of unpack_weights: gather the per-gate arrays back
+        into the flat parameter blob."""
+        import numpy as _np
+        from .. import ndarray as nd
+        args = dict(args)
+        # the blob size follows from any l0 i2h weight's input size
+        first = "%sl0_i2h%s_weight" % (self._prefix,
+                                       self._fused_gate_names[0])
+        if first not in args:
+            return args
+        isz = args[first].shape[1]
+        from ..ops.rnn import rnn_param_size
+        size = rnn_param_size(self._mode, isz, self._num_hidden,
+                              self._num_layers, self._bidirectional)
+        first_arr = args[first]
+        dtype = (first_arr.asnumpy() if hasattr(first_arr, "asnumpy")
+                 else _np.asarray(first_arr)).dtype
+        out = _np.zeros((size,), dtype)  # keep the model's param dtype
+        for name, start, shape in self._blob_slices(size):
+            v = args.pop(name)
+            v = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+            n = int(_np.prod(shape))
+            out[start:start + n] = v.reshape(-1)
+        args[self._parameter.name] = nd.array(out, dtype=dtype)
+        return args
+
     def unfuse(self):
         """Equivalent stack of unfused cells (reference unfuse)."""
         stack = SequentialRNNCell()

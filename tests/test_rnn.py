@@ -215,3 +215,59 @@ def test_rnn_symbol_json_round_trip():
     r2 = mx.sym.load_json(r.tojson())
     assert len(r2.list_outputs()) == 3
     assert r2.list_outputs() == r.list_outputs()
+
+
+def test_fused_unpack_weights_matches_unfused_numerics():
+    """FusedRNNCell.unpack_weights slices the flat blob into the unfuse()
+    stack's per-gate weights such that both graphs compute IDENTICAL
+    outputs (the reference's fused-vs-unfused consistency check,
+    tests/python/unittest/test_rnn.py), across modes x directions x
+    depth."""
+    from mxtpu.ops.rnn import rnn_param_size
+
+    T, N, C, H = 5, 3, 4, 6
+    rng = np.random.RandomState(7)
+    x_np = rng.uniform(-1, 1, (N, T, C)).astype(np.float32)
+
+    for mode in ("lstm", "gru", "rnn_tanh", "rnn_relu"):
+        for bidir in (False, True):
+            L = 2
+            fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode,
+                                        bidirectional=bidir,
+                                        prefix="f_%s%d_" % (mode, bidir))
+            size = rnn_param_size(mode, C, H, L, bidir)
+            blob = nd.array(rng.uniform(-0.4, 0.4, (size,))
+                            .astype(np.float32))
+
+            inputs = [mx.sym.var("t%d" % i) for i in range(T)]
+            fout, _ = fused.unroll(T, inputs, merge_outputs=True)
+            shapes = {"t%d" % i: (N, C) for i in range(T)}
+            fex = fout.simple_bind(mx.cpu(), grad_req="null", **shapes)
+            for i in range(T):
+                fex.arg_dict["t%d" % i][:] = x_np[:, i]
+            fex.arg_dict[fused._parameter.name][:] = blob
+            f_res = fex.forward(is_train=False)[0].asnumpy()
+
+            stack = fused.unfuse()
+            uout, _ = stack.unroll(T, [mx.sym.var("t%d" % i)
+                                       for i in range(T)],
+                                   merge_outputs=True)
+            unpacked = fused.unpack_weights(
+                {fused._parameter.name: blob})
+            feed = stack.pack_weights(unpacked)
+            uex = uout.simple_bind(mx.cpu(), grad_req="null", **shapes)
+            for i in range(T):
+                uex.arg_dict["t%d" % i][:] = x_np[:, i]
+            for name, val in feed.items():
+                uex.arg_dict[name][:] = val
+            u_res = uex.forward(is_train=False)[0].asnumpy()
+            np.testing.assert_allclose(
+                f_res, u_res, rtol=1e-4, atol=1e-5,
+                err_msg="%s bidir=%s" % (mode, bidir))
+
+            # pack round-trips back to the exact blob
+            repacked = fused.pack_weights(
+                fused.unpack_weights({fused._parameter.name: blob}))
+            np.testing.assert_allclose(
+                repacked[fused._parameter.name].asnumpy(),
+                blob.asnumpy(), rtol=1e-6)
