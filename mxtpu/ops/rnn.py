@@ -38,29 +38,49 @@ def _pallas_lstm_enabled():
     return jax.default_backend() == "tpu"
 
 
-def _unpack_params(params, mode, input_size, state_size, num_layers,
-                   num_dir):
-    """Slice the flat cudnn-layout vector: all weights (layer-major,
-    direction within layer), then all biases."""
+def rnn_blob_blocks(mode, input_size, state_size, num_layers, num_dir):
+    """The ONE definition of the flat cudnn-layout blob: all weights
+    (layer-major, direction within layer), then all biases. Yields
+    per-(layer, direction) block offsets/shapes consumed both by the op
+    (``_unpack_params``) and by ``FusedRNNCell.unpack_weights``
+    (rnn/rnn_cell.py) so the two can never drift."""
     G = _GATES[mode]
     H = state_size
-    weights, biases = [], []
+    blocks = []
     off = 0
     for layer in range(num_layers):
         isz = input_size if layer == 0 else H * num_dir
         for d in range(num_dir):
-            wi = params[off:off + G * H * isz].reshape(G * H, isz)
-            off += G * H * isz
-            wh = params[off:off + G * H * H].reshape(G * H, H)
-            off += G * H * H
-            weights.append((wi, wh))
+            blocks.append({"layer": layer, "dir": d,
+                           "wi": (off, (G * H, isz)),
+                           "wh": (off + G * H * isz, (G * H, H))})
+            off += G * H * isz + G * H * H
+    i = 0
     for layer in range(num_layers):
         for d in range(num_dir):
-            bi = params[off:off + G * H]
-            off += G * H
-            bh = params[off:off + G * H]
-            off += G * H
-            biases.append((bi, bh))
+            blocks[i]["bi"] = (off, (G * H,))
+            blocks[i]["bh"] = (off + G * H, (G * H,))
+            off += 2 * G * H
+            i += 1
+    return blocks, off
+
+
+def _unpack_params(params, mode, input_size, state_size, num_layers,
+                   num_dir):
+    """Slice the flat cudnn-layout vector per rnn_blob_blocks."""
+    blocks, _ = rnn_blob_blocks(mode, input_size, state_size, num_layers,
+                                num_dir)
+    weights, biases = [], []
+    for b in blocks:
+        (wi_off, wi_shape), (wh_off, wh_shape) = b["wi"], b["wh"]
+        wi = params[wi_off:wi_off + wi_shape[0] * wi_shape[1]] \
+            .reshape(wi_shape)
+        wh = params[wh_off:wh_off + wh_shape[0] * wh_shape[1]] \
+            .reshape(wh_shape)
+        weights.append((wi, wh))
+        (bi_off, bi_shape), (bh_off, bh_shape) = b["bi"], b["bh"]
+        biases.append((params[bi_off:bi_off + bi_shape[0]],
+                       params[bh_off:bh_off + bh_shape[0]]))
     return weights, biases
 
 

@@ -379,39 +379,32 @@ class FusedRNNCell(BaseRNNCell):
                 "rnn_relu": ("",), "rnn_tanh": ("",)}[self._mode]
 
     def _blob_slices(self, blob_size):
-        """Walk the flat cudnn-layout blob (ops/rnn.py _unpack_params:
-        all weights layer-major with direction inner, then all biases)
-        yielding (arg_name, start, shape) slices named for the unfuse()
-        stack's per-gate parameters."""
+        """Per-gate (arg_name, start, shape) slices of the flat blob,
+        derived from the ONE layout definition (ops/rnn.py
+        rnn_blob_blocks) and named for the unfuse() stack's parameters."""
+        from ..ops.rnn import rnn_blob_blocks
         G = len(self._fused_gate_names)
         H = self._num_hidden
         D = self._directions
         # infer input size from the blob size (reference rnn_cell.py:645)
         per_gate = blob_size // D // H // G
         isz = per_gate - (self._num_layers - 1) * (H + D * H + 2) - H - 2
+        blocks, total = rnn_blob_blocks(self._mode, isz, H,
+                                        self._num_layers, D)
+        assert total == blob_size, (total, blob_size)
         slices = []
-        off = 0
-        for layer in range(self._num_layers):
-            in_size = isz if layer == 0 else H * D
-            for d in range(D):
-                cp = "%s%s%d_" % (self._prefix, "lr"[d], layer)
+        for b in blocks:
+            cp = "%s%s%d_" % (self._prefix, "lr"[b["dir"]], b["layer"])
+            for group, key in (("i2h", "wi"), ("h2h", "wh")):
+                start, (gh, cols) = b[key]
                 for j, g in enumerate(self._fused_gate_names):
-                    slices.append(("%si2h%s_weight" % (cp, g),
-                                   off + j * H * in_size, (H, in_size)))
-                off += G * H * in_size
+                    slices.append(("%s%s%s_weight" % (cp, group, g),
+                                   start + j * H * cols, (H, cols)))
+            for group, key in (("i2h", "bi"), ("h2h", "bh")):
+                start, _ = b[key]
                 for j, g in enumerate(self._fused_gate_names):
-                    slices.append(("%sh2h%s_weight" % (cp, g),
-                                   off + j * H * H, (H, H)))
-                off += G * H * H
-        for layer in range(self._num_layers):
-            for d in range(D):
-                cp = "%s%s%d_" % (self._prefix, "lr"[d], layer)
-                for group in ("i2h", "h2h"):
-                    for j, g in enumerate(self._fused_gate_names):
-                        slices.append(("%s%s%s_bias" % (cp, group, g),
-                                       off + j * H, (H,)))
-                    off += G * H
-        assert off == blob_size, (off, blob_size)
+                    slices.append(("%s%s%s_bias" % (cp, group, g),
+                                   start + j * H, (H,)))
         return slices
 
     def unpack_weights(self, args):
@@ -436,11 +429,16 @@ class FusedRNNCell(BaseRNNCell):
         import numpy as _np
         from .. import ndarray as nd
         args = dict(args)
+        if self._parameter.name in args:
+            return args  # already packed
         # the blob size follows from any l0 i2h weight's input size
         first = "%sl0_i2h%s_weight" % (self._prefix,
                                        self._fused_gate_names[0])
         if first not in args:
-            return args
+            raise KeyError(
+                "pack_weights: neither %r nor the per-gate key %r is "
+                "present — prefix mismatch between this FusedRNNCell and "
+                "the saved parameters?" % (self._parameter.name, first))
         isz = args[first].shape[1]
         from ..ops.rnn import rnn_param_size
         size = rnn_param_size(self._mode, isz, self._num_hidden,

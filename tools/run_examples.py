@@ -30,6 +30,7 @@ EXAMPLES = [
     ("nce-loss/nce_lm.py", {}),
     ("deep-embedded-clustering/dec_toy.py", {}),
     ("stochastic-depth/sd_resnet.py", {}),
+    ("bayesian-methods/bbb_toy.py", {}),
 ]
 
 
