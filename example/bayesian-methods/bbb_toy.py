@@ -74,12 +74,17 @@ class BayesMLP:
         return acts, kl
 
 
-def predictive_entropy(model, x, n_samples=16):
+def mc_probs(model, x, n_samples=16):
+    """Monte-Carlo-averaged predictive probabilities."""
     probs = 0.0
     for _ in range(n_samples):
         logits, _ = model.forward_sample(mx.nd.array(x))
         probs = probs + mx.nd.softmax(logits, axis=-1).asnumpy()
-    probs /= n_samples
+    return probs / n_samples
+
+
+def predictive_entropy(model, x, n_samples=16):
+    probs = mc_probs(model, x, n_samples)
     return -(probs * np.log(probs + 1e-10)).sum(axis=1)
 
 
@@ -109,11 +114,7 @@ def main():
             print("epoch %d elbo-loss %.1f" % (epoch, tot / n_batches))
 
     # MC-averaged predictive accuracy
-    probs = 0.0
-    for _ in range(16):
-        logits, _ = model.forward_sample(mx.nd.array(xte))
-        probs = probs + mx.nd.softmax(logits, axis=-1).asnumpy()
-    acc = float((probs.argmax(1) == yte).mean())
+    acc = float((mc_probs(model, xte).argmax(1) == yte).mean())
     print("MC predictive accuracy: %.3f" % acc)
     assert acc > 0.9, acc
 

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Capsule network with dynamic routing (reference example/capsnet/,
+Sabour et al. 2017) at toy scale: conv feature extraction, primary
+capsules, 3 routing-by-agreement iterations (softmax over routing logits,
+agreement updates, squash nonlinearity), margin loss over capsule
+lengths. Exercises iterative routing inside autograd and per-class
+vector outputs.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+from mxtpu import autograd, gluon  # noqa: E402
+from mxtpu.gluon import nn  # noqa: E402
+
+CLASSES = 4
+PRIM_CAPS = 8      # number of primary capsules
+PRIM_DIM = 8
+OUT_DIM = 12
+
+
+def squash(v, axis=-1):
+    n2 = mx.nd.sum(mx.nd.square(v), axis=axis, keepdims=True)
+    return v * (n2 / (1 + n2)) / mx.nd.sqrt(n2 + 1e-8)
+
+
+class CapsNet(gluon.Block):
+    def __init__(self, **kw):
+        super(CapsNet, self).__init__(**kw)
+        with self.name_scope():
+            self.conv = nn.Conv2D(32, 5, strides=2, activation="relu")
+            self.prim = nn.Conv2D(PRIM_CAPS * PRIM_DIM, 3, strides=2)
+            # custom routing weight registered through the block's params
+            # so collect_params()/initialize() manage it
+            self.dense_w = self.params.get(
+                "route_weight", shape=(1, PRIM_CAPS * 9, CLASSES, OUT_DIM,
+                                  PRIM_DIM))
+
+    def forward(self, x):
+        b = x.shape[0]
+        h = self.prim(self.conv(x))                      # (B, C*D, s, s)
+        s = h.shape[2]
+        u = h.reshape((b, PRIM_CAPS, PRIM_DIM, s * s))
+        u = mx.nd.transpose(u, axes=(0, 1, 3, 2))        # (B, P, s*s, D)
+        u = squash(u.reshape((b, -1, PRIM_DIM)))         # (B, N, D)
+        n = u.shape[1]
+        # prediction vectors u_hat = W u  : (B, N, CLASSES, OUT_DIM)
+        w = self.dense_w.data()                          # (1,N,C,OD,PD)
+        assert n == w.shape[1], (
+            "conv geometry changed: %d primary capsules vs route_weight "
+            "sized for %d — update the shape in __init__" % (n, w.shape[1]))
+        u_hat = mx.nd.sum(w * u.reshape((b, n, 1, 1, PRIM_DIM)), axis=4)
+        # routing by agreement
+        logits = mx.nd.zeros((b, n, CLASSES, 1))
+        for it in range(3):
+            c = mx.nd.softmax(logits, axis=2)
+            sj = squash(mx.nd.sum(c * u_hat, axis=1), axis=-1)  # (B,C,OD)
+            if it < 2:
+                agree = mx.nd.sum(
+                    u_hat * sj.reshape((b, 1, CLASSES, OUT_DIM)),
+                    axis=3, keepdims=True)
+                logits = logits + agree
+        return mx.nd.sqrt(mx.nd.sum(mx.nd.square(sj), axis=2) + 1e-9)
+
+
+def margin_loss(lengths, y_onehot):
+    pos = mx.nd.square(mx.nd.relu(0.9 - lengths))
+    neg = mx.nd.square(mx.nd.relu(lengths - 0.1))
+    return mx.nd.sum(y_onehot * pos + 0.5 * (1 - y_onehot) * neg, axis=1)
+
+
+def make_data(n, seed):
+    protos = np.random.RandomState(0).uniform(0, 1, (CLASSES, 1, 20, 20)) \
+        .astype(np.float32)
+    r = np.random.RandomState(seed)
+    y = r.randint(0, CLASSES, n)
+    x = protos[y] + 0.15 * r.randn(n, 1, 20, 20).astype(np.float32)
+    return x.astype(np.float32), y
+
+
+def main():
+    mx.random.seed(77)
+    xtr, ytr = make_data(512, 1)
+    xte, yte = make_data(128, 2)
+    net = CapsNet()
+    net.initialize(mx.init.Normal(0.05))
+    net(mx.nd.array(xtr[:2]))  # resolve deferred shapes
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    batch = 64
+    for epoch in range(8):
+        tot = 0.0
+        for i in range(0, len(xtr), batch):
+            x = mx.nd.array(xtr[i:i + batch])
+            yb = ytr[i:i + batch]
+            y1h = mx.nd.array(np.eye(CLASSES, dtype=np.float32)[yb])
+            with autograd.record():
+                lengths = net(x)
+                l = mx.nd.mean(margin_loss(lengths, y1h))
+            l.backward()
+            trainer.step(batch)
+            tot += float(l.asnumpy())
+        print("epoch %d margin loss %.4f" % (epoch,
+                                             tot / (len(xtr) // batch)))
+    pred = net(mx.nd.array(xte)).asnumpy().argmax(1)
+    acc = float((pred == yte).mean())
+    print("val accuracy: %.3f" % acc)
+    assert acc > 0.9, acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -85,13 +85,8 @@ def _unpack_params(params, mode, input_size, state_size, num_layers,
 
 
 def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
-    G = _GATES[mode]
-    H = state_size
-    D = 2 if bidirectional else 1
-    size = 0
-    for layer in range(num_layers):
-        isz = input_size if layer == 0 else H * D
-        size += D * (G * H * isz + G * H * H + 2 * G * H)
+    _, size = rnn_blob_blocks(mode, input_size, state_size, num_layers,
+                              2 if bidirectional else 1)
     return size
 
 

@@ -31,6 +31,7 @@ EXAMPLES = [
     ("deep-embedded-clustering/dec_toy.py", {}),
     ("stochastic-depth/sd_resnet.py", {}),
     ("bayesian-methods/bbb_toy.py", {}),
+    ("capsnet/capsnet_toy.py", {}),
 ]
 
 
