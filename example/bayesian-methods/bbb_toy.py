@@ -113,13 +113,14 @@ def main():
         if epoch % 10 == 0:
             print("epoch %d elbo-loss %.1f" % (epoch, tot / n_batches))
 
-    # MC-averaged predictive accuracy
-    acc = float((mc_probs(model, xte).argmax(1) == yte).mean())
+    # one MC sweep over the test set serves both accuracy and entropy
+    probs_te = mc_probs(model, xte)
+    acc = float((probs_te.argmax(1) == yte).mean())
     print("MC predictive accuracy: %.3f" % acc)
     assert acc > 0.9, acc
 
     # uncertainty: far-off-manifold inputs get higher predictive entropy
-    ent_in = predictive_entropy(model, xte).mean()
+    ent_in = -(probs_te * np.log(probs_te + 1e-10)).sum(axis=1).mean()
     r = np.random.RandomState(9)
     x_ood = 6.0 * r.randn(256, DIM).astype(np.float32)
     ent_out = predictive_entropy(model, x_ood).mean()

@@ -32,6 +32,7 @@ EXAMPLES = [
     ("stochastic-depth/sd_resnet.py", {}),
     ("bayesian-methods/bbb_toy.py", {}),
     ("capsnet/capsnet_toy.py", {}),
+    ("ctc/ctc_toy.py", {}),
 ]
 
 
