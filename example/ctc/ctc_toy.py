@@ -82,6 +82,7 @@ class CTCLossMetric(mx.metric.EvalMetric):
 
 def main():
     mx.random.seed(41)
+    np.random.seed(41)  # NDArrayIter shuffle order
     xtr, ytr = make_data(1024, 1)
     xte, yte = make_data(256, 2)
     batch = 64
