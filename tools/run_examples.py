@@ -33,6 +33,7 @@ EXAMPLES = [
     ("bayesian-methods/bbb_toy.py", {}),
     ("capsnet/capsnet_toy.py", {}),
     ("ctc/ctc_toy.py", {}),
+    ("multivariate_time_series/lstnet_toy.py", {}),
 ]
 
 
