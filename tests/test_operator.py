@@ -197,3 +197,30 @@ def test_optimizer_update_ops():
     mom = nd.zeros((2,))
     outs = nd.sgd_mom_update(w, g, mom, lr=1.0, momentum=0.9)
     assert np.allclose(outs[0].asnumpy(), [0.9, 1.9])
+
+
+def test_executor_grad_req_add_accumulates():
+    """grad_req='add' accumulates across backward calls instead of
+    overwriting (reference kAddTo, graph_executor grad write semantics);
+    grad_req='write' overwrites."""
+    x = mx.sym.var("x")
+    w = mx.sym.var("w")
+    y = mx.sym.FullyConnected(x, w, no_bias=True, num_hidden=3)
+    xv = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    wv = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    cot = np.ones((2, 3), np.float32)
+
+    def run(req, n_backward):
+        ex = y.simple_bind(ctx=mx.cpu(),
+                           grad_req={"x": "null", "w": req},
+                           x=xv.shape, w=wv.shape)
+        ex.arg_dict["x"][:] = xv
+        ex.arg_dict["w"][:] = wv
+        for _ in range(n_backward):
+            ex.forward(is_train=True)
+            ex.backward([mx.nd.array(cot)])
+        return ex.grad_dict["w"].asnumpy()
+
+    single = run("write", 1)
+    np.testing.assert_allclose(run("write", 3), single, rtol=1e-6)
+    np.testing.assert_allclose(run("add", 3), 3 * single, rtol=1e-5)
