@@ -380,3 +380,99 @@ def test_slice_variants():
                ref, grad=False)
     _check(lambda a: mx.sym.slice_axis(a, axis=2, begin=-3, end=-1), [x],
            x[:, :, -3:-1])
+
+
+# ---- dropout / upsampling / leaky / embedding variants --------------------
+
+import mxtpu.autograd as ag  # noqa: E402
+
+
+def test_dropout_axes_broadcast_mask():
+    """Dropout with axes=(0,): one mask broadcast over the batch axis
+    (reference nn/dropout-inl.h axes param)."""
+    mx.random.seed(5)
+    x = nd.array(np.ones((8, 64), np.float32))
+    with ag.train_mode():
+        y = nd.Dropout(x, p=0.5, axes=(0,))
+    out = y.asnumpy()
+    # every row identical (mask shared across axis 0), values 0 or 1/(1-p)
+    for row in out[1:]:
+        np.testing.assert_array_equal(row, out[0])
+    vals = np.unique(out)
+    assert set(np.round(vals, 4)).issubset({0.0, 2.0}), vals
+    # eval mode: identity
+    assert np.array_equal(nd.Dropout(x, p=0.5).asnumpy(), x.asnumpy())
+
+
+def test_upsampling_nearest_symbolic():
+    r = _r(20)
+    x = r.uniform(-1, 1, (1, 2, 3, 3)).astype(np.float32)
+    _check(lambda a: mx.sym.UpSampling(a, scale=2, sample_type="nearest"),
+           [x], np.repeat(np.repeat(x, 2, 2), 2, 3))
+
+
+def test_upsampling_bilinear_interpolates():
+    """Bilinear upsampling of a linear ramp interpolates (monotonic, with
+    values strictly between grid points) — a nearest-neighbor regression
+    would produce a repeated staircase."""
+    ramp = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+    ramp = np.broadcast_to(ramp, (1, 1, 4, 4)).copy()
+    w = np.ones((1, 1, 4, 4), np.float32)
+    out = nd.UpSampling(nd.array(ramp), nd.array(w), scale=2,
+                        sample_type="bilinear", num_filter=1,
+                        num_args=2).asnumpy()
+    assert out.shape == (1, 1, 8, 8)
+    row = out[0, 0, 4]
+    assert np.all(np.diff(row) >= -1e-6), row          # monotone ramp
+    nearest = np.repeat(ramp[0, 0, 2], 2)
+    assert not np.allclose(row, nearest), "staircase = not bilinear"
+    interior = row[1:-1]
+    assert np.unique(np.round(interior, 4)).size > 4   # true interpolation
+
+
+@pytest.mark.parametrize("act,reff", [
+    ("leaky", lambda x: np.where(x >= 0, x, 0.25 * x)),
+    ("elu", lambda x: np.where(x >= 0, x, 0.25 * np.expm1(x))),
+    ("selu", lambda x: 1.0507009873554805 *
+     np.where(x >= 0, x, 1.6732632423543772 * np.expm1(x))),
+])
+def test_leaky_relu_family_symbolic(act, reff):
+    x = np.array([[-2.0, -0.5, 0.5, 2.0]], np.float32)
+    _check(lambda a: mx.sym.LeakyReLU(a, act_type=act), [x], reff(x))
+
+
+def test_prelu_symbolic():
+    x = np.array([-2.0, -0.5, 0.5, 2.0], np.float32)
+    x2 = np.broadcast_to(x[:, None], (4, 2)).copy()  # (batch, channel)
+    g = np.array([0.2, 0.3], np.float32)
+    _check(lambda a, b: mx.sym.LeakyReLU(a, b, act_type="prelu"),
+           [x2, g], np.where(x2 >= 0, x2, g * x2))
+
+
+def test_embedding_grad_rows():
+    """Embedding gradient only touches looked-up rows; repeated indices
+    accumulate (the sparse-grad contract densely realized) — checked
+    through BOTH the tape and the symbolic executor."""
+    w_np = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx_np = np.array([1, 1, 3], np.float32)
+    expected = np.zeros((4, 3), np.float32)
+    expected[1] = 2
+    expected[3] = 1
+
+    w = nd.array(w_np)
+    w.attach_grad()
+    with ag.record():
+        out = nd.Embedding(nd.array(idx_np), w, input_dim=4, output_dim=3)
+    out.backward(nd.array(np.ones((3, 3), np.float32)))
+    np.testing.assert_allclose(w.grad.asnumpy(), expected)
+
+    sym = mx.sym.Embedding(mx.sym.var("idx"), mx.sym.var("w"),
+                           input_dim=4, output_dim=3)
+    ex = sym.simple_bind(ctx=mx.cpu(),
+                         grad_req={"idx": "null", "w": "write"},
+                         idx=idx_np.shape, w=w_np.shape)
+    ex.arg_dict["idx"][:] = idx_np
+    ex.arg_dict["w"][:] = w_np
+    ex.forward(is_train=True)
+    ex.backward([nd.array(np.ones((3, 3), np.float32))])
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), expected)
