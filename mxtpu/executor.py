@@ -26,6 +26,20 @@ from .ndarray import NDArray, _wrap
 __all__ = ["Executor"]
 
 
+def _ones_cot(o):
+    # integer outputs (argmax/shape_array/casts) take float0 cotangents;
+    # a ones_like would make jax.vjp reject the pullback
+    if jnp.issubdtype(o.dtype, jnp.inexact):
+        return jnp.ones_like(o)
+    return _np.zeros(o.shape, jax.dtypes.float0)
+
+
+def _zeros_cot(o):
+    if jnp.issubdtype(o.dtype, jnp.inexact):
+        return jnp.zeros_like(o)
+    return _np.zeros(o.shape, jax.dtypes.float0)
+
+
 class Executor:
     """Compiled executor over a Symbol (API parity with mx.executor.Executor)."""
 
@@ -67,8 +81,7 @@ class Executor:
         # instead of storing them (base.maybe_remat).
         self._mirror = backward_mirror_enabled()
 
-        @jax.jit
-        def fwd_bwd(arg_vals, aux_vals, key, cotangents):
+        def _vjp_parts(arg_vals, aux_vals, key):
             feed = dict(zip(arg_names, arg_vals))
             feed.update(zip(aux_names, aux_vals))
 
@@ -81,14 +94,43 @@ class Executor:
                 return tuple(outs), new_aux
 
             primals = tuple(feed[n] for n in grad_args)
-            (outs, new_aux), vjp_fn = jax.vjp(
-                maybe_remat(f, enabled=self._mirror), primals)
-            zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
+            return jax.vjp(maybe_remat(f, enabled=self._mirror), primals)
+
+        @jax.jit
+        def fwd_bwd(arg_vals, aux_vals, key, cotangents):
+            (outs, new_aux), vjp_fn = _vjp_parts(arg_vals, aux_vals, key)
+            zero_aux = tuple(_zeros_cot(a) for a in new_aux)
             grads = vjp_fn((cotangents, zero_aux))[0]
+            return outs, new_aux, grads
+
+        @jax.jit
+        def fwd_bwd_ones(arg_vals, aux_vals, key):
+            # Fused train step for the loss-head case (out_grads=None):
+            # cotangents are ones, so they can be built inside the trace and
+            # the whole forward+backward is ONE compiled computation. This is
+            # what lets forward(is_train=True) speculate the backward and
+            # Module.fit pay for the forward convolutions exactly once per
+            # step (reference runs fwd nodes once and reuses activations,
+            # graph_executor.cc:81-109).
+            (outs, new_aux), vjp_fn = _vjp_parts(arg_vals, aux_vals, key)
+            cot = tuple(_ones_cot(o) for o in outs)
+            zero_aux = tuple(_zeros_cot(a) for a in new_aux)
+            grads = vjp_fn((cot, zero_aux))[0]
             return outs, new_aux, grads
 
         self._fwd = fwd
         self._fwd_bwd = fwd_bwd
+        self._fwd_bwd_ones = fwd_bwd_ones
+        # Backward speculation is earned, not assumed: None = undecided
+        # (plain forward), True = this executor proved to be a loss head
+        # (its backward arrives with out_grads=None), False = it received
+        # explicit head gradients or mutates inputs between forward and
+        # backward — speculation would be wasted work. Forward-only
+        # executors therefore never pay for a fused pass.
+        self._speculate = None
+        self._cached_grads = None
+        self._state_snapshot = None
+        self._grads_served = True
 
     # -- binding constructors ---------------------------------------------
     @staticmethod
@@ -172,10 +214,29 @@ class Executor:
         self._key, sub = jax.random.split(self._key)
         arg_vals = tuple(self.arg_dict[n]._data for n in self._arg_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
-        outs, new_aux = self._fwd(arg_vals, aux_vals, sub, bool(is_train))
+        if self._cached_grads is not None and not self._grads_served:
+            # the previous speculated backward was never consumed (e.g.
+            # training-mode prediction loops) — stop paying for it
+            self._speculate = False
+        self._cached_grads = None
+        if is_train and self._grad_args and self._speculate:
+            self._grads_served = False
+            outs, new_aux, grads = self._fwd_bwd_ones(arg_vals, aux_vals, sub)
+            self._cached_grads = grads
+        else:
+            outs, new_aux = self._fwd(arg_vals, aux_vals, sub, bool(is_train))
         if is_train:
             for n, v in zip(self._aux_names, new_aux):
                 self.aux_dict[n]._data = v
+        if self._cached_grads is not None:
+            # jax.Arrays are immutable, so any in-place NDArray write
+            # between forward and backward swaps the _data object —
+            # identity-compare against this (post-aux-update) snapshot at
+            # backward time to know whether speculated grads are still valid
+            self._state_snapshot = arg_vals + tuple(
+                self.aux_dict[n]._data for n in self._aux_names)
+        else:
+            self._state_snapshot = None
         self._last_key = sub
         self._outputs = [_wrap(o, self._ctx) for o in outs]
         if self._monitor_callback is not None:
@@ -188,18 +249,41 @@ class Executor:
             return
         if self._outputs is None:
             raise RuntimeError("backward called before forward")
-        if out_grads is None:
-            cotangents = tuple(jnp.ones_like(o._data) for o in self._outputs)
+        self._grads_served = True
+        state_now = tuple(self.arg_dict[n]._data for n in self._arg_names) \
+            + tuple(self.aux_dict[n]._data for n in self._aux_names)
+        fresh = (self._state_snapshot is not None and
+                 all(cur is old for cur, old
+                     in zip(state_now, self._state_snapshot)))
+        if out_grads is None and self._cached_grads is not None and fresh:
+            grads = self._cached_grads
+        elif out_grads is None:
+            if self._cached_grads is not None:
+                # caller mutates bound arrays between forward and backward;
+                # speculated grads are computed from forward-time values, so
+                # recompute from the current state and stop speculating
+                self._speculate = False
+            elif self._speculate is None:
+                # proven loss head: fuse the backward into forward from the
+                # next step on (Module.fit steady state = 1 forward/step)
+                self._speculate = True
+            arg_vals = state_now[:len(self._arg_names)]
+            aux_vals = state_now[len(self._arg_names):]
+            _outs, _new_aux, grads = self._fwd_bwd_ones(arg_vals, aux_vals,
+                                                        self._last_key)
         else:
+            # explicit head gradients: this executor sits mid-chain, so
+            # speculation can never pay off — stop doing it
+            self._speculate = False
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             cotangents = tuple(g._data if g is not None
-                               else jnp.zeros_like(o._data)
+                               else _zeros_cot(o._data)
                                for g, o in zip(out_grads, self._outputs))
-        arg_vals = tuple(self.arg_dict[n]._data for n in self._arg_names)
-        aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
-        outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals,
-                                             self._last_key, cotangents)
+            arg_vals = state_now[:len(self._arg_names)]
+            aux_vals = state_now[len(self._arg_names):]
+            _outs, _new_aux, grads = self._fwd_bwd(arg_vals, aux_vals,
+                                                   self._last_key, cotangents)
         for n, g in zip(self._grad_args, grads):
             tgt = self.grad_dict[n]
             if self._grad_req.get(n) == "add":

@@ -165,6 +165,8 @@ def test_bucketing_module():
 
 def test_conv_module():
     """Small conv net trains (reference tests/python/train/test_conv.py)."""
+    np.random.seed(7)   # init draws from the global stream: keep the test
+    mx.random.seed(7)   # independent of how many binds ran before it
     rng = np.random.RandomState(0)
     n = 256
     x = rng.randn(n, 1, 8, 8).astype("float32")
@@ -185,3 +187,140 @@ def test_conv_module():
             initializer=mx.initializer.Xavier(), num_epoch=20)
     score = mod.score(train, "acc")
     assert score[0][1] > 0.95, score
+
+
+def test_train_step_runs_one_fused_computation():
+    """After the first backward proves this executor is a loss head, each
+    forward(is_train=True)+backward() pair must execute exactly one compiled
+    computation — the speculative fused fwd+vjp — not a forward followed by
+    a second forward-recomputing fwd_bwd (the reference runs forward nodes
+    once and reuses activations, graph_executor.cc:81-109)."""
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3), name="conv")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    exe = net.simple_bind(mx.cpu(), grad_req="write",
+                          data=(2, 3, 8, 8), softmax_label=(2,))
+    calls = {"fwd": 0, "fwd_bwd": 0, "fwd_bwd_ones": 0}
+
+    def counted(name, fn):
+        def wrapper(*a, **kw):
+            calls[name] += 1
+            return fn(*a, **kw)
+        return wrapper
+
+    exe._fwd = counted("fwd", exe._fwd)
+    exe._fwd_bwd = counted("fwd_bwd", exe._fwd_bwd)
+    exe._fwd_bwd_ones = counted("fwd_bwd_ones", exe._fwd_bwd_ones)
+
+    exe.arg_dict["data"][:] = np.random.randn(2, 3, 8, 8).astype("float32")
+    exe.arg_dict["softmax_label"][:] = np.array([0.0, 2.0])
+    # step 1: plain forward, then backward proves the loss-head pattern
+    exe.forward(is_train=True)
+    exe.backward()
+    assert calls == {"fwd": 1, "fwd_bwd": 0, "fwd_bwd_ones": 1}
+    # steady state: ONE fused computation per train step, no plain forward
+    for _ in range(3):
+        exe.forward(is_train=True)
+        exe.backward()
+    assert calls == {"fwd": 1, "fwd_bwd": 0, "fwd_bwd_ones": 4}
+    # inference forward stays on the plain (non-differentiating) path
+    exe.forward(is_train=False)
+    assert calls["fwd"] == 2 and calls["fwd_bwd_ones"] == 4
+
+
+def test_speculative_backward_matches_explicit_cotangents():
+    """Speculated grads (ones cotangents fused at forward time) must match
+    the explicit fwd_bwd path, an executor that receives out_grads must
+    fall back and stop speculating, and mutating a bound array between
+    forward and backward must invalidate the speculated grads."""
+    x = np.random.RandomState(3).randn(4, 5).astype("float32")
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    net = mx.sym.FullyConnected(data, weight=w, num_hidden=3, no_bias=True,
+                                name="fc")
+    net = mx.sym.sum(mx.sym.square(net))
+    exe1 = net.simple_bind(mx.cpu(), grad_req="write", data=(4, 5))
+    exe2 = net.simple_bind(mx.cpu(), grad_req="write", data=(4, 5))
+    wval = np.random.RandomState(4).randn(3, 5).astype("float32")
+    for exe in (exe1, exe2):
+        exe.arg_dict["data"][:] = x
+        exe.arg_dict["w"][:] = wval
+    exe1.forward(is_train=True)
+    exe1.backward()           # enables speculation
+    exe1.forward(is_train=True)
+    exe1.backward()           # speculative cached path
+    assert exe1._cached_grads is not None
+    exe2._speculate = False
+    exe2.forward(is_train=True)
+    exe2.backward()           # classic fwd + fused-ones path
+    np.testing.assert_allclose(exe1.grad_dict["w"].asnumpy(),
+                               exe2.grad_dict["w"].asnumpy(), rtol=1e-6)
+    ref_grad = exe2.grad_dict["w"].asnumpy()
+    # mutating an input between forward and backward must not serve the
+    # speculated (stale) grads: grads reflect the new value, and the
+    # executor stops speculating
+    exe1.forward(is_train=True)
+    exe1.arg_dict["data"][:] = 2.0 * x
+    exe1.backward()
+    assert exe1._speculate is False
+    np.testing.assert_allclose(exe1.grad_dict["w"].asnumpy(),
+                               4.0 * ref_grad, rtol=1e-5)
+    # explicit out_grads: correct result + speculation stays off
+    exe1.arg_dict["data"][:] = x
+    og = mx.nd.array(np.full((), 2.0, dtype="float32"))
+    exe1.forward(is_train=True)
+    exe1.backward(out_grads=[og])
+    assert exe1._speculate is False
+    np.testing.assert_allclose(exe1.grad_dict["w"].asnumpy(),
+                               2.0 * ref_grad, rtol=1e-6)
+
+
+def test_train_forward_only_integer_output_ok():
+    """A for-training executor whose symbol has an integer output must not
+    crash at forward (integer outputs take float0 cotangents in the fused
+    speculative pass)."""
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    grp = mx.sym.Group([mx.sym.SoftmaxOutput(fc, name="softmax"),
+                        mx.sym.argmax(fc, axis=1)])
+    exe = grp.simple_bind(mx.cpu(), grad_req="write",
+                          data=(2, 5), softmax_label=(2,))
+    exe.arg_dict["data"][:] = np.random.RandomState(0).randn(2, 5).astype("f")
+    exe.arg_dict["fc_weight"][:] = \
+        np.random.RandomState(1).randn(3, 5).astype("f")
+    outs = exe.forward(is_train=True)
+    exe.backward()      # loss head proven -> next forward speculates
+    outs = exe.forward(is_train=True)
+    exe.backward()
+    assert outs[0].shape == (2, 3)
+    g = exe.grad_dict["fc_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_speculation_demoted_when_backward_stops():
+    """Training-mode prediction loops (forward(is_train=True) with no
+    backward) must not keep paying for speculated backwards: one unserved
+    speculation demotes the executor back to plain forwards."""
+    data = mx.sym.var("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=3),
+                               name="softmax")
+    exe = net.simple_bind(mx.cpu(), grad_req="write",
+                          data=(2, 5), softmax_label=(2,))
+    calls = {"ones": 0}
+    orig = exe._fwd_bwd_ones
+
+    def counting(*a, **kw):
+        calls["ones"] += 1
+        return orig(*a, **kw)
+
+    exe._fwd_bwd_ones = counting
+    exe.forward(is_train=True)
+    exe.backward()                    # proves loss head
+    exe.forward(is_train=True)        # speculates (1 fused call) ...
+    assert calls["ones"] == 2         # (backward fallback + speculation)
+    for _ in range(4):                # ... but nobody calls backward
+        exe.forward(is_train=True)
+    assert exe._speculate is False
+    assert calls["ones"] == 2         # exactly one wasted pass, then heals
