@@ -257,6 +257,11 @@ class Executor:
                      in zip(state_now, self._state_snapshot)))
         if out_grads is None and self._cached_grads is not None and fresh:
             grads = self._cached_grads
+            # drop the references: the optimizer update is about to swap
+            # every param's _data, and a kept snapshot would pin the whole
+            # forward-time parameter set in device memory between steps
+            self._cached_grads = None
+            self._state_snapshot = None
         elif out_grads is None:
             if self._cached_grads is not None:
                 # caller mutates bound arrays between forward and backward;

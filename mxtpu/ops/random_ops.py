@@ -91,7 +91,8 @@ def sample_uniform(low, high, shape=None, dtype=None):
     u = jax.random.uniform(next_rng_key(), out_shape, dtype=low.dtype)
     low_b = low.reshape(low.shape + (1,) * len(s))
     high_b = high.reshape(high.shape + (1,) * len(s))
-    return low_b + u * (high_b - low_b)
+    out = low_b + u * (high_b - low_b)
+    return out if dtype is None else out.astype(dtype)
 
 
 @register("sample_normal", stateful=True, differentiable=False)
@@ -99,8 +100,9 @@ def sample_normal(mu, sigma, shape=None, dtype=None):
     s = _shape(shape)
     out_shape = mu.shape + s
     n = jax.random.normal(next_rng_key(), out_shape, dtype=mu.dtype)
-    return mu.reshape(mu.shape + (1,) * len(s)) + \
+    out = mu.reshape(mu.shape + (1,) * len(s)) + \
         sigma.reshape(sigma.shape + (1,) * len(s)) * n
+    return out if dtype is None else out.astype(dtype)
 
 
 @register("sample_gamma", stateful=True, differentiable=False)
@@ -109,7 +111,8 @@ def sample_gamma(alpha, beta, shape=None, dtype=None):
     a = alpha.reshape(alpha.shape + (1,) * len(s))
     b = beta.reshape(beta.shape + (1,) * len(s))
     g = jax.random.gamma(next_rng_key(), jnp.broadcast_to(a, alpha.shape + s))
-    return g * b
+    out = g * b
+    return out if dtype is None else out.astype(dtype)
 
 
 @register("sample_exponential", stateful=True, differentiable=False,
@@ -118,7 +121,8 @@ def sample_exponential(lam, shape=None, dtype=None):
     s = _shape(shape)
     e = jax.random.exponential(next_rng_key(), lam.shape + s,
                                dtype=lam.dtype)
-    return e / lam.reshape(lam.shape + (1,) * len(s))
+    out = e / lam.reshape(lam.shape + (1,) * len(s))
+    return out if dtype is None else out.astype(dtype)
 
 
 @register("sample_poisson", stateful=True, differentiable=False,

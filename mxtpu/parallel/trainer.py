@@ -26,6 +26,8 @@ schedules stay dynamic across steps without retracing.
 """
 from __future__ import annotations
 
+import copy
+
 import numpy as _np
 
 import jax
@@ -530,10 +532,13 @@ def device_prefetch(iterator, mesh=None, size=2):
                 return type(batch)(*staged)
             return type(batch)(staged)
         if hasattr(batch, "data") and hasattr(batch, "label"):
-            batch.data = [NDArray(stage_arr(d)) for d in batch.data]
+            # build a fresh batch object: iterators that recycle one
+            # DataBatch across next() calls must not alias buffered entries
+            staged = copy.copy(batch)
+            staged.data = [NDArray(stage_arr(d)) for d in batch.data]
             if batch.label is not None:  # DataBatch allows label=None
-                batch.label = [NDArray(stage_arr(l)) for l in batch.label]
-            return batch
+                staged.label = [NDArray(stage_arr(l)) for l in batch.label]
+            return staged
         return stage_arr(batch)
 
     it = iter(iterator)

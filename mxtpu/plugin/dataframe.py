@@ -24,9 +24,13 @@ __all__ = ["DataFrameIter"]
 
 def _column_block(frame, field):
     """A column (or list of columns) -> one 2-D+ numpy block."""
-    col = frame[field]
     if isinstance(field, (list, tuple)):
-        return col.to_numpy().astype(_np.float32)
+        # a column list is a feature concat: each column's block (scalar,
+        # vector or image cells alike) flattens to (n, features) first
+        blocks = [_column_block(frame, f) for f in field]
+        blocks = [b.reshape(len(b), -1) for b in blocks]
+        return _np.concatenate(blocks, axis=1)
+    col = frame[field]
     first = col.iloc[0]
     if isinstance(first, (list, tuple, _np.ndarray)):
         block = _np.stack([_np.asarray(v, _np.float32) for v in col])

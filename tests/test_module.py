@@ -250,8 +250,9 @@ def test_speculative_backward_matches_explicit_cotangents():
     exe1.forward(is_train=True)
     exe1.backward()           # enables speculation
     exe1.forward(is_train=True)
+    assert exe1._cached_grads is not None   # speculation engaged
     exe1.backward()           # speculative cached path
-    assert exe1._cached_grads is not None
+    assert exe1._cached_grads is None       # served grads are released
     exe2._speculate = False
     exe2.forward(is_train=True)
     exe2.backward()           # classic fwd + fused-ones path

@@ -475,3 +475,23 @@ def test_device_prefetch_none_label_and_namedtuple():
     out = list(device_prefetch(iter([nb]), mesh=mesh))
     assert isinstance(out[0], Batch)
     np.testing.assert_allclose(np.asarray(out[0].data), nb.data)
+
+
+def test_device_prefetch_recycling_iterator_not_aliased():
+    """An iterator that reuses ONE DataBatch object across next() calls must
+    not have its buffered entries corrupted by later mutations."""
+    from mxtpu.parallel import MeshContext, device_prefetch
+    from mxtpu.io import DataBatch
+
+    mesh = MeshContext(jax.devices()[:1], data=1)
+    shared = DataBatch([mx.nd.zeros((2, 3))], [mx.nd.zeros((2,))])
+
+    def recycling():
+        for i in range(4):
+            shared.data = [mx.nd.full((2, 3), i)]
+            shared.label = [mx.nd.full((2,), i)]
+            yield shared
+
+    got = [float(b.data[0].asnumpy()[0, 0])
+           for b in device_prefetch(recycling(), mesh=mesh, size=3)]
+    assert got == [0.0, 1.0, 2.0, 3.0], got

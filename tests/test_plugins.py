@@ -115,3 +115,21 @@ def test_dataframe_iter_array_cells_module_fit():
             optimizer_params={"learning_rate": 0.5}, num_epoch=4)
     acc = dict(mod.score(it, "acc"))["accuracy"]
     assert acc > 0.9, acc
+
+
+def test_dataframe_iter_column_list_with_array_cells():
+    """A data_field column list may mix scalar and array-cell columns
+    (each stacked per-column, then concatenated along features)."""
+    import pandas as pd
+    df = pd.DataFrame({
+        "vec": [np.array([1.0, 2.0]), np.array([3.0, 4.0]),
+                np.array([5.0, 6.0]), np.array([7.0, 8.0])],
+        "s": [0.5, 1.5, 2.5, 3.5],
+        "y": [0.0, 1.0, 0.0, 1.0],
+    })
+    it = DataFrameIter(df, data_field=["vec", "s"], label_field="y",
+                       batch_size=2)
+    batch = next(it)
+    assert batch.data[0].shape == (2, 3)
+    np.testing.assert_allclose(batch.data[0].asnumpy(),
+                               [[1.0, 2.0, 0.5], [3.0, 4.0, 1.5]])
