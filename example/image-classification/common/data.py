@@ -1,0 +1,172 @@
+"""Data-iterator wiring shared by the image-classification scripts.
+
+Capability parity with the reference's common/data.py: the same CLI arg
+surface (data paths, rgb mean, augmentation level knobs, synthetic
+benchmark mode), producing mxtpu ImageRecordIter pipelines sharded by
+kvstore rank for distributed runs (reference get_rec_iter,
+example/image-classification/common/data.py:113-168).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu.io import DataBatch, DataDesc, DataIter
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, help="the training data")
+    data.add_argument("--data-val", type=str, help="the validation data")
+    data.add_argument("--rgb-mean", type=str,
+                      default="123.68,116.779,103.939",
+                      help="a tuple of size 3 for the mean rgb")
+    data.add_argument("--pad-size", type=int, default=0,
+                      help="padding the input image")
+    data.add_argument("--image-shape", type=str,
+                      help="the image shape fed into the network, "
+                           "e.g. 3,224,224")
+    data.add_argument("--num-classes", type=int,
+                      help="the number of classes")
+    data.add_argument("--num-examples", type=int,
+                      help="the number of training examples")
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="number of threads for data decoding")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="if 1, then feed the network with synthetic data")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Image augmentations")
+    aug.add_argument("--random-crop", type=int, default=1,
+                     help="if or not randomly crop the image")
+    aug.add_argument("--random-mirror", type=int, default=1,
+                     help="if or not randomly flip horizontally")
+    aug.add_argument("--max-random-h", type=int, default=0,
+                     help="max change of hue, range [0, 180]")
+    aug.add_argument("--max-random-s", type=int, default=0,
+                     help="max change of saturation, range [0, 255]")
+    aug.add_argument("--max-random-l", type=int, default=0,
+                     help="max change of intensity, range [0, 255]")
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0,
+                     help="max change of aspect ratio, range [0, 1]")
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0,
+                     help="max angle to rotate, range [0, 360]")
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0,
+                     help="max ratio to shear, range [0, 1]")
+    aug.add_argument("--max-random-scale", type=float, default=1,
+                     help="max ratio to scale")
+    aug.add_argument("--min-random-scale", type=float, default=1,
+                     help="min ratio to scale; should be >= "
+                          "img_size/input_shape, otherwise use --pad-size")
+    return aug
+
+
+def set_data_aug_level(parser, level):
+    if level >= 1:
+        parser.set_defaults(random_crop=1, random_mirror=1)
+    if level >= 2:
+        parser.set_defaults(max_random_h=36, max_random_s=50,
+                            max_random_l=50)
+    if level >= 3:
+        parser.set_defaults(max_random_rotate_angle=10,
+                            max_random_shear_ratio=0.1,
+                            max_random_aspect_ratio=0.25)
+
+
+class SyntheticDataIter(DataIter):
+    """Fixed random batch served max_iter times (--benchmark 1 mode)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        super().__init__(data_shape[0])
+        self.cur_iter = 0
+        self.max_iter = int(max_iter)
+        rng = np.random.RandomState(0)
+        self._data = mx.nd.array(
+            rng.uniform(-1, 1, data_shape).astype(dtype))
+        self._label = mx.nd.array(
+            rng.randint(0, num_classes, (data_shape[0],)).astype(dtype))
+        self._dtype = dtype
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", self._data.shape, self._dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", self._label.shape, self._dtype)]
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter > self.max_iter:
+            raise StopIteration
+        return DataBatch(data=[self._data], label=[self._label], pad=0,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def get_rec_iter(args, kv=None):
+    """(train, val) record iterators, sharded across kvstore workers."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    if getattr(args, "benchmark", 0):
+        data_shape = (args.batch_size,) + image_shape
+        train = SyntheticDataIter(args.num_classes, data_shape,
+                                  args.num_examples / args.batch_size)
+        return train, None
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    rgb_mean = [float(x) for x in args.rgb_mean.split(",")]
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        rand_crop=args.random_crop,
+        rand_mirror=args.random_mirror,
+        pad=args.pad_size, fill_value=127,
+        max_random_scale=args.max_random_scale,
+        min_random_scale=args.min_random_scale,
+        max_aspect_ratio=args.max_random_aspect_ratio,
+        random_h=args.max_random_h, random_s=args.max_random_s,
+        random_l=args.max_random_l,
+        max_rotate_angle=args.max_random_rotate_angle,
+        max_shear_ratio=args.max_random_shear_ratio,
+        preprocess_threads=args.data_nthreads,
+        shuffle=True, num_parts=nworker, part_index=rank)
+    if not args.data_val:
+        return train, None
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        rand_crop=False, rand_mirror=False,
+        preprocess_threads=args.data_nthreads,
+        num_parts=nworker, part_index=rank)
+    return train, val
+
+
+def make_synthetic_recfile(path, num_images, image_hw, num_classes,
+                           seed=0):
+    """Write a small synthetic .rec file of JPEG records whose brightness
+    correlates with the class label — learnable real-pipeline data for
+    hermetic runs and tests (there is no dataset download in this
+    environment)."""
+    from mxtpu import recordio
+
+    rng = np.random.RandomState(seed)
+    writer = recordio.MXRecordIO(path, "w")
+    try:
+        for i in range(num_images):
+            label = i % num_classes
+            base = 40 + (175 * label) // max(1, num_classes - 1)
+            img = rng.randint(-35, 36, (image_hw, image_hw, 3)) + base
+            img = np.clip(img, 0, 255).astype(np.uint8)
+            header = recordio.IRHeader(0, float(label), i, 0)
+            writer.write(recordio.pack_img(header, img, quality=95))
+    finally:
+        writer.close()
+    return path

@@ -1,0 +1,13 @@
+"""Plain MLP symbol (reference symbols/mlp.py capability)."""
+import mxtpu as mx
+
+
+def get_symbol(num_classes=10, **kwargs):
+    data = mx.sym.var("data")
+    data = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu", name="relu2")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc3")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

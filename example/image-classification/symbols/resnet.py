@@ -1,0 +1,122 @@
+"""Symbolic ResNet (pre-activation v2) for the Module training path.
+
+Capability parity with the reference's symbol library
+(example/image-classification/symbols/resnet.py): ``get_symbol`` picks the
+stage plan from ``num_layers`` and the input resolution — ImageNet-style
+nets (224x224, 7x7 stem, 4 stages) for large images, CIFAR-style nets
+((num_layers-2) % 9 == 0 bottleneck / % 6 == 0 basic, 3 stages, 3x3 stem)
+for small ones. Written against the mxtpu symbol API; BatchNorm runs in
+fused form inside the jitted graph, so there is no workspace/cudnn tuning
+surface to mirror.
+"""
+from __future__ import annotations
+
+import mxtpu as mx
+
+
+def _bn(data, name):
+    return mx.sym.BatchNorm(data, fix_gamma=False, eps=2e-5, momentum=0.9,
+                            name=name)
+
+
+def residual_unit(data, num_filter, stride, dim_match, name,
+                  bottleneck=True):
+    """One pre-activation residual unit: BN-relu-conv stack + identity."""
+    bn1 = _bn(data, name + "_bn1")
+    act1 = mx.sym.Activation(bn1, act_type="relu", name=name + "_relu1")
+    if bottleneck:
+        conv1 = mx.sym.Convolution(act1, num_filter=num_filter // 4,
+                                   kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                                   no_bias=True, name=name + "_conv1")
+        bn2 = _bn(conv1, name + "_bn2")
+        act2 = mx.sym.Activation(bn2, act_type="relu", name=name + "_relu2")
+        conv2 = mx.sym.Convolution(act2, num_filter=num_filter // 4,
+                                   kernel=(3, 3), stride=stride, pad=(1, 1),
+                                   no_bias=True, name=name + "_conv2")
+        bn3 = _bn(conv2, name + "_bn3")
+        act3 = mx.sym.Activation(bn3, act_type="relu", name=name + "_relu3")
+        body = mx.sym.Convolution(act3, num_filter=num_filter,
+                                  kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                                  no_bias=True, name=name + "_conv3")
+    else:
+        conv1 = mx.sym.Convolution(act1, num_filter=num_filter,
+                                   kernel=(3, 3), stride=stride, pad=(1, 1),
+                                   no_bias=True, name=name + "_conv1")
+        bn2 = _bn(conv1, name + "_bn2")
+        act2 = mx.sym.Activation(bn2, act_type="relu", name=name + "_relu2")
+        body = mx.sym.Convolution(act2, num_filter=num_filter,
+                                  kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                                  no_bias=True, name=name + "_conv2")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = mx.sym.Convolution(act1, num_filter=num_filter,
+                                      kernel=(1, 1), stride=stride,
+                                      no_bias=True, name=name + "_sc")
+    return body + shortcut
+
+
+def _plan(num_layers, image_h):
+    """(units per stage, filters per stage, bottleneck?) for a depth."""
+    if image_h <= 64:  # CIFAR-style: 3 stages on 16/32/64-wide features
+        if (num_layers - 2) % 9 == 0:
+            n = (num_layers - 2) // 9
+            return [n] * 3, [64, 128, 256], True
+        if (num_layers - 2) % 6 == 0:
+            n = (num_layers - 2) // 6
+            return [n] * 3, [16, 32, 64], False
+        raise ValueError("CIFAR resnet depth must satisfy "
+                         "(num_layers-2) %% 9 == 0 or %% 6 == 0, got %d"
+                         % num_layers)
+    table = {18: ([2, 2, 2, 2], False), 34: ([3, 4, 6, 3], False),
+             50: ([3, 4, 6, 3], True), 101: ([3, 4, 23, 3], True),
+             152: ([3, 8, 36, 3], True), 200: ([3, 24, 36, 3], True)}
+    if num_layers not in table:
+        raise ValueError("no unit plan for resnet-%d at %dpx"
+                         % (num_layers, image_h))
+    units, bottleneck = table[num_layers]
+    filters = [256, 512, 1024, 2048] if bottleneck else [64, 128, 256, 512]
+    return units, filters, bottleneck
+
+
+def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
+               dtype="float32", **kwargs):
+    c, h, w = (int(x) for x in image_shape.split(","))
+    units, filters, bottleneck = _plan(num_layers, h)
+
+    data = mx.sym.var("data")
+    if dtype == "float16":
+        data = mx.sym.Cast(data, dtype="float16")
+    body = _bn(data, "bn_data")
+    if h <= 64:
+        body = mx.sym.Convolution(body, num_filter=filters[0] // (4 if
+                                  bottleneck else 1), kernel=(3, 3),
+                                  stride=(1, 1), pad=(1, 1), no_bias=True,
+                                  name="conv0")
+    else:
+        body = mx.sym.Convolution(body, num_filter=64, kernel=(7, 7),
+                                  stride=(2, 2), pad=(3, 3), no_bias=True,
+                                  name="conv0")
+        body = _bn(body, "bn0")
+        body = mx.sym.Activation(body, act_type="relu", name="relu0")
+        body = mx.sym.Pooling(body, kernel=(3, 3), stride=(2, 2),
+                              pad=(1, 1), pool_type="max", name="pool0")
+
+    for stage, (n_units, n_filter) in enumerate(zip(units, filters)):
+        stride = (1, 1) if stage == 0 else (2, 2)
+        body = residual_unit(body, n_filter, stride, False,
+                             "stage%d_unit1" % (stage + 1), bottleneck)
+        for unit in range(2, n_units + 1):
+            body = residual_unit(body, n_filter, (1, 1), True,
+                                 "stage%d_unit%d" % (stage + 1, unit),
+                                 bottleneck)
+
+    body = _bn(body, "bn1")
+    body = mx.sym.Activation(body, act_type="relu", name="relu1")
+    pool = mx.sym.Pooling(body, global_pool=True, pool_type="avg",
+                          kernel=(7, 7), name="pool1")
+    flat = mx.sym.Flatten(pool)
+    fc = mx.sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    if dtype == "float16":
+        fc = mx.sym.Cast(fc, dtype="float32")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Train CIFAR-10 from record files through the full real-data pipeline
+(reference example/image-classification/train_cifar10.py).
+
+No dataset download exists in this environment: point --data-train /
+--data-val at cifar10 .rec files, or pass --synthetic N to generate a
+small learnable synthetic record set under data/ (hermetic runs, CI).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+
+logging.basicConfig(level=logging.DEBUG)
+
+from common import data, fit  # noqa: E402
+
+
+def ensure_synthetic(args):
+    os.makedirs("data", exist_ok=True)
+    hw = int(args.image_shape.split(",")[1])
+    train = os.path.join("data", "cifar10_synth_train.rec")
+    val = os.path.join("data", "cifar10_synth_val.rec")
+    data.make_synthetic_recfile(train, args.synthetic, hw,
+                                args.num_classes, seed=0)
+    data.make_synthetic_recfile(val, max(args.batch_size,
+                                         args.synthetic // 5), hw,
+                                args.num_classes, seed=1)
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    data.set_data_aug_level(parser, 2)
+    parser.add_argument("--synthetic", type=int, default=0,
+                        help="generate N synthetic training records "
+                             "instead of reading --data-train")
+    parser.set_defaults(
+        network="resnet",
+        num_layers=110,
+        data_train=os.path.join("data", "cifar10_train.rec"),
+        data_val=os.path.join("data", "cifar10_val.rec"),
+        num_classes=10,
+        num_examples=50000,
+        image_shape="3,28,28",
+        pad_size=4,
+        batch_size=128,
+        num_epochs=300,
+        lr=0.05,
+        lr_step_epochs="200,250",
+    )
+    args = parser.parse_args()
+    if args.synthetic:
+        args.data_train, args.data_val = ensure_synthetic(args)
+        args.num_examples = args.synthetic
+
+    from importlib import import_module
+    if args.engine == "sharded":
+        from mxtpu.gluon.model_zoo import vision
+        depth = args.num_layers if args.num_layers in (18, 34, 50, 101, 152) \
+            else 18
+        net = vision.get_resnet(1, depth, classes=args.num_classes)
+    else:
+        net = import_module("symbols." + args.network).get_symbol(
+            **vars(args))
+
+    fit.fit(args, net, data.get_rec_iter)

@@ -336,14 +336,75 @@ class ColorNormalizeAug(Augmenter):
         return color_normalize(src, self.mean, self.std)
 
 
+def _affine_hsl_cfg(max_rotate_angle=0, max_shear_ratio=0.0,
+                    min_random_scale=1.0, max_random_scale=1.0,
+                    max_aspect_ratio=0.0, random_h=0, random_s=0,
+                    random_l=0):
+    """(affine cfg, hsl cfg) dicts for the record-iter default augmenter —
+    single source for the pool-worker path and CreateAugmenter."""
+    affine = {}
+    if max_rotate_angle or max_shear_ratio or max_aspect_ratio or \
+            (min_random_scale, max_random_scale) != (1.0, 1.0):
+        affine = {"max_rotate_angle": max_rotate_angle,
+                  "max_shear_ratio": max_shear_ratio,
+                  "min_random_scale": min_random_scale,
+                  "max_random_scale": max_random_scale,
+                  "max_aspect_ratio": max_aspect_ratio}
+    hsl = {}
+    if random_h or random_s or random_l:
+        hsl = {"random_h": random_h, "random_s": random_s,
+               "random_l": random_l}
+    return affine, hsl
+
+
+class RecordDefaultAug(Augmenter):
+    """Record-iterator default geometry/color augs (pad, affine
+    rotate/shear/scale/aspect, h/s/l jitter — reference
+    image_aug_default.cc), shared with the pool workers
+    (mxtpu._image_worker)."""
+
+    def __init__(self, pad=0, fill_value=127, affine=None, hsl=None):
+        super().__init__(pad=pad, fill_value=fill_value,
+                         affine=affine or {}, hsl=hsl or {})
+        self.pad = pad
+        self.fill_value = fill_value
+        self.affine = affine or {}
+        self.hsl = hsl or {}
+
+    def __call__(self, src):
+        from . import _image_worker as w
+        arr = _np.clip(src.asnumpy(), 0, 255).astype(_np.uint8)
+        rng = _np.random.RandomState(_random.randint(0, 2 ** 31 - 1))
+        if self.affine:
+            arr = w.affine_augment(arr, rng, fill_value=self.fill_value,
+                                   **self.affine)
+        if self.pad:
+            arr = w.pad_image(arr, self.pad, self.fill_value)
+        if self.hsl:
+            arr = w.hsl_jitter(arr, rng, **self.hsl)
+        return nd.array(arr)
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
-                    rand_gray=0, inter_method=2):
+                    rand_gray=0, inter_method=2, pad=0, fill_value=127,
+                    max_random_scale=1.0, min_random_scale=1.0,
+                    max_aspect_ratio=0.0, max_rotate_angle=0,
+                    max_shear_ratio=0.0, random_h=0, random_s=0,
+                    random_l=0):
     """Build the standard augmenter list (reference image.py:CreateAugmenter)."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
+    affine, hsl = _affine_hsl_cfg(max_rotate_angle, max_shear_ratio,
+                                  min_random_scale, max_random_scale,
+                                  max_aspect_ratio, random_h, random_s,
+                                  random_l)
+    if affine or pad or hsl:
+        # pre-crop geometry + color from the record-iter surface; hsl runs
+        # here (uint8 domain) rather than post-cast
+        auglist.append(RecordDefaultAug(pad, fill_value, affine, hsl))
     crop_size = (data_shape[2], data_shape[1])
     if rand_resize:
         auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
@@ -663,13 +724,21 @@ class ImageRecordIterImpl:
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=0.0, std_g=0.0,
                  std_b=0.0, resize=0, label_width=1, part_index=0,
                  num_parts=1, preprocess_threads=4, prefetch_buffer=4,
-                 data_name="data", label_name="softmax_label", **kwargs):
+                 data_name="data", label_name="softmax_label",
+                 pad=0, fill_value=127, max_random_scale=1.0,
+                 min_random_scale=1.0, max_aspect_ratio=0.0,
+                 max_rotate_angle=0, max_shear_ratio=0.0,
+                 random_h=0, random_s=0, random_l=0, **kwargs):
         mean = None
         if mean_r or mean_g or mean_b:
             mean = _np.array([mean_r, mean_g, mean_b])
         std = None
         if std_r or std_g or std_b:
             std = _np.array([std_r or 1.0, std_g or 1.0, std_b or 1.0])
+        affine, hsl = _affine_hsl_cfg(max_rotate_angle, max_shear_ratio,
+                                      min_random_scale, max_random_scale,
+                                      max_aspect_ratio, random_h,
+                                      random_s, random_l)
         # measured in tools/bench_io.py: the pool path wins even on a
         # single-core host (the fixed-function numpy/PIL workers beat the
         # per-image nd-op augmenters 3x, and decode overlaps the consumer)
@@ -681,6 +750,8 @@ class ImageRecordIterImpl:
             cfg = {"crop_h": data_shape[1], "crop_w": data_shape[2],
                    "resize": resize, "rand_crop": bool(rand_crop),
                    "rand_mirror": bool(rand_mirror),
+                   "pad": int(pad), "fill_value": int(fill_value),
+                   "affine": affine, "hsl": hsl,
                    "mean": None if mean is None
                    else mean.astype(_np.float32),
                    "std": None if std is None else std.astype(_np.float32)}
@@ -694,6 +765,13 @@ class ImageRecordIterImpl:
             path_imgrec=path_imgrec, shuffle=shuffle,
             rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean,
             std=std, resize=resize,
+            pad=pad, fill_value=fill_value,
+            max_random_scale=max_random_scale,
+            min_random_scale=min_random_scale,
+            max_aspect_ratio=max_aspect_ratio,
+            max_rotate_angle=max_rotate_angle,
+            max_shear_ratio=max_shear_ratio,
+            random_h=random_h, random_s=random_s, random_l=random_l,
             data_name=data_name, label_name=label_name,
             part_index=part_index, num_parts=num_parts, **kwargs)
         if mean_img:

@@ -10,6 +10,10 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXAMPLES = [
     ("image-classification/train_mnist.py", {}),
+    ("image-classification/train_cifar10.py",
+     {"ARGS": ["--synthetic", "48", "--num-layers", "8",
+               "--batch-size", "8", "--num-epochs", "1",
+               "--model-prefix", "ckpt/r8", "--data-nthreads", "2"]}),
     ("image-classification/benchmark_score.py",
      {"ARGS": ["--models", "resnet-50", "--batch-sizes", "1"]}),
     ("rnn/lstm_bucketing.py", {}),
