@@ -240,9 +240,14 @@ def _fit_sharded(args, net, train, val, kv):
 
     lr, lr_scheduler = _get_lr_scheduler(args, kv)
     begin_epoch = args.load_epoch or 0
+    # rank-suffix checkpoints like the module path's _save_model, so
+    # workers sharing a filesystem never race on one file
+    prefix = args.model_prefix
+    if prefix and kv.rank > 0:
+        prefix = "%s-%d" % (prefix, kv.rank)
     if begin_epoch:
-        assert args.model_prefix is not None
-        net.load_params("%s-%04d.params" % (args.model_prefix, begin_epoch))
+        assert prefix is not None
+        net.load_params("%s-%04d.params" % (prefix, begin_epoch))
     else:
         net.initialize(_select_initializer(args))
 
@@ -296,15 +301,14 @@ def _fit_sharded(args, net, train, val, kv):
                     logging.info("Epoch[%d] Validation-%s=%f",
                                  epoch, name, v)
 
-        if args.model_prefix:
+        if prefix:
             trainer.sync_params()
-            dst_dir = os.path.dirname(args.model_prefix)
+            dst_dir = os.path.dirname(prefix)
             if dst_dir and not os.path.isdir(dst_dir):
                 os.makedirs(dst_dir, exist_ok=True)
-            net.save_params("%s-%04d.params" % (args.model_prefix,
-                                                epoch + 1))
+            net.save_params("%s-%04d.params" % (prefix, epoch + 1))
             logging.info('Saved checkpoint to "%s-%04d.params"',
-                         args.model_prefix, epoch + 1)
+                         prefix, epoch + 1)
 
 
 def _as_list(x):

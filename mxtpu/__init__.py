@@ -19,6 +19,36 @@ if _os.environ.get("JAX_PLATFORMS"):
     import jax as _jax
     _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
+def _join_process_group():
+    # launched by tools/launch.py: join the process group NOW, before any
+    # import below touches the backend (jax.distributed must come up
+    # before the first computation; the reference bootstraps in
+    # KVStore::Create via ps::StartAsync, kvstore_dist.h:50-55 — here
+    # package import is the earliest safe point). Spawned helper
+    # processes (DataLoader / record-iter decode workers) inherit the
+    # env and re-import this package — they must NOT try to join with a
+    # duplicate process_id, hence the MainProcess guard.
+    import multiprocessing as _mp
+    if _mp.current_process().name != "MainProcess":
+        return
+    import jax as _jax
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["MXTPU_COORDINATOR"],
+            num_processes=int(_os.environ["MXTPU_NUM_PROCS"]),
+            process_id=int(_os.environ["MXTPU_PROC_ID"]))
+    except RuntimeError as e:
+        # worker scripts may have initialized explicitly; anything else
+        # (unreachable coordinator, bad port) must fail LOUDLY — silently
+        # degrading to N independent single-process runs trains N wrong
+        # models (the reference's ps::StartAsync also fails hard)
+        if "already" not in str(e).lower():
+            raise
+
+
+if _os.environ.get("MXTPU_COORDINATOR"):
+    _join_process_group()
+
 from .base import MXNetError, MXTPUError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ndarray

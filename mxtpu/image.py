@@ -569,6 +569,13 @@ def _read_record_items(path_imgrec, part_index=0, num_parts=1):
             header, img = recordio.unpack(item)
             items.append((img, header.label))
         rec_idx += 1
+    if num_parts > 1:
+        # equal shard sizes across workers: SPMD collectives (DistKVStore
+        # push, psum in the fused step) are blocking all-process ops, so
+        # every rank must see the same number of batches per epoch — a
+        # lone extra push would deadlock the group
+        equal = rec_idx // num_parts
+        items = items[:equal]
     return items
 
 

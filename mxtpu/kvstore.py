@@ -34,6 +34,7 @@ group identically in both modes.
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import numpy as _np_mod
@@ -120,10 +121,16 @@ class KVStore:
                         merged._data = merged._data + arr._data
             else:
                 merged = v.copy()
+            merged = self._reduce_merged(k, merged)
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._store[k])
             else:
                 self._store[k]._data = merged._data
+
+    def _reduce_merged(self, key, merged):
+        """Hook: reduce the locally-merged push across workers (identity
+        for single-process stores; DistKVStore sums over processes)."""
+        return merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Pull current value into out array(s) (broadcast)."""
@@ -242,10 +249,28 @@ class KVStore:
 class DistKVStore(KVStore):
     """Multi-host store over jax.distributed/DCN (reference KVStoreDist).
 
-    In a multi-process launch (``jax.distributed.initialize`` already
-    called, e.g. by ``tools/launch.py``), cross-host reduction happens via
-    collectives inside the sharded training step; the store itself holds
-    the host-local replica. Single-process: degenerates to rank 0/1.
+    In a multi-process launch the store carries the reference dist_sync
+    contract itself: ``init`` broadcasts rank 0's value, ``push`` sums the
+    locally-merged value across ALL processes before the updater runs
+    (the ps-lite server merge, kvstore_dist_server.h:279-339, rendered as
+    a process all-gather+sum), and ``barrier`` is a real global barrier.
+    The fused training-step path (ShardedTrainer) still does its
+    cross-host reduction via collectives inside the compiled step; this
+    explicit path is for reference-style push/pull training loops.
+
+    If ``tools/launch.py`` exported its worker env (MXTPU_COORDINATOR /
+    MXTPU_NUM_PROCS / MXTPU_PROC_ID) and nothing initialized
+    jax.distributed yet, creating the store performs the initialization —
+    ``kv = mx.kv.create('dist_sync')`` is the bootstrap call in reference
+    scripts (kvstore_dist.h:50-55 ps::StartAsync + barrier).
+    Single-process: degenerates to rank 0/1.
+
+    **SPMD contract (differs from ps-lite):** init/push/barrier are
+    blocking ALL-process collectives, so every worker must issue the same
+    sequence of store calls — a rank pushing one extra time (uneven data
+    shards) deadlocks the group rather than being absorbed by a server.
+    The framework's record iterators shard to equal per-worker sizes for
+    exactly this reason (image.py _read_record_items).
     """
 
     def __init__(self, kv_type):
@@ -254,10 +279,24 @@ class DistKVStore(KVStore):
         self._size = 1
         try:
             import jax
-            procs = jax.process_count()
+            if jax.process_count() == 1 and \
+                    os.environ.get("MXTPU_COORDINATOR"):
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=os.environ[
+                            "MXTPU_COORDINATOR"],
+                        num_processes=int(os.environ["MXTPU_NUM_PROCS"]),
+                        process_id=int(os.environ["MXTPU_PROC_ID"]))
+                except RuntimeError as e:
+                    # "already initialized" is fine (package import or
+                    # the worker script did it); a connect failure must
+                    # propagate — degrading to N independent runs would
+                    # silently train N unsynchronized models
+                    if "already" not in str(e).lower():
+                        raise
             self._rank = jax.process_index()
-            self._size = procs
-        except Exception:
+            self._size = jax.process_count()
+        except ImportError:
             pass
 
     @property
@@ -267,6 +306,39 @@ class DistKVStore(KVStore):
     @property
     def num_workers(self):
         return self._size
+
+    def init(self, key, value):
+        super().init(key, value)
+        if self._size > 1:
+            # reference dist init: rank 0's value wins for every worker
+            from jax.experimental import multihost_utils
+            keys, vals = _ctype_key_value(key, value)
+            import jax.numpy as jnp
+            for k in keys:
+                g = multihost_utils.process_allgather(self._store[k]._data)
+                # allgather returns host numpy; store device arrays
+                self._store[k]._data = jnp.asarray(g[0])
+
+    def _reduce_merged(self, key, merged):
+        if self._size <= 1:
+            return merged
+        from jax.experimental import multihost_utils
+        import jax.numpy as jnp
+        g = multihost_utils.process_allgather(merged._data)
+        out = merged.copy()
+        out._data = jnp.asarray(g.sum(axis=0))
+        if hasattr(out, "_aux"):
+            # dense cross-process sum invalidated row-sparse metadata;
+            # sparse consumers lazily recover rows from the value
+            out._aux = None
+        return out
+
+    def barrier(self):
+        super().barrier()
+        if self._size > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "mxtpu_kv_barrier_%d" % self._barrier_count)
 
 
 def _key_int(k):

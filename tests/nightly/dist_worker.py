@@ -8,17 +8,25 @@ bitwise identical across processes (the dist_sync property the reference
 nightly checks via kvstore push/pull).
 """
 import os
+import sys
 
 import numpy as np
 
 import jax
 jax.config.update("jax_platforms", "cpu")
 
-coord = os.environ["MXTPU_COORDINATOR"]
 nproc = int(os.environ["MXTPU_NUM_PROCS"])
 rank = int(os.environ["MXTPU_PROC_ID"])
-jax.distributed.initialize(coordinator_address=coord, num_processes=nproc,
-                           process_id=rank)
+
+# the mxtpu import itself joins the process group from the launcher env
+# (the reference bootstraps in kv create; see mxtpu/__init__.py) — no
+# explicit jax.distributed.initialize here, that's part of the contract
+# under test
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx                                           # noqa: E402
+
+assert jax.process_count() == nproc, jax.process_count()
+assert jax.process_index() == rank
 
 import jax.numpy as jnp                                     # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
@@ -68,13 +76,10 @@ for _ in range(200):
 w_np = np.asarray(jax.device_get(w))
 np.testing.assert_allclose(w_np, wt, atol=2e-2)
 
-# (c) dist_sync vs dist_async: on the SPMD runtime both execute the same
-# synchronous program (behavior statement in mxtpu/kvstore.py) — assert
-# the two modes expose identical store semantics and process identity.
-import sys                                                   # noqa: E402
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
-import mxtpu as mx                                           # noqa: E402
-
+# (c) kvstore facade semantics across processes (reference
+# tests/nightly/dist_sync_kvstore.py): init broadcasts rank 0's value,
+# push SUMS each worker's contribution across all workers before the
+# updater applies, pull returns the identical merged state everywhere.
 results = {}
 for mode in ("dist_sync", "dist_async"):
     kv = mx.kvstore.create(mode)
@@ -82,7 +87,8 @@ for mode in ("dist_sync", "dist_async"):
     assert kv.rank == rank and kv.num_workers == nproc, \
         (mode, kv.rank, kv.num_workers)
     updates = []
-    kv.init(9, mx.nd.ones((3,)))
+    # rank-varying init value: the broadcast must make rank 0's win
+    kv.init(9, mx.nd.ones((3,)) * (1 + rank * 100))
 
     def updater(key, recv, local, _log=updates):
         _log.append(int(key))
@@ -93,8 +99,14 @@ for mode in ("dist_sync", "dist_async"):
     out = mx.nd.zeros((3,))
     kv.pull(9, out=out)
     # updater applied exactly once per push in both modes (the reference's
-    # server-side immediate apply, running where the store lives)
+    # server-side merge-then-apply, kvstore_dist_server.h:279-339)
     assert updates == [9], (mode, updates)
+    # merged push = sum over workers of (rank+1); init = rank 0's ones
+    expect_kv = 1.0 - 0.1 * sum(r + 1 for r in range(nproc))
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full((3,), expect_kv, np.float32),
+                               rtol=1e-6)
+    kv.barrier()
     results[mode] = out.asnumpy()
 np.testing.assert_array_equal(results["dist_sync"], results["dist_async"])
 

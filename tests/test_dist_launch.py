@@ -16,7 +16,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_local_launcher_dist_training():
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_local_launcher_dist_training(nproc):
     root = os.path.join(os.path.dirname(__file__), "..")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)            # one device per process
@@ -25,7 +26,8 @@ def test_local_launcher_dist_training():
     # grandchildren too (Popen(shell=True) would otherwise orphan them)
     proc = subprocess.Popen(
         [sys.executable, os.path.join(root, "tools", "launch.py"),
-         "-n", "2", "--launcher", "local", "--port", str(_free_port()),
+         "-n", str(nproc), "--launcher", "local",
+         "--port", str(_free_port()),
          sys.executable + " " + os.path.join(root, "tests", "nightly",
                                              "dist_worker.py")],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -38,4 +40,5 @@ def test_local_launcher_dist_training():
         proc.communicate()
         raise
     assert proc.returncode == 0, out[-2000:]
-    assert "RANK_0_OK" in out and "RANK_1_OK" in out, out[-2000:]
+    for r in range(nproc):
+        assert "RANK_%d_OK" % r in out, out[-2000:]
