@@ -38,11 +38,13 @@ def _join_process_group():
             num_processes=int(_os.environ["MXTPU_NUM_PROCS"]),
             process_id=int(_os.environ["MXTPU_PROC_ID"]))
     except RuntimeError as e:
-        # worker scripts may have initialized explicitly; anything else
-        # (unreachable coordinator, bad port) must fail LOUDLY — silently
-        # degrading to N independent single-process runs trains N wrong
-        # models (the reference's ps::StartAsync also fails hard)
-        if "already" not in str(e).lower():
+        # worker scripts may have initialized explicitly ("should only be
+        # called once"); anything else (unreachable coordinator, bad
+        # port) must fail LOUDLY — silently degrading to N independent
+        # single-process runs trains N wrong models (the reference's
+        # ps::StartAsync also fails hard)
+        msg = str(e).lower()
+        if "already" not in msg and "once" not in msg:
             raise
 
 

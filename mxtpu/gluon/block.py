@@ -230,7 +230,12 @@ class Block:
     def __call__(self, *args):
         for hook in self._forward_pre_hooks:
             hook(self, args)
-        out = self.forward(*args)
+        # jax.named_scope stamps this block's name onto every HLO op it
+        # traces, so XPlane/TensorBoard profiles of a jitted step
+        # attribute time to gluon blocks (the per-op view the reference
+        # engine records, src/engine/threaded_engine.h:339-350)
+        with jax.named_scope(self.name or type(self).__name__):
+            out = self.forward(*args)
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out

@@ -288,11 +288,12 @@ class DistKVStore(KVStore):
                         num_processes=int(os.environ["MXTPU_NUM_PROCS"]),
                         process_id=int(os.environ["MXTPU_PROC_ID"]))
                 except RuntimeError as e:
-                    # "already initialized" is fine (package import or
-                    # the worker script did it); a connect failure must
-                    # propagate — degrading to N independent runs would
-                    # silently train N unsynchronized models
-                    if "already" not in str(e).lower():
+                    # double-init is fine (package import or the worker
+                    # script did it); a connect failure must propagate —
+                    # degrading to N independent runs would silently
+                    # train N unsynchronized models
+                    msg = str(e).lower()
+                    if "already" not in msg and "once" not in msg:
                         raise
             self._rank = jax.process_index()
             self._size = jax.process_count()
@@ -315,9 +316,14 @@ class DistKVStore(KVStore):
             keys, vals = _ctype_key_value(key, value)
             import jax.numpy as jnp
             for k in keys:
-                g = multihost_utils.process_allgather(self._store[k]._data)
+                store = self._store[k]
+                g = multihost_utils.process_allgather(store._data)
                 # allgather returns host numpy; store device arrays
-                self._store[k]._data = jnp.asarray(g[0])
+                store._data = jnp.asarray(g[0])
+                if hasattr(store, "_aux"):
+                    # rank-local sparse metadata no longer matches the
+                    # broadcast value; recover lazily from the data
+                    store._aux = None
 
     def _reduce_merged(self, key, merged):
         if self._size <= 1:

@@ -347,15 +347,21 @@ class ShardedTrainer:
             # transfers (critical when the host link is thin).
             key, sub = jax.random.split(key)
             t = t + 1
-            (loss_val, (aux_new, outs)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(
-                    train_vals, aux_vals, inputs, label, sub, True)
+            # named_scope: profiles of this step attribute HLO time to
+            # fwd_bwd vs optimizer phases (block-level names come from
+            # Block.__call__'s own scopes nested inside)
+            with jax.named_scope("fwd_bwd"):
+                (loss_val, (aux_new, outs)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(
+                        train_vals, aux_vals, inputs, label, sub, True)
             new_vals, new_states = [], []
-            for j, (w, g, st) in enumerate(zip(train_vals, grads, states)):
-                w2, st2 = functional_optimizer_step(
-                    optimizer, j, w, g, st, t, lr)
-                new_vals.append(w2)
-                new_states.append(st2)
+            with jax.named_scope("optimizer"):
+                for j, (w, g, st) in enumerate(zip(train_vals, grads,
+                                                   states)):
+                    w2, st2 = functional_optimizer_step(
+                        optimizer, j, w, g, st, t, lr)
+                    new_vals.append(w2)
+                    new_states.append(st2)
             # pin layouts so donation round-trips buffers in place
             new_vals = [
                 jax.lax.with_sharding_constraint(v, s)

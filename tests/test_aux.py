@@ -199,3 +199,26 @@ def test_launcher_cluster_modes_dry_run():
             assert m in res.stdout, (mode, m, res.stdout)
         assert "MXTPU_COORDINATOR=node0:9327" in res.stdout, mode
         assert "MXTPU_NUM_PROCS=4" in res.stdout, mode
+
+
+def test_jit_step_attributes_blocks_via_named_scope():
+    """Gluon blocks stamp jax.named_scope onto their traced ops, so a
+    compiled step's HLO op_name metadata attributes time per block/phase
+    (the reference's per-op profiler view, threaded_engine.h:339-350)."""
+    import jax
+    import jax.numpy as jnp
+    from mxtpu import gluon
+
+    net = gluon.nn.HybridSequential(prefix="prof_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize()
+    x = mx.nd.ones((2, 6))
+    net(x)  # materialize params
+
+    def f(xv):
+        return net(mx.nd.NDArray(xv))._data
+
+    hlo = jax.jit(f).lower(jnp.ones((2, 6))).compile().as_text()
+    assert "prof_" in hlo, "block name_scope missing from compiled HLO"

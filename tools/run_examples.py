@@ -38,6 +38,7 @@ EXAMPLES = [
     ("capsnet/capsnet_toy.py", {}),
     ("ctc/ctc_toy.py", {}),
     ("multivariate_time_series/lstnet_toy.py", {}),
+    ("profiler/profile_resnet.py", {}),
 ]
 
 

@@ -411,6 +411,8 @@ def _run_stage_child(name, timeout):
         try:
             with open(out_path) as f:
                 partial = json.load(f)
+        except Exception:
+            pass  # child killed mid-dump: keep what the report already has
         finally:
             os.unlink(out_path)
     return ok, err, partial
@@ -463,6 +465,7 @@ def main():
             if ok:
                 report.setdefault("stages_done", []).append(name)
                 report.pop(name + "_error", None)
+                report.pop("tpu_unavailable", None)
                 pending.pop(0)
             else:
                 attempts[name] = attempts.get(name, 0) + 1
