@@ -30,6 +30,7 @@ class _State(threading.local):
         self.recording = False
         self.training = False
         self.tape = []
+        self.backward_pass = 0
 
 
 _STATE = _State()
@@ -126,10 +127,18 @@ def mark_variables(variables, gradients, grad_reqs="write"):
         v._is_ag_variable = True
 
 
+def current_backward_pass():
+    """Monotonic id of the backward() invocation in flight — lets custom
+    sparse-grad writers tell "second contribution in this pass" (merge)
+    from "new pass" (honor grad_req)."""
+    return _STATE.backward_pass
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Run backward from head arrays along the recorded tape
     (reference: Imperative::Backward imperative.cc:357)."""
     from .ndarray import NDArray
+    _STATE.backward_pass += 1
     if isinstance(heads, NDArray):
         heads = [heads]
         if head_grads is not None and not isinstance(head_grads, (list, tuple)):
@@ -198,12 +207,31 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 
 def _write_grad(arr, grads):
-    if getattr(arr, "_grad", None) is not None and id(arr) in grads:
-        g = grads[id(arr)].astype(arr._grad._data.dtype)
-        if getattr(arr, "_grad_req", "write") == "add":
-            arr._grad._data = arr._grad._data + g
-        else:
-            arr._grad._data = g
+    if getattr(arr, "_grad", None) is None or id(arr) not in grads:
+        return
+    from .ndarray.sparse import (CompactRowSparseNDArray,
+                                 compact_row_sparse_array, compact_merge)
+    tgt = arr._grad
+    if isinstance(tgt, CompactRowSparseNDArray):
+        # a dense cotangent reached a compact grad slot (the variable was
+        # used by a dense recorded op, not only the sparse-embedding
+        # path): compress its nonzero rows rather than corrupting the
+        # compact buffer with a full-shape value
+        import numpy as _np
+        g_np = _np.asarray(grads[id(arr)])
+        rows = _np.nonzero(g_np.reshape(g_np.shape[0], -1).any(axis=1))[0]
+        fresh = compact_row_sparse_array(
+            (g_np[rows], rows.astype(_np.int64)), shape=tgt.shape,
+            nnz_max=max(tgt.nnz_max, rows.size))
+        if getattr(arr, "_grad_req", "write") == "add" and tgt.nnz:
+            fresh = compact_merge([tgt, fresh])
+        tgt._assign_value(fresh)
+        return
+    g = grads[id(arr)].astype(tgt._data.dtype)
+    if getattr(arr, "_grad_req", "write") == "add":
+        tgt._data = tgt._data + g
+    else:
+        tgt._data = g
 
 
 def _accum(grads, arr, value):

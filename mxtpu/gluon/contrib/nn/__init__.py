@@ -1,3 +1,4 @@
-from .basic_layers import Concurrent, HybridConcurrent, Identity
+from .basic_layers import (Concurrent, HybridConcurrent, Identity, SparseEmbedding)
 
-__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+__all__ = ["Concurrent", "HybridConcurrent", "Identity",
+           "SparseEmbedding"]

@@ -39,3 +39,115 @@ class Identity(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return x
+
+
+class SparseEmbedding(HybridBlock):
+    """Embedding whose weight gradient is COMPACT row-sparse — O(nnz)
+    device memory and compute in the backward (reference
+    contrib.SparseEmbedding, src/operator/tensor/indexing_op.h
+    SparseEmbeddingOpBackwardRsp; gluon sparse_grad=True embedding).
+
+    The backward never materializes a (input_dim, output_dim) cotangent:
+    it segment-sums the output gradient over the unique ids in the batch
+    (bounded by ``nnz_max``, default = batch size) and writes the result
+    straight into the weight's compact row_sparse grad buffer. Pair with
+    any optimizer's lazy update (SGD/Adam touch stored rows only) via
+    ``gluon.Trainer`` as usual.
+
+    Eager-autograd path (like the reference's sparse embedding, which is
+    FComputeEx-only): not hybridizable.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 nnz_max=None, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = int(input_dim)
+        self._output_dim = int(output_dim)
+        self._nnz_max = int(nnz_max) if nnz_max else None
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            grad_stype="row_sparse",
+            grad_nnz_max=self._nnz_max or max(1, input_dim // 8))
+
+    def __repr__(self):
+        return "SparseEmbedding(%d -> %d)" % (self._input_dim,
+                                              self._output_dim)
+
+    def forward(self, x):
+        from .... import autograd as _ag
+        from .... import ndarray as nd
+        import jax
+        import jax.numpy as jnp
+        import numpy as _np
+        from ....ndarray.sparse import (compact_row_sparse_array,
+                                        compact_merge,
+                                        CompactRowSparseNDArray)
+
+        weight = self.weight.data()
+        vocab, dim = weight.shape
+        ids = x._data.astype(jnp.int32)
+        out = nd.NDArray(jnp.take(weight._data, ids, axis=0))
+        if not _ag.is_recording() or self.weight.grad_req == "null":
+            return out
+
+        block = self
+
+        def sparse_backward(cotangents, entry):
+            from ....ndarray.sparse import row_sparse_array
+            dy = cotangents[0]
+            flat_ids = ids.reshape(-1)
+            # bound = batch size, so NO unique id can be truncated —
+            # nnz_max only sizes the persistent grad buffer (which grows
+            # if a batch ever touches more rows). O(batch*dim), never
+            # O(vocab*dim).
+            bound = int(flat_ids.shape[0])
+            uniq, inv = jnp.unique(flat_ids, size=bound,
+                                   fill_value=vocab,
+                                   return_inverse=True)
+            rows = jax.ops.segment_sum(
+                dy.reshape(-1, dim), inv.reshape(-1),
+                num_segments=bound)
+            uniq_np = _np.asarray(jax.device_get(uniq)).astype(_np.int64)
+            valid = uniq_np < vocab
+            fresh = compact_row_sparse_array(
+                (_np.asarray(jax.device_get(rows))[valid],
+                 uniq_np[valid]),
+                shape=(vocab, dim), nnz_max=max(1, int(valid.sum())))
+            gbuf = block.weight._grad
+            # a weight used twice in ONE recorded graph gets two tape
+            # entries: contributions within the same backward pass always
+            # sum; across passes grad_req decides (write = replace)
+            cur_pass = _ag.current_backward_pass()
+            same_pass = getattr(gbuf, "_sparse_bwd_pass", None) == cur_pass
+            accumulate = same_pass or block.weight.grad_req == "add"
+            if isinstance(gbuf, CompactRowSparseNDArray):
+                if accumulate and gbuf.nnz:
+                    fresh = compact_merge([gbuf, fresh])
+                if fresh.nnz > gbuf.nnz_max:
+                    gbuf._data = fresh._data
+                    gbuf._aux = fresh._aux
+                    gbuf._nnz = fresh._nnz
+                else:
+                    gbuf._set_rows(
+                        _np.asarray(jax.device_get(
+                            fresh._aux["indices"]._data[:fresh._nnz])),
+                        fresh._data[:fresh._nnz])
+            else:
+                # dense-backed rsp grad buffer: build the dense-backed
+                # representation explicitly (a compact copy would be
+                # misinstalled by the generic _assign_value)
+                dense_rsp = row_sparse_array(
+                    (fresh.data, fresh.indices.asnumpy()),
+                    shape=(vocab, dim))
+                if accumulate:
+                    from ....ndarray import sparse as _sp
+                    dense_rsp = _sp.add(gbuf, dense_rsp)
+                gbuf._assign_value(dense_rsp)
+            gbuf._sparse_bwd_pass = cur_pass
+            return [None]  # ids take no gradient
+
+        entry = _ag.TapeEntry(
+            op=None, params={}, inputs=[x], input_values=[x._data],
+            outputs=[out], custom_backward=sparse_backward)
+        _ag._tape_append(entry)
+        return out

@@ -41,7 +41,7 @@ class Parameter:
     def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
                  lr_mult=1.0, wd_mult=1.0, init=None,
                  allow_deferred_init=False, differentiable=True,
-                 stype="default", grad_stype="default"):
+                 stype="default", grad_stype="default", grad_nnz_max=None):
         self.name = name
         self._grad_req = grad_req if differentiable else "null"
         if isinstance(shape, int):
@@ -54,6 +54,8 @@ class Parameter:
         self._allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
         self._stype = stype
+        self._grad_stype = grad_stype
+        self._grad_nnz_max = grad_nnz_max
         self._data = None          # NDArray (single logical copy)
         self._grad = None          # NDArray or None
         self._deferred_init = None  # (init, ctx) pending shape
@@ -143,7 +145,17 @@ class Parameter:
             self._init_grad()
 
     def _init_grad(self):
-        self._grad = nd.zeros(self._shape, dtype=self.dtype)
+        if getattr(self, "_grad_stype", "default") == "row_sparse":
+            # sparse-grad parameters (Embedding tables): the gradient
+            # buffer is row_sparse; with grad_nnz_max it is the compact
+            # O(nnz_max)-memory representation (reference
+            # indexing_op.h SparseEmbeddingOpBackwardRsp)
+            from ..ndarray import sparse as _sp
+            self._grad = _sp.zeros("row_sparse", self._shape,
+                                   dtype=self.dtype,
+                                   nnz_max=self._grad_nnz_max)
+        else:
+            self._grad = nd.zeros(self._shape, dtype=self.dtype)
         _ag.mark_variables([self._data], [self._grad], [self._grad_req])
 
     def _finish_deferred_init(self):
@@ -211,8 +223,16 @@ class Parameter:
 
     def zero_grad(self):
         if self._grad is not None:
-            import jax.numpy as jnp
-            self._grad._data = jnp.zeros_like(self._grad._data)
+            from ..ndarray.sparse import (BaseSparseNDArray,
+                                          CompactRowSparseNDArray)
+            if isinstance(self._grad, CompactRowSparseNDArray):
+                self._grad._clear()
+            else:
+                import jax.numpy as jnp
+                self._grad._data = jnp.zeros_like(self._grad._data)
+                if isinstance(self._grad, BaseSparseNDArray):
+                    # stale indices/data views must not outlive the zero
+                    self._grad._aux = None
 
     def var(self):
         if self._var is None:

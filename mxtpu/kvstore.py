@@ -92,11 +92,16 @@ class KVStore:
         """Push value(s); lists of arrays per key are reduced (summed) —
         the CommDevice/NCCL reduce path of the reference, rendered as one
         fused XLA add chain."""
-        from .ndarray.sparse import RowSparseNDArray, row_sparse_array
+        from .ndarray.sparse import (RowSparseNDArray, row_sparse_array,
+                                     CompactRowSparseNDArray,
+                                     compact_merge)
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
-                if all(isinstance(a, RowSparseNDArray) for a in v):
+                if all(isinstance(a, CompactRowSparseNDArray) for a in v):
+                    # O(nnz) union-merge — no dense buffer at any point
+                    merged = compact_merge(list(v))
+                elif all(isinstance(a, RowSparseNDArray) for a in v):
                     # union of stored rows, summed values (reference
                     # ElementwiseSum rsp path, src/ndarray/ndarray.cc:1225)
                     import numpy as np
@@ -124,6 +129,10 @@ class KVStore:
             merged = self._reduce_merged(k, merged)
             if self._updater is not None:
                 self._updater(_key_int(k), merged, self._store[k])
+            elif isinstance(self._store[k], CompactRowSparseNDArray):
+                # compact stores accept only compact pushes
+                # (_assign_value raises a pointed error otherwise)
+                self._store[k]._assign_value(merged)
             else:
                 self._store[k]._data = merged._data
 
@@ -134,15 +143,21 @@ class KVStore:
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Pull current value into out array(s) (broadcast)."""
+        from .ndarray.sparse import CompactRowSparseNDArray
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
         for k, o in zip(keys, outs):
             src = self._store[k]
-            if isinstance(o, (list, tuple)):
-                for arr in o:
+            for arr in (o if isinstance(o, (list, tuple)) else [o]):
+                if isinstance(src, CompactRowSparseNDArray):
+                    if not isinstance(arr, CompactRowSparseNDArray):
+                        raise TypeError(
+                            "pull of a compact row_sparse table into a "
+                            "non-compact target would materialize the "
+                            "full shape; use row_sparse_pull")
+                    arr._assign_value(src)
+                else:
                     arr._data = src._data
-            else:
-                o._data = src._data
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the given rows (reference KVStore::PullRowSparse,
@@ -150,7 +165,8 @@ class KVStore:
         receives a row_sparse view holding exactly the requested rows —
         only nnz rows move, which is the point of the API (embedding-table
         pulls touch a sliver of a huge weight)."""
-        from .ndarray.sparse import RowSparseNDArray, row_sparse_array
+        from .ndarray.sparse import (RowSparseNDArray, row_sparse_array,
+                                     CompactRowSparseNDArray)
         assert out is not None and row_ids is not None
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
@@ -160,11 +176,35 @@ class KVStore:
             rid_np = rid.asnumpy().astype("int64") if isinstance(rid, NDArray) \
                 else _np_mod.asarray(rid, dtype="int64")
             rid_np = _np_mod.unique(rid_np)
-            gathered = nd.take(src, nd.array(rid_np).astype("int32"), axis=0)
-            rsp = row_sparse_array((gathered, rid_np), shape=src.shape)
+            if isinstance(src, CompactRowSparseNDArray):
+                pulled = src.retain(rid_np)
+                gathered = pulled.data
+                # only resident rows come back (absent rows are zero in
+                # the logical table and stay absent in the pull)
+                got_ids = pulled.indices.asnumpy().astype("int64")
+            else:
+                gathered = nd.take(src, nd.array(rid_np).astype("int32"),
+                                   axis=0)
+                got_ids = rid_np
             targets = o if isinstance(o, (list, tuple)) else [o]
+            compact_only = all(isinstance(a, CompactRowSparseNDArray)
+                               for a in targets)
+            if isinstance(src, CompactRowSparseNDArray) and \
+                    not compact_only:
+                raise TypeError(
+                    "row_sparse_pull from a compact store requires "
+                    "compact targets (a dense target would materialize "
+                    "the full table)")
             for arr in targets:
+                if isinstance(arr, CompactRowSparseNDArray):
+                    # rows move compactly: no dense buffer of src.shape
+                    # is created on either side (reference
+                    # PullRowSparseImpl, kvstore_local.h)
+                    arr._set_rows(got_ids, gathered._data)
+                    continue
                 if isinstance(arr, RowSparseNDArray):
+                    rsp = row_sparse_array((gathered, got_ids),
+                                           shape=src.shape)
                     arr._data = rsp._data
                     arr._aux = {kk: vv.copy()
                                 for kk, vv in rsp._ensure_aux().items()}
@@ -308,15 +348,35 @@ class DistKVStore(KVStore):
     def num_workers(self):
         return self._size
 
+    def _allgather_compact(self, arr):
+        """All-process copies of a compact array's (rows, indices, nnz)."""
+        from jax.experimental import multihost_utils
+        from .ndarray.sparse import CompactRowSparseNDArray
+        import jax.numpy as jnp
+        rows = multihost_utils.process_allgather(arr._data)
+        idx = multihost_utils.process_allgather(arr._aux["indices"]._data)
+        nnz = multihost_utils.process_allgather(_np_mod.array([arr._nnz]))
+        return [CompactRowSparseNDArray(jnp.asarray(rows[p]),
+                                        jnp.asarray(idx[p]),
+                                        int(nnz[p][0]), arr.shape,
+                                        arr._ctx)
+                for p in range(rows.shape[0])]
+
     def init(self, key, value):
         super().init(key, value)
         if self._size > 1:
             # reference dist init: rank 0's value wins for every worker
             from jax.experimental import multihost_utils
+            from .ndarray.sparse import CompactRowSparseNDArray
             keys, vals = _ctype_key_value(key, value)
             import jax.numpy as jnp
             for k in keys:
                 store = self._store[k]
+                if isinstance(store, CompactRowSparseNDArray):
+                    # broadcast the whole compact triple — slot buffers
+                    # are meaningless without their indices and count
+                    store._assign_value(self._allgather_compact(store)[0])
+                    continue
                 g = multihost_utils.process_allgather(store._data)
                 # allgather returns host numpy; store device arrays
                 store._data = jnp.asarray(g[0])
@@ -329,7 +389,13 @@ class DistKVStore(KVStore):
         if self._size <= 1:
             return merged
         from jax.experimental import multihost_utils
+        from .ndarray.sparse import (CompactRowSparseNDArray,
+                                     compact_merge)
         import jax.numpy as jnp
+        if isinstance(merged, CompactRowSparseNDArray):
+            # slots differ per rank: union-merge by GLOBAL row id, never
+            # by elementwise buffer position
+            return compact_merge(self._allgather_compact(merged))
         g = multihost_utils.process_allgather(merged._data)
         out = merged.copy()
         out._data = jnp.asarray(g.sum(axis=0))

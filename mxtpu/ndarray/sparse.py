@@ -29,14 +29,15 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
-from ..base import canonical_dtype
+from ..base import canonical_dtype, MXNetError
 from ..context import current_context
 from . import NDArray, _wrap, array as _dense_array
 
 __all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
-           "csr_matrix", "row_sparse_array", "cast_storage", "retain",
-           "zeros", "empty", "array", "add", "subtract", "multiply",
-           "divide", "dot"]
+           "CompactRowSparseNDArray", "csr_matrix", "row_sparse_array",
+           "compact_row_sparse_array", "compact_merge", "cast_storage",
+           "retain", "zeros", "empty", "array", "add", "subtract",
+           "multiply", "divide", "dot"]
 
 
 def _idx_dtype(d=None):
@@ -193,6 +194,221 @@ class RowSparseNDArray(BaseSparseNDArray):
         return retain(self, indices)
 
 
+class CompactRowSparseNDArray(RowSparseNDArray):
+    """Row-sparse array with **O(nnz_max) device memory** — no dense
+    buffer ever exists for it, so a logical table larger than device HBM
+    works (the point of reference row_sparse storage, ndarray.h:61-66;
+    KVStoreLocal PullRowSparseImpl moves only stored rows).
+
+    Layout (all static shapes, XLA-friendly):
+
+    * ``_data``            — ``(nnz_max, *row_shape)`` stored-row buffer
+    * ``_aux['indices']``  — ``(nnz_max,)`` int32, sorted ascending; the
+      padding tail holds ``shape[0]`` (an out-of-range sentinel)
+    * ``_nnz``             — host int, number of valid slots
+
+    Supported surface: asnumpy, copy/astype, retain, row gather,
+    kvstore push (compact merge) / row_sparse_pull (no densify), lazy
+    optimizer updates, and the sparse-embedding backward. Dense ops that
+    would require materializing the full shape raise — call
+    ``tostype('default')`` to densify *deliberately*.
+    """
+
+    __slots__ = ("_nnz", "_lshape")
+
+    def __init__(self, rows, indices, nnz, shape, ctx=None):
+        ctx = ctx or current_context()
+        aux = {"indices": _wrap(indices, ctx)}
+        NDArray.__init__(self, rows, ctx)
+        self._aux = aux
+        self._nnz = int(nnz)
+        self._lshape = tuple(shape)
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def shape(self):
+        return self._lshape
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._lshape:
+            n *= s
+        return n
+
+    @property
+    def nnz_max(self):
+        return int(self._data.shape[0])
+
+    @property
+    def nnz(self):
+        return self._nnz
+
+    @property
+    def data(self):
+        """Stored rows (valid slots only), shape (nnz, *row_shape)."""
+        return _wrap(self._data[:self._nnz], self._ctx)
+
+    @property
+    def indices(self):
+        return _wrap(self._aux["indices"]._data[:self._nnz].astype(
+            _np.int64), self._ctx)
+
+    # -- conversion --------------------------------------------------------
+    def asnumpy(self):
+        """Densify on the HOST only (device HBM may not fit the shape)."""
+        out = _np.zeros(self._lshape, dtype=_np.asarray(
+            jax.device_get(self._data[:1])).dtype)
+        if self._nnz:
+            idx = _np.asarray(jax.device_get(
+                self._aux["indices"]._data[:self._nnz]))
+            out[idx] = _np.asarray(jax.device_get(
+                self._data[:self._nnz]))
+        return out
+
+    def todense(self):
+        raise MXNetError(
+            "CompactRowSparseNDArray holds only nnz_max rows on device; "
+            "materializing the full %s would defeat its purpose. Use "
+            "asnumpy() for a host copy or tostype('default') if the "
+            "dense table truly fits." % (self._lshape,))
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self.copy()
+        if stype == "default":
+            return _dense_array(self.asnumpy(), ctx=self._ctx)
+        raise ValueError("cannot cast compact row_sparse to %r" % stype)
+
+    def copy(self):
+        return CompactRowSparseNDArray(
+            self._data, self._aux["indices"]._data, self._nnz,
+            self._lshape, self._ctx)
+
+    def astype(self, dtype, copy=True):
+        d = canonical_dtype(dtype)
+        return CompactRowSparseNDArray(
+            self._data.astype(d), self._aux["indices"]._data, self._nnz,
+            self._lshape, self._ctx)
+
+    def _assign_value(self, src):
+        if isinstance(src, CompactRowSparseNDArray):
+            if src._lshape != self._lshape:
+                raise ValueError("shape mismatch in compact assignment")
+            self._data = src._data
+            self._aux = {"indices": src._aux["indices"].copy()}
+            self._nnz = src._nnz
+            return
+        raise MXNetError(
+            "cannot assign a dense value into a compact row_sparse "
+            "array (that would materialize the full shape); build a "
+            "compact array with compact_row_sparse_array(...)")
+
+    def _set_rows(self, indices, rows):
+        """Replace contents with (indices, rows); pads to nnz_max.
+        ``indices`` host numpy int, ``rows`` device (n, *row_shape)."""
+        n = int(indices.shape[0])
+        if n > self.nnz_max:
+            raise ValueError(
+                "%d rows exceed this array's nnz_max=%d"
+                % (n, self.nnz_max))
+        order = _np.argsort(indices, kind="stable")
+        idx_sorted = indices[order].astype(_np.int32)
+        pad = _np.full((self.nnz_max - n,), self._lshape[0], _np.int32)
+        idx_buf = jnp.asarray(_np.concatenate([idx_sorted, pad]))
+        rows = rows[jnp.asarray(order.astype(_np.int32))]
+        row_pad = jnp.zeros((self.nnz_max - n,) + tuple(self._lshape[1:]),
+                            rows.dtype)
+        self._data = jnp.concatenate([rows, row_pad], axis=0) \
+            if self.nnz_max > n else rows
+        self._aux = {"indices": _wrap(idx_buf, self._ctx)}
+        self._nnz = n
+
+    def _clear(self):
+        """Zero slots (grad reset between steps)."""
+        self._data = jnp.zeros_like(self._data)
+        self._aux["indices"]._data = jnp.full(
+            (self.nnz_max,), self._lshape[0], jnp.int32)
+        self._nnz = 0
+
+    def retain(self, indices):
+        if isinstance(indices, NDArray):
+            keep = indices.asnumpy().astype(_np.int64)
+        else:
+            keep = _np.asarray(indices, _np.int64)
+        stored = _np.asarray(jax.device_get(
+            self._aux["indices"]._data[:self._nnz])).astype(_np.int64)
+        mask = _np.isin(stored, keep)
+        slots = _np.nonzero(mask)[0]
+        out = CompactRowSparseNDArray(
+            jnp.zeros_like(self._data),
+            jnp.full((self.nnz_max,), self._lshape[0], jnp.int32),
+            0, self._lshape, self._ctx)
+        if slots.size:
+            out._set_rows(stored[mask],
+                          self._data[jnp.asarray(slots.astype(_np.int32))])
+        return out
+
+    def _recompute_aux(self):
+        raise MXNetError("compact row_sparse metadata is authoritative; "
+                         "it is never recomputed from a dense value")
+
+
+def compact_row_sparse_array(arg1, shape=None, nnz_max=None, ctx=None,
+                             dtype=None):
+    """Create a CompactRowSparseNDArray from ``(data, indices)``.
+
+    ``nnz_max`` bounds the stored-row buffer (defaults to len(indices));
+    device memory is nnz_max * row_size regardless of ``shape[0]``."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, CompactRowSparseNDArray):
+        out = arg1.astype(dtype) if dtype else arg1.copy()
+        return out
+    if not (isinstance(arg1, tuple) and len(arg1) == 2):
+        raise TypeError("compact_row_sparse_array expects (data, indices)")
+    data, indices = arg1
+    data = _as_nd(data, dtype)
+    idx_np = (indices.asnumpy() if isinstance(indices, NDArray)
+              else _np.asarray(indices)).astype(_np.int64)
+    if shape is None:
+        rows = int(idx_np.max()) + 1 if idx_np.size else 0
+        shape = (rows,) + tuple(data.shape[1:])
+    nnz_max = int(nnz_max) if nnz_max is not None else max(1, idx_np.size)
+    out = CompactRowSparseNDArray(
+        jnp.zeros((nnz_max,) + tuple(shape[1:]), data._data.dtype),
+        jnp.full((nnz_max,), shape[0], jnp.int32), 0, shape, ctx)
+    if idx_np.size:
+        out._set_rows(idx_np, data._data)
+    return out
+
+
+def compact_merge(arrs):
+    """Union-merge compact row-sparse arrays (sum of stored rows) —
+    the ElementwiseSum rsp path without any dense materialization."""
+    first = arrs[0]
+    total = sum(a._nnz for a in arrs)
+    bound = min(total, first._lshape[0]) or 1
+    ids = _np.concatenate([
+        _np.asarray(jax.device_get(a._aux["indices"]._data[:a._nnz]))
+        for a in arrs]) if total else _np.zeros((0,), _np.int64)
+    uniq = _np.unique(ids.astype(_np.int64))
+    if uniq.size > bound:
+        bound = uniq.size
+    out = CompactRowSparseNDArray(
+        jnp.zeros((bound,) + tuple(first._lshape[1:]), first._data.dtype),
+        jnp.full((bound,), first._lshape[0], jnp.int32),
+        0, first._lshape, first._ctx)
+    if uniq.size:
+        # sum rows per unique id via bounded segment-sum on device
+        rows = jnp.concatenate([a._data[:a._nnz] for a in arrs], axis=0)
+        seg = _np.searchsorted(uniq, ids)
+        summed = jax.ops.segment_sum(rows,
+                                     jnp.asarray(seg.astype(_np.int32)),
+                                     num_segments=uniq.size)
+        out._set_rows(uniq, summed)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # constructors
 # ---------------------------------------------------------------------------
@@ -271,13 +487,19 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     return RowSparseNDArray(nd_in._data, aux, ctx)
 
 
-def zeros(stype, shape, ctx=None, dtype=None):
-    """Sparse-typed zeros (reference mx.nd.sparse.zeros)."""
+def zeros(stype, shape, ctx=None, dtype=None, nnz_max=None):
+    """Sparse-typed zeros (reference mx.nd.sparse.zeros). Passing
+    ``nnz_max`` for row_sparse returns the compact O(nnz_max)-memory
+    representation instead of the dense-backed one."""
     ctx = ctx or current_context()
     dtype = canonical_dtype(dtype) if dtype is not None else _np.float32
     if stype == "default":
         from . import zeros as dzeros
         return dzeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "row_sparse" and nnz_max is not None:
+        return CompactRowSparseNDArray(
+            jnp.zeros((int(nnz_max),) + tuple(shape[1:]), dtype),
+            jnp.full((int(nnz_max),), shape[0], jnp.int32), 0, shape, ctx)
     dense = jnp.zeros(shape, dtype)
     if stype == "csr":
         aux = {"data": _dense_array(_np.zeros((0,), dtype)),

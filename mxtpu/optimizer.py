@@ -19,6 +19,7 @@ import pickle
 import warnings
 
 import numpy as _np
+import jax
 import jax.numpy as jnp
 
 from . import ndarray as nd
@@ -204,11 +205,45 @@ def _lazy_rsp_update(opt, index, weight, grad, state):
     Gathers the active rows, re-enters ``opt.update`` with dense row
     views (grad is dense there, so no recursion), scatters results back.
     """
+    from .ndarray.sparse import CompactRowSparseNDArray
     rows = grad.indices._data.astype(jnp.int32)
     if rows.shape[0] == 0:
         opt._update_count(index)
         return
     g_rows = NDArray(grad.data._data)
+    if isinstance(weight, CompactRowSparseNDArray):
+        # compact weight: translate global row ids to slots in the
+        # stored-row buffer (ids must be resident — pull them first via
+        # kv.row_sparse_pull, the reference's sparse-table workflow)
+        def _leaves(s):
+            if s is None:
+                return []
+            if isinstance(s, (tuple, list)):
+                return [x for e in s for x in _leaves(e)]
+            return [s]
+        if _leaves(state):
+            # slot-space state would silently follow residency changes to
+            # the wrong global rows; the reference keeps sparse-table
+            # optimizer state where the FULL table lives (the dist
+            # server, kvstore_dist_server.h) — mirror that contract
+            raise NotImplementedError(
+                "stateful optimizers on compact row_sparse weights are "
+                "not supported on the worker side: keep the optimizer "
+                "where the full table lives (kv.set_optimizer on a "
+                "dense-backed store) or use sgd with momentum=0")
+        import numpy as _np
+        w_idx = _np.asarray(jax.device_get(
+            weight._aux["indices"]._data[:weight._nnz])).astype(_np.int64)
+        g_idx = _np.asarray(jax.device_get(rows)).astype(_np.int64)
+        slots_np = _np.searchsorted(w_idx, g_idx)
+        if (slots_np >= w_idx.size).any() or \
+                (w_idx[_np.minimum(slots_np, w_idx.size - 1)]
+                 != g_idx).any():
+            missing = sorted(set(g_idx) - set(w_idx))[:5]
+            raise KeyError(
+                "gradient rows %s... not resident in compact weight "
+                "(row_sparse_pull them first)" % missing)
+        rows = jnp.asarray(slots_np.astype(_np.int32))
     w_rows = NDArray(weight._data[rows])
 
     def take(s):
