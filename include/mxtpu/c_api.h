@@ -3,9 +3,8 @@
  * \brief Core C ABI of the mxtpu framework.
  *
  * Reference counterpart: include/mxnet/c_api.h (2,216 lines, 174 MX*
- * functions). This header carries the ~60 most-consumed functions — the
- * surface every language binding (R/Scala/Perl/cpp-package) actually
- * calls: NDArray create/copy/sync, the imperative op invoke, autograd,
+ * functions). This header carries ~140 of them — the surface every
+ * language binding (R/Scala/Perl/cpp-package) actually calls: NDArray create/copy/sync, the imperative op invoke, autograd,
  * Symbol compose/infer, Executor bind/forward/backward, KVStore, and
  * DataIter handles. Signatures match the reference's where the semantics
  * carry over; deviations are documented inline.
@@ -25,6 +24,8 @@ extern "C" {
 #endif
 
 #include <stdint.h>
+#include <stdbool.h>
+#include <stddef.h>
 
 typedef unsigned int mx_uint;
 typedef float mx_float;
@@ -236,6 +237,270 @@ int MXDataIterBeforeFirst(DataIterHandle handle);
 int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
 int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
 int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+/* ------------------------------------------------- round-3 ABI breadth */
+
+typedef void *CachedOpHandle;
+typedef void *RecordIOHandle;
+typedef void *ProfileHandle;
+/*! \brief executor monitor callback: (output name, value, closure) */
+typedef void (MXExecMonitorCallback)(const char *name, NDArrayHandle value,
+                                     void *closure);
+/*! \brief C custom-op dispatcher. phase: 0=forward (arrays =
+ *  inputs then outputs), 1=backward (arrays = out_grads, inputs, then
+ *  in_grads). Read inputs / write results through
+ *  MXNDArraySyncCopyToCPU / FromCPU on the given handles. Return 0 on
+ *  success. */
+typedef int (MXCustomOpDispatcher)(int phase, int num_arrays,
+                                   NDArrayHandle *arrays, void *state);
+/*! \brief kvstore server controller: (command head, body, closure) */
+typedef void (MXKVServerController)(int head, const char *body,
+                                    void *closure);
+
+int MXEngineSetBulkSize(int size, int *prev);
+int MXSetNumOMPThreads(int num_threads);
+
+/* autograd */
+int MXAutogradIsRecording(bool *out);
+int MXAutogradIsTraining(bool *out);
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *outputs,
+                         NDArrayHandle *ograds, mx_uint num_variables,
+                         NDArrayHandle *variables, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes);
+int MXAutogradComputeGradient(mx_uint num_output, NDArrayHandle *outputs);
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out);
+
+/* NDArray breadth */
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type);
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 NDArrayHandle handle_src, int i);
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, bool full_check);
+/*! \brief serialized bytes; library-owned, stable until next call */
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf);
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out);
+int MXNDArrayLoadFromBuffer(const void *buf, size_t size,
+                            mx_uint *out_size, NDArrayHandle **out_arr,
+                            mx_uint *out_name_size,
+                            const char ***out_names);
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype,
+                            mx_uint num_aux, int *aux_type,
+                            mx_uint *aux_ndims, const mx_uint *aux_shape,
+                            NDArrayHandle *out);
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out);
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type);
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArraySetGradState(NDArrayHandle handle, int state);
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
+
+/* Symbol breadth */
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value);
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out);
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out);
+int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint *output_count);
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete);
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+int MXSymbolGetAtomicSymbolInfo(OpHandle creator, const char **name,
+                                const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args);
+
+/* Executor breadth */
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    mx_uint num_g2c_keys, const char **g2c_keys, const int *g2c_dev_types,
+    const int *g2c_dev_ids, mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    mx_uint num_provided_arg_shapes, const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx, mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    mx_uint num_provided_arg_stypes, const char **provided_arg_stype_names,
+    const int *provided_arg_stypes, mx_uint num_shared_arg_names,
+    const char **shared_arg_name_list, int *shared_buffer_len,
+    const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list,
+    mx_uint *num_in_args, NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out);
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train);
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 MXExecMonitorCallback callback,
+                                 void *callback_handle);
+
+/* CachedOp */
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out);
+int MXCreateCachedOpEx(SymbolHandle handle, int num_flags,
+                       const char **keys, const char **vals,
+                       CachedOpHandle *out);
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs);
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs,
+                       const int **out_stypes);
+int MXFreeCachedOp(CachedOpHandle handle);
+
+/* KVStore breadth */
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int *number, const int timeout_sec);
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVServerController controller,
+                       void *controller_handle);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit);
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, mx_uint num,
+                                    const char **keys, const char **vals);
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVUpdater updater,
+                          void *updater_handle);
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority);
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority);
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals);
+
+/* Profiler */
+int MXSetProfilerConfig(int num_params, const char *const *keys,
+                        const char *const *vals);
+int MXSetProfilerState(int state);
+int MXDumpProfile(int finished);
+int MXProfilePause(int paused);
+/*! \brief aggregate stats table; library-owned string */
+int MXAggregateProfileStatsPrint(const char **out_str, int reset);
+int MXProfileCreateDomain(const char *domain, ProfileHandle *out);
+int MXProfileCreateTask(ProfileHandle domain, const char *task_name,
+                        ProfileHandle *out);
+int MXProfileCreateFrame(ProfileHandle domain, const char *frame_name,
+                         ProfileHandle *out);
+int MXProfileCreateEvent(const char *event_name, ProfileHandle *out);
+int MXProfileCreateCounter(ProfileHandle domain, const char *counter_name,
+                           ProfileHandle *out);
+int MXProfileDestroyHandle(ProfileHandle handle);
+int MXProfileDurationStart(ProfileHandle duration_handle);
+int MXProfileDurationStop(ProfileHandle duration_handle);
+int MXProfileSetCounter(ProfileHandle counter_handle, uint64_t value);
+int MXProfileAdjustCounter(ProfileHandle counter_handle, int64_t delta);
+int MXProfileSetMarker(ProfileHandle domain, const char *instant_marker_name,
+                       const char *scope);
+
+/* RecordIO */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+/*! \brief *size = 0 at end of file; buffer library-owned until next read */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
+                               size_t *size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos);
+
+/* Custom ops from C */
+int MXCustomOpRegister(const char *op_type, int num_inputs, int num_outputs,
+                       MXCustomOpDispatcher dispatcher, void *state);
+
+/* DataIter extra */
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
+
+/* Ex aliases and legacy surface */
+/*! \brief MXImperativeInvoke + output storage types (all dense here) */
+int MXImperativeInvokeEx(OpHandle op, int num_inputs, NDArrayHandle *inputs,
+                         int *num_outputs, NDArrayHandle **outputs,
+                         int num_params, const char **param_keys,
+                         const char **param_vals, const int **out_stypes);
+/*! \brief group2ctx-aware Bind variants: placement maps to sharding
+ *  annotations under XLA, so the ctx-group arrays are accepted and the
+ *  bind behaves like MXExecutorBind (documented deviation) */
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out);
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out);
+/*! \brief host mirror of the array's contents; pointer stable until the
+ *  next call on this handle (the reference returns the device pointer —
+ *  meaningless across the XLA boundary, documented deviation) */
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+/*! \brief v0.x "Function" registry: superseded by the op registry; the
+ *  list is empty and handle-taking calls fail with a pointed error */
+typedef void *FunctionHandle;
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+int MXGetFunction(const char *name, FunctionHandle *out);
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions);
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask);
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars);
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals);
+/*! \brief deprecated in the reference (symbolic grad graphs come from
+ *  bind); always fails with guidance */
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out);
 
 #ifdef __cplusplus
 }  /* extern "C" */

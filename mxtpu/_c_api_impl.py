@@ -424,3 +424,552 @@ def data_iter_label(batch):
 
 def data_iter_pad(batch):
     return int(batch.pad or 0)
+
+
+# ----------------------------------------------------- round-3 ABI breadth
+
+def engine_set_bulk_size(size):
+    from mxtpu import engine
+    return engine.set_bulk_size(int(size))
+
+
+def set_num_omp_threads(n):
+    # XLA manages its own threadpools; accepted for parity (reference
+    # MXSetNumOMPThreads -> omp_set_num_threads)
+    import os
+    os.environ["OMP_NUM_THREADS"] = str(int(n))
+
+
+def autograd_is_recording():
+    import mxtpu.autograd as ag
+    return 1 if ag.is_recording() else 0
+
+
+def autograd_is_training():
+    import mxtpu.autograd as ag
+    return 1 if ag.is_training() else 0
+
+
+def autograd_backward_ex(outputs, ograds, variables, retain_graph,
+                         create_graph, is_train):
+    import mxtpu.autograd as ag
+    ograds = None if not ograds else list(ograds)
+    if create_graph:
+        raise NotImplementedError("create_graph (higher-order) is not "
+                                  "supported through the C ABI")
+    ag.backward(list(outputs), head_grads=ograds,
+                retain_graph=bool(retain_graph),
+                train_mode=bool(is_train))
+    # reference returns grads of `variables` when given; stype codes
+    # ride along so the C side never guesses (row_sparse grads exist now)
+    if variables:
+        grads = [v.grad for v in variables]
+        stypes = [(-1 if g is None else ndarray_storage_type(g))
+                  for g in grads]
+        return [grads, stypes]
+    return [[], []]
+
+
+def autograd_get_symbol(arr):
+    import mxtpu.autograd as ag
+    return ag.get_symbol(arr)
+
+
+# ------------------------------------------------------------ NDArray extra
+
+def ndarray_storage_type(arr):
+    stype = getattr(arr, "stype", "default")
+    return {"default": 1, "row_sparse": 2, "csr": 3}.get(stype, 1)
+
+
+def ndarray_detach(arr):
+    return arr.detach()
+
+
+def ndarray_wait_to_write(arr):
+    # jax arrays are immutable; pending producers resolve on wait_to_read
+    arr.wait_to_read()
+
+
+def ndarray_sync_copy_from_ndarray(dst, src, i):
+    if int(i) >= 0:
+        # reference semantics: i selects the i-th aux array of a sparse src
+        src = src._aux_data(int(i))
+    dst._assign_value(src)
+    dst.wait_to_read()
+
+
+def ndarray_save_raw_bytes(arr):
+    import pickle
+    return pickle.dumps({"shape": tuple(arr.shape),
+                         "dtype": str(np.dtype(arr.dtype)),
+                         "data": arr.asnumpy().tobytes()})
+
+
+def ndarray_load_raw_bytes(buf):
+    import pickle
+    nd = _nd()
+    d = pickle.loads(bytes(buf))
+    host = np.frombuffer(d["data"], dtype=d["dtype"]).reshape(d["shape"])
+    return nd.array(host)
+
+
+def ndarray_load_from_buffer(buf):
+    """In-memory variant of MXNDArrayLoad (reference LoadFromBuffer)."""
+    import io
+    nd = _nd()
+    data = nd.load_buffer(bytes(buf)) if hasattr(nd, "load_buffer") else None
+    if data is None:
+        import tempfile, os
+        with tempfile.NamedTemporaryFile(suffix=".params",
+                                         delete=False) as f:
+            f.write(bytes(buf))
+            path = f.name
+        try:
+            data = nd.load(path)
+        finally:
+            os.unlink(path)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return [[data[k] for k in names], names]
+    return [list(data), []]
+
+
+def ndarray_create_sparse(stype, shape, dev_type, dev_id, dtype,
+                          aux_types):
+    from mxtpu.ndarray import sparse
+    stype_name = {1: "default", 2: "row_sparse", 3: "csr"}[int(stype)]
+    return sparse.zeros(stype_name, tuple(int(s) for s in shape),
+                        ctx=_ctx(dev_type, dev_id),
+                        dtype=_DTYPE_CODES[dtype])
+
+
+def ndarray_aux_ndarray(arr, i):
+    return arr._aux_data(int(i)).copy()
+
+
+def ndarray_aux_type(arr, i):
+    aux = arr._aux_data(int(i))
+    return _DTYPE_CODES.index(str(np.dtype(aux.dtype)))
+
+
+def ndarray_data_ndarray(arr):
+    from mxtpu.ndarray import sparse as sp
+    if isinstance(arr, sp.BaseSparseNDArray):
+        return arr.data.copy()
+    return arr.detach()
+
+
+def ndarray_check_format(arr, full_check):
+    from mxtpu.ndarray import sparse as sp
+    if isinstance(arr, sp.CSRNDArray):
+        ptr = arr.indptr.asnumpy()
+        if (np.diff(ptr) < 0).any() or ptr[0] != 0:
+            raise ValueError("invalid CSR indptr")
+    if isinstance(arr, sp.RowSparseNDArray):
+        idx = arr.indices.asnumpy()
+        if idx.size and (np.diff(idx) <= 0).any():
+            raise ValueError("row_sparse indices must be strictly "
+                             "ascending")
+
+
+def ndarray_set_grad_state(arr, state):
+    arr._fresh_grad = bool(state)
+
+
+def ndarray_get_grad_state(arr):
+    return 1 if getattr(arr, "_fresh_grad", False) else 0
+
+
+# ------------------------------------------------------------ Symbol extra
+
+def symbol_get_name(s):
+    n = getattr(s, "name", None)
+    return ["" if n is None else str(n), 1 if n is not None else 0]
+
+
+def symbol_get_attr(s, key):
+    v = s.attr(key)
+    return ["" if v is None else str(v), 1 if v is not None else 0]
+
+
+def symbol_set_attr(s, key, value):
+    s._set_attr(**{str(key): str(value)})
+
+
+def symbol_list_attr(s, shallow):
+    out = []
+    attrs = s.attr_dict()
+    if shallow:
+        name = getattr(s, "name", None)
+        attrs = {name: attrs.get(name, {})} if name in attrs else {}
+        for k, v in attrs.get(name, {}).items():
+            out += [str(k), str(v)]
+        return out
+    for node, kv in attrs.items():
+        for k, v in kv.items():
+            out += ["%s$%s" % (node, k), str(v)]
+    return out
+
+
+def symbol_num_outputs(s):
+    return len(s.list_outputs())
+
+
+def symbol_get_children(s):
+    return s.get_children()
+
+
+def symbol_print(s):
+    return s.debug_str() if hasattr(s, "debug_str") else repr(s)
+
+
+def symbol_infer_type(s, keys, dtypes):
+    kwargs = {k: _DTYPE_CODES[int(d)] for k, d in zip(keys, dtypes)}
+    arg_types, out_types, aux_types = s.infer_type(**kwargs)
+
+    def codes(ts):
+        return [(-1 if t is None else
+                 _DTYPE_CODES.index(str(np.dtype(t)))) for t in ts]
+    return [codes(arg_types), codes(out_types), codes(aux_types)]
+
+
+def symbol_infer_shape_partial(s, keys, shapes):
+    kwargs = {k: tuple(int(x) for x in v) for k, v in zip(keys, shapes)}
+    arg_s, out_s, aux_s = s.infer_shape_partial(**kwargs)
+
+    def clean(ts):
+        return [list(t) if t is not None else [] for t in ts]
+    return [clean(arg_s), clean(out_s), clean(aux_s)]
+
+
+def symbol_atomic_info(op_name):
+    from mxtpu.ops import registry
+    op = registry.get_op(op_name)
+    doc = (op.fn.__doc__ or "").strip()
+    import inspect
+    try:
+        sig = inspect.signature(op.fn)
+        args = [p.name for p in sig.parameters.values()
+                if p.kind is p.POSITIONAL_OR_KEYWORD]
+    except (TypeError, ValueError):
+        args = []
+    return [op_name, doc, args, ["" for _ in args], ["" for _ in args]]
+
+
+# ---------------------------------------------------------- Executor extra
+
+def executor_simple_bind(sym, dev_type, dev_id, grad_req_type,
+                         shape_keys, shapes, dtype_keys, dtypes,
+                         stype_keys, stypes):
+    req_names = {0: "null", 1: "write", 2: "add"}
+    shape_kwargs = {k: tuple(int(x) for x in v)
+                    for k, v in zip(shape_keys, shapes)}
+    type_dict = {k: _DTYPE_CODES[int(d)]
+                 for k, d in zip(dtype_keys, dtypes)}
+    stype_names = {0: "default", 1: "default", 2: "row_sparse", 3: "csr"}
+    stype_dict = {k: stype_names[int(v)]
+                  for k, v in zip(stype_keys, stypes)}
+    exe = sym.simple_bind(_ctx(dev_type, dev_id),
+                          grad_req=req_names[int(grad_req_type)],
+                          type_dict=type_dict or None,
+                          stype_dict=stype_dict or None,
+                          **shape_kwargs)
+    return [exe, exe.arg_arrays, exe.grad_arrays, exe.aux_arrays]
+
+
+def executor_backward_ex(ex, head_grads, is_train):
+    grads = None if not head_grads else list(head_grads)
+    ex.backward(out_grads=grads, is_train=bool(is_train))
+
+
+def executor_print(ex):
+    return ex.debug_str()
+
+
+def executor_set_monitor(ex, trampoline):
+    def cb(name, arr):
+        trampoline(str(name), arr)
+    ex.set_monitor_callback(cb)
+
+
+# ---------------------------------------------------------- CachedOp
+
+class _CCachedOp:
+    """Shape-keyed jit cache over a Symbol — the CachedOp the reference
+    exposes through MXCreateCachedOp (src/imperative/cached_op.cc:179
+    per-shape re-specialization)."""
+
+    def __init__(self, sym):
+        self.sym = sym
+        self._cache = {}
+
+    def __call__(self, *inputs):
+        names = self.sym.list_arguments()
+        key = tuple((tuple(a.shape), str(np.dtype(a.dtype)))
+                    for a in inputs)
+        if key not in self._cache:
+            shapes = {n: tuple(a.shape) for n, a in zip(names, inputs)}
+            self._cache[key] = self.sym.simple_bind(
+                _mx().cpu(), grad_req="null", **shapes)
+        exe = self._cache[key]
+        for n, a in zip(names, inputs):
+            exe.arg_dict[n]._assign_value(a)
+        return exe.forward(is_train=False)
+
+
+def cached_op_create(sym, flag_keys, flag_vals):
+    return _CCachedOp(sym)
+
+
+def cached_op_invoke(op, inputs):
+    res = op(*list(inputs))
+    return list(res) if isinstance(res, (list, tuple)) else [res]
+
+
+# ---------------------------------------------------------- KVStore extra
+
+def kvstore_get_type(kv):
+    return kv.type
+
+
+def kvstore_barrier(kv):
+    kv._barrier()
+
+
+def kvstore_num_dead_node(kv, node_id, timeout):
+    return int(kv.get_num_dead_node(int(node_id), int(timeout)))
+
+
+def kvstore_is_worker():
+    import os
+    return 0 if os.environ.get("DMLC_ROLE") in ("server", "scheduler") \
+        else 1
+
+
+def kvstore_is_server():
+    import os
+    return 1 if os.environ.get("DMLC_ROLE") == "server" else 0
+
+
+def kvstore_is_scheduler():
+    import os
+    return 1 if os.environ.get("DMLC_ROLE") == "scheduler" else 0
+
+
+def kvstore_run_server(kv, trampoline):
+    from mxtpu import kvstore_server
+    kv._controller = trampoline
+    server = kvstore_server.KVStoreServer(kv)
+    server.run()
+
+
+def kvstore_send_command(kv, head, body):
+    kv._send_command_to_servers(int(head), str(body))
+
+
+def kvstore_set_barrier_before_exit(kv, flag):
+    kv._barrier_before_exit = bool(flag)
+
+
+def kvstore_set_gradient_compression(kv, keys, vals):
+    params = dict(zip(keys, vals))
+    if "threshold" in params:
+        params["threshold"] = float(params["threshold"])
+    kv.set_gradient_compression(params)
+
+
+def kvstore_init_str(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push_str(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=int(priority))
+
+
+def kvstore_pull_str(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+
+
+def kvstore_pull_row_sparse(kv, keys, outs, row_ids, priority):
+    kv.row_sparse_pull(list(keys), out=list(outs), priority=int(priority),
+                       row_ids=list(row_ids))
+
+
+def init_ps_env(keys, vals):
+    import os
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+
+
+# ---------------------------------------------------------- Profiler
+
+def profiler_set_config(keys, vals):
+    from mxtpu import profiler
+    params = {}
+    for k, v in zip(keys, vals):
+        low = str(v).strip().lower()
+        params[str(k)] = (low == "true") if low in ("true", "false") else v
+    profiler.set_config(**params)
+
+
+def profiler_set_state(state):
+    from mxtpu import profiler
+    profiler.set_state({0: "stop", 1: "run"}.get(int(state), "stop"))
+
+
+def profiler_dump(finished):
+    from mxtpu import profiler
+    profiler.dump(bool(finished))
+
+
+def profiler_pause(paused):
+    from mxtpu import profiler
+    profiler.pause() if paused else profiler.resume()
+
+
+def profiler_aggregate_print(reset):
+    from mxtpu import profiler
+    return profiler.dumps(bool(reset)) if hasattr(profiler, "dumps") else ""
+
+
+def profile_create_domain(name):
+    from mxtpu import profiler
+    return profiler.Domain(str(name))
+
+
+def profile_create_task(domain, name):
+    from mxtpu import profiler
+    return profiler.Task(str(name), domain)
+
+
+def profile_create_frame(domain, name):
+    from mxtpu import profiler
+    return profiler.Frame(str(name), domain)
+
+
+def profile_create_event(name):
+    from mxtpu import profiler
+    return profiler.Event(str(name))
+
+
+def profile_create_counter(domain, name):
+    from mxtpu import profiler
+    return profiler.Counter(str(name), domain)
+
+
+def profile_duration_start(obj):
+    obj.start()
+
+
+def profile_duration_stop(obj):
+    obj.stop()
+
+
+def profile_set_counter(counter, value):
+    counter.set_value(int(value))
+
+
+def profile_adjust_counter(counter, delta):
+    counter.increment(int(delta))
+
+
+def profile_set_marker(domain, name, scope):
+    from mxtpu import profiler
+    profiler.Marker(str(name), domain).mark(str(scope))
+
+
+# ---------------------------------------------------------- RecordIO
+
+def recordio_writer_create(path):
+    from mxtpu import recordio
+    return recordio.MXRecordIO(str(path), "w")
+
+
+def recordio_reader_create(path):
+    from mxtpu import recordio
+    return recordio.MXRecordIO(str(path), "r")
+
+
+def recordio_close(rec):
+    rec.close()
+
+
+def recordio_write(rec, buf):
+    rec.write(bytes(buf))
+
+
+def recordio_read(rec):
+    item = rec.read()
+    return b"" if item is None else bytes(item)
+
+
+def recordio_tell(rec):
+    return int(rec.tell())
+
+
+def recordio_seek(rec, pos):
+    rec.seek(int(pos))
+
+
+# ---------------------------------------------------------- Custom ops (C)
+
+def register_c_custom_op(op_type, dispatcher, num_inputs, num_outputs):
+    """Register a custom op whose forward/backward run through a C
+    dispatcher installed by MXCustomOpRegister (the capability of the
+    reference's CustomOpPropCreator protocol, include/mxnet/c_api.h,
+    rendered over the embedded interpreter). The dispatcher receives
+    (phase, [arrays]) and writes its results into the trailing output
+    arrays in place via MXNDArraySyncCopyFromCPU."""
+    import mxtpu.operator as op_mod
+
+    n_in, n_out = int(num_inputs), int(num_outputs)
+
+    class _COp(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            dispatcher(0, list(in_data) + list(out_data))
+
+        def backward(self, req, out_grad, in_grad, out_data, in_data, aux):
+            dispatcher(1, list(out_grad) + list(in_data) + list(in_grad))
+
+    class _CProp(op_mod.CustomOpProp):
+        def list_arguments(self):
+            return ["data%d" % i for i in range(n_in)]
+
+        def list_outputs(self):
+            return ["output%d" % i for i in range(n_out)]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]] * n_out, []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _COp()
+
+    op_mod.register(str(op_type))(_CProp)
+
+
+def executor_simple_bind_c(sym, dev_type, dev_id, req_names, req_types,
+                           shape_keys, shapes, dtype_keys, dtypes,
+                           stype_keys, stypes):
+    """MXExecutorSimpleBind marshaling: per-name grad-req strings."""
+    shape_kwargs = {k: tuple(int(x) for x in v)
+                    for k, v in zip(shape_keys, shapes)}
+    type_dict = {k: _DTYPE_CODES[int(d)]
+                 for k, d in zip(dtype_keys, dtypes)}
+    stype_names = {0: "default", 1: "default", 2: "row_sparse", 3: "csr"}
+    stype_dict = {k: stype_names[int(v)]
+                  for k, v in zip(stype_keys, stypes)}
+    if not req_names:
+        grad_req = req_types[0] if req_types else "write"
+    else:
+        grad_req = dict(zip(req_names, req_types))
+    exe = sym.simple_bind(_ctx(dev_type, dev_id), grad_req=grad_req,
+                          type_dict=type_dict or None,
+                          stype_dict=stype_dict or None,
+                          **shape_kwargs)
+    return [exe, exe.arg_arrays, exe.grad_arrays, exe.aux_arrays]
+
+
+def ndarray_sync_copy_to_all(arr):
+    """Whole-array host bytes (MXNDArrayGetData's host-mirror contract)."""
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()

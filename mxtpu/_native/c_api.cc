@@ -1224,3 +1224,1424 @@ int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
 }
 
 }  // extern "C"
+
+// ===================================================== round-3 ABI breadth
+
+namespace {
+
+// simple PyObject-owning handles
+struct CachedOpH { PyObject *obj = nullptr;
+                   std::vector<NDArrayHandle> outs; };
+struct RecordIOH { PyObject *obj = nullptr; std::string buf; };
+struct ProfileH { PyObject *obj = nullptr; };
+
+// C-callback trampolines exposed to Python as callables --------------------
+
+struct MonitorCtx { MXExecMonitorCallback *cb; void *closure; };
+
+PyObject *monitor_trampoline(PyObject *self, PyObject *args) {
+  auto *ctx = static_cast<MonitorCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.monitor"));
+  const char *name = nullptr;
+  PyObject *arr = nullptr;
+  if (!ctx || !PyArg_ParseTuple(args, "sO", &name, &arr)) return nullptr;
+  Py_INCREF(arr);
+  NDArrayHandle h = wrap_nd(arr);
+  ctx->cb(name, h, ctx->closure);
+  free_nd(h);
+  Py_RETURN_NONE;
+}
+
+struct DispatchCtx { MXCustomOpDispatcher *cb; void *state; };
+
+PyObject *dispatch_trampoline(PyObject *self, PyObject *args) {
+  auto *ctx = static_cast<DispatchCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.customop"));
+  int phase = 0;
+  PyObject *lst = nullptr;
+  if (!ctx || !PyArg_ParseTuple(args, "iO", &phase, &lst)) return nullptr;
+  Py_ssize_t n = PyList_Size(lst);
+  std::vector<NDArrayHandle> handles(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(lst, i);
+    Py_INCREF(o);
+    handles[i] = wrap_nd(o);
+  }
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = ctx->cb(phase, static_cast<int>(n), handles.data(), ctx->state);
+  Py_END_ALLOW_THREADS
+  for (auto h : handles) free_nd(h);
+  if (rc != 0) {
+    PyErr_SetString(PyExc_RuntimeError, "C custom-op dispatcher failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+struct ControllerCtx { MXKVServerController *cb; void *closure; };
+
+PyObject *controller_trampoline(PyObject *self, PyObject *args) {
+  auto *ctx = static_cast<ControllerCtx *>(
+      PyCapsule_GetPointer(self, "mxtpu.controller"));
+  int head = 0;
+  const char *body = nullptr;
+  if (!ctx || !PyArg_ParseTuple(args, "is", &head, &body)) return nullptr;
+  Py_BEGIN_ALLOW_THREADS
+  ctx->cb(head, body, ctx->closure);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+PyMethodDef monitor_def = {"monitor_trampoline", monitor_trampoline,
+                           METH_VARARGS, nullptr};
+PyMethodDef dispatch_def = {"dispatch_trampoline", dispatch_trampoline,
+                            METH_VARARGS, nullptr};
+PyMethodDef controller_def = {"controller_trampoline",
+                              controller_trampoline, METH_VARARGS, nullptr};
+
+PyObject *make_trampoline(PyMethodDef *def, const char *capname, void *ctx) {
+  PyObject *cap = PyCapsule_New(ctx, capname, nullptr);
+  if (!cap) return nullptr;
+  PyObject *fn = PyCFunction_New(def, cap);
+  Py_DECREF(cap);  // fn holds its own reference
+  return fn;
+}
+
+int simple_call(const char *fn, const char *fmt, ...) {
+  ensure_python();
+  GIL gil;
+  PyObject *mod = impl_module();
+  if (!mod) { set_error_from_python(); return -1; }
+  PyObject *callable = PyObject_GetAttrString(mod, fn);
+  if (!callable) { set_error_from_python(); return -1; }
+  va_list va;
+  va_start(va, fmt);
+  PyObject *args = fmt ? Py_VaBuildValue(fmt, va) : PyTuple_New(0);
+  va_end(va);
+  if (args && !PyTuple_Check(args)) {
+    PyObject *t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+  }
+  PyObject *res = args ? PyObject_CallObject(callable, args) : nullptr;
+  Py_DECREF(callable);
+  Py_XDECREF(args);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+// return int result helper
+int int_call(const char *fn, int *out, const char *fmt, ...) {
+  ensure_python();
+  GIL gil;
+  PyObject *mod = impl_module();
+  if (!mod) { set_error_from_python(); return -1; }
+  PyObject *callable = PyObject_GetAttrString(mod, fn);
+  if (!callable) { set_error_from_python(); return -1; }
+  va_list va;
+  va_start(va, fmt);
+  PyObject *args = fmt ? Py_VaBuildValue(fmt, va) : PyTuple_New(0);
+  va_end(va);
+  if (args && !PyTuple_Check(args)) {
+    PyObject *t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+  }
+  PyObject *res = args ? PyObject_CallObject(callable, args) : nullptr;
+  Py_DECREF(callable);
+  Py_XDECREF(args);
+  if (!res) { set_error_from_python(); return -1; }
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXEngineSetBulkSize(int size, int *prev) {
+  return int_call("engine_set_bulk_size", prev, "(i)", size);
+}
+
+int MXSetNumOMPThreads(int num_threads) {
+  return simple_call("set_num_omp_threads", "(i)", num_threads);
+}
+
+// ----------------------------------------------------------------- autograd
+
+int MXAutogradIsRecording(bool *out) {
+  int v = 0;
+  if (int_call("autograd_is_recording", &v, nullptr) != 0) return -1;
+  *out = v != 0;
+  return 0;
+}
+
+int MXAutogradIsTraining(bool *out) {
+  int v = 0;
+  if (int_call("autograd_is_training", &v, nullptr) != 0) return -1;
+  *out = v != 0;
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *outputs,
+                         NDArrayHandle *ograds, mx_uint num_variables,
+                         NDArrayHandle *variables, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes) {
+  GIL gil;
+  PyObject *outs = nd_list(num_output, outputs);
+  PyObject *ogs = ograds ? nd_list(num_output, ograds) : PyList_New(0);
+  PyObject *vars = num_variables ? nd_list(num_variables, variables)
+                                 : PyList_New(0);
+  PyObject *res = icall("autograd_backward_ex", "(OOOiii)", outs, ogs, vars,
+                        retain_graph, create_graph, is_train);
+  Py_DECREF(outs);
+  Py_DECREF(ogs);
+  Py_DECREF(vars);
+  if (!res) return -1;
+  static thread_local std::vector<NDArrayHandle> tl_grads;
+  static thread_local std::vector<int> tl_stypes;
+  for (auto h : tl_grads) free_nd(h);
+  tl_grads.clear();
+  tl_stypes.clear();
+  if (PyList_Check(res) && PyList_Size(res) == 2) {
+    PyObject *gl = PyList_GetItem(res, 0);
+    PyObject *sl = PyList_GetItem(res, 1);
+    Py_ssize_t n = PyList_Size(gl);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *g = PyList_GetItem(gl, i);
+      if (g == Py_None) {
+        // unattached grad: a null handle, not a wrapped None
+        tl_grads.push_back(nullptr);
+      } else {
+        Py_INCREF(g);
+        tl_grads.push_back(wrap_nd(g));
+      }
+      tl_stypes.push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GetItem(sl, i))));
+    }
+  }
+  Py_DECREF(res);
+  if (grad_handles) *grad_handles = tl_grads.data();
+  if (grad_stypes) *grad_stypes = tl_stypes.data();
+  return 0;
+}
+
+int MXAutogradComputeGradient(mx_uint num_output, NDArrayHandle *outputs) {
+  return MXAutogradBackward(num_output, outputs, nullptr, 0);
+}
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("autograd_get_symbol", "(O)", h->obj);
+  if (!res) return -1;
+  auto *sh = new SymbolH();
+  sh->obj = res;
+  *out = sh;
+  return 0;
+}
+
+// ------------------------------------------------------------ NDArray extra
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_storage_type", "(O)", h->obj);
+  if (!res) return -1;
+  *out_storage_type = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_detach", "(O)", h->obj);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_wait_to_write", "(O)", h->obj);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 NDArrayHandle handle_src, int i) {
+  GIL gil;
+  auto *hd = static_cast<NDArrayH *>(handle_dst);
+  auto *hs = static_cast<NDArrayH *>(handle_src);
+  PyObject *res = icall("ndarray_sync_copy_from_ndarray", "(OOi)", hd->obj,
+                        hs->obj, i);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, bool full_check) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_check_format", "(Oi)", h->obj,
+                        full_check ? 1 : 0);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_save_raw_bytes", "(O)", h->obj);
+  if (!res) return -1;
+  static thread_local std::string tl_raw;
+  char *buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &n) != 0) {
+    set_error_from_python();
+    Py_DECREF(res);
+    return -1;
+  }
+  tl_raw.assign(buf, n);
+  Py_DECREF(res);
+  *out_size = tl_raw.size();
+  *out_buf = tl_raw.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *mem = PyBytes_FromStringAndSize(
+      static_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  PyObject *res = icall("ndarray_load_raw_bytes", "(O)", mem);
+  Py_DECREF(mem);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArrayLoadFromBuffer(const void *buf, size_t size,
+                            mx_uint *out_size, NDArrayHandle **out_arr,
+                            mx_uint *out_name_size,
+                            const char ***out_names) {
+  ensure_python();
+  GIL gil;
+  PyObject *mem = PyBytes_FromStringAndSize(
+      static_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  PyObject *res = icall("ndarray_load_from_buffer", "(O)", mem);
+  Py_DECREF(mem);
+  if (!res) return -1;
+  PyObject *arrs = PyList_GetItem(res, 0);
+  PyObject *names = PyList_GetItem(res, 1);
+  for (auto h : tl_load_arrs) free_nd(h);
+  tl_load_arrs.clear();
+  Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *a = PyList_GetItem(arrs, i);
+    Py_INCREF(a);
+    tl_load_arrs.push_back(wrap_nd(a));
+  }
+  cache_str_list(names, &tl_load_names_store, &tl_load_names);
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(tl_load_arrs.size());
+  *out_arr = tl_load_arrs.data();
+  *out_name_size = static_cast<mx_uint>(tl_load_names.size());
+  *out_names = tl_load_names.data();
+  return 0;
+}
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int *aux_type, mx_uint *aux_ndims,
+                            const mx_uint *aux_shape, NDArrayHandle *out) {
+  (void)delay_alloc; (void)aux_type; (void)aux_ndims; (void)aux_shape;
+  ensure_python();
+  GIL gil;
+  PyObject *shp = uint_list(ndim, shape);
+  PyObject *res = icall("ndarray_create_sparse", "(iOiiiO)", storage_type,
+                        shp, dev_type, dev_id, dtype, Py_None);
+  Py_DECREF(shp);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  (void)num_aux;
+  return 0;
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_aux_ndarray", "(OI)", h->obj, i);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_aux_type", "(OI)", h->obj, i);
+  if (!res) return -1;
+  *out_type = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_data_ndarray", "(O)", h->obj);
+  if (!res) return -1;
+  *out = wrap_nd(res);
+  return 0;
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_set_grad_state", "(Oi)", h->obj, state);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_get_grad_state", "(O)", h->obj);
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+// ------------------------------------------------------------- Symbol extra
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_get_name", "(O)", h->obj);
+  if (!res) return -1;
+  h->json = PyUnicode_AsUTF8(PyList_GetItem(res, 0));
+  *success = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, 1)));
+  *out = h->json.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_get_attr", "(Os)", h->obj, key);
+  if (!res) return -1;
+  h->json = PyUnicode_AsUTF8(PyList_GetItem(res, 0));
+  *success = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, 1)));
+  *out = h->json.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                    const char *value) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_set_attr", "(Oss)", h->obj, key, value);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int symbol_attr_list(SymbolHandle symbol, int shallow, mx_uint *out_size,
+                     const char ***out) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_list_attr", "(Oi)", h->obj, shallow);
+  if (!res) return -1;
+  int rc = cache_str_list(res, &h->str_store, &h->str_ptrs);
+  Py_DECREF(res);
+  if (rc != 0) return -1;
+  *out_size = static_cast<mx_uint>(h->str_ptrs.size() / 2);
+  *out = h->str_ptrs.data();
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out) {
+  return symbol_attr_list(symbol, 0, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out) {
+  return symbol_attr_list(symbol, 1, out_size, out);
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint *output_count) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_num_outputs", "(O)", h->obj);
+  if (!res) return -1;
+  *output_count = static_cast<mx_uint>(PyLong_AsUnsignedLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_get_children", "(O)", h->obj);
+  if (!res) return -1;
+  auto *sh = new SymbolH();
+  sh->obj = res;
+  *out = sh;
+  return 0;
+}
+
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(symbol);
+  PyObject *res = icall("symbol_print", "(O)", h->obj);
+  if (!res) return -1;
+  h->json = PyUnicode_AsUTF8(res);
+  *out_str = h->json.c_str();
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(sym);
+  PyObject *ks = str_list(num_args, keys);
+  PyObject *ts = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SetItem(ts, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject *res = icall("symbol_infer_type", "(OOO)", h->obj, ks, ts);
+  Py_DECREF(ks);
+  Py_DECREF(ts);
+  if (!res) return -1;
+  static thread_local std::vector<int> tl_in, tl_out, tl_aux;
+  auto fill = [&](int idx, std::vector<int> *dst) {
+    dst->clear();
+    PyObject *lst = PyList_GetItem(res, idx);
+    Py_ssize_t n = PyList_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      dst->push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GetItem(lst, i))));
+  };
+  fill(0, &tl_in);
+  fill(1, &tl_out);
+  fill(2, &tl_aux);
+  Py_DECREF(res);
+  *in_type_size = static_cast<mx_uint>(tl_in.size());
+  *in_type_data = tl_in.data();
+  *out_type_size = static_cast<mx_uint>(tl_out.size());
+  *out_type_data = tl_out.data();
+  *aux_type_size = static_cast<mx_uint>(tl_aux.size());
+  *aux_type_data = tl_aux.data();
+  bool done = true;
+  for (int t : tl_in) done = done && t != -1;
+  *complete = done ? 1 : 0;
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(OpHandle creator, const char **name,
+                                const char **description, mx_uint *num_args,
+                                const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args) {
+  GIL gil;
+  const auto *nm = static_cast<const std::string *>(creator);
+  PyObject *res = icall("symbol_atomic_info", "(s)", nm->c_str());
+  if (!res) return -1;
+  static thread_local std::string tl_name, tl_desc, tl_kv;
+  static thread_local std::vector<std::string> tl_an_s, tl_at_s, tl_ad_s;
+  static thread_local std::vector<const char *> tl_an, tl_at, tl_ad;
+  tl_name = PyUnicode_AsUTF8(PyList_GetItem(res, 0));
+  tl_desc = PyUnicode_AsUTF8(PyList_GetItem(res, 1));
+  cache_str_list(PyList_GetItem(res, 2), &tl_an_s, &tl_an);
+  cache_str_list(PyList_GetItem(res, 3), &tl_at_s, &tl_at);
+  cache_str_list(PyList_GetItem(res, 4), &tl_ad_s, &tl_ad);
+  Py_DECREF(res);
+  tl_kv = "";
+  *name = tl_name.c_str();
+  *description = tl_desc.c_str();
+  *num_args = static_cast<mx_uint>(tl_an.size());
+  *arg_names = tl_an.data();
+  *arg_type_infos = tl_at.data();
+  *arg_descriptions = tl_ad.data();
+  if (key_var_num_args) *key_var_num_args = tl_kv.c_str();
+  return 0;
+}
+
+// InferShapePartial shares the marshaling of MXSymbolInferShape but
+// tolerates unknowns; the header's triple-pointer layout matches the
+// reference, flattened through the same thread-local staging.
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  GIL gil;
+  auto *h = static_cast<SymbolH *>(sym);
+  PyObject *ks = str_list(num_args, keys);
+  PyObject *shp = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *one = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(one, j - lo, PyLong_FromUnsignedLong(
+          arg_shape_data[j]));
+    PyList_SetItem(shp, i, one);
+  }
+  PyObject *res = icall("symbol_infer_shape_partial", "(OOO)", h->obj, ks,
+                        shp);
+  Py_DECREF(ks);
+  Py_DECREF(shp);
+  if (!res) return -1;
+  static thread_local std::vector<std::vector<mx_uint>> st_rows[3];
+  static thread_local std::vector<mx_uint> st_ndim[3];
+  static thread_local std::vector<const mx_uint *> st_ptr[3];
+  bool done = true;
+  for (int g = 0; g < 3; ++g) {
+    PyObject *lst = PyList_GetItem(res, g);
+    Py_ssize_t n = PyList_Size(lst);
+    st_rows[g].assign(n, {});
+    st_ndim[g].clear();
+    st_ptr[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *one = PyList_GetItem(lst, i);
+      Py_ssize_t m = PyList_Size(one);
+      if (m == 0) done = false;
+      for (Py_ssize_t j = 0; j < m; ++j)
+        st_rows[g][i].push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyList_GetItem(one, j))));
+      st_ndim[g].push_back(static_cast<mx_uint>(m));
+    }
+    for (auto &row : st_rows[g]) st_ptr[g].push_back(row.data());
+  }
+  Py_DECREF(res);
+  *in_shape_size = static_cast<mx_uint>(st_ptr[0].size());
+  *in_shape_ndim = st_ndim[0].data();
+  *in_shape_data = st_ptr[0].data();
+  *out_shape_size = static_cast<mx_uint>(st_ptr[1].size());
+  *out_shape_ndim = st_ndim[1].data();
+  *out_shape_data = st_ptr[1].data();
+  *aux_shape_size = static_cast<mx_uint>(st_ptr[2].size());
+  *aux_shape_ndim = st_ndim[2].data();
+  *aux_shape_data = st_ptr[2].data();
+  *complete = done ? 1 : 0;
+  return 0;
+}
+
+// ----------------------------------------------------------- Executor extra
+
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    mx_uint num_g2c_keys, const char **g2c_keys, const int *g2c_dev_types,
+    const int *g2c_dev_ids, mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    mx_uint num_provided_arg_shapes, const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx, mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    mx_uint num_provided_arg_stypes, const char **provided_arg_stype_names,
+    const int *provided_arg_stypes, mx_uint num_shared_arg_names,
+    const char **shared_arg_name_list, int *shared_buffer_len,
+    const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list,
+    mx_uint *num_in_args, NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out) {
+  // group2ctx / shared-exec memory sharing have no meaning under XLA's
+  // whole-graph compilation (device placement = sharding annotations;
+  // buffer reuse = XLA's allocator), so those inputs are accepted and
+  // ignored; shared buffers pass through unchanged.
+  (void)num_g2c_keys; (void)g2c_keys; (void)g2c_dev_types; (void)g2c_dev_ids;
+  (void)num_shared_arg_names; (void)shared_arg_name_list;
+  (void)shared_exec_handle;
+  GIL gil;
+  auto *sh = static_cast<SymbolH *>(symbol_handle);
+  PyObject *req_names = str_list(provided_grad_req_list_len,
+                                 provided_grad_req_names);
+  PyObject *req_types = str_list(provided_grad_req_list_len,
+                                 provided_grad_req_types);
+  PyObject *shape_keys = str_list(num_provided_arg_shapes,
+                                  provided_arg_shape_names);
+  PyObject *shapes = PyList_New(num_provided_arg_shapes);
+  for (mx_uint i = 0; i < num_provided_arg_shapes; ++i) {
+    mx_uint lo = provided_arg_shape_idx[i];
+    mx_uint hi = provided_arg_shape_idx[i + 1];
+    PyObject *one = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(one, j - lo,
+                     PyLong_FromUnsignedLong(provided_arg_shape_data[j]));
+    PyList_SetItem(shapes, i, one);
+  }
+  PyObject *dtype_keys = str_list(num_provided_arg_dtypes,
+                                  provided_arg_dtype_names);
+  PyObject *dtypes = PyList_New(num_provided_arg_dtypes);
+  for (mx_uint i = 0; i < num_provided_arg_dtypes; ++i)
+    PyList_SetItem(dtypes, i, PyLong_FromLong(provided_arg_dtypes[i]));
+  PyObject *stype_keys = str_list(num_provided_arg_stypes,
+                                  provided_arg_stype_names);
+  PyObject *stypes = PyList_New(num_provided_arg_stypes);
+  for (mx_uint i = 0; i < num_provided_arg_stypes; ++i)
+    PyList_SetItem(stypes, i, PyLong_FromLong(provided_arg_stypes[i]));
+  PyObject *res = icall("executor_simple_bind_c", "(OiiOOOOOOOO)", sh->obj,
+                        dev_type, dev_id, req_names, req_types, shape_keys,
+                        shapes, dtype_keys, dtypes, stype_keys, stypes);
+  Py_DECREF(req_names); Py_DECREF(req_types);
+  Py_DECREF(shape_keys); Py_DECREF(shapes);
+  Py_DECREF(dtype_keys); Py_DECREF(dtypes);
+  Py_DECREF(stype_keys); Py_DECREF(stypes);
+  if (!res) return -1;
+  auto *eh = new ExecutorH();
+  eh->obj = PyList_GetItem(res, 0);
+  Py_INCREF(eh->obj);
+  static thread_local std::vector<NDArrayHandle> tl_args, tl_grads, tl_aux;
+  auto fill = [&](int idx, std::vector<NDArrayHandle> *dst) {
+    for (auto h : *dst) free_nd(h);
+    dst->clear();
+    PyObject *lst = PyList_GetItem(res, idx);
+    Py_ssize_t n = PyList_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *a = PyList_GetItem(lst, i);
+      if (a == Py_None) {
+        dst->push_back(nullptr);
+        continue;
+      }
+      Py_INCREF(a);
+      dst->push_back(wrap_nd(a));
+    }
+  };
+  fill(1, &tl_args);
+  fill(2, &tl_grads);
+  fill(3, &tl_aux);
+  Py_DECREF(res);
+  *num_in_args = static_cast<mx_uint>(tl_args.size());
+  *in_args = tl_args.data();
+  *arg_grads = tl_grads.data();
+  *num_aux_states = static_cast<mx_uint>(tl_aux.size());
+  *aux_states = tl_aux.data();
+  if (shared_buffer_len && *shared_buffer_len >= 0) {
+    if (updated_shared_buffer_name_list)
+      *updated_shared_buffer_name_list = shared_buffer_name_list;
+    if (updated_shared_buffer_handle_list)
+      *updated_shared_buffer_handle_list = shared_buffer_handle_list;
+  }
+  *out = eh;
+  return 0;
+}
+
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train) {
+  GIL gil;
+  auto *h = static_cast<ExecutorH *>(handle);
+  PyObject *grads = len ? nd_list(len, head_grads) : PyList_New(0);
+  PyObject *res = icall("executor_backward_ex", "(OOi)", h->obj, grads,
+                        is_train);
+  Py_DECREF(grads);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  GIL gil;
+  auto *h = static_cast<ExecutorH *>(handle);
+  PyObject *res = icall("executor_print", "(O)", h->obj);
+  if (!res) return -1;
+  static thread_local std::string tl_dbg;
+  tl_dbg = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_str = tl_dbg.c_str();
+  return 0;
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 MXExecMonitorCallback callback,
+                                 void *callback_handle) {
+  GIL gil;
+  auto *h = static_cast<ExecutorH *>(handle);
+  auto *ctx = new MonitorCtx{callback, callback_handle};  // leaks w/ exec; fine
+  PyObject *fn = make_trampoline(&monitor_def, "mxtpu.monitor", ctx);
+  if (!fn) { set_error_from_python(); return -1; }
+  PyObject *res = icall("executor_set_monitor", "(OO)", h->obj, fn);
+  Py_DECREF(fn);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+// ----------------------------------------------------------------- CachedOp
+
+int MXCreateCachedOpEx(SymbolHandle handle, int num_flags, const char **keys,
+                       const char **vals, CachedOpHandle *out) {
+  GIL gil;
+  auto *sh = static_cast<SymbolH *>(handle);
+  PyObject *ks = str_list(num_flags, keys);
+  PyObject *vs = str_list(num_flags, vals);
+  PyObject *res = icall("cached_op_create", "(OOO)", sh->obj, ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (!res) return -1;
+  auto *h = new CachedOpH();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out) {
+  return MXCreateCachedOpEx(handle, 0, nullptr, nullptr, out);
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs) {
+  GIL gil;
+  auto *h = static_cast<CachedOpH *>(handle);
+  PyObject *ins = nd_list(num_inputs, inputs);
+  PyObject *res = icall("cached_op_invoke", "(OO)", h->obj, ins);
+  Py_DECREF(ins);
+  if (!res) return -1;
+  for (auto o : h->outs) free_nd(o);
+  h->outs.clear();
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(res, i);
+    Py_INCREF(o);
+    h->outs.push_back(wrap_nd(o));
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(h->outs.size());
+  *outputs = h->outs.data();
+  return 0;
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes) {
+  int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                            outputs);
+  if (rc != 0) return rc;
+  static thread_local std::vector<int> tl_stypes;
+  tl_stypes.assign(*num_outputs, 1);
+  *out_stypes = tl_stypes.data();
+  return 0;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  GIL gil;
+  auto *h = static_cast<CachedOpH *>(handle);
+  if (h) {
+    for (auto o : h->outs) free_nd(o);
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+// ------------------------------------------------------------ KVStore extra
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *res = icall("kvstore_get_type", "(O)", h->obj);
+  if (!res) return -1;
+  static thread_local std::string tl_type;
+  tl_type = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *type = tl_type.c_str();
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *res = icall("kvstore_barrier", "(O)", h->obj);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int *number, const int timeout_sec) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *res = icall("kvstore_num_dead_node", "(Oii)", h->obj, node_id,
+                        timeout_sec);
+  if (!res) return -1;
+  *number = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) {
+  return int_call("kvstore_is_worker", ret, nullptr);
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  return int_call("kvstore_is_server", ret, nullptr);
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  return int_call("kvstore_is_scheduler", ret, nullptr);
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVServerController controller,
+                       void *controller_handle) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  auto *ctx = new ControllerCtx{controller, controller_handle};
+  PyObject *fn = make_trampoline(&controller_def, "mxtpu.controller", ctx);
+  if (!fn) { set_error_from_python(); return -1; }
+  PyObject *res = icall("kvstore_run_server", "(OO)", h->obj, fn);
+  Py_DECREF(fn);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *res = icall("kvstore_send_command", "(Ois)", h->obj, cmd_id,
+                        cmd_body);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *res = icall("kvstore_set_barrier_before_exit", "(Oi)", h->obj,
+                        barrier_before_exit);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, mx_uint num,
+                                    const char **keys, const char **vals) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *ks = str_list(num, keys);
+  PyObject *vs = str_list(num, vals);
+  PyObject *res = icall("kvstore_set_gradient_compression", "(OOO)", h->obj,
+                        ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int kv_str_call(KVStoreHandle handle, const char *fn, mx_uint num,
+                const char **keys, NDArrayHandle *vals, int priority,
+                int with_priority) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *ks = str_list(num, keys);
+  PyObject *vs = nd_list(num, vals);
+  PyObject *res = with_priority
+      ? icall(fn, "(OOOi)", h->obj, ks, vs, priority)
+      : icall(fn, "(OOO)", h->obj, ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals) {
+  return kv_str_call(handle, "kvstore_init_str", num, keys, vals, 0, 0);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  return kv_str_call(handle, "kvstore_push_str", num, keys, vals, priority,
+                     1);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  return kv_str_call(handle, "kvstore_pull_str", num, keys, vals, priority,
+                     1);
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVUpdater updater,
+                          void *updater_handle) {
+  return MXKVStoreSetUpdater(handle, updater, updater_handle);
+}
+
+int kv_row_sparse_pull(KVStoreHandle handle, const char *fn, mx_uint num,
+                       PyObject *keys, NDArrayHandle *vals,
+                       const NDArrayHandle *row_ids, int priority) {
+  GIL gil;
+  auto *h = static_cast<KVStoreH *>(handle);
+  PyObject *vs = nd_list(num, vals);
+  PyObject *rs = nd_list(num, const_cast<NDArrayHandle *>(row_ids));
+  PyObject *res = icall(fn, "(OOOOi)", h->obj, keys, vs, rs, priority);
+  Py_DECREF(vs);
+  Py_DECREF(rs);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority) {
+  GIL gil;
+  PyObject *ks = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SetItem(ks, i, PyLong_FromLong(keys[i]));
+  int rc = kv_row_sparse_pull(handle, "kvstore_pull_row_sparse", num, ks,
+                              vals, row_ids, priority);
+  Py_DECREF(ks);
+  return rc;
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority) {
+  GIL gil;
+  PyObject *ks = str_list(num, keys);
+  int rc = kv_row_sparse_pull(handle, "kvstore_pull_row_sparse", num, ks,
+                              vals, row_ids, priority);
+  Py_DECREF(ks);
+  return rc;
+}
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  ensure_python();
+  GIL gil;
+  PyObject *ks = str_list(num_vars, keys);
+  PyObject *vs = str_list(num_vars, vals);
+  PyObject *res = icall("init_ps_env", "(OO)", ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+// ------------------------------------------------------------------ Profiler
+
+int MXSetProfilerConfig(int num_params, const char *const *keys,
+                        const char *const *vals) {
+  ensure_python();
+  GIL gil;
+  PyObject *ks = str_list(num_params, const_cast<const char **>(keys));
+  PyObject *vs = str_list(num_params, const_cast<const char **>(vals));
+  PyObject *res = icall("profiler_set_config", "(OO)", ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  return simple_call("profiler_set_state", "(i)", state);
+}
+
+int MXDumpProfile(int finished) {
+  return simple_call("profiler_dump", "(i)", finished);
+}
+
+int MXProfilePause(int paused) {
+  return simple_call("profiler_pause", "(i)", paused);
+}
+
+int MXAggregateProfileStatsPrint(const char **out_str, int reset) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall("profiler_aggregate_print", "(i)", reset);
+  if (!res) return -1;
+  static thread_local std::string tl_stats;
+  tl_stats = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_str = tl_stats.c_str();
+  return 0;
+}
+
+int profile_create(const char *fn, PyObject *arg1, const char *name,
+                   ProfileHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = arg1 ? icall(fn, "(Os)", arg1, name)
+                       : icall(fn, "(s)", name);
+  if (!res) return -1;
+  auto *h = new ProfileH();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int MXProfileCreateDomain(const char *domain, ProfileHandle *out) {
+  return profile_create("profile_create_domain", nullptr, domain, out);
+}
+
+int MXProfileCreateTask(ProfileHandle domain, const char *task_name,
+                        ProfileHandle *out) {
+  return profile_create("profile_create_task",
+                        static_cast<ProfileH *>(domain)->obj, task_name,
+                        out);
+}
+
+int MXProfileCreateFrame(ProfileHandle domain, const char *frame_name,
+                         ProfileHandle *out) {
+  return profile_create("profile_create_frame",
+                        static_cast<ProfileH *>(domain)->obj, frame_name,
+                        out);
+}
+
+int MXProfileCreateEvent(const char *event_name, ProfileHandle *out) {
+  return profile_create("profile_create_event", nullptr, event_name, out);
+}
+
+int MXProfileCreateCounter(ProfileHandle domain, const char *counter_name,
+                           ProfileHandle *out) {
+  return profile_create("profile_create_counter",
+                        static_cast<ProfileH *>(domain)->obj, counter_name,
+                        out);
+}
+
+int MXProfileDestroyHandle(ProfileHandle handle) {
+  GIL gil;
+  auto *h = static_cast<ProfileH *>(handle);
+  if (h) {
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+int MXProfileDurationStart(ProfileHandle duration_handle) {
+  GIL gil;
+  auto *h = static_cast<ProfileH *>(duration_handle);
+  PyObject *res = icall("profile_duration_start", "(O)", h->obj);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXProfileDurationStop(ProfileHandle duration_handle) {
+  GIL gil;
+  auto *h = static_cast<ProfileH *>(duration_handle);
+  PyObject *res = icall("profile_duration_stop", "(O)", h->obj);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXProfileSetCounter(ProfileHandle counter_handle, uint64_t value) {
+  GIL gil;
+  auto *h = static_cast<ProfileH *>(counter_handle);
+  PyObject *res = icall("profile_set_counter", "(OK)", h->obj,
+                        static_cast<unsigned long long>(value));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXProfileAdjustCounter(ProfileHandle counter_handle, int64_t delta) {
+  GIL gil;
+  auto *h = static_cast<ProfileH *>(counter_handle);
+  PyObject *res = icall("profile_adjust_counter", "(OL)", h->obj,
+                        static_cast<long long>(delta));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXProfileSetMarker(ProfileHandle domain, const char *marker_name,
+                       const char *scope) {
+  GIL gil;
+  auto *h = static_cast<ProfileH *>(domain);
+  PyObject *res = icall("profile_set_marker", "(Oss)", h->obj, marker_name,
+                        scope);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+// ------------------------------------------------------------------ RecordIO
+
+int recordio_create(const char *fn, const char *uri, RecordIOHandle *out) {
+  ensure_python();
+  GIL gil;
+  PyObject *res = icall(fn, "(s)", uri);
+  if (!res) return -1;
+  auto *h = new RecordIOH();
+  h->obj = res;
+  *out = h;
+  return 0;
+}
+
+int recordio_free(RecordIOHandle handle) {
+  GIL gil;
+  auto *h = static_cast<RecordIOH *>(handle);
+  if (h) {
+    PyObject *res = icall("recordio_close", "(O)", h->obj);
+    Py_XDECREF(res);
+    Py_XDECREF(h->obj);
+    delete h;
+  }
+  return 0;
+}
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  return recordio_create("recordio_writer_create", uri, out);
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  GIL gil;
+  auto *h = static_cast<RecordIOH *>(handle);
+  PyObject *mem = PyBytes_FromStringAndSize(buf,
+                                            static_cast<Py_ssize_t>(size));
+  PyObject *res = icall("recordio_write", "(OO)", h->obj, mem);
+  Py_DECREF(mem);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  GIL gil;
+  auto *h = static_cast<RecordIOH *>(handle);
+  PyObject *res = icall("recordio_tell", "(O)", h->obj);
+  if (!res) return -1;
+  *pos = static_cast<size_t>(PyLong_AsSize_t(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  return recordio_create("recordio_reader_create", uri, out);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
+                               size_t *size) {
+  GIL gil;
+  auto *h = static_cast<RecordIOH *>(handle);
+  PyObject *res = icall("recordio_read", "(O)", h->obj);
+  if (!res) return -1;
+  char *data = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &data, &n) != 0) {
+    set_error_from_python();
+    Py_DECREF(res);
+    return -1;
+  }
+  h->buf.assign(data, n);
+  Py_DECREF(res);
+  *buf = h->buf.data();
+  *size = h->buf.size();
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  GIL gil;
+  auto *h = static_cast<RecordIOH *>(handle);
+  PyObject *res = icall("recordio_seek", "(On)", h->obj,
+                        static_cast<Py_ssize_t>(pos));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t *pos) {
+  return MXRecordIOWriterTell(handle, pos);
+}
+
+// ------------------------------------------------------------- custom ops
+
+int MXCustomOpRegister(const char *op_type, int num_inputs, int num_outputs,
+                       MXCustomOpDispatcher dispatcher, void *state) {
+  ensure_python();
+  GIL gil;
+  auto *ctx = new DispatchCtx{dispatcher, state};  // lives forever (registry)
+  PyObject *fn = make_trampoline(&dispatch_def, "mxtpu.customop", ctx);
+  if (!fn) { set_error_from_python(); return -1; }
+  PyObject *res = icall("register_c_custom_op", "(sOii)", op_type, fn,
+                        num_inputs, num_outputs);
+  Py_DECREF(fn);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+// --------------------------------------------------------------- data iter
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  // sample indices are an iterator-internal detail here (the reference
+  // exposes RecordIO positions); an empty index is the documented "no
+  // index available" signal in the reference too
+  (void)handle;
+  static thread_local std::vector<uint64_t> tl_idx;
+  tl_idx.clear();
+  *out_index = tl_idx.data();
+  *out_size = 0;
+  return 0;
+}
+
+}  // extern "C"
+
+// --------------------------------------------- Ex aliases + legacy surface
+
+extern "C" {
+
+int MXImperativeInvokeEx(OpHandle op, int num_inputs, NDArrayHandle *inputs,
+                         int *num_outputs, NDArrayHandle **outputs,
+                         int num_params, const char **param_keys,
+                         const char **param_vals, const int **out_stypes) {
+  int rc = MXImperativeInvoke(op, num_inputs, inputs, num_outputs, outputs,
+                              num_params, param_keys, param_vals);
+  if (rc != 0) return rc;
+  static thread_local std::vector<int> tl_inv_stypes;
+  tl_inv_stypes.clear();
+  for (int i = 0; i < *num_outputs; ++i) {
+    int st = 1;
+    MXNDArrayGetStorageType((*outputs)[i], &st);
+    tl_inv_stypes.push_back(st);
+  }
+  *out_stypes = tl_inv_stypes.data();
+  return 0;
+}
+
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  (void)num_map_keys; (void)map_keys; (void)map_dev_types;
+  (void)map_dev_ids;  // group2ctx -> sharding annotations under XLA
+  return MXExecutorBind(symbol_handle, dev_type, dev_id, len, in_args,
+                        arg_grad_store, grad_req_type, aux_states_len,
+                        aux_states, out);
+}
+
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  (void)shared_exec;  // buffer sharing is XLA's allocator's job
+  return MXExecutorBindX(symbol_handle, dev_type, dev_id, num_map_keys,
+                         map_keys, map_dev_types, map_dev_ids, len, in_args,
+                         arg_grad_store, grad_req_type, aux_states_len,
+                         aux_states, out);
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  GIL gil;
+  auto *h = static_cast<NDArrayH *>(handle);
+  PyObject *res = icall("ndarray_sync_copy_to_all", "(O)", h->obj);
+  if (!res) return -1;
+  static thread_local std::string tl_host;
+  char *buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &n) != 0) {
+    set_error_from_python();
+    Py_DECREF(res);
+    return -1;
+  }
+  tl_host.assign(buf, n);
+  Py_DECREF(res);
+  *out_pdata = const_cast<char *>(tl_host.data());
+  return 0;
+}
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  // the v0.x function registry is empty by design: everything is an op
+  static FunctionHandle *empty = nullptr;
+  *out_size = 0;
+  *out_array = empty;
+  return 0;
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  (void)out;
+  g_last_error = std::string("no legacy function '") + name +
+                 "'; the v0.x function registry is superseded by the op "
+                 "registry (MXListAllOpNames/MXImperativeInvoke)";
+  return -1;
+}
+
+int legacy_func_error() {
+  g_last_error = "invalid FunctionHandle: the legacy function registry is "
+                 "empty (use the op registry)";
+  return -1;
+}
+
+int MXFuncGetInfo(FunctionHandle, const char **, const char **, mx_uint *,
+                  const char ***, const char ***, const char ***) {
+  return legacy_func_error();
+}
+
+int MXFuncDescribe(FunctionHandle, mx_uint *, mx_uint *, mx_uint *, int *) {
+  return legacy_func_error();
+}
+
+int MXFuncInvoke(FunctionHandle, NDArrayHandle *, mx_float *,
+                 NDArrayHandle *) {
+  return legacy_func_error();
+}
+
+int MXFuncInvokeEx(FunctionHandle, NDArrayHandle *, mx_float *,
+                   NDArrayHandle *, int, char **, char **) {
+  return legacy_func_error();
+}
+
+int MXSymbolGrad(SymbolHandle, mx_uint, const char **, SymbolHandle *) {
+  g_last_error = "MXSymbolGrad is deprecated (so in the reference too): "
+                 "gradients come from binding — use MXExecutorSimpleBind "
+                 "with grad_req or MXAutogradBackwardEx";
+  return -1;
+}
+
+}  // extern "C"

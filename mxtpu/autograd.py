@@ -212,6 +212,12 @@ def _write_grad(arr, grads):
     from .ndarray.sparse import (CompactRowSparseNDArray,
                                  compact_row_sparse_array, compact_merge)
     tgt = arr._grad
+    # a sparse-embedding backward may have already written into this
+    # buffer DURING this pass (custom_backward runs at tape-walk time);
+    # same-pass contributions always sum, whatever grad_req says
+    same_pass = getattr(tgt, "_sparse_bwd_pass", None) \
+        == _STATE.backward_pass
+    accumulate = same_pass or getattr(arr, "_grad_req", "write") == "add"
     if isinstance(tgt, CompactRowSparseNDArray):
         # a dense cotangent reached a compact grad slot (the variable was
         # used by a dense recorded op, not only the sparse-embedding
@@ -223,13 +229,15 @@ def _write_grad(arr, grads):
         fresh = compact_row_sparse_array(
             (g_np[rows], rows.astype(_np.int64)), shape=tgt.shape,
             nnz_max=max(tgt.nnz_max, rows.size))
-        if getattr(arr, "_grad_req", "write") == "add" and tgt.nnz:
+        if accumulate and tgt.nnz:
             fresh = compact_merge([tgt, fresh])
         tgt._assign_value(fresh)
         return
     g = grads[id(arr)].astype(tgt._data.dtype)
-    if getattr(arr, "_grad_req", "write") == "add":
+    if accumulate:
         tgt._data = tgt._data + g
+        if hasattr(tgt, "_aux"):
+            tgt._aux = None  # summed value: metadata recomputes lazily
     else:
         tgt._data = g
 

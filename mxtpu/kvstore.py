@@ -156,6 +156,12 @@ class KVStore:
                             "non-compact target would materialize the "
                             "full shape; use row_sparse_pull")
                     arr._assign_value(src)
+                elif isinstance(arr, CompactRowSparseNDArray):
+                    raise TypeError(
+                        "pull of a dense store into a compact "
+                        "row_sparse target: convert the store with "
+                        "compact_row_sparse_array or pull row-wise "
+                        "with row_sparse_pull")
                 else:
                     arr._data = src._data
 
@@ -353,6 +359,19 @@ class DistKVStore(KVStore):
         from jax.experimental import multihost_utils
         from .ndarray.sparse import CompactRowSparseNDArray
         import jax.numpy as jnp
+        # nnz_max buffers grow data-dependently per rank (SparseEmbedding
+        # backward); allgather needs identical shapes, so pad everyone to
+        # the fleet-wide max first
+        sizes = multihost_utils.process_allgather(
+            _np_mod.array([arr.nnz_max]))
+        m = int(sizes.max())
+        if arr.nnz_max < m:
+            pad_rows = jnp.zeros((m - arr.nnz_max,) + arr._data.shape[1:],
+                                 arr._data.dtype)
+            arr._data = jnp.concatenate([arr._data, pad_rows], axis=0)
+            pad_idx = jnp.full((m - arr.nnz_max,), arr.shape[0], jnp.int32)
+            arr._aux["indices"]._data = jnp.concatenate(
+                [arr._aux["indices"]._data, pad_idx])
         rows = multihost_utils.process_allgather(arr._data)
         idx = multihost_utils.process_allgather(arr._aux["indices"]._data)
         nnz = multihost_utils.process_allgather(_np_mod.array([arr._nnz]))

@@ -40,6 +40,7 @@ class NDArray:
     """A device tensor with MXNet NDArray semantics over a jax.Array."""
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_is_ag_variable",
+                 "_fresh_grad",
                  "__weakref__")
 
     def __init__(self, data, ctx=None):

@@ -328,6 +328,269 @@ int test_kvstore() {
   return 0;
 }
 
+
+// ---------------------------------------------------- round-3 ABI breadth
+
+static int g_monitor_calls = 0;
+void monitor_cb(const char *name, NDArrayHandle value, void *closure) {
+  (void)name; (void)value; (void)closure;
+  ++g_monitor_calls;
+}
+
+int double_op_dispatch(int phase, int num_arrays, NDArrayHandle *arrays,
+                       void *state) {
+  (void)state;
+  if (phase != 0) return 0;  // identity backward not exercised here
+  // forward: arrays = [input, output]; output = 2 * input
+  int half = num_arrays / 2;
+  for (int k = 0; k < half; ++k) {
+    mx_uint ndim = 0;
+    const mx_uint *dims = nullptr;
+    if (MXNDArrayGetShape(arrays[k], &ndim, &dims) != 0) return -1;
+    size_t n = 1;
+    for (mx_uint i = 0; i < ndim; ++i) n *= dims[i];
+    std::vector<float> buf(n);
+    if (MXNDArraySyncCopyToCPU(arrays[k], buf.data(), n) != 0) return -1;
+    for (auto &v : buf) v *= 2.0f;
+    if (MXNDArraySyncCopyFromCPU(arrays[half + k], buf.data(), n) != 0)
+      return -1;
+  }
+  return 0;
+}
+
+int test_round3_breadth(const char *tmpdir) {
+  // engine + profiler state surface
+  int prev = 0;
+  CHECK_OK(MXEngineSetBulkSize(10, &prev));
+  CHECK_OK(MXSetNumOMPThreads(2));
+  const char *pk[] = {"filename"};
+  std::string profile_path = std::string(tmpdir) + "/c_profile.json";
+  const char *pv[] = {profile_path.c_str()};
+  CHECK_OK(MXSetProfilerConfig(1, pk, pv));
+  CHECK_OK(MXSetProfilerState(1));
+  ProfileHandle domain = nullptr, task = nullptr, counter = nullptr;
+  CHECK_OK(MXProfileCreateDomain("cdomain", &domain));
+  CHECK_OK(MXProfileCreateTask(domain, "ctask", &task));
+  CHECK_OK(MXProfileDurationStart(task));
+  CHECK_OK(MXProfileDurationStop(task));
+  CHECK_OK(MXProfileCreateCounter(domain, "ccount", &counter));
+  CHECK_OK(MXProfileSetCounter(counter, 41));
+  CHECK_OK(MXProfileAdjustCounter(counter, 1));
+  CHECK_OK(MXProfileSetMarker(domain, "cmark", "process"));
+  CHECK_OK(MXSetProfilerState(0));
+  CHECK_OK(MXDumpProfile(1));
+  CHECK_OK(MXProfileDestroyHandle(task));
+  CHECK_OK(MXProfileDestroyHandle(counter));
+  CHECK_OK(MXProfileDestroyHandle(domain));
+  std::printf("  profiler OK\n");
+
+  // autograd state queries
+  bool rec = true, train = true;
+  CHECK_OK(MXAutogradIsRecording(&rec));
+  CHECK_OK(MXAutogradIsTraining(&train));
+  CHECK(!rec);
+
+  // NDArray breadth: storage type, detach, raw-bytes round trip
+  mx_uint shape[2] = {2, 2};
+  NDArrayHandle a = nullptr;
+  CHECK_OK(MXNDArrayCreate(shape, 2, 1, 0, 0, &a));
+  float host[4] = {1, 2, 3, 4};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(a, host, 4));
+  int stype = -1;
+  CHECK_OK(MXNDArrayGetStorageType(a, &stype));
+  CHECK(stype == 1);
+  NDArrayHandle det = nullptr;
+  CHECK_OK(MXNDArrayDetach(a, &det));
+  CHECK_OK(MXNDArrayWaitToWrite(a));
+  size_t nraw = 0;
+  const char *raw = nullptr;
+  CHECK_OK(MXNDArraySaveRawBytes(a, &nraw, &raw));
+  NDArrayHandle reborn = nullptr;
+  CHECK_OK(MXNDArrayLoadFromRawBytes(raw, nraw, &reborn));
+  float back[4] = {0, 0, 0, 0};
+  CHECK_OK(MXNDArraySyncCopyToCPU(reborn, back, 4));
+  for (int i = 0; i < 4; ++i) CHECK(back[i] == host[i]);
+  NDArrayHandle b = nullptr;
+  CHECK_OK(MXNDArrayCreate(shape, 2, 1, 0, 0, &b));
+  CHECK_OK(MXNDArraySyncCopyFromNDArray(b, a, -1));
+  CHECK_OK(MXNDArraySyncCheckFormat(a, true));
+  std::printf("  ndarray breadth OK\n");
+
+  // Symbol breadth: attrs, name, counts, type inference, debug print
+  SymbolHandle x = nullptr, fc = nullptr;
+  CHECK_OK(MXSymbolCreateVariable("data", &x));
+  OpHandle fc_op = nullptr;
+  CHECK_OK(MXGetOpHandle("FullyConnected", &fc_op));
+  const char *keys[] = {"num_hidden"};
+  const char *vals[] = {"4"};
+  CHECK_OK(MXSymbolCreateAtomicSymbol(fc_op, 1, keys, vals, &fc));
+  SymbolHandle args[] = {x};
+  CHECK_OK(MXSymbolCompose(fc, "fc1", 1, nullptr, args));
+  const char *nm = nullptr;
+  int ok = 0;
+  CHECK_OK(MXSymbolGetName(fc, &nm, &ok));
+  CHECK(ok == 1 && std::string(nm) == "fc1");
+  CHECK_OK(MXSymbolSetAttr(fc, "lr_mult", "2.0"));
+  const char *attr = nullptr;
+  CHECK_OK(MXSymbolGetAttr(fc, "lr_mult", &attr, &ok));
+  CHECK(ok == 1 && std::string(attr) == "2.0");
+  mx_uint n_out = 0;
+  CHECK_OK(MXSymbolGetNumOutputs(fc, &n_out));
+  CHECK(n_out == 1);
+  const char *dbg = nullptr;
+  CHECK_OK(MXSymbolPrint(fc, &dbg));
+  CHECK(dbg && dbg[0] != 0);
+  const char *info_name = nullptr, *info_desc = nullptr;
+  mx_uint info_nargs = 0;
+  const char **an = nullptr, **at = nullptr, **ad = nullptr;
+  const char *kv = nullptr;
+  CHECK_OK(MXSymbolGetAtomicSymbolInfo(fc_op, &info_name, &info_desc,
+                                       &info_nargs, &an, &at, &ad, &kv));
+  CHECK(std::string(info_name) == "FullyConnected");
+
+  int tkeys_data[] = {0};
+  const char *tkeys[] = {"data"};
+  mx_uint in_ts = 0, out_ts = 0, aux_ts = 0;
+  const int *in_td = nullptr, *out_td = nullptr, *aux_td = nullptr;
+  int complete = 0;
+  CHECK_OK(MXSymbolInferType(fc, 1, tkeys, tkeys_data, &in_ts, &in_td,
+                             &out_ts, &out_td, &aux_ts, &aux_td,
+                             &complete));
+  CHECK(complete == 1 && out_ts == 1 && out_td[0] == 0);
+  std::printf("  symbol breadth OK\n");
+
+  // SimpleBind + monitor callback + BackwardEx + Print
+  const char *sb_shape_names[] = {"data"};
+  mx_uint sb_shape_data[] = {3, 5};
+  mx_uint sb_shape_idx[] = {0, 2};
+  mx_uint n_in = 0, n_aux = 0;
+  NDArrayHandle *in_args = nullptr, *arg_grads = nullptr,
+                *aux_states = nullptr;
+  ExecutorHandle exec = nullptr;
+  int shared_len = -1;
+  CHECK_OK(MXExecutorSimpleBind(
+      fc, 1, 0, 0, nullptr, nullptr, nullptr, 0, nullptr, nullptr, 1,
+      sb_shape_names, sb_shape_data, sb_shape_idx, 0, nullptr, nullptr, 0,
+      nullptr, nullptr, 0, nullptr, &shared_len, nullptr, nullptr, nullptr,
+      nullptr, &n_in, &in_args, &arg_grads, &n_aux, &aux_states, nullptr,
+      &exec));
+  CHECK(n_in == 3);  // data, weight, bias
+  std::vector<float> ones(15, 1.0f);
+  CHECK_OK(MXNDArraySyncCopyFromCPU(in_args[0], ones.data(), 15));
+  std::vector<float> w(4 * 5, 0.1f);
+  CHECK_OK(MXNDArraySyncCopyFromCPU(in_args[1], w.data(), 20));
+  CHECK_OK(MXExecutorSetMonitorCallback(exec, monitor_cb, nullptr));
+  CHECK_OK(MXExecutorForward(exec, 1));
+  mx_uint n_eo = 0;
+  NDArrayHandle *eouts = nullptr;
+  CHECK_OK(MXExecutorOutputs(exec, &n_eo, &eouts));
+  CHECK(n_eo == 1 && g_monitor_calls > 0);
+  CHECK_OK(MXExecutorBackwardEx(exec, 0, nullptr, 1));
+  const char *exec_dbg = nullptr;
+  CHECK_OK(MXExecutorPrint(exec, &exec_dbg));
+  CHECK(exec_dbg && exec_dbg[0] != 0);
+  CHECK_OK(MXExecutorFree(exec));
+  std::printf("  simple_bind OK\n");
+
+  // CachedOp
+  CachedOpHandle cop = nullptr;
+  CHECK_OK(MXCreateCachedOp(fc, &cop));
+  NDArrayHandle cin[3];
+  mx_uint dshape[2] = {3, 5};
+  CHECK_OK(MXNDArrayCreate(dshape, 2, 1, 0, 0, &cin[0]));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(cin[0], ones.data(), 15));
+  mx_uint wshape[2] = {4, 5};
+  CHECK_OK(MXNDArrayCreate(wshape, 2, 1, 0, 0, &cin[1]));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(cin[1], w.data(), 20));
+  mx_uint bshape[1] = {4};
+  CHECK_OK(MXNDArrayCreate(bshape, 1, 1, 0, 0, &cin[2]));
+  int n_co = 0;
+  NDArrayHandle *couts = nullptr;
+  CHECK_OK(MXInvokeCachedOp(cop, 3, cin, &n_co, &couts));
+  CHECK(n_co == 1);
+  float cres[12];
+  CHECK_OK(MXNDArraySyncCopyToCPU(couts[0], cres, 12));
+  CHECK(std::fabs(cres[0] - 0.5f) < 1e-5);  // 5 * 1 * 0.1
+  CHECK_OK(MXFreeCachedOp(cop));
+  std::printf("  cached op OK\n");
+
+  // KVStore breadth: type, barrier, dead nodes, string keys, compression
+  KVStoreHandle kv2 = nullptr;
+  CHECK_OK(MXKVStoreCreate("local", &kv2));
+  const char *kv_type = nullptr;
+  CHECK_OK(MXKVStoreGetType(kv2, &kv_type));
+  CHECK(std::string(kv_type) == "local");
+  CHECK_OK(MXKVStoreBarrier(kv2));
+  int dead = -1;
+  CHECK_OK(MXKVStoreGetNumDeadNode(kv2, 0, &dead, 1));
+  CHECK(dead == 0);
+  int is_worker = 0;
+  CHECK_OK(MXKVStoreIsWorkerNode(&is_worker));
+  CHECK(is_worker == 1);
+  const char *skeys[] = {"weight0"};
+  NDArrayHandle kv_val = nullptr;
+  mx_uint kshape[1] = {3};
+  CHECK_OK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &kv_val));
+  float kv_host[3] = {1, 1, 1};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(kv_val, kv_host, 3));
+  NDArrayHandle kv_vals[] = {kv_val};
+  CHECK_OK(MXKVStoreInitEx(kv2, 1, skeys, kv_vals));
+  CHECK_OK(MXKVStorePushEx(kv2, 1, skeys, kv_vals, 0));
+  NDArrayHandle kv_out = nullptr;
+  CHECK_OK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &kv_out));
+  NDArrayHandle kv_outs[] = {kv_out};
+  CHECK_OK(MXKVStorePullEx(kv2, 1, skeys, kv_outs, 0));
+  float kv_res[3];
+  CHECK_OK(MXNDArraySyncCopyToCPU(kv_out, kv_res, 3));
+  CHECK(std::fabs(kv_res[0] - 1.0f) < 1e-6);
+  const char *gck[] = {"type", "threshold"};
+  const char *gcv[] = {"2bit", "0.5"};
+  CHECK_OK(MXKVStoreSetGradientCompression(kv2, 2, gck, gcv));
+  CHECK_OK(MXKVStoreFree(kv2));
+  std::printf("  kvstore breadth OK\n");
+
+  // RecordIO round trip
+  std::string rec_path = std::string(tmpdir) + "/c_records.rec";
+  RecordIOHandle writer = nullptr;
+  CHECK_OK(MXRecordIOWriterCreate(rec_path.c_str(), &writer));
+  const char payload[] = "hello-from-c";
+  CHECK_OK(MXRecordIOWriterWriteRecord(writer, payload, sizeof(payload)));
+  CHECK_OK(MXRecordIOWriterFree(writer));
+  RecordIOHandle reader = nullptr;
+  CHECK_OK(MXRecordIOReaderCreate(rec_path.c_str(), &reader));
+  const char *rbuf = nullptr;
+  size_t rsize = 0;
+  CHECK_OK(MXRecordIOReaderReadRecord(reader, &rbuf, &rsize));
+  CHECK(rsize == sizeof(payload) && std::memcmp(rbuf, payload, rsize) == 0);
+  CHECK_OK(MXRecordIOReaderReadRecord(reader, &rbuf, &rsize));
+  CHECK(rsize == 0);  // end of file
+  CHECK_OK(MXRecordIOReaderFree(reader));
+  std::printf("  recordio OK\n");
+
+  // custom op registered from C, invoked imperatively
+  CHECK_OK(MXCustomOpRegister("c_double", 1, 1, double_op_dispatch,
+                              nullptr));
+  OpHandle custom_op = nullptr;
+  CHECK_OK(MXGetOpHandle("Custom", &custom_op));
+  NDArrayHandle cop_in = nullptr;
+  CHECK_OK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &cop_in));
+  float three[3] = {3, 3, 3};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(cop_in, three, 3));
+  NDArrayHandle cop_inputs[] = {cop_in};
+  int n_cop_out = 0;
+  NDArrayHandle *cop_outs = nullptr;
+  const char *cop_keys[] = {"op_type"};
+  const char *cop_vals[] = {"c_double"};
+  CHECK_OK(MXImperativeInvoke(custom_op, 1, cop_inputs, &n_cop_out,
+                              &cop_outs, 1, cop_keys, cop_vals));
+  CHECK(n_cop_out == 1);
+  float doubled[3];
+  CHECK_OK(MXNDArraySyncCopyToCPU(cop_outs[0], doubled, 3));
+  for (int i = 0; i < 3; ++i) CHECK(std::fabs(doubled[i] - 6.0f) < 1e-5);
+  std::printf("  c custom op OK\n");
+  return 0;
+}
+
 int main(int argc, char **argv) {
   const char *tmpdir = argc > 1 ? argv[1] : "/tmp";
   int version;
@@ -341,6 +604,7 @@ int main(int argc, char **argv) {
   if (test_imperative_and_autograd()) return 1;
   if (test_symbol_and_executor()) return 1;
   if (test_kvstore()) return 1;
+  if (test_round3_breadth(tmpdir)) return 1;
   if (MXNotifyShutdown() != 0) return 1;
   std::printf("c_api_test OK\n");
   return 0;
