@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Toy FCN semantic segmentation (reference example/fcn-xs: a conv
+encoder scores at stride 4, a learnable Deconvolution upsamples back to
+input resolution, Crop aligns the upsampled map, and a per-pixel
+SoftmaxOutput (multi_output) trains against dense masks —
+symbol_fcnxs.py's fcn32s head at toy scale).
+
+Task: segment a bright square against noise; asserts pixel accuracy.
+
+Run: JAX_PLATFORMS=cpu python example/fcn-xs/fcn_toy.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+HW = 32
+CLASSES = 2
+
+
+def make_data(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(0, 0.3, (n, 1, HW, HW)).astype("f")
+    y = np.zeros((n, HW, HW), "f")
+    for i in range(n):
+        size = rng.randint(8, 18)
+        r0 = rng.randint(0, HW - size)
+        c0 = rng.randint(0, HW - size)
+        x[i, 0, r0:r0 + size, c0:c0 + size] += 0.7
+        y[i, r0:r0 + size, c0:c0 + size] = 1.0
+    return x, y
+
+
+def get_fcn_symbol():
+    data = mx.sym.var("data")
+    body = data
+    for i, ch in enumerate((16, 32)):  # two stride-2 stages -> stride 4
+        body = mx.sym.Convolution(body, num_filter=ch, kernel=(3, 3),
+                                  pad=(1, 1), name="conv%d" % i)
+        body = mx.sym.Activation(body, act_type="relu")
+        body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max", name="pool%d" % i)
+    score = mx.sym.Convolution(body, num_filter=CLASSES, kernel=(1, 1),
+                               name="score")
+    # learnable 2x-stride-4 upsampling back to input resolution
+    up = mx.sym.Deconvolution(score, num_filter=CLASSES, kernel=(8, 8),
+                              stride=(4, 4), pad=(2, 2), num_group=1,
+                              name="bigscore")
+    up = mx.sym.Crop(up, data, name="crop")
+    return mx.sym.SoftmaxOutput(up, multi_output=True,
+                                use_ignore=True, ignore_label=-1,
+                                name="softmax")
+
+
+def main():
+    np.random.seed(0)
+    mx.random.seed(0)
+    x, y = make_data(96)
+    sym = get_fcn_symbol()
+    train = mx.io.NDArrayIter(x, y, batch_size=8, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), num_epoch=6)
+
+    # per-pixel accuracy on the training images
+    val = mx.io.NDArrayIter(x, y, batch_size=8,
+                            label_name="softmax_label")
+    correct = total = 0
+    for batch in val:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = batch.label[0].asnumpy()
+        correct += (pred == lab).sum()
+        total += lab.size
+    acc = correct / total
+    print("pixel accuracy: %.3f" % acc)
+    assert acc > 0.93, acc
+    print("fcn_toy OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Toy Faster R-CNN (reference example/rcnn, the largest detection
+suite): the two-stage detection pipeline end to end at a size that runs
+in seconds on CPU —
+
+  stage 1  RPN: shared conv features -> anchor objectness
+           (SoftmaxOutput over anchors) + bbox deltas (SmoothL1 against
+           anchor-target regression, computed like
+           rcnn/rcnn/io/rpn.py's AnchorLoader at toy scale);
+  stage 2  Proposal op decodes+NMSes RPN outputs into rois,
+           ROIPooling crops features per roi, and an FC head classifies
+           each roi (rcnn/symbol/symbol_vgg.py get_vgg_rcnn shape).
+
+Task: one bright square per image. Asserts RPN learns objectness,
+proposals cover the ground-truth box, and the roi head separates
+object rois from background rois.
+
+Run: JAX_PLATFORMS=cpu python example/rcnn/train_rcnn_toy.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+HW = 32                 # image size
+STRIDE = 4              # feature stride after the backbone
+FEAT = HW // STRIDE     # 8x8 feature map
+SCALES = (4,)           # anchor side = stride*scale = 16 px
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+
+
+def make_images(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(0, 0.3, (n, 1, HW, HW)).astype("f")
+    boxes = np.zeros((n, 4), "f")
+    for i in range(n):
+        size = rng.randint(12, 18)
+        r0 = rng.randint(0, HW - size)
+        c0 = rng.randint(0, HW - size)
+        x[i, 0, r0:r0 + size, c0:c0 + size] += 0.7
+        boxes[i] = (c0, r0, c0 + size - 1, r0 + size - 1)  # x1 y1 x2 y2
+    return x, boxes
+
+
+def all_anchors():
+    """Anchor grid identical to the Proposal op's enumeration."""
+    base = float(STRIDE)
+    ctr = (base - 1) / 2
+    side = base * SCALES[0]  # matches the Proposal op's sqrt(base^2/r)*s
+    cells = []
+    for r in range(FEAT):
+        for c in range(FEAT):
+            cx, cy = c * base + ctr, r * base + ctr
+            cells.append([cx - side / 2, cy - side / 2,
+                          cx + side / 2, cy + side / 2])
+    return np.asarray(cells, "f")
+
+
+def iou(boxes, gt):
+    x1 = np.maximum(boxes[:, 0], gt[0])
+    y1 = np.maximum(boxes[:, 1], gt[1])
+    x2 = np.minimum(boxes[:, 2], gt[2])
+    y2 = np.minimum(boxes[:, 3], gt[3])
+    inter = np.clip(x2 - x1 + 1, 0, None) * np.clip(y2 - y1 + 1, 0, None)
+    area_b = (boxes[:, 2] - boxes[:, 0] + 1) * \
+        (boxes[:, 3] - boxes[:, 1] + 1)
+    area_g = (gt[2] - gt[0] + 1) * (gt[3] - gt[1] + 1)
+    return inter / (area_b + area_g - inter)
+
+
+def rpn_targets(boxes):
+    """Per-image anchor labels (1 obj / 0 bg / -1 ignore) + bbox deltas —
+    the AnchorLoader assignment rule at toy scale."""
+    anchors = all_anchors()
+    n = boxes.shape[0]
+    labels = np.zeros((n, A * FEAT * FEAT), "f")
+    deltas = np.zeros((n, A * 4, FEAT, FEAT), "f")
+    for i in range(n):
+        ious = iou(anchors, boxes[i])
+        lab = -np.ones(anchors.shape[0], "f")
+        lab[ious < 0.3] = 0.0
+        lab[ious >= 0.5] = 1.0
+        lab[np.argmax(ious)] = 1.0
+        labels[i] = lab
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        gw = boxes[i, 2] - boxes[i, 0] + 1
+        gh = boxes[i, 3] - boxes[i, 1] + 1
+        gcx = boxes[i, 0] + gw / 2
+        gcy = boxes[i, 1] + gh / 2
+        d = np.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                      np.log(gw / aw) * np.ones_like(aw),
+                      np.log(gh / ah) * np.ones_like(ah)], 1)
+        d[lab != 1.0] = 0.0  # only positive anchors regress
+        deltas[i] = d.reshape(FEAT, FEAT, A * 4).transpose(2, 0, 1)
+    # regression mask: 1 on positive-anchor positions (reference
+    # bbox_weight, rcnn/io/rpn.py), so background never drags deltas to 0
+    weights = (labels == 1.0).astype("f").reshape(-1, FEAT, FEAT, A)
+    weights = np.repeat(weights.transpose(0, 3, 1, 2), 4, axis=1)
+    return labels, deltas, weights
+
+
+def get_rpn_symbol():
+    data = mx.sym.var("data")
+    body = data
+    for i, ch in enumerate((16, 32)):
+        body = mx.sym.Convolution(body, num_filter=ch, kernel=(3, 3),
+                                  pad=(1, 1), name="conv%d" % i)
+        body = mx.sym.Activation(body, act_type="relu")
+        body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max")
+    feat = mx.sym.Convolution(body, num_filter=32, kernel=(3, 3),
+                              pad=(1, 1), name="rpn_conv")
+    feat = mx.sym.Activation(feat, act_type="relu", name="feat")
+    cls = mx.sym.Convolution(feat, num_filter=2 * A, kernel=(1, 1),
+                             name="rpn_cls_score")
+    cls = mx.sym.Reshape(cls, shape=(0, 2, -1))
+    cls_out = mx.sym.SoftmaxOutput(cls, multi_output=True, use_ignore=True,
+                                   ignore_label=-1, name="rpn_cls")
+    bbox = mx.sym.Convolution(feat, num_filter=4 * A, kernel=(1, 1),
+                              name="rpn_bbox_pred")
+    bbox_tgt = mx.sym.var("bbox_target")
+    bbox_w = mx.sym.var("bbox_weight")
+    bbox_loss = mx.sym.MakeLoss(
+        mx.sym.smooth_l1(bbox_w * (bbox - bbox_tgt), scalar=3.0),
+        grad_scale=1.0, name="rpn_bbox_loss")
+    return mx.sym.Group([cls_out, bbox_loss, mx.sym.BlockGrad(bbox)])
+
+
+def main():
+    np.random.seed(0)
+    mx.random.seed(0)
+    n = 64
+    x, boxes = make_images(n)
+    labels, deltas, weights = rpn_targets(boxes)
+
+    # ---- stage 1: train the RPN ------------------------------------------
+    sym = get_rpn_symbol()
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", data=(8, 1, HW, HW),
+                          rpn_cls_label=(8, A * FEAT * FEAT),
+                          bbox_target=(8, 4 * A, FEAT, FEAT),
+                          bbox_weight=(8, 4 * A, FEAT, FEAT))
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "rpn_cls_label", "bbox_target",
+                        "bbox_weight"):
+            init(mx.init.InitDesc(name), arr)
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    states = {k: opt.create_state(i, exe.arg_dict[k])
+              for i, k in enumerate(exe.grad_dict)}
+    for epoch in range(8):
+        for b in range(0, n, 8):
+            exe.arg_dict["data"][:] = x[b:b + 8]
+            exe.arg_dict["rpn_cls_label"][:] = labels[b:b + 8]
+            exe.arg_dict["bbox_target"][:] = deltas[b:b + 8]
+            exe.arg_dict["bbox_weight"][:] = weights[b:b + 8]
+            exe.forward(is_train=True)
+            exe.backward()
+            for i, (k, g) in enumerate(exe.grad_dict.items()):
+                if g is not None and k not in ("data", "rpn_cls_label",
+                                               "bbox_target",
+                                               "bbox_weight"):
+                    opt.update(i, exe.arg_dict[k], g, states[k])
+
+    # RPN objectness accuracy on labelled anchors
+    exe.arg_dict["data"][:] = x[:8]
+    exe.arg_dict["rpn_cls_label"][:] = labels[:8]
+    exe.arg_dict["bbox_target"][:] = deltas[:8]
+    exe.arg_dict["bbox_weight"][:] = weights[:8]
+    probs = exe.forward(is_train=False)[0].asnumpy()  # [8, 2, anchors]
+    pred = probs.argmax(axis=1)
+    mask = labels[:8] >= 0
+    rpn_acc = (pred[mask] == labels[:8][mask]).mean()
+    print("rpn objectness accuracy: %.3f" % rpn_acc)
+    assert rpn_acc > 0.9, rpn_acc
+
+    # ---- stage 2: Proposal + ROIPooling + roi head ----------------------
+    # probs is already softmaxed (B, 2, A*H*W): bg maps then fg maps —
+    # exactly the (B, 2A, H, W) layout Proposal expects for A=1
+    cls_prob = mx.nd.array(probs.reshape(8, 2 * A, FEAT, FEAT))
+    # use the trained deltas too
+    bbox_pred = exe.outputs[2]
+    bbox_pred = mx.nd.array(bbox_pred.asnumpy().reshape(8, 4 * A, FEAT,
+                                                        FEAT))
+    im_info = mx.nd.array(np.tile([HW, HW, 1.0], (8, 1)).astype("f"))
+    rois = mx.nd.Proposal(
+        cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=32,
+        rpn_post_nms_top_n=8, threshold=0.7, rpn_min_size=4,
+        scales=SCALES, ratios=RATIOS, feature_stride=STRIDE)
+    rois_np = rois.asnumpy()  # [8*post, 5]
+
+    # proposal recall: best proposal IoU vs gt per image
+    recalls = []
+    for i in range(8):
+        mine = rois_np[rois_np[:, 0] == i][:, 1:]
+        recalls.append(iou(mine, boxes[i]).max() if len(mine) else 0.0)
+    recall = float(np.mean([r > 0.5 for r in recalls]))
+    print("proposal recall@0.5: %.3f" % recall)
+    assert recall >= 0.75, recalls
+
+    # roi head: classify rois as object/background by IoU-derived labels
+    feat_sym = sym.get_internals()["feat_output"]
+    feat_exe = feat_sym.simple_bind(mx.cpu(), grad_req="null",
+                                    data=(8, 1, HW, HW))
+    feat_exe.copy_params_from(
+        {k: v for k, v in exe.arg_dict.items()
+         if k in feat_exe.arg_dict and k != "data"}, {})
+    feat_exe.arg_dict["data"][:] = x[:8]
+    feat = feat_exe.forward(is_train=False)[0]
+    pooled = mx.nd.ROIPooling(feat, rois, pooled_size=(4, 4),
+                              spatial_scale=1.0 / STRIDE)
+    roi_labels = np.zeros((rois_np.shape[0],), "f")
+    for j in range(rois_np.shape[0]):
+        i = int(rois_np[j, 0])
+        roi_labels[j] = 1.0 if iou(rois_np[j:j + 1, 1:],
+                                   boxes[i])[0] > 0.5 else 0.0
+    head = mx.sym.var("pooled")
+    head_net = mx.sym.FullyConnected(mx.sym.Flatten(head), num_hidden=32,
+                                     name="head_fc1")
+    head_net = mx.sym.Activation(head_net, act_type="relu")
+    head_net = mx.sym.FullyConnected(head_net, num_hidden=2,
+                                     name="head_fc2")
+    head_net = mx.sym.SoftmaxOutput(head_net, name="cls")
+    hexe = head_net.simple_bind(mx.cpu(), grad_req="write",
+                                pooled=tuple(pooled.shape),
+                                cls_label=(pooled.shape[0],))
+    for name, arr in hexe.arg_dict.items():
+        if name not in ("pooled", "cls_label"):
+            init(mx.init.InitDesc(name), arr)
+    hopt = mx.optimizer.Adam(learning_rate=0.01)
+    hstates = {k: hopt.create_state(i, hexe.arg_dict[k])
+               for i, k in enumerate(hexe.grad_dict)}
+    hexe.arg_dict["pooled"][:] = pooled
+    hexe.arg_dict["cls_label"][:] = roi_labels
+    for step in range(60):
+        hexe.forward(is_train=True)
+        hexe.backward()
+        for i, (k, g) in enumerate(hexe.grad_dict.items()):
+            if g is not None and k not in ("pooled", "cls_label"):
+                hopt.update(i, hexe.arg_dict[k], g, hstates[k])
+    pred = hexe.forward(is_train=False)[0].asnumpy().argmax(axis=1)
+    head_acc = (pred == roi_labels).mean()
+    print("roi head accuracy: %.3f" % head_acc)
+    assert head_acc > 0.85, head_acc
+    print("train_rcnn_toy OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -478,8 +478,9 @@ def autograd_get_symbol(arr):
 # ------------------------------------------------------------ NDArray extra
 
 def ndarray_storage_type(arr):
+    # reference NDArrayStorageType codes: kDefault=0 kRowSparse=1 kCSR=2
     stype = getattr(arr, "stype", "default")
-    return {"default": 1, "row_sparse": 2, "csr": 3}.get(stype, 1)
+    return {"default": 0, "row_sparse": 1, "csr": 2}.get(stype, -1)
 
 
 def ndarray_detach(arr):
@@ -493,8 +494,9 @@ def ndarray_wait_to_write(arr):
 
 def ndarray_sync_copy_from_ndarray(dst, src, i):
     if int(i) >= 0:
-        # reference semantics: i selects the i-th aux array of a sparse src
-        src = src._aux_data(int(i))
+        # reference semantics: i selects the i-th aux array of a sparse
+        # src, in the reference's aux order
+        src = _aux_by_ref_index(src, int(i))
     dst._assign_value(src)
     dst.wait_to_read()
 
@@ -538,18 +540,27 @@ def ndarray_load_from_buffer(buf):
 def ndarray_create_sparse(stype, shape, dev_type, dev_id, dtype,
                           aux_types):
     from mxtpu.ndarray import sparse
-    stype_name = {1: "default", 2: "row_sparse", 3: "csr"}[int(stype)]
+    stype_name = {0: "default", 1: "row_sparse", 2: "csr"}[int(stype)]
     return sparse.zeros(stype_name, tuple(int(s) for s in shape),
                         ctx=_ctx(dev_type, dev_id),
                         dtype=_DTYPE_CODES[dtype])
 
 
+def _aux_by_ref_index(arr, i):
+    """Reference aux ordering: CSR kIndPtr=0 kIdx=1; row_sparse kIdx=0
+    (include/mxnet/ndarray.h CSRAuxType/RowSparseAuxType) — the internal
+    _aux_names tuple orders differently."""
+    order = {"csr": ("indptr", "indices"),
+             "row_sparse": ("indices",)}[arr.stype]
+    return arr._ensure_aux()[order[int(i)]]
+
+
 def ndarray_aux_ndarray(arr, i):
-    return arr._aux_data(int(i)).copy()
+    return _aux_by_ref_index(arr, i).copy()
 
 
 def ndarray_aux_type(arr, i):
-    aux = arr._aux_data(int(i))
+    aux = _aux_by_ref_index(arr, i)
     return _DTYPE_CODES.index(str(np.dtype(aux.dtype)))
 
 
@@ -658,25 +669,6 @@ def symbol_atomic_info(op_name):
 
 
 # ---------------------------------------------------------- Executor extra
-
-def executor_simple_bind(sym, dev_type, dev_id, grad_req_type,
-                         shape_keys, shapes, dtype_keys, dtypes,
-                         stype_keys, stypes):
-    req_names = {0: "null", 1: "write", 2: "add"}
-    shape_kwargs = {k: tuple(int(x) for x in v)
-                    for k, v in zip(shape_keys, shapes)}
-    type_dict = {k: _DTYPE_CODES[int(d)]
-                 for k, d in zip(dtype_keys, dtypes)}
-    stype_names = {0: "default", 1: "default", 2: "row_sparse", 3: "csr"}
-    stype_dict = {k: stype_names[int(v)]
-                  for k, v in zip(stype_keys, stypes)}
-    exe = sym.simple_bind(_ctx(dev_type, dev_id),
-                          grad_req=req_names[int(grad_req_type)],
-                          type_dict=type_dict or None,
-                          stype_dict=stype_dict or None,
-                          **shape_kwargs)
-    return [exe, exe.arg_arrays, exe.grad_arrays, exe.aux_arrays]
-
 
 def executor_backward_ex(ex, head_grads, is_train):
     grads = None if not head_grads else list(head_grads)
@@ -956,7 +948,7 @@ def executor_simple_bind_c(sym, dev_type, dev_id, req_names, req_types,
                     for k, v in zip(shape_keys, shapes)}
     type_dict = {k: _DTYPE_CODES[int(d)]
                  for k, d in zip(dtype_keys, dtypes)}
-    stype_names = {0: "default", 1: "default", 2: "row_sparse", 3: "csr"}
+    stype_names = {0: "default", 1: "row_sparse", 2: "csr"}
     stype_dict = {k: stype_names[int(v)]
                   for k, v in zip(stype_keys, stypes)}
     if not req_names:

@@ -63,7 +63,7 @@ PyObject *impl_module() {
 }
 
 // call a function on the impl module; returns new ref or nullptr (+err set)
-PyObject *icall(const char *fn, const char *fmt, ...) {
+PyObject *vicall(const char *fn, const char *fmt, va_list va) {
   PyObject *mod = impl_module();
   if (!mod) {
     set_error_from_python();
@@ -74,10 +74,7 @@ PyObject *icall(const char *fn, const char *fmt, ...) {
     set_error_from_python();
     return nullptr;
   }
-  va_list va;
-  va_start(va, fmt);
   PyObject *args = fmt ? Py_VaBuildValue(fmt, va) : PyTuple_New(0);
-  va_end(va);
   if (!args) {
     Py_DECREF(callable);
     set_error_from_python();
@@ -92,6 +89,14 @@ PyObject *icall(const char *fn, const char *fmt, ...) {
   Py_DECREF(callable);
   Py_DECREF(args);
   if (!res) set_error_from_python();
+  return res;
+}
+
+PyObject *icall(const char *fn, const char *fmt, ...) {
+  va_list va;
+  va_start(va, fmt);
+  PyObject *res = vicall(fn, fmt, va);
+  va_end(va);
   return res;
 }
 
@@ -1311,23 +1316,11 @@ PyObject *make_trampoline(PyMethodDef *def, const char *capname, void *ctx) {
 int simple_call(const char *fn, const char *fmt, ...) {
   ensure_python();
   GIL gil;
-  PyObject *mod = impl_module();
-  if (!mod) { set_error_from_python(); return -1; }
-  PyObject *callable = PyObject_GetAttrString(mod, fn);
-  if (!callable) { set_error_from_python(); return -1; }
   va_list va;
   va_start(va, fmt);
-  PyObject *args = fmt ? Py_VaBuildValue(fmt, va) : PyTuple_New(0);
+  PyObject *res = vicall(fn, fmt, va);
   va_end(va);
-  if (args && !PyTuple_Check(args)) {
-    PyObject *t = PyTuple_Pack(1, args);
-    Py_DECREF(args);
-    args = t;
-  }
-  PyObject *res = args ? PyObject_CallObject(callable, args) : nullptr;
-  Py_DECREF(callable);
-  Py_XDECREF(args);
-  if (!res) { set_error_from_python(); return -1; }
+  if (!res) return -1;
   Py_DECREF(res);
   return 0;
 }
@@ -1336,23 +1329,11 @@ int simple_call(const char *fn, const char *fmt, ...) {
 int int_call(const char *fn, int *out, const char *fmt, ...) {
   ensure_python();
   GIL gil;
-  PyObject *mod = impl_module();
-  if (!mod) { set_error_from_python(); return -1; }
-  PyObject *callable = PyObject_GetAttrString(mod, fn);
-  if (!callable) { set_error_from_python(); return -1; }
   va_list va;
   va_start(va, fmt);
-  PyObject *args = fmt ? Py_VaBuildValue(fmt, va) : PyTuple_New(0);
+  PyObject *res = vicall(fn, fmt, va);
   va_end(va);
-  if (args && !PyTuple_Check(args)) {
-    PyObject *t = PyTuple_Pack(1, args);
-    Py_DECREF(args);
-    args = t;
-  }
-  PyObject *res = args ? PyObject_CallObject(callable, args) : nullptr;
-  Py_DECREF(callable);
-  Py_XDECREF(args);
-  if (!res) { set_error_from_python(); return -1; }
+  if (!res) return -1;
   *out = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;

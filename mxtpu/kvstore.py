@@ -365,11 +365,12 @@ class DistKVStore(KVStore):
         sizes = multihost_utils.process_allgather(
             _np_mod.array([arr.nnz_max]))
         m = int(sizes.max())
-        if arr.nnz_max < m:
-            pad_rows = jnp.zeros((m - arr.nnz_max,) + arr._data.shape[1:],
+        pad = m - arr.nnz_max  # nnz_max is a view of _data: compute first
+        if pad > 0:
+            pad_rows = jnp.zeros((pad,) + arr._data.shape[1:],
                                  arr._data.dtype)
             arr._data = jnp.concatenate([arr._data, pad_rows], axis=0)
-            pad_idx = jnp.full((m - arr.nnz_max,), arr.shape[0], jnp.int32)
+            pad_idx = jnp.full((pad,), arr.shape[0], jnp.int32)
             arr._aux["indices"]._data = jnp.concatenate(
                 [arr._aux["indices"]._data, pad_idx])
         rows = multihost_utils.process_allgather(arr._data)

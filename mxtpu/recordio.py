@@ -100,6 +100,12 @@ class MXRecordIO:
         if pad:
             self.handle.write(b"\x00" * pad)
 
+    def seek(self, pos):
+        """Seek the reader to a byte offset previously returned by
+        ``tell`` (reference MXRecordIOReaderSeek)."""
+        assert not self.writable, "seek is a reader operation"
+        self.handle.seek(int(pos))
+
     def tell(self):
         return self.handle.tell()
 

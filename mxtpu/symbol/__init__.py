@@ -437,6 +437,11 @@ def _create_symbol(op, *args, **kwargs):
                 used_names.append(argname)
             elif argname in _OPTIONAL_NO_AUTO:
                 continue            # genuinely optional: fn gets None
+            elif argname == "state_cell" and \
+                    params.get("mode", "lstm") != "lstm":
+                # only LSTM has a cell state; auto-creating a variable for
+                # GRU/vanilla RNN would surface a bogus learnable arg
+                continue
             else:
                 # auto-create variable (MXNet: implicit weight/bias/label vars)
                 suffix = argname

@@ -398,7 +398,7 @@ int test_round3_breadth(const char *tmpdir) {
   CHECK_OK(MXNDArraySyncCopyFromCPU(a, host, 4));
   int stype = -1;
   CHECK_OK(MXNDArrayGetStorageType(a, &stype));
-  CHECK(stype == 1);
+  CHECK(stype == 0);  // kDefaultStorage, reference code
   NDArrayHandle det = nullptr;
   CHECK_OK(MXNDArrayDetach(a, &det));
   CHECK_OK(MXNDArrayWaitToWrite(a));
@@ -564,6 +564,9 @@ int test_round3_breadth(const char *tmpdir) {
   CHECK(rsize == sizeof(payload) && std::memcmp(rbuf, payload, rsize) == 0);
   CHECK_OK(MXRecordIOReaderReadRecord(reader, &rbuf, &rsize));
   CHECK(rsize == 0);  // end of file
+  CHECK_OK(MXRecordIOReaderSeek(reader, 0));  // rewind by byte offset
+  CHECK_OK(MXRecordIOReaderReadRecord(reader, &rbuf, &rsize));
+  CHECK(rsize == sizeof(payload) && std::memcmp(rbuf, payload, rsize) == 0);
   CHECK_OK(MXRecordIOReaderFree(reader));
   std::printf("  recordio OK\n");
 

@@ -39,6 +39,9 @@ EXAMPLES = [
     ("ctc/ctc_toy.py", {}),
     ("multivariate_time_series/lstnet_toy.py", {}),
     ("profiler/profile_resnet.py", {}),
+    ("rcnn/train_rcnn_toy.py", {}),
+    ("fcn-xs/fcn_toy.py", {}),
+    ("speech_recognition/deepspeech_toy.py", {}),
 ]
 
 
