@@ -2029,7 +2029,12 @@ int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
                             outputs);
   if (rc != 0) return rc;
   static thread_local std::vector<int> tl_stypes;
-  tl_stypes.assign(*num_outputs, 1);
+  tl_stypes.clear();
+  for (int i = 0; i < *num_outputs; ++i) {
+    int st = 0;  // kDefaultStorage
+    MXNDArrayGetStorageType((*outputs)[i], &st);
+    tl_stypes.push_back(st);
+  }
   *out_stypes = tl_stypes.data();
   return 0;
 }

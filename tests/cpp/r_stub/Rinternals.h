@@ -1,0 +1,58 @@
+/* Minimal R-API stub for the compile-only CI gate of R-package/src.
+ *
+ * This image has no R toolchain; this header carries just enough of R's
+ * C API surface (types + declarations, no behavior) for gcc to fully
+ * type-check mxtpu_r.c. A real build still uses `R CMD SHLIB` against
+ * the actual headers — the gate catches signature drift against
+ * c_api.h, undeclared identifiers, and syntax errors on every CI run.
+ */
+#ifndef MXTPU_R_STUB_RINTERNALS_H_
+#define MXTPU_R_STUB_RINTERNALS_H_
+
+#include <stddef.h>
+
+typedef struct SEXPREC *SEXP;
+typedef ptrdiff_t R_xlen_t;
+
+#define NILSXP 0
+#define INTSXP 13
+#define REALSXP 14
+#define STRSXP 16
+#define VECSXP 19
+#define RAWSXP 24
+
+extern SEXP R_NilValue;
+
+SEXP Rf_allocVector(unsigned int, R_xlen_t);
+SEXP Rf_mkChar(const char *);
+SEXP Rf_ScalarInteger(int);
+SEXP Rf_ScalarReal(double);
+int Rf_asInteger(SEXP);
+double Rf_asReal(SEXP);
+R_xlen_t Rf_xlength(SEXP);
+int Rf_length(SEXP);
+void Rf_error(const char *, ...);
+SEXP Rf_protect(SEXP);
+void Rf_unprotect(int);
+#define PROTECT(x) Rf_protect(x)
+#define UNPROTECT(n) Rf_unprotect(n)
+
+double *REAL(SEXP);
+int *INTEGER(SEXP);
+unsigned char *RAW(SEXP);
+SEXP STRING_ELT(SEXP, R_xlen_t);
+void SET_STRING_ELT(SEXP, R_xlen_t, SEXP);
+SEXP VECTOR_ELT(SEXP, R_xlen_t);
+void SET_VECTOR_ELT(SEXP, R_xlen_t, SEXP);
+const char *CHAR(SEXP);
+
+SEXP R_MakeExternalPtr(void *, SEXP, SEXP);
+void *R_ExternalPtrAddr(SEXP);
+void R_ClearExternalPtr(SEXP);
+typedef void (*R_CFinalizer_t)(SEXP);
+void R_RegisterCFinalizerEx(SEXP, R_CFinalizer_t, int);
+#define TRUE 1
+#define FALSE 0
+void *R_alloc(size_t, int);
+
+#endif

@@ -1,0 +1,30 @@
+"""Compile-only CI gate for the R binding.
+
+This image has no R toolchain, so `R CMD SHLIB` cannot run; instead the
+.Call glue is fully type-checked by gcc against a minimal stub of R's C
+API (tests/cpp/r_stub/). The gate catches the failure classes that
+matter without R installed: signature drift against include/mxtpu/
+c_api.h, undeclared identifiers, and syntax errors. A real R build is
+documented in R-package/src/mxtpu_r.c's header comment.
+"""
+import glob
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_r_glue_typechecks_against_c_abi():
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    srcs = glob.glob(os.path.join(_ROOT, "R-package", "src", "*.c"))
+    assert srcs, "R glue sources missing"
+    res = subprocess.run(
+        ["gcc", "-fsyntax-only", "-Wall", "-Werror",
+         "-I", os.path.join(_ROOT, "tests", "cpp", "r_stub"),
+         "-I", _ROOT] + srcs,
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr[-2000:]
