@@ -1979,6 +1979,8 @@ int MXExecutorSetMonitorCallback(ExecutorHandle handle,
 
 // ----------------------------------------------------------------- CachedOp
 
+static const int *query_out_stypes(int n, NDArrayHandle *arrs);
+
 int MXCreateCachedOpEx(SymbolHandle handle, int num_flags, const char **keys,
                        const char **vals, CachedOpHandle *out) {
   GIL gil;
@@ -2028,14 +2030,7 @@ int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
   int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
                             outputs);
   if (rc != 0) return rc;
-  static thread_local std::vector<int> tl_stypes;
-  tl_stypes.clear();
-  for (int i = 0; i < *num_outputs; ++i) {
-    int st = 0;  // kDefaultStorage
-    MXNDArrayGetStorageType((*outputs)[i], &st);
-    tl_stypes.push_back(st);
-  }
-  *out_stypes = tl_stypes.data();
+  *out_stypes = query_out_stypes(*num_outputs, *outputs);
   return 0;
 }
 
@@ -2517,6 +2512,17 @@ int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
 
 extern "C" {
 
+static const int *query_out_stypes(int n, NDArrayHandle *arrs) {
+  static thread_local std::vector<int> tl_out_stypes;
+  tl_out_stypes.clear();
+  for (int i = 0; i < n; ++i) {
+    int st = 0;  // kDefaultStorage fallback if the query fails
+    if (MXNDArrayGetStorageType(arrs[i], &st) != 0) st = 0;
+    tl_out_stypes.push_back(st);
+  }
+  return tl_out_stypes.data();
+}
+
 int MXImperativeInvokeEx(OpHandle op, int num_inputs, NDArrayHandle *inputs,
                          int *num_outputs, NDArrayHandle **outputs,
                          int num_params, const char **param_keys,
@@ -2524,14 +2530,7 @@ int MXImperativeInvokeEx(OpHandle op, int num_inputs, NDArrayHandle *inputs,
   int rc = MXImperativeInvoke(op, num_inputs, inputs, num_outputs, outputs,
                               num_params, param_keys, param_vals);
   if (rc != 0) return rc;
-  static thread_local std::vector<int> tl_inv_stypes;
-  tl_inv_stypes.clear();
-  for (int i = 0; i < *num_outputs; ++i) {
-    int st = 1;
-    MXNDArrayGetStorageType((*outputs)[i], &st);
-    tl_inv_stypes.push_back(st);
-  }
-  *out_stypes = tl_inv_stypes.data();
+  *out_stypes = query_out_stypes(*num_outputs, *outputs);
   return 0;
 }
 

@@ -544,10 +544,36 @@ def _unpack_loaded(z):
     return out
 
 
+def _from_legacy(arrays, names):
+    from . import sparse as _sp
+
+    def conv(entry):
+        if isinstance(entry, dict):  # sparse triple
+            if entry["stype"] == "row_sparse":
+                return _sp.row_sparse_array(
+                    (entry["data"], entry["aux"][0]), shape=entry["shape"])
+            return _sp.csr_matrix(
+                (entry["data"], entry["aux"][1], entry["aux"][0]),
+                shape=entry["shape"])
+        return array(entry)
+    vals = [conv(a) for a in arrays]
+    if names:
+        return dict(zip(names, vals))
+    return vals
+
+
 def load(fname):
-    """Load NDArrays saved by ``save``. Returns dict or list matching input."""
+    """Load NDArrays saved by ``save`` — or by the REFERENCE: files
+    carrying the dmlc 0x112 list magic (mxnet-trained .params) parse
+    through mxtpu.legacy_params, so reference checkpoints and model-zoo
+    weights load directly."""
     import os
     path = fname if os.path.exists(fname) else fname + ".npz"
+    from ..legacy_params import is_legacy_params, load_legacy_params
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if is_legacy_params(head):
+        return _from_legacy(*load_legacy_params(path))
     with _np.load(path, allow_pickle=False) as z:
         return _unpack_loaded(z)
 
@@ -586,10 +612,15 @@ __all__ += ["contrib"]
 
 
 def load_from_bytes(buf):
-    """Load NDArrays from an in-memory save() blob (used by the C predict
-    API, reference MXNDArrayLoadFromBuffer)."""
+    """Load NDArrays from an in-memory blob — ours or the reference's
+    binary format (used by the C predict API with reference-trained
+    checkpoints, reference MXNDArrayLoadFromBuffer)."""
     import io as _io
-    with _np.load(_io.BytesIO(bytes(buf)), allow_pickle=False) as z:
+    from ..legacy_params import is_legacy_params, load_legacy_params
+    buf = bytes(buf)
+    if is_legacy_params(buf[:8]):
+        return _from_legacy(*load_legacy_params(buf))
+    with _np.load(_io.BytesIO(buf), allow_pickle=False) as z:
         return _unpack_loaded(z)
 
 

@@ -1,0 +1,120 @@
+"""Reference .params binary interop (src/ndarray/ndarray.cc:1510-1740):
+round trips, format sniffing in nd.load, model-zoo weight migration."""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import legacy_params as lp
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_dense_roundtrip_uint32_and_int64_dims(tmp_path):
+    arrs = {"w": mx.nd.array(np.arange(12, dtype="f").reshape(3, 4)),
+            "b": mx.nd.array(np.ones(4, np.float64)),
+            "i": mx.nd.array(np.arange(5)).astype("int32")}
+    for dims_dtype in (np.uint32, np.int64):
+        path = str(tmp_path / ("p_%s.params" % np.dtype(dims_dtype).name))
+        lp.save_legacy_params(path, arrs, dims_dtype=dims_dtype)
+        with open(path, "rb") as f:
+            assert lp.is_legacy_params(f.read(8))
+        loaded = mx.nd.load(path)   # sniffed automatically
+        assert set(loaded) == {"w", "b", "i"}
+        for k in arrs:
+            np.testing.assert_array_equal(loaded[k].asnumpy(),
+                                          arrs[k].asnumpy())
+            assert loaded[k].asnumpy().dtype == arrs[k].asnumpy().dtype
+
+
+def test_unnamed_list_and_empty_shapes(tmp_path):
+    path = str(tmp_path / "l.params")
+    lp.save_legacy_params(path, [mx.nd.ones((2, 2)), mx.nd.zeros((3,))])
+    out = mx.nd.load(path)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_array_equal(out[0].asnumpy(), np.ones((2, 2)))
+
+
+def test_sparse_v2_blob_parses():
+    """Hand-build a V2 row_sparse blob exactly as NDArray::Save writes
+    it and check the loader reconstructs the sparse array."""
+    rows = np.array([1, 4], np.int64)
+    data = np.arange(6, dtype=np.float32).reshape(2, 3)
+    blob = [struct.pack("<QQQ", lp.LIST_MAGIC, 0, 1),
+            struct.pack("<I", lp.V2_MAGIC),
+            struct.pack("<i", 1),                       # row_sparse
+            struct.pack("<I", 2) + np.asarray((2, 3), np.uint32).tobytes(),
+            struct.pack("<I", 2) + np.asarray((6, 3), np.uint32).tobytes(),
+            struct.pack("<ii", 1, 0),                   # cpu ctx
+            struct.pack("<i", 0),                       # f32 values
+            struct.pack("<i", 6),                       # int64 indices
+            struct.pack("<I", 1) + np.asarray((2,), np.uint32).tobytes(),
+            data.tobytes(), rows.tobytes(),
+            struct.pack("<Q", 1),
+            struct.pack("<Q", 3) + b"emb"]
+    arrays, names = lp.load_legacy_params(b"".join(blob))
+    assert names == ["emb"]
+    entry = arrays[0]
+    assert entry["stype"] == "row_sparse" and entry["shape"] == (6, 3)
+    from mxtpu.ndarray import _from_legacy
+    out = _from_legacy(arrays, names)["emb"]
+    np.testing.assert_array_equal(out.indices.asnumpy(), rows)
+    np.testing.assert_array_equal(out.data.asnumpy(), data)
+
+
+def test_model_zoo_weights_migrate(tmp_path):
+    """Weights exported in the reference format load back into a gluon
+    model-zoo net through the converter CLI."""
+    from mxtpu.gluon.model_zoo import vision
+    net = vision.get_resnet(1, 18, classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).rand(1, 3, 32, 32)
+                    .astype("f"))
+    want = net(x).asnumpy()
+    # keys prefix-free, as gluon save_params writes them (each net
+    # instance gets an auto-incremented name scope)
+    params = {p.name[len(net.prefix):]: p.data()
+              for p in net.collect_params().values()}
+    legacy = str(tmp_path / "zoo.params")
+    lp.save_legacy_params(legacy, params)
+
+    converted = str(tmp_path / "zoo_mxtpu.params")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "convert_params.py"),
+         legacy, converted],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=ROOT))
+    assert res.returncode == 0, res.stderr[-1500:]
+
+    net2 = vision.get_resnet(1, 18, classes=10)
+    net2.load_params(converted)
+    np.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_sparse_legacy_writer_roundtrip(tmp_path):
+    """Sparse arrays survive the mxtpu -> reference-format -> mxtpu trip
+    without densifying."""
+    from mxtpu.ndarray import sparse
+    rsp = sparse.row_sparse_array(
+        (np.arange(6, dtype="f").reshape(2, 3), np.array([1, 4])),
+        shape=(8, 3))
+    csr = sparse.csr_matrix(np.array([[0, 1.5, 0], [2.5, 0, 0]], "f"))
+    path = str(tmp_path / "sp.params")
+    lp.save_legacy_params(path, {"r": rsp, "c": csr})
+    out = mx.nd.load(path)
+    assert out["r"].stype == "row_sparse"
+    np.testing.assert_array_equal(out["r"].indices.asnumpy(), [1, 4])
+    np.testing.assert_array_equal(out["r"].asnumpy(), rsp.asnumpy())
+    assert out["c"].stype == "csr"
+    np.testing.assert_array_equal(out["c"].asnumpy(), csr.asnumpy())
+
+
+def test_predict_bytes_path_reads_legacy():
+    from mxtpu.ndarray import load_from_bytes
+    blob = lp.save_legacy_params(None, {"w": mx.nd.ones((2, 2))})
+    out = load_from_bytes(blob)
+    np.testing.assert_array_equal(out["w"].asnumpy(), np.ones((2, 2)))

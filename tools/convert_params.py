@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Convert parameter files between the reference's binary .params format
+and mxtpu's container (either direction; the model-zoo migration path,
+reference gluon/model_zoo/model_store.py downloads + mx.nd.load).
+
+  # reference-trained checkpoint -> mxtpu
+  python tools/convert_params.py resnet50-0000.params out.params
+
+  # mxtpu weights -> a file reference deployments can read
+  python tools/convert_params.py trained.params legacy.params --to-legacy
+
+Gluon model-zoo naming (e.g. resnetv10_conv0_weight) matches between the
+frameworks, so converted zoo weights load straight into
+mxtpu.gluon.model_zoo networks via net.load_params. Symbol checkpoints
+keep their arg:/aux: key prefixes untouched.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxtpu as mx  # noqa: E402
+from mxtpu.legacy_params import save_legacy_params  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("src")
+    ap.add_argument("dst")
+    ap.add_argument("--to-legacy", action="store_true",
+                    help="write the reference binary format instead of "
+                         "mxtpu's")
+    args = ap.parse_args()
+
+    data = mx.nd.load(args.src)   # sniffs either format
+    if args.to_legacy:
+        save_legacy_params(args.dst, data)
+    else:
+        mx.nd.save(args.dst, data)
+    n = len(data)
+    print("converted %d arrays: %s -> %s%s" % (
+        n, args.src, args.dst,
+        " (reference binary format)" if args.to_legacy else ""))
+
+
+if __name__ == "__main__":
+    main()
