@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Toy captcha OCR (reference example/captcha: one conv net predicting
+ALL digits of a multi-digit image through a stacked softmax head —
+mxnet_captcha.R's 4-digit LeNet). Images are 3 synthetic glyph digits
+side by side with noise; the label is the digit string.
+
+Run: JAX_PLATFORMS=cpu python example/captcha/captcha_toy.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+
+DIGITS = 3
+CLASSES = 5
+CELL = 8                # each glyph is 8x8
+
+
+def glyphs():
+    """5 distinguishable 8x8 binary glyphs."""
+    g = np.zeros((CLASSES, CELL, CELL), "f")
+    g[0, :, 3:5] = 1                       # vertical bar
+    g[1, 3:5, :] = 1                       # horizontal bar
+    g[2] = np.eye(CELL)                    # diagonal
+    g[3, 2:6, 2:6] = 1                     # block
+    g[4, [0, -1], :] = 1                   # top+bottom edges
+    return g
+
+
+def make_data(n, seed):
+    rng = np.random.RandomState(seed)
+    g = glyphs()
+    labels = rng.randint(0, CLASSES, (n, DIGITS))
+    imgs = np.zeros((n, 1, CELL, CELL * DIGITS), "f")
+    for i in range(n):
+        for d in range(DIGITS):
+            imgs[i, 0, :, d * CELL:(d + 1) * CELL] = g[labels[i, d]]
+    imgs += 0.25 * rng.randn(*imgs.shape)
+    return imgs.astype("f"), labels.astype("f")
+
+
+def build():
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")              # (N, DIGITS)
+    body = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                              pad=(1, 1))
+    body = mx.sym.Activation(body, act_type="relu")
+    body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max")
+    body = mx.sym.FullyConnected(mx.sym.Flatten(body), num_hidden=64)
+    body = mx.sym.Activation(body, act_type="relu")
+    fc = mx.sym.FullyConnected(body, num_hidden=DIGITS * CLASSES)
+    # stack per-digit softmax: (N*DIGITS, CLASSES) against flat labels —
+    # the reference's multi-digit head reshape
+    pred = mx.sym.Reshape(fc, shape=(-1, CLASSES))
+    flat_label = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, flat_label, name="softmax")
+
+
+def main():
+    np.random.seed(0)
+    mx.random.seed(0)
+    xtr, ytr = make_data(512, 1)
+    xte, yte = make_data(128, 2)
+    batch = 32
+    train = mx.io.NDArrayIter(xtr, ytr, batch, shuffle=True,
+                              label_name="label")
+    class DigitAccuracy(mx.metric.EvalMetric):
+        """Per-digit accuracy over the stacked (N*DIGITS, C) head."""
+
+        def __init__(self):
+            super().__init__("digit-acc")
+
+        def update(self, labels, preds):
+            want = labels[0].asnumpy().reshape(-1).astype(int)
+            got = preds[0].asnumpy().argmax(axis=1)
+            self.sum_metric += (want == got).sum()
+            self.num_inst += want.size
+
+    mod = mx.mod.Module(build(), data_names=("data",),
+                        label_names=("label",))
+    mod.fit(train, optimizer="adam",
+            optimizer_params={"learning_rate": 0.005},
+            eval_metric=DigitAccuracy(),
+            initializer=mx.init.Xavier(), num_epoch=10)
+
+    val = mx.io.NDArrayIter(xte, yte, batch, label_name="label")
+    exact = total = 0
+    for b in val:
+        mod.forward(b, is_train=False)
+        probs = mod.get_outputs()[0].asnumpy()       # (batch*DIGITS, C)
+        pred = probs.argmax(axis=1).reshape(-1, DIGITS)
+        want = b.label[0].asnumpy().astype(int)
+        k = batch - (b.pad or 0)
+        exact += (pred[:k] == want[:k]).all(axis=1).sum()
+        total += k
+    acc = exact / total
+    print("exact captcha accuracy: %.3f" % acc)
+    assert acc > 0.9, acc
+    print("captcha_toy OK")
+
+
+if __name__ == "__main__":
+    main()

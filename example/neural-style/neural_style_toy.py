@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Toy neural style transfer (reference example/neural-style: optimize
+the INPUT image so conv-feature content matches one image while
+gram-matrix style statistics match another — nstyle.py's TV-regularized
+input optimization, at a size that runs in seconds).
+
+Exercises the autograd path the suite otherwise rarely uses: gradients
+with respect to DATA (mark_variables on the input, not the weights)
+through a fixed random conv feature extractor.
+
+Run: JAX_PLATFORMS=cpu python example/neural-style/neural_style_toy.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+from mxtpu import gluon  # noqa: E402
+from mxtpu.gluon import nn  # noqa: E402
+
+HW = 24
+
+
+def make_extractor():
+    """Fixed (untrained) conv stack; two feature taps like relu1/relu2."""
+    f1 = nn.HybridSequential()
+    f1.add(nn.Conv2D(8, 3, padding=1), nn.Activation("relu"))
+    f2 = nn.HybridSequential()
+    f2.add(nn.MaxPool2D(2), nn.Conv2D(16, 3, padding=1),
+           nn.Activation("relu"))
+    for f in (f1, f2):
+        f.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2))
+    return f1, f2
+
+
+def gram(feat):
+    b, c, h, w = feat.shape
+    flat = mx.nd.reshape(feat, shape=(c, h * w))
+    return mx.nd.dot(flat, flat.T) / (c * h * w)
+
+
+def main():
+    np.random.seed(0)
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    # content: a centered bright square; style: diagonal stripes
+    content = np.zeros((1, 3, HW, HW), "f")
+    content[:, :, 6:18, 6:18] = 1.0
+    style = np.tile((np.add.outer(np.arange(HW), np.arange(HW)) % 6 < 3)
+                    .astype("f"), (1, 3, 1, 1))
+
+    f1, f2 = make_extractor()
+    c_nd, s_nd = mx.nd.array(content), mx.nd.array(style)
+    with mx.autograd.pause():
+        content_feat = f1(c_nd)
+        s1 = f1(s_nd)
+        style_grams = [gram(s1), gram(f2(s1))]
+
+    img = mx.nd.array(rng.uniform(0, 1, content.shape).astype("f"))
+    img.attach_grad()
+    losses = []
+    for step in range(200):
+        with mx.autograd.record():
+            feats = [f1(img)]
+            feats.append(f2(feats[0]))
+            closs = mx.nd.mean(mx.nd.square(feats[0] - content_feat))
+            sloss = sum(mx.nd.mean(mx.nd.square(gram(f) - g))
+                        for f, g in zip(feats, style_grams))
+            # total-variation smoothing, the nstyle.py regularizer
+            tv = mx.nd.mean(mx.nd.square(
+                img[:, :, 1:, :] - img[:, :, :-1, :])) + \
+                mx.nd.mean(mx.nd.square(
+                    img[:, :, :, 1:] - img[:, :, :, :-1]))
+            loss = closs + 20.0 * sloss + 0.1 * tv
+        loss.backward()
+        img._data = (img - 8.0 * img.grad)._data
+        img.grad._data = np.zeros_like(content)
+        losses.append(float(loss.asscalar()))
+    print("style+content loss: %.4f -> %.4f" % (losses[0], losses[-1]))
+    assert losses[-1] < losses[0] * 0.4, (losses[0], losses[-1])
+    print("neural_style_toy OK")
+
+
+if __name__ == "__main__":
+    main()

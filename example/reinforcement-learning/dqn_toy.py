@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Toy DQN (reference example/reinforcement-learning/dqn: Q-network +
+target network + replay buffer + epsilon-greedy, dqn_run_test.py's
+training loop shape) on an inline 1-D gridworld — no gym in this
+environment, so the env is 8 cells with a goal at the right edge;
+optimal return is reachable in a handful of steps.
+
+Run: JAX_PLATFORMS=cpu python example/reinforcement-learning/dqn_toy.py
+"""
+from __future__ import annotations
+
+import collections
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+import mxtpu as mx  # noqa: E402
+from mxtpu import gluon  # noqa: E402
+from mxtpu.gluon import nn  # noqa: E402
+
+N_CELLS = 8
+ACTIONS = 2            # left / right
+GAMMA = 0.9
+
+
+class Walk1D:
+    """Start at cell 1; +1 reward at the right edge, episode ends at
+    either edge or after 20 steps."""
+
+    def reset(self):
+        self.pos = 1
+        self.t = 0
+        return self._obs()
+
+    def _obs(self):
+        v = np.zeros(N_CELLS, "f")
+        v[self.pos] = 1.0
+        return v
+
+    def step(self, action):
+        self.t += 1
+        self.pos += 1 if action == 1 else -1
+        done = self.pos <= 0 or self.pos >= N_CELLS - 1 or self.t >= 20
+        reward = 1.0 if self.pos >= N_CELLS - 1 else 0.0
+        return self._obs(), reward, done
+
+
+def build_qnet():
+    net = nn.Sequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(ACTIONS))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def copy_params(src, dst):
+    for ps, pd in zip(src.collect_params().values(),
+                      dst.collect_params().values()):
+        pd.set_data(ps.data())
+
+
+def main():
+    random.seed(0)
+    np.random.seed(0)
+    mx.random.seed(0)
+    env = Walk1D()
+    qnet, target = build_qnet(), build_qnet()
+    qnet(mx.nd.array(np.zeros((1, N_CELLS), "f")))
+    target(mx.nd.array(np.zeros((1, N_CELLS), "f")))
+    copy_params(qnet, target)
+    trainer = gluon.Trainer(qnet.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.L2Loss()
+    replay = collections.deque(maxlen=2000)
+    eps = 1.0
+    returns = []
+    for episode in range(150):
+        obs = env.reset()
+        total = 0.0
+        done = False
+        while not done:
+            if random.random() < eps:
+                action = random.randrange(ACTIONS)
+            else:
+                q = qnet(mx.nd.array(obs[None])).asnumpy()[0]
+                action = int(q.argmax())
+            nxt, reward, done = env.step(action)
+            replay.append((obs, action, reward, nxt, done))
+            obs = nxt
+            total += reward
+            if len(replay) >= 64:
+                batch = random.sample(replay, 32)
+                s = mx.nd.array(np.stack([b[0] for b in batch]))
+                a = np.array([b[1] for b in batch])
+                r = np.array([b[2] for b in batch], "f")
+                s2 = mx.nd.array(np.stack([b[3] for b in batch]))
+                d = np.array([b[4] for b in batch], "f")
+                q2 = target(s2).asnumpy().max(axis=1)
+                y = mx.nd.array(r + GAMMA * (1 - d) * q2)
+                with mx.autograd.record():
+                    q = qnet(s)
+                    qa = mx.nd.pick(q, mx.nd.array(a.astype("f")), axis=1)
+                    loss = loss_fn(qa, y)
+                loss.backward()
+                trainer.step(32)
+        eps = max(0.05, eps * 0.97)
+        returns.append(total)
+        if episode % 25 == 0:
+            copy_params(qnet, target)
+    late = float(np.mean(returns[-30:]))
+    print("mean return (last 30 episodes): %.2f" % late)
+    assert late > 0.85, late
+    # the learned greedy policy walks straight to the goal
+    obs = env.reset()
+    for _ in range(N_CELLS):
+        q = qnet(mx.nd.array(obs[None])).asnumpy()[0]
+        obs, reward, done = env.step(int(q.argmax()))
+        if done:
+            break
+    assert reward == 1.0, "greedy policy failed to reach the goal"
+    print("dqn_toy OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,9 +22,12 @@ LIST_MAGIC = 0x112
 V1_MAGIC = 0xF993FAC8
 V2_MAGIC = 0xF993FAC9
 
-# mshadow type codes (include/mxnet/base.h TypeFlag)
-_DTYPES = [_np.float32, _np.float64, _np.float16, _np.uint8, _np.int32,
-           _np.int8, _np.int64]
+# mshadow type codes (include/mxnet/base.h TypeFlag) — the reference
+# understands codes 0..6 only; derived from the framework's single
+# dtype table so the two can't drift
+from .base import ID_TO_DTYPE as _ID_TO_DTYPE
+
+_DTYPES = [_ID_TO_DTYPE[i] for i in range(7)]
 
 __all__ = ["is_legacy_params", "load_legacy_params", "save_legacy_params"]
 
@@ -84,6 +87,8 @@ def _read_one(r):
         r.i32()  # ctx dev_type — everything loads to host here
         r.i32()  # ctx dev_id
         type_flag = r.i32()
+        if not 0 <= type_flag < len(_DTYPES):
+            raise ValueError("bad dtype code %d" % type_flag)
         aux = []
         for _ in range(nad):
             aux_type = r.i32()
@@ -98,6 +103,8 @@ def _read_one(r):
             return data
         aux_arrays = []
         for aux_type, aux_shape in aux:
+            if not 0 <= aux_type < len(_DTYPES):
+                raise ValueError("bad aux dtype code %d" % aux_type)
             adt = _np.dtype(_DTYPES[aux_type])
             an = int(_np.prod(aux_shape)) if aux_shape else 0
             aux_arrays.append(_np.frombuffer(
@@ -118,6 +125,8 @@ def _read_one(r):
     r.i32()
     r.i32()
     type_flag = r.i32()
+    if not 0 <= type_flag < len(_DTYPES):
+        raise ValueError("bad dtype code %d" % type_flag)
     dt = _np.dtype(_DTYPES[type_flag])
     n = int(_np.prod(shape))
     return _np.frombuffer(r.raw(n * dt.itemsize), dt).reshape(shape)
@@ -170,7 +179,14 @@ def save_legacy_params(path, data, dims_dtype=_np.uint32):
             _np.asarray(shape, dims_dtype).tobytes()
 
     def dtype_code(dt):
-        return [_np.dtype(d) for d in _DTYPES].index(_np.dtype(dt))
+        dt = _np.dtype(dt)
+        for i, d in enumerate(_DTYPES):
+            if _np.dtype(d) == dt:
+                return i
+        raise TypeError(
+            "the reference .params format cannot represent dtype %s; "
+            "cast the array first (e.g. .astype('float32') for "
+            "bfloat16 weights)" % dt)
 
     out = [struct.pack("<QQ", LIST_MAGIC, 0), struct.pack("<Q",
                                                           len(arrays))]

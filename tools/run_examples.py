@@ -42,6 +42,10 @@ EXAMPLES = [
     ("rcnn/train_rcnn_toy.py", {}),
     ("fcn-xs/fcn_toy.py", {}),
     ("speech_recognition/deepspeech_toy.py", {}),
+    ("neural-style/neural_style_toy.py", {}),
+    ("reinforcement-learning/dqn_toy.py", {}),
+    ("captcha/captcha_toy.py", {}),
+    ("dsd/dsd_toy.py", {}),
 ]
 
 
