@@ -1615,7 +1615,13 @@ int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
   auto *h = static_cast<SymbolH *>(symbol);
   PyObject *res = icall("symbol_get_name", "(O)", h->obj);
   if (!res) return -1;
-  h->json = PyUnicode_AsUTF8(PyList_GetItem(res, 0));
+  const char *name_utf8 = PyUnicode_AsUTF8(PyList_GetItem(res, 0));
+  if (!name_utf8) {
+    set_error_from_python();
+    Py_DECREF(res);
+    return -1;
+  }
+  h->json = name_utf8;
   *success = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, 1)));
   *out = h->json.c_str();
   Py_DECREF(res);
@@ -1628,7 +1634,13 @@ int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
   auto *h = static_cast<SymbolH *>(symbol);
   PyObject *res = icall("symbol_get_attr", "(Os)", h->obj, key);
   if (!res) return -1;
-  h->json = PyUnicode_AsUTF8(PyList_GetItem(res, 0));
+  const char *attr_utf8 = PyUnicode_AsUTF8(PyList_GetItem(res, 0));
+  if (!attr_utf8) {
+    set_error_from_python();
+    Py_DECREF(res);
+    return -1;
+  }
+  h->json = attr_utf8;
   *success = static_cast<int>(PyLong_AsLong(PyList_GetItem(res, 1)));
   *out = h->json.c_str();
   Py_DECREF(res);

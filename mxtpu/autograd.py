@@ -240,6 +240,8 @@ def _write_grad(arr, grads):
             tgt._aux = None  # summed value: metadata recomputes lazily
     else:
         tgt._data = g
+        if hasattr(tgt, "_aux"):
+            tgt._aux = None  # replaced value: metadata recomputes lazily
 
 
 def _accum(grads, arr, value):

@@ -133,6 +133,12 @@ class KVStore:
                 # compact stores accept only compact pushes
                 # (_assign_value raises a pointed error otherwise)
                 self._store[k]._assign_value(merged)
+            elif isinstance(merged, CompactRowSparseNDArray):
+                raise TypeError(
+                    "push of a compact row_sparse gradient into a "
+                    "non-compact store would install the (nnz_max, row) "
+                    "buffer as the full value; initialise the key with a "
+                    "CompactRowSparseNDArray or set an updater")
             else:
                 self._store[k]._data = merged._data
 

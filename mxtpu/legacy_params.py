@@ -219,8 +219,17 @@ def save_legacy_params(path, data, dims_dtype=_np.uint32):
                     struct.pack("<i", 6), tshape(idx.shape),
                     values.tobytes(), indptr.tobytes(), idx.tobytes()]
             continue
-        host = _np.ascontiguousarray(_np.asarray(
-            a.asnumpy() if hasattr(a, "asnumpy") else a))
+        host = _np.asarray(a.asnumpy() if hasattr(a, "asnumpy") else a)
+        if host.ndim == 0:
+            # an empty shape means "uninitialized NDArray" to the reference
+            # reader (shape.is_none() early return, ndarray.cc:1515-), so a
+            # scalar's payload cannot be represented; writing ctx/dtype/data
+            # anyway would desync every later array in the stream
+            raise TypeError(
+                "cannot save a zero-dim array in the reference .params "
+                "format (empty shape means uninitialized there); reshape "
+                "to (1,) first")
+        host = _np.ascontiguousarray(host)
         out += [struct.pack("<I", V2_MAGIC),
                 struct.pack("<i", 0),           # dense storage
                 tshape(host.shape),

@@ -118,3 +118,15 @@ def test_predict_bytes_path_reads_legacy():
     blob = lp.save_legacy_params(None, {"w": mx.nd.ones((2, 2))})
     out = load_from_bytes(blob)
     np.testing.assert_array_equal(out["w"].asnumpy(), np.ones((2, 2)))
+
+
+def test_zero_dim_array_save_refused(tmp_path):
+    """An empty shape means "uninitialized" to the reference reader
+    (NDArray::Load is_none() early return), so a scalar payload is
+    unrepresentable: saving one must raise, not desync the stream or
+    silently drop the value."""
+    path = str(tmp_path / "z.params")
+    with pytest.raises(TypeError, match="zero-dim"):
+        lp.save_legacy_params(path, {
+            "scalar": np.float32(0.01),
+            "after": np.arange(6, dtype="f").reshape(2, 3)})

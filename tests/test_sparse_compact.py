@@ -232,3 +232,16 @@ def test_stateful_optimizer_on_compact_weight_refused():
                            rescale_grad=1.0)
     with pytest.raises(NotImplementedError, match="full table lives"):
         opt.update(0, w, g, opt.create_state(0, w))
+
+
+def test_kvstore_compact_push_into_dense_store_refused():
+    """A compact gradient pushed at a dense-initialised key without an
+    updater must raise instead of installing the (nnz_max, row) buffer
+    as the store's full value (pull already guards the mirror case)."""
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((50, 4)))
+    g = sparse.compact_row_sparse_array(
+        (np.ones((2, 4), "f"), np.array([3, 7])), shape=(50, 4),
+        nnz_max=8)
+    with pytest.raises(TypeError):
+        kv.push("w", g)
