@@ -17,20 +17,18 @@ scheduler. The parameter-server *capability* (server-side optimizer via
 set_optimizer) is kept: the updater runs where the store lives, which on
 TPU is simply the device copy of the weights.
 
-**dist_async behavior statement** (asserted by tests/nightly/
-dist_worker.py): in the reference, 'dist_async' relaxes 'dist_sync' by
-letting the ps-lite server apply each worker's push immediately
-(kvstore_dist_server.h:339,462), trading gradient staleness for hiding
-parameter-server round-trip latency. The SPMD/XLA runtime has no server
-and no per-key round-trips — cross-host reduction is a compiled psum over
-ICI/DCN inside the training step — so the latency async exists to hide is
-gone, and ``create('dist_async')`` intentionally executes the same
-synchronous program as ``create('dist_sync')``. This is sound because
-async consistency is a *relaxation*: every synchronous schedule is a legal
-async schedule (staleness 0), so any algorithm correct under dist_async is
-correct here; the updater still runs where the store lives (the
-server-side-update capability), and rank/num_workers reflect the process
-group identically in both modes.
+**dist_async** is a real staleness-tolerant mode (reference
+kvstore_dist_server.h:339,462: the server applies each worker's push
+immediately, no merge barrier): ``create('dist_async')`` returns
+:class:`mxtpu.kvstore_async.AsyncDistKVStore`, a worker connected to a
+host-side parameter service where the optimizer runs the moment a
+gradient arrives. Workers never block on each other — a straggler's
+pushes land stale instead of stalling the fleet — and observed staleness
+is queryable (``staleness_stats()``). The SPMD fused-step path remains
+the synchronous fast path; dist_async exists for reference-style
+push/pull loops that want straggler tolerance
+(tests/nightly/async_worker.py demonstrates progress under an injected
+straggler with staleness > 0).
 """
 from __future__ import annotations
 
@@ -452,6 +450,9 @@ def create(name="local"):
     """Create a KVStore (reference src/kvstore/kvstore.cc:44-72)."""
     if not isinstance(name, string_types):
         raise TypeError("name must be a string")
+    if "async" in name:
+        from .kvstore_async import AsyncDistKVStore
+        return AsyncDistKVStore(name)
     if "dist" in name:
         return DistKVStore(name)
     if name in ("local", "device", "nccl", "local_allreduce_cpu",

@@ -1,0 +1,425 @@
+"""Asynchronous parameter service — the real 'dist_async' mode.
+
+The reference's ``dist_async`` lets the ps-lite server apply each worker's
+push the moment it arrives (``src/kvstore/kvstore_dist_server.h:339,462``
+``DataHandleDefault``: ``if (sync_mode_) merge-then-update else update``),
+with no cross-worker merge barrier. Workers run free: a straggler's pushes
+land late (stale) but never block the fleet. That capability has no SPMD
+analogue — XLA collectives are barriers by construction — so it gets its
+own host-side rendering here:
+
+* :class:`ParameterServer` — a threaded TCP service owning the parameter
+  table (ps-lite's ZeroMQ transport rendered with the standard library:
+  length-prefixed pickle frames, one daemon thread per connection). The
+  optimizer runs server-side the moment a push arrives (the reference's
+  server-side updater, ``kvstore_dist_server.h:150-196``), under a per-key
+  lock; different keys update concurrently.
+* :class:`AsyncDistKVStore` — the worker-side ``create('dist_async')``
+  store. ``push`` ships the locally-merged gradient and returns; ``pull``
+  fetches whatever the table holds right now. No collective, no barrier,
+  no lockstep: workers see each other only through the table.
+
+Staleness is observable, not just implied: every pull carries the key's
+update clock, every push carries the clock the worker last based its step
+on, and the server records ``staleness = clock_now - clock_base`` per
+push (``stats()``/``kv.staleness_stats()``). The nightly straggler test
+(tests/nightly/async_worker.py) asserts fast workers outrun a slow one
+and that observed staleness > 0 — the behavior sync mode cannot produce.
+
+Key sharding across multiple servers mirrors ps-lite's key→server
+assignment (``kvstore_dist.h`` BIGARRAY_BOUND key splits): each key lives
+on ``servers[hash(key) % n]``; servers are independent and never talk to
+each other. ``tools/launch.py -s N`` starts N server processes
+(DMLC_ROLE=server) and exports ``MXTPU_PS_ADDRS`` to every worker.
+
+Single-process use (no launcher env) spins up an in-process server
+thread, so ``create('dist_async')`` is runnable — and genuinely
+asynchronous across threads — everywhere.
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import sys
+import threading
+import time
+import zlib
+
+import numpy as _np
+
+from . import ndarray as nd
+from .kvstore import KVStore, _ctype_key_value, _key_int
+
+
+class _ModuleUnpickler(pickle.Unpickler):
+    """Unpickler that resolves classes through sys.modules before
+    falling back to __import__. The server handler threads run while the
+    ``mxtpu`` package import may still be in progress (the
+    DMLC_ROLE=server hook blocks inside _optional_imports), and a plain
+    ``__import__("mxtpu.optimizer")`` from another thread would wait on
+    the package's _initializing lock forever; already-loaded modules
+    need no import machinery at all."""
+
+    def find_class(self, module, name):
+        m = sys.modules.get(module)
+        if m is not None:
+            return getattr(m, name)
+        return super().find_class(module, name)
+
+__all__ = ["ParameterServer", "AsyncDistKVStore", "serve_forever"]
+
+_LEN = struct.Struct("<Q")
+
+
+def _send_frame(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server = self.server.owner
+        try:
+            while True:
+                msg = _recv_frame(self.request)
+                reply = server._dispatch(msg)
+                _send_frame(self.request, reply)
+                if msg[0] == "stop":
+                    break
+        except (ConnectionError, EOFError, OSError):
+            pass
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ParameterServer:
+    """Host-side async parameter table (reference KVStoreDistServer with
+    ``sync_mode_ == false``, kvstore_dist_server.h:339,462)."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self._tcp = _TCPServer((host, port), _Handler)
+        self._tcp.owner = self
+        self._table = {}           # key -> NDArray (host-side, cpu jax)
+        self._locks = {}           # key -> Lock (per-key serialization)
+        self._locks_guard = threading.Lock()
+        self._clock = {}           # key -> applied-update count
+        self._updater = None
+        self._stale_max = 0
+        self._stale_sum = 0
+        self._stale_n = 0
+        self._barrier_lock = threading.Lock()
+        self._barrier_cv = threading.Condition(self._barrier_lock)
+        self._barrier_gen = 0
+        self._barrier_arrived = 0
+        self._thread = None
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def address(self):
+        h, p = self._tcp.server_address
+        return "%s:%d" % (h, p)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # -- request dispatch -------------------------------------------------
+    def _lock_for(self, key):
+        with self._locks_guard:
+            return self._locks.setdefault(key, threading.Lock())
+
+    def _dispatch(self, msg):
+        cmd = msg[0]
+        if cmd == "init":
+            _, key, value = msg
+            with self._lock_for(key):
+                if key not in self._table:   # first writer wins (rank 0)
+                    self._table[key] = nd.array(value)
+                    self._clock[key] = 0
+            return ("ok",)
+        if cmd == "push":
+            _, key, grad, base_clock = msg
+            with self._lock_for(key):
+                if key not in self._table:
+                    return ("err", "push to uninitialized key %r" % (key,))
+                stale = self._clock[key] - base_clock
+                self._stale_max = max(self._stale_max, stale)
+                self._stale_sum += stale
+                self._stale_n += 1
+                g = nd.array(grad)
+                store = self._table[key]
+                if self._updater is not None:
+                    # async semantics: apply THIS push now, no merge wait
+                    self._updater(_key_int(key), g, store)
+                else:
+                    store._data = store._data + g._data
+                self._clock[key] += 1
+            return ("ok",)
+        if cmd == "pull":
+            _, key = msg
+            with self._lock_for(key):
+                if key not in self._table:
+                    return ("err", "pull of uninitialized key %r" % (key,))
+                return ("ok", self._table[key].asnumpy(), self._clock[key])
+        if cmd == "set_optimizer":
+            _, payload = msg
+            opt = sys.modules.get("mxtpu.optimizer")
+            if opt is None:
+                from . import optimizer as opt
+            optimizer = _ModuleUnpickler(io.BytesIO(payload)).load()
+            self._updater = opt.get_updater(optimizer)
+            return ("ok",)
+        if cmd == "barrier":
+            _, num_workers = msg
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_arrived += 1
+                if self._barrier_arrived >= num_workers:
+                    self._barrier_arrived = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    while self._barrier_gen == gen:
+                        self._barrier_cv.wait(timeout=120)
+            return ("ok",)
+        if cmd == "stats":
+            avg = self._stale_sum / self._stale_n if self._stale_n else 0.0
+            return ("ok", {"staleness_max": self._stale_max,
+                           "staleness_avg": avg,
+                           "pushes": self._stale_n,
+                           "clocks": dict(self._clock)})
+        if cmd == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return ("ok",)
+        return ("err", "unknown command %r" % (cmd,))
+
+
+def serve_forever():
+    """Server-role process entry (DMLC_ROLE=server, started by
+    tools/launch.py -s N). Binds the port given in MXTPU_PS_PORT and
+    blocks until a worker sends 'stop'."""
+    # serve_forever is reached DURING the mxtpu package import (the
+    # kvstore_server role hook fires from _optional_imports) and never
+    # returns — so every module and lazy code path a handler thread will
+    # need must be warmed NOW, in this thread: any import that names the
+    # mxtpu package from another thread blocks on the package's
+    # _initializing lock until an import that never finishes does.
+    from . import optimizer as _opt
+    warm = _opt.get_updater(_opt.SGD(learning_rate=0.01, momentum=0.9,
+                                     wd=1e-4))
+    warm(0, nd.ones((1,)), nd.ones((1,)))
+    port = int(os.environ.get("MXTPU_PS_PORT", "0"))
+    srv = ParameterServer(port=port)
+    srv.start()
+    print("mxtpu parameter server listening on %s" % srv.address,
+          flush=True)
+    srv._thread.join()
+
+
+class _ServerConn:
+    """One worker's connection to one server (thread-safe via a lock —
+    the worker pushes from its training thread only, but keep it safe)."""
+
+    def __init__(self, addr, connect_timeout=60.0):
+        host, _, port = addr.partition(":")
+        # the launcher starts servers and workers simultaneously and a
+        # server binds only after its (slow) mxtpu import + updater
+        # warm-up — on localhost an unbound port refuses instantly, so
+        # retry with backoff instead of failing the whole launch
+        deadline = time.time() + connect_timeout
+        delay = 0.1
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=300)
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        self._lock = threading.Lock()
+
+    def request(self, *msg):
+        with self._lock:
+            _send_frame(self._sock, msg)
+            reply = _recv_frame(self._sock)
+        if reply[0] == "err":
+            raise RuntimeError("parameter server: %s" % reply[1])
+        return reply
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class AsyncDistKVStore(KVStore):
+    """Worker-side 'dist_async' store (reference KVStoreDist with
+    sync_mode off). push/pull go to the parameter service; there are no
+    collectives and no lockstep across workers."""
+
+    def __init__(self, kv_type="dist_async"):
+        super().__init__(kv_type)
+        self._rank = int(os.environ.get(
+            "MXTPU_PROC_ID", os.environ.get("DMLC_WORKER_ID", "0")))
+        self._size = int(os.environ.get(
+            "MXTPU_NUM_PROCS", os.environ.get("DMLC_NUM_WORKER", "1")))
+        addrs = os.environ.get("MXTPU_PS_ADDRS", "")
+        self._own_server = None
+        if not addrs:
+            # single-process: host the table in-process so the mode is
+            # runnable (and truly async across threads) without a launcher
+            self._own_server = ParameterServer().start()
+            addrs = self._own_server.address
+        self._conns = [_ServerConn(a.strip())
+                       for a in addrs.split(",") if a.strip()]
+        self._base_clock = {}      # key -> clock of the last pull
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def _conn(self, key):
+        # deterministic cross-process key->server assignment (builtin
+        # hash() is salted per process; every worker must agree, like
+        # ps-lite's static key ranges)
+        digest = zlib.crc32(str(key).encode("utf-8"))
+        return self._conns[digest % len(self._conns)]
+
+    # -- core -------------------------------------------------------------
+    def init(self, key, value):
+        # reference KVStoreDist::InitImpl: rank 0's value is pushed to the
+        # servers, then EVERY worker barriers — so a pull after init never
+        # races the table creation
+        keys, vals = _ctype_key_value(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            if self._rank == 0:
+                self._conn(k).request("init", k, v.asnumpy())
+            self._base_clock[k] = 0
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                if len(v) > 1:
+                    v = [self._maybe_compress(k, i, a)
+                         for i, a in enumerate(v)]
+                merged = v[0].copy()
+                for arr in v[1:]:
+                    merged._data = merged._data + arr._data
+            else:
+                merged = v
+            self._conn(k).request("push", k, merged.asnumpy(),
+                                  self._base_clock.get(k, 0))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, o in zip(keys, outs):
+            _, value, clock = self._conn(k).request("pull", k)
+            self._base_clock[k] = clock
+            arr = nd.array(value)
+            for tgt in (o if isinstance(o, (list, tuple)) else [o]):
+                tgt._data = arr._data
+    # row_sparse_pull: inherited dense fallback is NOT available —
+    # the table lives server-side; async sparse pulls are out of scope
+    # (the reference's async mode is likewise dense-only in practice).
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise NotImplementedError(
+            "dist_async is a dense parameter service; use dist_sync for "
+            "row_sparse tables")
+
+    # -- optimizer --------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Serialize the optimizer to every server (reference kvstore.py
+        set_optimizer: rank 0 sends command 0 with the pickled optimizer;
+        other ranks only note it locally). Barriers afterwards so no
+        worker's push can beat the updater installation."""
+        if self._rank == 0:
+            payload = pickle.dumps(optimizer,
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+            for c in self._conns:
+                c.request("set_optimizer", payload)
+        self._optimizer = optimizer
+        # updater runs server-side; worker must NOT also apply it
+        self._updater = None
+        self.barrier()
+
+    def set_updater(self, updater):
+        # A worker-side updater would double-apply on top of the server's.
+        # The reference ignores set_updater for dist stores (updater_ is
+        # only consulted server-side); match that.
+        self._updater = None
+
+    # -- coordination -----------------------------------------------------
+    def barrier(self):
+        super().barrier()
+        self._conns[0].request("barrier", self._size)
+
+    def staleness_stats(self):
+        """Aggregated staleness evidence from every server: max/avg
+        staleness and per-key clocks. max > 0 is the observable proof
+        that updates interleaved asynchronously."""
+        agg = {"staleness_max": 0, "staleness_avg": 0.0, "pushes": 0,
+               "clocks": {}}
+        total_w = 0.0
+        for c in self._conns:
+            _, s = c.request("stats")
+            agg["staleness_max"] = max(agg["staleness_max"],
+                                       s["staleness_max"])
+            agg["pushes"] += s["pushes"]
+            total_w += s["staleness_avg"] * s["pushes"]
+            agg["clocks"].update(s["clocks"])
+        if agg["pushes"]:
+            agg["staleness_avg"] = total_w / agg["pushes"]
+        return agg
+
+    def close(self):
+        for c in self._conns:
+            c.close()
+        if self._own_server is not None:
+            self._own_server.stop()
+            self._own_server = None
+
+
+if __name__ == "__main__":
+    serve_forever()

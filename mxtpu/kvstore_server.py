@@ -1,17 +1,26 @@
 """Parameter-server role loop (reference python/mxnet/kvstore_server.py).
 
 In the reference, ``tools/launch.py`` starts scheduler/server/worker
-processes; server processes enter ``KVStoreServer.run`` which blocks on
-ps-lite handlers and applies the optimizer that workers serialize over
-(``src/kvstore/kvstore_dist_server.h:150-196``).
+processes running the SAME user script; ps-lite inspects ``DMLC_ROLE``
+and server processes block in ``KVStoreServer.run`` applying pushes with
+the optimizer workers serialize over (``src/kvstore/
+kvstore_dist_server.h:150-196``), then exit.
 
-TPU-native distributed training is SPMD over ``jax.distributed`` — every
-process is a worker and optimizer updates are sharded, so there is no
-separate server role to run. The API is kept so launch scripts written
-against the reference work unchanged: a ``server``/``scheduler`` role
-process enters :func:`_init_kvstore_server_module`, logs that the role is
-subsumed, and exits cleanly instead of deadlocking a fleet that expects
-the process to terminate.
+mxtpu keeps both halves of that contract:
+
+* **dist_sync** is SPMD over ``jax.distributed`` — every process is a
+  worker, optimizer updates are sharded, no server role is needed.
+* **dist_async** has a real host-side parameter service
+  (:mod:`mxtpu.kvstore_server`'s sibling :mod:`mxtpu.kvstore_async`):
+  a process launched with ``DMLC_ROLE=server`` and ``MXTPU_PS_PORT`` set
+  blocks here serving the async table — exactly the reference's server
+  lifecycle — and exits when a worker sends 'stop' or the launcher
+  terminates it.
+
+A server-role process with no ``MXTPU_PS_PORT`` (a sync-mode launch that
+passed ``-s N`` out of reference habit) logs that the role is subsumed
+and exits cleanly instead of deadlocking a fleet that expects it to
+terminate.
 """
 from __future__ import annotations
 
@@ -19,8 +28,6 @@ import logging
 import os
 import pickle
 import sys
-
-from . import kvstore as kvs
 
 __all__ = ["KVStoreServer", "_init_kvstore_server_module"]
 
@@ -55,17 +62,28 @@ class KVStoreServer:
         return server_controller
 
     def run(self):
-        """Reference: blocks in ps-lite until shutdown. Here the optimizer
-        runs sharded on the workers, so the server loop returns at once."""
-        logging.info("kvstore server role is subsumed by SPMD sharded "
-                     "optimizer updates; returning")
+        """Reference: blocks in ps-lite until shutdown. Async mode blocks
+        in the parameter service; sync mode has no server work to do."""
+        if os.environ.get("MXTPU_PS_PORT"):
+            from . import kvstore_async
+            kvstore_async.serve_forever()
+        else:
+            logging.info("kvstore server role is subsumed by SPMD sharded "
+                         "optimizer updates; returning")
 
 
 def _init_kvstore_server_module():
     """Process entry for DMLC_ROLE=server|scheduler launches (reference
     checks is_worker via ps-lite; we read the launcher's env directly)."""
     role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server" and os.environ.get("MXTPU_PS_PORT"):
+        # the async parameter service: block until shutdown (reference
+        # server lifecycle), then exit so the launcher can reap us
+        from . import kvstore_async
+        kvstore_async.serve_forever()
+        sys.exit(0)
     if role in ("server", "scheduler"):
+        from . import kvstore as kvs
         store = kvs.create("dist")
         server = KVStoreServer(store)
         server.run()

@@ -1,0 +1,85 @@
+"""dist_async straggler demonstration (reference async mode,
+src/kvstore/kvstore_dist_server.h:339,462: servers apply pushes
+immediately, workers never wait for each other).
+
+Launched by tools/launch.py -n 3 -s 2 --launcher local. Every worker runs
+independent SGD-through-the-server steps on the same least-squares
+problem for a fixed wall-time budget; rank 0 is an injected straggler
+(sleeps each step). Asserts the three properties sync mode cannot
+produce:
+
+1. progress under the straggler — fast workers complete several times
+   more pushes than the straggler in the same wall time;
+2. observed gradient staleness > 0 (server-side clocks);
+3. the model still converges (stale-gradient SGD on a convex problem).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx                                           # noqa: E402
+
+rank = int(os.environ["MXTPU_PROC_ID"])
+nproc = int(os.environ["MXTPU_NUM_PROCS"])
+out_dir = os.environ["ASYNC_TEST_DIR"]
+
+kv = mx.kv.create("dist_async")
+assert kv.type == "dist_async"
+assert kv.rank == rank and kv.num_workers == nproc
+
+# init broadcasts rank 0's value and barriers internally (reference
+# KVStoreDist::InitImpl); set_optimizer installs the server-side updater
+# from rank 0 and barriers before any push can race it
+wt = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+kv.init("w", mx.nd.zeros((4,)))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+
+rng = np.random.RandomState(1234 + rank)    # different data per worker
+X = rng.standard_normal((256, 4)).astype(np.float32)
+y = X @ wt
+
+w = mx.nd.zeros((4,))
+deadline = time.time() + 6.0
+pushes = 0
+while time.time() < deadline:
+    kv.pull("w", out=w)
+    wn = w.asnumpy()
+    i = np.random.randint(0, 256 - 32)
+    Xb, yb = X[i:i + 32], y[i:i + 32]
+    g = 2 * Xb.T @ (Xb @ wn - yb) / 32
+    kv.push("w", mx.nd.array(g))
+    pushes += 1
+    if rank == 0:
+        time.sleep(0.05)        # the injected straggler
+
+with open(os.path.join(out_dir, "rank%d.json" % rank), "w") as f:
+    json.dump({"rank": rank, "pushes": pushes}, f)
+
+# all workers drain before reading global stats / final weights
+kv.barrier()
+
+if rank == 0:
+    stats = kv.staleness_stats()
+    kv.pull("w", out=w)
+    final = w.asnumpy()
+    counts = {}
+    for r in range(nproc):
+        with open(os.path.join(out_dir, "rank%d.json" % r)) as f:
+            counts[r] = json.load(f)["pushes"]
+    fast = min(counts[r] for r in range(1, nproc))
+    assert fast >= 3 * counts[0], \
+        "straggler blocked the fleet: %r" % (counts,)
+    assert stats["staleness_max"] > 0, stats
+    err = float(np.abs(final - wt).max())
+    assert err < 0.15, (final, wt, err)
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump({"counts": counts, "staleness": stats,
+                   "final_err": err}, f)
+print("RANK_%d_OK" % rank, flush=True)

@@ -42,3 +42,38 @@ def test_local_launcher_dist_training(nproc):
     assert proc.returncode == 0, out[-2000:]
     for r in range(nproc):
         assert "RANK_%d_OK" % r in out, out[-2000:]
+
+
+def test_local_launcher_dist_async_straggler(tmp_path):
+    """dist_async through the launcher with real server processes
+    (-s 2): fast workers outrun an injected straggler, observed
+    staleness > 0, and stale-gradient SGD still converges
+    (tests/nightly/async_worker.py asserts all three)."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ASYNC_TEST_DIR"] = str(tmp_path)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "3", "-s", "2", "--launcher", "local",
+         "--port", str(_free_port()),
+         sys.executable + " " + os.path.join(root, "tests", "nightly",
+                                             "async_worker.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.communicate()
+        raise
+    assert proc.returncode == 0, out[-2000:]
+    for r in range(3):
+        assert "RANK_%d_OK" % r in out, out[-2000:]
+    import json
+    with open(tmp_path / "summary.json") as f:
+        summary = json.load(f)
+    assert summary["staleness"]["staleness_max"] > 0
+    assert summary["final_err"] < 0.15

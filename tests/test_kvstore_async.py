@@ -1,0 +1,174 @@
+"""dist_async parameter service (reference async mode,
+src/kvstore/kvstore_dist_server.h:339,462: pushes applied immediately
+server-side, no merge barrier — staleness traded for straggler
+tolerance). Fast in-process tier; the multi-process straggler
+demonstration is tests/nightly/async_worker.py via the local launcher."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.kvstore_async import AsyncDistKVStore, ParameterServer
+
+
+def test_create_returns_async_store():
+    kv = mx.kv.create("dist_async")
+    try:
+        assert isinstance(kv, AsyncDistKVStore)
+        assert kv.type == "dist_async"
+    finally:
+        kv.close()
+
+
+def test_server_side_optimizer_applies_each_push():
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.init(3, mx.nd.zeros((2, 3)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+        kv.push(3, mx.nd.ones((2, 3)))
+        kv.push(3, mx.nd.ones((2, 3)))
+        out = mx.nd.zeros((2, 3))
+        kv.pull(3, out=out)
+        # two sequential updates, each applied on arrival: w = 0 - .5 - .5
+        np.testing.assert_allclose(out.asnumpy(), -np.ones((2, 3)))
+        assert kv.staleness_stats()["pushes"] == 2
+    finally:
+        kv.close()
+
+
+def test_push_without_updater_accumulates():
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.init("a", mx.nd.array(np.arange(4, dtype="f")))
+        kv.push("a", mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull("a", out=out)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.arange(4, dtype="f") + 1)
+    finally:
+        kv.close()
+
+
+def test_list_push_merges_locally_before_send():
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.init("k", mx.nd.zeros((3,)))
+        kv.push("k", [mx.nd.ones((3,)), mx.nd.ones((3,)) * 2])
+        out = mx.nd.zeros((3,))
+        kv.pull("k", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 3 * np.ones(3))
+        # one wire push for the merged device shards
+        assert kv.staleness_stats()["clocks"]["k"] == 1
+    finally:
+        kv.close()
+
+
+def test_uninitialized_key_errors():
+    kv = mx.kv.create("dist_async")
+    try:
+        with pytest.raises(RuntimeError, match="uninitialized"):
+            kv.push("missing", mx.nd.ones((2,)))
+        with pytest.raises(RuntimeError, match="uninitialized"):
+            kv.pull("missing", out=mx.nd.zeros((2,)))
+        with pytest.raises(NotImplementedError):
+            kv.row_sparse_pull("missing", out=mx.nd.zeros((2,)),
+                               row_ids=mx.nd.array([0]))
+    finally:
+        kv.close()
+
+
+def _worker_env(addr, rank, nproc):
+    return {"MXTPU_PS_ADDRS": addr, "MXTPU_PROC_ID": str(rank),
+            "MXTPU_NUM_PROCS": str(nproc)}
+
+
+def _patched_env(env):
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    return saved
+
+
+def _restore_env(saved):
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def test_concurrent_workers_interleave_with_staleness():
+    """Two 'workers' (threads with their own stores/clocks) against one
+    shared server: pushes interleave without any barrier and the server
+    observes staleness > 0 — the async property itself."""
+    server = ParameterServer().start()
+    stores = []
+    try:
+        saved = _patched_env(_worker_env(server.address, 0, 2))
+        try:
+            kv0 = mx.kv.create("dist_async")
+            stores.append(kv0)
+            os.environ["MXTPU_PROC_ID"] = "1"
+            kv1 = mx.kv.create("dist_async")
+            stores.append(kv1)
+        finally:
+            _restore_env(saved)
+        # manual init: barrier needs both workers, run init concurrently
+        t = threading.Thread(
+            target=lambda: kv1.init("w", mx.nd.zeros((4,))))
+        t.start()
+        kv0.init("w", mx.nd.zeros((4,)))
+        t.join()
+
+        n_steps = {0: 40, 1: 40}
+        def run(kv, rank):
+            w = mx.nd.zeros((4,))
+            for _ in range(n_steps[rank]):
+                kv.pull("w", out=w)
+                kv.push("w", mx.nd.ones((4,)) * 0.01)
+        th = [threading.Thread(target=run, args=(kv, r))
+              for r, kv in enumerate(stores)]
+        for x in th:
+            x.start()
+        for x in th:
+            x.join()
+        stats = stores[0].staleness_stats()
+        assert stats["pushes"] == 80
+        assert stats["staleness_max"] > 0, stats
+        out = mx.nd.zeros((4,))
+        stores[0].pull("w", out=out)
+        # no updater: every push accumulated exactly once, stale or not
+        np.testing.assert_allclose(out.asnumpy(), 0.01 * 80 * np.ones(4),
+                                   rtol=1e-5)
+    finally:
+        for kv in stores:
+            kv.close()
+        server.stop()
+
+
+def test_key_sharding_across_servers():
+    s1, s2 = ParameterServer().start(), ParameterServer().start()
+    saved = _patched_env(_worker_env(
+        s1.address + "," + s2.address, 0, 1))
+    try:
+        kv = mx.kv.create("dist_async")
+        keys = ["k%d" % i for i in range(8)]
+        for k in keys:
+            kv.init(k, mx.nd.ones((2,)))
+            kv.push(k, mx.nd.ones((2,)))
+        # every key landed on exactly one server; union covers all keys
+        c1 = s1._clock
+        c2 = s2._clock
+        assert not (set(c1) & set(c2))
+        assert set(c1) | set(c2) == set(keys)
+        out = mx.nd.zeros((2,))
+        for k in keys:
+            kv.pull(k, out=out)
+            np.testing.assert_allclose(out.asnumpy(), 2 * np.ones(2))
+        kv.close()
+    finally:
+        _restore_env(saved)
+        s1.stop()
+        s2.stop()

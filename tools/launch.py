@@ -25,10 +25,38 @@ import subprocess
 import sys
 
 
+def _free_port(preferred):
+    """preferred if bindable, else an OS-assigned free port — a silent
+    EADDRINUSE in a server child would surface only as late
+    connection-refused errors in whatever workers hash to it."""
+    import socket
+    for port in (preferred, 0):
+        try:
+            with socket.socket() as s:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", port))
+                return s.getsockname()[1]
+        except OSError:
+            continue
+    return preferred
+
+
 def launch_local(args, command):
     procs = []
     base_env = dict(os.environ)
     coordinator = "127.0.0.1:%d" % args.port
+    # -s N starts N async parameter-server processes (DMLC_ROLE=server;
+    # reference dmlc-tracker starts ps-lite servers the same way); workers
+    # find them via MXTPU_PS_ADDRS for create('dist_async')
+    server_procs = []
+    ps_addrs = []
+    for s in range(args.num_servers):
+        ps_port = _free_port(args.port + 1 + s)
+        env = dict(base_env, DMLC_ROLE="server",
+                   MXTPU_PS_PORT=str(ps_port), JAX_PLATFORMS="cpu")
+        server_procs.append(subprocess.Popen(
+            [sys.executable, "-m", "mxtpu.kvstore_async"], env=env))
+        ps_addrs.append("127.0.0.1:%d" % ps_port)
     for rank in range(args.num_workers):
         env = dict(base_env)
         env.update({
@@ -41,6 +69,8 @@ def launch_local(args, command):
             "DMLC_NUM_SERVER": str(args.num_servers),
             "DMLC_WORKER_ID": str(rank),
         })
+        if ps_addrs:
+            env["MXTPU_PS_ADDRS"] = ",".join(ps_addrs)
         procs.append(subprocess.Popen(command, shell=True, env=env))
     code = 0
     try:
@@ -51,6 +81,9 @@ def launch_local(args, command):
         for p in procs:
             p.send_signal(signal.SIGTERM)
         code = 1
+    finally:
+        for p in server_procs:
+            p.send_signal(signal.SIGTERM)
     return code
 
 
