@@ -28,7 +28,7 @@ from .. import autograd as _ag
 from ..ops.registry import get_op, list_ops, next_rng_key
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
-           "eye", "concatenate", "save", "load", "waitall", "imports"]
+           "eye", "concatenate", "save", "load", "waitall"]
 
 
 def _jax_dtype(dtype):
@@ -364,6 +364,8 @@ def _invoke_impl(op, inputs, params):
                               outputs=out_nd, rng_key=rng_key)
         _ag._tape_append(entry)
     nuser = op.user_outputs
+    if callable(nuser):
+        nuser = nuser(call_params)
     if nuser is not None and nuser < len(out_nd):
         out_nd = out_nd[:nuser]
     return out_nd[0] if len(out_nd) == 1 else out_nd
@@ -576,10 +578,6 @@ def load(fname):
         return _from_legacy(*load_legacy_params(path))
     with _np.load(path, allow_pickle=False) as z:
         return _unpack_loaded(z)
-
-
-def imports(*a, **k):
-    raise NotImplementedError
 
 
 # sparse storage lives in a sibling module (imported last: it subclasses

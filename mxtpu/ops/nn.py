@@ -112,7 +112,22 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     stride = _pair(stride, nsp) if stride else (1,) * nsp
     dilate = _pair(dilate, nsp) if dilate else (1,) * nsp
     pad = _pair(pad, nsp) if pad else (0,) * nsp
+    adj = _pair(adj, nsp) if adj else (0,) * nsp
     kernel = _pair(kernel, nsp) if kernel else weight.shape[2:]
+    if target_shape:
+        # reference InferPad (deconvolution-inl.h): an explicit
+        # target_shape overrides pad AND adj — out = (in-1)*s - 2p
+        # + k_eff + adj solved for (p, adj) with adj in {0, 1}
+        target_shape = _pair(target_shape, nsp)
+        pad_l, adj_l = [], []
+        for i in range(nsp):
+            k_eff = (kernel[i] - 1) * dilate[i] + 1
+            excess = (data.shape[2 + i] - 1) * stride[i] + k_eff \
+                - target_shape[i]
+            p = (excess + 1) // 2
+            pad_l.append(p)
+            adj_l.append(2 * p - excess)
+        pad, adj = tuple(pad_l), tuple(adj_l)
     # Transposed conv = gradient of conv w.r.t. its input: use
     # conv_general_dilated with lhs_dilation (fractional stride).
     # Flip spatial dims of the kernel and swap in/out channels.
@@ -122,7 +137,7 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     pads = []
     for i in range(nsp):
         k = (kernel[i] - 1) * dilate[i]
-        pads.append((k - pad[i], k - pad[i] + (adj[i] if adj else 0)))
+        pads.append((k - pad[i], k - pad[i] + adj[i]))
     if num_group > 1:
         # grouped deconv: split channels, run per group, concat
         xs = jnp.split(data, num_group, axis=1)
@@ -282,15 +297,19 @@ def _bn_stats(data, axis):
 
 
 @register("BatchNorm", aliases=("batch_norm", "BatchNorm_v1"),
-          num_outputs=3, user_outputs=1, aux_update={3: 1, 4: 2},
-          needs_train_flag=True)
+          num_outputs=5,
+          user_outputs=lambda p: 3 if p.get("output_mean_var") else 1,
+          aux_update={3: 3, 4: 4}, needs_train_flag=True)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
                output_mean_var=False, axis=1, cudnn_off=False,
                _training=False):
     """Reference: src/operator/nn/batch_norm.cc. Returns
-    (out, new_moving_mean, new_moving_var); the runtime writes the moving
-    stats back into the aux arrays (MXNet mutates aux_states in the kernel).
+    (out, mean, var, new_moving_mean, new_moving_var): outputs 1-2 are the
+    statistics the normalization used (batch moments in training, moving
+    stats otherwise), surfaced to the user under output_mean_var=True; the
+    runtime writes outputs 3-4 back into the aux arrays (MXNet mutates
+    aux_states in the kernel).
     """
     axis = axis % data.ndim
     g = jnp.ones_like(gamma) if fix_gamma else gamma
@@ -314,7 +333,8 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     out = (x32 - mean.astype(jnp.float32).reshape(shape)) \
         * inv.reshape(shape) * g.astype(jnp.float32).reshape(shape) \
         + beta.astype(jnp.float32).reshape(shape)
-    return out.astype(data.dtype), new_mm, new_mv
+    return (out.astype(data.dtype), jnp.asarray(mean), jnp.asarray(var),
+            new_mm, new_mv)
 
 
 @register("LayerNorm")

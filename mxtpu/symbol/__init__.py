@@ -485,7 +485,10 @@ def _apply_op(op, name, inputs, params, attrs=None, input_names=()):
         name = _name_mgr.current().get(None, op.name.lower())
     node = _Node(op, name, in_refs, params, attrs, input_names)
     node.num_outputs = _node_num_outputs(op, params)
-    nuser = op.user_outputs or node.num_outputs
+    nuser = op.user_outputs
+    if callable(nuser):
+        nuser = nuser(params)
+    nuser = nuser or node.num_outputs
     return Symbol([(node, i) for i in range(nuser)])
 
 

@@ -173,10 +173,36 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
     return ex.grad_dict
 
 
+# Per-dtype tolerance ladder (reference test_utils.py:1207
+# check_consistency's default tol dict, with a bfloat16 tier added: bf16
+# has 8 mantissa bits => ~2-3 decimal digits, between fp16 and fp32).
+_CONSISTENCY_TOL = {
+    "float16": 1e-1,
+    "bfloat16": 5e-2,
+    "float32": 1e-3,
+    "float64": 1e-5,
+}
+
+
+def _tol_for(dtype, tol=None):
+    name = np.dtype(dtype).name if not isinstance(dtype, str) else dtype
+    if isinstance(tol, dict):
+        for k, v in tol.items():
+            kname = k if isinstance(k, str) else np.dtype(k).name
+            if kname == name:
+                return v
+    elif tol is not None:
+        return tol
+    return _CONSISTENCY_TOL.get(name, 1e-3)
+
+
 def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
                       arg_params=None, tol=None):
-    """Run the same symbol on several contexts and compare
-    (reference test_utils.py:1207 cpu/gpu consistency — cpu/tpu here)."""
+    """Run the same symbol on several contexts/dtypes and compare
+    (reference test_utils.py:1207 cpu/gpu consistency — cpu/tpu and
+    fp32/bf16 here). ``tol`` may be a number or a dtype-keyed dict; by
+    default each comparison uses the looser of the two executors' dtype
+    tiers (fp16 1e-1, bf16 5e-2, fp32 1e-3, fp64 1e-5)."""
     assert len(ctx_list) > 1
     exes = []
     for spec in ctx_list:
@@ -186,6 +212,11 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
         ex = sym.simple_bind(ctx=ctx, grad_req=grad_req,
                              type_dict=type_dict, **spec)
         exes.append(ex)
+
+    def pair_tol(a_arr, b_arr):
+        return max(_tol_for(_as_np(a_arr).dtype, tol),
+                   _tol_for(_as_np(b_arr).dtype, tol))
+
     # same init everywhere
     ref = exes[0]
     for name, arr in ref.arg_dict.items():
@@ -197,15 +228,20 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
     outs = [ex.forward(is_train=True) for ex in exes]
     for o in outs[1:]:
         for a, b in zip(outs[0], o):
-            assert_almost_equal(a, b.asnumpy().astype(np.float32),
-                                rtol=1e-3, atol=1e-3)
+            t = pair_tol(a, b)
+            assert_almost_equal(_as_np(a).astype(np.float32),
+                                b.asnumpy().astype(np.float32),
+                                rtol=t, atol=t)
     for ex in exes:
-        ex.backward([nd.ones(o.shape, ctx=ex._ctx) for o in ex.outputs])
+        ex.backward([nd.array(np.ones(o.shape, _as_np(o).dtype),
+                              ctx=ex._ctx) for o in ex.outputs])
     for ex in exes[1:]:
         for n in ref.grad_dict:
-            assert_almost_equal(ref.grad_dict[n],
-                                ex.grad_dict[n].asnumpy().astype(np.float32),
-                                rtol=1e-3, atol=1e-3)
+            t = pair_tol(ref.grad_dict[n], ex.grad_dict[n])
+            assert_almost_equal(
+                _as_np(ref.grad_dict[n]).astype(np.float32),
+                ex.grad_dict[n].asnumpy().astype(np.float32),
+                rtol=t, atol=t)
     return exes
 
 

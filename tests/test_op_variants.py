@@ -476,3 +476,325 @@ def test_embedding_grad_rows():
     ex.forward(is_train=True)
     ex.backward([nd.array(np.ones((3, 3), np.float32))])
     np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(), expected)
+
+
+# ===========================================================================
+# Reference torture grids (tests/python/unittest/test_operator.py:998
+# deconvolution, :1133 batchnorm-training, :1219 grouped convolution,
+# :1641 dilated convolution): systematic stride x dilate x pad x group
+# sweeps on odd shapes, fwd vs numpy + FD grads + bf16 consistency tiers.
+# ===========================================================================
+
+from mxtpu.test_utils import check_consistency  # noqa: E402
+
+
+def _grouped_conv_ref(x, w, b, stride, pad, dilate, groups):
+    cin_g = x.shape[1] // groups
+    nf = w.shape[0]
+    parts = []
+    for g in range(groups):
+        parts.append(np_conv2d(
+            x[:, g * cin_g:(g + 1) * cin_g],
+            w[g * (nf // groups):(g + 1) * (nf // groups)],
+            None, stride=stride, pad=pad, dilate=dilate))
+    ref = np.concatenate(parts, axis=1)
+    if b is not None:
+        ref = ref + b.reshape(1, -1, 1, 1)
+    return ref
+
+
+# full cartesian grid at odd spatial sizes; forward everywhere, FD
+# gradients on the diagonal slice (every config family appears in it)
+CONV_GRID = [(s, d, p, g)
+             for s in [(1, 1), (2, 2), (2, 1)]
+             for d in [(1, 1), (2, 2)]
+             for p in [(0, 0), (1, 1), (2, 1)]
+             for g in [1, 2]]
+
+
+@pytest.mark.parametrize("case", CONV_GRID,
+                         ids=lambda c: "s%s_d%s_p%s_g%d" % c)
+def test_convolution_grid_forward(case):
+    stride, dilate, pad, groups = case
+    r = _r(zlib.crc32(("grid%s" % (case,)).encode()))
+    x = r.uniform(-1, 1, (2, 4, 11, 9)).astype(np.float32)
+    w = r.uniform(-1, 1, (4, 4 // groups, 3, 3)).astype(np.float32)
+    b = r.uniform(-1, 1, (4,)).astype(np.float32)
+    ref = _grouped_conv_ref(x, w, b, stride, pad, dilate, groups)
+    _check(lambda a, ww, bb: mx.sym.Convolution(
+        a, ww, bb, kernel=(3, 3), num_filter=4, stride=stride, pad=pad,
+        dilate=dilate, num_group=groups), [x, w, b], ref, grad=False)
+
+
+@pytest.mark.parametrize("case", [
+    ((2, 2), (1, 1), (0, 0), 1),
+    ((1, 1), (2, 2), (1, 1), 2),
+    ((2, 1), (1, 1), (2, 1), 2),
+    ((2, 2), (2, 2), (2, 2), 1),
+], ids=lambda c: "s%s_d%s_p%s_g%d" % c)
+def test_convolution_grid_gradients(case):
+    stride, dilate, pad, groups = case
+    r = _r(zlib.crc32(("gridg%s" % (case,)).encode()))
+    x = r.uniform(-1, 1, (1, 2, 9, 7)).astype(np.float32)
+    w = r.uniform(-1, 1, (2, 2 // groups, 3, 3)).astype(np.float32)
+    b = r.uniform(-1, 1, (2,)).astype(np.float32)
+    ref = _grouped_conv_ref(x, w, b, stride, pad, dilate, groups)
+    _check(lambda a, ww, bb: mx.sym.Convolution(
+        a, ww, bb, kernel=(3, 3), num_filter=2, stride=stride, pad=pad,
+        dilate=dilate, num_group=groups), [x, w, b], ref)
+
+
+@pytest.mark.parametrize("dim", [1, 2, 3])
+def test_convolution_grouping_equals_sliced_concat(dim):
+    """Grouped conv == concat of per-group convs, fwd AND grads through
+    two executors (the reference :1219 property, all spatial dims)."""
+    num_filter, num_group = 4, 2
+    kernel = (3,) * dim
+    shape = (1, 4) + (7,) * dim
+    r = _r(100 + dim)
+
+    x = mx.sym.var("x")
+    w = mx.sym.var("w")
+    b = mx.sym.var("b")
+    y1 = mx.sym.Convolution(x, w, b, num_filter=num_filter,
+                            num_group=num_group, kernel=kernel)
+    xs = mx.sym.SliceChannel(x, num_outputs=num_group, axis=1)
+    ws = mx.sym.SliceChannel(w, num_outputs=num_group, axis=0)
+    bs = mx.sym.SliceChannel(b, num_outputs=num_group, axis=0)
+    y2 = mx.sym.Concat(*[
+        mx.sym.Convolution(xs[i], ws[i], bs[i],
+                           num_filter=num_filter // num_group,
+                           kernel=kernel)
+        for i in range(num_group)])
+
+    wshape = (num_filter, shape[1] // num_group) + kernel
+    ex1 = y1.simple_bind(mx.cpu(), x=shape, w=wshape, b=(num_filter,))
+    ex2 = y2.simple_bind(mx.cpu(), x=shape, w=wshape, b=(num_filter,))
+    for name in ("x", "w", "b"):
+        v = r.normal(size=ex1.arg_dict[name].shape).astype(np.float32)
+        ex1.arg_dict[name][:] = v
+        ex2.arg_dict[name][:] = v
+    o1 = ex1.forward(is_train=True)[0]
+    o2 = ex2.forward(is_train=True)[0]
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    ex1.backward([o1])
+    ex2.backward([o2])
+    for name in ("x", "w", "b"):
+        np.testing.assert_allclose(ex1.grad_dict[name].asnumpy(),
+                                   ex2.grad_dict[name].asnumpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+
+DEPTHWISE_GRID = [(c, k, s, p, hw)
+                  for c in [4, 8]
+                  for k in [3, 5]
+                  for s in [1, 2]
+                  for p in [0, 1]
+                  for hw in [7, 12]]
+
+
+@pytest.mark.parametrize("case", DEPTHWISE_GRID,
+                         ids=lambda c: "c%d_k%d_s%d_p%d_hw%d" % c)
+def test_depthwise_convolution_grid(case):
+    """num_group == channels (the reference :1282 depthwise grid)."""
+    c, k, s, p, hw = case
+    if hw + 2 * p < k:
+        pytest.skip("kernel larger than padded input")
+    r = _r(zlib.crc32(("dw%s" % (case,)).encode()))
+    x = r.uniform(-1, 1, (2, c, hw, hw)).astype(np.float32)
+    w = r.uniform(-1, 1, (c, 1, k, k)).astype(np.float32)
+    ref = _grouped_conv_ref(x, w, None, (s, s), (p, p), (1, 1), c)
+    _check(lambda a, ww: mx.sym.Convolution(
+        a, ww, kernel=(k, k), num_filter=c, num_group=c, stride=(s, s),
+        pad=(p, p), no_bias=True), [x, w], ref, grad=False)
+
+
+def test_convolution_dilated_impulse_response():
+    """A unit impulse through a dilated conv places kernel taps exactly
+    `dilate` apart (the reference :1641 impulse-response check)."""
+    for dil in [1, 2, 3]:
+        for ks in [1, 2, 3]:
+            n = 18
+            x = np.zeros((1, 1, n, n), np.float32)
+            x[0, 0, n // 2, n // 2] = 1.0
+            w = np.ones((1, 1, ks, ks), np.float32)
+            out = nd.Convolution(
+                nd.array(x), nd.array(w), kernel=(ks, ks), num_filter=1,
+                dilate=(dil, dil), no_bias=True).asnumpy()[0, 0]
+            ys, xs = np.nonzero(out)
+            assert len(ys) == ks * ks, (dil, ks, len(ys))
+            if ks > 1:
+                assert np.diff(np.unique(ys)).min() == dil
+                assert np.diff(np.unique(xs)).min() == dil
+
+
+# ---- deconvolution: target_shape / adj / stride grid ----------------------
+
+def test_deconvolution_target_shape_overrides_pad_adj():
+    """target_shape wins over (nonsense) pad/adj, 1-D and 2-D
+    (reference :998 check_deconvolution_target_shape)."""
+    x = mx.sym.var("x")
+    d2 = mx.sym.Deconvolution(x, mx.sym.var("w"), kernel=(3, 3),
+                              num_filter=5, stride=(2, 2),
+                              target_shape=(8, 8), pad=(99, 99),
+                              adj=(101, 101), no_bias=True)
+    _, outs, _ = d2.infer_shape(x=(2, 3, 4, 4))
+    assert outs[0] == (2, 5, 8, 8), outs
+    d1 = mx.sym.Deconvolution(x, mx.sym.var("w"), kernel=(3,),
+                              num_filter=5, stride=(2,),
+                              target_shape=(8,), pad=(99,), adj=(101,),
+                              no_bias=True)
+    _, outs, _ = d1.infer_shape(x=(2, 3, 4))
+    assert outs[0] == (2, 5, 8), outs
+    # explicit pad+adj route to the same 8x8 (reference's second case)
+    d3 = mx.sym.Deconvolution(x, mx.sym.var("w"), kernel=(3, 3),
+                              num_filter=5, stride=(2, 2), pad=(1, 1),
+                              adj=(1, 1), no_bias=True)
+    _, outs, _ = d3.infer_shape(x=(2, 3, 4, 4))
+    assert outs[0] == (2, 5, 8, 8), outs
+
+
+DECONV_GRID = [
+    # (in_shape, kernel, stride, pad, adj)
+    ((1, 1, 5, 5), (3, 3), (1, 1), (1, 1), (0, 0)),
+    ((4, 3, 14, 14), (3, 3), (1, 1), (1, 1), (0, 0)),
+    ((2, 3, 16, 16), (7, 7), (5, 5), (2, 2), (0, 0)),
+    ((1, 2, 6, 6), (3, 3), (2, 2), (1, 1), (1, 1)),
+    ((1, 1, 5), (3,), (1,), (1,), (0,)),
+    ((2, 3, 14), (3,), (1,), (1,), (0,)),
+    ((2, 3, 16), (7,), (5,), (2,), (0,)),
+]
+
+
+@pytest.mark.parametrize("case", DECONV_GRID,
+                         ids=lambda c: "i%s_k%s_s%s_p%s_a%s" % c)
+def test_deconvolution_forward_backward_grid(case):
+    """Deconv == adjoint of conv: fwd vs numpy upsample-conv ref, grads
+    by FD (reference :998 check_deconvolution_forward_backward grid,
+    medium shapes)."""
+    in_shape, kernel, stride, pad, adj = case
+    nsp = len(kernel)
+    r = _r(zlib.crc32(("dc%s" % (case,)).encode()))
+    nf = 2
+    x = r.uniform(-1, 1, in_shape).astype(np.float32)
+    w = r.uniform(-1, 1, (in_shape[1], nf) + kernel).astype(np.float32)
+    # adj extends the output at the far edge with COMPUTED positions
+    # (not zeros): take the full (pad=0) transposed conv and slice
+    # [pad : full - pad + adj] per spatial dim
+    if nsp == 1:
+        full = np_deconv2d(x[:, :, None, :], w[:, :, None, :],
+                           stride=(1,) + stride, pad=(0, 0))
+        ref = full[:, :, 0, pad[0]:full.shape[3] - pad[0] + adj[0]]
+    else:
+        full = np_deconv2d(x, w, stride=stride, pad=(0, 0))
+        ref = full[:, :, pad[0]:full.shape[2] - pad[0] + adj[0],
+                   pad[1]:full.shape[3] - pad[1] + adj[1]]
+    big = int(np.prod(in_shape)) > 400
+    _check(lambda a, ww: mx.sym.Deconvolution(
+        a, ww, kernel=kernel, num_filter=nf, stride=stride, pad=pad,
+        adj=adj, no_bias=True), [x, w], ref, grad=not big)
+
+
+# ---- BatchNorm: fix_gamma x use_global_stats x axis grid -------------------
+
+BN_GRID = [(shape, fix_gamma, use_global, axis)
+           for shape in [(2, 3), (2, 3, 2, 2)]
+           for fix_gamma in [True, False]
+           for use_global in [True, False]
+           for axis in [1, -1, 0]]
+
+
+@pytest.mark.parametrize("case", BN_GRID,
+                         ids=lambda c: "s%dd_fg%d_gs%d_ax%d" % (
+                             len(c[0]), c[1], c[2], c[3]))
+def test_batchnorm_grid_gradients(case):
+    """FD gradients across the BN mode grid (reference :1133
+    test_batchnorm_training, incl. varying channel axis)."""
+    shape, fix_gamma, use_global, axis = case
+    r = _r(zlib.crc32(("bn%s" % (case,)).encode()))
+    x = r.normal(-0.1, 1.0, size=shape).astype(np.float32)
+    C = shape[axis % len(shape)]
+    gamma = np.ones(C, np.float32)
+    beta = np.ones(C, np.float32)
+    if C > 1:
+        gamma[1] = 3
+    beta[0] = 3
+    mm = r.uniform(0.2, 1.0, C).astype(np.float32)
+    mv = r.uniform(0.5, 1.5, C).astype(np.float32)
+
+    sym = mx.sym.BatchNorm(mx.sym.var("a0"), mx.sym.var("a1"),
+                           mx.sym.var("a2"), mx.sym.var("mm"),
+                           mx.sym.var("mv"), fix_gamma=fix_gamma,
+                           use_global_stats=use_global, axis=axis)
+    check_numeric_gradient(
+        sym, {"a0": x, "a1": gamma, "a2": beta},
+        aux_states={"mm": mm, "mv": mv},
+        grad_nodes=["a0"] if fix_gamma else ["a0", "a1", "a2"],
+        numeric_eps=1e-2, rtol=0.16, atol=1e-2)
+
+
+def test_batchnorm_output_mean_var():
+    r = _r(77)
+    x = r.normal(0, 1, (4, 3, 5)).astype(np.float32)
+    sym = mx.sym.BatchNorm(mx.sym.var("a0"), mx.sym.var("g"),
+                           mx.sym.var("b"), mx.sym.var("mm"),
+                           mx.sym.var("mv"), fix_gamma=False,
+                           output_mean_var=True)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null", a0=x.shape,
+                         g=(3,), b=(3,))
+    ex.arg_dict["a0"][:] = x
+    ex.arg_dict["g"][:] = np.ones(3, np.float32)
+    ex.arg_dict["b"][:] = np.zeros(3, np.float32)
+    ex.aux_dict["mm"][:] = np.zeros(3, np.float32)
+    ex.aux_dict["mv"][:] = np.ones(3, np.float32)
+    outs = ex.forward(is_train=True)
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[1].asnumpy(), x.mean(axis=(0, 2)),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---- bf16 consistency tiers (check_consistency, reference GPU fp16 tier) --
+
+def _bf16_ctx_list(**shapes):
+    import jax.numpy as jnp
+    fp32 = {"ctx": mx.cpu(),
+            "type_dict": {k: np.float32 for k in shapes}}
+    bf16 = {"ctx": mx.cpu(),
+            "type_dict": {k: jnp.bfloat16 for k in shapes}}
+    fp32.update(shapes)
+    bf16.update(shapes)
+    return [fp32, bf16]
+
+
+def test_conv_bf16_consistency():
+    np.random.seed(11)
+    sym = mx.sym.Convolution(mx.sym.var("a0"), mx.sym.var("a1"),
+                             kernel=(3, 3), num_filter=4, pad=(1, 1),
+                             no_bias=True, name="conv")
+    check_consistency(sym, _bf16_ctx_list(a0=(2, 3, 8, 8),
+                                          a1=(4, 3, 3, 3)))
+
+
+def test_fc_bf16_consistency():
+    np.random.seed(12)
+    sym = mx.sym.FullyConnected(mx.sym.var("a0"), mx.sym.var("a1"),
+                                mx.sym.var("a2"), num_hidden=8)
+    check_consistency(sym, _bf16_ctx_list(a0=(4, 16), a1=(8, 16),
+                                          a2=(8,)))
+
+
+def test_pool_bf16_consistency():
+    np.random.seed(13)
+    sym = mx.sym.Pooling(mx.sym.var("a0"), kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    check_consistency(sym, _bf16_ctx_list(a0=(2, 3, 8, 8)))
+
+
+def test_bn_bf16_consistency():
+    np.random.seed(14)
+    sym = mx.sym.BatchNorm(mx.sym.var("a0"), mx.sym.var("a1"),
+                           mx.sym.var("a2"), mx.sym.var("mm"),
+                           mx.sym.var("mv"), fix_gamma=False)
+    check_consistency(sym, _bf16_ctx_list(a0=(4, 3, 6, 6), a1=(3,),
+                                          a2=(3,)))
