@@ -36,6 +36,91 @@ class _State(threading.local):
 _STATE = _State()
 
 
+def _hashable(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(_hashable(v) for v in x)
+    hash(x)
+    return x
+
+
+def _vjp_runner(op, params_t, static_t, nd_pos, arr_pos, n_vals,
+                n_outs, rng_used):
+    """Jitted vjp for one (op, params, input-structure) signature.
+
+    Built once per signature and cached (_VJP_CACHE); jax.jit's own
+    aval-keyed cache then handles shape/dtype specialization. Without
+    this, every tape entry re-traced ``jax.vjp`` of a fresh closure on
+    every backward — for scan-heavy ops (fused RNN) that retrace
+    dominated eager training time.
+    """
+    params = dict(params_t)
+    statics = dict(static_t)
+
+    def fwd(diff_vals, other_vals, key):
+        vals = [None] * n_vals
+        for i, v in statics.items():
+            vals[i] = v
+        for p, v in zip(nd_pos, diff_vals):
+            vals[p] = v
+        for p, v in zip(arr_pos, other_vals):
+            vals[p] = v
+        if rng_used:
+            with rng_scope(key):
+                r = op.fn(*vals, **params)
+        else:
+            r = op.fn(*vals, **params)
+        return r if isinstance(r, tuple) else (r,)
+
+    @jax.jit
+    def runner(diff_vals, other_vals, cotangents, key):
+        _, vjp_fn = jax.vjp(
+            lambda *xs: fwd(xs, other_vals, key), *diff_vals)
+        return vjp_fn(cotangents)
+
+    return runner
+
+
+_VJP_CACHE = {}
+_VJP_CACHE_MAX = 512
+
+
+def _cached_vjp(op, entry, nd_pos):
+    """Return runner(diff_vals, other_vals, cotangents, key) or None when
+    the signature isn't hashable (falls back to the direct path)."""
+    try:
+        params_t = tuple(sorted(
+            (k, _hashable(v)) for k, v in entry.params.items()))
+        arr_pos = tuple(
+            i for i, a in enumerate(entry.inputs)
+            if a is not None and i not in nd_pos)
+        static_t = tuple(
+            (i, _hashable(entry.input_values[i]))
+            for i, a in enumerate(entry.inputs)
+            if a is None and i not in nd_pos)
+        # keyed on the op OBJECT, not its name: dynamically-registered
+        # ops (hybridize CachedOps) can reuse a name across rebuilds,
+        # and a stale runner would silently compute old gradients
+        key = (id(op), params_t, static_t, tuple(nd_pos), arr_pos,
+               len(entry.input_values), len(entry.outputs),
+               entry.rng_key is not None)
+    except TypeError:
+        return None, None
+    hit = _VJP_CACHE.get(key)
+    if hit is None:
+        if len(_VJP_CACHE) >= _VJP_CACHE_MAX:
+            _VJP_CACHE.clear()
+        runner = _vjp_runner(op, params_t, static_t, tuple(nd_pos),
+                             arr_pos, len(entry.input_values),
+                             len(entry.outputs),
+                             entry.rng_key is not None)
+        # the op object is pinned in the value so its id() (the cache
+        # key) cannot be recycled by the allocator while the entry lives
+        _VJP_CACHE[key] = (op, runner)
+    else:
+        runner = hit[1]
+    return runner, list(arr_pos)
+
+
 def is_recording():
     return _STATE.recording
 
@@ -170,20 +255,29 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             nd_pos = [i for i, a in enumerate(entry.inputs)
                       if a is not None and i not in op.aux_update]
 
-            def fwd_fn(*xs):
-                vals = list(entry.input_values)
-                for p, x in zip(nd_pos, xs):
-                    vals[p] = x
-                if entry.rng_key is not None:
-                    with rng_scope(entry.rng_key):
-                        r = op.fn(*vals, **params)
-                else:
-                    r = op.fn(*vals, **params)
-                return r if isinstance(r, tuple) else (r,)
-
             primals = [entry.input_values[p] for p in nd_pos]
-            _, vjp_fn = jax.vjp(fwd_fn, *primals)
-            sub_grads = vjp_fn(cotangents)
+            runner, arr_pos = _cached_vjp(op, entry, nd_pos)
+            if runner is not None:
+                other = tuple(entry.input_values[p] for p in arr_pos)
+                key = entry.rng_key if entry.rng_key is not None \
+                    else jnp.zeros((2,), jnp.uint32)
+                sub_grads = runner(tuple(primals), other, cotangents,
+                                   key)
+            else:
+                # unhashable signature: direct (uncached) vjp
+                def fwd_fn(*xs):
+                    vals = list(entry.input_values)
+                    for p, x in zip(nd_pos, xs):
+                        vals[p] = x
+                    if entry.rng_key is not None:
+                        with rng_scope(entry.rng_key):
+                            r = op.fn(*vals, **params)
+                    else:
+                        r = op.fn(*vals, **params)
+                    return r if isinstance(r, tuple) else (r,)
+
+                _, vjp_fn = jax.vjp(fwd_fn, *primals)
+                sub_grads = vjp_fn(cotangents)
             in_grads = [None] * len(entry.inputs)
             for p, g in zip(nd_pos, sub_grads):
                 in_grads[p] = g
