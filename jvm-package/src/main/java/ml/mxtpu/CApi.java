@@ -1,0 +1,64 @@
+package ml.mxtpu;
+
+import com.sun.jna.Library;
+import com.sun.jna.Native;
+import com.sun.jna.Pointer;
+import com.sun.jna.ptr.IntByReference;
+import com.sun.jna.ptr.PointerByReference;
+
+/**
+ * JNA declarations over the mxtpu flat C ABI (include/mxtpu/c_api.h and
+ * c_predict_api.h — the same surface the reference's Scala package binds
+ * through JNI, scala-package/native/; here JNA needs no generated glue,
+ * which is why the C ABI was kept "JNA-ready": plain ints, pointers and
+ * const char*).
+ *
+ * Every function returns 0 on success and -1 on failure; the message is
+ * fetched with MXGetLastError (thread-local).
+ *
+ * Handle lifetime: callers own NDArray/Predictor handles and must free
+ * them (NDArray.close / Predictor.close below).
+ */
+public interface CApi extends Library {
+    CApi INSTANCE = Native.load(
+        System.getProperty("mxtpu.library", "mxtpu_c"), CApi.class);
+
+    /* ------------------------------------------------------------ misc */
+    String MXGetLastError();
+    int MXGetVersion(IntByReference out);
+    int MXRandomSeed(int seed);
+    int MXNotifyShutdown();
+
+    /* --------------------------------------------------------- NDArray */
+    int MXNDArrayCreateEx(int[] shape, int ndim, int devType, int devId,
+                          int delayAlloc, int dtype, PointerByReference out);
+    int MXNDArraySyncCopyFromCPU(Pointer handle, float[] data, long size);
+    int MXNDArraySyncCopyToCPU(Pointer handle, float[] data, long size);
+    int MXNDArrayWaitToRead(Pointer handle);
+    int MXNDArrayWaitAll();
+    int MXNDArrayFree(Pointer handle);
+    int MXNDArrayGetShape(Pointer handle, IntByReference outDim,
+                          PointerByReference outData);
+    int MXNDArrayGetDType(Pointer handle, IntByReference outDtype);
+
+    /* -------------------------------------------------- imperative ops */
+    int MXListAllOpNames(IntByReference outSize, PointerByReference outArr);
+    int MXGetOpHandle(String name, PointerByReference out);
+    int MXImperativeInvoke(Pointer op, int numInputs, Pointer[] inputs,
+                           IntByReference numOutputs,
+                           PointerByReference outputs, int numParams,
+                           String[] paramKeys, String[] paramVals);
+
+    /* ----------------------------------------------------- predict API */
+    int MXPredCreate(String symbolJson, byte[] paramBytes, int paramSize,
+                     int devType, int devId, int numInputNodes,
+                     String[] inputKeys, int[] inputShapeIndptr,
+                     int[] inputShapeData, PointerByReference out);
+    int MXPredSetInput(Pointer handle, String key, float[] data, int size);
+    int MXPredForward(Pointer handle);
+    int MXPredGetOutputShape(Pointer handle, int index,
+                             PointerByReference shapeData,
+                             IntByReference shapeNdim);
+    int MXPredGetOutput(Pointer handle, int index, float[] data, int size);
+    int MXPredFree(Pointer handle);
+}

@@ -1,0 +1,102 @@
+package ml.mxtpu;
+
+import com.sun.jna.Pointer;
+import com.sun.jna.ptr.IntByReference;
+import com.sun.jna.ptr.PointerByReference;
+
+/**
+ * Float32 device array over an mxtpu NDArrayHandle (the JVM counterpart
+ * of the reference's scala-package ml.dmlc.mxnet.NDArray, at the scope
+ * of the Perl binding: create, host copies, imperative op invoke).
+ */
+public final class NDArray implements AutoCloseable {
+    final Pointer handle;
+
+    NDArray(Pointer handle) {
+        this.handle = handle;
+    }
+
+    static void check(int rc) {
+        if (rc != 0) {
+            throw new RuntimeException("mxtpu: " +
+                CApi.INSTANCE.MXGetLastError());
+        }
+    }
+
+    /** Allocate a float32 array of the given shape on cpu(0). */
+    public static NDArray create(int... shape) {
+        PointerByReference out = new PointerByReference();
+        check(CApi.INSTANCE.MXNDArrayCreateEx(shape, shape.length,
+            /*cpu*/ 1, 0, 0, /*f32*/ 0, out));
+        return new NDArray(out.getValue());
+    }
+
+    /** Allocate and fill from a host buffer (row-major). */
+    public static NDArray fromArray(float[] data, int... shape) {
+        NDArray a = create(shape);
+        check(CApi.INSTANCE.MXNDArraySyncCopyFromCPU(a.handle, data,
+            data.length));
+        return a;
+    }
+
+    public int[] shape() {
+        IntByReference ndim = new IntByReference();
+        PointerByReference pdata = new PointerByReference();
+        check(CApi.INSTANCE.MXNDArrayGetShape(handle, ndim, pdata));
+        if (ndim.getValue() == 0) {
+            return new int[0];
+        }
+        return pdata.getValue().getIntArray(0, ndim.getValue());
+    }
+
+    public int size() {
+        int n = 1;
+        for (int d : shape()) {
+            n *= d;
+        }
+        return n;
+    }
+
+    /** Blocking device-to-host copy. */
+    public float[] toArray() {
+        float[] out = new float[size()];
+        check(CApi.INSTANCE.MXNDArraySyncCopyToCPU(handle, out, out.length));
+        return out;
+    }
+
+    /**
+     * Invoke a registered operator by name (MXImperativeInvoke with
+     * library-allocated outputs), e.g.
+     * {@code NDArray.invoke("elemwise_add", new NDArray[]{a, b})}.
+     */
+    public static NDArray[] invoke(String opName, NDArray[] inputs,
+                                   String[] paramKeys, String[] paramVals) {
+        PointerByReference op = new PointerByReference();
+        check(CApi.INSTANCE.MXGetOpHandle(opName, op));
+        Pointer[] in = new Pointer[inputs.length];
+        for (int i = 0; i < inputs.length; i++) {
+            in[i] = inputs[i].handle;
+        }
+        IntByReference numOut = new IntByReference(0);
+        PointerByReference outs = new PointerByReference();
+        int np = paramKeys == null ? 0 : paramKeys.length;
+        check(CApi.INSTANCE.MXImperativeInvoke(op.getValue(), in.length, in,
+            numOut, outs, np, paramKeys, paramVals));
+        int n = numOut.getValue();
+        Pointer[] handles = outs.getValue().getPointerArray(0, n);
+        NDArray[] result = new NDArray[n];
+        for (int i = 0; i < n; i++) {
+            result[i] = new NDArray(handles[i]);
+        }
+        return result;
+    }
+
+    public static NDArray[] invoke(String opName, NDArray[] inputs) {
+        return invoke(opName, inputs, null, null);
+    }
+
+    @Override
+    public void close() {
+        check(CApi.INSTANCE.MXNDArrayFree(handle));
+    }
+}

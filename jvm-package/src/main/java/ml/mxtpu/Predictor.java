@@ -1,0 +1,60 @@
+package ml.mxtpu;
+
+import com.sun.jna.Pointer;
+import com.sun.jna.ptr.IntByReference;
+import com.sun.jna.ptr.PointerByReference;
+
+/**
+ * Forward-only inference over the predict C API (c_predict_api.h; the
+ * reference ships the same deploy surface to the JVM through
+ * scala-package and the amalgamation JNI).
+ *
+ * Feed it a symbol JSON string and the bytes of a .params file (either
+ * the reference binary container or mxtpu's npz container — the C layer
+ * sniffs the format).
+ */
+public final class Predictor implements AutoCloseable {
+    private final Pointer handle;
+
+    public Predictor(String symbolJson, byte[] params, String inputKey,
+                     int[] inputShape) {
+        int[] indptr = {0, inputShape.length};
+        PointerByReference out = new PointerByReference();
+        NDArray.check(CApi.INSTANCE.MXPredCreate(symbolJson, params,
+            params.length, /*cpu*/ 1, 0, 1, new String[]{inputKey},
+            indptr, inputShape, out));
+        this.handle = out.getValue();
+    }
+
+    public void setInput(String key, float[] data) {
+        NDArray.check(CApi.INSTANCE.MXPredSetInput(handle, key, data,
+            data.length));
+    }
+
+    public void forward() {
+        NDArray.check(CApi.INSTANCE.MXPredForward(handle));
+    }
+
+    public int[] outputShape(int index) {
+        PointerByReference data = new PointerByReference();
+        IntByReference ndim = new IntByReference();
+        NDArray.check(CApi.INSTANCE.MXPredGetOutputShape(handle, index,
+            data, ndim));
+        return data.getValue().getIntArray(0, ndim.getValue());
+    }
+
+    public float[] getOutput(int index) {
+        int n = 1;
+        for (int d : outputShape(index)) {
+            n *= d;
+        }
+        float[] out = new float[n];
+        NDArray.check(CApi.INSTANCE.MXPredGetOutput(handle, index, out, n));
+        return out;
+    }
+
+    @Override
+    public void close() {
+        NDArray.check(CApi.INSTANCE.MXPredFree(handle));
+    }
+}

@@ -1,0 +1,67 @@
+package ml.mxtpu;
+
+import com.sun.jna.ptr.IntByReference;
+
+/**
+ * Runtime gate for the JVM binding (the analogue of
+ * perl-package's t/ suite): version query, NDArray host round-trip,
+ * imperative op invoke, and — when a symbol/params path pair is given
+ * as argv — a Predictor forward. Prints JVM_SMOKE_OK on success.
+ *
+ * Run:
+ *   java -cp jna.jar:classes -Djna.library.path=mxtpu/_native \
+ *        ml.mxtpu.SmokeTest [symbol.json params.bin]
+ */
+public final class SmokeTest {
+    private SmokeTest() { }
+
+    public static void main(String[] args) throws Exception {
+        IntByReference v = new IntByReference();
+        NDArray.check(CApi.INSTANCE.MXGetVersion(v));
+        System.out.println("mxtpu version " + v.getValue());
+
+        float[] data = {1f, 2f, 3f, 4f, 5f, 6f};
+        try (NDArray a = NDArray.fromArray(data, 2, 3);
+             NDArray b = NDArray.fromArray(data, 2, 3)) {
+            int[] shape = a.shape();
+            if (shape.length != 2 || shape[0] != 2 || shape[1] != 3) {
+                throw new AssertionError("shape " + shape.length);
+            }
+            NDArray[] sum = NDArray.invoke("elemwise_add",
+                new NDArray[]{a, b});
+            float[] out = sum[0].toArray();
+            for (int i = 0; i < data.length; i++) {
+                if (Math.abs(out[i] - 2 * data[i]) > 1e-6) {
+                    throw new AssertionError("elemwise_add[" + i + "] = "
+                        + out[i]);
+                }
+            }
+            sum[0].close();
+            // params: invoke with scalar kwargs
+            NDArray[] scaled = NDArray.invoke("_mul_scalar",
+                new NDArray[]{a}, new String[]{"scalar"},
+                new String[]{"3.0"});
+            float[] s = scaled[0].toArray();
+            if (Math.abs(s[0] - 3f) > 1e-6) {
+                throw new AssertionError("_mul_scalar " + s[0]);
+            }
+            scaled[0].close();
+        }
+
+        if (args.length == 2) {
+            String json = new String(java.nio.file.Files.readAllBytes(
+                java.nio.file.Paths.get(args[0])), "UTF-8");
+            byte[] params = java.nio.file.Files.readAllBytes(
+                java.nio.file.Paths.get(args[1]));
+            try (Predictor p = new Predictor(json, params, "data",
+                    new int[]{1, 8})) {
+                p.setInput("data", new float[8]);
+                p.forward();
+                float[] out = p.getOutput(0);
+                System.out.println("predict output[0] = " + out[0]
+                    + " (n=" + out.length + ")");
+            }
+        }
+        System.out.println("JVM_SMOKE_OK");
+    }
+}

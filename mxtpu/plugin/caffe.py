@@ -1,0 +1,133 @@
+"""Caffe runtime bridge (reference plugin/caffe/caffe_op.cc + caffe.py).
+
+The reference embeds libcaffe and runs arbitrary caffe layers inside
+MXNet graphs (``mx.sym.CaffeOp(prototxt=...)``). The TPU-native
+equivalent routes the layer through the host-callback escape hatch that
+already powers CustomOp (mxtpu/operator.py, reference
+src/operator/custom/custom-inl.h): the caffe layer executes in pycaffe
+on the host, everything around it stays XLA-compiled. The weight
+converter lives separately in tools/caffe_converter.py.
+
+Requires pycaffe (``import caffe``) at use time — this image ships
+without it, so construction raises a pointed ImportError; the bridge
+logic itself is exercised in CI against a pycaffe API fake
+(tests/test_plugins.py), the same seam a real caffe install plugs into.
+
+Usage (mirrors the reference's plugin/caffe):
+
+    from mxtpu.plugin import caffe as mxcaffe
+    out = mxcaffe.CaffeOp(data, prototxt='layer {type: "TanH" ...}')
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import operator
+
+
+def _caffe():
+    mod = sys.modules.get("caffe")
+    if mod is not None:
+        return mod
+    try:
+        import caffe  # noqa: F401
+        return sys.modules["caffe"]
+    except ImportError as e:
+        raise ImportError(
+            "mxtpu.plugin.caffe needs pycaffe ('import caffe'); it is "
+            "not installed in this environment. The bridge executes "
+            "caffe layers as host callbacks inside XLA graphs — install "
+            "caffe (BVLC caffe or Intel caffe, with pycaffe built) to "
+            "use it; weight conversion alone needs only "
+            "tools/caffe_converter.py") from e
+
+
+class _CaffeLayerNet:
+    """One caffe layer wrapped as a single-layer caffe.Net."""
+
+    def __init__(self, prototxt, in_shapes):
+        caffe = _caffe()
+        spec = ['name: "mxtpu_bridge"']
+        for i, shape in enumerate(in_shapes):
+            spec.append(
+                'input: "data%d"\ninput_shape { %s }'
+                % (i, " ".join("dim: %d" % d for d in shape)))
+        spec.append(prototxt)
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".prototxt", delete=False) as f:
+            f.write("\n".join(spec))
+            path = f.name
+        try:
+            self.net = caffe.Net(path, caffe.TEST)
+        finally:
+            os.unlink(path)
+        self.in_names = ["data%d" % i for i in range(len(in_shapes))]
+        self.out_name = self.net.outputs[0]
+
+    def forward(self, arrays):
+        for name, a in zip(self.in_names, arrays):
+            self.net.blobs[name].data[...] = a
+        self.net.forward()
+        return np.array(self.net.blobs[self.out_name].data)
+
+    def backward(self, out_grad):
+        self.net.blobs[self.out_name].diff[...] = out_grad
+        self.net.backward()
+        return [np.array(self.net.blobs[n].diff) for n in self.in_names]
+
+
+class _CaffeOpImpl(operator.CustomOp):
+    def __init__(self, layer):
+        self.layer = layer
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        out = self.layer.forward([a.asnumpy() for a in in_data])
+        self.assign(out_data[0], req[0], nd.array(out))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        grads = self.layer.backward(out_grad[0].asnumpy())
+        for i, g in enumerate(grads):
+            self.assign(in_grad[i], req[i], nd.array(g))
+
+
+@operator.register("CaffeOp")
+class CaffeOpProp(operator.CustomOpProp):
+    """CustomOpProp for a caffe layer (reference CaffeOpProp,
+    plugin/caffe/caffe_op-inl.h: prototxt string parameter, num_data
+    inputs, single output)."""
+
+    def __init__(self, prototxt, num_data="1"):
+        super().__init__(need_top_grad=True)
+        self.prototxt = prototxt
+        self.num_data = int(num_data)
+
+    def list_arguments(self):
+        return tuple("data%d" % i for i in range(self.num_data))
+
+    def list_outputs(self):
+        return ("output",)
+
+    def infer_shape(self, in_shape):
+        # probe the layer once for its output shape (caffe reshapes nets
+        # dynamically; the reference asks the embedded layer the same way)
+        layer = _CaffeLayerNet(self.prototxt, in_shape)
+        out = layer.forward([np.zeros(s, np.float32) for s in in_shape])
+        self._probe = layer
+        return in_shape, (tuple(out.shape),), ()
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        layer = getattr(self, "_probe", None) or \
+            _CaffeLayerNet(self.prototxt, in_shapes)
+        self._probe = None
+        return _CaffeOpImpl(layer)
+
+
+def CaffeOp(*data, prototxt, name=None):
+    """Imperative/graph entry (reference mx.sym.CaffeOp)."""
+    return nd.Custom(*data, op_type="CaffeOp", prototxt=prototxt,
+                     num_data=str(len(data)))

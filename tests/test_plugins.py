@@ -1,6 +1,8 @@
 """Plugin parity tests (reference plugin/opencv, plugin/sframe)."""
 import os
 
+import sys
+
 import numpy as np
 import pytest
 
@@ -133,3 +135,64 @@ def test_dataframe_iter_column_list_with_array_cells():
     assert batch.data[0].shape == (2, 3)
     np.testing.assert_allclose(batch.data[0].asnumpy(),
                                [[1.0, 2.0, 0.5], [3.0, 4.0, 1.5]])
+
+
+# ---------------------------------------------------------------------------
+# caffe runtime bridge (mxtpu/plugin/caffe.py; reference plugin/caffe/
+# caffe_op.cc). No pycaffe in this image, so the bridge logic runs against
+# a pycaffe API fake — the identical seam a real install plugs into.
+# ---------------------------------------------------------------------------
+
+class _FakeBlob:
+    def __init__(self, shape):
+        self.data = np.zeros(shape, np.float32)
+        self.diff = np.zeros(shape, np.float32)
+
+
+class _FakeTanhNet:
+    """pycaffe-API double: single TanH layer, one input/one output."""
+    TEST = 1
+
+    def __init__(self, prototxt_path, phase):
+        text = open(prototxt_path).read()
+        assert "TanH" in text
+        import re
+        dims = [int(d) for d in re.findall(r"dim: (\d+)", text)]
+        self.blobs = {"data0": _FakeBlob(tuple(dims)),
+                      "out": _FakeBlob(tuple(dims))}
+        self.outputs = ["out"]
+
+    def forward(self):
+        self.blobs["out"].data[...] = np.tanh(self.blobs["data0"].data)
+
+    def backward(self):
+        y = self.blobs["out"]
+        self.blobs["data0"].diff[...] = y.diff * (1 - y.data ** 2)
+
+
+def test_caffe_bridge_missing_pycaffe_message():
+    from mxtpu.plugin import caffe as mxcaffe
+    import sys as _sys
+    assert "caffe" not in _sys.modules or _sys.modules["caffe"] is None
+    with pytest.raises(ImportError, match="pycaffe"):
+        mxcaffe._caffe()
+
+
+def test_caffe_bridge_forward_backward_with_fake(monkeypatch):
+    import types
+    from mxtpu.plugin import caffe as mxcaffe
+    fake = types.SimpleNamespace(Net=_FakeTanhNet, TEST=_FakeTanhNet.TEST)
+    monkeypatch.setitem(sys.modules, "caffe", fake)
+
+    import mxtpu.autograd as ag
+    x_np = np.array([[0.2, -0.7, 1.3]], np.float32)
+    x = mx.nd.array(x_np)
+    x.attach_grad()
+    with ag.record():
+        y = mxcaffe.CaffeOp(
+            x, prototxt='layer { name: "t" type: "TanH" '
+                        'bottom: "data0" top: "out" }')
+    np.testing.assert_allclose(y.asnumpy(), np.tanh(x_np), rtol=1e-6)
+    y.backward(mx.nd.ones((1, 3)))
+    np.testing.assert_allclose(x.grad.asnumpy(), 1 - np.tanh(x_np) ** 2,
+                               rtol=1e-5)
