@@ -1,0 +1,89 @@
+"""Actor-critic policy gradient (reference example/gluon/
+actor_critic.py: gym CartPole). No gym in this environment, so the
+classic chain-walk MDP stands in — 8 states, move left/right, reward at
+the right end; same algorithm shape: shared body, policy + value heads,
+discounted returns, advantage-weighted log-prob loss + TD value loss."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+
+N_STATES, GAMMA = 8, 0.95
+
+
+class Net(gluon.Block):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.dense = nn.Dense(16, activation="relu")
+            self.action = nn.Dense(2)
+            self.value = nn.Dense(1)
+
+    def forward(self, x):
+        h = self.dense(x)
+        return self.action(h), self.value(h)
+
+
+def one_hot(s):
+    v = np.zeros((1, N_STATES), np.float32)
+    v[0, s] = 1
+    return mx.nd.array(v)
+
+
+def run_episode(net, rng, max_steps=40):
+    s = 0
+    rewards, logps, values = [], [], []
+    for _ in range(max_steps):
+        logits, val = net(one_hot(s))
+        p = np.asarray(mx.nd.softmax(logits).asnumpy()).ravel()
+        a = int(rng.rand() < p[1])
+        logp = mx.nd.log_softmax(logits)[0, a]
+        s = max(0, s - 1) if a == 0 else min(N_STATES - 1, s + 1)
+        r = 1.0 if s == N_STATES - 1 else -0.01
+        rewards.append(r)
+        logps.append(logp)
+        values.append(val[0, 0])
+        if s == N_STATES - 1:
+            break
+    return rewards, logps, values
+
+
+def main():
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    rng = np.random.RandomState(0)
+    lengths = []
+    for episode in range(150):
+        with autograd.record():
+            rewards, logps, values = run_episode(net, rng)
+            R = 0.0
+            loss = None
+            for r, logp, v in zip(reversed(rewards), reversed(logps),
+                                  reversed(values)):
+                R = r + GAMMA * R
+                adv = R - float(v.asnumpy())
+                term = -logp * adv + (v - R) ** 2
+                loss = term if loss is None else loss + term
+        loss.backward()
+        trainer.step(1)
+        lengths.append(len(rewards))
+        if episode % 30 == 0:
+            print("episode %d steps-to-goal %.1f"
+                  % (episode, np.mean(lengths[-30:])))
+    early = np.mean(lengths[:30])
+    late = np.mean(lengths[-30:])
+    print("avg episode length %.1f -> %.1f" % (early, late))
+    assert late <= early, (early, late)
+    assert late < 12, late          # optimal is 7 moves from state 0
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

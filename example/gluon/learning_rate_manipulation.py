@@ -1,0 +1,65 @@
+"""Adjusting the learning rate mid-training (reference example/gluon/
+learning_rate_manipulation.py): trainer.set_learning_rate between
+epochs, plus the scheduler route — both observable through
+trainer.learning_rate."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+
+
+def main():
+    r = np.random.RandomState(0)
+    X = r.standard_normal((256, 8)).astype("f")
+    w = r.standard_normal(8).astype("f")
+    y = (X @ w).astype("f")
+
+    net = nn.Dense(1)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    l2 = gluon.loss.L2Loss()
+    seen_lrs = []
+    for epoch in range(6):
+        if epoch == 3:
+            # manual decay, exactly what the reference demonstrates
+            trainer.set_learning_rate(trainer.learning_rate * 0.1)
+        seen_lrs.append(trainer.learning_rate)
+        it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+        for b in it:
+            with autograd.record():
+                loss = l2(net(b.data[0]).reshape((-1,)), b.label[0])
+            loss.backward()
+            trainer.step(b.data[0].shape[0])
+        print("epoch %d lr %.4f loss %.5f"
+              % (epoch, trainer.learning_rate,
+                 float(loss.mean().asnumpy())))
+    assert seen_lrs[0] == 0.1 and abs(seen_lrs[-1] - 0.01) < 1e-9
+
+    # scheduler route: FactorScheduler drives the same knob
+    net2 = nn.Dense(1)
+    net2.initialize(mx.init.Xavier())
+    sched = mx.lr_scheduler.FactorScheduler(step=3, factor=0.5)
+    trainer2 = gluon.Trainer(net2.collect_params(), "sgd",
+                             {"learning_rate": 0.2,
+                              "lr_scheduler": sched})
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    lrs = []
+    for b in it:
+        with autograd.record():
+            loss = l2(net2(b.data[0]).reshape((-1,)), b.label[0])
+        loss.backward()
+        trainer2.step(b.data[0].shape[0])
+        lrs.append(trainer2.learning_rate)
+    assert lrs[-1] < lrs[0], lrs
+    print("scheduler lr %.3f -> %.3f" % (lrs[0], lrs[-1]))
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

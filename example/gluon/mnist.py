@@ -1,0 +1,70 @@
+"""Gluon MNIST MLP (reference example/gluon/mnist.py): the canonical
+imperative training loop — net/Trainer/autograd.record/loss.backward —
+on MNIST (bundled synthetic fallback keeps it self-contained)."""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+
+
+def synthetic_mnist(n=512, seed=0):
+    r = np.random.RandomState(seed)
+    y = (r.rand(n) * 10).astype("f")
+    x = r.rand(n, 1, 28, 28).astype("f") * 0.1
+    for i in range(n):  # class-dependent blob so the task is learnable
+        c = int(y[i])
+        x[i, 0, 2 * c:2 * c + 6, 4:24] += 0.8
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    x, y = synthetic_mnist()
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        train.reset()
+        metric.reset()
+        total = 0.0
+        n = 0
+        for batch in train:
+            data = batch.data[0]
+            label = batch.label[0]
+            with autograd.record():
+                out = net(data.reshape((data.shape[0], -1)))
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total += float(loss.mean().asnumpy())
+            n += 1
+            metric.update([label], [out])
+        print("epoch %d loss %.4f %s" % (epoch, total / n,
+                                         metric.get()))
+    assert metric.get()[1] > 0.9, metric.get()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

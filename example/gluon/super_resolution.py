@@ -1,0 +1,91 @@
+"""Sub-pixel super-resolution (reference example/gluon/
+super_resolution.py: ESPCN — conv stack + pixel-shuffle upscale,
+L2 loss, PSNR eval). Synthetic band-limited images stand in for
+BSDS300."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+from mxtpu import autograd, gluon
+from mxtpu.gluon import nn
+
+UP = 2
+
+
+class SuperRes(gluon.HybridBlock):
+    def __init__(self, upscale, **kw):
+        super().__init__(**kw)
+        self.upscale = upscale
+        with self.name_scope():
+            self.conv1 = nn.Conv2D(16, 5, padding=2, activation="relu")
+            self.conv2 = nn.Conv2D(16, 3, padding=1, activation="relu")
+            self.conv3 = nn.Conv2D(upscale ** 2, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        h = self.conv3(self.conv2(self.conv1(x)))
+        # pixel shuffle: (N, r^2, H, W) -> (N, 1, rH, rW)
+        h = F.reshape(h, shape=(0, -4, self.upscale, self.upscale, 0, 0))
+        h = F.transpose(h, axes=(0, 3, 1, 4, 2))   # (N, H, r, W, r)
+        h = F.reshape(h, shape=(0, -3, -3))        # (N, rH, rW)
+        return F.expand_dims(h, axis=1)
+
+
+def make_images(n, hw, rng):
+    """Smooth random images (sum of low-frequency waves) — downsampling
+    then super-resolving them is well-posed."""
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float32) / hw
+    imgs = np.zeros((n, 1, hw, hw), np.float32)
+    for i in range(n):
+        for _ in range(4):
+            fx, fy = rng.uniform(1, 4, 2)
+            ph = rng.uniform(0, 2 * np.pi)
+            imgs[i, 0] += np.sin(2 * np.pi * (fx * xx + fy * yy) + ph)
+        imgs[i] = (imgs[i] - imgs[i].min()) / np.ptp(imgs[i])
+    return imgs
+
+
+def psnr(a, b):
+    mse = float(np.mean((a - b) ** 2))
+    return 10 * np.log10(1.0 / max(mse, 1e-12))
+
+
+def main():
+    rng = np.random.RandomState(0)
+    hi = make_images(64, 32, rng)
+    lo = hi[:, :, ::UP, ::UP]
+
+    net = SuperRes(UP)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.005})
+    l2 = gluon.loss.L2Loss()
+    it = mx.io.NDArrayIter(lo, hi, batch_size=16, shuffle=True)
+    for epoch in range(30):
+        it.reset()
+        total, n = 0.0, 0
+        for b in it:
+            with autograd.record():
+                loss = l2(net(b.data[0]), b.label[0])
+            loss.backward()
+            trainer.step(b.data[0].shape[0])
+            total += float(loss.mean().asnumpy())
+            n += 1
+        if epoch % 10 == 0:
+            print("epoch %d l2 %.5f" % (epoch, total / n))
+
+    out = net(mx.nd.array(lo[:8])).asnumpy()
+    model_psnr = psnr(out, hi[:8])
+    nearest = np.repeat(np.repeat(lo[:8], UP, 2), UP, 3)
+    base_psnr = psnr(nearest, hi[:8])
+    print("PSNR: nearest %.2f dB, model %.2f dB" % (base_psnr,
+                                                    model_psnr))
+    assert model_psnr > base_psnr, (model_psnr, base_psnr)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

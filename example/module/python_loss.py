@@ -1,0 +1,88 @@
+"""Custom Python loss through the Module API (reference
+example/module/python_loss.py): MakeLoss over a hand-written weighted
+cross-entropy, plus the PythonLossModule-style route of feeding
+gradients in from numpy."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+
+
+def synthetic(n=512, seed=1):
+    r = np.random.RandomState(seed)
+    y = (r.rand(n) * 4).astype("f")
+    x = r.rand(n, 32).astype("f") * 0.1
+    for i in range(n):
+        x[i, int(y[i]) * 8:int(y[i]) * 8 + 8] += 1.0
+    return x, y
+
+
+def main():
+    x, y = synthetic()
+
+    # --- MakeLoss: loss IS the symbol; grad of its mean flows back ---
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    p = mx.sym.softmax(fc)
+    # focal-ish weighted CE, written in symbols
+    onehot = mx.sym.one_hot(label, depth=4)
+    ce = -mx.sym.sum(onehot * mx.sym.log(p + 1e-8), axis=1)
+    loss = mx.sym.MakeLoss(ce * 0.5)
+
+    mod = mx.mod.Module(loss, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    it = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for epoch in range(4):
+        it.reset()
+        total, n = 0.0, 0
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            total += float(mod.get_outputs()[0].asnumpy().mean())
+            n += 1
+            mod.backward()
+            mod.update()
+        print("makeloss epoch %d loss %.4f" % (epoch, total / n))
+    assert total / n < 0.4, total / n
+
+    # --- numpy-side gradient injection (PythonLossModule route):
+    # forward a plain symbol, compute grad in numpy, backward(out_grads)
+    fc_only = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                    num_hidden=4, name="fc")
+    ex = fc_only.simple_bind(mx.cpu(), data=(64, 32))
+    r = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = r.uniform(-0.1, 0.1, v.shape).astype("f")
+    losses = []
+    for step in range(80):
+        i = (step * 64) % (len(x) - 64)
+        xb, yb = x[i:i + 64], y[i:i + 64].astype(int)
+        ex.arg_dict["data"][:] = xb
+        logits = ex.forward(is_train=True)[0].asnumpy()
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        prob = e / e.sum(1, keepdims=True)
+        losses.append(float(-np.log(
+            prob[np.arange(64), yb] + 1e-8).mean()))
+        grad = prob.copy()
+        grad[np.arange(64), yb] -= 1.0
+        ex.backward([mx.nd.array(grad / 64)])
+        for k in ex.arg_dict:
+            if k != "data":
+                ex.arg_dict[k]._data = ex.arg_dict[k]._data \
+                    - 0.5 * ex.grad_dict[k]._data
+    print("numpy-grad loss %.4f -> %.4f" % (losses[0], losses[-1]))
+    assert losses[-1] < losses[0] * 0.5
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""SequentialModule (reference example/module/sequential_module.py):
+chain two Modules — feature extractor then classifier — and train them
+as one unit."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+
+
+def main():
+    r = np.random.RandomState(3)
+    n = 512
+    y = (r.rand(n) * 4).astype("f")
+    x = r.rand(n, 32).astype("f") * 0.1
+    for i in range(n):
+        x[i, int(y[i]) * 8:int(y[i]) * 8 + 8] += 1.0
+
+    feat = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                              name="feat_fc"), act_type="relu")
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("feat"), num_hidden=4,
+                              name="head_fc"), name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, label_names=()))
+    seq.add(mx.mod.Module(head, data_names=("feat",)),
+            take_labels=True, auto_wiring=True)
+
+    it = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    seq.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3},
+            initializer=mx.init.Xavier())
+    metric = mx.metric.Accuracy()
+    score = seq.score(it, metric)
+    print("sequential module accuracy:", score)
+    assert dict(score)["accuracy"] > 0.9, score
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -111,6 +111,15 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    @property
+    def learning_rate(self):
+        """Current base learning rate (reference optimizer.py
+        Optimizer.learning_rate: scheduler value at num_update when a
+        scheduler is set, else the static lr)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
     # -- multipliers -------------------------------------------------------
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:

@@ -458,6 +458,28 @@ class ShardedTrainer:
         the Module.forward_backward+update equivalent."""
         return float(self.step_async(data, label).asnumpy())
 
+    def compiled_step(self, data, label):
+        """AOT-compile the fused training step for these batch shapes and
+        return (jax Compiled object, None). Does NOT execute anything:
+        use for XLA's own reports — memory_analysis() (the memcost
+        example reads peak activation memory per remat setting),
+        cost_analysis(), as_text()."""
+        data_list = data if isinstance(data, (list, tuple)) else [data]
+        if not self._placed:
+            self._place([NDArray(_as_jax(d)) for d in data_list])
+        inputs = self._shard_batch(data_list)
+        label_j = self._shard_batch([label])[0]
+        skey = ("train", tuple(tuple(i.shape) for i in inputs),
+                tuple(label_j.shape))
+        if skey not in self._step_fns:
+            self._step_fns[skey] = self._build_step(skey, len(inputs),
+                                                    True)
+        key, t, lr = self._device_step_state()
+        lowered = self._step_fns[skey].lower(
+            tuple(self._param_vals), tuple(self._opt_states),
+            tuple(self._aux_vals), tuple(inputs), label_j, key, t, lr)
+        return lowered.compile(), None
+
     def forward(self, data, label):
         """Evaluation forward: returns (loss, outputs) without updating."""
         data_list = data if isinstance(data, (list, tuple)) else [data]

@@ -46,6 +46,19 @@ EXAMPLES = [
     ("reinforcement-learning/dqn_toy.py", {}),
     ("captcha/captcha_toy.py", {}),
     ("dsd/dsd_toy.py", {}),
+    ("gluon/mnist.py", {}),
+    ("gluon/kaggle_k_fold_cross_validation.py", {}),
+    ("gluon/lstm_crf.py", {}),
+    ("gluon/actor_critic.py", {}),
+    ("gluon/super_resolution.py", {}),
+    ("gluon/word_language_model.py", {}),
+    ("gluon/learning_rate_manipulation.py", {}),
+    ("module/mnist_mlp.py", {}),
+    ("module/python_loss.py", {}),
+    ("module/sequential_module.py", {}),
+    ("rnn-time-major/rnn_cell_demo.py", {}),
+    ("memcost/inception_memcost.py", {}),
+    ("cnn_chinese_text_classification/text_cnn.py", {}),
 ]
 
 
