@@ -1,0 +1,84 @@
+"""TVM bridge (reference src/nnvm/tvm_bridge.cc:174 MXTVMBridge).
+
+The reference exposes MXNet's async engine to TVM so TVM-compiled
+PackedFuncs run inside MXNet graphs with correct read/mutate
+dependencies (``WrapAsyncCall``). The TPU-native rendering inverts the
+direction the same way the caffe bridge does: the PackedFunc executes as
+a host callback behind the CustomOp seam (mxtpu/operator.py), so
+everything around it stays XLA-compiled while TVM owns the wrapped
+computation; buffer handoff is zero-copy via DLPack where TVM accepts it
+(``tvm.nd.from_dlpack``), numpy otherwise.
+
+Optional exactly like the reference ("support for TVM is optional even
+when this code is always compiled"): importing this module never
+requires TVM; calling :func:`wrap_async_call` without a tvm install
+raises a pointed ImportError. The bridge logic is CI-tested against a
+TVM API fake (tests/test_plugins.py).
+
+Usage::
+
+    from mxtpu.contrib import tvm_bridge
+    f = tvm_bridge.wrap_async_call(my_packed_func, num_inputs=2)
+    c = f(a, b)          # a, b, c are mxtpu NDArrays
+
+where ``my_packed_func(in0, in1, out)`` follows TVM's
+destination-passing convention (last argument is the output buffer).
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def _tvm():
+    mod = sys.modules.get("tvm")
+    if mod is not None:
+        return mod
+    try:
+        import tvm  # noqa: F401
+        return sys.modules["tvm"]
+    except ImportError as e:
+        raise ImportError(
+            "mxtpu.contrib.tvm_bridge needs the tvm runtime ('import "
+            "tvm'); it is not installed in this environment. The bridge "
+            "runs TVM PackedFuncs as host callbacks inside XLA graphs — "
+            "install apache-tvm to use it") from e
+
+
+def _to_tvm(tvm_mod, host_np):
+    """numpy -> tvm.nd, via DLPack when available (zero host copy)."""
+    try:
+        return tvm_mod.nd.from_dlpack(host_np)
+    except Exception:
+        return tvm_mod.nd.array(host_np)
+
+
+def wrap_async_call(packed_func, num_inputs, out_shape=None,
+                    out_dtype=np.float32):
+    """Wrap a destination-passing TVM PackedFunc as an eager callable
+    over NDArrays (the WrapAsyncCall capability: correct dataflow
+    ordering comes from the framework — JAX's async dispatch — instead
+    of hand-managed engine vars).
+
+    packed_func(in_0, ..., in_{n-1}, out) is invoked with tvm.nd views
+    of the inputs and a preallocated output; out_shape defaults to the
+    first input's shape.
+    """
+    tvm_mod = _tvm()
+    from .. import ndarray as nd
+
+    def call(*arrays):
+        assert len(arrays) == num_inputs, \
+            "expected %d inputs" % num_inputs
+        host = [np.ascontiguousarray(a.asnumpy()) for a in arrays]
+        shape = out_shape or host[0].shape
+        out_host = np.zeros(shape, out_dtype)
+        args = [_to_tvm(tvm_mod, h) for h in host]
+        out_t = _to_tvm(tvm_mod, out_host)
+        packed_func(*args, out_t)
+        return nd.array(np.asarray(out_t.numpy()
+                                   if hasattr(out_t, "numpy")
+                                   else out_host))
+
+    return call

@@ -80,34 +80,35 @@ np.testing.assert_allclose(w_np, wt, atol=2e-2)
 # tests/nightly/dist_sync_kvstore.py): init broadcasts rank 0's value,
 # push SUMS each worker's contribution across all workers before the
 # updater applies, pull returns the identical merged state everywhere.
-results = {}
-for mode in ("dist_sync", "dist_async"):
-    kv = mx.kvstore.create(mode)
-    assert kv.type == mode
-    assert kv.rank == rank and kv.num_workers == nproc, \
-        (mode, kv.rank, kv.num_workers)
-    updates = []
-    # rank-varying init value: the broadcast must make rank 0's win
-    kv.init(9, mx.nd.ones((3,)) * (1 + rank * 100))
+# (dist_async is a real parameter-server mode now — it needs launcher
+# -s N server processes and has its own straggler nightly,
+# tests/nightly/async_worker.py; only the sync contract is checked here)
+kv = mx.kvstore.create("dist_sync")
+assert kv.type == "dist_sync"
+assert kv.rank == rank and kv.num_workers == nproc, \
+    (kv.rank, kv.num_workers)
+updates = []
+# rank-varying init value: the broadcast must make rank 0's win
+kv.init(9, mx.nd.ones((3,)) * (1 + rank * 100))
 
-    def updater(key, recv, local, _log=updates):
-        _log.append(int(key))
-        local[:] = local - 0.1 * recv
 
-    kv._set_updater(updater)
-    kv.push(9, mx.nd.ones((3,)) * (rank + 1))
-    out = mx.nd.zeros((3,))
-    kv.pull(9, out=out)
-    # updater applied exactly once per push in both modes (the reference's
-    # server-side merge-then-apply, kvstore_dist_server.h:279-339)
-    assert updates == [9], (mode, updates)
-    # merged push = sum over workers of (rank+1); init = rank 0's ones
-    expect_kv = 1.0 - 0.1 * sum(r + 1 for r in range(nproc))
-    np.testing.assert_allclose(out.asnumpy(),
-                               np.full((3,), expect_kv, np.float32),
-                               rtol=1e-6)
-    kv.barrier()
-    results[mode] = out.asnumpy()
-np.testing.assert_array_equal(results["dist_sync"], results["dist_async"])
+def updater(key, recv, local, _log=updates):
+    _log.append(int(key))
+    local[:] = local - 0.1 * recv
+
+
+kv._set_updater(updater)
+kv.push(9, mx.nd.ones((3,)) * (rank + 1))
+out = mx.nd.zeros((3,))
+kv.pull(9, out=out)
+# updater applied exactly once per push (the reference's server-side
+# merge-then-apply, kvstore_dist_server.h:279-339)
+assert updates == [9], updates
+# merged push = sum over workers of (rank+1); init = rank 0's ones
+expect_kv = 1.0 - 0.1 * sum(r + 1 for r in range(nproc))
+np.testing.assert_allclose(out.asnumpy(),
+                           np.full((3,), expect_kv, np.float32),
+                           rtol=1e-6)
+kv.barrier()
 
 print("RANK_%d_OK nprocs=%d ndevices=%d" % (rank, nproc, n))

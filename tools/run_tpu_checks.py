@@ -191,7 +191,10 @@ def check_profile(report):
     tuned = best_measured_config() or (32, False)
     batch, nhwc = tuned
     trace_root = os.path.join(ROOT, "docs", "traces")
-    xp_dir = os.path.join(trace_root, "xplane")
+    final_xp_dir = os.path.join(trace_root, "xplane")
+    # trace into a scratch dir and swap in only on success — a failed
+    # retry must not destroy previously committed trace evidence
+    xp_dir = os.path.join(trace_root, ".xplane_tmp")
     shutil.rmtree(xp_dir, ignore_errors=True)
     os.makedirs(xp_dir, exist_ok=True)
     try:
@@ -229,14 +232,18 @@ def check_profile(report):
             dst = os.path.join(trace_root, "resnet50_step_trace.json.gz")
             shutil.copy(found[0], dst)
             res["chrome_trace"] = os.path.relpath(dst, ROOT)
-        xplanes = sorted(glob.glob(os.path.join(
-            xp_dir, "**", "*.xplane.pb"), recursive=True))
-        if xplanes:
+        if glob.glob(os.path.join(xp_dir, "**", "*.xplane.pb"),
+                     recursive=True):
+            shutil.rmtree(final_xp_dir, ignore_errors=True)
+            os.rename(xp_dir, final_xp_dir)
+            xplanes = sorted(glob.glob(os.path.join(
+                final_xp_dir, "**", "*.xplane.pb"), recursive=True))
             res["xplane"] = os.path.relpath(xplanes[0], ROOT)
     except Exception as e:
         res["error"] = repr(e)[:300]
     finally:
         os.environ.pop("MXTPU_CONV_LAYOUT", None)
+        shutil.rmtree(xp_dir, ignore_errors=True)
     _flush(report)
 
 
@@ -247,14 +254,8 @@ def check_io_pipeline(report):
     bottleneck' (reference methodology: train_imagenet.py over
     iter_image_recordio_2.cc)."""
     import tempfile
-    import jax
-    import mxtpu as mx
-    from mxtpu import gluon
-    from mxtpu.gluon.model_zoo import vision
-    from mxtpu.parallel import MeshContext, ShardedTrainer
 
     sys.path.insert(0, os.path.join(ROOT, "tools"))
-    from bench_io import gen_dataset, measure_iter
 
     res = {}
     report["io_pipeline"] = res
