@@ -1,0 +1,132 @@
+"""Kaggle NDSB-1 plankton pipeline (reference example/kaggle-ndsb1/:
+gen_img_list -> im2rec -> train_dsb -> predict_dsb -> submission).
+
+Self-contained: synthesizes a tiny many-class plankton-style image set,
+packs it with tools/im2rec.py (the reference flow), trains the small
+"dsb" CNN via Module.fit over ImageRecordIter, then writes a
+competition-format submission CSV with per-class probabilities —
+the full tool chain of the reference suite in one runnable script.
+"""
+import argparse
+import csv
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+
+N_CLASSES = 8
+IMG = 48
+
+
+def gen_img_list(root, n_per_class, rng):
+    """Synthetic grayscale 'plankton': one blob archetype per class
+    (reference gen_img_list.py builds the train list from class dirs)."""
+    from PIL import Image
+    img_dir = os.path.join(root, "img")
+    os.makedirs(img_dir, exist_ok=True)
+    rows = []
+    idx = 0
+    yy, xx = np.mgrid[0:IMG, 0:IMG].astype(np.float32)
+    for c in range(N_CLASSES):
+        ang = 2 * np.pi * c / N_CLASSES
+        cx, cy = IMG / 2 + 12 * np.cos(ang), IMG / 2 + 12 * np.sin(ang)
+        for _ in range(n_per_class):
+            jx, jy = rng.uniform(-3, 3, 2)
+            r2 = (xx - cx - jx) ** 2 + (yy - cy - jy) ** 2
+            img = 255 * np.exp(-r2 / (2 * (4 + c) ** 2))
+            img += rng.uniform(0, 40, (IMG, IMG))
+            rel = "p%05d.jpg" % idx
+            Image.fromarray(np.clip(img, 0, 255).astype(np.uint8)) \
+                .convert("RGB").save(os.path.join(img_dir, rel))
+            rows.append((idx, c, rel))
+            idx += 1
+    rng.shuffle(rows)
+    lst = os.path.join(root, "tr.lst")
+    with open(lst, "w") as f:
+        for i, c, rel in rows:
+            f.write("%d\t%d\t%s\n" % (i, c, rel))
+    tools = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+    subprocess.run([sys.executable, os.path.join(tools, "im2rec.py"),
+                    os.path.join(root, "tr"), img_dir + "/"],
+                   check=True, capture_output=True,
+                   env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    return os.path.join(root, "tr.rec"), rows
+
+
+def get_dsb_sym():
+    """The reference's small 'dsb' convnet (symbol_dsb.py shape)."""
+    data = mx.sym.Variable("data")
+    net = data
+    for i, nf in enumerate((16, 32, 64)):
+        net = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=nf, name="conv%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=128)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.3)
+    net = mx.sym.FullyConnected(net, num_hidden=N_CLASSES)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--per-class", type=int, default=40)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    root = tempfile.mkdtemp(prefix="mxtpu_ndsb1_")
+    rec, rows = gen_img_list(root, args.per_class, rng)
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, IMG, IMG),
+        batch_size=args.batch_size, shuffle=True, rand_mirror=True,
+        mean_r=128, mean_g=128, mean_b=128, std_r=60, std_g=60,
+        std_b=60)
+    mod = mx.mod.Module(get_dsb_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.002},
+            initializer=mx.init.Xavier(), eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       10))
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    print("train accuracy %.3f" % acc)
+    assert acc > 0.85, acc
+
+    # predict + submission CSV (reference predict_dsb.py +
+    # submission_dsb.py: header of class names, one prob row per image)
+    it.reset()
+    probs, ids = [], []
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        p = mod.get_outputs()[0].asnumpy()
+        n_valid = args.batch_size - (batch.pad or 0)
+        probs.append(p[:n_valid])
+        ids.extend(batch.index[:n_valid] if batch.index is not None
+                   else range(len(ids), len(ids) + n_valid))
+    probs = np.concatenate(probs)
+    sub = os.path.join(root, "submission.csv")
+    with open(sub, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["image"] + ["class%d" % c for c in range(N_CLASSES)])
+        for i, p in zip(ids, probs):
+            w.writerow(["p%05d.jpg" % int(i)] +
+                       ["%.6f" % v for v in p])
+    n_rows = sum(1 for _ in open(sub)) - 1
+    assert n_rows == len(probs)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+    print("submission written: %s (%d rows)" % (sub, n_rows))
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

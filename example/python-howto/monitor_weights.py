@@ -1,0 +1,45 @@
+"""How to monitor per-op tensor stats during training (reference
+example/python-howto/monitor_weights.py): mx.mon.Monitor hooks the
+executor's monitor callback and dumps a stat per output each step."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+
+
+def main():
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    mon = mx.monitor.Monitor(interval=1, stat_func=lambda a:
+                             mx.nd.norm(a) / np.sqrt(a.size))
+    mod = mx.mod.Module(sym)
+    r = np.random.RandomState(0)
+    x = r.rand(64, 8).astype("f")
+    y = (r.rand(64) * 4).astype("f")
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    mod.install_monitor(mon)
+    seen = []
+    for batch in it:
+        mon.tic()
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        for name, key, val in mon.toc():
+            seen.append(key)
+    assert any("fc" in k for k in seen), seen
+    print("monitored %d stats, e.g. %s" % (len(seen), seen[:3]))
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

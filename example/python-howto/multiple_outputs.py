@@ -1,0 +1,34 @@
+"""How to build a multi-output symbol (reference
+example/python-howto/multiple_outputs.py): Group several heads and read
+them all from one executor."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+
+
+def main():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="relu")
+    out = mx.sym.Group([fc, act, mx.sym.BlockGrad(act)])
+    print("outputs:", out.list_outputs())
+    assert len(out.list_outputs()) == 3
+
+    ex = out.simple_bind(mx.cpu(), data=(2, 4))
+    r = np.random.RandomState(0)
+    ex.arg_dict["data"][:] = r.randn(2, 4).astype("f")
+    for k, v in ex.arg_dict.items():
+        if k != "data":
+            v[:] = r.uniform(-1, 1, v.shape).astype("f")
+    fc_o, act_o, blocked = [o.asnumpy() for o in ex.forward()]
+    np.testing.assert_allclose(act_o, np.maximum(fc_o, 0), rtol=1e-6)
+    np.testing.assert_allclose(blocked, act_o, rtol=1e-6)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -72,13 +72,17 @@ def wrap_async_call(packed_func, num_inputs, out_shape=None,
         assert len(arrays) == num_inputs, \
             "expected %d inputs" % num_inputs
         host = [np.ascontiguousarray(a.asnumpy()) for a in arrays]
-        shape = out_shape or host[0].shape
+        shape = host[0].shape if out_shape is None else out_shape
         out_host = np.zeros(shape, out_dtype)
         args = [_to_tvm(tvm_mod, h) for h in host]
         out_t = _to_tvm(tvm_mod, out_host)
         packed_func(*args, out_t)
-        return nd.array(np.asarray(out_t.numpy()
-                                   if hasattr(out_t, "numpy")
-                                   else out_host))
+        if hasattr(out_t, "numpy"):
+            result = out_t.numpy()
+        elif hasattr(out_t, "asnumpy"):      # tvm < 0.8 spelling
+            result = out_t.asnumpy()
+        else:                                # dlpack view: written in place
+            result = out_host
+        return nd.array(np.asarray(result))
 
     return call

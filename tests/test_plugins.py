@@ -238,7 +238,6 @@ def test_tvm_bridge_missing_tvm_message():
 
 
 def test_tvm_bridge_wrap_async_call_with_fake(monkeypatch):
-    import types
     from mxtpu.contrib import tvm_bridge
     monkeypatch.setitem(sys.modules, "tvm", _FakeTvmMod())
 

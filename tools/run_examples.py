@@ -59,6 +59,10 @@ EXAMPLES = [
     ("rnn-time-major/rnn_cell_demo.py", {}),
     ("memcost/inception_memcost.py", {}),
     ("cnn_chinese_text_classification/text_cnn.py", {}),
+    ("kaggle-ndsb1/train_dsb.py", {}),
+    ("python-howto/data_iter.py", {}),
+    ("python-howto/multiple_outputs.py", {}),
+    ("python-howto/monitor_weights.py", {}),
 ]
 
 
