@@ -103,28 +103,38 @@ def main():
     assert acc > 0.85, acc
 
     # predict + submission CSV (reference predict_dsb.py +
-    # submission_dsb.py: header of class names, one prob row per image)
-    it.reset()
-    probs, ids = [], []
-    for batch in it:
+    # submission_dsb.py: header of class names, one prob row per image).
+    # Deterministic eval iterator: NO shuffle, NO augmentation — record
+    # order equals the .lst order im2rec packed, so row k's filename is
+    # rows[k]'s image.
+    eval_it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, IMG, IMG),
+        batch_size=args.batch_size, shuffle=False,
+        mean_r=128, mean_g=128, mean_b=128, std_r=60, std_g=60,
+        std_b=60)
+    probs = []
+    for batch in eval_it:
         mod.forward(batch, is_train=False)
         p = mod.get_outputs()[0].asnumpy()
-        n_valid = args.batch_size - (batch.pad or 0)
-        probs.append(p[:n_valid])
-        ids.extend(batch.index[:n_valid] if batch.index is not None
-                   else range(len(ids), len(ids) + n_valid))
+        probs.append(p[:args.batch_size - (batch.pad or 0)])
     probs = np.concatenate(probs)
+    assert len(probs) == len(rows)
     sub = os.path.join(root, "submission.csv")
     with open(sub, "w", newline="") as f:
         w = csv.writer(f)
         w.writerow(["image"] + ["class%d" % c for c in range(N_CLASSES)])
-        for i, p in zip(ids, probs):
-            w.writerow(["p%05d.jpg" % int(i)] +
-                       ["%.6f" % v for v in p])
+        for (_, label, rel), p in zip(rows, probs):
+            w.writerow([rel] + ["%.6f" % v for v in p])
     n_rows = sum(1 for _ in open(sub)) - 1
     assert n_rows == len(probs)
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
-    print("submission written: %s (%d rows)" % (sub, n_rows))
+    # alignment sanity: the argmax class must match the named image's
+    # true label for the (near-perfectly trained) model
+    top = probs.argmax(axis=1)
+    agree = np.mean([t == c for t, (_, c, _) in zip(top, rows)])
+    assert agree > 0.85, agree
+    print("submission written: %s (%d rows, label agreement %.2f)"
+          % (sub, n_rows, agree))
     print("OK")
 
 

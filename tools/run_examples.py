@@ -63,6 +63,7 @@ EXAMPLES = [
     ("python-howto/data_iter.py", {}),
     ("python-howto/multiple_outputs.py", {}),
     ("python-howto/monitor_weights.py", {}),
+    ("mxnet_adversarial_vae/avae_toy.py", {}),
 ]
 
 
