@@ -336,6 +336,64 @@ def _check_io_pipeline_body(report, res, root, batch, n_images):
     _flush(report)
 
 
+def check_inference(report):
+    """benchmark_score tier (reference docs/faq/perf.md:107-144 P100
+    inference tables: ResNet-50 713.17, VGG 854.4, Inc-v3 493.72 img/s
+    at batch 32): forward-only throughput through the hybridized zoo
+    nets, fp32 (the reference's methodology) and bf16 (the TPU-native
+    serving dtype), NCHW and NHWC."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "benchmark_score", os.path.join(
+            ROOT, "example", "image-classification",
+            "benchmark_score.py"))
+    bs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bs)
+    import mxtpu as mx
+
+    res = report.setdefault("inference", {})
+    baselines = {"resnet-50": 713.17, "vgg16": 854.4,
+                 "inception-v3": 493.72}    # perf.md:144, P100 batch 32
+    for name, baseline in baselines.items():
+        hw = 299 if "inception" in name else 224
+        for dtype in ("float32", "bfloat16"):
+            for nhwc in (False, True):
+                key = "%s_b32_%s%s" % (name, dtype,
+                                       "_nhwc" if nhwc else "")
+                if "img_per_sec" in res.get(key, {}):
+                    continue   # real number from an earlier window
+                try:
+                    if nhwc:
+                        os.environ["MXTPU_CONV_LAYOUT"] = "NHWC"
+                    else:
+                        os.environ.pop("MXTPU_CONV_LAYOUT", None)
+                    mx.random.seed(0)
+                    net = bs.MODELS[name]()
+                    net.initialize(mx.init.Xavier(), force_reinit=True)
+                    if dtype == "bfloat16":
+                        net.cast("bfloat16")
+                    net.hybridize()
+                    x = mx.nd.array(np.random.uniform(
+                        size=(32, 3, hw, hw)).astype(np.float32))
+                    if dtype == "bfloat16":
+                        x = x.astype("bfloat16")
+                    out = net(x)
+                    out.wait_to_read()
+                    t0 = time.perf_counter()
+                    for _ in range(20):
+                        out = net(x)
+                    out.wait_to_read()
+                    img_s = 32 * 20 / (time.perf_counter() - t0)
+                    res[key] = {"img_per_sec": round(img_s, 1),
+                                "vs_baseline": round(img_s / baseline,
+                                                     2)}
+                except Exception as e:
+                    res[key] = {"error": repr(e)[:200]}
+                finally:
+                    os.environ.pop("MXTPU_CONV_LAYOUT", None)
+                _flush(report)
+
+
 def check_pallas_rnn(report):
     import jax
     import jax.numpy as jnp
@@ -536,6 +594,7 @@ STAGES = [
     ("roofline", check_roofline, 600),
     ("bench_nhwc", check_bench_nhwc, 1500),
     ("bench", check_bench, 2700),
+    ("inference", check_inference, 1800),
     ("profile", check_profile, 1200),
     ("io_pipeline", check_io_pipeline, 1800),
     ("pallas_rnn", check_pallas_rnn, 1200),
