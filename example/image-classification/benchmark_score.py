@@ -28,13 +28,17 @@ MODELS = {
 }
 
 
-def score(model_name, batch, hw, n_iter=10):
+def score(model_name, batch, hw, n_iter=10, dtype="float32"):
     mx.random.seed(0)
     net = MODELS[model_name]()
-    net.initialize(mx.init.Xavier())
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+    if dtype != "float32":
+        net.cast(dtype)
     net.hybridize()
     x = mx.nd.array(np.random.uniform(
         size=(batch, 3, hw, hw)).astype(np.float32))
+    if dtype != "float32":
+        x = x.astype(dtype)
     # warmup/compile
     out = net(x)
     out.wait_to_read()

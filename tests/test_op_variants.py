@@ -798,3 +798,57 @@ def test_bn_bf16_consistency():
                            mx.sym.var("mv"), fix_gamma=False)
     check_consistency(sym, _bf16_ctx_list(a0=(4, 3, 6, 6), a1=(3,),
                                           a2=(3,)))
+
+
+# ---- pooling depth: 1-D/3-D, count_include_pad, stride>kernel -------------
+
+def test_pooling_1d_3d():
+    r = _r(31)
+    # 1-D max/avg (NCW)
+    x1 = r.uniform(-1, 1, (2, 3, 9)).astype(np.float32)
+    ref = np_pool2d(x1[:, :, None, :], (1, 3), "max", (1, 2),
+                    (0, 0))[:, :, 0]
+    _check(lambda a: mx.sym.Pooling(a, kernel=(3,), stride=(2,),
+                                    pool_type="max"), [x1], ref,
+           grad=False)
+    # 3-D avg (NCDHW) vs explicit loop
+    x3 = r.uniform(-1, 1, (1, 2, 4, 4, 4)).astype(np.float32)
+    out = np.zeros((1, 2, 2, 2, 2), np.float64)
+    for d in range(2):
+        for i in range(2):
+            for j in range(2):
+                out[0, :, d, i, j] = x3[0, :, 2*d:2*d+2, 2*i:2*i+2,
+                                        2*j:2*j+2].mean(axis=(1, 2, 3))
+    _check(lambda a: mx.sym.Pooling(a, kernel=(2, 2, 2),
+                                    stride=(2, 2, 2), pool_type="avg"),
+           [x3], out.astype(np.float32))
+
+
+def test_pooling_stride_exceeds_kernel():
+    """stride > kernel skips input positions entirely (valid in the
+    reference; windows must not overlap or read out of bounds)."""
+    r = _r(32)
+    x = r.uniform(-1, 1, (1, 1, 8, 8)).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(3, 3),
+                     pool_type="max").asnumpy()
+    assert out.shape == (1, 1, 3, 3)
+    want = np.zeros((3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            want[i, j] = x[0, 0, 3*i:3*i+2, 3*j:3*j+2].max()
+    np.testing.assert_allclose(out[0, 0], want, rtol=1e-6)
+
+
+def test_avg_pool_count_include_pad():
+    """count_include_pad=False divides by the VALID window size at the
+    borders (reference pooling-inl.h GetPadAvg behavior)."""
+    x = np.ones((1, 1, 3, 3), np.float32)
+    incl = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                      pad=(1, 1), pool_type="avg",
+                      count_include_pad=True).asnumpy()
+    excl = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                      pad=(1, 1), pool_type="avg",
+                      count_include_pad=False).asnumpy()
+    # corner window: one valid element of value 1
+    np.testing.assert_allclose(excl[0, 0, 0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(incl[0, 0, 0, 0], 0.25, rtol=1e-6)
