@@ -28,7 +28,10 @@ from mxtpu.parallel import MeshContext, ShardedTrainer  # noqa: E402
 def main():
     import jax
 
-    profiler.set_config(filename="resnet_profile.json")
+    import tempfile
+    trace_path = os.path.join(tempfile.mkdtemp(prefix="mxtpu_prof_"),
+                              "resnet_profile.json")
+    profiler.set_config(filename=trace_path)
     profiler.set_state("run")
 
     net = vision.get_resnet(1, 18)
@@ -43,7 +46,7 @@ def main():
 
     profiler.set_state("stop")
     profiler.dump()
-    print("chrome trace written to resnet_profile.json")
+    print("chrome trace written to %s" % trace_path)
 
     # stage attribution from the compiled step's HLO metadata: count ops
     # per named_scope prefix (resnet stages + fwd_bwd/optimizer phases)
