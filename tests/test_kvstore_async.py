@@ -172,3 +172,29 @@ def test_key_sharding_across_servers():
         _restore_env(saved)
         s1.stop()
         s2.stop()
+
+
+def test_module_fit_through_dist_async():
+    """Module.fit with kvstore='dist_async': grads push to the parameter
+    service, SGD runs server-side (update_on_kvstore), weights pull back
+    — the reference's async training loop shape, single-process."""
+    r = np.random.RandomState(5)
+    y = (r.rand(192) * 4).astype("f")
+    x = r.rand(192, 16).astype("f") * 0.1
+    for i in range(192):
+        x[i, int(y[i]) * 4:int(y[i]) * 4 + 4] += 1.0
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4),
+        name="softmax")
+    mod = mx.mod.Module(sym)
+    mod.fit(it, num_epoch=4, kvstore="dist_async", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
+    # the optimizer really ran server-side: pushes were counted there
+    kv = mod._kvstore
+    stats = kv.staleness_stats()
+    assert stats["pushes"] >= 4 * 6 * 2  # epochs * batches * params
+    kv.close()
