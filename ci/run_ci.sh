@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# CI entry (reference Jenkinsfile + ci/build.py + runtime_functions.sh,
+# collapsed to the tiers that exist on a single host):
+#
+#   ci/run_ci.sh sanity    - compile every python file + native build
+#   ci/run_ci.sh fast      - pre-merge test tier (< 2 min)
+#   ci/run_ci.sh nightly   - full suite + example sweep + graft entry
+#
+# Env: JAX_PLATFORMS=cpu is forced for test tiers (tests/conftest.py
+# re-asserts it); the TPU measurement path is tools/run_tpu_checks.py,
+# run out-of-band when the chip answers.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+tier="${1:-fast}"
+
+case "$tier" in
+  sanity)
+    python -m compileall -q mxtpu tools tests example
+    make -C mxtpu/_native
+    ;;
+  fast)
+    JAX_PLATFORMS=cpu python -m pytest tests/ -m fast -q
+    ;;
+  nightly)
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q
+    JAX_PLATFORMS=cpu python tools/run_examples.py
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      JAX_PLATFORMS=cpu python __graft_entry__.py
+    ;;
+  *)
+    echo "usage: $0 {sanity|fast|nightly}" >&2
+    exit 2
+    ;;
+esac
+echo "ci tier '$tier' OK"
