@@ -696,8 +696,8 @@ def main():
         if time.time() >= deadline:
             break
         remaining = int((deadline - time.time()) / 60)
-        print("relay down; retrying for up to %d more minutes" % remaining,
-              flush=True)
+        print("[%s] relay down; retrying for up to %d more minutes"
+              % (time.strftime("%F %T"), remaining), flush=True)
         time.sleep(min(900, max(60, deadline - time.time())))
 
     if pending:
