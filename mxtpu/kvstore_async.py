@@ -27,10 +27,33 @@ push (``stats()``/``kv.staleness_stats()``). The nightly straggler test
 and that observed staleness > 0 — the behavior sync mode cannot produce.
 
 Key sharding across multiple servers mirrors ps-lite's key→server
-assignment (``kvstore_dist.h`` BIGARRAY_BOUND key splits): each key lives
-on ``servers[hash(key) % n]``; servers are independent and never talk to
-each other. ``tools/launch.py -s N`` starts N server processes
-(DMLC_ROLE=server) and exports ``MXTPU_PS_ADDRS`` to every worker.
+assignment: each key lives on ``servers[crc32(key) % n]``; servers are
+independent and never talk to each other. Big arrays additionally split
+into row-contiguous parts (the reference's
+``MXNET_KVSTORE_BIGARRAY_BOUND`` key splits, ``kvstore_dist.h:500-540``;
+bound here via ``MXTPU_KVSTORE_BIGARRAY_BOUND``, default 1e6 elements):
+each part is an independent subkey with its own server assignment, lock,
+clock, and optimizer-state slot — sound because every built-in optimizer
+update is elementwise, so updating row-slices independently computes the
+same result as the whole array. Parts move concurrently over a worker
+thread pool, so a push/pull of a 100 MB table pipelines across servers
+instead of serializing through one socket. ``tools/launch.py -s N``
+starts N server processes (DMLC_ROLE=server) and exports
+``MXTPU_PS_ADDRS`` to every worker.
+
+Wire compression: ``set_gradient_compression({'type': '2bit'})`` makes
+``push`` ship the 2-bit packed form (16x smaller) with a per-part
+worker-side error-feedback residual; the server dequantizes before its
+update — the reference's compressed-push pipeline
+(``kvstore_dist.h`` PushCompressed) rendered over this transport.
+
+Trust model: the wire format is pickle, so the service must only be
+reachable by processes of the same launch — it binds loopback by
+default, and ``tools/launch.py`` additionally exports a per-launch
+shared secret (``MXTPU_PS_TOKEN``); when set, every connection must
+present it in an ``auth`` frame before any other command, and failed
+auth closes the socket without unpickling anything further. Do not
+expose the port beyond hosts you trust with code execution.
 
 Single-process use (no launcher env) spins up an in-process server
 thread, so ``create('dist_async')`` is runnable — and genuinely
@@ -74,6 +97,48 @@ __all__ = ["ParameterServer", "AsyncDistKVStore", "serve_forever"]
 
 _LEN = struct.Struct("<Q")
 
+# ps-lite's MXNET_KVSTORE_BIGARRAY_BOUND analogue: arrays above this many
+# elements split into row-contiguous parts, each its own subkey
+_BIGARRAY_BOUND = int(os.environ.get(
+    "MXTPU_KVSTORE_BIGARRAY_BOUND", "1000000"))
+
+_GC_MARK = "gc2bit"  # wire tag for a 2-bit-compressed push payload
+
+
+def _slice_part(arr, lo, hi):
+    """Row slice of a part payload; rank-0 arrays are always one whole
+    part (a 0-d numpy array cannot be indexed)."""
+    return arr if arr.ndim == 0 else arr[lo:hi]
+
+
+def _part_bounds(shape, bound=None):
+    """Row ranges ``[(start, end), ...]`` splitting an array of ``shape``
+    into parts of at most ~``bound`` elements. One part for small or
+    rank-0 arrays."""
+    bound = _BIGARRAY_BOUND if bound is None else bound
+    size = 1
+    for d in shape:
+        size *= int(d)
+    nrows = int(shape[0]) if len(shape) else 1
+    if size <= bound or nrows <= 1:
+        return [(0, nrows)]
+    rows_per = max(1, bound // max(size // nrows, 1))
+    return [(r, min(r + rows_per, nrows))
+            for r in range(0, nrows, rows_per)]
+
+
+def _wire_decode(grad):
+    """Server side of the push payload: dense ndarray passes through;
+    a 2-bit-compressed tuple is dequantized (reference PushCompressed →
+    server-side dequantize, kvstore_dist_server.h)."""
+    if isinstance(grad, tuple) and len(grad) == 4 and grad[0] == _GC_MARK:
+        from .gradient_compression import dequantize_2bit
+        _, threshold, packed, shape = grad
+        import jax.numpy as jnp
+        return _np.asarray(dequantize_2bit(jnp.asarray(packed),
+                                           threshold, shape))
+    return grad
+
 
 def _send_frame(sock, obj):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
@@ -90,15 +155,46 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
+_MAX_FRAME = 1 << 34   # 16 GiB: far above any real push, far below the
+                       # garbage lengths a protocol mismatch produces
+
+
 def _recv_frame(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_FRAME:
+        # e.g. a tokened worker talking to a tokenless server: the raw
+        # auth preamble parses as an absurd frame length — fail loudly
+        # instead of blocking in _recv_exact forever
+        raise ConnectionError(
+            "oversized frame length %d — protocol mismatch (is "
+            "MXTPU_PS_TOKEN set on one side only?)" % n)
     return pickle.loads(_recv_exact(sock, n))
+
+
+_AUTH_MAGIC = b"MXA1"
+
+
+def _auth_blob(token):
+    """Fixed-length raw preamble proving knowledge of the launch secret.
+    Deliberately NOT a pickle frame: the point of auth is that no
+    attacker-controlled bytes reach pickle.loads, so the check must
+    happen on raw bytes before the first frame is read."""
+    import hashlib
+    return _AUTH_MAGIC + hashlib.sha256(token.encode("utf-8")).digest()
 
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server = self.server.owner
         try:
+            if server._token:
+                # exact-length raw compare before any unpickling; a
+                # wrong preamble closes the socket silently
+                import hmac
+                expected = _auth_blob(server._token)
+                got = _recv_exact(self.request, len(expected))
+                if not hmac.compare_digest(got, expected):
+                    return
             while True:
                 msg = _recv_frame(self.request)
                 reply = server._dispatch(msg)
@@ -118,14 +214,21 @@ class ParameterServer:
     """Host-side async parameter table (reference KVStoreDistServer with
     ``sync_mode_ == false``, kvstore_dist_server.h:339,462)."""
 
-    def __init__(self, port=0, host="127.0.0.1"):
+    def __init__(self, port=0, host="127.0.0.1", token=None):
         self._tcp = _TCPServer((host, port), _Handler)
         self._tcp.owner = self
+        self._token = token if token is not None \
+            else os.environ.get("MXTPU_PS_TOKEN") or None
         self._table = {}           # key -> NDArray (host-side, cpu jax)
         self._locks = {}           # key -> Lock (per-key serialization)
         self._locks_guard = threading.Lock()
         self._clock = {}           # key -> applied-update count
         self._updater = None
+        # one server-wide lock around updater invocations: the Updater and
+        # Optimizer carry cross-key shared state (states dict,
+        # num_update's read-modify-write max), which per-key locks alone
+        # would race on
+        self._updater_lock = threading.Lock()
         self._stale_max = 0
         self._stale_sum = 0
         self._stale_n = 0
@@ -174,11 +277,12 @@ class ParameterServer:
                 self._stale_max = max(self._stale_max, stale)
                 self._stale_sum += stale
                 self._stale_n += 1
-                g = nd.array(grad)
+                g = nd.array(_wire_decode(grad))
                 store = self._table[key]
                 if self._updater is not None:
                     # async semantics: apply THIS push now, no merge wait
-                    self._updater(_key_int(key), g, store)
+                    with self._updater_lock:
+                        self._updater(_key_int(key), g, store)
                 else:
                     store._data = store._data + g._data
                 self._clock[key] += 1
@@ -189,6 +293,15 @@ class ParameterServer:
                 if key not in self._table:
                     return ("err", "pull of uninitialized key %r" % (key,))
                 return ("ok", self._table[key].asnumpy(), self._clock[key])
+        if cmd == "pull_rows":
+            # sparse pull (reference kvstore_dist_server.h:631-792
+            # DataHandleRowSparse): only the requested rows travel
+            _, key, row_ids = msg
+            with self._lock_for(key):
+                if key not in self._table:
+                    return ("err", "pull of uninitialized key %r" % (key,))
+                rows = self._table[key].asnumpy()[row_ids]
+                return ("ok", rows, self._clock[key])
         if cmd == "set_optimizer":
             _, payload = msg
             opt = sys.modules.get("mxtpu.optimizer")
@@ -248,7 +361,7 @@ class _ServerConn:
     """One worker's connection to one server (thread-safe via a lock —
     the worker pushes from its training thread only, but keep it safe)."""
 
-    def __init__(self, addr, connect_timeout=60.0):
+    def __init__(self, addr, connect_timeout=60.0, token=None):
         host, _, port = addr.partition(":")
         # the launcher starts servers and workers simultaneously and a
         # server binds only after its (slow) mxtpu import + updater
@@ -267,11 +380,20 @@ class _ServerConn:
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
         self._lock = threading.Lock()
+        if token:
+            self._sock.sendall(_auth_blob(token))
 
     def request(self, *msg):
-        with self._lock:
-            _send_frame(self._sock, msg)
-            reply = _recv_frame(self._sock)
+        try:
+            with self._lock:
+                _send_frame(self._sock, msg)
+                reply = _recv_frame(self._sock)
+        except (ConnectionError, EOFError) as e:
+            raise ConnectionError(
+                "parameter server connection lost during %r: %s (a close "
+                "right after connect usually means MXTPU_PS_TOKEN does "
+                "not match between this worker and the server)"
+                % (msg[0], e)) from e
         if reply[0] == "err":
             raise RuntimeError("parameter server: %s" % reply[1])
         return reply
@@ -295,15 +417,24 @@ class AsyncDistKVStore(KVStore):
         self._size = int(os.environ.get(
             "MXTPU_NUM_PROCS", os.environ.get("DMLC_NUM_WORKER", "1")))
         addrs = os.environ.get("MXTPU_PS_ADDRS", "")
+        token = os.environ.get("MXTPU_PS_TOKEN") or None
         self._own_server = None
         if not addrs:
             # single-process: host the table in-process so the mode is
             # runnable (and truly async across threads) without a launcher
-            self._own_server = ParameterServer().start()
+            self._own_server = ParameterServer(token=token).start()
             addrs = self._own_server.address
-        self._conns = [_ServerConn(a.strip())
+        self._conns = [_ServerConn(a.strip(), token=token)
                        for a in addrs.split(",") if a.strip()]
-        self._base_clock = {}      # key -> clock of the last pull
+        self._base_clock = {}      # subkey -> clock of the last pull
+        self._parts = {}           # key -> [(subkey, row_lo, row_hi), ...]
+        self._shapes = {}          # key -> full array shape
+        from concurrent.futures import ThreadPoolExecutor
+        # parts of one array move concurrently (different sockets reach
+        # different servers in parallel; one socket still serializes)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * len(self._conns)),
+            thread_name_prefix="mxtpu-ps")
 
     # -- identity ---------------------------------------------------------
     @property
@@ -321,6 +452,36 @@ class AsyncDistKVStore(KVStore):
         digest = zlib.crc32(str(key).encode("utf-8"))
         return self._conns[digest % len(self._conns)]
 
+    # -- part plumbing ----------------------------------------------------
+    def _plan(self, k, shape):
+        """Record (and return) the part split for key ``k``. Every worker
+        computes the identical plan from the array shape, like ps-lite's
+        static key ranges. Recomputed whenever the shape differs from the
+        cached one — a failed pre-init push/pull must not poison the plan
+        the real init later establishes."""
+        plan = self._parts.get(k)
+        if plan is None or self._shapes.get(k) != tuple(shape):
+            bounds = _part_bounds(shape)
+            if len(bounds) == 1:
+                plan = [(k, 0, bounds[0][1])]
+            else:
+                plan = [("%s\x00%d" % (k, i), lo, hi)
+                        for i, (lo, hi) in enumerate(bounds)]
+            self._parts[k] = plan
+            self._shapes[k] = tuple(shape)
+        return plan
+
+    def _pmap(self, calls):
+        """Run request thunks concurrently on the pool; surface the first
+        failure. Ordering across parts is free — they are distinct keys.
+        The common single-part case runs inline: a pool handoff buys
+        nothing there and would tax every small parameter on the hot
+        training path."""
+        if len(calls) == 1:
+            return [calls[0]()]
+        futs = [self._pool.submit(c) for c in calls]
+        return [f.result() for f in futs]
+
     # -- core -------------------------------------------------------------
     def init(self, key, value):
         # reference KVStoreDist::InitImpl: rank 0's value is pushed to the
@@ -330,43 +491,133 @@ class AsyncDistKVStore(KVStore):
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
                 v = v[0]
+            plan = self._plan(k, v.shape)
             if self._rank == 0:
-                self._conn(k).request("init", k, v.asnumpy())
-            self._base_clock[k] = 0
+                arr = v.asnumpy()
+                self._pmap([
+                    (lambda sk=sk, lo=lo, hi=hi:
+                     self._conn(sk).request("init", sk,
+                                            _slice_part(arr, lo, hi)))
+                    for sk, lo, hi in plan])
+            for sk, _, _ in plan:
+                self._base_clock[sk] = 0
         self.barrier()
 
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
-                if len(v) > 1:
-                    v = [self._maybe_compress(k, i, a)
-                         for i, a in enumerate(v)]
                 merged = v[0].copy()
                 for arr in v[1:]:
                     merged._data = merged._data + arr._data
             else:
                 merged = v
-            self._conn(k).request("push", k, merged.asnumpy(),
-                                  self._base_clock.get(k, 0))
+            arr = merged.asnumpy()
+            self._pmap([
+                (lambda sk=sk, lo=lo, hi=hi:
+                 self._conn(sk).request(
+                     "push", sk,
+                     self._wire_payload(sk, _slice_part(arr, lo, hi)),
+                     self._base_clock.get(sk, 0)))
+                for sk, lo, hi in self._plan(k, merged.shape)])
+
+    def _wire_payload(self, subkey, part):
+        """Dense part, or its 2-bit packed form when compression is on
+        (per-part error-feedback residual lives worker-side, as the
+        reference's compressed push does)."""
+        if self._compression is None:
+            return part
+        import jax.numpy as jnp
+        packed = self._compression.compress(subkey, jnp.asarray(part))
+        return (_GC_MARK, self._compression.threshold,
+                _np.asarray(packed), part.shape)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
         for k, o in zip(keys, outs):
-            _, value, clock = self._conn(k).request("pull", k)
-            self._base_clock[k] = clock
-            arr = nd.array(value)
+            tgt0 = o[0] if isinstance(o, (list, tuple)) else o
+            plan = self._plan(k, tgt0.shape)
+            replies = self._pmap([
+                (lambda sk=sk: (sk, self._conn(sk).request("pull", sk)))
+                for sk, _, _ in plan])
+            pieces = []
+            for sk, (_, value, clock) in replies:
+                self._base_clock[sk] = clock
+                pieces.append(value)
+            full = pieces[0] if len(pieces) == 1 \
+                else _np.concatenate(pieces, axis=0)
+            arr = nd.array(full)
             for tgt in (o if isinstance(o, (list, tuple)) else [o]):
                 tgt._data = arr._data
-    # row_sparse_pull: inherited dense fallback is NOT available —
-    # the table lives server-side; async sparse pulls are out of scope
-    # (the reference's async mode is likewise dense-only in practice).
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        raise NotImplementedError(
-            "dist_async is a dense parameter service; use dist_sync for "
-            "row_sparse tables")
+        """Pull only the requested rows from the server table (reference
+        dist server sparse pulls, kvstore_dist_server.h:631-792
+        DataHandleRowSparse): each part owner slices its resident rows, so
+        only nnz rows cross the wire."""
+        from .ndarray.sparse import (RowSparseNDArray, row_sparse_array,
+                                     CompactRowSparseNDArray)
+        assert out is not None and row_ids is not None
+        keys, outs = _ctype_key_value(key, out)
+        if isinstance(row_ids, nd.NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, row_ids):
+            if k not in self._parts:
+                raise KeyError("row_sparse_pull of uninitialized key %r"
+                               % (k,))
+            rid_np = rid.asnumpy().astype("int64") \
+                if isinstance(rid, nd.NDArray) \
+                else _np.asarray(rid, dtype="int64")
+            rid_np = _np.unique(rid_np)
+            nrows = self._shapes[k][0] if self._shapes[k] else 1
+            if rid_np.size and (rid_np[0] < 0 or rid_np[-1] >= nrows):
+                raise IndexError(
+                    "row_sparse_pull row_ids out of range for table of "
+                    "%d rows: [%d, %d]" % (nrows, rid_np[0], rid_np[-1]))
+            plan = self._parts[k]
+
+            def fetch(sk, lo, hi):
+                ids = rid_np[(rid_np >= lo) & (rid_np < hi)]
+                if ids.size == 0:
+                    return None
+                _, rows, clock = self._conn(sk).request(
+                    "pull_rows", sk, (ids - lo))
+                self._base_clock[sk] = clock
+                return rows
+
+            pieces = [p for p in self._pmap(
+                [(lambda sk=sk, lo=lo, hi=hi: fetch(sk, lo, hi))
+                 for sk, lo, hi in plan]) if p is not None]
+            if pieces:
+                gathered = pieces[0] if len(pieces) == 1 \
+                    else _np.concatenate(pieces, axis=0)  # rid_np sorted
+            else:   # empty row_ids: a valid no-rows pull
+                gathered = _np.zeros((0,) + tuple(self._shapes[k][1:]),
+                                     "float32")
+            garr = nd.array(gathered)
+            for tgt in (o if isinstance(o, (list, tuple)) else [o]):
+                if isinstance(tgt, CompactRowSparseNDArray):
+                    tgt._set_rows(rid_np, garr._data)
+                elif isinstance(tgt, RowSparseNDArray):
+                    rsp = row_sparse_array((garr, rid_np),
+                                           shape=self._shapes[k])
+                    tgt._data = rsp._data
+                    tgt._aux = {kk: vv.copy()
+                                for kk, vv in rsp._ensure_aux().items()}
+                elif tgt.shape == garr.shape:
+                    tgt._data = garr._data
+                elif tuple(tgt.shape) == self._shapes[k]:
+                    # dense full-shape target (Module.prepare pulls into
+                    # full executor buffers — base-store contract,
+                    # kvstore.py row_sparse_pull): fetch the whole table
+                    self.pull(k, out=tgt)
+                else:
+                    raise TypeError(
+                        "row_sparse_pull target must be row_sparse, "
+                        "compact, the gathered shape, or the full table "
+                        "shape; got dense %r for %d rows"
+                        % (tgt.shape, rid_np.size))
 
     # -- optimizer --------------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -414,6 +665,7 @@ class AsyncDistKVStore(KVStore):
         return agg
 
     def close(self):
+        self._pool.shutdown(wait=True)
         for c in self._conns:
             c.close()
         if self._own_server is not None:

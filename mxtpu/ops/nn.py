@@ -114,16 +114,27 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     pad = _pair(pad, nsp) if pad else (0,) * nsp
     adj = _pair(adj, nsp) if adj else (0,) * nsp
     kernel = _pair(kernel, nsp) if kernel else weight.shape[2:]
-    if target_shape:
-        # reference InferPad (deconvolution-inl.h): an explicit
+    if target_shape and any(_pair(target_shape, nsp)):
+        # reference InferPad (deconvolution-inl.h:124-141): an explicit
         # target_shape overrides pad AND adj — out = (in-1)*s - 2p
-        # + k_eff + adj solved for (p, adj) with adj in {0, 1}
+        # + k_eff + adj solved for (p, adj) with adj in {0, 1}. An
+        # all-zero target_shape means "unset" (bCal skips it), and a
+        # target larger than the zero-pad output is rejected (the
+        # reference's CHECK_GE "too big target shape").
         target_shape = _pair(target_shape, nsp)
         pad_l, adj_l = [], []
         for i in range(nsp):
             k_eff = (kernel[i] - 1) * dilate[i] + 1
             excess = (data.shape[2 + i] - 1) * stride[i] + k_eff \
                 - target_shape[i]
+            if excess < 0:
+                raise ValueError(
+                    "too big target shape: target_shape[%d]=%d exceeds the "
+                    "maximum achievable output %d for input %d, stride %d, "
+                    "kernel %d, dilate %d" % (
+                        i, target_shape[i],
+                        (data.shape[2 + i] - 1) * stride[i] + k_eff,
+                        data.shape[2 + i], stride[i], kernel[i], dilate[i]))
             p = (excess + 1) // 2
             pad_l.append(p)
             adj_l.append(2 * p - excess)
@@ -305,11 +316,14 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                output_mean_var=False, axis=1, cudnn_off=False,
                _training=False):
     """Reference: src/operator/nn/batch_norm.cc. Returns
-    (out, mean, var, new_moving_mean, new_moving_var): outputs 1-2 are the
-    statistics the normalization used (batch moments in training, moving
-    stats otherwise), surfaced to the user under output_mean_var=True; the
-    runtime writes outputs 3-4 back into the aux arrays (MXNet mutates
-    aux_states in the kernel).
+    (out, mean, invstd, new_moving_mean, new_moving_var): outputs 1-2 are
+    the statistics the normalization used (batch moments in training,
+    moving stats otherwise), surfaced to the user under
+    output_mean_var=True — the second of them is the INVERSE standard
+    deviation 1/sqrt(var+eps), matching the reference kernel's saved
+    output ("outputs both data_mean and the inverse of data_var",
+    batch_norm.cc); the runtime writes outputs 3-4 back into the aux
+    arrays (MXNet mutates aux_states in the kernel).
     """
     axis = axis % data.ndim
     g = jnp.ones_like(gamma) if fix_gamma else gamma
@@ -333,7 +347,7 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     out = (x32 - mean.astype(jnp.float32).reshape(shape)) \
         * inv.reshape(shape) * g.astype(jnp.float32).reshape(shape) \
         + beta.astype(jnp.float32).reshape(shape)
-    return (out.astype(data.dtype), jnp.asarray(mean), jnp.asarray(var),
+    return (out.astype(data.dtype), jnp.asarray(mean), inv,
             new_mm, new_mv)
 
 

@@ -752,6 +752,33 @@ def test_batchnorm_output_mean_var():
     assert len(outs) == 3
     np.testing.assert_allclose(outs[1].asnumpy(), x.mean(axis=(0, 2)),
                                rtol=1e-4, atol=1e-5)
+    # third output is the INVERSE std (reference batch_norm.cc saves
+    # 1/sqrt(var+eps), not the variance)
+    np.testing.assert_allclose(
+        outs[2].asnumpy(),
+        1.0 / np.sqrt(x.var(axis=(0, 2)) + 1e-3),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_target_shape_validation():
+    r = _r(78)
+    x = r.normal(0, 1, (1, 2, 5, 5)).astype(np.float32)
+    w = r.normal(0, 1, (2, 3, 3, 3)).astype(np.float32)
+    # achievable target: solved pad/adj must reproduce the shape exactly
+    out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                              stride=(2, 2), num_filter=3,
+                              target_shape=(10, 10))
+    assert out.shape == (1, 3, 10, 10)
+    # all-zero target_shape means "unset" (reference bCal ignores it)
+    out0 = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                               stride=(2, 2), num_filter=3,
+                               target_shape=(0, 0))
+    assert out0.shape == (1, 3, 11, 11)
+    # unachievable target: reference CHECK_GE "too big target shape"
+    with pytest.raises(ValueError, match="too big target shape"):
+        mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            stride=(2, 2), num_filter=3,
+                            target_shape=(40, 40))
 
 
 # ---- bf16 consistency tiers (check_consistency, reference GPU fp16 tier) --

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import secrets
 import signal
 import subprocess
 import sys
@@ -50,6 +51,12 @@ def launch_local(args, command):
     # find them via MXTPU_PS_ADDRS for create('dist_async')
     server_procs = []
     ps_addrs = []
+    # per-launch shared secret: the PS wire protocol is pickle, so only
+    # processes of THIS launch may speak to the servers (any other local
+    # user connecting would otherwise get arbitrary code execution)
+    ps_token = secrets.token_hex(16) if args.num_servers else None
+    if ps_token:
+        base_env["MXTPU_PS_TOKEN"] = ps_token
     for s in range(args.num_servers):
         ps_port = _free_port(args.port + 1 + s)
         env = dict(base_env, DMLC_ROLE="server",
