@@ -1,0 +1,111 @@
+"""Dataset helpers shared by the example suites (reference
+example/utils/get_data.py: download MNIST/CIFAR from data.mxnet.io).
+
+This environment has zero egress, so instead of downloading, these
+helpers synthesize datasets with the same on-disk formats and return
+the same iterator types the reference helpers feed — examples written
+against the reference API run unchanged.
+"""
+import gzip
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx
+
+
+def _write_idx_images(path, images):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, len(images), *images.shape[1:]))
+        f.write(images.astype(np.uint8).tobytes())
+
+
+def _write_idx_labels(path, labels):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">II", 2049, len(labels)))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+def get_mnist(data_dir, n_train=512, n_test=128, seed=42):
+    """Materialize an MNIST-format dataset (idx-gzip files, the exact
+    layout mx.io.MNISTIter parses). Synthetic digit-like classes: each
+    class is a fixed random 28x28 prototype plus noise."""
+    os.makedirs(data_dir, exist_ok=True)
+    names = ["train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+             "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"]
+    if all(os.path.exists(os.path.join(data_dir, n)) for n in names):
+        return data_dir
+    rng = np.random.RandomState(seed)
+    protos = rng.uniform(0, 160, (10, 28, 28))
+    for n_img, img_name, lbl_name in ((n_train, names[0], names[1]),
+                                      (n_test, names[2], names[3])):
+        labels = rng.randint(0, 10, n_img)
+        images = np.clip(protos[labels]
+                         + rng.normal(0, 24, (n_img, 28, 28)), 0, 255)
+        _write_idx_images(os.path.join(data_dir, img_name), images)
+        _write_idx_labels(os.path.join(data_dir, lbl_name), labels)
+    return data_dir
+
+
+def get_mnist_iters(data_dir, batch_size=32, flat=False):
+    """Train/val MNISTIter pair over the materialized files (the shape
+    the reference's example code builds after get_mnist)."""
+    get_mnist(data_dir)
+    train = mx.io.MNISTIter(
+        image=os.path.join(data_dir, "train-images-idx3-ubyte.gz"),
+        label=os.path.join(data_dir, "train-labels-idx1-ubyte.gz"),
+        flat=flat, batch_size=batch_size, shuffle=True)
+    val = mx.io.MNISTIter(
+        image=os.path.join(data_dir, "t10k-images-idx3-ubyte.gz"),
+        label=os.path.join(data_dir, "t10k-labels-idx1-ubyte.gz"),
+        flat=flat, batch_size=batch_size, shuffle=False)
+    return train, val
+
+
+def get_cifar10(data_dir, n_train=256, n_test=64, seed=43):
+    """Materialize a CIFAR-10-like RecordIO pair (train.rec/test.rec via
+    tools/im2rec.py, the format the reference's cifar10 download
+    provides) and return the shard paths."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                    "tools"))
+    from PIL import Image
+    recs = [os.path.join(data_dir, s) for s in ("train.rec", "test.rec")]
+    if all(os.path.exists(r) for r in recs):
+        return recs
+    import subprocess
+    rng = np.random.RandomState(seed)
+    img_root = os.path.join(data_dir, "img")
+    for split, n_img in (("train", n_train), ("test", n_test)):
+        lst_rows = []
+        for i in range(n_img):
+            cls = int(rng.randint(0, 10))
+            arr = np.clip(rng.normal(100 + 12 * cls, 40, (32, 32, 3)),
+                          0, 255).astype(np.uint8)
+            rel = os.path.join(split, "%05d.png" % i)
+            os.makedirs(os.path.join(img_root, split), exist_ok=True)
+            Image.fromarray(arr).save(os.path.join(img_root, rel))
+            lst_rows.append("%d\t%d\t%s" % (i, cls, rel))
+        lst = os.path.join(data_dir, split + ".lst")
+        with open(lst, "w") as f:
+            f.write("\n".join(lst_rows) + "\n")
+        subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "..", "tools",
+                          "im2rec.py"),
+             os.path.join(data_dir, split), img_root, "--no-shuffle"],
+            check=True)
+    return recs
+
+
+if __name__ == "__main__":
+    import tempfile
+    root = tempfile.mkdtemp(prefix="mxtpu_getdata_")
+    train, val = get_mnist_iters(os.path.join(root, "mnist"))
+    batch = next(iter(train))
+    assert batch.data[0].shape == (32, 1, 28, 28)
+    recs = get_cifar10(os.path.join(root, "cifar10"))
+    assert all(os.path.exists(r) for r in recs)
+    print("get_data OK:", root)

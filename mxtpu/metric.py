@@ -5,7 +5,8 @@ base + registry (``mx.metric.create``), CompositeEvalMetric, Accuracy,
 TopKAccuracy, F1, MCC, Perplexity, MAE, MSE, RMSE, CrossEntropy,
 NegativeLogLikelihood, PearsonCorrelation, Loss, Torch, Caffe, CustomMetric
 and ``np()`` helper. Metric math runs on host numpy — metrics are by design
-the host-side observability path, off the XLA hot loop.
+the host-side observability path, off the XLA hot loop — and the per-batch
+bodies are vectorized numpy rather than the reference's element loops.
 """
 from __future__ import annotations
 
@@ -25,19 +26,36 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
     """Check label/pred count match (reference metric.py:36)."""
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+    measure = (lambda x: x.shape) if shape else len
+    got_l, got_p = measure(labels), measure(preds)
+    if got_l != got_p:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(got_l, got_p))
     if wrap:
         if isinstance(labels, ndarray.NDArray):
             labels = [labels]
         if isinstance(preds, ndarray.NDArray):
             preds = [preds]
     return labels, preds
+
+
+def _host(arr, dtype=None):
+    """NDArray -> host numpy, optionally cast."""
+    out = arr.asnumpy() if isinstance(arr, ndarray.NDArray) \
+        else numpy.asarray(arr)
+    return out if dtype is None else out.astype(dtype)
+
+
+def _listed(x):
+    return x if isinstance(x, list) else [x]
+
+
+def _pick_named(table, names):
+    """Values of ``table`` filtered/ordered by ``names`` (None = all)."""
+    if names is None:
+        return list(table.values())
+    return [table[n] for n in names]
 
 
 class EvalMetric:
@@ -54,24 +72,16 @@ class EvalMetric:
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            "metric": self.__class__.__name__,
-            "name": self.name,
-            "output_names": self.output_names,
-            "label_names": self.label_names})
+        config = dict(self._kwargs,
+                      metric=self.__class__.__name__,
+                      name=self.name,
+                      output_names=self.output_names,
+                      label_names=self.label_names)
         return config
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        self.update(_pick_named(label, self.label_names),
+                    _pick_named(pred, self.output_names))
 
     def update(self, labels, preds):
         raise NotImplementedError()
@@ -80,26 +90,26 @@ class EvalMetric:
         self.num_inst = 0
         self.sum_metric = 0.0
 
+    def _accum(self, total, count):
+        """Fold one batch's (sum, weight) into the running average."""
+        self.sum_metric += total
+        self.num_inst += count
+
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        value = self.sum_metric / self.num_inst if self.num_inst \
+            else float("nan")
+        return (self.name, value)
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        return list(zip(_listed(name), _listed(value)))
 
 
 _metric_registry = {}
 
 
 def register(klass):
-    name = klass.__name__.lower()
-    _metric_registry[name] = klass
+    _metric_registry[klass.__name__.lower()] = klass
     return klass
 
 
@@ -113,9 +123,11 @@ def alias(*names):
 
 
 def get(name, *args, **kwargs):
-    if name.lower() not in _metric_registry:
+    try:
+        klass = _metric_registry[name.lower()]
+    except KeyError:
         raise ValueError("Cannot find metric %s" % name)
-    return _metric_registry[name.lower()](*args, **kwargs)
+    return klass(*args, **kwargs)
 
 
 def create(metric, *args, **kwargs):
@@ -123,10 +135,8 @@ def create(metric, *args, **kwargs):
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, list):
-        composite_metric = CompositeEvalMetric()
-        for child_metric in metric:
-            composite_metric.add(create(child_metric, *args, **kwargs))
-        return composite_metric
+        parts = [create(m, *args, **kwargs) for m in metric]
+        return CompositeEvalMetric(parts)
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, string_types):
@@ -142,9 +152,7 @@ class CompositeEvalMetric(EvalMetric):
                  label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
@@ -157,42 +165,36 @@ class CompositeEvalMetric(EvalMetric):
                               .format(index, len(self.metrics)))
 
     def update_dict(self, labels, preds):
-        if self.label_names is not None:
-            labels = OrderedDict([i for i in labels.items()
-                                  if i[0] in self.label_names])
-        if self.output_names is not None:
-            preds = OrderedDict([i for i in preds.items()
-                                 if i[0] in self.output_names])
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+        def restrict(table, keep):
+            if keep is None:
+                return table
+            return OrderedDict(
+                (k, v) for k, v in table.items() if k in keep)
+        labels = restrict(labels, self.label_names)
+        preds = restrict(preds, self.output_names)
+        for child in self.metrics:
+            child.update_dict(labels, preds)
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for child in self.metrics:
+            child.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for child in getattr(self, "metrics", ()):
+            child.reset()
 
     def get(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if isinstance(name, string_types):
-                name = [name]
-            if isinstance(value, (float, int, numpy.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+        names, values = [], []
+        for child in self.metrics:
+            name, value = child.get()
+            names += _listed(name)
+            values += [value] if isinstance(
+                value, (float, int, numpy.generic)) else list(value)
         return (names, values)
 
     def get_config(self):
         config = super().get_config()
-        config.update({"metrics": [i.get_config() for i in self.metrics]})
+        config["metrics"] = [c.get_config() for c in self.metrics]
         return config
 
 
@@ -208,14 +210,14 @@ class Accuracy(EvalMetric):
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            if pred_label.shape != label.shape:
-                pred_label = ndarray.argmax(pred_label, axis=self.axis)
-            pred_label = pred_label.asnumpy().astype("int32")
-            label = label.asnumpy().astype("int32")
-            labels_, preds_ = check_label_shapes(label, pred_label)
-            self.sum_metric += (pred_label.flat == label.flat).sum()
-            self.num_inst += len(pred_label.flat)
+        for truth, scores in zip(labels, preds):
+            if scores.shape != truth.shape:
+                scores = ndarray.argmax(scores, axis=self.axis)
+            decided = _host(scores, "int32")
+            expected = _host(truth, "int32")
+            check_label_shapes(expected, decided)
+            hits = int((decided.ravel() == expected.ravel()).sum())
+            self._accum(hits, decided.size)
 
 
 @alias("top_k_accuracy", "top_k_acc")
@@ -232,24 +234,19 @@ class TopKAccuracy(EvalMetric):
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
-            pred_label = numpy.argsort(
-                pred_label.asnumpy().astype("float32"), axis=1)
-            label = label.asnumpy().astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flat == label.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].flat ==
-                        label.flat).sum()
-            self.num_inst += num_samples
+        for truth, scores in zip(labels, preds):
+            assert scores.ndim <= 2, \
+                "Predictions should be no more than 2 dims"
+            ranked = numpy.argsort(_host(scores, "float32"), axis=-1)
+            expected = _host(truth, "int32")
+            check_label_shapes(expected, ranked)
+            if ranked.ndim == 1:
+                hits = int((ranked.ravel() == expected.ravel()).sum())
+            else:
+                k = min(ranked.shape[1], self.top_k)
+                best = ranked[:, ranked.shape[1] - k:]
+                hits = int((best == expected.reshape(-1, 1)).any(1).sum())
+            self._accum(hits, ranked.shape[0])
 
 
 @alias("f1_score")
@@ -262,36 +259,21 @@ class F1(EvalMetric):
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            pred = pred.asnumpy()
-            label = label.asnumpy().astype("int32")
-            pred_label = numpy.argmax(pred, axis=1)
-            check_label_shapes(label, pred)
-            if len(numpy.unique(label)) > 2:
+        for truth, scores in zip(labels, preds):
+            scores_np = _host(scores)
+            expected = _host(truth, "int32")
+            decided = numpy.argmax(scores_np, axis=1)
+            check_label_shapes(expected, scores_np)
+            if numpy.unique(expected).size > 2:
                 raise ValueError("F1 currently only supports binary "
                                  "classification.")
-            true_positives, false_positives, false_negatives = 0., 0., 0.
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.
-            if true_positives + false_positives > 0:
-                precision = true_positives / (true_positives + false_positives)
-            else:
-                precision = 0.
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (true_positives + false_negatives)
-            else:
-                recall = 0.
-            if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.
-            self.sum_metric += f1_score
-            self.num_inst += 1
+            tp = float(((decided == 1) & (expected == 1)).sum())
+            fp = float(((decided == 1) & (expected == 0)).sum())
+            fn = float(((decided == 0) & (expected == 1)).sum())
+            precision = tp / (tp + fp) if tp + fp else 0.0
+            recall = tp / (tp + fn) if tp + fn else 0.0
+            pr = precision + recall
+            self._accum(2 * precision * recall / pr if pr else 0.0, 1)
 
 
 @register
@@ -304,17 +286,14 @@ class MCC(EvalMetric):
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            pred = pred.asnumpy()
-            label = label.asnumpy().astype("int32")
-            pred_label = numpy.argmax(pred, axis=1)
-            tp = float(((pred_label == 1) & (label == 1)).sum())
-            tn = float(((pred_label == 0) & (label == 0)).sum())
-            fp = float(((pred_label == 1) & (label == 0)).sum())
-            fn = float(((pred_label == 0) & (label == 1)).sum())
+        for truth, scores in zip(labels, preds):
+            expected = _host(truth, "int32")
+            decided = numpy.argmax(_host(scores), axis=1)
+            cells = [float(((decided == p) & (expected == t)).sum())
+                     for p, t in ((1, 1), (0, 0), (1, 0), (0, 1))]
+            tp, tn, fp, fn = cells
             denom = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
-            self.sum_metric += ((tp * tn - fp * fn) / denom) if denom else 0.0
-            self.num_inst += 1
+            self._accum((tp * tn - fp * fn) / denom if denom else 0.0, 1)
 
 
 @register
@@ -330,91 +309,101 @@ class Perplexity(EvalMetric):
 
     def update(self, labels, preds):
         assert len(labels) == len(preds)
-        loss = 0.
-        num = 0
-        for label, pred in zip(labels, preds):
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch: %s vs. %s" % (label.shape, pred.shape)
-            label = label.as_in_context(pred.context).reshape((label.size,))
-            pred = ndarray.pick(pred, label.astype(dtype="int32"),
-                                axis=self.axis)
-            pred_np = pred.asnumpy()
-            label_np = label.asnumpy()
+        neg_log = 0.0
+        count = 0
+        for truth, scores in zip(labels, preds):
+            assert truth.size == scores.size / scores.shape[-1], \
+                "shape mismatch: %s vs. %s" % (truth.shape, scores.shape)
+            flat = truth.as_in_context(scores.context) \
+                .reshape((truth.size,))
+            picked = _host(ndarray.pick(scores, flat.astype(dtype="int32"),
+                                        axis=self.axis))
             if self.ignore_label is not None:
-                ignore = (label_np == self.ignore_label).astype(pred_np.dtype)
-                num -= int(numpy.sum(ignore))
-                pred_np = pred_np * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, pred_np)))
-            num += pred_np.size
-        self.sum_metric += numpy.exp(loss / num) if num > 0 else float("nan")
-        self.num_inst += 1
+                masked = _host(flat) == self.ignore_label
+                count -= int(masked.sum())
+                picked = numpy.where(masked, 1.0, picked)
+            neg_log -= float(
+                numpy.log(numpy.maximum(1e-10, picked)).sum())
+            count += picked.size
+        self._accum(
+            numpy.exp(neg_log / count) if count > 0 else float("nan"), 1)
+
+
+def _column(x):
+    """1-d host vectors become (n, 1) so regression errors broadcast the
+    way the reference's per-row mean does."""
+    return x.reshape(len(x), 1) if x.ndim == 1 else x
+
+
+class _PairwiseError(EvalMetric):
+    """Shared driver for the regression metrics: per-batch mean of
+    ``_measure(truth, pred)`` over column-shaped host arrays."""
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for truth, scores in zip(labels, preds):
+            batch_value = self._measure(_column(_host(truth)),
+                                        _column(_host(scores)))
+            self._accum(float(batch_value), 1)
 
 
 @register
-class MAE(EvalMetric):
+class MAE(_PairwiseError):
     """Mean absolute error (reference metric.py:833)."""
 
     def __init__(self, name="mae", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _measure(truth, scores):
+        return numpy.abs(truth - scores).mean()
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_PairwiseError):
     """Mean squared error (reference metric.py:886)."""
 
     def __init__(self, name="mse", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    @staticmethod
+    def _measure(truth, scores):
+        return numpy.square(truth - scores).mean()
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_PairwiseError):
     """Root mean squared error (reference metric.py:939)."""
 
     def __init__(self, name="rmse", output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names)
 
+    @staticmethod
+    def _measure(truth, scores):
+        return math.sqrt(numpy.square(truth - scores).mean())
+
+
+class _ProbNLL(EvalMetric):
+    """Shared driver for CrossEntropy/NegativeLogLikelihood: -log of the
+    probability each row assigns to its true class."""
+
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+        for truth, scores in zip(labels, preds):
+            scores_np = _host(scores)
+            expected = _host(truth).ravel()
+            rows = scores_np.shape[0]
+            assert expected.shape[0] == rows, (expected.shape[0], rows)
+            chosen = scores_np[numpy.arange(rows),
+                               expected.astype(numpy.int64)]
+            self._accum(float(-numpy.log(chosen + self.eps).sum()), rows)
 
 
 @alias("ce")
-class CrossEntropy(EvalMetric):
+class CrossEntropy(_ProbNLL):
     """Cross entropy given predicted probabilities (reference metric.py:993)."""
 
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
@@ -423,20 +412,9 @@ class CrossEntropy(EvalMetric):
                          label_names=label_names)
         self.eps = eps
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
-
 
 @alias("nll_loss")
-class NegativeLogLikelihood(EvalMetric):
+class NegativeLogLikelihood(_ProbNLL):
     """NLL over predicted probabilities (reference metric.py:1050)."""
 
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
@@ -444,20 +422,6 @@ class NegativeLogLikelihood(EvalMetric):
         super().__init__(name, eps=eps, output_names=output_names,
                          label_names=label_names)
         self.eps = eps
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, \
-                (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
 
 
 @alias("pearsonr")
@@ -470,13 +434,11 @@ class PearsonCorrelation(EvalMetric):
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, False, True)
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            self.sum_metric += numpy.corrcoef(pred.ravel(),
-                                              label.ravel())[0, 1]
-            self.num_inst += 1
+        for truth, scores in zip(labels, preds):
+            check_label_shapes(truth, scores, False, True)
+            r = numpy.corrcoef(_host(scores).ravel(),
+                               _host(truth).ravel())[0, 1]
+            self._accum(float(r), 1)
 
 
 @register
@@ -490,9 +452,8 @@ class Loss(EvalMetric):
     def update(self, _, preds):
         if isinstance(preds, ndarray.NDArray):
             preds = [preds]
-        for pred in preds:
-            self.sum_metric += float(ndarray.sum(pred).asscalar())
-            self.num_inst += pred.size
+        for scores in preds:
+            self._accum(float(ndarray.sum(scores).asscalar()), scores.size)
 
 
 @register
@@ -519,7 +480,7 @@ class CustomMetric(EvalMetric):
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name, feval=feval,
                          allow_extra_outputs=allow_extra_outputs,
@@ -530,17 +491,12 @@ class CustomMetric(EvalMetric):
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
             labels, preds = check_label_shapes(labels, preds, True)
-        for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+        for scores, truth in zip(preds, labels):
+            outcome = self._feval(_host(truth), _host(scores))
+            if isinstance(outcome, tuple):
+                self._accum(*outcome)
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                self._accum(outcome, 1)
 
     def get_config(self):
         raise NotImplementedError("CustomMetric cannot be serialized")

@@ -56,6 +56,64 @@ for _n, _f in [
 
 
 # ---------------------------------------------------------------------------
+# Tensor-scalar family (reference src/operator/tensor/
+# elemwise_binary_scalar_op_{basic,extended,logic}.cc): the scalar rides
+# as an op parameter. The Python frontend's dunders reach jnp directly,
+# but exported symbol JSONs and the non-Python bindings invoke these BY
+# NAME, so the registered names (and their CamelCase aliases) are part
+# of the ABI surface.
+# ---------------------------------------------------------------------------
+
+_SCALAR_OPS = {
+    "_plus_scalar": ("_PlusScalar", lambda x, s: jnp.add(x, s)),
+    "_minus_scalar": ("_MinusScalar", lambda x, s: jnp.subtract(x, s)),
+    "_rminus_scalar": ("_RMinusScalar", lambda x, s: jnp.subtract(s, x)),
+    "_mul_scalar": ("_MulScalar", lambda x, s: jnp.multiply(x, s)),
+    "_div_scalar": ("_DivScalar", lambda x, s: jnp.divide(x, s)),
+    "_rdiv_scalar": ("_RDivScalar", lambda x, s: jnp.divide(s, x)),
+    "_mod_scalar": ("_ModScalar", lambda x, s: jnp.mod(x, s)),
+    "_rmod_scalar": ("_RModScalar", lambda x, s: jnp.mod(s, x)),
+    "_power_scalar": ("_PowerScalar", lambda x, s: jnp.power(x, s)),
+    "_rpower_scalar": ("_RPowerScalar", lambda x, s: jnp.power(s, x)),
+    "_maximum_scalar": ("_MaximumScalar", lambda x, s: jnp.maximum(x, s)),
+    "_minimum_scalar": ("_MinimumScalar", lambda x, s: jnp.minimum(x, s)),
+    "_hypot_scalar": ("_HypotScalar", lambda x, s: jnp.hypot(x, s)),
+}
+
+for _n, (_camel, _f) in _SCALAR_OPS.items():
+    def _mk_scalar(f):
+        def g(data, scalar=1.0):
+            return f(data, float(scalar))
+        return g
+    register(_n, aliases=(_camel,))(_mk_scalar(_f))
+
+_SCALAR_LOGIC = {
+    "_equal_scalar": ("_EqualScalar", jnp.equal),
+    "_not_equal_scalar": ("_NotEqualScalar", jnp.not_equal),
+    "_greater_scalar": ("_GreaterScalar", jnp.greater),
+    "_greater_equal_scalar": ("_GreaterEqualScalar", jnp.greater_equal),
+    "_lesser_scalar": ("_LesserScalar", jnp.less),
+    "_lesser_equal_scalar": ("_LesserEqualScalar", jnp.less_equal),
+    "_logical_and_scalar": ("_LogicalAndScalar", jnp.logical_and),
+    "_logical_or_scalar": ("_LogicalOrScalar", jnp.logical_or),
+    "_logical_xor_scalar": ("_LogicalXorScalar", jnp.logical_xor),
+}
+
+for _n, (_camel, _f) in _SCALAR_LOGIC.items():
+    def _mk_scalar_logic(f):
+        # 0/1 in the input dtype, like the broadcast comparisons above
+        def g(data, scalar=1.0):
+            out = f(data, float(scalar))
+            d = jnp.result_type(data)
+            return out.astype(d if jnp.issubdtype(d, jnp.floating) or
+                              jnp.issubdtype(d, jnp.integer)
+                              else jnp.float32)
+        return g
+    register(_n, differentiable=False, aliases=(_camel,))(
+        _mk_scalar_logic(_f))
+
+
+# ---------------------------------------------------------------------------
 # Unary math family (mshadow_op.h functors).
 # ---------------------------------------------------------------------------
 

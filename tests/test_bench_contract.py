@@ -18,8 +18,11 @@ def test_cpu_fallback_contract():
     assert res.returncode == 0, res.stderr[-500:]
     lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
     payload = json.loads(lines[-1])
-    assert payload["metric"] == "resnet50_train_img_per_sec"
+    # relay-down rounds emit the CPU inference scoreboard number (vs the
+    # reference's published CPU tables), not a toy training rate
+    assert payload["metric"] == "resnet50_infer_cpu_img_per_sec"
     assert payload["unit"] == "images/sec"
     assert payload["tpu_unavailable"] is True
+    assert payload.get("tiny") is True
     assert isinstance(payload["value"], (int, float))
     assert "error" not in payload, payload

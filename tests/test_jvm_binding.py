@@ -76,3 +76,28 @@ def test_jvm_smoke(tmp_path):
         capture_output=True, text=True, timeout=300, env=env)
     assert out.returncode == 0, out.stderr[-1500:]
     assert "JVM_SMOKE_OK" in out.stdout, out.stdout
+
+
+def test_c_hosted_smoke(tmp_path):
+    """Execute SmokeTest.java's exact call sequence without a JVM: the
+    C harness (jvm-package/smoke_harness.c) drives the same symbols in
+    the same order against the real libmxtpu_c.so, so the binding's
+    call pattern has actually RUN in this image — JNA itself adds only
+    argument marshalling on top of these calls. Where a JDK exists the
+    real Java gate above runs too."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    subprocess.run(["make", "-C", NATIVE, "libmxtpu_c.so"],
+                   check=True, capture_output=True)
+    exe = str(tmp_path / "smoke_harness")
+    subprocess.run(
+        ["gcc", "-O1", os.path.join(JVM, "smoke_harness.c"),
+         "-I", ROOT, "-L", NATIVE, "-lmxtpu_c",
+         "-Wl,-rpath," + NATIVE, "-lm", "-o", exe],
+        check=True, capture_output=True, text=True)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe], capture_output=True, text=True,
+                         timeout=300, env=env)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "JVM_SMOKE_OK" in out.stdout, out.stdout
+    assert "C_HOSTED_JVM_SEQUENCE_OK" in out.stdout, out.stdout

@@ -302,6 +302,47 @@ S("clip", lambda r: [np.array([[-0.9, -0.2, 0.3, 0.8],
   params={"a_min": -0.5, "a_max": 0.5},
   ref=lambda x, a_min, a_max: np.clip(x, a_min, a_max))
 
+# ---- tensor-scalar family (elemwise_binary_scalar_op_*.cc) ---------------
+
+S("_plus_scalar", lambda r: [u(r, 3, 4)], params={"scalar": 1.5},
+  ref=lambda x, scalar: x + scalar)
+S("_minus_scalar", lambda r: [u(r, 3, 4)], params={"scalar": 1.5},
+  ref=lambda x, scalar: x - scalar)
+S("_rminus_scalar", lambda r: [u(r, 3, 4)], params={"scalar": 1.5},
+  ref=lambda x, scalar: scalar - x)
+S("_mul_scalar", lambda r: [u(r, 3, 4)], params={"scalar": 3.0},
+  ref=lambda x, scalar: x * scalar)
+S("_div_scalar", lambda r: [u(r, 3, 4)], params={"scalar": 2.0},
+  ref=lambda x, scalar: x / scalar)
+S("_rdiv_scalar", lambda r: [away0(r, 3, 4, lo=0.5)],
+  params={"scalar": 2.0}, ref=lambda x, scalar: scalar / x)
+S("_mod_scalar", lambda r: [pos(r, 3, 4, lo=2.1, hi=2.9)],
+  params={"scalar": 0.8}, ref=lambda x, scalar: np.mod(x, scalar))
+S("_rmod_scalar", lambda r: [pos(r, 3, 4, lo=0.7, hi=0.95)],
+  params={"scalar": 2.5}, ref=lambda x, scalar: np.mod(scalar, x))
+S("_power_scalar", lambda r: [pos(r, 3, 4)], params={"scalar": 2.0},
+  ref=lambda x, scalar: np.power(x, scalar))
+S("_rpower_scalar", lambda r: [u(r, 3, 4, lo=-2, hi=2)],
+  params={"scalar": 2.0}, ref=lambda x, scalar: np.power(scalar, x))
+S("_maximum_scalar", lambda r: [distinct(r, 3, 4)], params={"scalar": 0.1},
+  ref=lambda x, scalar: np.maximum(x, scalar))
+S("_minimum_scalar", lambda r: [distinct(r, 3, 4)], params={"scalar": 0.1},
+  ref=lambda x, scalar: np.minimum(x, scalar))
+S("_hypot_scalar", lambda r: [away0(r, 3, 4)], params={"scalar": 1.5},
+  ref=lambda x, scalar: np.hypot(x, scalar))
+for _sn, _sref in [
+        ("_equal_scalar", np.equal), ("_not_equal_scalar", np.not_equal),
+        ("_greater_scalar", np.greater),
+        ("_greater_equal_scalar", np.greater_equal),
+        ("_lesser_scalar", np.less), ("_lesser_equal_scalar", np.less_equal),
+        ("_logical_and_scalar", np.logical_and),
+        ("_logical_or_scalar", np.logical_or),
+        ("_logical_xor_scalar", np.logical_xor)]:
+    def _mk_sref(f):
+        return lambda x, scalar: f(x, scalar).astype(np.float32)
+    S(_sn, lambda r: [r.choice([0.0, 0.5, 1.0], (3, 4)).astype("f")],
+      params={"scalar": 0.5}, ref=_mk_sref(_sref))
+
 # ---- elemwise binary ------------------------------------------------------
 
 S("broadcast_add", lambda r: [u(r, 3, 4), u(r, 1, 4)], ref=np.add)

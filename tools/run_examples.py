@@ -60,6 +60,8 @@ EXAMPLES = [
     ("memcost/inception_memcost.py", {}),
     ("cnn_chinese_text_classification/text_cnn.py", {}),
     ("kaggle-ndsb1/train_dsb.py", {}),
+    ("kaggle-ndsb2/train_ndsb2.py", {}),
+    ("utils/get_data.py", {}),
     ("python-howto/data_iter.py", {}),
     ("python-howto/multiple_outputs.py", {}),
     ("python-howto/monitor_weights.py", {}),
