@@ -1,0 +1,291 @@
+"""Fused RNN op torture grid (reference tests/python/unittest/
+test_operator.py RNN sections: check_rnn_consistency across modes /
+layers / directions, state carry, masking interactions).
+
+The oracle is an independent pure-numpy recurrence implemented here from
+the documented cudnn blob layout (ops/rnn.py rnn_blob_blocks) — NOT the
+op's own jax code — so layout bugs and cell-math bugs both surface.
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.ops.rnn import rnn_param_size
+from mxtpu.test_utils import check_numeric_gradient
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_unpack(params, mode, I, H, L, D):
+    """Independent re-read of the cudnn layout: all (wi, wh) blocks
+    layer-major / direction-minor, then all (bi, bh) in the same order."""
+    G = _GATES[mode]
+    mats, off = [], 0
+    for layer in range(L):
+        isz = I if layer == 0 else H * D
+        for _ in range(D):
+            wi = params[off:off + G * H * isz].reshape(G * H, isz)
+            off += G * H * isz
+            wh = params[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            mats.append([wi, wh])
+    for i in range(L * D):
+        mats[i].append(params[off:off + G * H])
+        off += G * H
+        mats[i].append(params[off:off + G * H])
+        off += G * H
+    return mats
+
+
+def _np_direction(xs, h0, c0, wi, wh, bi, bh, mode, reverse):
+    T = xs.shape[0]
+    H = h0.shape[-1]
+    seq = range(T - 1, -1, -1) if reverse else range(T)
+    h, c = h0.copy(), c0.copy()
+    ys = np.zeros((T, xs.shape[1], H), np.float64)
+    for t in seq:
+        pre = xs[t] @ wi.T + bi
+        if mode in ("rnn_relu", "rnn_tanh"):
+            g = pre + h @ wh.T + bh
+            h = np.tanh(g) if mode == "rnn_tanh" else np.maximum(g, 0)
+        elif mode == "lstm":
+            g = pre + h @ wh.T + bh
+            i_, f, gg, o = np.split(g, 4, axis=-1)
+            c = _sig(f) * c + _sig(i_) * np.tanh(gg)
+            h = _sig(o) * np.tanh(c)
+        else:   # gru, cuDNN variant: candidate sees r * (h @ Whn + bhn)
+            rz = _sig(pre[:, :2 * H] + h @ wh[:2 * H].T + bh[:2 * H])
+            r, z = np.split(rz, 2, axis=-1)
+            n = np.tanh(pre[:, 2 * H:]
+                        + r * (h @ wh[2 * H:].T + bh[2 * H:]))
+            h = (1 - z) * n + z * h
+        ys[t] = h
+    return ys, h, c
+
+
+def _np_rnn(data, params, state, cell, mode, L, D, H):
+    mats = _np_unpack(params, mode, data.shape[2], H, L, D)
+    x = data.astype(np.float64)
+    hs, cs = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            idx = layer * D + d
+            wi, wh, bi, bh = [m.astype(np.float64) for m in mats[idx]]
+            ys, hT, cT = _np_direction(x, state[idx], cell[idx], wi, wh,
+                                       bi, bh, mode, reverse=(d == 1))
+            outs.append(ys)
+            hs.append(hT)
+            cs.append(cT)
+        x = outs[0] if D == 1 else np.concatenate(outs, axis=-1)
+    return x, np.stack(hs), np.stack(cs)
+
+
+def _mk(mode, L, D, T=4, N=2, I=3, H=4, seed=0):
+    r = np.random.RandomState(seed)
+    data = r.uniform(-1, 1, (T, N, I)).astype("f")
+    psize = rnn_param_size(mode, I, H, L, D == 2)
+    params = (r.uniform(-1, 1, psize) / np.sqrt(H)).astype("f")
+    state = r.uniform(-1, 1, (L * D, N, H)).astype("f")
+    cell = r.uniform(-1, 1, (L * D, N, H)).astype("f")
+    return data, params, state, cell
+
+
+def _run_fused(data, params, state, cell, mode, L, D, H, **kw):
+    args = [mx.nd.array(data), mx.nd.array(params), mx.nd.array(state)]
+    if mode == "lstm":
+        args.append(mx.nd.array(cell))
+    return mx.nd.RNN(*args, state_size=H, num_layers=L,
+                     bidirectional=(D == 2), mode=mode,
+                     state_outputs=True, **kw)
+
+
+@pytest.mark.parametrize("mode", sorted(_GATES))
+@pytest.mark.parametrize("L", [1, 2, 3])
+@pytest.mark.parametrize("D", [1, 2])
+def test_fused_forward_grid(mode, L, D):
+    """Forward + final states vs the numpy oracle across the full
+    mode x depth x direction grid (reference check_rnn_consistency)."""
+    H = 4
+    data, params, state, cell = _mk(mode, L, D, seed=11 * L + D)
+    outs = _run_fused(data, params, state, cell, mode, L, D, H)
+    ref_y, ref_h, ref_c = _np_rnn(data, params, state,
+                                  np.zeros_like(cell) if mode != "lstm"
+                                  else cell, mode, L, D, H)
+    np.testing.assert_allclose(outs[0].asnumpy(), ref_y, rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(outs[1].asnumpy(), ref_h, rtol=2e-4,
+                               atol=2e-5)
+    if mode == "lstm":
+        np.testing.assert_allclose(outs[2].asnumpy(), ref_c, rtol=2e-4,
+                                   atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "lstm", "gru"])
+@pytest.mark.parametrize("D", [1, 2])
+def test_fused_grad_grid(mode, D):
+    """Numeric gradients through the fused op w.r.t. data, the packed
+    parameter blob, AND the initial states, 2 layers deep (reference
+    test_operator.py RNN grad sections). Smooth cells only — fp32
+    central differences are well-posed for them."""
+    L, H, T, N, I = 2, 3, 3, 2, 2
+    data, params, state, cell = _mk(mode, L, D, T=T, N=N, I=I, H=H,
+                                    seed=5 + D)
+    names = ["a0", "a1", "a2"] + (["a3"] if mode == "lstm" else [])
+    sym = mx.sym.RNN(*[mx.sym.var(n) for n in names], state_size=H,
+                     num_layers=L, bidirectional=(D == 2), mode=mode)
+    values = {"a0": data, "a1": params, "a2": state}
+    if mode == "lstm":
+        values["a3"] = cell
+    check_numeric_gradient(sym, values, grad_nodes=names,
+                           numeric_eps=1e-3, rtol=0.06, atol=2e-3)
+
+
+@pytest.mark.parametrize("D", [1, 2])
+def test_fused_grad_rnn_relu_vs_oracle(D):
+    """rnn_relu gradients: the kink makes fp32 finite differences of the
+    op itself ill-posed (a pre-activation within eps of zero anywhere in
+    the recurrence corrupts the estimate), so instead compare the op's
+    analytic grad against float64 central differences of the NUMPY
+    oracle at eps=1e-6 — stable to ~1e-9 away from the kink, and the
+    oracle equality with the op is already pinned by the forward grid."""
+    mode, L, H = "rnn_relu", 2, 3
+    data, params, state, cell = _mk(mode, L, D, T=3, N=2, I=2, H=H,
+                                    seed=5 + D)
+
+    names = ["a0", "a1", "a2"]
+    sym = mx.sym.RNN(*[mx.sym.var(n) for n in names], state_size=H,
+                     num_layers=L, bidirectional=(D == 2), mode=mode)
+    shapes = {"a0": data.shape, "a1": params.shape, "a2": state.shape}
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write", **shapes)
+    for n, v in (("a0", data), ("a1", params), ("a2", state)):
+        ex.arg_dict[n][:] = v
+    out = ex.forward(is_train=True)[0]
+    ex.backward(mx.nd.ones(out.shape))
+    analytic = {n: ex.grad_dict[n].asnumpy() for n in names}
+
+    def oracle_sum(vals):
+        y, _, _ = _np_rnn(vals["a0"].reshape(data.shape),
+                          vals["a1"].reshape(params.shape),
+                          vals["a2"].reshape(state.shape),
+                          np.zeros_like(cell), mode, L, D, H)
+        return y.sum()
+
+    eps = 1e-6
+    flat = {n: v.astype(np.float64).ravel()
+            for n, v in (("a0", data), ("a1", params), ("a2", state))}
+    for n in names:
+        numeric = np.zeros_like(flat[n])
+        for i in range(flat[n].size):
+            up, dn = dict(flat), dict(flat)
+            up[n] = flat[n].copy()
+            up[n][i] += eps
+            dn[n] = flat[n].copy()
+            dn[n][i] -= eps
+            numeric[i] = (oracle_sum(up) - oracle_sum(dn)) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic[n].ravel(), numeric, rtol=5e-3, atol=1e-4,
+            err_msg="rnn_relu grad w.r.t. %s" % n)
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru"])
+def test_state_carry_between_calls(mode):
+    """Running T steps in one call == two T/2 calls with the final
+    states of the first feeding the second (the stateful-decoding
+    pattern; exercises state_outputs round-tripping)."""
+    L, D, H = 2, 1, 4
+    data, params, state, cell = _mk(mode, L, D, T=6, seed=3)
+    full = _run_fused(data, params, state, cell, mode, L, D, H)
+
+    first = _run_fused(data[:3], params, state, cell, mode, L, D, H)
+    h_mid = first[1].asnumpy()
+    c_mid = first[2].asnumpy() if mode == "lstm" else cell
+    second = _run_fused(data[3:], params, h_mid, c_mid, mode, L, D, H)
+
+    joined = np.concatenate([first[0].asnumpy(), second[0].asnumpy()])
+    np.testing.assert_allclose(joined, full[0].asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(second[1].asnumpy(), full[1].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_masking_interaction():
+    """Variable-length semantics composed from RNN + sequence ops, the
+    reference recipe (sym/rnn use_sequence_length predates 1.1; masking
+    is done around the op): a unidirectional RNN over a padded batch
+    matches the unpadded run on every valid step, SequenceLast picks the
+    true last hidden state, and grads do not flow from masked-out tail
+    steps into the valid prefix's loss."""
+    mode, L, D, H = "lstm", 1, 1, 4
+    data, params, state, cell = _mk(mode, L, D, T=6, seed=9)
+    lengths = np.array([4, 6], "f")
+    padded = data.copy()
+    padded[4:, 0, :] = 7.7    # garbage past sample 0's length
+
+    y_pad = _run_fused(padded, params, state, cell, mode, L, D, H)[0] \
+        .asnumpy()
+    y_short = _run_fused(data[:4], params, state, cell, mode, L, D, H)[0] \
+        .asnumpy()
+    # causal op: valid prefix is untouched by the padded tail
+    np.testing.assert_allclose(y_pad[:4, 0], y_short[:, 0], rtol=1e-5,
+                               atol=1e-6)
+
+    # SequenceLast over the RNN output picks step length-1 per sample
+    last = mx.nd.SequenceLast(mx.nd.array(y_pad), mx.nd.array(lengths),
+                              use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], y_pad[3, 0], rtol=1e-6)
+    np.testing.assert_allclose(last[1], y_pad[5, 1], rtol=1e-6)
+
+    # masked loss: no gradient reaches the padded tail of the input
+    names = ["a0", "a1", "a2", "a3"]
+    out = mx.sym.RNN(*[mx.sym.var(n) for n in names], state_size=H,
+                     num_layers=L, mode=mode)
+    masked = mx.sym.SequenceMask(out, mx.sym.var("len"),
+                                 use_sequence_length=True)
+    ex = masked.bind(mx.cpu(),
+                     {"a0": mx.nd.array(padded),
+                      "a1": mx.nd.array(params),
+                      "a2": mx.nd.array(state),
+                      "a3": mx.nd.array(cell),
+                      "len": mx.nd.array(lengths)},
+                     args_grad={"a0": mx.nd.zeros(padded.shape)})
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones((6, 2, H)))
+    g = ex.grad_dict["a0"].asnumpy()
+    assert np.abs(g[4:, 0, :]).max() == 0.0, "masked steps leaked grad"
+    assert np.abs(g[:4, 0, :]).max() > 0.0, "valid steps got no grad"
+
+
+def test_dropout_between_layers():
+    """p>0 applies only between layers and only in training mode."""
+    mode, L, D, H = "gru", 2, 1, 4
+    data, params, state, cell = _mk(mode, L, D, seed=2)
+    base = _run_fused(data, params, state, cell, mode, L, D, H)[0] \
+        .asnumpy()
+    # eval mode: p is inert
+    drop_eval = _run_fused(data, params, state, cell, mode, L, D, H,
+                           p=0.5)[0].asnumpy()
+    np.testing.assert_allclose(drop_eval, base, rtol=1e-6)
+    # training mode: stochastic, different from base
+    mx.random.seed(0)
+    with mx.autograd.record(train_mode=True):
+        drop_train = _run_fused(data, params, state, cell, mode, L, D, H,
+                                p=0.5)[0].asnumpy()
+    assert np.abs(drop_train - base).max() > 1e-3
+
+
+def test_lstm_state_clip():
+    """lstm_state_clip_min/max bound the returned cell state
+    (reference RNNParam state clipping)."""
+    mode, L, D, H = "lstm", 1, 1, 4
+    data, params, state, cell = _mk(mode, L, D, seed=4)
+    big_cell = cell * 50.0
+    _, _, c_out = _run_fused(data, params, state, big_cell, mode, L, D, H,
+                             lstm_state_clip_min=-0.4,
+                             lstm_state_clip_max=0.4)
+    c = c_out.asnumpy()
+    assert c.min() >= -0.4 - 1e-6 and c.max() <= 0.4 + 1e-6
