@@ -10,6 +10,7 @@
 #ifndef MXTPU_CPP_BASE_HPP_
 #define MXTPU_CPP_BASE_HPP_
 
+#include <iomanip>
 #include <sstream>
 #include <stdexcept>
 #include <string>
@@ -62,8 +63,18 @@ inline std::string ShapeStr(const Shape &s) {
   return os.str();
 }
 
+/*! \brief round-trip decimal form of a number: std::to_string's fixed
+ *  6 decimals would turn 1e-7 into "0.000000", silently corrupting
+ *  scalar operands crossing the string ABI. */
+inline std::string NumStr(double v) {
+  std::ostringstream os;
+  os << std::setprecision(17) << v;
+  return os.str();
+}
+
 inline std::string TupleStr(const Tuple &t) {
   std::ostringstream os;
+  os << std::setprecision(17);
   os << "(";
   for (size_t i = 0; i < t.size(); ++i) {
     if (i) os << ",";

@@ -76,6 +76,11 @@ def fmt_expr(pname, ctype):
         return "TupleStr(%s)" % pname
     if ctype.startswith("const std::string"):
         return pname
+    if ctype == "double":
+        # std::to_string fixes 6 decimal places: to_string(1e-7) is
+        # "0.000000", which would silently zero a scalar operand
+        # (e.g. op::mul_scalar's multiplier). NumStr round-trips.
+        return "NumStr(%s)" % pname
     return "std::to_string(%s)" % pname
 
 
