@@ -43,6 +43,7 @@ def build():
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     mx.random.seed(9)
     xtr, ytr = make_data(8192, 0)
     xte, yte = make_data(512, 1)

@@ -46,6 +46,7 @@ def synthetic_corpus(n=600, seed=0):
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     x, y = synthetic_corpus()
     split = int(0.8 * len(x))
     train = mx.io.NDArrayIter(x[:split], y[:split], batch_size=32,

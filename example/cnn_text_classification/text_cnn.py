@@ -59,6 +59,7 @@ def build():
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     mx.random.seed(13)
     xtr, ytr = make_data(4096, 0)
     xte, yte = make_data(512, 1)

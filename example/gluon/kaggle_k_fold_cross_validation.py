@@ -47,6 +47,7 @@ def train_fold(net, X_tr, y_tr):
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     r = np.random.RandomState(7)
     n, d = 500, 16
     X = r.standard_normal((n, d)).astype("f")

@@ -14,6 +14,7 @@ from mxtpu.gluon import nn
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     r = np.random.RandomState(0)
     X = r.standard_normal((256, 8)).astype("f")
     w = r.standard_normal(8).astype("f")

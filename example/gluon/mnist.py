@@ -24,6 +24,7 @@ def synthetic_mnist(n=512, seed=0):
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     ap = argparse.ArgumentParser()
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=64)

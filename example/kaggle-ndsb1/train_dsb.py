@@ -77,6 +77,7 @@ def get_dsb_sym():
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     ap = argparse.ArgumentParser()
     ap.add_argument("--num-epochs", type=int, default=6)
     ap.add_argument("--batch-size", type=int, default=32)

@@ -29,6 +29,7 @@ def synthetic(n=512, seed=0):
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     x, y = synthetic()
     train = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=True,
                               label_name="softmax_label")

@@ -21,6 +21,7 @@ def synthetic(n=512, seed=1):
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     x, y = synthetic()
 
     # --- MakeLoss: loss IS the symbol; grad of its mean flows back ---

@@ -11,6 +11,7 @@ import mxtpu as mx
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     r = np.random.RandomState(3)
     n = 512
     y = (r.rand(n) * 4).astype("f")

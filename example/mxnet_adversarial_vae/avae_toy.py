@@ -40,6 +40,7 @@ def make_mlp(sizes, final=None):
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     rng = np.random.RandomState(0)
     # data on a 2-mode manifold embedded in DIM dims
     z_true = rng.randn(512, 2).astype("f")

@@ -38,6 +38,7 @@ def build(use_linear=False):
 
 
 def main():
+    np.random.seed(0)   # NDArrayIter shuffles via the global RNG
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(7)
     xtr, ytr = synthetic_digits(2048, seed=0)
