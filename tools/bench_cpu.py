@@ -38,13 +38,17 @@ ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, ROOT)
 
 # reference perf.md:40-47 (C4.8xlarge, 36 vCPU) and :78-85 (C4.xlarge,
-# 4 vCPU), batch 32 rows
+# 4 vCPU), batch 32 and batch 1 rows
 C4_8XL_VCPUS = 36
 C4_8XL_B32 = {"alexnet": 564.04, "vgg16": 87.15, "inception-v3": 83.05,
               "resnet-50": 62.19, "resnet-152": 25.76}
+C4_8XL_B1 = {"alexnet": 119.57, "vgg16": 34.23, "inception-v3": 54.42,
+             "resnet-50": 42.83, "resnet-152": 19.51}
 C4_XL_VCPUS = 4
 C4_XL_B32 = {"alexnet": 65.05, "vgg16": 10.91, "inception-v3": 9.34,
              "resnet-50": 10.31, "resnet-152": 3.86}
+C4_XL_B1 = {"alexnet": 37.92, "vgg16": 6.57, "inception-v3": 8.79,
+            "resnet-50": 9.65, "resnet-152": 3.73}
 
 
 def _score_mod():
@@ -108,8 +112,10 @@ def main():
                   "steady-state after warmup (reference perf.md "
                   "methodology)",
         "reference": {
-            "c4.8xlarge_b32": C4_8XL_B32, "c4.8xlarge_vcpus": C4_8XL_VCPUS,
-            "c4.xlarge_b32": C4_XL_B32, "c4.xlarge_vcpus": C4_XL_VCPUS,
+            "c4.8xlarge_b32": C4_8XL_B32, "c4.8xlarge_b1": C4_8XL_B1,
+            "c4.8xlarge_vcpus": C4_8XL_VCPUS,
+            "c4.xlarge_b32": C4_XL_B32, "c4.xlarge_b1": C4_XL_B1,
+            "c4.xlarge_vcpus": C4_XL_VCPUS,
             "source": "/root/reference/docs/faq/perf.md:31-90"},
         "timestamp": time.strftime("%F %T"),
     }
@@ -124,29 +130,30 @@ def main():
 
     models = ["resnet-50"] if args.quick else \
         ["resnet-50", "vgg16", "inception-v3", "alexnet", "resnet-152"]
-    results = {}
     out = os.path.join(ROOT, "docs", "cpu_scoreboard.json")
+    try:   # always merge: a batch-1 or single-model run must not clobber
+        with open(out) as f:   # the other rows already measured
+            results = json.load(f).get("results", {})
+    except OSError:
+        results = {}
     if args.models:
         models = [m.strip() for m in args.models.split(",") if m.strip()]
-        try:
-            with open(out) as f:
-                results = json.load(f).get("results", {})
-        except OSError:
-            pass
+    tables = {32: (C4_8XL_B32, C4_XL_B32), 1: (C4_8XL_B1, C4_XL_B1)}
+    t8, txl = tables.get(args.batch, ({}, {}))
     for name in models:
         img_s = score_model(name, args.batch)
         entry = {"img_per_sec": round(img_s, 2),
-                 "per_core": round(img_s / cores, 2)}
-        for label, table, vcpus in (
-                ("c4.8xlarge", C4_8XL_B32, C4_8XL_VCPUS),
-                ("c4.xlarge", C4_XL_B32, C4_XL_VCPUS)):
+                 "per_core": round(img_s / cores, 2), "batch": args.batch}
+        for label, table, vcpus in (("c4.8xlarge", t8, C4_8XL_VCPUS),
+                                    ("c4.xlarge", txl, C4_XL_VCPUS)):
             ref = table.get(name)
             if ref:
                 entry["vs_%s" % label] = round(img_s / ref, 3)
                 entry["vs_%s_per_vcpu" % label] = round(
                     (img_s / cores) / (ref / vcpus), 2)
-        results[name] = entry
-        print(name, entry, flush=True)
+        key = name if args.batch == 32 else "%s@b%d" % (name, args.batch)
+        results[key] = entry
+        print(key, entry, flush=True)
     report["results"] = results
 
     with open(out, "w") as f:
