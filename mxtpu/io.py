@@ -38,26 +38,22 @@ class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
 
     def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
         ret = super().__new__(cls, name, shape)
-        ret.dtype = dtype
-        ret.layout = layout
+        ret.dtype, ret.layout = dtype, layout
         return ret
 
     def __repr__(self):
-        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
-                                          self.layout)
+        return "DataDesc[%s,%s,%s,%s]" % (self + (self.dtype, self.layout))
 
     @staticmethod
     def get_batch_axis(layout):
-        if layout is None:
-            return 0
-        return layout.find("N")
+        return 0 if layout is None else layout.find("N")
 
     @staticmethod
     def get_list(shapes, types):
-        if types is not None:
-            type_dict = dict(types)
-            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
-        return [DataDesc(x[0], x[1]) for x in shapes]
+        if types is None:   # no types given: every desc gets the default
+            return [DataDesc(n, s) for n, s in shapes]
+        dtype_of = dict(types)   # missing name -> KeyError, by contract
+        return [DataDesc(n, s, dtype_of[n]) for n, s in shapes]
 
 
 class DataBatch:
@@ -65,26 +61,17 @@ class DataBatch:
 
     def __init__(self, data, label=None, pad=None, index=None,
                  bucket_key=None, provide_data=None, provide_label=None):
-        if data is not None:
-            assert isinstance(data, (list, tuple)), "Data must be list of NDArrays"
-        if label is not None:
-            assert isinstance(label, (list, tuple)), "Label must be list of NDArrays"
-        self.data = data
-        self.label = label
-        self.pad = pad
-        self.index = index
-        self.bucket_key = bucket_key
-        self.provide_data = provide_data
-        self.provide_label = provide_label
+        for part, what in ((data, "Data"), (label, "Label")):
+            assert part is None or isinstance(part, (list, tuple)), \
+                "%s must be list of NDArrays" % what
+        self.data, self.label = data, label
+        self.pad, self.index, self.bucket_key = pad, index, bucket_key
+        self.provide_data, self.provide_label = provide_data, provide_label
 
     def __str__(self):
-        data_shapes = [d.shape for d in self.data]
-        if self.label:
-            label_shapes = [l.shape for l in self.label]
-        else:
-            label_shapes = None
         return "{}: data shapes: {} label shapes: {}".format(
-            self.__class__.__name__, data_shapes, label_shapes)
+            self.__class__.__name__, [d.shape for d in self.data],
+            [l.shape for l in self.label] if self.label else None)
 
 
 class DataIter:
@@ -124,19 +111,39 @@ class DataIter:
         pass
 
 
-class ResizeIter(DataIter):
+class _CurrentBatchIter(DataIter):
+    """Combinator base: serves next/getdata/... off self.current_batch,
+    which subclasses refresh in iter_next()."""
+
+    current_batch = None
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return self.current_batch
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class ResizeIter(_CurrentBatchIter):
     """Resize an iterator to ``size`` batches per epoch (reference io.py:286)."""
 
     def __init__(self, data_iter, size, reset_internal=True):
-        super().__init__()
-        self.data_iter = data_iter
-        self.size = size
-        self.reset_internal = reset_internal
-        self.cur = 0
-        self.current_batch = None
+        super().__init__(batch_size=data_iter.batch_size)
+        self.data_iter, self.size = data_iter, size
+        self.reset_internal, self.cur = reset_internal, 0
         self.provide_data = data_iter.provide_data
         self.provide_label = data_iter.provide_label
-        self.batch_size = data_iter.batch_size
         if hasattr(data_iter, "default_bucket_key"):
             self.default_bucket_key = data_iter.default_bucket_key
 
@@ -150,148 +157,116 @@ class ResizeIter(DataIter):
             return False
         try:
             self.current_batch = self.data_iter.next()
-        except StopIteration:
-            self.data_iter.reset()
+        except StopIteration:   # wrap around: one epoch of the wrapped
+            self.data_iter.reset()   # iterator is shorter than `size`
             self.current_batch = self.data_iter.next()
         self.cur += 1
         return True
 
-    def next(self):
-        if self.iter_next():
-            return self.current_batch
-        raise StopIteration
 
-    def getdata(self):
-        return self.current_batch.data
-
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getindex(self):
-        return self.current_batch.index
-
-    def getpad(self):
-        return self.current_batch.pad
+def _wait_all(events):
+    for e in events:
+        e.wait()
 
 
-class PrefetchingIter(DataIter):
+def _clear_all(events):
+    for e in events:
+        e.clear()
+
+
+def _set_all(events):
+    for e in events:
+        e.set()
+
+
+class PrefetchingIter(_CurrentBatchIter):
     """Thread-prefetching combinator (reference io.py:375 + the C++
     engine-async ``iter_prefetcher.h``): one worker thread per wrapped
     iterator double-buffers batches so host IO overlaps device compute."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.n_iter = len(iters)
+        self.iters = iters if isinstance(iters, list) else [iters]
+        self.n_iter = len(self.iters)
         assert self.n_iter > 0
-        self.iters = iters
-        self.rename_data = rename_data
-        self.rename_label = rename_label
+        self.rename_data, self.rename_label = rename_data, rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
+        self.data_ready = [threading.Event() for _ in self.iters]
+        self.data_taken = [threading.Event() for _ in self.iters]
+        _set_all(self.data_taken)
         self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
-
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
-
+        self.next_batch = [None] * self.n_iter
         self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            threading.Thread(target=self._worker, args=(i,), daemon=True)
             for i in range(self.n_iter)]
         for thread in self.prefetch_threads:
             thread.start()
 
+    def _worker(self, i):
+        """Pull batch i+1 while the consumer holds batch i (double
+        buffering over data_taken/data_ready event pairs)."""
+        while True:
+            self.data_taken[i].wait()
+            if not self.started:
+                return
+            try:
+                self.next_batch[i] = self.iters[i].next()
+            except StopIteration:
+                self.next_batch[i] = None
+            self.data_taken[i].clear()
+            self.data_ready[i].set()
+
     def __del__(self):
         try:
             self.started = False
-            for e in self.data_taken:
-                e.set()
+            _set_all(self.data_taken)
             for thread in self.prefetch_threads:
                 thread.join(timeout=1.0)
         except Exception:
             pass
 
+    def _renamed_descs(self, renames, attr):
+        sources = [getattr(i, attr) for i in self.iters]
+        if renames is None:
+            return [d for descs in sources for d in descs]
+        return [DataDesc(r[d.name], d.shape, d.dtype)
+                if isinstance(d, DataDesc) else DataDesc(*d)
+                for r, descs in zip(renames, sources) for d in descs]
+
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_data]
-                    for r, i in zip(self.rename_data, self.iters)], [])
+        return self._renamed_descs(self.rename_data, "provide_data")
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_label]
-                    for r, i in zip(self.rename_label, self.iters)], [])
+        return self._renamed_descs(self.rename_label, "provide_label")
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
+        _wait_all(self.data_ready)   # workers quiesced before resetting
         for i in self.iters:
             i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        _clear_all(self.data_ready)
+        _set_all(self.data_taken)
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
-            return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
+        _wait_all(self.data_ready)
+        exhausted = [b is None for b in self.next_batch]
+        if any(exhausted):
+            assert all(exhausted), \
                 "Number of entry mismatches between iterators"
+            return False
+        lead = self.next_batch[0]
+        assert all(b.pad == lead.pad for b in self.next_batch), \
+            "Number of entry mismatches between iterators"
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad,
-            self.next_batch[0].index,
+            [a for b in self.next_batch for a in b.data],
+            [a for b in self.next_batch for a in b.label],
+            lead.pad, lead.index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        _clear_all(self.data_ready)
+        _set_all(self.data_taken)
         return True
-
-    def next(self):
-        if self.iter_next():
-            return self.current_batch
-        raise StopIteration
-
-    def getdata(self):
-        return self.current_batch.data
-
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getindex(self):
-        return self.current_batch.index
-
-    def getpad(self):
-        return self.current_batch.pad
 
 
 def _init_data(data, allow_empty, default_name):

@@ -40,27 +40,21 @@ class Optimizer:
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
                  param_dict=None):
-        self.rescale_grad = rescale_grad
-        self.lr = learning_rate
+        self.rescale_grad, self.lr, self.wd = rescale_grad, learning_rate, wd
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
             self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
+        self.lr_mult, self.wd_mult = {}, {}
+        self.begin_num_update = self.num_update = begin_num_update
         self._index_update_count = {}
-        self.clip_gradient = clip_gradient
-        self.multi_precision = multi_precision
-        if param_idx2name is None:
-            param_idx2name = {}
-        assert isinstance(param_idx2name, dict), \
+        self.clip_gradient, self.multi_precision = (clip_gradient,
+                                                    multi_precision)
+        assert param_idx2name is None or isinstance(param_idx2name, dict), \
             "param_idx2name should be a dict of param indexes to names."
-        self.idx2name = param_idx2name.copy()
-        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None \
-            else ()
-        self.param_dict = param_dict if param_dict else {}
+        self.idx2name = dict(param_idx2name or {})
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) \
+            if sym is not None else ()
+        self.param_dict = dict(param_dict or {})
         self.set_lr_mult({})
         self.set_wd_mult({})
 
@@ -68,123 +62,126 @@ class Optimizer:
     @staticmethod
     def register(klass):
         assert isinstance(klass, type)
-        name = klass.__name__.lower()
-        if name in Optimizer.opt_registry:
+        key = klass.__name__.lower()
+        if Optimizer.opt_registry.setdefault(key, klass) is not klass:
             warnings.warn("WARNING: New optimizer %s.%s is overriding "
                           "existing optimizer %s" % (klass.__module__,
-                                                     klass.__name__, name))
-        Optimizer.opt_registry[name] = klass
+                                                     klass.__name__, key))
+            Optimizer.opt_registry[key] = klass
         return klass
 
     @staticmethod
     def create_optimizer(name, **kwargs):
-        if name.lower() in Optimizer.opt_registry:
-            return Optimizer.opt_registry[name.lower()](**kwargs)
-        raise ValueError("Cannot find optimizer %s" % name)
+        try:
+            klass = Optimizer.opt_registry[name.lower()]
+        except KeyError:
+            raise ValueError("Cannot find optimizer %s" % name)
+        return klass(**kwargs)
 
     # -- state -------------------------------------------------------------
     def create_state(self, index, weight):
         return None
 
+    def _uses_master_weights(self, weight):
+        return self.multi_precision and weight.dtype == _np.float16
+
     def create_state_multi_precision(self, index, weight):
-        weight_master_copy = None
-        if self.multi_precision and weight.dtype == _np.float16:
-            weight_master_copy = weight.astype(_np.float32)
-            return (weight_master_copy,) + (self.create_state(index,
-                                                              weight_master_copy),)
-        if weight.dtype == _np.float16 and not self.multi_precision:
-            warnings.warn("Accumulating with float16 in optimizer can lead to "
-                          "poor accuracy or slow convergence. Consider using "
-                          "multi_precision=True option of the optimizer")
+        if self._uses_master_weights(weight):
+            master = weight.astype(_np.float32)
+            return (master, self.create_state(index, master))
+        if weight.dtype == _np.float16:
+            warnings.warn(
+                "Accumulating with float16 in optimizer can lead to poor "
+                "accuracy or slow convergence. Consider using "
+                "multi_precision=True option of the optimizer")
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == _np.float16:
-            weight_master_copy = state[0]
-            original_state = state[1]
-            grad32 = grad.astype(_np.float32)
-            self.update(index, weight_master_copy, grad32, original_state)
-            weight._data = weight_master_copy._data.astype(weight._data.dtype)
-        else:
+        if not self._uses_master_weights(weight):
             self.update(index, weight, grad, state)
+            return
+        master, inner_state = state
+        self.update(index, master, grad.astype(_np.float32), inner_state)
+        weight._data = master._data.astype(weight._data.dtype)
 
     @property
     def learning_rate(self):
         """Current base learning rate (reference optimizer.py
         Optimizer.learning_rate: scheduler value at num_update when a
         scheduler is set, else the static lr)."""
-        if self.lr_scheduler is not None:
-            return self.lr_scheduler(self.num_update)
-        return self.lr
+        sched = self.lr_scheduler
+        return self.lr if sched is None else sched(self.num_update)
 
     # -- multipliers -------------------------------------------------------
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
-            raise UserWarning("LRScheduler of the optimizer has already been "
-                              "defined. Note that set_learning_rate can mutate "
-                              "the value of the learning rate of the optimizer "
-                              "only when the LRScheduler of the optimizer is "
-                              "undefined.")
+            raise UserWarning(
+                "LRScheduler of the optimizer has already been defined. "
+                "Note that set_learning_rate can mutate the value of the "
+                "learning rate of the optimizer only when the LRScheduler "
+                "of the optimizer is undefined.")
         self.lr = lr
 
     def set_lr_scale(self, args_lrscale):
         raise DeprecationWarning("Use set_lr_mult instead.")
 
-    def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
+    def _sym_mults(self, tag):
+        """Per-name multipliers declared as symbol attrs (__lr_mult__ /
+        __wd_mult__)."""
+        found = {}
         if self.sym_info:
             attr, arg_names = self.sym_info
             for name in arg_names:
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+                declared = attr.get(name, {})
+                if tag in declared:
+                    found[name] = float(declared[tag])
+        return found
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = self._sym_mults("__lr_mult__")
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            is_weight = n.endswith("_weight")
-            is_fc_bias = n.endswith("_bias")
-            if not (is_weight or is_fc_bias):
-                self.wd_mult[n] = 0.0
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        # biases/gains decay at 0 unless told otherwise (reference:
+        # anything not *_weight / *_bias gets wd_mult 0)
+        self.wd_mult = {
+            n: 0.0 for n in self.idx2name.values()
+            if not n.endswith(("_weight", "_bias"))}
+        self.wd_mult.update(self._sym_mults("__wd_mult__"))
         self.wd_mult.update(args_wd_mult)
 
     # -- bookkeeping -------------------------------------------------------
     def _update_count(self, index):
-        if index not in self._index_update_count:
-            self._index_update_count[index] = self.begin_num_update
-        self._index_update_count[index] += 1
-        self.num_update = max(self._index_update_count[index], self.num_update)
+        count = self._index_update_count
+        count[index] = count.get(index, self.begin_num_update) + 1
+        self.num_update = max(count[index], self.num_update)
+
+    def _scaled(self, index, base, mults, which):
+        """base x the multiplier that applies to this slot: param_dict
+        beats explicit index entries beats name-keyed entries."""
+        if index in self.param_dict:
+            return base * getattr(self.param_dict[index], which)
+        if index in mults:
+            return base * mults[index]
+        if index in self.idx2name:
+            return base * mults.get(self.idx2name[index], 1.0)
+        return base
 
     def _get_lr(self, index):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        return self._scaled(index, self.learning_rate, self.lr_mult,
+                            "lr_mult")
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.param_dict:
-            wd *= self.param_dict[index].wd_mult
-        elif index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self._scaled(index, self.wd, self.wd_mult, "wd_mult")
+
+    def _begin_update(self, index):
+        """Count the update and fetch this slot's effective (lr, wd) —
+        every concrete update() opens with exactly this."""
+        self._update_count(index)
+        return self._get_lr(index), self._get_wd(index)
 
     def __getstate__(self):
         return self.__dict__
@@ -285,8 +282,7 @@ class SGD(Optimizer):
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.lazy_update = lazy_update
+        self.momentum, self.lazy_update = momentum, lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -296,9 +292,7 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         if _is_rsp(grad) and self.lazy_update:
             return _lazy_rsp_update(self, index, weight, grad, state)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         if state is not None:
             new_w, new_mom = nd.sgd_mom_update(
                 weight, grad, state, lr=lr, momentum=self.momentum, wd=wd,
@@ -321,8 +315,7 @@ class Signum(Optimizer):
 
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.momentum = momentum
-        self.wd_lh = wd_lh
+        self.momentum, self.wd_lh = momentum, wd_lh
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -330,9 +323,7 @@ class Signum(Optimizer):
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         clip = self.clip_gradient if self.clip_gradient is not None else -1.0
         if state is not None:
             new_w, new_mom = nd.signum_update(
@@ -354,9 +345,7 @@ class FTML(Optimizer):
 
     def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
         z = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
@@ -365,9 +354,7 @@ class FTML(Optimizer):
         return (d, v, z)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         t = self._index_update_count[index]
         d, v, z = state
         new_w, new_d, new_v, new_z = nd.ftml_update(
@@ -393,18 +380,14 @@ class LBSGD(Optimizer):
         logging.info("(Batch_scale=%f, warmup_epochs=%d, warmup_strategy=%s, "
                      "updates_per_epoch=%d)", batch_scale, warmup_epochs,
                      warmup_strategy, updates_per_epoch)
-        self.momentum = momentum
-        self.multi_precision = multi_precision
-        self.warmup_strategy = warmup_strategy
-        self.warmup_epochs = warmup_epochs
-        self.batch_scale = batch_scale
-        self.updates_per_epoch = updates_per_epoch
+        self.momentum, self.multi_precision = momentum, multi_precision
+        self.warmup_strategy, self.warmup_epochs = (warmup_strategy,
+                                                    warmup_epochs)
+        self.batch_scale, self.updates_per_epoch = (batch_scale,
+                                                    updates_per_epoch)
         self.init_updates = begin_epoch * updates_per_epoch
-        self.num_epochs = num_epochs
-        self.lbmult = 1
-        self.cumgrads = {}
-        self.adaptive = False
-        self.admult = 1
+        self.num_epochs, self.lbmult = num_epochs, 1
+        self.cumgrads, self.adaptive, self.admult = {}, False, 1
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -441,9 +424,7 @@ class LBSGD(Optimizer):
         return lars
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         if self.warmup_strategy == "lars":
             lbmult = self._get_lars(weight, grad, wd)
         else:
@@ -469,9 +450,8 @@ class DCASGD(Optimizer):
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
+        self.momentum, self.lamda = momentum, lamda
         self.weight_previous = {}
-        self.lamda = lamda
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -480,9 +460,7 @@ class DCASGD(Optimizer):
                 weight.copy())
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         g = grad._data * self.rescale_grad
         g = _clip(g, self.clip_gradient)
         mon, previous_weight = state
@@ -503,7 +481,7 @@ class NAG(Optimizer):
 
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
+        self.momentum = float(momentum)
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -511,9 +489,7 @@ class NAG(Optimizer):
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         g = grad._data * self.rescale_grad
         g = _clip(g, self.clip_gradient)
         g = g + wd * weight._data
@@ -532,9 +508,7 @@ class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference optimizer.py:1067)."""
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         g = grad._data * self.rescale_grad
         g = _clip(g, self.clip_gradient)
         from .ops.registry import next_rng_key
@@ -556,9 +530,7 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
@@ -568,9 +540,7 @@ class Adam(Optimizer):
     def update(self, index, weight, grad, state):
         if _is_rsp(grad) and self.lazy_update:
             return _lazy_rsp_update(self, index, weight, grad, state)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
@@ -601,9 +571,7 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         if _is_rsp(grad):
             return _lazy_rsp_update(self, index, weight, grad, state)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         g = grad._data * self.rescale_grad
         g = _clip(g, self.clip_gradient)
         history = state._data + g * g
@@ -634,9 +602,7 @@ class RMSProp(Optimizer):
         return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         clip = self.clip_gradient if self.clip_gradient is not None else -1.0
         cw = self.clip_weights if self.clip_weights is not None else -1.0
         if not self.centered:
@@ -706,9 +672,7 @@ class Ftrl(Optimizer):
     def update(self, index, weight, grad, state):
         if _is_rsp(grad):
             return _lazy_rsp_update(self, index, weight, grad, state)
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         z, n = state
         new_w, new_z, new_n = nd.ftrl_update(
             weight, grad, z, n, lr=lr, lamda1=self.lamda1, beta=self.beta,
@@ -735,9 +699,7 @@ class Adamax(Optimizer):
                          dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         t = self._index_update_count[index]
         lr /= (1.0 - self.beta1 ** t)
         g = grad._data * self.rescale_grad + wd * weight._data
@@ -755,9 +717,7 @@ class Nadam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
         self.m_schedule = 1.0
 
@@ -768,9 +728,7 @@ class Nadam(Optimizer):
                          dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin_update(index)
         t = self._index_update_count[index]
         g = grad._data * self.rescale_grad + wd * weight._data
         g = _clip(g, self.clip_gradient)
