@@ -393,54 +393,85 @@ def serve_forever():
     srv._thread.join()
 
 
-class _ServerConn:
-    """One worker's connection to one server (thread-safe via a lock —
-    the worker pushes from its training thread only, but keep it safe)."""
+# sockets per server per worker: the server handles each connection on
+# its own thread, so k sockets let k in-flight parts unpickle/apply in
+# parallel inside ONE server. Default 1 — on the 1-core measurement
+# host extra sockets bought nothing (docs/ps_throughput.json; the
+# server CPU, not the socket serialization, is the limit there); raise
+# on multi-core servers where handler threads can actually overlap.
+_CONNS_PER_SERVER = int(os.environ.get("MXTPU_PS_CONNS", "1"))
 
-    def __init__(self, addr, connect_timeout=60.0, token=None):
+
+class _ServerConn:
+    """One worker's channel to one server: a small pool of sockets, each
+    serving one in-flight request/reply at a time. Thread-safe —
+    concurrent callers pick an idle socket or wait on the round-robin
+    next one."""
+
+    def __init__(self, addr, connect_timeout=60.0, token=None,
+                 n_socks=None):
         host, _, port = addr.partition(":")
+        n_socks = max(1, n_socks if n_socks is not None
+                      else _CONNS_PER_SERVER)
         # the launcher starts servers and workers simultaneously and a
         # server binds only after its (slow) mxtpu import + updater
         # warm-up — on localhost an unbound port refuses instantly, so
         # retry with backoff instead of failing the whole launch
         deadline = time.time() + connect_timeout
-        delay = 0.1
-        while True:
-            try:
-                self._sock = socket.create_connection(
-                    (host, int(port)), timeout=300)
-                self._sock.setsockopt(socket.IPPROTO_TCP,
-                                      socket.TCP_NODELAY, 1)
-                break
-            except OSError:
-                if time.time() >= deadline:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 2.0)
-        self._lock = threading.Lock()
-        if token:
-            self._sock.sendall(_auth_blob(token))
+        self._socks = []
+        for _ in range(n_socks):
+            delay = 0.1
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=300)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    break
+                except OSError:
+                    if time.time() >= deadline:
+                        raise
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+            if token:
+                s.sendall(_auth_blob(token))
+            self._socks.append(s)
+        self._locks = [threading.Lock() for _ in self._socks]
+        self._rr = 0
+
+    def _pick(self):
+        """An idle socket if any lock is free, else block on the next in
+        round-robin order (fair under saturation)."""
+        for i, lock in enumerate(self._locks):
+            if lock.acquire(blocking=False):
+                return i, lock
+        i = self._rr = (self._rr + 1) % len(self._locks)
+        lock = self._locks[i]
+        lock.acquire()
+        return i, lock
 
     def request(self, *msg):
+        i, lock = self._pick()
         try:
-            with self._lock:
-                _send_frame(self._sock, msg)
-                reply = _recv_frame(self._sock)
+            _send_frame(self._socks[i], msg)
+            reply = _recv_frame(self._socks[i])
         except (ConnectionError, EOFError) as e:
             raise ConnectionError(
                 "parameter server connection lost during %r: %s (a close "
                 "right after connect usually means MXTPU_PS_TOKEN does "
                 "not match between this worker and the server)"
                 % (msg[0], e)) from e
+        finally:
+            lock.release()
         if reply[0] == "err":
             raise RuntimeError("parameter server: %s" % reply[1])
         return reply
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class AsyncDistKVStore(KVStore):
