@@ -64,6 +64,7 @@ from __future__ import annotations
 import io
 import os
 import pickle
+import queue as _queue
 import socket
 import socketserver
 import struct
@@ -404,13 +405,14 @@ _CONNS_PER_SERVER = int(os.environ.get("MXTPU_PS_CONNS", "1"))
 
 class _ServerConn:
     """One worker's channel to one server: a small pool of sockets, each
-    serving one in-flight request/reply at a time. Thread-safe —
-    concurrent callers pick an idle socket or wait on the round-robin
-    next one."""
+    serving one in-flight request/reply at a time. Thread-safe via a
+    free-index queue — callers block until any socket is idle."""
 
     def __init__(self, addr, connect_timeout=60.0, token=None,
                  n_socks=None):
-        host, _, port = addr.partition(":")
+        self._host, _, port = addr.partition(":")
+        self._port = int(port)
+        self._token = token
         n_socks = max(1, n_socks if n_socks is not None
                       else _CONNS_PER_SERVER)
         # the launcher starts servers and workers simultaneously and a
@@ -418,50 +420,60 @@ class _ServerConn:
         # warm-up — on localhost an unbound port refuses instantly, so
         # retry with backoff instead of failing the whole launch
         deadline = time.time() + connect_timeout
-        self._socks = []
-        for _ in range(n_socks):
-            delay = 0.1
-            while True:
-                try:
-                    s = socket.create_connection((host, int(port)),
-                                                 timeout=300)
-                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    break
-                except OSError:
-                    if time.time() >= deadline:
-                        raise
-                    time.sleep(delay)
-                    delay = min(delay * 2, 2.0)
-            if token:
-                s.sendall(_auth_blob(token))
-            self._socks.append(s)
-        self._locks = [threading.Lock() for _ in self._socks]
-        self._rr = 0
+        self._socks = [self._connect(deadline) for _ in range(n_socks)]
+        self._free = _queue.SimpleQueue()
+        for i in range(n_socks):
+            self._free.put(i)
 
-    def _pick(self):
-        """An idle socket if any lock is free, else block on the next in
-        round-robin order (fair under saturation)."""
-        for i, lock in enumerate(self._locks):
-            if lock.acquire(blocking=False):
-                return i, lock
-        i = self._rr = (self._rr + 1) % len(self._locks)
-        lock = self._locks[i]
-        lock.acquire()
-        return i, lock
+    def _connect(self, deadline):
+        delay = 0.1
+        while True:
+            try:
+                s = socket.create_connection((self._host, self._port),
+                                             timeout=300)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        if self._token:
+            s.sendall(_auth_blob(self._token))
+        return s
+
+    @property
+    def n_socks(self):
+        return len(self._socks)
 
     def request(self, *msg):
-        i, lock = self._pick()
+        i = self._free.get()
         try:
             _send_frame(self._socks[i], msg)
             reply = _recv_frame(self._socks[i])
-        except (ConnectionError, EOFError) as e:
-            raise ConnectionError(
-                "parameter server connection lost during %r: %s (a close "
-                "right after connect usually means MXTPU_PS_TOKEN does "
-                "not match between this worker and the server)"
-                % (msg[0], e)) from e
-        finally:
-            lock.release()
+        except Exception as e:
+            # ANY mid-conversation failure (timeout included) may leave
+            # a stale reply in flight — never reuse that socket: close
+            # it, try one quick reconnect, and surface the error. A
+            # failed reconnect leaves a closed socket whose next use
+            # errors loudly instead of mispairing replies.
+            try:
+                self._socks[i].close()
+            except OSError:
+                pass
+            try:
+                self._socks[i] = self._connect(time.time() + 10)
+            except OSError:
+                pass
+            self._free.put(i)
+            if isinstance(e, (ConnectionError, EOFError)):
+                raise ConnectionError(
+                    "parameter server connection lost during %r: %s (a "
+                    "close right after connect usually means "
+                    "MXTPU_PS_TOKEN does not match between this worker "
+                    "and the server)" % (msg[0], e)) from e
+            raise
+        self._free.put(i)
         if reply[0] == "err":
             raise RuntimeError("parameter server: %s" % reply[1])
         return reply
@@ -499,10 +511,11 @@ class AsyncDistKVStore(KVStore):
         self._parts = {}           # key -> [(subkey, row_lo, row_hi), ...]
         self._shapes = {}          # key -> full array shape
         from concurrent.futures import ThreadPoolExecutor
-        # parts of one array move concurrently (different sockets reach
-        # different servers in parallel; one socket still serializes)
+        # parts of one array move concurrently: enough workers to keep
+        # every socket of every server pool in flight
+        total_socks = sum(c.n_socks for c in self._conns)
         self._pool = ThreadPoolExecutor(
-            max_workers=max(4, 2 * len(self._conns)),
+            max_workers=max(4, 2 * total_socks),
             thread_name_prefix="mxtpu-ps")
 
     # -- identity ---------------------------------------------------------
