@@ -462,7 +462,9 @@ class _ServerConn:
             except OSError:
                 pass
             try:
-                self._socks[i] = self._connect(time.time() + 10)
+                # single attempt: stale-reply protection is the close
+                # above; retry loops here would stall error propagation
+                self._socks[i] = self._connect(time.time())
             except OSError:
                 pass
             self._free.put(i)

@@ -178,6 +178,13 @@ class ShardedTrainer:
     mesh : MeshContext, optional (defaults to all devices on the data axis)
     rules : ShardingRules, optional — tensor-parallel parameter layouts;
         unmatched parameters are replicated (pure DP).
+    zero1 : bool — ZeRO-stage-1 optimizer-state sharding: for pure-DP
+        (replicated) parameters whose leading dim divides the data axis,
+        optimizer state lives dim-0-sharded across the data axis and the
+        update computes on shards; declared via sharding constraints, so
+        XLA's SPMD partitioner materializes the reduce_scatter (grads) /
+        all_gather (updated weights) pair — no hand-written collectives.
+        State memory for those params drops by the data-axis size.
 
     Example
     -------
@@ -190,7 +197,7 @@ class ShardedTrainer:
 
     def __init__(self, block, loss, optimizer, optimizer_params=None,
                  mesh=None, rules=None, donate=True, dtype=None,
-                 remat=None, remat_policy=None):
+                 remat=None, remat_policy=None, zero1=False):
         if dtype not in (None, "float32", "bfloat16"):
             # float16 would need loss scaling (reference mp_sgd pairs fp16
             # weights with fp32 master copies + scale); bf16 shares f32's
@@ -223,6 +230,7 @@ class ShardedTrainer:
             raise ValueError("remat_policy given but remat=False")
         self._remat = bool(remat)
         self._remat_policy = remat_policy
+        self._zero1 = bool(zero1)
         self._step_fns = {}
         self._placed = False
         self._key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
@@ -240,6 +248,7 @@ class ShardedTrainer:
         self._aux_vals = None
         self._opt_states = None
         self._shardings = None
+        self._zero1_shardings = None
 
     # -- placement ---------------------------------------------------------
     def _place(self, args):
@@ -264,15 +273,29 @@ class ShardedTrainer:
                 for p, s in zip(params, shardings)]
         self._param_vals = [vals[i] for i in self._train_idx]
         self._aux_vals = [vals[i] for i in self._aux_idx]
+        # ZeRO-1: a pure-DP (replicated) param with a dim-0 divisible by
+        # the data axis gets its optimizer state dim-0-sharded there
+        self._zero1_shardings = []
+        ndata = self._mesh.axis_size(AXIS_DATA)
+        for i in self._train_idx:
+            p = params[i]
+            z_sh = None
+            if self._zero1 and ndata > 1 and len(p.shape) >= 1 \
+                    and p.shape[0] % ndata == 0 \
+                    and shardings[i] == self._mesh.replicated():
+                z_sh = self._mesh.sharding(
+                    AXIS_DATA, *([None] * (len(p.shape) - 1)))
+            self._zero1_shardings.append(z_sh)
         # sharded optimizer state: any state leaf with the param's shape
-        # inherits the param's sharding (momentum/variance live alongside
-        # the weight shard — the ZeRO-friendly default), scalars replicate.
+        # inherits the param's sharding — or its ZeRO-1 dim-0 shard
+        # (momentum/variance live alongside the weight shard), scalars
+        # replicate.
         self._opt_states = []
         for j, i in enumerate(self._train_idx):
             p = params[i]
             st = state_to_tree(
                 self._optimizer.create_state_multi_precision(j, p.data()))
-            sh = shardings[i]
+            sh = self._zero1_shardings[j] or shardings[i]
 
             def place_leaf(leaf, sh=sh, shape=p.shape):
                 if leaf is None:
@@ -355,11 +378,30 @@ class ShardedTrainer:
                     loss_fn, has_aux=True)(
                         train_vals, aux_vals, inputs, label, sub, True)
             new_vals, new_states = [], []
+            zero1_sh = self._zero1_shardings
             with jax.named_scope("optimizer"):
                 for j, (w, g, st) in enumerate(zip(train_vals, grads,
                                                    states)):
+                    z_sh = zero1_sh[j]
+                    if z_sh is not None:
+                        # ZeRO-1: pin grad/weight/state to the dim-0
+                        # data shard so the update computes on 1/N of
+                        # the param per device; the partitioner turns
+                        # the replicated-grad dependency into a
+                        # reduce_scatter and the new_vals constraint
+                        # below into an all_gather
+                        g = jax.lax.with_sharding_constraint(g, z_sh)
+                        w = jax.lax.with_sharding_constraint(w, z_sh)
                     w2, st2 = functional_optimizer_step(
                         optimizer, j, w, g, st, t, lr)
+                    if z_sh is not None:
+                        st2 = jax.tree_util.tree_map(
+                            lambda leaf, zs=z_sh, pw=w:
+                            jax.lax.with_sharding_constraint(leaf, zs)
+                            if leaf is not None
+                            and tuple(leaf.shape) == tuple(pw.shape)
+                            else leaf,
+                            st2, is_leaf=lambda x: x is None)
                     new_vals.append(w2)
                     new_states.append(st2)
             # pin layouts so donation round-trips buffers in place
