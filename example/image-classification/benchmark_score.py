@@ -27,9 +27,38 @@ MODELS = {
     "densenet121": vision.densenet121,
 }
 
+# models that exist as symbol builders rather than gluon zoo blocks
+# (the reference scored Inception-BN from its symbol library too)
+SYMBOL_MODELS = {"inception-bn": "inception_bn"}
+
+
+def _score_symbol(model_name, batch, hw, n_iter):
+    from importlib import import_module
+    here = os.path.dirname(os.path.abspath(__file__))
+    if here not in sys.path:   # callers import this module by file path
+        sys.path.insert(0, here)
+    mod = import_module("symbols." + SYMBOL_MODELS[model_name])
+    sym = mod.get_symbol(1000, "3,%d,%d" % (hw, hw))
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         data=(batch, 3, hw, hw),
+                         softmax_label=(batch,))
+    ex.arg_dict["data"][:] = np.random.uniform(
+        size=(batch, 3, hw, hw)).astype(np.float32)
+    out = ex.forward(is_train=False)[0]
+    out.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        out = ex.forward(is_train=False)[0]
+    out.wait_to_read()
+    return batch * n_iter / (time.perf_counter() - t0)
+
 
 def score(model_name, batch, hw, n_iter=10, dtype="float32"):
     mx.random.seed(0)
+    if model_name in SYMBOL_MODELS:
+        assert dtype == "float32", \
+            "symbol-path scoring is fp32 (the reference methodology)"
+        return _score_symbol(model_name, batch, hw, n_iter)
     net = MODELS[model_name]()
     net.initialize(mx.init.Xavier(), force_reinit=True)
     if dtype != "float32":
@@ -58,7 +87,7 @@ def main():
     p.add_argument("--iters", type=int, default=10)
     args = p.parse_args()
     for name in args.models.split(","):
-        hw = 299 if "inception" in name else args.image_size
+        hw = 299 if name == "inception-v3" else args.image_size
         for b in (int(x) for x in args.batch_sizes.split(",")):
             img_s = score(name, b, hw, args.iters)
             print("network: %-14s batch: %3d  images/sec: %.2f"

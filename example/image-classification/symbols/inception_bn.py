@@ -1,0 +1,112 @@
+"""Symbolic Inception-BN (GoogLeNet v2, Ioffe & Szegedy 2015) for the
+Module paths.
+
+Capability parity with the reference's symbol library
+(example/image-classification/symbols/inception-bn.py): same stage plan
+and channel allocation — it is the "Inception-BN" column of the
+reference's published CPU/GPU benchmark tables (docs/faq/perf.md), so
+the architecture must match for the numbers to be comparable. The
+construction here is table-driven over one mixed-block builder rather
+than per-block factory functions.
+"""
+from __future__ import annotations
+
+import mxtpu as mx
+
+
+def _unit(x, channels, kernel, name, stride=(1, 1), pad=(0, 0)):
+    """conv -> BN -> relu, the paper's basic unit."""
+    x = mx.sym.Convolution(x, num_filter=channels, kernel=kernel,
+                           stride=stride, pad=pad, name=name + "_conv")
+    x = mx.sym.BatchNorm(x, fix_gamma=False, name=name + "_bn")
+    return mx.sym.Activation(x, act_type="relu", name=name + "_relu")
+
+
+def _tower(x, name, *stages):
+    """A chain of units: stages are (channels, kernel, stride, pad)."""
+    for k, (ch, kern, stride, pad) in enumerate(stages):
+        x = _unit(x, ch, kern, "%s_%d" % (name, k), stride, pad)
+    return x
+
+
+def _mixed(x, name, n1x1, n3r, n3, nd3r, nd3, pool, proj, downsample=False):
+    """One Inception block. Normal blocks carry four branches
+    (1x1 / 3x3 / double-3x3 / pooled projection); downsample blocks drop
+    the 1x1 branch, stride their last convs, and pass the pool through
+    unprojected."""
+    stride = (2, 2) if downsample else (1, 1)
+    towers = []
+    if not downsample:
+        towers.append(_tower(x, name + "_b1", (n1x1, (1, 1), (1, 1),
+                                               (0, 0))))
+    towers.append(_tower(x, name + "_b3",
+                         (n3r, (1, 1), (1, 1), (0, 0)),
+                         (n3, (3, 3), stride, (1, 1))))
+    towers.append(_tower(x, name + "_bd3",
+                         (nd3r, (1, 1), (1, 1), (0, 0)),
+                         (nd3, (3, 3), (1, 1), (1, 1)),
+                         (nd3, (3, 3), stride, (1, 1))))
+    pooled = mx.sym.Pooling(x, kernel=(3, 3), stride=stride, pad=(1, 1),
+                            pool_type=pool, name=name + "_pool")
+    if proj:
+        pooled = _unit(pooled, proj, (1, 1), name + "_bp")
+    towers.append(pooled)
+    return mx.sym.Concat(*towers, name=name + "_concat")
+
+
+# (name, n1x1, n3x3red, n3x3, nd3x3red, nd3x3, pool, proj, downsample) —
+# the published channel allocation, stage by stage
+_PLAN = [
+    ("3a", 64, 64, 64, 64, 96, "avg", 32, False),
+    ("3b", 64, 64, 96, 64, 96, "avg", 64, False),
+    ("3c", 0, 128, 160, 64, 96, "max", 0, True),
+    ("4a", 224, 64, 96, 96, 128, "avg", 128, False),
+    ("4b", 192, 96, 128, 96, 128, "avg", 128, False),
+    ("4c", 160, 128, 160, 128, 160, "avg", 128, False),
+    ("4d", 96, 128, 192, 160, 192, "avg", 128, False),
+    ("4e", 0, 128, 192, 192, 256, "max", 0, True),
+    ("5a", 352, 192, 320, 160, 224, "avg", 128, False),
+    ("5b", 352, 192, 320, 192, 224, "max", 128, False),
+]
+
+
+def get_symbol(num_classes=1000, image_shape="3,224,224", **kwargs):
+    height = int(str(image_shape).split(",")[1])
+    x = mx.sym.Variable("data")
+    if height <= 28:
+        # small-image variant: 3x3 stem + simplified two-branch blocks
+        x = _unit(x, 96, (3, 3), "stem", pad=(1, 1))
+        small_plan = [("3a", 32, 32), ("3b", 32, 48), ("3c", 0, 80),
+                      ("4a", 112, 48), ("4b", 96, 64), ("4c", 80, 80),
+                      ("4d", 48, 96), ("4e", 0, 96), ("5a", 176, 160),
+                      ("5b", 176, 160)]
+        for name, c1, c3 in small_plan:
+            if c1 == 0:   # downsample: strided 3x3 + max pool
+                conv = _unit(x, c3, (3, 3), name + "_conv",
+                             stride=(2, 2), pad=(1, 1))
+                pool = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2),
+                                      pad=(1, 1), pool_type="max",
+                                      name=name + "_pool")
+                x = mx.sym.Concat(conv, pool, name=name + "_concat")
+            else:
+                x = mx.sym.Concat(
+                    _unit(x, c1, (1, 1), name + "_1x1"),
+                    _unit(x, c3, (3, 3), name + "_3x3", pad=(1, 1)),
+                    name=name + "_concat")
+        x = mx.sym.Pooling(x, kernel=(7, 7), pool_type="avg",
+                           name="global_pool")
+    else:
+        x = _unit(x, 64, (7, 7), "stem1", stride=(2, 2), pad=(3, 3))
+        x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2),
+                           pool_type="max", name="pool1")
+        x = _tower(x, "stem2", (64, (1, 1), (1, 1), (0, 0)),
+                   (192, (3, 3), (1, 1), (1, 1)))
+        x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2),
+                           pool_type="max", name="pool2")
+        for row in _PLAN:
+            x = _mixed(x, *row)
+        x = mx.sym.Pooling(x, kernel=(7, 7), stride=(1, 1),
+                           pool_type="avg", name="global_pool")
+    x = mx.sym.Flatten(x)
+    x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

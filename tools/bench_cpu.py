@@ -41,14 +41,18 @@ sys.path.insert(0, ROOT)
 # 4 vCPU), batch 32 and batch 1 rows
 C4_8XL_VCPUS = 36
 C4_8XL_B32 = {"alexnet": 564.04, "vgg16": 87.15, "inception-v3": 83.05,
-              "resnet-50": 62.19, "resnet-152": 25.76}
+              "resnet-50": 62.19, "resnet-152": 25.76,
+              "inception-bn": 208.21}
 C4_8XL_B1 = {"alexnet": 119.57, "vgg16": 34.23, "inception-v3": 54.42,
-             "resnet-50": 42.83, "resnet-152": 19.51}
+             "resnet-50": 42.83, "resnet-152": 19.51,
+             "inception-bn": 111.36}
 C4_XL_VCPUS = 4
 C4_XL_B32 = {"alexnet": 65.05, "vgg16": 10.91, "inception-v3": 9.34,
-             "resnet-50": 10.31, "resnet-152": 3.86}
+             "resnet-50": 10.31, "resnet-152": 3.86,
+             "inception-bn": 33.86}
 C4_XL_B1 = {"alexnet": 37.92, "vgg16": 6.57, "inception-v3": 8.79,
-            "resnet-50": 9.65, "resnet-152": 3.73}
+            "resnet-50": 9.65, "resnet-152": 3.73,
+            "inception-bn": 23.09}
 
 
 def _score_mod():
@@ -68,7 +72,7 @@ def score_model(name, batch=32, n_iter=None):
     """images/sec, reference methodology; iteration count auto-scales so
     slow models on small hosts still finish in a bounded time."""
     bs = _score_mod()
-    hw = 299 if "inception" in name else 224
+    hw = 299 if name == "inception-v3" else 224
     if n_iter is None:
         t0 = time.perf_counter()
         bs.score(name, batch, hw, n_iter=1)      # includes compile
@@ -129,7 +133,8 @@ def main():
         pass
 
     models = ["resnet-50"] if args.quick else \
-        ["resnet-50", "vgg16", "inception-v3", "alexnet", "resnet-152"]
+        ["resnet-50", "vgg16", "inception-v3", "alexnet", "resnet-152",
+         "inception-bn"]
     out = os.path.join(ROOT, "docs", "cpu_scoreboard.json")
     try:   # always merge: a batch-1 or single-model run must not clobber
         with open(out) as f:   # the other rows already measured

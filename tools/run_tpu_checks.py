@@ -355,7 +355,7 @@ def check_inference(report):
     baselines = {"resnet-50": 713.17, "vgg16": 854.4,
                  "inception-v3": 493.72}    # perf.md:144, P100 batch 32
     for name, baseline in baselines.items():
-        hw = 299 if "inception" in name else 224
+        hw = 299 if name == "inception-v3" else 224
         for dtype in ("float32", "bfloat16"):
             for nhwc in (False, True):
                 key = "%s_b32_%s%s" % (name, dtype,
