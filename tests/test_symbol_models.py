@@ -1,0 +1,47 @@
+"""Symbol-library models (example/image-classification/symbols/): the
+Module-path counterparts of the gluon zoo, used by train_imagenet and
+the benchmark's symbol-scoring leg."""
+import os
+import sys
+
+import numpy as np
+
+import mxtpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "example", "image-classification"))
+
+
+def test_inception_bn_small_variant_forward_backward():
+    from symbols.inception_bn import get_symbol
+    sym = get_symbol(10, "3,28,28")
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write",
+                         data=(2, 3, 28, 28), softmax_label=(2,))
+    r = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            # simple_bind zero-fills args; zero conv weights would zero
+            # the whole chain (and its gradients)
+            arr[:] = (r.rand(*arr.shape).astype("f") - 0.5) * 0.2
+    ex.arg_dict["data"][:] = r.rand(2, 3, 28, 28).astype("f")
+    ex.arg_dict["softmax_label"][:] = np.array([1.0, 3.0], "f")
+    out = ex.forward(is_train=True)[0]
+    assert out.shape == (2, 10)
+    p = out.asnumpy()
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_inception_bn_imagenet_variant_shapes():
+    from symbols.inception_bn import get_symbol
+    sym = get_symbol(1000, "3,224,224")
+    # channel allocation check at the meeting points (reference plan):
+    # final concat before global pool carries 352+320+224+128 = 1024
+    _, out_shapes, _ = sym.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 1000)
+    args = set(sym.list_arguments())
+    assert "in5b_b1_0_conv_weight" in args or any(
+        a.startswith("in5b") or "5b" in a for a in args), \
+        sorted(args)[:10]
