@@ -301,3 +301,28 @@ def test_rec2idx_tool(tmp_path):
     assert r.read_idx(0) == payloads[0]
     assert r.read_idx(6) == payloads[6]
     assert sorted(r.keys) == list(range(7))
+
+
+def test_prefetching_iter_rename_and_multi():
+    """PrefetchingIter over two iterators with renamed descriptors: the
+    combinator concatenates data/label lists and rewrites DataDesc names
+    (reference io.py PrefetchingIter rename_data/rename_label)."""
+    x1 = np.arange(24, dtype="f").reshape(12, 2)
+    x2 = np.arange(24, 36, dtype="f").reshape(12, 1)
+    y = np.arange(12, dtype="f")
+    it1 = mx.io.NDArrayIter(x1, y, batch_size=4, data_name="a",
+                            label_name="la")
+    it2 = mx.io.NDArrayIter(x2, None, batch_size=4, data_name="b")
+    pre = mx.io.PrefetchingIter(
+        [it1, it2], rename_data=[{"a": "left"}, {"b": "right"}],
+        rename_label=[{"la": "y"}, {}])
+    names = [d.name for d in pre.provide_data]
+    assert names == ["left", "right"], names
+    assert [d.name for d in pre.provide_label] == ["y"]
+    batches = list(pre)
+    assert len(batches) == 3
+    assert [a.shape for a in batches[0].data] == [(4, 2), (4, 1)]
+    got = np.concatenate([b.data[0].asnumpy() for b in batches])
+    np.testing.assert_allclose(got, x1)
+    pre.reset()
+    assert len(list(pre)) == 3

@@ -39,9 +39,15 @@ def test_inception_bn_imagenet_variant_shapes():
     sym = get_symbol(1000, "3,224,224")
     # channel allocation check at the meeting points (reference plan):
     # final concat before global pool carries 352+320+224+128 = 1024
-    _, out_shapes, _ = sym.infer_shape(data=(1, 3, 224, 224))
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(1, 3, 224, 224))
     assert out_shapes[0] == (1, 1000)
-    args = set(sym.list_arguments())
-    assert "in5b_b1_0_conv_weight" in args or any(
-        a.startswith("in5b") or "5b" in a for a in args), \
-        sorted(args)[:10]
+    # channel-allocation check: the 5b concat feeds global pool ->
+    # flatten -> fc, so fc1_weight's input width is the final plan sum
+    # 352 + 320 + 224 + 128 = 1024
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (1000, 1024), shapes["fc1_weight"]
+    # and the four 5b branches exist with the planned output channels
+    assert shapes["5b_b1_0_conv_weight"][0] == 352
+    assert shapes["5b_b3_1_conv_weight"][0] == 320
+    assert shapes["5b_bd3_2_conv_weight"][0] == 224
+    assert shapes["5b_bp_conv_weight"][0] == 128
