@@ -687,8 +687,8 @@ def main():
                     pending.pop(0)
             _flush(report)
             continue
-        if platform is not None:
-            # healthy non-TPU backend is definitive — waiting can't help
+        if platform is not None and args.wait == 0:
+            # one-shot mode on a healthy non-TPU backend: definitive
             report["tpu_unavailable"] = True
             _flush(report)
             print(json.dumps(report)[:400])
@@ -696,8 +696,17 @@ def main():
         if time.time() >= deadline:
             break
         remaining = int((deadline - time.time()) / 60)
-        print("[%s] relay down; retrying for up to %d more minutes"
-              % (time.strftime("%F %T"), remaining), flush=True)
+        if platform is not None:
+            # the relay errored FAST this probe (jax fell back to a
+            # healthy cpu backend) instead of hanging — still a down
+            # relay, and it can recover: keep waiting
+            print("[%s] relay errored (probe fell back to %r); retrying "
+                  "for up to %d more minutes"
+                  % (time.strftime("%F %T"), platform, remaining),
+                  flush=True)
+        else:
+            print("[%s] relay down; retrying for up to %d more minutes"
+                  % (time.strftime("%F %T"), remaining), flush=True)
         time.sleep(min(900, max(60, deadline - time.time())))
 
     if pending:
