@@ -687,8 +687,12 @@ def main():
                     pending.pop(0)
             _flush(report)
             continue
-        if platform is not None and args.wait == 0:
-            # one-shot mode on a healthy non-TPU backend: definitive
+        pinned = os.environ.get("JAX_PLATFORMS", "")
+        pinned_off_tpu = pinned and "tpu" not in pinned.lower()
+        if platform is not None and (args.wait == 0 or pinned_off_tpu):
+            # definitive: one-shot mode on a healthy non-TPU backend, or
+            # the environment itself pins a non-TPU platform — waiting
+            # could never succeed
             report["tpu_unavailable"] = True
             _flush(report)
             print(json.dumps(report)[:400])
