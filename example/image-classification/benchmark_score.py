@@ -44,13 +44,20 @@ def _score_symbol(model_name, batch, hw, n_iter):
                          softmax_label=(batch,))
     ex.arg_dict["data"][:] = np.random.uniform(
         size=(batch, 3, hw, hw)).astype(np.float32)
-    out = ex.forward(is_train=False)[0]
-    out.wait_to_read()
-    t0 = time.perf_counter()
-    for _ in range(n_iter):
+    # honest timing: difference method + host-fetch sync, with each
+    # forward's input carrying a zero-valued dependency on the previous
+    # output (mxtpu/benchmarking.py explains why wait_to_read is not a
+    # trustworthy barrier through the TPU relay)
+    from mxtpu.benchmarking import timed_loop, chain_input
+    data0 = ex.arg_dict["data"].copy()
+
+    def step(_s):
         out = ex.forward(is_train=False)[0]
-    out.wait_to_read()
-    return batch * n_iter / (time.perf_counter() - t0)
+        ex.arg_dict["data"][:] = chain_input(data0, out)
+        return out
+    sec, _ = timed_loop(step, lo_iters=max(2, n_iter // 4),
+                        min_work_s=0.3, max_iters=max(64, 4 * n_iter))
+    return batch / sec
 
 
 def score(model_name, batch, hw, n_iter=10, dtype="float32"):
@@ -68,15 +75,17 @@ def score(model_name, batch, hw, n_iter=10, dtype="float32"):
         size=(batch, 3, hw, hw)).astype(np.float32))
     if dtype != "float32":
         x = x.astype(dtype)
-    # warmup/compile
-    out = net(x)
-    out.wait_to_read()
-    t0 = time.perf_counter()
-    for _ in range(n_iter):
-        out = net(x)
-    out.wait_to_read()
-    dt = time.perf_counter() - t0
-    return batch * n_iter / dt
+    # honest timing: chained input + difference method + host-fetch
+    # sync (see mxtpu/benchmarking.py; wait_to_read is not a
+    # trustworthy barrier through the TPU relay)
+    from mxtpu.benchmarking import timed_loop, chain_input
+
+    def step(s):
+        out = net(x if s is None else s)
+        return chain_input(x, out)
+    sec, _ = timed_loop(step, lo_iters=max(2, n_iter // 4),
+                        min_work_s=0.3, max_iters=max(64, 4 * n_iter))
+    return batch / sec
 
 
 def main():
