@@ -54,14 +54,19 @@ def hostsync(value):
     import jax
     import jax.numpy as jnp
 
-    leaves = jax.tree_util.tree_leaves(value)
+    leaves = [lf for lf in jax.tree_util.tree_leaves(value)
+              if getattr(lf, "size", 0) > 0]
     if not leaves:
-        return None
+        # no device array to read back means no barrier happened — and a
+        # silent no-op here would quietly turn every timing downstream
+        # back into a dispatch-rate measurement
+        raise TypeError(
+            "hostsync needs a non-empty device array to read back "
+            "(got %r); have the timed step RETURN its output instead "
+            "of mutating in place" % (value,))
     leaf = leaves[0]
     if hasattr(leaf, "asnumpy"):          # mxtpu NDArray
         leaf = leaf._data
-    if hasattr(leaf, "shape") and getattr(leaf, "size", 1) == 0:
-        return np.asarray(leaf)
     return np.asarray(jnp.ravel(leaf)[0])
 
 

@@ -36,17 +36,6 @@ sys.path.insert(0, ROOT)
 REPORT = os.path.join(ROOT, "tpu_checks_report.json")
 
 
-def _timeit(fn, iters=20, warmup=3):
-    """Time a non-chainable thunk. Honest sync (host fetch + difference
-    method, mxtpu.benchmarking) — but repeated byte-identical dispatches
-    can be memoized by the relay, so prefer a chained ``timed_loop``
-    step whenever the op's output can feed its next input."""
-    from mxtpu.benchmarking import timed_loop
-    per, _ = timed_loop(lambda _s: fn(), lo_iters=max(2, iters // 4),
-                        settle=warmup)
-    return per
-
-
 def _flush(report, path=REPORT):
     """Persist partial results — the relay can wedge mid-run and a
     killed process must not lose the variants already measured."""
