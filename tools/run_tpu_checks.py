@@ -51,9 +51,13 @@ def check_roofline(report):
     import jax
     import jax.numpy as jnp
     from mxtpu.benchmarking import timed_loop, hostsync
-    res = {}
+    # resume-friendly: a timeout-killed attempt keeps its finished keys
+    # (merged into the report by the parent), so retries skip them
+    res = report.get("roofline") or {}
     report["roofline"] = res
     for n in (4096, 8192):
+        if "matmul_bf16_%d_tflops" % n in res:
+            continue
         # chained (x @ b) * 1/sqrt(n): every iteration's input depends on
         # the previous output, so no dispatch can be elided or memoized;
         # the rescale keeps the chain numerically bounded
@@ -66,30 +70,34 @@ def check_roofline(report):
         _flush(report)
     # HBM stream: big fp32 elementwise, chained through y (reads 2 buffers
     # + writes 1 per iteration)
-    n = 64 * 1024 * 1024
-    x = jnp.ones((n,), jnp.float32)
-    y0 = jnp.zeros((n,), jnp.float32)
-    g = jax.jit(lambda y: x + y * 1e-9)
-    sec, _ = timed_loop(lambda s: g(y0 if s is None else s))
-    res["hbm_stream_gbs"] = round(3 * 4 * n / sec / 1e9, 1)
+    if "hbm_stream_gbs" not in res:
+        n = 16 * 1024 * 1024
+        x = jnp.ones((n,), jnp.float32)
+        y0 = jnp.zeros((n,), jnp.float32)
+        g = jax.jit(lambda y: x + y * 1e-9)
+        sec, _ = timed_loop(lambda s: g(y0 if s is None else s),
+                            lo_iters=8, max_iters=2048)
+        res["hbm_stream_gbs"] = round(3 * 4 * n / sec / 1e9, 1)
+        _flush(report)
     # dispatch-enqueue latency: issue many tiny chained ops, no sync in
     # the loop; the final hostsync is amortized over the count
-    t0h = jnp.ones((8,), jnp.float32)
-    h = jax.jit(lambda t: t + 1)
-    t = h(t0h)
-    hostsync(t)
-    k = 2000
-    t1 = time.perf_counter()
-    for _ in range(k):
-        t = h(t)
-    enq = (time.perf_counter() - t1) / k     # pure enqueue rate
-    hostsync(t)
-    res["dispatch_enqueue_us"] = round(enq * 1e6, 1)
-    # executed round-trip rate of the same chain, overhead-cancelled
-    sec, _ = timed_loop(lambda s: h(t0h if s is None else s),
-                        lo_iters=64, min_work_s=0.05)
-    res["dispatch_us"] = round(sec * 1e6, 1)
-    _flush(report)
+    if "dispatch_us" not in res:
+        t0h = jnp.ones((8,), jnp.float32)
+        h = jax.jit(lambda t: t + 1)
+        t = h(t0h)
+        hostsync(t)
+        k = 500
+        t1 = time.perf_counter()
+        for _ in range(k):
+            t = h(t)
+        enq = (time.perf_counter() - t1) / k     # pure enqueue rate
+        hostsync(t)
+        res["dispatch_enqueue_us"] = round(enq * 1e6, 1)
+        # executed round-trip rate of the same chain, overhead-cancelled
+        sec, _ = timed_loop(lambda s: h(t0h if s is None else s),
+                            lo_iters=64, min_work_s=0.05, max_iters=2048)
+        res["dispatch_us"] = round(sec * 1e6, 1)
+        _flush(report)
 
 
 def _bench_variants(report, combos):
