@@ -114,7 +114,8 @@ def main():
         "batch": args.batch,
         "method": "benchmark_score.score, fwd-only, synthetic batch, "
                   "steady-state after warmup (reference perf.md "
-                  "methodology)",
+                  "methodology); chained-input difference timing with "
+                  "host-fetch sync (mxtpu/benchmarking.py, round 5)",
         "reference": {
             "c4.8xlarge_b32": C4_8XL_B32, "c4.8xlarge_b1": C4_8XL_B1,
             "c4.8xlarge_vcpus": C4_8XL_VCPUS,
