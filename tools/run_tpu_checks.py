@@ -159,14 +159,11 @@ def _bench_variants(report, combos):
             _flush(report)
 
 
-def check_bench_nhwc(report):
-    # the layout variants first: NHWC is the main single-chip MFU lever
-    _bench_variants(report, ((128, True, False), (256, True, False)))
-
-
 def check_bench(report):
-    # a failed headline child must not abort the batch/layout variants;
-    # retry in later windows unless a real on-TPU number landed
+    """The like-for-like headline first: ResNet-50 train batch 32, the
+    exact configuration of the reference's P100 181.53 img/s row
+    (perf.md:185) — one number that settles vs_baseline even if the
+    relay window closes right after."""
     b32 = report.get("bench_batch32")
     b32_good = (isinstance(b32, dict) and b32.get("value", 0) > 0
                 and not b32.get("error")
@@ -182,6 +179,17 @@ def check_bench(report):
         except Exception as e:
             report["bench_batch32"] = {"error": repr(e)}
         _flush(report)
+
+
+def check_bench_nhwc(report):
+    # the layout lever next: NHWC vs the b32/b128 NCHW anchors is the
+    # main single-chip MFU decision
+    _bench_variants(report, ((128, True, False), (256, True, False)))
+
+
+def check_bench_scale(report):
+    # batch scaling + remat headroom, valuable but after the headline
+    # and the layout decision
     _bench_variants(report, ((128, False, False), (256, False, False),
                              (512, False, False), (512, False, True)))
 
@@ -630,8 +638,9 @@ STAGES = [
     # (name, fn, child timeout seconds) — ordered by information value so
     # a short relay window captures the most important numbers first
     ("roofline", check_roofline, 600),
+    ("bench", check_bench, 1800),
     ("bench_nhwc", check_bench_nhwc, 1500),
-    ("bench", check_bench, 2700),
+    ("bench_scale", check_bench_scale, 2700),
     ("inference", check_inference, 1800),
     ("profile", check_profile, 1200),
     ("io_pipeline", check_io_pipeline, 1800),
