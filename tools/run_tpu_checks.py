@@ -638,7 +638,7 @@ STAGES = [
     # (name, fn, child timeout seconds) — ordered by information value so
     # a short relay window captures the most important numbers first
     ("roofline", check_roofline, 600),
-    ("bench", check_bench, 1800),
+    ("bench", check_bench, 2700),
     ("bench_nhwc", check_bench_nhwc, 1500),
     ("bench_scale", check_bench_scale, 2700),
     ("inference", check_inference, 1800),
