@@ -194,6 +194,29 @@ def check_bench_scale(report):
                              (512, False, False), (512, False, True)))
 
 
+def check_bench_smallbatch(report):
+    """The rest of the reference's P100 training table (perf.md:176-185
+    publishes batch 1-32): small batches are dispatch/latency-bound on
+    any accelerator, so this is the honest worst-case end of the curve.
+    b64 fills the 32-128 gap; a prior-window b128 outlier (808 img/s vs
+    the 2.0-2.3k plateau, relay hiccup) is moved aside and re-measured."""
+    outlier = report.get("bench_batch128")
+    if isinstance(outlier, dict) and \
+            outlier.get("img_per_sec", 0) < 1500 and \
+            "bench_batch128_outlier" not in report:
+        report["bench_batch128_outlier"] = outlier
+        # overwrite rather than delete: the parent merges child output
+        # with dict.update(), which cannot propagate a deletion — an
+        # img_per_sec-free placeholder makes _bench_variants re-measure
+        # and survives a timeout-kill between here and the re-measure
+        report["bench_batch128"] = {"remeasuring": True}
+        _flush(report)
+    _bench_variants(report, ((1, False, False), (2, False, False),
+                             (4, False, False), (8, False, False),
+                             (16, False, False), (64, False, False),
+                             (128, False, False)))
+
+
 def check_profile(report):
     """Trace real training steps on TPU: jax.profiler XPlane dump plus the
     perfetto/chrome trace it contains, committed under docs/traces/ so
@@ -560,58 +583,181 @@ def check_flash_attention(report):
         _flush(report)
 
 
-def check_consistency(report):
-    """Replay the op sweep's forward SPECS on TPU vs CPU (the reference's
-    cpu/gpu check_consistency tier, test_utils.py:1207)."""
+_CONSISTENCY_META = ("__complete__", "__spec_hash__")
+
+
+def _sweep_spec_hash():
+    """Identity of the sweep SPECS a cached leg pickle was computed
+    against — a cached CPU reference must be invalidated when
+    test_op_sweep.py changes, or fresh TPU outputs get compared to
+    stale reference outputs and report false mismatches."""
+    import hashlib
+    with open(os.path.join(ROOT, "tests", "test_op_sweep.py"), "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def _load_leg_pickle(path, spec_hash):
+    """A leg pickle, or None if absent/unreadable/stale."""
+    import pickle
+    try:
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+    except Exception:
+        return None
+    if not isinstance(d, dict) or d.get("__spec_hash__") != spec_hash:
+        return None
+    return d
+
+
+def _consistency_leg(out_path):
+    """Compute forward outputs for every non-stateful sweep SPEC on the
+    CURRENT process's default JAX backend and pickle {op: [arrays]}.
+    Run once under JAX_PLATFORMS=cpu (reference leg) and once in the
+    axon/TPU process — the axon relay registers only its own backend,
+    so the two legs cannot share an interpreter.
+
+    Resumable: results flush periodically, and a tiny sentinel file
+    records the op in flight so a timeout-killed attempt continues where
+    it stopped. An op left in flight by TWO consecutive kills is recorded
+    as an error and skipped — one kill is as likely the stage's
+    cumulative timeout expiring on a healthy (slow) op as a relay wedge,
+    so a single strike must not blacklist it."""
     import importlib.util
-    import jax
+    import pickle
     spec_mod = importlib.util.spec_from_file_location(
         "op_sweep_specs", os.path.join(ROOT, "tests", "test_op_sweep.py"))
     sweep = importlib.util.module_from_spec(spec_mod)
     spec_mod.loader.exec_module(sweep)
-    SPECS, _seed, _canonical_ops = (sweep.SPECS, sweep._seed,
-                                    sweep._canonical_ops)
     import mxtpu as mx
     import mxtpu.ndarray as nd
 
-    cpu_dev = jax.local_devices(backend="cpu")[0]
-    tpu_dev = jax.local_devices(backend="tpu")[0]
-    mismatches, errors, checked = [], [], 0
-    cons = {"ops_checked": 0, "mismatches": mismatches,
-            "errors": errors, "n_errors": 0, "partial": True}
-    report["consistency"] = cons
-    for name in sorted(SPECS):
-        spec = SPECS[name]
-        op = _canonical_ops()[name]
-        if op.stateful:
+    spec_hash = _sweep_spec_hash()
+    outs = _load_leg_pickle(out_path, spec_hash) or {}
+    outs["__spec_hash__"] = spec_hash
+    outs.pop("__complete__", None)
+
+    sent_path = out_path + ".inflight"
+    wedged_prior = {}
+    if os.path.exists(sent_path):
+        try:
+            with open(sent_path) as f:
+                nm, _, cnt = f.read().strip().partition(":")
+            if nm:
+                wedged_prior[nm] = int(cnt or 1)
+        except Exception:
+            pass
+
+    def flush():
+        with open(out_path, "wb") as f:
+            pickle.dump(outs, f)
+
+    canonical = sweep._canonical_ops()
+    unflushed = 0
+    for name in sorted(sweep.SPECS):
+        spec = sweep.SPECS[name]
+        if canonical[name].stateful:
             continue  # different backends draw identical keys, but skip
-        r = np.random.RandomState(_seed(name))
+        if name in outs:
+            continue
+        if wedged_prior.get(name, 0) >= 2:
+            outs[name] = ("error: unfinished in 2 prior attempts "
+                          "(relay wedge or stage timeout)")
+            flush()
+            unflushed = 0
+            os.unlink(sent_path)
+            continue
+        r = np.random.RandomState(sweep._seed(name))
         try:
             args = spec.args(r)
         except Exception:
             continue
-        outs = {}
-        for devname, dev in (("cpu", cpu_dev), ("tpu", tpu_dev)):
-            try:
-                with jax.default_device(dev):
-                    mx.random.seed(0)
-                    o = getattr(nd, name)(
-                        *[nd.array(a) if isinstance(a, np.ndarray) else a
-                          for a in args], **spec.params)
-                    o = o if isinstance(o, (list, tuple)) else [o]
-                    outs[devname] = [np.asarray(x.asnumpy()) for x in o]
-            except Exception as e:
+        with open(sent_path, "w") as f:
+            f.write("%s:%d" % (name, wedged_prior.get(name, 0) + 1))
+        try:
+            mx.random.seed(0)
+            o = getattr(nd, name)(
+                *[nd.array(a) if isinstance(a, np.ndarray) else a
+                  for a in args], **spec.params)
+            o = o if isinstance(o, (list, tuple)) else [o]
+            outs[name] = [np.asarray(x.asnumpy()) for x in o]
+        except Exception as e:
+            outs[name] = "error: " + repr(e)[:200]
+        unflushed += 1
+        if unflushed >= 10:
+            # batch the full-pickle rewrites (O(n^2) bytes if per-op);
+            # a kill loses at most the last <10 results, which the next
+            # attempt recomputes — only the sentinel needs per-op writes
+            flush()
+            unflushed = 0
+    outs["__complete__"] = "yes"
+    flush()
+    if os.path.exists(sent_path):
+        os.unlink(sent_path)
+
+
+def check_consistency(report):
+    """Replay the op sweep's forward SPECS on TPU vs CPU (the reference's
+    cpu/gpu check_consistency tier, test_utils.py:1207). The CPU
+    reference leg runs in a JAX_PLATFORMS=cpu child interpreter; the TPU
+    leg runs here (the axon process's only backend IS the TPU)."""
+    spec_hash = _sweep_spec_hash()
+    ref_path = os.path.join(ROOT, ".consistency_cpu_ref.pkl")
+    cpu_ref = _load_leg_pickle(ref_path, spec_hash)  # cached across runs
+    if cpu_ref is None or "__complete__" not in cpu_ref:
+        if cpu_ref is None and os.path.exists(ref_path):
+            os.unlink(ref_path)  # stale/corrupt cache: start over
+        # the leg resumes from whatever the cache holds and no-ops when
+        # already complete, so running it is always safe
+        proc = None
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--consistency-leg", ref_path],
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                capture_output=True, text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            pass  # partial cache kept; completeness checked below
+        cpu_ref = _load_leg_pickle(ref_path, spec_hash)
+        if cpu_ref is None or "__complete__" not in cpu_ref:
+            raise RuntimeError(
+                "cpu reference leg incomplete: %s"
+                % ((proc.stderr if proc else "") or "timeout")[-300:])
+
+    # surface the prior attempt's partial TPU progress in the report
+    # BEFORE the (wedgeable) TPU leg runs: a killed attempt still ships
+    # this via the parent's partial-merge, and a finished attempt
+    # overwrites the same key (the merge cannot propagate deletions)
+    tpu_path = os.path.join(ROOT, ".consistency_tpu_out.pkl")
+    prior = _load_leg_pickle(tpu_path, spec_hash) or {}
+    report["consistency"] = {
+        "partial": True,
+        "tpu_ops_so_far": len([k for k in prior
+                               if k not in _CONSISTENCY_META])}
+    _flush(report)
+
+    _consistency_leg(tpu_path)
+    tpu_out = _load_leg_pickle(tpu_path, spec_hash)
+
+    mismatches, errors, checked = [], [], 0
+    common = (set(cpu_ref) & set(tpu_out)) - set(_CONSISTENCY_META)
+    for name in sorted(common):
+        outs = {"cpu": cpu_ref[name], "tpu": tpu_out[name]}
+        for devname in ("cpu", "tpu"):
+            if isinstance(outs[devname], str):  # recorded error
                 errors.append({"op": name, "dev": devname,
-                               "error": repr(e)[:200]})
+                               "error": outs[devname]})
                 outs[devname] = None
         if outs.get("cpu") is None or outs.get("tpu") is None:
             continue
         checked += 1
-        if checked % 25 == 0:
-            cons["ops_checked"] = checked
-            cons["n_errors"] = len(errors)
-            _flush(report)
         for i, (a, b) in enumerate(zip(outs["cpu"], outs["tpu"])):
+            if a.shape != b.shape:
+                # np.allclose would raise on non-broadcastable shapes —
+                # and a shape divergence IS the bug this check hunts
+                mismatches.append({"op": name, "out": i,
+                                   "max_abs_diff": "shape %s vs %s"
+                                   % (a.shape, b.shape)})
+                continue
             if a.dtype.kind == "f":
                 # fp32 tier on-chip can use bf16 matmul passes: loose tol
                 if not np.allclose(a.astype(np.float64),
@@ -632,6 +778,7 @@ def check_consistency(report):
         "n_errors": len(errors),
     }
     _flush(report)
+    os.unlink(tpu_path)  # only after a fully-reported compare
 
 
 STAGES = [
@@ -647,6 +794,7 @@ STAGES = [
     ("pallas_rnn", check_pallas_rnn, 1200),
     ("flash_attention", check_flash_attention, 1800),
     ("consistency", check_consistency, 1800),
+    ("bench_smallbatch", check_bench_smallbatch, 2700),
 ]
 
 
@@ -699,7 +847,13 @@ def main():
                          "(the relay wedges for hours at a time)")
     ap.add_argument("--stage", help="internal: run one stage in-process")
     ap.add_argument("--out", help="internal: stage output path")
+    ap.add_argument("--consistency-leg", metavar="OUT_PKL",
+                    help="internal: dump this backend's sweep outputs")
     args = ap.parse_args()
+
+    if args.consistency_leg:
+        _consistency_leg(args.consistency_leg)
+        return 0
 
     if args.stage:
         # child mode: trust the parent's probe, run one stage, flush into
