@@ -21,13 +21,20 @@ it to hand-written Pallas TPU kernels:
 * fully-masked tiles (above the causal diagonal) are skipped outright.
 
 On non-TPU backends the same kernels run through the Pallas interpreter
-(tests), so numerics are identical everywhere. Expected to beat the XLA
-einsum+softmax path on long sequences (which materializes the T^2 score
-matrix, acutely so in the backward) — measured evidence is the
-``flash_attention`` stage of ``tools/run_tpu_checks.py`` (8k causal
-bf16, d∈{64,128}, block-size sweep, fwd and fwd+bwd vs XLA), recorded in
-``tpu_checks_report.json`` whenever the TPU relay grants a window; no
-speedup number is claimed here until that artifact holds one.
+(tests), so numerics are identical everywhere. Measured on a real
+v5e (the ``flash_attention`` stage of ``tools/run_tpu_checks.py``,
+artifact ``tpu_checks_report.json``, 2026-08-01 window; honest
+difference-timed host-fetch sync): 8k causal bf16, B=1 H=8, best block
+sizes (1024, 1024) —
+
+* d=64:  forward 1.46 ms vs 277.9 ms for the einsum+softmax XLA path
+  (which materializes the 8192^2 score matrix); fwd+bwd 5.05 ms.
+* d=128: forward 1.60 ms vs 225.2 ms XLA; fwd+bwd 5.08 ms.
+
+That forward lands at ~47 (d64) / ~86 (d128) TFLOP/s of attention
+FLOPs — the XLA ratio is large because the naive path is HBM-thrashing
+at this length, not because XLA is broken; the kernel's own absolute
+rate is the number that matters.
 
 Pallas itself is imported lazily on first use — `import mxtpu` stays
 cheap; the op registry registration in ops/__init__ binds a thin
