@@ -27,6 +27,7 @@ schedules stay dynamic across steps without retracing.
 from __future__ import annotations
 
 import copy
+import os
 
 import numpy as _np
 
@@ -165,6 +166,51 @@ def _as_jax(x):
     return jnp.asarray(x)
 
 
+class _AutoLayoutStep:
+    """A train-step callable compiled with XLA-chosen (AUTO) layouts for
+    the persistent state.
+
+    First call: AOT-lower/compile, relayout params/optimizer-state/aux
+    once into the executable's chosen input formats, then invoke the
+    Compiled object directly. Steady state: the step's outputs already
+    carry the chosen layouts (out layouts are AUTO-matched to the
+    donated inputs), so every later call is relayout-free — the whole
+    point: conv weights stay in the layout the convolutions want
+    instead of paying a copy per step."""
+
+    def __init__(self, jitted, mesh):
+        self._jit = jitted
+        self._mesh = mesh
+        self._compiled = None
+
+    @staticmethod
+    def _abstract(args):
+        # AUTO-layout lowering demands abstract args (a concrete
+        # jax.Array carries a concrete layout, which contradicts
+        # "compiler's choice"); shardings ride along so the SPMD
+        # partition matches the eventual real calls
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding), args)
+
+    def lower(self, *args):  # compiled_step() parity with plain jit
+        with self._mesh.mesh:
+            return self._jit.lower(*self._abstract(args))
+
+    def __call__(self, train_vals, states, aux_vals, *rest):
+        if self._compiled is None:
+            abst = self._abstract((train_vals, states, aux_vals) + rest)
+            with self._mesh.mesh:
+                self._compiled = self._jit.lower(*abst).compile()
+            fmts = self._compiled.input_formats[0]
+            # one-time relayout of the state the caller built in default
+            # layouts; from here on the step's own outputs feed back in
+            train_vals = jax.device_put(train_vals, fmts[0])
+            states = jax.device_put(states, fmts[1])
+            aux_vals = jax.device_put(aux_vals, fmts[2])
+        return self._compiled(train_vals, states, aux_vals, *rest)
+
+
 class ShardedTrainer:
     """Train a Gluon block SPMD over a device mesh.
 
@@ -197,7 +243,8 @@ class ShardedTrainer:
 
     def __init__(self, block, loss, optimizer, optimizer_params=None,
                  mesh=None, rules=None, donate=True, dtype=None,
-                 remat=None, remat_policy=None, zero1=False):
+                 remat=None, remat_policy=None, zero1=False,
+                 auto_layout=None):
         if dtype not in (None, "float32", "bfloat16"):
             # float16 would need loss scaling (reference mp_sgd pairs fp16
             # weights with fp32 master copies + scale); bf16 shares f32's
@@ -231,6 +278,16 @@ class ShardedTrainer:
         self._remat = bool(remat)
         self._remat_policy = remat_policy
         self._zero1 = bool(zero1)
+        # XLA-chosen persistent-state layouts (experimental): compile the
+        # train step with AUTO input/output layouts for params/optimizer
+        # state/aux so conv weights live in the layout the convolutions
+        # want instead of being relaid out every step — the round-5 TPU
+        # trace attributes ~22% of ResNet-50 step time to layout copies
+        # (docs/perf_analysis.md, round-5 scoreboard). Opt-in while the
+        # win is unmeasured; numerics are layout-invariant either way.
+        if auto_layout is None:
+            auto_layout = os.environ.get("MXTPU_AUTO_LAYOUT", "0") == "1"
+        self._auto_layout = bool(auto_layout)
         self._step_fns = {}
         self._placed = False
         self._key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
@@ -428,9 +485,22 @@ class ShardedTrainer:
                 # (bench.py's steady-state loop; a donated batch buffer
                 # would be invalidated after the first step). lr(7) is a
                 # carried constant, never replaced, so it must stay live.
-                return jax.jit(train_step,
-                               donate_argnums=(0, 1, 2, 5, 6)
-                               if self._donate else ())
+                donate = (0, 1, 2, 5, 6) if self._donate else ()
+                if self._auto_layout:
+                    from jax.experimental.layout import Format, Layout
+                    auto = Format(Layout.AUTO)
+                    # AUTO only on the persistent state (in AND out, so
+                    # the chosen layouts agree with donation aliasing);
+                    # batches/key/t/lr keep caller-visible defaults
+                    jitted = jax.jit(
+                        train_step,
+                        in_shardings=(auto, auto, auto, None, None,
+                                      None, None, None),
+                        out_shardings=(auto, auto, auto, None, None,
+                                       None, None),
+                        donate_argnums=donate)
+                    return _AutoLayoutStep(jitted, mesh)
+                return jax.jit(train_step, donate_argnums=donate)
             return jax.jit(eval_step)
 
     # -- public API --------------------------------------------------------

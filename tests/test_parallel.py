@@ -82,6 +82,33 @@ def test_data_parallel_matches_single_device():
     assert losses["dp8"][-1] < losses["dp8"][0]
 
 
+def test_auto_layout_matches_plain():
+    """auto_layout=True compiles the step with XLA-chosen (AUTO)
+    layouts for the persistent state and carries them across steps via
+    donation — numerics must be bit-identical to the default path (a
+    layout is storage order, not math). Conv net so weight layouts are
+    non-trivial; DP mesh so the sharded lowering path is the one
+    exercised."""
+    np.random.seed(0)
+    x = np.random.uniform(size=(8, 3, 16, 16)).astype(np.float32)
+    y = np.random.randint(0, 10, (8,)).astype(np.float32)
+
+    losses = {}
+    for auto in (False, True):
+        mx.random.seed(3)
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1), nn.Activation("relu"),
+                nn.MaxPool2D(2), nn.Flatten(), nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                            mesh=MeshContext(data=8), auto_layout=auto)
+        losses[auto] = [st.step(x, y) for _ in range(4)]
+    np.testing.assert_allclose(losses[False], losses[True],
+                               rtol=1e-6, atol=1e-7)
+    assert losses[True][-1] < losses[True][0]
+
+
 def test_tensor_parallel_matches_dp():
     """2-way DP x 4-way TP on the dense weights == pure DP numerics."""
     np.random.seed(1)

@@ -113,9 +113,12 @@ def _bench_variants(report, combos):
                        peak_tflops)
     kind = getattr(jax.devices()[0], "device_kind", "")
     peak = peak_tflops(kind) or 0.0
-    for batch, nhwc, remat in combos:
-        key = "bench_batch%d%s%s" % (batch, "_nhwc" if nhwc else "",
-                                     "_remat" if remat else "")
+    for combo in combos:
+        batch, nhwc, remat = combo[:3]
+        auto = combo[3] if len(combo) > 3 else False
+        key = "bench_batch%d%s%s%s" % (batch, "_nhwc" if nhwc else "",
+                                       "_remat" if remat else "",
+                                       "_auto" if auto else "")
         if isinstance(report.get(key), dict) and \
                 "img_per_sec" in report[key]:
             continue  # measured in an earlier window
@@ -134,7 +137,8 @@ def _bench_variants(report, combos):
                                 "sgd", {"learning_rate": 0.05,
                                         "momentum": 0.9, "wd": 1e-4},
                                 mesh=MeshContext(jax.devices()[:1], data=1),
-                                dtype="bfloat16", remat=remat)
+                                dtype="bfloat16", remat=remat,
+                                auto_layout=auto)
             for _ in range(3):
                 st.step(x, y)
             xd = st._shard_batch([x])[0]
@@ -426,6 +430,17 @@ def check_inference(report):
                 finally:
                     os.environ.pop("MXTPU_CONV_LAYOUT", None)
                 _flush(report)
+
+
+def check_bench_autolayout(report):
+    """AUTO persistent-state layouts (ShardedTrainer(auto_layout=True)):
+    the round-5 trace attributes ~22% of step time to layout copies, a
+    chunk of which are conv-weight relayouts between the optimizer's
+    default layout and the convolution's preferred one. AUTO lets XLA
+    keep the state in the preferred layout across steps. Measured at the
+    headline batch and the large-batch anchor."""
+    _bench_variants(report, ((32, False, False, True),
+                             (256, False, False, True)))
 
 
 def check_inference_smallbatch(report):
@@ -827,6 +842,7 @@ STAGES = [
     ("pallas_rnn", check_pallas_rnn, 1200),
     ("flash_attention", check_flash_attention, 1800),
     ("consistency", check_consistency, 1800),
+    ("bench_autolayout", check_bench_autolayout, 1800),
     ("bench_smallbatch", check_bench_smallbatch, 2700),
     ("inference_smallbatch", check_inference_smallbatch, 1800),
 ]
