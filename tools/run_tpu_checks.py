@@ -443,6 +443,138 @@ def check_bench_autolayout(report):
                              (256, False, False, True)))
 
 
+def _transformer_train_step(layers, d_model, heads, seq, vocab, attn):
+    """(init_fn, step_fn, flops_per_step) for a causal pre-LN
+    transformer LM train step — the long-context training workload the
+    reference has no counterpart for (its sequence tooling is bucketed
+    RNNs, SURVEY §5.7). attn='flash' routes through the Pallas kernels
+    (mxtpu/ops/pallas_attention.py), attn='xla' through the naive
+    einsum+softmax path; both bf16 compute, fp32 master weights + SGD."""
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.ops.pallas_attention import flash_attention
+    d_head = d_model // heads
+
+    def init(key):
+        ks = jax.random.split(key, 2 + 7 * layers)
+        s = 0.02
+        p = {"emb": jax.random.normal(ks[0], (vocab, d_model)) * s,
+             "head": jax.random.normal(ks[1], (d_model, vocab)) * s}
+        for i in range(layers):
+            k7 = ks[2 + 7 * i: 9 + 7 * i]
+            p["b%d" % i] = {
+                "wq": jax.random.normal(k7[0], (d_model, d_model)) * s,
+                "wk": jax.random.normal(k7[1], (d_model, d_model)) * s,
+                "wv": jax.random.normal(k7[2], (d_model, d_model)) * s,
+                "wo": jax.random.normal(k7[3], (d_model, d_model)) * s,
+                "w1": jax.random.normal(k7[4], (d_model, 4 * d_model)) * s,
+                "w2": jax.random.normal(k7[5], (4 * d_model, d_model)) * s,
+                "ln": jnp.ones((2, d_model))}
+        return p
+
+    def _ln(x, g):
+        m = x.mean(-1, keepdims=True)
+        v = ((x - m) ** 2).mean(-1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(v + 1e-5) * g
+
+    def _attend(q, k, v):
+        if attn == "flash":
+            return flash_attention(q, k, v, causal=True,
+                                   block_q=1024, block_k=1024)
+        T = q.shape[2]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d_head)
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e9)
+        w = jax.nn.softmax(s.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+    def fwd(params, tokens):
+        B, T = tokens.shape
+        h = params["emb"].astype(jnp.bfloat16)[tokens]
+        for i in range(layers):
+            b = {k: v.astype(jnp.bfloat16) for k, v in
+                 params["b%d" % i].items()}
+            x = _ln(h, b["ln"][0])
+            qkv = [(x @ b[w]).reshape(B, T, heads, d_head)
+                   .transpose(0, 2, 1, 3) for w in ("wq", "wk", "wv")]
+            a = _attend(*qkv).transpose(0, 2, 1, 3).reshape(B, T, d_model)
+            h = h + a @ b["wo"]
+            x = _ln(h, b["ln"][1])
+            h = h + jax.nn.gelu(x @ b["w1"]) @ b["w2"]
+        return h @ params["head"].astype(jnp.bfloat16)
+
+    def loss_fn(params, tokens):
+        logits = fwd(params, tokens[:, :-1]).astype(jnp.float32)
+        tgt = tokens[:, 1:]
+        lse = jax.nn.logsumexp(logits, -1)
+        picked = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+        return (lse - picked).mean()
+
+    def step(params, tokens, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        new = jax.tree_util.tree_map(lambda w, g: w - lr * g,
+                                     params, grads)
+        return new, loss
+
+    # matmul weight-element count: head projection + per-layer
+    # qkv/o (4d^2) and MLP (8d^2); the embedding lookup is a gather,
+    # not FLOPs
+    n_mm = vocab * d_model + layers * 12 * d_model * d_model
+    return init, step, n_mm
+
+
+def check_transformer_train(report):
+    """Long-context transformer LM training on one chip: 8k causal
+    sequence, bf16, flash (Pallas) vs naive XLA attention inside the
+    SAME full train step — tokens/sec and MFU. The modern counterpart
+    of the CNN headline; no reference baseline exists (MXNet 1.1
+    predates transformers), so the comparison is flash-vs-xla and
+    absolute MFU."""
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.benchmarking import timed_loop
+    from bench import peak_tflops
+    layers, d_model, heads, seq, vocab, batch = 4, 512, 8, 8192, 32000, 1
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    peak = peak_tflops(kind) or 0.0
+    res = report.setdefault("transformer_train", {})
+    res["config"] = {"layers": layers, "d_model": d_model, "heads": heads,
+                     "seq": seq, "vocab": vocab, "batch": batch,
+                     "dtype": "bfloat16"}
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, vocab, (batch, seq + 1)))
+    for attn in ("flash", "xla"):
+        if "tokens_per_sec" in res.get(attn, {}):
+            continue
+        try:
+            init, step, n_mm = _transformer_train_step(
+                layers, d_model, heads, seq, vocab, attn)
+            params = init(jax.random.PRNGKey(0))
+            jstep = jax.jit(step, donate_argnums=(0,))
+            params, _ = jstep(params, tokens, 1e-3)  # compile + settle
+
+            carry = {"p": params}
+
+            def one(_s):
+                carry["p"], loss = jstep(carry["p"], tokens, 1e-3)
+                return loss
+            sec, _ = timed_loop(one, lo_iters=2, min_work_s=1.0,
+                                max_iters=64)
+            toks = batch * seq / sec
+            # fwd matmul FLOPs: 2*T*n_mm_params; attention:
+            # 2 * 2*B*H*T^2*d_head, halved for causal; train = 3x fwd
+            attn_fl = 2 * 2 * batch * heads * seq ** 2 * (
+                d_model // heads) * 0.5
+            fl_step = 3 * (2 * batch * seq * n_mm + attn_fl)
+            entry = {"tokens_per_sec": round(toks, 1),
+                     "step_ms": round(sec * 1e3, 2)}
+            if peak:
+                entry["mfu"] = round(fl_step / sec / (peak * 1e12), 4)
+            res[attn] = entry
+        except Exception as e:
+            res[attn] = {"error": repr(e)[:200]}
+        _flush(report)
+
+
 def check_inference_smallbatch(report):
     """The latency-bound rows of the reference's P100 inference tables
     (perf.md:107-144 publishes batch 1-32): batch 1 and 8, fp32 NCHW —
@@ -845,6 +977,7 @@ STAGES = [
     ("bench_autolayout", check_bench_autolayout, 1800),
     ("bench_smallbatch", check_bench_smallbatch, 2700),
     ("inference_smallbatch", check_inference_smallbatch, 1800),
+    ("transformer_train", check_transformer_train, 1800),
 ]
 
 
