@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from .base import canonical_dtype, backward_mirror_enabled, maybe_remat
 from .context import current_context
-from .ops.registry import rng_scope
+from .ops.registry import rng_scope, split2 as _split2
 from .symbol import eval_graph
 from . import ndarray as nd
 from .ndarray import NDArray, _wrap
@@ -211,7 +211,7 @@ class Executor:
             # compressed metadata over, anything else invalidates it for
             # lazy recompute (NDArray._assign_value)
             self.arg_dict[k]._assign_value(v)
-        self._key, sub = jax.random.split(self._key)
+        self._key, sub = _split2(self._key)
         arg_vals = tuple(self.arg_dict[n]._data for n in self._arg_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
         if self._cached_grads is not None and not self._grads_served:

@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register, next_rng_key
+from .registry import register, next_rng_key, split2
 
 
 def _shape(shape):
@@ -59,7 +59,7 @@ def random_poisson(lam=1.0, shape=None, dtype="float32"):
 @register("random_negative_binomial", stateful=True, differentiable=False,
           aliases=("_random_negative_binomial",))
 def random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32"):
-    key1, key2 = jax.random.split(next_rng_key())
+    key1, key2 = split2(next_rng_key())
     g = jax.random.gamma(key1, k, _shape(shape)) * (1 - p) / p
     return jax.random.poisson(key2, g).astype(dtype)
 
@@ -69,7 +69,7 @@ def random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32"):
           aliases=("_random_generalized_negative_binomial",))
 def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
                                          dtype="float32"):
-    key1, key2 = jax.random.split(next_rng_key())
+    key1, key2 = split2(next_rng_key())
     if alpha == 0.0:
         return jax.random.poisson(key1, mu, _shape(shape)).astype(dtype)
     g = jax.random.gamma(key1, 1.0 / alpha, _shape(shape)) * alpha * mu
@@ -138,7 +138,7 @@ def sample_poisson(lam, shape=None, dtype="float32"):
           aliases=("_sample_negative_binomial",))
 def sample_negative_binomial(k, p, shape=None, dtype="float32"):
     s = _shape(shape)
-    key1, key2 = jax.random.split(next_rng_key())
+    key1, key2 = split2(next_rng_key())
     k_b = jnp.broadcast_to(k.reshape(k.shape + (1,) * len(s)), k.shape + s)
     p_b = jnp.broadcast_to(p.reshape(p.shape + (1,) * len(s)), p.shape + s)
     g = jax.random.gamma(key1, k_b.astype(jnp.float32)) * (1 - p_b) / p_b
@@ -151,7 +151,7 @@ def sample_negative_binomial(k, p, shape=None, dtype="float32"):
 def sample_generalized_negative_binomial(mu, alpha, shape=None,
                                          dtype="float32"):
     s = _shape(shape)
-    key1, key2 = jax.random.split(next_rng_key())
+    key1, key2 = split2(next_rng_key())
     mu_b = jnp.broadcast_to(mu.reshape(mu.shape + (1,) * len(s)),
                             mu.shape + s).astype(jnp.float32)
     a_b = jnp.broadcast_to(alpha.reshape(alpha.shape + (1,) * len(s)),

@@ -148,12 +148,27 @@ class rng_scope:
         _RNG.stack.pop()
 
 
+def split2(key):
+    """jax.random.split without the host sync: ``a, b = split2(k)``.
+
+    NEVER tuple-unpack a concrete split result (``a, b =
+    jax.random.split(k)``): iterating a jax.Array goes through
+    Array.__iter__, which materializes chunks on the HOST — a full
+    async-queue drain per call. Through the TPU relay that silent sync
+    serialized every hybridized forward (~2.4 ms+ each). Indexing
+    yields lazy device slices and keeps the dispatch async. (Unpacking
+    a *tracer* inside jit is fine — but using this helper everywhere
+    keeps the eager paths safe by habit.)"""
+    ks = jax.random.split(key)
+    return ks[0], ks[1]
+
+
 def next_rng_key():
     """Return a fresh PRNG key (eager: global state; traced: from scope)."""
     if _RNG.stack:
         holder = _RNG.stack[-1]
-        holder.key, sub = jax.random.split(holder.key)
+        holder.key, sub = split2(holder.key)
         holder.used = True
         return sub
-    _RNG.key, sub = jax.random.split(_RNG.key)
+    _RNG.key, sub = split2(_RNG.key)
     return sub

@@ -40,7 +40,7 @@ from .. import base as _base
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
-from ..ops.registry import rng_scope
+from ..ops.registry import rng_scope, split2 as _rng_split2
 from ..gluon.block import _swap_params, _trace_scope
 from ..gluon.loss import Loss
 from .mesh import MeshContext, ShardingRules, AXIS_DATA
@@ -523,7 +523,7 @@ class ShardedTrainer:
             # is donated every step), the host keeps advancing the other
             # for eval-time draws. np copy so donation can't delete the
             # host key's buffer (device_put may alias when shardings match).
-            self._key, dev_key = jax.random.split(self._key)
+            self._key, dev_key = _rng_split2(self._key)
             self._key_dev = jax.device_put(_np.asarray(dev_key), rep)
             self._t_dev = jax.device_put(
                 _np.asarray(self._num_update, _np.int32), rep)
@@ -599,7 +599,7 @@ class ShardedTrainer:
             self._place([NDArray(_as_jax(d)) for d in data_list])
         inputs = self._shard_batch(data_list)
         label_j = self._shard_batch([label])[0]
-        key, self._key = jax.random.split(self._key)
+        key, self._key = _rng_split2(self._key)
         skey = ("eval", tuple(tuple(i.shape) for i in inputs),
                 tuple(label_j.shape))
         if skey not in self._step_fns:
