@@ -129,3 +129,79 @@ def test_async_error_surfaces_at_waitall():
         y = y + 1.0
         engine.waitall()
         y.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# no hidden host syncs in steady-state dispatch paths
+# ---------------------------------------------------------------------------
+
+class _iter_trap:
+    """Fail the test if anything iterates a concrete jax.Array.
+
+    Array.__iter__ materializes chunks on the host — a silent
+    async-queue drain per call. Through a TPU relay with ~ms round
+    trips it serializes dispatch entirely; tuple-unpacking
+    jax.random.split's result did exactly this in every hybridized
+    forward until round 5 (fix: ops.registry.split2). Steady-state hot
+    paths must never iterate concrete arrays; this trap pins that."""
+
+    def __enter__(self):
+        import jax._src.array as jarray
+        self._mod = jarray
+        self._orig = jarray.ArrayImpl.__iter__
+
+        def trap(_self):
+            raise AssertionError(
+                "jax.Array.__iter__ in a steady-state dispatch path "
+                "(host-sync hazard; use ops.registry.split2-style "
+                "indexing instead of unpacking/iterating)")
+        jarray.ArrayImpl.__iter__ = trap
+        return self
+
+    def __exit__(self, *a):
+        self._mod.ArrayImpl.__iter__ = self._orig
+
+
+def test_hybrid_forward_iterates_no_concrete_arrays():
+    from mxtpu.gluon import nn
+    import mxtpu as mx2
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.Flatten(), nn.Dense(8))
+    net.initialize(mx2.init.Xavier())
+    net.hybridize()
+    x = mx2.nd.array(np.random.rand(2, 3, 8, 8).astype("f"))
+    net(x)  # compile outside the trap
+    with _iter_trap():
+        for _ in range(3):
+            out = net(x)
+    out.wait_to_read()
+
+
+def test_sharded_trainer_step_iterates_no_concrete_arrays():
+    import jax
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    from mxtpu.parallel import MeshContext, ShardedTrainer
+    import mxtpu as mx2
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16), nn.Activation("relu"), nn.Dense(4))
+    net.initialize(mx2.init.Xavier())
+    x = np.random.rand(8, 8).astype("f")
+    y = np.random.randint(0, 4, (8,)).astype("f")
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                        {"learning_rate": 0.1},
+                        mesh=MeshContext(jax.devices()[:1], data=1))
+    st.step(x, y)  # compile + materialize device step state
+    xd = st._shard_batch([x])[0]
+    yd = st._shard_batch([y])[0]
+    with _iter_trap():
+        for _ in range(3):
+            loss = st.step_async(xd, yd)
+    float(loss.asnumpy())
+
+
+def test_iter_trap_catches_the_old_pattern():
+    import jax
+    with _iter_trap():
+        with pytest.raises(AssertionError, match="host-sync hazard"):
+            _a, _b = jax.random.split(jax.random.PRNGKey(0))
