@@ -963,21 +963,24 @@ def check_consistency(report):
 
 STAGES = [
     # (name, fn, child timeout seconds) — ordered by information value so
-    # a short relay window captures the most important numbers first
+    # a short relay window captures the most important numbers first.
+    # Completed stages are skipped via stages_done, so this order only
+    # matters for what remains; the long resumable consistency sweep
+    # goes last so it cannot eat a short window.
     ("roofline", check_roofline, 600),
     ("bench", check_bench, 2700),
+    ("inference", check_inference, 1800),
+    ("bench_autolayout", check_bench_autolayout, 1800),
+    ("transformer_train", check_transformer_train, 1800),
     ("bench_nhwc", check_bench_nhwc, 1500),
     ("bench_scale", check_bench_scale, 2700),
-    ("inference", check_inference, 1800),
     ("profile", check_profile, 1200),
     ("io_pipeline", check_io_pipeline, 1800),
     ("pallas_rnn", check_pallas_rnn, 1200),
     ("flash_attention", check_flash_attention, 1800),
-    ("consistency", check_consistency, 1800),
-    ("bench_autolayout", check_bench_autolayout, 1800),
     ("bench_smallbatch", check_bench_smallbatch, 2700),
     ("inference_smallbatch", check_inference_smallbatch, 1800),
-    ("transformer_train", check_transformer_train, 1800),
+    ("consistency", check_consistency, 1800),
 ]
 
 
