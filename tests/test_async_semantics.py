@@ -205,3 +205,16 @@ def test_iter_trap_catches_the_old_pattern():
     with _iter_trap():
         with pytest.raises(AssertionError, match="host-sync hazard"):
             _a, _b = jax.random.split(jax.random.PRNGKey(0))
+
+
+def test_split2_matches_unpack_values():
+    """split2 replaced 'a, b = jax.random.split(k)' in eager paths for
+    dispatch-async reasons; the VALUES must be identical or every
+    seeded model in the zoo quietly reproduces differently."""
+    import jax
+    from mxtpu.ops.registry import split2
+    k = jax.random.PRNGKey(42)
+    ks = np.asarray(jax.random.split(k))
+    a, b = split2(k)
+    np.testing.assert_array_equal(np.asarray(a), ks[0])
+    np.testing.assert_array_equal(np.asarray(b), ks[1])
