@@ -125,7 +125,8 @@ def best_measured_config():
         try:
             batch = int(parts[0])
         except ValueError:
-            continue  # e.g. bench_batch128_outlier's moved-aside entry
+            continue  # non-numeric suffix keys (the outlier entry is
+            #           filtered by the "outlier" in parts check below)
         nhwc = "nhwc" in parts
         auto = "auto" in parts
         if "remat" in parts or "outlier" in parts:

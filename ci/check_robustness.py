@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Robustness lint for the dist/engine hot paths.
+
+The dist_async fault story (mxtpu/kvstore_async.py, "Fault tolerance")
+only holds if no code path can block forever on a silent socket or
+swallow a failure invisibly. This check fails CI on NEW instances of:
+
+1. **Unbounded socket waits** anywhere under ``mxtpu/``:
+   ``create_connection(`` with no explicit ``timeout=`` in the call
+   (checked over a 3-line window — calls wrap), ``settimeout(None)``,
+   and raw ``.recv(`` / ``.recv_into(`` reads.
+2. **Blind exception swallows** in the kvstore/engine/fault/checkpoint
+   paths: ``except Exception:`` or bare ``except:`` whose body is just
+   ``pass`` — the pattern that turns a dead server into a silent hang.
+
+Deliberate cases are pinned in ALLOW below by (path, stripped line):
+today's server-side frame read idles unbounded BY DESIGN (workers hold
+connections open between steps; worker-side callers settimeout() before
+entering the read loop). Anything not pinned fails, so a regression —
+or a new offender pasted in from old habits — is caught at the sanity
+tier, not in a 3 a.m. hung fleet.
+
+Run: ``python ci/check_robustness.py`` (wired into ``ci/run_ci.sh
+sanity``). To bless a new deliberate case, add its (path, line) pair to
+ALLOW with a comment saying why it cannot take a timeout.
+"""
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PKG = ROOT / "mxtpu"
+
+# (repo-relative path, stripped source line) -> why it is allowed
+ALLOW = {
+    # the shared frame-read loop: server-side it idles unbounded by
+    # design (workers keep connections open between steps); worker-side
+    # every caller runs settimeout() on the socket first (_request_once)
+    ("mxtpu/kvstore_async.py",
+     "r = sock.recv_into(view[got:], n - got)"),
+}
+
+# blind-swallow scan is scoped to the paths where a swallowed error
+# means a hung or silently-corrupt fleet
+SWALLOW_FILES = ("kvstore.py", "kvstore_async.py", "kvstore_server.py",
+                 "engine.py", "fault.py", "checkpoint.py")
+
+_SOCKET_PAT = re.compile(
+    r"create_connection\(|settimeout\(\s*None\s*\)|\.recv\(|\.recv_into\(")
+_EXCEPT_PAT = re.compile(r"^\s*except(\s+Exception)?\s*(:|\s+as\b.*:)\s*$")
+
+
+def _socket_offenders(path, lines):
+    rel = str(path.relative_to(ROOT))
+    for i, line in enumerate(lines):
+        stripped = line.strip()
+        if stripped.startswith("#") or not _SOCKET_PAT.search(line):
+            continue
+        if "create_connection(" in line:
+            # calls wrap: accept timeout= within the next two lines
+            window = "".join(lines[i:i + 3])
+            if "timeout" in window:
+                continue
+        if (rel, stripped) in ALLOW:
+            continue
+        yield (rel, i + 1, stripped,
+               "socket call with no explicit timeout")
+
+
+def _swallow_offenders(path, lines):
+    rel = str(path.relative_to(ROOT))
+    for i, line in enumerate(lines):
+        if not _EXCEPT_PAT.match(line):
+            continue
+        body = lines[i + 1].strip() if i + 1 < len(lines) else ""
+        if body != "pass":
+            continue
+        stripped = line.strip()
+        if (rel, stripped) in ALLOW:
+            continue
+        yield (rel, i + 1, stripped,
+               "blind 'except: pass' in a kvstore/engine path")
+
+
+def main():
+    offenders = []
+    for path in sorted(PKG.rglob("*.py")):
+        lines = path.read_text().splitlines(keepends=True)
+        offenders.extend(_socket_offenders(path, lines))
+        if path.name in SWALLOW_FILES:
+            offenders.extend(_swallow_offenders(path, lines))
+    if offenders:
+        print("robustness check FAILED — %d new offender(s):"
+              % len(offenders))
+        for rel, lineno, text, why in offenders:
+            print("  %s:%d: %s\n      %s" % (rel, lineno, why, text))
+        print("either give the call a timeout / a narrow except, or "
+              "pin it in ci/check_robustness.py ALLOW with a reason.")
+        return 1
+    print("robustness check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

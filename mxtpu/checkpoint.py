@@ -92,11 +92,15 @@ class CheckpointManager:
         return ocp.CheckpointManager(self.directory, options=opts)
 
     # -- public API ---------------------------------------------------------
-    def save(self, step, params, trainer=None, metadata=None):
+    def save(self, step, params, trainer=None, metadata=None,
+             extras=None):
         """Snapshot ``params`` (dict name -> NDArray/Parameter) plus the
         optimizer state of a Gluon ``trainer`` and free-form metadata.
-        Returns immediately when async; call :meth:`wait_until_finished`
-        or rely on the next save/restore to join."""
+        ``extras`` is a dict name -> ndarray of caller-owned blobs saved
+        verbatim (the dist_async ParameterServer stores its pickled
+        optimizer payload this way). Returns immediately when async;
+        call :meth:`wait_until_finished` or rely on the next
+        save/restore to join."""
         tree = {"params": _tree_from(params)}
         if trainer is not None:
             raw = trainer._updaters[0].get_states(dump_optimizer=True)
@@ -104,6 +108,9 @@ class CheckpointManager:
         if metadata is not None:
             tree["metadata"] = {"json": _np.frombuffer(
                 json.dumps(metadata).encode(), dtype=_np.uint8)}
+        if extras is not None:
+            tree["extras"] = {k: _np.asarray(v)
+                              for k, v in extras.items()}
         if self._orbax_mgr is not None:
             import orbax.checkpoint as ocp
             self._orbax_mgr.save(step, args=ocp.args.StandardSave(tree))
@@ -186,7 +193,7 @@ class CheckpointManager:
                 # directly — no device round-trip in the writer thread
                 with open(os.path.join(tmp, "params.npz"), "wb") as f:
                     _np.savez(f, **tree["params"])
-                for extra in ("trainer_states", "metadata"):
+                for extra in ("trainer_states", "metadata", "extras"):
                     if extra in tree:
                         _np.savez(os.path.join(tmp, extra + ".npz"),
                                   **(tree[extra]
@@ -212,12 +219,13 @@ class CheckpointManager:
         base = os.path.join(self.directory, "step_%d" % step)
         with _np.load(os.path.join(base, "params.npz")) as z:
             tree = {"params": {k: z[k] for k in z.files}}
-        for extra in ("trainer_states", "metadata"):
+        for extra in ("trainer_states", "metadata", "extras"):
             path = os.path.join(base, extra + ".npz")
             if os.path.exists(path):
                 with _np.load(path) as z:
                     d = {k: z[k] for k in z.files}
-                tree[extra] = d if extra == "metadata" else d[extra]
+                tree[extra] = d[extra] if extra == "trainer_states" \
+                    else d
         return tree
 
     def _retention(self):

@@ -1,0 +1,265 @@
+"""Deterministic fault injection for the dist_async transport.
+
+The reference's fault story is ps-lite's dead-node bookkeeping plus
+epoch-end checkpoints — faults are *counted*, never *exercised*. This
+module makes them exercisable in-tree: the kvstore_async transport calls
+:func:`fire` at four fixed points (worker.send, worker.recv, server.recv,
+server.send) and an installed :class:`FaultInjector` decides, from
+deterministic per-rule counters (never wall clock, never randomness),
+whether that event is dropped, delayed, truncated, severed, or escalated
+to a server kill. Tests drive the full fault matrix (fault kind x
+recovery path) with loopback threads and no sleeps beyond the injected
+delays themselves.
+
+Spec format (``MXTPU_FAULT_SPEC`` or :func:`install`): rules separated by
+``;``, each rule a comma-separated list of ``key=value`` pairs::
+
+    kind=sever,point=server.send,op=push,nth=1
+    kind=delay,point=worker.send,op=pull,delay=0.05,count=3
+    kind=kill,point=server.recv,op=push,nth=5
+
+Rule keys:
+
+``kind``   ``sever`` (connection dies at this point), ``drop`` (the frame
+           silently vanishes — the peer waits until its timeout), ``delay``
+           (sleep ``delay`` seconds, then proceed), ``truncate`` (a partial
+           garbage frame is written, then the connection dies), ``kill``
+           (server points only: the whole server stops, simulating a
+           crashed shard).
+``point``  ``worker.send`` | ``worker.recv`` | ``server.recv`` |
+           ``server.send`` | ``any``.
+``op``     wire command to match (``push``/``pull``/...); ``*`` (default)
+           matches all.
+``key``    substring of the wire key to match (optional).
+``nth``    1-based index of the matching event at which the rule starts
+           firing (default 1).
+``count``  how many consecutive matching events fire (default 1;
+           ``inf`` = forever).
+``delay``  seconds, for ``kind=delay``.
+
+The injection points bracket the request/reply cycle so each kind lands
+on a distinct recovery path:
+
+* ``worker.send`` faults are seen by the worker *before* the server saw
+  the request — a retry is trivially safe.
+* ``server.send`` faults happen *after* the server applied the request
+  but before the worker got the ack — the retry MUST be deduplicated
+  (the per-origin sequence numbers in kvstore_async make the replay
+  at-most-once).
+* ``server.recv`` + ``kind=kill`` crashes the shard mid-conversation —
+  the checkpoint-backed auto-resume path.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+
+__all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
+           "inject", "fire", "active"]
+
+_POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
+           "any")
+_KINDS = ("sever", "drop", "delay", "truncate", "kill")
+
+
+class FaultSever(ConnectionError):
+    """An injected connection loss (subclasses ConnectionError so every
+    existing retry/reconnect path treats it exactly like the real
+    thing)."""
+
+
+class _Rule:
+    __slots__ = ("kind", "point", "op", "key", "nth", "count", "delay",
+                 "seen", "fired")
+
+    def __init__(self, kind, point="any", op="*", key=None, nth=1,
+                 count=1, delay=0.0):
+        if kind not in _KINDS:
+            raise ValueError("unknown fault kind %r (one of %s)"
+                             % (kind, "/".join(_KINDS)))
+        if point not in _POINTS:
+            raise ValueError("unknown fault point %r (one of %s)"
+                             % (point, "/".join(_POINTS)))
+        if kind == "kill" and point.startswith("worker"):
+            raise ValueError("kind=kill only applies to server points")
+        self.kind = kind
+        self.point = point
+        self.op = op
+        self.key = key
+        self.nth = int(nth)
+        self.count = float("inf") if count in ("inf", float("inf")) \
+            else int(count)
+        self.delay = float(delay)
+        self.seen = 0          # matching events observed
+        self.fired = 0         # faults actually delivered
+
+    def matches(self, point, op, key):
+        if self.point != "any" and self.point != point:
+            return False
+        if self.op != "*" and self.op != op:
+            return False
+        if self.key is not None and (key is None
+                                     or self.key not in str(key)):
+            return False
+        return True
+
+
+def _parse_rule(text):
+    kw = {}
+    for pair in text.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        if "=" not in pair:
+            raise ValueError("fault rule field %r is not key=value" % pair)
+        k, _, v = pair.partition("=")
+        kw[k.strip()] = v.strip()
+    if "kind" not in kw:
+        raise ValueError("fault rule %r has no kind=" % text)
+    return _Rule(**kw)
+
+
+def parse_spec(spec):
+    """Parse a spec string into rules (exposed for tests)."""
+    return [_parse_rule(r) for r in spec.split(";") if r.strip()]
+
+
+class FaultInjector:
+    """Holds the rules and the deterministic counters. Thread-safe: the
+    transport fires from many handler/pool threads at once and every
+    rule's nth/count window must still be exact."""
+
+    def __init__(self, spec_or_rules):
+        if isinstance(spec_or_rules, str):
+            self.rules = parse_spec(spec_or_rules)
+        else:
+            self.rules = list(spec_or_rules)
+        self._lock = threading.Lock()
+
+    def _select(self, point, op, key):
+        """Advance counters; return the rule that fires here, if any."""
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(point, op, key):
+                    continue
+                rule.seen += 1
+                if rule.seen >= rule.nth and rule.fired < rule.count:
+                    rule.fired += 1
+                    return rule
+        return None
+
+    def fire(self, point, op=None, key=None, sock=None, server=None):
+        """Deliver whichever fault is scheduled for this event.
+
+        Returns ``None`` (no fault / proceed) or ``"drop"`` (the caller
+        must skip the send); raises :class:`FaultSever` for connection
+        faults. ``kind=kill`` stops ``server`` on a side thread first so
+        the crash looks like a real shard death (every connection dies,
+        the port closes) rather than one dropped frame.
+        """
+        rule = self._select(point, op, key)
+        if rule is None:
+            return None
+        if rule.kind == "delay":
+            time.sleep(rule.delay)
+            return None
+        if rule.kind == "drop":
+            return "drop"
+        if rule.kind == "truncate":
+            if sock is not None:
+                try:
+                    # a frame head promising far more bytes than follow:
+                    # the peer blocks on the body until our close lands
+                    sock.sendall(struct.pack("<Q", 1 << 20) + b"\x00" * 8)
+                except OSError:
+                    pass
+            raise FaultSever("injected truncate at %s (%s)" % (point, op))
+        if rule.kind == "kill":
+            if server is not None:
+                if hasattr(server, "kill"):
+                    # synchronous refuse-flag + async teardown: no retry
+                    # can slip in while the listener winds down
+                    server.kill()
+                else:
+                    threading.Thread(target=server.stop,
+                                     daemon=True).start()
+            raise FaultSever("injected server kill at %s (%s)"
+                             % (point, op))
+        raise FaultSever("injected sever at %s (%s)" % (point, op))
+
+    def stats(self):
+        """Per-rule (seen, fired) — lets tests assert a schedule ran."""
+        with self._lock:
+            return [(r.kind, r.point, r.op, r.seen, r.fired)
+                    for r in self.rules]
+
+
+_injector = None
+_env_loaded = False
+_guard = threading.Lock()
+
+
+def install(spec):
+    """Install a spec string / rule list / FaultInjector globally (tests
+    and the env hook both land here). Returns the injector."""
+    global _injector, _env_loaded
+    with _guard:
+        _injector = spec if isinstance(spec, FaultInjector) \
+            else FaultInjector(spec)
+        _env_loaded = True
+        return _injector
+
+
+def uninstall():
+    global _injector, _env_loaded
+    with _guard:
+        _injector = None
+        _env_loaded = True     # do not re-read the env after an explicit
+        #                        uninstall — tests own the injector now
+
+
+def active():
+    """The installed injector, lazily bootstrapping from
+    ``MXTPU_FAULT_SPEC`` on first use; None when fault-free."""
+    global _injector, _env_loaded
+    if not _env_loaded:
+        with _guard:
+            if not _env_loaded:
+                spec = os.environ.get("MXTPU_FAULT_SPEC", "").strip()
+                if spec:
+                    _injector = FaultInjector(spec)
+                _env_loaded = True
+    return _injector
+
+
+def fire(point, op=None, key=None, sock=None, server=None):
+    """Module-level hook the transport calls; free when no injector is
+    installed (one global read, no locking)."""
+    inj = active()
+    if inj is None:
+        return None
+    return inj.fire(point, op=op, key=key, sock=sock, server=server)
+
+
+class inject:
+    """``with fault.inject("kind=sever,..."):`` — scoped install for
+    tests; restores the previous injector (usually None) on exit."""
+
+    def __init__(self, spec):
+        self._spec = spec
+        self.injector = None
+
+    def __enter__(self):
+        global _injector
+        with _guard:
+            self._saved = _injector
+        self.injector = install(self._spec)
+        return self.injector
+
+    def __exit__(self, *exc):
+        global _injector
+        with _guard:
+            _injector = self._saved
+        return False

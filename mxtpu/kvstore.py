@@ -273,8 +273,20 @@ class KVStore:
         ps-lite GetDeadNodes): count of unresponsive peers. The SPMD runtime
         fails the whole program on peer loss (XLA collectives are not
         partition-tolerant), so a live store always reports 0 — the hook
-        exists so reference health-check loops run unchanged."""
+        exists so reference health-check loops run unchanged. The
+        dist_async store overrides this with real heartbeat-derived
+        liveness (see :meth:`health`)."""
         return 0
+
+    def health(self):
+        """Store health summary, uniform across store types so fleet
+        monitors need no isinstance checks: per-server states, dead-server
+        count (ps-lite's ``NumDeadNodes``), keys currently served from a
+        stale worker-side cache, and the buffered-push backlog. Local and
+        SPMD stores have no servers to die, so their report is trivially
+        healthy; ``dist_async`` overrides with live heartbeat state."""
+        return {"servers": [], "num_dead": 0, "degraded_keys": [],
+                "pending_pushes": 0}
 
     def barrier(self):
         self._barrier_count += 1

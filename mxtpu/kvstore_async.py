@@ -58,10 +58,54 @@ expose the port beyond hosts you trust with code execution.
 Single-process use (no launcher env) spins up an in-process server
 thread, so ``create('dist_async')`` is runnable — and genuinely
 asynchronous across threads — everywhere.
+
+Fault tolerance
+---------------
+The transport assumes connections die mid-conversation and servers crash
+mid-epoch (ps-lite only *counted* such deaths via ``NumDeadNodes``; here
+each failure has an exercised recovery path — see
+``docs/fault_tolerance.md`` and ``tests/test_fault_tolerance.py``):
+
+* **Retry/backoff RPC.** Every request carries a per-call socket timeout
+  (``MXTPU_PS_TIMEOUT``) and idempotent commands are retried up to
+  ``MXTPU_PS_RETRIES`` times with bounded exponential backoff
+  (``MXTPU_PS_BACKOFF`` .. ``MXTPU_PS_BACKOFF_MAX``) plus a
+  deterministic per-server jitter. A failed socket is closed, never
+  reused (a stale reply must not mispair), and reconnected lazily.
+* **At-most-once pushes.** A push acked after the connection died would
+  double-apply when replayed, so every push carries an
+  ``(origin, seq)`` pair — origin is unique per store instance, seq is
+  monotone — and the server skips (but acks) any seq it has already
+  applied for that origin+key. The seq table rides in the server
+  snapshot, so dedupe survives a server restart.
+* **Liveness.** A background heartbeat thread pings each server every
+  ``MXTPU_PS_HEARTBEAT`` seconds (0 disables); ``MXTPU_PS_DEAD_AFTER``
+  consecutive failures mark it dead. ``kv.health()`` reports per-server
+  state + ``num_dead`` (the ps-lite ``NumDeadNodes`` analogue, also via
+  ``kv.get_num_dead_node()``); recovery is detected by the same probe
+  and re-marks the server ok.
+* **Graceful degradation.** A ``pull`` whose shard is dead returns the
+  worker's last-pulled value for that part instead of raising; the key
+  is staleness-marked in ``kv.degraded_keys()`` / ``health()`` until a
+  live pull succeeds. A ``push`` to a dead shard is buffered (bounded
+  by ``MXTPU_PS_PENDING_MAX``) and replayed in order — with its
+  original seq, so replays stay at-most-once — when the heartbeat sees
+  the server again.
+* **Auto-resume.** With ``MXTPU_PS_SNAPSHOT_DIR`` set (or
+  ``snapshot_dir=``), the server snapshots its table, clocks, dedupe
+  seqs and optimizer through :class:`~mxtpu.checkpoint.CheckpointManager`
+  every ``MXTPU_PS_SNAPSHOT_EVERY`` pushes, and a restarting server
+  restores from the latest snapshot — ``tools/launch.py --ps-respawn``
+  wires the respawn so workers reconverge with no operator action.
+* **Fault injection.** :mod:`mxtpu.fault` (``MXTPU_FAULT_SPEC``) can
+  deterministically drop/delay/truncate/sever frames at either side of
+  the wire and kill servers on schedule; the fault-matrix tests drive
+  every path above through it.
 """
 from __future__ import annotations
 
 import io
+import logging
 import os
 import pickle
 import queue as _queue
@@ -73,8 +117,11 @@ import threading
 import time
 import zlib
 
+import uuid
+
 import numpy as _np
 
+from . import fault as _fault
 from . import ndarray as nd
 from .kvstore import KVStore, _ctype_key_value, _key_int
 
@@ -95,6 +142,8 @@ class _ModuleUnpickler(pickle.Unpickler):
         return super().find_class(module, name)
 
 __all__ = ["ParameterServer", "AsyncDistKVStore", "serve_forever"]
+
+_log = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
 
@@ -219,6 +268,8 @@ def _auth_blob(token):
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server = self.server.owner
+        with server._active_lock:
+            server._active.add(self.request)
         try:
             if server._token:
                 # exact-length raw compare before any unpickling; a
@@ -230,17 +281,38 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
             while True:
                 msg = _recv_frame(self.request)
+                op = msg[0]
+                key = msg[1] if len(msg) > 1 and \
+                    isinstance(msg[1], (str, int)) else None
+                # injection points bracket the dispatch: a server.recv
+                # fault loses the request BEFORE it was applied (replay
+                # is trivially safe), a server.send fault loses the ack
+                # AFTER it was applied (replay must dedupe)
+                _fault.fire("server.recv", op=op, key=key,
+                            sock=self.request, server=server)
                 reply = server._dispatch(msg)
+                _fault.fire("server.send", op=op, key=key,
+                            sock=self.request, server=server)
                 _send_frame(self.request, reply)
-                if msg[0] == "stop":
+                if op == "stop":
                     break
         except (ConnectionError, EOFError, OSError):
             pass
+        finally:
+            with server._active_lock:
+                server._active.discard(self.request)
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+    dying = False    # set synchronously by ParameterServer.stop()/kill():
+    #                  serve_forever's shutdown poll is ~0.5s, and a dead
+    #                  server must refuse new conversations IMMEDIATELY
+    #                  or a fast retry slips in during the window
+
+    def verify_request(self, request, client_address):
+        return not self.dying
 
     def process_request(self, request, client_address):
         request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -249,9 +321,18 @@ class _TCPServer(socketserver.ThreadingTCPServer):
 
 class ParameterServer:
     """Host-side async parameter table (reference KVStoreDistServer with
-    ``sync_mode_ == false``, kvstore_dist_server.h:339,462)."""
+    ``sync_mode_ == false``, kvstore_dist_server.h:339,462).
 
-    def __init__(self, port=0, host="127.0.0.1", token=None):
+    With ``snapshot_dir`` set (or ``MXTPU_PS_SNAPSHOT_DIR``), the table +
+    clocks + push-dedupe seqs + optimizer are snapshotted through
+    :class:`~mxtpu.checkpoint.CheckpointManager` every ``snapshot_every``
+    pushes (``MXTPU_PS_SNAPSHOT_EVERY``, default 100 once a dir is set),
+    and a fresh server restores the latest snapshot at construction — the
+    auto-resume half of the fault story (the reference's epoch-end
+    ``save_checkpoint`` done server-side and continuously)."""
+
+    def __init__(self, port=0, host="127.0.0.1", token=None,
+                 snapshot_dir=None, snapshot_every=None):
         self._tcp = _TCPServer((host, port), _Handler)
         self._tcp.owner = self
         self._token = token if token is not None \
@@ -260,7 +341,9 @@ class ParameterServer:
         self._locks = {}           # key -> Lock (per-key serialization)
         self._locks_guard = threading.Lock()
         self._clock = {}           # key -> applied-update count
+        self._applied = {}         # (origin, key) -> last applied push seq
         self._updater = None
+        self._opt_payload = None   # pickled optimizer, kept for snapshots
         # one server-wide lock around updater invocations: the Updater and
         # Optimizer carry cross-key shared state (states dict,
         # num_update's read-modify-write max), which per-key locks alone
@@ -269,11 +352,36 @@ class ParameterServer:
         self._stale_max = 0
         self._stale_sum = 0
         self._stale_n = 0
+        self._dup_n = 0            # deduped push replays (observability)
         self._barrier_lock = threading.Lock()
         self._barrier_cv = threading.Condition(self._barrier_lock)
         self._barrier_gen = 0
         self._barrier_arrived = 0
         self._thread = None
+        self._active = set()       # live handler sockets, severed on stop
+        self._active_lock = threading.Lock()
+        # -- snapshot-backed auto-resume --
+        if snapshot_dir is None:
+            snapshot_dir = os.environ.get("MXTPU_PS_SNAPSHOT_DIR") or None
+        self._snapshot_dir = snapshot_dir
+        if snapshot_every is None:
+            snapshot_every = int(os.environ.get(
+                "MXTPU_PS_SNAPSHOT_EVERY", "100"))
+        self._snapshot_every = int(snapshot_every)
+        self._snap_lock = threading.Lock()
+        self._push_count = 0
+        self._snap_count = 0
+        self._restored_step = None
+        self._ckpt = None
+        if self._snapshot_dir:
+            from .checkpoint import CheckpointManager
+            # sync fallback writer: the snapshot already runs off the
+            # push path (handler thread, under _snap_lock); orbax's
+            # process-wide async machinery buys nothing for a host table
+            self._ckpt = CheckpointManager(
+                self._snapshot_dir, max_to_keep=2, async_save=False,
+                use_orbax=False)
+            self._restore_snapshot()
 
     # -- lifecycle --------------------------------------------------------
     @property
@@ -288,10 +396,34 @@ class ParameterServer:
         return self
 
     def stop(self):
-        self._tcp.shutdown()
-        self._tcp.server_close()
+        """Stop serving AND sever every in-flight connection — a stopped
+        server must look like a crashed server to its workers (handler
+        threads would otherwise keep serving established sockets after
+        the listener closes, hiding the death the fault tests and the
+        launcher's respawn path both rely on)."""
+        self._tcp.dying = True
+        if self._thread is not None:   # shutdown() waits on an event only
+            self._tcp.shutdown()       # serve_forever sets — skip for a
+        self._tcp.server_close()       # server that never start()ed
+        with self._active_lock:
+            active = list(self._active)
+        for s in active:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
-    # -- request dispatch -------------------------------------------------
+    def kill(self):
+        """Crash the server as the fault injector sees it: new
+        conversations are refused from THIS instant (synchronous flag),
+        the full teardown finishes on a side thread. Deterministic for
+        tests: no retry can slip into the shutdown poll window."""
+        self._tcp.dying = True
+        threading.Thread(target=self.stop, daemon=True).start()
     def _lock_for(self, key):
         with self._locks_guard:
             return self._locks.setdefault(key, threading.Lock())
@@ -306,11 +438,25 @@ class ParameterServer:
                     self._clock[key] = 0
             return ("ok",)
         if cmd == "push":
-            _, key, grad, base_clock = msg
+            # ("push", key, grad, base_clock[, origin, seq]) — the
+            # origin/seq pair makes a retried push at-most-once: a replay
+            # whose seq this server already applied for that origin+key
+            # is acked but NOT re-applied (the ack, not the update, was
+            # what got lost). Legacy 4-tuple pushes skip dedupe.
+            key, grad, base_clock = msg[1], msg[2], msg[3]
+            origin, seq = (msg[4], msg[5]) if len(msg) >= 6 \
+                else (None, None)
             with self._lock_for(key):
                 if key not in self._table:
                     return ("err", "push to uninitialized key %r" % (key,))
-                stale = self._clock[key] - base_clock
+                if origin is not None:
+                    if self._applied.get((origin, key), 0) >= seq:
+                        self._dup_n += 1
+                        return ("ok", "dup")
+                    self._applied[(origin, key)] = seq
+                # a restored snapshot may trail the clock a worker based
+                # its step on: clamp, staleness is never negative
+                stale = max(0, self._clock[key] - base_clock)
                 self._stale_max = max(self._stale_max, stale)
                 self._stale_sum += stale
                 self._stale_n += 1
@@ -323,6 +469,10 @@ class ParameterServer:
                 else:
                     store._data = store._data + g._data
                 self._clock[key] += 1
+            self._push_count += 1
+            if self._ckpt is not None and self._snapshot_every > 0 \
+                    and self._push_count % self._snapshot_every == 0:
+                self.snapshot()
             return ("ok",)
         if cmd == "pull":
             _, key = msg
@@ -341,12 +491,13 @@ class ParameterServer:
                 return ("ok", rows, self._clock[key])
         if cmd == "set_optimizer":
             _, payload = msg
-            opt = sys.modules.get("mxtpu.optimizer")
-            if opt is None:
-                from . import optimizer as opt
-            optimizer = _ModuleUnpickler(io.BytesIO(payload)).load()
-            self._updater = opt.get_updater(optimizer)
+            self._install_optimizer(bytes(payload))
             return ("ok",)
+        if cmd == "ping":
+            # liveness probe: cheapest possible round trip (no locks, no
+            # table access) so a loaded server still answers heartbeats
+            return ("ok", {"pushes": self._stale_n,
+                           "keys": len(self._table)})
         if cmd == "barrier":
             _, num_workers = msg
             with self._barrier_cv:
@@ -365,11 +516,91 @@ class ParameterServer:
             return ("ok", {"staleness_max": self._stale_max,
                            "staleness_avg": avg,
                            "pushes": self._stale_n,
+                           "dup_pushes": self._dup_n,
+                           "snapshots": self._snap_count,
+                           "restored_step": self._restored_step,
                            "clocks": dict(self._clock)})
         if cmd == "stop":
             threading.Thread(target=self.stop, daemon=True).start()
             return ("ok",)
         return ("err", "unknown command %r" % (cmd,))
+
+    def _install_optimizer(self, payload):
+        opt = sys.modules.get("mxtpu.optimizer")
+        if opt is None:
+            from . import optimizer as opt
+        optimizer = _ModuleUnpickler(io.BytesIO(payload)).load()
+        self._updater = opt.get_updater(optimizer)
+        self._opt_payload = payload
+
+    # -- snapshot / auto-resume -------------------------------------------
+    @staticmethod
+    def _tag_key(k):
+        # npz/json-safe reversible tagging: table keys are ints or strs
+        return ["i", int(k)] if isinstance(k, int) else ["s", str(k)]
+
+    @staticmethod
+    def _untag_key(tagged):
+        t, v = tagged
+        return int(v) if t == "i" else str(v)
+
+    def snapshot(self):
+        """Write one consistent-enough snapshot of the service state.
+
+        Per-key consistency is exact (value and clock copied under the
+        key's lock); cross-key skew of a few pushes is inherent to async
+        mode and harmless — a restored table is just a slightly stale
+        table, which workers already tolerate. Non-blocking for pushes
+        to OTHER snapshots: if a snapshot is already being written this
+        one is skipped (the next push-interval boundary fires again)."""
+        if self._ckpt is None:
+            return False
+        if not self._snap_lock.acquire(blocking=False):
+            return False
+        try:
+            params, keys, clocks = {}, [], []
+            for key in list(self._table):
+                with self._lock_for(key):
+                    params["t%d" % len(keys)] = \
+                        self._table[key].asnumpy().copy()
+                    keys.append(self._tag_key(key))
+                    clocks.append(int(self._clock[key]))
+            meta = {"keys": keys, "clocks": clocks,
+                    "applied": [[o, self._tag_key(k), int(s)]
+                                for (o, k), s in self._applied.items()],
+                    "push_count": int(self._push_count)}
+            extras = None
+            if self._opt_payload is not None:
+                extras = {"optimizer": _np.frombuffer(
+                    self._opt_payload, dtype=_np.uint8)}
+            self._snap_count += 1
+            self._ckpt.save(self._snap_count, params, metadata=meta,
+                            extras=extras)
+            return True
+        finally:
+            self._snap_lock.release()
+
+    def _restore_snapshot(self):
+        step = self._ckpt.latest_step()
+        if step is None:
+            return
+        tree = self._ckpt.restore(step)
+        meta = tree["metadata"]
+        for i, (tagged, clock) in enumerate(zip(meta["keys"],
+                                                meta["clocks"])):
+            key = self._untag_key(tagged)
+            self._table[key] = nd.array(tree["params"]["t%d" % i])
+            self._clock[key] = int(clock)
+        self._applied = {(o, self._untag_key(k)): int(s)
+                         for o, k, s in meta.get("applied", [])}
+        self._push_count = int(meta.get("push_count", 0))
+        self._snap_count = step
+        self._restored_step = step
+        extras = tree.get("extras") or {}
+        if "optimizer" in extras:
+            self._install_optimizer(
+                bytes(_np.asarray(extras["optimizer"],
+                                  dtype=_np.uint8)))
 
 
 def serve_forever():
@@ -389,8 +620,11 @@ def serve_forever():
     port = int(os.environ.get("MXTPU_PS_PORT", "0"))
     srv = ParameterServer(port=port)
     srv.start()
-    print("mxtpu parameter server listening on %s" % srv.address,
-          flush=True)
+    resumed = "" if srv._restored_step is None else \
+        " (resumed from snapshot %d: %d keys)" % (srv._restored_step,
+                                                  len(srv._table))
+    print("mxtpu parameter server listening on %s%s"
+          % (srv.address, resumed), flush=True)
     srv._thread.join()
 
 
@@ -403,16 +637,48 @@ def serve_forever():
 _CONNS_PER_SERVER = int(os.environ.get("MXTPU_PS_CONNS", "1"))
 
 
+# retry/backoff knobs for the RPC layer (see module docstring, "Fault
+# tolerance"): per-call socket timeout, number of retries after the
+# first attempt, and the exponential backoff window between attempts
+_REQUEST_TIMEOUT = float(os.environ.get("MXTPU_PS_TIMEOUT", "300"))
+_RETRIES = int(os.environ.get("MXTPU_PS_RETRIES", "3"))
+_BACKOFF = float(os.environ.get("MXTPU_PS_BACKOFF", "0.05"))
+_BACKOFF_MAX = float(os.environ.get("MXTPU_PS_BACKOFF_MAX", "2.0"))
+_RECONNECT_TIMEOUT = float(os.environ.get("MXTPU_PS_RECONNECT", "5"))
+_DEAD_AFTER = int(os.environ.get("MXTPU_PS_DEAD_AFTER", "3"))
+
+# every command whose replay is harmless: pull/pull_rows/stats/ping read,
+# init is first-writer-wins, set_optimizer re-installs the same payload,
+# and push dedupes via its (origin, seq) pair. barrier is NOT here — a
+# replayed arrival would double-count this worker in the generation.
+_IDEMPOTENT = frozenset(
+    ("init", "push", "pull", "pull_rows", "stats", "ping",
+     "set_optimizer"))
+
+
 class _ServerConn:
     """One worker's channel to one server: a small pool of sockets, each
     serving one in-flight request/reply at a time. Thread-safe via a
-    free-index queue — callers block until any socket is idle."""
+    free-index queue — callers block until any socket is idle.
+
+    Carries the retry/backoff RPC layer and this worker's health view of
+    the server: consecutive request/heartbeat failures past
+    ``MXTPU_PS_DEAD_AFTER`` mark it ``dead``; any success marks it
+    ``ok`` again."""
 
     def __init__(self, addr, connect_timeout=60.0, token=None,
-                 n_socks=None):
+                 n_socks=None, request_timeout=None, retries=None):
+        self.addr = addr
         self._host, _, port = addr.partition(":")
         self._port = int(port)
         self._token = token
+        self._timeout = _REQUEST_TIMEOUT if request_timeout is None \
+            else float(request_timeout)
+        self._retries = _RETRIES if retries is None else int(retries)
+        self.state = "ok"
+        self.failures = 0          # consecutive failures
+        self.last_error = None
+        self._health_lock = threading.Lock()
         n_socks = max(1, n_socks if n_socks is not None
                       else _CONNS_PER_SERVER)
         # the launcher starts servers and workers simultaneously and a
@@ -430,7 +696,7 @@ class _ServerConn:
         while True:
             try:
                 s = socket.create_connection((self._host, self._port),
-                                             timeout=300)
+                                             timeout=self._timeout)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 break
             except OSError:
@@ -446,42 +712,130 @@ class _ServerConn:
     def n_socks(self):
         return len(self._socks)
 
-    def request(self, *msg):
+    # -- health bookkeeping ----------------------------------------------
+    def _note_ok(self):
+        with self._health_lock:
+            recovered = self.state == "dead"
+            self.state = "ok"
+            self.failures = 0
+            self.last_error = None
+        return recovered
+
+    def _note_failure(self, err):
+        with self._health_lock:
+            self.failures += 1
+            self.last_error = "%s: %s" % (type(err).__name__, err)
+            if self.failures >= _DEAD_AFTER:
+                self.state = "dead"
+
+    def mark_dead(self, err):
+        with self._health_lock:
+            self.failures = max(self.failures, _DEAD_AFTER)
+            self.state = "dead"
+            self.last_error = "%s: %s" % (type(err).__name__, err)
+
+    def health(self):
+        with self._health_lock:
+            return {"addr": self.addr, "state": self.state,
+                    "failures": self.failures,
+                    "last_error": self.last_error}
+
+    # -- the RPC layer ---------------------------------------------------
+    def _backoff_delay(self, attempt):
+        # bounded exponential backoff with DETERMINISTIC per-server
+        # jitter: crc32(addr:attempt) spreads a fleet's retries without
+        # randomness (the fault tests replay exact schedules)
+        base = min(_BACKOFF * (2 ** attempt), _BACKOFF_MAX)
+        j = zlib.crc32(("%s:%d" % (self.addr, attempt)).encode()) % 256
+        return base * (1.0 + j / 1024.0)
+
+    def _request_once(self, msg, timeout):
         i = self._free.get()
         try:
-            _send_frame(self._socks[i], msg)
-            reply = _recv_frame(self._socks[i])
-        except Exception as e:
+            if self._socks[i] is None:
+                # previous failure closed this slot: reconnect lazily,
+                # bounded so a dead server fails fast instead of hanging
+                self._socks[i] = self._connect(
+                    time.time() + _RECONNECT_TIMEOUT)
+            sock = self._socks[i]
+            sock.settimeout(timeout)
+            act = _fault.fire("worker.send", op=msg[0],
+                              key=msg[1] if len(msg) > 1 else None,
+                              sock=sock)
+            if act != "drop":      # a dropped frame: peer never sees it,
+                _send_frame(sock, msg)  # we still wait for the timeout
+            _fault.fire("worker.recv", op=msg[0],
+                        key=msg[1] if len(msg) > 1 else None, sock=sock)
+            reply = _recv_frame(sock)
+        except BaseException:
             # ANY mid-conversation failure (timeout included) may leave
             # a stale reply in flight — never reuse that socket: close
-            # it, try one quick reconnect, and surface the error. A
-            # failed reconnect leaves a closed socket whose next use
-            # errors loudly instead of mispairing replies.
-            try:
-                self._socks[i].close()
-            except OSError:
-                pass
-            try:
-                # single attempt: stale-reply protection is the close
-                # above; retry loops here would stall error propagation
-                self._socks[i] = self._connect(time.time())
-            except OSError:
-                pass
+            # it and leave the slot empty for a lazy reconnect.
+            s, self._socks[i] = self._socks[i], None
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
             self._free.put(i)
-            if isinstance(e, (ConnectionError, EOFError)):
-                raise ConnectionError(
-                    "parameter server connection lost during %r: %s (a "
-                    "close right after connect usually means "
-                    "MXTPU_PS_TOKEN does not match between this worker "
-                    "and the server)" % (msg[0], e)) from e
             raise
         self._free.put(i)
-        if reply[0] == "err":
-            raise RuntimeError("parameter server: %s" % reply[1])
         return reply
+
+    def request(self, *msg, **kw):
+        """Send one command and return its reply, retrying idempotent
+        commands through connection faults with bounded exponential
+        backoff. ``timeout=`` overrides the per-call socket timeout
+        (heartbeats probe with a short one)."""
+        timeout = kw.pop("timeout", None)
+        retries = kw.pop("retries", None)
+        assert not kw, kw
+        timeout = self._timeout if timeout is None else timeout
+        if retries is None:
+            retries = self._retries if msg[0] in _IDEMPOTENT else 0
+        last = None
+        for attempt in range(retries + 1):
+            try:
+                reply = self._request_once(msg, timeout)
+            except (ConnectionError, EOFError, OSError) as e:
+                last = e
+                self._note_failure(e)
+                if attempt < retries:
+                    time.sleep(self._backoff_delay(attempt))
+                continue
+            self._note_ok()
+            if reply[0] == "err":
+                raise RuntimeError("parameter server: %s" % reply[1])
+            return reply
+        # _note_failure counted every attempt, so an exhausted retry
+        # budget >= MXTPU_PS_DEAD_AFTER already flipped state to dead;
+        # a single failed probe (retries=0) only increments the count
+        raise ConnectionError(
+            "parameter server %s unreachable during %r after %d "
+            "attempt(s): %s (a close right after connect usually means "
+            "MXTPU_PS_TOKEN does not match between this worker and the "
+            "server)" % (self.addr, msg[0], retries + 1, last)) from last
+
+    def ping(self, timeout=2.0):
+        """One heartbeat probe: no retries, short timeout. When every
+        socket is busy serving real traffic the server is considered
+        alive by definition (it is answering us right now), so the probe
+        never steals a pool slot from a real transfer."""
+        try:
+            i = self._free.get_nowait()
+        except _queue.Empty:
+            return True
+        self._free.put(i)
+        try:
+            self.request("ping", timeout=timeout, retries=0)
+            return True
+        except (ConnectionError, OSError):
+            return False
 
     def close(self):
         for s in self._socks:
+            if s is None:
+                continue
             try:
                 s.close()
             except OSError:
@@ -512,6 +866,21 @@ class AsyncDistKVStore(KVStore):
         self._base_clock = {}      # subkey -> clock of the last pull
         self._parts = {}           # key -> [(subkey, row_lo, row_hi), ...]
         self._shapes = {}          # key -> full array shape
+        # -- fault-tolerance state (module docstring, "Fault tolerance") --
+        # unique push origin: rank alone is not unique (tests run many
+        # stores per process); the server dedupes replays per (origin,key)
+        self._origin = "%d-%s" % (self._rank, uuid.uuid4().hex[:8])
+        import itertools
+        self._seq = itertools.count(1)   # next() is GIL-atomic
+        self._pull_cache_on = os.environ.get(
+            "MXTPU_PS_PULL_CACHE", "1") != "0"
+        self._pull_cache = {}      # subkey -> (numpy value, clock)
+        self._degraded = set()     # subkeys served from cache right now
+        self._degraded_lock = threading.Lock()
+        self._pending_max = int(os.environ.get(
+            "MXTPU_PS_PENDING_MAX", "256"))
+        self._pending = {}         # conn -> [(subkey, payload, clock, seq)]
+        self._pending_lock = threading.Lock()
         from concurrent.futures import ThreadPoolExecutor
         # parts of one array move concurrently: enough workers to keep
         # every socket of every server pool in flight
@@ -519,6 +888,17 @@ class AsyncDistKVStore(KVStore):
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * total_socks),
             thread_name_prefix="mxtpu-ps")
+        # liveness: background heartbeat marks servers dead/recovered and
+        # flushes buffered pushes on recovery; 0 disables the thread
+        # (tests drive _check_health() directly for determinism)
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        interval = float(os.environ.get("MXTPU_PS_HEARTBEAT", "5"))
+        if interval > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(interval,),
+                daemon=True, name="mxtpu-ps-heartbeat")
+            self._hb_thread.start()
 
     # -- identity ---------------------------------------------------------
     @property
@@ -599,11 +979,38 @@ class AsyncDistKVStore(KVStore):
             arr = merged.asnumpy()
             self._pmap([
                 (lambda sk=sk, lo=lo, hi=hi:
-                 self._conn(sk).request(
-                     "push", sk,
-                     self._wire_payload(sk, _slice_part(arr, lo, hi)),
+                 self._push_part(
+                     sk, self._wire_payload(sk, _slice_part(arr, lo, hi)),
                      self._base_clock.get(sk, 0)))
                 for sk, lo, hi in self._plan(k, merged.shape)])
+
+    def _push_part(self, sk, payload, base_clock):
+        """One part's push: seq-stamped for at-most-once replay; a push
+        whose shard is dead (or dies despite retries) is buffered —
+        original seq and all — and replayed by the heartbeat when the
+        server returns. Ordering across a buffer flush is relaxed, which
+        async mode already tolerates (a buffered push is just a very
+        stale push); at-most-once is NOT relaxed."""
+        conn = self._conn(sk)
+        seq = next(self._seq)
+        if conn.state == "dead":
+            self._buffer_push(conn, sk, payload, base_clock, seq)
+            return
+        try:
+            conn.request("push", sk, payload, base_clock,
+                         self._origin, seq)
+        except ConnectionError:
+            self._buffer_push(conn, sk, payload, base_clock, seq)
+
+    def _buffer_push(self, conn, sk, payload, base_clock, seq):
+        with self._pending_lock:
+            pend = self._pending.setdefault(conn, [])
+            if len(pend) >= self._pending_max:
+                raise ConnectionError(
+                    "parameter server %s dead and its pending-push "
+                    "buffer is full (%d; MXTPU_PS_PENDING_MAX)"
+                    % (conn.addr, self._pending_max))
+            pend.append((sk, payload, base_clock, seq))
 
     def _wire_payload(self, subkey, part):
         """Dense part, or its 2-bit packed form when compression is on
@@ -616,6 +1023,38 @@ class AsyncDistKVStore(KVStore):
         return (_GC_MARK, self._compression.threshold,
                 _np.asarray(packed), part.shape)
 
+    def _pull_part(self, sk):
+        """One part's pull, with graceful degradation: when the shard is
+        unreachable despite retries, the last value this worker pulled
+        is served instead of raising — the key stays staleness-marked in
+        ``degraded_keys()``/``health()`` until a live pull lands, while
+        the heartbeat keeps probing the server in the background."""
+        conn = self._conn(sk)
+        try:
+            reply = conn.request("pull", sk)
+        except (ConnectionError, RuntimeError) as e:
+            # ConnectionError: shard unreachable despite retries.
+            # RuntimeError("uninitialized"): shard is back but restarted
+            # WITHOUT its state (no snapshot) — same degradation: the
+            # worker knew this key, so serve its last-known value.
+            # Any other server error is a real bug and surfaces.
+            if isinstance(e, RuntimeError) \
+                    and "uninitialized" not in str(e):
+                raise
+            cached = self._pull_cache.get(sk) \
+                if self._pull_cache_on else None
+            if cached is None:
+                raise
+            with self._degraded_lock:
+                self._degraded.add(sk)
+            return (sk, cached[0], cached[1])
+        value, clock = reply[1], reply[2]
+        if self._pull_cache_on:
+            self._pull_cache[sk] = (value, clock)
+        with self._degraded_lock:
+            self._degraded.discard(sk)
+        return (sk, value, clock)
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
@@ -623,10 +1062,10 @@ class AsyncDistKVStore(KVStore):
             tgt0 = o[0] if isinstance(o, (list, tuple)) else o
             plan = self._plan(k, tgt0.shape)
             replies = self._pmap([
-                (lambda sk=sk: (sk, self._conn(sk).request("pull", sk)))
+                (lambda sk=sk: self._pull_part(sk))
                 for sk, _, _ in plan])
             pieces = []
-            for sk, (_, value, clock) in replies:
+            for sk, value, clock in replies:
                 self._base_clock[sk] = clock
                 pieces.append(value)
             full = pieces[0] if len(pieces) == 1 \
@@ -730,6 +1169,76 @@ class AsyncDistKVStore(KVStore):
         super().barrier()
         self._conns[0].request("barrier", self._size)
 
+    # -- liveness / health ------------------------------------------------
+    def _heartbeat_loop(self, interval):
+        while not self._hb_stop.wait(interval):
+            try:
+                self._check_health()
+            except Exception as e:   # a probe bug must not kill training
+                _log.debug("heartbeat sweep failed: %s", e)
+
+    def _check_health(self, timeout=2.0):
+        """One synchronous liveness sweep (the heartbeat thread's body;
+        tests call it directly so no wall-clock enters the fault
+        matrix): probe every server, and flush buffered pushes to any
+        server that answers."""
+        for conn in self._conns:
+            if conn.ping(timeout=timeout):
+                with self._pending_lock:
+                    has_pending = bool(self._pending.get(conn))
+                if has_pending:
+                    self._flush_pending(conn)
+            # a failed probe already advanced the conn's failure count
+            # (past MXTPU_PS_DEAD_AFTER it flips to dead on its own)
+
+    def _flush_pending(self, conn):
+        """Replay buffered pushes in order with their ORIGINAL seqs —
+        the server's dedupe table makes a flush racing a retry, or a
+        flush interrupted and re-run, still at-most-once."""
+        with self._pending_lock:
+            items = self._pending.pop(conn, [])
+        for n, (sk, payload, clock, seq) in enumerate(items):
+            try:
+                conn.request("push", sk, payload, clock,
+                             self._origin, seq)
+            except ConnectionError:
+                with self._pending_lock:   # died again: keep the rest
+                    self._pending[conn] = items[n:] \
+                        + self._pending.get(conn, [])
+                return
+            except RuntimeError as e:
+                # err reply (e.g. the server restarted WITHOUT its
+                # snapshot and the key is gone): this push can never
+                # land — drop it loudly rather than retry forever
+                _log.warning("dropping undeliverable buffered push "
+                             "for %r: %s", sk, e)
+
+    def health(self):
+        """Worker-side fleet health: per-server state (the ps-lite
+        ``NumDeadNodes`` analogue, but with the *which* and *why*),
+        currently-degraded keys, and the pending-push backlog."""
+        servers = [c.health() for c in self._conns]
+        with self._pending_lock:
+            npend = sum(len(v) for v in self._pending.values())
+        with self._degraded_lock:
+            deg = sorted({str(sk).split("\x00")[0]
+                          for sk in self._degraded})
+        return {"servers": servers,
+                "num_dead": sum(1 for s in servers
+                                if s["state"] == "dead"),
+                "degraded_keys": deg,
+                "pending_pushes": npend}
+
+    def degraded_keys(self):
+        """Top-level keys whose last pull was served from the worker's
+        cache because their shard was unreachable (staleness mark)."""
+        return self.health()["degraded_keys"]
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Reference KVStore::get_num_dead_node via the heartbeat health
+        state: how many of this worker's servers are currently dead."""
+        return self.health()["num_dead"]
+
     def staleness_stats(self):
         """Aggregated staleness evidence from every server: max/avg
         staleness and per-key clocks. max > 0 is the observable proof
@@ -749,6 +1258,10 @@ class AsyncDistKVStore(KVStore):
         return agg
 
     def close(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
         self._pool.shutdown(wait=True)
         for c in self._conns:
             c.close()

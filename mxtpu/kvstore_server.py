@@ -15,7 +15,10 @@ mxtpu keeps both halves of that contract:
   a process launched with ``DMLC_ROLE=server`` and ``MXTPU_PS_PORT`` set
   blocks here serving the async table — exactly the reference's server
   lifecycle — and exits when a worker sends 'stop' or the launcher
-  terminates it.
+  terminates it. With ``MXTPU_PS_SNAPSHOT_DIR`` set the service
+  snapshots its state through CheckpointManager and a restarted
+  process (``tools/launch.py --ps-respawn`` rebinds the same port)
+  resumes from the latest snapshot — see ``docs/fault_tolerance.md``.
 
 A server-role process with no ``MXTPU_PS_PORT`` (a sync-mode launch that
 passed ``-s N`` out of reference habit) logs that the role is subsumed

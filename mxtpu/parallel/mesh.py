@@ -28,9 +28,26 @@ import numpy as _np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+try:                                 # jax >= 0.4.35 top-level export
+    from jax import shard_map as _shard_map_impl
+except ImportError:                  # older jax: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map_impl).parameters:
+    shard_map = _shard_map_impl
+else:
+    def shard_map(*args, **kwargs):
+        """Compat wrapper: newer jax renamed check_rep -> check_vma;
+        callers use the new spelling, old jax gets the translation."""
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_impl(*args, **kwargs)
+
 __all__ = ["AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
            "make_mesh", "MeshContext", "ShardingRules", "PartitionSpec",
-           "NamedSharding", "Mesh", "current_mesh"]
+           "NamedSharding", "Mesh", "current_mesh", "shard_map"]
 
 AXIS_DATA = "data"
 AXIS_MODEL = "model"

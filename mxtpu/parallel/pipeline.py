@@ -23,10 +23,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from .mesh import MeshContext, AXIS_PIPE, AXIS_DATA
+from .mesh import MeshContext, AXIS_PIPE, AXIS_DATA, shard_map
 
 __all__ = ["pipeline_spmd", "pipeline_apply"]
 

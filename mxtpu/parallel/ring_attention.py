@@ -25,10 +25,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from .mesh import MeshContext, AXIS_SEQ, AXIS_DATA
+from .mesh import MeshContext, AXIS_SEQ, AXIS_DATA, shard_map
 
 __all__ = ["ring_attention", "ring_attention_sharded", "ulysses_attention",
            "local_attention"]

@@ -202,12 +202,20 @@ class _AutoLayoutStep:
             abst = self._abstract((train_vals, states, aux_vals) + rest)
             with self._mesh.mesh:
                 self._compiled = self._jit.lower(*abst).compile()
-            fmts = self._compiled.input_formats[0]
-            # one-time relayout of the state the caller built in default
-            # layouts; from here on the step's own outputs feed back in
-            train_vals = jax.device_put(train_vals, fmts[0])
-            states = jax.device_put(states, fmts[1])
-            aux_vals = jax.device_put(aux_vals, fmts[2])
+        # relayout the persistent state into the executable's chosen
+        # input formats on EVERY call — device_put is a no-copy no-op
+        # once the layouts already match (the donated steady state), but
+        # it must run unconditionally: a second batch shape compiles a
+        # NEW executable whose chosen layouts may differ from what the
+        # first one's outputs carry, and with donate=False the step's
+        # outputs never adopt the input formats at all — both used to
+        # raise layout-mismatch on the second call.
+        fmts = (self._compiled.input_formats    # jax >= 0.5
+                if hasattr(self._compiled, "input_formats")
+                else self._compiled.input_layouts)[0]
+        train_vals = jax.device_put(train_vals, fmts[0])
+        states = jax.device_put(states, fmts[1])
+        aux_vals = jax.device_put(aux_vals, fmts[2])
         return self._compiled(train_vals, states, aux_vals, *rest)
 
 
@@ -487,8 +495,13 @@ class ShardedTrainer:
                 # carried constant, never replaced, so it must stay live.
                 donate = (0, 1, 2, 5, 6) if self._donate else ()
                 if self._auto_layout:
-                    from jax.experimental.layout import Format, Layout
-                    auto = Format(Layout.AUTO)
+                    try:    # jax >= 0.5: Format wraps the tiling Layout
+                        from jax.experimental.layout import Format, Layout
+                        auto = Format(Layout.AUTO)
+                    except ImportError:  # 0.4.x spelling of the same
+                        from jax.experimental.layout import (
+                            DeviceLocalLayout, Layout)
+                        auto = Layout(DeviceLocalLayout.AUTO)
                     # AUTO only on the persistent state (in AND out, so
                     # the chosen layouts agree with donation aliasing);
                     # batches/key/t/lr keep caller-visible defaults

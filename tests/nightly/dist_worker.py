@@ -30,7 +30,7 @@ assert jax.process_index() == rank
 
 import jax.numpy as jnp                                     # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
-from jax import shard_map                                   # noqa: E402
+from mxtpu.parallel.mesh import shard_map                   # noqa: E402
 
 devs = jax.devices()          # all processes' devices, DCN-addressable
 assert len(devs) >= nproc

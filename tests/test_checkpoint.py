@@ -90,6 +90,55 @@ def test_restore_missing_explicit_step(tmp_path):
     assert ckpt.restore(3) is None      # reaped/never-written step
 
 
+@pytest.mark.parametrize("crash_point", ["during_write", "before_publish"])
+def test_crash_mid_save_never_corrupts_latest(tmp_path, monkeypatch,
+                                              crash_point):
+    """Kill the fallback writer thread mid-save — either while the
+    arrays are being written or at the instant before the atomic
+    publish — and prove the 'crash mid-save can never corrupt the
+    latest checkpoint' claim: latest_step() still returns the previous
+    intact step, restore() loads it bit-exact, and a later save
+    recovers cleanly over the leftover .tmp debris."""
+    import mxtpu.checkpoint as ckpt_mod
+    net, trainer, x = _net_and_trainer()
+    before = net(x).asnumpy()
+    ckpt = CheckpointManager(str(tmp_path / "k"), use_orbax=False)
+    ckpt.save(1, net.collect_params())
+    ckpt.wait_until_finished()
+    assert ckpt.latest_step() == 1
+
+    if crash_point == "during_write":
+        real = ckpt_mod._np.savez
+
+        def dying(*a, **kw):
+            real(*a, **kw)               # bytes hit the .tmp dir, then
+            raise SystemExit("writer thread killed mid-save")
+
+        monkeypatch.setattr(ckpt_mod._np, "savez", dying)
+    else:
+        def dying_replace(src, dst):
+            raise SystemExit("writer thread killed before publish")
+
+        monkeypatch.setattr(ckpt_mod.os, "replace", dying_replace)
+
+    ckpt.save(2, net.collect_params())   # async writer dies mid-flight
+    with pytest.raises(RuntimeError, match="latest on-disk step is stale"):
+        ckpt.wait_until_finished()
+    monkeypatch.undo()
+
+    # the half-written step 2 must be invisible: only its .tmp remains
+    assert ckpt.latest_step() == 1
+    assert ckpt.all_steps() == [1]
+    net2, trainer2, _ = _net_and_trainer(seed=9)
+    ckpt.restore(None, net2.collect_params())
+    np.testing.assert_allclose(net2(x).asnumpy(), before, rtol=1e-6)
+
+    # and the manager recovers: the next save publishes over the debris
+    ckpt.save(2, net.collect_params())
+    ckpt.wait_until_finished()
+    assert ckpt.latest_step() == 2
+
+
 def test_async_write_failure_surfaces(tmp_path):
     net, trainer, _ = _net_and_trainer()
     ckpt = CheckpointManager(str(tmp_path / "good"), use_orbax=False)

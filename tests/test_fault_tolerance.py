@@ -1,0 +1,434 @@
+"""Fault matrix for the dist_async stack (mxtpu/fault.py +
+kvstore_async's retry/dedupe/health/auto-resume layers; see the module
+docstring's "Fault tolerance" section and docs/fault_tolerance.md).
+
+Every scenario is deterministic: faults come from the injection harness
+on exact event schedules (never from timing), servers are loopback
+threads in this process, and the only sleeps are sub-second backoffs the
+retry layer itself performs. The matrix each test row covers:
+
+fault kind x injection point        -> recovery path proven
+---------------------------------------------------------------------
+sever  @ worker.send (pre-apply)    -> plain retry, applied once
+sever  @ server.send (post-apply)   -> retry + seq dedupe (at-most-once)
+truncate @ worker.send              -> garbage frame isolated, retried
+drop   @ worker.send                -> per-call timeout fires, retried
+delay  @ worker.send                -> transparent (just slower)
+kill   @ server.recv                -> snapshot-backed restart, buffered
+                                       pushes flushed, workers reconverge
+server gone (no injection)          -> pull degrades to cached value,
+                                       health() reports the dead shard
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import fault
+from mxtpu import kvstore_async as ka
+from mxtpu.kvstore_async import ParameterServer
+
+
+@pytest.fixture(autouse=True)
+def _fast_failure_knobs(monkeypatch):
+    """Small retry/backoff windows so every fault path resolves in
+    well under a second, heartbeat thread off (tests sweep health
+    synchronously via kv._check_health()), and a clean injector."""
+    monkeypatch.setattr(ka, "_RETRIES", 2)
+    monkeypatch.setattr(ka, "_BACKOFF", 0.01)
+    monkeypatch.setattr(ka, "_BACKOFF_MAX", 0.05)
+    monkeypatch.setattr(ka, "_RECONNECT_TIMEOUT", 0.2)
+    monkeypatch.setattr(ka, "_DEAD_AFTER", 2)
+    monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0")
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+def _store(monkeypatch, addrs, rank=0, nproc=1):
+    monkeypatch.setenv("MXTPU_PS_ADDRS", addrs)
+    monkeypatch.setenv("MXTPU_PROC_ID", str(rank))
+    monkeypatch.setenv("MXTPU_NUM_PROCS", str(nproc))
+    return mx.kv.create("dist_async")
+
+
+# ---------------------------------------------------------------------------
+# the injection harness itself
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing_and_validation():
+    rules = fault.parse_spec(
+        "kind=sever,point=server.send,op=push,nth=3,count=2;"
+        "kind=delay,point=any,delay=0.25,count=inf")
+    assert len(rules) == 2
+    assert (rules[0].kind, rules[0].point, rules[0].op,
+            rules[0].nth, rules[0].count) == \
+        ("sever", "server.send", "push", 3, 2)
+    assert rules[1].delay == 0.25 and rules[1].count == float("inf")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        fault.parse_spec("kind=explode")
+    with pytest.raises(ValueError, match="unknown fault point"):
+        fault.parse_spec("kind=sever,point=everywhere")
+    with pytest.raises(ValueError, match="kill only applies to server"):
+        fault.parse_spec("kind=kill,point=worker.send")
+    with pytest.raises(ValueError, match="no kind="):
+        fault.parse_spec("point=worker.send")
+
+
+def test_injector_schedule_is_deterministic():
+    inj = fault.FaultInjector("kind=sever,point=worker.send,op=push,"
+                              "nth=2,count=2")
+    outcomes = []
+    for _ in range(5):
+        try:
+            inj.fire("worker.send", op="push")
+            outcomes.append("ok")
+        except fault.FaultSever:
+            outcomes.append("sever")
+    # exactly events 2 and 3 fault, nothing else — replayable schedule
+    assert outcomes == ["ok", "sever", "sever", "ok", "ok"]
+    inj2 = fault.FaultInjector("kind=sever,point=server.recv,op=pull,"
+                               "key=big")
+    inj2.fire("server.recv", op="pull", key="other")      # key mismatch
+    inj2.fire("worker.send", op="pull", key="big0")       # point mismatch
+    with pytest.raises(fault.FaultSever):
+        inj2.fire("server.recv", op="pull", key="big0")
+    assert inj2.stats()[0][3:] == (1, 1)                  # seen, fired
+
+
+def test_env_spec_bootstrap(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SPEC",
+                       "kind=delay,point=worker.recv,delay=0.01")
+    monkeypatch.setattr(fault, "_env_loaded", False)
+    monkeypatch.setattr(fault, "_injector", None)
+    inj = fault.active()
+    assert inj is not None and inj.rules[0].kind == "delay"
+
+
+# ---------------------------------------------------------------------------
+# retry / at-most-once replay
+# ---------------------------------------------------------------------------
+
+def test_pre_apply_sever_is_retried(monkeypatch):
+    """Connection dies BEFORE the frame reaches the server: the retry
+    needs no dedupe help — the replay is the first copy to arrive."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        with fault.inject("kind=sever,point=worker.send,op=push,nth=1") \
+                as inj:
+            kv.push("w", mx.nd.ones((4,)))
+        assert inj.stats()[0][4] == 1          # the fault really fired
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+        assert srv._clock["w"] == 1 and srv._dup_n == 0
+        assert kv.health()["num_dead"] == 0    # one blip != dead
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_lost_ack_push_replay_applied_exactly_once(monkeypatch):
+    """Connection dies AFTER the server applied the push but before the
+    ack: the blind replay MUST be deduped by the (origin, seq) pair —
+    clock-checked, the acceptance-criteria scenario."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        with fault.inject("kind=sever,point=server.send,op=push,nth=1") \
+                as inj:
+            kv.push("w", mx.nd.ones((4,)))     # applied; ack lost; replay
+        assert inj.stats()[0][4] == 1
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))  # not 2.0!
+        assert srv._clock["w"] == 1            # applied exactly once
+        assert srv._dup_n == 1                 # the replay was refused
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_truncate_fault_recovers(monkeypatch):
+    """A torn frame (bogus length then close) must be contained by the
+    server's framing guards and recovered by the worker's retry."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((2,)))
+        with fault.inject(
+                "kind=truncate,point=worker.send,op=push,nth=1"):
+            kv.push("w", mx.nd.ones((2,)))
+        out = mx.nd.zeros((2,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(2))
+        assert srv._clock["w"] == 1 and srv._dup_n == 0
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_dropped_frame_hits_timeout_then_retries(monkeypatch):
+    """kind=drop silently loses the request frame, so ONLY the per-call
+    timeout can notice — proves the timeout path, not just the
+    connection-error path."""
+    monkeypatch.setattr(ka, "_REQUEST_TIMEOUT", 0.3)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.array(np.arange(3, dtype="f")))
+        with fault.inject("kind=drop,point=worker.send,op=pull,nth=1") \
+                as inj:
+            out = mx.nd.zeros((3,))
+            kv.pull("w", out=out)
+        assert inj.stats()[0][4] == 1
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.arange(3, dtype="f"))
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_delay_fault_is_transparent(monkeypatch):
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((2,)))
+        with fault.inject("kind=delay,point=worker.send,op=push,"
+                          "delay=0.05,count=2") as inj:
+            kv.push("w", mx.nd.ones((2,)))
+            kv.push("w", mx.nd.ones((2,)))
+        assert inj.stats()[0][4] == 2
+        out = mx.nd.zeros((2,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 2 * np.ones(2))
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_barrier_is_never_replayed(monkeypatch):
+    """barrier is NOT idempotent (a replayed arrival would double-count
+    this worker in the generation), so a barrier fault must surface
+    instead of retrying."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((2,)))
+        with fault.inject("kind=sever,point=worker.send,op=barrier,"
+                          "nth=1"):
+            with pytest.raises(ConnectionError):
+                kv.barrier()
+        assert srv._barrier_arrived == 0       # no half-arrived worker
+    finally:
+        kv.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# liveness: dead-shard degradation + recovery
+# ---------------------------------------------------------------------------
+
+def test_dead_shard_pull_degrades_to_last_known(monkeypatch):
+    """Acceptance scenario: a pull whose shard is dead returns the
+    worker's last-known value (staleness-marked) instead of raising,
+    health() reports the dead server, and a recovered server clears
+    both on the next health sweep + pull."""
+    s1, s2 = ParameterServer().start(), ParameterServer().start()
+    kv = _store(monkeypatch, s1.address + "," + s2.address)
+    try:
+        keys = ["k%d" % i for i in range(6)]
+        for k in keys:
+            kv.init(k, mx.nd.ones((3,)) * float(k[1]))
+        out = mx.nd.zeros((3,))
+        for k in keys:
+            kv.pull(k, out=out)                # warm the last-known cache
+        # kill whichever server owns k0; remember its port for revival
+        dead = s1 if "k0" in s1._clock else s2
+        live = s2 if dead is s1 else s1
+        dead_port = int(dead.address.split(":")[1])
+        dead_keys = sorted(dead._clock)
+        dead.stop()
+
+        kv.pull("k0", out=out)                 # degraded, NOT an error
+        np.testing.assert_allclose(out.asnumpy(), np.zeros(3))
+        h = kv.health()
+        assert h["num_dead"] == 1
+        assert "k0" in h["degraded_keys"]
+        states = {s["addr"]: s["state"] for s in h["servers"]}
+        assert states[dead.address] == "dead"
+        assert states[live.address] == "ok"
+        assert kv.get_num_dead_node() == 1     # the NumDeadNodes analogue
+        # keys on the live shard are untouched by the dead one
+        live_key = sorted(live._clock)[0]
+        kv.pull(live_key, out=out)
+        assert live_key not in kv.health()["degraded_keys"]
+
+        # shard comes back on the same port: the background probe path
+        # (run synchronously here) re-marks it ok, and a live pull
+        # clears the staleness mark
+        revived = ParameterServer(port=dead_port).start()
+        try:
+            kv._check_health()
+            assert kv.health()["num_dead"] == 0
+            # revived empty table: the key is gone (no snapshot); a pull
+            # still degrades to cache rather than raising mid-training
+            kv.pull("k0", out=out)
+            assert "k0" in kv.health()["degraded_keys"], \
+                "no live value yet -> still staleness-marked"
+            for k in dead_keys:                # re-init repopulates
+                kv.init(k, mx.nd.ones((3,)) * 7)
+            kv.pull("k0", out=out)
+            np.testing.assert_allclose(out.asnumpy(), 7 * np.ones(3))
+            assert "k0" not in kv.health()["degraded_keys"]
+        finally:
+            revived.stop()
+    finally:
+        kv.close()
+        s1.stop()
+        s2.stop()
+
+
+def test_pull_without_cache_still_raises(monkeypatch):
+    """Degradation never invents data: a key this worker NEVER pulled
+    has no last-known value, so a dead shard must still raise."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((2,)))        # init warms no pull cache
+        srv.stop()
+        with pytest.raises(ConnectionError):
+            kv.pull("w", out=mx.nd.zeros((2,)))
+    finally:
+        kv.close()
+
+
+# ---------------------------------------------------------------------------
+# auto-resume: snapshots, buffered pushes, restart
+# ---------------------------------------------------------------------------
+
+def test_killed_server_restores_snapshot_and_reconverges(monkeypatch,
+                                                         tmp_path):
+    """Acceptance scenario: the injector kills the server on schedule
+    mid-training; a restart on the same port restores table, clocks,
+    optimizer AND the push-dedupe seqs from the snapshot; the worker's
+    buffered push flushes with its original seq (at-most-once across
+    the crash) and training reconverges with no operator action."""
+    snap = str(tmp_path / "snaps")
+    srv = ParameterServer(snapshot_dir=snap, snapshot_every=1).start()
+    port = int(srv.address.split(":")[1])
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+        kv.push("w", mx.nd.ones((4,)))         # applied + snapshotted
+        # 2nd push: the server is killed on receipt, BEFORE applying
+        # (the injector counts from installation, so nth=1 here)
+        with fault.inject("kind=kill,point=server.recv,op=push,nth=1"):
+            kv.push("w", mx.nd.ones((4,)))     # buffered, not lost
+        h = kv.health()
+        assert h["num_dead"] == 1 and h["pending_pushes"] == 1
+
+        srv2 = ParameterServer(port=port, snapshot_dir=snap).start()
+        try:
+            assert srv2._restored_step is not None
+            assert srv2._updater is not None, \
+                "optimizer must ride the snapshot"
+            np.testing.assert_allclose(srv2._table["w"].asnumpy(),
+                                       -0.5 * np.ones(4))
+            assert srv2._clock["w"] == 1
+
+            kv._check_health()                 # probe + flush buffered
+            h = kv.health()
+            assert h["num_dead"] == 0 and h["pending_pushes"] == 0
+            out = mx.nd.zeros((4,))
+            kv.pull("w", out=out)              # -0.5 - 0.5 = -1.0
+            np.testing.assert_allclose(out.asnumpy(), -np.ones(4))
+            assert srv2._clock["w"] == 2 and srv2._dup_n == 0
+
+            # reconvergence: the fleet keeps training as if nothing
+            # happened — each further push is applied exactly once
+            for _ in range(3):
+                kv.push("w", mx.nd.ones((4,)))
+            kv.pull("w", out=out)
+            np.testing.assert_allclose(out.asnumpy(), -2.5 * np.ones(4))
+            assert srv2._clock["w"] == 5
+        finally:
+            srv2.stop()
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_buffered_push_flush_is_deduped_against_retry(monkeypatch,
+                                                      tmp_path):
+    """The nastiest interleaving: the push's ack is lost (server DID
+    apply it), the server then dies before the worker's replay lands, so
+    the replay gets buffered — and after restart the flush replays a seq
+    the SNAPSHOT already recorded as applied. The restored dedupe table
+    must refuse it."""
+    snap = str(tmp_path / "snaps")
+    srv = ParameterServer(snapshot_dir=snap, snapshot_every=1).start()
+    port = int(srv.address.split(":")[1])
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        # push 1 applied + snapshotted (seq recorded), then the ack is
+        # severed AND the server dies, so every replay attempt fails
+        with fault.inject(
+                "kind=sever,point=server.send,op=push,nth=1;"
+                "kind=kill,point=server.recv,op=push,nth=2"):
+            kv.push("w", mx.nd.ones((4,)))
+        assert kv.health()["pending_pushes"] == 1
+        srv2 = ParameterServer(port=port, snapshot_dir=snap).start()
+        try:
+            kv._check_health()                 # flush replays seq 1
+            assert kv.health()["pending_pushes"] == 0
+            out = mx.nd.zeros((4,))
+            kv.pull("w", out=out)
+            np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+            assert srv2._clock["w"] == 1       # exactly once, ever
+            assert srv2._dup_n == 1            # the flush was refused
+        finally:
+            srv2.stop()
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_snapshot_roundtrip_preserves_key_types(tmp_path):
+    """Table keys are ints, plain strings, and NUL-separated part
+    subkeys — the snapshot's tagged-key encoding must round-trip all
+    three exactly."""
+    snap = str(tmp_path / "s")
+    srv = ParameterServer(snapshot_dir=snap, snapshot_every=0)
+    conn = ka._ServerConn(srv.start().address)
+    try:
+        conn.request("init", 7, np.arange(3, dtype="f"))
+        conn.request("init", "name", np.ones((2, 2), "f"))
+        conn.request("init", "big\x001", np.zeros(2, "f"))
+        conn.request("push", "big\x001", np.ones(2, "f"), 0, "o1", 5)
+        assert srv.snapshot()
+    finally:
+        conn.close()
+        srv.stop()
+    srv2 = ParameterServer(snapshot_dir=snap)
+    try:
+        assert set(srv2._table) == {7, "name", "big\x001"}
+        assert srv2._clock == {7: 0, "name": 0, "big\x001": 1}
+        assert srv2._applied == {("o1", "big\x001"): 5}
+        np.testing.assert_allclose(srv2._table[7].asnumpy(),
+                                   np.arange(3, dtype="f"))
+    finally:
+        srv2.stop()
+
+
+def test_local_store_health_is_trivially_ok():
+    kv = mx.kv.create("local")
+    h = kv.health()
+    assert h["num_dead"] == 0 and h["servers"] == []
+    assert kv.get_num_dead_node() == 0

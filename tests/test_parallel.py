@@ -88,13 +88,22 @@ def test_auto_layout_matches_plain():
     donation — numerics must be bit-identical to the default path (a
     layout is storage order, not math). Conv net so weight layouts are
     non-trivial; DP mesh so the sharded lowering path is the one
-    exercised."""
+    exercised.
+
+    Three configs: the plain baseline, auto_layout with donation, and
+    auto_layout WITHOUT donation (outputs never adopt the chosen input
+    formats, so every call must relayout). Each run also switches batch
+    shape mid-training and back — the second shape compiles a separate
+    executable whose chosen layouts may differ, and the state carried
+    from the first executable must be relaid out, not rejected."""
     np.random.seed(0)
     x = np.random.uniform(size=(8, 3, 16, 16)).astype(np.float32)
     y = np.random.randint(0, 10, (8,)).astype(np.float32)
+    x2 = np.concatenate([x, x])          # second shape, still 8-divisible
+    y2 = np.concatenate([y, y])
 
     losses = {}
-    for auto in (False, True):
+    for auto, donate in ((False, True), (True, True), (True, False)):
         mx.random.seed(3)
         net = nn.HybridSequential()
         net.add(nn.Conv2D(8, 3, padding=1), nn.Activation("relu"),
@@ -102,11 +111,19 @@ def test_auto_layout_matches_plain():
         net.initialize(mx.init.Xavier())
         st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                             "sgd", {"learning_rate": 0.1, "momentum": 0.9},
-                            mesh=MeshContext(data=8), auto_layout=auto)
-        losses[auto] = [st.step(x, y) for _ in range(4)]
-    np.testing.assert_allclose(losses[False], losses[True],
+                            mesh=MeshContext(data=8), auto_layout=auto,
+                            donate=donate)
+        ls = [st.step(x, y) for _ in range(4)]
+        ls.append(st.step(x2, y2))       # new shape -> new executable
+        ls.append(st.step(x, y))         # back: first executable again
+        losses[(auto, donate)] = ls
+    np.testing.assert_allclose(losses[(False, True)],
+                               losses[(True, True)],
                                rtol=1e-6, atol=1e-7)
-    assert losses[True][-1] < losses[True][0]
+    np.testing.assert_allclose(losses[(False, True)],
+                               losses[(True, False)],
+                               rtol=1e-6, atol=1e-7)
+    assert losses[(True, True)][-1] < losses[(True, True)][0]
 
 
 def test_tensor_parallel_matches_dp():
@@ -262,7 +279,7 @@ def test_ulysses_attention_matches_full():
     k = np.random.randn(b, h, t, d).astype(np.float32) * 0.5
     v = np.random.randn(b, h, t, d).astype(np.float32)
     mesh = MeshContext(seq=8)
-    from jax import shard_map
+    from mxtpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P2
     spec = P2(None, None, "seq", None)
     fn = shard_map(

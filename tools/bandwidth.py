@@ -59,7 +59,19 @@ def main():
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
-    from jax import shard_map
+    # standalone tool: jax-only shard_map compat (mxtpu may not be on
+    # sys.path when invoked as a script; mirror parallel/mesh.py's shim)
+    import inspect
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+    if "check_vma" in inspect.signature(_sm).parameters:
+        shard_map = _sm
+    else:
+        def shard_map(*a, **kw):
+            kw["check_rep"] = kw.pop("check_vma", True)
+            return _sm(*a, **kw)
     from jax.sharding import Mesh, PartitionSpec as P
     import numpy as np
 

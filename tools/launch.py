@@ -24,6 +24,8 @@ import secrets
 import signal
 import subprocess
 import sys
+import tempfile
+import time
 
 
 def _free_port(preferred):
@@ -42,6 +44,22 @@ def _free_port(preferred):
     return preferred
 
 
+def _spawn_server(idx, ps_port, base_env, args):
+    """One async parameter-server child. With a snapshot dir configured,
+    the server snapshots its table there and a RESPAWN of the same index
+    restores it — kvstore_async auto-resume — because the respawn reuses
+    the same port (workers reconnect via their retry layer) and the same
+    per-index directory."""
+    env = dict(base_env, DMLC_ROLE="server",
+               MXTPU_PS_PORT=str(ps_port), JAX_PLATFORMS="cpu")
+    if args.ps_snapshot_dir:
+        env["MXTPU_PS_SNAPSHOT_DIR"] = os.path.join(
+            args.ps_snapshot_dir, "server_%d" % idx)
+        env["MXTPU_PS_SNAPSHOT_EVERY"] = str(args.ps_snapshot_every)
+    return subprocess.Popen(
+        [sys.executable, "-m", "mxtpu.kvstore_async"], env=env)
+
+
 def launch_local(args, command):
     procs = []
     base_env = dict(os.environ)
@@ -50,6 +68,7 @@ def launch_local(args, command):
     # reference dmlc-tracker starts ps-lite servers the same way); workers
     # find them via MXTPU_PS_ADDRS for create('dist_async')
     server_procs = []
+    server_ports = []
     ps_addrs = []
     # per-launch shared secret: the PS wire protocol is pickle, so only
     # processes of THIS launch may speak to the servers (any other local
@@ -57,12 +76,15 @@ def launch_local(args, command):
     ps_token = secrets.token_hex(16) if args.num_servers else None
     if ps_token:
         base_env["MXTPU_PS_TOKEN"] = ps_token
+    if args.ps_respawn and not args.ps_snapshot_dir:
+        # a respawned server with no snapshot restores nothing and every
+        # in-flight key 404s — auto-provision the state dir instead
+        args.ps_snapshot_dir = tempfile.mkdtemp(prefix="mxtpu_ps_snap_")
+        print("ps snapshots in %s" % args.ps_snapshot_dir)
     for s in range(args.num_servers):
         ps_port = _free_port(args.port + 1 + s)
-        env = dict(base_env, DMLC_ROLE="server",
-                   MXTPU_PS_PORT=str(ps_port), JAX_PLATFORMS="cpu")
-        server_procs.append(subprocess.Popen(
-            [sys.executable, "-m", "mxtpu.kvstore_async"], env=env))
+        server_ports.append(ps_port)
+        server_procs.append(_spawn_server(s, ps_port, base_env, args))
         ps_addrs.append("127.0.0.1:%d" % ps_port)
     for rank in range(args.num_workers):
         env = dict(base_env)
@@ -80,9 +102,26 @@ def launch_local(args, command):
             env["MXTPU_PS_ADDRS"] = ",".join(ps_addrs)
         procs.append(subprocess.Popen(command, shell=True, env=env))
     code = 0
+    respawns = [0] * len(server_procs)
     try:
+        while any(p.poll() is None for p in procs):
+            if args.ps_respawn:
+                for i, sp in enumerate(server_procs):
+                    rc = sp.poll()
+                    if rc is None or rc == 0:
+                        continue   # alive, or clean 'stop' exit
+                    if respawns[i] >= args.ps_max_respawns:
+                        continue   # workers' retry layer surfaces it
+                    respawns[i] += 1
+                    print("server %d died (exit %d); respawning on port "
+                          "%d (%d/%d)" % (i, rc, server_ports[i],
+                                          respawns[i],
+                                          args.ps_max_respawns),
+                          flush=True)
+                    server_procs[i] = _spawn_server(
+                        i, server_ports[i], base_env, args)
+            time.sleep(0.2)
         for p in procs:
-            p.wait()
             code = code or p.returncode
     except KeyboardInterrupt:
         for p in procs:
@@ -90,7 +129,8 @@ def launch_local(args, command):
         code = 1
     finally:
         for p in server_procs:
-            p.send_signal(signal.SIGTERM)
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
     return code
 
 
@@ -209,8 +249,22 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("-s", "--num-servers", type=int, default=0,
-                   help="accepted for reference-CLI parity; mxtpu has no "
-                        "parameter servers (SPMD collectives instead)")
+                   help="async parameter-server processes for "
+                        "create('dist_async'); sync mode needs none "
+                        "(SPMD collectives instead)")
+    p.add_argument("--ps-respawn", action="store_true",
+                   help="local launcher: respawn a crashed parameter "
+                        "server on its original port; with snapshots it "
+                        "restores its table and workers reconverge")
+    p.add_argument("--ps-max-respawns", type=int, default=3,
+                   help="respawn budget per server before its death is "
+                        "left to the workers' retry layer")
+    p.add_argument("--ps-snapshot-dir", default=None,
+                   help="base dir for per-server state snapshots "
+                        "(server i uses <dir>/server_i); auto-created "
+                        "under $TMPDIR when --ps-respawn is on")
+    p.add_argument("--ps-snapshot-every", type=int, default=100,
+                   help="pushes between server snapshots")
     p.add_argument("--launcher",
                    choices=("local", "ssh", "mpi", "slurm", "sge"),
                    default="local")
