@@ -14,6 +14,8 @@ point in the pipeline as the reference's ReduceCompressed.
 """
 from __future__ import annotations
 
+import numpy as _np
+
 import jax.numpy as jnp
 
 from .ops.registry import register
@@ -48,6 +50,28 @@ def quantize_2bit(data, residual, threshold=0.5):
     packed = jnp.sum(flat.reshape(-1, _WORD) << shifts, axis=1,
                      dtype=jnp.uint32)
     return packed, new_residual.astype(residual.dtype)
+
+
+def _quantize_2bit_np(data, residual, threshold):
+    """Pure-numpy mirror of :func:`quantize_2bit` (bit-identical codes
+    and residual): the kvstore push path hands numpy parts, and a tiny
+    embedding/bias part must not pay a device dispatch per call to ride
+    a coalesced frame — the compressed payload is computed host-side in
+    one pass."""
+    r = _np.asarray(residual, _np.float32) + _np.asarray(data, _np.float32)
+    pos = r >= threshold
+    neg = r <= -threshold
+    new_residual = r - _np.where(pos, threshold, 0.0) \
+        + _np.where(neg, threshold, 0.0)
+    codes = _np.where(pos, 1, _np.where(neg, 2, 0)).astype(_np.uint64)
+    flat = codes.ravel()
+    pad = (-flat.size) % _WORD
+    if pad:
+        flat = _np.concatenate([flat, _np.zeros(pad, _np.uint64)])
+    shifts = (_np.arange(_WORD, dtype=_np.uint64) * 2)[None, :]
+    packed = (flat.reshape(-1, _WORD) << shifts).sum(
+        axis=1, dtype=_np.uint64).astype(_np.uint32)
+    return packed, new_residual
 
 
 @register("_contrib_gc_dequantize_2bit", differentiable=False)
@@ -88,10 +112,19 @@ class GradientCompression:
         self._residuals = {}
 
     def compress(self, slot, array):
-        """Quantize one device-array for wire transfer; updates the
-        slot's residual. Returns the packed uint32 representation."""
-        data = array.astype(jnp.float32)
+        """Quantize one array for wire transfer; updates the slot's
+        residual. Returns the packed uint32 representation. numpy input
+        (the kvstore push path) quantizes host-side — no device round
+        trip per part — via the bit-identical numpy mirror."""
         res = self._residuals.get(slot)
+        if isinstance(array, _np.ndarray):
+            if res is None or res.shape != array.shape:
+                res = _np.zeros(array.shape, _np.float32)
+            packed, new_res = _quantize_2bit_np(array, res,
+                                                self.threshold)
+            self._residuals[slot] = new_res
+            return packed
+        data = array.astype(jnp.float32)
         if res is None or res.shape != data.shape:
             res = jnp.zeros(data.shape, jnp.float32)
         packed, new_res = quantize_2bit(data, res, self.threshold)

@@ -101,14 +101,56 @@ each failure has an exercised recovery path — see
   deterministically drop/delay/truncate/sever frames at either side of
   the wire and kill servers on schedule; the fault-matrix tests drive
   every path above through it.
+
+Fast path
+---------
+The data path is built for throughput on top of those fault semantics
+(ps-lite's levers — zero-copy scatter-gather, many requests per
+connection, message coalescing — rendered here; measured in
+``tools/bench_kvstore.py`` / docs/perf_analysis.md "Comms fast path"):
+
+* **Zero-copy wire.** Sends are scatter-gather (``socket.sendmsg`` over
+  the frame head + each pickle-5 out-of-band buffer), so an N-byte
+  gradient leaves the worker without ever being concatenated; receives
+  land every buffer of a frame in one preallocated blob (one
+  ``recv_into`` stream, buffers are memoryview slices of it), so the
+  server applies straight out of the wire buffer.
+* **Request pipelining.** Every frame carries a correlation id and each
+  socket runs a bounded in-flight window (``MXTPU_PS_WINDOW``, default
+  8): sends and receives are decoupled, so the k parts of a big array
+  stream back-to-back instead of paying one RTT each. Any failure —
+  socket error, injected sever, a waiter's timeout — fails the whole
+  unacked window onto the retry layer, whose replays the push seq
+  dedupe keeps at-most-once.
+* **Small-key coalescing.** Parts at or below ``MXTPU_PS_COALESCE_BYTES``
+  (default 16 KiB) within one push/pull call batch into one multi-key
+  frame per server (the bigarray bound's dual: tiny embedding/bias keys
+  must not pay a full frame + dispatch each); compressed payloads ride
+  the same frames.
+* **Host-side apply.** The server table is plain numpy: the no-updater
+  accumulate is one in-place ``np.add`` per push straight from the wire
+  buffer (no device round trip), and pulls of updater-managed keys hand
+  out the immutable post-update buffer with zero copies.
+* **Same-process shortcut.** A worker whose server lives in THIS
+  process (single-process mode, loopback benches) skips socket and
+  pickle entirely — ps-lite's local/intra-node path: the request is
+  applied by direct dispatch under the same per-key locks, seq dedupe
+  and fault-injection points, so a 64 MB push costs one in-place
+  ``np.add`` and nothing else. ``MXTPU_PS_LOCAL=0`` forces the wire
+  (the fault matrix pins it off so every row exercises real framing;
+  note the shortcut also bypasses the ``MXTPU_PS_TOKEN`` preamble —
+  a same-process peer already runs our code).
+* **Counters.** ``kv.stats()`` reports wire bytes/frames, coalescing,
+  the in-flight high-water mark and retransmits — ``ci/
+  check_comms_perf.py`` pins the overhead without wall-clock timing.
 """
 from __future__ import annotations
 
 import io
+import itertools
 import logging
 import os
 import pickle
-import queue as _queue
 import socket
 import socketserver
 import struct
@@ -154,6 +196,33 @@ _BIGARRAY_BOUND = int(os.environ.get(
 
 _GC_MARK = "gc2bit"  # wire tag for a 2-bit-compressed push payload
 
+# pipelined-window size: how many requests may ride one socket
+# unacknowledged. Correlation ids pair replies to waiters, so the k
+# parts of a big push stream back-to-back instead of paying an RTT each
+# (ps-lite keeps many requests in flight per connection the same way).
+_WINDOW = int(os.environ.get("MXTPU_PS_WINDOW", "8"))
+
+# pushes/pulls whose payload is at most this many bytes coalesce into
+# one multi-key frame per server within a push/pull call — the bigarray
+# bound's dual: tiny embedding/bias keys must not pay a full frame +
+# dispatch each. 0 disables coalescing.
+_COALESCE_BYTES = int(os.environ.get("MXTPU_PS_COALESCE_BYTES", "16384"))
+
+_COALESCE_MAX = 512   # sub-commands per multi frame (stays far under
+#                       the receiver's 4096 buffer-count guard)
+
+_IOV_MAX = 512        # iovecs per sendmsg call (Linux caps at 1024)
+
+# same-process shortcut (ps-lite's local/intra-node path): a worker
+# whose server lives in THIS process — single-process mode, loopback
+# benches — skips socket and pickle entirely and applies requests by
+# direct dispatch under the same locks, dedupe and fault-injection
+# points as a wire request. MXTPU_PS_LOCAL=0 forces the wire (the
+# fault-matrix tests pin it off so every row exercises real framing).
+_LOCAL_ON = os.environ.get("MXTPU_PS_LOCAL", "1") != "0"
+_LOCAL_SERVERS = {}        # "host:port" -> in-process ParameterServer
+_LOCAL_GUARD = threading.Lock()
+
 
 def _slice_part(arr, lo, hi):
     """Row slice of a part payload; rank-0 arrays are always one whole
@@ -193,26 +262,69 @@ def _wire_decode(grad):
 _NBUF = struct.Struct("<I")
 
 
-def _send_frame(sock, obj):
-    """Pickle-5 framing with out-of-band buffers: big numpy payloads ride
-    as raw frames after the pickle body instead of being copied into it
-    (one fewer memcpy per side at ~100 MB scale; see tools/bench_ps.py).
+class _CommStats:
+    """Worker-side comms counters behind ``kv.stats()``. Cheap enough to
+    run unconditionally: one lock bump per frame, never per byte."""
+
+    _FIELDS = ("bytes_sent", "bytes_recv", "frames_sent", "frames_recv",
+               "coalesced_frames", "coalesced_subs", "retransmits",
+               "inflight_hwm", "local_reqs")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = dict.fromkeys(self._FIELDS, 0)
+
+    def add(self, field, n=1):
+        with self._lock:
+            self._v[field] += n
+
+    def hwm(self, inflight):
+        with self._lock:
+            if inflight > self._v["inflight_hwm"]:
+                self._v["inflight_hwm"] = inflight
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._v)
+
+
+def _sendmsg_all(sock, views):
+    """Scatter-gather sendall: one ``sendmsg`` syscall moves the frame
+    head and every raw buffer with no intermediate concatenation — the
+    zero-copy send half. Sequential ``sendall`` fallback where sendmsg
+    is missing (non-POSIX)."""
+    views = [v for v in views if v.nbytes]
+    if not hasattr(sock, "sendmsg"):
+        for v in views:
+            sock.sendall(v)
+        return
+    while views:
+        sent = sock.sendmsg(views[:_IOV_MAX])
+        while sent:
+            if sent >= views[0].nbytes:
+                sent -= views[0].nbytes
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
+def _send_frame(sock, obj, stats=None):
+    """Pickle-5 framing with out-of-band buffers: numpy payloads ride as
+    raw frames after the pickle body instead of being copied into it.
     Wire: u64 body_len, body, u32 n_buffers, u64 len x n, then the raw
-    buffer bytes back to back. All lengths travel in the head, so a
-    frame is one send for small messages and head + one send per big
-    buffer otherwise — never a tiny split segment (split sends interact
-    with Nagle/delayed-ACK into ~40 ms stalls per round trip)."""
+    buffer bytes back to back. The whole frame leaves in one
+    scatter-gather sendmsg — an N-byte gradient is never concatenated,
+    and no tiny split segment exists to trip Nagle/delayed-ACK."""
     buffers = []
     body = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
     raws = [buf.raw() for buf in buffers]
     head = (_LEN.pack(len(body)) + body + _NBUF.pack(len(raws))
             + b"".join(_LEN.pack(r.nbytes) for r in raws))
-    if len(head) + sum(r.nbytes for r in raws) <= 1 << 16:
-        sock.sendall(head + b"".join(r.tobytes() for r in raws))
-        return
-    sock.sendall(head)
-    for r in raws:
-        sock.sendall(r)
+    _sendmsg_all(sock, [memoryview(head)] + raws)
+    if stats is not None:
+        stats.add("bytes_sent", len(head) + sum(r.nbytes for r in raws))
+        stats.add("frames_sent")
 
 
 def _recv_exact(sock, n):
@@ -220,7 +332,16 @@ def _recv_exact(sock, n):
     view = memoryview(buf)
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)
+        try:
+            r = sock.recv_into(view[got:], n - got)
+        except socket.timeout:
+            if got:
+                # mid-frame stall: the stream position is lost and the
+                # connection must not be reused (idle timeouts — got==0
+                # — are the receiver thread's poll tick and harmless)
+                raise ConnectionError(
+                    "timed out mid-frame after %d/%d bytes" % (got, n))
+            raise
         if not r:
             raise ConnectionError("peer closed")
         got += r
@@ -243,13 +364,32 @@ def _read_len(sock):
     return n
 
 
-def _recv_frame(sock):
+def _recv_frame(sock, stats=None):
     body = _recv_exact(sock, _read_len(sock))
     (n_buf,) = _NBUF.unpack(_recv_exact(sock, _NBUF.size))
     if n_buf > 4096:
         raise ConnectionError("implausible buffer count %d" % n_buf)
-    lens = [_read_len(sock) for _ in range(n_buf)]
-    buffers = [_recv_exact(sock, n) for n in lens]
+    buffers, total = [], 0
+    if n_buf:
+        lens_raw = _recv_exact(sock, _LEN.size * n_buf)
+        lens = [_LEN.unpack_from(lens_raw, i * _LEN.size)[0]
+                for i in range(n_buf)]
+        total = sum(lens)
+        if any(n > _MAX_FRAME for n in lens) or total > _MAX_FRAME:
+            raise ConnectionError(
+                "oversized buffer length — protocol mismatch")
+        # one blob, one recv_into stream: every out-of-band buffer of
+        # the frame is a memoryview slice of it, so the payloads are
+        # reconstructed zero-copy straight out of the wire buffer
+        blob = memoryview(_recv_exact(sock, total))
+        off = 0
+        for n in lens:
+            buffers.append(blob[off:off + n])
+            off += n
+    if stats is not None:
+        stats.add("bytes_recv", _LEN.size + len(body) + _NBUF.size
+                  + _LEN.size * n_buf + total)
+        stats.add("frames_recv")
     return pickle.loads(body, buffers=buffers)
 
 
@@ -280,7 +420,12 @@ class _Handler(socketserver.BaseRequestHandler):
                 if not hmac.compare_digest(got, expected):
                     return
             while True:
-                msg = _recv_frame(self.request)
+                # every frame is (correlation id, command): requests of
+                # one connection pipeline — the worker streams the next
+                # frames while this one is being applied — and replies
+                # pair back to their waiters by cid. Apply order stays
+                # the arrival order (this loop is serial per conn).
+                cid, msg = _recv_frame(self.request)
                 op = msg[0]
                 key = msg[1] if len(msg) > 1 and \
                     isinstance(msg[1], (str, int)) else None
@@ -293,7 +438,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 reply = server._dispatch(msg)
                 _fault.fire("server.send", op=op, key=key,
                             sock=self.request, server=server)
-                _send_frame(self.request, reply)
+                _send_frame(self.request, (cid, reply))
                 if op == "stop":
                     break
         except (ConnectionError, EOFError, OSError):
@@ -393,6 +538,11 @@ class ParameterServer:
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, daemon=True)
         self._thread.start()
+        with _LOCAL_GUARD:
+            # same-process workers short-circuit the socket (a restarted
+            # server on a reused port re-registers, so the local path
+            # resumes after auto-respawn exactly like a reconnect)
+            _LOCAL_SERVERS[self.address] = self
         return self
 
     def stop(self):
@@ -402,6 +552,9 @@ class ParameterServer:
         the listener closes, hiding the death the fault tests and the
         launcher's respawn path both rely on)."""
         self._tcp.dying = True
+        with _LOCAL_GUARD:
+            if _LOCAL_SERVERS.get(self.address) is self:
+                del _LOCAL_SERVERS[self.address]
         if self._thread is not None:   # shutdown() waits on an event only
             self._tcp.shutdown()       # serve_forever sets — skip for a
         self._tcp.server_close()       # server that never start()ed
@@ -428,13 +581,25 @@ class ParameterServer:
         with self._locks_guard:
             return self._locks.setdefault(key, threading.Lock())
 
+    @staticmethod
+    def _as_table_value(value):
+        """Canonicalize an incoming init value to an owned, writable
+        numpy array (the table is plain numpy so the accumulate path can
+        add in place), with nd.array's float64/int64 narrowing kept."""
+        arr = _np.array(value, copy=True)
+        if arr.dtype == _np.float64:
+            arr = arr.astype(_np.float32)
+        elif arr.dtype == _np.int64:
+            arr = arr.astype(_np.int32)
+        return arr
+
     def _dispatch(self, msg):
         cmd = msg[0]
         if cmd == "init":
             _, key, value = msg
             with self._lock_for(key):
                 if key not in self._table:   # first writer wins (rank 0)
-                    self._table[key] = nd.array(value)
+                    self._table[key] = self._as_table_value(value)
                     self._clock[key] = 0
             return ("ok",)
         if cmd == "push":
@@ -460,14 +625,24 @@ class ParameterServer:
                 self._stale_max = max(self._stale_max, stale)
                 self._stale_sum += stale
                 self._stale_n += 1
-                g = nd.array(_wire_decode(grad))
+                g = _wire_decode(grad)
                 store = self._table[key]
                 if self._updater is not None:
-                    # async semantics: apply THIS push now, no merge wait
+                    # async semantics: apply THIS push now, no merge
+                    # wait. The updater math is device-side (mxtpu
+                    # optimizer), so bounce through NDArray and land the
+                    # result back as numpy (np.asarray of a CPU jax
+                    # buffer is zero-copy, and that buffer is immutable
+                    # — pulls may hand it out without a tear copy).
+                    w = nd.array(store)
                     with self._updater_lock:
-                        self._updater(_key_int(key), g, store)
+                        self._updater(_key_int(key), nd.array(g), w)
+                    self._table[key] = _np.asarray(w._data)
                 else:
-                    store._data = store._data + g._data
+                    # accumulate in place straight from the wire buffer:
+                    # no device asarray copy + dispatch per push — the
+                    # single biggest CPU cost of the old apply path
+                    _np.add(store, g, out=store, casting="unsafe")
                 self._clock[key] += 1
             self._push_count += 1
             if self._ckpt is not None and self._snapshot_every > 0 \
@@ -479,7 +654,13 @@ class ParameterServer:
             with self._lock_for(key):
                 if key not in self._table:
                     return ("err", "pull of uninitialized key %r" % (key,))
-                return ("ok", self._table[key].asnumpy(), self._clock[key])
+                tbl = self._table[key]
+                # the reply is pickled OUTSIDE this lock: hand out a
+                # stable copy where in-place accumulates could tear it.
+                # The updater path replaces entries wholesale (immutable
+                # once visible), so its pulls ship zero-copy.
+                value = tbl if self._updater is not None else tbl.copy()
+                return ("ok", value, self._clock[key])
         if cmd == "pull_rows":
             # sparse pull (reference kvstore_dist_server.h:631-792
             # DataHandleRowSparse): only the requested rows travel
@@ -487,8 +668,23 @@ class ParameterServer:
             with self._lock_for(key):
                 if key not in self._table:
                     return ("err", "pull of uninitialized key %r" % (key,))
-                rows = self._table[key].asnumpy()[row_ids]
+                rows = self._table[key][_np.asarray(row_ids)]
                 return ("ok", rows, self._clock[key])
+        if cmd == "multi":
+            # coalesced frame: one wire frame, many commands, replies in
+            # order. Each sub-command fires its own server.recv
+            # injection point so op=/key= fault rules still target
+            # individual pushes inside a batch; a sever mid-batch leaves
+            # a prefix applied, which the client's whole-batch replay +
+            # seq dedupe makes at-most-once.
+            replies = []
+            for sub in msg[1]:
+                _fault.fire("server.recv", op=sub[0],
+                            key=sub[1] if len(sub) > 1 and
+                            isinstance(sub[1], (str, int)) else None,
+                            server=self)
+                replies.append(self._dispatch(sub))
+            return ("ok", replies)
         if cmd == "set_optimizer":
             _, payload = msg
             self._install_optimizer(bytes(payload))
@@ -562,7 +758,7 @@ class ParameterServer:
             for key in list(self._table):
                 with self._lock_for(key):
                     params["t%d" % len(keys)] = \
-                        self._table[key].asnumpy().copy()
+                        _np.array(self._table[key], copy=True)
                     keys.append(self._tag_key(key))
                     clocks.append(int(self._clock[key]))
             meta = {"keys": keys, "clocks": clocks,
@@ -589,7 +785,9 @@ class ParameterServer:
         for i, (tagged, clock) in enumerate(zip(meta["keys"],
                                                 meta["clocks"])):
             key = self._untag_key(tagged)
-            self._table[key] = nd.array(tree["params"]["t%d" % i])
+            # owned writable copy: the accumulate path adds in place
+            self._table[key] = _np.array(tree["params"]["t%d" % i],
+                                         copy=True)
             self._clock[key] = int(clock)
         self._applied = {(o, self._untag_key(k)): int(s)
                          for o, k, s in meta.get("applied", [])}
@@ -649,25 +847,154 @@ _DEAD_AFTER = int(os.environ.get("MXTPU_PS_DEAD_AFTER", "3"))
 
 # every command whose replay is harmless: pull/pull_rows/stats/ping read,
 # init is first-writer-wins, set_optimizer re-installs the same payload,
-# and push dedupes via its (origin, seq) pair. barrier is NOT here — a
-# replayed arrival would double-count this worker in the generation.
+# push dedupes via its (origin, seq) pair, and multi only ever carries
+# the preceding commands. barrier is NOT here — a replayed arrival would
+# double-count this worker in the generation.
 _IDEMPOTENT = frozenset(
     ("init", "push", "pull", "pull_rows", "stats", "ping",
-     "set_optimizer"))
+     "set_optimizer", "multi"))
+
+
+class _Pending:
+    """One in-flight request on a channel."""
+
+    __slots__ = ("cid", "event", "reply", "error")
+
+    def __init__(self, cid):
+        self.cid = cid
+        self.event = threading.Event()
+        self.reply = None
+        self.error = None
+
+
+class _Channel:
+    """One pipelined socket to a server: frames go out under a send lock
+    stamped with correlation ids, a receiver thread pairs replies back
+    to their waiters, and a bounded window (``MXTPU_PS_WINDOW``) caps
+    how many requests ride unacknowledged. Any failure — socket error,
+    injected sever, a waiter's deadline — kills the whole channel:
+    every in-flight request fails with ConnectionError and the retry
+    layer above replays exactly the unacked window (the push seq dedupe
+    makes those replays at-most-once)."""
+
+    def __init__(self, conn, sock, window):
+        self._conn = conn
+        self._sock = sock
+        self._window = threading.Semaphore(window)
+        self._pending = {}         # cid -> _Pending
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._next_cid = itertools.count(1)
+        self.dead = False
+        self._err = None
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True,
+                                    name="mxtpu-ps-rx")
+        self._rx.start()
+
+    def inflight(self):
+        with self._lock:
+            return len(self._pending)
+
+    def submit(self, msg, timeout):
+        """Register a pending slot and send the frame; returns without
+        waiting for the reply — up to the window size of these stream
+        back to back on one socket."""
+        if not self._window.acquire(timeout=timeout):
+            raise ConnectionError(
+                "pipelined window stalled %.1fs on %s"
+                % (timeout, self._conn.addr))
+        p = _Pending(next(self._next_cid))
+        with self._lock:
+            if self.dead:
+                self._window.release()
+                raise ConnectionError("channel closed: %s" % (self._err,))
+            self._pending[p.cid] = p
+            self._conn._stats.hwm(len(self._pending))
+        try:
+            act = _fault.fire("worker.send", op=msg[0],
+                              key=msg[1] if len(msg) > 1 else None,
+                              sock=self._sock)
+            if act != "drop":      # dropped frame: the peer never sees
+                with self._send_lock:   # it; the waiter's deadline fires
+                    _send_frame(self._sock, (p.cid, msg),
+                                stats=self._conn._stats)
+        except BaseException as e:
+            self.fail(e)
+            raise
+        return p
+
+    def wait(self, p, msg, timeout):
+        try:
+            _fault.fire("worker.recv", op=msg[0],
+                        key=msg[1] if len(msg) > 1 else None,
+                        sock=self._sock)
+        except BaseException as e:
+            self.fail(e)
+            raise
+        if not p.event.wait(timeout):
+            # a silent reply (dropped frame, hung server) can only be
+            # noticed here; the stream position may be anywhere, so the
+            # whole channel dies and the window replays
+            self.fail(ConnectionError(
+                "no reply within %.1fs for %r from %s"
+                % (timeout, msg[0], self._conn.addr)))
+        if p.error is not None:
+            raise p.error
+        return p.reply
+
+    def _recv_loop(self):
+        while True:
+            try:
+                frame = _recv_frame(self._sock, stats=self._conn._stats)
+            except socket.timeout:
+                continue   # idle tick; waiters enforce their deadlines
+            except BaseException as e:
+                self.fail(e)
+                return
+            if not isinstance(frame, tuple) or len(frame) != 2:
+                self.fail(ConnectionError("unpaired reply frame"))
+                return
+            with self._lock:
+                p = self._pending.pop(frame[0], None)
+            if p is not None:
+                p.reply = frame[1]
+                p.event.set()
+                self._window.release()
+
+    def fail(self, err):
+        """Tear the channel down once: close the socket, fail every
+        pending waiter. Idempotent (the receiver, a failed submit and a
+        timed-out waiter may all race here)."""
+        with self._lock:
+            if self.dead:
+                return
+            self.dead = True
+            self._err = err
+            pend = list(self._pending.values())
+            self._pending.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for p in pend:
+            p.error = ConnectionError(
+                "connection to %s failed: %s: %s"
+                % (self._conn.addr, type(err).__name__, err))
+            p.event.set()
+            self._window.release()
 
 
 class _ServerConn:
-    """One worker's channel to one server: a small pool of sockets, each
-    serving one in-flight request/reply at a time. Thread-safe via a
-    free-index queue — callers block until any socket is idle.
-
-    Carries the retry/backoff RPC layer and this worker's health view of
-    the server: consecutive request/heartbeat failures past
-    ``MXTPU_PS_DEAD_AFTER`` mark it ``dead``; any success marks it
-    ``ok`` again."""
+    """One worker's view of one server: a set of pipelined channels
+    (``MXTPU_PS_CONNS`` sockets, each with a ``MXTPU_PS_WINDOW``-deep
+    in-flight window), the retry/backoff RPC layer, and this worker's
+    health bookkeeping for the server: consecutive request/heartbeat
+    failures past ``MXTPU_PS_DEAD_AFTER`` mark it ``dead``; any success
+    marks it ``ok`` again."""
 
     def __init__(self, addr, connect_timeout=60.0, token=None,
-                 n_socks=None, request_timeout=None, retries=None):
+                 n_socks=None, request_timeout=None, retries=None,
+                 stats=None, window=None):
         self.addr = addr
         self._host, _, port = addr.partition(":")
         self._port = int(port)
@@ -675,21 +1002,25 @@ class _ServerConn:
         self._timeout = _REQUEST_TIMEOUT if request_timeout is None \
             else float(request_timeout)
         self._retries = _RETRIES if retries is None else int(retries)
+        self._window_n = max(1, _WINDOW if window is None else int(window))
+        self._stats = stats if stats is not None else _CommStats()
         self.state = "ok"
         self.failures = 0          # consecutive failures
         self.last_error = None
         self._health_lock = threading.Lock()
         n_socks = max(1, n_socks if n_socks is not None
                       else _CONNS_PER_SERVER)
-        # the launcher starts servers and workers simultaneously and a
-        # server binds only after its (slow) mxtpu import + updater
-        # warm-up — on localhost an unbound port refuses instantly, so
-        # retry with backoff instead of failing the whole launch
-        deadline = time.time() + connect_timeout
-        self._socks = [self._connect(deadline) for _ in range(n_socks)]
-        self._free = _queue.SimpleQueue()
-        for i in range(n_socks):
-            self._free.put(i)
+        self._channels = [None] * n_socks
+        self._ch_locks = [threading.Lock() for _ in range(n_socks)]
+        self._rr = itertools.count()
+        # eager first connect: the launcher starts servers and workers
+        # simultaneously and a server binds only after its (slow) mxtpu
+        # import + updater warm-up — on localhost an unbound port
+        # refuses instantly, so retry with backoff instead of failing
+        # the whole launch. Extra channels connect lazily.
+        self._channels[0] = _Channel(
+            self, self._connect(time.time() + connect_timeout),
+            self._window_n)
 
     def _connect(self, deadline):
         delay = 0.1
@@ -710,7 +1041,23 @@ class _ServerConn:
 
     @property
     def n_socks(self):
-        return len(self._socks)
+        return len(self._channels)
+
+    def _channel(self, i=None):
+        """The channel for slot ``i`` (round-robin when unspecified),
+        lazily (re)connected — a failed channel is never reused, its
+        replacement gets a fresh socket (a stale reply must not
+        mispair even across reconnects: cids are per-channel)."""
+        if i is None:
+            i = next(self._rr) % len(self._channels)
+        with self._ch_locks[i]:
+            ch = self._channels[i]
+            if ch is None or ch.dead:
+                ch = _Channel(
+                    self, self._connect(time.time() + _RECONNECT_TIMEOUT),
+                    self._window_n)
+                self._channels[i] = ch
+            return ch
 
     # -- health bookkeeping ----------------------------------------------
     def _note_ok(self):
@@ -740,6 +1087,41 @@ class _ServerConn:
                     "failures": self.failures,
                     "last_error": self.last_error}
 
+    # -- the same-process shortcut ---------------------------------------
+    def _local_srv(self):
+        """The in-process ParameterServer behind this address, if any.
+        Its requests skip socket and pickle entirely: zero copies, one
+        direct ``_dispatch`` under the same per-key locks, seq dedupe
+        and fault-injection points as a wire request — so the whole
+        fault matrix holds on this transport too (``MXTPU_PS_LOCAL=0``
+        forces the wire; the matrix tests pin it off)."""
+        if not _LOCAL_ON:
+            return None
+        return _LOCAL_SERVERS.get(self.addr)
+
+    def _local_call(self, srv, msg, timeout):
+        op = msg[0]
+        key = msg[1] if len(msg) > 1 and isinstance(msg[1], (str, int)) \
+            else None
+        if srv._tcp.dying:
+            raise ConnectionError(
+                "in-process server %s is down" % self.addr)
+        dropped = _fault.fire("worker.send", op=op, key=key) == "drop"
+        if not dropped:
+            _fault.fire("server.recv", op=op, key=key, server=srv)
+            reply = srv._dispatch(msg)
+            if _fault.fire("server.send", op=op, key=key,
+                           server=srv) != "drop":
+                _fault.fire("worker.recv", op=op, key=key)
+                self._stats.add("local_reqs")
+                return reply
+        # a dropped request/reply frame is silent on the wire too:
+        # only the per-call deadline notices, then the retry layer runs
+        time.sleep(timeout)
+        raise ConnectionError(
+            "no reply within %.1fs for %r from %s"
+            % (timeout, op, self.addr))
+
     # -- the RPC layer ---------------------------------------------------
     def _backoff_delay(self, attempt):
         # bounded exponential backoff with DETERMINISTIC per-server
@@ -749,43 +1131,10 @@ class _ServerConn:
         j = zlib.crc32(("%s:%d" % (self.addr, attempt)).encode()) % 256
         return base * (1.0 + j / 1024.0)
 
-    def _request_once(self, msg, timeout):
-        i = self._free.get()
-        try:
-            if self._socks[i] is None:
-                # previous failure closed this slot: reconnect lazily,
-                # bounded so a dead server fails fast instead of hanging
-                self._socks[i] = self._connect(
-                    time.time() + _RECONNECT_TIMEOUT)
-            sock = self._socks[i]
-            sock.settimeout(timeout)
-            act = _fault.fire("worker.send", op=msg[0],
-                              key=msg[1] if len(msg) > 1 else None,
-                              sock=sock)
-            if act != "drop":      # a dropped frame: peer never sees it,
-                _send_frame(sock, msg)  # we still wait for the timeout
-            _fault.fire("worker.recv", op=msg[0],
-                        key=msg[1] if len(msg) > 1 else None, sock=sock)
-            reply = _recv_frame(sock)
-        except BaseException:
-            # ANY mid-conversation failure (timeout included) may leave
-            # a stale reply in flight — never reuse that socket: close
-            # it and leave the slot empty for a lazy reconnect.
-            s, self._socks[i] = self._socks[i], None
-            if s is not None:
-                try:
-                    s.close()
-                except OSError:
-                    pass
-            self._free.put(i)
-            raise
-        self._free.put(i)
-        return reply
-
     def request(self, *msg, **kw):
         """Send one command and return its reply, retrying idempotent
         commands through connection faults with bounded exponential
-        backoff. ``timeout=`` overrides the per-call socket timeout
+        backoff. ``timeout=`` overrides the per-call reply deadline
         (heartbeats probe with a short one)."""
         timeout = kw.pop("timeout", None)
         retries = kw.pop("retries", None)
@@ -795,13 +1144,19 @@ class _ServerConn:
             retries = self._retries if msg[0] in _IDEMPOTENT else 0
         last = None
         for attempt in range(retries + 1):
+            if attempt:
+                self._stats.add("retransmits")
+                time.sleep(self._backoff_delay(attempt - 1))
             try:
-                reply = self._request_once(msg, timeout)
+                srv = self._local_srv()
+                if srv is not None:
+                    reply = self._local_call(srv, msg, timeout)
+                else:
+                    ch = self._channel()
+                    reply = ch.wait(ch.submit(msg, timeout), msg, timeout)
             except (ConnectionError, EOFError, OSError) as e:
                 last = e
                 self._note_failure(e)
-                if attempt < retries:
-                    time.sleep(self._backoff_delay(attempt))
                 continue
             self._note_ok()
             if reply[0] == "err":
@@ -816,16 +1171,74 @@ class _ServerConn:
             "MXTPU_PS_TOKEN does not match between this worker and the "
             "server)" % (self.addr, msg[0], retries + 1, last)) from last
 
+    def request_all(self, msgs, timeout=None, return_exceptions=False):
+        """Pipelined fan-out: submit every message before waiting for
+        any reply, so k parts cost one streamed pass instead of k
+        request-reply round trips. Replies come back in ``msgs`` order.
+        A message whose pipelined pass fails is retried through the
+        backoff :meth:`request` path (callers pass only idempotent
+        commands; push replays are deduped server-side). With
+        ``return_exceptions`` a message's terminal ConnectionError /
+        err-reply RuntimeError lands in its result slot instead of
+        raising, so push callers can buffer individual parts."""
+        timeout = self._timeout if timeout is None else timeout
+        if self._local_srv() is not None:
+            # same-process dispatch is synchronous — there is no RTT to
+            # pipeline away, so each message just runs the retrying
+            # request path in order
+            out = []
+            for m in msgs:
+                try:
+                    out.append(self.request(*m, timeout=timeout))
+                except (ConnectionError, RuntimeError) as e:
+                    if not return_exceptions:
+                        raise
+                    out.append(e)
+            return out
+        calls = []
+        for m in msgs:
+            try:
+                ch = self._channel()
+                calls.append((ch.submit(m, timeout), ch))
+            except (ConnectionError, EOFError, OSError) as e:
+                self._note_failure(e)
+                calls.append(None)
+        out = []
+        for m, c in zip(msgs, calls):
+            reply = None
+            if c is not None:
+                try:
+                    reply = c[1].wait(c[0], m, timeout)
+                except (ConnectionError, EOFError, OSError) as e:
+                    self._note_failure(e)
+            if reply is None:
+                self._stats.add("retransmits")   # replay of this msg
+                try:
+                    reply = self.request(*m, timeout=timeout)
+                except (ConnectionError, RuntimeError) as e:
+                    if not return_exceptions:
+                        raise
+                    reply = e
+            elif reply[0] == "err":
+                err = RuntimeError("parameter server: %s" % reply[1])
+                if not return_exceptions:
+                    raise err
+                reply = err
+            else:
+                self._note_ok()
+            out.append(reply)
+        return out
+
     def ping(self, timeout=2.0):
-        """One heartbeat probe: no retries, short timeout. When every
-        socket is busy serving real traffic the server is considered
-        alive by definition (it is answering us right now), so the probe
-        never steals a pool slot from a real transfer."""
-        try:
-            i = self._free.get_nowait()
-        except _queue.Empty:
-            return True
-        self._free.put(i)
+        """One heartbeat probe: no retries, short timeout. The probe
+        rides its own correlation id on the pipelined channel, so it can
+        never interleave with — or steal the socket from — an in-flight
+        transfer (the old pool-slot re-acquisition race); when traffic
+        is already in flight the server is alive by definition and no
+        probe is sent at all."""
+        for ch in self._channels:
+            if ch is not None and not ch.dead and ch.inflight():
+                return True
         try:
             self.request("ping", timeout=timeout, retries=0)
             return True
@@ -833,13 +1246,9 @@ class _ServerConn:
             return False
 
     def close(self):
-        for s in self._socks:
-            if s is None:
-                continue
-            try:
-                s.close()
-            except OSError:
-                pass
+        for ch in self._channels:
+            if ch is not None:
+                ch.fail(ConnectionError("store closed"))
 
 
 class AsyncDistKVStore(KVStore):
@@ -861,7 +1270,9 @@ class AsyncDistKVStore(KVStore):
             # runnable (and truly async across threads) without a launcher
             self._own_server = ParameterServer(token=token).start()
             addrs = self._own_server.address
-        self._conns = [_ServerConn(a.strip(), token=token)
+        self._stats = _CommStats()
+        self._conns = [_ServerConn(a.strip(), token=token,
+                                   stats=self._stats)
                        for a in addrs.split(",") if a.strip()]
         self._base_clock = {}      # subkey -> clock of the last pull
         self._parts = {}           # key -> [(subkey, row_lo, row_hi), ...]
@@ -870,7 +1281,6 @@ class AsyncDistKVStore(KVStore):
         # unique push origin: rank alone is not unique (tests run many
         # stores per process); the server dedupes replays per (origin,key)
         self._origin = "%d-%s" % (self._rank, uuid.uuid4().hex[:8])
-        import itertools
         self._seq = itertools.count(1)   # next() is GIL-atomic
         self._pull_cache_on = os.environ.get(
             "MXTPU_PS_PULL_CACHE", "1") != "0"
@@ -937,12 +1347,17 @@ class AsyncDistKVStore(KVStore):
 
     def _pmap(self, calls):
         """Run request thunks concurrently on the pool; surface the first
-        failure. Ordering across parts is free — they are distinct keys.
-        The common single-part case runs inline: a pool handoff buys
-        nothing there and would tax every small parameter on the hot
-        training path."""
+        failure. Ordering across thunks is free — they target distinct
+        servers/keys. The common single-thunk case runs inline: a pool
+        handoff buys nothing there and would tax every small parameter
+        on the hot training path. On a pool thread (push_async path)
+        run serially instead of nesting submits — a saturated pool
+        waiting on its own queue would deadlock, and the pipelined
+        channels keep the wire busy regardless."""
         if len(calls) == 1:
             return [calls[0]()]
+        if threading.current_thread().name.startswith("mxtpu-ps"):
+            return [c() for c in calls]
         futs = [self._pool.submit(c) for c in calls]
         return [f.result() for f in futs]
 
@@ -969,6 +1384,7 @@ class AsyncDistKVStore(KVStore):
 
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
+        per_conn = {}          # conn -> {"small": [entries], "big": [..]}
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
                 merged = v[0].copy()
@@ -977,30 +1393,70 @@ class AsyncDistKVStore(KVStore):
             else:
                 merged = v
             arr = merged.asnumpy()
-            self._pmap([
-                (lambda sk=sk, lo=lo, hi=hi:
-                 self._push_part(
-                     sk, self._wire_payload(sk, _slice_part(arr, lo, hi)),
-                     self._base_clock.get(sk, 0)))
-                for sk, lo, hi in self._plan(k, merged.shape)])
+            for sk, lo, hi in self._plan(k, merged.shape):
+                payload = self._wire_payload(sk, _slice_part(arr, lo, hi))
+                nbytes = payload.nbytes if isinstance(payload, _np.ndarray) \
+                    else payload[2].nbytes
+                entry = (sk, payload, self._base_clock.get(sk, 0),
+                         next(self._seq))
+                lanes = per_conn.setdefault(
+                    self._conn(sk), {"small": [], "big": []})
+                lanes["small" if nbytes <= _COALESCE_BYTES
+                      else "big"].append(entry)
+        self._pmap([(lambda c=c, l=l: self._push_conn(c, l))
+                    for c, l in per_conn.items()])
 
-    def _push_part(self, sk, payload, base_clock):
-        """One part's push: seq-stamped for at-most-once replay; a push
-        whose shard is dead (or dies despite retries) is buffered —
-        original seq and all — and replayed by the heartbeat when the
-        server returns. Ordering across a buffer flush is relaxed, which
-        async mode already tolerates (a buffered push is just a very
-        stale push); at-most-once is NOT relaxed."""
-        conn = self._conn(sk)
-        seq = next(self._seq)
+    def _push_conn(self, conn, lanes):
+        """Everything one push() call sends to one server: big parts as
+        individual pipelined requests, small parts coalesced into
+        multi-key frames. Each part is seq-stamped for at-most-once
+        replay; a part whose shard is dead (or whose request fails
+        despite retries) is buffered — original seq and all — and
+        replayed by the heartbeat when the server returns. Ordering
+        across a buffer flush is relaxed, which async mode already
+        tolerates (a buffered push is just a very stale push);
+        at-most-once is NOT relaxed."""
+        small = lanes["small"]
+        if len(small) == 1:        # a lone small part gains nothing
+            lanes["big"] += small  # from the multi wrapper
+            small = []
+        msgs, groups = [], []
+        for i in range(0, len(small), _COALESCE_MAX):
+            chunk = small[i:i + _COALESCE_MAX]
+            msgs.append(("multi",
+                         [("push", sk, payload, clock, self._origin, seq)
+                          for sk, payload, clock, seq in chunk]))
+            groups.append((True, chunk))
+            self._stats.add("coalesced_frames")
+            self._stats.add("coalesced_subs", len(chunk))
+        for entry in lanes["big"]:
+            sk, payload, clock, seq = entry
+            msgs.append(("push", sk, payload, clock, self._origin, seq))
+            groups.append((False, [entry]))
         if conn.state == "dead":
-            self._buffer_push(conn, sk, payload, base_clock, seq)
+            for _, chunk in groups:
+                for entry in chunk:
+                    self._buffer_push(conn, *entry)
             return
-        try:
-            conn.request("push", sk, payload, base_clock,
-                         self._origin, seq)
-        except ConnectionError:
-            self._buffer_push(conn, sk, payload, base_clock, seq)
+        replies = conn.request_all(msgs, return_exceptions=True)
+        for (is_multi, chunk), reply in zip(groups, replies):
+            if isinstance(reply, ConnectionError):
+                for entry in chunk:
+                    self._buffer_push(conn, *entry)
+            elif isinstance(reply, Exception):
+                raise reply
+            elif is_multi:         # surface the first sub-error
+                for sub in reply[1]:
+                    if sub[0] == "err":
+                        raise RuntimeError(
+                            "parameter server: %s" % sub[1])
+
+    def push_async(self, key, value, priority=0):
+        """Fire-and-track push: ships on the worker pool and returns a
+        concurrent.futures.Future, so the caller's compute overlaps the
+        wire (the ShardedTrainer gradient-push hook rides this).
+        Failures surface at ``.result()``."""
+        return self._pool.submit(self.push, key, value, priority)
 
     def _buffer_push(self, conn, sk, payload, base_clock, seq):
         with self._pending_lock:
@@ -1015,61 +1471,117 @@ class AsyncDistKVStore(KVStore):
     def _wire_payload(self, subkey, part):
         """Dense part, or its 2-bit packed form when compression is on
         (per-part error-feedback residual lives worker-side, as the
-        reference's compressed push does)."""
+        reference's compressed push does). Compressed payloads ride the
+        coalesced frames like any other — GradientCompression takes the
+        numpy part directly and quantizes small parts without a device
+        round trip."""
         if self._compression is None:
             return part
-        import jax.numpy as jnp
-        packed = self._compression.compress(subkey, jnp.asarray(part))
+        packed = self._compression.compress(subkey, part)
         return (_GC_MARK, self._compression.threshold,
                 _np.asarray(packed), part.shape)
 
-    def _pull_part(self, sk):
-        """One part's pull, with graceful degradation: when the shard is
-        unreachable despite retries, the last value this worker pulled
-        is served instead of raising — the key stays staleness-marked in
-        ``degraded_keys()``/``health()`` until a live pull lands, while
-        the heartbeat keeps probing the server in the background."""
-        conn = self._conn(sk)
-        try:
-            reply = conn.request("pull", sk)
-        except (ConnectionError, RuntimeError) as e:
-            # ConnectionError: shard unreachable despite retries.
-            # RuntimeError("uninitialized"): shard is back but restarted
-            # WITHOUT its state (no snapshot) — same degradation: the
-            # worker knew this key, so serve its last-known value.
-            # Any other server error is a real bug and surfaces.
-            if isinstance(e, RuntimeError) \
-                    and "uninitialized" not in str(e):
-                raise
-            cached = self._pull_cache.get(sk) \
-                if self._pull_cache_on else None
-            if cached is None:
-                raise
-            with self._degraded_lock:
-                self._degraded.add(sk)
-            return (sk, cached[0], cached[1])
-        value, clock = reply[1], reply[2]
+    def _degraded_value(self, sk, err):
+        """Graceful-degradation policy for a failed part pull: a shard
+        unreachable despite retries (ConnectionError), or back but
+        restarted WITHOUT its state (RuntimeError "uninitialized"),
+        serves the worker's last-pulled value — the key stays
+        staleness-marked in ``degraded_keys()``/``health()`` until a
+        live pull lands. Any other server error is a real bug and
+        surfaces."""
+        if isinstance(err, RuntimeError) and "uninitialized" not in str(err):
+            raise err
+        cached = self._pull_cache.get(sk) if self._pull_cache_on else None
+        if cached is None:
+            raise err
+        with self._degraded_lock:
+            self._degraded.add(sk)
+        return (cached[0], cached[1])
+
+    def _note_pulled(self, sk, value, clock):
         if self._pull_cache_on:
             self._pull_cache[sk] = (value, clock)
         with self._degraded_lock:
             self._degraded.discard(sk)
-        return (sk, value, clock)
+        return (value, clock)
+
+    def _part_nbytes(self, k, lo, hi):
+        """Wire-size estimate for a part (assumes 4-byte elements — a
+        coalescing heuristic, not an invariant)."""
+        shape = self._shapes.get(k) or ()
+        if not shape:
+            return 4
+        per_row = 4
+        for d in shape[1:]:
+            per_row *= int(d)
+        return max(1, hi - lo) * per_row
+
+    def _pull_conn(self, conn, lanes):
+        """Everything one pull() call fetches from one server — small
+        parts coalesced, big parts individually pipelined. Returns
+        ``{subkey: (value, clock)}`` with per-part degradation."""
+        small = lanes["small"]
+        if len(small) == 1:
+            lanes["big"] += small
+            small = []
+        msgs, groups = [], []
+        for i in range(0, len(small), _COALESCE_MAX):
+            chunk = small[i:i + _COALESCE_MAX]
+            msgs.append(("multi", [("pull", sk) for sk in chunk]))
+            groups.append((True, chunk))
+            self._stats.add("coalesced_frames")
+            self._stats.add("coalesced_subs", len(chunk))
+        for sk in lanes["big"]:
+            msgs.append(("pull", sk))
+            groups.append((False, [sk]))
+        out = {}
+        replies = conn.request_all(msgs, return_exceptions=True)
+        for (is_multi, chunk), reply in zip(groups, replies):
+            if isinstance(reply, Exception):
+                for sk in chunk:
+                    out[sk] = self._degraded_value(sk, reply)
+                continue
+            subs = reply[1] if is_multi else [reply]
+            for sk, sub in zip(chunk, subs):
+                if sub[0] == "err":
+                    out[sk] = self._degraded_value(
+                        sk, RuntimeError("parameter server: %s" % sub[1]))
+                else:
+                    out[sk] = self._note_pulled(sk, sub[1], sub[2])
+        return out
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
+        plans = []
+        per_conn = {}
         for k, o in zip(keys, outs):
             tgt0 = o[0] if isinstance(o, (list, tuple)) else o
             plan = self._plan(k, tgt0.shape)
-            replies = self._pmap([
-                (lambda sk=sk: self._pull_part(sk))
-                for sk, _, _ in plan])
+            plans.append((k, o, plan))
+            for sk, lo, hi in plan:
+                lanes = per_conn.setdefault(
+                    self._conn(sk), {"small": [], "big": []})
+                lanes["small" if self._part_nbytes(k, lo, hi)
+                      <= _COALESCE_BYTES else "big"].append(sk)
+        results = {}
+        for got in self._pmap([(lambda c=c, l=l: self._pull_conn(c, l))
+                               for c, l in per_conn.items()]):
+            results.update(got)
+        for k, o, plan in plans:
             pieces = []
-            for sk, value, clock in replies:
+            for sk, _, _ in plan:
+                value, clock = results[sk]
                 self._base_clock[sk] = clock
                 pieces.append(value)
-            full = pieces[0] if len(pieces) == 1 \
-                else _np.concatenate(pieces, axis=0)
+            if len(pieces) == 1:
+                full = pieces[0]
+            else:
+                # assemble into one preallocated buffer: a single copy
+                # instead of concatenate-then-asarray's two passes
+                full = _np.empty(self._shapes[k], dtype=pieces[0].dtype)
+                for (sk, lo, hi), piece in zip(plan, pieces):
+                    full[lo:hi] = piece
             arr = nd.array(full)
             for tgt in (o if isinstance(o, (list, tuple)) else [o]):
                 tgt._data = arr._data
@@ -1238,6 +1750,31 @@ class AsyncDistKVStore(KVStore):
         """Reference KVStore::get_num_dead_node via the heartbeat health
         state: how many of this worker's servers are currently dead."""
         return self.health()["num_dead"]
+
+    def stats(self):
+        """Comms counters for this store's fast path: wire bytes/frames
+        both ways, coalescing (frames and sub-commands), the pipelined
+        in-flight high-water mark and retransmits — plus the push
+        dedupe/staleness counts of every *reachable* server (dead
+        shards are skipped, not waited on). ``retransmits`` > 0 with
+        ``dup_pushes`` covering the replays is the observable
+        at-most-once evidence under injected severs."""
+        s = self._stats.snapshot()
+        with self._pending_lock:
+            s["pending_pushes"] = sum(len(v)
+                                      for v in self._pending.values())
+        s["dup_pushes"] = 0
+        s["server_pushes"] = 0
+        for c in self._conns:
+            if c.state == "dead":
+                continue
+            try:
+                _, srv = c.request("stats", retries=0)
+            except (ConnectionError, RuntimeError, OSError):
+                continue
+            s["dup_pushes"] += srv.get("dup_pushes", 0)
+            s["server_pushes"] += srv.get("pushes", 0)
+        return s
 
     def staleness_stats(self):
         """Aggregated staleness evidence from every server: max/avg

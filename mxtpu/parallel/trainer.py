@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import copy
 import os
+from collections import deque as _deque
 
 import numpy as _np
 
@@ -300,6 +301,14 @@ class ShardedTrainer:
         self._placed = False
         self._key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
         self._num_update = 0
+        # async gradient-push hook (set_grad_push/attach_kvstore): when
+        # set, every jitted step also returns its gradients and the hook
+        # ships them off-thread — the NEXT step's compute overlaps the
+        # previous step's KVStore push. _push_inflight is the
+        # backpressure window of outstanding push futures.
+        self._grad_push = None
+        self._push_max = 2
+        self._push_inflight = _deque()
         # on-device step state, materialized at first step_async
         self._key_dev = None
         self._t_dev = None
@@ -428,6 +437,11 @@ class ShardedTrainer:
             forward_loss, enabled=self._remat, static_argnums=(5,),
             policy=self._remat_policy)
 
+        # when a gradient-push hook is registered the step also returns
+        # its (f32, pre-constraint) gradients so the hook can ship them;
+        # baked in at build time — set_grad_push drops cached train fns
+        want_grads = self._grad_push is not None
+
         def train_step(train_vals, states, aux_vals, inputs, label, key,
                        t, lr):
             # rng, step count and lr live on device and are carried through
@@ -474,8 +488,9 @@ class ShardedTrainer:
                 jax.lax.with_sharding_constraint(v, s)
                 for v, s in zip(new_vals,
                                 [self._shardings[i] for i in train_idx])]
-            return tuple(new_vals), tuple(new_states), tuple(aux_new), \
-                loss_val, outs, key, t
+            out = (tuple(new_vals), tuple(new_states), tuple(aux_new),
+                   loss_val, outs, key, t)
+            return out + (tuple(grads),) if want_grads else out
 
         def eval_step(train_vals, aux_vals, inputs, label, key):
             loss_val, (aux_new, outs) = forward_loss(
@@ -505,12 +520,14 @@ class ShardedTrainer:
                     # AUTO only on the persistent state (in AND out, so
                     # the chosen layouts agree with donation aliasing);
                     # batches/key/t/lr keep caller-visible defaults
+                    outs_sh = (auto, auto, auto, None, None, None, None)
+                    if want_grads:
+                        outs_sh += (None,)
                     jitted = jax.jit(
                         train_step,
                         in_shardings=(auto, auto, auto, None, None,
                                       None, None, None),
-                        out_shardings=(auto, auto, auto, None, None,
-                                       None, None),
+                        out_shardings=outs_sh,
                         donate_argnums=donate)
                     return _AutoLayoutStep(jitted, mesh)
                 return jax.jit(train_step, donate_argnums=donate)
@@ -567,15 +584,18 @@ class ShardedTrainer:
             self._lr_host = new_lr
             lr = jax.device_put(_np.asarray(new_lr, _np.float32),
                                 self._mesh.replicated())
-        (new_vals, new_states, aux_new, loss_val, outs, new_key,
-         new_t) = self._step_fns[skey](
+        res = self._step_fns[skey](
             tuple(self._param_vals), tuple(self._opt_states),
             tuple(self._aux_vals), tuple(inputs), label_j, key, t, lr)
+        (new_vals, new_states, aux_new, loss_val, outs, new_key,
+         new_t) = res[:7]
         self._param_vals = list(new_vals)
         self._opt_states = list(new_states)
         self._aux_vals = list(aux_new)
         self._last_outputs = outs
         self._key_dev, self._t_dev, self._lr_dev = new_key, new_t, lr
+        if len(res) > 7:               # gradient-push hook registered
+            self._dispatch_grad_push(res[7])
         return NDArray(loss_val)
 
     def step(self, data, label):
@@ -622,6 +642,63 @@ class ShardedTrainer:
             tuple(inputs), label_j, key)
         return float(loss_val), [NDArray(o) for o in outs]
 
+    # -- async gradient push -----------------------------------------------
+    def set_grad_push(self, push_fn, max_inflight=2):
+        """Register an asynchronous gradient-push hook.
+
+        After every :meth:`step_async`, ``push_fn({name: grad, ...})`` is
+        called with the step's per-parameter gradients (f32 NDArrays).
+        If it returns a future (anything with ``.result()``) the trainer
+        tracks it: at most ``max_inflight`` pushes ride outstanding, so
+        the NEXT step's compute overlaps the previous step's push while a
+        stalled sink applies backpressure instead of piling up memory.
+        Failures surface at the backpressure drain or at
+        :meth:`flush_grad_pushes` / :meth:`sync_params`.
+
+        ``push_fn=None`` unregisters (after draining)."""
+        self.flush_grad_pushes()
+        self._grad_push = push_fn
+        self._push_max = max(1, int(max_inflight))
+        # cached train fns were built without the grads output
+        self._step_fns = {k: v for k, v in self._step_fns.items()
+                          if k[0] != "train"}
+
+    def attach_kvstore(self, kv, max_inflight=2):
+        """Wire gradient pushes to a (dist_async) KVStore: every step's
+        gradients ship via ``kv.push_async`` on the store's worker pool
+        — compute overlaps the wire end-to-end, small parameters ride
+        the store's coalesced frames. Keys (parameter names) are lazily
+        ``kv.init``-ed with zeros on first push."""
+        inited = set()
+
+        def _push(grads):
+            new = [n for n in grads if n not in inited]
+            if new:
+                kv.init(new, [NDArray(jnp.zeros_like(grads[n]._data))
+                              for n in new])
+                inited.update(new)
+            keys = list(grads)
+            return kv.push_async(keys, [grads[k] for k in keys])
+
+        self.set_grad_push(_push, max_inflight=max_inflight)
+
+    def _dispatch_grad_push(self, grads):
+        names = [self._params[i].name for i in self._train_idx]
+        # drain to under the window BEFORE shipping: a slow sink blocks
+        # here (backpressure), never accumulates unbounded futures
+        while len(self._push_inflight) >= self._push_max:
+            self._push_inflight.popleft().result()
+        fut = self._grad_push(
+            {n: NDArray(g) for n, g in zip(names, grads)})
+        if fut is not None and hasattr(fut, "result"):
+            self._push_inflight.append(fut)
+
+    def flush_grad_pushes(self):
+        """Block until every outstanding gradient push has landed,
+        surfacing the first failure."""
+        while self._push_inflight:
+            self._push_inflight.popleft().result()
+
     def _host_lr(self):
         o = self._optimizer
         if o.lr_scheduler is not None:
@@ -639,6 +716,7 @@ class ShardedTrainer:
         """Copy mesh-sharded values back into the block's Parameters so
         save_params / export / eager inference see the trained weights
         (the kv.pull-at-checkpoint equivalent)."""
+        self.flush_grad_pushes()   # pushed state must not trail params
         if not self._placed:
             return
         for v, i in zip(self._param_vals, self._train_idx):

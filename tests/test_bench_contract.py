@@ -65,3 +65,33 @@ def test_attach_best_tpu_measurement(tmp_path, monkeypatch):
                         lambda p: str(tmp_path / "nowhere"))
     bench._attach_best_tpu_measurement(result2)
     assert "best_tpu_measured" not in result2
+
+
+def test_kvstore_bench_contract(tmp_path):
+    """tools/bench_kvstore.py: exactly one JSON line, rc 0, with the
+    fields the perf trajectory (docs/perf_analysis.md "Comms fast
+    path") is tracked by — on a fault-free tiny loopback run."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT,
+               MXTPU_PS_HEARTBEAT="0")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_kvstore.py"),
+         "--mb", "2", "--small-keys", "16", "--iters", "2", "--no-write"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, "must print exactly ONE JSON line"
+    payload = json.loads(lines[0])
+    assert payload["bench"] == "kvstore_loopback"
+    assert payload["transport"] in ("local", "tcp")
+    for field in ("payload_mb", "push_mb_s", "pull_mb_s",
+                  "small_push_ops_s", "small_pull_ops_s", "n_parts",
+                  "window", "iters"):
+        assert isinstance(payload[field], (int, float)), field
+    for lat in (payload["push"], payload["pull"]):
+        assert lat["p50_ms"] > 0 and lat["p99_ms"] >= lat["p50_ms"]
+    # both transports always reported: local headline + tcp sub-object
+    assert isinstance(payload["tcp"]["push_mb_s"], (int, float))
+    # comms counters rode along (the fault-free run retransmits nothing)
+    assert payload["wire"]["retransmits"] == 0
+    assert payload["wire"]["bytes_sent"] > 0
+    assert payload["wire"]["coalesced_subs"] >= 16

@@ -41,6 +41,10 @@ def _fast_failure_knobs(monkeypatch):
     monkeypatch.setattr(ka, "_RECONNECT_TIMEOUT", 0.2)
     monkeypatch.setattr(ka, "_DEAD_AFTER", 2)
     monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0")
+    # the matrix is about the WIRE: pin the same-process shortcut off so
+    # every row exercises real framing (the local-transport rows below
+    # flip it back on explicitly)
+    monkeypatch.setattr(ka, "_LOCAL_ON", False)
     fault.uninstall()
     yield
     fault.uninstall()
@@ -338,7 +342,7 @@ def test_killed_server_restores_snapshot_and_reconverges(monkeypatch,
             assert srv2._restored_step is not None
             assert srv2._updater is not None, \
                 "optimizer must ride the snapshot"
-            np.testing.assert_allclose(srv2._table["w"].asnumpy(),
+            np.testing.assert_allclose(srv2._table["w"],  # numpy table
                                        -0.5 * np.ones(4))
             assert srv2._clock["w"] == 1
 
@@ -421,7 +425,7 @@ def test_snapshot_roundtrip_preserves_key_types(tmp_path):
         assert set(srv2._table) == {7, "name", "big\x001"}
         assert srv2._clock == {7: 0, "name": 0, "big\x001": 1}
         assert srv2._applied == {("o1", "big\x001"): 5}
-        np.testing.assert_allclose(srv2._table[7].asnumpy(),
+        np.testing.assert_allclose(srv2._table[7],        # numpy table
                                    np.arange(3, dtype="f"))
     finally:
         srv2.stop()
@@ -432,3 +436,169 @@ def test_local_store_health_is_trivially_ok():
     h = kv.health()
     assert h["num_dead"] == 0 and h["servers"] == []
     assert kv.get_num_dead_node() == 0
+
+
+# ---------------------------------------------------------------------------
+# pipelined-window rows (ISSUE 2): the fast path must keep every fault
+# semantic above while many requests ride one socket unacknowledged
+# ---------------------------------------------------------------------------
+
+def _eight_part_push(monkeypatch):
+    """Shrink the bigarray bound so an (8, 4) array splits into 8
+    one-row parts — all of which stream back-to-back inside one
+    MXTPU_PS_WINDOW=8 window on the single socket. Coalescing is
+    pinned off so each part is its own pipelined frame (op=push on the
+    wire), which is what these rows are about."""
+    monkeypatch.setattr(ka, "_BIGARRAY_BOUND", 4)
+    monkeypatch.setattr(ka, "_COALESCE_BYTES", 0)
+
+
+def test_window_sever_mid_window_at_most_once(monkeypatch):
+    """Sever the connection after the server applied part 3 of an
+    8-part pipelined push but before its ack: the whole unacked window
+    fails onto the retry layer; the replay of the applied part is
+    deduped, the never-dispatched tail applies first-time — the table
+    holds each part EXACTLY once and stats() shows the evidence
+    (retransmits worker-side, dup_pushes server-side)."""
+    _eight_part_push(monkeypatch)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((8, 4)))
+        with fault.inject("kind=sever,point=server.send,op=push,nth=3") \
+                as inj:
+            kv.push("w", mx.nd.ones((8, 4)))
+        assert inj.stats()[0][4] == 1
+        out = mx.nd.zeros((8, 4))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones((8, 4)))
+        assert all(srv._clock["w\x00%d" % i] == 1 for i in range(8))
+        assert srv._dup_n >= 1                 # the applied part replayed
+        s = kv.stats()
+        assert s["retransmits"] >= 1           # window replay happened
+        assert s["dup_pushes"] >= 1            # ...and was deduped
+        assert s["inflight_hwm"] >= 2          # requests really pipelined
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_window_truncate_mid_window(monkeypatch):
+    """A torn frame in the middle of a streaming window: the channel
+    dies, every in-flight part replays, framing guards keep the server
+    sane — in-order flush still lands the whole array exactly once."""
+    _eight_part_push(monkeypatch)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((8, 4)))
+        with fault.inject(
+                "kind=truncate,point=worker.send,op=push,nth=4") as inj:
+            kv.push("w", mx.nd.ones((8, 4)))
+        assert inj.stats()[0][4] == 1
+        out = mx.nd.zeros((8, 4))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones((8, 4)))
+        assert all(srv._clock["w\x00%d" % i] == 1 for i in range(8))
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_window_drop_mid_window(monkeypatch):
+    """A silently dropped frame mid-window: only the waiter's deadline
+    can notice; the channel fails, the unacked window replays, dedupe
+    keeps the already-applied prefix at-most-once."""
+    _eight_part_push(monkeypatch)
+    monkeypatch.setattr(ka, "_REQUEST_TIMEOUT", 0.3)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((8, 4)))
+        with fault.inject("kind=drop,point=worker.send,op=push,nth=5") \
+                as inj:
+            kv.push("w", mx.nd.ones((8, 4)))
+        assert inj.stats()[0][4] == 1
+        out = mx.nd.zeros((8, 4))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones((8, 4)))
+        assert all(srv._clock["w\x00%d" % i] == 1 for i in range(8))
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_window_inorder_flush_same_key(monkeypatch):
+    """Two sequential pushes of ONE key with a sever between their acks:
+    replays must neither reorder nor double-apply — the final value is
+    the exact two-push sum."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        with fault.inject("kind=sever,point=server.send,op=push,nth=1"):
+            kv.push("w", mx.nd.ones((4,)))
+            kv.push("w", 2 * mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 3 * np.ones(4))
+        assert srv._clock["w"] == 2 and srv._dup_n == 1
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_coalesced_multi_sever_mid_batch(monkeypatch):
+    """Sever inside a coalesced multi-key frame after a prefix of its
+    sub-pushes applied: the client replays the WHOLE batch; the seq
+    dedupe refuses the prefix and applies only the tail — every key
+    lands exactly once."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        keys = ["k%d" % i for i in range(8)]
+        vals = [mx.nd.ones((3,)) * (i + 1) for i in range(8)]
+        kv.init(keys, [mx.nd.zeros((3,)) for _ in keys])
+        # 5th push EVENT at server.recv = sub-push 5 of the multi frame
+        # (subs fire their own server.recv), so 4 subs applied first
+        with fault.inject("kind=sever,point=server.recv,op=push,nth=5") \
+                as inj:
+            kv.push(keys, vals)
+        assert inj.stats()[0][4] == 1
+        for i, k in enumerate(keys):
+            out = mx.nd.zeros((3,))
+            kv.pull(k, out=out)
+            np.testing.assert_allclose(out.asnumpy(),
+                                       (i + 1) * np.ones(3))
+            assert srv._clock[k] == 1, (k, srv._clock)
+        assert srv._dup_n == 4                 # the applied prefix
+        s = kv.stats()
+        assert s["coalesced_subs"] >= 8        # they really coalesced
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_local_transport_fault_parity(monkeypatch):
+    """The same-process shortcut must keep the matrix semantics: a
+    post-apply sever replays through the same retry layer and the
+    replay is seq-deduped — at-most-once holds with zero wire."""
+    monkeypatch.setattr(ka, "_LOCAL_ON", True)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        with fault.inject("kind=sever,point=server.send,op=push,nth=1") \
+                as inj:
+            kv.push("w", mx.nd.ones((4,)))
+        assert inj.stats()[0][4] == 1
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+        assert srv._clock["w"] == 1 and srv._dup_n == 1
+        s = kv.stats()
+        assert s["local_reqs"] > 0             # it really went local
+        assert s["retransmits"] >= 1
+    finally:
+        kv.close()
+        srv.stop()

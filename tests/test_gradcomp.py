@@ -145,3 +145,39 @@ def test_bandwidth_tool_smoke():
         capture_output=True, text=True, env=env, timeout=300)
     assert r.returncode == 0, r.stderr
     assert "psum" in r.stdout and "ppermute" in r.stdout
+
+
+def test_numpy_mirror_matches_jax_path():
+    """The host-side numpy quantizer (the kvstore push fast path) must
+    produce bit-identical packed words and residuals to the jax op —
+    mixed pushes (device then host) may share one slot's residual."""
+    import jax.numpy as jnp
+    from mxtpu.gradient_compression import (GradientCompression,
+                                            _quantize_2bit_np,
+                                            quantize_2bit,
+                                            dequantize_2bit)
+    rng = np.random.RandomState(3)
+    data = rng.randn(5, 33).astype("f")      # odd size: exercises pad
+    res = rng.randn(5, 33).astype("f") * 0.1
+    p_np, r_np = _quantize_2bit_np(data, res, 0.5)
+    p_jx, r_jx = quantize_2bit(jnp.asarray(data), jnp.asarray(res), 0.5)
+    np.testing.assert_array_equal(p_np, np.asarray(p_jx))
+    np.testing.assert_allclose(r_np, np.asarray(r_jx), rtol=1e-6)
+    # a numpy part through GradientCompression round-trips like device
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    packed = gc.compress("w", data)          # numpy in -> host path
+    assert isinstance(packed, np.ndarray) and packed.dtype == np.uint32
+    assert isinstance(gc._residuals["w"], np.ndarray)
+    out = np.asarray(dequantize_2bit(jnp.asarray(packed), 0.5,
+                                     data.shape))
+    assert set(np.unique(out)) <= {-0.5, 0.0, 0.5}
+    # error feedback carries across rounds identically to the jax path:
+    # the first compress left residual data - out (res started at 0)
+    np.testing.assert_allclose(gc._residuals["w"], data - out,
+                               rtol=1e-5, atol=1e-6)
+    p2 = gc.compress("w", data)
+    p2_jx, r2_jx = quantize_2bit(jnp.asarray(data),
+                                 jnp.asarray(data - out), 0.5)
+    np.testing.assert_array_equal(p2, np.asarray(p2_jx))
+    np.testing.assert_allclose(gc._residuals["w"], np.asarray(r2_jx),
+                               rtol=1e-5, atol=1e-6)

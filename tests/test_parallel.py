@@ -593,3 +593,90 @@ def test_zero1_state_sharding_matches_plain_dp():
     for a, b in zip(plain._param_vals, z1._param_vals):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# async gradient-push hook (ISSUE 2: compute overlaps the KVStore push)
+# ---------------------------------------------------------------------------
+
+class _FakeFuture:
+    def __init__(self):
+        self.drained = False
+
+    def result(self):
+        self.drained = True
+
+
+def test_grad_push_hook_backpressure():
+    """set_grad_push: the hook sees every step's gradients (one entry
+    per trainable param, matching shapes), and the inflight window is
+    bounded — by step N+max_inflight the step-N future MUST have been
+    drained (backpressure, not unbounded pileup)."""
+    np.random.seed(5)
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randint(0, 10, (8,)).astype(np.float32)
+    net = _mlp()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(x))
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "sgd", {"learning_rate": 0.1},
+                        mesh=MeshContext(data=8))
+    seen, futs = [], []
+
+    def hook(grads):
+        seen.append(grads)
+        futs.append(_FakeFuture())
+        return futs[-1]
+
+    st.set_grad_push(hook, max_inflight=1)
+    for _ in range(3):
+        st.step(x, y)
+    assert len(seen) == 3
+    want = {p.name: p.shape for p in net._ordered_params()
+            if p.grad_req != "null"}
+    for grads in seen:
+        assert set(grads) == set(want)
+        for name, g in grads.items():
+            assert g.shape == tuple(want[name])
+            assert np.isfinite(g.asnumpy()).all()
+    # window=1: by the time push 3 was dispatched, push 1 AND 2 drained
+    assert futs[0].drained and futs[1].drained
+    assert not futs[2].drained          # still riding
+    st.flush_grad_pushes()
+    assert futs[2].drained
+    # unregister drains and stops calling
+    st.set_grad_push(None)
+    st.step(x, y)
+    assert len(seen) == 3
+
+
+def test_attach_kvstore_overlapped_push():
+    """attach_kvstore: every step's gradients land in a dist_async
+    store via push_async (lazy zero-init, per-step clock advance), and
+    sync_params waits for the outstanding pushes."""
+    np.random.seed(6)
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randint(0, 10, (8,)).astype(np.float32)
+    net = _mlp()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(x))
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "sgd", {"learning_rate": 0.1},
+                        mesh=MeshContext(data=8))
+    kv = mx.kv.create("dist_async")
+    try:
+        st.attach_kvstore(kv, max_inflight=2)
+        for _ in range(3):
+            st.step(x, y)
+        st.sync_params()               # implies flush_grad_pushes()
+        names = [p.name for p in net._ordered_params()
+                 if p.grad_req != "null"]
+        srv = kv._own_server
+        for name in names:
+            # every step's push applied (no lost/dup applies)
+            assert srv._clock[name] == 3, (name, srv._clock)
+        out = mx.nd.zeros(net._ordered_params()[0].shape)
+        kv.pull(names[0], out=out)     # accumulated grads, finite
+        assert np.isfinite(out.asnumpy()).all()
+    finally:
+        kv.close()

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Loopback microbench for the dist_async comms fast path.
+
+Measures the two regimes the wire rework targets (ISSUE 2; the numbers
+land in docs/perf_analysis.md "Comms fast path" and the before/after
+ratio is the acceptance criterion), on BOTH transports:
+
+* **bigarray push/pull** — one --mb MB gradient (default 64, split into
+  row parts at MXTPU_KVSTORE_BIGARRAY_BOUND) pushed/pulled --iters
+  times: MB/s plus p50/p99 per-call latency.
+* **small-key ops/s** — --small-keys keys of --small-bytes each (default
+  256 x 1 KB, the embedding/bias tail of a real model) pushed/pulled as
+  one list call per iteration: ops/s. This is the regime where
+  multi-key coalescing (MXTPU_PS_COALESCE_BYTES) pays.
+
+The headline numbers are the default transport — the same-process
+shortcut (MXTPU_PS_LOCAL), since the bench's server is in-process, the
+same situation as single-process dist_async mode. The "tcp" sub-object
+repeats the measurement with the shortcut disabled, i.e. over real
+loopback framing: zero-copy scatter-gather sends, recv_into receives,
+the MXTPU_PS_WINDOW pipelined window and coalesced frames.
+
+Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
+and mirrors it to docs/kvstore_bench.json unless --no-write. CPU-only,
+in-process loopback server — runnable every round with no TPU.
+
+Run: JAX_PLATFORMS=cpu python tools/bench_kvstore.py [--mb 64]
+     [--small-keys 256] [--iters 5]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, ROOT)
+
+
+def _pct(samples, q):
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+def _lat(samples_s):
+    return {"p50_ms": round(_pct(samples_s, 0.50) * 1e3, 3),
+            "p99_ms": round(_pct(samples_s, 0.99) * 1e3, 3)}
+
+
+def _measure(kv, mx, mb, small_keys, small_bytes, iters, tag):
+    """One full push/pull measurement pass on an open store."""
+    # -- bigarray regime --------------------------------------------
+    elems = int(mb * 1e6 / 4)
+    rows = max(1, elems // 4608)
+    big = mx.nd.array(np.random.RandomState(0)
+                      .rand(rows, 4608).astype("f"))
+    out = mx.nd.zeros(big.shape)
+    payload_mb = big.size * 4 / 1e6
+    key = "big_" + tag                 # fresh keys per pass: no clock
+    kv.init(key, big)                  # interference across transports
+    kv.push(key, big)                  # warm plans/sockets/jit
+    kv.pull(key, out=out)
+
+    push_t, pull_t = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        kv.push(key, big)
+        push_t.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        kv.pull(key, out=out)
+        pull_t.append(time.perf_counter() - t0)
+
+    # -- small-key regime -------------------------------------------
+    n_small_elems = max(1, small_bytes // 4)
+    keys = ["%s_s%03d" % (tag, i) for i in range(small_keys)]
+    vals = [mx.nd.array(np.full(n_small_elems, float(i % 7), "f"))
+            for i in range(small_keys)]
+    outs = [mx.nd.zeros((n_small_elems,)) for _ in keys]
+    kv.init(keys, vals)
+    kv.push(keys, vals)                # warm
+    kv.pull(keys, out=outs)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        kv.push(keys, vals)
+    small_push_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        kv.pull(keys, out=outs)
+    small_pull_s = time.perf_counter() - t0
+
+    return {
+        "payload_mb": round(payload_mb, 1),
+        "push_mb_s": round(payload_mb / (sum(push_t) / iters), 1),
+        "pull_mb_s": round(payload_mb / (sum(pull_t) / iters), 1),
+        "push": _lat(push_t),
+        "pull": _lat(pull_t),
+        "small_push_ops_s": round(small_keys * iters / small_push_s),
+        "small_pull_ops_s": round(small_keys * iters / small_pull_s),
+    }
+
+
+def run(mb, small_keys, small_bytes, iters):
+    import mxtpu as mx
+    from mxtpu import kvstore_async as ka
+
+    srv = ka.ParameterServer().start()
+    saved = os.environ.get("MXTPU_PS_ADDRS")
+    os.environ["MXTPU_PS_ADDRS"] = srv.address
+    local_saved = ka._LOCAL_ON
+    try:
+        kv = mx.kv.create("dist_async")
+
+        # default transport first (the same-process shortcut when it is
+        # on), then the wire with the shortcut pinned off
+        head = _measure(kv, mx, mb, small_keys, small_bytes, iters,
+                        "loc" if local_saved else "tcp")
+        tcp = head
+        if local_saved:
+            ka._LOCAL_ON = False
+            tcp = _measure(kv, mx, mb, small_keys, small_bytes, iters,
+                           "tcp")
+            ka._LOCAL_ON = local_saved
+
+        n_parts = sum(len(p) for p in kv._parts.values())
+        result = {
+            "bench": "kvstore_loopback",
+            "transport": "local" if local_saved else "tcp",
+            "n_parts": n_parts,
+            "iters": iters,
+            "small_keys": small_keys,
+            "small_bytes": small_bytes,
+            "window": int(os.environ.get("MXTPU_PS_WINDOW", "8") or 0),
+            "host_cores": os.cpu_count(),
+        }
+        result.update(head)
+        result["tcp"] = {k: tcp[k] for k in
+                         ("push_mb_s", "pull_mb_s", "push", "pull",
+                          "small_push_ops_s", "small_pull_ops_s")}
+        s = kv.stats()                 # comms counters (fast-path proof)
+        result["wire"] = {k: s[k] for k in
+                          ("bytes_sent", "bytes_recv", "frames_sent",
+                           "frames_recv", "coalesced_subs", "local_reqs",
+                           "inflight_hwm", "retransmits")
+                          if k in s}
+        kv.close()
+        return result
+    finally:
+        ka._LOCAL_ON = local_saved
+        if saved is None:
+            os.environ.pop("MXTPU_PS_ADDRS", None)
+        else:
+            os.environ["MXTPU_PS_ADDRS"] = saved
+        srv.stop()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=float, default=64.0,
+                    help="bigarray gradient volume in MB")
+    ap.add_argument("--small-keys", type=int, default=256)
+    ap.add_argument("--small-bytes", type=int, default=1024)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--no-write", action="store_true",
+                    help="do not mirror the line to docs/kvstore_bench.json")
+    args = ap.parse_args()
+
+    result = run(args.mb, args.small_keys, args.small_bytes, args.iters)
+    line = json.dumps(result)
+    print(line, flush=True)
+    if not args.no_write:
+        with open(os.path.join(ROOT, "docs", "kvstore_bench.json"),
+                  "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
