@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Counter-based overhead smoke for the guarded training loop.
+
+The TrainGuard contract (mxtpu/resilience.py) is that guarding a step is
+free on the happy path: the finite check and the bad-step select are
+fused into the SAME jitted program, and the verdict rides back packed
+with the loss, so the guarded loop performs exactly the one device→host
+read an unguarded ``step()`` already pays. Wall-clock can't pin that on
+a noisy host; structure can — in the style of ``check_comms_perf.py``:
+
+1. **No hidden sync in dispatch**: a steady-state guarded
+   ``step_async`` runs to completion under
+   ``jax.transfer_guard_device_to_host("disallow")`` — any
+   implicit device→host transfer on the dispatch path fails loudly.
+2. **One host read per step**: N guarded steps make exactly N metric
+   fetches (``guard.stats()['host_syncs']``) — loss, verdict and grad
+   norm all come out of that single packed vector.
+3. **One executable**: the guard compiles exactly one train step for a
+   given batch shape — the check/select adds no retrace and no
+   second program (a separate "check" program would mean an extra
+   dispatch + transfer per step).
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_guard_overhead.py`` (wired
+into ``ci/run_ci.sh fast``). No timing, no thresholds in seconds.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+import numpy as np                                    # noqa: E402
+import jax                                            # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+from mxtpu import gluon                               # noqa: E402
+from mxtpu.gluon import nn                            # noqa: E402
+from mxtpu.parallel import MeshContext, ShardedTrainer  # noqa: E402
+from mxtpu.resilience import TrainGuard               # noqa: E402
+
+_STEPS = 5
+
+
+def _no_d2h():
+    """disallow device→host transfers, where this jax version can."""
+    guard = getattr(jax, "transfer_guard_device_to_host", None)
+    if guard is None:                                 # pragma: no cover
+        return contextlib.nullcontext()
+    return guard("disallow")
+
+
+def main():
+    failures = []
+    np.random.seed(0)
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randint(0, 10, (8,)).astype(np.float32)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16), nn.Activation("relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(x))
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                        mesh=MeshContext(data=8))
+    guard = TrainGuard(st, spike_z=0)
+
+    guard.step(x, y)            # warm-up: compile + first placement
+
+    # -- 1: steady-state dispatch makes zero device->host transfers ---
+    for _ in range(_STEPS):
+        try:
+            with _no_d2h():
+                st.step_async(x, y)
+        except Exception as e:
+            failures.append(
+                "guarded step_async performed a device->host transfer "
+                "on the happy path: %s: %s" % (type(e).__name__, e))
+            break
+        # the guard's one read happens OUTSIDE the disallow scope,
+        # exactly as TrainGuard.step orders it
+        m = np.asarray(st.last_metrics())
+        if not (np.isfinite(m[0]) and m[1] == 1.0):
+            failures.append("steady-state step misreported: %r" % (m,))
+        st.commit_grad_push()
+
+    # -- 2: one host read per guarded step -----------------------------
+    before = guard.stats()["host_syncs"]
+    for _ in range(_STEPS):
+        guard.step(x, y)
+    reads = guard.stats()["host_syncs"] - before
+    if reads != _STEPS:
+        failures.append(
+            "%d guarded steps made %d host reads (contract: exactly "
+            "one packed metrics fetch per step)" % (_STEPS, reads))
+
+    # -- 3: the guard compiled exactly one train executable ------------
+    train_fns = [k for k in st._step_fns if k[0] == "train"]
+    if len(train_fns) != 1:
+        failures.append(
+            "guard mode holds %d train executables for one batch shape "
+            "(the check/select must fuse into THE step, not add a "
+            "second program)" % len(train_fns))
+
+    if failures:
+        print("check_guard_overhead: FAIL")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("check_guard_overhead: OK (no dispatch-path sync, one host "
+          "read per step, one fused executable)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

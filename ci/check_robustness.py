@@ -12,6 +12,17 @@ swallow a failure invisibly. This check fails CI on NEW instances of:
 2. **Blind exception swallows** in the kvstore/engine/fault/checkpoint
    paths: ``except Exception:`` or bare ``except:`` whose body is just
    ``pass`` — the pattern that turns a dead server into a silent hang.
+3. **Unbounded thread-synchronization waits** anywhere under
+   ``mxtpu/``: ``.wait()`` / ``.get()`` / ``.join()`` called with NO
+   arguments (no timeout). On the worker-resilience paths these are
+   exactly how a dead peer hangs a survivor forever; new ones must
+   carry a timeout or be pinned in ALLOW with a reason. (``.get()``
+   matches dict/metric getters too — pin those, the list stays short.)
+4. **Non-daemon threads** under ``mxtpu/``: a ``threading.Thread(``
+   whose 3-line call window carries no ``daemon=True`` keeps a crashed
+   worker's interpreter alive, which defeats ``kill``-based respawn
+   (the launcher waits on a zombie). Every in-tree thread is a daemon
+   today; keep it that way.
 
 Deliberate cases are pinned in ALLOW below by (path, stripped line):
 today's server-side frame read idles unbounded BY DESIGN (workers hold
@@ -40,6 +51,27 @@ ALLOW = {
     # every caller runs settimeout() on the socket first (_request_once)
     ("mxtpu/kvstore_async.py",
      "r = sock.recv_into(view[got:], n - got)"),
+    # -- grandfathered unbounded waits (pre-ISSUE-3 offenders; each sits
+    # behind a daemon thread or a deliberate block-forever entry point,
+    # so none can wedge a respawn — new code must do better) --
+    ("mxtpu/kvstore_async.py", "srv._thread.join()"),
+    #   ^ serve_forever(): the server role process blocks here by design
+    ("mxtpu/checkpoint.py", "self._pending.join()"),
+    #   ^ wait_until_finished joining the (daemon) writer thread
+    ("mxtpu/io.py", "e.wait()"),
+    #   ^ _wait_all over prefetch events; workers are daemons
+    ("mxtpu/io.py", "self.data_taken[i].wait()"),
+    #   ^ prefetch worker parked on its double-buffer event (daemon)
+    ("mxtpu/gluon/data/dataloader.py", "cond.wait()"),
+    #   ^ dataloader reorder wait; worker threads are daemons
+    ("mxtpu/gluon/data/dataloader.py", "item = task_q.get()"),
+    #   ^ dataloader task queue; worker threads are daemons
+    ("mxtpu/image.py", "out = res.get()"),
+    #   ^ multiprocessing AsyncResult in the image worker pool
+    ("mxtpu/metric.py", "name, value = self.get()"),
+    #   ^ EvalMetric.get() — a value getter, not a queue
+    ("mxtpu/metric.py", "name, value = child.get()"),
+    #   ^ EvalMetric.get() — a value getter, not a queue
 }
 
 # blind-swallow scan is scoped to the paths where a swallowed error
@@ -69,6 +101,39 @@ def _socket_offenders(path, lines):
                "socket call with no explicit timeout")
 
 
+_SYNC_WAIT_PAT = re.compile(r"\.(wait|get|join)\(\s*\)")
+_THREAD_PAT = re.compile(r"threading\.Thread\(")
+
+
+def _sync_wait_offenders(path, lines):
+    rel = str(path.relative_to(ROOT))
+    for i, line in enumerate(lines):
+        stripped = line.strip()
+        if stripped.startswith("#") or not _SYNC_WAIT_PAT.search(line):
+            continue
+        if (rel, stripped) in ALLOW:
+            continue
+        yield (rel, i + 1, stripped,
+               "wait()/get()/join() with no timeout")
+
+
+def _thread_offenders(path, lines):
+    rel = str(path.relative_to(ROOT))
+    for i, line in enumerate(lines):
+        stripped = line.strip()
+        if stripped.startswith("#") or not _THREAD_PAT.search(line):
+            continue
+        # calls wrap: accept daemon= within the call's 3-line window,
+        # or an explicit `.daemon = True` on the next two lines
+        window = "".join(lines[i:i + 3])
+        if "daemon" in window:
+            continue
+        if (rel, stripped) in ALLOW:
+            continue
+        yield (rel, i + 1, stripped,
+               "non-daemon thread (would outlive a killed worker)")
+
+
 def _swallow_offenders(path, lines):
     rel = str(path.relative_to(ROOT))
     for i, line in enumerate(lines):
@@ -89,6 +154,8 @@ def main():
     for path in sorted(PKG.rglob("*.py")):
         lines = path.read_text().splitlines(keepends=True)
         offenders.extend(_socket_offenders(path, lines))
+        offenders.extend(_sync_wait_offenders(path, lines))
+        offenders.extend(_thread_offenders(path, lines))
         if path.name in SWALLOW_FILES:
             offenders.extend(_swallow_offenders(path, lines))
     if offenders:

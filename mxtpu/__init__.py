@@ -70,6 +70,7 @@ def _optional_imports():
         ("kvstore", ("kv",)), ("kvstore_server", ()),
         ("gluon", ()), ("parallel", ()),
         ("gradient_compression", ()), ("checkpoint", ()),
+        ("resilience", ()),
         ("profiler", ()), ("recordio", ()), ("image", ()),
         ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
         ("rnn", ()), ("engine", ()), ("operator", ()), ("contrib", ()),

@@ -24,16 +24,29 @@ Usage::
 from __future__ import annotations
 
 import json
+import logging
 import os
+import pickle
 import shutil
 import threading
+import zipfile
+import zlib
 
 import numpy as _np
 
 from . import ndarray as nd
 from .ndarray import NDArray
 
-__all__ = ["CheckpointManager"]
+__all__ = ["CheckpointManager", "CheckpointCorrupt"]
+
+_log = logging.getLogger(__name__)
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint step failed its integrity check (CRC mismatch,
+    truncated archive, missing file). :meth:`CheckpointManager.restore`
+    treats it as 'this step is gone' and falls back to the previous
+    retained step instead of killing the resuming worker."""
 
 
 def _tree_from(params):
@@ -103,7 +116,14 @@ class CheckpointManager:
         save/restore to join."""
         tree = {"params": _tree_from(params)}
         if trainer is not None:
-            raw = trainer._updaters[0].get_states(dump_optimizer=True)
+            if hasattr(trainer, "_updaters"):     # gluon Trainer
+                raw = trainer._updaters[0].get_states(dump_optimizer=True)
+            else:
+                # state_dict-style trainer (ShardedTrainer): step count,
+                # RNG key, optimizer state, LR-scheduler progress —
+                # everything a respawned worker needs to resume
+                raw = pickle.dumps(trainer.state_dict(),
+                                   protocol=pickle.HIGHEST_PROTOCOL)
             tree["trainer_states"] = _np.frombuffer(raw, dtype=_np.uint8)
         if metadata is not None:
             tree["metadata"] = {"json": _np.frombuffer(
@@ -120,7 +140,14 @@ class CheckpointManager:
     def restore(self, step=None, params=None, trainer=None):
         """Load checkpoint ``step`` (latest when None). When ``params`` is
         given, values are written into it in place; the raw tree is
-        returned either way. Returns None when nothing exists."""
+        returned either way. Returns None when nothing exists.
+
+        A corrupt or truncated step (CRC mismatch against the per-array
+        tags the fallback writer records, torn archive, missing file)
+        is logged and skipped: restore falls back to the next-newest
+        retained step so an unattended respawn keeps going instead of
+        dying on a half-written checkpoint. Only when EVERY retained
+        step is corrupt does the failure surface."""
         self.wait_until_finished()
         if step is None:
             step = self.latest_step()
@@ -131,14 +158,32 @@ class CheckpointManager:
         if self._orbax_mgr is not None:
             tree = self._orbax_mgr.restore(step)
         else:
-            tree = self._fallback_restore(step)
+            candidates = [s for s in reversed(self.all_steps())
+                          if s <= step]
+            tree, first_err = None, None
+            for s in candidates:
+                try:
+                    tree = self._fallback_restore(s)
+                    break
+                except CheckpointCorrupt as e:
+                    _log.warning(
+                        "checkpoint step %d is corrupt (%s); falling "
+                        "back to the previous retained step", s, e)
+                    first_err = first_err or e
+            if tree is None:
+                raise CheckpointCorrupt(
+                    "no intact checkpoint among steps %r in %s"
+                    % (candidates, self.directory)) from first_err
         if params is not None:
             _tree_into(params, tree["params"])
         if trainer is not None and "trainer_states" in tree:
             raw = bytes(_np.asarray(tree["trainer_states"],
                                     dtype=_np.uint8))
-            for u in trainer._updaters:
-                u.set_states(raw)
+            if hasattr(trainer, "_updaters"):     # gluon Trainer
+                for u in trainer._updaters:
+                    u.set_states(raw)
+            else:
+                trainer.load_state_dict(pickle.loads(raw))
         meta = tree.get("metadata")
         if meta is not None and "json" in meta:
             tree["metadata"] = json.loads(
@@ -179,6 +224,12 @@ class CheckpointManager:
             self._orbax_mgr.close()
 
     # -- thread fallback ----------------------------------------------------
+    @staticmethod
+    def _crc_tags(arrays):
+        """CRC32 per array (over the raw bytes, C-order)."""
+        return {k: zlib.crc32(_np.ascontiguousarray(v).tobytes())
+                for k, v in arrays.items()}
+
     def _fallback_save(self, step, tree):
         self.wait_until_finished()          # one writer at a time
 
@@ -189,16 +240,24 @@ class CheckpointManager:
                 if os.path.isdir(tmp):
                     shutil.rmtree(tmp)
                 os.makedirs(tmp)
+                integrity = {}
                 # params are already host numpy (_tree_from): write them
                 # directly — no device round-trip in the writer thread
                 with open(os.path.join(tmp, "params.npz"), "wb") as f:
                     _np.savez(f, **tree["params"])
+                integrity["params"] = self._crc_tags(tree["params"])
                 for extra in ("trainer_states", "metadata", "extras"):
                     if extra in tree:
-                        _np.savez(os.path.join(tmp, extra + ".npz"),
-                                  **(tree[extra]
-                                     if isinstance(tree[extra], dict)
-                                     else {extra: tree[extra]}))
+                        d = (tree[extra]
+                             if isinstance(tree[extra], dict)
+                             else {extra: tree[extra]})
+                        _np.savez(os.path.join(tmp, extra + ".npz"), **d)
+                        integrity[extra] = self._crc_tags(d)
+                # per-array CRC tags, written LAST inside the tmp dir so
+                # a torn write of any array file is detectable even when
+                # the archive itself still opens
+                with open(os.path.join(tmp, "integrity.json"), "w") as f:
+                    json.dump(integrity, f)
                 if os.path.isdir(final):
                     shutil.rmtree(final)
                 os.replace(tmp, final)      # atomic publish
@@ -217,16 +276,53 @@ class CheckpointManager:
 
     def _fallback_restore(self, step):
         base = os.path.join(self.directory, "step_%d" % step)
-        with _np.load(os.path.join(base, "params.npz")) as z:
-            tree = {"params": {k: z[k] for k in z.files}}
-        for extra in ("trainer_states", "metadata", "extras"):
-            path = os.path.join(base, extra + ".npz")
-            if os.path.exists(path):
-                with _np.load(path) as z:
-                    d = {k: z[k] for k in z.files}
-                tree[extra] = d[extra] if extra == "trainer_states" \
-                    else d
+        try:
+            with _np.load(os.path.join(base, "params.npz")) as z:
+                tree = {"params": {k: z[k] for k in z.files}}
+            for extra in ("trainer_states", "metadata", "extras"):
+                path = os.path.join(base, extra + ".npz")
+                if os.path.exists(path):
+                    with _np.load(path) as z:
+                        d = {k: z[k] for k in z.files}
+                    tree[extra] = d[extra] if extra == "trainer_states" \
+                        else d
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile) as e:
+            # truncated/torn archive: np.load raises a zoo of errors —
+            # uniform verdict
+            raise CheckpointCorrupt(
+                "step %d unreadable: %s: %s"
+                % (step, type(e).__name__, e)) from e
+        self._verify_integrity(base, step, tree)
         return tree
+
+    def _verify_integrity(self, base, step, tree):
+        """Check the loaded arrays against the writer's CRC tags.
+        Checkpoints predating the tags (no integrity.json) pass — the
+        guarantee is forward-looking, not retroactive."""
+        path = os.path.join(base, "integrity.json")
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                tags = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorrupt(
+                "step %d integrity tags unreadable: %s" % (step, e)) from e
+        for section, expect in tags.items():
+            got = tree.get(section)
+            if section == "trainer_states" and got is not None:
+                got = {"trainer_states": got}
+            if got is None:
+                raise CheckpointCorrupt(
+                    "step %d is missing section %r" % (step, section))
+            found = self._crc_tags({k: got[k] for k in expect
+                                    if k in got})
+            for name, crc in expect.items():
+                if found.get(name) != crc:
+                    raise CheckpointCorrupt(
+                        "step %d array %s/%s fails its CRC32 tag"
+                        % (step, section, name))
 
     def _retention(self):
         steps = self.all_steps()

@@ -17,6 +17,8 @@ Spec format (``MXTPU_FAULT_SPEC`` or :func:`install`): rules separated by
     kind=sever,point=server.send,op=push,nth=1
     kind=delay,point=worker.send,op=pull,delay=0.05,count=3
     kind=kill,point=server.recv,op=push,nth=5
+    kind=nan_grad,point=worker.step,nth=3,count=2
+    kind=kill_worker,point=worker.step,nth=8
 
 Rule keys:
 
@@ -25,9 +27,19 @@ Rule keys:
            (sleep ``delay`` seconds, then proceed), ``truncate`` (a partial
            garbage frame is written, then the connection dies), ``kill``
            (server points only: the whole server stops, simulating a
-           crashed shard).
+           crashed shard), ``stall`` (a long ``delay``-second straggler
+           pause — same mechanics as ``delay``, named so straggler
+           schedules read as what they are), ``nan_grad`` (training-loop
+           points only: the caller must poison this step's batch so the
+           loss/gradients go non-finite — exercised by
+           :class:`mxtpu.resilience.TrainGuard`), ``kill_worker``
+           (training-loop points only: ``SIGKILL`` THIS process — the
+           deterministic ``kill -9`` of a worker mid-step that
+           ``tools/launch.py --worker-respawn`` recovers from).
 ``point``  ``worker.send`` | ``worker.recv`` | ``server.recv`` |
-           ``server.send`` | ``any``.
+           ``server.send`` | ``worker.step`` (fired by the guarded
+           training loop once per step, before the jitted step runs) |
+           ``any``.
 ``op``     wire command to match (``push``/``pull``/...); ``*`` (default)
            matches all.
 ``key``    substring of the wire key to match (optional).
@@ -60,8 +72,9 @@ __all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
            "inject", "fire", "active"]
 
 _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
-           "any")
-_KINDS = ("sever", "drop", "delay", "truncate", "kill")
+           "worker.step", "any")
+_KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
+          "nan_grad", "kill_worker")
 
 
 class FaultSever(ConnectionError):
@@ -84,6 +97,10 @@ class _Rule:
                              % (point, "/".join(_POINTS)))
         if kind == "kill" and point.startswith("worker"):
             raise ValueError("kind=kill only applies to server points")
+        if kind in ("nan_grad", "kill_worker") and \
+                point not in ("worker.step", "any"):
+            raise ValueError(
+                "kind=%s only applies to the worker.step point" % kind)
         self.kind = kind
         self.point = point
         self.op = op
@@ -153,20 +170,28 @@ class FaultInjector:
     def fire(self, point, op=None, key=None, sock=None, server=None):
         """Deliver whichever fault is scheduled for this event.
 
-        Returns ``None`` (no fault / proceed) or ``"drop"`` (the caller
-        must skip the send); raises :class:`FaultSever` for connection
-        faults. ``kind=kill`` stops ``server`` on a side thread first so
-        the crash looks like a real shard death (every connection dies,
-        the port closes) rather than one dropped frame.
+        Returns ``None`` (no fault / proceed), ``"drop"`` (the caller
+        must skip the send) or ``"nan_grad"`` (the training loop must
+        poison this step's batch); raises :class:`FaultSever` for
+        connection faults. ``kind=kill`` stops ``server`` on a side
+        thread first so the crash looks like a real shard death (every
+        connection dies, the port closes) rather than one dropped
+        frame. ``kind=kill_worker`` SIGKILLs this process — nothing
+        after it runs, exactly like an external ``kill -9``.
         """
         rule = self._select(point, op, key)
         if rule is None:
             return None
-        if rule.kind == "delay":
+        if rule.kind in ("delay", "stall"):
             time.sleep(rule.delay)
             return None
         if rule.kind == "drop":
             return "drop"
+        if rule.kind == "nan_grad":
+            return "nan_grad"
+        if rule.kind == "kill_worker":
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
         if rule.kind == "truncate":
             if sock is not None:
                 try:

@@ -486,6 +486,15 @@ class ImageIter:
             _random.shuffle(self._order)
         self._cursor = 0
 
+    def state_dict(self):
+        # the shuffled order is part of the cursor: restoring cursor=k
+        # into a differently-shuffled order would replay/skip samples
+        return {"cursor": int(self._cursor), "order": list(self._order)}
+
+    def load_state_dict(self, state):
+        self._order = list(state["order"])
+        self._cursor = int(state["cursor"])
+
     @property
     def provide_data(self):
         from .io import DataDesc

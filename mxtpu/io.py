@@ -75,7 +75,18 @@ class DataBatch:
 
 
 class DataIter:
-    """Base data iterator (reference io.py:180)."""
+    """Base data iterator (reference io.py:180).
+
+    Elastic-resume contract: :meth:`state_dict` returns the iterator's
+    resumable position (epoch/cursor and whatever reordering state an
+    exact resume needs) as a plain-python/JSON-able dict, and
+    :meth:`load_state_dict` restores it into an equivalently-constructed
+    iterator over the SAME source data — fast-forwarding where the
+    position cannot be seeked directly. A crashed worker's respawn
+    (``tools/launch.py --worker-respawn``) restores its data cursor this
+    way so no batch is silently skipped or double-trained. The base
+    iterator is stateless (``{}``): combinators and in-memory iterators
+    override."""
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -85,6 +96,14 @@ class DataIter:
 
     def reset(self):
         pass
+
+    def state_dict(self):
+        """Resumable position; ``{}`` for stateless iterators."""
+        return {}
+
+    def load_state_dict(self, state):
+        """Restore a :meth:`state_dict` position (stateless: no-op)."""
+        del state
 
     def next(self):
         if self.iter_next():
@@ -152,6 +171,17 @@ class ResizeIter(_CurrentBatchIter):
         if self.reset_internal:
             self.data_iter.reset()
 
+    def state_dict(self):
+        # cur alone is not resumable when the wrapped epoch is shorter
+        # than `size` (iter_next wraps around): the inner position is
+        # part of the cursor, so it rides along
+        return {"cur": int(self.cur),
+                "inner": self.data_iter.state_dict()}
+
+    def load_state_dict(self, state):
+        self.data_iter.load_state_dict(state.get("inner") or {})
+        self.cur = int(state["cur"])
+
     def iter_next(self):
         if self.cur == self.size:
             return False
@@ -196,6 +226,15 @@ class PrefetchingIter(_CurrentBatchIter):
         _set_all(self.data_taken)
         self.started = True
         self.next_batch = [None] * self.n_iter
+        # elastic-resume bookkeeping: each worker snapshots its iterator
+        # position right after fetching a batch; the consumer adopts
+        # that snapshot when the batch is DELIVERED, so state_dict()
+        # reports the position after the last batch the caller actually
+        # saw — never the position the prefetch threads ran ahead to
+        self._delivered = 0
+        self._next_state = [None] * self.n_iter
+        self._inner_states = None
+        self._errors = [None] * self.n_iter
         self.prefetch_threads = [
             threading.Thread(target=self._worker, args=(i,), daemon=True)
             for i in range(self.n_iter)]
@@ -211,8 +250,20 @@ class PrefetchingIter(_CurrentBatchIter):
                 return
             try:
                 self.next_batch[i] = self.iters[i].next()
+                # duck-typed: an iterator without the elastic-resume
+                # contract still prefetches; restore falls back to
+                # reset + fast-forward (see load_state_dict)
+                sd = getattr(self.iters[i], "state_dict", None)
+                self._next_state[i] = sd() if sd is not None else None
             except StopIteration:
                 self.next_batch[i] = None
+                self._next_state[i] = None
+            except BaseException as exc:  # noqa: B036 — a dying worker
+                # must never strand the consumer in _wait_all: park the
+                # error, wake the consumer, re-raise from iter_next
+                self.next_batch[i] = None
+                self._next_state[i] = None
+                self._errors[i] = exc
             self.data_taken[i].clear()
             self.data_ready[i].set()
 
@@ -245,16 +296,65 @@ class PrefetchingIter(_CurrentBatchIter):
         _wait_all(self.data_ready)   # workers quiesced before resetting
         for i in self.iters:
             i.reset()
+        self._delivered = 0
+        self._inner_states = None
+        self._errors = [None] * self.n_iter
         _clear_all(self.data_ready)
         _set_all(self.data_taken)
 
+    def state_dict(self):
+        """Position after the last DELIVERED batch. The prefetch
+        threads run ahead of the consumer by design; the snapshot the
+        worker took alongside that batch (see :meth:`_worker`) is what
+        rides here, so a restore never skips the batches that were
+        prefetched but not yet consumed."""
+        return {"delivered": int(self._delivered),
+                "iters": None if self._inner_states is None
+                else list(self._inner_states)}
+
+    def load_state_dict(self, state):
+        """Restore into this (possibly freshly constructed) combinator:
+        park the workers, rewind the wrapped iterators to the delivered
+        position — exact restore when they support it, reset +
+        fast-forward otherwise — and restart prefetching from there.
+        The worker threads survive the restore; only their fetch
+        position moves."""
+        _wait_all(self.data_ready)   # park workers; their stale batch
+        #                              (prefetched pre-restore) is dropped
+        inner = state.get("iters")
+        delivered = int(state.get("delivered", 0))
+        for k, it in enumerate(self.iters):
+            st = inner[k] if inner is not None else None
+            if st:
+                it.load_state_dict(st)
+            else:
+                # no capturable inner state: fast-forward through the
+                # batches the saved run had already consumed
+                it.reset()
+                for _ in range(delivered):
+                    it.next()
+        self._delivered = delivered
+        self._inner_states = list(inner) if inner is not None else None
+        self.next_batch = [None] * self.n_iter
+        self._next_state = [None] * self.n_iter
+        self._errors = [None] * self.n_iter
+        _clear_all(self.data_ready)
+        _set_all(self.data_taken)    # workers refetch from the restored
+        #                              position
+
     def iter_next(self):
         _wait_all(self.data_ready)
+        errors = [e for e in self._errors if e is not None]
+        if errors:
+            self._errors = [None] * self.n_iter
+            raise errors[0]
         exhausted = [b is None for b in self.next_batch]
         if any(exhausted):
             assert all(exhausted), \
                 "Number of entry mismatches between iterators"
             return False
+        self._delivered += 1
+        self._inner_states = list(self._next_state)
         lead = self.next_batch[0]
         assert all(b.pad == lead.pad for b in self.next_batch), \
             "Number of entry mismatches between iterators"
@@ -312,6 +412,11 @@ class NDArrayIter(DataIter):
             _np.random.shuffle(self.idx)
             self.data = [(k, v[self.idx]) for k, v in self.data]
             self.label = [(k, v[self.idx]) for k, v in self.label]
+        # full row permutation currently applied to the arrays (identity
+        # when unshuffled) — state_dict ships it so a restore into a
+        # fresh, differently-shuffled iterator replays the SAME epoch
+        # order the checkpointed run was walking
+        self._shuffle_perm = self.idx.copy() if shuffle else None
         if last_batch_handle == "discard":
             new_n = self.data[0][1].shape[0] - \
                 self.data[0][1].shape[0] % batch_size
@@ -337,6 +442,43 @@ class NDArrayIter(DataIter):
 
     def hard_reset(self):
         self.cursor = -self.batch_size
+
+    def state_dict(self):
+        return {"cursor": int(self.cursor),
+                "batch_size": int(self.batch_size),
+                "order": None if self._shuffle_perm is None
+                else [int(i) for i in self._shuffle_perm]}
+
+    def load_state_dict(self, state):
+        """Seek to a saved mid-epoch position. O(1) on the cursor; when
+        the saved run was shuffled, the arrays are re-gathered into the
+        SAVED epoch order first (undo this instance's own shuffle, then
+        apply the checkpointed permutation)."""
+        bs = int(state.get("batch_size", self.batch_size))
+        if bs != self.batch_size:
+            raise ValueError(
+                "cannot restore a batch_size=%d NDArrayIter state into "
+                "a batch_size=%d iterator" % (bs, self.batch_size))
+        order = state.get("order")
+        if order is not None:
+            n = self.data[0][1].shape[0]
+            perm = _np.asarray(order, dtype=_np.int64)
+            if perm.shape[0] != n:
+                raise ValueError(
+                    "saved epoch order covers %d rows but this iterator "
+                    "holds %d" % (perm.shape[0], n))
+            cur = self._shuffle_perm if self._shuffle_perm is not None \
+                else _np.arange(n)
+            inv = _np.empty(n, dtype=_np.int64)
+            inv[cur] = _np.arange(n)
+            sel = inv[perm]          # rows_now[sel] == rows_orig[perm]
+            self.data = [(k, v[sel]) for k, v in self.data]
+            self.label = [(k, v[sel]) for k, v in self.label]
+            self.data_list = [x[1] for x in self.data] \
+                + [x[1] for x in self.label]
+            self._shuffle_perm = perm
+            self.idx = perm[:self.idx.shape[0]]
+        self.cursor = int(state["cursor"])
 
     def reset(self):
         if self.last_batch_handle == "roll_over" and \
@@ -411,6 +553,12 @@ class CSVIter(DataIter):
 
     def reset(self):
         self._inner.reset()
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, state):
+        self._inner.load_state_dict(state)
 
     def next(self):
         return self._inner.next()
@@ -570,6 +718,12 @@ class MNISTIter(DataIter):
 
     def reset(self):
         self._inner.reset()
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, state):
+        self._inner.load_state_dict(state)
 
     def next(self):
         return self._inner.next()

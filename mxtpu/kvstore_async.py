@@ -97,10 +97,23 @@ each failure has an exercised recovery path — see
   every ``MXTPU_PS_SNAPSHOT_EVERY`` pushes, and a restarting server
   restores from the latest snapshot — ``tools/launch.py --ps-respawn``
   wires the respawn so workers reconverge with no operator action.
+* **Worker liveness.** The health story runs both ways: every store
+  registers with its servers (``hello`` with origin+rank), heartbeat
+  probes refresh the lease, and ``close()`` departs cleanly (``bye``).
+  Servers keep per-worker push/staleness/step-gap counters — surfaced
+  through ``kv.stats()``/``kv.health()`` with a push-count straggler
+  verdict (``MXTPU_PS_STRAGGLER_FACTOR``/``_MIN``) — and garbage-
+  collect a worker silent past ``MXTPU_PS_WORKER_DEAD_AFTER`` (its
+  membership and buffered dedupe seqs; 0 disables). Barriers carry a
+  deadline (``MXTPU_PS_BARRIER_TIMEOUT``): a barrier a dead worker can
+  never complete force-releases with a logged, counted timeout instead
+  of hanging the fleet.
 * **Fault injection.** :mod:`mxtpu.fault` (``MXTPU_FAULT_SPEC``) can
   deterministically drop/delay/truncate/sever frames at either side of
-  the wire and kill servers on schedule; the fault-matrix tests drive
-  every path above through it.
+  the wire, kill servers on schedule — and, for the worker-side story,
+  poison a training step's gradients (``nan_grad``), stall a worker
+  (``stall``) or SIGKILL it (``kill_worker``) at exact step numbers;
+  the fault-matrix tests drive every path above through it.
 
 Fast path
 ---------
@@ -498,6 +511,14 @@ class ParameterServer:
         self._stale_sum = 0
         self._stale_n = 0
         self._dup_n = 0            # deduped push replays (observability)
+        # -- worker membership / liveness (ps-lite's NumDeadNodes seen
+        # from the server side, but with per-worker evidence): origin ->
+        # {rank, pushes, staleness, last_seen, push gaps}. Epoch bumps
+        # on every join/leave so workers can observe churn.
+        self._workers = {}
+        self._workers_lock = threading.Lock()
+        self._membership_epoch = 0
+        self._barrier_timeouts = 0
         self._barrier_lock = threading.Lock()
         self._barrier_cv = threading.Condition(self._barrier_lock)
         self._barrier_gen = 0
@@ -581,6 +602,77 @@ class ParameterServer:
         with self._locks_guard:
             return self._locks.setdefault(key, threading.Lock())
 
+    # -- worker membership -------------------------------------------------
+    def _worker_rec(self, origin, rank=None):
+        """Touch (and lazily create) the liveness record for a worker
+        origin. Leaf lock: never taken while holding a key lock's
+        sibling — see _gc_workers for the ordering discipline."""
+        now = time.monotonic()
+        with self._workers_lock:
+            rec = self._workers.get(origin)
+            if rec is None:
+                self._membership_epoch += 1
+                rec = {"rank": rank, "pushes": 0, "stale_sum": 0,
+                       "stale_max": 0, "last_seen": now,
+                       "last_push": None, "push_gap_max": 0.0,
+                       "joined_epoch": self._membership_epoch}
+                self._workers[origin] = rec
+            if rank is not None:
+                rec["rank"] = rank
+            rec["last_seen"] = now
+            return rec
+
+    def _drop_worker(self, origin):
+        """Forget a worker: membership record AND its buffered dedupe
+        seqs (the per-(origin, key) at-most-once table would otherwise
+        grow one entry per key per worker incarnation forever). Key
+        locks are taken AFTER the membership lock is released — the
+        push path nests key-lock → workers-lock, so nesting the other
+        way here would deadlock."""
+        with self._workers_lock:
+            existed = self._workers.pop(origin, None) is not None
+            if existed:
+                self._membership_epoch += 1
+        if not existed:
+            return False
+        for key in [k for o, k in list(self._applied) if o == origin]:
+            with self._lock_for(key):
+                self._applied.pop((origin, key), None)
+        return True
+
+    def _gc_workers(self):
+        """Reap workers silent past MXTPU_PS_WORKER_DEAD_AFTER (0 =
+        disabled). Called lazily from the cheap read paths — no extra
+        thread, and fault-matrix schedules stay deterministic."""
+        if _WORKER_DEAD_AFTER <= 0:
+            return 0
+        now = time.monotonic()
+        with self._workers_lock:
+            dead = [o for o, r in self._workers.items()
+                    if now - r["last_seen"] > _WORKER_DEAD_AFTER]
+        n = 0
+        for o in dead:
+            if self._drop_worker(o):
+                _log.warning("parameter server: worker %s silent for "
+                             ">%gs — membership and dedupe state "
+                             "garbage-collected", o, _WORKER_DEAD_AFTER)
+                n += 1
+        return n
+
+    def _note_worker_push(self, origin, stale):
+        if origin is None:
+            return
+        rec = self._worker_rec(origin)
+        now = time.monotonic()
+        with self._workers_lock:
+            rec["pushes"] += 1
+            rec["stale_sum"] += stale
+            rec["stale_max"] = max(rec["stale_max"], stale)
+            if rec["last_push"] is not None:
+                rec["push_gap_max"] = max(rec["push_gap_max"],
+                                          now - rec["last_push"])
+            rec["last_push"] = now
+
     @staticmethod
     def _as_table_value(value):
         """Canonicalize an incoming init value to an owned, writable
@@ -625,6 +717,7 @@ class ParameterServer:
                 self._stale_max = max(self._stale_max, stale)
                 self._stale_sum += stale
                 self._stale_n += 1
+                self._note_worker_push(origin, stale)
                 g = _wire_decode(grad)
                 store = self._table[key]
                 if self._updater is not None:
@@ -689,13 +782,40 @@ class ParameterServer:
             _, payload = msg
             self._install_optimizer(bytes(payload))
             return ("ok",)
+        if cmd == "hello":
+            # worker (re-)registration: a fresh store — or a respawned
+            # worker's fresh store — announces its origin/rank; the
+            # membership epoch lets anyone observe churn
+            _, origin, rank = msg[0], msg[1], msg[2] if len(msg) > 2 \
+                else None
+            self._gc_workers()
+            self._worker_rec(origin, rank=rank)
+            with self._workers_lock:
+                return ("ok", {"epoch": self._membership_epoch,
+                               "workers": len(self._workers)})
+        if cmd == "bye":
+            # clean departure: membership leaves NOW (no dead-after
+            # wait) and the worker's dedupe seqs are reclaimed
+            self._drop_worker(msg[1])
+            return ("ok",)
         if cmd == "ping":
-            # liveness probe: cheapest possible round trip (no locks, no
-            # table access) so a loaded server still answers heartbeats
+            # liveness probe: cheapest possible round trip (no table
+            # access) so a loaded server still answers heartbeats; a
+            # probe carrying the worker's origin also refreshes its
+            # membership lease
+            if len(msg) > 1 and msg[1] is not None:
+                self._worker_rec(msg[1])
+            self._gc_workers()
             return ("ok", {"pushes": self._stale_n,
                            "keys": len(self._table)})
         if cmd == "barrier":
-            _, num_workers = msg
+            # optional deadline (seconds) after num_workers: a barrier
+            # that cannot complete — a member died mid-epoch — degrades
+            # to a counted, logged timeout instead of hanging the fleet
+            num_workers = msg[1]
+            deadline = None
+            if len(msg) > 2 and msg[2]:
+                deadline = time.monotonic() + float(msg[2])
             with self._barrier_cv:
                 gen = self._barrier_gen
                 self._barrier_arrived += 1
@@ -703,19 +823,49 @@ class ParameterServer:
                     self._barrier_arrived = 0
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
-                else:
-                    while self._barrier_gen == gen:
-                        self._barrier_cv.wait(timeout=120)
+                    return ("ok",)
+                while self._barrier_gen == gen:
+                    wait = 120.0
+                    if deadline is not None:
+                        wait = deadline - time.monotonic()
+                        if wait <= 0:
+                            # force-release the generation so every
+                            # other waiter unblocks too (they would
+                            # otherwise wait for a count that can no
+                            # longer be reached)
+                            self._barrier_timeouts += 1
+                            self._barrier_arrived = 0
+                            self._barrier_gen += 1
+                            self._barrier_cv.notify_all()
+                            _log.warning(
+                                "barrier released by deadline with "
+                                "%d/%d arrivals", num_workers - 1,
+                                num_workers)
+                            return ("ok", "timeout")
+                    self._barrier_cv.wait(timeout=wait)
             return ("ok",)
         if cmd == "stats":
             avg = self._stale_sum / self._stale_n if self._stale_n else 0.0
+            self._gc_workers()
+            with self._workers_lock:
+                workers = {
+                    o: {"rank": r["rank"], "pushes": r["pushes"],
+                        "staleness_max": r["stale_max"],
+                        "staleness_avg": (r["stale_sum"] / r["pushes"]
+                                          if r["pushes"] else 0.0),
+                        "push_gap_max": r["push_gap_max"]}
+                    for o, r in self._workers.items()}
+                epoch = self._membership_epoch
             return ("ok", {"staleness_max": self._stale_max,
                            "staleness_avg": avg,
                            "pushes": self._stale_n,
                            "dup_pushes": self._dup_n,
                            "snapshots": self._snap_count,
                            "restored_step": self._restored_step,
-                           "clocks": dict(self._clock)})
+                           "clocks": dict(self._clock),
+                           "workers": workers,
+                           "membership_epoch": epoch,
+                           "barrier_timeouts": self._barrier_timeouts})
         if cmd == "stop":
             threading.Thread(target=self.stop, daemon=True).start()
             return ("ok",)
@@ -845,6 +995,24 @@ _BACKOFF_MAX = float(os.environ.get("MXTPU_PS_BACKOFF_MAX", "2.0"))
 _RECONNECT_TIMEOUT = float(os.environ.get("MXTPU_PS_RECONNECT", "5"))
 _DEAD_AFTER = int(os.environ.get("MXTPU_PS_DEAD_AFTER", "3"))
 
+# -- worker liveness (the server-side mirror of the health story) --------
+# every barrier arrival waits at most this long before the server
+# force-releases the generation — a dead worker degrades a barrier to a
+# logged timeout instead of hanging the fleet forever
+_BARRIER_TIMEOUT = float(os.environ.get("MXTPU_PS_BARRIER_TIMEOUT", "300"))
+# seconds of silence (no push/ping/hello) after which a server garbage-
+# collects a worker's membership + buffered dedupe seqs; 0 disables the
+# sweep (tests drive exact schedules; production sets a real window)
+_WORKER_DEAD_AFTER = float(os.environ.get(
+    "MXTPU_PS_WORKER_DEAD_AFTER", "0"))
+# straggler verdict: a worker is a straggler when the fleet's max push
+# count exceeds factor * its own (once the fleet has pushed enough for
+# the ratio to mean anything) — push-count based, so the counters are
+# deterministic under the fault matrix, never wall-clock
+_STRAGGLER_FACTOR = float(os.environ.get(
+    "MXTPU_PS_STRAGGLER_FACTOR", "2.0"))
+_STRAGGLER_MIN = int(os.environ.get("MXTPU_PS_STRAGGLER_MIN", "10"))
+
 # every command whose replay is harmless: pull/pull_rows/stats/ping read,
 # init is first-writer-wins, set_optimizer re-installs the same payload,
 # push dedupes via its (origin, seq) pair, and multi only ever carries
@@ -852,7 +1020,7 @@ _DEAD_AFTER = int(os.environ.get("MXTPU_PS_DEAD_AFTER", "3"))
 # double-count this worker in the generation.
 _IDEMPOTENT = frozenset(
     ("init", "push", "pull", "pull_rows", "stats", "ping",
-     "set_optimizer", "multi"))
+     "set_optimizer", "multi", "hello", "bye"))
 
 
 class _Pending:
@@ -1229,18 +1397,22 @@ class _ServerConn:
             out.append(reply)
         return out
 
-    def ping(self, timeout=2.0):
+    def ping(self, timeout=2.0, origin=None):
         """One heartbeat probe: no retries, short timeout. The probe
         rides its own correlation id on the pipelined channel, so it can
         never interleave with — or steal the socket from — an in-flight
         transfer (the old pool-slot re-acquisition race); when traffic
         is already in flight the server is alive by definition and no
-        probe is sent at all."""
+        probe is sent at all. ``origin`` rides along so the probe also
+        refreshes this worker's server-side membership lease."""
         for ch in self._channels:
             if ch is not None and not ch.dead and ch.inflight():
                 return True
         try:
-            self.request("ping", timeout=timeout, retries=0)
+            if origin is not None:
+                self.request("ping", origin, timeout=timeout, retries=0)
+            else:
+                self.request("ping", timeout=timeout, retries=0)
             return True
         except (ConnectionError, OSError):
             return False
@@ -1291,6 +1463,8 @@ class AsyncDistKVStore(KVStore):
             "MXTPU_PS_PENDING_MAX", "256"))
         self._pending = {}         # conn -> [(subkey, payload, clock, seq)]
         self._pending_lock = threading.Lock()
+        self._extra_stats = {}     # name -> fn; merged into stats()
+        #                            (TrainGuard registers its counters)
         from concurrent.futures import ThreadPoolExecutor
         # parts of one array move concurrently: enough workers to keep
         # every socket of every server pool in flight
@@ -1309,6 +1483,9 @@ class AsyncDistKVStore(KVStore):
                 target=self._heartbeat_loop, args=(interval,),
                 daemon=True, name="mxtpu-ps-heartbeat")
             self._hb_thread.start()
+        # announce this worker to every reachable server (best-effort:
+        # a dead shard learns about us when the heartbeat re-registers)
+        self._register_workers(self._conns)
 
     # -- identity ---------------------------------------------------------
     @property
@@ -1678,8 +1855,35 @@ class AsyncDistKVStore(KVStore):
 
     # -- coordination -----------------------------------------------------
     def barrier(self):
+        """Fleet barrier with a server-side deadline
+        (``MXTPU_PS_BARRIER_TIMEOUT``): when a member died mid-epoch the
+        server force-releases the generation and this returns — logged
+        and counted in ``stats()['barrier_timeouts']`` — instead of
+        hanging every surviving worker forever."""
         super().barrier()
-        self._conns[0].request("barrier", self._size)
+        # the socket deadline must outlive the server-side one, or the
+        # RPC layer would tear the channel down before the degraded
+        # release can arrive
+        reply = self._conns[0].request(
+            "barrier", self._size, _BARRIER_TIMEOUT,
+            timeout=_BARRIER_TIMEOUT + 30.0)
+        if len(reply) > 1 and reply[1] == "timeout":
+            _log.warning(
+                "barrier degraded: released by the %gs deadline with "
+                "members missing (see kv.stats()['barrier_timeouts'])",
+                _BARRIER_TIMEOUT)
+
+    # -- worker registration ----------------------------------------------
+    def _register_workers(self, conns):
+        """Best-effort hello to each server: membership + liveness
+        lease. A respawned worker's fresh store re-registers the same
+        way, which is how the fleet learns the seat is filled again."""
+        for c in conns:
+            try:
+                c.request("hello", self._origin, self._rank, retries=0,
+                          timeout=5.0)
+            except (ConnectionError, RuntimeError, OSError):
+                pass
 
     # -- liveness / health ------------------------------------------------
     def _heartbeat_loop(self, interval):
@@ -1692,10 +1896,16 @@ class AsyncDistKVStore(KVStore):
     def _check_health(self, timeout=2.0):
         """One synchronous liveness sweep (the heartbeat thread's body;
         tests call it directly so no wall-clock enters the fault
-        matrix): probe every server, and flush buffered pushes to any
+        matrix): probe every server — the probe carries our origin so
+        the membership lease stays fresh — re-register with any server
+        that just came back (a respawned shard restored its table but
+        not the ephemeral membership), and flush buffered pushes to any
         server that answers."""
         for conn in self._conns:
-            if conn.ping(timeout=timeout):
+            was_dead = conn.state == "dead"
+            if conn.ping(timeout=timeout, origin=self._origin):
+                if was_dead:
+                    self._register_workers([conn])
                 with self._pending_lock:
                     has_pending = bool(self._pending.get(conn))
                 if has_pending:
@@ -1728,18 +1938,80 @@ class AsyncDistKVStore(KVStore):
     def health(self):
         """Worker-side fleet health: per-server state (the ps-lite
         ``NumDeadNodes`` analogue, but with the *which* and *why*),
-        currently-degraded keys, and the pending-push backlog."""
+        currently-degraded keys, the pending-push backlog, and the
+        server-side worker view — per-worker push/staleness counters,
+        the straggler verdict and the membership epoch — gathered from
+        every reachable server (dead shards are skipped, never waited
+        on)."""
         servers = [c.health() for c in self._conns]
         with self._pending_lock:
             npend = sum(len(v) for v in self._pending.values())
         with self._degraded_lock:
             deg = sorted({str(sk).split("\x00")[0]
                           for sk in self._degraded})
-        return {"servers": servers,
-                "num_dead": sum(1 for s in servers
-                                if s["state"] == "dead"),
-                "degraded_keys": deg,
-                "pending_pushes": npend}
+        out = {"servers": servers,
+               "num_dead": sum(1 for s in servers
+                               if s["state"] == "dead"),
+               "degraded_keys": deg,
+               "pending_pushes": npend}
+        out.update(self._fleet_worker_view(self._server_stats_sweep()))
+        return out
+
+    def _server_stats_sweep(self):
+        """One 'stats' round trip per reachable server (dead shards are
+        skipped, not waited on)."""
+        out = []
+        for c in self._conns:
+            if c.state == "dead":
+                continue
+            try:
+                _, srv = c.request("stats", retries=0)
+            except (ConnectionError, RuntimeError, OSError):
+                continue
+            out.append(srv)
+        return out
+
+    @staticmethod
+    def _fleet_worker_view(sweeps):
+        """Merge the servers' per-worker liveness tables: pushes sum
+        across shards, staleness/step-gap take the worst shard, and the
+        straggler verdict compares each worker's fleet-wide push count
+        against the leader (push-count based — deterministic under the
+        fault matrix, no wall clock)."""
+        workers = {}
+        epoch = 0
+        barrier_timeouts = 0
+        for srv in sweeps:
+            epoch = max(epoch, srv.get("membership_epoch", 0))
+            barrier_timeouts += srv.get("barrier_timeouts", 0)
+            for o, w in (srv.get("workers") or {}).items():
+                agg = workers.setdefault(
+                    o, {"rank": w.get("rank"), "pushes": 0,
+                        "staleness_max": 0, "push_gap_max": 0.0})
+                if agg["rank"] is None:
+                    agg["rank"] = w.get("rank")
+                agg["pushes"] += w.get("pushes", 0)
+                agg["staleness_max"] = max(agg["staleness_max"],
+                                           w.get("staleness_max", 0))
+                agg["push_gap_max"] = max(agg["push_gap_max"],
+                                          w.get("push_gap_max", 0.0))
+        stragglers = []
+        if workers:
+            lead = max(w["pushes"] for w in workers.values())
+            if lead >= _STRAGGLER_MIN:
+                stragglers = sorted(
+                    o for o, w in workers.items()
+                    if w["pushes"] * _STRAGGLER_FACTOR < lead)
+        return {"workers": workers, "stragglers": stragglers,
+                "membership_epoch": epoch,
+                "barrier_timeouts": barrier_timeouts}
+
+    def add_stats_source(self, name, fn):
+        """Merge a caller-side counter source into ``stats()`` under
+        ``name`` (TrainGuard publishes its skip/rollback counters this
+        way, so worker-side defenses read out next to the comms
+        evidence)."""
+        self._extra_stats[name] = fn
 
     def degraded_keys(self):
         """Top-level keys whose last pull was served from the worker's
@@ -1765,15 +2037,13 @@ class AsyncDistKVStore(KVStore):
                                       for v in self._pending.values())
         s["dup_pushes"] = 0
         s["server_pushes"] = 0
-        for c in self._conns:
-            if c.state == "dead":
-                continue
-            try:
-                _, srv = c.request("stats", retries=0)
-            except (ConnectionError, RuntimeError, OSError):
-                continue
+        sweeps = self._server_stats_sweep()
+        for srv in sweeps:
             s["dup_pushes"] += srv.get("dup_pushes", 0)
             s["server_pushes"] += srv.get("pushes", 0)
+        s.update(self._fleet_worker_view(sweeps))
+        for name, fn in self._extra_stats.items():
+            s[name] = fn()
         return s
 
     def staleness_stats(self):
@@ -1800,6 +2070,15 @@ class AsyncDistKVStore(KVStore):
             self._hb_thread.join(timeout=5)
             self._hb_thread = None
         self._pool.shutdown(wait=True)
+        # clean departure: servers drop this worker's membership and
+        # reclaim its dedupe seqs NOW instead of waiting out the
+        # MXTPU_PS_WORKER_DEAD_AFTER silence window
+        for c in self._conns:
+            if c.state != "dead":
+                try:
+                    c.request("bye", self._origin, retries=0, timeout=2.0)
+                except (ConnectionError, RuntimeError, OSError):
+                    pass
         for c in self._conns:
             c.close()
         if self._own_server is not None:

@@ -19,6 +19,13 @@ mxtpu keeps both halves of that contract:
   snapshots its state through CheckpointManager and a restarted
   process (``tools/launch.py --ps-respawn`` rebinds the same port)
   resumes from the latest snapshot — see ``docs/fault_tolerance.md``.
+  The service also tracks its *workers*: ``hello``/``bye``/heartbeat
+  registration keeps per-worker membership + push/staleness/straggler
+  counters, a worker silent past ``MXTPU_PS_WORKER_DEAD_AFTER`` has
+  its buffered state garbage-collected, and barrier waits degrade on a
+  ``MXTPU_PS_BARRIER_TIMEOUT`` deadline instead of hanging when a
+  member died — the server half of the worker-resilience story
+  (``tools/launch.py --worker-respawn`` is the launcher half).
 
 A server-role process with no ``MXTPU_PS_PORT`` (a sync-mode launch that
 passed ``-s N`` out of reference habit) logs that the role is subsumed

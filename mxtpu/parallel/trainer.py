@@ -301,6 +301,21 @@ class ShardedTrainer:
         self._placed = False
         self._key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
         self._num_update = 0
+        # guard mode (mxtpu.resilience.TrainGuard): the jitted step also
+        # computes isfinite(loss) & isfinite(global grad norm) and
+        # SELECTS the old params/opt-state/aux when the step is bad —
+        # a NaN gradient can never reach the persistent state. The
+        # (loss, ok, grad_norm) triple rides out as ONE packed device
+        # vector so the guard's host read costs the single transfer the
+        # unguarded step() already pays for the loss.
+        self._guard = False
+        self._last_metrics = None
+        self._deferred_grads = None
+        self._guard_lr_scale = 1.0
+        # elastic-resume plumbing: state restored before first placement
+        # is stashed and applied by _place
+        self._pending_opt_state = None
+        self._pending_key_dev = None
         # async gradient-push hook (set_grad_push/attach_kvstore): when
         # set, every jitted step also returns its gradients and the hook
         # ships them off-thread — the NEXT step's compute overlaps the
@@ -381,6 +396,9 @@ class ShardedTrainer:
             self._opt_states.append(jax.tree_util.tree_map(
                 place_leaf, st, is_leaf=lambda x: x is None))
         self._placed = True
+        if self._pending_opt_state is not None:
+            saved, self._pending_opt_state = self._pending_opt_state, None
+            self._apply_opt_state(saved)
 
     # -- the jitted step ---------------------------------------------------
     def _build_step(self, shapes_key, n_inputs, with_update):
@@ -441,6 +459,8 @@ class ShardedTrainer:
         # its (f32, pre-constraint) gradients so the hook can ship them;
         # baked in at build time — set_grad_push drops cached train fns
         want_grads = self._grad_push is not None
+        # guard mode is likewise baked in: set_guard drops cached fns
+        want_guard = self._guard
 
         def train_step(train_vals, states, aux_vals, inputs, label, key,
                        t, lr):
@@ -456,6 +476,16 @@ class ShardedTrainer:
                 (loss_val, (aux_new, outs)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(
                         train_vals, aux_vals, inputs, label, sub, True)
+            ok = None
+            if want_guard:
+                with jax.named_scope("guard_check"):
+                    # global grad norm in f32: NaN/Inf anywhere — and a
+                    # finite-but-exploded norm that overflows the square
+                    # — flips ok to False. Fused into THIS program: the
+                    # check costs a reduction, never a host round trip.
+                    gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                              for g in grads)
+                    ok = jnp.isfinite(loss_val) & jnp.isfinite(gsq)
             new_vals, new_states = [], []
             zero1_sh = self._zero1_shardings
             with jax.named_scope("optimizer"):
@@ -483,6 +513,24 @@ class ShardedTrainer:
                             st2, is_leaf=lambda x: x is None)
                     new_vals.append(w2)
                     new_states.append(st2)
+            if want_guard:
+                with jax.named_scope("guard_select"):
+                    # bad step: hold EVERY piece of persistent state —
+                    # params, optimizer state, aux (BN stats), step
+                    # count — at its pre-step value. A skipped step is
+                    # indistinguishable from a step that never ran.
+                    new_vals = [jnp.where(ok, nv, ov)
+                                for nv, ov in zip(new_vals, train_vals)]
+                    new_states = [jax.tree_util.tree_map(
+                        lambda nl, ol: None if nl is None
+                        else jnp.where(ok, nl, ol),
+                        ns, os_, is_leaf=lambda x: x is None)
+                        for ns, os_ in zip(new_states, states)]
+                    aux_new = tuple(jnp.where(ok, na, oa)
+                                    for na, oa in zip(aux_new, aux_vals))
+                    t = jnp.where(ok, t, t - 1)
+                    metrics = jnp.stack([
+                        loss_val, ok.astype(jnp.float32), jnp.sqrt(gsq)])
             # pin layouts so donation round-trips buffers in place
             new_vals = [
                 jax.lax.with_sharding_constraint(v, s)
@@ -490,7 +538,11 @@ class ShardedTrainer:
                                 [self._shardings[i] for i in train_idx])]
             out = (tuple(new_vals), tuple(new_states), tuple(aux_new),
                    loss_val, outs, key, t)
-            return out + (tuple(grads),) if want_grads else out
+            if want_grads:
+                out += (tuple(grads),)
+            if want_guard:
+                out += (metrics,)
+            return out
 
         def eval_step(train_vals, aux_vals, inputs, label, key):
             loss_val, (aux_new, outs) = forward_loss(
@@ -523,6 +575,8 @@ class ShardedTrainer:
                     outs_sh = (auto, auto, auto, None, None, None, None)
                     if want_grads:
                         outs_sh += (None,)
+                    if want_guard:
+                        outs_sh += (None,)
                     jitted = jax.jit(
                         train_step,
                         in_shardings=(auto, auto, auto, None, None,
@@ -549,12 +603,21 @@ class ShardedTrainer:
         """Lazily created on-device (key, t, lr) carried across steps."""
         if self._key_dev is None:
             rep = self._mesh.replicated()
-            # branch the host chain: the device chain carries one fork (and
-            # is donated every step), the host keeps advancing the other
-            # for eval-time draws. np copy so donation can't delete the
-            # host key's buffer (device_put may alias when shardings match).
-            self._key, dev_key = _rng_split2(self._key)
-            self._key_dev = jax.device_put(_np.asarray(dev_key), rep)
+            if self._pending_key_dev is not None:
+                # elastic resume: carry on the exact device RNG stream
+                # the checkpoint recorded — a respawned worker replays
+                # the same draws an uninterrupted run would have made
+                dev_key = self._pending_key_dev
+                self._pending_key_dev = None
+                self._key_dev = jax.device_put(_np.asarray(dev_key), rep)
+            else:
+                # branch the host chain: the device chain carries one
+                # fork (and is donated every step), the host keeps
+                # advancing the other for eval-time draws. np copy so
+                # donation can't delete the host key's buffer
+                # (device_put may alias when shardings match).
+                self._key, dev_key = _rng_split2(self._key)
+                self._key_dev = jax.device_put(_np.asarray(dev_key), rep)
             self._t_dev = jax.device_put(
                 _np.asarray(self._num_update, _np.int32), rep)
             self._lr_host = self._host_lr()
@@ -594,8 +657,19 @@ class ShardedTrainer:
         self._aux_vals = list(aux_new)
         self._last_outputs = outs
         self._key_dev, self._t_dev, self._lr_dev = new_key, new_t, lr
-        if len(res) > 7:               # gradient-push hook registered
-            self._dispatch_grad_push(res[7])
+        extra = 7
+        if self._grad_push is not None and len(res) > extra:
+            grads = res[extra]
+            extra += 1
+            if self._guard:
+                # the guard decides after its finite check whether this
+                # step's gradients ship (commit_grad_push) or vanish
+                # (drop_grad_push) — a NaN gradient never hits the wire
+                self._deferred_grads = grads
+            else:
+                self._dispatch_grad_push(grads)
+        if self._guard and len(res) > extra:
+            self._last_metrics = res[extra]
         return NDArray(loss_val)
 
     def step(self, data, label):
@@ -658,6 +732,7 @@ class ShardedTrainer:
         ``push_fn=None`` unregisters (after draining)."""
         self.flush_grad_pushes()
         self._grad_push = push_fn
+        self._deferred_grads = None
         self._push_max = max(1, int(max_inflight))
         # cached train fns were built without the grads output
         self._step_fns = {k: v for k, v in self._step_fns.items()
@@ -682,6 +757,140 @@ class ShardedTrainer:
 
         self.set_grad_push(_push, max_inflight=max_inflight)
 
+    # -- guard hooks (mxtpu.resilience.TrainGuard) -------------------------
+    def set_guard(self, enabled):
+        """Build train steps with the fused finite-check + select (see
+        _build_step): the step additionally returns a packed
+        (loss, ok, grad_norm) device vector and holds ALL persistent
+        state at its pre-step value when ok is False. Drops cached train
+        fns — the output signature changes."""
+        self.flush_grad_pushes()
+        self._guard = bool(enabled)
+        self._deferred_grads = None
+        self._last_metrics = None
+        self._step_fns = {k: v for k, v in self._step_fns.items()
+                          if k[0] != "train"}
+
+    def last_metrics(self):
+        """Guard mode: the last step's packed (loss, ok, grad_norm)
+        device vector — ONE host transfer reads all three."""
+        return self._last_metrics
+
+    def commit_grad_push(self):
+        """Guard verdict 'good step': ship the deferred gradients."""
+        grads, self._deferred_grads = self._deferred_grads, None
+        if grads is not None:
+            self._dispatch_grad_push(grads)
+
+    def drop_grad_push(self):
+        """Guard verdict 'bad step': this step's gradients vanish."""
+        self._deferred_grads = None
+
+    def rewind_step(self):
+        """Guard hook for a skipped step: the jitted step already held
+        the device step count at its pre-step value; pull the host-side
+        counter (which drives the LR schedule) back in line."""
+        self._num_update -= 1
+
+    def set_guard_lr_scale(self, scale):
+        """Multiplier the guard applies on top of the schedule (its
+        halve-on-repeated-failure policy); survives checkpoints via
+        state_dict."""
+        self._guard_lr_scale = float(scale)
+
+    # -- elastic resume ----------------------------------------------------
+    def state_dict(self):
+        """Everything the jitted step carries besides the parameters
+        themselves (those ride CheckpointManager's ``params`` tree):
+        step count, host+device RNG keys, optimizer state, LR-scheduler
+        progress and the guard LR scale. Outstanding gradient pushes are
+        drained first so the snapshot never captures a half-shipped
+        window."""
+        self.flush_grad_pushes()
+        st = {"num_update": int(self._num_update),
+              "rng_key": _np.asarray(self._key),
+              "guard_lr_scale": float(self._guard_lr_scale),
+              "lr": float(self._optimizer.lr)}
+        sched = self._optimizer.lr_scheduler
+        if sched is not None:
+            st["lr_scheduler"] = sched.state_dict()
+        if self._placed:
+            if self._key_dev is not None:
+                st["rng_key_dev"] = _np.asarray(self._key_dev)
+            st["opt_state"] = [self._opt_tree_to_np(t)
+                               for t in self._opt_states]
+        return st
+
+    def load_state_dict(self, state):
+        """Restore a :meth:`state_dict`. Parameters must already be back
+        in the block (CheckpointManager.restore writes them first); a
+        placed trainer re-stages them onto the mesh, an unplaced one
+        picks them up at first step."""
+        self.flush_grad_pushes()
+        self._num_update = int(state["num_update"])
+        self._key = jnp.asarray(state["rng_key"])
+        self._guard_lr_scale = float(state.get("guard_lr_scale", 1.0))
+        if "lr" in state:
+            self._optimizer.lr = float(state["lr"])
+        sched = self._optimizer.lr_scheduler
+        if sched is not None and "lr_scheduler" in state:
+            sched.load_state_dict(state["lr_scheduler"])
+        self._pending_key_dev = state.get("rng_key_dev")
+        # force _device_step_state to rebuild (key from the checkpoint,
+        # t from the restored num_update, lr from the restored schedule)
+        self._key_dev = self._t_dev = self._lr_dev = None
+        self._lr_host = None
+        saved_opt = state.get("opt_state")
+        if not self._placed:
+            self._pending_opt_state = saved_opt
+            return
+        # re-stage the (already restored) block parameters on the mesh.
+        # A parameter whose block-side buffer was donated away (caller
+        # round-tripped trainer state WITHOUT restoring params) keeps
+        # its live mesh value instead.
+        def _stage(j, i, store):
+            v = self._params[i].data()._data
+            if not (hasattr(v, "is_deleted") and v.is_deleted()):
+                store[j] = jax.device_put(v, self._shardings[i])
+
+        for j, i in enumerate(self._train_idx):
+            _stage(j, i, self._param_vals)
+        for j, i in enumerate(self._aux_idx):
+            _stage(j, i, self._aux_vals)
+        if saved_opt is not None:
+            self._apply_opt_state(saved_opt)
+
+    @staticmethod
+    def _opt_tree_to_np(tree):
+        """Optimizer-state pytree (nested tuples / None / jax arrays)
+        → host numpy with the same structure."""
+        if tree is None:
+            return None
+        if isinstance(tree, (tuple, list)):
+            return tuple(ShardedTrainer._opt_tree_to_np(t) for t in tree)
+        return _np.asarray(tree)
+
+    def _apply_opt_state(self, saved):
+        """Place host-numpy optimizer-state trees back onto the mesh
+        with the same sharding _place chooses (param-shaped leaves on
+        the param/ZeRO-1 shard, scalars replicated)."""
+        placed = []
+        for j, (i, tree) in enumerate(zip(self._train_idx, saved)):
+            p = self._params[i]
+            sh = self._zero1_shardings[j] or self._shardings[i]
+
+            def place(t, sh=sh, shape=p.shape):
+                if t is None:
+                    return None
+                if isinstance(t, (tuple, list)):
+                    return tuple(place(x, sh, shape) for x in t)
+                tgt = sh if tuple(t.shape) == tuple(shape) \
+                    else self._mesh.replicated()
+                return jax.device_put(_np.asarray(t), tgt)
+
+            placed.append(place(tree))
+        self._opt_states = placed
+
     def _dispatch_grad_push(self, grads):
         names = [self._params[i].name for i in self._train_idx]
         # drain to under the window BEFORE shipping: a slow sink blocks
@@ -701,9 +910,9 @@ class ShardedTrainer:
 
     def _host_lr(self):
         o = self._optimizer
-        if o.lr_scheduler is not None:
-            return float(o.lr_scheduler(self._num_update))
-        return float(o.lr)
+        base = float(o.lr_scheduler(self._num_update)) \
+            if o.lr_scheduler is not None else float(o.lr)
+        return base * self._guard_lr_scale
 
     @property
     def learning_rate(self):

@@ -1,0 +1,119 @@
+"""Elastic worker for the kill -9 / --worker-respawn end-to-end test.
+
+Launched by tools/launch.py -n 1 -s 1 --worker-respawn. The worker runs
+a fixed number of guarded train steps over an NDArrayIter, checkpoints
+its FULL state (params, optimizer, step count, RNG keys, LR-scheduler
+progress, iterator cursor) every few good steps through TrainGuard, and
+pushes every step's gradients to the dist_async parameter server.
+
+With MXTPU_FAULT_SPEC="kind=kill_worker,point=worker.step,nth=K" the
+fault harness SIGKILLs the process deterministically at step-attempt K.
+The launcher respawns it; the fresh process restores the latest
+checkpoint, re-registers with the server (hello + param pull),
+fast-forwards its data iterator, and finishes the remaining steps. The
+nth=K schedule counts per process, so as long as K exceeds the steps
+remaining after a restore the respawned incarnation never re-fires —
+the whole scenario is replayable with zero timing dependence.
+
+Because every source of randomness is seeded and the RNG keys ride the
+checkpoint, the final parameters must be IDENTICAL to an uninterrupted
+run (the parity half of the fault matrix: same script, no fault spec,
+fresh state dir).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx                                           # noqa: E402
+from mxtpu import gluon                                      # noqa: E402
+from mxtpu.gluon import nn                                   # noqa: E402
+from mxtpu.checkpoint import CheckpointManager               # noqa: E402
+from mxtpu.parallel import MeshContext, ShardedTrainer       # noqa: E402
+from mxtpu.resilience import TrainGuard                      # noqa: E402
+
+rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+state_dir = os.environ["MXTPU_WORKER_STATE_DIR"]
+out_dir = os.environ["RESILIENT_TEST_DIR"]
+total_steps = int(os.environ.get("RESILIENT_TOTAL_STEPS", "12"))
+
+# deterministic everything: the respawned incarnation re-derives the
+# same init/data, and the checkpoint carries the RNG streams forward
+np.random.seed(100 + rank)
+mx.random.seed(100 + rank)
+import mxtpu.gluon.block as _blk                             # noqa: E402
+_blk._NAME_COUNTERS.clear()
+
+net = nn.HybridSequential()
+with net.name_scope():
+    net.add(nn.Dense(16), nn.Activation("relu"), nn.Dense(10))
+net.initialize(mx.init.Xavier())
+
+rng = np.random.RandomState(7 + rank)
+X = rng.standard_normal((64, 8)).astype(np.float32)
+Y = rng.randint(0, 10, (64,)).astype(np.float32)
+net(mx.nd.array(X[:8]))
+
+it = mx.io.NDArrayIter(X, Y, batch_size=8)                   # 8 batches/epoch
+sched = mx.lr_scheduler.FactorScheduler(step=4, factor=0.5)
+sched.base_lr = 0.1
+st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                    {"learning_rate": 0.1, "momentum": 0.9,
+                     "lr_scheduler": sched}, mesh=MeshContext())
+ckpt = CheckpointManager(state_dir, max_to_keep=3, async_save=False,
+                         use_orbax=False)
+guard = TrainGuard(st, data_iter=it, ckpt=ckpt, ckpt_every=3, spike_z=0)
+
+kv = None
+if os.environ.get("MXTPU_PS_ADDRS"):
+    kv = mx.kv.create("dist_async")
+    guard.attach_kvstore(kv)
+
+restored = guard.restore()
+if restored is not None:
+    print("worker %d resumed from checkpoint step %d" % (rank, restored),
+          flush=True)
+    if kv is not None:
+        # re-registration already happened at store creation (hello);
+        # pull the server's current view of one key to prove the read
+        # path is live again before training resumes
+        names = sorted(kv._parts)
+        if names:
+            probe = mx.nd.zeros(kv._shapes[names[0]])
+            kv.pull(names[0], out=probe)
+            assert np.isfinite(probe.asnumpy()).all()
+
+loss = float("nan")
+while st._num_update < total_steps:
+    try:
+        batch = it.next()
+    except StopIteration:
+        it.reset()
+        batch = it.next()
+    loss = guard.step(batch.data[0], batch.label[0])
+
+if not np.isfinite(loss):
+    # a restore may land exactly at total_steps (nothing left to run):
+    # evaluate once so the finiteness claim still covers the params
+    loss, _ = st.forward(X[:8], Y[:8])
+assert np.isfinite(loss), "final loss is not finite: %r" % loss
+st.sync_params()
+params = {p.name: p.data().asnumpy() for p in net._ordered_params()}
+np.savez(os.path.join(out_dir, "rank%d_params.npz" % rank), **params)
+with open(os.path.join(out_dir, "rank%d.json" % rank), "w") as f:
+    json.dump({"rank": rank, "steps": int(st._num_update),
+               "loss": loss, "resumed_from": restored,
+               "lr": float(st.learning_rate),
+               "guard": {k: v for k, v in guard.stats().items()
+                         if isinstance(v, (int, float))}}, f)
+if kv is not None:
+    # bounded even if a peer died: the server releases the barrier on
+    # its MXTPU_PS_BARRIER_TIMEOUT deadline instead of hanging us
+    kv.barrier()
+    kv.close()
+print("RANK_%d_OK" % rank, flush=True)

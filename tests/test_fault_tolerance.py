@@ -18,6 +18,19 @@ kill   @ server.recv                -> snapshot-backed restart, buffered
                                        pushes flushed, workers reconverge
 server gone (no injection)          -> pull degrades to cached value,
                                        health() reports the dead shard
+kill_worker mid-push-window         -> SIGKILL between pipelined part
+                                       pushes: the applied prefix is
+                                       consistent (each part <= once),
+                                       the dead worker's membership +
+                                       dedupe seqs are GC'd, the fleet
+                                       continues (worker-liveness rows)
+stall  @ worker.send                -> straggler surfaces in the
+                                       per-worker push counters /
+                                       kv.stats()["stragglers"]
+worker dead (no bye)                -> server-side lease expiry GCs its
+                                       buffered state; barrier degrades
+                                       on its deadline instead of
+                                       hanging the survivors
 """
 import os
 
@@ -579,7 +592,185 @@ def test_coalesced_multi_sever_mid_batch(monkeypatch):
         srv.stop()
 
 
-def test_local_transport_fault_parity(monkeypatch):
+def test_worker_membership_hello_bye_gc(monkeypatch):
+    """Worker-liveness row: a store registers at creation (hello), its
+    pushes feed per-worker counters, and a clean close (bye) drops the
+    membership AND reclaims the worker's dedupe seqs — the per-origin
+    at-most-once table cannot grow one entry per worker incarnation
+    forever."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    origin = kv._origin
+    try:
+        assert origin in srv._workers          # hello at creation
+        epoch0 = srv._membership_epoch
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.push("w", mx.nd.ones((4,)))
+        kv.push("w", mx.nd.ones((4,)))
+        rec = srv._workers[origin]
+        assert rec["rank"] == 0 and rec["pushes"] == 2
+        assert (origin, "w") in srv._applied
+        s = kv.stats()
+        assert s["workers"][origin]["pushes"] == 2
+        assert s["membership_epoch"] == epoch0
+        h = kv.health()
+        assert origin in h["workers"] and h["stragglers"] == []
+    finally:
+        kv.close()                             # sends bye
+        assert origin not in srv._workers
+        assert (origin, "w") not in srv._applied
+        assert srv._membership_epoch == epoch0 + 1
+        srv.stop()
+
+
+def test_dead_worker_lease_expiry_gc(monkeypatch):
+    """A worker that vanishes WITHOUT a bye (kill -9): once its lease is
+    silent past MXTPU_PS_WORKER_DEAD_AFTER, the next sweep garbage-
+    collects its membership and buffered dedupe state."""
+    import time
+    monkeypatch.setattr(ka, "_WORKER_DEAD_AFTER", 0.05)
+    srv = ParameterServer().start()
+    conn = ka._ServerConn(srv.address)
+    try:
+        conn.request("init", "w", np.zeros(4, "f"))
+        conn.request("hello", "gone-worker", 3)
+        conn.request("push", "w", np.ones(4, "f"), 0, "gone-worker", 1)
+        assert "gone-worker" in srv._workers
+        assert ("gone-worker", "w") in srv._applied
+        time.sleep(0.08)                       # lease expires
+        assert srv._gc_workers() == 1          # the lazy sweep reaps it
+        assert "gone-worker" not in srv._workers
+        assert ("gone-worker", "w") not in srv._applied
+        # the table itself is untouched — only the worker's bookkeeping
+        np.testing.assert_allclose(srv._table["w"], np.ones(4))
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_barrier_deadline_degrades_instead_of_hanging(monkeypatch):
+    """A barrier a dead member can never complete: the server force-
+    releases the generation at the deadline, the waiter returns (logged
+    + counted), and the NEXT barrier round starts clean."""
+    import time
+    monkeypatch.setattr(ka, "_BARRIER_TIMEOUT", 0.3)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address, rank=0, nproc=2)
+    try:
+        kv.init("w", mx.nd.zeros((2,)))        # init barriers: 2 workers
+        # ...which itself would hang forever without the deadline — the
+        # second worker never existed. Measure the bound:
+        t0 = time.time()
+        kv.barrier()
+        assert time.time() - t0 < 5
+        assert srv._barrier_timeouts >= 1
+        assert srv._barrier_arrived == 0       # generation fully reset
+        assert kv.stats()["barrier_timeouts"] >= 1
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_stall_fault_surfaces_straggler_counters(monkeypatch):
+    """stall row: a stalled worker's push rate falls behind the fleet;
+    the per-worker push counters make the straggler observable in
+    kv.stats() — push-count based, so the verdict is deterministic."""
+    monkeypatch.setattr(ka, "_STRAGGLER_MIN", 10)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        # the stalled worker: 3 pushes, each through an injected stall
+        # (tiny delay — the *counter* is the evidence, not wall time)
+        with fault.inject("kind=stall,point=worker.send,op=push,"
+                          "delay=0.01,count=3") as inj:
+            for _ in range(3):
+                kv.push("w", mx.nd.ones((4,)))
+        assert inj.stats()[0][4] == 3
+        # a healthy peer outruns it 4:1
+        conn = ka._ServerConn(srv.address)
+        conn.request("hello", "fast-worker", 1)
+        for i in range(12):
+            conn.request("push", "w", np.ones(4, "f"), 0,
+                         "fast-worker", i + 1)
+        s = kv.stats()
+        assert s["workers"][kv._origin]["pushes"] == 3
+        assert s["workers"]["fast-worker"]["pushes"] == 12
+        assert kv._origin in s["stragglers"]
+        assert "fast-worker" not in s["stragglers"]
+        conn.close()
+    finally:
+        kv.close()
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_kill_worker_mid_push_window(monkeypatch, tmp_path):
+    """kill_worker row: a child worker is SIGKILLed by the fault
+    harness between the pipelined part-pushes of one big array. The
+    server must be left consistent — every part applied at most once,
+    no torn values — and a successor worker (fresh origin, the
+    launcher-respawn situation) completes the same push cleanly."""
+    import json
+    import subprocess
+    import sys
+    srv = ParameterServer().start()
+    child = r"""
+import os, numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import mxtpu as mx
+from mxtpu import kvstore_async as ka
+ka._BIGARRAY_BOUND = 4            # (8, 4) splits into 8 one-row parts
+ka._COALESCE_BYTES = 0
+kv = mx.kv.create("dist_async")
+kv.init("w", mx.nd.zeros((8, 4)))
+print("READY", flush=True)
+# SIGKILL fires on the 5th wire event after init's frames drain —
+# mid-window, with a prefix of the 8 part-pushes applied
+import mxtpu.fault as fault
+fault.install("kind=kill_worker,point=any,op=push,nth=5")
+kv.push("w", mx.nd.ones((8, 4)))
+print("UNREACHABLE", flush=True)
+"""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "MXTPU_PS_ADDRS": srv.address,
+                "MXTPU_PS_HEARTBEAT": "0", "MXTPU_PS_LOCAL": "0",
+                "MXTPU_PROC_ID": "0", "MXTPU_NUM_PROCS": "1"})
+    try:
+        proc = subprocess.run([sys.executable, "-c", child], env=env,
+                              capture_output=True, text=True, timeout=120)
+        assert "READY" in proc.stdout, proc.stdout + proc.stderr
+        assert "UNREACHABLE" not in proc.stdout
+        assert proc.returncode == -9           # really SIGKILLed
+        # applied prefix is consistent: each part 0 or 1 times, values
+        # whole (the zero-copy receive can never tear a row)
+        for i in range(8):
+            sk = "w\x00%d" % i
+            assert srv._clock[sk] in (0, 1)
+            row = srv._table[sk]
+            assert np.allclose(row, 0.0) or np.allclose(row, 1.0)
+        applied = sum(srv._clock["w\x00%d" % i] for i in range(8))
+        assert applied < 8                     # it really died mid-push
+        # the successor (fresh origin = respawned worker) finishes the
+        # job: its push is NOT deduped against the dead origin's seqs
+        monkeypatch.setattr(ka, "_BIGARRAY_BOUND", 4)
+        monkeypatch.setattr(ka, "_COALESCE_BYTES", 0)
+        kv = _store(monkeypatch, srv.address)
+        try:
+            kv.push("w", mx.nd.ones((8, 4)))
+            out = mx.nd.zeros((8, 4))
+            kv.pull("w", out=out)
+            got = out.asnumpy()
+            # every row = prefix (0/1) + successor's 1
+            for i in range(8):
+                expect = 1.0 + (1.0 if srv._clock["w\x00%d" % i] == 2
+                                else 0.0)
+                assert np.allclose(got[i], expect), (i, got[i])
+        finally:
+            kv.close()
+    finally:
+        srv.stop()
     """The same-process shortcut must keep the matrix semantics: a
     post-apply sever replays through the same retry layer and the
     replay is seq-deduped — at-most-once holds with zero wire."""

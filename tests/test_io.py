@@ -400,3 +400,226 @@ def test_prefetching_iter_reset_mid_epoch():
         "mid-epoch reset dropped or reordered a batch"
     it.reset()                            # reset at epoch END also clean
     assert sum(1 for _ in it) == 4
+
+
+# ---------------------------------------------------------------------------
+# elastic-resume iterator state (ISSUE 3): state_dict/load_state_dict
+# round-trips for mid-epoch positions, including restores into FRESH
+# process-like objects with prefetch threads restarted cleanly
+# ---------------------------------------------------------------------------
+
+def _epoch_data(n=20, width=2):
+    X = np.arange(n * width, dtype=np.float32).reshape(n, width)
+    Y = np.arange(n, dtype=np.float32)
+    return X, Y
+
+
+def test_ndarray_iter_state_roundtrip_mid_epoch():
+    X, Y = _epoch_data()
+    it = mx.io.NDArrayIter(X, Y, batch_size=4)
+    [it.next() for _ in range(2)]
+    state = it.state_dict()
+    assert state["cursor"] == 4
+    want = [it.next().data[0].asnumpy() for _ in range(3)]
+    it2 = mx.io.NDArrayIter(X, Y, batch_size=4)
+    it2.load_state_dict(state)
+    got = [it2.next().data[0].asnumpy() for _ in range(3)]
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+    # epoch boundary then next epoch behaves normally
+    with pytest.raises(StopIteration):
+        it2.next()
+    it2.reset()
+    assert it2.next().pad == 0
+
+
+def test_ndarray_iter_state_restores_shuffle_order():
+    """The saved run's epoch ORDER must survive a restore into a fresh,
+    differently-shuffled iterator — the permutation rides the state, so
+    no sample is skipped or double-trained mid-epoch."""
+    X, Y = _epoch_data()
+    np.random.seed(10)
+    it = mx.io.NDArrayIter(X, Y, batch_size=4, shuffle=True)
+    [it.next() for _ in range(2)]
+    state = it.state_dict()
+    want = [it.next().data[0].asnumpy() for _ in range(3)]
+    np.random.seed(99)                    # a fresh process shuffles anew
+    it2 = mx.io.NDArrayIter(X, Y, batch_size=4, shuffle=True)
+    it2.load_state_dict(state)
+    got = [it2.next().data[0].asnumpy() for _ in range(3)]
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+    # data/label rows stay aligned through the re-gather
+    it3 = mx.io.NDArrayIter(X, Y, batch_size=4, shuffle=True)
+    it3.load_state_dict(state)
+    b = it3.next()
+    np.testing.assert_array_equal(b.data[0].asnumpy()[:, 0] // 2,
+                                  b.label[0].asnumpy())
+    # mismatched batch size is refused loudly, not silently misaligned
+    it4 = mx.io.NDArrayIter(X, Y, batch_size=5, shuffle=True)
+    with pytest.raises(ValueError, match="batch_size"):
+        it4.load_state_dict(state)
+
+
+def test_resize_iter_state_roundtrip_across_wrap():
+    """ResizeIter longer than the wrapped epoch: the wrap-around
+    position (inner epoch + cursor) must ride the state."""
+    X, Y = _epoch_data()                  # 5 inner batches of 4
+    it = mx.io.ResizeIter(mx.io.NDArrayIter(X, Y, batch_size=4), 8)
+    [it.next() for _ in range(6)]         # 1 past the inner wrap
+    state = it.state_dict()
+    assert state["cur"] == 6
+    want = [it.next().data[0].asnumpy() for _ in range(2)]
+    it2 = mx.io.ResizeIter(mx.io.NDArrayIter(X, Y, batch_size=4), 8)
+    it2.load_state_dict(state)
+    got = [it2.next().data[0].asnumpy() for _ in range(2)]
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(StopIteration):
+        it2.next()                        # resized epoch ends on time
+
+
+def test_prefetching_iter_state_is_delivered_position():
+    """The prefetch thread runs AHEAD of the consumer; state_dict must
+    report the position after the last batch the consumer actually saw,
+    not the position the worker ran ahead to — otherwise a restore
+    skips the prefetched-but-unconsumed batch."""
+    X, Y = _epoch_data()
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, Y, batch_size=4))
+    b1 = it.next().data[0].asnumpy()
+    state = it.state_dict()
+    assert state["delivered"] == 1
+    # the inner snapshot rides at the delivered position (batch 1 →
+    # cursor 0), even though the worker has already fetched batch 2
+    # (cursor 4) — the run-ahead must not leak into the state
+    assert state["iters"][0]["cursor"] == 0
+    b2 = it.next().data[0].asnumpy()
+    assert not np.array_equal(b1, b2)
+
+
+def test_prefetching_iter_state_restore_into_fresh_object():
+    """Restore into a brand-new PrefetchingIter (fresh prefetch threads
+    already running, one batch eagerly prefetched from position 0):
+    the wrapped iterators rewind to the saved cursor, the stale
+    prefetched batch is dropped, and the stream continues exactly
+    where the saved run left off — then resets cleanly for the next
+    epoch (threads survive the restore)."""
+    X, Y = _epoch_data()
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, Y, batch_size=4))
+    [it.next() for _ in range(2)]
+    state = it.state_dict()
+    want = [it.next().data[0].asnumpy() for _ in range(3)]
+
+    it2 = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, Y, batch_size=4))
+    it2.load_state_dict(state)
+    got = [it2.next().data[0].asnumpy() for _ in range(3)]
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+    assert not it2.iter_next()            # epoch ends at the right spot
+    it2.reset()                           # threads restart cleanly...
+    count = 0
+    while it2.iter_next():
+        count += 1
+    assert count == 5                     # ...and the next epoch is full
+
+
+def test_prefetching_iter_state_stateless_inner_fast_forwards():
+    """A wrapped iterator with no capturable state ({}): restore resets
+    it and fast-forwards through the delivered count — slower, but no
+    batch is skipped or repeated."""
+
+    class Counting(mx.io.DataIter):       # stateless: base state_dict
+        def __init__(self):
+            super().__init__(batch_size=2)
+            self.provide_data = [("data", (2, 3))]
+            self.provide_label = [("label", (2,))]
+            self.i = 0
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= 6:
+                raise StopIteration
+            b = mx.io.DataBatch(
+                [mx.nd.array(np.full((2, 3), self.i, "float32"))],
+                [mx.nd.array(np.zeros(2, "float32"))], pad=0)
+            self.i += 1
+            return b
+
+    it = mx.io.PrefetchingIter(Counting())
+    [it.next() for _ in range(3)]
+    state = it.state_dict()
+    assert state["iters"] == [{}]
+    it2 = mx.io.PrefetchingIter(Counting())
+    it2.load_state_dict(state)
+    vals = [float(it2.next().data[0].asnumpy()[0, 0]) for _ in range(3)]
+    assert vals == [3.0, 4.0, 5.0]
+
+
+def test_prefetching_iter_duck_types_state_dict():
+    """An iterator outside the DataIter hierarchy (no state_dict at
+    all — e.g. image.ImageIter before it grew the contract) still
+    prefetches; its snapshot rides as None and restore falls back to
+    reset + fast-forward. Regression: the worker thread used to die on
+    the missing attribute and strand the consumer in _wait_all."""
+
+    class Bare:                           # deliberately NOT a DataIter
+        batch_size = 2
+        provide_data = [("data", (2, 3))]
+        provide_label = [("label", (2,))]
+
+        def __init__(self):
+            self.i = 0
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= 6:
+                raise StopIteration
+            b = mx.io.DataBatch(
+                [mx.nd.array(np.full((2, 3), self.i, "float32"))],
+                [mx.nd.array(np.zeros(2, "float32"))], pad=0)
+            self.i += 1
+            return b
+
+    it = mx.io.PrefetchingIter(Bare())
+    [it.next() for _ in range(3)]
+    state = it.state_dict()
+    assert state["iters"] == [None]
+    it2 = mx.io.PrefetchingIter(Bare())
+    it2.load_state_dict(state)
+    vals = [float(it2.next().data[0].asnumpy()[0, 0]) for _ in range(3)]
+    assert vals == [3.0, 4.0, 5.0]
+
+
+def test_prefetching_iter_propagates_worker_error():
+    """A wrapped iterator that raises mid-stream: the error surfaces
+    from next() on the consumer thread in bounded time instead of
+    hanging the pipeline (the dead-worker hang this guards against is
+    exactly what a respawned worker must never inherit)."""
+
+    class Exploding(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=2)
+            self.provide_data = [("data", (2, 3))]
+            self.provide_label = [("label", (2,))]
+            self.i = 0
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= 2:
+                raise RuntimeError("disk on fire")
+            b = mx.io.DataBatch(
+                [mx.nd.array(np.full((2, 3), self.i, "float32"))],
+                [mx.nd.array(np.zeros(2, "float32"))], pad=0)
+            self.i += 1
+            return b
+
+    it = mx.io.PrefetchingIter(Exploding())
+    [it.next() for _ in range(2)]
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        it.next()

@@ -1,0 +1,367 @@
+"""Worker-side resilience (mxtpu/resilience.py TrainGuard + the
+trainer/iterator/scheduler state plumbing behind elastic resume).
+
+Deterministic like the rest of the fault matrix: NaN/spike/stall events
+come from the injection harness (or explicit calls) on exact step
+schedules, and every assertion is on counters/values, never timing. The
+rows this file covers:
+
+fault / scenario                      -> defense proven
+---------------------------------------------------------------------
+nan_grad @ worker.step (skip policy)  -> in-jit finite check: params,
+                                         opt state, aux and step count
+                                         held; kvstore push dropped;
+                                         server table stays finite
+nan_grad (rollback policy)            -> M consecutive bad steps restore
+                                         the last-good checkpoint
+consecutive bad steps                 -> LR halved every K, scale rides
+                                         checkpoints
+finite loss spike                     -> EMA z-score soft anomaly: push
+                                         withheld, streak counted
+kill -9 / elastic resume (in-process  -> full worker state round-trips
+half; the real SIGKILL e2e lives in      through CheckpointManager
+test_dist_launch.py)                     (step, RNG, optimizer, LR
+                                         schedule, iterator cursor)
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import fault, gluon
+from mxtpu.gluon import nn
+from mxtpu.checkpoint import CheckpointManager
+from mxtpu.parallel import MeshContext, ShardedTrainer
+from mxtpu.resilience import TrainGuard
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+def _xy(seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(8, 4).astype(np.float32),
+            rs.randint(0, 10, (8,)).astype(np.float32))
+
+
+def _trainer(seed=3, **kw):
+    import mxtpu.gluon.block as _blk
+    _blk._NAME_COUNTERS.clear()
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16), nn.Activation("relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x, _ = _xy()
+    net(mx.nd.array(x))
+    kw.setdefault("mesh", MeshContext(data=8))
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "sgd", kw.pop("optimizer_params",
+                                      {"learning_rate": 0.1,
+                                       "momentum": 0.9}), **kw)
+    return net, st
+
+
+# ---------------------------------------------------------------------------
+# the new fault kinds
+# ---------------------------------------------------------------------------
+
+def test_new_fault_kinds_parse_and_validate():
+    rules = fault.parse_spec(
+        "kind=nan_grad,point=worker.step,nth=3,count=2;"
+        "kind=stall,point=worker.send,op=push,delay=0.01;"
+        "kind=kill_worker,point=worker.step,nth=9")
+    assert [r.kind for r in rules] == ["nan_grad", "stall", "kill_worker"]
+    with pytest.raises(ValueError, match="worker.step"):
+        fault.parse_spec("kind=nan_grad,point=server.recv")
+    with pytest.raises(ValueError, match="worker.step"):
+        fault.parse_spec("kind=kill_worker,point=worker.send")
+
+
+def test_nan_grad_schedule_is_deterministic():
+    inj = fault.FaultInjector(
+        "kind=nan_grad,point=worker.step,nth=2,count=2")
+    acts = [inj.fire("worker.step", op="step") for _ in range(5)]
+    assert acts == [None, "nan_grad", "nan_grad", None, None]
+
+
+# ---------------------------------------------------------------------------
+# the guarded step: skip policy
+# ---------------------------------------------------------------------------
+
+def test_nan_grad_skipped_in_jit():
+    """The acceptance row: injected NaN gradients with TrainGuard active
+    leave params/opt-state/step-count untouched — selected in the SAME
+    jitted program, not patched up afterwards — and the skip counters
+    match the injection schedule exactly."""
+    _, st = _trainer()
+    x, y = _xy()
+    guard = TrainGuard(st, spike_z=0)
+    losses = [guard.step(x, y) for _ in range(2)]
+    assert all(np.isfinite(l) for l in losses)
+    w_good = np.asarray(st._param_vals[0]).copy()
+    opt_good = np.asarray(st._opt_states[0][0]).copy()   # sgd momentum
+    t_good = int(st._num_update)
+    with fault.inject(
+            "kind=nan_grad,point=worker.step,nth=1,count=3") as inj:
+        bad = [guard.step(x, y) for _ in range(3)]
+    assert inj.stats()[0][4] == 3
+    assert all(np.isnan(l) for l in bad)      # caller sees the truth
+    np.testing.assert_array_equal(np.asarray(st._param_vals[0]), w_good)
+    np.testing.assert_array_equal(np.asarray(st._opt_states[0][0]),
+                                  opt_good)
+    assert int(st._num_update) == t_good      # LR schedule unmoved
+    assert int(np.asarray(st._t_dev)) == t_good
+    s = guard.stats()
+    assert s["steps"] == 5 and s["good_steps"] == 2
+    assert s["skipped"] == 3 and s["skipped_nonfinite"] == 3
+    assert s["rollbacks"] == 0
+    # and training continues cleanly once the injection window closes
+    assert np.isfinite(guard.step(x, y))
+    assert int(st._num_update) == t_good + 1
+
+
+def test_guard_keeps_server_table_finite():
+    """nan_grad + attached dist_async store: the poisoned step's push is
+    dropped before it ever reaches the wire — the server table stays
+    finite and the guard counters surface in kv.stats()['guard'] with
+    exactly the injected schedule."""
+    from mxtpu.kvstore_async import ParameterServer
+    os.environ["MXTPU_PS_HEARTBEAT"] = "0"
+    _, st = _trainer()
+    x, y = _xy()
+    srv = ParameterServer().start()
+    os.environ["MXTPU_PS_ADDRS"] = srv.address
+    kv = mx.kv.create("dist_async")
+    try:
+        guard = TrainGuard(st, spike_z=0)
+        guard.attach_kvstore(kv)
+        guard.step(x, y)
+        with fault.inject(
+                "kind=nan_grad,point=worker.step,nth=1,count=2") as inj:
+            guard.step(x, y)
+            guard.step(x, y)
+        guard.step(x, y)
+        st.flush_grad_pushes()
+        assert inj.stats()[0][4] == 2
+        # every table entry finite: no NaN was ever applied
+        for k, v in srv._table.items():
+            assert np.isfinite(v).all(), k
+        # clocks prove the two bad steps' pushes never arrived
+        names = [p.name for p in st._params if p.grad_req != "null"]
+        for n in names:
+            assert srv._clock[n] == 2, (n, srv._clock)
+        s = kv.stats()
+        assert s["guard"]["skipped_nonfinite"] == 2
+        assert s["guard"]["good_steps"] == 2
+        assert s["guard"]["rollbacks"] == 0
+    finally:
+        kv.close()
+        srv.stop()
+        del os.environ["MXTPU_PS_ADDRS"]
+
+
+def test_lr_halving_on_consecutive_bad_steps():
+    _, st = _trainer()
+    x, y = _xy()
+    guard = TrainGuard(st, spike_z=0, lr_halve_after=2)
+    guard.step(x, y)
+    lr0 = st.learning_rate
+    with fault.inject("kind=nan_grad,point=worker.step,nth=1,count=4"):
+        for _ in range(4):
+            guard.step(x, y)
+    assert st.learning_rate == pytest.approx(lr0 * 0.25)
+    assert guard.stats()["lr_halvings"] == 2
+    # a good step resets the streak, not the scale (the model earned
+    # that caution) — scale persists until a rollback/restore says so
+    guard.step(x, y)
+    assert guard.stats()["bad_streak"] == 0
+    assert st.learning_rate == pytest.approx(lr0 * 0.25)
+
+
+def test_spike_detector_soft_anomaly():
+    """A finite loss far outside the EMA distribution: the update
+    already happened (finiteness was fine) but the gradients are
+    withheld and the streak counts — a soft anomaly, not a skip."""
+    _, st = _trainer()
+    x, y = _xy()
+    guard = TrainGuard(st, spike_z=3.0, spike_warmup=3, spike_window=10)
+    seen = []
+
+    def fake_push(grads):
+        seen.append(len(grads))
+
+    st.set_grad_push(fake_push)
+    guard._trainer.set_guard(True)        # set_grad_push dropped caches
+    for _ in range(4):
+        guard.step(x, y)
+    n_good = len(seen)
+    assert n_good == 4
+    # forge a spike through the real pipeline: poison the EMA baseline
+    # comparison by feeding a loss 1000x the baseline — easiest done by
+    # scaling the labels into nonsense for one step is NOT finite-safe,
+    # so drive the detector directly with the real update path instead
+    assert guard._spike_check(guard._ema_mean * 1000 + 1000.0)
+    guard._c["spikes"] += 0               # (sanity: callable state)
+    s = guard.stats()
+    assert s["spikes"] == 0               # _spike_check alone is pure
+    # and through step(): monkey-level injection via a huge-loss batch
+    big = x * 1e18                        # finite loss, absurd scale
+    loss = guard.step(big, y)
+    if np.isfinite(loss):                 # spike path (not inf overflow)
+        assert guard.stats()["spikes"] == 1
+        assert len(seen) == n_good        # push withheld
+    else:                                 # overflowed to inf -> hard skip
+        assert guard.stats()["skipped_nonfinite"] == 1
+        assert len(seen) == n_good
+
+
+def test_rollback_policy_restores_last_good(tmp_path):
+    _, st = _trainer()
+    x, y = _xy()
+    ck = CheckpointManager(str(tmp_path / "g"), async_save=False,
+                           use_orbax=False)
+    guard = TrainGuard(st, ckpt=ck, policy="rollback", rollback_after=3,
+                       lr_halve_after=0, spike_z=0, ckpt_every=0)
+    guard.step(x, y)
+    guard.step(x, y)
+    assert guard.save() == 2
+    w_good = np.asarray(st._param_vals[0]).copy()
+    with fault.inject("kind=nan_grad,point=worker.step,nth=1,count=3"):
+        for _ in range(3):
+            guard.step(x, y)
+    s = guard.stats()
+    assert s["rollbacks"] == 1 and s["restores"] == 1
+    assert s["bad_streak"] == 0
+    np.testing.assert_allclose(np.asarray(st._param_vals[0]), w_good,
+                               rtol=1e-6)
+    assert int(st._num_update) == 2
+    assert np.isfinite(guard.step(x, y))
+
+
+# ---------------------------------------------------------------------------
+# elastic resume: full worker state round trip
+# ---------------------------------------------------------------------------
+
+def test_worker_state_roundtrip_matches_uninterrupted(tmp_path):
+    """Save at step 3, keep training to 6; a FRESH process-alike
+    (new net/trainer/iterator from the same seeds) restores the
+    checkpoint, fast-forwards its iterator, trains the same 3 remaining
+    steps — and lands on identical parameters and LR-schedule position.
+    This is the in-process half of the e2e kill -9 parity test."""
+    rs = np.random.RandomState(11)
+    X = rs.randn(32, 4).astype(np.float32)
+    Y = rs.randint(0, 10, (32,)).astype(np.float32)
+
+    def build():
+        import mxtpu.gluon.block as _blk
+        _blk._NAME_COUNTERS.clear()
+        mx.random.seed(5)
+        np.random.seed(5)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16), nn.Activation("relu"), nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.array(X[:8]))
+        sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+        sched.base_lr = 0.1
+        st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            "sgd", {"learning_rate": 0.1,
+                                    "momentum": 0.9,
+                                    "lr_scheduler": sched},
+                            mesh=MeshContext(data=8))
+        it = mx.io.NDArrayIter(X, Y, batch_size=8)
+        return net, st, it
+
+    def advance(guard, it, st, n):
+        for _ in range(n):
+            try:
+                b = it.next()
+            except StopIteration:
+                it.reset()
+                b = it.next()
+            guard.step(b.data[0], b.label[0])
+
+    ckdir = str(tmp_path / "w")
+    net, st, it = build()
+    guard = TrainGuard(st, data_iter=it,
+                       ckpt=CheckpointManager(ckdir, async_save=False,
+                                              use_orbax=False),
+                       ckpt_every=0, spike_z=0)
+    advance(guard, it, st, 3)
+    guard.save()
+    advance(guard, it, st, 3)
+    st.sync_params()
+    want = {p.name: p.data().asnumpy().copy()
+            for p in net._ordered_params()}
+    want_lr = st.learning_rate
+
+    net2, st2, it2 = build()
+    guard2 = TrainGuard(st2, data_iter=it2,
+                        ckpt=CheckpointManager(ckdir, async_save=False,
+                                               use_orbax=False),
+                        ckpt_every=0, spike_z=0)
+    assert guard2.restore() == 3
+    assert int(st2._num_update) == 3
+    advance(guard2, it2, st2, 3)
+    st2.sync_params()
+    assert st2.learning_rate == pytest.approx(want_lr)
+    for p in net2._ordered_params():
+        np.testing.assert_allclose(
+            p.data().asnumpy(), want[p.name], rtol=1e-6, atol=1e-7,
+            err_msg="resume diverged at %s" % p.name)
+
+
+def test_scheduler_state_rides_trainer_checkpoint(tmp_path):
+    """Satellite: LR-scheduler progress (FactorScheduler's applied-decay
+    counter) round-trips through CheckpointManager.save/restore with the
+    trainer — a resume mid-schedule continues the decay ladder instead
+    of replaying it from scratch."""
+    net, st = _trainer(optimizer_params={
+        "learning_rate": 1.0,
+        "lr_scheduler": mx.lr_scheduler.FactorScheduler(step=2,
+                                                        factor=0.5)})
+    x, y = _xy()
+    for _ in range(5):                    # two decays applied
+        st.step(x, y)
+    lr_mid = st.learning_rate
+    assert lr_mid < 1.0
+    ck = CheckpointManager(str(tmp_path / "s"), async_save=False,
+                           use_orbax=False)
+    st.sync_params()
+    ck.save(5, net.collect_params(), trainer=st)
+
+    net2, st2 = _trainer(optimizer_params={
+        "learning_rate": 1.0,
+        "lr_scheduler": mx.lr_scheduler.FactorScheduler(step=2,
+                                                        factor=0.5)})
+    ck.restore(5, net2.collect_params(), trainer=st2)
+    assert int(st2._num_update) == 5
+    assert st2.learning_rate == pytest.approx(lr_mid)
+    sched = st2._optimizer.lr_scheduler
+    assert sched.count == st._optimizer.lr_scheduler.count
+    assert sched.base_lr == pytest.approx(
+        st._optimizer.lr_scheduler.base_lr)
+
+
+def test_scheduler_state_dicts():
+    s = mx.lr_scheduler.FactorScheduler(step=3, factor=0.5)
+    s.base_lr = 1.0
+    s(7)                                   # decays applied
+    st = s.state_dict()
+    s2 = mx.lr_scheduler.FactorScheduler(step=3, factor=0.5)
+    s2.load_state_dict(st)
+    assert (s2.base_lr, s2.count) == (s.base_lr, s.count)
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[2, 4], factor=0.1)
+    m.base_lr = 1.0
+    m(3)
+    m2 = mx.lr_scheduler.MultiFactorScheduler(step=[2, 4], factor=0.1)
+    m2.load_state_dict(m.state_dict())
+    assert (m2.base_lr, m2.count, m2.cur_step_ind) == \
+        (m.base_lr, m.count, m.cur_step_ind)

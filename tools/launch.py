@@ -86,6 +86,12 @@ def launch_local(args, command):
         server_ports.append(ps_port)
         server_procs.append(_spawn_server(s, ps_port, base_env, args))
         ps_addrs.append("127.0.0.1:%d" % ps_port)
+    if args.worker_respawn and not args.worker_state_dir:
+        # a respawned worker with no state dir restarts from step 0 and
+        # double-trains its epoch — auto-provision one, like --ps-respawn
+        args.worker_state_dir = tempfile.mkdtemp(prefix="mxtpu_worker_")
+        print("worker state in %s" % args.worker_state_dir)
+    worker_envs = []
     for rank in range(args.num_workers):
         env = dict(base_env)
         env.update({
@@ -100,11 +106,36 @@ def launch_local(args, command):
         })
         if ps_addrs:
             env["MXTPU_PS_ADDRS"] = ",".join(ps_addrs)
+        if args.worker_state_dir:
+            # per-rank checkpoint dir a TrainGuard/CheckpointManager
+            # worker saves its state into; the respawn reuses it so the
+            # fresh process restores and fast-forwards
+            env["MXTPU_WORKER_STATE_DIR"] = os.path.join(
+                args.worker_state_dir, "worker_%d" % rank)
+        worker_envs.append(env)
         procs.append(subprocess.Popen(command, shell=True, env=env))
     code = 0
     respawns = [0] * len(server_procs)
+    worker_respawns = [0] * len(procs)
     try:
-        while any(p.poll() is None for p in procs):
+        # respawn passes run BEFORE the liveness check: a fleet whose
+        # last worker just got kill -9'd must be revived, not reaped
+        # (with -n 1 the old any-alive loop condition would exit first)
+        while True:
+            if args.worker_respawn:
+                for i, wp in enumerate(procs):
+                    rc = wp.poll()
+                    if rc is None or rc == 0:
+                        continue   # alive, or finished cleanly
+                    if worker_respawns[i] >= args.worker_max_respawns:
+                        continue   # budget spent: the exit code stands
+                    worker_respawns[i] += 1
+                    print("worker %d died (exit %d); respawning "
+                          "(%d/%d)" % (i, rc, worker_respawns[i],
+                                       args.worker_max_respawns),
+                          flush=True)
+                    procs[i] = subprocess.Popen(
+                        command, shell=True, env=worker_envs[i])
             if args.ps_respawn:
                 for i, sp in enumerate(server_procs):
                     rc = sp.poll()
@@ -120,6 +151,8 @@ def launch_local(args, command):
                           flush=True)
                     server_procs[i] = _spawn_server(
                         i, server_ports[i], base_env, args)
+            if all(p.poll() is not None for p in procs):
+                break
             time.sleep(0.2)
         for p in procs:
             code = code or p.returncode
@@ -265,6 +298,20 @@ def main():
                         "under $TMPDIR when --ps-respawn is on")
     p.add_argument("--ps-snapshot-every", type=int, default=100,
                    help="pushes between server snapshots")
+    p.add_argument("--worker-respawn", action="store_true",
+                   help="local launcher: respawn a worker that exits "
+                        "non-zero (kill -9 included); with a state dir "
+                        "the fresh process restores its checkpoint, "
+                        "re-registers with the servers and fast-forwards "
+                        "its data iterator (mxtpu.resilience.TrainGuard)")
+    p.add_argument("--worker-max-respawns", type=int, default=3,
+                   help="respawn budget per worker before its death "
+                        "is final")
+    p.add_argument("--worker-state-dir", default=None,
+                   help="base dir for per-worker checkpoints (rank r "
+                        "uses <dir>/worker_r, exported as "
+                        "MXTPU_WORKER_STATE_DIR); auto-created under "
+                        "$TMPDIR when --worker-respawn is on")
     p.add_argument("--launcher",
                    choices=("local", "ssh", "mpi", "slurm", "sge"),
                    default="local")
