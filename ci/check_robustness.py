@@ -23,6 +23,11 @@ swallow a failure invisibly. This check fails CI on NEW instances of:
    worker's interpreter alive, which defeats ``kill``-based respawn
    (the launcher waits on a zombie). Every in-tree thread is a daemon
    today; keep it that way.
+5. **Replication ack-before-durability regressions** in the server's
+   push handler: every ok-ack in ``_do_push`` must sit below the
+   ``_repl_barrier`` call, and the barrier must keep its sync-mode
+   wait on the backup — a new early ack would silently break the
+   "kill -9 a primary, lose zero acknowledged pushes" guarantee.
 
 Deliberate cases are pinned in ALLOW below by (path, stripped line):
 today's server-side frame read idles unbounded BY DESIGN (workers hold
@@ -149,6 +154,71 @@ def _swallow_offenders(path, lines):
                "blind 'except: pass' in a kvstore/engine path")
 
 
+# ---------------------------------------------------------------------------
+# 5. Replication ack-before-durability contract (ISSUE 4): in sync
+# replication mode a push must NOT be acked before the backup holds it.
+# Structurally: every ok-ack in the server's push handler (_do_push)
+# must sit below a _repl_barrier() call, and the barrier itself must
+# wait on the stream (wait_acked / wait_drained) in sync mode. This is
+# a grep-level contract on the dispatch source — it catches the easy
+# regression (a new early `return ("ok",...)` pasted above the
+# barrier), not every semantic hole; the fault matrix covers those.
+# ---------------------------------------------------------------------------
+
+def _block_of(lines, name):
+    """(start, end) line-index range of `def name` through the next
+    def/class at the same or lower indent."""
+    start = indent = None
+    for i, line in enumerate(lines):
+        stripped = line.lstrip()
+        if start is None:
+            if stripped.startswith("def %s(" % name):
+                start = i
+                indent = len(line) - len(stripped)
+            continue
+        if stripped.startswith(("def ", "class ")) and \
+                line.strip() and (len(line) - len(stripped)) <= indent:
+            return start, i
+    return (start, len(lines)) if start is not None else (None, None)
+
+
+def _repl_contract_offenders():
+    path = PKG / "kvstore_async.py"
+    lines = path.read_text().splitlines()
+    rel = str(path.relative_to(ROOT))
+
+    start, end = _block_of(lines, "_do_push")
+    if start is None:
+        yield (rel, 1, "def _do_push", "push handler not found — the "
+               "replication ack contract cannot be checked")
+        return
+    barrier_at = [i for i in range(start, end)
+                  if "_repl_barrier(" in lines[i]]
+    if not barrier_at:
+        yield (rel, start + 1, "def _do_push",
+               "push handler never calls _repl_barrier — acks no "
+               "longer respect the replication durability point")
+        return
+    for i in range(start, end):
+        line = lines[i].strip()
+        if not re.search(r'return \("ok"', line):
+            continue
+        if "skipped" in line:
+            continue   # catch-up skip: durability rides the pending xfer
+        if not any(b < i for b in barrier_at):
+            yield (rel, i + 1, line,
+                   "push acked ABOVE the _repl_barrier call — in sync "
+                   "mode this ack would not wait for the backup")
+
+    bstart, bend = _block_of(lines, "_repl_barrier")
+    body = "\n".join(lines[bstart:bend]) if bstart is not None else ""
+    for marker in ("wait_acked", "wait_drained", '"sync"'):
+        if marker not in body:
+            yield (rel, (bstart or 0) + 1, "def _repl_barrier",
+                   "_repl_barrier lost its %s path — sync-mode acks "
+                   "no longer wait on the backup" % marker)
+
+
 def main():
     offenders = []
     for path in sorted(PKG.rglob("*.py")):
@@ -158,6 +228,7 @@ def main():
         offenders.extend(_thread_offenders(path, lines))
         if path.name in SWALLOW_FILES:
             offenders.extend(_swallow_offenders(path, lines))
+    offenders.extend(_repl_contract_offenders())
     if offenders:
         print("robustness check FAILED — %d new offender(s):"
               % len(offenders))

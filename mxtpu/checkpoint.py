@@ -230,6 +230,21 @@ class CheckpointManager:
         return {k: zlib.crc32(_np.ascontiguousarray(v).tobytes())
                 for k, v in arrays.items()}
 
+    @staticmethod
+    def _fsync_file(f):
+        f.flush()
+        os.fsync(f.fileno())
+
+    @staticmethod
+    def _fsync_dir(path):
+        """Persist a directory's entries (the file names and the rename
+        itself live in the directory inode, not the files)."""
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
     def _fallback_save(self, step, tree):
         self.wait_until_finished()          # one writer at a time
 
@@ -242,25 +257,38 @@ class CheckpointManager:
                 os.makedirs(tmp)
                 integrity = {}
                 # params are already host numpy (_tree_from): write them
-                # directly — no device round-trip in the writer thread
+                # directly — no device round-trip in the writer thread.
+                # EVERY blob is fsynced before the publish rename: an
+                # os.replace made durable before its contents would let
+                # a crash (power cut, kill -9 mid-writeback) publish a
+                # manifest pointing at missing/partial arrays.
                 with open(os.path.join(tmp, "params.npz"), "wb") as f:
                     _np.savez(f, **tree["params"])
+                    self._fsync_file(f)
                 integrity["params"] = self._crc_tags(tree["params"])
                 for extra in ("trainer_states", "metadata", "extras"):
                     if extra in tree:
                         d = (tree[extra]
                              if isinstance(tree[extra], dict)
                              else {extra: tree[extra]})
-                        _np.savez(os.path.join(tmp, extra + ".npz"), **d)
+                        with open(os.path.join(tmp, extra + ".npz"),
+                                  "wb") as f:
+                            _np.savez(f, **d)
+                            self._fsync_file(f)
                         integrity[extra] = self._crc_tags(d)
                 # per-array CRC tags, written LAST inside the tmp dir so
                 # a torn write of any array file is detectable even when
                 # the archive itself still opens
                 with open(os.path.join(tmp, "integrity.json"), "w") as f:
                     json.dump(integrity, f)
+                    self._fsync_file(f)
+                # blobs durable; now their names, then the publish, then
+                # the publish's own directory entry
+                self._fsync_dir(tmp)
                 if os.path.isdir(final):
                     shutil.rmtree(final)
                 os.replace(tmp, final)      # atomic publish
+                self._fsync_dir(self.directory)
                 self._retention()
             except BaseException as e:      # surfaced by wait_until_finished
                 self._pending_error = e

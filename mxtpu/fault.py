@@ -33,15 +33,25 @@ Rule keys:
            points only: the caller must poison this step's batch so the
            loss/gradients go non-finite — exercised by
            :class:`mxtpu.resilience.TrainGuard`), ``kill_worker``
-           (training-loop points only: ``SIGKILL`` THIS process — the
+           (``SIGKILL`` THIS process — at ``worker.step`` it is the
            deterministic ``kill -9`` of a worker mid-step that
-           ``tools/launch.py --worker-respawn`` recovers from).
+           ``tools/launch.py --worker-respawn`` recovers from; at a
+           server point with ``role=server`` it takes down a parameter
+           server mid-conversation, the replication failover drill).
 ``point``  ``worker.send`` | ``worker.recv`` | ``server.recv`` |
            ``server.send`` | ``worker.step`` (fired by the guarded
            training loop once per step, before the jitted step runs) |
            ``any``.
-``op``     wire command to match (``push``/``pull``/...); ``*`` (default)
-           matches all.
+``op``     wire command to match (``push``/``pull``/``repl``/...); ``*``
+           (default) matches all. Replication-stream frames carry
+           ``op=repl`` end to end, so a rule with ``op=push`` never
+           accidentally lands on the primary→backup forwarding wire.
+``role``   only fire in processes whose ``DMLC_ROLE`` matches (default
+           ``*`` = any process). A launcher-wide ``MXTPU_FAULT_SPEC``
+           is inherited by every child; ``role=server`` scopes a rule
+           to the parameter-server processes so e.g. a ``kill_worker``
+           SIGKILL schedule can take down a primary shard without the
+           same event count ever firing in a worker.
 ``key``    substring of the wire key to match (optional).
 ``nth``    1-based index of the matching event at which the rule starts
            firing (default 1).
@@ -85,10 +95,10 @@ class FaultSever(ConnectionError):
 
 class _Rule:
     __slots__ = ("kind", "point", "op", "key", "nth", "count", "delay",
-                 "seen", "fired")
+                 "role", "seen", "fired")
 
     def __init__(self, kind, point="any", op="*", key=None, nth=1,
-                 count=1, delay=0.0):
+                 count=1, delay=0.0, role="*"):
         if kind not in _KINDS:
             raise ValueError("unknown fault kind %r (one of %s)"
                              % (kind, "/".join(_KINDS)))
@@ -97,14 +107,18 @@ class _Rule:
                              % (point, "/".join(_POINTS)))
         if kind == "kill" and point.startswith("worker"):
             raise ValueError("kind=kill only applies to server points")
-        if kind in ("nan_grad", "kill_worker") and \
-                point not in ("worker.step", "any"):
+        if kind == "nan_grad" and point not in ("worker.step", "any"):
             raise ValueError(
-                "kind=%s only applies to the worker.step point" % kind)
+                "kind=nan_grad only applies to the worker.step point")
+        # kill_worker is allowed at ANY point: at worker.step it is the
+        # deterministic kill -9 of a worker mid-step; at a server point
+        # (scoped by role=server) it SIGKILLs a parameter-server process
+        # mid-conversation — the replication failover drill
         self.kind = kind
         self.point = point
         self.op = op
         self.key = key
+        self.role = role
         self.nth = int(nth)
         self.count = float("inf") if count in ("inf", float("inf")) \
             else int(count)
@@ -119,6 +133,9 @@ class _Rule:
             return False
         if self.key is not None and (key is None
                                      or self.key not in str(key)):
+            return False
+        if self.role != "*" and \
+                self.role != os.environ.get("DMLC_ROLE", "worker"):
             return False
         return True
 

@@ -19,6 +19,12 @@ mxtpu keeps both halves of that contract:
   snapshots its state through CheckpointManager and a restarted
   process (``tools/launch.py --ps-respawn`` rebinds the same port)
   resumes from the latest snapshot — see ``docs/fault_tolerance.md``.
+  With ``MXTPU_PS_REPLICAS=2`` (``--ps-replicas 2``) the process is
+  one half of a primary/backup pair (``MXTPU_PS_PEER`` /
+  ``MXTPU_PS_ROLE``): it settles its role against the peer at boot —
+  a respawned ex-primary facing a promoted peer demotes itself and
+  rejoins as the new backup via state transfer — so a ``kill -9``'d
+  primary costs zero acknowledged updates in sync replication mode.
   The service also tracks its *workers*: ``hello``/``bye``/heartbeat
   registration keeps per-worker membership + push/staleness/straggler
   counters, a worker silent past ``MXTPU_PS_WORKER_DEAD_AFTER`` has

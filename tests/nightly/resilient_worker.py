@@ -88,6 +88,36 @@ if restored is not None:
             kv.pull(names[0], out=probe)
             assert np.isfinite(probe.asnumpy()).all()
 
+if kv is not None and os.environ.get("MXTPU_PS_REPLICAS", "1") != "1":
+    # replicated launch: hold training until the shard pair is
+    # redundant (backup joined + caught up). The replication guarantee
+    # — kill a primary, lose nothing acked — starts once the pair is
+    # formed; training into an unformed pair would just be the old
+    # single-server story, and the failover E2E must not race it.
+    import time
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        rows = kv.stats().get("replication") or []
+        if rows and all(
+                r["repl"] is not None and not r["repl"]["dead"]
+                and (r["repl"]["catchup"] or {}).get("done") for r in rows):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("replicated pair never became redundant")
+
+# step progress on disk: the server-failover E2E test watches this to
+# time its external kill -9 of the primary against real training
+progress_file = os.environ.get("RESILIENT_PROGRESS_FILE")
+
+
+def _note_progress():
+    if progress_file:
+        with open(progress_file + ".tmp", "w") as f:
+            f.write(str(int(st._num_update)))
+        os.replace(progress_file + ".tmp", progress_file)
+
+
 loss = float("nan")
 while st._num_update < total_steps:
     try:
@@ -96,6 +126,7 @@ while st._num_update < total_steps:
         it.reset()
         batch = it.next()
     loss = guard.step(batch.data[0], batch.label[0])
+    _note_progress()
 
 if not np.isfinite(loss):
     # a restore may land exactly at total_steps (nothing left to run):
@@ -105,10 +136,42 @@ assert np.isfinite(loss), "final loss is not finite: %r" % loss
 st.sync_params()
 params = {p.name: p.data().asnumpy() for p in net._ordered_params()}
 np.savez(os.path.join(out_dir, "rank%d_params.npz" % rank), **params)
+
+ps_view = None
+if kv is not None and os.environ.get("MXTPU_PS_REPLICAS", "1") != "1":
+    # replicated launch: wait for the pair to be redundant again (a
+    # respawned ex-primary rejoins as backup and catches up), then
+    # record the replication evidence the E2E failover test asserts
+    st.flush_grad_pushes()
+    import time
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        rows = kv.stats().get("replication") or []
+        if rows and all(
+                r["repl"] is not None and not r["repl"]["dead"]
+                and (r["repl"]["catchup"] or {}).get("done")
+                and r["repl"]["lag"] == 0 for r in rows):
+            break
+        time.sleep(0.5)
+    rows = kv.stats().get("replication") or []
+    ps_view = {"rows": rows,
+               "failovers": kv.health()["failovers"],
+               "promotions": sum(r.get("promotions", 0)
+                                 for r in rows)}
+    # the server-side accumulated gradient table is the parity
+    # object: a killed-primary run must match a clean run bit-for-bit
+    table = {}
+    for name in sorted(kv._parts):
+        probe = mx.nd.zeros(kv._shapes[name])
+        kv.pull(name, out=probe)
+        table[name] = probe.asnumpy()
+    np.savez(os.path.join(out_dir, "rank%d_table.npz" % rank), **table)
+
 with open(os.path.join(out_dir, "rank%d.json" % rank), "w") as f:
     json.dump({"rank": rank, "steps": int(st._num_update),
                "loss": loss, "resumed_from": restored,
                "lr": float(st.learning_rate),
+               "ps": ps_view,
                "guard": {k: v for k, v in guard.stats().items()
                          if isinstance(v, (int, float))}}, f)
 if kv is not None:

@@ -139,6 +139,106 @@ def test_crash_mid_save_never_corrupts_latest(tmp_path, monkeypatch,
     assert ckpt.latest_step() == 2
 
 
+def test_publish_fsyncs_blobs_and_dirs_before_rename(tmp_path,
+                                                     monkeypatch):
+    """The crash-safe-publication contract (ISSUE 4 satellite): every
+    array blob AND the manifest are fsynced, then the tmp directory's
+    entries, BEFORE the atomic rename — and the parent directory after
+    it. An os.replace durable before its contents would let a power
+    cut publish a manifest pointing at missing/partial blobs."""
+    import mxtpu.checkpoint as ckpt_mod
+    events = []
+    real_file = CheckpointManager._fsync_file
+    real_dir = CheckpointManager._fsync_dir
+    real_replace = ckpt_mod.os.replace
+
+    monkeypatch.setattr(
+        CheckpointManager, "_fsync_file",
+        staticmethod(lambda f: (events.append(("file", f.name)),
+                                real_file(f))[1]))
+    monkeypatch.setattr(
+        CheckpointManager, "_fsync_dir",
+        staticmethod(lambda p: (events.append(("dir", p)),
+                                real_dir(p))[1]))
+    monkeypatch.setattr(
+        ckpt_mod.os, "replace",
+        lambda src, dst: (events.append(("replace", src)),
+                          real_replace(src, dst))[1])
+
+    ckpt = CheckpointManager(str(tmp_path / "f"), async_save=False,
+                             use_orbax=False)
+    ckpt.save(1, {"w": np.arange(8, dtype="f")},
+              metadata={"step": 1},
+              extras={"blob": np.ones(3, "f")})
+    kinds = [k for k, _ in events]
+    assert kinds.index("replace") > kinds.index("dir"), \
+        "tmp dir entries must be durable before the publish"
+    assert kinds[-1] == "dir", \
+        "the publish rename itself must be fsynced (parent dir)"
+    file_syncs = {e[1].rsplit("/", 1)[-1] for e in events
+                  if e[0] == "file" and kinds.index("replace")
+                  > events.index(e)}
+    assert {"params.npz", "metadata.npz", "extras.npz",
+            "integrity.json"} <= file_syncs, file_syncs
+    assert ckpt.all_steps() == [1]
+
+
+@pytest.mark.parametrize("kill_point", ["between_fsync_and_rename",
+                                        "mid_blob_write"])
+def test_kill9_in_publish_window_never_corrupts(tmp_path, kill_point):
+    """A real SIGKILL — not an exception — lands either between the
+    final fsync and the publish rename, or mid-blob-write: the
+    published history must never contain a manifest pointing at a
+    missing or partial blob. Step 1 stays the intact latest, every
+    published step passes its integrity check, and the next save
+    recovers over the .tmp debris."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.join(os.path.dirname(__file__), "..")
+    cdir = str(tmp_path / "k9")
+    child = r"""
+import os, sys, numpy as np
+sys.path.insert(0, %(root)r)
+import mxtpu.checkpoint as cm
+ckpt = cm.CheckpointManager(%(cdir)r, async_save=False,
+                            use_orbax=False)
+ckpt.save(1, {"w": np.arange(8, dtype="f")}, metadata={"s": 1})
+print("STEP1", flush=True)
+import signal
+if %(kill_point)r == "between_fsync_and_rename":
+    cm.os.replace = lambda s, d: os.kill(os.getpid(), signal.SIGKILL)
+else:
+    real = cm._np.savez
+    def dying(f, **arrs):
+        real(f, **arrs)
+        os.kill(os.getpid(), signal.SIGKILL)
+    cm._np.savez = dying
+ckpt.save(2, {"w": np.ones(8, "f") * 2}, metadata={"s": 2})
+print("UNREACHABLE", flush=True)
+""" % {"root": os.path.abspath(root), "cdir": cdir,
+       "kill_point": kill_point}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert "STEP1" in proc.stdout, proc.stdout + proc.stderr
+    assert "UNREACHABLE" not in proc.stdout
+    assert proc.returncode == -9
+
+    ckpt = CheckpointManager(cdir, async_save=False, use_orbax=False)
+    # the half-published step is invisible; step 1 is the intact latest
+    assert ckpt.all_steps() == [1]
+    tree = ckpt.restore(None)
+    np.testing.assert_allclose(tree["params"]["w"],
+                               np.arange(8, dtype="f"))
+    # every PUBLISHED step's manifest references only intact blobs
+    for s in ckpt.all_steps():
+        ckpt._fallback_restore(s)       # raises CheckpointCorrupt if not
+    # and the manager recovers right over the debris
+    ckpt.save(2, {"w": np.ones(8, "f") * 2})
+    assert ckpt.latest_step() == 2
+
+
 def test_async_write_failure_surfaces(tmp_path):
     net, trainer, _ = _net_and_trainer()
     ckpt = CheckpointManager(str(tmp_path / "good"), use_orbax=False)

@@ -124,6 +124,125 @@ def _run_resilient(tmp_path, tag, fault_spec):
     return out, summary, params
 
 
+def _run_replicated(tmp_path, tag, kill_at_step=None):
+    """One launcher run of resilient_worker.py against a replicated
+    parameter shard (-s 1 --ps-replicas 2, sync mode, --ps-respawn).
+    With ``kill_at_step``, a REAL external ``kill -9`` lands on the
+    primary server process as soon as the worker's progress file shows
+    that step — mid-training, mid-push-stream, no injection harness.
+    Returns (launcher stdout, summary dict, server-table dict)."""
+    import json
+    import re
+    import signal
+    import threading
+    import time
+    import numpy as np
+    root = os.path.join(os.path.dirname(__file__), "..")
+    out_dir = tmp_path / ("out_" + tag)
+    state_dir = tmp_path / ("state_" + tag)
+    progress = tmp_path / ("progress_" + tag)
+    out_dir.mkdir()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RESILIENT_TEST_DIR"] = str(out_dir)
+    env["RESILIENT_TOTAL_STEPS"] = "12"
+    env["RESILIENT_PROGRESS_FILE"] = str(progress)
+    env["MXTPU_PS_BARRIER_TIMEOUT"] = "60"
+    env.pop("MXTPU_FAULT_SPEC", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "1", "-s", "1", "--ps-replicas", "2",
+         "--ps-repl-mode", "sync", "--ps-respawn",
+         "--worker-state-dir", str(state_dir),
+         "--port", str(_free_port()),
+         sys.executable + " " + os.path.join(root, "tests", "nightly",
+                                             "resilient_worker.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True)
+    lines = []
+    reader = threading.Thread(
+        target=lambda: lines.extend(iter(proc.stdout.readline, "")),
+        daemon=True)
+    reader.start()
+    try:
+        if kill_at_step is not None:
+            pid = None
+            killed = False
+            deadline = time.time() + 300
+            while time.time() < deadline and proc.poll() is None:
+                if pid is None:
+                    for line in list(lines):
+                        m = re.search(
+                            r"ps server 0 role=primary pid=(\d+)", line)
+                        if m:
+                            pid = int(m.group(1))
+                            break
+                if pid is not None and progress.exists():
+                    try:
+                        step = int(progress.read_text() or 0)
+                    except ValueError:
+                        step = 0
+                    if step >= kill_at_step:
+                        os.kill(pid, signal.SIGKILL)
+                        killed = True
+                        break
+                time.sleep(0.05)
+            assert killed, "never killed the primary (pid=%r):\n%s" \
+                % (pid, "".join(lines[-20:]))
+        proc.wait(timeout=420)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.wait()
+        raise
+    finally:
+        reader.join(timeout=10)
+    out = "".join(lines)
+    assert proc.returncode == 0, out[-3000:]
+    assert "RANK_0_OK" in out, out[-3000:]
+    with open(out_dir / "rank0.json") as f:
+        summary = json.load(f)
+    with np.load(out_dir / "rank0_table.npz") as z:
+        table = {k: z[k] for k in z.files}
+    return out, summary, table
+
+
+def test_ps_failover_matches_uninterrupted(tmp_path):
+    """Acceptance scenario (ISSUE 4) — the server-side twin of the
+    worker-respawn parity test: kill -9 the PRIMARY parameter server
+    mid-training with sync replication on. The worker fails over to
+    the promoted backup with zero acknowledged-push loss, the
+    launcher respawns the dead process, it rejoins as the new backup
+    and catches up — and the final server-side gradient table is
+    bit-for-bit identical to an uninterrupted run's."""
+    import numpy as np
+    out, summary, table = _run_replicated(tmp_path, "killed",
+                                          kill_at_step=4)
+    assert "server 0 died" in out and "respawning" in out, out[-3000:]
+    assert summary["steps"] == 12
+    assert np.isfinite(summary["loss"])
+    ps = summary["ps"]
+    assert ps["failovers"] >= 1, ps
+    assert ps["promotions"] >= 1, ps
+    # the pair is redundant again: old primary rejoined as backup and
+    # finished catch-up with the forwarding stream drained
+    row = ps["rows"][0]
+    assert row["role"] == "primary"
+    assert row["repl"]["catchup"]["done"] and row["repl"]["lag"] == 0, \
+        row
+
+    out2, summary2, table2 = _run_replicated(tmp_path, "clean")
+    assert summary2["ps"]["failovers"] == 0
+    assert summary2["ps"]["promotions"] == 0
+    assert set(table) == set(table2)
+    for name in table:
+        np.testing.assert_array_equal(
+            table[name], table2[name],
+            err_msg="server table diverged from the uninterrupted "
+                    "run at %s — an acknowledged push was lost or "
+                    "double-applied across the failover" % name)
+
+
 def test_worker_respawn_resumes_and_matches_uninterrupted(tmp_path):
     """Acceptance scenario (ISSUE 3): SIGKILL the worker mid-epoch on an
     exact step schedule; tools/launch.py --worker-respawn respawns it;

@@ -704,6 +704,315 @@ def test_stall_fault_surfaces_straggler_counters(monkeypatch):
         srv.stop()
 
 
+# ---------------------------------------------------------------------------
+# replication rows (ISSUE 4): primary/backup pairs, hot failover, zero
+# acknowledged-update loss. Every row drives promotion/rejoin/catch-up
+# through the same injection points as the rest of the matrix.
+# ---------------------------------------------------------------------------
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    """Poll an eventual condition with a hard deadline (the condition
+    itself is deterministic — only its arrival time is not)."""
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _pair(monkeypatch, repl_mode="sync", **srv_kw):
+    """A joined (primary, backup) shard pair plus a replicated store
+    pointed at the primary. The store learns the backup from hello."""
+    pri = ParameterServer(role="primary", repl_mode=repl_mode,
+                          **srv_kw).start()
+    bak = ParameterServer(role="backup", peer_addr=pri.address,
+                          repl_mode=repl_mode).start()
+    pri._peer_addr = bak.address
+    bak.join_cluster(probe_interval=0)
+    _wait_for(lambda: bak._catchup_complete, what="initial catch-up")
+    monkeypatch.setenv("MXTPU_PS_REPLICAS", "2")
+    kv = _store(monkeypatch, pri.address)
+    assert isinstance(kv._conns[0], ka._ReplicatedConn)
+    assert kv._conns[0]._addrs[1] == bak.address, \
+        "hello must teach the client the shard map"
+    return pri, bak, kv
+
+
+def test_sync_replication_mirrors_every_push(monkeypatch):
+    """The baseline invariant everything below builds on: in sync mode
+    a push RETURNING means the backup already applied it — no waits,
+    no eventually."""
+    pri, bak, kv = _pair(monkeypatch)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        for i in range(3):
+            kv.push("w", mx.nd.ones((4,)))
+            assert bak._clock.get("w") == i + 1, \
+                "sync ack returned before the backup applied"
+        np.testing.assert_allclose(bak._table["w"], 3 * np.ones(4))
+        assert pri._clock["w"] == 3
+        srv = kv.stats()
+        assert srv["replication"][0]["repl"]["lag"] == 0
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()
+
+
+def test_failover_pull_is_fresh_dead_shard_pull_is_stale(monkeypatch):
+    """Satellite: a pull served by a just-promoted backup is a LIVE
+    pull — no stale marker — while a genuinely dead shard (both
+    replicas gone) still degrades to the staleness-marked cache."""
+    pri, bak, kv = _pair(monkeypatch)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.push("w", mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)                  # warm the cache
+        pri.kill()
+        _wait_for(lambda: not pri._thread.is_alive(),
+                  what="primary teardown")
+        kv.pull("w", out=out)                  # failover, not degrade
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+        h = kv.health()
+        assert h["degraded_keys"] == [], \
+            "a failover pull must not carry the stale marker"
+        assert h["num_dead"] == 0
+        assert h["failovers"] == 1 and h["servers"][0]["failed_over"]
+        assert bak._role == "primary" and bak._promotions == 1
+        # now the shard dies for REAL: both replicas gone — the pull
+        # degrades to the last-known value and marks staleness
+        bak.stop()
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+        h = kv.health()
+        assert "w" in h["degraded_keys"]
+        assert h["num_dead"] == 1
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()
+
+
+def test_kill_primary_mid_window_zero_acked_loss(monkeypatch):
+    """Kill the primary between the pipelined part-pushes of one big
+    array (sync mode): the whole unacked window replays against the
+    promoted backup; parts the primary forwarded pre-kill are refused
+    by the transferred dedupe seqs — every part lands EXACTLY once and
+    nothing acked is lost."""
+    _eight_part_push(monkeypatch)
+    pri, bak, kv = _pair(monkeypatch)
+    try:
+        kv.init("w", mx.nd.zeros((8, 4)))
+        with fault.inject(
+                "kind=kill,point=server.recv,op=push,nth=3") as inj:
+            kv.push("w", mx.nd.ones((8, 4)))
+        assert inj.stats()[0][4] == 1
+        assert bak._role == "primary"
+        # the promoted table holds each part exactly once, values whole
+        for i in range(8):
+            sk = "w\x00%d" % i
+            assert bak._clock[sk] == 1, (sk, bak._clock)
+            assert np.allclose(bak._table[sk], 1.0), bak._table[sk]
+        out = mx.nd.zeros((8, 4))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones((8, 4)))
+        assert kv.stats()["failovers"] == 1
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()
+
+
+def test_kill_primary_mid_coalesced_batch(monkeypatch):
+    """Kill the primary inside a coalesced multi-key frame after a
+    prefix of its sub-pushes applied (and sync-replicated): the client
+    replays the WHOLE batch on the promoted backup, whose transferred
+    seqs refuse the prefix — every key exactly once."""
+    pri, bak, kv = _pair(monkeypatch)
+    try:
+        keys = ["k%d" % i for i in range(8)]
+        vals = [mx.nd.ones((3,)) * (i + 1) for i in range(8)]
+        kv.init(keys, [mx.nd.zeros((3,)) for _ in keys])
+        # sub-pushes fire their own server.recv inside the multi frame
+        with fault.inject(
+                "kind=kill,point=server.recv,op=push,nth=5") as inj:
+            kv.push(keys, vals)
+        assert inj.stats()[0][4] == 1
+        assert bak._role == "primary"
+        for i, k in enumerate(keys):
+            out = mx.nd.zeros((3,))
+            kv.pull(k, out=out)
+            np.testing.assert_allclose(out.asnumpy(),
+                                       (i + 1) * np.ones(3))
+            assert bak._clock[k] == 1, (k, bak._clock)
+        assert bak._dup_n >= 1         # the replayed prefix was refused
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()
+
+
+def test_sever_repl_stream_sync_mode_acks_after_recovery(monkeypatch):
+    """Sever the replication stream itself (sync mode): the push's ack
+    is withheld until the stream's retry lands the record — when
+    push() returns, the backup must hold the update, sever or no
+    sever, applied exactly once."""
+    pri, bak, kv = _pair(monkeypatch)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        with fault.inject(
+                "kind=sever,point=worker.send,op=repl,nth=1") as inj:
+            kv.push("w", mx.nd.ones((4,)))
+        assert inj.stats()[0][4] == 1          # the stream really tore
+        assert bak._clock.get("w") == 1, \
+            "sync ack returned before the re-sent record landed"
+        np.testing.assert_allclose(bak._table["w"], np.ones(4))
+        assert pri._repl is not None and not pri._repl.dead
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()
+
+
+def test_async_repl_mode_bounds_lag_then_drains(monkeypatch):
+    """async replication: pushes ack immediately, the stream lags at
+    most MXTPU_PS_REPL_LAG_MAX records, and drains to equality."""
+    monkeypatch.setattr(ka, "_REPL_LAG_MAX", 2)
+    pri, bak, kv = _pair(monkeypatch, repl_mode="async")
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        with fault.inject("kind=delay,point=worker.send,op=repl,"
+                          "delay=0.02,count=inf"):
+            for _ in range(6):
+                kv.push("w", mx.nd.ones((4,)))
+                assert pri._repl.lag() <= 2, "lag bound violated"
+        _wait_for(lambda: bak._clock.get("w") == 6, what="drain")
+        np.testing.assert_allclose(bak._table["w"], 6 * np.ones(4))
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()
+
+
+def test_kill_backup_during_catchup_primary_detaches(monkeypatch):
+    """Kill the backup mid-state-transfer: the stream dies terminally,
+    the primary detaches it (redundancy lost, loudly) and keeps
+    serving — the fleet never wedges on a dead backup."""
+    monkeypatch.setattr(ka, "_REPL_TIMEOUT", 5.0)
+    pri = ParameterServer(role="primary").start()
+    kv = _store(monkeypatch, pri.address)
+    try:
+        for i in range(6):
+            kv.init("k%d" % i, mx.nd.ones((3,)) * i)
+        bak = ParameterServer(role="backup",
+                              peer_addr=pri.address).start()
+        pri._peer_addr = bak.address
+        # the 3rd repl record (an xfer mid-transfer) kills the backup
+        with fault.inject(
+                "kind=kill,point=server.recv,op=repl,nth=3") as inj:
+            bak.join_cluster(probe_interval=0)
+            _wait_for(lambda: pri._repl is None,
+                      what="primary to detach the dead backup")
+        assert inj.stats()[0][4] == 1
+        assert not bak._catchup_complete
+        # the primary serves on, unreplicated
+        kv.push("k0", mx.nd.ones((3,)))
+        out = mx.nd.zeros((3,))
+        kv.pull("k0", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(3))
+        assert kv.health()["num_dead"] == 0
+        bak.stop()
+    finally:
+        kv.close()
+        pri.stop()
+
+
+def test_respawned_primary_rejoins_and_catches_up(monkeypatch):
+    """The full repair loop in-process: primary dies mid-training, the
+    backup promotes and serves, a fresh server on the old port demotes
+    itself against the promoted peer and catches up (table + clocks +
+    dedupe seqs + optimizer + ACCUMULATED updater state) — after which
+    new pushes replicate to it and the pair is redundant again.
+    Momentum SGD on purpose: a catch-up that transferred the table but
+    not the momentum buffers would diverge on the very next forwarded
+    push (the bug the public-API verify drive caught)."""
+    pri, bak, kv = _pair(monkeypatch)
+    port = int(pri.address.split(":")[1])
+    # momentum-SGD ground truth for grad=1 pushes: m += 0.9m+1,
+    # w -= 0.5m  ->  w1=-0.5, w2=-1.45, w3=-2.805
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
+                                          momentum=0.9))
+        kv.push("w", mx.nd.ones((4,)))
+        pri.kill()
+        _wait_for(lambda: not pri._thread.is_alive(),
+                  what="primary teardown")
+        kv.push("w", mx.nd.ones((4,)))         # fails over mid-stream
+        assert bak._role == "primary"
+        np.testing.assert_allclose(bak._table["w"], -1.45 * np.ones(4),
+                                   rtol=1e-6)
+        pri2 = ParameterServer(port=port, role="primary",
+                               peer_addr=bak.address).start()
+        try:
+            pri2.join_cluster(probe_interval=0)
+            assert pri2._role == "backup", \
+                "a respawn facing a promoted peer must demote"
+            _wait_for(lambda: pri2._catchup_complete, what="catch-up")
+            np.testing.assert_allclose(pri2._table["w"],
+                                       -1.45 * np.ones(4), rtol=1e-6)
+            assert pri2._clock["w"] == 2
+            assert pri2._updater is not None, \
+                "the optimizer must ride the state transfer"
+            assert any(k == "w" for (_, k) in pri2._applied), \
+                "push-dedupe seqs must ride the state transfer"
+            kv.push("w", mx.nd.ones((4,)))     # replicates to pri2 now
+            assert pri2._clock["w"] == 3
+            np.testing.assert_allclose(
+                pri2._table["w"], -2.805 * np.ones(4), rtol=1e-6,
+                err_msg="rejoined backup diverged — the accumulated "
+                        "momentum state did not ride the catch-up")
+            row = kv.health()["replication"][0]
+            assert row["role"] == "primary"
+            assert row["promotions"] == 1
+            assert row["repl"]["catchup"]["done"]
+            assert row["repl"]["lag"] == 0
+        finally:
+            pri2.stop()
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()
+
+
+def test_backup_refuses_client_ops_until_promoted(monkeypatch):
+    """Routing safety: a store (mis)pointed at a live backup gets the
+    not_serving verdict and swaps to the real primary instead of
+    reading a possibly-stale table."""
+    pri, bak, kv0 = _pair(monkeypatch)
+    kv0.init("w", mx.nd.zeros((4,)))
+    kv0.push("w", mx.nd.ones((4,)))
+    try:
+        # a second store whose 'primary' entry is actually the backup
+        monkeypatch.setenv("MXTPU_PS_BACKUP_ADDRS", pri.address)
+        kv = _store(monkeypatch, bak.address)
+        try:
+            out = mx.nd.zeros((4,))
+            kv.pull("w", out=out)              # refused, re-routed
+            np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+            assert kv._conns[0].failovers == 1
+            assert pri._role == "primary"      # promote was a no-op
+        finally:
+            kv.close()
+    finally:
+        kv0.close()
+        pri.stop()
+        bak.stop()
+
+
 @pytest.mark.slow
 def test_kill_worker_mid_push_window(monkeypatch, tmp_path):
     """kill_worker row: a child worker is SIGKILLed by the fault

@@ -80,8 +80,15 @@ def test_new_fault_kinds_parse_and_validate():
     assert [r.kind for r in rules] == ["nan_grad", "stall", "kill_worker"]
     with pytest.raises(ValueError, match="worker.step"):
         fault.parse_spec("kind=nan_grad,point=server.recv")
-    with pytest.raises(ValueError, match="worker.step"):
-        fault.parse_spec("kind=kill_worker,point=worker.send")
+    # kill_worker is valid at ANY point since ISSUE 4: at a server
+    # point (scoped by role=server) it SIGKILLs a parameter-server
+    # process — the replication failover drill. role= scopes a
+    # launcher-wide spec to one process kind.
+    (rule,) = fault.parse_spec(
+        "kind=kill_worker,point=server.recv,op=push,role=server")
+    assert rule.role == "server"
+    assert not rule.matches("server.recv", "push", None), \
+        "a role=server rule must not fire in a worker process"
 
 
 def test_nan_grad_schedule_is_deterministic():

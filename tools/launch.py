@@ -44,20 +44,33 @@ def _free_port(preferred):
     return preferred
 
 
-def _spawn_server(idx, ps_port, base_env, args):
+def _spawn_server(name, ps_port, base_env, args, role="primary",
+                  peer=None):
     """One async parameter-server child. With a snapshot dir configured,
-    the server snapshots its table there and a RESPAWN of the same index
+    the server snapshots its table there and a RESPAWN of the same slot
     restores it — kvstore_async auto-resume — because the respawn reuses
     the same port (workers reconnect via their retry layer) and the same
-    per-index directory."""
+    per-slot directory. With --ps-replicas 2 each shard is a
+    primary/backup pair: MXTPU_PS_PEER/MXTPU_PS_ROLE wire the pair
+    together, and a respawned process re-negotiates its role at boot
+    (a respawned ex-primary finds its promoted peer and rejoins as the
+    new backup, catching up via state transfer)."""
     env = dict(base_env, DMLC_ROLE="server",
-               MXTPU_PS_PORT=str(ps_port), JAX_PLATFORMS="cpu")
+               MXTPU_PS_PORT=str(ps_port), JAX_PLATFORMS="cpu",
+               MXTPU_PS_ROLE=role)
+    if peer:
+        env["MXTPU_PS_PEER"] = peer
     if args.ps_snapshot_dir:
         env["MXTPU_PS_SNAPSHOT_DIR"] = os.path.join(
-            args.ps_snapshot_dir, "server_%d" % idx)
+            args.ps_snapshot_dir, "server_%s" % name)
         env["MXTPU_PS_SNAPSHOT_EVERY"] = str(args.ps_snapshot_every)
-    return subprocess.Popen(
+    proc = subprocess.Popen(
         [sys.executable, "-m", "mxtpu.kvstore_async"], env=env)
+    # pid + port on stdout: external failover drills (and the E2E
+    # parity test) kill -9 an exact server process by parsing this
+    print("ps server %s role=%s pid=%d port=%d"
+          % (name, role, proc.pid, ps_port), flush=True)
+    return proc
 
 
 def launch_local(args, command):
@@ -81,11 +94,36 @@ def launch_local(args, command):
         # in-flight key 404s — auto-provision the state dir instead
         args.ps_snapshot_dir = tempfile.mkdtemp(prefix="mxtpu_ps_snap_")
         print("ps snapshots in %s" % args.ps_snapshot_dir)
+    replicas = max(1, args.ps_replicas)
+    # slot metadata drives both the first spawn and every respawn:
+    # (name, port, role, peer address). With --ps-replicas 2 the slots
+    # are N primaries followed by their N backups, wired pairwise.
+    server_slots = []
+    backup_addrs = []
+    ports = [_free_port(args.port + 1 + s)
+             for s in range(args.num_servers * (2 if replicas >= 2
+                                                else 1))]
     for s in range(args.num_servers):
-        ps_port = _free_port(args.port + 1 + s)
-        server_ports.append(ps_port)
-        server_procs.append(_spawn_server(s, ps_port, base_env, args))
-        ps_addrs.append("127.0.0.1:%d" % ps_port)
+        ps_addrs.append("127.0.0.1:%d" % ports[s])
+    if replicas >= 2:
+        for s in range(args.num_servers):
+            backup_addrs.append(
+                "127.0.0.1:%d" % ports[args.num_servers + s])
+        base_env["MXTPU_PS_REPLICAS"] = str(replicas)
+        base_env["MXTPU_PS_REPL_MODE"] = args.ps_repl_mode
+    for s in range(args.num_servers):
+        peer = backup_addrs[s] if replicas >= 2 else None
+        server_slots.append(("%d" % s, ports[s], "primary", peer))
+    for s in range(args.num_servers) if replicas >= 2 else []:
+        server_slots.append(("%d_backup" % s,
+                             ports[args.num_servers + s], "backup",
+                             ps_addrs[s]))
+    for name, port, role, peer in server_slots:
+        server_ports.append(port)
+        server_procs.append(_spawn_server(name, port, base_env, args,
+                                          role=role, peer=peer))
+    if backup_addrs:
+        base_env["MXTPU_PS_BACKUP_ADDRS"] = ",".join(backup_addrs)
     if args.worker_respawn and not args.worker_state_dir:
         # a respawned worker with no state dir restarts from step 0 and
         # double-trains its epoch — auto-provision one, like --ps-respawn
@@ -144,13 +182,17 @@ def launch_local(args, command):
                     if respawns[i] >= args.ps_max_respawns:
                         continue   # workers' retry layer surfaces it
                     respawns[i] += 1
-                    print("server %d died (exit %d); respawning on port "
-                          "%d (%d/%d)" % (i, rc, server_ports[i],
-                                          respawns[i],
+                    name, port, role, peer = server_slots[i]
+                    print("server %s died (exit %d); respawning on port "
+                          "%d (%d/%d)" % (name, rc, port, respawns[i],
                                           args.ps_max_respawns),
                           flush=True)
+                    # env role is only the opening bid: the respawned
+                    # process probes its peer at boot and, if the peer
+                    # was promoted meanwhile, rejoins as the new backup
                     server_procs[i] = _spawn_server(
-                        i, server_ports[i], base_env, args)
+                        name, port, base_env, args, role=role,
+                        peer=peer)
             if all(p.poll() is not None for p in procs):
                 break
             time.sleep(0.2)
@@ -285,6 +327,24 @@ def main():
                    help="async parameter-server processes for "
                         "create('dist_async'); sync mode needs none "
                         "(SPMD collectives instead)")
+    p.add_argument("--ps-replicas", type=int,
+                   default=int(os.environ.get("MXTPU_PS_REPLICAS",
+                                              "1")),
+                   help="2 pairs every parameter-server shard with a "
+                        "hot backup: applied updates replicate over "
+                        "the primary's stream, clients fail over in "
+                        "place on a primary death, and a respawned "
+                        "server rejoins as the new backup "
+                        "(docs/fault_tolerance.md, 'Replication & "
+                        "failover')")
+    p.add_argument("--ps-repl-mode", choices=("sync", "async"),
+                   default=os.environ.get("MXTPU_PS_REPL_MODE",
+                                          "sync"),
+                   help="sync (default): a push is acked only after "
+                        "the backup acked the forwarded update — zero "
+                        "acknowledged-update loss on a primary kill; "
+                        "async: ack immediately, replication lag "
+                        "bounded by MXTPU_PS_REPL_LAG_MAX")
     p.add_argument("--ps-respawn", action="store_true",
                    help="local launcher: respawn a crashed parameter "
                         "server on its original port; with snapshots it "
