@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Structural perf smoke for the fused Module train step.
+
+The fused-step contract (mxtpu/module/fused.py) is that a steady-state
+``Module.fit`` epoch is exactly one donated program dispatch per batch:
+no retraces, no per-batch host syncs. Wall-clock can't pin that on a
+noisy host; structure can — in the style of ``check_guard_overhead.py``:
+
+1. **Zero retraces after warmup**: the fused program cache compiles
+   during warmup (the bare step + the metric-fused step) and then a full
+   steady-state epoch adds ZERO cache misses — every batch is a cache
+   hit of an already-built executable.
+2. **Zero per-batch host syncs with async metrics**: the whole
+   steady-state epoch (forward_backward → update → update_metric per
+   batch) runs under ``jax.transfer_guard_device_to_host("disallow")`` —
+   any implicit device→host read on the hot path fails loudly. The
+   metric's device (sum, count) accumulator drains OUTSIDE the guarded
+   region, at epoch end, in exactly one fetch.
+3. **One executable per signature**: one batch signature holds at most
+   two programs (pre-metric warmup + metric-fused), never one per batch.
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_module_perf.py`` (wired into
+``ci/run_ci.sh fast``). No timing, no thresholds in seconds.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_MODULE_FUSED"] = "1"
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+import numpy as np                                    # noqa: E402
+import jax                                            # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+
+_BATCHES = 12
+
+
+def _no_d2h():
+    guard = getattr(jax, "transfer_guard_device_to_host", None)
+    if guard is None:                                 # pragma: no cover
+        return contextlib.nullcontext()
+    return guard("disallow")
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    failures = []
+    np.random.seed(0)
+    x = np.random.randn(128, 20).astype("float32")
+    y = np.random.randint(0, 4, 128).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    if mod._fused is None:
+        print("check_module_perf: FAIL")
+        print("  - fused train step did not engage on the default path")
+        return 1
+    metric = mx.metric.create("acc")
+    batches = list(it)
+
+    def one(batch):
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+    # warmup: first batch compiles the bare step and registers the
+    # metric; second batch compiles the metric-fused step
+    for b in batches[:2]:
+        one(b)
+    metric.get()
+
+    stats = mod._fused._group.stats
+    compiles_before = stats["compiles"]
+    drains_before = stats["metric_drains"]
+    metric.reset()
+
+    # -- 1+2: a steady-state epoch — zero retraces, zero host syncs ----
+    try:
+        with _no_d2h():
+            for i in range(_BATCHES):
+                one(batches[i % len(batches)])
+    except Exception as e:
+        failures.append(
+            "steady-state fit loop performed a device->host transfer "
+            "per batch: %s: %s" % (type(e).__name__, str(e)[:200]))
+
+    if stats["compiles"] != compiles_before:
+        failures.append(
+            "steady-state epoch retraced: %d new compiles after warmup "
+            "(contract: every batch is a program-cache hit)"
+            % (stats["compiles"] - compiles_before))
+    if stats["metric_drains"] != drains_before:
+        failures.append(
+            "metric accumulator drained %d times DURING the epoch "
+            "(contract: device-side accumulation, read at epoch end)"
+            % (stats["metric_drains"] - drains_before))
+
+    # the epoch-end read: exactly one fetch serves the whole epoch
+    name, value = metric.get()
+    if stats["metric_drains"] != drains_before + 1:
+        failures.append("epoch-end metric read made %d drains (want 1)"
+                        % (stats["metric_drains"] - drains_before))
+    if not (0.0 <= value <= 1.0):
+        failures.append("async-accumulated accuracy out of range: %r"
+                        % (value,))
+
+    # -- 3: one executable per signature -------------------------------
+    n_programs = len(mod._fused._cache)
+    if n_programs > 2:
+        failures.append(
+            "%d fused programs for one batch signature (want <= 2: "
+            "bare warmup step + metric-fused step)" % n_programs)
+
+    if failures:
+        print("check_module_perf: FAIL")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("check_module_perf: OK (zero retraces after warmup, zero "
+          "per-batch host syncs, %d programs, epoch metric in one read)"
+          % n_programs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,7 @@ case "$tier" in
     JAX_PLATFORMS=cpu python -m pytest tests/ -m fast -q
     JAX_PLATFORMS=cpu python ci/check_comms_perf.py
     JAX_PLATFORMS=cpu python ci/check_guard_overhead.py
+    JAX_PLATFORMS=cpu python ci/check_module_perf.py
     JAX_PLATFORMS=cpu python ci/check_replication.py
     ;;
   nightly)

@@ -373,6 +373,111 @@ class Executor:
         new_exe._out_shapes = [tuple(s) for s in out_shapes]
         return new_exe
 
+    # -- fused train step --------------------------------------------------
+    def make_fused_train_step(self, train_names, optimizer, opt_slots,
+                              metric_fn=None, donate=True):
+        """Build ONE donated jitted XLA program running the whole train
+        step: forward + backward (ones cotangents, loss-head pattern) +
+        the ENTIRE optimizer update as a multi-tensor apply (every
+        parameter through :func:`optimizer.functional_optimizer_step`,
+        reusing the ``ops/optim_ops.py`` kernels) and, optionally, the
+        metric's device-side (sum, count) accumulation.
+
+        ``train_names`` are the arguments updated by the optimizer, in
+        slot order; ``opt_slots`` the matching updater indices (so lr/wd
+        multipliers and saved optimizer states line up with the eager
+        per-param path). Every other argument (data, labels, fixed
+        params) rides as a non-donated input in ``other_names`` order =
+        ``[n for n in list_arguments() if n not in train_names]``.
+
+        Donation semantics: params (0), optimizer state trees (1), aux
+        states (2), rng key (4), step count (5) and the metric
+        accumulator (7) are donated — XLA updates the buffers in place,
+        and the CALLER'S input arrays are invalidated by the call. The
+        Module fused driver rebinds each NDArray's ``_data`` to the
+        returned value after every step. Batches (3) and lr (6) are
+        deliberately NOT donated: batches may be re-fed (pre-staged
+        loops) and lr is a carried constant.
+
+        Returns ``(fn, other_names)`` where ``fn(train_vals, state_trees,
+        aux_vals, other_vals, key, t, lr, metric_acc) -> (new_vals,
+        new_states, new_aux, outs, key', t+1, metric_acc')``.
+        """
+        from .optimizer import functional_optimizer_step
+        outputs_ref = self._symbol._outputs
+        arg_names = tuple(self._arg_names)
+        aux_names = tuple(self._aux_names)
+        train_names = tuple(train_names)
+        train_set = set(train_names)
+        other_names = tuple(n for n in arg_names if n not in train_set)
+        opt_slots = tuple(opt_slots)
+        mirror = self._mirror
+
+        def _forward(gvals, other_vals, aux_vals, key):
+            local = dict(zip(other_names, other_vals))
+            local.update(zip(aux_names, aux_vals))
+            local.update(zip(train_names, gvals))
+            with rng_scope(key):
+                outs, aux_updates = eval_graph(outputs_ref, local, True)
+            new_aux = tuple(aux_updates.get(n, local[n]) for n in aux_names)
+            return tuple(outs), new_aux
+
+        donate_argnums = (0, 1, 2, 4, 5, 7) if donate else ()
+
+        @functools.partial(jax.jit, donate_argnums=donate_argnums)
+        def fused(train_vals, state_trees, aux_vals, other_vals, key, t,
+                  lr, metric_acc):
+            key, sub = _split2(key)
+            t = t + 1
+
+            def f(gvals):
+                return _forward(gvals, other_vals, aux_vals, sub)
+
+            with jax.named_scope("fwd_bwd"):
+                (outs, new_aux), vjp_fn = jax.vjp(
+                    maybe_remat(f, enabled=mirror), tuple(train_vals))
+                cot = tuple(_ones_cot(o) for o in outs)
+                zero_aux = tuple(_zeros_cot(a) for a in new_aux)
+                grads = vjp_fn((cot, zero_aux))[0]
+            new_vals, new_states = [], []
+            with jax.named_scope("optimizer"):
+                for slot, w, g, st in zip(opt_slots, train_vals, grads,
+                                          state_trees):
+                    w2, st2 = functional_optimizer_step(
+                        optimizer, slot, w, g, st, t, lr)
+                    new_vals.append(w2)
+                    new_states.append(st2)
+            if metric_fn is not None:
+                with jax.named_scope("metric"):
+                    m_sum, m_cnt = metric_fn(dict(zip(other_names,
+                                                      other_vals)), outs)
+                    metric_acc = metric_acc + jnp.stack(
+                        [m_sum, m_cnt]).astype(metric_acc.dtype)
+            return (tuple(new_vals), tuple(new_states), tuple(new_aux),
+                    outs, key, t, metric_acc)
+
+        return fused, other_names
+
+    def adopt_arrays(self, arg_src, aux_src):
+        """Alias this executor's argument/aux slots to the given NDArray
+        OBJECTS (same shape+dtype) so a group of executors — the buckets
+        of a fused BucketingModule — share ONE device-side parameter
+        store: whichever bucket steps rebinds the shared arrays' _data,
+        and a bucket switch needs no host round-trip at all."""
+        for name, src in arg_src.items():
+            dst = self.arg_dict.get(name)
+            if dst is not None and dst is not src \
+                    and dst.shape == src.shape and dst.dtype == src.dtype:
+                self.arg_dict[name] = src
+        for name, src in aux_src.items():
+            dst = self.aux_dict.get(name)
+            if dst is not None and dst is not src \
+                    and dst.shape == src.shape and dst.dtype == src.dtype:
+                self.aux_dict[name] = src
+        self.arg_arrays = [self.arg_dict[n] for n in self._arg_names]
+        self.grad_arrays = [self.grad_dict.get(n) for n in self._arg_names]
+        self.aux_arrays = [self.aux_dict[n] for n in self._aux_names]
+
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback
 

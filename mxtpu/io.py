@@ -30,7 +30,32 @@ from .ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "CSVIter", "MNISTIter",
-           "ImageRecordIter", "LibSVMIter"]
+           "ImageRecordIter", "LibSVMIter", "stage_batch"]
+
+
+def stage_batch(batch, ctx=None):
+    """Stage a :class:`DataBatch`'s arrays onto the device AHEAD of the
+    step that consumes them.
+
+    ``jax.device_put`` dispatches asynchronously, so calling this on the
+    upcoming batch while the current step is still in flight overlaps the
+    host->device transfer with device compute (the engine-async
+    PrefetcherIter capability across the host link — and what
+    ``Module.prepare`` does on the fused-step path). Arrays already
+    resident on the target device pass through untouched; sparse arrays
+    are left alone (their compressed aux rides separately)."""
+    import jax
+
+    device = ctx.jax_device() if ctx is not None else None
+
+    def _stage(arrs):
+        for a in arrs or []:
+            if isinstance(a, NDArray) and not hasattr(a, "_aux"):
+                a._data = jax.device_put(a._data, device)
+
+    _stage(getattr(batch, "data", None))
+    _stage(getattr(batch, "label", None))
+    return batch
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):

@@ -14,6 +14,7 @@ import math
 from collections import OrderedDict
 
 import numpy
+import jax.numpy as jnp
 
 from .base import string_types
 from . import ndarray
@@ -41,10 +42,17 @@ def check_label_shapes(labels, preds, wrap=False, shape=False):
 
 
 def _host(arr, dtype=None):
-    """NDArray -> host numpy, optionally cast."""
-    out = arr.asnumpy() if isinstance(arr, ndarray.NDArray) \
-        else numpy.asarray(arr)
-    return out if dtype is None else out.astype(dtype)
+    """NDArray -> host numpy, optionally cast — WITHOUT an implicit copy
+    when the value is already host-resident: a numpy-backed input (or a
+    CPU jax buffer ``device_get`` can hand back as-is) flows through
+    ``asarray`` views, and the dtype cast copies only when the dtype
+    actually differs (``astype(copy=False)``)."""
+    if isinstance(arr, ndarray.NDArray):
+        import jax
+        out = numpy.asarray(jax.device_get(arr._data))
+    else:
+        out = numpy.asarray(arr)
+    return out if dtype is None else out.astype(dtype, copy=False)
 
 
 def _listed(x):
@@ -86,9 +94,47 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError()
 
+    # -- device-side accumulation (the Module fused-step fast path) --------
+    def device_batch(self, labels, preds):
+        """One batch's (sum, count) as jnp scalars, traceable inside a
+        jitted train step. Metrics overriding this can accumulate ON
+        DEVICE (``update_async``), eliminating the per-batch host sync
+        the numpy ``update`` forces. Base: no device implementation."""
+        return None
+
+    def supports_device_update(self):
+        """True when this metric overrides :meth:`device_batch` and takes
+        the default all-outputs/all-labels pairing (no name filtering —
+        the fused step hands it the raw output tuple)."""
+        return (type(self).device_batch is not EvalMetric.device_batch
+                and self.output_names is None and self.label_names is None)
+
+    def update_async(self, read_fn, reset_fn=None):
+        """Route accumulation through a device-side (sum, count)
+        accumulator owned by the caller (a fused Module step).
+        ``read_fn()`` must return the accumulated host ``(sum, count)``
+        pair AND zero the device accumulator; it is invoked lazily — at
+        :meth:`get` time (epoch end, or whenever a callback reads the
+        metric), never per batch. ``reset_fn()`` discards the device
+        accumulation when the metric is reset."""
+        self._async_reader = read_fn
+        self._async_resetter = reset_fn
+
+    def detach_async(self):
+        self._async_reader = self._async_resetter = None
+
+    def _drain_async(self):
+        reader = getattr(self, "_async_reader", None)
+        if reader is not None:
+            total, count = reader()
+            self._accum(total, count)
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        resetter = getattr(self, "_async_resetter", None)
+        if resetter is not None:
+            resetter()
 
     def _accum(self, total, count):
         """Fold one batch's (sum, weight) into the running average."""
@@ -96,6 +142,7 @@ class EvalMetric:
         self.num_inst += count
 
     def get(self):
+        self._drain_async()
         value = self.sum_metric / self.num_inst if self.num_inst \
             else float("nan")
         return (self.name, value)
@@ -219,6 +266,17 @@ class Accuracy(EvalMetric):
             hits = int((decided.ravel() == expected.ravel()).sum())
             self._accum(hits, decided.size)
 
+    def device_batch(self, labels, preds):
+        hits, count = 0.0, 0.0
+        for truth, scores in zip(labels, preds):
+            if scores.shape != truth.shape:
+                scores = jnp.argmax(scores, axis=self.axis)
+            decided = scores.astype(jnp.int32).ravel()
+            expected = truth.astype(jnp.int32).ravel()
+            hits = hits + jnp.sum(decided == expected).astype(jnp.float32)
+            count += decided.size
+        return hits, count
+
 
 @alias("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(EvalMetric):
@@ -247,6 +305,21 @@ class TopKAccuracy(EvalMetric):
                 best = ranked[:, ranked.shape[1] - k:]
                 hits = int((best == expected.reshape(-1, 1)).any(1).sum())
             self._accum(hits, ranked.shape[0])
+
+    def device_batch(self, labels, preds):
+        hits, count = 0.0, 0.0
+        for truth, scores in zip(labels, preds):
+            ranked = jnp.argsort(scores.astype(jnp.float32), axis=-1)
+            expected = truth.astype(jnp.int32)
+            if ranked.ndim == 1:
+                hits = hits + jnp.sum(ranked.ravel() == expected.ravel())
+            else:
+                k = min(ranked.shape[1], self.top_k)
+                best = ranked[:, ranked.shape[1] - k:]
+                hits = hits + jnp.sum(
+                    jnp.any(best == expected.reshape(-1, 1), axis=1))
+            count += ranked.shape[0]
+        return hits.astype(jnp.float32), count
 
 
 @alias("f1_score")
@@ -346,6 +419,15 @@ class _PairwiseError(EvalMetric):
                                         _column(_host(scores)))
             self._accum(float(batch_value), 1)
 
+    def device_batch(self, labels, preds):
+        def col(x):
+            return x.reshape(x.shape[0], 1) if x.ndim == 1 else x
+        total, count = 0.0, 0.0
+        for truth, scores in zip(labels, preds):
+            total = total + self._device_measure(col(truth), col(scores))
+            count += 1
+        return total, count
+
 
 @register
 class MAE(_PairwiseError):
@@ -358,6 +440,10 @@ class MAE(_PairwiseError):
     @staticmethod
     def _measure(truth, scores):
         return numpy.abs(truth - scores).mean()
+
+    @staticmethod
+    def _device_measure(truth, scores):
+        return jnp.abs(truth - scores).mean()
 
 
 @register
@@ -372,6 +458,10 @@ class MSE(_PairwiseError):
     def _measure(truth, scores):
         return numpy.square(truth - scores).mean()
 
+    @staticmethod
+    def _device_measure(truth, scores):
+        return jnp.square(truth - scores).mean()
+
 
 @register
 class RMSE(_PairwiseError):
@@ -384,6 +474,10 @@ class RMSE(_PairwiseError):
     @staticmethod
     def _measure(truth, scores):
         return math.sqrt(numpy.square(truth - scores).mean())
+
+    @staticmethod
+    def _device_measure(truth, scores):
+        return jnp.sqrt(jnp.square(truth - scores).mean())
 
 
 class _ProbNLL(EvalMetric):
@@ -400,6 +494,16 @@ class _ProbNLL(EvalMetric):
             chosen = scores_np[numpy.arange(rows),
                                expected.astype(numpy.int64)]
             self._accum(float(-numpy.log(chosen + self.eps).sum()), rows)
+
+    def device_batch(self, labels, preds):
+        total, count = 0.0, 0.0
+        for truth, scores in zip(labels, preds):
+            rows = scores.shape[0]
+            expected = truth.ravel().astype(jnp.int32)
+            chosen = scores[jnp.arange(rows), expected]
+            total = total - jnp.sum(jnp.log(chosen + self.eps))
+            count += rows
+        return total, count
 
 
 @alias("ce")
@@ -454,6 +558,13 @@ class Loss(EvalMetric):
             preds = [preds]
         for scores in preds:
             self._accum(float(ndarray.sum(scores).asscalar()), scores.size)
+
+    def device_batch(self, labels, preds):
+        total, count = 0.0, 0.0
+        for scores in preds:
+            total = total + jnp.sum(scores).astype(jnp.float32)
+            count += scores.size
+        return total, count
 
 
 @register

@@ -10,6 +10,7 @@ the params container is the framework's NDArray save format.
 from __future__ import annotations
 
 import logging
+import os
 from collections import namedtuple
 
 import numpy as np
@@ -23,6 +24,14 @@ __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _module_fused_enabled():
+    """MXTPU_MODULE_FUSED gate for the fused Module train step
+    (``module/fused.py``, ``docs/env_vars.md``): default ON; ``0`` keeps
+    the eager forward/backward/per-param-update loop everywhere."""
+    return os.environ.get("MXTPU_MODULE_FUSED", "1").strip().lower() \
+        not in ("0", "false", "off")
 
 
 def _create_kvstore(kvstore, num_device, arg_params):

@@ -198,12 +198,27 @@ class BucketingModule(BaseModule):
         self._curr_bucket_key = bucket_key
         # propagate the latest params into the bucket being switched to
         # (reference shares one memory pool across buckets; here buckets are
-        # separate jit specializations over shared host params)
+        # separate jit specializations over shared host params) — UNLESS
+        # both buckets alias the fused group's device store, in which case
+        # the switch needs no host round-trip at all (module/fused.py)
         if _propagate_params and prev is not None and \
                 prev is not self._curr_module and self.params_initialized:
+            prev_fused = getattr(prev, "_fused", None)
+            if prev_fused is not None and \
+                    prev_fused.shares_store_with(self._curr_module):
+                return
             prev._params_dirty = self._params_dirty or prev._params_dirty
             arg_params, aux_params = prev.get_params()
             self._curr_module.set_params(arg_params, aux_params)
+
+    def forward_backward(self, data_batch):
+        """One train step: switch to the batch's bucket, then delegate —
+        a fused bucket runs its ONE donated program over the shared
+        parameter store (a cache hit after the bucket's first batch)."""
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward_backward(data_batch)
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

@@ -211,33 +211,58 @@ class DataParallelExecutorGroup:
             exec_.copy_params_from(arg_params, aux_params,
                                    allow_extra_params=allow_extra)
 
+    @staticmethod
+    def _device_mean(block):
+        """Mean of per-device replicas computed ON DEVICE: gather every
+        replica onto the first one's device and reduce there — no numpy
+        round-trip per replica (the old ``sum(b.asnumpy())`` forced one
+        host sync + host add per device per parameter)."""
+        if len(block) == 1:
+            return block[0].copy()
+        import jax
+        acc = block[0]._data
+        dev = next(iter(acc.devices())) if hasattr(acc, "devices") else None
+        for b in block[1:]:
+            other = b._data
+            if dev is not None:
+                other = jax.device_put(other, dev)
+            acc = acc + other
+        return nd.NDArray(acc / len(block))
+
     def get_params(self, arg_params, aux_params):
         """Average params over devices into the given dicts
         (reference executor_group.py:get_params)."""
         for name, block in zip(self.param_names, self.param_arrays):
-            if len(block) == 1:
-                weight = block[0].copy()
-            else:
-                weight = sum(b.asnumpy() for b in block) / len(block)
-                weight = nd.array(weight)
+            weight = self._device_mean(block)
             arg_params[name] = weight.astype(arg_params[name].dtype) \
                 if name in arg_params else weight
         for name, block in zip(self.aux_names, self.aux_arrays):
-            if len(block) == 1:
-                weight = block[0].copy()
-            else:
-                weight = nd.array(sum(b.asnumpy() for b in block) / len(block))
-            aux_params[name] = weight
+            aux_params[name] = self._device_mean(block)
+
+    def adopt_store(self, param_store, aux_store):
+        """Alias every executor's parameter/aux slots to the shared
+        NDArray objects in the given stores (the fused BucketingModule
+        path: one device-side parameter store across buckets), then
+        refresh the collected array lists."""
+        for exec_ in self.execs:
+            exec_.adopt_arrays(param_store, aux_store)
+        self._collect_arrays()
 
     # -- execution ---------------------------------------------------------
+    def load_batch(self, data_batch):
+        """Scatter a batch into the executors' input buffers WITHOUT
+        running forward — the fused train step reads the staged values
+        and runs the whole step as one program."""
+        _load_general(data_batch.data, self.data_arrays)
+        if self.label_arrays is not None and data_batch.label:
+            _load_general(data_batch.label, self.label_arrays)
+
     def forward(self, data_batch, is_train=None):
         """Scatter batch, run forward on every executor
         (reference executor_group.py:422)."""
-        _load_general(data_batch.data, self.data_arrays)
+        self.load_batch(data_batch)
         if is_train is None:
             is_train = self.for_training
-        if self.label_arrays is not None and data_batch.label:
-            _load_general(data_batch.label, self.label_arrays)
         for exec_ in self.execs:
             exec_.forward(is_train=is_train)
 

@@ -1,0 +1,437 @@
+"""Fused Module train step: one donated XLA program per bucket.
+
+The eager ``Module.fit`` hot loop pays three distinct overheads per batch:
+``forward_backward`` dispatches a (speculatively fused) forward+vjp
+program, ``update`` walks every parameter through a Python updater loop —
+one eager optimizer-op dispatch per parameter — and ``update_metric``
+forces a full ``asnumpy()`` device sync. This module collapses all three
+into ONE jitted XLA program per (bucket, batch shape, dtype): forward +
+backward + the ENTIRE optimizer update as a multi-tensor apply (reusing
+the ``ops/optim_ops.py`` kernels through
+:func:`mxtpu.optimizer.functional_optimizer_step`), plus the metric's
+device-side (sum, count) accumulation (``EvalMetric.update_async``), with
+params / optimizer state / rng key / step count / metric accumulator all
+DONATED so XLA updates the buffers in place.
+
+Donation semantics: after every fused step the previous parameter and
+optimizer-state buffers are invalidated and each ``NDArray``'s ``_data``
+is rebound to the program's output — holders of the NDArray *wrappers*
+(executor ``arg_dict``, ``param_arrays``, updater states) always see the
+fresh values; raw ``jax.Array`` handles taken before a step are dead
+after it.
+
+``BucketingModule`` buckets share one optimizer (``borrow_optimizer``)
+and, here, one :class:`FusedGroupState`: every bucket's executor aliases
+the SAME parameter/aux NDArray objects (``Executor.adopt_arrays``), each
+bucket keeps its own compiled program per batch signature, and a bucket
+switch is a program-cache hit — no host-side parameter propagation, no
+re-dispatch.
+
+Escape hatch: anything the one-program contract can't honor — a
+``Monitor`` install (wants per-node outputs), a custom Python updater,
+sparse parameters, multi-context groups, kvstore-managed updates — falls
+back to the eager path (warning once for monitor / custom updaters).
+``MXTPU_MODULE_FUSED=0`` disables the whole mechanism
+(``docs/env_vars.md``).
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .. import optimizer as opt_mod
+from ..model import _module_fused_enabled
+from ..ndarray import NDArray, _wrap
+from ..optimizer import state_to_tree
+
+__all__ = ["FusedGroupState", "FusedModuleTrainer", "maybe_create",
+           "attach_borrowed", "metric_readback_interval"]
+
+
+def metric_readback_interval():
+    """MXTPU_METRIC_READBACK: drain the device metric accumulator every N
+    batches (0 = only when the metric is read: epoch end / callbacks)."""
+    try:
+        return int(os.environ.get("MXTPU_METRIC_READBACK", "0"))
+    except ValueError:
+        return 0
+
+
+class FusedGroupState:
+    """State shared by every module driving one optimizer (the
+    ``borrow_optimizer`` group — a BucketingModule's buckets): the
+    canonical device-side parameter/aux store, the donated rng/step/lr
+    scalars, the device metric accumulator, and the step counters."""
+
+    def __init__(self, optimizer, updater, ctx):
+        self.optimizer = optimizer
+        self.updater = updater
+        self.ctx = ctx
+        self.num_update = int(optimizer.num_update)
+        self.key_dev = None
+        self.t_dev = None
+        self.lr_dev = None
+        self.lr_host = None
+        self.param_store = {}
+        self.aux_store = {}
+        # device-side metric accumulation
+        self.metric = None
+        self.metric_fn = None
+        self.metric_key = None
+        self.metric_acc = None
+        self.batches_since_drain = 0
+        self.readback_every = metric_readback_interval()
+        self.warned_fallback = False
+        self.stats = {"steps": 0, "compiles": 0, "cache_hits": 0,
+                      "metric_drains": 0}
+
+    # -- donated device scalars -------------------------------------------
+    def device_state(self):
+        if self.key_dev is None:
+            dev = self.ctx.jax_device()
+            key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
+            self.key_dev = jax.device_put(_np.asarray(key), dev)
+            self.t_dev = jax.device_put(
+                _np.asarray(self.num_update, _np.int32), dev)
+            self.lr_host = self.host_lr()
+            self.lr_dev = jax.device_put(
+                _np.asarray(self.lr_host, _np.float32), dev)
+        return self.key_dev, self.t_dev, self.lr_dev
+
+    def host_lr(self):
+        o = self.optimizer
+        return float(o.lr_scheduler(self.num_update)) \
+            if o.lr_scheduler is not None else float(o.lr)
+
+    def refresh_lr(self):
+        """Push a new lr scalar only when the schedule actually moved —
+        the steady state makes zero host->device transfers."""
+        new_lr = self.host_lr()
+        if new_lr != self.lr_host:
+            self.lr_host = new_lr
+            self.lr_dev = jax.device_put(
+                _np.asarray(new_lr, _np.float32), self.ctx.jax_device())
+        return self.lr_dev
+
+    # -- device metric accumulator ----------------------------------------
+    def _zero_acc(self):
+        return jax.device_put(_np.zeros(2, _np.float32),
+                              self.ctx.jax_device())
+
+    def drain_metric(self):
+        """Fetch-and-zero the device (sum, count) pair — the ONE host
+        sync of the whole metric path, paid at read time, not per batch."""
+        acc = self.metric_acc
+        if acc is None:
+            return 0.0, 0.0
+        self.metric_acc = self._zero_acc()
+        self.batches_since_drain = 0
+        self.stats["metric_drains"] += 1
+        host = _np.asarray(jax.device_get(acc))
+        return float(host[0]), float(host[1])
+
+    def zero_metric(self):
+        if self.metric_acc is not None:
+            self.metric_acc = self._zero_acc()
+        self.batches_since_drain = 0
+
+    def detach_metric(self):
+        m = self.metric
+        if m is not None:
+            if self.metric_fn is not None:
+                m._drain_async()
+            m.detach_async()
+        self.metric = None
+        self.metric_fn = None
+        self.metric_key = None
+
+
+class FusedModuleTrainer:
+    """Per-Module driver of the fused train step over its executor."""
+
+    def __init__(self, module, group):
+        self._module = module
+        self._group = group
+        exec_group = module._exec_group
+        exec_ = exec_group.execs[0]
+        # updater slot i = position in the executor group's param list
+        # (the exact indices the eager per-param loop would use, so lr/wd
+        # multipliers and saved optimizer states line up bit-for-bit)
+        names_in_graph = [n for n in exec_group.param_names
+                          if n in exec_group.arg_names]
+        self._param_names = names_in_graph
+        self._train_names, self._opt_slots = [], []
+        for i, name in enumerate(names_in_graph):
+            if exec_.grad_dict.get(name) is not None:
+                self._train_names.append(name)
+                self._opt_slots.append(i)
+        self._cache = {}
+        self._last_fused = False
+        self._last_metric_applied = False
+
+    # -- group plumbing ----------------------------------------------------
+    def seed_store(self):
+        """First module of the group: its executor's arrays become the
+        canonical device parameter store."""
+        exec_ = self._module._exec_group.execs[0]
+        fs = self._group
+        fs.param_store = {n: exec_.arg_dict[n] for n in self._param_names}
+        fs.aux_store = {n: exec_.aux_dict[n] for n in exec_._aux_names}
+
+    def adopt_store(self):
+        """Alias this module's executors to the group's shared arrays
+        (values are already equal — bind copied them host-side once)."""
+        fs = self._group
+        if fs.param_store:
+            self._module._exec_group.adopt_store(fs.param_store,
+                                                 fs.aux_store)
+
+    def store_compatible(self):
+        """Every shared param name must agree on shape+dtype, or bucket
+        updates would fork — mismatches fall back to the eager path."""
+        exec_ = self._module._exec_group.execs[0]
+        for n, src in self._group.param_store.items():
+            dst = exec_.arg_dict.get(n)
+            if dst is not None and (dst.shape != src.shape or
+                                    dst.dtype != src.dtype):
+                return False
+        return True
+
+    def shares_store_with(self, other_module):
+        other = getattr(other_module, "_fused", None)
+        return other is not None and other._group is self._group
+
+    # -- fallback ----------------------------------------------------------
+    def _disable(self, reason):
+        fs = self._group
+        if not fs.warned_fallback:
+            warnings.warn(
+                "Module fused train step disabled: %s — falling back to "
+                "the eager forward/backward/update path." % reason,
+                stacklevel=4)
+            fs.warned_fallback = True
+        fs.detach_metric()
+        self._module._fused = None
+
+    # -- metric routing ----------------------------------------------------
+    def note_eager_forward(self):
+        self._last_fused = False
+
+    def note_metric(self, metric):
+        """True when this batch's contribution is already accumulated on
+        device; False routes the caller to the host update path (and
+        registers the metric so SUBSEQUENT steps fuse it)."""
+        fs = self._group
+        if not self._last_fused:
+            return False
+        if fs.metric is metric and self._last_metric_applied:
+            fs.batches_since_drain += 1
+            if fs.readback_every > 0 and \
+                    fs.batches_since_drain >= fs.readback_every:
+                metric._drain_async()
+            return True
+        if fs.metric is not metric:
+            self._register_metric(metric)
+        return False
+
+    def _register_metric(self, metric):
+        fs = self._group
+        fs.detach_metric()
+        fs.metric = metric
+        if not metric.supports_device_update():
+            return
+        label_names = tuple(self._module._label_names)
+
+        def metric_fn(feed, outs):
+            labels = tuple(feed[n] for n in label_names if n in feed)
+            return metric.device_batch(labels, outs)
+
+        try:
+            kw = tuple(sorted((k, repr(v))
+                              for k, v in metric._kwargs.items()))
+        except Exception:
+            kw = (id(metric),)
+        fs.metric_fn = metric_fn
+        fs.metric_key = (type(metric).__name__, kw)
+        if fs.metric_acc is None:
+            fs.metric_acc = fs._zero_acc()
+        metric.update_async(fs.drain_metric, fs.zero_metric)
+
+    # -- the step ----------------------------------------------------------
+    @staticmethod
+    def _shape_sig(arrs):
+        return tuple((tuple(a.shape), str(a.dtype)) for a in (arrs or []))
+
+    @staticmethod
+    def _write_state(dst, tree):
+        if dst is None:
+            return
+        if isinstance(dst, (tuple, list)):
+            for d, t in zip(dst, tree):
+                FusedModuleTrainer._write_state(d, t)
+        else:
+            dst._data = tree
+
+    @staticmethod
+    def _dedupe_donated(train_vals, state_trees):
+        """A state leaf aliasing a donated weight buffer (e.g. the Test
+        optimizer's state) would be donated twice — break the alias."""
+        seen = {id(v) for v in train_vals}
+
+        def fix(leaf):
+            if leaf is None:
+                return None
+            if isinstance(leaf, (tuple, list)):
+                return tuple(fix(x) for x in leaf)
+            if id(leaf) in seen:
+                return jnp.copy(leaf)
+            seen.add(id(leaf))
+            return leaf
+
+        return tuple(fix(t) for t in state_trees)
+
+    def step(self, data_batch):
+        """Run one fused forward+backward+update[+metric] step. Returns
+        False (after disabling, where appropriate) when the batch must
+        take the eager path instead."""
+        mod = self._module
+        fs = self._group
+        if isinstance(data_batch, list):
+            return False  # multi-module list batches: eager path
+        exec_group = mod._exec_group
+        exec_ = exec_group.execs[0]
+        if exec_._monitor_callback is not None:
+            self._disable("a Monitor is installed (per-node outputs need "
+                          "the eager executor)")
+            return False
+        if not isinstance(mod._updater, opt_mod.Updater) or \
+                mod._updater is not fs.updater:
+            self._disable("a custom updater replaced the shared "
+                          "optimizer Updater")
+            return False
+        # late reshape (bucketing-style): same contract as forward()
+        curr_shapes = tuple(i.shape for i in mod._data_shapes)
+        new_shapes = tuple(i.shape for i in data_batch.data)
+        if curr_shapes != new_shapes:
+            mod.reshape(*mod._shapes_for_batch(data_batch, new_shapes))
+            exec_group = mod._exec_group
+            exec_ = exec_group.execs[0]
+
+        key = (self._shape_sig(data_batch.data),
+               self._shape_sig(data_batch.label), fs.metric_key)
+        entry = self._cache.get(key)
+        if entry is None:
+            metric_fn = fs.metric_fn if fs.metric_key is not None else None
+            entry = exec_.make_fused_train_step(
+                self._train_names, fs.optimizer, self._opt_slots,
+                metric_fn=metric_fn)
+            self._cache[key] = entry
+            fs.stats["compiles"] += 1
+        else:
+            fs.stats["cache_hits"] += 1
+        fn, other_names = entry
+
+        exec_group.load_batch(data_batch)
+        train_vals = tuple(exec_.arg_dict[n]._data
+                           for n in self._train_names)
+        states_nd = [fs.updater.ensure_state(slot, exec_.arg_dict[name])
+                     for slot, name in zip(self._opt_slots,
+                                           self._train_names)]
+        state_trees = self._dedupe_donated(
+            train_vals, tuple(state_to_tree(s) for s in states_nd))
+        aux_vals = tuple(exec_.aux_dict[n]._data for n in exec_._aux_names)
+        other_vals = tuple(exec_.arg_dict[n]._data for n in other_names)
+        key_dev, t_dev, _ = fs.device_state()
+        if fs.optimizer.num_update > fs.num_update:
+            # eager update() calls interleaved with fused steps (mixed
+            # driving) advanced the host counters; re-sync the device
+            # step count so Adam-style bias correction stays aligned
+            fs.num_update = int(fs.optimizer.num_update)
+            t_dev = fs.t_dev = jax.device_put(
+                _np.asarray(fs.num_update, _np.int32), fs.ctx.jax_device())
+        fs.num_update += 1
+        lr_dev = fs.refresh_lr()
+        if fs.metric_acc is None:
+            fs.metric_acc = fs._zero_acc()
+
+        (new_vals, new_states, new_aux, outs, new_key, new_t,
+         new_acc) = fn(train_vals, state_trees, aux_vals, other_vals,
+                       key_dev, t_dev, lr_dev, fs.metric_acc)
+
+        # rebind every donated buffer's wrapper to the fresh value
+        for n, v in zip(self._train_names, new_vals):
+            exec_.arg_dict[n]._data = v
+        for dst, tree in zip(states_nd, new_states):
+            self._write_state(dst, tree)
+        for n, v in zip(exec_._aux_names, new_aux):
+            exec_.aux_dict[n]._data = v
+        fs.key_dev, fs.t_dev, fs.metric_acc = new_key, new_t, new_acc
+        exec_._outputs = [_wrap(o, exec_._ctx) for o in outs]
+        exec_._cached_grads = None
+        exec_._state_snapshot = None
+        # host mirrors of the in-program counters, so schedulers,
+        # `optimizer.learning_rate` and saved optimizer states agree with
+        # what the eager per-param loop would have recorded
+        opt = fs.optimizer
+        opt.num_update = fs.num_update
+        for slot in self._opt_slots:
+            opt._index_update_count[slot] = fs.num_update
+        fs.stats["steps"] += 1
+        self._last_fused = True
+        self._last_metric_applied = fs.metric_fn is not None
+        return True
+
+
+def _statically_eligible(module):
+    """Conditions knowable at init_optimizer/borrow time. Anything here
+    is a NORMAL configuration choice (multi-device groups, kvstore-managed
+    updates, sparse storage) — fall back silently, no warning."""
+    if not _module_fused_enabled():
+        return False
+    if len(module._context) != 1 or len(module._exec_group.execs) != 1:
+        return False
+    if module._kvstore is not None or module._update_on_kvstore:
+        return False
+    if not isinstance(module._updater, opt_mod.Updater):
+        return False
+    if not module.for_training or module.inputs_need_grad:
+        return False
+    if module._state_names:
+        return False
+    if module._grad_req != "write":
+        return False
+    exec_ = module._exec_group.execs[0]
+    for arr in list(exec_.arg_dict.values()) + list(exec_.grad_dict.values()):
+        if hasattr(arr, "_aux"):   # sparse storage: lazy-update path
+            return False
+    return True
+
+
+def maybe_create(module):
+    """Called at the end of ``Module.init_optimizer``: build the fused
+    trainer (and become the group's store owner) when eligible."""
+    if not _statically_eligible(module):
+        return None
+    group = FusedGroupState(module._optimizer, module._updater,
+                            module._context[0])
+    trainer = FusedModuleTrainer(module, group)
+    trainer.seed_store()
+    return trainer
+
+
+def attach_borrowed(module, shared_module):
+    """Called from ``Module.borrow_optimizer``: join the lender's fused
+    group, aliasing this module's executors to the shared device store
+    (the BucketingModule bucket-switch fast path)."""
+    lender = getattr(shared_module, "_fused", None)
+    if lender is None or not _statically_eligible(module):
+        return None
+    trainer = FusedModuleTrainer(module, lender._group)
+    if not trainer.store_compatible():
+        return None
+    trainer.adopt_store()
+    return trainer

@@ -28,7 +28,118 @@ from .ndarray import NDArray
 __all__ = ["Optimizer", "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD",
            "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax",
            "Nadam", "Test", "LBSGD", "create", "register", "get_updater",
-           "Updater", "ccSGD"]
+           "Updater", "ccSGD", "functional_optimizer_step", "state_to_tree",
+           "tree_to_state"]
+
+
+# ---------------------------------------------------------------------------
+# Functional (jit-traceable) optimizer adapter.
+#
+# The imperative Optimizer API keeps host-side python counters
+# (``_index_update_count``, ``num_update``) and computes lr via its
+# scheduler at call time. Inside a jitted train step those would freeze at
+# trace-time values; the adapter below hands the optimizer traced (t, lr)
+# scalars instead, so ANY registered optimizer runs unmodified inside one
+# XLA program. Shared by ``parallel.ShardedTrainer`` and the Module fused
+# train step (``module/fused.py``).
+# ---------------------------------------------------------------------------
+
+def state_to_tree(state):
+    """Optimizer state (None | NDArray | nested tuple/list) → jax pytree."""
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state._data
+    if isinstance(state, (tuple, list)):
+        return tuple(state_to_tree(s) for s in state)
+    return state
+
+
+def tree_to_state(tree):
+    """jax pytree → NDArray-structured optimizer state for Optimizer.update."""
+    if tree is None:
+        return None
+    if isinstance(tree, (tuple, list)):
+        return tuple(tree_to_state(t) for t in tree)
+    return NDArray(tree)
+
+
+class _TracedCounts(dict):
+    """Stands in for Optimizer._index_update_count during a functional
+    trace: every key reads as the traced step count."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __getitem__(self, key):
+        return self._t
+
+    def __setitem__(self, key, value):
+        pass
+
+    def __contains__(self, key):
+        return True
+
+
+class _functional_optimizer:
+    """Patch an Optimizer instance so update() can be traced by jit with a
+    dynamic step count and learning rate."""
+
+    def __init__(self, opt, t, lr):
+        self._opt = opt
+        self._t = t
+        self._lr = lr
+
+    def __enter__(self):
+        o = self._opt
+        self._saved = (o.__dict__.get("_index_update_count"),
+                       o.__dict__.get("num_update"))
+        lr_arg = self._lr
+
+        def _get_lr(index):
+            mult = 1.0
+            if index in o.param_dict:
+                mult = o.param_dict[index].lr_mult
+            elif index in o.lr_mult:
+                mult = o.lr_mult[index]
+            elif index in o.idx2name:
+                mult = o.lr_mult.get(o.idx2name[index], 1.0)
+            return lr_arg * mult
+
+        o._index_update_count = _TracedCounts(self._t)
+        o.num_update = self._t
+        o._update_count = lambda index: None
+        o._get_lr = _get_lr
+        return o
+
+    def __exit__(self, *a):
+        o = self._opt
+        for name in ("_update_count", "_get_lr"):
+            o.__dict__.pop(name, None)
+        saved_counts, saved_num = self._saved
+        if saved_counts is None:
+            o.__dict__.pop("_index_update_count", None)
+        else:
+            o._index_update_count = saved_counts
+        if saved_num is None:
+            o.__dict__.pop("num_update", None)
+        else:
+            o.num_update = saved_num
+
+
+def functional_optimizer_step(optimizer, index, weight_val, grad_val,
+                              state_tree, t, lr):
+    """Run one Optimizer.update purely: (w, g, state, t, lr) → (w', state').
+
+    Reuses the full imperative optimizer library (all 14 registered
+    optimizers, reference optimizer.py:432-1434) inside jit."""
+    w = NDArray(weight_val)
+    g = NDArray(grad_val)
+    state = tree_to_state(state_tree)
+    with _functional_optimizer(optimizer, t, lr):
+        optimizer.update_multi_precision(index, w, g, state)
+    return w._data, state_to_tree(state)
 
 
 class Optimizer:
@@ -770,7 +881,10 @@ class Updater:
         self.states = {}
         self.states_synced = {}
 
-    def __call__(self, index, grad, weight):
+    def ensure_state(self, index, weight):
+        """Materialize (and return) the state slot for ``index`` exactly as
+        ``__call__`` would — the Module fused train step reads states
+        directly instead of going through the per-param call."""
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
@@ -779,8 +893,11 @@ class Updater:
             self.states[index] = self.sync_state_context(self.states[index],
                                                          weight.context)
             self.states_synced[index] = True
+        return self.states[index]
+
+    def __call__(self, index, grad, weight):
         self.optimizer.update_multi_precision(index, weight, grad,
-                                              self.states[index])
+                                              self.ensure_state(index, weight))
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):

@@ -67,6 +67,31 @@ def test_attach_best_tpu_measurement(tmp_path, monkeypatch):
     assert "best_tpu_measured" not in result2
 
 
+def test_module_bench_contract():
+    """tools/bench_module.py: exactly one JSON line, rc 0, with the
+    fused-vs-eager fields the perf trajectory (docs/perf_analysis.md
+    "Module fast path") is tracked by — tiny models, CPU-only."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_BENCH_TINY="1",
+               PYTHONPATH=_ROOT)
+    env.pop("MXTPU_MODULE_FUSED", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_module.py"),
+         "--batches", "3", "--warmup", "2", "--no-write"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, "must print exactly ONE JSON line"
+    payload = json.loads(lines[0])
+    assert payload["bench"] == "module_fit"
+    assert payload["tiny"] is True
+    assert set(payload["models"]) == {"mlp", "lenet"}
+    for model, row in payload["models"].items():
+        for field in ("fused_img_s", "eager_img_s", "speedup",
+                      "batch_size"):
+            assert isinstance(row[field], (int, float)), (model, field)
+        assert row["fused_img_s"] > 0 and row["eager_img_s"] > 0
+
+
 def test_kvstore_bench_contract(tmp_path):
     """tools/bench_kvstore.py: exactly one JSON line, rc 0, with the
     fields the perf trajectory (docs/perf_analysis.md "Comms fast

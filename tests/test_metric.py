@@ -85,3 +85,91 @@ def test_pearson():
     m = mx.metric.create("pearsonr")
     m.update([label], [pred])
     assert abs(m.get()[1] - 1.0) < 1e-6
+
+
+# -- device-side accumulation (ISSUE 5: EvalMetric.update_async) ----------
+
+def _device_pair(metric, labels, preds):
+    """Run metric.device_batch on jnp arrays, return host (sum, count)."""
+    import jax.numpy as jnp
+    out = metric.device_batch(tuple(jnp.asarray(l) for l in labels),
+                              tuple(jnp.asarray(p) for p in preds))
+    assert out is not None
+    return float(out[0]), float(out[1])
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("acc", {}), ("top_k_accuracy", {"top_k": 2}), ("mae", {}),
+    ("mse", {}), ("rmse", {}), ("ce", {}), ("nll_loss", {}),
+])
+def test_device_batch_matches_host_update(name, kwargs):
+    """device_batch (the traced body the fused train step accumulates)
+    must agree with the numpy update() path on the same batch."""
+    rng = np.random.RandomState(0)
+    pred = np.abs(rng.rand(16, 4).astype("float32")) + 1e-3
+    pred = pred / pred.sum(axis=1, keepdims=True)
+    if name in ("mae", "mse", "rmse"):
+        label = rng.rand(16).astype("float32")
+        pred_in = rng.rand(16).astype("float32")
+    else:
+        label = rng.randint(0, 4, 16).astype("float32")
+        pred_in = pred
+    host = mx.metric.create(name, **kwargs)
+    host.update([mx.nd.array(label)], [mx.nd.array(pred_in)])
+    dev = mx.metric.create(name, **kwargs)
+    assert dev.supports_device_update()
+    s, c = _device_pair(dev, [label], [pred_in])
+    assert abs(c - host.num_inst) < 1e-6
+    assert abs(s - host.sum_metric) < 1e-4 * max(1.0, abs(host.sum_metric))
+
+
+def test_update_async_drains_lazily_and_resets():
+    """update_async routes accumulation through a caller-owned device
+    accumulator: get() drains it exactly once per read, reset() discards
+    both sides."""
+    m = mx.metric.create("acc")
+    box = {"sum": 6.0, "count": 10.0, "reads": 0, "resets": 0}
+
+    def reader():
+        box["reads"] += 1
+        s, c = box["sum"], box["count"]
+        box["sum"] = box["count"] = 0.0   # fetch-and-zero contract
+        return s, c
+
+    def resetter():
+        box["resets"] += 1
+        box["sum"] = box["count"] = 0.0
+
+    m.update_async(reader, resetter)
+    assert m.get()[1] == 0.6 and box["reads"] == 1
+    assert m.get()[1] == 0.6 and box["reads"] == 2  # idempotent re-read
+    box["sum"], box["count"] = 4.0, 4.0             # more device batches
+    assert abs(m.get()[1] - 10.0 / 14.0) < 1e-9
+    m.reset()
+    assert box["resets"] == 1
+    assert np.isnan(m.get()[1])                     # all state discarded
+    m.detach_async()
+    m.update([mx.nd.array([1.0])], [mx.nd.array([[0.2, 0.8]])])
+    assert m.get()[1] == 1.0                        # host path restored
+
+
+def test_unsupported_metrics_report_no_device_path():
+    comp = mx.metric.create(["acc", "ce"])
+    assert not comp.supports_device_update()
+    f1 = mx.metric.create("f1")
+    assert not f1.supports_device_update()
+    named = mx.metric.Accuracy(output_names=["softmax_output"])
+    assert not named.supports_device_update()
+
+
+def test_host_transfer_avoids_copy_when_host_resident():
+    """metric._host must not copy a host-resident numpy array when no
+    cast is needed (the metric.py:45 hardening)."""
+    from mxtpu.metric import _host
+    a = np.arange(6, dtype="float32").reshape(2, 3)
+    out = _host(a)
+    assert out is a                      # asarray view, no copy
+    out32 = _host(a, "float32")
+    assert out32 is a                    # astype(copy=False) no-op cast
+    out64 = _host(a, "float64")
+    assert out64.dtype == np.float64 and out64 is not a

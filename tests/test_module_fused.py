@@ -1,0 +1,241 @@
+"""Fused Module train-step tests (ISSUE 5): fused-vs-eager parity,
+BucketingModule bucket-switch cache reuse over the shared device store,
+and the eager fallback paths (Monitor / custom updater — warn once)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+
+def _toy_problem(n=128, dim=20, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, dim).astype("float32")
+    w = rng.randn(dim, classes).astype("float32")
+    y = (x @ w).argmax(axis=1).astype("float32")
+    return x, y
+
+
+def _mlp(classes=4):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit(fused, monkeypatch, optimizer="sgd", opt_params=None, epochs=2):
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1" if fused else "0")
+    np.random.seed(7)
+    mx.random.seed(7)
+    x, y = _toy_problem()
+    train = mx.io.NDArrayIter(x, y, batch_size=32,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, optimizer=optimizer,
+            optimizer_params=opt_params or {"learning_rate": 0.05,
+                                            "momentum": 0.9, "wd": 1e-4},
+            initializer=mx.initializer.Xavier(), num_epoch=epochs,
+            eval_metric="acc")
+    assert (mod._fused is not None) == fused
+    args, auxs = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_fused_vs_eager_parity(monkeypatch, optimizer, opt_params):
+    """Params after K epochs of fit() must match between the fused
+    one-program path and the eager forward/backward/update loop."""
+    _, fused_params = _fit(True, monkeypatch, optimizer, opt_params)
+    _, eager_params = _fit(False, monkeypatch, optimizer, opt_params)
+    assert fused_params.keys() == eager_params.keys()
+    for k in fused_params:
+        np.testing.assert_allclose(fused_params[k], eager_params[k],
+                                   rtol=5e-4, atol=1e-5, err_msg=k)
+
+
+def test_fused_optimizer_state_roundtrip(monkeypatch, tmp_path):
+    """Optimizer states written by the fused multi-tensor apply must
+    save/load through the standard Updater serialization."""
+    mod, _ = _fit(True, monkeypatch, "sgd",
+                  {"learning_rate": 0.05, "momentum": 0.9})
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    states = mod._updater.states
+    assert states and all(s is not None for s in states.values())
+    mod.load_optimizer_states(fname)
+    # training continues on the fused path after a state reload
+    x, y = _toy_problem()
+    batch = mx.io.DataBatch([mx.nd.array(x[:32])], [mx.nd.array(y[:32])])
+    mod.forward_backward(batch)
+    mod.update()
+    assert mod._fused is not None
+
+
+def test_bucketing_switch_is_cache_hit(monkeypatch):
+    """After each bucket's first batch, alternating buckets must re-use
+    compiled programs (no new compiles) and share ONE device parameter
+    store (no host-side param propagation on switch)."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    np.random.seed(3)
+    mx.random.seed(3)
+
+    def sym_gen(bucket_key):
+        data = mx.sym.var("data")
+        net = mx.sym.sum(data, axis=1)          # (B, L, D) -> (B, D)
+        net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu", name="relu1")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind([("data", (8, 10, 6))], [("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+
+    def batch_for(key):
+        x = rng.randn(8, key, 6).astype("float32")
+        y = rng.randint(0, 4, 8).astype("float32")
+        return mx.io.DataBatch(
+            [mx.nd.array(x)], [mx.nd.array(y)], bucket_key=key,
+            provide_data=[("data", (8, key, 6))],
+            provide_label=[("softmax_label", (8,))])
+
+    metric = mx.metric.create("acc")
+    # warmup: each bucket compiles its own program(s) on first visit
+    for key in (10, 20, 10, 20):
+        b = batch_for(key)
+        mod.forward_backward(b)
+        mod.update()
+        mod.update_metric(metric, b.label)
+    metric.get()
+
+    m10, m20 = mod._buckets[10], mod._buckets[20]
+    assert m10._fused is not None and m20._fused is not None
+    fs = m10._fused._group
+    assert m20._fused._group is fs, "buckets must share one fused group"
+    # one shared device store: the SAME NDArray objects back every bucket
+    e10 = m10._exec_group.execs[0]
+    e20 = m20._exec_group.execs[0]
+    for name in ("fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"):
+        assert e10.arg_dict[name] is e20.arg_dict[name], name
+
+    compiles = fs.stats["compiles"]
+    syncs_before = fs.stats["metric_drains"]
+    before = e10.arg_dict["fc1_weight"].asnumpy()
+    for key in (20, 10, 20, 10, 20, 10):
+        b = batch_for(key)
+        mod.forward_backward(b)
+        mod.update()
+        mod.update_metric(metric, b.label)
+    assert fs.stats["compiles"] == compiles, \
+        "bucket switches after warmup must be program-cache hits"
+    assert fs.stats["metric_drains"] == syncs_before, \
+        "no per-batch metric drains during steady-state switching"
+    after = e10.arg_dict["fc1_weight"].asnumpy()
+    assert np.abs(after - before).max() > 0, "training must still learn"
+    assert np.isfinite(after).all()
+
+
+def test_monitor_forces_eager_and_warns_once(monkeypatch):
+    """Installing a Monitor is incompatible with the one-program step:
+    the module must fall back to the eager path with ONE warning."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    x, y = _toy_problem()
+    train = mx.io.NDArrayIter(x, y, batch_size=32,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    assert mod._fused is not None
+    mod.install_monitor(mx.monitor.Monitor(1))
+    batch = next(iter(train))
+    with pytest.warns(UserWarning, match="fused train step disabled"):
+        mod.forward_backward(batch)
+    mod.update()
+    assert mod._fused is None, "monitor install must disable fusion"
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # a second warning would raise
+        mod.forward_backward(batch)
+        mod.update()
+
+
+def test_custom_updater_forces_eager_and_warns_once(monkeypatch):
+    """A custom Python updater can't be traced into the fused program:
+    fall back (warning once) and keep applying it eagerly."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    x, y = _toy_problem()
+    train = mx.io.NDArrayIter(x, y, batch_size=32,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    assert mod._fused is not None
+
+    applied = []
+
+    def updater(index, grad, weight):
+        applied.append(index)
+        weight._data = weight._data - 0.01 * grad._data
+
+    mod._updater = updater
+    batch = next(iter(train))
+    before = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    with pytest.warns(UserWarning, match="custom updater"):
+        mod.forward_backward(batch)
+    mod.update()
+    assert mod._fused is None
+    assert applied, "custom updater must run on the eager path"
+    after = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    assert np.abs(after - before).max() > 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        mod.forward_backward(batch)
+        mod.update()
+
+
+def test_fused_env_kill_switch(monkeypatch):
+    """MXTPU_MODULE_FUSED=0 keeps the whole Module stack eager."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "0")
+    x, y = _toy_problem()
+    train = mx.io.NDArrayIter(x, y, batch_size=32,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer()
+    assert mod._fused is None
+
+
+def test_fused_donation_rebinds_wrappers(monkeypatch):
+    """Donation invalidates old device buffers but every NDArray WRAPPER
+    (arg_dict entries, param_arrays) must stay live across steps."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    x, y = _toy_problem()
+    train = mx.io.NDArrayIter(x, y, batch_size=32,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    w = mod._exec_group.execs[0].arg_dict["fc1_weight"]
+    for batch in list(train)[:3]:
+        mod.forward_backward(batch)
+        mod.update()
+    vals = w.asnumpy()                  # wrapper rebound, still readable
+    assert np.isfinite(vals).all()
+    outs = mod.get_outputs()            # fused step published outputs
+    assert outs[0].shape == (32, 4)

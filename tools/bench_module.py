@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Loopback microbench for the Module fused train step (ISSUE 5).
+
+Measures steady-state ``Module.fit`` throughput — the exact hot loop
+``fit`` runs per batch (``forward_backward`` → ``update`` →
+``update_metric``) — for the two bundled CPU-runnable models:
+
+* **mlp**  — 256→128→64→10 softmax MLP at batch 64
+* **lenet** — LeNet-style conv/pool/conv/pool/fc on 1x28x28 at batch 4
+
+Batch sizes are per-model: the fused step removes PER-STEP dispatch
+overhead (python updater loop, per-batch metric sync, extra program
+launches), so each model runs in the regime where the Module path — not
+raw conv arithmetic on this 1-core CI host — is what's being measured:
+the MLP is overhead-dominated even at batch 64; the conv net only below
+~batch 8 (at batch 32+ its conv FLOPs bound a single core and the fused
+win shrinks to ~1.2x — the full scan is in docs/perf_analysis.md).
+
+Each model runs twice: ``MXTPU_MODULE_FUSED=1`` (one donated XLA program
+per step: forward + backward + whole optimizer update + device-side
+metric accumulation) and ``=0`` (the eager path: speculated fwd+bwd
+program, per-parameter Python optimizer dispatches, per-batch
+``asnumpy()`` metric sync). The warmup batches (compiles + metric
+registration) are excluded; the metric is drained once at the end so the
+async path's deferred work is counted.
+
+Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
+and mirrors it to docs/module_bench.json unless --no-write. CPU-only.
+MXTPU_BENCH_TINY shrinks the models/batch counts for the contract test.
+
+Run: JAX_PLATFORMS=cpu python tools/bench_module.py [--batches 100]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, ROOT)
+
+TINY = os.environ.get("MXTPU_BENCH_TINY", "0") not in ("", "0")
+
+
+def _mlp(mx, hidden=(128, 64), classes=10):
+    net = mx.sym.var("data")
+    for i, h in enumerate(hidden):
+        net = mx.sym.FullyConnected(net, num_hidden=h, name="fc%d" % i)
+        net = mx.sym.Activation(net, act_type="relu", name="act%d" % i)
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc_out")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _lenet(mx, classes=10):
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, kernel=(5, 5), num_filter=4,
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="tanh", name="tanh1")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool1")
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=8,
+                             name="conv2")
+    net = mx.sym.Activation(net, act_type="tanh", name="tanh2")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool2")
+    net = mx.sym.Flatten(net, name="flat")
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh", name="tanh3")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(model, n, batch_size):
+    rng = np.random.RandomState(0)
+    if model == "mlp":
+        x = rng.randn(n, 256).astype("float32")
+    else:
+        x = rng.randn(n, 1, 28, 28).astype("float32")
+    y = rng.randint(0, 10, n).astype("float32")
+    return x, y
+
+
+def _steady_state_rate(mx, sym, x, y, batch_size, batches, warmup):
+    """img/sec of the fit() hot loop after warmup, current env."""
+    it = mx.io.NDArrayIter(x, y, batch_size=batch_size,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01,
+                                         "momentum": 0.9})
+    metric = mx.metric.create("acc")
+    pool = list(it)
+
+    def one(batch):
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+    for i in range(warmup):
+        one(pool[i % len(pool)])
+    metric.get()                      # drain any device accumulation
+    metric.reset()
+
+    t0 = time.perf_counter()
+    for i in range(batches):
+        one(pool[i % len(pool)])
+    metric.get()                      # epoch-end read, both paths
+    # flush async dispatch: the step's outputs must actually exist
+    mod._exec_group.execs[0].arg_dict[
+        mod._exec_group.param_names[0]].wait_to_read()
+    dt = time.perf_counter() - t0
+    fused = mod._fused is not None
+    return batch_size * batches / dt, fused
+
+
+DEFAULT_BS = {"mlp": 8, "lenet": 2} if TINY else {"mlp": 64, "lenet": 4}
+
+
+def run(batches, warmup, batch_size=None):
+    import mxtpu as mx
+
+    models = {}
+    for name, sym_fn in (("mlp", _mlp), ("lenet", _lenet)):
+        bs = batch_size or DEFAULT_BS[name]
+        n = max(4 * bs, 64)
+        x, y = _data(name, n, bs)
+        sym = sym_fn(mx)
+        saved = os.environ.get("MXTPU_MODULE_FUSED")
+        try:
+            os.environ["MXTPU_MODULE_FUSED"] = "1"
+            fused_rate, was_fused = _steady_state_rate(
+                mx, sym, x, y, bs, batches, warmup)
+            assert was_fused, "fused path did not engage"
+            os.environ["MXTPU_MODULE_FUSED"] = "0"
+            eager_rate, was_fused = _steady_state_rate(
+                mx, sym, x, y, bs, batches, warmup)
+            assert not was_fused
+        finally:
+            if saved is None:
+                os.environ.pop("MXTPU_MODULE_FUSED", None)
+            else:
+                os.environ["MXTPU_MODULE_FUSED"] = saved
+        models[name] = {"batch_size": bs,
+                        "fused_img_s": round(fused_rate, 1),
+                        "eager_img_s": round(eager_rate, 1),
+                        "speedup": round(fused_rate / eager_rate, 2)}
+    return {"bench": "module_fit", "tiny": TINY,
+            "batches": batches, "warmup": warmup,
+            "host_cores": os.cpu_count(), "models": models}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=4 if TINY else 100,
+                    help="steady-state batches per timing run")
+    ap.add_argument("--warmup", type=int, default=2 if TINY else 8)
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="override the per-model defaults (%r)"
+                    % (DEFAULT_BS,))
+    ap.add_argument("--no-write", action="store_true",
+                    help="do not mirror the line to docs/module_bench.json")
+    args = ap.parse_args()
+
+    result = run(args.batches, args.warmup, args.batch_size)
+    line = json.dumps(result)
+    print(line, flush=True)
+    if not args.no_write:
+        with open(os.path.join(ROOT, "docs", "module_bench.json"),
+                  "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
