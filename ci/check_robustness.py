@@ -1,44 +1,33 @@
 #!/usr/bin/env python
-"""Robustness lint for the dist/engine hot paths.
+"""Structural robustness contracts for the dist/engine hot paths.
 
-The dist_async fault story (mxtpu/kvstore_async.py, "Fault tolerance")
-only holds if no code path can block forever on a silent socket or
-swallow a failure invisibly. This check fails CI on NEW instances of:
+Historically this check also policed unbounded socket waits, blind
+``except: pass`` swallows and untimed ``wait()/get()/join()`` with line
+regexes over a 3-line window plus a hand-pinned ALLOW list. Those rules
+are SUBSUMED by the AST-based analyzer (``tools/mxlint.py``, gated by
+``ci/check_static.py`` in the same sanity tier): the AST passes see
+wrapped calls, honor inline ``# mxlint: allow(...)`` pragmas instead of
+a side-table of (path, line) pins, and add the analyses a regex cannot
+do (lock-order cycles, host syncs in jitted code, use-after-donate).
+See ``docs/static_analysis.md``.
 
-1. **Unbounded socket waits** anywhere under ``mxtpu/``:
-   ``create_connection(`` with no explicit ``timeout=`` in the call
-   (checked over a 3-line window — calls wrap), ``settimeout(None)``,
-   and raw ``.recv(`` / ``.recv_into(`` reads.
-2. **Blind exception swallows** in the kvstore/engine/fault/checkpoint
-   paths: ``except Exception:`` or bare ``except:`` whose body is just
-   ``pass`` — the pattern that turns a dead server into a silent hang.
-3. **Unbounded thread-synchronization waits** anywhere under
-   ``mxtpu/``: ``.wait()`` / ``.get()`` / ``.join()`` called with NO
-   arguments (no timeout). On the worker-resilience paths these are
-   exactly how a dead peer hangs a survivor forever; new ones must
-   carry a timeout or be pinned in ALLOW with a reason. (``.get()``
-   matches dict/metric getters too — pin those, the list stays short.)
-4. **Non-daemon threads** under ``mxtpu/``: a ``threading.Thread(``
-   whose 3-line call window carries no ``daemon=True`` keeps a crashed
-   worker's interpreter alive, which defeats ``kill``-based respawn
-   (the launcher waits on a zombie). Every in-tree thread is a daemon
-   today; keep it that way.
-5. **Replication ack-before-durability regressions** in the server's
-   push handler: every ok-ack in ``_do_push`` must sit below the
-   ``_repl_barrier`` call, and the barrier must keep its sync-mode
-   wait on the backup — a new early ack would silently break the
-   "kill -9 a primary, lose zero acknowledged pushes" guarantee.
+What stays here are the two contracts that are about *structure*, not
+call sites — they assert a relationship between places in the code, so
+they read better as explicit checks than as lint passes:
 
-Deliberate cases are pinned in ALLOW below by (path, stripped line):
-today's server-side frame read idles unbounded BY DESIGN (workers hold
-connections open between steps; worker-side callers settimeout() before
-entering the read loop). Anything not pinned fails, so a regression —
-or a new offender pasted in from old habits — is caught at the sanity
-tier, not in a 3 a.m. hung fleet.
+1. **Non-daemon threads** under ``mxtpu/``: a ``threading.Thread(``
+   with no ``daemon=True`` (in the call or as an attribute on the next
+   lines) keeps a crashed worker's interpreter alive, which defeats
+   ``kill``-based respawn (the launcher waits on a zombie). Every
+   in-tree thread is a daemon today; keep it that way.
+2. **Replication ack-before-durability** in the server's push handler:
+   every ok-ack in ``_do_push`` must sit below the ``_repl_barrier``
+   call, and the barrier must keep its sync-mode wait on the backup —
+   a new early ack would silently break the "kill -9 a primary, lose
+   zero acknowledged pushes" guarantee (ISSUE 4 / the fault matrix).
 
 Run: ``python ci/check_robustness.py`` (wired into ``ci/run_ci.sh
-sanity``). To bless a new deliberate case, add its (path, line) pair to
-ALLOW with a comment saying why it cannot take a timeout.
+sanity``).
 """
 from __future__ import annotations
 
@@ -49,77 +38,7 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 PKG = ROOT / "mxtpu"
 
-# (repo-relative path, stripped source line) -> why it is allowed
-ALLOW = {
-    # the shared frame-read loop: server-side it idles unbounded by
-    # design (workers keep connections open between steps); worker-side
-    # every caller runs settimeout() on the socket first (_request_once)
-    ("mxtpu/kvstore_async.py",
-     "r = sock.recv_into(view[got:], n - got)"),
-    # -- grandfathered unbounded waits (pre-ISSUE-3 offenders; each sits
-    # behind a daemon thread or a deliberate block-forever entry point,
-    # so none can wedge a respawn — new code must do better) --
-    ("mxtpu/kvstore_async.py", "srv._thread.join()"),
-    #   ^ serve_forever(): the server role process blocks here by design
-    ("mxtpu/checkpoint.py", "self._pending.join()"),
-    #   ^ wait_until_finished joining the (daemon) writer thread
-    ("mxtpu/io.py", "e.wait()"),
-    #   ^ _wait_all over prefetch events; workers are daemons
-    ("mxtpu/io.py", "self.data_taken[i].wait()"),
-    #   ^ prefetch worker parked on its double-buffer event (daemon)
-    ("mxtpu/gluon/data/dataloader.py", "cond.wait()"),
-    #   ^ dataloader reorder wait; worker threads are daemons
-    ("mxtpu/gluon/data/dataloader.py", "item = task_q.get()"),
-    #   ^ dataloader task queue; worker threads are daemons
-    ("mxtpu/image.py", "out = res.get()"),
-    #   ^ multiprocessing AsyncResult in the image worker pool
-    ("mxtpu/metric.py", "name, value = self.get()"),
-    #   ^ EvalMetric.get() — a value getter, not a queue
-    ("mxtpu/metric.py", "name, value = child.get()"),
-    #   ^ EvalMetric.get() — a value getter, not a queue
-}
-
-# blind-swallow scan is scoped to the paths where a swallowed error
-# means a hung or silently-corrupt fleet
-SWALLOW_FILES = ("kvstore.py", "kvstore_async.py", "kvstore_server.py",
-                 "engine.py", "fault.py", "checkpoint.py")
-
-_SOCKET_PAT = re.compile(
-    r"create_connection\(|settimeout\(\s*None\s*\)|\.recv\(|\.recv_into\(")
-_EXCEPT_PAT = re.compile(r"^\s*except(\s+Exception)?\s*(:|\s+as\b.*:)\s*$")
-
-
-def _socket_offenders(path, lines):
-    rel = str(path.relative_to(ROOT))
-    for i, line in enumerate(lines):
-        stripped = line.strip()
-        if stripped.startswith("#") or not _SOCKET_PAT.search(line):
-            continue
-        if "create_connection(" in line:
-            # calls wrap: accept timeout= within the next two lines
-            window = "".join(lines[i:i + 3])
-            if "timeout" in window:
-                continue
-        if (rel, stripped) in ALLOW:
-            continue
-        yield (rel, i + 1, stripped,
-               "socket call with no explicit timeout")
-
-
-_SYNC_WAIT_PAT = re.compile(r"\.(wait|get|join)\(\s*\)")
 _THREAD_PAT = re.compile(r"threading\.Thread\(")
-
-
-def _sync_wait_offenders(path, lines):
-    rel = str(path.relative_to(ROOT))
-    for i, line in enumerate(lines):
-        stripped = line.strip()
-        if stripped.startswith("#") or not _SYNC_WAIT_PAT.search(line):
-            continue
-        if (rel, stripped) in ALLOW:
-            continue
-        yield (rel, i + 1, stripped,
-               "wait()/get()/join() with no timeout")
 
 
 def _thread_offenders(path, lines):
@@ -133,36 +52,19 @@ def _thread_offenders(path, lines):
         window = "".join(lines[i:i + 3])
         if "daemon" in window:
             continue
-        if (rel, stripped) in ALLOW:
-            continue
         yield (rel, i + 1, stripped,
                "non-daemon thread (would outlive a killed worker)")
 
 
-def _swallow_offenders(path, lines):
-    rel = str(path.relative_to(ROOT))
-    for i, line in enumerate(lines):
-        if not _EXCEPT_PAT.match(line):
-            continue
-        body = lines[i + 1].strip() if i + 1 < len(lines) else ""
-        if body != "pass":
-            continue
-        stripped = line.strip()
-        if (rel, stripped) in ALLOW:
-            continue
-        yield (rel, i + 1, stripped,
-               "blind 'except: pass' in a kvstore/engine path")
-
-
 # ---------------------------------------------------------------------------
-# 5. Replication ack-before-durability contract (ISSUE 4): in sync
+# Replication ack-before-durability contract (ISSUE 4): in sync
 # replication mode a push must NOT be acked before the backup holds it.
 # Structurally: every ok-ack in the server's push handler (_do_push)
 # must sit below a _repl_barrier() call, and the barrier itself must
 # wait on the stream (wait_acked / wait_drained) in sync mode. This is
-# a grep-level contract on the dispatch source — it catches the easy
-# regression (a new early `return ("ok",...)` pasted above the
-# barrier), not every semantic hole; the fault matrix covers those.
+# a source-shape contract — it catches the easy regression (a new early
+# `return ("ok",...)` pasted above the barrier), not every semantic
+# hole; the fault matrix covers those.
 # ---------------------------------------------------------------------------
 
 def _block_of(lines, name):
@@ -223,19 +125,16 @@ def main():
     offenders = []
     for path in sorted(PKG.rglob("*.py")):
         lines = path.read_text().splitlines(keepends=True)
-        offenders.extend(_socket_offenders(path, lines))
-        offenders.extend(_sync_wait_offenders(path, lines))
         offenders.extend(_thread_offenders(path, lines))
-        if path.name in SWALLOW_FILES:
-            offenders.extend(_swallow_offenders(path, lines))
     offenders.extend(_repl_contract_offenders())
     if offenders:
-        print("robustness check FAILED — %d new offender(s):"
+        print("robustness check FAILED — %d offender(s):"
               % len(offenders))
         for rel, lineno, text, why in offenders:
             print("  %s:%d: %s\n      %s" % (rel, lineno, why, text))
-        print("either give the call a timeout / a narrow except, or "
-              "pin it in ci/check_robustness.py ALLOW with a reason.")
+        print("make the thread a daemon / restore the ack barrier; "
+              "call-site rules (sockets, waits, swallows) now live in "
+              "ci/check_static.py — see docs/static_analysis.md.")
         return 1
     print("robustness check OK")
     return 0

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Static-analysis gate for the sanity tier: run every mxlint pass over
+``mxtpu/`` and ``tools/`` and fail on any finding that is neither
+pragma'd in the source nor recorded in the committed baseline
+(``ci/mxlint_baseline.json`` — empty today: the whole tree lints
+clean, so every new offender is a regression).
+
+This replaces the line-regex rules 1-3 of the old
+``ci/check_robustness.py`` (unbounded socket waits, blind exception
+swallows, untimed ``wait()/get()/join()``) with AST-accurate passes,
+and adds the three analyses a regex can never do: lock-order cycles,
+host syncs inside jitted code, and use-after-donate. The remaining
+structural contracts (daemon threads, replication ack-before-
+durability) stay in ``ci/check_robustness.py``.
+
+The machine-readable findings artifact lands in
+``mxlint_findings.json`` at the repo root (CI uploads it; git ignores
+it). Local pre-commit: ``python tools/mxlint.py --diff`` lints only
+the files changed vs main.
+
+Run: ``python ci/check_static.py`` (wired into ``ci/run_ci.sh
+sanity``). Docs: ``docs/static_analysis.md``.
+"""
+from __future__ import annotations
+
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+from mxlint.cli import main as mxlint_main  # noqa: E402
+
+BASELINE = ROOT / "ci" / "mxlint_baseline.json"
+ARTIFACT = ROOT / "mxlint_findings.json"
+
+
+def main():
+    rc = mxlint_main(["mxtpu", "tools",
+                      "--baseline", str(BASELINE),
+                      "--json", str(ARTIFACT)])
+    if rc == 0:
+        print("static analysis OK (artifact: %s)"
+              % ARTIFACT.relative_to(ROOT))
+    else:
+        print("static analysis FAILED — fix the finding, bless it with "
+              "an inline `# mxlint: allow(<pass>) — <reason>` pragma, "
+              "or (pre-existing debt only) regenerate "
+              "ci/mxlint_baseline.json. See docs/static_analysis.md.")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,7 @@ tier="${1:-fast}"
 case "$tier" in
   sanity)
     python -m compileall -q mxtpu tools tests example
+    python ci/check_static.py
     python ci/check_robustness.py
     make -C mxtpu/_native
     ;;

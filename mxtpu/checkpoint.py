@@ -210,7 +210,9 @@ class CheckpointManager:
         if self._orbax_mgr is not None:
             self._orbax_mgr.wait_until_finished()
         elif self._pending is not None:
-            self._pending.join()
+            # wait_until_finished's CONTRACT is to block until the
+            # (daemon) writer drained; the write is bounded by disk IO
+            self._pending.join()   # mxlint: allow(blocking-call) — wait_until_finished contract
             self._pending = None
             if self._pending_error is not None:
                 err, self._pending_error = self._pending_error, None

@@ -86,7 +86,10 @@ class DataLoader:
 
         def worker():
             while True:
-                item = task_q.get()
+                # daemon worker parked between tasks; the consumer's
+                # finally-block always delivers one None sentinel per
+                # worker, so this park cannot outlive the iteration
+                item = task_q.get()   # mxlint: allow(blocking-call) — sentinel-terminated daemon queue
                 if item is None:
                     return
                 i, indices = item
@@ -109,7 +112,14 @@ class DataLoader:
             for i in range(len(batches)):
                 with cond:
                     while i not in out_q:
-                        cond.wait()
+                        # tick + liveness: a fleet of workers that died
+                        # hard (interpreter teardown, kill) must raise,
+                        # not park the consumer forever
+                        if not cond.wait(timeout=1.0) and \
+                                not any(t.is_alive() for t in threads):
+                            raise RuntimeError(
+                                "all DataLoader workers died before "
+                                "delivering batch %d" % i)
                     batch, err = out_q.pop(i)
                 if err is not None:
                     raise err

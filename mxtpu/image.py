@@ -10,6 +10,7 @@ device compute.
 from __future__ import annotations
 
 import io as _io
+import logging
 import os
 import random as _random
 
@@ -17,6 +18,14 @@ import numpy as _np
 
 from . import ndarray as nd
 from .ndarray import NDArray
+
+_log = logging.getLogger(__name__)
+
+# hard deadline on one decode batch from the process pool: a pool whose
+# workers were all killed (OOM reaper) would otherwise park next()
+# forever; ten minutes is far beyond any real decode+augment batch
+_POOL_BATCH_TIMEOUT = float(os.environ.get(
+    "MXTPU_IMAGE_POOL_TIMEOUT", "600"))
 
 __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "random_size_crop", "color_normalize",
@@ -680,7 +689,14 @@ class _FastRecordIter:
             raise StopIteration
         res, pad = self._pending.popleft()
         self._submit()      # keep the pool at full depth while we wait
-        out = res.get()
+        import multiprocessing
+        try:
+            out = res.get(_POOL_BATCH_TIMEOUT)
+        except multiprocessing.TimeoutError:
+            raise RuntimeError(
+                "image decode pool delivered nothing for %.0fs "
+                "(workers killed? MXTPU_IMAGE_POOL_TIMEOUT raises the "
+                "deadline)" % _POOL_BATCH_TIMEOUT) from None
         # batched normalize + HWC->CHW here, vectorized over the batch
         arrs = _np.stack([a for a, _l in out]).astype(_np.float32)
         if self._mean is not None:
@@ -703,8 +719,10 @@ class _FastRecordIter:
     def __del__(self):
         try:
             self._pool.terminate()
-        except Exception:
-            pass
+        except Exception as e:
+            # interpreter-teardown races are expected; anything else in
+            # the log beats silence
+            _log.debug("image pool teardown failed: %s", e)
 
 
 class ImageRecordIterImpl:

@@ -16,6 +16,7 @@ the XLA-world analogue of MXNet's OMP decode + engine prefetch pipeline.
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import struct
 import threading
@@ -219,9 +220,27 @@ class ResizeIter(_CurrentBatchIter):
         return True
 
 
-def _wait_all(events):
-    for e in events:
-        e.wait()
+_log = logging.getLogger(__name__)
+
+# prefetch liveness tick: every park on a double-buffer event re-checks
+# the peer (worker: shutdown flag, consumer: worker thread liveness) at
+# this period instead of blocking forever on a peer that died hard
+_PREFETCH_TICK = 1.0
+
+
+def _wait_all(events, threads=None):
+    """Wait for every event; with ``threads`` given, a worker that died
+    without delivering (thread gone, event never set) raises instead of
+    parking the consumer forever. Workers that merely run slow keep the
+    consumer waiting — only death breaks the wait."""
+    for i, e in enumerate(events):
+        while not e.wait(timeout=_PREFETCH_TICK):
+            t = threads[i] if threads is not None and i < len(threads) \
+                else None
+            if t is not None and not t.is_alive():
+                raise RuntimeError(
+                    "prefetch worker %d died without delivering its "
+                    "batch" % i)
 
 
 def _clear_all(events):
@@ -270,7 +289,11 @@ class PrefetchingIter(_CurrentBatchIter):
         """Pull batch i+1 while the consumer holds batch i (double
         buffering over data_taken/data_ready event pairs)."""
         while True:
-            self.data_taken[i].wait()
+            # tick instead of parking forever: shutdown must not depend
+            # on __del__ winning the race to set the event
+            while not self.data_taken[i].wait(timeout=_PREFETCH_TICK):
+                if not self.started:
+                    return
             if not self.started:
                 return
             try:
@@ -298,8 +321,10 @@ class PrefetchingIter(_CurrentBatchIter):
             _set_all(self.data_taken)
             for thread in self.prefetch_threads:
                 thread.join(timeout=1.0)
-        except Exception:
-            pass
+        except Exception as e:
+            # teardown-order races during interpreter exit are expected
+            # here, but never worth hiding entirely
+            _log.debug("PrefetchingIter teardown failed: %s", e)
 
     def _renamed_descs(self, renames, attr):
         sources = [getattr(i, attr) for i in self.iters]
@@ -318,7 +343,7 @@ class PrefetchingIter(_CurrentBatchIter):
         return self._renamed_descs(self.rename_label, "provide_label")
 
     def reset(self):
-        _wait_all(self.data_ready)   # workers quiesced before resetting
+        _wait_all(self.data_ready, self.prefetch_threads)   # workers quiesced before resetting
         for i in self.iters:
             i.reset()
         self._delivered = 0
@@ -344,7 +369,7 @@ class PrefetchingIter(_CurrentBatchIter):
         fast-forward otherwise — and restart prefetching from there.
         The worker threads survive the restore; only their fetch
         position moves."""
-        _wait_all(self.data_ready)   # park workers; their stale batch
+        _wait_all(self.data_ready, self.prefetch_threads)   # park workers; their stale batch
         #                              (prefetched pre-restore) is dropped
         inner = state.get("iters")
         delivered = int(state.get("delivered", 0))
@@ -368,7 +393,7 @@ class PrefetchingIter(_CurrentBatchIter):
         #                              position
 
     def iter_next(self):
-        _wait_all(self.data_ready)
+        _wait_all(self.data_ready, self.prefetch_threads)
         errors = [e for e in self._errors if e is not None]
         if errors:
             self._errors = [None] * self.n_iter

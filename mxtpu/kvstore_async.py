@@ -396,7 +396,11 @@ def _recv_exact(sock, n):
     got = 0
     while got < n:
         try:
-            r = sock.recv_into(view[got:], n - got)
+            # the ONE audited raw read: server-side it idles unbounded
+            # BY DESIGN (workers hold connections open between steps);
+            # worker-side every caller runs settimeout() first
+            # (_request_once / the receiver thread's poll tick)
+            r = sock.recv_into(view[got:], n - got)  # mxlint: allow(blocking-call) — audited frame-read loop
         except socket.timeout:
             if got:
                 # mid-frame stall: the stream position is lost and the
@@ -1616,7 +1620,9 @@ def serve_forever():
         " [%s of pair with %s]" % (srv._role, srv._peer_addr)
     print("mxtpu parameter server listening on %s%s%s"
           % (srv.address, paired, resumed), flush=True)
-    srv._thread.join()
+    # the server role process blocks here until 'stop' BY DESIGN —
+    # this is its entire lifecycle, there is nothing to time out to
+    srv._thread.join()   # mxlint: allow(blocking-call) — serve_forever entry point
 
 
 # sockets per server per worker: the server handles each connection on

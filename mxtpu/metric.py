@@ -148,7 +148,7 @@ class EvalMetric:
         return (self.name, value)
 
     def get_name_value(self):
-        name, value = self.get()
+        name, value = self.get()   # mxlint: allow(blocking-call) — EvalMetric.get() is a value getter, not a wait
         return list(zip(_listed(name), _listed(value)))
 
 
@@ -233,7 +233,7 @@ class CompositeEvalMetric(EvalMetric):
     def get(self):
         names, values = [], []
         for child in self.metrics:
-            name, value = child.get()
+            name, value = child.get()   # mxlint: allow(blocking-call) — EvalMetric.get() is a value getter, not a wait
             names += _listed(name)
             values += [value] if isinstance(
                 value, (float, int, numpy.generic)) else list(value)

@@ -1,0 +1,50 @@
+"""mxlint fixture: blocking-call pass — positives marked EXPECT(...),
+everything unmarked must NOT be flagged. Never executed, only parsed."""
+import socket
+import queue
+import threading
+
+q = queue.Queue()
+ev = threading.Event()
+t = threading.Thread(target=print, daemon=True)
+
+
+def positives(sock, pool_result):
+    # wrapped over several lines with NO timeout anywhere — the old
+    # 3-line window version of this rule anchored on text, this one on
+    # the call node
+    c = socket.create_connection(  # EXPECT(blocking-call)
+        ("server.example",
+         9999),
+    )
+    # the word timeout in a nearby comment fooled the regex's window;
+    # the AST is not fooled (no timeout= in the CALL):
+    c2 = socket.create_connection(("h", 1))  # EXPECT(blocking-call)
+    # ...the retry layer owns the timeout elsewhere (not here!)
+    c.settimeout(None)  # EXPECT(blocking-call)
+    data = sock.recv(4096)  # EXPECT(blocking-call)
+    n = sock.recv_into(bytearray(16))  # EXPECT(blocking-call)
+    ev.wait()  # EXPECT(blocking-call)
+    t.join()  # EXPECT(blocking-call)
+    item = q.get()  # EXPECT(blocking-call)
+    out = pool_result.get()  # EXPECT(blocking-call)
+    return c, c2, data, n, item, out
+
+
+def negatives(sock, d):
+    # timeout present even though the call wraps over FOUR lines —
+    # beyond the old checker's window, trivial for the AST
+    c = socket.create_connection(
+        ("server.example",
+         9999),
+        timeout=5.0,
+    )
+    c3 = socket.create_connection(("h", 1), 5.0)   # positional timeout
+    ev.wait(timeout=1.0)
+    ev.wait(2.0)
+    t.join(timeout=0.5)
+    item = q.get(timeout=1.0)
+    value = d.get("key")           # dict-style getter: has an argument
+    other = d.get("key", None)
+    allowed = q.get()   # mxlint: allow(blocking-call) — fixture: sentinel-terminated daemon queue
+    return c, c3, item, value, other, allowed

@@ -1,0 +1,26 @@
+"""mxlint fixture: except-swallow pass on a CRITICAL path (this file's
+relpath ends in mxtpu/engine.py, so the pass applies fleet-path
+scoping): broad typed swallows are findings too."""
+
+
+def critical(conn):
+    try:
+        conn.flush()
+    except Exception:  # EXPECT(except-swallow)
+        pass
+    try:
+        conn.flush()
+    except:  # EXPECT(except-swallow)
+        pass
+    try:
+        conn.flush()
+    except (ValueError, Exception):  # EXPECT(except-swallow)
+        pass
+    try:
+        conn.flush()
+    except OSError:     # narrow stays allowed even here
+        pass
+    try:
+        conn.flush()
+    except Exception:   # mxlint: allow(except-swallow) — fixture: reviewed teardown race
+        pass

@@ -1,0 +1,54 @@
+"""mxlint fixture: trace-purity pass — host syncs and impure writes
+inside jitted code, including a root found through ``jax.jit(f)`` and a
+helper reached transitively. Unmarked code must stay clean."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_step(x, carrier):
+    y = float(x)  # EXPECT(trace-purity)
+    carrier.count = carrier.count + 1  # EXPECT(trace-purity)
+    z = x.asnumpy()  # EXPECT(trace-purity)
+    w = np.asarray(x)  # EXPECT(trace-purity)
+    print("tracing", x.shape)  # EXPECT(trace-purity)
+    return y, z, w
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def partial_decorated(x):
+    return x.item()  # EXPECT(trace-purity)
+
+
+def _helper(x):
+    # reached transitively from train_step: still traced
+    return jnp.asarray(x.tolist())  # EXPECT(trace-purity)
+
+
+def train_step(params, batch):
+    loss = jnp.sum(params * batch)
+    return _helper(loss)
+
+
+jitted = jax.jit(train_step, donate_argnums=(0,))
+
+
+def host_side(x, metric):
+    """NOT traced: the same calls are fine here."""
+    v = float(x)
+    arr = np.asarray(x)
+    metric.count += 1
+    print("host", v)
+    return arr
+
+
+@jax.jit
+def clean_step(params, grads, lr):
+    """Traced and pure: jnp math, local writes only — no findings."""
+    new = [p - lr * g for p, g in zip(params, grads)]
+    total = jnp.stack([jnp.sum(p) for p in new])
+    blessed = float(lr)  # mxlint: allow(trace-purity) — fixture: lr is a trace-time python scalar here
+    return new, total, blessed

@@ -1,0 +1,66 @@
+"""mxlint fixture: lock-order pass — a seeded AB/BA deadlock (one arm
+direct nesting, the other through a method call), a nested factory
+acquisition, and a clean consistently-ordered class."""
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._table_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def update(self):
+        with self._table_lock:
+            with self._stats_lock:  # EXPECT(lock-order)
+                pass
+
+    def report(self):
+        with self._stats_lock:
+            self._touch_table()  # EXPECT(lock-order)
+
+    def _touch_table(self):
+        with self._table_lock:
+            pass
+
+
+class NestedFactory:
+    def __init__(self):
+        self._locks = {}
+        self._guard = threading.Lock()
+
+    def _lock_for(self, key):
+        with self._guard:
+            return self._locks.setdefault(key, threading.Lock())
+
+    def transfer(self, src, dst):
+        with self._lock_for(src):
+            with self._lock_for(dst):  # EXPECT(lock-order)
+                pass
+
+
+class Ordered:
+    """Consistent order everywhere: no finding."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._a_lock:
+            self._under_b()
+
+    def _under_b(self):
+        with self._b_lock:
+            pass
+
+    def three(self):
+        # sequential, not nested: no edge at all
+        with self._b_lock:
+            pass
+        with self._a_lock:
+            pass

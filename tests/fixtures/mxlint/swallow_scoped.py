@@ -1,0 +1,26 @@
+"""mxlint fixture: except-swallow pass in a NON-critical module — only
+the bare / BaseException swallows are findings here; a broad-but-typed
+``except Exception: pass`` is left to the baseline tier."""
+
+
+def noncritical(path):
+    try:
+        open(path).close()
+    except:  # EXPECT(except-swallow)
+        pass
+    try:
+        open(path).close()
+    except BaseException:  # EXPECT(except-swallow)
+        pass
+    try:
+        open(path).close()
+    except Exception:       # broad but typed: not flagged off the hot paths
+        pass
+    try:
+        open(path).close()
+    except OSError:         # narrow + pass is a normal idiom
+        pass
+    try:
+        open(path).close()
+    except Exception as e:  # body does something: never flagged
+        print(e)

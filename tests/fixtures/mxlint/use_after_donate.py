@@ -1,0 +1,46 @@
+"""mxlint fixture: use-after-donate pass — reads of donated buffers
+after the donating call (the PR-5 ``_data``-rebind contract), both for
+a local ``jax.jit(..., donate_argnums=...)`` program and for the fused
+train step factory. Unmarked reads must stay clean."""
+import jax
+
+
+def step(params, batch, state):
+    return params, state
+
+
+def plain_use_after_donate(params, batch, state):
+    f = jax.jit(step, donate_argnums=(0, 2))
+    new_params, new_state = f(params, batch, state)
+    stale = params.sum()  # EXPECT(use-after-donate)
+    also_stale = state  # EXPECT(use-after-donate)
+    fine = batch.sum()            # position 1 is not donated
+    return stale, also_stale, fine, new_params, new_state
+
+
+def rebind_is_clean(params, batch, state):
+    f = jax.jit(step, donate_argnums=(0, 2))
+    # the rebind idiom: the donated NAME is re-bound by the very call,
+    # so later reads see the fresh buffer
+    params, state = f(params, batch, state)
+    return params.sum() + state.sum() + batch.sum()
+
+
+def spec_via_variable(params, batch, state, donate_on):
+    donate = (0, 2) if donate_on else ()
+    f = jax.jit(step, donate_argnums=donate)
+    out = f(params, batch, state)
+    return params  # EXPECT(use-after-donate)
+
+
+def fused_factory_contract(exec_, fs, tv, st, av, ov, key, t, lr):
+    entry = exec_.make_fused_train_step(["w"], fs.optimizer, [0])
+    fn, other_names = entry
+    res = fn(tv, st, av, ov, key, t, lr, fs.metric_acc)
+    stale_params = tv  # EXPECT(use-after-donate)
+    stale_acc = fs.metric_acc  # EXPECT(use-after-donate)
+    ok_batch = ov                 # position 3 rides non-donated
+    ok_lr = lr                    # position 6 is a carried constant
+    fs.metric_acc = res[-1]       # the rebind...
+    revived = fs.metric_acc       # ...revives the path
+    return stale_params, stale_acc, ok_batch, ok_lr, revived

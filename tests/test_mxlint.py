@@ -1,0 +1,246 @@
+"""mxlint analyzer tests: the fixture corpus (known positives marked
+``# EXPECT(pass-id)``, everything unmarked must stay clean), pragma
+scoping, baseline round-trip, the --diff file filter, and the live-tree
+no-new-findings-vs-baseline gate that mirrors ``ci/check_static.py``.
+"""
+import json
+import pathlib
+import re
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+from mxlint.core import (Finding, all_passes, diff_against_baseline,  # noqa: E402
+                         load_baseline, run_paths, save_baseline)
+from mxlint.cli import changed_files, main as cli_main  # noqa: E402
+
+FIXTURES = ROOT / "tests" / "fixtures" / "mxlint"
+_EXPECT = re.compile(r"#\s*EXPECT\((?P<id>[a-z-]+)\)")
+
+
+def _expected(path):
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), 1):
+        m = _EXPECT.search(line)
+        if m:
+            out.add((i, m.group("id")))
+    return out
+
+
+def _found(path):
+    return {(f.line, f.pass_id)
+            for f in run_paths([path], root=ROOT)}
+
+
+FIXTURE_FILES = sorted(FIXTURES.rglob("*.py"))
+
+
+def test_fixture_corpus_exists():
+    # one fixture per pass at minimum, each with >=1 positive
+    ids = set()
+    for f in FIXTURE_FILES:
+        ids.update(pid for _, pid in _expected(f))
+    assert ids == set(all_passes()), \
+        "every pass needs a fixture positive; have %s" % sorted(ids)
+
+
+@pytest.mark.parametrize("fixture", FIXTURE_FILES,
+                         ids=[str(f.relative_to(FIXTURES))
+                              for f in FIXTURE_FILES])
+def test_fixture(fixture):
+    """Exact agreement: every EXPECT line is found by exactly that
+    pass, and nothing unmarked is flagged (the known-negatives)."""
+    assert _found(fixture) == _expected(fixture)
+
+
+def test_wrapped_call_beyond_regex_window():
+    """The motivating case: a create_connection wrapped over four lines
+    with its timeout on the last line was a false positive for the old
+    3-line window, and a timeout-free call with the word 'timeout' in a
+    nearby comment was a false negative. The AST pass gets both right
+    (encoded in blocking_calls.py: the 4-line call is unmarked, the
+    comment-fooled call is an EXPECT)."""
+    src = (FIXTURES / "blocking_calls.py").read_text()
+    assert "timeout=5.0,\n    )" in src            # the wrapped negative
+    found = _found(FIXTURES / "blocking_calls.py")
+    neg_line = next(i for i, ln in enumerate(src.splitlines(), 1)
+                    if "server.example" in ln and "EXPECT" not in
+                    src.splitlines()[i - 2])
+    assert all(line != neg_line for line, _ in found)
+
+
+# ---------------------------------------------------------------------------
+# seeded-hazard acceptance cases (ISSUE 6): each archetypal bug is
+# caught by its pass
+# ---------------------------------------------------------------------------
+
+def test_seeded_lock_inversion_is_caught():
+    found = _found(FIXTURES / "lock_inversion.py")
+    assert sum(1 for _, pid in found if pid == "lock-order") >= 2
+
+
+def test_seeded_host_sync_in_jit_is_caught():
+    found = _found(FIXTURES / "host_sync_in_jit.py")
+    assert sum(1 for _, pid in found if pid == "trace-purity") >= 5
+
+
+def test_seeded_use_after_donate_is_caught():
+    found = _found(FIXTURES / "use_after_donate.py")
+    assert sum(1 for _, pid in found if pid == "use-after-donate") >= 3
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+def test_pragma_function_scope(tmp_path):
+    """A pragma on the def line blesses the whole body; the sibling
+    function stays flagged."""
+    f = tmp_path / "m.py"
+    f.write_text(
+        "def blessed(ev):   # mxlint: allow(blocking-call) — whole-fn\n"
+        "    ev.wait()\n"
+        "    ev.wait()\n"
+        "def flagged(ev):\n"
+        "    ev.wait()\n")
+    found = run_paths([f], root=tmp_path)
+    assert [(x.line, x.pass_id) for x in found] == \
+        [(5, "blocking-call")]
+
+
+def test_pragma_comment_only_line_blesses_next_line(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(
+        "def g(ev):\n"
+        "    # mxlint: allow(blocking-call) — next-line form\n"
+        "    ev.wait()\n"
+        "    ev.wait()\n")
+    found = run_paths([f], root=tmp_path)
+    assert [(x.line, x.pass_id) for x in found] == \
+        [(4, "blocking-call")]
+
+
+def test_pragma_in_string_literal_is_not_a_pragma(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(
+        's = "# mxlint: allow(blocking-call)"\n'
+        "def g(ev):\n"
+        "    ev.wait()\n")
+    found = run_paths([f], root=tmp_path)
+    assert [(x.line, x.pass_id) for x in found] == \
+        [(3, "blocking-call")]
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    findings = run_paths([FIXTURES / "blocking_calls.py"], root=ROOT)
+    assert findings
+    bl = tmp_path / "baseline.json"
+    save_baseline(bl, findings)
+    again = run_paths([FIXTURES / "blocking_calls.py"], root=ROOT)
+    new, old, stale = diff_against_baseline(again, load_baseline(bl))
+    assert new == [] and len(old) == len(findings) and stale == []
+
+
+def test_baseline_is_line_number_free(tmp_path):
+    """Moving an offender down a file keeps its grandfathered slot;
+    editing its text does not."""
+    f = tmp_path / "m.py"
+    f.write_text("def g(ev):\n    ev.wait()\n")
+    bl = tmp_path / "baseline.json"
+    save_baseline(bl, run_paths([f], root=tmp_path))
+    # shift the same line down: still grandfathered
+    f.write_text("import os\n\n\ndef g(ev):\n    ev.wait()\n")
+    new, old, _ = diff_against_baseline(
+        run_paths([f], root=tmp_path), load_baseline(bl))
+    assert new == [] and len(old) == 1
+    # change the offending text: a NEW finding
+    f.write_text("def g(ev):\n    ev.wait()  # changed\n")
+    new, _, stale = diff_against_baseline(
+        run_paths([f], root=tmp_path), load_baseline(bl))
+    assert len(new) == 1 and len(stale) == 1
+
+
+def test_duplicate_offenders_get_distinct_fingerprints(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text("def g(ev):\n    ev.wait()\n    ev.wait()\n")
+    found = run_paths([f], root=tmp_path)
+    assert len(found) == 2
+    assert found[0].fingerprint != found[1].fingerprint
+
+
+# ---------------------------------------------------------------------------
+# the live-tree gate (mirrors ci/check_static.py)
+# ---------------------------------------------------------------------------
+
+def test_live_tree_no_new_findings_vs_baseline():
+    """The whole point: mxtpu/ + tools/ lint clean against the
+    committed baseline. A failure here IS a regression (or a new
+    deliberate case needing an inline pragma)."""
+    findings = run_paths([ROOT / "mxtpu", ROOT / "tools"], root=ROOT)
+    baseline = load_baseline(ROOT / "ci" / "mxlint_baseline.json")
+    new, _, _ = diff_against_baseline(findings, baseline)
+    assert new == [], "new mxlint findings:\n%s" % \
+        "\n".join("  %s:%d [%s] %s" % (f.path, f.line, f.pass_id,
+                                       f.message) for f in new)
+
+
+def test_check_static_script_passes():
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "ci" / "check_static.py")],
+        capture_output=True, text=True, timeout=300, cwd=str(ROOT))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    artifact = ROOT / "mxlint_findings.json"
+    assert artifact.exists()
+    doc = json.loads(artifact.read_text())
+    assert doc["counts"]["new"] == 0
+    assert set(doc["passes"]) >= set(all_passes())
+
+
+# ---------------------------------------------------------------------------
+# cli plumbing
+# ---------------------------------------------------------------------------
+
+def test_cli_json_artifact(tmp_path, capsys):
+    out = tmp_path / "f.json"
+    rc = cli_main([str(FIXTURES / "swallow_scoped.py"), "--json",
+                   str(out), "--no-baseline", "-q"])
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    assert doc["counts"]["new"] == 2
+    assert all(f["pass"] == "except-swallow" for f in doc["findings"])
+
+
+def test_cli_pass_subset():
+    findings = run_paths([FIXTURES / "host_sync_in_jit.py"], root=ROOT,
+                         pass_names=["except-swallow"])
+    assert findings == []
+
+
+def test_diff_mode_file_filter():
+    """--diff collects changed python files under the linted roots
+    (smoke: must run git and return a list of existing files)."""
+    files = changed_files(ROOT, base="HEAD")
+    assert isinstance(files, list)
+    for f in files:
+        assert f.exists() and f.suffix == ".py"
+        rel = f.relative_to(ROOT)
+        assert rel.parts[0] in ("mxtpu", "tools")
+
+
+def test_finding_fingerprint_stability():
+    f1 = Finding("a.py", 3, 0, "blocking-call", "msg", text="x.wait()",
+                 func="g")
+    f2 = Finding("a.py", 9, 4, "blocking-call", "msg", text="x.wait()",
+                 func="g")
+    from mxlint.core import assign_fingerprints
+    assign_fingerprints([f1])
+    assign_fingerprints([f2])
+    assert f1.fingerprint == f2.fingerprint   # line-independent

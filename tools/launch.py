@@ -28,6 +28,34 @@ import tempfile
 import time
 
 
+def _reap(procs, grace=5.0):
+    """Terminate-and-reap with escalation: SIGTERM every live child,
+    give the fleet ``grace`` seconds to exit, SIGKILL stragglers, then
+    collect every corpse — bounded at each stage, so the launcher can
+    never hang on (or zombie-leak) a child that ignores TERM."""
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        try:
+            p.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.time() + grace
+    for p in live:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    for p in live:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:   # unkillable (D-state): log
+            print("warning: pid %d did not die after SIGKILL" % p.pid,
+                  file=sys.stderr)
+
+
 def _free_port(preferred):
     """preferred if bindable, else an OS-assigned free port — a silent
     EADDRINUSE in a server child would surface only as late
@@ -199,13 +227,13 @@ def launch_local(args, command):
         for p in procs:
             code = code or p.returncode
     except KeyboardInterrupt:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
+        _reap(procs)
         code = 1
     finally:
-        for p in server_procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
+        # servers ignore nothing a worker still needs by now: reap with
+        # TERM->KILL escalation so a hung server cannot zombie-leak or
+        # wedge the launcher's exit
+        _reap(server_procs)
     return code
 
 
@@ -226,9 +254,15 @@ def launch_ssh(args, command):
         if not args.dry_run:
             procs.append(subprocess.Popen(remote, shell=True))
     code = 0
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
+    try:
+        for p in procs:
+            # remote jobs run arbitrarily long; ^C is the operator's
+            # abort and is handled below with a bounded reap
+            p.wait()   # mxlint: allow(blocking-call) — foreground wait on remote jobs; ^C aborts
+            code = code or p.returncode
+    except KeyboardInterrupt:
+        _reap(procs)
+        code = 1
     return code
 
 

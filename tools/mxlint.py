@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""mxlint entry point — AST-based static analysis for the mxtpu
+concurrency, host-sync and donation contracts.
+
+Thin launcher for the ``tools/mxlint/`` package so the canonical
+invocation works from the repo root::
+
+    python tools/mxlint.py mxtpu tools
+    python tools/mxlint.py --diff          # only files changed vs main
+    python tools/mxlint.py --list-passes
+
+See ``docs/static_analysis.md`` for the pass catalog, pragma syntax and
+baseline workflow; ``ci/check_static.py`` is the CI wrapper.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mxlint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

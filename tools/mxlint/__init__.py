@@ -1,0 +1,46 @@
+"""mxlint — AST-based static analysis for the mxtpu concurrency,
+host-sync and donation contracts.
+
+``ci/check_robustness.py`` policed the dist/engine hot paths with line
+regexes over a 3-line window plus a hand-pinned ALLOW list. That stops
+working exactly where the code got dangerous: wrapped calls slip the
+window, lock *nesting* is invisible to any line matcher, and the fused
+train step's donation contract ("after the donating call, the old
+buffers are dead until ``_data`` is rebound") is a dataflow property, not
+a string. mxlint replaces the regex rules with real AST passes:
+
+* ``blocking-call`` — unbounded ``recv``/``recv_into``/``wait``/``get``/
+  ``join``/``create_connection``/``settimeout(None)`` detected on the
+  call node, so wrapping and aliasing don't hide them.
+* ``lock-order`` — per-function lock-acquisition graph (``with
+  self._lock:`` nesting, ``acquire``/``release`` pairs, one-level-deep
+  call summaries), reporting cycles and inconsistent acquisition orders
+  as potential deadlocks.
+* ``trace-purity`` — host syncs (``asnumpy``/``.item()``/``float()``/
+  ``np.asarray``/``device_get``) and impure state writes inside
+  functions reachable from a ``jax.jit`` root or the fused-step
+  registration.
+* ``use-after-donate`` — reads of an array passed at a donated argument
+  position after the donating call, before it is rebound.
+* ``except-swallow`` — ``except [Exception]: pass`` handlers, scoped by
+  module criticality.
+
+Deliberate cases are blessed IN THE SOURCE with an inline pragma::
+
+    sock.recv_into(view)   # mxlint: allow(blocking-call) — reason here
+
+and pre-existing findings are grandfathered via a committed baseline
+(``ci/mxlint_baseline.json``): CI (``ci/check_static.py``) fails only on
+findings that are neither pragma'd nor baselined. See
+``docs/static_analysis.md`` for the pass catalog, the pragma grammar,
+the baseline workflow and how to add a pass.
+"""
+from __future__ import annotations
+
+from .core import (Finding, LintPass, ModuleInfo, all_passes, register,
+                   run_paths)
+
+__all__ = ["Finding", "LintPass", "ModuleInfo", "all_passes", "register",
+           "run_paths"]
+
+__version__ = "1.0"

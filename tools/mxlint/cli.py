@@ -1,0 +1,174 @@
+"""mxlint command line.
+
+Typical uses (from the repo root)::
+
+    python tools/mxlint.py mxtpu tools        # lint, fail on findings
+    python tools/mxlint.py mxtpu --baseline ci/mxlint_baseline.json
+    python tools/mxlint.py mxtpu tools --write-baseline
+    python tools/mxlint.py --diff             # only files changed vs main
+    python tools/mxlint.py mxtpu --json out.json --passes lock-order
+
+Exit status: 0 clean (or everything grandfathered/pragma'd), 1 findings
+outside the baseline, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import subprocess
+import sys
+
+from .core import (all_passes, diff_against_baseline, load_baseline,
+                   run_paths, save_baseline)
+
+DEFAULT_BASELINE = "ci/mxlint_baseline.json"
+DEFAULT_PATHS = ("mxtpu", "tools")
+
+
+def repo_root(start=None):
+    p = pathlib.Path(start or __file__).resolve()
+    for cand in [p] + list(p.parents):
+        if (cand / ".git").exists() or (cand / "ROADMAP.md").exists():
+            return cand
+    return pathlib.Path.cwd()
+
+
+def changed_files(root, base="main", paths=DEFAULT_PATHS):
+    """Python files under ``paths`` changed vs ``base`` (committed diff
+    + working tree), for the fast local ``--diff`` mode."""
+    names = set()
+    for cmd in (["git", "diff", "--name-only", base],
+                ["git", "diff", "--name-only"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, cwd=str(root), capture_output=True,
+                                 text=True, timeout=30, check=False)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        names.update(ln.strip() for ln in out.stdout.splitlines()
+                     if ln.strip())
+    files = []
+    for name in sorted(names):
+        if not name.endswith(".py"):
+            continue
+        if not any(name == p or name.startswith(p.rstrip("/") + "/")
+                   for p in paths):
+            continue
+        f = root / name
+        if f.exists():
+            files.append(f)
+    return files
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: %s)"
+                         % " ".join(DEFAULT_PATHS))
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="grandfather findings recorded in FILE "
+                         "(default: %s when it exists)"
+                         % DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: report everything")
+    ap.add_argument("--write-baseline", nargs="?", const=True,
+                    default=None, metavar="FILE",
+                    help="write the current findings as the new "
+                         "baseline (default file: %s)" % DEFAULT_BASELINE)
+    ap.add_argument("--json", default=None, metavar="FILE",
+                    help="write the findings artifact as JSON")
+    ap.add_argument("--diff", nargs="?", const="main", default=None,
+                    metavar="BASE",
+                    help="lint only files changed vs BASE (default "
+                         "main) — fast local pre-commit mode")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass subset (see "
+                         "--list-passes)")
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only the summary line")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for name, cls in sorted(all_passes().items()):
+            print("%-18s %s" % (name, cls.description))
+        return 0
+
+    root = repo_root()
+    pass_names = [p.strip() for p in args.passes.split(",")
+                  if p.strip()] if args.passes else None
+
+    files = None
+    paths = [root / p for p in (args.paths or DEFAULT_PATHS)]
+    if args.diff is not None:
+        files = changed_files(root, base=args.diff,
+                              paths=args.paths or DEFAULT_PATHS)
+        if not files:
+            print("mxlint: no changed python files vs %s" % args.diff)
+            return 0
+
+    findings = run_paths(paths, root=root, pass_names=pass_names,
+                         files=files)
+
+    if args.write_baseline is not None:
+        target = pathlib.Path(
+            args.write_baseline if args.write_baseline is not True
+            else root / DEFAULT_BASELINE)
+        save_baseline(target, findings)
+        print("mxlint: baseline with %d finding(s) written to %s"
+              % (len(findings), target))
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline and \
+            (root / DEFAULT_BASELINE).exists():
+        baseline_path = root / DEFAULT_BASELINE
+    if baseline_path is not None and not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+        new, old, stale = diff_against_baseline(findings, baseline)
+    else:
+        new, old, stale = findings, [], []
+
+    if args.json:
+        doc = {"version": 1,
+               "passes": sorted(pass_names or all_passes()),
+               "counts": {"new": len(new), "grandfathered": len(old),
+                          "stale_baseline": len(stale)},
+               "findings": [f.to_dict() for f in new],
+               "grandfathered": [f.to_dict() for f in old],
+               "stale_baseline": stale}
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    if not args.quiet:
+        for f in new:
+            print("%s:%d: [%s] %s" % (f.path, f.line, f.pass_id,
+                                      f.message))
+            if f.text:
+                print("    %s" % f.text)
+    if stale and not args.quiet:
+        print("mxlint: %d baseline entr%s no longer observed (fixed or "
+              "drifted) — regenerate with --write-baseline to prune"
+              % (len(stale), "y is" if len(stale) == 1 else "ies are"))
+    print("mxlint: %d new finding(s), %d grandfathered, %d file(s)"
+          % (len(new), len(old),
+             len(files) if files is not None else
+             sum(1 for _ in _count_files(paths))))
+    if new:
+        print("fix it, bless it with `# mxlint: allow(<pass>) — "
+              "<reason>`, or (for pre-existing debt only) regenerate "
+              "the baseline. docs/static_analysis.md has the workflow.")
+    return 1 if new else 0
+
+
+def _count_files(paths):
+    from .core import iter_py_files
+    return iter_py_files(paths)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

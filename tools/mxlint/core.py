@@ -1,0 +1,371 @@
+"""mxlint framework core: findings, the pass registry, per-module
+analysis context (AST + pragmas), baseline bookkeeping and the runner.
+
+Design notes
+------------
+* A **finding** anchors at the AST node's first line. Pragmas and the
+  baseline both key off that anchor, so a wrapped multi-line call is
+  suppressed at the line the call *starts* on — no 3-line windows.
+* **Pragmas** are parsed from real COMMENT tokens (``tokenize``), never
+  from string literals. A pragma on a ``def``/``class`` header line
+  covers the whole body; anywhere else it covers its own line, and a
+  comment-only line covers the next code line.
+* The **baseline** stores content fingerprints, not line numbers:
+  ``(path, pass, enclosing-qualname, stripped source line, occurrence
+  index)``. Moving a grandfathered offender around a file does not
+  un-grandfather it; editing or duplicating it does — which is the
+  point.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import pathlib
+import tokenize
+
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+class Finding:
+    """One diagnostic: where, which pass, what, and in which function."""
+
+    __slots__ = ("path", "line", "col", "pass_id", "message", "text",
+                 "func", "fingerprint")
+
+    def __init__(self, path, line, col, pass_id, message, text="",
+                 func="<module>"):
+        self.path = str(path)
+        self.line = int(line)
+        self.col = int(col)
+        self.pass_id = pass_id
+        self.message = message
+        self.text = text
+        self.func = func
+        self.fingerprint = None     # assigned by assign_fingerprints
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.pass_id,
+                self.message)
+
+    def to_dict(self):
+        return {"fingerprint": self.fingerprint, "path": self.path,
+                "line": self.line, "pass": self.pass_id,
+                "func": self.func, "text": self.text,
+                "message": self.message}
+
+    def __repr__(self):
+        return "%s:%d: [%s] %s" % (self.path, self.line, self.pass_id,
+                                   self.message)
+
+
+def assign_fingerprints(findings):
+    """Stable content fingerprints, line-number free. Identical
+    (path, pass, func, text) tuples are disambiguated by occurrence
+    index in source order, so two copies of the same offending line in
+    one function get two distinct baseline slots."""
+    seen = {}
+    for f in sorted(findings, key=Finding.sort_key):
+        ident = (f.path, f.pass_id, f.func, f.text)
+        n = seen.get(ident, 0)
+        seen[ident] = n + 1
+        blob = "%s::%s::%s::%s::%d" % (f.path, f.pass_id, f.func,
+                                       f.text, n)
+        f.fingerprint = hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pragma parsing:  # mxlint: allow(pass-id[, pass-id]) — reason
+# ---------------------------------------------------------------------------
+
+_PRAGMA_HEAD = "mxlint:"
+
+
+def _parse_pragma_comment(comment):
+    """``(allowed_ids, reason)`` from one comment string, or None when it
+    carries no mxlint pragma. Grammar::
+
+        # mxlint: allow(pass-id[, pass-id...])[ <sep> reason]
+
+    where ``<sep>`` is em-dash / hyphen / colon (all optional)."""
+    body = comment.lstrip("#").strip()
+    if not body.startswith(_PRAGMA_HEAD):
+        return None
+    body = body[len(_PRAGMA_HEAD):].strip()
+    if not body.startswith("allow(") or ")" not in body:
+        return None
+    inner, _, rest = body[len("allow("):].partition(")")
+    ids = frozenset(p.strip() for p in inner.split(",") if p.strip())
+    reason = rest.lstrip(" \t-—:–").strip()
+    return ids, reason
+
+
+class PragmaMap:
+    """Line -> allowed pass ids for one module, with def/class-header
+    pragmas expanded to the whole body and comment-only-line pragmas
+    attached to the next code line."""
+
+    def __init__(self, source, tree):
+        per_line = {}        # lineno -> (ids, line_is_comment_only)
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                parsed = _parse_pragma_comment(tok.string)
+                if parsed is None:
+                    continue
+                line_text = source.splitlines()[tok.start[0] - 1]
+                own = line_text.strip().startswith("#")
+                per_line[tok.start[0]] = (parsed[0], own)
+        except (tokenize.TokenError, IndentationError):
+            pass
+        self._line_allow = {}     # lineno -> set of pass ids
+        comment_only = []
+        for lineno, (ids, own) in per_line.items():
+            if own:
+                comment_only.append((lineno, ids))
+            else:
+                self._line_allow.setdefault(lineno, set()).update(ids)
+        # a comment-only pragma line blesses the next code line
+        nlines = source.count("\n") + 1
+        lines = source.splitlines()
+        for lineno, ids in comment_only:
+            nxt = lineno + 1
+            while nxt <= nlines and (nxt - 1 >= len(lines)
+                                     or not lines[nxt - 1].strip()
+                                     or lines[nxt - 1].strip()
+                                     .startswith("#")):
+                nxt += 1
+            self._line_allow.setdefault(nxt, set()).update(ids)
+        # def/class-header pragmas cover the whole body
+        self._ranges = []         # (start, end, ids)
+        if tree is not None:
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                    continue
+                header = node.lineno
+                ids = self._line_allow.get(header)
+                if ids:
+                    self._ranges.append(
+                        (header, node.end_lineno or header, ids))
+
+    def allows(self, line, pass_id):
+        ids = self._line_allow.get(line)
+        if ids and (pass_id in ids or "*" in ids):
+            return True
+        for start, end, rids in self._ranges:
+            if start <= line <= end and (pass_id in rids or "*" in rids):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# per-module analysis context
+# ---------------------------------------------------------------------------
+
+class ModuleInfo:
+    """Everything a pass needs about one file: source, lines, AST,
+    pragma map, repo-relative path, and small shared lookups."""
+
+    def __init__(self, path, root):
+        self.path = pathlib.Path(path)
+        self.relpath = str(self.path.relative_to(root)) \
+            if root in self.path.parents or self.path == root \
+            else str(self.path)
+        self.source = self.path.read_text(encoding="utf-8",
+                                          errors="replace")
+        self.lines = self.source.splitlines()
+        self.parse_error = None
+        try:
+            self.tree = ast.parse(self.source)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+        self.pragmas = PragmaMap(self.source, self.tree)
+        self._parents = None
+        self._qualnames = None
+
+    # -- shared lookups ----------------------------------------------------
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def numpy_aliases(self):
+        """Local names bound to the numpy module by imports."""
+        out = set()
+        if self.tree is None:
+            return out
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        out.add(a.asname or "numpy")
+        return out
+
+    def parent_map(self):
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def qualname(self, node):
+        """Dotted enclosing-scope name for a node (``Cls.meth`` /
+        ``outer.<locals>.inner`` flattened to ``outer.inner``)."""
+        if self.tree is None:
+            return "<module>"
+        if self._qualnames is None:
+            self._qualnames = {}
+            parents = self.parent_map()
+            for n in ast.walk(self.tree):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    parts, cur = [n.name], parents.get(n)
+                    while cur is not None:
+                        if isinstance(cur, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef,
+                                            ast.ClassDef)):
+                            parts.append(cur.name)
+                        cur = parents.get(cur)
+                    self._qualnames[n] = ".".join(reversed(parts))
+        parents = self.parent_map()
+        cur = node
+        while cur is not None:
+            if cur in self._qualnames:
+                return self._qualnames[cur]
+            cur = parents.get(cur)
+        return "<module>"
+
+    def finding(self, node, pass_id, message):
+        lineno = getattr(node, "lineno", 1)
+        return Finding(self.relpath, lineno,
+                       getattr(node, "col_offset", 0), pass_id, message,
+                       text=self.line_text(lineno),
+                       func=self.qualname(node))
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+
+class LintPass:
+    """Base class for a pass plugin. Subclasses set ``name`` /
+    ``description`` and implement ``run(module) -> [Finding]``; the
+    framework applies pragmas, baseline and output handling."""
+
+    name = None
+    description = ""
+
+    def run(self, module):
+        raise NotImplementedError
+
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Class decorator adding a pass to the registry (import a module
+    defining registered passes and they become runnable — that is the
+    whole plugin mechanism)."""
+    assert cls.name, "a LintPass needs a name"
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_passes():
+    # importing the package registers the built-in passes
+    from . import passes  # noqa: F401
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths):
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                yield f
+        elif p.suffix == ".py":
+            yield p
+
+
+def run_paths(paths, root=None, pass_names=None, files=None):
+    """Run the selected passes over every .py under ``paths`` (or the
+    explicit ``files`` list); returns pragma-filtered, fingerprinted,
+    sorted findings."""
+    root = pathlib.Path(root) if root is not None \
+        else pathlib.Path.cwd()
+    registry = all_passes()
+    if pass_names:
+        unknown = set(pass_names) - set(registry)
+        if unknown:
+            raise SystemExit("mxlint: unknown pass(es): %s (have: %s)"
+                             % (", ".join(sorted(unknown)),
+                                ", ".join(sorted(registry))))
+        registry = {k: v for k, v in registry.items() if k in pass_names}
+    instances = [cls() for _, cls in sorted(registry.items())]
+    findings = []
+    file_list = list(files) if files is not None \
+        else list(iter_py_files(paths))
+    for path in file_list:
+        module = ModuleInfo(path, root)
+        if module.parse_error is not None:
+            findings.append(Finding(
+                module.relpath, module.parse_error.lineno or 1, 0,
+                "parse", "syntax error: %s" % module.parse_error.msg))
+            continue
+        for p in instances:
+            for f in p.run(module):
+                if not module.pragmas.allows(f.line, f.pass_id):
+                    findings.append(f)
+    return assign_fingerprints(sorted(findings, key=Finding.sort_key))
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path):
+    path = pathlib.Path(path)
+    if not path.exists():
+        return {"version": 1, "findings": []}
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_baseline(path, findings):
+    doc = {"version": 1,
+           "comment": "mxlint grandfathered findings; regenerate with "
+                      "`python tools/mxlint.py <paths> --write-baseline`"
+                      " (see docs/static_analysis.md)",
+           "findings": [f.to_dict() for f in findings]}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def diff_against_baseline(findings, baseline):
+    """``(new, grandfathered, stale)``: findings not in the baseline,
+    findings matched by it, and baseline entries no longer observed
+    (fixed or drifted — candidates for pruning)."""
+    base = {e["fingerprint"]: e for e in baseline.get("findings", [])}
+    new = [f for f in findings if f.fingerprint not in base]
+    old = [f for f in findings if f.fingerprint in base]
+    seen = {f.fingerprint for f in findings}
+    stale = [e for e in base.values() if e["fingerprint"] not in seen]
+    return new, old, stale

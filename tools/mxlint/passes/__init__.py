@@ -1,0 +1,10 @@
+"""Built-in mxlint passes. Importing this package registers them; a new
+pass is one module defining a ``@register``-ed ``LintPass`` subclass
+plus an import line here (docs/static_analysis.md, "Adding a pass")."""
+from __future__ import annotations
+
+from . import blocking    # noqa: F401
+from . import donation    # noqa: F401
+from . import locks       # noqa: F401
+from . import swallow     # noqa: F401
+from . import tracepurity  # noqa: F401

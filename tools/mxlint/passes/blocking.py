@@ -1,0 +1,75 @@
+"""blocking-call pass: unbounded blocking calls detected on the call AST.
+
+Subsumes the socket and sync-wait regex rules of the old
+``ci/check_robustness.py`` (its 3-line window missed wrapped calls; the
+AST node anchors the finding at the call regardless of layout):
+
+* ``.recv(`` / ``.recv_into(`` — raw socket reads must go through an
+  audited deadline-carrying loop (``_recv_exact``), never appear inline.
+* ``settimeout(None)`` — turning a socket's deadline off.
+* ``create_connection(...)`` with no ``timeout`` (positional or
+  keyword) — connect can hang on a black-holed host forever.
+* ``.wait()`` / ``.join()`` / ``.get()`` with **no positional argument
+  and no ``timeout=``** — the bare forms of Event/Condition/Thread/
+  queue/future waits, exactly how a dead peer hangs a survivor.
+  ``dict.get(key)`` and friends carry a positional argument and are
+  never matched (the old regex needed an ALLOW pin for each of those).
+
+Deliberate block-forever points (a server role's ``join()``, the shared
+frame-read loop) carry ``# mxlint: allow(blocking-call) — reason``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, register
+
+_WAIT_NAMES = frozenset(("wait", "join", "get"))
+
+
+def _has_timeout(call):
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+@register
+class BlockingCallPass(LintPass):
+    name = "blocking-call"
+    description = ("unbounded recv/wait/get/join/create_connection/"
+                   "settimeout(None) calls")
+
+    def run(self, module):
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None)
+            if attr is None:
+                continue
+            if attr in ("recv", "recv_into") and \
+                    isinstance(func, ast.Attribute):
+                out.append(module.finding(
+                    node, self.name,
+                    "raw .%s() read — socket reads must go through the "
+                    "deadline-carrying frame loop" % attr))
+            elif attr == "settimeout" and len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value is None:
+                out.append(module.finding(
+                    node, self.name,
+                    "settimeout(None) disables the socket deadline"))
+            elif attr == "create_connection":
+                if len(node.args) < 2 and not _has_timeout(node):
+                    out.append(module.finding(
+                        node, self.name,
+                        "create_connection() without an explicit "
+                        "timeout can hang on connect forever"))
+            elif attr in _WAIT_NAMES and \
+                    isinstance(func, ast.Attribute):
+                if not node.args and not _has_timeout(node):
+                    out.append(module.finding(
+                        node, self.name,
+                        ".%s() with no timeout — a dead peer hangs "
+                        "this caller forever" % attr))
+        return out

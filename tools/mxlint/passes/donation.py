@@ -1,0 +1,276 @@
+"""use-after-donate pass: reads of a buffer after it was passed at a
+donated argument position.
+
+The fused train step's contract (PR 5, ``mxtpu/module/fused.py``): a
+call to a program jitted with ``donate_argnums`` invalidates the
+caller's input buffers at the donated positions — every wrapper must be
+rebound (``nd._data = new_value``) before anyone reads it again. A read
+of the *donated local* after the call is at best a stale value and at
+worst a runtime "array has been deleted" error that only fires on real
+hardware, where donation actually aliases.
+
+Detection (intra-function, linear over the statement list — branches
+are walked in source order, which over-approximates; a pragma blesses
+the reviewed counterexample):
+
+1. **Donating callables.** A local name is donating when it is bound
+   (possibly through one tuple-unpack) from
+
+   * ``jax.jit(f, ..., donate_argnums=SPEC)`` — SPEC read from the
+     literal tuple/int, or from a prior ``SPEC = (...)`` assignment
+     (the ``X if cond else ()`` pattern takes the donating arm:
+     conservative), or
+   * a factory listed in :data:`DONATING_FACTORIES` — e.g.
+     ``make_fused_train_step`` returns ``(fn, other_names)`` where
+     ``fn`` donates positions (0, 1, 2, 4, 5, 7); the spec lives here
+     so the linter knows the executor's contract without dataflow
+     across modules.
+
+2. **Kill set.** At a call ``fn(a0, a1, ...)`` of a donating name, the
+   arguments at donated positions that are plain names or dotted
+   attribute paths become *dead*.
+
+3. **Verdict.** A later load of a dead path in the same function is a
+   finding; a store to the exact path (the ``_data`` rebind pattern
+   rebinds the wrapper, and reassigning the local itself) revives it.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, register
+
+# factory bare name -> (index of the donating fn in the returned tuple
+#                        or None when returned directly, donated args)
+DONATING_FACTORIES = {
+    "make_fused_train_step": (0, (0, 1, 2, 4, 5, 7)),
+}
+
+
+def _dotted(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _donate_spec(call, const_env):
+    """The donate_argnums tuple of a jax.jit(...) call, or None."""
+    f = call.func
+    is_jit = (isinstance(f, ast.Attribute) and f.attr == "jit") or \
+        (isinstance(f, ast.Name) and f.id == "jit")
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        return _spec_value(kw.value, const_env)
+    return None
+
+
+def _spec_value(node, const_env):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    if isinstance(node, ast.IfExp):
+        # `(0, 1, 2) if self._donate else ()` — analyze the donating arm
+        for arm in (node.body, node.orelse):
+            spec = _spec_value(arm, const_env)
+            if spec:
+                return spec
+        return None
+    if isinstance(node, ast.Name):
+        return const_env.get(node.id)
+    return None
+
+
+def _flatten(body):
+    """Statements of a function in source order, recursing into every
+    compound block (linear over-approximation of control flow)."""
+    for stmt in body:
+        yield stmt
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub and not isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef)):
+                yield from _flatten(sub)
+        for h in getattr(stmt, "handlers", []) or []:
+            yield from _flatten(h.body)
+
+
+@register
+class DonationPass(LintPass):
+    name = "use-after-donate"
+    description = ("reads of an array after it was passed at a donated "
+                   "argument position")
+
+    def run(self, module):
+        out = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_function(module, node))
+        return out
+
+    def _check_function(self, module, fn):
+        stmts = [s for s in _flatten(fn.body)
+                 if not isinstance(s, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef))]
+        const_env = {}          # name -> literal int tuple
+        donating = {}           # local name -> donated positions
+        tainted = {}            # name tainted by a factory ->
+        #                         (tuple_index, spec)
+        dead = {}               # dotted path -> (call lineno, fn name)
+        findings = []
+
+        for stmt in stmts:
+            # 1. findings first: loads of dead paths in this statement
+            #    (before this statement's own stores revive anything)
+            if dead:
+                findings.extend(
+                    self._dead_loads(module, stmt, dead))
+            # 2. donating calls anywhere in the statement kill their
+            #    donated arguments (the call runs before the
+            #    statement's own stores, so `params, _ = fn(params)`
+            #    kills and then revives — the rebind idiom stays clean)
+            for call in self._calls_of(stmt):
+                spec = self._call_spec(call, donating, const_env)
+                if spec is None:
+                    continue
+                callee = _dotted(call.func) or "<fn>"
+                for pos in spec:
+                    if pos < len(call.args):
+                        path = _dotted(call.args[pos])
+                        if path:
+                            dead[path] = (call.lineno, callee)
+            # 3. track assignments; stores revive their exact paths
+            if isinstance(stmt, ast.Assign):
+                self._track_assign(stmt, const_env, donating, tainted)
+                for t in stmt.targets:
+                    self._revive(t, dead)
+            elif isinstance(stmt, ast.AugAssign):
+                self._revive(stmt.target, dead)
+        return findings
+
+    # -- bookkeeping -------------------------------------------------------
+    @staticmethod
+    def _calls_of(stmt):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+    def _track_assign(self, stmt, const_env, donating, tainted):
+        value = stmt.value
+        targets = stmt.targets
+        # literal int tuples feed donate_argnums resolution
+        if len(targets) == 1 and isinstance(targets[0], ast.Name):
+            spec = _spec_value(value, const_env) \
+                if not isinstance(value, ast.Call) else None
+            if spec is not None:
+                const_env[targets[0].id] = spec
+        if isinstance(value, ast.Call):
+            spec = _donate_spec(value, const_env)
+            fname = value.func.attr \
+                if isinstance(value.func, ast.Attribute) else (
+                    value.func.id if isinstance(value.func, ast.Name)
+                    else None)
+            if spec is not None:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        donating[t.id] = spec
+            elif fname in DONATING_FACTORIES:
+                idx, fspec = DONATING_FACTORIES[fname]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        if idx is None:
+                            donating[t.id] = fspec
+                        else:
+                            tainted[t.id] = (idx, fspec)
+                    elif isinstance(t, ast.Tuple) and idx is not None \
+                            and idx < len(t.elts) and \
+                            isinstance(t.elts[idx], ast.Name):
+                        donating[t.elts[idx].id] = fspec
+        elif isinstance(value, ast.Name) and value.id in tainted:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    tainted[t.id] = tainted[value.id]
+                elif isinstance(t, ast.Tuple):
+                    idx, fspec = tainted[value.id]
+                    if idx < len(t.elts) and \
+                            isinstance(t.elts[idx], ast.Name):
+                        donating[t.elts[idx].id] = fspec
+        elif isinstance(value, ast.Subscript) and \
+                isinstance(value.value, ast.Name) and \
+                value.value.id in tainted and \
+                isinstance(value.slice, ast.Constant):
+            idx, fspec = tainted[value.value.id]
+            if value.slice.value == idx:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        donating[t.id] = fspec
+
+    def _call_spec(self, call, donating, const_env):
+        name = _dotted(call.func)
+        if name in donating:
+            return donating[name]
+        # direct jax.jit(f, donate_argnums=...)(args) immediate call
+        if isinstance(call.func, ast.Call):
+            return _donate_spec(call.func, const_env)
+        return None
+
+    @staticmethod
+    def _revive(target, dead):
+        path = _dotted(target)
+        if path is None:
+            if isinstance(target, ast.Tuple):
+                for e in target.elts:
+                    DonationPass._revive(e, dead)
+            return
+        dead.pop(path, None)
+        # rebinding a wrapper's attribute revives the wrapper path too
+        # (nd._data = new  revives nd._data, not nd itself: reading the
+        # NDArray wrapper was always fine — only raw handles die)
+
+    def _dead_loads(self, module, stmt, dead):
+        out = []
+        # stores in this very statement must not count as loads
+        store_paths = set()
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                p = _dotted(t)
+                if p:
+                    store_paths.add(p)
+        for node in ast.walk(stmt):
+            path = _dotted(node) if isinstance(node,
+                                               (ast.Name,
+                                                ast.Attribute)) else None
+            if path is None or path not in dead or \
+                    path in store_paths:
+                continue
+            if isinstance(getattr(node, "ctx", None), ast.Store):
+                continue
+            # attribute chains walk their sub-chains too; report the
+            # exact dead path once per statement
+            lineno, callee = dead[path]
+            out.append(module.finding(
+                node, self.name,
+                "%r is read after being donated to %s() at line %d — "
+                "the buffer is invalidated; rebind before reading"
+                % (path, callee, lineno)))
+            store_paths.add(path)   # one finding per statement per path
+        return out

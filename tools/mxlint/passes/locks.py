@@ -1,0 +1,492 @@
+"""lock-order pass: build the per-function lock-acquisition graph and
+report cycles / inconsistent acquisition orders as potential deadlocks.
+
+What a regex can never see — ``with self._lock:`` *nesting* — is the
+whole pass:
+
+1. **Lock discovery.** An attribute is a lock when the module assigns it
+   from ``threading.Lock/RLock/Condition/Semaphore/BoundedSemaphore``
+   (``self._x = threading.Lock()``), or when its name matches the lock
+   naming convention (``*lock*``, ``*guard*``, ``*_cv``, ``*mutex*``,
+   ``*cond*``). A call to a method whose name matches ``*lock_for*`` /
+   ``*get_lock*`` is a lock factory — its result counts as one logical
+   lock token (all per-key locks collapse to one token, which is sound
+   for ordering: two threads taking two *different* key locks in
+   opposite orders cannot deadlock, but the collapsed token still
+   catches key-lock-vs-other-lock inversions, and a *nested* key lock
+   shows up as a self-cycle worth a look).
+
+2. **Token identity.** ``self._x`` is scoped to the enclosing class.
+   ``other._x`` resolves to the single class declaring ``_x`` as a lock
+   when that is unambiguous, else to a shared ``?._x`` token (collapsing
+   distinct locks can only over-report, never hide an inversion).
+
+3. **Held-set tracking.** ``with tok:`` holds through the body (multiple
+   items nest left to right); ``tok.acquire(...)`` holds until a
+   matching ``tok.release()`` later in the same statement list or the
+   end of the function. While H is held, acquiring t adds edges
+   ``h -> t`` for every h in H.
+
+4. **Call summaries.** While holding H, calling a function/method
+   resolvable inside the analyzed file set adds ``h -> t`` for every
+   lock t that callee may (transitively) acquire — so ``with
+   self._lock_for(key): self._note_worker_push(...)`` contributes the
+   ``key-lock -> workers-lock`` edge even though the nested acquisition
+   is two calls deep. Methods resolve by name within the defining class
+   first, then uniquely across the file set.
+
+5. **Verdict.** Strongly-connected components of the edge graph with
+   more than one token are inconsistent acquisition orders (the classic
+   AB/BA inversion is the 2-cycle); a self-edge is a nested acquisition
+   of one non-reentrant token. Each cycle is one finding per
+   participating edge site, so individual sites can be pragma'd or
+   baselined.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import LintPass, register
+
+_LOCK_CTORS = frozenset(("Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"))
+_NAME_PAT = re.compile(r"lock|guard|mutex|cond|(^|_)cv$", re.IGNORECASE)
+_FACTORY_PAT = re.compile(r"lock_for|get_lock", re.IGNORECASE)
+
+
+def _attr_chain_root(node):
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node
+
+
+class _FuncInfo:
+    def __init__(self, node, qualname, cls):
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls            # enclosing class name or None
+        self.direct = set()       # lock tokens acquired directly
+        self.calls = set()        # (recv_kind, name): recv_kind in
+        #                           ("self", "other", "plain")
+        self.reach = None         # transitive token set
+
+
+class LockGraph:
+    """Per-module-set lock graph builder (kept separate from the pass so
+    the fixture harness and tests can drive it directly)."""
+
+    def __init__(self):
+        self.lock_attrs = {}      # attr -> set of declaring classes
+        self.funcs = {}           # qualname -> _FuncInfo
+        self.by_name = {}         # bare name -> [qualname]
+        self.by_class = {}        # (cls, name) -> qualname
+        self.edges = {}           # (a, b) -> [(module, line, qual)]
+
+    # -- discovery ---------------------------------------------------------
+    def _collect_lock_attrs(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and isinstance(value.func, (ast.Attribute, ast.Name))):
+                continue
+            ctor = value.func.attr if isinstance(value.func, ast.Attribute) \
+                else value.func.id
+            if ctor not in _LOCK_CTORS:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    cls = self._enclosing_class(module, t)
+                    self.lock_attrs.setdefault(t.attr, set()).add(
+                        cls or "?")
+
+    @staticmethod
+    def _enclosing_class(module, node):
+        parents = module.parent_map()
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = parents.get(cur)
+        return None
+
+    # -- token naming ------------------------------------------------------
+    def _token_for(self, expr, cls):
+        """Lock token for an expression, or None when it is not
+        lock-like. ``cls`` is the class of ``self`` at this site."""
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name and _FACTORY_PAT.search(name):
+                owner = cls if (isinstance(f, ast.Attribute)
+                                and isinstance(f.value, ast.Name)
+                                and f.value.id == "self") else "?"
+                return "%s.%s()" % (owner or "?", name)
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            declared = self.lock_attrs.get(attr)
+            lockish = bool(declared) or bool(_NAME_PAT.search(attr))
+            if not lockish:
+                return None
+            root = _attr_chain_root(expr)
+            if isinstance(root, ast.Name) and root.id == "self" and cls:
+                return "%s.%s" % (cls, attr)
+            if declared and len(declared) == 1:
+                return "%s.%s" % (next(iter(declared)), attr)
+            return "?.%s" % attr
+        if isinstance(expr, ast.Name) and _NAME_PAT.search(expr.id):
+            return "local.%s" % expr.id
+        if isinstance(expr, ast.Subscript):
+            # e.g. self._ch_locks[i]: one token for the whole family
+            return self._token_for(expr.value, cls)
+        return None
+
+    # -- function harvesting ----------------------------------------------
+    def add_module(self, module):
+        self._collect_lock_attrs(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = module.qualname(node)
+                cls = self._enclosing_class(module, node)
+                info = _FuncInfo(node, qual, cls)
+                self.funcs[(module.relpath, qual)] = info
+                self.by_name.setdefault(node.name, []).append(
+                    (module.relpath, qual))
+                if cls:
+                    self.by_class[(cls, node.name)] = \
+                        (module.relpath, qual)
+                self._walk_function(module, info)
+
+    def _walk_function(self, module, info):
+        self._walk_body(module, info, info.node.body, [])
+
+    def _note_acquire(self, module, info, token, held, node):
+        for h in held:
+            if h == token and h.endswith("()"):
+                # distinct keys of one factory are distinct locks; a
+                # nested factory acquisition is only *potentially* a
+                # self-deadlock, so record it but let the verdict
+                # message say so
+                pass
+            self.edges.setdefault((h, token), []).append(
+                (module.relpath, node.lineno, info.qualname))
+        info.direct.add(token)
+
+    def _walk_body(self, module, info, body, held):
+        held = list(held)
+        for stmt in body:
+            self._walk_stmt(module, info, stmt, held)
+
+    def _walk_stmt(self, module, info, stmt, held):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                     # nested defs analyzed separately
+        if isinstance(stmt, ast.With):
+            pushed = []
+            for item in stmt.items:
+                tok = self._token_for(item.context_expr, info.cls)
+                # calls inside the context expr still run
+                self._scan_calls(module, info, item.context_expr, held)
+                if tok is not None:
+                    self._note_acquire(module, info, tok, held,
+                                       item.context_expr)
+                    held.append(tok)
+                    pushed.append(tok)
+            self._walk_body(module, info, stmt.body, held)
+            for tok in pushed:
+                held.remove(tok)
+            return
+        # explicit acquire()/release() pairs, tracked linearly
+        call = self._stmt_call(stmt)
+        if call is not None and isinstance(call.func, ast.Attribute):
+            if call.func.attr == "acquire":
+                tok = self._token_for(call.func.value, info.cls)
+                if tok is not None:
+                    self._note_acquire(module, info, tok, held, call)
+                    held.append(tok)
+                    # still scan args (rare, but cheap)
+                    for a in call.args:
+                        self._scan_calls(module, info, a, held)
+                    return
+            elif call.func.attr == "release":
+                tok = self._token_for(call.func.value, info.cls)
+                if tok is not None and tok in held:
+                    held.remove(tok)
+                    return
+        # recurse into compound statements with the current held set
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self._walk_body(module, info, sub, held)
+        for h in getattr(stmt, "handlers", []) or []:
+            self._walk_body(module, info, h.body, held)
+        # scan expressions of this statement for calls made while held
+        self._scan_calls(module, info, stmt, held, skip_bodies=True)
+
+    @staticmethod
+    def _stmt_call(stmt):
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            return stmt.value
+        return None
+
+    def _scan_calls(self, module, info, node, held, skip_bodies=False):
+        """Record every call this function makes (for the transitive
+        lock summaries); the held-set edges for those calls are added by
+        the second walk in :meth:`finalize`."""
+        for child in ast.walk(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if not isinstance(child, ast.Call):
+                continue
+            f = child.func
+            if isinstance(f, ast.Attribute):
+                if isinstance(f.value, ast.Name) and f.value.id == "self":
+                    info.calls.add(("self", f.attr, child.lineno))
+                else:
+                    info.calls.add(("other", f.attr, child.lineno))
+            elif isinstance(f, ast.Name):
+                info.calls.add(("plain", f.id, child.lineno))
+
+    # -- interprocedural summary ------------------------------------------
+    # method names shared with the threading/queue primitives: a call
+    # like ``cv.wait()`` must never resolve to an unrelated same-named
+    # method in this file (it would fabricate lock edges)
+    _GENERIC = frozenset((
+        "wait", "join", "get", "put", "set", "clear", "notify",
+        "notify_all", "acquire", "release", "is_set", "result",
+        "append", "pop", "items", "values", "keys", "update", "add",
+        "discard", "remove", "copy", "close", "start"))
+
+    def _resolve(self, info, kind, name):
+        if kind == "self" and info.cls and \
+                (info.cls, name) in self.by_class:
+            return self.by_class[(info.cls, name)]
+        if kind != "plain" and name in self._GENERIC:
+            return None
+        cands = self.by_name.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _reach(self, key, stack=()):
+        info = self.funcs.get(key)
+        if info is None:
+            return set()
+        if info.reach is not None:
+            return info.reach
+        if key in stack:
+            return set(info.direct)
+        out = set(info.direct)
+        for entry in info.calls:
+            kind, name = entry[0], entry[1]
+            target = self._resolve(info, kind, name)
+            if target is not None:
+                out |= self._reach(target, stack + (key,))
+        info.reach = out
+        return out
+
+    def finalize(self, modules_by_path):
+        """Second walk adding summary edges: while held-set H, a call to
+        a resolvable callee adds H x reach(callee)."""
+        for key, info in self.funcs.items():
+            module = modules_by_path.get(key[0])
+            if module is None:
+                continue
+            self._summary_walk(module, info, info.node.body, [])
+
+    def _summary_walk(self, module, info, body, held):
+        held = list(held)
+        for stmt in body:
+            self._summary_stmt(module, info, stmt, held)
+
+    def _summary_stmt(self, module, info, stmt, held):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.With):
+            pushed = []
+            for item in stmt.items:
+                tok = self._token_for(item.context_expr, info.cls)
+                self._summary_calls(module, info, item.context_expr, held)
+                if tok is not None:
+                    held.append(tok)
+                    pushed.append(tok)
+            self._summary_walk(module, info, stmt.body, held)
+            for tok in pushed:
+                held.remove(tok)
+            return
+        call = self._stmt_call(stmt)
+        if call is not None and isinstance(call.func, ast.Attribute):
+            if call.func.attr == "acquire":
+                tok = self._token_for(call.func.value, info.cls)
+                if tok is not None:
+                    held.append(tok)
+                    return
+            elif call.func.attr == "release":
+                tok = self._token_for(call.func.value, info.cls)
+                if tok is not None and tok in held:
+                    held.remove(tok)
+                    return
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self._summary_walk(module, info, sub, held)
+        for h in getattr(stmt, "handlers", []) or []:
+            self._summary_walk(module, info, h.body, held)
+        if held:
+            self._summary_calls(module, info, stmt, held,
+                                top_level_only=True)
+
+    def _summary_calls(self, module, info, node, held,
+                       top_level_only=False):
+        if not held:
+            return
+        for child in ast.walk(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if not isinstance(child, ast.Call):
+                continue
+            if top_level_only and self._inside_nested_block(node, child):
+                continue
+            f = child.func
+            if isinstance(f, ast.Attribute):
+                kind = "self" if (isinstance(f.value, ast.Name)
+                                  and f.value.id == "self") else "other"
+                name = f.attr
+            elif isinstance(f, ast.Name):
+                kind, name = "plain", f.id
+            else:
+                continue
+            target = self._resolve(info, kind, name)
+            if target is None:
+                continue
+            for tok in self._reach(target):
+                for h in held:
+                    if h != tok:
+                        self.edges.setdefault((h, tok), []).append(
+                            (module.relpath, child.lineno,
+                             info.qualname))
+
+    @staticmethod
+    def _inside_nested_block(stmt, call):
+        """True when ``call`` sits inside a nested compound body of
+        ``stmt`` (those are visited by the statement recursion with
+        their own held set; scanning them again would double-count)."""
+        for field in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, field, None) or []:
+                if call.lineno >= sub.lineno and \
+                        call.lineno <= (sub.end_lineno or sub.lineno):
+                    return True
+        for h in getattr(stmt, "handlers", []) or []:
+            for sub in h.body:
+                if call.lineno >= sub.lineno and \
+                        call.lineno <= (sub.end_lineno or sub.lineno):
+                    return True
+        return False
+
+    # -- verdict -----------------------------------------------------------
+    def cycles(self):
+        """Strongly-connected components with >1 token, plus self-edges;
+        returns ``[(tokens, edge_sites)]``."""
+        graph = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index = {}
+        low = {}
+        on_stack = set()
+        stack = []
+        sccs = []
+        counter = [0]
+
+        def strongconnect(v):
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in graph.get(v, ()):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+
+        for v in list(graph):
+            if v not in index:
+                strongconnect(v)
+        out = []
+        for comp in sccs:
+            comp_set = set(comp)
+            if len(comp) > 1:
+                sites = []
+                for (a, b), locs in sorted(self.edges.items()):
+                    if a in comp_set and b in comp_set:
+                        sites.append(((a, b), locs))
+                out.append((sorted(comp_set), sites))
+        for (a, b), locs in sorted(self.edges.items()):
+            if a == b:
+                out.append(([a], [((a, b), locs)]))
+        return out
+
+
+@register
+class LockOrderPass(LintPass):
+    name = "lock-order"
+    description = ("lock-acquisition graph cycles / inconsistent "
+                   "acquisition orders (potential deadlocks)")
+
+    def run(self, module):
+        # the graph is meaningful per file: cross-file lock sharing in
+        # this tree happens through objects analyzed in their defining
+        # file (kvstore_async holds every party of its protocol)
+        graph = LockGraph()
+        graph.add_module(module)
+        graph.finalize({module.relpath: module})
+        out = []
+        for tokens, sites in graph.cycles():
+            if len(tokens) == 1:
+                kind = ("nested acquisition of %s (self-deadlock if "
+                        "non-reentrant; for a lock factory, a real "
+                        "deadlock when both sites can name the same "
+                        "key)" % tokens[0])
+            else:
+                kind = ("inconsistent lock order across {%s} — threads "
+                        "taking these in opposite orders can deadlock"
+                        % ", ".join(tokens))
+            for (a, b), locs in sites:
+                for (relpath, lineno, qual) in locs:
+                    f = module.finding(
+                        _Anchor(lineno), self.name,
+                        "%s; this site takes %s while holding %s"
+                        % (kind, b, a))
+                    f.func = qual
+                    out.append(f)
+        return out
+
+
+class _Anchor:
+    """Minimal node stand-in so ModuleInfo.finding can anchor a graph
+    edge (the edge site is a line, not a single AST node)."""
+
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.col_offset = 0

@@ -1,0 +1,87 @@
+"""except-swallow pass: AST-accurate ``except: pass`` detection, scoped
+by module criticality.
+
+A handler whose body is only ``pass``/``...`` turns a failure into
+silence. On the kvstore/engine/fault/checkpoint/io paths that silence is
+a hung or silently-corrupt fleet, so there ANY broad swallow
+(``except:``, ``except Exception:``, ``except BaseException:``, or a
+tuple containing one of those) is a finding. Elsewhere only the bare /
+``BaseException`` forms are flagged — a narrow ``except ValueError:
+pass`` is a normal idiom, and a broad one in cold code is grandfathered
+by the baseline rather than blocking CI.
+
+Unlike the old regex (which matched the *next line* only), the AST form
+sees the handler body whatever its layout, and a swallow annotated
+``# mxlint: allow(except-swallow) — reason`` on the ``except`` line is
+deliberately blessed.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from ..core import LintPass, register
+
+# module paths where a swallowed error means a hung or corrupt fleet;
+# matched against the repo-relative path with fnmatch
+CRITICAL = (
+    "*mxtpu/kvstore.py", "*mxtpu/kvstore_async.py",
+    "*mxtpu/kvstore_server.py", "*mxtpu/engine.py", "*mxtpu/fault.py",
+    "*mxtpu/checkpoint.py", "*mxtpu/resilience.py", "*mxtpu/io.py",
+    "*mxtpu/image.py", "*mxtpu/executor.py", "*mxtpu/module/*",
+    "*mxtpu/parallel/*", "*tools/launch.py",
+)
+
+_BROAD = frozenset(("Exception", "BaseException"))
+
+
+def _exc_names(handler):
+    t = handler.type
+    if t is None:
+        return {None}
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            names.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.add(e.attr)
+        else:
+            names.add("?")
+    return names
+
+
+def _body_is_swallow(handler):
+    return all(isinstance(s, ast.Pass)
+               or (isinstance(s, ast.Expr)
+                   and isinstance(s.value, ast.Constant)
+                   and s.value.value is Ellipsis)
+               for s in handler.body)
+
+
+@register
+class ExceptSwallowPass(LintPass):
+    name = "except-swallow"
+    description = "except-with-pass-only handlers, scoped by criticality"
+
+    def run(self, module):
+        critical = any(fnmatch.fnmatch(module.relpath, pat)
+                       for pat in CRITICAL)
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _body_is_swallow(node):
+                continue
+            names = _exc_names(node)
+            bare = None in names or "BaseException" in names
+            broad = bare or (names & _BROAD)
+            if bare or (critical and broad):
+                what = "bare except" if None in names else \
+                    "except %s" % "/".join(sorted(n for n in names if n))
+                out.append(module.finding(
+                    node, self.name,
+                    "%s: pass swallows failures silently%s" %
+                    (what, " on a critical fleet path" if critical
+                     else "")))
+        return out

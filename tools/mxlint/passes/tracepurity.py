@@ -1,0 +1,235 @@
+"""trace-purity pass: host syncs and impure state writes inside traced
+(jit-compiled) functions.
+
+A traced function runs once at trace time and is then replayed by XLA:
+any host sync inside it (``asnumpy()``, ``.item()``, ``float()`` /
+``int()`` of a traced value, ``np.asarray``, ``jax.device_get``,
+``block_until_ready``) forces a device round-trip per call during
+tracing — or, worse, silently re-introduces a per-batch sync when the
+value is an operand — and any write to ``self.X`` / nonlocal state runs
+ONCE at trace time and never again, which is almost never what the
+author meant. This is exactly the regression class PR 5 removed from
+the Module hot loop (`zero per-batch host syncs`), so it must not come
+back by accident.
+
+Roots: a function is *traced* when it is
+
+* decorated with ``jax.jit`` / ``jit`` /
+  ``functools.partial(jax.jit, ...)``, or
+* passed as the first argument to a ``jax.jit(...)`` / ``jit(...)``
+  call anywhere in the module (``jitted = jax.jit(train_step, ...)``),
+  or
+* listed in :data:`EXTRA_ROOTS` — the fused-step helpers that only ever
+  execute inside a traced program (``functional_optimizer_step``: every
+  call site sits inside a jitted train step).
+
+Reachability is closed over same-module calls (plain names, nested
+defs, ``self.`` methods of the enclosing class) — the fused step's
+``fused -> _forward -> eval_graph``-style chains are covered as far as
+this module defines them; cross-module callees are out of scope by
+design (each module is analyzed with its own roots).
+
+Checks are syntactic, not dataflow: ``float(x)`` on a trace-time Python
+constant is flagged too. That is deliberate — inside a jitted function
+"host value" vs "traced value" is precisely the distinction authors get
+wrong, and the blessing for a reviewed constant is a
+``# mxlint: allow(trace-purity) — <why this is trace-time>`` pragma.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, register
+
+# (module path suffix, function bare name) roots that are only ever
+# called from inside traced programs
+EXTRA_ROOTS = (
+    ("mxtpu/optimizer.py", "functional_optimizer_step"),
+)
+
+_HOST_ATTR_CALLS = frozenset(("asnumpy", "item", "tolist",
+                              "block_until_ready"))
+_HOST_NP_FUNCS = frozenset(("asarray", "array", "copy", "frombuffer",
+                            "save", "load"))
+
+
+def _is_jit_expr(node):
+    """True for ``jax.jit`` / ``jit`` name expressions."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_targets(call):
+    """Candidate traced-function names for a ``jax.jit(X, ...)`` call:
+    ``X`` itself when it is a bare name; the functions a lambda ``X``
+    calls (``jax.jit(lambda *a: wrapped(*a))``); and the name arguments
+    of a wrapper call ``X`` (``jax.jit(maybe_remat(body, ...))`` /
+    ``jax.jit(pl.pallas_call(kernel, ...))``) — one unwrap level."""
+    if not _is_jit_expr(call.func) or not call.args:
+        return ()
+    target = call.args[0]
+    if isinstance(target, ast.Name):
+        return (target.id,)
+    out = []
+    if isinstance(target, ast.Lambda):
+        for node in ast.walk(target.body):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name):
+                out.append(node.func.id)
+    elif isinstance(target, ast.Call):
+        for a in target.args:
+            if isinstance(a, ast.Name):
+                out.append(a.id)
+    return tuple(out)
+
+
+def _decorated_as_jit(func):
+    for dec in func.decorator_list:
+        if _is_jit_expr(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            # functools.partial(jax.jit, ...) or jax.jit(...) factory
+            if _is_jit_expr(dec.func):
+                return True
+            fname = dec.func.attr if isinstance(dec.func, ast.Attribute) \
+                else (dec.func.id if isinstance(dec.func, ast.Name)
+                      else None)
+            if fname == "partial" and dec.args and \
+                    _is_jit_expr(dec.args[0]):
+                return True
+    return False
+
+
+@register
+class TracePurityPass(LintPass):
+    name = "trace-purity"
+    description = ("host syncs / impure state writes inside functions "
+                   "reachable from a jax.jit root")
+
+    def run(self, module):
+        tree = module.tree
+        funcs = {}          # bare name -> [FunctionDef]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, []).append(node)
+        roots = set()
+        for name, defs in funcs.items():
+            for d in defs:
+                if _decorated_as_jit(d):
+                    roots.add(d)
+        # wrapper aliases: `wrapped = maybe_remat(body, ...)` makes a
+        # jit of `wrapped` a jit of `body` (one unwrap level)
+        aliases = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                inner = [a.id for a in node.value.args
+                         if isinstance(a, ast.Name) and a.id in funcs]
+                if inner:
+                    aliases[node.targets[0].id] = inner
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                for target in _jit_call_targets(node):
+                    for name in [target] + aliases.get(target, []):
+                        if name in funcs:
+                            roots.update(funcs[name])
+        for suffix, fname in EXTRA_ROOTS:
+            if module.relpath.endswith(suffix) and fname in funcs:
+                roots.update(funcs[fname])
+        if not roots:
+            return []
+        reachable = self._close_over_calls(module, funcs, roots)
+        np_aliases = module.numpy_aliases()
+        out = []
+        for fn in sorted(reachable, key=lambda n: n.lineno):
+            out.extend(self._check_traced(module, fn, np_aliases))
+        return out
+
+    # -- reachability ------------------------------------------------------
+    @staticmethod
+    def _close_over_calls(module, funcs, roots):
+        reachable = set(roots)
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = None
+                if isinstance(f, ast.Name):
+                    name = f.id
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    name = f.attr
+                if name and name in funcs:
+                    for cand in funcs[name]:
+                        if cand not in reachable:
+                            reachable.add(cand)
+                            work.append(cand)
+        return reachable
+
+    # -- the checks --------------------------------------------------------
+    def _check_traced(self, module, fn, np_aliases):
+        out = []
+        ctx = fn.name
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_call(module, node, np_aliases,
+                                            ctx))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        out.append(module.finding(
+                            node, self.name,
+                            "write to %s inside traced %s() runs once "
+                            "at trace time, not per step"
+                            % (ast.unparse(t), ctx)))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                out.append(module.finding(
+                    node, self.name,
+                    "%s write inside traced %s() is a trace-time "
+                    "side effect" % (type(node).__name__.lower(), ctx)))
+        return out
+
+    def _check_call(self, module, node, np_aliases, ctx):
+        f = node.func
+        out = []
+        if isinstance(f, ast.Attribute):
+            if f.attr in _HOST_ATTR_CALLS:
+                out.append(module.finding(
+                    node, self.name,
+                    ".%s() inside traced %s() is a host sync"
+                    % (f.attr, ctx)))
+            elif f.attr == "device_get":
+                out.append(module.finding(
+                    node, self.name,
+                    "device_get inside traced %s() is a host sync"
+                    % ctx))
+            elif isinstance(f.value, ast.Name) and \
+                    f.value.id in np_aliases and \
+                    f.attr in _HOST_NP_FUNCS:
+                out.append(module.finding(
+                    node, self.name,
+                    "%s.%s() inside traced %s() materializes on host "
+                    "(use jnp, or hoist out of the traced function)"
+                    % (f.value.id, f.attr, ctx)))
+        elif isinstance(f, ast.Name):
+            if f.id in ("float", "int") and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                out.append(module.finding(
+                    node, self.name,
+                    "%s() of a non-literal inside traced %s() forces a "
+                    "host sync if the value is traced" % (f.id, ctx)))
+            elif f.id == "print":
+                out.append(module.finding(
+                    node, self.name,
+                    "print() inside traced %s() fires at trace time "
+                    "only (use jax.debug.print)" % ctx))
+        return out
