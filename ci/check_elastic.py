@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Fast-tier elasticity smoke (ISSUE 7): 2 loopback servers + 2 worker
+stores, a third worker JOINS mid-drill, a hot shard SPLITS onto a
+freshly started third server, a worker LEAVES — and the table comes out
+the other side exact.
+
+This is the cheapest end-to-end drill of the whole elastic loop:
+
+  1. an anchor worker inits; workers JOIN mid-run (one before the
+     epoch, one mid-epoch — hello registers them, the hello reply
+     teaches the shard map) and all drain the server-owned shard
+     cursor together (no static rank/size slicing anywhere);
+  2. each (epoch, shard) is processed exactly once, whoever takes it;
+  3. server 0's keys split onto a fresh server online; pushes to moved
+     keys hit ``map_stale``, reroute, and land EXACTLY once (clock
+     arithmetic stays exact);
+  4. a worker departs cleanly (bye): membership drops, its cursor
+     assignments requeue, and a dynamic barrier releases by RE-COUNT,
+     not by deadline;
+  5. ``kv.stats()`` shows the join/leave/split/rebalance counters and
+     the per-server membership epochs.
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_elastic.py`` (wired into
+``ci/run_ci.sh fast``). Exit 0 = contract holds.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_PS_HEARTBEAT"] = "0"   # sweeps run synchronously
+os.environ["MXTPU_PS_LOCAL"] = "0"       # the drill is about the wire
+os.environ["MXTPU_PS_RETRIES"] = "2"
+os.environ["MXTPU_PS_BACKOFF"] = "0.01"
+os.environ["MXTPU_PS_RECONNECT"] = "0.5"
+os.environ["MXTPU_PS_ELASTIC"] = "1"
+os.environ["MXTPU_PS_CURSOR_POLL"] = "0.01"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np                                    # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+from mxtpu import kvstore_async as ka                 # noqa: E402
+
+
+def fail(msg):
+    print("elastic check FAILED: %s" % msg)
+    return 1
+
+
+def main():
+    s0 = ka.ParameterServer().start()
+    s1 = ka.ParameterServer().start()
+    os.environ["MXTPU_PS_ADDRS"] = "%s,%s" % (s0.address, s1.address)
+    os.environ["MXTPU_PROC_ID"] = "0"
+    os.environ["MXTPU_NUM_PROCS"] = "1"
+
+    # the anchor inits ALONE: in elastic mode barriers count the live
+    # membership, so every other worker joins mid-run, after init
+    kv_a = mx.kv.create("dist_async")
+    keys = ["w%d" % i for i in range(6)]
+    kv_a.init(keys, [mx.nd.zeros((4,)) for _ in keys])
+    kv_b = mx.kv.create("dist_async")          # joiner #1
+
+    # phase 1+2: two workers drain the cursor; a third joins mid-epoch
+    EPOCH, SHARDS, BATCHES = 0, 9, 2
+    counted = {"a": 0, "b": 0, "c": 0}
+    joiner_box = {}
+
+    def work(name, kv):
+        for shard in kv.shard_cursor(EPOCH, SHARDS):
+            for _ in range(BATCHES):
+                for k in keys:
+                    kv.push(k, mx.nd.ones((4,)))
+            counted[name] += 1
+            if name == "a" and counted["a"] == 1 and "c" not in joiner_box:
+                # joiner #2, deterministically mid-epoch: a fresh store
+                # hellos, learns the map, and takes cursor work
+                kv_c = mx.kv.create("dist_async")
+                tc = threading.Thread(target=work, args=("c", kv_c),
+                                      daemon=True)
+                joiner_box["c"] = (kv_c, tc)
+                tc.start()
+
+    ta = threading.Thread(target=work, args=("a", kv_a), daemon=True)
+    tb = threading.Thread(target=work, args=("b", kv_b), daemon=True)
+    ta.start(); tb.start()
+    ta.join(timeout=60); tb.join(timeout=60)
+    if ta.is_alive() or tb.is_alive():
+        return fail("cursor epoch never drained")
+    if "c" not in joiner_box:
+        return fail("the joiner never started")
+    kv_c, tc = joiner_box["c"]
+    tc.join(timeout=60)
+    if tc.is_alive():
+        return fail("the joiner never finished its cursor")
+    if sum(counted.values()) != SHARDS:
+        return fail("shard work total wrong: %r" % (counted,))
+
+    # phase 3: split server 0's keys onto a fresh server, then keep
+    # pushing — moved keys must reroute and land exactly once
+    s2 = ka.ParameterServer().start()
+    conn = ka._ServerConn(s0.address)
+    reply = conn.request("split", s2.address)
+    moved = reply[1]["moved"]
+    conn.close()
+    if not moved:
+        return fail("split moved nothing")
+    for k in keys:
+        kv_a.push(k, mx.nd.ones((4,)))
+        kv_b.push(k, mx.nd.ones((4,)))
+    want = SHARDS * BATCHES + 2
+    clocks = kv_a.staleness_stats()["clocks"]
+    if set(clocks) != set(keys):
+        return fail("keys lost across the split: %r" % (clocks,))
+    bad = {k: v for k, v in clocks.items() if v != want}
+    if bad:
+        return fail("acked updates lost or double-applied across the "
+                    "split (want %d): %r" % (want, bad))
+    if kv_a.stats()["map_reroutes"] < 1:
+        return fail("no map_stale reroute was ever exercised")
+
+    # phase 4: a worker leaves while another waits at a dynamic
+    # barrier — released by re-count, not by the deadline
+    released = threading.Event()
+
+    def barrier_a():
+        kv_a.barrier()
+        released.set()
+
+    t = threading.Thread(target=barrier_a, daemon=True)
+    t.start()
+    import time
+    deadline = time.monotonic() + 5
+    while s0._barrier_arrived < 1:
+        if time.monotonic() > deadline:
+            return fail("barrier arrival never registered")
+        time.sleep(0.01)
+    kv_b.close()                      # clean leave: bye
+    kv_c.close()
+    if not released.wait(timeout=10):
+        return fail("the leave did not release the barrier")
+    if s0._barrier_recounts < 1 or s0._barrier_timeouts:
+        return fail("barrier released the wrong way (recounts=%d, "
+                    "timeouts=%d)" % (s0._barrier_recounts,
+                                      s0._barrier_timeouts))
+
+    # phase 5: the operator evidence
+    st = kv_a.stats()
+    el = st["elastic"]
+    if el["joins"] < 3:
+        return fail("joins counter wrong: %r" % (el,))
+    if el["leaves"] < 2:
+        return fail("leaves counter wrong: %r" % (el,))
+    if el["splits"] != 1 or el["keys_moved"] != len(moved) \
+            or el["keys_adopted"] != len(moved):
+        return fail("split counters wrong: %r" % (el,))
+    if s0.address not in st["membership_epochs"]:
+        return fail("per-server membership epochs missing: %r"
+                    % (st["membership_epochs"],))
+
+    kv_a.close()
+    s0.stop(); s1.stop(); s2.stop()
+    print("elastic check OK — %d shards over 2+1 workers, %d key(s) "
+          "resharded online, %d reroute(s), barrier re-counted on "
+          "leave, zero acked-update loss"
+          % (SHARDS, len(moved), st["map_reroutes"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ Spec format (``MXTPU_FAULT_SPEC`` or :func:`install`): rules separated by
     kind=kill,point=server.recv,op=push,nth=5
     kind=nan_grad,point=worker.step,nth=3,count=2
     kind=kill_worker,point=worker.step,nth=8
+    kind=join_worker,point=worker.step,nth=5;kind=split_shard,nth=9
 
 Rule keys:
 
@@ -37,7 +38,14 @@ Rule keys:
            deterministic ``kill -9`` of a worker mid-step that
            ``tools/launch.py --worker-respawn`` recovers from; at a
            server point with ``role=server`` it takes down a parameter
-           server mid-conversation, the replication failover drill).
+           server mid-conversation, the replication failover drill),
+           ``join_worker`` / ``leave_worker`` / ``split_shard``
+           (elasticity drills, ``worker.step`` only: like ``nan_grad``
+           these are *signals* — :func:`fire` returns the kind name and
+           the harness that owns the fleet performs the action at that
+           exact step count, so elastic scale drills replay
+           deterministically inside the fault matrix; see
+           ``docs/fault_tolerance.md`` "Elasticity").
 ``point``  ``worker.send`` | ``worker.recv`` | ``server.recv`` |
            ``server.send`` | ``worker.step`` (fired by the guarded
            training loop once per step, before the jitted step runs) |
@@ -84,7 +92,16 @@ __all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
 _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
            "worker.step", "any")
 _KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
-          "nan_grad", "kill_worker")
+          "nan_grad", "kill_worker", "join_worker", "leave_worker",
+          "split_shard")
+
+# kinds that are SIGNALS, not transport faults: fire() returns the kind
+# name and the caller performs the action — nan_grad poisons the batch,
+# and the elastic kinds drive reproducible scale drills (a harness that
+# owns worker threads / the shard map reacts by joining a worker,
+# departing one, or splitting a key shard at that exact step count)
+_SIGNAL_KINDS = ("nan_grad", "join_worker", "leave_worker",
+                 "split_shard")
 
 
 class FaultSever(ConnectionError):
@@ -107,9 +124,9 @@ class _Rule:
                              % (point, "/".join(_POINTS)))
         if kind == "kill" and point.startswith("worker"):
             raise ValueError("kind=kill only applies to server points")
-        if kind == "nan_grad" and point not in ("worker.step", "any"):
+        if kind in _SIGNAL_KINDS and point not in ("worker.step", "any"):
             raise ValueError(
-                "kind=nan_grad only applies to the worker.step point")
+                "kind=%s only applies to the worker.step point" % kind)
         # kill_worker is allowed at ANY point: at worker.step it is the
         # deterministic kill -9 of a worker mid-step; at a server point
         # (scoped by role=server) it SIGKILLs a parameter-server process
@@ -204,8 +221,8 @@ class FaultInjector:
             return None
         if rule.kind == "drop":
             return "drop"
-        if rule.kind == "nan_grad":
-            return "nan_grad"
+        if rule.kind in _SIGNAL_KINDS:
+            return rule.kind
         if rule.kind == "kill_worker":
             import signal
             os.kill(os.getpid(), signal.SIGKILL)

@@ -145,6 +145,45 @@ two): each key shard is a (primary, backup) pair.
   pair is redundant again. ``kv.health()['replication']`` shows role,
   promotions, forwarding lag and catch-up progress throughout.
 
+Elasticity
+----------
+The fleet is not fixed at launch: workers join and leave mid-run and a
+hot key shard can be split across servers online (the ps-lite promise —
+nodes come and go — made operable; see docs/fault_tolerance.md
+"Elasticity"):
+
+* **Worker join/leave.** A joining worker simply creates a store: its
+  ``hello`` registers membership (counted in ``stats()['elastic']``),
+  it pulls current params, and it takes data-shard assignments from the
+  server-owned cursor below. A departing worker's ``bye`` (or its
+  liveness GC) releases its assignments. With ``MXTPU_PS_ELASTIC=1``
+  barriers count against the CURRENT membership, re-evaluated on every
+  join/leave — a departed worker releases the survivors by re-count
+  (``stats()['barrier_recounts']``) instead of by the
+  ``MXTPU_PS_BARRIER_TIMEOUT`` deadline.
+* **Server-owned data cursor.** ``kv.shard_cursor(epoch, num_shards)``
+  iterates data-shard indices handed out by server 0's epoch-sharded
+  cursor: each shard is assigned exactly once per epoch (assignment
+  replies are replay-deduped), a finished shard is acknowledged, and a
+  dead/departed worker's outstanding shards are re-queued for the
+  survivors — ``fit``-style loops stop assuming a static rank/size.
+* **Online shard split.** The operator command ``("split", dst_addr)``
+  (``tools/launch.py --scale``, ``python -m mxtpu.kvstore_async
+  --admin split``) hands half of a hot server's keys — hotness-ordered
+  by applied-update clocks — to ``dst_addr``. Each key moves atomically
+  under its key lock with its full state (value, clock, push-dedupe
+  seqs, accumulated per-key updater state) via an ``adopt_key``
+  transfer that reuses the catch-up state-transfer semantics; on a
+  replicated destination the ack implies the new shard's OWN backup
+  holds the key, so the old primary releases it only once it is
+  replicated again. Requests for a moved key are refused with
+  ``map_stale`` naming the new home — a routing verdict, not a failure:
+  the client records the forwarding override, re-fetches the versioned
+  shard map (pushed on hello and heartbeat), and replays there, where
+  the transferred dedupe seqs keep the replay at-most-once. A split
+  interrupted mid-way leaves a clean prefix moved and the rest owned —
+  re-issuing the split resumes it; nothing acknowledged is lost.
+
 Fast path
 ---------
 The data path is built for throughput on top of those fault semantics
@@ -194,6 +233,7 @@ import itertools
 import logging
 import os
 import pickle
+import re
 import socket
 import socketserver
 import struct
@@ -331,7 +371,7 @@ class _CommStats:
 
     _FIELDS = ("bytes_sent", "bytes_recv", "frames_sent", "frames_recv",
                "coalesced_frames", "coalesced_subs", "retransmits",
-               "inflight_hwm", "local_reqs")
+               "inflight_hwm", "local_reqs", "map_reroutes")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -756,6 +796,20 @@ class ParameterServer:
         self._workers = {}
         self._workers_lock = threading.Lock()
         self._membership_epoch = 0
+        self._joins = 0            # workers that registered (ever)
+        self._leaves = 0           # clean byes + liveness GCs
+        # -- elasticity: online reshard + server-owned data cursor --
+        self._map_version = 0      # bumps per key handed away/adopted
+        self._moved = {}           # key -> its new home "host:port"
+        self._keys_adopted = 0
+        self._keys_moved_out = 0
+        self._splits = 0
+        self._xfer_conns = {}      # split destination -> _ServerConn
+        self._xfer_guard = threading.Lock()
+        self._cursors = {}         # epoch -> shard-cursor state
+        self._cursor_lock = threading.Lock()
+        self._cursor_requeues = 0
+        self._barrier_recounts = 0
         self._barrier_timeouts = 0
         self._barrier_lock = threading.Lock()
         self._barrier_cv = threading.Condition(self._barrier_lock)
@@ -819,6 +873,11 @@ class ParameterServer:
         conn, self._peer_conn = self._peer_conn, None
         if conn is not None:
             conn.close()
+        with self._xfer_guard:
+            xfer = list(self._xfer_conns.values())
+            self._xfer_conns.clear()
+        for c in xfer:
+            c.close()
         with _LOCAL_GUARD:
             if _LOCAL_SERVERS.get(self.address) is self:
                 del _LOCAL_SERVERS[self.address]
@@ -889,6 +948,12 @@ class ParameterServer:
         self._catchup = {"total": len(keys), "sent": 0, "done": False}
         if self._opt_payload is not None:
             stream.forward(("set_optimizer", self._opt_payload))
+        if self._moved:
+            # the forwarding table travels too: a backup promoted later
+            # must refuse split-away keys with the right new home, not
+            # serve a stale pre-split copy
+            stream.forward(("moved_map", dict(self._moved),
+                            self._map_version))
         with self._updater_lock:
             if self._updater is not None:
                 # the ACCUMULATED updater state — momentum buffers,
@@ -1017,6 +1082,7 @@ class ParameterServer:
                 self._table.pop(key, None)
                 self._clock.pop(key, None)
         self._applied = {}
+        self._moved = {}   # the authority's catch-up re-teaches the map
         _log.warning("parameter server %s: demoted to backup of %s "
                      "(the peer was promoted while we were down)",
                      self.address, self._peer_addr)
@@ -1057,10 +1123,13 @@ class ParameterServer:
         origin. Leaf lock: never taken while holding a key lock's
         sibling — see _gc_workers for the ordering discipline."""
         now = time.monotonic()
+        created = False
         with self._workers_lock:
             rec = self._workers.get(origin)
             if rec is None:
+                created = True
                 self._membership_epoch += 1
+                self._joins += 1
                 rec = {"rank": rank, "pushes": 0, "stale_sum": 0,
                        "stale_max": 0, "last_seen": now,
                        "last_push": None, "push_gap_max": 0.0,
@@ -1069,7 +1138,19 @@ class ParameterServer:
             if rank is not None:
                 rec["rank"] = rank
             rec["last_seen"] = now
-            return rec
+        if created:
+            # a join can complete a dynamic barrier (its target grew,
+            # but so can a waiter's arithmetic change) — wake waiters
+            self._notify_membership()
+        return rec
+
+    def _notify_membership(self):
+        """Wake barrier waiters after a join/leave so a dynamic
+        (elastic) barrier re-counts against the new membership. Called
+        with NO other lock held — the barrier path nests
+        barrier-lock -> workers-lock, never the reverse."""
+        with self._barrier_cv:
+            self._barrier_cv.notify_all()
 
     def _drop_worker(self, origin):
         """Forget a worker: membership record AND its buffered dedupe
@@ -1082,11 +1163,17 @@ class ParameterServer:
             existed = self._workers.pop(origin, None) is not None
             if existed:
                 self._membership_epoch += 1
+                self._leaves += 1
         if not existed:
             return False
         for key in [k for o, k in list(self._applied) if o == origin]:
             with self._lock_for(key):
                 self._applied.pop((origin, key), None)
+        # a leaver's unfinished data shards go back on the cursor for
+        # the survivors, and its arrival can no longer be awaited — a
+        # dynamic barrier re-counts now instead of timing out later
+        self._requeue_cursor_shards(origin)
+        self._notify_membership()
         return True
 
     def _gc_workers(self):
@@ -1122,6 +1209,145 @@ class ParameterServer:
                                           now - rec["last_push"])
             rec["last_push"] = now
 
+    # -- elastic data cursor (module docstring, "Elasticity") --------------
+    def _cursor_for(self, epoch, num_shards):
+        """The (lazily created) cursor record for one epoch; caller
+        holds ``_cursor_lock``. History is bounded: epochs more than
+        two behind the newest are dropped."""
+        cur = self._cursors.get(epoch)
+        if cur is None:
+            cur = {"num_shards": int(num_shards), "next": 0,
+                   "requeued": [], "outstanding": {}, "done": set(),
+                   "last": {}}
+            self._cursors[epoch] = cur
+            for old in [e for e in self._cursors if e < epoch - 2]:
+                del self._cursors[old]
+        return cur
+
+    def _requeue_cursor_shards(self, origin):
+        """A departed worker's outstanding shard assignments go back on
+        the queue so a surviving worker picks them up (at-least-once:
+        the leaver may have processed part of a shard it never
+        acknowledged)."""
+        with self._cursor_lock:
+            for cur in self._cursors.values():
+                gone = [s for s, o in cur["outstanding"].items()
+                        if o == origin]
+                for s in gone:
+                    del cur["outstanding"][s]
+                    cur["requeued"].append(s)
+                    self._cursor_requeues += 1
+                cur["last"].pop(origin, None)
+
+    # -- elasticity: online shard split ------------------------------------
+    def _stale_reply(self, key, dst):
+        # a routing verdict like not_serving, NOT a failure: the command
+        # was not executed; the client records the forwarding override,
+        # refreshes its map and replays at the key's new home (where the
+        # transferred dedupe seqs keep the replay at-most-once)
+        return ("err", "map_stale: key %r moved to %s (map_version %d)"
+                       % (key, dst, self._map_version))
+
+    def _split_conn(self, addr):
+        with self._xfer_guard:
+            conn = self._xfer_conns.get(addr)
+        if conn is None:
+            conn = _ServerConn(addr, token=self._token, n_socks=1,
+                               connect_timeout=_RECONNECT_TIMEOUT)
+            with self._xfer_guard:
+                self._xfer_conns[addr] = conn
+        return conn
+
+    def _pick_split_keys(self):
+        """Every other key of the hotness-ordered local set: the moving
+        half and the staying half carry ~equal applied-update load
+        (clocks count applied updates), so splitting a hot shard really
+        halves its traffic."""
+        local = [k for k in self._table if k not in self._moved]
+        local.sort(key=lambda k: (-self._clock.get(k, 0), str(k)))
+        return local[0::2]
+
+    def _do_split(self, msg):
+        """("split", dst_addr[, keys]) — operator command on a shard
+        primary: hand half our keys (or exactly ``keys``) to the server
+        at ``dst_addr`` with full state — value, clock, push-dedupe
+        seqs, accumulated per-key updater state — then refuse the moved
+        keys with ``map_stale`` so clients re-route. Each key's handoff
+        is atomic under its key lock; an aborted split leaves a clean
+        prefix moved and the rest owned (re-issue the split to resume —
+        nothing acknowledged is lost either way)."""
+        dst = msg[1]
+        want = list(msg[2]) if len(msg) > 2 and msg[2] else None
+        if dst == self.address:
+            return ("err", "split destination is this server")
+        keys = want if want is not None else self._pick_split_keys()
+        moved = []
+        conn = None
+        try:
+            conn = self._split_conn(dst)
+            if self._opt_payload is not None:
+                # dst may be a just-spawned server that never saw the
+                # clients' launch-time set_optimizer broadcast
+                conn.request("set_optimizer", self._opt_payload)
+            for key in keys:
+                stream = rseq = None
+                # the key lock is held ACROSS the adopt RPC by design:
+                # pushes to THIS key wait (bounded by the RPC timeout)
+                # while every other key flows freely, and the moment
+                # the lock drops the key is either still ours or
+                # map_stale — no window where neither server owns it.
+                with self._lock_for(key):  # mxlint: allow(lock-order) — dst's key locks belong to a DIFFERENT server instance; adopt_key never calls back into this server, so the nesting cannot cycle
+                    if key not in self._table or key in self._moved:
+                        continue
+                    applied = [[o, s] for (o, k), s
+                               in list(self._applied.items()) if k == key]
+                    state = None
+                    with self._updater_lock:
+                        if self._updater is not None:
+                            state = self._updater.get_state_one(
+                                _key_int(key))
+                            if state is not None:
+                                state = _np.frombuffer(
+                                    state, dtype=_np.uint8)
+                    conn.request(
+                        "adopt_key", key,
+                        _np.array(self._table[key], copy=True),
+                        int(self._clock[key]), applied, state)
+                    # dst's ok means the key — and, on a replicated
+                    # destination, its backup copy — is durable there;
+                    # only now may ownership be released
+                    self._moved[key] = dst
+                    self._map_version += 1
+                    self._keys_moved_out += 1
+                    del self._table[key]
+                    self._clock.pop(key, None)
+                    for o, s in applied:
+                        self._applied.pop((o, key), None)
+                    stream = self._repl
+                    if stream is not None and not stream.dead:
+                        # our own backup mirrors the release (ordered
+                        # against this key's forwarded pushes by the
+                        # key lock), so a promotion mid-split still
+                        # refuses moved keys with the right forward
+                        rseq = stream.forward(("moved", key, dst))
+                self._repl_barrier(stream, rseq)
+                moved.append(key)
+        except (ConnectionError, RuntimeError, OSError) as e:
+            with self._xfer_guard:
+                self._xfer_conns.pop(dst, None)
+            if conn is not None:
+                conn.close()
+            return ("err", "split to %s aborted after %d of %d key(s) "
+                           "moved: %s: %s (re-issue the split to "
+                           "resume)" % (dst, len(moved), len(keys),
+                                        type(e).__name__, e))
+        self._splits += 1
+        _log.warning("parameter server %s: split %d key(s) -> %s "
+                     "(map_version %d)", self.address, len(moved), dst,
+                     self._map_version)
+        return ("ok", {"dst": dst, "moved": moved,
+                       "map_version": self._map_version})
+
     @staticmethod
     def _as_table_value(value):
         """Canonicalize an incoming init value to an owned, writable
@@ -1154,6 +1380,10 @@ class ParameterServer:
         _, key, value = msg
         stream = rseq = None
         with self._lock_for(key):
+            dst = self._moved.get(key)
+            if dst is not None:
+                return ("ok", "skipped") if _repl \
+                    else self._stale_reply(key, dst)
             if key not in self._table:   # first writer wins (rank 0)
                 self._table[key] = self._as_table_value(value)
                 self._clock[key] = 0
@@ -1176,6 +1406,13 @@ class ParameterServer:
         dup = False
         with self._lock_for(key):
             if key not in self._table:
+                dst = self._moved.get(key)
+                if dst is not None:
+                    # handed away in an online split: route, don't fail
+                    # (a repl record for a moved key is a stream replay
+                    # the release already ordered after — skip it)
+                    return ("ok", "skipped") if _repl \
+                        else self._stale_reply(key, dst)
                 if _repl and not self._catchup_complete:
                     # catch-up in progress and this key has not been
                     # transferred yet: skip — the pending xfer record
@@ -1246,7 +1483,7 @@ class ParameterServer:
     # of a backup's table, or failover could serve/accept torn state
     _CLIENT_STATE_CMDS = frozenset(
         ("init", "push", "pull", "pull_rows", "multi", "set_optimizer",
-         "barrier"))
+         "barrier", "split", "adopt_key", "cursor_next", "cursor_done"))
 
     def _dispatch(self, msg, _repl=False):
         cmd = msg[0]
@@ -1265,6 +1502,9 @@ class ParameterServer:
             _, key = msg
             with self._lock_for(key):
                 if key not in self._table:
+                    dst = self._moved.get(key)
+                    if dst is not None:
+                        return self._stale_reply(key, dst)
                     return ("err", "pull of uninitialized key %r" % (key,))
                 tbl = self._table[key]
                 # the reply is pickled OUTSIDE this lock: hand out a
@@ -1279,6 +1519,9 @@ class ParameterServer:
             _, key, row_ids = msg
             with self._lock_for(key):
                 if key not in self._table:
+                    dst = self._moved.get(key)
+                    if dst is not None:
+                        return self._stale_reply(key, dst)
                     return ("err", "pull of uninitialized key %r" % (key,))
                 rows = self._table[key][_np.asarray(row_ids)]
                 return ("ok", rows, self._clock[key])
@@ -1297,6 +1540,86 @@ class ParameterServer:
                             server=self)
                 replies.append(self._dispatch(sub))
             return ("ok", replies)
+        if cmd == "split":
+            return self._do_split(msg)
+        if cmd == "adopt_key":
+            # ("adopt_key", key, value, clock, applied, updater_state):
+            # the receiving half of an online shard split — overwrite-
+            # install under the key lock, forward to OUR backup before
+            # the ack (sync mode: the new shard is replicated before
+            # the old primary releases the key), and refuse replays
+            # that would clobber a newer local copy (the clock is the
+            # idempotency watermark, exactly like a replayed xfer).
+            _, key, value, clock, applied, state = msg
+            stream = rseq = None
+            dup = False
+            with self._lock_for(key):
+                if self._clock.get(key, -1) >= int(clock):
+                    dup = True
+                else:
+                    self._table[key] = _np.array(value, copy=True)
+                    self._clock[key] = int(clock)
+                    for o, s in applied:
+                        prev = self._applied.get((o, key), 0)
+                        self._applied[(o, key)] = max(prev, int(s))
+                    self._moved.pop(key, None)   # a key may move back
+                    if state is not None:
+                        with self._updater_lock:
+                            if self._updater is not None:
+                                self._updater.set_state_one(
+                                    _key_int(key),
+                                    bytes(_np.asarray(
+                                        state, dtype=_np.uint8)))
+                    self._keys_adopted += 1
+                    stream = None if _repl else self._repl
+                    if stream is not None:
+                        rseq = stream.forward(
+                            ("adopt_key", key, value, clock, applied,
+                             state))
+            self._repl_barrier(stream, rseq)
+            return ("ok", "dup") if dup else ("ok",)
+        if cmd == "shard_map":
+            # the versioned forwarding table: which keys this server
+            # handed away, and where (clients refresh on a version bump
+            # advertised in hello/ping replies)
+            return ("ok", {"version": self._map_version,
+                           "moved": dict(self._moved)})
+        if cmd == "cursor_next":
+            # ("cursor_next", origin, epoch, num_shards, rid): one
+            # data-shard assignment off the server-owned epoch cursor.
+            # rid makes the reply replay-safe: a retried request (lost
+            # ack) gets the SAME shard back instead of a second one.
+            _, origin, epoch, num_shards, rid = msg
+            self._worker_rec(origin)
+            with self._cursor_lock:
+                cur = self._cursor_for(int(epoch), num_shards)
+                last = cur["last"].get(origin)
+                if last is not None and last[0] == rid:
+                    shard = last[1]
+                else:
+                    if cur["requeued"]:
+                        shard = cur["requeued"].pop(0)
+                    elif cur["next"] < cur["num_shards"]:
+                        shard = cur["next"]
+                        cur["next"] += 1
+                    else:
+                        shard = None
+                    if shard is not None:
+                        cur["outstanding"][shard] = origin
+                    cur["last"][origin] = (rid, shard)
+                pending = cur["num_shards"] - len(cur["done"])
+            return ("ok", shard, pending)
+        if cmd == "cursor_done":
+            # shard finished: it can never be re-queued, and once every
+            # shard of the epoch is done the cursor reports pending=0
+            # so pollers stop waiting (idempotent: done is a set)
+            _, origin, epoch, shard = msg
+            with self._cursor_lock:
+                cur = self._cursors.get(int(epoch))
+                if cur is not None:
+                    cur["outstanding"].pop(shard, None)
+                    cur["done"].add(shard)
+            return ("ok",)
         if cmd == "set_optimizer":
             _, payload = msg
             self._install_optimizer(bytes(payload))
@@ -1334,8 +1657,32 @@ class ParameterServer:
             self._repl_applied_rseq = rseq
             self._repl_received += 1
             sc = sub[0]
-            if sc in ("push", "init", "set_optimizer"):
+            if sc in ("push", "init", "set_optimizer", "adopt_key"):
                 return self._dispatch(sub, _repl=True)
+            if sc == "moved":
+                # the primary handed ``key`` away mid-split: mirror the
+                # release (ordered after that key's last forwarded push
+                # by the key lock), so a promotion of THIS backup still
+                # refuses the moved key with the right forward address
+                _, key, dst = sub
+                with self._lock_for(key):
+                    self._moved[key] = dst
+                    self._map_version += 1
+                    self._table.pop(key, None)
+                    self._clock.pop(key, None)
+                    for pair in [p for p in list(self._applied)
+                                 if p[1] == key]:
+                        self._applied.pop(pair, None)
+                return ("ok",)
+            if sc == "moved_map":
+                # catch-up bulk form: the whole forwarding table as the
+                # primary held it at transfer start (later splits ride
+                # as individual ``moved`` records after it)
+                _, moved, version = sub
+                for k, d in moved.items():
+                    self._moved[k] = d
+                self._map_version = max(self._map_version, int(version))
+                return ("ok",)
             if sc == "opt_states":
                 # accumulated updater state (momentum, update counts,
                 # live optimizer) — set_optimizer rode the stream
@@ -1414,7 +1761,12 @@ class ParameterServer:
                 return ("ok", {"epoch": self._membership_epoch,
                                "workers": len(self._workers),
                                "role": self._role,
-                               "backup": backup})
+                               "backup": backup,
+                               # the versioned shard map rides every
+                               # hello, so a (re)joining worker starts
+                               # with current routing
+                               "map_version": self._map_version,
+                               "moved": dict(self._moved)})
         if cmd == "bye":
             # clean departure: membership leaves NOW (no dead-after
             # wait) and the worker's dedupe seqs are reclaimed
@@ -1429,24 +1781,47 @@ class ParameterServer:
                 self._worker_rec(msg[1])
             self._gc_workers()
             return ("ok", {"pushes": self._stale_n,
-                           "keys": len(self._table)})
+                           "keys": len(self._table),
+                           # heartbeat half of map propagation: a bump
+                           # makes the client fetch the full shard_map
+                           "map_version": self._map_version})
         if cmd == "barrier":
             # optional deadline (seconds) after num_workers: a barrier
             # that cannot complete — a member died mid-epoch — degrades
-            # to a counted, logged timeout instead of hanging the fleet
+            # to a counted, logged timeout instead of hanging the fleet.
+            # num_workers of 0/None is the ELASTIC form: the target is
+            # the CURRENT membership, re-evaluated on every join/leave
+            # (the _notify_membership wakeups), so a departed worker
+            # releases the survivors by re-count, not by deadline.
             num_workers = msg[1]
+            dynamic = not num_workers
+
+            def _target():
+                if not dynamic:
+                    return num_workers
+                with self._workers_lock:
+                    return max(1, len(self._workers))
+
             deadline = None
             if len(msg) > 2 and msg[2]:
                 deadline = time.monotonic() + float(msg[2])
             with self._barrier_cv:
                 gen = self._barrier_gen
                 self._barrier_arrived += 1
-                if self._barrier_arrived >= num_workers:
+                if self._barrier_arrived >= _target():
                     self._barrier_arrived = 0
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                     return ("ok",)
                 while self._barrier_gen == gen:
+                    if dynamic and self._barrier_arrived >= _target():
+                        # membership shrank to (or below) the arrivals:
+                        # a re-count release, the healthy elastic path
+                        self._barrier_recounts += 1
+                        self._barrier_arrived = 0
+                        self._barrier_gen += 1
+                        self._barrier_cv.notify_all()
+                        return ("ok", "recount")
                     wait = 120.0
                     if deadline is not None:
                         wait = deadline - time.monotonic()
@@ -1455,14 +1830,14 @@ class ParameterServer:
                             # other waiter unblocks too (they would
                             # otherwise wait for a count that can no
                             # longer be reached)
+                            arrived = self._barrier_arrived
                             self._barrier_timeouts += 1
                             self._barrier_arrived = 0
                             self._barrier_gen += 1
                             self._barrier_cv.notify_all()
                             _log.warning(
                                 "barrier released by deadline with "
-                                "%d/%d arrivals", num_workers - 1,
-                                num_workers)
+                                "%d/%d arrivals", arrived, _target())
                             return ("ok", "timeout")
                     self._barrier_cv.wait(timeout=wait)
             return ("ok",)
@@ -1499,6 +1874,15 @@ class ParameterServer:
                            "workers": workers,
                            "membership_epoch": epoch,
                            "barrier_timeouts": self._barrier_timeouts,
+                           "barrier_recounts": self._barrier_recounts,
+                           "joins": self._joins,
+                           "leaves": self._leaves,
+                           "splits": self._splits,
+                           "keys_moved_out": self._keys_moved_out,
+                           "keys_adopted": self._keys_adopted,
+                           "map_version": self._map_version,
+                           "moved_keys": len(self._moved),
+                           "cursor_requeues": self._cursor_requeues,
                            "role": self._role,
                            "promotions": self._promotions,
                            "repl": repl,
@@ -1553,7 +1937,13 @@ class ParameterServer:
             meta = {"keys": keys, "clocks": clocks,
                     "applied": [[o, self._tag_key(k), int(s)]
                                 for (o, k), s in self._applied.items()],
-                    "push_count": int(self._push_count)}
+                    "push_count": int(self._push_count),
+                    # the forwarding table survives a restart: a
+                    # respawned server must keep refusing split-away
+                    # keys (map_stale), not 404 them
+                    "moved": [[self._tag_key(k), d]
+                              for k, d in self._moved.items()],
+                    "map_version": int(self._map_version)}
             extras = None
             if self._opt_payload is not None:
                 extras = {"optimizer": _np.frombuffer(
@@ -1580,6 +1970,9 @@ class ParameterServer:
             self._clock[key] = int(clock)
         self._applied = {(o, self._untag_key(k)): int(s)
                          for o, k, s in meta.get("applied", [])}
+        self._moved = {self._untag_key(k): d
+                       for k, d in meta.get("moved", [])}
+        self._map_version = int(meta.get("map_version", 0))
         self._push_count = int(meta.get("push_count", 0))
         self._snap_count = step
         self._restored_step = step
@@ -1662,6 +2055,27 @@ _STRAGGLER_FACTOR = float(os.environ.get(
     "MXTPU_PS_STRAGGLER_FACTOR", "2.0"))
 _STRAGGLER_MIN = int(os.environ.get("MXTPU_PS_STRAGGLER_MIN", "10"))
 
+# -- elasticity (module docstring, "Elasticity") -------------------------
+# MXTPU_PS_ELASTIC=1 makes barriers count against the server's CURRENT
+# membership — re-evaluated on every join/leave — instead of the
+# launch-time fleet size, so a departed worker releases the survivors by
+# re-count instead of stranding them until the barrier deadline
+_ELASTIC = os.environ.get("MXTPU_PS_ELASTIC", "0") != "0"
+# poll interval while the shard cursor waits on another worker's
+# outstanding shard (a straggler's assignment requeues on its death)
+_CURSOR_POLL = float(os.environ.get("MXTPU_PS_CURSOR_POLL", "0.2"))
+# map_stale forwarding bound: a client whose shard map is k versions
+# stale needs at most k hops to find a key's current home
+_MAP_HOPS = 4
+
+
+def _stale_dst(err):
+    """The new-home address out of a ``map_stale`` refusal, else None
+    (the refusal is a routing verdict: the command was NOT executed)."""
+    m = re.search(r"map_stale: key .+ moved to (\S+) \(map_version",
+                  str(err))
+    return m.group(1) if m else None
+
 # every command whose replay is harmless: pull/pull_rows/stats/ping read,
 # init is first-writer-wins, set_optimizer re-installs the same payload,
 # push dedupes via its (origin, seq) pair, and multi only ever carries
@@ -1670,10 +2084,15 @@ _STRAGGLER_MIN = int(os.environ.get("MXTPU_PS_STRAGGLER_MIN", "10"))
 # naturally idempotent, and a replayed join_backup just restarts the
 # catch-up on a fresh stream id. barrier is NOT here — a replayed
 # arrival would double-count this worker in the generation.
+# The elastic commands replay safely too: shard_map reads, cursor_next
+# dedupes on its rid (a retry gets the SAME shard back), cursor_done
+# marks into a set, adopt_key refuses clocks at or below its watermark,
+# and a replayed split only re-moves keys still local.
 _IDEMPOTENT = frozenset(
     ("init", "push", "pull", "pull_rows", "stats", "ping",
      "set_optimizer", "multi", "hello", "bye",
-     "repl", "promote", "peer_info", "join_backup"))
+     "repl", "promote", "peer_info", "join_backup",
+     "shard_map", "cursor_next", "cursor_done", "adopt_key", "split"))
 
 
 class _Pending:
@@ -1828,6 +2247,7 @@ class _ServerConn:
         self.state = "ok"
         self.failures = 0          # consecutive failures
         self.last_error = None
+        self.last_ping = {}        # last ping reply info (map_version)
         self._health_lock = threading.Lock()
         n_socks = max(1, n_socks if n_socks is not None
                       else _CONNS_PER_SERVER)
@@ -2063,9 +2483,12 @@ class _ServerConn:
                 return True
         try:
             if origin is not None:
-                self.request("ping", origin, timeout=timeout, retries=0)
+                reply = self.request("ping", origin, timeout=timeout,
+                                     retries=0)
             else:
-                self.request("ping", timeout=timeout, retries=0)
+                reply = self.request("ping", timeout=timeout, retries=0)
+            if len(reply) > 1 and isinstance(reply[1], dict):
+                self.last_ping = reply[1]
             return True
         except (ConnectionError, OSError):
             return False
@@ -2120,6 +2543,11 @@ class _ReplicatedConn:
     def n_socks(self):
         with self._lock:
             return self._conns[self._active_i].n_socks
+
+    @property
+    def last_ping(self):
+        with self._lock:
+            return getattr(self._conns[self._active_i], "last_ping", {})
 
     @property
     def state(self):
@@ -2288,6 +2716,7 @@ class AsyncDistKVStore(KVStore):
             "MXTPU_NUM_PROCS", os.environ.get("DMLC_NUM_WORKER", "1")))
         addrs = os.environ.get("MXTPU_PS_ADDRS", "")
         token = os.environ.get("MXTPU_PS_TOKEN") or None
+        self._token = token
         self._own_server = None
         if not addrs:
             # single-process: host the table in-process so the mode is
@@ -2302,8 +2731,9 @@ class AsyncDistKVStore(KVStore):
         # env, or learned at hello) behind a _ReplicatedConn facade
         # that fails over in place; unreplicated launches keep the
         # plain conn — zero new indirection on that path
-        if int(os.environ.get("MXTPU_PS_REPLICAS", "1")) > 1 \
-                or any(backup_list):
+        self._replicated = int(os.environ.get(
+            "MXTPU_PS_REPLICAS", "1")) > 1 or any(backup_list)
+        if self._replicated:
             self._conns = [
                 _ReplicatedConn(
                     a,
@@ -2319,6 +2749,12 @@ class AsyncDistKVStore(KVStore):
         self._base_clock = {}      # subkey -> clock of the last pull
         self._parts = {}           # key -> [(subkey, row_lo, row_hi), ...]
         self._shapes = {}          # key -> full array shape
+        # -- elasticity: versioned shard map (module docstring) --
+        self._key_overrides = {}   # wire key -> its current home addr
+        self._map_versions = {}    # server addr -> last-seen map_version
+        self._extra_conns = {}     # reshard-born server addr -> conn
+        self._extra_guard = threading.Lock()
+        self._cursor_rid = itertools.count(1)
         # -- fault-tolerance state (module docstring, "Fault tolerance") --
         # unique push origin: rank alone is not unique (tests run many
         # stores per process); the server dedupes replays per (origin,key)
@@ -2369,9 +2805,89 @@ class AsyncDistKVStore(KVStore):
     def _conn(self, key):
         # deterministic cross-process key->server assignment (builtin
         # hash() is salted per process; every worker must agree, like
-        # ps-lite's static key ranges)
+        # ps-lite's static key ranges) — unless an online reshard moved
+        # the key, in which case the learned override wins
+        dst = self._key_overrides.get(key)
+        if dst is not None:
+            return self._conn_for_addr(dst)
         digest = zlib.crc32(str(key).encode("utf-8"))
         return self._conns[digest % len(self._conns)]
+
+    def _conn_for_addr(self, addr):
+        """The conn serving ``addr``: one of the launch-time shards, or
+        a conn built lazily for a reshard-born server the shard map
+        pointed us at (greeted with hello, so membership and that
+        server's map are learned there too)."""
+        for c in self._conns:
+            if addr in getattr(c, "_addrs", ()) or c.addr == addr:
+                return c
+        with self._extra_guard:
+            conn = self._extra_conns.get(addr)
+        if conn is not None:
+            return conn
+        if self._replicated:
+            conn = _ReplicatedConn(addr, token=self._token,
+                                   stats=self._stats,
+                                   on_failover=self._on_shard_failover,
+                                   connect_timeout=_RECONNECT_TIMEOUT)
+        else:
+            conn = _ServerConn(addr, token=self._token,
+                               stats=self._stats,
+                               connect_timeout=_RECONNECT_TIMEOUT)
+        with self._extra_guard:
+            live = self._extra_conns.setdefault(addr, conn)
+        if live is not conn:   # raced another thread: one conn per addr
+            conn.close()
+        else:
+            self._register_workers([conn])
+        return live
+
+    def _routed_request(self, sk, *msg, **kw):
+        """One request that follows ``map_stale`` forwarding: a refusal
+        names the key's new home — record the override, greet the new
+        server, replay there (the transferred dedupe seqs keep push
+        replays at-most-once). Bounded hops: a client whose map is k
+        versions stale needs at most k."""
+        conn = self._conn(sk)
+        for _ in range(_MAP_HOPS):
+            try:
+                return conn.request(*msg, **kw)
+            except RuntimeError as e:
+                dst = _stale_dst(e)
+                if dst is None:
+                    raise
+                self._stats.add("map_reroutes")
+                self._key_overrides[sk] = dst
+                conn = self._conn_for_addr(dst)
+        raise RuntimeError(
+            "shard map for key %r still stale after %d hops"
+            % (sk, _MAP_HOPS))
+
+    def _learn_map(self, addr, info):
+        """Adopt a server's shard-map advertisement (hello / shard_map
+        replies): its map version, and forwarding overrides for every
+        key it handed away."""
+        v = info.get("map_version")
+        if v is not None:
+            self._map_versions[addr] = v
+        for k, dst in (info.get("moved") or {}).items():
+            if dst != addr:
+                self._key_overrides[k] = dst
+
+    def _refresh_map(self, conn):
+        """Heartbeat half of map propagation: when a probe reply
+        advertises a newer shard-map version, fetch the full map."""
+        info = getattr(conn, "last_ping", None) or {}
+        v = info.get("map_version")
+        if v is None or self._map_versions.get(conn.addr) == v:
+            return
+        try:
+            reply = conn.request("shard_map", retries=0, timeout=5.0)
+        except (ConnectionError, RuntimeError, OSError):
+            return
+        self._learn_map(conn.addr,
+                        {"map_version": reply[1].get("version"),
+                         "moved": reply[1].get("moved")})
 
     # -- part plumbing ----------------------------------------------------
     def _plan(self, k, shape):
@@ -2491,12 +3007,32 @@ class AsyncDistKVStore(KVStore):
                 for entry in chunk:
                     self._buffer_push(conn, *entry)
             elif isinstance(reply, Exception):
-                raise reply
+                if _stale_dst(reply) is None:
+                    raise reply
+                for entry in chunk:   # moved key: replay at its new home
+                    self._replay_moved_push(entry, reply)
             elif is_multi:         # surface the first sub-error
-                for sub in reply[1]:
-                    if sub[0] == "err":
+                for entry, sub in zip(chunk, reply[1]):
+                    if sub[0] != "err":
+                        continue
+                    if _stale_dst(sub[1]) is None:
                         raise RuntimeError(
                             "parameter server: %s" % sub[1])
+                    self._replay_moved_push(
+                        entry,
+                        RuntimeError("parameter server: %s" % sub[1]))
+
+    def _replay_moved_push(self, entry, err):
+        """A push refused with ``map_stale``: it was NOT applied — learn
+        the key's new home and replay there with the ORIGINAL seq, so a
+        push that raced the key's handoff lands exactly once (either the
+        pre-move apply transferred with the dedupe seqs, or it applies
+        fresh at the destination)."""
+        sk, payload, clock, seq = entry
+        self._stats.add("map_reroutes")
+        self._key_overrides[sk] = _stale_dst(err)
+        self._routed_request(sk, "push", sk, payload, clock,
+                             self._origin, seq)
 
     def push_async(self, key, value, priority=0):
         """Fire-and-track push: ships on the worker pool and returns a
@@ -2586,16 +3122,37 @@ class AsyncDistKVStore(KVStore):
         for (is_multi, chunk), reply in zip(groups, replies):
             if isinstance(reply, Exception):
                 for sk in chunk:
-                    out[sk] = self._degraded_value(sk, reply)
+                    if _stale_dst(reply) is not None:
+                        out[sk] = self._pull_moved(sk, reply)
+                    else:
+                        out[sk] = self._degraded_value(sk, reply)
                 continue
             subs = reply[1] if is_multi else [reply]
             for sk, sub in zip(chunk, subs):
                 if sub[0] == "err":
-                    out[sk] = self._degraded_value(
-                        sk, RuntimeError("parameter server: %s" % sub[1]))
+                    if _stale_dst(sub[1]) is not None:
+                        out[sk] = self._pull_moved(
+                            sk, RuntimeError(
+                                "parameter server: %s" % sub[1]))
+                    else:
+                        out[sk] = self._degraded_value(
+                            sk, RuntimeError(
+                                "parameter server: %s" % sub[1]))
                 else:
                     out[sk] = self._note_pulled(sk, sub[1], sub[2])
         return out
+
+    def _pull_moved(self, sk, err):
+        """A pull refused with ``map_stale``: follow the forward to the
+        key's new home; only if the new home is ALSO unreachable does
+        the usual degradation policy apply."""
+        self._stats.add("map_reroutes")
+        self._key_overrides[sk] = _stale_dst(err)
+        try:
+            reply = self._routed_request(sk, "pull", sk)
+        except (ConnectionError, RuntimeError) as e:
+            return self._degraded_value(sk, e)
+        return self._note_pulled(sk, reply[1], reply[2])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None
@@ -2663,8 +3220,8 @@ class AsyncDistKVStore(KVStore):
                 ids = rid_np[(rid_np >= lo) & (rid_np < hi)]
                 if ids.size == 0:
                     return None
-                _, rows, clock = self._conn(sk).request(
-                    "pull_rows", sk, (ids - lo))
+                _, rows, clock = self._routed_request(
+                    sk, "pull_rows", sk, (ids - lo))
                 self._base_clock[sk] = clock
                 return rows
 
@@ -2729,19 +3286,58 @@ class AsyncDistKVStore(KVStore):
         (``MXTPU_PS_BARRIER_TIMEOUT``): when a member died mid-epoch the
         server force-releases the generation and this returns — logged
         and counted in ``stats()['barrier_timeouts']`` — instead of
-        hanging every surviving worker forever."""
+        hanging every surviving worker forever. In elastic mode
+        (``MXTPU_PS_ELASTIC=1``) the target is the server's CURRENT
+        membership, re-counted on every join/leave — a departed worker
+        releases the survivors by re-count
+        (``stats()['barrier_recounts']``), not by deadline."""
         super().barrier()
         # the socket deadline must outlive the server-side one, or the
         # RPC layer would tear the channel down before the degraded
         # release can arrive
+        fleet = 0 if _ELASTIC else self._size
         reply = self._conns[0].request(
-            "barrier", self._size, _BARRIER_TIMEOUT,
+            "barrier", fleet, _BARRIER_TIMEOUT,
             timeout=_BARRIER_TIMEOUT + 30.0)
         if len(reply) > 1 and reply[1] == "timeout":
             _log.warning(
                 "barrier degraded: released by the %gs deadline with "
                 "members missing (see kv.stats()['barrier_timeouts'])",
                 _BARRIER_TIMEOUT)
+
+    # -- elastic data sharding --------------------------------------------
+    def shard_cursor(self, epoch, num_shards, poll=None):
+        """Iterate this worker's share of an epoch's ``num_shards`` data
+        shards from the SERVER-owned cursor (server 0 is the authority):
+        each shard index is handed out exactly once per epoch across the
+        whole fleet — however many workers exist, join, or leave while
+        the epoch runs — and a dead/departed worker's unfinished shards
+        are re-queued for the survivors. The elastic replacement for
+        static ``part_index``/``num_parts`` iterator slicing: a joining
+        worker calls this and immediately takes work, no relaunch.
+
+        Yields shard indices; a shard is acknowledged as done when the
+        loop body finishes (advances past the yield). Workers that find
+        the epoch exhausted but unfinished poll every ``poll`` seconds
+        (``MXTPU_PS_CURSOR_POLL``) for re-queued work until every shard
+        is acknowledged."""
+        poll = _CURSOR_POLL if poll is None else float(poll)
+        while True:
+            reply = self._conns[0].request(
+                "cursor_next", self._origin, int(epoch),
+                int(num_shards), next(self._cursor_rid))
+            shard, pending = reply[1], reply[2]
+            if shard is None:
+                if pending <= 0:
+                    return
+                # another worker still owns shards: poll — its death
+                # re-queues them (worker-liveness GC / bye), its
+                # completion ends the epoch
+                time.sleep(poll)
+                continue
+            yield shard
+            self._conns[0].request(
+                "cursor_done", self._origin, int(epoch), shard)
 
     # -- worker registration ----------------------------------------------
     def _register_workers(self, conns):
@@ -2750,10 +3346,14 @@ class AsyncDistKVStore(KVStore):
         way, which is how the fleet learns the seat is filled again."""
         for c in conns:
             try:
-                c.request("hello", self._origin, self._rank, retries=0,
-                          timeout=5.0)
+                reply = c.request("hello", self._origin, self._rank,
+                                  retries=0, timeout=5.0)
             except (ConnectionError, RuntimeError, OSError):
-                pass
+                continue
+            if len(reply) > 1 and isinstance(reply[1], dict):
+                # the hello reply carries the versioned shard map: a
+                # (re)joining worker starts with current routing
+                self._learn_map(c.addr, reply[1])
 
     def _on_shard_failover(self, conn):
         """A shard just failed over to its promoted backup: re-announce
@@ -2779,11 +3379,14 @@ class AsyncDistKVStore(KVStore):
         that just came back (a respawned shard restored its table but
         not the ephemeral membership), and flush buffered pushes to any
         server that answers."""
-        for conn in self._conns:
+        with self._extra_guard:
+            extra = list(self._extra_conns.values())
+        for conn in list(self._conns) + extra:
             was_dead = conn.state == "dead"
             if conn.ping(timeout=timeout, origin=self._origin):
                 if was_dead:
                     self._register_workers([conn])
+                self._refresh_map(conn)
                 with self._pending_lock:
                     has_pending = bool(self._pending.get(conn))
                 if has_pending:
@@ -2799,8 +3402,11 @@ class AsyncDistKVStore(KVStore):
             items = self._pending.pop(conn, [])
         for n, (sk, payload, clock, seq) in enumerate(items):
             try:
-                conn.request("push", sk, payload, clock,
-                             self._origin, seq)
+                # routed: the key may have moved while its shard was
+                # down (a reshard away from the dying server is the
+                # textbook drill) — the replay follows the map
+                self._routed_request(sk, "push", sk, payload, clock,
+                                     self._origin, seq)
             except ConnectionError:
                 with self._pending_lock:   # died again: keep the rest
                     self._pending[conn] = items[n:] \
@@ -2849,10 +3455,12 @@ class AsyncDistKVStore(KVStore):
         return out
 
     def _server_stats_sweep(self):
-        """One 'stats' round trip per reachable server (dead shards are
-        skipped, not waited on)."""
+        """One 'stats' round trip per reachable server — reshard-born
+        servers included — (dead shards are skipped, not waited on)."""
         out = []
-        for c in self._conns:
+        with self._extra_guard:
+            extra = list(self._extra_conns.values())
+        for c in list(self._conns) + extra:
             if c.state == "dead":
                 continue
             try:
@@ -2872,11 +3480,16 @@ class AsyncDistKVStore(KVStore):
         against the leader (push-count based — deterministic under the
         fault matrix, no wall clock)."""
         workers = {}
-        epoch = 0
+        epochs = {}
         barrier_timeouts = 0
+        barrier_recounts = 0
         for srv in sweeps:
-            epoch = max(epoch, srv.get("membership_epoch", 0))
+            # per-server: the epoch counters are INDEPENDENT — a
+            # cross-server max would mix unrelated counters into one
+            # meaningless number
+            epochs[srv.get("addr")] = srv.get("membership_epoch", 0)
             barrier_timeouts += srv.get("barrier_timeouts", 0)
+            barrier_recounts += srv.get("barrier_recounts", 0)
             for o, w in (srv.get("workers") or {}).items():
                 agg = workers.setdefault(
                     o, {"rank": w.get("rank"), "pushes": 0,
@@ -2895,9 +3508,31 @@ class AsyncDistKVStore(KVStore):
                 stragglers = sorted(
                     o for o, w in workers.items()
                     if w["pushes"] * _STRAGGLER_FACTOR < lead)
+        elastic = {
+            # every worker registers with EVERY server, so fleet-wide
+            # join/leave event counts are the busiest server's number,
+            # not a sum; split/move/cursor events are per-server
+            # disjoint and DO sum
+            "joins": max((s.get("joins", 0) for s in sweeps),
+                         default=0),
+            "leaves": max((s.get("leaves", 0) for s in sweeps),
+                          default=0),
+            "splits": sum(s.get("splits", 0) for s in sweeps),
+            "keys_moved": sum(s.get("keys_moved_out", 0)
+                              for s in sweeps),
+            "keys_adopted": sum(s.get("keys_adopted", 0)
+                                for s in sweeps),
+            "cursor_requeues": sum(s.get("cursor_requeues", 0)
+                                   for s in sweeps),
+            "map_versions": {s.get("addr"): s.get("map_version", 0)
+                             for s in sweeps},
+        }
         return {"workers": workers, "stragglers": stragglers,
-                "membership_epoch": epoch,
-                "barrier_timeouts": barrier_timeouts}
+                "membership_epochs": epochs,
+                "membership_churn": any(e > 0 for e in epochs.values()),
+                "barrier_timeouts": barrier_timeouts,
+                "barrier_recounts": barrier_recounts,
+                "elastic": elastic}
 
     def add_stats_source(self, name, fn):
         """Merge a caller-side counter source into ``stats()`` under
@@ -2954,7 +3589,9 @@ class AsyncDistKVStore(KVStore):
         agg = {"staleness_max": 0, "staleness_avg": 0.0, "pushes": 0,
                "clocks": {}}
         total_w = 0.0
-        for c in self._conns:
+        with self._extra_guard:
+            extra = list(self._extra_conns.values())
+        for c in list(self._conns) + extra:
             _, s = c.request("stats")
             agg["staleness_max"] = max(agg["staleness_max"],
                                        s["staleness_max"])
@@ -2973,19 +3610,62 @@ class AsyncDistKVStore(KVStore):
         self._pool.shutdown(wait=True)
         # clean departure: servers drop this worker's membership and
         # reclaim its dedupe seqs NOW instead of waiting out the
-        # MXTPU_PS_WORKER_DEAD_AFTER silence window
-        for c in self._conns:
+        # MXTPU_PS_WORKER_DEAD_AFTER silence window (and a dynamic
+        # barrier re-counts immediately)
+        with self._extra_guard:
+            extra = list(self._extra_conns.values())
+            self._extra_conns = {}
+        for c in list(self._conns) + extra:
             if c.state != "dead":
                 try:
                     c.request("bye", self._origin, retries=0, timeout=2.0)
                 except (ConnectionError, RuntimeError, OSError):
                     pass
-        for c in self._conns:
+        for c in list(self._conns) + extra:
             c.close()
         if self._own_server is not None:
             self._own_server.stop()
             self._own_server = None
 
 
+def _admin_main(argv):
+    """Operator one-shots against a running launch (the shared secret
+    comes from ``MXTPU_PS_TOKEN`` in the environment, exactly as the
+    launcher exports it):
+
+    * ``--admin split --src host:port --dst host:port [--keys a,b]`` —
+      hand half (or exactly ``--keys``) of src's keys to dst online;
+    * ``--admin stats --src host:port`` — one server's stats as JSON.
+
+    ``tools/launch.py --scale`` drives the split drill through this.
+    """
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(prog="mxtpu.kvstore_async")
+    ap.add_argument("--admin", choices=("split", "stats"),
+                    required=True)
+    ap.add_argument("--src", required=True)
+    ap.add_argument("--dst", default=None)
+    ap.add_argument("--keys", default=None)
+    a = ap.parse_args(argv)
+    conn = _ServerConn(a.src,
+                       token=os.environ.get("MXTPU_PS_TOKEN") or None,
+                       n_socks=1, connect_timeout=30.0)
+    try:
+        if a.admin == "split":
+            if not a.dst:
+                ap.error("--admin split requires --dst")
+            keys = [k for k in (a.keys or "").split(",") if k] or None
+            reply = conn.request("split", a.dst, keys)
+        else:
+            reply = conn.request("stats")
+        print(json.dumps(reply[1], default=str))
+    finally:
+        conn.close()
+    return 0
+
+
 if __name__ == "__main__":
+    if "--admin" in sys.argv:
+        sys.exit(_admin_main(sys.argv[1:]))
     serve_forever()

@@ -939,6 +939,40 @@ class Updater:
         return pickle.dumps((states, self.optimizer) if dump_optimizer
                             else states)
 
+    def get_state_one(self, index):
+        """Pickled numpy form of ONE index's state (None when the slot
+        was never materialized) — the per-key slice of :meth:`get_states`
+        for online shard handoff: moving a key between kvstore servers
+        must carry its accumulated momentum/update-count state, and only
+        its state (a whole-dict transfer would clobber the receiver's
+        other keys)."""
+        if index not in self.states:
+            return None
+
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return type(s)(to_np(x) for x in s)
+            return s
+
+        return pickle.dumps(to_np(self.states[index]))
+
+    def set_state_one(self, index, payload):
+        """Install one index's state from :meth:`get_state_one` output;
+        re-synced to the weight's context lazily on next use, exactly
+        like a :meth:`set_states` restore."""
+        def from_np(s):
+            import numpy as _np
+            if isinstance(s, _np.ndarray):
+                return nd.array(s)
+            if isinstance(s, (tuple, list)):
+                return type(s)(from_np(x) for x in s)
+            return s
+
+        self.states[index] = from_np(pickle.loads(bytes(payload)))
+        self.states_synced[index] = False
+
 
 def get_updater(optimizer):
     """Wrap an optimizer as an updater closure (reference optimizer.py:1566)."""

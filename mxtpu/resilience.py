@@ -149,10 +149,20 @@ class TrainGuard:
         self._c = {"steps": 0, "good_steps": 0, "skipped": 0,
                    "skipped_nonfinite": 0, "spikes": 0, "lr_halvings": 0,
                    "rollbacks": 0, "restores": 0, "host_syncs": 0,
-                   "last_ckpt_step": None}
+                   "elastic_signals": 0, "last_ckpt_step": None}
+        self._elastic_cb = None
         trainer.set_guard(True)
 
     # -- wiring ------------------------------------------------------------
+    def set_elastic_callback(self, fn):
+        """Register a handler for the fault harness's elasticity signal
+        kinds (``join_worker``/``leave_worker``/``split_shard``): when a
+        schedule fires one at this guard's ``worker.step`` point, ``fn``
+        is called with the kind name BEFORE the step runs, so a scale
+        drill (spawn a worker, depart one, split a key shard) lands on
+        an exact, replayable step count. Without a handler the signals
+        are counted in ``stats()['elastic_signals']`` and ignored."""
+        self._elastic_cb = fn
     def attach_kvstore(self, kv, max_inflight=2):
         """Wire gradient pushes to a kvstore — the guarded flavor of
         ``ShardedTrainer.attach_kvstore``: pushes ship only after this
@@ -171,6 +181,10 @@ class TrainGuard:
         act = _fault.fire("worker.step", op="step")
         if act == "nan_grad":
             data = _poison(data)
+        elif act in ("join_worker", "leave_worker", "split_shard"):
+            self._c["elastic_signals"] += 1
+            if self._elastic_cb is not None:
+                self._elastic_cb(act)
         tr = self._trainer
         tr.step_async(data, label)
         # THE host read of the guarded loop: one packed vector carries

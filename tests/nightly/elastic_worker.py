@@ -1,0 +1,157 @@
+"""Elastic scale-out worker for the E2E drill (ISSUE 7).
+
+Launched by tools/launch.py -n 1 -s 2 with MXTPU_PS_ELASTIC=1 and a
+--scale schedule that, mid-run, ADDS a worker (MXTPU_ELASTIC_JOINER=1),
+SPLITS server 0's hot keys onto a freshly spawned server, and REMOVES
+the added worker again (SIGTERM = clean departure).
+
+The training problem is async_worker.py's least-squares SGD, widened to
+six independent keys so the split has a population to halve. The crucial
+structural difference from every earlier nightly: NOTHING here slices
+data by rank/size. All data flow comes from the server-owned shard
+cursor — ``kv.shard_cursor(epoch, NUM_SHARDS)`` — so however many
+workers exist at any instant, each (epoch, shard, batch) is processed by
+exactly one CLEANLY-finishing worker, and the batch content is a pure
+function of (epoch, shard, batch). That makes the fleet-wide work total
+exact: every key's server-side clock must end at EPOCHS x SHARDS x
+BATCHES regardless of joins, leaves, splits, or map_stale reroutes —
+the zero-acknowledged-update-loss + exactly-once invariant in one
+integer.
+
+Rank 0 is the anchor: it inits keys, installs the server-side optimizer,
+writes the progress file the --scale schedule triggers on, and at the
+end asserts the invariants and writes summary.json. A joiner pulls
+current params (no init, no static barrier) and simply starts taking
+shards. SIGTERM sets a flag checked between shards: the current shard is
+finished and acknowledged before the bye, so clean departure never
+inflates the work total.
+"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx                                           # noqa: E402
+
+rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+joiner = os.environ.get("MXTPU_ELASTIC_JOINER", "0") == "1"
+out_dir = os.environ["ELASTIC_TEST_DIR"]
+progress_file = os.environ.get("ELASTIC_PROGRESS_FILE")
+
+EPOCHS = int(os.environ.get("ELASTIC_EPOCHS", "3"))
+SHARDS = int(os.environ.get("ELASTIC_SHARDS", "6"))
+BATCHES = int(os.environ.get("ELASTIC_BATCHES", "4"))
+# per-batch throttle so a --scale drill's wall-clock events land while
+# training is still running (0 = flat out; the work TOTAL is identical
+# either way, which is the whole point of the cursor)
+BATCH_SLEEP = float(os.environ.get("ELASTIC_BATCH_SLEEP", "0"))
+KEYS = ["w%d" % i for i in range(6)]      # w0..w3 -> server 0 (split
+#                                           source), w4..w5 -> server 1
+DIM = 4
+
+# every batch is a pure function of its coordinates: whichever worker
+# draws (epoch, shard, batch) computes the identical X
+WT = {k: np.random.RandomState(500 + i).uniform(-2, 2, DIM)
+         .astype(np.float32) for i, k in enumerate(KEYS)}
+
+
+def batch_x(epoch, shard, b):
+    rs = np.random.RandomState(100000 + epoch * 1009 + shard * 53 + b)
+    return rs.standard_normal((32, DIM)).astype(np.float32)
+
+
+stop = {"flag": False}
+signal.signal(signal.SIGTERM,
+              lambda *_: stop.__setitem__("flag", True))
+
+kv = mx.kv.create("dist_async")
+
+if not joiner:
+    kv.init(KEYS, [mx.nd.zeros((DIM,)) for _ in KEYS])
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.05))
+else:
+    # the join contract: hello already registered us and taught us the
+    # shard map; wait for the anchor's init, pull current params, go
+    import time
+    probe = mx.nd.zeros((DIM,))
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            kv.pull(KEYS[0], out=probe)
+            break
+        except (RuntimeError, ConnectionError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+    print("worker %d joined mid-run (params pulled)" % rank, flush=True)
+
+done_batches = 0
+
+
+def note_progress():
+    if progress_file and rank == 0:
+        with open(progress_file + ".tmp", "w") as f:
+            f.write(str(done_batches))
+        os.replace(progress_file + ".tmp", progress_file)
+
+
+w = {k: mx.nd.zeros((DIM,)) for k in KEYS}
+for epoch in range(EPOCHS):
+    if stop["flag"]:
+        break
+    for shard in kv.shard_cursor(epoch, SHARDS):
+        for b in range(BATCHES):
+            X = batch_x(epoch, shard, b)
+            for k in KEYS:
+                kv.pull(k, out=w[k])
+                wn = w[k].asnumpy()
+                g = 2 * X.T @ (X @ wn - X @ WT[k]) / len(X)
+                kv.push(k, mx.nd.array(g))
+            done_batches += 1
+            note_progress()
+            if BATCH_SLEEP:
+                import time
+                time.sleep(BATCH_SLEEP)
+        # the shard is acknowledged when the generator resumes; only
+        # AFTER that may a clean departure leave
+    if stop["flag"]:
+        break
+
+if rank == 0:
+    # everyone else drains (or has departed): the elastic barrier
+    # counts the CURRENT membership, so nobody waits on a ghost
+    kv.barrier()
+    st = kv.stats()
+    clocks = kv.staleness_stats()["clocks"]
+    want = EPOCHS * SHARDS * BATCHES
+    assert set(clocks) == set(KEYS), clocks
+    bad = {k: v for k, v in clocks.items() if v != want}
+    assert not bad, "work total broken (want %d everywhere): %r" \
+        % (want, bad)
+    final_err = 0.0
+    for k in KEYS:
+        kv.pull(k, out=w[k])
+        final_err = max(final_err,
+                        float(np.abs(w[k].asnumpy() - WT[k]).max()))
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump({"final_err": final_err,
+                   "clocks": {k: int(v) for k, v in clocks.items()},
+                   "elastic": st["elastic"],
+                   "map_reroutes": st["map_reroutes"],
+                   "membership_epochs": st["membership_epochs"],
+                   "barrier_recounts": st["barrier_recounts"],
+                   "barrier_timeouts": st["barrier_timeouts"]}, f)
+elif not stop["flag"]:
+    # a worker finishing naturally drains with the fleet; a REMOVED
+    # worker skips the barrier — its bye is the departure, and the
+    # elastic barrier re-counts the survivors without it
+    kv.barrier()
+
+kv.close()
+print("RANK_%d_OK" % rank, flush=True)

@@ -1,0 +1,685 @@
+"""Elasticity matrix (ISSUE 7): workers join/leave mid-run, hot key
+shards split across servers online (mxtpu/kvstore_async.py module
+docstring "Elasticity", docs/fault_tolerance.md "Elasticity").
+
+Every row is deterministic: servers are loopback threads in this
+process, scale events land on exact request/step schedules (the fault
+harness's signal kinds, or direct commands), and the only polls are
+bounded condition waits. The matrix:
+
+scenario                          -> invariant proven
+---------------------------------------------------------------------
+server-owned shard cursor          -> each shard assigned exactly once
+                                      per epoch across N workers;
+                                      replayed assignment requests are
+                                      deduped (same shard back)
+worker leaves with work in hand    -> its outstanding shards requeue to
+                                      the survivors (at-least-once)
+barrier during a join              -> dynamic target grows; the barrier
+                                      completes when the NEW fleet
+                                      arrives (no timeout)
+barrier during a leave             -> released by RE-COUNT against the
+                                      shrunk membership, not by the
+                                      MXTPU_PS_BARRIER_TIMEOUT deadline
+online shard split                 -> value/clock/dedupe-seqs/updater
+                                      state move atomically; optimizer
+                                      trajectory continues bit-for-bit
+push to a moved key                -> map_stale -> reroute -> replay
+                                      lands EXACTLY once (dedupe seqs
+                                      travelled with the key)
+fresh worker after a split         -> learns the map at hello, routes
+                                      straight to the new home
+split aborted mid-transfer         -> clean prefix moved, rest owned,
+                                      re-issued split resumes; nothing
+                                      acked lost
+src primary killed mid-split       -> promoted backup knows the moved
+                                      prefix (map_stale forwards) and
+                                      owns the rest; zero acked loss
+replicated destination             -> the new shard's backup holds each
+                                      key BEFORE the old primary
+                                      releases it
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import fault
+from mxtpu import kvstore_async as ka
+from mxtpu.kvstore_async import ParameterServer
+
+
+@pytest.fixture(autouse=True)
+def _fast_failure_knobs(monkeypatch):
+    """Same discipline as the fault matrix: tiny retry/backoff windows,
+    heartbeat thread off, wire transport pinned on, elastic barriers
+    on, clean injector."""
+    monkeypatch.setattr(ka, "_RETRIES", 2)
+    monkeypatch.setattr(ka, "_BACKOFF", 0.01)
+    monkeypatch.setattr(ka, "_BACKOFF_MAX", 0.05)
+    monkeypatch.setattr(ka, "_RECONNECT_TIMEOUT", 0.2)
+    monkeypatch.setattr(ka, "_DEAD_AFTER", 2)
+    monkeypatch.setattr(ka, "_ELASTIC", True)
+    monkeypatch.setattr(ka, "_CURSOR_POLL", 0.01)
+    monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0")
+    monkeypatch.setattr(ka, "_LOCAL_ON", False)
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+def _store(monkeypatch, addrs, rank=0, nproc=1):
+    monkeypatch.setenv("MXTPU_PS_ADDRS", addrs)
+    monkeypatch.setenv("MXTPU_PROC_ID", str(rank))
+    monkeypatch.setenv("MXTPU_NUM_PROCS", str(nproc))
+    return mx.kv.create("dist_async")
+
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+# ---------------------------------------------------------------------------
+# the server-owned data cursor
+# ---------------------------------------------------------------------------
+
+def test_cursor_assigns_each_shard_exactly_once(monkeypatch):
+    """Two workers drain one epoch concurrently: the union of their
+    assignments is every shard, the intersection is empty — dynamic
+    work division with no static rank/size slicing anywhere."""
+    srv = ParameterServer().start()
+    a = _store(monkeypatch, srv.address)
+    b = _store(monkeypatch, srv.address)
+    try:
+        got = {"a": [], "b": []}
+
+        def drain(name, kv):
+            for shard in kv.shard_cursor(7, 12):
+                got[name].append(shard)
+
+        ta = threading.Thread(target=drain, args=("a", a))
+        tb = threading.Thread(target=drain, args=("b", b))
+        ta.start(); tb.start()
+        ta.join(timeout=10); tb.join(timeout=10)
+        assert not ta.is_alive() and not tb.is_alive()
+        assert sorted(got["a"] + got["b"]) == list(range(12))
+        assert not set(got["a"]) & set(got["b"])
+    finally:
+        a.close()
+        b.close()
+        srv.stop()
+
+
+def test_cursor_replayed_request_gets_same_shard(monkeypatch):
+    """The at-most-once story for assignments: a retried cursor_next
+    (lost ack) returns the SAME shard, not a second one — the rid is
+    the dedupe watermark."""
+    srv = ParameterServer().start()
+    conn = ka._ServerConn(srv.address)
+    try:
+        r1 = conn.request("cursor_next", "w1", 0, 4, 1)
+        r1b = conn.request("cursor_next", "w1", 0, 4, 1)   # replay
+        assert r1[1] == r1b[1] == 0
+        r2 = conn.request("cursor_next", "w1", 0, 4, 2)    # fresh rid
+        assert r2[1] == 1
+    finally:
+        conn.close()
+        srv.stop()
+
+
+def test_cursor_requeues_a_leavers_shards(monkeypatch):
+    """A worker departs (bye) holding assignments: they go back on the
+    queue and a survivor picks them up; the epoch still completes with
+    every shard done exactly once by SOMEONE."""
+    srv = ParameterServer().start()
+    conn = ka._ServerConn(srv.address)
+    kv = _store(monkeypatch, srv.address)
+    try:
+        conn.request("hello", "leaver", 1)
+        # the leaver takes shards 0 and 1 and vanishes without done
+        assert conn.request("cursor_next", "leaver", 0, 3, 1)[1] == 0
+        assert conn.request("cursor_next", "leaver", 0, 3, 2)[1] == 1
+        conn.request("bye", "leaver")
+        got = list(kv.shard_cursor(0, 3))
+        assert sorted(got) == [0, 1, 2]
+        _, s = conn.request("stats")
+        assert s["cursor_requeues"] == 2
+        assert s["leaves"] == 1
+    finally:
+        conn.close()
+        kv.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# dynamic barriers: join/leave while waiting
+# ---------------------------------------------------------------------------
+
+def test_barrier_completes_when_fleet_grows_mid_wait(monkeypatch):
+    """Barrier-during-join: A waits at a dynamic barrier against a
+    2-member fleet; worker C JOINS mid-wait (target grows to 3), then
+    the other two arrive — the barrier releases only when the grown
+    fleet is complete, by arrivals, never by deadline."""
+    monkeypatch.setattr(ka, "_BARRIER_TIMEOUT", 30)
+    srv = ParameterServer().start()
+    a = _store(monkeypatch, srv.address)
+    bconn = ka._ServerConn(srv.address)
+    bconn.request("hello", "worker-b", 1)      # 2nd member, not arrived
+    done = {"a": False}
+
+    def wait_a():
+        a.barrier()
+        done["a"] = True
+
+    t = threading.Thread(target=wait_a, daemon=True)
+    c = None
+    try:
+        t.start()
+        _wait_for(lambda: srv._barrier_arrived == 1,
+                  what="A's barrier arrival")
+        assert not done["a"]
+        c = _store(monkeypatch, srv.address)   # join mid-wait: target 3
+        tb = threading.Thread(
+            target=lambda: bconn.request("barrier", 0, 30,
+                                         timeout=40.0), daemon=True)
+        tb.start()
+        _wait_for(lambda: srv._barrier_arrived == 2,
+                  what="B's barrier arrival")
+        assert not done["a"], "released before the joined fleet arrived"
+        c.barrier()                            # 3/3: release
+        t.join(timeout=5)
+        tb.join(timeout=5)
+        assert done["a"]
+        assert srv._barrier_timeouts == 0
+        assert srv._barrier_recounts == 0      # completed by arrivals
+    finally:
+        t.join(timeout=5)
+        bconn.close()
+        a.close()
+        if c is not None:
+            c.close()
+        srv.stop()
+
+
+def test_barrier_recounts_when_member_leaves_mid_wait(monkeypatch):
+    """Barrier-during-leave (the ISSUE's re-count requirement): A and B
+    are members; A waits; B departs WITHOUT arriving. The barrier
+    releases by re-count against the shrunk membership — counted in
+    barrier_recounts, NOT in barrier_timeouts, and long before the
+    deadline."""
+    monkeypatch.setattr(ka, "_BARRIER_TIMEOUT", 60)
+    srv = ParameterServer().start()
+    a = _store(monkeypatch, srv.address)
+    b = _store(monkeypatch, srv.address)
+    done = {"a": False}
+
+    def wait_a():
+        a.barrier()
+        done["a"] = True
+
+    t = threading.Thread(target=wait_a, daemon=True)
+    try:
+        t.start()
+        _wait_for(lambda: srv._barrier_arrived == 1,
+                  what="A's barrier arrival")
+        assert not done["a"]
+        t0 = time.monotonic()
+        b.close()          # clean leave: bye drops membership
+        t.join(timeout=10)
+        assert done["a"], "barrier never released on the leave"
+        assert time.monotonic() - t0 < 5, "released by deadline, not " \
+                                          "by re-count"
+        assert srv._barrier_recounts == 1
+        assert srv._barrier_timeouts == 0
+        assert a.stats()["barrier_recounts"] == 1
+    finally:
+        t.join(timeout=5)
+        a.close()
+        srv.stop()
+
+
+def test_dead_worker_gc_releases_barrier(monkeypatch):
+    """The crash flavor of the leave row: a worker that vanishes
+    without a bye is lease-GC'd (MXTPU_PS_WORKER_DEAD_AFTER) and the
+    GC itself re-counts the barrier."""
+    monkeypatch.setattr(ka, "_BARRIER_TIMEOUT", 60)
+    monkeypatch.setattr(ka, "_WORKER_DEAD_AFTER", 0.05)
+    srv = ParameterServer().start()
+    a = _store(monkeypatch, srv.address)
+    conn = ka._ServerConn(srv.address)
+    done = {"a": False}
+
+    def wait_a():
+        a.barrier()
+        done["a"] = True
+
+    t = threading.Thread(target=wait_a, daemon=True)
+    try:
+        conn.request("hello", "ghost", 1)    # second member, never byes
+        t.start()
+        _wait_for(lambda: srv._barrier_arrived == 1,
+                  what="A's barrier arrival")
+        time.sleep(0.08)                     # leases expire (the parked
+        #                                      waiter A's too — it is
+        #                                      silent while it waits)
+        assert srv._gc_workers() >= 1        # the sweep reaps the ghost
+        t.join(timeout=10)
+        assert done["a"], "GC did not release the barrier"
+        assert srv._barrier_recounts == 1
+        assert srv._barrier_timeouts == 0
+    finally:
+        t.join(timeout=5)
+        conn.close()
+        a.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# online shard split
+# ---------------------------------------------------------------------------
+
+def _split_world(monkeypatch, n_keys=6, optimizer=False):
+    """Two launch-time servers + one fresh (reshard-target) server and
+    a store with n_keys initialized and pushed once."""
+    s0 = ParameterServer().start()
+    s1 = ParameterServer().start()
+    dst = ParameterServer().start()
+    kv = _store(monkeypatch, "%s,%s" % (s0.address, s1.address))
+    keys = ["k%d" % i for i in range(n_keys)]
+    kv.init(keys, [mx.nd.zeros((4,)) for _ in keys])
+    if optimizer:
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1,
+                                          momentum=0.9))
+    return s0, s1, dst, kv, keys
+
+
+def test_split_moves_keys_and_routes_exactly_once(monkeypatch):
+    """The core handoff: half of s0's keys move to a fresh server with
+    value+clock+dedupe seqs; subsequent pushes hit map_stale, reroute,
+    and land exactly once (clock arithmetic is exact across the whole
+    fleet)."""
+    s0, s1, dst, kv, keys = _split_world(monkeypatch)
+    conn = ka._ServerConn(s0.address)
+    try:
+        for k in keys:
+            kv.push(k, mx.nd.ones((4,)))
+        before = dict(s0._clock)
+        assert before, "s0 owns no keys — pick different key names"
+        reply = conn.request("split", dst.address)
+        moved = reply[1]["moved"]
+        assert moved and len(moved) == (len(before) + 1) // 2
+        for k in moved:
+            assert k not in s0._table
+            assert s0._moved[k] == dst.address
+            assert dst._clock[k] == 1          # clock travelled
+        # pushes after the split: moved keys reroute via map_stale
+        for k in keys:
+            kv.push(k, mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        for k in keys:
+            kv.pull(k, out=out)
+            np.testing.assert_allclose(out.asnumpy(), 2 * np.ones(4),
+                                       err_msg=str(k))
+        st = kv.stats()
+        assert st["map_reroutes"] >= len(moved)
+        assert st["elastic"]["splits"] == 1
+        assert st["elastic"]["keys_moved"] == len(moved)
+        assert st["elastic"]["keys_adopted"] == len(moved)
+        # fleet-wide table integrity: every key applied exactly twice
+        clocks = kv.staleness_stats()["clocks"]
+        assert set(clocks) == set(keys)
+        assert all(v == 2 for v in clocks.values()), clocks
+    finally:
+        conn.close()
+        kv.close()
+        s0.stop(); s1.stop(); dst.stop()
+
+
+def test_split_replays_are_deduped_exactly_once(monkeypatch):
+    """The satellite row verbatim: a client pushing to a moved key gets
+    map_stale -> refetches the map -> replays — and a RE-replay of the
+    same (origin, seq) at the new home is refused as a dup, because the
+    dedupe seqs travelled with the key."""
+    s0, s1, dst, kv, keys = _split_world(monkeypatch)
+    conn = ka._ServerConn(s0.address)
+    try:
+        for k in keys:
+            kv.push(k, mx.nd.ones((4,)))
+        moved = conn.request("split", dst.address)[1]["moved"]
+        k = moved[0]
+        # a push that still believes in the old map
+        seq = next(kv._seq)
+        with pytest.raises(RuntimeError, match="map_stale"):
+            conn.request("push", k, np.ones(4, "f"), 0,
+                         kv._origin, seq)
+        # the client-side replay path: reroute + replay
+        kv._replay_moved_push(
+            (k, np.ones(4, "f"), 0, seq),
+            RuntimeError("parameter server: map_stale: key %r moved "
+                         "to %s (map_version 1)" % (k, dst.address)))
+        assert dst._clock[k] == 2
+        # replaying the SAME seq again (retry after a lost ack) is a dup
+        dconn = ka._ServerConn(dst.address)
+        assert dconn.request("push", k, np.ones(4, "f"), 0,
+                             kv._origin, seq)[1] == "dup"
+        assert dst._clock[k] == 2
+        # and the PRE-split seq dedupe also travelled: replay seq 1
+        # (the original pre-split push) at the new home — refused
+        old = [s for (o, kk), s in dst._applied.items() if kk == k]
+        assert old, "dedupe seqs did not travel with the key"
+        dconn.close()
+    finally:
+        conn.close()
+        kv.close()
+        s0.stop(); s1.stop(); dst.stop()
+
+
+def test_fresh_worker_learns_map_at_hello(monkeypatch):
+    """A worker joining AFTER a split never sees map_stale at all: the
+    hello reply carries the versioned map, so its first push routes
+    straight to the key's new home."""
+    s0, s1, dst, kv, keys = _split_world(monkeypatch)
+    conn = ka._ServerConn(s0.address)
+    joiner = None
+    try:
+        moved = conn.request("split", dst.address)[1]["moved"]
+        joiner = _store(monkeypatch, "%s,%s" % (s0.address, s1.address))
+        for k in moved:
+            assert joiner._key_overrides.get(k) == dst.address
+        joiner.push(moved[0], mx.nd.ones((4,)))
+        assert joiner.stats()["map_reroutes"] == 0
+        assert dst._clock[moved[0]] == 1
+    finally:
+        conn.close()
+        if joiner is not None:
+            joiner.close()
+        kv.close()
+        s0.stop(); s1.stop(); dst.stop()
+
+
+def test_split_carries_updater_state(monkeypatch):
+    """Optimizer continuity: with a server-side momentum SGD, the
+    moved key's accumulated updater state travels — the post-split
+    trajectory matches an unsplit control server bit-for-bit."""
+    s0, s1, dst, kv, keys = _split_world(monkeypatch, optimizer=True)
+    # control: an unsplit server seeing the same push stream
+    ctrl = ParameterServer().start()
+    cconn = ka._ServerConn(ctrl.address)
+    conn = ka._ServerConn(s0.address)
+    try:
+        import pickle
+        cconn.request("set_optimizer",
+                      pickle.dumps(mx.optimizer.SGD(learning_rate=0.1,
+                                                    momentum=0.9)))
+        grads = [np.full(4, g, "f") for g in (1.0, 2.0, -1.0, 0.5)]
+        # two pushes pre-split, two post-split, same stream to control
+        for k in keys:
+            cconn.request("init", k, np.zeros(4, "f"))
+        for g in grads[:2]:
+            for k in keys:
+                kv.push(k, mx.nd.array(g))
+                cconn.request("push", k, g.copy(), 0)
+        moved = conn.request("split", dst.address)[1]["moved"]
+        assert moved
+        for g in grads[2:]:
+            for k in keys:
+                kv.push(k, mx.nd.array(g))
+                cconn.request("push", k, g.copy(), 0)
+        out = mx.nd.zeros((4,))
+        for k in keys:
+            kv.pull(k, out=out)
+            _, want, _ = cconn.request("pull", k)
+            np.testing.assert_array_equal(
+                out.asnumpy(), np.asarray(want),
+                err_msg="momentum state did not travel with %r" % (k,))
+    finally:
+        conn.close(); cconn.close()
+        kv.close()
+        ctrl.stop()
+        s0.stop(); s1.stop(); dst.stop()
+
+
+def test_split_aborts_cleanly_and_resumes(monkeypatch):
+    """Transfer interrupted mid-way (destination unreachable from the
+    second key on): a clean prefix is moved, the rest stays OWNED and
+    serving, and a re-issued split finishes the job — nothing acked is
+    ever lost."""
+    s0, s1, dst, kv, keys = _split_world(monkeypatch)
+    conn = ka._ServerConn(s0.address)
+    try:
+        for k in keys:
+            kv.push(k, mx.nd.ones((4,)))
+        n_local = len(s0._table)
+        assert n_local >= 2, "need >= 2 keys on s0 for a mid-split abort"
+        # move EVERY local key so the abort lands mid-transfer
+        local = sorted(s0._table)
+        with fault.inject("kind=sever,point=worker.send,"
+                          "op=adopt_key,nth=2,count=inf"):
+            with pytest.raises(RuntimeError, match="aborted after 1"):
+                conn.request("split", dst.address, local, retries=0)
+        assert len(s0._moved) == 1                  # the clean prefix
+        assert len(s0._table) == n_local - 1        # the rest still ours
+        # every key still serves (owned or forwarded), nothing lost
+        for k in keys:
+            kv.push(k, mx.nd.ones((4,)))
+        clocks = kv.staleness_stats()["clocks"]
+        assert all(v == 2 for v in clocks.values()), clocks
+        # re-issue: the split resumes over the remaining keys
+        reply = conn.request("split", dst.address)
+        assert reply[0] == "ok" and reply[1]["moved"]
+        assert s0._splits == 1                      # only the COMPLETE one
+    finally:
+        conn.close()
+        kv.close()
+        s0.stop(); s1.stop(); dst.stop()
+
+
+# ---------------------------------------------------------------------------
+# split x replication
+# ---------------------------------------------------------------------------
+
+def _pair(monkeypatch, **srv_kw):
+    """A joined (primary, backup) shard pair plus a replicated store
+    pointed at the primary (same helper as the fault matrix)."""
+    pri = ParameterServer(role="primary", **srv_kw).start()
+    bak = ParameterServer(role="backup", peer_addr=pri.address).start()
+    pri._peer_addr = bak.address
+    bak.join_cluster(probe_interval=0)
+    _wait_for(lambda: bak._catchup_complete, what="initial catch-up")
+    monkeypatch.setenv("MXTPU_PS_REPLICAS", "2")
+    kv = _store(monkeypatch, pri.address)
+    assert isinstance(kv._conns[0], ka._ReplicatedConn)
+    return pri, bak, kv
+
+
+def test_replicated_dst_backs_up_before_release(monkeypatch):
+    """'Each new shard gets its backup before the old primary releases
+    it': splitting INTO a replicated pair, every adopt is mirrored to
+    the destination's backup before src marks the key moved — kill the
+    new primary right after the split and nothing is lost."""
+    dpri, dbak, kv = _pair(monkeypatch)
+    src = ParameterServer().start()
+    conn = ka._ServerConn(src.address)
+    try:
+        sconn = ka._ServerConn(src.address)
+        for i in range(4):
+            sconn.request("init", "m%d" % i, np.zeros(4, "f"))
+            sconn.request("push", "m%d" % i, np.ones(4, "f"), 0,
+                          "w", 1)
+        sconn.close()
+        moved = conn.request("split", dpri.address)[1]["moved"]
+        assert moved
+        for k in moved:
+            # the backup holds the key + clock BEFORE src released it
+            assert dbak._clock.get(k) == 1, \
+                "dst backup missing %r at release time" % (k,)
+            np.testing.assert_allclose(dbak._table[k], np.ones(4))
+        # kill the new primary: the promoted backup serves the adopted
+        # keys — the split created no unreplicated window
+        dpri.kill()
+        _wait_for(lambda: not dpri._thread.is_alive(),
+                  what="dst primary teardown")
+        out = mx.nd.zeros((4,))
+        kv._plan(moved[0], (4,))
+        kv._key_overrides[moved[0]] = dpri.address
+        kv.pull(moved[0], out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+        assert dbak._role == "primary"
+    finally:
+        conn.close()
+        kv.close()
+        src.stop()
+        dpri.stop(); dbak.stop()
+
+
+def test_src_primary_killed_mid_split_no_acked_loss(monkeypatch):
+    """The satellite row: the SOURCE primary dies after a partial
+    split. Its sync-replicated backup learned the moved prefix (the
+    'moved' records rode the stream before the kill), so after
+    promotion it forwards the moved keys with map_stale and serves the
+    rest from its mirrored table — zero acknowledged-update loss, and
+    re-issuing the split against the promoted primary completes the
+    reshard."""
+    pri, bak, kv = _pair(monkeypatch)
+    dst = ParameterServer().start()
+    conn = ka._ServerConn(pri.address)
+    try:
+        keys = ["m%d" % i for i in range(4)]
+        kv.init(keys, [mx.nd.zeros((4,)) for _ in keys])
+        for k in keys:
+            kv.push(k, mx.nd.ones((4,)))
+        # abort the split after exactly one key moved...
+        with fault.inject("kind=sever,point=worker.send,"
+                          "op=adopt_key,nth=2,count=inf"):
+            with pytest.raises(RuntimeError, match="aborted after 1"):
+                conn.request("split", dst.address, retries=0)
+        moved_key = list(pri._moved)[0]
+        # ...the backup mirrored the release before anything else
+        assert bak._moved.get(moved_key) == dst.address
+        assert moved_key not in bak._table
+        # now the primary dies for real, mid-reshard
+        pri.kill()
+        _wait_for(lambda: not pri._thread.is_alive(),
+                  what="primary teardown")
+        # pushes continue: unmoved keys fail over to the promoted
+        # backup, the moved key forwards to dst — exactly once each
+        for k in keys:
+            kv.push(k, mx.nd.ones((4,)))
+        assert bak._role == "primary"
+        for k in keys:
+            want = 2
+            have = (dst._clock.get(k) if k == moved_key
+                    else bak._clock.get(k))
+            assert have == want, (k, have)
+        # the reshard resumes against the promoted primary
+        bconn = ka._ServerConn(bak.address)
+        reply = bconn.request("split", dst.address)
+        assert reply[0] == "ok" and reply[1]["moved"]
+        bconn.close()
+        clocks = {}
+        for srv in (bak, dst):
+            clocks.update(srv._clock)
+        assert set(clocks) == set(keys)
+        assert all(v == 2 for v in clocks.values()), clocks
+    finally:
+        conn.close()
+        kv.close()
+        pri.stop(); bak.stop(); dst.stop()
+
+
+def test_moved_map_survives_snapshot_restart(monkeypatch, tmp_path):
+    """A respawned source server keeps refusing split-away keys: the
+    forwarding table rides the snapshot, so a restart cannot resurrect
+    a stale copy of a moved key."""
+    src = ParameterServer(snapshot_dir=str(tmp_path),
+                          snapshot_every=0).start()
+    dst = ParameterServer().start()
+    conn = ka._ServerConn(src.address)
+    try:
+        for i in range(4):
+            conn.request("init", "m%d" % i, np.zeros(4, "f"))
+            conn.request("push", "m%d" % i, np.ones(4, "f"), 0, "w", 1)
+        moved = conn.request("split", dst.address)[1]["moved"]
+        src.snapshot()
+        conn.close()
+        src.stop()
+        src2 = ParameterServer(snapshot_dir=str(tmp_path)).start()
+        try:
+            assert src2._moved == {k: dst.address for k in moved}
+            assert src2._map_version >= len(moved)
+            c2 = ka._ServerConn(src2.address)
+            with pytest.raises(RuntimeError, match="map_stale"):
+                c2.request("pull", moved[0])
+            c2.close()
+        finally:
+            src2.stop()
+    finally:
+        dst.stop()
+
+
+# ---------------------------------------------------------------------------
+# the elastic fault kinds (reproducible drills)
+# ---------------------------------------------------------------------------
+
+def test_elastic_fault_kinds_parse_and_signal():
+    rules = fault.parse_spec(
+        "kind=join_worker,point=worker.step,nth=2;"
+        "kind=leave_worker,point=worker.step,nth=4;"
+        "kind=split_shard,nth=6")
+    assert [r.kind for r in rules] == ["join_worker", "leave_worker",
+                                      "split_shard"]
+    with pytest.raises(ValueError, match="worker.step"):
+        fault.parse_spec("kind=split_shard,point=server.recv")
+    inj = fault.FaultInjector(
+        "kind=join_worker,point=worker.step,nth=2;"
+        "kind=split_shard,point=worker.step,nth=3")
+    acts = [inj.fire("worker.step", op="step") for _ in range(4)]
+    # a fired rule consumes its event (later rules never see it), so
+    # the split rule's 3rd MATCHING event is global event 4
+    assert acts == [None, "join_worker", None, "split_shard"]
+
+
+def test_guard_elastic_callback_fires_on_schedule():
+    """TrainGuard delivers the elastic signals to a registered handler
+    on exact step counts (and counts them), without disturbing the
+    step itself."""
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    from mxtpu.parallel import MeshContext, ShardedTrainer
+    from mxtpu.resilience import TrainGuard
+    import mxtpu.gluon.block as _blk
+    _blk._NAME_COUNTERS.clear()
+    mx.random.seed(3)
+    np.random.seed(3)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randint(0, 10, (8,)).astype(np.float32)
+    net(mx.nd.array(x))
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "sgd", {"learning_rate": 0.1},
+                        mesh=MeshContext())
+    guard = TrainGuard(st, spike_z=0)
+    seen = []
+    guard.set_elastic_callback(lambda kind: seen.append(
+        (kind, guard.stats()["steps"])))
+    with fault.inject("kind=join_worker,point=worker.step,nth=2;"
+                      "kind=split_shard,point=worker.step,nth=4"):
+        for _ in range(5):
+            loss = guard.step(mx.nd.array(x), mx.nd.array(y))
+            assert np.isfinite(loss)
+    # fired BEFORE steps 2 and 5 ran (stats()["steps"] counts completed
+    # steps; the join rule consumed step-event 2, so the split rule's
+    # 4th matching event is global step 5)
+    assert seen == [("join_worker", 1), ("split_shard", 4)]
+    assert guard.stats()["elastic_signals"] == 2
+    assert guard.stats()["good_steps"] == 5

@@ -612,7 +612,12 @@ def test_worker_membership_hello_bye_gc(monkeypatch):
         assert (origin, "w") in srv._applied
         s = kv.stats()
         assert s["workers"][origin]["pushes"] == 2
-        assert s["membership_epoch"] == epoch0
+        # per-server epochs (the counters are independent per server —
+        # an aggregate max would be meaningless); churn is the only
+        # cross-server verdict kept
+        assert s["membership_epochs"][srv.address] == epoch0
+        assert s["membership_churn"] is True   # our own hello counts
+        assert s["elastic"]["joins"] == 1
         h = kv.health()
         assert origin in h["workers"] and h["stragglers"] == []
     finally:

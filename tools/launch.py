@@ -25,6 +25,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 
@@ -99,6 +100,46 @@ def _spawn_server(name, ps_port, base_env, args, role="primary",
     print("ps server %s role=%s pid=%d port=%d"
           % (name, role, proc.pid, ps_port), flush=True)
     return proc
+
+
+def _parse_scale(spec):
+    """``--scale`` drill events: ``;``-separated, each a comma list of
+    ``key=value`` — ``after=SECONDS`` or ``at_step=N`` (needs
+    ``--scale-progress``) picks the trigger, ``action=`` one of
+    add_worker / remove_worker / split_shard, plus ``rank=`` (remove)
+    and ``src=`` (split source server slot, default 0)."""
+    events = []
+    for item in spec.split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        ev = {}
+        for pair in item.split(","):
+            k, _, v = pair.partition("=")
+            ev[k.strip()] = v.strip()
+        if ev.get("action") not in ("add_worker", "remove_worker",
+                                    "split_shard"):
+            raise SystemExit("scale event %r needs action=add_worker|"
+                             "remove_worker|split_shard" % item)
+        if "after" not in ev and "at_step" not in ev:
+            raise SystemExit("scale event %r needs after= or at_step="
+                             % item)
+        events.append(ev)
+    return events
+
+
+def _wait_port(host, port, timeout=60.0):
+    """Block until something accepts on host:port (a just-spawned
+    server is still importing for a few seconds)."""
+    import socket
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=1.0):
+                return True
+        except OSError:
+            time.sleep(0.1)
+    return False
 
 
 def launch_local(args, command):
@@ -183,16 +224,162 @@ def launch_local(args, command):
     code = 0
     respawns = [0] * len(server_procs)
     worker_respawns = [0] * len(procs)
+
+    # -- the --scale drill: elastic add/remove/split events on a
+    # wall-clock or training-progress schedule (docs/fault_tolerance.md
+    # "Elasticity"). Runs on its own thread; the monitor loop below
+    # waits for it before declaring the launch finished.
+    scale_done = threading.Event()
+    stop_scale = threading.Event()
+    removed = set()    # ranks departed by a remove_worker event: their
+    #                    sh -c wrapper dies -15, which is NOT a failure
+
+    def _do_scale_event(ev):
+        act = ev["action"]
+        if act == "add_worker":
+            rank = len(procs)
+            env = dict(base_env)
+            env.update({
+                "MXTPU_NUM_PROCS": str(args.num_workers),
+                "MXTPU_PROC_ID": str(rank),
+                "DMLC_ROLE": "worker",
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_NUM_SERVER": str(args.num_servers),
+                "DMLC_WORKER_ID": str(rank),
+                # the joiner contract: skip init/set_optimizer, pull
+                # current params, take work from the shard cursor
+                "MXTPU_ELASTIC_JOINER": "1",
+            })
+            # a mid-run joiner CANNOT enter the already-formed
+            # jax.distributed group (the coordination service pins its
+            # world size at bootstrap) — elasticity rides the PS layer,
+            # so the joiner runs single-process XLA and shares the
+            # model only through the parameter servers
+            env.pop("MXTPU_COORDINATOR", None)
+            if ps_addrs:
+                env["MXTPU_PS_ADDRS"] = ",".join(ps_addrs)
+            if args.worker_state_dir:
+                env["MXTPU_WORKER_STATE_DIR"] = os.path.join(
+                    args.worker_state_dir, "worker_%d" % rank)
+            print("scale: adding worker %d" % rank, flush=True)
+            worker_envs.append(env)
+            worker_respawns.append(0)
+            procs.append(subprocess.Popen(command, shell=True, env=env))
+        elif act == "remove_worker":
+            rank = int(ev.get("rank", len(procs) - 1))
+            # SIGTERM is the CLEAN departure: an elastic worker's
+            # handler finishes its current shard, byes, and exits 0.
+            # Popen(shell=True) makes the tracked pid an sh -c wrapper,
+            # so the signal must reach its CHILDREN (the python worker)
+            # too, or only the shell dies and training runs on.
+            print("scale: removing worker %d (SIGTERM)" % rank,
+                  flush=True)
+            removed.add(rank)
+            pid = procs[rank].pid
+            kids = []
+            try:
+                for task in os.listdir("/proc/%d/task" % pid):
+                    with open("/proc/%d/task/%s/children"
+                              % (pid, task)) as f:
+                        kids += [int(c) for c in f.read().split()]
+            except OSError:
+                pass
+            for target in kids + [pid]:
+                try:
+                    os.kill(target, signal.SIGTERM)
+                except OSError:
+                    pass
+        else:  # split_shard
+            src_i = int(ev.get("src", "0"))
+            idx = len(server_slots)
+            port = _free_port(args.port + 101 + idx)
+            dst_addr = "127.0.0.1:%d" % port
+            slots = [("e%d" % idx, port, "primary", None)]
+            if max(1, args.ps_replicas) >= 2:
+                # the new shard is born replicated: its backup joins
+                # and catches up, and every adopted key mirrors there
+                # BEFORE the old primary releases it
+                bport = _free_port(args.port + 151 + idx)
+                slots = [("e%d" % idx, port, "primary",
+                          "127.0.0.1:%d" % bport),
+                         ("e%d_backup" % idx, bport, "backup",
+                          dst_addr)]
+            for name, p_, role, peer in slots:
+                server_slots.append((name, p_, role, peer))
+                respawns.append(0)
+                server_ports.append(p_)
+                server_procs.append(_spawn_server(
+                    name, p_, base_env, args, role=role, peer=peer))
+            if not _wait_port("127.0.0.1", port):
+                print("scale: split target %s never came up" % dst_addr,
+                      flush=True)
+                return
+            src_addr = ps_addrs[src_i]
+            admin_env = dict(base_env)
+            admin_env.pop("DMLC_ROLE", None)
+            admin_env["JAX_PLATFORMS"] = "cpu"
+            print("scale: splitting server %s -> %s"
+                  % (src_addr, dst_addr), flush=True)
+            r = subprocess.run(
+                [sys.executable, "-m", "mxtpu.kvstore_async",
+                 "--admin", "split", "--src", src_addr,
+                 "--dst", dst_addr],
+                env=admin_env, capture_output=True, text=True)
+            print("scale: split -> %s"
+                  % (r.stdout.strip() or r.stderr.strip()[-500:]),
+                  flush=True)
+
+    def _scale_controller(events):
+        t0 = time.time()
+        try:
+            for ev in events:
+                if "after" in ev:
+                    deadline = t0 + float(ev["after"])
+                    while time.time() < deadline:
+                        if stop_scale.is_set():
+                            return
+                        time.sleep(0.05)
+                else:
+                    want = int(ev["at_step"])
+                    while True:
+                        if stop_scale.is_set():
+                            return
+                        try:
+                            with open(args.scale_progress) as f:
+                                step = int(f.read() or 0)
+                        except (OSError, ValueError):
+                            step = 0
+                        if step >= want:
+                            break
+                        time.sleep(0.05)
+                try:
+                    _do_scale_event(ev)
+                except Exception as e:   # a drill bug must not wedge
+                    print("scale: event %r failed: %s" % (ev, e),
+                          flush=True)
+        finally:
+            scale_done.set()
+
+    if args.scale:
+        events = _parse_scale(args.scale)
+        if any("at_step" in e for e in events) \
+                and not args.scale_progress:
+            raise SystemExit("--scale with at_step= triggers needs "
+                             "--scale-progress FILE")
+        threading.Thread(target=_scale_controller, args=(events,),
+                         daemon=True).start()
+    else:
+        scale_done.set()
     try:
         # respawn passes run BEFORE the liveness check: a fleet whose
         # last worker just got kill -9'd must be revived, not reaped
         # (with -n 1 the old any-alive loop condition would exit first)
         while True:
             if args.worker_respawn:
-                for i, wp in enumerate(procs):
+                for i, wp in enumerate(list(procs)):
                     rc = wp.poll()
-                    if rc is None or rc == 0:
-                        continue   # alive, or finished cleanly
+                    if rc is None or rc == 0 or i in removed:
+                        continue   # alive, finished cleanly, or departed
                     if worker_respawns[i] >= args.worker_max_respawns:
                         continue   # budget spent: the exit code stands
                     worker_respawns[i] += 1
@@ -222,9 +409,18 @@ def launch_local(args, command):
                         name, port, base_env, args, role=role,
                         peer=peer)
             if all(p.poll() is not None for p in procs):
-                break
+                if not scale_done.is_set():
+                    # workers drained before the drill finished: stop
+                    # the controller (bounded) rather than hanging on
+                    # a progress file nobody writes anymore
+                    stop_scale.set()
+                    scale_done.wait(timeout=10)
+                if all(p.poll() is not None for p in procs):
+                    break
             time.sleep(0.2)
-        for p in procs:
+        for i, p in enumerate(procs):
+            if i in removed:
+                continue   # a drill departure is a clean exit
             code = code or p.returncode
     except KeyboardInterrupt:
         _reap(procs)
@@ -406,6 +602,21 @@ def main():
                         "uses <dir>/worker_r, exported as "
                         "MXTPU_WORKER_STATE_DIR); auto-created under "
                         "$TMPDIR when --worker-respawn is on")
+    p.add_argument("--scale", default=None,
+                   help="local launcher elasticity drill: ';'-separated "
+                        "events of 'after=SECS|at_step=N,action="
+                        "add_worker|remove_worker|split_shard"
+                        "[,rank=R][,src=I]' — add_worker spawns a "
+                        "joining worker (MXTPU_ELASTIC_JOINER=1), "
+                        "remove_worker SIGTERMs one (clean departure), "
+                        "split_shard spawns a fresh server (pair, with "
+                        "--ps-replicas 2) and splits server slot I's "
+                        "keys onto it online (docs/fault_tolerance.md "
+                        "'Elasticity')")
+    p.add_argument("--scale-progress", default=None,
+                   help="progress file written by the training script; "
+                        "at_step= scale triggers fire when its integer "
+                        "content reaches N")
     p.add_argument("--launcher",
                    choices=("local", "ssh", "mpi", "slurm", "sge"),
                    default="local")
