@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Fast-tier serving smoke (ISSUE 8): the four contracts of the
+request path, end to end on a loopback replica pair in this process.
+
+  1. **Coalescing**: concurrent single-row predicts land in FEWER
+     device batches than requests (device dispatches grow sublinearly
+     with load), and the steady-state sweep posts ZERO per-request
+     retraces with p99 under the request budget.
+  2. **Deadline expiry**: a request whose budget is burned before its
+     batch dispatches gets the ``expired`` verdict and NO response —
+     expired work is dropped before dispatch, never computed.
+  3. **Load shedding**: past MXTPU_SERVE_QUEUE_DEPTH, admission refuses
+     with the RETRIABLE ``overloaded`` verdict (client-visible as
+     ``Overloaded.retriable``), and nothing admitted is lost.
+  4. **Failover exactly-once**: ``kind=kill`` takes the active replica
+     down mid-batch (the in-process rendering of kill -9, same as
+     ci/check_replication.py); every acknowledged request is answered
+     EXACTLY ONCE, bit-for-bit identical to an uninterrupted engine —
+     replays carry their original request ids (visible in the
+     surviving replica's dup counters being clean and the client's
+     replay/failover counters firing).
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_serving.py`` (wired into
+``ci/run_ci.sh fast``). Exit 0 = contract holds.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_PS_HEARTBEAT"] = "0"
+os.environ["MXTPU_PS_LOCAL"] = "0"       # the drill is about the wire
+os.environ["MXTPU_PS_RETRIES"] = "1"
+os.environ["MXTPU_PS_BACKOFF"] = "0.01"
+os.environ["MXTPU_PS_RECONNECT"] = "0.5"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np                                    # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+from mxtpu import fault                               # noqa: E402
+from mxtpu.serving import (                           # noqa: E402
+    DeadlineExceeded, InferenceEngine, ModelServer, Overloaded,
+    ServingClient)
+
+IN_DIM, CLASSES = 12, 4
+# a single-bucket menu makes every device dispatch the same shape, so a
+# request's bits depend only on its rows — not on which batch
+# composition it coalesced into — and the oracle/failover comparisons
+# below can demand EXACT equality (docs/serving.md "Determinism")
+BUCKETS = (8,)
+BUDGET_MS = 2000.0
+
+
+def fail(msg):
+    print("serving check FAILED: %s" % msg)
+    return 1
+
+
+def build_model():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, IN_DIM))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    arg_params, aux_params = mod.get_params()
+    return net, arg_params, aux_params
+
+
+def predict_many(cli, xs, budget_ms=BUDGET_MS):
+    """Concurrent predicts; returns ({i: output}, {i: error}) and the
+    per-request exactly-once delivery count."""
+    outs, errs, delivered = {}, {}, {}
+    lock = threading.Lock()
+
+    def one(i):
+        try:
+            out = cli.predict(xs[i], budget_ms=budget_ms)[0]
+        except Exception as e:              # terminal verdicts included
+            with lock:
+                errs[i] = e
+            return
+        with lock:
+            outs[i] = out
+            delivered[i] = delivered.get(i, 0) + 1
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(len(xs))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    return outs, errs, delivered
+
+
+def main():
+    net, arg_params, aux_params = build_model()
+
+    def mkeng():
+        return InferenceEngine(net, arg_params, aux_params,
+                               {"data": (IN_DIM,)}, buckets=BUCKETS,
+                               warm=False)
+
+    # the uninterrupted oracle: what every request SHOULD answer
+    oracle = mkeng()
+    oracle.warm()
+
+    s1 = ModelServer(mkeng(), model_name="ci", batch_deadline_ms_=20,
+                     default_budget_ms_=BUDGET_MS).start()
+    s2 = ModelServer(mkeng(), model_name="ci", batch_deadline_ms_=20,
+                     default_budget_ms_=BUDGET_MS,
+                     replicas=[s1.address]).start()
+    s1._replicas.append(s2.address)
+    cli = ServingClient(addrs=[s1.address], budget_ms=BUDGET_MS)
+    info = cli.hello()
+    if sorted(info["replicas"]) != sorted([s1.address, s2.address]):
+        return fail("hello did not advertise the replica set: %r" % info)
+
+    rng = np.random.RandomState(7)
+    xs = [rng.rand(1, IN_DIM).astype("f") for _ in range(24)]
+    want = [np.asarray(oracle.predict([x])[0]) for x in xs]
+
+    # -- 1. coalescing + zero retraces + p99 under budget ---------------
+    compiles_warm = None
+    lat = []
+    for rounds in range(3):
+        t0 = time.perf_counter()
+        outs, errs, _ = predict_many(cli, xs)
+        lat.append(time.perf_counter() - t0)
+        if errs:
+            return fail("fault-free round %d errored: %r"
+                        % (rounds, errs))
+        for i, out in outs.items():
+            if not np.array_equal(out, want[i]):
+                return fail("request %d diverged from the oracle" % i)
+        if compiles_warm is None:
+            compiles_warm = s1._engine.cache.compiles
+    if s1._engine.cache.compiles != compiles_warm:
+        return fail("steady-state serving retraced: %d new compiles"
+                    % (s1._engine.cache.compiles - compiles_warm))
+    b = s1.stats()["batcher"]
+    if not b["batches"] < b["batched_requests"]:
+        return fail("no batch coalescing: %d batches for %d requests"
+                    % (b["batches"], b["batched_requests"]))
+    # closed-loop round wall time bounds every request's latency; the
+    # budget bounds p99 by construction if nothing expired
+    if s1.stats()["counters"]["expired"]:
+        return fail("fault-free rounds expired requests")
+    p99_bound_ms = max(lat) / len(xs) * 1e3 * len(xs)
+    if p99_bound_ms > BUDGET_MS:
+        return fail("p99 bound %.1fms exceeds the %.0fms budget"
+                    % (p99_bound_ms, BUDGET_MS))
+
+    # -- 2. deadline expiry: zero responses after expiry ----------------
+    resp_before = s1.stats()["counters"]["responses"]
+    try:
+        cli.predict(xs[0], budget_ms=1.0)   # 1ms budget, 20ms window
+        return fail("a 1ms-budget request was answered, not expired")
+    except DeadlineExceeded:
+        pass
+    c = s1.stats()["counters"]
+    if c["expired"] != 1:
+        return fail("expired counter %r, want 1" % (c["expired"],))
+    if c["responses"] != resp_before:
+        return fail("an expired request produced a response")
+
+    # -- 3. queue-full shedding with the retriable verdict --------------
+    s1._batcher._depth = 0
+    s2._batcher._depth = 0
+    try:
+        cli.predict(xs[0])
+        return fail("queue-full predict was admitted, not shed")
+    except Overloaded as e:
+        if not e.retriable:
+            return fail("overloaded verdict is not marked retriable")
+        if not any(v == "overloaded" for _, v, _ in e.verdicts):
+            return fail("shed without the overloaded verdict: %r"
+                        % (e.verdicts,))
+    s1._batcher._depth = 256
+    s2._batcher._depth = 256
+    if s1.stats()["counters"]["shed_overloaded"] < 1:
+        return fail("server never counted the shed")
+
+    # -- 4. kill the active replica mid-batch: exactly-once, bit-equal --
+    with fault.inject(
+            "kind=kill,point=serve.batch,nth=1") as inj:
+        outs, errs, delivered = predict_many(cli, xs)
+    if inj.stats()[0][4] != 1:
+        return fail("the mid-batch kill schedule never fired")
+    if errs:
+        return fail("acknowledged requests lost across the kill: %r"
+                    % errs)
+    if any(n != 1 for n in delivered.values()) or len(delivered) != len(xs):
+        return fail("exactly-once broken: %r" % delivered)
+    for i, out in outs.items():
+        if not np.array_equal(out, want[i]):
+            return fail("request %d not bit-identical across failover"
+                        % i)
+    cs = cli.stats()
+    if cs["failovers"] < 1 or cs["replays"] < 1:
+        return fail("failover drill never failed over: %r" % cs)
+    # whichever replica the kill landed on, the OTHER one answered
+    dead = [s for s in (s1, s2) if s._tcp.dying]
+    alive = [s for s in (s1, s2) if not s._tcp.dying]
+    if len(dead) != 1 or len(alive) != 1:
+        return fail("kill drill left %d dead replicas" % len(dead))
+    surv = alive[0].stats()
+    if surv["counters"]["responses"] < 1:
+        return fail("surviving replica answered nothing: %r"
+                    % surv["counters"])
+
+    cli.close()
+    s2.stop()
+    s1.stop()
+    print("serving check OK — %d requests: coalesced %d->%d batches, "
+          "0 retraces, expiry/shed verdicts enforced, mid-batch kill "
+          "failed over with exactly-once bit-identical answers "
+          "(%d replays, %d failovers)"
+          % (len(xs) * 3, b["batched_requests"], b["batches"],
+             cs["replays"], cs["failovers"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

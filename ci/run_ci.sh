@@ -28,6 +28,7 @@ case "$tier" in
     JAX_PLATFORMS=cpu python ci/check_module_perf.py
     JAX_PLATFORMS=cpu python ci/check_replication.py
     JAX_PLATFORMS=cpu python ci/check_elastic.py
+    JAX_PLATFORMS=cpu python ci/check_serving.py
     ;;
   nightly)
     JAX_PLATFORMS=cpu python -m pytest tests/ -q

@@ -49,6 +49,17 @@ Rule keys:
 ``point``  ``worker.send`` | ``worker.recv`` | ``server.recv`` |
            ``server.send`` | ``worker.step`` (fired by the guarded
            training loop once per step, before the jitted step runs) |
+           ``serve.request`` (model-serving admission: fired once per
+           predict request as it is admitted, ``op=predict``,
+           ``key=``request id — ``drop`` loses the admitted request
+           without a reply, ``delay`` burns request budget so deadline
+           expiry is exercisable on an exact schedule) |
+           ``serve.batch`` (fired by the dynamic batcher immediately
+           before a coalesced batch dispatches to the device,
+           ``op=batch`` — ``kill`` here is the kill-replica-mid-batch
+           drill: the whole batch's clients fail over and replay their
+           request ids on the surviving replica; see
+           ``docs/serving.md``) |
            ``any``.
 ``op``     wire command to match (``push``/``pull``/``repl``/...); ``*``
            (default) matches all. Replication-stream frames carry
@@ -90,7 +101,7 @@ __all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
            "inject", "fire", "active"]
 
 _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
-           "worker.step", "any")
+           "worker.step", "serve.request", "serve.batch", "any")
 _KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
           "nan_grad", "kill_worker", "join_worker", "leave_worker",
           "split_shard")

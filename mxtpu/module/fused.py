@@ -37,6 +37,7 @@ back to the eager path (warning once for monitor / custom updaters).
 from __future__ import annotations
 
 import os
+import threading
 import warnings
 
 import numpy as _np
@@ -48,8 +49,59 @@ from ..model import _module_fused_enabled
 from ..ndarray import NDArray, _wrap
 from ..optimizer import state_to_tree
 
-__all__ = ["FusedGroupState", "FusedModuleTrainer", "maybe_create",
-           "attach_borrowed", "metric_readback_interval"]
+__all__ = ["ProgramCache", "FusedGroupState", "FusedModuleTrainer",
+           "maybe_create", "attach_borrowed", "metric_readback_interval"]
+
+
+class ProgramCache:
+    """Per-signature compiled-program cache shared by the fused Module
+    train step and the serving engine (``mxtpu/serving/engine.py``).
+
+    One entry per signature key — for training a (data shapes, label
+    shapes, metric) tuple, for serving a (bucket, input signature)
+    tuple — built exactly once by the caller's ``build`` closure.
+    ``compiles``/``hits`` are the retrace observability both
+    ``ci/check_module_perf.py`` and ``ci/check_serving.py`` pin their
+    zero-retraces-after-warmup contracts on. Thread-safe: the serving
+    batcher compiles from its flush thread while handler threads may
+    probe stats concurrently."""
+
+    def __init__(self):
+        self._programs = {}
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.hits = 0
+
+    def get(self, key, build):
+        """The program for ``key``, building (and counting a compile)
+        on first use. Returns ``(program, hit)`` so callers can keep
+        their own per-group counters."""
+        with self._lock:
+            entry = self._programs.get(key)
+            if entry is not None:
+                self.hits += 1
+                return entry, True
+        # compile OUTSIDE the lock: a slow trace must not block stats
+        # probes (a racing duplicate build is benign — last write wins,
+        # both programs are identical)
+        entry = build()
+        with self._lock:
+            self._programs[key] = entry
+            self.compiles += 1
+        return entry, False
+
+    def __len__(self):
+        with self._lock:
+            return len(self._programs)
+
+    def keys(self):
+        with self._lock:
+            return list(self._programs)
+
+    def stats(self):
+        with self._lock:
+            return {"programs": len(self._programs),
+                    "compiles": self.compiles, "hits": self.hits}
 
 
 def metric_readback_interval():
@@ -169,7 +221,7 @@ class FusedModuleTrainer:
             if exec_.grad_dict.get(name) is not None:
                 self._train_names.append(name)
                 self._opt_slots.append(i)
-        self._cache = {}
+        self._cache = ProgramCache()
         self._last_fused = False
         self._last_metric_applied = False
 
@@ -323,16 +375,12 @@ class FusedModuleTrainer:
 
         key = (self._shape_sig(data_batch.data),
                self._shape_sig(data_batch.label), fs.metric_key)
-        entry = self._cache.get(key)
-        if entry is None:
-            metric_fn = fs.metric_fn if fs.metric_key is not None else None
-            entry = exec_.make_fused_train_step(
+        metric_fn = fs.metric_fn if fs.metric_key is not None else None
+        entry, hit = self._cache.get(
+            key, lambda: exec_.make_fused_train_step(
                 self._train_names, fs.optimizer, self._opt_slots,
-                metric_fn=metric_fn)
-            self._cache[key] = entry
-            fs.stats["compiles"] += 1
-        else:
-            fs.stats["cache_hits"] += 1
+                metric_fn=metric_fn))
+        fs.stats["cache_hits" if hit else "compiles"] += 1
         fn, other_names = entry
 
         exec_group.load_batch(data_batch)

@@ -1,0 +1,54 @@
+"""Production model serving — the "millions of users" leg of the roadmap.
+
+The reference's deploy surface is the C predict API + amalgamation
+bundle (PAPER.md layer 9, ``c_predict_api.h``): one request, one
+process, one shape-specialized executor. This package is the operable
+rendering of that surface for heavy concurrent traffic, built entirely
+out of machinery this tree already trusts:
+
+* :mod:`mxtpu.serving.engine` — loads ``Module.save_checkpoint``
+  artifacts and AOT-compiles one DONATED XLA predict program per batch
+  bucket through the fused Module path's
+  :class:`~mxtpu.module.fused.ProgramCache` (zero per-request retraces
+  in steady state, pinned by ``ci/check_serving.py``).
+* :mod:`mxtpu.serving.batcher` — the bounded-latency dynamic batcher:
+  same-signature requests coalesce into one device dispatch, padded
+  into the bucket shapes; a batch flushes when a bucket fills or the
+  oldest request has waited ``MXTPU_SERVE_BATCH_DEADLINE_MS``.
+  Admission control is a bounded queue (``MXTPU_SERVE_QUEUE_DEPTH``)
+  that sheds with a RETRIABLE ``overloaded`` verdict, and per-request
+  deadlines ride the wire: an expired request is dropped BEFORE
+  dispatch (never after) with the ``expired`` verdict.
+* :mod:`mxtpu.serving.server` — the replica process: kvstore_async's
+  PR-2 transport verbatim (zero-copy pickle-5 frames, pipelined
+  windows, token auth, the ``MXTPU_PS_LOCAL`` in-process shortcut) —
+  no new RPC layer. SIGTERM runs a two-phase graceful drain: stop
+  admissions, flush in-flight batches, exit — the shape
+  ``tools/launch.py``'s ``_reap`` escalation turns into a clean
+  rolling restart.
+* :mod:`mxtpu.serving.client` — the PR-4 ``_ReplicatedConn`` failover
+  pattern for a symmetric replica set: replicas are learned at hello,
+  a window failure health-probes and fails over in place, and the
+  replay carries the ORIGINAL request id — acknowledged requests are
+  answered exactly once, bit-for-bit identical across replicas (pure
+  function of the shared checkpoint).
+
+Fault drills ride :mod:`mxtpu.fault` at two new points —
+``serve.request`` (admission) and ``serve.batch`` (pre-dispatch) — plus
+the existing transport points, so kill/delay/sever serving scenarios
+replay deterministically (``tests/test_fault_tolerance.py``,
+``tests/test_serving.py``). Full architecture and semantics:
+``docs/serving.md``; knobs: ``docs/env_vars.md`` (``MXTPU_SERVE_*``);
+measured behavior: ``tools/bench_serving.py`` →
+``docs/perf_analysis.md`` "Serving".
+"""
+from __future__ import annotations
+
+from .engine import InferenceEngine, parse_buckets, parse_shape_spec
+from .batcher import DynamicBatcher, RETRIABLE_VERDICTS
+from .server import ModelServer
+from .client import ServingClient, Overloaded, DeadlineExceeded
+
+__all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
+           "ServingClient", "Overloaded", "DeadlineExceeded",
+           "RETRIABLE_VERDICTS", "parse_buckets", "parse_shape_spec"]

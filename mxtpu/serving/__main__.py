@@ -1,0 +1,80 @@
+"""Serving replica process entry: ``python -m mxtpu.serving``.
+
+Spawned per replica by ``tools/launch.py --serve N`` (which exports
+``MXTPU_SERVE_ADDRS`` with the whole replica set) or by hand. Env
+contract:
+
+* ``MXTPU_SERVE_MODEL``       checkpoint prefix (``prefix-symbol.json``
+                              + ``prefix-%04d.params``) — required
+* ``MXTPU_SERVE_EPOCH``       checkpoint epoch (default 0)
+* ``MXTPU_SERVE_DATA_SHAPES`` per-sample input shapes,
+                              ``name=dims[;name=dims]`` — required
+* ``MXTPU_SERVE_PORT``        port to bind (default 0 = OS-assigned)
+* ``MXTPU_SERVE_ADDRS``       comma list of ALL replica addresses
+                              (advertised to clients at hello)
+* ``MXTPU_SERVE_BUCKETS``     batch buckets (default ``1,2,4,8,16,32``)
+* plus the batching/admission knobs read by
+  :mod:`mxtpu.serving.server` (``MXTPU_SERVE_QUEUE_DEPTH``,
+  ``MXTPU_SERVE_BATCH_DEADLINE_MS``, ``MXTPU_SERVE_DEADLINE_MS``).
+
+Lifecycle: SIGTERM triggers the graceful drain — admissions stop (new
+predicts get the retriable ``draining`` verdict, steering clients to
+the surviving replicas), admitted batches flush, then the process exits
+0. This is exactly the TERM half of ``tools/launch.py``'s ``_reap``
+escalation, so a reaped serving fleet drains instead of dropping
+in-flight work; kill -9 is the crash drill the client failover path
+covers.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+
+def main():
+    prefix = os.environ.get("MXTPU_SERVE_MODEL")
+    shapes = os.environ.get("MXTPU_SERVE_DATA_SHAPES")
+    if not prefix or not shapes:
+        print("mxtpu.serving: MXTPU_SERVE_MODEL and "
+              "MXTPU_SERVE_DATA_SHAPES are required", file=sys.stderr)
+        return 2
+    epoch = int(os.environ.get("MXTPU_SERVE_EPOCH", "0"))
+    port = int(os.environ.get("MXTPU_SERVE_PORT", "0"))
+    buckets = os.environ.get("MXTPU_SERVE_BUCKETS", "1,2,4,8,16,32")
+
+    from . import InferenceEngine, ModelServer, parse_buckets, \
+        parse_shape_spec
+
+    engine = InferenceEngine.from_checkpoint(
+        prefix, epoch, parse_shape_spec(shapes),
+        buckets=parse_buckets(buckets), warm=False)
+    srv = ModelServer(engine, port=port,
+                      model_name=os.path.basename(prefix))
+
+    term = threading.Event()
+
+    def _on_term(signum, frame):
+        # flag only — drain runs on the main thread, not in the handler
+        term.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    srv.start()     # warms every bucket program before listening
+    print("mxtpu serving replica listening on %s (model=%s buckets=%s)"
+          % (srv.address, os.path.basename(prefix),
+             ",".join(str(b) for b in engine.buckets)), flush=True)
+    while not term.is_set():
+        term.wait(timeout=0.5)
+    print("mxtpu serving replica %s draining" % srv.address, flush=True)
+    drained = srv.drain(timeout=float(
+        os.environ.get("MXTPU_SERVE_DRAIN_TIMEOUT", "30")))
+    srv.stop()
+    print("mxtpu serving replica %s stopped (drained=%s)"
+          % (srv.address, drained), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

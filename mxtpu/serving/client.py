@@ -1,0 +1,271 @@
+"""ServingClient: replica-set predict client with in-place failover.
+
+The PR-4 ``_ReplicatedConn`` pattern applied to serving: one client
+holds a :class:`~mxtpu.kvstore_async._ServerConn` per replica (the full
+retry/window/pipelining/local-shortcut transport), routes every predict
+to the ACTIVE replica, and on a terminal window failure health-probes
+it and fails over in place. The crucial difference from the kvstore
+pair: serving replicas are symmetric (every replica loaded the same
+checkpoint and serves), so failover is just a route change — no
+promotion handshake.
+
+Exactly-once is the client's contract: every request carries a
+``(origin, seq)`` request id, a replay after a failure carries the
+ORIGINAL id, and the client delivers exactly one terminal outcome per
+id. Because predict is a pure function of the checkpoint, a replay
+recomputed on the backup is bit-for-bit the answer the dead replica
+would have given — which is what lets the kill -9 drill diff its
+response set against an uninterrupted run.
+
+Terminal outcomes surface as:
+
+* the output arrays (success);
+* :class:`Overloaded` — every live replica shed (queue at depth) or is
+  draining; RETRIABLE: back off and resubmit (``retriable`` is True);
+* :class:`DeadlineExceeded` — the budget expired before dispatch;
+* ``ConnectionError`` — no replica reachable at all;
+* ``RuntimeError`` — a non-retriable server error (bad payload).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import uuid
+
+import numpy as _np
+
+from .. import kvstore_async as _ka
+
+__all__ = ["ServingClient", "Overloaded", "DeadlineExceeded"]
+
+# extra reply-wait seconds past the request budget before the client
+# declares the window dead and fails over
+_CLIENT_GRACE = float(os.environ.get("MXTPU_SERVE_CLIENT_GRACE", "30"))
+
+
+class Overloaded(RuntimeError):
+    """Every replica shed this request (queue at depth / draining).
+    Retriable by contract: back off and resubmit — same semantics as
+    the kvstore's buffered-push path, but surfaced to the caller
+    because serving latency budgets make silent queueing wrong."""
+    retriable = True
+
+    def __init__(self, msg, verdicts=None):
+        super().__init__(msg)
+        self.verdicts = verdicts or []
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's budget expired before its batch dispatched. Not
+    retriable with the same deadline — the budget is gone."""
+    retriable = False
+
+
+def _default_budget_ms():
+    return float(os.environ.get("MXTPU_SERVE_DEADLINE_MS", "1000"))
+
+
+class ServingClient:
+    """One application's view of a serving replica set."""
+
+    def __init__(self, addrs=None, token=None, budget_ms=None,
+                 connect_timeout=30.0):
+        if addrs is None:
+            addrs = [a.strip() for a in
+                     os.environ.get("MXTPU_SERVE_ADDRS", "").split(",")
+                     if a.strip()]
+        if isinstance(addrs, str):
+            addrs = [a.strip() for a in addrs.split(",") if a.strip()]
+        if not addrs:
+            raise ValueError("no serving replicas: pass addrs= or set "
+                             "MXTPU_SERVE_ADDRS")
+        self._token = token if token is not None \
+            else os.environ.get("MXTPU_PS_TOKEN") or None
+        self._budget_ms = _default_budget_ms() if budget_ms is None \
+            else float(budget_ms)
+        self._connect_timeout = float(connect_timeout)
+        self._origin = uuid.uuid4().hex[:12]
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self._addrs = list(addrs)
+        self._conns = {}               # addr -> _ServerConn (lazy)
+        self._active_i = 0
+        self._stats = _ka._CommStats()
+        self._c = {"requests": 0, "responses": 0, "replays": 0,
+                   "failovers": 0, "shed": 0, "expired": 0}
+        self.signature = None
+        self.model = None
+
+    # -- replica plumbing --------------------------------------------------
+    def _conn_for(self, addr, connect_timeout=None):
+        with self._lock:
+            conn = self._conns.get(addr)
+        if conn is not None:
+            return conn
+        conn = _ka._ServerConn(
+            addr, token=self._token, stats=self._stats,
+            connect_timeout=self._connect_timeout
+            if connect_timeout is None else connect_timeout)
+        with self._lock:
+            # a racing builder won: use (and keep) the first one
+            existing = self._conns.get(addr)
+            if existing is not None:
+                return existing
+            self._conns[addr] = conn
+        return conn
+
+    def _active(self):
+        with self._lock:
+            return self._active_i, self._addrs[self._active_i]
+
+    def _fail_over(self, from_i):
+        """Advance the active index past ``from_i`` (idempotent under
+        racing threads: only the first mover swaps)."""
+        with self._lock:
+            if self._active_i == from_i and len(self._addrs) > 1:
+                self._active_i = (from_i + 1) % len(self._addrs)
+                self._c["failovers"] += 1
+                return True
+        return False
+
+    def _bump(self, field, n=1):
+        with self._lock:
+            self._c[field] += n
+
+    def hello(self):
+        """Greet the replica set: learn the full replica list, the
+        model signature and the server's batching knobs from whichever
+        replica answers first."""
+        last = None
+        for i in range(len(self._addrs)):
+            addr = self._addrs[(self._active_i + i) % len(self._addrs)]
+            try:
+                conn = self._conn_for(addr)
+                reply = conn.request("hello", self._origin, timeout=10.0)
+            except (ConnectionError, OSError, RuntimeError) as e:
+                last = e
+                continue
+            info = reply[1]
+            with self._lock:
+                for a in info.get("replicas", []):
+                    if a not in self._addrs:
+                        self._addrs.append(a)
+            self.signature = info.get("signature")
+            self.model = info.get("model")
+            return info
+        raise ConnectionError("no serving replica answered hello: %s"
+                              % (last,))
+
+    # -- the predict path --------------------------------------------------
+    def _request_timeout(self, budget_ms):
+        # reply can legally take budget + batch window + flush; anything
+        # past that is a dead/stalled replica and the window must fail
+        return budget_ms / 1000.0 + _CLIENT_GRACE
+
+    def predict(self, arrays, budget_ms=None):
+        """One predict: returns the list of output arrays (rows match
+        the request). ``arrays`` is one numpy array (single-input
+        models) or a list/tuple in the server's ``data_names`` order.
+        A connection-level failure health-probes the active replica
+        and replays the SAME request id on the next one."""
+        if isinstance(arrays, _np.ndarray):
+            arrays = (arrays,)
+        arrays = tuple(_np.ascontiguousarray(a) for a in arrays)
+        budget = self._budget_ms if budget_ms is None else float(budget_ms)
+        rid = "%s:%d" % (self._origin, next(self._seq))
+        self._bump("requests")
+        timeout = self._request_timeout(budget)
+        verdicts, last_err = [], None
+        with self._lock:
+            n_replicas = len(self._addrs)
+        for attempt in range(n_replicas + 1):
+            i, addr = self._active()
+            if any(a == addr for a, _, _ in verdicts):
+                break          # rotation came back to a shed replica
+            if attempt:
+                self._bump("replays")
+            try:
+                conn = self._conn_for(addr)
+                reply = conn.request("predict", rid, arrays, budget,
+                                     timeout=timeout, retries=0)
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                # health-probe before abandoning the replica: a single
+                # severed window on a live server is retried in place,
+                # a dead server fails over (the _ReplicatedConn move)
+                if self._probe(addr):
+                    continue       # alive: replay rid on the same route
+                self._fail_over(i)
+                continue
+            except RuntimeError as e:
+                # server-side err verdicts that really mean "this
+                # replica is going away mid-batch" re-route like a
+                # connection failure; anything else is the caller's
+                if "replica failed mid-batch" in str(e) \
+                        or "server stopped" in str(e):
+                    last_err = e
+                    self._fail_over(i)
+                    continue
+                raise
+            verdict = reply[0]
+            if verdict == "ok":
+                self._bump("responses")
+                return list(reply[1])
+            if verdict == "_no_reply":
+                # the in-process shortcut's rendering of a withheld
+                # reply (injected drop): same replay the wire timeout
+                # would trigger, without waiting out the clock
+                last_err = ConnectionError("request %s dropped" % rid)
+                self._fail_over(i)
+                continue
+            if verdict == "expired":
+                self._bump("expired")
+                raise DeadlineExceeded(
+                    "request %s expired before dispatch (budget %.0fms, "
+                    "%.1fms late)" % (rid, budget,
+                                      reply[1].get("late_ms", 0.0)))
+            if verdict in ("overloaded", "draining"):
+                # retriable shed: note it, try the next replica once —
+                # if the whole set sheds (or there is no other
+                # replica), surface Overloaded to the caller's backoff
+                verdicts.append((addr, verdict, reply[1]))
+                if not self._fail_over(i):
+                    break
+                continue
+            raise RuntimeError("unexpected predict verdict %r" % (reply,))
+        if verdicts:
+            self._bump("shed")
+            raise Overloaded(
+                "request %s shed by all replicas: %s"
+                % (rid, [(a, v) for a, v, _ in verdicts]),
+                verdicts=verdicts)
+        raise ConnectionError(
+            "request %s failed on every replica: %s" % (rid, last_err))
+
+    def _probe(self, addr):
+        try:
+            return self._conn_for(addr, connect_timeout=2.0).ping(
+                timeout=2.0, origin=self._origin)
+        except (ConnectionError, OSError):
+            return False
+
+    # -- observability / lifecycle ----------------------------------------
+    def server_stats(self, addr=None):
+        addr = addr if addr is not None else self._active()[1]
+        return self._conn_for(addr).request("stats", timeout=10.0)[1]
+
+    def stats(self):
+        with self._lock:
+            out = dict(self._c)
+            out["active"] = self._addrs[self._active_i]
+            out["replicas"] = list(self._addrs)
+        out["comms"] = self._stats.snapshot()
+        return out
+
+    def close(self):
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()

@@ -1,0 +1,308 @@
+"""Inference engine: AOT-compiled, donated, per-bucket predict programs.
+
+The deploy surface of the reference is ``c_predict_api.h`` — bind once,
+forward one batch at a time, every call shape-specialized by a full
+executor rebind. Serving wants the opposite cost model: a FIXED menu of
+batch shapes (the buckets), every program compiled BEFORE the first
+request lands (AOT, not first-call JIT), and zero per-request retraces
+in steady state. :class:`InferenceEngine` renders that:
+
+* **Checkpoint load.** ``InferenceEngine.from_checkpoint(prefix, epoch)``
+  loads the ``Module.save_checkpoint`` artifact (``prefix-symbol.json``
+  + ``prefix-%04d.params``) — the same files every training path in
+  this tree writes. Parameters and aux states are device-put ONCE and
+  shared by every bucket program (the serving analogue of the fused
+  Module path's shared device param store).
+* **Per-bucket donated programs.** For each bucket batch size the whole
+  symbol forward is lowered and compiled ahead of time as one XLA
+  program with the (padded) input batch DONATED — the request payload
+  buffer is dead the moment the program runs, so XLA may reuse it for
+  activations. Programs live in the same
+  :class:`~mxtpu.module.fused.ProgramCache` the fused train step uses;
+  its ``compiles``/``hits`` counters are what ``ci/check_serving.py``
+  pins the zero-per-request-retraces contract on.
+* **Determinism.** ``training=False`` (BatchNorm runs on its aux
+  running stats, Dropout is identity) and a trace-constant RNG key make
+  the program a pure function of (params, input): two replicas loaded
+  from the same checkpoint answer the same request bit-for-bit — the
+  property the failover drill's exactly-once/bit-identical acceptance
+  check rests on.
+
+The engine itself is stateless across calls and thread-safe for
+concurrent :meth:`predict` calls; the serving batcher drives it from
+one flush thread.
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import canonical_dtype
+from ..context import cpu
+from ..module.fused import ProgramCache
+from ..symbol import eval_graph
+from ..ops.registry import rng_scope
+
+__all__ = ["InferenceEngine", "parse_buckets", "parse_shape_spec"]
+
+
+def parse_buckets(spec):
+    """``MXTPU_SERVE_BUCKETS`` grammar: comma-separated batch sizes,
+    e.g. ``1,2,4,8,16,32`` — sorted, deduped, all positive."""
+    sizes = sorted({int(b) for b in str(spec).split(",") if b.strip()})
+    if not sizes or sizes[0] < 1:
+        raise ValueError("bucket spec %r needs positive batch sizes"
+                         % (spec,))
+    return tuple(sizes)
+
+
+def parse_shape_spec(spec):
+    """``MXTPU_SERVE_DATA_SHAPES`` grammar: ``name=dims;name=dims``
+    with dims a comma list of PER-SAMPLE dimensions (no batch dim),
+    e.g. ``data=3,32,32`` or ``data=64;mask=64``."""
+    shapes = {}
+    for item in str(spec).split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, dims = item.partition("=")
+        if not dims:
+            raise ValueError("shape spec %r needs name=dims" % (item,))
+        shapes[name.strip()] = tuple(
+            int(d) for d in dims.split(",") if d.strip())
+    if not shapes:
+        raise ValueError("empty data shape spec %r" % (spec,))
+    return shapes
+
+
+class InferenceEngine:
+    """Per-bucket AOT predict programs over one loaded model."""
+
+    def __init__(self, symbol, arg_params, aux_params, data_shapes,
+                 buckets=(1, 2, 4, 8, 16, 32), ctx=None, dtype="float32",
+                 warm=True):
+        self._symbol = symbol
+        self._ctx = ctx if ctx is not None else cpu()
+        self._dev = self._ctx.jax_device()
+        self._buckets = parse_buckets(
+            buckets if isinstance(buckets, str)
+            else ",".join(str(b) for b in buckets))
+        self._dtype = canonical_dtype(dtype)
+        # data inputs in a canonical order; everything else in the
+        # symbol's argument list must come from the checkpoint
+        self._data_names = tuple(sorted(data_shapes))
+        self._sample_shapes = {n: tuple(data_shapes[n])
+                               for n in self._data_names}
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        missing = [n for n in self._data_names if n not in arg_names]
+        if missing:
+            raise ValueError("data inputs %r are not arguments of the "
+                             "symbol (args: %r)" % (missing, arg_names))
+        # three kinds of symbol arguments: serving inputs (data_shapes),
+        # checkpoint parameters, and loss-head leftovers (label vars a
+        # training symbol carries — SoftmaxOutput's forward ignores its
+        # label, so they are fed as trace-constant zeros per bucket)
+        self._param_names = tuple(n for n in arg_names
+                                  if n not in self._data_names
+                                  and n in arg_params)
+        self._extra_names = tuple(n for n in arg_names
+                                  if n not in self._data_names
+                                  and n not in arg_params)
+        self._aux_names = tuple(aux_names)
+        # one shared device-resident copy of params/aux for all buckets
+        self._param_vals = tuple(
+            jax.device_put(arg_params[n].asnumpy(), self._dev)
+            for n in self._param_names)
+        self._aux_vals = tuple(
+            jax.device_put(aux_params[n].asnumpy(), self._dev)
+            for n in self._aux_names)
+        self.cache = ProgramCache()
+        self._build_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._stats = {"predicts": 0, "rows": 0, "pad_rows": 0}
+        if warm:
+            self.warm()
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, data_shapes, **kw):
+        """Load a ``save_checkpoint`` artifact (symbol json + params)
+        into a ready engine — the serving half of ``Module.load``."""
+        from ..model import load_checkpoint
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return cls(symbol, arg_params, aux_params, data_shapes, **kw)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def buckets(self):
+        return self._buckets
+
+    @property
+    def max_bucket(self):
+        return self._buckets[-1]
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    def signature(self):
+        """The wire-visible input contract (hello reply)."""
+        return {"data_names": list(self._data_names),
+                "sample_shapes": {n: list(s) for n, s
+                                  in self._sample_shapes.items()},
+                "dtype": str(_np.dtype(self._dtype)),
+                "buckets": list(self._buckets)}
+
+    def stats(self):
+        with self._stats_lock:
+            out = dict(self._stats)
+        out.update(self.cache.stats())
+        return out
+
+    def check_rows(self, arrays):
+        """Validate one request payload (a list/tuple of numpy arrays,
+        one per data input in ``data_names`` order). Returns the row
+        count; raises ValueError naming the mismatch."""
+        if len(arrays) != len(self._data_names):
+            raise ValueError(
+                "payload has %d arrays, model takes %d inputs %r"
+                % (len(arrays), len(self._data_names), self._data_names))
+        rows = None
+        for name, arr in zip(self._data_names, arrays):
+            arr = _np.asarray(arr)
+            want = self._sample_shapes[name]
+            if arr.ndim != len(want) + 1 or tuple(arr.shape[1:]) != want:
+                raise ValueError(
+                    "input %r has shape %r, want (rows,)+%r"
+                    % (name, tuple(arr.shape), want))
+            if rows is None:
+                rows = int(arr.shape[0])
+            elif int(arr.shape[0]) != rows:
+                raise ValueError(
+                    "inputs disagree on rows: %r has %d, expected %d"
+                    % (name, arr.shape[0], rows))
+        if rows == 0:
+            raise ValueError("empty request (0 rows)")
+        if rows > self.max_bucket:
+            raise ValueError(
+                "request rows %d exceed the largest bucket %d"
+                % (rows, self.max_bucket))
+        return rows
+
+    def bucket_for(self, rows):
+        """Smallest configured bucket holding ``rows``."""
+        for b in self._buckets:
+            if rows <= b:
+                return b
+        raise ValueError("rows %d exceed the largest bucket %d"
+                         % (rows, self.max_bucket))
+
+    # -- program construction ---------------------------------------------
+    def _extra_shapes(self, bucket):
+        """Inferred shapes of the loss-head leftovers for ``bucket``
+        (label vars scale with the batch: SoftmaxOutput's shape hint
+        derives them from the data shape)."""
+        if not self._extra_names:
+            return ()
+        kwargs = {n: (bucket,) + self._sample_shapes[n]
+                  for n in self._data_names}
+        arg_shapes, _outs, _aux = self._symbol.infer_shape(**kwargs)
+        by_name = dict(zip(self._symbol.list_arguments(), arg_shapes))
+        missing = [n for n in self._extra_names if by_name.get(n) is None]
+        if missing:
+            raise ValueError(
+                "symbol arguments %r are neither checkpoint parameters "
+                "nor declared data inputs, and their shapes cannot be "
+                "inferred — pass them in data_shapes or the checkpoint"
+                % (missing,))
+        return tuple((n, tuple(by_name[n])) for n in self._extra_names)
+
+    def _build_program(self, bucket):
+        """Lower + compile the bucket's forward AOT. Donation: the
+        padded input batch (argument 0) is donated — request payload
+        buffers are dead once the program runs."""
+        data_names = self._data_names
+        param_names = self._param_names
+        aux_names = self._aux_names
+        outputs_ref = self._symbol._outputs
+        extra_shapes = self._extra_shapes(bucket)
+        dtype = self._dtype
+
+        def predict_fn(data_vals, param_vals, aux_vals):
+            feed = dict(zip(param_names, param_vals))
+            feed.update(zip(aux_names, aux_vals))
+            feed.update(zip(data_names, data_vals))
+            for n, s in extra_shapes:
+                # loss-head label vars: forward ignores them, but the
+                # graph evaluator requires every variable bound
+                feed[n] = jnp.zeros(s, dtype)
+            # trace-constant key: inference is deterministic by
+            # construction (training=False; Dropout is identity), the
+            # key only satisfies ops that demand an rng scope
+            with rng_scope(jax.random.PRNGKey(0)):
+                outs, _aux_updates = eval_graph(outputs_ref, feed, False)
+            return tuple(outs)
+
+        jitted = jax.jit(predict_fn, donate_argnums=(0,))
+        data_abs = tuple(
+            jax.ShapeDtypeStruct((bucket,) + self._sample_shapes[n],
+                                 self._dtype)
+            for n in data_names)
+        param_abs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                          for v in self._param_vals)
+        aux_abs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                        for v in self._aux_vals)
+        with warnings.catch_warnings():
+            # most models cannot alias the input buffer into an output
+            # buffer; the donation is still correct (the batch is dead),
+            # so the advisory is pure noise at compile time
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return jitted.lower(data_abs, param_abs, aux_abs).compile()
+
+    def program(self, bucket):
+        """The compiled program for ``bucket`` (AOT-cached)."""
+        if bucket not in self._buckets:
+            raise ValueError("no bucket %d (configured: %r)"
+                             % (bucket, self._buckets))
+        program, _hit = self.cache.get(
+            ("predict", bucket), lambda: self._build_program(bucket))
+        return program
+
+    def warm(self):
+        """Compile every bucket program NOW — serving starts with the
+        full menu ready, so no request ever pays a trace."""
+        for b in self._buckets:
+            self.program(b)
+        return len(self._buckets)
+
+    # -- execution ---------------------------------------------------------
+    def predict(self, arrays, rows=None):
+        """Run one (possibly coalesced) batch: pad ``arrays`` into the
+        smallest bucket, dispatch the AOT program, return the outputs
+        as numpy arrays sliced back to ``rows``."""
+        if rows is None:
+            rows = self.check_rows(arrays)
+        bucket = self.bucket_for(rows)
+        program = self.program(bucket)
+        data_vals = []
+        for name, arr in zip(self._data_names, arrays):
+            arr = _np.ascontiguousarray(arr, dtype=self._dtype)
+            if rows < bucket:
+                padded = _np.zeros((bucket,) + self._sample_shapes[name],
+                                   self._dtype)
+                padded[:rows] = arr
+                arr = padded
+            data_vals.append(jax.device_put(arr, self._dev))
+        outs = program(tuple(data_vals), self._param_vals,
+                       self._aux_vals)
+        with self._stats_lock:
+            self._stats["predicts"] += 1
+            self._stats["rows"] += rows
+            self._stats["pad_rows"] += bucket - rows
+        return [_np.asarray(o)[:rows] for o in outs]

@@ -1,0 +1,397 @@
+"""ModelServer: the serving replica — PR-2 transport, serving dispatch.
+
+One replica = one :class:`ModelServer` over one
+:class:`~mxtpu.serving.engine.InferenceEngine` and one
+:class:`~mxtpu.serving.batcher.DynamicBatcher`. There is NO new RPC
+layer: the listener is kvstore_async's threaded ``_TCPServer`` with the
+same zero-copy pickle-5 frames, per-connection pipelining, raw-preamble
+``MXTPU_PS_TOKEN`` auth, and the ``MXTPU_PS_LOCAL`` same-process
+shortcut (the server registers in the shared local-server map, so an
+in-process client dispatches straight into :meth:`_dispatch` under the
+same admission/batching/fault points a wire request sees).
+
+The serving handler differs from the kvstore handler in exactly one
+way: a reply can be WITHHELD (``_NO_REPLY``) — the deterministic
+rendering of a dropped request (``serve.request``/``kind=drop``): the
+client's per-call deadline fires, its window fails, and the retry path
+replays the request id on another replica, exactly like a frame lost on
+a real wire.
+
+Lifecycle contract (docs/serving.md):
+
+* ``start()`` — AOT-warm every bucket program, then listen. A client's
+  first request never pays a compile.
+* ``drain()`` — two-phase graceful exit: stop admissions (every new
+  predict gets the retriable ``draining`` verdict, pushing clients to
+  the other replicas), flush everything already admitted, then return.
+  The SIGTERM handler in ``__main__`` runs drain-then-stop, which is
+  what makes ``tools/launch.py``'s ``_reap`` escalation graceful for
+  serving children: TERM drains, KILL is only for stragglers.
+* ``stop()`` — sever every established conversation BEFORE the
+  listener's shutdown poll (a stopped replica must look crashed to its
+  clients immediately — same contract as ``ParameterServer.stop``).
+* ``kill()`` — the fault injector's crash: refuse new conversations
+  synchronously, tear down on a side thread.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import socket
+import socketserver
+import threading
+import time
+
+from .. import fault as _fault
+from .. import kvstore_async as _ka
+from .batcher import DynamicBatcher
+
+__all__ = ["ModelServer", "queue_depth", "batch_deadline_ms",
+           "default_budget_ms"]
+
+_log = logging.getLogger(__name__)
+
+# withheld reply sentinel: the wire handler sends nothing (the client's
+# deadline notices); the in-process shortcut returns it verbatim and the
+# serving client raises the same ConnectionError the timeout would
+_NO_REPLY = ("_no_reply",)
+
+
+def queue_depth():
+    """MXTPU_SERVE_QUEUE_DEPTH: admitted-but-unflushed request bound —
+    at depth, new predicts shed with the retriable overloaded verdict."""
+    return int(os.environ.get("MXTPU_SERVE_QUEUE_DEPTH", "256"))
+
+
+def batch_deadline_ms():
+    """MXTPU_SERVE_BATCH_DEADLINE_MS: longest a queued request waits
+    for batch company before the batcher flushes anyway."""
+    return float(os.environ.get("MXTPU_SERVE_BATCH_DEADLINE_MS", "5"))
+
+
+def default_budget_ms():
+    """MXTPU_SERVE_DEADLINE_MS: per-request latency budget applied when
+    the client sent none; expired requests are dropped pre-dispatch."""
+    return float(os.environ.get("MXTPU_SERVE_DEADLINE_MS", "1000"))
+
+
+class _ServeHandler(socketserver.BaseRequestHandler):
+    """kvstore_async's ``_Handler`` contract, serving-shaped.
+
+    Two differences from the kvstore handler, both load-bearing:
+
+    * **Pipelined dispatch.** A predict is ADMITTED, not awaited: the
+      loop registers a resolve callback and immediately reads the next
+      frame, so one connection's in-flight window (``MXTPU_PS_WINDOW``)
+      lands many requests in the same coalesced batch instead of
+      serializing them through one handler thread. Replies pair by
+      correlation id — the client's ``_Channel`` already handles
+      out-of-order completion. A dedicated per-connection sender
+      thread writes replies, so a slow client's socket can stall only
+      its own connection, never the batcher's flush loop.
+    * **Withheld replies.** ``_NO_REPLY`` (an injected
+      ``serve.request``/``drop``) sends nothing: the client's per-call
+      deadline fires, its window fails, and the request id replays on
+      another replica — a dropped request behaves exactly like a frame
+      lost on a real wire.
+
+    The transport fault points stay: ``server.recv`` fires per frame in
+    the read loop, ``server.send`` fires per reply in the sender (so a
+    sever/kill on ``op=predict`` lands AFTER compute — the lost-ack
+    path the replay drills need).
+    """
+
+    def handle(self):
+        server = self.server.owner
+        sock = self.request
+        with server._active_lock:
+            server._active.add(sock)
+        import queue as _queue
+        out_q = _queue.Queue()
+        dead = threading.Event()
+
+        def _send_loop():
+            while not dead.is_set():
+                try:
+                    item = out_q.get(timeout=0.2)
+                except _queue.Empty:
+                    continue
+                if item is None:
+                    return
+                cid, op, key, reply = item
+                try:
+                    _fault.fire("server.send", op=op, key=key,
+                                sock=sock, server=server)
+                    _ka._send_frame(sock, (cid, reply))
+                except (ConnectionError, EOFError, OSError):
+                    dead.set()
+                    try:
+                        sock.close()     # unblocks the read loop too
+                    except OSError:
+                        pass
+                    return
+
+        sender = threading.Thread(target=_send_loop, daemon=True,
+                                  name="mxtpu-serve-tx")
+        sender.start()
+        try:
+            if server._token:
+                import hmac
+                expected = _ka._auth_blob(server._token)
+                got = _ka._recv_exact(sock, len(expected))
+                if not hmac.compare_digest(got, expected):
+                    return
+            while not dead.is_set():
+                cid, msg = _ka._recv_frame(sock)
+                op = msg[0]
+                key = msg[1] if len(msg) > 1 and \
+                    isinstance(msg[1], (str, int)) else None
+                _fault.fire("server.recv", op=op, key=key,
+                            sock=sock, server=server)
+                if op == "predict":
+                    res = server._admit(msg)
+                    if res == _NO_REPLY:
+                        continue
+                    if isinstance(res, tuple):   # immediate verdict
+                        out_q.put((cid, op, key, res))
+                    else:                        # parked: reply at flush
+                        res.on_resolve(
+                            lambda reply, cid=cid, key=key:
+                            out_q.put((cid, "predict", key, reply)))
+                    continue
+                reply = server._dispatch(msg)
+                if reply != _NO_REPLY:
+                    out_q.put((cid, op, key, reply))
+                if op == "stop":
+                    break
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            out_q.put(None)
+            sender.join(timeout=5.0)
+            dead.set()
+            with server._active_lock:
+                server._active.discard(sock)
+
+
+class ModelServer:
+    """One serving replica: model engine + dynamic batcher behind the
+    dist_async wire."""
+
+    def __init__(self, engine, port=0, host="127.0.0.1", token=None,
+                 replicas=None, model_name="model", queue_depth_=None,
+                 batch_deadline_ms_=None, default_budget_ms_=None):
+        self._engine = engine
+        self._model_name = model_name
+        self._tcp = _ka._TCPServer((host, port), _ServeHandler)
+        self._tcp.owner = self
+        self._token = token if token is not None \
+            else os.environ.get("MXTPU_PS_TOKEN") or None
+        # the replica set this server advertises at hello: itself plus
+        # its peers (MXTPU_SERVE_ADDRS, exported by tools/launch.py
+        # --serve N) — how clients learn where to fail over
+        if replicas is None:
+            replicas = [a.strip() for a in
+                        os.environ.get("MXTPU_SERVE_ADDRS", "").split(",")
+                        if a.strip()]
+        self._replicas = list(replicas)
+        if self.address not in self._replicas:
+            self._replicas.insert(0, self.address)
+        self._depth = queue_depth() if queue_depth_ is None \
+            else int(queue_depth_)
+        self._deadline_ms = batch_deadline_ms() \
+            if batch_deadline_ms_ is None else float(batch_deadline_ms_)
+        self._budget_ms = default_budget_ms() \
+            if default_budget_ms_ is None else float(default_budget_ms_)
+        self._batcher = DynamicBatcher(engine, self._depth,
+                                       self._deadline_ms, server=self)
+        self._draining = False
+        self._c_lock = threading.Lock()
+        self._c = {"requests": 0, "responses": 0, "shed_overloaded": 0,
+                   "shed_draining": 0, "expired": 0, "dropped": 0,
+                   "dup_requests": 0, "errors": 0}
+        # request-id dedupe window (observability, not correctness:
+        # predict is pure, a replay recomputes the same bits) — bounded
+        self._seen_rids = collections.OrderedDict()
+        self._seen_max = 4096
+        self._active = set()
+        self._active_lock = threading.Lock()
+        self._thread = None
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def address(self):
+        h, p = self._tcp.server_address
+        return "%s:%d" % (h, p)
+
+    def start(self):
+        self._engine.warm()
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True,
+            name="mxtpu-serve-listener")
+        self._thread.start()
+        with _ka._LOCAL_GUARD:
+            # same-process clients skip socket+pickle, same dispatch
+            _ka._LOCAL_SERVERS[self.address] = self
+        return self
+
+    def drain(self, timeout=30.0):
+        """Graceful phase: refuse new work, flush admitted work."""
+        self._draining = True
+        return self._batcher.drain(timeout=timeout)
+
+    def stop(self):
+        self._draining = True
+        self._tcp.dying = True
+        self._batcher.stop()
+        with _ka._LOCAL_GUARD:
+            if _ka._LOCAL_SERVERS.get(self.address) is self:
+                del _ka._LOCAL_SERVERS[self.address]
+        # sever established conversations BEFORE the listener's
+        # shutdown poll — a dead replica must look dead NOW, failover
+        # latency is client-visible (same contract as ParameterServer)
+        with self._active_lock:
+            active = list(self._active)
+        for s in active:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._tcp.shutdown()
+        self._tcp.server_close()
+
+    def kill(self):
+        """Crash as the fault injector (kind=kill) sees it: refuse new
+        conversations from THIS instant, full teardown on the side."""
+        self._tcp.dying = True
+        threading.Thread(target=self.stop, daemon=True).start()
+
+    # -- dispatch ----------------------------------------------------------
+    def _note_rid(self, rid):
+        with self._c_lock:
+            dup = rid in self._seen_rids
+            if dup:
+                self._seen_rids.move_to_end(rid)
+                self._c["dup_requests"] += 1
+            else:
+                self._seen_rids[rid] = True
+                while len(self._seen_rids) > self._seen_max:
+                    self._seen_rids.popitem(last=False)
+        return dup
+
+    def _bump(self, field, n=1):
+        with self._c_lock:
+            self._c[field] += n
+
+    def _account_reply(self, reply):
+        with self._c_lock:
+            if reply[0] == "ok":
+                self._c["responses"] += 1
+            elif reply[0] == "expired":
+                self._c["expired"] += 1
+            else:
+                self._c["errors"] += 1
+
+    def _admit(self, msg):
+        """Admission control for one ``("predict", rid, arrays,
+        budget_ms)`` frame. Returns an immediate verdict tuple
+        (shed/draining/err), ``_NO_REPLY`` (injected drop), or the
+        parked :class:`~mxtpu.serving.batcher.Request` whose terminal
+        reply arrives at batch flush. rid is the client's (origin, seq)
+        identity — a failover replay carries the ORIGINAL rid, which is
+        what the exactly-once accounting in the drills keys on."""
+        _, rid, arrays, budget_ms = msg
+        arrival = time.monotonic()
+        self._bump("requests")
+        self._note_rid(rid)
+        # admission-point fault hook: delay burns request budget
+        # (deadline-expiry drills), drop loses the admitted request
+        # without a reply (the client's deadline + replay path)
+        act = _fault.fire("serve.request", op="predict", key=rid,
+                          server=self)
+        if act == "drop":
+            self._bump("dropped")
+            return _NO_REPLY
+        if self._draining or self._tcp.dying:
+            self._bump("shed_draining")
+            return ("draining", {"replicas": self._replicas})
+        try:
+            rows = self._engine.check_rows(arrays)
+        except ValueError as e:
+            self._bump("errors")
+            return ("err", "bad predict payload: %s" % e)
+        budget = self._budget_ms if budget_ms is None else float(budget_ms)
+        deadline = arrival + budget / 1000.0
+        # the park bound: budget + batch window + a flush allowance (an
+        # injected mid-batch kill resolves every parked request, so the
+        # bound only matters for genuine flusher bugs)
+        req = self._batcher.submit(
+            rid, arrays, rows, deadline,
+            wait_bound=(budget / 1000.0 + self._deadline_ms / 1000.0
+                        + _FLUSH_GRACE))
+        if isinstance(req, tuple):          # shed verdict, not parked
+            self._bump("shed_overloaded")
+            return req
+        req.on_resolve(self._account_reply)
+        return req
+
+    def _do_predict(self, msg):
+        """Blocking form for the in-process shortcut (each caller is
+        its own thread, so concurrent local predicts still coalesce)."""
+        res = self._admit(msg)
+        if res == _NO_REPLY or isinstance(res, tuple):
+            return res
+        return res.wait(res.wait_bound)
+
+    def stats(self):
+        with self._c_lock:
+            counters = dict(self._c)
+        return {"address": self.address, "model": self._model_name,
+                "draining": self._draining, "replicas": self._replicas,
+                "queue_depth": self._depth,
+                "batch_deadline_ms": self._deadline_ms,
+                "counters": counters,
+                "batcher": self._batcher.stats(),
+                "engine": self._engine.stats()}
+
+    def _dispatch(self, msg):
+        cmd = msg[0]
+        if cmd == "predict":
+            return self._do_predict(msg)
+        if cmd == "hello":
+            # clients learn the replica set + model signature here —
+            # the serving analogue of the kvstore shard map at hello
+            return ("ok", {"model": self._model_name,
+                           "replicas": self._replicas,
+                           "draining": self._draining,
+                           "queue_depth": self._depth,
+                           "batch_deadline_ms": self._deadline_ms,
+                           "default_budget_ms": self._budget_ms,
+                           "signature": self._engine.signature()})
+        if cmd == "ping":
+            return ("ok", {"draining": self._draining,
+                           "pending": self._batcher.pending()})
+        if cmd == "stats":
+            return ("ok", self.stats())
+        if cmd == "drain":
+            # operator/drill hook: same two-phase path as SIGTERM
+            self._draining = True
+            threading.Thread(target=self._batcher.drain, kwargs={
+                "timeout": float(msg[1]) if len(msg) > 1 else 30.0},
+                daemon=True).start()
+            return ("ok", {"draining": True})
+        if cmd == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return ("ok",)
+        return ("err", "unknown serving command %r" % (cmd,))
+
+
+# extra seconds a parked handler waits past (budget + batch window) for
+# its flush before declaring the flusher stalled
+_FLUSH_GRACE = float(os.environ.get("MXTPU_SERVE_FLUSH_GRACE", "30"))

@@ -1,0 +1,143 @@
+"""Serving client driver for the kill -9 failover end-to-end test.
+
+Launched by ``tools/launch.py -n 1 --serve 2 ...`` as the worker
+command: the two serving replicas it talks to are REAL processes
+(``python -m mxtpu.serving``), and the test harness kill -9s one of
+them mid-run by parsing the launcher's ``serve replica I pid=P`` line.
+
+The driver fires SERVING_TOTAL_REQUESTS single-row predicts from
+SERVING_CLIENT_THREADS concurrent threads through one shared
+:class:`mxtpu.serving.ServingClient`. Request i's payload derives from
+a fixed seed, every answer is recorded by request index, and a progress
+file counts completions so the harness can time its kill. Retriable
+sheds back off and retry (bounded), so the only terminal outcomes are
+an answer or a hard error.
+
+Because the replicas serve a SINGLE batch bucket, a request's bits do
+not depend on which batch composition it coalesced into
+(docs/serving.md "Determinism") — so the response table of a killed run
+must match an uninterrupted run's BIT FOR BIT, which is exactly what
+tests/test_dist_launch.py::test_serving_replica_kill_matches_uninterrupted
+asserts, along with the exactly-once delivery accounting and the
+failover/batching counters in the summary.
+
+Env: SERVING_TEST_DIR (output), SERVING_PROGRESS_FILE,
+SERVING_TOTAL_REQUESTS (default 40), SERVING_CLIENT_THREADS (default
+4), SERVING_REQUEST_SLEEP (pacing seconds, default 0.02).
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu  # noqa: F401,E402  (package init)
+from mxtpu.serving import Overloaded, ServingClient          # noqa: E402
+
+IN_DIM = 6
+out_dir = os.environ["SERVING_TEST_DIR"]
+progress_path = os.environ.get("SERVING_PROGRESS_FILE")
+total = int(os.environ.get("SERVING_TOTAL_REQUESTS", "40"))
+n_threads = int(os.environ.get("SERVING_CLIENT_THREADS", "4"))
+pacing = float(os.environ.get("SERVING_REQUEST_SLEEP", "0.02"))
+
+
+def main():
+    cli = ServingClient(budget_ms=10000.0)   # MXTPU_SERVE_ADDRS from env
+    info = cli.hello()
+    answers = {}
+    delivered = {}
+    errors = {}
+    done = [0]
+    lock = threading.Lock()
+
+    def one(i):
+        x = (np.arange(IN_DIM, dtype="f").reshape(1, IN_DIM)
+             * 0.01 + i * 0.1)
+        for attempt in range(20):
+            try:
+                out = cli.predict(x)[0]
+            except Overloaded:
+                time.sleep(0.05)             # retriable: back off, retry
+                continue
+            except Exception as e:
+                with lock:
+                    errors[i] = "%s: %s" % (type(e).__name__, e)
+                return
+            with lock:
+                answers[i] = out
+                delivered[i] = delivered.get(i, 0) + 1
+                done[0] += 1
+                n = done[0]
+                if progress_path:
+                    # written under the lock: concurrent writers would
+                    # race each other's tmp-and-rename
+                    tmp = progress_path + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write(str(n))
+                    os.replace(tmp, progress_path)
+            return
+        with lock:
+            errors[i] = "shed on every retry"
+
+    def runner(tid):
+        for i in range(tid, total, n_threads):
+            try:
+                one(i)
+            except BaseException as e:       # a lost request must be
+                with lock:                   # visible, never silent
+                    errors.setdefault(i, "runner: %s: %s"
+                                      % (type(e).__name__, e))
+            if pacing:
+                time.sleep(pacing)
+
+    threads = [threading.Thread(target=runner, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+
+    # the surviving replica's server-side story (stats() counters)
+    server_stats = None
+    for addr in cli.stats()["replicas"]:
+        try:
+            server_stats = cli.server_stats(addr)
+            break
+        except (ConnectionError, RuntimeError, OSError):
+            continue
+
+    np.savez(os.path.join(out_dir, "answers.npz"),
+             **{"r%03d" % i: v for i, v in answers.items()})
+    summary = {
+        "total": total,
+        "answered": len(answers),
+        "errors": errors,
+        "exactly_once": all(n == 1 for n in delivered.values()),
+        "client": {k: v for k, v in cli.stats().items()
+                   if k not in ("comms",)},
+        "replicas_learned": sorted(cli.stats()["replicas"]),
+        "hello_model": info.get("model"),
+        "server": {
+            "counters": server_stats["counters"] if server_stats else None,
+            "batcher": server_stats["batcher"] if server_stats else None,
+        },
+    }
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f)
+    cli.close()
+    if errors:
+        print("CLIENT_ERRORS %r" % errors, flush=True)
+        return 1
+    print("CLIENT_OK answered=%d" % len(answers), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,3 +120,38 @@ def test_kvstore_bench_contract(tmp_path):
     assert payload["wire"]["retransmits"] == 0
     assert payload["wire"]["bytes_sent"] > 0
     assert payload["wire"]["coalesced_subs"] >= 16
+
+
+def test_serving_bench_contract():
+    """tools/bench_serving.py: exactly one JSON line, rc 0, with the
+    offered-load sweep fields the perf trajectory (docs/perf_analysis.md
+    "Serving") is tracked by — tiny levels, CPU-only loopback."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT,
+               MXTPU_BENCH_TINY="1", MXTPU_PS_HEARTBEAT="0")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_serving.py"),
+         "--no-write"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, "must print exactly ONE JSON line"
+    payload = json.loads(lines[0])
+    assert payload["bench"] == "serving_loopback"
+    assert payload["tiny"] is True
+    assert payload["transport"] in ("local", "tcp")
+    assert payload["buckets"] and payload["queue_depth"] >= 1
+    assert payload["levels"], "offered-load sweep missing"
+    for row in payload["levels"]:
+        for field in ("clients", "attempts", "answered", "req_s",
+                      "shed", "shed_rate", "expired"):
+            assert isinstance(row[field], (int, float)), field
+        assert row["p50_ms"] > 0 and row["p99_ms"] >= row["p50_ms"]
+        # every attempt has exactly one terminal outcome
+        assert row["answered"] + row["shed"] + row["expired"] \
+            + row["errors"] == row["attempts"]
+    # both transports always reported: local headline + tcp sub-object
+    assert isinstance(payload["tcp"]["req_s"], (int, float))
+    # the dynamic batcher actually batched, and steady state never
+    # retraced (the AOT bucket menu absorbed every request)
+    assert payload["batches"] <= payload["batched_requests"]
+    assert payload["retraces_after_warmup"] == 0

@@ -373,3 +373,157 @@ def test_worker_respawn_resumes_and_matches_uninterrupted(tmp_path):
             params[name], params2[name], rtol=1e-6, atol=1e-7,
             err_msg="respawned run diverged from uninterrupted run "
                     "at %s" % name)
+
+
+# ---------------------------------------------------------------------------
+# model serving (ISSUE 8): two REAL replica processes, kill -9 failover
+# ---------------------------------------------------------------------------
+
+_SERVING_CKPT_SCRIPT = """
+import sys
+sys.path.insert(0, sys.argv[2])
+import mxtpu as mx
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+mod = mx.mod.Module(net, data_names=("data",),
+                    label_names=("softmax_label",))
+mod.bind(data_shapes=[("data", (8, 6))],
+         label_shapes=[("softmax_label", (8,))])
+mod.init_params(mx.init.Uniform(0.1))
+mod.save_checkpoint(sys.argv[1], 0)
+print("CKPT_OK")
+"""
+
+
+def _run_serving(tmp_path, tag, prefix, kill_at_progress=None):
+    """One launcher run: 2 serving replica processes + 1 client-driver
+    worker (tests/nightly/serving_client_driver.py). With
+    ``kill_at_progress``, a REAL external kill -9 lands on serving
+    replica 0 (the client's initial active route) once the driver's
+    progress file shows that many completed requests — mid-stream,
+    mid-batch-window, no injection harness. Returns (stdout, summary
+    dict, {request index: answer bits})."""
+    import json
+    import re
+    import signal
+    import threading
+    import time
+    import numpy as np
+    root = os.path.join(os.path.dirname(__file__), "..")
+    out_dir = tmp_path / ("out_" + tag)
+    progress = tmp_path / ("progress_" + tag)
+    out_dir.mkdir()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SERVING_TEST_DIR"] = str(out_dir)
+    env["SERVING_PROGRESS_FILE"] = str(progress)
+    env["SERVING_TOTAL_REQUESTS"] = "40"
+    env["SERVING_CLIENT_THREADS"] = "4"
+    env["MXTPU_SERVE_BATCH_DEADLINE_MS"] = "25"
+    env.pop("MXTPU_FAULT_SPEC", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "1", "--serve", "2",
+         "--serve-model", prefix, "--serve-epoch", "0",
+         "--serve-data-shapes", "data=6", "--serve-buckets", "8",
+         "--port", str(_free_port()),
+         sys.executable + " " + os.path.join(
+             root, "tests", "nightly", "serving_client_driver.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True)
+    lines = []
+    reader = threading.Thread(
+        target=lambda: lines.extend(iter(proc.stdout.readline, "")),
+        daemon=True)
+    reader.start()
+    try:
+        if kill_at_progress is not None:
+            pid = None
+            killed = False
+            deadline = time.time() + 300
+            while time.time() < deadline and proc.poll() is None:
+                if pid is None:
+                    for line in list(lines):
+                        m = re.search(r"serve replica 0 pid=(\d+)", line)
+                        if m:
+                            pid = int(m.group(1))
+                            break
+                if pid is not None and progress.exists():
+                    try:
+                        step = int(progress.read_text() or 0)
+                    except ValueError:
+                        step = 0
+                    if step >= kill_at_progress:
+                        os.kill(pid, signal.SIGKILL)
+                        killed = True
+                        break
+                time.sleep(0.02)
+            assert killed, "never killed replica 0 (pid=%r):\n%s" \
+                % (pid, "".join(lines[-20:]))
+        proc.wait(timeout=420)
+    except subprocess.TimeoutExpired:
+        import signal as _sig
+        os.killpg(os.getpgid(proc.pid), _sig.SIGKILL)
+        proc.wait()
+        raise
+    finally:
+        reader.join(timeout=10)
+    out = "".join(lines)
+    assert proc.returncode == 0, out[-3000:]
+    assert "CLIENT_OK" in out, out[-3000:]
+    with open(out_dir / "summary.json") as f:
+        summary = json.load(f)
+    with np.load(out_dir / "answers.npz") as z:
+        answers = {k: z[k] for k in z.files}
+    return out, summary, answers
+
+
+def test_serving_replica_kill_matches_uninterrupted(tmp_path):
+    """Acceptance drill (ISSUE 8): two serving replicas under
+    concurrent client load, replica 0 killed with a REAL kill -9
+    mid-stream. Every acknowledged request is answered exactly once,
+    the response table is BIT-FOR-BIT identical to an uninterrupted
+    run's (single-bucket determinism), the client's failover counters
+    fired, and the surviving replica's server.stats() shows the
+    batching story."""
+    import numpy as np
+    root = os.path.join(os.path.dirname(__file__), "..")
+    prefix = str(tmp_path / "served_model")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", _SERVING_CKPT_SCRIPT, prefix, root],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0 and "CKPT_OK" in r.stdout, r.stderr[-2000:]
+
+    out, summary, answers = _run_serving(tmp_path, "killed", prefix,
+                                         kill_at_progress=8)
+    assert summary["answered"] == summary["total"] == 40
+    assert summary["exactly_once"] is True
+    assert not summary["errors"]
+    cli = summary["client"]
+    assert cli["failovers"] >= 1, cli
+    assert cli["replays"] >= 1, cli
+    srv = summary["server"]
+    assert srv["counters"]["responses"] >= 1
+    assert srv["batcher"]["batches"] >= 1
+    # dynamic batching under concurrent load: fewer device dispatches
+    # than requests on the surviving replica
+    assert srv["batcher"]["batches"] <= srv["batcher"]["batched_requests"]
+
+    out2, summary2, answers2 = _run_serving(tmp_path, "clean", prefix)
+    assert summary2["answered"] == 40
+    assert summary2["client"]["failovers"] == 0
+    assert set(answers) == set(answers2)
+    for k in answers:
+        np.testing.assert_array_equal(
+            answers[k], answers2[k],
+            err_msg="response %s diverged from the uninterrupted run "
+                    "— an acknowledged request was lost, double-"
+                    "answered, or recomputed differently across the "
+                    "kill -9 failover" % k)

@@ -1107,3 +1107,128 @@ print("UNREACHABLE", flush=True)
     finally:
         kv.close()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving rows: the model-serving request path through the same harness
+# (mxtpu/serving; the full behavior matrix lives in tests/test_serving.py,
+# these are the two wire-level rows of the fault matrix —
+# sever @ server.send (op=predict)  -> lost ack AFTER compute: replay
+#                                      with the ORIGINAL request id,
+#                                      answered exactly once client-side
+# kill  @ serve.batch               -> replica dies mid-batch: clients
+#                                      fail over, replays answered by
+#                                      the surviving replica)
+# ---------------------------------------------------------------------------
+
+def _serving_pair(batch_deadline_ms=10):
+    from mxtpu.serving import InferenceEngine, ModelServer
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    ap, xp = mod.get_params()
+
+    def mkeng():
+        return InferenceEngine(net, ap, xp, {"data": (6,)},
+                               buckets=(4,), warm=False)
+
+    s1 = ModelServer(mkeng(), model_name="fm",
+                     batch_deadline_ms_=batch_deadline_ms).start()
+    s2 = ModelServer(mkeng(), model_name="fm",
+                     batch_deadline_ms_=batch_deadline_ms,
+                     replicas=[s1.address]).start()
+    s1._replicas.append(s2.address)
+    return s1, s2, mkeng
+
+
+def test_serving_spec_points_validate():
+    rules = fault.parse_spec(
+        "kind=drop,point=serve.request,op=predict,nth=2;"
+        "kind=kill,point=serve.batch")
+    assert rules[0].point == "serve.request"
+    assert rules[1].point == "serve.batch"
+    # signal kinds stay training-loop-only; transport kinds are free
+    with pytest.raises(ValueError, match="worker.step"):
+        fault.parse_spec("kind=nan_grad,point=serve.request")
+    with pytest.raises(ValueError, match="worker.step"):
+        fault.parse_spec("kind=join_worker,point=serve.batch")
+
+
+def test_serving_sever_mid_predict_window(monkeypatch):
+    """Lost predict ack (sever @ server.send, post-compute): the
+    client's window fails, the health probe finds the replica alive,
+    and the replay carries the ORIGINAL request id — the server sees
+    the duplicate, the client delivers exactly one answer."""
+    from mxtpu.serving import ServingClient
+    s1, s2, mkeng = _serving_pair()
+    try:
+        cli = ServingClient(addrs=[s1.address], budget_ms=5000)
+        cli.hello()
+        x = np.ones((1, 6), "f")
+        warm = cli.predict(x)[0]                    # fault-free baseline
+        with fault.inject(
+                "kind=sever,point=server.send,op=predict,nth=1") as inj:
+            out = cli.predict(x)[0]
+        assert inj.stats()[0][4] == 1, "the sever never fired"
+        np.testing.assert_array_equal(out, warm)    # same bits, once
+        assert cli.stats()["replays"] >= 1
+        dups = (s1.stats()["counters"]["dup_requests"]
+                + s2.stats()["counters"]["dup_requests"])
+        assert dups == 1, "replay did not carry the original rid"
+    finally:
+        s2.stop()
+        s1.stop()
+
+
+def test_serving_kill_replica_mid_batch(monkeypatch):
+    """kind=kill @ serve.batch: the active replica crashes between
+    coalescing and compute. Every in-flight client fails over and
+    replays on the survivor; each request is answered exactly once,
+    bit-identical to the fault-free engine."""
+    import threading as _threading
+    from mxtpu.serving import ServingClient
+    s1, s2, mkeng = _serving_pair(batch_deadline_ms=20)
+    try:
+        cli = ServingClient(addrs=[s1.address], budget_ms=5000)
+        cli.hello()
+        oracle = mkeng()
+        rng = np.random.RandomState(5)
+        xs = [rng.rand(1, 6).astype("f") for _ in range(4)]
+        want = [oracle.predict([x])[0] for x in xs]
+        outs, errs = {}, {}
+        lock = _threading.Lock()
+
+        def one(i):
+            try:
+                r = cli.predict(xs[i])[0]
+                with lock:
+                    outs[i] = r
+            except Exception as e:
+                with lock:
+                    errs[i] = e
+
+        with fault.inject("kind=kill,point=serve.batch,nth=1") as inj:
+            ts = [_threading.Thread(target=one, args=(i,))
+                  for i in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+        assert inj.stats()[0][4] == 1, "the kill never fired"
+        assert not errs, errs
+        assert len(outs) == 4
+        for i, out in outs.items():
+            np.testing.assert_array_equal(out, want[i][:1])
+        assert cli.stats()["failovers"] >= 1
+        alive = [s for s in (s1, s2) if not s._tcp.dying]
+        assert len(alive) == 1
+        assert alive[0].stats()["counters"]["responses"] >= 1
+    finally:
+        s2.stop()
+        s1.stop()

@@ -1,0 +1,394 @@
+"""Serving fast tier: engine buckets/AOT, dynamic batcher semantics,
+admission control, deadlines, drain, and client failover — all loopback
+threads in this process (the E2E two-process kill -9 drill lives in
+tests/test_dist_launch.py; the four-contract smoke in
+ci/check_serving.py).
+
+Determinism notes the rows rely on: a single-bucket menu makes a
+request's bits independent of which batch composition it coalesced
+into (docs/serving.md "Determinism"), and every fault comes from the
+mxtpu.fault schedule harness — no timing-dependent assertions beyond
+generous bounds.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import fault
+from mxtpu import kvstore_async as ka
+from mxtpu.serving import (DeadlineExceeded, InferenceEngine,
+                           ModelServer, Overloaded, ServingClient,
+                           parse_buckets, parse_shape_spec)
+
+IN_DIM = 6
+
+
+@pytest.fixture(autouse=True)
+def _serving_knobs(monkeypatch):
+    monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0")
+    monkeypatch.setattr(ka, "_RETRIES", 1)
+    monkeypatch.setattr(ka, "_BACKOFF", 0.01)
+    monkeypatch.setattr(ka, "_BACKOFF_MAX", 0.05)
+    monkeypatch.setattr(ka, "_RECONNECT_TIMEOUT", 0.2)
+    monkeypatch.setattr(ka, "_DEAD_AFTER", 2)
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+@pytest.fixture(scope="module")
+def model():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, IN_DIM))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    arg_params, aux_params = mod.get_params()
+    return net, arg_params, aux_params
+
+
+def _engine(model, buckets=(1, 2, 4), warm=True):
+    net, arg_params, aux_params = model
+    return InferenceEngine(net, arg_params, aux_params,
+                           {"data": (IN_DIM,)}, buckets=buckets,
+                           warm=warm)
+
+
+def _server(model, **kw):
+    kw.setdefault("batch_deadline_ms_", 10)
+    buckets = kw.pop("buckets", (1, 2, 4))
+    return ModelServer(_engine(model, buckets=buckets, warm=False),
+                       model_name="t", **kw).start()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing + engine
+# ---------------------------------------------------------------------------
+
+def test_spec_parsing():
+    assert parse_buckets("8,1,4,4") == (1, 4, 8)
+    with pytest.raises(ValueError):
+        parse_buckets("0,2")
+    assert parse_shape_spec("data=3,32,32") == {"data": (3, 32, 32)}
+    assert parse_shape_spec("a=4;b=2,2") == {"a": (4,), "b": (2, 2)}
+    with pytest.raises(ValueError):
+        parse_shape_spec("nodims")
+
+
+def test_engine_warm_compiles_every_bucket_then_zero_retraces(model):
+    eng = _engine(model, buckets=(1, 2, 4), warm=True)
+    assert eng.cache.compiles == 3
+    x = np.random.RandomState(0).rand(3, IN_DIM).astype("f")
+    for _ in range(4):
+        out = eng.predict([x])
+    assert eng.cache.compiles == 3       # steady state never retraces
+    assert out[0].shape == (3, 3)
+    # padding accounting: 3 rows ride the 4-bucket
+    assert eng.stats()["pad_rows"] == 4 * 1
+
+
+def test_engine_validates_payloads(model):
+    eng = _engine(model, buckets=(1, 2), warm=False)
+    with pytest.raises(ValueError):
+        eng.check_rows([np.zeros((1, IN_DIM + 1), "f")])  # bad shape
+    with pytest.raises(ValueError):
+        eng.check_rows([np.zeros((3, IN_DIM), "f")])      # > max bucket
+    with pytest.raises(ValueError):
+        eng.check_rows([np.zeros((0, IN_DIM), "f")])      # empty
+    assert eng.check_rows([np.zeros((2, IN_DIM), "f")]) == 2
+
+
+def test_engine_from_checkpoint_roundtrip(model, tmp_path):
+    net, arg_params, aux_params = model
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, IN_DIM))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.set_params(arg_params, aux_params)
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 3)
+    eng = InferenceEngine.from_checkpoint(prefix, 3, {"data": (IN_DIM,)},
+                                          buckets=(2,), warm=False)
+    direct = _engine(model, buckets=(2,), warm=False)
+    x = np.random.RandomState(1).rand(2, IN_DIM).astype("f")
+    np.testing.assert_array_equal(eng.predict([x])[0],
+                                  direct.predict([x])[0])
+
+
+def test_single_bucket_bits_are_composition_independent(model):
+    # the determinism contract the failover drills rest on
+    eng = _engine(model, buckets=(4,), warm=True)
+    rng = np.random.RandomState(2)
+    xs = [rng.rand(1, IN_DIM).astype("f") for _ in range(4)]
+    alone = [eng.predict([x])[0] for x in xs]
+    packed = eng.predict([np.concatenate(xs)])[0]
+    for i in range(4):
+        np.testing.assert_array_equal(alone[i][0], packed[i])
+
+
+# ---------------------------------------------------------------------------
+# batching + admission on the server
+# ---------------------------------------------------------------------------
+
+def _concurrent(cli, xs, budget_ms=None):
+    outs, errs = {}, {}
+    lock = threading.Lock()
+
+    def one(i):
+        try:
+            r = cli.predict(xs[i], budget_ms=budget_ms)[0]
+            with lock:
+                outs[i] = r
+        except Exception as e:
+            with lock:
+                errs[i] = e
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(len(xs))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    return outs, errs
+
+
+def test_concurrent_requests_coalesce_into_buckets(model):
+    srv = _server(model, batch_deadline_ms_=25)
+    try:
+        cli = ServingClient(addrs=[srv.address], budget_ms=5000)
+        xs = [np.full((1, IN_DIM), float(i), "f") for i in range(8)]
+        outs, errs = _concurrent(cli, xs)
+        assert not errs
+        assert len(outs) == 8
+        b = srv.stats()["batcher"]
+        assert b["batches"] < b["batched_requests"] == 8
+        assert b["max_batch_rows"] <= 4          # bucket cap respected
+        # responses sliced back per request: row i is softmax of x_i,
+        # all rows of a request equal (constant input)
+        for i, out in outs.items():
+            assert out.shape == (1, 3)
+    finally:
+        srv.stop()
+
+
+def test_local_transport_parity(model, monkeypatch):
+    # the same admission/batching path serves the in-process shortcut
+    monkeypatch.setattr(ka, "_LOCAL_ON", True)
+    srv = _server(model)
+    try:
+        cli = ServingClient(addrs=[srv.address], budget_ms=5000)
+        out = cli.predict(np.ones((2, IN_DIM), "f"))[0]
+        assert out.shape == (2, 3)
+        assert cli.stats()["comms"]["local_reqs"] >= 1
+        assert srv.stats()["counters"]["responses"] == 1
+    finally:
+        srv.stop()
+
+
+def test_queue_full_sheds_with_retriable_verdict(model):
+    srv = _server(model, queue_depth_=0)
+    try:
+        cli = ServingClient(addrs=[srv.address], budget_ms=2000)
+        with pytest.raises(Overloaded) as ei:
+            cli.predict(np.ones((1, IN_DIM), "f"))
+        assert ei.value.retriable
+        assert any(v == "overloaded" for _, v, _ in ei.value.verdicts)
+        assert srv.stats()["counters"]["shed_overloaded"] == 1
+        assert srv.stats()["batcher"]["shed_queue_full"] == 1
+    finally:
+        srv.stop()
+
+
+def test_deadline_expiry_drops_before_dispatch(model):
+    srv = _server(model, batch_deadline_ms_=50)
+    try:
+        cli = ServingClient(addrs=[srv.address])
+        with pytest.raises(DeadlineExceeded):
+            cli.predict(np.ones((1, IN_DIM), "f"), budget_ms=1.0)
+        c = srv.stats()["counters"]
+        assert c["expired"] == 1
+        assert c["responses"] == 0               # zero responses after
+        assert srv.stats()["engine"]["predicts"] == 0  # never dispatched
+    finally:
+        srv.stop()
+
+
+def test_injected_admission_delay_burns_budget(model):
+    # kind=delay at serve.request: deterministic deadline-expiry drill
+    srv = _server(model, batch_deadline_ms_=5)
+    try:
+        cli = ServingClient(addrs=[srv.address])
+        with fault.inject("kind=delay,point=serve.request,delay=0.08"):
+            with pytest.raises(DeadlineExceeded):
+                cli.predict(np.ones((1, IN_DIM), "f"), budget_ms=30.0)
+        assert srv.stats()["counters"]["expired"] == 1
+    finally:
+        srv.stop()
+
+
+def test_drain_refuses_then_flushes(model):
+    srv = _server(model, batch_deadline_ms_=100)
+    try:
+        cli = ServingClient(addrs=[srv.address], budget_ms=5000)
+        # park a request in the open batch window, then drain: the
+        # parked request must still be answered (flushed, not dropped)
+        got = {}
+        t = threading.Thread(target=lambda: got.setdefault(
+            "out", cli.predict(np.ones((1, IN_DIM), "f"))))
+        t.start()
+        deadline = 50
+        while srv._batcher.pending() == 0 and deadline:
+            deadline -= 1
+            threading.Event().wait(0.01)
+        assert srv.drain(timeout=10.0)
+        t.join(timeout=10)
+        assert got["out"][0].shape == (1, 3)
+        # admissions now refuse with the retriable draining verdict
+        with pytest.raises(Overloaded) as ei:
+            cli.predict(np.ones((1, IN_DIM), "f"))
+        assert any(v == "draining" for _, v, _ in ei.value.verdicts)
+        assert srv.stats()["counters"]["shed_draining"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_oversized_request_is_an_error_not_a_shed(model):
+    srv = _server(model)        # buckets (1,2,4): 5 rows cannot fit
+    try:
+        cli = ServingClient(addrs=[srv.address])
+        with pytest.raises(RuntimeError, match="bad predict payload"):
+            cli.predict(np.ones((5, IN_DIM), "f"))
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# replica failover
+# ---------------------------------------------------------------------------
+
+def _pair(model, **kw):
+    s1 = _server(model, buckets=(4,), **kw)
+    s2 = ModelServer(_engine(model, buckets=(4,), warm=False),
+                     model_name="t", batch_deadline_ms_=10,
+                     replicas=[s1.address], **kw).start()
+    s1._replicas.append(s2.address)
+    return s1, s2
+
+
+def test_hello_learns_replica_set(model):
+    s1, s2 = _pair(model)
+    try:
+        cli = ServingClient(addrs=[s1.address])
+        info = cli.hello()
+        assert sorted(info["replicas"]) == sorted([s1.address,
+                                                   s2.address])
+        assert cli.signature["data_names"] == ["data"]
+        assert sorted(cli.stats()["replicas"]) == \
+            sorted([s1.address, s2.address])
+    finally:
+        s2.stop()
+        s1.stop()
+
+
+def test_killed_replica_fails_over_exactly_once(model):
+    s1, s2 = _pair(model)
+    try:
+        cli = ServingClient(addrs=[s1.address], budget_ms=5000)
+        cli.hello()
+        rng = np.random.RandomState(3)
+        xs = [rng.rand(1, IN_DIM).astype("f") for _ in range(6)]
+        oracle = _engine(model, buckets=(4,), warm=True)
+        want = [oracle.predict([x])[0] for x in xs]
+        with fault.inject("kind=kill,point=serve.batch,nth=1") as inj:
+            outs, errs = _concurrent(cli, xs)
+        assert inj.stats()[0][4] == 1, "kill never fired"
+        assert not errs, errs
+        assert len(outs) == 6                   # exactly one answer each
+        for i, out in outs.items():
+            np.testing.assert_array_equal(out, want[i][:1])
+        assert cli.stats()["failovers"] >= 1
+        # exactly one replica died; the other answered the replays
+        alive = [s for s in (s1, s2) if not s._tcp.dying]
+        assert len(alive) == 1
+        assert alive[0].stats()["counters"]["responses"] >= 1
+    finally:
+        s2.stop()
+        s1.stop()
+
+
+def test_draining_replica_steers_clients_to_peer(model):
+    s1, s2 = _pair(model)
+    try:
+        cli = ServingClient(addrs=[s1.address], budget_ms=5000)
+        cli.hello()
+        active = cli.stats()["active"]
+        draining = s1 if active == s1.address else s2
+        other = s2 if draining is s1 else s1
+        draining.drain(timeout=5.0)
+        out = cli.predict(np.ones((1, IN_DIM), "f"))[0]
+        assert out.shape == (1, 3)
+        assert other.stats()["counters"]["responses"] == 1
+        assert draining.stats()["counters"]["shed_draining"] == 1
+        assert cli.stats()["failovers"] >= 1
+    finally:
+        s2.stop()
+        s1.stop()
+
+
+def test_dup_request_ids_are_counted(model):
+    srv = _server(model)
+    try:
+        cli = ServingClient(addrs=[srv.address], budget_ms=2000)
+        x = np.ones((1, IN_DIM), "f")
+        cli.predict(x)
+        # replay the same rid by hand (what a failover replay does)
+        conn = cli._conn_for(srv.address)
+        rid = "%s:%d" % (cli._origin, 1)
+        reply = conn.request("predict", rid, (x,), 2000.0,
+                             timeout=30.0, retries=0)
+        assert reply[0] == "ok"
+        assert srv.stats()["counters"]["dup_requests"] == 1
+    finally:
+        srv.stop()
+
+
+def test_injected_drop_replays_on_peer(model):
+    # serve.request drop: the admitted request vanishes without a
+    # reply; the client replays the SAME rid on the other replica
+    s1, s2 = _pair(model)
+    try:
+        cli = ServingClient(addrs=[s1.address], budget_ms=2000)
+        cli.hello()
+        with fault.inject("kind=drop,point=serve.request,nth=1,count=1"):
+            out = cli.predict(np.ones((1, IN_DIM), "f"))[0]
+        assert out.shape == (1, 3)
+        total = (s1.stats()["counters"]["dropped"]
+                 + s2.stats()["counters"]["dropped"])
+        assert total == 1
+        assert cli.stats()["replays"] >= 1
+    finally:
+        s2.stop()
+        s1.stop()
+
+
+def test_server_stats_surface_the_story(model):
+    srv = _server(model)
+    try:
+        cli = ServingClient(addrs=[srv.address], budget_ms=2000)
+        cli.predict(np.ones((2, IN_DIM), "f"))
+        s = cli.server_stats()
+        assert s["counters"]["responses"] == 1
+        assert s["batcher"]["batches"] == 1
+        assert s["batcher"]["batched_rows"] == 2
+        assert s["engine"]["predicts"] == 1
+        assert s["queue_depth"] >= 1 and "batch_deadline_ms" in s
+    finally:
+        srv.stop()

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Loopback serving bench: offered load vs latency and shed rate.
+
+The serving acceptance surface (ISSUE 8; numbers land in
+docs/perf_analysis.md "Serving"): one in-process ModelServer over a
+tiny-MLP checkpoint, swept by closed-loop concurrent clients — each
+level doubles the offered load by doubling the concurrent client count
+(every client is its own ServingClient with its own connection,
+issuing --iters back-to-back predicts). Per level:
+
+* achieved throughput (req/s, rows/s) and request latency p50/p99;
+* shed rate: the fraction of attempts refused with the retriable
+  ``overloaded`` verdict once the offered load outruns the queue;
+* batching effectiveness: device batches vs requests, average rows per
+  dispatch (the dynamic-batching win: device dispatches grow sublinearly
+  with load).
+
+The headline sweep runs the default transport (the MXTPU_PS_LOCAL
+same-process shortcut — this bench's server IS in-process); the "tcp"
+sub-object repeats the middle level over real loopback framing. The
+steady-state sweep also proves the zero-retrace contract: program
+compiles after warmup stay flat (the AOT bucket menu absorbs every
+request shape).
+
+Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
+and mirrors it to docs/serving_bench.json unless --no-write. CPU-only.
+
+Run: JAX_PLATFORMS=cpu python tools/bench_serving.py
+     [--clients 8,64,256] [--iters 20]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, ROOT)
+
+
+def _pct(samples, q):
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+def _make_checkpoint(tmpdir, in_dim, hidden, classes):
+    """Save a tiny-MLP Module checkpoint the replicas would load in
+    production — the bench exercises the real from_checkpoint path."""
+    import mxtpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, in_dim))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    prefix = os.path.join(tmpdir, "bench_model")
+    mod.save_checkpoint(prefix, 0)
+    return prefix
+
+
+def _run_level(addr, n_clients, iters, in_dim, budget_ms):
+    """One closed-loop sweep level: n_clients threads, each its own
+    client/connection, iters predicts back to back."""
+    from mxtpu.serving import ServingClient, Overloaded, DeadlineExceeded
+    lat, sheds, expired, errors = [], [0], [0], [0]
+    lock = threading.Lock()
+    start = threading.Event()
+
+    def one_client(seed):
+        rng = np.random.RandomState(seed)
+        cli = ServingClient(addrs=[addr], budget_ms=budget_ms)
+        mine = []
+        start.wait(timeout=30.0)
+        for _ in range(iters):
+            x = rng.rand(1, in_dim).astype("f")
+            t0 = time.perf_counter()
+            try:
+                cli.predict(x)
+                mine.append(time.perf_counter() - t0)
+            except Overloaded:
+                with lock:
+                    sheds[0] += 1
+            except DeadlineExceeded:
+                with lock:
+                    expired[0] += 1
+            except (ConnectionError, RuntimeError):
+                with lock:
+                    errors[0] += 1
+        cli.close()
+        with lock:
+            lat.extend(mine)
+
+    threads = [threading.Thread(target=one_client, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    start.set()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+    attempts = n_clients * iters
+    ok = len(lat)
+    return {
+        "clients": n_clients,
+        "attempts": attempts,
+        "answered": ok,
+        "req_s": round(ok / wall, 1) if wall > 0 else 0.0,
+        "p50_ms": round(_pct(lat, 0.50) * 1e3, 3) if lat else None,
+        "p99_ms": round(_pct(lat, 0.99) * 1e3, 3) if lat else None,
+        "shed": sheds[0],
+        "shed_rate": round(sheds[0] / attempts, 4),
+        "expired": expired[0],
+        "errors": errors[0],
+    }
+
+
+def run(clients_levels, iters, in_dim, hidden, classes, buckets,
+        budget_ms):
+    import mxtpu  # noqa: F401  (engine import path)
+    from mxtpu import kvstore_async as ka
+    from mxtpu.serving import InferenceEngine, ModelServer
+
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_serve_bench_")
+    prefix = _make_checkpoint(tmpdir, in_dim, hidden, classes)
+    engine = InferenceEngine.from_checkpoint(
+        prefix, 0, {"data": (in_dim,)}, buckets=buckets, warm=True)
+    srv = ModelServer(engine, model_name="bench_mlp").start()
+    local_saved = ka._LOCAL_ON
+    try:
+        # warmup pass, then pin the compile counter: the sweep must
+        # post ZERO new compiles (per-request retraces)
+        _run_level(srv.address, 2, 2, in_dim, budget_ms)
+        compiles_after_warm = engine.cache.compiles
+
+        levels = [_run_level(srv.address, n, iters, in_dim, budget_ms)
+                  for n in clients_levels]
+        # batching effectiveness, cumulative over the sweep
+        b = srv.stats()["batcher"]
+        mid = clients_levels[len(clients_levels) // 2]
+        ka._LOCAL_ON = False
+        tcp = _run_level(srv.address, mid, iters, in_dim, budget_ms)
+        ka._LOCAL_ON = local_saved
+
+        result = {
+            "bench": "serving_loopback",
+            "transport": "local" if local_saved else "tcp",
+            "model": {"in_dim": in_dim, "hidden": hidden,
+                      "classes": classes},
+            "buckets": list(engine.buckets),
+            "iters": iters,
+            "budget_ms": budget_ms,
+            "queue_depth": srv._depth,
+            "batch_deadline_ms": srv._deadline_ms,
+            "host_cores": os.cpu_count(),
+            "levels": levels,
+            "tcp": tcp,
+            "batches": b["batches"],
+            "batched_requests": b["batched_requests"],
+            "avg_batch_rows": round(
+                b["batched_rows"] / b["batches"], 2) if b["batches"]
+            else 0.0,
+            "max_batch_rows": b["max_batch_rows"],
+            "retraces_after_warmup":
+                engine.cache.compiles - compiles_after_warm,
+        }
+        return result
+    finally:
+        ka._LOCAL_ON = local_saved
+        srv.stop()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", default=None,
+                    help="comma list of concurrent-client sweep levels "
+                         "(default 8,64,256; tiny mode 2,4)")
+    ap.add_argument("--iters", type=int, default=None,
+                    help="predicts per client per level (default 20; "
+                         "tiny mode 3)")
+    ap.add_argument("--budget-ms", type=float, default=2000.0)
+    ap.add_argument("--in-dim", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--buckets", default="1,2,4,8,16,32")
+    ap.add_argument("--no-write", action="store_true",
+                    help="do not mirror the line to "
+                         "docs/serving_bench.json")
+    args = ap.parse_args()
+    tiny = os.environ.get("MXTPU_BENCH_TINY", "0") != "0"
+    clients = args.clients or ("2,4" if tiny else "8,64,256")
+    iters = args.iters if args.iters is not None else (3 if tiny else 20)
+    levels = [int(c) for c in clients.split(",") if c.strip()]
+
+    result = run(levels, iters, args.in_dim, args.hidden, args.classes,
+                 args.buckets, args.budget_ms)
+    if tiny:
+        result["tiny"] = True
+    line = json.dumps(result)
+    print(line, flush=True)
+    if not args.no_write:
+        with open(os.path.join(ROOT, "docs", "serving_bench.json"),
+                  "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,31 @@ def _spawn_server(name, ps_port, base_env, args, role="primary",
     return proc
 
 
+def _spawn_serving_replica(idx, port, addrs, base_env, args):
+    """One model-serving replica child (``python -m mxtpu.serving``).
+    Every replica gets the FULL replica set in MXTPU_SERVE_ADDRS so its
+    hello replies teach clients where to fail over. Replicas are reaped
+    with the same ``_reap`` TERM→KILL escalation as servers — SIGTERM
+    is their graceful drain (stop admissions, flush in-flight batches,
+    exit 0), so a clean launcher exit never drops admitted requests."""
+    env = dict(base_env, JAX_PLATFORMS="cpu",
+               MXTPU_SERVE_PORT=str(port),
+               MXTPU_SERVE_ADDRS=",".join(addrs),
+               MXTPU_SERVE_MODEL=args.serve_model,
+               MXTPU_SERVE_EPOCH=str(args.serve_epoch),
+               MXTPU_SERVE_DATA_SHAPES=args.serve_data_shapes)
+    if args.serve_buckets:
+        env["MXTPU_SERVE_BUCKETS"] = args.serve_buckets
+    env.pop("DMLC_ROLE", None)     # not a parameter-server role process
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mxtpu.serving"], env=env)
+    # pid + port on stdout: kill -9 failover drills parse this, exactly
+    # like the ps-server line
+    print("serve replica %d pid=%d port=%d" % (idx, proc.pid, port),
+          flush=True)
+    return proc
+
+
 def _parse_scale(spec):
     """``--scale`` drill events: ``;``-separated, each a comma list of
     ``key=value`` — ``after=SECONDS`` or ``at_step=N`` (needs
@@ -193,6 +218,22 @@ def launch_local(args, command):
                                           role=role, peer=peer))
     if backup_addrs:
         base_env["MXTPU_PS_BACKUP_ADDRS"] = ",".join(backup_addrs)
+    # --serve N: a model-serving replica set next to (or instead of)
+    # the parameter servers; workers see MXTPU_SERVE_ADDRS and speak
+    # mxtpu.serving.ServingClient (docs/serving.md)
+    if args.serve:
+        if not (args.serve_model and args.serve_data_shapes):
+            raise SystemExit("--serve needs --serve-model and "
+                             "--serve-data-shapes")
+        serve_ports = [_free_port(args.port + 201 + i)
+                       for i in range(args.serve)]
+        serve_addrs = ["127.0.0.1:%d" % p for p in serve_ports]
+        base_env["MXTPU_SERVE_ADDRS"] = ",".join(serve_addrs)
+        for i, port in enumerate(serve_ports):
+            server_slots.append(("serve%d" % i, port, "serving", None))
+            server_ports.append(port)
+            server_procs.append(_spawn_serving_replica(
+                i, port, serve_addrs, base_env, args))
     if args.worker_respawn and not args.worker_state_dir:
         # a respawned worker with no state dir restarts from step 0 and
         # double-trains its epoch — auto-provision one, like --ps-respawn
@@ -396,8 +437,13 @@ def launch_local(args, command):
                         continue   # alive, or clean 'stop' exit
                     if respawns[i] >= args.ps_max_respawns:
                         continue   # workers' retry layer surfaces it
-                    respawns[i] += 1
                     name, port, role, peer = server_slots[i]
+                    if role == "serving":
+                        # a crashed serving replica is the failover
+                        # drill's subject: clients re-route to the
+                        # survivors, the launcher does not revive it
+                        continue
+                    respawns[i] += 1
                     print("server %s died (exit %d); respawning on port "
                           "%d (%d/%d)" % (name, rc, port, respawns[i],
                                           args.ps_max_respawns),
@@ -613,6 +659,25 @@ def main():
                         "--ps-replicas 2) and splits server slot I's "
                         "keys onto it online (docs/fault_tolerance.md "
                         "'Elasticity')")
+    p.add_argument("--serve", type=int, default=0,
+                   help="local launcher: start N model-serving replicas "
+                        "(python -m mxtpu.serving) and export "
+                        "MXTPU_SERVE_ADDRS to the workers; replicas "
+                        "drain gracefully on SIGTERM (the _reap "
+                        "escalation's TERM phase) and a kill -9'd "
+                        "replica is the client-failover drill "
+                        "(docs/serving.md)")
+    p.add_argument("--serve-model", default=None,
+                   help="checkpoint prefix the replicas load "
+                        "(prefix-symbol.json + prefix-%%04d.params)")
+    p.add_argument("--serve-epoch", type=int, default=0,
+                   help="checkpoint epoch for --serve-model")
+    p.add_argument("--serve-data-shapes", default=None,
+                   help="per-sample input shapes for the served model, "
+                        "'name=dims[;name=dims]' (e.g. data=3,32,32)")
+    p.add_argument("--serve-buckets", default=None,
+                   help="batch buckets the replicas AOT-compile "
+                        "(default 1,2,4,8,16,32)")
     p.add_argument("--scale-progress", default=None,
                    help="progress file written by the training script; "
                         "at_step= scale triggers fire when its integer "
