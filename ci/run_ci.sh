@@ -17,6 +17,9 @@ tier="${1:-fast}"
 case "$tier" in
   sanity)
     python -m compileall -q mxtpu tools tests example
+    # check_static = all mxlint passes incl. the whole-program contract
+    # gates (lock-order, wire-protocol, fault-coverage, env-drift) with
+    # a 15s wall-clock budget; emits mxlint_findings.{json,sarif}
     python ci/check_static.py
     python ci/check_robustness.py
     make -C mxtpu/_native

@@ -255,6 +255,17 @@ class ServingClient:
         addr = addr if addr is not None else self._active()[1]
         return self._conn_for(addr).request("stats", timeout=10.0)[1]
 
+    def drain(self, addr=None, timeout=30.0):
+        """Start a replica's two-phase graceful drain (default: the
+        active one) — the scriptable operator surface behind the same
+        path SIGTERM takes: the replica sheds new predicts with the
+        retriable ``draining`` verdict (steering this client's own
+        failover to its peers) while flushing everything already
+        admitted."""
+        addr = addr if addr is not None else self._active()[1]
+        return self._conn_for(addr).request(
+            "drain", float(timeout), timeout=10.0)[1]
+
     def stats(self):
         with self._lock:
             out = dict(self._c)

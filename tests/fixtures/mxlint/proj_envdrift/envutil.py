@@ -1,0 +1,8 @@
+"""Env-read helper: its ``name`` parameter flows into
+``os.environ.get``, making every resolvable literal call a read site
+for the env-drift pass."""
+import os
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, str(default)))

@@ -1,0 +1,19 @@
+"""Env-drift fixture, code side.
+
+``MXTPU_FAKE_TIMEOUT`` (direct read, wrapped over two lines) and
+``MXTPU_FAKE_DEPTH`` (read through the ``_env_int`` helper in
+``envutil.py`` — a cross-module wrapper the whole-program pass must
+resolve) are documented: negatives. ``MXTPU_SECRET_KNOB`` is read but
+has no definition row: the positive.
+"""
+import os
+
+from envutil import _env_int
+
+
+def configure():
+    timeout = float(os.environ.get(
+        "MXTPU_FAKE_TIMEOUT", "5"))
+    depth = _env_int("MXTPU_FAKE_DEPTH", 8)
+    secret = _env_int("MXTPU_SECRET_KNOB", 3)   # EXPECT(env-drift)
+    return timeout, depth, secret

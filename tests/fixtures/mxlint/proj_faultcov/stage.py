@@ -1,0 +1,17 @@
+"""Fault-coverage fixture: two injection points, one fully covered.
+
+``stage.alpha`` is in the corpus fault grammar (docs/env_vars.md) and
+has a fault-matrix row (tests/test_stage_matrix.py) — the negative.
+``stage.beta`` is in neither: untargetable by operators and untested.
+"""
+import os
+
+from mxtpu import fault as _fault
+
+
+def run_stage(batch):
+    spec = os.environ.get("MXTPU_FAULT_SPEC", "")
+    _fault.fire("stage.alpha", op="run", key=spec)
+    out = batch * 2
+    _fault.fire("stage.beta", op="drain")   # EXPECT(fault-coverage)
+    return out

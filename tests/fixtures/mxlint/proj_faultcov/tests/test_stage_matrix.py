@@ -1,0 +1,6 @@
+"""Corpus fault-matrix rows (reference material for the
+fault-coverage pass — this file is consulted, never linted)."""
+
+MATRIX = [
+    ("drop@alpha", "kind=drop,point=stage.alpha,nth=1"),
+]

@@ -1,0 +1,27 @@
+"""Wire-protocol drift fixture, client side.
+
+Requests ``ping``/``halt``/``fetch`` (served — negatives) and ``zap``
+(no dispatcher serves it: unserved request). Handles ``ok``/``busy``
+(emitted — negatives) and ``retired`` (nothing emits it: dead verdict
+handler)."""
+
+
+class WireClient:
+    def __init__(self, conn):
+        self.conn = conn
+
+    def call(self, key):
+        self.conn.request("ping", timeout=1.0)
+        reply = self.conn.request("fetch", key, timeout=1.0)
+        verdict = reply[0]
+        if verdict == "ok":
+            return reply[1]
+        if verdict == "busy":
+            return None
+        if verdict == "retired":   # EXPECT(wire-protocol)
+            return None
+        raise RuntimeError(reply)
+
+    def shutdown(self):
+        self.conn.request("halt", timeout=1.0)
+        self.conn.request("zap", timeout=1.0)   # EXPECT(wire-protocol)

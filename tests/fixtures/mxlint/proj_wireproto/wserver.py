@@ -1,0 +1,27 @@
+"""Wire-protocol drift fixture, server side.
+
+The dispatcher serves ``ping``/``halt``/``legacy_probe``; nothing in
+the corpus ever sends ``legacy_probe`` (dead op handler), and the
+``backpressure`` verdict it emits is handled by no client (unhandled
+verdict). ``ping``/``halt``/``ok``/``busy`` are the negatives: served,
+requested, emitted and handled."""
+
+
+class WireServer:
+    def __init__(self, table):
+        self.table = table
+
+    def _dispatch(self, msg):
+        cmd = msg[0]
+        if cmd == "ping":
+            return ("ok", {"alive": True})
+        if cmd == "halt":
+            return ("ok", {"stopping": True})
+        if cmd == "fetch":
+            if self.table.get(msg[1]) is None:
+                return ("busy", {"retry_in": 0.1})
+            return ("backpressure",   # EXPECT(wire-protocol)
+                    {"depth": len(self.table)})
+        if cmd == "legacy_probe":   # EXPECT(wire-protocol)
+            return ("ok", "probe")
+        return ("err", "unknown command %r" % (cmd,))

@@ -1,0 +1,29 @@
+"""Cross-module AB/BA lock inversion, side A.
+
+``Alpha.step`` takes ``Alpha._a`` and then calls into ``beta`` —
+``Beta.poke`` takes ``Beta._b``, so the interprocedural summary adds
+the edge ``Alpha._a -> Beta._b``. Side B lives in ``beta.py``, runs on
+a ``threading.Thread`` entry point, and adds the reverse edge: a
+whole-program-only deadlock (each file on its own is cycle-free)."""
+import threading
+
+from beta import Beta
+
+
+class Alpha:
+    def __init__(self):
+        self._a = threading.Lock()
+        self.partner = Beta(self)
+
+    def step(self):
+        with self._a:
+            self.partner.poke()   # EXPECT(lock-order)
+
+    def grab_a(self):
+        # called from beta's thread while Beta._b is held: the BA arm
+        with self._a:
+            return True
+
+    def safe_peek(self):
+        # negative: consistent order — nothing is held around this
+        return self.partner.poke()

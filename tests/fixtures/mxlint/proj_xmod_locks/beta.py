@@ -1,0 +1,28 @@
+"""Cross-module AB/BA lock inversion, side B: the thread entry point.
+
+``Beta._loop`` runs on a ``threading.Thread(target=...)`` — a
+concurrency root — and takes ``Beta._b`` before calling back into
+``Alpha.grab_a``, which takes ``Alpha._a``: the reverse edge of the
+inversion seeded in ``alpha.py``."""
+import threading
+
+
+class Beta:
+    def __init__(self, owner):
+        self.owner = owner
+        self._b = threading.Lock()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._b:
+            self.owner.grab_a()   # EXPECT(lock-order)
+
+    def poke(self):
+        with self._b:
+            return 1
+
+    def quiet(self):
+        # negative: takes _b alone, no call while held
+        with self._b:
+            x = 2
+        return x

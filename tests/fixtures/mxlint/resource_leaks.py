@@ -1,0 +1,67 @@
+"""resource-leak fixture: abandoned socket/thread/subprocess locals
+(positives) against every escape/cleanup shape the pass must respect
+(negatives)."""
+import socket
+import subprocess
+import threading
+
+
+def leaky_probe(host):
+    s = socket.create_connection((host, 80), timeout=2.0)   # EXPECT(resource-leak)
+    s.sendall(b"ping")
+    return True
+
+
+def closed_probe(host):
+    s = socket.create_connection((host, 80), timeout=2.0)
+    try:
+        s.sendall(b"ping")
+    finally:
+        s.close()
+
+
+def context_probe(host):
+    s = socket.create_connection((host, 80), timeout=2.0)
+    with s:
+        s.sendall(b"ping")
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)   # EXPECT(resource-leak)
+    t.start()
+
+
+def joined_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=5.0)
+
+
+def daemon_worker(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+
+
+def orphan_child():
+    p = subprocess.Popen(["true"])   # EXPECT(resource-leak)
+    return None
+
+
+def reaped_child():
+    p = subprocess.Popen(["true"])
+    p.wait(timeout=10.0)
+
+
+def escaping_socket():
+    s = socket.socket()
+    return s
+
+
+def registered_socket(registry):
+    s = socket.socket()
+    registry.append(s)
+
+
+def stored_socket(obj):
+    s = socket.socket()
+    obj.sock = s

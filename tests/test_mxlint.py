@@ -1,7 +1,10 @@
 """mxlint analyzer tests: the fixture corpus (known positives marked
-``# EXPECT(pass-id)``, everything unmarked must stay clean), pragma
-scoping, baseline round-trip, the --diff file filter, and the live-tree
-no-new-findings-vs-baseline gate that mirrors ``ci/check_static.py``.
+``# EXPECT(pass-id)``, everything unmarked must stay clean), the
+``proj_*`` whole-program corpora (cross-module lock inversion,
+wire-protocol / fault-coverage / env-drift contract fixtures), pragma
+scoping, baseline round-trip, SARIF output, the --diff file filter,
+and the live-tree no-new-findings-vs-baseline gate that mirrors
+``ci/check_static.py``.
 """
 import json
 import pathlib
@@ -14,18 +17,22 @@ import pytest
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT / "tools"))
 
-from mxlint.core import (Finding, all_passes, diff_against_baseline,  # noqa: E402
-                         load_baseline, run_paths, save_baseline)
+from mxlint.core import (Finding, all_passes, build_project,  # noqa: E402
+                         diff_against_baseline, load_baseline,
+                         run_paths, save_baseline)
 from mxlint.cli import changed_files, main as cli_main  # noqa: E402
 
 FIXTURES = ROOT / "tests" / "fixtures" / "mxlint"
 _EXPECT = re.compile(r"#\s*EXPECT\((?P<id>[a-z-]+)\)")
+# markdown fixtures (a corpus env_vars.md) carry HTML-comment markers
+_EXPECT_MD = re.compile(r"<!--\s*EXPECT\((?P<id>[a-z-]+)\)\s*-->")
 
 
 def _expected(path):
     out = set()
+    pat = _EXPECT_MD if path.suffix == ".md" else _EXPECT
     for i, line in enumerate(path.read_text().splitlines(), 1):
-        m = _EXPECT.search(line)
+        m = pat.search(line)
         if m:
             out.add((i, m.group("id")))
     return out
@@ -36,7 +43,28 @@ def _found(path):
             for f in run_paths([path], root=ROOT)}
 
 
-FIXTURE_FILES = sorted(FIXTURES.rglob("*.py"))
+# whole-program corpora: each proj_* directory is linted as one closed
+# project (its own docs/ and tests/ serve as contract references)
+CORPUS_DIRS = sorted(d for d in FIXTURES.iterdir()
+                     if d.is_dir() and d.name.startswith("proj_"))
+
+
+def _corpus_found(corpus):
+    return {(f.path, f.line, f.pass_id)
+            for f in run_paths([corpus], root=ROOT)}
+
+
+def _corpus_expected(corpus):
+    out = set()
+    for f in sorted(corpus.rglob("*.py")) + sorted(corpus.rglob("*.md")):
+        rel = str(f.relative_to(ROOT))
+        out.update((rel, line, pid) for line, pid in _expected(f))
+    return out
+
+
+FIXTURE_FILES = sorted(f for f in FIXTURES.rglob("*.py")
+                       if not any(p.name.startswith("proj_")
+                                  for p in f.parents))
 
 
 def test_fixture_corpus_exists():
@@ -44,6 +72,8 @@ def test_fixture_corpus_exists():
     ids = set()
     for f in FIXTURE_FILES:
         ids.update(pid for _, pid in _expected(f))
+    for d in CORPUS_DIRS:
+        ids.update(pid for _, _, pid in _corpus_expected(d))
     assert ids == set(all_passes()), \
         "every pass needs a fixture positive; have %s" % sorted(ids)
 
@@ -55,6 +85,15 @@ def test_fixture(fixture):
     """Exact agreement: every EXPECT line is found by exactly that
     pass, and nothing unmarked is flagged (the known-negatives)."""
     assert _found(fixture) == _expected(fixture)
+
+
+@pytest.mark.parametrize("corpus", CORPUS_DIRS,
+                         ids=[d.name for d in CORPUS_DIRS])
+def test_whole_program_corpus(corpus):
+    """Exact agreement over a closed multi-module corpus: findings may
+    anchor in any module (or the corpus docs), and everything unmarked
+    — including the corpus's own docs and tests — stays clean."""
+    assert _corpus_found(corpus) == _corpus_expected(corpus)
 
 
 def test_wrapped_call_beyond_regex_window():
@@ -91,6 +130,100 @@ def test_seeded_host_sync_in_jit_is_caught():
 def test_seeded_use_after_donate_is_caught():
     found = _found(FIXTURES / "use_after_donate.py")
     assert sum(1 for _, pid in found if pid == "use-after-donate") >= 3
+
+
+# ---------------------------------------------------------------------------
+# whole-program analysis (ISSUE 9): the project symbol table, the
+# cross-module lock graph, and the report-vs-analyze split
+# ---------------------------------------------------------------------------
+
+def test_cross_module_inversion_needs_whole_program():
+    """The seeded AB/BA inversion spans two modules joined by a thread
+    entry point: linting either file alone sees no cycle, linting the
+    corpus finds one edge site in each module."""
+    corpus = FIXTURES / "proj_xmod_locks"
+    alone = run_paths([corpus / "alpha.py"], root=ROOT)
+    assert all(f.pass_id != "lock-order" for f in alone)
+    together = [f for f in run_paths([corpus], root=ROOT)
+                if f.pass_id == "lock-order"]
+    assert {f.path.rsplit("/", 1)[-1] for f in together} == \
+        {"alpha.py", "beta.py"}
+
+
+def test_thread_entry_points_are_indexed():
+    project = build_project([FIXTURES / "proj_xmod_locks"], ROOT)
+    targets = {qual for _, qual, _, how in project.entry_points}
+    assert "Beta._loop" in targets
+
+
+def test_project_resolves_attr_typed_cross_module_calls():
+    """``self.partner.poke()`` resolves through the ``self.partner =
+    Beta(...)`` attribute type into the other module."""
+    project = build_project([FIXTURES / "proj_xmod_locks"], ROOT)
+    got = project.resolve_callsite(
+        "tests/fixtures/mxlint/proj_xmod_locks/alpha.py", "Alpha",
+        ("self_attr", "partner", "poke"))
+    assert got is not None
+    assert got[0].endswith("beta.py") and got[1] == "Beta.poke"
+
+
+def test_full_tree_request_analyzes_both_roots():
+    """Linting only ``mxtpu`` still builds the project over ``tools``
+    (a changed file's finding can depend on an unchanged peer), but
+    reports only under the requested path."""
+    project = build_project([ROOT / "mxtpu"], ROOT)
+    assert any(rel.startswith("tools/") for rel in project.modules)
+    assert all(rel.startswith("mxtpu") for rel in
+               project.report_relpaths)
+
+
+def test_changed_files_mode_reports_only_changed_files(tmp_path):
+    """--diff semantics: the project is whole, the report is the
+    changed set — a cross-file contract finding anchored in the
+    changed file appears; the peer's own findings do not."""
+    (tmp_path / "mxtpu").mkdir()
+    (tmp_path / "tools").mkdir()
+    client = tmp_path / "mxtpu" / "a_client.py"
+    client.write_text(
+        "class C:\n"
+        "    def __init__(self, conn):\n"
+        "        self.conn = conn\n"
+        "    def go(self):\n"
+        "        self.conn.request('ping', timeout=1.0)\n"
+        "        self.conn.request('zap', timeout=1.0)\n")
+    server = tmp_path / "tools" / "b_server.py"
+    server.write_text(
+        "class S:\n"
+        "    def _dispatch(self, msg):\n"
+        "        cmd = msg[0]\n"
+        "        if cmd == 'ping':\n"
+        "            return ('ok',)\n"
+        "        if cmd == 'legacy':\n"
+        "            return ('ok',)\n"
+        "        return ('err', 'nope')\n")
+    found = run_paths([tmp_path / "mxtpu", tmp_path / "tools"],
+                      root=tmp_path, files=[client])
+    msgs = [(f.path, f.pass_id, f.message) for f in found]
+    assert any("zap" in m for _, pid, m in msgs
+               if pid == "wire-protocol"), msgs
+    assert all(p.endswith("a_client.py") for p, _, _ in msgs)
+
+
+def test_open_file_set_skips_project_wide_directions(tmp_path):
+    """A loose file list is an *open* project: the dead-handler /
+    dead-doc directions stay quiet (they need the whole program to
+    mean anything)."""
+    f = tmp_path / "srv.py"
+    f.write_text(
+        "class S:\n"
+        "    def _dispatch(self, msg):\n"
+        "        cmd = msg[0]\n"
+        "        if cmd == 'ping':\n"
+        "            return ('ok',)\n"
+        "        if cmd == 'legacy':\n"
+        "            return ('ok',)\n"
+        "        return ('err', 'nope')\n")
+    assert run_paths([f], root=tmp_path) == []
 
 
 # ---------------------------------------------------------------------------
@@ -202,6 +335,40 @@ def test_check_static_script_passes():
     doc = json.loads(artifact.read_text())
     assert doc["counts"]["new"] == 0
     assert set(doc["passes"]) >= set(all_passes())
+    # the SARIF artifact rides along for CI diff annotation
+    sarif = json.loads((ROOT / "mxlint_findings.sarif").read_text())
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "mxlint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == \
+        set(all_passes())
+    assert run["results"] == []     # clean tree, empty baseline
+
+
+def test_sarif_artifact_shape(tmp_path):
+    """--sarif renders each finding as one result with rule id,
+    location and the line-free partial fingerprint."""
+    out = tmp_path / "f.sarif"
+    rc = cli_main([str(FIXTURES / "proj_wireproto"), "--sarif",
+                   str(out), "--no-baseline", "-q"])
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    results = doc["runs"][0]["results"]
+    assert len(results) == 4        # the corpus's four EXPECT rows
+    for res in results:
+        assert res["ruleId"] == "wire-protocol"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].startswith(
+            "tests/fixtures/mxlint/proj_wireproto/")
+        assert loc["region"]["startLine"] >= 1
+        assert res["partialFingerprints"]["mxlint/v1"]
+
+
+def test_check_static_runtime_budget_is_pinned():
+    """The sanity tier's wall-clock promise is enforced, not hoped
+    for: the gate script carries an explicit budget assertion."""
+    src = (ROOT / "ci" / "check_static.py").read_text()
+    assert "BUDGET_SECONDS" in src and "BUDGET EXCEEDED" in src
 
 
 # ---------------------------------------------------------------------------

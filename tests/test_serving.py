@@ -260,6 +260,27 @@ def test_drain_refuses_then_flushes(model):
         srv.stop()
 
 
+def test_client_drain_is_the_wire_form_of_sigterm(model):
+    """``ServingClient.drain()`` drives the ``drain`` wire op — the
+    scriptable operator surface (and the reason the op is not a dead
+    handler in the wire-protocol contract): the replica acks with
+    ``draining: True`` and subsequent admissions shed retriably."""
+    srv = _server(model)
+    try:
+        cli = ServingClient(addrs=[srv.address])
+        info = cli.drain(timeout=5.0)
+        assert info == {"draining": True}
+        deadline = 100
+        while not srv._batcher._stopped and deadline:
+            deadline -= 1
+            threading.Event().wait(0.01)
+        with pytest.raises(Overloaded) as ei:
+            cli.predict(np.ones((1, IN_DIM), "f"))
+        assert any(v == "draining" for _, v, _ in ei.value.verdicts)
+    finally:
+        srv.stop()
+
+
 def test_oversized_request_is_an_error_not_a_shed(model):
     srv = _server(model)        # buckets (1,2,4): 5 rows cannot fit
     try:

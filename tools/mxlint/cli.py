@@ -80,6 +80,9 @@ def main(argv=None):
                          "baseline (default file: %s)" % DEFAULT_BASELINE)
     ap.add_argument("--json", default=None, metavar="FILE",
                     help="write the findings artifact as JSON")
+    ap.add_argument("--sarif", default=None, metavar="FILE",
+                    help="write the findings artifact as SARIF 2.1.0 "
+                         "(for CI diff annotation)")
     ap.add_argument("--diff", nargs="?", const="main", default=None,
                     metavar="BASE",
                     help="lint only files changed vs BASE (default "
@@ -131,6 +134,11 @@ def main(argv=None):
         new, old, stale = diff_against_baseline(findings, baseline)
     else:
         new, old, stale = findings, [], []
+
+    if args.sarif:
+        from .sarif import write_sarif
+        write_sarif(args.sarif, findings,
+                    baseline_fingerprints=[f.fingerprint for f in old])
 
     if args.json:
         doc = {"version": 1,

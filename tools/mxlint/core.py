@@ -175,11 +175,16 @@ class ModuleInfo:
     """Everything a pass needs about one file: source, lines, AST,
     pragma map, repo-relative path, and small shared lookups."""
 
+    @staticmethod
+    def _relpath_of(path, root):
+        path = pathlib.Path(path)
+        root = pathlib.Path(root)
+        return str(path.relative_to(root)) \
+            if root in path.parents or path == root else str(path)
+
     def __init__(self, path, root):
         self.path = pathlib.Path(path)
-        self.relpath = str(self.path.relative_to(root)) \
-            if root in self.path.parents or self.path == root \
-            else str(self.path)
+        self.relpath = self._relpath_of(self.path, root)
         self.source = self.path.read_text(encoding="utf-8",
                                           errors="replace")
         self.lines = self.source.splitlines()
@@ -260,13 +265,19 @@ class ModuleInfo:
 
 class LintPass:
     """Base class for a pass plugin. Subclasses set ``name`` /
-    ``description`` and implement ``run(module) -> [Finding]``; the
-    framework applies pragmas, baseline and output handling."""
+    ``description`` and implement either ``run(module) -> [Finding]``
+    (``scope = "module"``) or ``run_project(project) -> [Finding]``
+    (``scope = "project"``, whole-program passes); the framework
+    applies pragmas, baseline and output handling either way."""
 
     name = None
     description = ""
+    scope = "module"
 
     def run(self, module):
+        raise NotImplementedError
+
+    def run_project(self, project):
         raise NotImplementedError
 
 
@@ -304,10 +315,54 @@ def iter_py_files(paths):
             yield p
 
 
+def _under_default_roots(path, root):
+    try:
+        rel = pathlib.Path(path).resolve().relative_to(
+            pathlib.Path(root).resolve())
+    except ValueError:
+        return False
+    from .project import DEFAULT_ROOT_DIRS
+    return bool(rel.parts) and rel.parts[0] in DEFAULT_ROOT_DIRS
+
+
+def build_project(paths, root, files=None):
+    """The whole-program context for one lint invocation (see
+    ``project.Project`` for the scope model): requested files select
+    what is *reported*; the analyzed file set is the full default
+    roots whenever the request lies inside them."""
+    from .project import DEFAULT_ROOT_DIRS, Project
+    root = pathlib.Path(root)
+    report_files = [pathlib.Path(f) for f in (
+        files if files is not None else iter_py_files(paths))]
+    if report_files and all(_under_default_roots(f, root)
+                            for f in report_files):
+        project_files = [f for d in DEFAULT_ROOT_DIRS
+                         for f in iter_py_files([root / d])]
+        closed = True
+    else:
+        project_files = report_files
+        closed = files is None and bool(paths) and \
+            all(pathlib.Path(p).is_dir() for p in paths)
+    modules, seen = [], set()
+    for f in project_files:
+        m = ModuleInfo(f, root)
+        if m.relpath in seen:
+            continue
+        seen.add(m.relpath)
+        modules.append(m)
+    report_relpaths = {ModuleInfo._relpath_of(f, root)
+                       for f in report_files}
+    return Project(modules, root=root, closed=closed,
+                   report_relpaths=report_relpaths)
+
+
 def run_paths(paths, root=None, pass_names=None, files=None):
     """Run the selected passes over every .py under ``paths`` (or the
     explicit ``files`` list); returns pragma-filtered, fingerprinted,
-    sorted findings."""
+    sorted findings. Module-scope passes run per reported file;
+    project-scope passes run once over the whole-program context and
+    are filtered down to findings anchored in reported files (or in a
+    contract doc like ``docs/env_vars.md``)."""
     root = pathlib.Path(root) if root is not None \
         else pathlib.Path.cwd()
     registry = all_passes()
@@ -319,20 +374,35 @@ def run_paths(paths, root=None, pass_names=None, files=None):
                                 ", ".join(sorted(registry))))
         registry = {k: v for k, v in registry.items() if k in pass_names}
     instances = [cls() for _, cls in sorted(registry.items())]
+    project = build_project(paths, root, files=files)
     findings = []
-    file_list = list(files) if files is not None \
-        else list(iter_py_files(paths))
-    for path in file_list:
-        module = ModuleInfo(path, root)
+    for relpath in sorted(project.report_relpaths):
+        module = project.modules.get(relpath)
+        if module is None:
+            continue
         if module.parse_error is not None:
             findings.append(Finding(
                 module.relpath, module.parse_error.lineno or 1, 0,
                 "parse", "syntax error: %s" % module.parse_error.msg))
             continue
         for p in instances:
+            if p.scope != "module":
+                continue
             for f in p.run(module):
                 if not module.pragmas.allows(f.line, f.pass_id):
                     findings.append(f)
+    for p in instances:
+        if p.scope != "project":
+            continue
+        for f in p.run_project(project):
+            owner = project.modules.get(f.path)
+            if owner is not None and f.path not in \
+                    project.report_relpaths:
+                continue       # anchored in an unchanged project file
+            if owner is not None and \
+                    owner.pragmas.allows(f.line, f.pass_id):
+                continue
+            findings.append(f)
     return assign_fingerprints(sorted(findings, key=Finding.sort_key))
 
 

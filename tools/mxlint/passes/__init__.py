@@ -5,6 +5,10 @@ from __future__ import annotations
 
 from . import blocking    # noqa: F401
 from . import donation    # noqa: F401
+from . import envdrift    # noqa: F401
+from . import faultcov    # noqa: F401
 from . import locks       # noqa: F401
+from . import resource    # noqa: F401
 from . import swallow     # noqa: F401
 from . import tracepurity  # noqa: F401
+from . import wireproto   # noqa: F401

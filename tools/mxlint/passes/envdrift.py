@@ -1,0 +1,216 @@
+"""env-drift pass: ``MXTPU_*`` knobs and ``docs/env_vars.md`` must
+describe the same set.
+
+Read-site extraction is whole-program and AST-accurate, which is what
+the old grep audit could never be:
+
+* direct reads — ``os.environ.get("MXTPU_X", ...)`` (wrapped over any
+  number of lines), ``os.environ["MXTPU_X"]``, ``os.getenv``,
+  ``environ.setdefault``, and ``"MXTPU_X" in os.environ`` membership
+  probes;
+* helper reads — a project function whose parameter flows into one of
+  the direct forms (``_env_int(name, default)``) is an *env-read
+  wrapper*; every resolvable call to it with a literal key is a read
+  site. Resolution goes through the project symbol table, so the
+  wrapper and its callers may live in different modules.
+
+Documentation is a definition row in ``env_vars.md``: a markdown table
+line whose first cell names the variable in backticks. Two drift
+directions:
+
+* a read site whose variable has no definition row — finding at the
+  read site (code-anchored, runs in every mode);
+* in closed/whole-tree runs, a definition row whose variable has no
+  read site in the project or the sibling ``tests/`` corpus — finding
+  anchored at the doc line. Rows describing retired knobs stay
+  honest with a literal ``(removed)`` marker instead of deletion-by-
+  forgetting.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, LintPass, register
+from ..project import env_reads_in_text
+
+_VAR = re.compile(r"MXTPU_[A-Z0-9_]+")
+# a definition row: first table cell contains `MXTPU_...` (possibly
+# several, e.g. "| `MXTPU_PS_BACKOFF` / `MXTPU_PS_BACKOFF_MAX` | ...")
+_DEF_ROW = re.compile(r"^\|[^|]*`[^`|]*MXTPU_")
+_REMOVED = re.compile(r"\(removed[):\s]", re.IGNORECASE)
+
+
+def _environ_expr(node):
+    """True for ``os.environ`` / ``environ`` / ``os.environ.copy()``-
+    rooted bases that denote the process environment."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "environ"
+    if isinstance(node, ast.Name):
+        return node.id == "environ"
+    return False
+
+
+def _key_node(call):
+    """The key-argument node of a direct environ read call, or None."""
+    f = call.func
+    if not isinstance(f, (ast.Attribute, ast.Name)):
+        return None
+    name = f.attr if isinstance(f, ast.Attribute) else f.id
+    if name == "getenv":
+        return call.args[0] if call.args else None
+    if name in ("get", "setdefault", "pop") and \
+            isinstance(f, ast.Attribute) and _environ_expr(f.value):
+        return call.args[0] if call.args else None
+    return None
+
+
+class _DocIndex:
+    def __init__(self, path, project):
+        self.path = path
+        try:
+            self.relpath = str(path.relative_to(project.root))
+        except ValueError:
+            self.relpath = str(path)
+        self.defined = {}        # var -> first definition line
+        self.removed = set()
+        self.mentioned = set()
+        for lineno, line in enumerate(
+                path.read_text(encoding="utf-8",
+                               errors="replace").splitlines(), 1):
+            vars_here = _VAR.findall(line)
+            self.mentioned.update(vars_here)
+            if not _DEF_ROW.match(line):
+                continue
+            first_cell = line.split("|")[1] if "|" in line else line
+            for v in _VAR.findall(first_cell):
+                self.defined.setdefault(v, lineno)
+                if _REMOVED.search(line):
+                    self.removed.add(v)
+
+
+@register
+class EnvDriftPass(LintPass):
+    name = "env-drift"
+    scope = "project"
+    description = ("MXTPU_* read sites vs docs/env_vars.md: "
+                   "undocumented reads and documented-but-dead knobs")
+
+    def run_project(self, project):
+        doc_path = project.find_contract_file("docs", "env_vars.md")
+        doc = _DocIndex(doc_path, project) if doc_path is not None \
+            else None
+        reads = {}               # var -> [(relpath, lineno)]
+        wrappers = self._find_wrappers(project)
+        for relpath, module in sorted(project.modules.items()):
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                var = line = None
+                if isinstance(node, ast.Call):
+                    key = _key_node(node)
+                    if key is None:
+                        key = self._wrapper_key(project, relpath,
+                                                module, node, wrappers)
+                    var, line = self._lit(key), node.lineno
+                elif isinstance(node, ast.Subscript) and \
+                        _environ_expr(node.value) and \
+                        isinstance(node.ctx, ast.Load):
+                    var, line = self._lit(node.slice), node.lineno
+                elif isinstance(node, ast.Compare) and \
+                        len(node.ops) == 1 and \
+                        isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                        and _environ_expr(node.comparators[0]):
+                    var, line = self._lit(node.left), node.lineno
+                if var is not None and var.startswith("MXTPU_"):
+                    reads.setdefault(var, []).append((relpath, line))
+        out = []
+        if doc is not None:
+            for var, sites in sorted(reads.items()):
+                if var in doc.defined:
+                    continue
+                for relpath, lineno in sites:
+                    out.append(project.modules[relpath].finding(
+                        _Line(lineno), self.name,
+                        "%s is read here but has no definition row in "
+                        "%s" % (var, doc.relpath)))
+            if project.contract_is_closed(doc_path):
+                test_reads = set()
+                for text in project.test_corpus().values():
+                    test_reads |= env_reads_in_text(text)
+                for var, lineno in sorted(doc.defined.items()):
+                    if var in reads or var in test_reads or \
+                            var in doc.removed:
+                        continue
+                    out.append(Finding(
+                        doc.relpath, lineno, 0, self.name,
+                        "%s is documented but nothing reads it — "
+                        "delete the row or mark it (removed)" % var,
+                        text="", func="<doc>"))
+        return out
+
+    @staticmethod
+    def _lit(node):
+        return node.value if isinstance(node, ast.Constant) and \
+            isinstance(node.value, str) else None
+
+    # -- wrapper plumbing --------------------------------------------------
+    def _find_wrappers(self, project):
+        """{func key: key-param index} for functions whose parameter
+        flows into a direct environ read."""
+        out = {}
+        for key, rec in project.funcs.items():
+            params = [a.arg for a in rec.node.args.args]
+            if rec.cls and params and params[0] == "self":
+                params = params[1:]
+                offset = 1
+            else:
+                offset = 0
+            if not params:
+                continue
+            for node in ast.walk(rec.node):
+                k = None
+                if isinstance(node, ast.Call):
+                    k = _key_node(node)
+                elif isinstance(node, ast.Subscript) and \
+                        _environ_expr(node.value):
+                    k = node.slice
+                if isinstance(k, ast.Name) and k.id in params:
+                    out[key] = params.index(k.id) + offset
+                    break
+        return out
+
+    def _wrapper_key(self, project, relpath, module, call, wrappers):
+        if not wrappers:
+            return None
+        from ..project import classify_call
+        kind = classify_call(call)
+        if kind is None:
+            return None
+        caller = self._enclosing_class(module, call)
+        target = project.resolve_callsite(relpath, caller, kind)
+        if target is None or target not in wrappers:
+            return None
+        idx = wrappers[target]
+        # a bound method call does not spell out self at the site
+        rec = project.funcs.get(target)
+        if rec is not None and rec.cls is not None and \
+                kind[0] != "plain" and idx:
+            idx -= 1
+        return call.args[idx] if idx < len(call.args) else None
+
+    @staticmethod
+    def _enclosing_class(module, node):
+        parents = module.parent_map()
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = parents.get(cur)
+        return None
+
+
+class _Line:
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.col_offset = 0

@@ -1,0 +1,109 @@
+"""fault-coverage pass: every fault-injection point is a tested,
+documented contract.
+
+``mxtpu/fault.py`` fires deterministic injection points
+(``fire("server.recv", ...)``) that the fault-matrix tests and the
+``MXTPU_FAULT_SPEC`` grammar in ``docs/env_vars.md`` target by name.
+A point added in code but absent from the grammar is un-targetable by
+operators; one absent from the fault matrix is an untested recovery
+path — both are exactly the drift this pass pins:
+
+* every ``fire("<point>")`` literal in the analyzed tree must appear
+  in the ``point=...`` alternation of the fault grammar
+  (``docs/env_vars.md``, resolved by walk-up so a fixture corpus can
+  carry its own copy);
+* in closed/whole-tree runs, every fire point must additionally appear
+  in at least one fault-matrix test row (textual ``point=<name>`` or
+  bare ``"<name>"`` in the sibling ``tests/`` corpus).
+
+Findings anchor at the ``fire(...)`` call site, so a deliberately
+untestable point carries its pragma next to the code it excuses.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import LintPass, register
+
+_POINT = re.compile(r"^[a-z_]+(\.[a-z_]+)+$")
+# the grammar row: point=worker.send\|worker.recv\|... (the backslashes
+# are markdown table escapes)
+_GRAMMAR = re.compile(r"point=((?:[a-z_.]+\\?\|)*[a-z_.]+)")
+
+
+def _grammar_points(doc_text):
+    out = set()
+    for m in _GRAMMAR.finditer(doc_text):
+        for p in m.group(1).replace("\\|", "|").split("|"):
+            if _POINT.match(p):
+                out.add(p)
+    return out
+
+
+@register
+class FaultCoveragePass(LintPass):
+    name = "fault-coverage"
+    scope = "project"
+    description = ("fire(<point>) literals missing from the "
+                   "MXTPU_FAULT_SPEC grammar or the fault-matrix "
+                   "tests")
+
+    def run_project(self, project):
+        sites = []               # (point, relpath, lineno)
+        for relpath, module in sorted(project.modules.items()):
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                if name != "fire" or not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        _POINT.match(arg.value):
+                    sites.append((arg.value, relpath, node.lineno))
+        if not sites:
+            return []
+        doc = project.find_contract_file("docs", "env_vars.md")
+        grammar = _grammar_points(
+            doc.read_text(encoding="utf-8", errors="replace")) \
+            if doc is not None else None
+        tests = project.test_corpus() if project.closed else None
+        out = []
+        for point, relpath, lineno in sites:
+            module = project.modules[relpath]
+            if grammar is not None and point not in grammar:
+                out.append(module.finding(
+                    _Line(lineno), self.name,
+                    "fault point %r is not in the MXTPU_FAULT_SPEC "
+                    "grammar (%s) — operators cannot target it"
+                    % (point, _rel(doc, project))))
+            if tests:
+                needle_a = "point=%s" % point
+                if not any(needle_a in text or ('"%s"' % point) in text
+                           or ("'%s'" % point) in text
+                           for text in tests.values()):
+                    out.append(module.finding(
+                        _Line(lineno), self.name,
+                        "fault point %r appears in no fault-matrix "
+                        "test row — its recovery path is untested"
+                        % point))
+        return out
+
+
+def _rel(path, project):
+    try:
+        return str(path.relative_to(project.root))
+    except ValueError:
+        return str(path)
+
+
+class _Line:
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.col_offset = 0

@@ -1,45 +1,51 @@
-"""lock-order pass: build the per-function lock-acquisition graph and
+"""lock-order pass: build the whole-program lock-acquisition graph and
 report cycles / inconsistent acquisition orders as potential deadlocks.
 
 What a regex can never see — ``with self._lock:`` *nesting* — is the
 whole pass:
 
-1. **Lock discovery.** An attribute is a lock when the module assigns it
-   from ``threading.Lock/RLock/Condition/Semaphore/BoundedSemaphore``
-   (``self._x = threading.Lock()``), or when its name matches the lock
-   naming convention (``*lock*``, ``*guard*``, ``*_cv``, ``*mutex*``,
-   ``*cond*``). A call to a method whose name matches ``*lock_for*`` /
-   ``*get_lock*`` is a lock factory — its result counts as one logical
-   lock token (all per-key locks collapse to one token, which is sound
-   for ordering: two threads taking two *different* key locks in
-   opposite orders cannot deadlock, but the collapsed token still
-   catches key-lock-vs-other-lock inversions, and a *nested* key lock
-   shows up as a self-cycle worth a look).
+1. **Lock discovery.** An attribute is a lock when the project assigns
+   it from ``threading.Lock/RLock/Condition/Semaphore/
+   BoundedSemaphore`` (``self._x = threading.Lock()``), or when its
+   name matches the lock naming convention (``*lock*``, ``*guard*``,
+   ``*_cv``, ``*mutex*``, ``*cond*``). A call to a method whose name
+   matches ``*lock_for*`` / ``*get_lock*`` is a lock factory — its
+   result counts as one logical lock token (all per-key locks collapse
+   to one token, which is sound for ordering: two threads taking two
+   *different* key locks in opposite orders cannot deadlock, but the
+   collapsed token still catches key-lock-vs-other-lock inversions,
+   and a *nested* key lock shows up as a self-cycle worth a look).
 
-2. **Token identity.** ``self._x`` is scoped to the enclosing class.
-   ``other._x`` resolves to the single class declaring ``_x`` as a lock
-   when that is unambiguous, else to a shared ``?._x`` token (collapsing
-   distinct locks can only over-report, never hide an inversion).
+2. **Token identity.** ``self._x`` is scoped to the enclosing class —
+   class-scoped tokens unify ACROSS modules, which is what lets a
+   serving-side call into ``kvstore_async`` meet the kvstore's own
+   acquisitions in one graph. ``other._x`` resolves to the single
+   declaring class (preferring a same-module declarer), else to a
+   module-scoped ``?`` token; bare local lock names scope to their
+   function (two functions' locals are different locks unless threaded
+   through a call, which the summaries model).
 
-3. **Held-set tracking.** ``with tok:`` holds through the body (multiple
-   items nest left to right); ``tok.acquire(...)`` holds until a
-   matching ``tok.release()`` later in the same statement list or the
-   end of the function. While H is held, acquiring t adds edges
+3. **Held-set tracking.** ``with tok:`` holds through the body
+   (multiple items nest left to right); ``tok.acquire(...)`` holds
+   until a matching ``tok.release()`` later in the same statement list
+   or the end of the function. While H is held, acquiring t adds edges
    ``h -> t`` for every h in H.
 
-4. **Call summaries.** While holding H, calling a function/method
-   resolvable inside the analyzed file set adds ``h -> t`` for every
-   lock t that callee may (transitively) acquire — so ``with
-   self._lock_for(key): self._note_worker_push(...)`` contributes the
-   ``key-lock -> workers-lock`` edge even though the nested acquisition
-   is two calls deep. Methods resolve by name within the defining class
-   first, then uniquely across the file set.
+4. **Interprocedural summaries.** While holding H, calling a function
+   resolvable through the project symbol table — same-class methods
+   (single-inheritance bases included), ``self.attr.m()`` through
+   attribute-type inference (``self.attr = Cls(...)``), imported
+   functions, then project-wide *unique* non-generic names — adds
+   ``h -> t`` for every lock t the callee may *transitively* acquire.
+   This is how a cross-module AB/BA inversion through a
+   ``threading.Thread(target=...)`` entry point surfaces: each
+   thread's body contributes its edges to the one global graph.
 
 5. **Verdict.** Strongly-connected components of the edge graph with
-   more than one token are inconsistent acquisition orders (the classic
-   AB/BA inversion is the 2-cycle); a self-edge is a nested acquisition
-   of one non-reentrant token. Each cycle is one finding per
-   participating edge site, so individual sites can be pragma'd or
+   more than one token are inconsistent acquisition orders (the
+   classic AB/BA inversion is the 2-cycle); a self-edge is a nested
+   acquisition of one non-reentrant token. Each cycle is one finding
+   per participating edge site, so individual sites can be pragma'd or
    baselined.
 """
 from __future__ import annotations
@@ -48,6 +54,7 @@ import ast
 import re
 
 from ..core import LintPass, register
+from ..project import classify_call
 
 _LOCK_CTORS = frozenset(("Lock", "RLock", "Condition", "Semaphore",
                          "BoundedSemaphore"))
@@ -62,28 +69,43 @@ def _attr_chain_root(node):
 
 
 class _FuncInfo:
-    def __init__(self, node, qualname, cls):
+    def __init__(self, node, relpath, qualname, cls):
         self.node = node
+        self.relpath = relpath
         self.qualname = qualname
         self.cls = cls            # enclosing class name or None
         self.direct = set()       # lock tokens acquired directly
-        self.calls = set()        # (recv_kind, name): recv_kind in
-        #                           ("self", "other", "plain")
+        self.calls = set()        # CallSite kind tuples (hashable)
         self.reach = None         # transitive token set
+
+    @property
+    def key(self):
+        return (self.relpath, self.qualname)
 
 
 class LockGraph:
-    """Per-module-set lock graph builder (kept separate from the pass so
-    the fixture harness and tests can drive it directly)."""
+    """Whole-program lock graph builder (kept separate from the pass so
+    the fixture harness and tests can drive it directly). Resolution
+    goes through the :class:`~mxlint.project.Project` symbol table."""
 
-    def __init__(self):
-        self.lock_attrs = {}      # attr -> set of declaring classes
-        self.funcs = {}           # qualname -> _FuncInfo
-        self.by_name = {}         # bare name -> [qualname]
-        self.by_class = {}        # (cls, name) -> qualname
-        self.edges = {}           # (a, b) -> [(module, line, qual)]
+    def __init__(self, project):
+        self.project = project
+        self.lock_attrs = {}      # attr -> {(relpath, class)}
+        self.funcs = {}           # (relpath, qualname) -> _FuncInfo
+        self.edges = {}           # (a, b) -> [(relpath, line, qual)]
 
     # -- discovery ---------------------------------------------------------
+    def build(self):
+        mods = sorted(self.project.modules.items())
+        for _, module in mods:
+            if module.tree is not None:
+                self._collect_lock_attrs(module)
+        for _, module in mods:
+            if module.tree is not None:
+                self._add_module(module)
+        self._finalize()
+        return self
+
     def _collect_lock_attrs(self, module):
         for node in ast.walk(module.tree):
             if not isinstance(node, (ast.Assign, ast.AnnAssign)):
@@ -104,7 +126,7 @@ class LockGraph:
                         t.value.id == "self":
                     cls = self._enclosing_class(module, t)
                     self.lock_attrs.setdefault(t.attr, set()).add(
-                        cls or "?")
+                        (module.relpath, cls or "?"))
 
     @staticmethod
     def _enclosing_class(module, node):
@@ -117,18 +139,21 @@ class LockGraph:
         return None
 
     # -- token naming ------------------------------------------------------
-    def _token_for(self, expr, cls):
+    def _token_for(self, expr, info):
         """Lock token for an expression, or None when it is not
-        lock-like. ``cls`` is the class of ``self`` at this site."""
+        lock-like. ``info`` carries the class of ``self`` and the
+        function scope for local-name tokens."""
+        cls = info.cls
         if isinstance(expr, ast.Call):
             f = expr.func
             name = f.attr if isinstance(f, ast.Attribute) else (
                 f.id if isinstance(f, ast.Name) else None)
             if name and _FACTORY_PAT.search(name):
-                owner = cls if (isinstance(f, ast.Attribute)
-                                and isinstance(f.value, ast.Name)
-                                and f.value.id == "self") else "?"
-                return "%s.%s()" % (owner or "?", name)
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self" and cls:
+                    return "%s.%s()" % (cls, name)
+                return "?[%s].%s()" % (info.relpath, name)
             return None
         if isinstance(expr, ast.Attribute):
             attr = expr.attr
@@ -139,43 +164,40 @@ class LockGraph:
             root = _attr_chain_root(expr)
             if isinstance(root, ast.Name) and root.id == "self" and cls:
                 return "%s.%s" % (cls, attr)
-            if declared and len(declared) == 1:
-                return "%s.%s" % (next(iter(declared)), attr)
-            return "?.%s" % attr
+            if declared:
+                # non-self access: the single declaring class wins; on
+                # a tie prefer a same-module declarer, else collapse to
+                # a module-scoped token (over-reports, never hides)
+                classes = {c for (_, c) in declared}
+                if len(classes) == 1:
+                    return "%s.%s" % (next(iter(classes)), attr)
+                local = {c for (rel, c) in declared
+                         if rel == info.relpath}
+                if len(local) == 1:
+                    return "%s.%s" % (next(iter(local)), attr)
+            return "?[%s].%s" % (info.relpath, attr)
         if isinstance(expr, ast.Name) and _NAME_PAT.search(expr.id):
-            return "local.%s" % expr.id
+            # a bare local: scoped to this function — distinct
+            # functions' locals are distinct locks
+            return "local[%s:%s].%s" % (info.relpath, info.qualname,
+                                        expr.id)
         if isinstance(expr, ast.Subscript):
             # e.g. self._ch_locks[i]: one token for the whole family
-            return self._token_for(expr.value, cls)
+            return self._token_for(expr.value, info)
         return None
 
     # -- function harvesting ----------------------------------------------
-    def add_module(self, module):
-        self._collect_lock_attrs(module)
+    def _add_module(self, module):
         for node in ast.walk(module.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 qual = module.qualname(node)
                 cls = self._enclosing_class(module, node)
-                info = _FuncInfo(node, qual, cls)
-                self.funcs[(module.relpath, qual)] = info
-                self.by_name.setdefault(node.name, []).append(
-                    (module.relpath, qual))
-                if cls:
-                    self.by_class[(cls, node.name)] = \
-                        (module.relpath, qual)
-                self._walk_function(module, info)
-
-    def _walk_function(self, module, info):
-        self._walk_body(module, info, info.node.body, [])
+                info = _FuncInfo(node, module.relpath, qual, cls)
+                self.funcs[info.key] = info
+                self._walk_body(module, info, info.node.body, [])
 
     def _note_acquire(self, module, info, token, held, node):
         for h in held:
-            if h == token and h.endswith("()"):
-                # distinct keys of one factory are distinct locks; a
-                # nested factory acquisition is only *potentially* a
-                # self-deadlock, so record it but let the verdict
-                # message say so
-                pass
             self.edges.setdefault((h, token), []).append(
                 (module.relpath, node.lineno, info.qualname))
         info.direct.add(token)
@@ -192,9 +214,9 @@ class LockGraph:
         if isinstance(stmt, ast.With):
             pushed = []
             for item in stmt.items:
-                tok = self._token_for(item.context_expr, info.cls)
+                tok = self._token_for(item.context_expr, info)
                 # calls inside the context expr still run
-                self._scan_calls(module, info, item.context_expr, held)
+                self._scan_calls(info, item.context_expr)
                 if tok is not None:
                     self._note_acquire(module, info, tok, held,
                                        item.context_expr)
@@ -208,16 +230,16 @@ class LockGraph:
         call = self._stmt_call(stmt)
         if call is not None and isinstance(call.func, ast.Attribute):
             if call.func.attr == "acquire":
-                tok = self._token_for(call.func.value, info.cls)
+                tok = self._token_for(call.func.value, info)
                 if tok is not None:
                     self._note_acquire(module, info, tok, held, call)
                     held.append(tok)
                     # still scan args (rare, but cheap)
                     for a in call.args:
-                        self._scan_calls(module, info, a, held)
+                        self._scan_calls(info, a)
                     return
             elif call.func.attr == "release":
-                tok = self._token_for(call.func.value, info.cls)
+                tok = self._token_for(call.func.value, info)
                 if tok is not None and tok in held:
                     held.remove(tok)
                     return
@@ -229,7 +251,7 @@ class LockGraph:
         for h in getattr(stmt, "handlers", []) or []:
             self._walk_body(module, info, h.body, held)
         # scan expressions of this statement for calls made while held
-        self._scan_calls(module, info, stmt, held, skip_bodies=True)
+        self._scan_calls(info, stmt)
 
     @staticmethod
     def _stmt_call(stmt):
@@ -237,45 +259,24 @@ class LockGraph:
             return stmt.value
         return None
 
-    def _scan_calls(self, module, info, node, held, skip_bodies=False):
+    def _scan_calls(self, info, node):
         """Record every call this function makes (for the transitive
-        lock summaries); the held-set edges for those calls are added by
-        the second walk in :meth:`finalize`."""
+        lock summaries); the held-set edges for those calls are added
+        by the second walk in :meth:`_finalize`."""
         for child in ast.walk(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
                                   ast.Lambda)):
                 continue
             if not isinstance(child, ast.Call):
                 continue
-            f = child.func
-            if isinstance(f, ast.Attribute):
-                if isinstance(f.value, ast.Name) and f.value.id == "self":
-                    info.calls.add(("self", f.attr, child.lineno))
-                else:
-                    info.calls.add(("other", f.attr, child.lineno))
-            elif isinstance(f, ast.Name):
-                info.calls.add(("plain", f.id, child.lineno))
+            kind = classify_call(child)
+            if kind is not None:
+                info.calls.add(kind)
 
     # -- interprocedural summary ------------------------------------------
-    # method names shared with the threading/queue primitives: a call
-    # like ``cv.wait()`` must never resolve to an unrelated same-named
-    # method in this file (it would fabricate lock edges)
-    _GENERIC = frozenset((
-        "wait", "join", "get", "put", "set", "clear", "notify",
-        "notify_all", "acquire", "release", "is_set", "result",
-        "append", "pop", "items", "values", "keys", "update", "add",
-        "discard", "remove", "copy", "close", "start"))
-
-    def _resolve(self, info, kind, name):
-        if kind == "self" and info.cls and \
-                (info.cls, name) in self.by_class:
-            return self.by_class[(info.cls, name)]
-        if kind != "plain" and name in self._GENERIC:
-            return None
-        cands = self.by_name.get(name, [])
-        if len(cands) == 1:
-            return cands[0]
-        return None
+    def _resolve(self, info, kind):
+        return self.project.resolve_callsite(info.relpath, info.cls,
+                                             kind)
 
     def _reach(self, key, stack=()):
         info = self.funcs.get(key)
@@ -286,19 +287,18 @@ class LockGraph:
         if key in stack:
             return set(info.direct)
         out = set(info.direct)
-        for entry in info.calls:
-            kind, name = entry[0], entry[1]
-            target = self._resolve(info, kind, name)
+        for kind in info.calls:
+            target = self._resolve(info, kind)
             if target is not None:
                 out |= self._reach(target, stack + (key,))
         info.reach = out
         return out
 
-    def finalize(self, modules_by_path):
-        """Second walk adding summary edges: while held-set H, a call to
-        a resolvable callee adds H x reach(callee)."""
+    def _finalize(self):
+        """Second walk adding summary edges: while held-set H, a call
+        to a resolvable callee adds H x reach(callee)."""
         for key, info in self.funcs.items():
-            module = modules_by_path.get(key[0])
+            module = self.project.modules.get(key[0])
             if module is None:
                 continue
             self._summary_walk(module, info, info.node.body, [])
@@ -315,7 +315,7 @@ class LockGraph:
         if isinstance(stmt, ast.With):
             pushed = []
             for item in stmt.items:
-                tok = self._token_for(item.context_expr, info.cls)
+                tok = self._token_for(item.context_expr, info)
                 self._summary_calls(module, info, item.context_expr, held)
                 if tok is not None:
                     held.append(tok)
@@ -327,12 +327,12 @@ class LockGraph:
         call = self._stmt_call(stmt)
         if call is not None and isinstance(call.func, ast.Attribute):
             if call.func.attr == "acquire":
-                tok = self._token_for(call.func.value, info.cls)
+                tok = self._token_for(call.func.value, info)
                 if tok is not None:
                     held.append(tok)
                     return
             elif call.func.attr == "release":
-                tok = self._token_for(call.func.value, info.cls)
+                tok = self._token_for(call.func.value, info)
                 if tok is not None and tok in held:
                     held.remove(tok)
                     return
@@ -358,16 +358,10 @@ class LockGraph:
                 continue
             if top_level_only and self._inside_nested_block(node, child):
                 continue
-            f = child.func
-            if isinstance(f, ast.Attribute):
-                kind = "self" if (isinstance(f.value, ast.Name)
-                                  and f.value.id == "self") else "other"
-                name = f.attr
-            elif isinstance(f, ast.Name):
-                kind, name = "plain", f.id
-            else:
+            kind = classify_call(child)
+            if kind is None:
                 continue
-            target = self._resolve(info, kind, name)
+            target = self._resolve(info, kind)
             if target is None:
                 continue
             for tok in self._reach(target):
@@ -409,28 +403,43 @@ class LockGraph:
         sccs = []
         counter = [0]
 
-        def strongconnect(v):
-            index[v] = low[v] = counter[0]
+        # iterative Tarjan: the whole-program graph can be deep
+        def strongconnect(root):
+            work = [(root, iter(graph.get(root, ())))]
+            index[root] = low[root] = counter[0]
             counter[0] += 1
-            stack.append(v)
-            on_stack.add(v)
-            for w in graph.get(v, ()):
-                if w not in index:
-                    strongconnect(w)
-                    low[v] = min(low[v], low[w])
-                elif w in on_stack:
-                    low[v] = min(low[v], index[w])
-            if low[v] == index[v]:
-                comp = []
-                while True:
-                    w = stack.pop()
-                    on_stack.discard(w)
-                    comp.append(w)
-                    if w == v:
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(graph.get(w, ()))))
+                        advanced = True
                         break
-                sccs.append(comp)
+                    elif w in on_stack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[v])
+                if low[v] == index[v]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == v:
+                            break
+                    sccs.append(comp)
 
-        for v in list(graph):
+        for v in sorted(graph):
             if v not in index:
                 strongconnect(v)
         out = []
@@ -451,16 +460,13 @@ class LockGraph:
 @register
 class LockOrderPass(LintPass):
     name = "lock-order"
-    description = ("lock-acquisition graph cycles / inconsistent "
-                   "acquisition orders (potential deadlocks)")
+    scope = "project"
+    description = ("whole-program lock-acquisition graph cycles / "
+                   "inconsistent acquisition orders (potential "
+                   "deadlocks)")
 
-    def run(self, module):
-        # the graph is meaningful per file: cross-file lock sharing in
-        # this tree happens through objects analyzed in their defining
-        # file (kvstore_async holds every party of its protocol)
-        graph = LockGraph()
-        graph.add_module(module)
-        graph.finalize({module.relpath: module})
+    def run_project(self, project):
+        graph = LockGraph(project).build()
         out = []
         for tokens, sites in graph.cycles():
             if len(tokens) == 1:
@@ -474,6 +480,9 @@ class LockOrderPass(LintPass):
                         % ", ".join(tokens))
             for (a, b), locs in sites:
                 for (relpath, lineno, qual) in locs:
+                    module = project.modules.get(relpath)
+                    if module is None:
+                        continue
                     f = module.finding(
                         _Anchor(lineno), self.name,
                         "%s; this site takes %s while holding %s"

@@ -1,0 +1,154 @@
+"""resource-leak pass: sockets, threads and subprocesses created and
+then abandoned in one function.
+
+The fleet's teardown bugs (PR 6's zombie launchers, nightly drivers
+leaking probe sockets) share one AST shape: a resource constructor
+bound to a plain local that the function neither closes, joins,
+returns, stores nor hands to anyone else. That narrow shape is what
+this pass flags — anything that *escapes* the function (returned,
+assigned to an attribute or container, passed as an argument, bound
+via ``with``) is presumed managed elsewhere, so the pass stays quiet
+on factories and registries by construction:
+
+* ``socket.socket()`` / ``socket.create_connection()`` locals need a
+  ``.close()`` (or a ``with`` block) on some path;
+* ``threading.Thread(...)`` locals need ``.join()`` unless created
+  ``daemon=True`` (a daemon thread's lifetime is the process's);
+* ``subprocess.Popen(...)`` locals need a ``wait``/``communicate``/
+  ``terminate``/``kill``.
+
+Escape analysis is per-function and name-based — deliberately simple;
+the point is the fire-and-forget constructor, not a full alias
+analysis.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass, register
+
+_CLEANUP = {
+    "socket": frozenset(("close", "detach", "shutdown")),
+    "thread": frozenset(("join",)),
+    "popen": frozenset(("wait", "communicate", "terminate", "kill",
+                        "poll")),
+}
+_CTORS = {
+    "socket": "socket", "create_connection": "socket",
+    "Thread": "thread", "Timer": "thread", "Popen": "popen",
+}
+_NOUN = {"socket": "socket", "thread": "thread", "popen": "subprocess"}
+
+
+def _ctor_kind(call):
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return _CTORS.get(name)
+
+
+def _daemon_true(call):
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                and bool(kw.value.value):
+            return True
+    return False
+
+
+@register
+class ResourceLeakPass(LintPass):
+    name = "resource-leak"
+    description = ("socket/thread/subprocess locals with no close/"
+                   "join/wait on any path and no escape from the "
+                   "function")
+
+    def run(self, module):
+        out = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_function(module, node))
+        return out
+
+    @staticmethod
+    def _own_nodes(fn):
+        """Walk ``fn`` without descending into nested defs/lambdas
+        (their locals are their own scope, checked separately) — but a
+        nested def still *sees* the enclosing locals, so closures are
+        scanned for cleanup/escape by the caller below."""
+        stack = [fn]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                stack.append(child)
+
+    def _check_function(self, module, fn):
+        created = {}             # local name -> (kind, ctor node)
+        for stmt in self._own_nodes(fn):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not (isinstance(stmt.value, ast.Call)):
+                continue
+            kind = _ctor_kind(stmt.value)
+            if kind is None:
+                continue
+            if kind == "thread" and _daemon_true(stmt.value):
+                continue
+            if len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                created[stmt.targets[0].id] = (kind, stmt.value)
+        if not created:
+            return []
+        cleaned, escaped = set(), set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in created:
+                    kind = created[f.value.id][0]
+                    if f.attr in _CLEANUP[kind]:
+                        cleaned.add(f.value.id)
+                for a in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    if isinstance(a, ast.Name) and a.id in created:
+                        escaped.add(a.id)
+            elif isinstance(node, ast.Return) and \
+                    node.value is not None:
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name) and n.id in created:
+                        escaped.add(n.id)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                src = node.value
+                names = {n.id for n in ast.walk(src)
+                         if isinstance(n, ast.Name)}
+                targets = node.targets \
+                    if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        # self.x = sock / d[k] = sock: escapes
+                        escaped.update(names & set(created))
+            elif isinstance(node, ast.withitem):
+                expr = node.context_expr
+                if isinstance(expr, ast.Name) and expr.id in created:
+                    cleaned.add(expr.id)
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)) and \
+                    node.value is not None:
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Name) and n.id in created:
+                        escaped.add(n.id)
+        out = []
+        for name, (kind, ctor) in sorted(created.items()):
+            if name in cleaned or name in escaped:
+                continue
+            out.append(module.finding(
+                ctor, self.name,
+                "%s %r is created here but never %s and never leaves "
+                "this function — it leaks on every path"
+                % (_NOUN[kind], name,
+                   "/".join(sorted(_CLEANUP[kind])[:2]) + "'d")))
+        return out

@@ -1,0 +1,401 @@
+"""wire-protocol pass: the op and verdict string sets of the fleet's
+wire protocols must agree between the servers that speak them and the
+clients that listen.
+
+The protocols under analysis are tuple-frame RPCs (kvstore_async, the
+serving wire): a request is ``("<op>", ...)``, a reply is
+``("<verdict>", ...)`` where verdicts beyond ``ok``/``err`` steer
+client routing (``overloaded``, ``draining``, ``expired``,
+``not_serving``/``map_stale`` inside err strings). None of this is
+typed — the contract lives in string literals on both sides of the
+wire, which is exactly what drifts silently when a server grows a new
+verdict nobody handles, or a handler outlives the last emitter.
+
+Extraction (all whole-program, over the project symbol table):
+
+* **Dispatchers** — a function assigning ``cmd``/``op``/``command``
+  from element 0 of a frame (``cmd = msg[0]``) and comparing it
+  against 2+ string literals. Those literals are the *dispatched op
+  set* (membership tests against literal tuples count too).
+* **Requested ops** — string literals in the first argument of a
+  ``*request*``-named call (``conn.request("hello", ...)``,
+  ``self._peer_request("peer_info")``), plus tuple-literal items of a
+  ``request_all`` batch. Looser *evidence* that an op is alive — a
+  tuple literal ``("push", ...)`` anywhere, or the literal appearing
+  as any call argument — only absolves a handler, it is never strong
+  enough to demand a handler.
+* **Emitted verdicts** — in *server modules* (a module containing a
+  dispatcher, plus modules whose classes a dispatcher module
+  instantiates as components, e.g. the serving batcher): the string
+  head of a tuple literal in return position, in a ``resolve(...)``
+  reply, or in a module-level constant (``_NO_REPLY``); plus the
+  ``tok`` of every ``("err", "tok: ...")`` reply — the kvstore's
+  routing sub-verdicts.
+* **Handled verdicts** — comparisons of a ``verdict``-named variable
+  or a ``reply[0]``-style subscript against string literals,
+  membership tests against literal tuples, substring guards
+  (``"not_serving" in str(e)``) and ``re.search("map_stale: ...")``
+  patterns.
+
+Findings:
+
+* an emitted verdict (beyond built-in ``ok``/``err``) with **no
+  handler anywhere** — the server speaks a word no client knows;
+* a requested op **no dispatcher serves** — the request can only come
+  back ``err``;
+* in closed/whole-tree runs additionally the dead-code directions: a
+  *handler* for a verdict nothing emits, and a *dispatched op* nothing
+  requests.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import LintPass, register
+
+_TOKEN = re.compile(r"^[a-z_][a-z0-9_]*$")
+_DISPATCH_VARS = frozenset(("cmd", "op", "command", "opcode"))
+_BUILTIN_VERDICTS = frozenset(("ok", "err"))
+_REPLY_BASES = re.compile(r"reply|resp|verdict|^r$")
+
+
+def _tok(value):
+    return isinstance(value, str) and bool(_TOKEN.match(value))
+
+
+def _str_const(node):
+    return node.value if isinstance(node, ast.Constant) and \
+        isinstance(node.value, str) else None
+
+
+def _iter_funcs(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class _Protocol:
+    """Everything extracted from one project, site-anchored."""
+
+    def __init__(self):
+        self.dispatched = {}      # op -> [(relpath, lineno)]
+        self.requested = {}       # op -> [(relpath, lineno)]
+        self.evidence = set()     # loose liveness evidence for ops
+        self.emitted = {}         # verdict -> [(relpath, lineno)]
+        self.err_texts = []       # literal err reply texts
+        self.handled = set()      # broad: any handling literal
+        self.handler_sites = {}   # narrow: verdict -> [(relpath, line)]
+        self.substr_sites = {}    # substring guards -> [(relpath, ln)]
+        self.dispatcher_modules = set()
+        self.client_modules = set()
+
+
+def _sub_verdict(text):
+    """``not_serving`` out of ``"not_serving: shard replica ..."``."""
+    head, sep, _ = text.partition(":")
+    if sep and _tok(head):
+        return head
+    return None
+
+
+@register
+class WireProtocolPass(LintPass):
+    name = "wire-protocol"
+    scope = "project"
+    description = ("op/verdict drift between wire servers and their "
+                   "clients (unhandled verdicts, unserved requests, "
+                   "dead handlers)")
+
+    # -- extraction --------------------------------------------------------
+    def _extract(self, project):
+        proto = _Protocol()
+        for relpath, module in sorted(project.modules.items()):
+            if module.tree is None:
+                continue
+            self._extract_module(relpath, module, proto)
+        self._extract_components(project, proto)
+        return proto
+
+    def _extract_module(self, relpath, module, proto):
+        tree = module.tree
+        for fn in _iter_funcs(tree):
+            ops = self._dispatcher_ops(fn)
+            if ops:
+                proto.dispatcher_modules.add(relpath)
+                for op, line in ops:
+                    proto.dispatched.setdefault(op, []).append(
+                        (relpath, line))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._scan_request_call(relpath, node, proto)
+            elif isinstance(node, ast.Tuple):
+                head = _str_const(node.elts[0]) if node.elts else None
+                if head is not None:
+                    proto.evidence.add(head)
+            elif isinstance(node, ast.Compare):
+                self._scan_compare(relpath, node, proto)
+        if relpath in proto.client_modules or \
+                self._has_strict_request(tree):
+            proto.client_modules.add(relpath)
+
+    def _dispatcher_ops(self, fn):
+        """``[(op, lineno)]`` when ``fn`` is a frame dispatcher, else
+        []: it assigns a ``cmd``/``op`` variable from ``<frame>[0]``
+        and compares it against >= 2 string literals."""
+        dvars = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Subscript)
+                    and isinstance(node.value.slice, ast.Constant)
+                    and node.value.slice.value == 0):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in _DISPATCH_VARS:
+                    dvars.add(t.id)
+        if not dvars:
+            return []
+        ops = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+                continue
+            left, right = node.left, node.comparators[0]
+            if isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                for lit, var in ((left, right), (right, left)):
+                    v = _str_const(lit)
+                    if v is not None and isinstance(var, ast.Name) \
+                            and var.id in dvars and _tok(v):
+                        ops.append((v, node.lineno))
+            elif isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                    isinstance(left, ast.Name) and left.id in dvars and \
+                    isinstance(right, (ast.Tuple, ast.List, ast.Set)):
+                for e in right.elts:
+                    v = _str_const(e)
+                    if v is not None and _tok(v):
+                        ops.append((v, node.lineno))
+        return ops if len({o for o, _ in ops}) >= 2 else []
+
+    @staticmethod
+    def _has_strict_request(tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    "request" in node.func.attr and node.args and \
+                    _str_const(node.args[0]) is not None:
+                return True
+        return False
+
+    def _scan_request_call(self, relpath, node, proto):
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name is None:
+            return
+        if "request" in name and node.args:
+            v = _str_const(node.args[0])
+            if v is not None and _tok(v):
+                if name == "request_all":
+                    proto.evidence.add(v)
+                else:
+                    proto.requested.setdefault(v, []).append(
+                        (relpath, node.lineno))
+            elif name == "request_all" and \
+                    isinstance(node.args[0], (ast.List, ast.Tuple)):
+                for e in node.args[0].elts:
+                    if isinstance(e, ast.Tuple) and e.elts:
+                        v = _str_const(e.elts[0])
+                        if v is not None and _tok(v):
+                            proto.requested.setdefault(v, []).append(
+                                (relpath, node.lineno))
+        # any literal op riding any call keeps a handler alive
+        for a in node.args:
+            v = _str_const(a)
+            if v is not None:
+                proto.evidence.add(v)
+        # re.search("map_stale: ...") / substring handling guards
+        if name in ("search", "match", "fullmatch") and node.args:
+            v = _str_const(node.args[0])
+            if v is not None:
+                sub = _sub_verdict(v)
+                if sub is not None:
+                    proto.handled.add(sub)
+                    proto.substr_sites.setdefault(sub, []).append(
+                        (relpath, node.lineno))
+
+    def _scan_compare(self, relpath, node, proto):
+        if len(node.ops) != 1:
+            return
+        left, right = node.left, node.comparators[0]
+
+        def is_reply_expr(x, narrow):
+            if isinstance(x, ast.Name):
+                return bool(_REPLY_BASES.search(x.id)) or \
+                    (not narrow and x.id in _DISPATCH_VARS)
+            if isinstance(x, ast.Subscript) and \
+                    isinstance(x.slice, ast.Constant) and \
+                    x.slice.value == 0:
+                base = x.value
+                if narrow:
+                    return isinstance(base, ast.Name) and \
+                        bool(_REPLY_BASES.search(base.id))
+                return True
+            return False
+
+        if isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            for lit, var in ((left, right), (right, left)):
+                v = _str_const(lit)
+                if v is None or not _tok(v):
+                    continue
+                if is_reply_expr(var, narrow=False):
+                    proto.handled.add(v)
+                if is_reply_expr(var, narrow=True):
+                    proto.handler_sites.setdefault(v, []).append(
+                        (relpath, node.lineno))
+        elif isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            if isinstance(right, (ast.Tuple, ast.List, ast.Set)):
+                for e in right.elts:
+                    v = _str_const(e)
+                    if v is None or not _tok(v):
+                        continue
+                    if is_reply_expr(left, narrow=False):
+                        proto.handled.add(v)
+                    if is_reply_expr(left, narrow=True):
+                        proto.handler_sites.setdefault(v, []).append(
+                            (relpath, node.lineno))
+            elif isinstance(right, ast.Call):
+                # "not_serving" in str(e): substring-shaped handling
+                v = _str_const(left)
+                fn = right.func
+                if v is not None and _tok(v) and \
+                        isinstance(fn, ast.Name) and fn.id == "str":
+                    proto.handled.add(v)
+                    proto.substr_sites.setdefault(v, []).append(
+                        (relpath, node.lineno))
+
+    # -- emit scope --------------------------------------------------------
+    def _extract_components(self, project, proto):
+        scope = set(proto.dispatcher_modules)
+        for relpath in proto.dispatcher_modules:
+            for recs in project.classes.values():
+                for crec in recs:
+                    if crec.relpath != relpath:
+                        continue
+                    for tname in crec.attr_types.values():
+                        for trec in project.classes.get(tname, ()):
+                            scope.add(trec.relpath)
+        for relpath in sorted(scope):
+            module = project.modules.get(relpath)
+            if module is None or module.tree is None:
+                continue
+            self._extract_emits(relpath, module, proto)
+
+    def _emit_tuple(self, relpath, node, proto):
+        if not (isinstance(node, ast.Tuple) and node.elts):
+            return
+        head = _str_const(node.elts[0])
+        if head is None or not _tok(head):
+            return
+        proto.emitted.setdefault(head, []).append(
+            (relpath, node.lineno))
+        if head == "err" and len(node.elts) > 1:
+            second = node.elts[1]
+            if isinstance(second, ast.BinOp) and \
+                    isinstance(second.op, ast.Mod):
+                second = second.left
+            text = _str_const(second)
+            if text is not None:
+                proto.err_texts.append(text)
+                sub = _sub_verdict(text)
+                if sub is not None:
+                    proto.emitted.setdefault(sub, []).append(
+                        (relpath, node.lineno))
+
+    def _extract_emits(self, relpath, module, proto):
+        tree = module.tree
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Return) and node.value is not None:
+                vals = [node.value]
+                if isinstance(node.value, ast.IfExp):
+                    vals = [node.value.body, node.value.orelse]
+                for v in vals:
+                    self._emit_tuple(relpath, v, proto)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "resolve":
+                for a in node.args:
+                    self._emit_tuple(relpath, a, proto)
+        # module-level reply constants (the _NO_REPLY sentinel)
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                self._emit_tuple(relpath, stmt.value, proto)
+
+    # -- verdicts ----------------------------------------------------------
+    def run_project(self, project):
+        proto = self._extract(project)
+        out = []
+
+        def emit(relpath, lineno, message):
+            module = project.modules.get(relpath)
+            if module is None:
+                return
+            out.append(module.finding(_Line(lineno), self.name,
+                                      message))
+
+        for verdict, sites in sorted(proto.emitted.items()):
+            if verdict in _BUILTIN_VERDICTS or verdict in proto.handled:
+                continue
+            for relpath, lineno in sites:
+                emit(relpath, lineno,
+                     "verdict %r is emitted on the wire but no client "
+                     "handles it (checked ==/in comparisons, substring "
+                     "guards and regexes project-wide)" % verdict)
+        if proto.dispatched:
+            for op, sites in sorted(proto.requested.items()):
+                if op in proto.dispatched:
+                    continue
+                for relpath, lineno in sites:
+                    emit(relpath, lineno,
+                         "op %r is requested but no dispatcher serves "
+                         "it — this request can only come back err"
+                         % op)
+        if project.closed:
+            alive = set(proto.evidence) | set(proto.requested)
+            for op, sites in sorted(proto.dispatched.items()):
+                if op in alive:
+                    continue
+                for relpath, lineno in sites:
+                    emit(relpath, lineno,
+                         "op %r has a dispatch arm but nothing in the "
+                         "program ever sends it — dead wire handler"
+                         % op)
+            emitted = set(proto.emitted) | _BUILTIN_VERDICTS
+            for verdict, sites in sorted(proto.handler_sites.items()):
+                if verdict in emitted or verdict in proto.dispatched \
+                        or verdict in proto.evidence:
+                    continue
+                for relpath, lineno in sites:
+                    if relpath not in proto.client_modules:
+                        continue
+                    emit(relpath, lineno,
+                         "handler for verdict %r but no server emits "
+                         "it — dead verdict handler" % verdict)
+            # a substring guard is alive while its text still appears
+            # in some emitted err reply
+            for verdict, sites in sorted(proto.substr_sites.items()):
+                if verdict in emitted or verdict in proto.evidence or \
+                        any(verdict in t for t in proto.err_texts):
+                    continue
+                for relpath, lineno in sites:
+                    if relpath not in proto.client_modules:
+                        continue
+                    emit(relpath, lineno,
+                         "substring guard for %r matches no emitted "
+                         "err reply — dead verdict handler" % verdict)
+        return out
+
+
+class _Line:
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.col_offset = 0

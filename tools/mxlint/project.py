@@ -1,0 +1,538 @@
+"""Whole-program analysis context: the project symbol table, import
+resolution, attribute-type inference, the cross-module call graph and
+thread entry points — the infrastructure the interprocedural passes
+(lock-order, wire-protocol, fault-coverage, env-drift) run on.
+
+Scope model
+-----------
+``run_paths`` builds ONE :class:`Project` per lint invocation:
+
+* When every requested file lives under the default lint roots
+  (``mxtpu/``, ``tools/``), the project is the FULL tree under those
+  roots and the requested files merely select which findings are
+  *reported* — so ``--diff`` and single-file lints still see the whole
+  call graph (a changed file's finding can depend on an unchanged
+  peer).
+* Otherwise (fixture corpora, tmp files) the project is exactly the
+  requested file set. A request that named a *directory* is treated as
+  a **closed** corpus: project-wide contract directions (a documented
+  knob with no read site, a dispatched op nobody requests, an untested
+  fault point) are meaningful and enabled. A request for loose files
+  is open: only code-anchored directions run.
+
+Resolution model (deliberately modest — precision over reach)
+-------------------------------------------------------------
+* ``self.m()``                 -> method ``m`` of the enclosing class,
+  then of its single-inheritance bases known to the project.
+* ``self.attr.m()``            -> via attribute-type inference:
+  ``self.attr = Cls(...)`` anywhere in the class binds ``attr: Cls``.
+* ``mod.f()`` / ``f()``        -> through the per-module import map
+  (``import x.y as mod`` / ``from x.y import f``), else same-module
+  top-level ``f``, else a project-wide *unique* name.
+* Names shared with threading/queue primitives (``get``, ``wait``,
+  ``join``...) never resolve through the unique-name fallback — a
+  ``cv.wait()`` must not alias an unrelated method (see
+  ``GENERIC_NAMES``).
+
+Thread entry points — ``threading.Thread(target=f)``, pool
+``submit(f)``, ``start_new_thread(f)`` — are indexed because they are
+the concurrency roots: every lock-order cycle needs at least two of
+them alive, and the fixture corpus seeds its cross-module inversion
+through one.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+# method names shared with the stdlib threading/queue/socket surface: a
+# call like ``cv.wait()`` must never resolve to a same-named project
+# method through the unique-name fallback (it would fabricate edges)
+GENERIC_NAMES = frozenset((
+    "wait", "join", "get", "put", "set", "clear", "notify",
+    "notify_all", "acquire", "release", "is_set", "result",
+    "append", "pop", "items", "values", "keys", "update", "add",
+    "discard", "remove", "copy", "close", "start", "stop", "run",
+    "send", "recv", "read", "write", "flush", "next", "reset",
+    "submit", "shutdown", "cancel", "count", "index", "sort",
+    "extend", "insert", "format", "strip", "split", "lower", "upper"))
+
+DEFAULT_ROOT_DIRS = ("mxtpu", "tools")
+
+
+class FuncRec:
+    """One project function/method: where it is, who encloses it, what
+    it calls (recorded by passes or the shared harvest below)."""
+
+    __slots__ = ("relpath", "qualname", "node", "cls", "calls")
+
+    def __init__(self, relpath, qualname, node, cls):
+        self.relpath = relpath
+        self.qualname = qualname
+        self.node = node
+        self.cls = cls              # enclosing class name or None
+        self.calls = []             # [CallSite]
+
+    @property
+    def key(self):
+        return (self.relpath, self.qualname)
+
+
+class CallSite:
+    """One call expression, pre-digested for resolution: ``kind`` is
+    how the callee was named —
+
+    * ``("plain", f)``          for ``f(...)``
+    * ``("self", m)``           for ``self.m(...)``
+    * ``("self_attr", a, m)``   for ``self.a.m(...)``
+    * ``("name", n, m)``        for ``n.m(...)`` (n a local/imported
+      name)
+    * ``("other", m)``          for any deeper attribute chain
+    """
+
+    __slots__ = ("kind", "lineno")
+
+    def __init__(self, kind, lineno):
+        self.kind = kind
+        self.lineno = lineno
+
+
+def classify_call(call):
+    """The :class:`CallSite` kind tuple for one ``ast.Call``, or None
+    for calls through subscripts/calls/lambdas."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("plain", f.id)
+    if not isinstance(f, ast.Attribute):
+        return None
+    base = f.value
+    if isinstance(base, ast.Name):
+        if base.id == "self":
+            return ("self", f.attr)
+        return ("name", base.id, f.attr)
+    if isinstance(base, ast.Attribute) and \
+            isinstance(base.value, ast.Name) and base.value.id == "self":
+        return ("self_attr", base.attr, f.attr)
+    return ("other", f.attr)
+
+
+class ClassRec:
+    __slots__ = ("relpath", "name", "node", "bases", "methods",
+                 "attr_types")
+
+    def __init__(self, relpath, name, node):
+        self.relpath = relpath
+        self.name = name
+        self.node = node
+        self.bases = []             # base-class bare names
+        self.methods = {}           # method name -> qualname
+        self.attr_types = {}        # self.X = Cls(...) -> "Cls"
+
+
+_THREAD_CTORS = frozenset(("Thread", "Timer"))
+_SUBMIT_NAMES = frozenset(("submit", "start_new_thread",
+                           "apply_async", "map_async"))
+
+
+class Project:
+    """The whole-program context handed to ``scope = "project"``
+    passes."""
+
+    def __init__(self, modules, root, closed=False, report_relpaths=None):
+        self.root = pathlib.Path(root)
+        self.modules = {}            # relpath -> ModuleInfo
+        for m in modules:
+            self.modules[m.relpath] = m
+        self.closed = closed
+        self.report_relpaths = set(report_relpaths) \
+            if report_relpaths is not None else set(self.modules)
+        self.funcs = {}              # (relpath, qualname) -> FuncRec
+        self.classes = {}            # bare name -> [ClassRec]
+        self.by_method = {}          # meth name -> [(relpath, qual, cls)]
+        self.by_plain = {}           # fn name -> [(relpath, qual)]
+        self.module_plain = {}       # (relpath, fn name) -> qual
+        self.imports = {}            # relpath -> {local: ("module", rel)
+        #                                        | ("symbol", rel, name)}
+        self.entry_points = []       # [(relpath, qualname, lineno, how)]
+        self._modname_to_rel = {}
+        basenames = {}
+        for relpath in self.modules:
+            name = self._modname(relpath)
+            self._modname_to_rel[name] = relpath
+            basenames.setdefault(name.rsplit(".", 1)[-1],
+                                 []).append(relpath)
+        # a flat corpus imports by basename (``import beta``): register
+        # unique basenames that no dotted name already claims
+        for base, rels in basenames.items():
+            if len(rels) == 1 and base not in self._modname_to_rel:
+                self._modname_to_rel[base] = rels[0]
+        for relpath, module in sorted(self.modules.items()):
+            if module.tree is not None:
+                self._harvest(relpath, module)
+        self._resolve_entry_points()
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def _modname(relpath):
+        parts = pathlib.PurePosixPath(relpath).with_suffix("").parts
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def _harvest(self, relpath, module):
+        self.imports[relpath] = imap = {}
+        tree = module.tree
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    tgt = self._modname_to_rel.get(a.name)
+                    if tgt is not None:
+                        imap[a.asname or a.name.split(".")[0]] = \
+                            ("module", tgt)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._absolute_from(relpath, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    as_mod = self._modname_to_rel.get(
+                        base + "." + a.name if base else a.name)
+                    if as_mod is not None:
+                        imap[a.asname or a.name] = ("module", as_mod)
+                        continue
+                    src = self._modname_to_rel.get(base)
+                    if src is not None:
+                        imap[a.asname or a.name] = \
+                            ("symbol", src, a.name)
+        # classes, functions, attribute types
+        parents = module.parent_map()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                rec = ClassRec(relpath, node.name, node)
+                rec.bases = [b.id if isinstance(b, ast.Name) else b.attr
+                             for b in node.bases
+                             if isinstance(b, (ast.Name, ast.Attribute))]
+                self.classes.setdefault(node.name, []).append(rec)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual = module.qualname(node)
+            cls = self._enclosing_class_name(parents, node)
+            rec = FuncRec(relpath, qual, node, cls)
+            self.funcs[rec.key] = rec
+            if cls:
+                self.by_method.setdefault(node.name, []).append(
+                    (relpath, qual, cls))
+                for crec in self.classes.get(cls, ()):
+                    if crec.relpath == relpath:
+                        crec.methods[node.name] = qual
+            else:
+                self.by_plain.setdefault(node.name, []).append(
+                    (relpath, qual))
+                cur = parents.get(node)
+                if not isinstance(cur, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.ClassDef)):
+                    self.module_plain[(relpath, node.name)] = qual
+            self._harvest_calls(rec)
+        self._harvest_attr_types(relpath, module, parents)
+
+    def _absolute_from(self, relpath, node):
+        """Absolute module name a ``from X import ...`` refers to, or
+        None when it points outside the project."""
+        if node.level == 0:
+            return node.module if node.module else None
+        pkg = self._modname(relpath).split(".")
+        # one level strips the module name itself, further levels strip
+        # packages
+        if len(pkg) < node.level:
+            return None
+        pkg = pkg[:len(pkg) - node.level]
+        if node.module:
+            pkg = pkg + node.module.split(".")
+        return ".".join(pkg)
+
+    @staticmethod
+    def _enclosing_class_name(parents, node):
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a def nested in a method belongs to no class
+                return None
+            cur = parents.get(cur)
+        return None
+
+    def _harvest_calls(self, rec):
+        for child in ast.walk(rec.node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and child is not rec.node:
+                continue
+            if isinstance(child, ast.Call):
+                kind = classify_call(child)
+                if kind is not None:
+                    rec.calls.append(CallSite(kind, child.lineno))
+
+    def _harvest_attr_types(self, relpath, module, parents):
+        """``self.X = Cls(...)`` inside a class binds ``X: Cls`` when
+        ``Cls`` names a project class (possibly through an import)."""
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if not (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)):
+                continue
+            cname = v.func.id
+            if cname not in self.classes and \
+                    self.imports.get(relpath, {}).get(cname) is None:
+                continue
+            cls = self._enclosing_class_of_node(parents, node)
+            if cls is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    for crec in self.classes.get(cls, ()):
+                        if crec.relpath == relpath:
+                            crec.attr_types[t.attr] = cname
+
+    @staticmethod
+    def _enclosing_class_of_node(parents, node):
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = parents.get(cur)
+        return None
+
+    def _resolve_entry_points(self):
+        """Index ``Thread(target=f)`` / ``submit(f)`` /
+        ``start_new_thread(f)`` spawn sites: the concurrency roots."""
+        for relpath, module in sorted(self.modules.items()):
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                fname = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                target = None
+                if fname in _THREAD_CTORS:
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                elif fname in _SUBMIT_NAMES and node.args:
+                    target = node.args[0]
+                if target is None:
+                    continue
+                key = self._entry_target_key(relpath, module, node,
+                                             target)
+                if key is not None:
+                    self.entry_points.append(
+                        (key[0], key[1], node.lineno, fname))
+
+    def _entry_target_key(self, relpath, module, call, target):
+        encl = None
+        parents = module.parent_map()
+        cur = parents.get(call)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                encl = cur.name
+                break
+            cur = parents.get(cur)
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self" and encl:
+            return self.resolve_method(encl, target.attr, relpath)
+        if isinstance(target, ast.Name):
+            got = self.resolve_plain(relpath, target.id)
+            if got is not None:
+                return got
+        return None
+
+    # -- resolution --------------------------------------------------------
+    def resolve_method(self, cls, name, relpath=None):
+        """``(relpath, qualname)`` of method ``name`` on class ``cls``
+        (walking single-inheritance bases known to the project)."""
+        seen = set()
+        stack = [cls]
+        while stack:
+            cname = stack.pop(0)
+            if cname in seen:
+                continue
+            seen.add(cname)
+            recs = self.classes.get(cname, ())
+            ordered = sorted(recs, key=lambda r: r.relpath != relpath)
+            for crec in ordered:
+                if name in crec.methods:
+                    return (crec.relpath, crec.methods[name])
+            for crec in ordered:
+                stack.extend(crec.bases)
+        return None
+
+    def resolve_plain(self, relpath, name):
+        """A bare-name call: same-module def, imported symbol, then a
+        project-wide unique non-generic name."""
+        got = self.module_plain.get((relpath, name))
+        if got is not None:
+            return (relpath, got)
+        imp = self.imports.get(relpath, {}).get(name)
+        if imp is not None and imp[0] == "symbol":
+            tgt = self.module_plain.get((imp[1], imp[2]))
+            if tgt is not None:
+                return (imp[1], tgt)
+            # an imported class: its __init__ runs at the call
+            got = self.resolve_method(imp[2], "__init__", imp[1])
+            if got is not None and imp[2] in self.classes:
+                return got
+        if name in self.classes:
+            cands = self.classes[name]
+            if len(cands) == 1:
+                got = self.resolve_method(name, "__init__",
+                                          cands[0].relpath)
+                if got is not None:
+                    return got
+        if name in GENERIC_NAMES:
+            return None
+        cands = self.by_plain.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def resolve_callsite(self, relpath, caller_cls, kind):
+        """Resolve one :class:`CallSite` kind tuple to a project
+        function key, or None."""
+        tag = kind[0]
+        if tag == "plain":
+            return self.resolve_plain(relpath, kind[1])
+        if tag == "self":
+            if caller_cls:
+                got = self.resolve_method(caller_cls, kind[1], relpath)
+                if got is not None:
+                    return got
+            return self._unique_method(kind[1])
+        if tag == "self_attr":
+            attr, meth = kind[1], kind[2]
+            if caller_cls:
+                for crec in self.classes.get(caller_cls, ()):
+                    tname = crec.attr_types.get(attr)
+                    if tname:
+                        got = self.resolve_method(tname, meth,
+                                                  crec.relpath)
+                        if got is not None:
+                            return got
+            return self._unique_method(meth)
+        if tag == "name":
+            base, meth = kind[1], kind[2]
+            imp = self.imports.get(relpath, {}).get(base)
+            if imp is not None and imp[0] == "module":
+                tgt = self.module_plain.get((imp[1], meth))
+                if tgt is not None:
+                    return (imp[1], tgt)
+                # module.Class(...) constructor
+                for crec in self.classes.get(meth, ()):
+                    if crec.relpath == imp[1]:
+                        return self.resolve_method(meth, "__init__",
+                                                   imp[1])
+                return None
+            return self._unique_method(meth)
+        if tag == "other":
+            return self._unique_method(kind[1])
+        return None
+
+    def _unique_method(self, name):
+        if name in GENERIC_NAMES:
+            return None
+        cands = self.by_method.get(name, [])
+        if len(cands) == 1:
+            return cands[0][:2]
+        return None
+
+    # -- contract context --------------------------------------------------
+    def common_dir(self):
+        dirs = [self.root / pathlib.Path(rel) for rel in self.modules]
+        if not dirs:
+            return self.root
+        parts = None
+        for d in dirs:
+            p = d.parent.parts
+            parts = p if parts is None else parts[
+                :next((i for i, (a, b) in enumerate(zip(parts, p))
+                       if a != b), min(len(parts), len(p)))]
+        return pathlib.Path(*parts) if parts else self.root
+
+    def find_contract_file(self, *relparts):
+        """Walk up from the modules' common directory to the project
+        root looking for e.g. ``docs/env_vars.md``; the fixture corpus
+        carries its own copy below its corpus dir, the real tree
+        resolves to the repo's."""
+        cur = self.common_dir()
+        root = self.root.resolve()
+        while True:
+            cand = cur.joinpath(*relparts)
+            if cand.exists():
+                return cand
+            if cur.resolve() == root or cur.parent == cur:
+                return None
+            cur = cur.parent
+
+    def contract_is_closed(self, contract_path):
+        """Project-wide drift directions (dead doc entry, dead handler,
+        untested fault point) fire only when the project can actually
+        see every referent: the full default-roots tree, or a
+        self-contained corpus whose contract file lives inside it."""
+        if contract_path is None:
+            return False
+        if not self.closed:
+            return False
+        try:
+            contract_path.resolve().relative_to(
+                self.common_dir().resolve())
+            return True
+        except ValueError:
+            pass
+        # full-tree mode: the contract doc sits beside the roots
+        return self._covers_default_roots()
+
+    def _covers_default_roots(self):
+        have = {rel.split("/")[0] for rel in self.modules}
+        return set(DEFAULT_ROOT_DIRS) <= have
+
+    def test_corpus(self):
+        """``{relpath: text}`` of the sibling test tree (fault-matrix
+        rows, env read sites in drivers) — reference material for the
+        contract passes, never lint targets themselves."""
+        tests = self.find_contract_file("tests")
+        out = {}
+        if tests is None or not tests.is_dir():
+            return out
+        for f in sorted(tests.rglob("*.py")):
+            inner = f.relative_to(tests).parts
+            if "__pycache__" in inner or "fixtures" in inner:
+                continue
+            try:
+                rel = str(f.relative_to(self.root))
+            except ValueError:
+                rel = str(f)
+            try:
+                out[rel] = f.read_text(encoding="utf-8",
+                                       errors="replace")
+            except OSError:
+                continue
+        return out
+
+
+_ENV_READ_RE = re.compile(
+    r"""(?:environ(?:\.get|\.setdefault)?\s*[\[\(]\s*|getenv\s*\(\s*)
+        ["'](MXTPU_[A-Z0-9_]+)["']""", re.VERBOSE)
+
+
+def env_reads_in_text(text):
+    """Textual env-read extraction for reference corpora (tests,
+    examples) where a full AST pass would be overkill."""
+    return set(_ENV_READ_RE.findall(text))

@@ -1,0 +1,74 @@
+"""SARIF 2.1.0 output: the findings artifact in the interchange format
+CI diff-annotators understand (one run, one rule per pass, one result
+per finding, the line-number-free fingerprint carried as a partial
+fingerprint so annotations survive rebases the same way the baseline
+does)."""
+from __future__ import annotations
+
+import json
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def findings_to_sarif(findings, tool_version="2.0",
+                      baseline_fingerprints=()):
+    """One SARIF ``run`` for a findings list. Findings whose
+    fingerprint sits in ``baseline_fingerprints`` are marked
+    ``baselineState: unchanged`` so annotators can hide them."""
+    from .core import all_passes
+    grandfathered = set(baseline_fingerprints)
+    rules = []
+    for name, cls in sorted(all_passes().items()):
+        rules.append({
+            "id": name,
+            "shortDescription": {"text": cls.description or name},
+            "helpUri": "docs/static_analysis.md",
+        })
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.pass_id,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col + 1)},
+                },
+                "logicalLocations": [{"name": f.func,
+                                      "kind": "function"}],
+            }],
+        }
+        if f.fingerprint:
+            res["partialFingerprints"] = {
+                "mxlint/v1": f.fingerprint}
+        if f.fingerprint in grandfathered:
+            res["baselineState"] = "unchanged"
+        results.append(res)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mxlint",
+                "version": tool_version,
+                "informationUri": "docs/static_analysis.md",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path, findings, baseline_fingerprints=()):
+    doc = findings_to_sarif(
+        findings, baseline_fingerprints=baseline_fingerprints)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
