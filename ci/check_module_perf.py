@@ -19,8 +19,16 @@ noisy host; structure can — in the style of ``check_guard_overhead.py``:
 3. **One executable per signature**: one batch signature holds at most
    two programs (pre-metric warmup + metric-fused), never one per batch.
 
-Run: ``JAX_PLATFORMS=cpu python ci/check_module_perf.py`` (wired into
-``ci/run_ci.sh fast``). No timing, no thresholds in seconds.
+``--dist`` (ISSUE 10) runs the same structural contract over the fused
+DISTRIBUTED path — ``Module.fit`` through ``kvstore='dist_async'`` in
+async mode: zero retraces after warmup, zero per-batch device->host
+transfers on the training thread (the gradient read rides the store's
+worker pool), and the bounded-inflight push window pinned through the
+``kv.stats()['module_fused_dist']`` counters.
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_module_perf.py [--dist]``
+(both wired into ``ci/run_ci.sh fast``). No timing, no thresholds in
+seconds.
 """
 from __future__ import annotations
 
@@ -33,6 +41,13 @@ os.environ["MXTPU_MODULE_FUSED"] = "1"
 
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..")))
+
+if "--dist" in sys.argv:
+    # async dist mode + a quiet loopback store, set BEFORE the first
+    # mxtpu import so module-level knobs see them
+    os.environ["MXTPU_MODULE_FUSED_DIST"] = "1"
+    os.environ["MXTPU_MODULE_DIST_MODE"] = "async"
+    os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
 
 import numpy as np                                    # noqa: E402
 import jax                                            # noqa: E402
@@ -140,5 +155,103 @@ def main():
     return 0
 
 
+def main_dist():
+    """The fused-dist structural contract (async mode, loopback PS)."""
+    failures = []
+    np.random.seed(0)
+    x = np.random.randn(128, 20).astype("float32")
+    y = np.random.randint(0, 4, 128).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    kv = mx.kv.create("dist_async")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    if mod._fused is None or mod._fused.mode != "dist":
+        print("check_module_perf --dist: FAIL")
+        print("  - fused dist train step did not engage "
+              "(mode=%r)" % (getattr(mod._fused, "mode", None),))
+        kv.close()
+        return 1
+    metric = mx.metric.create("acc")
+    batches = list(it)
+
+    def one(batch):
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+    # warmup: compiles + metric registration + the first window fills
+    for b in batches[:2]:
+        one(b)
+    mod._fused.flush()
+    metric.get()
+    stats = mod._fused._group.stats
+    compiles_before = stats["compiles"]
+    drains_before = stats["metric_drains"]
+    metric.reset()
+
+    # -- 1+2: steady state — zero retraces, zero training-thread
+    # device->host transfers (the gradient d2h rides the pool thread)
+    try:
+        with _no_d2h():
+            for i in range(_BATCHES):
+                one(batches[i % len(batches)])
+    except Exception as e:
+        failures.append(
+            "steady-state dist fit loop performed a device->host "
+            "transfer on the training thread: %s: %s"
+            % (type(e).__name__, str(e)[:200]))
+    mod._fused.flush()
+
+    if stats["compiles"] != compiles_before:
+        failures.append(
+            "steady-state dist epoch retraced: %d new compiles after "
+            "warmup" % (stats["compiles"] - compiles_before))
+    if stats["metric_drains"] != drains_before:
+        failures.append(
+            "metric accumulator drained %d times DURING the dist epoch"
+            % (stats["metric_drains"] - drains_before))
+    name, value = metric.get()
+    if not (0.0 <= value <= 1.0):
+        failures.append("async-accumulated accuracy out of range: %r"
+                        % (value,))
+
+    # -- 3: the push window really pipelined AND stayed bounded ------
+    win = kv.stats().get("module_fused_dist")
+    if win is None:
+        failures.append("kv.stats() lacks the module_fused_dist "
+                        "window counters")
+    else:
+        if win["dispatched"] < _BATCHES:
+            failures.append(
+                "push window dispatched %d jobs for %d batches"
+                % (win["dispatched"], _BATCHES))
+        if win["inflight_hwm"] > win["window"]:
+            failures.append(
+                "push window inflight high-water %d exceeded its "
+                "bound %d" % (win["inflight_hwm"], win["window"]))
+        if win["inflight_hwm"] < 1:
+            failures.append("push window never went async "
+                            "(inflight_hwm=0)")
+        if win["inflight"] != 0 or win["completed"] != win["dispatched"]:
+            failures.append(
+                "flush left the window undrained: %r" % (win,))
+    kv.close()
+
+    if failures:
+        print("check_module_perf --dist: FAIL")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("check_module_perf --dist: OK (zero retraces after warmup, "
+          "zero training-thread host syncs, push window bounded at %d "
+          "with hwm %d over %d dispatches)"
+          % (win["window"], win["inflight_hwm"], win["dispatched"]))
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_dist() if "--dist" in sys.argv else main())

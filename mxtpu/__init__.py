@@ -70,7 +70,7 @@ def _optional_imports():
         ("kvstore", ("kv",)), ("kvstore_server", ()),
         ("gluon", ()), ("parallel", ()),
         ("gradient_compression", ()), ("checkpoint", ()),
-        ("resilience", ()),
+        ("resilience", ()), ("partition", ()), ("dist_hooks", ()),
         ("profiler", ()), ("recordio", ()), ("image", ()),
         ("test_utils", ()), ("visualization", ("viz",)), ("monitor", ()),
         ("rnn", ()), ("engine", ()), ("operator", ()), ("contrib", ()),

@@ -106,14 +106,21 @@ class CheckpointManager:
 
     # -- public API ---------------------------------------------------------
     def save(self, step, params, trainer=None, metadata=None,
-             extras=None):
+             extras=None, layout=None):
         """Snapshot ``params`` (dict name -> NDArray/Parameter) plus the
         optimizer state of a Gluon ``trainer`` and free-form metadata.
         ``extras`` is a dict name -> ndarray of caller-owned blobs saved
         verbatim (the dist_async ParameterServer stores its pickled
-        optimizer payload this way). Returns immediately when async;
-        call :meth:`wait_until_finished` or rely on the next
-        save/restore to join."""
+        optimizer payload this way). ``layout`` is a
+        :class:`mxtpu.partition.PartitionRules`-style object (anything
+        with ``.layout(names) -> {group: [names]}``): the fallback
+        writer then writes one ``params-<group>.npz`` blob per rule
+        group — the SAME grouping that drives trainer mesh placement
+        and kvstore key shards, so a shard's keys restore from a
+        shard's file (restore is layout-agnostic: every ``params*.npz``
+        merges back). Returns immediately when async; call
+        :meth:`wait_until_finished` or rely on the next save/restore to
+        join."""
         tree = {"params": _tree_from(params)}
         if trainer is not None:
             if hasattr(trainer, "_updaters"):     # gluon Trainer
@@ -132,10 +139,12 @@ class CheckpointManager:
             tree["extras"] = {k: _np.asarray(v)
                               for k, v in extras.items()}
         if self._orbax_mgr is not None:
+            # orbax owns its own on-disk sharding; the rule-group layout
+            # applies to the fallback writer's npz blobs only
             import orbax.checkpoint as ocp
             self._orbax_mgr.save(step, args=ocp.args.StandardSave(tree))
             return
-        self._fallback_save(step, tree)
+        self._fallback_save(step, tree, layout=layout)
 
     def restore(self, step=None, params=None, trainer=None):
         """Load checkpoint ``step`` (latest when None). When ``params`` is
@@ -247,8 +256,11 @@ class CheckpointManager:
         finally:
             os.close(fd)
 
-    def _fallback_save(self, step, tree):
+    def _fallback_save(self, step, tree, layout=None):
         self.wait_until_finished()          # one writer at a time
+        groups = None
+        if layout is not None and tree.get("params"):
+            groups = layout.layout(list(tree["params"]))
 
         def write():
             try:
@@ -264,9 +276,21 @@ class CheckpointManager:
                 # os.replace made durable before its contents would let
                 # a crash (power cut, kill -9 mid-writeback) publish a
                 # manifest pointing at missing/partial arrays.
-                with open(os.path.join(tmp, "params.npz"), "wb") as f:
-                    _np.savez(f, **tree["params"])
-                    self._fsync_file(f)
+                # With a rule-group layout, each group gets its own blob
+                # (params-<group>.npz); the integrity section stays ONE
+                # flat params map so restore verifies the merged tree.
+                if groups:
+                    for tag in sorted(groups):
+                        fname = "params-%s.npz" % tag if tag \
+                            else "params.npz"
+                        blob = {k: tree["params"][k] for k in groups[tag]}
+                        with open(os.path.join(tmp, fname), "wb") as f:
+                            _np.savez(f, **blob)
+                            self._fsync_file(f)
+                else:
+                    with open(os.path.join(tmp, "params.npz"), "wb") as f:
+                        _np.savez(f, **tree["params"])
+                        self._fsync_file(f)
                 integrity["params"] = self._crc_tags(tree["params"])
                 for extra in ("trainer_states", "metadata", "extras"):
                     if extra in tree:
@@ -307,8 +331,19 @@ class CheckpointManager:
     def _fallback_restore(self, step):
         base = os.path.join(self.directory, "step_%d" % step)
         try:
-            with _np.load(os.path.join(base, "params.npz")) as z:
-                tree = {"params": {k: z[k] for k in z.files}}
+            # layout-agnostic read: one monolithic params.npz or one
+            # blob per rule group (params-<group>.npz) merge identically
+            params = {}
+            blobs = sorted(n for n in os.listdir(base)
+                           if n.startswith("params") and
+                           n.endswith(".npz"))
+            if not blobs:
+                raise CheckpointCorrupt(
+                    "step %d has no params blob" % step)
+            for name in blobs:
+                with _np.load(os.path.join(base, name)) as z:
+                    params.update({k: z[k] for k in z.files})
+            tree = {"params": params}
             for extra in ("trainer_states", "metadata", "extras"):
                 path = os.path.join(base, extra + ".npz")
                 if os.path.exists(path):

@@ -1,0 +1,135 @@
+"""Shared async gradient-push machinery for the two training stacks.
+
+Both :class:`~mxtpu.parallel.trainer.ShardedTrainer` (the gluon SPMD
+stack, PR 2) and the fused Module train step
+(:mod:`mxtpu.module.fused`, ISSUE 10) overlap each step's device compute
+with the previous step's KVStore wire work through the SAME pattern: the
+jitted step *emits gradients*, a hook ships them on the store's worker
+pool (``kv.push_async`` / ``kv.push_pull_async``), and a bounded
+in-flight window applies backpressure so a stalled sink blocks the
+dispatcher instead of piling up futures (and device gradients) without
+bound. This module is the one implementation of that window — extracted
+from ``parallel/trainer.py`` so the Module path cannot fork it.
+
+``AsyncPushWindow`` reaps completed futures on the DISPATCHING thread
+(at ``dispatch``/``drain_completed``/``flush``), so an ``on_complete``
+callback may safely touch donation-sensitive state (rebind parameter
+buffers, run a donated apply program): it never races the training
+thread because it runs on it.
+"""
+from __future__ import annotations
+
+import os
+from collections import deque
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+__all__ = ["AsyncPushWindow", "kvstore_grad_pusher", "push_inflight"]
+
+
+def push_inflight(default=2):
+    """MXTPU_MODULE_PUSH_INFLIGHT: bound on outstanding async grad
+    pushes of the fused Module dist step (the backpressure window)."""
+    try:
+        return max(1, int(os.environ.get("MXTPU_MODULE_PUSH_INFLIGHT",
+                                         str(default))))
+    except ValueError:
+        return default
+
+
+class AsyncPushWindow:
+    """Bounded window of outstanding push futures (the PR-2
+    backpressure pattern).
+
+    ``dispatch(thunk)`` first drains down to under ``max_inflight``
+    (blocking on the oldest future — backpressure), then calls
+    ``thunk()``; a returned future (anything with ``.result()``) is
+    tracked, anything else counts as completed immediately. Failures
+    surface at the drain that reaps them — never silently.
+
+    ``on_complete(result)`` (per-dispatch) runs when the future is
+    reaped, always on the reaping (training) thread — the safe place to
+    rebind donated buffers with the wire's results.
+
+    ``stats()`` is shaped for ``kv.add_stats_source``: the fused Module
+    dist path publishes it under ``kv.stats()['module_fused_dist']`` so
+    ``ci/check_module_perf.py --dist`` can pin the bounded-inflight
+    contract next to the comms evidence.
+    """
+
+    def __init__(self, max_inflight=2):
+        self._max = max(1, int(max_inflight))
+        self._inflight = deque()
+        self._dispatched = 0
+        self._completed = 0
+        self._hwm = 0
+
+    @property
+    def max_inflight(self):
+        return self._max
+
+    def __len__(self):
+        return len(self._inflight)
+
+    def _reap(self):
+        fut, on_complete = self._inflight.popleft()
+        res = fut.result()
+        self._completed += 1
+        if on_complete is not None:
+            on_complete(res)
+
+    def dispatch(self, thunk, on_complete=None):
+        """Backpressure-drain, then ship one push. Returns the future
+        (or the thunk's non-future result)."""
+        while len(self._inflight) >= self._max:
+            self._reap()
+        fut = thunk()
+        self._dispatched += 1
+        if fut is not None and hasattr(fut, "result"):
+            self._inflight.append((fut, on_complete))
+            if len(self._inflight) > self._hwm:
+                self._hwm = len(self._inflight)
+        else:
+            self._completed += 1
+            if on_complete is not None:
+                on_complete(fut)
+        return fut
+
+    def drain_completed(self):
+        """Reap every already-finished future without blocking on the
+        ones still in flight."""
+        while self._inflight and self._inflight[0][0].done():
+            self._reap()
+
+    def flush(self):
+        """Block until every outstanding push has landed, surfacing the
+        first failure (and running its on_complete)."""
+        while self._inflight:
+            self._reap()
+
+    def stats(self):
+        return {"window": self._max, "inflight": len(self._inflight),
+                "inflight_hwm": self._hwm, "dispatched": self._dispatched,
+                "completed": self._completed}
+
+
+def kvstore_grad_pusher(kv):
+    """The ``set_grad_push`` hook wiring gradients to a (dist_async)
+    KVStore: ``push_fn({name: grad})`` ships every gradient via
+    ``kv.push_async`` on the store's worker pool, lazily ``kv.init``-ing
+    unseen keys with zeros on first push (extracted from
+    ``ShardedTrainer.attach_kvstore`` so both stacks share it)."""
+    inited = set()
+
+    def _push(grads):
+        new = [n for n in grads if n not in inited]
+        if new:
+            kv.init(new, [NDArray(jnp.zeros_like(grads[n]._data))
+                          for n in new])
+            inited.update(new)
+        keys = list(grads)
+        return kv.push_async(keys, [grads[k] for k in keys])
+
+    return _push

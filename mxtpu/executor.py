@@ -458,6 +458,103 @@ class Executor:
 
         return fused, other_names
 
+    def make_fused_grad_step(self, train_names, metric_fn=None,
+                             donate=True):
+        """Grad-EMITTING mode of the fused train step — the
+        kvstore/dist path (ISSUE 10). ONE jitted program runs forward +
+        backward (ones cotangents, loss-head pattern) + the optional
+        device-side metric accumulation and RETURNS the gradients
+        instead of applying an optimizer: the update happens where the
+        kvstore says it does — server-side (``update_on_kvstore``) or
+        locally through :meth:`make_fused_apply_step` after the pull.
+
+        Donation semantics: the parameters are NOT donated — this
+        program only reads them, and the kvstore pull rebinds them
+        afterwards. Aux states (1), the rng key (3) and the metric
+        accumulator (4) are donated; the caller rebinds their wrappers
+        every step exactly like the train-step contract.
+
+        Returns ``(fn, other_names)`` where ``fn(train_vals, aux_vals,
+        other_vals, key, metric_acc) -> (grads, new_aux, outs, key',
+        metric_acc')``.
+        """
+        outputs_ref = self._symbol._outputs
+        arg_names = tuple(self._arg_names)
+        aux_names = tuple(self._aux_names)
+        train_names = tuple(train_names)
+        train_set = set(train_names)
+        other_names = tuple(n for n in arg_names if n not in train_set)
+        mirror = self._mirror
+
+        def _forward(gvals, other_vals, aux_vals, key):
+            local = dict(zip(other_names, other_vals))
+            local.update(zip(aux_names, aux_vals))
+            local.update(zip(train_names, gvals))
+            with rng_scope(key):
+                outs, aux_updates = eval_graph(outputs_ref, local, True)
+            new_aux = tuple(aux_updates.get(n, local[n]) for n in aux_names)
+            return tuple(outs), new_aux
+
+        donate_argnums = (1, 3, 4) if donate else ()
+
+        @functools.partial(jax.jit, donate_argnums=donate_argnums)
+        def fused_grads(train_vals, aux_vals, other_vals, key, metric_acc):
+            key, sub = _split2(key)
+
+            def f(gvals):
+                return _forward(gvals, other_vals, aux_vals, sub)
+
+            with jax.named_scope("fwd_bwd"):
+                (outs, new_aux), vjp_fn = jax.vjp(
+                    maybe_remat(f, enabled=mirror), tuple(train_vals))
+                cot = tuple(_ones_cot(o) for o in outs)
+                zero_aux = tuple(_zeros_cot(a) for a in new_aux)
+                grads = vjp_fn((cot, zero_aux))[0]
+            if metric_fn is not None:
+                with jax.named_scope("metric"):
+                    m_sum, m_cnt = metric_fn(dict(zip(other_names,
+                                                      other_vals)), outs)
+                    metric_acc = metric_acc + jnp.stack(
+                        [m_sum, m_cnt]).astype(metric_acc.dtype)
+            return grads, tuple(new_aux), outs, key, metric_acc
+
+        return fused_grads, other_names
+
+    def make_fused_apply_step(self, train_names, optimizer, opt_slots,
+                              donate=True):
+        """The optimizer half of the fused step on its own — the
+        locally-applied update of the kvstore dist path (ISSUE 10,
+        ``update_on_kvstore=False``): after the pull returns the merged
+        gradients, ONE jitted multi-tensor apply runs every parameter
+        through :func:`optimizer.functional_optimizer_step`, with the
+        parameters (0), optimizer state trees (1) and step count (3)
+        donated so XLA updates the buffers in place. Gradients (2) and
+        lr (4) are not donated (grads arrive as freshly-pulled host
+        values; lr is a carried constant).
+
+        Returns ``fn(train_vals, state_trees, grad_vals, t, lr) ->
+        (new_vals, new_states, t+1)``.
+        """
+        from .optimizer import functional_optimizer_step
+        opt_slots = tuple(opt_slots)
+
+        donate_argnums = (0, 1, 3) if donate else ()
+
+        @functools.partial(jax.jit, donate_argnums=donate_argnums)
+        def fused_apply(train_vals, state_trees, grad_vals, t, lr):
+            t = t + 1
+            new_vals, new_states = [], []
+            with jax.named_scope("optimizer"):
+                for slot, w, g, st in zip(opt_slots, train_vals,
+                                          grad_vals, state_trees):
+                    w2, st2 = functional_optimizer_step(
+                        optimizer, slot, w, g, st, t, lr)
+                    new_vals.append(w2)
+                    new_states.append(st2)
+            return tuple(new_vals), tuple(new_states), t
+
+        return fused_apply
+
     def adopt_arrays(self, arg_src, aux_src):
         """Alias this executor's argument/aux slots to the given NDArray
         OBJECTS (same shape+dtype) so a group of executors — the buckets

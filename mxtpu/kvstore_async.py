@@ -28,7 +28,18 @@ and that observed staleness > 0 — the behavior sync mode cannot produce.
 
 Key sharding across multiple servers mirrors ps-lite's key→server
 assignment: each key lives on ``servers[crc32(key) % n]``; servers are
-independent and never talk to each other. Big arrays additionally split
+independent and never talk to each other. A shared
+:class:`mxtpu.partition.PartitionRules` spec (``set_partition_rules``)
+refines this: keys a rule matches co-locate on their rule group's
+shard — the same grouping that drives ShardedTrainer mesh placement
+and CheckpointManager layout (ISSUE 10's one-spec-three-layouts).
+
+``push_pull`` fuses apply + read-back into ONE round trip per part
+(the reference's ps-lite PushPull, op ``pushpull``): the server
+applies the gradient and replies with the post-update value — the
+per-batch wire op of the fused Module dist step. Common optimizers
+apply on a numpy host mirror (``Optimizer.update_host``) so the
+server's per-push cost is arithmetic, not device dispatch. Big arrays additionally split
 into row-contiguous parts (the reference's
 ``MXNET_KVSTORE_BIGARRAY_BOUND`` key splits, ``kvstore_dist.h:500-540``;
 bound here via ``MXTPU_KVSTORE_BIGARRAY_BOUND``, default 1e6 elements):
@@ -245,6 +256,7 @@ import zlib
 import uuid
 
 import numpy as _np
+import jax
 
 from . import fault as _fault
 from . import ndarray as nd
@@ -1450,15 +1462,23 @@ class ParameterServer:
                 # update (updater math included) bit-for-bit.
                 if self._updater is not None:
                     # async semantics: apply THIS push now, no merge
-                    # wait. The updater math is device-side (mxtpu
-                    # optimizer), so bounce through NDArray and land the
-                    # result back as numpy (np.asarray of a CPU jax
-                    # buffer is zero-copy, and that buffer is immutable
-                    # — pulls may hand it out without a tear copy).
-                    w = nd.array(store)
+                    # wait. Common optimizers apply on their numpy host
+                    # mirror (Updater.update_host — no per-key device
+                    # round-trip, the cost that dominated the dist
+                    # Module hot loop); anything without a host mirror
+                    # bounces through NDArray and lands the result back
+                    # as numpy (np.asarray of a CPU jax buffer is
+                    # zero-copy, and that buffer is immutable — pulls
+                    # may hand it out without a tear copy; the host
+                    # path writes a fresh array for the same reason).
                     with self._updater_lock:
-                        self._updater(_key_int(key), nd.array(g), w)
-                        self._table[key] = _np.asarray(w._data)
+                        new_w = self._updater.update_host(
+                            _key_int(key), store, g)
+                        if new_w is None:
+                            w = nd.array(store)
+                            self._updater(_key_int(key), nd.array(g), w)
+                            new_w = _np.asarray(w._data)
+                        self._table[key] = new_w
                         self._clock[key] += 1
                         if stream is not None:
                             rseq = stream.forward(rec)
@@ -1482,8 +1502,9 @@ class ParameterServer:
     # stream must stay the only writer (and the authoritative reader)
     # of a backup's table, or failover could serve/accept torn state
     _CLIENT_STATE_CMDS = frozenset(
-        ("init", "push", "pull", "pull_rows", "multi", "set_optimizer",
-         "barrier", "split", "adopt_key", "cursor_next", "cursor_done"))
+        ("init", "push", "pushpull", "pull", "pull_rows", "multi",
+         "set_optimizer", "opt_states", "set_opt_states", "barrier",
+         "split", "adopt_key", "cursor_next", "cursor_done"))
 
     def _dispatch(self, msg, _repl=False):
         cmd = msg[0]
@@ -1498,6 +1519,29 @@ class ParameterServer:
             return self._do_init(msg, _repl=_repl)
         if cmd == "push":
             return self._do_push(msg, _repl=_repl)
+        if cmd == "pushpull":
+            # the reference's fused PushPull (kvstore_dist_server.h
+            # DataHandleDefault + response): apply the push, reply with
+            # the post-update value and clock in the SAME round trip —
+            # the dist Module fast path's per-batch op. Replication
+            # forwards the underlying push record, so backups replay it
+            # exactly like a plain push; a deduped replay still answers
+            # with the current value (at-most-once apply, always-fresh
+            # read).
+            reply = self._do_push(("push",) + tuple(msg[1:]),
+                                  _repl=_repl)
+            if reply[0] != "ok":
+                return reply
+            key = msg[1]
+            with self._lock_for(key):
+                if key not in self._table:
+                    dst = self._moved.get(key)
+                    if dst is not None:
+                        return self._stale_reply(key, dst)
+                    return ("err", "pull of uninitialized key %r" % (key,))
+                tbl = self._table[key]
+                value = tbl if self._updater is not None else tbl.copy()
+                return ("ok", value, self._clock[key])
         if cmd == "pull":
             _, key = msg
             with self._lock_for(key):
@@ -1630,6 +1674,33 @@ class ParameterServer:
                 if stream is not None:
                     rseq = stream.forward(
                         ("set_optimizer", self._opt_payload))
+            self._repl_barrier(stream, rseq)
+            return ("ok",)
+        if cmd == "opt_states":
+            # this shard's updater states, pickled numpy
+            # (Updater.get_states): the client's save_optimizer_states
+            # merges the disjoint per-shard slots into one file
+            if self._updater is None:
+                return ("err", "no optimizer installed on %s"
+                        % self.address)
+            with self._updater_lock:
+                return ("ok", self._updater.get_states())
+        if cmd == "set_opt_states":
+            # install saved updater states (each shard uses only its
+            # own keys' slots); replicated like set_optimizer so a
+            # promoted backup carries the restored state too
+            _, payload = msg
+            if self._updater is None:
+                return ("err", "no optimizer installed on %s"
+                        % self.address)
+            stream = rseq = None
+            with self._updater_lock:
+                self._updater.set_states(bytes(payload))
+                if not _repl:
+                    with self._repl_guard:
+                        stream = self._repl
+                    if stream is not None:
+                        rseq = stream.forward(("set_opt_states", payload))
             self._repl_barrier(stream, rseq)
             return ("ok",)
         if cmd == "repl":
@@ -2078,8 +2149,9 @@ def _stale_dst(err):
 
 # every command whose replay is harmless: pull/pull_rows/stats/ping read,
 # init is first-writer-wins, set_optimizer re-installs the same payload,
-# push dedupes via its (origin, seq) pair, and multi only ever carries
-# the preceding commands. Replication traffic is replay-safe too: repl
+# push dedupes via its (origin, seq) pair (pushpull likewise — a
+# replayed apply is refused but the reply still carries the current
+# value), and multi only ever carries the preceding commands. Replication traffic is replay-safe too: repl
 # records dedupe on the backup's rseq watermark, promote/peer_info are
 # naturally idempotent, and a replayed join_backup just restarts the
 # catch-up on a fresh stream id. barrier is NOT here — a replayed
@@ -2089,9 +2161,9 @@ def _stale_dst(err):
 # marks into a set, adopt_key refuses clocks at or below its watermark,
 # and a replayed split only re-moves keys still local.
 _IDEMPOTENT = frozenset(
-    ("init", "push", "pull", "pull_rows", "stats", "ping",
-     "set_optimizer", "multi", "hello", "bye",
-     "repl", "promote", "peer_info", "join_backup",
+    ("init", "push", "pushpull", "pull", "pull_rows", "stats", "ping",
+     "set_optimizer", "opt_states", "set_opt_states", "multi",
+     "hello", "bye", "repl", "promote", "peer_info", "join_backup",
      "shard_map", "cursor_next", "cursor_done", "adopt_key", "split"))
 
 
@@ -2751,6 +2823,7 @@ class AsyncDistKVStore(KVStore):
         self._shapes = {}          # key -> full array shape
         # -- elasticity: versioned shard map (module docstring) --
         self._key_overrides = {}   # wire key -> its current home addr
+        self._partition_rules = None   # shared PartitionRules spec
         self._map_versions = {}    # server addr -> last-seen map_version
         self._extra_conns = {}     # reshard-born server addr -> conn
         self._extra_guard = threading.Lock()
@@ -2802,6 +2875,19 @@ class AsyncDistKVStore(KVStore):
     def num_workers(self):
         return self._size
 
+    def set_partition_rules(self, rules):
+        """Adopt the shared :class:`mxtpu.partition.PartitionRules`
+        spec for key->server assignment: every key a rule matches
+        (parts of big arrays included) co-locates on the rule group's
+        shard, the same grouping that drives ShardedTrainer mesh
+        placement and the checkpoint layout — ONE spec, three layouts
+        (ISSUE 10). Unmatched keys keep the legacy per-key crc32
+        spread. Must be set identically on every worker BEFORE the
+        first init/push/pull, like the static key ranges it refines;
+        online-reshard overrides still win over the rules (a moved key
+        is a moved key)."""
+        self._partition_rules = rules
+
     def _conn(self, key):
         # deterministic cross-process key->server assignment (builtin
         # hash() is salted per process; every worker must agree, like
@@ -2810,6 +2896,11 @@ class AsyncDistKVStore(KVStore):
         dst = self._key_overrides.get(key)
         if dst is not None:
             return self._conn_for_addr(dst)
+        rules = self._partition_rules
+        if rules is not None:
+            idx = rules.shard_for(key, len(self._conns))
+            if idx is not None:
+                return self._conns[idx]
         digest = zlib.crc32(str(key).encode("utf-8"))
         return self._conns[digest % len(self._conns)]
 
@@ -2955,7 +3046,12 @@ class AsyncDistKVStore(KVStore):
                     merged._data = merged._data + arr._data
             else:
                 merged = v
-            arr = merged.asnumpy()
+            # raw numpy values are accepted as-is: the fused Module dist
+            # step batch-fetches a whole step's gradients in ONE
+            # device_get and pushes the host arrays, instead of paying a
+            # per-key d2h dispatch here
+            arr = merged.asnumpy() if hasattr(merged, "asnumpy") \
+                else _np.asarray(merged)
             for sk, lo, hi in self._plan(k, merged.shape):
                 payload = self._wire_payload(sk, _slice_part(arr, lo, hi))
                 nbytes = payload.nbytes if isinstance(payload, _np.ndarray) \
@@ -3040,6 +3136,147 @@ class AsyncDistKVStore(KVStore):
         wire (the ShardedTrainer gradient-push hook rides this).
         Failures surface at ``.result()``."""
         return self._pool.submit(self.push, key, value, priority)
+
+    def push_pull(self, key, value, out=None, priority=0):
+        """Fused push+pull: ONE wire round trip per part applies the
+        gradient server-side and returns the post-update value into
+        ``out`` — the reference's ps-lite ``PushPull``
+        (``kvstore_dist.h`` PushPullDefault), and the per-batch op of
+        the fused Module dist fast path. Entries are seq-stamped like
+        plain pushes, so a retried/replayed part applies at most once
+        while every retry still reads the current value. Failure
+        handling composes the push story (dead shard -> buffered with
+        the ORIGINAL seq, moved key -> routed replay) with the pull
+        story (degraded last-known values)."""
+        assert out is not None
+        keys, vals = _ctype_key_value(key, value)
+        _okeys, outs = _ctype_key_value(key, out)
+        per_conn = {}
+        plans = []
+        for k, v, o in zip(keys, vals, outs):
+            if isinstance(v, (list, tuple)):
+                merged = v[0].copy()
+                for arr_v in v[1:]:
+                    merged._data = merged._data + arr_v._data
+            else:
+                merged = v
+            arr = merged.asnumpy() if hasattr(merged, "asnumpy") \
+                else _np.asarray(merged)
+            plan = self._plan(k, merged.shape)
+            plans.append((k, o, plan))
+            for sk, lo, hi in plan:
+                payload = self._wire_payload(sk, _slice_part(arr, lo, hi))
+                nbytes = payload.nbytes if isinstance(payload, _np.ndarray) \
+                    else payload[2].nbytes
+                entry = (sk, payload, self._base_clock.get(sk, 0),
+                         next(self._seq))
+                lanes = per_conn.setdefault(
+                    self._conn(sk), {"small": [], "big": []})
+                lanes["small" if nbytes <= _COALESCE_BYTES
+                      else "big"].append(entry)
+        results = {}
+        for got in self._pmap([(lambda c=c, l=l: self._pushpull_conn(c, l))
+                               for c, l in per_conn.items()]):
+            results.update(got)
+        self._assemble_pulled(plans, results)
+
+    def _pushpull_conn(self, conn, lanes):
+        """Everything one push_pull() call exchanges with one server:
+        the push lanes of :meth:`_push_conn` (big parts pipelined,
+        small parts coalesced), but every sub-command is a fused
+        ``pushpull`` whose reply carries the post-update value.
+        Returns ``{subkey: (value, clock)}``."""
+        out = {}
+        small = lanes["small"]
+        if len(small) == 1:
+            lanes["big"] += small
+            small = []
+        msgs, groups = [], []
+        for i in range(0, len(small), _COALESCE_MAX):
+            chunk = small[i:i + _COALESCE_MAX]
+            msgs.append(("multi",
+                         [("pushpull", sk, payload, clock, self._origin,
+                           seq)
+                          for sk, payload, clock, seq in chunk]))
+            groups.append((True, chunk))
+            self._stats.add("coalesced_frames")
+            self._stats.add("coalesced_subs", len(chunk))
+        for entry in lanes["big"]:
+            sk, payload, clock, seq = entry
+            msgs.append(("pushpull", sk, payload, clock, self._origin,
+                         seq))
+            groups.append((False, [entry]))
+        if conn.state == "dead":
+            # push half buffers (original seq) for heartbeat replay;
+            # pull half degrades to the last-known value
+            err = ConnectionError(
+                "parameter server %s is dead" % conn.addr)
+            for _, chunk in groups:
+                for entry in chunk:
+                    self._buffer_push(conn, *entry)
+                    out[entry[0]] = self._degraded_value(entry[0], err)
+            return out
+        replies = conn.request_all(msgs, return_exceptions=True)
+        for (is_multi, chunk), reply in zip(groups, replies):
+            if isinstance(reply, ConnectionError):
+                for entry in chunk:
+                    self._buffer_push(conn, *entry)
+                    out[entry[0]] = self._degraded_value(entry[0], reply)
+            elif isinstance(reply, Exception):
+                if _stale_dst(reply) is None:
+                    raise reply
+                for entry in chunk:   # moved key: replay at its new home
+                    out[entry[0]] = self._pushpull_moved(entry, reply)
+            else:
+                subs = reply[1] if is_multi else [reply]
+                for entry, sub in zip(chunk, subs):
+                    sk = entry[0]
+                    if sub[0] == "err":
+                        if _stale_dst(sub[1]) is not None:
+                            out[sk] = self._pushpull_moved(
+                                entry, RuntimeError(
+                                    "parameter server: %s" % sub[1]))
+                        else:
+                            raise RuntimeError(
+                                "parameter server: %s" % sub[1])
+                    else:
+                        out[sk] = self._note_pulled(sk, sub[1], sub[2])
+        return out
+
+    def _pushpull_moved(self, entry, err):
+        """A pushpull refused with ``map_stale``: learn the key's new
+        home and replay there with the ORIGINAL seq — exactly-once
+        apply, fresh value from the key's new owner."""
+        sk, payload, clock, seq = entry
+        self._stats.add("map_reroutes")
+        self._key_overrides[sk] = _stale_dst(err)
+        reply = self._routed_request(sk, "pushpull", sk, payload, clock,
+                                     self._origin, seq)
+        return self._note_pulled(sk, reply[1], reply[2])
+
+    def push_pull_async(self, key, value, out=None, priority=0):
+        """One worker-pool job: push, then (optionally) pull the same
+        keys — the fused Module dist step's per-batch wire op
+        (``module/fused.py``). The push ships this step's gradients;
+        the chained pull lands the server's post-update values directly
+        into ``out`` (the shared device parameter store NDArrays, or
+        merged-gradient buffers), all OFF the training thread so the
+        next step's compute overlaps the wire and the device->host
+        gradient read never blocks dispatch. Returns a Future; failures
+        surface at ``.result()`` (the bounded-inflight window drain)."""
+        def _job():
+            vals = value
+            if isinstance(vals, (list, tuple)) and vals and \
+                    isinstance(vals[0], nd.NDArray):
+                # one batched d2h for the whole step's gradients
+                # instead of a per-key asnumpy dispatch chain
+                vals = jax.device_get([v._data for v in vals])
+            if out is not None:
+                self.push_pull(key, vals, out=out, priority=priority)
+            else:
+                self.push(key, vals, priority)
+
+        return self._pool.submit(_job)
 
     def _buffer_push(self, conn, sk, payload, base_clock, seq):
         with self._pending_lock:
@@ -3172,6 +3409,14 @@ class AsyncDistKVStore(KVStore):
         for got in self._pmap([(lambda c=c, l=l: self._pull_conn(c, l))
                                for c, l in per_conn.items()]):
             results.update(got)
+        self._assemble_pulled(plans, results)
+
+    def _assemble_pulled(self, plans, results):
+        """Reassemble per-part ``results`` into the pull targets and
+        rebind them in ONE batched host->device transfer: a multi-key
+        pull (the fused Module dist step rebinding every parameter per
+        batch) pays one dispatch, not one per key."""
+        assembled = []
         for k, o, plan in plans:
             pieces = []
             for sk, _, _ in plan:
@@ -3186,9 +3431,17 @@ class AsyncDistKVStore(KVStore):
                 full = _np.empty(self._shapes[k], dtype=pieces[0].dtype)
                 for (sk, lo, hi), piece in zip(plan, pieces):
                     full[lo:hi] = piece
-            arr = nd.array(full)
+            if full.dtype == _np.float64:    # nd.array's canonical rule
+                full = full.astype(_np.float32)
+            elif full.dtype == _np.int64:
+                full = full.astype(_np.int32)
+            assembled.append((o, full))
+        if not assembled:
+            return
+        devs = jax.device_put([full for _, full in assembled])
+        for (o, _full), dev in zip(assembled, devs):
             for tgt in (o if isinstance(o, (list, tuple)) else [o]):
-                tgt._data = arr._data
+                tgt._data = dev
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows from the server table (reference
@@ -3279,6 +3532,34 @@ class AsyncDistKVStore(KVStore):
         # The reference ignores set_updater for dist stores (updater_ is
         # only consulted server-side); match that.
         self._updater = None
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        """Optimizer states live SERVER-side in dist mode: fetch every
+        shard's updater slots (disjoint — each shard only materializes
+        its own keys) and write the merged dict in the standard
+        ``Updater`` serialization, so ``Module.save_optimizer_states``
+        round-trips through the server on the fused dist path."""
+        merged = {}
+        for c in self._conns:
+            reply = c.request("opt_states")
+            states = pickle.loads(reply[1])
+            if isinstance(states, tuple) and len(states) == 2:
+                states = states[0]
+            merged.update(states)
+        payload = pickle.dumps(
+            (merged, self._optimizer) if dump_optimizer else merged,
+            protocol=pickle.HIGHEST_PROTOCOL)
+        with open(fname, "wb") as fout:
+            fout.write(payload)
+
+    def load_optimizer_states(self, fname):
+        """Broadcast saved updater states to every shard (each uses
+        only its own keys' slots; replicated pairs forward on the
+        stream like set_optimizer)."""
+        with open(fname, "rb") as fin:
+            payload = fin.read()
+        for c in self._conns:
+            c.request("set_opt_states", payload)
 
     # -- coordination -----------------------------------------------------
     def barrier(self):

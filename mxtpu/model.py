@@ -57,6 +57,13 @@ def _create_kvstore(kvstore, num_device, arg_params):
         raise TypeError("kvstore must be KVStore, str or None")
     if kv is None:
         update_on_kvstore = False
+    elif os.environ.get("MXTPU_UPDATE_ON_KVSTORE", "1").strip().lower() \
+            in ("0", "false", "off"):
+        # the reference's MXNET_UPDATE_ON_KVSTORE escape: the store only
+        # merges gradients (push + pull), the worker applies the
+        # optimizer locally — Module's fused dist path renders this as
+        # the grad-emitting program + donated local apply
+        update_on_kvstore = False
     return (kv, update_on_kvstore)
 
 

@@ -27,15 +27,33 @@ bucket keeps its own compiled program per batch signature, and a bucket
 switch is a program-cache hit — no host-side parameter propagation, no
 re-dispatch.
 
+Distributed mode (ISSUE 10): a kvstore-managed Module no longer falls
+back to eager — it is a FAST path. The donated program switches to the
+grad-EMITTING form (``Executor.make_fused_grad_step``: forward +
+backward + device-metric accumulation, returning gradients), and the
+update rides the kvstore per its mode: ``update_on_kvstore`` pushes the
+gradients and pulls the server-updated weights straight back into the
+shared device parameter store (so bucket switches keep working), while
+the locally-applied mode pushes, pulls the merged gradients, and runs
+them through a donated multi-tensor apply program
+(``make_fused_apply_step``). ``MXTPU_MODULE_DIST_MODE=async`` pipelines
+the push+pull on the store's worker pool under the PR-2 bounded-inflight
+window (``mxtpu/dist_hooks.py``, ``MXTPU_MODULE_PUSH_INFLIGHT``) so the
+next step's compute overlaps the wire; the default ``sync`` mode ships
+inline and matches the eager dist path bit-for-bit.
+``MXTPU_MODULE_FUSED_DIST=0`` confines fusion to the local path.
+
 Escape hatch: anything the one-program contract can't honor — a
 ``Monitor`` install (wants per-node outputs), a custom Python updater,
-sparse parameters, multi-context groups, kvstore-managed updates — falls
-back to the eager path (warning once for monitor / custom updaters).
-``MXTPU_MODULE_FUSED=0`` disables the whole mechanism
-(``docs/env_vars.md``).
+sparse parameters, multi-context groups, ``inputs_need_grad`` — falls
+back to the eager path (warning once for monitor / custom updaters;
+every silent fallback logs its reason once at debug level, see
+``_fused_eligible``). ``MXTPU_MODULE_FUSED=0`` disables the whole
+mechanism (``docs/env_vars.md``).
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import warnings
@@ -44,13 +62,16 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from .. import ndarray as nd
 from .. import optimizer as opt_mod
+from ..dist_hooks import AsyncPushWindow, push_inflight
 from ..model import _module_fused_enabled
 from ..ndarray import NDArray, _wrap
 from ..optimizer import state_to_tree
 
 __all__ = ["ProgramCache", "FusedGroupState", "FusedModuleTrainer",
-           "maybe_create", "attach_borrowed", "metric_readback_interval"]
+           "maybe_create", "attach_borrowed", "metric_readback_interval",
+           "_fused_eligible"]
 
 
 class ProgramCache:
@@ -113,6 +134,21 @@ def metric_readback_interval():
         return 0
 
 
+def _fused_dist_enabled():
+    """MXTPU_MODULE_FUSED_DIST: default on; ``0`` keeps kvstore-managed
+    modules on the eager push/pull loop (the pre-ISSUE-10 behavior)."""
+    return os.environ.get("MXTPU_MODULE_FUSED_DIST", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def dist_mode():
+    """MXTPU_MODULE_DIST_MODE: ``sync`` (default — push+pull inline,
+    bit-for-bit with the eager dist path) or ``async`` (pipelined on the
+    store's worker pool under the bounded-inflight window)."""
+    mode = os.environ.get("MXTPU_MODULE_DIST_MODE", "sync").strip().lower()
+    return "async" if mode == "async" else "sync"
+
+
 class FusedGroupState:
     """State shared by every module driving one optimizer (the
     ``borrow_optimizer`` group — a BucketingModule's buckets): the
@@ -140,6 +176,23 @@ class FusedGroupState:
         self.warned_fallback = False
         self.stats = {"steps": 0, "compiles": 0, "cache_hits": 0,
                       "metric_drains": 0}
+        # dist modes (attach_kvstore): the store, the sync/async policy
+        # and the ONE shared push window across the group's buckets
+        self.kv = None
+        self.dist_mode = None
+        self.window = None
+
+    def attach_kvstore(self, kv):
+        """Wire the group to its kvstore (dist modes): the shared async
+        push/pull window (one per optimizer group — buckets share it)
+        plus the ``kv.stats()['module_fused_dist']`` counter source the
+        ``ci/check_module_perf.py --dist`` bounded-inflight contract
+        reads."""
+        self.kv = kv
+        self.dist_mode = dist_mode()
+        self.window = AsyncPushWindow(push_inflight())
+        if hasattr(kv, "add_stats_source"):
+            kv.add_stats_source("module_fused_dist", self.window.stats)
 
     # -- donated device scalars -------------------------------------------
     def device_state(self):
@@ -203,11 +256,24 @@ class FusedGroupState:
 
 
 class FusedModuleTrainer:
-    """Per-Module driver of the fused train step over its executor."""
+    """Per-Module driver of the fused train step over its executor.
 
-    def __init__(self, module, group):
+    ``mode`` selects which one-program contract drives the step:
+
+    * ``"local"`` — PR-5: forward+backward+optimizer (+metric) in one
+      donated program, ``update()`` is an acknowledgement;
+    * ``"dist"`` — kvstore-managed (``update_on_kvstore``): the program
+      emits gradients, ``update()`` pushes them and pulls the
+      server-updated weights back into the shared device store;
+    * ``"dist_local"`` — kvstore-merged gradients with a local
+      optimizer: push, pull the merged gradients, then one donated
+      multi-tensor apply program.
+    """
+
+    def __init__(self, module, group, mode="local"):
         self._module = module
         self._group = group
+        self._mode = mode
         exec_group = module._exec_group
         exec_ = exec_group.execs[0]
         # updater slot i = position in the executor group's param list
@@ -224,6 +290,14 @@ class FusedModuleTrainer:
         self._cache = ProgramCache()
         self._last_fused = False
         self._last_metric_applied = False
+        # dist modes: this step's emitted gradients, awaiting update()
+        self._pending_grads = None
+        # dist_local: reusable zero buffer backing the pull targets
+        self._grad_zeros = None
+
+    @property
+    def mode(self):
+        return self._mode
 
     # -- group plumbing ----------------------------------------------------
     def seed_store(self):
@@ -260,6 +334,8 @@ class FusedModuleTrainer:
     # -- fallback ----------------------------------------------------------
     def _disable(self, reason):
         fs = self._group
+        self.flush()
+        self._pending_grads = None
         if not fs.warned_fallback:
             warnings.warn(
                 "Module fused train step disabled: %s — falling back to "
@@ -268,6 +344,14 @@ class FusedModuleTrainer:
             fs.warned_fallback = True
         fs.detach_metric()
         self._module._fused = None
+
+    def flush(self):
+        """Drain the async push/pull window (dist modes; no-op on the
+        local path) — every emitted gradient has landed and every
+        pulled value is rebound when this returns."""
+        fs = self._group
+        if fs.window is not None:
+            fs.window.flush()
 
     # -- metric routing ----------------------------------------------------
     def note_eager_forward(self):
@@ -347,9 +431,11 @@ class FusedModuleTrainer:
         return tuple(fix(t) for t in state_trees)
 
     def step(self, data_batch):
-        """Run one fused forward+backward+update[+metric] step. Returns
-        False (after disabling, where appropriate) when the batch must
-        take the eager path instead."""
+        """Run one fused forward+backward[+update][+metric] step.
+        Returns False (after disabling, where appropriate) when the
+        batch must take the eager path instead. In the dist modes the
+        step emits gradients and stashes them for :meth:`finish_update`
+        (driven by ``Module.update()``)."""
         mod = self._module
         fs = self._group
         if isinstance(data_batch, list):
@@ -360,7 +446,12 @@ class FusedModuleTrainer:
             self._disable("a Monitor is installed (per-node outputs need "
                           "the eager executor)")
             return False
-        if not isinstance(mod._updater, opt_mod.Updater) or \
+        if self._mode == "dist":
+            if mod._updater is not None:
+                self._disable("a custom updater replaced the "
+                              "kvstore-managed update")
+                return False
+        elif not isinstance(mod._updater, opt_mod.Updater) or \
                 mod._updater is not fs.updater:
             self._disable("a custom updater replaced the shared "
                           "optimizer Updater")
@@ -372,6 +463,9 @@ class FusedModuleTrainer:
             mod.reshape(*mod._shapes_for_batch(data_batch, new_shapes))
             exec_group = mod._exec_group
             exec_ = exec_group.execs[0]
+
+        if self._mode != "local":
+            return self._dist_step(data_batch, exec_group, exec_)
 
         key = (self._shape_sig(data_batch.data),
                self._shape_sig(data_batch.label), fs.metric_key)
@@ -433,40 +527,224 @@ class FusedModuleTrainer:
         self._last_metric_applied = fs.metric_fn is not None
         return True
 
+    # -- the dist step -----------------------------------------------------
+    def _dist_step(self, data_batch, exec_group, exec_):
+        """Grad-emitting step of the kvstore modes: ONE donated program
+        runs forward+backward(+metric) and returns the gradients; they
+        are stashed for :meth:`finish_update` (``Module.update()``)."""
+        fs = self._group
+        key = ("grad", self._shape_sig(data_batch.data),
+               self._shape_sig(data_batch.label), fs.metric_key)
+        metric_fn = fs.metric_fn if fs.metric_key is not None else None
+        entry, hit = self._cache.get(
+            key, lambda: exec_.make_fused_grad_step(
+                self._train_names, metric_fn=metric_fn))
+        fs.stats["cache_hits" if hit else "compiles"] += 1
+        fn, other_names = entry
 
-def _statically_eligible(module):
-    """Conditions knowable at init_optimizer/borrow time. Anything here
-    is a NORMAL configuration choice (multi-device groups, kvstore-managed
-    updates, sparse storage) — fall back silently, no warning."""
+        exec_group.load_batch(data_batch)
+        train_vals = tuple(exec_.arg_dict[n]._data
+                           for n in self._train_names)
+        aux_vals = tuple(exec_.aux_dict[n]._data for n in exec_._aux_names)
+        other_vals = tuple(exec_.arg_dict[n]._data for n in other_names)
+        key_dev, _, _ = fs.device_state()
+        if fs.metric_acc is None:
+            fs.metric_acc = fs._zero_acc()
+
+        grads, new_aux, outs, new_key, new_acc = fn(
+            train_vals, aux_vals, other_vals, key_dev, fs.metric_acc)
+
+        # rebind every donated buffer's wrapper (params are NOT donated
+        # here — the kvstore pull rebinds them after the update lands)
+        for n, v in zip(exec_._aux_names, new_aux):
+            exec_.aux_dict[n]._data = v
+        fs.key_dev, fs.metric_acc = new_key, new_acc
+        exec_._outputs = [_wrap(o, exec_._ctx) for o in outs]
+        exec_._cached_grads = None
+        exec_._state_snapshot = None
+        self._pending_grads = grads
+        fs.stats["steps"] += 1
+        self._last_fused = True
+        self._last_metric_applied = fs.metric_fn is not None
+        return True
+
+    def finish_update(self):
+        """Complete a dist step after ``forward_backward``: ship the
+        emitted gradients through the kvstore and land the update.
+
+        * ``dist`` (update_on_kvstore): push gradients, pull the
+          server-updated weights straight into the SHARED device
+          parameter store — every bucket's executor aliases the same
+          NDArray objects, so a bucket switch stays a cache hit.
+        * ``dist_local``: push, pull the merged gradients, run one
+          donated multi-tensor apply program over them.
+
+        Sync mode ships inline (per-key order identical to the eager
+        ``_update_params_on_kvstore`` loop — bit-for-bit parity);
+        async mode dispatches one worker-pool job per step under the
+        bounded-inflight window, so the next step's compute overlaps
+        the wire and the device->host gradient read happens OFF the
+        training thread (the zero-host-sync contract)."""
+        grads = self._pending_grads
+        self._pending_grads = None
+        if self._mode == "local" or grads is None:
+            return
+        fs = self._group
+        kv = fs.kv
+        names = list(self._train_names)
+        if fs.dist_mode == "sync":
+            # one batched d2h for the step's gradients (the async path
+            # does the same inside push_pull_async, off-thread)
+            vals = list(jax.device_get(list(grads)))
+        else:
+            vals = [NDArray(g) for g in grads]
+        if self._mode == "dist":
+            outs = [fs.param_store[n] for n in names]
+            if fs.dist_mode == "sync":
+                kv.push_pull(names, vals, out=outs)
+            else:
+                fs.window.dispatch(
+                    lambda: kv.push_pull_async(names, vals, out=outs))
+            return
+        # dist_local: fresh pull-target WRAPPERS per dispatch (sharing
+        # one zero buffer) so overlapping async windows never write the
+        # same wrapper; the apply runs on the training thread at reap
+        # time (AsyncPushWindow contract), where donation is safe
+        gouts = self._grad_targets()
+        if fs.dist_mode == "sync":
+            kv.push_pull(names, vals, out=gouts)
+            self._apply_pulled(gouts)
+        else:
+            fs.window.dispatch(
+                lambda: kv.push_pull_async(names, vals, out=gouts),
+                on_complete=lambda _res, g=gouts: self._apply_pulled(g))
+
+    def _grad_targets(self):
+        exec_ = self._module._exec_group.execs[0]
+        if self._grad_zeros is None:
+            self._grad_zeros = {
+                n: nd.zeros(exec_.arg_dict[n].shape,
+                            dtype=exec_.arg_dict[n].dtype)
+                for n in self._train_names}
+        return [NDArray(self._grad_zeros[n]._data)
+                for n in self._train_names]
+
+    def _apply_pulled(self, gouts):
+        """dist_local: one donated multi-tensor apply of the pulled
+        (merged) gradients — the optimizer half of the PR-5 program on
+        its own, sharing the Updater state dict slot-for-slot."""
+        fs = self._group
+        exec_ = self._module._exec_group.execs[0]
+        grad_vals = tuple(g._data for g in gouts)
+        key = ("apply", tuple((tuple(g.shape), str(g.dtype))
+                              for g in grad_vals))
+        fn, hit = self._cache.get(
+            key, lambda: exec_.make_fused_apply_step(
+                self._train_names, fs.optimizer, self._opt_slots))
+        fs.stats["cache_hits" if hit else "compiles"] += 1
+
+        train_vals = tuple(exec_.arg_dict[n]._data
+                           for n in self._train_names)
+        states_nd = [fs.updater.ensure_state(slot, exec_.arg_dict[name])
+                     for slot, name in zip(self._opt_slots,
+                                           self._train_names)]
+        state_trees = self._dedupe_donated(
+            train_vals, tuple(state_to_tree(s) for s in states_nd))
+        _, t_dev, _ = fs.device_state()
+        if fs.optimizer.num_update > fs.num_update:
+            fs.num_update = int(fs.optimizer.num_update)
+            t_dev = fs.t_dev = jax.device_put(
+                _np.asarray(fs.num_update, _np.int32), fs.ctx.jax_device())
+        fs.num_update += 1
+        lr_dev = fs.refresh_lr()
+
+        new_vals, new_states, new_t = fn(train_vals, state_trees,
+                                         grad_vals, t_dev, lr_dev)
+
+        for n, v in zip(self._train_names, new_vals):
+            exec_.arg_dict[n]._data = v
+        for dst, tree in zip(states_nd, new_states):
+            self._write_state(dst, tree)
+        fs.t_dev = new_t
+        opt = fs.optimizer
+        opt.num_update = fs.num_update
+        for slot in self._opt_slots:
+            opt._index_update_count[slot] = fs.num_update
+
+
+def _fused_eligible(module):
+    """The fused-path eligibility predicate, narrowed by ISSUE 10:
+    kvstore-managed updates are now a FAST path (``dist`` /
+    ``dist_local`` modes), so silent fallback remains only for the
+    still-unsupported set — sparse parameters, multi-context groups,
+    ``inputs_need_grad`` — plus the explicit configuration outs
+    (env kill switches, non-write grad_req, state inputs, custom
+    updaters).
+
+    Returns ``(mode, reason)``: ``mode`` is ``'local'`` (in-program
+    optimizer), ``'dist'`` (server-side update via the kvstore),
+    ``'dist_local'`` (kvstore-merged gradients + fused local apply) or
+    ``None`` with the human-readable fallback reason — logged once at
+    debug level so fallbacks are diagnosable instead of silent."""
     if not _module_fused_enabled():
-        return False
+        return None, "MXTPU_MODULE_FUSED=0"
     if len(module._context) != 1 or len(module._exec_group.execs) != 1:
-        return False
-    if module._kvstore is not None or module._update_on_kvstore:
-        return False
-    if not isinstance(module._updater, opt_mod.Updater):
-        return False
-    if not module.for_training or module.inputs_need_grad:
-        return False
+        return None, "multi-context executor group"
+    if not module.for_training:
+        return None, "bound for inference (for_training=False)"
+    if module.inputs_need_grad:
+        return None, "inputs_need_grad (callers read input gradients)"
     if module._state_names:
-        return False
+        return None, "explicit state inputs (state_names)"
     if module._grad_req != "write":
-        return False
+        return None, "grad_req=%r (fused step assumes 'write')" \
+            % (module._grad_req,)
     exec_ = module._exec_group.execs[0]
     for arr in list(exec_.arg_dict.values()) + list(exec_.grad_dict.values()):
         if hasattr(arr, "_aux"):   # sparse storage: lazy-update path
-            return False
-    return True
+            return None, "sparse parameters (lazy-update path)"
+    if module._kvstore is not None:
+        if not _fused_dist_enabled():
+            return None, "MXTPU_MODULE_FUSED_DIST=0"
+        if not hasattr(module._kvstore, "push_async"):
+            return None, "kvstore %r has no async push path" \
+                % (getattr(module._kvstore, "type",
+                           type(module._kvstore).__name__),)
+        if module._update_on_kvstore:
+            return "dist", None
+        if not isinstance(module._updater, opt_mod.Updater):
+            return None, "custom updater"
+        return "dist_local", None
+    if not isinstance(module._updater, opt_mod.Updater):
+        return None, "custom updater"
+    return "local", None
+
+
+def _log_fallback(module, reason):
+    """One-shot debug log naming why the fused path disengaged (the
+    diagnosable half of the silent-fallback contract)."""
+    if getattr(module, "_fused_fallback_logged", None) == reason:
+        return
+    module._fused_fallback_logged = reason
+    logger = getattr(module, "logger", None) or logging
+    logger.debug(
+        "Module fused train step not engaged: %s — eager path "
+        "(eligibility matrix: docs/perf_analysis.md "
+        "'Distributed Module fast path')", reason)
 
 
 def maybe_create(module):
     """Called at the end of ``Module.init_optimizer``: build the fused
     trainer (and become the group's store owner) when eligible."""
-    if not _statically_eligible(module):
+    mode, reason = _fused_eligible(module)
+    if mode is None:
+        _log_fallback(module, reason)
         return None
     group = FusedGroupState(module._optimizer, module._updater,
                             module._context[0])
-    trainer = FusedModuleTrainer(module, group)
+    if mode != "local":
+        group.attach_kvstore(module._kvstore)
+    trainer = FusedModuleTrainer(module, group, mode)
     trainer.seed_store()
     return trainer
 
@@ -476,10 +754,20 @@ def attach_borrowed(module, shared_module):
     group, aliasing this module's executors to the shared device store
     (the BucketingModule bucket-switch fast path)."""
     lender = getattr(shared_module, "_fused", None)
-    if lender is None or not _statically_eligible(module):
+    if lender is None:
+        _log_fallback(module, "shared optimizer owner runs eager")
         return None
-    trainer = FusedModuleTrainer(module, lender._group)
+    mode, reason = _fused_eligible(module)
+    if mode is None:
+        _log_fallback(module, reason)
+        return None
+    if mode != lender.mode:
+        _log_fallback(module, "kvstore mode differs from the lender")
+        return None
+    trainer = FusedModuleTrainer(module, lender._group, mode)
     if not trainer.store_compatible():
+        _log_fallback(module, "parameter shape/dtype mismatch across "
+                              "buckets")
         return None
     trainer.adopt_store()
     return trainer

@@ -193,6 +193,28 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    # -- numpy host path (dist_async server per-push apply) ----------------
+    # Subclasses with a pure-numpy mirror of their update kernel set
+    # host_update = True and implement create_state_host/update_host:
+    # the parameter-server updater then applies each push without the
+    # per-key NDArray round-trip (h2d, a chain of eager jax dispatches,
+    # d2h) that dominated the dist Module hot loop — the same
+    # host-mirror trick GradientCompression uses for its quantizer.
+    host_update = False
+
+    def create_state_host(self, index, weight):
+        """Numpy state slot(s) for :meth:`update_host` (weight is a
+        numpy array)."""
+        return None
+
+    def update_host(self, index, weight, grad, state):
+        """One numpy update: read ``weight``, mutate ``state`` in
+        place, return the NEW weight array (never write ``weight`` —
+        the server table value may be aliased by zero-copy local
+        pulls), or None to route to the device path. Must mirror the
+        device kernel's arithmetic exactly (same operation order)."""
+        return None
+
     def _uses_master_weights(self, weight):
         return self.multi_precision and weight.dtype == _np.float16
 
@@ -399,6 +421,30 @@ class SGD(Optimizer):
         if self.momentum == 0.0:
             return None
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    host_update = True
+
+    def create_state_host(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _np.zeros_like(weight)
+
+    def update_host(self, index, weight, grad, state):
+        # numpy mirror of sgd[_mom]_update (ops/optim_ops.py): same
+        # _rescale_clip -> momentum -> apply operation order. wd == 0
+        # skips its term (identical bits for finite weights; TrainGuard
+        # keeps non-finite values out of the table)
+        lr, wd = self._begin_update(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient >= 0:
+            _np.clip(g, -self.clip_gradient, self.clip_gradient, out=g)
+        if wd != 0.0:
+            g = g + wd * weight
+        if state is not None:
+            state *= self.momentum
+            state -= lr * g
+            return weight + state
+        return weight - lr * g
 
     def update(self, index, weight, grad, state):
         if _is_rsp(grad) and self.lazy_update:
@@ -647,6 +693,30 @@ class Adam(Optimizer):
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
                 nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    host_update = True
+
+    def create_state_host(self, index, weight):
+        return (_np.zeros_like(weight), _np.zeros_like(weight))
+
+    def update_host(self, index, weight, grad, state):
+        # numpy mirror of adam_update with the same bias-corrected lr
+        lr, wd = self._begin_update(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * float(_np.sqrt(coef2)) / coef1
+        mean, var = state
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient >= 0:
+            _np.clip(g, -self.clip_gradient, self.clip_gradient, out=g)
+        if wd != 0.0:
+            g = g + wd * weight
+        mean *= self.beta1
+        mean += (1.0 - self.beta1) * g
+        var *= self.beta2
+        var += (1.0 - self.beta2) * _np.square(g)
+        return weight - lr * mean / (_np.sqrt(var) + self.epsilon)
 
     def update(self, index, weight, grad, state):
         if _is_rsp(grad) and self.lazy_update:
@@ -898,6 +968,41 @@ class Updater:
     def __call__(self, index, grad, weight):
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.ensure_state(index, weight))
+
+    @staticmethod
+    def _state_to_host(state):
+        """State slot -> writable numpy, same structure (a device-path
+        or restored-snapshot slot converts once; numpy slots pass
+        through)."""
+        if state is None:
+            return None
+        if isinstance(state, NDArray):
+            return _np.array(state.asnumpy(), copy=True)
+        if isinstance(state, (tuple, list)):
+            return type(state)(Updater._state_to_host(s) for s in state)
+        if isinstance(state, _np.ndarray) and not state.flags.writeable:
+            return state.copy()
+        return state
+
+    def update_host(self, index, weight, grad):
+        """Numpy host-path apply (the dist_async server's per-push fast
+        path): returns the NEW weight array, or None when the optimizer
+        has no host mirror (the caller then takes the NDArray path).
+        ``weight`` — the server's table value — is never mutated: pulls
+        over the local transport may alias it, so the update lands on a
+        private copy. State slots live (and mutate) as numpy."""
+        opt = self.optimizer
+        if not getattr(opt, "host_update", False) or opt.multi_precision:
+            return None
+        if index not in self.states:
+            self.states[index] = opt.create_state_host(index, weight)
+            self.states_synced[index] = True
+        elif not isinstance(self.states[index], _np.ndarray) or \
+                not self.states[index].flags.writeable:
+            self.states[index] = self._state_to_host(self.states[index])
+            self.states_synced[index] = True
+        return opt.update_host(index, weight, _np.asarray(grad),
+                               self.states[index])
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):

@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import copy
 import os
-from collections import deque as _deque
 
 import numpy as _np
 
@@ -39,6 +38,7 @@ from jax.sharding import PartitionSpec
 from .. import autograd as _ag
 from .. import base as _base
 from .. import ndarray as nd
+from ..dist_hooks import AsyncPushWindow, kvstore_grad_pusher
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
 # the functional (jit-traceable) optimizer adapter lives next to the
@@ -216,11 +216,11 @@ class ShardedTrainer:
         # async gradient-push hook (set_grad_push/attach_kvstore): when
         # set, every jitted step also returns its gradients and the hook
         # ships them off-thread — the NEXT step's compute overlaps the
-        # previous step's KVStore push. _push_inflight is the
-        # backpressure window of outstanding push futures.
+        # previous step's KVStore push. The bounded-inflight
+        # backpressure window is the shared dist_hooks implementation
+        # (the same one the fused Module dist step rides).
         self._grad_push = None
-        self._push_max = 2
-        self._push_inflight = _deque()
+        self._push_window = AsyncPushWindow(2)
         # on-device step state, materialized at first step_async
         self._key_dev = None
         self._t_dev = None
@@ -630,7 +630,7 @@ class ShardedTrainer:
         self.flush_grad_pushes()
         self._grad_push = push_fn
         self._deferred_grads = None
-        self._push_max = max(1, int(max_inflight))
+        self._push_window = AsyncPushWindow(max_inflight)
         # cached train fns were built without the grads output
         self._step_fns = {k: v for k, v in self._step_fns.items()
                           if k[0] != "train"}
@@ -640,19 +640,14 @@ class ShardedTrainer:
         gradients ship via ``kv.push_async`` on the store's worker pool
         — compute overlaps the wire end-to-end, small parameters ride
         the store's coalesced frames. Keys (parameter names) are lazily
-        ``kv.init``-ed with zeros on first push."""
-        inited = set()
-
-        def _push(grads):
-            new = [n for n in grads if n not in inited]
-            if new:
-                kv.init(new, [NDArray(jnp.zeros_like(grads[n]._data))
-                              for n in new])
-                inited.update(new)
-            keys = list(grads)
-            return kv.push_async(keys, [grads[k] for k in keys])
-
-        self.set_grad_push(_push, max_inflight=max_inflight)
+        ``kv.init``-ed with zeros on first push (the shared
+        ``dist_hooks.kvstore_grad_pusher`` hook). The window's counters
+        publish into ``kv.stats()['grad_push_window']``."""
+        self.set_grad_push(kvstore_grad_pusher(kv),
+                           max_inflight=max_inflight)
+        if hasattr(kv, "add_stats_source"):
+            kv.add_stats_source("grad_push_window",
+                                lambda: self._push_window.stats())
 
     # -- guard hooks (mxtpu.resilience.TrainGuard) -------------------------
     def set_guard(self, enabled):
@@ -790,20 +785,15 @@ class ShardedTrainer:
 
     def _dispatch_grad_push(self, grads):
         names = [self._params[i].name for i in self._train_idx]
-        # drain to under the window BEFORE shipping: a slow sink blocks
-        # here (backpressure), never accumulates unbounded futures
-        while len(self._push_inflight) >= self._push_max:
-            self._push_inflight.popleft().result()
-        fut = self._grad_push(
-            {n: NDArray(g) for n, g in zip(names, grads)})
-        if fut is not None and hasattr(fut, "result"):
-            self._push_inflight.append(fut)
+        # the window drains to under its bound BEFORE shipping: a slow
+        # sink blocks there (backpressure), never accumulates futures
+        payload = {n: NDArray(g) for n, g in zip(names, grads)}
+        self._push_window.dispatch(lambda: self._grad_push(payload))
 
     def flush_grad_pushes(self):
         """Block until every outstanding gradient push has landed,
         surfacing the first failure."""
-        while self._push_inflight:
-            self._push_inflight.popleft().result()
+        self._push_window.flush()
 
     def _host_lr(self):
         o = self._optimizer

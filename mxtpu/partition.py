@@ -1,0 +1,87 @@
+"""One regex-rule partition spec, three layouts (ISSUE 10).
+
+Before this module the system kept THREE independent parameter layouts:
+``ShardedTrainer`` placed parameters on the mesh through
+:class:`~mxtpu.parallel.mesh.ShardingRules`, the dist_async KVStore
+assigned keys to servers by ``crc32(key) % n``, and
+:class:`~mxtpu.checkpoint.CheckpointManager` wrote every parameter into
+one monolithic blob. A layer that is model-parallel on the mesh could
+land scattered across PS shards and interleaved in the checkpoint — the
+three views of "where does this parameter live" never had to agree.
+
+:class:`PartitionRules` extends ``ShardingRules`` (ordered
+``regex -> PartitionSpec`` rules, first match wins — the
+``match_partition_rules`` pattern) so ONE rule list drives all three:
+
+* **mesh placement** — inherited ``sharding_for``: ``ShardedTrainer``
+  already takes a ``rules=`` object, so a ``PartitionRules`` drops in
+  unchanged (ZeRO-1 state shards derive from the same specs);
+* **KVStore key shards** — :meth:`shard_for`: every key a rule matches
+  co-locates on ``crc32(rule pattern) % num_servers`` (all parts of a
+  big array included), so a rule group is one server's working set;
+  unmatched keys keep the legacy per-key crc32 spread
+  (``AsyncDistKVStore.set_partition_rules``);
+* **checkpoint layout** — :meth:`layout`: one params blob per rule
+  group (``CheckpointManager.save(..., layout=rules)``), so a shard's
+  keys restore from a shard's file.
+
+``tests/test_partition.py::test_layout_agreement`` pins the contract:
+two names in one rule group agree on all three layouts.
+"""
+from __future__ import annotations
+
+import zlib
+
+from .parallel.mesh import ShardingRules
+
+__all__ = ["PartitionRules", "PART_SEP"]
+
+# big arrays split into row parts "key\x00i" (kvstore_async._plan);
+# layout decisions are made on the base key so every part of one
+# parameter stays in its parameter's group
+PART_SEP = "\x00"
+
+
+class PartitionRules(ShardingRules):
+    """Ordered (regex, PartitionSpec) rules naming parameter groups.
+
+    The matched rule's *pattern string* is the group id: stable across
+    processes (unlike salted ``hash()``), human-readable in layouts, and
+    identical for every worker that was handed the same rule list.
+    """
+
+    def group_for(self, name):
+        """The pattern of the first rule matching ``name`` (part
+        subkeys match through their base key), or None when no rule
+        matches — callers fall back to their legacy layout."""
+        base = str(name).split(PART_SEP, 1)[0]
+        for pat, _spec in self.rules:
+            if pat.match(base):
+                return pat.pattern
+        return None
+
+    def shard_for(self, name, num_shards):
+        """Deterministic group -> shard assignment: every key of one
+        rule group lands on the same server. None when no rule matches
+        (caller keeps its per-key hash)."""
+        group = self.group_for(name)
+        if group is None:
+            return None
+        return zlib.crc32(group.encode("utf-8")) % max(1, int(num_shards))
+
+    def group_tag(self, group):
+        """Filesystem-safe stable id for a group (regex patterns are
+        not path-safe): the crc32 of the pattern, hex."""
+        return "%08x" % zlib.crc32(group.encode("utf-8"))
+
+    def layout(self, names):
+        """Checkpoint layout: ``{group_tag: [names...]}`` with every
+        unmatched name collected under the ``""`` (default) group —
+        one blob per rule group plus one for the remainder. Order of
+        names is preserved within each group."""
+        groups = {}
+        for n in names:
+            g = self.group_for(n)
+            tag = self.group_tag(g) if g is not None else ""
+            groups.setdefault(tag, []).append(n)
+        return groups

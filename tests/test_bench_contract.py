@@ -92,6 +92,34 @@ def test_module_bench_contract():
         assert row["fused_img_s"] > 0 and row["eager_img_s"] > 0
 
 
+def test_module_bench_dist_contract():
+    """tools/bench_module.py --dist: exactly one JSON line, rc 0, with
+    the eager vs fused-sync vs fused-async loopback-PS fields the
+    distributed perf trajectory (docs/perf_analysis.md "Distributed
+    Module fast path") is tracked by — tiny model, CPU-only."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_BENCH_TINY="1",
+               MXTPU_PS_HEARTBEAT="0", PYTHONPATH=_ROOT)
+    for k in ("MXTPU_MODULE_FUSED", "MXTPU_MODULE_FUSED_DIST",
+              "MXTPU_MODULE_DIST_MODE"):
+        env.pop(k, None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_module.py"),
+         "--dist", "--batches", "3", "--warmup", "2", "--no-write"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, "must print exactly ONE JSON line"
+    payload = json.loads(lines[0])
+    assert payload["bench"] == "module_fit_dist"
+    assert payload["tiny"] is True
+    row = payload["models"]["mlp"]
+    for field in ("batch_size", "eager_img_s", "fused_sync_img_s",
+                  "fused_async_img_s", "speedup_sync", "speedup_async"):
+        assert isinstance(row[field], (int, float)), field
+    assert row["eager_img_s"] > 0 and row["fused_sync_img_s"] > 0 \
+        and row["fused_async_img_s"] > 0
+
+
 def test_kvstore_bench_contract(tmp_path):
     """tools/bench_kvstore.py: exactly one JSON line, rc 0, with the
     fields the perf trajectory (docs/perf_analysis.md "Comms fast

@@ -1232,3 +1232,99 @@ def test_serving_kill_replica_mid_batch(monkeypatch):
     finally:
         s2.stop()
         s1.stop()
+
+
+# ---------------------------------------------------------------------------
+# fused Module dist path (ISSUE 10): faults mid-grad-push-window
+# ---------------------------------------------------------------------------
+
+def _fused_dist_module(monkeypatch, kv, batches=4):
+    """A Module on the fused dist fast path (async window) driven for
+    ``batches`` fit-loop steps against ``kv``. Returns (module, number
+    of trainable params)."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    monkeypatch.setenv("MXTPU_MODULE_FUSED_DIST", "1")
+    monkeypatch.setenv("MXTPU_MODULE_DIST_MODE", "async")
+    rng = np.random.RandomState(3)
+    x = rng.rand(64, 8).astype("f")
+    y = (rng.rand(64) * 4).astype("f")
+    it = mx.io.NDArrayIter(x, y, batch_size=16,
+                           label_name="softmax_label")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                              name="ffd"), name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is not None and mod._fused.mode == "dist"
+    pool = list(it)
+    for i in range(batches):
+        b = pool[i % len(pool)]
+        mod.forward_backward(b)
+        mod.update()
+    mod._fused.flush()
+    return mod, 2
+
+
+def test_fused_dist_sever_mid_grad_push_window(monkeypatch):
+    """Sever the connection after the server applied a fused-step
+    pushpull but before its ack (the grad-push window is in flight):
+    the window fails onto the retry layer, the replay of the applied
+    sub-pushes is REFUSED by seq dedupe while still answering with the
+    current value — each step's gradient lands exactly once and
+    training completes."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        with fault.inject(
+                "kind=sever,point=server.send,op=multi,nth=2") as inj:
+            mod, n_params = _fused_dist_module(monkeypatch, kv,
+                                               batches=4)
+        assert inj.stats()[0][4] == 1, "the sever never fired"
+        # exactly-once: every key's clock counts each step's push once
+        for k, c in srv._clock.items():
+            assert c == 4, (k, c)
+        assert srv._dup_n >= 1          # the applied batch replayed
+        s = kv.stats()
+        assert s["retransmits"] >= 1    # window replay happened
+        assert s["dup_pushes"] >= 1     # ...and was deduped
+        args, _ = mod.get_params()
+        for v in args.values():
+            assert np.isfinite(v.asnumpy()).all()
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_fused_dist_kill_primary_mid_grad_push_window(monkeypatch):
+    """SIGKILL the primary inside a fused-step pushpull frame after a
+    prefix of the step's sub-pushes applied (and sync-replicated): the
+    client fails over IN PLACE, replays the whole window on the
+    promoted backup, whose transferred dedupe seqs refuse the prefix —
+    every gradient exactly once, zero acknowledged loss, and the fused
+    path keeps training through the failover."""
+    pri, bak, kv = _pair(monkeypatch)
+    try:
+        # 2 sub-pushes per step frame: nth=6 lands on the SECOND sub of
+        # the third step, so the frame dies with a one-sub applied (and
+        # sync-replicated) prefix for the replay to be refused on
+        with fault.inject(
+                "kind=kill,point=server.recv,op=pushpull,nth=6") as inj:
+            mod, n_params = _fused_dist_module(monkeypatch, kv,
+                                               batches=4)
+        assert inj.stats()[0][4] == 1, "the kill never fired"
+        assert bak._role == "primary"
+        for k, c in bak._clock.items():
+            assert c == 4, (k, c)
+        assert bak._dup_n >= 1, "the replayed prefix must be refused"
+        assert kv.stats()["failovers"] == 1
+        assert mod._fused is not None and mod._fused.mode == "dist"
+        args, _ = mod.get_params()
+        for v in args.values():
+            assert np.isfinite(v.asnumpy()).all()
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()

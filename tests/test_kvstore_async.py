@@ -423,3 +423,68 @@ def test_realistic_volume_straggler():
         for kv in stores:
             kv.close()
         server.stop()
+
+
+def test_push_pull_one_round_trip():
+    """kv.push_pull (the fused pushpull wire op, ISSUE 10): apply +
+    read-back in one request — accumulate server: the returned value
+    is the post-apply table, the clock advances exactly once."""
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.init("w", mx.nd.ones((3,)))
+        out = mx.nd.zeros((3,))
+        kv.push_pull("w", mx.nd.ones((3,)), out=out)
+        np.testing.assert_allclose(out.asnumpy(), 2 * np.ones(3))
+        kv.push_pull("w", mx.nd.ones((3,)) * 3, out=out)
+        np.testing.assert_allclose(out.asnumpy(), 5 * np.ones(3))
+        srv = kv._own_server
+        assert srv._clock["w"] == 2
+    finally:
+        kv.close()
+
+
+def test_push_pull_big_array_parts():
+    """push_pull splits big arrays into the same row parts as
+    push/pull and reassembles the returned post-update value exactly."""
+    from mxtpu import kvstore_async as ka
+    old = ka._BIGARRAY_BOUND
+    ka._BIGARRAY_BOUND = 1000
+    try:
+        kv = mx.kv.create("dist_async")
+        r = np.random.RandomState(1)
+        w = r.rand(40, 100).astype("f")
+        g = r.rand(40, 100).astype("f")
+        kv.init("big", mx.nd.array(w))
+        assert len(kv._parts["big"]) == 4
+        out = mx.nd.zeros(w.shape)
+        kv.push_pull("big", mx.nd.array(g), out=out)
+        np.testing.assert_allclose(out.asnumpy(), w + g, rtol=1e-6)
+        kv.close()
+    finally:
+        ka._BIGARRAY_BOUND = old
+
+
+def test_push_pull_server_side_optimizer():
+    """With a server-side updater, push_pull returns the POST-UPDATE
+    weights (what the fused Module dist step rebinds its parameter
+    store with) — matching a separate push-then-pull bit-for-bit."""
+    from mxtpu import optimizer as opt
+    kv = mx.kv.create("dist_async")
+    kv2 = mx.kv.create("dist_async")
+    try:
+        for k in (kv, kv2):
+            k.set_optimizer(opt.SGD(learning_rate=0.5, momentum=0.9,
+                                    rescale_grad=1.0))
+        w0 = np.arange(6, dtype="f").reshape(2, 3)
+        g = np.ones((2, 3), "f")
+        kv.init("w", mx.nd.array(w0))
+        kv2.init("w", mx.nd.array(w0))
+        a, b = mx.nd.zeros((2, 3)), mx.nd.zeros((2, 3))
+        for _ in range(3):
+            kv.push_pull("w", mx.nd.array(g), out=a)
+            kv2.push("w", mx.nd.array(g))
+            kv2.pull("w", out=b)
+            np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    finally:
+        kv.close()
+        kv2.close()

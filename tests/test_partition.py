@@ -1,0 +1,145 @@
+"""One partition spec, three layouts (ISSUE 10, mxtpu/partition.py):
+the SAME PartitionRules object must drive ShardedTrainer mesh
+placement, dist_async KVStore key->server assignment, and the
+CheckpointManager file layout — pinned by the layout-agreement test."""
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.checkpoint import CheckpointManager
+from mxtpu.kvstore_async import ParameterServer
+from mxtpu.parallel import MeshContext, PartitionSpec as P
+from mxtpu.partition import PartitionRules
+
+RULES = [
+    (r".*fc1_.*", P("data", None)),
+    (r".*fc2_.*", P()),
+]
+NAMES = ["net_fc1_weight", "net_fc1_bias", "net_fc2_weight",
+         "net_fc2_bias", "embedding_table"]
+
+
+def test_group_and_shard_assignment():
+    rules = PartitionRules(RULES)
+    # first match wins; groups are the matched rule pattern
+    assert rules.group_for("net_fc1_weight") == r".*fc1_.*"
+    assert rules.group_for("net_fc1_weight") == \
+        rules.group_for("net_fc1_bias")
+    assert rules.group_for("embedding_table") is None
+    # part subkeys route through their base key
+    assert rules.group_for("net_fc1_weight\x000") == r".*fc1_.*"
+    # one group -> one shard, deterministic in num_shards
+    for n in (1, 2, 3, 7):
+        s_w = rules.shard_for("net_fc1_weight", n)
+        assert s_w == rules.shard_for("net_fc1_bias", n)
+        assert s_w == rules.shard_for("net_fc1_weight\x003", n)
+        assert 0 <= s_w < n
+    assert rules.shard_for("embedding_table", 4) is None
+
+
+def test_layout_groups():
+    rules = PartitionRules(RULES)
+    layout = rules.layout(NAMES)
+    tag1 = rules.group_tag(r".*fc1_.*")
+    tag2 = rules.group_tag(r".*fc2_.*")
+    assert layout[tag1] == ["net_fc1_weight", "net_fc1_bias"]
+    assert layout[tag2] == ["net_fc2_weight", "net_fc2_bias"]
+    assert layout[""] == ["embedding_table"]     # unmatched remainder
+
+
+def test_layout_agreement(monkeypatch, tmp_path):
+    """THE contract: two names in one rule group agree on (a) the mesh
+    PartitionSpec the trainer places them with, (b) the kvstore server
+    their keys land on, and (c) the checkpoint blob they restore from
+    — all read off the SAME PartitionRules object."""
+    rules = PartitionRules(RULES)
+    mc = MeshContext(data=2)
+
+    # (a) mesh placement: the ShardingRules half (what ShardedTrainer's
+    # _place consumes via rules.sharding_for)
+    s_w = rules.sharding_for(mc, "net_fc1_weight", (32, 16))
+    s_b = rules.sharding_for(mc, "net_fc1_bias", (32,))
+    assert s_w.spec == P("data", None)
+    assert s_b.spec == P("data")
+
+    # (b) kvstore key shards: two servers, rules installed
+    s1, s2 = ParameterServer().start(), ParameterServer().start()
+    monkeypatch.setenv("MXTPU_PS_ADDRS", s1.address + "," + s2.address)
+    monkeypatch.setenv("MXTPU_PROC_ID", "0")
+    monkeypatch.setenv("MXTPU_NUM_PROCS", "1")
+    monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0")
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.set_partition_rules(rules)
+        for name in NAMES:
+            kv.init(name, mx.nd.ones((4,)))
+            kv.push(name, mx.nd.ones((4,)))
+        servers = [s1, s2]
+        placed = {name: next(i for i, srv in enumerate(servers)
+                             if name in srv._clock)
+                  for name in NAMES}
+        # rule groups co-locate, exactly where shard_for says
+        assert placed["net_fc1_weight"] == placed["net_fc1_bias"] \
+            == rules.shard_for("net_fc1_weight", 2)
+        assert placed["net_fc2_weight"] == placed["net_fc2_bias"] \
+            == rules.shard_for("net_fc2_weight", 2)
+        # unmatched keys keep the legacy per-key crc32 spread
+        import zlib
+        assert placed["embedding_table"] == \
+            zlib.crc32(b"embedding_table") % 2
+        # pulls still roundtrip through the rule-routed shards
+        out = mx.nd.zeros((4,))
+        kv.pull("net_fc1_weight", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 2 * np.ones(4))
+    finally:
+        kv.close()
+        s1.stop()
+        s2.stop()
+
+    # (c) checkpoint layout: one blob per rule group
+    ckpt = CheckpointManager(str(tmp_path), async_save=False,
+                             use_orbax=False)
+    params = {n: mx.nd.ones((4,)) * (i + 1)
+              for i, n in enumerate(NAMES)}
+    ckpt.save(0, params, layout=rules)
+    step_dir = os.path.join(str(tmp_path), "step_0")
+    blobs = sorted(f for f in os.listdir(step_dir)
+                   if f.startswith("params") and f.endswith(".npz"))
+    tag1 = rules.group_tag(r".*fc1_.*")
+    tag2 = rules.group_tag(r".*fc2_.*")
+    assert set(blobs) == {"params.npz", "params-%s.npz" % tag1,
+                          "params-%s.npz" % tag2}
+    with np.load(os.path.join(step_dir, "params-%s.npz" % tag1)) as z:
+        assert set(z.files) == {"net_fc1_weight", "net_fc1_bias"}
+    # restore is layout-agnostic and verifies the merged CRC tags
+    tree = ckpt.restore(0)
+    assert set(tree["params"]) == set(NAMES)
+    for i, n in enumerate(NAMES):
+        np.testing.assert_allclose(tree["params"][n], (i + 1) * np.ones(4))
+
+
+def test_sharded_trainer_accepts_partition_rules():
+    """PartitionRules drops into ShardedTrainer's rules= unchanged:
+    after placement every parameter carries the sharding the shared
+    spec names (the trainer side of the layout agreement)."""
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    from mxtpu.parallel import ShardedTrainer
+
+    net = nn.HybridSequential(prefix="lay_")
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", prefix="fc1_"))
+        net.add(nn.Dense(4, prefix="fc2_"))
+    net.initialize(mx.initializer.Xavier())
+    rules = PartitionRules(RULES)
+    mesh = MeshContext(data=2)
+    st = ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "sgd", {"learning_rate": 0.1}, mesh=mesh,
+                        rules=rules)
+    x = mx.nd.array(np.random.RandomState(0).rand(8, 12))
+    yl = mx.nd.array(np.zeros(8))
+    st.step(x, yl)
+    for p, sh in zip(st._params, st._shardings):
+        assert sh == rules.sharding_for(mesh, p.name, p.shape), p.name

@@ -24,11 +24,21 @@ program, per-parameter Python optimizer dispatches, per-batch
 registration) are excluded; the metric is drained once at the end so the
 async path's deferred work is counted.
 
-Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
-and mirrors it to docs/module_bench.json unless --no-write. CPU-only.
-MXTPU_BENCH_TINY shrinks the models/batch counts for the contract test.
+``--dist`` (ISSUE 10) switches to the loopback-PS fit microbench: the
+same hot loop driven through ``kvstore='dist_async'`` (in-process
+server, local transport), measured three ways — the eager dist path
+(per-param push/pull loop), the fused-dist SYNC mode (one grad-emitting
+program + one coalesced push + one pull per batch, bit-for-bit with
+eager) and the fused-dist ASYNC mode (push+pull pipelined on the
+store's pool under the bounded-inflight window).
 
-Run: JAX_PLATFORMS=cpu python tools/bench_module.py [--batches 100]
+Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
+and mirrors it to docs/module_bench.json unless --no-write (the file
+keeps one line per bench kind: ``module_fit`` and ``module_fit_dist``).
+CPU-only. MXTPU_BENCH_TINY shrinks the models/batch counts for the
+contract test.
+
+Run: JAX_PLATFORMS=cpu python tools/bench_module.py [--dist] [--batches 100]
 """
 from __future__ import annotations
 
@@ -122,6 +132,96 @@ def _steady_state_rate(mx, sym, x, y, batch_size, batches, warmup):
 DEFAULT_BS = {"mlp": 8, "lenet": 2} if TINY else {"mlp": 64, "lenet": 4}
 
 
+def _dist_rate(mx, sym, x, y, batch_size, batches, warmup):
+    """img/sec of the fit() hot loop against an in-process dist_async
+    parameter service, current env (MXTPU_MODULE_FUSED[_DIST] /
+    MXTPU_MODULE_DIST_MODE select the path)."""
+    it = mx.io.NDArrayIter(x, y, batch_size=batch_size,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="dist_async", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.create("acc")
+    pool = list(it)
+
+    def one(batch):
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+    try:
+        for i in range(warmup):
+            one(pool[i % len(pool)])
+        if mod._fused is not None:
+            mod._fused.flush()
+        metric.get()   # mxlint: allow(blocking-call) — drain any device accumulation; a value getter, not a wait
+        metric.reset()
+
+        t0 = time.perf_counter()
+        for i in range(batches):
+            one(pool[i % len(pool)])
+        if mod._fused is not None:
+            mod._fused.flush()   # outstanding async windows count
+        metric.get()   # mxlint: allow(blocking-call) — epoch-end read (value getter), both paths
+        mod._exec_group.execs[0].arg_dict[
+            mod._exec_group.param_names[0]].wait_to_read()
+        dt = time.perf_counter() - t0
+        fused = mod._fused is not None
+    finally:
+        mod._kvstore.close()
+    return batch_size * batches / dt, fused
+
+
+def run_dist(batches, warmup, batch_size=None):
+    """The --dist sweep: eager vs fused-sync vs fused-async, loopback
+    PS, mlp model (the dispatch-bound regime the dist fast path
+    targets)."""
+    import mxtpu as mx
+
+    os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
+    bs = batch_size or DEFAULT_BS["mlp"]
+    n = max(4 * bs, 64)
+    x, y = _data("mlp", n, bs)
+    sym = _mlp(mx)
+    saved = {k: os.environ.get(k) for k in
+             ("MXTPU_MODULE_FUSED", "MXTPU_MODULE_FUSED_DIST",
+              "MXTPU_MODULE_DIST_MODE")}
+    rates = {}
+    try:
+        for name, env in (
+                ("eager", {"MXTPU_MODULE_FUSED": "1",
+                           "MXTPU_MODULE_FUSED_DIST": "0"}),
+                ("fused_sync", {"MXTPU_MODULE_FUSED": "1",
+                                "MXTPU_MODULE_FUSED_DIST": "1",
+                                "MXTPU_MODULE_DIST_MODE": "sync"}),
+                ("fused_async", {"MXTPU_MODULE_FUSED": "1",
+                                 "MXTPU_MODULE_FUSED_DIST": "1",
+                                 "MXTPU_MODULE_DIST_MODE": "async"})):
+            os.environ.update(env)
+            rate, fused = _dist_rate(mx, sym, x, y, bs, batches, warmup)
+            assert fused == (name != "eager"), \
+                "%s path engagement mismatch" % name
+            rates[name] = rate
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    row = {"batch_size": bs,
+           "eager_img_s": round(rates["eager"], 1),
+           "fused_sync_img_s": round(rates["fused_sync"], 1),
+           "fused_async_img_s": round(rates["fused_async"], 1),
+           "speedup_sync": round(rates["fused_sync"] / rates["eager"], 2),
+           "speedup_async": round(rates["fused_async"] / rates["eager"],
+                                  2)}
+    return {"bench": "module_fit_dist", "tiny": TINY,
+            "batches": batches, "warmup": warmup,
+            "host_cores": os.cpu_count(), "models": {"mlp": row}}
+
+
 def run(batches, warmup, batch_size=None):
     import mxtpu as mx
 
@@ -163,16 +263,40 @@ def main():
     ap.add_argument("--batch-size", type=int, default=None,
                     help="override the per-model defaults (%r)"
                     % (DEFAULT_BS,))
+    ap.add_argument("--dist", action="store_true",
+                    help="loopback-PS fit microbench: eager vs fused "
+                         "sync vs fused async over kvstore='dist_async'")
     ap.add_argument("--no-write", action="store_true",
                     help="do not mirror the line to docs/module_bench.json")
     args = ap.parse_args()
 
-    result = run(args.batches, args.warmup, args.batch_size)
+    if args.dist:
+        result = run_dist(args.batches, args.warmup, args.batch_size)
+    else:
+        result = run(args.batches, args.warmup, args.batch_size)
     line = json.dumps(result)
     print(line, flush=True)
     if not args.no_write:
-        with open(os.path.join(ROOT, "docs", "module_bench.json"),
-                  "w") as f:
+        # the file keeps one line per bench kind (module_fit and
+        # module_fit_dist): replace this kind's line, keep the other
+        path = os.path.join(ROOT, "docs", "module_bench.json")
+        kept = []
+        if os.path.exists(path):
+            with open(path) as f:
+                for existing in f:
+                    existing = existing.strip()
+                    if not existing:
+                        continue
+                    try:
+                        if json.loads(existing).get("bench") == \
+                                result["bench"]:
+                            continue
+                    except ValueError:
+                        continue
+                    kept.append(existing)
+        with open(path, "w") as f:
+            for existing in kept:
+                f.write(existing + "\n")
             f.write(line + "\n")
 
 

@@ -44,6 +44,13 @@ from ..core import LintPass, register
 #                        or None when returned directly, donated args)
 DONATING_FACTORIES = {
     "make_fused_train_step": (0, (0, 1, 2, 4, 5, 7)),
+    # the grad-emitting dist mode: params are read-only, aux/key/metric
+    # accumulator donated (executor.make_fused_grad_step)
+    "make_fused_grad_step": (0, (1, 3, 4)),
+    # the dist_local apply half: params/state/step-count donated,
+    # pulled grads and lr are not (executor.make_fused_apply_step,
+    # returned directly — not in a tuple)
+    "make_fused_apply_step": (None, (0, 1, 3)),
 }
 
 
