@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Fast-tier rollout drill (ISSUE 11): the continuous-deployment
+contracts of the train→serve loop, end to end on a loopback fleet in
+this process.
+
+  1. **Streaming, zero retraces**: a publisher streams weight versions
+     into a serving pair under concurrent load — every request is
+     answered exactly once by exactly ONE coherent version, and the
+     program-cache compile counters stay flat across every swap.
+  2. **Canary → verdict → promote**: a deterministic per-request-id
+     split routes a fraction of live traffic to the canary version;
+     the per-version counters feed a promote verdict; promotion makes
+     the canary the stable route with zero downtime.
+  3. **Kill -9 mid-swap**: ``kind=kill @ serve.swap`` takes a replica
+     down in the middle of installing a version (the in-process
+     rendering of kill -9); the fleet keeps answering from the peer —
+     exactly once, zero acknowledged loss.
+  4. **Bit-exact rollback**: rollback to the pinned version restores
+     it from the versioned snapshot, verifies the digest RECORDED at
+     publish, and reproduces the version's probe bits exactly.
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_rollout.py`` (wired into
+``ci/run_ci.sh fast``). Exit 0 = contract holds.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_PS_HEARTBEAT"] = "0"
+os.environ["MXTPU_PS_RETRIES"] = "1"
+os.environ["MXTPU_PS_BACKOFF"] = "0.01"
+os.environ["MXTPU_PS_RECONNECT"] = "0.5"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np                                    # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+from mxtpu import fault                               # noqa: E402
+from mxtpu.serving import (                           # noqa: E402
+    InferenceEngine, ModelServer, RolloutController, ServingClient,
+    WeightPublisher, WeightSync)
+
+IN_DIM, CLASSES = 12, 4
+BUCKETS = (8,)          # single bucket: bit-determinism across
+#                         compositions (docs/serving.md "Determinism")
+BUDGET_MS = 4000.0
+
+
+def fail(msg):
+    print("rollout check FAILED: %s" % msg)
+    return 1
+
+
+def build_model():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, IN_DIM))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    arg_params, aux_params = mod.get_params()
+    return net, arg_params, aux_params
+
+
+def main():
+    net, arg_params, aux_params = build_model()
+    weight_dir = tempfile.mkdtemp(prefix="mxtpu_rollout_ci_")
+
+    def mkeng():
+        return InferenceEngine(net, arg_params, aux_params,
+                               {"data": (IN_DIM,)}, buckets=BUCKETS,
+                               warm=False)
+
+    def params_v(scale):
+        return {n: v.asnumpy() * scale for n, v in arg_params.items()}
+
+    s1 = ModelServer(mkeng(), model_name="ci", batch_deadline_ms_=10,
+                     default_budget_ms_=BUDGET_MS,
+                     weight_dir=weight_dir).start()
+    s2 = ModelServer(mkeng(), model_name="ci", batch_deadline_ms_=10,
+                     default_budget_ms_=BUDGET_MS,
+                     replicas=[s1.address],
+                     weight_dir=weight_dir).start()
+    s1._replicas.append(s2.address)
+    addrs = [s1.address, s2.address]
+    cli = ServingClient(addrs=addrs, budget_ms=BUDGET_MS)
+    cli.hello()
+    ctl = RolloutController(addrs, model="ci")
+    pub = WeightPublisher(weight_dir)
+    syncs = [WeightSync(s, weight_dir=weight_dir, poll=0.05)
+             for s in (s1, s2)]
+
+    compiles0 = (s1._engine.cache.compiles, s2._engine.cache.compiles)
+    rng = np.random.RandomState(11)
+    x_probe = rng.rand(8, IN_DIM).astype("f")
+
+    # -- 1. publish -> stream -> swap under concurrent load -------------
+    pub.publish(params_v(1.2), pin=True)          # v1, the anchor
+    for s in syncs:
+        s.catch_up()
+    probe_v1 = np.asarray(cli.predict2(x_probe)[0][0])
+    v1_state = s1._engine.version_state()
+    if v1_state["version"] != 1:
+        return fail("v1 never landed: %r" % (v1_state,))
+
+    stop = threading.Event()
+    answered, errs = [], []
+    lock = threading.Lock()
+
+    def pound(seed):
+        r = np.random.RandomState(seed)
+        c = ServingClient(addrs=addrs, budget_ms=BUDGET_MS)
+        while not stop.is_set():
+            try:
+                _, info = c.predict2(r.rand(1, IN_DIM).astype("f"))
+                with lock:
+                    answered.append(info["version"])
+            except Exception as e:
+                with lock:
+                    errs.append(repr(e))
+        c.close()
+
+    threads = [threading.Thread(target=pound, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for scale in (1.4, 1.6, 1.8):                 # v2..v4 stream in
+        pub.publish(params_v(scale))
+        for s in syncs:
+            s.catch_up()
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    if errs:
+        return fail("streaming under load lost/errored requests: %r"
+                    % errs[:3])
+    if not answered:
+        return fail("no traffic answered during the stream")
+    if not set(answered) <= {0, 1, 2, 3, 4}:
+        return fail("incoherent versions answered: %r"
+                    % sorted(set(answered)))
+    if (s1._engine.cache.compiles,
+            s2._engine.cache.compiles) != compiles0:
+        return fail("weight swaps retraced predict programs")
+
+    # -- 2. canary split -> verdict -> promote ---------------------------
+    ctl.canary(1, 0.5)
+    seen = set()
+    for _ in range(40):
+        _, info = cli.predict2(rng.rand(1, IN_DIM).astype("f"))
+        seen.add(info["version"])
+    if seen != {1, 4}:
+        return fail("canary split answered %r, want {1, 4}" % (seen,))
+    verdict = ctl.verdict(1, stable_version=4)
+    if verdict["verdict"] != "promote":
+        return fail("healthy canary judged %r" % (verdict,))
+    ctl.promote(1)
+    _, info = cli.predict2(x_probe)
+    if info["version"] != 1:
+        return fail("promotion did not switch the stable route: %r"
+                    % (info,))
+
+    # -- 3. kill -9 mid-swap: fleet keeps answering, exactly once --------
+    ctl.unpin()   # promotion pinned nothing; make streaming live again
+    with fault.inject("kind=kill,point=serve.swap,nth=1") as inj:
+        # hand-deliver v5 to both replicas: the first swap kills its
+        # replica mid-install, the peer lands it and serves
+        p5 = params_v(2.0)
+        dead_err = None
+        try:
+            s1.swap_weights(p5, version=5)
+        except (ConnectionError, RuntimeError) as e:
+            dead_err = e
+        s2.swap_weights(p5, version=5)
+    if inj.stats()[0][4] != 1:
+        return fail("the mid-swap kill schedule never fired")
+    if dead_err is None:
+        return fail("the kill fired but the swap call survived")
+    dead = [s for s in (s1, s2) if s._tcp.dying]
+    alive = [s for s in (s1, s2) if not s._tcp.dying]
+    if len(dead) != 1 or len(alive) != 1:
+        return fail("mid-swap kill left %d dead replicas" % len(dead))
+    outs, errs2 = {}, {}
+
+    def one(i, x):
+        try:
+            r, info = cli.predict2(x)
+            outs[i] = (np.asarray(r[0]), info["version"])
+        except Exception as e:
+            errs2[i] = e
+
+    xs = [rng.rand(1, IN_DIM).astype("f") for _ in range(8)]
+    ts = [threading.Thread(target=one, args=(i, x))
+          for i, x in enumerate(xs)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    if errs2:
+        return fail("requests lost across the mid-swap kill: %r"
+                    % errs2)
+    if len(outs) != len(xs):
+        return fail("exactly-once broken across the kill: %d/%d"
+                    % (len(outs), len(xs)))
+    if any(v != 5 for _, v in outs.values()):
+        return fail("survivor answered stale versions: %r"
+                    % {i: v for i, (_, v) in outs.items()})
+
+    # -- 4. bit-exact rollback to the pinned version ---------------------
+    surv = alive[0]
+    surv_ctl = RolloutController([surv.address], model="ci")
+    rb = surv_ctl.rollback(1)[surv.address]
+    if rb["weights"]["pinned"] != 1:
+        return fail("rollback did not pin v1: %r" % (rb,))
+    cli2 = ServingClient(addrs=[surv.address], budget_ms=BUDGET_MS)
+    out_rb, info = cli2.predict2(x_probe)
+    if info["version"] != 1:
+        return fail("rollback answered version %r" % (info,))
+    if not np.array_equal(np.asarray(out_rb[0]), probe_v1):
+        return fail("rollback is not bit-exact against the recorded "
+                    "v1 probe")
+    if surv._engine.cache.compiles != compiles0[0]:
+        return fail("rollback retraced predict programs")
+
+    for s in syncs:
+        s.stop()
+    surv_ctl.close()
+    ctl.close()
+    cli2.close()
+    cli.close()
+    s2.stop()
+    s1.stop()
+    print("rollout check OK — %d streamed requests over 4 versions "
+          "(0 retraces), canary 50/50 -> promote verdict, kill -9 "
+          "mid-swap answered %d/%d exactly once on the survivor, "
+          "rollback to pinned v1 bit-exact"
+          % (len(answered), len(outs), len(xs)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

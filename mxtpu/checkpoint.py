@@ -23,6 +23,7 @@ Usage::
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -37,9 +38,26 @@ import numpy as _np
 from . import ndarray as nd
 from .ndarray import NDArray
 
-__all__ = ["CheckpointManager", "CheckpointCorrupt"]
+__all__ = ["CheckpointManager", "CheckpointCorrupt", "weight_digest"]
 
 _log = logging.getLogger(__name__)
+
+
+def weight_digest(arrays):
+    """Canonical sha256 identity of a named array set: names sorted,
+    each contributing name + dtype + shape + raw C-order bytes. Two
+    parameter sets with the same digest are bit-identical — the
+    verification token the weight-rollout surface records at publish
+    and re-checks at rollback (docs/serving.md "Rollout & weight
+    streaming")."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = _np.ascontiguousarray(arrays[name])
+        h.update(str(name).encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 class CheckpointCorrupt(RuntimeError):
@@ -85,6 +103,7 @@ class CheckpointManager:
         self.async_save = async_save
         self._pending = None
         self._pending_error = None
+        self._pins_lock = threading.Lock()
         if use_orbax is None:
             try:
                 import orbax.checkpoint  # noqa: F401
@@ -199,9 +218,81 @@ class CheckpointManager:
                 bytes(_np.asarray(meta["json"], dtype=_np.uint8)).decode())
         return tree
 
+    def restore_exact(self, step):
+        """Restore exactly ``step`` — NO fallback to an earlier
+        retained step (contrast :meth:`restore`). The rollback path
+        must produce the pinned version's bits or fail loudly; silently
+        serving a neighbor's params would defeat the digest check.
+        Returns None when the step does not exist; raises
+        :class:`CheckpointCorrupt` when it exists but is torn."""
+        self.wait_until_finished()
+        step = int(step)
+        if step not in self.all_steps():
+            return None
+        if self._orbax_mgr is not None:
+            return self._orbax_mgr.restore(step)
+        return self._fallback_restore(step)
+
     def latest_step(self):
         steps = self.all_steps()
         return max(steps) if steps else None
+
+    # -- versioned-weight surface: pins + digests --------------------------
+    # (fallback writer only: the serving weight stores construct with
+    # use_orbax=False; orbax owns its own retention policy)
+    @property
+    def _pins_path(self):
+        return os.path.join(self.directory, "pins.json")
+
+    def pins(self):
+        """The set of pinned steps — versions retention may NEVER
+        collect (the rollback anchors of the serving rollout story)."""
+        with self._pins_lock:
+            return set(self._read_pins())
+
+    def _read_pins(self):
+        try:
+            with open(self._pins_path) as f:
+                return {int(s) for s in json.load(f)}
+        except (OSError, ValueError):
+            return set()
+
+    def pin(self, step):
+        """Exempt ``step`` from retention until :meth:`unpin` — the
+        durable half of 'bit-exact rollback to a pinned version'."""
+        with self._pins_lock:
+            pins = self._read_pins()
+            pins.add(int(step))
+            self._write_pins(pins)
+
+    def unpin(self, step):
+        with self._pins_lock:
+            pins = self._read_pins()
+            pins.discard(int(step))
+            self._write_pins(pins)
+
+    def _write_pins(self, pins):
+        tmp = self._pins_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(sorted(pins), f)
+            self._fsync_file(f)
+        os.replace(tmp, self._pins_path)
+
+    def digest(self, step):
+        """The sha256 :func:`weight_digest` the writer recorded for
+        ``step``'s params (None for pre-digest or orbax checkpoints).
+        Rollback verifies restored bytes against THIS value — the
+        recorded identity, not a recomputation from possibly-corrupt
+        files."""
+        if self._orbax_mgr is not None:
+            return None
+        path = os.path.join(self.directory, "step_%d" % int(step),
+                            "integrity.json")
+        try:
+            with open(path) as f:
+                return json.load(f).get("digest")
+        except (OSError, ValueError):
+            return None
 
     def all_steps(self):
         if self._orbax_mgr is not None:
@@ -302,6 +393,9 @@ class CheckpointManager:
                             _np.savez(f, **d)
                             self._fsync_file(f)
                         integrity[extra] = self._crc_tags(d)
+                # whole-set identity next to the per-array tags: the
+                # rollout surface compares THIS digest at rollback
+                integrity["digest"] = weight_digest(tree["params"])
                 # per-array CRC tags, written LAST inside the tmp dir so
                 # a torn write of any array file is detectable even when
                 # the archive itself still opens
@@ -375,6 +469,8 @@ class CheckpointManager:
             raise CheckpointCorrupt(
                 "step %d integrity tags unreadable: %s" % (step, e)) from e
         for section, expect in tags.items():
+            if section == "digest":       # whole-set identity, not a
+                continue                  # per-array CRC section
             got = tree.get(section)
             if section == "trainer_states" and got is not None:
                 got = {"trainer_states": got}
@@ -390,7 +486,12 @@ class CheckpointManager:
                         % (step, section, name))
 
     def _retention(self):
-        steps = self.all_steps()
-        for s in steps[:-self.max_to_keep] if self.max_to_keep else []:
+        """keep-last-K over the UNPINNED steps; a pinned step is never
+        collected, however old (the rollback contract)."""
+        if not self.max_to_keep:
+            return
+        pinned = self.pins()
+        steps = [s for s in self.all_steps() if s not in pinned]
+        for s in steps[:-self.max_to_keep]:
             shutil.rmtree(os.path.join(self.directory, "step_%d" % s),
                           ignore_errors=True)

@@ -60,6 +60,19 @@ Rule keys:
            drill: the whole batch's clients fail over and replay their
            request ids on the surviving replica; see
            ``docs/serving.md``) |
+           ``serve.swap`` (fired by a serving replica immediately
+           before a new weight version swaps into the live engine,
+           ``op=swap``, ``key=v<version>`` — ``drop`` loses that
+           version record, the replica keeps answering from the last
+           complete version until the next one arrives; ``kill`` is
+           the kill-replica-mid-swap drill of the continuous-deployment
+           story; see docs/serving.md "Rollout & weight streaming") |
+           ``publish.snapshot`` (fired by the weight-publishing side —
+           ``WeightPublisher.publish`` or the parameter server's
+           ``publish`` op — before the versioned snapshot is written
+           and streamed, ``op=publish`` — ``drop``/``sever``/``kill``
+           lose the publish mid-flight; subscribers keep the last
+           COMPLETE version, never a torn one) |
            ``any``.
 ``op``     wire command to match (``push``/``pull``/``repl``/...); ``*``
            (default) matches all. Replication-stream frames carry
@@ -101,7 +114,8 @@ __all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
            "inject", "fire", "active"]
 
 _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
-           "worker.step", "serve.request", "serve.batch", "any")
+           "worker.step", "serve.request", "serve.batch", "serve.swap",
+           "publish.snapshot", "any")
 _KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
           "nan_grad", "kill_worker", "join_worker", "leave_worker",
           "split_shard")

@@ -852,6 +852,23 @@ class ParameterServer:
                 self._snapshot_dir, max_to_keep=2, async_save=False,
                 use_orbax=False)
             self._restore_snapshot()
+        # -- versioned weight publication (the train→serve stream:
+        # trainers drive the ``publish`` op, serving replicas follow
+        # via ``weight_sub`` + long-polled ``weights`` — the
+        # _ReplStream discipline applied to whole weight versions:
+        # totally ordered by version number, the subscriber's
+        # have-version watermark dedupes replays, catch-up on
+        # reconnect is just asking with the watermark) --
+        self._pub_lock = threading.Lock()
+        self._pub_cv = threading.Condition(self._pub_lock)
+        self._pub_version = 0
+        self._published = None      # latest version's host blobs
+        self._pub_digest = None
+        self._pub_count = 0
+        self._weight_subs = {}      # subscriber origin -> watermark
+        self._weight_dir = os.environ.get("MXTPU_SERVE_WEIGHT_DIR") \
+            or None
+        self._weight_ckpt = None    # lazy, first publish
 
     # -- lifecycle --------------------------------------------------------
     @property
@@ -1504,7 +1521,8 @@ class ParameterServer:
     _CLIENT_STATE_CMDS = frozenset(
         ("init", "push", "pushpull", "pull", "pull_rows", "multi",
          "set_optimizer", "opt_states", "set_opt_states", "barrier",
-         "split", "adopt_key", "cursor_next", "cursor_done"))
+         "split", "adopt_key", "cursor_next", "cursor_done",
+         "publish"))
 
     def _dispatch(self, msg, _repl=False):
         cmd = msg[0]
@@ -1935,6 +1953,11 @@ class ParameterServer:
                             "dup_acks": self._repl.dup_acks,
                             "catchup": dict(self._catchup)
                             if self._catchup else None}
+            with self._pub_cv:
+                weight_stream = {
+                    "published_version": self._pub_version,
+                    "publishes": self._pub_count,
+                    "subscribers": dict(self._weight_subs)}
             return ("ok", {"staleness_max": self._stale_max,
                            "staleness_avg": avg,
                            "pushes": self._stale_n,
@@ -1959,11 +1982,102 @@ class ParameterServer:
                            "repl": repl,
                            "repl_received": self._repl_received,
                            "repl_dup": self._repl_dup,
+                           "weight_stream": weight_stream,
                            "catchup_complete": self._catchup_complete})
+        if cmd == "publish":
+            return self._do_publish(msg)
+        if cmd == "weights":
+            # ("weights", origin, have_version, wait_s): the weight
+            # stream's delivery op — long-poll until a version past the
+            # caller's watermark exists (or wait_s elapses), then ship
+            # the WHOLE version (full coherent blobs, digest-tagged).
+            # A replay/reconnect with the same watermark is a no-op
+            # catch-up, never a double apply.
+            _, origin, have, wait_s = msg
+            have = int(have)
+            deadline = time.monotonic() + min(float(wait_s or 0), 60.0)
+            with self._pub_cv:
+                while self._pub_version <= have and not self._tcp.dying:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        break
+                    self._pub_cv.wait(timeout=min(remain, 0.5))
+                v = self._pub_version
+                if origin is not None:
+                    self._weight_subs[origin] = max(
+                        self._weight_subs.get(origin, -1), have)
+                if v <= have:
+                    return ("ok", {"version": v, "params": None,
+                                   "digest": None})
+                # blobs are replaced wholesale per publish, never
+                # mutated — safe to pickle outside the lock
+                return ("ok", {"version": v, "params": self._published,
+                               "digest": self._pub_digest})
+        if cmd == "weight_sub":
+            # subscriber registration on the weight stream: watermarks
+            # (and so lag) surface in stats()['weight_stream']
+            _, origin = msg
+            with self._pub_cv:
+                self._weight_subs.setdefault(origin, -1)
+                return ("ok", {"version": self._pub_version})
         if cmd == "stop":
             threading.Thread(target=self.stop, daemon=True).start()
             return ("ok",)
         return ("err", "unknown command %r" % (cmd,))
+
+    def _do_publish(self, msg):
+        """("publish", version, meta, pin): snapshot the CURRENT table
+        as one versioned, digest-tagged weight record — write it to the
+        versioned snapshot dir (when configured) and wake every
+        ``weights`` long-poller. Per-key values are copied under their
+        key locks; the published set is one coherent read of the table.
+        The version watermark makes a replayed publish a dup, and the
+        ``publish.snapshot`` fault point fires BEFORE anything is
+        visible, so a dropped/severed/killed publish loses the version
+        cleanly — subscribers keep the last COMPLETE one."""
+        _, version, meta, pin = msg
+        with self._pub_cv:
+            v = self._pub_version + 1 if version is None \
+                else int(version)
+            if v <= self._pub_version:
+                return ("ok", {"version": self._pub_version,
+                               "digest": self._pub_digest,
+                               "dup": True})
+        act = _fault.fire("publish.snapshot", op="publish",
+                          key="v%d" % v, server=self)
+        if act == "drop":
+            return ("err", "publish of weight version %d dropped "
+                           "(injected) — subscribers keep version %d"
+                    % (v, self._pub_version))
+        from .checkpoint import weight_digest
+        blobs = {}
+        for key in list(self._table):
+            with self._lock_for(key):
+                val = self._table.get(key)
+                if val is not None:
+                    blobs[str(key)] = _np.array(val, copy=True)
+        digest = weight_digest(blobs)
+        if self._weight_dir:
+            if self._weight_ckpt is None:
+                from .checkpoint import CheckpointManager
+                self._weight_ckpt = CheckpointManager(
+                    self._weight_dir,
+                    max_to_keep=int(os.environ.get(
+                        "MXTPU_SERVE_WEIGHT_KEEP", "5")),
+                    async_save=False, use_orbax=False)
+            self._weight_ckpt.save(v, blobs,
+                                   metadata=dict(meta or {},
+                                                 digest=digest))
+            if pin:
+                self._weight_ckpt.pin(v)
+        with self._pub_cv:
+            if v > self._pub_version:
+                self._pub_version = v
+                self._published = blobs
+                self._pub_digest = digest
+                self._pub_count += 1
+                self._pub_cv.notify_all()
+        return ("ok", {"version": v, "digest": digest})
 
     def _install_optimizer(self, payload):
         opt = sys.modules.get("mxtpu.optimizer")
@@ -2164,7 +2278,8 @@ _IDEMPOTENT = frozenset(
     ("init", "push", "pushpull", "pull", "pull_rows", "stats", "ping",
      "set_optimizer", "opt_states", "set_opt_states", "multi",
      "hello", "bye", "repl", "promote", "peer_info", "join_backup",
-     "shard_map", "cursor_next", "cursor_done", "adopt_key", "split"))
+     "shard_map", "cursor_next", "cursor_done", "adopt_key", "split",
+     "publish", "weights", "weight_sub"))
 
 
 class _Pending:
@@ -3560,6 +3675,25 @@ class AsyncDistKVStore(KVStore):
             payload = fin.read()
         for c in self._conns:
             c.request("set_opt_states", payload)
+
+    def publish_version(self, version=None, meta=None, pin=False):
+        """Publish every shard's CURRENT table as one weight version
+        for the serving fleet (the train→serve stream: serving
+        replicas follow via ``weight_sub``/``weights`` long-polls, or
+        poll the versioned snapshots each server writes when
+        ``MXTPU_SERVE_WEIGHT_DIR`` is set — docs/serving.md "Rollout &
+        weight streaming"). Single-shard fleets may leave ``version``
+        None (the server bumps its own watermark); multi-shard fleets
+        should pass an explicit version so every shard publishes the
+        same number and subscribers see one coherent fleet version.
+        ``pin=True`` exempts the snapshot from retention — the
+        rollback anchor. Returns one info dict per shard
+        (``{"version", "digest"}``)."""
+        replies = []
+        for c in self._conns:
+            replies.append(
+                c.request("publish", version, meta, pin)[1])
+        return replies
 
     # -- coordination -----------------------------------------------------
     def barrier(self):

@@ -33,11 +33,24 @@ out of machinery this tree already trusts:
   answered exactly once, bit-for-bit identical across replicas (pure
   function of the shared checkpoint).
 
-Fault drills ride :mod:`mxtpu.fault` at two new points —
-``serve.request`` (admission) and ``serve.batch`` (pre-dispatch) — plus
-the existing transport points, so kill/delay/sever serving scenarios
-replay deterministically (``tests/test_fault_tolerance.py``,
-``tests/test_serving.py``). Full architecture and semantics:
+* :mod:`mxtpu.serving.rollout` — the train→serve loop closed:
+  :class:`~mxtpu.serving.rollout.WeightPublisher` writes versioned,
+  digest-tagged weight snapshots; :class:`~mxtpu.serving.rollout.
+  WeightSync` streams them into live replicas (snapshot polling or the
+  parameter server's ``weights`` long-poll stream) with NO recompiles
+  — same shapes, program-cache hits — and an atomic version-epoch bump
+  between batches; :class:`~mxtpu.serving.rollout.RolloutController`
+  drives canary/A-B splits, promote/abort verdicts, zero-downtime
+  hot-swap via the drain verdict, and bit-exact rollback to a pinned
+  version verified against its recorded digest.
+
+Fault drills ride :mod:`mxtpu.fault` at four serving points —
+``serve.request`` (admission), ``serve.batch`` (pre-dispatch),
+``serve.swap`` (pre-weight-swap) and ``publish.snapshot`` (the
+publisher side) — plus the existing transport points, so
+kill/delay/sever serving scenarios replay deterministically
+(``tests/test_fault_tolerance.py``, ``tests/test_serving.py``,
+``tests/test_rollout.py``). Full architecture and semantics:
 ``docs/serving.md``; knobs: ``docs/env_vars.md`` (``MXTPU_SERVE_*``);
 measured behavior: ``tools/bench_serving.py`` →
 ``docs/perf_analysis.md`` "Serving".
@@ -48,7 +61,9 @@ from .engine import InferenceEngine, parse_buckets, parse_shape_spec
 from .batcher import DynamicBatcher, RETRIABLE_VERDICTS
 from .server import ModelServer
 from .client import ServingClient, Overloaded, DeadlineExceeded
+from .rollout import RolloutController, WeightPublisher, WeightSync
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
            "ServingClient", "Overloaded", "DeadlineExceeded",
+           "RolloutController", "WeightPublisher", "WeightSync",
            "RETRIABLE_VERDICTS", "parse_buckets", "parse_shape_spec"]

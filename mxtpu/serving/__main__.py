@@ -13,9 +13,21 @@ contract:
 * ``MXTPU_SERVE_ADDRS``       comma list of ALL replica addresses
                               (advertised to clients at hello)
 * ``MXTPU_SERVE_BUCKETS``     batch buckets (default ``1,2,4,8,16,32``)
+* ``MXTPU_SERVE_WEIGHT_DIR``  versioned weight-snapshot dir to follow
+                              (the WeightPublisher's; also the
+                              rollback restore source)
+* ``MXTPU_SERVE_WEIGHT_KV``   comma list of parameter-server addresses
+                              to follow via the ``weights`` long-poll
+                              stream instead of (or next to) the dir
+* ``MXTPU_SERVE_WEIGHT_POLL`` weight-sync tick seconds (default 0.5)
 * plus the batching/admission knobs read by
   :mod:`mxtpu.serving.server` (``MXTPU_SERVE_QUEUE_DEPTH``,
   ``MXTPU_SERVE_BATCH_DEADLINE_MS``, ``MXTPU_SERVE_DEADLINE_MS``).
+
+With a weight source configured the replica CATCHES UP to the current
+weight version BEFORE it starts admitting (the ``--serve-respawn``
+rejoin contract: a revived replica re-hellos already serving current
+weights, never stale ones), then follows the stream live.
 
 Lifecycle: SIGTERM triggers the graceful drain — admissions stop (new
 predicts get the retriable ``draining`` verdict, steering clients to
@@ -24,6 +36,12 @@ the surviving replicas), admitted batches flush, then the process exits
 escalation, so a reaped serving fleet drains instead of dropping
 in-flight work; kill -9 is the crash drill the client failover path
 covers.
+
+Admin one-shots (``tools/launch.py --rollout`` drives these)::
+
+    python -m mxtpu.serving --admin rollout --addrs host:p,host:p \
+        --action canary|promote|abort|rollback|pin|unpin|status \
+        [--version V] [--fraction F] [--model NAME]
 """
 from __future__ import annotations
 
@@ -43,15 +61,26 @@ def main():
     epoch = int(os.environ.get("MXTPU_SERVE_EPOCH", "0"))
     port = int(os.environ.get("MXTPU_SERVE_PORT", "0"))
     buckets = os.environ.get("MXTPU_SERVE_BUCKETS", "1,2,4,8,16,32")
+    weight_dir = os.environ.get("MXTPU_SERVE_WEIGHT_DIR") or None
+    weight_kv = os.environ.get("MXTPU_SERVE_WEIGHT_KV") or None
 
-    from . import InferenceEngine, ModelServer, parse_buckets, \
-        parse_shape_spec
+    from . import InferenceEngine, ModelServer, WeightSync, \
+        parse_buckets, parse_shape_spec
 
     engine = InferenceEngine.from_checkpoint(
         prefix, epoch, parse_shape_spec(shapes),
         buckets=parse_buckets(buckets), warm=False)
     srv = ModelServer(engine, port=port,
                       model_name=os.path.basename(prefix))
+
+    sync = None
+    if weight_dir or weight_kv:
+        sync = WeightSync(srv, weight_dir=weight_dir,
+                          kv_addrs=weight_kv)
+        # the rejoin contract: current weights BEFORE the first admit
+        caught = sync.catch_up()
+        print("mxtpu serving replica caught up to weight version %d"
+              % caught, flush=True)
 
     term = threading.Event()
 
@@ -62,12 +91,16 @@ def main():
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
     srv.start()     # warms every bucket program before listening
+    if sync is not None:
+        sync.start()
     print("mxtpu serving replica listening on %s (model=%s buckets=%s)"
           % (srv.address, os.path.basename(prefix),
              ",".join(str(b) for b in engine.buckets)), flush=True)
     while not term.is_set():
         term.wait(timeout=0.5)
     print("mxtpu serving replica %s draining" % srv.address, flush=True)
+    if sync is not None:
+        sync.stop()
     drained = srv.drain(timeout=float(
         os.environ.get("MXTPU_SERVE_DRAIN_TIMEOUT", "30")))
     srv.stop()
@@ -76,5 +109,50 @@ def main():
     return 0
 
 
+def _admin_main(argv):
+    """Operator one-shots against a running serving fleet — the wire
+    form of :class:`~mxtpu.serving.rollout.RolloutController` (the
+    shared secret comes from ``MXTPU_PS_TOKEN``, as the launcher
+    exports it)."""
+    import argparse
+    import json
+    from .rollout import RolloutController
+    ap = argparse.ArgumentParser(prog="mxtpu.serving")
+    ap.add_argument("--admin", choices=("rollout",), required=True)
+    ap.add_argument("--addrs", required=True,
+                    help="comma list of serving replica addresses")
+    ap.add_argument("--action", required=True,
+                    choices=("canary", "promote", "abort", "rollback",
+                             "pin", "unpin", "status", "verdict"))
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--version", type=int, default=None)
+    ap.add_argument("--fraction", type=float, default=0.1)
+    a = ap.parse_args(argv)
+    ctl = RolloutController(a.addrs, model=a.model)
+    try:
+        if a.action == "canary":
+            out = ctl.canary(a.version, a.fraction)
+        elif a.action == "promote":
+            out = ctl.promote(a.version)
+        elif a.action == "abort":
+            out = ctl.abort()
+        elif a.action == "rollback":
+            out = ctl.rollback(a.version)
+        elif a.action == "pin":
+            out = ctl.pin(a.version)
+        elif a.action == "unpin":
+            out = ctl.unpin()
+        elif a.action == "verdict":
+            out = ctl.verdict(a.version)
+        else:
+            out = ctl.status()
+        print(json.dumps(out, default=str))
+    finally:
+        ctl.close()
+    return 0
+
+
 if __name__ == "__main__":
+    if "--admin" in sys.argv:
+        sys.exit(_admin_main(sys.argv[1:]))
     sys.exit(main())

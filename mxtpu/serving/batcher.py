@@ -61,13 +61,19 @@ class Request:
     many predicts into the same coalesced batch."""
 
     __slots__ = ("rid", "arrays", "rows", "deadline", "enq_t",
-                 "event", "reply", "wait_bound", "_cbs", "_cb_lock")
+                 "event", "reply", "wait_bound", "version", "_cbs",
+                 "_cb_lock")
 
-    def __init__(self, rid, arrays, rows, deadline, wait_bound=60.0):
+    def __init__(self, rid, arrays, rows, deadline, wait_bound=60.0,
+                 version=None):
         self.rid = rid
         self.arrays = arrays
         self.rows = rows
         self.deadline = deadline
+        # weight version resolved at ADMISSION (stable or canary):
+        # batches never mix versions, so every request is answered by
+        # one coherent store even while swaps stream in
+        self.version = version
         self.enq_t = time.monotonic()
         self.event = threading.Event()
         self.reply = None
@@ -129,7 +135,8 @@ class DynamicBatcher:
         self._thread.start()
 
     # -- admission ---------------------------------------------------------
-    def submit(self, rid, arrays, rows, deadline, wait_bound=60.0):
+    def submit(self, rid, arrays, rows, deadline, wait_bound=60.0,
+               version=None):
         """Admit one request. Returns the parked :class:`Request`, or
         an ``("overloaded", info)`` verdict tuple when the queue is at
         depth — the caller relays it as the retriable shed reply."""
@@ -142,7 +149,7 @@ class DynamicBatcher:
                         {"queue_depth": self._depth,
                          "queued": len(self._queue) + self._inflight})
             req = Request(rid, arrays, rows, deadline,
-                          wait_bound=wait_bound)
+                          wait_bound=wait_bound, version=version)
             self._queue.append(req)
             self._queued_rows += rows
             if len(self._queue) > self._c["queue_hwm"]:
@@ -183,6 +190,9 @@ class DynamicBatcher:
                     continue
                 if rows + req.rows > max_rows:
                     break           # whole requests only; next flush
+                if batch and req.version != batch[0].version:
+                    break           # one coherent version per batch;
+                    #                 the other version flushes next
                 self._queue.popleft()
                 self._queued_rows -= req.rows
                 batch.append(req)
@@ -233,7 +243,8 @@ class DynamicBatcher:
             _np.concatenate([_np.asarray(r.arrays[i]) for r in batch])
             for i in range(len(self._engine.data_names))]
         try:
-            outs = self._engine.predict(arrays, rows=rows)
+            outs, answered = self._engine.predict_versioned(
+                arrays, rows=rows, version=batch[0].version)
         except Exception as e:
             for req in batch:
                 req.resolve(("err", "predict failed: %s: %s"
@@ -252,7 +263,8 @@ class DynamicBatcher:
             hi = lo + req.rows
             req.resolve(("ok", tuple(o[lo:hi] for o in outs),
                          {"batch_rows": rows,
-                          "batch_requests": len(batch)}))
+                          "batch_requests": len(batch),
+                          "version": answered}))
             lo = hi
 
     # -- lifecycle ---------------------------------------------------------

@@ -96,6 +96,7 @@ class ServingClient:
                    "failovers": 0, "shed": 0, "expired": 0}
         self.signature = None
         self.model = None
+        self.models = {}           # hosted menus learned at hello
 
     # -- replica plumbing --------------------------------------------------
     def _conn_for(self, addr, connect_timeout=None):
@@ -153,6 +154,7 @@ class ServingClient:
                         self._addrs.append(a)
             self.signature = info.get("signature")
             self.model = info.get("model")
+            self.models = info.get("models", {})
             return info
         raise ConnectionError("no serving replica answered hello: %s"
                               % (last,))
@@ -163,12 +165,21 @@ class ServingClient:
         # past that is a dead/stalled replica and the window must fail
         return budget_ms / 1000.0 + _CLIENT_GRACE
 
-    def predict(self, arrays, budget_ms=None):
+    def predict(self, arrays, budget_ms=None, model=None):
         """One predict: returns the list of output arrays (rows match
         the request). ``arrays`` is one numpy array (single-input
         models) or a list/tuple in the server's ``data_names`` order.
+        ``model`` routes to a non-default hosted menu by id.
         A connection-level failure health-probes the active replica
         and replays the SAME request id on the next one."""
+        outs, _info = self.predict2(arrays, budget_ms=budget_ms,
+                                    model=model)
+        return outs
+
+    def predict2(self, arrays, budget_ms=None, model=None):
+        """:meth:`predict` plus the reply's info dict — notably
+        ``info["version"]``, the weight version that answered (what
+        the rollout drills key their per-version evidence on)."""
         if isinstance(arrays, _np.ndarray):
             arrays = (arrays,)
         arrays = tuple(_np.ascontiguousarray(a) for a in arrays)
@@ -187,8 +198,13 @@ class ServingClient:
                 self._bump("replays")
             try:
                 conn = self._conn_for(addr)
-                reply = conn.request("predict", rid, arrays, budget,
-                                     timeout=timeout, retries=0)
+                if model is None:       # wire-compatible 4-tuple
+                    reply = conn.request("predict", rid, arrays, budget,
+                                         timeout=timeout, retries=0)
+                else:
+                    reply = conn.request("predict", rid, arrays, budget,
+                                         model, timeout=timeout,
+                                         retries=0)
             except (ConnectionError, OSError) as e:
                 last_err = e
                 # health-probe before abandoning the replica: a single
@@ -211,7 +227,9 @@ class ServingClient:
             verdict = reply[0]
             if verdict == "ok":
                 self._bump("responses")
-                return list(reply[1])
+                info = reply[2] if len(reply) > 2 and \
+                    isinstance(reply[2], dict) else {}
+                return list(reply[1]), info
             if verdict == "_no_reply":
                 # the in-process shortcut's rendering of a withheld
                 # reply (injected drop): same replay the wire timeout

@@ -28,26 +28,49 @@ in steady state. :class:`InferenceEngine` renders that:
   property the failover drill's exactly-once/bit-identical acceptance
   check rests on.
 
+* **Versioned weights (live streaming).** The params/aux device copies
+  live in immutable per-version *stores*; :meth:`swap_weights` installs
+  a fresh version (same names/shapes/dtypes — so every AOT program is a
+  cache HIT, zero recompiles) and bumps the serving epoch atomically
+  between batches. A request's version is resolved ONCE at admission
+  and its whole batch dispatches against that store, so every request
+  is answered by exactly one coherent version — never a half-swapped
+  table. Stores are retained keep-last-K plus whatever is stable /
+  canary / pinned, which is what makes bit-exact rollback to a pinned
+  version an O(1) route change (docs/serving.md "Rollout & weight
+  streaming").
+
 The engine itself is stateless across calls and thread-safe for
 concurrent :meth:`predict` calls; the serving batcher drives it from
 one flush thread.
 """
 from __future__ import annotations
 
+import os
 import threading
 import warnings
+import zlib
 
 import numpy as _np
 import jax
 import jax.numpy as jnp
 
 from ..base import canonical_dtype
+from ..checkpoint import weight_digest
 from ..context import cpu
 from ..module.fused import ProgramCache
 from ..symbol import eval_graph
 from ..ops.registry import rng_scope
 
 __all__ = ["InferenceEngine", "parse_buckets", "parse_shape_spec"]
+
+
+def version_keep():
+    """MXTPU_SERVE_VERSION_KEEP: in-memory weight versions retained
+    beyond the live set (stable/canary/pinned) — enough history that a
+    request admitted against version v is still answerable after the
+    next swap lands mid-batch."""
+    return max(1, int(os.environ.get("MXTPU_SERVE_VERSION_KEEP", "2")))
 
 
 def parse_buckets(spec):
@@ -84,7 +107,7 @@ class InferenceEngine:
 
     def __init__(self, symbol, arg_params, aux_params, data_shapes,
                  buckets=(1, 2, 4, 8, 16, 32), ctx=None, dtype="float32",
-                 warm=True):
+                 warm=True, version=0):
         self._symbol = symbol
         self._ctx = ctx if ctx is not None else cpu()
         self._dev = self._ctx.jax_device()
@@ -114,19 +137,44 @@ class InferenceEngine:
                                   if n not in self._data_names
                                   and n not in arg_params)
         self._aux_names = tuple(aux_names)
-        # one shared device-resident copy of params/aux for all buckets
-        self._param_vals = tuple(
-            jax.device_put(arg_params[n].asnumpy(), self._dev)
+        # one shared device-resident copy of params/aux for all buckets,
+        # per weight VERSION: an immutable store tuple swap_weights
+        # replaces wholesale (programs take params as runtime arguments,
+        # so a same-shape swap is always a program-cache hit)
+        param_vals = tuple(
+            jax.device_put(self._host_array(arg_params[n]), self._dev)
             for n in self._param_names)
-        self._aux_vals = tuple(
-            jax.device_put(aux_params[n].asnumpy(), self._dev)
+        aux_vals = tuple(
+            jax.device_put(self._host_array(aux_params[n]), self._dev)
             for n in self._aux_names)
+        self._param_shapes = tuple((v.shape, _np.dtype(v.dtype))
+                                   for v in param_vals)
+        self._aux_shapes = tuple((v.shape, _np.dtype(v.dtype))
+                                 for v in aux_vals)
+        self._store_lock = threading.Lock()
+        v0 = int(version)
+        self._stores = {v0: (param_vals, aux_vals, None)}
+        self._latest = v0          # swap watermark (stream dedupe)
+        self._stable = v0          # the version requests default to
+        self._canary = None        # (version, fraction) under rollout
+        self._pinned = None        # rollback anchor: stable is frozen
+        self._serve_epoch = 0      # bumps on every swap/policy change
+        self._keep = version_keep()
+        # back-compat aliases: always the STABLE store's tuples
+        self._param_vals = param_vals
+        self._aux_vals = aux_vals
         self.cache = ProgramCache()
         self._build_lock = threading.Lock()
         self._stats_lock = threading.Lock()
-        self._stats = {"predicts": 0, "rows": 0, "pad_rows": 0}
+        self._stats = {"predicts": 0, "rows": 0, "pad_rows": 0,
+                       "swaps": 0, "swaps_refused": 0,
+                       "version_rebinds": 0}
         if warm:
             self.warm()
+
+    @staticmethod
+    def _host_array(v):
+        return v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -162,7 +210,280 @@ class InferenceEngine:
         with self._stats_lock:
             out = dict(self._stats)
         out.update(self.cache.stats())
+        out.update(self.version_state())
         return out
+
+    # -- versioned weights -------------------------------------------------
+    def version_state(self):
+        """The rollout-visible version picture (rides hello/stats)."""
+        with self._store_lock:
+            return {"version": self._stable,
+                    "latest": self._latest,
+                    "versions": sorted(self._stores),
+                    "serve_epoch": self._serve_epoch,
+                    "canary": list(self._canary) if self._canary
+                    else None,
+                    "pinned": self._pinned}
+
+    def current_params(self, version=None):
+        """Host copies of a resident version's params (stable by
+        default), name -> numpy — what a publisher-side drill mutates
+        into the next version."""
+        with self._store_lock:
+            v = self._stable if version is None else int(version)
+            store = self._stores[v]
+        return {n: _np.asarray(val) for n, val in
+                zip(self._param_names, store[0])}
+
+    def store_digest(self, version=None):
+        """The digest recorded (or computed on demand) for a resident
+        version's params — rollback's bit-identity evidence."""
+        with self._store_lock:
+            v = self._stable if version is None else int(version)
+            store = self._stores.get(v)
+        if store is None:
+            return None
+        if store[2] is not None:
+            return store[2]
+        return weight_digest({n: _np.asarray(val) for n, val in
+                              zip(self._param_names, store[0])})
+
+    def swap_weights(self, arg_params, aux_params=None, version=None,
+                     digest=None, activate=True):
+        """Install ``arg_params`` (dict name -> numpy/NDArray; must
+        cover every checkpoint parameter with identical shapes/dtypes —
+        a mismatch would force a retrace and is refused) as a fresh
+        weight version, device_put into a NEW store; the serving epoch
+        bumps atomically so in-flight batches keep their resolved
+        store and the NEXT batch reads the new one. Returns the
+        installed version, or None when refused (stale version — the
+        stream-replay dedupe — or a half table). ``digest`` (the
+        publisher's :func:`~mxtpu.checkpoint.weight_digest`) is
+        verified against the incoming bytes before anything swaps."""
+        with self._store_lock:
+            v = self._latest + 1 if version is None else int(version)
+            if v <= self._latest:
+                self._note("swaps_refused")
+                return None
+        host = {}
+        for name in self._param_names:
+            if name not in arg_params:
+                # never a half-swapped table: all params or nothing
+                self._note("swaps_refused")
+                return None
+            host[name] = _np.ascontiguousarray(
+                self._host_array(arg_params[name]))
+        for name, (shape, dtype) in zip(self._param_names,
+                                        self._param_shapes):
+            a = host[name]
+            if tuple(a.shape) != tuple(shape):
+                raise ValueError(
+                    "weight version %d: param %r has shape %r, the "
+                    "compiled programs take %r — a swap must never "
+                    "retrace" % (v, name, tuple(a.shape), tuple(shape)))
+            if a.dtype != dtype:
+                host[name] = a.astype(dtype)
+        if digest is not None:
+            got = weight_digest(host)
+            if got != digest:
+                raise ValueError(
+                    "weight version %d failed digest verification "
+                    "(%s != %s) — refusing to serve corrupt params"
+                    % (v, got[:12], digest[:12]))
+        param_vals = tuple(jax.device_put(host[n], self._dev)
+                           for n in self._param_names)
+        if aux_params is not None:
+            aux_vals = tuple(
+                jax.device_put(_np.ascontiguousarray(
+                    self._host_array(aux_params[n])).astype(dt),
+                    self._dev)
+                for n, (_s, dt) in zip(self._aux_names,
+                                       self._aux_shapes))
+        else:
+            aux_vals = None
+        with self._store_lock:
+            if v <= self._latest:          # raced with a newer swap
+                self._note("swaps_refused")
+                return None
+            if aux_vals is None:
+                # aux (BN running stats) not republished: carry the
+                # latest store's forward
+                aux_vals = self._stores[self._latest][1]
+            self._stores[v] = (param_vals, aux_vals,
+                               digest or weight_digest(host))
+            self._latest = v
+            if activate and self._pinned is None:
+                self._stable = v
+                self._param_vals = param_vals
+                self._aux_vals = aux_vals
+            self._serve_epoch += 1
+            self._gc_stores_locked()
+            self._note("swaps")
+        return v
+
+    def _note(self, field):
+        with self._stats_lock:
+            self._stats[field] += 1
+
+    def _gc_stores_locked(self):
+        live = {self._stable, self._latest, self._pinned}
+        if self._canary is not None:
+            live.add(self._canary[0])
+        keep = sorted(self._stores)[-self._keep:]
+        for v in [v for v in self._stores
+                  if v not in live and v not in keep]:
+            del self._stores[v]
+
+    def set_canary(self, version, fraction):
+        """Route ``fraction`` of requests (deterministic per request
+        id) to ``version``; the rest stay on stable."""
+        fraction = float(fraction)
+        with self._store_lock:
+            if version is not None and int(version) not in self._stores:
+                raise ValueError("canary version %r is not resident "
+                                 "(have %r)" % (version,
+                                                sorted(self._stores)))
+            self._canary = (int(version), fraction) \
+                if version is not None else None
+            self._serve_epoch += 1
+
+    def promote(self, version=None):
+        """Make ``version`` (default: the canary) the stable route and
+        end the rollout — the canary's traffic share becomes 100%."""
+        with self._store_lock:
+            if version is None and self._canary is not None:
+                version = self._canary[0]
+            if version is None:
+                version = self._latest
+            version = int(version)
+            if version not in self._stores:
+                raise ValueError("cannot promote non-resident version "
+                                 "%d" % version)
+            self._stable = version
+            store = self._stores[version]
+            self._param_vals, self._aux_vals = store[0], store[1]
+            self._canary = None
+            self._pinned = None
+            self._serve_epoch += 1
+            return version
+
+    def abort_canary(self):
+        with self._store_lock:
+            self._canary = None
+            self._serve_epoch += 1
+
+    def pin(self, version):
+        """Freeze stable on ``version`` (must be resident): streamed
+        swaps keep landing as resident stores but stop auto-activating
+        — the engine half of bit-exact rollback."""
+        with self._store_lock:
+            version = int(version)
+            if version not in self._stores:
+                raise ValueError("cannot pin non-resident version %d "
+                                 "(have %r)" % (version,
+                                                sorted(self._stores)))
+            self._pinned = version
+            self._stable = version
+            store = self._stores[version]
+            self._param_vals, self._aux_vals = store[0], store[1]
+            self._canary = None
+            self._serve_epoch += 1
+
+    def unpin(self):
+        with self._store_lock:
+            self._pinned = None
+            self._serve_epoch += 1
+
+    def load_store(self, arg_params, version, digest=None,
+                   aux_params=None):
+        """Install a HISTORICAL version as a resident store WITHOUT
+        touching routing: unlike :meth:`swap_weights` this bypasses the
+        monotone version watermark (canary/rollback deliberately serve
+        older versions) and activates nothing — pair with
+        :meth:`set_canary`/:meth:`pin`/:meth:`promote`. Verifies
+        ``digest`` against the restored bytes; raises on any mismatch,
+        never half-installs."""
+        version = int(version)
+        host = {}
+        for name in self._param_names:
+            if name not in arg_params:
+                raise ValueError(
+                    "weight version %d is missing param %r — "
+                    "refusing a half table" % (version, name))
+            host[name] = _np.ascontiguousarray(
+                self._host_array(arg_params[name]))
+        for name, (shape, dtype) in zip(self._param_names,
+                                        self._param_shapes):
+            if tuple(host[name].shape) != tuple(shape):
+                raise ValueError(
+                    "weight version %d: param %r has shape %r, want "
+                    "%r" % (version, name, tuple(host[name].shape),
+                            tuple(shape)))
+            if host[name].dtype != dtype:
+                host[name] = host[name].astype(dtype)
+        if digest is not None and weight_digest(host) != digest:
+            raise ValueError(
+                "weight version %d failed digest verification — "
+                "the restored snapshot is not the recorded bits"
+                % version)
+        param_vals = tuple(jax.device_put(host[n], self._dev)
+                           for n in self._param_names)
+        aux_vals = None
+        if aux_params is not None:
+            aux_vals = tuple(
+                jax.device_put(_np.ascontiguousarray(
+                    self._host_array(aux_params[n])).astype(dt),
+                    self._dev)
+                for n, (_s, dt) in zip(self._aux_names,
+                                       self._aux_shapes))
+        with self._store_lock:
+            if aux_vals is None:
+                aux_vals = self._stores[self._stable][1]
+            self._stores[version] = (param_vals, aux_vals,
+                                     digest or weight_digest(host))
+            self._serve_epoch += 1
+        return version
+
+    def restore_version(self, arg_params, aux_params=None, version=0,
+                        digest=None):
+        """The rollback composite: :meth:`load_store` + :meth:`pin` —
+        install the historical version (digest-verified) and freeze
+        routing on it."""
+        version = self.load_store(arg_params, version, digest=digest,
+                                  aux_params=aux_params)
+        self.pin(version)
+        return version
+
+    def route_version(self, rid):
+        """Resolve which weight version answers request ``rid`` —
+        called ONCE at admission, so the whole batch a request joins
+        dispatches against one coherent store. Deterministic: the
+        canary split hashes the request id, never a clock or RNG."""
+        with self._store_lock:
+            if self._canary is None:
+                return self._stable
+            version, fraction = self._canary
+            if zlib.crc32(str(rid).encode()) % 10000 < fraction * 10000:
+                return version
+            return self._stable
+
+    def _resolve_store(self, version):
+        """The (params, aux, answered_version) for ``version``; a
+        version GC'd between admission and dispatch rebinds to stable
+        (counted — the batch is still answered by ONE coherent
+        version)."""
+        with self._store_lock:
+            v = self._stable if version is None else int(version)
+            store = self._stores.get(v)
+            if store is None:
+                v = self._stable
+                store = self._stores[v]
+                rebind = True
+            else:
+                rebind = False
+        if rebind:
+            self._note("version_rebinds")
+        return store[0], store[1], v
 
     def check_rows(self, arrays):
         """Validate one request payload (a list/tuple of numpy arrays,
@@ -283,13 +604,24 @@ class InferenceEngine:
 
     # -- execution ---------------------------------------------------------
     def predict(self, arrays, rows=None):
-        """Run one (possibly coalesced) batch: pad ``arrays`` into the
-        smallest bucket, dispatch the AOT program, return the outputs
-        as numpy arrays sliced back to ``rows``."""
+        """Run one (possibly coalesced) batch against the STABLE
+        version: pad ``arrays`` into the smallest bucket, dispatch the
+        AOT program, return the outputs as numpy arrays sliced back to
+        ``rows``."""
+        outs, _v = self.predict_versioned(arrays, rows=rows)
+        return outs
+
+    def predict_versioned(self, arrays, rows=None, version=None):
+        """The version-routed form the batcher drives: dispatch against
+        the store of ``version`` (None = stable) and return
+        ``(outputs, answered_version)``. The store triple is read once,
+        so the whole batch is answered by exactly one coherent weight
+        version even when a swap lands concurrently."""
         if rows is None:
             rows = self.check_rows(arrays)
         bucket = self.bucket_for(rows)
         program = self.program(bucket)
+        param_vals, aux_vals, answered = self._resolve_store(version)
         data_vals = []
         for name, arr in zip(self._data_names, arrays):
             arr = _np.ascontiguousarray(arr, dtype=self._dtype)
@@ -299,10 +631,9 @@ class InferenceEngine:
                 padded[:rows] = arr
                 arr = padded
             data_vals.append(jax.device_put(arr, self._dev))
-        outs = program(tuple(data_vals), self._param_vals,
-                       self._aux_vals)
+        outs = program(tuple(data_vals), param_vals, aux_vals)
         with self._stats_lock:
             self._stats["predicts"] += 1
             self._stats["rows"] += rows
             self._stats["pad_rows"] += bucket - rows
-        return [_np.asarray(o)[:rows] for o in outs]
+        return [_np.asarray(o)[:rows] for o in outs], answered

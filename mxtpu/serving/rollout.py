@@ -1,0 +1,504 @@
+"""Rollout & weight streaming: close the train→serve loop.
+
+PR 8 built the serving fleet and PR 10 the fused trainer, but a model
+still travelled between them as a frozen file. This module is the
+continuous-deployment surface over both (ROADMAP item 2;
+docs/serving.md "Rollout & weight streaming"):
+
+* :class:`WeightPublisher` — the trainer side. ``publish(params)``
+  writes one versioned, digest-tagged snapshot through
+  :class:`~mxtpu.checkpoint.CheckpointManager` (atomic rename, CRC
+  tags, keep-last-K retention that never collects a pinned version).
+  The ``publish.snapshot`` fault point fires BEFORE anything is
+  written, so a crashed/severed publish loses the version cleanly —
+  subscribers only ever see complete snapshots.
+* :class:`WeightSync` — the serving side. One bounded thread per
+  replica that follows a weight source and lands fresh versions
+  through ``ModelServer.swap_weights`` (the ``serve.swap`` choke
+  point). Two sources, same contract as the PR-4 ``_ReplStream``
+  pattern — totally-ordered version records, a watermark that refuses
+  replays, catch-up on reconnect by simply asking with the watermark:
+
+  - **snapshot polling** (``MXTPU_SERVE_WEIGHT_POLL`` over the
+    publisher's directory): newest intact step wins, a corrupt newest
+    falls back to the previous retained one;
+  - **parameter-server streaming**: long-poll the ``weights`` wire op
+    of the PS fleet (trainers drive ``kv.publish_version()``); the
+    ``weight_sub`` registration makes subscriber watermarks visible in
+    ``kv.stats()['weight_stream']``.
+
+  ``catch_up()`` applies the current version synchronously — what a
+  respawned replica runs BEFORE admitting (``tools/launch.py
+  --serve-respawn``), so a rejoin never serves stale weights.
+* :class:`RolloutController` — the operator side, fleet-wide over the
+  serving admin wire ops: canary / A-B traffic splits
+  (deterministic per-request-id hash, resolved at admission so every
+  request is answered by one coherent version), promote/abort
+  verdicts from the per-version response/error/latency counters,
+  zero-downtime hot-swap (drain → swap → resume, one replica at a
+  time, clients steered to peers by the ``draining`` verdict), and
+  bit-exact rollback to a pinned version (restored from the versioned
+  snapshot, verified against the digest recorded at publish).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import uuid
+
+import numpy as _np
+
+from .. import fault as _fault
+from .. import kvstore_async as _ka
+from ..checkpoint import CheckpointCorrupt, CheckpointManager, \
+    weight_digest
+
+__all__ = ["WeightPublisher", "WeightSync", "RolloutController",
+           "weight_poll_interval", "weight_keep"]
+
+_log = logging.getLogger(__name__)
+
+
+def weight_poll_interval():
+    """MXTPU_SERVE_WEIGHT_POLL: seconds between a replica's weight-sync
+    ticks (snapshot-dir scan or PS long-poll round; default 0.5)."""
+    return float(os.environ.get("MXTPU_SERVE_WEIGHT_POLL", "0.5"))
+
+
+def weight_keep():
+    """MXTPU_SERVE_WEIGHT_KEEP: versioned weight snapshots the
+    publisher retains on disk beyond the pinned ones (default 5)."""
+    return int(os.environ.get("MXTPU_SERVE_WEIGHT_KEEP", "5"))
+
+
+class WeightPublisher:
+    """Trainer-side versioned weight publishing into a snapshot dir."""
+
+    def __init__(self, directory, keep=None):
+        self._ckpt = CheckpointManager(
+            directory, max_to_keep=weight_keep() if keep is None
+            else int(keep), async_save=False, use_orbax=False)
+        latest = self._ckpt.latest_step()
+        self._version = 0 if latest is None else int(latest)
+        self._lock = threading.Lock()
+        self._c = {"published": 0, "dropped": 0}
+
+    @property
+    def directory(self):
+        return self._ckpt.directory
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    def publish(self, params, version=None, pin=False, meta=None):
+        """Publish ``params`` (dict name -> numpy/NDArray) as the next
+        weight version: digest-tag, atomic snapshot, optional pin.
+        Returns ``{"version", "digest"}`` — or None when the
+        ``publish.snapshot`` fault point dropped the publish (nothing
+        was written; subscribers keep the last complete version)."""
+        with self._lock:
+            v = self._version + 1 if version is None else int(version)
+            if v <= self._version and self._version:
+                raise ValueError(
+                    "publish version %d is not past the watermark %d"
+                    % (v, self._version))
+        # the crash-the-trainer-mid-publish drill point: drop/sever/
+        # kill here lose the version BEFORE any byte hits disk
+        act = _fault.fire("publish.snapshot", op="publish",
+                          key="v%d" % v)
+        if act == "drop":
+            with self._lock:
+                self._c["dropped"] += 1
+            return None
+        host = {}
+        for name, val in params.items():
+            if hasattr(val, "asnumpy"):
+                val = val.asnumpy()
+            host[str(name)] = _np.ascontiguousarray(val)
+        digest = weight_digest(host)
+        self._ckpt.save(v, host, metadata=dict(meta or {},
+                                               digest=digest))
+        if pin:
+            self._ckpt.pin(v)
+        with self._lock:
+            self._version = max(self._version, v)
+            self._c["published"] += 1
+        return {"version": v, "digest": digest}
+
+    def pin(self, version):
+        self._ckpt.pin(version)
+
+    def unpin(self, version):
+        self._ckpt.unpin(version)
+
+    def digest(self, version):
+        return self._ckpt.digest(version)
+
+    def versions(self):
+        return self._ckpt.all_steps()
+
+    def stats(self):
+        with self._lock:
+            return dict(self._c, version=self._version,
+                        retained=len(self._ckpt.all_steps()),
+                        pinned=sorted(self._ckpt.pins()))
+
+
+class WeightSync:
+    """Serving-side weight subscriber: follow a source, swap versions
+    into a :class:`~mxtpu.serving.server.ModelServer` menu."""
+
+    def __init__(self, server, model=None, weight_dir=None,
+                 kv_addrs=None, token=None, poll=None):
+        if weight_dir is None and not kv_addrs:
+            raise ValueError("WeightSync needs weight_dir= (snapshot "
+                             "polling) or kv_addrs= (PS streaming)")
+        self._server = server
+        self._model = model
+        self._poll = weight_poll_interval() if poll is None \
+            else float(poll)
+        self._token = token if token is not None \
+            else os.environ.get("MXTPU_PS_TOKEN") or None
+        self._ckpt = None
+        if weight_dir is not None:
+            self._ckpt = CheckpointManager(
+                weight_dir, max_to_keep=0, async_save=False,
+                use_orbax=False)
+        if isinstance(kv_addrs, str):
+            kv_addrs = [a.strip() for a in kv_addrs.split(",")
+                        if a.strip()]
+        self._kv_addrs = list(kv_addrs or [])
+        self._conns = {}
+        self._origin = "serve-%s" % uuid.uuid4().hex[:8]
+        # the subscription watermark: versions at or below are refused
+        # (replay dedupe), catch-up after a reconnect is just asking
+        # with this value — the _ReplStream discipline on weights
+        self._have = self._current_engine_version()
+        self._lock = threading.Lock()
+        self._c = {"applied": 0, "skipped_stale": 0, "dropped": 0,
+                   "corrupt_skipped": 0, "skew_skipped": 0, "errors": 0}
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- plumbing ----------------------------------------------------------
+    def _current_engine_version(self):
+        entry = self._server._entry_for(self._model)
+        state = entry.engine.version_state()
+        return int(state["latest"])
+
+    def _conn(self, addr):
+        conn = self._conns.get(addr)
+        if conn is None:
+            conn = _ka._ServerConn(addr, token=self._token, n_socks=1,
+                                   connect_timeout=30.0)
+            # registration: the server surfaces this subscriber's
+            # watermark (and lag) in stats()['weight_stream']
+            conn.request("weight_sub", self._origin, timeout=10.0)
+            self._conns[addr] = conn
+        return conn
+
+    # -- one sync round ----------------------------------------------------
+    def poll_once(self, wait_s=0.0):
+        """One source round: fetch-and-apply anything newer than the
+        watermark. Returns the newly applied version or None."""
+        if self._ckpt is not None:
+            return self._poll_snapshots()
+        return self._poll_kv(wait_s)
+
+    def _poll_snapshots(self):
+        steps = self._ckpt.all_steps()
+        for step in reversed(steps):
+            if step <= self._have:
+                return None
+            try:
+                tree = self._ckpt.restore_exact(step)
+            except CheckpointCorrupt:
+                # torn newest (publisher crashed mid-write would have
+                # been invisible thanks to the atomic rename, but disk
+                # rot happens): fall back to the previous retained one
+                with self._lock:
+                    self._c["corrupt_skipped"] += 1
+                continue
+            meta = (tree or {}).get("metadata") or {}
+            digest = meta.get("digest") if isinstance(meta, dict) \
+                else None
+            return self._apply(step, tree["params"], digest=digest)
+        return None
+
+    def _poll_kv(self, wait_s):
+        infos = []
+        for addr in self._kv_addrs:
+            reply = self._conn(addr).request(
+                "weights", self._origin, self._have, wait_s,
+                timeout=max(30.0, wait_s + 30.0))
+            infos.append(reply[1])
+        versions = sorted({int(i["version"]) for i in infos})
+        if versions[0] <= self._have:
+            return None
+        if len(versions) > 1:
+            # shards disagree mid-publish: wait for the fleet to
+            # converge rather than serving a cross-version mix
+            with self._lock:
+                self._c["skew_skipped"] += 1
+            return None
+        params = {}
+        for info in infos:
+            blobs = info.get("params")
+            if blobs is None:
+                return None
+            if info.get("digest") and \
+                    weight_digest(blobs) != info["digest"]:
+                with self._lock:
+                    self._c["errors"] += 1
+                _log.warning("weight version %d from the PS stream "
+                             "failed its digest — not swapping",
+                             versions[0])
+                return None
+            params.update(blobs)
+        digest = infos[0]["digest"] if len(infos) == 1 else \
+            weight_digest(params)
+        return self._apply(versions[0], params, digest=digest)
+
+    def _apply(self, version, params, digest=None):
+        try:
+            v = self._server.swap_weights(params, version=version,
+                                          digest=digest,
+                                          model=self._model)
+        except ValueError as e:
+            with self._lock:
+                self._c["errors"] += 1
+            _log.warning("weight version %d refused by the engine: %s",
+                         version, e)
+            return None
+        if v is not None:
+            with self._lock:
+                self._c["applied"] += 1
+                self._have = max(self._have, int(version))
+            return v
+        # None: either the engine already had it (advance the
+        # watermark) or the serve.swap fault dropped the record (leave
+        # the watermark so the next round re-delivers — catch-up)
+        if self._current_engine_version() >= int(version):
+            with self._lock:
+                self._c["skipped_stale"] += 1
+                self._have = max(self._have, int(version))
+        else:
+            with self._lock:
+                self._c["dropped"] += 1
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+    def catch_up(self, deadline_s=60.0):
+        """Apply the source's CURRENT version synchronously — run
+        BEFORE admitting (a respawned replica re-hellos only after
+        this), so a rejoining replica never answers from stale
+        weights. Bounded; returns the watermark."""
+        deadline = time.monotonic() + float(deadline_s)
+        while time.monotonic() < deadline:
+            try:
+                if self.poll_once(wait_s=0.0) is None:
+                    break
+            except (ConnectionError, RuntimeError, OSError) as e:
+                with self._lock:
+                    self._c["errors"] += 1
+                _log.warning("weight catch-up round failed: %s", e)
+                break
+        with self._lock:
+            return self._have
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="mxtpu-weight-sync")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once(wait_s=min(self._poll, 1.0)
+                               if self._kv_addrs else 0.0)
+            except (ConnectionError, RuntimeError, OSError) as e:
+                # a severed stream mid-record: count it, keep serving
+                # the last complete version, retry next tick (the
+                # watermark makes the retry an exact catch-up)
+                with self._lock:
+                    self._c["errors"] += 1
+                _log.debug("weight sync round failed: %s", e)
+            self._stop.wait(self._poll)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        for conn in self._conns.values():
+            conn.close()
+        self._conns = {}
+
+    def stats(self):
+        with self._lock:
+            out = dict(self._c)
+            out["version"] = self._have
+        out["source"] = "snapshots" if self._ckpt is not None else "kv"
+        return out
+
+
+class RolloutController:
+    """Operator surface: drive canary/promote/abort/rollback across a
+    serving replica set (the scriptable form of ``tools/launch.py
+    --rollout`` and ``python -m mxtpu.serving --admin``)."""
+
+    def __init__(self, addrs, model=None, token=None):
+        if isinstance(addrs, str):
+            addrs = [a.strip() for a in addrs.split(",") if a.strip()]
+        if not addrs:
+            raise ValueError("RolloutController needs replica addrs")
+        self._addrs = list(addrs)
+        self._model = model
+        self._token = token if token is not None \
+            else os.environ.get("MXTPU_PS_TOKEN") or None
+        self._conns = {}
+
+    def _conn(self, addr):
+        conn = self._conns.get(addr)
+        if conn is None:
+            conn = _ka._ServerConn(addr, token=self._token, n_socks=1,
+                                   connect_timeout=30.0)
+            self._conns[addr] = conn
+        return conn
+
+    def _fleet(self, *msg, timeout=60.0):
+        return {addr: self._conn(addr).request(*msg, timeout=timeout)[1]
+                for addr in self._addrs}
+
+    # -- primitives --------------------------------------------------------
+    def status(self):
+        return self._fleet("rollout", self._model, "status", None)
+
+    def canary(self, version, fraction):
+        """Split ``fraction`` of traffic onto ``version`` fleet-wide
+        (deterministic per request id — both a canary and, at 0.5, an
+        A/B experiment)."""
+        return self._fleet("rollout", self._model, "canary",
+                           {"version": int(version),
+                            "fraction": float(fraction)})
+
+    def promote(self, version=None):
+        return self._fleet("rollout", self._model, "promote",
+                           {"version": version})
+
+    def abort(self):
+        return self._fleet("rollout", self._model, "abort", None)
+
+    def pin(self, version):
+        return self._fleet("rollout", self._model, "pin",
+                           {"version": int(version)})
+
+    def unpin(self):
+        return self._fleet("rollout", self._model, "unpin", None)
+
+    def rollback(self, version):
+        """Bit-exact rollback fleet-wide: every replica restores the
+        pinned version (resident store or versioned snapshot), verifies
+        the recorded digest, and pins."""
+        return self._fleet("rollout", self._model, "rollback",
+                           {"version": int(version)})
+
+    def push_weights(self, params, version, aux=None, digest=None):
+        """Direct streaming: land ``version`` on every replica (the
+        publisher-to-replica path the CI drill uses)."""
+        host = {}
+        for name, val in params.items():
+            if hasattr(val, "asnumpy"):
+                val = val.asnumpy()
+            host[str(name)] = _np.ascontiguousarray(val)
+        if digest is None:
+            digest = weight_digest(host)
+        return self._fleet("weights_push", self._model, int(version),
+                           host, aux, digest)
+
+    def server_stats(self):
+        return self._fleet("stats")
+
+    # -- composite flows ---------------------------------------------------
+    def hot_swap(self, params, version, aux=None, digest=None,
+                 drain_timeout=15.0):
+        """Zero-downtime hot-swap via the existing drain verdict: one
+        replica at a time — drain (its clients steer to the peers),
+        swap the new version in, resume admissions. The fleet never
+        stops answering."""
+        out = {}
+        for addr in self._addrs:
+            conn = self._conn(addr)
+            conn.request("drain", drain_timeout, timeout=30.0)
+            deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < deadline:
+                pending = conn.request("ping", timeout=10.0)[1]
+                if not pending.get("pending"):
+                    break
+                time.sleep(0.02)
+            host = {n: (v.asnumpy() if hasattr(v, "asnumpy")
+                        else _np.ascontiguousarray(v))
+                    for n, v in params.items()}
+            reply = conn.request(
+                "weights_push", self._model, int(version), host, aux,
+                digest if digest is not None else weight_digest(host),
+                timeout=120.0)
+            conn.request("resume", timeout=30.0)
+            out[addr] = reply[1]
+        return out
+
+    def verdict(self, canary_version, stable_version=None,
+                min_responses=5, err_slack=0.01, latency_slack=2.0):
+        """Promote/abort verdict from the fleet's per-version evidence:
+        the canary must have answered ``min_responses`` (else
+        ``wait``), with an error ratio within ``err_slack`` of stable's
+        and mean latency within ``latency_slack``× stable's."""
+        agg = {}
+        for addr, stats in self.server_stats().items():
+            name = self._model or stats.get("model")
+            by_v = stats.get("models", {}).get(name, {}) \
+                .get("by_version", {})
+            for v, rec in by_v.items():
+                dst = agg.setdefault(int(v), {"responses": 0,
+                                              "errors": 0,
+                                              "lat_ms_sum": 0.0})
+                dst["responses"] += rec.get("responses", 0)
+                dst["errors"] += rec.get("errors", 0)
+                dst["lat_ms_sum"] += rec.get("lat_ms_sum", 0.0)
+
+        def _rates(v):
+            rec = agg.get(int(v), {"responses": 0, "errors": 0,
+                                   "lat_ms_sum": 0.0})
+            n = rec["responses"]
+            total = n + rec["errors"]
+            return (n, rec["errors"] / total if total else 0.0,
+                    rec["lat_ms_sum"] / n if n else 0.0)
+
+        if stable_version is None:
+            status = self.status()
+            stable_version = next(iter(status.values()))["weights"][
+                "version"]
+        c_n, c_err, c_lat = _rates(canary_version)
+        s_n, s_err, s_lat = _rates(stable_version)
+        evidence = {"canary": {"version": int(canary_version),
+                               "responses": c_n, "err_ratio": c_err,
+                               "lat_ms_mean": c_lat},
+                    "stable": {"version": int(stable_version),
+                               "responses": s_n, "err_ratio": s_err,
+                               "lat_ms_mean": s_lat}}
+        if c_n < min_responses:
+            return {"verdict": "wait", "evidence": evidence}
+        healthy = c_err <= s_err + err_slack and (
+            s_lat <= 0.0 or c_lat <= latency_slack * s_lat)
+        return {"verdict": "promote" if healthy else "abort",
+                "evidence": evidence}
+
+    def close(self):
+        for conn in self._conns.values():
+            conn.close()
+        self._conns = {}

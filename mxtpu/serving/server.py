@@ -50,6 +50,34 @@ from .batcher import DynamicBatcher
 __all__ = ["ModelServer", "queue_depth", "batch_deadline_ms",
            "default_budget_ms"]
 
+
+class _ModelEntry:
+    """One hosted (model, versioned-weights) menu: its engine, its own
+    dynamic batcher (versions never coalesce across models), and the
+    per-version response/latency counters the rollout verdict reads."""
+
+    __slots__ = ("name", "engine", "batcher", "lock", "by_version")
+
+    def __init__(self, name, engine, batcher):
+        self.name = name
+        self.engine = engine
+        self.batcher = batcher
+        self.lock = threading.Lock()
+        self.by_version = {}    # version -> responses/errors/latency
+
+    def note(self, version, field, lat_ms=None):
+        with self.lock:
+            rec = self.by_version.setdefault(
+                version, {"responses": 0, "errors": 0, "expired": 0,
+                          "lat_ms_sum": 0.0})
+            rec[field] += 1
+            if lat_ms is not None:
+                rec["lat_ms_sum"] += lat_ms
+
+    def version_stats(self):
+        with self.lock:
+            return {v: dict(rec) for v, rec in self.by_version.items()}
+
 _log = logging.getLogger(__name__)
 
 # withheld reply sentinel: the wire handler sends nothing (the client's
@@ -181,8 +209,8 @@ class ModelServer:
 
     def __init__(self, engine, port=0, host="127.0.0.1", token=None,
                  replicas=None, model_name="model", queue_depth_=None,
-                 batch_deadline_ms_=None, default_budget_ms_=None):
-        self._engine = engine
+                 batch_deadline_ms_=None, default_budget_ms_=None,
+                 weight_dir=None):
         self._model_name = model_name
         self._tcp = _ka._TCPServer((host, port), _ServeHandler)
         self._tcp.owner = self
@@ -204,13 +232,31 @@ class ModelServer:
             if batch_deadline_ms_ is None else float(batch_deadline_ms_)
         self._budget_ms = default_budget_ms() \
             if default_budget_ms_ is None else float(default_budget_ms_)
-        self._batcher = DynamicBatcher(engine, self._depth,
-                                       self._deadline_ms, server=self)
+        # N hosted (model, version) menus; the ctor engine is the
+        # default model every 4-tuple predict frame routes to
+        self._models = {}
+        self._models_lock = threading.Lock()
+        self._models[model_name] = _ModelEntry(
+            model_name, engine,
+            DynamicBatcher(engine, self._depth, self._deadline_ms,
+                           server=self))
+        # versioned weight snapshots (rollback source): the replica
+        # reads the SAME directory the publisher writes
+        if weight_dir is None:
+            weight_dir = os.environ.get("MXTPU_SERVE_WEIGHT_DIR") or None
+        self._weight_dir = weight_dir
+        self._weight_ckpt = None
+        if weight_dir:
+            from ..checkpoint import CheckpointManager
+            self._weight_ckpt = CheckpointManager(
+                weight_dir, max_to_keep=0, async_save=False,
+                use_orbax=False)
         self._draining = False
         self._c_lock = threading.Lock()
         self._c = {"requests": 0, "responses": 0, "shed_overloaded": 0,
                    "shed_draining": 0, "expired": 0, "dropped": 0,
-                   "dup_requests": 0, "errors": 0}
+                   "dup_requests": 0, "errors": 0, "swaps": 0,
+                   "swaps_dropped": 0, "rollbacks": 0}
         # request-id dedupe window (observability, not correctness:
         # predict is pure, a replay recomputes the same bits) — bounded
         self._seen_rids = collections.OrderedDict()
@@ -225,8 +271,42 @@ class ModelServer:
         h, p = self._tcp.server_address
         return "%s:%d" % (h, p)
 
+    @property
+    def _engine(self):
+        """The default model's engine (single-model back-compat)."""
+        return self._models[self._model_name].engine
+
+    @property
+    def _batcher(self):
+        return self._models[self._model_name].batcher
+
+    def _entries(self):
+        with self._models_lock:
+            return list(self._models.values())
+
+    def _entry_for(self, model):
+        name = self._model_name if model is None else model
+        with self._models_lock:
+            return self._models.get(name)
+
+    def add_model(self, name, engine):
+        """Host another (model, versioned-weights) menu next to the
+        default one; clients route with ``predict(..., model=name)``.
+        The new menu gets its own batcher, so its versions never
+        coalesce with another model's batches."""
+        with self._models_lock:
+            if name in self._models:
+                raise ValueError("model %r is already hosted" % (name,))
+            self._models[name] = _ModelEntry(
+                name, engine,
+                DynamicBatcher(engine, self._depth, self._deadline_ms,
+                               server=self))
+        if self._thread is not None:
+            engine.warm()
+
     def start(self):
-        self._engine.warm()
+        for entry in self._entries():
+            entry.engine.warm()
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, daemon=True,
             name="mxtpu-serve-listener")
@@ -239,12 +319,29 @@ class ModelServer:
     def drain(self, timeout=30.0):
         """Graceful phase: refuse new work, flush admitted work."""
         self._draining = True
-        return self._batcher.drain(timeout=timeout)
+        ok = True
+        for entry in self._entries():
+            ok = entry.batcher.drain(timeout=timeout) and ok
+        return ok
+
+    def resume(self):
+        """Re-open admissions after a drain — the second half of the
+        zero-downtime hot-swap dance (drain → swap weights → resume):
+        drained batchers are replaced wholesale (their flush threads
+        exited), then the draining verdict stops."""
+        for entry in self._entries():
+            if entry.batcher._stopped:
+                entry.batcher = DynamicBatcher(
+                    entry.engine, self._depth, self._deadline_ms,
+                    server=self)
+        self._draining = False
+        return True
 
     def stop(self):
         self._draining = True
         self._tcp.dying = True
-        self._batcher.stop()
+        for entry in self._entries():
+            entry.batcher.stop()
         with _ka._LOCAL_GUARD:
             if _ka._LOCAL_SERVERS.get(self.address) is self:
                 del _ka._LOCAL_SERVERS[self.address]
@@ -289,7 +386,7 @@ class ModelServer:
         with self._c_lock:
             self._c[field] += n
 
-    def _account_reply(self, reply):
+    def _account_reply(self, reply, entry=None, req=None, arrival=None):
         with self._c_lock:
             if reply[0] == "ok":
                 self._c["responses"] += 1
@@ -297,16 +394,34 @@ class ModelServer:
                 self._c["expired"] += 1
             else:
                 self._c["errors"] += 1
+        if entry is None or req is None:
+            return
+        # per-(model, version) accounting — what the rollout verdict
+        # compares canary vs stable on
+        if reply[0] == "ok":
+            v = reply[2].get("version") if len(reply) > 2 and \
+                isinstance(reply[2], dict) else req.version
+            lat = None if arrival is None \
+                else (time.monotonic() - arrival) * 1e3
+            entry.note(v, "responses", lat_ms=lat)
+        elif reply[0] == "expired":
+            entry.note(req.version, "expired")
+        else:
+            entry.note(req.version, "errors")
 
     def _admit(self, msg):
         """Admission control for one ``("predict", rid, arrays,
-        budget_ms)`` frame. Returns an immediate verdict tuple
+        budget_ms[, model])`` frame. Returns an immediate verdict tuple
         (shed/draining/err), ``_NO_REPLY`` (injected drop), or the
         parked :class:`~mxtpu.serving.batcher.Request` whose terminal
         reply arrives at batch flush. rid is the client's (origin, seq)
         identity — a failover replay carries the ORIGINAL rid, which is
-        what the exactly-once accounting in the drills keys on."""
-        _, rid, arrays, budget_ms = msg
+        what the exactly-once accounting in the drills keys on. The
+        request's weight version is resolved HERE (stable, or the
+        canary split hashed on rid) so its whole batch answers from
+        one coherent store."""
+        rid, arrays, budget_ms = msg[1], msg[2], msg[3]
+        model = msg[4] if len(msg) > 4 else None
         arrival = time.monotonic()
         self._bump("requests")
         self._note_rid(rid)
@@ -321,8 +436,13 @@ class ModelServer:
         if self._draining or self._tcp.dying:
             self._bump("shed_draining")
             return ("draining", {"replicas": self._replicas})
+        entry = self._entry_for(model)
+        if entry is None:
+            self._bump("errors")
+            return ("err", "unknown model %r (hosting %r)"
+                    % (model, sorted(self._models)))
         try:
-            rows = self._engine.check_rows(arrays)
+            rows = entry.engine.check_rows(arrays)
         except ValueError as e:
             self._bump("errors")
             return ("err", "bad predict payload: %s" % e)
@@ -331,15 +451,90 @@ class ModelServer:
         # the park bound: budget + batch window + a flush allowance (an
         # injected mid-batch kill resolves every parked request, so the
         # bound only matters for genuine flusher bugs)
-        req = self._batcher.submit(
+        req = entry.batcher.submit(
             rid, arrays, rows, deadline,
             wait_bound=(budget / 1000.0 + self._deadline_ms / 1000.0
-                        + _FLUSH_GRACE))
+                        + _FLUSH_GRACE),
+            version=entry.engine.route_version(rid))
         if isinstance(req, tuple):          # shed verdict, not parked
             self._bump("shed_overloaded")
             return req
-        req.on_resolve(self._account_reply)
+        req.on_resolve(lambda reply, e=entry, r=req, a=arrival:
+                       self._account_reply(reply, e, r, a))
         return req
+
+    # -- live weight deployment (docs/serving.md "Rollout & weight
+    # streaming") ----------------------------------------------------------
+    def swap_weights(self, arg_params, aux_params=None, version=None,
+                     digest=None, model=None):
+        """Install one streamed weight version into a hosted model —
+        the single choke point every weight source (repl-stream
+        subscriber, snapshot poller, ``weights_push`` wire op) goes
+        through, so the ``serve.swap`` fault point covers them all.
+        Returns the installed version, or None when the record was
+        dropped/refused (the replica keeps answering from the last
+        complete version)."""
+        entry = self._entry_for(model)
+        if entry is None:
+            raise ValueError("unknown model %r (hosting %r)"
+                             % (model, sorted(self._models)))
+        # mid-swap fault hook: drop loses THIS version record (the next
+        # one lands normally), kill is the kill-replica-mid-swap drill
+        act = _fault.fire("serve.swap", op="swap",
+                          key="v%s" % (version,), server=self)
+        if act == "drop":
+            self._bump("swaps_dropped")
+            return None
+        v = entry.engine.swap_weights(arg_params, aux_params,
+                                      version=version, digest=digest)
+        if v is not None:
+            self._bump("swaps")
+        return v
+
+    def _ensure_resident(self, entry, version):
+        """Make ``version`` a resident store (restore it from the
+        versioned weight snapshot when it aged out of memory), digest-
+        verified either way. Returns the restore source."""
+        version = int(version)
+        recorded = self._weight_ckpt.digest(version) \
+            if self._weight_ckpt is not None else None
+        state = entry.engine.version_state()
+        if version in state["versions"]:
+            if recorded is not None and \
+                    entry.engine.store_digest(version) != recorded:
+                raise ValueError(
+                    "resident version %d does not match its recorded "
+                    "digest — refusing to route to corrupt weights"
+                    % version)
+            return "resident"
+        if self._weight_ckpt is None:
+            raise ValueError(
+                "version %d is not resident and no weight dir is "
+                "configured (MXTPU_SERVE_WEIGHT_DIR)" % version)
+        tree = self._weight_ckpt.restore_exact(version)
+        if tree is None:
+            raise ValueError("version %d has no retained snapshot "
+                             "in %s" % (version, self._weight_dir))
+        entry.engine.load_store(tree["params"], version,
+                                digest=recorded)
+        return "snapshot"
+
+    def rollback(self, version, model=None):
+        """Bit-exact rollback: route back to ``version`` — resident
+        store when retained, else restored from the versioned weight
+        snapshot (``MXTPU_SERVE_WEIGHT_DIR``) — verified against the
+        digest the publisher RECORDED, then pinned (streamed swaps
+        keep landing but stop auto-activating until unpinned)."""
+        entry = self._entry_for(model)
+        if entry is None:
+            raise ValueError("unknown model %r (hosting %r)"
+                             % (model, sorted(self._models)))
+        version = int(version)
+        src = self._ensure_resident(entry, version)
+        entry.engine.pin(version)
+        self._bump("rollbacks")
+        return {"version": version, "source": src,
+                "digest": entry.engine.store_digest(version)}
 
     def _do_predict(self, msg):
         """Blocking form for the in-process shortcut (each caller is
@@ -352,44 +547,113 @@ class ModelServer:
     def stats(self):
         with self._c_lock:
             counters = dict(self._c)
+        models = {}
+        for entry in self._entries():
+            models[entry.name] = {
+                "engine": entry.engine.stats(),
+                "batcher": entry.batcher.stats(),
+                "weights": entry.engine.version_state(),
+                "by_version": entry.version_stats()}
         return {"address": self.address, "model": self._model_name,
                 "draining": self._draining, "replicas": self._replicas,
                 "queue_depth": self._depth,
                 "batch_deadline_ms": self._deadline_ms,
                 "counters": counters,
                 "batcher": self._batcher.stats(),
-                "engine": self._engine.stats()}
+                "engine": self._engine.stats(),
+                "models": models}
 
     def _dispatch(self, msg):
         cmd = msg[0]
         if cmd == "predict":
             return self._do_predict(msg)
         if cmd == "hello":
-            # clients learn the replica set + model signature here —
-            # the serving analogue of the kvstore shard map at hello
+            # clients learn the replica set + the hosted model menus
+            # (signatures AND live weight-version state) here — the
+            # serving analogue of the kvstore shard map at hello
+            models = {entry.name: {
+                "signature": entry.engine.signature(),
+                "weights": entry.engine.version_state()}
+                for entry in self._entries()}
             return ("ok", {"model": self._model_name,
                            "replicas": self._replicas,
                            "draining": self._draining,
                            "queue_depth": self._depth,
                            "batch_deadline_ms": self._deadline_ms,
                            "default_budget_ms": self._budget_ms,
-                           "signature": self._engine.signature()})
+                           "signature": self._engine.signature(),
+                           "models": models})
         if cmd == "ping":
             return ("ok", {"draining": self._draining,
-                           "pending": self._batcher.pending()})
+                           "pending": sum(e.batcher.pending()
+                                          for e in self._entries())})
         if cmd == "stats":
             return ("ok", self.stats())
         if cmd == "drain":
             # operator/drill hook: same two-phase path as SIGTERM
             self._draining = True
-            threading.Thread(target=self._batcher.drain, kwargs={
-                "timeout": float(msg[1]) if len(msg) > 1 else 30.0},
-                daemon=True).start()
+            for entry in self._entries():
+                threading.Thread(target=entry.batcher.drain, kwargs={
+                    "timeout": float(msg[1]) if len(msg) > 1 else 30.0},
+                    daemon=True).start()
             return ("ok", {"draining": True})
+        if cmd == "resume":
+            # the zero-downtime hot-swap exit: drain → swap → resume
+            return ("ok", {"draining": not self.resume()})
+        if cmd == "weights_push":
+            # ("weights_push", model, version, params, aux, digest):
+            # the direct streaming path — a publisher (or the CI drill)
+            # lands a fresh version straight on the replica
+            _, model, version, params, aux, digest = msg
+            try:
+                v = self.swap_weights(params, aux, version=version,
+                                      digest=digest, model=model)
+            except ValueError as e:
+                return ("err", "weight swap refused — %s" % e)
+            entry = self._entry_for(model)
+            return ("ok", {"version": v,
+                           "weights": entry.engine.version_state()})
+        if cmd == "rollout":
+            # ("rollout", model, action, kwargs) — the operator surface
+            # RolloutController drives fleet-wide
+            return self._do_rollout(msg)
         if cmd == "stop":
             threading.Thread(target=self.stop, daemon=True).start()
             return ("ok",)
         return ("err", "unknown serving command %r" % (cmd,))
+
+    def _do_rollout(self, msg):
+        _, model, action, kw = msg
+        kw = kw or {}
+        entry = self._entry_for(model)
+        if entry is None:
+            return ("err", "unknown model %r (hosting %r)"
+                    % (model, sorted(self._models)))
+        try:
+            if action == "canary":
+                if kw.get("version") is not None:
+                    self._ensure_resident(entry, kw["version"])
+                entry.engine.set_canary(kw.get("version"),
+                                        kw.get("fraction", 0.0))
+            elif action == "promote":
+                if kw.get("version") is not None:
+                    self._ensure_resident(entry, kw["version"])
+                entry.engine.promote(kw.get("version"))
+            elif action == "abort":
+                entry.engine.abort_canary()
+            elif action == "pin":
+                self._ensure_resident(entry, kw["version"])
+                entry.engine.pin(kw["version"])
+            elif action == "unpin":
+                entry.engine.unpin()
+            elif action == "rollback":
+                self.rollback(kw["version"], model=model)
+            elif action != "status":
+                return ("err", "unknown rollout action %r" % (action,))
+        except (ValueError, KeyError) as e:
+            return ("err", "rollout %s refused — %s" % (action, e))
+        return ("ok", {"weights": entry.engine.version_state(),
+                       "by_version": entry.version_stats()})
 
 
 # extra seconds a parked handler waits past (budget + batch window) for

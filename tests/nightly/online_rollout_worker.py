@@ -1,0 +1,297 @@
+"""Online-learning rollout drill worker (ISSUE 11 acceptance; driven by
+tests/test_dist_launch.py::test_online_rollout_closes_train_serve_loop
+through tools/launch.py -n 2 --serve 2 --serve-respawn).
+
+Rank 0 — the TRAINER: loads the served checkpoint, publishes it as
+pinned weight version 1, then actually trains (manual Module
+forward/backward/update on a fixed synthetic task) and publishes a
+fresh version after every round — the live train→serve stream.
+
+Rank 1 — the DRIVER: concurrent closed-loop clients stream predicts at
+the replica fleet while versions swap underneath; every reply records
+the answering weight version. The driver probes each newly observed
+version with a canonical batch, measures prediction quality
+(cross-entropy against the task's true labels — it must IMPROVE
+mid-stream), then drives a bit-exact rollback to pinned version 1 via
+the rollout admin wire and diffs the probe bits against the ones
+recorded at the start.
+
+Coordination is file-based in ROLLOUT_TEST_DIR (driver_ready,
+trainer_done.json); the driver's progress file counts answered
+requests ONCE swaps are in flight — the external kill -9 trigger.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+IN_DIM, CLASSES, BUCKET = 6, 3, 8
+OUT_DIR = os.environ["ROLLOUT_TEST_DIR"]
+PROGRESS = os.environ.get("ROLLOUT_PROGRESS_FILE")
+ROUNDS = int(os.environ.get("ROLLOUT_TRAIN_ROUNDS", "3"))
+
+# the shared synthetic task: a fixed linear teacher both ranks derive
+# from the same seed (the trainer fits it, the driver scores against it)
+_W_TRUE = np.random.RandomState(1234).randn(IN_DIM, CLASSES) \
+    .astype("f")
+
+
+def _labels(x):
+    return np.argmax(x @ _W_TRUE, axis=1).astype("f")
+
+
+def _eval_batch():
+    x = np.random.RandomState(123).rand(BUCKET, IN_DIM).astype("f")
+    return x, _labels(x).astype(int)
+
+
+def _wait_for(path, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_trainer():
+    import mxtpu as mx
+    from mxtpu.serving import WeightPublisher
+
+    prefix = os.environ["MXTPU_SERVE_MODEL"]
+    epoch = int(os.environ.get("MXTPU_SERVE_EPOCH", "0"))
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix,
+                                                           epoch)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (16, IN_DIM))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.set_params(arg_params, aux_params)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    pub = WeightPublisher(os.environ["MXTPU_SERVE_WEIGHT_DIR"])
+    ap, _xp = mod.get_params()
+    out = pub.publish({n: v.asnumpy() for n, v in ap.items()},
+                      pin=True, meta={"round": 0})
+    print("trainer published pinned v%d digest=%s"
+          % (out["version"], out["digest"][:12]), flush=True)
+
+    # the driver must record version 1's probe bits BEFORE v2 lands
+    if not _wait_for(os.path.join(OUT_DIR, "driver_ready")):
+        print("trainer: driver never became ready", flush=True)
+        return 1
+
+    rng = np.random.RandomState(42)
+    x_all = rng.rand(512, IN_DIM).astype("f")
+    y_all = _labels(x_all)
+    versions = [out["version"]]
+    for round_i in range(1, ROUNDS + 1):
+        train_iter = mx.io.NDArrayIter(
+            x_all, y_all, batch_size=16, shuffle=False,
+            label_name="softmax_label")
+        for _epoch in range(3):
+            train_iter.reset()
+            for batch in train_iter:
+                mod.forward_backward(batch)
+                mod.update()
+        ap, _xp = mod.get_params()
+        out = pub.publish({n: v.asnumpy() for n, v in ap.items()},
+                          meta={"round": round_i})
+        if out is None:
+            continue
+        versions.append(out["version"])
+        print("trainer published v%d" % out["version"], flush=True)
+        time.sleep(float(os.environ.get("ROLLOUT_PUBLISH_GAP", "1.5")))
+
+    done = {"final_version": versions[-1], "versions": versions,
+            "pinned": 1}
+    with open(os.path.join(OUT_DIR, "trainer_done.json"), "w") as f:
+        json.dump(done, f)
+    print("RANK_0_OK", flush=True)
+    return 0
+
+
+def run_driver():
+    from mxtpu.serving import RolloutController, ServingClient
+
+    addrs = [a for a in os.environ["MXTPU_SERVE_ADDRS"].split(",")
+             if a]
+    cli = ServingClient(addrs=addrs, budget_ms=8000)
+    deadline = time.time() + 120
+    while True:
+        try:
+            cli.hello()
+            break
+        except ConnectionError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+    x_eval, y_eval = _eval_batch()
+    lock = threading.Lock()
+    state = {"answered": 0, "errors": [], "versions": set(),
+             "probe_bits": {}, "loss_by_version": {}}
+
+    def _ce(outs):
+        p = np.clip(np.asarray(outs, "f"), 1e-9, 1.0)
+        return float(-np.mean(np.log(p[np.arange(BUCKET), y_eval])))
+
+    def _probe():
+        """One canonical full-bucket probe; records bits + quality
+        under whatever version ANSWERED (coherent by contract)."""
+        outs, info = cli.predict2(x_eval)
+        v = info["version"]
+        with lock:
+            state["versions"].add(v)
+            state["probe_bits"].setdefault(v, np.asarray(outs[0]))
+            state["loss_by_version"].setdefault(v, _ce(outs[0]))
+        return v
+
+    # pin down version 1's bits before releasing the trainer (the
+    # replicas may still be on ctor version 0 until the publish lands)
+    deadline = time.time() + 120
+    v = _probe()
+    while v < 1 and time.time() < deadline:
+        time.sleep(0.2)
+        v = _probe()
+    assert v == 1, "expected the pinned initial version, got %r" % v
+    with open(os.path.join(OUT_DIR, "driver_ready"), "w") as f:
+        f.write("ok")
+    print("driver recorded v1 probe bits", flush=True)
+
+    stop = threading.Event()
+
+    def pound(seed):
+        rng = np.random.RandomState(seed)
+        c = ServingClient(addrs=addrs, budget_ms=8000)
+        while not stop.is_set():
+            try:
+                _, info = c.predict2(
+                    rng.rand(1, IN_DIM).astype("f"))
+                with lock:
+                    state["answered"] += 1
+                    state["versions"].add(info["version"])
+                    n, nv = state["answered"], len(state["versions"])
+            except Exception as e:       # noqa: BLE001 — recorded
+                with lock:
+                    state["errors"].append(repr(e))
+                    n, nv = state["answered"], len(state["versions"])
+            if PROGRESS and nv >= 2:
+                # the kill -9 trigger: only counts once swaps are in
+                # flight, so the kill lands mid-rollout-stream
+                try:
+                    with open(PROGRESS + ".tmp", "w") as f:
+                        f.write(str(n))
+                    os.replace(PROGRESS + ".tmp", PROGRESS)
+                except OSError:
+                    pass
+        c.close()
+
+    threads = [threading.Thread(target=pound, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+
+    # follow the stream: probe whenever a new version shows up
+    done_path = os.path.join(OUT_DIR, "trainer_done.json")
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        _probe()
+        if os.path.exists(done_path):
+            break
+        time.sleep(0.2)
+    assert os.path.exists(done_path), "trainer never finished"
+    with open(done_path) as f:
+        done = json.load(f)
+    final_v = int(done["final_version"])
+    # drain the stream to the final version
+    deadline = time.time() + 60
+    while _probe() != final_v and time.time() < deadline:
+        time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+
+    with lock:
+        answered = state["answered"]
+        errors = list(state["errors"])
+        versions = sorted(state["versions"])
+        losses = dict(state["loss_by_version"])
+        v1_bits = state["probe_bits"][1]
+
+    # wait for BOTH replicas (one was kill -9'd and respawned) to
+    # settle on the final version before the fleet-wide rollback
+    ctl = RolloutController(addrs)
+    deadline = time.time() + 120
+    settled = False
+    while time.time() < deadline and not settled:
+        try:
+            status = ctl.status()
+            settled = all(
+                info["weights"]["latest"] >= final_v
+                for info in status.values())
+        except (ConnectionError, RuntimeError, OSError):
+            settled = False
+        if not settled:
+            time.sleep(0.3)
+    assert settled, "fleet never settled on v%d: %s" % (final_v,
+                                                        status)
+
+    # bit-exact rollback to the pinned version
+    rb = ctl.rollback(1)
+    outs, info = cli.predict2(x_eval)
+    assert info["version"] == 1, info
+    rb_bits = np.asarray(outs[0])
+    bit_exact = bool(np.array_equal(rb_bits, v1_bits))
+
+    # zero predict-program recompiles after warmup, on every replica
+    compiles = {}
+    fleet_stats = ctl.server_stats()
+    for addr, s in fleet_stats.items():
+        eng = s["engine"]
+        compiles[addr] = {"compiles": eng["compiles"],
+                          "hits": eng["hits"],
+                          "swaps": s["counters"]["swaps"]}
+    client_stats = cli.stats()
+    ctl.close()
+    cli.close()
+
+    summary = {
+        "answered": answered,
+        "errors": errors,
+        "versions": versions,
+        "final_version": final_v,
+        "loss_by_version": losses,
+        "rollback_bit_exact": bit_exact,
+        "rollback_info": {a: r.get("weights", {})
+                          for a, r in rb.items()},
+        "compiles": compiles,
+        "client": client_stats,
+    }
+    with open(os.path.join(OUT_DIR, "summary.json"), "w") as f:
+        json.dump(summary, f, default=str)
+    np.savez(os.path.join(OUT_DIR, "probe_bits.npz"),
+             v1=v1_bits, rollback=rb_bits)
+    print("DRIVER_OK answered=%d versions=%s" % (answered, versions),
+          flush=True)
+    print("RANK_1_OK", flush=True)
+    return 0
+
+
+def main():
+    rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+    os.makedirs(OUT_DIR, exist_ok=True)
+    if rank == 0:
+        return run_trainer()
+    return run_driver()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

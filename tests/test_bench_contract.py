@@ -183,3 +183,12 @@ def test_serving_bench_contract():
     # retraced (the AOT bucket menu absorbed every request)
     assert payload["batches"] <= payload["batched_requests"]
     assert payload["retraces_after_warmup"] == 0
+    # continuous deployment (ISSUE 11): swap latency + poll-mode
+    # weight-staleness lag ride every bench line, and a weight swap is
+    # never a retrace (same shapes -> program-cache hit)
+    ro = payload["rollout"]
+    assert ro["swaps"] >= 1
+    assert ro["swap_ms_p50"] > 0 and ro["swap_ms_max"] >= ro["swap_ms_p50"]
+    assert ro["staleness_ms_p50"] > 0
+    assert ro["staleness_ms_max"] >= ro["staleness_ms_p50"]
+    assert ro["retraces"] == 0

@@ -331,7 +331,93 @@ def test_integrity_tags_cover_all_sections(tmp_path):
     with open(tag_path) as f:
         tags = json.load(f)
     assert set(tags) == {"params", "trainer_states", "metadata",
-                         "extras"}
+                         "extras", "digest"}
+    # the whole-set identity next to the CRC sections: sha256 of the
+    # params, the token rollout verification keys on
+    assert tags["digest"] == ckpt.digest(1)
+    assert len(tags["digest"]) == 64
     # grandfathering: a pre-tag checkpoint (no integrity.json) loads
     os.unlink(tag_path)
     assert ckpt.restore(1) is not None
+
+
+# ---------------------------------------------------------------------------
+# versioned weight snapshots (ISSUE 11): pins, digests, GC
+# ---------------------------------------------------------------------------
+
+def test_pinned_versions_survive_retention(tmp_path):
+    """keep-last-K runs over the UNPINNED steps only: a pinned version
+    — the serving rollback anchor — is never collected, however many
+    newer versions land; unpinning re-exposes it to the next GC."""
+    ckpt = CheckpointManager(str(tmp_path / "w"), max_to_keep=2,
+                             async_save=False, use_orbax=False)
+    params = {"w": np.arange(6, dtype=np.float32)}
+    ckpt.save(1, params)
+    ckpt.pin(1)
+    for step in (2, 3, 4, 5, 6):
+        ckpt.save(step, {"w": params["w"] * step})
+    # unpinned tail is K=2 deep; step 1 survives by its pin alone
+    assert ckpt.all_steps() == [1, 5, 6]
+    assert ckpt.pins() == {1}
+    # the pinned bits restore exactly (no fallback involved)
+    tree = ckpt.restore_exact(1)
+    np.testing.assert_array_equal(tree["params"]["w"], params["w"])
+    # unpin: the next save's retention pass collects it
+    ckpt.unpin(1)
+    ckpt.save(7, {"w": params["w"] * 7})
+    assert ckpt.all_steps() == [6, 7]
+
+
+def test_digest_records_and_verifies_identity(tmp_path):
+    """The writer records weight_digest(params) in integrity.json;
+    digest(step) reads it back, and identical bits give identical
+    digests across independent saves (the rollback identity check)."""
+    from mxtpu.checkpoint import weight_digest
+    ckpt = CheckpointManager(str(tmp_path / "d"), async_save=False,
+                             use_orbax=False)
+    params = {"a": np.arange(4, dtype=np.float32),
+              "b": np.ones((2, 2), np.float32)}
+    ckpt.save(1, params)
+    d1 = ckpt.digest(1)
+    assert d1 == weight_digest(params)
+    # same bits, different step -> same digest; different bits differ
+    ckpt.save(2, params)
+    assert ckpt.digest(2) == d1
+    ckpt.save(3, {"a": params["a"] + 1, "b": params["b"]})
+    assert ckpt.digest(3) != d1
+    assert ckpt.digest(99) is None
+
+
+def test_corrupt_newest_version_falls_back_to_previous(tmp_path):
+    """A subscriber polling the snapshot dir must keep serving from
+    the last COMPLETE version when the newest is torn: restore() falls
+    back, restore_exact() refuses — and after the corrupt step is
+    superseded, the stream resumes normally."""
+    import os
+    from mxtpu.checkpoint import CheckpointCorrupt
+    ckpt = CheckpointManager(str(tmp_path / "c"), max_to_keep=5,
+                             async_save=False, use_orbax=False)
+    ckpt.save(1, {"w": np.arange(3, dtype=np.float32)})
+    ckpt.save(2, {"w": np.arange(3, dtype=np.float32) * 2})
+    # tear version 2's params blob (post-publish disk rot)
+    blob = os.path.join(str(tmp_path / "c"), "step_2", "params.npz")
+    with open(blob, "wb") as f:
+        f.write(b"torn")
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.restore_exact(2)
+    tree = ckpt.restore(2)          # falls back to version 1
+    np.testing.assert_array_equal(tree["params"]["w"],
+                                  np.arange(3, dtype=np.float32))
+    # a fresh complete version supersedes the torn one
+    ckpt.save(3, {"w": np.arange(3, dtype=np.float32) * 3})
+    tree = ckpt.restore_exact(3)
+    np.testing.assert_array_equal(tree["params"]["w"],
+                                  np.arange(3, dtype=np.float32) * 3)
+
+
+def test_restore_exact_missing_step_returns_none(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "m"), async_save=False,
+                             use_orbax=False)
+    assert ckpt.restore_exact(4) is None
+    ckpt.save(4, {"w": np.zeros(2, np.float32)})
+    assert ckpt.restore_exact(4) is not None

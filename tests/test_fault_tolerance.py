@@ -1150,14 +1150,20 @@ def _serving_pair(batch_deadline_ms=10):
 def test_serving_spec_points_validate():
     rules = fault.parse_spec(
         "kind=drop,point=serve.request,op=predict,nth=2;"
-        "kind=kill,point=serve.batch")
+        "kind=kill,point=serve.batch;"
+        "kind=kill,point=serve.swap;"
+        "kind=sever,point=publish.snapshot")
     assert rules[0].point == "serve.request"
     assert rules[1].point == "serve.batch"
+    assert rules[2].point == "serve.swap"
+    assert rules[3].point == "publish.snapshot"
     # signal kinds stay training-loop-only; transport kinds are free
     with pytest.raises(ValueError, match="worker.step"):
         fault.parse_spec("kind=nan_grad,point=serve.request")
     with pytest.raises(ValueError, match="worker.step"):
         fault.parse_spec("kind=join_worker,point=serve.batch")
+    with pytest.raises(ValueError, match="worker.step"):
+        fault.parse_spec("kind=split_shard,point=serve.swap")
 
 
 def test_serving_sever_mid_predict_window(monkeypatch):
@@ -1232,6 +1238,201 @@ def test_serving_kill_replica_mid_batch(monkeypatch):
     finally:
         s2.stop()
         s1.stop()
+
+
+# ---------------------------------------------------------------------------
+# weight-rollout rows (ISSUE 11): the train→serve stream through the same
+# harness (full behavior matrix in tests/test_rollout.py) —
+# drop  @ serve.swap        -> version record lost; the replica keeps
+#                              answering from the last COMPLETE version
+#                              and the stream's watermark re-delivers
+# sever @ serve.swap        -> weight stream severed mid-record: the
+#                              sync round fails, serving is unaffected,
+#                              the retry is an exact catch-up
+# kill  @ serve.swap        -> replica dies mid-swap: clients fail over,
+#                              the peer swaps the same version and
+#                              answers the replays exactly once
+# drop/sever @ publish.snapshot -> the trainer's publish is lost BEFORE
+#                              any byte lands; subscribers never see a
+#                              torn version
+# kill  @ publish.snapshot  -> the parameter server crashes mid-publish;
+#                              subscribers keep the last complete
+#                              version
+# ---------------------------------------------------------------------------
+
+def test_weight_swap_drop_keeps_last_complete_version():
+    """kind=drop @ serve.swap: the version record is lost at the swap
+    choke point — never a half-swapped table, the replica answers from
+    the last complete version; the next delivery of the SAME version
+    (the watermark was not advanced) applies cleanly."""
+    from mxtpu.serving import ServingClient
+    s1, s2, mkeng = _serving_pair()
+    try:
+        cli = ServingClient(addrs=[s1.address], budget_ms=5000)
+        x = np.ones((1, 6), "f")
+        _, ri = cli.predict2(x)
+        assert ri["version"] == 0
+        p1 = {n: v * 1.25
+              for n, v in s1._engine.current_params().items()}
+        with fault.inject(
+                "kind=drop,point=serve.swap,nth=1,count=1") as inj:
+            assert s1.swap_weights(p1, version=1) is None
+        assert inj.stats()[0][4] == 1, "the drop never fired"
+        assert s1.stats()["counters"]["swaps_dropped"] == 1
+        _, ri = cli.predict2(x)
+        assert ri["version"] == 0          # last complete version
+        # re-delivery (stream catch-up) lands the same version
+        assert s1.swap_weights(p1, version=1) == 1
+        _, ri = cli.predict2(x)
+        assert ri["version"] == 1
+    finally:
+        s2.stop()
+        s1.stop()
+
+
+def test_weight_stream_sever_mid_record_catches_up(tmp_path):
+    """kind=sever @ serve.swap: the weight stream dies mid-record. The
+    sync round surfaces the ConnectionError (counted), serving keeps
+    the old version, and the NEXT round re-delivers from the watermark
+    — the _ReplStream catch-up discipline on weights."""
+    from mxtpu.serving import ServingClient, WeightPublisher, WeightSync
+    s1, s2, mkeng = _serving_pair()
+    sync = None
+    try:
+        cli = ServingClient(addrs=[s1.address], budget_ms=5000)
+        pub = WeightPublisher(str(tmp_path / "w"))
+        pub.publish({n: v * 2.0
+                     for n, v in s1._engine.current_params().items()})
+        sync = WeightSync(s1, weight_dir=str(tmp_path / "w"), poll=0.05)
+        with fault.inject(
+                "kind=sever,point=serve.swap,nth=1,count=1") as inj:
+            with pytest.raises(ConnectionError):
+                sync.poll_once()
+        assert inj.stats()[0][4] == 1, "the sever never fired"
+        x = np.ones((1, 6), "f")
+        _, ri = cli.predict2(x)
+        assert ri["version"] == 0          # unaffected mid-sever
+        assert sync.poll_once() == 1       # exact catch-up, fault gone
+        _, ri = cli.predict2(x)
+        assert ri["version"] == 1
+    finally:
+        if sync is not None:
+            sync.stop()
+        s2.stop()
+        s1.stop()
+
+
+def test_weight_swap_kill_mid_swap_fails_over_exactly_once():
+    """kind=kill @ serve.swap: the active replica dies mid-swap. Its
+    clients fail over with their ORIGINAL request ids; the peer (which
+    received the same version record) answers every replay exactly
+    once from the NEW version — zero acknowledged loss across the
+    kill."""
+    import threading as _threading
+    from mxtpu.serving import ServingClient
+    s1, s2, mkeng = _serving_pair(batch_deadline_ms=20)
+    try:
+        cli = ServingClient(addrs=[s1.address], budget_ms=5000)
+        cli.hello()
+        p1 = {n: v * 1.5
+              for n, v in s1._engine.current_params().items()}
+        oracle = mkeng()
+        oracle.swap_weights(p1, version=1)
+        rng = np.random.RandomState(6)
+        xs = [rng.rand(1, 6).astype("f") for _ in range(4)]
+        want = [oracle.predict([x])[0] for x in xs]
+        with fault.inject("kind=kill,point=serve.swap,nth=1") as inj:
+            with pytest.raises((ConnectionError, RuntimeError)):
+                s1.swap_weights(p1, version=1)   # dies mid-swap
+            assert s2.swap_weights(p1, version=1) == 1
+        assert inj.stats()[0][4] == 1, "the kill never fired"
+        assert s1._tcp.dying and not s2._tcp.dying
+        outs, errs = {}, {}
+        lock = _threading.Lock()
+
+        def one(i):
+            try:
+                r, ri = cli.predict2(xs[i])
+                with lock:
+                    outs[i] = (r[0], ri["version"])
+            except Exception as e:
+                with lock:
+                    errs[i] = e
+
+        ts = [_threading.Thread(target=one, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errs, errs
+        assert len(outs) == 4              # exactly one answer each
+        for i, (out, v) in outs.items():
+            assert v == 1
+            np.testing.assert_array_equal(out, want[i][:1])
+        assert cli.stats()["failovers"] >= 1
+    finally:
+        s2.stop()
+        s1.stop()
+
+
+def test_publish_snapshot_drop_loses_publish_cleanly(tmp_path):
+    """kind=drop @ publish.snapshot: the publish is lost BEFORE any
+    byte is written — no torn snapshot, no version bump; the next
+    publish lands normally with the next version number."""
+    from mxtpu.serving import WeightPublisher
+    pub = WeightPublisher(str(tmp_path / "w"))
+    params = {"w": np.arange(4, dtype="f")}
+    with fault.inject(
+            "kind=drop,point=publish.snapshot,nth=1,count=1") as inj:
+        assert pub.publish(params) is None
+    assert inj.stats()[0][4] == 1, "the drop never fired"
+    assert pub.versions() == [] and pub.version == 0
+    assert pub.stats()["dropped"] == 1
+    out = pub.publish(params)
+    assert out["version"] == 1 and pub.versions() == [1]
+
+
+def test_publish_snapshot_sever_crashes_trainer_mid_publish(tmp_path):
+    """kind=sever @ publish.snapshot: the trainer-side publish dies
+    mid-flight. The fault fires BEFORE the snapshot write, so
+    subscribers can never observe a half-published version — the dir
+    still holds only complete, digest-verified versions."""
+    from mxtpu.serving import WeightPublisher
+    pub = WeightPublisher(str(tmp_path / "w"))
+    pub.publish({"w": np.zeros(4, "f")})
+    with fault.inject(
+            "kind=sever,point=publish.snapshot,nth=1,count=1") as inj:
+        with pytest.raises(ConnectionError):
+            pub.publish({"w": np.ones(4, "f")})
+    assert inj.stats()[0][4] == 1, "the sever never fired"
+    assert pub.versions() == [1]           # v2 never became visible
+    out = pub.publish({"w": np.ones(4, "f")})
+    assert out["version"] == 2 and pub.versions() == [1, 2]
+
+
+def test_publish_snapshot_kill_takes_down_ps_mid_publish():
+    """kind=kill @ publish.snapshot on the parameter server: the shard
+    crashes mid-publish. The publishing client sees the connection
+    die; the weight stream's published version never advances, so
+    subscribers keep the last complete version."""
+    srv = ka.ParameterServer()
+    srv.start()
+    conn = ka._ServerConn(srv.address, n_socks=1)
+    try:
+        conn.request("init", "w", np.ones(4, "f"))
+        reply = conn.request("publish", None, None, False)
+        assert reply[1]["version"] == 1
+        with fault.inject(
+                "kind=kill,point=publish.snapshot,nth=1") as inj:
+            with pytest.raises((ConnectionError, RuntimeError)):
+                conn.request("publish", None, None, False,
+                             retries=0, timeout=5.0)
+        assert inj.stats()[0][4] == 1, "the kill never fired"
+        assert srv._tcp.dying
+        assert srv._pub_version == 1       # v2 never became visible
+    finally:
+        conn.close()
+        srv.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -125,6 +125,58 @@ def _run_level(addr, n_clients, iters, in_dim, budget_ms):
     }
 
 
+def _measure_rollout(srv, engine, prefix, in_dim, swaps=5):
+    """Swap latency + weight-staleness lag: how long one weight-version
+    install takes (publish-to-serving handoff excluded), and how stale
+    a poll-mode replica's weights run end to end — publish timestamp
+    to the version actually answering requests (WeightPublisher →
+    WeightSync at MXTPU_SERVE_WEIGHT_POLL → device_put swap). Both are
+    the operational numbers of the continuous-deployment story
+    (docs/serving.md "Rollout & weight streaming")."""
+    from mxtpu.model import load_checkpoint
+    from mxtpu.serving import WeightPublisher, WeightSync
+    _sym, arg_params, _aux = load_checkpoint(prefix, 0)
+    base = {n: v.asnumpy() for n, v in arg_params.items()}
+    compiles_before = engine.cache.compiles
+    swap_s = []
+    for i in range(swaps):
+        params = {n: v * (1.0 + 0.01 * (i + 1)) for n, v in base.items()}
+        t0 = time.perf_counter()
+        v = srv.swap_weights(params)
+        swap_s.append(time.perf_counter() - t0)
+        assert v is not None
+    weight_dir = tempfile.mkdtemp(prefix="mxtpu_serve_bench_w_")
+    pub = WeightPublisher(weight_dir)
+    poll_s = 0.02
+    sync = WeightSync(srv, weight_dir=weight_dir, poll=poll_s)
+    sync.catch_up()
+    sync.start()
+    stale_s = []
+    # versions must be PAST the engine's watermark (the direct swaps
+    # above advanced it), or the lag would measure an instant no-op
+    v0 = srv._engine.version_state()["latest"]
+    for i in range(swaps):
+        params = {n: v * (2.0 + 0.01 * i) for n, v in base.items()}
+        out = pub.publish(params, version=v0 + i + 1)
+        t0 = time.perf_counter()
+        deadline = t0 + 30.0
+        while time.perf_counter() < deadline:
+            if srv._engine.version_state()["version"] >= out["version"]:
+                break
+            time.sleep(0.001)
+        stale_s.append(time.perf_counter() - t0)
+    sync.stop()
+    return {
+        "swaps": swaps,
+        "swap_ms_p50": round(_pct(swap_s, 0.50) * 1e3, 3),
+        "swap_ms_max": round(max(swap_s) * 1e3, 3),
+        "poll_s": poll_s,
+        "staleness_ms_p50": round(_pct(stale_s, 0.50) * 1e3, 3),
+        "staleness_ms_max": round(max(stale_s) * 1e3, 3),
+        "retraces": engine.cache.compiles - compiles_before,
+    }
+
+
 def run(clients_levels, iters, in_dim, hidden, classes, buckets,
         budget_ms):
     import mxtpu  # noqa: F401  (engine import path)
@@ -151,6 +203,9 @@ def run(clients_levels, iters, in_dim, hidden, classes, buckets,
         ka._LOCAL_ON = False
         tcp = _run_level(srv.address, mid, iters, in_dim, budget_ms)
         ka._LOCAL_ON = local_saved
+        # the continuous-deployment numbers: swap latency + poll-mode
+        # weight-staleness lag, with the zero-retrace pin riding along
+        rollout = _measure_rollout(srv, engine, prefix, in_dim)
 
         result = {
             "bench": "serving_loopback",
@@ -171,6 +226,7 @@ def run(clients_levels, iters, in_dim, hidden, classes, buckets,
                 b["batched_rows"] / b["batches"], 2) if b["batches"]
             else 0.0,
             "max_batch_rows": b["max_batch_rows"],
+            "rollout": rollout,
             "retraces_after_warmup":
                 engine.cache.compiles - compiles_after_warm,
         }

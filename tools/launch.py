@@ -105,10 +105,16 @@ def _spawn_server(name, ps_port, base_env, args, role="primary",
 def _spawn_serving_replica(idx, port, addrs, base_env, args):
     """One model-serving replica child (``python -m mxtpu.serving``).
     Every replica gets the FULL replica set in MXTPU_SERVE_ADDRS so its
-    hello replies teach clients where to fail over. Replicas are reaped
-    with the same ``_reap`` TERM→KILL escalation as servers — SIGTERM
-    is their graceful drain (stop admissions, flush in-flight batches,
-    exit 0), so a clean launcher exit never drops admitted requests."""
+    hello replies teach clients where to fail over. With a weight
+    source configured (``--serve-weight-dir`` / ``--serve-weight-kv``)
+    the replica catches up to the CURRENT weight version before it
+    admits and then follows the stream live — which is also what makes
+    a ``--serve-respawn`` rejoin well-defined: the revived process
+    re-binds its port, catches up, re-hellos, and serves current
+    weights. Replicas are reaped with the same ``_reap`` TERM→KILL
+    escalation as servers — SIGTERM is their graceful drain (stop
+    admissions, flush in-flight batches, exit 0), so a clean launcher
+    exit never drops admitted requests."""
     env = dict(base_env, JAX_PLATFORMS="cpu",
                MXTPU_SERVE_PORT=str(port),
                MXTPU_SERVE_ADDRS=",".join(addrs),
@@ -117,6 +123,12 @@ def _spawn_serving_replica(idx, port, addrs, base_env, args):
                MXTPU_SERVE_DATA_SHAPES=args.serve_data_shapes)
     if args.serve_buckets:
         env["MXTPU_SERVE_BUCKETS"] = args.serve_buckets
+    if args.serve_weight_dir:
+        env["MXTPU_SERVE_WEIGHT_DIR"] = args.serve_weight_dir
+    if args.serve_weight_kv:
+        env["MXTPU_SERVE_WEIGHT_KV"] = args.serve_weight_kv
+    if args.serve_weight_poll is not None:
+        env["MXTPU_SERVE_WEIGHT_POLL"] = str(args.serve_weight_poll)
     env.pop("DMLC_ROLE", None)     # not a parameter-server role process
     proc = subprocess.Popen(
         [sys.executable, "-m", "mxtpu.serving"], env=env)
@@ -149,6 +161,34 @@ def _parse_scale(spec):
         if "after" not in ev and "at_step" not in ev:
             raise SystemExit("scale event %r needs after= or at_step="
                              % item)
+        events.append(ev)
+    return events
+
+
+def _parse_rollout(spec):
+    """``--rollout`` drill events: ``;``-separated, each a comma list
+    of ``key=value`` — ``after=SECONDS`` or ``at_step=N`` (needs
+    ``--scale-progress``) picks the trigger, ``action=`` one of
+    canary / promote / abort / rollback / pin / unpin / status, plus
+    ``version=``, ``fraction=`` and ``model=`` as the action needs."""
+    events = []
+    for item in spec.split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        ev = {}
+        for pair in item.split(","):
+            k, _, v = pair.partition("=")
+            ev[k.strip()] = v.strip()
+        if ev.get("action") not in ("canary", "promote", "abort",
+                                    "rollback", "pin", "unpin",
+                                    "status"):
+            raise SystemExit("rollout event %r needs action=canary|"
+                             "promote|abort|rollback|pin|unpin|status"
+                             % item)
+        if "after" not in ev and "at_step" not in ev:
+            raise SystemExit("rollout event %r needs after= or "
+                             "at_step=" % item)
         events.append(ev)
     return events
 
@@ -221,6 +261,7 @@ def launch_local(args, command):
     # --serve N: a model-serving replica set next to (or instead of)
     # the parameter servers; workers see MXTPU_SERVE_ADDRS and speak
     # mxtpu.serving.ServingClient (docs/serving.md)
+    serve_addrs = []
     if args.serve:
         if not (args.serve_model and args.serve_data_shapes):
             raise SystemExit("--serve needs --serve-model and "
@@ -229,8 +270,17 @@ def launch_local(args, command):
                        for i in range(args.serve)]
         serve_addrs = ["127.0.0.1:%d" % p for p in serve_ports]
         base_env["MXTPU_SERVE_ADDRS"] = ",".join(serve_addrs)
+        # the serve contract rides to the WORKERS too: a trainer
+        # process publishing weights (WeightPublisher into the weight
+        # dir, or kv.publish_version) needs the served model prefix
+        # and the versioned snapshot dir the replicas follow
+        base_env["MXTPU_SERVE_MODEL"] = args.serve_model
+        base_env["MXTPU_SERVE_EPOCH"] = str(args.serve_epoch)
+        base_env["MXTPU_SERVE_DATA_SHAPES"] = args.serve_data_shapes
+        if args.serve_weight_dir:
+            base_env["MXTPU_SERVE_WEIGHT_DIR"] = args.serve_weight_dir
         for i, port in enumerate(serve_ports):
-            server_slots.append(("serve%d" % i, port, "serving", None))
+            server_slots.append(("serve%d" % i, port, "serving", i))
             server_ports.append(port)
             server_procs.append(_spawn_serving_replica(
                 i, port, serve_addrs, base_env, args))
@@ -411,6 +461,80 @@ def launch_local(args, command):
                          daemon=True).start()
     else:
         scale_done.set()
+
+    # -- the --rollout drill: canary/promote/abort/rollback events on a
+    # wall-clock or progress schedule, driven through the serving admin
+    # wire (python -m mxtpu.serving --admin rollout). The scriptable
+    # form of the continuous-deployment story: a canary split under
+    # real traffic, a verdict, a bit-exact rollback — all while the
+    # fleet keeps answering (docs/serving.md "Rollout & weight
+    # streaming").
+    rollout_done = threading.Event()
+
+    def _do_rollout_event(ev):
+        cmd = [sys.executable, "-m", "mxtpu.serving",
+               "--admin", "rollout", "--addrs", ",".join(serve_addrs),
+               "--action", ev["action"]]
+        if ev.get("version"):
+            cmd += ["--version", ev["version"]]
+        if ev.get("fraction"):
+            cmd += ["--fraction", ev["fraction"]]
+        if ev.get("model"):
+            cmd += ["--model", ev["model"]]
+        admin_env = dict(base_env)
+        admin_env.pop("DMLC_ROLE", None)
+        admin_env["JAX_PLATFORMS"] = "cpu"
+        print("rollout: %s" % " ".join(cmd[3:]), flush=True)
+        r = subprocess.run(cmd, env=admin_env, capture_output=True,
+                           text=True)
+        print("rollout: %s -> %s"
+              % (ev["action"],
+                 (r.stdout.strip() or r.stderr.strip())[-500:]),
+              flush=True)
+
+    def _rollout_controller(events):
+        t0 = time.time()
+        try:
+            for ev in events:
+                if "after" in ev:
+                    deadline = t0 + float(ev["after"])
+                    while time.time() < deadline:
+                        if stop_scale.is_set():
+                            return
+                        time.sleep(0.05)
+                else:
+                    want = int(ev["at_step"])
+                    while True:
+                        if stop_scale.is_set():
+                            return
+                        try:
+                            with open(args.scale_progress) as f:
+                                step = int(f.read() or 0)
+                        except (OSError, ValueError):
+                            step = 0
+                        if step >= want:
+                            break
+                        time.sleep(0.05)
+                try:
+                    _do_rollout_event(ev)
+                except Exception as e:   # a drill bug must not wedge
+                    print("rollout: event %r failed: %s" % (ev, e),
+                          flush=True)
+        finally:
+            rollout_done.set()
+
+    if args.rollout:
+        if not serve_addrs:
+            raise SystemExit("--rollout needs --serve N")
+        events = _parse_rollout(args.rollout)
+        if any("at_step" in e for e in events) \
+                and not args.scale_progress:
+            raise SystemExit("--rollout with at_step= triggers needs "
+                             "--scale-progress FILE")
+        threading.Thread(target=_rollout_controller, args=(events,),
+                         daemon=True).start()
+    else:
+        rollout_done.set()
     try:
         # respawn passes run BEFORE the liveness check: a fleet whose
         # last worker just got kill -9'd must be revived, not reaped
@@ -430,18 +554,34 @@ def launch_local(args, command):
                           flush=True)
                     procs[i] = subprocess.Popen(
                         command, shell=True, env=worker_envs[i])
-            if args.ps_respawn:
+            if args.ps_respawn or args.serve_respawn:
                 for i, sp in enumerate(server_procs):
                     rc = sp.poll()
                     if rc is None or rc == 0:
                         continue   # alive, or clean 'stop' exit
-                    if respawns[i] >= args.ps_max_respawns:
-                        continue   # workers' retry layer surfaces it
                     name, port, role, peer = server_slots[i]
+                    if role != "serving" and (
+                            not args.ps_respawn
+                            or respawns[i] >= args.ps_max_respawns):
+                        continue   # workers' retry layer surfaces it
                     if role == "serving":
-                        # a crashed serving replica is the failover
-                        # drill's subject: clients re-route to the
-                        # survivors, the launcher does not revive it
+                        # without --serve-respawn a crashed serving
+                        # replica is the failover drill's subject:
+                        # clients re-route to the survivors. WITH it,
+                        # the rejoin is well-defined now that weights
+                        # are versioned: the revived process re-binds
+                        # its port, catches up to the current weight
+                        # version BEFORE admitting, and re-hellos.
+                        if not args.serve_respawn or \
+                                respawns[i] >= args.serve_max_respawns:
+                            continue
+                        respawns[i] += 1
+                        print("serve replica %s died (exit %d); "
+                              "respawning on port %d (%d/%d)"
+                              % (name, rc, port, respawns[i],
+                                 args.serve_max_respawns), flush=True)
+                        server_procs[i] = _spawn_serving_replica(
+                            peer, port, serve_addrs, base_env, args)
                         continue
                     respawns[i] += 1
                     print("server %s died (exit %d); respawning on port "
@@ -455,12 +595,13 @@ def launch_local(args, command):
                         name, port, base_env, args, role=role,
                         peer=peer)
             if all(p.poll() is not None for p in procs):
-                if not scale_done.is_set():
-                    # workers drained before the drill finished: stop
-                    # the controller (bounded) rather than hanging on
+                if not scale_done.is_set() or not rollout_done.is_set():
+                    # workers drained before a drill finished: stop
+                    # the controllers (bounded) rather than hanging on
                     # a progress file nobody writes anymore
                     stop_scale.set()
                     scale_done.wait(timeout=10)
+                    rollout_done.wait(timeout=10)
                 if all(p.poll() is not None for p in procs):
                     break
             time.sleep(0.2)
@@ -678,6 +819,36 @@ def main():
     p.add_argument("--serve-buckets", default=None,
                    help="batch buckets the replicas AOT-compile "
                         "(default 1,2,4,8,16,32)")
+    p.add_argument("--serve-respawn", action="store_true",
+                   help="local launcher: respawn a kill -9'd serving "
+                        "replica on its original port — the fresh "
+                        "process catches up to the CURRENT weight "
+                        "version before admitting, then re-hellos "
+                        "(docs/serving.md 'Rollout & weight "
+                        "streaming')")
+    p.add_argument("--serve-max-respawns", type=int, default=3,
+                   help="respawn budget per serving replica before "
+                        "its death is left to client failover")
+    p.add_argument("--serve-weight-dir", default=None,
+                   help="versioned weight-snapshot dir the replicas "
+                        "follow (WeightPublisher's output; exported "
+                        "as MXTPU_SERVE_WEIGHT_DIR) — also the "
+                        "rollback restore source")
+    p.add_argument("--serve-weight-kv", default=None,
+                   help="comma list of parameter-server addresses the "
+                        "replicas follow via the 'weights' long-poll "
+                        "stream (exported as MXTPU_SERVE_WEIGHT_KV)")
+    p.add_argument("--serve-weight-poll", type=float, default=None,
+                   help="weight-sync tick seconds (exported as "
+                        "MXTPU_SERVE_WEIGHT_POLL; default 0.5)")
+    p.add_argument("--rollout", default=None,
+                   help="serving rollout drill: ';'-separated events "
+                        "of 'after=SECS|at_step=N,action=canary|"
+                        "promote|abort|rollback|pin|unpin|status"
+                        "[,version=V][,fraction=F][,model=M]' driven "
+                        "through the serving admin wire (python -m "
+                        "mxtpu.serving --admin rollout); at_step= "
+                        "reads --scale-progress")
     p.add_argument("--scale-progress", default=None,
                    help="progress file written by the training script; "
                         "at_step= scale triggers fire when its integer "
