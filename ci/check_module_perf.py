@@ -26,9 +26,17 @@ transfers on the training thread (the gradient read rides the store's
 worker pool), and the bounded-inflight push window pinned through the
 ``kv.stats()['module_fused_dist']`` counters.
 
-Run: ``JAX_PLATFORMS=cpu python ci/check_module_perf.py [--dist]``
-(both wired into ``ci/run_ci.sh fast``). No timing, no thresholds in
-seconds.
+``--amp`` (ISSUE 12) pins the mixed-precision mode's contracts:
+``MXTPU_AMP=bf16`` engages ON the fused path (fp32 master weights,
+optimizer state and aux in the donated store), a steady-state AMP
+epoch still makes zero retraces and zero training-thread host syncs,
+and — over REAL wire framing — the bf16 dist step's pushpull bytes
+per step are <= 0.55x the fp32 baseline (the half-width-wire
+contract, counter-based like ``ci/check_comms_perf.py``).
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_module_perf.py
+[--dist|--amp]`` (all wired into ``ci/run_ci.sh`` fast). No timing, no
+thresholds in seconds.
 """
 from __future__ import annotations
 
@@ -47,6 +55,10 @@ if "--dist" in sys.argv:
     # mxtpu import so module-level knobs see them
     os.environ["MXTPU_MODULE_FUSED_DIST"] = "1"
     os.environ["MXTPU_MODULE_DIST_MODE"] = "async"
+    os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
+if "--amp" in sys.argv:
+    os.environ["MXTPU_MODULE_FUSED_DIST"] = "1"
+    os.environ["MXTPU_MODULE_DIST_MODE"] = "sync"
     os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
 
 import numpy as np                                    # noqa: E402
@@ -253,5 +265,133 @@ def main_dist():
     return 0
 
 
+def _amp_wire_bytes(amp, batches=8):
+    """pushpull bytes/step of a short fused-sync dist run over REAL
+    framing (local transport pinned off so the byte counters tick)."""
+    from mxtpu import kvstore_async as ka
+    os.environ["MXTPU_AMP"] = amp
+    np.random.seed(0)
+    x = np.random.randn(64, 64).astype("float32")
+    y = np.random.randint(0, 4, 64).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=256, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    saved_local = ka._LOCAL_ON
+    ka._LOCAL_ON = False
+    try:
+        mod.init_optimizer(kvstore="dist_async", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+        assert mod._fused is not None and mod._fused.mode == "dist", \
+            "fused dist path must engage for the %s wire run" % (
+                amp or "fp32")
+        kv = mod._kvstore
+        pool = list(it)
+        mod.forward_backward(pool[0])       # warmup/compile
+        mod.update()
+        before = kv._stats.snapshot()
+        for i in range(batches):
+            mod.forward_backward(pool[i % len(pool)])
+            mod.update()
+        after = kv._stats.snapshot()
+        kv.close()
+    finally:
+        ka._LOCAL_ON = saved_local
+        os.environ.pop("MXTPU_AMP", None)
+    sent = (after["bytes_sent"] - before["bytes_sent"]) / batches
+    recv = (after["bytes_recv"] - before["bytes_recv"]) / batches
+    return sent, recv
+
+
+def main_amp():
+    """The mixed-precision structural contract (MXTPU_AMP=bf16)."""
+    failures = []
+    os.environ["MXTPU_AMP"] = "bf16"
+    np.random.seed(0)
+    x = np.random.randn(128, 20).astype("float32")
+    y = np.random.randint(0, 4, 128).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    if mod._fused is None or mod._fused._group.amp != "bf16":
+        print("check_module_perf --amp: FAIL")
+        print("  - AMP did not engage on the fused path (amp=%r)"
+              % (getattr(mod._fused and mod._fused._group, "amp", None),))
+        return 1
+    fs = mod._fused._group
+    metric = mx.metric.create("acc")
+    batches = list(it)
+
+    def one(batch):
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+    for b in batches[:2]:
+        one(b)
+    metric.get()
+    stats = fs.stats
+    compiles_before = stats["compiles"]
+    metric.reset()
+
+    # -- 1: steady-state AMP epoch — zero retraces, zero host syncs ----
+    try:
+        with _no_d2h():
+            for i in range(_BATCHES):
+                one(batches[i % len(batches)])
+    except Exception as e:
+        failures.append(
+            "steady-state AMP fit loop performed a device->host "
+            "transfer per batch: %s: %s" % (type(e).__name__,
+                                            str(e)[:200]))
+    if stats["compiles"] != compiles_before:
+        failures.append(
+            "steady-state AMP epoch retraced: %d new compiles after "
+            "warmup (cast-in/cast-out must live INSIDE the one "
+            "program)" % (stats["compiles"] - compiles_before))
+
+    # -- 2: fp32 masters in the donated store --------------------------
+    for name, arr in fs.param_store.items():
+        if np.dtype(arr.dtype) != np.float32:
+            failures.append("master weight %r is %s (want fp32)"
+                            % (name, arr.dtype))
+    name_, value = metric.get()
+    if not (0.0 <= value <= 1.0):
+        failures.append("AMP device-accumulated accuracy out of "
+                        "range: %r" % (value,))
+    os.environ.pop("MXTPU_AMP", None)
+
+    # -- 3: the half-width wire, counter-based -------------------------
+    s32, r32 = _amp_wire_bytes("")
+    sbf, rbf = _amp_wire_bytes("bf16")
+    ratio = (sbf + rbf) / max(1.0, s32 + r32)
+    if ratio > 0.55:
+        failures.append(
+            "bf16 dist pushpull moved %.0f bytes/step vs fp32's %.0f "
+            "(ratio %.3f > 0.55): the half-width wire regressed"
+            % (sbf + rbf, s32 + r32, ratio))
+
+    if failures:
+        print("check_module_perf --amp: FAIL")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("check_module_perf --amp: OK (bf16 engaged fused, zero "
+          "retraces after warmup, zero per-batch host syncs, fp32 "
+          "masters, wire bytes ratio %.3f <= 0.55)" % ratio)
+    return 0
+
+
 if __name__ == "__main__":
+    if "--amp" in sys.argv:
+        sys.exit(main_amp())
     sys.exit(main_dist() if "--dist" in sys.argv else main())

@@ -115,21 +115,33 @@ class AsyncPushWindow:
                 "completed": self._completed}
 
 
-def kvstore_grad_pusher(kv):
+def kvstore_grad_pusher(kv, wire_dtype=None):
     """The ``set_grad_push`` hook wiring gradients to a (dist_async)
     KVStore: ``push_fn({name: grad})`` ships every gradient via
     ``kv.push_async`` on the store's worker pool, lazily ``kv.init``-ing
     unseen keys with zeros on first push (extracted from
-    ``ShardedTrainer.attach_kvstore`` so both stacks share it)."""
+    ``ShardedTrainer.attach_kvstore`` so both stacks share it).
+
+    ``wire_dtype`` (the AMP half-width wire, ISSUE 12): cast each
+    gradient to this dtype before it ships — a bf16 cast halves the
+    push bytes; the server's fp32 master table upcasts on apply
+    (``kvstore_async._wire_decode``). Keys still init fp32 (the master
+    dtype). Leave None when GradientCompression is installed — 2-bit
+    beats bf16, a double-compress would only add error."""
     inited = set()
 
     def _push(grads):
         new = [n for n in grads if n not in inited]
         if new:
-            kv.init(new, [NDArray(jnp.zeros_like(grads[n]._data))
+            # masters are fp32 regardless of the wire dtype
+            kv.init(new, [NDArray(jnp.zeros(grads[n].shape, jnp.float32))
                           for n in new])
             inited.update(new)
         keys = list(grads)
-        return kv.push_async(keys, [grads[k] for k in keys])
+        if wire_dtype is None:
+            return kv.push_async(keys, [grads[k] for k in keys])
+        return kv.push_async(
+            keys, [NDArray(grads[k]._data.astype(wire_dtype))
+                   for k in keys])
 
     return _push

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from .base import canonical_dtype, backward_mirror_enabled, maybe_remat
 from .context import current_context
+from .layout import AutoLayoutStep, auto_format
 from .ops.registry import rng_scope, split2 as _split2
 from .symbol import eval_graph
 from . import ndarray as nd
@@ -374,8 +375,57 @@ class Executor:
         return new_exe
 
     # -- fused train step --------------------------------------------------
+    @staticmethod
+    def _amp_cast(compute_dtype, cast_exclude):
+        """The cast-in half of the mixed-precision policy (ISSUE 12,
+        ``MXTPU_AMP=bf16``): floating parameters and inputs compute in
+        ``compute_dtype``, names in ``cast_exclude`` (labels — their
+        values are class indices a bf16 mantissa would corrupt) and
+        non-floating inputs pass through untouched. Aux states (BN
+        running statistics) are NEVER routed through this cast — they
+        stay fp32 in the donated store. The cast sits INSIDE the
+        differentiated function, so gradients come back in the master
+        dtype (fp32) through the cast VJP."""
+        exclude = frozenset(cast_exclude or ())
+
+        def _amp(name, v):
+            if compute_dtype is None or name in exclude \
+                    or not jnp.issubdtype(v.dtype, jnp.floating):
+                return v
+            return v.astype(compute_dtype)
+
+        return _amp
+
+    @staticmethod
+    def _amp_verdict(grads, loss_scale):
+        """Unscale loss-scaled gradients and compute the TrainGuard-style
+        finite verdict (fp32 global grad square-sum — NaN/Inf anywhere,
+        or a finite-but-exploded norm that overflows the square, flips
+        ``ok`` to False). Returns ``(grads_fp32_unscaled, ok)``."""
+        inv = jnp.float32(1.0 / loss_scale)
+        grads = tuple(g.astype(jnp.float32) * inv
+                      if jnp.issubdtype(g.dtype, jnp.floating) else g
+                      for g in grads)
+        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in grads)
+        return grads, jnp.isfinite(gsq)
+
+    @staticmethod
+    def _amp_select(ok, new, old):
+        """Overflow skip: hold every piece of persistent state at its
+        pre-step value when the verdict is False (nested tuples with
+        None leaves — optimizer state trees — supported)."""
+        if new is None:
+            return None
+        if isinstance(new, (tuple, list)):
+            return tuple(Executor._amp_select(ok, n, o)
+                         for n, o in zip(new, old))
+        return jnp.where(ok, new, old)
+
     def make_fused_train_step(self, train_names, optimizer, opt_slots,
-                              metric_fn=None, donate=True):
+                              metric_fn=None, donate=True,
+                              compute_dtype=None, loss_scale=None,
+                              cast_exclude=(), auto_layout=False):
         """Build ONE donated jitted XLA program running the whole train
         step: forward + backward (ones cotangents, loss-head pattern) +
         the ENTIRE optimizer update as a multi-tensor apply (every
@@ -390,6 +440,21 @@ class Executor:
         params) rides as a non-donated input in ``other_names`` order =
         ``[n for n in list_arguments() if n not in train_names]``.
 
+        Mixed precision (``MXTPU_AMP=bf16``): ``compute_dtype`` casts
+        floating params and inputs (minus ``cast_exclude`` — label
+        names) to the compute dtype INSIDE the program, so activations
+        and the backward run reduced-precision while the donated store
+        keeps fp32 master weights, fp32 optimizer state and fp32 aux
+        (BN statistics); gradients return fp32 through the cast VJP and
+        :func:`optimizer.functional_optimizer_step` applies in fp32 —
+        cast-in/cast-out in the SAME program, zero extra host syncs or
+        retraces. ``loss_scale`` additionally scales the head cotangent
+        by S, unscales the fp32 gradients by 1/S, and reuses the
+        TrainGuard isfinite verdict to SKIP the update in-program on
+        overflow (params/state/aux/step-count all held at their
+        pre-step values — a skipped step is indistinguishable from one
+        that never ran).
+
         Donation semantics: params (0), optimizer state trees (1), aux
         states (2), rng key (4), step count (5) and the metric
         accumulator (7) are donated — XLA updates the buffers in place,
@@ -398,6 +463,12 @@ class Executor:
         returned value after every step. Batches (3) and lr (6) are
         deliberately NOT donated: batches may be re-fed (pre-staged
         loops) and lr is a carried constant.
+
+        ``auto_layout`` compiles with XLA-chosen (AUTO) layouts for the
+        persistent state (in AND out, so donation carries the chosen
+        layouts across steps) and returns an
+        :class:`~mxtpu.layout.AutoLayoutStep` that relayouts the donated
+        store exactly once at compile, not per call.
 
         Returns ``(fn, other_names)`` where ``fn(train_vals, state_trees,
         aux_vals, other_vals, key, t, lr, metric_acc) -> (new_vals,
@@ -412,19 +483,29 @@ class Executor:
         other_names = tuple(n for n in arg_names if n not in train_set)
         opt_slots = tuple(opt_slots)
         mirror = self._mirror
+        amp = self._amp_cast(compute_dtype, cast_exclude)
+        scale = float(loss_scale) if loss_scale else None
 
         def _forward(gvals, other_vals, aux_vals, key):
-            local = dict(zip(other_names, other_vals))
+            local = {n: amp(n, v) for n, v in zip(other_names,
+                                                  other_vals)}
             local.update(zip(aux_names, aux_vals))
-            local.update(zip(train_names, gvals))
+            local.update((n, amp(n, v)) for n, v in zip(train_names,
+                                                        gvals))
             with rng_scope(key):
                 outs, aux_updates = eval_graph(outputs_ref, local, True)
             new_aux = tuple(aux_updates.get(n, local[n]) for n in aux_names)
             return tuple(outs), new_aux
 
+        def _head_cot(o):
+            if jnp.issubdtype(o.dtype, jnp.inexact):
+                ones = jnp.ones_like(o)
+                return ones * jnp.asarray(scale, o.dtype) if scale \
+                    else ones
+            return _np.zeros(o.shape, jax.dtypes.float0)
+
         donate_argnums = (0, 1, 2, 4, 5, 7) if donate else ()
 
-        @functools.partial(jax.jit, donate_argnums=donate_argnums)
         def fused(train_vals, state_trees, aux_vals, other_vals, key, t,
                   lr, metric_acc):
             key, sub = _split2(key)
@@ -436,9 +517,13 @@ class Executor:
             with jax.named_scope("fwd_bwd"):
                 (outs, new_aux), vjp_fn = jax.vjp(
                     maybe_remat(f, enabled=mirror), tuple(train_vals))
-                cot = tuple(_ones_cot(o) for o in outs)
+                cot = tuple(_head_cot(o) for o in outs)
                 zero_aux = tuple(_zeros_cot(a) for a in new_aux)
                 grads = vjp_fn((cot, zero_aux))[0]
+            ok = None
+            if scale:
+                with jax.named_scope("amp_guard"):
+                    grads, ok = self._amp_verdict(grads, scale)
             new_vals, new_states = [], []
             with jax.named_scope("optimizer"):
                 for slot, w, g, st in zip(opt_slots, train_vals, grads,
@@ -447,19 +532,48 @@ class Executor:
                         optimizer, slot, w, g, st, t, lr)
                     new_vals.append(w2)
                     new_states.append(st2)
+            if ok is not None:
+                with jax.named_scope("amp_select"):
+                    new_vals = [jnp.where(ok, nv, ov)
+                                for nv, ov in zip(new_vals, train_vals)]
+                    new_states = [self._amp_select(ok, ns, os_)
+                                  for ns, os_ in zip(new_states,
+                                                     state_trees)]
+                    new_aux = tuple(jnp.where(ok, na, oa)
+                                    for na, oa in zip(new_aux, aux_vals))
+                    t = jnp.where(ok, t, t - 1)
             if metric_fn is not None:
                 with jax.named_scope("metric"):
                     m_sum, m_cnt = metric_fn(dict(zip(other_names,
                                                       other_vals)), outs)
-                    metric_acc = metric_acc + jnp.stack(
-                        [m_sum, m_cnt]).astype(metric_acc.dtype)
+                    contrib = jnp.stack([m_sum, m_cnt]).astype(
+                        metric_acc.dtype)
+                    if ok is not None:
+                        # a skipped step contributes nothing — one NaN
+                        # batch must not poison the epoch accumulator
+                        contrib = jnp.where(ok, contrib,
+                                            jnp.zeros_like(contrib))
+                    metric_acc = metric_acc + contrib
             return (tuple(new_vals), tuple(new_states), tuple(new_aux),
                     outs, key, t, metric_acc)
 
-        return fused, other_names
+        if auto_layout:
+            auto = auto_format()
+            jitted = jax.jit(
+                fused,
+                in_shardings=tuple(auto if i in (0, 1, 2) else None
+                                   for i in range(8)),
+                out_shardings=tuple(auto if i in (0, 1, 2) else None
+                                    for i in range(7)),
+                donate_argnums=donate_argnums)
+            return AutoLayoutStep(jitted, state_argnums=(0, 1, 2)), \
+                other_names
+        return jax.jit(fused, donate_argnums=donate_argnums), other_names
 
     def make_fused_grad_step(self, train_names, metric_fn=None,
-                             donate=True):
+                             donate=True, compute_dtype=None,
+                             loss_scale=None, cast_exclude=(),
+                             wire_dtype=None, auto_layout=False):
         """Grad-EMITTING mode of the fused train step — the
         kvstore/dist path (ISSUE 10). ONE jitted program runs forward +
         backward (ones cotangents, loss-head pattern) + the optional
@@ -467,6 +581,18 @@ class Executor:
         instead of applying an optimizer: the update happens where the
         kvstore says it does — server-side (``update_on_kvstore``) or
         locally through :meth:`make_fused_apply_step` after the pull.
+
+        Mixed precision (ISSUE 12): ``compute_dtype`` applies the same
+        cast-in policy as :meth:`make_fused_train_step` (bf16 params +
+        activations, fp32 aux, fp32 gradients at the cast boundary);
+        ``wire_dtype`` casts the EMITTED gradients — the push payload —
+        down in the same program, so the kvstore wire carries half-width
+        bytes with no extra dispatch (the server's fp32 master table
+        upcasts on apply, ``kvstore_async._wire_decode``). With
+        ``loss_scale``, an overflow step emits ZERO gradients instead of
+        scaled garbage (the server applies a no-op update — the dist
+        rendering of the skip, with no extra host sync) and holds the
+        aux states at their pre-step values.
 
         Donation semantics: the parameters are NOT donated — this
         program only reads them, and the kvstore pull rebinds them
@@ -485,19 +611,29 @@ class Executor:
         train_set = set(train_names)
         other_names = tuple(n for n in arg_names if n not in train_set)
         mirror = self._mirror
+        amp = self._amp_cast(compute_dtype, cast_exclude)
+        scale = float(loss_scale) if loss_scale else None
 
         def _forward(gvals, other_vals, aux_vals, key):
-            local = dict(zip(other_names, other_vals))
+            local = {n: amp(n, v) for n, v in zip(other_names,
+                                                  other_vals)}
             local.update(zip(aux_names, aux_vals))
-            local.update(zip(train_names, gvals))
+            local.update((n, amp(n, v)) for n, v in zip(train_names,
+                                                        gvals))
             with rng_scope(key):
                 outs, aux_updates = eval_graph(outputs_ref, local, True)
             new_aux = tuple(aux_updates.get(n, local[n]) for n in aux_names)
             return tuple(outs), new_aux
 
+        def _head_cot(o):
+            if jnp.issubdtype(o.dtype, jnp.inexact):
+                ones = jnp.ones_like(o)
+                return ones * jnp.asarray(scale, o.dtype) if scale \
+                    else ones
+            return _np.zeros(o.shape, jax.dtypes.float0)
+
         donate_argnums = (1, 3, 4) if donate else ()
 
-        @functools.partial(jax.jit, donate_argnums=donate_argnums)
         def fused_grads(train_vals, aux_vals, other_vals, key, metric_acc):
             key, sub = _split2(key)
 
@@ -507,21 +643,52 @@ class Executor:
             with jax.named_scope("fwd_bwd"):
                 (outs, new_aux), vjp_fn = jax.vjp(
                     maybe_remat(f, enabled=mirror), tuple(train_vals))
-                cot = tuple(_ones_cot(o) for o in outs)
+                cot = tuple(_head_cot(o) for o in outs)
                 zero_aux = tuple(_zeros_cot(a) for a in new_aux)
                 grads = vjp_fn((cot, zero_aux))[0]
+            ok = None
+            if scale:
+                with jax.named_scope("amp_guard"):
+                    grads, ok = self._amp_verdict(grads, scale)
+                    grads = tuple(jnp.where(ok, g, jnp.zeros_like(g))
+                                  for g in grads)
+                    new_aux = tuple(jnp.where(ok, na, oa)
+                                    for na, oa in zip(new_aux, aux_vals))
+            if wire_dtype is not None:
+                grads = tuple(g.astype(wire_dtype)
+                              if jnp.issubdtype(g.dtype, jnp.floating)
+                              else g for g in grads)
             if metric_fn is not None:
                 with jax.named_scope("metric"):
                     m_sum, m_cnt = metric_fn(dict(zip(other_names,
                                                       other_vals)), outs)
-                    metric_acc = metric_acc + jnp.stack(
-                        [m_sum, m_cnt]).astype(metric_acc.dtype)
+                    contrib = jnp.stack([m_sum, m_cnt]).astype(
+                        metric_acc.dtype)
+                    if ok is not None:
+                        contrib = jnp.where(ok, contrib,
+                                            jnp.zeros_like(contrib))
+                    metric_acc = metric_acc + contrib
             return grads, tuple(new_aux), outs, key, metric_acc
 
-        return fused_grads, other_names
+        if auto_layout:
+            # AUTO only where donation carries the layout across steps
+            # (the aux store); params arrive via the kvstore pull's
+            # device_put each step, so AUTO there would relayout per
+            # call instead of once
+            auto = auto_format()
+            jitted = jax.jit(
+                fused_grads,
+                in_shardings=tuple(auto if i == 1 else None
+                                   for i in range(5)),
+                out_shardings=tuple(auto if i == 1 else None
+                                    for i in range(5)),
+                donate_argnums=donate_argnums)
+            return AutoLayoutStep(jitted, state_argnums=(1,)), other_names
+        return jax.jit(fused_grads, donate_argnums=donate_argnums), \
+            other_names
 
     def make_fused_apply_step(self, train_names, optimizer, opt_slots,
-                              donate=True):
+                              donate=True, auto_layout=False):
         """The optimizer half of the fused step on its own — the
         locally-applied update of the kvstore dist path (ISSUE 10,
         ``update_on_kvstore=False``): after the pull returns the merged
@@ -530,7 +697,10 @@ class Executor:
         parameters (0), optimizer state trees (1) and step count (3)
         donated so XLA updates the buffers in place. Gradients (2) and
         lr (4) are not donated (grads arrive as freshly-pulled host
-        values; lr is a carried constant).
+        values; lr is a carried constant). Half-precision gradients (a
+        bf16 wire pull, ISSUE 12) upcast to the master-weight dtype
+        inside ``functional_optimizer_step`` — the apply always runs
+        fp32.
 
         Returns ``fn(train_vals, state_trees, grad_vals, t, lr) ->
         (new_vals, new_states, t+1)``.
@@ -540,7 +710,6 @@ class Executor:
 
         donate_argnums = (0, 1, 3) if donate else ()
 
-        @functools.partial(jax.jit, donate_argnums=donate_argnums)
         def fused_apply(train_vals, state_trees, grad_vals, t, lr):
             t = t + 1
             new_vals, new_states = [], []
@@ -553,7 +722,17 @@ class Executor:
                     new_states.append(st2)
             return tuple(new_vals), tuple(new_states), t
 
-        return fused_apply
+        if auto_layout:
+            auto = auto_format()
+            jitted = jax.jit(
+                fused_apply,
+                in_shardings=tuple(auto if i in (0, 1) else None
+                                   for i in range(5)),
+                out_shardings=tuple(auto if i in (0, 1) else None
+                                    for i in range(3)),
+                donate_argnums=donate_argnums)
+            return AutoLayoutStep(jitted, state_argnums=(0, 1))
+        return jax.jit(fused_apply, donate_argnums=donate_argnums)
 
     def adopt_arrays(self, arg_src, aux_src):
         """Alias this executor's argument/aux slots to the given NDArray

@@ -49,6 +49,11 @@ Rule keys:
 ``point``  ``worker.send`` | ``worker.recv`` | ``server.recv`` |
            ``server.send`` | ``worker.step`` (fired by the guarded
            training loop once per step, before the jitted step runs) |
+           ``module.step`` (fired by the fused Module train step once
+           per step, before the donated program dispatches —
+           ``nan_grad`` here poisons the batch through the real
+           compute path, the AMP loss-scale overflow-skip drill;
+           ``mxtpu/module/fused.py``) |
            ``serve.request`` (model-serving admission: fired once per
            predict request as it is admitted, ``op=predict``,
            ``key=``request id — ``drop`` loses the admitted request
@@ -114,8 +119,8 @@ __all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
            "inject", "fire", "active"]
 
 _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
-           "worker.step", "serve.request", "serve.batch", "serve.swap",
-           "publish.snapshot", "any")
+           "worker.step", "module.step", "serve.request", "serve.batch",
+           "serve.swap", "publish.snapshot", "any")
 _KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
           "nan_grad", "kill_worker", "join_worker", "leave_worker",
           "split_shard")
@@ -149,9 +154,18 @@ class _Rule:
                              % (point, "/".join(_POINTS)))
         if kind == "kill" and point.startswith("worker"):
             raise ValueError("kind=kill only applies to server points")
-        if kind in _SIGNAL_KINDS and point not in ("worker.step", "any"):
-            raise ValueError(
-                "kind=%s only applies to the worker.step point" % kind)
+        if kind in _SIGNAL_KINDS:
+            # nan_grad poisons a training step's batch at EITHER
+            # training-loop point (the guarded gluon loop, or the fused
+            # Module step — the AMP loss-scale overflow drill); the
+            # elastic kinds stay worker.step-only (the guard owns the
+            # fleet callbacks)
+            allowed = ("worker.step", "module.step", "any") \
+                if kind == "nan_grad" else ("worker.step", "any")
+            if point not in allowed:
+                raise ValueError(
+                    "kind=%s only applies to the %s point"
+                    % (kind, "/".join(allowed[:-1])))
         # kill_worker is allowed at ANY point: at worker.step it is the
         # deterministic kill -9 of a worker mid-step; at a server point
         # (scoped by role=server) it SIGKILLs a parameter-server process

@@ -115,7 +115,13 @@ class GradientCompression:
         """Quantize one array for wire transfer; updates the slot's
         residual. Returns the packed uint32 representation. numpy input
         (the kvstore push path) quantizes host-side — no device round
-        trip per part — via the bit-identical numpy mirror."""
+        trip per part — via the bit-identical numpy mirror.
+
+        Composes with AMP (``MXTPU_AMP=bf16``): 2 bits beat 16, so the
+        fused dist step SKIPS its bf16 wire cast when compression is on
+        (``FusedGroupState.attach_kvstore``) and full-precision parts
+        land here — no double-compress; a half-precision part that
+        arrives anyway upcasts through the f32 quantizer math below."""
         res = self._residuals.get(slot)
         if isinstance(array, _np.ndarray):
             if res is None or res.shape != array.shape:

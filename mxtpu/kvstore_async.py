@@ -233,6 +233,16 @@ connection, message coalescing — rendered here; measured in
   (the fault matrix pins it off so every row exercises real framing;
   note the shortcut also bypasses the ``MXTPU_PS_TOKEN`` preamble —
   a same-process peer already runs our code).
+* **Half-width wire (AMP).** With ``MXTPU_AMP=bf16`` the fused Module
+  step ships bf16 gradients — the payload array's dtype IS the wire
+  tag. ``_wire_decode`` upcasts into the server's fp32 MASTER table
+  (accumulate and the host-mirror optimizer always apply full
+  precision), ``pushpull`` replies bf16 in kind, and the client's
+  ``_assemble_pulled`` restores the pull target's dtype before the
+  one batched device_put — both directions halve (~0.50x bytes/step,
+  ``ci/check_module_perf.py --amp``). Replays are dtype-stable
+  through the seq dedupe; GradientCompression wins the format contest
+  when installed (2 bits beat 16 — compressed parts arrive fp32).
 * **Counters.** ``kv.stats()`` reports wire bytes/frames, coalescing,
   the in-flight high-water mark and retransmits — ``ci/
   check_comms_perf.py`` pins the overhead without wall-clock timing.
@@ -361,16 +371,43 @@ def _part_bounds(shape, bound=None):
             for r in range(0, nrows, rows_per)]
 
 
+def _half_float(dtype):
+    """Half-width float payload detection — the wire dtype tag of the
+    AMP fast path (``MXTPU_AMP=bf16``, docs/perf_analysis.md "Mixed
+    precision"): a push/pushpull frame whose payload array is bf16 or
+    fp16 carries half the bytes and upcasts into the fp32 master table
+    on apply. ml_dtypes registers bfloat16 OUTSIDE numpy's float
+    hierarchy (``np.issubdtype`` says False), so compare directly."""
+    try:
+        dtype = _np.dtype(dtype)
+    except TypeError:
+        return False
+    if dtype == _np.float16:
+        return True
+    return _bfloat16 is not None and dtype == _bfloat16
+
+
+try:
+    import ml_dtypes as _ml_dtypes
+    _bfloat16 = _np.dtype(_ml_dtypes.bfloat16)
+except ImportError:      # pragma: no cover - ml_dtypes ships with jax
+    _bfloat16 = None
+
+
 def _wire_decode(grad):
     """Server side of the push payload: dense ndarray passes through;
     a 2-bit-compressed tuple is dequantized (reference PushCompressed →
-    server-side dequantize, kvstore_dist_server.h)."""
+    server-side dequantize, kvstore_dist_server.h); a half-width (bf16
+    AMP) payload upcasts to fp32 so the master table and the server's
+    numpy host-mirror optimizer ALWAYS apply in full precision."""
     if isinstance(grad, tuple) and len(grad) == 4 and grad[0] == _GC_MARK:
         from .gradient_compression import dequantize_2bit
         _, threshold, packed, shape = grad
         import jax.numpy as jnp
         return _np.asarray(dequantize_2bit(jnp.asarray(packed),
                                            threshold, shape))
+    if isinstance(grad, _np.ndarray) and _half_float(grad.dtype):
+        return grad.astype(_np.float32)
     return grad
 
 
@@ -1559,6 +1596,18 @@ class ParameterServer:
                     return ("err", "pull of uninitialized key %r" % (key,))
                 tbl = self._table[key]
                 value = tbl if self._updater is not None else tbl.copy()
+                # half-width wire (AMP): the push payload's dtype IS the
+                # tag — reply in kind, so a bf16 pushpull round trip
+                # ships half the bytes BOTH ways while the table stays
+                # the fp32 master. A deduped replay carries the same
+                # payload, so its reply keeps the same dtype (the
+                # at-most-once apply / always-fresh read contract is
+                # dtype-stable).
+                wire_dt = getattr(msg[2], "dtype", None)
+                if wire_dt is not None and _half_float(wire_dt) and \
+                        isinstance(value, _np.ndarray) and \
+                        value.dtype == _np.float32:
+                    value = value.astype(wire_dt)
                 return ("ok", value, self._clock[key])
         if cmd == "pull":
             _, key = msg
@@ -2959,6 +3008,7 @@ class AsyncDistKVStore(KVStore):
         self._pending_lock = threading.Lock()
         self._extra_stats = {}     # name -> fn; merged into stats()
         #                            (TrainGuard registers its counters)
+        self._seq_pool = None      # lazy order-preserving push executor
         from concurrent.futures import ThreadPoolExecutor
         # parts of one array move concurrently: enough workers to keep
         # every socket of every server pool in flight
@@ -3370,7 +3420,7 @@ class AsyncDistKVStore(KVStore):
         return self._note_pulled(sk, reply[1], reply[2])
 
     def push_pull_async(self, key, value, out=None, priority=0):
-        """One worker-pool job: push, then (optionally) pull the same
+        """One background job: push, then (optionally) pull the same
         keys — the fused Module dist step's per-batch wire op
         (``module/fused.py``). The push ships this step's gradients;
         the chained pull lands the server's post-update values directly
@@ -3378,7 +3428,18 @@ class AsyncDistKVStore(KVStore):
         merged-gradient buffers), all OFF the training thread so the
         next step's compute overlaps the wire and the device->host
         gradient read never blocks dispatch. Returns a Future; failures
-        surface at ``.result()`` (the bounded-inflight window drain)."""
+        surface at ``.result()`` (the bounded-inflight window drain).
+
+        Jobs run on a dedicated ONE-worker executor, in submission
+        order, each completing (failover replays included) before the
+        next starts: the server's per-(origin, key) dedupe is a
+        monotone seq WATERMARK, so two concurrent step frames whose
+        failover replays landed out of order would have the earlier
+        seq wrongly refused as a dup — a lost acknowledged update.
+        Serializing the wire jobs preserves per-key seq order end to
+        end while the training thread still overlaps compute with the
+        in-flight job (the window's whole point); the multi-server
+        fan-out INSIDE one job still rides the shared pool."""
         def _job():
             vals = value
             if isinstance(vals, (list, tuple)) and vals and \
@@ -3391,7 +3452,18 @@ class AsyncDistKVStore(KVStore):
             else:
                 self.push(key, vals, priority)
 
-        return self._pool.submit(_job)
+        return self._ordered_pool().submit(_job)
+
+    def _ordered_pool(self):
+        """Lazy one-worker executor for order-sensitive async wire jobs
+        (named OUTSIDE the ``mxtpu-ps`` prefix so a job's _pmap fan-out
+        may still nest submits into the main pool)."""
+        pool = self._seq_pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            pool = self._seq_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="mxtpu-ordered-push")
+        return pool
 
     def _buffer_push(self, conn, sk, payload, base_clock, seq):
         with self._pending_lock:
@@ -3550,6 +3622,14 @@ class AsyncDistKVStore(KVStore):
                 full = full.astype(_np.float32)
             elif full.dtype == _np.int64:
                 full = full.astype(_np.int32)
+            else:
+                tgt0 = o[0] if isinstance(o, (list, tuple)) else o
+                tdt = _np.dtype(getattr(tgt0, "dtype", full.dtype))
+                if full.dtype != tdt and _half_float(full.dtype):
+                    # half-width wire reply (bf16 pushpull, AMP):
+                    # restore the pull target's master dtype host-side,
+                    # before the ONE batched device_put
+                    full = full.astype(tdt)
             assembled.append((o, full))
         if not assembled:
             return
@@ -4022,6 +4102,9 @@ class AsyncDistKVStore(KVStore):
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)
             self._hb_thread = None
+        if self._seq_pool is not None:
+            self._seq_pool.shutdown(wait=True)
+            self._seq_pool = None
         self._pool.shutdown(wait=True)
         # clean departure: servers drop this worker's membership and
         # reclaim its dedupe seqs NOW instead of waiting out the

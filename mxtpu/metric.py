@@ -46,13 +46,24 @@ def _host(arr, dtype=None):
     when the value is already host-resident: a numpy-backed input (or a
     CPU jax buffer ``device_get`` can hand back as-is) flows through
     ``asarray`` views, and the dtype cast copies only when the dtype
-    actually differs (``astype(copy=False)``)."""
+    actually differs (``astype(copy=False)``).
+
+    Half-precision values (bf16/fp16 — the AMP fused step's outputs)
+    upcast to f32 by default: metric math must accumulate in f32 even
+    when the step computes bf16, or a sum of >~256 same-magnitude terms
+    silently stops growing (8 mantissa bits)."""
     if isinstance(arr, ndarray.NDArray):
         import jax
         out = numpy.asarray(jax.device_get(arr._data))
     else:
         out = numpy.asarray(arr)
-    return out if dtype is None else out.astype(dtype, copy=False)
+    if dtype is None:
+        # ml_dtypes' bfloat16 sits outside numpy's float hierarchy
+        # (issubdtype says False) — detect halves by width + non-integer
+        if out.dtype.itemsize == 2 and out.dtype.kind not in "iub":
+            return out.astype(numpy.float32)
+        return out
+    return out.astype(dtype, copy=False)
 
 
 def _listed(x):
@@ -421,6 +432,10 @@ class _PairwiseError(EvalMetric):
 
     def device_batch(self, labels, preds):
         def col(x):
+            # f32 before the reduction: a bf16 step's outputs must not
+            # accumulate their error sums in 8 mantissa bits
+            x = x.astype(jnp.float32) if jnp.issubdtype(
+                x.dtype, jnp.inexact) else x
             return x.reshape(x.shape[0], 1) if x.ndim == 1 else x
         total, count = 0.0, 0.0
         for truth, scores in zip(labels, preds):
@@ -500,7 +515,10 @@ class _ProbNLL(EvalMetric):
         for truth, scores in zip(labels, preds):
             rows = scores.shape[0]
             expected = truth.ravel().astype(jnp.int32)
-            chosen = scores[jnp.arange(rows), expected]
+            # f32 log + sum: bf16 probabilities lose the tail the log
+            # exists to resolve, and a bf16 sum drifts past ~256 rows
+            chosen = scores[jnp.arange(rows), expected].astype(
+                jnp.float32)
             total = total - jnp.sum(jnp.log(chosen + self.eps))
             count += rows
         return total, count
@@ -557,12 +575,16 @@ class Loss(EvalMetric):
         if isinstance(preds, ndarray.NDArray):
             preds = [preds]
         for scores in preds:
-            self._accum(float(ndarray.sum(scores).asscalar()), scores.size)
+            # host f32 sum (via _host's half-precision upcast): summing
+            # a bf16 loss vector in bf16 sticks at ~256
+            self._accum(float(_host(scores).sum()), scores.size)
 
     def device_batch(self, labels, preds):
         total, count = 0.0, 0.0
         for scores in preds:
-            total = total + jnp.sum(scores).astype(jnp.float32)
+            # cast BEFORE the reduction — sum-of-bf16 drifts past ~256
+            # elements, the .astype after the fact cannot recover it
+            total = total + jnp.sum(scores.astype(jnp.float32))
             count += scores.size
         return total, count
 

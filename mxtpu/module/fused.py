@@ -43,6 +43,35 @@ next step's compute overlaps the wire; the default ``sync`` mode ships
 inline and matches the eager dist path bit-for-bit.
 ``MXTPU_MODULE_FUSED_DIST=0`` confines fusion to the local path.
 
+Mixed precision (ISSUE 12): ``MXTPU_AMP=bf16`` makes bf16-with-fp32-
+master-weights a MODE of the same one-program contract, not a separate
+path. The donated store keeps fp32 master weights, fp32 optimizer state
+and fp32 aux (BN running statistics); the program casts params and
+floating inputs (never labels, never aux) to bf16 INSIDE the trace, so
+activations and the backward run on the MXU's native reduced precision
+while gradients return fp32 through the cast VJP and
+``functional_optimizer_step`` applies in fp32 — cast-in/cast-out in the
+SAME program: zero extra host syncs, zero retraces. On the dist modes
+the grad-emitting program additionally casts the EMITTED gradients to
+bf16 for the wire (``kv.push_pull`` frames carry the dtype in the
+payload; the server's fp32 master table upcasts on apply and replies
+bf16 in kind — wire bytes per step drop ~2x on top of coalescing),
+unless GradientCompression is installed (2-bit beats bf16: compressed
+parts skip the cast, no double-compress). ``MXTPU_AMP_LOSS_SCALE=S``
+optionally scales the loss by S and reuses the TrainGuard isfinite
+verdict in-program: an overflow step is skipped (local modes: every
+donated buffer held at its pre-step value; dist mode: zero gradients
+ship, a server no-op) with the skip count readable via
+``FusedGroupState.amp_overflow_skips()``. AMP-ineligible setups (non-
+fp32 parameters) log their reason once at debug level and keep the
+fp32 fused path — never a silent wrong-dtype step.
+
+``MXTPU_AUTO_LAYOUT=1`` (shared with ShardedTrainer via
+``mxtpu/layout.py``) compiles the fused programs with XLA-chosen AUTO
+layouts for the donated persistent state and relayouts the store ONCE
+at compile, not per call — the layout-copy share of the step trace
+goes to the compiler's choice.
+
 Escape hatch: anything the one-program contract can't honor — a
 ``Monitor`` install (wants per-node outputs), a custom Python updater,
 sparse parameters, multi-context groups, ``inputs_need_grad`` — falls
@@ -53,6 +82,7 @@ mechanism (``docs/env_vars.md``).
 """
 from __future__ import annotations
 
+import copy
 import logging
 import os
 import threading
@@ -62,16 +92,18 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from .. import fault as _fault
 from .. import ndarray as nd
 from .. import optimizer as opt_mod
 from ..dist_hooks import AsyncPushWindow, push_inflight
+from ..layout import auto_layout_enabled
 from ..model import _module_fused_enabled
 from ..ndarray import NDArray, _wrap
 from ..optimizer import state_to_tree
 
 __all__ = ["ProgramCache", "FusedGroupState", "FusedModuleTrainer",
            "maybe_create", "attach_borrowed", "metric_readback_interval",
-           "_fused_eligible"]
+           "_fused_eligible", "amp_mode", "amp_loss_scale"]
 
 
 class ProgramCache:
@@ -141,6 +173,32 @@ def _fused_dist_enabled():
         not in ("0", "false", "off")
 
 
+def amp_mode():
+    """MXTPU_AMP: mixed-precision mode of the fused Module path.
+    Default off; ``bf16`` = bf16 compute params + activations with fp32
+    master weights, optimizer state and aux living in the donated store
+    (module docstring, "Mixed precision"). Anything else raises — a
+    typo'd dtype silently training fp32 would defeat the point."""
+    v = os.environ.get("MXTPU_AMP", "").strip().lower()
+    if v in ("", "0", "off", "none", "false"):
+        return None
+    if v in ("bf16", "bfloat16"):
+        return "bf16"
+    raise ValueError("MXTPU_AMP must be unset/'bf16', got %r" % v)
+
+
+def amp_loss_scale():
+    """MXTPU_AMP_LOSS_SCALE: static loss scale S for the AMP step
+    (0/unset = off — bf16 shares fp32's exponent range, so scaling is
+    optional belt-and-braces). When set, the fused program scales the
+    head cotangent by S, unscales gradients by 1/S in fp32, and skips
+    the step in-program when the TrainGuard isfinite verdict fails."""
+    try:
+        return float(os.environ.get("MXTPU_AMP_LOSS_SCALE", "0") or 0.0)
+    except ValueError:
+        return 0.0
+
+
 def dist_mode():
     """MXTPU_MODULE_DIST_MODE: ``sync`` (default — push+pull inline,
     bit-for-bit with the eager dist path) or ``async`` (pipelined on the
@@ -176,21 +234,53 @@ class FusedGroupState:
         self.warned_fallback = False
         self.stats = {"steps": 0, "compiles": 0, "cache_hits": 0,
                       "metric_drains": 0}
+        # mixed precision (MXTPU_AMP, module docstring): fixed for the
+        # group's lifetime at maybe_create so every bucket and every
+        # cached program agrees on the one policy
+        self.amp = None                  # None | "bf16"
+        self.compute_dtype = None        # jnp dtype params/inputs cast to
+        self.loss_scale = None           # static S, or None
+        self.wire_dtype = None           # dist: emitted-gradient dtype
+        self.auto_layout = auto_layout_enabled()
         # dist modes (attach_kvstore): the store, the sync/async policy
         # and the ONE shared push window across the group's buckets
         self.kv = None
         self.dist_mode = None
         self.window = None
 
+    def set_amp(self, amp):
+        """Engage the group's mixed-precision policy (maybe_create)."""
+        self.amp = amp
+        if amp == "bf16":
+            self.compute_dtype = jnp.bfloat16
+            scale = amp_loss_scale()
+            self.loss_scale = scale if scale else None
+            self.wire_dtype = jnp.bfloat16
+
+    def amp_overflow_skips(self):
+        """Loss-scale overflow steps skipped so far, on the modes whose
+        program carries the donated step count (local / dist_local):
+        attempted steps (the host counter) minus applied steps (ONE
+        on-demand device read of the donated ``t`` — never on the hot
+        path). 0 when loss scaling is off."""
+        if not self.loss_scale or self.t_dev is None:
+            return 0
+        return int(self.num_update) - int(jax.device_get(self.t_dev))
+
     def attach_kvstore(self, kv):
         """Wire the group to its kvstore (dist modes): the shared async
         push/pull window (one per optimizer group — buckets share it)
         plus the ``kv.stats()['module_fused_dist']`` counter source the
         ``ci/check_module_perf.py --dist`` bounded-inflight contract
-        reads."""
+        reads. With AMP on, gradient compression wins the wire-format
+        contest: 2-bit beats bf16, so compressed stores keep fp32
+        emitted gradients (no double-compress) while compute stays
+        bf16."""
         self.kv = kv
         self.dist_mode = dist_mode()
         self.window = AsyncPushWindow(push_inflight())
+        if getattr(kv, "_compression", None) is not None:
+            self.wire_dtype = None
         if hasattr(kv, "add_stats_source"):
             kv.add_stats_source("module_fused_dist", self.window.stats)
 
@@ -440,6 +530,14 @@ class FusedModuleTrainer:
         fs = self._group
         if isinstance(data_batch, list):
             return False  # multi-module list batches: eager path
+        # deterministic injection point of the fused training loop
+        # (fault-matrix: the loss-scale overflow-skip drill seeds
+        # nan_grad here, once per fused step)
+        act = _fault.fire("module.step", op="step")
+        if act == "nan_grad":
+            data_batch = copy.copy(data_batch)
+            data_batch.data = [NDArray(d._data * _np.nan)
+                               for d in data_batch.data]
         exec_group = mod._exec_group
         exec_ = exec_group.execs[0]
         if exec_._monitor_callback is not None:
@@ -473,7 +571,11 @@ class FusedModuleTrainer:
         entry, hit = self._cache.get(
             key, lambda: exec_.make_fused_train_step(
                 self._train_names, fs.optimizer, self._opt_slots,
-                metric_fn=metric_fn))
+                metric_fn=metric_fn,
+                compute_dtype=fs.compute_dtype,
+                loss_scale=fs.loss_scale,
+                cast_exclude=tuple(mod._label_names),
+                auto_layout=fs.auto_layout))
         fs.stats["cache_hits" if hit else "compiles"] += 1
         fn, other_names = entry
 
@@ -538,7 +640,12 @@ class FusedModuleTrainer:
         metric_fn = fs.metric_fn if fs.metric_key is not None else None
         entry, hit = self._cache.get(
             key, lambda: exec_.make_fused_grad_step(
-                self._train_names, metric_fn=metric_fn))
+                self._train_names, metric_fn=metric_fn,
+                compute_dtype=fs.compute_dtype,
+                loss_scale=fs.loss_scale,
+                cast_exclude=tuple(self._module._label_names),
+                wire_dtype=fs.wire_dtype,
+                auto_layout=fs.auto_layout))
         fs.stats["cache_hits" if hit else "compiles"] += 1
         fn, other_names = entry
 
@@ -640,7 +747,8 @@ class FusedModuleTrainer:
                               for g in grad_vals))
         fn, hit = self._cache.get(
             key, lambda: exec_.make_fused_apply_step(
-                self._train_names, fs.optimizer, self._opt_slots))
+                self._train_names, fs.optimizer, self._opt_slots,
+                auto_layout=fs.auto_layout))
         fs.stats["cache_hits" if hit else "compiles"] += 1
 
         train_vals = tuple(exec_.arg_dict[n]._data
@@ -733,6 +841,39 @@ def _log_fallback(module, reason):
         "'Distributed Module fast path')", reason)
 
 
+def _amp_eligible(module):
+    """The AMP-mode eligibility predicate (``MXTPU_AMP=bf16``): returns
+    ``(amp, reason)``. An ineligible combination NAMES its reason —
+    logged once at debug level, like the PR-10 fallback matrix — and
+    keeps the fp32 fused path: never a silent wrong-dtype step. The
+    custom-updater/monitor outs are handled upstream (they leave the
+    fused path entirely)."""
+    amp = amp_mode()
+    if amp is None:
+        return None, None
+    exec_ = module._exec_group.execs[0]
+    for name, arr in exec_.arg_dict.items():
+        if exec_.grad_dict.get(name) is None:
+            continue
+        if _np.dtype(arr.dtype) != _np.float32:
+            return None, (
+                "MXTPU_AMP=bf16 requested but parameter %r is %s — AMP "
+                "needs fp32 master weights (fp64/fp16 params keep the "
+                "fp32 fused step)" % (name, _np.dtype(arr.dtype).name))
+    return amp, None
+
+
+def _log_amp_fallback(module, reason):
+    """One-shot debug log naming why AMP stayed off while the fused
+    path engaged (the wrong-dtype half of the fallback contract)."""
+    if getattr(module, "_amp_fallback_logged", None) == reason:
+        return
+    module._amp_fallback_logged = reason
+    logger = getattr(module, "logger", None) or logging
+    logger.debug("Module AMP mode not engaged: %s — fp32 fused step "
+                 "(docs/perf_analysis.md 'Mixed precision')", reason)
+
+
 def maybe_create(module):
     """Called at the end of ``Module.init_optimizer``: build the fused
     trainer (and become the group's store owner) when eligible."""
@@ -742,6 +883,11 @@ def maybe_create(module):
         return None
     group = FusedGroupState(module._optimizer, module._updater,
                             module._context[0])
+    amp, amp_reason = _amp_eligible(module)
+    if amp is not None:
+        group.set_amp(amp)
+    elif amp_reason is not None:
+        _log_amp_fallback(module, amp_reason)
     if mode != "local":
         group.attach_kvstore(module._kvstore)
     trainer = FusedModuleTrainer(module, group, mode)

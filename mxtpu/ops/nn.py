@@ -336,10 +336,16 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     x32 = data.astype(jnp.float32)
     if _training and not use_global_stats:
         mean, var = _bn_stats(x32, axis)
-        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) \
-            * (1 - momentum)
-        new_mv = moving_var * momentum + var.astype(moving_var.dtype) \
-            * (1 - momentum)
+        # the running-stat blend ALSO computes in f32 (f32 casts are
+        # no-ops for the standard f32 aux store; a reduced-precision
+        # store would otherwise round the momentum product per batch —
+        # the convert/drift half of the BN-stat traffic). The updated
+        # stats live in the donated aux store, so the whole update stays
+        # inside the one fused step program.
+        new_mm = (moving_mean.astype(jnp.float32) * momentum
+                  + mean * (1 - momentum)).astype(moving_mean.dtype)
+        new_mv = (moving_var.astype(jnp.float32) * momentum
+                  + var * (1 - momentum)).astype(moving_var.dtype)
     else:
         mean, var = moving_mean, moving_var
         new_mm, new_mv = moving_mean, moving_var

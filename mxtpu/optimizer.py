@@ -133,7 +133,17 @@ def functional_optimizer_step(optimizer, index, weight_val, grad_val,
     """Run one Optimizer.update purely: (w, g, state, t, lr) → (w', state').
 
     Reuses the full imperative optimizer library (all 14 registered
-    optimizers, reference optimizer.py:432-1434) inside jit."""
+    optimizers, reference optimizer.py:432-1434) inside jit. Mixed
+    precision (``MXTPU_AMP=bf16``): a reduced-precision gradient — the
+    bf16 wire payload of the fused dist path, or a bf16 compute grad —
+    upcasts to the master-weight dtype here, so the optimizer math
+    ALWAYS runs in the weight's (fp32) precision; same-dtype callers
+    see a no-op."""
+    if hasattr(grad_val, "astype") and \
+            grad_val.dtype != weight_val.dtype and \
+            jnp.issubdtype(weight_val.dtype, jnp.floating) and \
+            jnp.issubdtype(grad_val.dtype, jnp.floating):
+        grad_val = grad_val.astype(weight_val.dtype)
     w = NDArray(weight_val)
     g = NDArray(grad_val)
     state = tree_to_state(state_tree)

@@ -39,6 +39,7 @@ from .. import autograd as _ag
 from .. import base as _base
 from .. import ndarray as nd
 from ..dist_hooks import AsyncPushWindow, kvstore_grad_pusher
+from ..layout import AutoLayoutStep, auto_format, auto_layout_enabled
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
 # the functional (jit-traceable) optimizer adapter lives next to the
@@ -64,57 +65,10 @@ def _as_jax(x):
     return jnp.asarray(x)
 
 
-class _AutoLayoutStep:
-    """A train-step callable compiled with XLA-chosen (AUTO) layouts for
-    the persistent state.
-
-    First call: AOT-lower/compile, relayout params/optimizer-state/aux
-    once into the executable's chosen input formats, then invoke the
-    Compiled object directly. Steady state: the step's outputs already
-    carry the chosen layouts (out layouts are AUTO-matched to the
-    donated inputs), so every later call is relayout-free — the whole
-    point: conv weights stay in the layout the convolutions want
-    instead of paying a copy per step."""
-
-    def __init__(self, jitted, mesh):
-        self._jit = jitted
-        self._mesh = mesh
-        self._compiled = None
-
-    @staticmethod
-    def _abstract(args):
-        # AUTO-layout lowering demands abstract args (a concrete
-        # jax.Array carries a concrete layout, which contradicts
-        # "compiler's choice"); shardings ride along so the SPMD
-        # partition matches the eventual real calls
-        return jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                           sharding=a.sharding), args)
-
-    def lower(self, *args):  # compiled_step() parity with plain jit
-        with self._mesh.mesh:
-            return self._jit.lower(*self._abstract(args))
-
-    def __call__(self, train_vals, states, aux_vals, *rest):
-        if self._compiled is None:
-            abst = self._abstract((train_vals, states, aux_vals) + rest)
-            with self._mesh.mesh:
-                self._compiled = self._jit.lower(*abst).compile()
-        # relayout the persistent state into the executable's chosen
-        # input formats on EVERY call — device_put is a no-copy no-op
-        # once the layouts already match (the donated steady state), but
-        # it must run unconditionally: a second batch shape compiles a
-        # NEW executable whose chosen layouts may differ from what the
-        # first one's outputs carry, and with donate=False the step's
-        # outputs never adopt the input formats at all — both used to
-        # raise layout-mismatch on the second call.
-        fmts = (self._compiled.input_formats    # jax >= 0.5
-                if hasattr(self._compiled, "input_formats")
-                else self._compiled.input_layouts)[0]
-        train_vals = jax.device_put(train_vals, fmts[0])
-        states = jax.device_put(states, fmts[1])
-        aux_vals = jax.device_put(aux_vals, fmts[2])
-        return self._compiled(train_vals, states, aux_vals, *rest)
+# the AUTO-layout step wrapper moved to mxtpu/layout.py (ISSUE 12) so
+# the fused Module path shares the one implementation; the old private
+# name keeps working for existing callers/tests
+_AutoLayoutStep = AutoLayoutStep
 
 
 class ShardedTrainer:
@@ -191,9 +145,7 @@ class ShardedTrainer:
         # trace attributes ~22% of ResNet-50 step time to layout copies
         # (docs/perf_analysis.md, round-5 scoreboard). Opt-in while the
         # win is unmeasured; numerics are layout-invariant either way.
-        if auto_layout is None:
-            auto_layout = os.environ.get("MXTPU_AUTO_LAYOUT", "0") == "1"
-        self._auto_layout = bool(auto_layout)
+        self._auto_layout = auto_layout_enabled(auto_layout)
         self._step_fns = {}
         self._placed = False
         self._key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
@@ -459,13 +411,7 @@ class ShardedTrainer:
                 # carried constant, never replaced, so it must stay live.
                 donate = (0, 1, 2, 5, 6) if self._donate else ()
                 if self._auto_layout:
-                    try:    # jax >= 0.5: Format wraps the tiling Layout
-                        from jax.experimental.layout import Format, Layout
-                        auto = Format(Layout.AUTO)
-                    except ImportError:  # 0.4.x spelling of the same
-                        from jax.experimental.layout import (
-                            DeviceLocalLayout, Layout)
-                        auto = Layout(DeviceLocalLayout.AUTO)
+                    auto = auto_format()
                     # AUTO only on the persistent state (in AND out, so
                     # the chosen layouts agree with donation aliasing);
                     # batches/key/t/lr keep caller-visible defaults
@@ -642,8 +588,16 @@ class ShardedTrainer:
         the store's coalesced frames. Keys (parameter names) are lazily
         ``kv.init``-ed with zeros on first push (the shared
         ``dist_hooks.kvstore_grad_pusher`` hook). The window's counters
-        publish into ``kv.stats()['grad_push_window']``."""
-        self.set_grad_push(kvstore_grad_pusher(kv),
+        publish into ``kv.stats()['grad_push_window']``.
+
+        A bf16 trainer (``dtype='bfloat16'``) ships bf16 gradients —
+        half the push bytes; the server's fp32 master table upcasts on
+        apply — unless the store compresses (2-bit beats bf16)."""
+        wire_dtype = None
+        if self._compute_dtype is not None and \
+                getattr(kv, "_compression", None) is None:
+            wire_dtype = self._compute_dtype
+        self.set_grad_push(kvstore_grad_pusher(kv, wire_dtype=wire_dtype),
                            max_inflight=max_inflight)
         if hasattr(kv, "add_stats_source"):
             kv.add_stats_source("grad_push_window",

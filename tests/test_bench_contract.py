@@ -120,6 +120,39 @@ def test_module_bench_dist_contract():
         and row["fused_async_img_s"] > 0
 
 
+def test_module_bench_amp_contract():
+    """tools/bench_module.py --amp: exactly one JSON line, rc 0, with
+    the fp32-vs-bf16 fused fields AND the half-width-wire bytes the
+    mixed-precision trajectory (docs/perf_analysis.md "Mixed
+    precision") is tracked by — tiny model, CPU-only."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_BENCH_TINY="1",
+               MXTPU_PS_HEARTBEAT="0", PYTHONPATH=_ROOT)
+    for k in ("MXTPU_AMP", "MXTPU_MODULE_FUSED", "MXTPU_MODULE_FUSED_DIST",
+              "MXTPU_MODULE_DIST_MODE"):
+        env.pop(k, None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_module.py"),
+         "--amp", "--batches", "3", "--warmup", "2", "--no-write"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, "must print exactly ONE JSON line"
+    payload = json.loads(lines[0])
+    assert payload["bench"] == "module_fit_amp"
+    assert payload["tiny"] is True
+    row = payload["models"]["mlp"]
+    for field in ("batch_size", "fp32_img_s", "bf16_img_s", "speedup"):
+        assert isinstance(row[field], (int, float)), field
+    dist = payload["dist"]
+    for field in ("batch_size", "fp32_img_s", "bf16_img_s", "speedup",
+                  "fp32_bytes_per_step", "bf16_bytes_per_step",
+                  "wire_bytes_ratio"):
+        assert isinstance(dist[field], (int, float)), field
+    # the half-width wire holds at ANY size (it is structural, not a
+    # wall-clock number): bf16 frames carry half the payload bytes
+    assert dist["wire_bytes_ratio"] <= 0.55
+
+
 def test_kvstore_bench_contract(tmp_path):
     """tools/bench_kvstore.py: exactly one JSON line, rc 0, with the
     fields the perf trajectory (docs/perf_analysis.md "Comms fast

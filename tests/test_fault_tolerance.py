@@ -1529,3 +1529,75 @@ def test_fused_dist_kill_primary_mid_grad_push_window(monkeypatch):
         kv.close()
         pri.stop()
         bak.stop()
+
+
+# ---------------------------------------------------------------------------
+# AMP half-width wire rows (ISSUE 12): the push payload's dtype IS the
+# wire tag — replay/dedupe must be dtype-stable, the server table stays
+# the fp32 master, and pushpull replies ride bf16 in kind.
+# ---------------------------------------------------------------------------
+
+def test_pushpull_bf16_wire_dtype_tag_replay_dedupe(monkeypatch):
+    """A bf16 pushpull severed at server.send (applied; ack lost): the
+    blind replay carries the SAME bf16 payload, the (origin, seq)
+    dedupe refuses the re-apply, the retry still answers with the
+    current value — and both the reply dtype (bf16, in kind) and the
+    server table dtype (fp32 master) survive the replay."""
+    import ml_dtypes
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((4,)))
+        g = np.ones(4, ml_dtypes.bfloat16)
+        out = mx.nd.zeros((4,))
+        with fault.inject(
+                "kind=sever,point=server.send,op=pushpull,nth=1") as inj:
+            kv.push_pull("w", g, out=out)
+        assert inj.stats()[0][4] == 1
+        # applied exactly once into the fp32 master, replay refused
+        assert srv._clock["w"] == 1
+        assert srv._dup_n == 1
+        assert srv._table["w"].dtype == np.float32
+        np.testing.assert_allclose(srv._table["w"], np.ones(4))
+        # the pull target got the post-update value, upcast to fp32
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+        # the raw wire reply is bf16 — the in-kind half of the tag
+        reply = kv._conn("w").request(
+            "pushpull", "w", np.ones(4, ml_dtypes.bfloat16), 0,
+            kv._origin, next(kv._seq))
+        assert reply[0] == "ok"
+        assert reply[1].dtype == ml_dtypes.bfloat16
+        assert srv._table["w"].dtype == np.float32
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_push_bf16_payload_upcasts_into_fp32_table(monkeypatch):
+    """A plain bf16 push (the ShardedTrainer attach_kvstore wire, or a
+    buffered replay): _wire_decode upcasts before the in-place apply,
+    so the accumulate math never runs half-precision."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        import ml_dtypes
+        kv.init("w", mx.nd.zeros((4,)))
+        for _ in range(3):
+            kv.push("w", np.full(4, 0.5, ml_dtypes.bfloat16))
+        assert srv._table["w"].dtype == np.float32
+        np.testing.assert_allclose(srv._table["w"], np.full(4, 1.5))
+        assert srv._clock["w"] == 3
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_module_step_fault_point_validates():
+    """The module.step grammar row: nan_grad is valid there (the AMP
+    loss-scale overflow drill), the elastic signal kinds are not (the
+    guard owns the fleet callbacks)."""
+    rules = fault.parse_spec("kind=nan_grad,point=module.step,nth=2")
+    assert rules[0].point == "module.step"
+    with pytest.raises(ValueError, match="join_worker"):
+        fault.parse_spec("kind=join_worker,point=module.step")

@@ -173,3 +173,39 @@ def test_host_transfer_avoids_copy_when_host_resident():
     assert out32 is a                    # astype(copy=False) no-op cast
     out64 = _host(a, "float64")
     assert out64.dtype == np.float64 and out64 is not a
+
+
+def test_metric_accumulates_fp32_under_bf16_step():
+    """ISSUE 12 regression: with a bf16 (AMP) step feeding the metric,
+    every accumulation must run f32 — a bf16 sum saturates at ~256
+    same-magnitude terms (8 mantissa bits), so an epoch of more than
+    ~256 batches would silently stop counting."""
+    import jax.numpy as jnp
+    from mxtpu.metric import _host
+
+    # device path: Loss over a bf16 vector of 4096 ones — a bf16-dtype
+    # reduction would answer ~256, f32 answers exactly 4096
+    total, count = mx.metric.Loss().device_batch(
+        (), (jnp.ones(4096, jnp.bfloat16),))
+    assert total.dtype == jnp.float32
+    assert float(total) == 4096.0 and count == 4096
+
+    # host path: _host upcasts half floats before numpy reductions
+    import ml_dtypes
+    host = _host(np.ones(513, ml_dtypes.bfloat16))
+    assert host.dtype == np.float32
+    assert host.sum() == 513.0           # bf16 pairwise sum gives 512
+
+    # the host Loss.update rides the same upcast
+    m = mx.metric.Loss()
+    m.update(None, [mx.nd.array(np.ones(600, "f")).astype("bfloat16")])
+    assert m.get()[1] == 1.0
+
+    # CE/NLL: the per-row -log picks accumulate f32 on device
+    ce = mx.metric.create("ce")
+    rows = 512
+    scores = jnp.full((rows, 2), 0.5, jnp.bfloat16)
+    labels = jnp.zeros(rows, jnp.bfloat16)
+    s, c = ce.device_batch((labels,), (scores,))
+    assert s.dtype == jnp.float32 and c == rows
+    assert abs(float(s) / rows - float(np.log(2))) < 1e-2

@@ -1,0 +1,379 @@
+"""Mixed-precision bf16 Module training (ISSUE 12, ``MXTPU_AMP=bf16``):
+bf16 compute + fp32 master weights as a MODE of the fused train step —
+parity bands vs the fp32 fused path (sgd + adam, single-host and dist
+sync), fp32 master-weight/optimizer-state invariants and their
+save/load round-trips (CheckpointManager artifacts AND the server
+``opt_states`` ops), the loss-scale overflow skip driven by a seeded
+``nan_grad`` fault row at the new ``module.step`` point, BN running
+statistics staying fp32 on device, GradientCompression composition
+(2-bit beats bf16 — no double-compress), the AMP-ineligible one-shot
+debug log, and the shared auto-layout wrapper on the fused path."""
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxtpu as mx
+from mxtpu import fault
+from mxtpu.module import fused as fused_mod
+
+
+def _toy_problem(n=192, seed=5, classes=4):
+    r = np.random.RandomState(seed)
+    y = (r.rand(n) * classes).astype("f")
+    x = r.rand(n, 16).astype("f") * 0.1
+    for i in range(n):
+        x[i, int(y[i]) * 4:int(y[i]) * 4 + 4] += 1.0
+    return x, y
+
+
+def _mlp(classes=4):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _bn_mlp(classes=4):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.BatchNorm(net, name="bn1", fix_gamma=False)
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit(monkeypatch, amp, kvstore=None, optimizer="sgd",
+         opt_params=None, epochs=3, sym_fn=_mlp, keep_module=False,
+         auto_layout=None):
+    """One Module.fit with/without AMP; returns (module-or-None,
+    params, engaged fused mode, group state-or-None)."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    monkeypatch.setenv("MXTPU_AMP", amp)
+    monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0")
+    if auto_layout is not None:
+        monkeypatch.setenv("MXTPU_AUTO_LAYOUT", auto_layout)
+    np.random.seed(7)
+    mx.random.seed(7)
+    x, y = _toy_problem()
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym_fn(), context=mx.cpu())
+    kw = {"kvstore": kvstore} if kvstore else {}
+    mod.fit(it, optimizer=optimizer,
+            optimizer_params=opt_params or {"learning_rate": 0.1,
+                                            "momentum": 0.9},
+            num_epoch=epochs, eval_metric="acc", **kw)
+    engaged = mod._fused.mode if mod._fused is not None else None
+    group = mod._fused._group if mod._fused is not None else None
+    args, _ = mod.get_params()
+    params = {k: v.asnumpy().copy() for k, v in args.items()}
+    if keep_module:
+        return mod, params, engaged, group
+    if mod._kvstore is not None:
+        mod._kvstore.close()
+    return None, params, engaged, group
+
+
+# adam normalizes step sizes, so a near-zero weight takes full-size
+# steps whose bf16 rounding noise accumulates — its band is absolute
+# (a few steps' worth), sgd's is the tight one
+_BANDS = {"sgd": dict(rtol=0.1, atol=0.02),
+          "adam": dict(rtol=0.25, atol=0.06)}
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_amp_local_parity_band(monkeypatch, optimizer, opt_params):
+    """The bf16 fused fit lands in the fp32 fused fit's neighborhood
+    (bf16 shares fp32's exponent range — only mantissa differs), with
+    fp32 master weights in the donated store the whole way."""
+    mod, bf16, m1, fs = _fit(monkeypatch, "bf16", optimizer=optimizer,
+                             opt_params=dict(opt_params),
+                             keep_module=True)
+    assert m1 == "local" and fs.amp == "bf16"
+    # fp32 masters: the device param store, the updater state slots
+    for name, arr in fs.param_store.items():
+        assert arr.dtype == np.float32, (name, arr.dtype)
+    for slot, st in fs.updater.states.items():
+        for leaf in jax.tree_util.tree_leaves(
+                fused_mod.state_to_tree(st)):
+            assert leaf.dtype == jnp.float32, (slot, leaf.dtype)
+    _, f32, m2, _ = _fit(monkeypatch, "", optimizer=optimizer,
+                         opt_params=dict(opt_params))
+    assert m2 == "local"
+    assert bf16.keys() == f32.keys()
+    for k in bf16:
+        assert np.isfinite(bf16[k]).all(), k
+        np.testing.assert_allclose(bf16[k], f32[k], err_msg=k,
+                                   **_BANDS[optimizer])
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_amp_dist_sync_parity_band(monkeypatch, optimizer, opt_params):
+    """dist sync (update_on_kvstore): bf16 gradients on the wire, fp32
+    master tables on the server, final params in the fp32 run's band."""
+    monkeypatch.setenv("MXTPU_MODULE_DIST_MODE", "sync")
+    mod, bf16, m1, fs = _fit(monkeypatch, "bf16", kvstore="dist_async",
+                             optimizer=optimizer,
+                             opt_params=dict(opt_params),
+                             keep_module=True)
+    try:
+        assert m1 == "dist" and fs.amp == "bf16"
+        assert fs.wire_dtype == jnp.bfloat16
+        # the server-side master tables stay fp32
+        srv = mod._kvstore._own_server
+        for k, v in srv._table.items():
+            assert v.dtype == np.float32, (k, v.dtype)
+    finally:
+        mod._kvstore.close()
+    _, f32, m2, _ = _fit(monkeypatch, "", kvstore="dist_async",
+                         optimizer=optimizer,
+                         opt_params=dict(opt_params))
+    assert m2 == "dist"
+    for k in bf16:
+        assert np.isfinite(bf16[k]).all(), k
+        np.testing.assert_allclose(bf16[k], f32[k], err_msg=k,
+                                   **_BANDS[optimizer])
+
+
+def test_amp_master_weight_checkpoint_roundtrip(monkeypatch, tmp_path):
+    """save_checkpoint artifacts carry fp32 masters (never a rounded
+    bf16 copy), and a load + continued AMP training works."""
+    mod, params, engaged, _ = _fit(monkeypatch, "bf16",
+                                   optimizer="adam",
+                                   opt_params={"learning_rate": 0.01},
+                                   keep_module=True)
+    assert engaged == "local"
+    prefix = str(tmp_path / "amp")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    loaded = mx.mod.Module.load(prefix, 1, load_optimizer_states=True)
+    x, y = _toy_problem()
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    loaded.bind(it.provide_data, it.provide_label)
+    loaded.init_optimizer(optimizer="adam",
+                          optimizer_params={"learning_rate": 0.01})
+    args, _ = loaded.get_params()
+    for k, v in args.items():
+        assert v.dtype == np.float32, (k, v.dtype)
+        np.testing.assert_array_equal(v.asnumpy(), params[k], err_msg=k)
+    assert loaded._fused is not None and \
+        loaded._fused._group.amp == "bf16"
+    batch = mx.io.DataBatch([mx.nd.array(x[:32])], [mx.nd.array(y[:32])])
+    loaded.forward_backward(batch)
+    loaded.update()
+    args2, _ = loaded.get_params()
+    assert any(not np.array_equal(args2[k].asnumpy(), params[k])
+               for k in params)
+
+
+def test_amp_dist_server_opt_states_roundtrip(monkeypatch, tmp_path):
+    """save/load_optimizer_states through the SERVER ``opt_states`` /
+    ``set_opt_states`` wire ops while the wire runs bf16: the restored
+    state is the fp32 master state and AMP training continues fused."""
+    monkeypatch.setenv("MXTPU_MODULE_DIST_MODE", "sync")
+    mod, _, engaged, fs = _fit(monkeypatch, "bf16", kvstore="dist_async",
+                               optimizer="adam",
+                               opt_params={"learning_rate": 0.01},
+                               keep_module=True)
+    try:
+        assert engaged == "dist" and fs.amp == "bf16"
+        fname = str(tmp_path / "amp_dist.states")
+        mod.save_optimizer_states(fname)
+        mod.load_optimizer_states(fname)
+        srv = mod._kvstore._own_server
+        with srv._updater_lock:
+            for slot, st in srv._updater.states.items():
+                for leaf in jax.tree_util.tree_leaves(
+                        fused_mod.state_to_tree(st)):
+                    assert np.dtype(leaf.dtype) == np.float32, slot
+        x, y = _toy_problem()
+        batch = mx.io.DataBatch([mx.nd.array(x[:32])],
+                                [mx.nd.array(y[:32])])
+        mod.forward_backward(batch)
+        mod.update()
+        assert mod._fused is not None and mod._fused.mode == "dist"
+    finally:
+        mod._kvstore.close()
+
+
+def test_amp_loss_scale_overflow_skip_nan_grad_fault_row(monkeypatch):
+    """Fault-matrix row (kind=nan_grad, point=module.step): a poisoned
+    batch under MXTPU_AMP_LOSS_SCALE makes every gradient non-finite;
+    the fused program's TrainGuard-style verdict SKIPS the step
+    in-program — params/opt-state/step-count bit-identical to before,
+    the skip counted by amp_overflow_skips(), training resumes on the
+    next good batch."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    monkeypatch.setenv("MXTPU_AMP_LOSS_SCALE", "1024")
+    np.random.seed(7)
+    mx.random.seed(7)
+    x, y = _toy_problem()
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    fs = mod._fused._group
+    assert fs.loss_scale == 1024.0
+    batches = list(it)
+
+    def snap():
+        exec_ = mod._exec_group.execs[0]
+        return {n: np.asarray(exec_.arg_dict[n].asnumpy()).copy()
+                for n in ("fc1_weight", "fc2_weight", "fc1_bias")}
+
+    with fault.inject("kind=nan_grad,point=module.step,nth=3") as inj:
+        for b in batches[:2]:
+            mod.forward_backward(b)
+            mod.update()
+        before = snap()
+        mod.forward_backward(batches[0])   # step 3: poisoned
+        mod.update()
+        assert inj.stats()[0][4] == 1, "the nan_grad never fired"
+    after_skip = snap()
+    for k in before:
+        np.testing.assert_array_equal(before[k], after_skip[k],
+                                      err_msg=k)
+    assert fs.amp_overflow_skips() == 1
+    mod.forward_backward(batches[1])       # good batch: training resumes
+    mod.update()
+    resumed = snap()
+    assert any(not np.array_equal(after_skip[k], resumed[k])
+               for k in resumed)
+    for k, v in resumed.items():
+        assert np.isfinite(v).all(), k
+
+
+def test_amp_bn_running_stats_stay_fp32_on_device(monkeypatch):
+    """BN running mean/var live in the donated aux store as fp32 and
+    update INSIDE the fused program — the AMP cast policy never touches
+    aux, and the per-batch stat math runs f32."""
+    mod, _, engaged, fs = _fit(monkeypatch, "bf16", sym_fn=_bn_mlp,
+                               keep_module=True)
+    assert engaged == "local" and fs.amp == "bf16"
+    exec_ = mod._exec_group.execs[0]
+    init_mean = np.zeros(16, np.float32)
+    for name, arr in exec_.aux_dict.items():
+        assert arr.dtype == np.float32, (name, arr.dtype)
+        host = arr.asnumpy()
+        assert np.isfinite(host).all(), name
+        if name.endswith("moving_mean"):
+            assert not np.array_equal(host, init_mean), \
+                "running mean never updated in-program"
+
+
+def test_amp_gradient_compression_composes(monkeypatch):
+    """2-bit compression beats bf16: with a compressed store the fused
+    dist step keeps fp32 emitted gradients (wire_dtype cleared — no
+    double-compress) while compute stays bf16, and training stays
+    finite."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0")
+    monkeypatch.setenv("MXTPU_MODULE_DIST_MODE", "sync")
+    np.random.seed(7)
+    mx.random.seed(7)
+    x, y = _toy_problem()
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    kv = mx.kv.create("dist_async")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    try:
+        fs = mod._fused._group
+        assert fs.amp == "bf16" and fs.compute_dtype == jnp.bfloat16
+        assert fs.wire_dtype is None, "compressed parts must skip the cast"
+        for b in list(it)[:3]:
+            mod.forward_backward(b)
+            mod.update()
+        args, _ = mod.get_params()
+        for k, v in args.items():
+            assert np.isfinite(v.asnumpy()).all(), k
+    finally:
+        kv.close()
+
+
+def test_amp_ineligible_params_log_once_keep_fp32_fused(monkeypatch,
+                                                        caplog):
+    """Non-fp32 parameters: AMP stays off with a ONE-shot named debug
+    log, the fp32 fused path still engages — never a silent wrong-dtype
+    step, never a needless eager fallback."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    monkeypatch.setenv("MXTPU_AMP", "bf16")
+    x, y = _toy_problem()
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    exec_ = mod._exec_group.execs[0]
+    exec_.arg_dict["fc1_weight"]._data = \
+        exec_.arg_dict["fc1_weight"]._data.astype(jnp.float16)
+    with caplog.at_level(logging.DEBUG):
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+    assert mod._fused is not None, "fp32 fused path must still engage"
+    assert mod._fused._group.amp is None
+    msgs = [r.message for r in caplog.records
+            if "AMP mode not engaged" in r.message]
+    assert len(msgs) == 1, msgs
+    assert "fc1_weight" in msgs[0] and "float16" in msgs[0]
+
+
+def test_amp_rejects_unknown_mode(monkeypatch):
+    monkeypatch.setenv("MXTPU_AMP", "fp8")
+    with pytest.raises(ValueError, match="MXTPU_AMP"):
+        fused_mod.amp_mode()
+
+
+@pytest.mark.parametrize("amp", ["", "bf16"])
+def test_auto_layout_fused_local_parity_and_zero_retraces(monkeypatch,
+                                                          amp):
+    """MXTPU_AUTO_LAYOUT=1 on the fused Module path: the AutoLayoutStep
+    wrapper compiles once per signature (zero retraces after warmup,
+    same program-cache accounting) and the numbers agree with the
+    default-layout run."""
+    _, base, m0, _ = _fit(monkeypatch, amp, auto_layout="0")
+    _, auto, m1, fs = _fit(monkeypatch, amp, auto_layout="1")
+    assert m0 == m1 == "local" and fs.auto_layout
+    assert fs.stats["compiles"] <= 2
+    assert fs.stats["cache_hits"] >= fs.stats["steps"] - 2
+    for k in base:
+        np.testing.assert_allclose(auto[k], base[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+
+
+def test_auto_layout_fused_dist_modes(monkeypatch):
+    """Auto-layout composes with the dist modes (grad-emitting step:
+    AUTO on the donated aux store only; dist_local: donated apply)."""
+    monkeypatch.setenv("MXTPU_MODULE_DIST_MODE", "sync")
+    _, params, mode, _ = _fit(monkeypatch, "bf16", kvstore="dist_async",
+                              auto_layout="1")
+    assert mode == "dist"
+    for k, v in params.items():
+        assert np.isfinite(v).all(), k
+    monkeypatch.setenv("MXTPU_UPDATE_ON_KVSTORE", "0")
+    _, params, mode, _ = _fit(monkeypatch, "bf16", kvstore="dist_async",
+                              auto_layout="1")
+    assert mode == "dist_local"
+    for k, v in params.items():
+        assert np.isfinite(v).all(), k

@@ -32,13 +32,21 @@ program + one coalesced push + one pull per batch, bit-for-bit with
 eager) and the fused-dist ASYNC mode (push+pull pipelined on the
 store's pool under the bounded-inflight window).
 
+``--amp`` (ISSUE 12) sweeps mixed precision: the fp32 fused path vs
+``MXTPU_AMP=bf16`` — single-host fit throughput, plus the dist sync
+loop over REAL wire framing with pushpull bytes/step (bf16 frames
+carry the dtype in the payload; the half-width-wire contract is
+bytes ratio <= 0.55, also pinned structurally by
+``ci/check_module_perf.py --amp``).
+
 Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
 and mirrors it to docs/module_bench.json unless --no-write (the file
-keeps one line per bench kind: ``module_fit`` and ``module_fit_dist``).
-CPU-only. MXTPU_BENCH_TINY shrinks the models/batch counts for the
-contract test.
+keeps one line per bench kind: ``module_fit``, ``module_fit_dist``
+and ``module_fit_amp``). CPU-only. MXTPU_BENCH_TINY shrinks the
+models/batch counts for the contract test.
 
-Run: JAX_PLATFORMS=cpu python tools/bench_module.py [--dist] [--batches 100]
+Run: JAX_PLATFORMS=cpu python tools/bench_module.py [--dist|--amp]
+     [--batches 100]
 """
 from __future__ import annotations
 
@@ -222,6 +230,110 @@ def run_dist(batches, warmup, batch_size=None):
             "host_cores": os.cpu_count(), "models": {"mlp": row}}
 
 
+def _amp_dist_rate(mx, sym, x, y, batch_size, batches, warmup):
+    """img/sec + wire bytes/step of the fused-sync dist fit hot loop
+    over the REAL framing (local transport off so the byte counters
+    tick), current MXTPU_AMP env."""
+    from mxtpu import kvstore_async as ka
+    it = mx.io.NDArrayIter(x, y, batch_size=batch_size,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    saved_local = ka._LOCAL_ON
+    ka._LOCAL_ON = False
+    try:
+        mod.init_optimizer(kvstore="dist_async", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.01})
+        kv = mod._kvstore
+        pool = list(it)
+
+        def one(batch):
+            mod.forward_backward(batch)
+            mod.update()
+
+        for i in range(warmup):
+            one(pool[i % len(pool)])
+        mod._fused.flush()
+        before = kv._stats.snapshot()
+        t0 = time.perf_counter()
+        for i in range(batches):
+            one(pool[i % len(pool)])
+        mod._fused.flush()
+        mod._exec_group.execs[0].arg_dict[
+            mod._exec_group.param_names[0]].wait_to_read()
+        dt = time.perf_counter() - t0
+        after = kv._stats.snapshot()
+        sent = (after["bytes_sent"] - before["bytes_sent"]) / batches
+        recv = (after["bytes_recv"] - before["bytes_recv"]) / batches
+        assert mod._fused is not None and mod._fused.mode == "dist"
+        kv.close()
+    finally:
+        ka._LOCAL_ON = saved_local
+    return batch_size * batches / dt, sent, recv
+
+
+def run_amp(batches, warmup, batch_size=None):
+    """The --amp sweep (ISSUE 12): fp32 fused vs bf16 fused, single-host
+    AND dist sync over the wire — throughput plus pushpull bytes/step
+    (the <= 0.55x half-width-wire contract ci/check_module_perf.py
+    --amp pins structurally)."""
+    import mxtpu as mx
+
+    os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
+    bs = batch_size or DEFAULT_BS["mlp"]
+    # dist leg runs the wire-bound regime (small batch: compute per
+    # step shrinks, the ~335KB/step pushpull stays) — that is where
+    # the half-width wire pays on a CPU host whose bf16 matmuls are
+    # EMULATED; on real hardware bf16 also wins the compute leg
+    dist_bs = batch_size or (DEFAULT_BS["mlp"] if TINY else 16)
+    sym = _mlp(mx)
+    saved = {k: os.environ.get(k) for k in
+             ("MXTPU_AMP", "MXTPU_MODULE_FUSED", "MXTPU_MODULE_FUSED_DIST",
+              "MXTPU_MODULE_DIST_MODE")}
+    os.environ.update({"MXTPU_MODULE_FUSED": "1",
+                       "MXTPU_MODULE_FUSED_DIST": "1",
+                       "MXTPU_MODULE_DIST_MODE": "sync"})
+    local, dist = {}, {}
+    try:
+        for name in ("fp32", "bf16"):
+            os.environ["MXTPU_AMP"] = "" if name == "fp32" else "bf16"
+            x, y = _data("mlp", max(4 * bs, 64), bs)
+            rate, fused = _steady_state_rate(mx, sym, x, y, bs, batches,
+                                             warmup)
+            assert fused, "%s local path did not engage" % name
+            local[name] = rate
+            xd, yd = _data("mlp", max(4 * dist_bs, 64), dist_bs)
+            dist[name] = _amp_dist_rate(mx, sym, xd, yd, dist_bs,
+                                        batches, warmup)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    wire_ratio = (dist["bf16"][1] + dist["bf16"][2]) / max(
+        1.0, dist["fp32"][1] + dist["fp32"][2])
+    return {"bench": "module_fit_amp", "tiny": TINY,
+            "batches": batches, "warmup": warmup,
+            "host_cores": os.cpu_count(),
+            "models": {"mlp": {
+                "batch_size": bs,
+                "fp32_img_s": round(local["fp32"], 1),
+                "bf16_img_s": round(local["bf16"], 1),
+                "speedup": round(local["bf16"] / local["fp32"], 2)}},
+            "dist": {
+                "batch_size": dist_bs,
+                "fp32_img_s": round(dist["fp32"][0], 1),
+                "bf16_img_s": round(dist["bf16"][0], 1),
+                "speedup": round(dist["bf16"][0] / dist["fp32"][0], 2),
+                "fp32_bytes_per_step": round(dist["fp32"][1]
+                                             + dist["fp32"][2]),
+                "bf16_bytes_per_step": round(dist["bf16"][1]
+                                             + dist["bf16"][2]),
+                "wire_bytes_ratio": round(wire_ratio, 3)}}
+
+
 def run(batches, warmup, batch_size=None):
     import mxtpu as mx
 
@@ -266,11 +378,17 @@ def main():
     ap.add_argument("--dist", action="store_true",
                     help="loopback-PS fit microbench: eager vs fused "
                          "sync vs fused async over kvstore='dist_async'")
+    ap.add_argument("--amp", action="store_true",
+                    help="mixed-precision microbench: fp32 vs bf16 fused "
+                         "(single-host + dist sync over the wire, with "
+                         "pushpull bytes/step)")
     ap.add_argument("--no-write", action="store_true",
                     help="do not mirror the line to docs/module_bench.json")
     args = ap.parse_args()
 
-    if args.dist:
+    if args.amp:
+        result = run_amp(args.batches, args.warmup, args.batch_size)
+    elif args.dist:
         result = run_dist(args.batches, args.warmup, args.batch_size)
     else:
         result = run(args.batches, args.warmup, args.batch_size)
