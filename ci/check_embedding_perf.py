@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Structural perf smoke for the sparse-embedding fast path (ISSUE 13).
+
+The sparse contract (mxtpu/module/fused.py "Sparse embeddings" +
+kvstore_async "Row-sparse fast path") pinned the check_module_perf way
+— structure, not wall clock:
+
+1. **One program, zero retraces**: a Module with row_sparse Embedding
+   tables engages the fused ``dist`` mode (device-side unique/gather
+   in the grad program) and a steady-state epoch after warmup adds
+   ZERO program-cache compiles.
+2. **Zero training-thread host syncs**: the async-mode epoch runs
+   under ``jax.transfer_guard_device_to_host("disallow")`` — the
+   (row_ids, rows) read happens on the store's worker pool, never on
+   the training thread.
+3. **Bounded window**: the sparse wire jobs ride the same
+   bounded-inflight window, pinned via
+   ``kv.stats()['module_fused_dist']``.
+4. **Wire bytes scale with rows touched**: over REAL framing, a 1%-
+   touch sparse pushpull ships <= 0.05x the dense pushpull's bytes
+   for the same table (the reason the feature exists).
+5. **Row-wise server cost**: the server's sparse counters account
+   every step (sparse_pushes == steps, rows bounded by batch x
+   lookups — the optimizer never paid full-table cost).
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_embedding_perf.py`` (wired
+into ``ci/run_ci.sh`` fast). No timing, no thresholds in seconds.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_MODULE_FUSED"] = "1"
+os.environ["MXTPU_MODULE_FUSED_DIST"] = "1"
+os.environ["MXTPU_MODULE_FUSED_SPARSE"] = "1"
+os.environ["MXTPU_MODULE_DIST_MODE"] = "async"
+os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+import numpy as np                                    # noqa: E402
+import jax                                            # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+
+_BATCHES = 12
+_VOCAB, _DIM, _NIDX = 64, 8, 4
+
+
+def _no_d2h():
+    guard = getattr(jax, "transfer_guard_device_to_host", None)
+    if guard is None:                                 # pragma: no cover
+        return contextlib.nullcontext()
+    return guard("disallow")
+
+
+def _embed_net():
+    data = mx.sym.var("data")
+    w = mx.sym.var("emb_weight", stype="row_sparse")
+    emb = mx.sym.Embedding(data, weight=w, input_dim=_VOCAB,
+                           output_dim=_DIM, name="emb")
+    flat = mx.sym.Reshape(emb, shape=(-1, _NIDX * _DIM))
+    fc = mx.sym.FullyConnected(flat, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    failures = []
+    np.random.seed(0)
+    x = np.random.randint(0, _VOCAB, (128, _NIDX)).astype("float32")
+    y = np.random.randint(0, 4, 128).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=16,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_embed_net(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    kv = mx.kv.create("dist_async")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    if mod._fused is None or mod._fused.mode != "dist" \
+            or not mod._fused._sparse_feeds:
+        print("check_embedding_perf: FAIL")
+        print("  - fused sparse dist step did not engage (mode=%r, "
+              "feeds=%r)" % (getattr(mod._fused, "mode", None),
+                             getattr(mod._fused, "_sparse_feeds", None)))
+        kv.close()
+        return 1
+    batches = list(it)
+
+    def one(batch):
+        mod.forward_backward(batch)
+        mod.update()
+
+    for b in batches[:2]:                 # warmup: compiles + window
+        one(b)
+    mod._fused.flush()
+
+    stats = mod._fused._group.stats
+    compiles_before = stats["compiles"]
+    pushes_before = kv.stats()["sparse_pushes"]
+
+    # -- 1+2: steady epoch — zero retraces, zero training-thread d2h --
+    try:
+        with _no_d2h():
+            for i in range(_BATCHES):
+                one(batches[i % len(batches)])
+    except Exception as e:
+        failures.append(
+            "steady-state sparse epoch performed a device->host "
+            "transfer on the training thread: %s: %s"
+            % (type(e).__name__, str(e)[:200]))
+    mod._fused.flush()
+
+    if stats["compiles"] != compiles_before:
+        failures.append(
+            "steady-state sparse epoch retraced: %d new compiles "
+            "after warmup" % (stats["compiles"] - compiles_before))
+
+    # -- 3: bounded window --------------------------------------------
+    kstats = kv.stats()
+    win = kstats.get("module_fused_dist") or {}
+    if not win or win.get("inflight_hwm", 99) > win.get("window", 0):
+        failures.append("async sparse window unbounded: %r" % (win,))
+    if win.get("inflight") != 0:
+        failures.append("window not drained by flush: %r" % (win,))
+
+    # -- 5: every step rode the sparse wire, rows bounded --------------
+    sparse_steps = kstats["sparse_pushes"] - pushes_before
+    if sparse_steps != _BATCHES:
+        failures.append(
+            "sparse pushes %d != steady-state steps %d (every step "
+            "must ride the sparse wire exactly once)"
+            % (sparse_steps, _BATCHES))
+    if kstats["sparse_rows"] > kstats["sparse_pushes"] * 16 * _NIDX:
+        failures.append("rows shipped exceed batch x lookups — the "
+                        "emit is not deduping")
+    kv.close()
+
+    # -- 4: wire bytes scale with rows touched (real framing) ----------
+    os.environ["MXTPU_PS_LOCAL"] = "0"
+    from mxtpu import kvstore_async as ka
+    ka._LOCAL_ON = False
+    kv2 = mx.kv.create("dist_async")
+    try:
+        rows, dim, touched = 2000, 16, 20            # 1% touch
+        kv2.init("emb", mx.nd.zeros((rows, dim)))
+        tgt = mx.nd.zeros((rows, dim))
+        ids = np.arange(0, rows, rows // touched,
+                        dtype="int64")[:touched]
+        g_rows = np.ones((touched, dim), "f")
+        g_dense = np.zeros((rows, dim), "f")
+        g_dense[ids] = 1.0
+
+        def step_bytes(fn):
+            before = kv2.stats()
+            fn()
+            after = kv2.stats()
+            return (after["bytes_sent"] - before["bytes_sent"]
+                    + after["bytes_recv"] - before["bytes_recv"])
+
+        dense_b = step_bytes(lambda: kv2.push_pull("emb", g_dense,
+                                                   out=tgt))
+        sparse_b = step_bytes(lambda: kv2.sparse_push_pull(
+            "emb", ids, g_rows, out=tgt))
+        if sparse_b > 0.05 * dense_b:
+            failures.append(
+                "sparse wire bytes %d > 0.05x dense %d at 1%% touch "
+                "(bytes must scale with rows touched)"
+                % (sparse_b, dense_b))
+    finally:
+        kv2.close()
+
+    if failures:
+        print("check_embedding_perf: FAIL")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("check_embedding_perf: OK (one program, zero retraces, zero "
+          "training-thread syncs, window bounded, sparse/dense bytes "
+          "%.4fx at 1%% touch)" % (sparse_b / max(1, dense_b)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,7 @@ case "$tier" in
     JAX_PLATFORMS=cpu python ci/check_module_perf.py
     JAX_PLATFORMS=cpu python ci/check_module_perf.py --dist
     JAX_PLATFORMS=cpu python ci/check_module_perf.py --amp
+    JAX_PLATFORMS=cpu python ci/check_embedding_perf.py
     JAX_PLATFORMS=cpu python ci/check_replication.py
     JAX_PLATFORMS=cpu python ci/check_elastic.py
     JAX_PLATFORMS=cpu python ci/check_serving.py

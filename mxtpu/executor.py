@@ -573,7 +573,8 @@ class Executor:
     def make_fused_grad_step(self, train_names, metric_fn=None,
                              donate=True, compute_dtype=None,
                              loss_scale=None, cast_exclude=(),
-                             wire_dtype=None, auto_layout=False):
+                             wire_dtype=None, auto_layout=False,
+                             sparse_emits=None):
         """Grad-EMITTING mode of the fused train step — the
         kvstore/dist path (ISSUE 10). ONE jitted program runs forward +
         backward (ones cotangents, loss-head pattern) + the optional
@@ -581,6 +582,21 @@ class Executor:
         instead of applying an optimizer: the update happens where the
         kvstore says it does — server-side (``update_on_kvstore``) or
         locally through :meth:`make_fused_apply_step` after the pull.
+
+        Sparse embeddings (ISSUE 13): ``sparse_emits`` maps a
+        row-sparse parameter name to the tuple of DIRECT-input names
+        feeding its Embedding lookups. For those parameters the SAME
+        program dedupes the step's indices on device (sort +
+        segment-position scatter — the static-shape unique) and
+        gathers the touched rows out of the dense VJP gradient, so the
+        emitted entry is a ``(row_ids, rows)`` pair instead of the
+        full-table gradient: ``row_ids`` is ``(nnz_max,)`` int32
+        sorted ascending with the table row count as the padding
+        sentinel (``nnz_max`` = total indices fed, a static bound),
+        ``rows`` is ``(nnz_max, *row_shape)`` with zero padding — the
+        sparse-pushpull wire payload, still ONE XLA program end to
+        end. ``wire_dtype`` applies to the gathered rows exactly like
+        dense gradients.
 
         Mixed precision (ISSUE 12): ``compute_dtype`` applies the same
         cast-in policy as :meth:`make_fused_train_step` (bf16 params +
@@ -613,6 +629,12 @@ class Executor:
         mirror = self._mirror
         amp = self._amp_cast(compute_dtype, cast_exclude)
         scale = float(loss_scale) if loss_scale else None
+        # sparse-emit plan: feed-name -> other_vals position, resolved
+        # once at build (eligibility already proved the feeds are
+        # direct inputs)
+        sparse_pos = {
+            name: tuple(other_names.index(f) for f in feeds)
+            for name, feeds in (sparse_emits or {}).items()}
 
         def _forward(gvals, other_vals, aux_vals, key):
             local = {n: amp(n, v) for n, v in zip(other_names,
@@ -631,6 +653,36 @@ class Executor:
                 return ones * jnp.asarray(scale, o.dtype) if scale \
                     else ones
             return _np.zeros(o.shape, jax.dtypes.float0)
+
+        def _wire(g):
+            if wire_dtype is not None and \
+                    jnp.issubdtype(g.dtype, jnp.floating):
+                return g.astype(wire_dtype)
+            return g
+
+        def _sparse_emit(name, g, other_vals):
+            """(row_ids, rows) out of the dense VJP gradient: static-
+            shape unique over the step's fed indices (sort, then
+            scatter each run's first element to its segment slot —
+            padding tail holds the num_rows sentinel), then one gather
+            of the touched rows. Duplicate indices were already
+            summed by the VJP's scatter-add, so gather IS the
+            segment-sum dedupe."""
+            num_rows = g.shape[0]
+            ids = jnp.concatenate([
+                jnp.reshape(other_vals[p], (-1,)).astype(jnp.int32)
+                for p in sparse_pos[name]])
+            sids = jnp.sort(ids)
+            first = jnp.concatenate([jnp.ones((1,), bool),
+                                     sids[1:] != sids[:-1]])
+            seg = jnp.cumsum(first) - 1
+            uniq = jnp.full(ids.shape, num_rows,
+                            jnp.int32).at[seg].set(sids)
+            valid = uniq < num_rows
+            safe = jnp.where(valid, uniq, 0)
+            rows = g[safe] * valid.reshape(
+                (-1,) + (1,) * (g.ndim - 1)).astype(g.dtype)
+            return uniq, _wire(rows)
 
         donate_argnums = (1, 3, 4) if donate else ()
 
@@ -654,10 +706,14 @@ class Executor:
                                   for g in grads)
                     new_aux = tuple(jnp.where(ok, na, oa)
                                     for na, oa in zip(new_aux, aux_vals))
-            if wire_dtype is not None:
-                grads = tuple(g.astype(wire_dtype)
-                              if jnp.issubdtype(g.dtype, jnp.floating)
-                              else g for g in grads)
+            if sparse_pos:
+                with jax.named_scope("sparse_emit"):
+                    grads = tuple(
+                        _sparse_emit(n, g, other_vals)
+                        if n in sparse_pos else _wire(g)
+                        for n, g in zip(train_names, grads))
+            elif wire_dtype is not None:
+                grads = tuple(_wire(g) for g in grads)
             if metric_fn is not None:
                 with jax.named_scope("metric"):
                     m_sum, m_cnt = metric_fn(dict(zip(other_names,

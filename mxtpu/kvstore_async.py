@@ -52,6 +52,27 @@ instead of serializing through one socket. ``tools/launch.py -s N``
 starts N server processes (DMLC_ROLE=server) and exports
 ``MXTPU_PS_ADDRS`` to every worker.
 
+Row-sparse fast path (ISSUE 13): giant embedding tables where each
+worker touches a few thousand rows per step ride ``sparse_push_pull``
+(wire op ``spushpull``; push-only form ``spush``) — frames carry
+``(row_ids, rows)`` instead of the full table, the server applies with
+the ROW-WISE optimizer mirror (``Optimizer.update_host_rows`` for
+sgd/adagrad/adam: only touched rows pay optimizer cost; anything else
+densifies the gradient and stays correct), and the reply gathers the
+same rows' post-update values in kind — one round trip per row-range
+part, wire bytes scaling with rows touched, never with table size. The
+part machinery above doubles as the sharding story: a table bigger
+than one server's memory splits into row-range parts whose subkeys
+spread across shards (``PartitionRules.mark_row_sharded`` distributes
+a rule group's parts round-robin instead of co-locating them), sparse
+frames fan out to the row-range owners and reassemble with ONE batched
+device_put. Seq-deduped replays answer with current row values; sparse
+records forward on the replication stream and move through
+``("split", dst)`` handoffs exactly-once like any other update. bf16
+rows (``MXTPU_AMP``) upcast into the fp32 master table and replies
+ride bf16 in kind. ``tools/bench_embedding.py`` measures the
+bytes/step scaling; ``ci/check_embedding_perf.py`` pins it.
+
 Wire compression: ``set_gradient_compression({'type': '2bit'})`` makes
 ``push`` ship the 2-bit packed form (16x smaller) with a per-part
 worker-side error-feedback residual; the server dequantizes before its
@@ -267,6 +288,7 @@ import uuid
 
 import numpy as _np
 import jax
+import jax.numpy as jnp
 
 from . import fault as _fault
 from . import ndarray as nd
@@ -300,6 +322,9 @@ _BIGARRAY_BOUND = int(os.environ.get(
     "MXTPU_KVSTORE_BIGARRAY_BOUND", "1000000"))
 
 _GC_MARK = "gc2bit"  # wire tag for a 2-bit-compressed push payload
+_SP_MARK = "sprows"  # pending-buffer tag for a row-sparse push: the
+#                      payload slot holds (_SP_MARK, row_ids, rows) and
+#                      _flush_pending replays it as an ``spush``
 
 # pipelined-window size: how many requests may ride one socket
 # unacknowledged. Correlation ids pair replies to waiters, so the k
@@ -420,7 +445,8 @@ class _CommStats:
 
     _FIELDS = ("bytes_sent", "bytes_recv", "frames_sent", "frames_recv",
                "coalesced_frames", "coalesced_subs", "retransmits",
-               "inflight_hwm", "local_reqs", "map_reroutes")
+               "inflight_hwm", "local_reqs", "map_reroutes",
+               "sparse_frames", "sparse_rows_sent")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -827,6 +853,14 @@ class ParameterServer:
         self._locks_guard = threading.Lock()
         self._clock = {}           # key -> applied-update count
         self._applied = {}         # (origin, key) -> last applied push seq
+        # keys that took a row-wise (spush/spushpull) update: their
+        # table entries mutate rows IN PLACE, so pulls must copy
+        # instead of aliasing (see _ensure_sparse_table). Re-derived
+        # lazily after restarts/splits — the flag is set before the
+        # first in-place write ever happens on this server.
+        self._sparse_keys = set()
+        self._sparse_pushes = 0    # row-wise applies (observability)
+        self._sparse_rows = 0      # rows touched by them, summed
         self._updater = None
         self._opt_payload = None   # pickled optimizer, kept for snapshots
         # one server-wide lock around updater invocations: the Updater and
@@ -1552,11 +1586,117 @@ class ParameterServer:
         self._repl_barrier(stream, rseq, dup=dup)
         return ("ok", "dup") if dup else ("ok",)
 
+    def _ensure_sparse_table(self, key):
+        """Mark ``key`` row-wise-mutable and return its table entry.
+        The dense updater path replaces entries wholesale so zero-copy
+        local pulls may alias them; the row-wise path updates rows IN
+        PLACE (the whole point: O(rows touched) per push), so the
+        first sparse touch replaces the entry with a private copy and
+        flags the key — pulls of flagged keys copy (``pull`` /
+        ``pushpull`` arms) instead of aliasing. Caller holds the key
+        lock."""
+        if key not in self._sparse_keys:
+            self._sparse_keys.add(key)
+            self._table[key] = _np.array(self._table[key], copy=True)
+        return self._table[key]
+
+    def _do_sparse_push(self, msg, _repl=False):
+        # ("spush", key, row_ids, rows, base_clock[, origin, seq]) —
+        # the row-sparse push (reference DataHandleRowSparse,
+        # kvstore_dist_server.h:631-792, on the PR-10 wire): only the
+        # touched rows travel, the row-wise optimizer
+        # (Updater.update_host_rows) charges only those rows, and the
+        # same (origin, seq) watermark keeps replays at-most-once.
+        # Optimizers without a row-wise mirror densify the gradient
+        # and take the dense path — correct for ALL of them, fast for
+        # sgd/adagrad/adam.
+        key, row_ids, rows, base_clock = msg[1], msg[2], msg[3], msg[4]
+        origin, seq = (msg[5], msg[6]) if len(msg) >= 7 else (None, None)
+        stream = rseq = None
+        dup = False
+        with self._lock_for(key):
+            if key not in self._table:
+                dst = self._moved.get(key)
+                if dst is not None:
+                    return ("ok", "skipped") if _repl \
+                        else self._stale_reply(key, dst)
+                if _repl and not self._catchup_complete:
+                    return ("ok", "skipped")
+                return ("err", "push to uninitialized key %r" % (key,))
+            if origin is not None and \
+                    self._applied.get((origin, key), 0) >= seq:
+                self._dup_n += 1
+                dup = True
+                stream = None if _repl else self._repl
+            else:
+                ids = _np.asarray(row_ids, dtype=_np.int64)
+                store = self._table[key]
+                if ids.size and (ids.min() < 0
+                                 or ids.max() >= store.shape[0]):
+                    return ("err", "sparse push row_ids out of range "
+                                   "for %r: [%d, %d] vs %d rows"
+                            % (key, ids.min(), ids.max(),
+                               store.shape[0]))
+                if origin is not None:
+                    self._applied[(origin, key)] = seq
+                stale = max(0, self._clock[key] - base_clock)
+                self._stale_max = max(self._stale_max, stale)
+                self._stale_sum += stale
+                self._stale_n += 1
+                self._note_worker_push(origin, stale)
+                g = _wire_decode(rows)   # bf16 rows upcast; the fp32
+                #                          master-table contract holds
+                store = self._ensure_sparse_table(key)
+                stream = None if _repl else self._repl
+                rec = ("spush", key, row_ids, rows, base_clock, origin,
+                       seq)
+                if self._updater is not None:
+                    with self._updater_lock:
+                        new_rows = self._updater.update_host_rows(
+                            _key_int(key), store, ids, g)
+                        if new_rows is None:
+                            # densify fallback: scatter the rows into a
+                            # zero gradient and run the dense apply —
+                            # any optimizer, O(table) cost
+                            dense = _np.zeros_like(store)
+                            dense[ids] = _np.asarray(g, store.dtype)
+                            new_w = self._updater.update_host(
+                                _key_int(key), store, dense)
+                            if new_w is None:
+                                w = nd.array(store)
+                                self._updater(_key_int(key),
+                                              nd.array(dense), w)
+                                new_w = _np.asarray(w._data)
+                            store[...] = new_w
+                        else:
+                            store[ids] = _np.asarray(new_rows,
+                                                     store.dtype)
+                        self._clock[key] += 1
+                        if stream is not None:
+                            rseq = stream.forward(rec)
+                else:
+                    # accumulate: ids are unique per frame (the worker
+                    # dedupes), so a plain scatter-add lands each row
+                    _np.add.at(store, ids, _np.asarray(g, store.dtype))
+                    self._clock[key] += 1
+                    if stream is not None:
+                        rseq = stream.forward(rec)
+                self._sparse_pushes += 1
+                self._sparse_rows += int(ids.size)
+        if not dup:
+            self._push_count += 1
+            if self._ckpt is not None and self._snapshot_every > 0 \
+                    and self._push_count % self._snapshot_every == 0:
+                self.snapshot()
+        self._repl_barrier(stream, rseq, dup=dup)
+        return ("ok", "dup") if dup else ("ok",)
+
     # state commands a backup refuses until promoted: the replication
     # stream must stay the only writer (and the authoritative reader)
     # of a backup's table, or failover could serve/accept torn state
     _CLIENT_STATE_CMDS = frozenset(
-        ("init", "push", "pushpull", "pull", "pull_rows", "multi",
+        ("init", "push", "pushpull", "spush", "spushpull", "pull",
+         "pull_rows", "multi",
          "set_optimizer", "opt_states", "set_opt_states", "barrier",
          "split", "adopt_key", "cursor_next", "cursor_done",
          "publish"))
@@ -1595,7 +1735,8 @@ class ParameterServer:
                         return self._stale_reply(key, dst)
                     return ("err", "pull of uninitialized key %r" % (key,))
                 tbl = self._table[key]
-                value = tbl if self._updater is not None else tbl.copy()
+                value = tbl if self._updater is not None and \
+                    key not in self._sparse_keys else tbl.copy()
                 # half-width wire (AMP): the push payload's dtype IS the
                 # tag — reply in kind, so a bf16 pushpull round trip
                 # ships half the bytes BOTH ways while the table stays
@@ -1609,6 +1750,38 @@ class ParameterServer:
                         value.dtype == _np.float32:
                     value = value.astype(wire_dt)
                 return ("ok", value, self._clock[key])
+        if cmd == "spush":
+            return self._do_sparse_push(msg, _repl=_repl)
+        if cmd == "spushpull":
+            # the row-sparse PushPull (ISSUE 13): apply the touched
+            # rows, reply gather-in-kind with the SAME rows' post-
+            # update values and the clock in one round trip — the
+            # per-batch wire op of the fused sparse-embedding dist
+            # step. A seq-deduped replay skips the apply but still
+            # answers with the CURRENT row values (at-most-once
+            # apply, always-fresh read, exactly like dense pushpull).
+            reply = self._do_sparse_push(("spush",) + tuple(msg[1:]),
+                                         _repl=_repl)
+            if reply[0] != "ok":
+                return reply
+            key, row_ids = msg[1], msg[2]
+            with self._lock_for(key):
+                if key not in self._table:
+                    dst = self._moved.get(key)
+                    if dst is not None:
+                        return self._stale_reply(key, dst)
+                    return ("err", "pull of uninitialized key %r" % (key,))
+                ids = _np.asarray(row_ids, dtype=_np.int64)
+                # fancy indexing copies — safe to pickle outside the
+                # lock even though sparse entries mutate in place
+                rows_out = self._table[key][ids]
+                # half-width wire (AMP): the rows payload's dtype IS
+                # the tag — reply in kind, fp32 master table unchanged
+                wire_dt = getattr(msg[3], "dtype", None)
+                if wire_dt is not None and _half_float(wire_dt) and \
+                        rows_out.dtype == _np.float32:
+                    rows_out = rows_out.astype(wire_dt)
+                return ("ok", rows_out, self._clock[key])
         if cmd == "pull":
             _, key = msg
             with self._lock_for(key):
@@ -1619,10 +1792,13 @@ class ParameterServer:
                     return ("err", "pull of uninitialized key %r" % (key,))
                 tbl = self._table[key]
                 # the reply is pickled OUTSIDE this lock: hand out a
-                # stable copy where in-place accumulates could tear it.
-                # The updater path replaces entries wholesale (immutable
-                # once visible), so its pulls ship zero-copy.
-                value = tbl if self._updater is not None else tbl.copy()
+                # stable copy where in-place writes could tear it (the
+                # accumulate path, and any sparse-flagged key — its
+                # rows mutate in place). The dense updater path
+                # replaces entries wholesale (immutable once visible),
+                # so its pulls ship zero-copy.
+                value = tbl if self._updater is not None and \
+                    key not in self._sparse_keys else tbl.copy()
                 return ("ok", value, self._clock[key])
         if cmd == "pull_rows":
             # sparse pull (reference kvstore_dist_server.h:631-792
@@ -1795,7 +1971,8 @@ class ParameterServer:
             self._repl_applied_rseq = rseq
             self._repl_received += 1
             sc = sub[0]
-            if sc in ("push", "init", "set_optimizer", "adopt_key"):
+            if sc in ("push", "spush", "init", "set_optimizer",
+                      "adopt_key"):
                 return self._dispatch(sub, _repl=True)
             if sc == "moved":
                 # the primary handed ``key`` away mid-split: mirror the
@@ -2011,6 +2188,9 @@ class ParameterServer:
                            "staleness_avg": avg,
                            "pushes": self._stale_n,
                            "dup_pushes": self._dup_n,
+                           "sparse_pushes": self._sparse_pushes,
+                           "sparse_rows": self._sparse_rows,
+                           "sparse_keys": len(self._sparse_keys),
                            "snapshots": self._snap_count,
                            "restored_step": self._restored_step,
                            "clocks": dict(self._clock),
@@ -2324,7 +2504,8 @@ def _stale_dst(err):
 # marks into a set, adopt_key refuses clocks at or below its watermark,
 # and a replayed split only re-moves keys still local.
 _IDEMPOTENT = frozenset(
-    ("init", "push", "pushpull", "pull", "pull_rows", "stats", "ping",
+    ("init", "push", "pushpull", "spush", "spushpull", "pull",
+     "pull_rows", "stats", "ping",
      "set_optimizer", "opt_states", "set_opt_states", "multi",
      "hello", "bye", "repl", "promote", "peer_info", "join_backup",
      "shard_map", "cursor_next", "cursor_done", "adopt_key", "split",
@@ -3465,6 +3646,201 @@ class AsyncDistKVStore(KVStore):
                 max_workers=1, thread_name_prefix="mxtpu-ordered-push")
         return pool
 
+    # -- row-sparse fast path (ISSUE 13) ----------------------------------
+    @staticmethod
+    def _as_host(x):
+        """Any array-ish (NDArray, jax array, numpy, list) -> numpy."""
+        if isinstance(x, nd.NDArray):
+            return _np.asarray(jax.device_get(x._data))
+        if isinstance(x, _np.ndarray):
+            return x
+        return _np.asarray(jax.device_get(x))
+
+    def sparse_push_pull(self, key, row_ids, rows, out=None, priority=0,
+                         drop_padding=False):
+        """Fused row-sparse push+pull — the embedding-table wire op
+        (reference ``PushPull`` + ``PullRowSparse`` combined, op
+        ``spushpull``): each row-range part owner applies the touched
+        rows with the ROW-WISE server optimizer
+        (``Optimizer.update_host_rows``) and replies gather-in-kind
+        with the same rows' post-update values, all in ONE round trip
+        per part. Wire bytes scale with rows touched, never with table
+        size; a seq-deduped replay answers with the current row
+        values.
+
+        ``row_ids`` must be unique per key (sorted here); with
+        ``drop_padding`` ids ``>= table rows`` (the fused step's
+        static-shape sentinel) and ``< 0`` are compacted away first.
+        ``out`` targets follow ``row_sparse_pull``: row_sparse /
+        compact (rows installed), dense of the gathered shape, or
+        dense full-table shape (touched rows scattered in); None skips
+        the read-back landing (push half still fused on the wire).
+        Replies land in ONE batched device_put. Dead shards buffer the
+        push half (original seq — the heartbeat flush replays it as an
+        ``spush``) and leave the out rows untouched, staleness-marked
+        like a degraded pull."""
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        ids_list = row_ids if isinstance(row_ids, (list, tuple)) \
+            else [row_ids]
+        rows_list = rows if isinstance(rows, (list, tuple)) else [rows]
+        outs = out if isinstance(out, (list, tuple)) else [out] * len(keys)
+        per_conn = {}
+        metas = []
+        for k, rid, rws, o in zip(keys, ids_list, rows_list, outs):
+            if k not in self._parts:
+                raise KeyError(
+                    "sparse_push_pull of uninitialized key %r" % (k,))
+            rid_np = self._as_host(rid).astype(_np.int64).reshape(-1)
+            rows_np = self._as_host(rws)
+            nrows = self._shapes[k][0] if self._shapes[k] else 1
+            if drop_padding:
+                keep = (rid_np >= 0) & (rid_np < nrows)
+                rid_np, rows_np = rid_np[keep], rows_np[keep]
+            order = _np.argsort(rid_np, kind="stable")
+            rid_np, rows_np = rid_np[order], rows_np[order]
+            if rid_np.size:
+                if rid_np[0] < 0 or rid_np[-1] >= nrows:
+                    raise IndexError(
+                        "sparse_push_pull row_ids out of range for "
+                        "table of %d rows: [%d, %d]"
+                        % (nrows, rid_np[0], rid_np[-1]))
+                if (_np.diff(rid_np) == 0).any():
+                    raise ValueError(
+                        "sparse_push_pull row_ids must be unique "
+                        "(dedupe/segment-sum the gradient rows first)")
+            sks = []
+            for sk, lo, hi in self._parts[k]:
+                sel = (rid_np >= lo) & (rid_np < hi)
+                if not sel.any():
+                    continue
+                entry = (sk, rid_np[sel] - lo, rows_np[sel],
+                         self._base_clock.get(sk, 0), next(self._seq))
+                per_conn.setdefault(self._conn(sk), []).append(entry)
+                sks.append(sk)
+                self._stats.add("sparse_frames")
+                self._stats.add("sparse_rows_sent", int(sel.sum()))
+            metas.append((k, o, rid_np, sks))
+        results = {}
+        for got in self._pmap([(lambda c=c, es=es:
+                                self._spushpull_conn(c, es))
+                               for c, es in per_conn.items()]):
+            results.update(got)
+        self._assemble_sparse(metas, results)
+
+    def _spushpull_conn(self, conn, entries):
+        """Everything one sparse_push_pull() exchanges with one server:
+        pipelined ``spushpull`` frames, one per touched row-range part.
+        Returns ``{subkey: (rows, clock) | None}`` — None marks a part
+        whose push was buffered for a dead/failed shard (the caller
+        leaves those out rows untouched)."""
+        out = {}
+        msgs = [("spushpull", sk, ids, rws, clock, self._origin, seq)
+                for sk, ids, rws, clock, seq in entries]
+        if conn.state == "dead":
+            for sk, ids, rws, clock, seq in entries:
+                self._buffer_push(conn, sk, (_SP_MARK, ids, rws), clock,
+                                  seq)
+                with self._degraded_lock:
+                    self._degraded.add(sk)
+                out[sk] = None
+            return out
+        replies = conn.request_all(msgs, return_exceptions=True)
+        for entry, reply in zip(entries, replies):
+            sk, ids, rws, clock, seq = entry
+            if isinstance(reply, ConnectionError):
+                self._buffer_push(conn, sk, (_SP_MARK, ids, rws), clock,
+                                  seq)
+                with self._degraded_lock:
+                    self._degraded.add(sk)
+                out[sk] = None
+            elif isinstance(reply, Exception):
+                if _stale_dst(reply) is None:
+                    raise reply
+                out[sk] = self._spushpull_moved(entry, reply)
+            elif reply[0] == "err":
+                if _stale_dst(reply[1]) is not None:
+                    out[sk] = self._spushpull_moved(
+                        entry, RuntimeError(
+                            "parameter server: %s" % reply[1]))
+                else:
+                    raise RuntimeError("parameter server: %s" % reply[1])
+            else:
+                self._base_clock[sk] = reply[2]
+                with self._degraded_lock:
+                    self._degraded.discard(sk)
+                out[sk] = (reply[1], reply[2])
+        return out
+
+    def _spushpull_moved(self, entry, err):
+        """A spushpull refused with ``map_stale``: learn the rows' new
+        home and replay there with the ORIGINAL seq — exactly-once
+        apply, fresh row values from the new owner."""
+        sk, ids, rws, clock, seq = entry
+        self._stats.add("map_reroutes")
+        self._key_overrides[sk] = _stale_dst(err)
+        reply = self._routed_request(sk, "spushpull", sk, ids, rws,
+                                     clock, self._origin, seq)
+        self._base_clock[sk] = reply[2]
+        return (reply[1], reply[2])
+
+    def _assemble_sparse(self, metas, results):
+        """Reassemble per-part row replies in ascending-id order and
+        land every target in ONE batched host->device transfer; the
+        scatter into full-shape targets runs as a cached device
+        dispatch (same shapes every step — no retrace)."""
+        from .ndarray.sparse import (RowSparseNDArray,
+                                     CompactRowSparseNDArray)
+        puts = []
+        for k, o, rid_np, sks in metas:
+            if o is None or not sks:
+                continue
+            pieces = [results.get(sk) for sk in sks]
+            if any(p is None for p in pieces):
+                continue        # degraded part: leave the target rows
+            rows_full = pieces[0][0] if len(pieces) == 1 \
+                else _np.concatenate([p[0] for p in pieces], axis=0)
+            tgt0 = o[0] if isinstance(o, (list, tuple)) else o
+            tdt = _np.dtype(getattr(tgt0, "dtype", rows_full.dtype))
+            if rows_full.dtype != tdt and _half_float(rows_full.dtype):
+                # bf16 reply-in-kind (AMP): restore the master dtype
+                # host-side, before the one batched device_put
+                rows_full = rows_full.astype(tdt)
+            puts.append((o, rid_np, rows_full))
+        if not puts:
+            return
+        devs = jax.device_put(
+            [rows for _, _, rows in puts]
+            + [ids.astype(_np.int32) for _, ids, _ in puts])
+        n = len(puts)
+        for (o, rid_np, _rows), rows_dev, ids_dev in zip(
+                puts, devs[:n], devs[n:]):
+            for tgt in (o if isinstance(o, (list, tuple)) else [o]):
+                if isinstance(tgt, CompactRowSparseNDArray):
+                    tgt._set_rows(rid_np, rows_dev)
+                elif tuple(tgt.shape) == tuple(rows_dev.shape) and \
+                        not isinstance(tgt, RowSparseNDArray):
+                    tgt._data = rows_dev
+                else:
+                    tgt._data = tgt._data.at[ids_dev].set(
+                        rows_dev.astype(tgt._data.dtype))
+                    if hasattr(tgt, "_aux"):
+                        tgt._aux = None   # metadata recomputes lazily
+
+    def sparse_push_pull_async(self, key, row_ids, rows, out=None,
+                               priority=0, drop_padding=False):
+        """One background row-sparse wire job on the order-preserving
+        executor (the ``push_pull_async`` contract: per-key seq order
+        end to end, device->host reads OFF the training thread).
+        ``row_ids``/``rows`` may be raw jax arrays straight out of the
+        fused grad program — the job device_gets them here. Returns a
+        Future; failures surface at ``.result()``."""
+        def _job():
+            self.sparse_push_pull(key, row_ids, rows, out=out,
+                                  priority=priority,
+                                  drop_padding=drop_padding)
+
+        return self._ordered_pool().submit(_job)
+
     def _buffer_push(self, conn, sk, payload, base_clock, seq):
         with self._pending_lock:
             pend = self._pending.setdefault(conn, [])
@@ -3637,6 +4013,11 @@ class AsyncDistKVStore(KVStore):
         for (o, _full), dev in zip(assembled, devs):
             for tgt in (o if isinstance(o, (list, tuple)) else [o]):
                 tgt._data = dev
+                if hasattr(tgt, "_aux"):
+                    # sparse-typed target (row_sparse param array): the
+                    # pulled value replaced the dense table wholesale —
+                    # the compressed metadata recomputes lazily
+                    tgt._aux = None
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows from the server table (reference
@@ -3696,9 +4077,16 @@ class AsyncDistKVStore(KVStore):
                     tgt._data = garr._data
                 elif tuple(tgt.shape) == self._shapes[k]:
                     # dense full-shape target (Module.prepare pulls into
-                    # full executor buffers — base-store contract,
-                    # kvstore.py row_sparse_pull): fetch the whole table
-                    self.pull(k, out=tgt)
+                    # full executor buffers): refresh ONLY the requested
+                    # rows — the server sliced row-wise, so a row pull
+                    # never ships the whole table (the old fallback
+                    # re-fetched the ENTIRE table here, defeating the
+                    # sparse wire for exactly the giant-table case
+                    # row_sparse_pull exists for)
+                    if rid_np.size:
+                        tgt._data = tgt._data.at[
+                            jnp.asarray(rid_np.astype(_np.int32))].set(
+                            garr._data.astype(tgt._data.dtype))
                 else:
                     raise TypeError(
                         "row_sparse_pull target must be row_sparse, "
@@ -3899,9 +4287,17 @@ class AsyncDistKVStore(KVStore):
             try:
                 # routed: the key may have moved while its shard was
                 # down (a reshard away from the dying server is the
-                # textbook drill) — the replay follows the map
-                self._routed_request(sk, "push", sk, payload, clock,
-                                     self._origin, seq)
+                # textbook drill) — the replay follows the map. A
+                # row-sparse entry (its payload slot carries the
+                # (_SP_MARK, row_ids, rows) tag) replays as an spush.
+                if isinstance(payload, tuple) and len(payload) == 3 \
+                        and payload[0] == _SP_MARK:
+                    self._routed_request(sk, "spush", sk, payload[1],
+                                         payload[2], clock,
+                                         self._origin, seq)
+                else:
+                    self._routed_request(sk, "push", sk, payload, clock,
+                                         self._origin, seq)
             except ConnectionError:
                 with self._pending_lock:   # died again: keep the rest
                     self._pending[conn] = items[n:] \
@@ -4062,10 +4458,14 @@ class AsyncDistKVStore(KVStore):
                              for c in self._conns)
         s["dup_pushes"] = 0
         s["server_pushes"] = 0
+        s["sparse_pushes"] = 0
+        s["sparse_rows"] = 0
         sweeps = self._server_stats_sweep()
         for srv in sweeps:
             s["dup_pushes"] += srv.get("dup_pushes", 0)
             s["server_pushes"] += srv.get("pushes", 0)
+            s["sparse_pushes"] += srv.get("sparse_pushes", 0)
+            s["sparse_rows"] += srv.get("sparse_rows", 0)
         s["replication"] = [
             {"addr": srv.get("addr"), "role": srv.get("role"),
              "promotions": srv.get("promotions", 0),

@@ -72,9 +72,26 @@ layouts for the donated persistent state and relayouts the store ONCE
 at compile, not per call — the layout-copy share of the step trace
 goes to the compiler's choice.
 
+Sparse embeddings (ISSUE 13): a kvstore-managed module whose
+row-sparse parameters are Embedding tables stays ONE XLA program — the
+grad-emitting step dedupes the batch's indices on device (static-shape
+sort/segment unique) and gathers the touched rows out of the dense VJP
+gradient (``Executor.make_fused_grad_step(sparse_emits=...)``), so the
+emitted entry is a ``(row_ids, rows)`` pair. ``finish_update`` ships
+it over the ``sparse_push_pull`` wire op: only touched rows travel,
+the server applies with the row-wise optimizer mirror
+(``Optimizer.update_host_rows``), and the gathered reply scatters back
+into the shared device store — wire bytes and server optimizer cost
+scale with rows touched, never with table size. bf16 rows compose with
+``MXTPU_AMP`` exactly like dense gradients. Requires
+``update_on_kvstore`` (the server owns the full table and its state —
+the reference's sparse-table contract); ``MXTPU_MODULE_FUSED_SPARSE=0``
+restores the eager densifying fallback.
+
 Escape hatch: anything the one-program contract can't honor — a
 ``Monitor`` install (wants per-node outputs), a custom Python updater,
-sparse parameters, multi-context groups, ``inputs_need_grad`` — falls
+sparse parameters off the server-managed dist path, multi-context
+groups, ``inputs_need_grad`` — falls
 back to the eager path (warning once for monitor / custom updaters;
 every silent fallback logs its reason once at debug level, see
 ``_fused_eligible``). ``MXTPU_MODULE_FUSED=0`` disables the whole
@@ -171,6 +188,61 @@ def _fused_dist_enabled():
     modules on the eager push/pull loop (the pre-ISSUE-10 behavior)."""
     return os.environ.get("MXTPU_MODULE_FUSED_DIST", "1").strip().lower() \
         not in ("0", "false", "off")
+
+
+def _fused_sparse_enabled():
+    """MXTPU_MODULE_FUSED_SPARSE: default on; ``0`` sends modules with
+    row-sparse parameters back to the eager dist path (which densifies
+    every embedding gradient onto the wire — the pre-ISSUE-13
+    behavior, kept as the escape hatch)."""
+    return os.environ.get("MXTPU_MODULE_FUSED_SPARSE",
+                          "1").strip().lower() not in ("0", "false",
+                                                       "off")
+
+
+def _sparse_param_names(exec_):
+    """Names bound with sparse storage (arg or grad) — the set the
+    eligibility predicate and the sparse-emit plan both key on."""
+    out = []
+    for name, arr in exec_.arg_dict.items():
+        if hasattr(arr, "_aux") or \
+                hasattr(exec_.grad_dict.get(name), "_aux"):
+            out.append(name)
+    return out
+
+
+def _sparse_grad_feeds(module, sparse_names):
+    """Resolve each sparse parameter's index feeds: the DIRECT-input
+    data variables of the Embedding nodes consuming it. Returns
+    ``(feeds dict, reason)`` — feeds is None with a human-readable
+    reason when the one-program sparse contract can't hold (a consumer
+    other than Embedding would put gradient mass outside the touched
+    rows; a computed index feed has no value the emit can read)."""
+    feeds = {n: [] for n in sparse_names}
+    sparse_set = set(sparse_names)
+    for node in module._symbol._topo():
+        if node.op is None:
+            continue
+        for pos, (src, _oi) in enumerate(node.inputs):
+            if not src.is_variable or src.name not in sparse_set:
+                continue
+            if getattr(node.op, "name", None) != "Embedding" or pos != 1:
+                return None, (
+                    "sparse parameter %r consumed by %r (only Embedding"
+                    " lookups emit row-sparse gradients)"
+                    % (src.name, getattr(node.op, "name", node.name)))
+            data_node = node.inputs[0][0]
+            if not data_node.is_variable:
+                return None, (
+                    "sparse parameter %r indexed by a computed value "
+                    "(the sparse emit needs a direct input feed)"
+                    % (src.name,))
+            feeds[src.name].append(data_node.name)
+    for name, fs in feeds.items():
+        if not fs:
+            return None, ("sparse parameter %r has no Embedding "
+                          "consumer" % (name,))
+    return {n: tuple(fs) for n, fs in feeds.items()}, None
 
 
 def amp_mode():
@@ -384,6 +456,14 @@ class FusedModuleTrainer:
         self._pending_grads = None
         # dist_local: reusable zero buffer backing the pull targets
         self._grad_zeros = None
+        # sparse fast path (ISSUE 13): param name -> its Embedding
+        # index feeds; empty when no sparse params ride this module
+        self._sparse_feeds = {}
+        if mode == "dist":
+            sparse_names = _sparse_param_names(exec_)
+            if sparse_names:
+                feeds, _ = _sparse_grad_feeds(module, sparse_names)
+                self._sparse_feeds = feeds or {}
 
     @property
     def mode(self):
@@ -645,7 +725,8 @@ class FusedModuleTrainer:
                 loss_scale=fs.loss_scale,
                 cast_exclude=tuple(self._module._label_names),
                 wire_dtype=fs.wire_dtype,
-                auto_layout=fs.auto_layout))
+                auto_layout=fs.auto_layout,
+                sparse_emits=self._sparse_feeds or None))
         fs.stats["cache_hits" if hit else "compiles"] += 1
         fn, other_names = entry
 
@@ -699,6 +780,8 @@ class FusedModuleTrainer:
         fs = self._group
         kv = fs.kv
         names = list(self._train_names)
+        if self._mode == "dist" and self._sparse_feeds:
+            return self._finish_update_sparse(grads, names)
         if fs.dist_mode == "sync":
             # one batched d2h for the step's gradients (the async path
             # does the same inside push_pull_async, off-thread)
@@ -725,6 +808,53 @@ class FusedModuleTrainer:
             fs.window.dispatch(
                 lambda: kv.push_pull_async(names, vals, out=gouts),
                 on_complete=lambda _res, g=gouts: self._apply_pulled(g))
+
+    def _finish_update_sparse(self, grads, names):
+        """The dist update when sparse embeddings ride the step
+        (ISSUE 13): dense gradients take the ``pushpull`` wire exactly
+        as before; each sparse parameter's emitted ``(row_ids, rows)``
+        pair takes ``sparse_push_pull`` — only touched rows travel,
+        the server applies row-wise, and the gathered reply scatters
+        straight back into the SHARED device parameter store (bucket
+        switches stay cache hits; untouched rows keep their values,
+        which is exactly what the server did too). Sync mode reads the
+        whole step — dense grads, ids, rows — in ONE batched
+        device_get; async ships both wire jobs on the ordered pool
+        under the same bounded window."""
+        fs = self._group
+        kv = fs.kv
+        sparse = self._sparse_feeds
+        d_idx = [i for i, n in enumerate(names) if n not in sparse]
+        s_idx = [i for i, n in enumerate(names) if n in sparse]
+        d_names = [names[i] for i in d_idx]
+        s_names = [names[i] for i in s_idx]
+        d_outs = [fs.param_store[n] for n in d_names]
+        s_outs = [fs.param_store[n] for n in s_names]
+        if fs.dist_mode == "sync":
+            leaves = [grads[i] for i in d_idx]
+            for i in s_idx:
+                leaves += [grads[i][0], grads[i][1]]
+            host = jax.device_get(leaves)     # ONE batched d2h
+            d_vals = host[:len(d_idx)]
+            sp = host[len(d_idx):]
+            if d_names:
+                kv.push_pull(d_names, d_vals, out=d_outs)
+            kv.sparse_push_pull(
+                s_names, [sp[2 * j] for j in range(len(s_idx))],
+                [sp[2 * j + 1] for j in range(len(s_idx))],
+                out=s_outs, drop_padding=True)
+            return
+        if d_names:
+            d_vals = [NDArray(grads[i]) for i in d_idx]
+            fs.window.dispatch(
+                lambda: kv.push_pull_async(d_names, d_vals,
+                                           out=d_outs))
+        ids_list = [grads[i][0] for i in s_idx]
+        rows_list = [grads[i][1] for i in s_idx]
+        fs.window.dispatch(
+            lambda: kv.sparse_push_pull_async(
+                s_names, ids_list, rows_list, out=s_outs,
+                drop_padding=True))
 
     def _grad_targets(self):
         exec_ = self._module._exec_group.execs[0]
@@ -781,19 +911,22 @@ class FusedModuleTrainer:
 
 
 def _fused_eligible(module):
-    """The fused-path eligibility predicate, narrowed by ISSUE 10:
-    kvstore-managed updates are now a FAST path (``dist`` /
-    ``dist_local`` modes), so silent fallback remains only for the
-    still-unsupported set — sparse parameters, multi-context groups,
-    ``inputs_need_grad`` — plus the explicit configuration outs
-    (env kill switches, non-write grad_req, state inputs, custom
-    updaters).
+    """The fused-path eligibility predicate, narrowed by ISSUE 10 and
+    again by ISSUE 13: kvstore-managed updates are a FAST path
+    (``dist`` / ``dist_local`` modes), and row-sparse embedding
+    parameters now ride the ``dist`` mode too (device-side
+    unique/gather in the grad program, sparse pushpull on the wire) —
+    silent fallback remains only for the still-unsupported set —
+    multi-context groups, ``inputs_need_grad``, sparse params off the
+    server-managed path — plus the explicit configuration outs (env
+    kill switches, non-write grad_req, state inputs, custom updaters).
 
     Returns ``(mode, reason)``: ``mode`` is ``'local'`` (in-program
     optimizer), ``'dist'`` (server-side update via the kvstore),
     ``'dist_local'`` (kvstore-merged gradients + fused local apply) or
     ``None`` with the human-readable fallback reason — logged once at
     debug level so fallbacks are diagnosable instead of silent."""
+    from ..ndarray.sparse import RowSparseNDArray, CompactRowSparseNDArray
     if not _module_fused_enabled():
         return None, "MXTPU_MODULE_FUSED=0"
     if len(module._context) != 1 or len(module._exec_group.execs) != 1:
@@ -808,9 +941,7 @@ def _fused_eligible(module):
         return None, "grad_req=%r (fused step assumes 'write')" \
             % (module._grad_req,)
     exec_ = module._exec_group.execs[0]
-    for arr in list(exec_.arg_dict.values()) + list(exec_.grad_dict.values()):
-        if hasattr(arr, "_aux"):   # sparse storage: lazy-update path
-            return None, "sparse parameters (lazy-update path)"
+    sparse_names = _sparse_param_names(exec_)
     if module._kvstore is not None:
         if not _fused_dist_enabled():
             return None, "MXTPU_MODULE_FUSED_DIST=0"
@@ -818,11 +949,43 @@ def _fused_eligible(module):
             return None, "kvstore %r has no async push path" \
                 % (getattr(module._kvstore, "type",
                            type(module._kvstore).__name__),)
+        if sparse_names:
+            # the sparse fast path (ISSUE 13): server-managed row-wise
+            # updates over the spushpull wire — the program must be
+            # able to emit (row_ids, rows) for every sparse param
+            if not _fused_sparse_enabled():
+                return None, "MXTPU_MODULE_FUSED_SPARSE=0"
+            if not module._update_on_kvstore:
+                return None, ("sparse parameters with "
+                              "update_on_kvstore=False (the local "
+                              "apply would densify every gradient)")
+            if not hasattr(module._kvstore, "sparse_push_pull"):
+                return None, "kvstore %r has no sparse_push_pull" \
+                    % (getattr(module._kvstore, "type",
+                               type(module._kvstore).__name__),)
+            for n in sparse_names:
+                for arr in (exec_.arg_dict.get(n),
+                            exec_.grad_dict.get(n)):
+                    if arr is None:
+                        continue
+                    if isinstance(arr, CompactRowSparseNDArray):
+                        return None, ("compact row_sparse parameter %r"
+                                      " (no dense device value for the"
+                                      " one-program step)" % (n,))
+                    if hasattr(arr, "_aux") and \
+                            not isinstance(arr, RowSparseNDArray):
+                        return None, ("non-row_sparse sparse "
+                                      "parameter %r" % (n,))
+            feeds, reason = _sparse_grad_feeds(module, sparse_names)
+            if feeds is None:
+                return None, reason
         if module._update_on_kvstore:
             return "dist", None
         if not isinstance(module._updater, opt_mod.Updater):
             return None, "custom updater"
         return "dist_local", None
+    if sparse_names:
+        return None, "sparse parameters (lazy-update path)"
     if not isinstance(module._updater, opt_mod.Updater):
         return None, "custom updater"
     return "local", None

@@ -225,6 +225,19 @@ class Optimizer:
         device kernel's arithmetic exactly (same operation order)."""
         return None
 
+    def update_host_rows(self, index, weight, grad_rows, state, row_ids):
+        """Row-wise numpy update — the sparse-pushpull server path
+        (ISSUE 13): apply this optimizer to ONLY the rows a worker
+        touched. ``weight`` is the FULL table (read the ``row_ids``
+        rows, never write it); ``state`` holds full-table numpy slots
+        from :meth:`create_state_host`, mutated in place at ``row_ids``
+        only; ``grad_rows`` is the ``(len(row_ids), *row_shape)``
+        gradient. Returns the NEW row values (same shape as
+        ``grad_rows``) or None to route to the densify fallback. Must
+        equal :meth:`update_host` restricted to the touched rows, so
+        per-push cost is O(rows touched), not O(table)."""
+        return None
+
     def _uses_master_weights(self, weight):
         return self.multi_precision and weight.dtype == _np.float16
 
@@ -455,6 +468,24 @@ class SGD(Optimizer):
             state -= lr * g
             return weight + state
         return weight - lr * g
+
+    def update_host_rows(self, index, weight, grad_rows, state, row_ids):
+        # update_host restricted to the touched rows: same
+        # _rescale_clip -> momentum -> apply operation order, state
+        # mutated at row_ids only (lazy-update semantics: untouched
+        # rows keep stale momentum, exactly like the rsp device path)
+        lr, wd = self._begin_update(index)
+        w = weight[row_ids]
+        g = grad_rows * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient >= 0:
+            _np.clip(g, -self.clip_gradient, self.clip_gradient, out=g)
+        if wd != 0.0:
+            g = g + wd * w
+        if state is not None:
+            m = state[row_ids] * self.momentum - lr * g
+            state[row_ids] = m
+            return w + m
+        return w - lr * g
 
     def update(self, index, weight, grad, state):
         if _is_rsp(grad) and self.lazy_update:
@@ -728,6 +759,29 @@ class Adam(Optimizer):
         var += (1.0 - self.beta2) * _np.square(g)
         return weight - lr * mean / (_np.sqrt(var) + self.epsilon)
 
+    def update_host_rows(self, index, weight, grad_rows, state, row_ids):
+        # update_host restricted to the touched rows. t is the key's
+        # push count (every push bumps it, dense or sparse), matching
+        # the dense server path; untouched rows keep stale mean/var —
+        # the reference's lazy adam semantics.
+        lr, wd = self._begin_update(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * float(_np.sqrt(coef2)) / coef1
+        mean, var = state
+        w = weight[row_ids]
+        g = grad_rows * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient >= 0:
+            _np.clip(g, -self.clip_gradient, self.clip_gradient, out=g)
+        if wd != 0.0:
+            g = g + wd * w
+        m = mean[row_ids] * self.beta1 + (1.0 - self.beta1) * g
+        v = var[row_ids] * self.beta2 + (1.0 - self.beta2) * _np.square(g)
+        mean[row_ids] = m
+        var[row_ids] = v
+        return w - lr * m / (_np.sqrt(v) + self.epsilon)
+
     def update(self, index, weight, grad, state):
         if _is_rsp(grad) and self.lazy_update:
             return _lazy_rsp_update(self, index, weight, grad, state)
@@ -758,6 +812,34 @@ class AdaGrad(Optimizer):
 
     def create_state(self, index, weight):
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    host_update = True
+
+    def create_state_host(self, index, weight):
+        return _np.zeros_like(weight)
+
+    def update_host(self, index, weight, grad, state):
+        # numpy mirror of the device update: same rescale -> clip ->
+        # history -> apply operation order (history mutates in place)
+        lr, wd = self._begin_update(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            _np.clip(g, -self.clip_gradient, self.clip_gradient, out=g)
+        state += g * g
+        return weight - lr * (g / _np.sqrt(state + self.float_stable_eps)
+                              + wd * weight)
+
+    def update_host_rows(self, index, weight, grad_rows, state, row_ids):
+        # update_host restricted to the touched rows — the accumulated
+        # squared-gradient history grows only where pushes landed
+        lr, wd = self._begin_update(index)
+        w = weight[row_ids]
+        g = grad_rows * self.rescale_grad
+        if self.clip_gradient is not None:
+            _np.clip(g, -self.clip_gradient, self.clip_gradient, out=g)
+        h = state[row_ids] + g * g
+        state[row_ids] = h
+        return w - lr * (g / _np.sqrt(h + self.float_stable_eps) + wd * w)
 
     def update(self, index, weight, grad, state):
         if _is_rsp(grad):
@@ -960,6 +1042,12 @@ class Updater:
         self.optimizer = optimizer
         self.states = {}
         self.states_synced = {}
+        # slots already living as writable host numpy (update_host /
+        # update_host_rows): tracked so tuple-structured states (adam's
+        # (mean, var)) convert ONCE instead of paying a full-table copy
+        # per push, and invalidated whenever set_states/set_state_one
+        # installs restored (NDArray-structured) slots
+        self._host_idx = set()
 
     def ensure_state(self, index, weight):
         """Materialize (and return) the state slot for ``index`` exactly as
@@ -994,6 +1082,22 @@ class Updater:
             return state.copy()
         return state
 
+    def _ensure_host_state(self, index, weight):
+        """The writable-numpy state slot for ``index``, created via
+        ``create_state_host`` on first touch or converted ONCE from a
+        restored/device-path slot (``_host_idx`` remembers converted
+        slots so tuple states don't re-copy per push)."""
+        opt = self.optimizer
+        if index not in self.states:
+            self.states[index] = opt.create_state_host(index, weight)
+            self.states_synced[index] = True
+            self._host_idx.add(index)
+        elif index not in self._host_idx:
+            self.states[index] = self._state_to_host(self.states[index])
+            self.states_synced[index] = True
+            self._host_idx.add(index)
+        return self.states[index]
+
     def update_host(self, index, weight, grad):
         """Numpy host-path apply (the dist_async server's per-push fast
         path): returns the NEW weight array, or None when the optimizer
@@ -1004,15 +1108,28 @@ class Updater:
         opt = self.optimizer
         if not getattr(opt, "host_update", False) or opt.multi_precision:
             return None
-        if index not in self.states:
-            self.states[index] = opt.create_state_host(index, weight)
-            self.states_synced[index] = True
-        elif not isinstance(self.states[index], _np.ndarray) or \
-                not self.states[index].flags.writeable:
-            self.states[index] = self._state_to_host(self.states[index])
-            self.states_synced[index] = True
         return opt.update_host(index, weight, _np.asarray(grad),
-                               self.states[index])
+                               self._ensure_host_state(index, weight))
+
+    def update_host_rows(self, index, weight, row_ids, grad_rows):
+        """Row-wise server apply (the sparse-pushpull path, ISSUE 13):
+        returns the NEW values of the ``row_ids`` rows, or None when the
+        optimizer has no row-wise host mirror — the caller then
+        densifies the gradient and takes the dense path, so ANY
+        optimizer stays correct while sgd/adagrad/adam pay only
+        O(rows touched). ``weight`` is the full table and is never
+        mutated here (the server scatters the returned rows under its
+        key lock); full-table state slots mutate in place at the
+        touched rows only."""
+        opt = self.optimizer
+        if not getattr(opt, "host_update", False) or opt.multi_precision:
+            return None
+        if type(opt).update_host_rows is Optimizer.update_host_rows:
+            return None
+        return opt.update_host_rows(index, weight,
+                                    _np.asarray(grad_rows),
+                                    self._ensure_host_state(index, weight),
+                                    row_ids)
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):
@@ -1040,6 +1157,7 @@ class Updater:
 
         self.states = {k: from_np(v) for k, v in states.items()}
         self.states_synced = dict.fromkeys(self.states.keys(), False)
+        self._host_idx = set()   # restored slots are NDArray-structured
 
     def get_states(self, dump_optimizer=False):
         # serialize as numpy so states round-trip without device handles
@@ -1087,6 +1205,7 @@ class Updater:
 
         self.states[index] = from_np(pickle.loads(bytes(payload)))
         self.states_synced[index] = False
+        self._host_idx.discard(index)
 
 
 def get_updater(optimizer):

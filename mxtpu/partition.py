@@ -48,7 +48,29 @@ class PartitionRules(ShardingRules):
     The matched rule's *pattern string* is the group id: stable across
     processes (unlike salted ``hash()``), human-readable in layouts, and
     identical for every worker that was handed the same rule list.
+
+    Row-sharded groups (ISSUE 13): a group whose parameter is ONE giant
+    embedding table wants the opposite of co-location — its row-range
+    parts (the ``MXTPU_KVSTORE_BIGARRAY_BOUND`` subkeys) must SPREAD
+    across servers so the table can exceed any single server's memory.
+    :meth:`mark_row_sharded` flips a matched group to that placement:
+    part ``i`` of a matching key lands on ``(crc32(pattern) + i) % n``,
+    deterministic for every worker, while the checkpoint layout keeps
+    the group as one blob (restore is layout-agnostic either way).
     """
+
+    def __init__(self, rules=None):
+        super().__init__(rules)
+        self._row_sharded = set()
+
+    def mark_row_sharded(self, pattern):
+        """Spread the matched group's row-range parts across shards
+        instead of co-locating them. ``pattern`` must be the pattern
+        string of one of this spec's rules."""
+        if not any(p.pattern == pattern for p, _ in self.rules):
+            raise ValueError("no rule with pattern %r" % (pattern,))
+        self._row_sharded.add(pattern)
+        return self
 
     def group_for(self, name):
         """The pattern of the first rule matching ``name`` (part
@@ -62,12 +84,24 @@ class PartitionRules(ShardingRules):
 
     def shard_for(self, name, num_shards):
         """Deterministic group -> shard assignment: every key of one
-        rule group lands on the same server. None when no rule matches
-        (caller keeps its per-key hash)."""
+        rule group lands on the same server — except row-sharded
+        groups, whose part subkeys rotate across shards (part ``i`` on
+        ``(group base + i) % n``) so one table spans the fleet. None
+        when no rule matches (caller keeps its per-key hash)."""
         group = self.group_for(name)
         if group is None:
             return None
-        return zlib.crc32(group.encode("utf-8")) % max(1, int(num_shards))
+        n = max(1, int(num_shards))
+        base = zlib.crc32(group.encode("utf-8"))
+        if group in getattr(self, "_row_sharded", ()):
+            s = str(name)
+            if PART_SEP in s:
+                try:
+                    part = int(s.split(PART_SEP, 1)[1])
+                except ValueError:
+                    part = 0
+                return (base + part) % n
+        return base % n
 
     def group_tag(self, group):
         """Filesystem-safe stable id for a group (regex patterns are

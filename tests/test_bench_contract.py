@@ -225,3 +225,29 @@ def test_serving_bench_contract():
     assert ro["staleness_ms_p50"] > 0
     assert ro["staleness_ms_max"] >= ro["staleness_ms_p50"]
     assert ro["retraces"] == 0
+
+
+def test_embedding_bench_contract(tmp_path):
+    """tools/bench_embedding.py: exactly one JSON line, rc 0, with the
+    sparse-wire scaling evidence (docs/perf_analysis.md "Sparse fast
+    path"): bytes/step tracking rows touched, never table size."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT,
+               MXTPU_PS_HEARTBEAT="0", MXTPU_BENCH_TINY="1")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "bench_embedding.py"),
+         "--no-write"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, "must print exactly ONE JSON line"
+    payload = json.loads(lines[0])
+    assert payload["bench"] == "embedding_sparse_wire"
+    assert payload["transport"] == "tcp"
+    for pt in payload["points"]:
+        for kind in ("dense", "sparse"):
+            assert pt[kind]["bytes_per_step"] > 0
+            assert pt[kind]["steps_per_s"] > 0
+        # the contract: sparse bytes track rows touched (within 2x of
+        # the touch fraction — headers/ids are the slack), dense don't
+        assert pt["bytes_ratio"] <= 2 * pt["touch_fraction"] + 0.01, pt

@@ -1601,3 +1601,131 @@ def test_module_step_fault_point_validates():
     assert rules[0].point == "module.step"
     with pytest.raises(ValueError, match="join_worker"):
         fault.parse_spec("kind=join_worker,point=module.step")
+
+
+# ---------------------------------------------------------------------------
+# row-sparse pushpull (ISSUE 13): faults mid-sparse-wire. The matrix rows:
+#   sever @ server.send op=spushpull -> replay refused by seq dedupe,
+#       reply still carries the CURRENT row values (exactly-once apply)
+#   kill primary mid-sparse-push     -> promoted backup holds the
+#       forwarded prefix and REFUSES its replay; rows land exactly once
+#   online split of an embedding shard -> row-range value + clock +
+#       dedupe seqs + row-wise optimizer state move exactly-once
+# ---------------------------------------------------------------------------
+
+def test_sparse_pushpull_sever_replays_exactly_once(monkeypatch):
+    """Sever after the server applied a sparse pushpull but before its
+    ack: the blind replay carries the same (row_ids, rows) payload,
+    the (origin, seq) watermark refuses the re-apply, and the retry's
+    reply still gathers the current row values — rows land exactly
+    once, the pull half stays fresh."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("emb", mx.nd.zeros((6, 3)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                          momentum=0.9,
+                                          rescale_grad=1.0))
+        ids = np.array([1, 4], "int64")
+        out = mx.nd.zeros((6, 3))
+        with fault.inject(
+                "kind=sever,point=server.send,op=spushpull,nth=1") as inj:
+            kv.sparse_push_pull("emb", ids, np.ones((2, 3), "f"),
+                                out=out)
+        assert inj.stats()[0][4] == 1, "the sever never fired"
+        assert srv._clock["emb"] == 1          # applied exactly once
+        assert srv._dup_n == 1                 # the replay was refused
+        assert kv.stats()["retransmits"] >= 1
+        got = out.asnumpy()
+        np.testing.assert_allclose(got[ids], -np.ones((2, 3)))
+        assert np.all(got[[0, 2, 3, 5]] == 0)  # untouched rows intact
+        # momentum applied once, not twice: second push continues it
+        kv.sparse_push_pull("emb", ids, np.ones((2, 3), "f"), out=out)
+        np.testing.assert_allclose(out.asnumpy()[ids],
+                                   np.full((2, 3), -2.9))
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_sparse_push_kill_primary_refuses_replayed_prefix(monkeypatch):
+    """SIGKILL the primary AFTER a sparse pushpull applied and
+    sync-replicated but before its ack: the client fails over in
+    place and replays the frame at the promoted backup, whose
+    forwarded watermark REFUSES the re-apply — every row update
+    exactly once, zero acknowledged loss, row values bit-intact."""
+    pri, bak, kv = _pair(monkeypatch)
+    try:
+        kv.init("emb", mx.nd.zeros((6, 3)))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0,
+                                          rescale_grad=1.0))
+        ids = np.array([2, 5], "int64")
+        out = mx.nd.zeros((6, 3))
+        kv.sparse_push_pull("emb", ids, np.ones((2, 3), "f"), out=out)
+        with fault.inject(
+                "kind=kill,point=server.send,op=spushpull,nth=1") as inj:
+            kv.sparse_push_pull("emb", ids, np.ones((2, 3), "f"),
+                                out=out)
+        assert inj.stats()[0][4] == 1, "the kill never fired"
+        assert bak._role == "primary"
+        assert kv.stats()["failovers"] == 1
+        # first frame refused (forwarded prefix), second applied fresh
+        assert bak._clock["emb"] == 2
+        assert bak._dup_n >= 1
+        got = out.asnumpy()
+        np.testing.assert_allclose(got[ids], -2 * np.ones((2, 3)))
+        np.testing.assert_allclose(
+            np.asarray(bak._table["emb"])[np.asarray(ids)],
+            -2 * np.ones((2, 3)))
+    finally:
+        kv.close()
+        pri.stop()
+        bak.stop()
+
+
+def test_split_moves_sparse_embedding_state_exactly_once(monkeypatch):
+    """Online split of a hot embedding shard: the sparse key moves with
+    its value, clock, push-dedupe seqs and ROW-WISE optimizer state
+    (numpy momentum table) — a replayed pre-split seq is refused at the
+    new home, and the next fresh push continues the momentum sequence
+    bit-for-bit with an unsplit control run."""
+    src = ParameterServer().start()
+    dst = ParameterServer().start()
+    ctl = ParameterServer().start()
+    kv = _store(monkeypatch, src.address)
+    monkeypatch.setenv("MXTPU_PS_ADDRS", ctl.address)
+    kv_ctl = mx.kv.create("dist_async")
+    try:
+        opt = dict(learning_rate=0.5, momentum=0.9, rescale_grad=1.0)
+        ids = np.array([1, 4], "int64")
+        for store in (kv, kv_ctl):
+            store.init("emb", mx.nd.zeros((6, 3)))
+            store.set_optimizer(mx.optimizer.SGD(**opt))
+        out, out_ctl = mx.nd.zeros((6, 3)), mx.nd.zeros((6, 3))
+        kv.sparse_push_pull("emb", ids, np.ones((2, 3), "f"), out=out)
+        kv_ctl.sparse_push_pull("emb", ids, np.ones((2, 3), "f"),
+                                out=out_ctl)
+        reply = kv._conn("emb").request("split", dst.address, ["emb"])
+        assert reply[0] == "ok" and reply[1]["moved"] == ["emb"]
+        assert "emb" not in src._table
+        # replay a PRE-SPLIT seq at the new home: the transferred
+        # dedupe seqs refuse it (nothing double-applies)
+        dst_conn = kv._conn_for_addr(dst.address)
+        r = dst_conn.request("spush", "emb", ids, np.ones((2, 3), "f"),
+                             0, kv._origin, 1)
+        assert r == ("ok", "dup")
+        assert dst._clock["emb"] == 1
+        # fresh push routes via map_stale to dst and CONTINUES the
+        # moved momentum state exactly like the unsplit control
+        kv.sparse_push_pull("emb", ids, np.ones((2, 3), "f"), out=out)
+        kv_ctl.sparse_push_pull("emb", ids, np.ones((2, 3), "f"),
+                                out=out_ctl)
+        assert dst._clock["emb"] == 2
+        np.testing.assert_array_equal(out.asnumpy(), out_ctl.asnumpy())
+        assert kv.stats()["map_reroutes"] >= 1
+    finally:
+        kv.close()
+        kv_ctl.close()
+        src.stop()
+        dst.stop()
+        ctl.stop()

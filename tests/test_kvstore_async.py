@@ -248,11 +248,23 @@ def test_row_sparse_pull_async():
         mask = np.ones(20, bool)
         mask[ids] = False
         assert np.all(rsp.asnumpy()[mask] == 0)
-        # dense FULL-shape target: base-store contract (Module.prepare
-        # pulls into full executor buffers) — whole table comes back
-        full = mx.nd.zeros((20, 6))
+        # dense FULL-shape target (Module.prepare pulls into full
+        # executor buffers): ONLY the requested rows refresh — the
+        # server slices row-wise, the whole table never rides the wire
+        # for a row pull (ISSUE 13 fixed the old whole-table re-fetch)
+        sentinel = np.full((20, 6), -7.0, "f")
+        full = mx.nd.array(sentinel)
         kv.row_sparse_pull("emb", out=full, row_ids=mx.nd.array(ids))
-        np.testing.assert_allclose(full.asnumpy(), w, rtol=1e-6)
+        got = full.asnumpy()
+        np.testing.assert_allclose(got[ids], w[ids], rtol=1e-6)
+        np.testing.assert_allclose(got[mask], sentinel[mask])
+        # out-of-range ids are refused before any wire traffic
+        with pytest.raises(IndexError, match="out of range"):
+            kv.row_sparse_pull("emb", out=mx.nd.zeros((1, 6)),
+                               row_ids=mx.nd.array([20]))
+        with pytest.raises(IndexError, match="out of range"):
+            kv.row_sparse_pull("emb", out=mx.nd.zeros((1, 6)),
+                               row_ids=mx.nd.array([-1]))
         kv.close()
     finally:
         ka._BIGARRAY_BOUND = old

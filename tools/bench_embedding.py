@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Loopback microbench for the row-sparse embedding wire (ISSUE 13).
+
+Sweeps table size x rows-touched-per-step and measures, over REAL wire
+framing (the TCP loopback transport — byte counters need frames), what
+the sparse fast path exists to change:
+
+* **bytes/step** — dense ``push_pull`` ships the whole table both
+  ways; ``sparse_push_pull`` ships ``(row_ids, rows)`` and gets the
+  same rows back. The ratio must track rows-touched / table-rows, not
+  table size.
+* **steps/s** — the server applies row-wise
+  (``Optimizer.update_host_rows``: only touched rows pay optimizer
+  cost) vs the dense full-table apply.
+
+Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
+and mirrors it to docs/embedding_bench.json unless --no-write.
+CPU-only; MXTPU_BENCH_TINY shrinks the sweep for the contract test.
+
+Run: JAX_PLATFORMS=cpu python tools/bench_embedding.py [--steps 30]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
+os.environ["MXTPU_PS_LOCAL"] = "0"   # bytes need real framing
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np                                    # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+
+
+def _step_stats(kv, fn, steps):
+    before = kv.stats()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fn()
+    dt = time.perf_counter() - t0
+    after = kv.stats()
+    wire = (after["bytes_sent"] - before["bytes_sent"]
+            + after["bytes_recv"] - before["bytes_recv"])
+    return wire / steps, steps / dt
+
+
+def run_point(rows, dim, touched, steps, optimizer):
+    """One (table size, rows touched) point: dense vs sparse, fresh
+    stores so optimizer state never leaks across measurements."""
+    r = np.random.RandomState(0)
+    ids = np.sort(r.choice(rows, size=touched, replace=False)
+                  ).astype("int64")
+    g_rows = r.rand(touched, dim).astype("f")
+    g_dense = np.zeros((rows, dim), "f")
+    g_dense[ids] = g_rows
+    out = {}
+    for kind in ("dense", "sparse"):
+        kv = mx.kv.create("dist_async")
+        try:
+            kv.init("emb", mx.nd.zeros((rows, dim)))
+            kv.set_optimizer(mx.optimizer.create(
+                optimizer, learning_rate=0.1, rescale_grad=1.0))
+            tgt = mx.nd.zeros((rows, dim))
+            if kind == "dense":
+                fn = lambda: kv.push_pull("emb", g_dense, out=tgt)  # noqa: E731
+            else:
+                fn = lambda: kv.sparse_push_pull(                   # noqa: E731
+                    "emb", ids, g_rows, out=tgt)
+            fn()                       # warmup (plan + state slots)
+            bytes_step, steps_s = _step_stats(kv, fn, steps)
+            out[kind] = {"bytes_per_step": round(bytes_step, 1),
+                         "steps_per_s": round(steps_s, 2)}
+        finally:
+            kv.close()
+    out["bytes_ratio"] = round(
+        out["sparse"]["bytes_per_step"]
+        / max(1.0, out["dense"]["bytes_per_step"]), 5)
+    out["touch_fraction"] = round(touched / rows, 5)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--no-write", action="store_true",
+                    help="do not mirror the line to "
+                         "docs/embedding_bench.json")
+    args = ap.parse_args()
+
+    tiny = bool(os.environ.get("MXTPU_BENCH_TINY"))
+    steps = 4 if tiny else args.steps
+    if tiny:
+        sweep = [(1000, 16, 10)]
+    else:
+        # table rows x dim x rows-touched-per-step: 1% and 10% touch
+        # at two table sizes (the 1%-touch row is the CI contract)
+        sweep = [(10000, 32, 100), (10000, 32, 1000),
+                 (100000, 16, 1000), (100000, 16, 10000)]
+
+    points = []
+    for rows, dim, touched in sweep:
+        pt = run_point(rows, dim, touched, steps, args.optimizer)
+        pt.update(rows=rows, dim=dim, touched=touched)
+        points.append(pt)
+
+    result = {"bench": "embedding_sparse_wire",
+              "optimizer": args.optimizer,
+              "steps": steps,
+              "transport": "tcp",
+              "points": points}
+    line = json.dumps(result)
+    print(line)
+    if not args.no_write:
+        with open(os.path.join(ROOT, "docs", "embedding_bench.json"),
+                  "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ EXAMPLES = [
     ("model-parallel/tp_mlp.py", {"DEVICES": 8}),
     ("recommenders/matrix_fact.py", {}),
     ("sparse/linear_classification.py", {}),
+    ("dlrm_click/dlrm_click.py", {}),
     ("autoencoder/mnist_sae.py", {}),
     ("adversary/fgsm_mnist.py", {}),
     ("svm_mnist/svm_mnist.py", {}),
