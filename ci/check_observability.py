@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Observability overhead + structure contract (ISSUE 14).
+
+Telemetry is only trustworthy if it is FREE enough to leave on, and
+only useful if it is actually collected. Both halves are pinned here,
+in the style of ``check_module_perf.py`` (structure where structure
+can pin it, interleaved best-of wall-clock only where the contract IS
+a cost bound):
+
+1. **Zero retraces, zero training-thread host syncs** — a steady-state
+   loopback dist ``Module.fit`` epoch with telemetry + sampled tracing
+   ON (``MXTPU_TRACE_SAMPLE=0.5``) runs under
+   ``jax.transfer_guard_device_to_host("disallow")`` and adds ZERO
+   program-cache misses: spans/counters are wall-clock-only metadata
+   and can never add a device sync or a recompile.
+2. **Collection really happened** — the sampled run recorded
+   ``module.step`` + wire spans stitched by one trace id per sampled
+   step, the per-process dump + merge produces a chrome-trace JSON,
+   the ``metrics`` wire op answers on the loopback server with the
+   ``kv.server`` view aboard, and an aggregator sweep renders a
+   non-gap fleet row.
+3. **Bounded cardinality** — no registry metric family exceeds
+   ``MXTPU_METRICS_MAX_SERIES`` and the snapshot reports zero
+   overflowed series for this workload.
+4. **<= 3% hot-path overhead** — the plane's per-step ADDED work
+   (sampler tick + counter/histogram bumps every step; start_trace +
+   two spans + flow pairs on every sampled step at
+   ``MXTPU_TRACE_SAMPLE=0.1``) is measured in isolated best-of tight
+   loops — stable to ~ns where an end-to-end A/B drowns in this
+   host's +-5% epoch jitter — and must be at most ``--max-overhead``
+   (default 3%) of the measured fused dist loopback step time. The
+   bench step is ~0.7 ms, orders of magnitude below a real training
+   step, so the bound is worst-case.
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_observability.py`` (wired
+into ``ci/run_ci.sh`` fast).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_MODULE_FUSED"] = "1"
+os.environ["MXTPU_MODULE_FUSED_DIST"] = "1"
+os.environ["MXTPU_MODULE_DIST_MODE"] = "sync"
+os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
+_TRACE_DIR = tempfile.mkdtemp(prefix="mxtpu_obs_ci_")
+os.environ["MXTPU_TRACE_DIR"] = _TRACE_DIR
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+import numpy as np                                    # noqa: E402
+import jax                                            # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+from mxtpu import obs                                 # noqa: E402
+from mxtpu import profiler as prof                    # noqa: E402
+
+_BATCHES = 12
+# the CI sampling rate for the overhead contract: every 10th step
+# carries a full trace. The structural half samples at 0.5 so span
+# coverage is dense; the cost bound is pinned at the rate an operator
+# would actually leave on.
+_ON_RATE = "0.1"
+
+
+def _no_d2h():
+    guard = getattr(jax, "transfer_guard_device_to_host", None)
+    if guard is None:                                 # pragma: no cover
+        return contextlib.nullcontext()
+    return guard("disallow")
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _build_dist_module():
+    np.random.seed(0)
+    x = np.random.randn(128, 20).astype("float32")
+    y = np.random.randint(0, 4, 128).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=16,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    kv = mx.kv.create("dist_async")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    return mod, kv, list(it)
+
+
+def _epoch(mod, batches, n):
+    for i in range(n):
+        mod.forward_backward(batches[i % len(batches)])
+        mod.update()
+    mod._fused.flush()
+
+
+def structural():
+    failures = []
+    os.environ["MXTPU_TRACE_SAMPLE"] = "0.5"
+    mod, kv, batches = _build_dist_module()
+    if mod._fused is None or mod._fused.mode != "dist":
+        return ["fused dist path did not engage under telemetry "
+                "(mode=%r)" % (getattr(mod._fused, "mode", None),)]
+    spans_before = [e for e in prof.snapshot_events()
+                    if e.get("cat") == "trace"]
+
+    # warmup compiles, then the guarded steady state
+    _epoch(mod, batches, 2)
+    stats = mod._fused._group.stats
+    compiles_before = stats["compiles"]
+    try:
+        with _no_d2h():
+            _epoch(mod, batches, _BATCHES)
+    except Exception as e:
+        failures.append(
+            "telemetry/tracing added a training-thread device->host "
+            "transfer: %s: %s" % (type(e).__name__, str(e)[:200]))
+    if stats["compiles"] != compiles_before:
+        failures.append(
+            "telemetry/tracing retraced the steady state: %d new "
+            "compiles" % (stats["compiles"] - compiles_before))
+
+    # -- collection happened: spans stitched by trace id ---------------
+    spans = [e for e in prof.snapshot_events()
+             if e.get("cat") == "trace" and e.get("ph") == "X"]
+    spans = spans[len(spans_before):]
+    names = {e["name"] for e in spans}
+    for want in ("module.step", "kv.client.rpc"):
+        if want not in names:
+            failures.append("no %r span recorded (have %s)"
+                            % (want, sorted(names)))
+    by_trace = {}
+    for e in spans:
+        by_trace.setdefault(e["args"].get("trace"), set()).add(e["name"])
+    stitched = [t for t, ns in by_trace.items()
+                if "module.step" in ns and "kv.client.rpc" in ns]
+    if not stitched:
+        failures.append("no trace id stitches a module.step span to "
+                        "its wire spans")
+    path = obs.dump_process_trace()
+    if path is None:
+        failures.append("dump_process_trace wrote nothing")
+    else:
+        merged = obs.merge_traces(_TRACE_DIR,
+                                  out=os.path.join(_TRACE_DIR,
+                                                   "merged.json"))
+        if not any(e.get("ph") == "X" for e in merged):
+            failures.append("merged timeline holds no complete spans")
+
+    # -- the metrics op + one aggregator sweep -------------------------
+    addr = kv._own_server.address if kv._own_server is not None else None
+    if addr is None:
+        failures.append("loopback run has no in-process server")
+    else:
+        agg = obs.TelemetryAggregator(targets=[addr])
+        doc = agg.sweep()
+        snap = doc["fleet"].get(addr, {})
+        if snap.get("gap"):
+            failures.append("metrics poll of the loopback server "
+                            "gapped: %s" % snap.get("error"))
+        elif "kv.server" not in {k.split("#")[0]
+                                 for k in snap.get("views", {})}:
+            failures.append("kv.server view missing from the metrics "
+                            "reply")
+        agg.stop()
+
+    # -- bounded cardinality -------------------------------------------
+    snap = obs.REGISTRY.snapshot()
+    bound = obs.max_series()
+    if snap["overflowed_series"] != 0:
+        failures.append("registry overflowed %d series on a plain "
+                        "loopback fit" % snap["overflowed_series"])
+    for name, fam in snap["metrics"].items():
+        if len(fam["series"]) > bound:
+            failures.append("metric %s holds %d series > bound %d"
+                            % (name, len(fam["series"]), bound))
+    kv.close()
+    os.environ["MXTPU_TRACE_SAMPLE"] = "0"
+    return failures
+
+
+def _traced_step_cost_us(iters=4000, reps=5):
+    """Wall cost of EVERYTHING a traced step adds — start_trace, the
+    ``module.step`` span, one nested ``kv.client.rpc`` span (spans,
+    flow pairs, registry bumps included), end_trace — measured in a
+    tight loop, best-of. Isolated measurement is stable where an
+    end-to-end A/B on a shared 1-core host is not: noise is strictly
+    additive, so the fastest rep is the clean number."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            tok = obs.start_trace()
+            with obs.span("module.step", mode="dist"):
+                with obs.span("kv.client.rpc", op="pushpull"):
+                    pass
+            obs.end_trace(tok)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    prof.reset()          # the microbench's spans are not a timeline
+    return best * 1e6
+
+
+def _untraced_step_cost_us(iters=200000, reps=5):
+    """Wall cost the plane adds to a NON-sampled step: one sampler
+    tick + the note_step counter/histogram bumps."""
+    sampler = obs.Sampler()
+    hist = obs.histogram("module.step_ms").default()
+    ctr = obs.counter("module.steps").default()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            sampler.sample()
+            ctr.inc()
+            hist.observe(0.7)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e6
+
+
+def overhead(max_overhead, n_batches=300, reps=3):
+    """The <=3% contract, counter-style (the repo's perf checks pin
+    structure, not racing wall clocks — see check_comms_perf): the
+    plane's per-step added work is measured in ISOLATION (tight
+    best-of loops, stable to ~ns) and compared against the fused dist
+    loopback step time (fastest of a few epochs — noise on this host
+    is strictly additive). overhead = rate * traced_cost + untraced
+    cost, over the step time. An end-to-end A/B at these magnitudes
+    (~1us added vs ~700us steps) cannot be resolved above this host's
+    +-5% epoch jitter, which is itself the strongest evidence the
+    plane is cheap."""
+    os.environ["MXTPU_TELEMETRY"] = "1"
+    os.environ.setdefault("MXTPU_TELEMETRY_DIR", _TRACE_DIR)
+    os.environ["MXTPU_TRACE_SAMPLE"] = _ON_RATE
+    mod, kv, batches = _build_dist_module()
+    _epoch(mod, batches, 2)                    # compile + warm
+    best_sps = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _epoch(mod, batches, n_batches)
+        best_sps = max(best_sps,
+                       n_batches / (time.perf_counter() - t0))
+    os.environ["MXTPU_TRACE_SAMPLE"] = "0"
+    os.environ.pop("MXTPU_TELEMETRY", None)
+    kv.close()
+    step_us = 1e6 / best_sps
+    added_us = float(_ON_RATE) * _traced_step_cost_us() \
+        + _untraced_step_cost_us()
+    ratio = added_us / step_us
+    return step_us, added_us, ratio
+
+
+def main():
+    max_overhead = 0.03
+    for i, a in enumerate(sys.argv):
+        if a == "--max-overhead" and i + 1 < len(sys.argv):
+            max_overhead = float(sys.argv[i + 1])
+    failures = structural()
+    step_us, added_us, ratio = overhead(max_overhead)
+    if ratio > max_overhead:
+        failures.append(
+            "telemetry + sampled tracing add %.2fus to a %.0fus step "
+            "(%.2f%% > the %.0f%% contract)"
+            % (added_us, step_us, ratio * 100, max_overhead * 100))
+    if failures:
+        print("check_observability: FAIL")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("check_observability: OK (zero retraces, zero "
+          "training-thread host syncs, spans stitched + merged, "
+          "metrics op live, cardinality bounded, overhead "
+          "%.2fus/%.0fus step = %.2f%% <= %.0f%% at sample rate %s)"
+          % (added_us, step_us, ratio * 100, max_overhead * 100,
+             _ON_RATE))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -292,6 +292,7 @@ import jax.numpy as jnp
 
 from . import fault as _fault
 from . import ndarray as nd
+from . import obs as _obs
 from .kvstore import KVStore, _ctype_key_value, _key_int
 
 
@@ -439,9 +440,65 @@ def _wire_decode(grad):
 _NBUF = struct.Struct("<I")
 
 
+# the kv client comms instruments (ISSUE 14): every _CommStats field is
+# a registry series labeled by store/client instance, so the unified
+# metrics plane and the per-instance `kv.stats()` dict read the SAME
+# counters — the dict is now a view over the registry. Past the
+# cardinality bound, labels() hands back detached series: the local
+# dict stays exact, the registry stays bounded.
+_KVC_COUNTERS = {
+    "bytes_sent": _obs.counter(
+        "kv.client.bytes_sent", "wire bytes sent", ("inst",)),
+    "bytes_recv": _obs.counter(
+        "kv.client.bytes_recv", "wire bytes received", ("inst",)),
+    "frames_sent": _obs.counter(
+        "kv.client.frames_sent", "wire frames sent", ("inst",)),
+    "frames_recv": _obs.counter(
+        "kv.client.frames_recv", "wire frames received", ("inst",)),
+    "coalesced_frames": _obs.counter(
+        "kv.client.coalesced_frames", "multi-key frames sent",
+        ("inst",)),
+    "coalesced_subs": _obs.counter(
+        "kv.client.coalesced_subs", "sub-commands coalesced",
+        ("inst",)),
+    "retransmits": _obs.counter(
+        "kv.client.retransmits", "request replays after a failure",
+        ("inst",)),
+    "local_reqs": _obs.counter(
+        "kv.client.local_reqs", "same-process shortcut dispatches",
+        ("inst",)),
+    "map_reroutes": _obs.counter(
+        "kv.client.map_reroutes", "map_stale reroutes followed",
+        ("inst",)),
+    "sparse_frames": _obs.counter(
+        "kv.client.sparse_frames", "row-sparse wire frames",
+        ("inst",)),
+    "sparse_rows_sent": _obs.counter(
+        "kv.client.sparse_rows_sent", "row-sparse rows shipped",
+        ("inst",)),
+}
+_KVC_HWM = _obs.gauge("kv.client.inflight_hwm",
+                      "pipelined-window in-flight high-water mark",
+                      ("inst",))
+_KVC_RPC_MS = _obs.histogram(
+    "kv.client.rpc_ms", "client-observed request round-trip latency",
+    ("op",))
+_KVC_INST = itertools.count(1)
+
+# server-side instruments: the applied-push rate is the fleet's
+# steps/s proxy per shard (mxtop's PS rows); everything else on the
+# server rides the "kv.server" view registered at start()
+_KVS_PUSHES = _obs.counter(
+    "kv.server.pushes", "updates applied by this server", ("inst",))
+_KVS_INST = itertools.count(1)
+
+
 class _CommStats:
     """Worker-side comms counters behind ``kv.stats()``. Cheap enough to
-    run unconditionally: one lock bump per frame, never per byte."""
+    run unconditionally: one lock bump per frame, never per byte —
+    each field IS a registry series (label ``inst=<n>``), so the same
+    numbers surface in ``obs.REGISTRY.snapshot()`` / the ``metrics``
+    wire op without a second bookkeeping path."""
 
     _FIELDS = ("bytes_sent", "bytes_recv", "frames_sent", "frames_recv",
                "coalesced_frames", "coalesced_subs", "retransmits",
@@ -449,21 +506,28 @@ class _CommStats:
                "sparse_frames", "sparse_rows_sent")
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._v = dict.fromkeys(self._FIELDS, 0)
+        inst = "c%d" % next(_KVC_INST)
+        self._c = {f: m.labels(inst) for f, m in _KVC_COUNTERS.items()}
+        self._hwm = _KVC_HWM.labels(inst)
 
     def add(self, field, n=1):
-        with self._lock:
-            self._v[field] += n
+        self._c[field].inc(n)
 
     def hwm(self, inflight):
-        with self._lock:
-            if inflight > self._v["inflight_hwm"]:
-                self._v["inflight_hwm"] = inflight
+        self._hwm.set_max(inflight)
 
     def snapshot(self):
-        with self._lock:
-            return dict(self._v)
+        out = {f: s.value for f, s in self._c.items()}
+        out["inflight_hwm"] = self._hwm.value
+        return out
+
+    def release(self):
+        """Give the registry series back (store/client close): the
+        local dict keeps working, the fleet snapshot forgets this
+        instance."""
+        for s in self._c.values():
+            s.drop()
+        self._hwm.drop()
 
 
 def _sendmsg_all(sock, views):
@@ -602,12 +666,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 if not hmac.compare_digest(got, expected):
                     return
             while True:
-                # every frame is (correlation id, command): requests of
-                # one connection pipeline — the worker streams the next
-                # frames while this one is being applied — and replies
-                # pair back to their waiters by cid. Apply order stays
-                # the arrival order (this loop is serial per conn).
-                cid, msg = _recv_frame(self.request)
+                # every frame is (correlation id, command[, trace ctx]):
+                # requests of one connection pipeline — the worker
+                # streams the next frames while this one is being
+                # applied — and replies pair back to their waiters by
+                # cid. Apply order stays the arrival order (this loop
+                # is serial per conn). The optional third element is
+                # pure observability metadata (a sampled trace id, see
+                # mxtpu/obs/trace.py): it never changes the reply.
+                frame = _recv_frame(self.request)
+                cid, msg = frame[0], frame[1]
+                tctx = frame[2] if len(frame) > 2 else None
                 op = msg[0]
                 key = msg[1] if len(msg) > 1 and \
                     isinstance(msg[1], (str, int)) else None
@@ -617,7 +686,15 @@ class _Handler(socketserver.BaseRequestHandler):
                 # AFTER it was applied (replay must dedupe)
                 _fault.fire("server.recv", op=op, key=key,
                             sock=self.request, server=server)
-                reply = server._dispatch(msg)
+                if tctx is None:
+                    reply = server._dispatch(msg)
+                else:
+                    # continue the caller's trace: the apply span is
+                    # what the merged timeline subtracts from the
+                    # client rpc span to show wire + queue time
+                    with _obs.adopt(tctx), \
+                            _obs.span("kv.server.apply", op=op):
+                        reply = server._dispatch(msg)
                 _fault.fire("server.send", op=op, key=key,
                             sock=self.request, server=server)
                 _send_frame(self.request, (cid, reply))
@@ -901,6 +978,10 @@ class ParameterServer:
         self._thread = None
         self._active = set()       # live handler sockets, severed on stop
         self._active_lock = threading.Lock()
+        # observability (ISSUE 14): the applied-push series + the
+        # "kv.server" registry view behind the `metrics` wire op
+        self._m_pushes = _KVS_PUSHES.labels("s%d" % next(_KVS_INST))
+        self._view_key = None
         # -- snapshot-backed auto-resume --
         if snapshot_dir is None:
             snapshot_dir = os.environ.get("MXTPU_PS_SNAPSHOT_DIR") or None
@@ -956,6 +1037,8 @@ class ParameterServer:
             # server on a reused port re-registers, so the local path
             # resumes after auto-respawn exactly like a reconnect)
             _LOCAL_SERVERS[self.address] = self
+        if self._view_key is None:
+            self._view_key = _obs.view("kv.server", self.metrics_view)
         return self
 
     def stop(self):
@@ -966,6 +1049,10 @@ class ParameterServer:
         launcher's respawn path both rely on)."""
         self._tcp.dying = True
         self._probe_stop.set()
+        if self._view_key is not None:
+            _obs.REGISTRY.unview(self._view_key)
+            self._view_key = None
+        self._m_pushes.drop()
         with self._repl_guard:
             stream = self._repl
         if stream is not None and not stream.dead:
@@ -1534,6 +1621,7 @@ class ParameterServer:
                 self._stale_max = max(self._stale_max, stale)
                 self._stale_sum += stale
                 self._stale_n += 1
+                self._m_pushes.inc()
                 self._note_worker_push(origin, stale)
                 g = _wire_decode(grad)
                 store = self._table[key]
@@ -1643,6 +1731,7 @@ class ParameterServer:
                 self._stale_max = max(self._stale_max, stale)
                 self._stale_sum += stale
                 self._stale_n += 1
+                self._m_pushes.inc()
                 self._note_worker_push(origin, stale)
                 g = _wire_decode(rows)   # bf16 rows upcast; the fp32
                 #                          master-table contract holds
@@ -2156,6 +2245,14 @@ class ParameterServer:
                             return ("ok", "timeout")
                     self._barrier_cv.wait(timeout=wait)
             return ("ok",)
+        if cmd == "metrics":
+            # the telemetry surface (ISSUE 14): this process's whole
+            # registry snapshot — instruments plus views, the
+            # "kv.server" view included — in one round trip. Strictly
+            # passive (no key locks, no state mutated) and answered by
+            # backups too: a backup's telemetry must not require a
+            # promotion.
+            return ("ok", _obs.REGISTRY.snapshot())
         if cmd == "stats":
             avg = self._stale_sum / self._stale_n if self._stale_n else 0.0
             self._gc_workers()
@@ -2253,6 +2350,35 @@ class ParameterServer:
             threading.Thread(target=self.stop, daemon=True).start()
             return ("ok",)
         return ("err", "unknown command %r" % (cmd,))
+
+    def metrics_view(self):
+        """The scalar server-side counters as one registry view row —
+        what a fleet poller reads per shard without the heavyweight
+        per-key clocks/workers tables of the ``stats`` op. Lock-light:
+        plain attribute reads of monotone counters (a torn read is at
+        worst one tick stale, which telemetry tolerates by design)."""
+        with self._repl_guard:
+            repl_lag = self._repl.lag() if self._repl is not None \
+                and not self._repl.dead else None
+        with self._workers_lock:
+            n_workers = len(self._workers)
+        return {"addr": self.address, "role": self._role,
+                "pushes": self._stale_n, "dup_pushes": self._dup_n,
+                "sparse_pushes": self._sparse_pushes,
+                "keys": len(self._table), "workers": n_workers,
+                "staleness_max": self._stale_max,
+                "joins": self._joins, "leaves": self._leaves,
+                "splits": self._splits,
+                "keys_moved_out": self._keys_moved_out,
+                "keys_adopted": self._keys_adopted,
+                "map_version": self._map_version,
+                "barrier_timeouts": self._barrier_timeouts,
+                "barrier_recounts": self._barrier_recounts,
+                "promotions": self._promotions,
+                "repl_lag": repl_lag,
+                "catchup_complete": self._catchup_complete,
+                "published_version": self._pub_version,
+                "snapshots": self._snap_count}
 
     def _do_publish(self, msg):
         """("publish", version, meta, pin): snapshot the CURRENT table
@@ -2509,7 +2635,7 @@ _IDEMPOTENT = frozenset(
      "set_optimizer", "opt_states", "set_opt_states", "multi",
      "hello", "bye", "repl", "promote", "peer_info", "join_backup",
      "shard_map", "cursor_next", "cursor_done", "adopt_key", "split",
-     "publish", "weights", "weight_sub"))
+     "publish", "weights", "weight_sub", "metrics"))
 
 
 class _Pending:
@@ -2572,8 +2698,14 @@ class _Channel:
                               key=msg[1] if len(msg) > 1 else None,
                               sock=self._sock)
             if act != "drop":      # dropped frame: the peer never sees
+                # a sampled trace rides as a third frame element —
+                # metadata only, absent (classic 2-tuple) when no
+                # trace is active on this thread
+                tctx = _obs.wire_ctx()
+                frame = (p.cid, msg) if tctx is None \
+                    else (p.cid, msg, tctx)
                 with self._send_lock:   # it; the waiter's deadline fires
-                    _send_frame(self._sock, (p.cid, msg),
+                    _send_frame(self._sock, frame,
                                 stats=self._conn._stats)
         except BaseException as e:
             self.fail(e)
@@ -2660,6 +2792,7 @@ class _ServerConn:
             else float(request_timeout)
         self._retries = _RETRIES if retries is None else int(retries)
         self._window_n = max(1, _WINDOW if window is None else int(window))
+        self._own_stats = stats is None   # release our registry series
         self._stats = stats if stats is not None else _CommStats()
         self.state = "ok"
         self.failures = 0          # consecutive failures
@@ -2793,7 +2926,15 @@ class _ServerConn:
         """Send one command and return its reply, retrying idempotent
         commands through connection faults with bounded exponential
         backoff. ``timeout=`` overrides the per-call reply deadline
-        (heartbeats probe with a short one)."""
+        (heartbeats probe with a short one). A sampled trace on this
+        thread records the whole call (retries included) as a
+        ``kv.client.rpc`` span."""
+        if _obs.active_ctx() is None:
+            return self._request_impl(msg, kw)
+        with _obs.span("kv.client.rpc", op=msg[0], addr=self.addr):
+            return self._request_impl(msg, kw)
+
+    def _request_impl(self, msg, kw):
         timeout = kw.pop("timeout", None)
         retries = kw.pop("retries", None)
         assert not kw, kw
@@ -2801,6 +2942,7 @@ class _ServerConn:
         if retries is None:
             retries = self._retries if msg[0] in _IDEMPOTENT else 0
         last = None
+        t0 = time.perf_counter()
         for attempt in range(retries + 1):
             if attempt:
                 self._stats.add("retransmits")
@@ -2817,6 +2959,8 @@ class _ServerConn:
                 self._note_failure(e)
                 continue
             self._note_ok()
+            _KVC_RPC_MS.labels(msg[0]).observe(
+                (time.perf_counter() - t0) * 1e3)
             if reply[0] == "err":
                 raise RuntimeError("parameter server: %s" % reply[1])
             return reply
@@ -2914,6 +3058,8 @@ class _ServerConn:
         for ch in self._channels:
             if ch is not None:
                 ch.fail(ConnectionError("store closed"))
+        if self._own_stats:
+            self._stats.release()
 
 
 class _ReplicatedConn:
@@ -2937,6 +3083,7 @@ class _ReplicatedConn:
     def __init__(self, primary_addr, backup_addr=None, token=None,
                  stats=None, on_failover=None, connect_timeout=60.0):
         self._token = token
+        self._own_stats = stats is None
         self._stats = stats if stats is not None else _CommStats()
         self._on_failover = on_failover
         self._addrs = [primary_addr, backup_addr]
@@ -3118,6 +3265,8 @@ class _ReplicatedConn:
             conns = [c for c in self._conns if c is not None]
         for c in conns:
             c.close()
+        if self._own_stats:
+            self._stats.release()
 
 
 class AsyncDistKVStore(KVStore):
@@ -3208,6 +3357,13 @@ class AsyncDistKVStore(KVStore):
                 target=self._heartbeat_loop, args=(interval,),
                 daemon=True, name="mxtpu-ps-heartbeat")
             self._hb_thread.start()
+        # observability (ISSUE 14): with MXTPU_TELEMETRY=1 this worker
+        # exports its registry on its own metrics endpoint (servers
+        # answer `metrics` on their main port; workers need this), and
+        # the worker-side health scalars ride a registry view either
+        # way
+        _obs.ensure_exporter()
+        self._view_key = _obs.view("kv.worker", self._metrics_view)
         # announce this worker to every reachable server (best-effort:
         # a dead shard learns about us when the heartbeat re-registers)
         self._register_workers(self._conns)
@@ -4425,6 +4581,28 @@ class AsyncDistKVStore(KVStore):
                 "barrier_recounts": barrier_recounts,
                 "elastic": elastic}
 
+    def _metrics_view(self):
+        """Worker-side health scalars for the registry snapshot: the
+        pending-push backlog, degraded keys, failovers — plus every
+        ``add_stats_source`` extra (guard, fused-dist window), so the
+        one poll a controller makes sees worker defenses too."""
+        with self._pending_lock:
+            npend = sum(len(v) for v in self._pending.values())
+        with self._degraded_lock:
+            ndeg = len(self._degraded)
+        out = {"rank": self._rank, "origin": self._origin,
+               "pending_pushes": npend, "degraded_keys": ndeg,
+               "failovers": sum(getattr(c, "failovers", 0)
+                                for c in self._conns),
+               "servers_dead": sum(1 for c in self._conns
+                                   if c.state == "dead")}
+        for name, fn in list(self._extra_stats.items()):
+            try:
+                out[name] = fn()
+            except Exception:   # a dying source must not kill the poll
+                out[name] = None
+        return out
+
     def add_stats_source(self, name, fn):
         """Merge a caller-side counter source into ``stats()`` under
         ``name`` (TrainGuard publishes its skip/rollback counters this
@@ -4521,6 +4699,10 @@ class AsyncDistKVStore(KVStore):
                     pass
         for c in list(self._conns) + extra:
             c.close()
+        # give the registry series/view back: closed stores must not
+        # count against the cardinality bound forever
+        self._stats.release()
+        _obs.REGISTRY.unview(self._view_key)
         if self._own_server is not None:
             self._own_server.stop()
             self._own_server = None

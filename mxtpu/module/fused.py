@@ -103,6 +103,7 @@ import copy
 import logging
 import os
 import threading
+import time
 import warnings
 
 import numpy as _np
@@ -111,12 +112,24 @@ import jax.numpy as jnp
 
 from .. import fault as _fault
 from .. import ndarray as nd
+from .. import obs as _obs
 from .. import optimizer as opt_mod
 from ..dist_hooks import AsyncPushWindow, push_inflight
 from ..layout import auto_layout_enabled
 from ..model import _module_fused_enabled
 from ..ndarray import NDArray, _wrap
 from ..optimizer import state_to_tree
+
+# the training-side fleet instruments (ISSUE 14): attempted fused
+# steps, and the steady-state step wall time measured as the gap
+# between consecutive step() entries — the donated-buffer handoff
+# already serializes consecutive dispatches, so the gap IS the step
+# time in steady state with NO extra device sync (the same
+# no-extra-sync discipline as the guard's packed read).
+_M_STEPS = _obs.counter("module.steps", "fused train steps dispatched")
+_M_STEP_MS = _obs.histogram(
+    "module.step_ms",
+    "inter-step wall time of the fused train loop (steady state)")
 
 __all__ = ["ProgramCache", "FusedGroupState", "FusedModuleTrainer",
            "maybe_create", "attach_borrowed", "metric_readback_interval",
@@ -306,6 +319,12 @@ class FusedGroupState:
         self.warned_fallback = False
         self.stats = {"steps": 0, "compiles": 0, "cache_hits": 0,
                       "metric_drains": 0}
+        # observability (ISSUE 14): sampled step tracing + the group's
+        # registry view; inter-step timing state for module.step_ms
+        self.tracer = _obs.Sampler()
+        self.last_step_t = None
+        self._view_key = _obs.view("module.fused",
+                                   lambda: dict(self.stats))
         # mixed precision (MXTPU_AMP, module docstring): fixed for the
         # group's lifetime at maybe_create so every bucket and every
         # cached program agrees on the one policy
@@ -319,6 +338,16 @@ class FusedGroupState:
         self.kv = None
         self.dist_mode = None
         self.window = None
+
+    def note_step(self):
+        """Per-step instrumentation on the training thread: count the
+        attempt and observe the gap since the previous step (the
+        steady-state step wall time — no device sync involved)."""
+        now = time.perf_counter()
+        if self.last_step_t is not None:
+            _M_STEP_MS.observe((now - self.last_step_t) * 1e3)
+        self.last_step_t = now
+        _M_STEPS.inc()
 
     def set_amp(self, amp):
         """Engage the group's mixed-precision policy (maybe_create)."""
@@ -452,6 +481,12 @@ class FusedModuleTrainer:
         self._cache = ProgramCache()
         self._last_fused = False
         self._last_metric_applied = False
+        # sampled step tracing: the span opens at dispatch and — in
+        # the dist modes — stays open through finish_update so the
+        # wire spans nest under it (one timeline per sampled step)
+        self._trace_open = False
+        self._step_span = None
+        self._trace_tok = None
         # dist modes: this step's emitted gradients, awaiting update()
         self._pending_grads = None
         # dist_local: reusable zero buffer backing the pull targets
@@ -522,6 +557,7 @@ class FusedModuleTrainer:
         fs = self._group
         if fs.window is not None:
             fs.window.flush()
+        self._end_step_trace()
 
     # -- metric routing ----------------------------------------------------
     def note_eager_forward(self):
@@ -645,6 +681,8 @@ class FusedModuleTrainer:
         if self._mode != "local":
             return self._dist_step(data_batch, exec_group, exec_)
 
+        fs.note_step()
+        self._begin_step_trace()
         key = (self._shape_sig(data_batch.data),
                self._shape_sig(data_batch.label), fs.metric_key)
         metric_fn = fs.metric_fn if fs.metric_key is not None else None
@@ -707,7 +745,28 @@ class FusedModuleTrainer:
         fs.stats["steps"] += 1
         self._last_fused = True
         self._last_metric_applied = fs.metric_fn is not None
+        self._end_step_trace()
         return True
+
+    # -- sampled step tracing ----------------------------------------------
+    def _begin_step_trace(self):
+        """Open a sampled trace for this step (MXTPU_TRACE_SAMPLE);
+        no-op — one counter tick — when sampled out."""
+        self._end_step_trace()   # a step whose update never came
+        if not self._group.tracer.sample():
+            return
+        self._trace_tok = _obs.start_trace()
+        self._step_span = _obs.span("module.step", mode=self._mode)
+        self._step_span.__enter__()
+        self._trace_open = True
+
+    def _end_step_trace(self):
+        if not self._trace_open:
+            return
+        self._trace_open = False
+        self._step_span.__exit__(None, None, None)
+        self._step_span = None
+        _obs.end_trace(self._trace_tok)
 
     # -- the dist step -----------------------------------------------------
     def _dist_step(self, data_batch, exec_group, exec_):
@@ -715,6 +774,8 @@ class FusedModuleTrainer:
         runs forward+backward(+metric) and returns the gradients; they
         are stashed for :meth:`finish_update` (``Module.update()``)."""
         fs = self._group
+        fs.note_step()
+        self._begin_step_trace()
         key = ("grad", self._shape_sig(data_batch.data),
                self._shape_sig(data_batch.label), fs.metric_key)
         metric_fn = fs.metric_fn if fs.metric_key is not None else None
@@ -773,6 +834,14 @@ class FusedModuleTrainer:
         bounded-inflight window, so the next step's compute overlaps
         the wire and the device->host gradient read happens OFF the
         training thread (the zero-host-sync contract)."""
+        try:
+            return self._finish_update_impl()
+        finally:
+            # the sampled step's span closes HERE, after the wire work
+            # it owns (sync mode: push+pull nested inside it)
+            self._end_step_trace()
+
+    def _finish_update_impl(self):
         grads = self._pending_grads
         self._pending_grads = None
         if self._mode == "local" or grads is None:

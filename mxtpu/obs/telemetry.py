@@ -1,0 +1,389 @@
+"""The telemetry surface: export, poll, merge (ISSUE 14).
+
+Three pieces close the loop from "every process has a registry" to
+"one place shows the fleet":
+
+* :class:`TelemetryExporter` — a tiny wire endpoint for processes that
+  are not already servers (workers). It reuses the kvstore transport
+  verbatim (``_TCPServer`` + ``_Handler``: zero-copy pickle-5 frames,
+  ``MXTPU_PS_TOKEN`` raw-preamble auth, the ``server.recv``/
+  ``server.send`` fault points) and answers exactly three ops:
+  ``metrics`` (the registry snapshot), ``ping``, ``stop``.
+  ParameterServer and ModelServer answer ``metrics`` on their main
+  ports — the exporter only exists for processes without one.
+  :func:`ensure_exporter` starts it when ``MXTPU_TELEMETRY=1`` and
+  writes its address to ``MXTPU_TELEMETRY_DIR/endpoints/`` so the
+  aggregator discovers workers without port plumbing.
+* :class:`TelemetryAggregator` — polls every target's ``metrics`` op
+  on an interval, merges the replies into ONE JSON snapshot
+  (``fleet.json``: per-address registry snapshot or a GAP record — a
+  dead shard's telemetry gap is reported, never fatal) plus a bounded
+  ring of history ticks rates are computed from.
+* ``python -m mxtpu.obs.telemetry`` — the aggregator as a process,
+  spawned by ``tools/launch.py --telemetry``; ``tools/mxtop.py``
+  renders its output live.
+
+Observability is strictly passive: a ``metrics`` poll takes no key
+locks, mutates no state beyond its own counters, and a dropped/severed
+poll loses one tick of telemetry and nothing else (the fault-matrix
+rows pin this).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+
+__all__ = ["TelemetryExporter", "TelemetryAggregator",
+           "ensure_exporter", "telemetry_enabled", "telemetry_dir",
+           "poll_interval", "history_len"]
+
+
+def telemetry_enabled():
+    """MXTPU_TELEMETRY: 1 starts a metrics exporter in every process
+    that creates a kvstore (workers); servers/replicas always answer
+    ``metrics`` on their main port regardless."""
+    return os.environ.get("MXTPU_TELEMETRY", "0") != "0"
+
+
+def telemetry_dir():
+    """MXTPU_TELEMETRY_DIR: the launch's telemetry rendezvous — worker
+    exporters drop endpoint files under ``endpoints/``, the aggregator
+    writes ``fleet.json`` there."""
+    return os.environ.get("MXTPU_TELEMETRY_DIR") or None
+
+
+def poll_interval():
+    """MXTPU_TELEMETRY_INTERVAL: seconds between aggregator sweeps
+    (default 1.0)."""
+    try:
+        return float(os.environ.get("MXTPU_TELEMETRY_INTERVAL", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def history_len():
+    """MXTPU_TELEMETRY_HISTORY: ring-buffer length of per-sweep
+    history ticks kept in fleet.json (default 64) — what rate columns
+    (steps/s, req/s) are computed from."""
+    try:
+        return max(2, int(os.environ.get("MXTPU_TELEMETRY_HISTORY",
+                                         "64")))
+    except ValueError:
+        return 64
+
+
+_polls = _metrics.counter("telemetry.polls",
+                          "aggregator target polls attempted")
+_gaps = _metrics.counter("telemetry.gaps",
+                         "aggregator polls answered by nobody "
+                         "(dead/unreachable target)")
+
+
+class TelemetryExporter:
+    """The worker-side metrics endpoint: kvstore transport, three ops.
+
+    Implements the ``_Handler`` owner contract (``_token``, ``_active``
+    + lock, ``_dispatch``) so the kvstore handler serves it unchanged —
+    same framing, same auth, same fault points (a ``metrics``-op fault
+    rule lands here exactly like on a real server)."""
+
+    def __init__(self, port=0, host="127.0.0.1", token=None):
+        from .. import kvstore_async as _ka
+        self._ka = _ka
+        self._tcp = _ka._TCPServer((host, port), _ka._Handler)
+        self._tcp.owner = self
+        self._token = token if token is not None \
+            else os.environ.get("MXTPU_PS_TOKEN") or None
+        self._active = set()
+        self._active_lock = threading.Lock()
+        self._thread = None
+
+    @property
+    def address(self):
+        h, p = self._tcp.server_address
+        return "%s:%d" % (h, p)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True,
+            name="mxtpu-obs-exporter")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._tcp.dying = True
+        with self._active_lock:
+            active = list(self._active)
+        for s in active:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._tcp.shutdown()
+        self._tcp.server_close()
+
+    def _dispatch(self, msg):
+        cmd = msg[0]
+        if cmd == "metrics":
+            return ("ok", _metrics.REGISTRY.snapshot())
+        if cmd == "ping":
+            return ("ok", {"exporter": True})
+        if cmd == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return ("ok",)
+        return ("err", "unknown telemetry command %r" % (cmd,))
+
+    def announce(self, directory=None):
+        """Write this exporter's address under
+        ``<dir>/endpoints/<role>-<pid>.ep`` so the aggregator finds it;
+        atomic (tmp + rename) so a reader never sees a torn file."""
+        directory = telemetry_dir() if directory is None else directory
+        if directory is None:
+            return None
+        epd = os.path.join(directory, "endpoints")
+        os.makedirs(epd, exist_ok=True)
+        role = os.environ.get("DMLC_ROLE", "worker")
+        path = os.path.join(epd, "%s-%d.ep" % (role, os.getpid()))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.address)
+        os.replace(tmp, path)
+        return path
+
+
+_exporter = None
+_exporter_guard = threading.Lock()
+
+
+def ensure_exporter():
+    """Start (once) and announce this process's metrics exporter when
+    ``MXTPU_TELEMETRY=1``; returns it (or None when telemetry is
+    off). Called from kvstore construction, so every launch worker
+    exports without code changes."""
+    global _exporter
+    if not telemetry_enabled():
+        return None
+    with _exporter_guard:
+        if _exporter is None:
+            _exporter = TelemetryExporter().start()
+            _exporter.announce()
+        return _exporter
+
+
+class TelemetryAggregator:
+    """Polls the fleet's ``metrics`` ops into one merged snapshot +
+    bounded history.
+
+    ``targets`` are explicit ``host:port`` strings (PS shards, serving
+    replicas); ``endpoints_dir`` is scanned every sweep for worker
+    exporter files, so mid-run joiners appear without restart. A target
+    that does not answer contributes a GAP record
+    (``{"gap": True, "error": ...}``) and bumps ``gaps`` — a dead
+    shard's telemetry hole is visible, never fatal."""
+
+    def __init__(self, targets=(), endpoints_dir=None, out=None,
+                 interval=None, history=None, token=None,
+                 connect_timeout=2.0):
+        self._connect_timeout = float(connect_timeout)
+        self._targets = list(targets)
+        self._epd = endpoints_dir
+        self._out = out
+        self._interval = poll_interval() if interval is None \
+            else float(interval)
+        self._history_len = history_len() if history is None \
+            else max(2, int(history))
+        self._token = token if token is not None \
+            else os.environ.get("MXTPU_PS_TOKEN") or None
+        self._conns = {}           # addr -> _ServerConn
+        self._ep_files = {}        # endpoint-derived addr -> file
+        self._gap_streak = {}      # addr -> consecutive gapped sweeps
+        self._history = []         # bounded ring of compact ticks
+        self._stop = threading.Event()
+        self._thread = None
+        self.sweeps = 0
+        self.gaps = 0
+
+    def _discover(self):
+        addrs = list(self._targets)
+        self._ep_files = {}        # endpoint-derived addr -> file path
+        if self._epd and os.path.isdir(self._epd):
+            for fn in sorted(os.listdir(self._epd)):
+                if not fn.endswith(".ep"):
+                    continue
+                path = os.path.join(self._epd, fn)
+                try:
+                    with open(path) as f:
+                        addr = f.read().strip()
+                except OSError:
+                    continue
+                if addr and addr not in addrs:
+                    addrs.append(addr)
+                    self._ep_files[addr] = path
+        return addrs
+
+    def _note_gap_streak(self, addr, gapped):
+        """Prune a DEAD worker's endpoint file after 3 consecutive
+        gapped sweeps: exited workers must not slow every future sweep
+        by a connect timeout each (explicit ``targets`` — PS shards,
+        replicas — are never pruned: their gap rows ARE the signal)."""
+        if not gapped:
+            self._gap_streak.pop(addr, None)
+            return
+        n = self._gap_streak.get(addr, 0) + 1
+        self._gap_streak[addr] = n
+        path = self._ep_files.get(addr)
+        if n >= 3 and path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._gap_streak.pop(addr, None)
+
+    def _poll_one(self, addr):
+        from .. import kvstore_async as _ka
+        _polls.inc()
+        try:
+            conn = self._conns.get(addr)
+            if conn is None:
+                conn = _ka._ServerConn(
+                    addr, token=self._token, n_socks=1,
+                    connect_timeout=self._connect_timeout)
+                self._conns[addr] = conn
+            reply = conn.request("metrics", retries=0, timeout=5.0)
+            return reply[1]
+        except (ConnectionError, RuntimeError, OSError) as e:
+            # the gap record: dead shard, unreachable worker, or a
+            # server too old to speak `metrics` — reported, not fatal
+            conn = self._conns.pop(addr, None)
+            if conn is not None:
+                conn.close()
+            self.gaps += 1
+            _gaps.inc()
+            return {"gap": True,
+                    "error": "%s: %s" % (type(e).__name__, e)}
+
+    @staticmethod
+    def _tick_summary(fleet):
+        """The compact per-sweep record rates are computed from: per
+        address, the headline monotone counters."""
+        out = {}
+        for addr, snap in fleet.items():
+            if snap.get("gap"):
+                out[addr] = None
+                continue
+            m = snap.get("metrics", {})
+
+            def total(name):
+                fam = m.get(name)
+                if not fam:
+                    return 0
+                vals = fam["series"].values()
+                if fam["kind"] == "histogram":
+                    return sum(v["count"] for v in vals)
+                return sum(vals)
+
+            out[addr] = {
+                "steps": total("module.steps"),
+                "responses": total("serve.responses"),
+                "requests": total("serve.requests"),
+                "pushes": total("kv.server.pushes"),
+                "bytes_sent": total("kv.client.bytes_sent"),
+            }
+        return out
+
+    def sweep(self):
+        """One synchronous poll of every known target; returns (and
+        optionally writes) the merged document. Tests and ``mxtop
+        --once`` drive this directly — no wall clock enters the fault
+        matrix."""
+        fleet = {}
+        for addr in self._discover():
+            snap = self._poll_one(addr)
+            fleet[addr] = snap
+            self._note_gap_streak(addr, bool(snap.get("gap")))
+        now = time.time()
+        self._history.append({"time": now,
+                              "counters": self._tick_summary(fleet)})
+        del self._history[:-self._history_len]
+        self.sweeps += 1
+        doc = {"time": now, "sweeps": self.sweeps, "gaps": self.gaps,
+               "interval": self._interval,
+               "fleet": fleet, "history": list(self._history)}
+        if self._out:
+            os.makedirs(os.path.dirname(self._out) or ".",
+                        exist_ok=True)
+            tmp = self._out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, self._out)
+        return doc
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.sweep()
+            except Exception:   # one bad sweep must not end telemetry
+                self.gaps += 1
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="mxtpu-obs-agg")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()
+
+
+def _main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="mxtpu.obs.telemetry",
+        description="fleet telemetry aggregator (tools/launch.py "
+                    "--telemetry spawns this)")
+    ap.add_argument("--targets", default="",
+                    help="comma list of host:port metrics endpoints")
+    ap.add_argument("--dir", default=None,
+                    help="telemetry dir (default MXTPU_TELEMETRY_DIR): "
+                         "endpoints/ scanned, fleet.json written")
+    ap.add_argument("--interval", type=float, default=None)
+    ap.add_argument("--once", action="store_true",
+                    help="one sweep, print the merged JSON, exit")
+    a = ap.parse_args(argv)
+    d = a.dir or telemetry_dir()
+    targets = [t.strip() for t in a.targets.split(",") if t.strip()]
+    agg = TelemetryAggregator(
+        targets=targets,
+        endpoints_dir=os.path.join(d, "endpoints") if d else None,
+        out=os.path.join(d, "fleet.json") if d else None,
+        interval=a.interval)
+    if a.once:
+        print(json.dumps(agg.sweep(), default=str))
+        agg.stop()
+        return 0
+    agg.start()
+    try:
+        while True:
+            # the aggregator's whole lifecycle: poll until the launcher
+            # reaps it (SIGTERM) — a bounded wait per tick, forever
+            time.sleep(60)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        agg.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

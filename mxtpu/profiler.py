@@ -24,6 +24,7 @@ import time
 
 __all__ = ["set_config", "profiler_set_config", "set_state",
            "profiler_set_state", "pause", "resume", "dump", "dumps",
+           "snapshot_events", "reset",
            "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
 
 _lock = threading.Lock()
@@ -81,11 +82,13 @@ profiler_set_state = set_state
 
 
 def pause():
-    _state["paused"] = True
+    with _lock:
+        _state["paused"] = True
 
 
 def resume():
-    _state["paused"] = False
+    with _lock:
+        _state["paused"] = False
 
 
 def is_active():
@@ -95,6 +98,28 @@ def is_active():
 def _emit(ev):
     with _lock:
         _state["events"].append(ev)
+
+
+def _emit_many(evs):
+    """Append a batch of events under one lock acquire (the obs trace
+    spans land a span + its flow pair per call)."""
+    with _lock:
+        _state["events"].extend(evs)
+
+
+def snapshot_events():
+    """A consistent copy of the event list while collection keeps
+    running — the read every dumper (dump/dumps/the obs trace dump)
+    goes through, so none of them ever races a concurrent _emit."""
+    with _lock:
+        return list(_state["events"])
+
+
+def reset():
+    """Drop collected events (tests and long runs that already dumped);
+    collection state is untouched."""
+    with _lock:
+        _state["events"] = []
 
 
 def record_span(name, cat, t0_us, t1_us, args=None):
@@ -111,6 +136,7 @@ def dumps(reset=False):
         events = list(_state["events"])
         if reset:
             _state["events"] = []
+    events = [e for e in events if "dur" in e]
     agg = {}
     for e in events:
         k = e["name"]
@@ -125,13 +151,19 @@ def dumps(reset=False):
 
 def dump(finished=True, profile_process="worker"):
     """Write the chrome://tracing JSON file (reference DumpProfile,
-    src/profiler/profiler.cc:170)."""
+    src/profiler/profiler.cc:170). Snapshot-and-continue: the event
+    list is copied under the lock and collection keeps running — a
+    dump mid-run can never race (or clear) concurrent emits. The file
+    lands atomically (tmp + rename) so a reader polling it never sees
+    a torn JSON."""
     with _lock:
         events = list(_state["events"])
         fname = _state["filename"]
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}
-    with open(fname, "w") as f:
+    tmp = "%s.tmp.%d" % (fname, os.getpid())
+    with open(tmp, "w") as f:
         json.dump(payload, f)
+    os.replace(tmp, fname)
     return fname
 
 
@@ -196,18 +228,24 @@ class Counter:
         self.name = name
         self.domain = domain
         self._value = 0
+        self._vlock = threading.Lock()
 
     def set_value(self, value):
-        self._value = value
+        with self._vlock:
+            self._value = value
         if is_active():
             _emit({"name": self.name, "ph": "C", "ts": _now_us(),
                    "pid": _PID, "args": {"value": value}})
 
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        with self._vlock:
+            value = self._value + delta
+        self.set_value(value)
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        with self._vlock:
+            value = self._value - delta
+        self.set_value(value)
 
     def __iadd__(self, v):
         self.increment(v)

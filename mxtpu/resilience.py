@@ -151,6 +151,11 @@ class TrainGuard:
                    "rollbacks": 0, "restores": 0, "host_syncs": 0,
                    "elastic_signals": 0, "last_ckpt_step": None}
         self._elastic_cb = None
+        # observability (ISSUE 14): the guard's defense counters ride
+        # the unified metrics plane as a polled view, so one `metrics`
+        # poll of a worker shows skips/rollbacks next to comms evidence
+        from . import obs as _obs
+        _obs.view("worker.guard", self.stats)
         trainer.set_guard(True)
 
     # -- wiring ------------------------------------------------------------

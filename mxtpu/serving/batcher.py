@@ -35,14 +35,53 @@ engine locks (the mxlint ``lock-order`` pass checks the whole package).
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 
 import numpy as _np
 
 from .. import fault as _fault
+from .. import obs as _obs
 
 __all__ = ["DynamicBatcher", "Request"]
+
+# batcher instruments (ISSUE 14): every stats() field is a registry
+# series labeled by batcher instance — the dict API reads the series
+# back, the fleet plane polls the same numbers via the `metrics` op
+_SB_COUNTERS = {
+    "batches": _obs.counter(
+        "serve.batch.batches", "coalesced device dispatches", ("inst",)),
+    "batched_rows": _obs.counter(
+        "serve.batch.rows", "rows dispatched in batches", ("inst",)),
+    "batched_requests": _obs.counter(
+        "serve.batch.requests", "requests landed in batches", ("inst",)),
+    "shed_queue_full": _obs.counter(
+        "serve.batch.shed_queue_full", "submits shed at queue depth",
+        ("inst",)),
+    "expired": _obs.counter(
+        "serve.batch.expired", "requests expired at dequeue", ("inst",)),
+    "batch_faults": _obs.counter(
+        "serve.batch.faults", "batches lost to injected faults",
+        ("inst",)),
+}
+_SB_GAUGES = {
+    "max_batch_rows": _obs.gauge(
+        "serve.batch.max_rows", "largest batch dispatched (rows)",
+        ("inst",)),
+    "max_batch_requests": _obs.gauge(
+        "serve.batch.max_requests", "largest batch (requests)",
+        ("inst",)),
+    "queue_hwm": _obs.gauge(
+        "serve.batch.queue_hwm", "queue-depth high-water mark",
+        ("inst",)),
+}
+_SB_QUEUED = _obs.gauge("serve.batch.queued",
+                        "requests queued + in the current flush",
+                        ("inst",))
+_SB_FLUSH_MS = _obs.histogram(
+    "serve.batch.flush_ms", "engine dispatch wall time per batch")
+_SB_INST = itertools.count(1)
 
 # terminal verdicts a request reply opens with (the wire contract —
 # docs/serving.md "Verdicts"): "ok" carries outputs; "overloaded" /
@@ -62,14 +101,17 @@ class Request:
 
     __slots__ = ("rid", "arrays", "rows", "deadline", "enq_t",
                  "event", "reply", "wait_bound", "version", "_cbs",
-                 "_cb_lock")
+                 "_cb_lock", "tctx")
 
     def __init__(self, rid, arrays, rows, deadline, wait_bound=60.0,
-                 version=None):
+                 version=None, tctx=None):
         self.rid = rid
         self.arrays = arrays
         self.rows = rows
         self.deadline = deadline
+        # sampled trace context that rode the predict frame: pure
+        # observability metadata — the batch flush continues the trace
+        self.tctx = tctx
         # weight version resolved at ADMISSION (stable or canary):
         # batches never mix versions, so every request is answered by
         # one coherent store even while swaps stream in
@@ -125,10 +167,12 @@ class DynamicBatcher:
         self._queued_rows = 0
         self._inflight = 0             # requests in the current flush
         self._stopped = False
-        self._c = {"batches": 0, "batched_rows": 0, "batched_requests": 0,
-                   "shed_queue_full": 0, "expired": 0, "max_batch_rows": 0,
-                   "max_batch_requests": 0, "queue_hwm": 0,
-                   "batch_faults": 0}
+        # every counter IS a registry series (ISSUE 14): stats() reads
+        # the instruments back, so the dict and the fleet plane agree
+        inst = "b%d" % next(_SB_INST)
+        self._c = {f: m.labels(inst) for f, m in _SB_COUNTERS.items()}
+        self._g = {f: m.labels(inst) for f, m in _SB_GAUGES.items()}
+        self._queued_g = _SB_QUEUED.labels(inst)
         self._thread = threading.Thread(target=self._flush_loop,
                                         daemon=True,
                                         name="mxtpu-serve-batcher")
@@ -136,7 +180,7 @@ class DynamicBatcher:
 
     # -- admission ---------------------------------------------------------
     def submit(self, rid, arrays, rows, deadline, wait_bound=60.0,
-               version=None):
+               version=None, tctx=None):
         """Admit one request. Returns the parked :class:`Request`, or
         an ``("overloaded", info)`` verdict tuple when the queue is at
         depth — the caller relays it as the retriable shed reply."""
@@ -144,16 +188,17 @@ class DynamicBatcher:
             if self._stopped:
                 return ("draining", {"reason": "batcher stopped"})
             if len(self._queue) + self._inflight >= self._depth:
-                self._c["shed_queue_full"] += 1
+                self._c["shed_queue_full"].inc()
                 return ("overloaded",
                         {"queue_depth": self._depth,
                          "queued": len(self._queue) + self._inflight})
             req = Request(rid, arrays, rows, deadline,
-                          wait_bound=wait_bound, version=version)
+                          wait_bound=wait_bound, version=version,
+                          tctx=tctx)
             self._queue.append(req)
             self._queued_rows += rows
-            if len(self._queue) > self._c["queue_hwm"]:
-                self._c["queue_hwm"] = len(self._queue)
+            self._g["queue_hwm"].set_max(len(self._queue))
+            self._queued_g.set(len(self._queue) + self._inflight)
             self._cv.notify_all()
             return req
 
@@ -206,8 +251,7 @@ class DynamicBatcher:
             if batch is None:
                 return
             for req in expired:
-                with self._cv:
-                    self._c["expired"] += 1
+                self._c["expired"].inc()
                 req.resolve(("expired",
                              {"rid": req.rid,
                               "late_ms": round((time.monotonic()
@@ -217,6 +261,7 @@ class DynamicBatcher:
                 self._dispatch(batch)
             with self._cv:
                 self._inflight = 0
+                self._queued_g.set(len(self._queue))
                 self._cv.notify_all()
 
     def _dispatch(self, batch):
@@ -228,36 +273,43 @@ class DynamicBatcher:
             # an injected kill/sever mid-batch: this replica is going
             # down — the batch's clients see their connections die and
             # replay their request ids on the surviving replica
-            with self._cv:
-                self._c["batch_faults"] += 1
+            self._c["batch_faults"].inc()
             for req in batch:
                 req.resolve(("err", "replica failed mid-batch: %s" % e))
             return
         if act == "drop":
-            with self._cv:
-                self._c["batch_faults"] += 1
+            self._c["batch_faults"].inc()
             for req in batch:
                 req.resolve(("err", "batch dropped (injected)"))
             return
         arrays = [
             _np.concatenate([_np.asarray(r.arrays[i]) for r in batch])
             for i in range(len(self._engine.data_names))]
+        # the first traced request of the batch carries the span (a
+        # batch mixes traced and untraced requests freely)
+        tctx = next((r.tctx for r in batch if r.tctx is not None), None)
+        t0 = time.perf_counter()
         try:
-            outs, answered = self._engine.predict_versioned(
-                arrays, rows=rows, version=batch[0].version)
+            if tctx is None:
+                outs, answered = self._engine.predict_versioned(
+                    arrays, rows=rows, version=batch[0].version)
+            else:
+                with _obs.adopt(tctx), \
+                        _obs.span("serve.batch.dispatch", rows=rows,
+                                  requests=len(batch)):
+                    outs, answered = self._engine.predict_versioned(
+                        arrays, rows=rows, version=batch[0].version)
         except Exception as e:
             for req in batch:
                 req.resolve(("err", "predict failed: %s: %s"
                              % (type(e).__name__, e)))
             return
-        with self._cv:
-            self._c["batches"] += 1
-            self._c["batched_rows"] += rows
-            self._c["batched_requests"] += len(batch)
-            if rows > self._c["max_batch_rows"]:
-                self._c["max_batch_rows"] = rows
-            if len(batch) > self._c["max_batch_requests"]:
-                self._c["max_batch_requests"] = len(batch)
+        _SB_FLUSH_MS.observe((time.perf_counter() - t0) * 1e3)
+        self._c["batches"].inc()
+        self._c["batched_rows"].inc(rows)
+        self._c["batched_requests"].inc(len(batch))
+        self._g["max_batch_rows"].set_max(rows)
+        self._g["max_batch_requests"].set_max(len(batch))
         lo = 0
         for req in batch:
             hi = lo + req.rows
@@ -300,9 +352,19 @@ class DynamicBatcher:
         for req in pend:
             req.resolve(("err", "server stopped"))
         self._thread.join(timeout=5.0)
+        self.release_metrics()
 
     def stats(self):
+        out = {f: s.value for f, s in self._c.items()}
+        out.update({f: s.value for f, s in self._g.items()})
         with self._cv:
-            out = dict(self._c)
             out["queued"] = len(self._queue)
         return out
+
+    def release_metrics(self):
+        """Return the registry series (replaced/stopped batchers must
+        not hold cardinality slots); the local stats() keeps working
+        on the detached series."""
+        for s in list(self._c.values()) + list(self._g.values()):
+            s.drop()
+        self._queued_g.drop()

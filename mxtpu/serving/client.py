@@ -36,6 +36,7 @@ import uuid
 import numpy as _np
 
 from .. import kvstore_async as _ka
+from .. import obs as _obs
 
 __all__ = ["ServingClient", "Overloaded", "DeadlineExceeded"]
 
@@ -97,6 +98,10 @@ class ServingClient:
         self.signature = None
         self.model = None
         self.models = {}           # hosted menus learned at hello
+        # sampled request tracing (MXTPU_TRACE_SAMPLE): a sampled
+        # predict opens a trace whose context rides the wire frame —
+        # client request, server admit, batch dispatch, one timeline
+        self._tracer = _obs.Sampler()
 
     # -- replica plumbing --------------------------------------------------
     def _conn_for(self, addr, connect_timeout=None):
@@ -180,6 +185,16 @@ class ServingClient:
         """:meth:`predict` plus the reply's info dict — notably
         ``info["version"]``, the weight version that answered (what
         the rollout drills key their per-version evidence on)."""
+        if not self._tracer.sample():
+            return self._predict2_impl(arrays, budget_ms, model)
+        tok = _obs.start_trace()
+        try:
+            with _obs.span("serve.client.request"):
+                return self._predict2_impl(arrays, budget_ms, model)
+        finally:
+            _obs.end_trace(tok)
+
+    def _predict2_impl(self, arrays, budget_ms=None, model=None):
         if isinstance(arrays, _np.ndarray):
             arrays = (arrays,)
         arrays = tuple(_np.ascontiguousarray(a) for a in arrays)

@@ -36,6 +36,7 @@ Lifecycle contract (docs/serving.md):
 from __future__ import annotations
 
 import collections
+import itertools
 import logging
 import os
 import socket
@@ -45,7 +46,46 @@ import time
 
 from .. import fault as _fault
 from .. import kvstore_async as _ka
+from .. import obs as _obs
 from .batcher import DynamicBatcher
+
+# server-level instruments (ISSUE 14): every counter in the old `_c`
+# dict is a registry series labeled by server instance — stats() reads
+# the instruments back; the fleet plane polls them via `metrics`
+_SRV_COUNTERS = {
+    "requests": _obs.counter(
+        "serve.requests", "predict frames admitted or refused",
+        ("inst",)),
+    "responses": _obs.counter(
+        "serve.responses", "ok replies delivered", ("inst",)),
+    "shed_overloaded": _obs.counter(
+        "serve.shed_overloaded", "requests shed at queue depth",
+        ("inst",)),
+    "shed_draining": _obs.counter(
+        "serve.shed_draining", "requests refused while draining",
+        ("inst",)),
+    "expired": _obs.counter(
+        "serve.expired", "requests expired before dispatch", ("inst",)),
+    "dropped": _obs.counter(
+        "serve.dropped", "admissions lost to injected drops",
+        ("inst",)),
+    "dup_requests": _obs.counter(
+        "serve.dup_requests", "replayed request ids observed",
+        ("inst",)),
+    "errors": _obs.counter(
+        "serve.errors", "err verdicts returned", ("inst",)),
+    "swaps": _obs.counter(
+        "serve.swaps", "weight versions installed", ("inst",)),
+    "swaps_dropped": _obs.counter(
+        "serve.swaps_dropped", "weight records lost to injected drops",
+        ("inst",)),
+    "rollbacks": _obs.counter(
+        "serve.rollbacks", "bit-exact rollbacks executed", ("inst",)),
+}
+_SRV_REQUEST_MS = _obs.histogram(
+    "serve.request_ms",
+    "admission-to-reply latency of ok responses", ("model",))
+_SRV_INST = itertools.count(1)
 
 __all__ = ["ModelServer", "queue_depth", "batch_deadline_ms",
            "default_budget_ms"]
@@ -171,14 +211,23 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                 if not hmac.compare_digest(got, expected):
                     return
             while not dead.is_set():
-                cid, msg = _ka._recv_frame(sock)
+                frame = _ka._recv_frame(sock)
+                cid, msg = frame[0], frame[1]
+                # optional third element: a sampled trace context —
+                # pure metadata, dropping it can never change a reply
+                tctx = frame[2] if len(frame) > 2 else None
                 op = msg[0]
                 key = msg[1] if len(msg) > 1 and \
                     isinstance(msg[1], (str, int)) else None
                 _fault.fire("server.recv", op=op, key=key,
                             sock=sock, server=server)
                 if op == "predict":
-                    res = server._admit(msg)
+                    if tctx is None:
+                        res = server._admit(msg)
+                    else:
+                        with _obs.adopt(tctx), \
+                                _obs.span("serve.admit", rid=str(key)):
+                            res = server._admit(msg, tctx=tctx)
                     if res == _NO_REPLY:
                         continue
                     if isinstance(res, tuple):   # immediate verdict
@@ -253,10 +302,11 @@ class ModelServer:
                 use_orbax=False)
         self._draining = False
         self._c_lock = threading.Lock()
-        self._c = {"requests": 0, "responses": 0, "shed_overloaded": 0,
-                   "shed_draining": 0, "expired": 0, "dropped": 0,
-                   "dup_requests": 0, "errors": 0, "swaps": 0,
-                   "swaps_dropped": 0, "rollbacks": 0}
+        # registry-backed counters (stats() reads them back); the lock
+        # stays for the rid-dedupe window below
+        inst = "m%d" % next(_SRV_INST)
+        self._c = {f: m.labels(inst) for f, m in _SRV_COUNTERS.items()}
+        self._view_key = None
         # request-id dedupe window (observability, not correctness:
         # predict is pure, a replay recomputes the same bits) — bounded
         self._seen_rids = collections.OrderedDict()
@@ -314,7 +364,23 @@ class ModelServer:
         with _ka._LOCAL_GUARD:
             # same-process clients skip socket+pickle, same dispatch
             _ka._LOCAL_SERVERS[self.address] = self
+        if self._view_key is None:
+            self._view_key = _obs.view("serving.server",
+                                       self._metrics_view)
         return self
+
+    def _metrics_view(self):
+        """The replica's registry-view row: draining flag, per-model
+        engine/batcher/version evidence — what one `metrics` poll of a
+        replica shows a fleet monitor."""
+        models = {}
+        for entry in self._entries():
+            models[entry.name] = {
+                "engine": entry.engine.stats(),
+                "batcher": entry.batcher.stats(),
+                "by_version": entry.version_stats()}
+        return {"address": self.address, "draining": self._draining,
+                "queue_depth": self._depth, "models": models}
 
     def drain(self, timeout=30.0):
         """Graceful phase: refuse new work, flush admitted work."""
@@ -331,6 +397,7 @@ class ModelServer:
         exited), then the draining verdict stops."""
         for entry in self._entries():
             if entry.batcher._stopped:
+                entry.batcher.release_metrics()
                 entry.batcher = DynamicBatcher(
                     entry.engine, self._depth, self._deadline_ms,
                     server=self)
@@ -340,6 +407,11 @@ class ModelServer:
     def stop(self):
         self._draining = True
         self._tcp.dying = True
+        if self._view_key is not None:
+            _obs.REGISTRY.unview(self._view_key)
+            self._view_key = None
+        for s in self._c.values():
+            s.drop()
         for entry in self._entries():
             entry.batcher.stop()
         with _ka._LOCAL_GUARD:
@@ -375,25 +447,24 @@ class ModelServer:
             dup = rid in self._seen_rids
             if dup:
                 self._seen_rids.move_to_end(rid)
-                self._c["dup_requests"] += 1
             else:
                 self._seen_rids[rid] = True
                 while len(self._seen_rids) > self._seen_max:
                     self._seen_rids.popitem(last=False)
+        if dup:
+            self._c["dup_requests"].inc()
         return dup
 
     def _bump(self, field, n=1):
-        with self._c_lock:
-            self._c[field] += n
+        self._c[field].inc(n)
 
     def _account_reply(self, reply, entry=None, req=None, arrival=None):
-        with self._c_lock:
-            if reply[0] == "ok":
-                self._c["responses"] += 1
-            elif reply[0] == "expired":
-                self._c["expired"] += 1
-            else:
-                self._c["errors"] += 1
+        if reply[0] == "ok":
+            self._c["responses"].inc()
+        elif reply[0] == "expired":
+            self._c["expired"].inc()
+        else:
+            self._c["errors"].inc()
         if entry is None or req is None:
             return
         # per-(model, version) accounting — what the rollout verdict
@@ -403,13 +474,17 @@ class ModelServer:
                 isinstance(reply[2], dict) else req.version
             lat = None if arrival is None \
                 else (time.monotonic() - arrival) * 1e3
+            if lat is not None:
+                # the serve.request latency histogram: p50/p99 per
+                # model for mxtop / bench_serving / the controller
+                _SRV_REQUEST_MS.labels(entry.name).observe(lat)
             entry.note(v, "responses", lat_ms=lat)
         elif reply[0] == "expired":
             entry.note(req.version, "expired")
         else:
             entry.note(req.version, "errors")
 
-    def _admit(self, msg):
+    def _admit(self, msg, tctx=None):
         """Admission control for one ``("predict", rid, arrays,
         budget_ms[, model])`` frame. Returns an immediate verdict tuple
         (shed/draining/err), ``_NO_REPLY`` (injected drop), or the
@@ -419,7 +494,9 @@ class ModelServer:
         what the exactly-once accounting in the drills keys on. The
         request's weight version is resolved HERE (stable, or the
         canary split hashed on rid) so its whole batch answers from
-        one coherent store."""
+        one coherent store. ``tctx`` (a sampled trace that rode the
+        frame) parks with the request so the batch flush continues the
+        trace — metadata only, never consulted for the answer."""
         rid, arrays, budget_ms = msg[1], msg[2], msg[3]
         model = msg[4] if len(msg) > 4 else None
         arrival = time.monotonic()
@@ -455,7 +532,7 @@ class ModelServer:
             rid, arrays, rows, deadline,
             wait_bound=(budget / 1000.0 + self._deadline_ms / 1000.0
                         + _FLUSH_GRACE),
-            version=entry.engine.route_version(rid))
+            version=entry.engine.route_version(rid), tctx=tctx)
         if isinstance(req, tuple):          # shed verdict, not parked
             self._bump("shed_overloaded")
             return req
@@ -545,8 +622,7 @@ class ModelServer:
         return res.wait(res.wait_bound)
 
     def stats(self):
-        with self._c_lock:
-            counters = dict(self._c)
+        counters = {f: s.value for f, s in self._c.items()}
         models = {}
         for entry in self._entries():
             models[entry.name] = {
@@ -589,6 +665,11 @@ class ModelServer:
                                           for e in self._entries())})
         if cmd == "stats":
             return ("ok", self.stats())
+        if cmd == "metrics":
+            # the telemetry surface (ISSUE 14): this replica's whole
+            # registry snapshot — same transport/auth/verdict
+            # discipline as every other op, strictly passive
+            return ("ok", _obs.REGISTRY.snapshot())
         if cmd == "drain":
             # operator/drill hook: same two-phase path as SIGTERM
             self._draining = True

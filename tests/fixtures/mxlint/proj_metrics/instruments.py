@@ -1,0 +1,34 @@
+"""Metrics-drift fixture, code side.
+
+``fake.requests`` (counter) and ``fake.lat_ms`` (histogram) are
+documented: negatives. ``fake.view`` is a documented view
+registration: negative. ``fake.secret_total`` is registered but has
+no definition row in the corpus catalog: the positive. The plain
+attribute call with a non-metric-shaped literal (``get``) and the
+undotted name must not match at all.
+"""
+
+
+class _Reg:
+    def counter(self, name, help="", labels=()):
+        return name
+
+    def histogram(self, name, help="", labels=()):
+        return name
+
+    def view(self, name, fn):
+        return name
+
+
+REG = _Reg()
+
+_REQS = REG.counter("fake.requests", "documented counter", ("inst",))
+_LAT = REG.histogram(
+    "fake.lat_ms", "documented histogram wrapped over lines")
+_SECRET = REG.counter("fake.secret_total")   # EXPECT(metrics-drift)
+_VIEW = REG.view("fake.view", lambda: {})
+_NOT_A_METRIC = REG.counter("plainname")     # undotted: out of scope
+
+
+def poll(d):
+    return d.get("fake.requests")            # a read, not a registration

@@ -1,0 +1,109 @@
+"""Observability E2E driver (ISSUE 14): one worker of a launch with
+real PS shards and serving replicas, full trace sampling on.
+
+Runs a short fused-dist fit over the REAL wire (its pushpull frames
+carry trace ids into the PS process), fires a batch of traced serving
+predicts (their frames carry trace ids into the replica process),
+paces the traffic so every process's periodic trace autodump lands,
+then waits one aggregator interval so fleet.json holds this worker's
+exporter row too. The pytest side merges MXTPU_TRACE_DIR and asserts
+one timeline covering >= 3 processes stitched by trace id, and runs
+tools/mxtop.py --once over the telemetry dir.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np  # noqa: E402
+
+import mxtpu as mx  # noqa: E402
+from mxtpu import obs  # noqa: E402
+
+
+def main():
+    out_dir = os.environ["OBS_TEST_DIR"]
+    mx.random.seed(11)
+    np.random.seed(11)
+
+    # -- traced fused-dist training over the real wire ------------------
+    r = np.random.RandomState(3)
+    x = r.rand(96, 8).astype("f")
+    y = (r.rand(96) * 2).astype("f")
+    it = mx.io.NDArrayIter(x, y, batch_size=16,
+                           label_name="softmax_label")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    kv = mx.kv.create("dist_async")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is not None and mod._fused.mode == "dist", \
+        "fused dist must engage for the traced-step story"
+    # two paced passes ~2.5s apart so the PS's periodic trace autodump
+    # (2s tick, fired from its span path) flushes the full history
+    for _pass in range(2):
+        it.reset()
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+        mod._fused.flush()
+        time.sleep(2.2)
+
+    # -- traced serving predicts ----------------------------------------
+    from mxtpu.serving import ServingClient
+    cli = ServingClient()
+    cli.hello()
+    for _pass in range(2):
+        for i in range(6):
+            outs = cli.predict(np.random.rand(1, 6).astype("f"))
+            assert outs[0].shape[0] == 1
+        time.sleep(2.2)
+    cli.close()
+
+    obs.dump_process_trace()
+    snap = obs.REGISTRY.snapshot()
+    with open(os.path.join(out_dir, "worker_summary.json"), "w") as f:
+        json.dump({
+            "steps": snap["metrics"]["module.steps"]["series"].get(
+                "", 0),
+            "spans": snap["metrics"]["trace.spans"]["series"].get(
+                "", 0),
+            "views": sorted(k.split("#")[0] for k in snap["views"]),
+        }, f)
+    kv.close()
+    # capture a fleet snapshot WHILE this worker's exporter is alive:
+    # the aggregator's final sweeps (after we exit) legitimately show
+    # our row as a gap, so the live picture is grabbed mid-run
+    exp = obs.ensure_exporter()
+    telem_dir = os.environ.get("MXTPU_TELEMETRY_DIR")
+    fleet_path = os.path.join(telem_dir, "fleet.json")
+    deadline = time.time() + 30
+    captured = False
+    while time.time() < deadline and not captured:
+        try:
+            with open(fleet_path) as f:
+                doc = json.load(f)
+            live = {a for a, s in doc.get("fleet", {}).items()
+                    if isinstance(s, dict) and not s.get("gap")}
+            if exp is not None and exp.address in live \
+                    and len(live) >= 3:
+                with open(os.path.join(out_dir, "fleet_live.json"),
+                          "w") as f:
+                    json.dump(doc, f)
+                captured = True
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.2)
+    assert captured, "fleet.json never showed all 3 processes live"
+    print("OBS_WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -210,6 +210,16 @@ def test_serving_bench_contract():
         # every attempt has exactly one terminal outcome
         assert row["answered"] + row["shed"] + row["expired"] \
             + row["errors"] == row["attempts"]
+        # server-side latency histograms (ISSUE 14): per-level bucket
+        # deltas of serve.request_ms / serve.batch.flush_ms ride every
+        # offered-load point — the same registry numbers mxtop and the
+        # telemetry plane read
+        for kind in ("request", "batch"):
+            h = row["server_lat"][kind]
+            assert h["count"] >= row["answered"] or kind == "batch", h
+            if h["count"]:
+                assert h["p50_ms"] > 0, h
+                assert h["p99_ms"] >= h["p50_ms"], h
     # both transports always reported: local headline + tcp sub-object
     assert isinstance(payload["tcp"]["req_s"], (int, float))
     # the dynamic batcher actually batched, and steady state never

@@ -664,3 +664,111 @@ def test_online_rollout_closes_train_serve_loop(tmp_path):
                summary["compiles"].values()) >= 1, summary["compiles"]
     assert any(rec["swaps"] >= 1 for rec in
                summary["compiles"].values()), summary["compiles"]
+
+
+# ---------------------------------------------------------------------------
+# fleet observability (ISSUE 14): one merged chrome://tracing timeline
+# across worker + PS + serving replica, and a live mxtop fleet snapshot
+# ---------------------------------------------------------------------------
+
+def test_observability_merged_timeline_and_mxtop(tmp_path):
+    """Acceptance (ISSUE 14): a real ``tools/launch.py`` run — 1 worker,
+    1 PS shard, 1 serving replica — with ``--telemetry`` and full trace
+    sampling. The per-process trace dumps merge into ONE timeline
+    covering >= 3 processes whose wire/apply spans are stitched by
+    shared trace ids, and ``tools/mxtop.py --once`` renders a live
+    fleet snapshot (worker exporter + PS + replica rows) from the same
+    run's telemetry dir."""
+    import json
+    root = os.path.join(os.path.dirname(__file__), "..")
+    prefix = str(tmp_path / "served_model")
+    trace_dir = tmp_path / "traces"
+    telem_dir = tmp_path / "telemetry"
+    out_dir = tmp_path / "out"
+    for d in (trace_dir, telem_dir, out_dir):
+        d.mkdir()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", _SERVING_CKPT_SCRIPT, prefix, root],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0 and "CKPT_OK" in r.stdout, r.stderr[-2000:]
+
+    env["OBS_TEST_DIR"] = str(out_dir)
+    env["MXTPU_TRACE_SAMPLE"] = "1"
+    env["MXTPU_TRACE_DIR"] = str(trace_dir)
+    env["MXTPU_TELEMETRY_INTERVAL"] = "0.3"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "1", "-s", "1", "--serve", "1",
+         "--serve-model", prefix, "--serve-epoch", "0",
+         "--serve-data-shapes", "data=6", "--serve-buckets", "8",
+         "--telemetry", "--telemetry-dir", str(telem_dir),
+         "--port", str(_free_port()),
+         sys.executable + " " + os.path.join(root, "tests", "nightly",
+                                             "obs_worker.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        proc.communicate()
+        raise
+    assert proc.returncode == 0, out[-3000:]
+    assert "OBS_WORKER_OK" in out, out[-3000:]
+
+    # -- ONE merged timeline covering >= 3 processes --------------------
+    sys.path.insert(0, root)
+    from mxtpu.obs import merge_traces
+    merged = merge_traces(str(trace_dir),
+                          out=str(tmp_path / "merged.json"))
+    spans = [e for e in merged if e.get("ph") == "X"]
+    pids = {e["pid"] for e in spans}
+    assert len(pids) >= 3, \
+        "timeline covers %d processes, want >= 3 (files: %s)" % (
+            len(pids), os.listdir(trace_dir))
+    by_pid_names = {}
+    for e in spans:
+        by_pid_names.setdefault(e["pid"], set()).add(e["name"])
+    all_names = set().union(*by_pid_names.values())
+    # wire/queue/apply spans from every side of the fleet
+    assert "module.step" in all_names, all_names
+    assert "kv.client.rpc" in all_names, all_names
+    assert "kv.server.apply" in all_names, all_names
+    assert {"serve.admit", "serve.batch.dispatch"} <= all_names, \
+        all_names
+    # stitching: one trace id spans worker AND server processes
+    by_trace_pids = {}
+    for e in spans:
+        tid = e.get("args", {}).get("trace")
+        if tid:
+            by_trace_pids.setdefault(tid, set()).add(e["pid"])
+    cross = [t for t, ps in by_trace_pids.items() if len(ps) >= 2]
+    assert cross, "no trace id stitches spans across processes"
+    # process_name metadata + flow events survived the merge
+    assert any(e.get("ph") == "M" for e in merged)
+    assert any(e.get("ph") == "s" for e in merged)
+
+    # -- the live telemetry surface: fleet.json + mxtop -----------------
+    # the driver captured fleet.json WHILE its exporter was alive (the
+    # aggregator's post-exit sweeps legitimately gap the worker row)
+    fleet = json.load(open(out_dir / "fleet_live.json"))
+    rows = fleet["fleet"]
+    live = {a for a, s in rows.items()
+            if isinstance(s, dict) and not s.get("gap")}
+    assert len(live) >= 3, \
+        "fleet snapshot holds %d live rows, want ps + replica + " \
+        "worker exporter: %r" % (len(live), sorted(rows))
+    roles = {rows[a].get("role") for a in live}
+    assert {"server", "worker", "serving"} <= roles, roles
+    mx_out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "mxtop.py"),
+         "--dir", str(telem_dir), "--once"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert mx_out.returncode == 0, mx_out.stderr[-2000:]
+    for addr in sorted(rows)[:2]:
+        assert addr in mx_out.stdout, mx_out.stdout
+    assert "PROC" in mx_out.stdout and "P99MS" in mx_out.stdout

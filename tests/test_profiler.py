@@ -1,0 +1,166 @@
+"""Profiler hardening tests (ISSUE 14 satellite): the module predates
+this suite — pause/resume/dump/dumps had no dedicated coverage.
+
+Covers: chrome-trace JSON shape and atomic dump, dump-while-running
+snapshot-and-continue semantics, event ordering, pause/resume gating,
+dumps aggregation (+ reset), Counter/Marker emission, and thread
+safety of concurrent record_span vs dump.
+"""
+import json
+import os
+import threading
+
+import pytest
+
+from mxtpu import profiler as prof
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    prof.reset()
+    prof.set_state("stop")
+    yield
+    prof.reset()
+    prof.set_state("stop")
+
+
+def test_dump_chrome_trace_shape(tmp_path):
+    fname = str(tmp_path / "p.json")
+    prof.set_config(filename=fname)
+    prof.set_state("run")
+    with prof.Domain("d").new_task("work"):
+        pass
+    prof.record_span("explicit", "cat", 10.0, 20.0, {"k": "v"})
+    out = prof.dump()
+    assert out == fname and os.path.exists(fname)
+    doc = json.load(open(fname))
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert len(evs) == 2
+    for e in evs:
+        assert e["ph"] == "X" and e["dur"] > 0
+        assert {"name", "cat", "ts", "pid", "tid"} <= set(e)
+    byname = {e["name"]: e for e in evs}
+    assert byname["explicit"]["args"] == {"k": "v"}
+    assert byname["explicit"]["dur"] == 10.0
+    # no .tmp leftovers: the dump is atomic (tmp + rename)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+def test_dump_snapshots_and_continues(tmp_path):
+    """A dump mid-run must neither stop collection nor clear events —
+    and a later dump sees both old and new events."""
+    fname = str(tmp_path / "p.json")
+    prof.set_config(filename=fname)
+    prof.set_state("run")
+    prof.record_span("before", "c", 0.0, 1.0)
+    prof.dump()
+    assert len(json.load(open(fname))["traceEvents"]) == 1
+    prof.record_span("after", "c", 2.0, 3.0)   # still collecting
+    prof.dump()
+    names = [e["name"] for e in json.load(open(fname))["traceEvents"]]
+    assert names == ["before", "after"]        # insertion order kept
+
+
+def test_pause_resume_gate_collection():
+    prof.set_state("run")
+    with prof.Domain("d").new_task("kept"):
+        pass
+    prof.pause()
+    assert not prof.is_active()
+    with prof.Domain("d").new_task("dropped"):
+        pass
+    prof.resume()
+    with prof.Domain("d").new_task("kept2"):
+        pass
+    names = [e["name"] for e in prof.snapshot_events()]
+    assert names == ["kept", "kept2"]
+
+
+def test_stopped_profiler_records_nothing():
+    with prof.Domain("d").new_task("t"):
+        pass
+    c = prof.Domain("d").new_counter("c")
+    c.increment(5)
+    prof.Domain("d").new_marker("m").mark()
+    assert prof.snapshot_events() == []
+
+
+def test_dumps_aggregates_and_resets():
+    prof.set_state("run")
+    for i in range(3):
+        prof.record_span("op_a", "c", 0.0, 10.0)
+    prof.record_span("op_b", "c", 0.0, 50.0)
+    text = prof.dumps()
+    lines = [ln for ln in text.splitlines()[1:] if ln.strip()]
+    # sorted by total time descending: op_b (50) over op_a (30)
+    assert lines[0].startswith("op_b") and lines[1].startswith("op_a")
+    assert "3" in lines[1]                     # op_a call count
+    prof.dumps(reset=True)
+    assert prof.snapshot_events() == []
+
+
+def test_counter_and_marker_events():
+    prof.set_state("run")
+    c = prof.Domain("d").new_counter("queue", value=2)
+    c += 3
+    c -= 1
+    prof.Domain("d").new_marker("mark").mark(scope="thread")
+    evs = prof.snapshot_events()
+    counts = [e for e in evs if e["ph"] == "C"]
+    assert [e["args"]["value"] for e in counts] == [2, 5, 4]
+    marks = [e for e in evs if e["ph"] == "i"]
+    assert marks and marks[0]["s"] == "t"
+
+
+def test_concurrent_record_and_dump_race_free(tmp_path):
+    """The satellite's original complaint: dump() racing the event
+    list. N writer threads record while a reader dumps repeatedly —
+    every dump must parse as complete JSON and the final event count
+    must be exact."""
+    fname = str(tmp_path / "race.json")
+    prof.set_config(filename=fname)
+    prof.set_state("run")
+    n_threads, per = 8, 200
+    start = threading.Event()
+
+    def writer(k):
+        start.wait()
+        for i in range(per):
+            prof.record_span("t%d" % k, "c", float(i), float(i + 1))
+
+    def dumper():
+        start.wait()
+        for _ in range(30):
+            prof.dump()
+            json.load(open(fname))             # always complete JSON
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(n_threads)] + \
+        [threading.Thread(target=dumper)]
+    for t in threads:
+        t.start()
+    start.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(prof.snapshot_events()) == n_threads * per
+    prof.dump()
+    assert len(json.load(open(fname))["traceEvents"]) == \
+        n_threads * per
+
+
+def test_counter_thread_safe_increments():
+    prof.set_state("stop")                     # no event emission cost
+    c = prof.Domain("d").new_counter("n")
+    per = 2000
+
+    def bump():
+        for _ in range(per):
+            c.increment()
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert c._value == 8 * per

@@ -49,6 +49,70 @@ def _pct(samples, q):
     return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
 
 
+def _hist_counts(name):
+    """(bounds, summed bucket counts) of one registry histogram family
+    — the server-side latency instruments (ISSUE 14)."""
+    from mxtpu import obs
+    fam = obs.REGISTRY.snapshot()["metrics"].get(name)
+    if not fam or fam["kind"] != "histogram":
+        return None, None
+    counts = None
+    for s in fam["series"].values():
+        counts = list(s["buckets"]) if counts is None else \
+            [a + b for a, b in zip(counts, s["buckets"])]
+    from mxtpu.obs.metrics import DEFAULT_BUCKETS
+    return DEFAULT_BUCKETS, counts
+
+
+def _pct_from_buckets(bounds, counts, q):
+    """Quantile estimate from (possibly diffed) bucket counts —
+    linear inside the owning bucket, like Histogram.percentile."""
+    total = sum(counts)
+    if not total:
+        return None
+    target = q * total
+    seen = 0.0
+    for i, c in enumerate(counts):
+        if seen + c >= target and c:
+            lo = bounds[i - 1] if i else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1] * 2
+            return round(lo + (hi - lo) * (target - seen) / c, 3)
+        seen += c
+    return round(bounds[-1] * 2, 3)
+
+
+class _ServerLat:
+    """Per-level server-side latency deltas: snapshot the
+    ``serve.request_ms`` (admission→reply) and ``serve.batch.flush_ms``
+    (device dispatch) histograms around a sweep level, report p50/p99
+    of just that level's observations."""
+
+    _FAMS = ("serve.request_ms", "serve.batch.flush_ms")
+
+    def __init__(self):
+        self._before = {f: _hist_counts(f) for f in self._FAMS}
+
+    def delta(self):
+        out = {}
+        for fam, key in (("serve.request_ms", "request"),
+                         ("serve.batch.flush_ms", "batch")):
+            bounds, after = _hist_counts(fam)
+            b_bounds, before = self._before[fam]
+            if after is None:
+                out[key] = None
+                continue
+            if before is None:
+                diff = after
+            else:
+                diff = [a - b for a, b in zip(after, before)]
+            out[key] = {
+                "count": sum(diff),
+                "p50_ms": _pct_from_buckets(bounds, diff, 0.50),
+                "p99_ms": _pct_from_buckets(bounds, diff, 0.99),
+            }
+        return out
+
+
 def _make_checkpoint(tmpdir, in_dim, hidden, classes):
     """Save a tiny-MLP Module checkpoint the replicas would load in
     production — the bench exercises the real from_checkpoint path."""
@@ -70,8 +134,13 @@ def _make_checkpoint(tmpdir, in_dim, hidden, classes):
 
 def _run_level(addr, n_clients, iters, in_dim, budget_ms):
     """One closed-loop sweep level: n_clients threads, each its own
-    client/connection, iters predicts back to back."""
+    client/connection, iters predicts back to back. Client-side
+    latency percentiles come from the raw sample list; server-side
+    ``serve.request_ms`` / ``serve.batch.flush_ms`` percentiles come
+    from the registry histograms' per-level bucket deltas — the same
+    numbers a fleet poller (mxtop, the autoscaling controller) reads."""
     from mxtpu.serving import ServingClient, Overloaded, DeadlineExceeded
+    srv_lat = _ServerLat()
     lat, sheds, expired, errors = [], [0], [0], [0]
     lock = threading.Lock()
     start = threading.Event()
@@ -122,6 +191,9 @@ def _run_level(addr, n_clients, iters, in_dim, budget_ms):
         "shed_rate": round(sheds[0] / attempts, 4),
         "expired": expired[0],
         "errors": errors[0],
+        # server-side histograms (bucket-delta estimates): request =
+        # admission->reply, batch = device dispatch wall per flush
+        "server_lat": srv_lat.delta(),
     }
 
 

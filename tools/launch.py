@@ -130,6 +130,7 @@ def _spawn_serving_replica(idx, port, addrs, base_env, args):
     if args.serve_weight_poll is not None:
         env["MXTPU_SERVE_WEIGHT_POLL"] = str(args.serve_weight_poll)
     env.pop("DMLC_ROLE", None)     # not a parameter-server role process
+    env["MXTPU_OBS_ROLE"] = "serving"   # telemetry role label
     proc = subprocess.Popen(
         [sys.executable, "-m", "mxtpu.serving"], env=env)
     # pid + port on stdout: kill -9 failover drills parse this, exactly
@@ -223,6 +224,20 @@ def launch_local(args, command):
     ps_token = secrets.token_hex(16) if args.num_servers else None
     if ps_token:
         base_env["MXTPU_PS_TOKEN"] = ps_token
+    # --telemetry: one observability plane for the whole launch
+    # (docs/observability.md). Every child inherits MXTPU_TELEMETRY /
+    # MXTPU_TELEMETRY_DIR — workers start metrics exporters and drop
+    # endpoint files, servers/replicas answer `metrics` on their main
+    # ports — and ONE aggregator child polls the fleet into
+    # <dir>/fleet.json (+ history), which tools/mxtop.py renders live.
+    if args.telemetry:
+        if not args.telemetry_dir:
+            args.telemetry_dir = tempfile.mkdtemp(prefix="mxtpu_telem_")
+        base_env["MXTPU_TELEMETRY"] = "1"
+        base_env["MXTPU_TELEMETRY_DIR"] = args.telemetry_dir
+        print("telemetry: %s/fleet.json (mxtop: python tools/mxtop.py "
+              "--dir %s)" % (args.telemetry_dir, args.telemetry_dir),
+              flush=True)
     if args.ps_respawn and not args.ps_snapshot_dir:
         # a respawned server with no snapshot restores nothing and every
         # in-flight key 404s — auto-provision the state dir instead
@@ -284,6 +299,22 @@ def launch_local(args, command):
             server_ports.append(port)
             server_procs.append(_spawn_serving_replica(
                 i, port, serve_addrs, base_env, args))
+    # the aggregator child: polls every PS shard / backup / serving
+    # replica (workers join via their endpoint files) into fleet.json.
+    # Spawned AFTER the target lists exist, reaped with the servers.
+    if args.telemetry:
+        agg_env = dict(base_env, JAX_PLATFORMS="cpu")
+        agg_env.pop("DMLC_ROLE", None)
+        targets = ps_addrs + backup_addrs + serve_addrs
+        agg = subprocess.Popen(
+            [sys.executable, "-m", "mxtpu.obs.telemetry",
+             "--targets", ",".join(targets),
+             "--dir", args.telemetry_dir], env=agg_env)
+        server_slots.append(("telemetry", 0, "telemetry", None))
+        server_ports.append(0)
+        server_procs.append(agg)
+        print("telemetry aggregator pid=%d targets=%d"
+              % (agg.pid, len(targets)), flush=True)
     if args.worker_respawn and not args.worker_state_dir:
         # a respawned worker with no state dir restarts from step 0 and
         # double-trains its epoch — auto-provision one, like --ps-respawn
@@ -560,6 +591,9 @@ def launch_local(args, command):
                     if rc is None or rc == 0:
                         continue   # alive, or clean 'stop' exit
                     name, port, role, peer = server_slots[i]
+                    if role == "telemetry":
+                        continue   # observability is passive: a dead
+                        #            aggregator is a gap, not a respawn
                     if role != "serving" and (
                             not args.ps_respawn
                             or respawns[i] >= args.ps_max_respawns):
@@ -853,6 +887,18 @@ def main():
                    help="progress file written by the training script; "
                         "at_step= scale triggers fire when its integer "
                         "content reaches N")
+    p.add_argument("--telemetry", action="store_true",
+                   help="local launcher: export MXTPU_TELEMETRY to "
+                        "every child (workers start metrics "
+                        "exporters) and spawn ONE aggregator that "
+                        "polls the fleet's `metrics` ops into "
+                        "<telemetry-dir>/fleet.json + history; render "
+                        "it live with tools/mxtop.py "
+                        "(docs/observability.md)")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="telemetry rendezvous dir (endpoint files + "
+                        "fleet.json); auto-created under $TMPDIR when "
+                        "--telemetry is on")
     p.add_argument("--launcher",
                    choices=("local", "ssh", "mpi", "slurm", "sge"),
                    default="local")

@@ -8,6 +8,7 @@ from . import donation    # noqa: F401
 from . import envdrift    # noqa: F401
 from . import faultcov    # noqa: F401
 from . import locks       # noqa: F401
+from . import metricsdrift  # noqa: F401
 from . import resource    # noqa: F401
 from . import swallow     # noqa: F401
 from . import tracepurity  # noqa: F401

@@ -1,0 +1,123 @@
+"""metrics-drift pass: the metric catalog and the code must describe
+the same set (the ``env-drift`` pattern applied to the observability
+plane, ISSUE 14).
+
+A *registration site* is a call ``<recv>.counter("a.b.c", ...)`` /
+``.gauge`` / ``.histogram`` / ``.view`` whose first argument is a
+string literal shaped like a dotted metric name (``seg.seg[...]``,
+lowercase) — the only way instruments enter :mod:`mxtpu.obs.metrics`'
+registry. A *definition row* is a markdown table line in
+``docs/observability.md`` whose first cell carries the name in
+backticks. Two drift directions:
+
+* a metric registered in code with no definition row — finding at the
+  registration site (code-anchored, runs in every mode): an
+  undocumented metric is invisible to operators and to the
+  ROADMAP-3 controller's contract;
+* in closed/whole-tree runs, a definition row whose metric has no
+  registration site — finding anchored at the doc line: a stale
+  catalog row describes telemetry that no longer exists. Retired
+  metrics stay honest with a literal ``(removed)`` marker.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, LintPass, register
+
+_METHODS = ("counter", "gauge", "histogram", "view")
+_NAME = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+# a definition row: first table cell contains a backticked dotted name
+_DEF_ROW = re.compile(r"^\|[^|]*`[a-z0-9_]+(\.[a-z0-9_]+)+`")
+_CELL_NAME = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
+_REMOVED = re.compile(r"\(removed[):\s]", re.IGNORECASE)
+
+
+class _DocIndex:
+    def __init__(self, path, project):
+        self.path = path
+        try:
+            self.relpath = str(path.relative_to(project.root))
+        except ValueError:
+            self.relpath = str(path)
+        self.defined = {}        # metric -> first definition line
+        self.removed = set()
+        for lineno, line in enumerate(
+                path.read_text(encoding="utf-8",
+                               errors="replace").splitlines(), 1):
+            if not _DEF_ROW.match(line):
+                continue
+            first_cell = line.split("|")[1] if "|" in line else line
+            for m in _CELL_NAME.findall(first_cell):
+                self.defined.setdefault(m, lineno)
+                if _REMOVED.search(line):
+                    self.removed.add(m)
+
+
+def _reg_name(call):
+    """The literal metric name of a registration call, else None."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _METHODS:
+        return None
+    if not call.args:
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+            and _NAME.match(a.value):
+        return a.value
+    return None
+
+
+@register
+class MetricsDriftPass(LintPass):
+    name = "metrics-drift"
+    scope = "project"
+    description = ("metric registration sites vs docs/observability.md:"
+                   " undocumented metrics and documented-but-dead rows")
+
+    def run_project(self, project):
+        sites = {}               # name -> [(relpath, lineno)]
+        for relpath, module in sorted(project.modules.items()):
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _reg_name(node)
+                if name is not None:
+                    sites.setdefault(name, []).append(
+                        (relpath, node.lineno))
+        if not sites:
+            return []
+        doc_path = project.find_contract_file("docs",
+                                              "observability.md")
+        doc = _DocIndex(doc_path, project) if doc_path is not None \
+            else None
+        out = []
+        if doc is None:
+            return out
+        for name, where in sorted(sites.items()):
+            if name in doc.defined:
+                continue
+            for relpath, lineno in where:
+                out.append(project.modules[relpath].finding(
+                    _Line(lineno), self.name,
+                    "metric %s is registered here but has no "
+                    "definition row in %s" % (name, doc.relpath)))
+        if project.contract_is_closed(doc_path):
+            for name, lineno in sorted(doc.defined.items()):
+                if name in sites or name in doc.removed:
+                    continue
+                out.append(Finding(
+                    doc.relpath, lineno, 0, self.name,
+                    "metric %s is documented but nothing registers "
+                    "it — delete the row or mark it (removed)" % name,
+                    text="", func="<doc>"))
+        return out
+
+
+class _Line:
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.col_offset = 0

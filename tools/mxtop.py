@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""mxtop: live per-process fleet table from the telemetry plane.
+
+Reads the aggregator's merged snapshot (``fleet.json`` written by
+``python -m mxtpu.obs.telemetry``, spawned by ``tools/launch.py
+--telemetry``) — or polls targets directly with ``--targets`` — and
+renders one row per process:
+
+  PROC        ROLE      STEP/S  REQ/S  P50MS  P99MS  QUEUE  PEND  STRAG  FAILOV  OVFL
+
+* STEP/S / REQ/S come from the history ring's counter deltas
+  (``module.steps`` per worker, ``serve.responses`` per replica,
+  applied pushes per PS shard ride the PUSH/S column share);
+* P50/P99 read the ``serve.request_ms`` / ``kv.client.rpc_ms``
+  histograms;
+* QUEUE is the batcher's queued gauge, PEND the worker's buffered
+  pushes, STRAG/FAILOV/OVFL the straggler/failover/cardinality-
+  overflow counters;
+* a GAP row (dead shard, unreachable worker) prints as ``gap: <why>``
+  — reported, never fatal.
+
+``--once`` prints a single table (CI/tests); the default loop redraws
+every ``--interval`` seconds until ^C. CPU-only, stdlib-only.
+
+Run: python tools/mxtop.py --dir /tmp/mxtpu_telem_xxx [--once]
+     python tools/mxtop.py --targets 127.0.0.1:9328,127.0.0.1:9329 --once
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, ROOT)
+
+_COLS = ("PROC", "ROLE", "STEP/S", "REQ/S", "PUSH/S", "P50MS",
+         "P99MS", "QUEUE", "PEND", "STRAG", "FAILOV", "OVFL")
+_W = (22, 11, 8, 8, 8, 8, 8, 6, 6, 6, 7, 5)
+
+
+def _fam_total(snap, name, kind_value="value"):
+    fam = (snap.get("metrics") or {}).get(name)
+    if not fam:
+        return None
+    vals = list(fam["series"].values())
+    if not vals:
+        return 0
+    if fam["kind"] == "histogram":
+        return sum(v["count"] for v in vals)
+    return sum(vals)
+
+
+def _fam_pct(snap, name, key):
+    """Worst (max) pXX across a histogram family's series."""
+    fam = (snap.get("metrics") or {}).get(name)
+    if not fam:
+        return None
+    vals = [v.get(key) for v in fam["series"].values()
+            if isinstance(v, dict) and v.get(key) is not None]
+    return max(vals) if vals else None
+
+
+def _view(snap, prefix):
+    """First view row whose key starts with ``prefix``."""
+    for key, v in sorted((snap.get("views") or {}).items()):
+        if key.split("#")[0] == prefix and isinstance(v, dict):
+            return v
+    return None
+
+
+def _rate(history, addr, field, now_counters):
+    """counter delta / time delta between the oldest retained tick and
+    the newest, per address; None when no usable pair exists."""
+    pts = [(h["time"], (h["counters"] or {}).get(addr))
+           for h in history if (h.get("counters") or {}).get(addr)]
+    if len(pts) < 2:
+        return None
+    (t0, c0), (t1, c1) = pts[0], pts[-1]
+    if t1 <= t0:
+        return None
+    return max(0.0, (c1.get(field, 0) - c0.get(field, 0)) / (t1 - t0))
+
+
+def _fmt(v, width, prec=1):
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = "%.*f" % (prec, v)
+    else:
+        s = str(v)
+    return s.rjust(width)[:width]
+
+
+def render(doc):
+    """The fleet table as a string (separated from I/O for tests)."""
+    lines = []
+    head = " ".join(c.rjust(w)[:w] if i else c.ljust(w)[:w]
+                    for i, (c, w) in enumerate(zip(_COLS, _W)))
+    lines.append(head)
+    lines.append("-" * len(head))
+    history = doc.get("history") or []
+    for addr, snap in sorted((doc.get("fleet") or {}).items()):
+        if not isinstance(snap, dict) or snap.get("gap"):
+            err = (snap or {}).get("error", "no snapshot")
+            lines.append("%s gap: %s"
+                         % (addr.ljust(_W[0])[:_W[0]], str(err)[:60]))
+            continue
+        role = snap.get("role", "?")
+        kvs = _view(snap, "kv.server")
+        kvw = _view(snap, "kv.worker")
+        step_s = _rate(history, addr, "steps", None)
+        req_s = _rate(history, addr, "responses", None)
+        push_s = _rate(history, addr, "pushes", None)
+        p50 = _fam_pct(snap, "serve.request_ms", "p50")
+        p99 = _fam_pct(snap, "serve.request_ms", "p99")
+        if p50 is None:
+            p50 = _fam_pct(snap, "kv.client.rpc_ms", "p50")
+            p99 = _fam_pct(snap, "kv.client.rpc_ms", "p99")
+        queue = _fam_total(snap, "serve.batch.queued")
+        pend = kvw.get("pending_pushes") if kvw else None
+        strag = None
+        if kvs is not None:
+            role = "%s/%s" % ("ps", kvs.get("role", "?"))
+        failov = kvw.get("failovers") if kvw else \
+            (kvs.get("promotions") if kvs else None)
+        ovfl = snap.get("overflowed_series")
+        row = [addr, role, step_s, req_s, push_s, p50, p99, queue,
+               pend, strag, failov, ovfl]
+        out = []
+        for i, (v, w) in enumerate(zip(row, _W)):
+            if i == 0:
+                out.append(str(v).ljust(w)[:w])
+            elif i == 1:
+                out.append(str(v).rjust(w)[:w])
+            else:
+                out.append(_fmt(v, w))
+        lines.append(" ".join(out))
+    lines.append("")
+    lines.append("sweeps=%s gaps=%s at %s"
+                 % (doc.get("sweeps"), doc.get("gaps"),
+                    time.strftime("%H:%M:%S",
+                                  time.localtime(doc.get("time",
+                                                         time.time())))))
+    return "\n".join(lines)
+
+
+def _load(args, agg):
+    if agg is not None:
+        return agg.sweep()
+    path = os.path.join(args.dir, "fleet.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=None,
+                    help="telemetry dir holding fleet.json (the "
+                         "launch.py --telemetry rendezvous)")
+    ap.add_argument("--targets", default=None,
+                    help="poll these host:port metrics endpoints "
+                         "directly (no aggregator needed)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one table and exit")
+    args = ap.parse_args(argv)
+    if not args.dir and not args.targets:
+        args.dir = os.environ.get("MXTPU_TELEMETRY_DIR")
+    if not args.dir and not args.targets:
+        ap.error("need --dir (or MXTPU_TELEMETRY_DIR) or --targets")
+    agg = None
+    if args.targets:
+        from mxtpu.obs.telemetry import TelemetryAggregator
+        agg = TelemetryAggregator(
+            targets=[t.strip() for t in args.targets.split(",")
+                     if t.strip()],
+            endpoints_dir=os.path.join(args.dir, "endpoints")
+            if args.dir else None)
+    try:
+        while True:
+            try:
+                doc = _load(args, agg)
+            except (OSError, ValueError) as e:
+                doc = {"fleet": {}, "history": [],
+                       "gaps": "load failed: %s" % e}
+            out = render(doc)
+            if args.once:
+                print(out)
+                return 0
+            # live redraw: clear + home, then the table
+            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if agg is not None:
+            agg.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
