@@ -25,6 +25,7 @@ too. Docs: ``docs/static_analysis.md``.
 """
 from __future__ import annotations
 
+import os
 import pathlib
 import sys
 import time
@@ -37,20 +38,38 @@ from mxlint.cli import main as mxlint_main  # noqa: E402
 BASELINE = ROOT / "ci" / "mxlint_baseline.json"
 ARTIFACT = ROOT / "mxlint_findings.json"
 SARIF = ROOT / "mxlint_findings.sarif"
+LOCKMODEL = ROOT / "mxlint_lockmodel.json"
 
-# wall-clock bound for the full-tree run (seconds). The whole-program
-# rebase made every run parse ~170 files and build the project symbol
-# table; this pin is what keeps that honest as the tree grows.
-BUDGET_SECONDS = 15.0
+# wall-clock bound for the full-tree run (seconds). Re-pinned 15 -> 20
+# for ISSUE 15: the shared-state-race / blocking-under-lock passes
+# build per-statement locksets, the whole-program call-graph
+# reachability from every concurrency root, and the transitive
+# caller-context fixpoint on top of the v2 symbol table (~11s actual
+# on the CI host; the pin keeps the sanity tier honest as it grows).
+BUDGET_SECONDS = 20.0
 
 
 def main():
     t0 = time.monotonic()
-    rc = mxlint_main(["mxtpu", "tools",
-                      "--baseline", str(BASELINE),
-                      "--json", str(ARTIFACT),
-                      "--sarif", str(SARIF)])
+    args = ["mxtpu", "tools",
+            "--baseline", str(BASELINE),
+            "--json", str(ARTIFACT),
+            "--sarif", str(SARIF)]
+    # lock-witness mode: also export the static lock model (what the
+    # runtime witness watches) and surface the observation artifact
+    # beside the findings (ci/check_lock_witness.py drives the actual
+    # instrumented run; docs/static_analysis.md "The lock witness")
+    witness = os.environ.get("MXTPU_LOCK_WITNESS") == "1"
+    if witness:
+        args += ["--lock-model", str(LOCKMODEL)]
+    rc = mxlint_main(args)
     elapsed = time.monotonic() - t0
+    if witness:
+        print("lock model exported to %s"
+              % LOCKMODEL.relative_to(ROOT))
+        obs = os.environ.get("MXTPU_LOCK_WITNESS_OUT")
+        if obs and pathlib.Path(obs).exists():
+            print("lock-witness observations artifact: %s" % obs)
     if rc == 0:
         print("static analysis OK in %.1fs (artifacts: %s, %s)"
               % (elapsed, ARTIFACT.relative_to(ROOT),

@@ -37,6 +37,10 @@ case "$tier" in
     JAX_PLATFORMS=cpu python ci/check_serving.py
     JAX_PLATFORMS=cpu python ci/check_rollout.py
     JAX_PLATFORMS=cpu python ci/check_observability.py
+    # lock-witness smoke: re-run the kvstore-window/replication/batcher
+    # slice with the runtime witness armed; fails on any access the
+    # static lockset model calls guarded that the run saw unguarded
+    JAX_PLATFORMS=cpu python ci/check_lock_witness.py
     ;;
   nightly)
     JAX_PLATFORMS=cpu python -m pytest tests/ -q

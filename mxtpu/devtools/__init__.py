@@ -1,0 +1,3 @@
+"""Developer-facing runtime instrumentation (never imported by the
+fleet itself). Currently: the lock witness (``lockwitness.py``), the
+runtime cross-check of mxlint's static lockset model."""

@@ -1,0 +1,431 @@
+"""Runtime lock witness: cross-check mxlint's static lockset model
+against what actually happens in a live test run (ISSUE 15).
+
+Static locksets can lie in one direction that matters: the analyzer
+may conclude an attribute is *guarded* (every access site holds the
+owning lock) while the live program reaches it through a path the
+analysis mis-resolved — a false negative that surfaces as production
+corruption, not CI red. The witness closes that hole:
+
+* ``threading.Lock``/``threading.RLock`` are patched with wrappers
+  that record a per-thread held-lockset (each wrapper remembers its
+  CREATION SITE, which is how runtime locks match the static model's
+  ``self._lock = threading.Lock()`` declaration lines).
+* Every attribute the static model exports as guarded
+  (``mxlint --lock-model``, built by the ``shared-state-race`` pass's
+  machinery) is replaced with a recording descriptor on its class.
+* Each access runs an Eraser-style ownership state machine: an object
+  is EXCLUSIVE to its first accessing thread until a second thread
+  touches it, then SHARED. A shared access **with no witnessed lock
+  held**, made from fleet code (``mxtpu/``), is a **contradiction**:
+  the static model called this attribute guarded; the run proved it
+  is not. ``ci/check_lock_witness.py`` fails on any contradiction.
+* A shared access holding locks whose creation sites do not match the
+  model's declared guards is recorded as a ``mismatch`` — evidence
+  the model matched the wrong lock — reported in the artifact but not
+  fatal (creation-site matching is heuristic for factory locks).
+
+Enablement (all read here; rows in docs/env_vars.md):
+
+* ``MXTPU_LOCK_WITNESS=1``      — arm the witness (tests/conftest.py
+  installs it before mxtpu is imported, so every fleet lock is born
+  wrapped).
+* ``MXTPU_LOCK_WITNESS_MODEL``  — path to the static model JSON.
+* ``MXTPU_LOCK_WITNESS_OUT``    — observation artifact path, dumped
+  at exit (and via :func:`dump`).
+
+This module deliberately imports NOTHING from mxtpu at module level:
+the conftest loads it by file path and calls :func:`install` BEFORE
+the first ``import mxtpu``, otherwise module-import-time locks (the
+obs registry, program caches) would be born unwrapped and every
+access under them would look unguarded.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+
+__all__ = ["install", "uninstall", "installed", "watch", "observations",
+           "contradictions", "dump", "reset"]
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_tls = threading.local()          # .held: list of wrapper objects
+
+# ownership: id(obj) -> owning thread id, or _SHARED once a second
+# thread has touched it (plain dict + GIL-atomic ops; entries are
+# never pruned — witness runs are test-scale by design)
+_SHARED = "SHARED"
+_owner = {}
+
+_state_lock = _REAL_LOCK()        # guards the observation tables only
+_obs = {}                         # (cls, attr) -> counters dict
+_contradictions = []              # unguarded shared WRITES (fatal)
+_unguarded_reads = []             # unguarded shared reads (reported)
+_CONTRA_CAP = 200
+
+#: filter contradictions to accesses made from fleet code; unit tests
+#: flip this off to drive watched attrs directly
+caller_filter = True
+
+
+def _held():
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _creation_site():
+    """(relpath-ish, lineno) of the frame creating a lock, normalized
+    to match the static model's repo-relative declaration sites. Walks
+    OUT of stdlib synchronization wrappers: the RLock a
+    ``threading.Condition()`` builds internally must carry the site of
+    the ``self._cv = threading.Condition()`` line the static model
+    declared, not a line inside threading.py."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename.replace(os.sep, "/")
+        base = fn.rsplit("/", 1)[-1]
+        if base not in ("threading.py", "queue.py") and \
+                "/concurrent/futures/" not in fn:
+            break
+        f = f.f_back
+    if f is None:
+        return ("?", 0)
+    fn = f.f_code.co_filename.replace(os.sep, "/")
+    for root in ("mxtpu/", "tools/", "tests/"):
+        i = fn.rfind("/" + root)
+        if i >= 0:
+            fn = fn[i + 1:]
+            break
+    return (fn, f.f_lineno)
+
+
+class _WLock:
+    """threading.Lock stand-in that tracks the per-thread held set."""
+
+    __slots__ = ("_inner", "site")
+
+    def __init__(self, site=None):
+        self._inner = _REAL_LOCK()
+        self.site = site if site is not None else _creation_site()
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _held().append(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # stdlib (concurrent.futures, logging) re-inits module locks
+        # in forked children; held sets are per-thread and the child
+        # starts with fresh thread state anyway
+        self._inner._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<witness Lock %s:%d>" % self.site
+
+
+class _WRLock:
+    """threading.RLock stand-in; implements the Condition protocol
+    (``_release_save``/``_acquire_restore``/``_is_owned``) so a
+    ``Condition`` built on it keeps the held set truthful across
+    ``wait()`` — the park drops this lock from the held set, the
+    wake-up restores it."""
+
+    __slots__ = ("_inner", "site")
+
+    def __init__(self, site=None):
+        self._inner = _REAL_RLOCK()
+        self.site = site if site is not None else _creation_site()
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _held().append(self)
+        return got
+
+    __enter__ = acquire
+
+    def release(self):
+        self._inner.release()
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- Condition protocol -------------------------------------------------
+    def _release_save(self):
+        held = _held()
+        n = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                n += 1
+        return (self._inner._release_save(), n)
+
+    def _acquire_restore(self, state):
+        inner_state, n = state
+        self._inner._acquire_restore(inner_state)
+        _held().extend([self] * n)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+
+    def __repr__(self):
+        return "<witness RLock %s:%d>" % self.site
+
+
+class _WatchedAttr:
+    """Data descriptor recording every read/write of one modeled
+    attribute. Storage composes with what the class already had: a
+    ``__slots__`` member descriptor is delegated to; a plain attribute
+    keeps living in ``obj.__dict__[attr]`` (data descriptors win the
+    lookup, so pickling and ``__dict__`` access still compose)."""
+
+    __slots__ = ("cls_name", "attr", "guards", "_orig")
+
+    def __init__(self, cls_name, attr, guards, orig):
+        self.cls_name = cls_name
+        self.attr = attr
+        self.guards = guards          # set of (relpath, lineno)
+        self._orig = orig             # prior descriptor (slot) or None
+
+    # -- storage ------------------------------------------------------------
+    def _read(self, obj):
+        if self._orig is not None:
+            return self._orig.__get__(obj, type(obj))
+        try:
+            return obj.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(self.attr) from None
+
+    def _write(self, obj, value):
+        if self._orig is not None:
+            self._orig.__set__(obj, value)
+        else:
+            obj.__dict__[self.attr] = value
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        _observe(self, obj, "read")
+        return self._read(obj)
+
+    def __set__(self, obj, value):
+        _observe(self, obj, "write")
+        self._write(obj, value)
+
+
+def _observe(watched, obj, rw):
+    tid = threading.get_ident()
+    oid = id(obj)
+    own = _owner.get(oid)
+    if own is None:
+        _owner[oid] = tid
+        shared = False
+    elif own == tid:
+        shared = False
+    else:
+        _owner[oid] = _SHARED
+        shared = True
+    held = list(getattr(_tls, "held", ()))
+    key = (watched.cls_name, watched.attr)
+    with _state_lock:
+        rec = _obs.get(key)
+        if rec is None:
+            rec = _obs[key] = {"reads": 0, "writes": 0, "shared": 0,
+                               "guarded": 0, "mismatch": 0,
+                               "unguarded": 0}
+        rec["reads" if rw == "read" else "writes"] += 1
+        if not shared:
+            return
+        rec["shared"] += 1
+        if held:
+            sites = {w.site for w in held}
+            if sites & watched.guards:
+                rec["guarded"] += 1
+            else:
+                rec["mismatch"] += 1
+            return
+        rec["unguarded"] += 1
+    # shared + zero locks held. A WRITE is a contradiction: the static
+    # model called this attribute guarded, the run just proved its
+    # write discipline is not. An unlocked shared READ is recorded but
+    # NOT a contradiction — the static model itself exempts plain
+    # snapshot reads (GIL-atomic, the stats() idiom), and reads can
+    # reach a watched attribute through local-variable receivers the
+    # static analysis never modeled as sites.
+    caller = sys._getframe(2)
+    fn = caller.f_code.co_filename.replace(os.sep, "/")
+    if caller_filter and "/mxtpu/" not in fn:
+        return
+    entry = {"class": watched.cls_name, "attr": watched.attr,
+             "access": rw,
+             "thread": threading.current_thread().name,
+             "caller": "%s:%d" % (fn.rsplit("/mxtpu/", 1)[-1],
+                                  caller.f_lineno)}
+    with _state_lock:
+        if rw == "write":
+            if len(_contradictions) < _CONTRA_CAP:
+                _contradictions.append(entry)
+        elif len(_unguarded_reads) < _CONTRA_CAP:
+            _unguarded_reads.append(entry)
+
+
+# ---------------------------------------------------------------------------
+# install / model loading
+# ---------------------------------------------------------------------------
+
+def installed():
+    return getattr(threading, "_mxtpu_lock_witness", None) is not None
+
+
+def install(model_path=None):
+    """Arm the witness: patch the lock factories, then watch every
+    attribute the static model calls guarded. Idempotent; returns the
+    number of watched attributes. Call BEFORE the first
+    ``import mxtpu``."""
+    if installed():
+        return 0
+    threading.Lock = _WLock
+    threading.RLock = _WRLock
+    # the marker doubles as the handle other loads of this file (by
+    # path vs. as mxtpu.devtools.lockwitness) can detect
+    threading._mxtpu_lock_witness = _WLock
+    if model_path is None:
+        model_path = os.environ.get("MXTPU_LOCK_WITNESS_MODEL")
+    n = 0
+    if model_path and os.path.exists(model_path):
+        with open(model_path) as f:
+            model = json.load(f)
+        for entry in model.get("attrs", ()):
+            if _watch_model_entry(entry):
+                n += 1
+    out = os.environ.get("MXTPU_LOCK_WITNESS_OUT")
+    if out:
+        atexit.register(dump, out)
+    sys.stderr.write("mxtpu lock witness: armed (%d modeled "
+                     "attributes watched)\n" % n)
+    return n
+
+
+def uninstall():
+    """Restore the real lock factories (watched attributes stay
+    watched — recording through them is harmless). For tests."""
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    if hasattr(threading, "_mxtpu_lock_witness"):
+        del threading._mxtpu_lock_witness
+
+
+def _watch_model_entry(entry):
+    import importlib
+    try:
+        mod = importlib.import_module(entry["module"])
+        cls = getattr(mod, entry["class"])
+    except Exception as e:
+        sys.stderr.write("lock witness: cannot watch %s.%s (%s)\n"
+                         % (entry["module"], entry["class"], e))
+        return False
+    guards = {tuple(d) for g in entry.get("guards", ())
+              for d in g.get("decl", ())}
+    return watch(cls, entry["attr"], guards)
+
+
+def watch(cls, attr, guards):
+    """Install the recording descriptor for ``cls.attr``; ``guards``
+    is a set of ``(relpath, lineno)`` lock-declaration sites the
+    static model says protect it."""
+    cur = cls.__dict__.get(attr)
+    if isinstance(cur, _WatchedAttr):
+        cur.guards = set(guards)      # re-watch: adopt the new model
+        return True
+    orig = cur if (cur is not None and hasattr(cur, "__set__")) \
+        else None
+    try:
+        setattr(cls, attr, _WatchedAttr(cls.__name__, attr,
+                                        set(guards), orig))
+    except (AttributeError, TypeError) as e:
+        sys.stderr.write("lock witness: cannot watch %s.%s (%s)\n"
+                         % (cls.__name__, attr, e))
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# results
+# ---------------------------------------------------------------------------
+
+def observations():
+    with _state_lock:
+        return {"%s.%s" % k: dict(v) for k, v in sorted(_obs.items())}
+
+
+def contradictions():
+    with _state_lock:
+        return list(_contradictions)
+
+
+def unguarded_reads():
+    with _state_lock:
+        return list(_unguarded_reads)
+
+
+def reset():
+    with _state_lock:
+        _obs.clear()
+        del _contradictions[:]
+        del _unguarded_reads[:]
+    _owner.clear()
+
+
+def dump(path):
+    """Write the observation artifact (atomic rename)."""
+    doc = {"version": 1,
+           "pid": os.getpid(),
+           "watched": len(_obs),
+           "observations": observations(),
+           "contradictions": contradictions(),
+           "unguarded_reads": unguarded_reads()}
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return doc

@@ -111,7 +111,7 @@ class GradientCompression:
         self.threshold = float(threshold)
         self._residuals = {}
 
-    def compress(self, slot, array):
+    def compress(self, slot, array):   # mxlint: allow(shared-state-race) — per-slot residual: each slot is compressed by exactly one pusher thread for the life of the run; the dict store is a GIL-atomic slot-keyed publish
         """Quantize one array for wire transfer; updates the slot's
         residual. Returns the packed uint32 representation. numpy input
         (the kvstore push path) quantizes host-side — no device round

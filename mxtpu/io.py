@@ -285,7 +285,7 @@ class PrefetchingIter(_CurrentBatchIter):
         for thread in self.prefetch_threads:
             thread.start()
 
-    def _worker(self, i):
+    def _worker(self, i):   # mxlint: allow(shared-state-race) — per-slot producer/consumer handoff serialized by the data_taken/data_ready event pairs: exactly one side owns a slot between the flips, and reset/restore first park every worker via _wait_all
         """Pull batch i+1 while the consumer holds batch i (double
         buffering over data_taken/data_ready event pairs)."""
         while True:
@@ -342,7 +342,7 @@ class PrefetchingIter(_CurrentBatchIter):
     def provide_label(self):
         return self._renamed_descs(self.rename_label, "provide_label")
 
-    def reset(self):
+    def reset(self):   # mxlint: allow(shared-state-race) — per-slot producer/consumer handoff serialized by the data_taken/data_ready event pairs: exactly one side owns a slot between the flips, and reset/restore first park every worker via _wait_all
         _wait_all(self.data_ready, self.prefetch_threads)   # workers quiesced before resetting
         for i in self.iters:
             i.reset()
@@ -362,7 +362,7 @@ class PrefetchingIter(_CurrentBatchIter):
                 "iters": None if self._inner_states is None
                 else list(self._inner_states)}
 
-    def load_state_dict(self, state):
+    def load_state_dict(self, state):   # mxlint: allow(shared-state-race) — per-slot producer/consumer handoff serialized by the data_taken/data_ready event pairs: exactly one side owns a slot between the flips, and reset/restore first park every worker via _wait_all
         """Restore into this (possibly freshly constructed) combinator:
         park the workers, rewind the wrapped iterators to the delivered
         position — exact restore when they support it, reset +
@@ -392,7 +392,7 @@ class PrefetchingIter(_CurrentBatchIter):
         _set_all(self.data_taken)    # workers refetch from the restored
         #                              position
 
-    def iter_next(self):
+    def iter_next(self):   # mxlint: allow(shared-state-race) — per-slot producer/consumer handoff serialized by the data_taken/data_ready event pairs: exactly one side owns a slot between the flips, and reset/restore first park every worker via _wait_all
         _wait_all(self.data_ready, self.prefetch_threads)
         errors = [e for e in self._errors if e is not None]
         if errors:

@@ -375,6 +375,24 @@ _REPL_TIMEOUT = float(os.environ.get("MXTPU_PS_REPL_TIMEOUT", "30"))
 _REPL_PROBE = float(os.environ.get("MXTPU_PS_REPL_PROBE", "2"))
 
 
+def _racing_copy(d, attempts=100):
+    """Reference-copy of a dict other threads keep mutating. Even the
+    C-level ``dict.copy()`` / ``list(d.items())`` can observe a resize
+    mid-clone (allocation may trigger a GC pass whose destructors are
+    a GIL checkpoint), raising "dictionary changed size during
+    iteration" — so retry the rare tear. Used by readers whose writers
+    hold per-KEY locks (there is no single lock a reader could
+    take)."""
+    for _ in range(attempts):
+        try:
+            return d.copy()
+        except RuntimeError:
+            continue
+    # ~impossible: would need `attempts` consecutive mid-copy resizes
+    raise RuntimeError("dict copy kept racing a resize after %d tries"
+                       % attempts)
+
+
 def _slice_part(arr, lo, hi):
     """Row slice of a part payload; rank-0 arrays are always one whole
     part (a 0-d numpy array cannot be indexed)."""
@@ -945,6 +963,12 @@ class ParameterServer:
         # num_update's read-modify-write max), which per-key locks alone
         # would race on
         self._updater_lock = threading.Lock()
+        # server-wide observability counters are mutated from every
+        # per-connection handler thread; the per-key locks serialize
+        # same-key pushes only, so cross-key `+=` would lose updates
+        # without a dedicated counter lock (leaf lock: nothing is
+        # acquired under it)
+        self._ctr_lock = threading.Lock()
         self._stale_max = 0
         self._stale_sum = 0
         self._stale_n = 0
@@ -1121,7 +1145,7 @@ class ParameterServer:
                   "replication); catch-up starting", self.address, addr,
                   self._repl_mode)
 
-    def _run_catchup(self, stream):
+    def _run_catchup(self, stream):   # mxlint: allow(shared-state-race) — catch-up runs on its single dedicated thread; _catchup progress is written only here and read as GIL-atomic ints/flags by the stats arm
         """Stream the full service state to a just-joined backup:
         optimizer first (forwarded pushes need the updater installed),
         then every key's value + clock + push-dedupe seqs as overwrite
@@ -1187,6 +1211,15 @@ class ParameterServer:
                      "serving UNREPLICATED until a backup rejoins",
                      self.address, addr, reason)
 
+    def _repl_stream(self):   # mxlint: allow(shared-state-race) — GIL-atomic binding read on the apply paths: attach/detach rebinds under _repl_guard, and a stream torn down after this read is handled by _ReplStream.dead / forward() raising onto the retry layer
+        """The live replication stream binding, read without
+        ``_repl_guard``: the apply paths (under per-key locks) grab the
+        binding once and forward through it; taking the guard here
+        would nest guard-inside-key-lock on every push for no benefit
+        — the race window (stream dies right after the read) already
+        has a handler either way."""
+        return self._repl
+
     # -- replication: backup side / role negotiation ----------------------
     def _peer_request(self, *msg, **kw):
         """One request to the configured peer over a lazily-held conn.
@@ -1250,7 +1283,7 @@ class ParameterServer:
                 daemon=True, name="mxtpu-ps-peer-probe")
             self._probe_thread.start()
 
-    def _become_backup(self):
+    def _become_backup(self):   # mxlint: allow(shared-state-race) — demotion path: runs at boot (join_cluster, before serving) or on the single peer-monitor thread with the repl stream already severed; the cleared-table stores publish atomically and catch-up repopulates
         """Demote to backup and drop local state: the surviving
         primary's table is the authority and ours (snapshot-restored,
         pre-crash) silently trails it — catch-up replaces everything,
@@ -1483,7 +1516,12 @@ class ParameterServer:
                 # while every other key flows freely, and the moment
                 # the lock drops the key is either still ours or
                 # map_stale — no window where neither server owns it.
-                with self._lock_for(key):  # mxlint: allow(lock-order) — dst's key locks belong to a DIFFERENT server instance; adopt_key never calls back into this server, so the nesting cannot cycle
+                # (pre-v3 this carried an allow(lock-order) pragma:
+                # the dst's key locks belong to a DIFFERENT server
+                # instance and adopt_key never calls back into this
+                # server — the v3 symbol-table precision now proves
+                # that nesting acyclic by itself)
+                with self._lock_for(key):
                     if key not in self._table or key in self._moved:
                         continue
                     applied = [[o, s] for (o, k), s
@@ -1504,13 +1542,15 @@ class ParameterServer:
                     # destination, its backup copy — is durable there;
                     # only now may ownership be released
                     self._moved[key] = dst
-                    self._map_version += 1
-                    self._keys_moved_out += 1
+                    # cross-key counters (see the moved-record arm)
+                    with self._ctr_lock:
+                        self._map_version += 1
+                        self._keys_moved_out += 1
                     del self._table[key]
                     self._clock.pop(key, None)
                     for o, s in applied:
                         self._applied.pop((o, key), None)
-                    stream = self._repl
+                    stream = self._repl_stream()
                     if stream is not None and not stream.dead:
                         # our own backup mirrors the release (ordered
                         # against this key's forwarded pushes by the
@@ -1574,7 +1614,7 @@ class ParameterServer:
             if key not in self._table:   # first writer wins (rank 0)
                 self._table[key] = self._as_table_value(value)
                 self._clock[key] = 0
-                stream = None if _repl else self._repl
+                stream = None if _repl else self._repl_stream()
                 if stream is not None:
                     rseq = stream.forward(("init", key, value))
         self._repl_barrier(stream, rseq)
@@ -1600,7 +1640,7 @@ class ParameterServer:
                     # the release already ordered after — skip it)
                     return ("ok", "skipped") if _repl \
                         else self._stale_reply(key, dst)
-                if _repl and not self._catchup_complete:
+                if _repl and not self._catchup_complete:   # mxlint: allow(shared-state-race) — GIL-atomic flag read under the key lock; the skip-until-transferred protocol tolerates a momentarily stale value
                     # catch-up in progress and this key has not been
                     # transferred yet: skip — the pending xfer record
                     # was snapshotted on the primary AFTER this push
@@ -1609,23 +1649,25 @@ class ParameterServer:
                 return ("err", "push to uninitialized key %r" % (key,))
             if origin is not None and \
                     self._applied.get((origin, key), 0) >= seq:
-                self._dup_n += 1
+                with self._ctr_lock:
+                    self._dup_n += 1
                 dup = True
-                stream = None if _repl else self._repl
+                stream = None if _repl else self._repl_stream()
             else:
                 if origin is not None:
                     self._applied[(origin, key)] = seq
                 # a restored snapshot may trail the clock a worker based
                 # its step on: clamp, staleness is never negative
                 stale = max(0, self._clock[key] - base_clock)
-                self._stale_max = max(self._stale_max, stale)
-                self._stale_sum += stale
-                self._stale_n += 1
+                with self._ctr_lock:
+                    self._stale_max = max(self._stale_max, stale)
+                    self._stale_sum += stale
+                    self._stale_n += 1
                 self._m_pushes.inc()
                 self._note_worker_push(origin, stale)
                 g = _wire_decode(grad)
                 store = self._table[key]
-                stream = None if _repl else self._repl
+                stream = None if _repl else self._repl_stream()
                 rec = ("push", key, grad, base_clock, origin, seq)
                 # records are enqueued UNDER the lock that serialized
                 # the apply: per-key stream order matches apply order
@@ -1667,9 +1709,11 @@ class ParameterServer:
                     if stream is not None:
                         rseq = stream.forward(rec)
         if not dup:
-            self._push_count += 1
+            with self._ctr_lock:
+                self._push_count += 1
+                pushes = self._push_count
             if self._ckpt is not None and self._snapshot_every > 0 \
-                    and self._push_count % self._snapshot_every == 0:
+                    and pushes % self._snapshot_every == 0:
                 self.snapshot()
         self._repl_barrier(stream, rseq, dup=dup)
         return ("ok", "dup") if dup else ("ok",)
@@ -1708,14 +1752,15 @@ class ParameterServer:
                 if dst is not None:
                     return ("ok", "skipped") if _repl \
                         else self._stale_reply(key, dst)
-                if _repl and not self._catchup_complete:
+                if _repl and not self._catchup_complete:   # mxlint: allow(shared-state-race) — GIL-atomic flag read under the key lock; the skip-until-transferred protocol tolerates a momentarily stale value
                     return ("ok", "skipped")
                 return ("err", "push to uninitialized key %r" % (key,))
             if origin is not None and \
                     self._applied.get((origin, key), 0) >= seq:
-                self._dup_n += 1
+                with self._ctr_lock:
+                    self._dup_n += 1
                 dup = True
-                stream = None if _repl else self._repl
+                stream = None if _repl else self._repl_stream()
             else:
                 ids = _np.asarray(row_ids, dtype=_np.int64)
                 store = self._table[key]
@@ -1728,15 +1773,16 @@ class ParameterServer:
                 if origin is not None:
                     self._applied[(origin, key)] = seq
                 stale = max(0, self._clock[key] - base_clock)
-                self._stale_max = max(self._stale_max, stale)
-                self._stale_sum += stale
-                self._stale_n += 1
+                with self._ctr_lock:
+                    self._stale_max = max(self._stale_max, stale)
+                    self._stale_sum += stale
+                    self._stale_n += 1
                 self._m_pushes.inc()
                 self._note_worker_push(origin, stale)
                 g = _wire_decode(rows)   # bf16 rows upcast; the fp32
                 #                          master-table contract holds
                 store = self._ensure_sparse_table(key)
-                stream = None if _repl else self._repl
+                stream = None if _repl else self._repl_stream()
                 rec = ("spush", key, row_ids, rows, base_clock, origin,
                        seq)
                 if self._updater is not None:
@@ -1770,12 +1816,15 @@ class ParameterServer:
                     self._clock[key] += 1
                     if stream is not None:
                         rseq = stream.forward(rec)
-                self._sparse_pushes += 1
-                self._sparse_rows += int(ids.size)
+                with self._ctr_lock:
+                    self._sparse_pushes += 1
+                    self._sparse_rows += int(ids.size)
         if not dup:
-            self._push_count += 1
+            with self._ctr_lock:
+                self._push_count += 1
+                pushes = self._push_count
             if self._ckpt is not None and self._snapshot_every > 0 \
-                    and self._push_count % self._snapshot_every == 0:
+                    and pushes % self._snapshot_every == 0:
                 self.snapshot()
         self._repl_barrier(stream, rseq, dup=dup)
         return ("ok", "dup") if dup else ("ok",)
@@ -1947,7 +1996,7 @@ class ParameterServer:
                                     bytes(_np.asarray(
                                         state, dtype=_np.uint8)))
                     self._keys_adopted += 1
-                    stream = None if _repl else self._repl
+                    stream = None if _repl else self._repl_stream()
                     if stream is not None:
                         rseq = stream.forward(
                             ("adopt_key", key, value, clock, applied,
@@ -2071,7 +2120,12 @@ class ParameterServer:
                 _, key, dst = sub
                 with self._lock_for(key):
                     self._moved[key] = dst
-                    self._map_version += 1
+                    # cross-key counter: the key lock only serializes
+                    # THIS key — concurrent moved records for other
+                    # keys bump too, and a lost increment would let two
+                    # different maps share a version
+                    with self._ctr_lock:
+                        self._map_version += 1
                     self._table.pop(key, None)
                     self._clock.pop(key, None)
                     for pair in [p for p in list(self._applied)
@@ -2085,7 +2139,7 @@ class ParameterServer:
                 _, moved, version = sub
                 for k, d in moved.items():
                     self._moved[k] = d
-                self._map_version = max(self._map_version, int(version))
+                self._map_version = max(self._map_version, int(version))   # mxlint: allow(shared-state-race) — repl records arrive on ONE pinned socket; the serial per-connection handler loop is the stream's total order
                 return ("ok",)
             if sc == "opt_states":
                 # accumulated updater state (momentum, update counts,
@@ -2109,7 +2163,7 @@ class ParameterServer:
                         self._applied[(o, key)] = max(prev, int(s))
                 return ("ok",)
             if sc == "catchup_done":
-                self._catchup_complete = True
+                self._catchup_complete = True   # mxlint: allow(shared-state-race) — repl records arrive on ONE pinned socket; the serial per-connection handler loop is the stream's total order
                 _log.info("parameter server %s: backup caught up "
                           "(%d keys)", self.address, len(self._table))
                 return ("ok",)
@@ -2164,12 +2218,12 @@ class ParameterServer:
             with self._workers_lock:
                 return ("ok", {"epoch": self._membership_epoch,
                                "workers": len(self._workers),
-                               "role": self._role,
+                               "role": self._role,   # mxlint: allow(shared-state-race) — GIL-atomic observability read inside the hello/membership arm; one momentarily stale reply is harmless
                                "backup": backup,
                                # the versioned shard map rides every
                                # hello, so a (re)joining worker starts
                                # with current routing
-                               "map_version": self._map_version,
+                               "map_version": self._map_version,   # mxlint: allow(shared-state-race) — GIL-atomic observability read inside the hello/membership arm; one momentarily stale reply is harmless
                                "moved": dict(self._moved)})
         if cmd == "bye":
             # clean departure: membership leaves NOW (no dead-after
@@ -2453,7 +2507,7 @@ class ParameterServer:
         t, v = tagged
         return int(v) if t == "i" else str(v)
 
-    def snapshot(self):
+    def snapshot(self):   # mxlint: allow(shared-state-race) — reads are GIL-atomic one-shot copies (list(dict.items()), int loads); per-key value consistency is taken under each key lock in the loop above them
         """Write one consistent-enough snapshot of the service state.
 
         Per-key consistency is exact (value and clock copied under the
@@ -2474,15 +2528,24 @@ class ParameterServer:
                         _np.array(self._table[key], copy=True)
                     keys.append(self._tag_key(key))
                     clocks.append(int(self._clock[key]))
+            # stable copies BEFORE the Python-level loops: handler
+            # threads insert into these dicts concurrently, and any
+            # iteration of the live dict — even list(d.items()) — can
+            # die with "dictionary changed size during iteration"
+            # (surfaced by the shared-state-race lockset pass; the
+            # writers hold per-KEY locks, so there is no lock a reader
+            # could take)
+            applied = list(_racing_copy(self._applied).items())
+            moved = list(_racing_copy(self._moved).items())
             meta = {"keys": keys, "clocks": clocks,
                     "applied": [[o, self._tag_key(k), int(s)]
-                                for (o, k), s in self._applied.items()],
+                                for (o, k), s in applied],
                     "push_count": int(self._push_count),
                     # the forwarding table survives a restart: a
                     # respawned server must keep refusing split-away
                     # keys (map_stale), not 404 them
                     "moved": [[self._tag_key(k), d]
-                              for k, d in self._moved.items()],
+                              for k, d in moved],
                     "map_version": int(self._map_version)}
             extras = None
             if self._opt_payload is not None:
@@ -2495,7 +2558,7 @@ class ParameterServer:
         finally:
             self._snap_lock.release()
 
-    def _restore_snapshot(self):
+    def _restore_snapshot(self):   # mxlint: allow(shared-state-race) — boot-time restore: start() runs this before the listener/handler threads exist
         step = self._ckpt.latest_step()
         if step is None:
             return
@@ -3315,6 +3378,12 @@ class AsyncDistKVStore(KVStore):
         self._base_clock = {}      # subkey -> clock of the last pull
         self._parts = {}           # key -> [(subkey, row_lo, row_hi), ...]
         self._shapes = {}          # key -> full array shape
+        # routing/layout caches are written from the training thread,
+        # the async push executor AND failover replay paths; one leaf
+        # lock serializes the writers (reads stay lock-free: dict
+        # lookups are GIL-atomic and every entry is immutable once
+        # written, so a reader sees either the old or the new value)
+        self._cache_lock = threading.Lock()
         # -- elasticity: versioned shard map (module docstring) --
         self._key_overrides = {}   # wire key -> its current home addr
         self._partition_rules = None   # shared PartitionRules spec
@@ -3450,7 +3519,8 @@ class AsyncDistKVStore(KVStore):
                 if dst is None:
                     raise
                 self._stats.add("map_reroutes")
-                self._key_overrides[sk] = dst
+                with self._cache_lock:
+                    self._key_overrides[sk] = dst
                 conn = self._conn_for_addr(dst)
         raise RuntimeError(
             "shard map for key %r still stale after %d hops"
@@ -3461,11 +3531,12 @@ class AsyncDistKVStore(KVStore):
         replies): its map version, and forwarding overrides for every
         key it handed away."""
         v = info.get("map_version")
-        if v is not None:
-            self._map_versions[addr] = v
-        for k, dst in (info.get("moved") or {}).items():
-            if dst != addr:
-                self._key_overrides[k] = dst
+        with self._cache_lock:
+            if v is not None:
+                self._map_versions[addr] = v
+            for k, dst in (info.get("moved") or {}).items():
+                if dst != addr:
+                    self._key_overrides[k] = dst
 
     def _refresh_map(self, conn):
         """Heartbeat half of map propagation: when a probe reply
@@ -3497,8 +3568,9 @@ class AsyncDistKVStore(KVStore):
             else:
                 plan = [("%s\x00%d" % (k, i), lo, hi)
                         for i, (lo, hi) in enumerate(bounds)]
-            self._parts[k] = plan
-            self._shapes[k] = tuple(shape)
+            with self._cache_lock:
+                self._parts[k] = plan
+                self._shapes[k] = tuple(shape)
         return plan
 
     def _pmap(self, calls):
@@ -3628,7 +3700,8 @@ class AsyncDistKVStore(KVStore):
         fresh at the destination)."""
         sk, payload, clock, seq = entry
         self._stats.add("map_reroutes")
-        self._key_overrides[sk] = _stale_dst(err)
+        with self._cache_lock:
+            self._key_overrides[sk] = _stale_dst(err)
         self._routed_request(sk, "push", sk, payload, clock,
                              self._origin, seq)
 
@@ -3751,7 +3824,8 @@ class AsyncDistKVStore(KVStore):
         apply, fresh value from the key's new owner."""
         sk, payload, clock, seq = entry
         self._stats.add("map_reroutes")
-        self._key_overrides[sk] = _stale_dst(err)
+        with self._cache_lock:
+            self._key_overrides[sk] = _stale_dst(err)
         reply = self._routed_request(sk, "pushpull", sk, payload, clock,
                                      self._origin, seq)
         return self._note_pulled(sk, reply[1], reply[2])
@@ -3933,7 +4007,8 @@ class AsyncDistKVStore(KVStore):
         apply, fresh row values from the new owner."""
         sk, ids, rws, clock, seq = entry
         self._stats.add("map_reroutes")
-        self._key_overrides[sk] = _stale_dst(err)
+        with self._cache_lock:
+            self._key_overrides[sk] = _stale_dst(err)
         reply = self._routed_request(sk, "spushpull", sk, ids, rws,
                                      clock, self._origin, seq)
         self._base_clock[sk] = reply[2]
@@ -4103,7 +4178,8 @@ class AsyncDistKVStore(KVStore):
         key's new home; only if the new home is ALSO unreachable does
         the usual degradation policy apply."""
         self._stats.add("map_reroutes")
-        self._key_overrides[sk] = _stale_dst(err)
+        with self._cache_lock:
+            self._key_overrides[sk] = _stale_dst(err)
         try:
             reply = self._routed_request(sk, "pull", sk)
         except (ConnectionError, RuntimeError) as e:

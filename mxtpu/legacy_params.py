@@ -38,7 +38,7 @@ class _Reader:
         self.pos = 0
         self.dims_dtype = dims_dtype
 
-    def raw(self, n):
+    def raw(self, n):   # mxlint: allow(shared-state-race) — _Reader is a function-local parse cursor; instances never cross threads (the 2-root reachability is the public-surface over-approximation)
         if self.pos + n > len(self.buf):
             raise ValueError("truncated reference .params stream")
         out = self.buf[self.pos:self.pos + n]

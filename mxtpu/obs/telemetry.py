@@ -205,6 +205,11 @@ class TelemetryAggregator:
         self._history = []         # bounded ring of compact ticks
         self._stop = threading.Event()
         self._thread = None
+        # sweep() is public (mxtop --once, tests) AND driven by the
+        # background loop: one lock serializes whole sweeps so the
+        # ring, the gap streaks, the conn cache and the counters never
+        # interleave between two concurrent drivers
+        self._sweep_lock = threading.Lock()
         self.sweeps = 0
         self.gaps = 0
 
@@ -300,7 +305,12 @@ class TelemetryAggregator:
         """One synchronous poll of every known target; returns (and
         optionally writes) the merged document. Tests and ``mxtop
         --once`` drive this directly — no wall clock enters the fault
-        matrix."""
+        matrix. Whole-sweep serialization: a ``--once`` driver racing
+        the background loop must not interleave ring/streak updates."""
+        with self._sweep_lock:
+            return self._sweep_locked()
+
+    def _sweep_locked(self):
         fleet = {}
         for addr in self._discover():
             snap = self._poll_one(addr)
@@ -328,7 +338,8 @@ class TelemetryAggregator:
             try:
                 self.sweep()
             except Exception:   # one bad sweep must not end telemetry
-                self.gaps += 1
+                with self._sweep_lock:
+                    self.gaps += 1
 
     def start(self):
         self._thread = threading.Thread(
@@ -340,9 +351,12 @@ class TelemetryAggregator:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
-        for conn in self._conns.values():
-            conn.close()
-        self._conns.clear()
+        # under the sweep lock: a loop sweep that outlived the join
+        # timeout must not repopulate the cache mid-teardown
+        with self._sweep_lock:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()
 
 
 def _main(argv=None):

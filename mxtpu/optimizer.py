@@ -1049,7 +1049,7 @@ class Updater:
         # installs restored (NDArray-structured) slots
         self._host_idx = set()
 
-    def ensure_state(self, index, weight):
+    def ensure_state(self, index, weight):   # mxlint: allow(shared-state-race) — the worker-side Updater is owned by its single training thread; the server-side instance is called under ParameterServer._updater_lock at every call site (the lock lives in the caller, which per-class lockset analysis cannot bind to the instance)
         """Materialize (and return) the state slot for ``index`` exactly as
         ``__call__`` would — the Module fused train step reads states
         directly instead of going through the per-param call."""
@@ -1082,7 +1082,7 @@ class Updater:
             return state.copy()
         return state
 
-    def _ensure_host_state(self, index, weight):
+    def _ensure_host_state(self, index, weight):   # mxlint: allow(shared-state-race) — the worker-side Updater is owned by its single training thread; the server-side instance is called under ParameterServer._updater_lock at every call site (the lock lives in the caller, which per-class lockset analysis cannot bind to the instance)
         """The writable-numpy state slot for ``index``, created via
         ``create_state_host`` on first touch or converted ONCE from a
         restored/device-path slot (``_host_idx`` remembers converted
@@ -1142,7 +1142,7 @@ class Updater:
             return list(synced_state)
         return state
 
-    def set_states(self, states):
+    def set_states(self, states):   # mxlint: allow(shared-state-race) — the worker-side Updater is owned by its single training thread; the server-side instance is called under ParameterServer._updater_lock at every call site (the lock lives in the caller, which per-class lockset analysis cannot bind to the instance)
         states = pickle.loads(states)
         if isinstance(states, tuple) and len(states) == 2:
             states, self.optimizer = states

@@ -191,14 +191,18 @@ class WeightSync:
         return int(state["latest"])
 
     def _conn(self, addr):
-        conn = self._conns.get(addr)
+        with self._lock:
+            conn = self._conns.get(addr)
         if conn is None:
             conn = _ka._ServerConn(addr, token=self._token, n_socks=1,
                                    connect_timeout=30.0)
             # registration: the server surfaces this subscriber's
             # watermark (and lag) in stats()['weight_stream']
             conn.request("weight_sub", self._origin, timeout=10.0)
-            self._conns[addr] = conn
+            # cache under the lock: the poll thread and a stop() that
+            # outlived its join timeout must not interleave here
+            with self._lock:
+                self._conns[addr] = conn
         return conn
 
     # -- one sync round ----------------------------------------------------
@@ -335,9 +339,10 @@ class WeightSync:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
-        for conn in self._conns.values():
+        with self._lock:
+            conns, self._conns = self._conns, {}
+        for conn in conns.values():
             conn.close()
-        self._conns = {}
 
     def stats(self):
         with self._lock:

@@ -76,6 +76,21 @@ def pytest_configure(config):
                    "(nightly tier; excluded from -m fast)")
     config.addinivalue_line(
         "markers", "fast: pre-merge tier, `pytest -m fast` < 2 min")
+    # lock witness (docs/static_analysis.md "Lock witness"): armed
+    # BEFORE any mxtpu import, and loaded by FILE PATH — `import
+    # mxtpu.devtools.lockwitness` would run mxtpu/__init__ first and
+    # every lock created during that import would be born unwrapped,
+    # making accesses under those locks look unguarded.
+    if os.environ.get("MXTPU_LOCK_WITNESS") == "1":
+        import importlib.util
+        import pathlib
+        lw = pathlib.Path(__file__).resolve().parent.parent / \
+            "mxtpu" / "devtools" / "lockwitness.py"
+        spec = importlib.util.spec_from_file_location(
+            "_mxtpu_lockwitness", str(lw))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.install()
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,61 @@
+"""blocking-under-lock fixture: waits performed while a lock is held.
+
+Positives: a socket recv under the wire lock (the raw-recv itself is a
+deliberately pragma'd blocking-call — the corpus also demonstrates
+that the two passes compose), a bounded queue get under the same lock,
+a sleep under lock, a wait on ANOTHER object's condition while holding
+a lock, and a helper whose every caller holds the lock (the transitive
+caller-context).
+
+Negatives: the standard condition idiom (wait on the condition you
+hold — wait() releases it), the same bounded get with nothing held,
+``dict.get(key)`` (positional arg: never a queue wait), and
+``os.path.join`` (join with args is not a thread join).
+"""
+import os
+import queue
+import socket
+import threading
+import time
+
+
+class Fetcher:
+    def __init__(self, addr):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._peer_cv = threading.Condition()
+        self._q = queue.Queue()
+        self._cache = {}
+        self._sock = socket.create_connection(addr, timeout=5.0)
+
+    def fetch(self):
+        with self._lock:
+            data = self._sock.recv(4096)   # mxlint: allow(blocking-call) — corpus: audited deadline loop  # EXPECT(blocking-under-lock)
+            item = self._q.get(timeout=0.5)          # EXPECT(blocking-under-lock)
+            time.sleep(0.01)                         # EXPECT(blocking-under-lock)
+            with self._peer_cv:
+                self._peer_cv.wait(timeout=1.0)      # EXPECT(blocking-under-lock)
+            return data, item
+
+    def drain(self):
+        with self._lock:
+            return self._pop_locked()
+
+    def _pop_locked(self):
+        # every caller holds self._lock: the transitive caller context
+        # carries it into this helper
+        return self._q.get(timeout=0.5)              # EXPECT(blocking-under-lock)
+
+    def wait_ready(self):
+        # the condition idiom: wait() RELEASES the held lock — negative
+        with self._cv:
+            while not self._cache:
+                self._cv.wait(timeout=0.5)
+
+    def poll(self):
+        # nothing held: bounded get is fine here — negative
+        item = self._q.get(timeout=0.5)
+        with self._lock:
+            hit = self._cache.get("latest")          # dict.get: negative
+            path = os.path.join("/tmp", "x")         # path join: negative
+        return item, hit, path

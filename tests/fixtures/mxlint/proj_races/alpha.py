@@ -1,0 +1,33 @@
+"""The writer side: a producer thread mutating shared state.
+
+Positives here: the cross-thread unlocked counter (mutated from BOTH
+the producer thread and the main-thread ``report`` surface with no
+lock at all), and the write-under-lock-A half of the split-lock race
+(beta's drain thread writes the same field under lock B).
+"""
+import threading
+
+from state import Shared
+
+
+class Producer:
+    def __init__(self):
+        self.state = Shared()
+        self.batch = 64            # init-phase: negative
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        while not self.state.dying:           # flag read: negative
+            self.state.hits += 1              # EXPECT(shared-state-race)
+            with self.state.lock_a:
+                self.state.queue_depth += 1   # EXPECT(shared-state-race)
+                self.state.total += 1
+                self.state.acked += 1
+            self.state.meter.inc()
+            self.state.requests.inc()
+
+    def report(self):
+        # the "training thread" half of the unlocked counter race
+        self.state.hits += 1                  # EXPECT(shared-state-race)
+        return self.state.hits

@@ -1,0 +1,32 @@
+"""The reader/consumer side: a drain thread that uses the WRONG lock.
+
+Positives here: the write-under-lock-B half of the split-lock race
+(alpha writes ``queue_depth`` under lock A), and the
+read-under-lock-B of ``total`` whose writers all hold lock A — the
+reader believes it is synchronized and is not.
+"""
+import threading
+
+from state import Shared
+
+
+class Consumer:
+    def __init__(self, shared=None):
+        self.state = shared if shared is not None else Shared()
+        self.seen = 0
+        t = threading.Thread(target=self._drain, daemon=True)
+        t.start()
+
+    def _drain(self):
+        while not self.state.dying:           # flag read: negative
+            with self.state.lock_b:
+                self.state.queue_depth -= 1   # EXPECT(shared-state-race)
+                if self.state.total > 0:      # EXPECT(shared-state-race)
+                    self.seen += 1
+            with self.state.lock_a:
+                self.state.acked += 1         # same lock as alpha: negative
+            self.state.meter.inc()
+
+    def finish(self):
+        # GIL-atomic publication of a plain flag: negative
+        self.state.dying = True

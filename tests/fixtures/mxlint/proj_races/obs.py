@@ -1,0 +1,18 @@
+"""Minimal registry stand-in so the corpus can exercise the
+metrics-plane exemption without importing the real mxtpu.obs."""
+import threading
+
+
+class Counter:
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+
+def counter(name):
+    return Counter(name)

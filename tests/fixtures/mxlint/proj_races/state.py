@@ -1,0 +1,44 @@
+"""Shared-state fixture: the state object two threaded modules share.
+
+Everything in THIS module is a known-negative for shared-state-race:
+init-phase writes, the per-series lock idiom (obs/metrics.py's
+``Series``), and registry-bound instruments.
+"""
+import threading
+
+from obs import counter
+
+
+class Meter:
+    """The obs/metrics per-series idiom: value guarded by its own
+    lock, read through a locked getter — fully consistent, no
+    finding."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self):
+        with self._lock:
+            self._value += 1
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Shared:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        # init-phase writes: the object has not escaped yet
+        self.hits = 0
+        self.queue_depth = 0
+        self.total = 0
+        self.acked = 0
+        self.dying = False
+        self.meter = Meter()
+        # registry instrument: per-series locks are the obs plane's
+        # guarantee, the race pass must not model its internals
+        self.requests = counter("fixture.requests")

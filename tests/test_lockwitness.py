@@ -1,0 +1,253 @@
+"""Lock-witness runtime tests (mxtpu/devtools/lockwitness.py): the
+lock wrappers' held-set bookkeeping (incl. the Condition protocol),
+the Eraser-style ownership transitions, contradiction/mismatch
+recording against a static model, slot-class watching, and the dump
+artifact. The witness is installed and UNINSTALLED per test — the
+rest of the suite must keep running on the real lock factories."""
+import importlib.util
+import json
+import pathlib
+import sys
+import threading
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "_lw_under_test", str(ROOT / "mxtpu" / "devtools" / "lockwitness.py"))
+lw = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lw)
+
+
+@pytest.fixture
+def witness():
+    lw.reset()
+    lw.caller_filter = False       # tests drive watched attrs directly
+    threading.Lock = lw._WLock
+    threading.RLock = lw._WRLock
+    threading._mxtpu_lock_witness = lw
+    try:
+        yield lw
+    finally:
+        lw.uninstall()
+        lw.caller_filter = True
+        lw.reset()
+
+
+def _in_thread(fn):
+    out = {}
+
+    def run():
+        out["r"] = fn()
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout=10.0)
+    assert "r" in out or not t.is_alive()
+    return out.get("r")
+
+
+# ---------------------------------------------------------------------------
+# lock wrappers
+# ---------------------------------------------------------------------------
+
+def test_lock_wrapper_tracks_held(witness):
+    lock = threading.Lock()
+    assert lw._held() == []
+    with lock:
+        assert lw._held() == [lock]
+        assert lock.locked()
+    assert lw._held() == []
+    assert not lock.locked()
+
+
+def test_held_set_is_per_thread(witness):
+    lock = threading.Lock()
+    with lock:
+        assert _in_thread(lambda: list(lw._held())) == []
+        assert lw._held() == [lock]
+
+
+def test_rlock_reentrant(witness):
+    rl = threading.RLock()
+    with rl:
+        with rl:
+            assert lw._held() == [rl, rl]
+        assert lw._held() == [rl]
+    assert lw._held() == []
+
+
+def test_condition_wait_releases_held(witness):
+    """The critical protocol case: Condition.wait() on a witness RLock
+    must drop the lock from the held set while parked and restore it
+    (at the right multiplicity) on wake."""
+    cv = threading.Condition()        # builds on witness RLock
+    seen = {}
+    started = threading.Event()
+
+    def waiter():
+        with cv:
+            started.set()
+            cv.wait(timeout=5.0)
+            seen["after"] = list(lw._held())
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert started.wait(5.0)
+    with cv:                          # acquirable => waiter released it
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert len(seen["after"]) == 1    # reacquired exactly once
+
+
+# ---------------------------------------------------------------------------
+# watched attributes + ownership
+# ---------------------------------------------------------------------------
+
+class _Plain:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.count = 0
+
+
+class _Slotted:
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0
+
+
+def test_exclusive_owner_never_contradicts(witness):
+    lw.watch(_Plain, "count", {("mxtpu/fake.py", 1)})
+    p = _Plain()
+    p.count += 1                       # all on the creating thread
+    assert p.count == 1
+    assert lw.contradictions() == []
+    obs = lw.observations()["_Plain.count"]
+    assert obs["writes"] >= 1 and obs["shared"] == 0
+
+
+def test_shared_unguarded_write_is_a_contradiction(witness):
+    lw.watch(_Plain, "count", {("mxtpu/fake.py", 1)})
+    p = _Plain()
+    p.count = 5                        # exclusive: fine
+
+    def bare_write():
+        p.count = 6                    # second thread, no lock held
+    _in_thread(bare_write)
+    cons = lw.contradictions()
+    assert len(cons) == 1
+    assert cons[0]["class"] == "_Plain" and cons[0]["attr"] == "count"
+    assert cons[0]["access"] == "write"
+
+
+def test_shared_unguarded_read_is_reported_not_fatal(witness):
+    """The static model exempts plain snapshot reads — so does the
+    witness: recorded in the artifact, never a contradiction."""
+    lw.watch(_Plain, "count", {("mxtpu/fake.py", 1)})
+    p = _Plain()
+    p.count = 5
+    _in_thread(lambda: p.count)
+    assert lw.contradictions() == []
+    reads = lw.unguarded_reads()
+    assert len(reads) == 1 and reads[0]["access"] == "read"
+
+
+def test_shared_guarded_access_matches_model(witness):
+    probe = _Plain()                   # learn the lock creation site
+    lw.watch(_Plain, "count", {probe.lock.site})
+    p = _Plain()                       # __init__ observed on MAIN
+
+    def locked_bump():
+        with p.lock:                   # second thread => SHARED
+            p.count += 1
+    _in_thread(locked_bump)
+    assert lw.contradictions() == []
+    obs = lw.observations()["_Plain.count"]
+    assert obs["guarded"] >= 1 and obs["unguarded"] == 0
+
+
+def test_wrong_lock_is_a_mismatch_not_a_contradiction(witness):
+    lw.watch(_Plain, "count", {("mxtpu/elsewhere.py", 99)})
+    p = _Plain()                       # __init__ observed on MAIN
+    other = threading.Lock()
+
+    def bump():
+        with other:
+            p.count += 1
+    _in_thread(bump)
+    assert lw.contradictions() == []
+    assert lw.observations()["_Plain.count"]["mismatch"] >= 1
+
+
+def test_slot_class_watch_delegates_storage(witness):
+    lw.watch(_Slotted, "v", {("mxtpu/fake.py", 1)})
+    s = _Slotted()
+    s.v = 7
+    assert s.v == 7
+    obs = lw.observations()["_Slotted.v"]
+    assert obs["writes"] >= 1 and obs["reads"] >= 1
+
+
+def test_test_driven_access_is_filtered_by_default(witness):
+    lw.caller_filter = True
+    lw.watch(_Plain, "count", {("mxtpu/fake.py", 1)})
+    p = _Plain()
+    p.count = 1
+    _in_thread(lambda: p.count)        # caller is this test file
+    assert lw.contradictions() == []   # filtered: not fleet code
+    assert lw.observations()["_Plain.count"]["unguarded"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# install / model plumbing / artifact
+# ---------------------------------------------------------------------------
+
+def test_install_uninstall_roundtrip(witness):
+    lw.uninstall()
+    real = threading.Lock
+    lw.install(model_path=None)
+    assert lw.installed()
+    assert threading.Lock is lw._WLock
+    assert lw.install(model_path=None) == 0    # idempotent
+    lw.uninstall()
+    assert threading.Lock is real
+
+
+def test_install_watches_model_entries(witness, tmp_path, monkeypatch):
+    fixture = tmp_path / "lwfixturemod.py"
+    fixture.write_text(
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self.items = 0\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    model = {"version": 1, "attrs": [
+        {"module": "lwfixturemod", "class": "Box", "attr": "items",
+         "guards": [{"token": "Box._lock",
+                     "decl": [["mxtpu/x.py", 3]]}]},
+        {"module": "no.such.module", "class": "X", "attr": "y",
+         "guards": []},
+    ]}
+    mp = tmp_path / "model.json"
+    mp.write_text(json.dumps(model))
+    lw.uninstall()
+    lw.install(model_path=str(mp))
+    import lwfixturemod
+    assert isinstance(lwfixturemod.Box.__dict__["items"],
+                      lw._WatchedAttr)
+    b = lwfixturemod.Box()
+    b.items += 2
+    assert b.items == 2
+
+
+def test_dump_artifact_shape(witness, tmp_path):
+    lw.watch(_Plain, "count", {("mxtpu/fake.py", 1)})
+    p = _Plain()
+    p.count = 3
+    _in_thread(lambda: p.count)
+    out = tmp_path / "obs.json"
+    doc = lw.dump(str(out))
+    loaded = json.loads(out.read_text())
+    assert loaded["observations"]["_Plain.count"]["reads"] >= 1
+    assert loaded["contradictions"] == doc["contradictions"]
+    assert loaded["version"] == 1

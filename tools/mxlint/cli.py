@@ -83,6 +83,10 @@ def main(argv=None):
     ap.add_argument("--sarif", default=None, metavar="FILE",
                     help="write the findings artifact as SARIF 2.1.0 "
                          "(for CI diff annotation)")
+    ap.add_argument("--lock-model", default=None, metavar="FILE",
+                    help="write the static lockset model (guarded "
+                         "shared attributes + their lock declaration "
+                         "sites) for the runtime lock witness")
     ap.add_argument("--diff", nargs="?", const="main", default=None,
                     metavar="BASE",
                     help="lint only files changed vs BASE (default "
@@ -115,6 +119,15 @@ def main(argv=None):
 
     findings = run_paths(paths, root=root, pass_names=pass_names,
                          files=files)
+
+    if args.lock_model:
+        from .core import build_project
+        from .locksets import lockset_model
+        model = lockset_model(build_project(paths, root, files=files))
+        with open(args.lock_model, "w") as f:
+            json.dump(model.witness_model(), f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
 
     if args.write_baseline is not None:
         target = pathlib.Path(

@@ -107,10 +107,16 @@ def _parse_pragma_comment(comment):
 class PragmaMap:
     """Line -> allowed pass ids for one module, with def/class-header
     pragmas expanded to the whole body and comment-only-line pragmas
-    attached to the next code line."""
+    attached to the next code line.
+
+    A pragma must carry a *reason* to suppress anything: ``allows``
+    only honors entries whose reason text is non-empty. A bare
+    ``# mxlint: allow(x)`` is inert — the finding survives, annotated
+    so the author knows why (the old review-should-reject-bare-pragmas
+    rule, made mechanical)."""
 
     def __init__(self, source, tree):
-        per_line = {}        # lineno -> (ids, line_is_comment_only)
+        per_line = {}      # lineno -> (ids, reason, comment_only)
         try:
             tokens = tokenize.generate_tokens(
                 io.StringIO(source).readline)
@@ -122,29 +128,33 @@ class PragmaMap:
                     continue
                 line_text = source.splitlines()[tok.start[0] - 1]
                 own = line_text.strip().startswith("#")
-                per_line[tok.start[0]] = (parsed[0], own)
+                per_line[tok.start[0]] = (parsed[0], parsed[1], own)
         except (tokenize.TokenError, IndentationError):
             pass
-        self._line_allow = {}     # lineno -> set of pass ids
+        self._line_allow = {}     # lineno -> {pass id -> reason}
         comment_only = []
-        for lineno, (ids, own) in per_line.items():
+        for lineno, (ids, reason, own) in per_line.items():
             if own:
-                comment_only.append((lineno, ids))
+                comment_only.append((lineno, ids, reason))
             else:
-                self._line_allow.setdefault(lineno, set()).update(ids)
+                slot = self._line_allow.setdefault(lineno, {})
+                for pid in ids:
+                    slot[pid] = reason
         # a comment-only pragma line blesses the next code line
         nlines = source.count("\n") + 1
         lines = source.splitlines()
-        for lineno, ids in comment_only:
+        for lineno, ids, reason in comment_only:
             nxt = lineno + 1
             while nxt <= nlines and (nxt - 1 >= len(lines)
                                      or not lines[nxt - 1].strip()
                                      or lines[nxt - 1].strip()
                                      .startswith("#")):
                 nxt += 1
-            self._line_allow.setdefault(nxt, set()).update(ids)
+            slot = self._line_allow.setdefault(nxt, {})
+            for pid in ids:
+                slot[pid] = reason
         # def/class-header pragmas cover the whole body
-        self._ranges = []         # (start, end, ids)
+        self._ranges = []         # (start, end, {pass id -> reason})
         if tree is not None:
             for node in ast.walk(tree):
                 if not isinstance(node, (ast.FunctionDef,
@@ -157,14 +167,24 @@ class PragmaMap:
                     self._ranges.append(
                         (header, node.end_lineno or header, ids))
 
-    def allows(self, line, pass_id):
+    def entry(self, line, pass_id):
+        """The pragma reason covering ``(line, pass_id)``, or None when
+        no pragma names that pass there. An empty string means a bare
+        (reasonless, therefore inert) pragma."""
         ids = self._line_allow.get(line)
-        if ids and (pass_id in ids or "*" in ids):
-            return True
+        if ids:
+            for pid in (pass_id, "*"):
+                if pid in ids:
+                    return ids[pid]
         for start, end, rids in self._ranges:
-            if start <= line <= end and (pass_id in rids or "*" in rids):
-                return True
-        return False
+            if start <= line <= end:
+                for pid in (pass_id, "*"):
+                    if pid in rids:
+                        return rids[pid]
+        return None
+
+    def allows(self, line, pass_id):
+        return bool(self.entry(line, pass_id))
 
 
 # ---------------------------------------------------------------------------
@@ -389,7 +409,7 @@ def run_paths(paths, root=None, pass_names=None, files=None):
             if p.scope != "module":
                 continue
             for f in p.run(module):
-                if not module.pragmas.allows(f.line, f.pass_id):
+                if _apply_pragma(module, f):
                     findings.append(f)
     for p in instances:
         if p.scope != "project":
@@ -399,11 +419,25 @@ def run_paths(paths, root=None, pass_names=None, files=None):
             if owner is not None and f.path not in \
                     project.report_relpaths:
                 continue       # anchored in an unchanged project file
-            if owner is not None and \
-                    owner.pragmas.allows(f.line, f.pass_id):
+            if owner is not None and not _apply_pragma(owner, f):
                 continue
             findings.append(f)
     return assign_fingerprints(sorted(findings, key=Finding.sort_key))
+
+
+def _apply_pragma(module, finding):
+    """True when the finding should be REPORTED. A pragma with a
+    reason suppresses it; a bare pragma is inert but annotates the
+    surviving finding (the reason requirement is mechanical, not a
+    review convention)."""
+    entry = module.pragmas.entry(finding.line, finding.pass_id)
+    if entry:
+        return False
+    if entry == "":
+        finding.message += (" [a pragma names this pass here but "
+                            "carries no reason — add `— <why>` to "
+                            "bless it]")
+    return True
 
 
 # ---------------------------------------------------------------------------

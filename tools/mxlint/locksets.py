@@ -1,0 +1,1173 @@
+"""Flow-sensitive lockset dataflow core (mxlint v3).
+
+The lock-order pass answers "can these locks deadlock"; this module
+answers the complementary question the PR-12 failover replay bug made
+urgent: **which locks are actually held when shared state is touched**.
+It is the shared machinery under the ``shared-state-race`` (Eraser-style
+lockset race detection) and ``blocking-under-lock`` passes, and the
+exporter of the *static lock model* the runtime lock witness
+(``mxtpu/devtools/lockwitness.py``) cross-checks in CI.
+
+The model, in order of construction:
+
+1. **Tokens.** A lock is named like the lock-order pass names it —
+   class-scoped (``Cls._lock``), with the declaring class resolved
+   through single-inheritance bases so ``Counter.inc``'s
+   ``self._lock`` and ``Series.value``'s ``self._lock`` are ONE token
+   (``Series._lock``). ``*lock_for*``/``*get_lock*`` factories collapse
+   to one token per factory; bare local lock names scope to their
+   function.
+
+2. **Per-statement held-lockset walk.** Every function body is walked
+   once tracking the held set through ``with`` items (nesting left to
+   right), statement-level ``acquire()``/``release()`` pairs, and
+   compound-statement bodies. At each interesting site the *current*
+   held set is recorded: attribute accesses (read/write, including
+   container mutation through ``self.x[k] = v`` and mutator-method
+   calls like ``self.x.append(...)``), call sites (for the caller
+   context and reachability), blocking calls, and thread-spawn points
+   (for the init-phase exemption).
+
+3. **Concurrency roots.** The entry points ``project.py`` already
+   indexes (``Thread(target=)`` / ``submit`` / ``start_new_thread``),
+   plus RPC dispatch handlers (detected structurally: a function
+   assigning ``cmd``/``op`` from a frame's element 0 and comparing it
+   against 2+ literals — the kvstore/serving local transport calls
+   these on the *client's* thread, so they are roots even though the
+   serve loop already reaches them), plus **main**: everything
+   reachable from functions with no in-project callers that are not
+   themselves spawn targets (the public API surface runs on the
+   caller's thread).
+
+4. **Effective locksets.** The lockset at a site is the directly held
+   set union the function's *caller context*: the intersection of the
+   held sets at every in-project call site resolving to it (one level
+   — a same-class helper called only under ``self._lock`` inherits
+   ``{Cls._lock}``; a root or an unlocked caller empties the context).
+
+5. **Verdict.** An attribute with sites in >= 2 root contexts, at
+   least one non-init write, and an EMPTY intersection of effective
+   site locksets is a candidate race. Exemptions (documented in
+   docs/static_analysis.md): init-phase writes (lexically before the
+   first spawn point in ``__init__``, or in helpers called only from
+   pre-spawn ``__init__`` code), lock-named guard attributes
+   themselves, attributes bound to internally-synchronized types
+   (Queue/Event/deque/obs registry series...), and obs metrics-plane
+   instruments (``self.x = counter(...)`` / ``.labels(...)`` — their
+   per-series locks are the guarantee, see obs/metrics.py).
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from .project import classify_call
+
+_LOCK_CTORS = frozenset(("Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"))
+_NAME_PAT = re.compile(r"lock|guard|mutex|cond|(^|_)cv$", re.IGNORECASE)
+_FACTORY_PAT = re.compile(r"lock_for|get_lock", re.IGNORECASE)
+
+# constructors whose instances carry their own synchronization (or are
+# GIL-atomic for the single-op accesses this pass can see): binding one
+# to an attribute makes method calls on that attribute safe without an
+# explicit guard. Reassigning the binding itself post-init is still
+# caught (the binding write is a plain attribute write... which this
+# exemption removes; accepted noise/precision trade, documented).
+_SYNCED_CTORS = frozenset((
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Event", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "deque", "local", "ThreadPoolExecutor",
+    "OrderedDict"))
+
+# the obs metrics plane: instruments/series registered through these
+# carry per-series locks (obs/metrics.py design rule #1) — state held
+# in them is modeled by the registry, not by this pass
+_OBS_CTORS = frozenset(("counter", "gauge", "histogram", "view",
+                        "labels", "default", "Counter", "Gauge",
+                        "Histogram"))
+
+# container-mutator method names: a call like ``self.x.append(v)``
+# writes x's state even though the AST marks the attribute Load
+_MUTATORS = frozenset((
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "sort", "reverse", "rotate"))
+
+# blocking calls for the blocking-under-lock pass: socket waits,
+# condition/event waits, queue hand-offs, joins, sleeps, future reads.
+# ``send*`` is deliberately absent: a per-socket sender thread writing
+# under its wire lock is the fleet's design, not a hazard.
+_BLOCKING = frozenset(("recv", "recv_into", "accept", "connect",
+                       "create_connection", "select", "wait",
+                       "wait_for", "get", "put", "join", "sleep",
+                       "result"))
+_SPAWN_NAMES = frozenset(("start", "submit", "start_new_thread",
+                          "apply_async", "map_async"))
+_DISPATCH_VARS = frozenset(("cmd", "op", "command", "opcode"))
+
+
+class AccessSite:
+    """One attribute read/write with the locks held at it. ``kind`` is
+    ``"read"``, ``"store"`` (plain rebind — GIL-atomic publication),
+    ``"rmw"`` (AugAssign — a lost-update window even under the GIL) or
+    ``"mut"`` (container mutation: subscript store/delete, mutator
+    method call)."""
+
+    __slots__ = ("attr_key", "kind", "relpath", "lineno", "func_key",
+                 "held", "init_phase", "node")
+
+    def __init__(self, attr_key, kind, relpath, lineno, func_key,
+                 held, init_phase, node):
+        self.attr_key = attr_key        # ((owner rel, owner cls), attr)
+        self.kind = kind
+        self.relpath = relpath
+        self.lineno = lineno
+        self.func_key = func_key        # (relpath, qualname)
+        self.held = frozenset(held)
+        self.init_phase = init_phase
+        self.node = node
+
+    @property
+    def write(self):
+        return self.kind != "read"
+
+
+class BlockingSite:
+    """One blocking call with the locks held around it."""
+
+    __slots__ = ("name", "relpath", "lineno", "func_key", "held",
+                 "wait_token", "node")
+
+    def __init__(self, name, relpath, lineno, func_key, held,
+                 wait_token, node):
+        self.name = name
+        self.relpath = relpath
+        self.lineno = lineno
+        self.func_key = func_key
+        self.held = frozenset(held)
+        self.wait_token = wait_token    # token waited ON (cv.wait)
+        self.node = node
+
+
+class _FuncLS:
+    """Per-function lockset facts."""
+
+    __slots__ = ("key", "relpath", "qualname", "cls", "node",
+                 "accesses", "blocking", "callsites", "is_init",
+                 "spawned", "self_thread_locals")
+
+    def __init__(self, key, relpath, qualname, cls, node):
+        self.key = key
+        self.relpath = relpath
+        self.qualname = qualname
+        self.cls = cls
+        self.node = node
+        self.accesses = []        # [AccessSite]
+        self.blocking = []        # [BlockingSite]
+        self.callsites = []       # [(kind, lineno, frozenset(held))]
+        self.is_init = qualname.endswith("__init__")
+        self.spawned = False      # an __init__ that published self to
+        #                           a thread it started
+        self.self_thread_locals = set()   # locals bound to
+        #                                   Thread(target=self.m)
+
+
+class LocksetModel:
+    """The whole-program lockset analysis; built once per lint run and
+    shared by both passes (and the witness-model exporter) through
+    :func:`lockset_model`."""
+
+    def __init__(self, project):
+        self.project = project
+        self.lock_attrs = {}      # attr -> {(relpath, cls, lineno)}
+        self.class_touch = {}     # (relpath, cls) -> touched attrs
+        self.exempt_attrs = set()  # (ident, attr) synced/obs bindings
+        self._token_idents = {}   # token label -> (ident, attr)
+        self.funcs = {}           # func key -> _FuncLS
+        self.roots = {}           # root id -> ("thread"|"dispatch", key)
+        self._reach = {}          # root id -> set(func key)
+        self._main_reach = None
+        self._callers = None      # func key -> [(caller key, held)]
+        self._ctx = {}            # func key -> frozenset (caller ctx)
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def build(self):
+        mods = sorted(self.project.modules.items())
+        for _, module in mods:
+            if module.tree is not None:
+                self._collect_lock_attrs(module)
+                self._collect_class_touch(module)
+        for _, module in mods:
+            if module.tree is not None:
+                self._collect_exempt_attrs(module)
+        for _, module in mods:
+            if module.tree is not None:
+                self._walk_module(module)
+        self._collect_roots()
+        return self
+
+    def _collect_lock_attrs(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call) and
+                    isinstance(value.func, (ast.Attribute, ast.Name))):
+                continue
+            ctor = value.func.attr if isinstance(value.func,
+                                                 ast.Attribute) \
+                else value.func.id
+            if ctor not in _LOCK_CTORS:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    cls = _enclosing_class(module, t)
+                    self.lock_attrs.setdefault(t.attr, set()).add(
+                        (module.relpath, cls or "?", node.lineno))
+
+    def _collect_class_touch(self, module):
+        """Which attrs each class touches in its own methods (for the
+        base-class owner unification). Keyed by the class *identity*
+        ``(relpath, name)`` — two modules' same-named classes are
+        different classes (the profiler and the obs plane both have a
+        ``Counter``)."""
+        parents = module.parent_map()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not (isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            cur = parents.get(node)
+            while cur is not None and not isinstance(cur, ast.ClassDef):
+                cur = parents.get(cur)
+            if cur is not None:
+                self.class_touch.setdefault(
+                    (module.relpath, cur.name), set()).add(node.attr)
+
+    def _collect_exempt_attrs(self, module):
+        """``self.x = Queue()`` / ``self.x = counter(...).labels(...)``
+        — attributes bound to internally-synchronized objects."""
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _is_synced_value(node.value):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    cls = _enclosing_class(module, t)
+                    if cls:
+                        owner = self.owner_class(cls, t.attr,
+                                                 module.relpath)
+                        self.exempt_attrs.add((owner, t.attr))
+
+    # ------------------------------------------------------------------
+    # owner-class unification
+    # ------------------------------------------------------------------
+    def _class_rec(self, cname, prefer_rel=None):
+        """The :class:`ClassRec` for a bare name, preferring the
+        same-module declaration (two modules' same-named classes must
+        never merge)."""
+        recs = self.project.classes.get(cname, ())
+        if prefer_rel is not None:
+            for r in recs:
+                if r.relpath == prefer_rel:
+                    return r
+        return recs[0] if recs else None
+
+    def owner_class(self, cls, attr, relpath):
+        """Identity ``(relpath, name)`` of the most-base ancestor of
+        ``cls`` (through single-inheritance bases known to the project)
+        that touches ``attr`` — so ``Counter._value`` and
+        ``Series._value`` are one attribute."""
+        best = None
+        for ident in self._base_chain(cls, relpath):
+            if attr in self.class_touch.get(ident, ()):
+                best = ident
+        return best if best is not None else (relpath, cls)
+
+    def _base_chain(self, cls, relpath):
+        """Identities of ``cls`` and its ancestors, most-derived
+        first."""
+        chain, seen, stack = [], set(), [(cls, relpath)]
+        while stack:
+            cname, rel = stack.pop(0)
+            rec = self._class_rec(cname, rel)
+            if rec is None:
+                ident = ("?", cname)
+                if ident not in seen:
+                    seen.add(ident)
+                    chain.append(ident)
+                continue
+            ident = (rec.relpath, rec.name)
+            if ident in seen:
+                continue
+            seen.add(ident)
+            chain.append(ident)
+            for b in rec.bases:
+                stack.append((b, rec.relpath))
+        return chain
+
+    # ------------------------------------------------------------------
+    # token naming
+    # ------------------------------------------------------------------
+    def token_for(self, expr, fls):
+        """Lock token for an expression, or None when not lock-like."""
+        cls = fls.cls
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name and _FACTORY_PAT.search(name):
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self" and cls:
+                    return "%s.%s()" % (cls, name)
+                return "?[%s].%s()" % (fls.relpath, name)
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            declared = self.lock_attrs.get(attr)
+            lockish = bool(declared) or bool(_NAME_PAT.search(attr))
+            if not lockish:
+                return None
+            # ``self.shared.lock`` with ``self.shared = Shared(...)``
+            # typed: the lock belongs to Shared — two classes guarding
+            # through the same shared object must agree on the token
+            base = expr.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and cls:
+                for crec in self.project.classes.get(cls, ()):
+                    if crec.relpath != fls.relpath:
+                        continue
+                    tname = crec.attr_types.get(base.attr)
+                    if tname:
+                        owner = self._lock_owner(tname, attr,
+                                                 crec.relpath)
+                        return self._token_label(owner, attr)
+            root = _attr_chain_root(expr)
+            if isinstance(root, ast.Name) and root.id == "self" and cls:
+                owner = self._lock_owner(cls, attr, fls.relpath)
+                return self._token_label(owner, attr)
+            if declared:
+                idents = {(rel, c) for (rel, c, _) in declared}
+                if len(idents) == 1:
+                    return self._token_label(next(iter(idents)), attr)
+                local = {(rel, c) for (rel, c, _) in declared
+                         if rel == fls.relpath}
+                if len(local) == 1:
+                    return self._token_label(next(iter(local)), attr)
+            return "?[%s].%s" % (fls.relpath, attr)
+        if isinstance(expr, ast.Name) and _NAME_PAT.search(expr.id):
+            return "local[%s:%s].%s" % (fls.relpath, fls.qualname,
+                                        expr.id)
+        if isinstance(expr, ast.Subscript):
+            return self.token_for(expr.value, fls)
+        return None
+
+    def _lock_owner(self, cls, attr, relpath):
+        """Declaring class identity for a lock attr through the base
+        chain — prefer a chain class that ASSIGNS the lock, else the
+        deepest chain class touching it."""
+        decl_idents = {(rel, c) for (rel, c, _)
+                       in self.lock_attrs.get(attr, ())}
+        owner = None
+        for ident in self._base_chain(cls, relpath):
+            if ident in decl_idents:
+                owner = ident
+        if owner is not None:
+            return owner
+        return self.owner_class(cls, attr, relpath)
+
+    def _token_label(self, ident, attr):
+        """Readable, identity-unique token string: ``Cls.attr`` when
+        the bare class name is project-unique, else ``Cls[rel].attr``.
+        The identity is remembered for :meth:`lock_decl_sites`."""
+        rel, cls = ident
+        if len(self.project.classes.get(cls, ())) > 1:
+            label = "%s[%s].%s" % (cls, rel, attr)
+        else:
+            label = "%s.%s" % (cls, attr)
+        self._token_idents[label] = (ident, attr)
+        return label
+
+    def lock_decl_sites(self, token):
+        """``[(relpath, lineno)]`` where the lock behind ``token`` is
+        created (for the runtime witness); [] for factory/local/unknown
+        tokens."""
+        got = self._token_idents.get(token)
+        if got is None:
+            return []
+        (rel, cls), attr = got
+        out = []
+        for (drel, dcls, lineno) in self.lock_attrs.get(attr, ()):
+            if (drel, dcls) == (rel, cls):
+                out.append((drel, lineno))
+        return sorted(out)
+
+    # ------------------------------------------------------------------
+    # the flow-sensitive walk
+    # ------------------------------------------------------------------
+    def _walk_module(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual = module.qualname(node)
+            cls = _enclosing_class(module, node)
+            fls = _FuncLS((module.relpath, qual), module.relpath, qual,
+                          cls, node)
+            self.funcs[fls.key] = fls
+            self._walk_body(module, fls, node.body, [])
+
+    def _walk_body(self, module, fls, body, held):
+        for stmt in body:
+            self._walk_stmt(module, fls, stmt, held)
+
+    def _walk_stmt(self, module, fls, stmt, held):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                    # nested defs analyzed separately
+        if isinstance(stmt, ast.With):
+            pushed = []
+            for item in stmt.items:
+                self._scan_expr(module, fls, item.context_expr, held,
+                                store_targets=())
+                tok = self.token_for(item.context_expr, fls)
+                if tok is not None:
+                    held.append(tok)
+                    pushed.append(tok)
+            self._walk_body(module, fls, stmt.body, held)
+            for tok in pushed:
+                held.remove(tok)
+            return
+        call = _stmt_call(stmt)
+        if call is not None and isinstance(call.func, ast.Attribute):
+            if call.func.attr == "acquire":
+                tok = self.token_for(call.func.value, fls)
+                if tok is not None:
+                    held.append(tok)
+                    return
+            elif call.func.attr == "release":
+                tok = self.token_for(call.func.value, fls)
+                if tok is not None and tok in held:
+                    held.remove(tok)
+                    return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                             ast.Delete)):
+            self._scan_assign(module, fls, stmt, held)
+        else:
+            for expr in _stmt_exprs(stmt):
+                self._scan_expr(module, fls, expr, held,
+                                store_targets=())
+        # compound bodies recurse with the current held set
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self._walk_body(module, fls, sub, held)
+        for h in getattr(stmt, "handlers", []) or []:
+            self._walk_body(module, fls, h.body, held)
+        # the guarded-acquire idiom: ``if not lock.acquire(...):
+        # return`` — the fall-through path holds the lock from here on
+        tok = self._guarded_acquire_token(fls, stmt)
+        if tok is not None:
+            held.append(tok)
+
+    def _guarded_acquire_token(self, fls, stmt):
+        """Token for ``if not X.acquire(...):`` whose body leaves the
+        function (return/raise/continue/break) — after the statement
+        the lock is held."""
+        if not isinstance(stmt, ast.If) or stmt.orelse:
+            return None
+        test = stmt.test
+        if not (isinstance(test, ast.UnaryOp)
+                and isinstance(test.op, ast.Not)
+                and isinstance(test.operand, ast.Call)
+                and isinstance(test.operand.func, ast.Attribute)
+                and test.operand.func.attr == "acquire"):
+            return None
+        if not stmt.body or not isinstance(
+                stmt.body[-1], (ast.Return, ast.Raise, ast.Continue,
+                                ast.Break)):
+            return None
+        return self.token_for(test.operand.func.value, fls)
+
+    # -- expression scanning ----------------------------------------------
+    def _scan_assign(self, module, fls, stmt, held):
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AugAssign):
+            targets, value = [stmt.target], stmt.value
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+            value = stmt.value
+        else:                               # Delete
+            targets, value = stmt.targets, None
+        aug = isinstance(stmt, ast.AugAssign)
+        # track ``t = Thread(target=self._loop)`` locals so a later
+        # ``t.start()`` flips the init-phase latch
+        if fls.is_init and isinstance(stmt, ast.Assign) and \
+                isinstance(value, ast.Call):
+            cname = value.func.attr \
+                if isinstance(value.func, ast.Attribute) \
+                else (value.func.id
+                      if isinstance(value.func, ast.Name) else None)
+            if cname in ("Thread", "Timer") and any(
+                    isinstance(n, ast.Name) and n.id == "self"
+                    for n in ast.walk(value)):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        fls.self_thread_locals.add(t.id)
+        for t in targets:
+            self._scan_target(module, fls, t, held, aug=aug)
+        if value is not None:
+            self._scan_expr(module, fls, value, held, store_targets=())
+
+    def _scan_target(self, module, fls, target, held, aug=False):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._scan_target(module, fls, e, held, aug=aug)
+            return
+        if isinstance(target, ast.Starred):
+            self._scan_target(module, fls, target.value, held, aug=aug)
+            return
+        if isinstance(target, ast.Attribute):
+            key = self._attr_key(fls, target)
+            if key is not None:
+                self._note_access(module, fls, key,
+                                  "rmw" if aug else "store", target,
+                                  held)
+            # the chain below the written attr is read
+            self._scan_expr(module, fls, target.value, held,
+                            store_targets=())
+            return
+        if isinstance(target, ast.Subscript):
+            # self.x[k] = v mutates x
+            base = target.value
+            if isinstance(base, ast.Attribute):
+                key = self._attr_key(fls, base)
+                if key is not None:
+                    self._note_access(module, fls, key, "mut", base,
+                                      held)
+                self._scan_expr(module, fls, base.value, held,
+                                store_targets=())
+            else:
+                self._scan_expr(module, fls, base, held,
+                                store_targets=())
+            self._scan_expr(module, fls, target.slice, held,
+                            store_targets=())
+            return
+        # plain Name targets carry no attribute state
+
+    def _scan_expr(self, module, fls, node, held, store_targets=()):
+        """Record accesses / calls / blocking sites in one expression
+        tree with the current held set."""
+        for child in ast.walk(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                self._scan_call(module, fls, child, held)
+            elif isinstance(child, ast.Attribute) and \
+                    isinstance(child.ctx, ast.Load):
+                if _is_mutator_receiver(module, child):
+                    continue       # handled as a write by _scan_call
+                parent = module.parent_map().get(child)
+                if isinstance(parent, ast.Call) and \
+                        parent.func is child and \
+                        self._is_method_name(fls, child):
+                    continue       # ``self.m(...)`` — a method, not state
+                key = self._attr_key(fls, child)
+                if key is not None:
+                    self._note_access(module, fls, key, "read", child,
+                                      held)
+
+    def _scan_call(self, module, fls, call, held):
+        kind = classify_call(call)
+        if kind is not None:
+            fls.callsites.append((kind, call.lineno, frozenset(held)))
+        f = call.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name is None:
+            return
+        # the init-phase latch: flip once __init__ hands *self* to a
+        # live thread — Thread(target=self.m).start(), a tracked
+        # t = Thread(target=self._loop) local's .start(), a
+        # self-attr thread's .start(), or submit(self.m). Starting an
+        # unrelated component (ParameterServer(...).start()) does not
+        # publish this object.
+        if fls.is_init and not fls.spawned and name in _SPAWN_NAMES:
+            if self._spawn_publishes_self(fls, call, name):
+                fls.spawned = True
+        # container mutators on an attribute are writes — unless the
+        # receiver is a project class defining a method of that name
+        # (``self._stats.add("k")`` is a call, not a set.add)
+        if name in _MUTATORS and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Attribute):
+            if not self._is_method_name(fls, f):
+                key = self._attr_key(fls, f.value)
+                if key is not None:
+                    self._note_access(module, fls, key, "mut", f.value,
+                                      held)
+        # blocking calls
+        if name in _BLOCKING:
+            site = self._blocking_site(module, fls, call, name, held)
+            if site is not None:
+                fls.blocking.append(site)
+
+    @staticmethod
+    def _spawn_publishes_self(fls, call, name):
+        """Does this start/submit hand ``self`` (or a thread whose
+        target is a self-method) to another thread?"""
+        if any(isinstance(n, ast.Name) and n.id == "self"
+               for n in ast.walk(call)):
+            return True
+        f = call.func
+        if name == "start" and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in fls.self_thread_locals:
+            return True
+        return False
+
+    def _blocking_site(self, module, fls, call, name, held):
+        f = call.func
+        if name == "get":
+            # dict.get(key[, default]) carries positional args;
+            # queue.get() / queue.get(timeout=...) does not
+            if call.args:
+                return None
+            if not isinstance(f, ast.Attribute):
+                return None
+        if name in ("wait", "wait_for", "join", "result", "put") and \
+                not isinstance(f, ast.Attribute):
+            return None
+        if name == "join" and call.args:
+            return None      # os.path.join / "sep".join — not a wait
+        wait_token = None
+        if name in ("wait", "wait_for") and isinstance(f, ast.Attribute):
+            wait_token = self.token_for(f.value, fls)
+        return BlockingSite(name, module.relpath, call.lineno, fls.key,
+                            held, wait_token, call)
+
+    def _is_method_name(self, fls, node):
+        """``self.m`` in call position where ``m`` is a known method of
+        the class (or its bases): a method lookup, not a state read. A
+        stored callable (``self._cb(...)``) stays a read."""
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "self" and fls.cls:
+            return self.project.resolve_method(
+                fls.cls, node.attr, fls.relpath) is not None
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and fls.cls:
+            for crec in self.project.classes.get(fls.cls, ()):
+                if crec.relpath != fls.relpath:
+                    continue
+                tname = crec.attr_types.get(base.attr)
+                if tname:
+                    return self.project.resolve_method(
+                        tname, node.attr, crec.relpath) is not None
+            # untyped receiver in plain call position: not a state
+            # read; a MUTATOR name on an untyped receiver stays a
+            # container mutation (the caller checks kind first)
+            return node.attr not in _MUTATORS
+        return True            # deeper chains are out of model anyway
+
+    # -- attribute identity ------------------------------------------------
+    def _attr_key(self, fls, node):
+        """``(owner class, attr)`` for a ``self.X`` (or typed
+        ``self.a.b``) attribute expression; None for everything this
+        pass does not model."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        attr = node.attr
+        if attr.startswith("__") or _NAME_PAT.search(attr):
+            return None           # dunders and the guards themselves
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            if fls.cls is None:
+                return None
+            owner = self.owner_class(fls.cls, attr, fls.relpath)
+            return (owner, attr)
+        # one level through attribute types: self.a.b with a typed
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and fls.cls:
+            for crec in self.project.classes.get(fls.cls, ()):
+                if crec.relpath != fls.relpath:
+                    continue
+                tname = crec.attr_types.get(base.attr)
+                if tname:
+                    return (self.owner_class(tname, attr,
+                                             crec.relpath), attr)
+        return None
+
+    def _note_access(self, module, fls, key, kind, node, held):
+        init = fls.is_init and not fls.spawned
+        fls.accesses.append(AccessSite(
+            key, kind, module.relpath, node.lineno, fls.key, held,
+            init, node))
+
+    # ------------------------------------------------------------------
+    # concurrency roots and reachability
+    # ------------------------------------------------------------------
+    def _collect_roots(self):
+        for (relpath, qual, lineno, how) in self.project.entry_points:
+            key = (relpath, qual)
+            if key in self.funcs:
+                self.roots.setdefault("thread:%s:%s" % key,
+                                      ("thread", key))
+        for key in self._dispatch_handlers():
+            self.roots.setdefault("dispatch:%s:%s" % key,
+                                  ("dispatch", key))
+
+    def _dispatch_handlers(self):
+        """Functions that structurally ARE frame dispatchers (the wire
+        servers' per-op switch): roots because the local transport runs
+        them on the requesting thread."""
+        out = []
+        for key, fls in self.funcs.items():
+            dvars = set()
+            for node in ast.walk(fls.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Subscript) and \
+                        isinstance(node.value.slice, ast.Constant) and \
+                        node.value.slice.value == 0:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and \
+                                t.id in _DISPATCH_VARS:
+                            dvars.add(t.id)
+            if not dvars:
+                continue
+            lits = set()
+            for node in ast.walk(fls.node):
+                if isinstance(node, ast.Compare) and \
+                        len(node.ops) == 1 and \
+                        isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+                    for lit, var in ((node.left, node.comparators[0]),
+                                     (node.comparators[0], node.left)):
+                        if isinstance(var, ast.Name) and \
+                                var.id in dvars and \
+                                isinstance(lit, ast.Constant) and \
+                                isinstance(lit.value, str):
+                            lits.add(lit.value)
+            if len(lits) >= 2:
+                out.append(key)
+        return sorted(out)
+
+    def _call_edges(self, key):
+        fls = self.funcs.get(key)
+        if fls is None:
+            return ()
+        out = []
+        for (kind, _lineno, _held) in fls.callsites:
+            tgt = self.project.resolve_callsite(fls.relpath, fls.cls,
+                                                kind)
+            if tgt is not None and tgt in self.funcs:
+                out.append(tgt)
+        return out
+
+    def _reach_from(self, keys):
+        seen = set()
+        stack = list(keys)
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            stack.extend(self._call_edges(k))
+        return seen
+
+    def reach(self, root_id):
+        got = self._reach.get(root_id)
+        if got is None:
+            _, key = self.roots[root_id]
+            got = self._reach_from([key])
+            self._reach[root_id] = got
+        return got
+
+    def main_reach(self):
+        """Everything reachable from the public surface: functions
+        with no in-project callers that are not spawn targets or
+        dispatchers — they run on whatever thread calls the API."""
+        if self._main_reach is None:
+            called = set()
+            for key in self.funcs:
+                called.update(self._call_edges(key))
+            root_keys = {key for (_, key) in self.roots.values()}
+            mains = [k for k in self.funcs
+                     if k not in called and k not in root_keys]
+            self._main_reach = self._reach_from(mains)
+        return self._main_reach
+
+    def contexts_of(self, func_key):
+        """The concurrency roots whose reach includes ``func_key``
+        (root ids, plus ``"main"``)."""
+        out = set()
+        for root_id in self.roots:
+            if func_key in self.reach(root_id):
+                out.add(root_id)
+        if func_key in self.main_reach():
+            out.add("main")
+        return out
+
+    # ------------------------------------------------------------------
+    # caller context (one level)
+    # ------------------------------------------------------------------
+    def _caller_index(self):
+        if self._callers is None:
+            self._callers = {}
+            for key, fls in self.funcs.items():
+                for (kind, lineno, held) in fls.callsites:
+                    tgt = self.project.resolve_callsite(
+                        fls.relpath, fls.cls, kind)
+                    if tgt is not None and tgt in self.funcs:
+                        self._callers.setdefault(tgt, []).append(
+                            (key, held))
+        return self._callers
+
+    def caller_ctx(self, func_key):
+        """Locks guaranteed held on ENTRY to ``func_key``: the
+        intersection over every in-project call site of (locks held at
+        the site ∪ the caller's own entry context) — a transitive
+        fixpoint, so the ``public() -> _locked() -> _helper()`` layering
+        idiom keeps its lock through any helper depth. Empty for
+        concurrency roots and public-surface functions (anyone may call
+        those with nothing held)."""
+        if not self._ctx:
+            self._compute_ctxs()
+        return self._ctx.get(func_key, frozenset())
+
+    def _compute_ctxs(self):
+        callers = self._caller_index()
+        root_keys = {key for (_, key) in self.roots.values()}
+        public = self._public_surface()
+        fixed = {f for f in self.funcs
+                 if f in root_keys or f in public or not callers.get(f)}
+        TOP = None                  # optimistic "not yet known"
+        ctx = {f: (frozenset() if f in fixed else TOP)
+               for f in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for f in self.funcs:
+                if f in fixed:
+                    continue
+                cur = None
+                for (c, held) in callers.get(f, ()):
+                    cctx = ctx.get(c, frozenset())
+                    if cctx is TOP:
+                        continue          # back edge: resolve optimistically
+                    v = held | cctx
+                    cur = set(v) if cur is None else cur & v
+                new = TOP if cur is None else frozenset(cur)
+                if new != ctx[f]:
+                    ctx[f] = new
+                    changed = True
+        self._ctx = {f: (v if v is not None else frozenset())
+                     for f, v in ctx.items()}
+
+    def _public_surface(self):
+        """Function keys with no in-project callers (API surface)."""
+        if not hasattr(self, "_public"):
+            called = set()
+            for key in self.funcs:
+                called.update(self._call_edges(key))
+            self._public = {k for k in self.funcs if k not in called}
+        return self._public
+
+    def effective(self, site):
+        """held ∪ caller-context for one site."""
+        return site.held | self.caller_ctx(site.func_key)
+
+    # ------------------------------------------------------------------
+    # verdicts
+    # ------------------------------------------------------------------
+    def attr_sites(self):
+        """``{(cls, attr): [AccessSite]}`` over the whole project.
+        Exempt attributes are removed, and so are sites blessed by a
+        reasoned ``allow(shared-state-race)`` pragma: a blessed site is
+        excluded from the MODEL, not merely from the report — a
+        deliberate lifecycle writer (boot-time restore, demotion path)
+        must not poison the lockset intersection and flag every other
+        correctly-locked site of the attribute."""
+        out = {}
+        for fls in self.funcs.values():
+            module = self.project.modules.get(fls.relpath)
+            for site in fls.accesses:
+                if site.attr_key in self.exempt_attrs:
+                    continue
+                if module is not None and module.pragmas.allows(
+                        site.lineno, "shared-state-race"):
+                    continue
+                out.setdefault(site.attr_key, []).append(site)
+        return out
+
+    def shared_attrs(self):
+        """``[(attr_key, sites, contexts, intersection)]`` for every
+        attribute accessed from >= 2 concurrency roots with >= 1
+        non-init write. ``intersection`` is the lockset common to every
+        live (non-init) site — empty means candidate race."""
+        out = []
+        func_ctx = {}
+        for attr_key, sites in sorted(self.attr_sites().items()):
+            live = [s for s in sites if not s.init_phase]
+            if not any(s.write for s in live):
+                continue
+            contexts = set()
+            for s in live:
+                ctx = func_ctx.get(s.func_key)
+                if ctx is None:
+                    ctx = self.contexts_of(s.func_key)
+                    func_ctx[s.func_key] = ctx
+                contexts |= ctx
+            if len(contexts) < 2:
+                continue
+            inter = None
+            for s in live:
+                eff = self.effective(s)
+                inter = set(eff) if inter is None else inter & eff
+            out.append((attr_key, live, contexts,
+                        frozenset(inter or ())))
+        return out
+
+    def races(self):
+        """The reportable subset of :meth:`shared_attrs` — empty
+        overall intersection AND one of three hazard shapes (each a
+        genuine corruption window, not a GIL-atomic publication):
+
+        (a) **unserialized writers** — >= 2 write sites with no lock
+            common to all of them, at least one being locked or
+            compound (two mutators of one map/counter that are not
+            mutually excluded can interleave and lose an update);
+        (b) **concurrent read-modify-write** — an unlocked ``+=`` /
+            container mutation in a function reachable from >= 2
+            concurrency roots (the load-op-store window loses updates
+            even under the GIL);
+        (c) **wrong-lock read** — the writers DO share a lock, but a
+            read site holds only locks disjoint from it (the reader
+            believes it is synchronized; it is not — it can see a
+            half-updated structure mid-write).
+
+        A flag that is only ever plainly rebound and read
+        (``self.dying = True`` / ``if self.dying``) stays quiet: one
+        bytecode op each way, atomic under the GIL, and the fleet's
+        deliberate idiom. An unlocked *plain read* of locked state is
+        likewise quiet — that is the snapshot-read idiom ``stats()``
+        uses everywhere."""
+        out = []
+        for (attr_key, sites, contexts, inter) in self.shared_attrs():
+            if inter:
+                continue
+            writes = [s for s in sites if s.write]
+            w_inter = None
+            w_union = set()
+            for s in writes:
+                eff = self.effective(s)
+                w_inter = set(eff) if w_inter is None else w_inter & eff
+                w_union |= eff
+            w_inter = w_inter or set()
+            locked_writes = any(self.effective(s) for s in writes)
+            compound = any(s.kind in ("rmw", "mut") for s in writes)
+            cand = (len(writes) >= 2 and not w_inter
+                    and (locked_writes or compound))
+            if not cand:
+                cand = any(
+                    s.kind in ("rmw", "mut") and not self.effective(s)
+                    and len(self.contexts_of(s.func_key)) >= 2
+                    for s in writes)
+            if not cand and w_inter:
+                cand = any(
+                    not s.write and self.effective(s)
+                    and not (self.effective(s) & w_inter)
+                    for s in sites)
+            if not cand:
+                continue
+            # the *offending* sites — where a pragma or a fix belongs:
+            # every write when the writers share no lock, and the
+            # wrong-lock readers (a reader holding only locks disjoint
+            # from every writer's believes it is synchronized and is
+            # not). A PLAIN unlocked read stays quiet either way —
+            # that is the GIL-atomic snapshot-read idiom ``stats()``
+            # uses everywhere, and the write side is where the
+            # corruption happens.
+            offending = []
+            for s in sites:
+                if s.write:
+                    if not w_inter:
+                        offending.append(s)
+                else:
+                    eff = self.effective(s)
+                    if eff and not (eff & w_union):
+                        offending.append(s)
+            out.append((attr_key, sites, contexts, offending))
+        return out
+
+    def blocking_sites(self):
+        """Every blocking call whose effective lockset is non-empty,
+        excluding condition waits on a held token (the wait RELEASES
+        that lock)."""
+        out = []
+        for fls in self.funcs.values():
+            for site in fls.blocking:
+                eff = site.held | self.caller_ctx(site.func_key)
+                if site.wait_token is not None and \
+                        site.wait_token in eff:
+                    eff = eff - {site.wait_token}
+                    if not eff:
+                        continue
+                    # waiting on one cv while holding ANOTHER lock
+                    # still stalls that other lock's waiters
+                if eff:
+                    out.append((site, frozenset(eff)))
+        return out
+
+    # ------------------------------------------------------------------
+    # the static lock model (runtime witness contract)
+    # ------------------------------------------------------------------
+    def witness_model(self):
+        """JSON-ready model of every *guarded* shared attribute: the
+        witness watches these at runtime and reports any shared access
+        observed with no lock held — a static-model contradiction."""
+        attrs = []
+        for (attr_key, sites, contexts, inter) in self.shared_attrs():
+            if not inter:
+                continue              # candidate races, not guarded
+            guards = []
+            for tok in sorted(inter):
+                decls = self.lock_decl_sites(tok)
+                if decls:
+                    guards.append({"token": tok,
+                                   "decl": [list(d) for d in decls]})
+            if not guards:
+                continue              # factory/local guards: unwitnessable
+            (rel, cls), attr = attr_key
+            mod = _module_name(rel)
+            if mod is None:
+                continue
+            attrs.append({
+                "class": cls, "attr": attr, "module": mod,
+                "guards": guards,
+                "sites": len(sites),
+                "contexts": sorted(contexts)})
+        return {"version": 1, "attrs": attrs}
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _attr_chain_root(node):
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node
+
+
+def _module_name(relpath):
+    """Importable dotted module for an ``mxtpu/`` relpath (the runtime
+    witness imports it); None for ``tools/`` scripts — those are not
+    importable packages."""
+    rel = pathlib.PurePosixPath(relpath)
+    if not rel.parts or rel.parts[0] != "mxtpu":
+        return None
+    parts = rel.with_suffix("").parts
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _enclosing_class(module, node):
+    """Class owning ``node``. For a ``def`` node: its syntactic class
+    (None when nested inside a method). For anything else (an
+    attribute site): the nearest enclosing class — a closure inside a
+    method still sees the method's ``self``."""
+    parents = module.parent_map()
+    cur = parents.get(node)
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+            cur = parents.get(cur)
+        return None
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = parents.get(cur)
+    return None
+
+
+def _stmt_call(stmt):
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        return stmt.value
+    return None
+
+
+def _stmt_exprs(stmt):
+    """The expression children of a statement, excluding compound
+    bodies (those recurse with their own held set)."""
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+
+
+def _is_mutator_receiver(module, attr_node):
+    """True when this Load attribute is the receiver of a mutator call
+    (``self.x.append(...)`` — x is recorded as a write, not a read)."""
+    parent = module.parent_map().get(attr_node)
+    return (isinstance(parent, ast.Attribute)
+            and parent.attr in _MUTATORS
+            and isinstance(module.parent_map().get(parent), ast.Call)
+            and module.parent_map().get(parent).func is parent)
+
+
+def _is_synced_value(value):
+    """Value expression constructing an internally-synchronized object
+    (possibly through a dotted name or a trailing ``.labels(...)``)."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if name in _SYNCED_CTORS or name in _OBS_CTORS:
+        return True
+    # chained obs idiom: counter("a.b").labels("x") — func is an
+    # Attribute on a Call
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Call):
+        return _is_synced_value(f.value)
+    return False
+
+
+_MODEL_CACHE = {}
+
+
+def lockset_model(project):
+    """The per-project singleton: both passes (and the CLI's
+    ``--lock-model`` exporter) share one built analysis."""
+    key = id(project)
+    got = _MODEL_CACHE.get(key)
+    if got is None or got[0] is not project:
+        model = LocksetModel(project).build()
+        _MODEL_CACHE.clear()      # one project per run; never grow
+        _MODEL_CACHE[key] = (project, model)
+        return model
+    return got[1]

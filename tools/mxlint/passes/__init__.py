@@ -4,11 +4,13 @@ plus an import line here (docs/static_analysis.md, "Adding a pass")."""
 from __future__ import annotations
 
 from . import blocking    # noqa: F401
+from . import blockinglock  # noqa: F401
 from . import donation    # noqa: F401
 from . import envdrift    # noqa: F401
 from . import faultcov    # noqa: F401
 from . import locks       # noqa: F401
 from . import metricsdrift  # noqa: F401
+from . import races       # noqa: F401
 from . import resource    # noqa: F401
 from . import swallow     # noqa: F401
 from . import tracepurity  # noqa: F401

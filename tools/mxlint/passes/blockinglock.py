@@ -1,0 +1,65 @@
+"""blocking-under-lock pass: a blocking wait while a lock is held
+parks every other thread that needs the lock for as long as the wait
+takes — the exact failure mode that turns one slow peer into a fleet
+stall. Built on the same per-statement held-lockset machinery as
+``shared-state-race``.
+
+Flagged while the effective lockset (directly held + the one-level
+caller context) is non-empty:
+
+* socket waits — ``.recv(`` / ``.recv_into(`` / ``.accept(`` /
+  ``.connect(`` / ``create_connection`` / ``select``;
+* condition/event waits — ``.wait()`` / ``.wait_for()`` — EXCEPT a
+  wait on a condition whose own lock is the only thing held (that is
+  the idiom: ``Condition.wait`` releases its lock while parked);
+* queue hand-offs — ``.get()`` (no positional args — ``dict.get(k)``
+  never matches) and ``.put(...)``;
+* ``.join()``, ``time.sleep``, ``future.result()``.
+
+Bounded waits are flagged too: ``q.get(timeout=0.1)`` under a lock
+still stalls that lock's waiters for the timeout — the existing
+``blocking-call`` pass owns the unbounded-wait question; this pass
+owns the held-lock question. ``send``/``sendall`` are deliberately not
+flagged: a per-socket sender thread writing under its wire lock is the
+transport's design.
+
+A deliberate hold-across-wait (e.g. a handoff that must keep its key
+lock across a peer RPC for exactly-once semantics) carries
+``# mxlint: allow(blocking-under-lock) — <why>``; the reason is
+mandatory.
+"""
+from __future__ import annotations
+
+from ..core import LintPass, register
+from ..locksets import lockset_model
+
+
+@register
+class BlockingUnderLockPass(LintPass):
+    name = "blocking-under-lock"
+    scope = "project"
+    description = ("blocking socket/condition/queue wait while a lock "
+                   "is held (stalls every waiter on that lock)")
+
+    def run_project(self, project):
+        model = lockset_model(project)
+        out = []
+        for (site, eff) in model.blocking_sites():
+            module = project.modules.get(site.relpath)
+            if module is None:
+                continue
+            f = module.finding(
+                _Anchor(site.lineno), self.name,
+                "blocking .%s() while holding {%s} — every thread "
+                "needing %s lock stalls for the duration of the wait"
+                % (site.name, ", ".join(sorted(eff)),
+                   "that" if len(eff) == 1 else "any held"))
+            f.func = site.func_key[1]
+            out.append(f)
+        return out
+
+
+class _Anchor:
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.col_offset = 0

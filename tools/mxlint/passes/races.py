@@ -1,0 +1,88 @@
+"""shared-state-race pass: Eraser-style lockset race detection over the
+whole program.
+
+For every class attribute reachable from >= 2 concurrency roots (the
+``Thread(target=)``/``submit`` entry points the project indexes, RPC
+dispatch handlers — the local transport runs those on the requesting
+thread — and the main-thread public surface) with at least one
+non-init write, the pass intersects the *effective lockset* (locks
+held per statement, plus the one-level caller context: locks held at
+every call site of a same-class helper) across all live access sites.
+An empty intersection means no single lock consistently guards the
+attribute: a candidate data race, reported at every function that
+touches it — so a race split across a sender thread and the training
+thread is caught from both modules.
+
+Exemptions (the lockset core applies them; docs/static_analysis.md
+"Lockset model" documents the reasoning):
+
+* init-phase writes — ``__init__`` assignments lexically before the
+  first thread-spawn point construct the object before it escapes;
+* lock-named guard attributes (``*lock*``/``*cv``/``*cond*``...) —
+  they ARE the synchronization;
+* attributes bound to internally-synchronized objects (Queue, Event,
+  deque, threading.local, executors) and to obs metrics-plane
+  instruments (``counter(...)``/``.labels(...)`` series carry
+  per-series locks — the registry models them, see obs/metrics.py).
+
+A deliberate lock-free idiom (a GIL-atomic flag read on a hot path, a
+monotone watermark) carries ``# mxlint: allow(shared-state-race) —
+<why the unlocked access is safe>``; the reason is mandatory — a
+bare pragma does not suppress.
+"""
+from __future__ import annotations
+
+from ..core import LintPass, register
+from ..locksets import lockset_model
+
+
+def _fmt_locks(tokens):
+    return "{%s}" % ", ".join(sorted(tokens)) if tokens else "no lock"
+
+
+@register
+class SharedStateRacePass(LintPass):
+    name = "shared-state-race"
+    scope = "project"
+    description = ("class attribute shared across concurrency roots "
+                   "with >=1 write and an empty site-lockset "
+                   "intersection (candidate data race)")
+
+    def run_project(self, project):
+        model = lockset_model(project)
+        out = []
+        for (attr_key, sites, contexts, offending) in model.races():
+            (_rel, cls), attr = attr_key
+            # one finding per offending function, anchored at its first
+            # offending write site (else read), so both sides of a
+            # cross-module race surface and can be pragma'd per site —
+            # a correctly-locked reader of the same attribute stays
+            # quiet
+            by_func = {}
+            for s in offending:
+                by_func.setdefault(s.func_key, []).append(s)
+            nwrites = sum(1 for s in sites if s.write)
+            for func_key, fsites in sorted(by_func.items()):
+                fsites.sort(key=lambda s: (not s.write, s.lineno))
+                anchor = fsites[0]
+                module = project.modules.get(anchor.relpath)
+                if module is None:
+                    continue
+                rw = "writes" if anchor.write else "reads"
+                eff = model.effective(anchor)
+                f = module.finding(
+                    _Anchor(anchor.lineno), self.name,
+                    "unlocked shared state %s.%s: this function %s it "
+                    "under %s, but no lock is common to all %d sites "
+                    "(%d writes) across %d concurrency roots"
+                    % (cls, attr, rw, _fmt_locks(eff), len(sites),
+                       nwrites, len(contexts)))
+                f.func = anchor.func_key[1]
+                out.append(f)
+        return out
+
+
+class _Anchor:
+    def __init__(self, lineno):
+        self.lineno = lineno
+        self.col_offset = 0

@@ -275,15 +275,32 @@ class Project:
                 if kind is not None:
                     rec.calls.append(CallSite(kind, child.lineno))
 
+    @staticmethod
+    def _ctor_call(value):
+        """The ``Cls(...)`` call in a binding value — direct, the
+        fallback arm of ``self.x = given or Cls(...)``, or either arm
+        of ``self.x = given if cond else Cls(...)``."""
+        arms = (value,)
+        if isinstance(value, ast.BoolOp) and isinstance(value.op,
+                                                       ast.Or):
+            arms = tuple(value.values)
+        elif isinstance(value, ast.IfExp):
+            arms = (value.body, value.orelse)
+        for arm in arms:
+            if isinstance(arm, ast.Call) and \
+                    isinstance(arm.func, ast.Name):
+                return arm
+        return None
+
     def _harvest_attr_types(self, relpath, module, parents):
         """``self.X = Cls(...)`` inside a class binds ``X: Cls`` when
-        ``Cls`` names a project class (possibly through an import)."""
+        ``Cls`` names a project class (possibly through an import);
+        the ``self.X = given or Cls(...)`` default idiom binds too."""
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Assign):
                 continue
-            v = node.value
-            if not (isinstance(v, ast.Call)
-                    and isinstance(v.func, ast.Name)):
+            v = self._ctor_call(node.value)
+            if v is None:
                 continue
             cname = v.func.id
             if cname not in self.classes and \
