@@ -107,6 +107,12 @@ on a distinct recovery path:
   at-most-once).
 * ``server.recv`` + ``kind=kill`` crashes the shard mid-conversation —
   the checkpoint-backed auto-resume path.
+* ``ctl.poll`` / ``ctl.action`` are the autoscaling controller's points
+  (mxtpu/fleet/): a dropped/severed poll is a missed telemetry tick the
+  policy degrades to hold-last-decision; a dropped action is a lost
+  actuation the journal retries under the SAME id (the executor's
+  dedupe keeps the retry exactly-once), and ``kind=kill_worker`` at
+  ``ctl.action`` is the controller-killed-mid-action drill.
 """
 from __future__ import annotations
 
@@ -120,7 +126,8 @@ __all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
 
 _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
            "worker.step", "module.step", "serve.request", "serve.batch",
-           "serve.swap", "publish.snapshot", "any")
+           "serve.swap", "publish.snapshot", "ctl.poll", "ctl.action",
+           "any")
 _KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
           "nan_grad", "kill_worker", "join_worker", "leave_worker",
           "split_shard")

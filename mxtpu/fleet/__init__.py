@@ -1,0 +1,37 @@
+"""Closed-loop fleet autoscaling (ROADMAP item 3, ISSUE 16).
+
+PR 14 gave every process a telemetry surface folded into one
+``fleet.json``; PRs 7/8 gave the fleet its actuators (elastic worker
+join/leave, online shard split, serving replica drain). This package
+closes the loop: a controller process polls the merged snapshot and
+drives the actuators through an idempotent, journaled, lease-fenced
+action pipeline.
+
+* :mod:`~mxtpu.fleet.policy` — the deterministic decision core: a pure
+  function from a window of fleet snapshots to an action list
+  (hysteresis bands, per-action cooldowns, capacity bounds, a rate
+  limiter; injected clock, no wall-time reads).
+* :mod:`~mxtpu.fleet.journal` — the write-ahead action journal: intent
+  before actuation, verdict after, replay on restart — a controller
+  killed -9 mid-action resumes exactly where it died.
+* :mod:`~mxtpu.fleet.actuator` — the file mailbox between controller
+  and launcher plus the idempotent executor (dedupe by action id,
+  epoch fencing) and the single-controller lease.
+* :mod:`~mxtpu.fleet.controller` — the process
+  (``python -m mxtpu.fleet.controller``, spawned by ``tools/launch.py
+  --autoscale``) wiring poll → decide → journal → mailbox, with the
+  ``ctl.poll`` / ``ctl.action`` fault points and the
+  ``fleet.controller.*`` metrics.
+
+docs/autoscaling.md is the operator contract.
+"""
+from __future__ import annotations
+
+from .policy import PolicyConfig, PolicyState, decide, summarize
+from .journal import ActionJournal
+from .actuator import ActionMailbox, ActionExecutor, Lease
+from .controller import Controller
+
+__all__ = ["PolicyConfig", "PolicyState", "decide", "summarize",
+           "ActionJournal", "ActionMailbox", "ActionExecutor", "Lease",
+           "Controller"]

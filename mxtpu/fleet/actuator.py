@@ -1,0 +1,280 @@
+"""Controller ↔ launcher actuation: lease, mailbox, idempotent executor.
+
+The controller cannot spawn workers itself — the launcher owns the
+command line, the env contract and the process table — so actuation is
+a file protocol inside the autoscale rendezvous directory::
+
+    <dir>/lease            single-controller lease (fencing epochs)
+    <dir>/actions/<id>.json    requests (controller → launcher)
+    <dir>/verdicts/<id>.json   results  (launcher → controller)
+    <dir>/wip/<id>         in-progress marker (crash forensics)
+    <dir>/fence            highest lease epoch the executor admitted
+
+Every file lands via tmp + rename, so a reader never sees a torn
+record. The three legs of exactly-once:
+
+* **journal** (mxtpu/fleet/journal.py): the controller writes intent
+  before submitting, so a kill -9 mid-action replays under the same id;
+* **dedupe**: :meth:`ActionExecutor.execute` keys on the action id — a
+  re-submitted id whose verdict file exists returns the RECORDED
+  verdict without re-running the handler (this is also what makes
+  ``tools/launch.py --scale`` retries safe: a re-issued
+  ``add_worker``/``split_shard`` after an ambiguous timeout cannot
+  double-apply);
+* **fencing**: actions carry the controller's lease epoch; the
+  executor persists the highest epoch it has admitted and refuses
+  lower ones with a ``fenced`` verdict — two controllers can never
+  interleave actuations even across a lease handover.
+"""
+from __future__ import annotations
+
+import errno
+import json
+import os
+import threading
+import time
+
+__all__ = ["Lease", "ActionMailbox", "ActionExecutor"]
+
+_ID_OK = set("abcdefghijklmnopqrstuvwxyz"
+             "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+
+def _check_id(action_id):
+    if not action_id or not set(action_id) <= _ID_OK:
+        raise ValueError("bad action id %r (path-unsafe)" % action_id)
+    return action_id
+
+
+def _write_atomic(path, doc):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(doc, f, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class Lease:
+    """Single-controller lease file: ``{owner, epoch, expires}``.
+
+    Acquisition succeeds when the file is absent, expired, or already
+    ours; every acquisition by a NEW owner bumps the epoch — the
+    fencing token every action carries. Renewal extends ``expires``
+    without changing the epoch. This is deliberately advisory-lock-free
+    (tmp + rename): last write wins, and the executor-side epoch check
+    is what makes a lost race harmless."""
+
+    def __init__(self, path, owner, ttl=10.0, clock=time.time):
+        self.path = path
+        self.owner = str(owner)
+        self.ttl = float(ttl)
+        self._clock = clock
+        self.epoch = 0
+
+    def _current(self):
+        return _read_json(self.path) or {}
+
+    def held(self, now=None):
+        now = self._clock() if now is None else now
+        cur = self._current()
+        return cur.get("owner") == self.owner \
+            and cur.get("expires", 0) > now
+
+    def acquire(self, now=None):
+        """True when this controller holds the lease after the call."""
+        now = self._clock() if now is None else now
+        cur = self._current()
+        if cur.get("owner") not in (None, self.owner) \
+                and cur.get("expires", 0) > now:
+            return False         # live foreign lease: stand down
+        if cur.get("owner") == self.owner and \
+                cur.get("expires", 0) > now:
+            self.epoch = int(cur.get("epoch", 0))
+            return True
+        self.epoch = int(cur.get("epoch", 0)) + 1
+        _write_atomic(self.path, {"owner": self.owner,
+                                  "epoch": self.epoch,
+                                  "expires": now + self.ttl})
+        return True
+
+    def renew(self, now=None):
+        now = self._clock() if now is None else now
+        cur = self._current()
+        if cur.get("owner") != self.owner:
+            return self.acquire(now)
+        self.epoch = int(cur.get("epoch", self.epoch))
+        _write_atomic(self.path, {"owner": self.owner,
+                                  "epoch": self.epoch,
+                                  "expires": now + self.ttl})
+        return True
+
+    def release(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ActionMailbox:
+    """The controller's half: submit requests, read verdicts."""
+
+    def __init__(self, directory):
+        self.dir = directory
+        self._req = os.path.join(directory, "actions")
+        self._ver = os.path.join(directory, "verdicts")
+
+    def submit(self, action_id, action, epoch):
+        """Idempotent by construction: re-submitting an id overwrites
+        the request file with identical content."""
+        _check_id(action_id)
+        _write_atomic(os.path.join(self._req, action_id + ".json"),
+                      {"id": action_id, "action": action,
+                       "epoch": epoch})
+
+    def verdict(self, action_id):
+        return _read_json(os.path.join(self._ver,
+                                       _check_id(action_id) + ".json"))
+
+    def wait(self, action_id, timeout, tick=0.05, sleep=time.sleep,
+             clock=time.monotonic):
+        deadline = clock() + timeout
+        while True:
+            v = self.verdict(action_id)
+            if v is not None:
+                return v
+            if clock() >= deadline:
+                return None
+            sleep(tick)
+
+
+class ActionExecutor:
+    """The launcher's half: apply each action id at most once.
+
+    ``handlers`` maps action kind → callable(action_dict) → detail.
+    :meth:`execute` is the idempotent core (verdict-file dedupe + wip
+    marker + epoch fence); :meth:`poll` scans the mailbox and executes
+    whatever is new — the launcher drives it from its monitor loop.
+    Also constructed WITHOUT a mailbox dir by the ``--scale`` drill
+    path, where it provides pure in-process dedupe."""
+
+    def __init__(self, directory, handlers, verbose=True):
+        self.dir = directory
+        self.handlers = dict(handlers)
+        self.verbose = verbose
+        self._req = os.path.join(directory, "actions")
+        self._ver = os.path.join(directory, "verdicts")
+        self._wip = os.path.join(directory, "wip")
+        self._fence_path = os.path.join(directory, "fence")
+        for d in (self._req, self._ver, self._wip):
+            os.makedirs(d, exist_ok=True)
+        doc = _read_json(self._fence_path)
+        self._fence = int(doc.get("epoch", 0)) if doc else 0
+        # the launcher drives execute() from BOTH its --scale drill
+        # thread and the controller-mailbox pump thread; the counters
+        # (and the fence) need one owning lock
+        self._count_lock = threading.Lock()
+        self.applied = 0
+        self.deduped = 0
+        self.fenced = 0
+
+    def _verdict(self, action_id, doc):
+        _write_atomic(os.path.join(self._ver, action_id + ".json"),
+                      doc)
+        return doc
+
+    def execute(self, action_id, action, epoch=0):
+        """Apply ``action`` exactly once under ``action_id``; returns
+        the verdict document (recorded or fresh). Safe to call any
+        number of times with the same id."""
+        _check_id(action_id)
+        prior = _read_json(os.path.join(self._ver,
+                                        action_id + ".json"))
+        if prior is not None:
+            with self._count_lock:
+                self.deduped += 1
+            return prior
+        epoch = int(epoch or 0)
+        with self._count_lock:
+            fence = self._fence
+            if epoch < fence:
+                self.fenced += 1
+            elif epoch > fence:
+                self._fence = epoch
+        if epoch < fence:
+            return self._verdict(action_id, {
+                "id": action_id, "verdict": "fenced",
+                "detail": "epoch %d < fence %d" % (epoch, fence)})
+        if epoch > fence:
+            _write_atomic(self._fence_path, {"epoch": epoch})
+        wip = os.path.join(self._wip, action_id)
+        try:
+            fd = os.open(wip, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+        except OSError as e:
+            if e.errno == errno.EEXIST:
+                # someone (or a previous incarnation) is mid-apply:
+                # never double-run; the caller's timeout verdict covers
+                # the crashed-executor case
+                with self._count_lock:
+                    self.deduped += 1
+                return None
+            raise
+        kind = (action or {}).get("action")
+        try:
+            handler = self.handlers.get(kind)
+            if handler is None:
+                doc = {"id": action_id, "verdict": "failed",
+                       "detail": "no handler for action %r" % kind}
+            else:
+                if self.verbose:
+                    print("autoscale: applying %s (%s)"
+                          % (kind, action_id), flush=True)
+                detail = handler(action)
+                with self._count_lock:
+                    self.applied += 1
+                doc = {"id": action_id, "verdict": "ok",
+                       "detail": detail}
+        except Exception as e:   # verdict, never a wedged launcher
+            doc = {"id": action_id, "verdict": "failed",
+                   "detail": "%s: %s" % (type(e).__name__, e)}
+        finally:
+            try:
+                os.unlink(wip)
+            except OSError:
+                pass
+        return self._verdict(action_id, doc)
+
+    def poll(self):
+        """Execute every mailbox request without a verdict yet; returns
+        the number of fresh applications."""
+        with self._count_lock:
+            before = self.applied
+        try:
+            names = sorted(os.listdir(self._req))
+        except OSError:
+            return 0
+        for fn in names:
+            if not fn.endswith(".json"):
+                continue
+            req = _read_json(os.path.join(self._req, fn))
+            if not req or "id" not in req:
+                continue
+            self.execute(req["id"], req.get("action") or {},
+                         epoch=req.get("epoch", 0))
+        with self._count_lock:
+            return self.applied - before
+
+    def stats(self):
+        with self._count_lock:
+            return {"applied": self.applied, "deduped": self.deduped,
+                    "fenced": self.fenced, "fence_epoch": self._fence}

@@ -1,0 +1,297 @@
+"""The autoscaling controller process (``python -m
+mxtpu.fleet.controller``, spawned by ``tools/launch.py --autoscale``).
+
+One tick = lease → poll → decide → actuate:
+
+1. **lease** — acquire/renew the single-controller lease
+   (mxtpu/fleet/actuator.py); without it the tick is a no-op (two
+   controllers never fight — the loser idles until the lease expires).
+2. **poll** — read the aggregator's ``fleet.json``. The ``ctl.poll``
+   fault point fires first: a dropped/severed poll is a missed tick,
+   and the policy's sweep-sequence check degrades it to
+   hold-last-decision (never a panic scale-down).
+3. **decide** — the pure policy core (mxtpu/fleet/policy.py) over the
+   frame window, with the controller's clock injected.
+4. **actuate** — per action: journal the intent, fire ``ctl.action``
+   (drop = a lost actuation this attempt; ``kill_worker`` = the
+   kill -9 mid-action drill), submit to the mailbox, await the
+   executor's verdict with bounded retry/backoff, journal the verdict.
+   A timeout is itself a verdict — the controller never wedges on a
+   dead launcher.
+
+On start the journal replays: every intent without a terminal verdict
+is re-submitted under its ORIGINAL id, and the executor's dedupe makes
+the replay exactly-once.
+
+Everything observable rides the ``fleet.controller.*`` instruments and
+the ``fleet.controller`` view (docs/observability.md), exported
+through the standard telemetry endpoint so the controller appears in
+``fleet.json`` — and in ``tools/mxtop.py`` — as one more fleet row.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .. import fault as _fault
+from ..obs import metrics as _obs
+from .actuator import ActionMailbox, Lease
+from .journal import ActionJournal, TERMINAL
+from .policy import PolicyConfig, PolicyState, decide, summarize
+
+__all__ = ["Controller"]
+
+_POLLS = _obs.counter("fleet.controller.polls",
+                      "telemetry polls by outcome", ("outcome",))
+_HOLDS = _obs.counter("fleet.controller.holds",
+                      "ticks held (stale/suspect telemetry)")
+_ACTIONS = _obs.counter("fleet.controller.actions",
+                        "actuations by kind and verdict",
+                        ("action", "verdict"))
+_RETRIES = _obs.counter("fleet.controller.retries",
+                        "actuation attempts beyond the first")
+_DECIDE_MS = _obs.histogram("fleet.controller.decide_ms",
+                            "policy evaluation wall time")
+_ACTION_MS = _obs.histogram("fleet.controller.action_ms",
+                            "submit-to-verdict wall time per action")
+_LEADER = _obs.gauge("fleet.controller.leader",
+                     "1 while this controller holds the lease")
+
+
+def _envf(name, default):
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class Controller:
+    """Poll → decide → journal → actuate, one tick at a time.
+
+    ``directory`` is the autoscale rendezvous (lease, journal, action
+    mailbox); ``fleet_path`` the aggregator's merged snapshot. Tests
+    inject ``poll_fn`` (frames without files), ``clock`` and ``sleep``
+    for deterministic schedules."""
+
+    def __init__(self, fleet_path, directory, cfg=None, owner=None,
+                 poll_fn=None, clock=time.time, sleep=time.sleep,
+                 interval=None, action_timeout=None,
+                 action_retries=None, lease_ttl=None):
+        self.fleet_path = fleet_path
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.cfg = cfg if cfg is not None else PolicyConfig.from_env()
+        self._clock = clock
+        self._sleep = sleep
+        self.interval = _envf("MXTPU_AUTOSCALE_INTERVAL", 1.0) \
+            if interval is None else float(interval)
+        self.action_timeout = _envf("MXTPU_AUTOSCALE_ACTION_TIMEOUT",
+                                    15.0) \
+            if action_timeout is None else float(action_timeout)
+        self.action_retries = int(_envf(
+            "MXTPU_AUTOSCALE_ACTION_RETRIES", 3)) \
+            if action_retries is None else int(action_retries)
+        ttl = _envf("MXTPU_AUTOSCALE_LEASE_TTL", 10.0) \
+            if lease_ttl is None else float(lease_ttl)
+        # the lease TTL must outlive a full actuation cycle, or the
+        # controller fences ITSELF mid-retry
+        ttl = max(ttl, self.action_timeout * (self.action_retries + 1)
+                  + 2 * self.interval)
+        owner = owner or "ctl-%d" % os.getpid()
+        self.mailbox = ActionMailbox(directory)
+        self.journal = ActionJournal(os.path.join(directory,
+                                                  "journal.jsonl"))
+        self.lease = Lease(os.path.join(directory, "lease"), owner,
+                           ttl=ttl, clock=clock)
+        self.state = PolicyState()
+        self.window = []
+        self._poll_fn = poll_fn if poll_fn is not None \
+            else self._poll_file
+        self._replayed = False
+        self.ticks = 0
+        self.issued = 0
+        self._view_key = _obs.view("fleet.controller", self.status)
+
+    # -- telemetry in ---------------------------------------------------
+    def _poll_file(self):
+        try:
+            with open(self.fleet_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def poll(self):
+        """One guarded fleet.json read; None = missed poll (gap, fault,
+        unreadable file) — the hold-last-decision input."""
+        try:
+            if _fault.fire("ctl.poll", op="poll",
+                           key=self.fleet_path) == "drop":
+                _POLLS.labels("miss").inc()
+                return None
+        except ConnectionError:     # FaultSever: the severed poll
+            _POLLS.labels("miss").inc()
+            return None
+        doc = self._poll_fn()
+        _POLLS.labels("ok" if doc is not None else "miss").inc()
+        return doc
+
+    # -- the loop -------------------------------------------------------
+    def tick(self):
+        """One control cycle; returns the actions issued (possibly
+        none). Never raises on telemetry problems — holding is the
+        degraded mode."""
+        now = self._clock()
+        self.ticks += 1
+        if not self.lease.acquire(now):
+            _LEADER.set(0)
+            return []
+        self.lease.renew(now)
+        _LEADER.set(1)
+        if not self._replayed:
+            self._replayed = True
+            self.replay()
+        doc = self.poll()
+        if doc is not None:
+            frame = summarize(doc)
+            if not self.window or frame["seq"] > self.window[-1]["seq"]:
+                self.window.append(frame)
+                del self.window[:-self.cfg.window]
+        holds_before = self.state.holds
+        t0 = time.perf_counter()
+        actions, self.state = decide(list(self.window), self.state,
+                                     self.cfg, now)
+        _DECIDE_MS.observe((time.perf_counter() - t0) * 1000.0)
+        if self.state.holds > holds_before:
+            _HOLDS.inc(self.state.holds - holds_before)
+        for action in actions:
+            aid = self.journal.next_id(action.get("action", "act"))
+            self.actuate(aid, action, self.lease.epoch)
+        return actions
+
+    def run(self, ticks=0, stop=None):
+        """The process loop: tick every ``interval`` seconds until
+        ``ticks`` are done (0 = forever) or ``stop`` (an Event) is
+        set."""
+        done = 0
+        while not (stop is not None and stop.is_set()):
+            self.tick()
+            done += 1
+            if ticks and done >= ticks:
+                break
+            self._sleep(self.interval)
+        return done
+
+    # -- actuation ------------------------------------------------------
+    def replay(self):
+        """Re-submit every journaled intent without a terminal verdict
+        — the kill -9 recovery path. Original ids, so the executor's
+        dedupe makes the replay exactly-once."""
+        pending = self.journal.replay()
+        for aid, action, epoch in pending:
+            print("fleet.controller: replaying in-flight action %s %r"
+                  % (aid, action), flush=True)
+            self.actuate(aid, action, epoch, replayed=True)
+        return len(pending)
+
+    def actuate(self, aid, action, epoch, replayed=False):
+        """Journal intent → submit → await verdict, with bounded
+        retry/backoff; every outcome (including timeout) lands in the
+        journal. Returns the terminal verdict string."""
+        kind = action.get("action", "act")
+        now = self._clock()
+        if not replayed:
+            self.journal.intent(aid, action, epoch, now)
+        t0 = time.perf_counter()
+        verdict_doc = None
+        for attempt in range(self.action_retries + 1):
+            if attempt:
+                _RETRIES.inc()
+            try:
+                # the actuation fault point: drop = this attempt's
+                # submit is lost (the verdict wait times out and the
+                # SAME id retries — the idempotence drill);
+                # kind=kill_worker here is the controller killed -9
+                # mid-action, after the intent, before the verdict
+                fired = _fault.fire("ctl.action", op=kind, key=aid)
+            except ConnectionError:
+                fired = "drop"
+            if fired != "drop":
+                self.mailbox.submit(aid, action, epoch)
+            verdict_doc = self.mailbox.wait(
+                aid, timeout=self.action_timeout * (attempt + 1),
+                sleep=self._sleep)
+            if verdict_doc is not None:
+                break
+        name = (verdict_doc or {}).get("verdict", "timeout")
+        if name not in TERMINAL:
+            name = "failed"
+        self.journal.verdict(aid, name,
+                             detail=(verdict_doc or {}).get("detail"),
+                             now=self._clock())
+        _ACTIONS.labels(kind, name).inc()
+        _ACTION_MS.observe((time.perf_counter() - t0) * 1000.0)
+        self.issued += 1
+        print("fleet.controller: %s %s -> %s"
+              % (aid, kind, name), flush=True)
+        return name
+
+    # -- observability --------------------------------------------------
+    def status(self):
+        return {"leader": self.lease.held(self._clock()),
+                "epoch": self.lease.epoch,
+                "ticks": self.ticks, "issued": self.issued,
+                "window": len(self.window),
+                "holds": self.state.holds,
+                "hold_reason": self.state.hold_reason,
+                "journal": self.journal.stats()}
+
+
+def _main(argv=None):
+    import argparse
+    import threading
+    ap = argparse.ArgumentParser(
+        prog="mxtpu.fleet.controller",
+        description="closed-loop autoscaling controller "
+                    "(tools/launch.py --autoscale spawns this)")
+    ap.add_argument("--dir", default=None,
+                    help="autoscale rendezvous dir (default "
+                         "MXTPU_AUTOSCALE_DIR): lease, journal, "
+                         "action mailbox")
+    ap.add_argument("--fleet", default=None,
+                    help="fleet.json path (default "
+                         "<MXTPU_TELEMETRY_DIR>/fleet.json)")
+    ap.add_argument("--interval", type=float, default=None)
+    ap.add_argument("--ticks", type=int, default=0,
+                    help="stop after N ticks (0 = run until killed)")
+    ap.add_argument("--owner", default=None)
+    a = ap.parse_args(argv)
+    directory = a.dir or os.environ.get("MXTPU_AUTOSCALE_DIR")
+    if not directory:
+        ap.error("need --dir or MXTPU_AUTOSCALE_DIR")
+    fleet = a.fleet
+    if not fleet:
+        tdir = os.environ.get("MXTPU_TELEMETRY_DIR")
+        if not tdir:
+            ap.error("need --fleet or MXTPU_TELEMETRY_DIR")
+        fleet = os.path.join(tdir, "fleet.json")
+    os.environ.setdefault("MXTPU_OBS_ROLE", "controller")
+    ctl = Controller(fleet, directory, owner=a.owner,
+                     interval=a.interval)
+    # the controller is one more telemetry row: export + announce so
+    # the aggregator folds it into fleet.json and mxtop renders it
+    from ..obs.telemetry import ensure_exporter
+    ensure_exporter()
+    stop = threading.Event()
+    try:
+        ctl.run(ticks=a.ticks, stop=stop)
+    except KeyboardInterrupt:
+        pass
+    print("fleet.controller: exiting (%s)"
+          % json.dumps(ctl.status(), default=str), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

@@ -1,0 +1,106 @@
+"""The write-ahead action journal: intent → actuate → verdict.
+
+Every controller decision is journaled BEFORE it is submitted to the
+actuator and again when its verdict lands. The journal is append-only
+JSONL, flushed+fsynced per record, tolerant of a torn tail line (a
+crash mid-append loses at most the record being written, never the
+file). On restart :meth:`ActionJournal.replay` returns every action
+with an intent but no terminal verdict — the controller re-submits
+them under their ORIGINAL ids, and the executor's id-keyed dedupe
+(mxtpu/fleet/actuator.py) makes the replay exactly-once: a controller
+killed -9 between intent and verdict never double-applies.
+
+Record shapes::
+
+    {"rec": "intent",  "id": "a7.add_worker", "seq": 7,
+     "action": {...}, "epoch": 3, "time": t}
+    {"rec": "verdict", "id": "a7.add_worker", "verdict": "ok"|
+     "failed"|"timeout"|"fenced", "detail": ..., "time": t}
+
+Ids are ``a<seq>.<kind>`` with ``seq`` monotone across restarts (the
+replayed journal's max + 1), so a restarted controller can never mint
+an id that collides with a pre-crash in-flight action.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["ActionJournal"]
+
+TERMINAL = ("ok", "failed", "timeout", "fenced")
+
+
+class ActionJournal:
+    def __init__(self, path):
+        self.path = path
+        self._seq = 0
+        self._pending = {}       # id -> (action, epoch) sans verdict
+        self._verdicts = {}      # id -> verdict string
+        if os.path.exists(path):
+            self._load()
+
+    def _load(self):
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue     # torn tail: the crash ate this record
+                if rec.get("rec") == "intent":
+                    self._seq = max(self._seq, int(rec.get("seq", 0)))
+                    self._pending[rec["id"]] = (rec.get("action"),
+                                                rec.get("epoch", 0))
+                elif rec.get("rec") == "verdict":
+                    self._pending.pop(rec.get("id"), None)
+                    self._verdicts[rec.get("id")] = rec.get("verdict")
+
+    def _append(self, rec):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def next_id(self, kind):
+        self._seq += 1
+        return "a%d.%s" % (self._seq, kind)
+
+    def intent(self, action_id, action, epoch, now=None):
+        """Write-ahead: MUST land before the mailbox submit."""
+        self._append({"rec": "intent", "id": action_id,
+                      "seq": self._seq, "action": action,
+                      "epoch": epoch, "time": now})
+        self._pending[action_id] = (action, epoch)
+
+    def verdict(self, action_id, verdict, detail=None, now=None):
+        if verdict not in TERMINAL:
+            raise ValueError("verdict %r not terminal (%s)"
+                             % (verdict, "/".join(TERMINAL)))
+        self._append({"rec": "verdict", "id": action_id,
+                      "verdict": verdict, "detail": detail,
+                      "time": now})
+        self._pending.pop(action_id, None)
+        self._verdicts[action_id] = verdict
+
+    def replay(self):
+        """(id, action, epoch) for every intent without a terminal
+        verdict, in seq order — the crash-recovery work list."""
+        def seq_of(aid):
+            try:
+                return int(aid.split(".", 1)[0][1:])
+            except (ValueError, IndexError):
+                return 0
+        return [(aid, act, ep) for aid, (act, ep)
+                in sorted(self._pending.items(),
+                          key=lambda kv: seq_of(kv[0]))]
+
+    def stats(self):
+        counts = {}
+        for v in self._verdicts.values():
+            counts[v] = counts.get(v, 0) + 1
+        return {"seq": self._seq, "pending": len(self._pending),
+                "verdicts": counts}

@@ -1,0 +1,387 @@
+"""The autoscaling decision core: pure, deterministic, unit-testable.
+
+:func:`summarize` parses one ``fleet.json`` document into a compact
+*frame* (per-role rows + headline rates); :func:`decide` maps a window
+of frames to an action list. Nothing here reads a wall clock, an env
+var (config is bound once at construction), a socket or a file — the
+fault matrix and the table-driven tests in ``tests/test_fleet.py``
+replay canned windows and assert exact action sequences.
+
+Safety properties the tests pin:
+
+* **hysteresis** — scale-up and scale-down thresholds are separated
+  bands, so a signal sitting on one threshold can never flap;
+* **confirmation** — a condition must hold over ``confirm_ticks``
+  consecutive frames before it acts (a one-tick spike is noise);
+* **cooldown** — per-action-kind minimum spacing, so one decision's
+  effect is observed before the next;
+* **rate limit** — a global cap on actions per sliding window: a
+  noisy signal can never thrash the fleet;
+* **bounds** — min/max workers / replicas / shards are hard clamps;
+* **hold-last-decision** — when telemetry itself is suspect (the
+  aggregator's sweep sequence stopped advancing, or the newest frame
+  is older than ``stale_sweeps``) the policy emits NOTHING: a gapped
+  poll degrades to holding the current capacity, never to a panic
+  scale-down.
+
+The "shard dead vs aggregator slow" distinction rides the monotone
+``seq`` + per-row ``age_sweeps`` stamps the aggregator puts on every
+row (mxtpu/obs/telemetry.py): a row whose age grows while the document
+sequence advances is genuinely unreachable (its capacity is excluded
+and shard actions are suppressed); a document whose sequence stopped
+advancing means the *observer* is behind, and everything holds.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["PolicyConfig", "PolicyState", "summarize", "decide",
+           "ACTIONS"]
+
+ACTIONS = ("add_worker", "remove_worker", "add_replica",
+           "drain_replica", "split_shard")
+
+
+def _envf(name, default):
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _envi(name, default):
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class PolicyConfig:
+    """Bounds, bands and pacing for :func:`decide`. ``from_env`` binds
+    the ``MXTPU_AUTOSCALE_*`` knobs once (docs/env_vars.md); tests
+    construct directly with keywords."""
+
+    _DEFAULTS = dict(
+        min_workers=1, max_workers=4,
+        min_replicas=1, max_replicas=4,
+        max_shards=4,
+        target_steps_s=0.0,          # 0 = worker scaling off
+        band=0.25,                   # hysteresis fraction around target
+        up_queue=8.0, down_queue=1.0,
+        up_rps=50.0, down_rps=5.0,   # per-replica request rates
+        p99_ms=0.0,                  # 0 = latency trigger off
+        split_skew=4.0,              # max/mean shard push-rate ratio
+        split_min_push_s=50.0,
+        cooldown_s=10.0,
+        rate_max=2, rate_window_s=30.0,
+        confirm_ticks=2,
+        stale_sweeps=3,
+        window=8,
+    )
+
+    def __init__(self, **kw):
+        for k, v in self._DEFAULTS.items():
+            setattr(self, k, kw.pop(k, v))
+        if kw:
+            raise TypeError("unknown policy knobs %r" % sorted(kw))
+        self.confirm_ticks = max(1, int(self.confirm_ticks))
+        self.window = max(self.confirm_ticks + 1, int(self.window))
+
+    @classmethod
+    def from_env(cls):
+        return cls(
+            min_workers=_envi("MXTPU_AUTOSCALE_MIN_WORKERS", 1),
+            max_workers=_envi("MXTPU_AUTOSCALE_MAX_WORKERS", 4),
+            min_replicas=_envi("MXTPU_AUTOSCALE_MIN_REPLICAS", 1),
+            max_replicas=_envi("MXTPU_AUTOSCALE_MAX_REPLICAS", 4),
+            max_shards=_envi("MXTPU_AUTOSCALE_MAX_SHARDS", 4),
+            target_steps_s=_envf("MXTPU_AUTOSCALE_TARGET_STEPS_S", 0.0),
+            band=_envf("MXTPU_AUTOSCALE_BAND", 0.25),
+            up_queue=_envf("MXTPU_AUTOSCALE_UP_QUEUE", 8.0),
+            down_queue=_envf("MXTPU_AUTOSCALE_DOWN_QUEUE", 1.0),
+            up_rps=_envf("MXTPU_AUTOSCALE_UP_RPS", 50.0),
+            down_rps=_envf("MXTPU_AUTOSCALE_DOWN_RPS", 5.0),
+            p99_ms=_envf("MXTPU_AUTOSCALE_P99_MS", 0.0),
+            split_skew=_envf("MXTPU_AUTOSCALE_SPLIT_SKEW", 4.0),
+            split_min_push_s=_envf("MXTPU_AUTOSCALE_SPLIT_MIN_PUSH_S",
+                                   50.0),
+            cooldown_s=_envf("MXTPU_AUTOSCALE_COOLDOWN_S", 10.0),
+            rate_max=_envi("MXTPU_AUTOSCALE_RATE_MAX", 2),
+            rate_window_s=_envf("MXTPU_AUTOSCALE_RATE_WINDOW_S", 30.0),
+            confirm_ticks=_envi("MXTPU_AUTOSCALE_CONFIRM_TICKS", 2),
+            stale_sweeps=_envi("MXTPU_AUTOSCALE_STALE_SWEEPS", 3),
+        )
+
+
+class PolicyState:
+    """What :func:`decide` carries between ticks: cooldown stamps, the
+    rate-limiter window, the last document sequence seen, and the hold
+    counter the fault-matrix rows assert on."""
+
+    def __init__(self):
+        self.last = {}       # action kind -> time it was last issued
+        self.recent = []     # issue times inside the rate window
+        self.last_seq = None
+        self.holds = 0
+        self.hold_reason = None
+
+    def snapshot(self):
+        return {"last": dict(self.last), "recent": list(self.recent),
+                "last_seq": self.last_seq, "holds": self.holds,
+                "hold_reason": self.hold_reason}
+
+
+def _rate(history, addr, field):
+    """Counter delta / time delta across the history ring for ``addr``
+    (mxtop's rate rule); None without two usable points."""
+    pts = [(h.get("time"), (h.get("counters") or {}).get(addr))
+           for h in history if (h.get("counters") or {}).get(addr)]
+    if len(pts) < 2:
+        return None
+    (t0, c0), (t1, c1) = pts[0], pts[-1]
+    if t0 is None or t1 is None or t1 <= t0:
+        return None
+    return max(0.0, (c1.get(field, 0) - c0.get(field, 0)) / (t1 - t0))
+
+
+def _fam_total(snap, name):
+    fam = (snap.get("metrics") or {}).get(name)
+    if not fam:
+        return None
+    vals = list(fam["series"].values())
+    if fam["kind"] == "histogram":
+        return sum(v["count"] for v in vals)
+    return sum(vals)
+
+
+def _fam_pct(snap, name, key):
+    fam = (snap.get("metrics") or {}).get(name)
+    if not fam:
+        return None
+    vals = [v.get(key) for v in fam["series"].values()
+            if isinstance(v, dict) and v.get(key) is not None]
+    return max(vals) if vals else None
+
+
+def _view(snap, prefix):
+    for key, v in sorted((snap.get("views") or {}).items()):
+        if key.split("#")[0] == prefix and isinstance(v, dict):
+            return v
+    return None
+
+
+def summarize(doc):
+    """One ``fleet.json`` document → one policy frame. Pure parsing;
+    roles come from each row's ``role`` stamp (gap rows carry the
+    last-known role so a dead shard is still classified as a shard)."""
+    history = doc.get("history") or []
+    frame = {"seq": doc.get("seq", doc.get("sweeps", 0)),
+             "time": doc.get("time", 0.0),
+             "workers": {}, "replicas": {}, "shards": {},
+             "controllers": {}, "gaps": {}}
+    for addr, snap in sorted((doc.get("fleet") or {}).items()):
+        if not isinstance(snap, dict):
+            continue
+        age = snap.get("age_sweeps", 0) or 0
+        role = snap.get("role") or "?"
+        if snap.get("gap"):
+            frame["gaps"][addr] = {"age": age, "role": role}
+            continue
+        if role == "server" or _view(snap, "kv.server") is not None:
+            kvs = _view(snap, "kv.server") or {}
+            frame["shards"][addr] = {
+                "age": age,
+                "push_s": _rate(history, addr, "pushes"),
+                "keys": kvs.get("keys"),
+                "shard_role": kvs.get("role", "primary"),
+                "stragglers": kvs.get("stragglers") or [],
+            }
+        elif role == "serving":
+            frame["replicas"][addr] = {
+                "age": age,
+                "queue": _fam_total(snap, "serve.batch.queued") or 0,
+                "req_s": _rate(history, addr, "requests"),
+                "resp_s": _rate(history, addr, "responses"),
+                "p99": _fam_pct(snap, "serve.request_ms", "p99"),
+            }
+        elif role == "controller":
+            frame["controllers"][addr] = {"age": age}
+        else:
+            frame["workers"][addr] = {
+                "age": age,
+                "pid": snap.get("pid"),
+                "step_s": _rate(history, addr, "steps"),
+            }
+    return frame
+
+
+def _live(rows, cfg):
+    """Rows young enough to count as capacity."""
+    return {a: r for a, r in rows.items()
+            if (r.get("age") or 0) <= cfg.stale_sweeps}
+
+
+def _confirmed(window, cfg, pred):
+    """True when ``pred(frame)`` holds over the last confirm_ticks
+    frames — the spike/flap suppressor."""
+    need = cfg.confirm_ticks
+    if len(window) < need:
+        return False
+    return all(pred(f) for f in window[-need:])
+
+
+def decide(window, state, cfg, now):
+    """(frames, state, config, injected clock) → (actions, state).
+
+    ``window`` is the chronological list of frames (oldest first,
+    newest last); ``now`` is the controller's clock — the only time
+    source the pacing machinery sees. Returns the action list for this
+    tick (possibly empty) and the updated state. Deterministic: same
+    inputs, same output, no ambient reads."""
+    if not window:
+        state.holds += 1
+        state.hold_reason = "no telemetry"
+        return [], state
+    newest = window[-1]
+    # -- hold-last-decision: the observer itself is suspect ------------
+    if state.last_seq is not None and newest["seq"] <= state.last_seq:
+        state.holds += 1
+        state.hold_reason = "sweep seq not advancing (aggregator slow)"
+        return [], state
+    state.last_seq = newest["seq"]
+    state.hold_reason = None
+
+    workers = _live(newest["workers"], cfg)
+    replicas = _live(newest["replicas"], cfg)
+    shards = _live(newest["shards"], cfg)
+    n_workers = len(workers)
+    n_replicas = len(replicas)
+    n_shards = len([a for a, s in shards.items()
+                    if s.get("shard_role") != "backup"])
+
+    state.recent = [t for t in state.recent
+                    if now - t < cfg.rate_window_s]
+    actions = []
+
+    def ready(kind):
+        if len(actions) + len(state.recent) >= cfg.rate_max:
+            return False
+        last = state.last.get(kind)
+        return last is None or now - last >= cfg.cooldown_s
+
+    def issue(kind, **fields):
+        actions.append(dict({"action": kind}, **fields))
+        state.last[kind] = now
+        state.recent.append(now)
+
+    # -- serving: queue/latency pressure up, idle band down ------------
+    def serve_pressure(f):
+        rs = _live(f["replicas"], cfg)
+        if not rs:
+            return False
+        queue = sum(r["queue"] for r in rs.values())
+        rps = sum(r["req_s"] or 0.0 for r in rs.values()) / len(rs)
+        p99 = max((r["p99"] or 0.0 for r in rs.values()), default=0.0)
+        return (queue > cfg.up_queue or rps > cfg.up_rps
+                or (cfg.p99_ms > 0 and p99 > cfg.p99_ms))
+
+    def serve_idle(f):
+        rs = _live(f["replicas"], cfg)
+        if not rs:
+            return False
+        queue = sum(r["queue"] for r in rs.values())
+        rates = [r["req_s"] for r in rs.values()]
+        if any(r is None for r in rates):
+            return False         # no rate yet: never scale down blind
+        rps = sum(rates) / len(rs)
+        return queue <= cfg.down_queue and rps < cfg.down_rps
+
+    if n_replicas and n_replicas < cfg.max_replicas \
+            and ready("add_replica") \
+            and _confirmed(window, cfg, serve_pressure):
+        issue("add_replica")
+    elif n_replicas > cfg.min_replicas and ready("drain_replica") \
+            and _confirmed(window, cfg, serve_idle):
+        # drain the highest address: deterministic victim selection
+        issue("drain_replica", addr=sorted(replicas)[-1])
+
+    # -- workers: throughput band around the configured target ---------
+    if cfg.target_steps_s > 0:
+        def starving(f):
+            ws = _live(f["workers"], cfg)
+            rates = [w["step_s"] for w in ws.values()]
+            if not rates or any(r is None for r in rates):
+                return False
+            return sum(rates) < cfg.target_steps_s * (1.0 - cfg.band)
+
+        def overshooting(f):
+            ws = _live(f["workers"], cfg)
+            rates = [w["step_s"] for w in ws.values()]
+            if len(rates) < 2 or any(r is None for r in rates):
+                return False
+            return sum(rates) > cfg.target_steps_s * (1.0 + cfg.band)
+
+        if n_workers < cfg.max_workers and ready("add_worker") \
+                and _confirmed(window, cfg, starving):
+            issue("add_worker")
+        elif n_workers > cfg.min_workers and ready("remove_worker") \
+                and _confirmed(window, cfg, overshooting):
+            victim = sorted(workers)[-1]
+            issue("remove_worker", pid=workers[victim].get("pid"))
+
+    # -- straggler eviction: the servers' push-count verdict -----------
+    if n_workers > cfg.min_workers and ready("remove_worker"):
+        def straggler_set(f):
+            out = set()
+            for s in _live(f["shards"], cfg).values():
+                for entry in s.get("stragglers") or []:
+                    out.add(tuple(entry) if isinstance(entry, list)
+                            else entry)
+            return out
+
+        persistent = None
+        for f in window[-cfg.confirm_ticks:]:
+            cur = straggler_set(f)
+            persistent = cur if persistent is None else \
+                (persistent & cur)
+        if persistent and len(window) >= cfg.confirm_ticks:
+            origin = sorted(persistent)[0]
+            rank = origin[1] if isinstance(origin, tuple) \
+                and len(origin) > 1 else None
+            issue("remove_worker", rank=rank,
+                  origin=list(origin) if isinstance(origin, tuple)
+                  else origin, reason="straggler")
+
+    # -- hot shard: sustained push-rate skew → online split ------------
+    # any gapped shard row is a reason for caution, not action: while a
+    # shard's reachability is in question the key map must not churn
+    shard_gaps = [g for g in newest["gaps"].values()
+                  if g.get("role") == "server"]
+    if not shard_gaps and shards and n_shards < cfg.max_shards \
+            and ready("split_shard"):
+        def skewed(f):
+            ss = {a: s for a, s in _live(f["shards"], cfg).items()
+                  if s.get("shard_role") != "backup"}
+            rates = {a: s["push_s"] for a, s in ss.items()}
+            if not rates or any(r is None for r in rates.values()):
+                return False
+            top = max(rates.values())
+            mean = sum(rates.values()) / len(rates)
+            if top < cfg.split_min_push_s:
+                return False
+            # a single shard carrying real load is definitionally hot;
+            # with siblings, demand the skew ratio
+            hot = ss[max(rates, key=rates.get)]
+            if (hot.get("keys") or 0) < 2:
+                return False     # nothing to split
+            return len(rates) == 1 or (mean > 0
+                                       and top / mean >= cfg.split_skew)
+
+        if _confirmed(window, cfg, skewed):
+            primaries = {a: s for a, s in shards.items()
+                         if s.get("shard_role") != "backup"}
+            hot = max(primaries,
+                      key=lambda a: primaries[a]["push_s"] or 0.0)
+            issue("split_shard", src_addr=hot)
+
+    return actions, state

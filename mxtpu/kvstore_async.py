@@ -2416,7 +2416,24 @@ class ParameterServer:
                 and not self._repl.dead else None
         with self._workers_lock:
             n_workers = len(self._workers)
+            # this shard's push-count straggler verdict, same rule as
+            # the fleet view (_fleet_worker_view) but computable from
+            # ONE shard's registry row — what the autoscaling policy
+            # reads from fleet.json (mxtpu/fleet/policy.py evicts only
+            # workers EVERY live shard calls a straggler, confirmed
+            # over several sweeps)
+            stragglers = []
+            if self._workers:
+                lead = max(w.get("pushes", 0)
+                           for w in self._workers.values())
+                if lead >= _STRAGGLER_MIN:
+                    stragglers = sorted(
+                        [o, w.get("rank")]
+                        for o, w in self._workers.items()
+                        if w.get("pushes", 0) * _STRAGGLER_FACTOR
+                        < lead)
         return {"addr": self.address, "role": self._role,
+                "stragglers": stragglers,
                 "pushes": self._stale_n, "dup_pushes": self._dup_n,
                 "sparse_pushes": self._sparse_pushes,
                 "keys": len(self._table), "workers": n_workers,

@@ -154,6 +154,7 @@ class ProgramCache:
         self._lock = threading.Lock()
         self.compiles = 0
         self.hits = 0
+        self.imports = 0
 
     def get(self, key, build):
         """The program for ``key``, building (and counting a compile)
@@ -184,7 +185,74 @@ class ProgramCache:
     def stats(self):
         with self._lock:
             return {"programs": len(self._programs),
-                    "compiles": self.compiles, "hits": self.hits}
+                    "compiles": self.compiles, "hits": self.hits,
+                    "imports": self.imports}
+
+    # -- AOT program export/import (ISSUE 16 prewarm) -------------------
+    # A joiner that can LOAD a peer's compiled executables skips the
+    # cold compile entirely: `jax.experimental.serialize_executable`
+    # round-trips an AOT-compiled program (XLA serialized executable +
+    # pickled in/out trees), and the cache file is just a pickle of
+    # {key: serialized-program}. Entries that are not serializable
+    # executables (training closures) are skipped on export, so the
+    # same cache class serves both the fused trainer and the serving
+    # engine unchanged.
+
+    def export_to(self, path, meta=None):
+        """Serialize every exportable compiled entry to ``path``
+        (atomic tmp + rename); returns how many entries landed, 0 when
+        nothing in the cache can be serialized (no file written)."""
+        import pickle
+        from jax.experimental import serialize_executable as _se
+        with self._lock:
+            items = list(self._programs.items())
+        programs = {}
+        for key, entry in items:
+            try:
+                programs[key] = pickle.dumps(_se.serialize(entry))
+            except Exception:
+                continue         # not an AOT executable: skip, no harm
+        if not programs:
+            return 0
+        doc = {"meta": meta, "programs": programs}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "wb") as f:
+            pickle.dump(doc, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return len(programs)
+
+    def import_from(self, path, expect_meta=None):
+        """Load a peer's exported programs into this cache; returns
+        the number imported (cached keys are never overwritten, so a
+        warm cache imports 0). Raises ``ValueError`` when the file's
+        meta fingerprint does not match ``expect_meta`` — a prewarm
+        file from a different model/signature must never install."""
+        import pickle
+        from jax.experimental import serialize_executable as _se
+        with open(path, "rb") as f:
+            doc = pickle.load(f)
+        if expect_meta is not None and doc.get("meta") != expect_meta:
+            raise ValueError(
+                "program cache %s was exported for a different "
+                "signature (meta mismatch)" % path)
+        imported = 0
+        for key, blob in (doc.get("programs") or {}).items():
+            with self._lock:
+                if key in self._programs:
+                    continue
+            payload, in_tree, out_tree = pickle.loads(blob)
+            program = _se.deserialize_and_load(payload, in_tree,
+                                               out_tree)
+            with self._lock:
+                if key in self._programs:
+                    continue     # racing warm(): first entry wins
+                self._programs[key] = program
+                self.imports += 1
+                imported += 1
+        return imported
 
 
 def metric_readback_interval():

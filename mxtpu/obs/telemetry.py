@@ -147,7 +147,8 @@ class TelemetryExporter:
             return None
         epd = os.path.join(directory, "endpoints")
         os.makedirs(epd, exist_ok=True)
-        role = os.environ.get("DMLC_ROLE", "worker")
+        role = os.environ.get("MXTPU_OBS_ROLE") \
+            or os.environ.get("DMLC_ROLE", "worker")
         path = os.path.join(epd, "%s-%d.ep" % (role, os.getpid()))
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -184,7 +185,24 @@ class TelemetryAggregator:
     exporter files, so mid-run joiners appear without restart. A target
     that does not answer contributes a GAP record
     (``{"gap": True, "error": ...}``) and bumps ``gaps`` — a dead
-    shard's telemetry hole is visible, never fatal."""
+    shard's telemetry hole is visible, never fatal.
+
+    Staleness is explicit (ISSUE 16): the document carries a monotone
+    ``seq`` (one per sweep) and every row carries the ``seq`` of the
+    sweep that last heard it plus ``age_sweeps`` since — so a consumer
+    (the autoscaling policy) can tell "this row is dead" (document
+    sequence advances, row age grows) from "the aggregator is behind"
+    (document sequence stopped). Gap rows keep the target's last-known
+    ``role``. An endpoint-derived target that stays gapped is PARKED
+    after 3 sweeps — probed only every 4th sweep so a fleet of exited
+    workers cannot slow every sweep by a connect timeout each — but its
+    row (with growing age) never disappears and its endpoint file is
+    never deleted: a paused-then-resumed exporter comes back as live
+    capacity on the next probe. Explicit targets are never parked:
+    their gap rows ARE the signal."""
+
+    _PARK_AFTER = 3          # consecutive gaps before parking
+    _PARK_PROBE_EVERY = 4    # probe a parked target every Nth sweep
 
     def __init__(self, targets=(), endpoints_dir=None, out=None,
                  interval=None, history=None, token=None,
@@ -202,6 +220,7 @@ class TelemetryAggregator:
         self._conns = {}           # addr -> _ServerConn
         self._ep_files = {}        # endpoint-derived addr -> file
         self._gap_streak = {}      # addr -> consecutive gapped sweeps
+        self._last_ok = {}         # addr -> {"seq", "role"} last heard
         self._history = []         # bounded ring of compact ticks
         self._stop = threading.Event()
         self._thread = None
@@ -232,22 +251,26 @@ class TelemetryAggregator:
         return addrs
 
     def _note_gap_streak(self, addr, gapped):
-        """Prune a DEAD worker's endpoint file after 3 consecutive
-        gapped sweeps: exited workers must not slow every future sweep
-        by a connect timeout each (explicit ``targets`` — PS shards,
-        replicas — are never pruned: their gap rows ARE the signal)."""
+        """Track consecutive gapped sweeps per target. An endpoint-
+        derived target whose streak reaches ``_PARK_AFTER`` is PARKED
+        (probed every ``_PARK_PROBE_EVERY`` sweeps instead of every
+        sweep) — never pruned: deleting the endpoint file used to
+        conflate "worker dead" with "worker paused", and a paused-then-
+        resumed exporter must come back as live capacity. The row's
+        growing ``age_sweeps`` is the dead-capacity signal consumers
+        act on."""
         if not gapped:
             self._gap_streak.pop(addr, None)
             return
-        n = self._gap_streak.get(addr, 0) + 1
-        self._gap_streak[addr] = n
-        path = self._ep_files.get(addr)
-        if n >= 3 and path is not None:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            self._gap_streak.pop(addr, None)
+        self._gap_streak[addr] = self._gap_streak.get(addr, 0) + 1
+
+    def _parked(self, addr):
+        """True when this endpoint-derived target's streak has it on
+        the reduced probe schedule and this sweep is not a probe."""
+        if self._gap_streak.get(addr, 0) < self._PARK_AFTER \
+                or addr not in self._ep_files:
+            return False
+        return (self.sweeps + 1) % self._PARK_PROBE_EVERY != 0
 
     def _poll_one(self, addr):
         from .. import kvstore_async as _ka
@@ -298,6 +321,7 @@ class TelemetryAggregator:
                 "requests": total("serve.requests"),
                 "pushes": total("kv.server.pushes"),
                 "bytes_sent": total("kv.client.bytes_sent"),
+                "actions": total("fleet.controller.actions"),
             }
         return out
 
@@ -312,16 +336,42 @@ class TelemetryAggregator:
 
     def _sweep_locked(self):
         fleet = {}
+        seq = self.sweeps + 1
         for addr in self._discover():
-            snap = self._poll_one(addr)
+            if self._parked(addr):
+                # reduced-rate probing, full-rate visibility: the row
+                # stays in the document with its age still growing
+                last = self._last_ok.get(addr)
+                snap = {"gap": True, "parked": True,
+                        "error": "parked after %d gapped sweeps"
+                                 % self._gap_streak.get(addr, 0)}
+            else:
+                snap = self._poll_one(addr)
+                last = self._last_ok.get(addr)
+            gapped = bool(snap.get("gap"))
+            if gapped:
+                # the staleness stamps consumers reason with: last-seen
+                # sweep + age, and the last-known role so a dead shard
+                # is still classified as a shard
+                snap["seq"] = last["seq"] if last else None
+                snap["age_sweeps"] = (seq - last["seq"]) if last \
+                    else self._gap_streak.get(addr, 0) + 1
+                if last:
+                    snap.setdefault("role", last.get("role"))
+            else:
+                snap["seq"] = seq
+                snap["age_sweeps"] = 0
+                self._last_ok[addr] = {"seq": seq,
+                                       "role": snap.get("role")}
             fleet[addr] = snap
-            self._note_gap_streak(addr, bool(snap.get("gap")))
+            self._note_gap_streak(addr, gapped)
         now = time.time()
         self._history.append({"time": now,
                               "counters": self._tick_summary(fleet)})
         del self._history[:-self._history_len]
         self.sweeps += 1
-        doc = {"time": now, "sweeps": self.sweeps, "gaps": self.gaps,
+        doc = {"time": now, "seq": self.sweeps,
+               "sweeps": self.sweeps, "gaps": self.gaps,
                "interval": self._interval,
                "fleet": fleet, "history": list(self._history)}
         if self._out:

@@ -20,6 +20,11 @@ contract:
                               to follow via the ``weights`` long-poll
                               stream instead of (or next to) the dir
 * ``MXTPU_SERVE_WEIGHT_POLL`` weight-sync tick seconds (default 0.5)
+* ``MXTPU_SERVE_PREWARM_DIR`` shared AOT program-cache dir: a booting
+                              replica imports a peer's exported
+                              program menu (cold start becomes a load,
+                              not a compile) and the first warm
+                              replica exports it (docs/autoscaling.md)
 * plus the batching/admission knobs read by
   :mod:`mxtpu.serving.server` (``MXTPU_SERVE_QUEUE_DEPTH``,
   ``MXTPU_SERVE_BATCH_DEADLINE_MS``, ``MXTPU_SERVE_DEADLINE_MS``).
@@ -63,6 +68,10 @@ def main():
     buckets = os.environ.get("MXTPU_SERVE_BUCKETS", "1,2,4,8,16,32")
     weight_dir = os.environ.get("MXTPU_SERVE_WEIGHT_DIR") or None
     weight_kv = os.environ.get("MXTPU_SERVE_WEIGHT_KV") or None
+    prewarm_dir = os.environ.get("MXTPU_SERVE_PREWARM_DIR") or None
+
+    import time
+    t_boot = time.monotonic()
 
     from . import InferenceEngine, ModelServer, WeightSync, \
         parse_buckets, parse_shape_spec
@@ -72,6 +81,21 @@ def main():
         buckets=parse_buckets(buckets), warm=False)
     srv = ModelServer(engine, port=port,
                       model_name=os.path.basename(prefix))
+
+    # the prewarm contract (docs/autoscaling.md): the FIRST replica
+    # pays the cold compile and publishes its AOT program menu; every
+    # later joiner imports it and warm() only compiles what is missing,
+    # so time-to-serving is a load, not a compile
+    prewarm_path = None
+    imported = 0
+    if prewarm_dir:
+        prewarm_path = os.path.join(
+            prewarm_dir, "%s-e%04d.programs"
+            % (os.path.basename(prefix), epoch))
+        if os.path.exists(prewarm_path):
+            imported = engine.prewarm_from(prewarm_path)
+            print("mxtpu serving replica prewarmed %d program(s) "
+                  "from %s" % (imported, prewarm_path), flush=True)
 
     sync = None
     if weight_dir or weight_kv:
@@ -93,6 +117,19 @@ def main():
     srv.start()     # warms every bucket program before listening
     if sync is not None:
         sync.start()
+    # the measured cold-start number the autoscaling CI pins: wall time
+    # from process boot to a fully-warmed, listening replica
+    print("mxtpu serving replica time-to-serving %.3fs "
+          "(prewarmed=%d compiles=%d)"
+          % (time.monotonic() - t_boot, imported,
+             engine.cache.compiles), flush=True)
+    if prewarm_path and engine.cache.compiles > 0:
+        # first replica (or a stale menu): publish the warmed programs
+        # for the next joiner — atomic write, identical content on a
+        # racing double-export, so last-wins is harmless
+        n = engine.export_programs(prewarm_path)
+        print("mxtpu serving replica exported %d program(s) to %s"
+              % (n, prewarm_path), flush=True)
     print("mxtpu serving replica listening on %s (model=%s buckets=%s)"
           % (srv.address, os.path.basename(prefix),
              ",".join(str(b) for b in engine.buckets)), flush=True)

@@ -602,6 +602,40 @@ class InferenceEngine:
             self.program(b)
         return len(self._buckets)
 
+    # -- prewarm: export/import the AOT program menu (ISSUE 16) --------
+    def program_fingerprint(self):
+        """What makes two engines program-compatible: the wire
+        signature plus every store shape the compiled programs were
+        lowered against. A prewarm file only installs when this
+        matches exactly."""
+        import jax as _jax
+        return {"signature": self.signature(),
+                "params": [[list(s), str(d)]
+                           for s, d in self._param_shapes],
+                "aux": [[list(s), str(d)]
+                        for s, d in self._aux_shapes],
+                "jax": _jax.__version__}
+
+    def export_programs(self, path):
+        """Serialize the warmed program menu for peers; returns the
+        entry count (0 = nothing exportable yet)."""
+        return self.cache.export_to(path,
+                                    meta=self.program_fingerprint())
+
+    def prewarm_from(self, path):
+        """Import a peer's exported programs — the joiner's warm start:
+        every imported bucket skips its cold compile (``warm()``
+        afterwards only builds what is missing). Refusal-tolerant: a
+        missing/mismatched/corrupt file imports 0 and the engine falls
+        back to compiling, never serves a wrong program."""
+        try:
+            return self.cache.import_from(
+                path, expect_meta=self.program_fingerprint())
+        except (OSError, ValueError, EOFError, ImportError) as e:
+            warnings.warn("prewarm import from %s skipped: %s"
+                          % (path, e))
+            return 0
+
     # -- execution ---------------------------------------------------------
     def predict(self, arrays, rows=None):
         """Run one (possibly coalesced) batch against the STABLE

@@ -1,0 +1,190 @@
+"""Diurnal autoscaling driver for the E2E acceptance drill (ISSUE 16).
+
+Launched by tools/launch.py -n 1 -s 1 --serve 1 --serve-max 2
+--autoscale with MXTPU_PS_ELASTIC=1 and the MXTPU_AUTOSCALE_* bands
+tuned so a scripted "day" of load makes every trigger reachable. The
+anchor (rank 0) IS the load generator:
+
+* a pump thread pushes the six keys flat out (the single PS shard's
+  push rate crosses the split band -> the controller splits it online)
+  and bumps ``module.steps`` (the worker fleet's throughput stays
+  under the configured target -> the controller adds a worker);
+* the main loop streams serving requests (~8/s, above the up_rps
+  band -> the controller adds the reserved replica, which PREWARMS
+  from the first replica's exported AOT program menu);
+* when the executor's verdicts show add_worker + add_replica +
+  split_shard all applied, the anchor declares NIGHT: requests stop,
+  the request rate decays through the idle band, and the controller
+  drains the added replica; pushes continue the whole time.
+
+The launcher's ``--autoscale-fault`` kills the controller -9 on its
+FIRST actuation (after the journaled intent, before any verdict); the
+respawned controller replays the journal and the executor's dedupe
+keeps the replay exactly-once — the pytest side asserts it from the
+launcher transcript.
+
+Zero acknowledged loss is asserted HERE: every acked push is counted
+as it returns, and at the end every key's server-side clock must equal
+its count exactly — across the online split, the reroutes, the
+controller kill, and every capacity change. A joiner (rank >= 1,
+MXTPU_ELASTIC_JOINER=1) hellos into the membership, idles as a live
+fleet row, and leaves cleanly at night.
+"""
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx                                           # noqa: E402
+from mxtpu import obs                                        # noqa: E402
+
+rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+joiner = os.environ.get("MXTPU_ELASTIC_JOINER", "0") == "1"
+out_dir = os.environ["AUTOSCALE_TEST_DIR"]
+night_marker = os.path.join(out_dir, "night")
+
+KEYS = ["w%d" % i for i in range(6)]
+DIM = 4
+STEPS = obs.metrics.counter("module.steps")
+
+
+def ok_verdicts():
+    """{action kind: [action ids]} of every OK verdict the launcher's
+    executor has recorded — the driver's view of what the controller
+    actually actuated."""
+    vdir = os.path.join(os.environ["MXTPU_AUTOSCALE_DIR"], "verdicts")
+    out = {}
+    try:
+        names = os.listdir(vdir)
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(vdir, fn)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("verdict") != "ok":
+            continue
+        aid = fn[:-5]
+        kind = aid.split(".", 1)[1] if "." in aid else aid
+        out.setdefault(kind, []).append(aid)
+    return out
+
+
+def main_joiner():
+    flag = {"stop": False}
+    signal.signal(signal.SIGTERM,
+                  lambda *_: flag.__setitem__("stop", True))
+    kv = mx.kv.create("dist_async")      # hello: a REAL membership join
+    print("worker %d joined mid-run" % rank, flush=True)
+    deadline = time.time() + 180
+    while not flag["stop"] and not os.path.exists(night_marker) \
+            and time.time() < deadline:
+        STEPS.inc()                      # a live (if unhurried) row
+        time.sleep(0.1)
+    kv.close()
+    print("RANK_%d_OK" % rank, flush=True)
+    return 0
+
+
+def main_anchor():
+    from mxtpu.serving import ServingClient
+    kv = mx.kv.create("dist_async")
+    kv.init(KEYS, [mx.nd.zeros((DIM,)) for _ in KEYS])
+    # pin the client to the first (live) replica: the reserved slot's
+    # address is advertised but nothing listens there until the
+    # controller adds it
+    cli = ServingClient(
+        addrs=os.environ["MXTPU_SERVE_ADDRS"].split(",")[:1])
+    cli.hello()
+
+    counted = {k: 0 for k in KEYS}
+    stop = threading.Event()
+
+    def pump():
+        # the diurnal base load: hot pushes (split pressure) + a step
+        # counter pace that stays under the autoscale target (worker
+        # pressure). Counting AFTER each push returns is what "acked"
+        # means — the zero-loss ledger.
+        while not stop.is_set():
+            for k in KEYS:
+                kv.push(k, mx.nd.ones((DIM,)))
+                counted[k] += 1
+            STEPS.inc(3)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+
+    # -- day: serve traffic until the controller has added a worker,
+    # added the reserved replica, and split the hot shard -------------
+    want_day = {"add_worker", "add_replica", "split_shard"}
+    x = np.random.RandomState(7).rand(1, 6).astype("f")
+    deadline = time.time() + 240
+    while not want_day <= set(ok_verdicts()):
+        if time.time() > deadline:
+            stop.set()
+            raise AssertionError(
+                "day actions never all landed: %r" % ok_verdicts())
+        try:
+            cli.predict(x)               # ~8 req/s: over the up band
+        except Exception:
+            pass                         # replica churn is the drill
+        time.sleep(0.12)
+
+    # -- night: the request stream stops; the idle band drains the
+    # added replica. Pushes keep flowing the whole time. --------------
+    tmp = night_marker + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(time.time()))
+    os.replace(tmp, night_marker)
+    print("autoscale driver: night (day verdicts %r)"
+          % sorted(ok_verdicts()), flush=True)
+    deadline = time.time() + 150
+    while "drain_replica" not in ok_verdicts():
+        if time.time() > deadline:
+            stop.set()
+            raise AssertionError(
+                "the idle band never drained a replica: %r"
+                % ok_verdicts())
+        time.sleep(0.2)
+
+    stop.set()
+    t.join(timeout=30)
+    assert not t.is_alive(), "the push pump never stopped"
+
+    # -- the ledger: every acked push applied exactly once ------------
+    clocks = kv.staleness_stats()["clocks"]
+    bad = {k: (clocks.get(k), counted[k]) for k in KEYS
+           if clocks.get(k) != counted[k]}
+    assert not bad, ("acked updates lost or double-applied across the "
+                     "autoscale run: %r" % (bad,))
+    summary = {
+        "counted": counted,
+        "clocks": {k: clocks.get(k) for k in KEYS},
+        "clocks_exact": not bad,
+        "total_acked": sum(counted.values()),
+        "map_reroutes": kv.stats()["map_reroutes"],
+        "verdicts": {k: sorted(v) for k, v in ok_verdicts().items()},
+    }
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    cli.close()
+    kv.close()
+    print("RANK_0_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main_joiner() if joiner or rank != 0 else main_anchor())

@@ -683,3 +683,55 @@ def test_guard_elastic_callback_fires_on_schedule():
     assert seen == [("join_worker", 1), ("split_shard", 4)]
     assert guard.stats()["elastic_signals"] == 2
     assert guard.stats()["good_steps"] == 5
+
+
+# ---------------------------------------------------------------------------
+# idempotent scale actuation (ISSUE 16 satellite): tools/launch.py routes
+# every --scale event (and every autoscale-controller action) through one
+# id-keyed ActionExecutor — re-issuing an event after an ambiguous
+# timeout returns the recorded verdict instead of double-applying
+# ---------------------------------------------------------------------------
+
+def test_reissued_add_worker_event_does_not_double_apply(tmp_path):
+    from mxtpu.fleet.actuator import ActionExecutor
+    spawned = []
+    ex = ActionExecutor(str(tmp_path),
+                        {"add_worker": lambda a: spawned.append(a) or
+                         {"rank": len(spawned)}}, verbose=False)
+    ev = {"action": "add_worker", "after": "1"}
+    # the launcher derives the id from the event's position, so the
+    # SAME drill event re-issued (ambiguous timeout, operator retry)
+    # lands on the same id
+    v1 = ex.execute("scale-0-add_worker", dict(ev))
+    v2 = ex.execute("scale-0-add_worker", dict(ev))
+    assert v1["verdict"] == v2["verdict"] == "ok"
+    assert len(spawned) == 1
+    # a DIFFERENT event applies normally
+    ex.execute("scale-1-add_worker", dict(ev))
+    assert len(spawned) == 2
+
+
+def test_reissued_split_shard_event_does_not_double_split(tmp_path):
+    from mxtpu.fleet.actuator import ActionExecutor
+    splits = []
+
+    def do_split(action):
+        splits.append(action.get("src", "0"))
+        return {"src": action.get("src", "0"), "dst": "127.0.0.1:9999"}
+
+    ex = ActionExecutor(str(tmp_path), {"split_shard": do_split},
+                        verbose=False)
+    ev = {"action": "split_shard", "src": "0", "after": "2"}
+    v1 = ex.execute("scale-0-split_shard", dict(ev))
+    # retry after an ambiguous timeout: the recorded verdict comes
+    # back, the split does NOT run twice (a double split would strand
+    # half the keys on a shard nobody routes to)
+    v2 = ex.execute("scale-0-split_shard", dict(ev))
+    assert splits == ["0"]
+    assert v2["detail"]["dst"] == v1["detail"]["dst"]
+    # and across a launcher restart the verdict record still holds
+    ex2 = ActionExecutor(str(tmp_path), {"split_shard": do_split},
+                         verbose=False)
+    assert ex2.execute("scale-0-split_shard",
+                       dict(ev))["verdict"] == "ok"
+    assert splits == ["0"]

@@ -1,0 +1,645 @@
+"""Autoscaling controller unit tier (mxtpu/fleet/, docs/autoscaling.md).
+
+Table-driven policy tests replay canned fleet.json frame windows (ramp,
+spike, flap, straggler, hot shard, dead shard, gapped aggregator) and
+assert EXACT action sequences — including what the cooldown, hysteresis,
+confirmation and rate-limit machinery must suppress. The journal /
+executor / lease tests pin the exactly-once actuation protocol, and the
+fault-matrix rows drive the ``ctl.poll`` / ``ctl.action`` points:
+
+* a dropped actuation retries under the SAME id and never double-applies
+  (``point=ctl.action``);
+* a gapped telemetry poll degrades to hold-last-decision — never a
+  panic scale-down (``point=ctl.poll``).
+
+Everything here is in-process and clock-injected: no subprocesses, no
+sleeps, fast tier. The process-level drills (controller kill -9 replay,
+prewarmed joiner, diurnal load) live in ci/check_autoscale.py and
+tests/test_dist_launch.py.
+"""
+import json
+import os
+
+import pytest
+
+from mxtpu import fault
+from mxtpu.fleet.actuator import ActionExecutor, ActionMailbox, Lease
+from mxtpu.fleet.controller import Controller
+from mxtpu.fleet.journal import ActionJournal
+from mxtpu.fleet.policy import (PolicyConfig, PolicyState, decide,
+                                summarize)
+
+
+# ---------------------------------------------------------------------------
+# frame builders: the policy consumes summarize() output, so tests build
+# frames in exactly that shape
+# ---------------------------------------------------------------------------
+
+def frame(seq, workers=None, replicas=None, shards=None, gaps=None):
+    return {"seq": seq, "time": float(seq),
+            "workers": workers or {}, "replicas": replicas or {},
+            "shards": shards or {}, "controllers": {},
+            "gaps": gaps or {}}
+
+
+def replica(queue=0, req_s=0.0, p99=None, age=0):
+    return {"age": age, "queue": queue, "req_s": req_s,
+            "resp_s": req_s, "p99": p99}
+
+
+def worker(step_s=None, pid=None, age=0):
+    return {"age": age, "pid": pid, "step_s": step_s}
+
+
+def shard(push_s=None, keys=10, role="primary", stragglers=(), age=0):
+    return {"age": age, "push_s": push_s, "keys": keys,
+            "shard_role": role, "stragglers": list(stragglers)}
+
+
+def run_ticks(frames_per_tick, cfg, dt=1.0):
+    """Feed decide() one growing window per tick (advancing clock) and
+    return the per-tick action lists — the table-test harness."""
+    state = PolicyState()
+    window = []
+    out = []
+    now = 0.0
+    for f in frames_per_tick:
+        window.append(f)
+        del window[:-cfg.window]
+        actions, state = decide(list(window), state, cfg, now)
+        out.append(actions)
+        now += dt
+    return out, state
+
+
+# ---------------------------------------------------------------------------
+# policy: scale-up / scale-down with confirmation + hysteresis
+# ---------------------------------------------------------------------------
+
+def test_ramp_adds_replica_only_after_confirmation():
+    cfg = PolicyConfig(confirm_ticks=2)
+    f = lambda s: frame(s, replicas={"r1": replica(queue=20)})  # noqa: E731
+    out, _ = run_ticks([f(1), f(2)], cfg)
+    # tick 1: pressure seen once — NOT confirmed; tick 2: confirmed
+    assert out[0] == []
+    assert [a["action"] for a in out[1]] == ["add_replica"]
+
+
+def test_one_tick_spike_is_noise():
+    cfg = PolicyConfig(confirm_ticks=2)
+    seqs = [frame(1, replicas={"r1": replica(queue=0, req_s=1.0)}),
+            frame(2, replicas={"r1": replica(queue=30, req_s=1.0)}),
+            frame(3, replicas={"r1": replica(queue=0, req_s=1.0)}),
+            frame(4, replicas={"r1": replica(queue=30, req_s=1.0)})]
+    out, _ = run_ticks(seqs, cfg)
+    assert all(a == [] for a in out), out
+
+
+def test_hysteresis_band_never_flaps():
+    # queue between down_queue(1) and up_queue(8), rps between
+    # down_rps(5) and up_rps(50): inside the dead band, forever
+    cfg = PolicyConfig(confirm_ticks=2, min_replicas=1)
+    seqs = [frame(s, replicas={"r1": replica(queue=4, req_s=20.0),
+                               "r2": replica(queue=4, req_s=20.0)})
+            for s in range(1, 7)]
+    out, _ = run_ticks(seqs, cfg)
+    assert all(a == [] for a in out), out
+
+
+def test_idle_drains_highest_replica_respecting_min():
+    cfg = PolicyConfig(confirm_ticks=2, min_replicas=1)
+    two = {"r1": replica(queue=0, req_s=0.5),
+           "r2": replica(queue=0, req_s=0.5)}
+    out, _ = run_ticks([frame(1, replicas=two),
+                        frame(2, replicas=two)], cfg)
+    assert out[1] == [{"action": "drain_replica", "addr": "r2"}]
+    # at the min bound the same signal yields nothing
+    one = {"r1": replica(queue=0, req_s=0.5)}
+    out, _ = run_ticks([frame(1, replicas=one),
+                        frame(2, replicas=one)], cfg)
+    assert all(a == [] for a in out)
+
+
+def test_unknown_rate_never_scales_down():
+    # req_s None = no history yet: scaling down blind is forbidden
+    cfg = PolicyConfig(confirm_ticks=1, min_replicas=1)
+    rs = {"r1": replica(queue=0, req_s=None),
+          "r2": replica(queue=0, req_s=None)}
+    out, _ = run_ticks([frame(1, replicas=rs)], cfg)
+    assert out == [[]]
+
+
+def test_max_replicas_clamps_scale_up():
+    cfg = PolicyConfig(confirm_ticks=1, max_replicas=2)
+    rs = {"r1": replica(queue=50), "r2": replica(queue=50)}
+    out, _ = run_ticks([frame(1, replicas=rs)], cfg)
+    assert out == [[]]
+
+
+# ---------------------------------------------------------------------------
+# policy: cooldown + rate limiter
+# ---------------------------------------------------------------------------
+
+def test_cooldown_and_rate_limit_pace_repeat_actions():
+    cfg = PolicyConfig(confirm_ticks=1, max_replicas=8,
+                       cooldown_s=10.0, rate_max=2, rate_window_s=30.0)
+    f = lambda s: frame(s, replicas={"r1": replica(queue=50)})  # noqa: E731
+    state = PolicyState()
+    window = []
+    issued_at = []
+    for tick in range(40):
+        window.append(f(tick + 1))
+        del window[:-cfg.window]
+        actions, state = decide(list(window), state, cfg,
+                                now=float(tick))
+        if actions:
+            assert [a["action"] for a in actions] == ["add_replica"]
+            issued_at.append(tick)
+    # t=0 fires; cooldown holds until t=10; rate window (2 per 30s)
+    # then blocks until t=0 falls out of the window at t=30, cooldown
+    # pushes the next to 30; then 40 is out of range
+    assert issued_at == [0, 10, 30]
+
+
+# ---------------------------------------------------------------------------
+# policy: worker throughput band + straggler eviction
+# ---------------------------------------------------------------------------
+
+def test_worker_band_scales_both_directions():
+    cfg = PolicyConfig(confirm_ticks=2, target_steps_s=100.0,
+                       min_workers=1, max_workers=4)
+    starve = {"w1": worker(step_s=30.0, pid=11),
+              "w2": worker(step_s=30.0, pid=12)}
+    out, _ = run_ticks([frame(1, workers=starve),
+                        frame(2, workers=starve)], cfg)
+    assert out[1] == [{"action": "add_worker"}]
+    over = {"w1": worker(step_s=80.0, pid=11),
+            "w2": worker(step_s=80.0, pid=12)}
+    out, _ = run_ticks([frame(1, workers=over),
+                        frame(2, workers=over)], cfg)
+    assert out[1] == [{"action": "remove_worker", "pid": 12}]
+
+
+def test_worker_band_needs_rates_and_bounds():
+    cfg = PolicyConfig(confirm_ticks=1, target_steps_s=100.0,
+                       min_workers=1, max_workers=4)
+    # a worker with no rate yet freezes the band logic
+    out, _ = run_ticks([frame(1, workers={
+        "w1": worker(step_s=None), "w2": worker(step_s=30.0)})], cfg)
+    assert out == [[]]
+    # a single worker can never be removed below min_workers
+    out, _ = run_ticks([frame(1, workers={
+        "w1": worker(step_s=300.0)}),
+        frame(2, workers={"w1": worker(step_s=300.0)})], cfg)
+    assert all(a == [] for a in out)
+
+
+def test_straggler_eviction_needs_persistence():
+    cfg = PolicyConfig(confirm_ticks=2, min_workers=1)
+    ws = {"w1": worker(step_s=1.0, pid=1),
+          "w2": worker(step_s=1.0, pid=2)}
+    lagging = {"s1": shard(push_s=10.0,
+                           stragglers=[["127.0.0.1:70", 1]])}
+    clean = {"s1": shard(push_s=10.0)}
+    # verdict only in the newest frame: intersection empty, no action
+    out, _ = run_ticks([frame(1, workers=ws, shards=clean),
+                        frame(2, workers=ws, shards=lagging)], cfg)
+    assert all(a == [] for a in out)
+    # persistent across the confirmation window: evict by rank
+    out, _ = run_ticks([frame(1, workers=ws, shards=lagging),
+                        frame(2, workers=ws, shards=lagging)], cfg)
+    assert out[1] == [{"action": "remove_worker", "rank": 1,
+                       "origin": ["127.0.0.1:70", 1],
+                       "reason": "straggler"}]
+
+
+# ---------------------------------------------------------------------------
+# policy: hot shard split + dead-shard caution
+# ---------------------------------------------------------------------------
+
+def test_hot_single_shard_splits_once_sustained():
+    cfg = PolicyConfig(confirm_ticks=2, max_shards=4)
+    hot = {"s1": shard(push_s=120.0, keys=50)}
+    out, _ = run_ticks([frame(1, shards=hot), frame(2, shards=hot)],
+                       cfg)
+    assert out[0] == []
+    assert out[1] == [{"action": "split_shard", "src_addr": "s1"}]
+
+
+def test_skew_split_picks_the_hot_shard():
+    cfg = PolicyConfig(confirm_ticks=1, max_shards=8, split_skew=1.5)
+    ss = {"s1": shard(push_s=100.0, keys=40),
+          "s2": shard(push_s=5.0, keys=40),
+          "b1": shard(push_s=100.0, keys=40, role="backup")}
+    out, _ = run_ticks([frame(1, shards=ss)], cfg)
+    assert out == [[{"action": "split_shard", "src_addr": "s1"}]]
+
+
+def test_split_suppressed_by_shard_gap_and_bounds():
+    cfg = PolicyConfig(confirm_ticks=1, max_shards=4)
+    hot = {"s1": shard(push_s=120.0, keys=50)}
+    # a gapped SHARD row (reachability in question) freezes the key map
+    out, _ = run_ticks([frame(1, shards=hot,
+                              gaps={"s2": {"age": 1,
+                                           "role": "server"}})], cfg)
+    assert out == [[]]
+    # a gapped WORKER row does not
+    out, _ = run_ticks([frame(1, shards=hot,
+                              gaps={"w9": {"age": 1,
+                                           "role": "worker"}})], cfg)
+    assert out == [[{"action": "split_shard", "src_addr": "s1"}]]
+    # max_shards clamp counts primaries only
+    cfg2 = PolicyConfig(confirm_ticks=1, max_shards=1)
+    out, _ = run_ticks([frame(1, shards=hot)], cfg2)
+    assert out == [[]]
+    # a shard with a single key has nothing to split
+    thin = {"s1": shard(push_s=120.0, keys=1)}
+    out, _ = run_ticks([frame(1, shards=thin)], cfg)
+    assert out == [[]]
+
+
+def test_dead_shard_is_excluded_not_panicked():
+    # the seq ADVANCES while one shard row's age grows past
+    # stale_sweeps: that row is dead capacity (excluded), but nothing
+    # fires — no split (gap caution) and no worker eviction from its
+    # stale straggler verdict
+    cfg = PolicyConfig(confirm_ticks=2, stale_sweeps=3)
+    ws = {"w1": worker(step_s=1.0, pid=1),
+          "w2": worker(step_s=1.0, pid=2)}
+    stale = {"s1": shard(push_s=200.0, keys=50,
+                         stragglers=[["127.0.0.1:70", 1]], age=5)}
+    out, state = run_ticks([frame(s, workers=ws, shards=stale)
+                            for s in (1, 2, 3)], cfg)
+    assert all(a == [] for a in out), out
+    assert state.holds == 0     # live doc: these are decisions, not holds
+
+
+def test_aggregator_slow_holds_last_decision():
+    # the SAME seq re-presented = the observer is behind: even under
+    # screaming pressure the policy emits nothing and counts a hold
+    cfg = PolicyConfig(confirm_ticks=1)
+    f = frame(7, replicas={"r1": replica(queue=500)})
+    state = PolicyState()
+    actions, state = decide([f], state, cfg, now=0.0)
+    assert [a["action"] for a in actions] == ["add_replica"]
+    actions, state = decide([f], state, cfg, now=1.0)
+    assert actions == []
+    assert state.holds == 1
+    assert "not advancing" in state.hold_reason
+
+
+def test_empty_window_holds():
+    state = PolicyState()
+    actions, state = decide([], state, PolicyConfig(), now=0.0)
+    assert actions == [] and state.holds == 1
+
+
+# ---------------------------------------------------------------------------
+# summarize: fleet.json document → frame
+# ---------------------------------------------------------------------------
+
+def test_summarize_classifies_roles_rates_and_gaps():
+    doc = {
+        "seq": 7, "time": 123.0,
+        "history": [
+            {"time": 0.0, "counters": {
+                "w1": {"steps": 0}, "s1": {"pushes": 0},
+                "r1": {"requests": 0, "responses": 0}}},
+            {"time": 10.0, "counters": {
+                "w1": {"steps": 50}, "s1": {"pushes": 600},
+                "r1": {"requests": 100, "responses": 90}}},
+        ],
+        "fleet": {
+            "w1": {"role": "worker", "pid": 42, "age_sweeps": 0},
+            "s1": {"role": "server", "age_sweeps": 0, "views": {
+                "kv.server#1": {"keys": 8, "role": "primary",
+                                "stragglers": [["w9", 9]]}}},
+            "r1": {"role": "serving", "age_sweeps": 1, "metrics": {
+                "serve.batch.queued": {"kind": "gauge",
+                                       "series": {"": 3}},
+                "serve.request_ms": {"kind": "histogram", "series": {
+                    "": {"count": 10, "p99": 12.5}}}}},
+            "c1": {"role": "controller", "age_sweeps": 0},
+            "dead": {"gap": True, "role": "server", "age_sweeps": 4,
+                     "error": "connection refused"},
+        }}
+    f = summarize(doc)
+    assert f["seq"] == 7
+    assert f["workers"]["w1"]["pid"] == 42
+    assert f["workers"]["w1"]["step_s"] == pytest.approx(5.0)
+    assert f["shards"]["s1"]["push_s"] == pytest.approx(60.0)
+    assert f["shards"]["s1"]["keys"] == 8
+    assert f["shards"]["s1"]["stragglers"] == [["w9", 9]]
+    assert f["replicas"]["r1"]["queue"] == 3
+    assert f["replicas"]["r1"]["req_s"] == pytest.approx(10.0)
+    assert f["replicas"]["r1"]["p99"] == pytest.approx(12.5)
+    assert "c1" in f["controllers"]
+    assert f["gaps"]["dead"] == {"age": 4, "role": "server"}
+
+
+# ---------------------------------------------------------------------------
+# journal: write-ahead intents, replay, torn tails
+# ---------------------------------------------------------------------------
+
+def test_journal_replays_only_unverdicted_intents(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = ActionJournal(path)
+    a = j.next_id("add_worker")
+    j.intent(a, {"action": "add_worker"}, 1, now=1.0)
+    b = j.next_id("split_shard")
+    j.intent(b, {"action": "split_shard", "src_addr": "x"}, 1, now=2.0)
+    j.verdict(a, "ok", now=3.0)
+    j2 = ActionJournal(path)
+    assert j2.replay() == [(b, {"action": "split_shard",
+                                "src_addr": "x"}, 1)]
+    # seq is monotone across restarts: no id collision with pre-crash
+    # in-flight actions
+    assert j2.next_id("add_worker") == "a3.add_worker"
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = ActionJournal(path)
+    a = j.next_id("add_worker")
+    j.intent(a, {"action": "add_worker"}, 2, now=1.0)
+    with open(path, "a") as f:
+        f.write('{"rec": "verdict", "id": "a1.add_wor')   # crash mid-append
+    j2 = ActionJournal(path)
+    assert [x[0] for x in j2.replay()] == [a]
+
+
+def test_journal_rejects_nonterminal_verdicts(tmp_path):
+    j = ActionJournal(str(tmp_path / "j.jsonl"))
+    a = j.next_id("add_worker")
+    j.intent(a, {"action": "add_worker"}, 1)
+    with pytest.raises(ValueError):
+        j.verdict(a, "maybe")
+
+
+# ---------------------------------------------------------------------------
+# executor: exactly-once application + fencing
+# ---------------------------------------------------------------------------
+
+def test_executor_applies_each_id_at_most_once(tmp_path):
+    ran = []
+    ex = ActionExecutor(str(tmp_path),
+                        {"add_worker": lambda a: ran.append(a) or
+                         {"rank": len(ran)}}, verbose=False)
+    v1 = ex.execute("a1.add_worker", {"action": "add_worker"}, epoch=1)
+    v2 = ex.execute("a1.add_worker", {"action": "add_worker"}, epoch=1)
+    assert v1["verdict"] == "ok" and v2["verdict"] == "ok"
+    assert v2["detail"] == v1["detail"]     # the RECORDED verdict
+    assert len(ran) == 1
+    assert ex.stats()["deduped"] == 1
+
+
+def test_executor_survives_restart_without_reapplying(tmp_path):
+    ran = []
+    handlers = {"add_worker": lambda a: ran.append(1) or {}}
+    ex = ActionExecutor(str(tmp_path), handlers, verbose=False)
+    ex.execute("a1.add_worker", {"action": "add_worker"}, epoch=1)
+    # a fresh executor over the same directory (launcher restart)
+    ex2 = ActionExecutor(str(tmp_path), handlers, verbose=False)
+    v = ex2.execute("a1.add_worker", {"action": "add_worker"}, epoch=1)
+    assert v["verdict"] == "ok" and len(ran) == 1
+    assert ex2.stats()["fence_epoch"] == 1    # fence persisted too
+
+
+def test_executor_fences_stale_epochs(tmp_path):
+    ran = []
+    ex = ActionExecutor(str(tmp_path),
+                        {"add_worker": lambda a: ran.append(1) or {}},
+                        verbose=False)
+    ex.execute("a1.add_worker", {"action": "add_worker"}, epoch=3)
+    v = ex.execute("a2.add_worker", {"action": "add_worker"}, epoch=2)
+    assert v["verdict"] == "fenced" and len(ran) == 1
+
+
+def test_executor_turns_handler_errors_into_failed_verdicts(tmp_path):
+    def boom(action):
+        raise RuntimeError("no capacity")
+    ex = ActionExecutor(str(tmp_path), {"add_replica": boom},
+                        verbose=False)
+    v = ex.execute("a1.add_replica", {"action": "add_replica"})
+    assert v["verdict"] == "failed" and "no capacity" in v["detail"]
+    v2 = ex.execute("a9.bogus", {"action": "bogus"})
+    assert v2["verdict"] == "failed" and "no handler" in v2["detail"]
+
+
+def test_executor_in_progress_marker_blocks_reentry(tmp_path):
+    ex = ActionExecutor(str(tmp_path), {}, verbose=False)
+    wip = os.path.join(str(tmp_path), "wip", "a1.add_worker")
+    with open(wip, "w"):
+        pass     # a previous incarnation died mid-apply
+    v = ex.execute("a1.add_worker", {"action": "add_worker"})
+    assert v is None     # never double-run; caller's timeout covers it
+
+
+def test_executor_poll_drains_the_mailbox(tmp_path):
+    ran = []
+    ex = ActionExecutor(str(tmp_path),
+                        {"drain_replica": lambda a: ran.append(a) or
+                         {"addr": a.get("addr")}}, verbose=False)
+    mb = ActionMailbox(str(tmp_path))
+    mb.submit("a1.drain_replica",
+              {"action": "drain_replica", "addr": "127.0.0.1:9528"}, 1)
+    assert ex.poll() == 1
+    assert ex.poll() == 0     # verdict recorded, nothing new
+    assert mb.verdict("a1.drain_replica")["verdict"] == "ok"
+    assert mb.verdict("a1.drain_replica")["detail"]["addr"] \
+        == "127.0.0.1:9528"
+
+
+def test_action_ids_must_be_path_safe(tmp_path):
+    mb = ActionMailbox(str(tmp_path))
+    with pytest.raises(ValueError):
+        mb.submit("../evil", {"action": "add_worker"}, 1)
+
+
+# ---------------------------------------------------------------------------
+# lease: single controller, epoch fencing on takeover
+# ---------------------------------------------------------------------------
+
+def test_lease_epoch_bumps_on_takeover_only(tmp_path):
+    clock = [100.0]
+    path = str(tmp_path / "lease")
+    l1 = Lease(path, "c1", ttl=5.0, clock=lambda: clock[0])
+    assert l1.acquire() and l1.epoch == 1
+    l2 = Lease(path, "c2", ttl=5.0, clock=lambda: clock[0])
+    assert not l2.acquire()            # live foreign lease: stand down
+    clock[0] += 2.0
+    assert l1.renew() and l1.epoch == 1    # renewal keeps the epoch
+    clock[0] += 10.0                       # c1's lease expires
+    assert l2.acquire() and l2.epoch == 2  # takeover bumps it
+    assert not l1.held()
+
+
+# ---------------------------------------------------------------------------
+# controller: crash replay + the ctl.* fault-matrix rows
+# ---------------------------------------------------------------------------
+
+def _serve_doc(seq, queue):
+    return {"seq": seq, "time": float(seq), "history": [], "fleet": {
+        "127.0.0.1:9601": {"role": "serving", "age_sweeps": 0,
+                           "metrics": {"serve.batch.queued": {
+                               "kind": "gauge", "series": {"": queue}}}}}}
+
+
+def _controller(tmp_path, docs, executor=None, **kw):
+    """A controller whose injected sleep pumps the executor — actuation
+    round-trips complete in-process with no threads."""
+    it = iter(docs)
+    last = {"doc": None}
+
+    def poll_fn():
+        nxt = next(it, None)
+        if nxt is not None:
+            last["doc"] = nxt
+        return last["doc"]
+
+    def pump(seconds):
+        if executor is not None:
+            executor.poll()
+
+    kw.setdefault("cfg", PolicyConfig(confirm_ticks=2, cooldown_s=0.0))
+    kw.setdefault("action_timeout", 0.2)
+    kw.setdefault("action_retries", 2)
+    return Controller(fleet_path=str(tmp_path / "fleet.json"),
+                      directory=str(tmp_path), poll_fn=poll_fn,
+                      sleep=pump, owner="test", **kw)
+
+
+def test_controller_issues_and_journals_pressure_action(tmp_path):
+    ran = []
+    ex = ActionExecutor(str(tmp_path),
+                        {"add_replica": lambda a: ran.append(1) or {}},
+                        verbose=False)
+    c = _controller(tmp_path, [_serve_doc(1, 30), _serve_doc(2, 30)],
+                    executor=ex)
+    c.run(ticks=2)
+    assert len(ran) == 1
+    assert c.journal.stats() == {"seq": 1, "pending": 0,
+                                 "verdicts": {"ok": 1}}
+
+
+def test_controller_killed_mid_action_replays_exactly_once(tmp_path):
+    """kill -9 between intent and verdict: the successor replays the
+    SAME id; whether or not the executor already applied it, it applies
+    exactly once overall."""
+    ran = []
+    ex = ActionExecutor(str(tmp_path),
+                        {"add_replica": lambda a: ran.append(1) or {}},
+                        verbose=False)
+    # incarnation 1 "crashes" after journaling the intent (never
+    # submits): simulate by writing the intent directly
+    j = ActionJournal(str(tmp_path / "journal.jsonl"))
+    aid = j.next_id("add_replica")
+    j.intent(aid, {"action": "add_replica"}, 1, now=0.0)
+    # incarnation 2: replay on first tick re-actuates under the id
+    c = _controller(tmp_path, [_serve_doc(1, 0)], executor=ex)
+    c.run(ticks=1)
+    assert len(ran) == 1
+    assert c.journal.stats()["pending"] == 0
+    # incarnation 3 (crash AFTER the executor applied): replay dedupes
+    j3 = ActionJournal(str(tmp_path / "journal.jsonl"))
+    j3.intent(aid, {"action": "add_replica"}, 1, now=9.0)  # re-open it
+    c3 = _controller(tmp_path, [_serve_doc(2, 0)], executor=ex)
+    c3.run(ticks=1)
+    assert len(ran) == 1        # never double-applied
+    assert ex.stats()["applied"] == 1
+
+
+def test_dropped_action_retries_idempotently(tmp_path):
+    """Fault-matrix row: kind=drop at point=ctl.action loses the first
+    submit; the bounded retry re-submits the SAME id and the executor's
+    dedupe keeps it exactly-once."""
+    ran = []
+    ex = ActionExecutor(str(tmp_path),
+                        {"add_replica": lambda a: ran.append(1) or {}},
+                        verbose=False)
+    c = _controller(tmp_path, [_serve_doc(1, 30), _serve_doc(2, 30)],
+                    executor=ex)
+    with fault.inject("kind=drop,point=ctl.action,nth=1,count=1"):
+        c.run(ticks=2)
+    assert len(ran) == 1
+    assert c.journal.stats()["verdicts"] == {"ok": 1}
+
+
+def test_gapped_poll_holds_last_decision(tmp_path):
+    """Fault-matrix row: kind=drop at point=ctl.poll severs the
+    controller's telemetry read; the policy holds (no actions, hold
+    counter grows) and NEVER panics into a scale-down."""
+    ran = []
+    ex = ActionExecutor(str(tmp_path),
+                        {"drain_replica": lambda a: ran.append(1) or {},
+                         "remove_worker": lambda a: ran.append(1) or {},
+                         "add_replica": lambda a: ran.append(1) or {}},
+                        verbose=False)
+    docs = [_serve_doc(s, 30) for s in (1, 2, 3, 4)]
+    c = _controller(tmp_path, docs, executor=ex)
+    with fault.inject("kind=drop,point=ctl.poll,nth=1,count=4"):
+        c.run(ticks=4)
+    assert ran == []                      # four blind ticks: no action
+    assert c.state.holds >= 3             # held, not panicked
+    c.run(ticks=2)                        # telemetry back: loop closes
+    assert len(ran) == 1
+
+
+def test_severed_poll_is_a_miss_not_a_crash(tmp_path):
+    c = _controller(tmp_path, [_serve_doc(1, 0)])
+    with fault.inject("kind=sever,point=ctl.poll,nth=1,count=1"):
+        assert c.poll() is None           # FaultSever → missed poll
+    assert c.poll() is not None
+
+
+def test_second_controller_stands_down_until_lease_expires(tmp_path):
+    clock = [0.0]
+    kw = dict(clock=lambda: clock[0], lease_ttl=5.0,
+              action_timeout=0.01, action_retries=0, interval=0.1)
+    c1 = _controller(tmp_path, [_serve_doc(1, 0)], **dict(kw))
+    c1.tick()
+    assert c1.lease.epoch == 1
+    c2 = Controller(fleet_path=str(tmp_path / "fleet.json"),
+                    directory=str(tmp_path),
+                    poll_fn=lambda: _serve_doc(2, 0),
+                    sleep=lambda s: None, owner="rival",
+                    cfg=PolicyConfig(), **kw)
+    assert c2.tick() == [] and c2.lease.epoch == 0   # stood down
+    clock[0] += 100.0                                # c1 expired
+    c2.tick()
+    assert c2.lease.epoch == 2     # takeover fences the old epoch
+
+
+def test_controller_status_view_is_json_serializable(tmp_path):
+    c = _controller(tmp_path, [_serve_doc(1, 0)])
+    c.run(ticks=1)
+    doc = json.loads(json.dumps(c.status(), default=str))
+    assert doc["ticks"] == 1 and "journal" in doc
+
+
+# ---------------------------------------------------------------------------
+# mxtop: the controller gets its own fleet row
+# ---------------------------------------------------------------------------
+
+def test_mxtop_renders_controller_row():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import mxtop
+    doc = {"seq": 3, "sweeps": 3, "gaps": 0, "time": 0.0,
+           "history": [
+               {"time": 0.0, "counters": {"127.0.0.1:9700":
+                                          {"actions": 0}}},
+               {"time": 10.0, "counters": {"127.0.0.1:9700":
+                                           {"actions": 5}}}],
+           "fleet": {"127.0.0.1:9700": {
+               "role": "controller", "age_sweeps": 0,
+               "views": {"fleet.controller#1": {
+                   "leader": True, "epoch": 2, "ticks": 40,
+                   "issued": 5, "holds": 3,
+                   "journal": {"pending": 1}}}}}}
+    out = mxtop.render(doc)
+    assert "controller" in out
+    assert "leader=True" in out and "epoch=2" in out
+    assert "issued=5" in out and "holds=3" in out
+    assert "pending=1" in out and "act/s=0.50" in out

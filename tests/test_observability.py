@@ -447,29 +447,54 @@ def test_dead_shard_telemetry_gap_is_reported_not_fatal():
         agg.stop()
 
 
-def test_stale_endpoint_file_pruned_after_gap_streak(tmp_path):
-    """A dead WORKER's endpoint file is pruned after 3 consecutive
-    gapped sweeps (so exited workers stop taxing every sweep with a
-    connect timeout); explicit targets — PS shards, replicas — keep
-    their gap rows forever (that gap IS the operator signal)."""
+def test_gapped_endpoint_parked_not_pruned_and_resumes(tmp_path):
+    """Staleness semantics (ISSUE 16): a gapped worker endpoint is
+    PARKED after 3 gapped sweeps (probed every 4th sweep, so exited
+    workers stop taxing every sweep with a connect timeout) but its row
+    and endpoint file survive — the document ``seq`` advances while the
+    row's ``age_sweeps`` grows, which is how a consumer tells "this row
+    is dead" from "the aggregator is behind". A paused-then-RESUMED
+    exporter comes back as live capacity on the next probe sweep;
+    pruning (the old behavior) conflated it with dead capacity
+    forever."""
     epd = tmp_path / "endpoints"
     epd.mkdir()
+    exp = obs.TelemetryExporter().start()
+    addr = exp.address
+    port = int(addr.rsplit(":", 1)[1])
     ep = epd / "worker-1.ep"
-    ep.write_text("127.0.0.1:1")
+    ep.write_text(addr)
     agg = obs.TelemetryAggregator(targets=["127.0.0.1:2"],
                                   endpoints_dir=str(epd),
                                   connect_timeout=0.2)
     try:
-        for i in range(3):
+        doc = agg.sweep()                       # sweep 1: live
+        row = doc["fleet"][addr]
+        assert not row.get("gap")
+        assert row["seq"] == 1 and row["age_sweeps"] == 0
+        exp.stop()                              # the PAUSE
+        for i in range(2, 8):                   # sweeps 2..7: gapped
             doc = agg.sweep()
-            assert doc["fleet"]["127.0.0.1:1"]["gap"]
-        assert not ep.exists(), "stale endpoint file must be pruned"
+            row = doc["fleet"][addr]
+            assert row["gap"], "row must persist while gapped"
+            assert row["seq"] == 1              # last sweep that heard it
+            assert row["age_sweeps"] == i - 1   # grows with doc seq
+            assert doc["seq"] == i              # ...which ADVANCES
+        assert row.get("parked"), "reduced-rate probing by now"
+        assert ep.exists(), "endpoint file must never be pruned"
+        # the RESUME: same port, fresh exporter (sweep 8 is a probe)
+        exp = obs.TelemetryExporter(port=port).start()
         doc = agg.sweep()
-        assert "127.0.0.1:1" not in doc["fleet"]
-        assert doc["fleet"]["127.0.0.1:2"]["gap"], \
-            "explicit targets keep reporting their gap"
+        row = doc["fleet"][addr]
+        assert not row.get("gap"), \
+            "a paused-then-resumed exporter is live capacity again"
+        assert row["seq"] == 8 and row["age_sweeps"] == 0
+        # explicit targets are never parked: their gap IS the signal
+        assert doc["fleet"]["127.0.0.1:2"]["gap"]
+        assert not doc["fleet"]["127.0.0.1:2"].get("parked")
     finally:
         agg.stop()
+        exp.stop()
 
 
 def test_spec_validates_metrics_fault_rules():

@@ -28,6 +28,13 @@ import tempfile
 import threading
 import time
 
+# the autoscale/scale actuation layer imports mxtpu.fleet (stdlib-only
+# modules, but the package import needs the repo root on the path when
+# the launcher runs from elsewhere)
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
 
 def _reap(procs, grace=5.0):
     """Terminate-and-reap with escalation: SIGTERM every live child,
@@ -156,9 +163,11 @@ def _parse_scale(spec):
             k, _, v = pair.partition("=")
             ev[k.strip()] = v.strip()
         if ev.get("action") not in ("add_worker", "remove_worker",
-                                    "split_shard"):
+                                    "split_shard", "add_replica",
+                                    "drain_replica"):
             raise SystemExit("scale event %r needs action=add_worker|"
-                             "remove_worker|split_shard" % item)
+                             "remove_worker|split_shard|add_replica|"
+                             "drain_replica" % item)
         if "after" not in ev and "at_step" not in ev:
             raise SystemExit("scale event %r needs after= or at_step="
                              % item)
@@ -212,6 +221,10 @@ def launch_local(args, command):
     procs = []
     base_env = dict(os.environ)
     coordinator = "127.0.0.1:%d" % args.port
+    if args.autoscale:
+        # the closed loop needs its sensor plane: the controller's only
+        # input is the aggregator's fleet.json
+        args.telemetry = True
     # -s N starts N async parameter-server processes (DMLC_ROLE=server;
     # reference dmlc-tracker starts ps-lite servers the same way); workers
     # find them via MXTPU_PS_ADDRS for create('dist_async')
@@ -238,6 +251,27 @@ def launch_local(args, command):
         print("telemetry: %s/fleet.json (mxtop: python tools/mxtop.py "
               "--dir %s)" % (args.telemetry_dir, args.telemetry_dir),
               flush=True)
+    # -- autoscale plumbing (docs/autoscaling.md): the action mailbox /
+    # journal / lease directory, shared by the controller child and this
+    # launcher's executor; plus the prewarm dir serving replicas export
+    # their AOT program menus into so a controller-added replica boots
+    # warm. Provisioned before any child spawns so every env inherits it.
+    autoscale_dir = None
+    if args.autoscale or args.scale:
+        autoscale_dir = args.autoscale_dir or (
+            os.path.join(args.telemetry_dir, "autoscale")
+            if args.telemetry_dir
+            else tempfile.mkdtemp(prefix="mxtpu_autoscale_"))
+        os.makedirs(autoscale_dir, exist_ok=True)
+        base_env["MXTPU_AUTOSCALE_DIR"] = autoscale_dir
+    if args.autoscale and args.serve:
+        prewarm_dir = os.path.join(autoscale_dir, "prewarm")
+        os.makedirs(prewarm_dir, exist_ok=True)
+        base_env.setdefault("MXTPU_SERVE_PREWARM_DIR", prewarm_dir)
+        # persistent XLA compile cache for every child: a joiner's
+        # jit compiles become cache loads too, not just its AOT menu
+        base_env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                            os.path.join(autoscale_dir, "jaxcache"))
     if args.ps_respawn and not args.ps_snapshot_dir:
         # a respawned server with no snapshot restores nothing and every
         # in-flight key 404s — auto-provision the state dir instead
@@ -277,13 +311,24 @@ def launch_local(args, command):
     # the parameter servers; workers see MXTPU_SERVE_ADDRS and speak
     # mxtpu.serving.ServingClient (docs/serving.md)
     serve_addrs = []
+    serve_live = []
+    serve_reserve = []   # (idx, port) slots held back for the
+    #                      controller's add_replica actuation
     if args.serve:
         if not (args.serve_model and args.serve_data_shapes):
             raise SystemExit("--serve needs --serve-model and "
                              "--serve-data-shapes")
+        # --serve-max reserves extra ports up front so the FULL
+        # potential replica set is in MXTPU_SERVE_ADDRS from the first
+        # hello: clients already know where a scaled-up replica will
+        # appear, and failover finds it without a re-hello
+        n_slots = max(args.serve, args.serve_max or 0)
         serve_ports = [_free_port(args.port + 201 + i)
-                       for i in range(args.serve)]
+                       for i in range(n_slots)]
         serve_addrs = ["127.0.0.1:%d" % p for p in serve_ports]
+        serve_live = serve_addrs[:args.serve]
+        serve_reserve = [(i, serve_ports[i])
+                         for i in range(args.serve, n_slots)]
         base_env["MXTPU_SERVE_ADDRS"] = ",".join(serve_addrs)
         # the serve contract rides to the WORKERS too: a trainer
         # process publishing weights (WeightPublisher into the weight
@@ -294,7 +339,7 @@ def launch_local(args, command):
         base_env["MXTPU_SERVE_DATA_SHAPES"] = args.serve_data_shapes
         if args.serve_weight_dir:
             base_env["MXTPU_SERVE_WEIGHT_DIR"] = args.serve_weight_dir
-        for i, port in enumerate(serve_ports):
+        for i, port in enumerate(serve_ports[:args.serve]):
             server_slots.append(("serve%d" % i, port, "serving", i))
             server_ports.append(port)
             server_procs.append(_spawn_serving_replica(
@@ -305,7 +350,7 @@ def launch_local(args, command):
     if args.telemetry:
         agg_env = dict(base_env, JAX_PLATFORMS="cpu")
         agg_env.pop("DMLC_ROLE", None)
-        targets = ps_addrs + backup_addrs + serve_addrs
+        targets = ps_addrs + backup_addrs + serve_live
         agg = subprocess.Popen(
             [sys.executable, "-m", "mxtpu.obs.telemetry",
              "--targets", ",".join(targets),
@@ -315,6 +360,36 @@ def launch_local(args, command):
         server_procs.append(agg)
         print("telemetry aggregator pid=%d targets=%d"
               % (agg.pid, len(targets)), flush=True)
+
+    # -- the autoscale controller child: the policy brain. It only ever
+    # READS fleet.json and WRITES action files into the mailbox; this
+    # launcher's executor (below) is the sole actuator. Separate process
+    # so kill -9 mid-action is a first-class drill: the respawn replays
+    # its journal and the executor dedupes (docs/autoscaling.md).
+    def _spawn_controller(respawn=False):
+        env = dict(base_env, JAX_PLATFORMS="cpu")
+        env.pop("DMLC_ROLE", None)
+        env["MXTPU_OBS_ROLE"] = "controller"
+        if args.autoscale_fault and not respawn:
+            env["MXTPU_FAULT_SPEC"] = args.autoscale_fault
+        elif respawn:
+            # a controller fault drill is one-shot: the respawned
+            # controller must replay its journal, not re-die on the
+            # same injected kill
+            env.pop("MXTPU_FAULT_SPEC", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "mxtpu.fleet.controller",
+             "--dir", autoscale_dir,
+             "--fleet", os.path.join(args.telemetry_dir, "fleet.json")],
+            env=env)
+        print("autoscale controller pid=%d dir=%s"
+              % (proc.pid, autoscale_dir), flush=True)
+        return proc
+
+    if args.autoscale:
+        server_slots.append(("controller", 0, "controller", None))
+        server_ports.append(0)
+        server_procs.append(_spawn_controller())
     if args.worker_respawn and not args.worker_state_dir:
         # a respawned worker with no state dir restarts from step 0 and
         # double-trains its epoch — auto-provision one, like --ps-respawn
@@ -355,106 +430,245 @@ def launch_local(args, command):
     stop_scale = threading.Event()
     removed = set()    # ranks departed by a remove_worker event: their
     #                    sh -c wrapper dies -15, which is NOT a failure
+    drained_slots = set()   # server_slots indices drained on purpose
+    actuate_lock = threading.Lock()   # one actuation mutates the fleet
+    #                                   at a time (executor thread +
+    #                                   --scale thread both actuate)
 
-    def _do_scale_event(ev):
-        act = ev["action"]
-        if act == "add_worker":
-            rank = len(procs)
-            env = dict(base_env)
-            env.update({
-                "MXTPU_NUM_PROCS": str(args.num_workers),
-                "MXTPU_PROC_ID": str(rank),
-                "DMLC_ROLE": "worker",
-                "DMLC_NUM_WORKER": str(args.num_workers),
-                "DMLC_NUM_SERVER": str(args.num_servers),
-                "DMLC_WORKER_ID": str(rank),
-                # the joiner contract: skip init/set_optimizer, pull
-                # current params, take work from the shard cursor
-                "MXTPU_ELASTIC_JOINER": "1",
-            })
-            # a mid-run joiner CANNOT enter the already-formed
-            # jax.distributed group (the coordination service pins its
-            # world size at bootstrap) — elasticity rides the PS layer,
-            # so the joiner runs single-process XLA and shares the
-            # model only through the parameter servers
-            env.pop("MXTPU_COORDINATOR", None)
-            if ps_addrs:
-                env["MXTPU_PS_ADDRS"] = ",".join(ps_addrs)
-            if args.worker_state_dir:
-                env["MXTPU_WORKER_STATE_DIR"] = os.path.join(
-                    args.worker_state_dir, "worker_%d" % rank)
-            print("scale: adding worker %d" % rank, flush=True)
-            worker_envs.append(env)
-            worker_respawns.append(0)
-            procs.append(subprocess.Popen(command, shell=True, env=env))
-        elif act == "remove_worker":
-            rank = int(ev.get("rank", len(procs) - 1))
-            # SIGTERM is the CLEAN departure: an elastic worker's
-            # handler finishes its current shard, byes, and exits 0.
-            # Popen(shell=True) makes the tracked pid an sh -c wrapper,
-            # so the signal must reach its CHILDREN (the python worker)
-            # too, or only the shell dies and training runs on.
-            print("scale: removing worker %d (SIGTERM)" % rank,
-                  flush=True)
-            removed.add(rank)
-            pid = procs[rank].pid
-            kids = []
+    def _announce_endpoint(role, addr):
+        """Dynamically added children (replicas, split shards) are not
+        in the aggregator's static target list — an endpoint file is
+        how they join the telemetry plane mid-run."""
+        if not args.telemetry_dir:
+            return
+        epd = os.path.join(args.telemetry_dir, "endpoints")
+        os.makedirs(epd, exist_ok=True)
+        path = os.path.join(epd,
+                            "%s-%s.ep" % (role, addr.replace(":", "-")))
+        tmp = path + ".tmp%d" % os.getpid()
+        with open(tmp, "w") as f:
+            f.write(addr)
+        os.replace(tmp, path)
+
+    def _retract_endpoint(role, addr):
+        if not args.telemetry_dir:
+            return
+        try:
+            os.unlink(os.path.join(
+                args.telemetry_dir, "endpoints",
+                "%s-%s.ep" % (role, addr.replace(":", "-"))))
+        except OSError:
+            pass
+
+    def _act_add_worker(action=None):
+        rank = len(procs)
+        env = dict(base_env)
+        env.update({
+            "MXTPU_NUM_PROCS": str(args.num_workers),
+            "MXTPU_PROC_ID": str(rank),
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+            "DMLC_WORKER_ID": str(rank),
+            # the joiner contract: skip init/set_optimizer, pull
+            # current params, take work from the shard cursor
+            "MXTPU_ELASTIC_JOINER": "1",
+        })
+        # a mid-run joiner CANNOT enter the already-formed
+        # jax.distributed group (the coordination service pins its
+        # world size at bootstrap) — elasticity rides the PS layer,
+        # so the joiner runs single-process XLA and shares the
+        # model only through the parameter servers
+        env.pop("MXTPU_COORDINATOR", None)
+        if ps_addrs:
+            env["MXTPU_PS_ADDRS"] = ",".join(ps_addrs)
+        if args.worker_state_dir:
+            env["MXTPU_WORKER_STATE_DIR"] = os.path.join(
+                args.worker_state_dir, "worker_%d" % rank)
+        print("scale: adding worker %d" % rank, flush=True)
+        worker_envs.append(env)
+        worker_respawns.append(0)
+        procs.append(subprocess.Popen(command, shell=True, env=env))
+        return {"rank": rank}
+
+    def _worker_rank_for_pid(pid):
+        """Rank whose process tree contains pid — telemetry snapshots
+        carry the python worker's pid, but the tracked Popen is its
+        sh -c wrapper."""
+        for rank, wp in enumerate(procs):
+            if rank in removed or wp.poll() is not None:
+                continue
+            if wp.pid == pid:
+                return rank
             try:
-                for task in os.listdir("/proc/%d/task" % pid):
+                for task in os.listdir("/proc/%d/task" % wp.pid):
                     with open("/proc/%d/task/%s/children"
-                              % (pid, task)) as f:
-                        kids += [int(c) for c in f.read().split()]
+                              % (wp.pid, task)) as f:
+                        if pid in [int(c) for c in f.read().split()]:
+                            return rank
+            except OSError:
+                continue
+        return None
+
+    def _act_remove_worker(action=None):
+        action = action or {}
+        rank = None
+        if action.get("rank") is not None:
+            rank = int(action["rank"])
+        elif action.get("pid") is not None:
+            rank = _worker_rank_for_pid(int(action["pid"]))
+        if rank is None:
+            live = [r for r, wp in enumerate(procs)
+                    if r not in removed and wp.poll() is None]
+            if not live:
+                raise RuntimeError("no live worker to remove")
+            rank = live[-1]
+        # SIGTERM is the CLEAN departure: an elastic worker's
+        # handler finishes its current shard, byes, and exits 0.
+        # Popen(shell=True) makes the tracked pid an sh -c wrapper,
+        # so the signal must reach its CHILDREN (the python worker)
+        # too, or only the shell dies and training runs on.
+        print("scale: removing worker %d (SIGTERM)" % rank,
+              flush=True)
+        removed.add(rank)
+        pid = procs[rank].pid
+        kids = []
+        try:
+            for task in os.listdir("/proc/%d/task" % pid):
+                with open("/proc/%d/task/%s/children"
+                          % (pid, task)) as f:
+                    kids += [int(c) for c in f.read().split()]
+        except OSError:
+            pass
+        for target in kids + [pid]:
+            try:
+                os.kill(target, signal.SIGTERM)
             except OSError:
                 pass
-            for target in kids + [pid]:
-                try:
-                    os.kill(target, signal.SIGTERM)
-                except OSError:
-                    pass
-        else:  # split_shard
-            src_i = int(ev.get("src", "0"))
-            idx = len(server_slots)
-            port = _free_port(args.port + 101 + idx)
-            dst_addr = "127.0.0.1:%d" % port
-            slots = [("e%d" % idx, port, "primary", None)]
-            if max(1, args.ps_replicas) >= 2:
-                # the new shard is born replicated: its backup joins
-                # and catches up, and every adopted key mirrors there
-                # BEFORE the old primary releases it
-                bport = _free_port(args.port + 151 + idx)
-                slots = [("e%d" % idx, port, "primary",
-                          "127.0.0.1:%d" % bport),
-                         ("e%d_backup" % idx, bport, "backup",
-                          dst_addr)]
-            for name, p_, role, peer in slots:
-                server_slots.append((name, p_, role, peer))
-                respawns.append(0)
-                server_ports.append(p_)
-                server_procs.append(_spawn_server(
-                    name, p_, base_env, args, role=role, peer=peer))
-            if not _wait_port("127.0.0.1", port):
-                print("scale: split target %s never came up" % dst_addr,
-                      flush=True)
-                return
-            src_addr = ps_addrs[src_i]
-            admin_env = dict(base_env)
-            admin_env.pop("DMLC_ROLE", None)
-            admin_env["JAX_PLATFORMS"] = "cpu"
-            print("scale: splitting server %s -> %s"
-                  % (src_addr, dst_addr), flush=True)
-            r = subprocess.run(
-                [sys.executable, "-m", "mxtpu.kvstore_async",
-                 "--admin", "split", "--src", src_addr,
-                 "--dst", dst_addr],
-                env=admin_env, capture_output=True, text=True)
-            print("scale: split -> %s"
-                  % (r.stdout.strip() or r.stderr.strip()[-500:]),
-                  flush=True)
+        return {"rank": rank}
+
+    def _act_split_shard(action=None):
+        action = action or {}
+        idx = len(server_slots)
+        port = _free_port(args.port + 101 + idx)
+        dst_addr = "127.0.0.1:%d" % port
+        slots = [("e%d" % idx, port, "primary", None)]
+        if max(1, args.ps_replicas) >= 2:
+            # the new shard is born replicated: its backup joins
+            # and catches up, and every adopted key mirrors there
+            # BEFORE the old primary releases it
+            bport = _free_port(args.port + 151 + idx)
+            slots = [("e%d" % idx, port, "primary",
+                      "127.0.0.1:%d" % bport),
+                     ("e%d_backup" % idx, bport, "backup",
+                      dst_addr)]
+        for name, p_, role, peer in slots:
+            server_slots.append((name, p_, role, peer))
+            respawns.append(0)
+            server_ports.append(p_)
+            server_procs.append(_spawn_server(
+                name, p_, base_env, args, role=role, peer=peer))
+        if not _wait_port("127.0.0.1", port):
+            raise RuntimeError(
+                "split target %s never came up" % dst_addr)
+        src_addr = action.get("src_addr") \
+            or ps_addrs[int(action.get("src", 0))]
+        admin_env = dict(base_env)
+        admin_env.pop("DMLC_ROLE", None)
+        admin_env["JAX_PLATFORMS"] = "cpu"
+        print("scale: splitting server %s -> %s"
+              % (src_addr, dst_addr), flush=True)
+        r = subprocess.run(
+            [sys.executable, "-m", "mxtpu.kvstore_async",
+             "--admin", "split", "--src", src_addr,
+             "--dst", dst_addr],
+            env=admin_env, capture_output=True, text=True)
+        print("scale: split -> %s"
+              % (r.stdout.strip() or r.stderr.strip()[-500:]),
+              flush=True)
+        if r.returncode != 0:
+            raise RuntimeError("split admin failed: %s"
+                               % r.stderr.strip()[-300:])
+        for name, p_, role, peer in slots:
+            _announce_endpoint("server", "127.0.0.1:%d" % p_)
+        return {"src": src_addr, "dst": dst_addr}
+
+    def _act_add_replica(action=None):
+        if not serve_reserve:
+            raise RuntimeError(
+                "no reserved serving slot left (--serve-max)")
+        i, port = serve_reserve.pop(0)
+        addr = "127.0.0.1:%d" % port
+        print("scale: adding serving replica %d on %s" % (i, addr),
+              flush=True)
+        server_slots.append(("serve%d" % i, port, "serving", i))
+        respawns.append(0)
+        server_ports.append(port)
+        server_procs.append(_spawn_serving_replica(
+            i, port, serve_addrs, base_env, args))
+        if not _wait_port("127.0.0.1", port, timeout=180):
+            raise RuntimeError("replica %s never came up" % addr)
+        _announce_endpoint("serving", addr)
+        return {"addr": addr}
+
+    def _act_drain_replica(action=None):
+        action = action or {}
+        target = None
+        for si, (name, port, role, peer) in enumerate(server_slots):
+            if role != "serving" or si in drained_slots:
+                continue
+            sp = server_procs[si]
+            if sp.poll() is not None:
+                continue
+            addr = "127.0.0.1:%d" % port
+            if action.get("addr") in (None, addr):
+                target = (si, addr, sp)
+                if action.get("addr"):
+                    break
+        if target is None:
+            raise RuntimeError("no live serving replica to drain (%r)"
+                               % action.get("addr"))
+        si, addr, sp = target
+        print("scale: draining serving replica %s (SIGTERM)" % addr,
+              flush=True)
+        drained_slots.add(si)    # respawn loop must not revive it
+        sp.send_signal(signal.SIGTERM)   # graceful drain, exits 0
+        _retract_endpoint("serving", addr)
+        return {"addr": addr}
+
+    # -- the idempotent actuation layer: EVERY fleet mutation (the
+    # --scale drill's scripted events AND the --autoscale controller's
+    # mailbox actions) goes through ONE ActionExecutor keyed by action
+    # id, so a re-issued action after an ambiguous timeout returns the
+    # recorded verdict instead of double-applying.
+    executor = None
+    if args.scale or args.autoscale:
+        from mxtpu.fleet.actuator import ActionExecutor
+        handlers = {}
+        for kind, fn in (("add_worker", _act_add_worker),
+                         ("remove_worker", _act_remove_worker),
+                         ("split_shard", _act_split_shard),
+                         ("add_replica", _act_add_replica),
+                         ("drain_replica", _act_drain_replica)):
+            def _locked(action=None, _fn=fn):
+                with actuate_lock:
+                    return _fn(action)
+            handlers[kind] = _locked
+        executor = ActionExecutor(autoscale_dir, handlers)
+
+    def _do_scale_event(ev, idx):
+        # position-derived id: a re-issued event after an ambiguous
+        # timeout hits the executor's verdict record, not the handler
+        eid = "scale-%d-%s" % (idx, ev["action"])
+        v = executor.execute(eid, dict(ev)) or {}
+        print("scale: %s -> %s %s"
+              % (eid, v.get("verdict"), str(v.get("detail"))[:200]),
+              flush=True)
 
     def _scale_controller(events):
         t0 = time.time()
         try:
-            for ev in events:
+            for idx, ev in enumerate(events):
                 if "after" in ev:
                     deadline = t0 + float(ev["after"])
                     while time.time() < deadline:
@@ -475,7 +689,7 @@ def launch_local(args, command):
                             break
                         time.sleep(0.05)
                 try:
-                    _do_scale_event(ev)
+                    _do_scale_event(ev, idx)
                 except Exception as e:   # a drill bug must not wedge
                     print("scale: event %r failed: %s" % (ev, e),
                           flush=True)
@@ -492,6 +706,20 @@ def launch_local(args, command):
                          daemon=True).start()
     else:
         scale_done.set()
+
+    # -- the mailbox pump: applies controller-submitted actions through
+    # the executor (each at most once) and writes their verdict files
+    stop_exec = threading.Event()
+    if args.autoscale:
+        def _exec_loop():
+            while not stop_exec.wait(0.2):
+                try:
+                    executor.poll()
+                except Exception as e:   # an actuator bug must not
+                    #                      kill the pump
+                    print("autoscale: executor error: %s" % e,
+                          flush=True)
+        threading.Thread(target=_exec_loop, daemon=True).start()
 
     # -- the --rollout drill: canary/promote/abort/rollback events on a
     # wall-clock or progress schedule, driven through the serving admin
@@ -585,7 +813,7 @@ def launch_local(args, command):
                           flush=True)
                     procs[i] = subprocess.Popen(
                         command, shell=True, env=worker_envs[i])
-            if args.ps_respawn or args.serve_respawn:
+            if args.ps_respawn or args.serve_respawn or args.autoscale:
                 for i, sp in enumerate(server_procs):
                     rc = sp.poll()
                     if rc is None or rc == 0:
@@ -594,6 +822,22 @@ def launch_local(args, command):
                     if role == "telemetry":
                         continue   # observability is passive: a dead
                         #            aggregator is a gap, not a respawn
+                    if role == "controller":
+                        # --autoscale implies the controller must live:
+                        # the revived process re-takes the lease (epoch
+                        # bump fences any straggler) and replays its
+                        # journal — kill -9 mid-action is the drill
+                        if not args.autoscale or respawns[i] >= 5:
+                            continue
+                        respawns[i] += 1
+                        print("autoscale controller died (exit %s); "
+                              "respawning (%d/5)" % (rc, respawns[i]),
+                              flush=True)
+                        server_procs[i] = _spawn_controller(
+                            respawn=True)
+                        continue
+                    if i in drained_slots:
+                        continue   # departed on purpose, stays down
                     if role != "serving" and (
                             not args.ps_respawn
                             or respawns[i] >= args.ps_max_respawns):
@@ -647,6 +891,7 @@ def launch_local(args, command):
         _reap(procs)
         code = 1
     finally:
+        stop_exec.set()
         # servers ignore nothing a worker still needs by now: reap with
         # TERM->KILL escalation so a hung server cannot zombie-leak or
         # wedge the launcher's exit
@@ -834,6 +1079,33 @@ def main():
                         "--ps-replicas 2) and splits server slot I's "
                         "keys onto it online (docs/fault_tolerance.md "
                         "'Elasticity')")
+    p.add_argument("--autoscale", action="store_true",
+                   help="local launcher: close the loop — spawn the "
+                        "autoscaling controller child (python -m "
+                        "mxtpu.fleet.controller), which reads the "
+                        "telemetry plane's fleet.json and submits "
+                        "add/remove-worker, split-shard and add/drain-"
+                        "replica actions into the action mailbox; THIS "
+                        "launcher executes them idempotently and "
+                        "respawns a crashed controller (journal "
+                        "replay). Implies --telemetry. Policy knobs "
+                        "ride MXTPU_AUTOSCALE_* env vars "
+                        "(docs/autoscaling.md)")
+    p.add_argument("--autoscale-dir", default=None,
+                   help="action mailbox / journal / lease dir (default "
+                        "<telemetry-dir>/autoscale); exported as "
+                        "MXTPU_AUTOSCALE_DIR")
+    p.add_argument("--autoscale-fault", default=None,
+                   help="MXTPU_FAULT_SPEC for the controller child "
+                        "ONLY (e.g. 'point=ctl.action,kind=kill_worker"
+                        ",nth=1' for the kill-mid-action drill); "
+                        "dropped on respawn so the drill is one-shot")
+    p.add_argument("--serve-max", type=int, default=0,
+                   help="reserve serving ports up to this count so the "
+                        "autoscale controller can add replicas beyond "
+                        "--serve N; the FULL slot set is advertised in "
+                        "MXTPU_SERVE_ADDRS from the start (default: "
+                        "no headroom)")
     p.add_argument("--serve", type=int, default=0,
                    help="local launcher: start N model-serving replicas "
                         "(python -m mxtpu.serving) and export "

@@ -17,7 +17,10 @@ renders one row per process:
   pushes, STRAG/FAILOV/OVFL the straggler/failover/cardinality-
   overflow counters;
 * a GAP row (dead shard, unreachable worker) prints as ``gap: <why>``
-  — reported, never fatal.
+  — reported, never fatal;
+* an autoscaling controller process (``launch.py --autoscale``) gets a
+  dedicated row — leadership, epoch, issued actions, holds, journal
+  backlog and its action rate (docs/autoscaling.md).
 
 ``--once`` prints a single table (CI/tests); the default loop redraws
 every ``--interval`` seconds until ^C. CPU-only, stdlib-only.
@@ -109,6 +112,23 @@ def render(doc):
                          % (addr.ljust(_W[0])[:_W[0]], str(err)[:60]))
             continue
         role = snap.get("role", "?")
+        ctl = _view(snap, "fleet.controller")
+        if ctl is not None:
+            # the autoscaling controller's row: decisions, not
+            # throughput — leadership, issued actions, holds, journal
+            # backlog and the action rate from the history ring
+            act_s = _rate(history, addr, "actions", None)
+            j = ctl.get("journal") or {}
+            lines.append(
+                "%s %s leader=%s epoch=%s issued=%s holds=%s "
+                "pending=%s act/s=%s"
+                % (addr.ljust(_W[0])[:_W[0]],
+                   "controller".rjust(_W[1])[:_W[1]],
+                   ctl.get("leader"), ctl.get("epoch"),
+                   ctl.get("issued"), ctl.get("holds"),
+                   j.get("pending"),
+                   "-" if act_s is None else "%.2f" % act_s))
+            continue
         kvs = _view(snap, "kv.server")
         kvw = _view(snap, "kv.worker")
         step_s = _rate(history, addr, "steps", None)
