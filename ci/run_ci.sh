@@ -36,6 +36,7 @@ case "$tier" in
     JAX_PLATFORMS=cpu python ci/check_elastic.py
     JAX_PLATFORMS=cpu python ci/check_autoscale.py
     JAX_PLATFORMS=cpu python ci/check_serving.py
+    JAX_PLATFORMS=cpu python ci/check_generate_perf.py
     JAX_PLATFORMS=cpu python ci/check_rollout.py
     JAX_PLATFORMS=cpu python ci/check_observability.py
     # lock-witness smoke: re-run the kvstore-window/replication/batcher

@@ -126,8 +126,8 @@ __all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
 
 _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
            "worker.step", "module.step", "serve.request", "serve.batch",
-           "serve.swap", "publish.snapshot", "ctl.poll", "ctl.action",
-           "any")
+           "serve.step", "serve.swap", "publish.snapshot", "ctl.poll",
+           "ctl.action", "any")
 _KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
           "nan_grad", "kill_worker", "join_worker", "leave_worker",
           "split_shard")

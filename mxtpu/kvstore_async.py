@@ -728,6 +728,12 @@ class _Handler(socketserver.BaseRequestHandler):
 class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # socketserver's default accept backlog is 5: a burst of clients
+    # (a redeployed trainer fleet, a serving sweep ramping concurrency)
+    # overflows it and the overflow waits out a full ~1s TCP SYN
+    # retransmit before connecting — observed as a 1000ms connect wall
+    # at >10 simultaneous dials (the kernel clamps this to somaxconn)
+    request_queue_size = 1024
     dying = False    # set synchronously by ParameterServer.stop()/kill():
     #                  serve_forever's shutdown poll is ~0.5s, and a dead
     #                  server must refuse new conversations IMMEDIATELY
@@ -2719,15 +2725,19 @@ _IDEMPOTENT = frozenset(
 
 
 class _Pending:
-    """One in-flight request on a channel."""
+    """One in-flight request on a channel. ``on_partial`` (set before
+    the frame is sent) receives streamed partial replies — frames
+    tagged ``"+"`` that do NOT retire the pending slot; the terminal
+    2-tuple reply still pairs and releases the window as always."""
 
-    __slots__ = ("cid", "event", "reply", "error")
+    __slots__ = ("cid", "event", "reply", "error", "on_partial")
 
-    def __init__(self, cid):
+    def __init__(self, cid, on_partial=None):
         self.cid = cid
         self.event = threading.Event()
         self.reply = None
         self.error = None
+        self.on_partial = on_partial
 
 
 class _Channel:
@@ -2758,15 +2768,17 @@ class _Channel:
         with self._lock:
             return len(self._pending)
 
-    def submit(self, msg, timeout):
+    def submit(self, msg, timeout, on_partial=None):
         """Register a pending slot and send the frame; returns without
         waiting for the reply — up to the window size of these stream
-        back to back on one socket."""
+        back to back on one socket. ``on_partial`` (if given) is called
+        from the receiver thread with each streamed partial reply for
+        this request; the terminal 2-tuple reply still pairs normally."""
         if not self._window.acquire(timeout=timeout):
             raise ConnectionError(
                 "pipelined window stalled %.1fs on %s"
                 % (timeout, self._conn.addr))
-        p = _Pending(next(self._next_cid))
+        p = _Pending(next(self._next_cid), on_partial=on_partial)
         with self._lock:
             if self.dead:
                 self._window.release()
@@ -2820,6 +2832,21 @@ class _Channel:
             except BaseException as e:
                 self.fail(e)
                 return
+            if isinstance(frame, tuple) and len(frame) == 3 \
+                    and frame[2] == "+":
+                # streamed partial: delivered to the pending slot's
+                # callback without retiring it — the window stays held
+                # until the terminal 2-tuple reply pairs.  A partial
+                # for an unknown cid (caller already failed/timed out)
+                # is dropped silently.
+                with self._lock:
+                    p = self._pending.get(frame[0])
+                if p is not None and p.on_partial is not None:
+                    try:
+                        p.on_partial(frame[1])
+                    except BaseException:   # mxlint: allow(except-swallow) — a caller's partial-frame observer raising must not tear the shared channel under every OTHER in-flight request; the terminal reply still pairs and carries the authoritative full answer
+                        pass
+                continue
             if not isinstance(frame, tuple) or len(frame) != 2:
                 self.fail(ConnectionError("unpaired reply frame"))
                 return
@@ -2841,6 +2868,16 @@ class _Channel:
             self._err = err
             pend = list(self._pending.values())
             self._pending.clear()
+        try:
+            # shutdown BEFORE close: close() alone defers the real fd
+            # close while the receiver thread is blocked in recv() on
+            # this socket, so the thread (and the server's handler for
+            # this connection, which never sees our FIN) would linger
+            # until the socket timeout ticks — hundreds of zombie
+            # threads under a connection-churning load
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -3052,6 +3089,72 @@ class _ServerConn:
             "attempt(s): %s (a close right after connect usually means "
             "MXTPU_PS_TOKEN does not match between this worker and the "
             "server)" % (self.addr, msg[0], retries + 1, last)) from last
+
+    def stream(self, *msg, **kw):
+        """Send one command whose reply is a STREAM: zero or more
+        partial frames (tagged ``"+"`` on the wire, delivered to
+        ``on_partial`` from the receiver thread) followed by one
+        terminal reply, which is returned. Never retried here — a
+        partially-streamed command is not idempotent at this layer;
+        the caller replays with its own dedupe (the serving client
+        pins the weight version and dedupes tokens by index)."""
+        on_partial = kw.pop("on_partial", None)
+        timeout = kw.pop("timeout", None)
+        assert not kw, kw
+        timeout = self._timeout if timeout is None else timeout
+        t0 = time.perf_counter()
+        try:
+            srv = self._local_srv()
+            if srv is not None:
+                reply = self._local_stream(srv, msg, timeout, on_partial)
+            else:
+                ch = self._channel()
+                p = ch.submit(msg, timeout, on_partial=on_partial)
+                reply = ch.wait(p, msg, timeout)
+        except (ConnectionError, EOFError, OSError) as e:
+            self._note_failure(e)
+            raise
+        self._note_ok()
+        _KVC_RPC_MS.labels(msg[0]).observe(
+            (time.perf_counter() - t0) * 1e3)
+        if reply[0] == "err":
+            raise RuntimeError("parameter server: %s" % reply[1])
+        return reply
+
+    def _local_stream(self, srv, msg, timeout, on_partial):
+        """In-process mirror of :meth:`stream`, with the same fault
+        points as ``_local_call`` plus one ``server.send`` fire per
+        partial frame (a dropped partial is silently skipped, exactly
+        like a dropped wire frame — the client recovers the token from
+        the terminal reply)."""
+        op = msg[0]
+        key = msg[1] if len(msg) > 1 and isinstance(msg[1], (str, int)) \
+            else None
+        if srv._tcp.dying:
+            raise ConnectionError(
+                "in-process server %s is down" % self.addr)
+        dropped = _fault.fire("worker.send", op=op, key=key) == "drop"
+        if not dropped:
+            _fault.fire("server.recv", op=op, key=key, server=srv)
+
+            def emit(partial):
+                if on_partial is None:
+                    return
+                if _fault.fire("server.send", op=op, key=key,
+                               server=srv) == "drop":
+                    return
+                on_partial(partial)
+
+            reply = srv._dispatch_stream(msg, emit)
+            if _fault.fire("server.send", op=op, key=key,
+                           server=srv) != "drop":
+                _fault.fire("worker.recv", op=op, key=key)
+                self._stats.add("local_reqs")
+                return reply
+        time.sleep(timeout)
+        raise ConnectionError(
+            "no reply within %.1fs for %r from %s"
+            % (timeout, op, self.addr))
 
     def request_all(self, msgs, timeout=None, return_exceptions=False):
         """Pipelined fan-out: submit every message before waiting for

@@ -597,6 +597,66 @@ def identity(data):
     return data
 
 
+# ---------------------------------------------------------------------------
+# KV-cached causal self-attention (the serving decode primitive, ISSUE 17).
+# One op serves BOTH phases of autoregressive generation and training:
+#   * prefill / training: pos=0, a T-token chunk writes cache rows 0..T-1
+#     and each position t attends rows j <= t (exact causal attention —
+#     feeding zero caches with pos=0 and S >= T degenerates to plain
+#     causal self-attention, so the train and generate symbols share it);
+#   * decode: T=1, pos=p writes row p and attends rows j <= p.
+# The updated caches are real outputs: the serving engine compiles them
+# as DONATED inputs aliased to outputs, so the packed per-slot KV state
+# never leaves the device between steps.
+# Correctness under padded prefill: rows past the true prompt length hold
+# garbage K/V, but the causal mask only ever exposes row j once j <= pos
+# of a later step — and the decode step at position j OVERWRITES row j
+# before attending it, so garbage is never visible.
+# ---------------------------------------------------------------------------
+
+@register("cached_attention", num_outputs=3)
+def cached_attention(query, key, value, k_cache, v_cache, pos, num_heads=1,
+                     alibi=False):
+    """query/key/value ``[B, T, D]``; caches ``[B, S, D]``; ``pos [B]``
+    (write offset per sample). Returns ``(out, k_cache_next,
+    v_cache_next)``. ``alibi=True`` adds the parameter-free linear
+    distance bias (Press et al.) — per-head slope ``2^(-8(i+1)/H)``
+    times the query-key distance ``(pos + t) - s``. Because the
+    distance is computed from the ABSOLUTE cache positions, the bias is
+    bit-identical between a T-token prefill/training chunk and a
+    one-token decode step — positional information with zero extra
+    state to carry between steps."""
+    p = pos.astype(jnp.int32).reshape(-1)
+    B, T, D = query.shape
+    S = k_cache.shape[1]
+    H = int(num_heads)
+    hd = D // H
+    write = jax.vmap(
+        lambda cache, rows, at: lax.dynamic_update_slice(cache, rows, (at, 0)))
+    new_k = write(k_cache, key.astype(k_cache.dtype), p)
+    new_v = write(v_cache, value.astype(v_cache.dtype), p)
+    qh = query.reshape(B, T, H, hd)
+    kh = new_k.astype(query.dtype).reshape(B, S, H, hd)
+    vh = new_v.astype(query.dtype).reshape(B, S, H, hd)
+    scores = jnp.einsum("bthd,bshd->bhts", qh, kh) / jnp.sqrt(
+        jnp.asarray(hd, query.dtype))
+    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :, None]
+    s_idx = jnp.arange(S, dtype=jnp.int32)[None, None, :]
+    q_abs = p[:, None, None] + t_idx                     # [B, T, 1]
+    allowed = s_idx <= q_abs                             # [B, T, S]
+    if alibi and str(alibi).lower() not in ("false", "0"):
+        slopes = jnp.asarray(
+            [2.0 ** (-8.0 * (i + 1) / H) for i in range(H)],
+            scores.dtype)
+        dist = (q_abs - s_idx).astype(scores.dtype)      # [B, T, S]
+        scores = scores - slopes[None, :, None, None] * dist[:, None]
+    scores = jnp.where(allowed[:, None, :, :], scores,
+                       jnp.asarray(-1e30, scores.dtype))
+    att = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", att, vh).reshape(B, T, D)
+    return out.astype(query.dtype), new_k, new_v
+
+
 @register("SVMOutput")
 def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                use_linear=False):

@@ -18,7 +18,14 @@ out of machinery this tree already trusts:
   Admission control is a bounded queue (``MXTPU_SERVE_QUEUE_DEPTH``)
   that sheds with a RETRIABLE ``overloaded`` verdict, and per-request
   deadlines ride the wire: an expired request is dropped BEFORE
-  dispatch (never after) with the ``expired`` verdict.
+  dispatch (never after) with the ``expired`` verdict. The same module
+  hosts :class:`~mxtpu.serving.batcher.GenerateScheduler`, the
+  CONTINUOUS scheduler behind the ``generate`` op: slot-indexed decode
+  lanes step every in-flight sequence in one donated-buffer XLA
+  dispatch, sequences join/leave at step boundaries without draining
+  the batch, and a budget exhausted BETWEEN decode steps frees the
+  slot with the ``expired`` verdict (docs/serving.md "Continuous
+  batching & generation").
 * :mod:`mxtpu.serving.server` — the replica process: kvstore_async's
   PR-2 transport verbatim (zero-copy pickle-5 frames, pipelined
   windows, token auth, the ``MXTPU_PS_LOCAL`` in-process shortcut) —
@@ -58,12 +65,14 @@ measured behavior: ``tools/bench_serving.py`` →
 from __future__ import annotations
 
 from .engine import InferenceEngine, parse_buckets, parse_shape_spec
-from .batcher import DynamicBatcher, RETRIABLE_VERDICTS
+from .batcher import (DynamicBatcher, GenerateScheduler,
+                      RETRIABLE_VERDICTS)
 from .server import ModelServer
 from .client import ServingClient, Overloaded, DeadlineExceeded
 from .rollout import RolloutController, WeightPublisher, WeightSync
 
-__all__ = ["InferenceEngine", "DynamicBatcher", "ModelServer",
-           "ServingClient", "Overloaded", "DeadlineExceeded",
-           "RolloutController", "WeightPublisher", "WeightSync",
-           "RETRIABLE_VERDICTS", "parse_buckets", "parse_shape_spec"]
+__all__ = ["InferenceEngine", "DynamicBatcher", "GenerateScheduler",
+           "ModelServer", "ServingClient", "Overloaded",
+           "DeadlineExceeded", "RolloutController", "WeightPublisher",
+           "WeightSync", "RETRIABLE_VERDICTS", "parse_buckets",
+           "parse_shape_spec"]

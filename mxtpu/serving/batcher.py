@@ -36,15 +36,18 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
 
 import numpy as _np
+import jax as _jax
 
 from .. import fault as _fault
 from .. import obs as _obs
 
-__all__ = ["DynamicBatcher", "Request"]
+__all__ = ["DynamicBatcher", "Request", "GenerateScheduler",
+           "GenRequest", "RETRIABLE_VERDICTS"]
 
 # batcher instruments (ISSUE 14): every stats() field is a registry
 # series labeled by batcher instance — the dict API reads the series
@@ -368,3 +371,468 @@ class DynamicBatcher:
         for s in list(self._c.values()) + list(self._g.values()):
             s.drop()
         self._queued_g.drop()
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching for autoregressive generation (ISSUE 17).
+#
+# Where DynamicBatcher coalesces-flushes-disbands, the scheduler keeps
+# ONE in-flight decode batch alive and lets sequences join and leave it
+# at every step boundary: a finished sequence frees its slot, a queued
+# prefill is adopted into a free slot — the decode batch never drains.
+# Per-step cost is constant (the decode program is compiled for a fixed
+# slot capacity; inactive slots compute garbage), so aggregate tokens/s
+# scales with the number of ACTIVE sequences — the continuous-batching
+# throughput story tools/bench_serving.py measures and
+# ci/check_generate_perf.py pins.
+#
+# Versions: a sequence's weight version resolves ONCE at admission and
+# the store tuple rides the sequence's decode LANE — a packed batch of
+# slots all on one version. A hot-swap never tears an in-flight
+# sequence: its lane keeps the resolved store alive by reference while
+# new admissions open a lane on the new version; the old lane drains
+# naturally. A replayed sequence that already streamed tokens pins its
+# admission version (engine.store_exact) — never a silent rebind.
+# ---------------------------------------------------------------------------
+
+_GEN_COUNTERS = {
+    "sequences": _obs.counter(
+        "serve.gen.sequences", "generate sequences admitted", ("inst",)),
+    "finished": _obs.counter(
+        "serve.gen.finished", "sequences finished (eos/len)", ("inst",)),
+    "expired": _obs.counter(
+        "serve.gen.expired", "sequences expired (at dequeue or "
+        "mid-generation between decode steps)", ("inst",)),
+    "shed_queue_full": _obs.counter(
+        "serve.gen.shed_queue_full", "generate submits shed at depth",
+        ("inst",)),
+    "steps": _obs.counter(
+        "serve.gen.steps", "decode steps dispatched", ("inst",)),
+    "tokens": _obs.counter(
+        "serve.gen.tokens", "tokens generated (decode + prefill first "
+        "tokens)", ("inst",)),
+    "prefills": _obs.counter(
+        "serve.gen.prefills", "prefill dispatches", ("inst",)),
+    "step_faults": _obs.counter(
+        "serve.gen.step_faults", "decode steps lost to injected faults",
+        ("inst",)),
+}
+_GEN_GAUGES = {
+    "slots_active": _obs.gauge(
+        "serve.gen.slots_active", "in-flight sequences across lanes",
+        ("inst",)),
+    "lanes": _obs.gauge(
+        "serve.gen.lanes", "live decode lanes (one per weight version)",
+        ("inst",)),
+    "queue_hwm": _obs.gauge(
+        "serve.gen.queue_hwm", "generate queue high-water mark",
+        ("inst",)),
+}
+_GEN_TTFT_MS = _obs.histogram(
+    "serve.gen.ttft_ms", "admission -> first token wall time")
+_GEN_STEP_MS = _obs.histogram(
+    "serve.gen.step_ms", "decode step wall time (one XLA dispatch)")
+_GEN_INST = itertools.count(1)
+
+
+def gen_lanes_max():
+    """MXTPU_SERVE_GENERATE_LANES: concurrent decode lanes (one per
+    weight version in flight) — 2 covers a hot-swap window: the old
+    version drains while the new one serves."""
+    return max(1, int(os.environ.get("MXTPU_SERVE_GENERATE_LANES", "2")))
+
+
+class GenRequest:
+    """One admitted generate sequence.
+
+    Same two delivery styles as :class:`Request` (blocking
+    :meth:`wait` / :meth:`on_resolve`), plus a PER-TOKEN stream:
+    ``on_token(idx, tok, version)`` fires for every generated token, in
+    order, from the scheduler thread — the wire handler turns each into
+    a partial reply frame riding the pipelined sender. The terminal
+    ``ok`` reply repeats the FULL token list, so a dropped token frame
+    is recovered from the terminal reply, never re-generated."""
+
+    __slots__ = ("rid", "prompt", "max_new", "eos_id", "deadline",
+                 "enq_t", "event", "reply", "wait_bound", "version",
+                 "pinned", "tokens_out", "on_token", "_cbs", "_cb_lock",
+                 "tctx", "store")
+
+    def __init__(self, rid, prompt, max_new, deadline, wait_bound=120.0,
+                 version=None, pinned=False, eos_id=None, on_token=None,
+                 tctx=None):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.wait_bound = wait_bound
+        self.version = version
+        self.pinned = bool(pinned)
+        self.on_token = on_token
+        self.tctx = tctx
+        self.store = None              # (params, aux) resolved at admission
+        self.enq_t = time.monotonic()
+        self.event = threading.Event()
+        self.reply = None
+        self.tokens_out = []
+        self._cbs = []
+        self._cb_lock = threading.Lock()
+
+    def emit(self, tok):
+        """Record + stream one generated token (scheduler thread only)."""
+        idx = len(self.tokens_out)
+        self.tokens_out.append(int(tok))
+        cb = self.on_token
+        if cb is not None:
+            cb(idx, int(tok), self.version)
+
+    def on_resolve(self, cb):
+        with self._cb_lock:
+            if self.reply is None:
+                self._cbs.append(cb)
+                return
+        cb(self.reply)
+
+    def resolve(self, reply):
+        with self._cb_lock:
+            if self.reply is not None:
+                return
+            self.reply = reply
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            cb(reply)
+        self.event.set()
+
+    def wait(self, timeout=None):
+        timeout = self.wait_bound if timeout is None else timeout
+        if not self.event.wait(timeout):
+            return ("err", "no decode progress within %.1fs for %s"
+                    % (timeout, self.rid))
+        return self.reply
+
+    def _finish(self, reason):
+        return ("ok", {"rid": self.rid,
+                       "tokens": _np.asarray(self.tokens_out, _np.int32),
+                       "n": len(self.tokens_out),
+                       "version": self.version,
+                       "reason": reason})
+
+
+class _GenLane:
+    """One packed decode batch: every slot on ONE weight version whose
+    store tuple is held by reference — a swap or store GC can never
+    tear the lane's in-flight sequences."""
+
+    __slots__ = ("version", "store", "state", "slot_req", "active")
+
+    def __init__(self, version, store, state, capacity):
+        self.version = version
+        self.store = store             # (param_vals, aux_vals)
+        self.state = state             # [tok_feed, pos, states]
+        self.slot_req = [None] * capacity
+        self.active = 0
+
+
+class GenerateScheduler:
+    """Continuous decode scheduler in front of one generative
+    :class:`InferenceEngine`."""
+
+    def __init__(self, engine, queue_depth, server=None, slots=None,
+                 lanes=None):
+        from .engine import gen_slots, gen_max_new
+        self._engine = engine
+        self._depth = int(queue_depth)
+        self._slots = int(slots) if slots else gen_slots()
+        self._max_lanes = int(lanes) if lanes else gen_lanes_max()
+        self._max_new_cap = gen_max_new()
+        self._server = server
+        self._cv = threading.Condition()
+        self._queue = collections.deque()
+        self._lanes = {}               # version -> _GenLane
+        self._active = 0
+        self._stopped = False
+        self._killed = None            # hard-stop error message
+        inst = "g%d" % next(_GEN_INST)
+        self._c = {f: m.labels(inst) for f, m in _GEN_COUNTERS.items()}
+        self._g = {f: m.labels(inst) for f, m in _GEN_GAUGES.items()}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="mxtpu-serve-generate")
+        self._thread.start()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, rid, prompt, max_new, deadline, wait_bound=120.0,
+               version=None, pinned=False, eos_id=None, on_token=None,
+               tctx=None):
+        """Admit one sequence. Returns the parked :class:`GenRequest`
+        or a verdict tuple: ``overloaded`` (queue at depth, retriable),
+        ``draining``, or ``err`` (a pinned replay version no longer
+        resident — honest refusal beats a torn stream)."""
+        prompt = _np.asarray(prompt).reshape(-1)
+        plen = int(prompt.shape[0])
+        spec = self._engine.generate_spec()
+        cache_len = spec["cache_len"]
+        if plen < 1 or plen >= cache_len:
+            return ("err", "prompt length %d out of range [1, %d)"
+                    % (plen, cache_len))
+        self._engine.gen_bucket_for(plen)     # raises -> caller's err
+        max_new = max(1, min(int(max_new), self._max_new_cap,
+                             cache_len - plen))
+        if pinned and version is not None:
+            store = self._engine.store_exact(version)
+            if store is None:
+                return ("err", "weight version %r is no longer resident"
+                               " — cannot replay a pinned sequence"
+                        % (version,))
+            answered = int(version)
+        else:
+            params, aux, answered = self._engine._resolve_store(version)
+            store = (params, aux)
+        with self._cv:
+            if self._stopped:
+                return ("draining", {"reason": "scheduler stopped"})
+            if len(self._queue) + self._active >= self._depth:
+                self._c["shed_queue_full"].inc()
+                return ("overloaded",
+                        {"queue_depth": self._depth,
+                         "queued": len(self._queue) + self._active})
+            req = GenRequest(rid, prompt, max_new, deadline,
+                             wait_bound=wait_bound, version=answered,
+                             pinned=pinned, eos_id=eos_id,
+                             on_token=on_token, tctx=tctx)
+            req.store = store
+            self._queue.append(req)
+            self._c["sequences"].inc()
+            self._g["queue_hwm"].set_max(len(self._queue))
+            self._cv.notify_all()
+            return req
+
+    # -- the scheduler thread ----------------------------------------------
+    def _run(self):
+        # the lane table (self._lanes) is OWNED by this thread: every
+        # touch — placement, stepping, retirement, the fail-everything
+        # teardown — happens here. stop() never reaches in; it posts
+        # _killed and joins, and THIS loop runs the teardown on its way
+        # out, so a hard stop can never race a decode step over the
+        # lane it is tearing down.
+        while True:
+            with self._cv:
+                if self._killed is not None:
+                    break
+                if not self._queue and self._active == 0:
+                    if self._stopped:
+                        return
+                    self._cv.wait(timeout=0.05)
+                    continue
+            try:
+                self._admit_queued()
+                self._step_lanes()
+            except BaseException as e:
+                # an injected kill/sever at serve.step: this replica is
+                # going down — every in-flight and queued sequence fails
+                # fast; clients replay on the surviving replica
+                self._c["step_faults"].inc()
+                self._fail_all("replica failed mid-batch: %s" % e)
+                return
+        self._fail_all(self._killed)
+
+    def _admit_queued(self):
+        """Move queued sequences into free slots: prefill + adopt at
+        the step boundary — the in-flight batch never drains to admit.
+        Expiry is ALSO decided here (dequeue) for queued sequences."""
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+        keep, expired = [], []
+        now = time.monotonic()
+        for req in pending:
+            if req.deadline is not None and now >= req.deadline:
+                expired.append(req)
+                continue
+            lane = self._lane_for(req)
+            if lane is None:
+                keep.append(req)       # no lane/slot yet: stays queued
+                continue
+            slot = lane.slot_req.index(None)
+            self._prefill_into(req, lane, slot)
+        with self._cv:
+            self._queue.extendleft(reversed(keep))
+            self._cv.notify_all()
+        for req in expired:
+            self._c["expired"].inc()
+            req.resolve(("expired",
+                         {"rid": req.rid, "generated": 0,
+                          "late_ms": round((now - req.deadline) * 1e3,
+                                           3)}))
+
+    def _lane_for(self, req):
+        """The lane answering ``req.version`` with a free slot, created
+        on demand (evicting an idle lane when at the lane cap), or None
+        when the sequence cannot be placed this step."""
+        lane = self._lanes.get(req.version)
+        if lane is not None:
+            return lane if lane.active < len(lane.slot_req) else None
+        if len(self._lanes) >= self._max_lanes:
+            idle = [v for v, ln in self._lanes.items() if ln.active == 0]
+            if not idle:
+                return None
+            del self._lanes[idle[0]]
+        lane = _GenLane(req.version, req.store,
+                        self._engine.gen_state_init(self._slots),
+                        self._slots)
+        self._lanes[req.version] = lane
+        self._g["lanes"].set_max(len(self._lanes))
+        return lane
+
+    def _prefill_into(self, req, lane, slot):
+        self._c["prefills"].inc()
+        try:
+            first, rows = self._engine.gen_prefill(
+                req.prompt, lane.store[0], lane.store[1])
+        except Exception as e:
+            req.resolve(("err", "prefill failed: %s: %s"
+                         % (type(e).__name__, e)))
+            return
+        tok0 = int(_jax.device_get(first)[0])
+        _GEN_TTFT_MS.observe((time.monotonic() - req.enq_t) * 1e3)
+        self._c["tokens"].inc()
+        req.emit(tok0)
+        if (req.max_new <= 1
+                or (req.eos_id is not None and tok0 == req.eos_id)):
+            self._c["finished"].inc()
+            req.resolve(req._finish(
+                "eos" if req.eos_id is not None and tok0 == req.eos_id
+                else "len"))
+            return
+        lane.state = self._engine.gen_adopt(
+            lane.state, first, int(req.prompt.shape[0]), rows, slot)
+        lane.slot_req[slot] = req
+        lane.active += 1
+        with self._cv:
+            self._active += 1
+        self._g["slots_active"].set(self._active)
+
+    def _step_lanes(self):
+        for lane in list(self._lanes.values()):
+            if lane.active == 0:
+                continue
+            act = _fault.fire("serve.step", op="generate",
+                              key="active=%d" % lane.active,
+                              server=self._server)
+            if act == "drop":
+                self._c["step_faults"].inc()
+                for slot, req in enumerate(lane.slot_req):
+                    if req is not None:
+                        self._free(lane, slot)
+                        req.resolve(("err",
+                                     "decode step dropped (injected)"))
+                continue
+            t0 = time.perf_counter()
+            nxt, lane.state = self._engine.gen_step(
+                lane.state, lane.store[0], lane.store[1])
+            toks = _jax.device_get(nxt)       # the ONE per-step host read
+            _GEN_STEP_MS.observe((time.perf_counter() - t0) * 1e3)
+            self._c["steps"].inc()
+            self._c["tokens"].inc(lane.active)
+            now = time.monotonic()
+            for slot, req in enumerate(lane.slot_req):
+                if req is None:
+                    continue
+                req.emit(int(toks[slot]))
+                if ((req.eos_id is not None
+                     and int(toks[slot]) == req.eos_id)
+                        or len(req.tokens_out) >= req.max_new):
+                    self._free(lane, slot)
+                    self._c["finished"].inc()
+                    req.resolve(req._finish(
+                        "eos" if req.eos_id is not None
+                        and int(toks[slot]) == req.eos_id else "len"))
+                elif req.deadline is not None and now >= req.deadline:
+                    # the mid-generation expiry fix (ISSUE 17 satellite):
+                    # a budget exhausted BETWEEN decode steps frees the
+                    # slot now instead of decoding forever
+                    self._free(lane, slot)
+                    self._c["expired"].inc()
+                    req.resolve(("expired",
+                                 {"rid": req.rid,
+                                  "generated": len(req.tokens_out),
+                                  "late_ms": round(
+                                      (now - req.deadline) * 1e3, 3)}))
+        self._g["slots_active"].set(self._active)
+        # retire empty lanes off the current stable version — a drained
+        # hot-swap lane releases its store reference here
+        stable = self._engine.version_state()["version"]
+        for v in [v for v, ln in self._lanes.items()
+                  if ln.active == 0 and v != stable]:
+            del self._lanes[v]
+
+    def _free(self, lane, slot):
+        lane.slot_req[slot] = None
+        lane.active -= 1
+        with self._cv:
+            self._active -= 1
+            self._cv.notify_all()
+
+    def _fail_all(self, msg):
+        with self._cv:
+            pend = list(self._queue)
+            self._queue.clear()
+            self._killed = msg
+            self._cv.notify_all()
+        for lane in self._lanes.values():
+            for slot, req in enumerate(lane.slot_req):
+                if req is not None:
+                    lane.slot_req[slot] = None
+                    req.resolve(("err", msg))
+            lane.active = 0
+        self._lanes.clear()
+        with self._cv:
+            self._active = 0
+        for req in pend:
+            req.resolve(("err", msg))
+
+    # -- lifecycle ---------------------------------------------------------
+    def pending(self):
+        with self._cv:
+            return len(self._queue) + self._active
+
+    def drain(self, timeout=30.0):
+        """Finish every admitted sequence, then stop the thread. The
+        server must have stopped admissions FIRST. Bounded."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+            while self._queue or self._active:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=min(0.1, left))
+        self._thread.join(timeout=max(0.1, deadline - time.monotonic()))
+        return True
+
+    def stop(self):
+        """Hard stop (crash path): fail everything queued + in flight.
+        The teardown itself runs ON the scheduler thread (it owns the
+        lane table); this just posts the verdict and waits it out. A
+        thread that already exited left nothing queued or in flight:
+        graceful drain returns only once both are empty, and the
+        step-fault path tears everything down on its way out."""
+        with self._cv:
+            self._stopped = True
+            self._killed = "server stopped"
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)
+        self.release_metrics()
+
+    def stats(self):
+        out = {f: s.value for f, s in self._c.items()}
+        out.update({f: s.value for f, s in self._g.items()})
+        with self._cv:
+            out["queued"] = len(self._queue)
+            out["active"] = self._active
+        return out
+
+    def release_metrics(self):
+        for s in list(self._c.values()) + list(self._g.values()):
+            s.drop()

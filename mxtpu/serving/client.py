@@ -67,6 +67,13 @@ def _default_budget_ms():
     return float(os.environ.get("MXTPU_SERVE_DEADLINE_MS", "1000"))
 
 
+def _default_generate_budget_ms():
+    # generation budgets the whole multi-step sequence, so its default
+    # (MXTPU_SERVE_GENERATE_DEADLINE_MS) is far larger than predict's
+    return float(os.environ.get("MXTPU_SERVE_GENERATE_DEADLINE_MS",
+                                "30000"))
+
+
 class ServingClient:
     """One application's view of a serving replica set."""
 
@@ -275,6 +282,149 @@ class ServingClient:
                 verdicts=verdicts)
         raise ConnectionError(
             "request %s failed on every replica: %s" % (rid, last_err))
+
+    # -- the generate path -------------------------------------------------
+    def generate(self, tokens, max_new=64, budget_ms=None, model=None,
+                 eos_id=None, on_token=None):
+        """Autoregressive generation: returns the generated token list.
+        ``tokens`` is the 1-D int prompt; ``on_token(idx, tok, version)``
+        (optional) fires per streamed token, in order, exactly once —
+        even across a replica failover mid-sequence."""
+        toks, _info = self.generate2(tokens, max_new=max_new,
+                                     budget_ms=budget_ms, model=model,
+                                     eos_id=eos_id, on_token=on_token)
+        return toks
+
+    def generate2(self, tokens, max_new=64, budget_ms=None, model=None,
+                  eos_id=None, on_token=None):
+        """:meth:`generate` plus the terminal info dict — notably
+        ``info["version"]`` (the one weight version the WHOLE sequence
+        answered from) and ``info["reason"]`` (``eos``/``len``)."""
+        if not self._tracer.sample():
+            return self._generate2_impl(tokens, max_new, budget_ms,
+                                        model, eos_id, on_token)
+        tok = _obs.start_trace()
+        try:
+            with _obs.span("serve.client.generate"):
+                return self._generate2_impl(tokens, max_new, budget_ms,
+                                            model, eos_id, on_token)
+        finally:
+            _obs.end_trace(tok)
+
+    def _generate2_impl(self, tokens, max_new, budget_ms, model,
+                        eos_id, on_token):
+        """Exactly-once streaming with in-place failover.
+
+        The client pins the weight version from the FIRST token frame
+        it sees; a replay after a connection failure carries the
+        ORIGINAL rid plus that pinned version, so the surviving replica
+        regenerates the identical deterministic sequence and the
+        idx-based dedupe below turns the replayed prefix into no-ops —
+        the caller's ``on_token`` observes every index exactly once, in
+        order. Tokens whose partial frames were dropped/severed are
+        recovered from the terminal ``ok`` reply (which repeats the
+        full list), never re-generated."""
+        prompt = _np.ascontiguousarray(
+            _np.asarray(tokens, _np.int32).reshape(-1))
+        budget = _default_generate_budget_ms() if budget_ms is None \
+            else float(budget_ms)
+        rid = "%s:%d" % (self._origin, next(self._seq))
+        self._bump("requests")
+        timeout = self._request_timeout(budget)
+        out_tokens = []
+        pinned = [None]            # version from the first token frame
+        plock = threading.Lock()
+
+        def _on_partial(reply):
+            if not isinstance(reply, tuple) or len(reply) != 4 \
+                    or reply[0] != "tok":
+                return
+            _, idx, tok, ver = reply
+            with plock:
+                if pinned[0] is None:
+                    pinned[0] = ver
+                if idx != len(out_tokens):
+                    return     # replayed/duplicated frame: already have it
+                out_tokens.append(int(tok))
+            if on_token is not None:
+                on_token(idx, int(tok), ver)
+
+        verdicts, last_err = [], None
+        with self._lock:
+            n_replicas = len(self._addrs)
+        for attempt in range(n_replicas + 1):
+            i, addr = self._active()
+            if any(a == addr for a, _, _ in verdicts):
+                break          # rotation came back to a shed replica
+            if attempt:
+                self._bump("replays")
+            opts = {"max_new": int(max_new), "budget_ms": budget}
+            if eos_id is not None:
+                opts["eos_id"] = int(eos_id)
+            if model is not None:
+                opts["model"] = model
+            with plock:
+                if pinned[0] is not None:
+                    opts["version"] = pinned[0]
+            try:
+                conn = self._conn_for(addr)
+                reply = conn.stream("generate", rid, prompt, opts,
+                                    timeout=timeout,
+                                    on_partial=_on_partial)
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                if self._probe(addr):
+                    continue   # alive: replay rid on the same route
+                self._fail_over(i)
+                continue
+            except RuntimeError as e:
+                if "replica failed mid-batch" in str(e) \
+                        or "server stopped" in str(e):
+                    last_err = e
+                    self._fail_over(i)
+                    continue
+                raise
+            verdict = reply[0]
+            if verdict == "ok":
+                self._bump("responses")
+                info = reply[1] if isinstance(reply[1], dict) else {}
+                full = [int(t) for t in
+                        _np.asarray(info.get("tokens", ()),
+                                    _np.int64).reshape(-1)]
+                with plock:
+                    recovered_from = len(out_tokens)
+                    out_tokens.extend(full[recovered_from:])
+                if on_token is not None:
+                    # tokens whose partial frames were lost on the wire:
+                    # delivered now from the authoritative terminal list
+                    for idx in range(recovered_from, len(full)):
+                        on_token(idx, full[idx], info.get("version"))
+                return list(out_tokens), info
+            if verdict == "_no_reply":
+                last_err = ConnectionError("request %s dropped" % rid)
+                self._fail_over(i)
+                continue
+            if verdict == "expired":
+                self._bump("expired")
+                raise DeadlineExceeded(
+                    "sequence %s expired mid-generation (budget %.0fms, "
+                    "%d token(s) generated, %.1fms late)"
+                    % (rid, budget, reply[1].get("generated", 0),
+                       reply[1].get("late_ms", 0.0)))
+            if verdict in ("overloaded", "draining"):
+                verdicts.append((addr, verdict, reply[1]))
+                if not self._fail_over(i):
+                    break
+                continue
+            raise RuntimeError("unexpected generate verdict %r" % (reply,))
+        if verdicts:
+            self._bump("shed")
+            raise Overloaded(
+                "sequence %s shed by all replicas: %s"
+                % (rid, [(a, v) for a, v, _ in verdicts]),
+                verdicts=verdicts)
+        raise ConnectionError(
+            "sequence %s failed on every replica: %s" % (rid, last_err))
 
     def _probe(self, addr):
         try:

@@ -54,6 +54,7 @@ import zlib
 import numpy as _np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..base import canonical_dtype
 from ..checkpoint import weight_digest
@@ -71,6 +72,28 @@ def version_keep():
     request admitted against version v is still answerable after the
     next swap lands mid-batch."""
     return max(1, int(os.environ.get("MXTPU_SERVE_VERSION_KEEP", "2")))
+
+
+def gen_slots():
+    """MXTPU_SERVE_GENERATE_SLOTS: decode-batch capacity — the fixed
+    slot count every decode program is compiled for. One XLA dispatch
+    per step serves up to this many in-flight sequences."""
+    return max(1, int(os.environ.get("MXTPU_SERVE_GENERATE_SLOTS", "32")))
+
+
+def gen_max_new():
+    """MXTPU_SERVE_GENERATE_MAX_NEW: hard cap on tokens generated per
+    sequence (a request's ``max_new`` is clamped to it)."""
+    return max(1, int(os.environ.get("MXTPU_SERVE_GENERATE_MAX_NEW",
+                                     "256")))
+
+
+def gen_prefill_buckets():
+    """MXTPU_SERVE_GENERATE_PREFILL_BUCKETS: prompt-length buckets the
+    prefill programs are compiled for (same grammar as
+    MXTPU_SERVE_BUCKETS; a prompt pads into the smallest fit)."""
+    return parse_buckets(os.environ.get(
+        "MXTPU_SERVE_GENERATE_PREFILL_BUCKETS", "8,16,32"))
 
 
 def parse_buckets(spec):
@@ -137,6 +160,7 @@ class InferenceEngine:
                                   if n not in self._data_names
                                   and n not in arg_params)
         self._aux_names = tuple(aux_names)
+        self._gen = self._detect_generate()
         # one shared device-resident copy of params/aux for all buckets,
         # per weight VERSION: an immutable store tuple swap_weights
         # replaces wholesale (programs take params as runtime arguments,
@@ -168,7 +192,8 @@ class InferenceEngine:
         self._stats_lock = threading.Lock()
         self._stats = {"predicts": 0, "rows": 0, "pad_rows": 0,
                        "swaps": 0, "swaps_refused": 0,
-                       "version_rebinds": 0}
+                       "version_rebinds": 0,
+                       "gen_prefills": 0, "gen_steps": 0}
         if warm:
             self.warm()
 
@@ -200,11 +225,14 @@ class InferenceEngine:
 
     def signature(self):
         """The wire-visible input contract (hello reply)."""
-        return {"data_names": list(self._data_names),
-                "sample_shapes": {n: list(s) for n, s
-                                  in self._sample_shapes.items()},
-                "dtype": str(_np.dtype(self._dtype)),
-                "buckets": list(self._buckets)}
+        sig = {"data_names": list(self._data_names),
+               "sample_shapes": {n: list(s) for n, s
+                                 in self._sample_shapes.items()},
+               "dtype": str(_np.dtype(self._dtype)),
+               "buckets": list(self._buckets)}
+        if self._gen is not None:
+            sig["generate"] = self.generate_spec()
+        return sig
 
     def stats(self):
         with self._stats_lock:
@@ -485,6 +513,15 @@ class InferenceEngine:
             self._note("version_rebinds")
         return store[0], store[1], v
 
+    def store_exact(self, version):
+        """``(params, aux)`` for EXACTLY ``version``, or None. The
+        pinned-replay resolver for generation: a replayed sequence that
+        already streamed tokens must never silently rebind to stable —
+        that would tear the token stream across weight versions."""
+        with self._store_lock:
+            store = self._stores.get(int(version))
+        return None if store is None else (store[0], store[1])
+
     def check_rows(self, arrays):
         """Validate one request payload (a list/tuple of numpy arrays,
         one per data input in ``data_names`` order). Returns the row
@@ -524,24 +561,49 @@ class InferenceEngine:
                          % (rows, self.max_bucket))
 
     # -- program construction ---------------------------------------------
+    def _declared_var_specs(self):
+        """``name -> (shape, dtype)`` for every symbol VARIABLE that
+        declared a ``__shape__`` with a leading 0 (batch) dimension —
+        the per-sample contract generative state vars ride (shape
+        inference cannot derive them: nothing upstream constrains a
+        cache input's shape)."""
+        out = {}
+        for node_name, attrs in self._symbol.attr_dict().items():
+            s = attrs.get("__shape__")
+            if s is None:
+                continue
+            s = tuple(int(d) for d in s)
+            if s and s[0] == 0 and all(d > 0 for d in s[1:]):
+                out[node_name] = (s, canonical_dtype(
+                    attrs.get("__dtype__", self._dtype)))
+        return out
+
     def _extra_shapes(self, bucket):
-        """Inferred shapes of the loss-head leftovers for ``bucket``
-        (label vars scale with the batch: SoftmaxOutput's shape hint
-        derives them from the data shape)."""
+        """(name, shape, dtype) of the non-data non-param leftovers for
+        ``bucket``: label vars a training head carries (inferred — the
+        SoftmaxOutput shape hint scales them with the batch) and
+        generative state vars (declared ``__shape__``, batch dim 0)."""
         if not self._extra_names:
             return ()
-        kwargs = {n: (bucket,) + self._sample_shapes[n]
-                  for n in self._data_names}
-        arg_shapes, _outs, _aux = self._symbol.infer_shape(**kwargs)
-        by_name = dict(zip(self._symbol.list_arguments(), arg_shapes))
-        missing = [n for n in self._extra_names if by_name.get(n) is None]
+        declared = self._declared_var_specs()
+        resolved = {n: ((bucket,) + declared[n][0][1:], declared[n][1])
+                    for n in self._extra_names if n in declared}
+        missing = [n for n in self._extra_names if n not in resolved]
         if missing:
-            raise ValueError(
-                "symbol arguments %r are neither checkpoint parameters "
-                "nor declared data inputs, and their shapes cannot be "
-                "inferred — pass them in data_shapes or the checkpoint"
-                % (missing,))
-        return tuple((n, tuple(by_name[n])) for n in self._extra_names)
+            kwargs = {n: (bucket,) + self._sample_shapes[n]
+                      for n in self._data_names}
+            arg_shapes, _outs, _aux = self._symbol.infer_shape(**kwargs)
+            by_name = dict(zip(self._symbol.list_arguments(), arg_shapes))
+            bad = [n for n in missing if by_name.get(n) is None]
+            if bad:
+                raise ValueError(
+                    "symbol arguments %r are neither checkpoint "
+                    "parameters nor declared data inputs, and their "
+                    "shapes cannot be inferred — pass them in "
+                    "data_shapes or declare var shapes" % (bad,))
+            for n in missing:
+                resolved[n] = (tuple(by_name[n]), self._dtype)
+        return tuple((n,) + resolved[n] for n in self._extra_names)
 
     def _build_program(self, bucket):
         """Lower + compile the bucket's forward AOT. Donation: the
@@ -552,16 +614,15 @@ class InferenceEngine:
         aux_names = self._aux_names
         outputs_ref = self._symbol._outputs
         extra_shapes = self._extra_shapes(bucket)
-        dtype = self._dtype
 
         def predict_fn(data_vals, param_vals, aux_vals):
             feed = dict(zip(param_names, param_vals))
             feed.update(zip(aux_names, aux_vals))
             feed.update(zip(data_names, data_vals))
-            for n, s in extra_shapes:
-                # loss-head label vars: forward ignores them, but the
+            for n, s, dt in extra_shapes:
+                # loss-head label vars / generative state vars: the
                 # graph evaluator requires every variable bound
-                feed[n] = jnp.zeros(s, dtype)
+                feed[n] = jnp.zeros(s, dt)
             # trace-constant key: inference is deterministic by
             # construction (training=False; Dropout is identity), the
             # key only satisfies ops that demand an rng scope
@@ -597,10 +658,293 @@ class InferenceEngine:
 
     def warm(self):
         """Compile every bucket program NOW — serving starts with the
-        full menu ready, so no request ever pays a trace."""
+        full menu ready, so no request ever pays a trace. A generative
+        model's prefill/decode/adopt menu warms too, so the first
+        sequence never pays a trace either."""
         for b in self._buckets:
             self.program(b)
-        return len(self._buckets)
+        n = len(self._buckets)
+        if self._gen is not None:
+            for L in self.gen_prefill_menu():
+                self.gen_prefill_program(L)
+                n += 1
+            K = gen_slots()
+            self.gen_decode_program(K)
+            self.gen_adopt_program(K)
+            n += 2
+        return n
+
+    # -- autoregressive generation (ISSUE 17) ------------------------------
+    # The generative symbol contract: exactly one data input (the token
+    # ids, [batch, time]), an extra var named "pos" (per-slot write
+    # offset, declared shape (0,)), and for every remaining extra var
+    # ``n`` (a KV/state cache, declared per-sample shape (0, S, ...))
+    # an output named ``n + "_next"`` carrying its updated value.
+    # ``example/char_lm`` builds it; any symbol shaped this way serves.
+    def _detect_generate(self):
+        if len(self._data_names) != 1:
+            return None
+        if "pos" not in self._extra_names:
+            return None
+        out_idx = {n: i for i, n in
+                   enumerate(self._symbol.list_outputs())}
+        declared = self._declared_var_specs()
+        states = []
+        for n in self._extra_names:
+            if n == "pos":
+                continue
+            i = out_idx.get(n + "_next_output")
+            spec = declared.get(n)
+            if i is None or spec is None or len(spec[0]) < 2:
+                return None
+            states.append((n, tuple(spec[0][1:]), spec[1], i))
+        if not states:
+            return None
+        return {"tok": self._data_names[0], "pos": "pos",
+                "states": tuple(states),
+                "cache_len": int(min(s[1][0] for s in states))}
+
+    @property
+    def is_generative(self):
+        return self._gen is not None
+
+    def generate_spec(self):
+        """The wire-visible generation contract (None for one-shot
+        models): state names, cache length (the hard sequence-length
+        ceiling), the compiled prefill menu and the max_new clamp."""
+        if self._gen is None:
+            return None
+        return {"token_input": self._gen["tok"],
+                "states": [n for n, _s, _d, _i in self._gen["states"]],
+                "cache_len": self._gen["cache_len"],
+                "prefill_buckets": list(self.gen_prefill_menu()),
+                "slots": gen_slots(),
+                "max_new": gen_max_new()}
+
+    def gen_prefill_menu(self):
+        """Prefill prompt-length buckets, clamped to the cache length."""
+        if self._gen is None:
+            return ()
+        S = self._gen["cache_len"]
+        menu = tuple(b for b in gen_prefill_buckets() if b <= S)
+        return menu or (S,)
+
+    def gen_bucket_for(self, plen):
+        for b in self.gen_prefill_menu():
+            if plen <= b:
+                return b
+        raise ValueError(
+            "prompt length %d exceeds the largest prefill bucket %d"
+            % (plen, self.gen_prefill_menu()[-1]))
+
+    def _store_abs(self):
+        param_abs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                          for v in self._param_vals)
+        aux_abs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                        for v in self._aux_vals)
+        return param_abs, aux_abs
+
+    def _build_gen_prefill(self, L):
+        """Prompt in (padded to bucket ``L``, batch 1) -> (first greedy
+        token, per-sequence state rows). The token buffer is donated;
+        the logits row the first token comes from is the TRUE last
+        prompt position, so padding never leaks into the sample."""
+        g = self._gen
+        tok_name, pos_name = g["tok"], g["pos"]
+        states = g["states"]
+        param_names, aux_names = self._param_names, self._aux_names
+        outputs_ref = self._symbol._outputs
+
+        def prefill_fn(tokens, length, param_vals, aux_vals):
+            feed = dict(zip(param_names, param_vals))
+            feed.update(zip(aux_names, aux_vals))
+            feed[tok_name] = tokens
+            feed[pos_name] = jnp.zeros((1,), jnp.int32)
+            for n, s, dt, _i in states:
+                feed[n] = jnp.zeros((1,) + s, dt)
+            with rng_scope(jax.random.PRNGKey(0)):
+                outs, _aux = eval_graph(outputs_ref, feed, False)
+            logits = outs[0]
+            if logits.ndim == 2:          # flattened head: (L, V)
+                logits = logits.reshape(1, L, -1)
+            last = jnp.take_along_axis(
+                logits,
+                (length.astype(jnp.int32) - 1)[:, None, None], axis=1)
+            first = jnp.argmax(last[:, 0, :], axis=-1).astype(jnp.int32)
+            rows = tuple(outs[i] for _n, _s, _dt, i in states)
+            return first, rows
+
+        jitted = jax.jit(prefill_fn, donate_argnums=(0,))
+        param_abs, aux_abs = self._store_abs()
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return jitted.lower(
+                jax.ShapeDtypeStruct((1, L), self._dtype),
+                jax.ShapeDtypeStruct((1,), _np.int32),
+                param_abs, aux_abs).compile()
+
+    def _build_gen_decode(self, K):
+        """ONE decode step over the packed ``K``-slot batch: (current
+        tokens, positions, packed state) -> (readable next tokens, the
+        next step's feed, advanced positions, updated state). Token
+        feed, positions and state are DONATED — XLA aliases them into
+        the outputs, so per-step cost is one dispatch and the KV state
+        never round-trips the host. Inactive slots compute garbage at
+        constant cost; adoption overwrites their rows."""
+        g = self._gen
+        tok_name, pos_name = g["tok"], g["pos"]
+        states = g["states"]
+        state_names = tuple(n for n, _s, _d, _i in states)
+        param_names, aux_names = self._param_names, self._aux_names
+        outputs_ref = self._symbol._outputs
+
+        def decode_fn(tok_feed, pos, state_vals, param_vals, aux_vals):
+            feed = dict(zip(param_names, param_vals))
+            feed.update(zip(aux_names, aux_vals))
+            feed[tok_name] = tok_feed
+            feed[pos_name] = pos
+            feed.update(zip(state_names, state_vals))
+            with rng_scope(jax.random.PRNGKey(0)):
+                outs, _aux = eval_graph(outputs_ref, feed, False)
+            logits = outs[0]
+            if logits.ndim == 3:
+                logits = logits[:, -1, :]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            new_states = tuple(outs[i] for _n, _s, _dt, i in states)
+            return (nxt, nxt[:, None].astype(tok_feed.dtype),
+                    pos + 1, new_states)
+
+        jitted = jax.jit(decode_fn, donate_argnums=(0, 1, 2))
+        param_abs, aux_abs = self._store_abs()
+        state_abs = tuple(jax.ShapeDtypeStruct((K,) + s, dt)
+                          for _n, s, dt, _i in states)
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return jitted.lower(
+                jax.ShapeDtypeStruct((K, 1), self._dtype),
+                jax.ShapeDtypeStruct((K,), _np.int32),
+                state_abs, param_abs, aux_abs).compile()
+
+    def _build_gen_adopt(self, K):
+        """Insert one prefilled sequence into decode slot ``slot`` of
+        the packed batch (donated in place) — how a queued sequence
+        joins the in-flight batch at a step boundary without draining
+        it."""
+        g = self._gen
+        states = g["states"]
+
+        def adopt_fn(tok_feed, pos, state_vals, row_tok, row_pos,
+                     row_states, slot):
+            slot = slot.astype(jnp.int32)
+            tok_feed = lax.dynamic_update_slice(
+                tok_feed, row_tok.reshape(1, 1).astype(tok_feed.dtype),
+                (slot, 0))
+            pos = lax.dynamic_update_slice(
+                pos, row_pos.reshape(1).astype(pos.dtype), (slot,))
+            new_states = tuple(
+                lax.dynamic_update_slice(
+                    s, r.astype(s.dtype), (slot,) + (0,) * (s.ndim - 1))
+                for s, r in zip(state_vals, row_states))
+            return tok_feed, pos, new_states
+
+        jitted = jax.jit(adopt_fn, donate_argnums=(0, 1, 2))
+        state_abs = tuple(jax.ShapeDtypeStruct((K,) + s, dt)
+                          for _n, s, dt, _i in states)
+        row_abs = tuple(jax.ShapeDtypeStruct((1,) + s, dt)
+                        for _n, s, dt, _i in states)
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return jitted.lower(
+                jax.ShapeDtypeStruct((K, 1), self._dtype),
+                jax.ShapeDtypeStruct((K,), _np.int32),
+                state_abs,
+                jax.ShapeDtypeStruct((1,), _np.int32),
+                jax.ShapeDtypeStruct((1,), _np.int32),
+                row_abs,
+                jax.ShapeDtypeStruct((), _np.int32)).compile()
+
+    def _require_gen(self):
+        if self._gen is None:
+            raise ValueError(
+                "model is not generative: the symbol lacks the "
+                "pos/state-next generation contract")
+
+    def gen_prefill_program(self, L):
+        self._require_gen()
+        program, _hit = self.cache.get(
+            ("gen_prefill", L), lambda: self._build_gen_prefill(L))
+        return program
+
+    def gen_decode_program(self, K):
+        self._require_gen()
+        program, _hit = self.cache.get(
+            ("gen_decode", K), lambda: self._build_gen_decode(K))
+        return program
+
+    def gen_adopt_program(self, K):
+        self._require_gen()
+        program, _hit = self.cache.get(
+            ("gen_adopt", K), lambda: self._build_gen_adopt(K))
+        return program
+
+    def gen_state_init(self, K):
+        """Fresh packed decode state for ``K`` slots: [token feed
+        (K, 1), positions (K,) int32, per-state caches] — the triple a
+        decode lane owns and every step donates forward."""
+        self._require_gen()
+        tok_feed = jax.device_put(_np.zeros((K, 1), self._dtype),
+                                  self._dev)
+        pos = jax.device_put(_np.zeros((K,), _np.int32), self._dev)
+        states = tuple(jax.device_put(_np.zeros((K,) + s, dt), self._dev)
+                       for _n, s, dt, _i in self._gen["states"])
+        return [tok_feed, pos, states]
+
+    def gen_prefill(self, tokens, param_vals, aux_vals):
+        """Prefill one prompt against an explicit store. Returns
+        ``(first_token (1,) int32 device array, state rows)`` — the
+        caller reads the token and adopts the rows into a slot."""
+        self._require_gen()
+        arr = _np.asarray(tokens).reshape(-1)
+        plen = int(arr.shape[0])
+        if plen < 1:
+            raise ValueError("empty prompt")
+        L = self.gen_bucket_for(plen)
+        padded = _np.zeros((1, L), self._dtype)
+        padded[0, :plen] = arr
+        program = self.gen_prefill_program(L)
+        first, rows = program(
+            jax.device_put(padded, self._dev),
+            jax.device_put(_np.asarray([plen], _np.int32), self._dev),
+            param_vals, aux_vals)
+        self._note("gen_prefills")
+        return first, rows
+
+    def gen_step(self, state, param_vals, aux_vals):
+        """One decode step over a lane's packed state; returns
+        ``(readable_tokens (K,) int32, new_state)``. The old state is
+        donated — dead after this call."""
+        self._require_gen()
+        K = int(state[0].shape[0])
+        program = self.gen_decode_program(K)
+        nxt, tok_feed, pos, new_states = program(
+            state[0], state[1], state[2], param_vals, aux_vals)
+        self._note("gen_steps")
+        return nxt, [tok_feed, pos, new_states]
+
+    def gen_adopt(self, state, first_tok, plen, rows, slot):
+        """Write a prefilled sequence into ``slot`` of a lane's packed
+        state (donated in place); position starts at the prompt
+        length."""
+        self._require_gen()
+        K = int(state[0].shape[0])
+        program = self.gen_adopt_program(K)
+        tok_feed, pos, new_states = program(
+            state[0], state[1], state[2], first_tok,
+            _np.asarray([plen], _np.int32), rows, _np.int32(slot))
+        return [tok_feed, pos, new_states]
 
     # -- prewarm: export/import the AOT program menu (ISSUE 16) --------
     def program_fingerprint(self):

@@ -431,21 +431,32 @@ class RolloutController:
 
     # -- composite flows ---------------------------------------------------
     def hot_swap(self, params, version, aux=None, digest=None,
-                 drain_timeout=15.0):
+                 drain_timeout=15.0, live=False):
         """Zero-downtime hot-swap via the existing drain verdict: one
         replica at a time — drain (its clients steer to the peers),
         swap the new version in, resume admissions. The fleet never
-        stops answering."""
+        stops answering.
+
+        ``live=True`` skips the drain/resume dance entirely: every
+        request's weight version resolves ONCE at admission (predict
+        batches never mix versions, and a generate sequence's whole
+        decode lane holds its admission-time store by reference), so a
+        ``weights_push`` under sustained traffic can never tear an
+        in-flight answer — new admissions route to the new version, old
+        lanes drain naturally. This is the right mode under long-lived
+        generate sequences, where a full drain would stall the swap
+        behind every in-flight sequence's completion."""
         out = {}
         for addr in self._addrs:
             conn = self._conn(addr)
-            conn.request("drain", drain_timeout, timeout=30.0)
-            deadline = time.monotonic() + drain_timeout
-            while time.monotonic() < deadline:
-                pending = conn.request("ping", timeout=10.0)[1]
-                if not pending.get("pending"):
-                    break
-                time.sleep(0.02)
+            if not live:
+                conn.request("drain", drain_timeout, timeout=30.0)
+                deadline = time.monotonic() + drain_timeout
+                while time.monotonic() < deadline:
+                    pending = conn.request("ping", timeout=10.0)[1]
+                    if not pending.get("pending"):
+                        break
+                    time.sleep(0.02)
             host = {n: (v.asnumpy() if hasattr(v, "asnumpy")
                         else _np.ascontiguousarray(v))
                     for n, v in params.items()}
@@ -453,7 +464,8 @@ class RolloutController:
                 "weights_push", self._model, int(version), host, aux,
                 digest if digest is not None else weight_digest(host),
                 timeout=120.0)
-            conn.request("resume", timeout=30.0)
+            if not live:
+                conn.request("resume", timeout=30.0)
             out[addr] = reply[1]
         return out
 

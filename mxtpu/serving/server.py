@@ -47,7 +47,7 @@ import time
 from .. import fault as _fault
 from .. import kvstore_async as _ka
 from .. import obs as _obs
-from .batcher import DynamicBatcher
+from .batcher import DynamicBatcher, GenerateScheduler
 
 # server-level instruments (ISSUE 14): every counter in the old `_c`
 # dict is a registry series labeled by server instance — stats() reads
@@ -88,20 +88,23 @@ _SRV_REQUEST_MS = _obs.histogram(
 _SRV_INST = itertools.count(1)
 
 __all__ = ["ModelServer", "queue_depth", "batch_deadline_ms",
-           "default_budget_ms"]
+           "default_budget_ms", "generate_budget_ms"]
 
 
 class _ModelEntry:
     """One hosted (model, versioned-weights) menu: its engine, its own
-    dynamic batcher (versions never coalesce across models), and the
+    dynamic batcher (versions never coalesce across models), the
+    continuous generate scheduler (generative engines only), and the
     per-version response/latency counters the rollout verdict reads."""
 
-    __slots__ = ("name", "engine", "batcher", "lock", "by_version")
+    __slots__ = ("name", "engine", "batcher", "scheduler", "lock",
+                 "by_version")
 
-    def __init__(self, name, engine, batcher):
+    def __init__(self, name, engine, batcher, scheduler=None):
         self.name = name
         self.engine = engine
         self.batcher = batcher
+        self.scheduler = scheduler
         self.lock = threading.Lock()
         self.by_version = {}    # version -> responses/errors/latency
 
@@ -142,6 +145,14 @@ def default_budget_ms():
     """MXTPU_SERVE_DEADLINE_MS: per-request latency budget applied when
     the client sent none; expired requests are dropped pre-dispatch."""
     return float(os.environ.get("MXTPU_SERVE_DEADLINE_MS", "1000"))
+
+
+def generate_budget_ms():
+    """MXTPU_SERVE_GENERATE_DEADLINE_MS: per-sequence generation budget
+    applied when the client sent none — a budget exhausted between
+    decode steps frees the slot with the ``expired`` verdict."""
+    return float(os.environ.get("MXTPU_SERVE_GENERATE_DEADLINE_MS",
+                                "30000"))
 
 
 class _ServeHandler(socketserver.BaseRequestHandler):
@@ -187,11 +198,16 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                     continue
                 if item is None:
                     return
-                cid, op, key, reply = item
+                cid, op, key, reply, more = item
                 try:
                     _fault.fire("server.send", op=op, key=key,
                                 sock=sock, server=server)
-                    _ka._send_frame(sock, (cid, reply))
+                    # a streamed partial (a generate token) rides as a
+                    # "+"-tagged 3-tuple: it does NOT retire the
+                    # client's pending slot — only the terminal 2-tuple
+                    # reply pairs and releases the window
+                    _ka._send_frame(sock, (cid, reply, "+") if more
+                                    else (cid, reply))
                 except (ConnectionError, EOFError, OSError):
                     dead.set()
                     try:
@@ -231,15 +247,41 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                     if res == _NO_REPLY:
                         continue
                     if isinstance(res, tuple):   # immediate verdict
-                        out_q.put((cid, op, key, res))
+                        out_q.put((cid, op, key, res, False))
                     else:                        # parked: reply at flush
                         res.on_resolve(
                             lambda reply, cid=cid, key=key:
-                            out_q.put((cid, "predict", key, reply)))
+                            out_q.put((cid, "predict", key, reply,
+                                       False)))
+                    continue
+                if op == "generate":
+                    # the token stream rides the SAME pipelined sender
+                    # as every other reply: each generated token becomes
+                    # a partial frame, the terminal verdict (repeating
+                    # the full token list) pairs the request
+                    def _tok(idx, tok, ver, cid=cid, key=key):
+                        out_q.put((cid, "generate", key,
+                                   ("tok", idx, tok, ver), True))
+                    if tctx is None:
+                        res = server._admit_generate(msg, on_token=_tok)
+                    else:
+                        with _obs.adopt(tctx), \
+                                _obs.span("serve.admit", rid=str(key)):
+                            res = server._admit_generate(
+                                msg, tctx=tctx, on_token=_tok)
+                    if res == _NO_REPLY:
+                        continue
+                    if isinstance(res, tuple):   # immediate verdict
+                        out_q.put((cid, op, key, res, False))
+                    else:                        # parked: reply at finish
+                        res.on_resolve(
+                            lambda reply, cid=cid, key=key:
+                            out_q.put((cid, "generate", key, reply,
+                                       False)))
                     continue
                 reply = server._dispatch(msg)
                 if reply != _NO_REPLY:
-                    out_q.put((cid, op, key, reply))
+                    out_q.put((cid, op, key, reply, False))
                 if op == "stop":
                     break
         except (ConnectionError, EOFError, OSError):
@@ -288,7 +330,8 @@ class ModelServer:
         self._models[model_name] = _ModelEntry(
             model_name, engine,
             DynamicBatcher(engine, self._depth, self._deadline_ms,
-                           server=self))
+                           server=self),
+            self._make_scheduler(engine))
         # versioned weight snapshots (rollback source): the replica
         # reads the SAME directory the publisher writes
         if weight_dir is None:
@@ -350,9 +393,19 @@ class ModelServer:
             self._models[name] = _ModelEntry(
                 name, engine,
                 DynamicBatcher(engine, self._depth, self._deadline_ms,
-                               server=self))
+                               server=self),
+                self._make_scheduler(engine))
         if self._thread is not None:
             engine.warm()
+
+    def _make_scheduler(self, engine):
+        """A continuous :class:`GenerateScheduler` for a generative
+        engine (one whose symbol declares the KV-cache/pos contract);
+        classic one-shot models host no scheduler and refuse
+        ``generate`` with an err verdict."""
+        if not engine.is_generative:
+            return None
+        return GenerateScheduler(engine, self._depth, server=self)
 
     def start(self):
         for entry in self._entries():
@@ -375,10 +428,12 @@ class ModelServer:
         replica shows a fleet monitor."""
         models = {}
         for entry in self._entries():
-            models[entry.name] = {
-                "engine": entry.engine.stats(),
-                "batcher": entry.batcher.stats(),
-                "by_version": entry.version_stats()}
+            row = {"engine": entry.engine.stats(),
+                   "batcher": entry.batcher.stats(),
+                   "by_version": entry.version_stats()}
+            if entry.scheduler is not None:
+                row["scheduler"] = entry.scheduler.stats()
+            models[entry.name] = row
         return {"address": self.address, "draining": self._draining,
                 "queue_depth": self._depth, "models": models}
 
@@ -388,6 +443,8 @@ class ModelServer:
         ok = True
         for entry in self._entries():
             ok = entry.batcher.drain(timeout=timeout) and ok
+            if entry.scheduler is not None:
+                ok = entry.scheduler.drain(timeout=timeout) and ok
         return ok
 
     def resume(self):
@@ -401,6 +458,9 @@ class ModelServer:
                 entry.batcher = DynamicBatcher(
                     entry.engine, self._depth, self._deadline_ms,
                     server=self)
+            if entry.scheduler is not None and entry.scheduler._stopped:
+                entry.scheduler.release_metrics()
+                entry.scheduler = self._make_scheduler(entry.engine)
         self._draining = False
         return True
 
@@ -414,6 +474,8 @@ class ModelServer:
             s.drop()
         for entry in self._entries():
             entry.batcher.stop()
+            if entry.scheduler is not None:
+                entry.scheduler.stop()
         with _ka._LOCAL_GUARD:
             if _ka._LOCAL_SERVERS.get(self.address) is self:
                 del _ka._LOCAL_SERVERS[self.address]
@@ -540,6 +602,65 @@ class ModelServer:
                        self._account_reply(reply, e, r, a))
         return req
 
+    def _admit_generate(self, msg, tctx=None, on_token=None):
+        """Admission control for one ``("generate", rid, tokens, opts)``
+        frame — the stateful-sequence sibling of :meth:`_admit` with the
+        SAME verdict surface (drop/draining/overloaded/err) and the same
+        rid identity for exactly-once replay accounting. ``opts`` keys:
+        ``max_new``, ``budget_ms``, ``eos_id``, ``model``, ``version``
+        (a failover replay PINS the version its first answer streamed
+        from — a pinned version no longer resident is an honest err, a
+        silent rebind would tear the sequence). The weight version
+        resolves HERE, once, at admission: a hot-swap mid-sequence can
+        never mix versions within one sequence. ``on_token`` streams
+        each generated token (scheduler thread) — the wire handler turns
+        them into partial frames on the pipelined sender."""
+        rid, tokens = msg[1], msg[2]
+        opts = msg[3] if len(msg) > 3 and msg[3] is not None else {}
+        model = opts.get("model")
+        arrival = time.monotonic()
+        self._bump("requests")
+        self._note_rid(rid)
+        act = _fault.fire("serve.request", op="generate", key=rid,
+                          server=self)
+        if act == "drop":
+            self._bump("dropped")
+            return _NO_REPLY
+        if self._draining or self._tcp.dying:
+            self._bump("shed_draining")
+            return ("draining", {"replicas": self._replicas})
+        entry = self._entry_for(model)
+        if entry is None:
+            self._bump("errors")
+            return ("err", "unknown model %r (hosting %r)"
+                    % (model, sorted(self._models)))
+        if entry.scheduler is None:
+            self._bump("errors")
+            return ("err", "model %r is not generative — its symbol "
+                    "declares no KV-cache/pos contract" % (entry.name,))
+        budget = opts.get("budget_ms")
+        budget = generate_budget_ms() if budget is None else float(budget)
+        deadline = arrival + budget / 1000.0
+        pinned = opts.get("version") is not None
+        version = opts["version"] if pinned \
+            else entry.engine.route_version(rid)
+        req = entry.scheduler.submit(
+            rid, tokens, opts.get("max_new", 64), deadline,
+            wait_bound=budget / 1000.0 + _FLUSH_GRACE,
+            version=version, pinned=pinned, eos_id=opts.get("eos_id"),
+            on_token=on_token, tctx=tctx)
+        if isinstance(req, tuple):          # shed/err verdict
+            if req[0] == "overloaded":
+                self._bump("shed_overloaded")
+            elif req[0] == "draining":
+                self._bump("shed_draining")
+            else:
+                self._bump("errors")
+            return req
+        req.on_resolve(lambda reply, e=entry, r=req, a=arrival:
+                       self._account_reply(reply, e, r, a))
+        return req
+
     # -- live weight deployment (docs/serving.md "Rollout & weight
     # streaming") ----------------------------------------------------------
     def swap_weights(self, arg_params, aux_params=None, version=None,
@@ -621,15 +742,27 @@ class ModelServer:
             return res
         return res.wait(res.wait_bound)
 
+    def _do_generate(self, msg, on_token=None):
+        """Blocking form of generate: admit, then park until the
+        terminal verdict. Without ``on_token`` the per-token stream is
+        simply not observed — the terminal ``ok`` repeats the full
+        token list, so nothing is lost."""
+        res = self._admit_generate(msg, on_token=on_token)
+        if res == _NO_REPLY or isinstance(res, tuple):
+            return res
+        return res.wait(res.wait_bound)
+
     def stats(self):
         counters = {f: s.value for f, s in self._c.items()}
         models = {}
         for entry in self._entries():
-            models[entry.name] = {
-                "engine": entry.engine.stats(),
-                "batcher": entry.batcher.stats(),
-                "weights": entry.engine.version_state(),
-                "by_version": entry.version_stats()}
+            row = {"engine": entry.engine.stats(),
+                   "batcher": entry.batcher.stats(),
+                   "weights": entry.engine.version_state(),
+                   "by_version": entry.version_stats()}
+            if entry.scheduler is not None:
+                row["scheduler"] = entry.scheduler.stats()
+            models[entry.name] = row
         return {"address": self.address, "model": self._model_name,
                 "draining": self._draining, "replicas": self._replicas,
                 "queue_depth": self._depth,
@@ -643,6 +776,10 @@ class ModelServer:
         cmd = msg[0]
         if cmd == "predict":
             return self._do_predict(msg)
+        if cmd == "generate":
+            # non-streaming fallback (plain request transport): the
+            # terminal reply carries the whole token list
+            return self._do_generate(msg)
         if cmd == "hello":
             # clients learn the replica set + the hosted model menus
             # (signatures AND live weight-version state) here — the
@@ -661,8 +798,11 @@ class ModelServer:
                            "models": models})
         if cmd == "ping":
             return ("ok", {"draining": self._draining,
-                           "pending": sum(e.batcher.pending()
-                                          for e in self._entries())})
+                           "pending": sum(
+                               e.batcher.pending()
+                               + (e.scheduler.pending()
+                                  if e.scheduler is not None else 0)
+                               for e in self._entries())})
         if cmd == "stats":
             return ("ok", self.stats())
         if cmd == "metrics":
@@ -677,6 +817,12 @@ class ModelServer:
                 threading.Thread(target=entry.batcher.drain, kwargs={
                     "timeout": float(msg[1]) if len(msg) > 1 else 30.0},
                     daemon=True).start()
+                if entry.scheduler is not None:
+                    threading.Thread(
+                        target=entry.scheduler.drain, kwargs={
+                            "timeout": float(msg[1]) if len(msg) > 1
+                            else 30.0},
+                        daemon=True).start()
             return ("ok", {"draining": True})
         if cmd == "resume":
             # the zero-downtime hot-swap exit: drain → swap → resume
@@ -702,6 +848,18 @@ class ModelServer:
             threading.Thread(target=self.stop, daemon=True).start()
             return ("ok",)
         return ("err", "unknown serving command %r" % (cmd,))
+
+    def _dispatch_stream(self, msg, emit):
+        """Streaming dispatch for the in-process shortcut
+        (``_ServerConn._local_stream``): a ``generate`` streams each
+        token through ``emit`` as a partial reply, mirroring the wire
+        handler's "+"-tagged frames; every other command answers
+        exactly as :meth:`_dispatch`."""
+        if msg[0] == "generate":
+            return self._do_generate(
+                msg, on_token=lambda idx, tok, ver:
+                emit(("tok", idx, tok, ver)))
+        return self._dispatch(msg)
 
     def _do_rollout(self, msg):
         _, model, action, kw = msg

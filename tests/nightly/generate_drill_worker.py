@@ -1,0 +1,303 @@
+"""Continuous-batching generation drill worker (ISSUE 17 acceptance;
+driven by tests/test_dist_launch.py::test_generate_kill_and_swap_drill
+through tools/launch.py -n 2 --serve 2 --serve-respawn).
+
+Rank 0 — the PUBLISHER: loads the served LM checkpoint, publishes it
+as pinned weight version 1, then keeps publishing deterministically
+perturbed versions — live hot-swaps landing under sustained
+generation.
+
+Rank 1 — the DRIVER: concurrent client threads stream generate2
+sequences at the replica fleet while versions swap underneath and the
+harness kill -9s replica 0 mid-stream. Every sequence records its
+streamed token frames (idx, tok, version) plus the terminal info; the
+driver then verifies the three ISSUE 17 acceptance properties from
+the records alone:
+
+  * exactly-once: each sequence's frame indices are 0..n-1, each
+    seen once, in order — across the kill, the failover replay and
+    any dropped partials;
+  * zero torn sequences: every frame of one sequence carries ONE
+    weight version, the one the terminal info reports;
+  * oracle match: for each (prompt, version) the driver recomputes
+    the greedy continuation LOCALLY by full re-prefill from the
+    weight-dir snapshot of that exact version — the served tokens
+    must match bit-for-bit.
+
+Coordination is file-based in GEN_TEST_DIR (driver_ready,
+trainer_done.json); the driver's progress file counts finished
+sequences ONCE >= 2 weight versions have answered — the external
+kill -9 trigger, so the kill lands with swaps already in flight.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+OUT_DIR = os.environ["GEN_TEST_DIR"]
+PROGRESS = os.environ.get("GEN_PROGRESS_FILE")
+ROUNDS = int(os.environ.get("GEN_PUBLISH_ROUNDS", "3"))
+MAX_NEW = int(os.environ.get("GEN_DRILL_MAX_NEW", "10"))
+# fixed prompt pool: lengths 3..6 so prompt + MAX_NEW - 1 stays inside
+# the largest prefill bucket (16) for the oracle's full re-prefill
+PROMPTS = [(1, 2, 3), (4, 5, 6, 7), (8, 9, 10, 11, 12),
+           (13, 14, 15, 1, 2, 3)]
+
+
+def _wait_for(path, timeout=180.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_publisher():
+    import mxtpu as mx
+    from mxtpu.serving import WeightPublisher
+
+    prefix = os.environ["MXTPU_SERVE_MODEL"]
+    epoch = int(os.environ.get("MXTPU_SERVE_EPOCH", "0"))
+    _sym, arg_params, _aux = mx.model.load_checkpoint(prefix, epoch)
+    params = {n: v.asnumpy() for n, v in arg_params.items()}
+
+    pub = WeightPublisher(os.environ["MXTPU_SERVE_WEIGHT_DIR"])
+    out = pub.publish(params, pin=True, meta={"round": 0})
+    print("publisher pinned v%d digest=%s"
+          % (out["version"], out["digest"][:12]), flush=True)
+
+    if not _wait_for(os.path.join(OUT_DIR, "driver_ready")):
+        print("publisher: driver never became ready", flush=True)
+        return 1
+
+    versions = [out["version"]]
+    for round_i in range(1, ROUNDS + 1):
+        # a deterministic nudge per round: the driver recomputes each
+        # version's decode from the SNAPSHOT, so any perturbation works
+        # as long as it changes the argmax chain now and then
+        rng = np.random.RandomState(1000 + round_i)
+        params = {n: a + 0.05 * rng.randn(*a.shape).astype(a.dtype)
+                  for n, a in params.items()}
+        out = pub.publish(params, meta={"round": round_i})
+        if out is None:
+            continue
+        versions.append(out["version"])
+        print("publisher v%d" % out["version"], flush=True)
+        time.sleep(float(os.environ.get("GEN_PUBLISH_GAP", "1.5")))
+
+    done = {"final_version": versions[-1], "versions": versions}
+    with open(os.path.join(OUT_DIR, "trainer_done.json"), "w") as f:
+        json.dump(done, f)
+    print("RANK_0_OK", flush=True)
+    return 0
+
+
+def _oracle_tokens(sym, params, prompt, n):
+    """The greedy continuation recomputed WITHOUT the serving decode
+    path: one full prefill per token on the growing prompt, reading
+    the model's next-token pick fresh each time — an independent
+    reference the engine's cached single-token decode must match."""
+    from mxtpu.serving import InferenceEngine
+    eng = InferenceEngine(sym, params, {}, data_shapes={"data": (1,)},
+                          buckets=(1,), warm=False)
+    pvals, avals, _v = eng._resolve_store(None)
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        first, _rows = eng.gen_prefill(
+            np.asarray(toks, np.int32), pvals, avals)
+        nxt = int(np.asarray(first).reshape(-1)[0])
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def run_driver():
+    import mxtpu as mx
+    from mxtpu.checkpoint import CheckpointManager
+    from mxtpu.serving import ServingClient
+
+    addrs = [a for a in os.environ["MXTPU_SERVE_ADDRS"].split(",")
+             if a]
+    cli = ServingClient(addrs=addrs, budget_ms=30000)
+    deadline = time.time() + 180
+    while True:
+        try:
+            cli.hello()
+            break
+        except ConnectionError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+    # wait until the fleet swapped to the pinned published version —
+    # fresh replicas still answer from ctor version 0
+    deadline = time.time() + 180
+    while True:
+        toks, info = cli.generate2(PROMPTS[0], max_new=2)
+        if info["version"] >= 1:
+            break
+        if time.time() > deadline:
+            raise AssertionError(
+                "fleet never reached v1 (still %r)" % info)
+        time.sleep(0.2)
+    with open(os.path.join(OUT_DIR, "driver_ready"), "w") as f:
+        f.write("ok")
+    print("driver saw v%d, streaming" % info["version"], flush=True)
+
+    lock = threading.Lock()
+    state = {"records": [], "errors": [], "versions": set(),
+             "client_stats": []}
+    stop = threading.Event()
+
+    def pound(seed):
+        rng = np.random.RandomState(seed)
+        c = ServingClient(addrs=addrs, budget_ms=30000)
+        while not stop.is_set():
+            prompt = PROMPTS[rng.randint(len(PROMPTS))]
+            frames = []
+            try:
+                toks, inf = c.generate2(
+                    prompt, max_new=MAX_NEW,
+                    on_token=lambda i, t, v: frames.append((i, t, v)))
+                rec = {"prompt": list(prompt), "toks": toks,
+                       "version": inf["version"],
+                       "reason": inf["reason"], "frames": frames}
+                with lock:
+                    state["records"].append(rec)
+                    state["versions"].add(inf["version"])
+                    n, nv = len(state["records"]), \
+                        len(state["versions"])
+            except Exception as e:       # noqa: BLE001 — recorded
+                with lock:
+                    state["errors"].append(repr(e))
+                    n, nv = len(state["records"]), \
+                        len(state["versions"])
+            if PROGRESS and nv >= 2:
+                # the kill -9 trigger: counts only once hot-swaps are
+                # in flight, so the kill lands mid-rollout mid-stream
+                try:
+                    with open(PROGRESS + ".tmp", "w") as f:
+                        f.write(str(n))
+                    os.replace(PROGRESS + ".tmp", PROGRESS)
+                except OSError:
+                    pass
+        with lock:
+            state["client_stats"].append(c.stats())
+        c.close()
+
+    threads = [threading.Thread(target=pound, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+
+    # stream until the publisher finished AND the fleet's answers
+    # reached the final version (swaps really landed under load)
+    done_path = os.path.join(OUT_DIR, "trainer_done.json")
+    assert _wait_for(done_path, timeout=300), "publisher never finished"
+    with open(done_path) as f:
+        done = json.load(f)
+    final_v = int(done["final_version"])
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        with lock:
+            seen = set(state["versions"])
+        if final_v in seen:
+            break
+        time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+
+    with lock:
+        records = list(state["records"])
+        errors = list(state["errors"])
+        versions = sorted(state["versions"])
+
+    # -- acceptance property 1+2: exactly-once frames, zero torn -------
+    torn = []
+    not_exactly_once = []
+    for i, rec in enumerate(records):
+        idxs = [f[0] for f in rec["frames"]]
+        if idxs != list(range(len(rec["toks"]))) \
+                or [f[1] for f in rec["frames"]] != rec["toks"]:
+            not_exactly_once.append((i, rec))
+        vers = {f[2] for f in rec["frames"]}
+        if vers - {rec["version"]}:
+            torn.append((i, rec))
+
+    # -- acceptance property 3: the oracle recompute -------------------
+    # rebuild each answering version's greedy continuation from its
+    # weight-dir SNAPSHOT and diff the served tokens bit-for-bit
+    prefix = os.environ["MXTPU_SERVE_MODEL"]
+    epoch = int(os.environ.get("MXTPU_SERVE_EPOCH", "0"))
+    sym, _ap, _aux = mx.model.load_checkpoint(prefix, epoch)
+    cm = CheckpointManager(os.environ["MXTPU_SERVE_WEIGHT_DIR"],
+                           max_to_keep=0, async_save=False,
+                           use_orbax=False)
+    expected = {}
+    mismatches = []
+    for rec in records:
+        key = (tuple(rec["prompt"]), rec["version"])
+        if key not in expected:
+            tree = cm.restore_exact(rec["version"])
+            assert tree is not None, \
+                "version %d has no snapshot" % rec["version"]
+            expected[key] = _oracle_tokens(
+                sym, tree["params"], rec["prompt"], MAX_NEW)
+        if rec["toks"] != expected[key]:
+            mismatches.append({"prompt": rec["prompt"],
+                               "version": rec["version"],
+                               "served": rec["toks"],
+                               "oracle": expected[key]})
+
+    # the kill's client-side story lives in the POUND threads' own
+    # clients: sum their counters (the probe client barely routes)
+    with lock:
+        per_client = list(state["client_stats"])
+    agg = {}
+    for s in per_client + [cli.stats()]:
+        for k, v in s.items():
+            if isinstance(v, (int, float)):
+                agg[k] = agg.get(k, 0) + v
+    cli.close()
+    summary = {
+        "answered": len(records),
+        "errors": errors,
+        "versions": versions,
+        "final_version": final_v,
+        "exactly_once": not not_exactly_once,
+        "torn": [i for i, _ in torn],
+        "sequences_by_version": {
+            str(v): sum(1 for r in records if r["version"] == v)
+            for v in versions},
+        "oracle": {"checked": len(records),
+                   "distinct": len(expected),
+                   "mismatches": mismatches},
+        "client": agg,
+    }
+    with open(os.path.join(OUT_DIR, "summary.json"), "w") as f:
+        json.dump(summary, f, default=str)
+    print("DRIVER_OK answered=%d versions=%s oracle=%d/%d"
+          % (len(records), versions, len(records) - len(mismatches),
+             len(records)), flush=True)
+    print("RANK_1_OK", flush=True)
+    return 0
+
+
+def main():
+    rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+    os.makedirs(OUT_DIR, exist_ok=True)
+    if rank == 0:
+        return run_publisher()
+    return run_driver()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -235,6 +235,24 @@ def test_serving_bench_contract():
     assert ro["staleness_ms_p50"] > 0
     assert ro["staleness_ms_max"] >= ro["staleness_ms_p50"]
     assert ro["retraces"] == 0
+    # continuous-batching generation (ISSUE 17): tokens/s per sweep
+    # level with TTFT/per-step percentiles from the serve.gen.*
+    # registry histograms, and a retrace-free steady state (the >= 2x
+    # batching win at 64-vs-8 is pinned by ci/check_generate_perf.py,
+    # not here — tiny levels are too small to assert a ratio)
+    gen = payload["generate"]
+    assert gen["slots"] >= 1 and gen["max_new"] >= 1
+    assert gen["levels"], "generate sweep missing"
+    for row in gen["levels"]:
+        assert row["errors"] == 0, row
+        assert row["tokens"] == row["sequences"] * gen["max_new"], row
+        assert row["tok_s"] > 0
+        assert row["ttft"]["count"] >= row["sequences"], row
+        assert row["ttft"]["p99_ms"] >= row["ttft"]["p50_ms"] > 0
+        assert row["step"]["count"] >= 1, row
+        assert row["step"]["p99_ms"] >= row["step"]["p50_ms"] > 0
+    assert gen["decode_steps"] >= 1
+    assert gen["retraces_after_warmup"] == 0
 
 
 def test_embedding_bench_contract(tmp_path):

@@ -22,11 +22,19 @@ steady-state sweep also proves the zero-retrace contract: program
 compiles after warmup stay flat (the AOT bucket menu absorbs every
 request shape).
 
+The "generate" sub-object is the continuous-batching sweep (ISSUE 17):
+a tiny attention LM checkpoint served through the generate path, each
+level N concurrent greedy sequences — tokens/s per level, TTFT and
+per-decode-step p50/p99 from the ``serve.gen.ttft_ms`` /
+``serve.gen.step_ms`` registry histograms' per-level bucket deltas,
+and the batching win (tokens/s at the top level over the bottom one,
+the number ci/check_generate_perf.py pins at >= 2x for 64 vs 8).
+
 Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
 and mirrors it to docs/serving_bench.json unless --no-write. CPU-only.
 
 Run: JAX_PLATFORMS=cpu python tools/bench_serving.py
-     [--clients 8,64,256] [--iters 20]
+     [--clients 8,64,256] [--iters 20] [--max-new 32]
 """
 from __future__ import annotations
 
@@ -111,6 +119,153 @@ class _ServerLat:
                 "p99_ms": _pct_from_buckets(bounds, diff, 0.99),
             }
         return out
+
+
+class _GenLat:
+    """Per-level decode-path latency deltas: ``serve.gen.ttft_ms``
+    (admission to first streamed token — prefill wait + dispatch) and
+    ``serve.gen.step_ms`` (one packed decode step), reported as p50/p99
+    of just this level's observations."""
+
+    _FAMS = ("serve.gen.ttft_ms", "serve.gen.step_ms")
+
+    def __init__(self):
+        self._before = {f: _hist_counts(f) for f in self._FAMS}
+
+    def delta(self):
+        out = {}
+        for fam, key in (("serve.gen.ttft_ms", "ttft"),
+                         ("serve.gen.step_ms", "step")):
+            bounds, after = _hist_counts(fam)
+            _b, before = self._before[fam]
+            if after is None:
+                out[key] = None
+                continue
+            diff = after if before is None else \
+                [a - b for a, b in zip(after, before)]
+            out[key] = {"count": sum(diff),
+                        "p50_ms": _pct_from_buckets(bounds, diff, 0.50),
+                        "p99_ms": _pct_from_buckets(bounds, diff, 0.99)}
+        return out
+
+
+def _make_gen_checkpoint(tmpdir, vocab, dim, cache_len):
+    """Save a tiny attention-LM GENERATE checkpoint (the KV-cache/pos
+    contract of example/char_lm) — the sweep exercises the production
+    from_checkpoint -> is_generative -> scheduler path."""
+    import mxtpu as mx
+    from mxtpu.model import save_checkpoint
+    rng = np.random.RandomState(11)
+    data = mx.sym.Variable("data")
+    pos = mx.sym.Variable("pos", shape=(0,), dtype="int32")
+    kc = mx.sym.Variable("kc", shape=(0, cache_len, dim))
+    vc = mx.sym.Variable("vc", shape=(0, cache_len, dim))
+    emb = mx.sym.Embedding(data=data, input_dim=vocab, output_dim=dim,
+                           name="emb")
+    q = mx.sym.FullyConnected(data=emb, num_hidden=dim, flatten=False,
+                              name="q")
+    k = mx.sym.FullyConnected(data=emb, num_hidden=dim, flatten=False,
+                              name="k")
+    v = mx.sym.FullyConnected(data=emb, num_hidden=dim, flatten=False,
+                              name="v")
+    att = mx.sym.cached_attention(q, k, v, kc, vc, pos, num_heads=2,
+                                  name="att")
+    out = mx.sym.FullyConnected(data=att[0], num_hidden=vocab,
+                                flatten=False, name="proj")
+    sym = mx.sym.Group([out, mx.sym.identity(att[1], name="kc_next"),
+                        mx.sym.identity(att[2], name="vc_next")])
+    f = lambda *s: rng.randn(*s).astype(np.float32) * 0.4  # noqa: E731
+    args = {"emb_weight": f(vocab, dim),
+            "q_weight": f(dim, dim), "q_bias": np.zeros(dim, "f"),
+            "k_weight": f(dim, dim), "k_bias": np.zeros(dim, "f"),
+            "v_weight": f(dim, dim), "v_bias": np.zeros(dim, "f"),
+            "proj_weight": f(vocab, dim),
+            "proj_bias": np.zeros(vocab, "f")}
+    prefix = os.path.join(tmpdir, "bench_lm")
+    save_checkpoint(prefix, 0, sym,
+                    {n: mx.nd.array(a) for n, a in args.items()}, {})
+    return prefix
+
+
+def _run_generate_level(addr, n_clients, max_new, vocab):
+    """One generate sweep level: n_clients threads, one greedy
+    sequence each, streamed over the continuous scheduler. Tokens/s is
+    end-to-end (admission to terminal verdict, prefill included);
+    TTFT/step percentiles come from the registry histogram deltas."""
+    from mxtpu.serving import ServingClient
+    gen_lat = _GenLat()
+    counts, errors = [0] * n_clients, [0]
+    lock = threading.Lock()
+    start = threading.Event()
+    # every client finishes constructing BEFORE the clock starts —
+    # otherwise connection setup of the tail threads is billed to the
+    # measured window and tokens/s undershoots at mid concurrency
+    ready = threading.Barrier(n_clients + 1)
+
+    def one_client(j):
+        cli = ServingClient(addrs=[addr])
+        ready.wait(timeout=60.0)
+        start.wait(timeout=30.0)
+        try:
+            toks, _info = cli.generate2(
+                [1 + (j % (vocab - 2)), 2, 3], max_new=max_new,
+                model="bench_lm")
+            counts[j] = len(toks)
+        except Exception:
+            with lock:
+                errors[0] += 1
+        cli.close()
+
+    threads = [threading.Thread(target=one_client, args=(j,),
+                                daemon=True) for j in range(n_clients)]
+    for t in threads:
+        t.start()
+    ready.wait(timeout=60.0)
+    t0 = time.perf_counter()
+    start.set()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+    tokens = sum(counts)
+    row = {"clients": n_clients, "sequences": n_clients - errors[0],
+           "tokens": tokens, "errors": errors[0],
+           "tok_s": round(tokens / wall, 1) if wall > 0 else 0.0}
+    row.update(gen_lat.delta())
+    return row
+
+
+def _measure_generate(tmpdir, levels, max_new, vocab, dim, cache_len,
+                      slots):
+    """The continuous-batching sweep, on its own server so the predict
+    sweep's batcher stats stay untouched."""
+    from mxtpu.serving import InferenceEngine, ModelServer
+    os.environ.setdefault("MXTPU_SERVE_GENERATE_SLOTS", str(slots))
+    prefix = _make_gen_checkpoint(tmpdir, vocab, dim, cache_len)
+    engine = InferenceEngine.from_checkpoint(
+        prefix, 0, {"data": (1,)}, buckets=(1,))
+    srv = ModelServer(engine, model_name="bench_lm").start()
+    try:
+        # warm sequence, then pin: the sweep must retrace NOTHING
+        _run_generate_level(srv.address, 2, 4, vocab)
+        compiles_after_warm = engine.cache.compiles
+        rows = [_run_generate_level(srv.address, n, max_new, vocab)
+                for n in levels]
+        sched = srv.stats()["models"]["bench_lm"]["scheduler"]
+        return {
+            "slots": engine.generate_spec()["slots"],
+            "max_new": max_new,
+            "cache_len": cache_len,
+            "levels": rows,
+            # the batching win: top sweep level over the bottom one
+            "speedup_top_vs_bottom": round(
+                rows[-1]["tok_s"] / rows[0]["tok_s"], 2)
+            if rows[0]["tok_s"] else None,
+            "decode_steps": sched["steps"],
+            "retraces_after_warmup":
+                engine.cache.compiles - compiles_after_warm,
+        }
+    finally:
+        srv.stop()
 
 
 def _make_checkpoint(tmpdir, in_dim, hidden, classes):
@@ -250,7 +405,8 @@ def _measure_rollout(srv, engine, prefix, in_dim, swaps=5):
 
 
 def run(clients_levels, iters, in_dim, hidden, classes, buckets,
-        budget_ms):
+        budget_ms, gen_levels=None, max_new=32, gen_dim=128,
+        gen_cache=64, gen_slots=32):
     import mxtpu  # noqa: F401  (engine import path)
     from mxtpu import kvstore_async as ka
     from mxtpu.serving import InferenceEngine, ModelServer
@@ -278,6 +434,10 @@ def run(clients_levels, iters, in_dim, hidden, classes, buckets,
         # the continuous-deployment numbers: swap latency + poll-mode
         # weight-staleness lag, with the zero-retrace pin riding along
         rollout = _measure_rollout(srv, engine, prefix, in_dim)
+        # the continuous-batching generation sweep (ISSUE 17)
+        generate = _measure_generate(
+            tmpdir, gen_levels or clients_levels, max_new, 17,
+            gen_dim, gen_cache, gen_slots)
 
         result = {
             "bench": "serving_loopback",
@@ -299,6 +459,7 @@ def run(clients_levels, iters, in_dim, hidden, classes, buckets,
             else 0.0,
             "max_batch_rows": b["max_batch_rows"],
             "rollout": rollout,
+            "generate": generate,
             "retraces_after_warmup":
                 engine.cache.compiles - compiles_after_warm,
         }
@@ -321,6 +482,9 @@ def main():
     ap.add_argument("--hidden", type=int, default=128)
     ap.add_argument("--classes", type=int, default=10)
     ap.add_argument("--buckets", default="1,2,4,8,16,32")
+    ap.add_argument("--max-new", type=int, default=None,
+                    help="tokens per generated sequence (default 32; "
+                         "tiny mode 8)")
     ap.add_argument("--no-write", action="store_true",
                     help="do not mirror the line to "
                          "docs/serving_bench.json")
@@ -330,8 +494,14 @@ def main():
     iters = args.iters if args.iters is not None else (3 if tiny else 20)
     levels = [int(c) for c in clients.split(",") if c.strip()]
 
+    max_new = args.max_new if args.max_new is not None else \
+        (8 if tiny else 32)
     result = run(levels, iters, args.in_dim, args.hidden, args.classes,
-                 args.buckets, args.budget_ms)
+                 args.buckets, args.budget_ms, gen_levels=levels,
+                 max_new=max_new,
+                 gen_dim=16 if tiny else 128,
+                 gen_cache=16 if tiny else 64,
+                 gen_slots=4 if tiny else 32)
     if tiny:
         result["tiny"] = True
     line = json.dumps(result)

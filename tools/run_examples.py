@@ -24,6 +24,7 @@ EXAMPLES = [
     ("recommenders/matrix_fact.py", {}),
     ("sparse/linear_classification.py", {}),
     ("dlrm_click/dlrm_click.py", {}),
+    ("char_lm/char_lm.py", {}),
     ("autoencoder/mnist_sae.py", {}),
     ("adversary/fgsm_mnist.py", {}),
     ("svm_mnist/svm_mnist.py", {}),
