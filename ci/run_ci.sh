@@ -38,6 +38,7 @@ case "$tier" in
     JAX_PLATFORMS=cpu python ci/check_serving.py
     JAX_PLATFORMS=cpu python ci/check_generate_perf.py
     JAX_PLATFORMS=cpu python ci/check_rollout.py
+    JAX_PLATFORMS=cpu python ci/check_streaming.py
     JAX_PLATFORMS=cpu python ci/check_observability.py
     # lock-witness smoke: re-run the kvstore-window/replication/batcher
     # slice with the runtime witness armed; fails on any access the

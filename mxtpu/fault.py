@@ -127,7 +127,7 @@ __all__ = ["FaultSever", "FaultInjector", "install", "uninstall",
 _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
            "worker.step", "module.step", "serve.request", "serve.batch",
            "serve.step", "serve.swap", "publish.snapshot", "ctl.poll",
-           "ctl.action", "any")
+           "ctl.action", "stream.append", "stream.tail", "any")
 _KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
           "nan_grad", "kill_worker", "join_worker", "leave_worker",
           "split_shard")

@@ -999,6 +999,18 @@ class ParameterServer:
         self._cursors = {}         # epoch -> shard-cursor state
         self._cursor_lock = threading.Lock()
         self._cursor_requeues = 0
+        # -- streaming data plane (ISSUE 18): committed consumption
+        # cursors per (consumer group, log shard, segment), plus the
+        # per-stream-origin commit watermark that keeps a respawned
+        # trainer's replayed frames exactly-once. Deliberately NOT in
+        # self._applied: worker-death GC must never forget a stream
+        # origin — the identity is derived from the log position, not
+        # from a worker incarnation, and must outlive every consumer.
+        self._stream_lock = threading.Lock()
+        self._stream_offsets = {}  # (group, shard, seg) -> [offset, final]
+        self._stream_applied = {}  # stream origin -> last commit seq
+        self._stream_commits = 0
+        self._stream_dup = 0
         self._barrier_recounts = 0
         self._barrier_timeouts = 0
         self._barrier_lock = threading.Lock()
@@ -1438,16 +1450,21 @@ class ParameterServer:
     # -- elastic data cursor (module docstring, "Elasticity") --------------
     def _cursor_for(self, epoch, num_shards):
         """The (lazily created) cursor record for one epoch; caller
-        holds ``_cursor_lock``. History is bounded: epochs more than
-        two behind the newest are dropped."""
+        holds ``_cursor_lock``. History is bounded: int epochs more
+        than two behind the newest are dropped. String epochs are the
+        streaming plane's segment leases (``st|group|shard|seg``) —
+        they neither age out other epochs nor age out themselves here;
+        a segment's lease retires with its final stream commit."""
         cur = self._cursors.get(epoch)
         if cur is None:
             cur = {"num_shards": int(num_shards), "next": 0,
                    "requeued": [], "outstanding": {}, "done": set(),
                    "last": {}}
             self._cursors[epoch] = cur
-            for old in [e for e in self._cursors if e < epoch - 2]:
-                del self._cursors[old]
+            if isinstance(epoch, int):
+                for old in [e for e in self._cursors
+                            if isinstance(e, int) and e < epoch - 2]:
+                    del self._cursors[old]
         return cur
 
     def _requeue_cursor_shards(self, origin):
@@ -1835,6 +1852,52 @@ class ParameterServer:
         self._repl_barrier(stream, rseq, dup=dup)
         return ("ok", "dup") if dup else ("ok",)
 
+    def _do_stream_commit(self, commit, origin, seq, _repl=False):
+        """Advance one consumer group's committed (segment, offset)
+        consumption cursor — the offsets half of a ``stream_push``
+        frame (ISSUE 18). The SAME deterministic (origin, seq) identity
+        that deduped the frame's gradient parts gates the cursor, so a
+        respawned trainer replaying its last frame can neither re-train
+        the records (per-key watermark) nor re-advance / rewind the
+        cursor (this watermark). Returns True when the commit was a
+        refused replay."""
+        if commit is None:
+            return False
+        group, shard, seg, offset, final = commit
+        stream = rseq = None
+        dup = False
+        with self._stream_lock:
+            if self._stream_applied.get(origin, -1) >= seq:
+                dup = True
+                stream = None if _repl else self._repl_stream()
+            else:
+                self._stream_applied[origin] = int(seq)
+                ckey = (group, int(shard), int(seg))
+                cur = self._stream_offsets.get(ckey)
+                if cur is None:
+                    cur = [0, False]
+                    self._stream_offsets[ckey] = cur
+                cur[0] = max(cur[0], int(offset))
+                cur[1] = bool(cur[1] or final)
+                with self._ctr_lock:
+                    self._stream_commits += 1
+                stream = None if _repl else self._repl_stream()
+                if stream is not None:
+                    # enqueued under the stream lock: the backup's
+                    # cursor order matches the primary's apply order
+                    rseq = stream.forward(
+                        ("stream_commit", tuple(commit), origin,
+                         int(seq)))
+        if final and not dup:
+            # a fully-consumed segment's lease retires with its final
+            # commit (the lease epoch string IS the stream origin); a
+            # late cursor_next for it re-leases an exhausted segment,
+            # which the committed offset renders a no-op re-read
+            with self._cursor_lock:
+                self._cursors.pop(origin, None)
+        self._repl_barrier(stream, rseq, dup=dup)
+        return dup
+
     # state commands a backup refuses until promoted: the replication
     # stream must stay the only writer (and the authoritative reader)
     # of a backup's table, or failover could serve/accept torn state
@@ -1843,7 +1906,7 @@ class ParameterServer:
          "pull_rows", "multi",
          "set_optimizer", "opt_states", "set_opt_states", "barrier",
          "split", "adopt_key", "cursor_next", "cursor_done",
-         "publish"))
+         "publish", "stream_push", "stream_offsets"))
 
     def _dispatch(self, msg, _repl=False):
         cmd = msg[0]
@@ -2022,11 +2085,26 @@ class ParameterServer:
             # ack) gets the SAME shard back instead of a second one.
             _, origin, epoch, num_shards, rid = msg
             self._worker_rec(origin)
+            # int epochs are training-data cursors; string epochs are
+            # streaming segment leases (exactly-once segment handout)
+            if not isinstance(epoch, str):
+                epoch = int(epoch)
             with self._cursor_lock:
-                cur = self._cursor_for(int(epoch), num_shards)
+                cur = self._cursor_for(epoch, num_shards)
                 last = cur["last"].get(origin)
+                held = [s for s, o in cur["outstanding"].items()
+                        if o == origin]
                 if last is not None and last[0] == rid:
                     shard = last[1]
+                elif held and isinstance(epoch, str):
+                    # a segment-lease holder re-asking (fresh rid)
+                    # re-gets its own shard — a restarted tail
+                    # re-leases its segment instead of deadlocking
+                    # behind itself. Training cursors (int epochs)
+                    # keep handing out FRESH shards: a worker
+                    # legitimately pipelines several at once
+                    shard = held[0]
+                    cur["last"][origin] = (rid, shard)
                 else:
                     if cur["requeued"]:
                         shard = cur["requeued"].pop(0)
@@ -2045,12 +2123,62 @@ class ParameterServer:
             # shard of the epoch is done the cursor reports pending=0
             # so pollers stop waiting (idempotent: done is a set)
             _, origin, epoch, shard = msg
+            if not isinstance(epoch, str):
+                epoch = int(epoch)
             with self._cursor_lock:
-                cur = self._cursors.get(int(epoch))
+                cur = self._cursors.get(epoch)
                 if cur is not None:
                     cur["outstanding"].pop(shard, None)
                     cur["done"].add(shard)
             return ("ok",)
+        if cmd == "stream_push":
+            # ("stream_push", origin, seq, parts, commit) — the
+            # exactly-once serve→train frame (ISSUE 18): gradient parts
+            # AND the consumption offset they were computed from commit
+            # under ONE deterministic identity. ``origin`` names the
+            # (consumer group, log shard, segment) and ``seq`` derives
+            # from the record end-offset, so a kill -9'd trainer's
+            # respawn re-sends bit-identical frames — every replay is
+            # refused by the same per-(origin, key) watermarks that
+            # dedupe ordinary pushes, and the cursor by its own
+            # watermark. Parts are push/spush-shaped: ("d", key, grad,
+            # base_clock) or ("s", key, row_ids, rows, base_clock); a
+            # parts-less frame is a pure offset commit (segment
+            # finalize).
+            _, origin, seq, parts, commit = msg
+            dups = []
+            for p in parts:
+                if p[0] == "s":
+                    reply = self._do_sparse_push(
+                        ("spush", p[1], p[2], p[3], p[4], origin, seq),
+                        _repl=_repl)
+                else:
+                    reply = self._do_push(
+                        ("push", p[1], p[2], p[3], origin, seq),
+                        _repl=_repl)
+                if reply[0] != "ok":
+                    return reply
+                dups.append(len(reply) > 1 and reply[1] == "dup")
+            cdup = self._do_stream_commit(commit, origin, seq,
+                                          _repl=_repl)
+            if commit is not None:
+                dups.append(cdup)
+            if dups and all(dups):
+                with self._ctr_lock:
+                    self._stream_dup += 1
+                return ("ok", "dup")
+            return ("ok",)
+        if cmd == "stream_offsets":
+            # ("stream_offsets", group): one consumer group's committed
+            # consumption cursors — what a respawned tailer resumes
+            # from, and what the GC watermark (fleet-min fully-consumed
+            # segment) is computed over
+            group = msg[1]
+            with self._stream_lock:
+                rows = [[sh, sg, int(off), bool(fin)]
+                        for (g, sh, sg), (off, fin)
+                        in self._stream_offsets.items() if g == group]
+            return ("ok", sorted(rows))
         if cmd == "set_optimizer":
             _, payload = msg
             self._install_optimizer(bytes(payload))
@@ -2167,6 +2295,15 @@ class ParameterServer:
                     for o, s in applied:
                         prev = self._applied.get((o, key), 0)
                         self._applied[(o, key)] = max(prev, int(s))
+                return ("ok",)
+            if sc == "stream_commit":
+                # the offsets half of a forwarded stream_push frame:
+                # the backup mirrors the consumption cursor under the
+                # same (origin, seq) watermark, so a promoted backup
+                # resumes tailers from exactly the primary's commit
+                _, commit, origin, seq = sub
+                self._do_stream_commit(tuple(commit), origin, int(seq),
+                                       _repl=True)
                 return ("ok",)
             if sc == "catchup_done":
                 self._catchup_complete = True   # mxlint: allow(shared-state-race) — repl records arrive on ONE pinned socket; the serial per-connection handler loop is the stream's total order
@@ -2363,6 +2500,9 @@ class ParameterServer:
                            "map_version": self._map_version,
                            "moved_keys": len(self._moved),
                            "cursor_requeues": self._cursor_requeues,
+                           "stream_commits": self._stream_commits,
+                           "stream_dup": self._stream_dup,
+                           "stream_segments": len(self._stream_offsets),
                            "role": self._role,
                            "promotions": self._promotions,
                            "repl": repl,
@@ -2560,9 +2700,23 @@ class ParameterServer:
             # could take)
             applied = list(_racing_copy(self._applied).items())
             moved = list(_racing_copy(self._moved).items())
+            stream_applied = list(
+                _racing_copy(self._stream_applied).items())
+            stream_offsets = list(
+                _racing_copy(self._stream_offsets).items())
             meta = {"keys": keys, "clocks": clocks,
                     "applied": [[o, self._tag_key(k), int(s)]
                                 for (o, k), s in applied],
+                    # the streaming consumption cursors + their commit
+                    # watermarks ride every snapshot: a restarted shard
+                    # must keep refusing replayed stream frames and
+                    # resuming tailers from the committed offsets
+                    "stream_applied": [[o, int(s)]
+                                       for o, s in stream_applied],
+                    "stream_offsets": [[g, int(sh), int(sg), int(off),
+                                        bool(fin)]
+                                       for (g, sh, sg), (off, fin)
+                                       in stream_offsets],
                     "push_count": int(self._push_count),
                     # the forwarding table survives a restart: a
                     # respawned server must keep refusing split-away
@@ -2596,6 +2750,11 @@ class ParameterServer:
             self._clock[key] = int(clock)
         self._applied = {(o, self._untag_key(k)): int(s)
                          for o, k, s in meta.get("applied", [])}
+        self._stream_applied = {o: int(s) for o, s
+                                in meta.get("stream_applied", [])}
+        self._stream_offsets = {
+            (g, int(sh), int(sg)): [int(off), bool(fin)]
+            for g, sh, sg, off, fin in meta.get("stream_offsets", [])}
         self._moved = {self._untag_key(k): d
                        for k, d in meta.get("moved", [])}
         self._map_version = int(meta.get("map_version", 0))
@@ -2690,6 +2849,26 @@ _ELASTIC = os.environ.get("MXTPU_PS_ELASTIC", "0") != "0"
 # poll interval while the shard cursor waits on another worker's
 # outstanding shard (a straggler's assignment requeues on its death)
 _CURSOR_POLL = float(os.environ.get("MXTPU_PS_CURSOR_POLL", "0.2"))
+
+
+def stream_origin(group, shard, seg):
+    """The deterministic push identity of one (consumer group, log
+    shard, segment) — ISSUE 18's exactly-once anchor. Unlike the
+    per-incarnation worker origin (rank + uuid), this derives purely
+    from the log position: a kill -9'd trainer's respawn re-computes
+    the SAME origin for the same segment, so its replayed frames land
+    on the server's existing (origin, seq) watermarks and are refused,
+    not re-applied. Doubles as the segment's lease-cursor epoch."""
+    return "st|%s|%d|%08d" % (group, int(shard), int(seg))
+
+
+def stream_commit_seq(offset, final):
+    """The monotone commit sequence for a consumption offset within
+    one segment: strictly increasing in the offset, with the
+    ``final`` (segment fully consumed) flag ordered AFTER a plain
+    commit at the same offset — so an empty-tail finalize is never
+    refused as a replay of the last record's commit."""
+    return (int(offset) << 1) | (1 if final else 0)
 # map_stale forwarding bound: a client whose shard map is k versions
 # stale needs at most k hops to find a key's current home
 _MAP_HOPS = 4
@@ -2714,14 +2893,18 @@ def _stale_dst(err):
 # The elastic commands replay safely too: shard_map reads, cursor_next
 # dedupes on its rid (a retry gets the SAME shard back), cursor_done
 # marks into a set, adopt_key refuses clocks at or below its watermark,
-# and a replayed split only re-moves keys still local.
+# and a replayed split only re-moves keys still local. The streaming
+# plane is replay-safe BY CONSTRUCTION: stream_push frames carry a
+# deterministic (origin, seq) identity the watermarks refuse, and
+# stream_offsets is a read.
 _IDEMPOTENT = frozenset(
     ("init", "push", "pushpull", "spush", "spushpull", "pull",
      "pull_rows", "stats", "ping",
      "set_optimizer", "opt_states", "set_opt_states", "multi",
      "hello", "bye", "repl", "promote", "peer_info", "join_backup",
      "shard_map", "cursor_next", "cursor_done", "adopt_key", "split",
-     "publish", "weights", "weight_sub", "metrics"))
+     "publish", "weights", "weight_sub", "metrics",
+     "stream_push", "stream_offsets"))
 
 
 class _Pending:
@@ -4573,6 +4756,77 @@ class AsyncDistKVStore(KVStore):
             yield shard
             self._conns[0].request(
                 "cursor_done", self._origin, int(epoch), shard)
+
+    # -- streaming data plane (ISSUE 18; docs/streaming.md) ---------------
+    def stream_lease(self, lease):
+        """Try to take the exclusive fleet-wide lease named by
+        ``lease`` (a :func:`stream_origin` string — one log segment).
+        Rides the server-owned shard cursor with ``num_shards=1``:
+        ``"owned"`` — this worker holds it (a replayed request is
+        rid-deduped to the same verdict); ``"wait"`` — another live
+        consumer holds it (its death re-queues the lease through the
+        worker-liveness machinery); ``"done"`` — already fully
+        consumed."""
+        reply = self._conns[0].request(
+            "cursor_next", self._origin, lease, 1,
+            next(self._cursor_rid))
+        shard, pending = reply[1], reply[2]
+        if shard is not None:
+            return "owned"
+        return "done" if pending <= 0 else "wait"
+
+    def stream_lease_done(self, lease):
+        """Acknowledge a held segment lease as fully consumed (the
+        cursor_done half of :meth:`stream_lease`; idempotent)."""
+        self._conns[0].request("cursor_done", self._origin, lease, 0)
+
+    def stream_offsets(self, group):
+        """One consumer group's committed consumption cursors:
+        ``{(shard, seg): (offset, final)}`` — what a respawned tailer
+        resumes from, and the input to the GC watermark."""
+        reply = self._conns[0].request("stream_offsets", group)
+        return {(int(sh), int(sg)): (int(off), bool(fin))
+                for sh, sg, off, fin in reply[1]}
+
+    def stream_push(self, parts, commit, sparse_parts=()):
+        """Push gradients AND the consumption offset they were computed
+        from as one exactly-once frame (ISSUE 18 tentpole c).
+
+        ``parts``: ``[(key, grad)]`` dense numpy/NDArray grads;
+        ``sparse_parts``: ``[(key, row_ids, rows)]`` row-wise (the
+        PR-13 fast path); ``commit``: ``(group, shard, seg, offset,
+        final)`` from :meth:`StreamingIter.pending_commit`. Both halves
+        ride the SAME deterministic (origin, seq) identity derived from
+        the commit, so the whole frame is idempotent: a retry — or a
+        kill -9'd trainer's respawn recomputing the identical frame
+        from the identical records — is refused by the server's
+        watermarks. Keys must be single-part (under the part-split
+        bound); parts-less calls are pure offset commits. Returns True
+        when the server refused every half as a replay."""
+        group, shard, seg, offset, final = commit
+        origin = stream_origin(group, shard, seg)
+        seq = stream_commit_seq(offset, final)
+        per_conn = {}
+        for k, g in parts:
+            g = g.asnumpy() if hasattr(g, "asnumpy") else g
+            g = _np.ascontiguousarray(g)
+            per_conn.setdefault(self._conn(k), []).append(
+                ("d", k, g, self._base_clock.get(k, 0)))
+        for k, ids, rows in sparse_parts:
+            per_conn.setdefault(self._conn(k), []).append(
+                ("s", k, _np.asarray(ids, dtype=_np.int64),
+                 _np.ascontiguousarray(rows),
+                 self._base_clock.get(k, 0)))
+        # the commit rides the lease/offset authority (server 0); when
+        # no part routes there, a commit-only frame goes anyway
+        home = self._conns[0]
+        per_conn.setdefault(home, [])
+        replies = self._pmap([
+            (lambda c=c, pl=pl:
+             c.request("stream_push", origin, seq, pl,
+                       commit if c is home else None))
+            for c, pl in per_conn.items()])
+        return all(len(r) > 1 and r[1] == "dup" for r in replies)
 
     # -- worker registration ----------------------------------------------
     def _register_workers(self, conns):

@@ -82,6 +82,14 @@ class MXRecordIO:
 
     def close(self):
         if self.is_open and self.handle is not None:
+            if self.writable:
+                # durability: close() is the commit point — flush alone
+                # leaves records in the page cache, where a host crash
+                # right after "successful" close loses them (a writer
+                # that dies BEFORE close is the reader's torn-tail
+                # contract instead)
+                self.handle.flush()
+                os.fsync(self.handle.fileno())
             self.handle.close()
             self.is_open = False
 
@@ -121,6 +129,14 @@ class MXRecordIO:
                           % (self.handle.tell() - 8))
         length = lrec & _LMASK
         data = self.handle.read(length)
+        if len(data) < length:
+            # torn tail: a SIGKILL'd writer died mid-record. Every
+            # frame before this one is intact — report clean EOF (the
+            # cursor rewinds to the torn frame, so tell() names where
+            # the valid prefix ends) instead of handing out a partial
+            # payload as if it were a record.
+            self.handle.seek(-(8 + len(data)), os.SEEK_CUR)
+            return None
         pad = (4 - (length & 3)) & 3
         if pad:
             self.handle.read(pad)

@@ -251,6 +251,9 @@ class ServingClient:
                 self._bump("responses")
                 info = reply[2] if len(reply) > 2 and \
                     isinstance(reply[2], dict) else {}
+                # the request identity rides the info dict so callers
+                # can report_outcome() a late label against it
+                info.setdefault("rid", rid)
                 return list(reply[1]), info
             if verdict == "_no_reply":
                 # the in-process shortcut's rendering of a withheld
@@ -432,6 +435,28 @@ class ServingClient:
                 timeout=2.0, origin=self._origin)
         except (ConnectionError, OSError):
             return False
+
+    def report_outcome(self, rid, label):
+        """Deliver the late label for an answered request (ISSUE 18):
+        the replica that served ``rid`` joins it with the features it
+        noted and appends the complete ``(features, outcome)`` record
+        to its streaming emit log. The client doesn't track which
+        replica answered, so this walks the replica set and stops at
+        the first join; True when some replica joined. Best-effort by
+        design — a lost outcome is a counted shed on the serving side,
+        never an error here."""
+        label = _np.ascontiguousarray(_np.asarray(label))
+        with self._lock:
+            addrs = list(self._addrs)
+        for addr in addrs:
+            try:
+                reply = self._conn_for(addr).request(
+                    "outcome", rid, label, timeout=10.0)
+            except (ConnectionError, OSError, RuntimeError):
+                continue
+            if reply[0] == "ok" and reply[1].get("joined"):
+                return True
+        return False
 
     # -- observability / lifecycle ----------------------------------------
     def server_stats(self, addr=None):

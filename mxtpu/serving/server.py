@@ -344,6 +344,9 @@ class ModelServer:
                 weight_dir, max_to_keep=0, async_save=False,
                 use_orbax=False)
         self._draining = False
+        # optional streaming emit hook (ISSUE 18): an EmitLog that
+        # records (features, outcome) per answered request
+        self._emit = None
         self._c_lock = threading.Lock()
         # registry-backed counters (stats() reads them back); the lock
         # stays for the rid-dedupe window below
@@ -446,6 +449,15 @@ class ModelServer:
             if entry.scheduler is not None:
                 ok = entry.scheduler.drain(timeout=timeout) and ok
         return ok
+
+    def set_emit(self, emit):
+        """Attach (or detach with ``None``) a streaming
+        :class:`~mxtpu.streaming.EmitLog`: every answered predict notes
+        its ``(rid, features)`` for the outcome join, and the
+        ``outcome`` wire op completes the record into the durable log.
+        The server never owns the log — the caller closes it (one
+        EmitLog may serve several in-process replicas)."""
+        self._emit = emit
 
     def resume(self):
         """Re-open admissions after a drain — the second half of the
@@ -600,6 +612,12 @@ class ModelServer:
             return req
         req.on_resolve(lambda reply, e=entry, r=req, a=arrival:
                        self._account_reply(reply, e, r, a))
+        emit = self._emit
+        if emit is not None:
+            # bounded-dict insert only — the emit log's whole design is
+            # that the predict path never blocks on it
+            req.on_resolve(lambda reply, em=emit, r=rid, a=arrays:
+                           em.note(r, a, reply))
         return req
 
     def _admit_generate(self, msg, tctx=None, on_token=None):
@@ -844,6 +862,17 @@ class ModelServer:
             # ("rollout", model, action, kwargs) — the operator surface
             # RolloutController drives fleet-wide
             return self._do_rollout(msg)
+        if cmd == "outcome":
+            # ("outcome", rid, label): the label half of a streamed
+            # (features, outcome) record — joined against the features
+            # the predict-resolve hook noted under the same rid. Always
+            # "ok": an unjoinable outcome (no emit configured, rid
+            # evicted/unknown, queue full) is a counted shed, never a
+            # serving failure.
+            _, rid, label = msg
+            emit = self._emit
+            joined = emit is not None and emit.outcome(rid, label)
+            return ("ok", {"joined": bool(joined)})
         if cmd == "stop":
             threading.Thread(target=self.stop, daemon=True).start()
             return ("ok",)

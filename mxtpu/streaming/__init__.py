@@ -1,0 +1,46 @@
+"""Crash-safe streaming data plane: the serve→train half of the loop
+(ISSUE 18; reference ``src/io/`` RecordIO logs + iterators, grown the
+production direction ROADMAP item 4 needs).
+
+The train→serve direction already streams (``WeightPublisher`` →
+``WeightSync``); this package closes the circle:
+
+* :mod:`~mxtpu.streaming.log` — a durable, sharded, append-only record
+  log. :class:`StreamWriter` appends length+CRC-framed records into
+  segment files and seals them with the PR-4 publish discipline (fsync
+  blob + dir before the rename that makes a sealed segment visible);
+  :class:`StreamReader` tails the open segment torn-tail-tolerantly (a
+  partial/CRC-failing tail record means "not yet written", never an
+  error).
+* :mod:`~mxtpu.streaming.emit` — the serving-side producer:
+  :class:`EmitLog` logs ``(features, outcome)`` per answered request
+  off a bounded queue (overflow sheds with a counter — serving latency
+  is never hostage to the log), with an outcome-join for labels that
+  arrive after the prediction.
+* :mod:`~mxtpu.streaming.iter_` — :class:`StreamingIter`, a real
+  :class:`~mxtpu.io.DataIter` that tails segments through
+  ``kv.shard_cursor`` leases and commits consumption offsets through
+  the kvstore WITH the gradient push they feed (exactly-once across
+  kill -9: the respawn re-derives the same (origin, seq) identity from
+  the committed offset, so replays are refused by the server's
+  at-most-once watermark).
+* :mod:`~mxtpu.streaming.trainer` — :class:`ContinualTrainer`, the
+  tail→train→publish loop that folds fresh records into the kvstore
+  tables and republishes weights to the serving fleet.
+
+Contracts and the on-disk format: ``docs/streaming.md``.
+"""
+from __future__ import annotations
+
+from .log import (RecordCorrupt, StreamReader, StreamWriter,
+                  gc_consumed, list_segments, segment_seq)
+from .emit import EmitLog, decode_record, encode_record
+from .iter_ import StreamingIter, stream_origin
+from .trainer import ContinualTrainer
+
+__all__ = [
+    "StreamWriter", "StreamReader", "RecordCorrupt", "list_segments",
+    "segment_seq", "gc_consumed", "EmitLog", "encode_record",
+    "decode_record", "StreamingIter", "stream_origin",
+    "ContinualTrainer",
+]

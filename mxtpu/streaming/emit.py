@@ -1,0 +1,213 @@
+"""Serving emits (ISSUE 18 tentpole b): replicas log ``(features,
+outcome)`` per answered request into the durable stream.
+
+The contract is latency-first: the predict path only records the
+request's features in a bounded join table (dict insert), the
+``outcome`` wire op only moves the joined record onto a bounded queue
+(non-blocking put) — every disk byte is written by ONE background
+thread, and overflow anywhere sheds with a counter instead of making
+serving latency hostage to the log. Outcome-join handles the
+production shape where the label (click, purchase, measured value)
+arrives seconds after the prediction: ``note(rid, features)`` at
+predict-resolve, ``outcome(rid, label)`` when the label shows up, the
+complete record hits the log only when both halves met.
+"""
+from __future__ import annotations
+
+import itertools as _it
+import os
+import queue
+import struct
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+
+from .. import obs as _obs
+
+__all__ = ["EmitLog", "encode_record", "decode_record"]
+
+_EMIT_JOINED = _obs.counter(
+    "stream.emit_joined", "feature/outcome pairs joined and enqueued",
+    ("inst",))
+_EMIT_DROPPED = _obs.counter(
+    "stream.emit_dropped",
+    "joined records shed at the bounded emit queue", ("inst",))
+_EMIT_ORPHANS = _obs.counter(
+    "stream.emit_orphans",
+    "outcomes with no pending prediction to join", ("inst",))
+_EMIT_EVICTED = _obs.counter(
+    "stream.emit_join_evicted",
+    "pending predictions evicted from the bounded join table",
+    ("inst",))
+_EMIT_ERRORS = _obs.counter(
+    "stream.emit_errors", "append failures swallowed by the emit log",
+    ("inst",))
+_EMIT_INST = _it.count(1)
+
+_MAGIC = b"MXE1"
+_HEAD = struct.Struct("<4sHBB")   # magic, rid len, n features, has label
+
+
+def emit_queue_max():
+    """MXTPU_STREAM_EMIT_QUEUE: joined-record queue bound — at depth,
+    further outcomes shed with a counter (never block serving)."""
+    return int(os.environ.get("MXTPU_STREAM_EMIT_QUEUE", "1024"))
+
+
+def join_max():
+    """MXTPU_STREAM_JOIN_MAX: pending-prediction join-table bound —
+    oldest entries evict (counted) when labels never arrive."""
+    return int(os.environ.get("MXTPU_STREAM_JOIN_MAX", "4096"))
+
+
+def _pack_array(a):
+    a = _np.ascontiguousarray(a)
+    dt = a.dtype.str.encode("ascii")
+    return b"".join([
+        struct.pack("<B", len(dt)), dt,
+        struct.pack("<B", len(a.shape)),
+        struct.pack("<%dq" % len(a.shape), *a.shape) if a.shape else b"",
+        a.tobytes()])
+
+
+def _unpack_array(buf, pos):
+    (ndt,) = struct.unpack_from("<B", buf, pos)
+    pos += 1
+    dt = _np.dtype(buf[pos:pos + ndt].decode("ascii"))
+    pos += ndt
+    (nd,) = struct.unpack_from("<B", buf, pos)
+    pos += 1
+    shape = struct.unpack_from("<%dq" % nd, buf, pos) if nd else ()
+    pos += 8 * nd
+    n = dt.itemsize * int(_np.prod(shape, dtype=_np.int64)) \
+        if shape else dt.itemsize
+    a = _np.frombuffer(buf[pos:pos + n], dtype=dt).reshape(shape)
+    return a, pos + n
+
+
+def encode_record(rid, features, label=None):
+    """One ``(rid, features, outcome)`` record as self-describing
+    bytes: explicit dtype/shape framing, no pickle in the on-disk
+    format — a log outlives the processes that wrote it."""
+    rid_b = str(rid).encode("utf-8")
+    feats = tuple(features)
+    parts = [_HEAD.pack(_MAGIC, len(rid_b), len(feats),
+                        0 if label is None else 1), rid_b]
+    for f in feats:
+        parts.append(_pack_array(f))
+    if label is not None:
+        parts.append(_pack_array(label))
+    return b"".join(parts)
+
+
+def decode_record(buf):
+    """Inverse of :func:`encode_record`:
+    ``(rid, features_tuple, label_or_None)``."""
+    magic, nrid, nfeat, has_label = _HEAD.unpack_from(buf, 0)
+    if magic != _MAGIC:
+        raise ValueError("bad emit record magic %r" % (magic,))
+    pos = _HEAD.size
+    rid = buf[pos:pos + nrid].decode("utf-8")
+    pos += nrid
+    feats = []
+    for _ in range(nfeat):
+        a, pos = _unpack_array(buf, pos)
+        feats.append(a)
+    label = None
+    if has_label:
+        label, pos = _unpack_array(buf, pos)
+    return rid, tuple(feats), label
+
+
+class EmitLog:
+    """The bounded, non-blocking bridge from a :class:`ModelServer`'s
+    answered requests to a :class:`~mxtpu.streaming.log.StreamWriter`.
+    Attach with ``server.set_emit(emit)``; detach/close when done —
+    the server never owns it (one log may take emits from many
+    replicas of one process)."""
+
+    def __init__(self, writer, queue_max=None, join_max_=None):
+        self._writer = writer
+        self._join_max = join_max() if join_max_ is None \
+            else int(join_max_)
+        self._q = queue.Queue(
+            maxsize=emit_queue_max() if queue_max is None
+            else int(queue_max))
+        self._pending = OrderedDict()    # rid -> features tuple
+        self._plock = threading.Lock()
+        inst = "e%d" % next(_EMIT_INST)
+        self._m_joined = _EMIT_JOINED.labels(inst)
+        self._m_dropped = _EMIT_DROPPED.labels(inst)
+        self._m_orphans = _EMIT_ORPHANS.labels(inst)
+        self._m_evicted = _EMIT_EVICTED.labels(inst)
+        self._m_errors = _EMIT_ERRORS.labels(inst)
+        self._thread = threading.Thread(
+            target=self._drain, daemon=True, name="mxtpu-stream-emit")
+        self._thread.start()
+
+    # -- the serving-thread half (never blocks, never raises) -------------
+    def note(self, rid, features, reply=None):
+        """Record an answered request's features for the outcome join
+        (predict-resolve hook; non-ok replies are not joinable)."""
+        if reply is not None and reply[0] != "ok":
+            return
+        with self._plock:
+            self._pending[rid] = tuple(features)
+            self._pending.move_to_end(rid)
+            while len(self._pending) > self._join_max:
+                self._pending.popitem(last=False)
+                self._m_evicted.inc()
+
+    def outcome(self, rid, label):
+        """Join a late label to its prediction and enqueue the complete
+        record. True only when the pair met AND fit the queue."""
+        with self._plock:
+            feats = self._pending.pop(rid, None)
+        if feats is None:
+            self._m_orphans.inc()
+            return False
+        try:
+            self._q.put_nowait((rid, feats, label))
+        except queue.Full:
+            self._m_dropped.inc()
+            return False
+        self._m_joined.inc()
+        return True
+
+    # -- the disk half (one background thread) -----------------------------
+    def _drain(self):
+        while True:
+            item = self._q.get()   # mxlint: allow(blocking-call) — sentinel-terminated daemon queue
+            if item is None:
+                self._q.task_done()
+                return
+            rid, feats, label = item
+            try:
+                self._writer.append(encode_record(rid, feats, label))
+            except (IOError, OSError, ConnectionError):
+                # a dying log never takes serving with it: count, shed
+                self._m_errors.inc()
+            finally:
+                self._q.task_done()
+
+    def flush(self):
+        """Block until every enqueued record reached the writer."""
+        self._q.join()   # mxlint: allow(blocking-call) — in-process drain thread, flush contract
+
+    def close(self, seal=True):
+        """Drain, stop the writer thread, and (by default) seal the
+        open segment so every joined record is durable."""
+        self._q.join()   # mxlint: allow(blocking-call) — in-process drain thread, close contract
+        self._q.put(None)
+        self._thread.join(timeout=30)
+        if seal:
+            self._writer.close()
+
+    def counters(self):
+        return {"joined": self._m_joined.value,
+                "dropped": self._m_dropped.value,
+                "orphans": self._m_orphans.value,
+                "join_evicted": self._m_evicted.value,
+                "errors": self._m_errors.value,
+                "pending": len(self._pending)}

@@ -1,0 +1,283 @@
+"""Exactly-once stream tailing (ISSUE 18 tentpole c).
+
+:class:`StreamingIter` is a real :class:`~mxtpu.io.DataIter` over a
+:mod:`~mxtpu.streaming.log` directory, built so a kill -9 anywhere in
+the tail→train loop loses no record and trains none twice:
+
+* **Leases, not locks.** A consumer takes one segment at a time through
+  the server-owned shard cursor (``kv.stream_lease`` with the
+  :func:`stream_origin` string as the cursor epoch). If the holder
+  dies, worker-liveness GC re-queues the lease to the next consumer.
+* **Offsets commit WITH the gradients.** The iterator never records
+  progress itself — after each batch it exposes
+  :meth:`pending_commit`, the ``(group, shard, seg, offset, final)``
+  tuple the trainer hands to ``kv.stream_push`` alongside the gradient
+  parts. Both halves ride one wire frame under one deterministic
+  (origin, seq) identity, so the offset is durable exactly when the
+  gradients are applied — never before (would lose records on a crash
+  after commit) and never after (would double-train on a crash after
+  push).
+* **Deterministic batching.** A batch closes only when ``batch_size``
+  records are buffered or the segment is sealed and exhausted (the
+  remainder flushes with ``final=True``). Batch composition is a pure
+  function of log content — which is what makes a respawn's replayed
+  frame BIT-IDENTICAL to the one the dead trainer may already have
+  pushed, so the server's watermark refusal is exact, not approximate.
+
+Resume needs no local state: the authoritative position is the
+server's committed ``stream_offsets``; :meth:`state_dict` is advisory.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from .. import obs as _obs
+from ..io import DataBatch, DataIter
+from ..kvstore_async import stream_commit_seq, stream_origin
+from .emit import decode_record
+from .log import StreamReader, list_segments, list_shards
+from .log import gc_consumed as _gc_consumed
+
+__all__ = ["StreamingIter", "stream_origin", "stream_commit_seq"]
+
+_TAIL_RECORDS = _obs.counter(
+    "stream.tail_records", "records consumed from the stream",
+    ("group",))
+_TAIL_BATCHES = _obs.counter(
+    "stream.tail_batches", "batches handed to the trainer", ("group",))
+_TAIL_WAITS = _obs.counter(
+    "stream.lease_waits",
+    "lease attempts refused because another consumer holds the segment",
+    ("group",))
+
+
+def tail_poll():
+    """MXTPU_STREAM_POLL: seconds between tail re-reads of an open
+    segment that yielded nothing."""
+    return float(os.environ.get("MXTPU_STREAM_POLL", "0.05"))
+
+
+class StreamingIter(DataIter):
+    """Tail a stream log through kvstore segment leases with
+    exactly-once consumption.
+
+    Protocol (what :class:`~mxtpu.streaming.trainer.ContinualTrainer`
+    runs)::
+
+        batch = it.next()                       # records of one batch
+        kv.stream_push(grads, it.pending_commit())
+        it.commit_done()                        # only after the push
+
+    ``decode`` maps raw payload bytes to a record (default: the emit
+    codec); pass ``None`` for raw bytes. ``idle_timeout`` bounds how
+    long :meth:`iter_next` waits for new records before reporting the
+    stream (currently) exhausted; ``None`` tails forever. Buffered
+    records of a still-open segment survive an exhausted ``iter_next``
+    — they flush when the producer seals or fills the batch.
+    """
+
+    def __init__(self, kv, root, group="default", shards=None,
+                 batch_size=32, decode=decode_record, poll=None,
+                 idle_timeout=None):
+        super().__init__(batch_size=int(batch_size))
+        self._kv = kv
+        self._root = root
+        self._group = group
+        self._shards = None if shards is None else sorted(
+            int(s) for s in shards)
+        self._decode = decode
+        self._poll = tail_poll() if poll is None else float(poll)
+        self._idle_timeout = idle_timeout
+        # current lease: (shard, seg) + reader position
+        self._lease = None
+        self._reader = None
+        self._offset = 0
+        self._sealed = False
+        self._buf = []            # decoded records not yet batched
+        self._batch = None        # records handed out, awaiting commit
+        self._pending = None      # (group, shard, seg, offset, final)
+        self._m_records = _TAIL_RECORDS.labels(group)
+        self._m_batches = _TAIL_BATCHES.labels(group)
+        self._m_waits = _TAIL_WAITS.labels(group)
+
+    # -- lease / scan ------------------------------------------------------
+    def _scan_shards(self):
+        return self._shards if self._shards is not None \
+            else list_shards(self._root)
+
+    def _acquire(self, offsets=None):
+        """Lease the lowest unconsumed (shard, seg); True on success."""
+        if offsets is None:
+            offsets = self._kv.stream_offsets(self._group)
+        for shard in self._scan_shards():
+            for seq, _path, _sealed in list_segments(self._root, shard):
+                off, fin = offsets.get((shard, seq), (0, False))
+                if fin:
+                    continue
+                verdict = self._kv.stream_lease(
+                    stream_origin(self._group, shard, seq))
+                if verdict != "owned":
+                    if verdict == "wait":
+                        self._m_waits.inc()
+                    continue
+                # re-check under the lease: a final commit may have
+                # landed between the scan and the grant
+                off, fin = self._kv.stream_offsets(self._group).get(
+                    (shard, seq), (0, False))
+                if fin:
+                    self._kv.stream_lease_done(
+                        stream_origin(self._group, shard, seq))
+                    continue
+                self._lease = (shard, seq)
+                self._reader = StreamReader(self._root, shard)
+                self._offset = off
+                self._sealed = False
+                return True
+        return False
+
+    def _release(self, final):
+        if self._lease is None:
+            return
+        if final:
+            self._kv.stream_lease_done(stream_origin(
+                self._group, self._lease[0], self._lease[1]))
+        self._lease = None
+        self._reader = None
+        self._offset = 0
+        self._sealed = False
+
+    # -- batching ----------------------------------------------------------
+    def _fill(self, deadline):
+        """Advance until a batch can close; True when one is ready."""
+        while True:
+            if len(self._buf) >= self.batch_size:
+                return True
+            if self._lease is None:
+                if not self._acquire():
+                    if deadline is not None and time.time() >= deadline:
+                        return False
+                    time.sleep(self._poll)
+                    continue
+            shard, seg = self._lease
+            records, end, sealed = self._reader.read(seg, self._offset)
+            if records:
+                deadline = None if self._idle_timeout is None \
+                    else time.time() + self._idle_timeout
+                for payload, rec_end in records:
+                    self._buf.append(
+                        (payload if self._decode is None
+                         else self._decode(payload), rec_end))
+                self._m_records.inc(len(records))
+                self._offset = end
+                self._sealed = sealed
+                continue
+            self._sealed = sealed
+            if sealed:
+                # exhausted sealed segment: flush the remainder as the
+                # final batch, or finalize parts-less when nothing is
+                # left (every record already committed non-final)
+                if self._buf:
+                    return True
+                self._kv.stream_push(
+                    [], (self._group, shard, seg, self._offset, True))
+                self._release(final=True)
+                continue
+            if deadline is not None and time.time() >= deadline:
+                return False
+            time.sleep(self._poll)
+
+    def iter_next(self):
+        if self._pending is not None:
+            raise RuntimeError(
+                "previous batch not committed: call commit_done() "
+                "after stream_push, before the next batch")
+        deadline = None if self._idle_timeout is None \
+            else time.time() + self._idle_timeout
+        if not self._fill(deadline):
+            return False
+        shard, seg = self._lease
+        take = min(self.batch_size, len(self._buf))
+        chunk, self._buf = self._buf[:take], self._buf[take:]
+        self._batch = [r for r, _ in chunk]
+        final = self._sealed and not self._buf
+        self._pending = (self._group, shard, seg, chunk[-1][1], final)
+        self._m_batches.inc()
+        return True
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(data=list(self._batch), label=None, pad=0,
+                         index=None)
+
+    def getdata(self):
+        return list(self._batch) if self._batch is not None else None
+
+    def getlabel(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+    # -- the exactly-once handshake ---------------------------------------
+    def pending_commit(self):
+        """The ``(group, shard, seg, offset, final)`` consumption
+        commit for the batch just handed out — push it WITH the
+        gradients it produced (``kv.stream_push``)."""
+        return self._pending
+
+    def commit_done(self):
+        """Acknowledge that :meth:`pending_commit` reached the server
+        (inside the gradient frame). Only now does the iterator move
+        past the batch; on ``final`` the segment lease retires."""
+        if self._pending is None:
+            return
+        final = self._pending[4]
+        self._pending = None
+        self._batch = None
+        if final:
+            self._release(final=True)
+
+    # -- resume / GC -------------------------------------------------------
+    def reset(self):
+        """Drop local position (NOT server commits) — e.g. after a
+        failed push whose batch must be re-read, re-batched and re-sent
+        under the same deterministic identity."""
+        self._pending = None
+        self._batch = None
+        self._buf = []
+        self._release(final=False)
+
+    def state_dict(self):
+        """Advisory only: the authoritative resume position is the
+        server's committed ``stream_offsets`` (re-read on every
+        :meth:`_acquire`), which is exactly what makes resume safe
+        without local state."""
+        return {"group": self._group,
+                "lease": list(self._lease) if self._lease else None,
+                "offset": self._offset}
+
+    def load_state_dict(self, state):
+        del state   # resume is server-authoritative; nothing to do
+
+    def gc(self):
+        """Delete sealed segments wholly behind the committed-final
+        watermark (the contiguous final prefix per shard). Returns the
+        number of segments removed. Never touches a segment any record
+        of which is uncommitted."""
+        offsets = self._kv.stream_offsets(self._group)
+        removed = 0
+        for shard in self._scan_shards():
+            mark = -1
+            for seq, _path, sealed in list_segments(self._root, shard):
+                _off, fin = offsets.get((shard, seq), (0, False))
+                if not (sealed and fin):
+                    break
+                mark = seq
+            if mark >= 0:
+                removed += _gc_consumed(self._root, shard, mark)
+        return removed
+
+    def close(self):
+        self.reset()

@@ -1,0 +1,390 @@
+"""The durable, sharded, append-only record log (ISSUE 18 tentpole a).
+
+On-disk layout, one directory per shard::
+
+    <root>/shard-<k>/seg-00000012.log    sealed — immutable, fsynced,
+                                         published by rename
+    <root>/shard-<k>/seg-00000013.open   the tail — appended in place,
+                                         readers tolerate a torn tail
+
+Frame format (self-delimiting, CRC-checked)::
+
+    [magic u32][len u32][crc32 u32][payload bytes]
+
+Durability contract (docs/streaming.md):
+
+* a **sealed** segment is durable and immutable: every byte was
+  fsynced, then the ``.open`` → ``.log`` rename published it, then the
+  directory entry was fsynced — the PR-4 publish discipline
+  (:meth:`~mxtpu.checkpoint.CheckpointManager._fsync_file` /
+  ``_fsync_dir``), so a crash can never expose a half-sealed segment;
+* the **open** segment is the tail: records become reader-visible at
+  flush, durable at seal (or per-append with ``MXTPU_STREAM_FSYNC=1``).
+  A torn/CRC-failing final frame means "not yet written" — readers
+  stop before it and re-read once complete, NEVER error;
+* a torn frame **followed by more bytes**, or any CRC failure inside a
+  sealed segment, is real corruption → :class:`RecordCorrupt`.
+
+A writer respawned onto a directory with an ``.open`` tail (its
+predecessor was killed mid-append) truncates the torn suffix and seals
+the complete prefix — exactly the recovery the crash drill in
+``tests/test_streaming.py`` exercises.
+"""
+from __future__ import annotations
+
+import itertools as _it
+import os
+import re
+import struct
+import threading
+import zlib
+
+from .. import fault as _fault
+from .. import obs as _obs
+from ..checkpoint import CheckpointManager as _Ckpt
+
+__all__ = ["StreamWriter", "StreamReader", "RecordCorrupt",
+           "list_segments", "segment_seq", "gc_consumed"]
+
+_MAGIC = 0x584D5453              # "STMX"
+_HEADER = struct.Struct("<III")  # magic, payload length, crc32
+_SEG_RE = re.compile(r"^seg-(\d{8})\.(log|open)$")
+
+# registry instruments (ISSUE 14 discipline: registered once at module
+# level, labeled per writer/reader instance; docs/observability.md rows)
+_STREAM_APPENDS = _obs.counter(
+    "stream.append_records", "records appended to the log", ("inst",))
+_STREAM_APPEND_BYTES = _obs.counter(
+    "stream.append_bytes", "payload bytes appended to the log",
+    ("inst",))
+_STREAM_SEALED = _obs.counter(
+    "stream.segments_sealed", "segments sealed (published by rename)",
+    ("inst",))
+_STREAM_APPEND_DROPS = _obs.counter(
+    "stream.append_dropped", "appends lost to injected drops",
+    ("inst",))
+_STREAM_RECOVERED = _obs.counter(
+    "stream.torn_tails_recovered",
+    "torn tail frames truncated at writer recovery", ("inst",))
+_STREAM_GC = _obs.counter(
+    "stream.gc_segments", "consumed sealed segments collected",
+    ("inst",))
+_STREAM_INST = _it.count(1)
+
+
+def segment_bytes():
+    """MXTPU_STREAM_SEGMENT_BYTES: roll the open segment once its size
+    reaches this bound (the tail of the last frame may overshoot)."""
+    return int(os.environ.get("MXTPU_STREAM_SEGMENT_BYTES",
+                              str(1 << 20)))
+
+
+def _fsync_on_append():
+    """MXTPU_STREAM_FSYNC: 1 = fsync every append (records are durable
+    before the writer returns); 0 = flush only (visible to tailers,
+    durable at seal) — the default, matching the emit path's
+    latency-over-durability stance."""
+    return os.environ.get("MXTPU_STREAM_FSYNC", "0") != "0"
+
+
+class RecordCorrupt(IOError):
+    """Real log corruption: a CRC failure inside a sealed segment, or
+    a torn frame that is not the final bytes of the open tail."""
+
+
+def segment_seq(name):
+    """The segment sequence number of a ``seg-NNNNNNNN.(log|open)``
+    file name, or None for foreign files."""
+    m = _SEG_RE.match(os.path.basename(name))
+    return int(m.group(1)) if m else None
+
+
+def _shard_dir(root, shard):
+    return os.path.join(root, "shard-%d" % int(shard))
+
+
+def list_segments(root, shard):
+    """``[(seq, path, sealed)]`` for one shard, sequence-ordered. The
+    open tail (at most one) sorts last by construction: seals are
+    strictly sequence-ordered."""
+    d = _shard_dir(root, shard)
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for n in names:
+        m = _SEG_RE.match(n)
+        if m:
+            out.append((int(m.group(1)), os.path.join(d, n),
+                        m.group(2) == "log"))
+    out.sort()
+    return out
+
+
+def list_shards(root):
+    """The shard indices present under ``root`` (discovered from the
+    ``shard-<k>`` directory names)."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        m = re.match(r"^shard-(\d+)$", n)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def frame(payload):
+    """One wire frame for ``payload``: header (magic, length, crc32)
+    followed by the raw bytes."""
+    payload = bytes(payload)
+    return _HEADER.pack(_MAGIC, len(payload),
+                        zlib.crc32(payload)) + payload
+
+
+def read_frames(path, offset=0, sealed=False):
+    """Yield ``(payload, end_offset)`` for every complete frame from
+    ``offset``. On an incomplete/CRC-failing FINAL frame of an open
+    segment: stop (torn tail, "not yet written"). The same condition
+    inside a sealed segment — or with bytes following it — raises
+    :class:`RecordCorrupt`."""
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        f.seek(offset)
+        pos = offset
+        while True:
+            head = f.read(_HEADER.size)
+            if not head:
+                return
+            if len(head) < _HEADER.size:
+                if sealed or pos + len(head) < size:
+                    raise RecordCorrupt(
+                        "torn frame header at %s:%d" % (path, pos))
+                return                       # torn tail: not yet written
+            magic, length, crc = _HEADER.unpack(head)
+            if magic != _MAGIC:
+                raise RecordCorrupt(
+                    "bad stream magic 0x%08x at %s:%d"
+                    % (magic, path, pos))
+            payload = f.read(length)
+            end = pos + _HEADER.size + length
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                if sealed or end < size:
+                    raise RecordCorrupt(
+                        "corrupt record at %s:%d" % (path, pos))
+                return                       # torn tail: not yet written
+            yield payload, end
+            pos = end
+
+
+class StreamWriter:
+    """Appends CRC-framed records into one shard's segment chain.
+
+    Thread-safe (the emit queue's writer thread and a roll from a
+    foreground ``close`` may race); one writer per shard directory is
+    the deployment contract — segment sequence numbers are claimed from
+    the directory listing at open, like the snapshot steps of PR 4."""
+
+    def __init__(self, root, shard=0, segment_bytes_=None):
+        self.root = root
+        self.shard = int(shard)
+        self.dir = _shard_dir(root, shard)
+        os.makedirs(self.dir, exist_ok=True)
+        self._seg_bytes = segment_bytes() if segment_bytes_ is None \
+            else int(segment_bytes_)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._seq = 0              # sequence of the OPEN segment
+        self._size = 0
+        self._dead = False
+        inst = "w%d" % next(_STREAM_INST)
+        self._m_appends = _STREAM_APPENDS.labels(inst)
+        self._m_bytes = _STREAM_APPEND_BYTES.labels(inst)
+        self._m_sealed = _STREAM_SEALED.labels(inst)
+        self._m_drops = _STREAM_APPEND_DROPS.labels(inst)
+        self._m_recovered = _STREAM_RECOVERED.labels(inst)
+        self._m_gc = _STREAM_GC.labels(inst)
+        self._recover()
+
+    # -- recovery ----------------------------------------------------------
+    def _recover(self):
+        """Adopt the shard directory: truncate a predecessor's torn
+        tail off any leftover ``.open`` segment, seal its complete
+        prefix, and claim the next sequence number."""
+        segs = list_segments(self.root, self.shard)
+        next_seq = segs[-1][0] + 1 if segs else 0
+        for seq, path, sealed in segs:
+            if sealed:
+                continue
+            good = 0
+            for _, end in read_frames(path, 0, sealed=False):
+                good = end
+            if good < os.path.getsize(path):
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+                    _Ckpt._fsync_file(f)
+                self._m_recovered.inc()
+            if good:
+                self._seal_path(path)
+            else:
+                os.unlink(path)        # nothing recoverable: reuse slot
+                next_seq = min(next_seq, seq)
+        self._seq = next_seq
+
+    # -- append ------------------------------------------------------------
+    def _open_segment(self):
+        path = os.path.join(self.dir, "seg-%08d.open" % self._seq)
+        self._fh = open(path, "ab")
+        self._size = self._fh.tell()
+
+    def append(self, payload, fsync=None):
+        """Append one record. Returns ``(segment_seq, end_offset)`` —
+        the consumption cursor a reader that has this record will
+        commit — or None when an injected fault shed it (counted).
+
+        ``kind=truncate`` at ``stream.append`` renders a mid-write
+        crash: the frame's prefix lands, the writer dies — readers see
+        a torn tail, the next writer's recovery truncates it."""
+        key = "shard-%d/seg-%08d" % (self.shard, self._seq)
+        with self._lock:
+            if self._dead:
+                raise IOError("stream writer for %s died mid-append"
+                              % self.dir)
+            act = _fault.fire("stream.append", op="append", key=key)
+            if act == "drop":
+                self._m_drops.inc()
+                return None
+            if self._fh is None:
+                self._open_segment()
+            buf = frame(payload)
+            if act == "truncate":
+                # a kill -9 mid-write: half the frame reaches the disk,
+                # then this writer is gone for good
+                self._fh.write(buf[:max(1, len(buf) // 2)])
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+                self._dead = True
+                raise _fault.FaultSever(
+                    "injected mid-append crash on %s" % key)
+            self._fh.write(buf)
+            do_sync = _fsync_on_append() if fsync is None else fsync
+            if do_sync:
+                _Ckpt._fsync_file(self._fh)
+            else:
+                self._fh.flush()       # visible to tailers now
+            self._size += len(buf)
+            seq, end = self._seq, self._size
+            self._m_appends.inc()
+            self._m_bytes.inc(len(payload))
+            if self._size >= self._seg_bytes:
+                self._seal_locked()
+            return seq, end
+
+    # -- sealing -----------------------------------------------------------
+    def _seal_path(self, open_path):
+        """fsync blob → publish rename → fsync dir: a sealed segment
+        either exists completely or not at all."""
+        with open(open_path, "rb+") as f:
+            _Ckpt._fsync_file(f)
+        final = open_path[:-len(".open")] + ".log"
+        os.replace(open_path, final)
+        _Ckpt._fsync_dir(os.path.dirname(final))
+        self._m_sealed.inc()
+        return final
+
+    def _seal_locked(self):
+        if self._fh is None:
+            return None
+        path = self._fh.name
+        self._fh.close()
+        self._fh = None
+        final = self._seal_path(path)
+        self._seq += 1
+        self._size = 0
+        return final
+
+    def seal(self):
+        """Seal the open segment now (durable + immutable); the next
+        append opens the next sequence number. No-op when empty."""
+        with self._lock:
+            return self._seal_locked()
+
+    def close(self):
+        """Durable shutdown: seal whatever the open tail holds."""
+        with self._lock:
+            if self._dead:
+                return
+            self._seal_locked()
+
+    # -- GC ----------------------------------------------------------------
+    def gc(self, watermark):
+        """Collect sealed segments at or below the fleet-min consumed
+        ``watermark`` (see :func:`gc_consumed`)."""
+        n = gc_consumed(self.root, self.shard, watermark)
+        if n:
+            self._m_gc.inc(n)
+        return n
+
+
+def gc_consumed(root, shard, watermark):
+    """Delete sealed segments with ``seq <= watermark`` — the caller
+    derived ``watermark`` as the fleet-min fully-consumed segment (the
+    kvstore's ``stream_offsets`` reply: every consumer group committed
+    ``final`` for it). The open tail and anything above the watermark
+    are never touched, so an unconsumed segment cannot be collected."""
+    n = 0
+    for seq, path, sealed in list_segments(root, shard):
+        if sealed and seq <= int(watermark):
+            os.unlink(path)
+            n += 1
+    if n:
+        _Ckpt._fsync_dir(_shard_dir(root, shard))
+    return n
+
+
+class StreamReader:
+    """Torn-tail-tolerant reads over one shard's segment chain. The
+    tailing consumer (:class:`~mxtpu.streaming.iter_.StreamingIter`)
+    drives it with explicit ``(segment, offset)`` cursors — the reader
+    itself is stateless, so a respawned consumer resumes by handing the
+    committed cursor straight back in."""
+
+    def __init__(self, root, shard=0):
+        self.root = root
+        self.shard = int(shard)
+
+    def segments(self):
+        return list_segments(self.root, self.shard)
+
+    def read(self, seg, offset=0):
+        """``(records, end_offset, sealed)`` for the complete frames of
+        segment ``seg`` past ``offset``: every record that is fully
+        written now, as ``(payload, record_end_offset)`` pairs — the
+        per-record end is what a consumer commits as its consumption
+        cursor. ``sealed`` tells the consumer whether the segment
+        can still grow (False) or this is its final extent (True —
+        ``end_offset`` at file size means fully consumed). A missing
+        UNSEALED segment reads as empty: the writer may not have
+        created it yet; a missing sealed one is the GC watermark's
+        business, never reached by a committed cursor."""
+        act = _fault.fire("stream.tail", op="tail",
+                          key="shard-%d/seg-%08d" % (self.shard, seg))
+        if act == "drop":
+            # a dropped tail poll: no records seen this tick, the next
+            # poll re-reads from the same cursor
+            return [], offset, False
+        for s, path, sealed in list_segments(self.root, self.shard):
+            if s != seg:
+                continue
+            records = []
+            end = offset
+            for payload, pend in read_frames(path, offset,
+                                             sealed=sealed):
+                records.append((payload, pend))
+                end = pend
+            return records, end, sealed
+        return [], offset, False

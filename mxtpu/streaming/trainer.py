@@ -1,0 +1,146 @@
+"""The continual-learning loop (ISSUE 18 tentpole d): tail fresh
+``(features, outcome)`` records, fold them into the kvstore tables,
+republish weights to the serving fleet — closing the serve→train→serve
+circle.
+
+The loop is deliberately thin; every hard guarantee lives below it:
+
+* exactly-once consumption is the :class:`StreamingIter` +
+  ``kv.stream_push`` handshake (the offset commits IN the gradient
+  frame under a deterministic identity);
+* durability is the :mod:`~mxtpu.streaming.log` seal discipline;
+* delivery to serving is the PR-16 :class:`WeightPublisher` →
+  ``WeightSync`` path.
+
+So the trainer itself may be killed -9 at ANY line: its respawn,
+constructed the same way, resumes from the server's committed offsets
+and re-derives bit-identical frames for anything in flight.
+"""
+from __future__ import annotations
+
+import itertools as _it
+import time
+
+import numpy as _np
+
+from .. import ndarray as nd
+from .. import obs as _obs
+
+__all__ = ["ContinualTrainer"]
+
+_TRAIN_STEPS = _obs.counter(
+    "stream.train_steps", "stream batches folded into the tables",
+    ("inst",))
+_TRAIN_INST = _it.count(1)
+
+
+class ContinualTrainer:
+    """Run ``grad_fn`` over stream batches and push the result with
+    the batch's consumption commit.
+
+    ``params``: ``{name: initial numpy array}`` — rank 0 initializes
+    the kvstore keys (first-writer-wins, so a respawn's re-init is a
+    no-op) and every step pulls the post-update values back into the
+    local mirror. ``grad_fn(params, records) -> {name: grad}`` (or
+    ``({name: grad}, [(name, row_ids, rows)])`` to ride the PR-13
+    row-wise fast path). With no server optimizer installed the push
+    ACCUMULATES — ``grad_fn`` returns deltas to fold in.
+
+    ``publisher``: an optional :class:`~mxtpu.serving.WeightPublisher`;
+    every ``publish_every`` committed steps the pulled tables publish
+    to the serving fleet. ``gc_every``: every N steps, drop sealed
+    segments wholly behind the committed-final watermark.
+    """
+
+    def __init__(self, kv, it, params, grad_fn, publisher=None,
+                 publish_every=0, gc_every=0, push_retries=8,
+                 push_backoff=0.05):
+        self._kv = kv
+        self._it = it
+        self._grad_fn = grad_fn
+        self._publisher = publisher
+        self._publish_every = int(publish_every)
+        self._gc_every = int(gc_every)
+        self._push_retries = int(push_retries)
+        self._push_backoff = float(push_backoff)
+        self.steps = 0
+        self.published = 0
+        self._m_steps = _TRAIN_STEPS.labels("t%d" % next(_TRAIN_INST))
+        self._mirror = {}
+        for k, v in params.items():
+            arr = nd.array(_np.asarray(v))
+            kv.init(k, arr)            # rank-0 push + barrier
+            self._mirror[k] = arr
+        self._refresh()
+
+    def _refresh(self):
+        for k, arr in self._mirror.items():
+            self._kv.pull(k, out=arr)
+
+    @property
+    def params(self):
+        """The local post-pull mirror as ``{name: numpy}``."""
+        return {k: v.asnumpy() for k, v in self._mirror.items()}
+
+    def _push(self, dense, sparse, commit):
+        # the frame is idempotent by construction (deterministic
+        # origin/seq from the commit), so retry-on-sever is safe: a
+        # half-applied first attempt is finished, not doubled
+        last = None
+        for _ in range(self._push_retries):
+            try:
+                return self._kv.stream_push(dense, commit,
+                                            sparse_parts=sparse)
+            except (ConnectionError, OSError) as err:
+                last = err
+                time.sleep(self._push_backoff)
+        raise last
+
+    def step(self):
+        """Consume one batch; False when the stream is (currently)
+        exhausted. A True return means the batch's gradients AND its
+        consumption offset are durably applied server-side."""
+        try:
+            batch = self._it.next()
+        except StopIteration:
+            return False
+        out = self._grad_fn(self.params, batch.data)
+        dense_map, sparse = out if isinstance(out, tuple) else (out, ())
+        dense = sorted(dense_map.items())
+        self._push(dense, sparse, self._it.pending_commit())
+        self._it.commit_done()
+        self.steps += 1
+        self._m_steps.inc()
+        self._refresh()
+        if self._publisher is not None and self._publish_every and \
+                self.steps % self._publish_every == 0:
+            self._publisher.publish(self.params)
+            self.published += 1
+        if self._gc_every and self.steps % self._gc_every == 0:
+            self._it.gc()
+        return True
+
+    def run(self, max_steps=None, duration=None):
+        """Step until the stream goes quiet (``it.idle_timeout``), the
+        step budget is spent, or the wall-clock budget expires.
+        Returns the number of steps taken."""
+        t0 = time.time()
+        taken = 0
+        while True:
+            if max_steps is not None and taken >= max_steps:
+                break
+            if duration is not None and time.time() - t0 >= duration:
+                break
+            if not self.step():
+                break
+            taken += 1
+        return taken
+
+    def publish(self, pin=False):
+        """Publish the current mirror immediately (e.g. after
+        :meth:`run` returns)."""
+        if self._publisher is None:
+            raise RuntimeError("no WeightPublisher configured")
+        ver = self._publisher.publish(self.params, pin=pin)
+        self.published += 1
+        return ver

@@ -279,3 +279,35 @@ def test_embedding_bench_contract(tmp_path):
         # the contract: sparse bytes track rows touched (within 2x of
         # the touch fraction — headers/ids are the slack), dense don't
         assert pt["bytes_ratio"] <= 2 * pt["touch_fraction"] + 0.01, pt
+
+
+def test_streaming_bench_contract():
+    """tools/bench_streaming.py (ISSUE 18): exactly one JSON line, rc 0,
+    with the durable-log + exactly-once loop fields docs/perf_analysis.md
+    "Streaming" is tracked by — tiny counts, CPU-only loopback."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT,
+               MXTPU_BENCH_TINY="1", MXTPU_PS_HEARTBEAT="0")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_streaming.py"),
+         "--no-write"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, "must print exactly ONE JSON line"
+    payload = json.loads(lines[0])
+    assert payload["bench"] == "streaming_loopback"
+    assert payload["tiny"] is True
+    assert payload["records"] >= 1 and payload["payload_bytes"] >= 1
+    # durable log: append (buffered + fsync-per-record) and sealed tail
+    for section in ("append", "append_fsync"):
+        assert payload[section]["records_s"] > 0
+        assert payload[section]["mb_s"] > 0
+    # per-record durability must cost more than seal-time durability
+    assert payload["append_fsync"]["records_s"] \
+        <= payload["append"]["records_s"]
+    assert payload["tail"]["records_s"] > 0
+    # exactly-once loop: tail→train steps with the offset commit riding
+    # each stream_push frame, plus the respawn-storm dup-refusal rate
+    loop = payload["loop"]
+    assert loop["steps_s"] > 0 and loop["records_s"] > 0
+    assert loop["dup_refused_s"] > 0

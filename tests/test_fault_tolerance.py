@@ -31,6 +31,17 @@ worker dead (no bye)                -> server-side lease expiry GCs its
                                        buffered state; barrier degrades
                                        on its deadline instead of
                                        hanging the survivors
+drop   @ stream.append              -> record shed before any byte hits
+                                       the segment file: no torn record
+                                       is ever tailer-visible
+sever  @ stream.tail                -> consumer dies holding a segment
+                                       lease; bye requeues it and the
+                                       successor resumes exactly-once
+                                       from the committed offset
+trainer killed post-apply           -> the respawn's bit-identical
+                                       stream_push frame (grads +
+                                       offset commit) is refused by the
+                                       (origin, seq) watermark
 """
 import os
 
@@ -1729,3 +1740,82 @@ def test_split_moves_sparse_embedding_state_exactly_once(monkeypatch):
         src.stop()
         dst.stop()
         ctl.stop()
+
+
+# ---------------------------------------------------------------------------
+# streaming data plane rows (ISSUE 18; full drills in test_streaming.py
+# and the serve->train loop in test_dist_launch.py)
+# ---------------------------------------------------------------------------
+
+def test_stream_append_drop_no_torn_record(tmp_path):
+    """drop @ stream.append: the injected loss sheds the record BEFORE
+    any byte reaches the segment file — a concurrent tailer can never
+    observe a torn record, only a clean gap the producer re-sends."""
+    from mxtpu.streaming import StreamReader, StreamWriter
+    w = StreamWriter(str(tmp_path), shard=0)
+    w.append(b"first")
+    with fault.inject("kind=drop,point=stream.append,nth=1") as inj:
+        assert w.append(b"lost") is None
+        assert inj.stats()[0][4] == 1
+    seg, _ = w.append(b"second")
+    records, _end, _sealed = StreamReader(str(tmp_path), 0).read(seg)
+    assert [p for p, _ in records] == [b"first", b"second"]
+    w.close()
+
+
+def test_stream_sever_mid_tail_requeues_lease(monkeypatch, tmp_path):
+    """sever @ stream.tail: the consumer dies mid-tail holding the
+    segment lease; its bye requeues the lease and a successor replays
+    the segment from the committed offset — exactly once (the clock
+    totals in test_streaming.py's twin prove the arithmetic)."""
+    from mxtpu.kvstore_async import stream_origin
+    from mxtpu.streaming import StreamingIter, StreamWriter
+    w = StreamWriter(str(tmp_path), shard=0)
+    w.append(b"rec")
+    w.close()
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        it = StreamingIter(kv, str(tmp_path), group="m", batch_size=1,
+                           decode=None, idle_timeout=0.3, poll=0.01)
+        with fault.inject("kind=sever,point=stream.tail,nth=1"):
+            with pytest.raises(ConnectionError):
+                it.iter_next()
+        assert srv._cursors[stream_origin("m", 0, 0)]["outstanding"]
+        kv.close()                          # bye -> lease requeues
+        kv2 = _store(monkeypatch, srv.address)
+        it2 = StreamingIter(kv2, str(tmp_path), group="m",
+                            batch_size=1, decode=None,
+                            idle_timeout=0.3, poll=0.01)
+        assert it2.iter_next() is True      # successor owns the lease
+        assert it2.getdata() == [b"rec"]
+        kv2.stream_push([], it2.pending_commit())
+        it2.commit_done()
+        assert kv2.stream_offsets("m")[(0, 0)][1] is True
+        kv2.close()
+    finally:
+        srv.stop()
+
+
+def test_stream_killed_trainer_replay_refused(monkeypatch, tmp_path):
+    """Trainer killed between the server durably applying a frame
+    (grads + offset commit) and recording its success locally: the
+    respawn re-derives the SAME (origin, seq) frame from the log and
+    the server refuses the double — grads AND commit."""
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        kv.init("w", mx.nd.zeros((2,)))
+        frame_parts = [("w", np.ones((2,), "f"))]
+        commit = ("m", 0, 0, 64, False)
+        assert kv.stream_push(frame_parts, commit) is False  # applied
+        # the respawn's bit-identical replay
+        assert kv.stream_push(frame_parts, commit) is True   # refused
+        out = mx.nd.zeros((2,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)       # once
+        assert srv._stream_dup == 1 and srv._clock["w"] == 1
+        assert kv.stream_offsets("m")[(0, 0)] == (64, False)
+    finally:
+        kv.close()
+        srv.stop()

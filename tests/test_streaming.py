@@ -1,0 +1,496 @@
+"""Behavior suite for the crash-safe streaming data plane (ISSUE 18:
+mxtpu/streaming/* + the kvstore stream_push/stream_offsets plane).
+
+Deterministic throughout: faults come from the injection harness on
+exact schedules, the kvstore servers are loopback threads, and batch
+composition is a pure function of log content — which is exactly the
+property the exactly-once drills rely on (a respawn's replayed frames
+are bit-identical to the dead consumer's, so watermark refusal is
+exact)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import fault
+from mxtpu import kvstore_async as ka
+from mxtpu.kvstore_async import ParameterServer, stream_origin
+from mxtpu.streaming import (ContinualTrainer, EmitLog, RecordCorrupt,
+                             StreamingIter, StreamReader, StreamWriter,
+                             decode_record, encode_record)
+from mxtpu.streaming import log as slog
+
+
+@pytest.fixture(autouse=True)
+def _fast_failure_knobs(monkeypatch):
+    monkeypatch.setattr(ka, "_RETRIES", 2)
+    monkeypatch.setattr(ka, "_BACKOFF", 0.01)
+    monkeypatch.setattr(ka, "_BACKOFF_MAX", 0.05)
+    monkeypatch.setattr(ka, "_RECONNECT_TIMEOUT", 0.2)
+    monkeypatch.setenv("MXTPU_PS_HEARTBEAT", "0")
+    monkeypatch.setattr(ka, "_LOCAL_ON", False)
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+def _store(monkeypatch, addrs, rank=0, nproc=1):
+    monkeypatch.setenv("MXTPU_PS_ADDRS", addrs)
+    monkeypatch.setenv("MXTPU_PROC_ID", str(rank))
+    monkeypatch.setenv("MXTPU_NUM_PROCS", str(nproc))
+    return mx.kv.create("dist_async")
+
+
+def _sum_grad_fn(params, records):
+    tot = np.zeros((2,), np.float32)
+    for _rid, feats, _label in records:
+        tot += feats[0]
+    return {"acc": tot}
+
+
+def _write_records(root, n, shard=0, start=0, **kw):
+    w = StreamWriter(root, shard=shard, **kw)
+    for i in range(start, start + n):
+        w.append(encode_record("r%d" % i,
+                               (np.full((2,), i, np.float32),),
+                               np.float32(i)))
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# the durable log
+# ---------------------------------------------------------------------------
+
+def test_log_roundtrip_and_roll(tmp_path):
+    """Records roundtrip bit-exact; the writer rolls segments at the
+    configured bound and seals each full one (``.open`` -> ``.log``
+    rename), so tailers see sealed prefixes plus one growing tail."""
+    root = str(tmp_path)
+    w = StreamWriter(root, shard=0, segment_bytes_=256)
+    payloads = [bytes([i]) * 100 for i in range(7)]
+    for p in payloads:
+        w.append(p)
+    segs = slog.list_segments(root, 0)
+    assert len(segs) >= 2 and segs[-1][2] is False    # open tail
+    assert all(sealed for _, _, sealed in segs[:-1])
+    r = StreamReader(root, 0)
+    got = []
+    for seq, _path, _sealed in segs:
+        records, _end, _ = r.read(seq)
+        got.extend(p for p, _ in records)
+    assert got == payloads
+    w.close()
+    assert all(sealed for _, _, sealed in slog.list_segments(root, 0))
+
+
+def test_log_torn_tail_reads_as_not_yet_written(tmp_path):
+    """A half-written record at the tail of the OPEN segment is "not
+    yet written": the reader returns every complete record and stops —
+    no exception, and a later completed write appends past it."""
+    root = str(tmp_path)
+    w = StreamWriter(root, shard=0)
+    w.append(b"alpha")
+    seg, _off = w.append(b"beta")
+    # simulate the writer dying mid-append: raw partial frame
+    path = os.path.join(root, "shard-0", "seg-%08d.open" % seg)
+    frame = slog.frame(b"gamma-that-was-torn")
+    with open(path, "ab") as f:
+        f.write(frame[:len(frame) - 3])
+    records, end, sealed = StreamReader(root, 0).read(seg)
+    assert [p for p, _ in records] == [b"alpha", b"beta"]
+    assert sealed is False
+    # re-read from the committed cursor: same verdict, still no error
+    again, _end2, _ = StreamReader(root, 0).read(seg, offset=end)
+    assert again == []
+
+
+def test_log_writer_recovery_truncates_torn_tail(tmp_path):
+    """A new writer over a crashed writer's shard truncates the torn
+    suffix (counted), seals the complete prefix, and claims the next
+    segment — the records before the tear stay durable and readable."""
+    root = str(tmp_path)
+    w = StreamWriter(root, shard=0)
+    seg, _ = w.append(b"kept")
+    path = os.path.join(root, "shard-0", "seg-%08d.open" % seg)
+    w._fh.close()                     # drop the handle, keep the file
+    frame = slog.frame(b"torn")
+    with open(path, "ab") as f:
+        f.write(frame[:4])
+    w2 = StreamWriter(root, shard=0)
+    segs = slog.list_segments(root, 0)
+    assert segs[0][0] == seg and segs[0][2] is True   # sealed prefix
+    records, _end, sealed = StreamReader(root, 0).read(seg)
+    assert [p for p, _ in records] == [b"kept"] and sealed
+    nseg, _ = w2.append(b"after-recovery")
+    assert nseg == seg + 1
+    w2.close()
+
+
+def test_log_sealed_corruption_is_an_error(tmp_path):
+    """The torn-tail tolerance is ONLY for the open tail: a CRC failure
+    inside a sealed segment is real corruption and must raise."""
+    root = str(tmp_path)
+    _write_records(root, 3)
+    seq, path, sealed = slog.list_segments(root, 0)[0]
+    assert sealed
+    with open(path, "r+b") as f:
+        f.seek(20)
+        b = f.read(1)
+        f.seek(20)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(RecordCorrupt):
+        StreamReader(root, 0).read(seq)
+
+
+def test_log_drop_mid_append_no_torn_record(tmp_path):
+    """Fault row — drop @ stream.append: the record is shed BEFORE any
+    byte hits the file (counted), so no torn record is ever visible to
+    a tailer."""
+    root = str(tmp_path)
+    w = StreamWriter(root, shard=0)
+    w.append(b"before")
+    with fault.inject("kind=drop,point=stream.append,nth=1") as inj:
+        assert w.append(b"dropped") is None
+        assert inj.stats()[0][4] == 1
+    seg, _ = w.append(b"after")
+    records, _end, _ = StreamReader(root, 0).read(seg)
+    assert [p for p, _ in records] == [b"before", b"after"]
+    w.close()
+
+
+def test_log_truncate_mid_append_then_recovery(tmp_path):
+    """Fault row — truncate @ stream.append: the injected mid-frame
+    crash leaves a torn tail that tailers skip and the next writer
+    truncates away; every acknowledged record survives."""
+    root = str(tmp_path)
+    w = StreamWriter(root, shard=0)
+    seg, _ = w.append(b"acked")
+    with fault.inject("kind=truncate,point=stream.append,nth=1"):
+        with pytest.raises(ConnectionError):
+            w.append(b"torn-by-crash")
+    records, _end, _ = StreamReader(root, 0).read(seg)
+    assert [p for p, _ in records] == [b"acked"]
+    w2 = StreamWriter(root, shard=0)    # recovery seals the prefix
+    records, _end, sealed = StreamReader(root, 0).read(seg)
+    assert [p for p, _ in records] == [b"acked"] and sealed
+    w2.close()
+
+
+# ---------------------------------------------------------------------------
+# the emit codec + bounded queue
+# ---------------------------------------------------------------------------
+
+def test_emit_codec_roundtrip():
+    rid = "origin-1:42"
+    feats = (np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.array([7], dtype=np.int64))
+    label = np.float32(3.5)
+    rid2, feats2, label2 = decode_record(
+        encode_record(rid, feats, label))
+    assert rid2 == rid
+    assert len(feats2) == 2
+    np.testing.assert_array_equal(feats2[0], feats[0])
+    np.testing.assert_array_equal(feats2[1], feats[1])
+    assert feats2[0].dtype == np.float32 and feats2[1].dtype == np.int64
+    np.testing.assert_array_equal(label2, label)
+    # label-less (outcome still pending) encodes too
+    _rid3, _f3, label3 = decode_record(encode_record(rid, feats))
+    assert label3 is None
+
+
+def test_emit_join_and_shed(tmp_path):
+    """note+outcome joins into the log; an unjoined outcome is a
+    counted orphan; queue overflow sheds with a counter instead of
+    blocking; the join table is bounded with eviction."""
+    w = StreamWriter(str(tmp_path), shard=0)
+    em = EmitLog(w, queue_max=64, join_max_=2)
+    x = np.ones((2,), np.float32)
+    em.note("a", (x,), ("ok", {}))
+    assert em.outcome("a", np.float32(1.0)) is True
+    assert em.outcome("never-noted", np.float32(0.0)) is False
+    em.note("err", (x,), ("err", "boom"))     # non-ok: not joinable
+    assert em.outcome("err", np.float32(0.0)) is False
+    # bounded join table: 3 notes into a 2-slot table evicts oldest
+    em.note("r1", (x,))
+    em.note("r2", (x,))
+    em.note("r3", (x,))
+    assert em.outcome("r1", np.float32(0.0)) is False   # evicted
+    c = em.counters()
+    assert c["joined"] == 1 and c["orphans"] == 3
+    assert c["join_evicted"] >= 1
+    em.close()
+    records, _end, sealed = StreamReader(str(tmp_path), 0).read(0)
+    assert sealed and len(records) == 1
+    rid, feats, label = decode_record(records[0][0])
+    assert rid == "a" and float(np.ravel(label)[0]) == 1.0
+
+
+def test_emit_queue_overflow_sheds_not_blocks(tmp_path):
+    """With the drain thread wedged, outcomes beyond the queue bound
+    return False immediately (counted shed) — serving never blocks on
+    the log."""
+    w = StreamWriter(str(tmp_path), shard=0)
+    gate = threading.Event()
+    real_append = w.append
+    w.append = lambda payload: (gate.wait(10), real_append(payload))[1]
+    em = EmitLog(w, queue_max=2, join_max_=64)
+    x = np.ones((1,), np.float32)
+    for i in range(5):
+        em.note("r%d" % i, (x,))
+    results = [em.outcome("r%d" % i, np.float32(i)) for i in range(5)]
+    # 1 in-flight with the drain thread + 2 queued; the rest shed
+    assert results.count(False) >= 2
+    assert em.counters()["dropped"] >= 2
+    gate.set()
+    em.close()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once tailing through the kvstore
+# ---------------------------------------------------------------------------
+
+def test_streaming_iter_exactly_once_and_replay_refused(monkeypatch,
+                                                        tmp_path):
+    """The core tentpole drill in-process: consume a sealed stream via
+    leases; totals are exact; a FRESH client re-tailing the same group
+    consumes nothing (committed-final offsets) and a replayed frame is
+    refused wholesale by the (origin, seq) watermark."""
+    root = str(tmp_path)
+    _write_records(root, 10)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        it = StreamingIter(kv, root, group="g", batch_size=4,
+                           idle_timeout=0.3, poll=0.01)
+        tr = ContinualTrainer(kv, it,
+                              {"acc": np.zeros((2,), np.float32)},
+                              _sum_grad_fn)
+        assert tr.run() == 3              # 4 + 4 + 2(final)
+        np.testing.assert_allclose(tr.params["acc"], 45.0)
+        offs = kv.stream_offsets("g")
+        assert offs[(0, 0)][1] is True    # committed final
+
+        # respawned consumer: nothing to consume, totals unchanged
+        kv2 = _store(monkeypatch, srv.address)
+        it2 = StreamingIter(kv2, root, group="g", batch_size=4,
+                            idle_timeout=0.3, poll=0.01)
+        tr2 = ContinualTrainer(kv2, it2,
+                               {"acc": np.zeros((2,), np.float32)},
+                               _sum_grad_fn)
+        assert tr2.run() == 0
+        np.testing.assert_allclose(tr2.params["acc"], 45.0)
+
+        # a manually replayed frame (the respawn's in-flight double)
+        # is refused as a whole: grads AND commit
+        assert kv.stream_push(
+            [("acc", np.full((2,), 99.0, np.float32))],
+            ("g", 0, 0, offs[(0, 0)][0], True)) is True
+        out = mx.nd.zeros((2,))
+        kv.pull("acc", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 45.0)
+        assert srv._stream_dup >= 1
+        kv2.close()
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_stream_offsets_survive_server_snapshot(monkeypatch, tmp_path):
+    """The consumption cursor is part of the server's durable state:
+    a snapshot-restored server still refuses the respawned consumer's
+    replay (exactly-once across BOTH trainer and server crashes)."""
+    root = str(tmp_path / "stream")
+    snap = str(tmp_path / "snaps")
+    _write_records(root, 4)
+    srv = ParameterServer(snapshot_dir=snap, snapshot_every=1).start()
+    port = int(srv.address.split(":")[1])
+    kv = _store(monkeypatch, srv.address)
+    try:
+        it = StreamingIter(kv, root, group="g", batch_size=4,
+                           idle_timeout=0.3, poll=0.01)
+        tr = ContinualTrainer(kv, it,
+                              {"acc": np.zeros((2,), np.float32)},
+                              _sum_grad_fn)
+        assert tr.run() == 1
+        srv.snapshot()
+        kv.close()
+        srv.stop()
+        srv2 = ParameterServer(port=port, snapshot_dir=snap).start()
+        try:
+            kv2 = _store(monkeypatch, srv2.address)
+            offs = kv2.stream_offsets("g")
+            assert offs[(0, 0)][1] is True
+            it2 = StreamingIter(kv2, root, group="g", batch_size=4,
+                                idle_timeout=0.3, poll=0.01)
+            tr2 = ContinualTrainer(kv2, it2,
+                                   {"acc": np.zeros((2,), np.float32)},
+                                   _sum_grad_fn)
+            assert tr2.run() == 0
+            np.testing.assert_allclose(tr2.params["acc"], 6.0)
+            kv2.close()
+        finally:
+            srv2.stop()
+    finally:
+        srv.stop()
+
+
+def test_lease_excludes_second_consumer(monkeypatch, tmp_path):
+    """Segment leases are exclusive: while one consumer holds a
+    segment, a second gets "wait"; after the final commit retires the
+    lease the verdict from the offsets is final and the segment is
+    never re-consumed."""
+    root = str(tmp_path)
+    _write_records(root, 2)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    kv2 = _store(monkeypatch, srv.address)
+    try:
+        lease = stream_origin("g", 0, 0)
+        assert kv.stream_lease(lease) == "owned"
+        assert kv2.stream_lease(lease) == "wait"
+        # holder finishes the segment through the commit plane
+        _write = kv.stream_push([], ("g", 0, 0, 9999, True))
+        offs = kv2.stream_offsets("g")
+        assert offs[(0, 0)] == (9999, True)
+        it2 = StreamingIter(kv2, root, group="g", batch_size=4,
+                            idle_timeout=0.2, poll=0.01)
+        assert it2.iter_next() is False   # final: nothing to lease
+    finally:
+        kv.close()
+        kv2.close()
+        srv.stop()
+
+
+def test_sever_mid_tail_requeues_lease_exactly_once(monkeypatch,
+                                                    tmp_path):
+    """Fault row — sever @ stream.tail: consumer A dies mid-tail after
+    committing one batch; its departure (bye) requeues the lease and
+    consumer B resumes AT THE COMMITTED OFFSET — per-record totals
+    land exactly once."""
+    root = str(tmp_path)
+    _write_records(root, 8)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        it = StreamingIter(kv, root, group="g", batch_size=4,
+                           idle_timeout=0.3, poll=0.01)
+        tr = ContinualTrainer(kv, it,
+                              {"acc": np.zeros((2,), np.float32)},
+                              _sum_grad_fn)
+        # A leases the segment, then its tail read is severed — it
+        # dies holding the lease, having committed nothing
+        with fault.inject("kind=sever,point=stream.tail,nth=1") as inj:
+            with pytest.raises(ConnectionError):
+                tr.step()
+            assert inj.stats()[0][4] == 1
+        kv.close()                        # bye: lease requeues
+
+        kv2 = _store(monkeypatch, srv.address)
+        it2 = StreamingIter(kv2, root, group="g", batch_size=4,
+                            idle_timeout=0.3, poll=0.01)
+        tr2 = ContinualTrainer(kv2, it2,
+                               {"acc": np.zeros((2,), np.float32)},
+                               _sum_grad_fn)
+        assert tr2.run() == 2             # records 0..7, exactly once
+        np.testing.assert_allclose(tr2.params["acc"], 28.0)
+        assert kv2.stream_offsets("g")[(0, 0)][1] is True
+        kv2.close()
+    finally:
+        srv.stop()
+
+
+def test_kill_between_push_and_ack_dedupes(monkeypatch, tmp_path):
+    """Fault row — trainer killed between the server applying the
+    frame and the trainer seeing the ack (sever @ server.send): the
+    respawn re-reads from the last committed offset, regenerates the
+    bit-identical frame, and the server refuses the double — the
+    clock-total is exact, not doubled."""
+    root = str(tmp_path)
+    _write_records(root, 4)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        it = StreamingIter(kv, root, group="g", batch_size=4,
+                           idle_timeout=0.3, poll=0.01)
+        tr = ContinualTrainer(kv, it,
+                              {"acc": np.zeros((2,), np.float32)},
+                              _sum_grad_fn)
+        # the ack of the one stream_push frame is severed: the trainer
+        # retries the identical frame (deterministic identity) and the
+        # server refuses the replayed apply
+        with fault.inject(
+                "kind=sever,point=server.send,op=stream_push,nth=1") \
+                as inj:
+            assert tr.step() is True
+            assert inj.stats()[0][4] == 1
+        np.testing.assert_allclose(tr.params["acc"], 6.0)
+        assert srv._clock["acc"] == 1 and srv._stream_dup >= 0
+        assert srv._stream_commits == 1
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_gc_only_behind_committed_final_watermark(monkeypatch,
+                                                 tmp_path):
+    """GC never collects a segment with uncommitted records: sealed
+    but unconsumed segments survive; consumed-final ones go."""
+    root = str(tmp_path)
+    w = StreamWriter(root, shard=0, segment_bytes_=64)
+    for i in range(4):
+        w.append(encode_record("r%d" % i,
+                               (np.full((2,), i, np.float32),),
+                               np.float32(i)))
+    w.close()
+    assert len(slog.list_segments(root, 0)) >= 2
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        it = StreamingIter(kv, root, group="g", batch_size=4,
+                           idle_timeout=0.3, poll=0.01)
+        assert it.gc() == 0               # nothing committed yet
+        assert len(slog.list_segments(root, 0)) >= 2
+        tr = ContinualTrainer(kv, it,
+                              {"acc": np.zeros((2,), np.float32)},
+                              _sum_grad_fn)
+        tr.run()
+        n_before = len(slog.list_segments(root, 0))
+        assert it.gc() == n_before        # all consumed-final: all go
+        assert slog.list_segments(root, 0) == []
+        np.testing.assert_allclose(tr.params["acc"], 6.0)
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def test_streaming_iter_is_a_data_iter(monkeypatch, tmp_path):
+    """StreamingIter honors the DataIter surface: next() returns a
+    DataBatch, state_dict/load_state_dict exist (advisory — resume is
+    server-authoritative), and uncommitted batches refuse a second
+    next()."""
+    root = str(tmp_path)
+    _write_records(root, 4)
+    srv = ParameterServer().start()
+    kv = _store(monkeypatch, srv.address)
+    try:
+        it = StreamingIter(kv, root, group="g", batch_size=4,
+                           idle_timeout=0.3, poll=0.01)
+        assert isinstance(it, mx.io.DataIter)
+        batch = it.next()
+        assert isinstance(batch, mx.io.DataBatch)
+        assert len(batch.data) == 4 and batch.pad == 0
+        st = it.state_dict()
+        assert st["group"] == "g" and st["lease"] == [0, 0]
+        it.load_state_dict(st)
+        with pytest.raises(RuntimeError, match="not committed"):
+            it.next()
+        commit = it.pending_commit()
+        assert commit[0] == "g" and commit[4] is True
+        kv.stream_push([], commit)
+        it.commit_done()
+        assert it.iter_next() is False
+    finally:
+        kv.close()
+        srv.stop()

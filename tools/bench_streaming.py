@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Streaming data-plane bench (ISSUE 18): the durable-log and
+exactly-once loop costs the continual-learning path pays.
+
+Three sections (numbers land in docs/perf_analysis.md "Streaming"):
+
+* **append** — StreamWriter records/s and MB/s at the default segment
+  size, plus the fsync-per-append rate (``MXTPU_STREAM_FSYNC=1``): the
+  price of per-record durability vs the default seal-time durability.
+* **tail** — StreamReader records/s over sealed segments (the cold
+  respawn catch-up read), CRC verification included.
+* **loop** — the exactly-once serve→train handshake over a loopback
+  ParameterServer: stream_push frames/s with the offset commit riding
+  each frame (records/s = frames/s x batch), and the replay-refusal
+  rate (a respawn storm's worst case: every frame a dup — refusal must
+  be CHEAPER than an apply, or crash recovery melts the server).
+
+Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
+and mirrors it to docs/streaming_bench.json unless --no-write.
+CPU-only; MXTPU_BENCH_TINY=1 shrinks counts for the contract test.
+
+Run: JAX_PLATFORMS=cpu python tools/bench_streaming.py [--records N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_PS_HEARTBEAT"] = "0"
+
+TINY = os.environ.get("MXTPU_BENCH_TINY") == "1"
+
+
+def bench_append(root, n, payload, fsync):
+    from mxtpu.streaming import StreamWriter
+    w = StreamWriter(root, shard=0)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        w.append(payload, fsync=fsync)
+    w.close()
+    dt = time.perf_counter() - t0
+    return {"records_s": round(n / dt, 1),
+            "mb_s": round(n * len(payload) / dt / 1e6, 2)}
+
+
+def bench_tail(root, n):
+    from mxtpu.streaming import StreamReader
+    from mxtpu.streaming.log import list_segments
+    r = StreamReader(root, 0)
+    t0 = time.perf_counter()
+    got = 0
+    for seq, _path, _sealed in list_segments(root, 0):
+        records, _end, _ = r.read(seq)
+        got += len(records)
+    dt = time.perf_counter() - t0
+    assert got == n, (got, n)
+    return {"records_s": round(n / dt, 1)}
+
+
+def bench_loop(root, n_records, batch):
+    import mxtpu as mx
+    from mxtpu.kvstore_async import ParameterServer
+    from mxtpu.streaming import (ContinualTrainer, StreamingIter,
+                                 StreamWriter, encode_record)
+
+    w = StreamWriter(root, shard=0)
+    for i in range(n_records):
+        w.append(encode_record(
+            "r%d" % i, (np.full((8,), i % 7, np.float32),),
+            np.float32(i % 7)))
+    w.close()
+
+    srv = ParameterServer().start()
+    os.environ["MXTPU_PS_ADDRS"] = srv.address
+    os.environ["MXTPU_PROC_ID"] = "0"
+    os.environ["MXTPU_NUM_PROCS"] = "1"
+    kv = mx.kv.create("dist_async")
+    try:
+        it = StreamingIter(kv, root, group="bench", batch_size=batch,
+                           idle_timeout=0.2, poll=0.005)
+
+        def grad_fn(params, records):
+            tot = np.zeros((8,), np.float32)
+            for _rid, feats, _label in records:
+                tot += feats[0]
+            return {"acc": tot}
+
+        tr = ContinualTrainer(kv, it,
+                              {"acc": np.zeros((8,), np.float32)},
+                              grad_fn)
+        t0 = time.perf_counter()
+        steps = tr.run()
+        dt = time.perf_counter() - t0
+        assert steps == (n_records + batch - 1) // batch, steps
+
+        # replay-refusal rate: re-send one frame's worth of dups
+        parts = [("acc", np.ones((8,), np.float32))]
+        offs = kv.stream_offsets("bench")
+        (shard, seg), (offset, _fin) = sorted(offs.items())[0]
+        n_dup = max(50, n_records // 4)
+        t0 = time.perf_counter()
+        for _ in range(n_dup):
+            kv.stream_push(parts, ("bench", shard, seg, offset, True))
+        dup_dt = time.perf_counter() - t0
+        assert srv._stream_dup >= n_dup
+        return {"steps_s": round(steps / dt, 1),
+                "records_s": round(n_records / dt, 1),
+                "dup_refused_s": round(n_dup / dup_dt, 1)}
+    finally:
+        kv.close()
+        srv.stop()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int,
+                    default=500 if TINY else 20000)
+    ap.add_argument("--payload-bytes", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--no-write", action="store_true")
+    args = ap.parse_args()
+
+    payload = os.urandom(args.payload_bytes)
+    out = {"bench": "streaming_loopback", "tiny": TINY,
+           "records": args.records,
+           "payload_bytes": args.payload_bytes,
+           "batch": args.batch}
+    tmp = tempfile.mkdtemp(prefix="mxtpu_stream_bench_")
+    try:
+        adir = os.path.join(tmp, "append")
+        out["append"] = bench_append(adir, args.records, payload,
+                                     fsync=False)
+        out["tail"] = bench_tail(adir, args.records)
+        out["append_fsync"] = bench_append(
+            os.path.join(tmp, "fsync"),
+            max(50, args.records // 20), payload, fsync=True)
+        out["loop"] = bench_loop(os.path.join(tmp, "loop"),
+                                 args.records if TINY
+                                 else min(args.records, 4000),
+                                 args.batch)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    line = json.dumps(out, sort_keys=True)
+    print(line)
+    if not args.no_write:
+        with open(os.path.join(ROOT, "docs",
+                               "streaming_bench.json"), "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
