@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Fast-tier split-brain drill (ISSUE 19): a replicated loopback pair
+survives a real network partition — primary alive but cut off — with
+zero acked-update loss, and a Jepsen-style journal proves it.
+
+The drill walks the full partition lifecycle:
+
+  A. warm-up — replicated pushes, both tables converge;
+  B. DIVERGENCE — ``kind=partition`` severs the primary->backup
+     replication link only. The (async-mode) primary keeps acking
+     clients and buffers every applied-but-unreplicated record for
+     heal-time reconciliation;
+  C. PARTITION — a second standing cut isolates the primary from the
+     whole client command surface. The client's failover probe asks
+     the standby whether the primary is merely unreachable
+     (``peer_alive``); with ``MXTPU_PS_PARTITION_GRACE=0`` the grace
+     window is already spent, so availability wins: the backup is
+     promoted and mints fencing epoch 2. Both sides now serve — the
+     classic split-brain setup — but the fleet epochs differ, so no
+     two servers ever ack the same key in the same epoch;
+  D. HEAL — the cuts lift. A client frame carrying epoch 2 fences the
+     deposed primary mid-flight (it refuses with the ``fenced``
+     verdict instead of acking), its peer probe confirms the higher
+     epoch, and ``rejoin()`` replays the reconciliation buffer at the
+     new primary — deduped exactly-once by the (origin, seq)
+     watermarks — before demoting and catching back up;
+  E. the healed pair takes more traffic, and the final tables are
+     bit-for-bit equal to an uninterrupted control run.
+
+Every invoke/ack/apply is journaled under ``MXTPU_HISTORY_DIR`` and
+the offline checker (mxtpu.devtools.consistency) must prove the >=10k
+record history clean: no acked write lost, no double apply,
+single-writer-per-epoch, monotone per-key clocks.
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_partition.py`` (wired into
+``ci/run_ci.sh fast``). Exit 0 = contract holds.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_PS_HEARTBEAT"] = "0"   # sweeps run synchronously
+os.environ["MXTPU_PS_LOCAL"] = "0"       # the drill is about the wire
+os.environ["MXTPU_PS_RETRIES"] = "2"
+os.environ["MXTPU_PS_BACKOFF"] = "0.01"
+os.environ["MXTPU_PS_RECONNECT"] = "0.5"
+# a fully-partitioned primary should be deposed on the FIRST failed
+# client op — the grace window that protects against client-side-only
+# cuts is a different drill (tests/test_fault_tolerance.py)
+os.environ["MXTPU_PS_PARTITION_GRACE"] = "0"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np                                    # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+from mxtpu import fault                               # noqa: E402
+from mxtpu import kvstore_async as ka                 # noqa: E402
+from mxtpu.devtools import consistency                # noqa: E402
+
+KEYS = ["k%d" % i for i in range(4)]
+SHAPE = (8,)
+ROUNDS_A = 250      # warm-up (replicated)
+ROUNDS_B = 150      # divergence (repl link cut; 600 recs < RECONCILE_MAX)
+ROUNDS_C = 250      # partition (backup promoted, epoch 2)
+ROUNDS_D = 250      # post-heal (replicated again)
+TOTAL = ROUNDS_A + ROUNDS_B + ROUNDS_C + ROUNDS_D
+
+# the whole client command surface toward one address: what a real
+# network partition cuts (peer_info/join_backup/promote/repl ride
+# other links and are scoped by their own addr)
+CLIENT_OPS = "push|pull|pushpull|spushpull|multi|init|hello|ping" \
+             "|barrier|shard_map"
+
+
+def fail(msg):
+    print("partition check FAILED: %s" % msg)
+    return 1
+
+
+def make_pair(repl_mode="async"):
+    """primary + joined backup; addresses guaranteed substring-free of
+    each other (the fault rules match addr by substring)."""
+    pri = ka.ParameterServer(role="primary", repl_mode=repl_mode).start()
+    for _ in range(4):
+        bak = ka.ParameterServer(role="backup",
+                                 peer_addr=pri.address).start()
+        if pri.address not in bak.address \
+                and bak.address not in pri.address:
+            break
+        bak.stop()
+    pri._peer_addr = bak.address
+    bak.join_cluster(probe_interval=0)
+    deadline = time.monotonic() + 10
+    while not bak._catchup_complete:
+        if time.monotonic() > deadline:
+            raise RuntimeError("initial catch-up never completed")
+        time.sleep(0.01)
+    return pri, bak
+
+
+def make_client(addr):
+    os.environ["MXTPU_PS_ADDRS"] = addr
+    os.environ["MXTPU_PS_REPLICAS"] = "2"
+    os.environ["MXTPU_PROC_ID"] = "0"
+    os.environ["MXTPU_NUM_PROCS"] = "1"
+    kv = mx.kv.create("dist_async")
+    kv.init(KEYS, [mx.nd.zeros(SHAPE) for _ in KEYS])
+    return kv
+
+
+def push_rounds(kv, n):
+    for _ in range(n):
+        for k in KEYS:
+            kv.push(k, mx.nd.ones(SHAPE))
+
+
+def wait_clock(srv, want, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while any(srv._clock.get(k, 0) < want for k in KEYS):
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+def control_run():
+    """The uninterrupted reference: same pair shape, same pushes, no
+    faults, no journaling. Returns {key: table bytes}."""
+    pri, bak = make_pair()
+    kv = make_client(pri.address)
+    push_rounds(kv, TOTAL)
+    if not wait_clock(pri, TOTAL) or not wait_clock(bak, TOTAL):
+        raise RuntimeError("control run never converged")
+    tables = {k: np.asarray(pri._table[k]).tobytes() for k in KEYS}
+    kv.close()
+    bak.stop()
+    pri.stop()
+    return tables
+
+
+def main():
+    control = control_run()
+
+    hist = tempfile.mkdtemp(prefix="mxtpu_partition_hist_")
+    os.environ["MXTPU_HISTORY_DIR"] = hist
+    consistency.reset()
+    try:
+        return drill(control, hist)
+    finally:
+        os.environ.pop("MXTPU_HISTORY_DIR", None)
+        consistency.reset()
+        shutil.rmtree(hist, ignore_errors=True)
+
+
+def drill(control, hist):
+    pri, bak = make_pair()
+    kv = make_client(pri.address)
+
+    # -- phase A: warm-up; both replicas converge -------------------------
+    push_rounds(kv, ROUNDS_A)
+    if not wait_clock(bak, ROUNDS_A):
+        return fail("warm-up replication never drained")
+
+    # -- phase B: sever ONLY primary->backup replication ------------------
+    spec_b = "kind=partition,point=worker.send,addr=%s,op=repl" \
+        % bak.address
+    with fault.inject(spec_b) as inj:
+        push_rounds(kv, ROUNDS_B)
+        deadline = time.monotonic() + 5
+        while not pri._repl_lost:
+            if time.monotonic() > deadline:
+                return fail("severed repl stream never detached")
+            time.sleep(0.01)
+    if inj.stats()[0][4] < 1:
+        return fail("the repl-link cut never fired")
+    n_b = ROUNDS_B * len(KEYS)
+    if len(pri._unreplicated) != n_b:
+        return fail("reconciliation buffer holds %d records, want %d"
+                    % (len(pri._unreplicated), n_b))
+    if not wait_clock(pri, ROUNDS_A + ROUNDS_B):
+        return fail("primary lost acked pushes during divergence")
+    if any(bak._clock.get(k, 0) != ROUNDS_A for k in KEYS):
+        return fail("backup advanced while the repl link was cut")
+
+    # -- phase C: partition the primary from every client op --------------
+    spec_c = "kind=partition,point=worker.send,addr=%s,op=%s" \
+        % (pri.address, CLIENT_OPS)
+    with fault.inject(spec_c) as inj:
+        push_rounds(kv, ROUNDS_C)
+        if bak._role != "primary":
+            return fail("backup was not promoted (role=%s)" % bak._role)
+        if bak._epoch != 2:
+            return fail("promotion minted epoch %d, want 2" % bak._epoch)
+        if pri._role != "primary" or pri._epoch != 1:
+            return fail("the cut-off primary changed state (%s/%d) "
+                        "without hearing the new epoch"
+                        % (pri._role, pri._epoch))
+    if inj.stats()[0][4] < 1:
+        return fail("the client-surface cut never fired")
+    if not wait_clock(bak, ROUNDS_A + ROUNDS_C):
+        return fail("promoted backup lost acked pushes")
+
+    # -- phase D: heal. A client frame carrying the new epoch fences the
+    # deposed primary (it must REFUSE, not ack), then its peer probe
+    # drives reconciliation, demotion and catch-up.
+    probe = ka._ServerConn(pri.address, n_socks=1)
+    try:
+        probe.request("push", KEYS[0],
+                      np.ones(SHAPE, dtype=np.float32), 0,
+                      "fence-probe", 1, 2, retries=0)
+        return fail("deposed primary acked a client frame that "
+                    "carried the newer epoch")
+    except RuntimeError as e:
+        if "fenced" not in str(e):
+            return fail("expected a fenced refusal, got %r" % e)
+    finally:
+        probe.close()
+    if not pri._fenced:
+        return fail("the epoch-2 client frame did not fence the "
+                    "deposed primary")
+    if not pri._probe_peer():
+        return fail("fenced primary failed to rejoin after heal")
+    if pri._role != "backup":
+        return fail("deposed primary did not demote (role=%s)"
+                    % pri._role)
+    if pri._epoch != 2:
+        return fail("rejoined backup is at epoch %d, want 2"
+                    % pri._epoch)
+    # reconciliation replayed the divergence window at the new primary
+    if not wait_clock(bak, ROUNDS_A + ROUNDS_B + ROUNDS_C):
+        return fail("reconciliation lost part of the divergence window")
+    deadline = time.monotonic() + 10
+    while not pri._catchup_complete:
+        if time.monotonic() > deadline:
+            return fail("post-heal catch-up never completed")
+        time.sleep(0.01)
+
+    # -- phase E: the healed pair takes traffic and reconverges -----------
+    push_rounds(kv, ROUNDS_D)
+    if not wait_clock(bak, TOTAL) or not wait_clock(pri, TOTAL):
+        return fail("healed pair never reconverged")
+    out = mx.nd.zeros(SHAPE)
+    for k in KEYS:
+        kv.pull(k, out=out)
+        if not np.allclose(out.asnumpy(), float(TOTAL)):
+            return fail("key %r pulled %r, want %d acked pushes"
+                        % (k, out.asnumpy(), TOTAL))
+        if np.asarray(bak._table[k]).tobytes() != control[k]:
+            return fail("healed primary table for %r is not bit-equal "
+                        "to the uninterrupted control" % k)
+        if np.asarray(pri._table[k]).tobytes() != control[k]:
+            return fail("rejoined backup table for %r is not bit-equal "
+                        "to the uninterrupted control" % k)
+    h = kv.health()
+    if h["failovers"] != 1:
+        return fail("health counted %d failovers, want 1"
+                    % h["failovers"])
+
+    kv.close()
+    bak.stop()
+    pri.stop()
+
+    # -- the checker proves it from the journal ---------------------------
+    consistency.reset()   # flush the writer before reading
+    report = consistency.check(hist)
+    print(consistency.format_report(report))
+    if not report["ok"]:
+        return fail("consistency checker found violations")
+    if report["ops"] < 10000:
+        return fail("history too small for the acceptance bar: %d "
+                    "records, want >= 10000" % report["ops"])
+    if sorted(report["epochs"]) != [1, 2]:
+        return fail("journal saw epochs %r, want [1, 2]"
+                    % report["epochs"])
+    print("partition check OK — split-brain window healed, %d keys, "
+          "%d-record history clean, zero acked-update loss"
+          % (len(KEYS), report["ops"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,9 +44,12 @@ LOCKMODEL = ROOT / "mxlint_lockmodel.json"
 # for ISSUE 15: the shared-state-race / blocking-under-lock passes
 # build per-statement locksets, the whole-program call-graph
 # reachability from every concurrency root, and the transitive
-# caller-context fixpoint on top of the v2 symbol table (~11s actual
-# on the CI host; the pin keeps the sanity tier honest as it grows).
-BUDGET_SECONDS = 20.0
+# caller-context fixpoint on top of the v2 symbol table. Re-pinned
+# 20 -> 25 for ISSUE 19: the partition-tolerance layer adds a new
+# analyzed module (devtools/consistency.py) plus several hundred
+# lines of fencing/reconciliation code in the kvstore (~17s actual
+# on the CI host; the old pin left no headroom under suite load).
+BUDGET_SECONDS = 25.0
 
 
 def main():

@@ -33,6 +33,7 @@ case "$tier" in
     JAX_PLATFORMS=cpu python ci/check_module_perf.py --amp
     JAX_PLATFORMS=cpu python ci/check_embedding_perf.py
     JAX_PLATFORMS=cpu python ci/check_replication.py
+    JAX_PLATFORMS=cpu python ci/check_partition.py
     JAX_PLATFORMS=cpu python ci/check_elastic.py
     JAX_PLATFORMS=cpu python ci/check_autoscale.py
     JAX_PLATFORMS=cpu python ci/check_serving.py

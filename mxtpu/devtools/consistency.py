@@ -1,0 +1,260 @@
+"""Jepsen-style history journaling + offline consistency checking for
+the dist_async data plane (ISSUE 19 tentpole c).
+
+Every partition/failover drill has so far asserted its OWN invariants
+(final clocks, bit-equal tables). This module makes the guarantees
+checkable from first principles instead: when ``MXTPU_HISTORY_DIR`` is
+set, clients journal every push *invocation* and *acknowledgement* and
+servers journal every *application* — each record stamped with the
+operation identity ``(origin, seq)``, the fencing epoch it executed
+under, the key, and a value digest — and :func:`check` proves, offline,
+the four properties the replication design promises:
+
+1. **no acked write lost** — every (origin, seq, key) a client saw
+   acked has a surviving application: an apply on some server whose
+   table was not subsequently wiped (a deposed primary rejoining as a
+   backup wipes; its journal says so), or a re-apply elsewhere.
+2. **no double apply** — no server applied the same (origin, seq, key)
+   twice within one table lifetime (between wipes). Replication means
+   a record legitimately applies on BOTH replicas; the same replica
+   applying it twice is the at-most-once violation.
+3. **single writer per epoch** — for any (epoch, key), client-driven
+   applies come from at most ONE server. Split-brain is exactly two
+   servers acking client writes for the same key in the same epoch;
+   fencing epochs exist to make this impossible, and this check is the
+   proof.
+4. **monotone per-key clocks** — each server's per-key clock strictly
+   increases across its applies within one table lifetime.
+
+The journal is JSONL, one file per (process, journal) so writers never
+contend across processes; records carry ``time.time()`` only to order
+*cross*-file events coarsely — within a file, line order is the true
+order (appends happen under the writer's lock, and apply records are
+written under the same per-key lock that serialized the apply).
+
+Run the checker over a directory with
+``python tools/check_history.py <dir>`` or :func:`check` directly;
+every partition drill (tests/test_fault_tolerance.py,
+ci/check_partition.py, the tests/test_dist_launch.py E2E drill) ends
+by asserting ``check(dir)["ok"]``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+
+__all__ = ["enabled", "journal", "reset", "digest", "check",
+           "format_report"]
+
+_lock = threading.Lock()
+_file = None
+_path = None
+
+
+def _dir():
+    return os.environ.get("MXTPU_HISTORY_DIR", "").strip() or None
+
+
+def enabled():
+    """True when histories are being journaled (MXTPU_HISTORY_DIR set).
+    Hot paths gate their digest computation on this — one env read, no
+    locking, free when off."""
+    return _dir() is not None
+
+
+def reset():
+    """Close the writer so the next record reopens against the CURRENT
+    env (tests flip MXTPU_HISTORY_DIR per drill)."""
+    global _file, _path
+    with _lock:
+        if _file is not None:
+            try:
+                _file.close()
+            except OSError:
+                pass
+        _file = None
+        _path = None
+
+
+def digest(value):
+    """Cheap stable digest of a pushed/applied value for cross-side
+    comparison: crc32 over the raw bytes of the numpy payload. Tagged
+    wire payloads (compressed / row-sparse tuples) digest their repr —
+    stability matters, not cryptography."""
+    try:
+        import numpy as _np
+        arr = _np.ascontiguousarray(value)
+        return zlib.crc32(arr.tobytes()) & 0xFFFFFFFF
+    except (TypeError, ValueError):
+        return zlib.crc32(repr(value).encode()) & 0xFFFFFFFF
+
+
+def journal(ev, **fields):
+    """Append one history record (no-op unless enabled). ``ev`` is one
+    of ``invoke`` / ``ack`` / ``apply`` (the checked triple) or the
+    lifecycle marks ``wipe`` / ``promote`` / ``fence`` that scope the
+    checks. Writer errors are swallowed: history is evidence, never a
+    failure mode of the data plane itself."""
+    d = _dir()
+    if d is None:
+        return
+    global _file, _path
+    rec = {"ev": ev, "t": time.time()}
+    rec.update(fields)
+    line = json.dumps(rec, sort_keys=True, default=str)
+    with _lock:
+        try:
+            if _file is None or _path != d:
+                os.makedirs(d, exist_ok=True)
+                # one file per process: every thread appends under
+                # _lock, so line order IS this process's event order
+                fname = os.path.join(d, "history-%d.jsonl" % os.getpid())
+                _file = open(fname, "a", buffering=1)
+                _path = d
+            _file.write(line + "\n")
+        except OSError:
+            pass
+
+
+# -- the offline checker --------------------------------------------------
+
+def _load(history_dir):
+    recs = []
+    for name in sorted(os.listdir(history_dir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(history_dir, name)) as fin:
+            for i, line in enumerate(fin):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue       # a torn tail line (killed writer)
+                rec["_file"] = name
+                rec["_line"] = i
+                recs.append(rec)
+    return recs
+
+
+def check(history_dir):
+    """Check one journaled history; returns a report dict:
+    ``ok`` (bool), ``ops`` (total records), ``acked`` / ``applied``
+    counts, ``epochs`` seen, and ``violations`` — one human-readable
+    string per proven violation, empty when the history is clean."""
+    recs = _load(history_dir)
+    violations = []
+
+    # node lifetimes: wipe marks end a server's table era. An apply
+    # survives iff no later wipe on its node (file order per node; the
+    # journal is one file per process but a node is named explicitly,
+    # so multi-node processes still separate).
+    wipes = {}                          # node -> [t, ...]
+    for r in recs:
+        if r["ev"] == "wipe":
+            wipes.setdefault(r.get("node"), []).append(r["t"])
+
+    def survives(apply_rec):
+        for wt in wipes.get(apply_rec.get("node"), ()):
+            if wt > apply_rec["t"]:
+                return False
+        return True
+
+    def era(apply_rec):
+        # which table lifetime of its node an apply belongs to
+        return sum(1 for wt in wipes.get(apply_rec.get("node"), ())
+                   if wt < apply_rec["t"])
+
+    acked = {}                          # (origin, seq, key) -> rec
+    applies = {}                        # (origin, seq, key) -> [rec]
+    invoked = {}
+    for r in recs:
+        ident = (r.get("origin"), r.get("seq"), r.get("key"))
+        if r["ev"] == "ack":
+            acked.setdefault(ident, r)
+        elif r["ev"] == "invoke":
+            invoked.setdefault(ident, r)
+        elif r["ev"] == "apply":
+            applies.setdefault(ident, []).append(r)
+
+    # 1. no acked write lost
+    for ident, r in acked.items():
+        if not any(survives(a) for a in applies.get(ident, ())):
+            violations.append(
+                "lost acked write: origin=%s seq=%s key=%s was acked "
+                "but no surviving apply exists" % ident)
+
+    # 2. no double apply (same node, same era)
+    for ident, lst in applies.items():
+        per = {}
+        for a in lst:
+            per.setdefault((a.get("node"), era(a)), []).append(a)
+        for (node, _e), dup in per.items():
+            if len(dup) > 1:
+                violations.append(
+                    "double apply: origin=%s seq=%s key=%s applied %d "
+                    "times on %s within one table lifetime"
+                    % (ident + (len(dup), node)))
+
+    # 3. single writer per epoch: client-driven applies (via=client)
+    # for one (epoch, key) must all land on one node
+    writers = {}                        # (epoch, key) -> {node}
+    for lst in applies.values():
+        for a in lst:
+            if a.get("via") == "client":
+                writers.setdefault(
+                    (a.get("epoch"), a.get("key")), set()).add(
+                    a.get("node"))
+    for (epoch, key), nodes in sorted(
+            writers.items(), key=lambda kv: str(kv[0])):
+        if len(nodes) > 1:
+            violations.append(
+                "split brain: epoch=%s key=%s has client writes "
+                "applied by %d servers (%s)"
+                % (epoch, key, len(nodes), ", ".join(sorted(nodes))))
+
+    # 4. monotone per-key clocks per node era (file/line order within a
+    # node's journal is its true apply order)
+    seq_clock = {}                      # (node, era, key) -> last clock
+    for r in sorted((a for lst in applies.values() for a in lst),
+                    key=lambda a: (a["_file"], a["_line"])):
+        clock = r.get("clock")
+        if clock is None:
+            continue
+        slot = (r.get("node"), era(r), r.get("key"))
+        last = seq_clock.get(slot)
+        if last is not None and clock <= last:
+            violations.append(
+                "non-monotone clock: node=%s key=%s clock went "
+                "%s -> %s" % (slot[0], slot[2], last, clock))
+        seq_clock[slot] = clock
+
+    return {"ok": not violations,
+            "ops": len(recs),
+            "invoked": len(invoked),
+            "acked": len(acked),
+            "applied": sum(len(v) for v in applies.values()),
+            "nodes": sorted({r.get("node") for lst in applies.values()
+                             for r in lst if r.get("node")}),
+            "epochs": sorted({r.get("epoch") for lst in applies.values()
+                              for r in lst
+                              if r.get("epoch") is not None}),
+            "violations": violations}
+
+
+def format_report(report):
+    lines = ["consistency: %s — %d records, %d invoked, %d acked, "
+             "%d applied, epochs %s, nodes %d"
+             % ("CLEAN" if report["ok"] else "VIOLATED",
+                report["ops"], report["invoked"], report["acked"],
+                report["applied"], report["epochs"],
+                len(report["nodes"]))]
+    lines += ["  VIOLATION: %s" % v for v in report["violations"][:50]]
+    if len(report["violations"]) > 50:
+        lines.append("  ... and %d more"
+                     % (len(report["violations"]) - 50))
+    return "\n".join(lines)

@@ -45,7 +45,22 @@ Rule keys:
            the harness that owns the fleet performs the action at that
            exact step count, so elastic scale drills replay
            deterministically inside the fault matrix; see
-           ``docs/fault_tolerance.md`` "Elasticity").
+           ``docs/fault_tolerance.md`` "Elasticity"), ``partition``
+           (a STANDING asymmetric link cut between (role, role) pairs
+           — worker↔primary, primary↔backup, controller↔telemetry:
+           every matching event dies like a severed connection for as
+           long as the rule's fire window is open, and the link heals
+           on the scheduled later event — ``count=`` exhaustion — or
+           on :meth:`FaultInjector.heal`. Direction comes from the
+           point (``server.recv`` cuts the request half of a link,
+           ``server.send`` the reply half — the asymmetric-cut drill);
+           endpoint comes from ``dst=``/``addr=``/``role=``; wire
+           scope from ``op=`` alternation — ``op=repl`` alone isolates
+           the primary↔backup stream, ``point=ctl.poll|ctl.action``
+           rules cut controller↔telemetry. Unlike every other kind its
+           ``count`` defaults to ``inf``: a partition persists until
+           healed. See docs/fault_tolerance.md "Partitions &
+           fencing").
 ``point``  ``worker.send`` | ``worker.recv`` | ``server.recv`` |
            ``server.send`` | ``worker.step`` (fired by the guarded
            training loop once per step, before the jitted step runs) |
@@ -80,9 +95,12 @@ Rule keys:
            COMPLETE version, never a torn one) |
            ``any``.
 ``op``     wire command to match (``push``/``pull``/``repl``/...); ``*``
-           (default) matches all. Replication-stream frames carry
-           ``op=repl`` end to end, so a rule with ``op=push`` never
-           accidentally lands on the primary→backup forwarding wire.
+           (default) matches all; ``|`` separates alternatives
+           (``op=push|pull|hello`` — how one partition rule covers the
+           whole client command surface while the peer wire stays up).
+           Replication-stream frames carry ``op=repl`` end to end, so a
+           rule with ``op=push`` never accidentally lands on the
+           primary→backup forwarding wire.
 ``role``   only fire in processes whose ``DMLC_ROLE`` matches (default
            ``*`` = any process). A launcher-wide ``MXTPU_FAULT_SPEC``
            is inherited by every child; ``role=server`` scopes a rule
@@ -90,6 +108,16 @@ Rule keys:
            SIGKILL schedule can take down a primary shard without the
            same event count ever firing in a worker.
 ``key``    substring of the wire key to match (optional).
+``dst``    server-side points only: fire only when the RECEIVING
+           server's replication role matches (``dst=primary`` /
+           ``dst=backup``) — how an in-process drill cuts
+           worker↔primary while worker↔backup and the peer probe wire
+           stay healthy, even though every endpoint shares one
+           injector.
+``addr``   worker-side points only: substring of the remote server
+           address — the sending half of an asymmetric (role, role)
+           cut when the processes are real and the roles aren't
+           distinguishable by ``dst``.
 ``nth``    1-based index of the matching event at which the rule starts
            firing (default 1).
 ``count``  how many consecutive matching events fire (default 1;
@@ -129,8 +157,8 @@ _POINTS = ("worker.send", "worker.recv", "server.recv", "server.send",
            "serve.step", "serve.swap", "publish.snapshot", "ctl.poll",
            "ctl.action", "stream.append", "stream.tail", "any")
 _KINDS = ("sever", "drop", "delay", "truncate", "kill", "stall",
-          "nan_grad", "kill_worker", "join_worker", "leave_worker",
-          "split_shard")
+          "partition", "nan_grad", "kill_worker", "join_worker",
+          "leave_worker", "split_shard")
 
 # kinds that are SIGNALS, not transport faults: fire() returns the kind
 # name and the caller performs the action — nan_grad poisons the batch,
@@ -148,11 +176,11 @@ class FaultSever(ConnectionError):
 
 
 class _Rule:
-    __slots__ = ("kind", "point", "op", "key", "nth", "count", "delay",
-                 "role", "seen", "fired")
+    __slots__ = ("kind", "point", "op", "ops", "key", "nth", "count",
+                 "delay", "role", "dst", "addr", "seen", "fired")
 
     def __init__(self, kind, point="any", op="*", key=None, nth=1,
-                 count=1, delay=0.0, role="*"):
+                 count=None, delay=0.0, role="*", dst=None, addr=None):
         if kind not in _KINDS:
             raise ValueError("unknown fault kind %r (one of %s)"
                              % (kind, "/".join(_KINDS)))
@@ -180,25 +208,47 @@ class _Rule:
         self.kind = kind
         self.point = point
         self.op = op
+        # ``|``-separated alternation: a partition rule names the whole
+        # client command surface in one rule (op=push|pull|hello|...)
+        self.ops = None if op == "*" else frozenset(op.split("|"))
         self.key = key
         self.role = role
+        self.dst = dst
+        self.addr = addr
         self.nth = int(nth)
+        if count is None:
+            # a partition is a standing link cut: it stays up until the
+            # scheduled heal event count — or FaultInjector.heal() —
+            # closes the window; everything else defaults to one shot
+            count = "inf" if kind == "partition" else 1
         self.count = float("inf") if count in ("inf", float("inf")) \
             else int(count)
         self.delay = float(delay)
         self.seen = 0          # matching events observed
         self.fired = 0         # faults actually delivered
 
-    def matches(self, point, op, key):
+    def matches(self, point, op, key, server=None, addr=None):
         if self.point != "any" and self.point != point:
             return False
-        if self.op != "*" and self.op != op:
+        if self.ops is not None and op not in self.ops:
             return False
         if self.key is not None and (key is None
                                      or self.key not in str(key)):
             return False
         if self.role != "*" and \
                 self.role != os.environ.get("DMLC_ROLE", "worker"):
+            return False
+        if self.dst is not None and \
+                getattr(server, "_role", None) != self.dst:
+            # dst scopes a server-side point to the RECEIVING endpoint's
+            # replication role — how one rule cuts worker<->primary
+            # without touching the worker<->backup (or peer) links even
+            # when every endpoint shares one process
+            return False
+        if self.addr is not None and (addr is None
+                                      or self.addr not in str(addr)):
+            # addr scopes a worker-side point to the remote endpoint
+            # (the sending half of an asymmetric cut)
             return False
         return True
 
@@ -235,11 +285,12 @@ class FaultInjector:
             self.rules = list(spec_or_rules)
         self._lock = threading.Lock()
 
-    def _select(self, point, op, key):
+    def _select(self, point, op, key, server=None, addr=None):
         """Advance counters; return the rule that fires here, if any."""
         with self._lock:
             for rule in self.rules:
-                if not rule.matches(point, op, key):
+                if not rule.matches(point, op, key, server=server,
+                                    addr=addr):
                     continue
                 rule.seen += 1
                 if rule.seen >= rule.nth and rule.fired < rule.count:
@@ -247,7 +298,24 @@ class FaultInjector:
                     return rule
         return None
 
-    def fire(self, point, op=None, key=None, sock=None, server=None):
+    def heal(self, kind="partition"):
+        """Close matching rules' fire windows NOW — the programmatic
+        heal event for standing cuts (``kind=None`` heals every rule).
+        Deterministic drills prefer a scheduled ``count=``; heal() is
+        for the harness that owns the partition's lifetime. Returns how
+        many rules were retired."""
+        with self._lock:
+            n = 0
+            for r in self.rules:
+                if kind is not None and r.kind != kind:
+                    continue
+                if r.fired < r.count:
+                    r.count = r.fired
+                    n += 1
+            return n
+
+    def fire(self, point, op=None, key=None, sock=None, server=None,
+             addr=None):
         """Deliver whichever fault is scheduled for this event.
 
         Returns ``None`` (no fault / proceed), ``"drop"`` (the caller
@@ -259,7 +327,7 @@ class FaultInjector:
         frame. ``kind=kill_worker`` SIGKILLs this process — nothing
         after it runs, exactly like an external ``kill -9``.
         """
-        rule = self._select(point, op, key)
+        rule = self._select(point, op, key, server=server, addr=addr)
         if rule is None:
             return None
         if rule.kind in ("delay", "stall"):
@@ -267,6 +335,14 @@ class FaultInjector:
             return None
         if rule.kind == "drop":
             return "drop"
+        if rule.kind == "partition":
+            # a standing link cut: every matching event inside the
+            # window dies exactly like a severed connection, and the
+            # link heals when the window closes (count exhausted or
+            # heal()) — no process state to clean up, the next event
+            # simply goes through
+            raise FaultSever("injected partition at %s (%s)"
+                             % (point, op))
         if rule.kind in _SIGNAL_KINDS:
             return rule.kind
         if rule.kind == "kill_worker":
@@ -339,13 +415,14 @@ def active():
     return _injector
 
 
-def fire(point, op=None, key=None, sock=None, server=None):
+def fire(point, op=None, key=None, sock=None, server=None, addr=None):
     """Module-level hook the transport calls; free when no injector is
     installed (one global read, no locking)."""
     inj = active()
     if inj is None:
         return None
-    return inj.fire(point, op=op, key=key, sock=sock, server=server)
+    return inj.fire(point, op=op, key=key, sock=sock, server=server,
+                    addr=addr)
 
 
 class inject:

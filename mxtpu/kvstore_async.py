@@ -293,6 +293,7 @@ import jax.numpy as jnp
 from . import fault as _fault
 from . import ndarray as nd
 from . import obs as _obs
+from .devtools import consistency as _consistency
 from .kvstore import KVStore, _ctype_key_value, _key_int
 
 
@@ -795,6 +796,7 @@ class _ReplStream:
         self._acked = 0                  # last backup-acked
         self.dead = False
         self.death_reason = None
+        self.pending = []                # unacked window, kept at kill
         self.forwarded = 0               # records acked by the backup
         self.dup_acks = 0                # backup refused as replayed
         self._thread = threading.Thread(
@@ -858,12 +860,19 @@ class _ReplStream:
         with self._cv:
             return self._rseq - self._acked
 
-    def kill(self, reason):
+    def kill(self, reason, unacked=None):
         with self._cv:
             if self.dead:
                 return
             self.dead = True
             self.death_reason = "%s: %s" % (type(reason).__name__, reason)
+            # the unacked window — records in the dying batch plus
+            # everything still queued — survives the teardown WITH its
+            # rseq numbering: the owner keeps it for heal-time
+            # reconciliation, and the new primary dedupes each record
+            # exactly against the stream prefix it already applied
+            # (rseq <= its repl watermark for this stream id)
+            self.pending = list(unacked or []) + list(self._q)
             self._q = []
             self._cv.notify_all()
         self.conn.close()
@@ -883,12 +892,18 @@ class _ReplStream:
                 # pipelined fan-out, then per-record in-order retries —
                 # all from THIS thread, so the total order the backup
                 # sees (and its rseq watermark refuses replays against)
-                # is exactly enqueue order
+                # is exactly enqueue order. Frames carry the sender's
+                # fencing epoch: a deposed primary still draining its
+                # stream is refused with ``fenced`` by the promoted
+                # peer, which is one of the ways it learns it is
+                # deposed.
+                epoch = self._owner._epoch
                 replies = self.conn.request_all(
-                    [("repl", self.id, rseq, sub) for rseq, sub in batch],
+                    [("repl", self.id, rseq, sub, epoch)
+                     for rseq, sub in batch],
                     timeout=_REPL_TIMEOUT)
             except (ConnectionError, RuntimeError, OSError) as e:
-                self.kill(e)
+                self.kill(e, unacked=batch)
                 return
             with self._cv:
                 self._acked = batch[-1][0]
@@ -949,6 +964,30 @@ class ParameterServer:
         self._peer_conn = None       # lazy _ServerConn for peer probes
         self._probe_stop = threading.Event()
         self._probe_thread = None
+        # -- fencing epochs (ISSUE 19): every promotion mints a higher
+        # epoch; a primary that learns of a higher one — peer probe,
+        # client frame, replication refusal, rejoin handshake — is
+        # DEPOSED: it stops acking client state commands with the
+        # ``fenced`` routing verdict until it rejoins as a backup.
+        # Durable: the epoch rides every snapshot's meta.
+        self._epoch = 1
+        self._fenced = False
+        self._fenced_at = 0          # the higher epoch we learned of
+        # heal-time reconciliation: while the repl stream is down this
+        # primary keeps the applied-but-unreplicated window (bounded,
+        # as (rseq, record) pairs) so a rejoin can replay it at the new
+        # primary. The replay CANNOT lean on the (origin, key) push
+        # watermarks — those assume FIFO per origin, and the new
+        # primary has already applied the client's POST-failover seqs —
+        # so the new primary dedupes each record exactly: against the
+        # stream prefix it applied (rseq vs its repl watermark) and
+        # against the idents it applied for clients since its own
+        # promotion (_epoch_applied, recorded promote -> reconcile)
+        self._repl_lost = False
+        self._unreplicated = []
+        self._lost_stream_id = None
+        self._epoch_applied = None        # None = not recording
+        self._epoch_applied_overflow = False
         self._table = {}           # key -> NDArray (host-side, cpu jax)
         self._locks = {}           # key -> Lock (per-key serialization)
         self._locks_guard = threading.Lock()
@@ -1157,6 +1196,12 @@ class ParameterServer:
             stream = _ReplStream(self, conn, self._repl_mode)
             self._repl = stream
             self._backup_addr = addr
+            # redundancy is back: the catch-up transfer about to run
+            # carries the whole table, reconciliation window included
+            self._repl_lost = False
+            with self._ctr_lock:
+                self._unreplicated = []
+                self._lost_stream_id = None
         threading.Thread(target=self._run_catchup, args=(stream,),
                          daemon=True, name="mxtpu-ps-catchup").start()
         _log.info("parameter server %s: backup %s attached (%s "
@@ -1219,15 +1264,29 @@ class ParameterServer:
         still the live stream (a replaced stream's death is not a
         detach). Loud — redundancy is gone until a backup rejoins —
         but the primary keeps serving solo rather than wedging the
-        fleet."""
+        fleet. The stream's unacked window moves into the
+        reconciliation buffer, and a ``fenced`` refusal from the peer
+        means we are the DEPOSED side of a healed partition: fence now
+        instead of serving split-brain."""
         with self._repl_guard:
             if self._repl is not stream:
                 return
             self._repl = None
             addr, self._backup_addr = self._backup_addr, None
+            self._repl_lost = True
+            self._lost_stream_id = stream.id
+            with self._ctr_lock:
+                keep = _RECONCILE_MAX - len(self._unreplicated)
+                if keep > 0:
+                    self._unreplicated.extend(stream.pending[-keep:])
         _log.warning("parameter server %s: backup %s detached (%s) — "
-                     "serving UNREPLICATED until a backup rejoins",
-                     self.address, addr, reason)
+                     "serving UNREPLICATED until a backup rejoins "
+                     "(%d unacked records kept for reconciliation)",
+                     self.address, addr, reason,
+                     len(stream.pending))
+        higher = _fenced_epoch(reason)
+        if higher is not None:
+            self._fence(higher, "replication refused by promoted peer")
 
     def _repl_stream(self):   # mxlint: allow(shared-state-race) — GIL-atomic binding read on the apply paths: attach/detach rebinds under _repl_guard, and a stream torn down after this read is handled by _ReplStream.dead / forward() raising onto the retry layer
         """The live replication stream binding, read without
@@ -1286,6 +1345,12 @@ class ParameterServer:
             info = self._peer_request("peer_info", retries=0,
                                       timeout=2.0)
             peer = info[1] if info is not None else None
+            if peer is not None:
+                # the rejoin handshake is one of the fencing triggers:
+                # a respawned/healed primary adopts the fleet epoch
+                # before it could possibly ack anything stale
+                self._epoch = max(self._epoch,   # mxlint: allow(shared-state-race) — monotone max-adopt at boot (join_cluster runs before serving starts); no handler thread exists yet
+                                  int(peer.get("fence_epoch", 0)))
             if peer is not None and peer.get("role") == "primary":
                 self._become_backup()
             elif peer is not None and peer.get("catchup_complete") \
@@ -1321,28 +1386,121 @@ class ParameterServer:
                 self._clock.pop(key, None)
         self._applied = {}
         self._moved = {}   # the authority's catch-up re-teaches the map
+        with self._ctr_lock:
+            self._repl_lost = False
+            self._unreplicated = []
+            self._lost_stream_id = None
+            self._epoch_applied = None
+            self._epoch_applied_overflow = False
+        # the wipe mark scopes the consistency checker's node eras:
+        # applies before it did NOT survive on this node (they live on
+        # only through reconciliation / re-replication elsewhere)
+        _consistency.journal("wipe", node=self.address,
+                             epoch=self._epoch)
         _log.warning("parameter server %s: demoted to backup of %s "
                      "(the peer was promoted while we were down)",
                      self.address, self._peer_addr)
 
     def _probe_peer(self):
-        """One peer-monitor tick (backup side): if the peer is a
+        """One peer-monitor tick. Backup side: if the peer is a
         primary that does not currently list us as its backup — first
         boot, primary restart, or a detach we never observed — ask to
-        (re)join. Returns True when attached. Primaries no-op: the
-        rejoin is always driven from the backup end."""
-        if self._role != "backup" or self._tcp.dying:
+        (re)join; returns True when attached. Primary side (ISSUE 19):
+        the probe is a fencing trigger — a peer that is ALSO primary
+        at a higher epoch means WE are the deposed half of a healed
+        partition: fence and rejoin under it."""
+        if self._tcp.dying:
+            return False
+        if self._role == "primary":
+            if self._fenced:
+                return self.rejoin()
+            info = self._peer_request("peer_info", retries=0,
+                                      timeout=2.0)
+            if info is None:
+                return False
+            peer = info[1]
+            if peer.get("role") == "primary" and \
+                    int(peer.get("fence_epoch", 0)) > self._epoch:
+                self._fence(int(peer.get("fence_epoch", 0)),
+                            "peer probe found a higher epoch")
+                return self.rejoin()
+            return False
+        if self._role != "backup":
             return False
         info = self._peer_request("peer_info", retries=0, timeout=2.0)
         if info is None:
             return False
         peer = info[1]
+        self._epoch = max(self._epoch,   # mxlint: allow(shared-state-race) — monotone max-adopt on the single peer-monitor thread; concurrent readers see either epoch, both of which this server honored at some instant
+                          int(peer.get("fence_epoch", 0)))
         if peer.get("role") != "primary":
             return False   # two backups: a promote must break the tie
         if peer.get("backup") == self.address:
             return True    # already attached
         return self._peer_request("join_backup", self.address,
                                   retries=0, timeout=5.0) is not None
+
+    def _fence(self, higher, why):
+        """Learn of a higher fencing epoch: this server is DEPOSED. It
+        stops acking every client state command (the ``fenced``
+        verdict) immediately — split-brain prevention is exactly this
+        line — and waits for :meth:`rejoin` (the peer-monitor drives
+        it; drills call it synchronously) to reconcile and demote."""
+        with self._repl_guard:
+            if higher <= self._epoch or self._fenced:
+                if higher > self._fenced_at:
+                    self._fenced_at = max(self._fenced_at, higher)
+                if higher <= self._epoch:
+                    return
+            else:
+                self._fenced_at = higher
+            self._fenced = True
+        _consistency.journal("fence", node=self.address,
+                             epoch=self._epoch, deposed_by=higher)
+        _log.warning(
+            "parameter server %s: FENCED at epoch %d — a peer holds "
+            "epoch %d (%s); refusing client writes until rejoin",
+            self.address, self._epoch, higher, why)
+
+    def rejoin(self, timeout=10.0):
+        """Heal-time reconciliation for a fenced ex-primary: replay
+        the applied-but-unreplicated window at the new primary — which
+        dedupes each record exactly (against the repl-stream prefix it
+        applied and the idents it applied for clients since its own
+        promotion) — then drop local state and rejoin the pair as its
+        backup. Returns True once demoted (catch-up streams in
+        asynchronously)."""
+        if not self._fenced or self._role != "primary":
+            return False
+        with self._ctr_lock:
+            raw = list(self._unreplicated)
+        # unique by (origin, seq, key): the stream-death harvest and
+        # the _repl_lost buffering can each capture a record caught in
+        # the teardown race, and the replay must carry it once
+        seen, entries = set(), []
+        for rseq, rec in raw:
+            ident = _rec_ident(rec)
+            if ident is None or ident in seen:
+                continue
+            seen.add(ident)
+            entries.append((rseq, rec))
+        if entries:
+            reply = self._peer_request(
+                "reconcile", self._epoch, self._lost_stream_id,
+                entries, retries=0, timeout=timeout)
+            if reply is None:
+                return False   # peer unreachable: the monitor retries
+            _log.warning(
+                "parameter server %s: reconciled %d unacked records "
+                "at %s (%s)", self.address, len(entries),
+                self._peer_addr, reply[1])
+            with self._ctr_lock:
+                self._unreplicated = []
+        self._become_backup()
+        with self._repl_guard:
+            self._epoch = max(self._epoch, self._fenced_at)   # mxlint: allow(shared-state-race) — monotone max-adopt under _repl_guard on the peer-monitor thread; the fenced flag (checked first everywhere) kept every client arm refusing throughout
+            self._fenced = False
+        return self._probe_peer()
 
     def _probe_loop(self, interval):
         while not self._probe_stop.wait(interval):
@@ -1459,7 +1617,11 @@ class ParameterServer:
         if cur is None:
             cur = {"num_shards": int(num_shards), "next": 0,
                    "requeued": [], "outstanding": {}, "done": set(),
-                   "last": {}}
+                   "last": {},
+                   # shard -> fencing epoch it was last granted under
+                   # (ISSUE 19: stale-epoch completions are refused
+                   # once the shard was re-granted after a heal)
+                   "granted": {}}
             self._cursors[epoch] = cur
             if isinstance(epoch, int):
                 for old in [e for e in self._cursors
@@ -1643,15 +1805,59 @@ class ParameterServer:
         self._repl_barrier(stream, rseq)
         return ("ok",)
 
-    def _do_push(self, msg, _repl=False):
-        # ("push", key, grad, base_clock[, origin, seq]) — the
+    def _note_applied(self, rec, key, origin, seq, _repl, rseq=None):
+        """Post-apply bookkeeping, under the SAME key lock that
+        serialized the apply (ISSUE 19): journal the application for
+        the consistency checker; while the repl stream is down
+        (``_repl_lost``) buffer the record — with the rseq it was
+        forwarded under, if any — for heal-time reconciliation; and,
+        between this server's own promotion and the deposed peer's
+        reconcile, record every client-applied ident so the reconcile
+        replay can be deduped exactly (a high-watermark cannot: this
+        primary has already applied the client's post-failover seqs,
+        which sit ABOVE the divergence window's)."""
+        if not _repl and (self._repl_lost   # mxlint: allow(shared-state-race) — GIL-atomic flag reads gating the slow path; the flags flip under _ctr_lock and the lock is retaken before mutating
+                          or self._epoch_applied is not None):
+            with self._ctr_lock:
+                if (self._repl_lost   # mxlint: allow(shared-state-race) — re-checked under _ctr_lock, the lock every _repl_lost/_unreplicated writer holds; the unlocked sites are the gating fast-path reads blessed above
+                        and len(self._unreplicated) < _RECONCILE_MAX):
+                    self._unreplicated.append((rseq, rec))
+                ea = self._epoch_applied
+                if ea is not None and origin is not None:
+                    if len(ea) < _RECONCILE_MAX * 16:
+                        ea.add((origin, seq, key))
+                    else:
+                        self._epoch_applied_overflow = True
+        if origin is not None and _consistency.enabled():
+            _consistency.journal(
+                "apply", origin=origin, seq=seq, key=str(key),
+                epoch=self._epoch, clock=self._clock[key],   # mxlint: allow(shared-state-race) — GIL-atomic journal stamp under the key lock: the epoch an apply records is whichever this server honored at that instant, exactly what the checker wants
+                node=self.address, role=self._role,   # mxlint: allow(shared-state-race) — GIL-atomic journal stamp; a role flip mid-apply is scoped by the wipe record the demotion journals
+                via="repl" if _repl else "client",
+                digest=_consistency.digest(self._table[key]))
+
+    def _do_push(self, msg, _repl=False, _reconcile=False):
+        # ("push", key, grad, base_clock[, origin, seq[, epoch]]) — the
         # origin/seq pair makes a retried push at-most-once: a replay
         # whose seq this server already applied for that origin+key
         # is acked but NOT re-applied (the ack, not the update, was
-        # what got lost). Legacy 4-tuple pushes skip dedupe.
+        # what got lost). Legacy 4-tuple pushes skip dedupe. The
+        # trailing fencing epoch (ISSUE 19) is the client-frame fencing
+        # trigger: a client that witnessed a promotion this server
+        # missed deposes it on contact. ``_reconcile`` bypasses the
+        # watermark dup check: a heal-time replay carries seqs BELOW
+        # the watermark (the client moved on after failover) that were
+        # nonetheless never applied here — the reconcile arm has
+        # already proven that exactly, per record.
         key, grad, base_clock = msg[1], msg[2], msg[3]
         origin, seq = (msg[4], msg[5]) if len(msg) >= 6 \
             else (None, None)
+        if not _repl and len(msg) >= 7 and msg[6] is not None \
+                and msg[6] > self._epoch:
+            self._fence(msg[6], "client frame carried a newer epoch")
+            return ("err", "fenced: shard replica %s was deposed by a "
+                           "peer promotion (epoch %d)"
+                           % (self.address, self._fenced_at))
         stream = rseq = None
         dup = False
         with self._lock_for(key):
@@ -1670,7 +1876,7 @@ class ParameterServer:
                     # applied there, so it already carries its effect
                     return ("ok", "skipped")
                 return ("err", "push to uninitialized key %r" % (key,))
-            if origin is not None and \
+            if not _reconcile and origin is not None and \
                     self._applied.get((origin, key), 0) >= seq:
                 with self._ctr_lock:
                     self._dup_n += 1
@@ -1678,7 +1884,10 @@ class ParameterServer:
                 stream = None if _repl else self._repl_stream()
             else:
                 if origin is not None:
-                    self._applied[(origin, key)] = seq
+                    # max, not assign: a reconcile replay's seq sits
+                    # below the watermark and must not reopen it
+                    self._applied[(origin, key)] = max(
+                        self._applied.get((origin, key), 0), seq)
                 # a restored snapshot may trail the clock a worker based
                 # its step on: clamp, staleness is never negative
                 stale = max(0, self._clock[key] - base_clock)
@@ -1731,6 +1940,8 @@ class ParameterServer:
                     self._clock[key] += 1
                     if stream is not None:
                         rseq = stream.forward(rec)
+                self._note_applied(rec, key, origin, seq, _repl,
+                                   rseq=rseq)
         if not dup:
             with self._ctr_lock:
                 self._push_count += 1
@@ -1755,7 +1966,7 @@ class ParameterServer:
             self._table[key] = _np.array(self._table[key], copy=True)
         return self._table[key]
 
-    def _do_sparse_push(self, msg, _repl=False):
+    def _do_sparse_push(self, msg, _repl=False, _reconcile=False):
         # ("spush", key, row_ids, rows, base_clock[, origin, seq]) —
         # the row-sparse push (reference DataHandleRowSparse,
         # kvstore_dist_server.h:631-792, on the PR-10 wire): only the
@@ -1767,6 +1978,12 @@ class ParameterServer:
         # sgd/adagrad/adam.
         key, row_ids, rows, base_clock = msg[1], msg[2], msg[3], msg[4]
         origin, seq = (msg[5], msg[6]) if len(msg) >= 7 else (None, None)
+        if not _repl and len(msg) >= 8 and msg[7] is not None \
+                and msg[7] > self._epoch:
+            self._fence(msg[7], "client frame carried a newer epoch")
+            return ("err", "fenced: shard replica %s was deposed by a "
+                           "peer promotion (epoch %d)"
+                           % (self.address, self._fenced_at))
         stream = rseq = None
         dup = False
         with self._lock_for(key):
@@ -1778,7 +1995,7 @@ class ParameterServer:
                 if _repl and not self._catchup_complete:   # mxlint: allow(shared-state-race) — GIL-atomic flag read under the key lock; the skip-until-transferred protocol tolerates a momentarily stale value
                     return ("ok", "skipped")
                 return ("err", "push to uninitialized key %r" % (key,))
-            if origin is not None and \
+            if not _reconcile and origin is not None and \
                     self._applied.get((origin, key), 0) >= seq:
                 with self._ctr_lock:
                     self._dup_n += 1
@@ -1794,7 +2011,8 @@ class ParameterServer:
                             % (key, ids.min(), ids.max(),
                                store.shape[0]))
                 if origin is not None:
-                    self._applied[(origin, key)] = seq
+                    self._applied[(origin, key)] = max(
+                        self._applied.get((origin, key), 0), seq)
                 stale = max(0, self._clock[key] - base_clock)
                 with self._ctr_lock:
                     self._stale_max = max(self._stale_max, stale)
@@ -1839,6 +2057,8 @@ class ParameterServer:
                     self._clock[key] += 1
                     if stream is not None:
                         rseq = stream.forward(rec)
+                self._note_applied(rec, key, origin, seq, _repl,
+                                   rseq=rseq)
                 with self._ctr_lock:
                     self._sparse_pushes += 1
                     self._sparse_rows += int(ids.size)
@@ -1917,6 +2137,14 @@ class ParameterServer:
             return ("err", "not_serving: shard replica %s is a backup "
                            "(primary: %s)"
                            % (self.address, self._peer_addr))
+        if not _repl and self._fenced and cmd in self._CLIENT_STATE_CMDS:
+            # "fenced" is likewise a routing verdict (ISSUE 19): this
+            # server was deposed by a promotion it did not witness —
+            # acking anything now is split-brain. The message carries
+            # the HIGHER epoch so clients adopt it on sight.
+            return ("err", "fenced: shard replica %s was deposed by a "
+                           "peer promotion (epoch %d)"
+                           % (self.address, self._fenced_at))
         if cmd == "init":
             return self._do_init(msg, _repl=_repl)
         if cmd == "push":
@@ -2077,6 +2305,7 @@ class ParameterServer:
             # handed away, and where (clients refresh on a version bump
             # advertised in hello/ping replies)
             return ("ok", {"version": self._map_version,
+                           "fence_epoch": self._epoch,
                            "moved": dict(self._moved)})
         if cmd == "cursor_next":
             # ("cursor_next", origin, epoch, num_shards, rid): one
@@ -2116,18 +2345,39 @@ class ParameterServer:
                     if shard is not None:
                         cur["outstanding"][shard] = origin
                     cur["last"][origin] = (rid, shard)
+                if shard is not None:
+                    # the grant is stamped with the CURRENT fencing
+                    # epoch (ISSUE 19): after a partition heals, a
+                    # completion presented under an older stamp for a
+                    # shard that was re-granted since is refused — a
+                    # partitioned StreamingIter tailer cannot double-
+                    # consume a segment past the heal
+                    cur["granted"][shard] = self._epoch
                 pending = cur["num_shards"] - len(cur["done"])
-            return ("ok", shard, pending)
+            return ("ok", shard, pending, self._epoch)
         if cmd == "cursor_done":
             # shard finished: it can never be re-queued, and once every
             # shard of the epoch is done the cursor reports pending=0
-            # so pollers stop waiting (idempotent: done is a set)
-            _, origin, epoch, shard = msg
+            # so pollers stop waiting (idempotent: done is a set). The
+            # optional trailing element is the fencing epoch the shard
+            # was granted under (see cursor_next).
+            _, origin, epoch, shard = msg[:4]
+            done_epoch = msg[4] if len(msg) > 4 else None
             if not isinstance(epoch, str):
                 epoch = int(epoch)
             with self._cursor_lock:
                 cur = self._cursors.get(epoch)
                 if cur is not None:
+                    granted = cur["granted"].get(shard) \
+                        if "granted" in cur else None
+                    holder = cur["outstanding"].get(shard)
+                    if done_epoch is not None and granted is not None \
+                            and done_epoch < granted \
+                            and holder is not None and holder != origin:
+                        return ("err", "fenced: shard %r of cursor %r "
+                                       "was re-granted to %s under a "
+                                       "newer fleet epoch (epoch %d)"
+                                % (shard, epoch, holder, granted))
                     cur["outstanding"].pop(shard, None)
                     cur["done"].add(shard)
             return ("ok",)
@@ -2230,10 +2480,24 @@ class ParameterServer:
             # preserves the primary's total send order.
             if self._role == "primary":
                 # a zombie old primary streaming at a promoted server
-                # must be refused, not applied over the live table
-                return ("err", "not_serving: %s is a primary; refusing "
-                               "replication records" % self.address)
-            _, sid, rseq, sub = msg
+                # must be refused, not applied over the live table —
+                # and the refusal carries OUR epoch, so the sender
+                # fences itself on sight (_on_repl_dead parses it)
+                return ("err", "fenced: %s is a promoted primary; "
+                               "refusing replication records (epoch %d)"
+                        % (self.address, self._epoch))
+            _, sid, rseq, sub = msg[:4]
+            rec_epoch = msg[4] if len(msg) > 4 else None
+            if rec_epoch is not None and rec_epoch != self._epoch:
+                if rec_epoch < self._epoch:
+                    # a stale-epoch stream: its primary was deposed by
+                    # a promotion it has not witnessed yet
+                    return ("err", "fenced: replication record at "
+                                   "stale epoch %d refused by %s "
+                                   "(epoch %d)"
+                            % (rec_epoch, self.address, self._epoch))
+                # adopt: the stream IS the primary's authority
+                self._epoch = rec_epoch   # mxlint: allow(shared-state-race) — forward-only adopt on the single repl-apply path of a backup; no client arm acks while role is backup, so a momentarily stale reader cannot ack under the old epoch
             if sid != self._repl_stream_id:
                 self._repl_stream_id = sid
                 self._repl_applied_rseq = 0
@@ -2320,13 +2584,36 @@ class ParameterServer:
                 was = self._role
                 if was == "backup":
                     self._role = "primary"
+                    # mint the fencing epoch (ISSUE 19): monotone,
+                    # durable (snapshots carry it), and the line every
+                    # split-brain check hangs off — the deposed
+                    # incumbent is one epoch behind from this instant
+                    self._epoch += 1   # mxlint: allow(shared-state-race) — the promotion mint under _repl_guard; every other writer is a monotone adopt, so readers on any thread see some epoch this server honored, never a torn or regressing value
                     self._promotions += 1
                     self._catchup_complete = True
+                    with self._ctr_lock:
+                        # record every client-applied ident from this
+                        # instant until the deposed peer reconciles:
+                        # the exact-dedupe set its replay checks
+                        # against (the watermark can't — clients'
+                        # post-failover seqs land above the deposed
+                        # side's divergence window)
+                        self._epoch_applied = set()
+                        self._epoch_applied_overflow = False
                     _log.warning(
                         "parameter server %s: promoted backup -> "
-                        "primary (old primary %s presumed dead)",
-                        self.address, self._peer_addr)
-            return ("ok", {"role": self._role, "was": was})
+                        "primary at epoch %d (old primary %s presumed "
+                        "dead or partitioned)",
+                        self.address, self._epoch, self._peer_addr)
+            if was == "backup":
+                _consistency.journal("promote", node=self.address,
+                                     epoch=self._epoch)
+                if self._ckpt is not None:
+                    # the epoch must survive a crash of the NEW primary:
+                    # snapshot now, not at the next push interval
+                    self.snapshot()
+            return ("ok", {"role": self._role, "was": was,
+                           "fence_epoch": self._epoch})
         if cmd == "peer_info":
             with self._repl_guard:
                 backup = self._backup_addr \
@@ -2334,8 +2621,90 @@ class ParameterServer:
                     else None
             return ("ok", {"role": self._role, "addr": self.address,
                            "backup": backup,
+                           "fence_epoch": self._epoch,
+                           "fenced": self._fenced,
                            "catchup_complete": self._catchup_complete,
                            "keys": len(self._table)})
+        if cmd == "peer_alive":
+            # probe-through-peer (ISSUE 19): a client that lost its
+            # link to one replica asks the OTHER replica whether the
+            # peer is dead or merely unreachable from that client —
+            # "dead" justifies promotion, "alive but cut off from you"
+            # does not (the client marks it unreachable and degrades)
+            info = self._peer_request("peer_info", retries=0,
+                                      timeout=1.0)
+            peer = info[1] if info is not None else None
+            return ("ok", {"role": self._role,
+                           "fence_epoch": self._epoch,
+                           "peer_alive": peer is not None,
+                           "peer_role":
+                               peer.get("role") if peer else None,
+                           "peer_epoch":
+                               int(peer.get("fence_epoch", 0))
+                               if peer else None})
+        if cmd == "reconcile":
+            # heal-time replay of a fenced ex-primary's applied-but-
+            # unreplicated window (ISSUE 19). The (origin, key) push
+            # watermarks CANNOT dedupe this replay — they assume FIFO
+            # per origin, and this primary has already applied the
+            # clients' post-failover seqs, which sit above the
+            # divergence window's — so each record is deduped exactly:
+            #   * forwarded on the dead stream and rseq <= the prefix
+            #     we applied for that stream id -> already replicated;
+            #   * ident in _epoch_applied (client-applied here since
+            #     our promotion) -> the client itself replayed its
+            #     unacked copy after failing over;
+            #   * otherwise it exists only on the deposed side: apply
+            #     (watermark bypassed), forwarding to OUR backup like
+            #     any other write.
+            if self._role != "primary":
+                return ("err", "not_serving: reconcile at a backup")
+            _, peer_epoch, sid, entries = msg
+            with self._ctr_lock:
+                ea = self._epoch_applied
+                exact = ea is not None and \
+                    not self._epoch_applied_overflow
+            if not exact:
+                _log.warning(
+                    "parameter server %s: reconcile without an exact "
+                    "epoch-applied record (%s) — falling back to "
+                    "watermark dedupe, replays below the watermark "
+                    "are refused", self.address,
+                    "overflowed" if ea is not None else "not recording")
+            applied = dup = 0
+            for rseq, rec in entries:
+                rec = tuple(rec)
+                if rseq is not None and sid is not None \
+                        and sid == self._repl_stream_id \
+                        and rseq <= self._repl_applied_rseq:
+                    dup += 1      # replicated to us before the cut
+                    continue
+                if ea is not None and _rec_ident(rec) in ea:
+                    dup += 1      # the client replayed it post-failover
+                    continue
+                if rec[0] == "push":
+                    reply = self._do_push(rec, _reconcile=exact)
+                elif rec[0] == "spush":
+                    reply = self._do_sparse_push(rec, _reconcile=exact)
+                else:
+                    continue
+                if reply[0] == "ok":
+                    if len(reply) > 1 and reply[1] == "dup":
+                        dup += 1
+                    else:
+                        applied += 1
+            with self._ctr_lock:
+                # reconciliation done: the deposed window is settled,
+                # stop recording (and free) the epoch-applied idents
+                self._epoch_applied = None
+                self._epoch_applied_overflow = False
+            _log.warning(
+                "parameter server %s: reconciled %d records from the "
+                "deposed epoch-%s primary (%d applied, %d already "
+                "held)", self.address, len(entries), peer_epoch,
+                applied, dup)
+            return ("ok", {"applied": applied, "dup": dup,
+                           "fence_epoch": self._epoch})
         if cmd == "join_backup":
             # a (re)spawned peer asks to become our backup: attach the
             # stream and start the state transfer, after which the
@@ -2343,14 +2712,28 @@ class ParameterServer:
             if self._role != "primary":
                 return ("err", "not_serving: a backup cannot adopt a "
                                "backup")
+            if self._fenced:
+                return ("err", "fenced: %s was deposed and cannot "
+                               "adopt a backup (epoch %d)"
+                        % (self.address, self._fenced_at))
             self._attach_backup(msg[1])
-            return ("ok", {"stream": self._repl.id})
+            return ("ok", {"stream": self._repl.id,
+                           "fence_epoch": self._epoch})
         if cmd == "hello":
             # worker (re-)registration: a fresh store — or a respawned
             # worker's fresh store — announces its origin/rank; the
             # membership epoch lets anyone observe churn
             _, origin, rank = msg[0], msg[1], msg[2] if len(msg) > 2 \
                 else None
+            cli_epoch = msg[3] if len(msg) > 3 else None
+            if cli_epoch is not None and cli_epoch > self._epoch:
+                # the rejoin-handshake fencing trigger: a registering
+                # client that witnessed a promotion this server missed
+                if self._role == "primary":
+                    self._fence(cli_epoch,
+                                "hello carried a newer epoch")
+                else:
+                    self._epoch = cli_epoch
             self._gc_workers()
             self._worker_rec(origin, rank=rank)
             # the hello reply is where clients learn the shard's
@@ -2362,6 +2745,8 @@ class ParameterServer:
                 return ("ok", {"epoch": self._membership_epoch,
                                "workers": len(self._workers),
                                "role": self._role,   # mxlint: allow(shared-state-race) — GIL-atomic observability read inside the hello/membership arm; one momentarily stale reply is harmless
+                               "fence_epoch": self._epoch,
+                               "fenced": self._fenced,   # mxlint: allow(shared-state-race) — GIL-atomic observability read inside the hello/membership arm; one momentarily stale reply is harmless
                                "backup": backup,
                                # the versioned shard map rides every
                                # hello, so a (re)joining worker starts
@@ -2383,6 +2768,8 @@ class ParameterServer:
             self._gc_workers()
             return ("ok", {"pushes": self._stale_n,
                            "keys": len(self._table),
+                           "role": self._role,
+                           "fence_epoch": self._epoch,
                            # heartbeat half of map propagation: a bump
                            # makes the client fetch the full shard_map
                            "map_version": self._map_version})
@@ -2505,6 +2892,9 @@ class ParameterServer:
                            "stream_segments": len(self._stream_offsets),
                            "role": self._role,
                            "promotions": self._promotions,
+                           "fence_epoch": self._epoch,
+                           "fenced": self._fenced,
+                           "unreplicated": len(self._unreplicated),
                            "repl": repl,
                            "repl_received": self._repl_received,
                            "repl_dup": self._repl_dup,
@@ -2723,6 +3113,11 @@ class ParameterServer:
                     # keys (map_stale), not 404 them
                     "moved": [[self._tag_key(k), d]
                               for k, d in moved],
+                    # the fencing epoch is durable (ISSUE 19): a
+                    # crashed-and-respawned primary restores the epoch
+                    # it was promoted at, so a still-running deposed
+                    # peer can never out-rank it with a stale epoch
+                    "fence_epoch": int(self._epoch),
                     "map_version": int(self._map_version)}
             extras = None
             if self._opt_payload is not None:
@@ -2758,6 +3153,8 @@ class ParameterServer:
         self._moved = {self._untag_key(k): d
                        for k, d in meta.get("moved", [])}
         self._map_version = int(meta.get("map_version", 0))
+        self._epoch = max(self._epoch,
+                          int(meta.get("fence_epoch", 1)))
         self._push_count = int(meta.get("push_count", 0))
         self._snap_count = step
         self._restored_step = step
@@ -2850,6 +3247,23 @@ _ELASTIC = os.environ.get("MXTPU_PS_ELASTIC", "0") != "0"
 # outstanding shard (a straggler's assignment requeues on its death)
 _CURSOR_POLL = float(os.environ.get("MXTPU_PS_CURSOR_POLL", "0.2"))
 
+# -- partition tolerance (ISSUE 19) --------------------------------------
+# before promoting a standby, the client asks it whether it can still
+# reach the incumbent (peer_alive). If the standby says yes — the cut is
+# client-side only — promotion is suppressed for this grace window and
+# the incumbent is marked "unreachable" instead (pulls degrade, pushes
+# buffer). After the grace expires, availability wins: promote anyway —
+# the fencing epoch makes the aggressive choice safe.
+_PARTITION_GRACE = float(os.environ.get("MXTPU_PS_PARTITION_GRACE", "5.0"))
+# set to 0 to skip the probe-through-peer check and promote immediately
+# on failure, restoring the pre-ISSUE-19 failover behavior
+_PARTITION_PROBE = os.environ.get(
+    "MXTPU_PS_PARTITION_PROBE", "1") not in ("0", "")
+# cap on the deposed primary's applied-but-unreplicated buffer (records
+# kept for heal-time reconciliation); beyond it the OLDEST survive —
+# the new primary's (origin, seq) watermarks refuse replays anyway
+_RECONCILE_MAX = int(os.environ.get("MXTPU_PS_RECONCILE_MAX", "1024"))
+
 
 def stream_origin(group, shard, seg):
     """The deterministic push identity of one (consumer group, log
@@ -2881,6 +3295,25 @@ def _stale_dst(err):
                   str(err))
     return m.group(1) if m else None
 
+
+def _fenced_epoch(err):
+    """The higher fencing epoch out of a ``fenced`` refusal, else None.
+    Like ``map_stale``, ``fenced`` is a routing verdict: the command
+    was NOT executed; the client refetches the map and replays with
+    its original (origin, seq) at the fenced-in home."""
+    m = re.search(r"fenced: .*\(epoch (\d+)\)", str(err))
+    return int(m.group(1)) if m else None
+
+
+def _rec_ident(rec):
+    """(origin, seq, key) identity of a replication/reconcile record,
+    or None for record kinds without one (init, set_optimizer, ...)."""
+    if rec[0] == "push":
+        return (rec[4], rec[5], rec[1])
+    if rec[0] == "spush":
+        return (rec[5], rec[6], rec[1])
+    return None
+
 # every command whose replay is harmless: pull/pull_rows/stats/ping read,
 # init is first-writer-wins, set_optimizer re-installs the same payload,
 # push dedupes via its (origin, seq) pair (pushpull likewise — a
@@ -2902,6 +3335,7 @@ _IDEMPOTENT = frozenset(
      "pull_rows", "stats", "ping",
      "set_optimizer", "opt_states", "set_opt_states", "multi",
      "hello", "bye", "repl", "promote", "peer_info", "join_backup",
+     "peer_alive", "reconcile",
      "shard_map", "cursor_next", "cursor_done", "adopt_key", "split",
      "publish", "weights", "weight_sub", "metrics",
      "stream_push", "stream_offsets"))
@@ -2971,7 +3405,7 @@ class _Channel:
         try:
             act = _fault.fire("worker.send", op=msg[0],
                               key=msg[1] if len(msg) > 1 else None,
-                              sock=self._sock)
+                              sock=self._sock, addr=self._conn.addr)
             if act != "drop":      # dropped frame: the peer never sees
                 # a sampled trace rides as a third frame element —
                 # metadata only, absent (classic 2-tuple) when no
@@ -2991,7 +3425,7 @@ class _Channel:
         try:
             _fault.fire("worker.recv", op=msg[0],
                         key=msg[1] if len(msg) > 1 else None,
-                        sock=self._sock)
+                        sock=self._sock, addr=self._conn.addr)
         except BaseException as e:
             self.fail(e)
             raise
@@ -3098,6 +3532,13 @@ class _ServerConn:
         self.failures = 0          # consecutive failures
         self.last_error = None
         self.last_ping = {}        # last ping reply info (map_version)
+        # this pair lineage's fencing epoch as witnessed by THIS worker
+        # (ISSUE 19). Epochs are minted per replica pair — comparing
+        # epochs across unrelated shards is meaningless — so frames to
+        # this server are stamped from here, never from a fleet-wide
+        # max (a promotion on shard A must not fence healthy shard B).
+        self.fence_epoch = 1
+        self._unreach_since = None
         self._health_lock = threading.Lock()
         n_socks = max(1, n_socks if n_socks is not None
                       else _CONNS_PER_SERVER)
@@ -3134,6 +3575,12 @@ class _ServerConn:
     def n_socks(self):
         return len(self._channels)
 
+    def note_epoch(self, ep):
+        """Monotone adopt of a fencing epoch witnessed for this
+        server's pair (hello/ping/shard_map replies, fenced refusals)."""
+        if ep is not None and int(ep) > self.fence_epoch:
+            self.fence_epoch = int(ep)   # mxlint: allow(shared-state-race) — monotone max of a GIL-atomic int; a lost race re-adopts on the next witnessed reply
+
     def _channel(self, i=None):
         """The channel for slot ``i`` (round-robin when unspecified),
         lazily (re)connected — a failed channel is never reused, its
@@ -3157,13 +3604,15 @@ class _ServerConn:
             self.state = "ok"
             self.failures = 0
             self.last_error = None
+            self._unreach_since = None
         return recovered
 
     def _note_failure(self, err):
         with self._health_lock:
             self.failures += 1
             self.last_error = "%s: %s" % (type(err).__name__, err)
-            if self.failures >= _DEAD_AFTER:
+            if self.failures >= _DEAD_AFTER and \
+                    self.state != "unreachable":
                 self.state = "dead"
 
     def mark_dead(self, err):
@@ -3171,6 +3620,27 @@ class _ServerConn:
             self.failures = max(self.failures, _DEAD_AFTER)
             self.state = "dead"
             self.last_error = "%s: %s" % (type(err).__name__, err)
+
+    def mark_unreachable(self, err):
+        """Partition verdict (ISSUE 19): the server is alive — its peer
+        can still reach it — but OUR link to it is cut. Distinguished
+        from ``dead`` so the health surface, and anything keying off
+        it, knows no promotion is warranted: pulls degrade to cached
+        values and pushes buffer until the link heals."""
+        with self._health_lock:
+            self.state = "unreachable"
+            self.last_error = "%s: %s" % (type(err).__name__, err)
+            if self._unreach_since is None:
+                self._unreach_since = time.monotonic()
+
+    def unreachable_for(self):
+        """Seconds this server has been in the ``unreachable`` state
+        (0.0 when it is not)."""
+        with self._health_lock:
+            if self.state != "unreachable" or \
+                    self._unreach_since is None:
+                return 0.0
+            return time.monotonic() - self._unreach_since
 
     def health(self):
         with self._health_lock:
@@ -3197,13 +3667,15 @@ class _ServerConn:
         if srv._tcp.dying:
             raise ConnectionError(
                 "in-process server %s is down" % self.addr)
-        dropped = _fault.fire("worker.send", op=op, key=key) == "drop"
+        dropped = _fault.fire("worker.send", op=op, key=key,
+                              addr=self.addr) == "drop"
         if not dropped:
             _fault.fire("server.recv", op=op, key=key, server=srv)
             reply = srv._dispatch(msg)
             if _fault.fire("server.send", op=op, key=key,
                            server=srv) != "drop":
-                _fault.fire("worker.recv", op=op, key=key)
+                _fault.fire("worker.recv", op=op, key=key,
+                            addr=self.addr)
                 self._stats.add("local_reqs")
                 return reply
         # a dropped request/reply frame is silent on the wire too:
@@ -3316,7 +3788,8 @@ class _ServerConn:
         if srv._tcp.dying:
             raise ConnectionError(
                 "in-process server %s is down" % self.addr)
-        dropped = _fault.fire("worker.send", op=op, key=key) == "drop"
+        dropped = _fault.fire("worker.send", op=op, key=key,
+                              addr=self.addr) == "drop"
         if not dropped:
             _fault.fire("server.recv", op=op, key=key, server=srv)
 
@@ -3457,6 +3930,9 @@ class _ReplicatedConn:
         self._active_i = 0
         self._gen = 0              # bumps on every swap
         self.failovers = 0
+        # ONE epoch for the pair: primary and backup share a fencing
+        # lineage, and a promotion on either side advances it (ISSUE 19)
+        self.fence_epoch = 1
         self._lock = threading.Lock()
         self._fo_lock = threading.Lock()
         self._conns[0] = _ServerConn(primary_addr, token=token,
@@ -3494,24 +3970,43 @@ class _ReplicatedConn:
             return standby.state
         return "ok" if standby_addr is not None else "dead"
 
+    def note_epoch(self, ep):
+        """Monotone adopt of this pair's fencing epoch (hello/ping
+        replies, fenced refusals from either replica)."""
+        if ep is not None and int(ep) > self.fence_epoch:
+            self.fence_epoch = int(ep)   # mxlint: allow(shared-state-race) — monotone max of a GIL-atomic int; a lost race re-adopts on the next witnessed reply
+
     def _learn_backup(self, addr):
         with self._lock:
             if addr and self._addrs[1] is None \
                     and addr != self._addrs[0]:
                 self._addrs[1] = addr
 
-    def _failover(self, gen, err):
+    def _failover(self, gen, err, promote=True):
         """Promote the standby and swap it in, unless another thread
         already moved the generation on. Raises ``err`` when no
         standby is configured or the standby cannot be promoted —
-        i.e. the shard is genuinely dead."""
+        i.e. the shard is genuinely dead.
+
+        Partition discipline (ISSUE 19): with ``promote=False`` (a
+        ``fenced`` refusal — the standby already holds a newer epoch)
+        the swap happens WITHOUT minting a promotion. Otherwise the
+        standby is first asked whether it can still reach the active
+        (``peer_alive``): a peer that is alive-but-cut-off-from-us is
+        marked ``unreachable`` instead of deposed — no spurious
+        promotion on a client-side link cut — until the
+        ``MXTPU_PS_PARTITION_GRACE`` window expires, after which
+        availability wins (the fencing epoch makes the aggressive
+        choice safe: the deposed side stops acking the moment it
+        learns the new epoch)."""
         with self._fo_lock:
             with self._lock:
                 if self._gen != gen:
                     return      # raced: a peer thread already swapped
                 i = 1 - self._active_i
                 addr, conn = self._addrs[i], self._conns[i]
-                old_addr = self._conns[self._active_i].addr
+                act = self._conns[self._active_i]
+                old_addr = act.addr
             if addr is None:
                 raise err
             try:
@@ -3519,8 +4014,32 @@ class _ReplicatedConn:
                     conn = _ServerConn(
                         addr, token=self._token, stats=self._stats,
                         connect_timeout=_RECONNECT_TIMEOUT)
-                conn.request("promote", timeout=5.0, retries=1)
+                if promote and _PARTITION_PROBE:
+                    try:
+                        pv = conn.request("peer_alive", timeout=5.0,
+                                          retries=0)[1]
+                    except (ConnectionError, RuntimeError, OSError):
+                        pv = None   # standby mute: classic failover
+                    if pv is not None:
+                        if pv.get("role") == "primary":
+                            # the standby was already promoted (by a
+                            # peer client or its own monitor): adopt it
+                            promote = False
+                        elif pv.get("peer_alive") and \
+                                act.unreachable_for() < _PARTITION_GRACE:
+                            # the active is alive — its peer reaches it
+                            # — so only OUR link is cut: degrade (pulls
+                            # serve cached values, pushes buffer)
+                            # instead of deposing a healthy primary
+                            act.mark_unreachable(err)
+                            with self._lock:
+                                self._conns[i] = conn
+                            raise err
+                if promote:
+                    conn.request("promote", timeout=5.0, retries=1)
             except (ConnectionError, RuntimeError, OSError) as e:
+                if e is err:
+                    raise
                 raise err from e
             with self._lock:
                 self._conns[i] = conn
@@ -3528,8 +4047,10 @@ class _ReplicatedConn:
                 self._gen += 1
                 self.failovers += 1
         _log.warning(
-            "shard failover: %s -> %s (%s: %s); backup promoted "
-            "in-place", old_addr, addr, type(err).__name__, err)
+            "shard failover: %s -> %s (%s: %s); backup %s",
+            old_addr, addr, type(err).__name__, err,
+            "promoted in-place" if promote
+            else "already primary (swapped without promote)")
         cb = self._on_failover
         if cb is not None:
             try:
@@ -3554,10 +4075,18 @@ class _ReplicatedConn:
             except RuntimeError as e:
                 # a not_serving refusal means the command was NOT
                 # executed, so even non-idempotent commands replay
-                # safely on the real primary
-                if attempt or "not_serving" not in str(e):
+                # safely on the real primary. Likewise fenced (ISSUE
+                # 19): the deposed replica refused without executing;
+                # the peer already holds the newer epoch, so swap to it
+                # WITHOUT issuing another promote
+                if attempt or ("not_serving" not in str(e)
+                               and "fenced" not in str(e)):
                     raise
-                self._failover(gen, e)
+                # a fenced refusal names the deposing epoch: the pair
+                # moved on — adopt before swapping to the new primary
+                self.note_epoch(_fenced_epoch(e))
+                self._failover(gen, e,
+                               promote="fenced" not in str(e))
                 continue
             if msg[0] == "hello" and len(reply) > 1 \
                     and isinstance(reply[1], dict):
@@ -3573,10 +4102,14 @@ class _ReplicatedConn:
         redo = [i for i, r in enumerate(out)
                 if isinstance(r, ConnectionError)
                 or (isinstance(r, RuntimeError)
-                    and "not_serving" in str(r))]
+                    and ("not_serving" in str(r)
+                         or "fenced" in str(r)))]
         if redo:
+            first = out[redo[0]]
+            self.note_epoch(_fenced_epoch(first))
             try:
-                self._failover(gen, out[redo[0]])
+                self._failover(gen, first,
+                               promote="fenced" not in str(first))
             except (ConnectionError, RuntimeError, OSError):
                 pass           # shard genuinely dead: original errors
             else:              # stand and the caller buffers/degrades
@@ -3694,11 +4227,18 @@ class AsyncDistKVStore(KVStore):
         self._extra_conns = {}     # reshard-born server addr -> conn
         self._extra_guard = threading.Lock()
         self._cursor_rid = itertools.count(1)
+        self._lease_epochs = {}    # lease -> fencing epoch granted under
         # -- fault-tolerance state (module docstring, "Fault tolerance") --
         # unique push origin: rank alone is not unique (tests run many
         # stores per process); the server dedupes replays per (origin,key)
         self._origin = "%d-%s" % (self._rank, uuid.uuid4().hex[:8])
         self._seq = itertools.count(1)   # next() is GIL-atomic
+        # the newest fencing epoch this client has witnessed (ISSUE
+        # 19): rides every push frame and hello, so a deposed primary
+        # fences itself on first contact with any client that saw the
+        # promotion — monotone, adopted from every reply that carries
+        # "fence_epoch" (hello/ping/shard_map/promote)
+        self._fleet_epoch = 1
         self._pull_cache_on = os.environ.get(
             "MXTPU_PS_PULL_CACHE", "1") != "0"
         self._pull_cache = {}      # subkey -> (numpy value, clock)
@@ -3812,9 +4352,18 @@ class AsyncDistKVStore(KVStore):
         names the key's new home — record the override, greet the new
         server, replay there (the transferred dedupe seqs keep push
         replays at-most-once). Bounded hops: a client whose map is k
-        versions stale needs at most k."""
+        versions stale needs at most k.
+
+        ``epoch_at`` names the fencing-epoch slot in ``msg``: it is
+        re-stamped from each hop's TARGET conn (epochs are per pair —
+        a frame must never carry another shard's epoch)."""
+        epoch_at = kw.pop("epoch_at", None)
         conn = self._conn(sk)
         for _ in range(_MAP_HOPS):
+            if epoch_at is not None:
+                msg = msg[:epoch_at] \
+                    + (getattr(conn, "fence_epoch", 1),) \
+                    + msg[epoch_at + 1:]
             try:
                 return conn.request(*msg, **kw)
             except RuntimeError as e:
@@ -3833,6 +4382,7 @@ class AsyncDistKVStore(KVStore):
         """Adopt a server's shard-map advertisement (hello / shard_map
         replies): its map version, and forwarding overrides for every
         key it handed away."""
+        self._note_epoch(info.get("fence_epoch"))
         v = info.get("map_version")
         with self._cache_lock:
             if v is not None:
@@ -3841,10 +4391,23 @@ class AsyncDistKVStore(KVStore):
                 if dst != addr:
                     self._key_overrides[k] = dst
 
+    def _note_epoch(self, ep):
+        """Adopt a fencing epoch witnessed in any server reply — the
+        max ever seen; never goes backwards."""
+        if ep is None:
+            return
+        with self._cache_lock:
+            if int(ep) > self._fleet_epoch:
+                self._fleet_epoch = int(ep)
+
     def _refresh_map(self, conn):
         """Heartbeat half of map propagation: when a probe reply
         advertises a newer shard-map version, fetch the full map."""
         info = getattr(conn, "last_ping", None) or {}
+        self._note_epoch(info.get("fence_epoch"))
+        note = getattr(conn, "note_epoch", None)
+        if note is not None:
+            note(info.get("fence_epoch"))
         v = info.get("map_version")
         if v is None or self._map_versions.get(conn.addr) == v:
             return
@@ -3852,8 +4415,11 @@ class AsyncDistKVStore(KVStore):
             reply = conn.request("shard_map", retries=0, timeout=5.0)
         except (ConnectionError, RuntimeError, OSError):
             return
+        if note is not None:
+            note(reply[1].get("fence_epoch"))
         self._learn_map(conn.addr,
                         {"map_version": reply[1].get("version"),
+                         "fence_epoch": reply[1].get("fence_epoch"),
                          "moved": reply[1].get("moved")})
 
     # -- part plumbing ----------------------------------------------------
@@ -3956,20 +4522,33 @@ class AsyncDistKVStore(KVStore):
         if len(small) == 1:        # a lone small part gains nothing
             lanes["big"] += small  # from the multi wrapper
             small = []
+        # stamp with the TARGET pair's epoch, not the fleet max: a
+        # promotion on another shard must not fence this healthy one
+        ep = getattr(conn, "fence_epoch", 1)
+        jr = _consistency.enabled()
         msgs, groups = [], []
         for i in range(0, len(small), _COALESCE_MAX):
             chunk = small[i:i + _COALESCE_MAX]
             msgs.append(("multi",
-                         [("push", sk, payload, clock, self._origin, seq)
+                         [("push", sk, payload, clock, self._origin,
+                           seq, ep)
                           for sk, payload, clock, seq in chunk]))
             groups.append((True, chunk))
             self._stats.add("coalesced_frames")
             self._stats.add("coalesced_subs", len(chunk))
         for entry in lanes["big"]:
             sk, payload, clock, seq = entry
-            msgs.append(("push", sk, payload, clock, self._origin, seq))
+            msgs.append(("push", sk, payload, clock, self._origin, seq,
+                         ep))
             groups.append((False, [entry]))
-        if conn.state == "dead":
+        if jr:
+            for _, chunk in groups:
+                for sk, payload, clock, seq in chunk:
+                    _consistency.journal(
+                        "invoke", origin=self._origin, seq=seq,
+                        key=str(sk), epoch=ep,
+                        digest=_consistency.digest(payload))
+        if conn.state in ("dead", "unreachable"):
             for _, chunk in groups:
                 for entry in chunk:
                     self._buffer_push(conn, *entry)
@@ -3987,6 +4566,8 @@ class AsyncDistKVStore(KVStore):
             elif is_multi:         # surface the first sub-error
                 for entry, sub in zip(chunk, reply[1]):
                     if sub[0] != "err":
+                        if jr:
+                            self._journal_ack(entry, ep)
                         continue
                     if _stale_dst(sub[1]) is None:
                         raise RuntimeError(
@@ -3994,6 +4575,17 @@ class AsyncDistKVStore(KVStore):
                     self._replay_moved_push(
                         entry,
                         RuntimeError("parameter server: %s" % sub[1]))
+            elif jr:
+                self._journal_ack(chunk[0], ep)
+
+    def _journal_ack(self, entry, ep=None):
+        """One acked push in the consistency journal (ISSUE 19): the
+        server's ok landed back at this client — from here on, losing
+        the update is a checkable violation."""
+        sk, _payload, clock, seq = entry
+        _consistency.journal(
+            "ack", origin=self._origin, seq=seq, key=str(sk),
+            epoch=self._fleet_epoch if ep is None else ep, clock=clock)
 
     def _replay_moved_push(self, entry, err):
         """A push refused with ``map_stale``: it was NOT applied — learn
@@ -4006,7 +4598,9 @@ class AsyncDistKVStore(KVStore):
         with self._cache_lock:
             self._key_overrides[sk] = _stale_dst(err)
         self._routed_request(sk, "push", sk, payload, clock,
-                             self._origin, seq)
+                             self._origin, seq, None, epoch_at=6)
+        if _consistency.enabled():
+            self._journal_ack(entry)
 
     def push_async(self, key, value, priority=0):
         """Fire-and-track push: ships on the worker pool and returns a
@@ -4069,12 +4663,13 @@ class AsyncDistKVStore(KVStore):
         if len(small) == 1:
             lanes["big"] += small
             small = []
+        ep = getattr(conn, "fence_epoch", 1)
         msgs, groups = [], []
         for i in range(0, len(small), _COALESCE_MAX):
             chunk = small[i:i + _COALESCE_MAX]
             msgs.append(("multi",
                          [("pushpull", sk, payload, clock, self._origin,
-                           seq)
+                           seq, ep)
                           for sk, payload, clock, seq in chunk]))
             groups.append((True, chunk))
             self._stats.add("coalesced_frames")
@@ -4082,9 +4677,9 @@ class AsyncDistKVStore(KVStore):
         for entry in lanes["big"]:
             sk, payload, clock, seq = entry
             msgs.append(("pushpull", sk, payload, clock, self._origin,
-                         seq))
+                         seq, ep))
             groups.append((False, [entry]))
-        if conn.state == "dead":
+        if conn.state in ("dead", "unreachable"):
             # push half buffers (original seq) for heartbeat replay;
             # pull half degrades to the last-known value
             err = ConnectionError(
@@ -4267,9 +4862,11 @@ class AsyncDistKVStore(KVStore):
         whose push was buffered for a dead/failed shard (the caller
         leaves those out rows untouched)."""
         out = {}
-        msgs = [("spushpull", sk, ids, rws, clock, self._origin, seq)
+        ep = getattr(conn, "fence_epoch", 1)
+        msgs = [("spushpull", sk, ids, rws, clock, self._origin, seq,
+                 ep)
                 for sk, ids, rws, clock, seq in entries]
-        if conn.state == "dead":
+        if conn.state in ("dead", "unreachable"):
             for sk, ids, rws, clock, seq in entries:
                 self._buffer_push(conn, sk, (_SP_MARK, ids, rws), clock,
                                   seq)
@@ -4745,6 +5342,11 @@ class AsyncDistKVStore(KVStore):
                 "cursor_next", self._origin, int(epoch),
                 int(num_shards), next(self._cursor_rid))
             shard, pending = reply[1], reply[2]
+            # the grant's fencing epoch (ISSUE 19): presented back at
+            # cursor_done, so a completion that straddled a partition
+            # heal is refused if the shard was re-granted since
+            granted = reply[3] if len(reply) > 3 else None
+            self._note_epoch(granted)
             if shard is None:
                 if pending <= 0:
                     return
@@ -4755,7 +5357,8 @@ class AsyncDistKVStore(KVStore):
                 continue
             yield shard
             self._conns[0].request(
-                "cursor_done", self._origin, int(epoch), shard)
+                "cursor_done", self._origin, int(epoch), shard,
+                granted)
 
     # -- streaming data plane (ISSUE 18; docs/streaming.md) ---------------
     def stream_lease(self, lease):
@@ -4772,13 +5375,35 @@ class AsyncDistKVStore(KVStore):
             next(self._cursor_rid))
         shard, pending = reply[1], reply[2]
         if shard is not None:
+            # remember the grant's fencing epoch for stream_lease_done
+            # (a lease completed across a partition heal must not
+            # retire a segment that was re-leased in a newer epoch)
+            granted = reply[3] if len(reply) > 3 else None
+            self._note_epoch(granted)
+            with self._cache_lock:
+                self._lease_epochs[lease] = granted
             return "owned"
         return "done" if pending <= 0 else "wait"
 
     def stream_lease_done(self, lease):
         """Acknowledge a held segment lease as fully consumed (the
-        cursor_done half of :meth:`stream_lease`; idempotent)."""
-        self._conns[0].request("cursor_done", self._origin, lease, 0)
+        cursor_done half of :meth:`stream_lease`; idempotent). A
+        ``fenced`` refusal means the lease was re-granted under a newer
+        fleet epoch while we were partitioned — the lease is LOST, not
+        an error (the new holder finishes the segment; our consumed
+        records were already deduped by the frame watermarks)."""
+        with self._cache_lock:
+            granted = self._lease_epochs.pop(lease, None)
+        try:
+            self._conns[0].request("cursor_done", self._origin, lease,
+                                   0, granted)
+        except RuntimeError as e:
+            if "fenced" not in str(e):
+                raise
+            self._note_epoch(_fenced_epoch(e))
+            _log.warning("segment lease %s was re-granted under a "
+                         "newer epoch while this worker was "
+                         "partitioned; yielding it", lease)
 
     def stream_offsets(self, group):
         """One consumer group's committed consumption cursors:
@@ -4835,13 +5460,22 @@ class AsyncDistKVStore(KVStore):
         way, which is how the fleet learns the seat is filled again."""
         for c in conns:
             try:
+                # the hello carries the epoch we witnessed for THIS
+                # pair: a deposed primary that missed the promotion
+                # fences the moment any witness re-registers (ISSUE
+                # 19). Never the fleet max — epochs are per pair, and
+                # another shard's promotion must not fence this one.
                 reply = c.request("hello", self._origin, self._rank,
+                                  getattr(c, "fence_epoch", 1),
                                   retries=0, timeout=5.0)
             except (ConnectionError, RuntimeError, OSError):
                 continue
             if len(reply) > 1 and isinstance(reply[1], dict):
                 # the hello reply carries the versioned shard map: a
                 # (re)joining worker starts with current routing
+                note = getattr(c, "note_epoch", None)
+                if note is not None:
+                    note(reply[1].get("fence_epoch"))
                 self._learn_map(c.addr, reply[1])
 
     def _on_shard_failover(self, conn):
@@ -4871,7 +5505,7 @@ class AsyncDistKVStore(KVStore):
         with self._extra_guard:
             extra = list(self._extra_conns.values())
         for conn in list(self._conns) + extra:
-            was_dead = conn.state == "dead"
+            was_dead = conn.state in ("dead", "unreachable")
             if conn.ping(timeout=timeout, origin=self._origin):
                 if was_dead:
                     self._register_workers([conn])
@@ -4900,10 +5534,14 @@ class AsyncDistKVStore(KVStore):
                         and payload[0] == _SP_MARK:
                     self._routed_request(sk, "spush", sk, payload[1],
                                          payload[2], clock,
-                                         self._origin, seq)
+                                         self._origin, seq,
+                                         None, epoch_at=7)
                 else:
                     self._routed_request(sk, "push", sk, payload, clock,
-                                         self._origin, seq)
+                                         self._origin, seq,
+                                         None, epoch_at=6)
+                if _consistency.enabled():
+                    self._journal_ack((sk, payload, clock, seq))
             except ConnectionError:
                 with self._pending_lock:   # died again: keep the rest
                     self._pending[conn] = items[n:] \
@@ -4933,6 +5571,13 @@ class AsyncDistKVStore(KVStore):
         out = {"servers": servers,
                "num_dead": sum(1 for s in servers
                                if s["state"] == "dead"),
+               # partitioned, not dead (ISSUE 19): the shard is alive —
+               # its peer reaches it — but OUR link is cut; pulls are
+               # degrading and pushes are buffering, and no promotion
+               # was (or should be) triggered
+               "num_unreachable": sum(1 for s in servers
+                                      if s["state"] == "unreachable"),
+               "fence_epoch": self._fleet_epoch,
                "degraded_keys": deg,
                "pending_pushes": npend,
                "failovers": sum(s.get("failovers", 0)
@@ -4945,6 +5590,8 @@ class AsyncDistKVStore(KVStore):
         out["replication"] = [
             {"addr": s.get("addr"), "role": s.get("role"),
              "promotions": s.get("promotions", 0),
+             "fence_epoch": s.get("fence_epoch"),
+             "fenced": s.get("fenced", False),
              "repl": s.get("repl"),
              "catchup_complete": s.get("catchup_complete", True)}
             for s in sweeps if s.get("role") is not None]

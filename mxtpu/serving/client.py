@@ -105,6 +105,10 @@ class ServingClient:
         self.signature = None
         self.model = None
         self.models = {}           # hosted menus learned at hello
+        # newest lifecycle epoch witnessed per replica (ISSUE 19): a
+        # probe verdict stamped OLDER than this is stale evidence — a
+        # delayed or partition-buffered reply — and never demotes
+        self._addr_epoch = {}
         # sampled request tracing (MXTPU_TRACE_SAMPLE): a sampled
         # predict opens a trace whose context rides the wire frame —
         # client request, server admit, batch dispatch, one timeline
@@ -430,11 +434,31 @@ class ServingClient:
             "sequence %s failed on every replica: %s" % (rid, last_err))
 
     def _probe(self, addr):
+        """Health-probe one replica: True keeps routing to it, False
+        demotes (fails over past it). The ping verdict carries the
+        replica's lifecycle epoch, minted per drain/resume transition
+        (ISSUE 19): a reply stamped BELOW the newest epoch this client
+        has witnessed for that replica is stale evidence — delayed or
+        buffered through a partition — so its ``draining`` content is
+        ignored rather than flapping a healthy, resumed replica out of
+        the rotation. A fresh (current-epoch) draining verdict demotes:
+        replaying into a draining replica only gets shed."""
         try:
-            return self._conn_for(addr, connect_timeout=2.0).ping(
-                timeout=2.0, origin=self._origin)
+            conn = self._conn_for(addr, connect_timeout=2.0)
+            if not conn.ping(timeout=2.0, origin=self._origin):
+                return False
         except (ConnectionError, OSError):
             return False
+        info = conn.last_ping if isinstance(conn.last_ping, dict) else {}
+        epoch = info.get("epoch")
+        if epoch is None:
+            return True            # pre-epoch server: alive is enough
+        with self._lock:
+            known = self._addr_epoch.get(addr, 0)
+            if epoch < known:
+                return True        # stale verdict: not demotion evidence
+            self._addr_epoch[addr] = epoch
+        return not info.get("draining")
 
     def report_outcome(self, rid, label):
         """Deliver the late label for an answered request (ISSUE 18):

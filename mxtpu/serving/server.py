@@ -344,6 +344,12 @@ class ModelServer:
                 weight_dir, max_to_keep=0, async_save=False,
                 use_orbax=False)
         self._draining = False
+        # lifecycle epoch (ISSUE 19): bumped on every drain/resume
+        # transition and carried by ping verdicts, so a client that
+        # receives a DELAYED probe reply — through a healing partition,
+        # or buffered from before a resume — can tell it is stale
+        # evidence and must not demote a healthy replica on it
+        self._serve_epoch = 1
         # optional streaming emit hook (ISSUE 18): an EmitLog that
         # records (features, outcome) per answered request
         self._emit = None
@@ -440,9 +446,16 @@ class ModelServer:
         return {"address": self.address, "draining": self._draining,
                 "queue_depth": self._depth, "models": models}
 
+    def _set_draining(self, flag):
+        """Flip the draining verdict, minting a new lifecycle epoch on
+        every transition — the monotone stamp ping verdicts carry."""
+        if self._draining != flag:
+            self._serve_epoch += 1   # mxlint: allow(shared-state-race) — transitions run on the drain/undrain control path only; ping readers are GIL-atomic and the stamp is monotone, so a stale read is just the pre-transition verdict
+        self._draining = flag
+
     def drain(self, timeout=30.0):
         """Graceful phase: refuse new work, flush admitted work."""
-        self._draining = True
+        self._set_draining(True)
         ok = True
         for entry in self._entries():
             ok = entry.batcher.drain(timeout=timeout) and ok
@@ -473,11 +486,11 @@ class ModelServer:
             if entry.scheduler is not None and entry.scheduler._stopped:
                 entry.scheduler.release_metrics()
                 entry.scheduler = self._make_scheduler(entry.engine)
-        self._draining = False
+        self._set_draining(False)
         return True
 
     def stop(self):
-        self._draining = True
+        self._set_draining(True)
         self._tcp.dying = True
         if self._view_key is not None:
             _obs.REGISTRY.unview(self._view_key)
@@ -815,7 +828,11 @@ class ModelServer:
                            "signature": self._engine.signature(),
                            "models": models})
         if cmd == "ping":
+            # the probe verdict carries the lifecycle epoch: clients
+            # ignore any reply stamped older than one they have
+            # already witnessed (partition anti-flap, ISSUE 19)
             return ("ok", {"draining": self._draining,
+                           "epoch": self._serve_epoch,
                            "pending": sum(
                                e.batcher.pending()
                                + (e.scheduler.pending()
@@ -830,7 +847,7 @@ class ModelServer:
             return ("ok", _obs.REGISTRY.snapshot())
         if cmd == "drain":
             # operator/drill hook: same two-phase path as SIGTERM
-            self._draining = True
+            self._set_draining(True)
             for entry in self._entries():
                 threading.Thread(target=entry.batcher.drain, kwargs={
                     "timeout": float(msg[1]) if len(msg) > 1 else 30.0},

@@ -1,0 +1,165 @@
+"""Partition-tolerance E2E worker (ISSUE 19).
+
+Launched by tools/launch.py -n 1 -s 1 --ps-replicas 2 against REAL
+parameter-server processes. The worker drives the whole partition
+lifecycle from inside its own process — the fault harness cuts the
+client->primary link at the wire (``kind=partition,point=worker.send``)
+while the server-to-server links stay up, exactly the asymmetric cut a
+top-of-rack switch failure produces:
+
+  A. warm-up rounds — the replicated pair converges;
+  B. CUT: every client op toward the launch-time primary is severed.
+     Pushes buffer under the MXTPU_PS_PARTITION_GRACE window (the
+     standby's peer_alive probe confirms the primary is alive, so no
+     spurious promotion), pulls degrade to cached values — then the
+     grace expires and availability wins: the standby is promoted and
+     mints fencing epoch 2. The deposed primary hears the new epoch
+     over the UNCUT server-to-server probe link, fences itself (the
+     launcher log shows the refusal), rejoins as the new backup and
+     catches up — all while the client-side cut still stands;
+  C. HEAL: the cut lifts and the worker finishes its rounds against
+     the healed, re-redundant pair.
+
+A fixed number of seeded pushes per phase makes the run comparable to
+an uninterrupted control: the final server-side table must be
+bit-for-bit identical (buffered pushes flush in order with their
+original seqs, so not even float addition order may drift). With
+MXTPU_HISTORY_DIR set, every invoke/ack/apply is journaled for the
+offline consistency checker.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxtpu as mx                                           # noqa: E402
+from mxtpu import fault                                      # noqa: E402
+
+rank = int(os.environ.get("MXTPU_PROC_ID", "0"))
+out_dir = os.environ["PARTITION_TEST_DIR"]
+rounds_a = int(os.environ.get("PARTITION_ROUNDS_A", "20"))
+rounds_b = int(os.environ.get("PARTITION_ROUNDS_B", "30"))
+rounds_c = int(os.environ.get("PARTITION_ROUNDS_C", "20"))
+cut_run = os.environ.get("PARTITION_CUT", "0") != "0"
+
+KEYS = ["p%d" % i for i in range(4)]
+SHAPE = (8,)
+# the whole client command surface toward one address — what a real
+# network partition cuts. The server-to-server plane (peer_info,
+# join_backup, promote, repl) rides other links, and `stats` stays
+# open as the out-of-band observability plane the drill reads through.
+CLIENT_OPS = "push|pull|pushpull|spushpull|multi|init|hello|ping" \
+             "|barrier|shard_map"
+
+kv = mx.kv.create("dist_async")
+kv.init(KEYS, [mx.nd.zeros(SHAPE) for _ in KEYS])
+
+
+def wait_redundant(timeout=60):
+    """Block until the shard pair is redundant: backup attached, caught
+    up, forwarding stream drained. Returns the replication rows."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        # health() rows (not stats()): they carry fence_epoch too
+        rows = kv.health().get("replication") or []
+        if rows and all(
+                r["repl"] is not None and not r["repl"]["dead"]
+                and (r["repl"]["catchup"] or {}).get("done")
+                and r["repl"]["lag"] == 0 for r in rows):
+            return rows
+        time.sleep(0.2)
+    raise AssertionError("replicated pair never became redundant: %r"
+                         % (kv.health().get("replication"),))
+
+
+# same seed both runs: the drill's table must be bit-for-bit equal to
+# the control's, so the grad sequence itself must be identical
+rng = np.random.RandomState(777 + rank)
+
+
+def push_round():
+    for k in KEYS:
+        grad = rng.standard_normal(SHAPE).astype(np.float32)
+        kv.push(k, mx.nd.array(grad))
+
+
+wait_redundant()
+
+# -- phase A: warm-up; prime the pull cache so cut-time pulls have a
+# cached value to degrade to -----------------------------------------
+for _ in range(rounds_a):
+    push_round()
+probe = mx.nd.zeros(SHAPE)
+for k in KEYS:
+    kv.pull(k, out=probe)
+
+inj = None
+if cut_run:
+    pri_addr = os.environ["MXTPU_PS_ADDRS"].split(",")[0]
+    others = os.environ.get("MXTPU_PS_BACKUP_ADDRS", "").split(",")
+    # fault rules match addr by substring: the cut must not also
+    # swallow the standby's address
+    assert not any(pri_addr in b for b in others if b), \
+        "primary address is a substring of a backup's: %s vs %r" \
+        % (pri_addr, others)
+    spec = "kind=partition,point=worker.send,addr=%s,op=%s" \
+        % (pri_addr, CLIENT_OPS)
+    inj = fault.install(spec)
+    print("partition worker: CUT client->%s" % pri_addr, flush=True)
+
+# -- phase B: fixed rounds through the cut (fixed, so the push totals
+# match the control run exactly). Early rounds buffer pushes and serve
+# degraded pulls inside the grace window; once it expires a pull's
+# failover promotes the standby and flushes the buffer in order. -----
+for _ in range(rounds_b):
+    push_round()
+    kv.pull(KEYS[0], out=probe)
+    time.sleep(0.05)
+
+if cut_run:
+    h = kv.health()
+    assert h["fence_epoch"] == 2, \
+        "standby never promoted under the cut: %r" % (h,)
+    assert h["failovers"] == 1, h
+    assert inj.stats()[0][4] >= 1, "the cut never fired"
+    print("partition worker: standby promoted, fleet epoch 2",
+          flush=True)
+    # the deposed primary fences over the uncut server-to-server probe
+    # link and rejoins as backup — while the client cut still stands
+    rows = wait_redundant()
+    assert rows[0]["fence_epoch"] == 2, rows
+    fault.uninstall()   # heal
+    print("partition worker: HEALED", flush=True)
+
+# -- phase C: the healed pair takes the rest of the workload ----------
+for _ in range(rounds_c):
+    push_round()
+
+rows = wait_redundant()
+h = kv.health()
+assert h["pending_pushes"] == 0, h
+
+table = {}
+for k in KEYS:
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(k, out=out)
+    table[k] = out.asnumpy()
+np.savez(os.path.join(out_dir, "rank%d_table.npz" % rank), **table)
+
+with open(os.path.join(out_dir, "rank%d.json" % rank), "w") as f:
+    json.dump({"rank": rank,
+               "rounds": rounds_a + rounds_b + rounds_c,
+               "failovers": h["failovers"],
+               "fence_epoch": h["fence_epoch"],
+               "promotions": sum(r.get("promotions", 0) for r in rows),
+               "rows": rows}, f)
+
+kv.barrier()
+kv.close()
+print("PARTITION_RANK_%d_OK" % rank, flush=True)

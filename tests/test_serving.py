@@ -413,3 +413,36 @@ def test_server_stats_surface_the_story(model):
         assert s["queue_depth"] >= 1 and "batch_deadline_ms" in s
     finally:
         srv.stop()
+
+
+def test_stale_epoch_probe_cannot_demote_healthy_replica(model):
+    # partition anti-flap (ISSUE 19): ping verdicts carry the
+    # replica's lifecycle epoch; a delayed pre-resume "draining"
+    # verdict that arrives after the client witnessed the resumed
+    # epoch is stale evidence and must NOT demote the replica
+    s1, s2 = _pair(model)
+    try:
+        cli = ServingClient(addrs=[s1.address, s2.address],
+                            budget_ms=5000)
+        assert cli._probe(s1.address) is True
+        e0 = cli._addr_epoch[s1.address]
+        s1.drain(timeout=5.0)
+        # a CURRENT-epoch draining verdict is real demotion evidence
+        assert cli._probe(s1.address) is False
+        s1.resume()
+        assert cli._probe(s1.address) is True
+        assert cli._addr_epoch[s1.address] == e0 + 2
+        # replay of the drain-era verdict, delivered late: the epoch
+        # is below the newest witnessed -> ignored, replica stays
+        conn = cli._conn_for(s1.address)
+        conn.last_ping = {"draining": True, "epoch": e0 + 1}
+        orig_ping = conn.ping
+        conn.ping = lambda **kw: True   # deliver the stale dict only
+        try:
+            assert cli._probe(s1.address) is True
+        finally:
+            conn.ping = orig_ping
+        assert cli.stats()["failovers"] == 0
+    finally:
+        s2.stop()
+        s1.stop()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Offline consistency checker CLI (ISSUE 19 tentpole c).
+
+Point it at a journal directory written under ``MXTPU_HISTORY_DIR``
+and it proves — or disproves — the four replication guarantees over
+the recorded history: no acked write lost, no double apply,
+single-writer-per-epoch, monotone per-key clocks.
+
+    python tools/check_history.py /tmp/drill_history
+    python tools/check_history.py --json /tmp/drill_history
+
+Exit 0 = history is clean; 1 = at least one proven violation;
+2 = usage / empty history. Every partition drill ends here.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxtpu.devtools import consistency          # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="check a journaled dist_async history for "
+                    "lost acks, double applies, split-brain writers "
+                    "and clock regressions")
+    ap.add_argument("history_dir", help="directory of history-*.jsonl "
+                                        "files (MXTPU_HISTORY_DIR)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report dict as JSON")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.history_dir):
+        print("check_history: %r is not a directory" % args.history_dir,
+              file=sys.stderr)
+        return 2
+    report = consistency.check(args.history_dir)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(consistency.format_report(report))
+    if report["ops"] == 0:
+        print("check_history: empty history (nothing was journaled — "
+              "was MXTPU_HISTORY_DIR set for the drill?)",
+              file=sys.stderr)
+        return 2
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
