@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Structural perf smoke for the pjit-sharded fused step (ISSUE 20).
+
+Runs on 8 emulated CPU devices (the XLA host-platform knob) and pins
+the mesh-mode contracts that wall-clock can't, in the style of
+``check_module_perf.py``:
+
+1. **The store is really distributed**: with every parameter dim-0
+   divisible by the mesh, the per-device addressable bytes of the
+   donated param + optimizer-state store are <= ~1/N of the total
+   (small slack for the replicated scalars: step count, lr, rng key).
+2. **Zero retraces after warmup**: a steady-state epoch through the
+   SPMD program adds zero program-cache misses.
+3. **Transfer-guard clean**: the same epoch runs under
+   ``jax.transfer_guard_device_to_host("disallow")`` — mesh mode must
+   not introduce per-batch host syncs (scatter/gather stays device
+   side, the metric accumulates on the mesh).
+4. **Sharded serving menu**: an ``InferenceEngine(mesh=...)`` answers
+   repeat requests and weight swaps with ZERO new compiles.
+
+Run: ``JAX_PLATFORMS=cpu python ci/check_mesh_perf.py`` (wired into
+``ci/run_ci.sh`` fast). No timing, no thresholds in seconds.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ["MXTPU_MODULE_FUSED"] = "1"
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+import numpy as np                                    # noqa: E402
+import jax                                            # noqa: E402
+
+import mxtpu as mx                                    # noqa: E402
+from mxtpu.parallel import MeshContext                # noqa: E402
+
+N_DEV = 8
+_BATCHES = 12
+# replicated-scalar slack on top of the ideal 1/N split: step count,
+# lr, rng key, metric accumulator — a few KB, not a few MB
+_SLACK_BYTES = 8 * 1024
+
+
+def _no_d2h():
+    guard = getattr(jax, "transfer_guard_device_to_host", None)
+    if guard is None:                                 # pragma: no cover
+        return contextlib.nullcontext()
+    return guard("disallow")
+
+
+def _mlp():
+    # every param's dim 0 divides the 8-way mesh: fc1_weight (256, 64),
+    # fc1_bias (256,), fc2_weight (8, 256), fc2_bias (8,)
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=256, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _store_leaves(mod):
+    """Every persistent device buffer of the donated train store:
+    params + optimizer-state leaves (momentum etc.)."""
+    leaves = [a._data for a in mod._fused._group.param_store.values()]
+    for state in getattr(mod._updater, "states", {}).values():
+        for leaf in jax.tree_util.tree_leaves(state):
+            if hasattr(leaf, "_data"):
+                leaf = leaf._data
+            if hasattr(leaf, "addressable_shards"):
+                leaves.append(leaf)
+    return leaves
+
+
+def _per_device_bytes(leaves):
+    per_dev = {}
+    total = 0
+    for arr in leaves:
+        total += arr.nbytes
+        for s in arr.addressable_shards:
+            per_dev[s.device.id] = per_dev.get(s.device.id, 0) \
+                + s.data.nbytes
+    return per_dev, total
+
+
+def main():
+    failures = []
+    if len(jax.devices()) != N_DEV:
+        print("check_mesh_perf: FAIL")
+        print("  - expected %d emulated devices, found %d (XLA_FLAGS "
+              "not honored?)" % (N_DEV, len(jax.devices())))
+        return 1
+
+    mesh = MeshContext({"model": N_DEV})
+    np.random.seed(0)
+    x = np.random.randn(128, 64).astype("float32")
+    y = np.random.randint(0, 8, 128).astype("float32")
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.set_sharding(mesh)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    if mod._fused is None:
+        print("check_mesh_perf: FAIL")
+        print("  - fused train step did not engage with set_sharding")
+        return 1
+    metric = mx.metric.create("acc")
+    batches = list(it)
+
+    def one(batch):
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+    for b in batches[:2]:                     # warmup compiles
+        one(b)
+    metric.get()
+    fs = mod._fused._group
+    if fs.mesh is None:
+        failures.append("fused group lost the mesh (fs.mesh is None)")
+    compiles_before = fs.stats["compiles"]
+
+    # -- 2+3: steady-state epoch: zero retraces, transfer-guard clean --
+    try:
+        with _no_d2h():
+            for i in range(_BATCHES):
+                one(batches[i % len(batches)])
+    except Exception as e:
+        failures.append(
+            "steady-state mesh epoch performed a device->host transfer "
+            "per batch: %s: %s" % (type(e).__name__, str(e)[:200]))
+    if fs.stats["compiles"] != compiles_before:
+        failures.append(
+            "steady-state mesh epoch retraced: %d new compiles after "
+            "warmup" % (fs.stats["compiles"] - compiles_before))
+    metric.get()
+
+    # -- 1: the 1/N memory contract ------------------------------------
+    per_dev, total = _per_device_bytes(_store_leaves(mod))
+    if len(per_dev) != N_DEV:
+        failures.append("store occupies %d devices (want %d)"
+                        % (len(per_dev), N_DEV))
+    worst = max(per_dev.values())
+    bound = total // N_DEV + _SLACK_BYTES
+    if worst > bound:
+        failures.append(
+            "per-device store bytes %d exceed 1/N bound %d "
+            "(total %d over %d devices): params or opt state are "
+            "not actually sharded" % (worst, bound, total, N_DEV))
+
+    # -- 4: the sharded serving menu -----------------------------------
+    from mxtpu.serving import InferenceEngine
+    args, _ = mod.get_params()
+    host = {k: v.asnumpy() for k, v in args.items()}
+    eng = InferenceEngine(_mlp(), host, {}, {"data": (64,)},
+                          buckets=(4,), warm=True, mesh=mesh)
+    q = np.random.randn(4, 64).astype(np.float32)
+    eng.predict([q])
+    serve_compiles = eng.stats()["compiles"]
+    eng.predict([q])
+    eng.swap_weights(host)
+    eng.predict([q])
+    if eng.stats()["compiles"] != serve_compiles:
+        failures.append(
+            "sharded serving recompiled on a repeat request / weight "
+            "swap (%d -> %d)" % (serve_compiles,
+                                 eng.stats()["compiles"]))
+    fp = eng.program_fingerprint()
+    if fp.get("mesh", {}).get("shape") != [["model", N_DEV]]:
+        failures.append("serving fingerprint does not pin the mesh "
+                        "topology: %r" % (fp.get("mesh"),))
+
+    if failures:
+        print("check_mesh_perf: FAIL")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("check_mesh_perf: OK (store %d B over %d devices, worst "
+          "per-device %d B <= %d B (~1/N), zero retraces after warmup, "
+          "transfer-guard clean, sharded serving swap/repeat without "
+          "recompiles)" % (total, N_DEV, worst, bound))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

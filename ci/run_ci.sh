@@ -31,6 +31,8 @@ case "$tier" in
     JAX_PLATFORMS=cpu python ci/check_module_perf.py
     JAX_PLATFORMS=cpu python ci/check_module_perf.py --dist
     JAX_PLATFORMS=cpu python ci/check_module_perf.py --amp
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      JAX_PLATFORMS=cpu python ci/check_mesh_perf.py
     JAX_PLATFORMS=cpu python ci/check_embedding_perf.py
     JAX_PLATFORMS=cpu python ci/check_replication.py
     JAX_PLATFORMS=cpu python ci/check_partition.py

@@ -24,6 +24,17 @@ of machinery the tree already trusts:
 
 Run: JAX_PLATFORMS=cpu python example/char_lm/char_lm.py
      [--dim 32] [--layers 2] [--epochs 8] [--seq-len 48]
+
+Long-context training (ISSUE 20): ``--mesh-seq N`` builds an N-way
+``seq`` mesh axis and trains the same symbols with attention routed
+through ``parallel/ring_attention.py`` — each device holds T/N query
+rows, K/V blocks rotate via ppermute, attention memory is O(T/N) per
+device — while the fused train step runs as a pjit mesh program
+(``Module.set_sharding``). Serving is untouched: decode steps are
+T=1 and never route.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+     python example/char_lm/char_lm.py --mesh-seq 8
 """
 import argparse
 import os
@@ -104,6 +115,9 @@ def main(argv=None):
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--model-prefix", default=None,
                     help="checkpoint prefix (default: a temp dir)")
+    ap.add_argument("--mesh-seq", type=int, default=0,
+                    help="sequence-parallel mesh axis size: train with "
+                         "ring attention over N devices (0 = off)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
@@ -125,13 +139,28 @@ def main(argv=None):
     mod = mx.mod.Module(train_symbol(D, args.heads, args.layers, T),
                         context=mx.cpu(), data_names=sorted(feed),
                         label_names=["softmax_label"])
-    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
-            optimizer_params={"learning_rate": 3e-3},
-            initializer=mx.init.Xavier(),
-            eval_metric=mx.metric.Perplexity(ignore_label=None))
-    it.reset()
-    ppl = dict(mod.score(
-        it, mx.metric.Perplexity(ignore_label=None)))["perplexity"]
+    import contextlib
+    train_scope = contextlib.nullcontext()
+    if args.mesh_seq > 1:
+        # the long-context lever: seq-parallel ring attention inside a
+        # pjit mesh train program (attention memory O(T/N) per device)
+        from mxtpu.parallel import MeshContext
+        from mxtpu.ops.nn import seq_parallel
+        if T % args.mesh_seq:
+            raise SystemExit("--seq-len %d not divisible by --mesh-seq"
+                             " %d" % (T, args.mesh_seq))
+        mesh = MeshContext({"seq": args.mesh_seq})
+        mod.set_sharding(mesh)
+        train_scope = seq_parallel(mesh)
+        print("mesh:", mesh, "— attention rides the seq ring")
+    with train_scope:
+        mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+                optimizer_params={"learning_rate": 3e-3},
+                initializer=mx.init.Xavier(),
+                eval_metric=mx.metric.Perplexity(ignore_label=None))
+        it.reset()
+        ppl = dict(mod.score(
+            it, mx.metric.Perplexity(ignore_label=None)))["perplexity"]
     assert ppl < 1.35, "corpus not learned (perplexity %.3f)" % ppl
 
     # -- save the GENERATION artifact (bigger cache, same params) ----------

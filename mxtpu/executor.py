@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from .base import canonical_dtype, backward_mirror_enabled, maybe_remat
 from .context import current_context
-from .layout import AutoLayoutStep, auto_format
+from .layout import AutoLayoutStep, MeshStep, auto_format
 from .ops.registry import rng_scope, split2 as _split2
 from .symbol import eval_graph
 from . import ndarray as nd
@@ -215,6 +215,15 @@ class Executor:
         self._key, sub = _split2(self._key)
         arg_vals = tuple(self.arg_dict[n]._data for n in self._arg_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
+        if self._arg_names or self._aux_names:
+            # params adopted from a mesh-sharded fused store live on every
+            # mesh device while freshly-fed data sits on one; replicate the
+            # minority so the jit sees one consistent device set (the
+            # program then runs as a GSPMD mesh program)
+            from .ndarray import _align_devices
+            merged = _align_devices(list(arg_vals) + list(aux_vals))
+            arg_vals = tuple(merged[:len(arg_vals)])
+            aux_vals = tuple(merged[len(arg_vals):])
         if self._cached_grads is not None and not self._grads_served:
             # the previous speculated backward was never consumed (e.g.
             # training-mode prediction loops) — stop paying for it
@@ -422,10 +431,72 @@ class Executor:
                          for n, o in zip(new, old))
         return jnp.where(ok, new, old)
 
+    def _mesh_plan(self, mesh, rules, train_names, state_trees=None):
+        """NamedSharding placement plan for a mesh-compiled fused step
+        (ISSUE 20): parameters and aux states place through
+        ``rules.sharding_for`` (first match wins, unmatched names
+        replicate, non-dividing mesh axes drop per dim); optimizer-state
+        leaves inherit their parameter's sharding when param-shaped
+        (momenta, adam variance — the ZeRO memory win) and replicate
+        otherwise (scalar step counts). Returns ``(param_sh, state_sh,
+        aux_sh, repl)``; ``state_sh`` is None when no state trees were
+        given."""
+        if rules is None:
+            from .parallel.mesh import ShardingRules
+            rules = ShardingRules([])
+        repl = mesh.replicated()
+        param_sh = tuple(
+            rules.sharding_for(mesh, n, tuple(self.arg_dict[n].shape))
+            for n in train_names)
+        aux_sh = tuple(
+            rules.sharding_for(mesh, n, tuple(self.aux_dict[n].shape))
+            for n in self._aux_names)
+        state_sh = None
+        if state_trees is not None:
+            state_sh = tuple(
+                jax.tree_util.tree_map(
+                    lambda leaf, _p=psh, _w=tuple(
+                        self.arg_dict[n].shape):
+                        _p if tuple(getattr(leaf, "shape", ())) == _w
+                        else repl,
+                    st)
+                for n, psh, st in zip(train_names, param_sh,
+                                      state_trees))
+        return param_sh, state_sh, aux_sh, repl
+
+    def _mesh_other_shardings(self, mesh, rules, other_names,
+                              batch_names):
+        """Placement for the non-donated inputs of a mesh program:
+        batch tensors (data/labels) shard dim 0 over the ``data`` axis
+        when it exists and divides — the ``_split_input_slice``
+        equivalent done by GSPMD instead of host-side np splits — and
+        fixed (non-trained) parameters follow the rules like any other
+        parameter. Everything the mesh program touches must live on the
+        mesh's full device set: replication is the fallback, never a
+        single-device placement."""
+        from .parallel.mesh import AXIS_DATA
+        repl = mesh.replicated()
+        batch_set = set(batch_names or ())
+        out = []
+        for n in other_names:
+            shape = tuple(self.arg_dict[n].shape)
+            if n in batch_set:
+                dsize = mesh.axis_size(AXIS_DATA)
+                out.append(mesh.batch_sharding()
+                           if shape and dsize > 1
+                           and shape[0] % dsize == 0 else repl)
+            elif rules is not None:
+                out.append(rules.sharding_for(mesh, n, shape))
+            else:
+                out.append(repl)
+        return tuple(out)
+
     def make_fused_train_step(self, train_names, optimizer, opt_slots,
                               metric_fn=None, donate=True,
                               compute_dtype=None, loss_scale=None,
-                              cast_exclude=(), auto_layout=False):
+                              cast_exclude=(), auto_layout=False,
+                              mesh=None, rules=None, state_trees=None,
+                              batch_names=()):
         """Build ONE donated jitted XLA program running the whole train
         step: forward + backward (ones cotangents, loss-head pattern) +
         the ENTIRE optimizer update as a multi-tensor apply (every
@@ -469,6 +540,20 @@ class Executor:
         layouts across steps) and returns an
         :class:`~mxtpu.layout.AutoLayoutStep` that relayouts the donated
         store exactly once at compile, not per call.
+
+        ``mesh`` + ``rules`` (ISSUE 20) compile the SAME program as an
+        SPMD mesh program: the donated store is placed with explicit
+        ``in_shardings``/``out_shardings`` from
+        :meth:`_mesh_plan` (params/aux by rule, optimizer-state leaves
+        inheriting their parameter's sharding, scalars replicated) and
+        a :class:`~mxtpu.layout.MeshStep` scatters the seed store
+        across the mesh on first call — per-device param+opt memory
+        ~1/N, zero per-step resharding because out matches in.
+        ``state_trees`` supplies the optimizer-state tree structure for
+        per-leaf placement; ``batch_names`` are the data/label inputs
+        eligible for dim-0 ``data``-axis sharding. Mesh placement wins
+        over ``auto_layout`` (AUTO markers don't compose with explicit
+        NamedShardings).
 
         Returns ``(fn, other_names)`` where ``fn(train_vals, state_trees,
         aux_vals, other_vals, key, t, lr, metric_acc) -> (new_vals,
@@ -557,6 +642,23 @@ class Executor:
             return (tuple(new_vals), tuple(new_states), tuple(new_aux),
                     outs, key, t, metric_acc)
 
+        if mesh is not None:
+            param_sh, state_sh, aux_sh, repl = self._mesh_plan(
+                mesh, rules, train_names, state_trees)
+            other_sh = self._mesh_other_shardings(
+                mesh, rules, other_names, batch_names)
+            jitted = jax.jit(
+                fused,
+                in_shardings=(param_sh, state_sh, aux_sh, other_sh,
+                              repl, repl, repl, repl),
+                out_shardings=(param_sh, state_sh, aux_sh, None,
+                               repl, repl, repl),
+                donate_argnums=donate_argnums)
+            sh_map = {0: param_sh, 2: aux_sh, 3: other_sh,
+                      4: repl, 5: repl, 6: repl, 7: repl}
+            if state_sh is not None:
+                sh_map[1] = state_sh
+            return MeshStep(jitted, mesh, sh_map), other_names
         if auto_layout:
             auto = auto_format()
             jitted = jax.jit(
@@ -574,7 +676,8 @@ class Executor:
                              donate=True, compute_dtype=None,
                              loss_scale=None, cast_exclude=(),
                              wire_dtype=None, auto_layout=False,
-                             sparse_emits=None):
+                             sparse_emits=None, mesh=None, rules=None,
+                             batch_names=()):
         """Grad-EMITTING mode of the fused train step — the
         kvstore/dist path (ISSUE 10). ONE jitted program runs forward +
         backward (ones cotangents, loss-head pattern) + the optional
@@ -615,6 +718,14 @@ class Executor:
         afterwards. Aux states (1), the rng key (3) and the metric
         accumulator (4) are donated; the caller rebinds their wrappers
         every step exactly like the train-step contract.
+
+        ``mesh`` + ``rules`` (ISSUE 20) compile the grad emitter as an
+        SPMD mesh program like :meth:`make_fused_train_step`: params
+        and aux place by rule, emitted gradients keep unspecified out
+        shardings (the pull gathers them host-side either way), and
+        the returned :class:`~mxtpu.layout.MeshStep` re-scatters the
+        freshly-pulled params each step — inherent to the dist cycle,
+        not a retrace. Mesh wins over ``auto_layout``.
 
         Returns ``(fn, other_names)`` where ``fn(train_vals, aux_vals,
         other_vals, key, metric_acc) -> (grads, new_aux, outs, key',
@@ -726,6 +837,19 @@ class Executor:
                     metric_acc = metric_acc + contrib
             return grads, tuple(new_aux), outs, key, metric_acc
 
+        if mesh is not None:
+            param_sh, _unused, aux_sh, repl = self._mesh_plan(
+                mesh, rules, train_names)
+            other_sh = self._mesh_other_shardings(
+                mesh, rules, other_names, batch_names)
+            jitted = jax.jit(
+                fused_grads,
+                in_shardings=(param_sh, aux_sh, other_sh, repl, repl),
+                out_shardings=(None, aux_sh, None, repl, repl),
+                donate_argnums=donate_argnums)
+            return MeshStep(jitted, mesh, {
+                0: param_sh, 1: aux_sh, 2: other_sh,
+                3: repl, 4: repl}), other_names
         if auto_layout:
             # AUTO only where donation carries the layout across steps
             # (the aux store); params arrive via the kvstore pull's
@@ -744,7 +868,8 @@ class Executor:
             other_names
 
     def make_fused_apply_step(self, train_names, optimizer, opt_slots,
-                              donate=True, auto_layout=False):
+                              donate=True, auto_layout=False,
+                              mesh=None, rules=None, state_trees=None):
         """The optimizer half of the fused step on its own — the
         locally-applied update of the kvstore dist path (ISSUE 10,
         ``update_on_kvstore=False``): after the pull returns the merged
@@ -757,6 +882,12 @@ class Executor:
         bf16 wire pull, ISSUE 12) upcast to the master-weight dtype
         inside ``functional_optimizer_step`` — the apply always runs
         fp32.
+
+        ``mesh`` + ``rules`` (ISSUE 20): params/state place by rule
+        like :meth:`make_fused_train_step`; the pulled gradients are
+        param-shaped, so they re-scatter into the params' shardings
+        each apply (the dist_local rendering of the input pipeline).
+        Mesh wins over ``auto_layout``.
 
         Returns ``fn(train_vals, state_trees, grad_vals, t, lr) ->
         (new_vals, new_states, t+1)``.
@@ -778,6 +909,18 @@ class Executor:
                     new_states.append(st2)
             return tuple(new_vals), tuple(new_states), t
 
+        if mesh is not None:
+            param_sh, state_sh, _unused, repl = self._mesh_plan(
+                mesh, rules, train_names, state_trees)
+            jitted = jax.jit(
+                fused_apply,
+                in_shardings=(param_sh, state_sh, param_sh, repl, repl),
+                out_shardings=(param_sh, state_sh, repl),
+                donate_argnums=donate_argnums)
+            sh_map = {0: param_sh, 2: param_sh, 3: repl, 4: repl}
+            if state_sh is not None:
+                sh_map[1] = state_sh
+            return MeshStep(jitted, mesh, sh_map)
         if auto_layout:
             auto = auto_format()
             jitted = jax.jit(

@@ -17,6 +17,12 @@ on first call, relayouts the persistent state into the executable's
 chosen input formats exactly once (``jax.device_put`` is a no-copy no-op
 when the layouts already match — every later call), and invokes the
 Compiled object directly.
+
+:class:`MeshStep` (ISSUE 20) is the same carry-through-donation idea
+one level up: instead of XLA-chosen layouts on one device, explicit
+``NamedSharding`` placements over a device mesh — the wrapper scatters
+the donated store across the mesh once and the program's matching
+out_shardings keep it there.
 """
 from __future__ import annotations
 
@@ -25,7 +31,8 @@ import os
 
 import jax
 
-__all__ = ["AutoLayoutStep", "auto_format", "auto_layout_enabled"]
+__all__ = ["AutoLayoutStep", "MeshStep", "auto_format",
+           "auto_layout_enabled"]
 
 
 def auto_layout_enabled(default=None):
@@ -107,3 +114,49 @@ class AutoLayoutStep:
         for i in self._state_argnums:
             args[i] = jax.device_put(args[i], fmts[i])
         return self._compiled(*args)
+
+
+class MeshStep:
+    """A fused step compiled as an SPMD program over a device mesh
+    (ISSUE 20): the ``jax.jit`` was built with explicit NamedSharding
+    ``in_shardings``/``out_shardings`` so the donated param/opt-state/
+    aux store lives SHARDED across the mesh — per-device memory ~1/N —
+    and GSPMD inserts the collectives.
+
+    ``shardings`` maps argnum -> the placement of that argument: a
+    single sharding, a tuple of shardings, or a nested tuple tree
+    mirroring an optimizer-state tree. Every call device_puts the
+    mapped arguments into their target shardings first: the FIRST call
+    scatters the single-device seed store across the mesh (one real
+    transfer), and every later call is a no-copy no-op because the
+    step's out_shardings equal its in_shardings — donation carries the
+    sharded buffers across steps, so the steady state is
+    reshard-free. Batch arguments mapped here pay one host->mesh
+    placement per step, which is the input pipeline, not a sync.
+    """
+
+    def __init__(self, jitted, mesh, shardings):
+        self._jit = jitted
+        self.mesh = mesh
+        self._shardings = dict(shardings)
+
+    @staticmethod
+    def _put(val, sh):
+        # pairwise recursion over matching tuple structure; a single
+        # sharding against a subtree broadcasts over its leaves
+        # (jax.device_put pytree semantics)
+        if isinstance(val, (tuple, list)) and \
+                isinstance(sh, (tuple, list)) and len(val) == len(sh):
+            return tuple(MeshStep._put(v, s) for v, s in zip(val, sh))
+        if val is None or sh is None:
+            return val
+        return jax.device_put(val, sh)
+
+    def lower(self, *args):  # compiled_step() parity with plain jit
+        return self._jit.lower(*args)
+
+    def __call__(self, *args):
+        args = list(args)
+        for i, sh in self._shardings.items():
+            args[i] = self._put(args[i], sh)
+        return self._jit(*args)

@@ -360,6 +360,57 @@ def dist_mode():
     return "async" if mode == "async" else "sync"
 
 
+def mesh_spec():
+    """MXTPU_MESH: engage the mesh-sharded fused step (ISSUE 20) with
+    no code changes — comma-separated ``axis=size`` pairs building a
+    MeshContext over all local devices, e.g. ``model=-1`` (every device
+    on the tensor axis) or ``data=2,model=4``; ``-1`` absorbs the
+    remainder like :func:`~mxtpu.parallel.mesh.make_mesh`. Unset/empty
+    keeps the single-device program. Modules configured explicitly via
+    ``Module.set_sharding`` win over the env."""
+    v = os.environ.get("MXTPU_MESH", "").strip()
+    if not v:
+        return None
+    out = {}
+    for part in v.split(","):
+        axis, sep, size = part.partition("=")
+        axis = axis.strip()
+        if not sep or not axis:
+            raise ValueError(
+                "MXTPU_MESH wants 'axis=size[,axis=size...]', got %r"
+                % (v,))
+        try:
+            out[axis] = int(size)
+        except ValueError:
+            raise ValueError("MXTPU_MESH axis %r has non-integer size "
+                             "%r" % (axis, size.strip()))
+    return out
+
+
+def _mesh_config(module):
+    """Resolve the module's mesh engagement: ``(mesh, rules, reason)``.
+    An explicit ``Module.set_sharding(mesh, rules)`` wins; otherwise
+    ``MXTPU_MESH`` builds the mesh and, with no rules given, every
+    parameter's dim 0 shards over the FIRST mesh axis where it divides
+    (FSDP-style — the 1/N memory default; non-dividing dims replicate
+    per ``ShardingRules._fit``)."""
+    mesh = getattr(module, "_mesh_ctx", None)
+    rules = getattr(module, "_sharding_rules", None)
+    if mesh is None:
+        spec = mesh_spec()
+        if spec is None:
+            return None, None, None
+        from ..parallel.mesh import MeshContext
+        mesh = MeshContext(spec)
+    if mesh.num_devices <= 1:
+        return None, None, "mesh has a single device"
+    if rules is None:
+        from ..parallel.mesh import PartitionSpec
+        from ..partition import PartitionRules
+        rules = PartitionRules([(r".*", PartitionSpec(mesh.axis_names[0]))])
+    return mesh, rules, None
+
+
 class FusedGroupState:
     """State shared by every module driving one optimizer (the
     ``borrow_optimizer`` group — a BucketingModule's buckets): the
@@ -401,6 +452,10 @@ class FusedGroupState:
         self.loss_scale = None           # static S, or None
         self.wire_dtype = None           # dist: emitted-gradient dtype
         self.auto_layout = auto_layout_enabled()
+        # mesh sharding (ISSUE 20, set_mesh): compile the group's
+        # programs as SPMD mesh programs with the store sharded by rule
+        self.mesh = None
+        self.rules = None
         # dist modes (attach_kvstore): the store, the sync/async policy
         # and the ONE shared push window across the group's buckets
         self.kv = None
@@ -436,6 +491,27 @@ class FusedGroupState:
             return 0
         return int(self.num_update) - int(jax.device_get(self.t_dev))
 
+    def set_mesh(self, mesh, rules):
+        """Engage mesh-sharded compilation for the group (ISSUE 20):
+        every program the group builds from here on places its donated
+        param/opt-state/aux store with the rules' NamedShardings over
+        ``mesh`` — per-device memory ~1/N. Fixed at maybe_create like
+        the AMP policy, so every bucket and cached program agrees.
+        AUTO layout markers don't compose with explicit NamedShardings,
+        so the mesh wins over ``MXTPU_AUTO_LAYOUT``."""
+        self.mesh = mesh
+        self.rules = rules
+        self.auto_layout = False
+
+    def scalar_target(self):
+        """Placement of the donated device scalars (rng key, step
+        count, lr, metric accumulator): replicated over the mesh in
+        mesh mode — a single-device scalar next to sharded stores
+        would make the program's device sets disagree — else the
+        group's context device."""
+        return self.mesh.replicated() if self.mesh is not None \
+            else self.ctx.jax_device()
+
     def attach_kvstore(self, kv):
         """Wire the group to its kvstore (dist modes): the shared async
         push/pull window (one per optimizer group — buckets share it)
@@ -456,7 +532,7 @@ class FusedGroupState:
     # -- donated device scalars -------------------------------------------
     def device_state(self):
         if self.key_dev is None:
-            dev = self.ctx.jax_device()
+            dev = self.scalar_target()
             key = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31 - 1))
             self.key_dev = jax.device_put(_np.asarray(key), dev)
             self.t_dev = jax.device_put(
@@ -478,13 +554,13 @@ class FusedGroupState:
         if new_lr != self.lr_host:
             self.lr_host = new_lr
             self.lr_dev = jax.device_put(
-                _np.asarray(new_lr, _np.float32), self.ctx.jax_device())
+                _np.asarray(new_lr, _np.float32), self.scalar_target())
         return self.lr_dev
 
     # -- device metric accumulator ----------------------------------------
     def _zero_acc(self):
         return jax.device_put(_np.zeros(2, _np.float32),
-                              self.ctx.jax_device())
+                              self.scalar_target())
 
     def drain_metric(self):
         """Fetch-and-zero the device (sum, count) pair — the ONE host
@@ -676,6 +752,13 @@ class FusedModuleTrainer:
     def _shape_sig(arrs):
         return tuple((tuple(a.shape), str(a.dtype)) for a in (arrs or []))
 
+    def _batch_names(self):
+        """The per-batch inputs (data + labels) — the names the mesh
+        plan may shard dim 0 over the ``data`` axis; fixed params keep
+        rule placement."""
+        mod = self._module
+        return tuple(mod._data_names) + tuple(mod._label_names)
+
     @staticmethod
     def _write_state(dst, tree):
         if dst is None:
@@ -754,18 +837,8 @@ class FusedModuleTrainer:
         key = (self._shape_sig(data_batch.data),
                self._shape_sig(data_batch.label), fs.metric_key)
         metric_fn = fs.metric_fn if fs.metric_key is not None else None
-        entry, hit = self._cache.get(
-            key, lambda: exec_.make_fused_train_step(
-                self._train_names, fs.optimizer, self._opt_slots,
-                metric_fn=metric_fn,
-                compute_dtype=fs.compute_dtype,
-                loss_scale=fs.loss_scale,
-                cast_exclude=tuple(mod._label_names),
-                auto_layout=fs.auto_layout))
-        fs.stats["cache_hits" if hit else "compiles"] += 1
-        fn, other_names = entry
-
-        exec_group.load_batch(data_batch)
+        # state trees are gathered BEFORE the program build: the mesh
+        # plan places optimizer-state leaves by their actual shapes
         train_vals = tuple(exec_.arg_dict[n]._data
                            for n in self._train_names)
         states_nd = [fs.updater.ensure_state(slot, exec_.arg_dict[name])
@@ -773,6 +846,21 @@ class FusedModuleTrainer:
                                            self._train_names)]
         state_trees = self._dedupe_donated(
             train_vals, tuple(state_to_tree(s) for s in states_nd))
+        entry, hit = self._cache.get(
+            key, lambda: exec_.make_fused_train_step(
+                self._train_names, fs.optimizer, self._opt_slots,
+                metric_fn=metric_fn,
+                compute_dtype=fs.compute_dtype,
+                loss_scale=fs.loss_scale,
+                cast_exclude=tuple(mod._label_names),
+                auto_layout=fs.auto_layout,
+                mesh=fs.mesh, rules=fs.rules,
+                state_trees=state_trees,
+                batch_names=self._batch_names()))
+        fs.stats["cache_hits" if hit else "compiles"] += 1
+        fn, other_names = entry
+
+        exec_group.load_batch(data_batch)
         aux_vals = tuple(exec_.aux_dict[n]._data for n in exec_._aux_names)
         other_vals = tuple(exec_.arg_dict[n]._data for n in other_names)
         key_dev, t_dev, _ = fs.device_state()
@@ -782,7 +870,7 @@ class FusedModuleTrainer:
             # step count so Adam-style bias correction stays aligned
             fs.num_update = int(fs.optimizer.num_update)
             t_dev = fs.t_dev = jax.device_put(
-                _np.asarray(fs.num_update, _np.int32), fs.ctx.jax_device())
+                _np.asarray(fs.num_update, _np.int32), fs.scalar_target())
         fs.num_update += 1
         lr_dev = fs.refresh_lr()
         if fs.metric_acc is None:
@@ -855,7 +943,9 @@ class FusedModuleTrainer:
                 cast_exclude=tuple(self._module._label_names),
                 wire_dtype=fs.wire_dtype,
                 auto_layout=fs.auto_layout,
-                sparse_emits=self._sparse_feeds or None))
+                sparse_emits=self._sparse_feeds or None,
+                mesh=fs.mesh, rules=fs.rules,
+                batch_names=self._batch_names()))
         fs.stats["cache_hits" if hit else "compiles"] += 1
         fn, other_names = entry
 
@@ -1012,12 +1102,6 @@ class FusedModuleTrainer:
         grad_vals = tuple(g._data for g in gouts)
         key = ("apply", tuple((tuple(g.shape), str(g.dtype))
                               for g in grad_vals))
-        fn, hit = self._cache.get(
-            key, lambda: exec_.make_fused_apply_step(
-                self._train_names, fs.optimizer, self._opt_slots,
-                auto_layout=fs.auto_layout))
-        fs.stats["cache_hits" if hit else "compiles"] += 1
-
         train_vals = tuple(exec_.arg_dict[n]._data
                            for n in self._train_names)
         states_nd = [fs.updater.ensure_state(slot, exec_.arg_dict[name])
@@ -1025,11 +1109,18 @@ class FusedModuleTrainer:
                                            self._train_names)]
         state_trees = self._dedupe_donated(
             train_vals, tuple(state_to_tree(s) for s in states_nd))
+        fn, hit = self._cache.get(
+            key, lambda: exec_.make_fused_apply_step(
+                self._train_names, fs.optimizer, self._opt_slots,
+                auto_layout=fs.auto_layout,
+                mesh=fs.mesh, rules=fs.rules,
+                state_trees=state_trees))
+        fs.stats["cache_hits" if hit else "compiles"] += 1
         _, t_dev, _ = fs.device_state()
         if fs.optimizer.num_update > fs.num_update:
             fs.num_update = int(fs.optimizer.num_update)
             t_dev = fs.t_dev = jax.device_put(
-                _np.asarray(fs.num_update, _np.int32), fs.ctx.jax_device())
+                _np.asarray(fs.num_update, _np.int32), fs.scalar_target())
         fs.num_update += 1
         lr_dev = fs.refresh_lr()
 
@@ -1188,6 +1279,14 @@ def maybe_create(module):
         group.set_amp(amp)
     elif amp_reason is not None:
         _log_amp_fallback(module, amp_reason)
+    mesh, rules, mesh_reason = _mesh_config(module)
+    if mesh is not None:
+        group.set_mesh(mesh, rules)
+    elif mesh_reason is not None:
+        logger = getattr(module, "logger", None) or logging
+        logger.debug("Module mesh sharding not engaged: %s — "
+                     "single-device fused step (docs/sharding.md)",
+                     mesh_reason)
     if mode != "local":
         group.attach_kvstore(module._kvstore)
     trainer = FusedModuleTrainer(module, group, mode)

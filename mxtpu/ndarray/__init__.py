@@ -325,6 +325,40 @@ def invoke(op, inputs, params):
     return out
 
 
+def _align_devices(values):
+    """Re-place eager inputs whose device commitments disagree.
+
+    Outputs of a pjit mesh program (Module.set_sharding /
+    MXTPU_MESH) are committed to every mesh device; eager math mixing
+    them with host-fed single-device arrays trips jax's incompatible-
+    devices check (metric updates do exactly this with the forward
+    outputs). Replicate the minority onto the widest device set so the
+    op stays lazy and runs where the data already lives."""
+    wide = None
+    mixed = False
+    for v in values:
+        if isinstance(v, jax.Array) and not isinstance(v, jax.core.Tracer):
+            if wide is None:
+                wide = v
+            elif v.sharding.device_set != wide.sharding.device_set:
+                mixed = True
+                if len(v.sharding.device_set) > \
+                        len(wide.sharding.device_set):
+                    wide = v
+    if not mixed or wide is None or len(wide.sharding.device_set) <= 1:
+        return values
+    mesh = getattr(wide.sharding, "mesh", None)
+    if mesh is None:
+        return values
+    from jax.sharding import NamedSharding, PartitionSpec
+    target = NamedSharding(mesh, PartitionSpec())
+    return [jax.device_put(v, target)
+            if isinstance(v, jax.Array)
+            and not isinstance(v, jax.core.Tracer)
+            and v.sharding.device_set != wide.sharding.device_set
+            else v for v in values]
+
+
 def _invoke_impl(op, inputs, params):
     values = []
     nd_inputs = []
@@ -335,6 +369,8 @@ def _invoke_impl(op, inputs, params):
         else:
             values.append(i)
             nd_inputs.append(None)
+    if len(values) > 1:
+        values = _align_devices(values)
     call_params = dict(params)
     if op.needs_train_flag:
         call_params["_training"] = _ag.is_training()

@@ -612,7 +612,54 @@ def identity(data):
 # garbage K/V, but the causal mask only ever exposes row j once j <= pos
 # of a later step — and the decode step at position j OVERWRITES row j
 # before attending it, so garbage is never visible.
+#
+# Long-context route (ISSUE 20): under a seq_parallel() scope (or an
+# ambient MeshContext) with a ``seq`` mesh axis, the FULL-WINDOW case
+# (T == S, the pos=0 training/prefill configuration where the chunk
+# covers the whole cache) computes the attention itself through
+# parallel/ring_attention.py — each device holds T/n query rows and the
+# K/V blocks rotate via ppermute, O(T/n) attention memory per device —
+# while the cache writes stay as-is so the op contract is unchanged.
+# Decode (T=1) and bucketed serving prefill (T < S) never route.
 # ---------------------------------------------------------------------------
+
+_SEQ_PARALLEL = []
+
+
+class seq_parallel:
+    """Scope routing full-window ``cached_attention`` through ring
+    attention over ``mesh``'s ``seq`` axis. Enter it around the code
+    that TRACES the program (``Module.fit``, an engine ``warm()``):
+    the route is decided at trace time, costs nothing per step, and
+    only engages when T == S and the seq axis divides T."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _SEQ_PARALLEL.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *a):
+        _SEQ_PARALLEL.pop()
+
+
+def _seq_parallel_mesh(T, S, H):
+    """The mesh to ring-route this cached_attention call over, or
+    None for the dense path (no scope/ambient mesh, no ``seq`` axis,
+    not the full-window configuration, or T not divisible)."""
+    mesh = _SEQ_PARALLEL[-1] if _SEQ_PARALLEL else None
+    if mesh is None:
+        from ..parallel.mesh import current_mesh
+        mesh = current_mesh()
+    if mesh is None:
+        return None
+    from ..parallel.mesh import AXIS_SEQ
+    n = mesh.axis_size(AXIS_SEQ)
+    if n <= 1 or T != S or T % n:
+        return None
+    return mesh
+
 
 @register("cached_attention", num_outputs=3)
 def cached_attention(query, key, value, k_cache, v_cache, pos, num_heads=1,
@@ -625,16 +672,35 @@ def cached_attention(query, key, value, k_cache, v_cache, pos, num_heads=1,
     distance is computed from the ABSOLUTE cache positions, the bias is
     bit-identical between a T-token prefill/training chunk and a
     one-token decode step — positional information with zero extra
-    state to carry between steps."""
+    state to carry between steps.
+
+    Inside a :class:`seq_parallel` scope the full-window case (T == S;
+    callers feed pos=0 there — the training configuration) attends via
+    ring attention over the mesh ``seq`` axis instead of the dense
+    [T, S] score matrix; the cache outputs are unchanged."""
     p = pos.astype(jnp.int32).reshape(-1)
     B, T, D = query.shape
     S = k_cache.shape[1]
     H = int(num_heads)
     hd = D // H
+    use_alibi = bool(alibi) and str(alibi).lower() not in ("false", "0")
     write = jax.vmap(
         lambda cache, rows, at: lax.dynamic_update_slice(cache, rows, (at, 0)))
     new_k = write(k_cache, key.astype(k_cache.dtype), p)
     new_v = write(v_cache, value.astype(v_cache.dtype), p)
+    mesh = _seq_parallel_mesh(T, S, H)
+    if mesh is not None:
+        from ..parallel.ring_attention import ring_attention_sharded
+
+        def heads_first(a):      # [B, T, D] -> [B, H, T, hd]
+            return a.astype(query.dtype).reshape(
+                B, T, H, hd).transpose(0, 2, 1, 3)
+
+        o = ring_attention_sharded(
+            heads_first(query), heads_first(key), heads_first(value),
+            mesh, causal=True, data_axis=None, alibi=use_alibi)
+        out = o.transpose(0, 2, 1, 3).reshape(B, T, D)
+        return out.astype(query.dtype), new_k, new_v
     qh = query.reshape(B, T, H, hd)
     kh = new_k.astype(query.dtype).reshape(B, S, H, hd)
     vh = new_v.astype(query.dtype).reshape(B, S, H, hd)
@@ -644,7 +710,7 @@ def cached_attention(query, key, value, k_cache, v_cache, pos, num_heads=1,
     s_idx = jnp.arange(S, dtype=jnp.int32)[None, None, :]
     q_abs = p[:, None, None] + t_idx                     # [B, T, 1]
     allowed = s_idx <= q_abs                             # [B, T, S]
-    if alibi and str(alibi).lower() not in ("false", "0"):
+    if use_alibi:
         slopes = jnp.asarray(
             [2.0 ** (-8.0 * (i + 1) / H) for i in range(H)],
             scores.dtype)
@@ -655,6 +721,33 @@ def cached_attention(query, key, value, k_cache, v_cache, pos, num_heads=1,
     att = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhts,bshd->bthd", att, vh).reshape(B, T, D)
     return out.astype(query.dtype), new_k, new_v
+
+
+# ---------------------------------------------------------------------------
+# Mixture-of-Experts FFN (ISSUE 20): the symbol-level wrapper over
+# parallel/moe.py's einsum dispatch/combine, so Module-built transformers
+# can carry an expert layer. With the expert weights rule-sharded over the
+# ``expert`` mesh axis (PartitionRules + Module.set_sharding), GSPMD lowers
+# the ecd/ech dispatch einsums to the expert all-to-all automatically.
+# ---------------------------------------------------------------------------
+
+@register("moe_ffn", num_outputs=2)
+def moe_ffn(data, gate_weight, w1, b1, w2, b2, capacity_factor=1.25,
+            num_selected=1):
+    """Expert feed-forward over the token dimension. ``data``
+    ``[B, T, D]`` (or already-flat ``[T, D]``); ``gate_weight
+    [D, E]``; ``w1 [E, D, H]``; ``b1 [E, H]``; ``w2 [E, H, D]``;
+    ``b2 [E, D]``. Returns ``(y, aux)`` — y shaped like data, aux a
+    ``(1,)`` Switch load-balancing loss (fraction * mean-prob per
+    expert; wire it into the training head or drop it — the combine
+    path keeps the gate differentiable either way)."""
+    from ..parallel.moe import moe_ffn as _moe_ffn
+    shape = data.shape
+    x = data.reshape(-1, shape[-1])
+    y, aux = _moe_ffn(x, gate_weight, w1, b1, w2, b2,
+                      capacity_factor=float(capacity_factor),
+                      num_selected=int(num_selected))
+    return y.reshape(shape).astype(data.dtype), aux.reshape(1)
 
 
 @register("SVMOutput")

@@ -12,7 +12,7 @@ Axes: ``data`` (DP), ``model`` (TP), ``pipe`` (PP), ``seq``
 """
 from .mesh import (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT,
                    make_mesh, MeshContext, ShardingRules, PartitionSpec,
-                   NamedSharding, Mesh, current_mesh)
+                   NamedSharding, Mesh, current_mesh, use_mesh)
 from .trainer import (ShardedTrainer, functional_optimizer_step,
                       state_to_tree, tree_to_state, device_prefetch)
 from .ring_attention import (ring_attention, ring_attention_sharded,
@@ -23,7 +23,7 @@ from .moe import moe_dispatch, moe_ffn, expert_sharding_rules
 __all__ = [
     "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
     "make_mesh", "MeshContext", "ShardingRules", "PartitionSpec",
-    "NamedSharding", "Mesh", "current_mesh",
+    "NamedSharding", "Mesh", "current_mesh", "use_mesh",
     "ShardedTrainer", "functional_optimizer_step", "state_to_tree",
     "tree_to_state", "device_prefetch",
     "ring_attention", "ring_attention_sharded", "ulysses_attention",

@@ -45,9 +45,34 @@ else:
             kwargs["check_rep"] = kwargs.pop("check_vma")
         return _shard_map_impl(*args, **kwargs)
 
+# Mesh-as-context API drift (same shape as the shard_map shim above):
+# older jax only has `with mesh:` (Mesh IS the context manager), newer
+# jax adds jax.sharding.use_mesh and deprecates/removes Mesh.__enter__.
+# Callers go through use_mesh() and get whichever this jax provides.
+try:                                 # jax >= 0.5 explicit-context API
+    from jax.sharding import use_mesh as _use_mesh_impl
+except ImportError:                  # older jax: Mesh is the manager
+    _use_mesh_impl = None
+
+
+def use_mesh(mesh):
+    """Context manager installing ``mesh`` as the ambient mesh for
+    pjit/sharding resolution — accepts a ``Mesh`` or ``MeshContext``.
+    Prefers the classic ``with mesh:`` resource-env semantics when the
+    Mesh context manager still exists, else ``jax.sharding.use_mesh``."""
+    if isinstance(mesh, MeshContext):
+        mesh = mesh.mesh
+    if hasattr(type(mesh), "__enter__"):
+        return mesh
+    if _use_mesh_impl is not None:
+        return _use_mesh_impl(mesh)
+    raise RuntimeError(
+        "this jax version has neither Mesh.__enter__ nor "
+        "jax.sharding.use_mesh")
+
 __all__ = ["AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
            "make_mesh", "MeshContext", "ShardingRules", "PartitionSpec",
-           "NamedSharding", "Mesh", "current_mesh", "shard_map"]
+           "NamedSharding", "Mesh", "current_mesh", "shard_map", "use_mesh"]
 
 AXIS_DATA = "data"
 AXIS_MODEL = "model"
@@ -104,6 +129,7 @@ class MeshContext:
             self.mesh = make_mesh(**mesh_or_sizes)
         else:
             self.mesh = make_mesh(devices=mesh_or_sizes, **axis_sizes)
+        self._mesh_cm = None
 
     # -- properties --------------------------------------------------------
     @property
@@ -141,11 +167,13 @@ class MeshContext:
 
     def __enter__(self):
         _CURRENT_MESH.append(self)
-        self.mesh.__enter__()
+        self._mesh_cm = use_mesh(self.mesh)
+        self._mesh_cm.__enter__()
         return self
 
     def __exit__(self, *a):
-        self.mesh.__exit__(*a)
+        cm, self._mesh_cm = self._mesh_cm, None
+        cm.__exit__(*a)
         _CURRENT_MESH.pop()
 
     def __repr__(self):

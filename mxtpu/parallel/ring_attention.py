@@ -33,19 +33,29 @@ __all__ = ["ring_attention", "ring_attention_sharded", "ulysses_attention",
            "local_attention"]
 
 
+def _alibi_slopes(h, dtype=jnp.float32):
+    """Per-head ALiBi slopes ``2^(-8(i+1)/H)`` (Press et al.) — the
+    same formula ``ops.nn.cached_attention`` uses, so the ring route
+    and the dense cache route agree on the bias."""
+    return jnp.asarray([2.0 ** (-8.0 * (i + 1) / h) for i in range(h)],
+                       dtype)
+
+
 def local_attention(q, k, v, causal=False, scale=None, q_offset=0,
-                    k_offset=0, impl="auto"):
+                    k_offset=0, impl="auto", alibi=False):
     """Softmax attention on local shards. q,k,v: [B, H, T, D].
 
     ``q_offset``/``k_offset`` give the global positions of the local rows
     for causal masking under sequence sharding. ``impl``: "flash" lowers
     to the Pallas flash-attention kernels (ops/pallas_attention.py),
     "xla" is the plain einsum+softmax path, "auto" picks flash on TPU
-    for sequences long enough to tile."""
+    for sequences long enough to tile. ``alibi=True`` subtracts the
+    per-head linear distance bias from the scores (the Pallas kernels
+    do not carry the bias, so alibi forces the xla path)."""
     if impl == "auto":
-        impl = ("flash" if jax.default_backend() == "tpu"
+        impl = ("flash" if jax.default_backend() == "tpu" and not alibi
                 and q.shape[2] >= 128 and k.shape[2] >= 128 else "xla")
-    if impl == "flash":
+    if impl == "flash" and not alibi:
         from ..ops.pallas_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                q_offset=q_offset, k_offset=k_offset)
@@ -53,9 +63,13 @@ def local_attention(q, k, v, causal=False, scale=None, q_offset=0,
     if scale is None:
         scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    qi = q_offset + jnp.arange(q.shape[2])
+    ki = k_offset + jnp.arange(k.shape[2])
+    if alibi:
+        dist = (qi[:, None] - ki[None, :]).astype(s.dtype)
+        s = s - _alibi_slopes(q.shape[1], s.dtype)[None, :, None, None] \
+            * dist[None, None]
     if causal:
-        qi = q_offset + jnp.arange(q.shape[2])
-        ki = k_offset + jnp.arange(k.shape[2])
         mask = qi[:, None] >= ki[None, :]
         s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
     p = jax.nn.softmax(s, axis=-1)
@@ -63,7 +77,7 @@ def local_attention(q, k, v, causal=False, scale=None, q_offset=0,
 
 
 def ring_attention(q, k, v, axis_name=AXIS_SEQ, causal=False, scale=None,
-                   impl="auto"):
+                   impl="auto", alibi=False):
     """Ring attention over a shard_map axis. q,k,v: local [B, H, T/n, D].
 
     Must run inside shard_map (or pmap) with ``axis_name`` bound. Each of
@@ -75,28 +89,38 @@ def ring_attention(q, k, v, axis_name=AXIS_SEQ, causal=False, scale=None,
     ``impl="flash"`` computes each ring step with the Pallas flash
     kernels (ops/pallas_attention.py): per-step (out, lse) pairs merge
     online via logaddexp, so the whole ring is one flash pass per K/V
-    block — "auto" picks flash on TPU for local shards >= 128 rows."""
+    block — "auto" picks flash on TPU for local shards >= 128 rows.
+
+    ``alibi=True`` subtracts the per-head linear distance bias from
+    every block's scores; the absolute ring positions (``my*t + i`` vs
+    ``src*t + j``) make the bias identical to the dense single-device
+    computation, so the ring route stays numerically compatible with
+    ``cached_attention``'s full-window path."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, h, t, d = q.shape
     if impl == "auto":
         impl = ("flash" if jax.default_backend() == "tpu" and t >= 128
-                else "xla")
-    if impl == "flash":
+                and not alibi else "xla")
+    if impl == "flash" and not alibi:
         return _ring_attention_flash(q, k, v, axis_name, causal, scale,
                                      n, my)
     if scale is None:
         scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     q32 = q.astype(jnp.float32)
     neg = jnp.finfo(jnp.float32).min
+    slopes = _alibi_slopes(h) if alibi else None
 
     def absorb(i, o, m, l, kk, vv):
         src = (my - i) % n          # whose K/V block we now hold
         s = jnp.einsum("bhqd,bhkd->bhqk", q32,
                        kk.astype(jnp.float32)) * scale
+        qi = my * t + jnp.arange(t)
+        ki = src * t + jnp.arange(t)
+        if alibi:
+            dist = (qi[:, None] - ki[None, :]).astype(jnp.float32)
+            s = s - slopes[None, :, None, None] * dist[None, None]
         if causal:
-            qi = my * t + jnp.arange(t)
-            ki = src * t + jnp.arange(t)
             mask = qi[:, None] >= ki[None, :]
             s = jnp.where(mask[None, None], s, neg)
         m_blk = jnp.max(s, axis=-1)
@@ -171,7 +195,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, n, my):
 
 def ring_attention_sharded(q, k, v, mesh, causal=False,
                            data_axis=AXIS_DATA, seq_axis=AXIS_SEQ,
-                           impl="auto"):
+                           impl="auto", alibi=False):
     """shard_map-bound ring attention over a MeshContext.
 
     q,k,v: global [B, H, T, D]; B sharded over ``data``, T over ``seq``.
@@ -181,10 +205,11 @@ def ring_attention_sharded(q, k, v, mesh, causal=False,
     spec = P(data_axis if data_axis in mesh.axis_names else None, None,
              seq_axis if seq_axis in mesh.axis_names else None, None)
     if seq_axis not in mesh.axis_names:
-        return local_attention(q, k, v, causal=causal, impl=impl)
+        return local_attention(q, k, v, causal=causal, impl=impl,
+                               alibi=alibi)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
-                          impl=impl),
+                          impl=impl, alibi=alibi),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

@@ -1,4 +1,4 @@
-"""One regex-rule partition spec, three layouts (ISSUE 10).
+"""One regex-rule partition spec, four layouts (ISSUE 10, ISSUE 20).
 
 Before this module the system kept THREE independent parameter layouts:
 ``ShardedTrainer`` placed parameters on the mesh through
@@ -23,10 +23,20 @@ three views of "where does this parameter live" never had to agree.
   (``AsyncDistKVStore.set_partition_rules``);
 * **checkpoint layout** — :meth:`layout`: one params blob per rule
   group (``CheckpointManager.save(..., layout=rules)``), so a shard's
-  keys restore from a shard's file.
+  keys restore from a shard's file;
+* **pjit mesh programs** (ISSUE 20) — :meth:`named_shardings`: the
+  same first-match-wins specs lifted into ``{name: NamedSharding}``
+  trees over a :class:`~mxtpu.parallel.mesh.MeshContext`, consumed as
+  ``in_shardings``/``out_shardings`` by the fused train step and the
+  AOT serving programs (unmatched names replicate; mesh axes that do
+  not divide a dim fall back to replication on that dim).
 
 ``tests/test_partition.py::test_layout_agreement`` pins the contract:
-two names in one rule group agree on all three layouts.
+two names in one rule group agree on all the layouts.
+
+``group_for``/``shard_for`` sit on the kvstore push/pull hot path
+(every key of every step); both memoize so the compiled-regex scan
+runs once per distinct key, not once per call.
 """
 from __future__ import annotations
 
@@ -62,6 +72,12 @@ class PartitionRules(ShardingRules):
     def __init__(self, rules=None):
         super().__init__(rules)
         self._row_sharded = set()
+        # hot-path memo caches: base name -> group pattern, and
+        # (name, n) -> shard. Both are monotone for a frozen rule list;
+        # mark_row_sharded changes shard routing, so it drops the shard
+        # cache (group routing is unaffected).
+        self._group_cache = {}
+        self._shard_cache = {}
 
     def mark_row_sharded(self, pattern):
         """Spread the matched group's row-range parts across shards
@@ -70,24 +86,44 @@ class PartitionRules(ShardingRules):
         if not any(p.pattern == pattern for p, _ in self.rules):
             raise ValueError("no rule with pattern %r" % (pattern,))
         self._row_sharded.add(pattern)
+        self._shard_cache.clear()   # mxlint: allow(shared-state-race) — config-time call, before the store's worker threads start routing keys
         return self
 
     def group_for(self, name):
         """The pattern of the first rule matching ``name`` (part
         subkeys match through their base key), or None when no rule
-        matches — callers fall back to their legacy layout."""
+        matches — callers fall back to their legacy layout. Memoized:
+        every push/pull consults this per key."""
         base = str(name).split(PART_SEP, 1)[0]
+        try:
+            return self._group_cache[base]
+        except KeyError:
+            pass
+        group = None
         for pat, _spec in self.rules:
             if pat.match(base):
-                return pat.pattern
-        return None
+                group = pat.pattern
+                break
+        self._group_cache[base] = group   # mxlint: allow(shared-state-race) — idempotent memo: racing writers store the same deterministic value, GIL keeps the dict op atomic
+        return group
 
     def shard_for(self, name, num_shards):
         """Deterministic group -> shard assignment: every key of one
         rule group lands on the same server — except row-sharded
         groups, whose part subkeys rotate across shards (part ``i`` on
         ``(group base + i) % n``) so one table spans the fleet. None
-        when no rule matches (caller keeps its per-key hash)."""
+        when no rule matches (caller keeps its per-key hash).
+        Memoized on (name, num_shards)."""
+        cache_key = (str(name), int(num_shards))
+        try:
+            return self._shard_cache[cache_key]
+        except KeyError:
+            pass
+        shard = self._shard_for_uncached(name, num_shards)
+        self._shard_cache[cache_key] = shard   # mxlint: allow(shared-state-race) — idempotent memo: racing writers store the same deterministic value, GIL keeps the dict op atomic
+        return shard
+
+    def _shard_for_uncached(self, name, num_shards):
         group = self.group_for(name)
         if group is None:
             return None
@@ -119,3 +155,41 @@ class PartitionRules(ShardingRules):
             tag = self.group_tag(g) if g is not None else ""
             groups.setdefault(tag, []).append(n)
         return groups
+
+    # -- fourth layout: pjit mesh programs (ISSUE 20) ----------------------
+    def named_shardings(self, mesh_ctx, shapes):
+        """``{name: NamedSharding}`` over ``shapes`` (dict name ->
+        shape tuple, or an iterable of (name, shape) pairs): the
+        sharding trees the mesh-compiled fused step and the AOT
+        serving programs place their donated stores with. First match
+        wins; an unmatched name replicates (``PartitionSpec()``); a
+        mesh axis that does not divide its dim is dropped for that dim
+        (inherited ``sharding_for`` semantics). Part subkeys route
+        through their base key, same as every other layout."""
+        items = shapes.items() if hasattr(shapes, "items") else shapes
+        out = {}
+        for name, shape in items:
+            base = str(name).split(PART_SEP, 1)[0]
+            out[name] = self.sharding_for(mesh_ctx, base, tuple(shape))
+        return out
+
+    def opt_state_shardings(self, mesh_ctx, shapes, state_tree):
+        """Shardings for an optimizer-state pytree: every param-shaped
+        leaf inherits its parameter's sharding; scalar / differently-
+        shaped leaves (step counts, scalar accumulators) replicate.
+        ``state_tree`` is ``{name: pytree of arrays}`` aligned with
+        ``shapes``."""
+        import jax
+
+        param_sh = self.named_shardings(mesh_ctx, shapes)
+        repl = mesh_ctx.replicated()
+        out = {}
+        for name, tree in state_tree.items():
+            want = tuple(shapes[name])
+            sh = param_sh.get(name, repl)
+            out[name] = jax.tree_util.tree_map(
+                lambda leaf, _sh=sh, _want=want:
+                    _sh if tuple(getattr(leaf, "shape", ())) == _want
+                    else repl,
+                tree)
+        return out

@@ -28,6 +28,19 @@ in steady state. :class:`InferenceEngine` renders that:
   property the failover drill's exactly-once/bit-identical acceptance
   check rests on.
 
+* **Sharded serving (ISSUE 20).** Pass ``mesh=``/``rules=`` (or set
+  ``MXTPU_MESH``) and the whole menu — predict buckets AND the
+  prefill/decode/adopt generation programs — lowers as SPMD programs
+  over the device mesh: the weight stores and the packed KV caches
+  live sharded per the rules (per-device bytes ~1/N), GSPMD inserts
+  the collectives, :meth:`swap_weights` device_puts each incoming
+  version straight into its per-name ``NamedSharding``, and
+  :meth:`program_fingerprint` grows the mesh topology + rules so a
+  prewarm file only installs on a matching fleet. Generation programs
+  carry explicit ``out_shardings`` because their outputs feed other
+  AOT programs (prefill rows -> adopt, decode state -> decode state):
+  an AOT call rejects an input whose placement differs from the
+  lowered aval, so the handoffs are pinned, not GSPMD's choice.
 * **Versioned weights (live streaming).** The params/aux device copies
   live in immutable per-version *stores*; :meth:`swap_weights` installs
   a fresh version (same names/shapes/dtypes — so every AOT program is a
@@ -59,7 +72,7 @@ from jax import lax
 from ..base import canonical_dtype
 from ..checkpoint import weight_digest
 from ..context import cpu
-from ..module.fused import ProgramCache
+from ..module.fused import ProgramCache, mesh_spec
 from ..symbol import eval_graph
 from ..ops.registry import rng_scope
 
@@ -130,10 +143,11 @@ class InferenceEngine:
 
     def __init__(self, symbol, arg_params, aux_params, data_shapes,
                  buckets=(1, 2, 4, 8, 16, 32), ctx=None, dtype="float32",
-                 warm=True, version=0):
+                 warm=True, version=0, mesh=None, rules=None):
         self._symbol = symbol
         self._ctx = ctx if ctx is not None else cpu()
         self._dev = self._ctx.jax_device()
+        self._mesh, self._rules = self._resolve_mesh(mesh, rules)
         self._buckets = parse_buckets(
             buckets if isinstance(buckets, str)
             else ",".join(str(b) for b in buckets))
@@ -166,10 +180,10 @@ class InferenceEngine:
         # replaces wholesale (programs take params as runtime arguments,
         # so a same-shape swap is always a program-cache hit)
         param_vals = tuple(
-            jax.device_put(self._host_array(arg_params[n]), self._dev)
+            self._put_named(n, self._host_array(arg_params[n]))
             for n in self._param_names)
         aux_vals = tuple(
-            jax.device_put(self._host_array(aux_params[n]), self._dev)
+            self._put_named(n, self._host_array(aux_params[n]))
             for n in self._aux_names)
         self._param_shapes = tuple((v.shape, _np.dtype(v.dtype))
                                    for v in param_vals)
@@ -200,6 +214,72 @@ class InferenceEngine:
     @staticmethod
     def _host_array(v):
         return v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+
+    # -- sharded placement (ISSUE 20) --------------------------------------
+    @staticmethod
+    def _resolve_mesh(mesh, rules):
+        """``(mesh, rules)`` for sharded serving, or ``(None, None)``
+        for the single-device engine. An explicit ``mesh=`` wins;
+        otherwise ``MXTPU_MESH`` builds one (same grammar as the fused
+        trainer). Default rules shard every parameter's dim 0 over the
+        first mesh axis where it divides — the FSDP-style 1/N-memory
+        default the trainer uses, so a server started with the same
+        env shards the same way the trainer trained."""
+        if mesh is None:
+            spec = mesh_spec()
+            if spec is None:
+                return None, None
+            from ..parallel.mesh import MeshContext
+            mesh = MeshContext(spec)
+        if mesh.num_devices <= 1:
+            return None, None
+        if rules is None:
+            from ..parallel.mesh import PartitionSpec
+            from ..partition import PartitionRules
+            rules = PartitionRules(
+                [(r".*", PartitionSpec(mesh.axis_names[0]))])
+        return mesh, rules
+
+    def _placement(self, name, shape):
+        """Where a named store array lives: the rules' NamedSharding
+        over the mesh (unmatched -> replicated; non-dividing mesh axes
+        dropped per-dim) in sharded mode, else the context device."""
+        if self._mesh is None:
+            return self._dev
+        return self._rules.sharding_for(self._mesh, name, tuple(shape))
+
+    def _put_named(self, name, host):
+        host = _np.asarray(host)
+        return jax.device_put(host, self._placement(name, host.shape))
+
+    def _data_placement(self, shape):
+        """Where a (padded) input batch lives: dim 0 over the ``data``
+        mesh axis when the bucket divides it, else replicated — never
+        a lone device, which would not compose with sharded params."""
+        if self._mesh is None:
+            return self._dev
+        from ..parallel.mesh import AXIS_DATA
+        d = self._mesh.axis_size(AXIS_DATA)
+        if shape and d > 1 and int(shape[0]) % d == 0:
+            return self._mesh.batch_sharding()
+        return self._mesh.replicated()
+
+    def _replicated(self):
+        return self._dev if self._mesh is None \
+            else self._mesh.replicated()
+
+    def _abs(self, shape, dtype, sharding=None):
+        """Abstract aval for AOT lowering. Single-device mode carries
+        no placement (lowering stays device-agnostic, unchanged from
+        the pre-mesh engine); sharded mode rides the placement along —
+        ``AutoLayoutStep._abstract``'s trick one level up — so the
+        compiled program IS the SPMD partition the real calls
+        dispatch. Default placement on the mesh is replicated."""
+        if self._mesh is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        if sharding is None or not hasattr(sharding, "mesh"):
+            sharding = self._mesh.replicated()
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -318,13 +398,12 @@ class InferenceEngine:
                     "weight version %d failed digest verification "
                     "(%s != %s) — refusing to serve corrupt params"
                     % (v, got[:12], digest[:12]))
-        param_vals = tuple(jax.device_put(host[n], self._dev)
+        param_vals = tuple(self._put_named(n, host[n])
                            for n in self._param_names)
         if aux_params is not None:
             aux_vals = tuple(
-                jax.device_put(_np.ascontiguousarray(
-                    self._host_array(aux_params[n])).astype(dt),
-                    self._dev)
+                self._put_named(n, _np.ascontiguousarray(
+                    self._host_array(aux_params[n])).astype(dt))
                 for n, (_s, dt) in zip(self._aux_names,
                                        self._aux_shapes))
         else:
@@ -454,14 +533,13 @@ class InferenceEngine:
                 "weight version %d failed digest verification — "
                 "the restored snapshot is not the recorded bits"
                 % version)
-        param_vals = tuple(jax.device_put(host[n], self._dev)
+        param_vals = tuple(self._put_named(n, host[n])
                            for n in self._param_names)
         aux_vals = None
         if aux_params is not None:
             aux_vals = tuple(
-                jax.device_put(_np.ascontiguousarray(
-                    self._host_array(aux_params[n])).astype(dt),
-                    self._dev)
+                self._put_named(n, _np.ascontiguousarray(
+                    self._host_array(aux_params[n])).astype(dt))
                 for n, (_s, dt) in zip(self._aux_names,
                                        self._aux_shapes))
         with self._store_lock:
@@ -632,13 +710,11 @@ class InferenceEngine:
 
         jitted = jax.jit(predict_fn, donate_argnums=(0,))
         data_abs = tuple(
-            jax.ShapeDtypeStruct((bucket,) + self._sample_shapes[n],
-                                 self._dtype)
+            self._abs((bucket,) + self._sample_shapes[n], self._dtype,
+                      self._data_placement(
+                          (bucket,) + self._sample_shapes[n]))
             for n in data_names)
-        param_abs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
-                          for v in self._param_vals)
-        aux_abs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
-                        for v in self._aux_vals)
+        param_abs, aux_abs = self._store_abs()
         with warnings.catch_warnings():
             # most models cannot alias the input buffer into an output
             # buffer; the donation is still correct (the batch is dead),
@@ -738,11 +814,23 @@ class InferenceEngine:
             % (plen, self.gen_prefill_menu()[-1]))
 
     def _store_abs(self):
-        param_abs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+        # sharded mode: the live store arrays already sit in their
+        # per-name NamedShardings, so their .sharding IS the aval
+        # placement (single-device mode stays placement-free)
+        param_abs = tuple(self._abs(v.shape, v.dtype, v.sharding)
                           for v in self._param_vals)
-        aux_abs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+        aux_abs = tuple(self._abs(v.shape, v.dtype, v.sharding)
                         for v in self._aux_vals)
         return param_abs, aux_abs
+
+    def _gen_state_placements(self, K):
+        """Per-state placements for the packed ``K``-slot decode
+        caches: rule-matched per name (the slot dim shards when K
+        divides its axis — the KV cache's share of the 1/N memory
+        win), replicated when unmatched, the lone device when no mesh
+        is configured."""
+        return tuple(self._placement(n, (K,) + s)
+                     for n, s, _dt, _i in self._gen["states"])
 
     def _build_gen_prefill(self, L):
         """Prompt in (padded to bucket ``L``, batch 1) -> (first greedy
@@ -774,14 +862,24 @@ class InferenceEngine:
             rows = tuple(outs[i] for _n, _s, _dt, i in states)
             return first, rows
 
-        jitted = jax.jit(prefill_fn, donate_argnums=(0,))
+        if self._mesh is None:
+            jitted = jax.jit(prefill_fn, donate_argnums=(0,))
+        else:
+            # explicit out_shardings: the prefill rows feed the adopt
+            # program, whose lowered avals pin their placement — the
+            # handoff must match exactly or the AOT call is rejected
+            repl = self._mesh.replicated()
+            row_sh = tuple(self._placement(n, (1,) + s)
+                           for n, s, _dt, _i in states)
+            jitted = jax.jit(prefill_fn, donate_argnums=(0,),
+                             out_shardings=(repl, row_sh))
         param_abs, aux_abs = self._store_abs()
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
             return jitted.lower(
-                jax.ShapeDtypeStruct((1, L), self._dtype),
-                jax.ShapeDtypeStruct((1,), _np.int32),
+                self._abs((1, L), self._dtype),
+                self._abs((1,), _np.int32),
                 param_abs, aux_abs).compile()
 
     def _build_gen_decode(self, K):
@@ -815,16 +913,25 @@ class InferenceEngine:
             return (nxt, nxt[:, None].astype(tok_feed.dtype),
                     pos + 1, new_states)
 
-        jitted = jax.jit(decode_fn, donate_argnums=(0, 1, 2))
+        state_sh = self._gen_state_placements(K)
+        if self._mesh is None:
+            jitted = jax.jit(decode_fn, donate_argnums=(0, 1, 2))
+        else:
+            # out state placement == in state placement: donation
+            # carries the sharded KV caches across steps reshard-free
+            repl = self._mesh.replicated()
+            jitted = jax.jit(decode_fn, donate_argnums=(0, 1, 2),
+                             out_shardings=(repl, repl, repl, state_sh))
         param_abs, aux_abs = self._store_abs()
-        state_abs = tuple(jax.ShapeDtypeStruct((K,) + s, dt)
-                          for _n, s, dt, _i in states)
+        state_abs = tuple(
+            self._abs((K,) + s, dt, sh)
+            for (_n, s, dt, _i), sh in zip(states, state_sh))
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
             return jitted.lower(
-                jax.ShapeDtypeStruct((K, 1), self._dtype),
-                jax.ShapeDtypeStruct((K,), _np.int32),
+                self._abs((K, 1), self._dtype),
+                self._abs((K,), _np.int32),
                 state_abs, param_abs, aux_abs).compile()
 
     def _build_gen_adopt(self, K):
@@ -849,22 +956,30 @@ class InferenceEngine:
                 for s, r in zip(state_vals, row_states))
             return tok_feed, pos, new_states
 
-        jitted = jax.jit(adopt_fn, donate_argnums=(0, 1, 2))
-        state_abs = tuple(jax.ShapeDtypeStruct((K,) + s, dt)
-                          for _n, s, dt, _i in states)
-        row_abs = tuple(jax.ShapeDtypeStruct((1,) + s, dt)
-                        for _n, s, dt, _i in states)
+        state_sh = self._gen_state_placements(K)
+        if self._mesh is None:
+            jitted = jax.jit(adopt_fn, donate_argnums=(0, 1, 2))
+        else:
+            repl = self._mesh.replicated()
+            jitted = jax.jit(adopt_fn, donate_argnums=(0, 1, 2),
+                             out_shardings=(repl, repl, state_sh))
+        state_abs = tuple(
+            self._abs((K,) + s, dt, sh)
+            for (_n, s, dt, _i), sh in zip(states, state_sh))
+        row_abs = tuple(
+            self._abs((1,) + s, dt, self._placement(n, (1,) + s))
+            for n, s, dt, _i in states)
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
             return jitted.lower(
-                jax.ShapeDtypeStruct((K, 1), self._dtype),
-                jax.ShapeDtypeStruct((K,), _np.int32),
+                self._abs((K, 1), self._dtype),
+                self._abs((K,), _np.int32),
                 state_abs,
-                jax.ShapeDtypeStruct((1,), _np.int32),
-                jax.ShapeDtypeStruct((1,), _np.int32),
+                self._abs((1,), _np.int32),
+                self._abs((1,), _np.int32),
                 row_abs,
-                jax.ShapeDtypeStruct((), _np.int32)).compile()
+                self._abs((), _np.int32)).compile()
 
     def _require_gen(self):
         if self._gen is None:
@@ -895,11 +1010,13 @@ class InferenceEngine:
         (K, 1), positions (K,) int32, per-state caches] — the triple a
         decode lane owns and every step donates forward."""
         self._require_gen()
-        tok_feed = jax.device_put(_np.zeros((K, 1), self._dtype),
-                                  self._dev)
-        pos = jax.device_put(_np.zeros((K,), _np.int32), self._dev)
-        states = tuple(jax.device_put(_np.zeros((K,) + s, dt), self._dev)
-                       for _n, s, dt, _i in self._gen["states"])
+        dev = self._replicated()
+        tok_feed = jax.device_put(_np.zeros((K, 1), self._dtype), dev)
+        pos = jax.device_put(_np.zeros((K,), _np.int32), dev)
+        states = tuple(
+            jax.device_put(_np.zeros((K,) + s, dt), sh)
+            for (_n, s, dt, _i), sh in zip(
+                self._gen["states"], self._gen_state_placements(K)))
         return [tok_feed, pos, states]
 
     def gen_prefill(self, tokens, param_vals, aux_vals):
@@ -915,9 +1032,10 @@ class InferenceEngine:
         padded = _np.zeros((1, L), self._dtype)
         padded[0, :plen] = arr
         program = self.gen_prefill_program(L)
+        dev = self._replicated()
         first, rows = program(
-            jax.device_put(padded, self._dev),
-            jax.device_put(_np.asarray([plen], _np.int32), self._dev),
+            jax.device_put(padded, dev),
+            jax.device_put(_np.asarray([plen], _np.int32), dev),
             param_vals, aux_vals)
         self._note("gen_prefills")
         return first, rows
@@ -950,15 +1068,24 @@ class InferenceEngine:
     def program_fingerprint(self):
         """What makes two engines program-compatible: the wire
         signature plus every store shape the compiled programs were
-        lowered against. A prewarm file only installs when this
-        matches exactly."""
+        lowered against — and, for a sharded engine, the mesh topology
+        and sharding rules (an SPMD program for an 8-way mesh must
+        never install on a different fleet shape). A prewarm file only
+        installs when this matches exactly."""
         import jax as _jax
-        return {"signature": self.signature(),
-                "params": [[list(s), str(d)]
-                           for s, d in self._param_shapes],
-                "aux": [[list(s), str(d)]
-                        for s, d in self._aux_shapes],
-                "jax": _jax.__version__}
+        fp = {"signature": self.signature(),
+              "params": [[list(s), str(d)]
+                         for s, d in self._param_shapes],
+              "aux": [[list(s), str(d)]
+                      for s, d in self._aux_shapes],
+              "jax": _jax.__version__}
+        if self._mesh is not None:
+            fp["mesh"] = {
+                "shape": [[a, int(self._mesh.axis_size(a))]
+                          for a in self._mesh.axis_names],
+                "rules": [[pat.pattern, str(spec)]
+                          for pat, spec in self._rules.rules]}
+        return fp
 
     def export_programs(self, path):
         """Serialize the warmed program menu for peers; returns the
@@ -1008,7 +1135,8 @@ class InferenceEngine:
                                    self._dtype)
                 padded[:rows] = arr
                 arr = padded
-            data_vals.append(jax.device_put(arr, self._dev))
+            data_vals.append(
+                jax.device_put(arr, self._data_placement(arr.shape)))
         outs = program(tuple(data_vals), param_vals, aux_vals)
         with self._stats_lock:
             self._stats["predicts"] += 1

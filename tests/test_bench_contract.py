@@ -153,6 +153,47 @@ def test_module_bench_amp_contract():
     assert dist["wire_bytes_ratio"] <= 0.55
 
 
+def test_module_bench_mesh_contract():
+    """tools/bench_module.py --mesh: exactly one JSON line, rc 0, with
+    the single-vs-sharded train/serve fields the mesh trajectory
+    (docs/perf_analysis.md "Sharded Module") is tracked by — tiny
+    model, 8 emulated CPU devices."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_BENCH_TINY="1",
+               MXTPU_PS_HEARTBEAT="0", PYTHONPATH=_ROOT,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    for k in ("MXTPU_MODULE_FUSED", "MXTPU_MESH"):
+        env.pop(k, None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_module.py"),
+         "--mesh", "--batches", "3", "--warmup", "2", "--no-write"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    lines = [l for l in res.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, "must print exactly ONE JSON line"
+    payload = json.loads(lines[0])
+    assert payload["bench"] == "module_fit_mesh"
+    assert payload["tiny"] is True
+    assert payload["devices"] == 8
+    train = payload["train"]
+    for field in ("batch_size", "fused_img_s", "mesh_img_s",
+                  "mesh_vs_single", "store_bytes",
+                  "store_bytes_worst_device", "store_devices"):
+        assert isinstance(train[field], (int, float)), field
+    assert train["fused_img_s"] > 0 and train["mesh_img_s"] > 0
+    # the structural half of the row holds at ANY size: the donated
+    # store (params + opt state) really splits ~1/N across the mesh
+    assert train["store_devices"] == 8
+    assert train["store_bytes_worst_device"] <= \
+        train["store_bytes"] // 8 + 8 * 1024
+    serve = payload["serve"]
+    for field in ("batch_size", "single_req_s", "mesh_req_s",
+                  "mesh_vs_single"):
+        assert isinstance(serve[field], (int, float)), field
+    assert serve["single_req_s"] > 0 and serve["mesh_req_s"] > 0
+    # steady-state sharded serving never recompiles (AOT menu)
+    assert serve["recompiles"] == 0
+
+
 def test_kvstore_bench_contract(tmp_path):
     """tools/bench_kvstore.py: exactly one JSON line, rc 0, with the
     fields the perf trajectory (docs/perf_analysis.md "Comms fast

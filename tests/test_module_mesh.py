@@ -1,0 +1,305 @@
+"""pjit-sharded fused training + sharded serving (ISSUE 20).
+
+conftest forces 8 emulated CPU devices, so every test here runs real
+SPMD programs: ``Module.set_sharding(mesh, rules)`` compiles the fused
+train step with the donated param/opt/aux stores sharded by rule,
+``MXTPU_MESH`` engages the same path from the environment, and
+``InferenceEngine(mesh=, rules=)`` AOT-compiles the serving menu over
+the mesh. Pinned here: numerics parity with the single-device
+programs, the rules -> NamedSharding mapping, the sharded checkpoint
+round-trip, zero steady-state retraces, and the seq-parallel ring
+attention route."""
+import numpy as np
+import pytest
+
+import jax
+
+import mxtpu as mx
+from mxtpu.parallel import MeshContext, PartitionSpec as P
+from mxtpu.partition import PartitionRules
+
+
+def _toy_problem(n=128, dim=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, dim).astype("float32")
+    w = rng.randn(dim, classes).astype("float32")
+    y = (x @ w).argmax(axis=1).astype("float32")
+    return x, y
+
+
+def _mlp(classes=4):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit(monkeypatch, mesh=None, rules=None, optimizer="sgd",
+         opt_params=None, epochs=2, env=()):
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "1")
+    for k, v in dict(env).items():
+        monkeypatch.setenv(k, v)
+    np.random.seed(7)
+    mx.random.seed(7)
+    x, y = _toy_problem()
+    train = mx.io.NDArrayIter(x, y, batch_size=32,
+                              label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    if mesh is not None:
+        mod.set_sharding(mesh, rules)
+    mod.fit(train, optimizer=optimizer,
+            optimizer_params=opt_params or {"learning_rate": 0.05,
+                                            "momentum": 0.9, "wd": 1e-4},
+            initializer=mx.initializer.Xavier(), num_epoch=epochs,
+            eval_metric="acc")
+    assert mod._fused is not None
+    args, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}
+
+
+def _store_arrays(mod):
+    return {n: a._data
+            for n, a in mod._fused._group.param_store.items()}
+
+
+def _spec(sharding):
+    """PartitionSpec normalized for comparison: trailing Nones trimmed
+    (P('model') and P('model', None) name the same placement)."""
+    t = tuple(sharding.spec)
+    while t and t[-1] is None:
+        t = t[:-1]
+    return t
+
+
+# ---------------------------------------------------------------------------
+# sharded fused training
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_mesh_vs_single_device_parity(monkeypatch, optimizer, opt_params):
+    """Params after K epochs must match between the mesh SPMD program
+    and the plain single-device fused step — same math, different
+    layout."""
+    mesh = MeshContext({"model": 8})
+    _, single = _fit(monkeypatch, optimizer=optimizer,
+                     opt_params=opt_params)
+    mod, sharded = _fit(monkeypatch, mesh=mesh, optimizer=optimizer,
+                        opt_params=opt_params)
+    assert single.keys() == sharded.keys()
+    for k in single:
+        np.testing.assert_allclose(sharded[k], single[k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    # the donated store actually lives on the mesh, FSDP dim-0 layout
+    store = _store_arrays(mod)
+    w = store["fc1_weight"]                      # (32, 16): 32 % 8 == 0
+    assert len(w.sharding.device_set) == 8
+    assert _spec(w.sharding) == ("model",)
+    # per-device bytes ~ 1/N for dividing params
+    shard = w.addressable_shards[0].data
+    assert shard.size * 8 == w.size
+
+
+def test_mesh_amp_bf16_parity(monkeypatch):
+    """AMP composes with the mesh: MXTPU_AMP=bf16 sharded-vs-single
+    stays bit-exact (same bf16 rounding, same reduction order)."""
+    mesh = MeshContext({"model": 8})
+    _, single = _fit(monkeypatch, env={"MXTPU_AMP": "bf16"})
+    _, sharded = _fit(monkeypatch, mesh=mesh, env={"MXTPU_AMP": "bf16"})
+    for k in single:
+        np.testing.assert_array_equal(sharded[k], single[k], err_msg=k)
+
+
+def test_mesh_steady_state_no_retrace(monkeypatch):
+    """After the first batch compiles the mesh program, further steps
+    (and epochs) must be cache hits — zero retraces, zero recompiles."""
+    mesh = MeshContext({"model": 8})
+    mod, _ = _fit(monkeypatch, mesh=mesh, epochs=1)
+    fs = mod._fused._group
+    compiles = fs.stats["compiles"]
+    x, y = _toy_problem()
+    batch = mx.io.DataBatch([mx.nd.array(x[:32])], [mx.nd.array(y[:32])])
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    assert fs.stats["compiles"] == compiles, \
+        "steady-state mesh steps must not recompile"
+
+
+def test_mxtpu_mesh_env_knob(monkeypatch):
+    """MXTPU_MESH=model=-1 engages the sharded step with no code
+    changes, numerics-parity with the unset default."""
+    _, single = _fit(monkeypatch)
+    mod, sharded = _fit(monkeypatch, env={"MXTPU_MESH": "model=-1"})
+    for k in single:
+        np.testing.assert_allclose(sharded[k], single[k],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    store = _store_arrays(mod)
+    assert len(store["fc1_weight"].sharding.device_set) == 8
+
+
+# ---------------------------------------------------------------------------
+# rules -> NamedSharding mapping
+# ---------------------------------------------------------------------------
+
+def test_named_shardings_mapping():
+    """First match wins; unmatched names replicate; a mesh axis that
+    does not divide its dim is dropped for that dim."""
+    mesh = MeshContext({"model": 8})
+    rules = PartitionRules([
+        (r"fc1_.*", P("model")),
+        (r"fc1_weight", P(None, "model")),       # shadowed: first wins
+        (r"odd_.*", P("model")),
+    ])
+    sh = rules.named_shardings(mesh, {
+        "fc1_weight": (32, 16), "fc1_bias": (32,),
+        "odd_weight": (6, 16), "other": (8, 8)})
+    assert _spec(sh["fc1_weight"]) == ("model",)
+    assert _spec(sh["fc1_bias"]) == ("model",)
+    assert _spec(sh["odd_weight"]) == (), \
+        "8 does not divide 6: the axis must drop, not crash"
+    assert _spec(sh["other"]) == (), "unmatched -> replicated"
+    for s in sh.values():
+        assert len(s.mesh.devices.ravel()) == 8
+
+
+def test_opt_state_shardings_inherit():
+    """Param-shaped optimizer-state leaves inherit the param sharding;
+    scalar leaves replicate."""
+    mesh = MeshContext({"model": 8})
+    rules = PartitionRules([(r".*", P("model"))])
+    shapes = {"w": (32, 4)}
+    state = {"w": {"mom": np.zeros((32, 4), np.float32),
+                   "step": np.zeros((), np.float32)}}
+    sh = rules.opt_state_shardings(mesh, shapes, state)
+    assert _spec(sh["w"]["mom"]) == ("model",)
+    assert _spec(sh["w"]["step"]) == ()
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoint round-trip
+# ---------------------------------------------------------------------------
+
+def test_sharded_checkpoint_roundtrip(monkeypatch, tmp_path):
+    """Params trained on the mesh travel through CheckpointManager
+    (grouped by the SAME PartitionRules) and restore bit-exact into a
+    fresh sharded serving engine."""
+    from mxtpu.checkpoint import CheckpointManager
+    from mxtpu.serving import InferenceEngine
+
+    mesh = MeshContext({"model": 8})
+    rules = PartitionRules([(r"fc1_.*", P("model")), (r".*", P())])
+    mod, trained = _fit(monkeypatch, mesh=mesh, rules=rules, epochs=1)
+    ckpt = CheckpointManager(str(tmp_path), async_save=False,
+                             use_orbax=False)
+    args, _ = mod.get_params()
+    ckpt.save(0, args, layout=rules)
+    tree = ckpt.restore(0)
+    assert set(tree["params"]) == set(trained)
+    for k, v in trained.items():
+        np.testing.assert_array_equal(tree["params"][k], v, err_msg=k)
+    # restored params drive a sharded engine identical to the original
+    restored = {k: np.asarray(v) for k, v in tree["params"].items()}
+    e0 = InferenceEngine(_mlp(), trained, {}, {"data": (16,)},
+                         buckets=(4,), warm=False)
+    e1 = InferenceEngine(_mlp(), restored, {}, {"data": (16,)},
+                         buckets=(4,), warm=False, mesh=mesh,
+                         rules=rules)
+    x = np.random.RandomState(1).randn(4, 16).astype(np.float32)
+    np.testing.assert_allclose(e1.predict([x])[0], e0.predict([x])[0],
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sharded serving
+# ---------------------------------------------------------------------------
+
+def test_sharded_engine_predict_parity_and_swap():
+    """The mesh engine's AOT menu matches the single-device engine,
+    repeat requests and weight swaps never retrace, and the program
+    fingerprint pins the mesh topology (prewarm refuses a mismatch)."""
+    from mxtpu.serving import InferenceEngine
+
+    def params(seed):
+        rng = np.random.RandomState(seed)
+        return {"fc1_weight": rng.randn(32, 16).astype(np.float32) * .1,
+                "fc1_bias": np.zeros(32, np.float32),
+                "fc2_weight": rng.randn(4, 32).astype(np.float32) * .1,
+                "fc2_bias": np.zeros(4, np.float32)}
+
+    mesh = MeshContext({"model": 8})
+    e0 = InferenceEngine(_mlp(), params(3), {}, {"data": (16,)},
+                         buckets=(4,), warm=True)
+    e1 = InferenceEngine(_mlp(), params(3), {}, {"data": (16,)},
+                         buckets=(4,), warm=True, mesh=mesh)
+    w = dict(zip(e1._param_names, e1._param_vals))["fc1_weight"]
+    assert len(w.sharding.device_set) == 8
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    np.testing.assert_allclose(e1.predict([x])[0], e0.predict([x])[0],
+                               rtol=1e-6, atol=1e-7)
+    compiles = e1.stats()["compiles"]
+    e1.predict([x])
+    assert e1.stats()["compiles"] == compiles, "repeat request retraced"
+    assert e0.swap_weights(params(9)) == e1.swap_weights(params(9)) == 1
+    np.testing.assert_allclose(e1.predict([x])[0], e0.predict([x])[0],
+                               rtol=1e-6, atol=1e-7)
+    assert e1.stats()["compiles"] == compiles, "swap_weights retraced"
+    # fingerprints: the mesh engine pins its topology, single stays bare
+    fp0, fp1 = e0.program_fingerprint(), e1.program_fingerprint()
+    assert "mesh" not in fp0
+    assert fp1["mesh"]["shape"] == [["model", 8]]
+
+
+# ---------------------------------------------------------------------------
+# seq-parallel ring attention route
+# ---------------------------------------------------------------------------
+
+def test_seq_parallel_ring_route_parity():
+    """Under ``seq_parallel(mesh)`` a full-window ``cached_attention``
+    routes through the ring (forward AND gradient parity with the
+    dense path); decode shapes (T=1) never route."""
+    from mxtpu.ops.nn import cached_attention, seq_parallel
+    import jax.numpy as jnp
+
+    B, T, D, H = 2, 16, 16, 2
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    kc = jnp.zeros((B, T, D))
+    vc = jnp.zeros((B, T, D))
+    pos = jnp.zeros((B,), jnp.int32)
+    mesh = MeshContext({"seq": 8})
+
+    dense, dk, dv = cached_attention(q, k, v, kc, vc, pos, num_heads=H,
+                                     alibi=True)
+    with seq_parallel(mesh):
+        ring, rk, rv = cached_attention(q, k, v, kc, vc, pos,
+                                        num_heads=H, alibi=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
+    assert jnp.array_equal(dk, rk) and jnp.array_equal(dv, rv)
+
+    def loss(qq, route):
+        def f(o):
+            return jnp.sum(o[0] * o[0])
+        if route:
+            with seq_parallel(mesh):
+                return f(cached_attention(qq, k, v, kc, vc, pos,
+                                          num_heads=H, alibi=True))
+        return f(cached_attention(qq, k, v, kc, vc, pos, num_heads=H,
+                                  alibi=True))
+
+    g0 = jax.grad(lambda qq: loss(qq, False))(q)
+    g1 = jax.grad(lambda qq: loss(qq, True))(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=1e-4, atol=1e-4)
+
+    # decode step: T=1 != S -> the dense cache path, ring never engages
+    with seq_parallel(mesh):
+        o1, _, _ = cached_attention(q[:, :1], k[:, :1], v[:, :1],
+                                    kc, vc, pos, num_heads=H, alibi=True)
+    assert o1.shape == (B, 1, D)

@@ -39,14 +39,27 @@ carry the dtype in the payload; the half-width-wire contract is
 bytes ratio <= 0.55, also pinned structurally by
 ``ci/check_module_perf.py --amp``).
 
+``--mesh`` (ISSUE 20) sweeps the pjit-sharded fused step: the fused
+single-device fit loop vs the same loop compiled as an SPMD program
+over an 8-way emulated mesh (``Module.set_sharding``), plus single vs
+sharded AOT serving (``InferenceEngine(mesh=...)``) request rates. On
+emulated CPU devices the mesh legs pay partitioning overhead instead
+of banking real-chip speedup, so the row carries the structural
+evidence alongside the rates: per-device store bytes (~1/N of total)
+and a zero-recompile steady serve state (the hard pins live in
+``ci/check_mesh_perf.py``).
+
 Prints exactly ONE JSON line (tests/test_bench_contract.py parses it)
 and mirrors it to docs/module_bench.json unless --no-write (the file
-keeps one line per bench kind: ``module_fit``, ``module_fit_dist``
-and ``module_fit_amp``). CPU-only. MXTPU_BENCH_TINY shrinks the
-models/batch counts for the contract test.
+keeps one line per bench kind: ``module_fit``, ``module_fit_dist``,
+``module_fit_amp`` and ``module_fit_mesh``). CPU-only.
+MXTPU_BENCH_TINY shrinks the models/batch counts for the contract
+test.
 
 Run: JAX_PLATFORMS=cpu python tools/bench_module.py [--dist|--amp]
      [--batches 100]
+     XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+       JAX_PLATFORMS=cpu python tools/bench_module.py --mesh
 """
 from __future__ import annotations
 
@@ -102,11 +115,14 @@ def _data(model, n, batch_size):
     return x, y
 
 
-def _steady_state_rate(mx, sym, x, y, batch_size, batches, warmup):
+def _steady_state_rate(mx, sym, x, y, batch_size, batches, warmup,
+                       mesh=None):
     """img/sec of the fit() hot loop after warmup, current env."""
     it = mx.io.NDArrayIter(x, y, batch_size=batch_size,
                            label_name="softmax_label")
     mod = mx.mod.Module(sym, context=mx.cpu())
+    if mesh is not None:
+        mod.set_sharding(mesh)
     mod.bind(it.provide_data, it.provide_label)
     mod.init_params(mx.initializer.Xavier())
     mod.init_optimizer(optimizer="sgd",
@@ -334,6 +350,117 @@ def run_amp(batches, warmup, batch_size=None):
                 "wire_bytes_ratio": round(wire_ratio, 3)}}
 
 
+def _mesh_store_stats(mx, jax, sym, x, y, batch_size, mesh):
+    """One mesh-mode train step, then the structural numbers the row
+    carries: host params for the serve leg + the donated store's
+    (total, worst-per-device, devices-occupied) byte split across
+    params AND optimizer-state leaves."""
+    it = mx.io.NDArrayIter(x, y, batch_size=batch_size,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.set_sharding(mesh)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01,
+                                         "momentum": 0.9})
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    leaves = [a._data for a in mod._fused._group.param_store.values()]
+    for state in getattr(mod._updater, "states", {}).values():
+        for leaf in jax.tree_util.tree_leaves(state):
+            leaf = getattr(leaf, "_data", leaf)
+            if hasattr(leaf, "addressable_shards"):
+                leaves.append(leaf)
+    per_dev, total = {}, 0
+    for arr in leaves:
+        total += arr.nbytes
+        for s in arr.addressable_shards:
+            per_dev[s.device.id] = per_dev.get(s.device.id, 0) \
+                + s.data.nbytes
+    args_, _ = mod.get_params()
+    host = {k: v.asnumpy() for k, v in args_.items()}
+    return host, total, max(per_dev.values()), len(per_dev)
+
+
+def _serve_rate(mx, sym, host, batches, mesh=None):
+    """req/sec of the AOT predict menu on repeat batch-8 requests,
+    plus the recompile count across the timed window (must be 0)."""
+    from mxtpu.serving import InferenceEngine
+    eng = InferenceEngine(sym, host, {}, {"data": (256,)},
+                          buckets=(8,), warm=True, mesh=mesh)
+    q = np.random.RandomState(1).randn(8, 256).astype("float32")
+    eng.predict([q])                      # any residual placement work
+    before = eng.stats()["compiles"]
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        eng.predict([q])
+    dt = time.perf_counter() - t0
+    return batches / dt, eng.stats()["compiles"] - before
+
+
+def run_mesh(batches, warmup, batch_size=None):
+    """The --mesh sweep (ISSUE 20): fused single-device vs pjit-sharded
+    fused train loop, and single vs sharded serving, on the emulated
+    8-way mesh. Every param dim 0 divides the mesh so the FSDP default
+    rule shards the whole store."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("MXTPU_PS_HEARTBEAT", "0")
+    import jax
+    import mxtpu as mx
+    from mxtpu.parallel import MeshContext
+
+    n_dev = len(jax.devices())
+    mesh = MeshContext({"model": n_dev})
+    hidden = (64, 32) if TINY else (256, 64)
+    sym = _mlp(mx, hidden=hidden, classes=8)
+    bs = batch_size or DEFAULT_BS["mlp"]
+    n = max(4 * bs, 64)
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 256).astype("float32")
+    y = rng.randint(0, 8, n).astype("float32")
+
+    saved = {k: os.environ.get(k)
+             for k in ("MXTPU_MODULE_FUSED", "MXTPU_MESH")}
+    os.environ.pop("MXTPU_MESH", None)     # explicit mesh only: the
+    os.environ["MXTPU_MODULE_FUSED"] = "1"  # single leg must stay single
+    try:
+        single_rate, f1 = _steady_state_rate(mx, sym, x, y, bs,
+                                             batches, warmup)
+        mesh_rate, f2 = _steady_state_rate(mx, sym, x, y, bs,
+                                           batches, warmup, mesh=mesh)
+        assert f1 and f2, "fused path did not engage"
+        host, store_total, store_worst, store_devs = _mesh_store_stats(
+            mx, jax, sym, x, y, bs, mesh)
+        serve_single, rc0 = _serve_rate(mx, sym, host, batches)
+        serve_mesh, rc1 = _serve_rate(mx, sym, host, batches, mesh=mesh)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {"bench": "module_fit_mesh", "tiny": TINY,
+            "batches": batches, "warmup": warmup,
+            "host_cores": os.cpu_count(), "devices": n_dev,
+            "train": {
+                "batch_size": bs,
+                "fused_img_s": round(single_rate, 1),
+                "mesh_img_s": round(mesh_rate, 1),
+                "mesh_vs_single": round(mesh_rate / single_rate, 2),
+                "store_bytes": store_total,
+                "store_bytes_worst_device": store_worst,
+                "store_devices": store_devs},
+            "serve": {
+                "batch_size": 8,
+                "single_req_s": round(serve_single, 1),
+                "mesh_req_s": round(serve_mesh, 1),
+                "mesh_vs_single": round(serve_mesh / serve_single, 2),
+                "recompiles": rc0 + rc1}}
+
+
 def run(batches, warmup, batch_size=None):
     import mxtpu as mx
 
@@ -382,11 +509,17 @@ def main():
                     help="mixed-precision microbench: fp32 vs bf16 fused "
                          "(single-host + dist sync over the wire, with "
                          "pushpull bytes/step)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="pjit-sharded microbench: fused single-device "
+                         "vs 8-way mesh train + serve (set XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8)")
     ap.add_argument("--no-write", action="store_true",
                     help="do not mirror the line to docs/module_bench.json")
     args = ap.parse_args()
 
-    if args.amp:
+    if args.mesh:
+        result = run_mesh(args.batches, args.warmup, args.batch_size)
+    elif args.amp:
         result = run_amp(args.batches, args.warmup, args.batch_size)
     elif args.dist:
         result = run_dist(args.batches, args.warmup, args.batch_size)
@@ -395,8 +528,9 @@ def main():
     line = json.dumps(result)
     print(line, flush=True)
     if not args.no_write:
-        # the file keeps one line per bench kind (module_fit and
-        # module_fit_dist): replace this kind's line, keep the other
+        # the file keeps one line per bench kind (module_fit,
+        # module_fit_dist, module_fit_amp, module_fit_mesh): replace
+        # this kind's line, keep the others
         path = os.path.join(ROOT, "docs", "module_bench.json")
         kept = []
         if os.path.exists(path):

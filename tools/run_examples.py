@@ -25,6 +25,7 @@ EXAMPLES = [
     ("sparse/linear_classification.py", {}),
     ("dlrm_click/dlrm_click.py", {}),
     ("char_lm/char_lm.py", {}),
+    ("moe_transformer/moe_transformer.py", {"DEVICES": 8}),
     ("autoencoder/mnist_sae.py", {}),
     ("adversary/fgsm_mnist.py", {}),
     ("svm_mnist/svm_mnist.py", {}),
